@@ -1,0 +1,26 @@
+"""Test configuration: force an 8-device virtual CPU mesh before JAX initializes.
+
+Multi-chip hardware is unavailable in CI; shardings are validated the way the driver's
+``dryrun_multichip`` does — over ``xla_force_host_platform_device_count`` CPU devices.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_config_singleton():
+    """Each test sees a fresh Config.from_env() so monkeypatched env vars apply."""
+    from tpurpc.utils import config as config_mod
+
+    config_mod.set_config(None)
+    yield
+    config_mod.set_config(None)

@@ -1,0 +1,111 @@
+"""Config / trace / stats unit tests (SURVEY.md §5 config+tracing subsystems)."""
+
+import pytest
+
+from tpurpc.utils import config as config_mod
+from tpurpc.utils import stats, trace
+from tpurpc.utils.config import Config, Platform, get_config
+
+
+def test_defaults_match_reference_readme():
+    # README.md:17-25 documents: ring 4MB, 1 poller thread, 500us busy-poll,
+    # 1000ms poller sleep.
+    cfg = Config()
+    assert cfg.platform is Platform.TCP
+    assert cfg.ring_buffer_size == 4 * 1024 * 1024
+    assert cfg.poller_thread_num == 1
+    assert cfg.busy_polling_timeout_us == 500
+    assert cfg.poller_sleep_timeout_ms == 1000
+    assert cfg.send_chunk_size == 512 * 1024
+
+
+@pytest.mark.parametrize(
+    "raw,expected",
+    [
+        ("TCP", Platform.TCP),
+        ("RDMA_BP", Platform.RING_BP),
+        ("RDMA_EVENT", Platform.RING_EVENT),
+        ("RDMA_BPEV", Platform.RING_BPEV),
+        ("RDMA_TPU", Platform.TPU),
+        ("TPU", Platform.TPU),
+        ("rdma_bpev", Platform.RING_BPEV),
+    ],
+)
+def test_platform_env_aliases(monkeypatch, raw, expected):
+    # The reference reads GRPC_PLATFORM_TYPE (iomgr_internal.cc:36-61); we accept
+    # its exact values plus our own spellings.
+    monkeypatch.setenv("GRPC_PLATFORM_TYPE", raw)
+    assert Config.from_env().platform is expected
+
+
+def test_unknown_platform_raises(monkeypatch):
+    monkeypatch.setenv("GRPC_PLATFORM_TYPE", "CARRIER_PIGEON")
+    with pytest.raises(ValueError, match="unknown platform"):
+        Config.from_env()
+
+
+def test_tpurpc_names_take_precedence(monkeypatch):
+    monkeypatch.setenv("GRPC_RDMA_RING_BUFFER_SIZE_KB", "64")
+    monkeypatch.setenv("TPURPC_RING_BUFFER_SIZE_KB", "128")
+    assert Config.from_env().ring_buffer_size_kb == 128
+
+
+def test_grpc_rdma_aliases_respected(monkeypatch):
+    monkeypatch.setenv("GRPC_RDMA_POLLER_THREAD_NUM", "3")
+    monkeypatch.setenv("GRPC_RDMA_BUSY_POLLING_TIMEOUT_US", "250")
+    cfg = Config.from_env()
+    assert cfg.poller_thread_num == 3
+    assert cfg.busy_polling_timeout_us == 250
+
+
+def test_ring_size_rounds_to_power_of_two(monkeypatch):
+    monkeypatch.setenv("TPURPC_RING_BUFFER_SIZE_KB", "100")
+    # 100KB → next pow2 = 128KB (ring_buffer.cc:22 requires power-of-two capacity)
+    assert Config.from_env().ring_buffer_size == 128 * 1024
+
+
+def test_singleton_reads_env_once(monkeypatch):
+    monkeypatch.setenv("TPURPC_RING_BUFFER_SIZE_KB", "64")
+    first = get_config()
+    monkeypatch.setenv("TPURPC_RING_BUFFER_SIZE_KB", "256")
+    assert get_config() is first
+    config_mod.set_config(None)
+    assert get_config().ring_buffer_size_kb == 256
+
+
+def test_trace_env_grammar(monkeypatch):
+    monkeypatch.setenv("TPURPC_TRACE", "all,-http2")
+    trace.reapply_env()
+    flags = trace.list_tracers()
+    assert flags["ring"] is True
+    assert flags["http2"] is False
+    monkeypatch.setenv("TPURPC_TRACE", "ring_event")
+    trace.reapply_env()
+    flags = trace.list_tracers()
+    assert flags["ring_event"] is True
+    assert flags["ring"] is False
+    monkeypatch.delenv("TPURPC_TRACE")
+    trace.reapply_env()
+
+
+def test_profile_spans_and_table():
+    stats.enable(True)
+    try:
+        with stats.profile("unit_test_op"):
+            pass
+        snap = stats.snapshot()
+        assert snap["unit_test_op"][0] >= 1
+        table = stats.print_table()
+        assert "unit_test_op" in table
+    finally:
+        stats.enable(False)
+
+
+def test_copy_ledger_accumulates_and_resets():
+    led = stats.CopyLedger()
+    led.add("host_copy", 100)
+    led.add("device_dma", 4096)
+    assert led.as_dict()["host_copy"] == 100
+    assert led.as_dict()["device_dma"] == 4096
+    led.reset()
+    assert all(v == 0 for v in led.as_dict().values())

@@ -1,0 +1,4 @@
+from tpurpc.utils.config import Config, Platform, get_config, set_config
+from tpurpc.utils import trace
+
+__all__ = ["Config", "Platform", "get_config", "set_config", "trace"]

@@ -1,0 +1,213 @@
+"""Typed runtime configuration.
+
+The reference spreads configuration over four mechanisms (SURVEY.md §5): env vars
+(platform switch ``GRPC_PLATFORM_TYPE`` in ``iomgr_internal.cc:36-61``; the new-gen
+``GRPC_RDMA_*`` family in ``src/core/lib/ibverbs/config.cc:48-113``; the old-gen family in
+``src/core/lib/rdma/rdma_utils.h:22-106``), channel args, GPR global-config strings, and
+benchmark flags.  tpurpc collapses them into this one typed layer while keeping the
+documented UX: the transport is still selected by an env var at process start, and every
+reference knob has a ``TPURPC_*`` spelling plus its original ``GRPC_RDMA_*`` /
+``GRPC_PLATFORM_TYPE`` alias so a reference user's environment keeps working.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import os
+import threading
+from typing import Optional, Tuple
+
+
+class Platform(enum.Enum):
+    """Which byte-pipe ``tpurpc.core.endpoint.create_endpoint`` dispatches to.
+
+    Mirrors ``platform_t{IOMGR_TCP, IOMGR_RDMA_BP, IOMGR_RDMA_BPEV, IOMGR_RDMA_EVENT}``
+    (reference ``iomgr_internal.h:45``).  The RDMA modes map onto ring-buffer transports
+    with the same three wakeup disciplines; ``TPU`` is the new mode whose receive ring is
+    device(HBM)-resident.
+    """
+
+    TCP = "TCP"
+    RING_BP = "RING_BP"        # busy-poll            (ref: RDMA_BP)
+    RING_EVENT = "RING_EVENT"  # event/interrupt      (ref: RDMA_EVENT)
+    RING_BPEV = "RING_BPEV"    # hybrid spin-then-block (ref: RDMA_BPEV, the default perf mode)
+    TPU = "TPU"                # HBM-resident receive ring + zero-copy jax.Array recv
+
+    @property
+    def is_ring(self) -> bool:
+        return self is not Platform.TCP
+
+
+# Accept the reference's spellings verbatim (README.md:17-25 documents these values).
+_PLATFORM_ALIASES = {
+    "TCP": Platform.TCP,
+    "RDMA_BP": Platform.RING_BP,
+    "RDMA_EVENT": Platform.RING_EVENT,
+    "RDMA_BPEV": Platform.RING_BPEV,
+    "RING_BP": Platform.RING_BP,
+    "RING_EVENT": Platform.RING_EVENT,
+    "RING_BPEV": Platform.RING_BPEV,
+    "TPU": Platform.TPU,
+    "RDMA_TPU": Platform.TPU,  # BASELINE.json north-star spelling
+}
+
+
+def env_lookup(name: str, *aliases: str) -> Tuple[Optional[str], Optional[str]]:
+    """First non-empty value among ``name`` and its aliases → (key_found, value).
+
+    Empty-string values count as unset (so ``TPURPC_X="" GRPC_X=y`` falls through to
+    the alias).  This is THE env-with-fallback helper — trace/stats reuse it so the
+    semantics are identical everywhere.
+    """
+    for key in (name, *aliases):
+        val = os.environ.get(key)
+        if val is not None and val != "":
+            return key, val
+    return None, None
+
+
+def _env(name: str, *aliases: str) -> Optional[str]:
+    return env_lookup(name, *aliases)[1]
+
+
+def _env_int(name: str, default: int, *aliases: str) -> int:
+    key, val = env_lookup(name, *aliases)
+    if val is None:
+        return default
+    try:
+        return int(val)
+    except ValueError as exc:
+        raise ValueError(f"{key}={val!r} is not an integer") from exc
+
+
+def _env_bool(name: str, default: bool, *aliases: str) -> bool:
+    val = _env(name, *aliases)
+    if val is None:
+        return default
+    return val.strip().lower() in ("1", "true", "yes", "on")
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    """Process-wide config snapshot, read once from the environment.
+
+    Field ↔ reference-knob map (citations into /root/reference):
+
+    ==========================  =====================================================
+    platform                    GRPC_PLATFORM_TYPE        iomgr_internal.cc:36-61
+    ring_buffer_size_kb         GRPC_RDMA_RING_BUFFER_SIZE_KB   config.cc:93-101 (default 4MB, README:17-25)
+    poller_thread_num           GRPC_RDMA_POLLER_THREAD_NUM     config.cc:67-74  (default 1)
+    busy_polling_timeout_us     GRPC_RDMA_BUSY_POLLING_TIMEOUT_US config.cc:75-83 (default 500us)
+    poller_sleep_timeout_ms     GRPC_RDMA_POLLER_SLEEP_TIMEOUT_MS config.cc:84-92 (default 1000ms)
+    zerocopy_threshold_kb       GRPC_RDMA_ZEROCOPY_THRESHOLD_KB  config.cc:102-113
+                                (reference default = uint32 max, i.e. DISABLED; -1 here)
+    send_chunk_size             GRPC_RDMA_SEND_CHUNK_SIZE  rdma_utils.h:87-92 (default 512KB)
+    zerocopy_enable             GRPC_RDMA_ZEROCOPY_ENABLE  rdma_utils.h:93-97
+    polling_yield               GRPC_RDMA_POLLING_YIELD    rdma_utils.h:75-80
+    device_ordinal              (TPU analog of GRPC_RDMA_DEVICE_NAME/PORT/GID, config.cc:48-66)
+    pair_pool_size              kInitPoolSize=128          pair.h:273-333
+    poller_capacity             kMaxPairs=4096             poller.h:12
+    ==========================  =====================================================
+    """
+
+    platform: Platform = Platform.TCP
+    ring_buffer_size_kb: int = 4096
+    poller_thread_num: int = 1
+    busy_polling_timeout_us: int = 500
+    poller_sleep_timeout_ms: int = 1000
+    zerocopy_threshold_kb: int = -1  # -1 = disabled, matching config.cc:108-113
+    send_chunk_size: int = 512 * 1024
+    zerocopy_enable: bool = True
+    polling_yield: bool = True  # unset env means yield ON (rdma_utils.h:76-77)
+    device_ordinal: int = 0
+    pair_pool_size: int = 128
+    poller_capacity: int = 4096
+
+    @property
+    def ring_buffer_size(self) -> int:
+        """Ring capacity in bytes; rounded up to a power of two like the reference
+        (``ring_buffer.cc:22`` asserts power-of-two capacity)."""
+        size = self.ring_buffer_size_kb * 1024
+        return 1 << max(12, (size - 1).bit_length())
+
+    @property
+    def zerocopy_threshold(self) -> int:
+        """Payload size (bytes) at or above which sends use the zero-copy path.
+
+        Disabled (never triggers) when ``zerocopy_threshold_kb < 0``, mirroring the
+        reference's uint32-max default (``config.cc:108-113``)."""
+        if self.zerocopy_threshold_kb < 0:
+            return 1 << 62
+        return self.zerocopy_threshold_kb * 1024
+
+    @classmethod
+    def from_env(cls) -> "Config":
+        raw = _env("TPURPC_PLATFORM_TYPE", "GRPC_PLATFORM_TYPE")
+        if raw is None:
+            platform = Platform.TCP
+        else:
+            try:
+                platform = _PLATFORM_ALIASES[raw.strip().upper()]
+            except KeyError:
+                # The reference exits on unknown values (iomgr_internal.cc:52-59);
+                # we raise, which surfaces at first Config.get().
+                raise ValueError(
+                    f"unknown platform type {raw!r}; expected one of "
+                    f"{sorted(_PLATFORM_ALIASES)}"
+                ) from None
+        return cls(
+            platform=platform,
+            ring_buffer_size_kb=_env_int(
+                "TPURPC_RING_BUFFER_SIZE_KB", cls.ring_buffer_size_kb,
+                "GRPC_RDMA_RING_BUFFER_SIZE_KB"),
+            poller_thread_num=_env_int(
+                "TPURPC_POLLER_THREAD_NUM", cls.poller_thread_num,
+                "GRPC_RDMA_POLLER_THREAD_NUM"),
+            busy_polling_timeout_us=_env_int(
+                "TPURPC_BUSY_POLLING_TIMEOUT_US", cls.busy_polling_timeout_us,
+                "GRPC_RDMA_BUSY_POLLING_TIMEOUT_US"),
+            poller_sleep_timeout_ms=_env_int(
+                "TPURPC_POLLER_SLEEP_TIMEOUT_MS", cls.poller_sleep_timeout_ms,
+                "GRPC_RDMA_POLLER_SLEEP_TIMEOUT_MS"),
+            zerocopy_threshold_kb=_env_int(
+                "TPURPC_ZEROCOPY_THRESHOLD_KB", cls.zerocopy_threshold_kb,
+                "GRPC_RDMA_ZEROCOPY_THRESHOLD_KB"),
+            send_chunk_size=_env_int(
+                "TPURPC_SEND_CHUNK_SIZE", cls.send_chunk_size,
+                "GRPC_RDMA_SEND_CHUNK_SIZE"),
+            zerocopy_enable=_env_bool(
+                "TPURPC_ZEROCOPY_ENABLE", cls.zerocopy_enable,
+                "GRPC_RDMA_ZEROCOPY_ENABLE"),
+            polling_yield=_env_bool(
+                "TPURPC_POLLING_YIELD", cls.polling_yield,
+                "GRPC_RDMA_POLLING_YIELD"),
+            device_ordinal=_env_int("TPURPC_DEVICE_ORDINAL", cls.device_ordinal),
+            pair_pool_size=_env_int("TPURPC_PAIR_POOL_SIZE", cls.pair_pool_size),
+            poller_capacity=_env_int("TPURPC_POLLER_CAPACITY", cls.poller_capacity),
+        )
+
+
+_lock = threading.Lock()
+_instance: Optional[Config] = None
+
+
+def get_config() -> Config:
+    """Lazy process-wide singleton, like ``Config::Get()`` (``config.h:13-54``)."""
+    global _instance
+    if _instance is None:
+        with _lock:
+            if _instance is None:
+                _instance = Config.from_env()
+    return _instance
+
+
+def set_config(config: Optional[Config]) -> None:
+    """Override (or with ``None`` reset) the singleton — tests and embedders only.
+
+    The reference has no equivalent (env is read once, immutably); tests there must
+    re-exec.  Being able to swap the snapshot in-process is deliberate ergonomics.
+    """
+    global _instance
+    with _lock:
+        _instance = config

@@ -1,0 +1,194 @@
+"""Profiling spans and copy-ledger counters.
+
+Reference: the fork's profiling subsystem (SURVEY.md §2.5/§5) — ``GRPCProfiler`` RAII
+spans feeding per-thread HdrHistogram slots for ~30 instrumented ops
+(``include/grpcpp/stats_time.h:11-44``), enabled by ``GRPC_PROFILING`` /
+``GRPC_PROFILING_UNIT`` (``src/core/lib/debug/stats_time.cc:25-45``), printed as an
+ASCII table at shutdown (``stats_time.cc:161-246`` via ``debug/VariadicTable.h``).
+
+tpurpc keeps the same shape — named spans, per-thread accumulation, a table printer —
+plus one thing the reference does not have: a **copy ledger** counting host-memcpy bytes
+on the receive path, because the north star (BASELINE.md) is "host-memcpy bytes = 0" and
+an unmeasured claim is worthless.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+
+def _enabled() -> bool:
+    from tpurpc.utils.config import _env
+
+    return (_env("TPURPC_PROFILING", "GRPC_PROFILING") or "").lower() in (
+        "1", "true", "micro", "on")
+
+
+class _Hist:
+    """Tiny log-bucketed latency histogram (stand-in for HdrHistogram_c)."""
+
+    __slots__ = ("buckets", "count", "total_ns", "max_ns")
+
+    def __init__(self):
+        self.buckets = [0] * 64
+        self.count = 0
+        self.total_ns = 0
+        self.max_ns = 0
+
+    def record(self, ns: int) -> None:
+        self.count += 1
+        self.total_ns += ns
+        if ns > self.max_ns:
+            self.max_ns = ns
+        self.buckets[min(63, max(0, ns.bit_length()))] += 1
+
+    def percentile(self, q: float) -> float:
+        """Approximate: returns the upper bound of the bucket holding quantile q."""
+        if self.count == 0:
+            return 0.0
+        target = math.ceil(self.count * q)
+        seen = 0
+        for i, n in enumerate(self.buckets):
+            seen += n
+            if seen >= target:
+                return float(1 << i)
+        return float(self.max_ns)
+
+
+class _ThreadSlots(threading.local):
+    def __init__(self):
+        self.slots: Dict[str, _Hist] = defaultdict(_Hist)
+        self.registered = False
+
+
+_tls = _ThreadSlots()
+_all_slots_lock = threading.Lock()
+_all_slots: List[Dict[str, _Hist]] = []
+_force_enabled: Optional[bool] = None
+
+
+def enable(on: bool = True) -> None:
+    """Programmatic switch, like ``grpc_stats_time_enable`` (stats_time.cc:47-58)."""
+    global _force_enabled
+    _force_enabled = on
+
+
+def profiling_on() -> bool:
+    return _force_enabled if _force_enabled is not None else _enabled()
+
+
+class profile:
+    """``with profile("op"):`` span — the ``GRPCProfiler`` RAII equivalent."""
+
+    __slots__ = ("op", "t0")
+
+    def __init__(self, op: str):
+        self.op = op
+        self.t0 = 0
+
+    def __enter__(self):
+        if profiling_on():
+            self.t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        if self.t0:
+            if not _tls.registered:
+                with _all_slots_lock:
+                    _all_slots.append(_tls.slots)
+                _tls.registered = True
+            _tls.slots[self.op].record(time.perf_counter_ns() - self.t0)
+            self.t0 = 0
+        return False
+
+
+def snapshot() -> Dict[str, Tuple[int, float, float, float]]:
+    """op → (count, mean_us, p50_us, p99_us) merged across threads."""
+    merged: Dict[str, _Hist] = defaultdict(_Hist)
+    with _all_slots_lock:
+        slot_dicts = list(_all_slots)
+    for slots in slot_dicts:
+        for op, h in list(slots.items()):
+            m = merged[op]
+            m.count += h.count
+            m.total_ns += h.total_ns
+            m.max_ns = max(m.max_ns, h.max_ns)
+            for i, n in enumerate(h.buckets):
+                m.buckets[i] += n
+    return {
+        op: (
+            h.count,
+            (h.total_ns / h.count / 1e3) if h.count else 0.0,
+            h.percentile(0.5) / 1e3,
+            h.percentile(0.99) / 1e3,
+        )
+        for op, h in merged.items()
+    }
+
+
+def print_table() -> str:
+    """ASCII table like ``grpc_stats_time_print`` (stats_time.cc:161-246)."""
+    rows = snapshot()
+    if not rows:
+        return "(no profiling data)"
+    header = f"{'op':<32} {'count':>10} {'mean(us)':>10} {'p50(us)':>10} {'p99(us)':>10}"
+    lines = [header, "-" * len(header)]
+    for op in sorted(rows):
+        c, mean, p50, p99 = rows[op]
+        lines.append(f"{op:<32} {c:>10} {mean:>10.2f} {p50:>10.1f} {p99:>10.1f}")
+    out = "\n".join(lines)
+    print(out)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Copy ledger — new in tpurpc (BASELINE.md target: receive-path host memcpy == 0).
+# ---------------------------------------------------------------------------
+
+class CopyLedger:
+    """Counts bytes moved by each mechanism on the hot paths.
+
+    Categories:
+      host_copy      — CPU memcpy through host DRAM (what we are eliminating)
+      device_dma     — NIC/DMA bytes landing directly in device memory
+      device_alias   — bytes surfaced zero-copy (aliased, no move at all)
+      host_staged    — bytes bounced host→device because true DMA is unavailable
+    """
+
+    CATEGORIES = ("host_copy", "device_dma", "device_alias", "host_staged")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.host_copy = 0
+        self.device_dma = 0
+        self.device_alias = 0
+        self.host_staged = 0
+
+    def add(self, category: str, nbytes: int) -> None:
+        if category not in self.CATEGORIES:
+            raise ValueError(
+                f"unknown copy-ledger category {category!r}; "
+                f"expected one of {self.CATEGORIES}")
+        with self._lock:
+            setattr(self, category, getattr(self, category) + nbytes)
+
+    def reset(self) -> None:
+        with self._lock:
+            self.host_copy = self.device_dma = 0
+            self.device_alias = self.host_staged = 0
+
+    def as_dict(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "host_copy": self.host_copy,
+                "device_dma": self.device_dma,
+                "device_alias": self.device_alias,
+                "host_staged": self.host_staged,
+            }
+
+
+ledger = CopyLedger()
