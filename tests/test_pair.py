@@ -1,0 +1,247 @@
+"""Pair lifecycle / flow-control / wakeup-discipline tests (SURVEY.md §2.1, §7 stage 5).
+
+The reference validates this layer only via benchmarks (§4); we test it directly over
+the loopback and shm domains, including a genuine cross-process shared-memory exchange.
+"""
+
+import os
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from tpurpc.core import pair as P
+from tpurpc.core import poller as PL
+from tpurpc.core.pair import Pair, PairState, create_loopback_pair
+from tpurpc.core.poller import PairPool, Poller, wait_readable
+
+
+@pytest.fixture(autouse=True)
+def _fresh_singletons():
+    yield
+    Poller.reset()
+    PairPool.reset()
+
+
+def test_loopback_roundtrip():
+    a, b = create_loopback_pair(ring_size=4096)
+    try:
+        assert a.state is PairState.CONNECTED
+        a.send([b"ping"])
+        assert wait_readable(b, timeout=5, discipline="event")
+        assert b.recv() == b"ping"
+        b.send([b"pong", b"!"])
+        assert wait_readable(a, timeout=5, discipline="event")
+        assert a.recv() == b"pong!"
+        assert a.total_sent == 4 and a.total_recv == 5
+    finally:
+        a.destroy()
+        b.destroy()
+
+
+def test_partial_send_and_credit_resume():
+    a, b = create_loopback_pair(ring_size=1024)
+    try:
+        payload = bytes(range(256)) * 40  # 10240 bytes >> ring
+        # First send fills the ring and stalls partway (want_write set) ...
+        sent = a.send([payload])
+        assert 0 < sent < len(payload)
+        assert a.want_write
+        # ... and with no credits returned yet, a retry accepts nothing.
+        assert a.send([payload], byte_idx=sent) == 0
+        received = bytearray()
+        while sent < len(payload) or len(received) < len(payload):
+            received += b.recv()  # draining publishes credits (half-ring rule)
+            if sent < len(payload):
+                sent += a.send([payload], byte_idx=sent)
+        assert bytes(received) == payload
+        assert not a.want_write
+    finally:
+        a.destroy()
+        b.destroy()
+
+
+def test_send_chunking_respects_chunk_size(monkeypatch):
+    monkeypatch.setenv("TPURPC_SEND_CHUNK_SIZE", "128")
+    a, b = create_loopback_pair(ring_size=1 << 16)
+    try:
+        payload = b"z" * 1000
+        assert a.send([payload]) == 1000  # several 128B ring messages, one call
+        got = bytearray()
+        while len(got) < 1000:
+            got += b.recv()
+        assert bytes(got) == payload
+    finally:
+        a.destroy()
+        b.destroy()
+
+
+def test_graceful_close_half_close_then_drain():
+    a, b = create_loopback_pair(ring_size=4096)
+    try:
+        a.send([b"last words"])
+        a.disconnect()
+        assert a.state is PairState.DISCONNECTED
+        # b observes peer_exit but can still drain in-flight data (HALF_CLOSED,
+        # ref pair.cc:325-347 drain-then-close).
+        assert wait_readable(b, timeout=5, discipline="event")
+        assert b.get_status() is PairState.HALF_CLOSED
+        assert b.recv() == b"last words"
+    finally:
+        a.destroy()
+        b.destroy()
+
+
+def test_abrupt_peer_death_detected():
+    a, b = create_loopback_pair(ring_size=4096)
+    try:
+        b.notify_sock.close()  # peer process dies without disconnect
+        b.notify_sock = None
+        deadline = time.monotonic() + 5
+        while a.get_status() is PairState.CONNECTED and time.monotonic() < deadline:
+            a.drain_notifications()
+            time.sleep(0.01)
+        assert a.state is PairState.ERROR
+        with pytest.raises(BrokenPipeError):
+            a.send([b"into the void"])
+    finally:
+        a.destroy()
+        b.destroy()
+
+
+def test_reentrancy_tripwire():
+    a, b = create_loopback_pair(ring_size=4096)
+    try:
+        with a._send_guard:
+            with pytest.raises(AssertionError, match="concurrent entry"):
+                a.send([b"nope"])
+    finally:
+        a.destroy()
+        b.destroy()
+
+
+def test_pair_pool_revival():
+    pool = PairPool(max_idle_per_key=4)
+    p1 = pool.take("server:1234")
+    p1._mark_error("synthetic")
+    pool.putback("server:1234", p1)
+    assert pool.idle_count("server:1234") == 1
+    p2 = pool.take("server:1234")
+    assert p2 is p1
+    assert p2.state is PairState.INITIALIZED  # init() revived it (pair.cc:85-141)
+    assert p2.error is None
+
+
+def test_poller_hybrid_wakeup():
+    a, b = create_loopback_pair(ring_size=4096)
+    poller = Poller.get()
+    poller.add_pollable(b)
+    try:
+        def late_send():
+            time.sleep(0.15)
+            a.send([b"wake up"])
+
+        t = threading.Thread(target=late_send)
+        t.start()
+        assert wait_readable(b, timeout=10, discipline="hybrid")
+        assert b.recv() == b"wake up"
+        t.join()
+    finally:
+        poller.remove_pollable(b)
+        a.destroy()
+        b.destroy()
+
+
+def test_busy_discipline_bounded_spin():
+    a, b = create_loopback_pair(ring_size=4096)
+    try:
+        t0 = time.monotonic()
+        assert not wait_readable(b, timeout=0.05, discipline="busy")
+        assert time.monotonic() - t0 < 2
+        a.send([b"x"])
+        assert wait_readable(b, timeout=1, discipline="busy")
+    finally:
+        a.destroy()
+        b.destroy()
+
+
+def test_shm_domain_same_process():
+    a, b = create_loopback_pair(ring_size=4096, domain=P.ShmDomain())
+    try:
+        a.send([b"via /dev/shm"])
+        assert wait_readable(b, timeout=5, discipline="event")
+        assert b.recv() == b"via /dev/shm"
+    finally:
+        a.destroy()
+        b.destroy()
+
+
+def test_shm_cross_process_echo():
+    """The real thing: two processes, rings in POSIX shm, one-sided writes with zero
+    kernel crossings per message, bootstrap + events over a socketpair."""
+    parent_sock, child_sock = socket.socketpair()
+    pid = os.fork()
+    if pid == 0:
+        # --- child: echo server ---
+        status = 1
+        try:
+            parent_sock.close()
+            pair = Pair(P.ShmDomain(), ring_size=8192)
+            pair.init()
+            pair.connect_over_socket(child_sock)
+            echoed = 0
+            while echoed < 3:
+                if wait_readable(pair, timeout=10, discipline="event"):
+                    data = pair.recv()
+                    if data:
+                        pair.send([b"echo:", data])
+                        echoed += 1
+                    elif pair.get_status() is not PairState.CONNECTED:
+                        break
+            pair.destroy()
+            status = 0
+        finally:
+            os._exit(status)
+    # --- parent: client ---
+    child_sock.close()
+    pair = Pair(P.ShmDomain(), ring_size=8192)
+    pair.init()
+    pair.connect_over_socket(parent_sock)
+    try:
+        for i in range(3):
+            msg = f"msg-{i}".encode() * (i + 1)
+            pair.send([msg])
+            got = b""
+            deadline = time.monotonic() + 10
+            while len(got) < len(msg) + 5 and time.monotonic() < deadline:
+                if wait_readable(pair, timeout=5, discipline="event"):
+                    got += pair.recv()
+            assert got == b"echo:" + msg
+        pair.disconnect()
+    finally:
+        pair.destroy()
+        _, code = os.waitpid(pid, 0)
+        assert os.waitstatus_to_exitcode(code) == 0
+
+
+def test_asymmetric_ring_sizes():
+    domain = P.LocalDomain()
+    a = Pair(domain, ring_size=1024)
+    b = Pair(domain, ring_size=65536)
+    a.init()
+    b.init()
+    sa, sb = socket.socketpair()
+    t = threading.Thread(target=b.connect_over_socket, args=(sb,))
+    t.start()
+    a.connect_over_socket(sa)
+    t.join()
+    try:
+        assert a.writer.layout.capacity == 65536  # a writes into b's big ring
+        assert b.writer.layout.capacity == 1024
+        a.send([b"a" * 2000])  # fits b's ring
+        assert b.recv() == b"a" * 2000
+    finally:
+        a.destroy()
+        b.destroy()

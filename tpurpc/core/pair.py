@@ -1,0 +1,672 @@
+"""Pair: one high-performance connection — two receive rings + a status word, glued by
+one-sided writes.
+
+Reference: ``src/core/lib/ibverbs/pair.{h,cc}`` (``PairPollable``).  A pair owns
+
+* a **receive ring** the peer writes messages into (data moves by one-sided writes into
+  the peer's ring at the mirrored tail — ``pair.cc:587-622`` ``postWrite``),
+* a 16-byte **status buffer** ``{remote_head, peer_exit}`` the peer writes credits and
+  the graceful-close flag into (``pair.h:100-103``),
+* the six-state lifecycle ``kUninitialized → kInitialized → kConnected →
+  kHalfClosed/kDisconnected/kError`` (``pair.h:44-51``), with ``init()`` explicitly
+  reviving error/disconnected pairs for pool reuse (``pair.cc:85-141``).
+
+Where the reference's one-sided write is an ``IBV_WR_RDMA_WRITE`` on an RC queue pair,
+tpurpc abstracts it as a :class:`MemoryDomain` — in-process buffers for loopback,
+POSIX shared memory for cross-process on one host, and a device-staged domain for the
+TPU HBM ring (``tpurpc.tpu``).  The *protocol* (framing, credits, close, liveness) is
+identical across domains, which is the property the reference proves by running three
+different NIC disciplines over one ring format.
+
+Bootstrap mirrors the reference exactly: a boring already-connected socket carries the
+address exchange (``exchange_data``, ``rdma_bp_posix.cc:640-692``), after which the
+socket is *kept* as the event/liveness channel — the reference keeps its TCP fd for
+liveness too (``rdma_conn.h:90-99`` ``IsPeerAlive``) and delivers completion interrupts
+via completion-channel fds (``rdma_conn.cc:24-26``); our notify socket plays both roles.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+import os
+import socket
+import struct
+import threading
+import time
+import uuid
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from tpurpc.core.ring import RingReader, RingWriter, RingFull
+from tpurpc.utils.config import get_config
+from tpurpc.utils.trace import trace_ring
+
+_U64 = struct.Struct("<Q")
+
+STATUS_BYTES = 16
+_STATUS_HEAD_OFF = 0
+_STATUS_EXIT_OFF = 8
+
+
+class PairState(enum.Enum):
+    """Mirrors ``PairStatus`` (``pair.h:44-51``)."""
+
+    UNINITIALIZED = "uninitialized"
+    INITIALIZED = "initialized"
+    CONNECTED = "connected"
+    HALF_CLOSED = "half_closed"      # peer wrote peer_exit and stopped sending
+    DISCONNECTED = "disconnected"
+    ERROR = "error"
+
+
+# ---------------------------------------------------------------------------
+# Memory domains: who implements the one-sided write.
+# ---------------------------------------------------------------------------
+
+class Region:
+    """A chunk of registerable memory owned by this side (ref: ``Buffer``,
+    ``buffer.h:12-35`` — pinned + ibv_reg_mr there; here just addressable bytes)."""
+
+    __slots__ = ("handle", "buf", "_close")
+
+    def __init__(self, handle: str, buf, close: Callable[[], None] = lambda: None):
+        self.handle = handle
+        self.buf = memoryview(buf)
+        self._close = close
+
+    def close(self) -> None:
+        self.buf.release()
+        self._close()
+
+
+class Window:
+    """A write handle onto the *peer's* region (ref: ``MemoryRegion`` envelope shipping
+    an ``ibv_mr`` descriptor, ``memory_region.h:14-47``)."""
+
+    __slots__ = ("write", "_close")
+
+    def __init__(self, write: Callable[[int, bytes], None],
+                 close: Callable[[], None] = lambda: None):
+        self.write = write  # write(offset, data) — one-sided, no peer CPU involved
+        self._close = close
+
+    def close(self) -> None:
+        self._close()
+
+
+class MemoryDomain:
+    """Allocates local regions and opens windows onto peer regions by handle."""
+
+    kind = "abstract"
+
+    def alloc(self, nbytes: int) -> Region:
+        raise NotImplementedError
+
+    def open_window(self, handle: str, nbytes: int) -> Window:
+        raise NotImplementedError
+
+
+class LocalDomain(MemoryDomain):
+    """In-process domain: regions live in a process-wide registry; windows write
+    directly.  This is the "loopback PairPollable" the reference never wrote
+    (SURVEY.md §4 calls it the missing fake) — it lets the full pair/poller/endpoint
+    stack run in CI with zero hardware."""
+
+    kind = "local"
+    _registry: Dict[str, bytearray] = {}
+    _lock = threading.Lock()
+
+    def alloc(self, nbytes: int) -> Region:
+        handle = f"local:{uuid.uuid4().hex}"
+        buf = bytearray(nbytes)
+        with self._lock:
+            self._registry[handle] = buf
+
+        def _close():
+            with self._lock:
+                self._registry.pop(handle, None)
+
+        return Region(handle, buf, _close)
+
+    def open_window(self, handle: str, nbytes: int) -> Window:
+        with self._lock:
+            buf = self._registry[handle]
+        mv = memoryview(buf)
+
+        def write(off: int, data) -> None:
+            mv[off:off + len(data)] = data
+
+        return Window(write, mv.release)
+
+
+class ShmDomain(MemoryDomain):
+    """Cross-process domain over POSIX shared memory: a server and its local clients
+    exchange ring writes through ``/dev/shm`` with zero kernel involvement per
+    message — the closest host-only analog of the reference's NIC-placed writes."""
+
+    kind = "shm"
+
+    @staticmethod
+    def _untrack(shm) -> None:
+        # The allocator owns unlink explicitly (Region.close); Python's
+        # resource_tracker would otherwise double-unlink from every process that
+        # ever mapped the segment and warn about "leaks" after fork.
+        from multiprocessing import resource_tracker
+
+        try:
+            resource_tracker.unregister(shm._name, "shared_memory")
+        except Exception:
+            pass
+
+    def alloc(self, nbytes: int) -> Region:
+        from multiprocessing import shared_memory
+
+        shm = shared_memory.SharedMemory(create=True, size=nbytes)
+        self._untrack(shm)
+
+        def _close():
+            shm.close()
+            try:
+                shm.unlink()
+            except FileNotFoundError:
+                pass
+
+        return Region(f"shm:{shm.name}", shm.buf, _close)
+
+    def open_window(self, handle: str, nbytes: int) -> Window:
+        from multiprocessing import shared_memory
+
+        assert handle.startswith("shm:")
+        shm = shared_memory.SharedMemory(name=handle[4:])
+        self._untrack(shm)
+        mv = shm.buf
+
+        def write(off: int, data) -> None:
+            mv[off:off + len(data)] = data
+
+        def _close():
+            mv.release()
+            shm.close()
+
+        return Window(write, _close)
+
+
+_DOMAINS: Dict[str, Callable[[], MemoryDomain]] = {
+    "local": LocalDomain,
+    "shm": ShmDomain,
+}
+
+
+def register_domain(kind: str, factory: Callable[[], MemoryDomain]) -> None:
+    """Extension point the TPU domain uses (``tpurpc.tpu``)."""
+    _DOMAINS[kind] = factory
+
+
+# ---------------------------------------------------------------------------
+# Address: what gets exchanged at bootstrap.
+# ---------------------------------------------------------------------------
+
+class Address:
+    """Serializable rendezvous blob (ref: ``Address`` with lid/qpn/psn/gid/tag/
+    ring_buffer_size, ``address.h:24-31``; peers assert tag+size match,
+    ``pair.cc:148-149``)."""
+
+    def __init__(self, tag: str, domain_kind: str, ring_size: int,
+                 ring_handle: str, status_handle: str):
+        self.tag = tag
+        self.domain_kind = domain_kind
+        self.ring_size = ring_size
+        self.ring_handle = ring_handle
+        self.status_handle = status_handle
+
+    def to_bytes(self) -> bytes:
+        return json.dumps({
+            "tag": self.tag,
+            "domain": self.domain_kind,
+            "ring_size": self.ring_size,
+            "ring": self.ring_handle,
+            "status": self.status_handle,
+        }).encode()
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "Address":
+        d = json.loads(raw.decode())
+        return cls(d["tag"], d["domain"], d["ring_size"], d["ring"], d["status"])
+
+
+def _send_blob(sock: socket.socket, blob: bytes) -> None:
+    sock.sendall(struct.pack("<I", len(blob)) + blob)
+
+
+def _recv_blob(sock: socket.socket) -> bytes:
+    need = struct.unpack("<I", _recv_exact(sock, 4))[0]
+    return _recv_exact(sock, need)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    out = b""
+    while len(out) < n:
+        chunk = sock.recv(n - len(out))
+        if not chunk:
+            raise ConnectionError("peer closed during address exchange")
+        out += chunk
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The Pair.
+# ---------------------------------------------------------------------------
+
+#: notify tokens carried on the notify socket (≈ completion events / WRITE_WITH_IMM)
+NOTIFY_DATA = b"d"
+NOTIFY_CREDIT = b"c"
+NOTIFY_EXIT = b"x"
+
+
+class ContentAssertion:
+    """Single-entrant tripwire on send/recv, like the reference's reentrancy guard
+    (``pair.h:64-81``): two threads inside Send (or Recv) concurrently is a caller bug
+    we want to explode loudly, not corrupt a ring."""
+
+    def __init__(self, name: str):
+        self._name = name
+        self._flag = False
+        self._lock = threading.Lock()
+
+    def __enter__(self):
+        with self._lock:
+            if self._flag:
+                raise AssertionError(f"concurrent entry into {self._name}")
+            self._flag = True
+
+    def __exit__(self, *exc):
+        with self._lock:
+            self._flag = False
+        return False
+
+
+class Pair:
+    """One connection's data plane.  Thread model: one sender thread + one receiver
+    thread at a time (enforced by :class:`ContentAssertion`), any thread may poll."""
+
+    def __init__(self, domain: Optional[MemoryDomain] = None,
+                 ring_size: Optional[int] = None, tag: Optional[str] = None):
+        cfg = get_config()
+        self.domain = domain or LocalDomain()
+        self.ring_size = ring_size or cfg.ring_buffer_size
+        self.tag = tag or uuid.uuid4().hex[:12]
+        self.state = PairState.UNINITIALIZED
+        self.error: Optional[str] = None
+
+        self.recv_region: Optional[Region] = None
+        self.status_region: Optional[Region] = None
+        self.reader: Optional[RingReader] = None
+        self.writer: Optional[RingWriter] = None
+        self._peer_ring: Optional[Window] = None
+        self._peer_status: Optional[Window] = None
+
+        #: peer-driven event channel (completion interrupts + liveness); set at connect
+        self.notify_sock: Optional[socket.socket] = None
+        #: local-poller-driven wakeup (BPEV's grpc_wakeup_fd, pair.h:187)
+        self._wakeup_r, self._wakeup_w = -1, -1
+        self._wakeup_armed = False  # poller sets; consumer clears
+
+        self._send_guard = ContentAssertion("Pair.send")
+        self._recv_guard = ContentAssertion("Pair.recv")
+        self._credit_lock = threading.Lock()
+        self._published_head_mirror = 0  # last head value we published to the peer
+        self.want_write = False  # a sender is stalled waiting for credits
+        # monotonic counters (ref: per-pair live counters, pair.h:235-270)
+        self.total_sent = 0
+        self.total_recv = 0
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def init(self) -> None:
+        """Allocate + zero rings, reset counters.  Revives ERROR/DISCONNECTED pairs
+        like the reference (``pair.cc:85-141``, explicitly re-initializing recycled
+        pool pairs)."""
+        self._release_resources()
+        self.recv_region = self.domain.alloc(self.ring_size)
+        self.status_region = self.domain.alloc(STATUS_BYTES)
+        self.reader = RingReader(self.recv_region.buf, self.ring_size)
+        self.writer = None  # created at connect, once peer ring size is known
+        self._published_head_mirror = 0
+        self.error = None
+        self.want_write = False
+        self._wakeup_r, self._wakeup_w = os.pipe()
+        os.set_blocking(self._wakeup_w, False)
+        os.set_blocking(self._wakeup_r, False)
+        self.state = PairState.INITIALIZED
+
+    def local_address(self) -> Address:
+        assert self.state in (PairState.INITIALIZED, PairState.CONNECTED)
+        return Address(self.tag, self.domain.kind, self.ring_size,
+                       self.recv_region.handle, self.status_region.handle)
+
+    def connect_over_socket(self, sock: socket.socket) -> None:
+        """Bootstrap over an already-connected socket: both sides swap Address blobs,
+        then open one-sided windows (ref: ``exchange_data`` over the TCP fd,
+        ``rdma_bp_posix.cc:640-692``; MR swap ``pair.cc:472-486``).  The socket stays
+        alive as the notify/liveness channel."""
+        if self.state is not PairState.INITIALIZED:
+            raise RuntimeError(f"connect in state {self.state}")
+        _send_blob(sock, self.local_address().to_bytes())
+        peer = Address.from_bytes(_recv_blob(sock))
+        self._attach_peer(peer)
+        sock.setblocking(False)
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass  # not a TCP socket (e.g. unix socketpair)
+        self.notify_sock = sock
+
+    def _attach_peer(self, peer: Address) -> None:
+        if peer.domain_kind != self.domain.kind:
+            raise ValueError(f"domain mismatch: {peer.domain_kind} vs {self.domain.kind}")
+        # Reference asserts ring sizes match (pair.cc:148-149); we allow asymmetric
+        # rings — the writer just honors the peer's capacity.
+        self._peer_ring = self.domain.open_window(peer.ring_handle, peer.ring_size)
+        self._peer_status = self.domain.open_window(peer.status_handle, STATUS_BYTES)
+        self.writer = RingWriter(peer.ring_size, self._peer_ring.write)
+        self.state = PairState.CONNECTED
+        trace_ring.log("pair %s connected (peer tag %s, ring %d)",
+                       self.tag, peer.tag, peer.ring_size)
+
+    # -- notify channel (completion events) ----------------------------------
+
+    def _notify(self, token: bytes) -> None:
+        sock = self.notify_sock
+        if sock is None:
+            return
+        try:
+            sock.send(token)
+        except (BlockingIOError, InterruptedError):
+            pass  # event channel saturated — busy/hybrid pollers don't need it
+        except OSError:
+            self._mark_error("notify channel broken")
+
+    def drain_notifications(self) -> bytes:
+        """Non-blocking drain of the peer-event channel; returns the tokens seen.
+        An empty-read (peer closed) flips the pair to ERROR, the moral equivalent of
+        the reference's TCP-fd zero-byte liveness probe (``rdma_conn.h:90-99``)."""
+        sock = self.notify_sock
+        if sock is None:
+            return b""
+        out = b""
+        while True:
+            try:
+                chunk = sock.recv(4096)
+            except (BlockingIOError, InterruptedError):
+                break
+            except OSError:
+                self._mark_error("notify channel read failed")
+                break
+            if chunk == b"":
+                if self.state is PairState.CONNECTED:
+                    self._mark_error("peer vanished (notify socket closed)")
+                break
+            out += chunk
+        return out
+
+    def peek_events(self) -> bool:
+        """Non-consuming probe of the notify channel (``MSG_PEEK``): True if events
+        are pending or the peer died.  The background :class:`~tpurpc.core.poller.
+        Poller` uses this so it never steals tokens an event-discipline waiter is
+        blocked on — only the pair's owner consumes via
+        :meth:`drain_notifications`."""
+        sock = self.notify_sock
+        if sock is None:
+            return False
+        try:
+            chunk = sock.recv(1, socket.MSG_PEEK)
+        except (BlockingIOError, InterruptedError):
+            return False
+        except OSError:
+            self._mark_error("notify channel read failed")
+            return True
+        if chunk == b"":
+            if self.state is PairState.CONNECTED:
+                self._mark_error("peer vanished (notify socket closed)")
+            return True
+        return True
+
+    # -- wakeup fd (local poller -> blocked selector) -------------------------
+
+    @property
+    def wakeup_fd(self) -> int:
+        return self._wakeup_r
+
+    def kick(self) -> None:
+        """Poller writes the wakeup fd when this pair needs attention
+        (``poller.cc:92-101`` writing the pair's ``grpc_wakeup_fd``)."""
+        if not self._wakeup_armed:
+            self._wakeup_armed = True
+            try:
+                os.write(self._wakeup_w, b"\x01")
+            except (BlockingIOError, OSError):
+                pass
+
+    def consume_wakeup(self) -> None:
+        # Drain FIRST, clear the armed flag LAST: a kick() landing between the two
+        # leaves the flag False with a byte in the pipe — a harmless spurious wakeup.
+        # The reverse order can eat the byte while leaving the flag True, and every
+        # later kick() would early-out: a lost wakeup that blocks a waiter forever.
+        try:
+            while os.read(self._wakeup_r, 64):
+                pass
+        except (BlockingIOError, OSError):
+            pass
+        self._wakeup_armed = False
+
+    # -- status / credits -----------------------------------------------------
+
+    def _poll_status_words(self) -> Tuple[int, int]:
+        buf = self.status_region.buf
+        return (_U64.unpack_from(buf, _STATUS_HEAD_OFF)[0],
+                _U64.unpack_from(buf, _STATUS_EXIT_OFF)[0])
+
+    def process_credits(self) -> None:
+        """Fold the peer-written status buffer into local writer state
+        (``pair.cc:294-301`` reading mirrored remote_head; peer_exit check
+        ``pair.cc:349-375``).  Serialized: sender thread and poller thread both call
+        this, and check-then-act on ``remote_head`` must be atomic."""
+        if self.writer is None:
+            return
+        with self._credit_lock:
+            head, peer_exit = self._poll_status_words()
+            if head > self.writer.remote_head:
+                self.writer.update_remote_head(head)
+        if peer_exit and self.state is PairState.CONNECTED:
+            self.state = PairState.HALF_CLOSED
+            trace_ring.log("pair %s: peer_exit observed -> HALF_CLOSED", self.tag)
+
+    def _publish_credits_if_due(self, force: bool = False) -> None:
+        """One-sided-write our head into the peer's status buffer after consuming
+        ≥ half ring (``pair.cc:276-284``, ``updateStatus`` ``:624-641``)."""
+        if self._peer_status is None:
+            return
+        if force or self.reader.should_publish_head():
+            head = self.reader.take_publish()
+            if head != self._published_head_mirror:
+                self._published_head_mirror = head
+                self._peer_status.write(_STATUS_HEAD_OFF, _U64.pack(head))
+                self._notify(NOTIFY_CREDIT)
+
+    # -- data plane -----------------------------------------------------------
+
+    def send(self, slices: Sequence, byte_idx: int = 0) -> int:
+        """Send as much of ``slices[byte_idx:]`` as flow control allows; returns bytes
+        accepted.  Partial sends are normal — the caller re-arms on write-ready
+        (``rdma_flush`` loop + ``notify_on_write``, ``rdma_bp_posix.cc:470-586``).
+        Large payloads are chunked to ``send_chunk_size`` per ring message
+        (old-gen chunked flush, ``rdma_utils.h:87-92``)."""
+        # HALF_CLOSED is not sendable either: the peer has left and will never drain
+        # its ring or return credits — accepting bytes would black-hole them.
+        if self.state is not PairState.CONNECTED:
+            raise BrokenPipeError(f"pair {self.tag} not sendable: {self.state}"
+                                  + (f" ({self.error})" if self.error else ""))
+        cfg = get_config()
+        with self._send_guard:
+            self.process_credits()
+            views: List[memoryview] = []
+            skip = byte_idx
+            for s in slices:
+                v = memoryview(s).cast("B")
+                if skip >= len(v):
+                    skip -= len(v)
+                    continue
+                views.append(v[skip:] if skip else v)
+                skip = 0
+            total = 0
+            while views:
+                budget = min(self.writer.writable_payload(), cfg.send_chunk_size)
+                if budget == 0:
+                    self.want_write = True
+                    break
+                chunk: List[memoryview] = []
+                n = 0
+                while views and n < budget:
+                    v = views[0]
+                    take = min(len(v), budget - n)
+                    chunk.append(v[:take])
+                    if take == len(v):
+                        views.pop(0)
+                    else:
+                        views[0] = v[take:]
+                    n += take
+                try:
+                    self.writer.writev(chunk)
+                except RingFull:  # lost race with our own budget math — treat as stall
+                    self.want_write = True
+                    break
+                total += n
+                self._notify(NOTIFY_DATA)
+            if not views:
+                self.want_write = False
+            self.total_sent += total
+            return total
+
+    def recv_into(self, dst) -> int:
+        """Drain the receive ring into ``dst``; publishes credits as a side effect
+        (``PairPollable::Recv`` → ``RingBufferPollable::Read``,
+        ``ring_buffer.cc:122-191``)."""
+        with self._recv_guard:
+            n = self.reader.read_into(dst)
+            self.total_recv += n
+            self._publish_credits_if_due()
+            return n
+
+    def recv(self, max_bytes: int = 1 << 20) -> bytes:
+        cap = self.reader.layout.capacity if self.reader is not None else 0
+        buf = bytearray(min(max_bytes, cap))
+        n = self.recv_into(buf)
+        return bytes(buf[:n])
+
+    def has_message(self) -> bool:
+        return self.reader is not None and self.reader.has_message()
+
+    def readable(self) -> int:
+        return self.reader.readable() if self.reader is not None else 0
+
+    def has_pending_writes(self) -> bool:
+        """True when a sender stalled for credits and space has since appeared — the
+        poller uses this to wake writers (``poller.cc:77-88`` checking
+        ``HasPendingWrites``)."""
+        if not self.want_write or self.writer is None:
+            return False
+        self.process_credits()
+        return self.writer.writable_payload() > 0
+
+    # -- close / liveness ------------------------------------------------------
+
+    def get_status(self) -> PairState:
+        """Cheap liveness probe: fold in peer_exit + notify-channel health
+        (``get_status`` ``pair.cc:349-375``)."""
+        if self.state is PairState.CONNECTED:
+            self.process_credits()
+        return self.state
+
+    def disconnect(self) -> None:
+        """Graceful close: one-sided-write ``peer_exit=1`` into the peer's status
+        buffer, notify, then stop sending (``Disconnect`` ``pair.cc:325-347``)."""
+        if self.state in (PairState.CONNECTED, PairState.HALF_CLOSED):
+            self._publish_credits_if_due(force=True)
+            try:
+                self._peer_status.write(_STATUS_EXIT_OFF, _U64.pack(1))
+                self._notify(NOTIFY_EXIT)
+            except Exception:
+                pass
+        self.state = PairState.DISCONNECTED
+
+    def _mark_error(self, why: str) -> None:
+        if self.state not in (PairState.DISCONNECTED,):
+            self.state = PairState.ERROR
+        if self.error is None:
+            self.error = why
+        trace_ring.log("pair %s -> ERROR: %s", self.tag, why)
+
+    def _release_resources(self) -> None:
+        # Views into regions must drop before the regions close (shm unmap refuses
+        # while exported pointers exist).
+        if self.reader is not None:
+            self.reader.release()
+            self.reader = None
+        self.writer = None
+        for attr in ("_peer_ring", "_peer_status"):
+            w = getattr(self, attr)
+            if w is not None:
+                w.close()
+                setattr(self, attr, None)
+        for attr in ("recv_region", "status_region"):
+            r = getattr(self, attr)
+            if r is not None:
+                r.close()
+                setattr(self, attr, None)
+        if self.notify_sock is not None:
+            try:
+                self.notify_sock.close()
+            except OSError:
+                pass
+            self.notify_sock = None
+        for fd_attr in ("_wakeup_r", "_wakeup_w"):
+            fd = getattr(self, fd_attr)
+            if fd >= 0:
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+                setattr(self, fd_attr, -1)
+
+    def destroy(self) -> None:
+        if self.state in (PairState.CONNECTED, PairState.HALF_CLOSED):
+            self.disconnect()
+        self._release_resources()
+        self.state = PairState.UNINITIALIZED
+
+
+def create_loopback_pair(ring_size: int = 1 << 16,
+                         domain: Optional[MemoryDomain] = None) -> Tuple[Pair, Pair]:
+    """Two connected in-process pairs over a unix socketpair — the CI-testable fake
+    the reference never wrote (SURVEY.md §4's 'missing fake')."""
+    domain = domain or LocalDomain()
+    a = Pair(domain, ring_size)
+    b = Pair(domain, ring_size)
+    a.init()
+    b.init()
+    sa, sb = socket.socketpair()
+    done: List[Optional[BaseException]] = [None]
+
+    def _bside():
+        try:
+            b.connect_over_socket(sb)
+        except BaseException as exc:  # surfaced below
+            done[0] = exc
+
+    t = threading.Thread(target=_bside, daemon=True)
+    t.start()
+    a.connect_over_socket(sa)
+    t.join(timeout=10)
+    if done[0] is not None:
+        raise done[0]
+    return a, b

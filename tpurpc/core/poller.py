@@ -1,0 +1,261 @@
+"""Background poller threads + pair pool — the hybrid (BPEV) machinery.
+
+Reference: ``src/core/lib/ibverbs/poller.{h,cc}`` — N dedicated busy-poll threads
+(default 1, ``GRPC_RDMA_POLLER_THREAD_NUM``) round-robin over a slot array of
+registered pairs; when a pair has a message / a resumable pending write / an error,
+the poller writes that pair's wakeup fd so a selector blocked in epoll wakes
+(``poller.cc:52-106``).  Threads sleep on a condvar when no pairs are registered
+(``poller.cc:58-63``); capacity 4096 pairs (``poller.h:12``).
+
+And ``PairPool`` (``pair.h:273-333``): keyed take/putback recycling of pairs — the
+client keys by server URI, the server keys by peer address
+(``rdma_bp_posix.cc:748-763``); ``Pair.init()`` revives recycled pairs.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Callable, Dict, List, Optional
+
+from tpurpc.core.pair import Pair, PairState
+from tpurpc.utils.config import get_config
+from tpurpc.utils.trace import trace_ring
+
+
+class Poller:
+    """Round-robin scanner kicking wakeup fds (the BPEV background engine)."""
+
+    _instance: Optional["Poller"] = None
+    _instance_lock = threading.Lock()
+
+    @classmethod
+    def get(cls) -> "Poller":
+        """Lazy singleton, started on first use like ``Poller::Get()``
+        (``poller.h:17-35``)."""
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = Poller()
+                cls._instance.start()
+            return cls._instance
+
+    @classmethod
+    def reset(cls) -> None:
+        with cls._instance_lock:
+            inst, cls._instance = cls._instance, None
+        if inst is not None:
+            inst.stop()
+
+    def __init__(self, thread_num: Optional[int] = None):
+        cfg = get_config()
+        self.thread_num = thread_num or cfg.poller_thread_num
+        self.capacity = cfg.poller_capacity
+        self.sleep_timeout_s = cfg.poller_sleep_timeout_ms / 1000.0
+        self.polling_yield = cfg.polling_yield
+        self._pairs: List[Optional[Pair]] = []
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._threads: List[threading.Thread] = []
+        self._running = False
+        self._pair_count = 0
+
+    # -- registration --------------------------------------------------------
+
+    def add_pollable(self, pair: Pair) -> None:
+        with self._cv:
+            if self._pair_count >= self.capacity:
+                raise RuntimeError(f"poller at capacity ({self.capacity} pairs)")
+            for i, slot in enumerate(self._pairs):
+                if slot is None:
+                    self._pairs[i] = pair
+                    break
+            else:
+                self._pairs.append(pair)
+            self._pair_count += 1
+            self._cv.notify_all()
+
+    def remove_pollable(self, pair: Pair) -> None:
+        with self._cv:
+            for i, slot in enumerate(self._pairs):
+                if slot is pair:
+                    self._pairs[i] = None
+                    self._pair_count -= 1
+                    break
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._running:
+            return
+        self._running = True
+        for i in range(self.thread_num):
+            t = threading.Thread(target=self._run, name=f"tpurpc-poller-{i}",
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+        trace_ring.log("poller started (%d threads)", self.thread_num)
+
+    def stop(self) -> None:
+        with self._cv:
+            self._running = False
+            self._cv.notify_all()
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads.clear()
+
+    # -- the scan loop (poller.cc:52-106) --------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                if not self._running:
+                    return
+                if self._pair_count == 0:
+                    self._cv.wait(timeout=self.sleep_timeout_s)
+                    continue
+                snapshot = [p for p in self._pairs if p is not None]
+            for pair in snapshot:
+                try:
+                    if self._needs_attention(pair):
+                        pair.kick()
+                except Exception:
+                    # A dying pair must never take the poller down; kick so the
+                    # owner observes the error state.
+                    pair.kick()
+            if self.polling_yield:
+                time.sleep(0)  # GRPC_RDMA_POLLING_YIELD (rdma_utils.h:75-80)
+
+    @staticmethod
+    def _needs_attention(pair: Pair) -> bool:
+        if pair.state in (PairState.ERROR, PairState.HALF_CLOSED):
+            return True
+        if pair.has_message():
+            return True
+        if pair.has_pending_writes():
+            return True
+        # Non-consuming probe: notify tokens stay in the socket for whichever
+        # waiter owns them; peer death still flips the pair to ERROR here.
+        if pair.peek_events():
+            return True
+        return pair.state in (PairState.ERROR, PairState.HALF_CLOSED)
+
+
+def wait_readable(pair: Pair, timeout: Optional[float] = None,
+                  discipline: Optional[str] = None) -> bool:
+    """Block until ``pair`` has something for its owner (message, resumable write,
+    state change) under one of the three wakeup disciplines — the ``pollable_epoll``
+    seam of the reference condensed to one function:
+
+    * ``"busy"``  — pure spin until deadline (``ev_epollex_rdma_bp_linux.cc:1020-1110``)
+    * ``"event"`` — block on the peer-driven notify socket
+      (``ev_epollex_rdma_event_linux.cc:686-706``, completion-channel fds in epoll)
+    * ``"hybrid"``— spin ≤ ``busy_polling_timeout_us`` then block on the notify socket
+      *and* the poller-written wakeup fd (``ev_epollex_rdma_bpev_linux.cc:1079-1160``);
+      requires the pair to be registered with :class:`Poller`.
+
+    Returns True if the pair needs attention, False on timeout.
+    """
+    import selectors
+
+    cfg = get_config()
+    if discipline is None:
+        from tpurpc.utils.config import Platform
+
+        discipline = {Platform.RING_BP: "busy", Platform.RING_EVENT: "event",
+                      Platform.RING_BPEV: "hybrid"}.get(cfg.platform, "hybrid")
+
+    def ready() -> bool:
+        pair.drain_notifications()
+        return (pair.has_message() or pair.has_pending_writes()
+                or pair.state not in (PairState.CONNECTED,))
+
+    deadline = None if timeout is None else time.monotonic() + timeout
+    if ready():
+        return True
+
+    if discipline in ("busy", "hybrid"):
+        spin_deadline = time.monotonic() + cfg.busy_polling_timeout_us / 1e6
+        if discipline == "busy" and deadline is not None:
+            spin_deadline = deadline
+        elif discipline == "busy":
+            spin_deadline = float("inf")
+        while time.monotonic() < spin_deadline:
+            if ready():
+                return True
+            if cfg.polling_yield:
+                time.sleep(0)
+        if discipline == "busy":
+            return ready()
+
+    # block on fds (event + hybrid)
+    sel = selectors.DefaultSelector()
+    try:
+        if pair.notify_sock is not None:
+            sel.register(pair.notify_sock, selectors.EVENT_READ)
+        if discipline == "hybrid" and pair.wakeup_fd >= 0:
+            sel.register(pair.wakeup_fd, selectors.EVENT_READ)
+        while True:
+            if ready():
+                return True
+            remain = None if deadline is None else deadline - time.monotonic()
+            if remain is not None and remain <= 0:
+                return ready()
+            events = sel.select(timeout=remain)
+            if events:
+                pair.consume_wakeup()
+                if ready():
+                    return True
+    finally:
+        sel.close()
+
+
+class PairPool:
+    """Keyed pair recycling (``pair.h:273-333``).  Pairs are returned under the peer
+    key and revived by ``init()`` on the next take — connection churn to the same peer
+    never reallocates rings."""
+
+    _instance: Optional["PairPool"] = None
+    _instance_lock = threading.Lock()
+
+    @classmethod
+    def get(cls) -> "PairPool":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = PairPool()
+            return cls._instance
+
+    @classmethod
+    def reset(cls) -> None:
+        with cls._instance_lock:
+            cls._instance = None
+
+    def __init__(self, pair_factory: Callable[[], Pair] = Pair,
+                 max_idle_per_key: Optional[int] = None):
+        cfg = get_config()
+        self.pair_factory = pair_factory
+        self.max_idle_per_key = (max_idle_per_key if max_idle_per_key is not None
+                                 else cfg.pair_pool_size)
+        self._idle: Dict[str, List[Pair]] = defaultdict(list)
+        self._lock = threading.Lock()
+
+    def take(self, key: str) -> Pair:
+        with self._lock:
+            bucket = self._idle.get(key)
+            pair = bucket.pop() if bucket else None
+        if pair is None:
+            pair = self.pair_factory()
+        pair.init()
+        return pair
+
+    def putback(self, key: str, pair: Pair) -> None:
+        with self._lock:
+            bucket = self._idle[key]
+            if len(bucket) < self.max_idle_per_key:
+                bucket.append(pair)
+                return
+        pair.destroy()
+
+    def idle_count(self, key: str) -> int:
+        with self._lock:
+            return len(self._idle.get(key, []))

@@ -1,0 +1,338 @@
+"""The credit-managed, header/footer-framed receive ring — heart of the data plane.
+
+This is a reimplementation of the *math* of the reference's
+``src/core/lib/ibverbs/ring_buffer.{h,cc}`` (``RingBufferPollable``), not its code:
+
+* Wire format per message (``ring_buffer.h:43-52``)::
+
+      [8B header = payload byte count][payload, zero-padded to 8B][8B footer = all-ones]
+
+  All fields start 8-byte aligned, and the ring capacity is a power of two ≥ 64, so no
+  64-bit word ever straddles the wrap point.
+
+* **Completion detection** (``ring_buffer.cc:56-97``): the consumed region of the ring is
+  always zero (the reader zeroes what it eats; the buffer starts zeroed), so a non-zero
+  header word means "a message starts here".  The message is *complete* only when the
+  footer word at its computed end is all-ones.  The producer writes payload → footer →
+  header in that order, so a reader that observes header≠0 ∧ footer==~0 is guaranteed an
+  intact payload on any total-store-order host (the reference gets the same guarantee
+  from the NIC's in-order placement of a single RDMA WRITE).
+
+* **Partial reads** (``ring_buffer.cc:122-191``, ``remain_``/``moving_head_``): a reader
+  may drain fewer bytes than a message holds; progress is carried across calls, and the
+  span is only zeroed + the head only advanced when the message is fully consumed.
+
+* **Wrap-split writes** (``ring_buffer.cc:261-330``, ``GetWriteRequests``): one logical
+  message occupies one contiguous span of ring offsets, which maps to ≤2 physical
+  segments (split at the wrap).  ``RingWriter`` emits the same ≤2-segment descriptors;
+  in the loopback transport they become memcpys, in a verbs transport they would be the
+  SGE lists of an ``IBV_WR_RDMA_WRITE``, in the TPU transport they become device DMAs.
+
+* **Credit flow control** (``pair.cc:276-301``): the writer stalls when the mirrored
+  ``remote_head`` says the ring is full (3×8B reserved, ``ring_buffer.h:185-189``); the
+  reader publishes its head back to the writer after consuming ≥ half the ring.
+
+Differences from the reference, on purpose: head/tail are monotonically increasing
+64-bit counters masked on access (the reference stores masked offsets), which makes the
+full/empty math race-free and assertable; and padding bytes are never written because
+the consumed-region-is-zero invariant already guarantees they are zero.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Callable, Iterable, List, Optional, Sequence, Tuple
+
+ALIGN = 8
+HEADER_BYTES = 8
+FOOTER_BYTES = 8
+FOOTER_MAGIC = 0xFFFFFFFFFFFFFFFF
+#: Reserved slack the writer never fills: header + footer + one 8B gap
+#: (``ring_buffer.h:185-189`` reserves the same 3×8B).
+RESERVED_BYTES = HEADER_BYTES + FOOTER_BYTES + ALIGN
+
+_U64 = struct.Struct("<Q")
+
+
+def align_up(n: int) -> int:
+    return (n + ALIGN - 1) & ~(ALIGN - 1)
+
+
+def message_span(payload_len: int) -> int:
+    """Total ring bytes one message of ``payload_len`` occupies."""
+    return HEADER_BYTES + align_up(payload_len) + FOOTER_BYTES
+
+
+class RingLayout:
+    """Pure offset math shared by every transport (host shm, native, TPU staging)."""
+
+    __slots__ = ("capacity", "mask")
+
+    def __init__(self, capacity: int):
+        if capacity < 64 or capacity & (capacity - 1):
+            # ring_buffer.cc:22 asserts power-of-two capacity.
+            raise ValueError(f"ring capacity must be a power of two >= 64, got {capacity}")
+        self.capacity = capacity
+        self.mask = capacity - 1
+
+    def phys(self, abs_off: int) -> int:
+        return abs_off & self.mask
+
+    def max_payload(self) -> int:
+        """Largest single-message payload this ring can ever carry."""
+        return self.capacity - RESERVED_BYTES
+
+    def segments(self, abs_off: int, nbytes: int) -> List[Tuple[int, int]]:
+        """Map a contiguous logical span to ≤2 physical (offset, len) segments.
+
+        The reference's ``GetWriteRequests`` (``ring_buffer.cc:261-330``) does the same
+        split to build ≤2 ``ibv_send_wr``s.
+        """
+        assert 0 <= nbytes <= self.capacity
+        if nbytes == 0:
+            return []
+        p = self.phys(abs_off)
+        if p + nbytes <= self.capacity:
+            return [(p, nbytes)]
+        first = self.capacity - p
+        return [(p, first), (0, nbytes - first)]
+
+
+class RingReader:
+    """Consumer view over the ring memory this side owns (the peer writes into it)."""
+
+    def __init__(self, buf, capacity: Optional[int] = None):
+        self.buf = memoryview(buf)
+        cap = capacity if capacity is not None else len(self.buf)
+        if len(self.buf) < cap:
+            raise ValueError("buffer smaller than declared capacity")
+        self.layout = RingLayout(cap)
+        self.head = 0  # absolute; phys offset = head & mask
+        # Partial-read state (reference remain_/moving_head_, ring_buffer.cc:168-183).
+        self._msg_len = 0        # payload length of the in-progress message (0 = none)
+        self._msg_read = 0       # payload bytes already handed to the app
+        # Credit state (pair.cc:276-284: publish after consuming >= half ring).
+        self.consumed_since_publish = 0
+
+    # -- completion scanning ------------------------------------------------
+
+    def _word(self, abs_off: int) -> int:
+        p = self.layout.phys(abs_off)
+        return _U64.unpack_from(self.buf, p)[0]
+
+    def _message_at(self, abs_off: int) -> int:
+        """Payload length of the complete message starting at abs_off, else 0.
+
+        Mirrors ``HasMessage``/``GetReadableSize`` (``ring_buffer.cc:56-97``): header
+        word non-zero AND footer word all-ones.
+        """
+        hdr = self._word(abs_off)
+        if hdr == 0:
+            return 0
+        if hdr > self.layout.max_payload():
+            raise RingCorruption(
+                f"header {hdr} exceeds max payload {self.layout.max_payload()} "
+                f"at offset {self.layout.phys(abs_off)}")
+        footer_off = abs_off + HEADER_BYTES + align_up(hdr)
+        if self._word(footer_off) != FOOTER_MAGIC:
+            return 0  # body still in flight
+        return hdr
+
+    def has_message(self) -> bool:
+        if self._msg_len:
+            return True
+        return self._message_at(self.head) != 0
+
+    def readable(self) -> int:
+        """Total payload bytes currently drainable (all complete messages).
+
+        Like ``GetReadableSize`` the endpoint uses to size its slice allocation
+        (``rdma_bp_posix.cc:306-327`` → ``ring_buffer.cc:67-97``).
+        """
+        total = 0
+        off = self.head
+        if self._msg_len:
+            total += self._msg_len - self._msg_read
+            off += message_span(self._msg_len)
+        scanned = 0
+        while scanned < self.layout.capacity:
+            ln = self._message_at(off)
+            if ln == 0:
+                break
+            total += ln
+            span = message_span(ln)
+            off += span
+            scanned += span
+        return total
+
+    # -- draining -----------------------------------------------------------
+
+    def _copy_out(self, abs_off: int, n: int, dst: memoryview, dst_off: int) -> None:
+        for seg_off, seg_len in self.layout.segments(abs_off, n):
+            dst[dst_off:dst_off + seg_len] = self.buf[seg_off:seg_off + seg_len]
+            dst_off += seg_len
+
+    def _zero(self, abs_off: int, n: int) -> None:
+        for seg_off, seg_len in self.layout.segments(abs_off, n):
+            self.buf[seg_off:seg_off + seg_len] = b"\x00" * seg_len
+
+    def read_into(self, dst) -> int:
+        """Drain up to ``len(dst)`` payload bytes; returns the count actually read.
+
+        Handles message-at-a-time consumption, partial-message resumption, and the
+        zero-on-consume invariant (``ring_buffer.cc:122-191``).
+        """
+        dst = memoryview(dst)
+        if dst.readonly:
+            raise ValueError("read_into needs a writable buffer")
+        dst = dst.cast("B")
+        total = 0
+        while total < len(dst):
+            if self._msg_len == 0:
+                ln = self._message_at(self.head)
+                if ln == 0:
+                    break
+                self._msg_len = ln
+                self._msg_read = 0
+            n = min(len(dst) - total, self._msg_len - self._msg_read)
+            payload_off = self.head + HEADER_BYTES + self._msg_read
+            self._copy_out(payload_off, n, dst, total)
+            self._msg_read += n
+            total += n
+            if self._msg_read == self._msg_len:
+                span = message_span(self._msg_len)
+                self._zero(self.head, span)
+                self.head += span
+                self.consumed_since_publish += span
+                self._msg_len = 0
+                self._msg_read = 0
+        return total
+
+    def read(self, nbytes: int) -> bytes:
+        # Size by capacity, not by a readable() pre-scan — readable() re-parses every
+        # queued message's framing, and read_into() is about to do that walk anyway.
+        out = bytearray(min(nbytes, self.layout.capacity))
+        n = self.read_into(out)
+        return bytes(out[:n])
+
+    # -- credits ------------------------------------------------------------
+
+    def should_publish_head(self) -> bool:
+        """True once ≥ half the ring has been consumed since the last publish
+        (the reference's credit-return rule, ``pair.cc:276-284``)."""
+        return self.consumed_since_publish >= self.layout.capacity // 2
+
+    def take_publish(self) -> int:
+        """Consume the pending credit and return the head value to publish."""
+        self.consumed_since_publish = 0
+        return self.head
+
+    # -- invariants ---------------------------------------------------------
+
+    def release(self) -> None:
+        """Drop the memoryview so the underlying region (e.g. POSIX shm) can close."""
+        self.buf.release()
+
+    def check_empty_region(self) -> bool:
+        """Debug invariant from ``ring_buffer.h:215-219``: every byte from the
+        current head to the next unwritten area that is *not* part of a pending
+        message must be zero.  Cheap version: if no message is pending, the word at
+        head must be zero."""
+        return self._msg_len != 0 or self.has_message() or self._word(self.head) in (0,)
+
+
+class RingCorruption(RuntimeError):
+    """A framing invariant was violated (footer/header asserts in ring_buffer.cc)."""
+
+
+WriteFn = Callable[[int, "memoryview | bytes"], None]
+
+
+class RingWriter:
+    """Producer view: encodes messages into the *peer's* ring via one-sided writes.
+
+    ``write_fn(phys_offset, data)`` performs the actual placement — a memcpy for the
+    loopback/shm transport, an RDMA WRITE SGE for verbs, a DMA for the TPU path.  The
+    writer never reads the peer ring; everything it knows about the consumer arrives via
+    ``update_remote_head`` (the credit write, mirroring ``status_report.remote_head``,
+    ``pair.h:100-103`` / ``pair.cc:294-301``).
+    """
+
+    def __init__(self, capacity: int, write_fn: WriteFn):
+        self.layout = RingLayout(capacity)
+        self.write_fn = write_fn
+        self.tail = 0         # absolute count of ring bytes ever written
+        self.remote_head = 0  # mirrored consumer head (credits)
+
+    # -- flow control -------------------------------------------------------
+
+    def in_flight(self) -> int:
+        used = self.tail - self.remote_head
+        assert 0 <= used <= self.layout.capacity, (self.tail, self.remote_head)
+        return used
+
+    def writable_payload(self) -> int:
+        """Largest payload acceptable to :meth:`write` right now.
+
+        ``capacity - used - 3×8B``; because this value is 8-aligned, any payload ≤ it
+        has ``span(payload) ≤ capacity - used - 8``, i.e. the 8-byte gap before the
+        consumer's head is never touched.  (Reference: ``GetWritableSize``,
+        ``ring_buffer.h:185-189``.)
+        """
+        return max(0, self.layout.capacity - self.in_flight() - RESERVED_BYTES)
+
+    def update_remote_head(self, head: int) -> None:
+        if head < self.remote_head or head > self.tail:
+            raise RingCorruption(
+                f"credit head {head} outside [{self.remote_head}, {self.tail}]")
+        self.remote_head = head
+
+    # -- encoding -----------------------------------------------------------
+
+    def _put(self, abs_off: int, data) -> None:
+        view = memoryview(data).cast("B")
+        pos = 0
+        for seg_off, seg_len in self.layout.segments(abs_off, len(view)):
+            self.write_fn(seg_off, view[pos:pos + seg_len])
+            pos += seg_len
+
+    def write(self, payload) -> int:
+        """Encode one message; returns payload bytes written (all or nothing).
+
+        Caller is responsible for chunking to :meth:`writable_payload` — the pair layer
+        does that, mirroring the reference's partial-send resumption
+        (``pair.cc:645-734``).
+        """
+        return self.writev([payload])
+
+    def writev(self, slices: Sequence) -> int:
+        """Gather-encode several slices as ONE message (one header/footer), like the
+        reference's ``grpc_slice*`` gather send building a single doorbell
+        (``pair.cc:645-734``) and ``EncodeBuffer`` iovec variants
+        (``ring_buffer.h:106-178``)."""
+        views = [memoryview(s).cast("B") for s in slices]
+        payload_len = sum(len(v) for v in views)
+        if payload_len == 0:
+            return 0
+        if payload_len > self.writable_payload():
+            raise RingFull(payload_len, self.writable_payload())
+        # Order matters for lock-free completion detection: payload, footer, header.
+        off = self.tail + HEADER_BYTES
+        for v in views:
+            self._put(off, v)
+            off += len(v)
+        # Padding bytes are already zero (consumed-region invariant) — never written.
+        footer_off = self.tail + HEADER_BYTES + align_up(payload_len)
+        self._put(footer_off, _U64.pack(FOOTER_MAGIC))
+        self._put(self.tail, _U64.pack(payload_len))
+        self.tail += message_span(payload_len)
+        return payload_len
+
+
+class RingFull(RuntimeError):
+    """Message does not fit the currently writable span; caller must wait for credits."""
+
+    def __init__(self, wanted: int, available: int):
+        super().__init__(f"ring full: wanted {wanted} payload bytes, {available} writable")
+        self.wanted = wanted
+        self.available = available

@@ -112,7 +112,17 @@ def snapshot() -> Dict[str, Tuple[int, float, float, float]]:
     with _all_slots_lock:
         slot_dicts = list(_all_slots)
     for slots in slot_dicts:
-        for op, h in list(slots.items()):
+        # Other threads keep recording while we read; retry if the dict resizes
+        # under us.  Slightly torn counts are fine for stats; crashing is not.
+        for _ in range(5):
+            try:
+                items = list(slots.items())
+                break
+            except RuntimeError:
+                continue
+        else:
+            items = []
+        for op, h in items:
             m = merged[op]
             m.count += h.count
             m.total_ns += h.total_ns
