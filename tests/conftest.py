@@ -18,9 +18,14 @@ import pytest  # noqa: E402
 
 @pytest.fixture(autouse=True)
 def _reset_config_singleton():
-    """Each test sees a fresh Config.from_env() so monkeypatched env vars apply."""
+    """Each test sees a fresh Config.from_env() so monkeypatched env vars apply;
+    poller/pool singletons die with the test that used them."""
     from tpurpc.utils import config as config_mod
 
     config_mod.set_config(None)
     yield
+    from tpurpc.core.poller import PairPool, Poller
+
+    Poller.reset()
+    PairPool.reset()
     config_mod.set_config(None)

@@ -1,0 +1,186 @@
+"""Endpoint conformance harness.
+
+Reference: ``test/core/iomgr/endpoint_tests.{h,cc}`` — one suite of read/write/shutdown
+semantics run against *every* endpoint implementation, which is how the upstream suite
+exercises the RDMA endpoints for free (SURVEY.md §4.1).  Our matrix: TCP, three ring
+disciplines (over the platform env switch, exactly as a user selects them), mock, and
+passthru.
+"""
+
+import queue
+import threading
+import time
+
+import pytest
+
+from tpurpc.core.endpoint import (
+    EndpointError,
+    EndpointListener,
+    MockEndpoint,
+    ReadTimeout,
+    connect_endpoint,
+    passthru_endpoint_pair,
+)
+
+
+def _listener_fixture(monkeypatch, platform):
+    """Stand up listener+client with GRPC_PLATFORM_TYPE=<platform> — the documented
+    UX (reference README.md:17-25)."""
+    monkeypatch.setenv("GRPC_PLATFORM_TYPE", platform)
+    from tpurpc.utils import config as config_mod
+
+    config_mod.set_config(None)  # re-read env, like a fresh process
+    accepted: "queue.Queue" = queue.Queue()
+    listener = EndpointListener("127.0.0.1", 0, accepted.put)
+    client = connect_endpoint("127.0.0.1", listener.port)
+    server = accepted.get(timeout=10)
+    return listener, client, server
+
+
+PLATFORMS = ["TCP", "RDMA_BP", "RDMA_EVENT", "RDMA_BPEV"]
+
+
+@pytest.fixture(params=PLATFORMS + ["passthru"])
+def endpoint_pair(request, monkeypatch):
+    if request.param == "passthru":
+        a, b = passthru_endpoint_pair()
+        yield a, b
+        a.close()
+        b.close()
+        return
+    listener, client, server = _listener_fixture(monkeypatch, request.param)
+    yield client, server
+    client.close()
+    server.close()
+    listener.close()
+
+
+def _read_exact(ep, n, timeout=30):
+    out = b""
+    deadline = time.monotonic() + timeout
+    while len(out) < n:
+        remain = deadline - time.monotonic()
+        assert remain > 0, f"timed out with {len(out)}/{n} bytes"
+        chunk = ep.read(n - len(out), timeout=remain)
+        assert chunk != b"", "unexpected EOF"
+        out += chunk
+    return out
+
+
+def test_roundtrip_small(endpoint_pair):
+    a, b = endpoint_pair
+    a.write(b"hello")
+    assert _read_exact(b, 5) == b"hello"
+    b.write([b"wor", b"ld"])  # gather write
+    assert _read_exact(a, 5) == b"world"
+
+
+def test_large_transfer_both_directions(endpoint_pair):
+    a, b = endpoint_pair
+    blob = bytes(i & 0xFF for i in range(1 << 20))  # 1 MiB
+
+    def pump_a():
+        a.write(blob)
+
+    t = threading.Thread(target=pump_a)
+    t.start()
+    got = _read_exact(b, len(blob), timeout=60)
+    t.join(timeout=60)
+    assert got == blob
+    t2 = threading.Thread(target=lambda: b.write(blob))
+    t2.start()
+    assert _read_exact(a, len(blob), timeout=60) == blob
+    t2.join(timeout=60)
+
+
+def test_many_small_writes_preserve_stream(endpoint_pair):
+    a, b = endpoint_pair
+    msgs = [f"m{i:04d}|".encode() for i in range(200)]
+
+    def pump():
+        for m in msgs:
+            a.write(m)
+
+    t = threading.Thread(target=pump)
+    t.start()
+    expect = b"".join(msgs)
+    assert _read_exact(b, len(expect), timeout=60) == expect
+    t.join()
+
+
+def test_clean_eof_on_close(endpoint_pair):
+    a, b = endpoint_pair
+    a.write(b"bye")
+    a.close()
+    assert _read_exact(b, 3) == b"bye"
+    assert b.read(100, timeout=10) == b""  # clean EOF after drain
+
+
+def test_read_timeout(endpoint_pair):
+    a, b = endpoint_pair
+    with pytest.raises(ReadTimeout):
+        b.read(100, timeout=0.2)
+    # endpoint still usable afterwards
+    a.write(b"late")
+    assert _read_exact(b, 4) == b"late"
+
+
+def test_peer_and_local_names(endpoint_pair):
+    a, b = endpoint_pair
+    for ep in (a, b):
+        assert ep.peer
+        assert ep.local_address
+
+
+@pytest.mark.parametrize("platform", PLATFORMS)
+def test_write_after_peer_close_fails(monkeypatch, platform):
+    listener, client, server = _listener_fixture(monkeypatch, platform)
+    try:
+        server.close()
+        with pytest.raises((EndpointError, ReadTimeout)):
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                client.write(b"x" * 65536)  # must eventually surface the close
+        # read side reports EOF or error, never hangs
+        try:
+            assert client.read(100, timeout=5) == b""
+        except (EndpointError, ReadTimeout):
+            pass
+    finally:
+        client.close()
+        listener.close()
+
+
+def test_mock_endpoint_scriptability():
+    m = MockEndpoint()
+    m.inject(b"scripted")
+    assert m.read(100) == b"scripted"
+    m.write([b"cap", b"tured"])
+    assert bytes(m.written) == b"captured"
+    m.inject_eof()
+    assert m.read(10) == b""
+    assert m.read(10) == b""  # EOF is sticky
+    m.close()
+    with pytest.raises(EndpointError):
+        m.read(1)
+
+
+def test_mock_endpoint_retains_tail_beyond_max_bytes():
+    m = MockEndpoint()
+    m.inject(b"x" * 100)
+    assert m.read(10) == b"x" * 10
+    rest = b""
+    while len(rest) < 90:
+        rest += m.read(40)
+    assert rest == b"x" * 90  # nothing dropped
+
+
+def test_ring_pool_recycles_pairs(monkeypatch):
+    from tpurpc.core.poller import PairPool
+
+    listener, client, server = _listener_fixture(monkeypatch, "RDMA_BPEV")
+    key = client.pool_key
+    client.close()
+    server.close()
+    listener.close()
+    assert PairPool.get().idle_count(key) == 1  # returned on close (pool recycle)

@@ -125,13 +125,17 @@ def test_reentrancy_tripwire():
 def test_pair_pool_revival():
     pool = PairPool(max_idle_per_key=4)
     p1 = pool.take("server:1234")
-    p1._mark_error("synthetic")
-    pool.putback("server:1234", p1)
-    assert pool.idle_count("server:1234") == 1
-    p2 = pool.take("server:1234")
-    assert p2 is p1
-    assert p2.state is PairState.INITIALIZED  # init() revived it (pair.cc:85-141)
-    assert p2.error is None
+    try:
+        p1._mark_error("synthetic")
+        pool.putback("server:1234", p1)
+        assert pool.idle_count("server:1234") == 1
+        p2 = pool.take("server:1234")
+        assert p2 is p1
+        assert p2.state is PairState.INITIALIZED  # init() revived it (pair.cc:85-141)
+        assert p2.error is None
+    finally:
+        p1.destroy()
+        pool.drain()
 
 
 def test_poller_hybrid_wakeup():

@@ -1,0 +1,466 @@
+"""Endpoint: the swappable byte-pipe seam, and the factory that swaps it.
+
+This is the single most important architectural idea taken from the reference
+(SURVEY.md §1): *one stable endpoint interface with N byte-pipes selected at runtime by
+an env var, chosen at connection-accept time, with everything above it untouched*.
+
+Reference mapping:
+
+* ``Endpoint`` ≈ the 12-slot ``grpc_endpoint`` vtable (``src/core/lib/iomgr/
+  endpoint.h`` — read/write/shutdown/destroy/get_peer/get_local_address/get_fd/
+  can_track_err; the pollset slots collapse into our blocking-with-timeout model).
+* ``create_endpoint`` ≈ the fork's new factory ``grpc_endpoint_create(fd, args, peer,
+  is_server)`` (``endpoint.cc:33-54``), called from the accept loop
+  (``tcp_server_posix.cc:267``) and the client connector
+  (``tcp_client_posix.cc:124-126``).
+* ``RingEndpoint`` ≈ ``grpc_rdma`` wrapping a ``PairPollable``
+  (``rdma_bp_posix.cc:45-82``): creation takes a pooled pair, bootstraps it over the
+  just-connected socket, and (hybrid mode) registers it with the background poller
+  (``:706-796``); teardown removes it from the poller, disconnects, and returns the
+  pair to the pool (``:112-132``).  Read surfaces ``HALF_CLOSED``-after-drain as EOF
+  and ``ERROR`` as ``ConnectionError`` — the UNAVAILABLE-and-reconnect contract
+  (``rdma_bp_posix.cc:86-96``).
+* ``MockEndpoint`` / ``PassthruEndpoint`` ≈ ``test/core/util/mock_endpoint.cc`` and
+  ``passthru_endpoint.cc`` — the scriptable seams the upstream test suite (and ours)
+  builds on.
+
+Thread model: tpurpc uses blocking endpoints driven by a thread per connection instead
+of porting iomgr's closure/combiner machinery — idiomatic for Python, and the native
+C++ core owns the genuinely hot loops.
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import threading
+import time
+from typing import Callable, Optional, Tuple
+
+from tpurpc.core.pair import Pair, PairState
+from tpurpc.core.poller import PairPool, Poller, wait_readable
+from tpurpc.utils.config import Platform, get_config
+from tpurpc.utils.trace import trace_endpoint
+
+
+class EndpointError(ConnectionError):
+    """Transport-level failure; RPC layer maps it to UNAVAILABLE (ref:
+    ``rdma_bp_posix.cc:86-96`` annotating endpoint errors with
+    ``GRPC_STATUS_UNAVAILABLE`` so client_channel reconnects)."""
+
+
+class Endpoint:
+    """Blocking byte-pipe with the grpc_endpoint contract.
+
+    * ``read`` returns ≥1 byte, or ``b""`` exactly once at clean EOF, or raises
+      :class:`EndpointError`.
+    * ``write`` accepts the whole buffer or raises.
+    * ``close`` is idempotent and releases transport resources.
+    """
+
+    def read(self, max_bytes: int = 1 << 20,
+             timeout: Optional[float] = None) -> bytes:
+        raise NotImplementedError
+
+    def write(self, data) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        raise NotImplementedError
+
+    @property
+    def peer(self) -> str:
+        raise NotImplementedError
+
+    @property
+    def local_address(self) -> str:
+        raise NotImplementedError
+
+    def fileno(self) -> int:
+        """fd for pollers where one exists; -1 otherwise (``grpc_endpoint_get_fd``)."""
+        return -1
+
+    def can_track_err(self) -> bool:
+        return False
+
+
+class ReadTimeout(TimeoutError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# TCP endpoint (ref: tcp_posix.cc — the fallback pipe and the bootstrap carrier).
+# ---------------------------------------------------------------------------
+
+class TcpEndpoint(Endpoint):
+    def __init__(self, sock: socket.socket):
+        self._sock = sock
+        sock.setblocking(True)
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass  # unix sockets
+        self._peer = _fmt_addr(sock, peer=True)
+        self._local = _fmt_addr(sock, peer=False)
+        self._closed = False
+
+    def read(self, max_bytes: int = 1 << 20,
+             timeout: Optional[float] = None) -> bytes:
+        if self._closed:
+            raise EndpointError("read on closed endpoint")
+        self._sock.settimeout(timeout)
+        try:
+            return self._sock.recv(max_bytes)
+        except socket.timeout as exc:
+            raise ReadTimeout() from exc
+        except OSError as exc:
+            raise EndpointError(f"tcp read failed: {exc}") from exc
+        finally:
+            self._sock.settimeout(None)
+
+    def write(self, data) -> None:
+        if self._closed:
+            raise EndpointError("write on closed endpoint")
+        try:
+            if isinstance(data, (list, tuple)):
+                self._sock.sendmsg(data)  # gather write, no concat copy
+            else:
+                self._sock.sendall(data)
+        except OSError as exc:
+            raise EndpointError(f"tcp write failed: {exc}") from exc
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            try:
+                self._sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            self._sock.close()
+
+    @property
+    def peer(self) -> str:
+        return self._peer
+
+    @property
+    def local_address(self) -> str:
+        return self._local
+
+    def fileno(self) -> int:
+        return -1 if self._closed else self._sock.fileno()
+
+    def can_track_err(self) -> bool:
+        return True
+
+
+def _fmt_addr(sock: socket.socket, peer: bool) -> str:
+    try:
+        addr = sock.getpeername() if peer else sock.getsockname()
+    except OSError:
+        return "unknown:?"
+    if isinstance(addr, tuple):
+        return f"ipv4:{addr[0]}:{addr[1]}" if len(addr) == 2 else f"ipv6:[{addr[0]}]:{addr[1]}"
+    return f"unix:{addr or '(unnamed)'}"
+
+
+# ---------------------------------------------------------------------------
+# Ring endpoint (ref: rdma_bp_posix.cc / rdma_event_posix.cc).
+# ---------------------------------------------------------------------------
+
+class RingEndpoint(Endpoint):
+    """A pooled Pair fronted by the Endpoint contract.
+
+    ``sendmsg``-style gather writes map to the pair's slice-gather send; reads drain
+    the ring and surface close/error per the reference's contract.
+    """
+
+    def __init__(self, sock: socket.socket, *, discipline: str,
+                 pool_key: str, pair: Optional[Pair] = None,
+                 register_with_poller: Optional[bool] = None):
+        self.discipline = discipline
+        self.pool_key = pool_key
+        self._peer_desc = _fmt_addr(sock, peer=True)
+        self._local_desc = _fmt_addr(sock, peer=False)
+        self.pair = pair if pair is not None else PairPool.get().take(pool_key)
+        if self.pair.state is not PairState.CONNECTED:
+            try:
+                self.pair.connect_over_socket(sock)
+            except Exception:
+                # Failed bootstrap (e.g. platform-mismatched peer): release the
+                # rings now, don't leak them until interpreter exit.
+                self.pair.destroy()
+                raise
+        self._registered = (register_with_poller if register_with_poller is not None
+                            else discipline == "hybrid")
+        if self._registered:
+            Poller.get().add_pollable(self.pair)
+        self._closed = False
+        trace_endpoint.log("ring endpoint up: %s <-> %s (%s)", self._local_desc,
+                           self._peer_desc, discipline)
+
+    def read(self, max_bytes: int = 1 << 20,
+             timeout: Optional[float] = None) -> bytes:
+        if self._closed:
+            raise EndpointError("read on closed endpoint")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            data = self.pair.recv(max_bytes)
+            if data:
+                return data
+            state = self.pair.get_status()
+            if state is PairState.HALF_CLOSED:
+                # The peer's final write and its peer_exit flag race: re-drain once
+                # after observing HALF_CLOSED so in-flight bytes are never dropped.
+                data = self.pair.recv(max_bytes)
+                return data if data else b""
+            if state in (PairState.ERROR, PairState.DISCONNECTED):
+                raise EndpointError(
+                    f"ring endpoint unavailable: {state.value}"
+                    + (f" ({self.pair.error})" if self.pair.error else ""))
+            remain = None if deadline is None else deadline - time.monotonic()
+            if remain is not None and remain <= 0:
+                raise ReadTimeout()
+            wait_readable(self.pair, timeout=remain, discipline=self.discipline)
+
+    def write(self, data) -> None:
+        if self._closed:
+            raise EndpointError("write on closed endpoint")
+        slices = list(data) if isinstance(data, (list, tuple)) else [data]
+        total = sum(len(s) for s in slices)
+        sent = 0
+        while sent < total:
+            try:
+                sent += self.pair.send(slices, byte_idx=sent)
+            except BrokenPipeError as exc:
+                raise EndpointError(str(exc)) from exc
+            if sent < total:
+                # stalled for credits; wait for the peer to drain
+                wait_readable(self.pair, timeout=30, discipline=self.discipline)
+                if self.pair.get_status() not in (PairState.CONNECTED,):
+                    raise EndpointError(
+                        f"peer went away mid-write ({self.pair.state.value})")
+
+    def close(self) -> None:
+        """Teardown order per ``rdma_bp_posix.cc:112-132``: out of the poller,
+        disconnect, back to the pool."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._registered:
+            Poller.get().remove_pollable(self.pair)
+        self.pair.disconnect()
+        PairPool.get().putback(self.pool_key, self.pair)
+
+    @property
+    def peer(self) -> str:
+        return self._peer_desc
+
+    @property
+    def local_address(self) -> str:
+        return self._local_desc
+
+    def fileno(self) -> int:
+        return self.pair.wakeup_fd if not self._closed else -1
+
+
+# ---------------------------------------------------------------------------
+# Test endpoints (ref: test/core/util/{mock,passthru}_endpoint.cc).
+# ---------------------------------------------------------------------------
+
+class MockEndpoint(Endpoint):
+    """Scriptable endpoint: the test injects reads and captures writes."""
+
+    def __init__(self, peer: str = "mock:peer"):
+        self._rq: "queue.Queue[bytes]" = queue.Queue()
+        self._pending = bytearray()  # tail of a read larger than max_bytes
+        self.written = bytearray()
+        self._peer_name = peer
+        self._closed = False
+        self._eof = False
+
+    def inject(self, data: bytes) -> None:
+        self._rq.put(data)
+
+    def inject_eof(self) -> None:
+        self._rq.put(b"")
+
+    def read(self, max_bytes: int = 1 << 20,
+             timeout: Optional[float] = None) -> bytes:
+        if self._closed:
+            raise EndpointError("read on closed endpoint")
+        if self._pending:
+            out = bytes(self._pending[:max_bytes])
+            del self._pending[:max_bytes]
+            return out
+        if self._eof:
+            return b""
+        try:
+            data = self._rq.get(timeout=timeout)
+        except queue.Empty:
+            raise ReadTimeout() from None
+        if data == b"":
+            self._eof = True
+        self._pending += data[max_bytes:]
+        return data[:max_bytes]
+
+    def write(self, data) -> None:
+        if self._closed:
+            raise EndpointError("write on closed endpoint")
+        slices = data if isinstance(data, (list, tuple)) else [data]
+        for s in slices:
+            self.written += bytes(s)
+
+    def close(self) -> None:
+        self._closed = True
+
+    @property
+    def peer(self) -> str:
+        return self._peer_name
+
+    @property
+    def local_address(self) -> str:
+        return "mock:local"
+
+
+def passthru_endpoint_pair() -> Tuple[Endpoint, Endpoint]:
+    """Two endpoints joined by in-memory queues (``passthru_endpoint.cc``)."""
+
+    class _Half(Endpoint):
+        def __init__(self, rx: queue.Queue, tx: queue.Queue, name: str):
+            self._rx, self._tx, self._name = rx, tx, name
+            self._pending = bytearray()
+            self._closed = False
+            self._eof = False
+
+        def read(self, max_bytes: int = 1 << 20,
+                 timeout: Optional[float] = None) -> bytes:
+            if self._closed:
+                raise EndpointError("read on closed endpoint")
+            if self._pending:
+                out = bytes(self._pending[:max_bytes])
+                del self._pending[:max_bytes]
+                return out
+            if self._eof:
+                return b""
+            try:
+                data = self._rx.get(timeout=timeout)
+            except queue.Empty:
+                raise ReadTimeout() from None
+            if data == b"":
+                self._eof = True
+            self._pending += data[max_bytes:]
+            return data[:max_bytes]
+
+        def write(self, data) -> None:
+            if self._closed:
+                raise EndpointError("write on closed endpoint")
+            slices = data if isinstance(data, (list, tuple)) else [data]
+            payload = b"".join(bytes(s) for s in slices)
+            if payload:
+                self._tx.put(payload)
+
+        def close(self) -> None:
+            if not self._closed:
+                self._closed = True
+                self._tx.put(b"")
+
+        @property
+        def peer(self) -> str:
+            return f"passthru:{self._name}:peer"
+
+        @property
+        def local_address(self) -> str:
+            return f"passthru:{self._name}:local"
+
+    q1: queue.Queue = queue.Queue()
+    q2: queue.Queue = queue.Queue()
+    return _Half(q1, q2, "a"), _Half(q2, q1, "b")
+
+
+# ---------------------------------------------------------------------------
+# The factory + connectors (ref: endpoint.cc:33-54, tcp_client/server_posix.cc).
+# ---------------------------------------------------------------------------
+
+def create_endpoint(sock: socket.socket, *, is_server: bool,
+                    pool_key: Optional[str] = None,
+                    platform: Optional[Platform] = None) -> Endpoint:
+    """Wrap a just-connected socket in the platform-selected byte pipe.
+
+    Mirrors ``grpc_endpoint_create`` dispatch (``endpoint.cc:33-54``): TCP wraps the
+    socket directly; ring platforms bootstrap a pooled pair over the socket.  The
+    pool key mirrors the reference's identity rule (``rdma_bp_posix.cc:748-763``):
+    clients key by the server address, servers key by the peer address.
+    """
+    cfg = get_config()
+    platform = platform or cfg.platform
+    if platform is Platform.TCP:
+        return TcpEndpoint(sock)
+    if platform is Platform.TPU:
+        from tpurpc.tpu.endpoint import TpuRingEndpoint  # lazy: jax import
+
+        key = pool_key or _fmt_addr(sock, peer=True)
+        return TpuRingEndpoint(sock, pool_key=key, is_server=is_server)
+    discipline = platform.discipline
+    key = pool_key or _fmt_addr(sock, peer=True)
+    # Pool pairs default to the shm domain (works in-process and cross-process on one
+    # host).  Ring platforms require both peers on one host, the same way the
+    # reference's RDMA modes require both peers on one IB fabric.
+    return RingEndpoint(sock, discipline=discipline, pool_key=key)
+
+
+def connect_endpoint(host: str, port: int,
+                     timeout: Optional[float] = 30) -> Endpoint:
+    """Client side: TCP-connect, then let the factory pick the pipe
+    (``tcp_client_posix.cc:124-126``)."""
+    sock = socket.create_connection((host, port), timeout=timeout)
+    sock.settimeout(None)
+    return create_endpoint(sock, is_server=False, pool_key=f"{host}:{port}")
+
+
+class EndpointListener:
+    """Accept loop feeding the factory (``tcp_server_posix.cc:267``)."""
+
+    def __init__(self, host: str, port: int,
+                 on_endpoint: Callable[[Endpoint], None]):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(128)
+        self.port = self._sock.getsockname()[1]
+        self._on_endpoint = on_endpoint
+        self._stopped = False
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"tpurpc-accept-{self.port}")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stopped:
+            try:
+                sock, addr = self._sock.accept()
+            except OSError as exc:
+                if self._stopped:
+                    return
+                # Transient accept failures (EMFILE, ECONNABORTED...) must not
+                # kill the accept loop while the listen socket stays bound.
+                trace_endpoint.log("accept failed (%s); continuing", exc)
+                time.sleep(0.05)
+                continue
+            try:
+                # Server keys pooled pairs by peer host (ref rule: server keys by
+                # peer, rdma_bp_posix.cc:748-763) — ephemeral ports would defeat
+                # reuse entirely.
+                ep = create_endpoint(sock, is_server=True,
+                                     pool_key=f"peer:{addr[0]}")
+            except Exception as exc:
+                trace_endpoint.log("accept bootstrap failed: %s", exc)
+                sock.close()
+                continue
+            self._on_endpoint(ep)
+
+    def close(self) -> None:
+        self._stopped = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._thread.join(timeout=5)

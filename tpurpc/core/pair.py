@@ -234,12 +234,27 @@ class Address:
         return cls(d["tag"], d["domain"], d["ring_size"], d["ring"], d["status"])
 
 
+#: Bootstrap frame magic.  A peer whose GRPC_PLATFORM_TYPE disagrees (e.g. a TCP
+#: client hitting a ring server) sends arbitrary bytes here; the magic check turns
+#: that misconfiguration into an immediate clear error instead of a hang.  (The
+#: reference has no such guard — mismatched env vars are undefined behavior there.)
+_BOOTSTRAP_MAGIC = b"TRB1"
+_MAX_BLOB = 1 << 16
+
+
 def _send_blob(sock: socket.socket, blob: bytes) -> None:
-    sock.sendall(struct.pack("<I", len(blob)) + blob)
+    sock.sendall(_BOOTSTRAP_MAGIC + struct.pack("<I", len(blob)) + blob)
 
 
 def _recv_blob(sock: socket.socket) -> bytes:
+    magic = _recv_exact(sock, 4)
+    if magic != _BOOTSTRAP_MAGIC:
+        raise ConnectionError(
+            f"bad bootstrap magic {magic!r}: peer is not speaking the ring "
+            f"bootstrap protocol (GRPC_PLATFORM_TYPE mismatch between peers?)")
     need = struct.unpack("<I", _recv_exact(sock, 4))[0]
+    if need > _MAX_BLOB:
+        raise ConnectionError(f"bootstrap blob implausibly large ({need} bytes)")
     return _recv_exact(sock, need)
 
 
@@ -323,12 +338,19 @@ class Pair:
     # -- lifecycle ----------------------------------------------------------
 
     def init(self) -> None:
-        """Allocate + zero rings, reset counters.  Revives ERROR/DISCONNECTED pairs
-        like the reference (``pair.cc:85-141``, explicitly re-initializing recycled
-        pool pairs)."""
-        self._release_resources()
-        self.recv_region = self.domain.alloc(self.ring_size)
-        self.status_region = self.domain.alloc(STATUS_BYTES)
+        """Allocate (or zero and reuse) rings, reset counters.  Revives
+        ERROR/DISCONNECTED/quiesced pairs like the reference (``pair.cc:85-141``,
+        explicitly re-initializing recycled pool pairs) — a pooled pair keeps its
+        ring allocations across connections; only the per-connection channels
+        (notify socket, wakeup pipe, peer windows) are fresh."""
+        self._release_channels()
+        if self.recv_region is not None and len(self.recv_region.buf) == self.ring_size:
+            self.recv_region.buf[:] = b"\x00" * self.ring_size
+            self.status_region.buf[:] = b"\x00" * STATUS_BYTES
+        else:
+            self._release_regions()
+            self.recv_region = self.domain.alloc(self.ring_size)
+            self.status_region = self.domain.alloc(STATUS_BYTES)
         self.reader = RingReader(self.recv_region.buf, self.ring_size)
         self.writer = None  # created at connect, once peer ring size is known
         self._published_head_mirror = 0
@@ -606,9 +628,10 @@ class Pair:
             self.error = why
         trace_ring.log("pair %s -> ERROR: %s", self.tag, why)
 
-    def _release_resources(self) -> None:
-        # Views into regions must drop before the regions close (shm unmap refuses
-        # while exported pointers exist).
+    def _release_channels(self) -> None:
+        """Per-connection state: peer windows, notify socket, wakeup pipe, reader
+        view.  (Views into regions must drop before regions can close — shm unmap
+        refuses while exported pointers exist.)"""
         if self.reader is not None:
             self.reader.release()
             self.reader = None
@@ -617,11 +640,6 @@ class Pair:
             w = getattr(self, attr)
             if w is not None:
                 w.close()
-                setattr(self, attr, None)
-        for attr in ("recv_region", "status_region"):
-            r = getattr(self, attr)
-            if r is not None:
-                r.close()
                 setattr(self, attr, None)
         if self.notify_sock is not None:
             try:
@@ -637,6 +655,25 @@ class Pair:
                 except OSError:
                     pass
                 setattr(self, fd_attr, -1)
+
+    def _release_regions(self) -> None:
+        for attr in ("recv_region", "status_region"):
+            r = getattr(self, attr)
+            if r is not None:
+                r.close()
+                setattr(self, attr, None)
+
+    def _release_resources(self) -> None:
+        self._release_channels()
+        self._release_regions()
+
+    def quiesce(self) -> None:
+        """Release per-connection channels but keep ring allocations, so a pooled
+        pair holds no fds and no peer references while idle."""
+        if self.state in (PairState.CONNECTED, PairState.HALF_CLOSED):
+            self.disconnect()
+        self._release_channels()
+        self.state = PairState.UNINITIALIZED
 
     def destroy(self) -> None:
         if self.state in (PairState.CONNECTED, PairState.HALF_CLOSED):

@@ -160,13 +160,14 @@ def wait_readable(pair: Pair, timeout: Optional[float] = None,
 
     cfg = get_config()
     if discipline is None:
-        from tpurpc.utils.config import Platform
-
-        discipline = {Platform.RING_BP: "busy", Platform.RING_EVENT: "event",
-                      Platform.RING_BPEV: "hybrid"}.get(cfg.platform, "hybrid")
+        discipline = cfg.platform.discipline or "hybrid"
 
     def ready() -> bool:
-        pair.drain_notifications()
+        if pair.drain_notifications():
+            # We may have consumed a token another waiter (full-duplex: the write
+            # side of the same endpoint) was blocked on — kick the wakeup pipe so
+            # every fd-waiter re-checks.
+            pair.kick()
         return (pair.has_message() or pair.has_pending_writes()
                 or pair.state not in (PairState.CONNECTED,))
 
@@ -188,12 +189,17 @@ def wait_readable(pair: Pair, timeout: Optional[float] = None,
         if discipline == "busy":
             return ready()
 
-    # block on fds (event + hybrid)
+    # Block on fds (event + hybrid).  Both waiter kinds register BOTH fds: the
+    # notify socket (peer-driven) and the wakeup pipe (poller-driven + the
+    # kick-after-drain cross-waiter signal above).  Each select is additionally
+    # capped so that a wakeup lost to any unforeseen race degrades to a bounded
+    # hiccup, never a hang.
+    _SELECT_CAP_S = 0.05
     sel = selectors.DefaultSelector()
     try:
         if pair.notify_sock is not None:
             sel.register(pair.notify_sock, selectors.EVENT_READ)
-        if discipline == "hybrid" and pair.wakeup_fd >= 0:
+        if pair.wakeup_fd >= 0:
             sel.register(pair.wakeup_fd, selectors.EVENT_READ)
         while True:
             if ready():
@@ -201,7 +207,8 @@ def wait_readable(pair: Pair, timeout: Optional[float] = None,
             remain = None if deadline is None else deadline - time.monotonic()
             if remain is not None and remain <= 0:
                 return ready()
-            events = sel.select(timeout=remain)
+            slice_s = _SELECT_CAP_S if remain is None else min(remain, _SELECT_CAP_S)
+            events = sel.select(timeout=slice_s)
             if events:
                 pair.consume_wakeup()
                 if ready():
@@ -228,34 +235,61 @@ class PairPool:
     @classmethod
     def reset(cls) -> None:
         with cls._instance_lock:
-            cls._instance = None
+            inst, cls._instance = cls._instance, None
+        if inst is not None:
+            inst.drain()
 
-    def __init__(self, pair_factory: Callable[[], Pair] = Pair,
+    def __init__(self, pair_factory: Optional[Callable[[], Pair]] = None,
                  max_idle_per_key: Optional[int] = None):
         cfg = get_config()
+        if pair_factory is None:
+            # Default domain is POSIX shm: one allocator that works both in-process
+            # and across processes on a host (the endpoint factory relies on this).
+            from tpurpc.core.pair import ShmDomain
+
+            pair_factory = lambda: Pair(ShmDomain())  # noqa: E731
         self.pair_factory = pair_factory
         self.max_idle_per_key = (max_idle_per_key if max_idle_per_key is not None
                                  else cfg.pair_pool_size)
+        #: one global bound, like the reference's fixed 128-pair pool (pair.h:273)
+        self.max_idle_total = self.max_idle_per_key
         self._idle: Dict[str, List[Pair]] = defaultdict(list)
+        self._idle_total = 0
         self._lock = threading.Lock()
 
     def take(self, key: str) -> Pair:
         with self._lock:
             bucket = self._idle.get(key)
             pair = bucket.pop() if bucket else None
+            if pair is not None:
+                self._idle_total -= 1
         if pair is None:
             pair = self.pair_factory()
         pair.init()
         return pair
 
     def putback(self, key: str, pair: Pair) -> None:
+        """Quiesce (drop fds + peer refs, keep ring allocations) and shelve.  Pairs
+        beyond the global bound are destroyed outright."""
+        pair.quiesce()
         with self._lock:
             bucket = self._idle[key]
-            if len(bucket) < self.max_idle_per_key:
+            if (len(bucket) < self.max_idle_per_key
+                    and self._idle_total < self.max_idle_total):
                 bucket.append(pair)
+                self._idle_total += 1
                 return
         pair.destroy()
 
     def idle_count(self, key: str) -> int:
         with self._lock:
             return len(self._idle.get(key, []))
+
+    def drain(self) -> None:
+        """Destroy every idle pair (releases ring memory, incl. /dev/shm files)."""
+        with self._lock:
+            pairs = [p for bucket in self._idle.values() for p in bucket]
+            self._idle.clear()
+            self._idle_total = 0
+        for p in pairs:
+            p.destroy()

@@ -38,6 +38,18 @@ class Platform(enum.Enum):
     def is_ring(self) -> bool:
         return self is not Platform.TCP
 
+    @property
+    def discipline(self) -> Optional[str]:
+        """Wakeup discipline for ring platforms; None for TCP.  Single source of
+        truth for the platform→discipline mapping (ref: platform→poll-strategy
+        forcing, ``ev_posix.cc:225-232``)."""
+        return {
+            Platform.RING_BP: "busy",
+            Platform.RING_EVENT: "event",
+            Platform.RING_BPEV: "hybrid",
+            Platform.TPU: "hybrid",
+        }.get(self)
+
 
 # Accept the reference's spellings verbatim (README.md:17-25 documents these values).
 _PLATFORM_ALIASES = {
