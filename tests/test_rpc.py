@@ -1,0 +1,314 @@
+"""RPC layer tests: four call shapes × transports, status/deadline/cancel semantics.
+
+Mirrors the reference's test strategy (SURVEY.md §4): the end2end matrix runs the
+*same* RPC behaviors over every byte pipe — inproc (passthru endpoints), loopback TCP,
+and the shm ring platforms — because the layers above the endpoint seam must not be
+able to tell the difference.
+"""
+
+import threading
+import time
+
+import pytest
+
+import tpurpc.rpc as rpc
+from tpurpc.rpc import frame as fr
+from tpurpc.rpc.status import StatusCode
+
+
+# ---------------------------------------------------------------------------
+# Frame codec unit tests
+# ---------------------------------------------------------------------------
+
+def test_metadata_roundtrip():
+    md = [("k", "v"), ("data-bin", b"\x00\xff"), ("empty", "")]
+    blob = fr.encode_metadata(md)
+    out, consumed = fr.decode_metadata(blob)
+    assert consumed == len(blob)
+    assert out == md
+
+
+def test_headers_roundtrip():
+    payload = fr.headers_payload("/svc/M", [("a", "b")], timeout_us=123456)
+    path, timeout_us, md = fr.parse_headers(payload)
+    assert path == "/svc/M"
+    assert timeout_us == 123456
+    assert md == [("a", "b")]
+
+
+def test_trailers_roundtrip():
+    payload = fr.trailers_payload(StatusCode.NOT_FOUND, "nope", [("x", "y")])
+    code, details, md = fr.parse_trailers(payload)
+    assert code is StatusCode.NOT_FOUND
+    assert details == "nope"
+    assert md == [("x", "y")]
+
+
+# ---------------------------------------------------------------------------
+# Service fixture used across transports
+# ---------------------------------------------------------------------------
+
+def _echo(request: bytes, context) -> bytes:
+    return request
+
+
+def _fail(request: bytes, context):
+    context.abort(StatusCode.PERMISSION_DENIED, "not allowed")
+
+
+def _slow(request: bytes, context) -> bytes:
+    time.sleep(1.0)
+    return request
+
+
+def _count(request: bytes, context):
+    for i in range(int(request)):
+        yield str(i).encode()
+
+
+def _total(request_iterator, context) -> bytes:
+    return str(sum(int(x) for x in request_iterator)).encode()
+
+
+def _double_each(request_iterator, context):
+    for x in request_iterator:
+        yield str(int(x) * 2).encode()
+
+
+def _md_echo(request: bytes, context) -> bytes:
+    context.set_trailing_metadata([("seen", str(len(context.invocation_metadata())))])
+    return request
+
+
+def make_server() -> rpc.Server:
+    srv = rpc.server(max_workers=8)
+    srv.add_service("t.Echo", {
+        "Echo": rpc.unary_unary_rpc_method_handler(_echo),
+        "Fail": rpc.unary_unary_rpc_method_handler(_fail),
+        "Slow": rpc.unary_unary_rpc_method_handler(_slow),
+        "Count": rpc.unary_stream_rpc_method_handler(_count),
+        "Total": rpc.stream_unary_rpc_method_handler(_total),
+        "DoubleEach": rpc.stream_stream_rpc_method_handler(_double_each),
+        "MdEcho": rpc.unary_unary_rpc_method_handler(_md_echo),
+    })
+    return srv
+
+
+@pytest.fixture(params=["inproc", "tcp"])
+def channel(request):
+    srv = make_server()
+    if request.param == "inproc":
+        srv.start()
+        ch = rpc.inproc_channel(srv)
+    else:
+        srv.add_insecure_port("127.0.0.1:0")
+        srv.start()
+        ch = rpc.insecure_channel(f"127.0.0.1:{srv.bound_ports[0]}")
+    yield ch
+    ch.close()
+    srv.stop(grace=0.2)
+
+
+# ---------------------------------------------------------------------------
+# The four call shapes
+# ---------------------------------------------------------------------------
+
+def test_unary_unary(channel):
+    echo = channel.unary_unary("/t.Echo/Echo")
+    assert echo(b"hello tpu", timeout=10) == b"hello tpu"
+
+
+def test_unary_unary_large_fragmented(channel):
+    echo = channel.unary_unary("/t.Echo/Echo")
+    big = bytes(range(256)) * (3 * fr.MAX_FRAME_PAYLOAD // 256 // 2)  # ~1.5 frames
+    assert echo(big, timeout=30) == big
+
+
+def test_unary_stream(channel):
+    count = channel.unary_stream("/t.Echo/Count")
+    got = [int(x) for x in count(b"5", timeout=10)]
+    assert got == [0, 1, 2, 3, 4]
+
+
+def test_stream_unary(channel):
+    total = channel.stream_unary("/t.Echo/Total")
+    assert total(iter([b"1", b"2", b"3"]), timeout=10) == b"6"
+
+
+def test_stream_stream(channel):
+    double = channel.stream_stream("/t.Echo/DoubleEach")
+    got = [int(x) for x in double(iter([b"1", b"2", b"3"]), timeout=10)]
+    assert got == [2, 4, 6]
+
+
+def test_concurrent_calls_multiplexed(channel):
+    echo = channel.unary_unary("/t.Echo/Echo")
+    results = {}
+    errs = []
+
+    def worker(i):
+        try:
+            results[i] = echo(str(i).encode() * 100, timeout=20)
+        except Exception as exc:  # pragma: no cover
+            errs.append(exc)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errs
+    assert results == {i: str(i).encode() * 100 for i in range(16)}
+
+
+# ---------------------------------------------------------------------------
+# Status, deadline, cancel, metadata
+# ---------------------------------------------------------------------------
+
+def test_abort_surfaces_status(channel):
+    fail = channel.unary_unary("/t.Echo/Fail")
+    with pytest.raises(rpc.RpcError) as ei:
+        fail(b"x", timeout=10)
+    assert ei.value.code() is StatusCode.PERMISSION_DENIED
+    assert "not allowed" in ei.value.details()
+
+
+def test_unimplemented(channel):
+    nope = channel.unary_unary("/t.Echo/NoSuchMethod")
+    with pytest.raises(rpc.RpcError) as ei:
+        nope(b"x", timeout=10)
+    assert ei.value.code() is StatusCode.UNIMPLEMENTED
+
+
+def test_deadline_exceeded(channel):
+    slow = channel.unary_unary("/t.Echo/Slow")
+    t0 = time.monotonic()
+    with pytest.raises(rpc.RpcError) as ei:
+        slow(b"x", timeout=0.2)
+    assert ei.value.code() is StatusCode.DEADLINE_EXCEEDED
+    assert time.monotonic() - t0 < 0.9  # did not wait for the handler
+
+
+def test_cancel_streaming(channel):
+    count = channel.unary_stream("/t.Echo/Count")
+    call = count(b"1000000", timeout=30)
+    it = iter(call)
+    next(it)
+    call.cancel()
+    with pytest.raises(rpc.RpcError) as ei:
+        for _ in it:
+            pass
+    assert ei.value.code() is StatusCode.CANCELLED
+
+
+def test_trailing_metadata(channel):
+    md = channel.unary_unary("/t.Echo/MdEcho")
+    resp, call = md.with_call(b"x", timeout=10, metadata=[("a", "1"), ("b", "2")])
+    assert resp == b"x"
+    assert ("seen", "2") in list(call.trailing_metadata())
+    assert call.code() is StatusCode.OK
+
+
+def test_handler_exception_maps_to_unknown(channel):
+    count = channel.unary_stream("/t.Echo/Count")
+    with pytest.raises(rpc.RpcError) as ei:
+        list(count(b"not-a-number", timeout=10))
+    assert ei.value.code() is StatusCode.UNKNOWN
+
+
+# ---------------------------------------------------------------------------
+# Transport failure → UNAVAILABLE → reconnect
+# ---------------------------------------------------------------------------
+
+def test_server_gone_maps_unavailable_then_reconnects():
+    srv = make_server()
+    srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    port = srv.bound_ports[0]
+    ch = rpc.insecure_channel(f"127.0.0.1:{port}")
+    echo = ch.unary_unary("/t.Echo/Echo")
+    assert echo(b"a", timeout=10) == b"a"
+
+    srv.stop(grace=0)
+    with pytest.raises(rpc.RpcError) as ei:
+        echo(b"b", timeout=3)
+    assert ei.value.code() is StatusCode.UNAVAILABLE
+
+    # Bring a fresh server up on the same port: channel must recover.
+    srv2 = make_server()
+    srv2.add_insecure_port(f"127.0.0.1:{port}")
+    srv2.start()
+    deadline = time.monotonic() + 10
+    while True:
+        try:
+            assert echo(b"c", timeout=5) == b"c"
+            break
+        except rpc.RpcError:
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.1)
+    ch.close()
+    srv2.stop(grace=0.2)
+
+
+def test_ping(channel):
+    rtt = channel.ping(timeout=5)
+    assert rtt < 5
+
+
+def test_ping_unresponsive_peer_times_out():
+    """A peer that accepts bytes but never replies must fail the ping, not
+    fake success (regression: ping used to return unconditionally)."""
+    from tpurpc.core.endpoint import passthru_endpoint_pair
+    from tpurpc.rpc.channel import Channel
+
+    a, b = passthru_endpoint_pair()  # nobody reads b: silent peer
+    ch = Channel(endpoint_factory=lambda: a)
+    with pytest.raises(rpc.RpcError) as ei:
+        ch.ping(timeout=0.3)
+    assert ei.value.code() is StatusCode.DEADLINE_EXCEEDED
+    ch.close()
+
+
+# ---------------------------------------------------------------------------
+# Regressions from code review
+# ---------------------------------------------------------------------------
+
+def test_empty_unary_request_delivered(channel):
+    """b'' is a legal request (default-valued proto) and must reach the handler."""
+    echo = channel.unary_unary("/t.Echo/Echo")
+    assert echo(b"", timeout=10) == b""
+
+
+def test_empty_messages_in_streams(channel):
+    total = channel.stream_unary("/t.Echo/Total")
+    # empty payloads are still messages; int(b"") raises → UNKNOWN, which proves
+    # the empty message was delivered rather than swallowed as a half-close
+    with pytest.raises(rpc.RpcError) as ei:
+        total(iter([b"1", b""]), timeout=10)
+    assert ei.value.code() is StatusCode.UNKNOWN
+
+
+def test_crashing_request_iterator_fails_fast(channel):
+    """An exception in the user's request iterator must terminate the call
+    promptly (regression: used to hang until deadline)."""
+
+    def bad_iter():
+        yield b"1"
+        raise ValueError("boom")
+
+    total = channel.stream_unary("/t.Echo/Total")
+    t0 = time.monotonic()
+    with pytest.raises(rpc.RpcError) as ei:
+        total(bad_iter(), timeout=30)
+    assert time.monotonic() - t0 < 5
+    assert ei.value.code() is StatusCode.CANCELLED
+
+
+def test_oversized_metadata_fails_stream_not_connection(channel):
+    echo = channel.unary_unary("/t.Echo/Echo")
+    with pytest.raises(rpc.RpcError) as ei:
+        echo(b"x", timeout=10, metadata=[("big", "v" * (2 * fr.MAX_FRAME_PAYLOAD))])
+    assert ei.value.code() is StatusCode.RESOURCE_EXHAUSTED
+    # connection survives: next call works
+    assert echo(b"still alive", timeout=10) == b"still alive"

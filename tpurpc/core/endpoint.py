@@ -38,7 +38,7 @@ import time
 from typing import Callable, Optional, Tuple
 
 from tpurpc.core.pair import Pair, PairState
-from tpurpc.core.poller import PairPool, Poller, wait_readable
+from tpurpc.core.poller import PairPool, Poller, wait_readable, wait_writable
 from tpurpc.utils.config import Platform, get_config
 from tpurpc.utils.trace import trace_endpoint
 
@@ -116,7 +116,10 @@ class TcpEndpoint(Endpoint):
         except OSError as exc:
             raise EndpointError(f"tcp read failed: {exc}") from exc
         finally:
-            self._sock.settimeout(None)
+            try:
+                self._sock.settimeout(None)
+            except OSError:
+                pass  # concurrent close(): the recv error above is the real story
 
     def write(self, data) -> None:
         if self._closed:
@@ -235,7 +238,7 @@ class RingEndpoint(Endpoint):
                 raise EndpointError(str(exc)) from exc
             if sent < total:
                 # stalled for credits; wait for the peer to drain
-                wait_readable(self.pair, timeout=30, discipline=self.discipline)
+                wait_writable(self.pair, timeout=30, discipline=self.discipline)
                 if self.pair.get_status() not in (PairState.CONNECTED,):
                     raise EndpointError(
                         f"peer went away mid-write ({self.pair.state.value})")
@@ -267,22 +270,15 @@ class RingEndpoint(Endpoint):
 # Test endpoints (ref: test/core/util/{mock,passthru}_endpoint.cc).
 # ---------------------------------------------------------------------------
 
-class MockEndpoint(Endpoint):
-    """Scriptable endpoint: the test injects reads and captures writes."""
+class _QueueReadEndpoint(Endpoint):
+    """Shared read machinery for queue-fed test endpoints: pending-tail buffering
+    for reads larger than ``max_bytes``, sticky EOF on an injected ``b""``."""
 
-    def __init__(self, peer: str = "mock:peer"):
-        self._rq: "queue.Queue[bytes]" = queue.Queue()
-        self._pending = bytearray()  # tail of a read larger than max_bytes
-        self.written = bytearray()
-        self._peer_name = peer
+    def __init__(self, rx: "queue.Queue[bytes]"):
+        self._rx = rx
+        self._pending = bytearray()
         self._closed = False
         self._eof = False
-
-    def inject(self, data: bytes) -> None:
-        self._rq.put(data)
-
-    def inject_eof(self) -> None:
-        self._rq.put(b"")
 
     def read(self, max_bytes: int = 1 << 20,
              timeout: Optional[float] = None) -> bytes:
@@ -295,7 +291,7 @@ class MockEndpoint(Endpoint):
         if self._eof:
             return b""
         try:
-            data = self._rq.get(timeout=timeout)
+            data = self._rx.get(timeout=timeout)
         except queue.Empty:
             raise ReadTimeout() from None
         if data == b"":
@@ -303,15 +299,30 @@ class MockEndpoint(Endpoint):
         self._pending += data[max_bytes:]
         return data[:max_bytes]
 
+    def close(self) -> None:
+        self._closed = True
+
+
+class MockEndpoint(_QueueReadEndpoint):
+    """Scriptable endpoint: the test injects reads and captures writes."""
+
+    def __init__(self, peer: str = "mock:peer"):
+        super().__init__(queue.Queue())
+        self.written = bytearray()
+        self._peer_name = peer
+
+    def inject(self, data: bytes) -> None:
+        self._rx.put(data)
+
+    def inject_eof(self) -> None:
+        self._rx.put(b"")
+
     def write(self, data) -> None:
         if self._closed:
             raise EndpointError("write on closed endpoint")
         slices = data if isinstance(data, (list, tuple)) else [data]
         for s in slices:
             self.written += bytes(s)
-
-    def close(self) -> None:
-        self._closed = True
 
     @property
     def peer(self) -> str:
@@ -325,31 +336,10 @@ class MockEndpoint(Endpoint):
 def passthru_endpoint_pair() -> Tuple[Endpoint, Endpoint]:
     """Two endpoints joined by in-memory queues (``passthru_endpoint.cc``)."""
 
-    class _Half(Endpoint):
+    class _Half(_QueueReadEndpoint):
         def __init__(self, rx: queue.Queue, tx: queue.Queue, name: str):
-            self._rx, self._tx, self._name = rx, tx, name
-            self._pending = bytearray()
-            self._closed = False
-            self._eof = False
-
-        def read(self, max_bytes: int = 1 << 20,
-                 timeout: Optional[float] = None) -> bytes:
-            if self._closed:
-                raise EndpointError("read on closed endpoint")
-            if self._pending:
-                out = bytes(self._pending[:max_bytes])
-                del self._pending[:max_bytes]
-                return out
-            if self._eof:
-                return b""
-            try:
-                data = self._rx.get(timeout=timeout)
-            except queue.Empty:
-                raise ReadTimeout() from None
-            if data == b"":
-                self._eof = True
-            self._pending += data[max_bytes:]
-            return data[:max_bytes]
+            super().__init__(rx)
+            self._tx, self._name = tx, name
 
         def write(self, data) -> None:
             if self._closed:
@@ -361,7 +351,7 @@ def passthru_endpoint_pair() -> Tuple[Endpoint, Endpoint]:
 
         def close(self) -> None:
             if not self._closed:
-                self._closed = True
+                super().close()
                 self._tx.put(b"")
 
         @property
@@ -434,9 +424,16 @@ class EndpointListener:
         self._thread.start()
 
     def _loop(self) -> None:
+        # Periodic timeout so close() from another thread is observed: closing an
+        # fd does NOT wake a thread blocked in accept(2), and the blocked accept's
+        # reference keeps the listening socket (and the port) alive.
+        self._sock.settimeout(0.2)
         while not self._stopped:
             try:
                 sock, addr = self._sock.accept()
+                sock.settimeout(None)
+            except socket.timeout:
+                continue
             except OSError as exc:
                 if self._stopped:
                     return
@@ -445,22 +442,37 @@ class EndpointListener:
                 trace_endpoint.log("accept failed (%s); continuing", exc)
                 time.sleep(0.05)
                 continue
-            try:
-                # Server keys pooled pairs by peer host (ref rule: server keys by
-                # peer, rdma_bp_posix.cc:748-763) — ephemeral ports would defeat
-                # reuse entirely.
-                ep = create_endpoint(sock, is_server=True,
-                                     pool_key=f"peer:{addr[0]}")
-            except Exception as exc:
-                trace_endpoint.log("accept bootstrap failed: %s", exc)
-                sock.close()
-                continue
-            self._on_endpoint(ep)
+            # Bootstrap off the accept thread: a ring handshake blocks (bounded
+            # by BOOTSTRAP_TIMEOUT_S), and one silent client must not stall
+            # every other accept behind it.
+            threading.Thread(target=self._bootstrap, args=(sock, addr),
+                             daemon=True,
+                             name=f"tpurpc-bootstrap-{self.port}").start()
+
+    def _bootstrap(self, sock: socket.socket, addr) -> None:
+        try:
+            # Server keys pooled pairs by peer host (ref rule: server keys by
+            # peer, rdma_bp_posix.cc:748-763) — ephemeral ports would defeat
+            # reuse entirely.
+            ep = create_endpoint(sock, is_server=True,
+                                 pool_key=f"peer:{addr[0]}")
+        except Exception as exc:
+            trace_endpoint.log("accept bootstrap failed: %s", exc)
+            sock.close()
+            return
+        if self._stopped:
+            ep.close()
+            return
+        self._on_endpoint(ep)
 
     def close(self) -> None:
         self._stopped = True
         try:
-            self._sock.close()
+            self._sock.shutdown(socket.SHUT_RDWR)  # wakes a blocked accept on Linux
         except OSError:
             pass
         self._thread.join(timeout=5)
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -240,6 +240,9 @@ class Address:
 #: reference has no such guard — mismatched env vars are undefined behavior there.)
 _BOOTSTRAP_MAGIC = b"TRB1"
 _MAX_BLOB = 1 << 16
+#: Bound on the address-exchange handshake (ref exchange_data poll loop is also
+#: bounded, rdma_bp_posix.cc:640-692).
+BOOTSTRAP_TIMEOUT_S = 20.0
 
 
 def _send_blob(sock: socket.socket, blob: bytes) -> None:
@@ -338,19 +341,20 @@ class Pair:
     # -- lifecycle ----------------------------------------------------------
 
     def init(self) -> None:
-        """Allocate (or zero and reuse) rings, reset counters.  Revives
-        ERROR/DISCONNECTED/quiesced pairs like the reference (``pair.cc:85-141``,
-        explicitly re-initializing recycled pool pairs) — a pooled pair keeps its
-        ring allocations across connections; only the per-connection channels
-        (notify socket, wakeup pipe, peer windows) are fresh."""
+        """Allocate fresh rings, reset counters.  Revives ERROR/DISCONNECTED/
+        quiesced pairs like the reference (``pair.cc:85-141``, explicitly
+        re-initializing recycled pool pairs).
+
+        Regions are always NEW allocations (new shm name), never zero-and-reuse:
+        a previous peer that still holds a window onto the old region (its sender
+        racing past a state check at disconnect time) must land its stale
+        one-sided writes in the orphaned segment, not in the next connection's
+        ring.  The reference gets this for free because tearing down the QP kills
+        in-flight RDMA; a shm window has no such fence."""
         self._release_channels()
-        if self.recv_region is not None and len(self.recv_region.buf) == self.ring_size:
-            self.recv_region.buf[:] = b"\x00" * self.ring_size
-            self.status_region.buf[:] = b"\x00" * STATUS_BYTES
-        else:
-            self._release_regions()
-            self.recv_region = self.domain.alloc(self.ring_size)
-            self.status_region = self.domain.alloc(STATUS_BYTES)
+        self._release_regions()
+        self.recv_region = self.domain.alloc(self.ring_size)
+        self.status_region = self.domain.alloc(STATUS_BYTES)
         self.reader = RingReader(self.recv_region.buf, self.ring_size)
         self.writer = None  # created at connect, once peer ring size is known
         self._published_head_mirror = 0
@@ -370,11 +374,26 @@ class Pair:
         """Bootstrap over an already-connected socket: both sides swap Address blobs,
         then open one-sided windows (ref: ``exchange_data`` over the TCP fd,
         ``rdma_bp_posix.cc:640-692``; MR swap ``pair.cc:472-486``).  The socket stays
-        alive as the notify/liveness channel."""
+        alive as the notify/liveness channel.
+
+        The handshake is bounded by ``BOOTSTRAP_TIMEOUT_S``: a peer that connects
+        but never speaks (port scanner, platform-mismatched server that handed the
+        socket straight to its app) produces a timeout error, not a hang."""
         if self.state is not PairState.INITIALIZED:
             raise RuntimeError(f"connect in state {self.state}")
-        _send_blob(sock, self.local_address().to_bytes())
-        peer = Address.from_bytes(_recv_blob(sock))
+        sock.settimeout(BOOTSTRAP_TIMEOUT_S)
+        try:
+            _send_blob(sock, self.local_address().to_bytes())
+            peer = Address.from_bytes(_recv_blob(sock))
+        except socket.timeout as exc:
+            raise ConnectionError(
+                f"pair bootstrap timed out after {BOOTSTRAP_TIMEOUT_S}s "
+                "(peer not speaking the ring bootstrap protocol?)") from exc
+        finally:
+            try:
+                sock.settimeout(None)
+            except OSError:
+                pass
         self._attach_peer(peer)
         sock.setblocking(False)
         try:
@@ -668,11 +687,12 @@ class Pair:
         self._release_regions()
 
     def quiesce(self) -> None:
-        """Release per-connection channels but keep ring allocations, so a pooled
-        pair holds no fds and no peer references while idle."""
+        """Release everything per-connection — channels, peer refs, AND ring
+        regions (init() always allocates fresh regions, see its docstring, so an
+        idle pooled pair pinning /dev/shm would buy nothing)."""
         if self.state in (PairState.CONNECTED, PairState.HALF_CLOSED):
             self.disconnect()
-        self._release_channels()
+        self._release_resources()
         self.state = PairState.UNINITIALIZED
 
     def destroy(self) -> None:

@@ -156,6 +156,27 @@ def wait_readable(pair: Pair, timeout: Optional[float] = None,
 
     Returns True if the pair needs attention, False on timeout.
     """
+    return _wait(pair, timeout, discipline,
+                 lambda: (pair.has_message() or pair.has_pending_writes()
+                          or pair.state not in (PairState.CONNECTED,)))
+
+
+def wait_writable(pair: Pair, timeout: Optional[float] = None,
+                  discipline: Optional[str] = None) -> bool:
+    """Block until a credit-stalled write can resume (or the pair dies).
+
+    Distinct from :func:`wait_readable` on purpose: a writer stalled for credits
+    must NOT be woken by unread *inbound* data (``has_message``), or a
+    request-response app that writes before reading would busy-spin through its
+    stall loop at 100% CPU.
+    """
+    return _wait(pair, timeout, discipline,
+                 lambda: (pair.has_pending_writes()
+                          or pair.state not in (PairState.CONNECTED,)))
+
+
+def _wait(pair: Pair, timeout: Optional[float], discipline: Optional[str],
+          predicate) -> bool:
     import selectors
 
     cfg = get_config()
@@ -168,8 +189,7 @@ def wait_readable(pair: Pair, timeout: Optional[float] = None,
             # side of the same endpoint) was blocked on — kick the wakeup pipe so
             # every fd-waiter re-checks.
             pair.kick()
-        return (pair.has_message() or pair.has_pending_writes()
-                or pair.state not in (PairState.CONNECTED,))
+        return predicate()
 
     deadline = None if timeout is None else time.monotonic() + timeout
     if ready():
@@ -219,8 +239,9 @@ def wait_readable(pair: Pair, timeout: Optional[float] = None,
 
 class PairPool:
     """Keyed pair recycling (``pair.h:273-333``).  Pairs are returned under the peer
-    key and revived by ``init()`` on the next take — connection churn to the same peer
-    never reallocates rings."""
+    key and revived by ``init()`` on the next take.  What's recycled is the Pair
+    *object* and its domain binding; ring regions are allocated fresh per
+    connection (see ``Pair.init`` for why stale one-sided writes forbid reuse)."""
 
     _instance: Optional["PairPool"] = None
     _instance_lock = threading.Lock()

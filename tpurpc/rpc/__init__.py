@@ -1,0 +1,36 @@
+"""tpurpc.rpc — call/stream layer over the endpoint seam (SURVEY.md §7 stage 3).
+
+grpcio-shaped public surface so application code ports mechanically:
+
+    channel = tpurpc.rpc.insecure_channel("host:port")
+    hello = channel.unary_unary("/demo.Greeter/SayHello")
+    reply = hello(b"world", timeout=5)
+
+    srv = tpurpc.rpc.server()
+    srv.add_service("demo.Greeter", {"SayHello": tpurpc.rpc.unary_unary_rpc_method_handler(fn)})
+    srv.add_insecure_port("0.0.0.0:50051"); srv.start()
+"""
+
+from tpurpc.rpc.status import AbortError, Metadata, RpcError, StatusCode
+from tpurpc.rpc.channel import Channel, insecure_channel
+from tpurpc.rpc.server import (
+    Server,
+    ServerContext,
+    RpcMethodHandler,
+    inproc_channel,
+    method_handlers_generic_handler,
+    server,
+    stream_stream_rpc_method_handler,
+    stream_unary_rpc_method_handler,
+    unary_stream_rpc_method_handler,
+    unary_unary_rpc_method_handler,
+)
+
+__all__ = [
+    "AbortError", "Metadata", "RpcError", "StatusCode",
+    "Channel", "insecure_channel",
+    "Server", "ServerContext", "RpcMethodHandler", "server", "inproc_channel",
+    "method_handlers_generic_handler",
+    "unary_unary_rpc_method_handler", "unary_stream_rpc_method_handler",
+    "stream_unary_rpc_method_handler", "stream_stream_rpc_method_handler",
+]

@@ -1,0 +1,536 @@
+"""Client channel: multiplexed calls over one endpoint, with reconnect-on-UNAVAILABLE.
+
+Reference mapping (SURVEY.md §3.2/§3.3):
+
+* ``Channel`` ≈ ``grpc_channel`` + the client_channel filter
+  (``ext/filters/client_channel/client_channel.cc``): it owns subchannel
+  (re)connection with exponential backoff (``lib/backoff/``), hands calls to a live
+  transport, and maps transport failure to ``UNAVAILABLE`` so callers may retry
+  (``rdma_bp_posix.cc:86-96`` annotation rule).
+* ``_Connection`` ≈ one chttp2 transport instance: a reader thread demuxing frames
+  to per-stream state (``chttp2_transport.cc`` read_action_locked), a write path
+  serialized by ``FrameWriter`` (write_action), odd client stream ids as in h2.
+* The four ``*MultiCallable`` shapes mirror grpcio's public API
+  (``src/python/grpcio/grpc/_channel.py``) so porting an app is mechanical.
+"""
+
+from __future__ import annotations
+
+import queue
+import random
+import threading
+import time
+from typing import Callable, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+from tpurpc.core.endpoint import Endpoint, EndpointError, connect_endpoint
+from tpurpc.rpc import frame as fr
+from tpurpc.rpc.status import (Deserializer, Metadata, RpcError, Serializer,
+                               StatusCode, identity_codec as _identity)
+from tpurpc.utils.trace import TraceFlag
+
+trace_channel = TraceFlag("channel")
+
+
+class _ClientStream:
+    """Per-call state the reader thread feeds and the caller thread drains."""
+
+    def __init__(self, stream_id: int):
+        self.stream_id = stream_id
+        self.events: "queue.Queue[tuple]" = queue.Queue()
+        self.initial_metadata: Optional[List[Tuple[str, "str | bytes"]]] = None
+        self._fragments: List[bytes] = []
+        self.done = False  # trailers or failure delivered
+
+    def deliver_message(self, payload: bytes, more: bool) -> None:
+        self._fragments.append(payload)
+        if more:
+            return
+        whole = b"".join(self._fragments)
+        self._fragments = []
+        self.events.put(("message", whole))
+
+    def deliver_trailers(self, code: StatusCode, details: str, md) -> None:
+        self.done = True
+        self.events.put(("trailers", code, details, md))
+
+    def deliver_failure(self, code: StatusCode, details: str) -> None:
+        self.done = True
+        self.events.put(("trailers", code, details, []))
+
+
+class _Connection:
+    """One live transport: endpoint + reader thread + muxed writer."""
+
+    def __init__(self, endpoint: Endpoint, on_dead: Callable[["_Connection"], None]):
+        self.endpoint = endpoint
+        self.writer = fr.FrameWriter(endpoint)
+        self.reader = fr.FrameReader(endpoint)
+        self._streams: dict[int, _ClientStream] = {}
+        self._lock = threading.Lock()
+        self._next_stream_id = 1  # odd ids, client-initiated (h2 convention)
+        self._pong_waiters: List[threading.Event] = []
+        self.alive = True
+        self._on_dead = on_dead
+        self.writer.send_preface()
+        self._thread = threading.Thread(target=self._read_loop, daemon=True,
+                                        name="tpurpc-chan-reader")
+        self._thread.start()
+
+    def open_stream(self) -> _ClientStream:
+        with self._lock:
+            if not self.alive:
+                raise EndpointError("connection closed")
+            sid = self._next_stream_id
+            self._next_stream_id += 2
+            st = _ClientStream(sid)
+            self._streams[sid] = st
+            return st
+
+    def close_stream(self, st: _ClientStream) -> None:
+        with self._lock:
+            self._streams.pop(st.stream_id, None)
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                f = self.reader.read_frame()
+                if f is None:
+                    self._die("server closed connection")
+                    return
+                self._dispatch(f)
+        except (EndpointError, fr.FrameError, OSError) as exc:
+            self._die(str(exc))
+
+    def _dispatch(self, f: fr.Frame) -> None:
+        if f.type == fr.PING:
+            self.writer.send(fr.PONG, 0, 0, f.payload)
+            return
+        if f.type == fr.PONG:
+            with self._lock:
+                waiters, self._pong_waiters = self._pong_waiters, []
+            for ev in waiters:
+                ev.set()
+            return
+        if f.type == fr.GOAWAY:
+            self._die("server sent GOAWAY")
+            return
+        with self._lock:
+            st = self._streams.get(f.stream_id)
+        if st is None:
+            return  # late frame for a cancelled/finished stream
+        if f.type == fr.MESSAGE:
+            st.deliver_message(f.payload, bool(f.flags & fr.FLAG_MORE))
+        elif f.type == fr.HEADERS:
+            md, _ = fr.decode_metadata(f.payload)
+            st.initial_metadata = md
+            st.events.put(("initial_metadata", md))
+        elif f.type in (fr.TRAILERS, fr.RST):
+            code, details, md = fr.parse_trailers(f.payload)
+            # Terminal frame: nothing further arrives for this stream — drop it
+            # now so abandoned Call objects don't leak connection state.
+            self.close_stream(st)
+            st.deliver_trailers(code, details, md)
+        else:
+            raise fr.FrameError(f"unexpected frame {f!r}")
+
+    def ping(self, timeout: float) -> float:
+        """Round-trip one PING/PONG; returns seconds or raises on no reply."""
+        ev = threading.Event()
+        with self._lock:
+            if not self.alive:
+                raise EndpointError("connection closed")
+            self._pong_waiters.append(ev)
+        t0 = time.perf_counter()
+        self.writer.send(fr.PING, 0, 0, b"tpurpc-ping")
+        if not ev.wait(timeout):
+            raise TimeoutError("ping timed out")
+        if not self.alive:  # waiters are released on death too
+            raise EndpointError("connection died during ping")
+        return time.perf_counter() - t0
+
+    def _die(self, why: str) -> None:
+        with self._lock:
+            if not self.alive:
+                return
+            self.alive = False
+            streams = list(self._streams.values())
+            self._streams.clear()
+            waiters, self._pong_waiters = self._pong_waiters, []
+        for ev in waiters:
+            ev.set()  # ping() observes !alive via the raced send/raise below
+        trace_channel.log("connection dead: %s", why)
+        for st in streams:
+            st.deliver_failure(StatusCode.UNAVAILABLE, f"transport failed: {why}")
+        try:
+            self.endpoint.close()
+        except Exception:
+            pass
+        self._on_dead(self)
+
+    def close(self) -> None:
+        self._die("channel closed")
+
+
+class Channel:
+    """A lazily-(re)connecting client channel.
+
+    ``target`` is ``"host:port"``; tests may instead inject ``endpoint_factory``
+    (e.g. one half of :func:`tpurpc.core.endpoint.passthru_endpoint_pair` — the
+    moral equivalent of the reference's inproc transport).
+    """
+
+    #: reconnect backoff, mirroring lib/backoff defaults (initial 1s would be
+    #: sluggish for tests; we start at 50ms, cap 2s, jitter 20%).
+    _BACKOFF_INITIAL = 0.05
+    _BACKOFF_MAX = 2.0
+    _BACKOFF_MULT = 1.6
+
+    def __init__(self, target: Optional[str] = None, *,
+                 endpoint_factory: Optional[Callable[[], Endpoint]] = None,
+                 connect_timeout: float = 30.0):
+        if endpoint_factory is None:
+            if target is None:
+                raise ValueError("need target or endpoint_factory")
+            host, _, port_s = target.rpartition(":")
+            if not host or not port_s.isdigit():
+                raise ValueError(f"target must be host:port, got {target!r}")
+            port = int(port_s)
+            factory = lambda: connect_endpoint(host, port, timeout=connect_timeout)
+        else:
+            factory = endpoint_factory
+        self._factory = factory
+        self._conn: Optional[_Connection] = None
+        self._lock = threading.Lock()          # guards _conn/_closed/backoff state
+        self._connect_lock = threading.Lock()  # serializes dial attempts only
+        self._closed = False
+        self._backoff = self._BACKOFF_INITIAL
+        self._next_attempt = 0.0
+
+    # -- connection management ----------------------------------------------
+
+    def _connection(self) -> _Connection:
+        with self._lock:
+            if self._closed:
+                raise RpcError(StatusCode.UNAVAILABLE, "channel closed")
+            if self._conn is not None and self._conn.alive:
+                return self._conn
+        # Dial outside self._lock: a blackholed connect must not freeze close()
+        # or concurrent calls for the whole connect timeout.
+        with self._connect_lock:
+            with self._lock:
+                if self._closed:
+                    raise RpcError(StatusCode.UNAVAILABLE, "channel closed")
+                if self._conn is not None and self._conn.alive:
+                    return self._conn
+                wait = self._next_attempt - time.monotonic()
+            if wait > 0:
+                time.sleep(wait)
+            try:
+                ep = self._factory()
+                conn = _Connection(ep, self._on_conn_dead)
+            except (OSError, EndpointError) as exc:
+                with self._lock:
+                    self._next_attempt = (
+                        time.monotonic()
+                        + self._backoff * (1 + 0.2 * random.random()))
+                    self._backoff = min(self._backoff * self._BACKOFF_MULT,
+                                        self._BACKOFF_MAX)
+                raise RpcError(StatusCode.UNAVAILABLE,
+                               f"connect failed: {exc}") from exc
+            with self._lock:
+                if self._closed:
+                    conn.close()
+                    raise RpcError(StatusCode.UNAVAILABLE, "channel closed")
+                self._backoff = self._BACKOFF_INITIAL
+                self._conn = conn
+                return conn
+
+    def _on_conn_dead(self, conn: _Connection) -> None:
+        with self._lock:
+            if self._conn is conn:
+                self._conn = None
+
+    def ping(self, timeout: float = 5.0) -> float:
+        """Round-trip a PING; returns seconds.  Liveness probe (the reference's
+        analog: rate-limited ``ibv_query_qp``, ``pair.cc:349-375``)."""
+        conn = self._connection()
+        try:
+            return conn.ping(timeout)
+        except TimeoutError as exc:
+            raise RpcError(StatusCode.DEADLINE_EXCEEDED, str(exc)) from exc
+        except (EndpointError, OSError) as exc:
+            raise RpcError(StatusCode.UNAVAILABLE, str(exc)) from exc
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            conn, self._conn = self._conn, None
+        if conn is not None:
+            conn.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- call surface (grpcio-shaped) ----------------------------------------
+
+    def unary_unary(self, method: str, request_serializer: Serializer = _identity,
+                    response_deserializer: Deserializer = _identity) -> "UnaryUnary":
+        return UnaryUnary(self, method, request_serializer, response_deserializer)
+
+    def unary_stream(self, method: str, request_serializer: Serializer = _identity,
+                     response_deserializer: Deserializer = _identity) -> "UnaryStream":
+        return UnaryStream(self, method, request_serializer, response_deserializer)
+
+    def stream_unary(self, method: str, request_serializer: Serializer = _identity,
+                     response_deserializer: Deserializer = _identity) -> "StreamUnary":
+        return StreamUnary(self, method, request_serializer, response_deserializer)
+
+    def stream_stream(self, method: str, request_serializer: Serializer = _identity,
+                      response_deserializer: Deserializer = _identity) -> "StreamStream":
+        return StreamStream(self, method, request_serializer, response_deserializer)
+
+
+class Call:
+    """In-flight call handle: response iteration, cancel, metadata accessors."""
+
+    def __init__(self, conn: _Connection, st: _ClientStream,
+                 deserializer: Deserializer, deadline: Optional[float]):
+        self._conn = conn
+        self._st = st
+        self._deser = deserializer
+        self._deadline = deadline
+        self._trailing: Optional[Metadata] = None
+        self._code: Optional[StatusCode] = None
+        self._details = ""
+        self._cancelled = False
+
+    # -- metadata/status ------------------------------------------------------
+
+    def initial_metadata(self):
+        return self._st.initial_metadata or []
+
+    def trailing_metadata(self):
+        return self._trailing
+
+    def code(self) -> Optional[StatusCode]:
+        return self._code
+
+    def details(self) -> str:
+        return self._details
+
+    def cancel(self) -> None:
+        if self._code is not None or self._cancelled:
+            return
+        self._cancelled = True
+        try:
+            self._conn.writer.send(fr.RST, 0, self._st.stream_id,
+                                   fr.rst_payload(StatusCode.CANCELLED,
+                                                  "cancelled by client"))
+        except (EndpointError, OSError):
+            pass
+        self._st.deliver_failure(StatusCode.CANCELLED, "cancelled by client")
+
+    def time_remaining(self) -> Optional[float]:
+        if self._deadline is None:
+            return None
+        return max(0.0, self._deadline - time.monotonic())
+
+    # -- response consumption -------------------------------------------------
+
+    def _next_event(self):
+        timeout = self.time_remaining()
+        try:
+            return self._st.events.get(timeout=timeout)
+        except queue.Empty:
+            self._expire()
+            raise RpcError(StatusCode.DEADLINE_EXCEEDED,
+                           "deadline exceeded awaiting response") from None
+
+    def _expire(self) -> None:
+        self._code = StatusCode.DEADLINE_EXCEEDED
+        self._details = "deadline exceeded"
+        try:
+            self._conn.writer.send(fr.RST, 0, self._st.stream_id,
+                                   fr.rst_payload(StatusCode.DEADLINE_EXCEEDED,
+                                                  "deadline exceeded"))
+        except (EndpointError, OSError):
+            pass
+        self._conn.close_stream(self._st)
+
+    def _finish(self, code: StatusCode, details: str, md) -> None:
+        self._code = code
+        self._details = details
+        self._trailing = md
+        self._conn.close_stream(self._st)
+
+    def messages(self) -> Iterator[object]:
+        """Yield deserialized responses until trailers; raise on non-OK."""
+        while True:
+            ev = self._next_event()
+            if ev[0] == "initial_metadata":
+                continue
+            if ev[0] == "message":
+                yield self._deser(ev[1])
+                continue
+            _, code, details, md = ev
+            self._finish(code, details, md)
+            if code is not StatusCode.OK:
+                raise RpcError(code, details, md)
+            return
+
+    def __iter__(self):
+        return self.messages()
+
+
+class _MultiCallable:
+    def __init__(self, channel: Channel, method: str,
+                 serializer: Serializer, deserializer: Deserializer):
+        self._channel = channel
+        self._method = method
+        self._ser = serializer
+        self._deser = deserializer
+
+    def _start(self, metadata: Optional[Metadata],
+               timeout: Optional[float]) -> Tuple[_Connection, _ClientStream, Call]:
+        conn = self._channel._connection()
+        try:
+            st = conn.open_stream()
+            deadline = None if timeout is None else time.monotonic() + timeout
+            timeout_us = None if timeout is None else max(0, int(timeout * 1e6))
+            conn.writer.send(fr.HEADERS, 0, st.stream_id,
+                             fr.headers_payload(self._method, metadata or (),
+                                                timeout_us))
+        except fr.FrameError as exc:
+            conn.close_stream(st)
+            raise RpcError(StatusCode.RESOURCE_EXHAUSTED, str(exc)) from exc
+        except (EndpointError, OSError) as exc:
+            raise RpcError(StatusCode.UNAVAILABLE,
+                           f"transport failed: {exc}") from exc
+        return conn, st, Call(conn, st, self._deser, deadline)
+
+    def _send_one(self, conn: _Connection, st: _ClientStream, request,
+                  end_stream: bool) -> None:
+        try:
+            conn.writer.send(fr.MESSAGE, fr.FLAG_END_STREAM if end_stream else 0,
+                             st.stream_id, self._ser(request))
+        except (EndpointError, OSError) as exc:
+            raise RpcError(StatusCode.UNAVAILABLE,
+                           f"transport failed: {exc}") from exc
+
+    def _send_stream(self, conn: _Connection, st: _ClientStream,
+                     request_iterator: Iterable, call: Call) -> None:
+        try:
+            for request in request_iterator:
+                if st.done:
+                    return  # server already terminated the call
+                self._send_one(conn, st, request, end_stream=False)
+            # Pure half-close marker, NOT an empty message (FLAG_NO_MESSAGE).
+            conn.writer.send(fr.MESSAGE,
+                             fr.FLAG_END_STREAM | fr.FLAG_NO_MESSAGE,
+                             st.stream_id, b"")
+        except (RpcError, EndpointError, OSError):
+            pass  # reader thread surfaces the transport failure with a status
+        except Exception as exc:
+            # The *user's* request iterator (or serializer) raised: terminate the
+            # stream both ways or the call would hang until its deadline and the
+            # server handler would block forever on requests.get().
+            try:
+                conn.writer.send(fr.RST, 0, st.stream_id,
+                                 fr.rst_payload(StatusCode.CANCELLED,
+                                                f"request iterator raised: {exc}"))
+            except (EndpointError, OSError, fr.FrameError):
+                pass
+            conn.close_stream(st)
+            st.deliver_failure(StatusCode.CANCELLED,
+                               f"request iterator raised: {exc!r}")
+
+
+class UnaryUnary(_MultiCallable):
+    def __call__(self, request, timeout: Optional[float] = None,
+                 metadata: Optional[Metadata] = None):
+        response, _ = self.with_call(request, timeout=timeout, metadata=metadata)
+        return response
+
+    def with_call(self, request, timeout: Optional[float] = None,
+                  metadata: Optional[Metadata] = None):
+        conn, st, call = self._start(metadata, timeout)
+        self._send_one(conn, st, request, end_stream=True)
+        response = None
+        got = False
+        for msg in call.messages():
+            if got:
+                raise RpcError(StatusCode.INTERNAL,
+                               "unary call received multiple responses")
+            response, got = msg, True
+        if not got:
+            raise RpcError(StatusCode.INTERNAL, "unary call received no response")
+        return response, call
+
+    def future(self, request, timeout: Optional[float] = None,
+               metadata: Optional[Metadata] = None):
+        """Minimal future: runs the call on a daemon thread."""
+        import concurrent.futures
+
+        fut: "concurrent.futures.Future" = concurrent.futures.Future()
+
+        def run():
+            if not fut.set_running_or_notify_cancel():
+                return
+            try:
+                fut.set_result(self(request, timeout, metadata))
+            except BaseException as exc:
+                fut.set_exception(exc)
+
+        threading.Thread(target=run, daemon=True,
+                         name="tpurpc-unary-future").start()
+        return fut
+
+
+class UnaryStream(_MultiCallable):
+    def __call__(self, request, timeout: Optional[float] = None,
+                 metadata: Optional[Metadata] = None) -> Call:
+        conn, st, call = self._start(metadata, timeout)
+        self._send_one(conn, st, request, end_stream=True)
+        return call
+
+
+class StreamUnary(_MultiCallable):
+    def __call__(self, request_iterator: Iterable,
+                 timeout: Optional[float] = None,
+                 metadata: Optional[Metadata] = None):
+        conn, st, call = self._start(metadata, timeout)
+        sender = threading.Thread(
+            target=self._send_stream, args=(conn, st, request_iterator, call),
+            daemon=True)
+        sender.start()
+        response = None
+        got = False
+        for msg in call.messages():
+            if got:
+                raise RpcError(StatusCode.INTERNAL,
+                               "unary call received multiple responses")
+            response, got = msg, True
+        sender.join(timeout=5)
+        if not got:
+            raise RpcError(StatusCode.INTERNAL, "unary response missing")
+        return response
+
+
+class StreamStream(_MultiCallable):
+    def __call__(self, request_iterator: Iterable,
+                 timeout: Optional[float] = None,
+                 metadata: Optional[Metadata] = None) -> Call:
+        conn, st, call = self._start(metadata, timeout)
+        sender = threading.Thread(
+            target=self._send_stream, args=(conn, st, request_iterator, call),
+            daemon=True)
+        sender.start()
+        return call
+
+
+def insecure_channel(target: str, **kwargs) -> Channel:
+    """grpcio-shaped constructor."""
+    return Channel(target, **kwargs)

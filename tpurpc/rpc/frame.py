@@ -1,0 +1,271 @@
+"""tpurpc native wire format: multiplexed frames over one byte-pipe endpoint.
+
+Design position (SURVEY.md §7 stage 3): the reference rides unmodified HTTP/2
+(``src/core/ext/transport/chttp2/``, 15,302 LoC) above its swapped byte pipe.  tpurpc
+keeps the *semantics* HTTP/2 gives gRPC — stream multiplexing, metadata, half-close,
+trailers-carry-status, cancellation (RST_STREAM), ping — in a deliberately simpler
+binary framing, because HPACK + h2 flow-control windows buy nothing on a
+single-tenant accelerator-to-accelerator link.  A separate ``tpurpc.rpc.h2`` module
+speaks true gRPC-over-HTTP/2 for stock-grpcio interop; both sit on the same Endpoint.
+
+Frame layout (all integers little-endian)::
+
+    [u8 type][u8 flags][u32 stream_id][u32 length] [payload: length bytes]
+
+Frame types mirror the h2 subset gRPC actually uses (``frame_*.cc`` in the
+reference): HEADERS, MESSAGE (DATA), TRAILERS (HEADERS+END_STREAM), RST, PING,
+GOAWAY.  A MESSAGE larger than ``MAX_FRAME_PAYLOAD`` is split into fragments with
+the MORE flag set on all but the last — the structural analog of the reference's
+chunked flush at ``max_send_size`` (``rdma_event_posix.cc:312-421``).
+
+Metadata encoding: ``u16 count`` then per-entry ``u16 keylen, key-utf8,
+u32 vallen, value-bytes``.  Keys ending in ``-bin`` carry binary values (gRPC
+convention); all other values are utf-8 text.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+from tpurpc.core.endpoint import Endpoint
+from tpurpc.rpc.status import StatusCode
+
+MAGIC = b"TPURPC\x01\x00"  # connection preface, client → server
+MAX_FRAME_PAYLOAD = 1 << 20
+HEADER_FMT = struct.Struct("<BBII")
+
+# frame types
+HEADERS = 1
+MESSAGE = 2
+TRAILERS = 3
+RST = 4
+PING = 5
+PONG = 6
+GOAWAY = 7
+
+# flags
+FLAG_END_STREAM = 0x01  # sender half-closes this stream (ref: h2 END_STREAM)
+FLAG_MORE = 0x02        # this MESSAGE frame is a fragment; more follow
+FLAG_NO_MESSAGE = 0x04  # MESSAGE frame carries no message (pure half-close marker),
+                        # distinguishing it from a genuine empty message
+
+_U16 = struct.Struct("<H")
+_U32 = struct.Struct("<I")
+
+
+class FrameError(Exception):
+    """Protocol violation on the wire; connection-fatal."""
+
+
+def encode_metadata(md: Sequence[Tuple[str, "str | bytes"]]) -> bytes:
+    parts = [_U16.pack(len(md))]
+    for key, value in md:
+        kb = key.encode("utf-8")
+        vb = value if isinstance(value, (bytes, bytearray)) else str(value).encode("utf-8")
+        parts.append(_U16.pack(len(kb)))
+        parts.append(kb)
+        parts.append(_U32.pack(len(vb)))
+        parts.append(bytes(vb))
+    return b"".join(parts)
+
+
+def decode_metadata(buf: bytes, offset: int = 0) -> Tuple[List[Tuple[str, "str | bytes"]], int]:
+    try:
+        (count,) = _U16.unpack_from(buf, offset)
+        offset += 2
+        out: List[Tuple[str, "str | bytes"]] = []
+        for _ in range(count):
+            (klen,) = _U16.unpack_from(buf, offset)
+            offset += 2
+            key = bytes(buf[offset:offset + klen]).decode("utf-8")
+            offset += klen
+            (vlen,) = _U32.unpack_from(buf, offset)
+            offset += 4
+            raw = bytes(buf[offset:offset + vlen])
+            offset += vlen
+            value: "str | bytes" = raw if key.endswith("-bin") else raw.decode("utf-8")
+            out.append((key, value))
+        return out, offset
+    except (struct.error, UnicodeDecodeError) as exc:
+        raise FrameError(f"bad metadata block: {exc}") from exc
+
+
+class Frame:
+    __slots__ = ("type", "flags", "stream_id", "payload")
+
+    def __init__(self, type: int, flags: int, stream_id: int, payload: bytes = b""):
+        self.type = type
+        self.flags = flags
+        self.stream_id = stream_id
+        self.payload = payload
+
+    def __repr__(self) -> str:
+        names = {1: "HEADERS", 2: "MESSAGE", 3: "TRAILERS", 4: "RST",
+                 5: "PING", 6: "PONG", 7: "GOAWAY"}
+        return (f"<Frame {names.get(self.type, self.type)} sid={self.stream_id} "
+                f"flags={self.flags:#x} len={len(self.payload)}>")
+
+
+def encode_frame(ftype: int, flags: int, stream_id: int, payload: bytes = b"") -> List[bytes]:
+    """Header + payload as separate slices for the endpoint's gather write."""
+    out = [HEADER_FMT.pack(ftype, flags, stream_id, len(payload))]
+    if payload:
+        out.append(payload)
+    return out
+
+
+def headers_payload(path: str, metadata: Sequence[Tuple[str, "str | bytes"]] = (),
+                    timeout_us: Optional[int] = None) -> bytes:
+    md = [(":path", path)]
+    if timeout_us is not None:
+        md.append((":timeout-us", str(timeout_us)))
+    md.extend(metadata)
+    return encode_metadata(md)
+
+
+def parse_headers(payload: bytes) -> Tuple[str, Optional[int], List[Tuple[str, "str | bytes"]]]:
+    md, _ = decode_metadata(payload)
+    path = ""
+    timeout_us: Optional[int] = None
+    user: List[Tuple[str, "str | bytes"]] = []
+    for key, value in md:
+        if key == ":path":
+            path = str(value)
+        elif key == ":timeout-us":
+            try:
+                timeout_us = int(value)
+            except ValueError as exc:
+                raise FrameError(f"bad :timeout-us {value!r}") from exc
+        else:
+            user.append((key, value))
+    if not path:
+        raise FrameError("HEADERS missing :path")
+    return path, timeout_us, user
+
+
+MAX_STATUS_DETAILS = 16 << 10
+
+
+def trailers_payload(code: StatusCode, details: str = "",
+                     metadata: Sequence[Tuple[str, "str | bytes"]] = ()) -> bytes:
+    md = [(":status", str(int(code)))]
+    if details:
+        # Bound the status message (e.g. a handler exception repr) so trailers
+        # always fit one control frame.
+        md.append((":message", details[:MAX_STATUS_DETAILS]))
+    md.extend(metadata)
+    return encode_metadata(md)
+
+
+def parse_trailers(payload: bytes) -> Tuple[StatusCode, str, List[Tuple[str, "str | bytes"]]]:
+    md, _ = decode_metadata(payload)
+    code = StatusCode.UNKNOWN
+    details = ""
+    user: List[Tuple[str, "str | bytes"]] = []
+    for key, value in md:
+        if key == ":status":
+            try:
+                code = StatusCode(int(value))
+            except ValueError as exc:
+                raise FrameError(f"bad :status {value!r}") from exc
+        elif key == ":message":
+            details = str(value)
+        else:
+            user.append((key, value))
+    return code, details, user
+
+
+def rst_payload(code: StatusCode, details: str = "") -> bytes:
+    return trailers_payload(code, details)
+
+
+parse_rst = parse_trailers
+
+
+class FrameWriter:
+    """Serializes frame writes from many threads onto one endpoint.
+
+    The single lock is the moral equivalent of chttp2's write-combiner
+    (``chttp2_transport.cc:997`` write_action): one writer at a time, gather slices,
+    large messages fragmented so no stream can monopolize the pipe.
+    """
+
+    def __init__(self, endpoint: Endpoint):
+        import threading
+
+        self._ep = endpoint
+        self._lock = threading.Lock()
+
+    def send(self, ftype: int, flags: int, stream_id: int, payload: bytes = b"") -> None:
+        if len(payload) > MAX_FRAME_PAYLOAD:
+            if ftype != MESSAGE:
+                # Control frames don't fragment; sending one oversized would make
+                # the peer tear down the whole multiplexed connection.  Fail just
+                # this caller instead.
+                raise FrameError(
+                    f"control frame payload {len(payload)} exceeds "
+                    f"{MAX_FRAME_PAYLOAD}; metadata too large")
+            self._send_fragmented(flags, stream_id, payload)
+            return
+        with self._lock:
+            self._ep.write(encode_frame(ftype, flags, stream_id, payload))
+
+    def _send_fragmented(self, flags: int, stream_id: int, payload: bytes) -> None:
+        view = memoryview(payload)
+        with self._lock:
+            pos = 0
+            while pos < len(view):
+                chunk = view[pos:pos + MAX_FRAME_PAYLOAD]
+                pos += len(chunk)
+                last = pos >= len(view)
+                fl = (flags if last else (flags & ~FLAG_END_STREAM) | FLAG_MORE)
+                self._ep.write(encode_frame(MESSAGE, fl, stream_id, bytes(chunk)))
+
+    def send_preface(self) -> None:
+        with self._lock:
+            self._ep.write(MAGIC)
+
+
+class FrameReader:
+    """Buffered frame parser over the endpoint's read() stream."""
+
+    def __init__(self, endpoint: Endpoint, expect_preface: bool = False):
+        self._ep = endpoint
+        self._buf = bytearray()
+        self._eof = False
+        self._need_preface = expect_preface
+
+    def _fill(self, need: int, timeout: Optional[float] = None) -> bool:
+        """Grow the buffer to ≥ need bytes; False on clean EOF first."""
+        while len(self._buf) < need:
+            if self._eof:
+                return False
+            data = self._ep.read(1 << 20, timeout=timeout)
+            if data == b"":
+                self._eof = True
+                return len(self._buf) >= need
+            self._buf += data
+        return True
+
+    def read_frame(self, timeout: Optional[float] = None) -> Optional[Frame]:
+        """Next frame, or None at clean EOF.  Raises EndpointError/FrameError."""
+        if self._need_preface:
+            if not self._fill(len(MAGIC), timeout):
+                return None
+            if bytes(self._buf[:len(MAGIC)]) != MAGIC:
+                raise FrameError(f"bad connection preface: {bytes(self._buf[:8])!r}")
+            del self._buf[:len(MAGIC)]
+            self._need_preface = False
+        if not self._fill(HEADER_FMT.size, timeout):
+            if self._buf:
+                raise FrameError("truncated frame header at EOF")
+            return None
+        ftype, flags, stream_id, length = HEADER_FMT.unpack_from(self._buf)
+        if length > MAX_FRAME_PAYLOAD:
+            raise FrameError(f"frame length {length} exceeds max {MAX_FRAME_PAYLOAD}")
+        if not self._fill(HEADER_FMT.size + length, timeout):
+            raise FrameError("truncated frame payload at EOF")
+        payload = bytes(self._buf[HEADER_FMT.size:HEADER_FMT.size + length])
+        del self._buf[:HEADER_FMT.size + length]
+        return Frame(ftype, flags, stream_id, payload)

@@ -1,0 +1,470 @@
+"""Server: listener → per-connection demux → handler dispatch on a thread pool.
+
+Reference mapping:
+
+* ``Server`` ≈ ``grpc_server`` (``src/core/lib/surface/server.cc``) + C++
+  ``ServerBuilder`` (``src/cpp/server/server_builder.cc``): ports, registered
+  methods, a thread pool standing in for the CQ/thread-manager machinery
+  (``src/cpp/thread_manager/``).
+* ``_ServerConnection`` ≈ one accepted chttp2 transport
+  (``grpc_server_setup_transport``); its reader thread plays the role of the
+  transport's read_action + stream demux.
+* ``ServerContext`` mirrors grpcio's (``src/python/grpcio/grpc/_server.py``):
+  invocation metadata, deadline, cancellation, ``abort``, trailing metadata.
+* Method handlers reuse grpcio's four-shape taxonomy so generated service glue
+  ports directly.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, Iterable, Iterator, List, Optional, Tuple
+
+from tpurpc.core.endpoint import (Endpoint, EndpointError, EndpointListener,
+                                  passthru_endpoint_pair)
+from tpurpc.rpc import frame as fr
+from tpurpc.rpc.status import (AbortError, Deserializer, Metadata, Serializer,
+                               StatusCode, identity_codec as _identity)
+from tpurpc.utils.trace import TraceFlag
+
+trace_server = TraceFlag("server")
+_log = logging.getLogger("tpurpc.server")
+
+
+class RpcMethodHandler:
+    """One registered method: shape + behavior + codecs (grpcio taxonomy)."""
+
+    __slots__ = ("kind", "behavior", "request_deserializer", "response_serializer")
+
+    KINDS = ("unary_unary", "unary_stream", "stream_unary", "stream_stream")
+
+    def __init__(self, kind: str, behavior: Callable,
+                 request_deserializer: Deserializer = _identity,
+                 response_serializer: Serializer = _identity):
+        if kind not in self.KINDS:
+            raise ValueError(f"bad handler kind {kind}")
+        self.kind = kind
+        self.behavior = behavior
+        self.request_deserializer = request_deserializer
+        self.response_serializer = response_serializer
+
+    @property
+    def request_streaming(self) -> bool:
+        return self.kind.startswith("stream")
+
+    @property
+    def response_streaming(self) -> bool:
+        return self.kind.endswith("stream")
+
+
+def unary_unary_rpc_method_handler(behavior, request_deserializer=_identity,
+                                   response_serializer=_identity):
+    return RpcMethodHandler("unary_unary", behavior, request_deserializer,
+                            response_serializer)
+
+
+def unary_stream_rpc_method_handler(behavior, request_deserializer=_identity,
+                                    response_serializer=_identity):
+    return RpcMethodHandler("unary_stream", behavior, request_deserializer,
+                            response_serializer)
+
+
+def stream_unary_rpc_method_handler(behavior, request_deserializer=_identity,
+                                    response_serializer=_identity):
+    return RpcMethodHandler("stream_unary", behavior, request_deserializer,
+                            response_serializer)
+
+
+def stream_stream_rpc_method_handler(behavior, request_deserializer=_identity,
+                                     response_serializer=_identity):
+    return RpcMethodHandler("stream_stream", behavior, request_deserializer,
+                            response_serializer)
+
+
+def method_handlers_generic_handler(service: str,
+                                    method_handlers: Dict[str, RpcMethodHandler]):
+    """grpcio-shaped: returns {path: handler} for Server.add_generic_handlers."""
+    return {f"/{service}/{name}": h for name, h in method_handlers.items()}
+
+
+class ServerContext:
+    """Handed to every handler; grpcio-compatible surface."""
+
+    def __init__(self, conn: "_ServerConnection", stream: "_ServerStream",
+                 metadata: List[Tuple[str, "str | bytes"]],
+                 deadline: Optional[float]):
+        self._conn = conn
+        self._stream = stream
+        self._metadata = metadata
+        self._deadline = deadline
+        self._trailing: Metadata = ()
+        self._initial_sent = False
+        self._cancelled = threading.Event()
+        self._code: Optional[StatusCode] = None
+        self._details = ""
+
+    # grpcio surface ---------------------------------------------------------
+
+    def invocation_metadata(self) -> Metadata:
+        return list(self._metadata)
+
+    def peer(self) -> str:
+        return self._conn.endpoint.peer
+
+    def deadline_remaining(self) -> Optional[float]:
+        if self._deadline is None:
+            return None
+        return max(0.0, self._deadline - time.monotonic())
+
+    time_remaining = deadline_remaining
+
+    def is_active(self) -> bool:
+        return not self._cancelled.is_set()
+
+    def cancel(self) -> None:
+        self._cancelled.set()
+
+    def set_trailing_metadata(self, metadata: Metadata) -> None:
+        self._trailing = metadata
+
+    def set_code(self, code: StatusCode) -> None:
+        self._code = code
+
+    def set_details(self, details: str) -> None:
+        self._details = details
+
+    def abort(self, code: StatusCode, details: str = ""):
+        if code is StatusCode.OK:
+            raise ValueError("abort with OK is invalid")
+        raise AbortError(code, details)
+
+    def send_initial_metadata(self, metadata: Metadata) -> None:
+        if self._initial_sent:
+            raise RuntimeError("initial metadata already sent")
+        self._initial_sent = True
+        self._conn.writer.send(fr.HEADERS, 0, self._stream.stream_id,
+                               fr.encode_metadata(list(metadata)))
+
+    # internal ---------------------------------------------------------------
+
+    def _deadline_exceeded(self) -> bool:
+        return self._deadline is not None and time.monotonic() >= self._deadline
+
+
+class _ServerStream:
+    """Inbound half of one RPC: request frames → handler-visible iterator."""
+
+    _END = object()
+
+    def __init__(self, stream_id: int):
+        self.stream_id = stream_id
+        self.requests: "queue.Queue[object]" = queue.Queue()
+        self._fragments: List[bytes] = []
+        self.half_closed = False
+        self.context: Optional[ServerContext] = None
+
+    def deliver_message(self, payload: bytes, more: bool, end_stream: bool,
+                        no_message: bool = False) -> None:
+        if not no_message:
+            self._fragments.append(payload)
+            if not more:
+                whole = b"".join(self._fragments)
+                self._fragments = []
+                self.requests.put(whole)
+        if end_stream:
+            self.half_closed = True
+            self.requests.put(self._END)
+
+    def cancel(self) -> None:
+        if self.context is not None:
+            self.context.cancel()
+        self.requests.put(self._END)
+
+    def request_iterator(self, deserializer: Deserializer,
+                         context: ServerContext) -> Iterator[object]:
+        while True:
+            item = self.requests.get()
+            if item is self._END:
+                return
+            if not context.is_active():
+                return
+            yield deserializer(item)
+
+
+class _ServerConnection:
+    def __init__(self, server: "Server", endpoint: Endpoint):
+        self.server = server
+        self.endpoint = endpoint
+        self.writer = fr.FrameWriter(endpoint)
+        self.reader = fr.FrameReader(endpoint, expect_preface=True)
+        self._streams: Dict[int, _ServerStream] = {}
+        self._lock = threading.Lock()
+        self.alive = True
+        self._thread = threading.Thread(target=self._read_loop, daemon=True,
+                                        name="tpurpc-srv-reader")
+        self._thread.start()
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                f = self.reader.read_frame()
+                if f is None:
+                    break
+                self._dispatch(f)
+        except (EndpointError, fr.FrameError, OSError) as exc:
+            trace_server.log("server connection error: %s", exc)
+        finally:
+            self._shutdown()
+
+    def _dispatch(self, f: fr.Frame) -> None:
+        if f.type == fr.PING:
+            self.writer.send(fr.PONG, 0, 0, f.payload)
+            return
+        if f.type == fr.PONG:
+            return
+        if f.type == fr.GOAWAY:
+            raise EndpointError("client sent GOAWAY")
+        with self._lock:
+            st = self._streams.get(f.stream_id)
+        if f.type == fr.HEADERS:
+            if st is not None:
+                raise fr.FrameError(f"duplicate HEADERS for stream {f.stream_id}")
+            self._start_stream(f)
+            return
+        if st is None:
+            return  # frame for a finished/cancelled stream
+        if f.type == fr.MESSAGE:
+            st.deliver_message(f.payload, bool(f.flags & fr.FLAG_MORE),
+                               bool(f.flags & fr.FLAG_END_STREAM),
+                               bool(f.flags & fr.FLAG_NO_MESSAGE))
+        elif f.type == fr.RST:
+            st.cancel()
+            self._finish_stream(st)
+        else:
+            raise fr.FrameError(f"unexpected frame {f!r}")
+
+    def _start_stream(self, f: fr.Frame) -> None:
+        path, timeout_us, metadata = fr.parse_headers(f.payload)
+        st = _ServerStream(f.stream_id)
+        with self._lock:
+            self._streams[f.stream_id] = st
+        deadline = (None if timeout_us is None
+                    else time.monotonic() + timeout_us / 1e6)
+        handler = self.server._lookup(path)
+        if handler is None:
+            self._send_trailers(st, StatusCode.UNIMPLEMENTED,
+                                f"unknown method {path}")
+            self._finish_stream(st)
+            return
+        ctx = ServerContext(self, st, metadata, deadline)
+        st.context = ctx
+        try:
+            self.server._pool.submit(self._run_handler, handler, st, ctx, path)
+        except RuntimeError:  # pool shut down: server is stopping
+            self._send_trailers(st, StatusCode.UNAVAILABLE, "server shutting down")
+            self._finish_stream(st)
+
+    def _run_handler(self, handler: RpcMethodHandler, st: _ServerStream,
+                     ctx: ServerContext, path: str) -> None:
+        try:
+            if handler.request_streaming:
+                request_in = st.request_iterator(handler.request_deserializer, ctx)
+            else:
+                try:
+                    # Honor the declared deadline while waiting for the request
+                    # body, or a silent client pins this pool worker until its
+                    # connection dies.
+                    item = st.requests.get(timeout=ctx.deadline_remaining())
+                except queue.Empty:
+                    self._send_trailers(st, StatusCode.DEADLINE_EXCEEDED,
+                                        "deadline exceeded awaiting request")
+                    return
+                if item is _ServerStream._END or not ctx.is_active():
+                    if ctx.is_active():
+                        self._send_trailers(
+                            st, StatusCode.INVALID_ARGUMENT,
+                            "client half-closed before sending a request")
+                    return
+                request_in = handler.request_deserializer(item)
+
+            result = handler.behavior(request_in, ctx)
+
+            if handler.response_streaming:
+                for response in result:
+                    if not ctx.is_active():
+                        return
+                    if ctx._deadline_exceeded():
+                        self._send_trailers(st, StatusCode.DEADLINE_EXCEEDED,
+                                            "deadline exceeded", ctx._trailing)
+                        return
+                    self.writer.send(fr.MESSAGE, 0, st.stream_id,
+                                     handler.response_serializer(response))
+            else:
+                if ctx.is_active():
+                    self.writer.send(fr.MESSAGE, 0, st.stream_id,
+                                     handler.response_serializer(result))
+            if ctx.is_active():
+                code = ctx._code if ctx._code is not None else StatusCode.OK
+                self._send_trailers(st, code, ctx._details, ctx._trailing)
+        except AbortError as exc:
+            self._send_trailers(st, exc.code, exc.details, ctx._trailing)
+        except (EndpointError, OSError):
+            pass  # connection already gone
+        except Exception as exc:  # handler bug → UNKNOWN, like grpcio
+            _log.exception("handler for %s raised", path)
+            self._send_trailers(st, StatusCode.UNKNOWN,
+                                f"Exception calling application: {exc}")
+        finally:
+            self._finish_stream(st)
+
+    def _send_trailers(self, st: _ServerStream, code: StatusCode, details: str,
+                       metadata: Metadata = ()) -> None:
+        try:
+            try:
+                self.writer.send(fr.TRAILERS, fr.FLAG_END_STREAM, st.stream_id,
+                                 fr.trailers_payload(code, details, list(metadata)))
+            except fr.FrameError:
+                # User trailing metadata too large for one control frame: still
+                # terminate the stream correctly, just without the metadata.
+                self.writer.send(
+                    fr.TRAILERS, fr.FLAG_END_STREAM, st.stream_id,
+                    fr.trailers_payload(StatusCode.INTERNAL,
+                                        "trailing metadata too large"))
+        except (EndpointError, OSError):
+            pass
+
+    def _finish_stream(self, st: _ServerStream) -> None:
+        with self._lock:
+            self._streams.pop(st.stream_id, None)
+
+    def _shutdown(self) -> None:
+        with self._lock:
+            if not self.alive:
+                return
+            self.alive = False
+            streams = list(self._streams.values())
+            self._streams.clear()
+        for st in streams:
+            st.cancel()
+        try:
+            self.endpoint.close()
+        except Exception:
+            pass
+        self.server._forget(self)
+
+    def close(self) -> None:
+        try:
+            self.endpoint.close()  # unblocks the reader thread
+        except Exception:
+            pass
+
+
+class Server:
+    """Thread-pooled RPC server over any Endpoint source."""
+
+    def __init__(self, max_workers: int = 32):
+        self._pool = ThreadPoolExecutor(max_workers=max_workers,
+                                        thread_name_prefix="tpurpc-handler")
+        self._methods: Dict[str, RpcMethodHandler] = {}
+        self._listeners: List[EndpointListener] = []
+        self._pending_ports: List[Tuple[str, int]] = []
+        self._connections: List[_ServerConnection] = []
+        self._lock = threading.Lock()
+        self._started = False
+        self._stopped = threading.Event()
+
+    # -- registration --------------------------------------------------------
+
+    def add_method(self, path: str, handler: RpcMethodHandler) -> None:
+        self._methods[path] = handler
+
+    def add_generic_handlers(self, handlers: Dict[str, RpcMethodHandler]) -> None:
+        self._methods.update(handlers)
+
+    def add_service(self, service: str,
+                    method_handlers: Dict[str, RpcMethodHandler]) -> None:
+        self.add_generic_handlers(
+            method_handlers_generic_handler(service, method_handlers))
+
+    def _lookup(self, path: str) -> Optional[RpcMethodHandler]:
+        return self._methods.get(path)
+
+    # -- ports / lifecycle ---------------------------------------------------
+
+    def add_insecure_port(self, address: str) -> int:
+        host, _, port = address.rpartition(":")
+        if self._started:
+            return self._open_port(host or "0.0.0.0", int(port))
+        self._pending_ports.append((host or "0.0.0.0", int(port)))
+        return int(port)
+
+    def _open_port(self, host: str, port: int) -> int:
+        listener = EndpointListener(host, port, self.serve_endpoint)
+        self._listeners.append(listener)
+        return listener.port
+
+    def start(self) -> "Server":
+        if self._started:
+            return self
+        self._started = True
+        self.bound_ports = [self._open_port(h, p) for h, p in self._pending_ports]
+        self._pending_ports.clear()
+        return self
+
+    def serve_endpoint(self, endpoint: Endpoint) -> None:
+        """Adopt an already-connected endpoint (inproc/test path)."""
+        conn = _ServerConnection(self, endpoint)
+        with self._lock:
+            self._connections.append(conn)
+
+    def _forget(self, conn: _ServerConnection) -> None:
+        with self._lock:
+            try:
+                self._connections.remove(conn)
+            except ValueError:
+                pass
+
+    def stop(self, grace: Optional[float] = None) -> threading.Event:
+        for listener in self._listeners:
+            listener.close()
+        self._listeners.clear()
+        with self._lock:
+            conns = list(self._connections)
+        if grace:
+            deadline = time.monotonic() + grace
+            while time.monotonic() < deadline:
+                with self._lock:
+                    busy = any(c._streams for c in self._connections)
+                if not busy:
+                    break
+                time.sleep(0.01)
+        for conn in conns:
+            conn.close()
+        self._pool.shutdown(wait=False)
+        self._stopped.set()
+        return self._stopped
+
+    def wait_for_termination(self, timeout: Optional[float] = None) -> bool:
+        return self._stopped.wait(timeout)
+
+
+def server(max_workers: int = 32) -> Server:
+    """grpcio-shaped constructor (``grpc.server(ThreadPoolExecutor(...))``)."""
+    return Server(max_workers=max_workers)
+
+
+def inproc_channel(srv: Server):
+    """In-process channel↔server wiring over a passthru endpoint pair — the
+    reference's inproc transport (``src/core/ext/transport/inproc/``) as a seam."""
+    from tpurpc.rpc.channel import Channel
+
+    def factory():
+        a, b = passthru_endpoint_pair()
+        srv.serve_endpoint(b)
+        return a
+
+    return Channel(endpoint_factory=factory)

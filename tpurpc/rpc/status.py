@@ -1,0 +1,70 @@
+"""RPC status codes and errors — the surface contract of every call.
+
+The 16 canonical codes mirror gRPC's ``grpc_status_code`` (reference:
+``include/grpc/impl/codegen/status.h``); the transport→status mapping rule comes from
+the fork's endpoint error annotation: transport failures surface as ``UNAVAILABLE`` so
+the client channel knows it may reconnect and retry (``rdma_bp_posix.cc:86-96``).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Callable, Optional, Sequence, Tuple
+
+Metadata = Sequence[Tuple[str, "str | bytes"]]
+Serializer = Callable[[object], bytes]
+Deserializer = Callable[[bytes], object]
+
+
+def identity_codec(x):
+    """Default (de)serializer: the application speaks raw bytes."""
+    return x
+
+
+class StatusCode(enum.IntEnum):
+    OK = 0
+    CANCELLED = 1
+    UNKNOWN = 2
+    INVALID_ARGUMENT = 3
+    DEADLINE_EXCEEDED = 4
+    NOT_FOUND = 5
+    ALREADY_EXISTS = 6
+    PERMISSION_DENIED = 7
+    RESOURCE_EXHAUSTED = 8
+    FAILED_PRECONDITION = 9
+    ABORTED = 10
+    OUT_OF_RANGE = 11
+    UNIMPLEMENTED = 12
+    INTERNAL = 13
+    UNAVAILABLE = 14
+    DATA_LOSS = 15
+    UNAUTHENTICATED = 16
+
+
+class RpcError(Exception):
+    """Raised on the client when a call terminates with a non-OK status."""
+
+    def __init__(self, code: StatusCode, details: str = "",
+                 trailing_metadata: Optional[Metadata] = None):
+        super().__init__(f"{code.name}: {details}")
+        self._code = code
+        self._details = details
+        self._trailing = tuple(trailing_metadata or ())
+
+    def code(self) -> StatusCode:
+        return self._code
+
+    def details(self) -> str:
+        return self._details
+
+    def trailing_metadata(self) -> Metadata:
+        return self._trailing
+
+
+class AbortError(Exception):
+    """Raised inside a server handler by ``context.abort`` to terminate the RPC."""
+
+    def __init__(self, code: StatusCode, details: str):
+        super().__init__(f"{code.name}: {details}")
+        self.code = code
+        self.details = details
