@@ -6,12 +6,19 @@ Multi-chip hardware is unavailable in CI; shardings are validated the way the dr
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The axon sitecustomize (TPU tunnel image) force-registers jax_platforms
+# "axon,cpu" regardless of env; pin the jax config back to pure CPU so the
+# suite is hermetic and never blocks on the single shared TPU chip.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

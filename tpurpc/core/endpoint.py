@@ -411,13 +411,18 @@ class EndpointListener:
     """Accept loop feeding the factory (``tcp_server_posix.cc:267``)."""
 
     def __init__(self, host: str, port: int,
-                 on_endpoint: Callable[[Endpoint], None]):
+                 on_endpoint: Callable[[Endpoint], None],
+                 ready: "Optional[threading.Event]" = None):
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
         self._sock.listen(128)
         self.port = self._sock.getsockname()[1]
         self._on_endpoint = on_endpoint
+        # grpcio semantics: the port is bound (connects land in the listen
+        # backlog) but nothing is accepted until the server starts — otherwise
+        # an early client could race method registration into UNIMPLEMENTED.
+        self._ready = ready
         self._stopped = False
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=f"tpurpc-accept-{self.port}")
@@ -428,6 +433,9 @@ class EndpointListener:
         # fd does NOT wake a thread blocked in accept(2), and the blocked accept's
         # reference keeps the listening socket (and the port) alive.
         self._sock.settimeout(0.2)
+        if self._ready is not None:
+            while not self._stopped and not self._ready.wait(timeout=0.2):
+                pass
         while not self._stopped:
             try:
                 sock, addr = self._sock.accept()

@@ -27,6 +27,7 @@ via completion-channel fds (``rdma_conn.cc:24-26``); our notify socket plays bot
 
 from __future__ import annotations
 
+import contextlib
 import enum
 import json
 import os
@@ -146,30 +147,52 @@ class ShmDomain(MemoryDomain):
 
     kind = "shm"
 
+    # The allocator owns unlink explicitly (Region.close); Python's
+    # resource_tracker would otherwise unlink from every process that ever
+    # mapped the segment. Unregistering after the fact still races (processes
+    # sharing one inherited tracker each send UNREGISTER → KeyError spam in the
+    # tracker daemon), so suppress the registration itself. Python 3.13 has
+    # SharedMemory(track=False); this is the 3.12 equivalent.
+    _track_mu = threading.Lock()
+
     @staticmethod
-    def _untrack(shm) -> None:
-        # The allocator owns unlink explicitly (Region.close); Python's
-        # resource_tracker would otherwise double-unlink from every process that
-        # ever mapped the segment and warn about "leaks" after fork.
+    @contextlib.contextmanager
+    def _untracked():
         from multiprocessing import resource_tracker
 
-        try:
-            resource_tracker.unregister(shm._name, "shared_memory")
-        except Exception:
-            pass
+        with ShmDomain._track_mu:
+            orig_reg = resource_tracker.register
+            orig_unreg = resource_tracker.unregister
+
+            def _skip_reg(name, rtype):
+                if rtype != "shared_memory":
+                    orig_reg(name, rtype)
+
+            def _skip_unreg(name, rtype):
+                if rtype != "shared_memory":
+                    orig_unreg(name, rtype)
+
+            resource_tracker.register = _skip_reg
+            resource_tracker.unregister = _skip_unreg
+            try:
+                yield
+            finally:
+                resource_tracker.register = orig_reg
+                resource_tracker.unregister = orig_unreg
 
     def alloc(self, nbytes: int) -> Region:
         from multiprocessing import shared_memory
 
-        shm = shared_memory.SharedMemory(create=True, size=nbytes)
-        self._untrack(shm)
+        with self._untracked():
+            shm = shared_memory.SharedMemory(create=True, size=nbytes)
 
         def _close():
             shm.close()
-            try:
-                shm.unlink()
-            except FileNotFoundError:
-                pass
+            with self._untracked():  # unlink() also talks to the tracker
+                try:
+                    shm.unlink()
+                except FileNotFoundError:
+                    pass
 
         return Region(f"shm:{shm.name}", shm.buf, _close)
 
@@ -177,8 +200,8 @@ class ShmDomain(MemoryDomain):
         from multiprocessing import shared_memory
 
         assert handle.startswith("shm:")
-        shm = shared_memory.SharedMemory(name=handle[4:])
-        self._untrack(shm)
+        with self._untracked():
+            shm = shared_memory.SharedMemory(name=handle[4:])
         mv = shm.buf
 
         def write(off: int, data) -> None:

@@ -1,0 +1,20 @@
+"""grpcio-jax shim: jax.Array in/out over tpurpc (BASELINE.json north star).
+
+* :mod:`tpurpc.jaxshim.codec` — tensor/pytree wire format, zero-copy decode.
+* :mod:`tpurpc.jaxshim.service` — tensor services, fan-in batching, serve_jax.
+"""
+
+from tpurpc.jaxshim.codec import (decode_tensor, decode_tree, encode_tensor,
+                                  encode_tensor_bytes, encode_tree,
+                                  encode_tree_bytes, tensor_deserializer,
+                                  tensor_serializer, to_jax,
+                                  tree_deserializer, tree_serializer)
+from tpurpc.jaxshim.service import (FanInBatcher, TensorClient,
+                                    add_tensor_method, serve_jax)
+
+__all__ = [
+    "decode_tensor", "decode_tree", "encode_tensor", "encode_tensor_bytes",
+    "encode_tree", "encode_tree_bytes", "tensor_deserializer",
+    "tensor_serializer", "to_jax", "tree_deserializer", "tree_serializer",
+    "FanInBatcher", "TensorClient", "add_tensor_method", "serve_jax",
+]

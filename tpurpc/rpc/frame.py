@@ -212,14 +212,18 @@ class FrameWriter:
             self._ep.write(encode_frame(ftype, flags, stream_id, payload))
 
     def _send_fragmented(self, flags: int, stream_id: int, payload: bytes) -> None:
+        # Lock per fragment, not per message: fragments carry stream_id +
+        # FLAG_MORE so other streams' frames (and PING/PONG, TRAILERS) may
+        # interleave — a huge tensor on a credit-stalled ring must not add
+        # head-of-line latency to every other stream on the connection.
         view = memoryview(payload)
-        with self._lock:
-            pos = 0
-            while pos < len(view):
-                chunk = view[pos:pos + MAX_FRAME_PAYLOAD]
-                pos += len(chunk)
-                last = pos >= len(view)
-                fl = (flags if last else (flags & ~FLAG_END_STREAM) | FLAG_MORE)
+        pos = 0
+        while pos < len(view):
+            chunk = view[pos:pos + MAX_FRAME_PAYLOAD]
+            pos += len(chunk)
+            last = pos >= len(view)
+            fl = (flags if last else (flags & ~FLAG_END_STREAM) | FLAG_MORE)
+            with self._lock:
                 self._ep.write(encode_frame(MESSAGE, fl, stream_id, bytes(chunk)))
 
     def send_preface(self) -> None:

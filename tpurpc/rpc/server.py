@@ -371,10 +371,11 @@ class Server:
                                         thread_name_prefix="tpurpc-handler")
         self._methods: Dict[str, RpcMethodHandler] = {}
         self._listeners: List[EndpointListener] = []
-        self._pending_ports: List[Tuple[str, int]] = []
+        self.bound_ports: List[int] = []
         self._connections: List[_ServerConnection] = []
         self._lock = threading.Lock()
         self._started = False
+        self._serving = threading.Event()
         self._stopped = threading.Event()
 
     # -- registration --------------------------------------------------------
@@ -396,14 +397,16 @@ class Server:
     # -- ports / lifecycle ---------------------------------------------------
 
     def add_insecure_port(self, address: str) -> int:
+        """Bind now, return the real port (grpcio semantics: the port for
+        ":0" must be known before start so clients can be pointed at it)."""
         host, _, port = address.rpartition(":")
-        if self._started:
-            return self._open_port(host or "0.0.0.0", int(port))
-        self._pending_ports.append((host or "0.0.0.0", int(port)))
-        return int(port)
+        bound = self._open_port(host or "0.0.0.0", int(port))
+        self.bound_ports.append(bound)
+        return bound
 
     def _open_port(self, host: str, port: int) -> int:
-        listener = EndpointListener(host, port, self.serve_endpoint)
+        listener = EndpointListener(host, port, self.serve_endpoint,
+                                    ready=self._serving)
         self._listeners.append(listener)
         return listener.port
 
@@ -411,8 +414,7 @@ class Server:
         if self._started:
             return self
         self._started = True
-        self.bound_ports = [self._open_port(h, p) for h, p in self._pending_ports]
-        self._pending_ports.clear()
+        self._serving.set()  # listeners begin accepting (bound since add_port)
         return self
 
     def serve_endpoint(self, endpoint: Endpoint) -> None:
