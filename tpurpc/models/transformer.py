@@ -1,0 +1,208 @@
+"""Flagship sharded model: decoder-only MoE transformer over the 5-axis mesh.
+
+Every parallelism family the TPU build owes (task brief; the reference itself
+has none — SURVEY.md §2.7) lands here, in one ``shard_map`` program:
+
+* **dp**  batch sharding; gradient reduction falls out of shard_map transpose
+* **pp**  layers stacked on a leading axis sharded over 'pp';
+          :func:`tpurpc.parallel.pipeline.pipeline_apply` rings microbatches
+* **sp**  sequence sharded; :func:`ring_attention_block` rotates K/V
+* **tp**  attention heads + expert FFN column-split; one psum per block
+* **ep**  experts sharded; two all_to_alls per MoE layer
+          (batch is sharded over ('dp','ep') jointly so expert dispatch moves
+          distinct tokens — ep doubles as data parallelism outside MoE layers,
+          the standard Switch/GShard layout)
+
+Weights stay in the param dtype (bfloat16 on TPU keeps the MXU at full rate);
+softmax/router/loss statistics accumulate in float32.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from tpurpc.parallel.mesh import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpurpc.parallel.moe import moe_block, MoEParams
+from tpurpc.parallel.pipeline import microbatch, pipeline_apply, unmicrobatch
+from tpurpc.parallel.ring_attention import ring_attention_block
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 256
+    d_model: int = 128
+    n_heads: int = 8
+    head_dim: int = 16
+    d_ff: int = 256
+    n_layers: int = 4
+    n_experts: int = 2
+    capacity_factor: float = 2.0
+    n_micro: int = 2          # pipeline microbatches (must divide local batch)
+    dtype: Any = jnp.float32  # bfloat16 on real TPU
+
+    def validate(self, mesh: Mesh) -> None:
+        ax = dict(zip(mesh.axis_names, mesh.devices.shape))
+        assert self.n_heads % ax.get("tp", 1) == 0, "heads % tp != 0"
+        assert self.n_experts % ax.get("ep", 1) == 0, "experts % ep != 0"
+        assert self.n_layers % ax.get("pp", 1) == 0, "layers % pp != 0"
+
+
+def init_params(key, cfg: TransformerConfig) -> Dict[str, jax.Array]:
+    L, d, H, Dh = cfg.n_layers, cfg.d_model, cfg.n_heads, cfg.head_dim
+    E, f, V = cfg.n_experts, cfg.d_ff, cfg.vocab
+    ks = jax.random.split(key, 8)
+    s = d ** -0.5
+    dt = cfg.dtype
+    return {
+        "embed": (jax.random.normal(ks[0], (V, d)) * s).astype(dt),
+        "ln_f": jnp.ones((d,), dt),
+        "ln1": jnp.ones((L, d), dt),
+        "ln2": jnp.ones((L, d), dt),
+        "wq": (jax.random.normal(ks[1], (L, d, H, Dh)) * s).astype(dt),
+        "wk": (jax.random.normal(ks[2], (L, d, H, Dh)) * s).astype(dt),
+        "wv": (jax.random.normal(ks[3], (L, d, H, Dh)) * s).astype(dt),
+        "wo": (jax.random.normal(ks[4], (L, H, Dh, d))
+               * (H * Dh) ** -0.5).astype(dt),
+        "router": (jax.random.normal(ks[5], (L, d, E)) * s).astype(dt),
+        "w_in": (jax.random.normal(ks[6], (L, E, d, f)) * s).astype(dt),
+        "w_out": (jax.random.normal(ks[7], (L, E, f, d))
+                  * f ** -0.5).astype(dt),
+    }
+
+
+def param_specs(cfg: TransformerConfig) -> Dict[str, P]:
+    return {
+        "embed": P(None, None),
+        "ln_f": P(None),
+        "ln1": P("pp", None),
+        "ln2": P("pp", None),
+        "wq": P("pp", None, "tp", None),
+        "wk": P("pp", None, "tp", None),
+        "wv": P("pp", None, "tp", None),
+        "wo": P("pp", "tp", None, None),
+        "router": P("pp", None, None),
+        "w_in": P("pp", "ep", None, None),
+        "w_out": P("pp", "ep", None, None),
+    }
+
+
+DATA_SPEC = P(("dp", "ep"), "sp")  # [B, S] tokens
+
+
+def _layer_norm(x, scale):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
+    return ((xf - mu) * lax.rsqrt(var + 1e-6) * scale.astype(jnp.float32)
+            ).astype(x.dtype)
+
+
+def _block(lp: Dict[str, jax.Array], h: jax.Array,
+           cfg: TransformerConfig) -> jax.Array:
+    """One transformer block on local shards. h: [b, s_loc, d]."""
+    # -- attention: tp over heads, sp ring over sequence --
+    x = _layer_norm(h, lp["ln1"])
+    q = jnp.einsum("bsd,dhk->bhsk", x, lp["wq"])
+    k = jnp.einsum("bsd,dhk->bhsk", x, lp["wk"])
+    v = jnp.einsum("bsd,dhk->bhsk", x, lp["wv"])
+    o = ring_attention_block(q, k, v, axis_name="sp", causal=True)
+    attn = jnp.einsum("bhsk,hkd->bsd", o, lp["wo"])
+    attn = lax.psum(attn, "tp")
+    h = h + attn
+    # -- MoE FFN: ep all_to_all --
+    x = _layer_norm(h, lp["ln2"])
+    b, s, d = x.shape
+    flat = x.reshape(b * s, d)
+    moe = MoEParams(router=lp["router"], w_in=lp["w_in"], w_out=lp["w_out"])
+    y, _aux = moe_block(moe, flat, axis_name="ep",
+                        capacity_factor=cfg.capacity_factor)
+    return h + y.reshape(b, s, d)
+
+
+_LAYER_KEYS = ("ln1", "ln2", "wq", "wk", "wv", "wo", "router", "w_in", "w_out")
+
+
+def _forward_local(params: Dict[str, jax.Array], tokens: jax.Array,
+                   cfg: TransformerConfig) -> jax.Array:
+    """shard_map body: local tokens [b_loc, s_loc] → local logits."""
+    h = jnp.take(params["embed"], tokens, axis=0)          # [b, s, d]
+
+    stage_params = {k: params[k] for k in _LAYER_KEYS}     # [L_loc, ...]
+
+    def stage_fn(sp_params, hm):
+        def one_layer(carry, lp):
+            return _block(lp, carry, cfg), None
+        out, _ = lax.scan(one_layer, hm, sp_params)
+        return out
+
+    hm = microbatch(h, cfg.n_micro)
+    hm = pipeline_apply(stage_fn, stage_params, hm, axis_name="pp")
+    h = unmicrobatch(hm)
+
+    h = _layer_norm(h, params["ln_f"])
+    logits = jnp.einsum("bsd,vd->bsv", h.astype(jnp.float32),
+                        params["embed"].astype(jnp.float32))
+    return logits
+
+
+def _loss_local(params, tokens, targets, cfg) -> jax.Array:
+    logits = _forward_local(params, tokens, cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    loss = jnp.mean(nll)
+    return lax.pmean(loss, ("dp", "ep", "sp"))
+
+
+def _in_specs(cfg: TransformerConfig):
+    return (param_specs(cfg), DATA_SPEC, DATA_SPEC)
+
+
+def build_loss_fn(cfg: TransformerConfig, mesh: Mesh):
+    cfg.validate(mesh)
+    body = functools.partial(_loss_local, cfg=cfg)
+    return shard_map(body, mesh=mesh,
+                     in_specs=_in_specs(cfg), out_specs=P(),
+                     check_rep=False)
+
+
+def build_forward(cfg: TransformerConfig, mesh: Mesh):
+    """jit-ready sharded forward: (params, tokens[B,S]) → logits."""
+    cfg.validate(mesh)
+    body = functools.partial(_forward_local, cfg=cfg)
+    fwd = shard_map(body, mesh=mesh,
+                    in_specs=(param_specs(cfg), DATA_SPEC),
+                    out_specs=P(("dp", "ep"), "sp", None),
+                    check_rep=False)
+    return jax.jit(fwd)
+
+
+def build_train_step(cfg: TransformerConfig, mesh: Mesh, lr: float = 1e-3):
+    """Full sharded training step: (params, opt_state, tokens, targets) →
+    (params, opt_state, loss). Adam moments inherit param shardings."""
+    import optax
+
+    cfg.validate(mesh)
+    opt = optax.adamw(lr)
+    loss_fn = build_loss_fn(cfg, mesh)
+
+    def step(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(step), opt
+
+
+def shard_params(params, cfg: TransformerConfig, mesh: Mesh):
+    specs = param_specs(cfg)
+    return {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+            for k, v in params.items()}

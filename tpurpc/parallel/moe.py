@@ -1,0 +1,91 @@
+"""Expert parallelism: switch-style top-1 MoE with all_to_all dispatch.
+
+One expert FFN per 'ep' shard. Tokens are routed top-1, packed into
+per-expert capacity slots host-free (cumsum position trick — no dynamic
+shapes, XLA-friendly), exchanged with two ``lax.all_to_all``s over the 'ep'
+axis (dispatch + return), and combined weighted by the router gate.
+
+The all_to_all rides ICI exactly like the reference's RDMA WRITEs ride the
+NIC: a one-sided bulk permutation of payload between peers with no
+request/response round trip (SURVEY.md §2.8 → TPU mapping §5).
+
+Everything is a per-device block function for use inside shard_map with axis
+name 'ep' bound; see tpurpc/models/transformer.py for placement.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class MoEParams(NamedTuple):
+    router: jax.Array   # [d_model, n_experts]
+    w_in: jax.Array     # [1(local experts), d_model, d_ff]
+    w_out: jax.Array    # [1, d_ff, d_model]
+
+
+def init_moe(key, d_model: int, d_ff: int, n_experts: int,
+             dtype=jnp.float32) -> MoEParams:
+    """Global-view params; shard w_in/w_out leading axis over 'ep'."""
+    kr, ki, ko = jax.random.split(key, 3)
+    s = d_model ** -0.5
+    return MoEParams(
+        router=(jax.random.normal(kr, (d_model, n_experts)) * s).astype(dtype),
+        w_in=(jax.random.normal(ki, (n_experts, d_model, d_ff)) * s).astype(dtype),
+        w_out=(jax.random.normal(ko, (n_experts, d_ff, d_model))
+               * d_ff ** -0.5).astype(dtype),
+    )
+
+
+def moe_block(params: MoEParams, x: jax.Array, axis_name: str = "ep",
+              capacity_factor: float = 2.0) -> Tuple[jax.Array, jax.Array]:
+    """Per-device body. x: [T, d] local tokens. Returns (y, aux_loss).
+
+    ``params.w_in/w_out`` arrive as the local expert slice [E_loc, d, f].
+    Router is replicated. aux_loss is the switch load-balance term
+    (mean fraction·router-prob product, scaled by n_experts²).
+    """
+    ep = lax.psum(1, axis_name)
+    T, d = x.shape
+    e_loc = params.w_in.shape[0]
+    E = ep * e_loc
+    cap = max(1, int(capacity_factor * T / E))
+
+    logits = jnp.einsum("td,de->te", x.astype(jnp.float32),
+                        params.router.astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                       # [T]
+    gate = jnp.max(probs, axis=-1)                            # [T]
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)     # [T, E]
+
+    # load-balance aux (Switch Transformer eq. 4): fraction of tokens vs
+    # mean router prob per expert, both local; psum makes it global-mean.
+    frac = lax.pmean(jnp.mean(onehot, axis=0), axis_name)
+    pmean = lax.pmean(jnp.mean(probs, axis=0), axis_name)
+    aux = jnp.sum(frac * pmean) * E
+
+    # position of each token within its expert's capacity
+    pos = jnp.cumsum(onehot, axis=0) - 1.0                    # [T, E]
+    keep = (pos < cap).astype(jnp.float32) * onehot
+    pos_clamped = jnp.clip(pos, 0, cap - 1).astype(jnp.int32)
+    slot = jax.nn.one_hot(pos_clamped, cap, dtype=jnp.float32)  # [T, E, C]
+    dispatch = slot * keep[..., None]                         # [T, E, C]
+    combine = dispatch * gate[:, None, None]
+
+    # pack: [E, C, d]; all_to_all → [E, C, d] grouped by source shard
+    packed = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+    recv = lax.all_to_all(packed.reshape(ep, e_loc, cap, d), axis_name,
+                          split_axis=0, concat_axis=0, tiled=True)
+    # recv: [ep(src), e_loc, C, d] → local experts see all shards' tokens
+    h = jnp.einsum("secd,edf->secf", recv, params.w_in.astype(jnp.float32))
+    h = jax.nn.gelu(h)
+    y = jnp.einsum("secf,efd->secd", h, params.w_out.astype(jnp.float32))
+    back = lax.all_to_all(y, axis_name, split_axis=0, concat_axis=0,
+                          tiled=True)                          # [ep, e_loc, C, d]
+    out = jnp.einsum("tec,ecd->td", combine,
+                     back.reshape(E, cap, d))
+    return out.astype(x.dtype), aux.astype(jnp.float32)

@@ -1,0 +1,86 @@
+"""Pipeline parallelism: GPipe-style microbatch ring over the 'pp' axis.
+
+Stage s holds its layer slice (params stacked on a leading axis sharded over
+'pp'); activations hop stage→stage with ``lax.ppermute`` while a ``lax.scan``
+walks M + P - 1 ticks (M microbatches through P stages, the classic bubble).
+Every stage runs its compute every tick — bubbles burn FLOPs instead of
+introducing data-dependent control flow, which is the XLA-friendly trade.
+
+Autodiff: ``ppermute``'s transpose is the reverse permutation, so
+``jax.grad`` through the whole schedule yields the textbook 1F1B-equivalent
+backward ring with no custom VJP.
+
+Per-device body for shard_map with axis name 'pp' bound by the caller.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                   stage_params: Any, x_micro: jax.Array,
+                   axis_name: str = "pp") -> jax.Array:
+    """Run microbatches through the stage ring.
+
+    stage_fn(params_local, h) -> h' — one stage's compute (same signature on
+    every stage; param *values* differ per shard).
+    stage_params: this device's stage slice (leading stage axis squeezed by
+    the caller's in_spec).
+    x_micro: [M, mb, ...] microbatched input, replicated over 'pp'.
+
+    Returns [M, mb, ...] outputs, replicated over 'pp' (masked psum from the
+    last stage).
+    """
+    P = lax.psum(1, axis_name)
+    stage = lax.axis_index(axis_name)
+    M = x_micro.shape[0]
+    perm = [(i, (i + 1) % P) for i in range(P)]
+
+    probe = jax.eval_shape(lambda p, h: stage_fn(p, h), stage_params,
+                           jax.ShapeDtypeStruct(x_micro.shape[1:],
+                                                x_micro.dtype))
+    if probe.shape != x_micro.shape[1:] or probe.dtype != x_micro.dtype:
+        raise ValueError("pipeline stages must preserve activation shape/dtype "
+                         f"({x_micro.shape[1:]}/{x_micro.dtype} -> "
+                         f"{probe.shape}/{probe.dtype})")
+
+    def tick(carry, t):
+        recv, out_acc = carry
+        xm = lax.dynamic_index_in_dim(x_micro, jnp.clip(t, 0, M - 1), 0,
+                                      keepdims=False)
+        h = jnp.where(stage == 0, xm, recv)
+        y = stage_fn(stage_params, h)
+        recv_next = lax.ppermute(y, axis_name, perm)
+        # last stage commits microbatch (t - (P-1)) when it's in range
+        m_idx = t - (P - 1)
+        commit = jnp.logical_and(stage == P - 1,
+                                 jnp.logical_and(m_idx >= 0, m_idx < M))
+        safe = jnp.clip(m_idx, 0, M - 1)
+        cur = lax.dynamic_index_in_dim(out_acc, safe, 0, keepdims=False)
+        upd = jnp.where(commit, y, cur)
+        out_acc = lax.dynamic_update_index_in_dim(out_acc, upd, safe, 0)
+        return (recv_next, out_acc), None
+
+    recv0 = jnp.zeros_like(x_micro[0])
+    out0 = jnp.zeros_like(x_micro)
+    (_, out), _ = lax.scan(tick, (recv0, out0), jnp.arange(M + P - 1))
+    # replicate the last stage's buffer to every stage
+    return lax.psum(jnp.where(stage == P - 1, out, jnp.zeros_like(out)),
+                    axis_name)
+
+
+def microbatch(x: jax.Array, n_micro: int) -> jax.Array:
+    """[B, ...] -> [M, B/M, ...]; B must divide evenly (static shapes)."""
+    B = x.shape[0]
+    if B % n_micro:
+        raise ValueError(f"batch {B} not divisible by {n_micro} microbatches")
+    return x.reshape(n_micro, B // n_micro, *x.shape[1:])
+
+
+def unmicrobatch(x: jax.Array) -> jax.Array:
+    return x.reshape(x.shape[0] * x.shape[1], *x.shape[2:])

@@ -1,0 +1,100 @@
+"""Ring attention: exact long-context attention with sequence sharded over 'sp'.
+
+Each device holds one sequence block of Q, K, V. K/V blocks rotate around the
+'sp' ring via ``lax.ppermute`` while a flash-style numerically stable
+accumulator (running row-max, rescaled numerator/denominator) folds in one
+block per step — after ``sp`` steps every Q block has attended to the full
+sequence without any device ever materializing the (S, S) score matrix.
+
+Communication pattern = the reference's credit ring inverted: instead of one
+fixed buffer receiving remote writes (``ibverbs/ring_buffer.cc``), the payload
+itself circulates over ICI. Compute/comm overlap is XLA's job (the ppermute
+and the matmul of the *previous* block are independent in the dataflow graph).
+
+Used inside ``shard_map`` bodies — operates on per-device blocks with axis
+name 'sp' bound by the caller (see tpurpc/models/transformer.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _ring_perm(axis_size: int):
+    # shift +1: device i sends to i+1, so at step s device i holds the block
+    # originally owned by (i - s) mod axis_size.
+    return [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+
+def ring_attention_block(q: jax.Array, k: jax.Array, v: jax.Array,
+                         axis_name: str = "sp", causal: bool = False,
+                         scale: Optional[float] = None) -> jax.Array:
+    """Per-device body: q,k,v are local blocks [B, H, S_blk, D].
+
+    Returns the local output block [B, H, S_blk, D] in q.dtype; softmax
+    statistics accumulate in float32 regardless of input dtype (bfloat16
+    inputs keep the MXU fed, fp32 running stats keep softmax exact).
+    """
+    sp = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    B, H, S, D = q.shape
+    if scale is None:
+        scale = D ** -0.5
+    qf = q.astype(jnp.float32) * scale
+
+    perm = _ring_perm(sp)
+
+    def step(carry, s):
+        k_cur, v_cur, m, num, den = carry
+        # source block index: who originally owned the K/V we now hold
+        src = (idx - s) % sp
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qf, k_cur.astype(jnp.float32))
+        if causal:
+            q_pos = idx * S + jnp.arange(S)[:, None]        # [S,1] global q
+            k_pos = src * S + jnp.arange(S)[None, :]        # [1,S] global k
+            scores = jnp.where(k_pos > q_pos, -jnp.inf, scores)
+        blk_max = jnp.max(scores, axis=-1)                  # [B,H,S]
+        m_new = jnp.maximum(m, blk_max)
+        # rescale old accumulators; exp(-inf - -inf) guarded by where
+        alpha = jnp.exp(jnp.where(jnp.isneginf(m), -jnp.inf, m - m_new))
+        p = jnp.exp(scores - m_new[..., None])              # [B,H,S,Sk]
+        p = jnp.where(jnp.isneginf(scores), 0.0, p)
+        num = num * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32))
+        den = den * alpha + jnp.sum(p, axis=-1)
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, m_new, num, den), None
+
+    m0 = jnp.full((B, H, S), -jnp.inf, jnp.float32)
+    num0 = jnp.zeros((B, H, S, D), jnp.float32)
+    den0 = jnp.zeros((B, H, S), jnp.float32)
+    (k, v, m, num, den), _ = lax.scan(
+        step, (k, v, m0, num0, den0), jnp.arange(sp))
+    # fully-masked rows (can't happen for causal with s>=1, but keep det.)
+    out = num / jnp.maximum(den, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh,
+                   causal: bool = False, axis_name: str = "sp") -> jax.Array:
+    """Whole-array convenience wrapper: shard [B,H,S,D] over 'sp' and run.
+
+    For use outside an existing shard_map (tests, serving). Model code should
+    call :func:`ring_attention_block` inside its own shard_map instead.
+    """
+    from jax.sharding import PartitionSpec as P
+    from tpurpc.parallel.mesh import shard_map
+
+    spec = P(None, None, axis_name, None)
+    fn = shard_map(
+        functools.partial(ring_attention_block, axis_name=axis_name,
+                          causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_rep=False)
+    return fn(q, k, v)
