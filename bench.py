@@ -1,0 +1,108 @@
+"""tpurpc headline benchmark: 4MB tensor streaming into jax.Array.
+
+Mirrors the reference's large-payload bandwidth test (RDMA_BP, 128KB–4MB
+payloads → 82.6 Gb/s on IB EDR, SURVEY.md §6) recast as the TPU north star:
+client streams float32[1024,1024] (4 MiB) tensors over the ring transport;
+the server decodes each into a ``jax.Array`` on the default backend (TPU HBM
+on real hardware) and acknowledges with total bytes.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline is against the reference's 82.6 Gb/s (= 10.325 GB/s) aggregate
+TX bandwidth — measured on InfiniBand EDR hardware; we run whatever link the
+bench host gives us (loopback shm rings here).
+
+Env knobs: TPURPC_BENCH_MSGS (default 64 × 4MiB), TPURPC_BENCH_PLATFORM
+(default RDMA_BPEV = hybrid-wakeup ring), TPURPC_BENCH_CPU=1 to pin jax to
+CPU (CI without a chip).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+BASELINE_GBPS = 82.6 / 8  # reference aggregate bandwidth, GB/s
+
+_SERVER_CODE = r"""
+import os, sys
+import numpy as np
+if os.environ.get("TPURPC_BENCH_CPU") == "1":
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+import jax
+from tpurpc.jaxshim import add_tensor_method, to_jax
+from tpurpc.rpc.server import Server
+
+def consume(req_iter):
+    total = 0
+    checksum = 0.0
+    for tree in req_iter:
+        arr = to_jax(tree["x"])          # host view -> device (HBM on TPU)
+        arr.block_until_ready()
+        total += arr.nbytes
+        checksum += float(arr[0, 0])
+    yield {"bytes": np.int64(total), "check": np.float64(checksum)}
+
+srv = Server(max_workers=8)
+add_tensor_method(srv, "Sink", consume, kind="stream_stream")
+port = srv.add_insecure_port("127.0.0.1:0")
+srv.start()
+print(port, flush=True)
+srv.wait_for_termination(timeout=600)
+"""
+
+
+def main() -> None:
+    os.environ.setdefault("GRPC_PLATFORM_TYPE",
+                          os.environ.get("TPURPC_BENCH_PLATFORM", "RDMA_BPEV"))
+    os.environ.setdefault("GRPC_RDMA_RING_BUFFER_SIZE_KB", "16384")
+
+    n_msgs = int(os.environ.get("TPURPC_BENCH_MSGS", "64"))
+
+    import numpy as np
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (os.path.dirname(os.path.abspath(__file__)) +
+                         os.pathsep + env.get("PYTHONPATH", ""))
+    srv = subprocess.Popen([sys.executable, "-c", _SERVER_CODE],
+                           stdout=subprocess.PIPE,
+                           stderr=subprocess.DEVNULL, env=env, text=True)
+    try:
+        port = int(srv.stdout.readline().strip())
+
+        from tpurpc.jaxshim import TensorClient
+        from tpurpc.rpc.channel import Channel
+
+        payload = np.ones((1024, 1024), np.float32)  # 4 MiB
+        with Channel(f"127.0.0.1:{port}") as ch:
+            cli = TensorClient(ch)
+
+            def gen(k):
+                for _ in range(k):
+                    yield {"x": payload}
+
+            # warmup: backend init + jit + ring bring-up out of the timing
+            list(cli.duplex("Sink", gen(2), timeout=300))
+
+            t0 = time.perf_counter()
+            replies = list(cli.duplex("Sink", gen(n_msgs), timeout=600))
+            dt = time.perf_counter() - t0
+
+        total = int(np.asarray(replies[-1]["bytes"]).ravel()[0])
+        assert total == n_msgs * payload.nbytes, (total, n_msgs)
+        gbps = total / dt / 1e9
+        print(json.dumps({
+            "metric": "stream_4MiB_tensors_to_jax_Array",
+            "value": round(gbps, 3),
+            "unit": "GB/s",
+            "vs_baseline": round(gbps / BASELINE_GBPS, 3),
+        }))
+    finally:
+        srv.kill()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+"""Model-family smoke tests (thin variants keep CPU CI fast) + graft entry."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpurpc.models.resnet import (init_resnet, make_infer_fn, resnet18_thin,
+                                  resnet50)
+
+
+def test_thin_resnet_forward():
+    model = resnet18_thin(num_classes=10)
+    variables = init_resnet(jax.random.PRNGKey(0), model, image_size=32,
+                            batch=2)
+    logits = jax.jit(make_infer_fn(model))(
+        variables, jnp.ones((2, 32, 32, 3), jnp.float32))
+    assert logits.shape == (2, 10)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_resnet50_has_50_conv_layers():
+    model = resnet50()
+    variables = init_resnet(jax.random.PRNGKey(0), model, image_size=64,
+                            batch=1)
+    flat = jax.tree_util.tree_leaves_with_path(variables["params"])
+    kernels = [p for p, v in flat
+               if "Conv" in jax.tree_util.keystr(p) or "conv" in
+               jax.tree_util.keystr(p)]
+    conv_kernels = [p for p, v in flat if v.ndim == 4]
+    # 1 stem + 3 per bottleneck * (3+4+6+3) + 4 projections = 53 convs
+    assert len(conv_kernels) == 53
+    dense = [v for p, v in flat if v.ndim == 2]
+    assert dense[0].shape[-1] == 1000
+
+
+def test_graft_entry_shapes():
+    import __graft_entry__ as ge
+
+    fn, (variables, images) = ge.entry()
+    out = jax.eval_shape(fn, variables, images)
+    assert out.shape == (images.shape[0], 1000)
+
+
+def test_graft_dryrun_two_devices():
+    import __graft_entry__ as ge
+
+    ge.dryrun_multichip(2)
