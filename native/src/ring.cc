@@ -1,0 +1,188 @@
+// tpurpc native data plane: framed-ring hot ops behind a C ABI (ctypes-loaded).
+//
+// Same wire format as tpurpc/core/ring.py (which re-derives the math of the
+// reference's src/core/lib/ibverbs/ring_buffer.{h,cc}):
+//
+//   [8B header = payload len][payload, zero-padded to 8B][8B footer = ~0]
+//
+// capacity is a power of two >= 64; offsets are monotonically increasing
+// 64-bit counters masked on access; no 8B word ever straddles the wrap.
+//
+// Memory model: one producer process writes, one consumer process reads over
+// shared memory. Stores are ordered payload -> footer -> header with a
+// release fence before the header store; the reader issues an acquire fence
+// after observing header!=0 && footer==~0. (The reference gets placement
+// order from a single RDMA WRITE; shm needs the fences spelled out.)
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr uint64_t kAlign = 8;
+constexpr uint64_t kHeader = 8;
+constexpr uint64_t kFooter = 8;
+constexpr uint64_t kFooterMagic = ~0ULL;
+constexpr uint64_t kReserved = kHeader + kFooter + kAlign;
+
+inline uint64_t align_up(uint64_t n) { return (n + kAlign - 1) & ~(kAlign - 1); }
+inline uint64_t msg_span(uint64_t len) { return kHeader + align_up(len) + kFooter; }
+
+inline uint64_t load_word(const uint8_t* ring, uint64_t mask, uint64_t off) {
+  uint64_t w;
+  std::memcpy(&w, ring + (off & mask), sizeof(w));
+  return w;
+}
+
+inline void store_word(uint8_t* ring, uint64_t mask, uint64_t off, uint64_t w) {
+  std::memcpy(ring + (off & mask), &w, sizeof(w));
+}
+
+// Copy a logical span out of the ring (<=2 physical segments at the wrap).
+void copy_out(const uint8_t* ring, uint64_t cap, uint64_t mask, uint64_t off,
+              uint8_t* dst, uint64_t n) {
+  uint64_t p = off & mask;
+  uint64_t first = cap - p;
+  if (n <= first) {
+    std::memcpy(dst, ring + p, n);
+  } else {
+    std::memcpy(dst, ring + p, first);
+    std::memcpy(dst + first, ring, n - first);
+  }
+}
+
+void copy_in(uint8_t* ring, uint64_t cap, uint64_t mask, uint64_t off,
+             const uint8_t* src, uint64_t n) {
+  uint64_t p = off & mask;
+  uint64_t first = cap - p;
+  if (n <= first) {
+    std::memcpy(ring + p, src, n);
+  } else {
+    std::memcpy(ring + p, src, first);
+    std::memcpy(ring, src + first, n - first);
+  }
+}
+
+void zero_span(uint8_t* ring, uint64_t cap, uint64_t mask, uint64_t off,
+               uint64_t n) {
+  uint64_t p = off & mask;
+  uint64_t first = cap - p;
+  if (n <= first) {
+    std::memset(ring + p, 0, n);
+  } else {
+    std::memset(ring + p, 0, first);
+    std::memset(ring, 0, n - first);
+  }
+}
+
+// Complete-message scan at `off`: payload length, 0 if none/incomplete,
+// ~0 on corruption (header exceeds max payload).
+uint64_t message_at(const uint8_t* ring, uint64_t cap, uint64_t mask,
+                    uint64_t off) {
+  uint64_t hdr = load_word(ring, mask, off);
+  if (hdr == 0) return 0;
+  if (hdr > cap - kReserved) return ~0ULL;
+  uint64_t footer = load_word(ring, mask, off + kHeader + align_up(hdr));
+  if (footer != kFooterMagic) return 0;
+  std::atomic_thread_fence(std::memory_order_acquire);
+  return hdr;
+}
+
+}  // namespace
+
+extern "C" {
+
+int tpr_abi_version() { return 1; }
+
+// Total drainable payload bytes (all complete messages + pending remainder).
+uint64_t tpr_ring_readable(const uint8_t* ring, uint64_t cap, uint64_t head,
+                           uint64_t msg_len, uint64_t msg_read) {
+  uint64_t mask = cap - 1;
+  uint64_t total = 0;
+  uint64_t off = head;
+  if (msg_len) {
+    total += msg_len - msg_read;
+    off += msg_span(msg_len);
+  }
+  uint64_t scanned = 0;
+  while (scanned < cap) {
+    uint64_t ln = message_at(ring, cap, mask, off);
+    if (ln == 0 || ln == ~0ULL) break;
+    total += ln;
+    uint64_t sp = msg_span(ln);
+    off += sp;
+    scanned += sp;
+  }
+  return total;
+}
+
+// Drain up to dst_len payload bytes. Returns bytes read, or ~0 on corruption.
+// head/msg_len/msg_read/consumed are caller state, updated in place.
+uint64_t tpr_ring_read_into(uint8_t* ring, uint64_t cap, uint64_t* head,
+                            uint64_t* msg_len, uint64_t* msg_read,
+                            uint8_t* dst, uint64_t dst_len,
+                            uint64_t* consumed) {
+  uint64_t mask = cap - 1;
+  uint64_t total = 0;
+  while (total < dst_len) {
+    if (*msg_len == 0) {
+      uint64_t ln = message_at(ring, cap, mask, *head);
+      if (ln == ~0ULL) return ~0ULL;
+      if (ln == 0) break;
+      *msg_len = ln;
+      *msg_read = 0;
+    }
+    uint64_t want = dst_len - total;
+    uint64_t left = *msg_len - *msg_read;
+    uint64_t n = want < left ? want : left;
+    copy_out(ring, cap, mask, *head + kHeader + *msg_read, dst + total, n);
+    *msg_read += n;
+    total += n;
+    if (*msg_read == *msg_len) {
+      uint64_t sp = msg_span(*msg_len);
+      zero_span(ring, cap, mask, *head, sp);
+      *head += sp;
+      *consumed += sp;
+      *msg_len = 0;
+      *msg_read = 0;
+    }
+  }
+  return total;
+}
+
+// Gather-encode one message at *tail (payload -> footer -> fence -> header).
+// Returns payload bytes written, or ~0 if it doesn't fit the writable span.
+uint64_t tpr_ring_writev(uint8_t* ring, uint64_t cap, uint64_t* tail,
+                         uint64_t remote_head,
+                         const uint8_t* const* segs, const uint64_t* lens,
+                         uint32_t nsegs) {
+  uint64_t mask = cap - 1;
+  uint64_t payload = 0;
+  for (uint32_t i = 0; i < nsegs; ++i) payload += lens[i];
+  if (payload == 0) return 0;
+  uint64_t used = *tail - remote_head;
+  uint64_t writable = used + kReserved >= cap ? 0 : cap - used - kReserved;
+  if (payload > writable) return ~0ULL;
+  uint64_t off = *tail + kHeader;
+  for (uint32_t i = 0; i < nsegs; ++i) {
+    copy_in(ring, cap, mask, off, segs[i], lens[i]);
+    off += lens[i];
+  }
+  store_word(ring, mask, *tail + kHeader + align_up(payload), kFooterMagic);
+  std::atomic_thread_fence(std::memory_order_release);
+  store_word(ring, mask, *tail, payload);
+  *tail += msg_span(payload);
+  return payload;
+}
+
+// Has a complete message? (poller fast check; 1 = yes, 0 = no, -1 corruption)
+int tpr_ring_has_message(const uint8_t* ring, uint64_t cap, uint64_t head,
+                         uint64_t msg_len) {
+  if (msg_len) return 1;
+  uint64_t ln = message_at(ring, cap, cap - 1, head);
+  if (ln == ~0ULL) return -1;
+  return ln != 0 ? 1 : 0;
+}
+
+}  // extern "C"

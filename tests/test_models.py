@@ -23,9 +23,6 @@ def test_resnet50_has_50_conv_layers():
     variables = init_resnet(jax.random.PRNGKey(0), model, image_size=64,
                             batch=1)
     flat = jax.tree_util.tree_leaves_with_path(variables["params"])
-    kernels = [p for p, v in flat
-               if "Conv" in jax.tree_util.keystr(p) or "conv" in
-               jax.tree_util.keystr(p)]
     conv_kernels = [p for p, v in flat if v.ndim == 4]
     # 1 stem + 3 per bottleneck * (3+4+6+3) + 4 projections = 53 convs
     assert len(conv_kernels) == 53

@@ -1,0 +1,85 @@
+"""ctypes loader for the native data-plane core (native/build/libtpurpc.so).
+
+The reference's entire data plane is C++ (``src/core/lib/ibverbs/``); ours
+keeps the state machines in Python and pushes the per-byte work — framed-ring
+scan/copy/zero with proper acquire/release fences — into C++. Pure-Python
+fallbacks stay in tpurpc/core/ring.py; ``TPURPC_NATIVE=0`` forces them (both
+paths are covered by the same test suite).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+import numpy as np
+
+_LIB: "Optional[ctypes.CDLL]" = None
+_TRIED = False
+
+ABI_VERSION = 1
+
+
+def _lib_path() -> str:
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(root, "native", "build", "libtpurpc.so")
+
+
+def load() -> "Optional[ctypes.CDLL]":
+    """The native library, or None (absent, disabled, or ABI-mismatched)."""
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    if os.environ.get("TPURPC_NATIVE", "1") == "0":
+        return None
+    path = _lib_path()
+    if not os.path.exists(path):
+        return None
+    try:
+        # PyDLL: calls run WITH the GIL held. The ring ops take raw pointers
+        # into shm segments whose lifetime is managed by Python memoryview
+        # release + munmap on other threads; holding the GIL makes each
+        # [liveness-check → native call] pair atomic against teardown, the
+        # exact safety the pure-Python slicing path gets implicitly.
+        lib = ctypes.PyDLL(path)
+    except OSError:
+        return None
+    if lib.tpr_abi_version() != ABI_VERSION:
+        return None
+    u64 = ctypes.c_uint64
+    pu64 = ctypes.POINTER(u64)
+    pu8 = ctypes.c_void_p
+    lib.tpr_ring_readable.restype = u64
+    lib.tpr_ring_readable.argtypes = [pu8, u64, u64, u64, u64]
+    lib.tpr_ring_read_into.restype = u64
+    lib.tpr_ring_read_into.argtypes = [pu8, u64, pu64, pu64, pu64, pu8, u64,
+                                       pu64]
+    lib.tpr_ring_writev.restype = u64
+    lib.tpr_ring_writev.argtypes = [pu8, u64, pu64, u64,
+                                    ctypes.POINTER(ctypes.c_void_p),
+                                    pu64, ctypes.c_uint32]
+    lib.tpr_ring_has_message.restype = ctypes.c_int
+    lib.tpr_ring_has_message.argtypes = [pu8, u64, u64, u64]
+    _LIB = lib
+    return _LIB
+
+
+def addr_of(buf, writable: bool) -> int:
+    """Raw address of a buffer-protocol object without copying.
+
+    numpy handles both read-only and writable exporters; the array is a view,
+    so the caller must keep ``buf`` alive for the duration of the native call.
+    """
+    arr = np.frombuffer(buf, dtype=np.uint8)
+    if writable and not arr.flags.writeable:
+        raise ValueError("writable buffer required")
+    return arr.ctypes.data
+
+
+def reset_for_tests() -> None:
+    global _LIB, _TRIED
+    _LIB = None
+    _TRIED = False

@@ -62,6 +62,18 @@ class Endpoint:
              timeout: Optional[float] = None) -> bytes:
         raise NotImplementedError
 
+    def read_into(self, dst, timeout: Optional[float] = None) -> int:
+        """Read ≥1 byte directly into ``dst``; 0 exactly once at clean EOF.
+
+        Default shim bounces through :meth:`read`; transports with placement
+        control (TCP ``recv_into``, ring drain) override to skip the copy.
+        """
+        dst = memoryview(dst).cast("B")
+        data = self.read(len(dst), timeout=timeout)
+        n = len(data)
+        dst[:n] = data
+        return n
+
     def write(self, data) -> None:
         raise NotImplementedError
 
@@ -103,30 +115,62 @@ class TcpEndpoint(Endpoint):
         self._peer = _fmt_addr(sock, peer=True)
         self._local = _fmt_addr(sock, peer=False)
         self._closed = False
+        self._cur_timeout: Optional[float] = None  # None == blocking
+        self._timeout_lock = threading.Lock()
+
+    def _set_timeout(self, timeout: Optional[float]) -> None:
+        # settimeout is a real syscall (fcntl); hot read loops pass the same
+        # value every time, so only touch the socket when it changes. The lock
+        # keeps cache and socket in step when reader and writer threads race
+        # (last setter wins, same as the raw socket).
+        with self._timeout_lock:
+            if timeout != self._cur_timeout:
+                self._sock.settimeout(timeout)
+                self._cur_timeout = timeout
 
     def read(self, max_bytes: int = 1 << 20,
              timeout: Optional[float] = None) -> bytes:
         if self._closed:
             raise EndpointError("read on closed endpoint")
-        self._sock.settimeout(timeout)
         try:
+            self._set_timeout(timeout)
             return self._sock.recv(max_bytes)
         except socket.timeout as exc:
             raise ReadTimeout() from exc
         except OSError as exc:
             raise EndpointError(f"tcp read failed: {exc}") from exc
-        finally:
-            try:
-                self._sock.settimeout(None)
-            except OSError:
-                pass  # concurrent close(): the recv error above is the real story
+
+    def read_into(self, dst, timeout: Optional[float] = None) -> int:
+        if self._closed:
+            raise EndpointError("read on closed endpoint")
+        try:
+            self._set_timeout(timeout)
+            return self._sock.recv_into(dst)
+        except socket.timeout as exc:
+            raise ReadTimeout() from exc
+        except OSError as exc:
+            raise EndpointError(f"tcp read failed: {exc}") from exc
 
     def write(self, data) -> None:
         if self._closed:
             raise EndpointError("write on closed endpoint")
         try:
+            self._set_timeout(None)  # writes always block; undo read timeouts
             if isinstance(data, (list, tuple)):
-                self._sock.sendmsg(data)  # gather write, no concat copy
+                # sendmsg is a gather write but may place PARTIALLY under
+                # pressure, and the kernel caps one call at IOV_MAX=1024
+                # iovecs (a large pytree serializes to 2-3 segments per leaf);
+                # loop chunked until every byte is on the wire.
+                views = [memoryview(s).cast("B") for s in data if len(s)]
+                while views:
+                    sent = self._sock.sendmsg(views[:1024])
+                    while sent:
+                        if sent >= len(views[0]):
+                            sent -= len(views[0])
+                            views.pop(0)
+                        else:
+                            views[0] = views[0][sent:]
+                            sent = 0
             else:
                 self._sock.sendall(data)
         except OSError as exc:
@@ -203,19 +247,31 @@ class RingEndpoint(Endpoint):
 
     def read(self, max_bytes: int = 1 << 20,
              timeout: Optional[float] = None) -> bytes:
+        buf = bytearray(min(max_bytes, self.pair.ring_size))
+        n = self.read_into(buf, timeout=timeout)
+        del buf[n:]
+        return bytes(buf)
+
+    def read_into(self, dst, timeout: Optional[float] = None) -> int:
         if self._closed:
             raise EndpointError("read on closed endpoint")
+        dst = memoryview(dst).cast("B")
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
-            data = self.pair.recv(max_bytes)
-            if data:
-                return data
+            try:
+                n = self.pair.recv_into(dst)
+            except ConnectionError as exc:
+                raise EndpointError(str(exc)) from exc
+            if n:
+                return n
             state = self.pair.get_status()
             if state is PairState.HALF_CLOSED:
                 # The peer's final write and its peer_exit flag race: re-drain once
                 # after observing HALF_CLOSED so in-flight bytes are never dropped.
-                data = self.pair.recv(max_bytes)
-                return data if data else b""
+                try:
+                    return self.pair.recv_into(dst)
+                except ConnectionError:
+                    return 0
             if state in (PairState.ERROR, PairState.DISCONNECTED):
                 raise EndpointError(
                     f"ring endpoint unavailable: {state.value}"

@@ -38,7 +38,7 @@ import time
 import uuid
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
-from tpurpc.core.ring import RingReader, RingWriter, RingFull
+from tpurpc.core.ring import RingCorruption, RingFull, RingReader, RingWriter
 from tpurpc.utils.config import get_config
 from tpurpc.utils.trace import trace_ring
 
@@ -84,11 +84,13 @@ class Window:
     """A write handle onto the *peer's* region (ref: ``MemoryRegion`` envelope shipping
     an ``ibv_mr`` descriptor, ``memory_region.h:14-47``)."""
 
-    __slots__ = ("write", "_close")
+    __slots__ = ("write", "view", "_close")
 
     def __init__(self, write: Callable[[int, bytes], None],
-                 close: Callable[[], None] = lambda: None):
+                 close: Callable[[], None] = lambda: None,
+                 view: "Optional[memoryview]" = None):
         self.write = write  # write(offset, data) — one-sided, no peer CPU involved
+        self.view = view    # mapped memory when host-addressable (native path)
         self._close = close
 
     def close(self) -> None:
@@ -137,7 +139,7 @@ class LocalDomain(MemoryDomain):
         def write(off: int, data) -> None:
             mv[off:off + len(data)] = data
 
-        return Window(write, mv.release)
+        return Window(write, mv.release, view=mv)
 
 
 class ShmDomain(MemoryDomain):
@@ -211,7 +213,7 @@ class ShmDomain(MemoryDomain):
             mv.release()
             shm.close()
 
-        return Window(write, _close)
+        return Window(write, _close, view=mv)
 
 
 _DOMAINS: Dict[str, Callable[[], MemoryDomain]] = {
@@ -432,7 +434,8 @@ class Pair:
         # rings — the writer just honors the peer's capacity.
         self._peer_ring = self.domain.open_window(peer.ring_handle, peer.ring_size)
         self._peer_status = self.domain.open_window(peer.status_handle, STATUS_BYTES)
-        self.writer = RingWriter(peer.ring_size, self._peer_ring.write)
+        self.writer = RingWriter(peer.ring_size, self._peer_ring.write,
+                                 mapped=self._peer_ring.view)
         self.state = PairState.CONNECTED
         trace_ring.log("pair %s connected (peer tag %s, ring %d)",
                        self.tag, peer.tag, peer.ring_size)
@@ -616,7 +619,17 @@ class Pair:
         (``PairPollable::Recv`` → ``RingBufferPollable::Read``,
         ``ring_buffer.cc:122-191``)."""
         with self._recv_guard:
-            n = self.reader.read_into(dst)
+            reader = self.reader
+            if reader is None:  # quiesced/destroyed under a racing reader thread
+                raise ConnectionError("pair is closed")
+            try:
+                n = reader.read_into(dst)
+            except (RingCorruption, ValueError) as exc:
+                # ring memory released by a concurrent teardown — surface as a
+                # connection error, not data corruption
+                if "released" in str(exc):
+                    raise ConnectionError("pair is closed") from None
+                raise
             self.total_recv += n
             self._publish_credits_if_due()
             return n

@@ -40,8 +40,11 @@ the consumed-region-is-zero invariant already guarantees they are zero.
 
 from __future__ import annotations
 
+import ctypes
 import struct
 from typing import Callable, Iterable, List, Optional, Sequence, Tuple
+
+from tpurpc.core import _native
 
 ALIGN = 8
 HEADER_BYTES = 8
@@ -113,6 +116,15 @@ class RingReader:
         self._msg_read = 0       # payload bytes already handed to the app
         # Credit state (pair.cc:276-284: publish after consuming >= half ring).
         self.consumed_since_publish = 0
+        # Native fast path: scan/copy/zero in C++ when the lib is built and the
+        # ring memory is addressable (shm/local buffers always are).
+        self._nat = _native.load()
+        self._nat_addr = None
+        if self._nat is not None:
+            try:
+                self._nat_addr = _native.addr_of(self.buf, writable=True)
+            except (ValueError, TypeError):
+                self._nat = None
 
     # -- completion scanning ------------------------------------------------
 
@@ -138,9 +150,28 @@ class RingReader:
             return 0  # body still in flight
         return hdr
 
+    def _alive(self) -> bool:
+        """buf still mapped? (GIL held from here through the native call, so a
+        racing release() cannot interleave — see PyDLL note in _native.py)"""
+        try:
+            _ = self.buf.nbytes
+            return True
+        except ValueError:
+            return False
+
     def has_message(self) -> bool:
         if self._msg_len:
             return True
+        if self._nat is not None:
+            if not self._alive():
+                raise RingCorruption("ring memory released")
+            r = self._nat.tpr_ring_has_message(
+                self._nat_addr, self.layout.capacity, self.head, self._msg_len)
+            if r < 0:
+                raise RingCorruption(
+                    f"header exceeds max payload at offset "
+                    f"{self.layout.phys(self.head)}")
+            return bool(r)
         return self._message_at(self.head) != 0
 
     def readable(self) -> int:
@@ -149,6 +180,12 @@ class RingReader:
         Like ``GetReadableSize`` the endpoint uses to size its slice allocation
         (``rdma_bp_posix.cc:306-327`` → ``ring_buffer.cc:67-97``).
         """
+        if self._nat is not None:
+            if not self._alive():
+                raise RingCorruption("ring memory released")
+            return self._nat.tpr_ring_readable(
+                self._nat_addr, self.layout.capacity, self.head,
+                self._msg_len, self._msg_read)
         total = 0
         off = self.head
         if self._msg_len:
@@ -186,6 +223,8 @@ class RingReader:
         if dst.readonly:
             raise ValueError("read_into needs a writable buffer")
         dst = dst.cast("B")
+        if self._nat is not None and len(dst) > 0:
+            return self._read_into_native(dst)
         total = 0
         while total < len(dst):
             if self._msg_len == 0:
@@ -207,6 +246,28 @@ class RingReader:
                 self._msg_len = 0
                 self._msg_read = 0
         return total
+
+    def _read_into_native(self, dst: memoryview) -> int:
+        if not self._alive():
+            raise RingCorruption("ring memory released")
+        head = ctypes.c_uint64(self.head)
+        msg_len = ctypes.c_uint64(self._msg_len)
+        msg_read = ctypes.c_uint64(self._msg_read)
+        consumed = ctypes.c_uint64(self.consumed_since_publish)
+        n = self._nat.tpr_ring_read_into(
+            self._nat_addr, self.layout.capacity,
+            ctypes.byref(head), ctypes.byref(msg_len), ctypes.byref(msg_read),
+            _native.addr_of(dst, writable=True), len(dst),
+            ctypes.byref(consumed))
+        if n == 0xFFFFFFFFFFFFFFFF:
+            raise RingCorruption(
+                f"header exceeds max payload at offset "
+                f"{self.layout.phys(head.value)}")
+        self.head = head.value
+        self._msg_len = msg_len.value
+        self._msg_read = msg_read.value
+        self.consumed_since_publish = consumed.value
+        return n
 
     def read(self, nbytes: int) -> bytes:
         # Size by capacity, not by a readable() pre-scan — readable() re-parses every
@@ -258,11 +319,22 @@ class RingWriter:
     ``pair.h:100-103`` / ``pair.cc:294-301``).
     """
 
-    def __init__(self, capacity: int, write_fn: WriteFn):
+    def __init__(self, capacity: int, write_fn: WriteFn,
+                 mapped: Optional[memoryview] = None):
         self.layout = RingLayout(capacity)
         self.write_fn = write_fn
         self.tail = 0         # absolute count of ring bytes ever written
         self.remote_head = 0  # mirrored consumer head (credits)
+        # Native gather-encode straight into the mapped peer ring (shm window);
+        # transports whose placement is a callback (TPU DMA) stay on write_fn.
+        self._nat = _native.load() if mapped is not None else None
+        self._nat_addr = None
+        if self._nat is not None:
+            try:
+                self._nat_addr = _native.addr_of(mapped, writable=True)
+                self._mapped = mapped  # keep the exporter alive
+            except (ValueError, TypeError):
+                self._nat = None
 
     # -- flow control -------------------------------------------------------
 
@@ -316,6 +388,8 @@ class RingWriter:
             return 0
         if payload_len > self.writable_payload():
             raise RingFull(payload_len, self.writable_payload())
+        if self._nat is not None:
+            return self._writev_native(views, payload_len)
         # Order matters for lock-free completion detection: payload, footer, header.
         off = self.tail + HEADER_BYTES
         for v in views:
@@ -327,6 +401,26 @@ class RingWriter:
         self._put(self.tail, _U64.pack(payload_len))
         self.tail += message_span(payload_len)
         return payload_len
+
+
+    def _writev_native(self, views: Sequence[memoryview],
+                       payload_len: int) -> int:
+        try:
+            _ = self._mapped.nbytes  # peer window still mapped? (see _alive)
+        except ValueError:
+            raise RingCorruption("peer ring window released") from None
+        n = len(views)
+        seg_ptrs = (ctypes.c_void_p * n)(
+            *[_native.addr_of(v, writable=False) for v in views])
+        seg_lens = (ctypes.c_uint64 * n)(*[len(v) for v in views])
+        tail = ctypes.c_uint64(self.tail)
+        got = self._nat.tpr_ring_writev(
+            self._nat_addr, self.layout.capacity, ctypes.byref(tail),
+            self.remote_head, seg_ptrs, seg_lens, n)
+        if got == 0xFFFFFFFFFFFFFFFF:
+            raise RingFull(payload_len, self.writable_payload())
+        self.tail = tail.value
+        return got
 
 
 class RingFull(RuntimeError):

@@ -287,10 +287,13 @@ def _treedef_from_json(j) -> Any:
         skeleton, is_leaf=lambda x: x is _SENTINEL)
 
 
-# Serializer/Deserializer adapters for the rpc layer --------------------------
+# Serializer/Deserializer adapters for the rpc layer.
+# Serializers return GATHER LISTS — the frame writer scatter-writes the
+# segments (ring slice-gather / sendmsg) so the tensor payload is never
+# joined into an intermediate host buffer.
 
-def tensor_serializer(x) -> bytes:
-    return encode_tensor_bytes(x)
+def tensor_serializer(x) -> List[bytes]:
+    return encode_tensor(x)
 
 
 def tensor_deserializer(buf) -> np.ndarray:
@@ -298,8 +301,8 @@ def tensor_deserializer(buf) -> np.ndarray:
     return arr
 
 
-def tree_serializer(tree) -> bytes:
-    return encode_tree_bytes(tree)
+def tree_serializer(tree) -> List[bytes]:
+    return encode_tree(tree)
 
 
 def tree_deserializer(buf) -> Any:

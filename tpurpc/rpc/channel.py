@@ -38,15 +38,16 @@ class _ClientStream:
         self.stream_id = stream_id
         self.events: "queue.Queue[tuple]" = queue.Queue()
         self.initial_metadata: Optional[List[Tuple[str, "str | bytes"]]] = None
-        self._fragments: List[bytes] = []
+        #: fragment assembly — the FrameReader sink appends wire bytes here
+        #: directly (single receive-side copy; no per-fragment bytes + join)
+        self.assembly = bytearray()
         self.done = False  # trailers or failure delivered
 
-    def deliver_message(self, payload: bytes, more: bool) -> None:
-        self._fragments.append(payload)
+    def commit_message(self, more: bool) -> None:
         if more:
             return
-        whole = b"".join(self._fragments)
-        self._fragments = []
+        whole = self.assembly
+        self.assembly = bytearray()
         self.events.put(("message", whole))
 
     def deliver_trailers(self, code: StatusCode, details: str, md) -> None:
@@ -58,6 +59,28 @@ class _ClientStream:
         self.events.put(("trailers", code, details, []))
 
 
+class _ChannelSink(fr.MessageSink):
+    """Routes MESSAGE payload bytes into per-stream assembly buffers."""
+
+    def __init__(self, conn: "_Connection"):
+        self._conn = conn
+        self._discard = bytearray()  # sink for late frames of dead streams
+
+    def buffer_for(self, stream_id: int) -> bytearray:
+        with self._conn._lock:
+            st = self._conn._streams.get(stream_id)
+        if st is None:
+            del self._discard[:]
+            return self._discard
+        return st.assembly
+
+    def commit(self, stream_id: int, flags: int) -> None:
+        with self._conn._lock:
+            st = self._conn._streams.get(stream_id)
+        if st is not None:
+            st.commit_message(bool(flags & fr.FLAG_MORE))
+
+
 class _Connection:
     """One live transport: endpoint + reader thread + muxed writer."""
 
@@ -65,6 +88,7 @@ class _Connection:
         self.endpoint = endpoint
         self.writer = fr.FrameWriter(endpoint)
         self.reader = fr.FrameReader(endpoint)
+        self.reader.sink = _ChannelSink(self)
         self._streams: dict[int, _ClientStream] = {}
         self._lock = threading.Lock()
         self._next_stream_id = 1  # odd ids, client-initiated (h2 convention)
@@ -97,6 +121,8 @@ class _Connection:
                 if f is None:
                     self._die("server closed connection")
                     return
+                if f is fr.CONSUMED:  # MESSAGE already routed via the sink
+                    continue
                 self._dispatch(f)
         except (EndpointError, fr.FrameError, OSError) as exc:
             self._die(str(exc))
@@ -118,8 +144,9 @@ class _Connection:
             st = self._streams.get(f.stream_id)
         if st is None:
             return  # late frame for a cancelled/finished stream
-        if f.type == fr.MESSAGE:
-            st.deliver_message(f.payload, bool(f.flags & fr.FLAG_MORE))
+        if f.type == fr.MESSAGE:  # only without a sink (never in practice)
+            st.assembly += f.payload
+            st.commit_message(bool(f.flags & fr.FLAG_MORE))
         elif f.type == fr.HEADERS:
             md, _ = fr.decode_metadata(f.payload)
             st.initial_metadata = md

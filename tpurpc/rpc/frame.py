@@ -197,63 +197,151 @@ class FrameWriter:
         self._ep = endpoint
         self._lock = threading.Lock()
 
-    def send(self, ftype: int, flags: int, stream_id: int, payload: bytes = b"") -> None:
-        if len(payload) > MAX_FRAME_PAYLOAD:
-            if ftype != MESSAGE:
-                # Control frames don't fragment; sending one oversized would make
-                # the peer tear down the whole multiplexed connection.  Fail just
-                # this caller instead.
-                raise FrameError(
-                    f"control frame payload {len(payload)} exceeds "
-                    f"{MAX_FRAME_PAYLOAD}; metadata too large")
-            self._send_fragmented(flags, stream_id, payload)
-            return
-        with self._lock:
-            self._ep.write(encode_frame(ftype, flags, stream_id, payload))
+    def send(self, ftype: int, flags: int, stream_id: int,
+             payload: "bytes | Sequence" = b"") -> None:
+        """Write one logical frame.
 
-    def _send_fragmented(self, flags: int, stream_id: int, payload: bytes) -> None:
+        MESSAGE payloads may be a gather list of buffers (the tensor codec's
+        segment output) — they are fragmented and scatter-written with zero
+        joins/copies; the endpoint's gather write (ring slice-send /
+        ``sendmsg``) does the placement.
+        """
+        segs = ([memoryview(s).cast("B") for s in payload]
+                if isinstance(payload, (list, tuple)) else
+                [memoryview(payload).cast("B")])
+        segs = [s for s in segs if len(s)]
+        total = sum(len(s) for s in segs)
+        if total <= MAX_FRAME_PAYLOAD:
+            with self._lock:
+                self._ep.write(
+                    [HEADER_FMT.pack(ftype, flags, stream_id, total)] + segs)
+            return
+        if ftype != MESSAGE:
+            # Control frames don't fragment; sending one oversized would make
+            # the peer tear down the whole multiplexed connection.  Fail just
+            # this caller instead.
+            raise FrameError(
+                f"control frame payload {total} exceeds "
+                f"{MAX_FRAME_PAYLOAD}; metadata too large")
+        self._send_fragmented(flags, stream_id, segs, total)
+
+    def _send_fragmented(self, flags: int, stream_id: int,
+                         segs: List[memoryview], total: int) -> None:
         # Lock per fragment, not per message: fragments carry stream_id +
         # FLAG_MORE so other streams' frames (and PING/PONG, TRAILERS) may
         # interleave — a huge tensor on a credit-stalled ring must not add
         # head-of-line latency to every other stream on the connection.
-        view = memoryview(payload)
-        pos = 0
-        while pos < len(view):
-            chunk = view[pos:pos + MAX_FRAME_PAYLOAD]
-            pos += len(chunk)
-            last = pos >= len(view)
+        sent = 0
+        si = 0       # current segment index
+        so = 0       # offset within current segment
+        while sent < total:
+            n = min(MAX_FRAME_PAYLOAD, total - sent)
+            frame_segs: List[memoryview] = []
+            need = n
+            while need:
+                seg = segs[si]
+                take = min(need, len(seg) - so)
+                frame_segs.append(seg[so:so + take])
+                so += take
+                need -= take
+                if so == len(seg):
+                    si += 1
+                    so = 0
+            sent += n
+            last = sent >= total
             fl = (flags if last else (flags & ~FLAG_END_STREAM) | FLAG_MORE)
             with self._lock:
-                self._ep.write(encode_frame(MESSAGE, fl, stream_id, bytes(chunk)))
+                self._ep.write(
+                    [HEADER_FMT.pack(MESSAGE, fl, stream_id, n)] + frame_segs)
 
     def send_preface(self) -> None:
         with self._lock:
             self._ep.write(MAGIC)
 
 
+#: Returned by read_frame when a MESSAGE frame was routed to the sink — the
+#: caller's loop just continues; there is no Frame object for bulk payloads.
+CONSUMED = object()
+
+
+class MessageSink:
+    """Destination for MESSAGE payload bytes, bypassing Frame materialization.
+
+    The reader appends each fragment's bytes straight into the per-stream
+    assembly buffer (one copy off the wire, no per-frame bytes() + no join —
+    the receive-side half of the copy ledger the north star optimizes)."""
+
+    def buffer_for(self, stream_id: int) -> bytearray:
+        raise NotImplementedError
+
+    def commit(self, stream_id: int, flags: int) -> None:
+        raise NotImplementedError
+
+
 class FrameReader:
-    """Buffered frame parser over the endpoint's read() stream."""
+    """Buffered frame parser over the endpoint's read()/read_into() stream."""
 
     def __init__(self, endpoint: Endpoint, expect_preface: bool = False):
         self._ep = endpoint
         self._buf = bytearray()
         self._eof = False
         self._need_preface = expect_preface
+        self._scratch = bytearray(MAX_FRAME_PAYLOAD)
+        self._scratch_mv = memoryview(self._scratch)
+        self.sink: Optional[MessageSink] = None
+        # In-flight sink-routed MESSAGE interrupted by ReadTimeout:
+        # (dst, rest, stream_id, flags) — resumed by the next read_frame.
+        self._pending_msg: Optional[tuple] = None
 
     def _fill(self, need: int, timeout: Optional[float] = None) -> bool:
-        """Grow the buffer to ≥ need bytes; False on clean EOF first."""
+        """Grow the buffer to ≥ need bytes; False on clean EOF first.
+
+        Reads EXACTLY the deficit, never ahead: over-reading would drag MESSAGE
+        payload bytes through this buffer, adding a copy to the bulk path whose
+        whole point (sink routing) is to skip it. Control structures are tiny,
+        so the extra small recv per frame is noise next to a saved 1MiB memcpy.
+        """
         while len(self._buf) < need:
             if self._eof:
                 return False
-            data = self._ep.read(1 << 20, timeout=timeout)
-            if data == b"":
+            n = self._ep.read_into(self._scratch_mv[:need - len(self._buf)],
+                                   timeout=timeout)
+            if n == 0:
                 self._eof = True
                 return len(self._buf) >= need
-            self._buf += data
+            self._buf += self._scratch_mv[:n]
         return True
 
-    def read_frame(self, timeout: Optional[float] = None) -> Optional[Frame]:
-        """Next frame, or None at clean EOF.  Raises EndpointError/FrameError."""
+    def _drain_message(self, dst: bytearray, rest: int, stream_id: int,
+                       flags: int, timeout: Optional[float]):
+        """Stream the remaining payload straight into the assembly buffer.
+
+        A ReadTimeout mid-payload parks the progress in ``_pending_msg`` so the
+        next read_frame resumes exactly where the wire stopped — the framing
+        never desyncs."""
+        try:
+            while rest:
+                n = self._ep.read_into(
+                    self._scratch_mv[:min(rest, MAX_FRAME_PAYLOAD)],
+                    timeout=timeout)
+                if n == 0:
+                    self._eof = True
+                    raise FrameError("truncated frame payload at EOF")
+                dst += self._scratch_mv[:n]
+                rest -= n
+        except TimeoutError:
+            self._pending_msg = (dst, rest, stream_id, flags)
+            raise
+        self._pending_msg = None
+        self.sink.commit(stream_id, flags)
+        return CONSUMED
+
+    def read_frame(self, timeout: Optional[float] = None):
+        """Next control Frame, CONSUMED for sink-routed MESSAGE frames, or
+        None at clean EOF.  Raises EndpointError/FrameError."""
+        if self._pending_msg is not None:
+            dst, rest, stream_id, flags = self._pending_msg
+            return self._drain_message(dst, rest, stream_id, flags, timeout)
         if self._need_preface:
             if not self._fill(len(MAGIC), timeout):
                 return None
@@ -268,8 +356,17 @@ class FrameReader:
         ftype, flags, stream_id, length = HEADER_FMT.unpack_from(self._buf)
         if length > MAX_FRAME_PAYLOAD:
             raise FrameError(f"frame length {length} exceeds max {MAX_FRAME_PAYLOAD}")
-        if not self._fill(HEADER_FMT.size + length, timeout):
+        hdr = HEADER_FMT.size
+        if ftype == MESSAGE and self.sink is not None:
+            dst = self.sink.buffer_for(stream_id)
+            have = min(length, len(self._buf) - hdr)
+            if have:
+                dst += memoryview(self._buf)[hdr:hdr + have]
+            del self._buf[:hdr + have]
+            return self._drain_message(dst, length - have, stream_id, flags,
+                                       timeout)
+        if not self._fill(hdr + length, timeout):
             raise FrameError("truncated frame payload at EOF")
-        payload = bytes(self._buf[HEADER_FMT.size:HEADER_FMT.size + length])
-        del self._buf[:HEADER_FMT.size + length]
+        payload = bytes(self._buf[hdr:hdr + length])
+        del self._buf[:hdr + length]
         return Frame(ftype, flags, stream_id, payload)

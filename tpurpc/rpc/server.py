@@ -163,18 +163,17 @@ class _ServerStream:
     def __init__(self, stream_id: int):
         self.stream_id = stream_id
         self.requests: "queue.Queue[object]" = queue.Queue()
-        self._fragments: List[bytes] = []
+        #: fragment assembly — the FrameReader sink appends wire bytes here
+        self.assembly = bytearray()
         self.half_closed = False
         self.context: Optional[ServerContext] = None
 
-    def deliver_message(self, payload: bytes, more: bool, end_stream: bool,
-                        no_message: bool = False) -> None:
-        if not no_message:
-            self._fragments.append(payload)
-            if not more:
-                whole = b"".join(self._fragments)
-                self._fragments = []
-                self.requests.put(whole)
+    def commit_message(self, more: bool, end_stream: bool,
+                       no_message: bool = False) -> None:
+        if not no_message and not more:
+            whole = self.assembly
+            self.assembly = bytearray()
+            self.requests.put(whole)
         if end_stream:
             self.half_closed = True
             self.requests.put(self._END)
@@ -195,12 +194,37 @@ class _ServerStream:
             yield deserializer(item)
 
 
+class _ServerSink(fr.MessageSink):
+    """Routes request MESSAGE bytes into per-stream assembly buffers."""
+
+    def __init__(self, conn: "_ServerConnection"):
+        self._conn = conn
+        self._discard = bytearray()
+
+    def buffer_for(self, stream_id: int) -> bytearray:
+        with self._conn._lock:
+            st = self._conn._streams.get(stream_id)
+        if st is None:
+            del self._discard[:]
+            return self._discard
+        return st.assembly
+
+    def commit(self, stream_id: int, flags: int) -> None:
+        with self._conn._lock:
+            st = self._conn._streams.get(stream_id)
+        if st is not None:
+            st.commit_message(bool(flags & fr.FLAG_MORE),
+                              bool(flags & fr.FLAG_END_STREAM),
+                              bool(flags & fr.FLAG_NO_MESSAGE))
+
+
 class _ServerConnection:
     def __init__(self, server: "Server", endpoint: Endpoint):
         self.server = server
         self.endpoint = endpoint
         self.writer = fr.FrameWriter(endpoint)
         self.reader = fr.FrameReader(endpoint, expect_preface=True)
+        self.reader.sink = _ServerSink(self)
         self._streams: Dict[int, _ServerStream] = {}
         self._lock = threading.Lock()
         self.alive = True
@@ -214,6 +238,8 @@ class _ServerConnection:
                 f = self.reader.read_frame()
                 if f is None:
                     break
+                if f is fr.CONSUMED:  # MESSAGE already routed via the sink
+                    continue
                 self._dispatch(f)
         except (EndpointError, fr.FrameError, OSError) as exc:
             trace_server.log("server connection error: %s", exc)
@@ -237,10 +263,11 @@ class _ServerConnection:
             return
         if st is None:
             return  # frame for a finished/cancelled stream
-        if f.type == fr.MESSAGE:
-            st.deliver_message(f.payload, bool(f.flags & fr.FLAG_MORE),
-                               bool(f.flags & fr.FLAG_END_STREAM),
-                               bool(f.flags & fr.FLAG_NO_MESSAGE))
+        if f.type == fr.MESSAGE:  # only without a sink (never in practice)
+            st.assembly += f.payload
+            st.commit_message(bool(f.flags & fr.FLAG_MORE),
+                              bool(f.flags & fr.FLAG_END_STREAM),
+                              bool(f.flags & fr.FLAG_NO_MESSAGE))
         elif f.type == fr.RST:
             st.cancel()
             self._finish_stream(st)
