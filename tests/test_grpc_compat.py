@@ -1,0 +1,171 @@
+"""Wire-compat: STOCK grpcio clients against a tpurpc server (drop-in proof).
+
+The reference is gRPC itself, so its clients work against it by definition;
+tpurpc earns the same property here — a grpc.insecure_channel from the
+installed grpcio (C-core: full HPACK with huffman + dynamic-table indexing,
+real flow control) drives the tpurpc server's h2 path, while tpurpc-native
+clients share the same port via protocol sniffing.
+"""
+
+import threading
+import time
+
+import grpc
+import pytest
+
+import tpurpc.rpc as tps  # package re-exports Server + handler factories
+from tpurpc.rpc.channel import Channel
+from tpurpc.rpc.status import StatusCode
+
+
+def _echo_server():
+    srv = tps.Server(max_workers=8)
+
+    def echo(req, ctx):
+        return req
+
+    def tail(req, ctx):
+        for i in range(4):
+            yield req + str(i).encode()
+
+    def collect(req_iter, ctx):
+        return b"|".join(req_iter)
+
+    def chat(req_iter, ctx):
+        for req in req_iter:
+            yield b"re:" + req
+
+    def boom(req, ctx):
+        ctx.set_trailing_metadata((("saw-md", "yes"),))
+        ctx.abort(StatusCode.FAILED_PRECONDITION, "nope: not ready")
+
+    def meta(req, ctx):
+        md = {k: v for k, v in ctx.invocation_metadata()}
+        ctx.set_trailing_metadata((("echoed-key", md.get("x-custom", "?")),
+                                   ("bin-bin", md.get("x-blob-bin", b"")),))
+        return req
+
+    srv.add_method("/test.Echo/Echo", tps.unary_unary_rpc_method_handler(echo))
+    srv.add_method("/test.Echo/Tail", tps.unary_stream_rpc_method_handler(tail))
+    srv.add_method("/test.Echo/Collect",
+                   tps.stream_unary_rpc_method_handler(collect))
+    srv.add_method("/test.Echo/Chat",
+                   tps.stream_stream_rpc_method_handler(chat))
+    srv.add_method("/test.Echo/Boom", tps.unary_unary_rpc_method_handler(boom))
+    srv.add_method("/test.Echo/Meta", tps.unary_unary_rpc_method_handler(meta))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    return srv, port
+
+
+@pytest.fixture(scope="module")
+def compat():
+    srv, port = _echo_server()
+    ch = grpc.insecure_channel(f"127.0.0.1:{port}")
+    yield srv, port, ch
+    ch.close()
+    srv.stop(grace=0)
+
+
+_ID = lambda x: x  # bytes-in/bytes-out "serializer" for raw interop
+
+
+def test_grpcio_unary(compat):
+    _, _, ch = compat
+    mc = ch.unary_unary("/test.Echo/Echo", _ID, _ID)
+    assert mc(b"hello tpu", timeout=20) == b"hello tpu"
+
+
+def test_grpcio_unary_large_flow_controlled(compat):
+    """4MiB both ways exercises DATA fragmentation + window updates."""
+    _, _, ch = compat
+    mc = ch.unary_unary("/test.Echo/Echo", _ID, _ID)
+    big = bytes(range(256)) * (4 * 4096)  # 4 MiB
+    assert mc(big, timeout=60) == big
+
+
+def test_grpcio_server_streaming(compat):
+    _, _, ch = compat
+    mc = ch.unary_stream("/test.Echo/Tail", _ID, _ID)
+    assert list(mc(b"x", timeout=20)) == [b"x0", b"x1", b"x2", b"x3"]
+
+
+def test_grpcio_client_streaming(compat):
+    _, _, ch = compat
+    mc = ch.stream_unary("/test.Echo/Collect", _ID, _ID)
+    assert mc(iter([b"a", b"b", b"c"]), timeout=20) == b"a|b|c"
+
+
+def test_grpcio_bidi_streaming(compat):
+    _, _, ch = compat
+    mc = ch.stream_stream("/test.Echo/Chat", _ID, _ID)
+    assert list(mc(iter([b"1", b"2"]), timeout=20)) == [b"re:1", b"re:2"]
+
+
+def test_grpcio_error_status_and_message(compat):
+    _, _, ch = compat
+    mc = ch.unary_unary("/test.Echo/Boom", _ID, _ID)
+    with pytest.raises(grpc.RpcError) as ei:
+        mc(b"x", timeout=20)
+    assert ei.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+    assert "nope: not ready" in ei.value.details()
+    md = dict(ei.value.trailing_metadata())
+    assert md.get("saw-md") == "yes"
+
+
+def test_grpcio_metadata_roundtrip_incl_binary(compat):
+    _, _, ch = compat
+    mc = ch.unary_unary("/test.Echo/Meta", _ID, _ID)
+    resp, call = mc.with_call(
+        b"m", timeout=20,
+        metadata=(("x-custom", "v123"), ("x-blob-bin", b"\x00\x01\xfe")))
+    assert resp == b"m"
+    md = dict(call.trailing_metadata())
+    assert md.get("echoed-key") == "v123"
+    assert md.get("bin-bin") == b"\x00\x01\xfe"
+
+
+def test_grpcio_unimplemented(compat):
+    _, _, ch = compat
+    mc = ch.unary_unary("/test.Echo/Nope", _ID, _ID)
+    with pytest.raises(grpc.RpcError) as ei:
+        mc(b"x", timeout=20)
+    assert ei.value.code() == grpc.StatusCode.UNIMPLEMENTED
+
+
+def test_grpcio_deadline(compat):
+    srv, _, ch = compat
+
+    def slow(req, ctx):
+        time.sleep(5)
+        return req
+
+    srv.add_method("/test.Echo/Slow", tps.unary_unary_rpc_method_handler(slow))
+    mc = ch.unary_unary("/test.Echo/Slow", _ID, _ID)
+    t0 = time.monotonic()
+    with pytest.raises(grpc.RpcError) as ei:
+        mc(b"x", timeout=0.5)
+    assert ei.value.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+    assert time.monotonic() - t0 < 3
+
+
+def test_grpcio_many_concurrent_calls(compat):
+    _, _, ch = compat
+    mc = ch.unary_unary("/test.Echo/Echo", _ID, _ID)
+    results = [None] * 16
+    def one(i):
+        results[i] = mc(f"m{i}".encode(), timeout=30)
+    ts = [threading.Thread(target=one, args=(i,)) for i in range(16)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert results == [f"m{i}".encode() for i in range(16)]
+
+
+def test_native_and_grpcio_share_one_port(compat):
+    """Protocol sniffing: tpurpc-native framing and h2 on the same listener."""
+    srv, port, ch = compat
+    mc = ch.unary_unary("/test.Echo/Echo", _ID, _ID)
+    with Channel(f"127.0.0.1:{port}") as native:
+        nmc = native.unary_unary("/test.Echo/Echo")
+        assert nmc(b"native", timeout=20) == b"native"
+        assert mc(b"h2", timeout=20) == b"h2"

@@ -1,0 +1,139 @@
+"""TPU data plane: copy ledger accounting, HBM ring leases, device serialization."""
+
+import numpy as np
+import pytest
+
+from tpurpc.tpu import HbmRing, ledger
+from tpurpc.tpu.serialize import deserialize_to_device, serialize_from_device
+
+
+# -- ledger ------------------------------------------------------------------
+
+def test_ledger_track_window():
+    with ledger.track() as w:
+        ledger.host_copy(100)
+        ledger.dma_h2d(40)
+    assert w["host_copy"] == 100 and w["dma_h2d"] == 40 and w["dma_d2h"] == 0
+
+
+def test_rpc_path_reports_to_ledger():
+    """An end-to-end tensor RPC over loopback rings reports its copies."""
+    import jax
+
+    from tpurpc.jaxshim import TensorClient, serve_jax
+    from tpurpc.rpc.channel import Channel
+
+    srv, port, _ = serve_jax(lambda t: t, "127.0.0.1:0")
+    try:
+        x = np.ones((256, 256), np.float32)  # 256KiB
+        with Channel(f"127.0.0.1:{port}") as ch, ledger.track() as w:
+            TensorClient(ch).call("Call", {"x": x}, timeout=30)
+        # request+response cross the wire: both directions' assembly copies
+        # must be visible, and they are bounded (no hidden O(n) blowup)
+        assert w["host_copy"] >= 2 * x.nbytes
+        assert w["host_copy"] <= 8 * x.nbytes
+    finally:
+        srv.stop(grace=0)
+
+
+# -- serialize ---------------------------------------------------------------
+
+def test_serialize_from_device_roundtrip():
+    import jax.numpy as jnp
+
+    x = jnp.arange(64, dtype=jnp.float32).reshape(8, 8)
+    with ledger.track() as w:
+        segs = serialize_from_device(x)
+    assert w["dma_d2h"] == 0  # host backend: no movement
+    assert w["zero_copy"] == x.nbytes
+    buf = b"".join(bytes(s) for s in segs)
+    y, end = deserialize_to_device(buf)
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+
+
+def test_deserialize_counts_alias_on_host_backend():
+    from tpurpc.jaxshim import codec
+
+    x = np.arange(1024, dtype=np.float32)
+    buf = bytearray(codec.encode_tensor_bytes(x))  # writable → dlpack alias
+    with ledger.track() as w:
+        y, _ = deserialize_to_device(buf)
+    assert w["zero_copy"] >= x.nbytes
+    assert w["host_copy"] == 0
+    np.testing.assert_array_equal(np.asarray(y), x)
+
+
+# -- HBM ring ----------------------------------------------------------------
+
+def test_hbm_ring_place_view_roundtrip():
+    ring = HbmRing(1 << 16)
+    x = np.arange(512, dtype=np.float32)
+    off, n = ring.place(x)
+    with ring.view(off, n, np.float32, (512,)) as arr:
+        np.testing.assert_array_equal(np.asarray(arr), x)
+
+
+def test_hbm_ring_wrap_and_reuse():
+    cap = 1 << 12  # 4KiB ring
+    ring = HbmRing(cap)
+    rng = np.random.default_rng(0)
+    for i in range(10):  # 10 x 1.5KiB through a 4KiB ring forces wraps
+        x = rng.standard_normal(384).astype(np.float32)  # 1536B
+        off, n = ring.place(x)
+        lease = ring.view(off, n, np.float32, (384,))
+        np.testing.assert_array_equal(np.asarray(lease.array), x)
+        lease.release()
+    st = ring.stats()
+    assert st["live_spans"] == 0 and st["writable"] == cap
+
+
+def test_hbm_ring_lease_pins_span():
+    ring = HbmRing(1 << 12)
+    x = np.ones(256, np.float32)  # 1KiB
+    off, n = ring.place(x)
+    lease = ring.view(off, n)
+    ring.place(x)  # second message fits
+    before = ring.stats()["writable"]
+    lease2 = ring.view(off, n)      # second lease on the same span
+    lease.release()
+    assert ring.stats()["writable"] == before  # still pinned by lease2
+    lease2.release()
+    assert ring.stats()["writable"] > before   # first span freed
+
+
+def test_hbm_ring_full_raises():
+    ring = HbmRing(1 << 12)
+    with pytest.raises(BufferError):
+        ring.place(np.zeros(5000, np.uint8))
+
+
+def test_hbm_ring_ordered_head_advance():
+    """Later spans released first must not advance the head past an earlier
+    still-unconsumed span (credit ordering, pair.cc:276-284 analog)."""
+    ring = HbmRing(1 << 12)
+    a = ring.place(np.ones(128, np.uint8))
+    b = ring.place(np.ones(128, np.uint8))
+    lb = ring.view(*b)
+    lb.release()
+    assert ring.stats()["head"] == 0  # span a not consumed yet
+    la = ring.view(*a)
+    la.release()
+    assert ring.stats()["head"] == a[1] + b[1]
+
+
+def test_end_to_end_rx_into_hbm_ring_zero_host_copy_after_assembly():
+    """North-star shape: wire buffer → HBM placement → device view, with the
+    ledger proving no host memcpy after frame assembly."""
+    from tpurpc.jaxshim import codec
+
+    x = np.arange(4096, dtype=np.float32)
+    wire = bytearray(codec.encode_tensor_bytes(x))
+    arr_view, _ = codec.decode_tensor(wire)      # zero-copy parse
+
+    ring = HbmRing(1 << 16)
+    with ledger.track() as w:
+        off, n = ring.place(arr_view.view(np.uint8))
+        with ring.view(off, n, np.float32, (4096,)) as dev:
+            np.testing.assert_array_equal(np.asarray(dev), x)
+    assert w["host_copy"] == 0
+    assert w["dma_h2d"] == x.nbytes

@@ -45,6 +45,7 @@ import struct
 from typing import Callable, Iterable, List, Optional, Sequence, Tuple
 
 from tpurpc.core import _native
+from tpurpc.tpu import ledger
 
 ALIGN = 8
 HEADER_BYTES = 8
@@ -245,6 +246,7 @@ class RingReader:
                 self.consumed_since_publish += span
                 self._msg_len = 0
                 self._msg_read = 0
+        ledger.host_copy(total)
         return total
 
     def _read_into_native(self, dst: memoryview) -> int:
@@ -267,6 +269,7 @@ class RingReader:
         self._msg_len = msg_len.value
         self._msg_read = msg_read.value
         self.consumed_since_publish = consumed.value
+        ledger.host_copy(n)
         return n
 
     def read(self, nbytes: int) -> bytes:
@@ -391,6 +394,7 @@ class RingWriter:
         if self._nat is not None:
             return self._writev_native(views, payload_len)
         # Order matters for lock-free completion detection: payload, footer, header.
+        ledger.host_copy(payload_len)
         off = self.tail + HEADER_BYTES
         for v in views:
             self._put(off, v)
@@ -420,6 +424,7 @@ class RingWriter:
         if got == 0xFFFFFFFFFFFFFFFF:
             raise RingFull(payload_len, self.writable_payload())
         self.tail = tail.value
+        ledger.host_copy(got)
         return got
 
 

@@ -154,13 +154,19 @@ def to_jax(arr: np.ndarray):
     """
     import jax
 
+    from tpurpc.tpu import ledger
+
     if not arr.flags.writeable:
         # jax dlpack import refuses read-only buffers; device_put instead
         # (still a single copy onto device / into the backend arena).
+        ledger.dma_h2d(arr.nbytes)
         return jax.device_put(arr)
     try:
-        return jax.dlpack.from_dlpack(arr)
+        out = jax.dlpack.from_dlpack(arr)
+        ledger.zero_copy(arr.nbytes)
+        return out
     except (TypeError, RuntimeError, ValueError):
+        ledger.dma_h2d(arr.nbytes)
         return jax.device_put(arr)
 
 

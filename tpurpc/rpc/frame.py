@@ -30,6 +30,7 @@ from typing import Iterable, List, Optional, Sequence, Tuple
 
 from tpurpc.core.endpoint import Endpoint
 from tpurpc.rpc.status import StatusCode
+from tpurpc.tpu import ledger as _ledger
 
 MAGIC = b"TPURPC\x01\x00"  # connection preface, client → server
 MAX_FRAME_PAYLOAD = 1 << 20
@@ -328,6 +329,7 @@ class FrameReader:
                     self._eof = True
                     raise FrameError("truncated frame payload at EOF")
                 dst += self._scratch_mv[:n]
+                _ledger.host_copy(n)
                 rest -= n
         except TimeoutError:
             self._pending_msg = (dst, rest, stream_id, flags)
@@ -362,6 +364,7 @@ class FrameReader:
             have = min(length, len(self._buf) - hdr)
             if have:
                 dst += memoryview(self._buf)[hdr:hdr + have]
+                _ledger.host_copy(have)
             del self._buf[:hdr + have]
             return self._drain_message(dst, length - have, stream_id, flags,
                                        timeout)

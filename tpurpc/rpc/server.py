@@ -219,11 +219,13 @@ class _ServerSink(fr.MessageSink):
 
 
 class _ServerConnection:
-    def __init__(self, server: "Server", endpoint: Endpoint):
+    def __init__(self, server: "Server", endpoint: Endpoint,
+                 preface_consumed: bool = False):
         self.server = server
         self.endpoint = endpoint
         self.writer = fr.FrameWriter(endpoint)
-        self.reader = fr.FrameReader(endpoint, expect_preface=True)
+        self.reader = fr.FrameReader(endpoint,
+                                     expect_preface=not preface_consumed)
         self.reader.sink = _ServerSink(self)
         self._streams: Dict[int, _ServerStream] = {}
         self._lock = threading.Lock()
@@ -445,8 +447,43 @@ class Server:
         return self
 
     def serve_endpoint(self, endpoint: Endpoint) -> None:
-        """Adopt an already-connected endpoint (inproc/test path)."""
-        conn = _ServerConnection(self, endpoint)
+        """Adopt an already-connected endpoint, sniffing the protocol.
+
+        The first 8 bytes decide: the TPURPC magic routes to the native
+        framing; ``PRI * HT`` (the h2 connection preface) routes to the gRPC
+        wire-compat path — one port serves stock gRPC clients and tpurpc
+        clients simultaneously (the reference needs no sniff because it IS
+        gRPC; we speak both).
+
+        Runs the sniff on its own thread: callers (accept bootstrap, inproc
+        tests) may invoke this before the client has written a byte.
+        """
+        threading.Thread(target=self._sniff_and_serve, args=(endpoint,),
+                         daemon=True, name="tpurpc-sniff").start()
+
+    def _sniff_and_serve(self, endpoint: Endpoint) -> None:
+        first = bytearray(8)
+        got = 0
+        try:
+            while got < 8:
+                n = endpoint.read_into(memoryview(first)[got:], timeout=30)
+                if n == 0:
+                    endpoint.close()
+                    return
+                got += n
+        except (EndpointError, TimeoutError):
+            endpoint.close()
+            return
+        if bytes(first) == fr.MAGIC:
+            conn = _ServerConnection(self, endpoint, preface_consumed=True)
+        elif bytes(first) == b"PRI * HT":
+            from tpurpc.wire.grpc_h2 import GrpcH2Connection
+
+            conn = GrpcH2Connection(self, endpoint, preface_consumed=8)
+        else:
+            trace_server.log("unknown protocol preface %r; dropping", bytes(first))
+            endpoint.close()
+            return
         with self._lock:
             self._connections.append(conn)
 

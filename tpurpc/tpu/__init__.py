@@ -1,0 +1,26 @@
+"""TPU-side data plane: copy ledger, device serialization, HBM receive ring.
+
+The BASELINE.json north star re-homed: receive rings in device memory,
+payloads surfaced as zero-copy ``jax.Array``s, outbound tensors serialized
+from device without host staging — with every remaining copy measured by
+:mod:`tpurpc.tpu.ledger` so the emulated path can't overclaim.
+
+``serialize`` is loaded lazily: it pulls in the jaxshim codec, which itself
+ships bytes through the rpc layer that reports into the ledger — eager
+import here would close that cycle.
+"""
+
+from tpurpc.tpu import ledger
+from tpurpc.tpu.hbm_ring import HbmLease, HbmRing
+
+__all__ = ["ledger", "HbmLease", "HbmRing", "deserialize_to_device",
+           "serialize_from_device", "tree_from_device"]
+
+
+def __getattr__(name):
+    if name in ("deserialize_to_device", "serialize_from_device",
+                "tree_from_device"):
+        from tpurpc.tpu import serialize
+
+        return getattr(serialize, name)
+    raise AttributeError(f"module 'tpurpc.tpu' has no attribute {name!r}")

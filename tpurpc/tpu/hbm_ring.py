@@ -1,0 +1,177 @@
+"""Device-resident receive ring: tensor payloads live in TPU HBM, consumers
+get device views — the emulated form of the BASELINE north star.
+
+Real hardware path (not reachable in this environment): the NIC DMAs into a
+dmabuf-exported HBM ring, head/footer words stay host-visible, and ``Recv``
+returns device buffer handles. This module emulates the *architecture* with
+XLA-visible pieces so the protocol, lease discipline, and copy ledger are
+real even though the placement is a ``device_put``:
+
+* ``place`` — one h2d movement per payload (ledger: dma_h2d), donated-buffer
+  ``dynamic_update_slice`` so XLA updates the ring in place instead of
+  rewriting 16MB per message.
+* ``view`` — ``dynamic_slice`` + bitcast on device; payload bytes never
+  return to the host.
+* lease/credit — a message's span stays pinned until every handle is
+  released; only then does the head advance (SURVEY.md §7 hard-part #4: a
+  ``jax.Array`` aliasing ring memory must gate credit return).
+
+Capacity is a power of two; offsets are monotonic 64-bit counters — the same
+invariants as the host ring (tpurpc/core/ring.py), so the flow-control math
+is shared by inspection.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from tpurpc.tpu import ledger
+
+
+class HbmRing:
+    """Byte ring in device memory with host-tracked head/tail + leases."""
+
+    def __init__(self, capacity: int, device=None):
+        import jax
+        import jax.numpy as jnp
+
+        if capacity < 64 or capacity & (capacity - 1):
+            raise ValueError("capacity must be a power of two >= 64")
+        self.capacity = capacity
+        self._mask = capacity - 1
+        if device is None:
+            device = jax.devices()[0]
+        self.device = device
+        self.buf = jax.device_put(jnp.zeros((capacity,), jnp.uint8), device)
+        self.tail = 0   # absolute bytes ever placed
+        self.head = 0   # absolute bytes ever freed
+        self._lock = threading.Lock()
+        #: span -> [outstanding leases, ever_released] — a span frees only
+        #: after at least one lease was taken AND all were released, so a
+        #: placed-but-unconsumed message can never be reclaimed under it
+        self._live: Dict[Tuple[int, int], list] = {}
+
+        def _update(buf, payload, start):
+            import jax.lax as lax
+            return lax.dynamic_update_slice(buf, payload, (start,))
+
+        self._update = jax.jit(_update, donate_argnums=0)
+
+        def _slice(buf, start, n):
+            import jax.lax as lax
+            return lax.dynamic_slice(buf, (start,), (n,))
+
+        # n is static per shape; jit caches per payload size
+        self._slice = jax.jit(_slice, static_argnums=2)
+
+    # -- producer ------------------------------------------------------------
+
+    def writable(self) -> int:
+        return self.capacity - (self.tail - self.head)
+
+    def place(self, payload) -> Tuple[int, int]:
+        """DMA one payload into the ring; returns its (offset, nbytes) span.
+
+        Emulates the NIC's placement write: exactly one h2d movement, zero
+        host memcpy (the payload view is consumed in place).
+        """
+        import jax
+
+        src = np.frombuffer(payload, np.uint8) if not isinstance(
+            payload, np.ndarray) else payload.reshape(-1).view(np.uint8)
+        n = src.nbytes
+        with self._lock:
+            if n > self.writable():
+                raise BufferError(f"HBM ring full: {n} > {self.writable()}")
+            off = self.tail
+            self.tail += n
+            self._live[(off, n)] = [0, False]
+        p = off & self._mask
+        dev = jax.device_put(jax.numpy.asarray(src), self.device)
+        ledger.dma_h2d(n)
+        first = min(n, self.capacity - p)
+        self.buf = self._update(self.buf, dev[:first], p)
+        if first < n:  # wrap: second placement at offset 0
+            self.buf = self._update(self.buf, dev[first:], 0)
+        return off, n
+
+    # -- consumer ------------------------------------------------------------
+
+    def view(self, off: int, n: int, dtype=np.uint8,
+             shape: Optional[tuple] = None) -> "HbmLease":
+        """Device view of a placed span; pins it until the lease is released."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        with self._lock:
+            if (off, n) not in self._live:
+                raise KeyError(f"span ({off}, {n}) not live")
+            self._live[(off, n)][0] += 1
+        p = off & self._mask
+        first = min(n, self.capacity - p)
+        seg = self._slice(self.buf, p, first)
+        if first < n:
+            seg = jnp.concatenate([seg, self._slice(self.buf, 0, n - first)])
+        dt = jnp.dtype(dtype)
+        if dt != jnp.uint8:
+            seg = lax.bitcast_convert_type(
+                seg.reshape(-1, dt.itemsize), dt).reshape(-1)
+        if shape is not None:
+            seg = seg.reshape(shape)
+        ledger.zero_copy(n)  # device-side reinterpretation, no host bytes
+        return HbmLease(self, off, n, seg)
+
+    def _release(self, off: int, n: int) -> None:
+        with self._lock:
+            entry = self._live[(off, n)]
+            entry[0] -= 1
+            entry[1] = True
+            if entry[0] > 0:
+                return
+            # advance head over every consumed (leased-and-released) prefix
+            while self._live:
+                first_key = min(self._live)
+                cnt, consumed = self._live[first_key]
+                if first_key[0] != self.head or cnt > 0 or not consumed:
+                    break
+                del self._live[first_key]
+                self.head += first_key[1]
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"capacity": self.capacity, "head": self.head,
+                    "tail": self.tail, "live_spans": len(self._live),
+                    "writable": self.writable()}
+
+
+class HbmLease:
+    """A device view pinning its ring span; release returns the credit.
+
+    ``release()`` is idempotent; dropping the lease without releasing leaks
+    the span until process exit (deliberate: silent auto-free under GC
+    pressure would make flow control nondeterministic — the reference's
+    credits are explicit too, ``pair.cc:276-284``)."""
+
+    __slots__ = ("_ring", "_off", "_n", "array", "_released")
+
+    def __init__(self, ring: HbmRing, off: int, n: int, array):
+        self._ring = ring
+        self._off = off
+        self._n = n
+        self.array = array
+        self._released = False
+
+    def release(self) -> None:
+        if not self._released:
+            self._released = True
+            self._ring._release(self._off, self._n)
+
+    def __enter__(self):
+        return self.array
+
+    def __exit__(self, *exc):
+        self.release()
+        return False

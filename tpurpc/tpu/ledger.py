@@ -1,0 +1,88 @@
+"""Copy ledger: byte-exact accounting of host memcpys and DMAs per path.
+
+BASELINE.json's third headline metric is "host-memcpy bytes" on the receive
+path — a number the reference cannot even measure (its copies are implicit in
+``ring_buffer.cc:122-191`` Read and slice assembly). Every data-plane layer
+reports its copies here, so "zero-copy" is a measured claim, not a slogan:
+
+* ``host_copy``    — CPU memcpy between two host buffers (ring drain, frame
+                     assembly, codec copy=True, staging)
+* ``dma_h2d``      — host buffer → device memory (jax device_put of wire bytes)
+* ``dma_d2h``      — device memory → host buffer (serialize-from-device)
+* ``zero_copy``    — payload bytes delivered by aliasing (dlpack import of a
+                     wire buffer, ring-lease views)
+
+Counters are process-wide and monotonic; :func:`track` snapshots a window.
+GIL-protected integer adds — the accounting itself must not cost a memcpy.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Dict
+
+_lock = threading.Lock()
+_counters: Dict[str, int] = {
+    "host_copy": 0,
+    "dma_h2d": 0,
+    "dma_d2h": 0,
+    "zero_copy": 0,
+}
+
+
+def add(kind: str, nbytes: int) -> None:
+    if nbytes:
+        with _lock:
+            _counters[kind] += nbytes
+
+
+def host_copy(nbytes: int) -> None:
+    add("host_copy", nbytes)
+
+
+def dma_h2d(nbytes: int) -> None:
+    add("dma_h2d", nbytes)
+
+
+def dma_d2h(nbytes: int) -> None:
+    add("dma_d2h", nbytes)
+
+
+def zero_copy(nbytes: int) -> None:
+    add("zero_copy", nbytes)
+
+
+def snapshot() -> Dict[str, int]:
+    with _lock:
+        return dict(_counters)
+
+
+def reset() -> None:
+    with _lock:
+        for k in _counters:
+            _counters[k] = 0
+
+
+class Window:
+    """Counter deltas over a tracked region."""
+
+    def __init__(self, start: Dict[str, int]):
+        self._start = start
+        self.delta: Dict[str, int] = {}
+
+    def close(self, end: Dict[str, int]) -> None:
+        self.delta = {k: end[k] - self._start[k] for k in end}
+
+    def __getitem__(self, k: str) -> int:
+        return self.delta[k]
+
+
+@contextlib.contextmanager
+def track():
+    """``with ledger.track() as w: ...`` → ``w["host_copy"]`` etc."""
+    w = Window(snapshot())
+    try:
+        yield w
+    finally:
+        w.close(snapshot())

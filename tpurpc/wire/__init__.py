@@ -1,0 +1,2 @@
+"""gRPC wire-compat layer: HTTP/2 + HPACK so stock gRPC clients interoperate
+(SURVEY.md §7 stage 3's compatibility path; reference: chttp2, §2.4)."""

@@ -1,0 +1,464 @@
+"""gRPC-over-HTTP/2 server connection: stock gRPC clients hit tpurpc servers.
+
+This is the drop-in capability: the reference IS gRPC, so any grpcio /
+grpc++ client must be able to call a tpurpc server unchanged. A connection
+whose first bytes are the h2 preface (sniffed in ``Server.serve_endpoint``)
+lands here instead of the native TPURPC framing; the same registered
+``RpcMethodHandler``s serve both protocols.
+
+Implements the gRPC HTTP/2 protocol mapping: POST /Service/Method,
+``content-type: application/grpc``, 5-byte length-prefixed messages in DATA,
+``grpc-timeout`` deadlines, trailers with ``grpc-status``/``grpc-message``
+(percent-encoded), ``-bin`` metadata as unpadded base64, flow control both
+directions. Reference: chttp2 + surface/call.cc (SURVEY.md §2.4/§3.3).
+"""
+
+from __future__ import annotations
+
+import base64
+import logging
+import queue
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from tpurpc.core.endpoint import Endpoint, EndpointError
+from tpurpc.rpc.status import AbortError, Metadata, StatusCode
+from tpurpc.wire import h2
+from tpurpc.wire.hpack import HpackDecoder, HpackEncoder, HpackError
+
+_log = logging.getLogger("tpurpc.grpc_h2")
+
+_GRPC_MSG_HDR = struct.Struct("!BI")
+
+#: our receive windows (we grant aggressively; tensors are big)
+RECV_WINDOW = 4 << 20
+
+
+def _parse_timeout(value: str) -> Optional[float]:
+    try:
+        unit = value[-1]
+        n = int(value[:-1])
+    except (ValueError, IndexError):
+        return None
+    return n * {"H": 3600.0, "M": 60.0, "S": 1.0, "m": 1e-3, "u": 1e-6,
+                "n": 1e-9}.get(unit, None) if unit in "HMSmun" else None
+
+
+def _pct_encode(msg: str) -> str:
+    out = []
+    for b in msg.encode("utf-8"):
+        if 0x20 <= b <= 0x7E and b != 0x25:
+            out.append(chr(b))
+        else:
+            out.append(f"%{b:02X}")
+    return "".join(out)
+
+
+def _decode_metadata_value(key: str, value: bytes):
+    if key.endswith("-bin"):
+        pad = -len(value) % 4
+        return base64.b64decode(value + b"=" * pad)
+    return value.decode("utf-8", "replace")
+
+
+def _encode_metadata_value(key: str, value) -> str:
+    if key.endswith("-bin"):
+        raw = value if isinstance(value, (bytes, bytearray)) else str(value).encode()
+        return base64.b64encode(raw).decode().rstrip("=")
+    return value.decode() if isinstance(value, (bytes, bytearray)) else str(value)
+
+
+class _H2Stream:
+    _END = object()
+
+    def __init__(self, stream_id: int):
+        self.stream_id = stream_id
+        self.requests: "queue.Queue[object]" = queue.Queue()
+        self.partial = bytearray()   # gRPC message assembly across DATA frames
+        self.half_closed = False
+        self.cancelled = threading.Event()
+        self.window: Optional[h2.FlowWindow] = None  # send window, set by conn
+        self.headers_sent = False
+
+
+class H2ServerContext:
+    """grpcio-compatible context for handlers reached over the h2 path."""
+
+    def __init__(self, conn: "GrpcH2Connection", stream: _H2Stream,
+                 metadata: List[Tuple[str, object]],
+                 deadline: Optional[float]):
+        self._conn = conn
+        self._stream = stream
+        self._metadata = metadata
+        self._deadline = deadline
+        self._trailing: Metadata = ()
+        self._code: Optional[StatusCode] = None
+        self._details = ""
+
+    def invocation_metadata(self):
+        return list(self._metadata)
+
+    def peer(self) -> str:
+        return self._conn.endpoint.peer
+
+    def deadline_remaining(self) -> Optional[float]:
+        if self._deadline is None:
+            return None
+        return max(0.0, self._deadline - time.monotonic())
+
+    time_remaining = deadline_remaining
+
+    def is_active(self) -> bool:
+        return not self._stream.cancelled.is_set()
+
+    def cancel(self) -> None:
+        self._stream.cancelled.set()
+
+    def set_trailing_metadata(self, metadata: Metadata) -> None:
+        self._trailing = metadata
+
+    def set_code(self, code: StatusCode) -> None:
+        self._code = code
+
+    def set_details(self, details: str) -> None:
+        self._details = details
+
+    def abort(self, code: StatusCode, details: str = ""):
+        if code is StatusCode.OK:
+            raise ValueError("abort with OK is invalid")
+        raise AbortError(code, details)
+
+    def send_initial_metadata(self, metadata: Metadata) -> None:
+        self._conn.send_response_headers(self._stream, metadata)
+
+    def _deadline_exceeded(self) -> bool:
+        return self._deadline is not None and time.monotonic() > self._deadline
+
+
+class GrpcH2Connection:
+    """One accepted h2 connection serving gRPC semantics."""
+
+    def __init__(self, server, endpoint: Endpoint,
+                 preface_consumed: int = 0):
+        self.server = server
+        self.endpoint = endpoint
+        self._scanner = h2.FrameScanner()
+        self._decoder = HpackDecoder()
+        self._encoder = HpackEncoder()
+        self._write_lock = threading.Lock()
+        self._streams: Dict[int, _H2Stream] = {}
+        self._lock = threading.Lock()
+        self._peer_max_frame = h2.DEFAULT_MAX_FRAME
+        self._peer_initial_window = h2.DEFAULT_WINDOW
+        self._conn_window = h2.FlowWindow(h2.DEFAULT_WINDOW)  # our sends
+        self._recv_conn_credit = 0
+        self._preface_left = len(h2.PREFACE) - preface_consumed
+        self._headers_frag: Optional[Tuple[int, int, bytearray]] = None
+        self.alive = True
+        self._send_settings()
+        self._thread = threading.Thread(target=self._read_loop, daemon=True,
+                                        name="tpurpc-h2-reader")
+        self._thread.start()
+
+    # -- writing -------------------------------------------------------------
+
+    def _write(self, segs: List[bytes]) -> None:
+        with self._write_lock:
+            self.endpoint.write(segs)
+
+    def _send_settings(self) -> None:
+        self._write(h2.pack_settings({
+            h2.SETTINGS_MAX_CONCURRENT_STREAMS: 1024,
+            h2.SETTINGS_INITIAL_WINDOW_SIZE: RECV_WINDOW,
+            h2.SETTINGS_MAX_FRAME_SIZE: h2.DEFAULT_MAX_FRAME,
+        }))
+        # lift the connection-level receive window too
+        self._write(h2.pack_window_update(0, RECV_WINDOW - h2.DEFAULT_WINDOW))
+
+    def send_response_headers(self, st: _H2Stream, metadata: Metadata = ()) -> None:
+        if st.headers_sent:
+            return
+        st.headers_sent = True
+        hdrs = [(":status", "200"), ("content-type", "application/grpc")]
+        for k, v in metadata:
+            hdrs.append((k.lower(), _encode_metadata_value(k.lower(), v)))
+        self._write(h2.pack_frame(h2.HEADERS, h2.FLAG_END_HEADERS,
+                                  st.stream_id, self._encoder.encode(hdrs)))
+
+    def send_message(self, st: _H2Stream, payload) -> None:
+        if isinstance(payload, (list, tuple)):
+            payload = b"".join(bytes(p) for p in payload)
+        else:
+            payload = bytes(payload)
+        data = _GRPC_MSG_HDR.pack(0, len(payload)) + payload
+        mv = memoryview(data)
+        pos = 0
+        while pos < len(mv):
+            want = min(len(mv) - pos, self._peer_max_frame)
+            got = st.window.take(want, timeout=120)
+            conn_got = self._conn_window.take(got, timeout=120)
+            if conn_got < got:  # return the stream window over-reservation
+                st.window.grant(got - conn_got)
+                got = conn_got
+            chunk = mv[pos:pos + got]
+            self._write(h2.pack_frame(h2.DATA, 0, st.stream_id, bytes(chunk)))
+            pos += got
+
+    def send_trailers(self, st: _H2Stream, code: StatusCode, details: str,
+                      metadata: Metadata = ()) -> None:
+        if not st.headers_sent:
+            self.send_response_headers(st)
+        hdrs = [("grpc-status", str(int(code)))]
+        if details:
+            hdrs.append(("grpc-message", _pct_encode(details)))
+        for k, v in metadata:
+            hdrs.append((k.lower(), _encode_metadata_value(k.lower(), v)))
+        self._write(h2.pack_frame(
+            h2.HEADERS, h2.FLAG_END_HEADERS | h2.FLAG_END_STREAM,
+            st.stream_id, self._encoder.encode(hdrs)))
+
+    # -- reading -------------------------------------------------------------
+
+    def _read_loop(self) -> None:
+        scratch = bytearray(1 << 16)
+        mv = memoryview(scratch)
+        try:
+            while True:
+                if self._preface_left > 0:
+                    n = self.endpoint.read_into(mv[:self._preface_left])
+                    if n == 0:
+                        return
+                    self._preface_left -= n
+                    continue
+                frame = self._scanner.next_frame()
+                if frame is None:
+                    n = self.endpoint.read_into(mv)
+                    if n == 0:
+                        return
+                    self._scanner.feed(mv[:n])
+                    continue
+                self._dispatch(*frame)
+        except (EndpointError, h2.H2Error, HpackError, OSError) as exc:
+            _log.debug("h2 connection error: %s", exc)
+        finally:
+            self._shutdown()
+
+    def _dispatch(self, ftype: int, flags: int, sid: int, payload: bytes) -> None:
+        if self._headers_frag is not None and ftype != h2.CONTINUATION:
+            raise h2.H2Error("expected CONTINUATION")
+        if ftype == h2.SETTINGS:
+            if flags & h2.FLAG_ACK:
+                return
+            settings = h2.parse_settings(payload)
+            if h2.SETTINGS_MAX_FRAME_SIZE in settings:
+                self._peer_max_frame = settings[h2.SETTINGS_MAX_FRAME_SIZE]
+            if h2.SETTINGS_INITIAL_WINDOW_SIZE in settings:
+                new = settings[h2.SETTINGS_INITIAL_WINDOW_SIZE]
+                delta = new - self._peer_initial_window
+                self._peer_initial_window = new
+                with self._lock:
+                    for st in self._streams.values():
+                        st.window.adjust(delta)
+            self._write(h2.pack_settings({}, ack=True))
+        elif ftype == h2.PING:
+            if not flags & h2.FLAG_ACK:
+                self._write(h2.pack_frame(h2.PING, h2.FLAG_ACK, 0, payload))
+        elif ftype == h2.WINDOW_UPDATE:
+            inc = int.from_bytes(payload[:4], "big") & 0x7FFFFFFF
+            if sid == 0:
+                self._conn_window.grant(inc)
+            else:
+                with self._lock:
+                    st = self._streams.get(sid)
+                if st is not None:
+                    st.window.grant(inc)
+        elif ftype == h2.HEADERS:
+            block = h2.strip_padding(flags, payload, has_priority=True)
+            if flags & h2.FLAG_END_HEADERS:
+                self._on_headers(sid, block, bool(flags & h2.FLAG_END_STREAM))
+            else:
+                self._headers_frag = (sid, flags, bytearray(block))
+        elif ftype == h2.CONTINUATION:
+            if self._headers_frag is None or self._headers_frag[0] != sid:
+                raise h2.H2Error("unexpected CONTINUATION")
+            fsid, fflags, buf = self._headers_frag
+            buf += payload
+            if flags & h2.FLAG_END_HEADERS:
+                self._headers_frag = None
+                self._on_headers(fsid, bytes(buf),
+                                 bool(fflags & h2.FLAG_END_STREAM))
+        elif ftype == h2.DATA:
+            self._on_data(sid, flags, payload)
+        elif ftype == h2.RST_STREAM:
+            with self._lock:
+                st = self._streams.pop(sid, None)
+            if st is not None:
+                st.cancelled.set()
+                st.requests.put(_H2Stream._END)
+        elif ftype == h2.GOAWAY:
+            raise h2.H2Error("client sent GOAWAY")
+        # PRIORITY / PUSH_PROMISE / unknown: ignore
+
+    def _on_headers(self, sid: int, block: bytes, end_stream: bool) -> None:
+        headers = self._decoder.decode(block)
+        with self._lock:
+            existing = self._streams.get(sid)
+        if existing is not None:  # client trailers — treat as half-close
+            existing.half_closed = True
+            existing.requests.put(_H2Stream._END)
+            return
+        pseudo = {}
+        metadata: List[Tuple[str, object]] = []
+        timeout_s: Optional[float] = None
+        for name_b, value_b in headers:
+            name = name_b.decode("ascii", "replace")
+            if name.startswith(":"):
+                pseudo[name] = value_b.decode("ascii", "replace")
+            elif name == "grpc-timeout":
+                timeout_s = _parse_timeout(value_b.decode("ascii", "replace"))
+            elif name in ("te", "content-type", "user-agent", "grpc-encoding",
+                          "grpc-accept-encoding", "accept-encoding"):
+                pass  # transport-level, not surfaced as metadata (grpcio parity)
+            else:
+                metadata.append((name, _decode_metadata_value(name, value_b)))
+        path = pseudo.get(":path", "")
+        st = _H2Stream(sid)
+        st.window = h2.FlowWindow(self._peer_initial_window)
+        with self._lock:
+            self._streams[sid] = st
+        if end_stream:
+            st.half_closed = True
+            st.requests.put(_H2Stream._END)
+        deadline = (None if timeout_s is None
+                    else time.monotonic() + timeout_s)
+        handler = self.server._lookup(path)
+        if handler is None:
+            self.send_trailers(st, StatusCode.UNIMPLEMENTED,
+                               f"unknown method {path}")
+            self._finish(st)
+            return
+        ctx = H2ServerContext(self, st, metadata, deadline)
+        self.server._pool.submit(self._run_handler, handler, st, ctx, path)
+
+    def _on_data(self, sid: int, flags: int, payload: bytes) -> None:
+        data = h2.strip_padding(flags, payload, has_priority=False)
+        with self._lock:
+            st = self._streams.get(sid)
+        # flow control: grant back what we consumed, always (even on unknown
+        # streams — the bytes crossed the connection window regardless)
+        if payload:
+            self._write(h2.pack_window_update(0, len(payload)))
+            if st is not None:
+                self._write(h2.pack_window_update(sid, len(payload)))
+        if st is None:
+            return
+        st.partial += data
+        while True:
+            if len(st.partial) < _GRPC_MSG_HDR.size:
+                break
+            compressed, length = _GRPC_MSG_HDR.unpack_from(st.partial)
+            if len(st.partial) < _GRPC_MSG_HDR.size + length:
+                break
+            if compressed:
+                self.send_trailers(st, StatusCode.UNIMPLEMENTED,
+                                   "compressed messages not supported")
+                self._finish(st)
+                return
+            msg = bytes(st.partial[_GRPC_MSG_HDR.size:
+                                   _GRPC_MSG_HDR.size + length])
+            del st.partial[:_GRPC_MSG_HDR.size + length]
+            st.requests.put(msg)
+        if flags & h2.FLAG_END_STREAM:
+            st.half_closed = True
+            st.requests.put(_H2Stream._END)
+
+    # -- handler execution ----------------------------------------------------
+
+    def _request_iterator(self, st: _H2Stream, deserializer, ctx):
+        while True:
+            item = st.requests.get()
+            if item is _H2Stream._END:
+                return
+            if not ctx.is_active():
+                return
+            yield deserializer(item)
+
+    def _run_handler(self, handler, st: _H2Stream, ctx: H2ServerContext,
+                     path: str) -> None:
+        try:
+            if handler.request_streaming:
+                request_in = self._request_iterator(
+                    st, handler.request_deserializer, ctx)
+            else:
+                try:
+                    item = st.requests.get(timeout=ctx.deadline_remaining())
+                except queue.Empty:
+                    self.send_trailers(st, StatusCode.DEADLINE_EXCEEDED,
+                                       "deadline exceeded awaiting request")
+                    return
+                if item is _H2Stream._END or not ctx.is_active():
+                    if ctx.is_active():
+                        self.send_trailers(
+                            st, StatusCode.INVALID_ARGUMENT,
+                            "client half-closed before sending a request")
+                    return
+                request_in = handler.request_deserializer(item)
+
+            result = handler.behavior(request_in, ctx)
+
+            self.send_response_headers(st)
+            if handler.response_streaming:
+                for response in result:
+                    if not ctx.is_active():
+                        return
+                    if ctx._deadline_exceeded():
+                        self.send_trailers(st, StatusCode.DEADLINE_EXCEEDED,
+                                           "deadline exceeded", ctx._trailing)
+                        return
+                    self.send_message(st, handler.response_serializer(response))
+            else:
+                if ctx.is_active():
+                    self.send_message(st, handler.response_serializer(result))
+            if ctx.is_active():
+                code = ctx._code if ctx._code is not None else StatusCode.OK
+                self.send_trailers(st, code, ctx._details, ctx._trailing)
+        except AbortError as exc:
+            self.send_trailers(st, exc.code, exc.details, ctx._trailing)
+        except (EndpointError, h2.H2Error, OSError):
+            pass  # connection gone
+        except Exception as exc:  # handler bug → UNKNOWN, like grpcio
+            _log.exception("h2 handler for %s raised", path)
+            self.send_trailers(st, StatusCode.UNKNOWN,
+                               f"Exception calling application: {exc}")
+        finally:
+            self._finish(st)
+
+    def _finish(self, st: _H2Stream) -> None:
+        with self._lock:
+            self._streams.pop(st.stream_id, None)
+
+    def _shutdown(self) -> None:
+        with self._lock:
+            if not self.alive:
+                return
+            self.alive = False
+            streams = list(self._streams.values())
+            self._streams.clear()
+        self._conn_window.kill()
+        for st in streams:
+            st.cancelled.set()
+            st.window.kill()
+            st.requests.put(_H2Stream._END)
+        try:
+            self.endpoint.close()
+        except Exception:
+            pass
+        self.server._forget(self)
+
+    def close(self) -> None:
+        try:
+            self.endpoint.close()
+        except Exception:
+            pass

@@ -1,0 +1,267 @@
+"""HPACK (RFC 7541) — header compression for the gRPC wire-compat path.
+
+The reference carries this in ``chttp2/transport/hpack_{parser,encoder,
+table}.cc`` (SURVEY.md §2.4); this is a from-scratch implementation of the
+spec, not a port: the decoder handles every field representation (indexed,
+literal ±indexing, never-indexed, table-size update), huffman-coded strings,
+and the dynamic table with eviction; the encoder is the minimal legal one —
+literal-without-indexing with raw strings for unknown headers, indexed
+fields for static-table hits — stateless by design so a lost frame can never
+desynchronize two ends' dynamic tables.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque, List, Tuple
+
+from tpurpc.wire.rfc7541_tables import HUFFMAN_CODES, STATIC_TABLE
+
+Header = Tuple[bytes, bytes]
+
+
+class HpackError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Huffman coding (Appendix B)
+# ---------------------------------------------------------------------------
+
+def _build_tree():
+    # binary trie: internal node = [zero_branch, one_branch]; leaf = symbol int
+    root: list = [None, None]
+    for sym, (code, nbits) in enumerate(HUFFMAN_CODES):
+        node = root
+        for i in range(nbits - 1, -1, -1):
+            bit = (code >> i) & 1
+            if i == 0:
+                node[bit] = sym
+            else:
+                if node[bit] is None:
+                    node[bit] = [None, None]
+                node = node[bit]
+    return root
+
+
+_TREE = _build_tree()
+_EOS = 256
+
+
+def huffman_decode(data: bytes) -> bytes:
+    out = bytearray()
+    node = _TREE
+    depth = 0  # bits consumed since last symbol (for padding validation)
+    ones = True  # padding must be a prefix of EOS == all ones
+    for byte in data:
+        for i in range(7, -1, -1):
+            bit = (byte >> i) & 1
+            nxt = node[bit]
+            depth += 1
+            ones = ones and bit == 1
+            if nxt is None:
+                raise HpackError("invalid huffman code")
+            if isinstance(nxt, int):
+                if nxt == _EOS:
+                    raise HpackError("EOS in huffman string")
+                out.append(nxt)
+                node = _TREE
+                depth = 0
+                ones = True
+            else:
+                node = nxt
+    if depth > 7 or not ones:
+        raise HpackError("bad huffman padding")
+    return bytes(out)
+
+
+def huffman_encode(data: bytes) -> bytes:
+    acc = 0
+    nbits = 0
+    out = bytearray()
+    for b in data:
+        code, n = HUFFMAN_CODES[b]
+        acc = (acc << n) | code
+        nbits += n
+        while nbits >= 8:
+            nbits -= 8
+            out.append((acc >> nbits) & 0xFF)
+    if nbits:
+        pad = 8 - nbits
+        out.append(((acc << pad) | ((1 << pad) - 1)) & 0xFF)
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# Primitive codecs (§5)
+# ---------------------------------------------------------------------------
+
+def encode_int(value: int, prefix_bits: int, first_byte_flags: int = 0) -> bytes:
+    limit = (1 << prefix_bits) - 1
+    if value < limit:
+        return bytes([first_byte_flags | value])
+    out = bytearray([first_byte_flags | limit])
+    value -= limit
+    while value >= 0x80:
+        out.append(0x80 | (value & 0x7F))
+        value >>= 7
+    out.append(value)
+    return bytes(out)
+
+
+def decode_int(data, pos: int, prefix_bits: int) -> Tuple[int, int]:
+    limit = (1 << prefix_bits) - 1
+    if pos >= len(data):
+        raise HpackError("truncated integer")
+    value = data[pos] & limit
+    pos += 1
+    if value < limit:
+        return value, pos
+    shift = 0
+    while True:
+        if pos >= len(data):
+            raise HpackError("truncated integer continuation")
+        b = data[pos]
+        pos += 1
+        value += (b & 0x7F) << shift
+        shift += 7
+        if shift > 35:
+            raise HpackError("integer overflow")
+        if not b & 0x80:
+            return value, pos
+
+
+def decode_string(data, pos: int) -> Tuple[bytes, int]:
+    if pos >= len(data):
+        raise HpackError("truncated string")
+    huff = bool(data[pos] & 0x80)
+    length, pos = decode_int(data, pos, 7)
+    if pos + length > len(data):
+        raise HpackError("truncated string payload")
+    raw = bytes(data[pos:pos + length])
+    return (huffman_decode(raw) if huff else raw), pos + length
+
+
+def encode_string(data: bytes) -> bytes:
+    return encode_int(len(data), 7, 0x00) + data
+
+
+# ---------------------------------------------------------------------------
+# Tables (§2.3)
+# ---------------------------------------------------------------------------
+
+_STATIC: List[Header] = [
+    (n.encode() if n else None, v.encode() if v is not None else b"")
+    for n, v in STATIC_TABLE
+]
+_STATIC_LOOKUP = {}
+for _i in range(1, len(_STATIC)):
+    _n, _v = _STATIC[_i]
+    _STATIC_LOOKUP.setdefault((_n, _v), _i)
+
+_ENTRY_OVERHEAD = 32
+
+
+class _DynamicTable:
+    def __init__(self, max_size: int = 4096):
+        self.entries: Deque[Header] = deque()  # most recent first
+        self.size = 0
+        self.max_size = max_size
+        self.cap = max_size  # protocol ceiling (SETTINGS_HEADER_TABLE_SIZE)
+
+    def add(self, name: bytes, value: bytes) -> None:
+        need = len(name) + len(value) + _ENTRY_OVERHEAD
+        while self.entries and self.size + need > self.max_size:
+            n, v = self.entries.pop()
+            self.size -= len(n) + len(v) + _ENTRY_OVERHEAD
+        if need <= self.max_size:
+            self.entries.appendleft((name, value))
+            self.size += need
+        # else: entry larger than table — spec says result is an empty table
+
+    def resize(self, new_max: int) -> None:
+        if new_max > self.cap:
+            raise HpackError(f"table size {new_max} above ceiling {self.cap}")
+        self.max_size = new_max
+        while self.entries and self.size > self.max_size:
+            n, v = self.entries.pop()
+            self.size -= len(n) + len(v) + _ENTRY_OVERHEAD
+
+    def get(self, index: int) -> Header:
+        # index is 1-based; 1..61 static, 62.. dynamic
+        if 1 <= index < len(_STATIC):
+            return _STATIC[index]
+        didx = index - len(_STATIC)
+        if 0 <= didx < len(self.entries):
+            return self.entries[didx]
+        raise HpackError(f"index {index} out of range")
+
+
+# ---------------------------------------------------------------------------
+# Decoder / Encoder
+# ---------------------------------------------------------------------------
+
+class HpackDecoder:
+    def __init__(self, max_table_size: int = 4096):
+        self._table = _DynamicTable(max_table_size)
+
+    def decode(self, block) -> List[Header]:
+        data = bytes(block)
+        pos = 0
+        out: List[Header] = []
+        while pos < len(data):
+            b = data[pos]
+            if b & 0x80:  # indexed field
+                idx, pos = decode_int(data, pos, 7)
+                if idx == 0:
+                    raise HpackError("indexed field with index 0")
+                out.append(self._table.get(idx))
+            elif b & 0x40:  # literal with incremental indexing
+                idx, pos = decode_int(data, pos, 6)
+                name = (self._table.get(idx)[0] if idx
+                        else None)
+                if name is None:
+                    name, pos = decode_string(data, pos)
+                value, pos = decode_string(data, pos)
+                self._table.add(name, value)
+                out.append((name, value))
+            elif b & 0x20:  # dynamic table size update
+                new_max, pos = decode_int(data, pos, 5)
+                self._table.resize(new_max)
+            else:  # literal without indexing (0x00) / never indexed (0x10)
+                idx, pos = decode_int(data, pos, 4)
+                name = self._table.get(idx)[0] if idx else None
+                if name is None:
+                    name, pos = decode_string(data, pos)
+                value, pos = decode_string(data, pos)
+                out.append((name, value))
+        return out
+
+
+class HpackEncoder:
+    """Minimal legal encoder: static-table hits as indexed fields, everything
+    else literal-without-indexing with raw strings. Deliberately stateless
+    (no dynamic table) — nothing to desynchronize."""
+
+    def encode(self, headers) -> bytes:
+        out = bytearray()
+        for name, value in headers:
+            n = name.encode() if isinstance(name, str) else bytes(name)
+            v = value.encode() if isinstance(value, str) else bytes(value)
+            idx = _STATIC_LOOKUP.get((n, v))
+            if idx is not None:
+                out += encode_int(idx, 7, 0x80)
+                continue
+            name_idx = _STATIC_LOOKUP.get((n, b""))
+            if name_idx is None:
+                # find any static entry with this name for name-only reference
+                for i in range(1, len(_STATIC)):
+                    if _STATIC[i][0] == n:
+                        name_idx = i
+                        break
+            if name_idx is not None:
+                out += encode_int(name_idx, 4, 0x00)
+            else:
+                out += b"\x00" + encode_string(n)
+            out += encode_string(v)
+        return bytes(out)
