@@ -1,0 +1,78 @@
+"""Bench rig: histogram math, micro closed-loop, qps localhost scenario."""
+
+import io
+import re
+
+import pytest
+
+from tpurpc.bench import micro, qps
+from tpurpc.bench.histogram import LatencyHistogram
+
+
+def test_histogram_percentiles_accurate():
+    h = LatencyHistogram()
+    for v in range(1, 10001):  # 1..10000 ns uniform
+        h.record(v)
+    assert h.total == 10000
+    assert h.percentile(50) == pytest.approx(5000, rel=0.03)
+    assert h.percentile(99) == pytest.approx(9900, rel=0.03)
+    assert h.mean_ns == pytest.approx(5000.5, rel=0.001)
+
+
+def test_histogram_merge_matches_union():
+    a, b, u = LatencyHistogram(), LatencyHistogram(), LatencyHistogram()
+    for v in (10, 200, 3000, 45000):
+        a.record(v)
+        u.record(v)
+    for v in (7, 800, 90000):
+        b.record(v)
+        u.record(v)
+    a.merge(b)
+    assert a.total == u.total and a.sum_ns == u.sum_ns
+    assert a.percentile(50) == u.percentile(50)
+
+
+def test_histogram_serialization_roundtrip():
+    h = LatencyHistogram()
+    for v in (5, 77, 1234, 987654):
+        h.record(v)
+    h2 = LatencyHistogram.from_dict(h.to_dict())
+    assert h2.percentile(99) == h.percentile(99)
+    assert h2.total == h.total
+
+
+def test_micro_closed_loop_unary_report_format():
+    srv = micro.run_server(0)
+    try:
+        out = io.StringIO()
+        result = micro.run_client(f"127.0.0.1:{srv.bench_port}", req_size=64,
+                                  duration=1.5, report_every=0.5, out=out)
+        text = out.getvalue()
+        # reference-compatible log lines (SURVEY.md §6 format)
+        assert re.search(r"Rate \d+ RPCs/s, TX Bandwidth [\d.]+ Mb/s, "
+                         r"RTT \(us\) mean [\d.]+ P50 [\d.]+", text)
+        assert "Aggregated" in text
+        assert result["rpcs"] > 10
+        assert result["rtt_us"]["p50"] > 0
+    finally:
+        srv.stop(grace=0)
+
+
+def test_micro_streaming_ping_pong():
+    srv = micro.run_server(0)
+    try:
+        result = micro.run_client(f"127.0.0.1:{srv.bench_port}", req_size=32,
+                                  streaming=True, duration=1.5,
+                                  report_every=0.5, out=io.StringIO())
+        assert result["rpcs"] > 10
+    finally:
+        srv.stop(grace=0)
+
+
+def test_qps_localhost_scenario_two_clients():
+    agg = qps.run_localhost(n_clients=2, req_size=64, duration=1.5,
+                            concurrency=1)
+    assert agg["n_clients"] == 2
+    assert agg["rpcs"] > 20
+    assert agg["rate_rps"] > 0
+    assert agg["rtt_us"]["p50"] > 0

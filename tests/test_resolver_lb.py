@@ -1,0 +1,94 @@
+"""Resolver + load balancing: target URIs, pick_first failover, round_robin."""
+
+import threading
+
+import pytest
+
+import tpurpc.rpc as rpc
+from tpurpc.rpc.resolver import (make_policy, register_resolver,
+                                 resolve_target)
+
+
+def _echo_server():
+    srv = rpc.Server(max_workers=4)
+    marker = {}
+
+    def who(req, ctx):
+        return marker["name"].encode()
+
+    srv.add_method("/t.S/Who", rpc.unary_unary_rpc_method_handler(who))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    return srv, port, marker
+
+
+def test_resolve_ipv4_list():
+    assert resolve_target("ipv4:10.0.0.1:5,10.0.0.2:7") == [
+        ("10.0.0.1", 5), ("10.0.0.2", 7)]
+
+
+def test_resolve_dns_localhost():
+    addrs = resolve_target("dns:///localhost:1234")
+    assert ("127.0.0.1", 1234) in addrs or ("::1", 1234, 0, 0) in addrs \
+        or any(a[1] == 1234 for a in addrs)
+
+
+def test_resolve_bad_target():
+    with pytest.raises(ValueError):
+        resolve_target("ipv4:nonsense")
+
+
+def test_custom_resolver_scheme():
+    register_resolver("fake", lambda rest: [("127.0.0.1", int(rest))])
+    assert resolve_target("fake:4242") == [("127.0.0.1", 4242)]
+
+
+def test_unknown_policy_rejected():
+    with pytest.raises(ValueError):
+        make_policy("magic", 2)
+
+
+def test_pick_first_fails_over_to_live_address():
+    srv, port, marker = _echo_server()
+    marker["name"] = "b"
+    try:
+        # first address is a dead port; pick_first must move on
+        dead = port + 1 if port < 65000 else port - 1
+        with rpc.Channel(f"ipv4:127.0.0.1:{dead},127.0.0.1:{port}",
+                         connect_timeout=2) as ch:
+            mc = ch.unary_unary("/t.S/Who")
+            assert mc(b"", timeout=10) == b"b"
+            # sticks with the live one on subsequent calls
+            assert mc(b"", timeout=10) == b"b"
+    finally:
+        srv.stop(grace=0)
+
+
+def test_round_robin_spreads_calls():
+    s1, p1, m1 = _echo_server()
+    s2, p2, m2 = _echo_server()
+    m1["name"] = "s1"
+    m2["name"] = "s2"
+    try:
+        with rpc.Channel(f"ipv4:127.0.0.1:{p1},127.0.0.1:{p2}",
+                         lb_policy="round_robin") as ch:
+            mc = ch.unary_unary("/t.S/Who")
+            got = {bytes(mc(b"", timeout=10)) for _ in range(6)}
+        assert got == {b"s1", b"s2"}
+    finally:
+        s1.stop(grace=0)
+        s2.stop(grace=0)
+
+
+def test_round_robin_skips_dead_member():
+    s1, p1, m1 = _echo_server()
+    m1["name"] = "alive"
+    try:
+        dead = p1 + 1 if p1 < 65000 else p1 - 1
+        with rpc.Channel(f"ipv4:127.0.0.1:{dead},127.0.0.1:{p1}",
+                         lb_policy="round_robin", connect_timeout=2) as ch:
+            mc = ch.unary_unary("/t.S/Who")
+            for _ in range(4):
+                assert mc(b"", timeout=10) == b"alive"
+    finally:
+        s1.stop(grace=0)

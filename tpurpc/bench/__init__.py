@@ -1,0 +1,10 @@
+"""Benchmark rig: micro-bench clone + qps-style driver/worker.
+
+Mirrors the reference's two performance harnesses (SURVEY.md §2.6/§4):
+``examples/cpp/micro-bench`` (closed/open-loop MPI client with HdrHistogram
+RTTs and periodic rate lines) and ``test/cpp/qps`` (driver RPC-controls N
+workers). Log lines use the reference's format so plots are comparable:
+
+    Rate <N> RPCs/s, TX Bandwidth <M> Mb/s, RTT (us) mean <..> P50 <..> P99 <..>
+    Aggregated ...
+"""

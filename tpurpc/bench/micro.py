@@ -1,0 +1,236 @@
+"""Micro-benchmark: closed/open-loop RPC ping-pong with reference log format.
+
+Clone of ``examples/cpp/micro-bench`` (``mb_client.cc``/``mb_server.cc``):
+a BenchmarkService echo server; clients issue unary or streaming ping-pongs
+of a fixed request size, closed-loop (next request after the reply) or
+open-loop (fixed issue rate), recording RTTs in a mergeable histogram and
+printing the reference's periodic/aggregate lines so its plot scripts
+(``draw/draw_bandwidth.py``-style) parse ours unchanged.
+
+CLI:
+    python -m tpurpc.bench.micro server --port 0
+    python -m tpurpc.bench.micro client --target HOST:PORT --req-size 64 \
+        --streaming --duration 10 --concurrency 1 [--rate 50000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+from typing import List, Optional
+
+import tpurpc.rpc as rpc
+from tpurpc.bench.histogram import LatencyHistogram
+
+SERVICE = "/tpurpc.Benchmark/"
+
+
+def add_benchmark_service(srv: "rpc.Server") -> None:
+    """Echo endpoints mirroring BenchmarkService (benchmark_service.proto)."""
+
+    def unary_call(req, ctx):
+        return req
+
+    def streaming_call(req_iter, ctx):
+        for req in req_iter:
+            yield req
+
+    srv.add_method(SERVICE + "UnaryCall",
+                   rpc.unary_unary_rpc_method_handler(unary_call))
+    srv.add_method(SERVICE + "StreamingCall",
+                   rpc.stream_stream_rpc_method_handler(streaming_call))
+
+
+def run_server(port: int = 0, max_workers: int = 32) -> "rpc.Server":
+    srv = rpc.Server(max_workers=max_workers)
+    add_benchmark_service(srv)
+    bound = srv.add_insecure_port(f"0.0.0.0:{port}")
+    srv.start()
+    srv.bench_port = bound
+    return srv
+
+
+class ClientStats:
+    def __init__(self):
+        self.hist = LatencyHistogram()
+        self.rpcs = 0
+        self.bytes_tx = 0
+        self.lock = threading.Lock()
+
+    def record(self, rtt_ns: int, nbytes: int) -> None:
+        with self.lock:
+            self.hist.record(rtt_ns)
+            self.rpcs += 1
+            self.bytes_tx += nbytes
+
+    def take_interval(self):
+        with self.lock:
+            r, b = self.rpcs, self.bytes_tx
+            self.rpcs = 0
+            self.bytes_tx = 0
+            return r, b
+
+
+def _report_line(rpcs: int, nbytes: int, dt: float,
+                 hist: LatencyHistogram) -> str:
+    rate = rpcs / dt if dt > 0 else 0.0
+    mbps = nbytes * 8 / dt / 1e6 if dt > 0 else 0.0
+    return (f"Rate {rate:.0f} RPCs/s, TX Bandwidth {mbps:.1f} Mb/s, "
+            f"RTT (us) mean {hist.mean_ns / 1e3:.2f} "
+            f"P50 {hist.percentile(50) / 1e3:.2f} "
+            f"P95 {hist.percentile(95) / 1e3:.2f} "
+            f"P99 {hist.percentile(99) / 1e3:.2f}")
+
+
+def _closed_loop_unary(ch, stats: ClientStats, payload: bytes,
+                       stop: threading.Event) -> None:
+    mc = ch.unary_unary(SERVICE + "UnaryCall")
+    try:
+        while not stop.is_set():
+            t0 = time.perf_counter_ns()
+            mc(payload, timeout=30)
+            stats.record(time.perf_counter_ns() - t0, len(payload))
+    except rpc.RpcError:
+        if not stop.is_set():  # shutdown races are expected, mid-run isn't
+            raise
+
+
+def _closed_loop_streaming(ch, stats: ClientStats, payload: bytes,
+                           stop: threading.Event) -> None:
+    mc = ch.stream_stream(SERVICE + "StreamingCall")
+    send_times: "List[int]" = []
+
+    def gen():
+        while not stop.is_set():
+            send_times.append(time.perf_counter_ns())
+            yield payload
+    try:
+        for _reply in mc(gen(), timeout=None):
+            stats.record(time.perf_counter_ns() - send_times.pop(0),
+                         len(payload))
+            if stop.is_set():
+                break
+    except rpc.RpcError:
+        if not stop.is_set():
+            raise
+
+
+def _open_loop_unary(ch, stats: ClientStats, payload: bytes,
+                     stop: threading.Event, rate: float) -> None:
+    """Fixed issue rate; RTT includes queueing (the open-loop honesty the
+    reference's mb_client implements with a send schedule)."""
+    mc = ch.unary_unary(SERVICE + "UnaryCall")
+    period = 1.0 / rate
+    next_t = time.perf_counter()
+    inflight: "threading.Semaphore" = threading.Semaphore(512)
+
+    def issue():
+        t0 = time.perf_counter_ns()
+        try:
+            mc(payload, timeout=30)
+            stats.record(time.perf_counter_ns() - t0, len(payload))
+        finally:
+            inflight.release()
+
+    while not stop.is_set():
+        now = time.perf_counter()
+        if now < next_t:
+            time.sleep(min(next_t - now, 0.01))
+            continue
+        next_t += period
+        inflight.acquire()
+        threading.Thread(target=issue, daemon=True).start()
+
+
+def run_client(target: str, req_size: int = 64, streaming: bool = False,
+               duration: float = 10.0, concurrency: int = 1,
+               rate: Optional[float] = None, report_every: float = 1.0,
+               out=sys.stdout) -> dict:
+    payload = bytes(req_size)
+    stats = ClientStats()
+    stop = threading.Event()
+    channels = [rpc.insecure_channel(target) for _ in range(concurrency)]
+    workers = []
+    for ch in channels:
+        if rate is not None:
+            fn = lambda c=ch: _open_loop_unary(c, stats, payload, stop,
+                                               rate / concurrency)
+        elif streaming:
+            fn = lambda c=ch: _closed_loop_streaming(c, stats, payload, stop)
+        else:
+            fn = lambda c=ch: _closed_loop_unary(c, stats, payload, stop)
+        t = threading.Thread(target=fn, daemon=True)
+        t.start()
+        workers.append(t)
+
+    t_start = time.perf_counter()
+    last = t_start
+    agg_rpcs = 0
+    agg_bytes = 0
+    while time.perf_counter() - t_start < duration:
+        time.sleep(min(report_every, duration / 2))
+        now = time.perf_counter()
+        rpcs, nbytes = stats.take_interval()
+        agg_rpcs += rpcs
+        agg_bytes += nbytes
+        print(_report_line(rpcs, nbytes, now - last, stats.hist), file=out)
+        last = now
+    stop.set()
+    for ch in channels:
+        try:
+            ch.close()
+        except Exception:
+            pass
+    total_dt = time.perf_counter() - t_start
+    rpcs, nbytes = stats.take_interval()
+    agg_rpcs += rpcs
+    agg_bytes += nbytes
+    h = stats.hist
+    print("Aggregated " + _report_line(agg_rpcs, agg_bytes, total_dt, h),
+          file=out)
+    return {
+        "rpcs": agg_rpcs, "duration_s": total_dt,
+        "rate_rps": agg_rpcs / total_dt if total_dt else 0.0,
+        "tx_mbps": agg_bytes * 8 / total_dt / 1e6 if total_dt else 0.0,
+        "rtt_us": {"mean": h.mean_ns / 1e3, "p50": h.percentile(50) / 1e3,
+                   "p95": h.percentile(95) / 1e3,
+                   "p99": h.percentile(99) / 1e3},
+        "histogram": h.to_dict(),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tpurpc.bench.micro")
+    sub = ap.add_subparsers(dest="role", required=True)
+    s = sub.add_parser("server")
+    s.add_argument("--port", type=int, default=0)
+    c = sub.add_parser("client")
+    c.add_argument("--target", required=True)
+    c.add_argument("--req-size", type=int, default=64)
+    c.add_argument("--streaming", action="store_true")
+    c.add_argument("--duration", type=float, default=10.0)
+    c.add_argument("--concurrency", type=int, default=1)
+    c.add_argument("--rate", type=float, default=None,
+                   help="open-loop issue rate (RPCs/s); omit for closed loop")
+    c.add_argument("--json", action="store_true",
+                   help="print the aggregate as one JSON line at the end")
+    args = ap.parse_args(argv)
+    if args.role == "server":
+        srv = run_server(args.port)
+        print(f"listening {srv.bench_port}", flush=True)
+        srv.wait_for_termination()
+        return 0
+    result = run_client(args.target, req_size=args.req_size,
+                        streaming=args.streaming, duration=args.duration,
+                        concurrency=args.concurrency, rate=args.rate)
+    if args.json:
+        result.pop("histogram")
+        print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,193 @@
+"""qps-style distributed benchmark rig: a driver RPC-controls N workers.
+
+Clone of ``test/cpp/qps`` (SURVEY.md §4.2): ``driver.cc RunScenario`` talks
+to ``qps_worker.cc`` WorkerService over gRPC itself; workers then assume
+server or client roles for the measured traffic. Here the control plane is
+tpurpc, configs/stats are JSON trees, and the whole scenario can run
+all-localhost (the reference's ``json_run_localhost`` trick — multi-node
+shape without a cluster).
+
+    # every participant:
+    python -m tpurpc.bench.qps worker --port 5000x
+    # orchestrator:
+    python -m tpurpc.bench.qps driver --workers h1:50001,h2:50002 \
+        --req-size 64 --duration 10
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+from typing import Dict, List
+
+import tpurpc.rpc as rpc
+from tpurpc.bench import micro
+from tpurpc.bench.histogram import LatencyHistogram
+
+WORKER_SERVICE = "/tpurpc.WorkerService/"
+
+
+def _jser(obj) -> bytes:
+    return json.dumps(obj).encode()
+
+
+def _jdes(buf) -> dict:
+    return json.loads(bytes(buf).decode())
+
+
+class WorkerServicer:
+    """RunServer / RunClient control streams (qps_worker.cc:105-140)."""
+
+    def run_server(self, req_iter, ctx):
+        setup = next(req_iter, None)
+        if setup is None:
+            return
+        srv = micro.run_server(port=int(setup.get("port", 0)),
+                               max_workers=int(setup.get("threads", 16)))
+        try:
+            yield {"port": srv.bench_port, "ok": True}
+            for _mark in req_iter:   # each mark → interval status
+                yield {"port": srv.bench_port, "ok": True}
+        finally:
+            srv.stop(grace=0)
+
+    def run_client(self, req_iter, ctx):
+        setup = next(req_iter, None)
+        if setup is None:
+            return
+        result = micro.run_client(
+            setup["target"], req_size=int(setup.get("req_size", 64)),
+            streaming=bool(setup.get("streaming", False)),
+            duration=float(setup.get("duration", 10.0)),
+            concurrency=int(setup.get("concurrency", 1)),
+            rate=setup.get("rate"), out=open("/dev/null", "w"))
+        yield result
+
+    def attach(self, srv: "rpc.Server") -> None:
+        srv.add_method(
+            WORKER_SERVICE + "RunServer",
+            rpc.stream_stream_rpc_method_handler(self.run_server, _jdes, _jser))
+        srv.add_method(
+            WORKER_SERVICE + "RunClient",
+            rpc.stream_stream_rpc_method_handler(self.run_client, _jdes, _jser))
+
+
+def run_worker(port: int = 0) -> "rpc.Server":
+    srv = rpc.Server(max_workers=16)
+    WorkerServicer().attach(srv)
+    bound = srv.add_insecure_port(f"0.0.0.0:{port}")
+    srv.start()
+    srv.worker_port = bound
+    return srv
+
+
+def run_scenario(worker_targets: List[str], req_size: int = 64,
+                 streaming: bool = False, duration: float = 10.0,
+                 concurrency: int = 1, rate=None,
+                 server_host: str = "127.0.0.1") -> Dict:
+    """First worker serves; the rest run clients (driver.cc RunScenario)."""
+    if len(worker_targets) < 2:
+        raise ValueError("need >= 2 workers (1 server + >=1 client)")
+    channels = [rpc.insecure_channel(t) for t in worker_targets]
+    try:
+        # stand up the measured server on worker 0
+        srv_mc = channels[0].stream_stream(WORKER_SERVICE + "RunServer",
+                                           _jser, _jdes)
+        srv_q: "list" = []
+        srv_done = threading.Event()
+        srv_stream_stop = threading.Event()
+
+        def srv_reqs():
+            yield {"port": 0}
+            srv_stream_stop.wait()
+
+        srv_call = srv_mc(srv_reqs(), timeout=None)
+        it = iter(srv_call)
+        status = next(it)
+        bench_port = status["port"]
+
+        # fan the clients out
+        target = f"{server_host}:{bench_port}"
+        results: List[Dict] = [None] * (len(channels) - 1)
+
+        def one(i, ch):
+            mc = ch.stream_stream(WORKER_SERVICE + "RunClient", _jser, _jdes)
+            out = list(mc(iter([{
+                "target": target, "req_size": req_size,
+                "streaming": streaming, "duration": duration,
+                "concurrency": concurrency, "rate": rate,
+            }]), timeout=duration + 60))
+            results[i] = out[-1]
+
+        ts = [threading.Thread(target=one, args=(i, ch))
+              for i, ch in enumerate(channels[1:])]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        srv_stream_stop.set()
+
+        # merge: aggregate rate sums; RTT percentiles from merged histograms
+        merged = LatencyHistogram()
+        agg = {"rate_rps": 0.0, "tx_mbps": 0.0, "rpcs": 0}
+        for r in results:
+            if r is None:
+                continue
+            agg["rate_rps"] += r["rate_rps"]
+            agg["tx_mbps"] += r["tx_mbps"]
+            agg["rpcs"] += r["rpcs"]
+            merged.merge(LatencyHistogram.from_dict(r["histogram"]))
+        agg["rtt_us"] = {"mean": merged.mean_ns / 1e3,
+                         "p50": merged.percentile(50) / 1e3,
+                         "p99": merged.percentile(99) / 1e3}
+        agg["n_clients"] = len(results)
+        return agg
+    finally:
+        srv_done.set()
+        for ch in channels:
+            try:
+                ch.close()
+            except Exception:
+                pass
+
+
+def run_localhost(n_clients: int = 2, **kw) -> Dict:
+    """All-localhost scenario: workers in-process (json_run_localhost.cc)."""
+    workers = [run_worker(0) for _ in range(n_clients + 1)]
+    try:
+        targets = [f"127.0.0.1:{w.worker_port}" for w in workers]
+        return run_scenario(targets, **kw)
+    finally:
+        for w in workers:
+            w.stop(grace=0)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tpurpc.bench.qps")
+    sub = ap.add_subparsers(dest="role", required=True)
+    w = sub.add_parser("worker")
+    w.add_argument("--port", type=int, default=0)
+    d = sub.add_parser("driver")
+    d.add_argument("--workers", required=True,
+                   help="comma-separated host:port worker list")
+    d.add_argument("--req-size", type=int, default=64)
+    d.add_argument("--streaming", action="store_true")
+    d.add_argument("--duration", type=float, default=10.0)
+    d.add_argument("--concurrency", type=int, default=1)
+    d.add_argument("--server-host", default="127.0.0.1")
+    args = ap.parse_args(argv)
+    if args.role == "worker":
+        srv = run_worker(args.port)
+        print(f"worker listening {srv.worker_port}", flush=True)
+        srv.wait_for_termination()
+        return 0
+    agg = run_scenario(args.workers.split(","), req_size=args.req_size,
+                       streaming=args.streaming, duration=args.duration,
+                       concurrency=args.concurrency,
+                       server_host=args.server_host)
+    print(json.dumps(agg))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -34,3 +34,10 @@ __all__ = [
     "unary_unary_rpc_method_handler", "unary_stream_rpc_method_handler",
     "stream_unary_rpc_method_handler", "stream_stream_rpc_method_handler",
 ]
+
+from tpurpc.rpc.interceptors import (ClientInterceptor, FaultConfig,
+                                     FaultInjector, ServerInterceptor,
+                                     intercept_channel)
+
+__all__ += ["ClientInterceptor", "FaultConfig", "FaultInjector",
+            "ServerInterceptor", "intercept_channel"]

@@ -198,6 +198,66 @@ class _Connection:
         self._die("channel closed")
 
 
+class _Subchannel:
+    """One address's connection + exponential reconnect backoff
+    (≈ Subchannel in client_channel + lib/backoff, SURVEY.md §3.2)."""
+
+    def __init__(self, factory: Callable[[], Endpoint], channel: "Channel"):
+        self._factory = factory
+        self._channel = channel
+        self._conn: Optional[_Connection] = None
+        self._lock = threading.Lock()          # guards _conn/backoff state
+        self._connect_lock = threading.Lock()  # serializes dial attempts only
+        self._backoff = Channel._BACKOFF_INITIAL
+        self._next_attempt = 0.0
+
+    def get(self) -> _Connection:
+        with self._lock:
+            if self._conn is not None and self._conn.alive:
+                return self._conn
+        # Dial outside self._lock: a blackholed connect must not freeze close()
+        # or concurrent calls for the whole connect timeout.
+        with self._connect_lock:
+            with self._lock:
+                if self._conn is not None and self._conn.alive:
+                    return self._conn
+                wait = self._next_attempt - time.monotonic()
+            if wait > 0:
+                time.sleep(wait)
+            if self._channel._is_closed():
+                raise RpcError(StatusCode.UNAVAILABLE, "channel closed")
+            try:
+                ep = self._factory()
+                conn = _Connection(ep, self._on_conn_dead)
+            except (OSError, EndpointError) as exc:
+                with self._lock:
+                    self._next_attempt = (
+                        time.monotonic()
+                        + self._backoff * (1 + 0.2 * random.random()))
+                    self._backoff = min(self._backoff * Channel._BACKOFF_MULT,
+                                        Channel._BACKOFF_MAX)
+                raise RpcError(StatusCode.UNAVAILABLE,
+                               f"connect failed: {exc}") from exc
+            with self._lock:
+                if self._channel._is_closed():
+                    conn.close()
+                    raise RpcError(StatusCode.UNAVAILABLE, "channel closed")
+                self._backoff = Channel._BACKOFF_INITIAL
+                self._conn = conn
+                return conn
+
+    def _on_conn_dead(self, conn: _Connection) -> None:
+        with self._lock:
+            if self._conn is conn:
+                self._conn = None
+
+    def close(self) -> None:
+        with self._lock:
+            conn, self._conn = self._conn, None
+        if conn is not None:
+            conn.close()
+
+
 class Channel:
     """A lazily-(re)connecting client channel.
 
@@ -214,68 +274,47 @@ class Channel:
 
     def __init__(self, target: Optional[str] = None, *,
                  endpoint_factory: Optional[Callable[[], Endpoint]] = None,
-                 connect_timeout: float = 30.0):
+                 connect_timeout: float = 30.0, lb_policy: str = "pick_first"):
+        from tpurpc.rpc.resolver import make_policy, resolve_target
+
         if endpoint_factory is None:
             if target is None:
                 raise ValueError("need target or endpoint_factory")
-            host, _, port_s = target.rpartition(":")
-            if not host or not port_s.isdigit():
-                raise ValueError(f"target must be host:port, got {target!r}")
-            port = int(port_s)
-            factory = lambda: connect_endpoint(host, port, timeout=connect_timeout)
+            addrs = resolve_target(target)
+            factories = [
+                (lambda h=h, p=p: connect_endpoint(h, p,
+                                                   timeout=connect_timeout))
+                for h, p in addrs]
         else:
-            factory = endpoint_factory
-        self._factory = factory
-        self._conn: Optional[_Connection] = None
-        self._lock = threading.Lock()          # guards _conn/_closed/backoff state
-        self._connect_lock = threading.Lock()  # serializes dial attempts only
+            factories = [endpoint_factory]
+        self._subchannels = [_Subchannel(f, self) for f in factories]
+        self._policy = make_policy(lb_policy, len(self._subchannels))
+        self._lock = threading.Lock()  # guards _closed
         self._closed = False
-        self._backoff = self._BACKOFF_INITIAL
-        self._next_attempt = 0.0
+        from tpurpc.rpc import channelz as _channelz
+
+        _channelz.register_channel(self)
 
     # -- connection management ----------------------------------------------
 
     def _connection(self) -> _Connection:
+        """LB pick: walk subchannels in policy order, first READY/dialable
+        wins (client_channel resolver→LB→subchannel flow, SURVEY.md §3.2)."""
         with self._lock:
             if self._closed:
                 raise RpcError(StatusCode.UNAVAILABLE, "channel closed")
-            if self._conn is not None and self._conn.alive:
-                return self._conn
-        # Dial outside self._lock: a blackholed connect must not freeze close()
-        # or concurrent calls for the whole connect timeout.
-        with self._connect_lock:
-            with self._lock:
-                if self._closed:
-                    raise RpcError(StatusCode.UNAVAILABLE, "channel closed")
-                if self._conn is not None and self._conn.alive:
-                    return self._conn
-                wait = self._next_attempt - time.monotonic()
-            if wait > 0:
-                time.sleep(wait)
+        last_exc: Optional[Exception] = None
+        for idx in self._policy.order():
+            sc = self._subchannels[idx]
             try:
-                ep = self._factory()
-                conn = _Connection(ep, self._on_conn_dead)
-            except (OSError, EndpointError) as exc:
-                with self._lock:
-                    self._next_attempt = (
-                        time.monotonic()
-                        + self._backoff * (1 + 0.2 * random.random()))
-                    self._backoff = min(self._backoff * self._BACKOFF_MULT,
-                                        self._BACKOFF_MAX)
-                raise RpcError(StatusCode.UNAVAILABLE,
-                               f"connect failed: {exc}") from exc
-            with self._lock:
-                if self._closed:
-                    conn.close()
-                    raise RpcError(StatusCode.UNAVAILABLE, "channel closed")
-                self._backoff = self._BACKOFF_INITIAL
-                self._conn = conn
+                conn = sc.get()
+                self._policy.connected(idx)
                 return conn
-
-    def _on_conn_dead(self, conn: _Connection) -> None:
-        with self._lock:
-            if self._conn is conn:
-                self._conn = None
+            except RpcError as exc:
+                self._policy.failed(idx)
+                last_exc = exc
+        raise last_exc if last_exc is not None else RpcError(
+            StatusCode.UNAVAILABLE, "no subchannels")
 
     def ping(self, timeout: float = 5.0) -> float:
         """Round-trip a PING; returns seconds.  Liveness probe (the reference's
@@ -288,12 +327,15 @@ class Channel:
         except (EndpointError, OSError) as exc:
             raise RpcError(StatusCode.UNAVAILABLE, str(exc)) from exc
 
+    def _is_closed(self) -> bool:
+        with self._lock:
+            return self._closed
+
     def close(self) -> None:
         with self._lock:
             self._closed = True
-            conn, self._conn = self._conn, None
-        if conn is not None:
-            conn.close()
+        for sc in self._subchannels:
+            sc.close()
 
     def __enter__(self):
         return self

@@ -1,0 +1,103 @@
+"""channelz-lite: live introspection of servers and channels.
+
+The reference inherits gRPC's channelz service (``src/cpp/server/channelz/``,
+SURVEY.md §5 tracing row). This is the same capability without the protobuf
+service wrapper: a process-wide registry + JSON-able stat dicts, exposed both
+programmatically and as a registrable tensor/bytes RPC method so remote
+inspection works over tpurpc itself.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import weakref
+from typing import Dict, List
+
+
+_lock = threading.Lock()
+_servers: "weakref.WeakSet" = weakref.WeakSet()
+_channels: "weakref.WeakSet" = weakref.WeakSet()
+
+
+class CallCounters:
+    """started/succeeded/failed + last-activity timestamps (channelz core)."""
+
+    __slots__ = ("started", "succeeded", "failed", "last_call_started")
+
+    def __init__(self):
+        self.started = 0
+        self.succeeded = 0
+        self.failed = 0
+        self.last_call_started = 0.0
+
+    def on_start(self) -> None:
+        self.started += 1
+        self.last_call_started = time.time()
+
+    def on_finish(self, ok: bool) -> None:
+        if ok:
+            self.succeeded += 1
+        else:
+            self.failed += 1
+
+    def as_dict(self) -> Dict:
+        return {"calls_started": self.started,
+                "calls_succeeded": self.succeeded,
+                "calls_failed": self.failed,
+                "last_call_started": self.last_call_started}
+
+
+def register_server(srv) -> None:
+    with _lock:
+        _servers.add(srv)
+
+
+def register_channel(ch) -> None:
+    with _lock:
+        _channels.add(ch)
+
+
+def server_info(srv) -> Dict:
+    info = {
+        "ports": list(getattr(srv, "bound_ports", [])),
+        "methods": sorted(srv._methods.keys()),
+        "connections": len(srv._connections),
+        "interceptors": len(getattr(srv, "interceptors", [])),
+    }
+    counters = getattr(srv, "call_counters", None)
+    if counters is not None:
+        info.update(counters.as_dict())
+    return info
+
+
+def channel_info(ch) -> Dict:
+    subs = getattr(ch, "_subchannels", [])
+    return {
+        "subchannels": len(subs),
+        "connected": sum(1 for s in subs
+                         if s._conn is not None and s._conn.alive),
+        "lb_policy": getattr(getattr(ch, "_policy", None), "name", "?"),
+        "closed": ch._is_closed(),
+    }
+
+
+def snapshot() -> Dict:
+    with _lock:
+        servers = list(_servers)
+        channels = list(_channels)
+    return {
+        "servers": [server_info(s) for s in servers],
+        "channels": [channel_info(c) for c in channels],
+    }
+
+
+def add_channelz_service(srv) -> None:
+    """Expose the snapshot as ``/tpurpc.Channelz/Get`` (bytes → JSON bytes)."""
+    from tpurpc.rpc.server import unary_unary_rpc_method_handler
+
+    srv.add_method(
+        "/tpurpc.Channelz/Get",
+        unary_unary_rpc_method_handler(
+            lambda _req, _ctx: json.dumps(snapshot()).encode()))

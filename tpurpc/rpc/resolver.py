@@ -1,0 +1,125 @@
+"""Name resolution + load-balancing policies for the client channel.
+
+The reference inherits these from gRPC's client_channel filter
+(``ext/filters/client_channel/resolver/{dns,sockaddr,fake}`` and
+``lb_policy/{pick_first,round_robin}`` — SURVEY.md §2.4). Same target UX:
+
+* ``"host:port"`` / ``"dns:///host:port"`` → DNS resolution (getaddrinfo)
+* ``"ipv4:1.2.3.4:5,6.7.8.9:10"``          → static address list
+* ``register_resolver("scheme", fn)``       → the fake-resolver test seam
+
+Policies: ``pick_first`` (dial addresses in order, stick with the winner —
+gRPC's default) and ``round_robin`` (rotate READY subchannels per call).
+"""
+
+from __future__ import annotations
+
+import itertools
+import socket
+import threading
+from typing import Callable, List, Sequence, Tuple
+
+Address = Tuple[str, int]
+ResolveFn = Callable[[str], List[Address]]
+
+_RESOLVERS: dict = {}
+
+
+def register_resolver(scheme: str, fn: ResolveFn) -> None:
+    """Register a scheme (the reference's fake resolver seam,
+    ``resolver/fake/fake_resolver.cc``)."""
+    _RESOLVERS[scheme] = fn
+
+
+def _parse_hostport(hp: str) -> Address:
+    host, _, port_s = hp.rpartition(":")
+    if not host or not port_s.isdigit():
+        raise ValueError(f"bad address {hp!r} (want host:port)")
+    return host, int(port_s)
+
+
+def _dns_resolve(hostport: str) -> List[Address]:
+    host, port = _parse_hostport(hostport)
+    try:
+        infos = socket.getaddrinfo(host, port, type=socket.SOCK_STREAM)
+    except socket.gaierror as exc:
+        raise ValueError(f"resolution of {host!r} failed: {exc}") from exc
+    seen = []
+    for _family, _type, _proto, _canon, sockaddr in infos:
+        addr = (sockaddr[0], sockaddr[1])
+        if addr not in seen:
+            seen.append(addr)
+    return seen or [(host, port)]
+
+
+def resolve_target(target: str) -> List[Address]:
+    """gRPC-style target URI → ordered address list."""
+    scheme, sep, rest = target.partition(":")
+    if sep and scheme in _RESOLVERS:
+        return _RESOLVERS[scheme](rest.lstrip("/"))
+    if target.startswith("dns:"):
+        return _dns_resolve(target[4:].lstrip("/"))
+    if target.startswith("ipv4:") or target.startswith("ipv6:"):
+        rest = target.split(":", 1)[1]
+        return [_parse_hostport(a) for a in rest.split(",") if a]
+    if target.startswith("static:"):
+        return [_parse_hostport(a) for a in target[7:].split(",") if a]
+    return _dns_resolve(target)
+
+
+class PickFirst:
+    """Try addresses in order; stick with the first that connects."""
+
+    name = "pick_first"
+
+    def __init__(self, n: int):
+        self._n = n
+        self._current = 0
+        self._lock = threading.Lock()
+
+    def order(self) -> Sequence[int]:
+        with self._lock:
+            cur = self._current
+        return [(cur + i) % self._n for i in range(self._n)]
+
+    def connected(self, idx: int) -> None:
+        with self._lock:
+            self._current = idx
+
+    def failed(self, idx: int) -> None:
+        with self._lock:
+            if self._current == idx:
+                self._current = (idx + 1) % self._n
+
+
+class RoundRobin:
+    """Rotate across subchannels per call."""
+
+    name = "round_robin"
+
+    def __init__(self, n: int):
+        self._n = n
+        self._counter = itertools.count()
+        self._lock = threading.Lock()
+
+    def order(self) -> Sequence[int]:
+        with self._lock:
+            start = next(self._counter) % self._n
+        return [(start + i) % self._n for i in range(self._n)]
+
+    def connected(self, idx: int) -> None:
+        pass
+
+    def failed(self, idx: int) -> None:
+        pass
+
+
+POLICIES = {"pick_first": PickFirst, "round_robin": RoundRobin}
+
+
+def make_policy(name: str, n: int):
+    try:
+        return POLICIES[name](n)
+    except KeyError:
+        raise ValueError(f"unknown lb policy {name!r} "
+                         f"(have {sorted(POLICIES)})") from None

@@ -22,7 +22,8 @@ import queue
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Callable, Dict, Iterable, Iterator, List, Optional, Tuple
+from typing import (Callable, Dict, Iterable, Iterator, List, Optional,
+                    Sequence, Tuple)
 
 from tpurpc.core.endpoint import (Endpoint, EndpointError, EndpointListener,
                                   passthru_endpoint_pair)
@@ -283,7 +284,7 @@ class _ServerConnection:
             self._streams[f.stream_id] = st
         deadline = (None if timeout_us is None
                     else time.monotonic() + timeout_us / 1e6)
-        handler = self.server._lookup(path)
+        handler = self.server._lookup_intercepted(path, metadata)
         if handler is None:
             self._send_trailers(st, StatusCode.UNIMPLEMENTED,
                                 f"unknown method {path}")
@@ -299,6 +300,16 @@ class _ServerConnection:
 
     def _run_handler(self, handler: RpcMethodHandler, st: _ServerStream,
                      ctx: ServerContext, path: str) -> None:
+        counters = self.server.call_counters
+        counters.on_start()
+        ok = False
+        try:
+            ok = self._run_handler_inner(handler, st, ctx, path)
+        finally:
+            counters.on_finish(ok)
+
+    def _run_handler_inner(self, handler: RpcMethodHandler, st: _ServerStream,
+                           ctx: ServerContext, path: str) -> bool:
         try:
             if handler.request_streaming:
                 request_in = st.request_iterator(handler.request_deserializer, ctx)
@@ -339,6 +350,7 @@ class _ServerConnection:
             if ctx.is_active():
                 code = ctx._code if ctx._code is not None else StatusCode.OK
                 self._send_trailers(st, code, ctx._details, ctx._trailing)
+                return code is StatusCode.OK
         except AbortError as exc:
             self._send_trailers(st, exc.code, exc.details, ctx._trailing)
         except (EndpointError, OSError):
@@ -349,6 +361,7 @@ class _ServerConnection:
                                 f"Exception calling application: {exc}")
         finally:
             self._finish_stream(st)
+        return False
 
     def _send_trailers(self, st: _ServerStream, code: StatusCode, details: str,
                        metadata: Metadata = ()) -> None:
@@ -395,9 +408,14 @@ class _ServerConnection:
 class Server:
     """Thread-pooled RPC server over any Endpoint source."""
 
-    def __init__(self, max_workers: int = 32):
+    def __init__(self, max_workers: int = 32, interceptors: Sequence = ()):
         self._pool = ThreadPoolExecutor(max_workers=max_workers,
                                         thread_name_prefix="tpurpc-handler")
+        self.interceptors = list(interceptors)
+        from tpurpc.rpc import channelz as _channelz
+
+        self.call_counters = _channelz.CallCounters()
+        _channelz.register_server(self)
         self._methods: Dict[str, RpcMethodHandler] = {}
         self._listeners: List[EndpointListener] = []
         self.bound_ports: List[int] = []
@@ -419,6 +437,17 @@ class Server:
                     method_handlers: Dict[str, RpcMethodHandler]) -> None:
         self.add_generic_handlers(
             method_handlers_generic_handler(service, method_handlers))
+
+    def _lookup_intercepted(self, path: str,
+                            metadata) -> Optional[RpcMethodHandler]:
+        """Handler lookup through the server interceptor chain."""
+        handler = self._lookup(path)
+        if not self.interceptors:
+            return handler
+        from tpurpc.rpc.interceptors import apply_server_interceptors
+
+        return apply_server_interceptors(handler, path, metadata,
+                                         self.interceptors)
 
     def _lookup(self, path: str) -> Optional[RpcMethodHandler]:
         return self._methods.get(path)

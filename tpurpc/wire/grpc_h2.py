@@ -333,7 +333,7 @@ class GrpcH2Connection:
             st.requests.put(_H2Stream._END)
         deadline = (None if timeout_s is None
                     else time.monotonic() + timeout_s)
-        handler = self.server._lookup(path)
+        handler = self.server._lookup_intercepted(path, metadata)
         if handler is None:
             self.send_trailers(st, StatusCode.UNIMPLEMENTED,
                                f"unknown method {path}")
@@ -387,6 +387,16 @@ class GrpcH2Connection:
 
     def _run_handler(self, handler, st: _H2Stream, ctx: H2ServerContext,
                      path: str) -> None:
+        counters = self.server.call_counters
+        counters.on_start()
+        ok = False
+        try:
+            ok = bool(self._run_handler_inner(handler, st, ctx, path))
+        finally:
+            counters.on_finish(ok)
+
+    def _run_handler_inner(self, handler, st: _H2Stream,
+                           ctx: H2ServerContext, path: str):
         try:
             if handler.request_streaming:
                 request_in = self._request_iterator(
@@ -424,6 +434,7 @@ class GrpcH2Connection:
             if ctx.is_active():
                 code = ctx._code if ctx._code is not None else StatusCode.OK
                 self.send_trailers(st, code, ctx._details, ctx._trailing)
+                return code is StatusCode.OK
         except AbortError as exc:
             self.send_trailers(st, exc.code, exc.details, ctx._trailing)
         except (EndpointError, h2.H2Error, OSError):
@@ -434,6 +445,7 @@ class GrpcH2Connection:
                                f"Exception calling application: {exc}")
         finally:
             self._finish(st)
+        return False
 
     def _finish(self, st: _H2Stream) -> None:
         with self._lock:
