@@ -1,0 +1,27 @@
+"""Greeter over tpurpc — the reference's examples/cpp/helloworld analog.
+
+Runs client and server in one process; also callable from a stock grpcio
+client (same port, h2 sniffed).
+"""
+
+import tpurpc.rpc as rpc
+
+
+def main() -> int:
+    srv = rpc.Server(max_workers=4)
+    srv.add_method(
+        "/helloworld.Greeter/SayHello",
+        rpc.unary_unary_rpc_method_handler(
+            lambda name, ctx: b"Hello, " + bytes(name) + b"!"))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    with rpc.Channel(f"127.0.0.1:{port}") as ch:
+        reply = ch.unary_unary("/helloworld.Greeter/SayHello")(b"tpu",
+                                                               timeout=10)
+        print(bytes(reply).decode())
+    srv.stop(grace=0)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

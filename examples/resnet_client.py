@@ -1,0 +1,36 @@
+"""Client for the ResNet-50 inference server (BASELINE.json config #5)."""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--target", default="127.0.0.1:50051")
+    ap.add_argument("--n", type=int, default=4, help="requests to send")
+    ap.add_argument("--image-size", type=int, default=224)
+    args = ap.parse_args()
+
+    import tpurpc.rpc as rpc
+    from tpurpc.jaxshim import TensorClient
+
+    rng = np.random.default_rng(0)
+    with rpc.Channel(args.target) as ch:
+        cli = TensorClient(ch)
+        for i in range(args.n):
+            images = rng.standard_normal(
+                (1, args.image_size, args.image_size, 3)).astype(np.float32)
+            t0 = time.perf_counter()
+            out = cli.call("Classify", {"images": images}, timeout=120)
+            dt = (time.perf_counter() - t0) * 1e3
+            print(f"request {i}: top1={np.asarray(out['top1'])[0]} "
+                  f"({dt:.1f} ms)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

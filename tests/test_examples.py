@@ -1,0 +1,39 @@
+"""Examples stay runnable (BASELINE config #5 end-to-end with thin model)."""
+
+import subprocess
+import sys
+
+import numpy as np
+
+
+def test_helloworld_example():
+    out = subprocess.run([sys.executable, "examples/helloworld.py"],
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "Hello, tpu!" in out.stdout
+
+
+def test_resnet_serving_end_to_end_thin():
+    sys.path.insert(0, "examples")
+    try:
+        from resnet_server import build_server
+    finally:
+        sys.path.pop(0)
+
+    import tpurpc.rpc as rpc
+    from tpurpc.jaxshim import TensorClient
+
+    srv, port, batcher, size = build_server(0, thin=True, batch=4,
+                                            max_delay_s=0.005)
+    try:
+        rng = np.random.default_rng(1)
+        with rpc.Channel(f"127.0.0.1:{port}") as ch:
+            cli = TensorClient(ch)
+            out = cli.call("Classify", {
+                "images": rng.standard_normal((2, size, size, 3))
+                .astype(np.float32)}, timeout=120)
+        assert np.asarray(out["logits"]).shape == (2, 10)
+        assert np.asarray(out["top1"]).shape == (2,)
+        assert batcher.rows_run == 2
+    finally:
+        srv.stop(grace=0)
