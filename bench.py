@@ -11,9 +11,17 @@ vs_baseline is against the reference's 82.6 Gb/s (= 10.325 GB/s) aggregate
 TX bandwidth — measured on InfiniBand EDR hardware; we run whatever link the
 bench host gives us (loopback shm rings here).
 
+Robustness contract (VERDICT r1 #1): the server prints its port *before* jax
+backend init, then warms the backend (import jax + device_put + decode jit)
+and prints READY; the client budgets that cold start outside every RPC
+deadline. Server stderr is captured and surfaced on any failure. If the
+default jax platform (axon TPU tunnel) fails to come up within
+TPURPC_BENCH_READY_S, the run falls back to JAX_PLATFORMS=cpu so the
+benchmark always produces a number.
+
 Env knobs: TPURPC_BENCH_MSGS (default 64 × 4MiB), TPURPC_BENCH_PLATFORM
 (default RDMA_BPEV = hybrid-wakeup ring), TPURPC_BENCH_CPU=1 to pin jax to
-CPU (CI without a chip).
+CPU directly, TPURPC_BENCH_READY_S (default 300) backend warmup budget.
 """
 
 from __future__ import annotations
@@ -22,19 +30,37 @@ import json
 import os
 import subprocess
 import sys
+import tempfile
+import threading
 import time
 
 BASELINE_GBPS = 82.6 / 8  # reference aggregate bandwidth, GB/s
 
 _SERVER_CODE = r"""
-import os, sys
+import os, sys, time
 import numpy as np
+
+from tpurpc.rpc.server import Server
+
+srv = Server(max_workers=8)
+port = srv.add_insecure_port("127.0.0.1:0")
+print("PORT", port, flush=True)          # bind first: cheap, can't hang
+
+# Backend bring-up OUTSIDE any RPC deadline. On the axon TPU tunnel this can
+# take minutes; the client waits for READY with its own wall budget.
 if os.environ.get("TPURPC_BENCH_CPU") == "1":
     import jax
     jax.config.update("jax_platforms", "cpu")
 import jax
+t0 = time.time()
+dev = jax.devices()[0]
+x = jax.device_put(np.ones((1024, 1024), np.float32))
+x.block_until_ready()
+y = (x[:8, :8] + 1.0).block_until_ready()   # trivial compile warm
+print("WARM", dev.platform, round(time.time() - t0, 1), file=sys.stderr,
+      flush=True)
+
 from tpurpc.jaxshim import add_tensor_method, to_jax
-from tpurpc.rpc.server import Server
 
 def consume(req_iter):
     total = 0
@@ -46,32 +72,89 @@ def consume(req_iter):
         checksum += float(arr[0, 0])
     yield {"bytes": np.int64(total), "check": np.float64(checksum)}
 
-srv = Server(max_workers=8)
 add_tensor_method(srv, "Sink", consume, kind="stream_stream")
-port = srv.add_insecure_port("127.0.0.1:0")
 srv.start()
-print(port, flush=True)
-srv.wait_for_termination(timeout=600)
+print("READY", dev.platform, flush=True)
+srv.wait_for_termination(timeout=1200)
 """
 
 
-def main() -> None:
-    os.environ.setdefault("GRPC_PLATFORM_TYPE",
-                          os.environ.get("TPURPC_BENCH_PLATFORM", "RDMA_BPEV"))
-    os.environ.setdefault("GRPC_RDMA_RING_BUFFER_SIZE_KB", "16384")
+class _ServerProc:
+    """Bench server subprocess with line-oriented readiness + stderr capture."""
 
-    n_msgs = int(os.environ.get("TPURPC_BENCH_MSGS", "64"))
+    def __init__(self, env):
+        self.stderr_file = tempfile.NamedTemporaryFile(
+            mode="w+", prefix="tpurpc_bench_srv_", suffix=".err", delete=False)
+        self.proc = subprocess.Popen(
+            [sys.executable, "-u", "-c", _SERVER_CODE],
+            stdout=subprocess.PIPE, stderr=self.stderr_file, env=env,
+            text=True)
+        self._lines: list[str] = []
+        self._cond = threading.Condition()
+        self._reader = threading.Thread(target=self._drain, daemon=True)
+        self._reader.start()
 
+    def _drain(self):
+        for line in self.proc.stdout:
+            with self._cond:
+                self._lines.append(line.strip())
+                self._cond.notify_all()
+        with self._cond:
+            self._lines.append(None)  # EOF sentinel
+            self._cond.notify_all()
+
+    def wait_line(self, prefix: str, timeout: float):
+        deadline = time.time() + timeout
+        seen = 0
+        with self._cond:
+            while True:
+                while seen < len(self._lines):
+                    line = self._lines[seen]
+                    seen += 1
+                    if line is None:
+                        raise RuntimeError(
+                            f"server exited before '{prefix}'"
+                            f" (rc={self.proc.poll()})\n{self.stderr_tail()}")
+                    if line.startswith(prefix):
+                        return line
+                remain = deadline - time.time()
+                if remain <= 0:
+                    raise TimeoutError(
+                        f"server did not print '{prefix}' within {timeout}s\n"
+                        f"{self.stderr_tail()}")
+                self._cond.wait(remain)
+
+    def stderr_tail(self, n=4000) -> str:
+        try:
+            self.stderr_file.flush()
+            with open(self.stderr_file.name) as f:
+                data = f.read()
+            return "--- server stderr tail ---\n" + data[-n:]
+        except OSError:
+            return "(server stderr unavailable)"
+
+    def kill(self):
+        self.proc.kill()
+        try:
+            self.proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+        # failure paths already surfaced stderr via stderr_tail()
+        try:
+            self.stderr_file.close()
+            os.unlink(self.stderr_file.name)
+        except OSError:
+            pass
+
+
+def _run_once(env, n_msgs: int, ready_s: float):
     import numpy as np
 
-    env = dict(os.environ)
-    env["PYTHONPATH"] = (os.path.dirname(os.path.abspath(__file__)) +
-                         os.pathsep + env.get("PYTHONPATH", ""))
-    srv = subprocess.Popen([sys.executable, "-c", _SERVER_CODE],
-                           stdout=subprocess.PIPE,
-                           stderr=subprocess.DEVNULL, env=env, text=True)
+    srv = _ServerProc(env)
     try:
-        port = int(srv.stdout.readline().strip())
+        port = int(srv.wait_line("PORT", 60).split()[1])
+        ready = srv.wait_line("READY", ready_s)
+        platform = ready.split()[1]
 
         from tpurpc.jaxshim import TensorClient
         from tpurpc.rpc.channel import Channel
@@ -84,7 +167,7 @@ def main() -> None:
                 for _ in range(k):
                     yield {"x": payload}
 
-            # warmup: backend init + jit + ring bring-up out of the timing
+            # warmup RPC: decode jit + ring bring-up out of the timing
             list(cli.duplex("Sink", gen(2), timeout=300))
 
             t0 = time.perf_counter()
@@ -93,15 +176,46 @@ def main() -> None:
 
         total = int(np.asarray(replies[-1]["bytes"]).ravel()[0])
         assert total == n_msgs * payload.nbytes, (total, n_msgs)
-        gbps = total / dt / 1e9
-        print(json.dumps({
-            "metric": "stream_4MiB_tensors_to_jax_Array",
-            "value": round(gbps, 3),
-            "unit": "GB/s",
-            "vs_baseline": round(gbps / BASELINE_GBPS, 3),
-        }))
+        return total / dt / 1e9, platform
+    except Exception:
+        sys.stderr.write(srv.stderr_tail() + "\n")
+        raise
     finally:
         srv.kill()
+
+
+def main() -> None:
+    os.environ.setdefault("GRPC_PLATFORM_TYPE",
+                          os.environ.get("TPURPC_BENCH_PLATFORM", "RDMA_BPEV"))
+    os.environ.setdefault("GRPC_RDMA_RING_BUFFER_SIZE_KB", "16384")
+
+    n_msgs = int(os.environ.get("TPURPC_BENCH_MSGS", "64"))
+    # Budget for jax backend bring-up on the default platform. Sized so a dead
+    # TPU tunnel (observed: jax.devices() on axon not returning in 580 s) still
+    # leaves room for the CPU-fallback run inside a ~600 s driver timeout.
+    ready_s = float(os.environ.get("TPURPC_BENCH_READY_S", "300"))
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (os.path.dirname(os.path.abspath(__file__)) +
+                         os.pathsep + env.get("PYTHONPATH", ""))
+
+    try:
+        gbps, platform = _run_once(env, n_msgs, ready_s)
+    except (TimeoutError, RuntimeError) as exc:
+        if env.get("TPURPC_BENCH_CPU") == "1":
+            raise
+        sys.stderr.write(f"default-platform bench failed ({exc});"
+                         f" retrying with JAX_PLATFORMS=cpu\n")
+        env["TPURPC_BENCH_CPU"] = "1"
+        gbps, platform = _run_once(env, n_msgs, ready_s)
+
+    print(json.dumps({
+        "metric": "stream_4MiB_tensors_to_jax_Array",
+        "value": round(gbps, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(gbps / BASELINE_GBPS, 3),
+        "jax_platform": platform,
+    }))
 
 
 if __name__ == "__main__":

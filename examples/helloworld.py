@@ -4,7 +4,12 @@ Runs client and server in one process; also callable from a stock grpcio
 client (same port, h2 sniffed).
 """
 
-import tpurpc.rpc as rpc
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import tpurpc.rpc as rpc  # noqa: E402
 
 
 def main() -> int:

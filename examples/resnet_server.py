@@ -11,6 +11,10 @@ connections by the fan-in batcher, and classified by a jitted flax ResNet-50.
 from __future__ import annotations
 
 import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def build_server(port: int = 0, thin: bool = False, batch: int = 8,

@@ -17,6 +17,16 @@
 #include <atomic>
 #include <cstdint>
 #include <cstring>
+#include <ctime>
+
+#include <sched.h>
+
+#if defined(__x86_64__) || defined(__i386__)
+#include <immintrin.h>
+#define TPR_PAUSE() _mm_pause()
+#else
+#define TPR_PAUSE() std::atomic_thread_fence(std::memory_order_seq_cst)
+#endif
 
 namespace {
 
@@ -93,7 +103,7 @@ uint64_t message_at(const uint8_t* ring, uint64_t cap, uint64_t mask,
 
 extern "C" {
 
-int tpr_abi_version() { return 1; }
+int tpr_abi_version() { return 2; }
 
 // Total drainable payload bytes (all complete messages + pending remainder).
 uint64_t tpr_ring_readable(const uint8_t* ring, uint64_t cap, uint64_t head,
@@ -183,6 +193,61 @@ int tpr_ring_has_message(const uint8_t* ring, uint64_t cap, uint64_t head,
   uint64_t ln = message_at(ring, cap, cap - 1, head);
   if (ln == ~0ULL) return -1;
   return ln != 0 ? 1 : 0;
+}
+
+namespace {
+inline uint64_t now_ns() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return uint64_t(ts.tv_sec) * 1000000000ULL + uint64_t(ts.tv_nsec);
+}
+}  // namespace
+
+// GIL-free spin-waits (loaded via CDLL, not PyDLL): the busy window of the
+// BP/BPEV disciplines runs here at native speed without starving other
+// Python threads. Mirrors the reference's busy-poll loops
+// (ev_epollex_rdma_bp_linux.cc:1020-1110 scanning pairs for HasMessage,
+// pair.cc:407-411 waitDataWrites spinning the CQ). Callers bound each call
+// by timeout_us and re-check full pair state between calls.
+
+// Spin until a complete message sits at `head` (1), corruption (-1), or
+// timeout (0). The watched words live in this side's OWN receive ring, whose
+// lifetime the caller pins for the duration of the call.
+int tpr_ring_wait_message(const uint8_t* ring, uint64_t cap, uint64_t head,
+                          uint64_t timeout_us) {
+  uint64_t mask = cap - 1;
+  uint64_t deadline = now_ns() + timeout_us * 1000ULL;
+  for (;;) {
+    uint64_t ln = message_at(ring, cap, mask, head);
+    if (ln == ~0ULL) return -1;
+    if (ln != 0) return 1;
+    for (int i = 0; i < 64; ++i) TPR_PAUSE();
+    // sched_yield per lap (GRPC_RDMA_POLLING_YIELD, rdma_utils.h:75-80):
+    // ~100ns on an idle multicore; on an oversubscribed host it hands the
+    // core to the producer we are waiting on instead of burning the slice.
+    sched_yield();
+    if (now_ns() >= deadline) return 0;
+  }
+}
+
+// Spin until the u64 at `addr` differs from `old` (returns 1) or timeout (0).
+// Used by credit-stalled writers watching their own status buffer's
+// remote-head word (the peer one-sided-writes credits there), and for the
+// peer_exit word.
+int tpr_spin_u64_change(const uint8_t* addr, uint64_t old_val,
+                        uint64_t timeout_us) {
+  uint64_t deadline = now_ns() + timeout_us * 1000ULL;
+  for (;;) {
+    uint64_t w;
+    std::memcpy(&w, addr, sizeof(w));
+    if (w != old_val) {
+      std::atomic_thread_fence(std::memory_order_acquire);
+      return 1;
+    }
+    for (int i = 0; i < 64; ++i) TPR_PAUSE();
+    sched_yield();
+    if (now_ns() >= deadline) return 0;
+  }
 }
 
 }  // extern "C"

@@ -16,9 +16,10 @@ from typing import Optional
 import numpy as np
 
 _LIB: "Optional[ctypes.CDLL]" = None
+_SPIN: "Optional[ctypes.CDLL]" = None
 _TRIED = False
 
-ABI_VERSION = 1
+ABI_VERSION = 2
 
 
 def _lib_path() -> str:
@@ -64,7 +65,26 @@ def load() -> "Optional[ctypes.CDLL]":
     lib.tpr_ring_has_message.restype = ctypes.c_int
     lib.tpr_ring_has_message.argtypes = [pu8, u64, u64, u64]
     _LIB = lib
+
+    # Second handle via CDLL: these calls RELEASE the GIL — they are the
+    # bounded busy-poll windows (BP/BPEV disciplines), and a spinning waiter
+    # must not starve the very threads that produce what it waits for.
+    # Callers pin the watched memory (an exported buffer view) across the
+    # call; Region.close retries on BufferError until waiters unpin.
+    spin = ctypes.CDLL(path)
+    spin.tpr_ring_wait_message.restype = ctypes.c_int
+    spin.tpr_ring_wait_message.argtypes = [pu8, u64, u64, u64]
+    spin.tpr_spin_u64_change.restype = ctypes.c_int
+    spin.tpr_spin_u64_change.argtypes = [pu8, u64, u64]
+    global _SPIN
+    _SPIN = spin
     return _LIB
+
+
+def load_spin() -> "Optional[ctypes.CDLL]":
+    """GIL-releasing spin-wait entry points (None when native is unavailable)."""
+    load()
+    return _SPIN
 
 
 def addr_of(buf, writable: bool) -> int:
@@ -80,6 +100,7 @@ def addr_of(buf, writable: bool) -> int:
 
 
 def reset_for_tests() -> None:
-    global _LIB, _TRIED
+    global _LIB, _SPIN, _TRIED
     _LIB = None
+    _SPIN = None
     _TRIED = False

@@ -107,6 +107,11 @@ class ReadTimeout(TimeoutError):
 class TcpEndpoint(Endpoint):
     def __init__(self, sock: socket.socket):
         self._sock = sock
+        # The socket stays BLOCKING for its whole life; read deadlines are a
+        # select() ahead of the recv instead of settimeout(). settimeout is
+        # per-socket state, so a writer thread flipping it to blocking would
+        # clobber a concurrent reader's deadline (last-setter-wins) — the
+        # FrameReader's resume path depends on its ReadTimeout actually firing.
         sock.setblocking(True)
         try:
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -115,25 +120,29 @@ class TcpEndpoint(Endpoint):
         self._peer = _fmt_addr(sock, peer=True)
         self._local = _fmt_addr(sock, peer=False)
         self._closed = False
-        self._cur_timeout: Optional[float] = None  # None == blocking
-        self._timeout_lock = threading.Lock()
 
-    def _set_timeout(self, timeout: Optional[float]) -> None:
-        # settimeout is a real syscall (fcntl); hot read loops pass the same
-        # value every time, so only touch the socket when it changes. The lock
-        # keeps cache and socket in step when reader and writer threads race
-        # (last setter wins, same as the raw socket).
-        with self._timeout_lock:
-            if timeout != self._cur_timeout:
-                self._sock.settimeout(timeout)
-                self._cur_timeout = timeout
+    def _await_readable(self, timeout: Optional[float]) -> None:
+        if timeout is None:
+            return
+        import select
+
+        # poll(), not select(): select.select fails outright for fds >=
+        # FD_SETSIZE (1024), which a busy server crosses easily.
+        try:
+            p = select.poll()
+            p.register(self._sock.fileno(), select.POLLIN)
+            r = p.poll(max(0.0, timeout) * 1000.0)
+        except (OSError, ValueError) as exc:
+            raise EndpointError(f"tcp read failed: {exc}") from exc
+        if not r:
+            raise ReadTimeout()
 
     def read(self, max_bytes: int = 1 << 20,
              timeout: Optional[float] = None) -> bytes:
         if self._closed:
             raise EndpointError("read on closed endpoint")
         try:
-            self._set_timeout(timeout)
+            self._await_readable(timeout)
             return self._sock.recv(max_bytes)
         except socket.timeout as exc:
             raise ReadTimeout() from exc
@@ -144,7 +153,7 @@ class TcpEndpoint(Endpoint):
         if self._closed:
             raise EndpointError("read on closed endpoint")
         try:
-            self._set_timeout(timeout)
+            self._await_readable(timeout)
             return self._sock.recv_into(dst)
         except socket.timeout as exc:
             raise ReadTimeout() from exc
@@ -155,7 +164,6 @@ class TcpEndpoint(Endpoint):
         if self._closed:
             raise EndpointError("write on closed endpoint")
         try:
-            self._set_timeout(None)  # writes always block; undo read timeouts
             if isinstance(data, (list, tuple)):
                 # sendmsg is a gather write but may place PARTIALLY under
                 # pressure, and the kernel caps one call at IOV_MAX=1024

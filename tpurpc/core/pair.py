@@ -38,6 +38,9 @@ import time
 import uuid
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+import numpy as np
+
+from tpurpc.core import _native
 from tpurpc.core.ring import RingCorruption, RingFull, RingReader, RingWriter
 from tpurpc.utils.config import get_config
 from tpurpc.utils.trace import trace_ring
@@ -64,6 +67,20 @@ class PairState(enum.Enum):
 # Memory domains: who implements the one-sided write.
 # ---------------------------------------------------------------------------
 
+def retry_buffer_op(fn: Callable[[], None], timeout_s: float = 2.0) -> None:
+    """Run a release/unmap that may transiently hit BufferError while a
+    GIL-free native spin holds an exported view (≤ one bounded slice)."""
+    deadline = time.monotonic() + timeout_s
+    while True:
+        try:
+            fn()
+            return
+        except BufferError:
+            if time.monotonic() >= deadline:
+                raise
+            time.sleep(0.001)
+
+
 class Region:
     """A chunk of registerable memory owned by this side (ref: ``Buffer``,
     ``buffer.h:12-35`` — pinned + ibv_reg_mr there; here just addressable bytes)."""
@@ -76,8 +93,13 @@ class Region:
         self._close = close
 
     def close(self) -> None:
-        self.buf.release()
-        self._close()
+        # A GIL-free native spin (Pair.spin) may still pin this memory through
+        # an exported buffer view for ≤ one bounded spin slice; BOTH the
+        # memoryview release and the shm unmap refuse while exports exist.
+        # Retry briefly instead of leaking (the spinner unpins within one
+        # bounded slice).
+        retry_buffer_op(self.buf.release)
+        retry_buffer_op(self._close)
 
 
 class Window:
@@ -350,9 +372,14 @@ class Pair:
 
         #: peer-driven event channel (completion interrupts + liveness); set at connect
         self.notify_sock: Optional[socket.socket] = None
-        #: local-poller-driven wakeup (BPEV's grpc_wakeup_fd, pair.h:187)
-        self._wakeup_r, self._wakeup_w = -1, -1
-        self._wakeup_armed = False  # poller sets; consumer clears
+        #: local wakeup pipes (BPEV's grpc_wakeup_fd, pair.h:187) — ONE PER
+        #: WAITER ROLE. The notify socket is shared and its tokens are
+        #: consumed by whichever waiter drains first; a per-role pipe that
+        #: only its own waiter consumes is what makes the kick-after-drain
+        #: broadcast lossless (a reader eating a credit token re-kicks both
+        #: pipes; the writer's pipe byte can only be consumed by the writer).
+        self._wake_r: Dict[str, int] = {"read": -1, "write": -1}
+        self._wake_w: Dict[str, int] = {"read": -1, "write": -1}
 
         self._send_guard = ContentAssertion("Pair.send")
         self._recv_guard = ContentAssertion("Pair.recv")
@@ -385,9 +412,12 @@ class Pair:
         self._published_head_mirror = 0
         self.error = None
         self.want_write = False
-        self._wakeup_r, self._wakeup_w = os.pipe()
-        os.set_blocking(self._wakeup_w, False)
-        os.set_blocking(self._wakeup_r, False)
+        for role in ("read", "write"):
+            r, w = os.pipe()
+            os.set_blocking(r, False)
+            os.set_blocking(w, False)
+            self._wake_r[role] = r
+            self._wake_w[role] = w
         self.state = PairState.INITIALIZED
 
     def local_address(self) -> Address:
@@ -451,7 +481,13 @@ class Pair:
         except (BlockingIOError, InterruptedError):
             pass  # event channel saturated — busy/hybrid pollers don't need it
         except OSError:
-            self._mark_error("notify channel broken")
+            # Best-effort: a send failure here usually means the peer already
+            # left (EPIPE after its graceful close) — the authoritative death
+            # signals are the peer_exit status word and the RECV-side probe
+            # (empty read) in drain_notifications/peek_events. Marking ERROR
+            # here turned every graceful close into a poisoned receive path
+            # for whatever data was still draining.
+            pass
 
     def drain_notifications(self) -> bytes:
         """Non-blocking drain of the peer-event channel; returns the tokens seen.
@@ -470,11 +506,20 @@ class Pair:
                 self._mark_error("notify channel read failed")
                 break
             if chunk == b"":
-                if self.state is PairState.CONNECTED:
-                    self._mark_error("peer vanished (notify socket closed)")
+                self._on_notify_closed()
                 break
             out += chunk
         return out
+
+    def _on_notify_closed(self) -> None:
+        """Peer's end of the notify socket closed. Graceful close writes
+        peer_exit BEFORE closing (``Disconnect`` pair.cc:325-347), so fold the
+        status words first; only an unexplained closure is an ERROR (the
+        crash-detection analog of the zero-byte TCP probe, rdma_conn.h:90-99)."""
+        if self.state is PairState.CONNECTED:
+            self.process_credits()  # may observe peer_exit -> HALF_CLOSED
+        if self.state is PairState.CONNECTED:
+            self._mark_error("peer vanished (notify socket closed)")
 
     def peek_events(self) -> bool:
         """Non-consuming probe of the notify channel (``MSG_PEEK``): True if events
@@ -493,38 +538,48 @@ class Pair:
             self._mark_error("notify channel read failed")
             return True
         if chunk == b"":
-            if self.state is PairState.CONNECTED:
-                self._mark_error("peer vanished (notify socket closed)")
+            self._on_notify_closed()
             return True
         return True
 
-    # -- wakeup fd (local poller -> blocked selector) -------------------------
+    # -- wakeup fds (local poller -> blocked selector) ------------------------
 
     @property
     def wakeup_fd(self) -> int:
-        return self._wakeup_r
+        """The read-waiter wakeup fd (``grpc_endpoint_get_fd`` analog)."""
+        return self._wake_r["read"]
+
+    def wakeup_fd_for(self, role: str) -> int:
+        return self._wake_r[role]
 
     def kick(self) -> None:
-        """Poller writes the wakeup fd when this pair needs attention
-        (``poller.cc:92-101`` writing the pair's ``grpc_wakeup_fd``)."""
-        if not self._wakeup_armed:
-            self._wakeup_armed = True
-            try:
-                os.write(self._wakeup_w, b"\x01")
-            except (BlockingIOError, OSError):
-                pass
+        """Wake every blocked waiter on this pair (``poller.cc:92-101`` writing
+        the pair's ``grpc_wakeup_fd``).
 
-    def consume_wakeup(self) -> None:
-        # Drain FIRST, clear the armed flag LAST: a kick() landing between the two
-        # leaves the flag False with a byte in the pipe — a harmless spurious wakeup.
-        # The reverse order can eat the byte while leaving the flag True, and every
-        # later kick() would early-out: a lost wakeup that blocks a waiter forever.
+        Unconditional non-blocking writes: round 1 guarded this with an
+        "armed" flag cleared by the consumer, and the window between a
+        consumer draining the byte and clearing the flag suppressed
+        concurrent kicks — a lost wakeup the old 50 ms select cap papered
+        over. A redundant byte in a pipe is free; a suppressed kick is a
+        stall. EAGAIN on a full pipe means a byte is already pending, which
+        is exactly the required post-condition."""
+        for role in ("read", "write"):
+            fd = self._wake_w[role]
+            if fd >= 0:
+                try:
+                    os.write(fd, b"\x01")
+                except (BlockingIOError, OSError):
+                    pass
+
+    def consume_wakeup(self, role: str = "read") -> None:
+        fd = self._wake_r[role]
+        if fd < 0:
+            return
         try:
-            while os.read(self._wakeup_r, 64):
+            while os.read(fd, 64):
                 pass
         except (BlockingIOError, OSError):
             pass
-        self._wakeup_armed = False
 
     # -- status / credits -----------------------------------------------------
 
@@ -608,10 +663,18 @@ class Pair:
                     self.want_write = True
                     break
                 total += n
-                self._notify(NOTIFY_DATA)
             if not views:
                 self.want_write = False
             self.total_sent += total
+            # ONE completion event per send call, not per chunk: round 1's
+            # per-chunk token (64 syscalls + wakeups per 4 MiB) was a measured
+            # throughput killer. The ring contents are visible to a spinning
+            # receiver the instant each chunk's header lands; the token only
+            # unblocks an event-discipline receiver parked in select, and one
+            # token wakes it for everything written so far (the reference
+            # likewise wakes only via poller/completion, poller.cc:92-101).
+            if total:
+                self._notify(NOTIFY_DATA)
             return total
 
     def recv_into(self, dst) -> int:
@@ -655,6 +718,59 @@ class Pair:
         self.process_credits()
         return self.writer.writable_payload() > 0
 
+    # -- native busy-poll (GIL-free) -------------------------------------------
+
+    def spin(self, role: str, timeout_us: int) -> bool:
+        """Bounded native spin on the role's watched words, GIL released.
+
+        ``read`` watches the local receive ring for a complete message
+        (header+footer words, like ``pollable_epoll``'s ``HasMessage`` scan,
+        ``ev_epollex_rdma_bp_linux.cc:1020-1110``); ``write`` watches the
+        status buffer's remote-head word the peer one-sided-writes credits
+        into (``pair.cc:294-301``). Returns True when the watched condition
+        fired OR the spin is impossible (no native lib, memory released) —
+        the caller always re-checks the full predicate in Python either way;
+        False means the slice timed out quietly.
+
+        The buffer is pinned by an exported view for the call's duration;
+        Region.close retries its unmap until spinners unpin (≤ one slice).
+        """
+        spin = _native.load_spin()
+        if spin is None:
+            # Pure-Python fallback: no bounded native spin exists, so the
+            # caller's loop would become a GIL-held hot poll. Yield the core
+            # each lap (the round-1 polling_yield behavior) and let the
+            # caller's ready() do the checking.
+            time.sleep(0)
+            return True
+        if role == "read":
+            reader = self.reader
+            if reader is None or reader._msg_len:
+                return True
+            try:
+                arr = np.frombuffer(reader.buf, dtype=np.uint8)
+            except ValueError:
+                return True  # ring released under us; predicate will surface it
+            r = spin.tpr_ring_wait_message(
+                arr.ctypes.data, reader.layout.capacity, reader.head,
+                timeout_us)
+            return r != 0
+        region = self.status_region
+        writer = self.writer
+        if region is None or writer is None:
+            return True
+        try:
+            arr = np.frombuffer(region.buf, dtype=np.uint8)
+        except ValueError:
+            return True
+        # Watch for divergence from the last FOLDED credit value, not from the
+        # word's current value: a credit that landed between the caller's
+        # predicate check and this call returns immediately instead of
+        # spinning a whole slice past it.
+        r = spin.tpr_spin_u64_change(
+            arr.ctypes.data + _STATUS_HEAD_OFF, writer.remote_head, timeout_us)
+        return r != 0
+
     # -- close / liveness ------------------------------------------------------
 
     def get_status(self) -> PairState:
@@ -681,12 +797,24 @@ class Pair:
             self.state = PairState.ERROR
         if self.error is None:
             self.error = why
+        # Waiters may be blocked in an uncapped select; the state change IS
+        # their wake condition, so deliver it.
+        self.kick()
         trace_ring.log("pair %s -> ERROR: %s", self.tag, why)
 
     def _release_channels(self) -> None:
         """Per-connection state: peer windows, notify socket, wakeup pipe, reader
         view.  (Views into regions must drop before regions can close — shm unmap
-        refuses while exported pointers exist.)"""
+        refuses while exported pointers exist.)
+
+        Kick FIRST: with the uncapped select (poller.py), a waiter blocked on
+        these very fds would otherwise hang forever — closing a registered fd
+        silently deregisters it from epoll, delivering nothing. The kick bytes
+        are level-readable, so even a waiter mid-gap (between its predicate
+        check and the select) wakes and observes the state change; a waiter
+        that races the close itself gets EBADF from select, which _wait treats
+        as a state-change wakeup."""
+        self.kick()
         if self.reader is not None:
             self.reader.release()
             self.reader = None
@@ -702,14 +830,14 @@ class Pair:
             except OSError:
                 pass
             self.notify_sock = None
-        for fd_attr in ("_wakeup_r", "_wakeup_w"):
-            fd = getattr(self, fd_attr)
-            if fd >= 0:
-                try:
-                    os.close(fd)
-                except OSError:
-                    pass
-                setattr(self, fd_attr, -1)
+        for pipes in (self._wake_r, self._wake_w):
+            for role, fd in pipes.items():
+                if fd >= 0:
+                    try:
+                        os.close(fd)
+                    except OSError:
+                        pass
+                    pipes[role] = -1
 
     def _release_regions(self) -> None:
         for attr in ("recv_region", "status_region"):

@@ -107,6 +107,14 @@ class Poller:
     # -- the scan loop (poller.cc:52-106) --------------------------------------
 
     def _run(self) -> None:
+        # Adaptive cadence: the reference busy-spins its poller on a DEDICATED
+        # core (poller.cc:52-106); on shared cores a hot scan loop starves the
+        # data-plane threads it exists to wake (measured: ~15% of all stack
+        # samples on a 1-CPU host). Since every send carries a notify token
+        # and kicks are per-role-pipe lossless, the poller is a safety net —
+        # scan hot only while pairs actually need attention, back off to a
+        # millisecond cadence when quiet.
+        idle_rounds = 0
         while True:
             with self._cv:
                 if not self._running:
@@ -115,16 +123,24 @@ class Poller:
                     self._cv.wait(timeout=self.sleep_timeout_s)
                     continue
                 snapshot = [p for p in self._pairs if p is not None]
+            any_hot = False
             for pair in snapshot:
                 try:
-                    if self._needs_attention(pair):
+                    if self._scan_edges(pair):
+                        any_hot = True
                         pair.kick()
                 except Exception:
                     # A dying pair must never take the poller down; kick so the
                     # owner observes the error state.
                     pair.kick()
-            if self.polling_yield:
-                time.sleep(0)  # GRPC_RDMA_POLLING_YIELD (rdma_utils.h:75-80)
+            if any_hot:
+                idle_rounds = 0
+                if self.polling_yield:
+                    time.sleep(0)  # GRPC_RDMA_POLLING_YIELD (rdma_utils.h:75-80)
+            else:
+                idle_rounds += 1
+                time.sleep(0 if idle_rounds < 4 else
+                           min(0.001 * (1 << min(idle_rounds - 4, 4)), 0.016))
 
     @staticmethod
     def _needs_attention(pair: Pair) -> bool:
@@ -139,6 +155,25 @@ class Poller:
         if pair.peek_events():
             return True
         return pair.state in (PairState.ERROR, PairState.HALF_CLOSED)
+
+    @staticmethod
+    def _scan_edges(pair: Pair) -> bool:
+        """Kick only on a false→true EDGE of each watched condition.
+
+        Kicks are lossless (unconditional per-role pipe writes), so one kick
+        per condition-arrival suffices: a waiter only ever blocks after
+        observing its predicate false, which can only happen after the
+        condition cleared — the next arrival is a fresh edge and a fresh
+        kick. Level-triggered re-kicking (round 1) kept the scan loop and
+        both wakeup pipes hot for the entire lifetime of every in-flight
+        message.
+        """
+        state = (pair.has_message(), pair.has_pending_writes(),
+                 pair.state in (PairState.ERROR, PairState.HALF_CLOSED)
+                 or pair.peek_events())
+        prev = getattr(pair, "_poller_edges", (False, False, False))
+        pair._poller_edges = state
+        return any(now and not was for now, was in zip(state, prev))
 
 
 def wait_readable(pair: Pair, timeout: Optional[float] = None,
@@ -158,7 +193,8 @@ def wait_readable(pair: Pair, timeout: Optional[float] = None,
     """
     return _wait(pair, timeout, discipline,
                  lambda: (pair.has_message() or pair.has_pending_writes()
-                          or pair.state not in (PairState.CONNECTED,)))
+                          or pair.state not in (PairState.CONNECTED,)),
+                 role="read")
 
 
 def wait_writable(pair: Pair, timeout: Optional[float] = None,
@@ -172,11 +208,27 @@ def wait_writable(pair: Pair, timeout: Optional[float] = None,
     """
     return _wait(pair, timeout, discipline,
                  lambda: (pair.has_pending_writes()
-                          or pair.state not in (PairState.CONNECTED,)))
+                          or pair.state not in (PairState.CONNECTED,)),
+                 role="write")
+
+
+_CPUS: Optional[int] = None
+
+
+def _effective_cpus() -> int:
+    global _CPUS
+    if _CPUS is None:
+        import os
+
+        try:
+            _CPUS = len(os.sched_getaffinity(0))
+        except (AttributeError, OSError):
+            _CPUS = os.cpu_count() or 1
+    return _CPUS
 
 
 def _wait(pair: Pair, timeout: Optional[float], discipline: Optional[str],
-          predicate) -> bool:
+          predicate, role: str = "read") -> bool:
     import selectors
 
     cfg = get_config()
@@ -186,8 +238,9 @@ def _wait(pair: Pair, timeout: Optional[float], discipline: Optional[str],
     def ready() -> bool:
         if pair.drain_notifications():
             # We may have consumed a token another waiter (full-duplex: the write
-            # side of the same endpoint) was blocked on — kick the wakeup pipe so
-            # every fd-waiter re-checks.
+            # side of the same endpoint) was blocked on — kick BOTH role pipes so
+            # every fd-waiter re-checks; each role consumes only its own pipe, so
+            # this broadcast cannot itself be stolen.
             pair.kick()
         return predicate()
 
@@ -195,42 +248,66 @@ def _wait(pair: Pair, timeout: Optional[float], discipline: Optional[str],
     if ready():
         return True
 
+    #: one native spin slice; full pair state (error/exit words, notify-channel
+    #: death) is re-checked in Python between slices.
+    _SLICE_US = 500
+
+    # Hybrid's busy window presumes a core to burn while ANOTHER core
+    # produces (the reference pins dedicated poller threads, poller.cc:52).
+    # On a single-hart host every spin microsecond is stolen from the
+    # producer, so hybrid degrades to pure event; explicit "busy" is honored
+    # as configured.
+    if discipline == "hybrid" and _effective_cpus() < 2:
+        discipline = "event"
+
     if discipline in ("busy", "hybrid"):
-        spin_deadline = time.monotonic() + cfg.busy_polling_timeout_us / 1e6
-        if discipline == "busy" and deadline is not None:
-            spin_deadline = deadline
-        elif discipline == "busy":
-            spin_deadline = float("inf")
-        while time.monotonic() < spin_deadline:
+        if discipline == "busy":
+            spin_deadline = deadline if deadline is not None else float("inf")
+        else:
+            spin_deadline = time.monotonic() + cfg.busy_polling_timeout_us / 1e6
+        while True:
+            now = time.monotonic()
+            if now >= spin_deadline:
+                break
+            slice_us = _SLICE_US
+            if spin_deadline != float("inf"):
+                slice_us = max(1, min(_SLICE_US,
+                                      int((spin_deadline - now) * 1e6)))
+            # GIL-free native spin on the watched words; True = fired (or spin
+            # unavailable — then this degrades to a pure Python poll loop).
+            pair.spin(role, slice_us)
             if ready():
                 return True
-            if cfg.polling_yield:
-                time.sleep(0)
         if discipline == "busy":
             return ready()
 
-    # Block on fds (event + hybrid).  Both waiter kinds register BOTH fds: the
-    # notify socket (peer-driven) and the wakeup pipe (poller-driven + the
-    # kick-after-drain cross-waiter signal above).  Each select is additionally
-    # capped so that a wakeup lost to any unforeseen race degrades to a bounded
-    # hiccup, never a hang.
-    _SELECT_CAP_S = 0.05
+    # Block on fds (event + hybrid): the shared notify socket (peer-driven
+    # tokens) and this role's OWN wakeup pipe (poller kicks + cross-waiter
+    # broadcast). No cap on the select: every state transition is followed by
+    # a token (peer) or a kick (poller / token-drainer), and the per-role pipe
+    # means no other thread can consume our wakeup between our predicate check
+    # and the select — the race the old 50 ms cap papered over.
     sel = selectors.DefaultSelector()
     try:
         if pair.notify_sock is not None:
             sel.register(pair.notify_sock, selectors.EVENT_READ)
-        if pair.wakeup_fd >= 0:
-            sel.register(pair.wakeup_fd, selectors.EVENT_READ)
+        wfd = pair.wakeup_fd_for(role)
+        if wfd >= 0:
+            sel.register(wfd, selectors.EVENT_READ)
         while True:
             if ready():
                 return True
             remain = None if deadline is None else deadline - time.monotonic()
             if remain is not None and remain <= 0:
                 return ready()
-            slice_s = _SELECT_CAP_S if remain is None else min(remain, _SELECT_CAP_S)
-            events = sel.select(timeout=slice_s)
+            try:
+                events = sel.select(timeout=remain)
+            except (OSError, ValueError):
+                # A racing local close() invalidated a registered fd — that IS
+                # a state change; surface it through the predicate.
+                return ready()
             if events:
-                pair.consume_wakeup()
+                pair.consume_wakeup(role)
                 if ready():
                     return True
     finally:

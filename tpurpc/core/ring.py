@@ -294,8 +294,20 @@ class RingReader:
     # -- invariants ---------------------------------------------------------
 
     def release(self) -> None:
-        """Drop the memoryview so the underlying region (e.g. POSIX shm) can close."""
-        self.buf.release()
+        """Drop the memoryview so the underlying region (e.g. POSIX shm) can
+        close. Retries: a GIL-free spin (Pair.spin) may hold an export for ≤
+        one bounded slice."""
+        import time
+
+        deadline = time.monotonic() + 2.0
+        while True:
+            try:
+                self.buf.release()
+                return
+            except BufferError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.001)
 
     def check_empty_region(self) -> bool:
         """Debug invariant from ``ring_buffer.h:215-219``: every byte from the

@@ -177,6 +177,26 @@ class GrpcH2Connection:
         # lift the connection-level receive window too
         self._write(h2.pack_window_update(0, RECV_WINDOW - h2.DEFAULT_WINDOW))
 
+    def _send_header_block(self, sid: int, block: bytes,
+                           end_stream: bool) -> None:
+        """Emit one logical header block as HEADERS (+ CONTINUATIONs when the
+        encoded block exceeds the peer's SETTINGS_MAX_FRAME_SIZE — e.g. a large
+        trailing ``-bin`` metadata blob). END_HEADERS only on the last
+        fragment; an oversized single frame is a FRAME_SIZE_ERROR that kills
+        the whole connection on a compliant peer (RFC 7540 §4.2)."""
+        limit = self._peer_max_frame
+        es = h2.FLAG_END_STREAM if end_stream else 0
+        frags = [block[i:i + limit] for i in range(0, len(block), limit)] or [b""]
+        segs: List[bytes] = []
+        for i, frag in enumerate(frags):
+            ftype = h2.HEADERS if i == 0 else h2.CONTINUATION
+            flags = es if ftype == h2.HEADERS else 0
+            if i == len(frags) - 1:
+                flags |= h2.FLAG_END_HEADERS
+            segs.extend(h2.pack_frame(ftype, flags, sid, frag))
+        # one gather write: CONTINUATIONs must be contiguous on the wire
+        self._write(segs)
+
     def send_response_headers(self, st: _H2Stream, metadata: Metadata = ()) -> None:
         if st.headers_sent:
             return
@@ -184,8 +204,8 @@ class GrpcH2Connection:
         hdrs = [(":status", "200"), ("content-type", "application/grpc")]
         for k, v in metadata:
             hdrs.append((k.lower(), _encode_metadata_value(k.lower(), v)))
-        self._write(h2.pack_frame(h2.HEADERS, h2.FLAG_END_HEADERS,
-                                  st.stream_id, self._encoder.encode(hdrs)))
+        self._send_header_block(st.stream_id, self._encoder.encode(hdrs),
+                                end_stream=False)
 
     def send_message(self, st: _H2Stream, payload) -> None:
         if isinstance(payload, (list, tuple)):
@@ -198,7 +218,17 @@ class GrpcH2Connection:
         while pos < len(mv):
             want = min(len(mv) - pos, self._peer_max_frame)
             got = st.window.take(want, timeout=120)
-            conn_got = self._conn_window.take(got, timeout=120)
+            try:
+                conn_got = self._conn_window.take(got, timeout=120)
+            except Exception:
+                # conn-window take failed after the stream-window reservation:
+                # grant the reserved bytes back or they leak forever, then
+                # surface a status instead of dying trailers-less (a
+                # TimeoutError here is a peer that stopped granting credit).
+                st.window.grant(got)
+                raise AbortError(StatusCode.UNAVAILABLE,
+                                 "flow-control stalled: peer stopped granting "
+                                 "window credit") from None
             if conn_got < got:  # return the stream window over-reservation
                 st.window.grant(got - conn_got)
                 got = conn_got
@@ -215,9 +245,8 @@ class GrpcH2Connection:
             hdrs.append(("grpc-message", _pct_encode(details)))
         for k, v in metadata:
             hdrs.append((k.lower(), _encode_metadata_value(k.lower(), v)))
-        self._write(h2.pack_frame(
-            h2.HEADERS, h2.FLAG_END_HEADERS | h2.FLAG_END_STREAM,
-            st.stream_id, self._encoder.encode(hdrs)))
+        self._send_header_block(st.stream_id, self._encoder.encode(hdrs),
+                                end_stream=True)
 
     # -- reading -------------------------------------------------------------
 
@@ -378,7 +407,15 @@ class GrpcH2Connection:
 
     def _request_iterator(self, st: _H2Stream, deserializer, ctx):
         while True:
-            item = st.requests.get()
+            # Deadline applies while awaiting the next client message too: a
+            # client that stalls without half-closing must not pin a worker
+            # past grpc-timeout (grpcio cancels the call at deadline).
+            try:
+                item = st.requests.get(timeout=ctx.deadline_remaining())
+            except queue.Empty:
+                ctx.cancel()
+                raise AbortError(StatusCode.DEADLINE_EXCEEDED,
+                                 "deadline exceeded awaiting request") from None
             if item is _H2Stream._END:
                 return
             if not ctx.is_active():
