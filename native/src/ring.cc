@@ -1,9 +1,20 @@
 // tpurpc native data plane: framed-ring hot ops behind a C ABI (ctypes-loaded).
 //
 // Same wire format as tpurpc/core/ring.py (which re-derives the math of the
-// reference's src/core/lib/ibverbs/ring_buffer.{h,cc}):
+// reference's src/core/lib/ibverbs/ring_buffer.{h,cc}, then diverges on
+// completion detection):
 //
-//   [8B header = payload len][payload, zero-padded to 8B][8B footer = ~0]
+//   [8B header = lo32 payload len | hi32 seq32][payload, padded to 8B]
+//   [8B footer = seq64 ^ kFooterSalt]
+//
+// where seq is the per-ring monotone message counter (seq32 = its low 32
+// bits). The reference detects completion by keeping the consumed region
+// zero (reader memsets what it eats, ring_buffer.cc:122-191); that is a
+// full extra memory pass over every byte. Stamping each message with a
+// never-repeating sequence makes stale bytes self-evidently stale instead:
+// a message is complete iff header.seq32 == expected && footer == expected
+// seq64 pattern — 96 bits of freshness, no zeroing. (The peer writes every
+// ring byte either way; this is not a trust boundary.)
 //
 // capacity is a power of two >= 64; offsets are monotonically increasing
 // 64-bit counters masked on access; no 8B word ever straddles the wrap.
@@ -11,7 +22,7 @@
 // Memory model: one producer process writes, one consumer process reads over
 // shared memory. Stores are ordered payload -> footer -> header with a
 // release fence before the header store; the reader issues an acquire fence
-// after observing header!=0 && footer==~0. (The reference gets placement
+// after observing a matching header+footer. (The reference gets placement
 // order from a single RDMA WRITE; shm needs the fences spelled out.)
 
 #include <atomic>
@@ -33,8 +44,13 @@ namespace {
 constexpr uint64_t kAlign = 8;
 constexpr uint64_t kHeader = 8;
 constexpr uint64_t kFooter = 8;
-constexpr uint64_t kFooterMagic = ~0ULL;
+constexpr uint64_t kFooterSalt = 0xA5C3F00D5EEDFACEULL;
 constexpr uint64_t kReserved = kHeader + kFooter + kAlign;
+
+inline uint64_t footer_stamp(uint64_t seq) { return seq ^ kFooterSalt; }
+inline uint64_t header_stamp(uint64_t len, uint64_t seq) {
+  return (len & 0xFFFFFFFFULL) | (seq << 32);
+}
 
 inline uint64_t align_up(uint64_t n) { return (n + kAlign - 1) & ~(kAlign - 1); }
 inline uint64_t msg_span(uint64_t len) { return kHeader + align_up(len) + kFooter; }
@@ -74,70 +90,69 @@ void copy_in(uint8_t* ring, uint64_t cap, uint64_t mask, uint64_t off,
   }
 }
 
-void zero_span(uint8_t* ring, uint64_t cap, uint64_t mask, uint64_t off,
-               uint64_t n) {
-  uint64_t p = off & mask;
-  uint64_t first = cap - p;
-  if (n <= first) {
-    std::memset(ring + p, 0, n);
-  } else {
-    std::memset(ring + p, 0, first);
-    std::memset(ring, 0, n - first);
-  }
-}
-
-// Complete-message scan at `off`: payload length, 0 if none/incomplete,
-// ~0 on corruption (header exceeds max payload).
+// Complete-message scan at `off` for sequence number `seq`: payload length,
+// 0 if none/incomplete. A seq32 match with an implausible length is treated
+// as stale bytes, NOT corruption: after 2^32 messages the 32-bit stamp laps,
+// and old payload bytes (e.g. zeros, whose hi-word matches any seq ≡ 0
+// mod 2^32 — including the all-zero fresh ring at seq 0) may transiently
+// mimic a stamped header until the writer's real header lands. The 64-bit
+// footer stamp still gates actual completion.
 uint64_t message_at(const uint8_t* ring, uint64_t cap, uint64_t mask,
-                    uint64_t off) {
+                    uint64_t off, uint64_t seq) {
   uint64_t hdr = load_word(ring, mask, off);
-  if (hdr == 0) return 0;
-  if (hdr > cap - kReserved) return ~0ULL;
-  uint64_t footer = load_word(ring, mask, off + kHeader + align_up(hdr));
-  if (footer != kFooterMagic) return 0;
+  if ((hdr >> 32) != (seq & 0xFFFFFFFFULL)) return 0;  // stale or in-flight
+  uint64_t len = hdr & 0xFFFFFFFFULL;
+  if (len == 0 || len > cap - kReserved) return 0;  // stale lookalike
+  uint64_t footer = load_word(ring, mask, off + kHeader + align_up(len));
+  if (footer != footer_stamp(seq)) return 0;  // body still in flight
   std::atomic_thread_fence(std::memory_order_acquire);
-  return hdr;
+  return len;
 }
 
 }  // namespace
 
 extern "C" {
 
-int tpr_abi_version() { return 2; }
+int tpr_abi_version() { return 3; }
 
 // Total drainable payload bytes (all complete messages + pending remainder).
+// `seq` is the expected sequence of the FIRST unparsed message at/after head.
 uint64_t tpr_ring_readable(const uint8_t* ring, uint64_t cap, uint64_t head,
-                           uint64_t msg_len, uint64_t msg_read) {
+                           uint64_t msg_len, uint64_t msg_read,
+                           uint64_t seq) {
   uint64_t mask = cap - 1;
   uint64_t total = 0;
   uint64_t off = head;
-  if (msg_len) {
+  if (msg_len) {  // in-progress message carries `seq`; the next one is seq+1
     total += msg_len - msg_read;
     off += msg_span(msg_len);
+    ++seq;
   }
   uint64_t scanned = 0;
   while (scanned < cap) {
-    uint64_t ln = message_at(ring, cap, mask, off);
+    uint64_t ln = message_at(ring, cap, mask, off, seq);
     if (ln == 0 || ln == ~0ULL) break;
     total += ln;
     uint64_t sp = msg_span(ln);
     off += sp;
     scanned += sp;
+    ++seq;
   }
   return total;
 }
 
 // Drain up to dst_len payload bytes. Returns bytes read, or ~0 on corruption.
-// head/msg_len/msg_read/consumed are caller state, updated in place.
+// head/msg_len/msg_read/consumed/seq are caller state, updated in place.
+// No zeroing of consumed spans: freshness comes from the seq stamps.
 uint64_t tpr_ring_read_into(uint8_t* ring, uint64_t cap, uint64_t* head,
                             uint64_t* msg_len, uint64_t* msg_read,
                             uint8_t* dst, uint64_t dst_len,
-                            uint64_t* consumed) {
+                            uint64_t* consumed, uint64_t* seq) {
   uint64_t mask = cap - 1;
   uint64_t total = 0;
   while (total < dst_len) {
     if (*msg_len == 0) {
-      uint64_t ln = message_at(ring, cap, mask, *head);
+      uint64_t ln = message_at(ring, cap, mask, *head, *seq);
       if (ln == ~0ULL) return ~0ULL;
       if (ln == 0) break;
       *msg_len = ln;
@@ -151,22 +166,23 @@ uint64_t tpr_ring_read_into(uint8_t* ring, uint64_t cap, uint64_t* head,
     total += n;
     if (*msg_read == *msg_len) {
       uint64_t sp = msg_span(*msg_len);
-      zero_span(ring, cap, mask, *head, sp);
       *head += sp;
       *consumed += sp;
       *msg_len = 0;
       *msg_read = 0;
+      ++*seq;
     }
   }
   return total;
 }
 
-// Gather-encode one message at *tail (payload -> footer -> fence -> header).
-// Returns payload bytes written, or ~0 if it doesn't fit the writable span.
+// Gather-encode one message at *tail (payload -> footer -> fence -> header),
+// stamped with *seq. Returns payload bytes written, or ~0 if it doesn't fit
+// the writable span.
 uint64_t tpr_ring_writev(uint8_t* ring, uint64_t cap, uint64_t* tail,
                          uint64_t remote_head,
                          const uint8_t* const* segs, const uint64_t* lens,
-                         uint32_t nsegs) {
+                         uint32_t nsegs, uint64_t* seq) {
   uint64_t mask = cap - 1;
   uint64_t payload = 0;
   for (uint32_t i = 0; i < nsegs; ++i) payload += lens[i];
@@ -179,18 +195,20 @@ uint64_t tpr_ring_writev(uint8_t* ring, uint64_t cap, uint64_t* tail,
     copy_in(ring, cap, mask, off, segs[i], lens[i]);
     off += lens[i];
   }
-  store_word(ring, mask, *tail + kHeader + align_up(payload), kFooterMagic);
+  store_word(ring, mask, *tail + kHeader + align_up(payload),
+             footer_stamp(*seq));
   std::atomic_thread_fence(std::memory_order_release);
-  store_word(ring, mask, *tail, payload);
+  store_word(ring, mask, *tail, header_stamp(payload, *seq));
   *tail += msg_span(payload);
+  ++*seq;
   return payload;
 }
 
 // Has a complete message? (poller fast check; 1 = yes, 0 = no, -1 corruption)
 int tpr_ring_has_message(const uint8_t* ring, uint64_t cap, uint64_t head,
-                         uint64_t msg_len) {
+                         uint64_t msg_len, uint64_t seq) {
   if (msg_len) return 1;
-  uint64_t ln = message_at(ring, cap, cap - 1, head);
+  uint64_t ln = message_at(ring, cap, cap - 1, head, seq);
   if (ln == ~0ULL) return -1;
   return ln != 0 ? 1 : 0;
 }
@@ -214,11 +232,11 @@ inline uint64_t now_ns() {
 // timeout (0). The watched words live in this side's OWN receive ring, whose
 // lifetime the caller pins for the duration of the call.
 int tpr_ring_wait_message(const uint8_t* ring, uint64_t cap, uint64_t head,
-                          uint64_t timeout_us) {
+                          uint64_t seq, uint64_t timeout_us) {
   uint64_t mask = cap - 1;
   uint64_t deadline = now_ns() + timeout_us * 1000ULL;
   for (;;) {
-    uint64_t ln = message_at(ring, cap, mask, head);
+    uint64_t ln = message_at(ring, cap, mask, head, seq);
     if (ln == ~0ULL) return -1;
     if (ln != 0) return 1;
     for (int i = 0; i < 64; ++i) TPR_PAUSE();

@@ -4,6 +4,12 @@ The invariants tested here are the ones the reference enforces with asserts in
 ``src/core/lib/ibverbs/ring_buffer.cc`` (footer checks :144-145,179; power-of-two :22;
 ``check_empty`` ``ring_buffer.h:215-219``) plus stream-integrity fuzzing the reference
 never had (SURVEY.md §4 notes it ships no RDMA unit tests — we do better).
+
+tpurpc's framing diverges from the reference on completion detection: messages
+are sequence-stamped (header ``[u32 len|u32 seq32]``, footer ``seq64^SALT``)
+instead of relying on a zeroed consumed region, eliminating the reference's
+memset of every consumed byte (``ring_buffer.cc:122-191``). The staleness
+tests below pin down that replacement invariant.
 """
 
 import random
@@ -68,22 +74,52 @@ def test_incomplete_message_not_visible():
     reader, writer = make_pipe()
     buf = reader.buf
     payload = b"x" * 16
-    # footer at 8+16, header withheld
+    # footer at 8+16 (stamped for seq 0), header withheld
     buf[8:24] = payload
-    buf[24:32] = b"\xff" * 8
+    buf[24:32] = R.footer_stamp(0).to_bytes(8, "little")
     assert not reader.has_message()
     assert reader.read(100) == b""
     # header arrives last → message becomes visible atomically
-    buf[0:8] = (16).to_bytes(8, "little")
+    buf[0:8] = R.header_stamp(16, 0).to_bytes(8, "little")
     assert reader.has_message()
     assert reader.read(100) == payload
 
 
-def test_zeroed_after_consume():
+def test_stale_bytes_never_look_like_messages():
+    """The seq-framing replacement for the reference's zero-on-consume
+    invariant: after a message is consumed its bytes REMAIN in the ring, and
+    the reader must not re-parse them as a new message (the old protocol
+    guaranteed this by memsetting the span; ours by the sequence stamp)."""
     reader, writer = make_pipe(256)
     writer.write(b"a" * 100)
-    reader.read(100)
-    assert bytes(reader.buf) == b"\x00" * 256
+    assert reader.read(100) == b"a" * 100
+    # consumed span is NOT zeroed (that's the point — no extra memory pass)...
+    assert bytes(reader.buf) != b"\x00" * 256
+    # ...but nothing at head parses as a message
+    assert not reader.has_message()
+    assert reader.readable() == 0
+    assert reader.read(100) == b""
+    # and a genuine next message is still recognized
+    writer.write(b"b" * 10)
+    assert reader.read(100) == b"b" * 10
+
+
+def test_forged_stale_header_rejected_across_wrap():
+    """A payload that embeds a byte pattern identical to a valid OLD header/
+    footer must not fool the reader after the ring wraps over it."""
+    reader, writer = make_pipe(256)
+    # Message whose payload IS a forged copy of a seq-0 header+footer pair.
+    forged = (R.header_stamp(8, 2).to_bytes(8, "little") + b"E" * 8 +
+              R.footer_stamp(2).to_bytes(8, "little"))
+    writer.write(forged)
+    assert reader.read(100) == forged
+    # Ring now holds stale bytes that literally spell a stamped message for
+    # seq 2; the reader expects seq 1 next, at a different offset — nothing
+    # should surface without a genuine write.
+    assert not reader.has_message()
+    assert reader.read(100) == b""
+    writer.write(b"ok")
+    assert reader.read(100) == b"ok"
 
 
 def test_partial_read_resumption():
@@ -137,11 +173,17 @@ def test_credit_not_published_below_half_ring():
     assert not reader.should_publish_head()  # 100+16 < 512
 
 
-def test_corrupt_header_detected():
+def test_implausible_header_treated_as_stale():
+    """A seq-matching header with an impossible length is a stale lookalike
+    (possible after the 32-bit stamp laps), not a parsed message and not a
+    connection-killing corruption."""
     reader, writer = make_pipe(256)
-    reader.buf[0:8] = (10**6).to_bytes(8, "little")  # way beyond max payload
-    with pytest.raises(R.RingCorruption):
-        reader.has_message()
+    reader.buf[0:8] = R.header_stamp(10**6, 0).to_bytes(8, "little")
+    assert not reader.has_message()
+    assert reader.read(100) == b""
+    # the genuine message overwrites the lookalike and parses normally
+    writer.write(b"real")
+    assert reader.read(100) == b"real"
 
 
 def test_credit_regression_detected():
@@ -188,8 +230,8 @@ def test_wrap_heavy_stream_fuzz():
     received += reader.read(1 << 20)
     assert bytes(received) == bytes(sent)
     assert reader.readable() == 0
-    # zero-on-consume invariant holds for the whole buffer once fully drained
-    assert bytes(reader.buf) == b"\x00" * 512
+    # stale bytes remain (no zeroing pass) yet nothing parses as a message
+    assert reader.check_empty_region()
 
 
 def test_max_payload_message_exact_fit():

@@ -19,7 +19,7 @@ _LIB: "Optional[ctypes.CDLL]" = None
 _SPIN: "Optional[ctypes.CDLL]" = None
 _TRIED = False
 
-ABI_VERSION = 2
+ABI_VERSION = 3
 
 
 def _lib_path() -> str:
@@ -54,16 +54,16 @@ def load() -> "Optional[ctypes.CDLL]":
     pu64 = ctypes.POINTER(u64)
     pu8 = ctypes.c_void_p
     lib.tpr_ring_readable.restype = u64
-    lib.tpr_ring_readable.argtypes = [pu8, u64, u64, u64, u64]
+    lib.tpr_ring_readable.argtypes = [pu8, u64, u64, u64, u64, u64]
     lib.tpr_ring_read_into.restype = u64
     lib.tpr_ring_read_into.argtypes = [pu8, u64, pu64, pu64, pu64, pu8, u64,
-                                       pu64]
+                                       pu64, pu64]
     lib.tpr_ring_writev.restype = u64
     lib.tpr_ring_writev.argtypes = [pu8, u64, pu64, u64,
                                     ctypes.POINTER(ctypes.c_void_p),
-                                    pu64, ctypes.c_uint32]
+                                    pu64, ctypes.c_uint32, pu64]
     lib.tpr_ring_has_message.restype = ctypes.c_int
-    lib.tpr_ring_has_message.argtypes = [pu8, u64, u64, u64]
+    lib.tpr_ring_has_message.argtypes = [pu8, u64, u64, u64, u64]
     _LIB = lib
 
     # Second handle via CDLL: these calls RELEASE the GIL — they are the
@@ -73,7 +73,7 @@ def load() -> "Optional[ctypes.CDLL]":
     # call; Region.close retries on BufferError until waiters unpin.
     spin = ctypes.CDLL(path)
     spin.tpr_ring_wait_message.restype = ctypes.c_int
-    spin.tpr_ring_wait_message.argtypes = [pu8, u64, u64, u64]
+    spin.tpr_ring_wait_message.argtypes = [pu8, u64, u64, u64, u64]
     spin.tpr_spin_u64_change.restype = ctypes.c_int
     spin.tpr_spin_u64_change.argtypes = [pu8, u64, u64]
     global _SPIN

@@ -380,6 +380,10 @@ class Pair:
         #: pipes; the writer's pipe byte can only be consumed by the writer).
         self._wake_r: Dict[str, int] = {"read": -1, "write": -1}
         self._wake_w: Dict[str, int] = {"read": -1, "write": -1}
+        #: persistent per-role selectors (epoll fd reused across waits — a
+        #: fresh DefaultSelector per wait is 5 syscalls of pure overhead on
+        #: the small-RPC path)
+        self._selectors: Dict[str, object] = {}
 
         self._send_guard = ContentAssertion("Pair.send")
         self._recv_guard = ContentAssertion("Pair.recv")
@@ -389,6 +393,16 @@ class Pair:
         # monotonic counters (ref: per-pair live counters, pair.h:235-270)
         self.total_sent = 0
         self.total_recv = 0
+
+        # Eager/inline receive plan: the notify channel carries typed records
+        # in FIFO order — ring-data grants, inline payloads, credit/exit
+        # hints — and the plan is the in-order queue of consumable byte
+        # sources built from them (see class docstring, "inline sends").
+        self._rx_plan: "List[list]" = []  # [kind, value] entries
+        self._rx_buf = bytearray()        # partial-record assembly
+        self._rx_lock = threading.Lock()
+        self._notify_lock = threading.Lock()  # serializes notify-socket writes
+        self.inline_threshold = 0         # set at init() from config
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -412,6 +426,18 @@ class Pair:
         self._published_head_mirror = 0
         self.error = None
         self.want_write = False
+        self._rx_plan = []
+        self._rx_buf = bytearray()
+        # Inline sends ride the notify socket; they help exactly where
+        # spin-free wakeups are the read path (event discipline, or any
+        # discipline degraded to event on a single-CPU host). Under busy/
+        # hybrid with real cores the native ring spin is faster than a socket
+        # round trip, so small messages stay on the ring there.
+        discipline = cfg.platform.discipline
+        if discipline == "event" or _effective_cpus() < 2:
+            self.inline_threshold = cfg.inline_threshold
+        else:
+            self.inline_threshold = 0
         for role in ("read", "write"):
             r, w = os.pipe()
             os.set_blocking(r, False)
@@ -499,7 +525,7 @@ class Pair:
         out = b""
         while True:
             try:
-                chunk = sock.recv(4096)
+                chunk = sock.recv(65536)
             except (BlockingIOError, InterruptedError):
                 break
             except OSError:
@@ -509,6 +535,8 @@ class Pair:
                 self._on_notify_closed()
                 break
             out += chunk
+            if len(chunk) < 65536:
+                break  # drained; skip the guaranteed-EAGAIN second recv
         return out
 
     def _on_notify_closed(self) -> None:
@@ -552,7 +580,7 @@ class Pair:
     def wakeup_fd_for(self, role: str) -> int:
         return self._wake_r[role]
 
-    def kick(self) -> None:
+    def kick(self, exclude: Optional[str] = None) -> None:
         """Wake every blocked waiter on this pair (``poller.cc:92-101`` writing
         the pair's ``grpc_wakeup_fd``).
 
@@ -562,8 +590,15 @@ class Pair:
         concurrent kicks — a lost wakeup the old 50 ms select cap papered
         over. A redundant byte in a pipe is free; a suppressed kick is a
         stall. EAGAIN on a full pipe means a byte is already pending, which
-        is exactly the required post-condition."""
+        is exactly the required post-condition.
+
+        ``exclude`` skips one role's pipe: a waiter that just drained shared
+        notify tokens re-checks its own predicate immediately, so kicking
+        itself only buys a guaranteed spurious wake (an extra select+consume
+        round per RPC, measured on the 64B path)."""
         for role in ("read", "write"):
+            if role == exclude:
+                continue
             fd = self._wake_w[role]
             if fd >= 0:
                 try:
@@ -580,6 +615,27 @@ class Pair:
                 pass
         except (BlockingIOError, OSError):
             pass
+
+    def waiter_selector(self, role: str):
+        """The role's persistent selector over (notify socket, role pipe);
+        created lazily, lives until the connection's channels are released.
+        Only the role's single waiter thread touches it (ContentAssertion
+        enforces one reader + one writer)."""
+        import selectors
+
+        sel = self._selectors.get(role)
+        if sel is None:
+            sel = selectors.DefaultSelector()
+            try:
+                if self.notify_sock is not None:
+                    sel.register(self.notify_sock, selectors.EVENT_READ)
+                fd = self._wake_r[role]
+                if fd >= 0:
+                    sel.register(fd, selectors.EVENT_READ)
+            except (OSError, ValueError, KeyError):
+                pass  # racing close; the waiter's predicate re-check handles it
+            self._selectors[role] = sel
+        return sel
 
     # -- status / credits -----------------------------------------------------
 
@@ -753,7 +809,7 @@ class Pair:
                 return True  # ring released under us; predicate will surface it
             r = spin.tpr_ring_wait_message(
                 arr.ctypes.data, reader.layout.capacity, reader.head,
-                timeout_us)
+                reader.seq, timeout_us)
             return r != 0
         region = self.status_region
         writer = self.writer
@@ -815,6 +871,12 @@ class Pair:
         that races the close itself gets EBADF from select, which _wait treats
         as a state-change wakeup."""
         self.kick()
+        sels, self._selectors = self._selectors, {}
+        for sel in sels.values():
+            try:
+                sel.close()
+            except OSError:
+                pass
         if self.reader is not None:
             self.reader.release()
             self.reader = None

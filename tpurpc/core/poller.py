@@ -107,14 +107,15 @@ class Poller:
     # -- the scan loop (poller.cc:52-106) --------------------------------------
 
     def _run(self) -> None:
-        # Adaptive cadence: the reference busy-spins its poller on a DEDICATED
-        # core (poller.cc:52-106); on shared cores a hot scan loop starves the
-        # data-plane threads it exists to wake (measured: ~15% of all stack
-        # samples on a 1-CPU host). Since every send carries a notify token
-        # and kicks are per-role-pipe lossless, the poller is a safety net —
-        # scan hot only while pairs actually need attention, back off to a
-        # millisecond cadence when quiet.
-        idle_rounds = 0
+        # Watchdog cadence, NOT a busy scan. The reference busy-spins its
+        # poller on a DEDICATED core because its one-sided NIC writes carry
+        # no events at all (poller.cc:52-106); tpurpc's domains deliver a
+        # notify token on every send/credit-publish, and kicks are per-role-
+        # pipe lossless, so waiters are woken by tokens in the common path.
+        # The poller's job is recovery from pathological token loss — a fixed
+        # millisecond heartbeat bounds that recovery without stealing the
+        # core from the data-plane threads (a hot scan measured ~15-25% of
+        # wall time on a 1-CPU host).
         while True:
             with self._cv:
                 if not self._running:
@@ -123,24 +124,15 @@ class Poller:
                     self._cv.wait(timeout=self.sleep_timeout_s)
                     continue
                 snapshot = [p for p in self._pairs if p is not None]
-            any_hot = False
             for pair in snapshot:
                 try:
                     if self._scan_edges(pair):
-                        any_hot = True
                         pair.kick()
                 except Exception:
                     # A dying pair must never take the poller down; kick so the
                     # owner observes the error state.
                     pair.kick()
-            if any_hot:
-                idle_rounds = 0
-                if self.polling_yield:
-                    time.sleep(0)  # GRPC_RDMA_POLLING_YIELD (rdma_utils.h:75-80)
-            else:
-                idle_rounds += 1
-                time.sleep(0 if idle_rounds < 4 else
-                           min(0.001 * (1 << min(idle_rounds - 4, 4)), 0.016))
+            time.sleep(0.001)
 
     @staticmethod
     def _needs_attention(pair: Pair) -> bool:
@@ -237,11 +229,12 @@ def _wait(pair: Pair, timeout: Optional[float], discipline: Optional[str],
 
     def ready() -> bool:
         if pair.drain_notifications():
-            # We may have consumed a token another waiter (full-duplex: the write
-            # side of the same endpoint) was blocked on — kick BOTH role pipes so
-            # every fd-waiter re-checks; each role consumes only its own pipe, so
-            # this broadcast cannot itself be stolen.
-            pair.kick()
+            # We may have consumed a token another waiter (full-duplex: the
+            # write side of the same endpoint) was blocked on — kick the
+            # OTHER role's pipe so it re-checks (each role consumes only its
+            # own pipe, so the broadcast cannot be stolen; our own predicate
+            # is checked right below, no self-kick needed).
+            pair.kick(exclude=role)
         return predicate()
 
     deadline = None if timeout is None else time.monotonic() + timeout
@@ -286,32 +279,30 @@ def _wait(pair: Pair, timeout: Optional[float], discipline: Optional[str],
     # broadcast). No cap on the select: every state transition is followed by
     # a token (peer) or a kick (poller / token-drainer), and the per-role pipe
     # means no other thread can consume our wakeup between our predicate check
-    # and the select — the race the old 50 ms cap papered over.
-    sel = selectors.DefaultSelector()
-    try:
-        if pair.notify_sock is not None:
-            sel.register(pair.notify_sock, selectors.EVENT_READ)
-        wfd = pair.wakeup_fd_for(role)
-        if wfd >= 0:
-            sel.register(wfd, selectors.EVENT_READ)
-        while True:
+    # and the select — the race the old 50 ms cap papered over. The selector
+    # is persistent per (pair, role): rebuilding epoll state every wait is 5
+    # syscalls of overhead per small RPC.
+    sel = pair.waiter_selector(role)
+    if not sel.get_map():
+        # nothing registerable — the pair's channels are (being) released;
+        # never block on an empty selector
+        return ready()
+    while True:
+        if ready():
+            return True
+        remain = None if deadline is None else deadline - time.monotonic()
+        if remain is not None and remain <= 0:
+            return ready()
+        try:
+            events = sel.select(timeout=remain)
+        except (OSError, ValueError):
+            # A racing local close() invalidated a registered fd — that IS
+            # a state change; surface it through the predicate.
+            return ready()
+        if events:
+            pair.consume_wakeup(role)
             if ready():
                 return True
-            remain = None if deadline is None else deadline - time.monotonic()
-            if remain is not None and remain <= 0:
-                return ready()
-            try:
-                events = sel.select(timeout=remain)
-            except (OSError, ValueError):
-                # A racing local close() invalidated a registered fd — that IS
-                # a state change; surface it through the predicate.
-                return ready()
-            if events:
-                pair.consume_wakeup(role)
-                if ready():
-                    return True
-    finally:
-        sel.close()
 
 
 class PairPool:

@@ -10,13 +10,18 @@ This is a reimplementation of the *math* of the reference's
   All fields start 8-byte aligned, and the ring capacity is a power of two ≥ 64, so no
   64-bit word ever straddles the wrap point.
 
-* **Completion detection** (``ring_buffer.cc:56-97``): the consumed region of the ring is
-  always zero (the reader zeroes what it eats; the buffer starts zeroed), so a non-zero
-  header word means "a message starts here".  The message is *complete* only when the
-  footer word at its computed end is all-ones.  The producer writes payload → footer →
-  header in that order, so a reader that observes header≠0 ∧ footer==~0 is guaranteed an
-  intact payload on any total-store-order host (the reference gets the same guarantee
-  from the NIC's in-order placement of a single RDMA WRITE).
+* **Completion detection** — deliberately different from the reference
+  (``ring_buffer.cc:56-97``). The reference keeps the consumed region zero (the reader
+  memsets every byte it eats, ``ring_buffer.cc:122-191``) so that "header word ≠ 0"
+  means "message starts here"; that zeroing is a full extra memory pass over all
+  traffic. tpurpc stamps each message with the ring's monotone sequence number
+  instead: header = ``[u32 len | u32 seq32]``, footer = ``seq64 ^ SALT``.  A message
+  is complete iff the header's seq32 matches the reader's expected sequence AND the
+  footer carries the expected 64-bit stamp — 96 bits of freshness, so stale bytes
+  from previous wraps are self-evidently stale and nothing is ever zeroed.  The
+  producer still writes payload → footer → header with a release fence before the
+  header store (the reference gets the same guarantee from the NIC's in-order
+  placement of a single RDMA WRITE).
 
 * **Partial reads** (``ring_buffer.cc:122-191``, ``remain_``/``moving_head_``): a reader
   may drain fewer bytes than a message holds; progress is carried across calls, and the
@@ -50,12 +55,22 @@ from tpurpc.tpu import ledger
 ALIGN = 8
 HEADER_BYTES = 8
 FOOTER_BYTES = 8
-FOOTER_MAGIC = 0xFFFFFFFFFFFFFFFF
+#: Salt in the footer stamp (must match native/src/ring.cc kFooterSalt).
+FOOTER_SALT = 0xA5C3F00D5EEDFACE
 #: Reserved slack the writer never fills: header + footer + one 8B gap
 #: (``ring_buffer.h:185-189`` reserves the same 3×8B).
 RESERVED_BYTES = HEADER_BYTES + FOOTER_BYTES + ALIGN
 
 _U64 = struct.Struct("<Q")
+_U64_MASK = (1 << 64) - 1
+
+
+def footer_stamp(seq: int) -> int:
+    return (seq ^ FOOTER_SALT) & _U64_MASK
+
+
+def header_stamp(length: int, seq: int) -> int:
+    return (length & 0xFFFFFFFF) | ((seq & 0xFFFFFFFF) << 32)
 
 
 def align_up(n: int) -> int:
@@ -112,6 +127,7 @@ class RingReader:
             raise ValueError("buffer smaller than declared capacity")
         self.layout = RingLayout(cap)
         self.head = 0  # absolute; phys offset = head & mask
+        self.seq = 0   # sequence expected of the next unparsed message
         # Partial-read state (reference remain_/moving_head_, ring_buffer.cc:168-183).
         self._msg_len = 0        # payload length of the in-progress message (0 = none)
         self._msg_read = 0       # payload bytes already handed to the app
@@ -133,23 +149,28 @@ class RingReader:
         p = self.layout.phys(abs_off)
         return _U64.unpack_from(self.buf, p)[0]
 
-    def _message_at(self, abs_off: int) -> int:
-        """Payload length of the complete message starting at abs_off, else 0.
+    def _message_at(self, abs_off: int, seq: int) -> int:
+        """Payload length of the complete message stamped ``seq`` starting at
+        abs_off, else 0.
 
-        Mirrors ``HasMessage``/``GetReadableSize`` (``ring_buffer.cc:56-97``): header
-        word non-zero AND footer word all-ones.
-        """
+        Role of ``HasMessage``/``GetReadableSize`` (``ring_buffer.cc:56-97``),
+        reworked for sequence-stamped framing: complete iff the header's
+        seq32 matches AND the footer carries the 64-bit stamp (see module
+        docstring)."""
         hdr = self._word(abs_off)
-        if hdr == 0:
+        if (hdr >> 32) != (seq & 0xFFFFFFFF):
+            return 0  # stale bytes or header not yet placed
+        ln = hdr & 0xFFFFFFFF
+        if ln == 0 or ln > self.layout.max_payload():
+            # Stale lookalike, not corruption: zeros (fresh ring / zero
+            # payloads) match any seq ≡ 0 mod 2^32, and after the 32-bit
+            # stamp laps, old payload bytes may transiently mimic a header.
+            # The 64-bit footer stamp still gates completion.
             return 0
-        if hdr > self.layout.max_payload():
-            raise RingCorruption(
-                f"header {hdr} exceeds max payload {self.layout.max_payload()} "
-                f"at offset {self.layout.phys(abs_off)}")
-        footer_off = abs_off + HEADER_BYTES + align_up(hdr)
-        if self._word(footer_off) != FOOTER_MAGIC:
+        footer_off = abs_off + HEADER_BYTES + align_up(ln)
+        if self._word(footer_off) != footer_stamp(seq):
             return 0  # body still in flight
-        return hdr
+        return ln
 
     def _alive(self) -> bool:
         """buf still mapped? (GIL held from here through the native call, so a
@@ -167,13 +188,14 @@ class RingReader:
             if not self._alive():
                 raise RingCorruption("ring memory released")
             r = self._nat.tpr_ring_has_message(
-                self._nat_addr, self.layout.capacity, self.head, self._msg_len)
+                self._nat_addr, self.layout.capacity, self.head,
+                self._msg_len, self.seq)
             if r < 0:
                 raise RingCorruption(
-                    f"header exceeds max payload at offset "
+                    f"invalid header length at offset "
                     f"{self.layout.phys(self.head)}")
             return bool(r)
-        return self._message_at(self.head) != 0
+        return self._message_at(self.head, self.seq) != 0
 
     def readable(self) -> int:
         """Total payload bytes currently drainable (all complete messages).
@@ -186,21 +208,24 @@ class RingReader:
                 raise RingCorruption("ring memory released")
             return self._nat.tpr_ring_readable(
                 self._nat_addr, self.layout.capacity, self.head,
-                self._msg_len, self._msg_read)
+                self._msg_len, self._msg_read, self.seq)
         total = 0
         off = self.head
-        if self._msg_len:
+        seq = self.seq
+        if self._msg_len:  # in-progress message carries seq; next one is seq+1
             total += self._msg_len - self._msg_read
             off += message_span(self._msg_len)
+            seq += 1
         scanned = 0
         while scanned < self.layout.capacity:
-            ln = self._message_at(off)
+            ln = self._message_at(off, seq)
             if ln == 0:
                 break
             total += ln
             span = message_span(ln)
             off += span
             scanned += span
+            seq += 1
         return total
 
     # -- draining -----------------------------------------------------------
@@ -210,15 +235,13 @@ class RingReader:
             dst[dst_off:dst_off + seg_len] = self.buf[seg_off:seg_off + seg_len]
             dst_off += seg_len
 
-    def _zero(self, abs_off: int, n: int) -> None:
-        for seg_off, seg_len in self.layout.segments(abs_off, n):
-            self.buf[seg_off:seg_off + seg_len] = b"\x00" * seg_len
-
     def read_into(self, dst) -> int:
         """Drain up to ``len(dst)`` payload bytes; returns the count actually read.
 
-        Handles message-at-a-time consumption, partial-message resumption, and the
-        zero-on-consume invariant (``ring_buffer.cc:122-191``).
+        Handles message-at-a-time consumption and partial-message resumption
+        (``ring_buffer.cc:122-191``). Unlike the reference, consumed spans are
+        NOT zeroed — freshness comes from the sequence stamps (module
+        docstring), saving a full memory pass per byte of traffic.
         """
         dst = memoryview(dst)
         if dst.readonly:
@@ -229,7 +252,7 @@ class RingReader:
         total = 0
         while total < len(dst):
             if self._msg_len == 0:
-                ln = self._message_at(self.head)
+                ln = self._message_at(self.head, self.seq)
                 if ln == 0:
                     break
                 self._msg_len = ln
@@ -241,11 +264,11 @@ class RingReader:
             total += n
             if self._msg_read == self._msg_len:
                 span = message_span(self._msg_len)
-                self._zero(self.head, span)
                 self.head += span
                 self.consumed_since_publish += span
                 self._msg_len = 0
                 self._msg_read = 0
+                self.seq += 1
         ledger.host_copy(total)
         return total
 
@@ -256,19 +279,21 @@ class RingReader:
         msg_len = ctypes.c_uint64(self._msg_len)
         msg_read = ctypes.c_uint64(self._msg_read)
         consumed = ctypes.c_uint64(self.consumed_since_publish)
+        seq = ctypes.c_uint64(self.seq)
         n = self._nat.tpr_ring_read_into(
             self._nat_addr, self.layout.capacity,
             ctypes.byref(head), ctypes.byref(msg_len), ctypes.byref(msg_read),
             _native.addr_of(dst, writable=True), len(dst),
-            ctypes.byref(consumed))
+            ctypes.byref(consumed), ctypes.byref(seq))
         if n == 0xFFFFFFFFFFFFFFFF:
             raise RingCorruption(
-                f"header exceeds max payload at offset "
+                f"invalid header length at offset "
                 f"{self.layout.phys(head.value)}")
         self.head = head.value
         self._msg_len = msg_len.value
         self._msg_read = msg_read.value
         self.consumed_since_publish = consumed.value
+        self.seq = seq.value
         ledger.host_copy(n)
         return n
 
@@ -281,10 +306,19 @@ class RingReader:
 
     # -- credits ------------------------------------------------------------
 
+    #: Credit-publish threshold divisor. The reference publishes after half
+    #: the ring (``pair.cc:276-284``) because each credit return is an RDMA
+    #: write worth amortizing; tpurpc's credit is an 8-byte shm store + one
+    #: token, so finer quanta (capacity/4) buy pipelining — the stalled
+    #: writer resumes while the reader still drains — at negligible cost.
+    PUBLISH_DIVISOR = 4
+
     def should_publish_head(self) -> bool:
-        """True once ≥ half the ring has been consumed since the last publish
-        (the reference's credit-return rule, ``pair.cc:276-284``)."""
-        return self.consumed_since_publish >= self.layout.capacity // 2
+        """True once capacity/PUBLISH_DIVISOR has been consumed since the
+        last publish (the reference's credit-return rule, ``pair.cc:276-284``,
+        with a finer default quantum — see PUBLISH_DIVISOR)."""
+        return (self.consumed_since_publish
+                >= self.layout.capacity // self.PUBLISH_DIVISOR)
 
     def take_publish(self) -> int:
         """Consume the pending credit and return the head value to publish."""
@@ -310,11 +344,13 @@ class RingReader:
                 time.sleep(0.001)
 
     def check_empty_region(self) -> bool:
-        """Debug invariant from ``ring_buffer.h:215-219``: every byte from the
-        current head to the next unwritten area that is *not* part of a pending
-        message must be zero.  Cheap version: if no message is pending, the word at
-        head must be zero."""
-        return self._msg_len != 0 or self.has_message() or self._word(self.head) in (0,)
+        """Debug invariant (role of ``ring_buffer.h:215-219``'s check_empty,
+        adapted to seq framing): if no message is pending, the header word at
+        head must NOT already carry the expected sequence stamp with a bad
+        body — i.e. the position is either stale bytes or a complete message."""
+        if self._msg_len != 0 or self.has_message():
+            return True
+        return (self._word(self.head) >> 32) != (self.seq & 0xFFFFFFFF)
 
 
 class RingCorruption(RuntimeError):
@@ -339,6 +375,7 @@ class RingWriter:
         self.layout = RingLayout(capacity)
         self.write_fn = write_fn
         self.tail = 0         # absolute count of ring bytes ever written
+        self.seq = 0          # sequence stamp of the next message
         self.remote_head = 0  # mirrored consumer head (credits)
         # Native gather-encode straight into the mapped peer ring (shm window);
         # transports whose placement is a callback (TPU DMA) stay on write_fn.
@@ -411,11 +448,12 @@ class RingWriter:
         for v in views:
             self._put(off, v)
             off += len(v)
-        # Padding bytes are already zero (consumed-region invariant) — never written.
+        # Padding bytes are never validated — no need to write them.
         footer_off = self.tail + HEADER_BYTES + align_up(payload_len)
-        self._put(footer_off, _U64.pack(FOOTER_MAGIC))
-        self._put(self.tail, _U64.pack(payload_len))
+        self._put(footer_off, _U64.pack(footer_stamp(self.seq)))
+        self._put(self.tail, _U64.pack(header_stamp(payload_len, self.seq)))
         self.tail += message_span(payload_len)
+        self.seq += 1
         return payload_len
 
 
@@ -430,12 +468,14 @@ class RingWriter:
             *[_native.addr_of(v, writable=False) for v in views])
         seg_lens = (ctypes.c_uint64 * n)(*[len(v) for v in views])
         tail = ctypes.c_uint64(self.tail)
+        seq = ctypes.c_uint64(self.seq)
         got = self._nat.tpr_ring_writev(
             self._nat_addr, self.layout.capacity, ctypes.byref(tail),
-            self.remote_head, seg_ptrs, seg_lens, n)
+            self.remote_head, seg_ptrs, seg_lens, n, ctypes.byref(seq))
         if got == 0xFFFFFFFFFFFFFFFF:
             raise RingFull(payload_len, self.writable_payload())
         self.tail = tail.value
+        self.seq = seq.value
         ledger.host_copy(got)
         return got
 

@@ -454,6 +454,9 @@ class Call:
         return self.messages()
 
 
+_NO_REQUEST = object()
+
+
 class _MultiCallable:
     def __init__(self, channel: Channel, method: str,
                  serializer: Serializer, deserializer: Deserializer):
@@ -463,15 +466,26 @@ class _MultiCallable:
         self._deser = deserializer
 
     def _start(self, metadata: Optional[Metadata],
-               timeout: Optional[float]) -> Tuple[_Connection, _ClientStream, Call]:
+               timeout: Optional[float],
+               first_request=_NO_REQUEST) -> Tuple[_Connection, _ClientStream, Call]:
+        """Open a stream and send HEADERS — fused with the first (only)
+        MESSAGE when the request is known upfront, so a unary call costs one
+        transport write/notify instead of two."""
         conn = self._channel._connection()
         try:
             st = conn.open_stream()
             deadline = None if timeout is None else time.monotonic() + timeout
             timeout_us = None if timeout is None else max(0, int(timeout * 1e6))
-            conn.writer.send(fr.HEADERS, 0, st.stream_id,
-                             fr.headers_payload(self._method, metadata or (),
-                                                timeout_us))
+            hdr_payload = fr.headers_payload(self._method, metadata or (),
+                                             timeout_us)
+            if first_request is _NO_REQUEST:
+                conn.writer.send(fr.HEADERS, 0, st.stream_id, hdr_payload)
+            else:
+                conn.writer.send_many([
+                    (fr.HEADERS, 0, st.stream_id, hdr_payload),
+                    (fr.MESSAGE, fr.FLAG_END_STREAM, st.stream_id,
+                     self._ser(first_request)),
+                ])
         except fr.FrameError as exc:
             conn.close_stream(st)
             raise RpcError(StatusCode.RESOURCE_EXHAUSTED, str(exc)) from exc
@@ -525,8 +539,7 @@ class UnaryUnary(_MultiCallable):
 
     def with_call(self, request, timeout: Optional[float] = None,
                   metadata: Optional[Metadata] = None):
-        conn, st, call = self._start(metadata, timeout)
-        self._send_one(conn, st, request, end_stream=True)
+        conn, st, call = self._start(metadata, timeout, first_request=request)
         response = None
         got = False
         for msg in call.messages():
@@ -561,8 +574,7 @@ class UnaryUnary(_MultiCallable):
 class UnaryStream(_MultiCallable):
     def __call__(self, request, timeout: Optional[float] = None,
                  metadata: Optional[Metadata] = None) -> Call:
-        conn, st, call = self._start(metadata, timeout)
-        self._send_one(conn, st, request, end_stream=True)
+        conn, st, call = self._start(metadata, timeout, first_request=request)
         return call
 
 

@@ -255,6 +255,38 @@ class FrameWriter:
                 self._ep.write(
                     [HEADER_FMT.pack(MESSAGE, fl, stream_id, n)] + frame_segs)
 
+    def send_many(self, frames: Sequence[Tuple[int, int, int, "bytes | Sequence"]]
+                  ) -> None:
+        """Write several logical frames in ONE endpoint write (one transport
+        notify/wakeup instead of one per frame — the unary fast path sends
+        HEADERS+MESSAGE / MESSAGE+TRAILERS fused). Frames whose payload
+        exceeds MAX_FRAME_PAYLOAD fall back to the fragmenting path in order.
+        """
+        batch: List[memoryview] = []
+        for ftype, flags, stream_id, payload in frames:
+            segs = ([memoryview(s).cast("B") for s in payload]
+                    if isinstance(payload, (list, tuple)) else
+                    [memoryview(payload).cast("B")])
+            segs = [s for s in segs if len(s)]
+            total = sum(len(s) for s in segs)
+            if total > MAX_FRAME_PAYLOAD:
+                if batch:
+                    with self._lock:
+                        self._ep.write(batch)
+                    batch = []
+                if ftype != MESSAGE:
+                    raise FrameError(
+                        f"control frame payload {total} exceeds "
+                        f"{MAX_FRAME_PAYLOAD}; metadata too large")
+                self._send_fragmented(flags, stream_id, segs, total)
+                continue
+            batch.append(memoryview(
+                HEADER_FMT.pack(ftype, flags, stream_id, total)))
+            batch.extend(segs)
+        if batch:
+            with self._lock:
+                self._ep.write(batch)
+
     def send_preface(self) -> None:
         with self._lock:
             self._ep.write(MAGIC)

@@ -343,13 +343,27 @@ class _ServerConnection:
                         return
                     self.writer.send(fr.MESSAGE, 0, st.stream_id,
                                      handler.response_serializer(response))
-            else:
                 if ctx.is_active():
-                    self.writer.send(fr.MESSAGE, 0, st.stream_id,
-                                     handler.response_serializer(result))
-            if ctx.is_active():
+                    code = (ctx._code if ctx._code is not None
+                            else StatusCode.OK)
+                    self._send_trailers(st, code, ctx._details, ctx._trailing)
+                    return code is StatusCode.OK
+            elif ctx.is_active():
+                # Unary response: MESSAGE + TRAILERS fused into one transport
+                # write (one receiver wakeup instead of two).
                 code = ctx._code if ctx._code is not None else StatusCode.OK
-                self._send_trailers(st, code, ctx._details, ctx._trailing)
+                try:
+                    self.writer.send_many([
+                        (fr.MESSAGE, 0, st.stream_id,
+                         handler.response_serializer(result)),
+                        (fr.TRAILERS, fr.FLAG_END_STREAM, st.stream_id,
+                         fr.trailers_payload(code, ctx._details,
+                                             list(ctx._trailing))),
+                    ])
+                except fr.FrameError:
+                    self._send_trailers(st, StatusCode.INTERNAL,
+                                        "trailing metadata too large")
+                    return False
                 return code is StatusCode.OK
         except AbortError as exc:
             self._send_trailers(st, exc.code, exc.details, ctx._trailing)
