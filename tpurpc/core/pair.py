@@ -394,15 +394,7 @@ class Pair:
         self.total_sent = 0
         self.total_recv = 0
 
-        # Eager/inline receive plan: the notify channel carries typed records
-        # in FIFO order — ring-data grants, inline payloads, credit/exit
-        # hints — and the plan is the in-order queue of consumable byte
-        # sources built from them (see class docstring, "inline sends").
-        self._rx_plan: "List[list]" = []  # [kind, value] entries
-        self._rx_buf = bytearray()        # partial-record assembly
-        self._rx_lock = threading.Lock()
         self._notify_lock = threading.Lock()  # serializes notify-socket writes
-        self.inline_threshold = 0         # set at init() from config
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -426,18 +418,6 @@ class Pair:
         self._published_head_mirror = 0
         self.error = None
         self.want_write = False
-        self._rx_plan = []
-        self._rx_buf = bytearray()
-        # Inline sends ride the notify socket; they help exactly where
-        # spin-free wakeups are the read path (event discipline, or any
-        # discipline degraded to event on a single-CPU host). Under busy/
-        # hybrid with real cores the native ring spin is faster than a socket
-        # round trip, so small messages stay on the ring there.
-        discipline = cfg.platform.discipline
-        if discipline == "event" or _effective_cpus() < 2:
-            self.inline_threshold = cfg.inline_threshold
-        else:
-            self.inline_threshold = 0
         for role in ("read", "write"):
             r, w = os.pipe()
             os.set_blocking(r, False)
