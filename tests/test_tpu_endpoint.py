@@ -1,0 +1,242 @@
+"""Platform.TPU transport: TpuRingEndpoint dispatch, device-ring decode,
+lease-gated credit, end-to-end tensor RPC with ledger-proven copy accounting.
+
+The north-star path (BASELINE.json): wire bytes → frame assembly (host) →
+device-ring placement → lease-backed jax.Array, with host-memcpy = 0 after
+assembly. Reference analogs: creation path ``rdma_bp_posix.cc:706-796``,
+receive drain ``ring_buffer.cc:122-191``.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from tpurpc.jaxshim import TensorClient, add_tensor_method, codec
+from tpurpc.rpc.channel import Channel
+from tpurpc.rpc.server import Server
+from tpurpc.tpu import HbmRing, ledger
+from tpurpc.tpu.endpoint import (DeviceMessage, TpuRingEndpoint,
+                                 decode_tensor_to_ring, decode_tree_to_ring)
+
+
+def _tpu_server(monkeypatch, fn, kind="unary_unary", device=True,
+                platform="TPU"):
+    monkeypatch.setenv("GRPC_PLATFORM_TYPE", platform)
+    srv = Server(max_workers=4)
+    add_tensor_method(srv, "Call", fn, kind=kind, device=device)
+    srv.start()
+    port = srv.add_insecure_port("127.0.0.1:0")
+    return srv, port
+
+
+# -- decode-to-ring units -----------------------------------------------------
+
+def test_decode_tensor_to_ring_zero_host_copy():
+    """The DeserializeToDevice step itself moves no bytes host-side."""
+    x = np.arange(2048, dtype=np.float32)
+    wire = bytearray(codec.encode_tensor_bytes(x))
+    ring = HbmRing(1 << 16)
+    with ledger.track() as w:
+        lease, end = decode_tensor_to_ring(ring, wire)
+    assert w["host_copy"] == 0
+    assert w["dma_h2d"] == x.nbytes
+    assert w["dma_d2d"] >= x.nbytes  # in-ring landing + view materialization
+    assert end == len(wire)
+    with lease as arr:
+        assert arr.shape == (2048,)
+        np.testing.assert_array_equal(np.asarray(arr), x)
+
+
+def test_decode_tree_to_ring_roundtrip_and_release():
+    tree = {"w": np.ones((16, 16), np.float32),
+            "b": np.arange(16, dtype=np.int32)}
+    wire = codec.encode_tree_bytes(tree)
+    ring = HbmRing(1 << 16)
+    out, leases = decode_tree_to_ring(ring, wire)
+    np.testing.assert_array_equal(np.asarray(out["w"]), tree["w"])
+    np.testing.assert_array_equal(np.asarray(out["b"]), tree["b"])
+    assert ring.stats()["live_spans"] == 2
+    for lease in leases:
+        lease.release()
+    st = ring.stats()
+    assert st["live_spans"] == 0 and st["writable"] == st["capacity"]
+
+
+def test_ring_credit_blocks_until_lease_release():
+    """An unreleased lease back-pressures placement (flow control), and a
+    release from another thread unblocks a waiting place()."""
+    x = np.zeros(3000, np.uint8)
+    wire = bytearray(codec.encode_tensor_bytes(x))
+    ring = HbmRing(1 << 12)  # 4 KiB: one message in flight
+    lease, _ = decode_tensor_to_ring(ring, wire)
+    with pytest.raises(BufferError):
+        decode_tensor_to_ring(ring, wire, timeout=0.05)
+    t = threading.Timer(0.1, lease.release)
+    t.start()
+    lease2, _ = decode_tensor_to_ring(ring, wire, timeout=5)  # blocks, then ok
+    lease2.release()
+    t.join()
+
+
+def test_oversized_payload_rejected():
+    ring = HbmRing(1 << 12)
+    wire = bytearray(codec.encode_tensor_bytes(np.zeros(8192, np.uint8)))
+    with pytest.raises(BufferError):
+        decode_tensor_to_ring(ring, wire, timeout=0.05)
+
+
+def test_empty_tensors_no_span_collision():
+    """Consecutive zero-size leaves must not collide on the (off, 0) span key
+    (reviewer finding: shared _live entry corrupted lease counts)."""
+    tree = {"a": np.zeros((0,), np.float32), "b": np.zeros((0,), np.float64),
+            "c": np.arange(4, dtype=np.int32)}
+    ring = HbmRing(1 << 12)
+    out, leases = decode_tree_to_ring(ring, codec.encode_tree_bytes(tree))
+    assert out["a"].shape == (0,) and out["b"].shape == (0,)
+    np.testing.assert_array_equal(np.asarray(out["c"]), tree["c"])
+    for lease in leases:
+        lease.release()  # must not KeyError
+    st = ring.stats()
+    assert st["live_spans"] == 0 and st["writable"] == st["capacity"]
+
+
+def test_corrupt_trailer_releases_leases():
+    """A poison trailer must return every taken lease (reviewer finding:
+    leaked credit = one-peer DoS on the connection's ring)."""
+    tree = {"x": np.ones(64, np.float32)}
+    wire = bytearray(codec.encode_tree_bytes(tree))
+    wire[-3:] = b"\xff\xff\xff"  # corrupt the JSON treedef trailer
+    ring = HbmRing(1 << 12)
+    with pytest.raises(Exception):
+        decode_tree_to_ring(ring, wire)
+    st = ring.stats()
+    assert st["live_spans"] == 0 and st["writable"] == st["capacity"]
+
+
+def test_tree_larger_than_ring_fails_fast():
+    """A tree that can never fit must raise immediately, not stall a worker
+    the full place timeout waiting on its own leases (reviewer finding)."""
+    import time
+
+    tree = {"a": np.zeros(3000, np.uint8), "b": np.zeros(3000, np.uint8)}
+    ring = HbmRing(1 << 12)  # 4 KiB < 6 KB total
+    t0 = time.monotonic()
+    with pytest.raises(BufferError, match="capacity"):
+        decode_tree_to_ring(ring, codec.encode_tree_bytes(tree))
+    assert time.monotonic() - t0 < 1.0
+    assert ring.stats()["live_spans"] == 0
+
+
+# -- endpoint dispatch --------------------------------------------------------
+
+@pytest.mark.parametrize("spelling", ["TPU", "RDMA_TPU"])
+def test_factory_dispatches_tpu_endpoint(monkeypatch, spelling):
+    """GRPC_PLATFORM_TYPE=TPU|RDMA_TPU yields TpuRingEndpoint on both sides
+    (the import that was a ModuleNotFoundError in round 1)."""
+    monkeypatch.setenv("GRPC_PLATFORM_TYPE", spelling)
+    from tpurpc.core.endpoint import EndpointListener, connect_endpoint
+
+    got = []
+    ev = threading.Event()
+
+    def on_ep(ep):
+        got.append(ep)
+        ev.set()
+
+    lst = EndpointListener("127.0.0.1", 0, on_ep)
+    try:
+        cli = connect_endpoint("127.0.0.1", lst.port)
+        assert ev.wait(10)
+        assert isinstance(cli, TpuRingEndpoint)
+        assert isinstance(got[0], TpuRingEndpoint)
+        cli.write(b"ping")
+        assert got[0].read(16, timeout=5) == b"ping"
+        cli.close()
+        got[0].close()
+    finally:
+        lst.close()
+
+
+# -- end-to-end tensor RPC on the TPU platform --------------------------------
+
+def test_e2e_device_tensor_rpc(monkeypatch):
+    """GRPC_PLATFORM_TYPE=TPU end to end: handler receives ring-backed device
+    arrays, decode adds no host copies beyond frame assembly."""
+    import jax
+
+    seen = {}
+
+    def fn(tree):
+        seen["type"] = type(tree["x"])
+        return {"y": tree["x"] * 2}
+
+    srv, port = _tpu_server(monkeypatch, fn)
+    try:
+        x = np.arange(1024, dtype=np.float32).reshape(32, 32)
+        with Channel(f"127.0.0.1:{port}") as ch:
+            out = TensorClient(ch).call("Call", {"x": x}, timeout=30)
+        np.testing.assert_array_equal(np.asarray(out["y"]), x * 2)
+        assert issubclass(seen["type"], jax.Array)
+    finally:
+        srv.stop(grace=0)
+
+
+def test_e2e_client_device_response(monkeypatch):
+    """call_device: the RESPONSE lands in the client connection's device ring
+    and comes back as a lease-holding DeviceMessage."""
+    def fn(tree):
+        return {"y": np.asarray(tree["x"]) + 1}
+
+    srv, port = _tpu_server(monkeypatch, fn)
+    try:
+        x = np.arange(256, dtype=np.float32)
+        with Channel(f"127.0.0.1:{port}") as ch:
+            cli = TensorClient(ch)
+            msg = cli.call_device("Call", {"x": x}, timeout=30)
+            assert isinstance(msg, DeviceMessage)
+            ring = ch.device_ring()
+            assert ring is not None and ring.stats()["live_spans"] == 1
+            with msg as tree:
+                np.testing.assert_array_equal(np.asarray(tree["y"]), x + 1)
+            assert ring.stats()["live_spans"] == 0  # credit returned
+    finally:
+        srv.stop(grace=0)
+
+
+def test_e2e_streaming_rolling_credit(monkeypatch):
+    """A device-mode stream longer than the ring holds only one message's
+    leases at a time (rolling release as the handler advances)."""
+    monkeypatch.setenv("TPURPC_HBM_RING_SIZE_KB", "64")  # 64 KiB device ring
+
+    def consume(trees):
+        total = 0
+        for t in trees:
+            total += int(np.asarray(t["x"]).sum())
+        yield {"total": np.int64(total)}
+
+    srv, port = _tpu_server(monkeypatch, consume, kind="stream_stream")
+    try:
+        x = np.ones(4096, np.float32)  # 16 KiB per message, 8 messages
+        with Channel(f"127.0.0.1:{port}") as ch:
+            replies = list(TensorClient(ch).duplex(
+                "Call", iter([{"x": x}] * 8), timeout=60))
+        assert int(np.asarray(replies[0]["total"]).ravel()[0]) == 8 * 4096
+    finally:
+        srv.stop(grace=0)
+
+
+def test_device_method_falls_back_off_platform(monkeypatch):
+    """device=True on a TCP transport degrades to the host decode."""
+    def fn(tree):
+        return {"y": np.asarray(tree["x"]) * 3}
+
+    srv, port = _tpu_server(monkeypatch, fn, platform="TCP")
+    try:
+        x = np.arange(64, dtype=np.float32)
+        with Channel(f"127.0.0.1:{port}") as ch:
+            out = TensorClient(ch).call("Call", {"x": x}, timeout=30)
+            np.testing.assert_array_equal(np.asarray(out["y"]), x * 3)
+            assert ch.device_ring() is None
+    finally:
+        srv.stop(grace=0)

@@ -208,6 +208,17 @@ class TcpEndpoint(Endpoint):
         return True
 
 
+def device_ring_of(endpoint: Endpoint):
+    """The endpoint's device (HBM) receive ring, or None off-platform.
+
+    Single probe shared by the server and client surfaces: present only on
+    :class:`tpurpc.tpu.endpoint.TpuRingEndpoint` (``GRPC_PLATFORM_TYPE=TPU``).
+    Checks the class attribute first so non-TPU endpoints pay no lazy-init."""
+    if isinstance(getattr(type(endpoint), "device_ring", None), property):
+        return endpoint.device_ring
+    return None
+
+
 def _fmt_addr(sock: socket.socket, peer: bool) -> str:
     try:
         addr = sock.getpeername() if peer else sock.getsockname()

@@ -39,31 +39,106 @@ def _method_path(name: str) -> str:
     return f"/{TENSOR_SERVICE}/{name}"
 
 
+def _device_decoder(ctx):
+    """Per-call request decoder: device-ring placement when the transport is
+    the TPU platform, host-aliasing decode otherwise.
+
+    Returns ``(decode(buf) -> tree, finish())``. Credit discipline: each
+    ``decode`` releases the PREVIOUS message's leases (the handler advancing
+    the request iterator means it is done with that message — the rolling
+    analog of the host ring's drain-then-credit, ``pair.cc:276-284``), and
+    ``finish`` releases the last message's when the handler returns
+    (SURVEY §7 hard-part #4: leases gate the ring's credit return)."""
+    ring = getattr(ctx, "device_ring", None)
+    if ring is None:
+        return codec.tree_deserializer, lambda: None
+    from tpurpc.tpu.endpoint import decode_tree_to_ring
+
+    held = []
+
+    def decode(buf):
+        for lease in held:
+            lease.release()
+        held.clear()
+        tree, leases = decode_tree_to_ring(ring, buf)
+        held.extend(leases)
+        return tree
+
+    def finish():
+        for lease in held:
+            lease.release()
+        held.clear()
+
+    return decode, finish
+
+
 def add_tensor_method(server: Server, name: str,
                       fn: Callable[..., Any],
-                      kind: str = "unary_unary") -> None:
+                      kind: str = "unary_unary",
+                      device: bool = False) -> None:
     """Register ``fn(tree) -> tree`` as a tensor-typed method.
 
     ``fn`` receives the decoded request pytree (numpy views over the receive
     buffer; pass through :func:`tpurpc.jaxshim.codec.to_jax` or let jit trace
     them — jax treats numpy zero-copy on CPU backends). Its return pytree is
     encoded the same way.
+
+    With ``device=True`` and the TPU platform
+    (``GRPC_PLATFORM_TYPE=TPU``), request payloads are placed into the
+    connection's HBM receive ring and ``fn`` gets lease-backed device arrays;
+    the leases (ring credit) are released when ``fn`` returns. On other
+    platforms ``device=True`` degrades to the host-aliasing decode.
     """
+    if not device:
+        if kind == "unary_unary":
+            def behavior(req, ctx):
+                return fn(req)
+            handler = unary_unary_rpc_method_handler(
+                behavior, codec.tree_deserializer, codec.tree_serializer)
+        elif kind == "unary_stream":
+            def behavior(req, ctx):
+                yield from fn(req)
+            handler = unary_stream_rpc_method_handler(
+                behavior, codec.tree_deserializer, codec.tree_serializer)
+        elif kind == "stream_stream":
+            def behavior(req_iter, ctx):
+                yield from fn(req_iter)
+            handler = stream_stream_rpc_method_handler(
+                behavior, codec.tree_deserializer, codec.tree_serializer)
+        else:
+            raise ValueError(f"unsupported tensor method kind {kind}")
+        server.add_method(_method_path(name), handler)
+        return
+
+    # device mode: identity deserializer (raw message bytes reach the
+    # behavior), decode inside where ctx exposes the connection's ring.
     if kind == "unary_unary":
-        def behavior(req, ctx):
-            return fn(req)
+        def behavior(raw, ctx):
+            decode, finish = _device_decoder(ctx)
+            try:
+                return fn(decode(raw))
+            finally:
+                finish()
         handler = unary_unary_rpc_method_handler(
-            behavior, codec.tree_deserializer, codec.tree_serializer)
+            behavior, response_serializer=codec.tree_serializer)
     elif kind == "unary_stream":
-        def behavior(req, ctx):
-            yield from fn(req)
+        def behavior(raw, ctx):
+            decode, finish = _device_decoder(ctx)
+            try:
+                yield from fn(decode(raw))
+            finally:
+                finish()
         handler = unary_stream_rpc_method_handler(
-            behavior, codec.tree_deserializer, codec.tree_serializer)
+            behavior, response_serializer=codec.tree_serializer)
     elif kind == "stream_stream":
-        def behavior(req_iter, ctx):
-            yield from fn(req_iter)
+        def behavior(raw_iter, ctx):
+            decode, finish = _device_decoder(ctx)
+            try:
+                yield from fn(decode(raw) for raw in raw_iter)
+            finally:
+                finish()
         handler = stream_stream_rpc_method_handler(
-            behavior, codec.tree_deserializer, codec.tree_serializer)
+            behavior, response_serializer=codec.tree_serializer)
     else:
         raise ValueError(f"unsupported tensor method kind {kind}")
     server.add_method(_method_path(name), handler)
@@ -79,6 +154,28 @@ class TensorClient:
         mc = self._channel.unary_unary(
             _method_path(name), codec.tree_serializer, codec.tree_deserializer)
         return mc(tree, timeout=timeout)
+
+    def call_device(self, name: str, tree: Any,
+                    timeout: Optional[float] = None):
+        """Unary call whose RESPONSE decodes into the channel's device ring.
+
+        Returns a :class:`tpurpc.tpu.endpoint.DeviceMessage` — use it as a
+        context manager (or call ``.release()``) so the ring credit returns.
+        Falls back to a plain host decode (still wrapped in DeviceMessage,
+        with no leases) when the channel's transport isn't the TPU platform.
+        """
+        from tpurpc.tpu.endpoint import DeviceMessage, decode_tree_to_ring
+
+        mc = self._channel.unary_unary(
+            _method_path(name), codec.tree_serializer)
+        raw, call = mc.with_call(tree, timeout=timeout)
+        # The call's OWN connection: an LB re-pick here could land the
+        # response in a different connection's ring (or fail a finished call).
+        ring = call.device_ring()
+        if ring is None:
+            return DeviceMessage(codec.decode_tree(raw), [])
+        out, leases = decode_tree_to_ring(ring, raw)
+        return DeviceMessage(out, leases)
 
     def stream(self, name: str, tree: Any,
                timeout: Optional[float] = None) -> Iterator[Any]:

@@ -316,6 +316,17 @@ class Channel:
         raise last_exc if last_exc is not None else RpcError(
             StatusCode.UNAVAILABLE, "no subchannels")
 
+    def device_ring(self):
+        """The live connection's device (HBM) receive ring, or None when the
+        transport isn't :class:`tpurpc.tpu.endpoint.TpuRingEndpoint`
+        (``GRPC_PLATFORM_TYPE=TPU``). NOTE: this dials/picks a connection;
+        to decode a response already in hand, prefer
+        :meth:`Call.device_ring`, which is pinned to the connection the
+        response arrived on."""
+        from tpurpc.core.endpoint import device_ring_of
+
+        return device_ring_of(self._connection().endpoint)
+
     def ping(self, timeout: float = 5.0) -> float:
         """Round-trip a PING; returns seconds.  Liveness probe (the reference's
         analog: rate-limited ``ibv_query_qp``, ``pair.cc:349-375``)."""
@@ -406,6 +417,15 @@ class Call:
         if self._deadline is None:
             return None
         return max(0.0, self._deadline - time.monotonic())
+
+    def device_ring(self):
+        """Device ring of the connection THIS call ran on (or None off the
+        TPU platform) — unlike :meth:`Channel.device_ring`, never dials, so
+        it can't pick a different subchannel than the one that carried the
+        response."""
+        from tpurpc.core.endpoint import device_ring_of
+
+        return device_ring_of(self._conn.endpoint)
 
     # -- response consumption -------------------------------------------------
 

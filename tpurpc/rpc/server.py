@@ -116,6 +116,18 @@ class ServerContext:
     def peer(self) -> str:
         return self._conn.endpoint.peer
 
+    @property
+    def device_ring(self):
+        """The connection's device (HBM) receive ring, or None off-platform.
+
+        Present only when the transport is a
+        :class:`tpurpc.tpu.endpoint.TpuRingEndpoint`
+        (``GRPC_PLATFORM_TYPE=TPU``); tensor handlers registered with
+        ``device=True`` decode through it."""
+        from tpurpc.core.endpoint import device_ring_of
+
+        return device_ring_of(self._conn.endpoint)
+
     def deadline_remaining(self) -> Optional[float]:
         if self._deadline is None:
             return None

@@ -14,7 +14,12 @@ from tpurpc.tpu import ledger
 from tpurpc.tpu.hbm_ring import HbmLease, HbmRing
 
 __all__ = ["ledger", "HbmLease", "HbmRing", "deserialize_to_device",
-           "serialize_from_device", "tree_from_device"]
+           "serialize_from_device", "tree_from_device", "TpuRingEndpoint",
+           "DeviceMessage", "decode_tensor_to_ring", "decode_tree_to_ring"]
+
+#: endpoint module exports, loaded lazily (they import the rpc/endpoint stack)
+_ENDPOINT_NAMES = ("TpuRingEndpoint", "DeviceMessage", "decode_tensor_to_ring",
+                   "decode_tree_to_ring")
 
 
 def __getattr__(name):
@@ -23,4 +28,8 @@ def __getattr__(name):
         from tpurpc.tpu import serialize
 
         return getattr(serialize, name)
+    if name in _ENDPOINT_NAMES:
+        from tpurpc.tpu import endpoint
+
+        return getattr(endpoint, name)
     raise AttributeError(f"module 'tpurpc.tpu' has no attribute {name!r}")

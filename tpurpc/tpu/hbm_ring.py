@@ -7,18 +7,35 @@ returns device buffer handles. This module emulates the *architecture* with
 XLA-visible pieces so the protocol, lease discipline, and copy ledger are
 real even though the placement is a ``device_put``:
 
-* ``place`` — one h2d movement per payload (ledger: dma_h2d), donated-buffer
-  ``dynamic_update_slice`` so XLA updates the ring in place instead of
-  rewriting 16MB per message.
-* ``view`` — ``dynamic_slice`` + bitcast on device; payload bytes never
-  return to the host.
+* ``place`` — one h2d movement per payload (ledger: dma_h2d) followed by the
+  donated-buffer ``dynamic_update_slice`` that lands it in the ring. The
+  in-ring landing write moves the payload a second time ON DEVICE, and the
+  ledger records it as ``dma_d2d`` — two honest entries for two real
+  movements (on NIC hardware the DMA writes the ring directly and both
+  entries collapse into the NIC's single placement write).
+* ``view`` — ``dynamic_slice`` (+ bitcast) on device. XLA's dynamic_slice
+  materializes a NEW buffer — a device copy, not an alias — so ``view``
+  records ``dma_d2d``, never ``zero_copy``. Payload bytes still never touch
+  the host, which is the property the north star actually needs
+  (host-memcpy = 0 after frame assembly).
 * lease/credit — a message's span stays pinned until every handle is
   released; only then does the head advance (SURVEY.md §7 hard-part #4: a
   ``jax.Array`` aliasing ring memory must gate credit return).
 
+Thread model: ``self.buf`` is rebound by donating jits in ``place`` while
+``view`` slices it — both run under ``self._lock`` for their whole device
+op, because a donated buffer is DELETED the moment the update launches and a
+concurrent slice of the old binding would fault (advisor r1 finding). The
+lock spans an XLA dispatch, which is acceptable for the emulation: one ring
+has one producer (the receive path) and its consumers.
+
 Capacity is a power of two; offsets are monotonic 64-bit counters — the same
 invariants as the host ring (tpurpc/core/ring.py), so the flow-control math
 is shared by inspection.
+
+Reference analog: the creation path ``rdma_bp_posix.cc:706-796`` (pool take →
+init → bootstrap → poller) and the receive drain ``ring_buffer.cc:122-191``;
+here the drain's landing target is device memory.
 """
 
 from __future__ import annotations
@@ -49,6 +66,8 @@ class HbmRing:
         self.tail = 0   # absolute bytes ever placed
         self.head = 0   # absolute bytes ever freed
         self._lock = threading.Lock()
+        #: signaled whenever the head advances (space became writable)
+        self._space = threading.Condition(self._lock)
         #: span -> [outstanding leases, ever_released] — a span frees only
         #: after at least one lease was taken AND all were released, so a
         #: placed-but-unconsumed message can never be reclaimed under it
@@ -72,59 +91,94 @@ class HbmRing:
     def writable(self) -> int:
         return self.capacity - (self.tail - self.head)
 
-    def place(self, payload) -> Tuple[int, int]:
+    def place(self, payload, timeout: Optional[float] = None) -> Tuple[int, int]:
         """DMA one payload into the ring; returns its (offset, nbytes) span.
 
-        Emulates the NIC's placement write: exactly one h2d movement, zero
-        host memcpy (the payload view is consumed in place).
+        Emulates the NIC's placement write: one h2d movement plus the in-ring
+        landing write (dma_d2d); zero host memcpy (the payload view is
+        consumed in place).
+
+        Blocks up to ``timeout`` seconds for lease releases to free space
+        (credit-based flow control, ``pair.cc:276-284`` analog); with
+        ``timeout=None`` a full ring raises :class:`BufferError` immediately.
+        A payload larger than the whole ring always raises.
         """
         import jax
 
         src = np.frombuffer(payload, np.uint8) if not isinstance(
             payload, np.ndarray) else payload.reshape(-1).view(np.uint8)
         n = src.nbytes
+        if n == 0:
+            # Zero-size spans never enter _live: they'd all share the key
+            # (tail, 0) and corrupt each other's lease counts. An empty
+            # payload needs no ring bytes and no credit.
+            return self.tail, 0
+        if n > self.capacity:
+            raise BufferError(f"payload {n} exceeds ring capacity {self.capacity}")
         with self._lock:
+            if n > self.writable() and timeout is not None:
+                import time as _time
+                deadline = _time.monotonic() + timeout
+                while n > self.writable():
+                    remain = deadline - _time.monotonic()
+                    if remain <= 0 or not self._space.wait(timeout=remain):
+                        break
             if n > self.writable():
                 raise BufferError(f"HBM ring full: {n} > {self.writable()}")
             off = self.tail
             self.tail += n
             self._live[(off, n)] = [0, False]
-        p = off & self._mask
-        dev = jax.device_put(jax.numpy.asarray(src), self.device)
-        ledger.dma_h2d(n)
-        first = min(n, self.capacity - p)
-        self.buf = self._update(self.buf, dev[:first], p)
-        if first < n:  # wrap: second placement at offset 0
-            self.buf = self._update(self.buf, dev[first:], 0)
+            p = off & self._mask
+            dev = jax.device_put(jax.numpy.asarray(src), self.device)
+            ledger.dma_h2d(n)
+            first = min(n, self.capacity - p)
+            # Donating update: rebinding self.buf under the lock — view()
+            # must never slice a just-donated (deleted) binding.
+            self.buf = self._update(self.buf, dev[:first], p)
+            if first < n:  # wrap: second placement at offset 0
+                self.buf = self._update(self.buf, dev[first:], 0)
+            ledger.dma_d2d(n)  # the in-ring landing write
         return off, n
 
     # -- consumer ------------------------------------------------------------
 
     def view(self, off: int, n: int, dtype=np.uint8,
              shape: Optional[tuple] = None) -> "HbmLease":
-        """Device view of a placed span; pins it until the lease is released."""
+        """Device view of a placed span; pins it until the lease is released.
+
+        The returned array is a device-side materialization (dma_d2d) of the
+        span: payload bytes never return to the host, but the slice IS a
+        device copy and the ledger says so.
+        """
         import jax.numpy as jnp
         from jax import lax
 
+        if n == 0:
+            dt = jnp.dtype(dtype)
+            empty = jnp.zeros((0,), dt).reshape(shape if shape is not None
+                                                else (0,))
+            return HbmLease(self, off, 0, empty)
         with self._lock:
             if (off, n) not in self._live:
                 raise KeyError(f"span ({off}, {n}) not live")
             self._live[(off, n)][0] += 1
-        p = off & self._mask
-        first = min(n, self.capacity - p)
-        seg = self._slice(self.buf, p, first)
-        if first < n:
-            seg = jnp.concatenate([seg, self._slice(self.buf, 0, n - first)])
+            p = off & self._mask
+            first = min(n, self.capacity - p)
+            seg = self._slice(self.buf, p, first)
+            if first < n:
+                seg = jnp.concatenate([seg, self._slice(self.buf, 0, n - first)])
         dt = jnp.dtype(dtype)
         if dt != jnp.uint8:
             seg = lax.bitcast_convert_type(
                 seg.reshape(-1, dt.itemsize), dt).reshape(-1)
         if shape is not None:
             seg = seg.reshape(shape)
-        ledger.zero_copy(n)  # device-side reinterpretation, no host bytes
+        ledger.dma_d2d(n)  # slice materialization: a device copy, not an alias
         return HbmLease(self, off, n, seg)
 
     def _release(self, off: int, n: int) -> None:
+        if n == 0:
+            return  # zero-size spans hold no credit (never entered _live)
         with self._lock:
             entry = self._live[(off, n)]
             entry[0] -= 1
@@ -132,6 +186,7 @@ class HbmRing:
             if entry[0] > 0:
                 return
             # advance head over every consumed (leased-and-released) prefix
+            advanced = False
             while self._live:
                 first_key = min(self._live)
                 cnt, consumed = self._live[first_key]
@@ -139,6 +194,9 @@ class HbmRing:
                     break
                 del self._live[first_key]
                 self.head += first_key[1]
+                advanced = True
+            if advanced:
+                self._space.notify_all()
 
     def stats(self) -> Dict[str, int]:
         with self._lock:

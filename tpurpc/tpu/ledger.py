@@ -9,8 +9,12 @@ reports its copies here, so "zero-copy" is a measured claim, not a slogan:
                      assembly, codec copy=True, staging)
 * ``dma_h2d``      — host buffer → device memory (jax device_put of wire bytes)
 * ``dma_d2h``      — device memory → host buffer (serialize-from-device)
+* ``dma_d2d``      — device → device movement (ring in-place update, slice
+                     materialization in ``HbmRing.view`` — XLA's dynamic_slice
+                     produces a NEW buffer, which is a copy, and the ledger
+                     says so; see VERDICT r1 "the copy ledger lies")
 * ``zero_copy``    — payload bytes delivered by aliasing (dlpack import of a
-                     wire buffer, ring-lease views)
+                     wire buffer): no bytes moved anywhere
 
 Counters are process-wide and monotonic; :func:`track` snapshots a window.
 GIL-protected integer adds — the accounting itself must not cost a memcpy.
@@ -27,6 +31,7 @@ _counters: Dict[str, int] = {
     "host_copy": 0,
     "dma_h2d": 0,
     "dma_d2h": 0,
+    "dma_d2d": 0,
     "zero_copy": 0,
 }
 
@@ -47,6 +52,10 @@ def dma_h2d(nbytes: int) -> None:
 
 def dma_d2h(nbytes: int) -> None:
     add("dma_d2h", nbytes)
+
+
+def dma_d2d(nbytes: int) -> None:
+    add("dma_d2d", nbytes)
 
 
 def zero_copy(nbytes: int) -> None:

@@ -135,6 +135,10 @@ class Config:
     device_ordinal: int = 0
     pair_pool_size: int = 128
     poller_capacity: int = 4096
+    #: Device (HBM) receive-ring capacity for Platform.TPU endpoints — the
+    #: analog of ring_buffer_size_kb for the device-resident ring. Default
+    #: 16 MiB: four in-flight 4 MiB tensors per connection.
+    hbm_ring_size_kb: int = 16384
 
     @property
     def ring_buffer_size(self) -> int:
@@ -197,7 +201,16 @@ class Config:
             device_ordinal=_env_int("TPURPC_DEVICE_ORDINAL", cls.device_ordinal),
             pair_pool_size=_env_int("TPURPC_PAIR_POOL_SIZE", cls.pair_pool_size),
             poller_capacity=_env_int("TPURPC_POLLER_CAPACITY", cls.poller_capacity),
+            hbm_ring_size_kb=_env_int(
+                "TPURPC_HBM_RING_SIZE_KB", cls.hbm_ring_size_kb),
         )
+
+    @property
+    def hbm_ring_size(self) -> int:
+        """Device ring capacity in bytes, power-of-two rounded like
+        :attr:`ring_buffer_size`."""
+        size = self.hbm_ring_size_kb * 1024
+        return 1 << max(12, (size - 1).bit_length())
 
 
 _lock = threading.Lock()
