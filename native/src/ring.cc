@@ -113,7 +113,42 @@ uint64_t message_at(const uint8_t* ring, uint64_t cap, uint64_t mask,
 
 extern "C" {
 
-int tpr_abi_version() { return 3; }
+int tpr_abi_version() { return 4; }
+
+// --- waiter-advertisement protocol (the futex-style sleep handshake) --------
+//
+// The reference's BP mode costs ZERO syscalls per send: the receiver discovers
+// data by polling the ring, and only the EVENT/BPEV sleep path needs a wake
+// (write-with-imm / completion channel, rdma_event_posix.cc). Our analog: a
+// waiter publishes "I am blocked on the notify fd" in its own status region
+// before sleeping; the peer reads that word after its data/credit store and
+// sends the 1-byte notify ONLY when someone is actually asleep.
+//
+// Correctness is the classic Dekker/futex argument and needs StoreLoad
+// ordering on both sides, which x86's TSO does NOT give for free:
+//   waiter:  store waiting=1 (seq_cst = full fence) ; load ring state
+//   sender:  store data      ; full fence           ; load waiting
+// If the waiter missed the data, its waiting store is ordered before the
+// sender's fenced load, so the sender sees waiting=1 and kicks. If the sender
+// saw waiting=0, the waiter's store came later, so its ring re-check (after
+// its own fence) sees the data and never blocks. Lost wakeups are impossible.
+
+void tpr_store_u64_seqcst(uint8_t* addr, uint64_t val) {
+  __atomic_store_n(reinterpret_cast<uint64_t*>(addr), val, __ATOMIC_SEQ_CST);
+  // The waiter's subsequent ring/credit re-checks are PLAIN loads issued from
+  // Python; a seq_cst store alone does not forbid them from hoisting above it
+  // on aarch64 (stlr only orders against ldar). The explicit fence buys the
+  // StoreLoad edge the proof needs on every architecture (x86: the xchg the
+  // store compiles to was already a full barrier; the extra mfence is noise
+  // on the sleep path).
+  std::atomic_thread_fence(std::memory_order_seq_cst);
+}
+
+uint64_t tpr_load_u64_fenced(const uint8_t* addr) {
+  std::atomic_thread_fence(std::memory_order_seq_cst);
+  return __atomic_load_n(reinterpret_cast<const uint64_t*>(addr),
+                         __ATOMIC_SEQ_CST);
+}
 
 // Total drainable payload bytes (all complete messages + pending remainder).
 // `seq` is the expected sequence of the FIRST unparsed message at/after head.

@@ -249,3 +249,44 @@ def test_asymmetric_ring_sizes():
     finally:
         a.destroy()
         b.destroy()
+
+
+def test_bootstrap_negotiates_waitflag_caps():
+    """Both sides of a bootstrap learn the peer's capability set; the notify
+    skip is gated on the peer advertising 'waitflag' (an asymmetric peer —
+    TPURPC_NATIVE=0 or an older build — must get unconditional notifies or it
+    sleeps forever on data already in its ring)."""
+    from tpurpc.core import _native
+
+    a, b = P.create_loopback_pair()
+    try:
+        expect = frozenset(["waitflag"]) if _native.load() else frozenset()
+        assert a.peer_caps == expect and b.peer_caps == expect
+    finally:
+        a.destroy()
+        b.destroy()
+
+
+def test_peer_without_waitflag_always_notified():
+    """A peer whose Address carried no caps (legacy/non-native) reads as
+    'always waiting': every send must carry the notify byte."""
+    a, b = P.create_loopback_pair()
+    try:
+        a.peer_caps = frozenset()  # simulate a legacy peer
+        assert a._peer_waiting("read") is True
+        assert a._peer_waiting("write") is True
+    finally:
+        a.destroy()
+        b.destroy()
+
+
+def test_address_caps_roundtrip_and_legacy_blob():
+    addr = P.Address("t", "local", 4096, "r", "s", caps=["waitflag"])
+    back = P.Address.from_bytes(addr.to_bytes())
+    assert back.caps == frozenset(["waitflag"])
+    # a legacy blob without the caps key parses as no capabilities
+    import json as _json
+
+    legacy = _json.dumps({"tag": "t", "domain": "local", "ring_size": 4096,
+                          "ring": "r", "status": "s"}).encode()
+    assert P.Address.from_bytes(legacy).caps == frozenset()

@@ -23,6 +23,13 @@ from tpurpc.tpu.endpoint import (DeviceMessage, TpuRingEndpoint,
 def _tpu_server(monkeypatch, fn, kind="unary_unary", device=True,
                 platform="TPU"):
     monkeypatch.setenv("GRPC_PLATFORM_TYPE", platform)
+    # Re-arm the config singleton AFTER the env change: a straggler thread
+    # from the previous test (server teardown, bootstrap) can rebuild the
+    # singleton in the window between the autouse fixture's reset and this
+    # setenv, silently pinning the whole test to the default TCP platform.
+    from tpurpc.utils import config as config_mod
+
+    config_mod.set_config(None)
     srv = Server(max_workers=4)
     add_tensor_method(srv, "Call", fn, kind=kind, device=device)
     srv.start()
@@ -135,6 +142,9 @@ def test_factory_dispatches_tpu_endpoint(monkeypatch, spelling):
     """GRPC_PLATFORM_TYPE=TPU|RDMA_TPU yields TpuRingEndpoint on both sides
     (the import that was a ModuleNotFoundError in round 1)."""
     monkeypatch.setenv("GRPC_PLATFORM_TYPE", spelling)
+    from tpurpc.utils import config as config_mod
+
+    config_mod.set_config(None)  # see _tpu_server: straggler-thread rebuild
     from tpurpc.core.endpoint import EndpointListener, connect_endpoint
 
     got = []

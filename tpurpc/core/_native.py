@@ -19,7 +19,7 @@ _LIB: "Optional[ctypes.CDLL]" = None
 _SPIN: "Optional[ctypes.CDLL]" = None
 _TRIED = False
 
-ABI_VERSION = 3
+ABI_VERSION = 4
 
 
 def _lib_path() -> str:
@@ -64,6 +64,11 @@ def load() -> "Optional[ctypes.CDLL]":
                                     pu64, ctypes.c_uint32, pu64]
     lib.tpr_ring_has_message.restype = ctypes.c_int
     lib.tpr_ring_has_message.argtypes = [pu8, u64, u64, u64, u64]
+    # waiter-advertisement words (futex-style sleep handshake; see ring.cc)
+    lib.tpr_store_u64_seqcst.restype = None
+    lib.tpr_store_u64_seqcst.argtypes = [pu8, u64]
+    lib.tpr_load_u64_fenced.restype = u64
+    lib.tpr_load_u64_fenced.argtypes = [pu8]
     _LIB = lib
 
     # Second handle via CDLL: these calls RELEASE the GIL — they are the
@@ -93,10 +98,24 @@ def addr_of(buf, writable: bool) -> int:
     numpy handles both read-only and writable exporters; the array is a view,
     so the caller must keep ``buf`` alive for the duration of the native call.
     """
+    return pin(buf, writable)[1]
+
+
+def pin(buf, writable: bool):
+    """(array, address) for repeated native calls on a long-lived buffer.
+
+    The returned array holds a buffer-protocol export: the underlying
+    memoryview/shm segment cannot release while it is referenced, which is
+    what makes a CACHED address safe to pass to native code. Owners must drop
+    the pin before closing the buffer (close paths retry on BufferError for
+    the in-flight-call window).
+
+    ``__array_interface__`` instead of ``.ctypes.data``: the latter constructs
+    a ctypes helper object per access, measurable on the per-RPC path."""
     arr = np.frombuffer(buf, dtype=np.uint8)
     if writable and not arr.flags.writeable:
         raise ValueError("writable buffer required")
-    return arr.ctypes.data
+    return arr, arr.__array_interface__["data"][0]
 
 
 def reset_for_tests() -> None:

@@ -47,9 +47,21 @@ from tpurpc.utils.trace import trace_ring
 
 _U64 = struct.Struct("<Q")
 
-STATUS_BYTES = 16
+#: Status region layout. Two cache lines: the first holds the PEER-written
+#: words (credit head, peer_exit — one-sided writes from the other side), the
+#: second holds the LOCALLY-written waiter-advertisement words the peer only
+#: reads. Separate lines so peer credit writes and local waiting-flag stores
+#: never false-share (cross-process cache-line ping-pong on the hot path).
+STATUS_BYTES = 128
 _STATUS_HEAD_OFF = 0
 _STATUS_EXIT_OFF = 8
+#: "a read-waiter is blocked on the notify fd" — senders skip the notify
+#: syscall when 0 (receiver is spinning or mid-drain). Futex-style protocol;
+#: fences + proof in native/src/ring.cc tpr_store_u64_seqcst.
+_STATUS_RXWAIT_OFF = 64
+#: same, for a credit-stalled writer blocked on the notify fd
+_STATUS_WXWAIT_OFF = 72
+_WAIT_OFF = {"read": _STATUS_RXWAIT_OFF, "write": _STATUS_WXWAIT_OFF}
 
 
 class PairState(enum.Enum):
@@ -259,12 +271,20 @@ class Address:
     ``pair.cc:148-149``)."""
 
     def __init__(self, tag: str, domain_kind: str, ring_size: int,
-                 ring_handle: str, status_handle: str):
+                 ring_handle: str, status_handle: str,
+                 caps: "Optional[Sequence[str]]" = None):
         self.tag = tag
         self.domain_kind = domain_kind
         self.ring_size = ring_size
         self.ring_handle = ring_handle
         self.status_handle = status_handle
+        #: capability strings, negotiated at bootstrap. "waitflag" = this side
+        #: publishes the waiter-advertisement words (native fences present),
+        #: so its peer may skip notify bytes when no waiter is advertised.
+        #: A peer that doesn't advertise it (TPURPC_NATIVE=0, older version)
+        #: gets unconditional notifies — asymmetric processes never lose
+        #: wakeups (reviewer finding: the skip must be opt-in per peer).
+        self.caps = frozenset(caps or ())
 
     def to_bytes(self) -> bytes:
         return json.dumps({
@@ -273,12 +293,14 @@ class Address:
             "ring_size": self.ring_size,
             "ring": self.ring_handle,
             "status": self.status_handle,
+            "caps": sorted(self.caps),
         }).encode()
 
     @classmethod
     def from_bytes(cls, raw: bytes) -> "Address":
         d = json.loads(raw.decode())
-        return cls(d["tag"], d["domain"], d["ring_size"], d["ring"], d["status"])
+        return cls(d["tag"], d["domain"], d["ring_size"], d["ring"],
+                   d["status"], d.get("caps", ()))
 
 
 #: Bootstrap frame magic.  A peer whose GRPC_PLATFORM_TYPE disagrees (e.g. a TCP
@@ -384,6 +406,12 @@ class Pair:
         #: fresh DefaultSelector per wait is 5 syscalls of pure overhead on
         #: the small-RPC path)
         self._selectors: Dict[str, object] = {}
+        #: cached (np array, address) pins of the status pages for the
+        #: waiter-advertisement words; nulled by teardown before any close
+        self._status_np = None
+        self._peer_status_np = None
+        #: peer capability strings from the bootstrap Address (see Address.caps)
+        self.peer_caps: frozenset = frozenset()
 
         self._send_guard = ContentAssertion("Pair.send")
         self._recv_guard = ContentAssertion("Pair.recv")
@@ -428,8 +456,10 @@ class Pair:
 
     def local_address(self) -> Address:
         assert self.state in (PairState.INITIALIZED, PairState.CONNECTED)
+        caps = ["waitflag"] if _native.load() is not None else []
         return Address(self.tag, self.domain.kind, self.ring_size,
-                       self.recv_region.handle, self.status_region.handle)
+                       self.recv_region.handle, self.status_region.handle,
+                       caps=caps)
 
     def connect_over_socket(self, sock: socket.socket) -> None:
         """Bootstrap over an already-connected socket: both sides swap Address blobs,
@@ -470,6 +500,7 @@ class Pair:
         # rings — the writer just honors the peer's capacity.
         self._peer_ring = self.domain.open_window(peer.ring_handle, peer.ring_size)
         self._peer_status = self.domain.open_window(peer.status_handle, STATUS_BYTES)
+        self.peer_caps = peer.caps
         self.writer = RingWriter(peer.ring_size, self._peer_ring.write,
                                  mapped=self._peer_ring.view)
         self.state = PairState.CONNECTED
@@ -477,6 +508,88 @@ class Pair:
                        self.tag, peer.tag, peer.ring_size)
 
     # -- notify channel (completion events) ----------------------------------
+
+    # -- waiter advertisement (futex-style sleep handshake) -------------------
+
+    def _status_pin(self):
+        """Cached (array, addr) pin of our status region, or None.
+
+        The array reference is what makes the cached address safe: it holds a
+        buffer export, so the region cannot unmap under a native call that
+        grabbed the pin into a local (teardown nulls the cache FIRST, then
+        Region.close retries its release for the in-flight window)."""
+        pin = self._status_np
+        if pin is None:
+            region = self.status_region
+            if region is None:
+                return None
+            try:
+                pin = _native.pin(region.buf, writable=True)
+            except (ValueError, TypeError):
+                return None  # racing teardown
+            self._status_np = pin
+            if self.status_region is not region:
+                # Teardown nulled the attribute between our read and the
+                # cache store; a cached export would wedge Region.close's
+                # retry forever. Drop it — our local still pins safely for
+                # this one call (the retry covers that bounded window).
+                self._status_np = None
+                return None
+        return pin
+
+    def _peer_status_pin(self):
+        pin = self._peer_status_np
+        if pin is None:
+            win = self._peer_status
+            if win is None or win.view is None:
+                return None
+            try:
+                pin = _native.pin(win.view, writable=False)
+            except (ValueError, TypeError):
+                return None
+            self._peer_status_np = pin
+            if self._peer_status is not win:  # see _status_pin
+                self._peer_status_np = None
+                return None
+        return pin
+
+    def set_waiting(self, role: str, flag: bool) -> None:
+        """Publish 'this role is blocked on the notify fd' in our status
+        region, where the peer's data/credit producer reads it (one-sided,
+        like everything else in the status page). seq_cst store = the full
+        fence the sleep protocol's Dekker argument needs (ring.cc).
+
+        No-op without the native lib: then producers notify unconditionally
+        (`_peer_waiting` returns True), which is the pre-advertisement
+        behavior — correct, just one syscall heavier per send."""
+        lib = _native.load()
+        if lib is None:
+            return
+        pin = self._status_pin()
+        if pin is None:
+            return  # racing teardown; waiters re-check state and exit
+        lib.tpr_store_u64_seqcst(pin[1] + _WAIT_OFF[role], 1 if flag else 0)
+
+    def _peer_waiting(self, role: str) -> bool:
+        """Is the peer's ``role`` waiter blocked on its notify fd?  True also
+        when we can't tell (no native fences / window gone) — then the caller
+        sends the notify byte unconditionally, trading a syscall for safety.
+
+        The fenced load after our data/footer/header stores is the producer
+        half of the sleep protocol (StoreLoad ordering; ring.cc).
+
+        Negotiated: only a peer that advertised "waitflag" at bootstrap (its
+        process has the native fences and DOES publish the words) may have
+        its notifies skipped — an asymmetric peer (TPURPC_NATIVE=0, older
+        build) leaves the words at 0 forever, which without the capability
+        gate would read as "nobody is waiting" and hang it permanently."""
+        lib = _native.load()
+        if lib is None or "waitflag" not in self.peer_caps:
+            return True
+        pin = self._peer_status_pin()
+        if pin is None:
+            return True
+        return bool(lib.tpr_load_u64_fenced(pin[1] + _WAIT_OFF[role]))
 
     def _notify(self, token: bytes) -> None:
         sock = self.notify_sock
@@ -649,7 +762,11 @@ class Pair:
             if head != self._published_head_mirror:
                 self._published_head_mirror = head
                 self._peer_status.write(_STATUS_HEAD_OFF, _U64.pack(head))
-                self._notify(NOTIFY_CREDIT)
+                # Wake the peer's credit-stalled writer only if one is
+                # actually asleep; a spinning writer watches the head word
+                # natively (tpr_spin_u64_change) and needs no byte.
+                if force or self._peer_waiting("write"):
+                    self._notify(NOTIFY_CREDIT)
 
     # -- data plane -----------------------------------------------------------
 
@@ -702,14 +819,16 @@ class Pair:
             if not views:
                 self.want_write = False
             self.total_sent += total
-            # ONE completion event per send call, not per chunk: round 1's
-            # per-chunk token (64 syscalls + wakeups per 4 MiB) was a measured
-            # throughput killer. The ring contents are visible to a spinning
-            # receiver the instant each chunk's header lands; the token only
-            # unblocks an event-discipline receiver parked in select, and one
-            # token wakes it for everything written so far (the reference
-            # likewise wakes only via poller/completion, poller.cc:92-101).
-            if total:
+            # ONE completion event per send call, not per chunk (round 1's
+            # per-chunk token was a measured throughput killer) — and only
+            # when a receiver is actually ASLEEP on its notify fd. A spinning
+            # receiver sees the ring header the instant it lands; skipping
+            # the byte makes the BP/BPEV fast path a zero-syscall send, the
+            # reference's defining property (its RDMA WRITE needs no
+            # completion on the passive side; only the event path wakes via
+            # the completion channel, poller.cc:92-101). The waiting flag +
+            # fences make the skip lossless (ring.cc sleep-protocol proof).
+            if total and self._peer_waiting("read"):
                 self._notify(NOTIFY_DATA)
             return total
 
@@ -783,28 +902,30 @@ class Pair:
             reader = self.reader
             if reader is None or reader._msg_len:
                 return True
-            try:
-                arr = np.frombuffer(reader.buf, dtype=np.uint8)
-            except ValueError:
-                return True  # ring released under us; predicate will surface it
+            pin = reader._nat_pin  # local ref pins the ring across the call
+            if pin is None:
+                try:
+                    pin, addr = _native.pin(reader.buf, writable=True)
+                except (ValueError, TypeError):
+                    return True  # ring released; predicate will surface it
+            else:
+                addr = reader._nat_addr
             r = spin.tpr_ring_wait_message(
-                arr.ctypes.data, reader.layout.capacity, reader.head,
+                addr, reader.layout.capacity, reader.head,
                 reader.seq, timeout_us)
             return r != 0
-        region = self.status_region
         writer = self.writer
-        if region is None or writer is None:
+        if writer is None:
             return True
-        try:
-            arr = np.frombuffer(region.buf, dtype=np.uint8)
-        except ValueError:
+        pin = self._status_pin()  # local ref pins across the GIL-free call
+        if pin is None:
             return True
         # Watch for divergence from the last FOLDED credit value, not from the
         # word's current value: a credit that landed between the caller's
         # predicate check and this call returns immediately instead of
         # spinning a whole slice past it.
         r = spin.tpr_spin_u64_change(
-            arr.ctypes.data + _STATUS_HEAD_OFF, writer.remote_head, timeout_us)
+            pin[1] + _STATUS_HEAD_OFF, writer.remote_head, timeout_us)
         return r != 0
 
     # -- close / liveness ------------------------------------------------------
@@ -861,11 +982,16 @@ class Pair:
             self.reader.release()
             self.reader = None
         self.writer = None
+        # Order against _peer_status_pin's re-cache race: null the ATTRIBUTE
+        # first (new pins become impossible), then the cache, then close —
+        # an in-flight _peer_waiting still pinning through a local is covered
+        # by the retry.
         for attr in ("_peer_ring", "_peer_status"):
             w = getattr(self, attr)
             if w is not None:
-                w.close()
                 setattr(self, attr, None)
+                self._peer_status_np = None
+                retry_buffer_op(w.close)
         if self.notify_sock is not None:
             try:
                 self.notify_sock.close()
@@ -882,11 +1008,14 @@ class Pair:
                     pipes[role] = -1
 
     def _release_regions(self) -> None:
+        # Attribute first, then cache, then close (see _peer-status comment in
+        # _release_channels; _status_pin re-checks the attribute after caching).
         for attr in ("recv_region", "status_region"):
             r = getattr(self, attr)
             if r is not None:
-                r.close()
                 setattr(self, attr, None)
+                self._status_np = None
+                r.close()
 
     def _release_resources(self) -> None:
         self._release_channels()

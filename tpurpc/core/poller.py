@@ -287,22 +287,31 @@ def _wait(pair: Pair, timeout: Optional[float], discipline: Optional[str],
         # nothing registerable — the pair's channels are (being) released;
         # never block on an empty selector
         return ready()
-    while True:
-        if ready():
-            return True
-        remain = None if deadline is None else deadline - time.monotonic()
-        if remain is not None and remain <= 0:
-            return ready()
-        try:
-            events = sel.select(timeout=remain)
-        except (OSError, ValueError):
-            # A racing local close() invalidated a registered fd — that IS
-            # a state change; surface it through the predicate.
-            return ready()
-        if events:
-            pair.consume_wakeup(role)
+    # Advertise "blocked on the notify fd" for the whole sleeping phase, so
+    # producers pay the notify syscall only while someone is actually asleep
+    # (futex-style handshake; fences + lost-wakeup proof in ring.cc). Order
+    # matters: flag up (full fence) BEFORE the predicate re-check before each
+    # select — a producer that missed the flag must be visible to the
+    # re-check, and one that saw it sends the byte the select consumes.
+    pair.set_waiting(role, True)
+    try:
+        while True:
             if ready():
                 return True
+            remain = None if deadline is None else deadline - time.monotonic()
+            if remain is not None and remain <= 0:
+                return ready()
+            try:
+                events = sel.select(timeout=remain)
+            except (OSError, ValueError):
+                # A racing local close() invalidated a registered fd — that IS
+                # a state change; surface it through the predicate.
+                return ready()
+            if events:
+                pair.consume_wakeup(role)
+                # loop back to the top, where ready() re-checks the predicate
+    finally:
+        pair.set_waiting(role, False)
 
 
 class PairPool:

@@ -134,12 +134,16 @@ class RingReader:
         # Credit state (pair.cc:276-284: publish after consuming >= half ring).
         self.consumed_since_publish = 0
         # Native fast path: scan/copy/zero in C++ when the lib is built and the
-        # ring memory is addressable (shm/local buffers always are).
+        # ring memory is addressable (shm/local buffers always are). The pin
+        # (a live np view) is what keeps the cached address valid: the ring
+        # cannot unmap while it exists; release() drops it first.
         self._nat = _native.load()
         self._nat_addr = None
+        self._nat_pin = None
         if self._nat is not None:
             try:
-                self._nat_addr = _native.addr_of(self.buf, writable=True)
+                self._nat_pin, self._nat_addr = _native.pin(
+                    self.buf, writable=True)
             except (ValueError, TypeError):
                 self._nat = None
 
@@ -333,6 +337,7 @@ class RingReader:
         one bounded slice."""
         import time
 
+        self._nat_pin = None  # drop our own export before releasing
         deadline = time.monotonic() + 2.0
         while True:
             try:

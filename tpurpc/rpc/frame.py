@@ -326,19 +326,23 @@ class FrameReader:
         # (dst, rest, stream_id, flags) — resumed by the next read_frame.
         self._pending_msg: Optional[tuple] = None
 
-    def _fill(self, need: int, timeout: Optional[float] = None) -> bool:
-        """Grow the buffer to ≥ need bytes; False on clean EOF first.
+    #: Opportunistic read-ahead for control structures. One endpoint read
+    #: (syscall / ring drain) usually picks up a whole burst of small frames
+    #: — header+metadata+message+trailers of the unary fast path — instead of
+    #: one read per deficit (profiled: ~10 ring drains per 64B RPC before).
+    #: The cost is bounded: at most this many MESSAGE-payload bytes get
+    #: dragged through _buf (then handed to the sink from there), noise next
+    #: to a saved syscall on the small path and next to the payload itself on
+    #: the bulk path (8 KiB per ≥1 MiB frame ≤ 0.8%).
+    READ_AHEAD = 8192
 
-        Reads EXACTLY the deficit, never ahead: over-reading would drag MESSAGE
-        payload bytes through this buffer, adding a copy to the bulk path whose
-        whole point (sink routing) is to skip it. Control structures are tiny,
-        so the extra small recv per frame is noise next to a saved 1MiB memcpy.
-        """
+    def _fill(self, need: int, timeout: Optional[float] = None) -> bool:
+        """Grow the buffer to ≥ need bytes; False on clean EOF first."""
         while len(self._buf) < need:
             if self._eof:
                 return False
-            n = self._ep.read_into(self._scratch_mv[:need - len(self._buf)],
-                                   timeout=timeout)
+            want = max(need - len(self._buf), self.READ_AHEAD)
+            n = self._ep.read_into(self._scratch_mv[:want], timeout=timeout)
             if n == 0:
                 self._eof = True
                 return len(self._buf) >= need
