@@ -15,11 +15,12 @@ class _CollectSink(fr.MessageSink):
         self.done = []
 
     def buffer_for(self, stream_id):
-        return self.buffers.setdefault(stream_id, bytearray())
+        return self.buffers.setdefault(stream_id, fr.Assembly())
 
     def commit(self, stream_id, flags):
         if not flags & fr.FLAG_MORE:
-            self.done.append((stream_id, bytes(self.buffers.pop(stream_id))))
+            self.done.append(
+                (stream_id, bytes(self.buffers.pop(stream_id).take())))
 
 
 def test_sink_assembles_fragmented_gather_message():

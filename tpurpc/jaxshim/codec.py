@@ -313,3 +313,17 @@ def tree_serializer(tree) -> List[bytes]:
 
 def tree_deserializer(buf) -> Any:
     return decode_tree(buf)
+
+
+def raw_view(buf):
+    """Identity deserializer that opts INTO receiving the assembly view
+    (``alias_ok``): device-mode tensor handlers decode it themselves."""
+    return buf
+
+
+# These decode zero-copy over the received assembly view; the rpc layer hands
+# them the memoryview as-is instead of materializing grpcio-style bytes
+# (tpurpc.rpc.status.deserialize).
+tensor_deserializer.alias_ok = True
+tree_deserializer.alias_ok = True
+raw_view.alias_ok = True

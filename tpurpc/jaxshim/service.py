@@ -120,7 +120,7 @@ def add_tensor_method(server: Server, name: str,
             finally:
                 finish()
         handler = unary_unary_rpc_method_handler(
-            behavior, response_serializer=codec.tree_serializer)
+            behavior, codec.raw_view, codec.tree_serializer)
     elif kind == "unary_stream":
         def behavior(raw, ctx):
             decode, finish = _device_decoder(ctx)
@@ -129,7 +129,7 @@ def add_tensor_method(server: Server, name: str,
             finally:
                 finish()
         handler = unary_stream_rpc_method_handler(
-            behavior, response_serializer=codec.tree_serializer)
+            behavior, codec.raw_view, codec.tree_serializer)
     elif kind == "stream_stream":
         def behavior(raw_iter, ctx):
             decode, finish = _device_decoder(ctx)
@@ -138,7 +138,7 @@ def add_tensor_method(server: Server, name: str,
             finally:
                 finish()
         handler = stream_stream_rpc_method_handler(
-            behavior, response_serializer=codec.tree_serializer)
+            behavior, codec.raw_view, codec.tree_serializer)
     else:
         raise ValueError(f"unsupported tensor method kind {kind}")
     server.add_method(_method_path(name), handler)
@@ -167,7 +167,7 @@ class TensorClient:
         from tpurpc.tpu.endpoint import DeviceMessage, decode_tree_to_ring
 
         mc = self._channel.unary_unary(
-            _method_path(name), codec.tree_serializer)
+            _method_path(name), codec.tree_serializer, codec.raw_view)
         raw, call = mc.with_call(tree, timeout=timeout)
         # The call's OWN connection: an LB re-pick here could land the
         # response in a different connection's ring (or fail a finished call).

@@ -25,7 +25,8 @@ from typing import Callable, Iterable, Iterator, List, Optional, Sequence, Tuple
 from tpurpc.core.endpoint import Endpoint, EndpointError, connect_endpoint
 from tpurpc.rpc import frame as fr
 from tpurpc.rpc.status import (Deserializer, Metadata, RpcError, Serializer,
-                               StatusCode, identity_codec as _identity)
+                               StatusCode, deserialize as _deserialize,
+                               identity_codec as _identity)
 from tpurpc.utils.trace import TraceFlag
 
 trace_channel = TraceFlag("channel")
@@ -40,15 +41,15 @@ class _ClientStream:
         self.initial_metadata: Optional[List[Tuple[str, "str | bytes"]]] = None
         #: fragment assembly — the FrameReader sink appends wire bytes here
         #: directly (single receive-side copy; no per-fragment bytes + join)
-        self.assembly = bytearray()
+        self.assembly = fr.Assembly()
         self.done = False  # trailers or failure delivered
 
     def commit_message(self, more: bool) -> None:
         if more:
             return
-        whole = self.assembly
-        self.assembly = bytearray()
-        self.events.put(("message", whole))
+        # take() detaches the storage (consumers may alias it); the Assembly
+        # object itself is reusable for the next message.
+        self.events.put(("message", self.assembly.take()))
 
     def deliver_trailers(self, code: StatusCode, details: str, md) -> None:
         self.done = True
@@ -64,13 +65,13 @@ class _ChannelSink(fr.MessageSink):
 
     def __init__(self, conn: "_Connection"):
         self._conn = conn
-        self._discard = bytearray()  # sink for late frames of dead streams
+        self._discard = fr.Assembly()  # sink for late frames of dead streams
 
-    def buffer_for(self, stream_id: int) -> bytearray:
+    def buffer_for(self, stream_id: int) -> fr.Assembly:
         with self._conn._lock:
             st = self._conn._streams.get(stream_id)
         if st is None:
-            del self._discard[:]
+            self._discard.take()  # drop late bytes
             return self._discard
         return st.assembly
 
@@ -145,7 +146,7 @@ class _Connection:
         if st is None:
             return  # late frame for a cancelled/finished stream
         if f.type == fr.MESSAGE:  # only without a sink (never in practice)
-            st.assembly += f.payload
+            st.assembly.append(f.payload)
             st.commit_message(bool(f.flags & fr.FLAG_MORE))
         elif f.type == fr.HEADERS:
             md, _ = fr.decode_metadata(f.payload)
@@ -462,7 +463,7 @@ class Call:
             if ev[0] == "initial_metadata":
                 continue
             if ev[0] == "message":
-                yield self._deser(ev[1])
+                yield _deserialize(self._deser, ev[1])
                 continue
             _, code, details, md = ev
             self._finish(code, details, md)

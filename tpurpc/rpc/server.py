@@ -29,7 +29,8 @@ from tpurpc.core.endpoint import (Endpoint, EndpointError, EndpointListener,
                                   passthru_endpoint_pair)
 from tpurpc.rpc import frame as fr
 from tpurpc.rpc.status import (AbortError, Deserializer, Metadata, Serializer,
-                               StatusCode, identity_codec as _identity)
+                               StatusCode, deserialize as _deserialize,
+                               identity_codec as _identity)
 from tpurpc.utils.trace import TraceFlag
 
 trace_server = TraceFlag("server")
@@ -177,16 +178,16 @@ class _ServerStream:
         self.stream_id = stream_id
         self.requests: "queue.Queue[object]" = queue.Queue()
         #: fragment assembly — the FrameReader sink appends wire bytes here
-        self.assembly = bytearray()
+        self.assembly = fr.Assembly()
         self.half_closed = False
         self.context: Optional[ServerContext] = None
 
     def commit_message(self, more: bool, end_stream: bool,
                        no_message: bool = False) -> None:
         if not no_message and not more:
-            whole = self.assembly
-            self.assembly = bytearray()
-            self.requests.put(whole)
+            # take() detaches the storage (consumers may alias it); the
+            # Assembly object itself is reusable for the next message.
+            self.requests.put(self.assembly.take())
         if end_stream:
             self.half_closed = True
             self.requests.put(self._END)
@@ -204,7 +205,7 @@ class _ServerStream:
                 return
             if not context.is_active():
                 return
-            yield deserializer(item)
+            yield _deserialize(deserializer, item)
 
 
 class _ServerSink(fr.MessageSink):
@@ -212,13 +213,13 @@ class _ServerSink(fr.MessageSink):
 
     def __init__(self, conn: "_ServerConnection"):
         self._conn = conn
-        self._discard = bytearray()
+        self._discard = fr.Assembly()
 
-    def buffer_for(self, stream_id: int) -> bytearray:
+    def buffer_for(self, stream_id: int) -> fr.Assembly:
         with self._conn._lock:
             st = self._conn._streams.get(stream_id)
         if st is None:
-            del self._discard[:]
+            self._discard.take()  # drop late bytes
             return self._discard
         return st.assembly
 
@@ -279,7 +280,7 @@ class _ServerConnection:
         if st is None:
             return  # frame for a finished/cancelled stream
         if f.type == fr.MESSAGE:  # only without a sink (never in practice)
-            st.assembly += f.payload
+            st.assembly.append(f.payload)
             st.commit_message(bool(f.flags & fr.FLAG_MORE),
                               bool(f.flags & fr.FLAG_END_STREAM),
                               bool(f.flags & fr.FLAG_NO_MESSAGE))
@@ -341,7 +342,7 @@ class _ServerConnection:
                             st, StatusCode.INVALID_ARGUMENT,
                             "client half-closed before sending a request")
                     return
-                request_in = handler.request_deserializer(item)
+                request_in = _deserialize(handler.request_deserializer, item)
 
             result = handler.behavior(request_in, ctx)
 

@@ -21,6 +21,24 @@ def identity_codec(x):
     return x
 
 
+def deserialize(deserializer, buf):
+    """Apply ``deserializer`` to a received message buffer.
+
+    Assembled messages arrive as memoryviews over detached Assembly storage
+    (``tpurpc.rpc.frame``). grpcio's contract hands deserializers *bytes*, so
+    views are materialized first (a real, LEDGERED host copy) — except for
+    deserializers marked ``alias_ok = True`` (the tensor codec), which decode
+    zero-copy straight over the view. Only the raw-bytes surface pays the
+    materialization; the bulk tensor path keeps the saved pass."""
+    if isinstance(buf, memoryview) and not getattr(deserializer, "alias_ok",
+                                                   False):
+        from tpurpc.tpu import ledger as _ledger
+
+        _ledger.host_copy(len(buf))
+        buf = bytes(buf)
+    return deserializer(buf)
+
+
 class StatusCode(enum.IntEnum):
     OK = 0
     CANCELLED = 1
