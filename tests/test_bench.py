@@ -76,3 +76,63 @@ def test_qps_localhost_scenario_two_clients():
     assert agg["rpcs"] > 20
     assert agg["rate_rps"] > 0
     assert agg["rtt_us"]["p50"] > 0
+
+
+def _cpus() -> int:
+    import os
+
+    try:
+        return len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        return os.cpu_count() or 1
+
+
+@pytest.mark.skipif(_cpus() < 4, reason=(
+    "ring-beats-TCP is a property of the spinning data plane: with <4 cores "
+    "the hybrid discipline degrades to event (poller.py) and the measurement "
+    "compares scheduler wakeup latencies, not transports. The bench host "
+    "(multi-core TPU VM) runs this; single-hart CI skips."))
+def test_ring_beats_tcp_small_unary(monkeypatch):
+    """The reference's defining property (README.md:1-8): the ring path must
+    beat the TCP fallback on the same host. 64B closed-loop unary."""
+    import io as _io
+
+    import tpurpc.utils.config as config_mod
+
+    results = {}
+    for platform in ("TCP", "RDMA_BPEV"):
+        monkeypatch.setenv("GRPC_PLATFORM_TYPE", platform)
+        config_mod.set_config(None)
+        srv = micro.run_server(0)
+        try:
+            r = micro.run_client(f"127.0.0.1:{srv.bench_port}", req_size=64,
+                                 duration=2.0, report_every=10,
+                                 out=_io.StringIO())
+        finally:
+            srv.stop(grace=0)
+        results[platform] = r
+    assert (results["RDMA_BPEV"]["rtt_us"]["p50"]
+            < results["TCP"]["rtt_us"]["p50"]), results
+
+
+@pytest.mark.skipif(_cpus() < 4, reason="see test_ring_beats_tcp_small_unary")
+def test_ring_beats_tcp_streaming_bandwidth(monkeypatch):
+    """1MiB streaming ping-pong bandwidth: ring >= TCP on a spinning host."""
+    import io as _io
+
+    import tpurpc.utils.config as config_mod
+
+    rates = {}
+    for platform in ("TCP", "RDMA_BPEV"):
+        monkeypatch.setenv("GRPC_PLATFORM_TYPE", platform)
+        config_mod.set_config(None)
+        srv = micro.run_server(0)
+        try:
+            r = micro.run_client(f"127.0.0.1:{srv.bench_port}",
+                                 req_size=1 << 20, streaming=True,
+                                 duration=2.0, report_every=10,
+                                 out=_io.StringIO())
+        finally:
+            srv.stop(grace=0)
+        rates[platform] = r["rpcs"]
+    assert rates["RDMA_BPEV"] >= rates["TCP"], rates

@@ -1,0 +1,202 @@
+"""Wire-compat, roles swapped: the tpurpc H2Channel against a STOCK grpcio
+server (the other half of the drop-in proof — ``test_grpc_compat.py`` covers
+stock clients hitting tpurpc servers).
+
+The grpcio server here is the real C-core: full HPACK (huffman + dynamic
+table), real flow control, trailers-only errors — everything a compliant
+client must survive. Mirrors the reference's property that its client stack
+IS gRPC (chttp2_connector, SURVEY.md §3.2).
+"""
+
+import threading
+import time
+from concurrent import futures
+
+import grpc
+import pytest
+
+from tpurpc.rpc.status import RpcError, StatusCode
+from tpurpc.wire.h2_client import H2Channel
+
+_ID = lambda x: x
+
+
+class _Handlers(grpc.GenericRpcHandler):
+    """Raw-bytes service on a stock grpcio server."""
+
+    def service(self, details):
+        name = details.method.rsplit("/", 1)[-1]
+        if name == "Echo":
+            return grpc.unary_unary_rpc_method_handler(
+                lambda req, ctx: req,
+                request_deserializer=_ID, response_serializer=_ID)
+        if name == "Tail":
+            def tail(req, ctx):
+                for i in range(4):
+                    yield req + str(i).encode()
+            return grpc.unary_stream_rpc_method_handler(
+                tail, request_deserializer=_ID, response_serializer=_ID)
+        if name == "Collect":
+            def collect(req_iter, ctx):
+                return b"|".join(req_iter)
+            return grpc.stream_unary_rpc_method_handler(
+                collect, request_deserializer=_ID, response_serializer=_ID)
+        if name == "Chat":
+            def chat(req_iter, ctx):
+                for req in req_iter:
+                    yield b"re:" + req
+            return grpc.stream_stream_rpc_method_handler(
+                chat, request_deserializer=_ID, response_serializer=_ID)
+        if name == "Boom":
+            def boom(req, ctx):
+                ctx.set_trailing_metadata((("saw-md", "yes"),))
+                ctx.abort(grpc.StatusCode.FAILED_PRECONDITION, "nope: not ready")
+            return grpc.unary_unary_rpc_method_handler(
+                boom, request_deserializer=_ID, response_serializer=_ID)
+        if name == "Meta":
+            def meta(req, ctx):
+                md = {k: v for k, v in ctx.invocation_metadata()}
+                ctx.set_trailing_metadata(
+                    (("echoed-key", md.get("x-custom", "?")),
+                     ("bin-bin", md.get("x-blob-bin", b"")),))
+                return req
+            return grpc.unary_unary_rpc_method_handler(
+                meta, request_deserializer=_ID, response_serializer=_ID)
+        if name == "Slow":
+            def slow(req, ctx):
+                time.sleep(5)
+                return req
+            return grpc.unary_unary_rpc_method_handler(
+                slow, request_deserializer=_ID, response_serializer=_ID)
+        return None  # UNIMPLEMENTED
+
+
+@pytest.fixture(scope="module")
+def rig():
+    srv = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+    srv.add_generic_rpc_handlers((_Handlers(),))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    ch = H2Channel(f"127.0.0.1:{port}")
+    yield srv, port, ch
+    ch.close()
+    srv.stop(grace=0)
+
+
+def test_unary_roundtrip(rig):
+    _, _, ch = rig
+    mc = ch.unary_unary("/test.Echo/Echo")
+    assert mc(b"hello from tpurpc", timeout=20) == b"hello from tpurpc"
+
+
+def test_unary_large_flow_controlled(rig):
+    """4 MiB both directions: DATA chunking under the peer's max-frame and
+    conn+stream windows, window replenishment on receive."""
+    _, _, ch = rig
+    mc = ch.unary_unary("/test.Echo/Echo")
+    big = bytes(range(256)) * (4 * 4096)  # 4 MiB
+    assert mc(big, timeout=60) == big
+
+
+def test_server_streaming(rig):
+    _, _, ch = rig
+    mc = ch.unary_stream("/test.Echo/Tail")
+    assert list(mc(b"x", timeout=20)) == [b"x0", b"x1", b"x2", b"x3"]
+
+
+def test_client_streaming(rig):
+    _, _, ch = rig
+    mc = ch.stream_unary("/test.Echo/Collect")
+    assert mc(iter([b"a", b"b", b"c"]), timeout=20) == b"a|b|c"
+
+
+def test_bidi_streaming(rig):
+    _, _, ch = rig
+    mc = ch.stream_stream("/test.Echo/Chat")
+    assert list(mc(iter([b"1", b"2"]), timeout=20)) == [b"re:1", b"re:2"]
+
+
+def test_error_status_message_and_trailing_metadata(rig):
+    _, _, ch = rig
+    mc = ch.unary_unary("/test.Echo/Boom")
+    with pytest.raises(RpcError) as ei:
+        mc(b"x", timeout=20)
+    assert ei.value.code() is StatusCode.FAILED_PRECONDITION
+    assert "nope: not ready" in ei.value.details()
+    md = dict(ei.value.trailing_metadata() or [])
+    assert md.get("saw-md") == "yes"
+
+
+def test_metadata_roundtrip_incl_binary(rig):
+    _, _, ch = rig
+    mc = ch.unary_unary("/test.Echo/Meta")
+    # metadata travels out; echoed values come back in trailers, but a
+    # successful call doesn't raise — use Boom-style check via a failing
+    # variant is not available, so assert via the error-free path + a second
+    # call carrying different metadata (dynamic-table exercise).
+    assert mc(b"m", timeout=20,
+              metadata=(("x-custom", "v123"),
+                        ("x-blob-bin", b"\x00\x01\xfe"))) == b"m"
+    assert mc(b"m2", timeout=20,
+              metadata=(("x-custom", "v456"),
+                        ("x-blob-bin", b"\xff\x00"))) == b"m2"
+
+
+def test_unimplemented_maps_to_status(rig):
+    _, _, ch = rig
+    mc = ch.unary_unary("/test.Echo/Nope")
+    with pytest.raises(RpcError) as ei:
+        mc(b"x", timeout=20)
+    assert ei.value.code() is StatusCode.UNIMPLEMENTED
+
+
+def test_deadline_expires_fast(rig):
+    _, _, ch = rig
+    mc = ch.unary_unary("/test.Echo/Slow")
+    t0 = time.monotonic()
+    with pytest.raises(RpcError) as ei:
+        mc(b"x", timeout=0.5)
+    assert ei.value.code() is StatusCode.DEADLINE_EXCEEDED
+    assert time.monotonic() - t0 < 3
+
+
+def test_many_sequential_calls_exercise_dynamic_table(rig):
+    """Repeated calls with repeating headers: the dynamic-table encoder path
+    must stay in sync with grpcio's decoder across many HEADERS blocks."""
+    _, _, ch = rig
+    mc = ch.unary_unary("/test.Echo/Echo")
+    for i in range(20):
+        payload = f"msg-{i}".encode()
+        assert mc(payload, timeout=20,
+                  metadata=(("x-repeat", "const"),)) == payload
+
+
+def test_many_concurrent_calls(rig):
+    _, _, ch = rig
+    mc = ch.unary_unary("/test.Echo/Echo")
+    results = [None] * 16
+
+    def one(i):
+        results[i] = mc(f"m{i}".encode(), timeout=30)
+
+    ts = [threading.Thread(target=one, args=(i,)) for i in range(16)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert results == [f"m{i}".encode() for i in range(16)]
+
+
+def test_h2channel_against_tpurpc_server():
+    """Full circle: our h2 client against our own server's sniffed h2 path."""
+    import tpurpc.rpc as tps
+
+    srv = tps.Server(max_workers=4)
+    srv.add_method("/test.Echo/Echo",
+                   tps.unary_unary_rpc_method_handler(lambda req, ctx: req))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    try:
+        with H2Channel(f"127.0.0.1:{port}") as ch:
+            mc = ch.unary_unary("/test.Echo/Echo")
+            assert mc(b"self-interop", timeout=20) == b"self-interop"
+    finally:
+        srv.stop(grace=0)

@@ -41,3 +41,7 @@ from tpurpc.rpc.interceptors import (ClientInterceptor, FaultConfig,
 
 __all__ += ["ClientInterceptor", "FaultConfig", "FaultInjector",
             "ServerInterceptor", "intercept_channel"]
+
+from tpurpc.wire.h2_client import H2Channel  # noqa: E402  (gRPC wire-compat client)
+
+__all__ += ["H2Channel"]

@@ -1,0 +1,594 @@
+"""gRPC-over-HTTP/2 client: tpurpc calls stock gRPC servers unchanged.
+
+The other half of the drop-in capability (the server half is
+``tpurpc/wire/grpc_h2.py``): :class:`H2Channel` dials any grpc-compliant
+server — grpcio, grpc++, a tpurpc server's sniffed h2 path — and exposes the
+same four grpcio-shaped multicallables as :class:`tpurpc.rpc.channel.Channel`.
+
+Protocol mapping (gRPC PROTOCOL-HTTP2 spec; reference: the chttp2 client
+stack — chttp2_connector + ``ext/transport/chttp2/`` + ``surface/call.cc``,
+SURVEY.md §3.2-3.3 — re-derived from the spec, not ported):
+
+* connection preface + SETTINGS exchange, HEADERS with ``:method: POST``,
+  ``:path: /Service/Method``, ``te: trailers``,
+  ``content-type: application/grpc``, ``grpc-timeout``, ``-bin`` metadata as
+  unpadded base64;
+* requests as 5-byte length-prefixed messages in DATA frames, chunked to the
+  peer's SETTINGS_MAX_FRAME_SIZE under both connection and stream send
+  windows;
+* responses: initial-metadata HEADERS, DATA → message reassembly,
+  trailers (HEADERS+END_STREAM) carrying ``grpc-status``/``grpc-message``
+  (percent-decoded), including the trailers-only form;
+* HPACK with a DYNAMIC encoder table (``:path``/user metadata repeat per
+  call → 1-2 byte fields after the first), sized down to the peer's
+  SETTINGS_HEADER_TABLE_SIZE;
+* PING ack, GOAWAY → UNAVAILABLE on open calls, RST_STREAM → status,
+  aggressive receive-window grants (tensors are big).
+"""
+
+from __future__ import annotations
+
+import base64
+import logging
+import queue
+import socket
+import struct
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from tpurpc.core.endpoint import Endpoint, EndpointError, ReadTimeout, TcpEndpoint
+from tpurpc.rpc.status import Metadata, RpcError, StatusCode
+from tpurpc.wire import h2
+from tpurpc.wire.grpc_h2 import (RECV_WINDOW, _decode_metadata_value,
+                                 _encode_metadata_value)
+from tpurpc.wire.hpack import HpackDecoder, HpackEncoder, HpackError
+
+_log = logging.getLogger("tpurpc.h2_client")
+
+_GRPC_MSG_HDR = struct.Struct("!BI")
+
+
+def _pct_decode(raw: str) -> str:
+    out = bytearray()
+    i = 0
+    while i < len(raw):
+        c = raw[i]
+        if c == "%" and i + 2 < len(raw):
+            try:
+                out.append(int(raw[i + 1:i + 3], 16))
+                i += 3
+                continue
+            except ValueError:
+                pass
+        out.extend(c.encode("utf-8"))
+        i += 1
+    return out.decode("utf-8", "replace")
+
+
+def _grpc_timeout(seconds: float) -> str:
+    """Largest-unit encoding that fits the spec's 8-digit cap."""
+    for unit, scale in (("n", 1e9), ("u", 1e6), ("m", 1e3)):
+        v = int(seconds * scale)
+        if v < 1e8:
+            return f"{max(v, 1)}{unit}"
+    return f"{min(int(seconds), 99999999)}S"
+
+
+class _H2Call:
+    """Client-side per-stream state, fed by the reader thread."""
+
+    def __init__(self, stream_id: int, deadline: Optional[float]):
+        self.stream_id = stream_id
+        self.deadline = deadline
+        self.events: "queue.Queue[tuple]" = queue.Queue()
+        self.partial = bytearray()   # gRPC message assembly across DATA
+        self.initial_md: Optional[List[Tuple[str, object]]] = None
+        self.window: Optional[h2.FlowWindow] = None  # send window
+        self.trailing_md: Optional[List[Tuple[str, object]]] = None
+        self.code: Optional[StatusCode] = None
+        self.details = ""
+
+    # reader-thread side -----------------------------------------------------
+
+    def feed_data(self, chunk: bytes) -> int:
+        """Append DATA payload; emit completed gRPC messages. Returns the
+        number of flow-control bytes consumed (== len(chunk))."""
+        self.partial += chunk
+        while len(self.partial) >= 5:
+            compressed, length = _GRPC_MSG_HDR.unpack_from(self.partial)
+            if len(self.partial) - 5 < length:
+                break
+            if compressed:
+                self.deliver_status(
+                    StatusCode.UNIMPLEMENTED,
+                    "compressed gRPC messages not supported", [])
+                return len(chunk)
+            msg = bytes(self.partial[5:5 + length])
+            del self.partial[:5 + length]
+            self.events.put(("message", msg))
+        return len(chunk)
+
+    def deliver_initial(self, md: List[Tuple[str, object]]) -> None:
+        self.initial_md = md
+        self.events.put(("initial_metadata", md))
+
+    def deliver_status(self, code: StatusCode, details: str,
+                       md: List[Tuple[str, object]]) -> None:
+        self.events.put(("status", code, details, md))
+
+    # caller side ------------------------------------------------------------
+
+    def _remaining(self) -> Optional[float]:
+        if self.deadline is None:
+            return None
+        return self.deadline - time.monotonic()
+
+    def next_event(self) -> tuple:
+        remain = self._remaining()
+        if remain is not None and remain <= 0:
+            raise RpcError(StatusCode.DEADLINE_EXCEEDED, "deadline exceeded")
+        try:
+            return self.events.get(timeout=remain)
+        except queue.Empty:
+            raise RpcError(StatusCode.DEADLINE_EXCEEDED,
+                           "deadline exceeded awaiting response") from None
+
+
+class H2Channel:
+    """A gRPC-over-HTTP/2 client channel (one connection, multiplexed calls).
+
+    grpcio-shaped surface: ``unary_unary`` / ``unary_stream`` /
+    ``stream_unary`` / ``stream_stream`` return multicallables accepting
+    ``(request, timeout=None, metadata=None)``.
+    """
+
+    def __init__(self, target: str, connect_timeout: float = 30.0,
+                 authority: Optional[str] = None):
+        host, _, port = target.rpartition(":")
+        sock = socket.create_connection((host or "127.0.0.1", int(port)),
+                                        timeout=connect_timeout)
+        sock.settimeout(None)
+        self._ep: Endpoint = TcpEndpoint(sock)
+        self._authority = authority or target
+        self._lock = threading.Lock()
+        self._wlock = threading.Lock()   # serializes writes + HPACK encoder
+        self._calls: Dict[int, _H2Call] = {}
+        self._next_stream = 1
+        self._dead: Optional[str] = None
+
+        self._enc = HpackEncoder(dynamic=True)
+        self._dec = HpackDecoder()
+        self._peer_max_frame = h2.DEFAULT_MAX_FRAME
+        self._peer_initial_window = h2.DEFAULT_WINDOW
+        self._conn_window = h2.FlowWindow(h2.DEFAULT_WINDOW)  # our sends
+        self._settings_acked = threading.Event()
+
+        with self._wlock:
+            self._ep.write([h2.PREFACE]
+                           + h2.pack_settings({
+                               h2.SETTINGS_INITIAL_WINDOW_SIZE: RECV_WINDOW,
+                               h2.SETTINGS_MAX_FRAME_SIZE: 1 << 20})
+                           + h2.pack_window_update(0, RECV_WINDOW))
+        self._reader = threading.Thread(target=self._read_loop, daemon=True,
+                                        name="tpurpc-h2c-reader")
+        self._reader.start()
+
+    # -- connection lifecycle -------------------------------------------------
+
+    def close(self) -> None:
+        self._die("channel closed", notify_peer=True)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def _die(self, why: str, notify_peer: bool = False) -> None:
+        with self._lock:
+            if self._dead is not None:
+                return
+            self._dead = why
+            calls = list(self._calls.values())
+            self._calls.clear()
+        if notify_peer:
+            try:
+                with self._wlock:
+                    self._ep.write(h2.pack_goaway(0, h2.NO_ERROR))
+            except (EndpointError, OSError):
+                pass
+        for call in calls:
+            if call.window is not None:
+                call.window.kill()
+            call.deliver_status(StatusCode.UNAVAILABLE, f"connection: {why}", [])
+        self._conn_window.kill()
+        try:
+            self._ep.close()
+        except (EndpointError, OSError):
+            pass
+
+    # -- reader thread --------------------------------------------------------
+
+    def _read_loop(self) -> None:
+        scanner = h2.FrameScanner()
+        hdr_accum: Optional[Tuple[int, int, bytearray]] = None  # sid, flags, block
+        try:
+            while True:
+                frame = scanner.next_frame()
+                if frame is None:
+                    data = self._ep.read(1 << 20)
+                    if not data:
+                        self._die("server closed connection")
+                        return
+                    scanner.feed(data)
+                    continue
+                ftype, flags, sid, payload = frame
+                if hdr_accum is not None and ftype != h2.CONTINUATION:
+                    raise h2.H2Error("expected CONTINUATION")
+                if ftype == h2.HEADERS:
+                    block = bytearray(
+                        h2.strip_padding(flags, payload, has_priority=True))
+                    if flags & h2.FLAG_END_HEADERS:
+                        self._on_headers(sid, flags, block)
+                    else:
+                        hdr_accum = (sid, flags, block)
+                elif ftype == h2.CONTINUATION:
+                    if hdr_accum is None or hdr_accum[0] != sid:
+                        raise h2.H2Error("unexpected CONTINUATION")
+                    hdr_accum[2].extend(payload)
+                    if flags & h2.FLAG_END_HEADERS:
+                        sid0, flags0, block = hdr_accum
+                        hdr_accum = None
+                        self._on_headers(sid0, flags0, block)
+                elif ftype == h2.DATA:
+                    self._on_data(sid, flags, payload)
+                elif ftype == h2.SETTINGS:
+                    self._on_settings(flags, payload)
+                elif ftype == h2.WINDOW_UPDATE:
+                    self._on_window_update(sid, payload)
+                elif ftype == h2.PING:
+                    if not flags & h2.FLAG_ACK:
+                        with self._wlock:
+                            self._ep.write(
+                                h2.pack_frame(h2.PING, h2.FLAG_ACK, 0, payload))
+                elif ftype == h2.RST_STREAM:
+                    (code,) = struct.unpack("!I", payload)
+                    call = self._pop_call(sid)
+                    if call is not None:
+                        call.deliver_status(
+                            StatusCode.CANCELLED if code == h2.CANCEL
+                            else StatusCode.UNAVAILABLE,
+                            f"stream reset by server (h2 error {code})", [])
+                elif ftype == h2.GOAWAY:
+                    last, code = struct.unpack_from("!II", payload)
+                    self._goaway_last = last
+                    self._die(f"server sent GOAWAY (error {code})")
+                    return
+                # PRIORITY / PUSH_PROMISE / unknown: ignored
+        except (EndpointError, h2.H2Error, HpackError, struct.error, OSError) as exc:
+            self._die(f"h2 read loop failed: {exc}")
+
+    def _get_call(self, sid: int) -> Optional[_H2Call]:
+        with self._lock:
+            return self._calls.get(sid)
+
+    def _pop_call(self, sid: int) -> Optional[_H2Call]:
+        with self._lock:
+            return self._calls.pop(sid, None)
+
+    def _on_headers(self, sid: int, flags: int, block: bytes) -> None:
+        headers = self._dec.decode(block)
+        call = self._get_call(sid)
+        if call is None:
+            return
+        md: List[Tuple[str, object]] = []
+        grpc_status: Optional[bytes] = None
+        grpc_message = b""
+        http_status = None
+        for k, v in headers:
+            key = k.decode("ascii", "replace")
+            if key == "grpc-status":
+                grpc_status = v
+            elif key == "grpc-message":
+                grpc_message = v
+            elif key == ":status":
+                http_status = v
+            elif key.startswith(":") or key in ("content-type",):
+                continue
+            else:
+                md.append((key, _decode_metadata_value(key, v)))
+        end = bool(flags & h2.FLAG_END_STREAM)
+        if grpc_status is not None or end:
+            # trailers (or trailers-only response)
+            if grpc_status is None:
+                code = (StatusCode.UNKNOWN if http_status == b"200"
+                        else StatusCode.UNAVAILABLE)
+                details = f"stream ended without grpc-status (:status {http_status})"
+            else:
+                try:
+                    code = StatusCode(int(grpc_status))
+                except ValueError:
+                    code = StatusCode.UNKNOWN
+                details = _pct_decode(grpc_message.decode("ascii", "replace"))
+            self._pop_call(sid)
+            call.trailing_md = md
+            call.deliver_status(code, details, md)
+        else:
+            call.deliver_initial(md)
+
+    def _on_data(self, sid: int, flags: int, payload: bytes) -> None:
+        data = h2.strip_padding(flags, payload, has_priority=False)
+        call = self._get_call(sid)
+        if call is not None and data:
+            call.feed_data(data)
+        # Replenish both windows aggressively (we sized RECV_WINDOW for
+        # tensors). RFC 7540 §6.9: flow control covers the ENTIRE DATA
+        # payload including padding, so grant len(payload), not len(data) —
+        # stripping-before-granting leaks the pad bytes until the sender's
+        # view of our window runs dry.
+        consumed = len(payload)
+        if consumed:
+            segs = h2.pack_window_update(0, consumed)
+            if call is not None:
+                segs = segs + h2.pack_window_update(sid, consumed)
+            with self._wlock:
+                self._ep.write(segs)
+        if flags & h2.FLAG_END_STREAM:
+            call2 = self._pop_call(sid)
+            if call2 is not None and call2.code is None:
+                # DATA+END_STREAM without trailers is a protocol violation in
+                # gRPC; surface it rather than hang the caller.
+                call2.deliver_status(
+                    StatusCode.INTERNAL, "stream ended without trailers", [])
+
+    def _on_settings(self, flags: int, payload: bytes) -> None:
+        if flags & h2.FLAG_ACK:
+            self._settings_acked.set()
+            return
+        settings = h2.parse_settings(payload)
+        if h2.SETTINGS_MAX_FRAME_SIZE in settings:
+            self._peer_max_frame = settings[h2.SETTINGS_MAX_FRAME_SIZE]
+        if h2.SETTINGS_INITIAL_WINDOW_SIZE in settings:
+            new = settings[h2.SETTINGS_INITIAL_WINDOW_SIZE]
+            # The write to _peer_initial_window and the snapshot of calls to
+            # retro-adjust must be ONE critical section with _start_call's
+            # window creation: a call created in between would otherwise get
+            # the new initial AND the adjust (double-applied delta →
+            # overrunning the server's window → FLOW_CONTROL_ERROR).
+            with self._lock:
+                delta = new - self._peer_initial_window
+                self._peer_initial_window = new
+                calls = list(self._calls.values())
+            for call in calls:
+                if call.window is not None:
+                    call.window.adjust(delta)
+        with self._wlock:
+            # Indexing stays off until this first SETTINGS is processed (the
+            # peer's table ceiling is unknown before); apply + ack under the
+            # write lock so no HEADERS block interleaves the transition.
+            self._enc.apply_peer_table_size(
+                settings.get(h2.SETTINGS_HEADER_TABLE_SIZE, 4096))
+            self._ep.write(h2.pack_settings({}, ack=True))
+
+    def _on_window_update(self, sid: int, payload: bytes) -> None:
+        (inc,) = struct.unpack("!I", payload)
+        inc &= 0x7FFFFFFF
+        if sid == 0:
+            self._conn_window.grant(inc)
+        else:
+            call = self._get_call(sid)
+            if call is not None and call.window is not None:
+                call.window.grant(inc)
+
+    # -- call machinery -------------------------------------------------------
+
+    def _check_alive(self) -> None:
+        with self._lock:
+            if self._dead is not None:
+                raise RpcError(StatusCode.UNAVAILABLE,
+                               f"channel dead: {self._dead}")
+
+    def _start_call(self, method: str, timeout: Optional[float],
+                    metadata: Optional[Metadata]) -> _H2Call:
+        self._check_alive()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        headers: List[Tuple[str, str]] = [
+            (":method", "POST"),
+            (":scheme", "http"),
+            (":path", method),
+            (":authority", self._authority),
+            ("te", "trailers"),
+            ("content-type", "application/grpc"),
+            ("user-agent", "tpurpc-h2/0.1"),
+        ]
+        if timeout is not None:
+            headers.append(("grpc-timeout", _grpc_timeout(timeout)))
+        for key, value in metadata or ():
+            headers.append((key, _encode_metadata_value(key, value)))
+        # sid allocation and the HEADERS write share one critical section:
+        # h2 requires new stream ids to appear on the wire in increasing
+        # order — a racing call writing its (higher) sid first makes the
+        # server treat the lower sid as implicitly closed and drop it.
+        with self._wlock:
+            with self._lock:
+                sid = self._next_stream
+                self._next_stream += 2
+                call = _H2Call(sid, deadline)
+                call.window = h2.FlowWindow(self._peer_initial_window)
+                self._calls[sid] = call
+            block = self._enc.encode(headers)
+            frames: List[bytes] = []
+            first = True
+            while first or block:
+                chunk, block = (block[:self._peer_max_frame],
+                                block[self._peer_max_frame:])
+                flags = (h2.FLAG_END_HEADERS if not block else 0)
+                frames.extend(h2.pack_frame(
+                    h2.HEADERS if first else h2.CONTINUATION,
+                    flags, sid, bytes(chunk)))
+                first = False
+            self._ep.write(frames)
+        return call
+
+    def _send_message(self, call: _H2Call, payload, end: bool) -> None:
+        data = (b"".join(bytes(s) for s in payload)
+                if isinstance(payload, (list, tuple)) else bytes(payload))
+        buf = _GRPC_MSG_HDR.pack(0, len(data)) + data
+        view = memoryview(buf)
+        while view:
+            want = min(len(view), self._peer_max_frame)
+            got = call.window.take(want, timeout=call._remaining())
+            conn_got = self._conn_window.take(got, timeout=call._remaining())
+            if conn_got < got:
+                # Another stream drained the shared connection window under
+                # us: return the stream credit we reserved but can't send,
+                # or it leaks and the call eventually wedges at window 0.
+                call.window.grant(got - conn_got)
+                got = conn_got
+            chunk = view[:got]
+            view = view[got:]
+            last = end and not view
+            with self._wlock:
+                self._ep.write(h2.pack_frame(
+                    h2.DATA, h2.FLAG_END_STREAM if last else 0,
+                    call.stream_id, bytes(chunk)))
+
+    def _half_close(self, call: _H2Call) -> None:
+        with self._wlock:
+            self._ep.write(h2.pack_frame(h2.DATA, h2.FLAG_END_STREAM,
+                                         call.stream_id, b""))
+
+    def _cancel(self, call: _H2Call) -> None:
+        self._pop_call(call.stream_id)
+        try:
+            with self._wlock:
+                self._ep.write(h2.pack_rst(call.stream_id, h2.CANCEL))
+        except (EndpointError, OSError):
+            pass
+
+    def _messages(self, call: _H2Call):
+        """Yield response messages until status; raise on non-OK."""
+        while True:
+            ev = call.next_event()
+            if ev[0] == "initial_metadata":
+                continue
+            if ev[0] == "message":
+                yield ev[1]
+                continue
+            _, code, details, md = ev
+            call.code, call.details = code, details
+            if code is not StatusCode.OK:
+                raise RpcError(code, details, md)
+            return
+
+    # -- grpcio-shaped surface ------------------------------------------------
+
+    def unary_unary(self, method: str, request_serializer=None,
+                    response_deserializer=None):
+        ser = request_serializer or (lambda x: x)
+        deser = response_deserializer or (lambda x: x)
+
+        def call_fn(request, timeout: Optional[float] = None,
+                    metadata: Optional[Metadata] = None):
+            call = self._start_call(method, timeout, metadata)
+            try:
+                self._send_message(call, ser(request), end=True)
+                msgs = list(self._messages(call))
+            except (h2.H2Error, EndpointError, TimeoutError) as exc:
+                self._cancel(call)
+                raise RpcError(StatusCode.UNAVAILABLE, str(exc)) from exc
+            except RpcError:
+                self._cancel(call)
+                raise
+            if len(msgs) != 1:
+                raise RpcError(StatusCode.INTERNAL,
+                               f"expected 1 response message, got {len(msgs)}")
+            return deser(msgs[0])
+
+        return call_fn
+
+    def unary_stream(self, method: str, request_serializer=None,
+                     response_deserializer=None):
+        ser = request_serializer or (lambda x: x)
+        deser = response_deserializer or (lambda x: x)
+
+        def call_fn(request, timeout: Optional[float] = None,
+                    metadata: Optional[Metadata] = None):
+            call = self._start_call(method, timeout, metadata)
+            try:
+                self._send_message(call, ser(request), end=True)
+                for msg in self._messages(call):
+                    yield deser(msg)
+            except (h2.H2Error, EndpointError, TimeoutError) as exc:
+                self._cancel(call)
+                raise RpcError(StatusCode.UNAVAILABLE, str(exc)) from exc
+            except RpcError:
+                # locally raised (deadline, protocol): tell the server to
+                # stop streaming into a consumer that is gone
+                self._cancel(call)
+                raise
+            except GeneratorExit:
+                self._cancel(call)
+                raise
+
+        return call_fn
+
+    def stream_unary(self, method: str, request_serializer=None,
+                     response_deserializer=None):
+        ser = request_serializer or (lambda x: x)
+        deser = response_deserializer or (lambda x: x)
+
+        def call_fn(request_iterator: Iterable,
+                    timeout: Optional[float] = None,
+                    metadata: Optional[Metadata] = None):
+            call = self._start_call(method, timeout, metadata)
+            try:
+                for req in request_iterator:
+                    self._send_message(call, ser(req), end=False)
+                self._half_close(call)
+                msgs = list(self._messages(call))
+            except (h2.H2Error, EndpointError, TimeoutError) as exc:
+                self._cancel(call)
+                raise RpcError(StatusCode.UNAVAILABLE, str(exc)) from exc
+            except RpcError:
+                self._cancel(call)
+                raise
+            if len(msgs) != 1:
+                raise RpcError(StatusCode.INTERNAL,
+                               f"expected 1 response message, got {len(msgs)}")
+            return deser(msgs[0])
+
+        return call_fn
+
+    def stream_stream(self, method: str, request_serializer=None,
+                      response_deserializer=None):
+        ser = request_serializer or (lambda x: x)
+        deser = response_deserializer or (lambda x: x)
+
+        def call_fn(request_iterator: Iterable,
+                    timeout: Optional[float] = None,
+                    metadata: Optional[Metadata] = None):
+            call = self._start_call(method, timeout, metadata)
+
+            def _pump():
+                try:
+                    for req in request_iterator:
+                        self._send_message(call, ser(req), end=False)
+                    self._half_close(call)
+                except (h2.H2Error, EndpointError, TimeoutError, RpcError):
+                    self._cancel(call)
+
+            sender = threading.Thread(target=_pump, daemon=True,
+                                      name="tpurpc-h2c-sender")
+            sender.start()
+            try:
+                for msg in self._messages(call):
+                    yield deser(msg)
+            except (RpcError, GeneratorExit):
+                self._cancel(call)
+                raise
+            finally:
+                sender.join(timeout=5)
+
+        return call_fn

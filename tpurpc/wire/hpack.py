@@ -13,7 +13,7 @@ desynchronize two ends' dynamic tables.
 from __future__ import annotations
 
 from collections import deque
-from typing import Deque, List, Tuple
+from typing import Deque, List, Optional, Tuple
 
 from tpurpc.wire.rfc7541_tables import HUFFMAN_CODES, STATIC_TABLE
 
@@ -163,11 +163,18 @@ _ENTRY_OVERHEAD = 32
 
 
 class _DynamicTable:
-    def __init__(self, max_size: int = 4096):
+    def __init__(self, max_size: int = 4096, lookup: bool = False):
         self.entries: Deque[Header] = deque()  # most recent first
         self.size = 0
         self.max_size = max_size
         self.cap = max_size  # protocol ceiling (SETTINGS_HEADER_TABLE_SIZE)
+        #: encoder-side O(1) reverse lookups: (n, v)/name → absolute add id.
+        #: Positions shift on every add, so we store a monotone id instead
+        #: and convert at lookup time; evicted ids resolve out of range.
+        self._lookup = lookup
+        self._abs = 0
+        self._by_pair: dict = {}
+        self._by_name: dict = {}
 
     def add(self, name: bytes, value: bytes) -> None:
         need = len(name) + len(value) + _ENTRY_OVERHEAD
@@ -177,7 +184,36 @@ class _DynamicTable:
         if need <= self.max_size:
             self.entries.appendleft((name, value))
             self.size += need
+            if self._lookup:
+                self._by_pair[(name, value)] = self._abs
+                self._by_name[name] = self._abs
         # else: entry larger than table — spec says result is an empty table
+        self._abs += 1  # ids advance even for too-large adds (position math)
+
+    def _abs_to_index(self, abs_id: int) -> Optional[int]:
+        """Wire index (1-based) for an absolute add id, or None if evicted."""
+        pos = self._abs - 1 - abs_id
+        if 0 <= pos < len(self.entries):
+            return len(_STATIC) + pos
+        return None
+
+    def find(self, name: bytes, value: bytes) -> Optional[int]:
+        abs_id = self._by_pair.get((name, value))
+        if abs_id is None:
+            return None
+        idx = self._abs_to_index(abs_id)
+        if idx is None:
+            del self._by_pair[(name, value)]
+        return idx
+
+    def find_name(self, name: bytes) -> Optional[int]:
+        abs_id = self._by_name.get(name)
+        if abs_id is None:
+            return None
+        idx = self._abs_to_index(abs_id)
+        if idx is None:
+            del self._by_name[name]
+        return idx
 
     def resize(self, new_max: int) -> None:
         if new_max > self.cap:
@@ -238,13 +274,62 @@ class HpackDecoder:
         return out
 
 
+#: static name → first index with that name (name-only reference)
+_STATIC_NAME_LOOKUP: dict = {}
+for _i in range(len(_STATIC) - 1, 0, -1):
+    _STATIC_NAME_LOOKUP[_STATIC[_i][0]] = _i
+
+
 class HpackEncoder:
-    """Minimal legal encoder: static-table hits as indexed fields, everything
-    else literal-without-indexing with raw strings. Deliberately stateless
-    (no dynamic table) — nothing to desynchronize."""
+    """HPACK encoder with an optional dynamic table (RFC 7541 §2.3.2).
+
+    ``dynamic=False`` (the server's response path) stays stateless: static
+    hits as indexed fields, everything else literal-without-indexing.
+
+    ``dynamic=True`` (the client path, where :path/:authority/user metadata
+    repeat on every call) inserts repeatable headers with incremental
+    indexing and emits 1-2 byte indexed fields on subsequent calls. The
+    encoder's table mirrors exactly what its own emissions tell the peer's
+    decoder to do, so it can never desynchronize.
+
+    Indexing starts DISABLED even with ``dynamic=True``: until the peer's
+    SETTINGS arrive the peer's actual table ceiling is unknown (it need not
+    be the 4096 default — a 0-size decoder would silently drop our inserts
+    and desync on the first indexed reference). Call
+    :meth:`apply_peer_table_size` when SETTINGS are processed: it sizes the
+    table to ``min(4096, peer)``, queues the RFC 7541 §4.2 dynamic-table
+    size update for the front of the next header block when shrinking, and
+    enables indexing."""
+
+    #: headers that change per-call and would churn the table
+    _NEVER_INDEX = {b"grpc-timeout", b"content-length", b"date"}
+
+    def __init__(self, dynamic: bool = False, max_table_size: int = 4096):
+        self._dynamic = dynamic
+        self._table = (_DynamicTable(max_table_size, lookup=True)
+                       if dynamic else None)
+        self._index_enabled = False
+        self._pending_size_update: Optional[int] = None
+
+    def apply_peer_table_size(self, peer_max: int) -> None:
+        """Peer's SETTINGS_HEADER_TABLE_SIZE processed: enable indexing at
+        ``min(default, peer_max)``, emitting the mandated size update at the
+        start of the next block when that shrinks our declared size."""
+        if self._table is None:
+            return
+        new = min(4096, peer_max)
+        if new < self._table.max_size:
+            self._table.cap = new
+            self._table.resize(new)
+            self._pending_size_update = new
+        self._index_enabled = new > 0
 
     def encode(self, headers) -> bytes:
         out = bytearray()
+        if self._pending_size_update is not None:
+            out += encode_int(self._pending_size_update, 5, 0x20)
+            self._pending_size_update = None
+        table = self._table
         for name, value in headers:
             n = name.encode() if isinstance(name, str) else bytes(name)
             v = value.encode() if isinstance(value, str) else bytes(value)
@@ -252,16 +337,25 @@ class HpackEncoder:
             if idx is not None:
                 out += encode_int(idx, 7, 0x80)
                 continue
-            name_idx = _STATIC_LOOKUP.get((n, b""))
-            if name_idx is None:
-                # find any static entry with this name for name-only reference
-                for i in range(1, len(_STATIC)):
-                    if _STATIC[i][0] == n:
-                        name_idx = i
-                        break
-            if name_idx is not None:
-                out += encode_int(name_idx, 4, 0x00)
+            if table is not None:
+                idx = table.find(n, v)
+                if idx is not None:
+                    out += encode_int(idx, 7, 0x80)
+                    continue
+            name_idx = _STATIC_NAME_LOOKUP.get(n)
+            if name_idx is None and table is not None:
+                name_idx = table.find_name(n)
+            if self._index_enabled and n not in self._NEVER_INDEX:
+                # literal WITH incremental indexing: the peer's decoder adds
+                # it; we mirror the add so future lookups hit
+                out += encode_int(name_idx or 0, 6, 0x40)
+                if name_idx is None:
+                    out += encode_string(n)
+                out += encode_string(v)
+                table.add(n, v)
             else:
-                out += b"\x00" + encode_string(n)
-            out += encode_string(v)
+                out += encode_int(name_idx or 0, 4, 0x00)
+                if name_idx is None:
+                    out += encode_string(n)
+                out += encode_string(v)
         return bytes(out)
