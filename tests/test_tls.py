@@ -1,0 +1,218 @@
+"""TLS: credentials surface + encrypted transport on every platform.
+
+The reference's security stack exists so creds work UNCHANGED over the
+swapped byte pipe (SURVEY §2.4, ``lib/security`` + ``tsi``; ``h2_ssl.cc``
+fixture). Proven here four ways: tpurpc↔tpurpc over TLS on the TCP *and*
+ring platforms (ring bootstrap + notify ride the TLS socket), a STOCK
+grpcio TLS client against a tpurpc secure port, and our H2Channel against
+a stock grpcio TLS server.
+"""
+
+import datetime
+import threading
+
+import grpc
+import pytest
+
+import tpurpc.rpc as tps
+
+
+@pytest.fixture(scope="module")
+def certs():
+    """Self-signed CA + server cert for localhost (cryptography lib)."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    def make_key():
+        return rsa.generate_private_key(public_exponent=65537, key_size=2048)
+
+    now = datetime.datetime.now(datetime.timezone.utc)
+
+    ca_key = make_key()
+    ca_name = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, "tpurpc-test-ca")])
+    ca_cert = (x509.CertificateBuilder()
+               .subject_name(ca_name).issuer_name(ca_name)
+               .public_key(ca_key.public_key())
+               .serial_number(x509.random_serial_number())
+               .not_valid_before(now - datetime.timedelta(minutes=5))
+               .not_valid_after(now + datetime.timedelta(days=1))
+               .add_extension(x509.BasicConstraints(ca=True, path_length=None),
+                              critical=True)
+               .sign(ca_key, hashes.SHA256()))
+
+    def issue(cn):
+        key = make_key()
+        cert = (x509.CertificateBuilder()
+                .subject_name(x509.Name(
+                    [x509.NameAttribute(NameOID.COMMON_NAME, cn)]))
+                .issuer_name(ca_name)
+                .public_key(key.public_key())
+                .serial_number(x509.random_serial_number())
+                .not_valid_before(now - datetime.timedelta(minutes=5))
+                .not_valid_after(now + datetime.timedelta(days=1))
+                .add_extension(x509.SubjectAlternativeName(
+                    [x509.DNSName("localhost"),
+                     x509.IPAddress(__import__("ipaddress")
+                                    .ip_address("127.0.0.1"))]),
+                    critical=False)
+                .sign(ca_key, hashes.SHA256()))
+        key_pem = key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.TraditionalOpenSSL,
+            serialization.NoEncryption())
+        return key_pem, cert.public_bytes(serialization.Encoding.PEM)
+
+    ca_pem = ca_cert.public_bytes(serialization.Encoding.PEM)
+    srv_key, srv_cert = issue("localhost")
+    cli_key, cli_cert = issue("tpurpc-test-client")
+    return {"ca": ca_pem, "srv_key": srv_key, "srv_cert": srv_cert,
+            "cli_key": cli_key, "cli_cert": cli_cert}
+
+
+def _tls_server(certs, require_client_auth=False):
+    srv = tps.Server(max_workers=4)
+    srv.add_method("/t.S/Echo",
+                   tps.unary_unary_rpc_method_handler(lambda req, ctx: req))
+    creds = tps.ssl_server_credentials(
+        [(certs["srv_key"], certs["srv_cert"])],
+        root_certificates=certs["ca"] if require_client_auth else None,
+        require_client_auth=require_client_auth)
+    port = srv.add_secure_port("127.0.0.1:0", creds)
+    srv.start()
+    return srv, port
+
+
+@pytest.mark.parametrize("platform", ["TCP", "RDMA_BPEV"])
+def test_tls_e2e_native(monkeypatch, platform, certs):
+    """tpurpc↔tpurpc over TLS; ring platforms bootstrap over the TLS socket
+    and keep it as the encrypted notify channel."""
+    monkeypatch.setenv("GRPC_PLATFORM_TYPE", platform)
+    from tpurpc.utils import config as config_mod
+
+    config_mod.set_config(None)
+    srv, port = _tls_server(certs)
+    try:
+        creds = tps.ssl_channel_credentials(root_certificates=certs["ca"])
+        with tps.secure_channel(f"localhost:{port}", creds) as ch:
+            mc = ch.unary_unary("/t.S/Echo")
+            assert bytes(mc(b"secure", timeout=20)) == b"secure"
+            big = bytes(256) * 4096  # 1 MiB through the encrypted pipe
+            assert bytes(mc(big, timeout=30)) == big
+    finally:
+        srv.stop(grace=0)
+
+
+def test_tls_rejects_untrusted_server(monkeypatch, certs):
+    monkeypatch.setenv("GRPC_PLATFORM_TYPE", "TCP")
+    srv, port = _tls_server(certs)
+    try:
+        # a trust anchor that did NOT sign the server cert
+        creds = tps.ssl_channel_credentials(
+            root_certificates=certs["cli_cert"])
+        with pytest.raises(Exception):
+            with tps.secure_channel(f"localhost:{port}", creds) as ch:
+                ch.unary_unary("/t.S/Echo")(b"x", timeout=5)
+    finally:
+        srv.stop(grace=0)
+
+
+def test_tls_plaintext_client_rejected(monkeypatch, certs):
+    """A plaintext client hitting a secure port dies at handshake, cleanly."""
+    monkeypatch.setenv("GRPC_PLATFORM_TYPE", "TCP")
+    srv, port = _tls_server(certs)
+    try:
+        with pytest.raises(tps.RpcError):
+            with tps.Channel(f"127.0.0.1:{port}") as ch:
+                ch.unary_unary("/t.S/Echo")(b"x", timeout=5)
+    finally:
+        srv.stop(grace=0)
+
+
+def test_mtls_requires_client_cert(monkeypatch, certs):
+    monkeypatch.setenv("GRPC_PLATFORM_TYPE", "TCP")
+    srv, port = _tls_server(certs, require_client_auth=True)
+    try:
+        # without a client cert: rejected
+        bare = tps.ssl_channel_credentials(root_certificates=certs["ca"])
+        with pytest.raises(Exception):
+            with tps.secure_channel(f"localhost:{port}", bare) as ch:
+                ch.unary_unary("/t.S/Echo")(b"x", timeout=5)
+        # with one: accepted
+        mutual = tps.ssl_channel_credentials(
+            root_certificates=certs["ca"],
+            private_key=certs["cli_key"],
+            certificate_chain=certs["cli_cert"])
+        with tps.secure_channel(f"localhost:{port}", mutual) as ch:
+            assert bytes(ch.unary_unary("/t.S/Echo")(b"m", timeout=20)) == b"m"
+    finally:
+        srv.stop(grace=0)
+
+
+def test_stock_grpcio_tls_client_against_tpurpc(monkeypatch, certs):
+    """grpc.secure_channel (C-core TLS + ALPN h2) → tpurpc secure port."""
+    monkeypatch.setenv("GRPC_PLATFORM_TYPE", "TCP")
+    srv, port = _tls_server(certs)
+    try:
+        creds = grpc.ssl_channel_credentials(root_certificates=certs["ca"])
+        with grpc.secure_channel(f"localhost:{port}", creds) as ch:
+            mc = ch.unary_unary("/t.S/Echo", lambda x: x, lambda x: x)
+            assert mc(b"grpcio-tls", timeout=20) == b"grpcio-tls"
+    finally:
+        srv.stop(grace=0)
+
+
+def test_h2channel_tls_against_stock_grpcio(certs):
+    """Our h2 client over TLS → stock grpcio TLS server."""
+    from concurrent import futures
+
+    gsrv = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+
+    class H(grpc.GenericRpcHandler):
+        def service(self, details):
+            if details.method.endswith("Echo"):
+                return grpc.unary_unary_rpc_method_handler(
+                    lambda req, ctx: req,
+                    request_deserializer=lambda x: x,
+                    response_serializer=lambda x: x)
+            return None
+
+    gsrv.add_generic_rpc_handlers((H(),))
+    gcreds = grpc.ssl_server_credentials(
+        [(certs["srv_key"], certs["srv_cert"])])
+    port = gsrv.add_secure_port("127.0.0.1:0", gcreds)
+    gsrv.start()
+    try:
+        creds = tps.ssl_channel_credentials(root_certificates=certs["ca"])
+        with tps.H2Channel(f"localhost:{port}", credentials=creds) as ch:
+            mc = ch.unary_unary("/t.S/Echo")
+            assert mc(b"h2-tls", timeout=20) == b"h2-tls"
+    finally:
+        gsrv.stop(grace=0)
+
+
+@pytest.mark.parametrize("platform", ["RDMA_BPEV", "RDMA_TPU"])
+def test_ring_platform_port_serves_stock_grpcio_tls(monkeypatch, platform,
+                                                    certs):
+    """Ring-platform listeners dispatch MIXED clients: a stock grpcio TLS
+    client (h2 preface) lands on the TCP path while ring peers bootstrap —
+    beyond the reference, whose RDMA ports cannot speak to vanilla gRPC."""
+    monkeypatch.setenv("GRPC_PLATFORM_TYPE", platform)
+    from tpurpc.utils import config as config_mod
+
+    config_mod.set_config(None)
+    srv, port = _tls_server(certs)
+    try:
+        # ring-native client over TLS
+        creds = tps.ssl_channel_credentials(root_certificates=certs["ca"])
+        with tps.secure_channel(f"localhost:{port}", creds) as ch:
+            assert bytes(ch.unary_unary("/t.S/Echo")(b"ring", timeout=30)) == b"ring"
+        # stock grpcio TLS client on the SAME port
+        gc = grpc.ssl_channel_credentials(root_certificates=certs["ca"])
+        with grpc.secure_channel(f"localhost:{port}", gc) as gch:
+            mc = gch.unary_unary("/t.S/Echo", lambda x: x, lambda x: x)
+            assert mc(b"h2-on-ring-port", timeout=20) == b"h2-on-ring-port"
+    finally:
+        srv.stop(grace=0)

@@ -105,8 +105,11 @@ class ReadTimeout(TimeoutError):
 # ---------------------------------------------------------------------------
 
 class TcpEndpoint(Endpoint):
-    def __init__(self, sock: socket.socket):
+    def __init__(self, sock: socket.socket, preread: bytes = b""):
         self._sock = sock
+        #: bytes already consumed from the socket by the listener's protocol
+        #: peek (ring-platform dispatch); served to readers first
+        self._preread = bytearray(preread)
         # The socket stays BLOCKING for its whole life; read deadlines are a
         # select() ahead of the recv instead of settimeout(). settimeout is
         # per-socket state, so a writer thread flipping it to blocking would
@@ -123,6 +126,11 @@ class TcpEndpoint(Endpoint):
 
     def _await_readable(self, timeout: Optional[float]) -> None:
         if timeout is None:
+            return
+        # TLS: records already decrypted into the SSL layer are invisible to
+        # poll() on the raw fd — check the buffered byte count first.
+        pending = getattr(self._sock, "pending", None)
+        if pending is not None and pending():
             return
         import select
 
@@ -141,6 +149,10 @@ class TcpEndpoint(Endpoint):
              timeout: Optional[float] = None) -> bytes:
         if self._closed:
             raise EndpointError("read on closed endpoint")
+        if self._preread:
+            out = bytes(self._preread[:max_bytes])
+            del self._preread[:max_bytes]
+            return out
         try:
             self._await_readable(timeout)
             return self._sock.recv(max_bytes)
@@ -152,6 +164,12 @@ class TcpEndpoint(Endpoint):
     def read_into(self, dst, timeout: Optional[float] = None) -> int:
         if self._closed:
             raise EndpointError("read on closed endpoint")
+        if self._preread:
+            dst = memoryview(dst).cast("B")
+            n = min(len(dst), len(self._preread))
+            dst[:n] = self._preread[:n]
+            del self._preread[:n]
+            return n
         try:
             self._await_readable(timeout)
             return self._sock.recv_into(dst)
@@ -165,6 +183,12 @@ class TcpEndpoint(Endpoint):
             raise EndpointError("write on closed endpoint")
         try:
             if isinstance(data, (list, tuple)):
+                if hasattr(self._sock, "pending"):
+                    # SSLSocket (sendmsg raises NotImplementedError there):
+                    # records are re-framed anyway, so one join costs what
+                    # the TLS layer would have paid internally.
+                    self._sock.sendall(b"".join(bytes(s) for s in data))
+                    return
                 # sendmsg is a gather write but may place PARTIALLY under
                 # pressure, and the kernel caps one call at IOV_MAX=1024
                 # iovecs (a large pytree serializes to 2-3 segments per leaf);
@@ -242,7 +266,8 @@ class RingEndpoint(Endpoint):
 
     def __init__(self, sock: socket.socket, *, discipline: str,
                  pool_key: str, pair: Optional[Pair] = None,
-                 register_with_poller: Optional[bool] = None):
+                 register_with_poller: Optional[bool] = None,
+                 preread: bytes = b""):
         self.discipline = discipline
         self.pool_key = pool_key
         self._peer_desc = _fmt_addr(sock, peer=True)
@@ -250,7 +275,7 @@ class RingEndpoint(Endpoint):
         self.pair = pair if pair is not None else PairPool.get().take(pool_key)
         if self.pair.state is not PairState.CONNECTED:
             try:
-                self.pair.connect_over_socket(sock)
+                self.pair.connect_over_socket(sock, preread=preread)
             except Exception:
                 # Failed bootstrap (e.g. platform-mismatched peer): release the
                 # rings now, don't leak them until interpreter exit.
@@ -460,24 +485,61 @@ def create_endpoint(sock: socket.socket, *, is_server: bool,
     platform = platform or cfg.platform
     if platform is Platform.TCP:
         return TcpEndpoint(sock)
+    preread = b""
+    if is_server:
+        # Ring-platform listeners serve MIXED clients: ring peers open with
+        # the TRB1 bootstrap magic; stock gRPC (h2 preface) and native-TCP-
+        # framing clients fall through to a TCP endpoint carrying the peeked
+        # bytes. An explicit 4-byte read (not MSG_PEEK) so the dispatch works
+        # identically on TLS sockets, where only decrypted bytes mean
+        # anything. The reference cannot do this — a vanilla gRPC client
+        # cannot talk to its RDMA ports at all.
+        from tpurpc.core.pair import _BOOTSTRAP_MAGIC, peek_protocol
+
+        preread = peek_protocol(sock)
+        if preread != _BOOTSTRAP_MAGIC:
+            return TcpEndpoint(sock, preread=preread)
     if platform is Platform.TPU:
         from tpurpc.tpu.endpoint import TpuRingEndpoint  # lazy: jax import
 
         key = pool_key or _fmt_addr(sock, peer=True)
-        return TpuRingEndpoint(sock, pool_key=key, is_server=is_server)
+        return TpuRingEndpoint(sock, pool_key=key, is_server=is_server,
+                               preread=preread)
     discipline = platform.discipline
     key = pool_key or _fmt_addr(sock, peer=True)
     # Pool pairs default to the shm domain (works in-process and cross-process on one
     # host).  Ring platforms require both peers on one host, the same way the
     # reference's RDMA modes require both peers on one IB fabric.
-    return RingEndpoint(sock, discipline=discipline, pool_key=key)
+    return RingEndpoint(sock, discipline=discipline, pool_key=key,
+                        preread=preread)
+
+
+def tls_client_handshake(sock: socket.socket, ssl_context,
+                         server_hostname: str) -> socket.socket:
+    """Client-side TLS wrap with uniform failure semantics (shared by the
+    endpoint factory and the h2 wire-compat client)."""
+    try:
+        return ssl_context.wrap_socket(sock, server_hostname=server_hostname)
+    except (OSError, ValueError) as exc:
+        sock.close()
+        raise EndpointError(f"TLS handshake failed: {exc}") from exc
 
 
 def connect_endpoint(host: str, port: int,
-                     timeout: Optional[float] = 30) -> Endpoint:
-    """Client side: TCP-connect, then let the factory pick the pipe
-    (``tcp_client_posix.cc:124-126``)."""
+                     timeout: Optional[float] = 30,
+                     ssl_context=None,
+                     server_hostname: Optional[str] = None) -> Endpoint:
+    """Client side: TCP-connect (optionally TLS-wrap), then let the factory
+    pick the pipe (``tcp_client_posix.cc:124-126``).
+
+    With ``ssl_context`` the handshake happens BEFORE platform dispatch, so
+    every platform's bootstrap — including the ring address exchange and its
+    notify/liveness channel — rides the encrypted stream (the reference's
+    creds-work-unchanged-over-the-swapped-pipe property, SURVEY §2.4)."""
     sock = socket.create_connection((host, port), timeout=timeout)
+    if ssl_context is not None:
+        sock = tls_client_handshake(sock, ssl_context,
+                                    server_hostname or host)
     sock.settimeout(None)
     return create_endpoint(sock, is_server=False, pool_key=f"{host}:{port}")
 
@@ -487,7 +549,9 @@ class EndpointListener:
 
     def __init__(self, host: str, port: int,
                  on_endpoint: Callable[[Endpoint], None],
-                 ready: "Optional[threading.Event]" = None):
+                 ready: "Optional[threading.Event]" = None,
+                 ssl_context=None):
+        self._ssl_context = ssl_context
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -534,6 +598,13 @@ class EndpointListener:
 
     def _bootstrap(self, sock: socket.socket, addr) -> None:
         try:
+            if self._ssl_context is not None:
+                # Handshake before dispatch: the platform sniff/bootstrap
+                # reads DECRYPTED bytes. A client speaking plaintext (or bad
+                # certs) fails here, never reaching the protocol layer.
+                sock.settimeout(20)
+                sock = self._ssl_context.wrap_socket(sock, server_side=True)
+                sock.settimeout(None)
             # Server keys pooled pairs by peer host (ref rule: server keys by
             # peer, rdma_bp_posix.cc:748-763) — ephemeral ports would defeat
             # reuse entirely.

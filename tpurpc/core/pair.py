@@ -32,6 +32,7 @@ import enum
 import json
 import os
 import socket
+import ssl
 import struct
 import threading
 import time
@@ -318,8 +319,8 @@ def _send_blob(sock: socket.socket, blob: bytes) -> None:
     sock.sendall(_BOOTSTRAP_MAGIC + struct.pack("<I", len(blob)) + blob)
 
 
-def _recv_blob(sock: socket.socket) -> bytes:
-    magic = _recv_exact(sock, 4)
+def _recv_blob(sock: socket.socket, preread: bytes = b"") -> bytes:
+    magic = preread + _recv_exact(sock, 4 - len(preread))
     if magic != _BOOTSTRAP_MAGIC:
         raise ConnectionError(
             f"bad bootstrap magic {magic!r}: peer is not speaking the ring "
@@ -338,6 +339,26 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
             raise ConnectionError("peer closed during address exchange")
         out += chunk
     return out
+
+
+def peek_protocol(sock: socket.socket, timeout: float = BOOTSTRAP_TIMEOUT_S
+                  ) -> bytes:
+    """Server-side protocol dispatch: consume and return the first 4 bytes.
+
+    A ring-platform listener uses this to route each accepted connection —
+    ring clients open with the TRB1 bootstrap magic; stock gRPC (h2 preface)
+    and native-TCP-framing clients get a TCP endpoint carrying the preread
+    bytes instead of a bootstrap error. Works identically on TLS sockets
+    (the bytes are post-decryption), which MSG_PEEK cannot."""
+    old = sock.gettimeout()
+    sock.settimeout(timeout)
+    try:
+        return _recv_exact(sock, 4)
+    finally:
+        try:
+            sock.settimeout(old)
+        except OSError:
+            pass
 
 
 # ---------------------------------------------------------------------------
@@ -461,7 +482,8 @@ class Pair:
                        self.recv_region.handle, self.status_region.handle,
                        caps=caps)
 
-    def connect_over_socket(self, sock: socket.socket) -> None:
+    def connect_over_socket(self, sock: socket.socket,
+                            preread: bytes = b"") -> None:
         """Bootstrap over an already-connected socket: both sides swap Address blobs,
         then open one-sided windows (ref: ``exchange_data`` over the TCP fd,
         ``rdma_bp_posix.cc:640-692``; MR swap ``pair.cc:472-486``).  The socket stays
@@ -475,7 +497,7 @@ class Pair:
         sock.settimeout(BOOTSTRAP_TIMEOUT_S)
         try:
             _send_blob(sock, self.local_address().to_bytes())
-            peer = Address.from_bytes(_recv_blob(sock))
+            peer = Address.from_bytes(_recv_blob(sock, preread))
         except socket.timeout as exc:
             raise ConnectionError(
                 f"pair bootstrap timed out after {BOOTSTRAP_TIMEOUT_S}s "
@@ -597,6 +619,8 @@ class Pair:
             return
         try:
             sock.send(token)
+        except (ssl.SSLWantWriteError, ssl.SSLWantReadError):
+            pass  # TLS record stalled mid-flight; same as a saturated channel
         except (BlockingIOError, InterruptedError):
             pass  # event channel saturated — busy/hybrid pollers don't need it
         except OSError:
@@ -619,8 +643,9 @@ class Pair:
         while True:
             try:
                 chunk = sock.recv(65536)
-            except (BlockingIOError, InterruptedError):
-                break
+            except (BlockingIOError, InterruptedError,
+                    ssl.SSLWantReadError, ssl.SSLWantWriteError):
+                break  # nothing decryptable yet ≡ EAGAIN on a plain socket
             except OSError:
                 self._mark_error("notify channel read failed")
                 break
@@ -651,6 +676,21 @@ class Pair:
         sock = self.notify_sock
         if sock is None:
             return False
+        if hasattr(sock, "pending"):
+            # SSLSocket: MSG_PEEK is unsupported (ValueError on flags) and
+            # meaningless on a record stream. A non-consuming HINT suffices
+            # for the poller's purpose: decrypted bytes pending, or raw
+            # ciphertext readable on the fd (a spurious True just makes the
+            # owner drain and find nothing).
+            if sock.pending():
+                return True
+            import select
+
+            try:
+                r, _, _ = select.select([sock.fileno()], [], [], 0)
+            except (OSError, ValueError):
+                return True  # racing close; owner's drain will resolve it
+            return bool(r)
         try:
             chunk = sock.recv(1, socket.MSG_PEEK)
         except (BlockingIOError, InterruptedError):

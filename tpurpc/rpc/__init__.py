@@ -45,3 +45,14 @@ __all__ += ["ClientInterceptor", "FaultConfig", "FaultInjector",
 from tpurpc.wire.h2_client import H2Channel  # noqa: E402  (gRPC wire-compat client)
 
 __all__ += ["H2Channel"]
+
+from tpurpc.rpc.channel import secure_channel  # noqa: E402
+from tpurpc.rpc.credentials import (ChannelCredentials,  # noqa: E402
+                                    ServerCredentials,
+                                    insecure_for_testing_channel_credentials,
+                                    ssl_channel_credentials,
+                                    ssl_server_credentials)
+
+__all__ += ["secure_channel", "ChannelCredentials", "ServerCredentials",
+            "ssl_channel_credentials", "ssl_server_credentials",
+            "insecure_for_testing_channel_credentials"]

@@ -275,16 +275,20 @@ class Channel:
 
     def __init__(self, target: Optional[str] = None, *,
                  endpoint_factory: Optional[Callable[[], Endpoint]] = None,
-                 connect_timeout: float = 30.0, lb_policy: str = "pick_first"):
+                 connect_timeout: float = 30.0, lb_policy: str = "pick_first",
+                 credentials=None):
         from tpurpc.rpc.resolver import make_policy, resolve_target
 
+        ssl_ctx = getattr(credentials, "_context", None)
+        override = getattr(credentials, "_override_hostname", None)
         if endpoint_factory is None:
             if target is None:
                 raise ValueError("need target or endpoint_factory")
             addrs = resolve_target(target)
             factories = [
-                (lambda h=h, p=p: connect_endpoint(h, p,
-                                                   timeout=connect_timeout))
+                (lambda h=h, p=p: connect_endpoint(
+                    h, p, timeout=connect_timeout, ssl_context=ssl_ctx,
+                    server_hostname=override))
                 for h, p in addrs]
         else:
             factories = [endpoint_factory]
@@ -636,3 +640,9 @@ class StreamStream(_MultiCallable):
 def insecure_channel(target: str, **kwargs) -> Channel:
     """grpcio-shaped constructor."""
     return Channel(target, **kwargs)
+
+
+def secure_channel(target: str, credentials, **kwargs) -> Channel:
+    """grpcio-shaped constructor: pass the result of
+    :func:`tpurpc.rpc.credentials.ssl_channel_credentials`."""
+    return Channel(target, credentials=credentials, **kwargs)

@@ -489,9 +489,21 @@ class Server:
         self.bound_ports.append(bound)
         return bound
 
-    def _open_port(self, host: str, port: int) -> int:
+    def add_secure_port(self, address: str, server_credentials) -> int:
+        """TLS port (grpcio-shaped): every connection handshakes before the
+        protocol sniff, so native-framing, ring-bootstrap, and h2 traffic all
+        ride the encrypted stream. Pass the result of
+        :func:`tpurpc.rpc.credentials.ssl_server_credentials`."""
+        host, _, port = address.rpartition(":")
+        bound = self._open_port(host or "0.0.0.0", int(port),
+                                ssl_context=server_credentials._context)
+        self.bound_ports.append(bound)
+        return bound
+
+    def _open_port(self, host: str, port: int, ssl_context=None) -> int:
         listener = EndpointListener(host, port, self.serve_endpoint,
-                                    ready=self._serving)
+                                    ready=self._serving,
+                                    ssl_context=ssl_context)
         self._listeners.append(listener)
         return listener.port
 

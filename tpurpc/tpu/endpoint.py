@@ -60,9 +60,9 @@ class TpuRingEndpoint(RingEndpoint):
     """
 
     def __init__(self, sock: socket.socket, *, pool_key: str,
-                 is_server: bool = False):
+                 is_server: bool = False, preread: bytes = b""):
         super().__init__(sock, discipline=Platform.TPU.discipline,
-                         pool_key=pool_key)
+                         pool_key=pool_key, preread=preread)
         self.is_server = is_server
         self._hbm: Optional[HbmRing] = None
         import threading

@@ -115,6 +115,12 @@ class _H2Call:
 
     def deliver_status(self, code: StatusCode, details: str,
                        md: List[Tuple[str, object]]) -> None:
+        # Record on the call BEFORE queueing: a sender blocked in the flow
+        # window needs a non-consuming way to learn the outcome (consuming
+        # the queued event would starve the response consumer).
+        self.code = code
+        self.details = details
+        self.trailing_md = md
         self.events.put(("status", code, details, md))
 
     # caller side ------------------------------------------------------------
@@ -144,10 +150,17 @@ class H2Channel:
     """
 
     def __init__(self, target: str, connect_timeout: float = 30.0,
-                 authority: Optional[str] = None):
+                 authority: Optional[str] = None, credentials=None):
         host, _, port = target.rpartition(":")
         sock = socket.create_connection((host or "127.0.0.1", int(port)),
                                         timeout=connect_timeout)
+        ssl_ctx = getattr(credentials, "_context", None)
+        if ssl_ctx is not None:
+            from tpurpc.core.endpoint import tls_client_handshake
+
+            hostname = (getattr(credentials, "_override_hostname", None)
+                        or host or "127.0.0.1")
+            sock = tls_client_handshake(sock, ssl_ctx, hostname)
         sock.settimeout(None)
         self._ep: Endpoint = TcpEndpoint(sock)
         self._authority = authority or target
@@ -275,7 +288,13 @@ class H2Channel:
 
     def _pop_call(self, sid: int) -> Optional[_H2Call]:
         with self._lock:
-            return self._calls.pop(sid, None)
+            call = self._calls.pop(sid, None)
+        if call is not None and call.window is not None:
+            # The stream is over (trailers/RST/cancel): release any sender
+            # blocked in FlowWindow.take — no grant can ever arrive for a
+            # dead stream, so without the kill it waits forever.
+            call.window.kill()
+        return call
 
     def _on_headers(self, sid: int, flags: int, block: bytes) -> None:
         headers = self._dec.decode(block)
@@ -438,8 +457,29 @@ class H2Channel:
         view = memoryview(buf)
         while view:
             want = min(len(view), self._peer_max_frame)
-            got = call.window.take(want, timeout=call._remaining())
-            conn_got = self._conn_window.take(got, timeout=call._remaining())
+            try:
+                got = call.window.take(want, timeout=call._remaining())
+                conn_got = self._conn_window.take(got,
+                                                  timeout=call._remaining())
+            except TimeoutError:
+                # Deadline passed while flow-control starved: this is a
+                # DEADLINE, not a transport failure (grpcio semantics; the
+                # receive path reports the identical condition the same way).
+                raise RpcError(StatusCode.DEADLINE_EXCEEDED,
+                               "deadline exceeded while sending "
+                               "(flow-control starved)") from None
+            except h2.H2Error:
+                # The stream's window was killed: terminated under us. If a
+                # real status arrived (trailers-only reject, RST), surface
+                # THAT; stop sending quietly on OK (server finished early
+                # without draining the request, which h2 permits).
+                if call.code is StatusCode.OK:
+                    return
+                if call.code is not None:
+                    raise RpcError(call.code, call.details,
+                                   call.trailing_md) from None
+                raise RpcError(StatusCode.UNAVAILABLE,
+                               "stream closed while sending") from None
             if conn_got < got:
                 # Another stream drained the shared connection window under
                 # us: return the stream credit we reserved but can't send,
@@ -501,6 +541,11 @@ class H2Channel:
             except RpcError:
                 self._cancel(call)
                 raise
+            except Exception:
+                # user code (serializer / request iterator) blew up: free the
+                # server-side stream before propagating
+                self._cancel(call)
+                raise
             if len(msgs) != 1:
                 raise RpcError(StatusCode.INTERNAL,
                                f"expected 1 response message, got {len(msgs)}")
@@ -526,6 +571,9 @@ class H2Channel:
             except RpcError:
                 # locally raised (deadline, protocol): tell the server to
                 # stop streaming into a consumer that is gone
+                self._cancel(call)
+                raise
+            except Exception:
                 self._cancel(call)
                 raise
             except GeneratorExit:
@@ -554,6 +602,11 @@ class H2Channel:
             except RpcError:
                 self._cancel(call)
                 raise
+            except Exception:
+                # user code (serializer / request iterator) blew up: free the
+                # server-side stream before propagating
+                self._cancel(call)
+                raise
             if len(msgs) != 1:
                 raise RpcError(StatusCode.INTERNAL,
                                f"expected 1 response message, got {len(msgs)}")
@@ -578,6 +631,14 @@ class H2Channel:
                     self._half_close(call)
                 except (h2.H2Error, EndpointError, TimeoutError, RpcError):
                     self._cancel(call)
+                except Exception as exc:
+                    # user code (request iterator / serializer) blew up in
+                    # the sender thread: cancel AND deliver a status, or the
+                    # response consumer blocks forever on an empty queue
+                    self._cancel(call)
+                    call.deliver_status(
+                        StatusCode.INTERNAL,
+                        f"request iterator/serializer failed: {exc!r}", [])
 
             sender = threading.Thread(target=_pump, daemon=True,
                                       name="tpurpc-h2c-sender")

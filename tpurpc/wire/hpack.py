@@ -179,8 +179,17 @@ class _DynamicTable:
     def add(self, name: bytes, value: bytes) -> None:
         need = len(name) + len(value) + _ENTRY_OVERHEAD
         while self.entries and self.size + need > self.max_size:
+            evicted_abs = self._abs - len(self.entries)  # oldest entry's id
             n, v = self.entries.pop()
             self.size -= len(n) + len(v) + _ENTRY_OVERHEAD
+            if self._lookup:
+                # Purge exactly-matching ids so the reverse maps can't grow
+                # unboundedly on never-repeated header values (a newer add
+                # of the same pair/name keeps its newer id).
+                if self._by_pair.get((n, v)) == evicted_abs:
+                    del self._by_pair[(n, v)]
+                if self._by_name.get(n) == evicted_abs:
+                    del self._by_name[n]
         if need <= self.max_size:
             self.entries.appendleft((name, value))
             self.size += need
