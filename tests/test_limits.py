@@ -1,0 +1,161 @@
+"""Bounded memory: max message size + per-stream backpressure + pool bounds.
+
+VERDICT r1 #8 / reference analogs: resource_quota.cc, chttp2
+flow_control.{h,cc} — a fast sender must not balloon server memory, and an
+over-limit message must be rejected cleanly (framing intact, stream gets
+RESOURCE_EXHAUSTED, connection survives).
+"""
+
+import threading
+import time
+
+import pytest
+
+import tpurpc.rpc as tps
+from tpurpc.rpc.status import RpcError, StatusCode
+
+
+def _server(**kw):
+    srv = tps.Server(max_workers=4, **kw)
+    srv.add_method("/t.S/Echo",
+                   tps.unary_unary_rpc_method_handler(lambda req, ctx: req))
+
+    def count(req_iter, ctx):
+        n = 0
+        for _ in req_iter:
+            n += 1
+        return str(n).encode()
+
+    srv.add_method("/t.S/Count",
+                   tps.stream_unary_rpc_method_handler(count))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    return srv, port
+
+
+def test_oversized_unary_rejected_cleanly():
+    """Over-limit request → RESOURCE_EXHAUSTED; the connection (and the
+    next, legal call on it) survives — the reject is per-stream."""
+    srv, port = _server(max_receive_message_length=64 << 10)  # 64 KiB
+    try:
+        with tps.Channel(f"127.0.0.1:{port}") as ch:
+            mc = ch.unary_unary("/t.S/Echo")
+            with pytest.raises(RpcError) as ei:
+                mc(b"x" * (1 << 20), timeout=20)  # 1 MiB >> 64 KiB
+            assert ei.value.code() is StatusCode.RESOURCE_EXHAUSTED
+            assert "larger than max" in ei.value.details()
+            # framing stayed in sync: a small call on the SAME channel works
+            assert bytes(mc(b"small", timeout=20)) == b"small"
+    finally:
+        srv.stop(grace=0)
+
+
+def test_oversized_mid_stream_aborts_stream_only():
+    srv, port = _server(max_receive_message_length=64 << 10)
+    try:
+        with tps.Channel(f"127.0.0.1:{port}") as ch:
+            mc = ch.stream_unary("/t.S/Count")
+            msgs = [b"ok1", b"y" * (1 << 20), b"ok2"]
+            with pytest.raises(RpcError) as ei:
+                mc(iter(msgs), timeout=20)
+            assert ei.value.code() is StatusCode.RESOURCE_EXHAUSTED
+            # connection still serves
+            assert bytes(ch.unary_unary("/t.S/Echo")(b"z", timeout=20)) == b"z"
+    finally:
+        srv.stop(grace=0)
+
+
+def test_oversized_response_rejected_client_side():
+    """The CLIENT enforces its receive bound too."""
+    srv, port = _server()  # server side unlimited-ish default
+    try:
+        with tps.Channel(f"127.0.0.1:{port}",
+                         max_receive_message_length=32 << 10) as ch:
+            mc = ch.unary_unary("/t.S/Echo")
+            with pytest.raises(RpcError) as ei:
+                mc(b"q" * (256 << 10), timeout=20)  # reply exceeds 32 KiB
+            assert ei.value.code() is StatusCode.RESOURCE_EXHAUSTED
+    finally:
+        srv.stop(grace=0)
+
+
+def test_env_knob_applies(monkeypatch):
+    monkeypatch.setenv("TPURPC_MAX_RECV_MESSAGE_LENGTH", str(16 << 10))
+    from tpurpc.utils import config as config_mod
+
+    config_mod.set_config(None)
+    srv, port = _server()
+    try:
+        with tps.Channel(f"127.0.0.1:{port}") as ch:
+            with pytest.raises(RpcError) as ei:
+                ch.unary_unary("/t.S/Echo")(b"e" * (64 << 10), timeout=20)
+            assert ei.value.code() is StatusCode.RESOURCE_EXHAUSTED
+    finally:
+        srv.stop(grace=0)
+
+
+def test_slow_reader_backpressures_fast_sender(monkeypatch):
+    """A handler that reads slowly must bound buffered messages: the reader
+    stops draining at stream_queue_depth and the transport's flow control
+    stalls the sender — memory stays bounded end to end."""
+    monkeypatch.setenv("TPURPC_STREAM_QUEUE_DEPTH", "4")
+    from tpurpc.utils import config as config_mod
+
+    config_mod.set_config(None)
+
+    consumed = []
+    release = threading.Event()
+
+    def slow_count(req_iter, ctx):
+        for item in req_iter:
+            consumed.append(len(bytes(item)))
+            if len(consumed) == 1:
+                release.wait(timeout=30)  # park after the first message
+        return str(len(consumed)).encode()
+
+    srv = tps.Server(max_workers=4)
+    srv.add_method("/t.S/Slow",
+                   tps.stream_unary_rpc_method_handler(slow_count))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    try:
+        sent = [0]
+        result = [None]
+        n_msgs, msg = 64, b"b" * (1 << 20)  # 64 x 1 MiB
+
+        def gen():
+            for _ in range(n_msgs):
+                sent[0] += 1
+                yield msg
+
+        def call():
+            with tps.Channel(f"127.0.0.1:{port}") as ch:
+                result[0] = bytes(
+                    ch.stream_unary("/t.S/Slow")(gen(), timeout=60))
+
+        t = threading.Thread(target=call)
+        t.start()
+        time.sleep(2.0)  # sender runs against a parked handler
+        # Backpressure: the generator must NOT have pushed everything while
+        # the handler sits on message 1. In-flight budget = queue depth (4
+        # messages) + ring capacity + kernel socket buffers << 64 MiB;
+        # without the bound the reader drains all 64 immediately.
+        assert sent[0] < n_msgs, f"no backpressure: all {sent[0]} sent"
+        release.set()
+        t.join(timeout=60)
+        assert result[0] == str(n_msgs).encode()
+    finally:
+        srv.stop(grace=0)
+
+
+def test_pair_pool_per_key_bound_below_total():
+    from tpurpc.core.poller import PairPool
+
+    pool = PairPool(max_idle_total=128)
+    assert pool.max_idle_total == 128
+    assert pool.max_idle_per_key == 32  # one hot key can't evict-starve all
+    pool.drain()
+    # an explicit per-key bound is honored as given
+    pool = PairPool(max_idle_total=128, max_idle_per_key=8)
+    assert pool.max_idle_per_key == 8
+    pool.drain()

@@ -338,6 +338,7 @@ class PairPool:
             inst.drain()
 
     def __init__(self, pair_factory: Optional[Callable[[], Pair]] = None,
+                 max_idle_total: Optional[int] = None,
                  max_idle_per_key: Optional[int] = None):
         cfg = get_config()
         if pair_factory is None:
@@ -347,10 +348,15 @@ class PairPool:
 
             pair_factory = lambda: Pair(ShmDomain())  # noqa: E731
         self.pair_factory = pair_factory
-        self.max_idle_per_key = (max_idle_per_key if max_idle_per_key is not None
-                                 else cfg.pair_pool_size)
-        #: one global bound, like the reference's fixed 128-pair pool (pair.h:273)
-        self.max_idle_total = self.max_idle_per_key
+        #: global bound = the reference's flat 128-pair pool (pair.h:273);
+        #: the per-key default is a QUARTER of it so one hot peer key cannot
+        #: evict-starve every other key (r1 verdict: equal bounds did). An
+        #: explicit max_idle_per_key is honored as given.
+        self.max_idle_total = (max_idle_total if max_idle_total is not None
+                               else cfg.pair_pool_size)
+        self.max_idle_per_key = (max_idle_per_key
+                                 if max_idle_per_key is not None
+                                 else max(1, self.max_idle_total // 4))
         self._idle: Dict[str, List[Pair]] = defaultdict(list)
         self._idle_total = 0
         self._lock = threading.Lock()

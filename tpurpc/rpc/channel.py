@@ -35,7 +35,7 @@ trace_channel = TraceFlag("channel")
 class _ClientStream:
     """Per-call state the reader thread feeds and the caller thread drains."""
 
-    def __init__(self, stream_id: int):
+    def __init__(self, stream_id: int, queue_depth: int = 64):
         self.stream_id = stream_id
         self.events: "queue.Queue[tuple]" = queue.Queue()
         self.initial_metadata: Optional[List[Tuple[str, "str | bytes"]]] = None
@@ -43,13 +43,38 @@ class _ClientStream:
         #: directly (single receive-side copy; no per-fragment bytes + join)
         self.assembly = fr.Assembly()
         self.done = False  # trailers or failure delivered
+        #: backpressure: bounded count of completed-but-unconsumed response
+        #: messages (see _ServerStream._credits for the full rationale);
+        #: trailers/failure events bypass — they must never deadlock
+        self._credits = threading.BoundedSemaphore(max(1, queue_depth))
 
-    def commit_message(self, more: bool) -> None:
+    def _acquire_credit(self) -> bool:
+        while not self._credits.acquire(timeout=0.25):
+            if self.done:
+                return False
+        return True
+
+    def release_credit(self) -> None:
+        try:
+            self._credits.release()
+        except ValueError:
+            pass
+
+    def commit_message(self, more: bool, oversized: bool = False) -> None:
         if more:
+            return
+        if oversized:
+            self.assembly.oversized = False
+            self.deliver_failure(
+                StatusCode.RESOURCE_EXHAUSTED,
+                "received message larger than max_receive_message_length")
             return
         # take() detaches the storage (consumers may alias it); the Assembly
         # object itself is reusable for the next message.
-        self.events.put(("message", self.assembly.take()))
+        if self._acquire_credit():
+            self.events.put(("message", self.assembly.take()))
+        else:
+            self.assembly.take()  # stream already finished: drop
 
     def deliver_trailers(self, code: StatusCode, details: str, md) -> None:
         self.done = True
@@ -79,17 +104,20 @@ class _ChannelSink(fr.MessageSink):
         with self._conn._lock:
             st = self._conn._streams.get(stream_id)
         if st is not None:
-            st.commit_message(bool(flags & fr.FLAG_MORE))
+            st.commit_message(bool(flags & fr.FLAG_MORE),
+                              oversized=st.assembly.oversized)
 
 
 class _Connection:
     """One live transport: endpoint + reader thread + muxed writer."""
 
-    def __init__(self, endpoint: Endpoint, on_dead: Callable[["_Connection"], None]):
+    def __init__(self, endpoint: Endpoint, on_dead: Callable[["_Connection"], None],
+                 max_recv_bytes: "Optional[int]" = None):
         self.endpoint = endpoint
         self.writer = fr.FrameWriter(endpoint)
         self.reader = fr.FrameReader(endpoint)
         self.reader.sink = _ChannelSink(self)
+        self.reader.sink.max_message_bytes = max_recv_bytes
         self._streams: dict[int, _ClientStream] = {}
         self._lock = threading.Lock()
         self._next_stream_id = 1  # odd ids, client-initiated (h2 convention)
@@ -107,7 +135,10 @@ class _Connection:
                 raise EndpointError("connection closed")
             sid = self._next_stream_id
             self._next_stream_id += 2
-            st = _ClientStream(sid)
+            from tpurpc.utils.config import get_config
+
+            st = _ClientStream(sid,
+                               queue_depth=get_config().stream_queue_depth)
             self._streams[sid] = st
             return st
 
@@ -229,7 +260,9 @@ class _Subchannel:
                 raise RpcError(StatusCode.UNAVAILABLE, "channel closed")
             try:
                 ep = self._factory()
-                conn = _Connection(ep, self._on_conn_dead)
+                conn = _Connection(
+                    ep, self._on_conn_dead,
+                    max_recv_bytes=self._channel.max_receive_message_length)
             except (OSError, EndpointError) as exc:
                 with self._lock:
                     self._next_attempt = (
@@ -276,8 +309,13 @@ class Channel:
     def __init__(self, target: Optional[str] = None, *,
                  endpoint_factory: Optional[Callable[[], Endpoint]] = None,
                  connect_timeout: float = 30.0, lb_policy: str = "pick_first",
-                 credentials=None):
+                 credentials=None,
+                 max_receive_message_length: Optional[int] = None):
         from tpurpc.rpc.resolver import make_policy, resolve_target
+        from tpurpc.utils.config import get_config
+
+        self.max_receive_message_length = get_config().resolve_recv_limit(
+            max_receive_message_length)
 
         ssl_ctx = getattr(credentials, "_context", None)
         override = getattr(credentials, "_override_hostname", None)
@@ -418,6 +456,18 @@ class Call:
             pass
         self._st.deliver_failure(StatusCode.CANCELLED, "cancelled by client")
 
+    def __del__(self):
+        # An ABANDONED streaming call (iterator dropped mid-stream without
+        # cancel) must not wedge the connection: the server keeps streaming,
+        # the stream's credit bound fills, and the reader thread would block
+        # in _acquire_credit with nobody left to set `done`. GC-time cancel
+        # RSTs the server and delivers the failure that unblocks the reader
+        # (grpcio's core does the equivalent via call refcounts).
+        try:
+            self.cancel()
+        except Exception:
+            pass  # interpreter teardown: modules may be half-dead
+
     def time_remaining(self) -> Optional[float]:
         if self._deadline is None:
             return None
@@ -467,6 +517,7 @@ class Call:
             if ev[0] == "initial_metadata":
                 continue
             if ev[0] == "message":
+                self._st.release_credit()  # slot freed: reader may refill
                 yield _deserialize(self._deser, ev[1])
                 continue
             _, code, details, md = ev

@@ -310,11 +310,15 @@ class Assembly:
     alias it indefinitely (the tensor codec's zero-copy decode does), so the
     next message gets fresh storage instead of a reuse-after-free."""
 
-    __slots__ = ("_buf", "_used")
+    __slots__ = ("_buf", "_used", "oversized")
 
     def __init__(self):
         self._buf = None
         self._used = 0
+        #: the in-flight message tripped the receive-size limit: remaining
+        #: fragments are consumed-and-discarded (framing stays in sync) and
+        #: the sink's commit delivers RESOURCE_EXHAUSTED instead of a message
+        self.oversized = False
 
     def __len__(self) -> int:
         return self._used
@@ -349,7 +353,9 @@ class Assembly:
 
     def take(self):
         """Detach and return the filled prefix (memoryview over the storage);
-        the assembly resets to empty with fresh backing."""
+        the assembly resets to empty with fresh backing and a clear
+        :attr:`oversized` flag."""
+        self.oversized = False
         if self._buf is None:
             return memoryview(b"")
         out = memoryview(self._buf.data)[:self._used]
@@ -364,6 +370,12 @@ class MessageSink:
     The reader drains each fragment's bytes straight into the per-stream
     :class:`Assembly` (one copy off the wire: transport → message storage —
     the receive-side half of the copy ledger the north star optimizes)."""
+
+    #: Largest acceptable assembled message; None = unlimited. Enforced by
+    #: the FrameReader BEFORE buffering (an over-limit message is discarded
+    #: in transit, never held in memory) — grpc.max_receive_message_length /
+    #: resource_quota.cc's receive-side role.
+    max_message_bytes = None
 
     def buffer_for(self, stream_id: int) -> Assembly:
         raise NotImplementedError
@@ -420,12 +432,20 @@ class FrameReader:
         never desyncs."""
         try:
             while rest:
-                n = self._ep.read_into(dst.tail(rest), timeout=timeout)
+                if dst.oversized:
+                    # consume-and-discard through the scratch: the framing
+                    # must stay in sync even for rejected messages
+                    n = self._ep.read_into(
+                        self._scratch_mv[:min(rest, MAX_FRAME_PAYLOAD)],
+                        timeout=timeout)
+                else:
+                    n = self._ep.read_into(dst.tail(rest), timeout=timeout)
                 if n == 0:
                     self._eof = True
                     raise FrameError("truncated frame payload at EOF")
-                dst.advance(n)
-                _ledger.host_copy(n)
+                if not dst.oversized:
+                    dst.advance(n)
+                    _ledger.host_copy(n)
                 rest -= n
         except TimeoutError:
             self._pending_msg = (dst, rest, stream_id, flags)
@@ -457,8 +477,17 @@ class FrameReader:
         hdr = HEADER_FMT.size
         if ftype == MESSAGE and self.sink is not None:
             dst = self.sink.buffer_for(stream_id)
-            dst.reserve(length)  # announced frame length: presize ONCE
+            limit = self.sink.max_message_bytes
+            if (limit is not None and not dst.oversized
+                    and len(dst) + length > limit):
+                dst.take()  # free what was buffered; the message is doomed
+                dst.oversized = True  # AFTER take() (take clears the flag)
             have = min(length, len(self._buf) - hdr)
+            if dst.oversized:
+                del self._buf[:hdr + have]
+                return self._drain_message(dst, length - have, stream_id,
+                                           flags, timeout)
+            dst.reserve(length)  # announced frame length: presize ONCE
             if have:
                 dst.append(memoryview(self._buf)[hdr:hdr + have])
                 _ledger.host_copy(have)

@@ -31,6 +31,7 @@ from tpurpc.rpc import frame as fr
 from tpurpc.rpc.status import (AbortError, Deserializer, Metadata, Serializer,
                                StatusCode, deserialize as _deserialize,
                                identity_codec as _identity)
+from tpurpc.utils.config import get_config
 from tpurpc.utils.trace import TraceFlag
 
 trace_server = TraceFlag("server")
@@ -173,21 +174,55 @@ class _ServerStream:
     """Inbound half of one RPC: request frames → handler-visible iterator."""
 
     _END = object()
+    _OVERSIZED = object()
 
-    def __init__(self, stream_id: int):
+    def __init__(self, stream_id: int, queue_depth: int = 64,
+                 recv_limit: Optional[int] = None):
         self.stream_id = stream_id
+        #: the EFFECTIVE receive bound (server override or config), quoted in
+        #: the RESOURCE_EXHAUSTED details so operators debug the right knob
+        self.recv_limit = recv_limit
         self.requests: "queue.Queue[object]" = queue.Queue()
         #: fragment assembly — the FrameReader sink appends wire bytes here
         self.assembly = fr.Assembly()
         self.half_closed = False
         self.context: Optional[ServerContext] = None
+        #: Backpressure: at most queue_depth completed-but-unconsumed
+        #: messages per stream. The connection READER blocks acquiring a
+        #: credit, which stops draining the transport, which dries the
+        #: ring's credits, which stalls the sender — memory stays bounded
+        #: end to end. Control sentinels (_END/_OVERSIZED) bypass: they must
+        #: never deadlock delivery. (resource_quota.cc's role, per-stream.)
+        self._credits = threading.BoundedSemaphore(max(1, queue_depth))
+
+    def _acquire_credit(self) -> bool:
+        """Block until a queue slot frees; False if the stream/ctx died
+        meanwhile (drop the message — nobody will read it)."""
+        while not self._credits.acquire(timeout=0.25):
+            ctx = self.context
+            if ctx is not None and not ctx.is_active():
+                return False
+        return True
+
+    def _release_credit(self) -> None:
+        try:
+            self._credits.release()
+        except ValueError:
+            pass  # sentinel consumption paths may over-release; cap holds
 
     def commit_message(self, more: bool, end_stream: bool,
-                       no_message: bool = False) -> None:
-        if not no_message and not more:
+                       no_message: bool = False,
+                       oversized: bool = False) -> None:
+        if oversized and not more:
+            self.assembly.oversized = False
+            self.requests.put(self._OVERSIZED)
+        elif not no_message and not more:
             # take() detaches the storage (consumers may alias it); the
             # Assembly object itself is reusable for the next message.
-            self.requests.put(self.assembly.take())
+            if self._acquire_credit():
+                self.requests.put(self.assembly.take())
+            else:
+                self.assembly.take()  # stream dead: drop, free the bytes
         if end_stream:
             self.half_closed = True
             self.requests.put(self._END)
@@ -197,12 +232,24 @@ class _ServerStream:
             self.context.cancel()
         self.requests.put(self._END)
 
+    def next_request(self, timeout: Optional[float] = None):
+        """One queue item with its credit returned; queue.Empty on timeout."""
+        item = self.requests.get(timeout=timeout)
+        if item is not self._END and item is not self._OVERSIZED:
+            self._release_credit()
+        return item
+
     def request_iterator(self, deserializer: Deserializer,
                          context: ServerContext) -> Iterator[object]:
         while True:
-            item = self.requests.get()
+            item = self.next_request()
             if item is self._END:
                 return
+            if item is self._OVERSIZED:
+                raise AbortError(
+                    StatusCode.RESOURCE_EXHAUSTED,
+                    "received message larger than max "
+                    f"({self.recv_limit} bytes)")
             if not context.is_active():
                 return
             yield _deserialize(deserializer, item)
@@ -229,7 +276,8 @@ class _ServerSink(fr.MessageSink):
         if st is not None:
             st.commit_message(bool(flags & fr.FLAG_MORE),
                               bool(flags & fr.FLAG_END_STREAM),
-                              bool(flags & fr.FLAG_NO_MESSAGE))
+                              bool(flags & fr.FLAG_NO_MESSAGE),
+                              oversized=st.assembly.oversized)
 
 
 class _ServerConnection:
@@ -241,6 +289,7 @@ class _ServerConnection:
         self.reader = fr.FrameReader(endpoint,
                                      expect_preface=not preface_consumed)
         self.reader.sink = _ServerSink(self)
+        self.reader.sink.max_message_bytes = server.max_receive_message_length
         self._streams: Dict[int, _ServerStream] = {}
         self._lock = threading.Lock()
         self.alive = True
@@ -292,7 +341,9 @@ class _ServerConnection:
 
     def _start_stream(self, f: fr.Frame) -> None:
         path, timeout_us, metadata = fr.parse_headers(f.payload)
-        st = _ServerStream(f.stream_id)
+        st = _ServerStream(f.stream_id,
+                           queue_depth=get_config().stream_queue_depth,
+                           recv_limit=self.server.max_receive_message_length)
         with self._lock:
             self._streams[f.stream_id] = st
         deadline = (None if timeout_us is None
@@ -331,10 +382,16 @@ class _ServerConnection:
                     # Honor the declared deadline while waiting for the request
                     # body, or a silent client pins this pool worker until its
                     # connection dies.
-                    item = st.requests.get(timeout=ctx.deadline_remaining())
+                    item = st.next_request(timeout=ctx.deadline_remaining())
                 except queue.Empty:
                     self._send_trailers(st, StatusCode.DEADLINE_EXCEEDED,
                                         "deadline exceeded awaiting request")
+                    return
+                if item is _ServerStream._OVERSIZED:
+                    self._send_trailers(
+                        st, StatusCode.RESOURCE_EXHAUSTED,
+                        "received message larger than max "
+                        f"({st.recv_limit} bytes)")
                     return
                 if item is _ServerStream._END or not ctx.is_active():
                     if ctx.is_active():
@@ -435,10 +492,14 @@ class _ServerConnection:
 class Server:
     """Thread-pooled RPC server over any Endpoint source."""
 
-    def __init__(self, max_workers: int = 32, interceptors: Sequence = ()):
+    def __init__(self, max_workers: int = 32, interceptors: Sequence = (),
+                 max_receive_message_length: Optional[int] = None):
         self._pool = ThreadPoolExecutor(max_workers=max_workers,
                                         thread_name_prefix="tpurpc-handler")
         self.interceptors = list(interceptors)
+        #: per-message receive bound (None = config default; -1 = unlimited)
+        self.max_receive_message_length = get_config().resolve_recv_limit(
+            max_receive_message_length)
         from tpurpc.rpc import channelz as _channelz
 
         self.call_counters = _channelz.CallCounters()

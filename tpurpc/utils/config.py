@@ -139,6 +139,15 @@ class Config:
     #: analog of ring_buffer_size_kb for the device-resident ring. Default
     #: 16 MiB: four in-flight 4 MiB tensors per connection.
     hbm_ring_size_kb: int = 16384
+    #: Largest acceptable received message, bytes (-1 = unlimited). The
+    #: grpc.max_receive_message_length analog, sized for tensor traffic
+    #: (grpcio's 4 MiB default would reject one float32[1024,1024] payload).
+    max_recv_message_length: int = 64 << 20
+    #: Completed-but-unconsumed messages buffered per stream before the
+    #: connection reader stops draining the transport (backpressure; the
+    #: ring's credit flow then stalls the sender). resource_quota.cc's role,
+    #: expressed in messages instead of bytes.
+    stream_queue_depth: int = 64
 
     @property
     def ring_buffer_size(self) -> int:
@@ -203,7 +212,27 @@ class Config:
             poller_capacity=_env_int("TPURPC_POLLER_CAPACITY", cls.poller_capacity),
             hbm_ring_size_kb=_env_int(
                 "TPURPC_HBM_RING_SIZE_KB", cls.hbm_ring_size_kb),
+            max_recv_message_length=_env_int(
+                "TPURPC_MAX_RECV_MESSAGE_LENGTH", cls.max_recv_message_length),
+            stream_queue_depth=_env_int(
+                "TPURPC_STREAM_QUEUE_DEPTH", cls.stream_queue_depth),
         )
+
+    @property
+    def max_recv_message_bytes(self):
+        """None when unlimited (env value < 0), else the byte bound."""
+        if self.max_recv_message_length < 0:
+            return None
+        return self.max_recv_message_length
+
+    def resolve_recv_limit(self, override):
+        """One rule for the Server/Channel option: None → config default,
+        negative → unlimited (None), else the explicit byte bound."""
+        if override is None:
+            return self.max_recv_message_bytes
+        if override < 0:
+            return None
+        return override
 
     @property
     def hbm_ring_size(self) -> int:
