@@ -1,0 +1,293 @@
+"""Codegen layer (SURVEY L8 / reference src/compiler):
+
+1. the tpurpc protoc plugin generates working native stubs end to end,
+2. modules shaped exactly like grpc_tools.protoc output (stub calling
+   ``channel.unary_unary(..., _registered_method=True)``; server side
+   calling ``add_generic_rpc_handlers`` + ``add_registered_method_handlers``
+   with grpcio handler OBJECTS) run unchanged on tpurpc, and
+3. protobuf_codec wires generated message classes to any handler.
+"""
+
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import grpc
+import pytest
+
+import tpurpc.rpc as tps
+from tpurpc.codegen import protobuf_codec
+
+PROTO = textwrap.dedent("""\
+    syntax = "proto3";
+    package demo;
+
+    message Ping { string text = 1; int32 n = 2; }
+    message Pong { string text = 1; int32 total = 2; }
+
+    service Greeter {
+      rpc Hello (Ping) returns (Pong);
+      rpc Tail (Ping) returns (stream Pong);
+      rpc Sum (stream Ping) returns (Pong);
+      rpc Chat (stream Ping) returns (stream Pong);
+    }
+    """)
+
+
+@pytest.fixture(scope="module")
+def generated(tmp_path_factory):
+    """protoc --python_out + our plugin --tpurpc_out, imported from tmp."""
+    out = tmp_path_factory.mktemp("gen")
+    (out / "demo.proto").write_text(PROTO)
+    shim = out / "protoc-gen-tpurpc"
+    shim.write_text(f"#!/bin/sh\nexec {sys.executable} -m tpurpc.codegen.plugin\n")
+    shim.chmod(0o755)
+    import os
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (str(Path(__file__).resolve().parent.parent)
+                         + os.pathsep + env.get("PYTHONPATH", ""))
+    subprocess.run(
+        ["protoc", f"--plugin=protoc-gen-tpurpc={shim}",
+         f"--python_out={out}", f"--tpurpc_out={out}",
+         f"-I{out}", "demo.proto"],
+        check=True, env=env)
+    sys.path.insert(0, str(out))
+    try:
+        import demo_pb2
+        import demo_tpurpc
+        yield demo_pb2, demo_tpurpc
+    finally:
+        sys.path.remove(str(out))
+        for mod in ("demo_pb2", "demo_tpurpc"):
+            sys.modules.pop(mod, None)
+
+
+class _GreeterImpl:
+    def Hello(self, request, context):
+        import demo_pb2
+
+        return demo_pb2.Pong(text=f"hello {request.text}", total=request.n)
+
+    def Tail(self, request, context):
+        import demo_pb2
+
+        for i in range(request.n):
+            yield demo_pb2.Pong(text=request.text, total=i)
+
+    def Sum(self, request_iterator, context):
+        import demo_pb2
+
+        total = sum(r.n for r in request_iterator)
+        return demo_pb2.Pong(text="sum", total=total)
+
+    def Chat(self, request_iterator, context):
+        import demo_pb2
+
+        for r in request_iterator:
+            yield demo_pb2.Pong(text=f"re:{r.text}", total=r.n)
+
+
+def test_plugin_generated_stubs_end_to_end(generated):
+    demo_pb2, demo_tpurpc = generated
+    srv = tps.Server(max_workers=4)
+    demo_tpurpc.add_GreeterServicer_to_server(_GreeterImpl(), srv)
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    try:
+        with tps.Channel(f"127.0.0.1:{port}") as ch:
+            stub = demo_tpurpc.GreeterStub(ch)
+            pong = stub.Hello(demo_pb2.Ping(text="tpu", n=7), timeout=20)
+            assert (pong.text, pong.total) == ("hello tpu", 7)
+            tails = list(stub.Tail(demo_pb2.Ping(text="t", n=3), timeout=20))
+            assert [p.total for p in tails] == [0, 1, 2]
+            s = stub.Sum(iter([demo_pb2.Ping(n=i) for i in (1, 2, 3)]),
+                         timeout=20)
+            assert s.total == 6
+            chats = list(stub.Chat(iter([demo_pb2.Ping(text="x", n=1)]),
+                                   timeout=20))
+            assert chats[0].text == "re:x"
+    finally:
+        srv.stop(grace=0)
+
+
+def test_plugin_unimplemented_servicer_base(generated):
+    demo_pb2, demo_tpurpc = generated
+    srv = tps.Server(max_workers=2)
+    demo_tpurpc.add_GreeterServicer_to_server(
+        demo_tpurpc.GreeterServicer(), srv)  # base class: all UNIMPLEMENTED
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    try:
+        with tps.Channel(f"127.0.0.1:{port}") as ch:
+            stub = demo_tpurpc.GreeterStub(ch)
+            with pytest.raises(tps.RpcError) as ei:
+                stub.Hello(demo_pb2.Ping(text="x"), timeout=20)
+            assert ei.value.code() is tps.StatusCode.UNIMPLEMENTED
+    finally:
+        srv.stop(grace=0)
+
+
+# ---------------------------------------------------------------------------
+# Stock grpc_tools-SHAPED module (faithful mimic of its generated output —
+# grpcio-tools isn't installed here, so the generated text is inlined).
+# ---------------------------------------------------------------------------
+
+def _make_grpcio_style_module(demo_pb2):
+    class GreeterStub:
+        """Byte-for-byte the call shape grpc_tools.protoc emits."""
+
+        def __init__(self, channel):
+            self.Hello = channel.unary_unary(
+                "/demo.Greeter/Hello",
+                request_serializer=demo_pb2.Ping.SerializeToString,
+                response_deserializer=demo_pb2.Pong.FromString,
+                _registered_method=True)
+            self.Tail = channel.unary_stream(
+                "/demo.Greeter/Tail",
+                request_serializer=demo_pb2.Ping.SerializeToString,
+                response_deserializer=demo_pb2.Pong.FromString,
+                _registered_method=True)
+            self.Sum = channel.stream_unary(
+                "/demo.Greeter/Sum",
+                request_serializer=demo_pb2.Ping.SerializeToString,
+                response_deserializer=demo_pb2.Pong.FromString,
+                _registered_method=True)
+
+    def add_GreeterServicer_to_server(servicer, server):
+        rpc_method_handlers = {
+            "Hello": grpc.unary_unary_rpc_method_handler(
+                servicer.Hello,
+                request_deserializer=demo_pb2.Ping.FromString,
+                response_serializer=demo_pb2.Pong.SerializeToString),
+            "Tail": grpc.unary_stream_rpc_method_handler(
+                servicer.Tail,
+                request_deserializer=demo_pb2.Ping.FromString,
+                response_serializer=demo_pb2.Pong.SerializeToString),
+            "Sum": grpc.stream_unary_rpc_method_handler(
+                servicer.Sum,
+                request_deserializer=demo_pb2.Ping.FromString,
+                response_serializer=demo_pb2.Pong.SerializeToString),
+        }
+        generic_handler = grpc.method_handlers_generic_handler(
+            "demo.Greeter", rpc_method_handlers)
+        server.add_generic_rpc_handlers((generic_handler,))
+        server.add_registered_method_handlers("demo.Greeter",
+                                              rpc_method_handlers)
+
+    return GreeterStub, add_GreeterServicer_to_server
+
+
+def test_stock_grpcio_generated_shapes_run_on_tpurpc(generated):
+    """The mechanical-port claim: a grpc_tools-generated module — grpcio
+    handler objects, generic handler registration, _registered_method kwarg
+    and all — drives a tpurpc server AND a tpurpc channel unchanged."""
+    demo_pb2, _ = generated
+    GreeterStub, add_to_server = _make_grpcio_style_module(demo_pb2)
+
+    srv = tps.Server(max_workers=4)
+    add_to_server(_GreeterImpl(), srv)
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    try:
+        with tps.Channel(f"127.0.0.1:{port}") as ch:
+            stub = GreeterStub(ch)
+            pong = stub.Hello(demo_pb2.Ping(text="port", n=3), timeout=20)
+            assert (pong.text, pong.total) == ("hello port", 3)
+            assert [p.total for p in
+                    stub.Tail(demo_pb2.Ping(text="t", n=2), timeout=20)] == [0, 1]
+            assert stub.Sum(iter([demo_pb2.Ping(n=5), demo_pb2.Ping(n=6)]),
+                            timeout=20).total == 11
+        # and the same stub drives a STOCK grpcio client channel against the
+        # tpurpc server's h2 path (generated modules are channel-agnostic)
+        with grpc.insecure_channel(f"127.0.0.1:{port}") as gch:
+            gstub = GreeterStub(gch)
+            assert gstub.Hello(demo_pb2.Ping(text="h2", n=1),
+                               timeout=20).text == "hello h2"
+    finally:
+        srv.stop(grace=0)
+
+
+def test_protobuf_codec_roundtrip(generated):
+    demo_pb2, _ = generated
+    ser, deser = protobuf_codec(demo_pb2.Ping)
+    msg = demo_pb2.Ping(text="abc", n=42)
+    back = deser(memoryview(ser(msg)))  # views, as the rpc layer delivers
+    assert (back.text, back.n) == ("abc", 42)
+
+
+def test_protobuf_codec_with_handlers(generated):
+    demo_pb2, _ = generated
+    ping_ser, ping_deser = protobuf_codec(demo_pb2.Ping)
+    pong_ser, pong_deser = protobuf_codec(demo_pb2.Pong)
+
+    srv = tps.Server(max_workers=2)
+    srv.add_method("/demo.Greeter/Hello", tps.unary_unary_rpc_method_handler(
+        lambda req, ctx: demo_pb2.Pong(text=req.text.upper(), total=req.n),
+        request_deserializer=ping_deser, response_serializer=pong_ser))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    try:
+        with tps.Channel(f"127.0.0.1:{port}") as ch:
+            mc = ch.unary_unary("/demo.Greeter/Hello", ping_ser, pong_deser)
+            pong = mc(demo_pb2.Ping(text="up", n=9), timeout=20)
+            assert (pong.text, pong.total) == ("UP", 9)
+    finally:
+        srv.stop(grace=0)
+
+
+def test_plugin_cross_file_message_types(tmp_path):
+    """Service methods using messages from an IMPORTED .proto must resolve
+    to THAT file's pb2 module (reviewer finding: broken refs crashed the
+    generated module on import)."""
+    (tmp_path / "types.proto").write_text(textwrap.dedent("""\
+        syntax = "proto3";
+        package shared;
+        message Blob { bytes data = 1; }
+        """))
+    (tmp_path / "svc.proto").write_text(textwrap.dedent("""\
+        syntax = "proto3";
+        package app;
+        import "types.proto";
+        message Ack { int32 size = 1; }
+        service Store { rpc Put (shared.Blob) returns (Ack); }
+        """))
+    shim = tmp_path / "protoc-gen-tpurpc"
+    shim.write_text(
+        f"#!/bin/sh\nexec {sys.executable} -m tpurpc.codegen.plugin\n")
+    shim.chmod(0o755)
+    import os
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (str(Path(__file__).resolve().parent.parent)
+                         + os.pathsep + env.get("PYTHONPATH", ""))
+    subprocess.run(
+        ["protoc", f"--plugin=protoc-gen-tpurpc={shim}",
+         f"--python_out={tmp_path}", f"--tpurpc_out={tmp_path}",
+         f"-I{tmp_path}", "svc.proto", "types.proto"],
+        check=True, env=env)
+    sys.path.insert(0, str(tmp_path))
+    try:
+        import svc_pb2
+        import svc_tpurpc
+        import types_pb2
+
+        srv = tps.Server(max_workers=2)
+
+        class Impl(svc_tpurpc.StoreServicer):
+            def Put(self, request, context):
+                return svc_pb2.Ack(size=len(request.data))
+
+        svc_tpurpc.add_StoreServicer_to_server(Impl(), srv)
+        port = srv.add_insecure_port("127.0.0.1:0")
+        srv.start()
+        try:
+            with tps.Channel(f"127.0.0.1:{port}") as ch:
+                stub = svc_tpurpc.StoreStub(ch)
+                ack = stub.Put(types_pb2.Blob(data=b"12345"), timeout=20)
+                assert ack.size == 5
+        finally:
+            srv.stop(grace=0)
+    finally:
+        sys.path.remove(str(tmp_path))
+        for mod in ("svc_pb2", "svc_tpurpc", "types_pb2"):
+            sys.modules.pop(mod, None)

@@ -324,6 +324,18 @@ class Server:
             handler = _adapt(handler, self._loop_ref)
         self._sync.add_method(path, handler)
 
+    def add_service(self, service: str, method_handlers) -> None:
+        for name, h in dict(method_handlers).items():
+            self.add_method(f"/{service}/{name}", h)
+
+    # grpcio-generated-code surface (sync-behavior handlers pass straight
+    # through to the threaded server's adaptation; see rpc/server.py)
+    def add_generic_rpc_handlers(self, generic_handlers) -> None:
+        self._sync.add_generic_rpc_handlers(generic_handlers)
+
+    def add_registered_method_handlers(self, service, method_handlers) -> None:
+        self._sync.add_registered_method_handlers(service, method_handlers)
+
     def add_insecure_port(self, address: str) -> int:
         return self._sync.add_insecure_port(address)
 
@@ -402,9 +414,9 @@ class Channel:
             None, lambda: self._sync.ping(timeout))
 
     def unary_unary(self, method: str, request_serializer=_identity,
-                    response_deserializer=_identity):
+                    response_deserializer=_identity, **grpcio_kwargs):
         mc = self._sync.unary_unary(method, request_serializer,
-                                    response_deserializer)
+                                    response_deserializer, **grpcio_kwargs)
 
         async def call(request, timeout: Optional[float] = None,
                        metadata: Optional[Metadata] = None):
@@ -414,9 +426,9 @@ class Channel:
         return call
 
     def unary_stream(self, method: str, request_serializer=_identity,
-                     response_deserializer=_identity):
+                     response_deserializer=_identity, **grpcio_kwargs):
         mc = self._sync.unary_stream(method, request_serializer,
-                                     response_deserializer)
+                                     response_deserializer, **grpcio_kwargs)
 
         def call(request, timeout: Optional[float] = None,
                  metadata: Optional[Metadata] = None) -> AsyncIterator:
@@ -426,9 +438,9 @@ class Channel:
         return call
 
     def stream_unary(self, method: str, request_serializer=_identity,
-                     response_deserializer=_identity):
+                     response_deserializer=_identity, **grpcio_kwargs):
         mc = self._sync.stream_unary(method, request_serializer,
-                                     response_deserializer)
+                                     response_deserializer, **grpcio_kwargs)
 
         async def call(request_iterator, timeout: Optional[float] = None,
                        metadata: Optional[Metadata] = None):
@@ -443,9 +455,9 @@ class Channel:
         return call
 
     def stream_stream(self, method: str, request_serializer=_identity,
-                      response_deserializer=_identity):
+                      response_deserializer=_identity, **grpcio_kwargs):
         mc = self._sync.stream_stream(method, request_serializer,
-                                      response_deserializer)
+                                      response_deserializer, **grpcio_kwargs)
 
         def call(request_iterator, timeout: Optional[float] = None,
                  metadata: Optional[Metadata] = None) -> AsyncIterator:

@@ -399,21 +399,34 @@ class Channel:
 
     # -- call surface (grpcio-shaped) ----------------------------------------
 
+    # Factories accept (and ignore) the extra kwargs grpcio-generated stubs
+    # pass (_registered_method=True since grpcio 1.60) and treat None codecs
+    # as identity, grpcio-style — so a stock *_pb2_grpc.FooStub(channel)
+    # built against THIS channel works unchanged (mechanical-port claim).
+
     def unary_unary(self, method: str, request_serializer: Serializer = _identity,
-                    response_deserializer: Deserializer = _identity) -> "UnaryUnary":
-        return UnaryUnary(self, method, request_serializer, response_deserializer)
+                    response_deserializer: Deserializer = _identity,
+                    **_grpcio_kwargs) -> "UnaryUnary":
+        return UnaryUnary(self, method, request_serializer or _identity,
+                          response_deserializer or _identity)
 
     def unary_stream(self, method: str, request_serializer: Serializer = _identity,
-                     response_deserializer: Deserializer = _identity) -> "UnaryStream":
-        return UnaryStream(self, method, request_serializer, response_deserializer)
+                     response_deserializer: Deserializer = _identity,
+                     **_grpcio_kwargs) -> "UnaryStream":
+        return UnaryStream(self, method, request_serializer or _identity,
+                           response_deserializer or _identity)
 
     def stream_unary(self, method: str, request_serializer: Serializer = _identity,
-                     response_deserializer: Deserializer = _identity) -> "StreamUnary":
-        return StreamUnary(self, method, request_serializer, response_deserializer)
+                     response_deserializer: Deserializer = _identity,
+                     **_grpcio_kwargs) -> "StreamUnary":
+        return StreamUnary(self, method, request_serializer or _identity,
+                           response_deserializer or _identity)
 
     def stream_stream(self, method: str, request_serializer: Serializer = _identity,
-                      response_deserializer: Deserializer = _identity) -> "StreamStream":
-        return StreamStream(self, method, request_serializer, response_deserializer)
+                      response_deserializer: Deserializer = _identity,
+                      **_grpcio_kwargs) -> "StreamStream":
+        return StreamStream(self, method, request_serializer or _identity,
+                            response_deserializer or _identity)
 
 
 class Call:
@@ -607,14 +620,25 @@ class _MultiCallable:
                                f"request iterator raised: {exc!r}")
 
 
+def _reject_call_credentials(grpcio_kw: dict) -> None:
+    """grpcio callers may pass credentials/wait_for_ready/compression per
+    call. wait_for_ready/compression are advisory — ignored; per-call
+    CREDENTIALS are a security feature we must not silently drop."""
+    if grpcio_kw.get("credentials") is not None:
+        raise NotImplementedError(
+            "per-call credentials are not supported; use channel credentials")
+
+
 class UnaryUnary(_MultiCallable):
     def __call__(self, request, timeout: Optional[float] = None,
-                 metadata: Optional[Metadata] = None):
+                 metadata: Optional[Metadata] = None, **grpcio_kw):
+        _reject_call_credentials(grpcio_kw)
         response, _ = self.with_call(request, timeout=timeout, metadata=metadata)
         return response
 
     def with_call(self, request, timeout: Optional[float] = None,
-                  metadata: Optional[Metadata] = None):
+                  metadata: Optional[Metadata] = None, **grpcio_kw):
+        _reject_call_credentials(grpcio_kw)
         conn, st, call = self._start(metadata, timeout, first_request=request)
         response = None
         got = False
@@ -649,7 +673,8 @@ class UnaryUnary(_MultiCallable):
 
 class UnaryStream(_MultiCallable):
     def __call__(self, request, timeout: Optional[float] = None,
-                 metadata: Optional[Metadata] = None) -> Call:
+                 metadata: Optional[Metadata] = None, **grpcio_kw) -> Call:
+        _reject_call_credentials(grpcio_kw)
         conn, st, call = self._start(metadata, timeout, first_request=request)
         return call
 
@@ -657,7 +682,8 @@ class UnaryStream(_MultiCallable):
 class StreamUnary(_MultiCallable):
     def __call__(self, request_iterator: Iterable,
                  timeout: Optional[float] = None,
-                 metadata: Optional[Metadata] = None):
+                 metadata: Optional[Metadata] = None, **grpcio_kw):
+        _reject_call_credentials(grpcio_kw)
         conn, st, call = self._start(metadata, timeout)
         sender = threading.Thread(
             target=self._send_stream, args=(conn, st, request_iterator, call),
@@ -679,7 +705,8 @@ class StreamUnary(_MultiCallable):
 class StreamStream(_MultiCallable):
     def __call__(self, request_iterator: Iterable,
                  timeout: Optional[float] = None,
-                 metadata: Optional[Metadata] = None) -> Call:
+                 metadata: Optional[Metadata] = None, **grpcio_kw) -> Call:
+        _reject_call_credentials(grpcio_kw)
         conn, st, call = self._start(metadata, timeout)
         sender = threading.Thread(
             target=self._send_stream, args=(conn, st, request_iterator, call),

@@ -94,6 +94,16 @@ def method_handlers_generic_handler(service: str,
     return {f"/{service}/{name}": h for name, h in method_handlers.items()}
 
 
+class _HandlerCallDetails:
+    """grpc.HandlerCallDetails shape for GenericRpcHandler.service()."""
+
+    __slots__ = ("method", "invocation_metadata")
+
+    def __init__(self, method: str, invocation_metadata=()):
+        self.method = method
+        self.invocation_metadata = tuple(invocation_metadata or ())
+
+
 class ServerContext:
     """Handed to every handler; grpcio-compatible surface."""
 
@@ -505,6 +515,7 @@ class Server:
         self.call_counters = _channelz.CallCounters()
         _channelz.register_server(self)
         self._methods: Dict[str, RpcMethodHandler] = {}
+        self._generic_handlers: List = []  # grpcio GenericRpcHandler objects
         self._listeners: List[EndpointListener] = []
         self.bound_ports: List[int] = []
         self._connections: List[_ServerConnection] = []
@@ -521,6 +532,46 @@ class Server:
     def add_generic_handlers(self, handlers: Dict[str, RpcMethodHandler]) -> None:
         self._methods.update(handlers)
 
+    # -- grpcio-generated-code compatibility ---------------------------------
+    #
+    # Modules generated by grpc_tools.protoc register services via
+    # add_generic_rpc_handlers((generic_handler,)) and (grpcio>=1.60)
+    # add_registered_method_handlers(service, {name: grpc.RpcMethodHandler}).
+    # Accepting both — with grpcio's handler OBJECTS duck-adapted to ours —
+    # makes `add_FooServicer_to_server(servicer, tpurpc_server)` run
+    # unchanged: the mechanical-port claim for the server side.
+
+    @staticmethod
+    def _adapt_foreign_handler(h) -> Optional[RpcMethodHandler]:
+        """grpc.RpcMethodHandler (any object with the grpcio attribute set)
+        → our handler; None if it isn't one."""
+        if isinstance(h, RpcMethodHandler):
+            return h
+        try:
+            kind = (("stream" if h.request_streaming else "unary") + "_"
+                    + ("stream" if h.response_streaming else "unary"))
+            behavior = getattr(h, kind)
+        except AttributeError:
+            return None
+        if behavior is None:
+            return None
+        return RpcMethodHandler(kind, behavior,
+                                h.request_deserializer or _identity,
+                                h.response_serializer or _identity)
+
+    def add_generic_rpc_handlers(self, generic_handlers) -> None:
+        """grpcio-shaped: a sequence of GenericRpcHandler objects whose
+        ``.service(handler_call_details)`` resolves methods at call time."""
+        self._generic_handlers.extend(generic_handlers)
+
+    def add_registered_method_handlers(self, service: str,
+                                       method_handlers) -> None:
+        """grpcio-shaped (>=1.60): eager per-method registration."""
+        for name, h in dict(method_handlers).items():
+            adapted = self._adapt_foreign_handler(h)
+            if adapted is not None:
+                self._methods[f"/{service}/{name}"] = adapted
+
     def add_service(self, service: str,
                     method_handlers: Dict[str, RpcMethodHandler]) -> None:
         self.add_generic_handlers(
@@ -529,7 +580,7 @@ class Server:
     def _lookup_intercepted(self, path: str,
                             metadata) -> Optional[RpcMethodHandler]:
         """Handler lookup through the server interceptor chain."""
-        handler = self._lookup(path)
+        handler = self._lookup(path, metadata)
         if not self.interceptors:
             return handler
         from tpurpc.rpc.interceptors import apply_server_interceptors
@@ -537,8 +588,36 @@ class Server:
         return apply_server_interceptors(handler, path, metadata,
                                          self.interceptors)
 
-    def _lookup(self, path: str) -> Optional[RpcMethodHandler]:
-        return self._methods.get(path)
+    def _lookup(self, path: str, metadata=()) -> Optional[RpcMethodHandler]:
+        handler = self._methods.get(path)
+        if handler is not None:
+            return handler
+        # grpcio-generic fallback: resolve through registered
+        # GenericRpcHandler objects (duck-typed .service(details)), or plain
+        # {path: handler} mappings (what tpurpc's own
+        # method_handlers_generic_handler returns — pre-1.60-style generated
+        # code passes those straight to add_generic_rpc_handlers).
+        for gh in self._generic_handlers:
+            getter = getattr(gh, "get", None)
+            cacheable = getter is not None
+            if cacheable:  # Mapping-shaped: metadata-independent by shape
+                found = getter(path)
+            else:
+                try:
+                    found = gh.service(_HandlerCallDetails(path, metadata))
+                except Exception:
+                    # a routing bug must not masquerade as UNIMPLEMENTED
+                    _log.exception(
+                        "generic handler %r raised resolving %s", gh, path)
+                    continue
+            if found is not None:
+                adapted = self._adapt_foreign_handler(found)
+                if adapted is not None and cacheable:
+                    # hot-path cache; .service() results are NOT cached —
+                    # a generic handler may route on metadata per call
+                    self._methods[path] = adapted
+                return adapted
+        return None
 
     # -- ports / lifecycle ---------------------------------------------------
 
