@@ -60,7 +60,7 @@ y = (x[:8, :8] + 1.0).block_until_ready()   # trivial compile warm
 print("WARM", dev.platform, round(time.time() - t0, 1), file=sys.stderr,
       flush=True)
 
-from tpurpc.jaxshim import add_tensor_method, to_jax
+from tpurpc.jaxshim import FanInBatcher, add_tensor_method, to_jax
 
 def consume(req_iter):
     total = 0
@@ -73,8 +73,41 @@ def consume(req_iter):
     yield {"bytes": np.int64(total), "check": np.float64(checksum)}
 
 add_tensor_method(srv, "Sink", consume, kind="stream_stream")
+
+# ---- serving flagship (BASELINE configs #4/#5): ResNet + fan-in batching --
+# Full ResNet-50 @224 on an accelerator; the thin-18 @64 stand-in on the CPU
+# fallback so the smoke stays fast. fixed_bucket -> ONE compiled shape.
+batcher = None
+if os.environ.get("TPURPC_BENCH_SERVING", "1") == "1":
+    import jax.numpy as jnp
+    from tpurpc.models.resnet import (init_resnet, make_infer_fn,
+                                      resnet18_thin, resnet50)
+
+    on_accel = dev.platform not in ("cpu",)
+    if on_accel:
+        model, img, model_name = resnet50(dtype=jnp.bfloat16), 224, "resnet50"
+    else:
+        model, img, model_name = resnet18_thin(), 64, "resnet18_thin"
+    variables = init_resnet(jax.random.PRNGKey(0), model, image_size=img)
+    infer = jax.jit(make_infer_fn(model))
+    MAXB = int(os.environ.get("TPURPC_BENCH_SERVING_BATCH", "8"))
+
+    def serve_fn(tree):
+        return {"logits": infer(variables, tree["x"])}
+
+    batcher = FanInBatcher(serve_fn, max_batch=MAXB, max_delay_s=0.005,
+                          fixed_bucket=True)
+    add_tensor_method(srv, "Infer", batcher)
+    # warm the single compiled batch shape before READY
+    warm = np.zeros((MAXB, img, img, 3), np.float32)
+    jax.tree_util.tree_map(lambda x: x.block_until_ready(),
+                           infer(variables, warm))
+    # stdout: the client parses this line (single source of model/img truth)
+    print("SERVING", model_name, img, flush=True)
+
 srv.start()
-print("READY", dev.platform, flush=True)
+print("READY", dev.platform, ("serving" if batcher else "noserving"),
+      flush=True)
 srv.wait_for_termination(timeout=1200)
 """
 
@@ -147,6 +180,63 @@ class _ServerProc:
             pass
 
 
+def _serving_phase(port: int, model: str, img: int):
+    """8-client fan-in (BASELINE config #4): concurrent image requests over
+    independent connections, batched server-side into one jitted call.
+    Returns (qps, model_name, n_requests); raises on failure.
+
+    Timing starts at a barrier AFTER every client has connected and warmed
+    (connection setup + first-dispatch latency excluded from the steady-state
+    figure the phase exists to measure)."""
+    import threading
+
+    import numpy as np
+
+    from tpurpc.jaxshim import TensorClient
+    from tpurpc.rpc.channel import Channel
+
+    n_clients = int(os.environ.get("TPURPC_BENCH_SERVING_CLIENTS", "8"))
+    per_client = int(os.environ.get("TPURPC_BENCH_SERVING_REQS", "16"))
+    image = np.random.default_rng(0).standard_normal(
+        (1, img, img, 3)).astype(np.float32)
+    errors: list = []
+    done = [0] * n_clients
+    start = threading.Barrier(n_clients + 1)
+
+    def client(idx: int):
+        try:
+            with Channel(f"127.0.0.1:{port}") as ch:
+                cli = TensorClient(ch)
+                cli.call("Infer", {"x": image}, timeout=300)  # per-conn warm
+                start.wait(timeout=600)
+                for _ in range(per_client):
+                    out = cli.call("Infer", {"x": image}, timeout=300)
+                    assert np.asarray(out["logits"]).shape[0] == 1
+                    done[idx] += 1
+        except Exception as exc:  # surfaced after join
+            errors.append(exc)
+            try:
+                start.abort()  # never leave the main thread at the barrier
+            except Exception:
+                pass
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(n_clients)]
+    [t.start() for t in threads]
+    start.wait(timeout=600)
+    t0 = time.perf_counter()
+    [t.join(timeout=600) for t in threads]
+    dt = time.perf_counter() - t0
+    if errors:
+        raise errors[0]
+    if any(t.is_alive() for t in threads):
+        raise TimeoutError("serving client thread still running after join "
+                           "timeout; qps would be measured on a racing "
+                           "partial count")
+    total = sum(done)
+    return total / dt, model, total
+
+
 def _run_once(env, n_msgs: int, ready_s: float):
     import numpy as np
 
@@ -154,7 +244,9 @@ def _run_once(env, n_msgs: int, ready_s: float):
     try:
         port = int(srv.wait_line("PORT", 60).split()[1])
         ready = srv.wait_line("READY", ready_s)
-        platform = ready.split()[1]
+        parts = ready.split()
+        platform = parts[1]
+        serving_on = len(parts) > 2 and parts[2] == "serving"
 
         from tpurpc.jaxshim import TensorClient
         from tpurpc.rpc.channel import Channel
@@ -176,7 +268,17 @@ def _run_once(env, n_msgs: int, ready_s: float):
 
         total = int(np.asarray(replies[-1]["bytes"]).ravel()[0])
         assert total == n_msgs * payload.nbytes, (total, n_msgs)
-        return total / dt / 1e9, platform
+
+        serving = None
+        if serving_on:
+            try:
+                # the server's SERVING line (printed before READY) is the
+                # single source of truth for the model/image geometry
+                _, model, img = srv.wait_line("SERVING", 10).split()
+                serving = _serving_phase(port, model, int(img))
+            except Exception as exc:  # serving is auxiliary: report, don't fail
+                sys.stderr.write(f"serving phase failed: {exc}\n")
+        return total / dt / 1e9, platform, serving
     except Exception:
         sys.stderr.write(srv.stderr_tail() + "\n")
         raise
@@ -200,22 +302,30 @@ def main() -> None:
                          os.pathsep + env.get("PYTHONPATH", ""))
 
     try:
-        gbps, platform = _run_once(env, n_msgs, ready_s)
+        gbps, platform, serving = _run_once(env, n_msgs, ready_s)
     except (TimeoutError, RuntimeError) as exc:
         if env.get("TPURPC_BENCH_CPU") == "1":
             raise
         sys.stderr.write(f"default-platform bench failed ({exc});"
                          f" retrying with JAX_PLATFORMS=cpu\n")
         env["TPURPC_BENCH_CPU"] = "1"
-        gbps, platform = _run_once(env, n_msgs, ready_s)
+        gbps, platform, serving = _run_once(env, n_msgs, ready_s)
 
-    print(json.dumps({
+    out = {
         "metric": "stream_4MiB_tensors_to_jax_Array",
         "value": round(gbps, 3),
         "unit": "GB/s",
         "vs_baseline": round(gbps / BASELINE_GBPS, 3),
         "jax_platform": platform,
-    }))
+    }
+    if serving is not None:
+        # BASELINE configs #4/#5 (8-client fan-in batching into a ResNet
+        # server); the reference publishes no figure, so no vs_baseline.
+        qps, model, total = serving
+        out["serving_qps"] = round(qps, 1)
+        out["serving_model"] = model
+        out["serving_requests"] = total
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -247,3 +247,25 @@ def test_eight_client_fanin_end_to_end():
         assert batcher.batches_run < 8  # real cross-connection stacking
     finally:
         srv.stop(grace=0)
+
+
+def test_batcher_fixed_bucket_single_shape():
+    """fixed_bucket pads every dispatch to max_batch: exactly one compiled
+    shape (the accelerator-serving mode bench.py uses)."""
+    import numpy as np
+
+    from tpurpc.jaxshim.service import FanInBatcher
+
+    shapes = []
+
+    def fn(tree):
+        shapes.append(tree["x"].shape[0])
+        return tree
+
+    b = FanInBatcher(fn, max_batch=8, max_delay_s=0.001, fixed_bucket=True)
+    try:
+        out = b({"x": np.ones((1, 4), np.float32)})
+        assert out["x"].shape[0] == 1  # reply sliced back to the request rows
+        assert shapes == [8]           # but the dispatch was padded to 8
+    finally:
+        b.close()

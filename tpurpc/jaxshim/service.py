@@ -219,11 +219,21 @@ class FanInBatcher:
     """
 
     def __init__(self, fn: Callable[[Any], Any], max_batch: int = 8,
-                 max_delay_s: float = 0.002, pad_to_bucket: bool = True):
+                 max_delay_s: float = 0.002, pad_to_bucket: bool = True,
+                 fixed_bucket: bool = False):
         self._fn = fn
         self.max_batch = max_batch
         self.max_delay_s = max_delay_s
         self.pad_to_bucket = pad_to_bucket
+        #: always pad to max_batch: ONE compiled shape for single-row
+        #: requests, the right trade on accelerators where each new batch
+        #: shape recompiles (XLA static shapes) — wasted pad rows cost far
+        #: less than a mid-serving compile stall. NOTE: a dispatch whose
+        #: requests total MORE than max_batch rows (multi-row requests) still
+        #: pads to that larger total and compiles its shape; the one-shape
+        #: guarantee assumes ≤1 row per request or callers sizing max_batch
+        #: to the true row bound.
+        self.fixed_bucket = fixed_bucket
         self._lock = threading.Lock()
         self._queue: List[_Pending] = []
         self._kick = threading.Condition(self._lock)
@@ -273,6 +283,8 @@ class FanInBatcher:
                 self._run(batch)
 
     def _bucket(self, n: int) -> int:
+        if self.fixed_bucket:
+            return self.max_batch
         if not self.pad_to_bucket:
             return n
         b = 1
