@@ -113,7 +113,7 @@ uint64_t message_at(const uint8_t* ring, uint64_t cap, uint64_t mask,
 
 extern "C" {
 
-int tpr_abi_version() { return 4; }
+int tpr_abi_version() { return 5; }
 
 // --- waiter-advertisement protocol (the futex-style sleep handshake) --------
 //
@@ -237,6 +237,83 @@ uint64_t tpr_ring_writev(uint8_t* ring, uint64_t cap, uint64_t* tail,
   *tail += msg_span(payload);
   ++*seq;
   return payload;
+}
+
+// Fused fast-path send (the per-RPC hot loop of pair.py's send(), one call
+// instead of ~10 Python-level steps): fold the peer-published credit head
+// from our status page, gather-encode the segments as chunked ring messages
+// under the credit budget, then decide — with the fenced load the sleep
+// protocol requires — whether the peer needs a notify byte.
+//
+//   status_addr:      our status page (peer one-sided-writes credits at +0)
+//   peer_rxwait_addr: peer's status page read-waiter word, or null (then
+//                     *notify_out is always 1 when bytes were written)
+//   chunk_size:       max payload per ring message (send_chunk_size)
+//
+// Returns payload bytes accepted — possibly a PARTIAL total (0 = fully
+// stalled for credits); the caller resumes the remainder via its byte
+// cursor. *tail / *seq / *remote_head update in place. Never returns ~0ULL.
+uint64_t tpr_send_fast(uint8_t* ring, uint64_t cap, uint64_t* tail,
+                       uint64_t* seq, const uint8_t* status_addr,
+                       uint64_t* remote_head,
+                       const uint8_t* peer_rxwait_addr,
+                       const uint8_t* const* segs, const uint64_t* lens,
+                       uint32_t nsegs, uint64_t chunk_size,
+                       int* notify_out) {
+  // fold credits (pair.cc:294-301 reading mirrored remote_head; monotone)
+  uint64_t head = __atomic_load_n(
+      reinterpret_cast<const uint64_t*>(status_addr), __ATOMIC_ACQUIRE);
+  if (head > *remote_head && head <= *tail) *remote_head = head;
+
+  uint64_t total = 0;
+  uint32_t si = 0;
+  uint64_t so = 0;
+  const uint8_t* chunk_ptrs[64];
+  uint64_t chunk_lens[64];
+  while (si < nsegs) {
+    uint64_t used = *tail - *remote_head;
+    uint64_t writable = used + kReserved >= cap ? 0 : cap - used - kReserved;
+    uint64_t budget = writable < chunk_size ? writable : chunk_size;
+    if (budget == 0) break;
+    // assemble one chunk's worth of (sub)segments
+    uint32_t n = 0;
+    uint64_t take_total = 0;
+    while (si < nsegs && take_total < budget && n < 64) {
+      uint64_t avail = lens[si] - so;
+      uint64_t take = budget - take_total < avail ? budget - take_total : avail;
+      if (take) {
+        chunk_ptrs[n] = segs[si] + so;
+        chunk_lens[n] = take;
+        ++n;
+      }
+      take_total += take;
+      so += take;
+      if (so == lens[si]) {
+        ++si;
+        so = 0;
+      }
+    }
+    if (take_total == 0) break;
+    uint64_t got = tpr_ring_writev(ring, cap, tail, *remote_head,
+                                   chunk_ptrs, chunk_lens, n, seq);
+    if (got == ~0ULL) break;  // unreachable (budget uses writev's own math);
+                              // defensively: report what IS on the wire —
+                              // the caller resumes from the returned total
+    total += got;
+  }
+  // Notify only a sleeping peer (fenced load AFTER the data stores — the
+  // producer half of the sleep protocol; see tpr_store_u64_seqcst).
+  if (total == 0) {
+    *notify_out = 0;
+  } else if (peer_rxwait_addr == nullptr) {
+    *notify_out = 1;
+  } else {
+    std::atomic_thread_fence(std::memory_order_seq_cst);
+    *notify_out = __atomic_load_n(
+        reinterpret_cast<const uint64_t*>(peer_rxwait_addr),
+        __ATOMIC_SEQ_CST) != 0;
+  }
+  return total;
 }
 
 // Has a complete message? (poller fast check; 1 = yes, 0 = no, -1 corruption)

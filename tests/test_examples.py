@@ -37,3 +37,10 @@ def test_resnet_serving_end_to_end_thin():
         assert batcher.rows_run == 2
     finally:
         srv.stop(grace=0)
+
+
+def test_secure_aio_inference_example():
+    out = subprocess.run([sys.executable, "examples/secure_aio_inference.py"],
+                         capture_output=True, text=True, timeout=180)
+    assert out.returncode == 0, out.stderr
+    assert "secure aio inference ok" in out.stdout

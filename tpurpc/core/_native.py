@@ -19,7 +19,7 @@ _LIB: "Optional[ctypes.CDLL]" = None
 _SPIN: "Optional[ctypes.CDLL]" = None
 _TRIED = False
 
-ABI_VERSION = 4
+ABI_VERSION = 5
 
 
 def _lib_path() -> str:
@@ -69,6 +69,13 @@ def load() -> "Optional[ctypes.CDLL]":
     lib.tpr_store_u64_seqcst.argtypes = [pu8, u64]
     lib.tpr_load_u64_fenced.restype = u64
     lib.tpr_load_u64_fenced.argtypes = [pu8]
+    # fused hot-path send: credit fold + chunked gather-encode + notify
+    # decision in one GIL-held call (see ring.cc tpr_send_fast)
+    lib.tpr_send_fast.restype = u64
+    lib.tpr_send_fast.argtypes = [pu8, u64, pu64, pu64, pu8, pu64, pu8,
+                                  ctypes.POINTER(ctypes.c_void_p), pu64,
+                                  ctypes.c_uint32, u64,
+                                  ctypes.POINTER(ctypes.c_int)]
     _LIB = lib
 
     # Second handle via CDLL: these calls RELEASE the GIL — they are the

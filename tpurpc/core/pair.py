@@ -28,6 +28,7 @@ via completion-channel fds (``rdma_conn.cc:24-26``); our notify socket plays bot
 from __future__ import annotations
 
 import contextlib
+import ctypes
 import enum
 import json
 import os
@@ -42,6 +43,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from tpurpc.core import _native
+from tpurpc.tpu import ledger as ring_ledger
 from tpurpc.core.ring import RingCorruption, RingFull, RingReader, RingWriter
 from tpurpc.utils.config import get_config
 from tpurpc.utils.trace import trace_ring
@@ -823,7 +825,6 @@ class Pair:
                                   + (f" ({self.error})" if self.error else ""))
         cfg = get_config()
         with self._send_guard:
-            self.process_credits()
             views: List[memoryview] = []
             skip = byte_idx
             for s in slices:
@@ -833,6 +834,10 @@ class Pair:
                     continue
                 views.append(v[skip:] if skip else v)
                 skip = 0
+            fast = self._send_fast(views, cfg)
+            if fast is not None:
+                return fast
+            self.process_credits()
             total = 0
             while views:
                 budget = min(self.writer.writable_payload(), cfg.send_chunk_size)
@@ -871,6 +876,69 @@ class Pair:
             if total and self._peer_waiting("read"):
                 self._notify(NOTIFY_DATA)
             return total
+
+    def _send_fast(self, views: "List[memoryview]", cfg) -> "Optional[int]":
+        """Fused native send (``tpr_send_fast``): credit fold + chunked
+        gather-encode + the sleep-protocol notify decision collapse into one
+        GIL-held C call — the ~10 Python-level steps of the slow path are
+        the measured per-RPC overhead in the multi-core spin regime.
+        Returns bytes accepted, or None when the fast path doesn't apply
+        (no native lib, unmapped ring, teardown racing)."""
+        lib = _native.load()
+        writer = self.writer
+        if (lib is None or writer is None or writer._nat is None
+                or not views):
+            return None
+        status_pin = self._status_pin()
+        if status_pin is None:
+            return None
+        peer_rxwait = 0
+        if "waitflag" in self.peer_caps:
+            peer_pin = self._peer_status_pin()
+            if peer_pin is not None:
+                peer_rxwait = peer_pin[1] + _STATUS_RXWAIT_OFF
+        n = len(views)
+        # locals pin every view for the call's duration
+        seg_ptrs = (ctypes.c_void_p * n)(
+            *[_native.addr_of(v, writable=False) for v in views])
+        seg_lens = (ctypes.c_uint64 * n)(*[len(v) for v in views])
+        tail = ctypes.c_uint64(writer.tail)
+        seq = ctypes.c_uint64(writer.seq)
+        rh = ctypes.c_uint64(writer.remote_head)
+        notify = ctypes.c_int(0)
+        # The credit lock spans the CALL and the writeback: the peer can
+        # consume freshly written bytes and publish a head beyond our stale
+        # writer.tail the instant the C call's stores land, and a concurrent
+        # process_credits() folding that head against the not-yet-written-
+        # back tail would raise a spurious RingCorruption. The call is
+        # GIL-held and bounded, so the hold is short.
+        with self._credit_lock:
+            got = lib.tpr_send_fast(
+                writer._nat_addr, writer.layout.capacity,
+                ctypes.byref(tail), ctypes.byref(seq),
+                status_pin[1] + _STATUS_HEAD_OFF, ctypes.byref(rh),
+                peer_rxwait or None, seg_ptrs, seg_lens, n,
+                cfg.send_chunk_size, ctypes.byref(notify))
+            writer.tail = tail.value
+            writer.seq = seq.value
+            if rh.value > writer.remote_head:
+                writer.remote_head = rh.value
+        ring_ledger.host_copy(got)
+        self.total_sent += got
+        total_len = sum(len(v) for v in views)
+        self.want_write = got < total_len
+        # the fast path folds only the credit word; peer_exit still must
+        # flip state (cheap single unpack — Disconnect, pair.cc:325-347)
+        if self.state is PairState.CONNECTED and self.status_region is not None:
+            try:
+                if _U64.unpack_from(self.status_region.buf,
+                                    _STATUS_EXIT_OFF)[0]:
+                    self.state = PairState.HALF_CLOSED
+            except ValueError:
+                pass  # racing teardown; caller's state checks surface it
+        if notify.value:
+            self._notify(NOTIFY_DATA)
+        return got
 
     def recv_into(self, dst) -> int:
         """Drain the receive ring into ``dst``; publishes credits as a side effect
