@@ -1,0 +1,173 @@
+"""Chaos: the stack under concurrent churn, garbage peers, and server death.
+
+The reference's robustness properties (SURVEY §4/§5: peer_exit handling,
+reconnect-on-UNAVAILABLE, bounded bootstrap, misconfiguration surfacing as
+clear errors) exercised adversarially rather than one case at a time.
+"""
+
+import os
+import random
+import socket
+import threading
+import time
+
+import pytest
+
+import tpurpc.rpc as tps
+from tpurpc.rpc.status import RpcError, StatusCode
+
+
+def _echo_server(platform=None, **kw):
+    srv = tps.Server(max_workers=8, **kw)
+    srv.add_method("/c.S/Echo",
+                   tps.unary_unary_rpc_method_handler(lambda req, ctx: req))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    return srv, port
+
+
+@pytest.mark.parametrize("platform", ["TCP", "RDMA_BPEV"])
+def test_garbage_and_churn_peers_dont_break_service(monkeypatch, platform):
+    """While real clients run traffic, hostile peers connect and send
+    garbage / connect and vanish / open-close rapidly. Service must stay
+    correct throughout and afterwards."""
+    monkeypatch.setenv("GRPC_PLATFORM_TYPE", platform)
+    from tpurpc.utils import config as config_mod
+
+    config_mod.set_config(None)
+    srv, port = _echo_server()
+    stop = threading.Event()
+    errors: list = []
+
+    def good_client(idx: int):
+        try:
+            with tps.Channel(f"127.0.0.1:{port}") as ch:
+                mc = ch.unary_unary("/c.S/Echo")
+                i = 0
+                while not stop.is_set():
+                    payload = f"{idx}-{i}".encode()
+                    assert bytes(mc(payload, timeout=30)) == payload
+                    i += 1
+                assert i > 0, "client made no progress"
+        except Exception as exc:
+            errors.append(exc)
+
+    def garbage_peer():
+        rng = random.Random(1234)
+        while not stop.is_set():
+            try:
+                s = socket.create_connection(("127.0.0.1", port), timeout=5)
+                mode = rng.randrange(3)
+                if mode == 0:
+                    s.sendall(rng.randbytes(rng.randrange(1, 256)))
+                elif mode == 1:
+                    pass  # connect-and-vanish (silent peer)
+                # mode 2: immediate close
+                s.close()
+            except OSError:
+                pass
+            time.sleep(0.02)
+
+    clients = [threading.Thread(target=good_client, args=(i,))
+               for i in range(3)]
+    chaos = threading.Thread(target=garbage_peer, daemon=True)
+    try:
+        [t.start() for t in clients]
+        chaos.start()
+        time.sleep(4.0)
+    finally:
+        stop.set()
+        [t.join(timeout=60) for t in clients]
+    assert not errors, errors
+    # the server is still healthy after the storm
+    with tps.Channel(f"127.0.0.1:{port}") as ch:
+        assert bytes(ch.unary_unary("/c.S/Echo")(b"after", timeout=20)) == b"after"
+    srv.stop(grace=0)
+
+
+def test_server_death_mid_streams_fails_calls_cleanly():
+    """Kill the server while many streaming calls are in flight: every call
+    must terminate with a status (UNAVAILABLE/CANCELLED), never hang."""
+    srv = tps.Server(max_workers=8)
+
+    hold = threading.Event()
+
+    def trickle(req, ctx):
+        for i in range(10_000):
+            if not ctx.is_active():
+                return
+            yield str(i).encode()
+            hold.wait(timeout=0.01)
+
+    srv.add_method("/c.S/Trickle", tps.unary_stream_rpc_method_handler(trickle))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+
+    outcomes: list = []
+
+    def consumer():
+        try:
+            with tps.Channel(f"127.0.0.1:{port}") as ch:
+                got = 0
+                for _ in ch.unary_stream("/c.S/Trickle")(b"", timeout=60):
+                    got += 1
+                outcomes.append(("finished", got))
+        except RpcError as exc:
+            outcomes.append(("status", exc.code()))
+        except Exception as exc:
+            outcomes.append(("error", exc))
+
+    threads = [threading.Thread(target=consumer) for _ in range(4)]
+    [t.start() for t in threads]
+    time.sleep(1.0)           # streams established and flowing
+    srv.stop(grace=0)         # yank the server
+    [t.join(timeout=30) for t in threads]
+    assert len(outcomes) == 4, outcomes
+    for kind, detail in outcomes:
+        assert kind == "status", (kind, detail)
+        assert detail in (StatusCode.UNAVAILABLE, StatusCode.CANCELLED), detail
+
+
+def test_channel_churn_during_traffic(monkeypatch):
+    """Rapid open/close of channels (pool take/putback churn on the ring
+    platform) while a steady client runs: no cross-talk, no corruption."""
+    monkeypatch.setenv("GRPC_PLATFORM_TYPE", "RDMA_BPEV")
+    from tpurpc.utils import config as config_mod
+
+    config_mod.set_config(None)
+    srv, port = _echo_server()
+    stop = threading.Event()
+    errors: list = []
+
+    def steady():
+        try:
+            with tps.Channel(f"127.0.0.1:{port}") as ch:
+                mc = ch.unary_unary("/c.S/Echo")
+                i = 0
+                while not stop.is_set():
+                    payload = os.urandom(1024)
+                    assert bytes(mc(payload, timeout=30)) == payload
+                    i += 1
+                assert i > 3
+        except Exception as exc:
+            errors.append(exc)
+
+    def churner():
+        try:
+            while not stop.is_set():
+                with tps.Channel(f"127.0.0.1:{port}") as ch:
+                    assert bytes(ch.unary_unary("/c.S/Echo")(
+                        b"x", timeout=30)) == b"x"
+        except Exception as exc:
+            errors.append(exc)
+
+    ts = [threading.Thread(target=steady),
+          threading.Thread(target=churner), threading.Thread(target=churner)]
+    try:
+        [t.start() for t in ts]
+        time.sleep(4.0)
+    finally:
+        stop.set()
+        [t.join(timeout=60) for t in ts]
+    assert not errors, errors
+    srv.stop(grace=0)

@@ -1,0 +1,129 @@
+"""grpc.health.v1 service: native channels, status lifecycle, Watch streams,
+and wire compatibility with a stock grpcio client over the h2 path."""
+
+import threading
+import time
+
+import grpc
+import pytest
+
+import tpurpc.rpc as tps
+from tpurpc.rpc import health
+from tpurpc.rpc.status import RpcError, StatusCode
+
+
+def _rig():
+    srv = tps.Server(max_workers=4)
+    servicer = health.add_health_servicer(srv)
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    return srv, servicer, port
+
+
+def test_check_overall_and_named_service():
+    srv, servicer, port = _rig()
+    try:
+        servicer.set("demo.Svc", health.ServingStatus.SERVING)
+        with tps.Channel(f"127.0.0.1:{port}") as ch:
+            check = ch.unary_unary(f"/{health.SERVICE_NAME}/Check")
+            assert health.decode_response(
+                check(health.encode_request(""), timeout=10)) \
+                is health.ServingStatus.SERVING
+            assert health.decode_response(
+                check(health.encode_request("demo.Svc"), timeout=10)) \
+                is health.ServingStatus.SERVING
+            servicer.set("demo.Svc", health.ServingStatus.NOT_SERVING)
+            assert health.decode_response(
+                check(health.encode_request("demo.Svc"), timeout=10)) \
+                is health.ServingStatus.NOT_SERVING
+            with pytest.raises(RpcError) as ei:
+                check(health.encode_request("no.such.Svc"), timeout=10)
+            assert ei.value.code() is StatusCode.NOT_FOUND
+    finally:
+        srv.stop(grace=0)
+
+
+def test_watch_streams_status_transitions():
+    srv, servicer, port = _rig()
+    try:
+        servicer.set("w.Svc", health.ServingStatus.SERVING)
+        seen = []
+        done = threading.Event()
+
+        def watch():
+            with tps.Channel(f"127.0.0.1:{port}") as ch:
+                stream = ch.unary_stream(f"/{health.SERVICE_NAME}/Watch")(
+                    health.encode_request("w.Svc"), timeout=30)
+                for msg in stream:
+                    seen.append(health.decode_response(msg))
+                    if len(seen) == 3:
+                        done.set()
+                        return
+
+        t = threading.Thread(target=watch, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 10
+        while len(seen) < 1 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        servicer.set("w.Svc", health.ServingStatus.NOT_SERVING)
+        while len(seen) < 2 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        servicer.set("w.Svc", health.ServingStatus.SERVING)
+        assert done.wait(timeout=10), seen
+        assert seen == [health.ServingStatus.SERVING,
+                        health.ServingStatus.NOT_SERVING,
+                        health.ServingStatus.SERVING]
+    finally:
+        srv.stop(grace=0)
+
+
+def test_watch_unknown_service_reports_service_unknown():
+    srv, _, port = _rig()
+    try:
+        with tps.Channel(f"127.0.0.1:{port}") as ch:
+            stream = iter(ch.unary_stream(f"/{health.SERVICE_NAME}/Watch")(
+                health.encode_request("never.registered"), timeout=10))
+            assert health.decode_response(next(stream)) \
+                is health.ServingStatus.SERVICE_UNKNOWN
+    finally:
+        srv.stop(grace=0)
+
+
+def test_stock_grpcio_health_check_wire_compat():
+    """A stock grpcio client speaking the health proto (raw encoding — the
+    installed grpcio ships no grpc_health package here) over the h2 path."""
+    srv, servicer, port = _rig()
+    try:
+        servicer.set("h2.Svc", health.ServingStatus.SERVING)
+        with grpc.insecure_channel(f"127.0.0.1:{port}") as ch:
+            mc = ch.unary_unary(f"/{health.SERVICE_NAME}/Check",
+                                lambda x: x, lambda x: x)
+            raw = mc(health.encode_request("h2.Svc"), timeout=10)
+            assert health.decode_response(raw) is health.ServingStatus.SERVING
+            with pytest.raises(grpc.RpcError) as ei:
+                mc(health.encode_request("missing"), timeout=10)
+            assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+    finally:
+        srv.stop(grace=0)
+
+
+def test_proto_roundtrip_and_unknown_fields():
+    assert health.decode_request(health.encode_request("a.b.C")) == "a.b.C"
+    assert health.decode_request(b"") == ""
+    for st in health.ServingStatus:
+        assert health.decode_response(health.encode_response(st)) is st
+    # unknown fields are skipped, not fatal (forward compat)
+    extra = health.encode_request("svc") + b"\x10\x05"  # field 2 varint
+    assert health.decode_request(extra) == "svc"
+
+
+def test_malformed_request_maps_to_invalid_argument():
+    srv, _, port = _rig()
+    try:
+        with tps.Channel(f"127.0.0.1:{port}") as ch:
+            check = ch.unary_unary(f"/{health.SERVICE_NAME}/Check")
+            with pytest.raises(RpcError) as ei:
+                check(b"\x0a\x80", timeout=10)  # truncated length varint
+            assert ei.value.code() is StatusCode.INVALID_ARGUMENT
+    finally:
+        srv.stop(grace=0)

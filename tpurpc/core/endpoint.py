@@ -286,8 +286,30 @@ class RingEndpoint(Endpoint):
         if self._registered:
             Poller.get().add_pollable(self.pair)
         self._closed = False
+        #: in-flight read/write tracking: close() must NOT return the pair to
+        #: the pool while a (possibly blocked) reader/writer thread is still
+        #: inside it — the pool would hand the same Pair to a NEW connection
+        #: whose reader then collides with the stale one (ContentAssertion
+        #: "concurrent entry", found by the chaos churn test).
+        self._ops_lock = threading.Lock()
+        self._ops = 0
+        self._ops_idle = threading.Event()
+        self._ops_idle.set()
         trace_endpoint.log("ring endpoint up: %s <-> %s (%s)", self._local_desc,
                            self._peer_desc, discipline)
+
+    def _op_enter(self) -> None:
+        with self._ops_lock:
+            if self._closed:
+                raise EndpointError("endpoint closed")
+            self._ops += 1
+            self._ops_idle.clear()
+
+    def _op_exit(self) -> None:
+        with self._ops_lock:
+            self._ops -= 1
+            if self._ops == 0:
+                self._ops_idle.set()
 
     def read(self, max_bytes: int = 1 << 20,
              timeout: Optional[float] = None) -> bytes:
@@ -297,8 +319,13 @@ class RingEndpoint(Endpoint):
         return bytes(buf)
 
     def read_into(self, dst, timeout: Optional[float] = None) -> int:
-        if self._closed:
-            raise EndpointError("read on closed endpoint")
+        self._op_enter()
+        try:
+            return self._read_into_locked(dst, timeout)
+        finally:
+            self._op_exit()
+
+    def _read_into_locked(self, dst, timeout: Optional[float]) -> int:
         dst = memoryview(dst).cast("B")
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
@@ -308,6 +335,8 @@ class RingEndpoint(Endpoint):
                 raise EndpointError(str(exc)) from exc
             if n:
                 return n
+            if self._closed:
+                raise EndpointError("endpoint closed")
             state = self.pair.get_status()
             if state is PairState.HALF_CLOSED:
                 # The peer's final write and its peer_exit flag race: re-drain once
@@ -326,32 +355,56 @@ class RingEndpoint(Endpoint):
             wait_readable(self.pair, timeout=remain, discipline=self.discipline)
 
     def write(self, data) -> None:
-        if self._closed:
-            raise EndpointError("write on closed endpoint")
-        slices = list(data) if isinstance(data, (list, tuple)) else [data]
-        total = sum(len(s) for s in slices)
-        sent = 0
-        while sent < total:
-            try:
-                sent += self.pair.send(slices, byte_idx=sent)
-            except BrokenPipeError as exc:
-                raise EndpointError(str(exc)) from exc
-            if sent < total:
-                # stalled for credits; wait for the peer to drain
-                wait_writable(self.pair, timeout=30, discipline=self.discipline)
-                if self.pair.get_status() not in (PairState.CONNECTED,):
-                    raise EndpointError(
-                        f"peer went away mid-write ({self.pair.state.value})")
+        self._op_enter()
+        try:
+            slices = list(data) if isinstance(data, (list, tuple)) else [data]
+            total = sum(len(s) for s in slices)
+            sent = 0
+            while sent < total:
+                try:
+                    sent += self.pair.send(slices, byte_idx=sent)
+                except BrokenPipeError as exc:
+                    raise EndpointError(str(exc)) from exc
+                if sent < total:
+                    if self._closed:
+                        raise EndpointError("endpoint closed")
+                    # stalled for credits; wait for the peer to drain
+                    wait_writable(self.pair, timeout=30,
+                                  discipline=self.discipline)
+                    if self.pair.get_status() not in (PairState.CONNECTED,):
+                        raise EndpointError(
+                            f"peer went away mid-write ({self.pair.state.value})")
+        finally:
+            self._op_exit()
 
     def close(self) -> None:
         """Teardown order per ``rdma_bp_posix.cc:112-132``: out of the poller,
-        disconnect, back to the pool."""
-        if self._closed:
-            return
-        self._closed = True
+        disconnect, back to the pool — with a DRAIN between disconnect and
+        putback: the state change + kick wakes any thread blocked inside the
+        pair, and only when every in-flight read/write has exited may the
+        pool re-issue it (else a recycled pair's new owner collides with the
+        stale thread — chaos-test finding)."""
+        with self._ops_lock:
+            if self._closed:
+                return
+            self._closed = True
         if self._registered:
             Poller.get().remove_pollable(self.pair)
         self.pair.disconnect()
+        self.pair.kick()  # wake blocked waiters; they observe DISCONNECTED
+        if not self._ops_idle.wait(timeout=10):
+            # A reader is wedged past every wake path: destroying leaks this
+            # pair object but NEVER hands a contended pair to a new owner.
+            trace_endpoint.log("ring endpoint close: in-flight op did not "
+                               "drain; destroying pair %s", self.pair.tag)
+            try:
+                self.pair.destroy()
+            except Exception:
+                # the wedged op may pin ring exports past destroy's retry
+                # budget; best-effort — the one certainty close() must keep
+                # is that this pair never reaches the pool
+                pass
+            return
         PairPool.get().putback(self.pool_key, self.pair)
 
     @property

@@ -649,10 +649,15 @@ class Pair:
                     ssl.SSLWantReadError, ssl.SSLWantWriteError):
                 break  # nothing decryptable yet ≡ EAGAIN on a plain socket
             except OSError:
-                self._mark_error("notify channel read failed")
+                # A STALE caller may hold a socket from a previous life of
+                # this pooled pair (teardown closed it; init() replaced it):
+                # its EBADF must not poison the pair's NEW connection.
+                if sock is self.notify_sock and sock.fileno() != -1:
+                    self._mark_error("notify channel read failed")
                 break
             if chunk == b"":
-                self._on_notify_closed()
+                if sock is self.notify_sock:  # stale-life guard (see peek)
+                    self._on_notify_closed()
                 break
             out += chunk
             if len(chunk) < 65536:
@@ -698,10 +703,17 @@ class Pair:
         except (BlockingIOError, InterruptedError):
             return False
         except OSError:
-            self._mark_error("notify channel read failed")
-            return True
+            # Poller scans race pool recycling: a captured socket from the
+            # pair's PREVIOUS life (closed at quiesce, replaced by init)
+            # raises EBADF here — benign staleness, not a liveness failure;
+            # marking would poison whatever connection holds the pair NOW.
+            if sock is self.notify_sock and sock.fileno() != -1:
+                self._mark_error("notify channel read failed")
+                return True
+            return False
         if chunk == b"":
-            self._on_notify_closed()
+            if sock is self.notify_sock:
+                self._on_notify_closed()
             return True
         return True
 
