@@ -169,3 +169,17 @@ def test_native_and_grpcio_share_one_port(compat):
         nmc = native.unary_unary("/test.Echo/Echo")
         assert nmc(b"native", timeout=20) == b"native"
         assert mc(b"h2", timeout=20) == b"h2"
+
+
+def test_grpcio_gzip_compressed_client(compat):
+    """A stock grpcio client with channel-level gzip compression: the tpurpc
+    server must decompress requests (and advertise its accept list)."""
+    srv, port, _ = compat
+    with grpc.insecure_channel(f"127.0.0.1:{port}",
+                               compression=grpc.Compression.Gzip) as ch:
+        mc = ch.unary_unary("/test.Echo/Echo", _ID, _ID)
+        payload = b"compress-me " * 400  # compressible, > trivial size
+        assert mc(payload, timeout=20) == payload
+        mcs = ch.stream_unary("/test.Echo/Collect", _ID, _ID)
+        assert mcs(iter([b"a" * 100, b"b" * 100]), timeout=20) == \
+            b"a" * 100 + b"|" + b"b" * 100

@@ -200,3 +200,20 @@ def test_h2channel_against_tpurpc_server():
             assert mc(b"self-interop", timeout=20) == b"self-interop"
     finally:
         srv.stop(grace=0)
+
+
+def test_h2channel_against_gzip_compressing_server():
+    """A grpcio server configured for gzip compresses RESPONSES; H2Channel
+    must advertise gzip and decompress them."""
+    gsrv = grpc.server(futures.ThreadPoolExecutor(max_workers=4),
+                       compression=grpc.Compression.Gzip)
+    gsrv.add_generic_rpc_handlers((_Handlers(),))
+    port = gsrv.add_insecure_port("127.0.0.1:0")
+    gsrv.start()
+    try:
+        with H2Channel(f"127.0.0.1:{port}") as ch:
+            mc = ch.unary_unary("/test.Echo/Echo")
+            payload = b"squeeze " * 500
+            assert mc(payload, timeout=20) == payload
+    finally:
+        gsrv.stop(grace=0)

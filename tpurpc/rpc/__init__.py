@@ -42,9 +42,19 @@ from tpurpc.rpc.interceptors import (ClientInterceptor, FaultConfig,
 __all__ += ["ClientInterceptor", "FaultConfig", "FaultInjector",
             "ServerInterceptor", "intercept_channel"]
 
-from tpurpc.wire.h2_client import H2Channel  # noqa: E402  (gRPC wire-compat client)
-
+# H2Channel is exported LAZILY: tpurpc.wire.h2_client imports
+# tpurpc.wire.grpc_h2, which imports tpurpc.rpc.status — an eager import here
+# makes any `import tpurpc.wire.grpc_h2`-first program hit this package's
+# __init__ mid-cycle and crash on the partially initialized module.
 __all__ += ["H2Channel"]
+
+
+def __getattr__(name):
+    if name == "H2Channel":
+        from tpurpc.wire.h2_client import H2Channel
+
+        return H2Channel
+    raise AttributeError(f"module 'tpurpc.rpc' has no attribute {name!r}")
 
 from tpurpc.rpc.channel import secure_channel  # noqa: E402
 from tpurpc.rpc.credentials import (ChannelCredentials,  # noqa: E402

@@ -16,11 +16,13 @@ directions. Reference: chttp2 + surface/call.cc (SURVEY.md §2.4/§3.3).
 from __future__ import annotations
 
 import base64
+import gzip
 import logging
 import queue
 import struct
 import threading
 import time
+import zlib
 from typing import Dict, List, Optional, Tuple
 
 from tpurpc.core.endpoint import Endpoint, EndpointError
@@ -31,6 +33,28 @@ from tpurpc.wire.hpack import HpackDecoder, HpackEncoder, HpackError
 _log = logging.getLogger("tpurpc.grpc_h2")
 
 _GRPC_MSG_HDR = struct.Struct("!BI")
+
+
+def decode_grpc_message(msg: bytes, compressed: int, encoding: str):
+    """Per-message decompression per the gRPC spec; shared by the h2 server
+    and client. Returns ``(message, None)`` or ``(None, (status, details))``:
+    compressed-flag with identity encoding is INTERNAL (spec/grpcio parity),
+    unknown codecs are UNIMPLEMENTED, corrupt bodies are INTERNAL
+    (gzip raises OSError/BadGzipFile on bad magic, EOFError on truncation,
+    zlib.error on a corrupt deflate body — all three are wire corruption)."""
+    if not compressed:
+        return msg, None
+    if encoding == "gzip":
+        try:
+            return gzip.decompress(msg), None
+        except (OSError, EOFError, zlib.error):
+            return None, (StatusCode.INTERNAL, "corrupt gzip message")
+    if encoding == "identity":
+        return None, (StatusCode.INTERNAL,
+                      "compressed-flag set with identity grpc-encoding")
+    return None, (StatusCode.UNIMPLEMENTED,
+                  f"message encoding {encoding!r} not supported "
+                  "(accept: identity, gzip)")
 
 #: our receive windows (we grant aggressively; tensors are big)
 RECV_WINDOW = 4 << 20
@@ -77,6 +101,7 @@ class _H2Stream:
         self.stream_id = stream_id
         self.requests: "queue.Queue[object]" = queue.Queue()
         self.partial = bytearray()   # gRPC message assembly across DATA frames
+        self.recv_encoding = "identity"  # request grpc-encoding
         self.half_closed = False
         self.cancelled = threading.Event()
         self.window: Optional[h2.FlowWindow] = None  # send window, set by conn
@@ -201,7 +226,8 @@ class GrpcH2Connection:
         if st.headers_sent:
             return
         st.headers_sent = True
-        hdrs = [(":status", "200"), ("content-type", "application/grpc")]
+        hdrs = [(":status", "200"), ("content-type", "application/grpc"),
+                ("grpc-accept-encoding", "identity,gzip")]
         for k, v in metadata:
             hdrs.append((k.lower(), _encode_metadata_value(k.lower(), v)))
         self._send_header_block(st.stream_id, self._encoder.encode(hdrs),
@@ -341,19 +367,23 @@ class GrpcH2Connection:
         pseudo = {}
         metadata: List[Tuple[str, object]] = []
         timeout_s: Optional[float] = None
+        encoding = "identity"
         for name_b, value_b in headers:
             name = name_b.decode("ascii", "replace")
             if name.startswith(":"):
                 pseudo[name] = value_b.decode("ascii", "replace")
             elif name == "grpc-timeout":
                 timeout_s = _parse_timeout(value_b.decode("ascii", "replace"))
-            elif name in ("te", "content-type", "user-agent", "grpc-encoding",
+            elif name == "grpc-encoding":
+                encoding = value_b.decode("ascii", "replace")
+            elif name in ("te", "content-type", "user-agent",
                           "grpc-accept-encoding", "accept-encoding"):
                 pass  # transport-level, not surfaced as metadata (grpcio parity)
             else:
                 metadata.append((name, _decode_metadata_value(name, value_b)))
         path = pseudo.get(":path", "")
         st = _H2Stream(sid)
+        st.recv_encoding = encoding
         st.window = h2.FlowWindow(self._peer_initial_window)
         with self._lock:
             self._streams[sid] = st
@@ -390,14 +420,14 @@ class GrpcH2Connection:
             compressed, length = _GRPC_MSG_HDR.unpack_from(st.partial)
             if len(st.partial) < _GRPC_MSG_HDR.size + length:
                 break
-            if compressed:
-                self.send_trailers(st, StatusCode.UNIMPLEMENTED,
-                                   "compressed messages not supported")
-                self._finish(st)
-                return
             msg = bytes(st.partial[_GRPC_MSG_HDR.size:
                                    _GRPC_MSG_HDR.size + length])
             del st.partial[:_GRPC_MSG_HDR.size + length]
+            msg, err = decode_grpc_message(msg, compressed, st.recv_encoding)
+            if err is not None:
+                self.send_trailers(st, err[0], err[1])
+                self._finish(st)
+                return
             st.requests.put(msg)
         if flags & h2.FLAG_END_STREAM:
             st.half_closed = True

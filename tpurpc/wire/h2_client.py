@@ -41,7 +41,7 @@ from tpurpc.core.endpoint import Endpoint, EndpointError, ReadTimeout, TcpEndpoi
 from tpurpc.rpc.status import Metadata, RpcError, StatusCode
 from tpurpc.wire import h2
 from tpurpc.wire.grpc_h2 import (RECV_WINDOW, _decode_metadata_value,
-                                 _encode_metadata_value)
+                                 _encode_metadata_value, decode_grpc_message)
 from tpurpc.wire.hpack import HpackDecoder, HpackEncoder, HpackError
 
 _log = logging.getLogger("tpurpc.h2_client")
@@ -83,6 +83,7 @@ class _H2Call:
         self.deadline = deadline
         self.events: "queue.Queue[tuple]" = queue.Queue()
         self.partial = bytearray()   # gRPC message assembly across DATA
+        self.recv_encoding = "identity"  # response grpc-encoding
         self.initial_md: Optional[List[Tuple[str, object]]] = None
         self.window: Optional[h2.FlowWindow] = None  # send window
         self.trailing_md: Optional[List[Tuple[str, object]]] = None
@@ -99,13 +100,13 @@ class _H2Call:
             compressed, length = _GRPC_MSG_HDR.unpack_from(self.partial)
             if len(self.partial) - 5 < length:
                 break
-            if compressed:
-                self.deliver_status(
-                    StatusCode.UNIMPLEMENTED,
-                    "compressed gRPC messages not supported", [])
-                return len(chunk)
             msg = bytes(self.partial[5:5 + length])
             del self.partial[:5 + length]
+            msg, err = decode_grpc_message(msg, compressed,
+                                           self.recv_encoding)
+            if err is not None:
+                self.deliver_status(err[0], err[1], [])
+                return len(chunk)
             self.events.put(("message", msg))
         return len(chunk)
 
@@ -313,7 +314,12 @@ class H2Channel:
                 grpc_message = v
             elif key == ":status":
                 http_status = v
-            elif key.startswith(":") or key in ("content-type",):
+            elif key == "grpc-encoding":
+                call.recv_encoding = (v.decode("ascii", "replace")
+                                      if isinstance(v, (bytes, bytearray))
+                                      else str(v))
+            elif (key.startswith(":")
+                  or key in ("content-type", "grpc-accept-encoding")):
                 continue
             else:
                 md.append((key, _decode_metadata_value(key, v)))
@@ -419,6 +425,7 @@ class H2Channel:
             (":authority", self._authority),
             ("te", "trailers"),
             ("content-type", "application/grpc"),
+            ("grpc-accept-encoding", "identity,gzip"),
             ("user-agent", "tpurpc-h2/0.1"),
         ]
         if timeout is not None:
