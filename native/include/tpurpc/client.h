@@ -1,0 +1,90 @@
+/* tpurpc C client API — the app-facing native surface (SURVEY.md §1 L7).
+ *
+ * The reference ships a full C++ application API (src/cpp/ + include/grpcpp/,
+ * 14,328 LoC) above its C core surface (src/core/lib/surface/). tpurpc's
+ * equivalent is deliberately small: a blocking C API over the tpurpc native
+ * framing (tpurpc/rpc/frame.py documents the wire format), speaking TCP to
+ * any tpurpc server — including ring-platform and TPU-platform listeners,
+ * whose accept loops protocol-sniff the preface (tpurpc/rpc/server.py).
+ * A header-only C++ RAII wrapper lives in tpurpc/client.hpp.
+ *
+ * Concurrency model: one background reader thread per channel demuxes frames
+ * to calls (the moral equivalent of grpc's completion-queue plumbing,
+ * completion_queue.cc:393, collapsed to blocking calls); any number of app
+ * threads may run calls on one channel concurrently.
+ *
+ * All functions return 0 / a valid pointer on success unless noted.
+ * Status codes match gRPC's numbering (tpurpc/rpc/status.py).
+ */
+#ifndef TPURPC_CLIENT_H
+#define TPURPC_CLIENT_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct tpr_channel tpr_channel;
+typedef struct tpr_call tpr_call;
+
+/* -- status codes (grpc numbering) -- */
+enum {
+  TPR_OK = 0,
+  TPR_CANCELLED = 1,
+  TPR_UNKNOWN = 2,
+  TPR_DEADLINE_EXCEEDED = 4,
+  TPR_UNIMPLEMENTED = 12,
+  TPR_INTERNAL = 13,
+  TPR_UNAVAILABLE = 14
+};
+
+/* Connect a channel. timeout_ms bounds the TCP connect. NULL on failure. */
+tpr_channel *tpr_channel_create(const char *host, int port, int timeout_ms);
+void tpr_channel_destroy(tpr_channel *ch);
+
+/* Round-trip a PING frame; returns microseconds, or -1 on failure. */
+int64_t tpr_channel_ping(tpr_channel *ch, int timeout_ms);
+
+/* Start a call. metadata: flat array of 2*n_md C strings (k,v,k,v,...);
+ * timeout_ms <= 0 means no deadline. NULL when the channel is dead. */
+tpr_call *tpr_call_start(tpr_channel *ch, const char *method,
+                         const char *const *metadata, size_t n_md,
+                         int timeout_ms);
+
+/* Send one request message. end_stream half-closes after this message. */
+int tpr_call_send(tpr_call *c, const uint8_t *data, size_t len,
+                  int end_stream);
+
+/* Half-close without a message (client finished sending). */
+int tpr_call_writes_done(tpr_call *c);
+
+/* Receive the next response message. Returns 1 with *data/*len set (caller
+ * frees with tpr_buf_free), 0 at end of the response stream (trailers seen),
+ * -1 on transport error / deadline. */
+int tpr_call_recv(tpr_call *c, uint8_t **data, size_t *len);
+
+/* Block until trailers; returns the status code. details (optional) receives
+ * the status message, NUL-terminated, truncated to cap. */
+int tpr_call_finish(tpr_call *c, char *details, size_t cap);
+
+/* Cancel: RST the stream. Safe at any point before finish. */
+void tpr_call_cancel(tpr_call *c);
+
+/* Destroy a finished/cancelled call object. */
+void tpr_call_destroy(tpr_call *c);
+
+void tpr_buf_free(uint8_t *data);
+
+/* Convenience: full unary round trip. Returns the status code; on TPR_OK,
+ * *resp/*resp_len carry the response (caller frees). */
+int tpr_unary_call(tpr_channel *ch, const char *method, const uint8_t *req,
+                   size_t req_len, uint8_t **resp, size_t *resp_len,
+                   char *details, size_t details_cap, int timeout_ms);
+
+#ifdef __cplusplus
+} /* extern "C" */
+#endif
+
+#endif /* TPURPC_CLIENT_H */

@@ -1,0 +1,496 @@
+// tpurpc C client implementation — native app API over the tpurpc framing.
+//
+// Wire format (authoritative doc: tpurpc/rpc/frame.py): 8-byte preface
+// "TPURPC\x01\x00", then little-endian frames
+//   [u8 type][u8 flags][u32 stream_id][u32 length][payload]
+// with the gRPC-subset frame vocabulary (HEADERS/MESSAGE/TRAILERS/RST/
+// PING/PONG/GOAWAY) the reference's chttp2 layer provides (frame_*.cc).
+// One reader thread per channel demuxes to per-call mailboxes — the
+// collapsed analog of grpc's pollset/completion-queue machinery
+// (completion_queue.cc:393) for a blocking app API.
+
+#include "../include/tpurpc/client.h"
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdio>
+#include <cstdlib>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint8_t kHeaders = 1, kMessage = 2, kTrailers = 3, kRst = 4,
+                  kPing = 5, kPong = 6, kGoaway = 7;
+constexpr uint8_t kFlagEndStream = 0x01, kFlagMore = 0x02,
+                  kFlagNoMessage = 0x04;
+constexpr size_t kMaxFramePayload = 1u << 20;
+const char kMagic[] = "TPURPC\x01\x00";  // 8 bytes incl. trailing NUL
+
+using Clock = std::chrono::steady_clock;
+
+void put_u16(std::string &out, uint16_t v) {
+  out.push_back(static_cast<char>(v & 0xff));
+  out.push_back(static_cast<char>(v >> 8));
+}
+void put_u32(std::string &out, uint32_t v) {
+  for (int i = 0; i < 4; i++) out.push_back(static_cast<char>((v >> (8 * i)) & 0xff));
+}
+uint16_t get_u16(const uint8_t *p) { return static_cast<uint16_t>(p[0] | (p[1] << 8)); }
+uint32_t get_u32(const uint8_t *p) {
+  return p[0] | (p[1] << 8) | (p[2] << 16) | (uint32_t(p[3]) << 24);
+}
+
+std::string encode_metadata(
+    const std::vector<std::pair<std::string, std::string>> &md) {
+  std::string out;
+  put_u16(out, static_cast<uint16_t>(md.size()));
+  for (const auto &kv : md) {
+    put_u16(out, static_cast<uint16_t>(kv.first.size()));
+    out += kv.first;
+    put_u32(out, static_cast<uint32_t>(kv.second.size()));
+    out += kv.second;
+  }
+  return out;
+}
+
+bool decode_metadata(const uint8_t *buf, size_t len,
+                     std::vector<std::pair<std::string, std::string>> *out) {
+  if (len < 2) return false;
+  size_t off = 2;
+  uint16_t count = get_u16(buf);
+  for (uint16_t i = 0; i < count; i++) {
+    if (off + 2 > len) return false;
+    uint16_t klen = get_u16(buf + off);
+    off += 2;
+    if (off + klen + 4 > len) return false;
+    std::string key(reinterpret_cast<const char *>(buf + off), klen);
+    off += klen;
+    uint32_t vlen = get_u32(buf + off);
+    off += 4;
+    if (off + vlen > len) return false;
+    out->emplace_back(std::move(key),
+                      std::string(reinterpret_cast<const char *>(buf + off), vlen));
+    off += vlen;
+  }
+  return true;
+}
+
+struct Call {
+  uint32_t stream_id = 0;
+  tpr_channel *ch = nullptr;
+  std::deque<std::string> messages;   // complete reassembled messages
+  std::string partial;                // FLAG_MORE fragment accumulator
+  bool trailers_seen = false;
+  int status_code = TPR_UNKNOWN;
+  std::string status_details;
+  Clock::time_point deadline{};
+  bool has_deadline = false;
+  bool cancelled = false;
+};
+
+}  // namespace
+
+struct tpr_call {
+  Call c;
+};
+
+struct tpr_channel {
+  int fd = -1;
+  std::mutex write_mu;                 // serializes whole frames (FrameWriter analog)
+  std::mutex mu;                       // guards streams / pong / alive
+  std::condition_variable cv;          // signaled on any delivery
+  std::map<uint32_t, tpr_call *> streams;
+  uint32_t next_stream_id = 1;         // odd, client-initiated (h2 convention)
+  std::atomic<bool> alive{true};
+  uint64_t pong_count = 0;
+  std::thread reader;
+
+  ~tpr_channel() {
+    alive.store(false);
+    if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
+    if (reader.joinable()) reader.join();
+    if (fd >= 0) ::close(fd);
+  }
+
+  bool write_all(const void *buf, size_t len) {
+    const char *p = static_cast<const char *>(buf);
+    while (len > 0) {
+      ssize_t n = ::send(fd, p, len, MSG_NOSIGNAL);
+      if (n <= 0) {
+        if (n < 0 && (errno == EINTR)) continue;
+        return false;
+      }
+      p += n;
+      len -= static_cast<size_t>(n);
+    }
+    return true;
+  }
+
+  bool send_frame(uint8_t type, uint8_t flags, uint32_t sid,
+                  const void *payload, size_t len) {
+    std::string hdr;
+    hdr.push_back(static_cast<char>(type));
+    hdr.push_back(static_cast<char>(flags));
+    put_u32(hdr, sid);
+    put_u32(hdr, static_cast<uint32_t>(len));
+    std::lock_guard<std::mutex> lk(write_mu);
+    if (!alive.load()) return false;
+    return write_all(hdr.data(), hdr.size()) && (len == 0 || write_all(payload, len));
+  }
+
+  bool read_exact(void *buf, size_t len) {
+    char *p = static_cast<char *>(buf);
+    while (len > 0) {
+      ssize_t n = ::recv(fd, p, len, 0);
+      if (n <= 0) {
+        if (n < 0 && errno == EINTR) continue;
+        return false;
+      }
+      p += n;
+      len -= static_cast<size_t>(n);
+    }
+    return true;
+  }
+
+  void die() {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      if (!alive.exchange(false)) return;
+      for (auto &kv : streams) {
+        Call &c = kv.second->c;
+        if (!c.trailers_seen) {
+          c.trailers_seen = true;
+          c.status_code = TPR_UNAVAILABLE;
+          c.status_details = "connection lost";
+        }
+      }
+    }
+    cv.notify_all();
+  }
+
+  void read_loop() {
+    std::vector<uint8_t> payload;
+    while (alive.load()) {
+      uint8_t hdr[10];
+      if (!read_exact(hdr, sizeof hdr)) break;
+      uint8_t type = hdr[0], flags = hdr[1];
+      uint32_t sid = get_u32(hdr + 2), len = get_u32(hdr + 6);
+      if (len > kMaxFramePayload + 65536) break;  // insane frame: poisoned pipe
+      payload.resize(len);
+      if (len > 0 && !read_exact(payload.data(), len)) break;
+
+      if (type == kPing) {
+        send_frame(kPong, 0, 0, payload.data(), payload.size());
+        continue;
+      }
+      if (type == kPong) {
+        {
+          std::lock_guard<std::mutex> lk(mu);
+          pong_count++;
+        }
+        cv.notify_all();
+        continue;
+      }
+      if (type == kGoaway) break;
+
+      std::unique_lock<std::mutex> lk(mu);
+      auto it = streams.find(sid);
+      if (it == streams.end()) continue;  // late frame for a finished call
+      Call &c = it->second->c;
+      if (type == kMessage) {
+        if (!(flags & kFlagNoMessage))
+          c.partial.append(reinterpret_cast<char *>(payload.data()), len);
+        if (!(flags & kFlagMore) && !(flags & kFlagNoMessage)) {
+          c.messages.push_back(std::move(c.partial));
+          c.partial.clear();
+        }
+        if (flags & kFlagEndStream) {
+          // server half-closed without trailers: tolerate, finish as OK
+          c.trailers_seen = true;
+          c.status_code = TPR_OK;
+          streams.erase(it);
+        }
+      } else if (type == kHeaders) {
+        // initial metadata: stored nowhere yet (API exposes trailers only)
+      } else if (type == kTrailers || type == kRst) {
+        std::vector<std::pair<std::string, std::string>> md;
+        decode_metadata(payload.data(), len, &md);
+        c.status_code = TPR_UNKNOWN;
+        for (auto &kv : md) {
+          if (kv.first == ":status") c.status_code = atoi(kv.second.c_str());
+          else if (kv.first == ":message") c.status_details = kv.second;
+        }
+        c.trailers_seen = true;
+        streams.erase(it);
+      }
+      lk.unlock();
+      cv.notify_all();
+    }
+    die();
+  }
+};
+
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+tpr_channel *tpr_channel_create(const char *host, int port, int timeout_ms) {
+  struct addrinfo hints {};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  char portbuf[16];
+  snprintf(portbuf, sizeof portbuf, "%d", port);
+  struct addrinfo *res = nullptr;
+  if (getaddrinfo(host, portbuf, &hints, &res) != 0 || res == nullptr)
+    return nullptr;
+  int fd = -1;
+  for (struct addrinfo *ai = res; ai; ai = ai->ai_next) {
+    fd = ::socket(ai->ai_family, ai->ai_socktype | SOCK_NONBLOCK,
+                  ai->ai_protocol);
+    if (fd < 0) continue;
+    int rc = ::connect(fd, ai->ai_addr, ai->ai_addrlen);
+    if (rc != 0 && errno == EINPROGRESS) {
+      struct pollfd pfd {fd, POLLOUT, 0};
+      if (::poll(&pfd, 1, timeout_ms > 0 ? timeout_ms : -1) == 1) {
+        int err = 0;
+        socklen_t len = sizeof err;
+        getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &len);
+        rc = err == 0 ? 0 : -1;
+      } else {
+        rc = -1;  // timeout
+      }
+    }
+    if (rc == 0) {
+      int fl = fcntl(fd, F_GETFL);
+      fcntl(fd, F_SETFL, fl & ~O_NONBLOCK);  // back to blocking for IO
+      break;
+    }
+    ::close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+
+  auto *ch = new tpr_channel();
+  ch->fd = fd;
+  if (!ch->write_all(kMagic, 8)) {
+    delete ch;
+    return nullptr;
+  }
+  ch->reader = std::thread([ch] { ch->read_loop(); });
+  return ch;
+}
+
+void tpr_channel_destroy(tpr_channel *ch) { delete ch; }
+
+int64_t tpr_channel_ping(tpr_channel *ch, int timeout_ms) {
+  uint64_t before;
+  {
+    std::lock_guard<std::mutex> lk(ch->mu);
+    before = ch->pong_count;
+  }
+  auto t0 = Clock::now();
+  if (!ch->send_frame(kPing, 0, 0, "p", 1)) return -1;
+  std::unique_lock<std::mutex> lk(ch->mu);
+  bool ok = ch->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), [&] {
+    return ch->pong_count > before || !ch->alive.load();
+  });
+  if (!ok || ch->pong_count <= before) return -1;
+  return std::chrono::duration_cast<std::chrono::microseconds>(Clock::now() - t0)
+      .count();
+}
+
+tpr_call *tpr_call_start(tpr_channel *ch, const char *method,
+                         const char *const *metadata, size_t n_md,
+                         int timeout_ms) {
+  if (!ch->alive.load()) return nullptr;
+  auto *call = new tpr_call();
+  {
+    std::lock_guard<std::mutex> lk(ch->mu);
+    call->c.stream_id = ch->next_stream_id;
+    ch->next_stream_id += 2;
+    call->c.ch = ch;
+    if (timeout_ms > 0) {
+      call->c.has_deadline = true;
+      call->c.deadline = Clock::now() + std::chrono::milliseconds(timeout_ms);
+    }
+    ch->streams[call->c.stream_id] = call;
+  }
+  std::vector<std::pair<std::string, std::string>> md;
+  md.emplace_back(":path", method);
+  if (timeout_ms > 0)
+    md.emplace_back(":timeout-us", std::to_string(int64_t(timeout_ms) * 1000));
+  for (size_t i = 0; i + 1 < 2 * n_md; i += 2)
+    md.emplace_back(metadata[i], metadata[i + 1]);
+  std::string payload = encode_metadata(md);
+  if (!ch->send_frame(kHeaders, 0, call->c.stream_id, payload.data(),
+                      payload.size())) {
+    std::lock_guard<std::mutex> lk(ch->mu);
+    ch->streams.erase(call->c.stream_id);
+    delete call;
+    return nullptr;
+  }
+  return call;
+}
+
+int tpr_call_send(tpr_call *c, const uint8_t *data, size_t len, int end_stream) {
+  tpr_channel *ch = c->c.ch;
+  // fragment at the frame bound with MORE on all but the last piece
+  size_t off = 0;
+  do {
+    size_t n = len - off;
+    bool last = n <= kMaxFramePayload;
+    if (!last) n = kMaxFramePayload;
+    uint8_t flags = 0;
+    if (!last) flags |= kFlagMore;
+    if (last && end_stream) flags |= kFlagEndStream;
+    if (!ch->send_frame(kMessage, flags, c->c.stream_id, data + off, n))
+      return -1;
+    off += n;
+  } while (off < len);
+  return 0;
+}
+
+int tpr_call_writes_done(tpr_call *c) {
+  return c->c.ch->send_frame(kMessage, kFlagEndStream | kFlagNoMessage,
+                             c->c.stream_id, nullptr, 0)
+             ? 0
+             : -1;
+}
+
+static bool wait_event(tpr_call *c, std::unique_lock<std::mutex> &lk) {
+  tpr_channel *ch = c->c.ch;
+  auto ready = [&] {
+    return !c->c.messages.empty() || c->c.trailers_seen || !ch->alive.load();
+  };
+  if (c->c.has_deadline)
+    return ch->cv.wait_until(lk, c->c.deadline, ready);
+  ch->cv.wait(lk, ready);
+  return true;
+}
+
+int tpr_call_recv(tpr_call *c, uint8_t **data, size_t *len) {
+  tpr_channel *ch = c->c.ch;
+  std::unique_lock<std::mutex> lk(ch->mu);
+  while (true) {
+    if (!c->c.messages.empty()) {
+      std::string &m = c->c.messages.front();
+      *len = m.size();
+      *data = static_cast<uint8_t *>(malloc(m.size() ? m.size() : 1));
+      memcpy(*data, m.data(), m.size());
+      c->c.messages.pop_front();
+      return 1;
+    }
+    if (c->c.trailers_seen) return 0;
+    if (!ch->alive.load()) return -1;
+    if (!wait_event(c, lk)) return -1;  // deadline
+  }
+}
+
+int tpr_call_finish(tpr_call *c, char *details, size_t cap) {
+  tpr_channel *ch = c->c.ch;
+  std::unique_lock<std::mutex> lk(ch->mu);
+  while (!c->c.trailers_seen) {
+    if (!ch->alive.load()) {
+      c->c.trailers_seen = true;
+      c->c.status_code = TPR_UNAVAILABLE;
+      c->c.status_details = "connection lost";
+      break;
+    }
+    if (!wait_event(c, lk)) {  // client-side deadline
+      // RST first (while trailers_seen is still false — cancel's guard
+      // refuses finished calls), then record the local status.
+      lk.unlock();
+      tpr_call_cancel(c);
+      lk.lock();
+      if (!c->c.trailers_seen) {  // reader may have raced trailers in
+        c->c.trailers_seen = true;
+        c->c.status_code = TPR_DEADLINE_EXCEEDED;
+        c->c.status_details = "deadline exceeded (client)";
+      }
+      break;
+    }
+  }
+  if (details && cap > 0) {
+    size_t n = c->c.status_details.size();
+    if (n >= cap) n = cap - 1;
+    memcpy(details, c->c.status_details.data(), n);
+    details[n] = '\0';
+  }
+  return c->c.status_code;
+}
+
+void tpr_call_cancel(tpr_call *c) {
+  tpr_channel *ch = c->c.ch;
+  {
+    std::lock_guard<std::mutex> lk(ch->mu);
+    if (c->c.cancelled || c->c.trailers_seen) return;
+    c->c.cancelled = true;
+  }
+  std::vector<std::pair<std::string, std::string>> md;
+  md.emplace_back(":status", std::to_string(TPR_CANCELLED));
+  md.emplace_back(":message", "cancelled by client");
+  std::string payload = encode_metadata(md);
+  ch->send_frame(kRst, 0, c->c.stream_id, payload.data(), payload.size());
+}
+
+void tpr_call_destroy(tpr_call *c) {
+  tpr_channel *ch = c->c.ch;
+  {
+    std::lock_guard<std::mutex> lk(ch->mu);
+    ch->streams.erase(c->c.stream_id);
+  }
+  delete c;
+}
+
+void tpr_buf_free(uint8_t *data) { free(data); }
+
+int tpr_unary_call(tpr_channel *ch, const char *method, const uint8_t *req,
+                   size_t req_len, uint8_t **resp, size_t *resp_len,
+                   char *details, size_t details_cap, int timeout_ms) {
+  tpr_call *c = tpr_call_start(ch, method, nullptr, 0, timeout_ms);
+  if (!c) {
+    if (details && details_cap) snprintf(details, details_cap, "channel dead");
+    return TPR_UNAVAILABLE;
+  }
+  if (tpr_call_send(c, req, req_len, /*end_stream=*/1) != 0) {
+    tpr_call_destroy(c);
+    if (details && details_cap) snprintf(details, details_cap, "send failed");
+    return TPR_UNAVAILABLE;
+  }
+  uint8_t *data = nullptr;
+  size_t len = 0;
+  int got = tpr_call_recv(c, &data, &len);
+  int code = tpr_call_finish(c, details, details_cap);
+  if (code == TPR_OK && got == 1) {
+    *resp = data;
+    *resp_len = len;
+  } else if (got == 1) {
+    tpr_buf_free(data);
+  } else if (code == TPR_OK) {
+    code = TPR_INTERNAL;  // OK status but no response message
+    if (details && details_cap) snprintf(details, details_cap, "no response");
+  }
+  tpr_call_destroy(c);
+  return code;
+}
+
+}  // extern "C"

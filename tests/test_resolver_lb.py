@@ -92,3 +92,68 @@ def test_round_robin_skips_dead_member():
                 assert mc(b"", timeout=10) == b"alive"
     finally:
         s1.stop(grace=0)
+
+
+# -- ring_hash ---------------------------------------------------------------
+
+def test_ring_hash_deterministic_and_distributed():
+    from tpurpc.rpc.resolver import RingHash, ring_hash_key
+
+    pol = RingHash(4)
+    with ring_hash_key("alpha"):
+        first = list(pol.order())
+        assert list(pol.order()) == first      # same key -> same order
+    # distinct keys spread over backends
+    firsts = set()
+    for i in range(64):
+        with ring_hash_key(f"key-{i}"):
+            firsts.add(pol.order()[0])
+    assert len(firsts) == 4
+    # preference list is a permutation (failover covers every backend)
+    with ring_hash_key("alpha"):
+        assert sorted(pol.order()) == [0, 1, 2, 3]
+
+
+def test_ring_hash_minimal_reshuffle():
+    """Consistent hashing property: keys whose primary is NOT the removed
+    backend keep their primary when it disappears (here: the ring order's
+    second choice never changes for other-primary keys)."""
+    from tpurpc.rpc.resolver import RingHash, ring_hash_key
+
+    pol = RingHash(4)
+    keys = [f"k{i}" for i in range(128)]
+    primary = {}
+    for k in keys:
+        with ring_hash_key(k):
+            primary[k] = pol.order()[0]
+    victim = primary[keys[0]]
+    for k in keys:
+        with ring_hash_key(k):
+            order = list(pol.order())
+        if primary[k] != victim:
+            # removing `victim` (skipping it) must not move this key
+            assert [i for i in order if i != victim][0] == primary[k]
+
+
+def test_ring_hash_without_key_rotates():
+    from tpurpc.rpc.resolver import RingHash
+
+    pol = RingHash(3)
+    assert {pol.order()[0] for _ in range(6)} == {0, 1, 2}
+
+
+def test_ring_hash_channel_stickiness():
+    s1, p1, m1 = _echo_server()
+    s2, p2, m2 = _echo_server()
+    m1["name"] = "s1"
+    m2["name"] = "s2"
+    try:
+        with rpc.Channel(f"ipv4:127.0.0.1:{p1},127.0.0.1:{p2}",
+                         lb_policy="ring_hash") as ch:
+            mc = ch.unary_unary("/t.S/Who")
+            with rpc.ring_hash_key("session-9"):
+                got = {bytes(mc(b"", timeout=10)) for _ in range(4)}
+            assert len(got) == 1               # sticky under a fixed key
+    finally:
+        s1.stop(grace=0)
+        s2.stop(grace=0)

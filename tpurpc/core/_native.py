@@ -28,6 +28,45 @@ def _lib_path() -> str:
     return os.path.join(root, "native", "build", "libtpurpc.so")
 
 
+def _try_build(path: str) -> None:
+    """Best-effort first-use build of the native core (fresh checkouts ship
+    sources only). One direct g++ invocation — no cmake dependency — guarded
+    by an exclusive lockfile so concurrent processes don't race the link;
+    losers wait for the winner. Failure is fine: callers fall back to the
+    pure-Python data plane. ``TPURPC_NATIVE_BUILD=0`` disables."""
+    import shutil
+    import subprocess
+
+    if os.environ.get("TPURPC_NATIVE_BUILD", "1") == "0":
+        return
+    gxx = shutil.which("g++")
+    if gxx is None:
+        return
+    import glob
+
+    build_dir = os.path.dirname(path)
+    srcs = sorted(glob.glob(
+        os.path.join(os.path.dirname(build_dir), "src", "*.cc")))
+    if not srcs:
+        return
+    os.makedirs(build_dir, exist_ok=True)
+    lock_path = os.path.join(build_dir, ".build.lock")
+    try:
+        import fcntl
+
+        with open(lock_path, "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)  # winner builds, losers wait here
+            if not os.path.exists(path):
+                tmp = path + ".tmp"
+                subprocess.run(
+                    [gxx, "-std=c++17", "-O3", "-DNDEBUG", "-shared", "-fPIC",
+                     *srcs, "-o", tmp, "-lpthread"],
+                    check=True, timeout=120, capture_output=True)
+                os.replace(tmp, path)  # atomic: no partially-linked .so visible
+    except Exception:
+        pass
+
+
 def load() -> "Optional[ctypes.CDLL]":
     """The native library, or None (absent, disabled, or ABI-mismatched)."""
     global _LIB, _TRIED
@@ -37,6 +76,8 @@ def load() -> "Optional[ctypes.CDLL]":
     if os.environ.get("TPURPC_NATIVE", "1") == "0":
         return None
     path = _lib_path()
+    if not os.path.exists(path):
+        _try_build(path)
     if not os.path.exists(path):
         return None
     try:

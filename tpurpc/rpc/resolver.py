@@ -9,15 +9,19 @@ The reference inherits these from gRPC's client_channel filter
 * ``register_resolver("scheme", fn)``       → the fake-resolver test seam
 
 Policies: ``pick_first`` (dial addresses in order, stick with the winner —
-gRPC's default) and ``round_robin`` (rotate READY subchannels per call).
+gRPC's default), ``round_robin`` (rotate READY subchannels per call), and
+``ring_hash`` (consistent hashing — the reference inherits
+``lb_policy/ring_hash/ring_hash.cc``; same calls land on the same backend,
+and a dead backend's keys spill to its ring successor only).
 """
 
 from __future__ import annotations
 
+import hashlib
 import itertools
 import socket
 import threading
-from typing import Callable, List, Sequence, Tuple
+from typing import Callable, List, Optional, Sequence, Tuple
 
 Address = Tuple[str, int]
 ResolveFn = Callable[[str], List[Address]]
@@ -114,7 +118,91 @@ class RoundRobin:
         pass
 
 
-POLICIES = {"pick_first": PickFirst, "round_robin": RoundRobin}
+_call_key = threading.local()
+
+
+class ring_hash_key:
+    """Route calls made inside this context by a consistent-hash key:
+
+    >>> with ring_hash_key("user-42"):
+    ...     stub.Get(req)        # always lands on the same backend
+
+    The reference's ring_hash policy hashes a per-RPC attribute (the xds
+    hash policy); tpurpc's channel API has no per-call LB metadata plumbing,
+    so the key rides a thread-local that :class:`RingHash` reads at pick
+    time. Without an active key, picks rotate (round-robin degenerate)."""
+
+    def __init__(self, key: str):
+        self._key = key
+
+    def __enter__(self):
+        self._prev = getattr(_call_key, "key", None)
+        _call_key.key = self._key
+        return self
+
+    def __exit__(self, *exc):
+        _call_key.key = self._prev
+        return False
+
+
+class RingHash:
+    """Consistent hashing over subchannel indices.
+
+    Each backend index is placed on a 2^32 ring at ``replicas`` points
+    (md5 of ``"{idx}:{r}"``); a call's key hashes to a ring point and the
+    preference order is the distinct backends encountered walking clockwise
+    — so losing a backend moves only its arc to its successor, the property
+    the reference's policy exists for."""
+
+    name = "ring_hash"
+    replicas = 64
+
+    def __init__(self, n: int):
+        self._n = n
+        self._counter = itertools.count()
+        self._lock = threading.Lock()
+        points: List[Tuple[int, int]] = []
+        for idx in range(n):
+            for r in range(self.replicas):
+                h = hashlib.md5(f"{idx}:{r}".encode()).digest()
+                points.append((int.from_bytes(h[:4], "big"), idx))
+        points.sort()
+        self._points = points
+
+    def _walk(self, start_hash: int) -> Sequence[int]:
+        """Distinct backend indices in clockwise ring order from a point."""
+        import bisect
+
+        i = bisect.bisect_left(self._points, (start_hash, -1))
+        order: List[int] = []
+        seen = set()
+        for k in range(len(self._points)):
+            _, idx = self._points[(i + k) % len(self._points)]
+            if idx not in seen:
+                seen.add(idx)
+                order.append(idx)
+                if len(order) == self._n:
+                    break
+        return order
+
+    def order(self) -> Sequence[int]:
+        key: Optional[str] = getattr(_call_key, "key", None)
+        if key is None:
+            with self._lock:
+                start = next(self._counter) % self._n
+            return [(start + i) % self._n for i in range(self._n)]
+        h = hashlib.md5(key.encode()).digest()
+        return self._walk(int.from_bytes(h[:4], "big"))
+
+    def connected(self, idx: int) -> None:
+        pass
+
+    def failed(self, idx: int) -> None:
+        pass
+
+
+POLICIES = {"pick_first": PickFirst, "round_robin": RoundRobin,
+            "ring_hash": RingHash}
 
 
 def make_policy(name: str, n: int):
