@@ -83,7 +83,7 @@ def consume(req_iter):
 
     for tree in req_iter:
         inflight.append(to_jax(tree["x"]))   # async dispatch -> device
-        if len(inflight) > 2:
+        if len(inflight) > 3:
             retire(inflight.popleft())
     while inflight:
         retire(inflight.popleft())
@@ -307,7 +307,7 @@ def _run_once(env, n_msgs: int, ready_s: float):
 def main() -> None:
     os.environ.setdefault("GRPC_PLATFORM_TYPE",
                           os.environ.get("TPURPC_BENCH_PLATFORM", "RDMA_BPEV"))
-    os.environ.setdefault("GRPC_RDMA_RING_BUFFER_SIZE_KB", "16384")
+    os.environ.setdefault("GRPC_RDMA_RING_BUFFER_SIZE_KB", "32768")
 
     n_msgs = int(os.environ.get("TPURPC_BENCH_MSGS", "64"))
     # Budget for jax backend bring-up on the default platform. Sized so a dead

@@ -1,0 +1,49 @@
+// C++ application example: a native tpurpc server (no Python anywhere).
+//
+// Mirrors the reference's C++ helloworld server (examples/cpp/helloworld
+// greeter_server) over tpurpc's native server API
+// (native/include/tpurpc/server.hpp). Python tpurpc channels — and the C++
+// client — call it over the native framing.
+//
+// Build: g++ -std=c++17 -O2 examples/cpp_server.cc \
+//            native/src/tpurpc_server.cc -Inative/include -lpthread \
+//            -o /tmp/tpurpc_cpp_server
+// Run: /tmp/tpurpc_cpp_server   (prints "PORT <n>", serves until stdin EOF)
+
+#include <cstdio>
+#include <string>
+
+#include "tpurpc/server.hpp"
+
+int main() {
+  tpurpc::Server srv(0);
+
+  srv.AddMethod("/demo.Greeter/SayHello", [](tpurpc::ServerCall &call) {
+    std::string req;
+    if (!call.Read(&req)) return 13;  // INTERNAL: no request
+    call.Write("Hello, " + req + "!");
+    return 0;
+  });
+
+  srv.AddMethod("/demo.Greeter/Echo", [](tpurpc::ServerCall &call) {
+    std::string req;
+    while (call.Read(&req)) call.Write(req);
+    return call.cancelled() ? 1 : 0;
+  });
+
+  srv.AddMethod("/demo.Greeter/Chat", [](tpurpc::ServerCall &call) {
+    std::string msg;
+    while (call.Read(&msg)) call.Write("echo:" + msg);
+    return call.cancelled() ? 1 : 0;
+  });
+
+  srv.Start();
+  printf("PORT %d\n", srv.port());
+  fflush(stdout);
+
+  // serve until stdin closes (the test harness's lifetime signal)
+  char buf[64];
+  while (fgets(buf, sizeof buf, stdin) != nullptr) {
+  }
+  return 0;
+}

@@ -1,0 +1,73 @@
+/* tpurpc C server API — native app servers over the tpurpc framing.
+ *
+ * Counterpart of client.h; together they are the app-facing native surface
+ * the reference provides as src/cpp/server (ServerBuilder / sync service,
+ * SURVEY.md §1 L7). Scope: blocking handlers on a thread-per-connection
+ * accept loop, all four call shapes expressed through one call object
+ * (read-until-end / write-many / finish-with-status).
+ *
+ * Each connection has a reader thread that demuxes frames to per-stream
+ * call objects; every call's handler runs on its OWN thread, so concurrent
+ * calls — whether multiplexed on one connection (as tpurpc Python channels
+ * do) or on separate connections — execute concurrently. Handlers sharing
+ * state must synchronize accordingly.
+ */
+#ifndef TPURPC_SERVER_H
+#define TPURPC_SERVER_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct tpr_server tpr_server;
+typedef struct tpr_server_call tpr_server_call;
+
+/* Handler: drive the call via tpr_srv_recv/tpr_srv_send, then return the
+ * status code to send in trailers (0 = OK). `ud` is the registration's
+ * user data pointer. */
+typedef int (*tpr_handler_fn)(tpr_server_call *call, void *ud);
+
+/* Create a server bound to 127.0.0.1:port (port 0 = ephemeral; actual port
+ * via tpr_server_port). NULL on bind failure. */
+tpr_server *tpr_server_create(int port);
+int tpr_server_port(tpr_server *s);
+
+/* Register a handler for an exact :path. Must precede tpr_server_start. */
+void tpr_server_register(tpr_server *s, const char *method, tpr_handler_fn fn,
+                         void *ud);
+
+/* Start the accept loop (background thread). */
+int tpr_server_start(tpr_server *s);
+
+/* Stop accepting, close connections, join threads, free. */
+void tpr_server_destroy(tpr_server *s);
+
+/* -- inside a handler -- */
+
+/* Next request message: 1 = got one (*data/*len set, free with
+ * tpr_srv_buf_free), 0 = client half-closed, -1 = connection error/cancel. */
+int tpr_srv_recv(tpr_server_call *c, uint8_t **data, size_t *len);
+
+/* Send one response message. */
+int tpr_srv_send(tpr_server_call *c, const uint8_t *data, size_t len);
+
+/* The call's :path (valid for the handler's duration). */
+const char *tpr_srv_method(tpr_server_call *c);
+
+/* Remaining time before the client's deadline, in microseconds;
+ * INT64_MAX when the call has no deadline. */
+int64_t tpr_srv_deadline_us(tpr_server_call *c);
+
+/* Set the trailers' :message detail (optional, before returning). */
+void tpr_srv_set_details(tpr_server_call *c, const char *details);
+
+void tpr_srv_buf_free(uint8_t *data);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TPURPC_SERVER_H */

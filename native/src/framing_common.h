@@ -1,0 +1,138 @@
+// Shared wire-framing helpers for the native client and server
+// (tpurpc_client.cc / tpurpc_server.cc). The authoritative format doc is
+// tpurpc/rpc/frame.py: 8-byte preface "TPURPC\x01\x00", little-endian
+// frames [u8 type][u8 flags][u32 stream_id][u32 length][payload], metadata
+// as u16 count + (u16 klen, key, u32 vlen, value) entries.
+#ifndef TPURPC_FRAMING_COMMON_H
+#define TPURPC_FRAMING_COMMON_H
+
+#include <errno.h>
+#include <stdint.h>
+#include <string.h>
+#include <sys/socket.h>
+
+#include <mutex>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace tpr_wire {
+
+constexpr uint8_t kHeaders = 1, kMessage = 2, kTrailers = 3, kRst = 4,
+                  kPing = 5, kPong = 6, kGoaway = 7;
+constexpr uint8_t kFlagEndStream = 0x01, kFlagMore = 0x02,
+                  kFlagNoMessage = 0x04;
+constexpr size_t kMaxFramePayload = 1u << 20;
+inline const char kMagic[] = "TPURPC\x01\x00";  // 8 bytes incl trailing NUL
+
+inline void put_u16(std::string &out, uint16_t v) {
+  out.push_back(static_cast<char>(v & 0xff));
+  out.push_back(static_cast<char>(v >> 8));
+}
+inline void put_u32(std::string &out, uint32_t v) {
+  for (int i = 0; i < 4; i++)
+    out.push_back(static_cast<char>((v >> (8 * i)) & 0xff));
+}
+inline uint16_t get_u16(const uint8_t *p) {
+  return static_cast<uint16_t>(p[0] | (p[1] << 8));
+}
+inline uint32_t get_u32(const uint8_t *p) {
+  return p[0] | (p[1] << 8) | (p[2] << 16) | (uint32_t(p[3]) << 24);
+}
+
+inline std::string encode_metadata(
+    const std::vector<std::pair<std::string, std::string>> &md) {
+  std::string out;
+  put_u16(out, static_cast<uint16_t>(md.size()));
+  for (const auto &kv : md) {
+    put_u16(out, static_cast<uint16_t>(kv.first.size()));
+    out += kv.first;
+    put_u32(out, static_cast<uint32_t>(kv.second.size()));
+    out += kv.second;
+  }
+  return out;
+}
+
+inline bool decode_metadata(
+    const uint8_t *buf, size_t len,
+    std::vector<std::pair<std::string, std::string>> *out) {
+  if (len < 2) return false;
+  size_t off = 2;
+  uint16_t count = get_u16(buf);
+  for (uint16_t i = 0; i < count; i++) {
+    if (off + 2 > len) return false;
+    uint16_t klen = get_u16(buf + off);
+    off += 2;
+    if (off + klen + 4 > len) return false;
+    std::string key(reinterpret_cast<const char *>(buf + off), klen);
+    off += klen;
+    uint32_t vlen = get_u32(buf + off);
+    off += 4;
+    if (off + vlen > len) return false;
+    out->emplace_back(
+        std::move(key),
+        std::string(reinterpret_cast<const char *>(buf + off), vlen));
+    off += vlen;
+  }
+  return true;
+}
+
+inline bool fd_write_all(int fd, const void *buf, size_t len) {
+  const char *p = static_cast<const char *>(buf);
+  while (len > 0) {
+    ssize_t n = ::send(fd, p, len, MSG_NOSIGNAL);
+    if (n <= 0) {
+      if (n < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+inline bool fd_read_exact(int fd, void *buf, size_t len) {
+  char *p = static_cast<char *>(buf);
+  while (len > 0) {
+    ssize_t n = ::recv(fd, p, len, 0);
+    if (n <= 0) {
+      if (n < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+// Serialized whole-frame write (the FrameWriter-analog lock lives with the
+// caller's mutex).
+inline bool fd_send_frame_locked(int fd, uint8_t type, uint8_t flags,
+                                 uint32_t sid, const void *payload,
+                                 size_t len) {
+  std::string hdr;
+  hdr.push_back(static_cast<char>(type));
+  hdr.push_back(static_cast<char>(flags));
+  put_u32(hdr, sid);
+  put_u32(hdr, static_cast<uint32_t>(len));
+  return fd_write_all(fd, hdr.data(), hdr.size()) &&
+         (len == 0 || fd_write_all(fd, payload, len));
+}
+
+// Read one frame header+payload; false on EOF/error/insane length.
+inline bool fd_read_frame(int fd, uint8_t *type, uint8_t *flags,
+                          uint32_t *sid, std::vector<uint8_t> *payload) {
+  uint8_t hdr[10];
+  if (!fd_read_exact(fd, hdr, sizeof hdr)) return false;
+  *type = hdr[0];
+  *flags = hdr[1];
+  *sid = get_u32(hdr + 2);
+  uint32_t len = get_u32(hdr + 6);
+  if (len > kMaxFramePayload + 65536) return false;
+  payload->resize(len);
+  return len == 0 || fd_read_exact(fd, payload->data(), len);
+}
+
+}  // namespace tpr_wire
+
+#endif  // TPURPC_FRAMING_COMMON_H

@@ -11,6 +11,8 @@
 
 #include "../include/tpurpc/client.h"
 
+#include "framing_common.h"
+
 #include <arpa/inet.h>
 #include <fcntl.h>
 #include <netdb.h>
@@ -35,61 +37,8 @@
 
 namespace {
 
-constexpr uint8_t kHeaders = 1, kMessage = 2, kTrailers = 3, kRst = 4,
-                  kPing = 5, kPong = 6, kGoaway = 7;
-constexpr uint8_t kFlagEndStream = 0x01, kFlagMore = 0x02,
-                  kFlagNoMessage = 0x04;
-constexpr size_t kMaxFramePayload = 1u << 20;
-const char kMagic[] = "TPURPC\x01\x00";  // 8 bytes incl. trailing NUL
-
+using namespace tpr_wire;
 using Clock = std::chrono::steady_clock;
-
-void put_u16(std::string &out, uint16_t v) {
-  out.push_back(static_cast<char>(v & 0xff));
-  out.push_back(static_cast<char>(v >> 8));
-}
-void put_u32(std::string &out, uint32_t v) {
-  for (int i = 0; i < 4; i++) out.push_back(static_cast<char>((v >> (8 * i)) & 0xff));
-}
-uint16_t get_u16(const uint8_t *p) { return static_cast<uint16_t>(p[0] | (p[1] << 8)); }
-uint32_t get_u32(const uint8_t *p) {
-  return p[0] | (p[1] << 8) | (p[2] << 16) | (uint32_t(p[3]) << 24);
-}
-
-std::string encode_metadata(
-    const std::vector<std::pair<std::string, std::string>> &md) {
-  std::string out;
-  put_u16(out, static_cast<uint16_t>(md.size()));
-  for (const auto &kv : md) {
-    put_u16(out, static_cast<uint16_t>(kv.first.size()));
-    out += kv.first;
-    put_u32(out, static_cast<uint32_t>(kv.second.size()));
-    out += kv.second;
-  }
-  return out;
-}
-
-bool decode_metadata(const uint8_t *buf, size_t len,
-                     std::vector<std::pair<std::string, std::string>> *out) {
-  if (len < 2) return false;
-  size_t off = 2;
-  uint16_t count = get_u16(buf);
-  for (uint16_t i = 0; i < count; i++) {
-    if (off + 2 > len) return false;
-    uint16_t klen = get_u16(buf + off);
-    off += 2;
-    if (off + klen + 4 > len) return false;
-    std::string key(reinterpret_cast<const char *>(buf + off), klen);
-    off += klen;
-    uint32_t vlen = get_u32(buf + off);
-    off += 4;
-    if (off + vlen > len) return false;
-    out->emplace_back(std::move(key),
-                      std::string(reinterpret_cast<const char *>(buf + off), vlen));
-    off += vlen;
-  }
-  return true;
-}
 
 struct Call {
   uint32_t stream_id = 0;
@@ -129,43 +78,18 @@ struct tpr_channel {
   }
 
   bool write_all(const void *buf, size_t len) {
-    const char *p = static_cast<const char *>(buf);
-    while (len > 0) {
-      ssize_t n = ::send(fd, p, len, MSG_NOSIGNAL);
-      if (n <= 0) {
-        if (n < 0 && (errno == EINTR)) continue;
-        return false;
-      }
-      p += n;
-      len -= static_cast<size_t>(n);
-    }
-    return true;
+    return tpr_wire::fd_write_all(fd, buf, len);
   }
 
   bool send_frame(uint8_t type, uint8_t flags, uint32_t sid,
                   const void *payload, size_t len) {
-    std::string hdr;
-    hdr.push_back(static_cast<char>(type));
-    hdr.push_back(static_cast<char>(flags));
-    put_u32(hdr, sid);
-    put_u32(hdr, static_cast<uint32_t>(len));
     std::lock_guard<std::mutex> lk(write_mu);
     if (!alive.load()) return false;
-    return write_all(hdr.data(), hdr.size()) && (len == 0 || write_all(payload, len));
+    return fd_send_frame_locked(fd, type, flags, sid, payload, len);
   }
 
   bool read_exact(void *buf, size_t len) {
-    char *p = static_cast<char *>(buf);
-    while (len > 0) {
-      ssize_t n = ::recv(fd, p, len, 0);
-      if (n <= 0) {
-        if (n < 0 && errno == EINTR) continue;
-        return false;
-      }
-      p += n;
-      len -= static_cast<size_t>(n);
-    }
-    return true;
+    return tpr_wire::fd_read_exact(fd, buf, len);
   }
 
   void die() {
@@ -186,14 +110,11 @@ struct tpr_channel {
 
   void read_loop() {
     std::vector<uint8_t> payload;
+    uint8_t type, flags;
+    uint32_t sid;
     while (alive.load()) {
-      uint8_t hdr[10];
-      if (!read_exact(hdr, sizeof hdr)) break;
-      uint8_t type = hdr[0], flags = hdr[1];
-      uint32_t sid = get_u32(hdr + 2), len = get_u32(hdr + 6);
-      if (len > kMaxFramePayload + 65536) break;  // insane frame: poisoned pipe
-      payload.resize(len);
-      if (len > 0 && !read_exact(payload.data(), len)) break;
+      if (!fd_read_frame(fd, &type, &flags, &sid, &payload)) break;
+      size_t len = payload.size();
 
       if (type == kPing) {
         send_frame(kPong, 0, 0, payload.data(), payload.size());
@@ -247,6 +168,37 @@ struct tpr_channel {
 };
 
 // ---------------------------------------------------------------------------
+
+// RST the stream and record a local terminal status. Servers do NOT
+// acknowledge an RST with trailers (tpurpc/rpc/server.py cancels the
+// context and goes quiet), so the call must finish locally — otherwise a
+// deadline-less Finish() after Cancel() would wait forever. A real trailers
+// frame that raced in first wins.
+static void rst_and_finish_locally(tpr_call *c, int code,
+                                   const char *details) {
+  tpr_channel *ch = c->c.ch;
+  {
+    std::lock_guard<std::mutex> lk(ch->mu);
+    if (c->c.cancelled || c->c.trailers_seen) return;
+    c->c.cancelled = true;
+  }
+  std::vector<std::pair<std::string, std::string>> md;
+  md.emplace_back(":status", std::to_string(TPR_CANCELLED));
+  md.emplace_back(":message", details);
+  std::string payload = encode_metadata(md);
+  ch->send_frame(kRst, 0, c->c.stream_id, payload.data(), payload.size());
+  {
+    std::lock_guard<std::mutex> lk(ch->mu);
+    ch->streams.erase(c->c.stream_id);
+    if (!c->c.trailers_seen) {
+      c->c.trailers_seen = true;
+      c->c.status_code = code;
+      c->c.status_details = details;
+    }
+  }
+  ch->cv.notify_all();
+}
+
 
 extern "C" {
 
@@ -416,16 +368,10 @@ int tpr_call_finish(tpr_call *c, char *details, size_t cap) {
       break;
     }
     if (!wait_event(c, lk)) {  // client-side deadline
-      // RST first (while trailers_seen is still false — cancel's guard
-      // refuses finished calls), then record the local status.
       lk.unlock();
-      tpr_call_cancel(c);
+      rst_and_finish_locally(c, TPR_DEADLINE_EXCEEDED,
+                             "deadline exceeded (client)");
       lk.lock();
-      if (!c->c.trailers_seen) {  // reader may have raced trailers in
-        c->c.trailers_seen = true;
-        c->c.status_code = TPR_DEADLINE_EXCEEDED;
-        c->c.status_details = "deadline exceeded (client)";
-      }
       break;
     }
   }
@@ -439,17 +385,7 @@ int tpr_call_finish(tpr_call *c, char *details, size_t cap) {
 }
 
 void tpr_call_cancel(tpr_call *c) {
-  tpr_channel *ch = c->c.ch;
-  {
-    std::lock_guard<std::mutex> lk(ch->mu);
-    if (c->c.cancelled || c->c.trailers_seen) return;
-    c->c.cancelled = true;
-  }
-  std::vector<std::pair<std::string, std::string>> md;
-  md.emplace_back(":status", std::to_string(TPR_CANCELLED));
-  md.emplace_back(":message", "cancelled by client");
-  std::string payload = encode_metadata(md);
-  ch->send_frame(kRst, 0, c->c.stream_id, payload.data(), payload.size());
+  rst_and_finish_locally(c, TPR_CANCELLED, "cancelled by client");
 }
 
 void tpr_call_destroy(tpr_call *c) {

@@ -1,0 +1,339 @@
+// tpurpc C server implementation — native app servers over the framing.
+//
+// Wire format: tpurpc/rpc/frame.py via framing_common.h. Model: accept-loop
+// thread + one reader thread per connection that DEMUXES frames to
+// per-stream call objects (tpurpc Python channels multiplex concurrent
+// calls over one connection, so per-stream routing is mandatory, not a
+// nicety); each call's handler runs on its own thread. The reference's
+// equivalent machinery is src/cpp/server/ + surface/server.cc's
+// registered-method dispatch, collapsed to tpurpc scale.
+
+#include "../include/tpurpc/server.h"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdio>
+#include <cstdlib>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "framing_common.h"
+
+using namespace tpr_wire;
+
+namespace {
+using Clock = std::chrono::steady_clock;
+struct Conn;
+}  // namespace
+
+struct tpr_server_call {
+  Conn *conn = nullptr;
+  uint32_t stream_id = 0;
+  std::string method;
+  int64_t deadline_us = INT64_MAX;  // absolute, vs Clock epoch
+  std::string details;
+
+  // reader-thread-filled state, guarded by conn->mu
+  std::deque<std::string> pending;  // complete messages
+  std::string partial;              // MORE-fragment accumulator
+  bool half_closed = false;         // client END_STREAM seen
+  bool cancelled = false;           // RST / connection death
+};
+
+namespace {
+
+struct Conn {
+  int fd = -1;
+  std::mutex write_mu;             // serializes whole frames
+  std::mutex mu;                   // guards streams + call state
+  std::condition_variable cv;      // signaled on any delivery
+  std::map<uint32_t, tpr_server_call *> streams;
+  std::atomic<bool> alive{true};
+  std::atomic<bool> fd_closed{false};
+  std::thread thread;
+  std::atomic<int> handler_threads{0};
+
+  bool send_frame(uint8_t type, uint8_t flags, uint32_t sid,
+                  const void *payload, size_t len) {
+    std::lock_guard<std::mutex> lk(write_mu);
+    if (fd_closed.load()) return false;
+    return fd_send_frame_locked(fd, type, flags, sid, payload, len);
+  }
+
+  void send_trailers(uint32_t sid, int code, const std::string &details) {
+    std::vector<std::pair<std::string, std::string>> md;
+    md.emplace_back(":status", std::to_string(code));
+    if (!details.empty()) md.emplace_back(":message", details);
+    std::string payload = encode_metadata(md);
+    send_frame(kTrailers, kFlagEndStream, sid, payload.data(), payload.size());
+  }
+
+  void close_fd() {
+    // write_mu excludes a concurrent send_frame mid-write on the dying fd;
+    // the flag (checked under write_mu) prevents double close / fd reuse.
+    std::lock_guard<std::mutex> lk(write_mu);
+    if (!fd_closed.exchange(true)) ::close(fd);
+  }
+};
+
+}  // namespace
+
+struct tpr_server {
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> running{false};
+  std::thread accept_thread;
+  std::map<std::string, std::pair<tpr_handler_fn, void *>> handlers;
+  std::mutex conns_mu;
+  std::vector<Conn *> conns;
+
+  void run_handler(Conn *c, tpr_server_call *call) {
+    auto it = handlers.find(call->method);
+    int code;
+    if (it == handlers.end()) {
+      code = 12;  // UNIMPLEMENTED
+      call->details = "unknown method " + call->method;
+    } else {
+      code = it->second.first(call, it->second.second);
+    }
+    bool was_cancelled;
+    {
+      std::lock_guard<std::mutex> lk(c->mu);
+      was_cancelled = call->cancelled;
+      c->streams.erase(call->stream_id);
+    }
+    if (!was_cancelled) c->send_trailers(call->stream_id, code, call->details);
+    delete call;
+    c->handler_threads.fetch_sub(1);
+  }
+
+  void serve_conn(Conn *c) {
+    char magic[8];
+    if (!fd_read_exact(c->fd, magic, 8) || memcmp(magic, kMagic, 8) != 0)
+      return;
+    uint8_t type, flags;
+    uint32_t sid;
+    std::vector<uint8_t> payload;
+    while (running.load() && c->alive.load()) {
+      if (!fd_read_frame(c->fd, &type, &flags, &sid, &payload)) break;
+      if (type == kPing) {
+        c->send_frame(kPong, 0, 0, payload.data(), payload.size());
+        continue;
+      }
+      if (type == kHeaders) {
+        std::vector<std::pair<std::string, std::string>> md;
+        if (!decode_metadata(payload.data(), payload.size(), &md)) break;
+        auto *call = new tpr_server_call();
+        call->conn = c;
+        call->stream_id = sid;
+        for (auto &kv : md) {
+          if (kv.first == ":path") call->method = kv.second;
+          else if (kv.first == ":timeout-us")
+            call->deadline_us =
+                std::chrono::duration_cast<std::chrono::microseconds>(
+                    Clock::now().time_since_epoch()).count() +
+                atoll(kv.second.c_str());
+        }
+        bool duplicate;
+        {
+          std::lock_guard<std::mutex> lk(c->mu);
+          duplicate = c->streams.count(sid) != 0;
+          if (!duplicate) c->streams[sid] = call;
+        }
+        if (duplicate) {
+          // duplicate HEADERS on an active sid: protocol violation —
+          // overwriting would orphan one call's frame routing forever
+          c->send_trailers(sid, 13, "duplicate stream id");  // INTERNAL
+          delete call;
+          continue;
+        }
+        c->handler_threads.fetch_add(1);
+        std::thread([this, c, call] { run_handler(c, call); }).detach();
+        continue;
+      }
+      // frame for an existing stream
+      std::unique_lock<std::mutex> lk(c->mu);
+      auto it = c->streams.find(sid);
+      if (it == c->streams.end()) continue;  // finished/unknown: drop
+      tpr_server_call *call = it->second;
+      if (type == kRst) {
+        call->cancelled = true;
+      } else if (type == kMessage) {
+        if (!(flags & kFlagNoMessage))
+          call->partial.append(reinterpret_cast<char *>(payload.data()),
+                               payload.size());
+        if (!(flags & kFlagMore) && !(flags & kFlagNoMessage)) {
+          call->pending.push_back(std::move(call->partial));
+          call->partial.clear();
+        }
+        if (flags & kFlagEndStream) call->half_closed = true;
+      }
+      lk.unlock();
+      c->cv.notify_all();
+    }
+    // connection done: fail outstanding calls, wake their handlers
+    {
+      std::lock_guard<std::mutex> lk(c->mu);
+      for (auto &kv : c->streams) kv.second->cancelled = true;
+    }
+    c->cv.notify_all();
+    // wait for handlers to drain (they hold call pointers)
+    while (c->handler_threads.load() > 0)
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    c->close_fd();
+    c->alive.store(false);
+  }
+
+  void reap_dead_conns() {
+    std::lock_guard<std::mutex> lk(conns_mu);
+    for (auto it = conns.begin(); it != conns.end();) {
+      Conn *c = *it;
+      if (!c->alive.load()) {
+        if (c->thread.joinable()) c->thread.join();
+        delete c;
+        it = conns.erase(it);
+      } else {
+        ++it;
+      }
+    }
+  }
+
+  void accept_loop() {
+    while (running.load()) {
+      struct sockaddr_in peer {};
+      socklen_t plen = sizeof peer;
+      int fd = ::accept(listen_fd, reinterpret_cast<sockaddr *>(&peer), &plen);
+      if (fd < 0) {
+        if (errno == EINTR) continue;
+        return;  // listener closed
+      }
+      reap_dead_conns();  // bound growth: finished conns freed on each accept
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+      auto *c = new Conn();
+      c->fd = fd;
+      {
+        std::lock_guard<std::mutex> lk(conns_mu);
+        conns.push_back(c);
+      }
+      c->thread = std::thread([this, c] { serve_conn(c); });
+    }
+  }
+};
+
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+tpr_server *tpr_server_create(int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  struct sockaddr_in addr {};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd, reinterpret_cast<sockaddr *>(&addr), sizeof addr) != 0 ||
+      ::listen(fd, 128) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  socklen_t alen = sizeof addr;
+  getsockname(fd, reinterpret_cast<sockaddr *>(&addr), &alen);
+  auto *s = new tpr_server();
+  s->listen_fd = fd;
+  s->port = ntohs(addr.sin_port);
+  return s;
+}
+
+int tpr_server_port(tpr_server *s) { return s->port; }
+
+void tpr_server_register(tpr_server *s, const char *method, tpr_handler_fn fn,
+                         void *ud) {
+  s->handlers[method] = {fn, ud};
+}
+
+int tpr_server_start(tpr_server *s) {
+  s->running.store(true);
+  s->accept_thread = std::thread([s] { s->accept_loop(); });
+  return 0;
+}
+
+void tpr_server_destroy(tpr_server *s) {
+  s->running.store(false);
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  {
+    std::lock_guard<std::mutex> lk(s->conns_mu);
+    for (Conn *c : s->conns) {
+      c->alive.store(false);
+      if (!c->fd_closed.load()) ::shutdown(c->fd, SHUT_RDWR);
+      if (c->thread.joinable()) c->thread.join();
+      delete c;
+    }
+    s->conns.clear();
+  }
+  delete s;
+}
+
+int tpr_srv_recv(tpr_server_call *c, uint8_t **data, size_t *len) {
+  Conn *conn = c->conn;
+  std::unique_lock<std::mutex> lk(conn->mu);
+  while (true) {
+    if (!c->pending.empty()) {
+      std::string &m = c->pending.front();
+      *len = m.size();
+      *data = static_cast<uint8_t *>(malloc(m.size() ? m.size() : 1));
+      memcpy(*data, m.data(), m.size());
+      c->pending.pop_front();
+      return 1;
+    }
+    if (c->cancelled) return -1;
+    if (c->half_closed) return 0;
+    conn->cv.wait(lk);
+  }
+}
+
+int tpr_srv_send(tpr_server_call *c, const uint8_t *data, size_t len) {
+  size_t off = 0;
+  do {
+    size_t n = len - off;
+    bool last = n <= kMaxFramePayload;
+    if (!last) n = kMaxFramePayload;
+    uint8_t flags = last ? 0 : kFlagMore;
+    if (!c->conn->send_frame(kMessage, flags, c->stream_id, data + off, n))
+      return -1;
+    off += n;
+  } while (off < len);
+  return 0;
+}
+
+const char *tpr_srv_method(tpr_server_call *c) { return c->method.c_str(); }
+
+int64_t tpr_srv_deadline_us(tpr_server_call *c) {
+  if (c->deadline_us == INT64_MAX) return INT64_MAX;
+  int64_t now = std::chrono::duration_cast<std::chrono::microseconds>(
+                    Clock::now().time_since_epoch()).count();
+  int64_t left = c->deadline_us - now;
+  return left > 0 ? left : 0;
+}
+
+void tpr_srv_set_details(tpr_server_call *c, const char *details) {
+  c->details = details ? details : "";
+}
+
+void tpr_srv_buf_free(uint8_t *data) { free(data); }
+
+}  // extern "C"

@@ -183,3 +183,15 @@ def test_grpcio_gzip_compressed_client(compat):
         mcs = ch.stream_unary("/test.Echo/Collect", _ID, _ID)
         assert mcs(iter([b"a" * 100, b"b" * 100]), timeout=20) == \
             b"a" * 100 + b"|" + b"b" * 100
+
+
+def test_grpcio_deflate_compressed_client(compat):
+    """Same as the gzip case but with the deflate codec (raw zlib stream,
+    gRPC's second standard compressor) — decode_grpc_message must handle
+    both and advertise them in grpc-accept-encoding."""
+    srv, port, _ = compat
+    with grpc.insecure_channel(f"127.0.0.1:{port}",
+                               compression=grpc.Compression.Deflate) as ch:
+        mc = ch.unary_unary("/test.Echo/Echo", _ID, _ID)
+        payload = b"deflate-me " * 400
+        assert mc(payload, timeout=20) == payload

@@ -157,3 +157,115 @@ def test_ring_hash_channel_stickiness():
     finally:
         s1.stop(grace=0)
         s2.stop(grace=0)
+
+
+# -- retry policy ------------------------------------------------------------
+
+def _flaky_server(fail_times: int, code=None):
+    from tpurpc.rpc.status import StatusCode
+
+    code = code or StatusCode.UNAVAILABLE
+    srv = rpc.Server(max_workers=2)
+    calls = {"n": 0}
+
+    def handler(req, ctx):
+        calls["n"] += 1
+        if calls["n"] <= fail_times:
+            ctx.abort(code, "flake")
+        return b"ok:" + str(calls["n"]).encode()
+
+    srv.add_method("/t.S/Flaky", rpc.unary_unary_rpc_method_handler(handler))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    return srv, port, calls
+
+
+def test_retry_unary_recovers():
+    srv, port, calls = _flaky_server(2)
+    try:
+        pol = rpc.RetryPolicy(max_attempts=4, initial_backoff=0.01)
+        with rpc.Channel(f"127.0.0.1:{port}", retry_policy=pol) as ch:
+            out = ch.unary_unary("/t.S/Flaky")(b"", timeout=10)
+        assert out == b"ok:3"
+        assert calls["n"] == 3
+    finally:
+        srv.stop(grace=0)
+
+
+def test_retry_exhaustion_surfaces_last_error():
+    import pytest as _pytest
+
+    from tpurpc.rpc.status import RpcError, StatusCode
+
+    srv, port, calls = _flaky_server(10)
+    try:
+        pol = rpc.RetryPolicy(max_attempts=3, initial_backoff=0.01)
+        with rpc.Channel(f"127.0.0.1:{port}", retry_policy=pol) as ch:
+            with _pytest.raises(RpcError) as ei:
+                ch.unary_unary("/t.S/Flaky")(b"", timeout=10)
+        assert ei.value.code() == StatusCode.UNAVAILABLE
+        assert calls["n"] == 3                 # exactly max_attempts
+    finally:
+        srv.stop(grace=0)
+
+
+def test_retry_skips_non_retryable_codes():
+    import pytest as _pytest
+
+    from tpurpc.rpc.status import RpcError, StatusCode
+
+    srv, port, calls = _flaky_server(10, code=StatusCode.INVALID_ARGUMENT)
+    try:
+        pol = rpc.RetryPolicy(max_attempts=4, initial_backoff=0.01)
+        with rpc.Channel(f"127.0.0.1:{port}", retry_policy=pol) as ch:
+            with _pytest.raises(RpcError) as ei:
+                ch.unary_unary("/t.S/Flaky")(b"", timeout=10)
+        assert ei.value.code() == StatusCode.INVALID_ARGUMENT
+        assert calls["n"] == 1                 # no retry on non-retryable
+    finally:
+        srv.stop(grace=0)
+
+
+def test_retry_off_by_default():
+    import pytest as _pytest
+
+    from tpurpc.rpc.status import RpcError
+
+    srv, port, calls = _flaky_server(1)
+    try:
+        with rpc.Channel(f"127.0.0.1:{port}") as ch:
+            with _pytest.raises(RpcError):
+                ch.unary_unary("/t.S/Flaky")(b"", timeout=10)
+        assert calls["n"] == 1
+    finally:
+        srv.stop(grace=0)
+
+
+def test_retry_never_replays_committed_call():
+    """A call whose response message was already delivered must NOT be
+    retried even when trailers carry a retryable code (gRPC retry
+    contract): the handler would re-execute."""
+    import pytest as _pytest
+
+    from tpurpc.rpc.status import RpcError, StatusCode
+
+    srv = rpc.Server(max_workers=2)
+    calls = {"n": 0}
+
+    def handler(req, ctx):
+        calls["n"] += 1
+        ctx.set_code(StatusCode.UNAVAILABLE)   # non-OK trailers AFTER the
+        return b"payload"                      # response message
+
+    srv.add_method("/t.S/Committed", rpc.unary_unary_rpc_method_handler(handler))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    try:
+        pol = rpc.RetryPolicy(max_attempts=4, initial_backoff=0.01)
+        with rpc.Channel(f"127.0.0.1:{port}", retry_policy=pol) as ch:
+            with _pytest.raises(RpcError) as ei:
+                ch.unary_unary("/t.S/Committed")(b"", timeout=10)
+        assert ei.value.code() == StatusCode.UNAVAILABLE
+        assert calls["n"] == 1                 # executed exactly once
+    finally:
+        srv.stop(grace=0)

@@ -51,17 +51,28 @@ def _try_build(path: str) -> None:
         return
     os.makedirs(build_dir, exist_ok=True)
     lock_path = os.path.join(build_dir, ".build.lock")
+    fail_stamp = os.path.join(build_dir, ".build.failed")
     try:
         import fcntl
 
         with open(lock_path, "w") as lock:
             fcntl.flock(lock, fcntl.LOCK_EX)  # winner builds, losers wait here
+            if os.path.exists(fail_stamp):
+                return  # a prior attempt failed: don't re-pay the compile
             if not os.path.exists(path):
                 tmp = path + ".tmp"
-                subprocess.run(
-                    [gxx, "-std=c++17", "-O3", "-DNDEBUG", "-shared", "-fPIC",
-                     *srcs, "-o", tmp, "-lpthread"],
-                    check=True, timeout=120, capture_output=True)
+                try:
+                    subprocess.run(
+                        [gxx, "-std=c++17", "-O3", "-DNDEBUG", "-shared",
+                         "-fPIC", *srcs, "-o", tmp, "-lpthread"],
+                        check=True, timeout=120, capture_output=True)
+                except Exception as exc:
+                    # Stamp the failure so every future process skips the
+                    # broken 120s compile instead of serially retrying it.
+                    # Delete the stamp (or native/build) to retry.
+                    with open(fail_stamp, "w") as f:
+                        f.write(f"{type(exc).__name__}: {exc}\n")
+                    return
                 os.replace(tmp, path)  # atomic: no partially-linked .so visible
     except Exception:
         pass

@@ -270,6 +270,7 @@ class FanInBatcher:
         #: the pipeline depth — blocking put() backpressures the batcher
         #: thread, and through it the callers, when the device falls behind
         self._inflight: "_queue.Queue" = _queue.Queue(maxsize=max(2, d2h_workers))
+        self._reaped = False  # set by close() after the workers are gone
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="tpurpc-batcher")
         self._completers = [
@@ -288,13 +289,22 @@ class FanInBatcher:
             self._kick.notify_all()
         self._thread.join(timeout=5)
         for _ in self._completers:   # one sentinel per completion worker,
-            self._inflight.put(None)  # after the last dispatched batch
+            try:                      # after the last dispatched batch.
+                # Non-blocking: if the queue is full its consumers are wedged
+                # (stalled device) and a blocking put would wedge close() too
+                # — the sweep below fails those batches instead.
+                self._inflight.put_nowait(None)
+            except _queue.Full:
+                break
         for c in self._completers:
             c.join(timeout=5)
+        self._reaped = True  # a still-blocked dispatch put now fails its batch
         # Shutdown race sweep: if the batcher thread outlived its join
         # timeout (device stall) its final batch can land after the workers
         # exited on sentinels — fail those callers instead of stranding them
-        # on p.event forever.
+        # on p.event forever. (A put racing this sweep is covered by the
+        # _reaped check in the dispatch loop: either the sweep sees the item,
+        # or the put times out and fails the batch itself.)
         while True:
             try:
                 item = self._inflight.get_nowait()
@@ -380,7 +390,36 @@ class FanInBatcher:
                 p.error = e
                 p.event.set()
             return
-        self._inflight.put((batch, sizes, total, out))
+        # Bounded-backpressure put that stays shutdown-safe: once close()
+        # has reaped the completion workers (_reaped), nobody will ever
+        # drain the queue — fail this batch's callers instead of parking
+        # them behind a put that can no longer complete.
+        import queue as _queue
+
+        def fail_batch(b):
+            for p in b:
+                p.error = RuntimeError("batcher closed")
+                p.event.set()
+
+        while True:
+            if self._reaped:
+                fail_batch(batch)
+                return
+            try:
+                self._inflight.put((batch, sizes, total, out), timeout=0.25)
+                break
+            except _queue.Full:
+                continue
+        if self._reaped:
+            # Reaping raced our successful put and close()'s sweep may have
+            # already drained: self-sweep so no batch is ever stranded.
+            while True:
+                try:
+                    item = self._inflight.get_nowait()
+                except _queue.Empty:
+                    return
+                if item is not None:
+                    fail_batch(item[0])
 
     def _complete_loop(self) -> None:
         """Stage 2: one whole-batch device→host transfer, numpy reply split."""

@@ -46,6 +46,10 @@ from tpurpc.rpc.resolver import register_resolver, ring_hash_key
 
 __all__ += ["register_resolver", "ring_hash_key"]
 
+from tpurpc.rpc.channel import RetryPolicy
+
+__all__ += ["RetryPolicy"]
+
 # H2Channel is exported LAZILY: tpurpc.wire.h2_client imports
 # tpurpc.wire.grpc_h2, which imports tpurpc.rpc.status — an eager import here
 # makes any `import tpurpc.wire.grpc_h2`-first program hit this package's

@@ -310,7 +310,11 @@ class Channel:
                  endpoint_factory: Optional[Callable[[], Endpoint]] = None,
                  connect_timeout: float = 30.0, lb_policy: str = "pick_first",
                  credentials=None,
-                 max_receive_message_length: Optional[int] = None):
+                 max_receive_message_length: Optional[int] = None,
+                 retry_policy: "Optional[RetryPolicy]" = None):
+        #: channel-level retry policy for unary-request calls (None = off,
+        #: matching gRPC's default of retries disabled without service config)
+        self.retry_policy = retry_policy
         from tpurpc.rpc.resolver import make_policy, resolve_target
         from tpurpc.utils.config import get_config
 
@@ -546,6 +550,56 @@ class Call:
 _NO_REQUEST = object()
 
 
+class RetryPolicy:
+    """Client retry policy — the reference inherits gRPC's service-config
+    retries (retryPolicy: maxAttempts/backoff/retryableStatusCodes, applied
+    in the client_channel filter). tpurpc applies it to unary-request calls
+    (the full request is in hand to replay); calls that already delivered a
+    response message are never retried, matching the gRPC retry contract.
+
+    >>> ch = Channel(target, retry_policy=RetryPolicy(max_attempts=4))
+    """
+
+    __slots__ = ("max_attempts", "initial_backoff", "max_backoff",
+                 "backoff_multiplier", "retryable_codes")
+
+    def __init__(self, max_attempts: int = 3, initial_backoff: float = 0.05,
+                 max_backoff: float = 1.0, backoff_multiplier: float = 2.0,
+                 retryable_codes: Sequence[StatusCode] = (
+                     StatusCode.UNAVAILABLE,)):
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self.max_attempts = max_attempts
+        self.initial_backoff = initial_backoff
+        self.max_backoff = max_backoff
+        self.backoff_multiplier = backoff_multiplier
+        self.retryable_codes = tuple(retryable_codes)
+
+    def run(self, deadline: Optional[float], attempt_fn):
+        """Drive attempt_fn() under this policy. Backoff is jittered ±20%
+        (lib/backoff's jitter), truncated so a sleep never outlives the
+        call deadline."""
+        backoff = self.initial_backoff
+        attempt = 0
+        while True:
+            try:
+                return attempt_fn()
+            except RpcError as exc:
+                attempt += 1
+                code = exc.code() if callable(exc.code) else exc.code
+                if (attempt >= self.max_attempts
+                        or code not in self.retryable_codes
+                        or getattr(exc, "_tpurpc_committed", False)):
+                    raise
+                sleep = min(backoff, self.max_backoff)
+                sleep *= 1.0 + random.uniform(-0.2, 0.2)
+                if (deadline is not None
+                        and time.monotonic() + sleep >= deadline):
+                    raise
+                time.sleep(sleep)
+                backoff *= self.backoff_multiplier
+
+
 class _MultiCallable:
     def __init__(self, channel: Channel, method: str,
                  serializer: Serializer, deserializer: Deserializer):
@@ -639,30 +693,61 @@ class UnaryUnary(_MultiCallable):
     def with_call(self, request, timeout: Optional[float] = None,
                   metadata: Optional[Metadata] = None, **grpcio_kw):
         _reject_call_credentials(grpcio_kw)
+        policy = self._channel.retry_policy
+        deadline = None if timeout is None else time.monotonic() + timeout
+
+        def attempt():
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+            return self._call_once(request, remaining, metadata)
+
+        if policy is None:
+            return attempt()
+        return policy.run(deadline, attempt)
+
+    def _call_once(self, request, timeout: Optional[float],
+                   metadata: Optional[Metadata]):
         conn, st, call = self._start(metadata, timeout, first_request=request)
         response = None
         got = False
-        for msg in call.messages():
+        try:
+            for msg in call.messages():
+                if got:
+                    raise RpcError(StatusCode.INTERNAL,
+                                   "unary call received multiple responses")
+                response, got = msg, True
+        except RpcError as exc:
             if got:
-                raise RpcError(StatusCode.INTERNAL,
-                               "unary call received multiple responses")
-            response, got = msg, True
+                # A response message was already delivered: the call is
+                # committed — replaying it would re-execute the handler
+                # (gRPC's retry contract forbids this too).
+                exc._tpurpc_committed = True
+            raise
         if not got:
             raise RpcError(StatusCode.INTERNAL, "unary call received no response")
         return response, call
 
     def future(self, request, timeout: Optional[float] = None,
                metadata: Optional[Metadata] = None):
-        """Minimal future: runs the call on a daemon thread."""
+        """Minimal future: runs the call on a daemon thread. The caller's
+        ring_hash key (a thread-local) is captured NOW and re-installed in
+        the worker thread, so keyed routing survives the thread hop."""
         import concurrent.futures
 
+        from tpurpc.rpc import resolver as _resolver
+
+        key = getattr(_resolver._call_key, "key", None)
         fut: "concurrent.futures.Future" = concurrent.futures.Future()
 
         def run():
             if not fut.set_running_or_notify_cancel():
                 return
             try:
-                fut.set_result(self(request, timeout, metadata))
+                if key is not None:
+                    with _resolver.ring_hash_key(key):
+                        fut.set_result(self(request, timeout, metadata))
+                else:
+                    fut.set_result(self(request, timeout, metadata))
             except BaseException as exc:
                 fut.set_exception(exc)
 

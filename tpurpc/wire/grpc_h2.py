@@ -49,12 +49,18 @@ def decode_grpc_message(msg: bytes, compressed: int, encoding: str):
             return gzip.decompress(msg), None
         except (OSError, EOFError, zlib.error):
             return None, (StatusCode.INTERNAL, "corrupt gzip message")
+    if encoding == "deflate":
+        # gRPC "deflate" is a raw zlib stream (RFC 1950), grpcio parity
+        try:
+            return zlib.decompress(msg), None
+        except zlib.error:
+            return None, (StatusCode.INTERNAL, "corrupt deflate message")
     if encoding == "identity":
         return None, (StatusCode.INTERNAL,
                       "compressed-flag set with identity grpc-encoding")
     return None, (StatusCode.UNIMPLEMENTED,
                   f"message encoding {encoding!r} not supported "
-                  "(accept: identity, gzip)")
+                  "(accept: identity, gzip, deflate)")
 
 #: our receive windows (we grant aggressively; tensors are big)
 RECV_WINDOW = 4 << 20
@@ -227,7 +233,7 @@ class GrpcH2Connection:
             return
         st.headers_sent = True
         hdrs = [(":status", "200"), ("content-type", "application/grpc"),
-                ("grpc-accept-encoding", "identity,gzip")]
+                ("grpc-accept-encoding", "identity,gzip,deflate")]
         for k, v in metadata:
             hdrs.append((k.lower(), _encode_metadata_value(k.lower(), v)))
         self._send_header_block(st.stream_id, self._encoder.encode(hdrs),

@@ -425,7 +425,7 @@ class H2Channel:
             (":authority", self._authority),
             ("te", "trailers"),
             ("content-type", "application/grpc"),
-            ("grpc-accept-encoding", "identity,gzip"),
+            ("grpc-accept-encoding", "identity,gzip,deflate"),
             ("user-agent", "tpurpc-h2/0.1"),
         ]
         if timeout is not None:
