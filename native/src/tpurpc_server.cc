@@ -84,6 +84,14 @@ struct Conn {
     std::lock_guard<std::mutex> lk(write_mu);
     if (!fd_closed.exchange(true)) ::close(fd);
   }
+
+  void shutdown_fd() {
+    // Same discipline as close_fd: the check and the shutdown must be one
+    // critical section, or a racing close_fd can recycle the fd number
+    // between them and this shutdown() hits an unrelated descriptor.
+    std::lock_guard<std::mutex> lk(write_mu);
+    if (!fd_closed.load()) ::shutdown(fd, SHUT_RDWR);
+  }
 };
 
 }  // namespace
@@ -279,7 +287,7 @@ void tpr_server_destroy(tpr_server *s) {
     std::lock_guard<std::mutex> lk(s->conns_mu);
     for (Conn *c : s->conns) {
       c->alive.store(false);
-      if (!c->fd_closed.load()) ::shutdown(c->fd, SHUT_RDWR);
+      c->shutdown_fd();
       if (c->thread.joinable()) c->thread.join();
       delete c;
     }

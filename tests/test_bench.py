@@ -136,3 +136,28 @@ def test_ring_beats_tcp_streaming_bandwidth(monkeypatch):
             srv.stop(grace=0)
         rates[platform] = r["rpcs"]
     assert rates["RDMA_BPEV"] >= rates["TCP"], rates
+
+
+def test_raw_bench_modes():
+    """Raw (no-RPC) transport bench — the rdma_microbenchmark analog —
+    produces sane JSON for both workloads on every wait discipline."""
+    import json as _json
+
+    from tpurpc.bench import raw as rawbench
+
+    out = rawbench.run_bw(size=1 << 16, msgs=32, ring_size=1 << 20,
+                          discipline="event")
+    assert out["gbps"] > 0 and out["msgs_per_s"] > 0
+
+    out = rawbench.run_lat(iters=50, ring_size=1 << 20, discipline="hybrid")
+    assert out["p50_us"] > 0 and out["p99_us"] >= out["p50_us"]
+
+    # CLI shape: one JSON line
+    import io
+    import contextlib
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rawbench.main(["bw", "--size", "65536", "--msgs", "16",
+                       "--ring-kb", "1024"])
+    parsed = _json.loads(buf.getvalue())
+    assert parsed["metric"] == "raw_ring_bandwidth"

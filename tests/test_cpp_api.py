@@ -29,8 +29,11 @@ def _build_example():
     os.makedirs(os.path.dirname(BIN), exist_ok=True)
     srcs = [os.path.join(ROOT, "examples", "cpp_client.cc"),
             os.path.join(ROOT, "native", "src", "tpurpc_client.cc")]
+    deps = srcs + [os.path.join(ROOT, "native", "src", "framing_common.h"),
+                   os.path.join(ROOT, "native", "include", "tpurpc", "client.h"),
+                   os.path.join(ROOT, "native", "include", "tpurpc", "client.hpp")]
     if (os.path.exists(BIN)
-            and all(os.path.getmtime(BIN) > os.path.getmtime(s) for s in srcs)):
+            and all(os.path.getmtime(BIN) > os.path.getmtime(d) for d in deps)):
         return
     subprocess.run(
         [gxx, "-std=c++17", "-O2", *srcs,
@@ -156,9 +159,12 @@ def _build_server_example():
     os.makedirs(os.path.dirname(SRV_BIN), exist_ok=True)
     srcs = [os.path.join(ROOT, "examples", "cpp_server.cc"),
             os.path.join(ROOT, "native", "src", "tpurpc_server.cc")]
+    deps = srcs + [os.path.join(ROOT, "native", "src", "framing_common.h"),
+                   os.path.join(ROOT, "native", "include", "tpurpc", "server.h"),
+                   os.path.join(ROOT, "native", "include", "tpurpc", "server.hpp")]
     if (os.path.exists(SRV_BIN)
-            and all(os.path.getmtime(SRV_BIN) > os.path.getmtime(s)
-                    for s in srcs)):
+            and all(os.path.getmtime(SRV_BIN) > os.path.getmtime(d)
+                    for d in deps)):
         return
     subprocess.run(
         [gxx, "-std=c++17", "-O2", *srcs,

@@ -312,3 +312,28 @@ def test_oversized_metadata_fails_stream_not_connection(channel):
     assert ei.value.code() is StatusCode.RESOURCE_EXHAUSTED
     # connection survives: next call works
     assert echo(b"still alive", timeout=10) == b"still alive"
+
+
+def test_keepalive_detects_dead_peer(monkeypatch):
+    """GRPC_ARG_KEEPALIVE_TIME_MS: an unresponsive peer (accepts bytes,
+    never answers the PING) must be detected and the connection killed, so
+    the next call dials fresh instead of hanging."""
+    import time as _time
+
+    from tpurpc.core.endpoint import passthru_endpoint_pair
+    from tpurpc.rpc.channel import Channel
+    from tpurpc.utils import config as config_mod
+
+    monkeypatch.setenv("GRPC_ARG_KEEPALIVE_TIME_MS", "100")
+    monkeypatch.setenv("GRPC_ARG_KEEPALIVE_TIMEOUT_MS", "200")
+    config_mod.set_config(None)  # re-read env
+
+    a, b = passthru_endpoint_pair()  # b swallows everything, answers nothing
+    ch = Channel(endpoint_factory=lambda: a)
+    conn = ch._connection()
+    assert conn.alive
+    deadline = _time.monotonic() + 5
+    while conn.alive and _time.monotonic() < deadline:
+        _time.sleep(0.05)
+    assert not conn.alive  # keepalive declared the silent peer dead
+    ch.close()

@@ -1,0 +1,140 @@
+"""Raw transport micro-benchmark: the ring data plane WITHOUT the RPC stack.
+
+Clone of the reference's ``examples/cpp/rdma_microbenchmark`` (``mb.cc`` —
+raw ibverbs WRITE ping-pong/bandwidth with no gRPC anywhere), recast for
+the tpurpc data plane: drive :class:`tpurpc.core.pair.Pair` directly over
+the loopback/shm domain and report raw bandwidth + message rate, giving the
+A/B baseline that isolates RPC-stack overhead from transport cost (the
+same comparison the reference's README tells its users to run first).
+
+Two workloads, mirroring ``mb.cc``'s modes:
+
+* ``bw``   — one-way bulk: sender streams ``--msgs`` messages of
+  ``--size`` bytes; receiver drains. Reports GB/s + msgs/s.
+* ``lat``  — ping-pong: 1-byte echo round trips. Reports p50/p99 µs.
+
+CLI:
+    python -m tpurpc.bench.raw bw  --size 1048576 --msgs 256
+    python -m tpurpc.bench.raw lat --iters 2000
+
+Threads, not processes: the loopback pair shares one address space the way
+the reference's single-host A/B test shares one NIC. ``--discipline``
+selects the wait mode (busy/event/hybrid) like ``GRPC_PLATFORM_TYPE``
+selects it for the RPC stack.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+from typing import List
+
+from tpurpc.core.pair import create_loopback_pair
+from tpurpc.core.poller import wait_readable
+
+
+def run_bw(size: int, msgs: int, ring_size: int, discipline: str) -> dict:
+    a, b = create_loopback_pair(ring_size=ring_size)
+    payload = b"\xab" * size
+    total = size * msgs
+    recv_done = threading.Event()
+    recv_bytes = [0]
+
+    def drain():
+        while recv_bytes[0] < total:
+            if not wait_readable(b, timeout=30, discipline=discipline):
+                break
+            chunk = b.recv()
+            recv_bytes[0] += len(chunk)
+        recv_done.set()
+
+    t = threading.Thread(target=drain, daemon=True)
+    try:
+        t0 = time.perf_counter()
+        t.start()
+        for _ in range(msgs):
+            sent = 0
+            while sent < size:
+                n = a.send([payload], byte_idx=sent)
+                sent += n
+        if not recv_done.wait(timeout=60):
+            raise TimeoutError("receiver did not drain")
+        dt = time.perf_counter() - t0
+    finally:
+        a.destroy()
+        b.destroy()
+    return {
+        "metric": "raw_ring_bandwidth",
+        "gbps": round(total / dt / 1e9, 3),
+        "msgs_per_s": round(msgs / dt, 1),
+        "size": size,
+        "discipline": discipline,
+    }
+
+
+def run_lat(iters: int, ring_size: int, discipline: str) -> dict:
+    a, b = create_loopback_pair(ring_size=ring_size)
+    stop = threading.Event()
+
+    def echo():
+        while not stop.is_set():
+            if not wait_readable(b, timeout=1, discipline=discipline):
+                continue
+            data = b.recv()
+            if data:
+                b.send([data])
+
+    t = threading.Thread(target=echo, daemon=True)
+    t.start()
+    rtts: List[float] = []
+    try:
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            a.send([b"x"])
+            while True:
+                if wait_readable(a, timeout=5, discipline=discipline):
+                    if a.recv():
+                        break
+            rtts.append(time.perf_counter() - t0)
+    finally:
+        stop.set()
+        t.join(timeout=2)
+        a.destroy()
+        b.destroy()
+    rtts.sort()
+    return {
+        "metric": "raw_ring_latency",
+        "p50_us": round(rtts[len(rtts) // 2] * 1e6, 1),
+        "p99_us": round(rtts[int(len(rtts) * 0.99)] * 1e6, 1),
+        "iters": iters,
+        "discipline": discipline,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tpurpc.bench.raw")
+    sub = ap.add_subparsers(dest="mode", required=True)
+    bw = sub.add_parser("bw")
+    bw.add_argument("--size", type=int, default=1 << 20)
+    bw.add_argument("--msgs", type=int, default=256)
+    lat = sub.add_parser("lat")
+    lat.add_argument("--iters", type=int, default=2000)
+    for p in (bw, lat):
+        p.add_argument("--ring-kb", type=int, default=4096)
+        p.add_argument("--discipline", default="hybrid",
+                       choices=("busy", "event", "hybrid"))
+    args = ap.parse_args(argv)
+    if args.mode == "bw":
+        out = run_bw(args.size, args.msgs, args.ring_kb * 1024,
+                     args.discipline)
+    else:
+        out = run_lat(args.iters, args.ring_kb * 1024, args.discipline)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

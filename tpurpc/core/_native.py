@@ -55,10 +55,23 @@ def _try_build(path: str) -> None:
     try:
         import fcntl
 
+        src_mtime = max(os.path.getmtime(s) for s in srcs)
         with open(lock_path, "w") as lock:
             fcntl.flock(lock, fcntl.LOCK_EX)  # winner builds, losers wait here
             if os.path.exists(fail_stamp):
-                return  # a prior attempt failed: don't re-pay the compile
+                # A prior attempt failed. Honor the stamp only while the
+                # sources are unchanged — newer sources (a fix, a git pull)
+                # invalidate it, as does a stamp older than the sources on
+                # disk. A transient failure (loaded machine) is retried by
+                # touching the sources or deleting native/build.
+                try:
+                    with open(fail_stamp) as f:
+                        stamped = float(f.readline().strip() or 0)
+                except (OSError, ValueError):
+                    stamped = 0.0
+                if stamped >= src_mtime:
+                    return
+                os.unlink(fail_stamp)
             if not os.path.exists(path):
                 tmp = path + ".tmp"
                 try:
@@ -67,11 +80,10 @@ def _try_build(path: str) -> None:
                          "-fPIC", *srcs, "-o", tmp, "-lpthread"],
                         check=True, timeout=120, capture_output=True)
                 except Exception as exc:
-                    # Stamp the failure so every future process skips the
-                    # broken 120s compile instead of serially retrying it.
-                    # Delete the stamp (or native/build) to retry.
+                    # Stamp the failure so future processes skip the broken
+                    # 120s compile until the sources change.
                     with open(fail_stamp, "w") as f:
-                        f.write(f"{type(exc).__name__}: {exc}\n")
+                        f.write(f"{src_mtime}\n{type(exc).__name__}: {exc}\n")
                     return
                 os.replace(tmp, path)  # atomic: no partially-linked .so visible
     except Exception:

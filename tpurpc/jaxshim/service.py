@@ -290,21 +290,28 @@ class FanInBatcher:
         self._thread.join(timeout=5)
         for _ in self._completers:   # one sentinel per completion worker,
             try:                      # after the last dispatched batch.
-                # Non-blocking: if the queue is full its consumers are wedged
-                # (stalled device) and a blocking put would wedge close() too
-                # — the sweep below fails those batches instead.
-                self._inflight.put_nowait(None)
+                # Generous timeout: a merely-backlogged (healthy) queue
+                # drains and takes the sentinel; only a truly wedged
+                # consumer set makes us give up so close() stays bounded.
+                self._inflight.put(None, timeout=10)
             except _queue.Full:
                 break
         for c in self._completers:
             c.join(timeout=5)
+        if any(c.is_alive() for c in self._completers):
+            # Workers are wedged in device work (unrecoverable device
+            # stall) but still hold the queue's consumer role — if they
+            # ever unwedge they will drain remaining batches, so failing
+            # those batches now would be both premature and racy. Leave
+            # the daemon threads to their fate.
+            return
         self._reaped = True  # a still-blocked dispatch put now fails its batch
         # Shutdown race sweep: if the batcher thread outlived its join
-        # timeout (device stall) its final batch can land after the workers
-        # exited on sentinels — fail those callers instead of stranding them
-        # on p.event forever. (A put racing this sweep is covered by the
-        # _reaped check in the dispatch loop: either the sweep sees the item,
-        # or the put times out and fails the batch itself.)
+        # timeout its final batch can land after the workers exited on
+        # sentinels — fail those callers instead of stranding them on
+        # p.event forever. (A put racing this sweep is covered by the
+        # _reaped check in the dispatch loop: either the sweep sees the
+        # item, or the put times out and fails the batch itself.)
         while True:
             try:
                 item = self._inflight.get_nowait()

@@ -128,6 +128,40 @@ class _Connection:
         self._thread = threading.Thread(target=self._read_loop, daemon=True,
                                         name="tpurpc-chan-reader")
         self._thread.start()
+        self._start_keepalive()
+
+    def _start_keepalive(self) -> None:
+        """Client keepalive (GRPC_ARG_KEEPALIVE_TIME_MS family, off by
+        default like gRPC): PING on an idle cadence; a missed PONG within
+        keepalive_timeout kills the connection so the channel's reconnect
+        machinery takes over instead of calls hanging on a dead peer."""
+        from tpurpc.utils.config import get_config
+
+        cfg = get_config()
+        if cfg.keepalive_time_ms <= 0:
+            return
+        interval = cfg.keepalive_time_ms / 1000.0
+        timeout = max(0.001, cfg.keepalive_timeout_ms / 1000.0)
+        # Interruptible sleep: _die() sets the event so a dead connection's
+        # keepalive thread (and its reference to this connection) unwinds
+        # immediately instead of parking in sleep() for up to a full
+        # interval (think GRPC_ARG_KEEPALIVE_TIME_MS=2h on a flaky link).
+        self._ka_stop = threading.Event()
+
+        def loop():
+            while self.alive:
+                if self._ka_stop.wait(interval):
+                    return
+                if not self.alive:
+                    return
+                try:
+                    self.ping(timeout)
+                except (EndpointError, TimeoutError, OSError):
+                    self._die("keepalive ping timed out")
+                    return
+
+        threading.Thread(target=loop, daemon=True,
+                         name="tpurpc-keepalive").start()
 
     def open_stream(self) -> _ClientStream:
         with self._lock:
@@ -217,6 +251,9 @@ class _Connection:
             waiters, self._pong_waiters = self._pong_waiters, []
         for ev in waiters:
             ev.set()  # ping() observes !alive via the raced send/raise below
+        ka = getattr(self, "_ka_stop", None)
+        if ka is not None:
+            ka.set()  # release the keepalive thread immediately
         trace_channel.log("connection dead: %s", why)
         for st in streams:
             st.deliver_failure(StatusCode.UNAVAILABLE, f"transport failed: {why}")

@@ -148,6 +148,14 @@ class Config:
     #: ring's credit flow then stalls the sender). resource_quota.cc's role,
     #: expressed in messages instead of bytes.
     stream_queue_depth: int = 64
+    #: Client keepalive: PING the server every N ms of inactivity; 0/neg
+    #: disables (gRPC's default — keepalive off unless configured). Accepts
+    #: gRPC's channel-arg spelling GRPC_ARG_KEEPALIVE_TIME_MS as an env var
+    #: for parity with the reference's knob family.
+    keepalive_time_ms: int = 0
+    #: How long to wait for the keepalive PONG before declaring the
+    #: connection dead (GRPC_ARG_KEEPALIVE_TIMEOUT_MS; default 20 s).
+    keepalive_timeout_ms: int = 20000
 
     @property
     def ring_buffer_size(self) -> int:
@@ -216,6 +224,12 @@ class Config:
                 "TPURPC_MAX_RECV_MESSAGE_LENGTH", cls.max_recv_message_length),
             stream_queue_depth=_env_int(
                 "TPURPC_STREAM_QUEUE_DEPTH", cls.stream_queue_depth),
+            keepalive_time_ms=_env_int(
+                "TPURPC_KEEPALIVE_TIME_MS", cls.keepalive_time_ms,
+                "GRPC_ARG_KEEPALIVE_TIME_MS"),
+            keepalive_timeout_ms=_env_int(
+                "TPURPC_KEEPALIVE_TIMEOUT_MS", cls.keepalive_timeout_ms,
+                "GRPC_ARG_KEEPALIVE_TIMEOUT_MS"),
         )
 
     @property
