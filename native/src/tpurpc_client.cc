@@ -374,13 +374,16 @@ int tpr_call_finish(tpr_call *c, char *details, size_t cap) {
       lk.lock();
       // If a concurrent cancel won the race, rst_and_finish_locally
       // early-returned without a terminal status; the racing thread sets
-      // trailers_seen right after its RST send completes — wait for it so
-      // we never surface the default TPR_UNKNOWN.
-      ch->cv.wait(lk, [&] { return c->c.trailers_seen || !ch->alive.load(); });
-      if (!c->c.trailers_seen) {  // channel died mid-race
+      // trailers_seen right after its RST send completes — wait for it,
+      // BOUNDED: that thread can itself be stuck in send() on a peer that
+      // stopped reading, and a deadline-exceeded call must never hang.
+      ch->cv.wait_for(lk, std::chrono::seconds(5), [&] {
+        return c->c.trailers_seen || !ch->alive.load();
+      });
+      if (!c->c.trailers_seen) {
         c->c.trailers_seen = true;
-        c->c.status_code = TPR_UNAVAILABLE;
-        c->c.status_details = "connection lost";
+        c->c.status_code = TPR_DEADLINE_EXCEEDED;
+        c->c.status_details = "deadline exceeded (client)";
       }
       break;
     }

@@ -337,3 +337,67 @@ def test_keepalive_detects_dead_peer(monkeypatch):
         _time.sleep(0.05)
     assert not conn.alive  # keepalive declared the silent peer dead
     ch.close()
+
+
+def test_max_connection_age_drains_gracefully(monkeypatch):
+    """GRPC_ARG_MAX_CONNECTION_AGE_MS: the server GOAWAYs an aged
+    connection; an in-flight call completes, and the NEXT call transparently
+    lands on a fresh connection."""
+    import time as _time
+
+    from tpurpc.utils import config as config_mod
+
+    monkeypatch.setenv("GRPC_ARG_MAX_CONNECTION_AGE_MS", "300")
+    config_mod.set_config(None)
+
+    srv = rpc.Server(max_workers=4)
+
+    def slow_echo(req, ctx):
+        _time.sleep(0.6)           # alive across the age expiry
+        return bytes(req)
+
+    srv.add_method("/t.Age/Slow", rpc.unary_unary_rpc_method_handler(slow_echo))
+    srv.add_method("/t.Age/Fast",
+                   rpc.unary_unary_rpc_method_handler(lambda b, c: bytes(b)))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    try:
+        with rpc.Channel(f"127.0.0.1:{port}") as ch:
+            # starts before the age expires, finishes after: must succeed
+            assert ch.unary_unary("/t.Age/Slow")(b"x", timeout=10) == b"x"
+            conn1 = ch._subchannels[0]._conn
+            # subsequent calls re-dial (old conn drained); repeated calls
+            # must keep working across successive aged connections
+            for _ in range(3):
+                assert ch.unary_unary("/t.Age/Fast")(b"y", timeout=10) == b"y"
+            assert ch._subchannels[0]._conn is not conn1 \
+                or not conn1.alive or conn1.draining
+    finally:
+        srv.stop(grace=0)
+
+
+def test_client_idle_timeout_closes_and_redials(monkeypatch):
+    """GRPC_ARG_CLIENT_IDLE_TIMEOUT_MS: an idle connection is dropped;
+    the next call dials fresh and succeeds."""
+    import time as _time
+
+    from tpurpc.utils import config as config_mod
+
+    monkeypatch.setenv("GRPC_ARG_CLIENT_IDLE_TIMEOUT_MS", "200")
+    config_mod.set_config(None)
+
+    srv = make_server()
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    try:
+        with rpc.Channel(f"127.0.0.1:{port}") as ch:
+            echo = ch.unary_unary("/t.Echo/Echo")
+            assert echo(b"1", timeout=10) == b"1"
+            conn = ch._subchannels[0]._conn
+            deadline = _time.monotonic() + 5
+            while conn.alive and _time.monotonic() < deadline:
+                _time.sleep(0.05)
+            assert not conn.alive          # idle monitor closed it
+            assert echo(b"2", timeout=10) == b"2"   # transparent re-dial
+    finally:
+        srv.stop(grace=0)

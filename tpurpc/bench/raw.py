@@ -62,6 +62,11 @@ def run_bw(size: int, msgs: int, ring_size: int, discipline: str) -> dict:
                 sent += n
         if not recv_done.wait(timeout=60):
             raise TimeoutError("receiver did not drain")
+        if recv_bytes[0] != total:
+            # drain() bailed on a wait_readable timeout: reporting a number
+            # computed from bytes that never arrived would be silently wrong
+            raise TimeoutError(
+                f"receiver stalled at {recv_bytes[0]}/{total} bytes")
         dt = time.perf_counter() - t0
     finally:
         a.destroy()
@@ -94,10 +99,13 @@ def run_lat(iters: int, ring_size: int, discipline: str) -> dict:
         for _ in range(iters):
             t0 = time.perf_counter()
             a.send([b"x"])
+            deadline = t0 + 10.0
             while True:
                 if wait_readable(a, timeout=5, discipline=discipline):
                     if a.recv():
                         break
+                if time.perf_counter() > deadline:
+                    raise TimeoutError("echo reply never arrived")
             rtts.append(time.perf_counter() - t0)
     finally:
         stop.set()

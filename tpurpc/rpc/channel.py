@@ -123,12 +123,15 @@ class _Connection:
         self._next_stream_id = 1  # odd ids, client-initiated (h2 convention)
         self._pong_waiters: List[threading.Event] = []
         self.alive = True
+        self.draining = False        # GOAWAY received: no new streams
+        self.last_activity = time.monotonic()
         self._on_dead = on_dead
         self.writer.send_preface()
         self._thread = threading.Thread(target=self._read_loop, daemon=True,
                                         name="tpurpc-chan-reader")
         self._thread.start()
         self._start_keepalive()
+        self._start_idle_monitor()
 
     def _start_keepalive(self) -> None:
         """Client keepalive (GRPC_ARG_KEEPALIVE_TIME_MS family, off by
@@ -163,10 +166,39 @@ class _Connection:
         threading.Thread(target=loop, daemon=True,
                          name="tpurpc-keepalive").start()
 
+    def _start_idle_monitor(self) -> None:
+        """client_idle filter analog (GRPC_ARG_CLIENT_IDLE_TIMEOUT_MS, off
+        by default): a connection with no streams and no activity for the
+        idle window is closed; the next call dials fresh. Frees server-side
+        per-connection state (pairs, rings) held by forgotten channels."""
+        from tpurpc.utils.config import get_config
+
+        cfg = get_config()
+        if cfg.client_idle_timeout_ms <= 0:
+            return
+        window = cfg.client_idle_timeout_ms / 1000.0
+        self._idle_stop = threading.Event()
+
+        def loop():
+            while self.alive:
+                if self._idle_stop.wait(min(window, 1.0)):
+                    return
+                with self._lock:
+                    idle = (not self._streams
+                            and time.monotonic() - self.last_activity >= window)
+                if idle:
+                    self._die("client idle timeout")
+                    return
+
+        threading.Thread(target=loop, daemon=True,
+                         name="tpurpc-client-idle").start()
+
     def open_stream(self) -> _ClientStream:
         with self._lock:
             if not self.alive:
                 raise EndpointError("connection closed")
+            if self.draining:
+                raise EndpointError("connection draining (GOAWAY)")
             sid = self._next_stream_id
             self._next_stream_id += 2
             from tpurpc.utils.config import get_config
@@ -174,11 +206,19 @@ class _Connection:
             st = _ClientStream(sid,
                                queue_depth=get_config().stream_queue_depth)
             self._streams[sid] = st
+            self.last_activity = time.monotonic()
             return st
 
     def close_stream(self, st: _ClientStream) -> None:
+        finish_drain = False
         with self._lock:
             self._streams.pop(st.stream_id, None)
+            self.last_activity = time.monotonic()
+            finish_drain = self.draining and not self._streams
+        if finish_drain:
+            # last in-flight call on a GOAWAY'd connection finished: the
+            # graceful close completes (max_connection_age contract)
+            self._die("drained after GOAWAY")
 
     def _read_loop(self) -> None:
         try:
@@ -204,7 +244,15 @@ class _Connection:
                 ev.set()
             return
         if f.type == fr.GOAWAY:
-            self._die("server sent GOAWAY")
+            # Graceful drain (gRPC GOAWAY semantics / max_age filter): stop
+            # opening new streams here — the subchannel dials fresh for the
+            # next call — but let in-flight calls run to completion. Close
+            # when the last one finishes (or now, if none are in flight).
+            with self._lock:
+                self.draining = True
+                empty = not self._streams
+            if empty:
+                self._die("server sent GOAWAY")
             return
         with self._lock:
             st = self._streams.get(f.stream_id)
@@ -254,6 +302,9 @@ class _Connection:
         ka = getattr(self, "_ka_stop", None)
         if ka is not None:
             ka.set()  # release the keepalive thread immediately
+        idle = getattr(self, "_idle_stop", None)
+        if idle is not None:
+            idle.set()
         trace_channel.log("connection dead: %s", why)
         for st in streams:
             st.deliver_failure(StatusCode.UNAVAILABLE, f"transport failed: {why}")
@@ -282,13 +333,15 @@ class _Subchannel:
 
     def get(self) -> _Connection:
         with self._lock:
-            if self._conn is not None and self._conn.alive:
+            if (self._conn is not None and self._conn.alive
+                    and not self._conn.draining):
                 return self._conn
         # Dial outside self._lock: a blackholed connect must not freeze close()
         # or concurrent calls for the whole connect timeout.
         with self._connect_lock:
             with self._lock:
-                if self._conn is not None and self._conn.alive:
+                if (self._conn is not None and self._conn.alive
+                        and not self._conn.draining):
                     return self._conn
                 wait = self._next_attempt - time.monotonic()
             if wait > 0:
@@ -650,10 +703,26 @@ class _MultiCallable:
                first_request=_NO_REQUEST) -> Tuple[_Connection, _ClientStream, Call]:
         """Open a stream and send HEADERS — fused with the first (only)
         MESSAGE when the request is known upfront, so a unary call costs one
-        transport write/notify instead of two."""
-        conn = self._channel._connection()
+        transport write/notify instead of two.
+
+        A connection that turned draining (max_age GOAWAY) between the LB
+        pick and open_stream is retried transparently on a fresh dial —
+        gRPC's "transparent retry" for streams the application never saw on
+        the wire; without it every age expiry has a window of spurious
+        UNAVAILABLE."""
+        for _ in range(3):
+            conn = self._channel._connection()
+            try:
+                st = conn.open_stream()
+                break
+            except EndpointError:
+                if not conn.draining:
+                    raise RpcError(StatusCode.UNAVAILABLE,
+                                   "connection closed while starting call")
+        else:
+            raise RpcError(StatusCode.UNAVAILABLE,
+                           "no non-draining connection after 3 dials")
         try:
-            st = conn.open_stream()
             deadline = None if timeout is None else time.monotonic() + timeout
             timeout_us = None if timeout is None else max(0, int(timeout * 1e6))
             hdr_payload = fr.headers_payload(self._method, metadata or (),
@@ -736,6 +805,22 @@ class UnaryUnary(_MultiCallable):
         def attempt():
             remaining = (None if deadline is None
                          else max(0.0, deadline - time.monotonic()))
+            # Transparent retry (distinct from RetryPolicy): a stream the
+            # server REFUSED at admission — RST "connection draining" from a
+            # max_age GOAWAY race — never reached a handler, so replaying it
+            # on a fresh connection is always safe (gRPC does the same for
+            # GOAWAY-refused streams).
+            for _ in range(3):
+                try:
+                    return self._call_once(request, remaining, metadata)
+                except RpcError as exc:
+                    code = exc.code() if callable(exc.code) else exc.code
+                    refused = (code is StatusCode.UNAVAILABLE
+                               and "connection draining" in exc.details()
+                               and not getattr(exc, "_tpurpc_committed",
+                                               False))
+                    if not refused:
+                        raise
             return self._call_once(request, remaining, metadata)
 
         if policy is None:

@@ -303,9 +303,38 @@ class _ServerConnection:
         self._streams: Dict[int, _ServerStream] = {}
         self._lock = threading.Lock()
         self.alive = True
+        self.draining = False  # GOAWAY sent; no new streams accepted
         self._thread = threading.Thread(target=self._read_loop, daemon=True,
                                         name="tpurpc-srv-reader")
         self._thread.start()
+        self._start_age_timer()
+
+    def _start_age_timer(self) -> None:
+        """max_age filter analog (GRPC_ARG_MAX_CONNECTION_AGE_MS, off by
+        default): after the age, GOAWAY the client — it stops opening
+        streams here and dials fresh — then close once in-flight streams
+        drain. Bounds how long one connection monopolizes pooled pairs."""
+        age_ms = get_config().max_connection_age_ms
+        if age_ms <= 0:
+            return
+
+        def expire():
+            with self._lock:
+                if not self.alive or self.draining:
+                    return
+                self.draining = True
+                empty = not self._streams
+            try:
+                self.writer.send(fr.GOAWAY, 0, 0, b"max_connection_age")
+            except (EndpointError, OSError, fr.FrameError):
+                return  # connection already dying
+            if empty:
+                self._shutdown()
+
+        t = threading.Timer(age_ms / 1000.0, expire)
+        t.daemon = True
+        t.start()
+        self._age_timer = t
 
     def _read_loop(self) -> None:
         try:
@@ -355,7 +384,16 @@ class _ServerConnection:
                            queue_depth=get_config().stream_queue_depth,
                            recv_limit=self.server.max_receive_message_length)
         with self._lock:
-            self._streams[f.stream_id] = st
+            if self.draining:
+                rejected = True  # raced the GOAWAY: client dials fresh
+            else:
+                rejected = False
+                self._streams[f.stream_id] = st
+        if rejected:
+            self.writer.send(fr.RST, 0, f.stream_id,
+                             fr.rst_payload(StatusCode.UNAVAILABLE,
+                                            "connection draining (max_age)"))
+            return
         deadline = (None if timeout_us is None
                     else time.monotonic() + timeout_us / 1e6)
         handler = self.server._lookup_intercepted(path, metadata)
@@ -476,6 +514,9 @@ class _ServerConnection:
     def _finish_stream(self, st: _ServerStream) -> None:
         with self._lock:
             self._streams.pop(st.stream_id, None)
+            drained = self.draining and not self._streams and self.alive
+        if drained:
+            self._shutdown()  # last in-flight stream after GOAWAY: close
 
     def _shutdown(self) -> None:
         with self._lock:
@@ -484,6 +525,9 @@ class _ServerConnection:
             self.alive = False
             streams = list(self._streams.values())
             self._streams.clear()
+        timer = getattr(self, "_age_timer", None)
+        if timer is not None:
+            timer.cancel()  # else a dead connection is pinned until its age
         for st in streams:
             st.cancel()
         try:

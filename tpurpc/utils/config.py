@@ -156,6 +156,13 @@ class Config:
     #: How long to wait for the keepalive PONG before declaring the
     #: connection dead (GRPC_ARG_KEEPALIVE_TIMEOUT_MS; default 20 s).
     keepalive_timeout_ms: int = 20000
+    #: client_idle filter analog: close a client connection with no streams
+    #: after this much inactivity; 0/neg disables (the default here —
+    #: gRPC's filter defaults to 30 min when configured).
+    client_idle_timeout_ms: int = 0
+    #: max_age filter analog: server sends GOAWAY on connections older than
+    #: this; in-flight calls drain, new calls dial fresh. 0/neg disables.
+    max_connection_age_ms: int = 0
 
     @property
     def ring_buffer_size(self) -> int:
@@ -230,6 +237,12 @@ class Config:
             keepalive_timeout_ms=_env_int(
                 "TPURPC_KEEPALIVE_TIMEOUT_MS", cls.keepalive_timeout_ms,
                 "GRPC_ARG_KEEPALIVE_TIMEOUT_MS"),
+            client_idle_timeout_ms=_env_int(
+                "TPURPC_CLIENT_IDLE_TIMEOUT_MS", cls.client_idle_timeout_ms,
+                "GRPC_ARG_CLIENT_IDLE_TIMEOUT_MS"),
+            max_connection_age_ms=_env_int(
+                "TPURPC_MAX_CONNECTION_AGE_MS", cls.max_connection_age_ms,
+                "GRPC_ARG_MAX_CONNECTION_AGE_MS"),
         )
 
     @property
