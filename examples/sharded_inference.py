@@ -1,0 +1,103 @@
+"""Sharded model serving: RPC fan-in feeding a pjit'd multi-chip model.
+
+The full TPU-native story in one file — the piece the reference never had
+(its parallelism is RPC-plane only, SURVEY.md §2.7):
+
+* bytes arrive over the swappable transport (`GRPC_PLATFORM_TYPE`) into one
+  host process;
+* `FanInBatcher` stacks concurrent requests into one batch;
+* the model is a MoE transformer jitted over a 5-axis `jax.sharding.Mesh`
+  (dp/pp/sp/tp/ep) — XLA inserts the psum/ppermute/all_to_all collectives
+  that ride ICI on real multi-chip hardware;
+* logits return to each caller over its own connection.
+
+Runs anywhere via the virtual CPU mesh (the same trick the driver's
+dryrun_multichip uses); on a real TPU pod slice the identical program
+scales because axis sizes are compile-time constants, not code paths.
+
+    python examples/sharded_inference.py          # 8 virtual devices
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import threading  # noqa: E402
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+from tpurpc.jaxshim import FanInBatcher, TensorClient, add_tensor_method  # noqa: E402
+from tpurpc.models.transformer import (TransformerConfig, build_forward,  # noqa: E402
+                                       init_params, shard_params)
+from tpurpc.parallel.mesh import build_mesh, factor_mesh  # noqa: E402
+from tpurpc.rpc.channel import Channel  # noqa: E402
+from tpurpc.rpc.server import Server  # noqa: E402
+
+
+def main() -> int:
+    jax.config.update("jax_platforms", "cpu")
+    sizes = factor_mesh(8)
+    mesh = build_mesh(8, sizes=sizes)
+    print(f"mesh axes: {sizes}")
+    cfg = TransformerConfig(vocab=128, d_model=32, n_heads=2 * sizes["tp"],
+                            head_dim=8, d_ff=64, n_layers=2 * sizes["pp"],
+                            n_experts=max(2, sizes["ep"]), capacity_factor=4.0,
+                            n_micro=2)
+    params = shard_params(init_params(jax.random.PRNGKey(0), cfg), cfg, mesh)
+    fwd = build_forward(cfg, mesh)
+
+    B, S = 4 * sizes["dp"] * sizes["ep"], 16 * sizes["sp"]
+
+    def serve(tree):
+        logits = fwd(params, tree["tokens"].astype(np.int32))
+        return {"logits": logits}
+
+    # fixed_bucket: always pad to exactly max_batch=B rows — the pjit'd
+    # forward admits exactly [B, S] (shardings bake the batch size in)
+    batcher = FanInBatcher(serve, max_batch=B, max_delay_s=0.05,
+                           pad_to_bucket=True, fixed_bucket=True)
+    srv = Server(max_workers=2 * B)
+    add_tensor_method(srv, "Generate", batcher)
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    print(f"sharded server on :{port} — model over {len(mesh.devices.ravel())}"
+          " devices")
+
+    rng = np.random.default_rng(0)
+    rows = [rng.integers(0, cfg.vocab, (1, S)).astype(np.int32)
+            for _ in range(B)]
+    outs = [None] * B
+
+    def client(i):
+        with Channel(f"127.0.0.1:{port}") as ch:
+            cli = TensorClient(ch)
+            r = cli.call("Generate", {"tokens": rows[i]}, timeout=120)
+            outs[i] = np.asarray(r["logits"])
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(B)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    assert all(o is not None and o.shape == (1, S, cfg.vocab) for o in outs)
+
+    # cross-check: the batched sharded forward == per-row results the
+    # clients got (fan-in stacking didn't mix rows up)
+    dense = np.asarray(fwd(params, np.concatenate(rows, axis=0)))
+    for i in range(B):
+        np.testing.assert_allclose(outs[i][0], dense[i], rtol=2e-4, atol=2e-4)
+    print(f"OK: {B} concurrent clients, one sharded batch, "
+          f"row-exact logits (batches={batcher.batches_run})")
+    srv.stop(grace=0)
+    batcher.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

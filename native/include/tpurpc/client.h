@@ -48,7 +48,9 @@ void tpr_channel_destroy(tpr_channel *ch);
 int64_t tpr_channel_ping(tpr_channel *ch, int timeout_ms);
 
 /* Start a call. metadata: flat array of 2*n_md C strings (k,v,k,v,...);
- * timeout_ms <= 0 means no deadline. NULL when the channel is dead. */
+ * timeout_ms <= 0 means no deadline. NULL when the channel is dead or the
+ * server sent GOAWAY (max_connection_age drain) — in-flight calls still
+ * complete, but new calls need a fresh tpr_channel_create. */
 tpr_call *tpr_call_start(tpr_channel *ch, const char *method,
                          const char *const *metadata, size_t n_md,
                          int timeout_ms);

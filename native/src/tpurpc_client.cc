@@ -51,6 +51,7 @@ struct Call {
   Clock::time_point deadline{};
   bool has_deadline = false;
   bool cancelled = false;
+  int internal_users = 0;  // threads inside rst_and_finish_locally's send
 };
 
 }  // namespace
@@ -66,6 +67,7 @@ struct tpr_channel {
   std::condition_variable cv;          // signaled on any delivery
   std::map<uint32_t, tpr_call *> streams;
   uint32_t next_stream_id = 1;         // odd, client-initiated (h2 convention)
+  bool draining = false;               // GOAWAY seen: no new calls (mu)
   std::atomic<bool> alive{true};
   uint64_t pong_count = 0;
   std::thread reader;
@@ -128,7 +130,15 @@ struct tpr_channel {
         cv.notify_all();
         continue;
       }
-      if (type == kGoaway) break;
+      if (type == kGoaway) {
+        // Graceful drain (server max_connection_age): stop admitting new
+        // calls but keep reading so in-flight calls finish; the connection
+        // dies when the last one completes (below) or at socket EOF.
+        std::lock_guard<std::mutex> lk(mu);
+        draining = true;
+        if (streams.empty()) break;
+        continue;
+      }
 
       std::unique_lock<std::mutex> lk(mu);
       auto it = streams.find(sid);
@@ -160,8 +170,10 @@ struct tpr_channel {
         c.trailers_seen = true;
         streams.erase(it);
       }
+      bool drained = draining && streams.empty();
       lk.unlock();
       cv.notify_all();
+      if (drained) break;  // last in-flight call on a GOAWAY'd connection
     }
     die();
   }
@@ -177,24 +189,28 @@ struct tpr_channel {
 static void rst_and_finish_locally(tpr_call *c, int code,
                                    const char *details) {
   tpr_channel *ch = c->c.ch;
+  uint32_t sid;
   {
     std::lock_guard<std::mutex> lk(ch->mu);
     if (c->c.cancelled || c->c.trailers_seen) return;
     c->c.cancelled = true;
+    c->c.internal_users++;  // pins `c` across the unlocked send below —
+    sid = c->c.stream_id;   // tpr_call_destroy waits for users to drain
   }
   std::vector<std::pair<std::string, std::string>> md;
   md.emplace_back(":status", std::to_string(TPR_CANCELLED));
   md.emplace_back(":message", details);
   std::string payload = encode_metadata(md);
-  ch->send_frame(kRst, 0, c->c.stream_id, payload.data(), payload.size());
+  ch->send_frame(kRst, 0, sid, payload.data(), payload.size());
   {
     std::lock_guard<std::mutex> lk(ch->mu);
-    ch->streams.erase(c->c.stream_id);
+    ch->streams.erase(sid);
     if (!c->c.trailers_seen) {
       c->c.trailers_seen = true;
       c->c.status_code = code;
       c->c.status_details = details;
     }
+    c->c.internal_users--;
   }
   ch->cv.notify_all();
 }
@@ -277,6 +293,10 @@ tpr_call *tpr_call_start(tpr_channel *ch, const char *method,
   auto *call = new tpr_call();
   {
     std::lock_guard<std::mutex> lk(ch->mu);
+    if (ch->draining) {  // GOAWAY'd: the app must open a fresh channel
+      delete call;
+      return nullptr;
+    }
     call->c.stream_id = ch->next_stream_id;
     ch->next_stream_id += 2;
     call->c.ch = ch;
@@ -404,8 +424,17 @@ void tpr_call_cancel(tpr_call *c) {
 void tpr_call_destroy(tpr_call *c) {
   tpr_channel *ch = c->c.ch;
   {
-    std::lock_guard<std::mutex> lk(ch->mu);
+    std::unique_lock<std::mutex> lk(ch->mu);
     ch->streams.erase(c->c.stream_id);
+    // A cancel/deadline thread may still be inside its (possibly stuck)
+    // RST send holding `c`; freeing now would be a use-after-free when it
+    // resumes. Wait for it to drain — bounded: if the send is wedged past
+    // any reasonable socket stall, deliberately leak the call object (a
+    // leak on a pathological connection beats heap corruption).
+    bool drained = ch->cv.wait_for(lk, std::chrono::seconds(30), [&] {
+      return c->c.internal_users == 0;
+    });
+    if (!drained) return;  // leak: racer still holds `c`
   }
   delete c;
 }

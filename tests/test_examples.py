@@ -44,3 +44,12 @@ def test_secure_aio_inference_example():
                          capture_output=True, text=True, timeout=180)
     assert out.returncode == 0, out.stderr
     assert "secure aio inference ok" in out.stdout
+
+
+def test_sharded_inference_example():
+    """RPC fan-in feeding a pjit'd 8-virtual-device MoE transformer: the
+    full transport→batcher→sharded-model→reply loop, row-exact."""
+    out = subprocess.run([sys.executable, "examples/sharded_inference.py"],
+                         capture_output=True, text=True, timeout=400)
+    assert out.returncode == 0, out.stderr
+    assert "row-exact logits" in out.stdout

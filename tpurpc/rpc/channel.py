@@ -186,6 +186,13 @@ class _Connection:
                 with self._lock:
                     idle = (not self._streams
                             and time.monotonic() - self.last_activity >= window)
+                    if idle:
+                        # Gate BEFORE releasing the lock: open_stream checks
+                        # draining under this same lock, so a call racing
+                        # the idle close gets "draining" (transparently
+                        # re-dialed) instead of a spurious UNAVAILABLE
+                        # after its HEADERS hit a dying connection.
+                        self.draining = True
                 if idle:
                     self._die("client idle timeout")
                     return
