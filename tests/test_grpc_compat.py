@@ -195,3 +195,20 @@ def test_grpcio_deflate_compressed_client(compat):
         mc = ch.unary_unary("/test.Echo/Echo", _ID, _ID)
         payload = b"deflate-me " * 400
         assert mc(payload, timeout=20) == payload
+
+
+def test_graceful_stop_with_h2_connection():
+    """stop(grace) must survive connections speaking the h2 protocol (they
+    have no frame-protocol writer to GOAWAY) and still terminate."""
+    import tpurpc.rpc as rpc
+
+    srv = rpc.Server(max_workers=2)
+    srv.add_method("/test.Echo/Echo",
+                   rpc.unary_unary_rpc_method_handler(lambda b, c: bytes(b)))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    with grpc.insecure_channel(f"127.0.0.1:{port}") as ch:
+        mc = ch.unary_unary("/test.Echo/Echo", _ID, _ID)
+        assert mc(b"hi", timeout=20) == b"hi"
+        ev = srv.stop(grace=1)          # h2 conn live: must not raise
+        assert ev.wait(timeout=10)

@@ -401,3 +401,134 @@ def test_client_idle_timeout_closes_and_redials(monkeypatch):
             assert echo(b"2", timeout=10) == b"2"   # transparent re-dial
     finally:
         srv.stop(grace=0)
+
+
+def test_graceful_stop_drains_inflight_and_refuses_new():
+    """stop(grace): in-flight calls complete through the grace window
+    (GOAWAY announced, grpcio parity); calls started after stop fail fast
+    with UNAVAILABLE instead of hanging."""
+    import time as _time
+
+    srv = rpc.Server(max_workers=4)
+    entered = threading.Event()
+
+    def slow(req, ctx):
+        entered.set()
+        _time.sleep(0.5)
+        return b"done:" + bytes(req)
+
+    srv.add_method("/t.Stop/Slow", rpc.unary_unary_rpc_method_handler(slow))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    ch = rpc.Channel(f"127.0.0.1:{port}")
+    result = {}
+
+    def call():
+        result["v"] = bytes(ch.unary_unary("/t.Stop/Slow")(b"x", timeout=10))
+
+    t = threading.Thread(target=call)
+    t.start()
+    assert entered.wait(timeout=10)
+    stopper = threading.Thread(target=lambda: srv.stop(grace=5))
+    stopper.start()
+    t.join(timeout=10)
+    stopper.join(timeout=10)
+    assert result.get("v") == b"done:x"        # drained, not killed
+    with pytest.raises(rpc.RpcError) as ei:
+        ch.unary_unary("/t.Stop/Slow")(b"y", timeout=3)
+    assert ei.value.code() is StatusCode.UNAVAILABLE
+    ch.close()
+
+
+def test_server_keepalive_reaps_silent_client(monkeypatch):
+    """Symmetric server keepalive: a client that connects, talks once, then
+    goes silent without closing (half-dead peer) is reaped within
+    time+timeout, freeing the server-side connection state."""
+    import socket as _socket
+    import time as _time
+
+    from tpurpc.rpc import frame as fr
+    from tpurpc.utils import config as config_mod
+
+    monkeypatch.setenv("GRPC_ARG_KEEPALIVE_TIME_MS", "150")
+    monkeypatch.setenv("GRPC_ARG_KEEPALIVE_TIMEOUT_MS", "300")
+    config_mod.set_config(None)
+
+    srv = make_server()
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    try:
+        raw = _socket.create_connection(("127.0.0.1", port), timeout=5)
+        raw.sendall(fr.MAGIC)          # valid preface, then dead air
+        _time.sleep(0.3)
+        with srv._lock:
+            assert any(c.alive for c in srv._connections)  # admitted
+        deadline = _time.monotonic() + 5
+        while _time.monotonic() < deadline:
+            with srv._lock:
+                if not any(c.alive for c in srv._connections):
+                    break
+            _time.sleep(0.05)
+        with srv._lock:
+            assert not any(c.alive for c in srv._connections)  # reaped
+        raw.close()
+    finally:
+        srv.stop(grace=0)
+
+
+def test_server_keepalive_spares_ponging_idle_client(monkeypatch):
+    """A client that answers the server's keepalive PINGs — and sends
+    NOTHING else (its own keepalive disabled via a raw responder, so the
+    PONG path itself is what keeps it alive) — must not be reaped."""
+    import socket as _socket
+    import struct as _struct
+    import threading as _threading
+    import time as _time
+
+    from tpurpc.rpc import frame as fr
+    from tpurpc.utils import config as config_mod
+
+    monkeypatch.setenv("GRPC_ARG_KEEPALIVE_TIME_MS", "150")
+    monkeypatch.setenv("GRPC_ARG_KEEPALIVE_TIMEOUT_MS", "300")
+    config_mod.set_config(None)
+
+    srv = make_server()
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    raw = _socket.create_connection(("127.0.0.1", port), timeout=5)
+    stop = _threading.Event()
+
+    def pong_responder():
+        raw.sendall(fr.MAGIC)
+        buf = b""
+        raw.settimeout(0.2)
+        while not stop.is_set():
+            try:
+                data = raw.recv(4096)
+            except _socket.timeout:
+                continue
+            except OSError:
+                return
+            if not data:
+                return
+            buf += data
+            while len(buf) >= 10:
+                ftype, flags, sid, ln = _struct.unpack_from("<BBII", buf)
+                if len(buf) < 10 + ln:
+                    break
+                payload, buf = buf[10:10 + ln], buf[10 + ln:]
+                if ftype == fr.PING:   # answer ONLY pings
+                    raw.sendall(_struct.pack("<BBII", fr.PONG, 0, 0,
+                                             len(payload)) + payload)
+
+    t = _threading.Thread(target=pong_responder, daemon=True)
+    t.start()
+    try:
+        _time.sleep(1.5)               # several ping windows
+        with srv._lock:
+            assert any(c.alive for c in srv._connections)  # spared
+    finally:
+        stop.set()
+        t.join(timeout=2)
+        raw.close()
+        srv.stop(grace=0)

@@ -304,10 +304,64 @@ class _ServerConnection:
         self._lock = threading.Lock()
         self.alive = True
         self.draining = False  # GOAWAY sent; no new streams accepted
+        self.last_frame = time.monotonic()  # any inbound frame refreshes
         self._thread = threading.Thread(target=self._read_loop, daemon=True,
                                         name="tpurpc-srv-reader")
         self._thread.start()
         self._start_age_timer()
+        self._start_keepalive()
+
+    def _start_keepalive(self) -> None:
+        """Server-side keepalive (the same GRPC_ARG_KEEPALIVE_TIME_MS knob,
+        symmetric with the client's): PING a quiet client, close the
+        connection when nothing — not even the PONG — arrives within the
+        timeout. Dead clients otherwise pin pooled pairs/rings forever."""
+        cfg = get_config()
+        if cfg.keepalive_time_ms <= 0:
+            return
+        interval = cfg.keepalive_time_ms / 1000.0
+        timeout = max(0.001, cfg.keepalive_timeout_ms / 1000.0)
+        self._ka_stop = threading.Event()
+
+        def loop():
+            ping_sent_at = None  # monotonic ts of the outstanding PING
+            while self.alive:
+                if self._ka_stop.wait(min(interval, 1.0)):
+                    return
+                with self._lock:
+                    busy = bool(self._streams)
+                if busy:
+                    # In-flight streams: the reader may be deliberately
+                    # stalled on per-stream backpressure (stream_queue_depth)
+                    # with the client's PONGs sitting unread — reaping here
+                    # would kill live transfers. Peer death mid-stream is
+                    # caught by write errors / EOF; keepalive exists for the
+                    # IDLE-and-silent case (dead clients pinning pool state).
+                    ping_sent_at = None
+                    continue
+                if ping_sent_at is not None and self.last_frame > ping_sent_at:
+                    ping_sent_at = None  # the PING was answered (PONG/any
+                    # frame arrived after it): next silence window gets a
+                    # fresh PING instead of timing out on the old one
+                quiet = time.monotonic() - self.last_frame
+                if quiet < interval:
+                    ping_sent_at = None  # frames flowed; window restarts
+                    continue
+                if ping_sent_at is None:
+                    try:  # ONE ping per silence window (gRPC parity)
+                        self.writer.send(fr.PING, 0, 0, b"srv-keepalive")
+                        ping_sent_at = time.monotonic()
+                    except (EndpointError, OSError, fr.FrameError):
+                        self._shutdown()
+                        return
+                elif time.monotonic() - ping_sent_at >= timeout:
+                    trace_server.log("keepalive: client silent %.1fs, closing",
+                                     quiet)
+                    self._shutdown()
+                    return
+
+        threading.Thread(target=loop, daemon=True,
+                         name="tpurpc-srv-keepalive").start()
 
     def _start_age_timer(self) -> None:
         """max_age filter analog (GRPC_ARG_MAX_CONNECTION_AGE_MS, off by
@@ -342,6 +396,7 @@ class _ServerConnection:
                 f = self.reader.read_frame()
                 if f is None:
                     break
+                self.last_frame = time.monotonic()  # client is alive
                 if f is fr.CONSUMED:  # MESSAGE already routed via the sink
                     continue
                 self._dispatch(f)
@@ -528,6 +583,9 @@ class _ServerConnection:
         timer = getattr(self, "_age_timer", None)
         if timer is not None:
             timer.cancel()  # else a dead connection is pinned until its age
+        ka = getattr(self, "_ka_stop", None)
+        if ka is not None:
+            ka.set()  # release the keepalive monitor immediately
         for st in streams:
             st.cancel()
         try:
@@ -753,6 +811,21 @@ class Server:
         with self._lock:
             conns = list(self._connections)
         if grace:
+            # Graceful semantics (grpcio parity): announce shutdown — every
+            # frame-protocol connection gets a GOAWAY so clients stop
+            # opening streams here (in-flight calls keep running through
+            # the grace window). h2 connections have no GOAWAY sender yet;
+            # they still get the drain wait below and close() after it.
+            for conn in conns:
+                writer = getattr(conn, "writer", None)
+                if writer is None:
+                    continue  # h2-protocol connection
+                with conn._lock:
+                    conn.draining = True
+                try:
+                    writer.send(fr.GOAWAY, 0, 0, b"server shutdown")
+                except (EndpointError, OSError, fr.FrameError):
+                    pass  # connection already dying
             deadline = time.monotonic() + grace
             while time.monotonic() < deadline:
                 with self._lock:
