@@ -171,3 +171,55 @@ def test_channel_churn_during_traffic(monkeypatch):
         [t.join(timeout=60) for t in ts]
     assert not errors, errors
     srv.stop(grace=0)
+
+
+def test_churn_with_full_connection_management(monkeypatch):
+    """All connection-management machinery at once, under churn: keepalive
+    both sides + client_idle + max_age, aggressive windows, ring platform.
+    Every call must succeed (GOAWAY/idle races retry transparently); the
+    machinery must neither kill live calls nor leak dead connections."""
+    monkeypatch.setenv("GRPC_PLATFORM_TYPE", "RDMA_BPEV")
+    monkeypatch.setenv("GRPC_ARG_KEEPALIVE_TIME_MS", "200")
+    monkeypatch.setenv("GRPC_ARG_KEEPALIVE_TIMEOUT_MS", "400")
+    monkeypatch.setenv("GRPC_ARG_CLIENT_IDLE_TIMEOUT_MS", "300")
+    monkeypatch.setenv("GRPC_ARG_MAX_CONNECTION_AGE_MS", "500")
+    from tpurpc.utils import config as config_mod
+
+    config_mod.set_config(None)
+
+    srv = tps.Server(max_workers=8)
+
+    def echo(req, ctx):
+        time.sleep(random.uniform(0, 0.02))
+        return bytes(req)
+
+    srv.add_method("/cm.S/Echo", tps.unary_unary_rpc_method_handler(echo))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    stop = threading.Event()
+    errors = []
+    done = [0] * 3
+
+    def worker(idx):
+        try:
+            with tps.Channel(f"127.0.0.1:{port}") as ch:
+                mc = ch.unary_unary("/cm.S/Echo")
+                while not stop.is_set():
+                    payload = os.urandom(256)
+                    assert bytes(mc(payload, timeout=30)) == payload
+                    done[idx] += 1
+                    if done[idx] % 7 == 0:
+                        time.sleep(random.uniform(0, 0.4))  # idle gaps
+        except Exception as exc:
+            errors.append(exc)
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(3)]
+    try:
+        [t.start() for t in ts]
+        time.sleep(6.0)
+    finally:
+        stop.set()
+        [t.join(timeout=60) for t in ts]
+    assert not errors, errors
+    assert all(n > 5 for n in done), done
+    srv.stop(grace=0)

@@ -383,12 +383,25 @@ class _ServerConnection:
             except (EndpointError, OSError, fr.FrameError):
                 return  # connection already dying
             if empty:
-                self._shutdown()
+                self._linger_then_shutdown()
 
         t = threading.Timer(age_ms / 1000.0, expire)
         t.daemon = True
         t.start()
         self._age_timer = t
+
+    #: After GOAWAY, wait this long before closing the socket: a HEADERS
+    #: frame already in flight from a client that hasn't processed the
+    #: GOAWAY yet must be answered with RST "connection draining" (which
+    #: clients retry transparently) — closing instantly turns that race
+    #: into a visible UNAVAILABLE "server closed connection".
+    _GOAWAY_LINGER_S = 1.0
+
+    def _linger_then_shutdown(self) -> None:
+        t = threading.Timer(self._GOAWAY_LINGER_S, self._shutdown)
+        t.daemon = True
+        t.start()
+        self._linger_timer = t
 
     def _read_loop(self) -> None:
         try:
@@ -570,8 +583,10 @@ class _ServerConnection:
         with self._lock:
             self._streams.pop(st.stream_id, None)
             drained = self.draining and not self._streams and self.alive
-        if drained:
-            self._shutdown()  # last in-flight stream after GOAWAY: close
+        if drained and getattr(self, "_linger_timer", None) is None:
+            # last in-flight stream after GOAWAY: close after the linger
+            # (racing HEADERS still get a clean RST meanwhile)
+            self._linger_then_shutdown()
 
     def _shutdown(self) -> None:
         with self._lock:
@@ -586,6 +601,9 @@ class _ServerConnection:
         ka = getattr(self, "_ka_stop", None)
         if ka is not None:
             ka.set()  # release the keepalive monitor immediately
+        linger = getattr(self, "_linger_timer", None)
+        if linger is not None:
+            linger.cancel()
         for st in streams:
             st.cancel()
         try:
