@@ -837,7 +837,14 @@ class Server:
             for conn in conns:
                 writer = getattr(conn, "writer", None)
                 if writer is None:
-                    continue  # h2-protocol connection
+                    # h2-protocol connection: speak h2's own GOAWAY
+                    try:
+                        from tpurpc.wire import h2 as _h2
+
+                        conn._write(_h2.pack_goaway(0, 0, b"server shutdown"))
+                    except Exception:
+                        pass  # connection already dying
+                    continue
                 with conn._lock:
                     conn.draining = True
                 try:
