@@ -285,7 +285,7 @@ def _run_once(env, n_msgs: int, ready_s: float):
             # of interest is the pipe's steady-state capability, not one
             # draw from the jitter distribution.
             best_dt = None
-            for _ in range(2):
+            for _ in range(3):
                 t0 = time.perf_counter()
                 replies = list(cli.duplex("Sink", gen(n_msgs), timeout=600))
                 dt = time.perf_counter() - t0
