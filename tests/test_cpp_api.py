@@ -273,3 +273,41 @@ def test_python_multiplexed_streams_on_cpp_server():
     finally:
         proc.stdin.close()
         proc.wait(timeout=10)
+
+
+def test_cpp_loop_under_asan():
+    """The full native client→server loop compiled with ASan+UBSan: catches
+    use-after-free / data races in the call-lifetime machinery (the
+    cancel/deadline RST path pins call objects; this is its tripwire)."""
+    gxx = shutil.which("g++")
+    if gxx is None:
+        pytest.skip("no g++ toolchain")
+    bd = os.path.join(ROOT, "native", "build")
+    os.makedirs(bd, exist_ok=True)
+    asan_srv = os.path.join(bd, "asan_server")
+    asan_cli = os.path.join(bd, "asan_client")
+    flags = ["-std=c++17", "-O1", "-g", "-fsanitize=address,undefined",
+             "-I", os.path.join(ROOT, "native", "include"), "-lpthread"]
+    subprocess.run([gxx, os.path.join(ROOT, "examples", "cpp_server.cc"),
+                    os.path.join(ROOT, "native", "src", "tpurpc_server.cc"),
+                    *flags, "-o", asan_srv],
+                   check=True, timeout=180, capture_output=True)
+    subprocess.run([gxx, os.path.join(ROOT, "examples", "cpp_client.cc"),
+                    os.path.join(ROOT, "native", "src", "tpurpc_client.cc"),
+                    *flags, "-o", asan_cli],
+                   check=True, timeout=180, capture_output=True)
+    proc = subprocess.Popen([asan_srv], stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE,
+                            stdin=subprocess.PIPE, text=True)
+    try:
+        port = proc.stdout.readline().split()[1]
+        for _ in range(2):
+            out = subprocess.run([asan_cli, port], capture_output=True,
+                                 text=True, timeout=120)
+            assert out.returncode == 0, (out.stdout, out.stderr)
+            assert "ERROR" not in out.stderr, out.stderr
+    finally:
+        proc.stdin.close()
+        proc.wait(timeout=15)
+        srv_err = proc.stderr.read()
+        assert "ERROR" not in srv_err, srv_err
