@@ -199,3 +199,67 @@ def test_aio_abandoned_stream_does_not_wedge_channel():
         return bytes(req)
 
     _run(main())
+
+
+def test_aio_deadline_exceeded():
+    """A stalled handler must surface DEADLINE_EXCEEDED through the asyncio
+    surface (not hang the event loop)."""
+    import threading as _threading
+
+    from tpurpc.rpc.status import RpcError, StatusCode
+
+    release = _threading.Event()
+
+    async def stall(req, ctx):
+        await asyncio.get_event_loop().run_in_executor(
+            None, release.wait, 20)
+        return b"late"
+
+    srv = aio.Server(max_workers=4)
+    srv.add_method("/a.S/Stall", aio.unary_unary_rpc_method_handler(stall))
+    port = srv.add_insecure_port("127.0.0.1:0")
+
+    async def main():
+        await srv.start()
+        try:
+            async with aio.insecure_channel(f"127.0.0.1:{port}") as ch:
+                with pytest.raises(RpcError) as ei:
+                    await ch.unary_unary("/a.S/Stall")(b"x", timeout=0.5)
+                assert ei.value.code() is StatusCode.DEADLINE_EXCEEDED
+        finally:
+            release.set()
+            await srv.stop(grace=0)
+
+    asyncio.run(main())
+
+
+def test_aio_retry_policy_applies():
+    """Channel-level RetryPolicy plumbs through the aio surface."""
+    from tpurpc.rpc.channel import RetryPolicy
+    from tpurpc.rpc.status import StatusCode
+
+    calls = {"n": 0}
+
+    async def flaky(req, ctx):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            ctx.abort(StatusCode.UNAVAILABLE, "flake")
+        return b"ok"
+
+    srv = aio.Server(max_workers=4)
+    srv.add_method("/a.S/Flaky", aio.unary_unary_rpc_method_handler(flaky))
+    port = srv.add_insecure_port("127.0.0.1:0")
+
+    async def main():
+        await srv.start()
+        try:
+            pol = RetryPolicy(max_attempts=5, initial_backoff=0.01)
+            async with aio.insecure_channel(f"127.0.0.1:{port}",
+                                            retry_policy=pol) as ch:
+                assert await ch.unary_unary("/a.S/Flaky")(b"", timeout=10) \
+                    == b"ok"
+            assert calls["n"] == 3
+        finally:
+            await srv.stop(grace=0)
+
+    asyncio.run(main())
