@@ -1,0 +1,13 @@
+"""Pallas device kernels for tpurpc's hot device-side ops.
+
+The host data plane's hot loops live in C++ (``native/src``); the DEVICE
+side's hot op is the HBM ring-window consume — materializing a possibly
+wrapped span of the device-resident receive ring as one contiguous array
+(``tpurpc/tpu/hbm_ring.py``). :mod:`tpurpc.ops.ring_window` fuses that
+into a single Pallas kernel (one d2d pass) instead of the
+slice + slice + concatenate chain XLA would otherwise launch.
+"""
+
+from tpurpc.ops.ring_window import ring_window  # noqa: F401
+
+__all__ = ["ring_window"]

@@ -1,0 +1,116 @@
+"""Pallas kernel: contiguous window of a wrapped device ring, in one pass.
+
+The consume path of the HBM receive ring (``tpurpc/tpu/hbm_ring.py``,
+reference analog ``ring_buffer.cc:122-191`` — whose ``Read`` memcpys out of
+the host ring) needs ``out[i] = ring[(head + i) mod capacity]`` for a span
+that may cross the wrap point. Expressed in jax ops that is
+``dynamic_slice + dynamic_slice + concatenate`` — three kernels and an
+intermediate. This module does it as ONE Pallas kernel, blocked over the
+output, each block combining (at most) the two source segments with
+dynamic rolls:
+
+    for output block at offset o (size B, B | capacity):
+        p1 = (head + o) mod capacity          # block's source start
+        d  = p1 - min(p1, capacity - B)       # overrun past the wrap, 0..B
+        A  = ring[p1 - d : p1 - d + B]        # static-size, dynamic-start
+        Bw = ring[0 : B]
+        out = where(lane < B - d, roll(A, -d), roll(Bw, B - d))
+
+    roll(A, -d)[i]    = ring[p1 + i]            for i <  B - d   (pre-wrap)
+    roll(Bw, B - d)[i] = ring[i - (B - d)]      for i >= B - d   (post-wrap)
+
+Works on ``uint32`` lanes (TPU-friendly), so offsets/lengths must be
+4-byte aligned; the caller falls back to the jax-op chain otherwise.
+Validated against a numpy oracle across wrap phases in interpret mode
+(the CPU test mesh); on real TPU hardware the kernel is opt-in via
+``TPURPC_PALLAS=1`` until it has been profiled there.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+#: output block, in uint32 lanes (4 KiB of ring per block — far under VMEM)
+_BLOCK = 1024
+
+
+def _kernel(head_ref, buf_ref, out_ref, *, block: int, capacity_words: int):
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    pid = pl.program_id(0)
+    o = pid * block
+    p1 = (head_ref[0] + o) % capacity_words
+    p1c = jnp.minimum(p1, capacity_words - block)
+    d = p1 - p1c                      # 0 unless this block crosses the wrap
+    seg_a = buf_ref[pl.dslice(p1c, block)]
+    seg_b = buf_ref[pl.dslice(0, block)]
+    lanes = jax.lax.iota(jnp.int32, block)
+    rolled_a = jnp.roll(seg_a, -d)
+    rolled_b = jnp.roll(seg_b, block - d)
+    out_ref[...] = jnp.where(lanes < block - d, rolled_a, rolled_b)
+
+
+import jax  # noqa: E402  (after the docstring; kernel body uses jax.lax)
+
+
+@functools.partial(jax.jit, static_argnames=("n_words", "interpret"))
+def _ring_window_impl(buf_u8, head_word, *, n_words: int, interpret: bool):
+    """One compiled dispatch: uint8→uint32 bitcast, the pallas gather, and
+    the uint32→uint8 bitcast all fuse under this jit (an eager prologue
+    would re-touch O(capacity) bytes per call)."""
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    buf_words = jax.lax.bitcast_convert_type(
+        buf_u8.reshape(-1, 4), jnp.uint32).reshape(-1)
+    capacity_words = buf_words.shape[0]
+    block = min(_BLOCK, n_words)
+    # pad the requested length up to a whole number of blocks; caller trims
+    padded = ((n_words + block - 1) // block) * block
+    grid = (padded // block,)
+    out = pl.pallas_call(
+        functools.partial(_kernel, block=block,
+                          capacity_words=capacity_words),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),   # head index, scalar-ish
+            pl.BlockSpec(memory_space=pl.ANY),   # whole ring stays in HBM/ANY
+        ],
+        out_specs=pl.BlockSpec((block,), lambda i: (i,)),
+        out_shape=jax.ShapeDtypeStruct((padded,), jnp.uint32),
+        interpret=interpret,
+    )(head_word, buf_words)
+    return jax.lax.bitcast_convert_type(
+        out[:n_words].reshape(-1, 1), jnp.uint8).reshape(-1)
+
+
+def ring_window(buf, head: int, n: int, *, interpret: bool = False):
+    """``out[i] = buf[(head + i) mod capacity]`` as one fused kernel.
+
+    ``buf``: 1-D device uint8 array, power-of-two length. ``head``/``n``
+    must be multiples of 4 (uint32 lanes). Returns a uint8 array of
+    length ``n``. Raises ValueError on alignment the kernel can't take —
+    callers fall back to the jax-op chain.
+    """
+    import jax.numpy as jnp
+
+    capacity = buf.shape[0]
+    if n == 0:
+        return jnp.zeros((0,), jnp.uint8)
+    if capacity % 4 or head % 4 or n % 4:
+        raise ValueError("ring_window needs 4-byte alignment")
+    if n > capacity:
+        raise ValueError(f"window {n} exceeds capacity {capacity}")
+    head_word = jnp.asarray([(head // 4) % (capacity // 4)], jnp.int32)
+    return _ring_window_impl(buf, head_word, n_words=n // 4,
+                             interpret=interpret)
+
+
+def ring_window_reference(buf: np.ndarray, head: int, n: int) -> np.ndarray:
+    """Numpy oracle for the kernel's contract."""
+    capacity = buf.shape[0]
+    idx = (head + np.arange(n)) % capacity
+    return np.asarray(buf)[idx]

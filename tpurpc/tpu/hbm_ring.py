@@ -86,6 +86,35 @@ class HbmRing:
         # n is static per shape; jit caches per payload size
         self._slice = jax.jit(_slice, static_argnums=2)
 
+    def _pallas_window(self, p: int, n: int):
+        """Fused wrapped-window gather (tpurpc.ops.ring_window), or None to
+        use the jax-op chain. Gating: alignment the kernel requires; and on
+        real accelerators the kernel is opt-in (``TPURPC_PALLAS=1``) until
+        profiled there — CPU runs use interpret mode and take it always
+        (it is how the kernel stays continuously tested)."""
+        import os
+
+        if getattr(self, "_pallas_broken", False):
+            return None  # failed once: don't re-pay trace+raise per view
+        if p % 4 or n % 4 or self.capacity % 4:
+            return None
+        on_cpu = self.device.platform == "cpu"
+        if not on_cpu and os.environ.get("TPURPC_PALLAS", "0") != "1":
+            return None
+        try:
+            from tpurpc.ops import ring_window
+
+            return ring_window(self.buf, p, n, interpret=on_cpu)
+        except Exception as exc:
+            # kernel trouble: the slice+concat chain is law. Remember and
+            # warn ONCE — retracing a failing kernel on every wrapped view
+            # (under self._lock, on the consume hot path) is not acceptable.
+            self._pallas_broken = True
+            import warnings
+
+            warnings.warn(f"pallas ring_window disabled after failure: {exc}")
+            return None
+
     # -- producer ------------------------------------------------------------
 
     def writable(self) -> int:
@@ -164,9 +193,15 @@ class HbmRing:
             self._live[(off, n)][0] += 1
             p = off & self._mask
             first = min(n, self.capacity - p)
-            seg = self._slice(self.buf, p, first)
-            if first < n:
-                seg = jnp.concatenate([seg, self._slice(self.buf, 0, n - first)])
+            seg = None
+            if first < n:  # wrapped span: try the fused Pallas gather —
+                # ONE kernel/d2d pass instead of slice+slice+concatenate
+                seg = self._pallas_window(p, n)
+            if seg is None:
+                seg = self._slice(self.buf, p, first)
+                if first < n:
+                    seg = jnp.concatenate(
+                        [seg, self._slice(self.buf, 0, n - first)])
         dt = jnp.dtype(dtype)
         if dt != jnp.uint8:
             seg = lax.bitcast_convert_type(
