@@ -88,19 +88,18 @@ class HbmRing:
 
     def _pallas_window(self, p: int, n: int):
         """Fused wrapped-window gather (tpurpc.ops.ring_window), or None to
-        use the jax-op chain. Gating: alignment the kernel requires; and on
-        real accelerators the kernel is opt-in (``TPURPC_PALLAS=1``) until
-        profiled there — CPU runs use interpret mode and take it always
-        (it is how the kernel stays continuously tested)."""
+        use the jax-op chain. The kernel is validated on real TPU hardware
+        (v5e) and in interpret mode (CPU, where the suite runs it on every
+        wrapped view) — on by default, ``TPURPC_PALLAS=0`` opts out."""
         import os
 
         if getattr(self, "_pallas_broken", False):
             return None  # failed once: don't re-pay trace+raise per view
-        if p % 4 or n % 4 or self.capacity % 4:
+        if p % 4 or n % 4 or self.capacity % 4 or self.capacity < 9 * 512:
+            return None  # alignment/size the kernel can't take
+        if os.environ.get("TPURPC_PALLAS", "1") == "0":
             return None
         on_cpu = self.device.platform == "cpu"
-        if not on_cpu and os.environ.get("TPURPC_PALLAS", "0") != "1":
-            return None
         try:
             from tpurpc.ops import ring_window
 
