@@ -54,7 +54,7 @@ def test_hbm_ring_wrapped_view_takes_kernel_path(monkeypatch):
     ring = HbmRing(capacity=1 << 13, device=jax.devices("cpu")[0])
     rng = np.random.default_rng(3)
     wrapped = 0
-    # 1400 % 4 == 0: spans stay 4-aligned so the kernel path is eligible
+    # 2800 % 4 == 0: spans stay 4-aligned so the kernel path is eligible
     for i in range(5):
         payload = rng.integers(0, 256, 2800).astype(np.uint8)
         off, n = ring.place(payload.tobytes())

@@ -221,3 +221,40 @@ def test_pipeline_grads_match_sequential():
     gs = jax.grad(loss_s)(Ws)
     np.testing.assert_allclose(np.asarray(gp), np.asarray(gs),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_distributed_single_process_bringup():
+    """initialize_cluster is a no-op single-process; global_mesh factors
+    the full (virtual 8-device) cluster with dp outermost so DCN-crossing
+    axes are the tolerant ones. The same entry points a multi-host launch
+    uses (TPURPC_COORDINATOR et al.) — exercised in the 1-process limit."""
+    import numpy as np
+
+    import jax
+
+    from tpurpc.parallel.distributed import (global_mesh, initialize_cluster,
+                                             process_count)
+
+    assert initialize_cluster() == 0
+    assert initialize_cluster() == 0          # idempotent
+    assert process_count() == 1
+    mesh, sizes = global_mesh()
+    assert int(np.prod(list(sizes.values()))) == len(jax.devices())
+    assert tuple(mesh.axis_names) == ("dp", "pp", "sp", "tp", "ep")
+    # the mesh is usable: a psum over it compiles and runs
+    from jax.sharding import PartitionSpec as P
+
+    from tpurpc.parallel.mesh import shard_map
+
+    def allsum(x):
+        import jax.numpy as jnp
+        s = x
+        for ax in ("dp", "pp", "sp", "tp", "ep"):
+            s = jax.lax.psum(s, ax)
+        return s
+
+    f = shard_map(allsum, mesh=mesh, in_specs=(P(("dp", "ep")),),
+                  out_specs=P(("dp", "ep")))
+    x = np.ones((8, 4), np.float32)
+    out = np.asarray(jax.jit(f)(x))
+    assert np.allclose(out, len(jax.devices()) * np.ones_like(out) / 1)

@@ -87,28 +87,36 @@ def _kernel(head_ref, buf_ref, out_ref, scr_a, scr_b, sem_a, sem_b,
     row1 = p1 // _C
     row1c = jnp.minimum(row1, rows - (_R + 1))   # clamp: 9 rows must fit
     d_rows = row1 - row1c
+    # pre-wrap length for this block; only the (at most one) block whose
+    # window crosses the wrap ever selects from window B
+    pre = capacity_words - p1
     # window A: 9 rows from the (clamped) source start; covers the
     # pre-wrap part of the block at flat offset s = d_rows*C + p1%C < 9C
     cp_a = pltpu.make_async_copy(
         buf_ref.at[pl.dslice(row1c, _R + 1), :],
         scr_a.at[pl.dslice(0, _R + 1), :], sem_a)
     cp_a.start()
-    # window B: 9 rows from ring start; covers the post-wrap part
-    cp_b = pltpu.make_async_copy(
-        buf_ref.at[pl.dslice(0, _R + 1), :],
-        scr_b.at[pl.dslice(0, _R + 1), :], sem_b)
-    cp_b.start()
+
+    # window B: 9 rows from ring start; covers the post-wrap part. Skipped
+    # for non-crossing blocks (the common case) — its lanes would be fully
+    # discarded, so the DMA would be pure wasted bandwidth.
+    @pl.when(pre < block)
+    def _copy_wrap_window():
+        cp_b = pltpu.make_async_copy(
+            buf_ref.at[pl.dslice(0, _R + 1), :],
+            scr_b.at[pl.dslice(0, _R + 1), :], sem_b)
+        cp_b.start()
+        cp_b.wait()
+
     cp_a.wait()
-    cp_b.wait()
 
     lanes = jax.lax.broadcasted_iota(jnp.int32, (_SCRATCH_ROWS, _C), 1)
     flat = (jax.lax.broadcasted_iota(jnp.int32, (_SCRATCH_ROWS, _C), 0) * _C
             + lanes)
     s_a = d_rows * _C + p1 % _C
     a = _flat_roll_neg(scr_a[...], s_a, lanes)
-    # pre-wrap length for this block; when >= block, B is never selected
-    # and its (possibly garbage-rolled) lanes are discarded by the select
-    pre = capacity_words - p1
+    # when pre >= block, B is never selected and its (stale-scratch,
+    # garbage-rolled) lanes are discarded by the select below
     b = _flat_roll_pos(scr_b[...], jax.lax.rem(pre, capacity_words), lanes)
     out_ref[...] = jnp.where(flat < pre, a, b)[:_R]
 

@@ -1,0 +1,14 @@
+"""TPU scale-out: mesh construction, parallel forms, multi-host bring-up.
+
+Single-host and multi-host run the SAME programs: build a 5-axis mesh
+(``mesh.build_mesh``), shard with the provided specs, and XLA inserts the
+collectives — ICI inside a slice, DCN across hosts once
+``distributed.initialize_cluster`` has joined the processes.
+"""
+
+from tpurpc.parallel.distributed import (global_mesh, initialize_cluster,
+                                         process_count)
+from tpurpc.parallel.mesh import build_mesh, factor_mesh
+
+__all__ = ["build_mesh", "factor_mesh", "global_mesh",
+           "initialize_cluster", "process_count"]

@@ -97,6 +97,8 @@ class HbmRing:
             return None  # failed once: don't re-pay trace+raise per view
         if p % 4 or n % 4 or self.capacity % 4 or self.capacity < 9 * 512:
             return None  # alignment/size the kernel can't take
+        if self.device.platform not in ("cpu", "tpu"):
+            return None  # validated on TPU (+ CPU interpret) only
         if os.environ.get("TPURPC_PALLAS", "1") == "0":
             return None
         on_cpu = self.device.platform == "cpu"
