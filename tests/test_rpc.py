@@ -488,8 +488,11 @@ def test_server_keepalive_spares_ponging_idle_client(monkeypatch):
     from tpurpc.rpc import frame as fr
     from tpurpc.utils import config as config_mod
 
-    monkeypatch.setenv("GRPC_ARG_KEEPALIVE_TIME_MS", "150")
-    monkeypatch.setenv("GRPC_ARG_KEEPALIVE_TIMEOUT_MS", "300")
+    # generous timeout: the PONG responder is a Python thread that polls at
+    # 200 ms — on a loaded 1-core CI box it can be starved for over a
+    # second, which must not read as a dead peer
+    monkeypatch.setenv("GRPC_ARG_KEEPALIVE_TIME_MS", "300")
+    monkeypatch.setenv("GRPC_ARG_KEEPALIVE_TIMEOUT_MS", "3000")
     config_mod.set_config(None)
 
     srv = make_server()
