@@ -22,24 +22,31 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BIN = os.path.join(ROOT, "native", "build", "cpp_client_example")
 
 
-def _build_example():
+def _build_cpp(out_bin, example, native_src, headers):
+    """Compile one example+runtime pair, skipping when the binary is newer
+    than every source/header it depends on."""
     gxx = shutil.which("g++")
     if gxx is None:
         pytest.skip("no g++ toolchain")
-    os.makedirs(os.path.dirname(BIN), exist_ok=True)
-    srcs = [os.path.join(ROOT, "examples", "cpp_client.cc"),
-            os.path.join(ROOT, "native", "src", "tpurpc_client.cc")]
-    deps = srcs + [os.path.join(ROOT, "native", "src", "framing_common.h"),
-                   os.path.join(ROOT, "native", "include", "tpurpc", "client.h"),
-                   os.path.join(ROOT, "native", "include", "tpurpc", "client.hpp")]
-    if (os.path.exists(BIN)
-            and all(os.path.getmtime(BIN) > os.path.getmtime(d) for d in deps)):
+    os.makedirs(os.path.dirname(out_bin), exist_ok=True)
+    srcs = [os.path.join(ROOT, "examples", example),
+            os.path.join(ROOT, "native", "src", native_src)]
+    deps = srcs + [os.path.join(ROOT, "native", "src", "framing_common.h")] + [
+        os.path.join(ROOT, "native", "include", "tpurpc", h) for h in headers]
+    if (os.path.exists(out_bin)
+            and all(os.path.getmtime(out_bin) > os.path.getmtime(d)
+                    for d in deps)):
         return
     subprocess.run(
         [gxx, "-std=c++17", "-O2", *srcs,
          "-I", os.path.join(ROOT, "native", "include"),
-         "-lpthread", "-o", BIN],
+         "-lpthread", "-o", out_bin],
         check=True, timeout=180, capture_output=True)
+
+
+def _build_example():
+    _build_cpp(BIN, "cpp_client.cc", "tpurpc_client.cc",
+               ["client.h", "client.hpp"])
 
 
 def _server():
@@ -153,24 +160,8 @@ SRV_BIN = os.path.join(ROOT, "native", "build", "cpp_server_example")
 
 
 def _build_server_example():
-    gxx = shutil.which("g++")
-    if gxx is None:
-        pytest.skip("no g++ toolchain")
-    os.makedirs(os.path.dirname(SRV_BIN), exist_ok=True)
-    srcs = [os.path.join(ROOT, "examples", "cpp_server.cc"),
-            os.path.join(ROOT, "native", "src", "tpurpc_server.cc")]
-    deps = srcs + [os.path.join(ROOT, "native", "src", "framing_common.h"),
-                   os.path.join(ROOT, "native", "include", "tpurpc", "server.h"),
-                   os.path.join(ROOT, "native", "include", "tpurpc", "server.hpp")]
-    if (os.path.exists(SRV_BIN)
-            and all(os.path.getmtime(SRV_BIN) > os.path.getmtime(d)
-                    for d in deps)):
-        return
-    subprocess.run(
-        [gxx, "-std=c++17", "-O2", *srcs,
-         "-I", os.path.join(ROOT, "native", "include"),
-         "-lpthread", "-o", SRV_BIN],
-        check=True, timeout=180, capture_output=True)
+    _build_cpp(SRV_BIN, "cpp_server.cc", "tpurpc_server.cc",
+               ["server.h", "server.hpp"])
 
 
 def test_python_client_against_cpp_server():

@@ -647,6 +647,11 @@ class Call:
 _NO_REQUEST = object()
 
 
+def _status_of(exc: RpcError) -> StatusCode:
+    """RpcError's grpcio-style ``code()`` method, tolerant of plain attrs."""
+    return exc.code() if callable(exc.code) else exc.code
+
+
 class RetryPolicy:
     """Client retry policy — the reference inherits gRPC's service-config
     retries (retryPolicy: maxAttempts/backoff/retryableStatusCodes, applied
@@ -683,7 +688,7 @@ class RetryPolicy:
                 return attempt_fn()
             except RpcError as exc:
                 attempt += 1
-                code = exc.code() if callable(exc.code) else exc.code
+                code = _status_of(exc)
                 if (attempt >= self.max_attempts
                         or code not in self.retryable_codes
                         or getattr(exc, "_tpurpc_committed", False)):
@@ -821,8 +826,7 @@ class UnaryUnary(_MultiCallable):
                 try:
                     return self._call_once(request, remaining, metadata)
                 except RpcError as exc:
-                    code = exc.code() if callable(exc.code) else exc.code
-                    refused = (code is StatusCode.UNAVAILABLE
+                    refused = (_status_of(exc) is StatusCode.UNAVAILABLE
                                and "connection draining" in exc.details()
                                and not getattr(exc, "_tpurpc_committed",
                                                False))

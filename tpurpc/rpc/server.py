@@ -834,13 +834,13 @@ class Server:
             # opening streams here (in-flight calls keep running through
             # the grace window). h2 connections have no GOAWAY sender yet;
             # they still get the drain wait below and close() after it.
+            from tpurpc.wire import h2 as _h2
+
             for conn in conns:
                 writer = getattr(conn, "writer", None)
                 if writer is None:
                     # h2-protocol connection: speak h2's own GOAWAY
                     try:
-                        from tpurpc.wire import h2 as _h2
-
                         conn._write(_h2.pack_goaway(0, 0, b"server shutdown"))
                     except Exception:
                         pass  # connection already dying
