@@ -161,3 +161,15 @@ def test_raw_bench_modes():
                        "--ring-kb", "1024"])
     parsed = _json.loads(buf.getvalue())
     assert parsed["metric"] == "raw_ring_bandwidth"
+
+
+def test_sweep_cell_runs():
+    """One sweep cell end to end: fresh-process server under the cell's
+    platform, JSON result with the reference-comparable fields."""
+    from tpurpc.bench.sweep import run_cell
+
+    cell = run_cell("TCP", 64, duration=1.0, concurrency=1, streaming=False)
+    assert cell["rpcs"] > 0
+    assert cell["rate_rps"] > 0
+    assert {"p50", "p95", "p99"} <= set(cell["rtt_us"])
+    assert cell["platform"] == "TCP" and cell["size"] == 64

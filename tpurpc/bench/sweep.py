@@ -1,0 +1,105 @@
+"""Parameter-sweep driver over the micro-benchmark: the reference's run.sh.
+
+The reference validates with shell sweeps over its micro-bench
+(``examples/cpp/helloworld.benchmark/benchmark/run.sh`` parameterizes
+platform × size × clients and archives the logs ``draw/draw_bandwidth.py``
+plots — SURVEY.md §2.6/§6). This module is that rig as one command: each
+cell runs a fresh server subprocess with the cell's ``GRPC_PLATFORM_TYPE``
+(config is read once per process — sweeping inside one process would lie),
+drives ``tpurpc.bench.micro``'s client in-process, and emits one
+JSON line per cell plus a final table.
+
+    python -m tpurpc.bench.sweep --platforms TCP,RDMA_BPEV \\
+        --sizes 64,65536 --duration 3
+
+Reference-comparable fields: rate_rps, tx_mbps, rtt p50/p95/p99 (µs).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+_SERVER = """
+from tpurpc.bench import micro
+srv = micro.run_server(port=0)
+print("PORT", srv.bound_ports[0], flush=True)
+srv.wait_for_termination(timeout=600)
+"""
+
+
+def run_cell(platform: str, size: int, duration: float, concurrency: int,
+             streaming: bool) -> dict:
+    env = dict(os.environ)
+    env["GRPC_PLATFORM_TYPE"] = platform
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    srv = subprocess.Popen([sys.executable, "-u", "-c", _SERVER],
+                           stdout=subprocess.PIPE, text=True, env=env)
+    try:
+        line = srv.stdout.readline()
+        if not line.startswith("PORT"):
+            rc = srv.poll()
+            raise RuntimeError(
+                f"sweep server failed to start (rc={rc}): {line!r}")
+        port = int(line.split()[1])
+        # the CLIENT must also run under the cell's platform: subprocess it
+        code = (
+            "import json, sys\n"
+            "from tpurpc.bench.micro import run_client\n"
+            "import io\n"
+            f"r = run_client('127.0.0.1:{port}', req_size={size},"
+            f" streaming={streaming}, duration={duration},"
+            f" concurrency={concurrency}, out=io.StringIO())\n"
+            "r.pop('histogram', None)\n"
+            "print(json.dumps(r))\n"
+        )
+        out = subprocess.run([sys.executable, "-u", "-c", code],
+                             capture_output=True, text=True, env=env,
+                             timeout=duration + 120)
+        if out.returncode != 0:
+            raise RuntimeError(f"client failed: {out.stderr[-500:]}")
+        cell = json.loads(out.stdout.strip().splitlines()[-1])
+    finally:
+        srv.kill()
+        srv.wait(timeout=10)  # no zombie/fd leak per cell
+        srv.stdout.close()
+    cell.update({"platform": platform, "size": size,
+                 "concurrency": concurrency, "streaming": streaming})
+    return cell
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tpurpc.bench.sweep")
+    ap.add_argument("--platforms", default="TCP,RDMA_BPEV")
+    ap.add_argument("--sizes", default="64,65536")
+    ap.add_argument("--duration", type=float, default=3.0)
+    ap.add_argument("--concurrency", type=int, default=1)
+    ap.add_argument("--streaming", action="store_true")
+    args = ap.parse_args(argv)
+
+    cells = []
+    for platform in args.platforms.split(","):
+        for size in (int(s) for s in args.sizes.split(",")):
+            t0 = time.time()
+            cell = run_cell(platform.strip(), size, args.duration,
+                            args.concurrency, args.streaming)
+            cell["wall_s"] = round(time.time() - t0, 1)
+            print(json.dumps(cell), flush=True)
+            cells.append(cell)
+
+    # reference-log-style closing table
+    print(f"\n{'platform':<12}{'size':>8}{'RPC/s':>12}{'Mb/s':>10}"
+          f"{'p50us':>8}{'p99us':>8}")
+    for c in cells:
+        print(f"{c['platform']:<12}{c['size']:>8}{c['rate_rps']:>12.0f}"
+              f"{c['tx_mbps']:>10.1f}{c['rtt_us']['p50']:>8.0f}"
+              f"{c['rtt_us']['p99']:>8.0f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
