@@ -47,3 +47,44 @@ def test_counters_and_snapshot():
         assert any("/t.S/Ok" in s["methods"] for s in remote["servers"])
     finally:
         srv.stop(grace=0)
+
+
+def test_channelz_exposes_connection_management_state():
+    """The new keepalive/max_age machinery is observable: draining counts
+    and active stream totals appear in both server and channel views."""
+    import threading
+    import time as _time
+
+    import tpurpc.rpc as rpc
+    from tpurpc.rpc import channelz
+
+    srv = rpc.Server(max_workers=2)
+    release = threading.Event()
+
+    def slow(req, ctx):
+        release.wait(timeout=20)
+        return b"ok"
+
+    srv.add_method("/z.S/Slow", rpc.unary_unary_rpc_method_handler(slow))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    try:
+        with rpc.Channel(f"127.0.0.1:{port}") as ch:
+            t = threading.Thread(
+                target=lambda: ch.unary_unary("/z.S/Slow")(b"", timeout=30))
+            t.start()
+            deadline = _time.monotonic() + 10
+            while _time.monotonic() < deadline:
+                sinfo = channelz.server_info(srv)
+                cinfo = channelz.channel_info(ch)
+                if sinfo["active_streams"] >= 1 and cinfo["active_streams"] >= 1:
+                    break
+                _time.sleep(0.02)
+            assert sinfo["active_streams"] >= 1
+            assert cinfo["active_streams"] >= 1
+            assert sinfo["draining_connections"] == 0
+            release.set()
+            t.join(timeout=10)
+    finally:
+        release.set()
+        srv.stop(grace=0)

@@ -60,10 +60,15 @@ def register_channel(ch) -> None:
 
 
 def server_info(srv) -> Dict:
+    conns = list(getattr(srv, "_connections", []))
     info = {
         "ports": list(getattr(srv, "bound_ports", [])),
         "methods": sorted(srv._methods.keys()),
-        "connections": len(srv._connections),
+        "connections": len(conns),
+        # connection-management state (keepalive/max_age drain visibility)
+        "draining_connections": sum(
+            1 for c in conns if getattr(c, "draining", False)),
+        "active_streams": sum(len(getattr(c, "_streams", ())) for c in conns),
         "interceptors": len(getattr(srv, "interceptors", [])),
     }
     counters = getattr(srv, "call_counters", None)
@@ -74,10 +79,12 @@ def server_info(srv) -> Dict:
 
 def channel_info(ch) -> Dict:
     subs = getattr(ch, "_subchannels", [])
+    live = [s._conn for s in subs if s._conn is not None and s._conn.alive]
     return {
         "subchannels": len(subs),
-        "connected": sum(1 for s in subs
-                         if s._conn is not None and s._conn.alive),
+        "connected": len(live),
+        "draining": sum(1 for c in live if getattr(c, "draining", False)),
+        "active_streams": sum(len(getattr(c, "_streams", ())) for c in live),
         "lb_policy": getattr(getattr(ch, "_policy", None), "name", "?"),
         "closed": ch._is_closed(),
     }
