@@ -269,3 +269,43 @@ def test_retry_never_replays_committed_call():
         assert calls["n"] == 1                 # executed exactly once
     finally:
         srv.stop(grace=0)
+
+
+def test_retry_server_streaming_before_first_message():
+    """Server-streaming retry rule: a stream failing BEFORE its first
+    response replays; one that fails mid-stream (committed) does not."""
+    import pytest as _pytest
+
+    from tpurpc.rpc.status import RpcError, StatusCode
+
+    srv = rpc.Server(max_workers=2)
+    calls = {"early": 0, "mid": 0}
+
+    def early_fail(req, ctx):
+        calls["early"] += 1
+        if calls["early"] <= 2:
+            ctx.abort(StatusCode.UNAVAILABLE, "not yet")
+        for i in range(3):
+            yield b"m%d" % i
+
+    def mid_fail(req, ctx):
+        calls["mid"] += 1
+        yield b"first"
+        ctx.abort(StatusCode.UNAVAILABLE, "mid-stream")
+
+    srv.add_method("/t.S/Early", rpc.unary_stream_rpc_method_handler(early_fail))
+    srv.add_method("/t.S/Mid", rpc.unary_stream_rpc_method_handler(mid_fail))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    try:
+        pol = rpc.RetryPolicy(max_attempts=4, initial_backoff=0.01)
+        with rpc.Channel(f"127.0.0.1:{port}", retry_policy=pol) as ch:
+            got = [bytes(m) for m in ch.unary_stream("/t.S/Early")(b"", timeout=10)]
+            assert got == [b"m0", b"m1", b"m2"]
+            assert calls["early"] == 3          # two retries then success
+
+            with _pytest.raises(RpcError):
+                list(ch.unary_stream("/t.S/Mid")(b"", timeout=10))
+            assert calls["mid"] == 1            # committed: never replayed
+    finally:
+        srv.stop(grace=0)

@@ -677,10 +677,18 @@ class RetryPolicy:
         self.backoff_multiplier = backoff_multiplier
         self.retryable_codes = tuple(retryable_codes)
 
+    def next_sleep(self, backoff: float,
+                   deadline: Optional[float]) -> Optional[float]:
+        """The jittered (±20%, lib/backoff style) clamped sleep for the next
+        retry, or None when it would outlive the call deadline."""
+        sleep = min(backoff, self.max_backoff)
+        sleep *= 1.0 + random.uniform(-0.2, 0.2)
+        if deadline is not None and time.monotonic() + sleep >= deadline:
+            return None
+        return sleep
+
     def run(self, deadline: Optional[float], attempt_fn):
-        """Drive attempt_fn() under this policy. Backoff is jittered ±20%
-        (lib/backoff's jitter), truncated so a sleep never outlives the
-        call deadline."""
+        """Drive attempt_fn() under this policy."""
         backoff = self.initial_backoff
         attempt = 0
         while True:
@@ -693,10 +701,8 @@ class RetryPolicy:
                         or code not in self.retryable_codes
                         or getattr(exc, "_tpurpc_committed", False)):
                     raise
-                sleep = min(backoff, self.max_backoff)
-                sleep *= 1.0 + random.uniform(-0.2, 0.2)
-                if (deadline is not None
-                        and time.monotonic() + sleep >= deadline):
+                sleep = self.next_sleep(backoff, deadline)
+                if sleep is None:
                     raise
                 time.sleep(sleep)
                 backoff *= self.backoff_multiplier
@@ -889,12 +895,91 @@ class UnaryUnary(_MultiCallable):
         return fut
 
 
+class _RetryingStreamCall:
+    """Call-shaped wrapper retrying a server-streaming RPC that failed
+    BEFORE its first response message (gRPC's retry rule for streams: once
+    anything was delivered the call is committed). The request is unary,
+    so replay is always possible. Start failures (dial, admission) consume
+    retry attempts exactly like stream failures; one attempt/backoff
+    budget spans the whole call. Cancellation during a backoff sleep stops
+    further replays."""
+
+    def __init__(self, mc: "UnaryStream", request, timeout, metadata,
+                 policy: "RetryPolicy"):
+        self._inner: Optional[Call] = None  # first: __getattr__ recursion guard
+        self._mc = mc
+        self._request = request
+        self._deadline = (None if timeout is None
+                          else time.monotonic() + timeout)
+        self._metadata = metadata
+        self._policy = policy
+        self._attempt = 0
+        self._backoff = policy.initial_backoff
+        self._cancelled = False
+        self._start_with_retry()  # eager start, grpcio semantics
+
+    def _handle_failure(self, exc: RpcError, committed: bool) -> None:
+        """Count the attempt; sleep for the backoff; or re-raise."""
+        self._attempt += 1
+        if (self._cancelled or committed
+                or self._attempt >= self._policy.max_attempts
+                or _status_of(exc) not in self._policy.retryable_codes):
+            raise exc
+        sleep = self._policy.next_sleep(self._backoff, self._deadline)
+        if sleep is None:
+            raise exc
+        time.sleep(sleep)
+        self._backoff *= self._policy.backoff_multiplier
+        if self._cancelled:  # cancelled while we slept: stop replaying
+            raise exc
+
+    def _start_with_retry(self) -> None:
+        while True:
+            try:
+                remaining = (None if self._deadline is None
+                             else max(0.0, self._deadline - time.monotonic()))
+                _, _, self._inner = self._mc._start(
+                    self._metadata, remaining, first_request=self._request)
+                return
+            except RpcError as exc:
+                self._handle_failure(exc, committed=False)
+
+    def messages(self) -> Iterator[object]:
+        while True:
+            delivered = False
+            try:
+                for msg in self._inner.messages():
+                    delivered = True
+                    yield msg
+                return
+            except RpcError as exc:
+                self._handle_failure(exc, committed=delivered)
+                self._start_with_retry()
+
+    def __iter__(self):
+        return self.messages()
+
+    def cancel(self):
+        self._cancelled = True
+        if self._inner is not None:
+            self._inner.cancel()
+
+    def __getattr__(self, name):
+        # full Call-surface delegation (time_remaining, device_ring, ...)
+        # to the CURRENT attempt's call
+        return getattr(self._inner, name)
+
+
 class UnaryStream(_MultiCallable):
     def __call__(self, request, timeout: Optional[float] = None,
-                 metadata: Optional[Metadata] = None, **grpcio_kw) -> Call:
+                 metadata: Optional[Metadata] = None, **grpcio_kw):
         _reject_call_credentials(grpcio_kw)
-        conn, st, call = self._start(metadata, timeout, first_request=request)
-        return call
+        policy = self._channel.retry_policy
+        if policy is None:
+            conn, st, call = self._start(metadata, timeout,
+                                         first_request=request)
+            return call
+        return _RetryingStreamCall(self, request, timeout, metadata, policy)
 
 
 class StreamUnary(_MultiCallable):
