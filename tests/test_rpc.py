@@ -239,13 +239,19 @@ def test_server_gone_maps_unavailable_then_reconnects():
     srv2.add_insecure_port(f"127.0.0.1:{port}")
     srv2.start()
     deadline = time.monotonic() + 60  # generous: shared-core CI jitter
+    attempts = 0
     while True:
         try:
             assert echo(b"c", timeout=5) == b"c"
             break
-        except rpc.RpcError:
+        except rpc.RpcError as exc:
+            attempts += 1
             if time.monotonic() > deadline:
-                raise
+                # rare load-dependent flake: make the escape diagnosable
+                raise AssertionError(
+                    f"reconnect never succeeded: {attempts} attempts over "
+                    f"60s, last error {exc!r}, subchannel "
+                    f"{ch._subchannels[0].__dict__}") from exc
             time.sleep(0.1)
     ch.close()
     srv2.stop(grace=0.2)
