@@ -120,10 +120,34 @@ if os.environ.get("TPURPC_BENCH_SERVING", "1") == "1":
     warm = np.zeros((MAXB, img, img, 3), np.float32)
     jax.tree_util.tree_map(lambda x: x.block_until_ready(),
                            infer(variables, warm))
+    # Analytic per-inference FLOPs straight from XLA's cost model (exact for
+    # the compiled graph; no hand-derived constant to go stale), and a
+    # device-only batched-inference rate: MFU of the *compute path* with the
+    # RPC/tunnel out of the picture. Serving QPS divided by the same peak
+    # gives end-to-end MFU; the gap between the two is transport cost.
+    flops_per_inf = 0.0
+    dev_qps = 0.0
+    try:
+        ca = (jax.jit(make_infer_fn(model))
+              .lower(variables, warm).compile().cost_analysis())
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        flops_per_inf = float(ca.get("flops", 0.0)) / MAXB
+        warm_dev = jax.device_put(warm)  # exclude h2d from the compute rate
+        reps, t0 = 0, time.time()
+        while time.time() - t0 < 1.0:
+            jax.tree_util.tree_map(lambda x: x.block_until_ready(),
+                                   infer(variables, warm_dev))
+            reps += 1
+        dev_qps = reps * MAXB / (time.time() - t0)
+    except Exception as exc:  # cost model is auxiliary: report, don't fail
+        print("MFUERR", repr(exc), file=sys.stderr, flush=True)
+    print("FLOPS", flops_per_inf, round(dev_qps, 1), flush=True)
     # stdout: the client parses this line (single source of model/img truth)
     print("SERVING", model_name, img, flush=True)
 
 srv.start()
+print("DEVKIND", getattr(dev, "device_kind", dev.platform), flush=True)
 print("READY", dev.platform, ("serving" if batcher else "noserving"),
       flush=True)
 srv.wait_for_termination(timeout=1200)
@@ -280,31 +304,44 @@ def _run_once(env, n_msgs: int, ready_s: float):
             # warmup RPC: decode jit + ring bring-up out of the timing
             list(cli.duplex("Sink", gen(2), timeout=300))
 
-            # Two timed rounds, report the better: the device link's
-            # bandwidth wobbles run to run (tunnel weather), and the metric
-            # of interest is the pipe's steady-state capability, not one
-            # draw from the jitter distribution.
-            best_dt = None
+            # Three timed rounds; report the median (comparable across
+            # rounds, robust to one bad draw of tunnel weather) and keep the
+            # best alongside it in the detail record for ceiling-spotting.
+            dts = []
             for _ in range(3):
                 t0 = time.perf_counter()
                 replies = list(cli.duplex("Sink", gen(n_msgs), timeout=600))
                 dt = time.perf_counter() - t0
                 total = int(np.asarray(replies[-1]["bytes"]).ravel()[0])
                 assert total == n_msgs * payload.nbytes, (total, n_msgs)
-                if best_dt is None or dt < best_dt:
-                    best_dt = dt
-            dt = best_dt
+                dts.append(dt)
+            dts.sort()
+            dt = dts[len(dts) // 2]  # median
+            globals()["_LAST_STREAM_DTS"] = dts  # best/median detail for JSON
 
         serving = None
+        extras = {"stream_dts": [round(x, 3) for x in
+                                 globals().get("_LAST_STREAM_DTS", [])]}
+        try:
+            extras["device_kind"] = srv.wait_line("DEVKIND", 5).split(
+                " ", 1)[1].strip()
+        except Exception:
+            pass
         if serving_on:
             try:
                 # the server's SERVING line (printed before READY) is the
                 # single source of truth for the model/image geometry
                 _, model, img = srv.wait_line("SERVING", 10).split()
+                try:
+                    _, flops, dev_qps = srv.wait_line("FLOPS", 5).split()
+                    extras["model_flops_per_inference"] = float(flops)
+                    extras["device_infer_qps"] = float(dev_qps)
+                except Exception:
+                    pass
                 serving = _serving_phase(port, model, int(img))
             except Exception as exc:  # serving is auxiliary: report, don't fail
                 sys.stderr.write(f"serving phase failed: {exc}\n")
-        return total / dt / 1e9, platform, serving
+        return total / dt / 1e9, platform, serving, extras
     except Exception:
         sys.stderr.write(srv.stderr_tail() + "\n")
         raise
@@ -327,15 +364,17 @@ def main() -> None:
     env["PYTHONPATH"] = (os.path.dirname(os.path.abspath(__file__)) +
                          os.pathsep + env.get("PYTHONPATH", ""))
 
+    fallback = False
     try:
-        gbps, platform, serving = _run_once(env, n_msgs, ready_s)
+        gbps, platform, serving, extras = _run_once(env, n_msgs, ready_s)
     except (TimeoutError, RuntimeError) as exc:
         if env.get("TPURPC_BENCH_CPU") == "1":
             raise
         sys.stderr.write(f"default-platform bench failed ({exc});"
                          f" retrying with JAX_PLATFORMS=cpu\n")
         env["TPURPC_BENCH_CPU"] = "1"
-        gbps, platform, serving = _run_once(env, n_msgs, ready_s)
+        fallback = True
+        gbps, platform, serving, extras = _run_once(env, n_msgs, ready_s)
 
     out = {
         "metric": "stream_4MiB_tensors_to_jax_Array",
@@ -344,6 +383,14 @@ def main() -> None:
         "vs_baseline": round(gbps / BASELINE_GBPS, 3),
         "jax_platform": platform,
     }
+    if fallback:
+        # Loud, unmissable: this artifact measured the CPU fallback, not the
+        # chip — the number is NOT comparable to an accelerator run (and the
+        # serving model is the thin stand-in, named in serving_model below).
+        out["fallback"] = True
+        out["fallback_reason"] = "accelerator bring-up failed; reran on cpu"
+    if extras.get("stream_dts"):
+        out["stream_round_secs"] = extras["stream_dts"]  # sorted; median used
     if serving is not None:
         # BASELINE configs #4/#5 (8-client fan-in batching into a ResNet
         # server); the reference publishes no figure, so no vs_baseline.
@@ -351,7 +398,43 @@ def main() -> None:
         out["serving_qps"] = round(qps, 1)
         out["serving_model"] = model
         out["serving_requests"] = total
+        flops = extras.get("model_flops_per_inference")
+        if flops:
+            # MFU = achieved model FLOP/s ÷ chip peak. Two flavors:
+            # serving_mfu has the whole RPC+tunnel pipeline in it;
+            # device_mfu is the compute path alone (batched, weights+pixels
+            # already in HBM) — the gap between them is transport cost.
+            peak = _peak_flops(platform, extras.get("device_kind", ""))
+            if extras.get("device_kind"):
+                out["device_kind"] = extras["device_kind"]
+            out["model_flops_per_inference"] = flops
+            out["peak_flops_assumed"] = peak
+            out["serving_mfu"] = round(qps * flops / peak, 8) if peak else None
+            dev_qps = extras.get("device_infer_qps")
+            if dev_qps:
+                out["device_infer_qps"] = dev_qps
+                out["device_mfu"] = (round(dev_qps * flops / peak, 6)
+                                     if peak else None)
     print(json.dumps(out))
+
+
+def _peak_flops(platform: str, device_kind: str) -> float:
+    """Peak dense-matmul FLOP/s for the bench device (bf16 for TPUs).
+
+    Published figures: TPU v5e ("v5 lite") 197 TFLOP/s bf16, v4 275, v5p 459.
+    CPU fallback uses a nominal 100 GFLOP/s so the field stays populated and
+    obviously-not-a-TPU numbers read as such.
+    """
+    peaks = {"v5 lite": 197e12, "v5e": 197e12, "v4": 275e12, "v5p": 459e12,
+             "v5": 197e12, "v6": 918e12}
+    if platform == "cpu":
+        return 100e9
+    kind = (device_kind
+            or os.environ.get("TPURPC_BENCH_DEVICE_KIND", "v5 lite")).lower()
+    for key, val in peaks.items():
+        if key in kind:
+            return val
+    return 197e12
 
 
 if __name__ == "__main__":

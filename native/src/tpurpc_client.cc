@@ -97,7 +97,11 @@ struct tpr_channel {
   void die() {
     {
       std::lock_guard<std::mutex> lk(mu);
-      if (!alive.exchange(false)) return;
+      // Sweep + notify even when alive was already false: the *first*
+      // flipper may have been ~tpr_channel, which doesn't sweep — an app
+      // thread parked in a deadline-less tpr_call_recv/finish must still be
+      // failed and woken, or it hangs on (then uses) a destroyed channel.
+      alive.store(false);
       for (auto &kv : streams) {
         Call &c = kv.second->c;
         if (!c.trailers_seen) {

@@ -220,6 +220,60 @@ def test_handler_exception_maps_to_unknown(channel):
 # Transport failure → UNAVAILABLE → reconnect
 # ---------------------------------------------------------------------------
 
+def test_stopped_server_refuses_late_adoptions():
+    """Regression (round-2 reconnect bug): a connection whose protocol sniff
+    completes after ``stop()`` must be refused, not adopted — an adopted one
+    would answer every call "server shutting down" forever and the client,
+    seeing healthy trailers, would never redial."""
+    from tpurpc.core.endpoint import passthru_endpoint_pair
+    from tpurpc.rpc.channel import Channel
+
+    srv = make_server()
+    srv.start()
+    srv.stop(grace=0)
+    a, b = passthru_endpoint_pair()
+    srv.serve_endpoint(b)  # the racy late adoption, made deterministic
+    ch = Channel(endpoint_factory=lambda: a)
+    echo = ch.unary_unary("/t.Echo/Echo")
+    with pytest.raises(rpc.RpcError) as ei:
+        echo(b"x", timeout=3)
+    assert ei.value.code() in (StatusCode.UNAVAILABLE,
+                               StatusCode.DEADLINE_EXCEEDED)
+    # the stopped server must hold no live connection
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and srv._connections:
+        time.sleep(0.02)
+    assert not srv._connections
+    ch.close()
+
+
+def test_pool_rejection_kills_connection_so_client_redials():
+    """Regression (round-2 reconnect bug, defense in depth): if a live
+    connection's server can no longer run handlers, the *connection* must
+    die with the rejected call — a client stuck on it would otherwise retry
+    against the same husk for its whole deadline."""
+    srv = make_server()
+    srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    port = srv.bound_ports[0]
+    ch = rpc.insecure_channel(f"127.0.0.1:{port}")
+    echo = ch.unary_unary("/t.Echo/Echo")
+    assert echo(b"a", timeout=10) == b"a"
+    conn = ch._subchannels[0]._conn
+    assert conn is not None and conn.alive
+    srv._pool.shutdown(wait=False)  # simulate the stale-adoption state
+    with pytest.raises(rpc.RpcError) as ei:
+        echo(b"b", timeout=3)
+    assert ei.value.code() is StatusCode.UNAVAILABLE
+    # the husk connection must be torn down so the next call redials
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and conn.alive:
+        time.sleep(0.02)
+    assert not conn.alive
+    ch.close()
+    srv.stop(grace=0)
+
+
 def test_server_gone_maps_unavailable_then_reconnects():
     srv = make_server()
     srv.add_insecure_port("127.0.0.1:0")

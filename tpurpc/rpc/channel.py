@@ -157,6 +157,18 @@ class _Connection:
                     return
                 if not self.alive:
                     return
+                # Ping only a genuinely idle connection (gRPC pings after
+                # keepalive_time of *inactivity*; the server loop skips
+                # in-flight streams for the same reason): with streams open,
+                # the single reader thread can be parked in credit-acquire or
+                # a long message burst, leaving the PONG unread past the
+                # timeout — and the keepalive would then kill a healthy
+                # connection, failing every in-flight call UNAVAILABLE.
+                with self._lock:
+                    busy = (bool(self._streams)
+                            or time.monotonic() - self.last_activity < interval)
+                if busy:
+                    continue
                 try:
                     self.ping(timeout)
                 except (EndpointError, TimeoutError, OSError):
@@ -821,16 +833,20 @@ class UnaryUnary(_MultiCallable):
         deadline = None if timeout is None else time.monotonic() + timeout
 
         def attempt():
-            remaining = (None if deadline is None
-                         else max(0.0, deadline - time.monotonic()))
             # Transparent retry (distinct from RetryPolicy): a stream the
             # server REFUSED at admission — RST "connection draining" from a
             # max_age GOAWAY race — never reached a handler, so replaying it
             # on a fresh connection is always safe (gRPC does the same for
-            # GOAWAY-refused streams).
+            # GOAWAY-refused streams). Each replay re-derives its budget from
+            # the OUTER deadline — a per-attempt re-anchor would extend the
+            # caller's wall-clock deadline by up to 3 refused attempts.
+            def remaining():
+                return (None if deadline is None
+                        else max(0.0, deadline - time.monotonic()))
+
             for _ in range(3):
                 try:
-                    return self._call_once(request, remaining, metadata)
+                    return self._call_once(request, remaining(), metadata)
                 except RpcError as exc:
                     refused = (_status_of(exc) is StatusCode.UNAVAILABLE
                                and "connection draining" in exc.details()
@@ -838,7 +854,7 @@ class UnaryUnary(_MultiCallable):
                                                False))
                     if not refused:
                         raise
-            return self._call_once(request, remaining, metadata)
+            return self._call_once(request, remaining(), metadata)
 
         if policy is None:
             return attempt()

@@ -477,6 +477,13 @@ class _ServerConnection:
         except RuntimeError:  # pool shut down: server is stopping
             self._send_trailers(st, StatusCode.UNAVAILABLE, "server shutting down")
             self._finish_stream(st)
+            # A server that cannot run handlers must not keep answering: kill
+            # the connection so the client's subchannel redials (a fresh
+            # server may own this port by now). Without this, a connection
+            # adopted in the stop() race answers every call with this trailer
+            # forever and the client — seeing healthy RPC replies — never
+            # reconnects (observed: 597 failed attempts/60s in round-2 CI).
+            self.close()
 
     def _run_handler(self, handler: RpcMethodHandler, st: _ServerStream,
                      ctx: ServerContext, path: str) -> None:
@@ -641,6 +648,7 @@ class Server:
         self._connections: List[_ServerConnection] = []
         self._lock = threading.Lock()
         self._started = False
+        self._stopping = False  # set under _lock before conns are torn down
         self._serving = threading.Event()
         self._stopped = threading.Event()
 
@@ -812,8 +820,18 @@ class Server:
             trace_server.log("unknown protocol preface %r; dropping", bytes(first))
             endpoint.close()
             return
+        # Registration must be atomic against stop(): this sniff thread may
+        # have been waiting on the preface for seconds, during which stop()
+        # closed every *registered* connection and shut the pool. Adopting a
+        # connection now would strand the client on a server that answers
+        # every call "server shutting down" and never dies (the round-2
+        # reconnect bug: client saw healthy trailers, so it never redialed).
         with self._lock:
-            self._connections.append(conn)
+            adopted = not self._stopping
+            if adopted:
+                self._connections.append(conn)
+        if not adopted:
+            conn.close()
 
     def _forget(self, conn: _ServerConnection) -> None:
         with self._lock:
@@ -827,6 +845,7 @@ class Server:
             listener.close()
         self._listeners.clear()
         with self._lock:
+            self._stopping = True  # gate _sniff_and_serve adoptions first
             conns = list(self._connections)
         if grace:
             # Graceful semantics (grpcio parity): announce shutdown — every

@@ -405,7 +405,15 @@ class GrpcH2Connection:
             self._finish(st)
             return
         ctx = H2ServerContext(self, st, metadata, deadline)
-        self.server._pool.submit(self._run_handler, handler, st, ctx, path)
+        try:
+            self.server._pool.submit(self._run_handler, handler, st, ctx, path)
+        except RuntimeError:  # pool shut down: server is stopping
+            self.send_trailers(st, StatusCode.UNAVAILABLE,
+                               "server shutting down")
+            self._finish(st)
+            # Same contract as the native framing path: a connection whose
+            # server cannot run handlers kills itself so clients redial.
+            self.close()
 
     def _on_data(self, sid: int, flags: int, payload: bytes) -> None:
         data = h2.strip_padding(flags, payload, has_priority=False)
