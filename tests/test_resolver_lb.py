@@ -309,3 +309,115 @@ def test_retry_server_streaming_before_first_message():
             assert calls["mid"] == 1            # committed: never replayed
     finally:
         srv.stop(grace=0)
+
+
+# -- priority + weighted_target composition ---------------------------------
+# (ref lb_policy/priority/priority.cc, weighted_target/weighted_target.cc)
+
+def test_priority_prefers_high_then_fails_over_and_back():
+    pol = make_policy({"priority": {
+        "children": [{"policy": "pick_first", "indices": [0]},
+                     {"policy": "pick_first", "indices": [1]}],
+        "failover_timeout_s": 0.2}}, 2)
+    assert list(pol.order())[0] == 0          # healthy: priority 0 leads
+    pol.failed(0)
+    assert list(pol.order())[0] == 1          # failover: priority 1 leads
+    assert 0 in pol.order()                   # but 0 stays dialable in-order
+    import time as _t
+    _t.sleep(0.25)
+    assert list(pol.order())[0] == 0          # mark expired: fail back
+
+def test_priority_connected_clears_mark():
+    pol = make_policy({"priority": [
+        {"policy": "pick_first", "indices": [0]},
+        {"policy": "pick_first", "indices": [1]}]}, 2)
+    pol.failed(0)
+    assert list(pol.order())[0] == 1
+    pol.connected(0)                          # a dial succeeded: healthy now
+    assert list(pol.order())[0] == 0
+
+def test_weighted_target_split_is_weight_proportional():
+    pol = make_policy({"weighted_target": [
+        {"weight": 3, "policy": "pick_first", "indices": [0]},
+        {"weight": 1, "policy": "pick_first", "indices": [1]}]}, 2)
+    firsts = [pol.order()[0] for _ in range(8)]
+    assert firsts.count(0) == 6 and firsts.count(1) == 2
+    # smooth WRR: the weight-1 target is interleaved, not bunched at the end
+    assert firsts[:4].count(1) == 1
+
+def test_weighted_target_of_priority_nested_tree():
+    # weighted_target of priority lists: indices in the nested spec are
+    # local to the child's universe, remapped onto the channel's global ones
+    pol = make_policy({"weighted_target": [
+        {"weight": 1, "indices": [0, 1],
+         "policy": {"priority": [{"policy": "pick_first", "indices": [0]},
+                                 {"policy": "pick_first", "indices": [1]}]}},
+        {"weight": 1, "indices": [2]},
+    ]}, 3)
+    orders = [list(pol.order()) for _ in range(4)]
+    assert all(sorted(o) == [0, 1, 2] for o in orders)
+    assert {o[0] for o in orders} == {0, 2}   # each target leads alternately
+    pol.failed(0)                              # nested priority fails over
+    lead = [o for o in (list(pol.order()) for _ in range(2)) if o[0] != 2][0]
+    assert lead[0] == 1
+
+def test_bad_composite_specs_rejected():
+    with pytest.raises(ValueError):
+        make_policy({"priority": {"children": []}}, 2)
+    with pytest.raises(ValueError):
+        make_policy({"weighted_target": [
+            {"weight": 0, "policy": "pick_first", "indices": [0]}]}, 1)
+    with pytest.raises(ValueError):
+        make_policy({"priority": [{"policy": "pick_first",
+                                   "indices": [5]}]}, 2)
+    with pytest.raises(ValueError):
+        make_policy({"mystery": []}, 1)
+
+def test_priority_channel_integration_failover():
+    s1, p1, m1 = _echo_server()
+    s2, p2, m2 = _echo_server()
+    m1["name"] = "primary"
+    m2["name"] = "backup"
+    try:
+        spec = {"priority": {
+            "children": [{"policy": "pick_first", "indices": [0]},
+                         {"policy": "pick_first", "indices": [1]}],
+            "failover_timeout_s": 30}}
+        with rpc.Channel(f"ipv4:127.0.0.1:{p1},127.0.0.1:{p2}",
+                         lb_policy=spec, connect_timeout=2) as ch:
+            mc = ch.unary_unary("/t.S/Who")
+            assert mc(b"", timeout=10) == b"primary"
+            s1.stop(grace=0)
+            # primary gone: calls land on the backup (walk-the-order dial
+            # covers the transition; the failed mark keeps it there)
+            deadline = 30
+            import time as _t
+            t0 = _t.monotonic()
+            while _t.monotonic() - t0 < deadline:
+                try:
+                    if mc(b"", timeout=5) == b"backup":
+                        break
+                except rpc.RpcError:
+                    _t.sleep(0.05)
+            assert mc(b"", timeout=10) == b"backup"
+    finally:
+        s1.stop(grace=0)
+        s2.stop(grace=0)
+
+def test_weighted_target_channel_integration_split():
+    s1, p1, m1 = _echo_server()
+    s2, p2, m2 = _echo_server()
+    m1["name"] = "w3"
+    m2["name"] = "w1"
+    try:
+        spec = {"weighted_target": [
+            {"weight": 3, "policy": "pick_first", "indices": [0]},
+            {"weight": 1, "policy": "pick_first", "indices": [1]}]}
+        with rpc.Channel(f"ipv4:127.0.0.1:{p1},127.0.0.1:{p2}",
+                         lb_policy=spec) as ch:
+            mc = ch.unary_unary("/t.S/Who")
+            got = [bytes(mc(b"", timeout=10)) for _ in range(8)]
+        assert got.count(b"w3") == 6 and got.count(b"w1") == 2
+    finally:
+        s1.stop(grace=0)
+        s2.stop(grace=0)

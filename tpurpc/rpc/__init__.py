@@ -74,3 +74,7 @@ from tpurpc.rpc.credentials import (ChannelCredentials,  # noqa: E402
 __all__ += ["secure_channel", "ChannelCredentials", "ServerCredentials",
             "ssl_channel_credentials", "ssl_server_credentials",
             "insecure_for_testing_channel_credentials"]
+
+from tpurpc.rpc.reflection import enable_server_reflection  # noqa: E402
+
+__all__ += ["enable_server_reflection"]

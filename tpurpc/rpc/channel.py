@@ -20,7 +20,8 @@ import queue
 import random
 import threading
 import time
-from typing import Callable, Iterable, Iterator, List, Optional, Sequence, Tuple
+from typing import (Callable, Iterable, Iterator, List, Optional, Sequence,
+                    Tuple, Union)
 
 from tpurpc.core.endpoint import Endpoint, EndpointError, connect_endpoint
 from tpurpc.rpc import frame as fr
@@ -407,6 +408,11 @@ class Channel:
     ``target`` is ``"host:port"``; tests may instead inject ``endpoint_factory``
     (e.g. one half of :func:`tpurpc.core.endpoint.passthru_endpoint_pair` — the
     moral equivalent of the reference's inproc transport).
+
+    ``lb_policy`` is a policy name (``"pick_first"``, ``"round_robin"``,
+    ``"ring_hash"``) or a composition-tree dict spec (``priority`` /
+    ``weighted_target`` over subchannel index subsets) — see
+    :func:`tpurpc.rpc.resolver.make_policy` for the grammar.
     """
 
     #: reconnect backoff, mirroring lib/backoff defaults (initial 1s would be
@@ -417,7 +423,8 @@ class Channel:
 
     def __init__(self, target: Optional[str] = None, *,
                  endpoint_factory: Optional[Callable[[], Endpoint]] = None,
-                 connect_timeout: float = 30.0, lb_policy: str = "pick_first",
+                 connect_timeout: float = 30.0,
+                 lb_policy: "Union[str, dict]" = "pick_first",
                  credentials=None,
                  max_receive_message_length: Optional[int] = None,
                  retry_policy: "Optional[RetryPolicy]" = None):

@@ -36,28 +36,8 @@ class ServingStatus(enum.IntEnum):
     SERVICE_UNKNOWN = 3  # Watch-only, per the health spec
 
 
-def _encode_varint(n: int) -> bytes:
-    out = bytearray()
-    while True:
-        b = n & 0x7F
-        n >>= 7
-        out.append(b | (0x80 if n else 0))
-        if not n:
-            return bytes(out)
-
-
-def _decode_varint(buf: bytes, pos: int):
-    shift = 0
-    val = 0
-    while True:
-        if pos >= len(buf):
-            raise ValueError("truncated varint")
-        b = buf[pos]
-        pos += 1
-        val |= (b & 0x7F) << shift
-        if not b & 0x80:
-            return val, pos
-        shift += 7
+from tpurpc.wire.protowire import decode_varint as _decode_varint
+from tpurpc.wire.protowire import encode_varint as _encode_varint
 
 
 def encode_request(service: str) -> bytes:

@@ -201,13 +201,202 @@ class RingHash:
         pass
 
 
+class _IndexMapped:
+    """Adapter running a child policy over a subset of the channel's
+    subchannel indices: the child sees local indices ``0..k-1``; the adapter
+    translates to/from the global ones. This is what lets ``priority`` and
+    ``weighted_target`` compose arbitrary leaf policies (the reference builds
+    the same shape as a tree of LB policies handing each child its own
+    address sublist — ``lb_policy/priority/priority.cc``,
+    ``weighted_target/weighted_target.cc``)."""
+
+    def __init__(self, child, indices: Sequence[int]):
+        self.child = child
+        self.indices = list(indices)
+        self._rev = {g: l for l, g in enumerate(self.indices)}
+
+    def order(self) -> Sequence[int]:
+        return [self.indices[i] for i in self.child.order()]
+
+    def connected(self, gidx: int) -> None:
+        if gidx in self._rev:
+            self.child.connected(self._rev[gidx])
+
+    def failed(self, gidx: int) -> None:
+        if gidx in self._rev:
+            self.child.failed(self._rev[gidx])
+
+
+class Priority:
+    """Ordered failover across child policies (ref
+    ``lb_policy/priority/priority.cc``): all traffic goes to the
+    highest-priority child with a usable backend; when every backend of the
+    active child is marked failed, traffic fails over to the next child.
+    Failed marks expire after ``failover_timeout_s`` so a recovered
+    higher-priority child gets re-probed and traffic **fails back** (the
+    reference drives this with its failover timer + child re-activation).
+
+    The emitted order always appends the lower-priority children after the
+    active child's backends — a single call can thus ride the channel's
+    walk-the-order dial loop through a mid-call failover without waiting for
+    the mark bookkeeping to settle."""
+
+    name = "priority"
+
+    def __init__(self, children: Sequence[_IndexMapped],
+                 failover_timeout_s: float = 10.0):
+        if not children:
+            raise ValueError("priority needs at least one child")
+        self._children = list(children)
+        self.failover_timeout_s = failover_timeout_s
+        self._failed_at: dict = {}          # global idx -> monotonic mark
+        self._lock = threading.Lock()
+
+    def _usable(self, child: _IndexMapped, now: float) -> bool:
+        for g in child.indices:
+            t = self._failed_at.get(g)
+            if t is None or now - t >= self.failover_timeout_s:
+                return True  # healthy, or failed mark expired: re-probe
+        return False
+
+    def order(self) -> Sequence[int]:
+        import time as _time
+
+        now = _time.monotonic()
+        with self._lock:
+            ranked = sorted(
+                range(len(self._children)),
+                key=lambda i: 0 if self._usable(self._children[i], now) else 1)
+        out: List[int] = []
+        seen = set()
+        for ci in ranked:
+            for g in self._children[ci].order():
+                if g not in seen:
+                    seen.add(g)
+                    out.append(g)
+        return out
+
+    def connected(self, gidx: int) -> None:
+        with self._lock:
+            self._failed_at.pop(gidx, None)
+        for c in self._children:
+            c.connected(gidx)
+
+    def failed(self, gidx: int) -> None:
+        import time as _time
+
+        with self._lock:
+            self._failed_at[gidx] = _time.monotonic()
+        for c in self._children:
+            c.failed(gidx)
+
+
+class WeightedTarget:
+    """Weight-proportional traffic split across named targets, each with its
+    own child policy (ref ``lb_policy/weighted_target/weighted_target.cc``).
+    Pick uses smooth weighted round-robin (deterministic: a weight-3 target
+    gets exactly 3 of every ``total`` picks, maximally interleaved), then
+    the remaining targets are appended so dial failures spill over."""
+
+    name = "weighted_target"
+
+    def __init__(self, targets: Sequence[Tuple[float, _IndexMapped]]):
+        if not targets:
+            raise ValueError("weighted_target needs at least one target")
+        self._targets = [(float(w), c) for w, c in targets]
+        if any(w <= 0 for w, _ in self._targets):
+            raise ValueError("weights must be positive")
+        self._current = [0.0] * len(self._targets)
+        self._lock = threading.Lock()
+
+    def order(self) -> Sequence[int]:
+        with self._lock:
+            total = sum(w for w, _ in self._targets)
+            for i, (w, _) in enumerate(self._targets):
+                self._current[i] += w
+            ranked = sorted(range(len(self._targets)),
+                            key=lambda i: -self._current[i])
+            self._current[ranked[0]] -= total
+        out: List[int] = []
+        seen = set()
+        for ti in ranked:
+            for g in self._targets[ti][1].order():
+                if g not in seen:
+                    seen.add(g)
+                    out.append(g)
+        return out
+
+    def connected(self, gidx: int) -> None:
+        for _, c in self._targets:
+            c.connected(gidx)
+
+    def failed(self, gidx: int) -> None:
+        for _, c in self._targets:
+            c.failed(gidx)
+
+
 POLICIES = {"pick_first": PickFirst, "round_robin": RoundRobin,
             "ring_hash": RingHash}
 
 
-def make_policy(name: str, n: int):
-    try:
-        return POLICIES[name](n)
-    except KeyError:
-        raise ValueError(f"unknown lb policy {name!r} "
-                         f"(have {sorted(POLICIES)})") from None
+def make_policy(spec, n: int):
+    """Build an LB policy.
+
+    ``spec`` is either a policy name (``"pick_first"``, ``"round_robin"``,
+    ``"ring_hash"``) over all ``n`` subchannels, or a composition tree à la
+    gRPC service config (ref priority/weighted_target policies):
+
+    >>> make_policy({"priority": {
+    ...     "children": [
+    ...         {"policy": "round_robin", "indices": [0, 1]},
+    ...         {"policy": "pick_first", "indices": [2]},
+    ...     ], "failover_timeout_s": 5}}, 3)
+    >>> make_policy({"weighted_target": {"targets": [
+    ...     {"weight": 3, "policy": "pick_first", "indices": [0]},
+    ...     {"weight": 1, "policy": "pick_first", "indices": [1]},
+    ... ]}}, 2)
+
+    Children nest: a ``policy`` value may itself be a dict spec (e.g. a
+    weighted_target of priority lists), in which case its ``indices`` are
+    the universe its nested spec's indices refer into.
+    """
+    if isinstance(spec, str):
+        try:
+            return POLICIES[spec](n)
+        except KeyError:
+            raise ValueError(f"unknown lb policy {spec!r} "
+                             f"(have {sorted(POLICIES)})") from None
+    if not isinstance(spec, dict) or len(spec) != 1:
+        raise ValueError(f"lb policy spec must be a name or one-key dict, "
+                         f"got {spec!r}")
+    kind, body = next(iter(spec.items()))
+
+    def build_child(entry) -> _IndexMapped:
+        indices = entry.get("indices")
+        if not indices:
+            raise ValueError(f"child {entry!r} needs non-empty 'indices'")
+        bad = [i for i in indices if not 0 <= i < n]
+        if bad:
+            raise ValueError(f"child indices {bad} out of range 0..{n - 1}")
+        child = make_policy(entry.get("policy", "pick_first"), len(indices))
+        return _IndexMapped(child, indices)
+
+    if kind == "priority":
+        if isinstance(body, list):
+            body = {"children": body}
+        if not isinstance(body, dict) or "children" not in body:
+            raise ValueError(f"priority spec needs 'children': {body!r}")
+        children = [build_child(e) for e in body["children"]]
+        return Priority(children,
+                        failover_timeout_s=body.get("failover_timeout_s",
+                                                    10.0))
+    if kind == "weighted_target":
+        if isinstance(body, list):
+            body = {"targets": body}
+        if not isinstance(body, dict) or "targets" not in body:
+            raise ValueError(f"weighted_target spec needs 'targets': {body!r}")
+        targets = [(e.get("weight", 1), build_child(e))
+                   for e in body["targets"]]
+        return WeightedTarget(targets)
+    raise ValueError(f"unknown composite lb policy {kind!r} "
+                     f"(have: priority, weighted_target)")
