@@ -264,6 +264,21 @@ def register_domain(kind: str, factory: Callable[[], MemoryDomain]) -> None:
     _DOMAINS[kind] = factory
 
 
+def make_domain(kind: str) -> MemoryDomain:
+    """Instantiate a registered domain by name (the ``TPURPC_RING_DOMAIN``
+    dispatch). ``tcp_window`` registers lazily on first use — it is the only
+    domain whose import starts background machinery (a record server)."""
+    if kind not in _DOMAINS and kind == "tcp_window":
+        import tpurpc.core.tcpw  # noqa: F401  (registers itself)
+    factory = _DOMAINS.get(kind)
+    if factory is None:
+        raise ValueError(f"unknown ring domain {kind!r} "
+                         f"(have {sorted(_DOMAINS)})")
+    # call OUTSIDE the lookup guard: a KeyError raised inside a registered
+    # factory must surface as itself, not as "unknown ring domain"
+    return factory()
+
+
 # ---------------------------------------------------------------------------
 # Address: what gets exchanged at bootstrap.
 # ---------------------------------------------------------------------------

@@ -342,11 +342,13 @@ class PairPool:
                  max_idle_per_key: Optional[int] = None):
         cfg = get_config()
         if pair_factory is None:
-            # Default domain is POSIX shm: one allocator that works both in-process
-            # and across processes on a host (the endpoint factory relies on this).
-            from tpurpc.core.pair import ShmDomain
+            # Domain per config (TPURPC_RING_DOMAIN): shm by default (works
+            # in-process and cross-process on one host); tcp_window carries
+            # the same protocol across hosts (tpurpc/core/tcpw.py).
+            from tpurpc.core.pair import make_domain
 
-            pair_factory = lambda: Pair(ShmDomain())  # noqa: E731
+            kind = cfg.ring_domain
+            pair_factory = lambda: Pair(make_domain(kind))  # noqa: E731
         self.pair_factory = pair_factory
         #: global bound = the reference's flat 128-pair pool (pair.h:273);
         #: the per-key default is a QUARTER of it so one hot peer key cannot

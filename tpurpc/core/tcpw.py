@@ -1,0 +1,291 @@
+"""``tcp_window`` — the cross-host one-sided memory domain over sockets.
+
+The reference's product is a fast pipe *between hosts*: the sender RDMA-WRITEs
+payload straight into the peer's receive ring and credits flow back the same
+way (``/root/reference/src/core/lib/ibverbs/pair.cc:587-622`` postWrite,
+``:624-641`` updateStatus). Without IB hardware, this module supplies the
+second *real* implementation of the :class:`tpurpc.core.pair.MemoryDomain`
+seam: a socket-carried one-sided write domain. The pair/ring/credit protocol
+above it is byte-for-byte the one the shm domain runs — which is the point:
+the seam is proven by two genuinely different fabrics.
+
+Design (and how it mirrors verbs semantics):
+
+- Each process runs ONE record server (lazy singleton). ``alloc`` registers
+  a plain local buffer under a 16-byte key and hands out a handle
+  ``tcpw:<host>:<port>:<key>`` — the moral equivalent of an ``ibv_mr``
+  rkey + raddr envelope (``memory_region.h:14-47``).
+- ``open_window(handle)`` attaches to the peer process's record server.
+  ``Window.write(offset, data)`` ships a ``(key, offset, len, payload)``
+  record; the peer's applier thread lands it in the region buffer. The
+  writer never rendezvouses with the *consumer* — the consuming thread just
+  polls its ring memory, exactly as with shm or a NIC's DMA.
+- ALL windows from this process to one peer process share a single ordered
+  connection (refcounted). That gives the cross-buffer total order an RC QP
+  gives the reference: a credit write posted after a data write can never
+  be observed before it. (Two sockets would reorder data vs. status and
+  break the ring protocol's publication invariant.)
+- Writes racing a region's teardown are discarded with a trace log — the
+  one-sided analog of writes to a deregistered MR.
+
+The advertised host defaults to ``127.0.0.1`` (CI: cross-process on one
+box); set ``TPURPC_TCPW_HOST`` to the host's reachable address for real
+cross-host deployments. Select the domain with ``TPURPC_RING_DOMAIN=
+tcp_window`` (alias ``GRPC_RDMA_DOMAIN``) on BOTH peers.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+import uuid
+from typing import Dict, Optional, Tuple
+
+from tpurpc.core.pair import MemoryDomain, Region, Window, register_domain
+from tpurpc.utils.trace import TraceFlag
+
+trace_tcpw = TraceFlag("tcpw")
+
+#: record header: region key (16B), offset (u64), payload length (u32)
+_REC = struct.Struct("<16sQI")
+_HELLO = b"TPWD"  # protocol guard on the record connection
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    # recv_into a preallocated buffer: O(n) for ring-sized records (the
+    # += accumulation alternative is O(n²) in copies at 64KB TCP chunks)
+    buf = bytearray(n)
+    view = memoryview(buf)
+    filled = 0
+    while filled < n:
+        try:
+            got = sock.recv_into(view[filled:])
+        except OSError:
+            return None
+        if not got:
+            return None
+        filled += got
+    return bytes(buf)
+
+
+class _RecordServer:
+    """Per-process applier: lands inbound one-sided writes into regions."""
+
+    _instance: Optional["_RecordServer"] = None
+    _lock = threading.Lock()
+
+    @classmethod
+    def get(cls) -> "_RecordServer":
+        with cls._lock:
+            inst = cls._instance
+            if inst is None or inst.pid != os.getpid():
+                # Fork-aware: a child inherits the singleton object but NOT
+                # its accept/applier threads — regions registered in the
+                # child would advertise a port only the parent serves. Fresh
+                # server (and peer-link cache) per process.
+                _PeerLink.forget_inherited()
+                inst = cls._instance = _RecordServer()
+            return inst
+
+    def __init__(self):
+        from tpurpc.utils.config import get_config
+
+        self.pid = os.getpid()
+        self._regions: Dict[bytes, memoryview] = {}
+        self._reg_lock = threading.Lock()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((get_config().tcpw_bind, 0))
+        self._sock.listen(64)
+        self.port = self._sock.getsockname()[1]
+        self._stopped = False
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name="tpurpc-tcpw-accept").start()
+
+    def close(self) -> None:
+        """Stop accepting and release the port (process teardown/tests)."""
+        self._stopped = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with type(self)._lock:
+            if type(self)._instance is self:
+                type(self)._instance = None
+
+    # -- region registry -----------------------------------------------------
+
+    def register(self, key: bytes, buf: memoryview) -> None:
+        with self._reg_lock:
+            self._regions[key] = buf
+
+    def unregister(self, key: bytes) -> None:
+        with self._reg_lock:
+            self._regions.pop(key, None)
+
+    # -- inbound -------------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        self._sock.settimeout(0.5)
+        while not self._stopped:
+            try:
+                conn, addr = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._apply_loop, args=(conn,),
+                             daemon=True, name="tpurpc-tcpw-apply").start()
+
+    def _apply_loop(self, conn: socket.socket) -> None:
+        """One peer process's ordered write stream; applied sequentially —
+        the in-order-delivery property the ring protocol's publication
+        invariant (payload visible before seq words) rests on."""
+        with conn:
+            if _recv_exact(conn, len(_HELLO)) != _HELLO:
+                trace_tcpw.log("record conn with bad hello; dropping")
+                return
+            while True:
+                hdr = _recv_exact(conn, _REC.size)
+                if hdr is None:
+                    return
+                key, off, ln = _REC.unpack(hdr)
+                payload = _recv_exact(conn, ln)
+                if payload is None:
+                    return
+                with self._reg_lock:
+                    buf = self._regions.get(key)
+                if buf is None:
+                    # write raced region teardown: the deregistered-MR analog
+                    trace_tcpw.log("discarding %dB write to dead region", ln)
+                    continue
+                if off + ln > len(buf):
+                    trace_tcpw.log("discarding out-of-bounds write "
+                                   "(%d+%d > %d)", off, ln, len(buf))
+                    continue
+                buf[off:off + ln] = payload
+
+
+class _PeerLink:
+    """One refcounted, ordered record connection to a peer process."""
+
+    _links: Dict[Tuple[str, int], "_PeerLink"] = {}
+    _links_lock = threading.Lock()
+    _links_pid = os.getpid()
+
+    @classmethod
+    def forget_inherited(cls) -> None:
+        """Post-fork: inherited link sockets belong to the parent's streams —
+        reusing one would interleave two processes' records. Drop the cache
+        (fds close with the objects; the parent's copies are unaffected)."""
+        with cls._links_lock:
+            cls._links.clear()
+            cls._links_pid = os.getpid()
+
+    @classmethod
+    def attach(cls, host: str, port: int) -> "_PeerLink":
+        with cls._links_lock:
+            if cls._links_pid != os.getpid():
+                cls._links.clear()
+                cls._links_pid = os.getpid()
+            link = cls._links.get((host, port))
+            if link is None or link.dead:
+                link = cls._links[(host, port)] = _PeerLink(host, port)
+            link.refs += 1
+            return link
+
+    def __init__(self, host: str, port: int):
+        self.key = (host, port)
+        self.refs = 0
+        self.dead = False
+        self._sock = socket.create_connection((host, port), timeout=20)
+        # connect timeout must NOT linger on the stream: a mid-record
+        # socket.timeout would leave the shared ordered stream misaligned
+        # (writes block on backpressure instead — that IS the flow control)
+        self._sock.settimeout(None)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._send_lock = threading.Lock()
+        self._sock.sendall(_HELLO)
+
+    def write(self, key: bytes, off: int, data) -> None:
+        with self._send_lock:
+            if self.dead:
+                raise ConnectionError("tcp_window peer link closed")
+            try:
+                # gathered send per record (no concat copy); sendmsg may
+                # stop short on backpressure, so finish the record with
+                # sendall — the lock holds until the record is whole, which
+                # is what keeps the shared stream parseable.
+                hdr = _REC.pack(key, off, len(data))
+                view = memoryview(data).cast("B")
+                sent = self._sock.sendmsg([hdr, view])
+                if sent < len(hdr):
+                    self._sock.sendall(hdr[sent:])
+                    sent = len(hdr)
+                if sent < len(hdr) + len(view):
+                    self._sock.sendall(view[sent - len(hdr):])
+            except OSError:
+                # any send failure may have transmitted a PARTIAL record:
+                # the stream is misaligned beyond repair — poison the link
+                # so no other window appends bytes the applier would parse
+                # as a garbage header.
+                self.dead = True
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                raise
+
+    def release(self) -> None:
+        with self._links_lock:
+            self.refs -= 1
+            if self.refs > 0:
+                return
+            self._links.pop(self.key, None)
+            self.dead = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class TcpWindowDomain(MemoryDomain):
+    """Socket-carried one-sided writes: the cross-host ring fabric."""
+
+    kind = "tcp_window"
+
+    def alloc(self, nbytes: int) -> Region:
+        server = _RecordServer.get()
+        key = uuid.uuid4().bytes
+        buf = bytearray(nbytes)
+        mv = memoryview(buf)
+        server.register(key, mv)
+        from tpurpc.utils.config import get_config
+
+        handle = f"tcpw:{get_config().tcpw_host}:{server.port}:{key.hex()}"
+
+        def _close():
+            server.unregister(key)
+
+        return Region(handle, buf, _close)
+
+    def open_window(self, handle: str, nbytes: int) -> Window:
+        if not handle.startswith("tcpw:"):
+            raise ValueError(f"not a tcp_window handle: {handle!r}")
+        host, port_s, key_hex = handle[5:].rsplit(":", 2)
+        key = bytes.fromhex(key_hex)
+        link = _PeerLink.attach(host, int(port_s))
+
+        def write(off: int, data) -> None:
+            link.write(key, off, data)
+
+        # view=None: not host-addressable from this side (cross-host); the
+        # pair's native fast paths check for None and stay on the portable
+        # path (pair.py:568).
+        return Window(write, link.release, view=None)
+
+
+register_domain("tcp_window", TcpWindowDomain)

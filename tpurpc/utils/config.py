@@ -163,6 +163,19 @@ class Config:
     #: max_age filter analog: server sends GOAWAY on connections older than
     #: this; in-flight calls drain, new calls dial fresh. 0/neg disables.
     max_connection_age_ms: int = 0
+    #: Which MemoryDomain carries the ring's one-sided writes: "shm"
+    #: (cross-process, one host — the default), "local" (in-process), or
+    #: "tcp_window" (cross-HOST over an ordered record socket,
+    #: tpurpc/core/tcpw.py). The analog of the reference choosing the
+    #: ibverbs device for its pairs; must match on both peers (asserted at
+    #: bootstrap like the reference's tag/size match, pair.cc:148-149).
+    ring_domain: str = "shm"
+    #: tcp_window only: the address peers should dial to reach this
+    #: process's record server (advertised inside region handles), and the
+    #: local bind address. Set tcpw_host to the host's reachable IP for
+    #: real cross-host deployments.
+    tcpw_host: str = "127.0.0.1"
+    tcpw_bind: str = "0.0.0.0"
 
     @property
     def ring_buffer_size(self) -> int:
@@ -243,6 +256,10 @@ class Config:
             max_connection_age_ms=_env_int(
                 "TPURPC_MAX_CONNECTION_AGE_MS", cls.max_connection_age_ms,
                 "GRPC_ARG_MAX_CONNECTION_AGE_MS"),
+            ring_domain=(_env("TPURPC_RING_DOMAIN", "GRPC_RDMA_DOMAIN")
+                         or cls.ring_domain).strip().lower(),
+            tcpw_host=_env("TPURPC_TCPW_HOST") or cls.tcpw_host,
+            tcpw_bind=_env("TPURPC_TCPW_BIND") or cls.tcpw_bind,
         )
 
     @property
