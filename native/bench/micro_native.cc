@@ -1,0 +1,154 @@
+// Native micro-benchmark: closed-loop small-RPC ping-pong, C client vs C
+// server in one process over loopback TCP — the number the reference
+// commits as examples/cpp/micro-bench logs
+// (draw/latency/client_latency_RDMA_BP_size_64_streaming_true.log:
+// 7.01 us p50, 211K RPC/s on IB EDR; SURVEY.md §6). This measures tpurpc's
+// native data loop with the Python framework out of the picture — the
+// framework-overhead headroom quantifier VERDICT r2 next#3 asked for.
+//
+// Build: g++ -std=c++17 -O2 native/bench/micro_native.cc \
+//          native/src/tpurpc_client.cc native/src/tpurpc_server.cc \
+//          -Inative/include -lpthread -o /tmp/micro_native
+// Run:   /tmp/micro_native [req_size=64] [duration_s=5] [threads=1]
+//                          [streaming=0|1]
+// streaming=1 is the reference's measured configuration (its committed
+// latency logs are `streaming_true`): ONE bidi call per thread, ping-pong
+// messages — call setup/teardown off the per-RPC path.
+//
+// Output: the reference's log line shape —
+//   "Rate N RPCs/s, TX Bandwidth M Mb/s, RTT (us) mean A P50 B P99 C"
+// then one JSON line for machine consumption.
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+#include "tpurpc/client.h"
+#include "tpurpc/server.h"
+
+static int echo_handler(tpr_server_call *call, void *) {
+  uint8_t *data;
+  size_t len;
+  while (tpr_srv_recv(call, &data, &len) == 1) {
+    tpr_srv_send(call, data, len);
+    tpr_srv_buf_free(data);
+  }
+  return 0;
+}
+
+// callback-API echo: runs on the reader thread, no handler-thread handoff
+static int echo_cb(tpr_server_call *call, const uint8_t *data, size_t len,
+                   void *) {
+  tpr_srv_send(call, data, len);
+  return 0;
+}
+
+int main(int argc, char **argv) {
+  size_t req_size = argc > 1 ? (size_t)atoll(argv[1]) : 64;
+  double duration_s = argc > 2 ? atof(argv[2]) : 5.0;
+  int threads = argc > 3 ? atoi(argv[3]) : 1;
+  int streaming = argc > 4 ? atoi(argv[4]) : 0;
+  int use_cb = argc > 5 ? atoi(argv[5]) : 1;  // callback API by default
+
+  tpr_server *srv = tpr_server_create(0);
+  if (!srv) { fprintf(stderr, "server create failed\n"); return 1; }
+  if (use_cb)
+    tpr_server_register_callback(srv, "/bench.Echo/Echo", echo_cb, nullptr);
+  else
+    tpr_server_register(srv, "/bench.Echo/Echo", echo_handler, nullptr);
+  if (tpr_server_start(srv) != 0) { fprintf(stderr, "start failed\n"); return 1; }
+  int port = tpr_server_port(srv);
+
+  std::atomic<uint64_t> total_rpcs{0};
+  std::vector<std::vector<double>> lat_us_per_thread(threads);
+  std::vector<std::thread> workers;
+  auto t_end = std::chrono::steady_clock::now() +
+               std::chrono::duration<double>(duration_s);
+
+  for (int t = 0; t < threads; ++t) {
+    workers.emplace_back([&, t]() {
+      tpr_channel *ch = tpr_channel_create("127.0.0.1", port, 5000);
+      if (!ch) { fprintf(stderr, "connect failed\n"); return; }
+      std::vector<uint8_t> payload(req_size, 0xAB);
+      auto &lat = lat_us_per_thread[t];
+      lat.reserve(1 << 20);
+      if (streaming) {
+        // one bidi call for the whole run: message round trips only
+        tpr_call *c = tpr_call_start(ch, "/bench.Echo/Echo", nullptr, 0, 0);
+        if (!c) { tpr_channel_destroy(ch); return; }
+        while (std::chrono::steady_clock::now() < t_end) {
+          auto t0 = std::chrono::steady_clock::now();
+          if (tpr_call_send(c, payload.data(), payload.size(), 0) != 0) break;
+          uint8_t *resp; size_t rlen;
+          if (tpr_call_recv(c, &resp, &rlen) != 1) break;
+          tpr_buf_free(resp);
+          auto dt = std::chrono::duration<double, std::micro>(
+                        std::chrono::steady_clock::now() - t0).count();
+          lat.push_back(dt);
+          total_rpcs.fetch_add(1, std::memory_order_relaxed);
+        }
+        tpr_call_cancel(c);
+        tpr_call_destroy(c);
+      } else {
+        while (std::chrono::steady_clock::now() < t_end) {
+          auto t0 = std::chrono::steady_clock::now();
+          tpr_call *c = tpr_call_start(ch, "/bench.Echo/Echo", nullptr, 0,
+                                       5000);
+          if (!c) break;
+          if (tpr_call_send(c, payload.data(), payload.size(), 1) != 0) {
+            tpr_call_destroy(c);
+            break;
+          }
+          uint8_t *resp; size_t rlen;
+          int got = tpr_call_recv(c, &resp, &rlen);
+          if (got == 1) tpr_buf_free(resp);
+          int st = tpr_call_finish(c, nullptr, 0);
+          tpr_call_destroy(c);
+          if (got != 1 || st != TPR_OK) break;
+          auto dt = std::chrono::duration<double, std::micro>(
+                        std::chrono::steady_clock::now() - t0).count();
+          lat.push_back(dt);
+          total_rpcs.fetch_add(1, std::memory_order_relaxed);
+        }
+      }
+      tpr_channel_destroy(ch);
+    });
+  }
+  auto t_start = std::chrono::steady_clock::now();
+  for (auto &w : workers) w.join();
+  double elapsed = std::chrono::duration<double>(
+                       std::chrono::steady_clock::now() - t_start).count();
+  tpr_server_destroy(srv);
+
+  std::vector<double> lat;
+  for (auto &v : lat_us_per_thread) lat.insert(lat.end(), v.begin(), v.end());
+  if (lat.empty()) { fprintf(stderr, "no completed RPCs\n"); return 1; }
+  std::sort(lat.begin(), lat.end());
+  auto pct = [&](double p) {
+    size_t i = (size_t)(p / 100.0 * (double)(lat.size() - 1));
+    return lat[i];
+  };
+  double mean = 0;
+  for (double x : lat) mean += x;
+  mean /= (double)lat.size();
+  uint64_t n = total_rpcs.load();
+  double rate = (double)n / elapsed;
+  double tx_mbps = rate * (double)req_size * 8.0 / 1e6;
+
+  // the reference's periodic log line shape (SURVEY.md §6)
+  printf("Rate %.0f RPCs/s, TX Bandwidth %.2f Mb/s, RTT (us) mean %.2f "
+         "P50 %.2f P99 %.2f\n", rate, tx_mbps, mean, pct(50), pct(99));
+  printf("{\"bench\": \"micro_native\", \"req_size\": %zu, \"threads\": %d, "
+         "\"streaming\": %s, "
+         "\"duration_s\": %.1f, \"rpcs\": %llu, \"rate_rps\": %.0f, "
+         "\"rtt_us_mean\": %.2f, \"rtt_us_p50\": %.2f, \"rtt_us_p99\": %.2f}\n",
+         req_size, threads, streaming ? "true" : "false", elapsed,
+         (unsigned long long)n, rate, mean, pct(50), pct(99));
+  return 0;
+}

@@ -39,6 +39,23 @@ int tpr_server_port(tpr_server *s);
 void tpr_server_register(tpr_server *s, const char *method, tpr_handler_fn fn,
                          void *ud);
 
+/* -- callback (reactor) API --------------------------------------------
+ *
+ * The reference ships sync, CQ-async, AND callback server APIs
+ * (src/cpp/server/server_callback.cc); this is tpurpc's callback shape.
+ * `on_msg` fires ON THE CONNECTION READER THREAD once per complete request
+ * message — no per-call thread, no handoff: the low-latency path for
+ * message-echo/transform services. Reply synchronously with tpr_srv_send.
+ * Contract: return 0 to continue; a positive return ends the call NOW with
+ * that status code (negative returns are coerced to INTERNAL(13) — the
+ * client always gets trailers). At client half-close the call ends OK.
+ * Handlers must not block: they stall every stream on the connection
+ * (exactly like gRPC callback reactors). */
+typedef int (*tpr_msg_cb)(tpr_server_call *call, const uint8_t *data,
+                          size_t len, void *ud);
+void tpr_server_register_callback(tpr_server *s, const char *method,
+                                  tpr_msg_cb on_msg, void *ud);
+
 /* Start the accept loop (background thread). */
 int tpr_server_start(tpr_server *s);
 

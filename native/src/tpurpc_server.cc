@@ -48,6 +48,12 @@ struct tpr_server_call {
   std::string partial;              // MORE-fragment accumulator
   bool half_closed = false;         // client END_STREAM seen
   bool cancelled = false;           // RST / connection death
+
+  // callback-API calls: handled inline on the reader thread (no thread,
+  // no pending queue — each complete message goes straight to the cb)
+  int (*inline_cb)(tpr_server_call *, const uint8_t *, size_t, void *) =
+      nullptr;
+  void *inline_ud = nullptr;
 };
 
 namespace {
@@ -102,6 +108,7 @@ struct tpr_server {
   std::atomic<bool> running{false};
   std::thread accept_thread;
   std::map<std::string, std::pair<tpr_handler_fn, void *>> handlers;
+  std::map<std::string, std::pair<tpr_msg_cb, void *>> cb_handlers;
   std::mutex conns_mu;
   std::vector<Conn *> conns;
 
@@ -165,6 +172,21 @@ struct tpr_server {
           delete call;
           continue;
         }
+        auto cb_it = cb_handlers.find(call->method);
+        if (cb_it != cb_handlers.end()) {
+          // callback API: no thread — messages dispatch inline below
+          call->inline_cb = cb_it->second.first;
+          call->inline_ud = cb_it->second.second;
+          if (flags & kFlagEndStream) {  // empty call: trailers now
+            {
+              std::lock_guard<std::mutex> lk2(c->mu);
+              c->streams.erase(sid);
+            }
+            c->send_trailers(sid, 0, call->details);
+            delete call;
+          }
+          continue;
+        }
         c->handler_threads.fetch_add(1);
         std::thread([this, c, call] { run_handler(c, call); }).detach();
         continue;
@@ -174,6 +196,51 @@ struct tpr_server {
       auto it = c->streams.find(sid);
       if (it == c->streams.end()) continue;  // finished/unknown: drop
       tpr_server_call *call = it->second;
+      if (call->inline_cb) {
+        // reactor path: complete messages run the cb ON THIS THREAD;
+        // teardown is immediate at RST/half-close/nonzero-return. Only the
+        // reader touches inline calls, so the lock is released first.
+        lk.unlock();
+        bool finished = false;
+        bool rst = false;
+        int code = 0;
+        if (type == kRst) {
+          finished = rst = true;  // cancelled: client left, no trailers
+        } else if (type == kMessage) {
+          const bool has_payload = !(flags & kFlagNoMessage);
+          const bool complete = has_payload && !(flags & kFlagMore);
+          if (complete && call->partial.empty()) {
+            // common case: whole message in one frame — feed the cb the
+            // frame buffer directly, no accumulator alloc/copy
+            code = call->inline_cb(call, payload.data(), payload.size(),
+                                   call->inline_ud);
+          } else {
+            if (has_payload)
+              call->partial.append(reinterpret_cast<char *>(payload.data()),
+                                   payload.size());
+            if (complete) {
+              std::string msg = std::move(call->partial);
+              call->partial.clear();
+              code = call->inline_cb(
+                  call, reinterpret_cast<const uint8_t *>(msg.data()),
+                  msg.size(), call->inline_ud);
+            }
+          }
+          // negative returns are app errors, not a protocol escape hatch:
+          // map them to INTERNAL so the client always gets trailers
+          if (code < 0) code = 13;
+          if (code != 0 || (flags & kFlagEndStream)) finished = true;
+        }
+        if (finished) {
+          {
+            std::lock_guard<std::mutex> lk2(c->mu);
+            c->streams.erase(sid);
+          }
+          if (!rst) c->send_trailers(sid, code, call->details);
+          delete call;
+        }
+        continue;
+      }
       if (type == kRst) {
         call->cancelled = true;
       } else if (type == kMessage) {
@@ -198,6 +265,13 @@ struct tpr_server {
     // wait for handlers to drain (they hold call pointers)
     while (c->handler_threads.load() > 0)
       std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    {
+      // inline (callback-API) calls have no handler thread to free them:
+      // whatever is left in the map now is reader-owned — reap it here
+      std::lock_guard<std::mutex> lk(c->mu);
+      for (auto &kv : c->streams) delete kv.second;
+      c->streams.clear();
+    }
     c->close_fd();
     c->alive.store(false);
   }
@@ -270,6 +344,11 @@ int tpr_server_port(tpr_server *s) { return s->port; }
 void tpr_server_register(tpr_server *s, const char *method, tpr_handler_fn fn,
                          void *ud) {
   s->handlers[method] = {fn, ud};
+}
+
+void tpr_server_register_callback(tpr_server *s, const char *method,
+                                  tpr_msg_cb on_msg, void *ud) {
+  s->cb_handlers[method] = {on_msg, ud};
 }
 
 int tpr_server_start(tpr_server *s) {

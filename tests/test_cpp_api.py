@@ -302,3 +302,118 @@ def test_cpp_loop_under_asan():
         proc.wait(timeout=15)
         srv_err = proc.stderr.read()
         assert "ERROR" not in srv_err, srv_err
+
+
+_CB_SERVER_SRC = r"""
+// callback (reactor) API server: handlers run inline on the reader thread
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include "tpurpc/server.h"
+
+static int echo_cb(tpr_server_call *call, const uint8_t *d, size_t n, void *) {
+  tpr_srv_send(call, d, n);
+  return 0;
+}
+static int limit_cb(tpr_server_call *call, const uint8_t *d, size_t n, void *ud) {
+  // ends the call with RESOURCE_EXHAUSTED(8) on a message saying "stop"
+  (void)ud;
+  if (n == 4 && memcmp(d, "stop", 4) == 0) {
+    tpr_srv_set_details(call, "limit reached");
+    return 8;
+  }
+  tpr_srv_send(call, d, n);
+  return 0;
+}
+int main() {
+  tpr_server *s = tpr_server_create(0);
+  tpr_server_register_callback(s, "/cb.S/Echo", echo_cb, nullptr);
+  tpr_server_register_callback(s, "/cb.S/Limited", limit_cb, nullptr);
+  tpr_server_start(s);
+  printf("PORT %d\n", tpr_server_port(s));
+  fflush(stdout);
+  getchar();  // run until stdin closes
+  tpr_server_destroy(s);
+  return 0;
+}
+"""
+
+
+def test_python_client_against_cpp_callback_server(tmp_path):
+    """The callback (reactor) server API — handlers inline on the reader
+    thread (ref src/cpp/server/server_callback.cc shape): unary, streaming
+    ping-pong, mid-stream nonzero status, and multiplexed calls."""
+    gxx = shutil.which("g++")
+    if gxx is None:
+        pytest.skip("no g++ toolchain")
+    src = tmp_path / "cb_server.cc"
+    src.write_text(_CB_SERVER_SRC)
+    binp = tmp_path / "cb_server"
+    subprocess.run(
+        [gxx, "-std=c++17", "-O1", str(src),
+         os.path.join(ROOT, "native", "src", "tpurpc_server.cc"),
+         "-I", os.path.join(ROOT, "native", "include"),
+         "-lpthread", "-o", str(binp)],
+        check=True, timeout=180, capture_output=True)
+    proc = subprocess.Popen([str(binp)], stdout=subprocess.PIPE,
+                            stdin=subprocess.PIPE, text=True)
+    try:
+        port = int(proc.stdout.readline().split()[1])
+        from tpurpc.rpc.status import RpcError, StatusCode
+
+        with rpc.Channel(f"127.0.0.1:{port}") as ch:
+            # unary through the reactor path
+            echo = ch.unary_unary("/cb.S/Echo")
+            assert echo(b"hi", timeout=10) == b"hi"
+            # streaming ping-pong
+            chat = ch.stream_stream("/cb.S/Echo")
+            got = [bytes(m) for m in chat(iter([b"a", b"b", b"c"]),
+                                          timeout=10)]
+            assert got == [b"a", b"b", b"c"]
+            # mid-stream nonzero status ends the call with that code
+            lim = ch.stream_stream("/cb.S/Limited")
+            call = lim(iter([b"one", b"stop", b"never-sent"]), timeout=10)
+            seen = []
+            with pytest.raises(RpcError) as ei:
+                for m in call:
+                    seen.append(bytes(m))
+            assert seen == [b"one"]
+            assert ei.value.code() is StatusCode.RESOURCE_EXHAUSTED
+            assert "limit reached" in ei.value.details()
+            # reactor calls multiplex on one connection like any other
+            mc = ch.unary_unary("/cb.S/Echo")
+            import concurrent.futures as cf
+
+            with cf.ThreadPoolExecutor(4) as ex:
+                outs = list(ex.map(
+                    lambda i: bytes(mc(b"m%d" % i, timeout=10)), range(8)))
+            assert outs == [b"m%d" % i for i in range(8)]
+    finally:
+        proc.stdin.close()
+        proc.wait(timeout=10)
+
+
+def test_micro_native_bench_smoke(tmp_path):
+    """The native micro-bench (the reference's examples/cpp/micro-bench
+    analog) builds and produces sane numbers in both modes."""
+    gxx = shutil.which("g++")
+    if gxx is None:
+        pytest.skip("no g++ toolchain")
+    binp = tmp_path / "micro_native"
+    subprocess.run(
+        [gxx, "-std=c++17", "-O2",
+         os.path.join(ROOT, "native", "bench", "micro_native.cc"),
+         os.path.join(ROOT, "native", "src", "tpurpc_client.cc"),
+         os.path.join(ROOT, "native", "src", "tpurpc_server.cc"),
+         "-I", os.path.join(ROOT, "native", "include"),
+         "-lpthread", "-o", str(binp)],
+        check=True, timeout=180, capture_output=True)
+    import json as _json
+
+    for streaming in (0, 1):
+        out = subprocess.run([str(binp), "64", "1", "1", str(streaming)],
+                             capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr
+        rec = _json.loads(out.stdout.strip().splitlines()[-1])
+        assert rec["rpcs"] > 100
+        assert rec["rtt_us_p50"] > 0
