@@ -7,7 +7,9 @@
 //
 // Build (from the repo root; the test suite does this automatically):
 //   g++ -std=c++17 -O2 examples/cpp_client.cc native/src/tpurpc_client.cc \
-//       -Inative/include -lpthread -o /tmp/tpurpc_cpp_client
+//       native/src/ring.cc -Inative/include -lpthread -o /tmp/tpurpc_cpp_client
+// Set GRPC_PLATFORM_TYPE=RDMA_BP (or BPEV/EVENT) to ride the shm ring data
+// plane — the app code is unchanged; only the byte pipe under it swaps.
 // Run: /tmp/tpurpc_cpp_client <port>
 //
 // Exercises all the API surface a port of a reference C++ app needs:

@@ -6,7 +6,7 @@
 // client — call it over the native framing.
 //
 // Build: g++ -std=c++17 -O2 examples/cpp_server.cc \
-//            native/src/tpurpc_server.cc -Inative/include -lpthread \
+//            native/src/tpurpc_server.cc native/src/ring.cc -Inative/include -lpthread \
 //            -o /tmp/tpurpc_cpp_server
 // Run: /tmp/tpurpc_cpp_server   (prints "PORT <n>", serves until stdin EOF)
 

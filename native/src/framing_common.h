@@ -8,6 +8,7 @@
 
 #include <errno.h>
 #include <stdint.h>
+#include <stdlib.h>
 #include <string.h>
 #include <sys/socket.h>
 
@@ -105,32 +106,77 @@ inline bool fd_read_exact(int fd, void *buf, size_t len) {
   return true;
 }
 
-// Serialized whole-frame write (the FrameWriter-analog lock lives with the
-// caller's mutex).
-inline bool fd_send_frame_locked(int fd, uint8_t type, uint8_t flags,
-                                 uint32_t sid, const void *payload,
-                                 size_t len) {
-  std::string hdr;
+// Transport-generic frame IO: T needs write_all(ptr,len)/read_exact(ptr,len)
+// with the usual all-or-nothing contract. This is the seam that lets one
+// framing loop ride either a TCP fd or the shm ring transport
+// (ring_transport.h) — the grpc_endpoint-vtable idea (endpoint.cc:33-54) at
+// native-app scale. (The former fd_-prefixed frame helpers were these exact
+// bodies specialized to an fd; callers now go through the templates.)
+inline void build_frame_header(std::string &hdr, uint8_t type, uint8_t flags,
+                               uint32_t sid, size_t len) {
   hdr.push_back(static_cast<char>(type));
   hdr.push_back(static_cast<char>(flags));
   put_u32(hdr, sid);
   put_u32(hdr, static_cast<uint32_t>(len));
-  return fd_write_all(fd, hdr.data(), hdr.size()) &&
-         (len == 0 || fd_write_all(fd, payload, len));
 }
 
-// Read one frame header+payload; false on EOF/error/insane length.
-inline bool fd_read_frame(int fd, uint8_t *type, uint8_t *flags,
-                          uint32_t *sid, std::vector<uint8_t> *payload) {
+template <typename T>
+inline bool t_send_frame_locked(T &t, uint8_t type, uint8_t flags,
+                                uint32_t sid, const void *payload,
+                                size_t len) {
+  std::string hdr;
+  build_frame_header(hdr, type, flags, sid, len);
+  return t.write_all(hdr.data(), hdr.size()) &&
+         (len == 0 || t.write_all(payload, len));
+}
+
+// Ring-transport specialization: header+payload as one gathered ring
+// message, one notify (R = tpr_ring::RingTransport or anything with
+// write_gather).
+template <typename R>
+inline bool ring_send_frame_locked(R &ring, uint8_t type, uint8_t flags,
+                                   uint32_t sid, const void *payload,
+                                   size_t len) {
+  std::string hdr;
+  build_frame_header(hdr, type, flags, sid, len);
+  return ring.write_gather(hdr.data(), hdr.size(), payload, len);
+}
+
+template <typename T>
+inline bool t_read_frame(T &t, uint8_t *type, uint8_t *flags, uint32_t *sid,
+                         std::vector<uint8_t> *payload) {
   uint8_t hdr[10];
-  if (!fd_read_exact(fd, hdr, sizeof hdr)) return false;
+  if (!t.read_exact(hdr, sizeof hdr)) return false;
   *type = hdr[0];
   *flags = hdr[1];
   *sid = get_u32(hdr + 2);
   uint32_t len = get_u32(hdr + 6);
   if (len > kMaxFramePayload + 65536) return false;
   payload->resize(len);
-  return len == 0 || fd_read_exact(fd, payload->data(), len);
+  return len == 0 || t.read_exact(payload->data(), len);
+}
+
+// GRPC_PLATFORM_TYPE dispatch for native apps (iomgr_internal.cc:36-61
+// analog): any of the ring platforms means "bootstrap the shm ring over
+// the connected socket"; TCP (or unset) keeps plain fd framing.
+inline bool platform_wants_ring() {
+  const char *p = getenv("TPURPC_PLATFORM_TYPE");
+  if (!p) p = getenv("GRPC_PLATFORM_TYPE");
+  if (!p) return false;
+  return strcmp(p, "RDMA_BP") == 0 || strcmp(p, "RDMA_BPEV") == 0 ||
+         strcmp(p, "RDMA_EVENT") == 0;
+}
+
+inline uint64_t ring_size_from_env() {
+  const char *p = getenv("TPURPC_RING_BUFFER_SIZE_KB");
+  if (!p) p = getenv("GRPC_RDMA_RING_BUFFER_SIZE_KB");
+  uint64_t kb = p ? strtoull(p, nullptr, 10) : 4096;
+  if (kb == 0) kb = 4096;
+  uint64_t bytes = kb * 1024;
+  // power-of-two, >= 4096 (config.py ring_buffer_size rule)
+  uint64_t size = 4096;
+  while (size < bytes) size <<= 1;
+  return size;
 }
 
 }  // namespace tpr_wire

@@ -1,0 +1,436 @@
+// Ring transport for native (C/C++) tpurpc apps: the same one-sided-write
+// shm data plane Python endpoints ride (tpurpc/core/pair.py), implemented
+// against the C ring ops in ring.cc — so GRPC_PLATFORM_TYPE=RDMA_BP|BPEV|
+// EVENT works for a pure-native process with no Python anywhere.
+//
+// Protocol parity (the authoritative impl is tpurpc/core/pair.py):
+// - bootstrap: "TRB1" magic + u32 length + JSON Address blob each way over
+//   the connected TCP fd (pair.py _send_blob/_recv_blob; the reference's
+//   exchange_data, rdma_bp_posix.cc:640-692). Address keys: tag, domain,
+//   ring_size, ring, status, caps. domain must be "shm" on both sides.
+// - data: seq-stamped header/footer framed ring messages (ring.cc ops),
+//   credits published as a one-sided u64 store of the consumer head into
+//   the peer's status page (+0) after >= capacity/4 consumed
+//   (RingReader.PUBLISH_DIVISOR), peer_exit at +8.
+// - events: the bootstrap socket stays alive as the notify channel carrying
+//   single-byte tokens 'd' (data), 'c' (credit), 'x' (exit). This side
+//   advertises NO "waitflag" capability, so the Python peer always sends
+//   notify bytes (the asymmetric-peer contract, pair.py Address.caps), and
+//   this side always sends them too — correctness first; the native app
+//   path is event-driven (the EVENT discipline), not spinning.
+//
+// Thread model matches the fd transport: one reader thread calls
+// read_exact(); any thread calls write_all() under the caller's write lock.
+#ifndef TPURPC_RING_TRANSPORT_H
+#define TPURPC_RING_TRANSPORT_H
+
+#include <fcntl.h>
+#include <poll.h>
+#include <sys/mman.h>
+#include <sys/socket.h>
+#include <sys/time.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <random>
+#include <string>
+
+#include "framing_common.h"
+
+// C ring ops (ring.cc)
+extern "C" {
+uint64_t tpr_ring_read_into(uint8_t *ring, uint64_t cap, uint64_t *head,
+                            uint64_t *msg_len, uint64_t *msg_read,
+                            uint8_t *dst, uint64_t dst_len,
+                            uint64_t *consumed, uint64_t *seq);
+uint64_t tpr_ring_writev(uint8_t *ring, uint64_t cap, uint64_t *tail,
+                         uint64_t remote_head, const uint8_t *const *segs,
+                         const uint64_t *lens, uint32_t nsegs, uint64_t *seq);
+int tpr_ring_has_message(const uint8_t *ring, uint64_t cap, uint64_t head,
+                         uint64_t seq);
+void tpr_store_u64_seqcst(uint8_t *addr, uint64_t val);
+uint64_t tpr_load_u64_fenced(const uint8_t *addr);
+}
+
+namespace tpr_ring {
+
+constexpr size_t kStatusBytes = 128;
+constexpr size_t kStatusHeadOff = 0;
+constexpr size_t kStatusExitOff = 8;
+constexpr uint64_t kReservedBytes = 24;  // header + footer + align gap
+constexpr int kPublishDivisor = 4;       // RingReader.PUBLISH_DIVISOR
+
+// ---------------------------------------------------------------------------
+// POSIX shm region (the ShmDomain analog)
+// ---------------------------------------------------------------------------
+
+struct ShmRegion {
+  std::string name;  // no leading slash (Python SharedMemory convention)
+  uint8_t *base = nullptr;
+  size_t len = 0;
+  bool owner = false;
+
+  bool create(size_t nbytes) {
+    std::random_device rd;
+    char buf[48];
+    snprintf(buf, sizeof buf, "tpr_%08x%08x", rd(), rd());
+    name = buf;
+    std::string path = "/" + name;
+    int fd = ::shm_open(path.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
+    if (fd < 0) return false;
+    if (::ftruncate(fd, (off_t)nbytes) != 0) {
+      ::close(fd);
+      ::shm_unlink(path.c_str());
+      return false;
+    }
+    base = static_cast<uint8_t *>(::mmap(nullptr, nbytes,
+                                         PROT_READ | PROT_WRITE, MAP_SHARED,
+                                         fd, 0));
+    ::close(fd);
+    if (base == MAP_FAILED) {
+      base = nullptr;
+      ::shm_unlink(path.c_str());
+      return false;
+    }
+    memset(base, 0, nbytes);
+    len = nbytes;
+    owner = true;
+    return true;
+  }
+
+  bool open(const std::string &handle_name, size_t nbytes) {
+    name = handle_name;
+    std::string path = "/" + name;
+    int fd = ::shm_open(path.c_str(), O_RDWR, 0600);
+    if (fd < 0) return false;
+    base = static_cast<uint8_t *>(::mmap(nullptr, nbytes,
+                                         PROT_READ | PROT_WRITE, MAP_SHARED,
+                                         fd, 0));
+    ::close(fd);
+    if (base == MAP_FAILED) {
+      base = nullptr;
+      return false;
+    }
+    len = nbytes;
+    owner = false;
+    return true;
+  }
+
+  void close() {
+    if (base) ::munmap(base, len);
+    base = nullptr;
+    if (owner && !name.empty()) ::shm_unlink(("/" + name).c_str());
+    name.clear();
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Minimal JSON helpers for the Address blob (we control both producers;
+// the fields are flat string/int/list-of-string)
+// ---------------------------------------------------------------------------
+
+inline bool json_find_string(const std::string &j, const char *key,
+                             std::string *out) {
+  std::string pat = std::string("\"") + key + "\":";
+  size_t p = j.find(pat);
+  if (p == std::string::npos) return false;
+  p += pat.size();
+  while (p < j.size() && (j[p] == ' ')) ++p;
+  if (p >= j.size() || j[p] != '"') return false;
+  size_t q = j.find('"', p + 1);
+  if (q == std::string::npos) return false;
+  *out = j.substr(p + 1, q - p - 1);
+  return true;
+}
+
+inline bool json_find_u64(const std::string &j, const char *key,
+                          uint64_t *out) {
+  std::string pat = std::string("\"") + key + "\":";
+  size_t p = j.find(pat);
+  if (p == std::string::npos) return false;
+  p += pat.size();
+  while (p < j.size() && j[p] == ' ') ++p;
+  char *end = nullptr;
+  unsigned long long v = strtoull(j.c_str() + p, &end, 10);
+  if (end == j.c_str() + p) return false;
+  *out = v;
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// The transport
+// ---------------------------------------------------------------------------
+
+struct RingTransport {
+  int notify_fd = -1;          // the bootstrap socket, kept as event channel
+  ShmRegion recv_ring, status;        // ours (peer writes into them)
+  ShmRegion peer_ring, peer_status;   // peer's (we write into them)
+  uint64_t ring_size = 0;       // our recv ring capacity
+  uint64_t peer_ring_size = 0;  // peer's recv ring capacity (we send into it)
+
+  // reader state (our ring)
+  uint64_t head = 0, msg_len = 0, msg_read = 0, consumed = 0, rseq = 0;
+  uint64_t published_head = 0;
+  // writer state (peer ring)
+  uint64_t tail = 0, wseq = 0, remote_head = 0;
+
+  std::atomic<bool> alive{false};
+  std::atomic<bool> peer_exited{false};  // reader + writer threads both touch
+  std::mutex notify_mu;  // serializes notify-token sends
+
+  // -- bootstrap -----------------------------------------------------------
+
+  // Client side: full TRB1 exchange on a fresh socket. Server side: pass
+  // preread_magic=true when the listener already consumed the 4 magic
+  // bytes while sniffing the protocol. timeout_ms bounds the handshake
+  // (pair.py BOOTSTRAP_TIMEOUT_S: a peer that connects but never speaks
+  // must produce an error, not a hang); <=0 keeps the 20s default.
+  bool bootstrap(int fd, uint64_t my_ring_size, bool preread_magic,
+                 std::string *err, int timeout_ms = 0) {
+    notify_fd = fd;
+    ring_size = my_ring_size;
+    struct timeval tv;
+    tv.tv_sec = timeout_ms > 0 ? timeout_ms / 1000 : 20;
+    tv.tv_usec = timeout_ms > 0 ? (timeout_ms % 1000) * 1000 : 0;
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
+    bool ok = bootstrap_inner(fd, preread_magic, err);
+    tv.tv_sec = 0;
+    tv.tv_usec = 0;  // back to blocking for the notify channel
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
+    return ok;
+  }
+
+  bool bootstrap_inner(int fd, bool preread_magic, std::string *err) {
+    if (!recv_ring.create(ring_size) || !status.create(kStatusBytes)) {
+      *err = "shm alloc failed";
+      return false;
+    }
+    char tag[16];
+    std::random_device rd;
+    snprintf(tag, sizeof tag, "%08x", rd());
+    char blob[512];
+    int blen = snprintf(
+        blob, sizeof blob,
+        "{\"tag\": \"%s\", \"domain\": \"shm\", \"ring_size\": %llu, "
+        "\"ring\": \"shm:%s\", \"status\": \"shm:%s\", \"caps\": []}",
+        tag, (unsigned long long)ring_size, recv_ring.name.c_str(),
+        status.name.c_str());
+    // send: TRB1 + u32 len + blob
+    char hdr[8] = {'T', 'R', 'B', '1'};
+    uint32_t ln = (uint32_t)blen;
+    memcpy(hdr + 4, &ln, 4);
+    if (!tpr_wire::fd_write_all(fd, hdr, 8) ||
+        !tpr_wire::fd_write_all(fd, blob, (size_t)blen)) {
+      *err = "bootstrap send failed";
+      return false;
+    }
+    // recv peer blob
+    if (!preread_magic) {
+      char magic[4];
+      if (!tpr_wire::fd_read_exact(fd, magic, 4) ||
+          memcmp(magic, "TRB1", 4) != 0) {
+        *err = "bad bootstrap magic from peer (platform mismatch?)";
+        return false;
+      }
+    }
+    uint32_t plen = 0;
+    if (!tpr_wire::fd_read_exact(fd, &plen, 4) || plen > (1u << 16)) {
+      *err = "bootstrap length read failed";
+      return false;
+    }
+    std::string pblob(plen, '\0');
+    if (!tpr_wire::fd_read_exact(fd, pblob.data(), plen)) {
+      *err = "bootstrap blob read failed";
+      return false;
+    }
+    std::string domain, ring_h, status_h;
+    uint64_t prs = 0;
+    if (!json_find_string(pblob, "domain", &domain) ||
+        !json_find_string(pblob, "ring", &ring_h) ||
+        !json_find_string(pblob, "status", &status_h) ||
+        !json_find_u64(pblob, "ring_size", &prs)) {
+      *err = "malformed peer address blob";
+      return false;
+    }
+    if (domain != "shm") {
+      *err = "domain mismatch: peer offers '" + domain + "', this app is shm";
+      return false;
+    }
+    if (ring_h.rfind("shm:", 0) != 0 || status_h.rfind("shm:", 0) != 0) {
+      *err = "peer handles not shm";
+      return false;
+    }
+    peer_ring_size = prs;
+    if (!peer_ring.open(ring_h.substr(4), peer_ring_size) ||
+        !peer_status.open(status_h.substr(4), kStatusBytes)) {
+      *err = "mapping peer shm failed";
+      return false;
+    }
+    alive.store(true);
+    return true;
+  }
+
+  // -- byte-stream contract (same as the fd helpers) -----------------------
+
+  bool write_all(const void *buf, size_t len) {
+    const uint8_t *p = static_cast<const uint8_t *>(buf);
+    while (len > 0 && alive.load()) {
+      fold_credits();
+      uint64_t writable = writable_now();
+      if (writable == 0) {
+        if (peer_gone()) return false;
+        if (!wait_event(100)) continue;  // slice + re-check (lost-notify safe)
+        continue;
+      }
+      uint64_t n = len < writable ? len : writable;
+      const uint8_t *segs[1] = {p};
+      uint64_t lens[1] = {n};
+      uint64_t got = tpr_ring_writev(peer_ring.base, peer_ring_size, &tail,
+                                     remote_head, segs, lens, 1, &wseq);
+      if (got == ~0ULL) continue;  // raced our own budget math
+      p += got;
+      len -= got;
+      notify('d');
+    }
+    return len == 0;
+  }
+
+  // Whole-frame gather send: header + payload as ONE ring message with ONE
+  // notify token — the per-RPC hot path (two write_all calls would cost two
+  // framed messages and two notify syscalls). Falls back to sequential
+  // write_all when the frame exceeds a single message's capacity.
+  bool write_gather(const void *a, size_t alen, const void *b, size_t blen) {
+    uint64_t total = alen + blen;
+    uint64_t max_msg = peer_ring_size > kReservedBytes
+                           ? peer_ring_size - kReservedBytes
+                           : 0;
+    if (total > max_msg)
+      return write_all(a, alen) && (blen == 0 || write_all(b, blen));
+    while (alive.load()) {
+      fold_credits();
+      if (writable_now() >= total) {
+        const uint8_t *segs[2] = {static_cast<const uint8_t *>(a),
+                                  static_cast<const uint8_t *>(b)};
+        uint64_t lens[2] = {alen, blen};
+        uint64_t got = tpr_ring_writev(peer_ring.base, peer_ring_size, &tail,
+                                       remote_head, segs, lens,
+                                       blen ? 2 : 1, &wseq);
+        if (got != ~0ULL) {
+          notify('d');
+          return true;
+        }
+      }
+      if (peer_gone()) return false;
+      wait_event(100);
+    }
+    return false;
+  }
+
+  bool read_exact(void *buf, size_t len) {
+    uint8_t *p = static_cast<uint8_t *>(buf);
+    while (len > 0) {
+      uint64_t got = tpr_ring_read_into(recv_ring.base, ring_size, &head,
+                                        &msg_len, &msg_read, p, len,
+                                        &consumed, &rseq);
+      if (got == ~0ULL) return false;  // corruption
+      p += got;
+      len -= got;
+      publish_credits_if_due();
+      if (len == 0) break;
+      if (!alive.load()) return false;
+      if (ring_empty_and_peer_gone()) return false;  // clean EOF
+      wait_event(100);
+    }
+    return true;
+  }
+
+  void shutdown() {
+    // graceful: tell the peer (exit word + token), then unblock our reader
+    if (peer_status.base) {
+      tpr_store_u64_seqcst(peer_status.base + kStatusExitOff, 1);
+      notify('x');
+    }
+    alive.store(false);
+    if (notify_fd >= 0) ::shutdown(notify_fd, SHUT_RDWR);
+  }
+
+  void close() {
+    alive.store(false);
+    recv_ring.close();
+    status.close();
+    peer_ring.close();
+    peer_status.close();
+  }
+
+  // -- internals -----------------------------------------------------------
+
+  void fold_credits() {
+    uint64_t h = tpr_load_u64_fenced(status.base + kStatusHeadOff);
+    if (h > remote_head && h <= tail) remote_head = h;
+  }
+
+  uint64_t writable_now() const {
+    uint64_t used = tail - remote_head;
+    return used + kReservedBytes >= peer_ring_size
+               ? 0
+               : peer_ring_size - used - kReservedBytes;
+  }
+
+  bool peer_gone() {
+    return tpr_load_u64_fenced(status.base + kStatusExitOff) != 0 ||
+           peer_exited || !alive.load();
+  }
+
+  bool ring_empty_and_peer_gone() {
+    if (!peer_gone()) return false;
+    // peer exited, but drain whatever it wrote before leaving
+    return !tpr_ring_has_message(recv_ring.base, ring_size, head, rseq) &&
+           msg_len == 0;
+  }
+
+  void publish_credits_if_due(bool force = false) {
+    if (!peer_status.base) return;
+    if (force || consumed >= ring_size / kPublishDivisor) {
+      consumed = 0;
+      if (head != published_head) {
+        published_head = head;
+        tpr_store_u64_seqcst(peer_status.base + kStatusHeadOff, head);
+        notify('c');
+      }
+    }
+  }
+
+  void notify(char token) {
+    std::lock_guard<std::mutex> lk(notify_mu);
+    if (notify_fd < 0) return;
+    ::send(notify_fd, &token, 1, MSG_NOSIGNAL | MSG_DONTWAIT);
+    // EAGAIN => tokens already queued: the peer has wakeups pending
+  }
+
+  // Block up to timeout_ms for a notify token (or peer close). Returns true
+  // if an event arrived. Always drains every queued token.
+  bool wait_event(int timeout_ms) {
+    struct pollfd pfd = {notify_fd, POLLIN, 0};
+    int r = ::poll(&pfd, 1, timeout_ms);
+    if (r <= 0) return false;
+    char tokens[64];
+    ssize_t n = ::recv(notify_fd, tokens, sizeof tokens, MSG_DONTWAIT);
+    if (n == 0) {  // peer closed the event channel: connection over
+      peer_exited = true;
+      return true;
+    }
+    for (ssize_t i = 0; i < n; ++i)
+      if (tokens[i] == 'x') peer_exited = true;
+    return n > 0;
+  }
+};
+
+}  // namespace tpr_ring
+
+#endif  // TPURPC_RING_TRANSPORT_H

@@ -10,6 +10,8 @@
 
 #include "../include/tpurpc/server.h"
 
+#include "ring_transport.h"
+
 #include <arpa/inet.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
@@ -60,6 +62,10 @@ namespace {
 
 struct Conn {
   int fd = -1;
+  // non-null when this connection bootstrapped the shm ring data plane
+  // (client opened with the TRB1 magic): frames ride the ring, the fd
+  // stays inside the transport as the notify channel
+  tpr_ring::RingTransport *ring = nullptr;
   std::mutex write_mu;             // serializes whole frames
   std::mutex mu;                   // guards streams + call state
   std::condition_variable cv;      // signaled on any delivery
@@ -69,11 +75,28 @@ struct Conn {
   std::thread thread;
   std::atomic<int> handler_threads{0};
 
+  ~Conn() {
+    if (ring) {
+      ring->close();
+      delete ring;
+    }
+  }
+
+  bool write_all(const void *buf, size_t len) {
+    return ring ? ring->write_all(buf, len) : fd_write_all(fd, buf, len);
+  }
+
+  bool read_exact(void *buf, size_t len) {
+    return ring ? ring->read_exact(buf, len) : fd_read_exact(fd, buf, len);
+  }
+
   bool send_frame(uint8_t type, uint8_t flags, uint32_t sid,
                   const void *payload, size_t len) {
     std::lock_guard<std::mutex> lk(write_mu);
     if (fd_closed.load()) return false;
-    return fd_send_frame_locked(fd, type, flags, sid, payload, len);
+    if (ring)  // one gathered ring message + one notify per frame
+      return ring_send_frame_locked(*ring, type, flags, sid, payload, len);
+    return t_send_frame_locked(*this, type, flags, sid, payload, len);
   }
 
   void send_trailers(uint32_t sid, int code, const std::string &details) {
@@ -88,7 +111,10 @@ struct Conn {
     // write_mu excludes a concurrent send_frame mid-write on the dying fd;
     // the flag (checked under write_mu) prevents double close / fd reuse.
     std::lock_guard<std::mutex> lk(write_mu);
-    if (!fd_closed.exchange(true)) ::close(fd);
+    if (!fd_closed.exchange(true)) {
+      if (ring) ring->shutdown();  // exit word + notify before fd close
+      ::close(fd);
+    }
   }
 
   void shutdown_fd() {
@@ -96,7 +122,10 @@ struct Conn {
     // critical section, or a racing close_fd can recycle the fd number
     // between them and this shutdown() hits an unrelated descriptor.
     std::lock_guard<std::mutex> lk(write_mu);
-    if (!fd_closed.load()) ::shutdown(fd, SHUT_RDWR);
+    if (!fd_closed.load()) {
+      if (ring) ring->shutdown();
+      ::shutdown(fd, SHUT_RDWR);
+    }
   }
 };
 
@@ -132,15 +161,41 @@ struct tpr_server {
     c->handler_threads.fetch_sub(1);
   }
 
-  void serve_conn(Conn *c) {
+  // Protocol sniff + preface, mirroring the Python listener (peek_protocol,
+  // endpoint.py): ring clients open with the 4-byte TRB1 bootstrap magic;
+  // plain framing clients send the 8-byte TPURPC preface. False = dead conn.
+  bool accept_preface(Conn *c) {
     char magic[8];
-    if (!fd_read_exact(c->fd, magic, 8) || memcmp(magic, kMagic, 8) != 0)
-      return;
+    if (!fd_read_exact(c->fd, magic, 4)) return false;
+    if (memcmp(magic, "TRB1", 4) == 0) {
+      auto *rt = new tpr_ring::RingTransport();
+      std::string err;
+      if (!rt->bootstrap(c->fd, tpr_wire::ring_size_from_env(),
+                         /*preread_magic=*/true, &err)) {
+        fprintf(stderr, "tpurpc server: ring bootstrap failed: %s\n",
+                err.c_str());
+        rt->close();
+        delete rt;
+        return false;
+      }
+      c->ring = rt;
+      // the framing preface now rides the ring byte stream
+      return c->read_exact(magic, 8) && memcmp(magic, kMagic, 8) == 0;
+    }
+    return fd_read_exact(c->fd, magic + 4, 4) &&
+           memcmp(magic, kMagic, 8) == 0;
+  }
+
+  void serve_conn(Conn *c) {
+    bool serving = accept_preface(c);
+    // a failed preface still falls through to the shared teardown below:
+    // early returns here used to leak the Conn (alive stayed true, so
+    // reap_dead_conns never freed it) and its fd
     uint8_t type, flags;
     uint32_t sid;
     std::vector<uint8_t> payload;
-    while (running.load() && c->alive.load()) {
-      if (!fd_read_frame(c->fd, &type, &flags, &sid, &payload)) break;
+    while (serving && running.load() && c->alive.load()) {
+      if (!t_read_frame(*c, &type, &flags, &sid, &payload)) break;
       if (type == kPing) {
         c->send_frame(kPong, 0, 0, payload.data(), payload.size());
         continue;

@@ -29,9 +29,12 @@ def _build_cpp(out_bin, example, native_src, headers):
     if gxx is None:
         pytest.skip("no g++ toolchain")
     os.makedirs(os.path.dirname(out_bin), exist_ok=True)
-    srcs = [os.path.join(ROOT, "examples", example),
-            os.path.join(ROOT, "native", "src", native_src)]
-    deps = srcs + [os.path.join(ROOT, "native", "src", "framing_common.h")] + [
+    native_srcs = ([native_src] if isinstance(native_src, str)
+                   else list(native_src))
+    srcs = [os.path.join(ROOT, "examples", example)] + [
+        os.path.join(ROOT, "native", "src", ns) for ns in native_srcs]
+    deps = srcs + [os.path.join(ROOT, "native", "src", h) for h in
+                   ("framing_common.h", "ring_transport.h")] + [
         os.path.join(ROOT, "native", "include", "tpurpc", h) for h in headers]
     if (os.path.exists(out_bin)
             and all(os.path.getmtime(out_bin) > os.path.getmtime(d)
@@ -45,7 +48,7 @@ def _build_cpp(out_bin, example, native_src, headers):
 
 
 def _build_example():
-    _build_cpp(BIN, "cpp_client.cc", "tpurpc_client.cc",
+    _build_cpp(BIN, "cpp_client.cc", ["tpurpc_client.cc", "ring.cc"],
                ["client.h", "client.hpp"])
 
 
@@ -143,6 +146,7 @@ int main() {{
         subprocess.run(
             ["g++", "-std=c++17", "-O0", tmp_src,
              os.path.join(ROOT, "native", "src", "tpurpc_client.cc"),
+             os.path.join(ROOT, "native", "src", "ring.cc"),
              "-I", os.path.join(ROOT, "native", "include"),
              "-lpthread", "-o", tmp_bin],
             check=True, timeout=180, capture_output=True)
@@ -160,7 +164,7 @@ SRV_BIN = os.path.join(ROOT, "native", "build", "cpp_server_example")
 
 
 def _build_server_example():
-    _build_cpp(SRV_BIN, "cpp_server.cc", "tpurpc_server.cc",
+    _build_cpp(SRV_BIN, "cpp_server.cc", ["tpurpc_server.cc", "ring.cc"],
                ["server.h", "server.hpp"])
 
 
@@ -281,10 +285,12 @@ def test_cpp_loop_under_asan():
              "-I", os.path.join(ROOT, "native", "include"), "-lpthread"]
     subprocess.run([gxx, os.path.join(ROOT, "examples", "cpp_server.cc"),
                     os.path.join(ROOT, "native", "src", "tpurpc_server.cc"),
+                    os.path.join(ROOT, "native", "src", "ring.cc"),
                     *flags, "-o", asan_srv],
                    check=True, timeout=180, capture_output=True)
     subprocess.run([gxx, os.path.join(ROOT, "examples", "cpp_client.cc"),
                     os.path.join(ROOT, "native", "src", "tpurpc_client.cc"),
+                    os.path.join(ROOT, "native", "src", "ring.cc"),
                     *flags, "-o", asan_cli],
                    check=True, timeout=180, capture_output=True)
     proc = subprocess.Popen([asan_srv], stdout=subprocess.PIPE,
@@ -352,6 +358,7 @@ def test_python_client_against_cpp_callback_server(tmp_path):
     subprocess.run(
         [gxx, "-std=c++17", "-O1", str(src),
          os.path.join(ROOT, "native", "src", "tpurpc_server.cc"),
+         os.path.join(ROOT, "native", "src", "ring.cc"),
          "-I", os.path.join(ROOT, "native", "include"),
          "-lpthread", "-o", str(binp)],
         check=True, timeout=180, capture_output=True)
@@ -405,6 +412,7 @@ def test_micro_native_bench_smoke(tmp_path):
          os.path.join(ROOT, "native", "bench", "micro_native.cc"),
          os.path.join(ROOT, "native", "src", "tpurpc_client.cc"),
          os.path.join(ROOT, "native", "src", "tpurpc_server.cc"),
+         os.path.join(ROOT, "native", "src", "ring.cc"),
          "-I", os.path.join(ROOT, "native", "include"),
          "-lpthread", "-o", str(binp)],
         check=True, timeout=180, capture_output=True)
@@ -417,3 +425,80 @@ def test_micro_native_bench_smoke(tmp_path):
         rec = _json.loads(out.stdout.strip().splitlines()[-1])
         assert rec["rpcs"] > 100
         assert rec["rtt_us_p50"] > 0
+
+
+# -- C++ apps on the RING transport (VERDICT r2 next#8) ----------------------
+
+def test_cpp_client_rides_ring_data_plane(monkeypatch):
+    """GRPC_PLATFORM_TYPE=RDMA_BP in the C++ client's env makes it bootstrap
+    the shm ring over the socket and run ALL frames through one-sided ring
+    writes — app code unchanged (the reference's defining property,
+    endpoint.cc:33-54, now for native apps)."""
+    monkeypatch.setenv("GRPC_PLATFORM_TYPE", "RDMA_BP")
+    _build_example()
+    srv = _server()
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    try:
+        env = dict(os.environ, GRPC_PLATFORM_TYPE="RDMA_BP",
+                   GRPC_RDMA_RING_BUFFER_SIZE_KB="1024")
+        proc = subprocess.run([BIN, str(port)], capture_output=True,
+                              text=True, timeout=120, env=env)
+        assert proc.returncode == 0, (proc.stdout, proc.stderr)
+        _check(proc.stdout)
+    finally:
+        srv.stop(grace=0)
+
+
+def test_python_client_against_cpp_ring_server(monkeypatch):
+    """Reverse direction: the Python channel ring-bootstraps against a pure
+    C++ server whose listener protocol-sniffs TRB1."""
+    monkeypatch.setenv("GRPC_PLATFORM_TYPE", "RDMA_BP")
+    monkeypatch.setenv("GRPC_RDMA_RING_BUFFER_SIZE_KB", "1024")
+    _build_server_example()
+    env = dict(os.environ, GRPC_PLATFORM_TYPE="RDMA_BP",
+               GRPC_RDMA_RING_BUFFER_SIZE_KB="1024")
+    proc = subprocess.Popen([SRV_BIN], stdout=subprocess.PIPE,
+                            stdin=subprocess.PIPE, text=True, env=env)
+    try:
+        port = int(proc.stdout.readline().split()[1])
+        with rpc.Channel(f"127.0.0.1:{port}") as ch:
+            hello = ch.unary_unary("/demo.Greeter/SayHello")
+            assert hello(b"ring", timeout=20) == b"Hello, ring!"
+            # big payload: wrap-split + partial sends + credit returns
+            big = b"R" * (3 << 20)
+            echo = ch.unary_unary("/demo.Greeter/Echo")
+            assert echo(big, timeout=60) == big
+            # streaming across the ring
+            chat = ch.stream_stream("/demo.Greeter/Chat")
+            got = [bytes(m) for m in chat(iter([b"a", b"b"]), timeout=20)]
+            assert got == [b"echo:a", b"echo:b"]
+    finally:
+        proc.stdin.close()
+        proc.wait(timeout=10)
+
+
+def test_cpp_ring_micro_smoke(tmp_path):
+    """C++ client <-> C++ server entirely over the ring transport."""
+    gxx = shutil.which("g++")
+    if gxx is None:
+        pytest.skip("no g++ toolchain")
+    binp = tmp_path / "micro_ring"
+    subprocess.run(
+        [gxx, "-std=c++17", "-O2",
+         os.path.join(ROOT, "native", "bench", "micro_native.cc"),
+         os.path.join(ROOT, "native", "src", "tpurpc_client.cc"),
+         os.path.join(ROOT, "native", "src", "tpurpc_server.cc"),
+         os.path.join(ROOT, "native", "src", "ring.cc"),
+         "-I", os.path.join(ROOT, "native", "include"),
+         "-lpthread", "-o", str(binp)],
+        check=True, timeout=180, capture_output=True)
+    import json as _json
+
+    env = dict(os.environ, GRPC_PLATFORM_TYPE="RDMA_BP",
+               GRPC_RDMA_RING_BUFFER_SIZE_KB="1024")
+    out = subprocess.run([str(binp), "4096", "1", "1", "1"],
+                         capture_output=True, text=True, timeout=60, env=env)
+    assert out.returncode == 0, out.stderr
+    rec = _json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["rpcs"] > 100
