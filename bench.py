@@ -373,6 +373,10 @@ def main() -> None:
         sys.stderr.write(f"default-platform bench failed ({exc});"
                          f" retrying with JAX_PLATFORMS=cpu\n")
         env["TPURPC_BENCH_CPU"] = "1"
+        # The axon sitecustomize registers the tunnel plugin whenever this
+        # var is set, and a black-holing tunnel hangs backend init even
+        # under jax_platforms=cpu — the fallback must not touch it at all.
+        env.pop("PALLAS_AXON_POOL_IPS", None)
         fallback = True
         gbps, platform, serving, extras = _run_once(env, n_msgs, ready_s)
 

@@ -7,6 +7,12 @@ Multi-chip hardware is unavailable in CI; shardings are validated the way the dr
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Subprocesses spawned by tests must never touch the TPU tunnel either:
+# the axon sitecustomize registers its PJRT plugin whenever this var is
+# set, and a black-holing tunnel then hangs ANY jax-importing child at
+# first use (observed mid round-3: jnp.zeros blocking >200s). Popping it
+# here sanitizes the env every test child inherits.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -86,21 +86,56 @@ class HbmRing:
         # n is static per shape; jit caches per payload size
         self._slice = jax.jit(_slice, static_argnums=2)
 
+    def _pallas_ok(self, p: int, n: int, min_capacity: int,
+                   broken_attr: str) -> bool:
+        """Shared eligibility guard for the place/view kernels: first-failure
+        latch, 4-byte alignment, capacity floor, validated platforms, env
+        opt-out (``TPURPC_PALLAS=0``)."""
+        import os
+
+        return not (getattr(self, broken_attr, False)
+                    or p % 4 or n % 4 or self.capacity < min_capacity
+                    or self.device.platform not in ("cpu", "tpu")
+                    or os.environ.get("TPURPC_PALLAS", "1") == "0")
+
+    def _pallas_place(self, dev_payload, p: int, n: int) -> bool:
+        """Land ``dev_payload`` at physical offset ``p`` via the aliased
+        ring_scatter kernel (tpurpc.ops.ring_scatter) — ONE landing write
+        for a WRAPPED span instead of two donated dynamic_update_slice
+        dispatches (callers only invoke this when the span wraps; the
+        non-wrap case is already a single update). Returns False to use
+        the jax-op chain."""
+        if not self._pallas_ok(p, n, 2 * 9 * 512, "_pallas_place_broken"):
+            return False
+        on_cpu = self.device.platform == "cpu"
+        try:
+            from tpurpc.ops.ring_scatter import ring_scatter
+
+            self.buf = ring_scatter(self.buf, dev_payload, p,
+                                    interpret=on_cpu)
+            return True
+        except Exception as exc:
+            # ring_scatter DONATES the ring. A compile-time failure (the
+            # usual Mosaic/tunnel mode) raises before launch, so the buffer
+            # is intact and falling back is safe. A post-launch runtime
+            # failure consumed the donation — the old contents are gone and
+            # "fallback" would update a deleted array after tail/_live were
+            # advanced: surface the corruption honestly instead.
+            if getattr(self.buf, "is_deleted", lambda: False)():
+                raise
+            self._pallas_place_broken = True
+            import warnings
+
+            warnings.warn(f"pallas ring_scatter disabled after failure: {exc}")
+            return False
+
     def _pallas_window(self, p: int, n: int):
         """Fused wrapped-window gather (tpurpc.ops.ring_window), or None to
         use the jax-op chain. The kernel is validated on real TPU hardware
         (v5e) and in interpret mode (CPU, where the suite runs it on every
         wrapped view) — on by default, ``TPURPC_PALLAS=0`` opts out."""
-        import os
-
-        if getattr(self, "_pallas_broken", False):
-            return None  # failed once: don't re-pay trace+raise per view
-        if p % 4 or n % 4 or self.capacity % 4 or self.capacity < 9 * 512:
-            return None  # alignment/size the kernel can't take
-        if self.device.platform not in ("cpu", "tpu"):
-            return None  # validated on TPU (+ CPU interpret) only
-        if os.environ.get("TPURPC_PALLAS", "1") == "0":
-            return None
+        if not self._pallas_ok(p, n, 9 * 512, "_pallas_broken"):
+            return None  # ineligible, or failed once (don't re-pay per view)
         on_cpu = self.device.platform == "cpu"
         try:
             from tpurpc.ops import ring_window
@@ -162,11 +197,16 @@ class HbmRing:
             dev = jax.device_put(jax.numpy.asarray(src), self.device)
             ledger.dma_h2d(n)
             first = min(n, self.capacity - p)
-            # Donating update: rebinding self.buf under the lock — view()
-            # must never slice a just-donated (deleted) binding.
-            self.buf = self._update(self.buf, dev[:first], p)
-            if first < n:  # wrap: second placement at offset 0
-                self.buf = self._update(self.buf, dev[first:], 0)
+            # Wrapped spans prefer the aliased ring_scatter kernel — ONE
+            # landing write instead of two donated updates (VERDICT r2
+            # next#6); non-wrapped spans are already a single update. The
+            # jax-op chain below is the fallback law.
+            if first >= n or not self._pallas_place(dev, p, n):
+                # Donating update: rebinding self.buf under the lock —
+                # view() must never slice a just-donated (deleted) binding.
+                self.buf = self._update(self.buf, dev[:first], p)
+                if first < n:  # wrap: second placement at offset 0
+                    self.buf = self._update(self.buf, dev[first:], 0)
             ledger.dma_d2d(n)  # the in-ring landing write
         return off, n
 
