@@ -1,0 +1,190 @@
+"""On-chip validation + link-ceiling measurement battery.
+
+Runs everything this repo needs from a live TPU in one shot (the axon
+tunnel dies for hours at a time — when it's up, harvest fast):
+
+1. ring_window + ring_scatter Pallas kernels vs numpy oracles, compiled
+   (interpret=False) on the real chip, across wrap phases.
+2. Raw link ceiling with RANDOM data (the tunnel compresses zeros/ones —
+   BASELINE.md honesty note): h2d bandwidth, d2h bandwidth, on-device
+   d2d copy bandwidth. These are the denominators for "X% of link".
+3. Zero-copy `view` experiment (VERDICT r2 next#7): can a jax.Array alias
+   ring memory? Tries device-side dlpack round trip and
+   unsafe_buffer_pointer identity on a dynamic_slice — records whether
+   XLA ever returns an alias (expected: no; dynamic_slice materializes)
+   and the measured d2d slice bandwidth that is therefore the `view`
+   floor.
+
+Writes ONE JSON blob to stdout and (unless --no-save) to
+bench/results/chipcheck.json. Budget-bounded: every phase has a timeout;
+a dead tunnel yields {"ok": false, "error": ...} instead of a hang.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _now():
+    return time.perf_counter()
+
+
+def main() -> int:
+    out = {"ok": False, "started_unix": time.time()}
+    t0 = _now()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    dev = jax.devices()[0]
+    out["jax_platform"] = dev.platform
+    out["device_kind"] = getattr(dev, "device_kind", "?")
+    out["devices_init_s"] = round(_now() - t0, 1)
+    on_chip = dev.platform not in ("cpu",)
+    rng = np.random.default_rng(0)
+
+    # -- 1. kernel validation on the chip -----------------------------------
+    kern = {}
+    try:
+        from tpurpc.ops.ring_scatter import ring_scatter, ring_scatter_reference
+        from tpurpc.ops.ring_window import ring_window, ring_window_reference
+
+        interp = not on_chip  # compiled Mosaic on the chip; interpret on CPU
+        cap = 1 << 20  # 1 MiB ring
+        ring0 = rng.integers(0, 256, cap, dtype=np.uint8)
+        cases = [(0, 4096), (4 * 37, 65536), (cap - 2048, 8192),
+                 (cap - 4 * 100, 4096), (4 * 513, 4 * 300)]
+        t = _now()
+        buf = jax.device_put(jnp.asarray(ring0), dev)
+        for start, n in cases:
+            pay = rng.integers(0, 256, n, dtype=np.uint8)
+            want = ring_scatter_reference(np.asarray(buf), pay, start)
+            buf = ring_scatter(buf, jax.device_put(jnp.asarray(pay), dev),
+                               start, interpret=interp)
+            got = np.asarray(buf)
+            if not np.array_equal(got, want):
+                raise AssertionError(f"scatter mismatch at {start},{n}")
+        kern["ring_scatter"] = "ok"
+        kern["ring_scatter_compiled"] = not interp
+        kern["ring_scatter_s"] = round(_now() - t, 1)
+        t = _now()
+        snap = np.asarray(buf)
+        for head, n in [(0, 4096), (cap - 2048, 8192), (4 * 37, 65536)]:
+            want = ring_window_reference(snap, head, n)
+            got = np.asarray(ring_window(buf, head, n, interpret=interp))
+            if not np.array_equal(got, want):
+                raise AssertionError(f"window mismatch at {head},{n}")
+        kern["ring_window"] = "ok"
+        kern["ring_window_s"] = round(_now() - t, 1)
+    except Exception as exc:
+        kern["error"] = f"{type(exc).__name__}: {exc}"
+    out["kernels"] = kern
+
+    # -- 2. raw link ceiling (random data; the tunnel compresses) ----------
+    link = {}
+    try:
+        n_mb = 8
+        x = rng.standard_normal((n_mb << 18,), dtype=np.float32)  # n_mb MiB
+        # h2d
+        t = _now()
+        reps = 0
+        while _now() - t < 8.0:
+            y = jax.device_put(x, dev)
+            y.block_until_ready()
+            reps += 1
+            if reps >= 8:
+                break
+        link["h2d_gbps"] = round(reps * x.nbytes / (_now() - t) / 1e9, 3)
+        # d2h
+        t = _now()
+        reps = 0
+        while _now() - t < 8.0:
+            _ = np.asarray(y)
+            reps += 1
+            if reps >= 8:
+                break
+        link["d2h_gbps"] = round(reps * x.nbytes / (_now() - t) / 1e9, 3)
+        # on-device copy (the floor for a copying `view`)
+        cp = jax.jit(lambda a: a + 0)
+        cp(y).block_until_ready()
+        t = _now()
+        reps = 0
+        while _now() - t < 5.0:
+            cp(y).block_until_ready()
+            reps += 1
+            if reps >= 20:
+                break
+        link["d2d_copy_gbps"] = round(
+            2 * reps * x.nbytes / (_now() - t) / 1e9, 3)  # read+write
+    except Exception as exc:
+        link["error"] = f"{type(exc).__name__}: {exc}"
+    out["link"] = link
+
+    # -- 3. zero-copy view experiment ---------------------------------------
+    zc = {}
+    try:
+        big = jax.device_put(
+            jnp.asarray(rng.integers(0, 256, 1 << 20, dtype=np.uint8)), dev)
+        big.block_until_ready()
+
+        def ptr_of(arr):
+            try:
+                return arr.addressable_shards[0].data.unsafe_buffer_pointer()
+            except Exception:
+                return None
+
+        base_ptr = ptr_of(big)
+        zc["base_ptr_known"] = base_ptr is not None
+        sl = jax.jit(lambda a: jax.lax.dynamic_slice(a, (4096,), (65536,)))(big)
+        sl.block_until_ready()
+        sl_ptr = ptr_of(sl)
+        zc["slice_ptr_known"] = sl_ptr is not None
+        if base_ptr is not None and sl_ptr is not None:
+            inside = base_ptr <= sl_ptr < base_ptr + (1 << 20)
+            zc["slice_aliases_ring"] = bool(inside)
+        # dlpack round trip: does importing a slice produce an alias?
+        try:
+            back = jnp.from_dlpack(sl)  # consumes sl.__dlpack__()
+            back.block_until_ready()
+            zc["dlpack_roundtrip"] = True
+            zc["dlpack_ptr_same"] = (ptr_of(back) == sl_ptr
+                                     if sl_ptr is not None else None)
+        except Exception as exc:
+            zc["dlpack_roundtrip"] = f"failed: {type(exc).__name__}: {exc}"
+        # measured slice (view) bandwidth — the copy floor if no aliasing
+        slf = jax.jit(lambda a: jax.lax.dynamic_slice(a, (0,), (1 << 19,)))
+        slf(big).block_until_ready()
+        t = _now()
+        reps = 0
+        while _now() - t < 5.0:
+            slf(big).block_until_ready()
+            reps += 1
+            if reps >= 40:
+                break
+        zc["slice_copy_gbps"] = round(
+            2 * reps * (1 << 19) / (_now() - t) / 1e9, 3)
+    except Exception as exc:
+        zc["error"] = f"{type(exc).__name__}: {exc}"
+    out["zero_copy"] = zc
+
+    out["ok"] = "error" not in kern and "error" not in link
+    out["on_chip"] = on_chip
+    out["total_s"] = round(_now() - t0, 1)
+    blob = json.dumps(out, indent=1)
+    print(blob)
+    if "--no-save" not in sys.argv:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "results", "chipcheck.json")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(blob + "\n")
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
