@@ -36,6 +36,21 @@ def _now():
 
 def main() -> int:
     out = {"ok": False, "started_unix": time.time()}
+    # Hard watchdog: the docstring's no-hang promise. jax calls on a dying
+    # tunnel block indefinitely (observed: jax.devices() >10min); SIGALRM
+    # cannot interrupt them gracefully, so on fire we emit the error JSON
+    # and hard-exit.
+    import signal
+
+    budget = int(os.environ.get("CHIPCHECK_BUDGET_S", "1200"))
+
+    def _die(signum, frame):
+        out["error"] = f"watchdog: exceeded {budget}s (tunnel hung?)"
+        print(json.dumps(out))
+        os._exit(2)
+
+    signal.signal(signal.SIGALRM, _die)
+    signal.alarm(budget)
     t0 = _now()
     import jax
     import jax.numpy as jnp
@@ -100,13 +115,18 @@ def main() -> int:
             if reps >= 8:
                 break
         link["h2d_gbps"] = round(reps * x.nbytes / (_now() - t) / 1e9, 3)
-        # d2h
+        # d2h — one FRESH device array per rep: jax.Array caches its host
+        # copy (_npy_value) on first np.asarray, so re-reading one array
+        # measures the cache, not the link
+        fresh = [jax.device_put(x, dev) + np.float32(i) for i in range(4)]
+        for a in fresh:
+            a.block_until_ready()
         t = _now()
         reps = 0
-        while _now() - t < 8.0:
-            _ = np.asarray(y)
+        for a in fresh:
+            _ = np.asarray(a)
             reps += 1
-            if reps >= 8:
+            if _now() - t > 12.0:
                 break
         link["d2h_gbps"] = round(reps * x.nbytes / (_now() - t) / 1e9, 3)
         # on-device copy (the floor for a copying `view`)
