@@ -110,11 +110,23 @@ class TcpEndpoint(Endpoint):
         #: bytes already consumed from the socket by the listener's protocol
         #: peek (ring-platform dispatch); served to readers first
         self._preread = bytearray(preread)
+        #: TLS only: serializes ALL OpenSSL calls on this socket. CPython
+        #: releases the GIL around SSL_read/SSL_write, and OpenSSL forbids
+        #: concurrent use of one SSL* — the reader and writer threads racing
+        #: produced sporadic DECRYPTION_FAILED_OR_BAD_RECORD_MAC under load
+        #: (the round-2/3 mTLS flake). Lock holds are bounded (every locked
+        #: SSL call carries a short settimeout); fd-level readiness waits
+        #: happen OUTSIDE the lock, so a blocked peer can never deadlock the
+        #: two directions against each other.
+        self._ssl_lock = (threading.Lock()
+                          if hasattr(sock, "pending") else None)
         # The socket stays BLOCKING for its whole life; read deadlines are a
         # select() ahead of the recv instead of settimeout(). settimeout is
         # per-socket state, so a writer thread flipping it to blocking would
         # clobber a concurrent reader's deadline (last-setter-wins) — the
-        # FrameReader's resume path depends on its ReadTimeout actually firing.
+        # FrameReader's resume path depends on its ReadTimeout actually
+        # firing. (TLS sockets DO flip settimeout, but only under _ssl_lock,
+        # which every SSL read and write holds — race-free by construction.)
         sock.setblocking(True)
         try:
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -125,12 +137,10 @@ class TcpEndpoint(Endpoint):
         self._closed = False
 
     def _await_readable(self, timeout: Optional[float]) -> None:
+        # Plaintext sockets only: TLS reads divert to _ssl_recv before
+        # reaching here (whose locked-recv-first pass covers the
+        # TLS-buffered-plaintext case poll() can't see).
         if timeout is None:
-            return
-        # TLS: records already decrypted into the SSL layer are invisible to
-        # poll() on the raw fd — check the buffered byte count first.
-        pending = getattr(self._sock, "pending", None)
-        if pending is not None and pending():
             return
         import select
 
@@ -145,6 +155,50 @@ class TcpEndpoint(Endpoint):
         if not r:
             raise ReadTimeout()
 
+    def _ssl_recv(self, fn, timeout: Optional[float]):
+        """One serialized SSL read. Each pass tries a short locked SSL_read
+        first (TLS-buffered plaintext is invisible to the raw fd, and
+        SSL_pending itself isn't safe to probe unlocked), then waits for
+        raw-fd readability OUTSIDE the lock — so an idle reader parks in
+        poll() holding nothing and a writer is never starved."""
+        import select
+        import ssl as _ssl
+
+        deadline = (None if timeout is None
+                    else time.monotonic() + max(0.0, timeout))
+        while True:
+            hold = 0.1
+            if deadline is not None:
+                # honor sub-100ms deadlines: never block past the caller's
+                # budget inside the locked recv
+                hold = max(0.001, min(hold, deadline - time.monotonic()))
+            with self._ssl_lock:
+                if self._closed:
+                    raise EndpointError("read on closed endpoint")
+                self._sock.settimeout(hold)
+                try:
+                    return fn()
+                except (socket.timeout, _ssl.SSLWantReadError):
+                    pass  # nothing buffered/partial record: wait off-lock
+                finally:
+                    try:
+                        self._sock.settimeout(None)
+                    except OSError:
+                        pass
+            if deadline is not None:
+                remain = deadline - time.monotonic()
+                if remain <= 0:
+                    raise ReadTimeout()
+                slice_s = min(remain, 5.0)
+            else:
+                slice_s = 5.0
+            try:
+                p = select.poll()
+                p.register(self._sock.fileno(), select.POLLIN)
+                p.poll(slice_s * 1000.0)
+            except (OSError, ValueError) as exc:
+                raise EndpointError(f"tcp read failed: {exc}") from exc
+
     def read(self, max_bytes: int = 1 << 20,
              timeout: Optional[float] = None) -> bytes:
         if self._closed:
@@ -154,6 +208,9 @@ class TcpEndpoint(Endpoint):
             del self._preread[:max_bytes]
             return out
         try:
+            if self._ssl_lock is not None:
+                return self._ssl_recv(lambda: self._sock.recv(max_bytes),
+                                      timeout)
             self._await_readable(timeout)
             return self._sock.recv(max_bytes)
         except socket.timeout as exc:
@@ -171,6 +228,9 @@ class TcpEndpoint(Endpoint):
             del self._preread[:n]
             return n
         try:
+            if self._ssl_lock is not None:
+                return self._ssl_recv(lambda: self._sock.recv_into(dst),
+                                      timeout)
             self._await_readable(timeout)
             return self._sock.recv_into(dst)
         except socket.timeout as exc:
@@ -178,17 +238,65 @@ class TcpEndpoint(Endpoint):
         except OSError as exc:
             raise EndpointError(f"tcp read failed: {exc}") from exc
 
+    def _ssl_send_all(self, data: bytes) -> None:
+        """Serialized SSL write in bounded-lock chunks. On a timed-out
+        chunk the SSL layer demands a retry with the SAME buffer (no
+        partial-write mode) — the loop re-sends the identical view, and the
+        released lock between attempts lets the reader drain (which is what
+        un-wedges a peer blocked on its own full send buffer)."""
+        import select
+        import ssl as _ssl
+
+        view = memoryview(data).cast("B")
+        pos = 0
+        while pos < len(view):
+            with self._ssl_lock:
+                if self._closed:
+                    raise EndpointError("write on closed endpoint")
+                self._sock.settimeout(0.2)
+                try:
+                    budget = time.monotonic() + 0.2  # bound the lock hold
+                    while pos < len(view) and time.monotonic() < budget:
+                        # single send() per step: a timed-out SSL_write is
+                        # pending inside OpenSSL and MUST be retried with
+                        # the buffer at the SAME position — pos advances
+                        # only on success, so the retry resends view[pos:]
+                        # exactly (sendall would restart the prefix and
+                        # corrupt the record stream)
+                        pos += self._sock.send(view[pos:pos + 65536])
+                except (socket.timeout, _ssl.SSLWantWriteError):
+                    pass  # retry same position after the peer drains
+                finally:
+                    try:
+                        self._sock.settimeout(None)
+                    except OSError:
+                        pass
+            if pos >= len(view):
+                break
+            # off-lock: wait for room so the retry isn't a hot spin (and the
+            # reader can take the lock meanwhile — the anti-deadlock step)
+            try:
+                p = select.poll()
+                p.register(self._sock.fileno(), select.POLLOUT)
+                p.poll(200)
+            except (OSError, ValueError):
+                pass  # racing close: the locked retry will surface it
+
     def write(self, data) -> None:
         if self._closed:
             raise EndpointError("write on closed endpoint")
         try:
+            if self._ssl_lock is not None:
+                # SSLSocket (sendmsg raises NotImplementedError there):
+                # records are re-framed anyway, so ONE join costs what the
+                # TLS layer would have paid internally (bytes.join accepts
+                # memoryviews directly; scalars pass through zero-copy —
+                # _ssl_send_all wraps them in a memoryview itself).
+                self._ssl_send_all(b"".join(data)
+                                   if isinstance(data, (list, tuple))
+                                   else data)
+                return
             if isinstance(data, (list, tuple)):
-                if hasattr(self._sock, "pending"):
-                    # SSLSocket (sendmsg raises NotImplementedError there):
-                    # records are re-framed anyway, so one join costs what
-                    # the TLS layer would have paid internally.
-                    self._sock.sendall(b"".join(bytes(s) for s in data))
-                    return
                 # sendmsg is a gather write but may place PARTIALLY under
                 # pressure, and the kernel caps one call at IOV_MAX=1024
                 # iovecs (a large pytree serializes to 2-3 segments per leaf);
