@@ -1,0 +1,162 @@
+"""Python-over-native-C-API channel (tpurpc.rpc.native_client) — the
+SURVEY §7 stage-7 ctypes binding: blocking calls run inside libtpurpc.so.
+Served by the ordinary Python Server; also exercised over the ring
+platform (the native loop bootstraps the shm ring under an unchanged
+Python caller)."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import tpurpc.rpc as rpc
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "native", "build", "libtpurpc.so")),
+    reason="native lib not built")
+
+from tpurpc.rpc.native_client import NativeChannel  # noqa: E402
+from tpurpc.rpc.status import RpcError, StatusCode  # noqa: E402
+
+
+@pytest.fixture()
+def py_server():
+    srv = rpc.Server(max_workers=4)
+    srv.add_method("/n.S/Echo",
+                   rpc.unary_unary_rpc_method_handler(lambda r, c: bytes(r)))
+
+    def double_each(req_iter, ctx):
+        for m in req_iter:
+            yield bytes(m) * 2
+
+    srv.add_method("/n.S/Dbl", rpc.stream_stream_rpc_method_handler(double_each))
+
+    def fail(r, c):
+        c.abort(StatusCode.FAILED_PRECONDITION, "nope")
+
+    srv.add_method("/n.S/Fail", rpc.unary_unary_rpc_method_handler(fail))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    yield port
+    srv.stop(grace=0)
+
+
+def test_native_unary_and_ping(py_server):
+    with NativeChannel("127.0.0.1", py_server) as ch:
+        assert ch.ping(5) < 5
+        echo = ch.unary_unary("/n.S/Echo")
+        assert echo(b"hi", timeout=10) == b"hi"
+        big = bytes(range(256)) * 8192  # 2MB: frame fragmentation
+        assert echo(big, timeout=30) == big
+
+
+def test_native_serializers(py_server):
+    with NativeChannel("127.0.0.1", py_server) as ch:
+        echo = ch.unary_unary("/n.S/Echo",
+                              request_serializer=lambda s: s.encode(),
+                              response_deserializer=lambda b: b.decode())
+        assert echo("text", timeout=10) == "text"
+
+
+def test_native_status_mapping(py_server):
+    with NativeChannel("127.0.0.1", py_server) as ch:
+        with pytest.raises(RpcError) as ei:
+            ch.unary_unary("/n.S/Fail")(b"", timeout=10)
+        assert ei.value.code() is StatusCode.FAILED_PRECONDITION
+        assert "nope" in ei.value.details()
+        with pytest.raises(RpcError) as ei:
+            ch.unary_unary("/n.S/Missing")(b"", timeout=10)
+        assert ei.value.code() is StatusCode.UNIMPLEMENTED
+
+
+def test_native_streaming(py_server):
+    with NativeChannel("127.0.0.1", py_server) as ch:
+        dbl = ch.stream_stream("/n.S/Dbl")
+        out = list(dbl(iter([b"a", b"bb", b"ccc"]), timeout=10))
+        assert out == [b"aa", b"bbbb", b"cccccc"]
+
+
+def test_native_channel_over_ring_platform():
+    """The whole point: a PYTHON process on the native loop gets the ring
+    data plane by env alone (GRPC_PLATFORM_TYPE honored inside the .so)."""
+    env = dict(os.environ, GRPC_PLATFORM_TYPE="RDMA_BP",
+               GRPC_RDMA_RING_BUFFER_SIZE_KB="1024")
+    code = (
+        "import tpurpc.rpc as rpc\n"
+        "from tpurpc.rpc.native_client import NativeChannel\n"
+        "srv = rpc.Server(max_workers=4)\n"
+        "srv.add_method('/n.S/Echo',"
+        " rpc.unary_unary_rpc_method_handler(lambda r, c: bytes(r)))\n"
+        "port = srv.add_insecure_port('127.0.0.1:0')\n"
+        "srv.start()\n"
+        "with NativeChannel('127.0.0.1', port) as ch:\n"
+        "    echo = ch.unary_unary('/n.S/Echo')\n"
+        "    assert echo(b'ring', timeout=20) == b'ring'\n"
+        "    big = bytes(range(256)) * 4096\n"
+        "    assert echo(big, timeout=60) == big\n"
+        "print('RING_OK')\n"
+        "srv.stop(grace=0)\n"
+    )
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, env=env, timeout=180)
+    assert out.returncode == 0, out.stderr
+    assert "RING_OK" in out.stdout
+
+
+def test_native_vs_python_latency(tmp_path):
+    """The fast path must actually be faster. Measured against a C++
+    callback-API echo server so the SERVER cost is constant and small —
+    against the (slower) Python server both clients are server-bound and
+    the comparison measures nothing (observed: 33us vs 95us/call here)."""
+    import shutil
+    gxx = shutil.which("g++")
+    if gxx is None:
+        pytest.skip("no g++ toolchain")
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    src = tmp_path / "echo_srv.cc"
+    src.write_text(
+        '#include <cstdio>\n#include "tpurpc/server.h"\n'
+        'static int cb(tpr_server_call *c, const uint8_t *d, size_t n,'
+        ' void *) { tpr_srv_send(c, d, n); return 0; }\n'
+        'int main() { tpr_server *s = tpr_server_create(0);\n'
+        '  tpr_server_register_callback(s, "/n.S/Echo", cb, nullptr);\n'
+        '  tpr_server_start(s); printf("PORT %d\\n", tpr_server_port(s));\n'
+        '  fflush(stdout); getchar(); tpr_server_destroy(s); }\n')
+    binp = tmp_path / "echo_srv"
+    subprocess.run(
+        [gxx, "-std=c++17", "-O2", str(src),
+         os.path.join(root, "native", "src", "tpurpc_server.cc"),
+         os.path.join(root, "native", "src", "ring.cc"),
+         "-I", os.path.join(root, "native", "include"),
+         "-lpthread", "-o", str(binp)],
+        check=True, timeout=180, capture_output=True)
+    proc = subprocess.Popen([str(binp)], stdout=subprocess.PIPE,
+                            stdin=subprocess.PIPE, text=True)
+    try:
+        port = int(proc.stdout.readline().split()[1])
+        N = 500
+        with NativeChannel("127.0.0.1", port) as ch:
+            echo = ch.unary_unary("/n.S/Echo")
+            echo(b"warm", timeout=10)
+            t0 = time.perf_counter()
+            for _ in range(N):
+                echo(b"x", timeout=10)
+            native_s = time.perf_counter() - t0
+        with rpc.insecure_channel(f"127.0.0.1:{port}") as ch:
+            echo = ch.unary_unary("/n.S/Echo")
+            echo(b"warm", timeout=10)
+            t0 = time.perf_counter()
+            for _ in range(N):
+                echo(b"x", timeout=10)
+            py_s = time.perf_counter() - t0
+        sys.stderr.write(f"native {native_s/N*1e6:.0f}us/call vs python "
+                         f"{py_s/N*1e6:.0f}us/call\n")
+        # margin absorbs 1-core scheduling hiccups; the real ratio is ~3x
+        assert native_s < py_s * 1.2
+    finally:
+        proc.stdin.close()
+        proc.wait(timeout=10)

@@ -62,6 +62,10 @@ def __getattr__(name):
         from tpurpc.wire.h2_client import H2Channel
 
         return H2Channel
+    if name == "NativeChannel":
+        from tpurpc.rpc.native_client import NativeChannel
+
+        return NativeChannel
     raise AttributeError(f"module 'tpurpc.rpc' has no attribute {name!r}")
 
 from tpurpc.rpc.channel import secure_channel  # noqa: E402
@@ -78,3 +82,5 @@ __all__ += ["secure_channel", "ChannelCredentials", "ServerCredentials",
 from tpurpc.rpc.reflection import enable_server_reflection  # noqa: E402
 
 __all__ += ["enable_server_reflection"]
+
+__all__ += ["NativeChannel"]

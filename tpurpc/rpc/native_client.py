@@ -1,0 +1,287 @@
+"""Python binding over the native C client — the latency fast path.
+
+SURVEY.md §7 stage 7 prescribes "a Python layer over the C API (minimal
+Cython/ctypes layer)" the way grpcio's Python rides its Cython-wrapped C
+core (``src/python/grpcio/grpc/_cython``). tpurpc's default channel is
+pure Python (rich: LB trees, retries, interceptors, h2 interop); this
+module is the thin ctypes alternative for latency-critical clients — the
+blocking call path runs entirely inside ``libtpurpc.so`` (one GIL release
+per call, no Python-level framing), and honors ``GRPC_PLATFORM_TYPE``:
+with ``RDMA_BP|BPEV|EVENT`` the native channel bootstraps the shm ring
+data plane (ring_transport.h), so a Python process gets the
+ring-beats-TCP small-RPC numbers the native micro-bench measures
+(bench/results/micro_native_1core.log).
+
+    from tpurpc.rpc.native_client import NativeChannel
+    with NativeChannel("127.0.0.1", port) as ch:
+        echo = ch.unary_unary("/pkg.Svc/Echo")
+        reply = echo(b"payload", timeout=5.0)
+
+Scope: unary + streaming calls, deadlines, status mapping, ping. Not
+here (use the default Channel): LB policies, retries, interceptors, TLS,
+h2 wire compat — this is deliberately the reference's "thin stub over the
+C core" shape, not a second full client.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Callable, Iterable, Optional
+
+from tpurpc.rpc.status import RpcError, StatusCode
+
+_LIB = None
+_LIB_LOCK = threading.Lock()
+
+
+def _load():
+    global _LIB
+    with _LIB_LOCK:
+        if _LIB is not None:
+            return _LIB
+        here = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        path = os.environ.get(
+            "TPURPC_NATIVE_LIB",
+            os.path.join(here, "native", "build", "libtpurpc.so"))
+        lib = ctypes.CDLL(path)
+        lib.tpr_channel_create.restype = ctypes.c_void_p
+        lib.tpr_channel_create.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                           ctypes.c_int]
+        lib.tpr_channel_destroy.argtypes = [ctypes.c_void_p]
+        lib.tpr_channel_ping.restype = ctypes.c_int64
+        lib.tpr_channel_ping.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.tpr_unary_call.restype = ctypes.c_int
+        lib.tpr_unary_call.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_size_t),
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int]
+        lib.tpr_call_start.restype = ctypes.c_void_p
+        lib.tpr_call_start.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_size_t, ctypes.c_int]
+        lib.tpr_call_send.restype = ctypes.c_int
+        lib.tpr_call_send.argtypes = [ctypes.c_void_p,
+                                      ctypes.POINTER(ctypes.c_uint8),
+                                      ctypes.c_size_t, ctypes.c_int]
+        lib.tpr_call_writes_done.restype = ctypes.c_int
+        lib.tpr_call_writes_done.argtypes = [ctypes.c_void_p]
+        lib.tpr_call_recv.restype = ctypes.c_int
+        lib.tpr_call_recv.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_size_t)]
+        lib.tpr_call_finish.restype = ctypes.c_int
+        lib.tpr_call_finish.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                        ctypes.c_size_t]
+        lib.tpr_call_cancel.argtypes = [ctypes.c_void_p]
+        lib.tpr_call_destroy.argtypes = [ctypes.c_void_p]
+        lib.tpr_buf_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+        _LIB = lib
+        return lib
+
+
+def _u8(data) -> "ctypes.Array":
+    view = memoryview(data).cast("B")
+    return (ctypes.c_uint8 * len(view)).from_buffer_copy(view)
+
+
+def _timeout_ms(timeout: Optional[float]) -> int:
+    if timeout is None:
+        return 0
+    return max(1, int(timeout * 1000))
+
+
+def _take_buf(lib, pptr, plen) -> bytes:
+    try:
+        return ctypes.string_at(pptr, plen.value) if plen.value else b""
+    finally:
+        if pptr:
+            lib.tpr_buf_free(pptr)
+
+
+class NativeCall:
+    """A streaming call handle (thin ClientCall analog)."""
+
+    def __init__(self, lib, call):
+        self._lib = lib
+        self._call = call
+        self._lock = threading.Lock()
+
+    def write(self, data, end_stream: bool = False) -> None:
+        buf = _u8(data)
+        if self._lib.tpr_call_send(self._call, buf, len(buf),
+                                   1 if end_stream else 0) != 0:
+            raise RpcError(StatusCode.UNAVAILABLE, "send failed")
+
+    def writes_done(self) -> None:
+        self._lib.tpr_call_writes_done(self._call)
+
+    def read(self) -> Optional[bytes]:
+        """Next response message, or None at end of stream/error
+        (finish() distinguishes)."""
+        pptr = ctypes.POINTER(ctypes.c_uint8)()
+        plen = ctypes.c_size_t()
+        r = self._lib.tpr_call_recv(self._call,
+                                    ctypes.byref(pptr), ctypes.byref(plen))
+        if r != 1:
+            return None
+        return _take_buf(self._lib, pptr, plen)
+
+    def finish(self):
+        details = ctypes.create_string_buffer(1024)
+        code = self._lib.tpr_call_finish(self._call, details, 1024)
+        return (StatusCode(code) if code in StatusCode._value2member_map_
+                else StatusCode.UNKNOWN), details.value.decode(
+                    "utf-8", "replace")
+
+    def cancel(self) -> None:
+        self._lib.tpr_call_cancel(self._call)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._call:
+                self._lib.tpr_call_destroy(self._call)
+                self._call = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeChannel:
+    """ctypes channel over the native client loop (see module docstring)."""
+
+    def __init__(self, host: str, port: int, connect_timeout: float = 10.0):
+        self._lib = _load()
+        self._ch = self._lib.tpr_channel_create(
+            host.encode(), int(port), _timeout_ms(connect_timeout))
+        if not self._ch:
+            raise RpcError(StatusCode.UNAVAILABLE,
+                           f"native connect to {host}:{port} failed")
+
+    def _handle(self):
+        """The live native handle; raises (instead of passing a freed/NULL
+        pointer into C and segfaulting) once close() ran. Closing with
+        calls in flight is unsupported, like destroying a grpcio channel
+        mid-call."""
+        ch = self._ch
+        if not ch:
+            raise RpcError(StatusCode.UNAVAILABLE, "channel closed")
+        return ch
+
+    # -- surface -------------------------------------------------------------
+
+    def ping(self, timeout: float = 5.0) -> float:
+        us = self._lib.tpr_channel_ping(self._handle(), _timeout_ms(timeout))
+        if us < 0:
+            raise RpcError(StatusCode.UNAVAILABLE, "ping failed")
+        return us / 1e6
+
+    def unary_unary(self, method: str,
+                    request_serializer: Optional[Callable] = None,
+                    response_deserializer: Optional[Callable] = None):
+        mb = method.encode()
+        lib = self._lib
+
+        def call(request, timeout: Optional[float] = None):
+            ch = self._handle()  # per-call: a closed channel raises
+            raw = (request_serializer(request) if request_serializer
+                   else request)
+            buf = _u8(raw)
+            pptr = ctypes.POINTER(ctypes.c_uint8)()
+            plen = ctypes.c_size_t()
+            details = ctypes.create_string_buffer(1024)
+            code = lib.tpr_unary_call(ch, mb, buf, len(buf),
+                                      ctypes.byref(pptr), ctypes.byref(plen),
+                                      details, 1024, _timeout_ms(timeout))
+            if code != 0:
+                raise RpcError(
+                    StatusCode(code) if code in StatusCode._value2member_map_
+                    else StatusCode.UNKNOWN,
+                    details.value.decode("utf-8", "replace"))
+            body = _take_buf(lib, pptr, plen)
+            return (response_deserializer(body) if response_deserializer
+                    else body)
+
+        return call
+
+    def start_call(self, method: str,
+                   timeout: Optional[float] = None) -> NativeCall:
+        c = self._lib.tpr_call_start(self._handle(), method.encode(), None,
+                                     0, _timeout_ms(timeout))
+        if not c:
+            raise RpcError(StatusCode.UNAVAILABLE, "call start failed")
+        return NativeCall(self._lib, c)
+
+    def stream_stream(self, method: str):
+        """Bidi helper with the Channel-compatible iterator shape."""
+
+        def call(request_iterator: Iterable, timeout: Optional[float] = None):
+            nc = self.start_call(method, timeout)
+            app_exc: list = []
+
+            def run():
+                try:
+                    for item in request_iterator:
+                        nc.write(item)
+                    nc.writes_done()
+                except RpcError:
+                    pass  # reader surfaces the status
+                except BaseException as exc:  # the app's iterator raised:
+                    # half-close never happens — cancel so the reader (and
+                    # the server's handler) unblock, and surface the error
+                    app_exc.append(exc)
+                    nc.cancel()
+
+            t = threading.Thread(target=run, daemon=True)
+            t.start()
+            try:
+                while True:
+                    msg = nc.read()
+                    if msg is None:
+                        break
+                    yield msg
+            finally:
+                if t.is_alive():
+                    # early consumer exit with requests still flowing: RST
+                    # first (the server drops the stream, backpressure
+                    # releases, the blocked write fails fast), THEN join —
+                    # destroying the call under a live writer thread is a
+                    # native use-after-free
+                    nc.cancel()
+                t.join()
+                code, details = nc.finish()
+                nc.close()
+                if app_exc:
+                    raise app_exc[0]
+                if code is not StatusCode.OK:
+                    raise RpcError(code, details)
+
+        return call
+
+    def close(self) -> None:
+        ch, self._ch = self._ch, None
+        if ch:
+            self._lib.tpr_channel_destroy(ch)
+
+    def __del__(self):
+        # safety net: a dropped channel must not leak the native reader
+        # thread + fd (+ shm ring on ring platforms)
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
