@@ -245,9 +245,23 @@ def _serving_phase(port: int, model: str, img: int):
     done = [0] * n_clients
     start = threading.Barrier(n_clients + 1)
 
+    def _make_channel():
+        # NativeChannel (ctypes over libtpurpc.so) when available: the
+        # closed-loop client's per-call overhead is part of the measured
+        # QPS, and the native loop is ~3x the pure-Python path
+        # (BASELINE.md). TPURPC_BENCH_NATIVE_CLIENT=0 opts out.
+        if os.environ.get("TPURPC_BENCH_NATIVE_CLIENT", "1") == "1":
+            try:
+                from tpurpc.rpc.native_client import NativeChannel
+
+                return NativeChannel("127.0.0.1", port)
+            except Exception:
+                pass  # lib missing/unbuildable: pure-Python path
+        return Channel(f"127.0.0.1:{port}")
+
     def client(idx: int):
         try:
-            with Channel(f"127.0.0.1:{port}") as ch:
+            with _make_channel() as ch:
                 cli = TensorClient(ch)
                 cli.call("Infer", {"x": image}, timeout=300)  # per-conn warm
                 start.wait(timeout=600)

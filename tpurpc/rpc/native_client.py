@@ -86,6 +86,11 @@ def _load():
 
 
 def _u8(data) -> "ctypes.Array":
+    # serializers may emit a gather list of segments (the tensor codec
+    # does); the C API takes one buffer, so join — one copy, same price
+    # the TLS path pays
+    if isinstance(data, (list, tuple)):
+        data = b"".join(data)
     view = memoryview(data).cast("B")
     return (ctypes.c_uint8 * len(view)).from_buffer_copy(view)
 
