@@ -1,0 +1,180 @@
+"""Look-aside LB (grpclb capability) + Channel.update_addresses.
+
+Ref ``lb_policy/grpclb/grpclb.cc``: balancer streams server lists, the
+channel redirects live, falls back to resolver addresses when the
+balancer dies."""
+
+import time
+
+import pytest
+
+import tpurpc.rpc as rpc
+from tpurpc.rpc.lookaside import (LoadBalancerServicer, enable_lookaside)
+from tpurpc.rpc.status import RpcError
+
+
+def _named_server(name: str):
+    srv = rpc.Server(max_workers=4)
+    srv.add_method("/l.S/Who",
+                   rpc.unary_unary_rpc_method_handler(
+                       lambda r, c, n=name: n.encode()))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    return srv, port
+
+
+def _await(fn, timeout=30, every=0.05):
+    """Poll until fn() is truthy; a call racing a membership swap may land
+    on a just-closed backend once (documented transient) — treat RpcError
+    as not-ready, like any retrying client would."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if fn():
+                return True
+        except RpcError:
+            pass
+        time.sleep(every)
+    return False
+
+
+# -- update_addresses (the mechanism) ----------------------------------------
+
+def test_update_addresses_moves_traffic_and_keeps_live_subchannels():
+    s1, p1 = _named_server("one")
+    s2, p2 = _named_server("two")
+    try:
+        with rpc.Channel(f"127.0.0.1:{p1}") as ch:
+            who = ch.unary_unary("/l.S/Who")
+            assert who(b"", timeout=10) == b"one"
+            conn_before = ch._subchannels[0]._conn
+            # keep p1, add p2, round-robin over both
+            ch._lb_spec = "round_robin"
+            ch.update_addresses([("127.0.0.1", p1), ("127.0.0.1", p2)])
+            assert ch._subchannels[0]._conn is conn_before  # reused, live
+            got = {bytes(who(b"", timeout=10)) for _ in range(6)}
+            assert got == {b"one", b"two"}
+            # drop p1 entirely
+            ch.update_addresses([f"127.0.0.1:{p2}"])
+            for _ in range(4):
+                assert who(b"", timeout=10) == b"two"
+    finally:
+        s1.stop(grace=0)
+        s2.stop(grace=0)
+
+
+def test_update_addresses_guards():
+    from tpurpc.core.endpoint import passthru_endpoint_pair
+    from tpurpc.rpc.channel import Channel
+
+    a, _b = passthru_endpoint_pair()
+    ch = Channel(endpoint_factory=lambda: a)
+    with pytest.raises(RuntimeError):
+        ch.update_addresses(["127.0.0.1:1"])
+    ch.close()
+    s1, p1 = _named_server("x")
+    try:
+        ch = rpc.Channel(f"127.0.0.1:{p1}")
+        with pytest.raises(ValueError):
+            ch.update_addresses([])
+        ch.close()
+        with pytest.raises(RpcError):
+            ch.update_addresses([f"127.0.0.1:{p1}"])  # closed channel
+    finally:
+        s1.stop(grace=0)
+
+
+# -- the balancer protocol ----------------------------------------------------
+
+def test_lookaside_balancer_directs_and_rebalances():
+    s1, p1 = _named_server("backend1")
+    s2, p2 = _named_server("backend2")
+    bal_srv = rpc.Server(max_workers=4)
+    balancer = LoadBalancerServicer()
+    balancer.attach(bal_srv)
+    bal_port = bal_srv.add_insecure_port("127.0.0.1:0")
+    bal_srv.start()
+    balancer.set_servers("demo", [f"127.0.0.1:{p1}"])
+    try:
+        with rpc.Channel(f"127.0.0.1:{p2}") as ch:  # fallback = backend2
+            watcher = enable_lookaside(ch, f"127.0.0.1:{bal_port}", "demo")
+            who = ch.unary_unary("/l.S/Who")
+            # balancer list (backend1) takes over
+            assert _await(lambda: bytes(who(b"", timeout=10)) == b"backend1")
+            # rebalance to backend2
+            balancer.set_servers("demo", [f"127.0.0.1:{p2}"])
+            assert _await(lambda: bytes(who(b"", timeout=10)) == b"backend2")
+            watcher.stop()
+    finally:
+        bal_srv.stop(grace=0)
+        s1.stop(grace=0)
+        s2.stop(grace=0)
+
+
+def test_lookaside_falls_back_when_balancer_dies():
+    s1, p1 = _named_server("lbpick")
+    s2, p2 = _named_server("fallback")
+    bal_srv = rpc.Server(max_workers=4)
+    balancer = LoadBalancerServicer()
+    balancer.attach(bal_srv)
+    bal_port = bal_srv.add_insecure_port("127.0.0.1:0")
+    bal_srv.start()
+    balancer.set_servers("d", [f"127.0.0.1:{p1}"])
+    try:
+        with rpc.Channel(f"127.0.0.1:{p2}") as ch:
+            watcher = enable_lookaside(ch, f"127.0.0.1:{bal_port}", "d")
+            who = ch.unary_unary("/l.S/Who")
+            assert _await(lambda: bytes(who(b"", timeout=10)) == b"lbpick")
+            bal_srv.stop(grace=0)  # balancer gone
+            # grpclb fallback rule: revert to the resolver-provided list
+            assert _await(lambda: bytes(who(b"", timeout=10)) == b"fallback")
+            watcher.stop()
+    finally:
+        s1.stop(grace=0)
+        s2.stop(grace=0)
+
+
+def test_lookaside_rejects_factory_channel():
+    from tpurpc.core.endpoint import passthru_endpoint_pair
+    from tpurpc.rpc.channel import Channel
+
+    a, _b = passthru_endpoint_pair()
+    ch = Channel(endpoint_factory=lambda: a)
+    with pytest.raises(ValueError):
+        enable_lookaside(ch, "127.0.0.1:1", "x")
+    ch.close()
+
+
+def test_update_addresses_hostname_normalizes_to_resolved():
+    """'localhost:p' must match the constructor's resolved keys — a no-op
+    update keeps the live connection instead of redialing."""
+    s1, p1 = _named_server("same")
+    try:
+        with rpc.Channel(f"localhost:{p1}") as ch:
+            who = ch.unary_unary("/l.S/Who")
+            assert who(b"", timeout=10) == b"same"
+            live = [sc._conn for sc in ch._subchannels if sc._conn is not None]
+            assert live
+            ch.update_addresses([f"localhost:{p1}"])
+            kept = [sc._conn for sc in ch._subchannels if sc._conn is not None]
+            assert any(c in live for c in kept)  # the connection survived
+            assert who(b"", timeout=10) == b"same"
+    finally:
+        s1.stop(grace=0)
+
+
+def test_update_addresses_with_composite_spec_degrades_to_round_robin():
+    s1, p1 = _named_server("a")
+    s2, p2 = _named_server("b")
+    try:
+        spec = {"priority": [{"policy": "pick_first", "indices": [0]}]}
+        with rpc.Channel(f"127.0.0.1:{p1}", lb_policy=spec) as ch:
+            who = ch.unary_unary("/l.S/Who")
+            assert who(b"", timeout=10) == b"a"
+            # membership change: dict spec can't remap -> round_robin set
+            ch.update_addresses([f"127.0.0.1:{p1}", f"127.0.0.1:{p2}"])
+            got = {bytes(who(b"", timeout=10)) for _ in range(6)}
+            assert got == {b"a", b"b"}
+    finally:
+        s1.stop(grace=0)
+        s2.stop(grace=0)

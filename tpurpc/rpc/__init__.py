@@ -83,4 +83,9 @@ from tpurpc.rpc.reflection import enable_server_reflection  # noqa: E402
 
 __all__ += ["enable_server_reflection"]
 
+from tpurpc.rpc.lookaside import (LoadBalancerServicer,  # noqa: E402
+                                  enable_lookaside)
+
+__all__ += ["LoadBalancerServicer", "enable_lookaside"]
+
 __all__ += ["NativeChannel"]

@@ -439,16 +439,17 @@ class Channel:
 
         ssl_ctx = getattr(credentials, "_context", None)
         override = getattr(credentials, "_override_hostname", None)
+        self._lb_spec = lb_policy
+        self._conn_kw = dict(timeout=connect_timeout, ssl_context=ssl_ctx,
+                             server_hostname=override)
         if endpoint_factory is None:
             if target is None:
                 raise ValueError("need target or endpoint_factory")
             addrs = resolve_target(target)
-            factories = [
-                (lambda h=h, p=p: connect_endpoint(
-                    h, p, timeout=connect_timeout, ssl_context=ssl_ctx,
-                    server_hostname=override))
-                for h, p in addrs]
+            self._addrs: "Optional[list]" = list(addrs)
+            factories = [self._addr_factory(h, p) for h, p in addrs]
         else:
+            self._addrs = None  # injected factory: membership is fixed
             factories = [endpoint_factory]
         self._subchannels = [_Subchannel(f, self) for f in factories]
         self._policy = make_policy(lb_policy, len(self._subchannels))
@@ -460,21 +461,86 @@ class Channel:
 
     # -- connection management ----------------------------------------------
 
+    def _addr_factory(self, h: str, p: int):
+        kw = self._conn_kw
+        return lambda: connect_endpoint(h, p, timeout=kw["timeout"],
+                                        ssl_context=kw["ssl_context"],
+                                        server_hostname=kw["server_hostname"])
+
+    def update_addresses(self, addrs) -> None:
+        """Replace the channel's backend set (re-resolution / look-aside
+        balancing — the grpclb ServerList update, ``grpclb.cc``). Addresses
+        present in both old and new sets KEEP their live subchannel (and
+        its connection); removed ones are closed; the LB policy is rebuilt
+        over the new membership with the channel's original spec.
+
+        ``addrs``: iterable of ``(host, port)`` or ``"host:port"`` strings.
+        In-flight calls on kept subchannels are unaffected; calls racing
+        the swap may still land on a closing backend once and retry per
+        the normal UNAVAILABLE path.
+        """
+        from tpurpc.rpc.resolver import make_policy, resolve_target
+
+        parsed: list = []
+        for a in addrs:
+            if isinstance(a, tuple):
+                parsed.append(a)
+            else:
+                # resolve strings the same way the constructor did — the
+                # keep-live matching below compares against RESOLVED
+                # addresses, so "localhost:p" must normalize to the same
+                # keys or a no-op update would tear down live connections
+                parsed.extend(resolve_target(a))
+        if not parsed:
+            raise ValueError("update_addresses needs at least one address")
+        # Composite dict specs pin absolute subchannel indices — they can't
+        # survive a membership size change. Balanced sets get round_robin,
+        # exactly what grpclb runs over its server lists (grpclb.cc).
+        spec = (self._lb_spec if isinstance(self._lb_spec, str)
+                else "round_robin")
+        with self._lock:
+            if self._closed:
+                raise RpcError(StatusCode.UNAVAILABLE, "channel closed")
+            if self._addrs is None:
+                raise RuntimeError(
+                    "channel built from endpoint_factory has fixed membership")
+            old = {}
+            for a, sc in zip(self._addrs, self._subchannels):
+                old.setdefault(a, []).append(sc)
+            new_subs = []
+            for a in parsed:
+                bucket = old.get(a)
+                if bucket:
+                    new_subs.append(bucket.pop(0))  # keep the live conn
+                else:
+                    new_subs.append(_Subchannel(self._addr_factory(*a), self))
+            removed = [sc for bucket in old.values() for sc in bucket]
+            policy = make_policy(spec, len(new_subs))
+            # atomic swap: _connection() snapshots both attributes
+            self._subchannels = new_subs
+            self._policy = policy
+            self._addrs = list(parsed)
+        for sc in removed:
+            sc.close()
+
     def _connection(self) -> _Connection:
         """LB pick: walk subchannels in policy order, first READY/dialable
         wins (client_channel resolver→LB→subchannel flow, SURVEY.md §3.2)."""
         with self._lock:
             if self._closed:
                 raise RpcError(StatusCode.UNAVAILABLE, "channel closed")
+            # snapshot: update_addresses swaps both under this lock, so a
+            # pick never mixes one generation's policy with another's subs
+            policy, subs = self._policy, self._subchannels
         last_exc: Optional[Exception] = None
-        for idx in self._policy.order():
-            sc = self._subchannels[idx]
+        for idx in policy.order():
+            sc = subs[idx]
             try:
                 conn = sc.get()
-                self._policy.connected(idx)
+                policy.connected(idx)
                 return conn
             except RpcError as exc:
-                self._policy.failed(idx)
+                policy.failed(idx)
                 last_exc = exc
         raise last_exc if last_exc is not None else RpcError(
             StatusCode.UNAVAILABLE, "no subchannels")

@@ -1,0 +1,188 @@
+"""Look-aside load balancing — the grpclb capability, tpurpc-shaped.
+
+The reference ships ``lb_policy/grpclb/grpclb.cc``: the channel opens a
+stream to a BALANCER service, receives ServerList updates, directs RPCs at
+the listed backends, and falls back to its resolver-provided addresses if
+the balancer is unreachable (fallback timer). This module is that control
+loop over tpurpc's own streaming RPC + :meth:`Channel.update_addresses`:
+
+server side::
+
+    balancer = LoadBalancerServicer()
+    balancer.attach(admin_server)                 # serves /tpurpc.lb.v1.*
+    balancer.set_servers("inventory", ["10.0.0.5:50051", "10.0.0.6:50051"])
+
+client side::
+
+    ch = rpc.Channel("fallback-host:50051", lb_policy="round_robin")
+    watcher = enable_lookaside(ch, "balancer-host:9000", name="inventory")
+    ...                                            # calls rebalance live
+    watcher.stop()
+
+Wire format: JSON bodies (this is a tpurpc-native control protocol, not
+the grpc.lb.v1 protobuf — stock-grpclb interop is out of scope the same
+way xds is; the CAPABILITY is what's reproduced). Request:
+``{"name": ...}``; each response: ``{"servers": ["h:p", ...]}``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+from tpurpc.rpc.status import RpcError
+from tpurpc.utils.trace import TraceFlag
+
+trace_lb = TraceFlag("lookaside")
+
+SERVICE = "tpurpc.lb.v1.LoadBalancer"
+METHOD = f"/{SERVICE}/BalanceLoad"
+
+
+class LoadBalancerServicer:
+    """Balancer service: per-name server lists, pushed to subscribers.
+
+    ``set_servers(name, addrs)`` updates a list and wakes every watcher
+    stream; each stream immediately receives the current list on
+    subscribe (grpclb's initial ServerList)."""
+
+    def __init__(self):
+        self._lock = threading.Condition()
+        self._lists: Dict[str, List[str]] = {}
+        self._epoch = 0
+
+    def set_servers(self, name: str, addrs: Sequence[str]) -> None:
+        with self._lock:
+            self._lists[name] = list(addrs)
+            self._epoch += 1
+            self._lock.notify_all()
+
+    def _balance_load(self, request_iterator, ctx):
+        first = next(iter(request_iterator), None)
+        if first is None:
+            return
+        try:
+            name = json.loads(bytes(first).decode())["name"]
+        except (ValueError, KeyError):
+            from tpurpc.rpc.status import AbortError, StatusCode
+
+            raise AbortError(StatusCode.INVALID_ARGUMENT,
+                             "malformed BalanceLoad request") from None
+        last_sent: Optional[List[str]] = None
+        while ctx.is_active():
+            with self._lock:
+                current = list(self._lists.get(name, []))
+                epoch = self._epoch
+                if current == last_sent:
+                    # wait for a change (bounded so ctx liveness re-checks)
+                    self._lock.wait_for(lambda: self._epoch != epoch,
+                                        timeout=1.0)
+                    continue
+            last_sent = current
+            yield json.dumps({"servers": current}).encode()
+
+    def attach(self, server) -> None:
+        from tpurpc.rpc.server import stream_stream_rpc_method_handler
+
+        server.add_method(METHOD,
+                          stream_stream_rpc_method_handler(self._balance_load))
+
+
+class LookasideWatcher:
+    """The client control loop: subscribe, apply updates, fall back."""
+
+    def __init__(self, channel, balancer_target: str, name: str,
+                 fallback_timeout: float = 10.0):
+        if getattr(channel, "_addrs", None) is None:
+            # fail fast: endpoint_factory channels have fixed membership;
+            # discovering this on the first ServerList would kill the
+            # watcher thread silently
+            raise ValueError(
+                "look-aside balancing needs a target-built channel "
+                "(endpoint_factory channels have fixed membership)")
+        self._channel = channel
+        self._balancer_target = balancer_target
+        self._name = name
+        self._fallback_timeout = fallback_timeout
+        #: the resolver-provided addresses to fall back to (grpclb fallback)
+        self._fallback_addrs = list(channel._addrs)
+        self._stop = threading.Event()
+        self._applied_balancer_list = False
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="tpurpc-lookaside")
+        self._thread.start()
+
+    def _run(self) -> None:
+        from tpurpc.rpc.channel import Channel
+
+        backoff = 0.2
+        while not self._stop.is_set():
+            try:
+                with Channel(self._balancer_target,
+                             connect_timeout=self._fallback_timeout) as bch:
+                    self._bch = bch  # stop() closes it to unblock the recv
+                    stream = bch.stream_stream(METHOD)
+                    sub = json.dumps({"name": self._name}).encode()
+
+                    def reqs():
+                        yield sub
+                        # hold the stream open until stop
+                        while not self._stop.wait(0.5):
+                            pass
+                        return
+
+                    for msg in stream(reqs(), timeout=None):
+                        if self._stop.is_set():
+                            return
+                        try:
+                            servers = json.loads(
+                                bytes(msg).decode()).get("servers")
+                        except ValueError:
+                            servers = None
+                        if not servers:
+                            trace_lb.log("ignoring malformed/empty "
+                                         "ServerList update")
+                            continue
+                        if servers:
+                            trace_lb.log("lookaside %r -> %d servers",
+                                         self._name, len(servers))
+                            self._channel.update_addresses(servers)
+                            self._applied_balancer_list = True
+                        backoff = 0.2
+            except (RpcError, OSError, ValueError) as exc:
+                trace_lb.log("balancer stream failed: %s", exc)
+            if self._stop.is_set():
+                return
+            # balancer unreachable: restore the fallback list once
+            # (grpclb fallback-to-resolver rule), then retry with backoff
+            if self._applied_balancer_list and self._fallback_addrs:
+                try:
+                    self._channel.update_addresses(self._fallback_addrs)
+                    self._applied_balancer_list = False
+                    trace_lb.log("lookaside %r: fell back to resolver list",
+                                 self._name)
+                except (RpcError, RuntimeError):
+                    pass  # channel closing
+            if self._stop.wait(backoff):
+                return
+            backoff = min(backoff * 2, 5.0)
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        bch = getattr(self, "_bch", None)
+        if bch is not None:
+            try:
+                bch.close()  # unblocks a watcher parked in recv
+            except Exception:
+                pass
+        self._thread.join(timeout=timeout)
+
+
+def enable_lookaside(channel, balancer_target: str, name: str,
+                     fallback_timeout: float = 10.0) -> LookasideWatcher:
+    """Attach a grpclb-style watcher to ``channel``; returns the watcher
+    (call ``stop()`` before closing the channel)."""
+    return LookasideWatcher(channel, balancer_target, name,
+                            fallback_timeout)
