@@ -32,12 +32,14 @@
 #include <unistd.h>
 
 #include <atomic>
+#include <chrono>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
 #include <mutex>
 #include <random>
 #include <string>
+#include <thread>
 
 #include "framing_common.h"
 
@@ -165,6 +167,27 @@ inline bool json_find_u64(const std::string &j, const char *key,
 // The transport
 // ---------------------------------------------------------------------------
 
+// The wakeup disciplines (SURVEY §2.3): RDMA_BP/BPEV busy-poll the ring
+// words for a bounded slice before blocking in poll() — the reference's
+// pollable_epoll spin (ev_epollex_rdma_bp_linux.cc:1020-1110), bounded by
+// GRPC_RDMA_BUSY_POLLING_TIMEOUT_US (default 500us, README:17-25);
+// RDMA_EVENT never spins. A single-hart host never spins either: the peer
+// can't run while we burn the core, so spinning only delays its wakeup
+// (the Python poller makes the same call — poller.py).
+inline int spin_budget_us_from_env() {
+  const char *p = getenv("TPURPC_PLATFORM_TYPE");
+  if (!p) p = getenv("GRPC_PLATFORM_TYPE");
+  if (!p || strcmp(p, "RDMA_EVENT") == 0) return 0;
+  const char *t = getenv("TPURPC_BUSY_POLLING_TIMEOUT_US");
+  if (!t) t = getenv("GRPC_RDMA_BUSY_POLLING_TIMEOUT_US");
+  if (t) {  // explicit knob wins, single-hart or not (operator's call)
+    long v = strtol(t, nullptr, 10);
+    return v > 0 ? (int)v : 0;
+  }
+  if (std::thread::hardware_concurrency() <= 1) return 0;
+  return 500;
+}
+
 struct RingTransport {
   int notify_fd = -1;          // the bootstrap socket, kept as event channel
   ShmRegion recv_ring, status;        // ours (peer writes into them)
@@ -177,6 +200,8 @@ struct RingTransport {
   uint64_t published_head = 0;
   // writer state (peer ring)
   uint64_t tail = 0, wseq = 0, remote_head = 0;
+  // wakeup discipline (BP/BPEV spin slice; 0 = EVENT / single-hart)
+  int spin_us = spin_budget_us_from_env();
 
   std::atomic<bool> alive{false};
   std::atomic<bool> peer_exited{false};  // reader + writer threads both touch
@@ -285,6 +310,7 @@ struct RingTransport {
       uint64_t writable = writable_now();
       if (writable == 0) {
         if (peer_gone()) return false;
+        if (spin_for_credits()) continue;  // BP/BPEV: credits mid-spin
         if (!wait_event(100)) continue;  // slice + re-check (lost-notify safe)
         continue;
       }
@@ -327,7 +353,7 @@ struct RingTransport {
         }
       }
       if (peer_gone()) return false;
-      wait_event(100);
+      if (!spin_for_credits()) wait_event(100);
     }
     return false;
   }
@@ -345,9 +371,27 @@ struct RingTransport {
       if (len == 0) break;
       if (!alive.load()) return false;
       if (ring_empty_and_peer_gone()) return false;  // clean EOF
+      if (spin_for_message()) continue;  // BP/BPEV: data landed mid-spin
       wait_event(100);
     }
     return true;
+  }
+
+  // Bounded busy-poll on the ring's header word (the BP/BPEV hot loop).
+  // True = a message appeared; false = slice expired (caller blocks).
+  bool spin_for_message() {
+    if (spin_us <= 0) return false;
+    auto end = std::chrono::steady_clock::now() +
+               std::chrono::microseconds(spin_us);
+    while (std::chrono::steady_clock::now() < end) {
+      if (tpr_ring_has_message(recv_ring.base, ring_size, head, rseq))
+        return true;
+      if (!alive.load() || peer_exited.load()) return false;
+#if defined(__x86_64__) || defined(__i386__)
+      __builtin_ia32_pause();
+#endif
+    }
+    return false;
   }
 
   void shutdown() {
@@ -380,6 +424,24 @@ struct RingTransport {
     return used + kReservedBytes >= peer_ring_size
                ? 0
                : peer_ring_size - used - kReservedBytes;
+  }
+
+  // Bounded busy-poll on the peer-published credit word (write twin of
+  // spin_for_message; the reference's writer watches remote_head the same
+  // way, pair.cc:294-301).
+  bool spin_for_credits() {
+    if (spin_us <= 0) return false;
+    auto end = std::chrono::steady_clock::now() +
+               std::chrono::microseconds(spin_us);
+    while (std::chrono::steady_clock::now() < end) {
+      fold_credits();
+      if (writable_now() > 0) return true;
+      if (!alive.load() || peer_gone()) return false;
+#if defined(__x86_64__) || defined(__i386__)
+      __builtin_ia32_pause();
+#endif
+    }
+    return false;
   }
 
   bool peer_gone() {
