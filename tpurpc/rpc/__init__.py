@@ -88,4 +88,8 @@ from tpurpc.rpc.lookaside import (LoadBalancerServicer,  # noqa: E402
 
 __all__ += ["LoadBalancerServicer", "enable_lookaside"]
 
+from tpurpc.rpc.health import add_health_servicer  # noqa: E402
+
+__all__ += ["add_health_servicer"]
+
 __all__ += ["NativeChannel"]
