@@ -32,6 +32,12 @@ The advertised host defaults to ``127.0.0.1`` (CI: cross-process on one
 box); set ``TPURPC_TCPW_HOST`` to the host's reachable address for real
 cross-host deployments. Select the domain with ``TPURPC_RING_DOMAIN=
 tcp_window`` (alias ``GRPC_RDMA_DOMAIN``) on BOTH peers.
+
+Security note: the record stream is a SEPARATE plaintext TCP connection —
+TLS on the RPC port encrypts the bootstrap/notify channel but not these
+one-sided writes (exactly like the reference, whose RDMA payloads bypass
+TLS on the NIC: SURVEY §2.4 "security sits above the endpoint seam").
+Deploy on trusted network segments or under an encrypted overlay.
 """
 
 from __future__ import annotations
