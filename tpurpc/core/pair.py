@@ -100,12 +100,20 @@ class Region:
     """A chunk of registerable memory owned by this side (ref: ``Buffer``,
     ``buffer.h:12-35`` — pinned + ibv_reg_mr there; here just addressable bytes)."""
 
-    __slots__ = ("handle", "buf", "_close")
+    __slots__ = ("handle", "buf", "_close", "on_write")
 
     def __init__(self, handle: str, buf, close: Callable[[], None] = lambda: None):
         self.handle = handle
         self.buf = memoryview(buf)
         self._close = close
+        #: Optional post-apply hook for ASYNCHRONOUS domains (tcp_window):
+        #: called by the domain's applier after landing peer bytes in this
+        #: region. Synchronous domains (local/shm) never call it — their
+        #: writes are visible before the peer's notify token can arrive, so
+        #: the token alone is a sufficient wakeup. With an async domain the
+        #: token (notify socket) can BEAT the data (record socket); the
+        #: applier's kick is what closes that lost-wakeup window.
+        self.on_write: Optional[Callable[[], None]] = None
 
     def close(self) -> None:
         # A GIL-free native spin (Pair.spin) may still pin this memory through
@@ -479,6 +487,11 @@ class Pair:
         self._release_regions()
         self.recv_region = self.domain.alloc(self.ring_size)
         self.status_region = self.domain.alloc(STATUS_BYTES)
+        # Async-domain wakeup closure (see Region.on_write): data landing in
+        # the ring wakes readers; credits/exit landing in the status page
+        # wake stalled writers. kick() is idempotent and cheap (pipe byte).
+        self.recv_region.on_write = self.kick
+        self.status_region.on_write = self.kick
         self.reader = RingReader(self.recv_region.buf, self.ring_size)
         self.writer = None  # created at connect, once peer ring size is known
         self._published_head_mirror = 0
@@ -1113,6 +1126,12 @@ class Pair:
         check and the select) wakes and observes the state change; a waiter
         that races the close itself gets EBADF from select, which _wait treats
         as a state-change wakeup."""
+        # Detach the async-domain applier hook BEFORE the wake fds close:
+        # a record landing mid-teardown must not kick() into a just-closed
+        # (and possibly OS-reused) fd number.
+        for region in (self.recv_region, self.status_region):
+            if region is not None:
+                region.on_write = None
         self.kick()
         sels, self._selectors = self._selectors, {}
         for sel in sels.values():

@@ -99,7 +99,7 @@ class _RecordServer:
         from tpurpc.utils.config import get_config
 
         self.pid = os.getpid()
-        self._regions: Dict[bytes, memoryview] = {}
+        self._regions: "Dict[bytes, Region]" = {}
         self._reg_lock = threading.Lock()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -123,9 +123,9 @@ class _RecordServer:
 
     # -- region registry -----------------------------------------------------
 
-    def register(self, key: bytes, buf: memoryview) -> None:
+    def register(self, key: bytes, region: Region) -> None:
         with self._reg_lock:
-            self._regions[key] = buf
+            self._regions[key] = region
 
     def unregister(self, key: bytes) -> None:
         with self._reg_lock:
@@ -163,16 +163,36 @@ class _RecordServer:
                 if payload is None:
                     return
                 with self._reg_lock:
-                    buf = self._regions.get(key)
-                if buf is None:
+                    region = self._regions.get(key)
+                if region is None:
                     # write raced region teardown: the deregistered-MR analog
                     trace_tcpw.log("discarding %dB write to dead region", ln)
                     continue
-                if off + ln > len(buf):
-                    trace_tcpw.log("discarding out-of-bounds write "
-                                   "(%d+%d > %d)", off, ln, len(buf))
+                try:
+                    buf = region.buf
+                    if off + ln > len(buf):
+                        trace_tcpw.log("discarding out-of-bounds write "
+                                       "(%d+%d > %d)", off, ln, len(buf))
+                        continue
+                    buf[off:off + ln] = payload
+                except ValueError:
+                    # Region.close() releases the view BEFORE unregistering;
+                    # a record landing in that window is a stale write
+                    trace_tcpw.log("discarding %dB write to closing region",
+                                   ln)
                     continue
-                buf[off:off + ln] = payload
+                # Post-apply kick (Region.on_write): THIS is what makes the
+                # async domain lose no wakeups — the peer's notify token can
+                # arrive before this record does, and a waiter that re-checked
+                # too early would sleep forever without it. (Teardown nulls
+                # the hook before closing its wake fds, so a racing kick can
+                # never write a reused fd.)
+                hook = region.on_write
+                if hook is not None:
+                    try:
+                        hook()
+                    except Exception:
+                        pass  # racing pair teardown
 
 
 class _PeerLink:
@@ -268,7 +288,6 @@ class TcpWindowDomain(MemoryDomain):
         key = uuid.uuid4().bytes
         buf = bytearray(nbytes)
         mv = memoryview(buf)
-        server.register(key, mv)
         from tpurpc.utils.config import get_config
 
         handle = f"tcpw:{get_config().tcpw_host}:{server.port}:{key.hex()}"
@@ -276,7 +295,12 @@ class TcpWindowDomain(MemoryDomain):
         def _close():
             server.unregister(key)
 
-        return Region(handle, buf, _close)
+        del mv
+        region = Region(handle, buf, _close)
+        # registered as the Region itself: the applier lands bytes through
+        # region.buf and runs its on_write kick (async-domain wakeup contract)
+        server.register(key, region)
+        return region
 
     def open_window(self, handle: str, nbytes: int) -> Window:
         if not handle.startswith("tcpw:"):
@@ -293,5 +317,29 @@ class TcpWindowDomain(MemoryDomain):
         # path (pair.py:568).
         return Window(write, link.release, view=None)
 
+
+def _after_fork_in_child() -> None:
+    """Fresh locks + empty singletons in the child: a thread holding any of
+    these locks at fork() would leave the child a locked mutex with no
+    owner (deadlock on first touch). Class locks are replaced; the
+    INHERITED instance's/links' locks are replaced too — closures captured
+    pre-fork (Region._close -> server.unregister, Window.write -> link)
+    still reach those objects. Inherited links are also marked dead: their
+    sockets belong to the parent's record streams, and a child write would
+    interleave two processes' records."""
+    inst = _RecordServer._instance
+    if inst is not None:
+        inst._reg_lock = threading.Lock()
+    _RecordServer._lock = threading.Lock()
+    _RecordServer._instance = None
+    for link in _PeerLink._links.values():
+        link._send_lock = threading.Lock()
+        link.dead = True
+    _PeerLink._links_lock = threading.Lock()
+    _PeerLink._links = {}
+    _PeerLink._links_pid = os.getpid()
+
+
+os.register_at_fork(after_in_child=_after_fork_in_child)
 
 register_domain("tcp_window", TcpWindowDomain)
