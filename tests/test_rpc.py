@@ -595,3 +595,60 @@ def test_server_keepalive_spares_ponging_idle_client(monkeypatch):
         t.join(timeout=2)
         raw.close()
         srv.stop(grace=0)
+
+
+# ---------------------------------------------------------------------------
+# Inline (reactor) unary handlers — the Python twin of the native callback
+# API (tpr_server_register_callback): handler runs on the reader thread.
+# ---------------------------------------------------------------------------
+
+def test_inline_unary_handler_end_to_end():
+    srv = rpc.Server(max_workers=2)
+    srv.add_method("/i.S/Echo", rpc.unary_unary_rpc_method_handler(
+        lambda r, c: bytes(r) + b"!", inline=True))
+
+    def md_reader(req, ctx):
+        return dict(ctx.invocation_metadata()).get("k", "?").encode()
+
+    srv.add_method("/i.S/Md", rpc.unary_unary_rpc_method_handler(
+        md_reader, inline=True))
+
+    def boom(req, ctx):
+        raise RuntimeError("kaboom")
+
+    srv.add_method("/i.S/Boom", rpc.unary_unary_rpc_method_handler(
+        boom, inline=True))
+
+    def abort(req, ctx):
+        ctx.abort(StatusCode.PERMISSION_DENIED, "no")
+
+    srv.add_method("/i.S/Abort", rpc.unary_unary_rpc_method_handler(
+        abort, inline=True))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    try:
+        with rpc.insecure_channel(f"127.0.0.1:{port}") as ch:
+            assert ch.unary_unary("/i.S/Echo")(b"hi", timeout=10) == b"hi!"
+            assert ch.unary_unary("/i.S/Echo")(b"", timeout=10) == b"!"
+            big = b"B" * (2 << 20)  # fragmented request reassembles first
+            assert ch.unary_unary("/i.S/Echo")(big, timeout=30) == big + b"!"
+            assert ch.unary_unary("/i.S/Md")(
+                b"", timeout=10, metadata=[("k", "v")]) == b"v"
+            # handler exceptions map to UNKNOWN and the connection survives
+            with pytest.raises(rpc.RpcError) as ei:
+                ch.unary_unary("/i.S/Boom")(b"", timeout=10)
+            assert ei.value.code() is StatusCode.UNKNOWN
+            with pytest.raises(rpc.RpcError) as ei:
+                ch.unary_unary("/i.S/Abort")(b"", timeout=10)
+            assert ei.value.code() is StatusCode.PERMISSION_DENIED
+            # the SAME connection keeps serving after inline errors
+            assert ch.unary_unary("/i.S/Echo")(b"again", timeout=10) == b"again!"
+    finally:
+        srv.stop(grace=0)
+
+
+def test_inline_rejected_for_streaming_kinds():
+    from tpurpc.rpc.server import RpcMethodHandler
+
+    with pytest.raises(ValueError):
+        RpcMethodHandler("unary_stream", lambda r, c: iter([]), inline=True)

@@ -39,7 +39,8 @@ def add_benchmark_service(srv: "rpc.Server") -> None:
             yield req
 
     srv.add_method(SERVICE + "UnaryCall",
-                   rpc.unary_unary_rpc_method_handler(unary_call))
+                   rpc.unary_unary_rpc_method_handler(unary_call,
+                                                      inline=True))
     srv.add_method(SERVICE + "StreamingCall",
                    rpc.stream_stream_rpc_method_handler(streaming_call))
 

@@ -39,17 +39,30 @@ _log = logging.getLogger("tpurpc.server")
 
 
 class RpcMethodHandler:
-    """One registered method: shape + behavior + codecs (grpcio taxonomy)."""
+    """One registered method: shape + behavior + codecs (grpcio taxonomy).
 
-    __slots__ = ("kind", "behavior", "request_deserializer", "response_serializer")
+    ``inline=True`` (unary_unary only) runs the handler ON THE CONNECTION
+    READER THREAD when the request completes — no thread-pool handoff, the
+    low-latency reactor path (the native callback API's contract,
+    ``native/include/tpurpc/server.h``; gRPC's inlineable callback methods
+    are the upstream analog). The handler MUST NOT block: it stalls every
+    stream on its connection.
+    """
+
+    __slots__ = ("kind", "behavior", "request_deserializer",
+                 "response_serializer", "inline")
 
     KINDS = ("unary_unary", "unary_stream", "stream_unary", "stream_stream")
 
     def __init__(self, kind: str, behavior: Callable,
                  request_deserializer: Deserializer = _identity,
-                 response_serializer: Serializer = _identity):
+                 response_serializer: Serializer = _identity,
+                 inline: bool = False):
         if kind not in self.KINDS:
             raise ValueError(f"bad handler kind {kind}")
+        if inline and kind != "unary_unary":
+            raise ValueError("inline handlers are unary_unary only")
+        self.inline = inline
         self.kind = kind
         self.behavior = behavior
         self.request_deserializer = request_deserializer
@@ -65,9 +78,10 @@ class RpcMethodHandler:
 
 
 def unary_unary_rpc_method_handler(behavior, request_deserializer=_identity,
-                                   response_serializer=_identity):
+                                   response_serializer=_identity,
+                                   inline: bool = False):
     return RpcMethodHandler("unary_unary", behavior, request_deserializer,
-                            response_serializer)
+                            response_serializer, inline=inline)
 
 
 def unary_stream_rpc_method_handler(behavior, request_deserializer=_identity,
@@ -197,6 +211,10 @@ class _ServerStream:
         self.assembly = fr.Assembly()
         self.half_closed = False
         self.context: Optional[ServerContext] = None
+        #: reactor-path pending invocation: (handler, ctx, path) set by
+        #: _start_stream for inline unary handlers; consumed by the sink's
+        #: commit when the request completes (runs on the reader thread)
+        self.inline_call = None
         #: Backpressure: at most queue_depth completed-but-unconsumed
         #: messages per stream. The connection READER blocks acquiring a
         #: credit, which stops draining the transport, which dries the
@@ -288,6 +306,14 @@ class _ServerSink(fr.MessageSink):
                               bool(flags & fr.FLAG_END_STREAM),
                               bool(flags & fr.FLAG_NO_MESSAGE),
                               oversized=st.assembly.oversized)
+            if (flags & fr.FLAG_END_STREAM) and st.inline_call is not None:
+                # reactor path: the whole request is in st.requests — run
+                # the handler ON THE READER THREAD (no pool handoff). The
+                # native callback API's exact contract (server.h), opt-in
+                # per handler; a blocking handler stalls this connection.
+                handler, ctx, path = st.inline_call
+                st.inline_call = None
+                self._conn._run_handler(handler, st, ctx, path)
 
 
 class _ServerConnection:
@@ -472,6 +498,11 @@ class _ServerConnection:
             return
         ctx = ServerContext(self, st, metadata, deadline)
         st.context = ctx
+        if getattr(handler, "inline", False):
+            # reactor path: defer to the sink's commit (reader thread) when
+            # the request message completes — zero pool handoffs
+            st.inline_call = (handler, ctx, path)
+            return
         try:
             self.server._pool.submit(self._run_handler, handler, st, ctx, path)
         except RuntimeError:  # pool shut down: server is stopping
