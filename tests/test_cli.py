@@ -1,0 +1,61 @@
+"""The grpcurl-shaped CLI (tpurpc.tools.cli) against a live server."""
+
+import subprocess
+import sys
+
+import pytest
+
+import tpurpc.rpc as rpc
+from tpurpc.rpc import health
+
+
+@pytest.fixture()
+def served():
+    srv = rpc.Server(max_workers=4)
+    srv.add_method("/c.S/Echo",
+                   rpc.unary_unary_rpc_method_handler(
+                       lambda r, c: bytes(r).upper(), inline=True))
+    rpc.enable_server_reflection(srv)
+    hs = rpc.add_health_servicer(srv)
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    yield srv, port, hs
+    srv.stop(grace=0)
+
+
+def _cli(*args):
+    return subprocess.run([sys.executable, "-m", "tpurpc.tools.cli", *args],
+                          capture_output=True, text=True, timeout=120)
+
+
+def test_cli_list(served):
+    _, port, _ = served
+    out = _cli("list", f"127.0.0.1:{port}")
+    assert out.returncode == 0, out.stderr
+    assert "c.S" in out.stdout
+    assert "grpc.reflection.v1alpha.ServerReflection" in out.stdout
+
+
+def test_cli_call_and_status(served):
+    _, port, _ = served
+    out = _cli("call", f"127.0.0.1:{port}", "/c.S/Echo", "hello")
+    assert out.returncode == 0 and out.stdout == "HELLO"
+    out = _cli("call", f"127.0.0.1:{port}", "/c.S/Nope", "x")
+    assert out.returncode == 12  # UNIMPLEMENTED, grpcurl-style exit code
+    assert "UNIMPLEMENTED" in out.stderr
+
+
+def test_cli_health_and_ping(served):
+    _, port, hs = served
+    out = _cli("health", f"127.0.0.1:{port}")
+    assert out.returncode == 0 and "SERVING" in out.stdout
+    hs.set("", health.ServingStatus.NOT_SERVING)
+    out = _cli("health", f"127.0.0.1:{port}")
+    assert out.returncode == 1 and "NOT_SERVING" in out.stdout
+    out = _cli("ping", f"127.0.0.1:{port}")
+    assert out.returncode == 0 and "us" in out.stdout
+
+
+def test_cli_unreachable():
+    out = _cli("--timeout", "2", "ping", "127.0.0.1:1")
+    assert out.returncode == 14  # UNAVAILABLE

@@ -1,0 +1,1 @@
+"""Operator tooling over the standard services (reflection, health)."""

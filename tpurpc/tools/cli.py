@@ -1,0 +1,135 @@
+"""tpurpc command-line tool — the grpcurl-shaped workflow over tpurpc.
+
+The reference ecosystem's debugging loop is `grpcurl list/describe/call`
+against the reflection service (``src/cpp/ext/proto_server_reflection.cc``);
+this is that loop as a first-party tool over tpurpc's native framing:
+
+    python -m tpurpc.tools.cli list host:port
+    python -m tpurpc.tools.cli health host:port [service]
+    python -m tpurpc.tools.cli call host:port /pkg.Svc/Method [payload]
+    python -m tpurpc.tools.cli ping host:port
+
+``call`` sends the payload bytes verbatim (or stdin when omitted; prefix
+with @file to read a file) and prints the raw response — codecs live in
+generated stubs, not here. Exit code 0 on OK, the gRPC status code
+otherwise (grpcurl convention).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tpurpc.rpc.channel import Channel
+from tpurpc.rpc.status import RpcError
+
+
+def _channel(target: str) -> Channel:
+    return Channel(target)
+
+
+def cmd_list(args) -> int:
+    from tpurpc.rpc.reflection import V1ALPHA_SERVICE
+    from tpurpc.wire.protowire import fields, ld
+
+    with _channel(args.target) as ch:
+        mc = ch.stream_stream(f"/{V1ALPHA_SERVICE}/ServerReflectionInfo")
+        reply = next(iter(mc(iter([ld(7, b"")]), timeout=args.timeout)))
+    names = []
+    for f, _w, v in fields(bytes(reply)):
+        if f == 6:
+            for f2, _w2, v2 in fields(bytes(v)):
+                if f2 == 1:
+                    for f3, _w3, v3 in fields(bytes(v2)):
+                        if f3 == 1:
+                            names.append(bytes(v3).decode())
+    for n in sorted(names):
+        print(n)
+    return 0
+
+
+def cmd_health(args) -> int:
+    from tpurpc.rpc import health
+
+    with _channel(args.target) as ch:
+        mc = ch.unary_unary("/grpc.health.v1.Health/Check")
+        try:
+            raw = mc(health.encode_request(args.service or ""),
+                     timeout=args.timeout)
+        except RpcError as exc:
+            print(f"error: {exc.code().name}: {exc.details()}",
+                  file=sys.stderr)
+            return exc.code().value
+    status = health.decode_response(raw)
+    print(status.name)
+    return 0 if status is health.ServingStatus.SERVING else 1
+
+
+def cmd_call(args) -> int:
+    if args.payload is None:
+        payload = sys.stdin.buffer.read()
+    elif args.payload.startswith("@"):
+        with open(args.payload[1:], "rb") as f:
+            payload = f.read()
+    else:
+        payload = args.payload.encode()
+    with _channel(args.target) as ch:
+        mc = ch.unary_unary(args.method)
+        try:
+            resp, call = mc.with_call(payload, timeout=args.timeout)
+        except RpcError as exc:
+            print(f"error: {exc.code().name}: {exc.details()}",
+                  file=sys.stderr)
+            return exc.code().value
+        sys.stdout.buffer.write(bytes(resp))
+        sys.stdout.buffer.flush()
+        for k, v in call.trailing_metadata() or ():
+            print(f"\n{k}: {v}", file=sys.stderr)
+    return 0
+
+
+def cmd_ping(args) -> int:
+    with _channel(args.target) as ch:
+        try:
+            rtt = ch.ping(timeout=args.timeout)
+        except RpcError as exc:
+            print(f"error: {exc.code().name}: {exc.details()}",
+                  file=sys.stderr)
+            return exc.code().value
+    print(f"{rtt * 1e6:.0f} us")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m tpurpc.tools.cli",
+                                 description=__doc__.splitlines()[0])
+    ap.add_argument("--timeout", type=float, default=20.0)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p = sub.add_parser("list", help="reflection: list services")
+    p.add_argument("target")
+    p.set_defaults(fn=cmd_list)
+    p = sub.add_parser("health", help="grpc.health.v1 check")
+    p.add_argument("target")
+    p.add_argument("service", nargs="?", default="")
+    p.set_defaults(fn=cmd_health)
+    p = sub.add_parser("call", help="unary call with raw bytes")
+    p.add_argument("target")
+    p.add_argument("method")
+    p.add_argument("payload", nargs="?", default=None)
+    p.set_defaults(fn=cmd_call)
+    p = sub.add_parser("ping", help="transport-level PING round trip")
+    p.add_argument("target")
+    p.set_defaults(fn=cmd_ping)
+    args = ap.parse_args(argv)
+    try:
+        return args.fn(args)
+    except RpcError as exc:
+        print(f"error: {exc.code().name}: {exc.details()}", file=sys.stderr)
+        return exc.code().value
+    except (ConnectionError, OSError) as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 14  # UNAVAILABLE
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
