@@ -59,3 +59,10 @@ def test_cli_health_and_ping(served):
 def test_cli_unreachable():
     out = _cli("--timeout", "2", "ping", "127.0.0.1:1")
     assert out.returncode == 14  # UNAVAILABLE
+
+
+def test_cli_missing_payload_file_is_usage_error(served):
+    _, port, _ = served
+    out = _cli("call", f"127.0.0.1:{port}", "/c.S/Echo", "@/no/such/file")
+    assert out.returncode == 2  # usage error, not UNAVAILABLE
+    assert "cannot read payload file" in out.stderr

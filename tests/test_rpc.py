@@ -652,3 +652,41 @@ def test_inline_rejected_for_streaming_kinds():
 
     with pytest.raises(ValueError):
         RpcMethodHandler("unary_stream", lambda r, c: iter([]), inline=True)
+
+
+def test_inline_handler_deadline_without_body():
+    """A client that opens an inline-method stream with a deadline but never
+    sends the body must get DEADLINE_EXCEEDED and the stream must be reaped
+    (review finding: the parked call used to leak forever)."""
+    from tpurpc.core.endpoint import passthru_endpoint_pair
+
+    srv = rpc.Server(max_workers=2)
+    srv.add_method("/i.S/Echo", rpc.unary_unary_rpc_method_handler(
+        lambda r, c: bytes(r), inline=True))
+    srv.start()
+    a, b = passthru_endpoint_pair()
+    srv.serve_endpoint(b)
+    w = fr.FrameWriter(a)
+    w.send_preface()
+    # HEADERS with a 300ms deadline, then silence
+    w.send(fr.HEADERS, 0, 1,
+           fr.headers_payload("/i.S/Echo", [], timeout_us=300000))
+    reader = fr.FrameReader(a)
+    deadline = time.monotonic() + 10
+    got = None
+    while time.monotonic() < deadline:
+        f = reader.read_frame()
+        if f is None:
+            break
+        if f is not fr.CONSUMED and f.type == fr.TRAILERS:
+            got = fr.parse_trailers(f.payload)
+            break
+    assert got is not None, "no trailers within 10s"
+    assert got[0] is StatusCode.DEADLINE_EXCEEDED
+    # the stream itself was reaped
+    conn = srv._connections[0]
+    t0 = time.monotonic()
+    while conn._streams and time.monotonic() - t0 < 5:
+        time.sleep(0.02)
+    assert not conn._streams
+    srv.stop(grace=0)

@@ -215,6 +215,7 @@ class _ServerStream:
         #: _start_stream for inline unary handlers; consumed by the sink's
         #: commit when the request completes (runs on the reader thread)
         self.inline_call = None
+        self.inline_timer = None  # deadline watchdog for the parked call
         #: Backpressure: at most queue_depth completed-but-unconsumed
         #: messages per stream. The connection READER blocks acquiring a
         #: credit, which stops draining the transport, which dries the
@@ -306,14 +307,16 @@ class _ServerSink(fr.MessageSink):
                               bool(flags & fr.FLAG_END_STREAM),
                               bool(flags & fr.FLAG_NO_MESSAGE),
                               oversized=st.assembly.oversized)
-            if (flags & fr.FLAG_END_STREAM) and st.inline_call is not None:
-                # reactor path: the whole request is in st.requests — run
-                # the handler ON THE READER THREAD (no pool handoff). The
-                # native callback API's exact contract (server.h), opt-in
-                # per handler; a blocking handler stalls this connection.
-                handler, ctx, path = st.inline_call
-                st.inline_call = None
-                self._conn._run_handler(handler, st, ctx, path)
+            if flags & fr.FLAG_END_STREAM:
+                ic = self._conn._claim_inline(st)
+                if ic is not None:
+                    # reactor path: the whole request is in st.requests —
+                    # run the handler ON THE READER THREAD (no pool
+                    # handoff). The native callback API's exact contract
+                    # (server.h), opt-in per handler; a blocking handler
+                    # stalls this connection.
+                    handler, ctx, path = ic
+                    self._conn._run_handler(handler, st, ctx, path)
 
 
 class _ServerConnection:
@@ -500,8 +503,19 @@ class _ServerConnection:
         st.context = ctx
         if getattr(handler, "inline", False):
             # reactor path: defer to the sink's commit (reader thread) when
-            # the request message completes — zero pool handoffs
+            # the request message completes — zero pool handoffs. The
+            # declared deadline still needs a watchdog: a client that opens
+            # the stream but never sends the body would otherwise park the
+            # call forever (and a non-empty _streams suppresses the
+            # keepalive reaper) — non-inline handlers get this from
+            # next_request(timeout=...).
             st.inline_call = (handler, ctx, path)
+            if deadline is not None:
+                t = threading.Timer(max(0.0, deadline - time.monotonic()),
+                                    self._inline_deadline, args=(st,))
+                t.daemon = True
+                st.inline_timer = t
+                t.start()
             return
         try:
             self.server._pool.submit(self._run_handler, handler, st, ctx, path)
@@ -515,6 +529,22 @@ class _ServerConnection:
             # forever and the client — seeing healthy RPC replies — never
             # reconnects (observed: 597 failed attempts/60s in round-2 CI).
             self.close()
+
+    def _claim_inline(self, st: _ServerStream):
+        """Atomically take a parked inline call (the sink's commit and the
+        deadline watchdog race for it; exactly one side runs)."""
+        with self._lock:
+            ic, st.inline_call = st.inline_call, None
+        if ic is not None and st.inline_timer is not None:
+            st.inline_timer.cancel()
+            st.inline_timer = None
+        return ic
+
+    def _inline_deadline(self, st: _ServerStream) -> None:
+        if self._claim_inline(st) is not None:
+            self._send_trailers(st, StatusCode.DEADLINE_EXCEEDED,
+                                "deadline exceeded awaiting request")
+            self._finish_stream(st)
 
     def _run_handler(self, handler: RpcMethodHandler, st: _ServerStream,
                      ctx: ServerContext, path: str) -> None:

@@ -69,8 +69,14 @@ def cmd_call(args) -> int:
     if args.payload is None:
         payload = sys.stdin.buffer.read()
     elif args.payload.startswith("@"):
-        with open(args.payload[1:], "rb") as f:
-            payload = f.read()
+        try:
+            with open(args.payload[1:], "rb") as f:
+                payload = f.read()
+        except OSError as exc:
+            # local usage error, NOT a network failure: exit 2 (argparse's
+            # usage-error code), never a gRPC status a script would retry
+            print(f"error: cannot read payload file: {exc}", file=sys.stderr)
+            return 2
     else:
         payload = args.payload.encode()
     with _channel(args.target) as ch:
