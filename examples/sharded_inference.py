@@ -56,6 +56,14 @@ def main() -> int:
 
     B, S = 4 * sizes["dp"] * sizes["ep"], 16 * sizes["sp"]
 
+    # Warm the pjit'd forward BEFORE serving: the sharded compile can take
+    # tens of seconds on a loaded single-core host, and production servers
+    # never pay cold compiles inside a caller's RPC deadline (bench.py's
+    # server warms the same way before printing READY).
+    jax.tree_util.tree_map(
+        lambda x: x.block_until_ready(),
+        fwd(params, np.zeros((B, S), np.int32)))
+
     def serve(tree):
         logits = fwd(params, tree["tokens"].astype(np.int32))
         return {"logits": logits}
