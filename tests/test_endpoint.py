@@ -25,7 +25,13 @@ from tpurpc.core.endpoint import (
 
 def _listener_fixture(monkeypatch, platform):
     """Stand up listener+client with GRPC_PLATFORM_TYPE=<platform> — the documented
-    UX (reference README.md:17-25)."""
+    UX (reference README.md:17-25). The "+tcpw" suffix additionally selects
+    the cross-host tcp_window ring domain (TPURPC_RING_DOMAIN), running the
+    identical conformance battery over the socket-carried one-sided fabric."""
+    if platform.endswith("+tcpw"):
+        platform = platform[:-5]
+        monkeypatch.setenv("TPURPC_RING_DOMAIN", "tcp_window")
+        monkeypatch.setenv("TPURPC_RING_BUFFER_SIZE_KB", "256")
     monkeypatch.setenv("GRPC_PLATFORM_TYPE", platform)
     from tpurpc.utils import config as config_mod
 
@@ -37,7 +43,8 @@ def _listener_fixture(monkeypatch, platform):
     return listener, client, server
 
 
-PLATFORMS = ["TCP", "RDMA_BP", "RDMA_EVENT", "RDMA_BPEV"]
+PLATFORMS = ["TCP", "RDMA_BP", "RDMA_EVENT", "RDMA_BPEV",
+             "RDMA_BP+tcpw", "RDMA_BPEV+tcpw"]
 
 
 @pytest.fixture(params=PLATFORMS + ["passthru"])
