@@ -1,0 +1,142 @@
+"""grpc.channelz.v1 wire service: stock grpcio client, hand-decoded protos
+(the grpc_channelz package isn't in this image; these are the bytes the
+grpcdebug tool sends)."""
+
+import grpc
+import pytest
+
+import tpurpc.rpc as rpc
+from tpurpc.rpc.channelz_v1 import SERVICE, enable_channelz
+from tpurpc.wire.protowire import encode_varint, fields, ld, vf
+
+_ID = lambda b: b
+
+
+def _submsgs(raw, field_no):
+    return [bytes(v) for f, _w, v in fields(bytes(raw)) if f == field_no]
+
+
+def _field(raw, field_no, default=None):
+    for f, _w, v in fields(bytes(raw)):
+        if f == field_no:
+            return v
+    return default
+
+
+@pytest.fixture()
+def served():
+    srv = rpc.Server(max_workers=4)
+    srv.add_method("/z.S/Echo", rpc.unary_unary_rpc_method_handler(
+        lambda r, c: bytes(r), inline=True))
+    enable_channelz(srv)
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    yield srv, port
+    srv.stop(grace=0)
+
+
+def test_get_servers_stock_grpcio(served):
+    srv, port = served
+    with grpc.insecure_channel(f"127.0.0.1:{port}") as ch:
+        mc = ch.unary_unary(f"/{SERVICE}/GetServers", _ID, _ID)
+        resp = mc(b"")  # defaults: start 0
+    servers = _submsgs(resp, 1)
+    assert servers, "no servers listed"
+    assert _field(resp, 2) == 1  # end = true
+    # our server is among them: its ref has an id, its listen socket is
+    # named after the port (socket ids come from the entity-id space)
+    found = False
+    for s in servers:
+        ref = _field(s, 1)
+        for sock in _submsgs(s, 3):
+            if _field(sock, 2) == f"listen:{port}".encode():
+                found = True
+                assert _field(sock, 1, 0) > 0
+        assert ref is not None and _field(ref, 1, 0) > 0
+    assert found, f"listen socket {port} not reported"
+
+
+def test_channel_counters_and_get_channel(served):
+    _, port = served
+    with rpc.insecure_channel(f"127.0.0.1:{port}") as tch:
+        echo = tch.unary_unary("/z.S/Echo")
+        for _ in range(3):
+            assert echo(b"x", timeout=10) == b"x"
+        cid = tch._channelz_id
+        with grpc.insecure_channel(f"127.0.0.1:{port}") as ch:
+            mc = ch.unary_unary(f"/{SERVICE}/GetChannel", _ID, _ID)
+            resp = mc(vf(1, cid))
+        channel = _field(resp, 1)
+        data = _field(channel, 2)
+        assert _field(data, 4) >= 3      # calls_started
+        assert _field(data, 5) >= 3      # calls_succeeded
+        state = _field(data, 1)
+        assert _field(state, 1) == 3     # READY (channelz.proto)
+        # NOT_FOUND for a bogus id
+        with grpc.insecure_channel(f"127.0.0.1:{port}") as ch:
+            mc = ch.unary_unary(f"/{SERVICE}/GetChannel", _ID, _ID)
+            with pytest.raises(grpc.RpcError) as ei:
+                mc(vf(1, 999999))
+            assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+
+
+def test_server_call_counters_move(served):
+    srv, port = served
+    with rpc.insecure_channel(f"127.0.0.1:{port}") as tch:
+        assert tch.unary_unary("/z.S/Echo")(b"y", timeout=10) == b"y"
+    with grpc.insecure_channel(f"127.0.0.1:{port}") as ch:
+        mc = ch.unary_unary(f"/{SERVICE}/GetServer", _ID, _ID)
+        resp = mc(vf(1, srv._channelz_id))
+    data = _field(_field(resp, 1), 2)
+    assert _field(data, 2, 0) >= 1  # calls_started (incl. this RPC family)
+
+
+def test_pagination(served):
+    _, port = served
+    with grpc.insecure_channel(f"127.0.0.1:{port}") as ch:
+        mc = ch.unary_unary(f"/{SERVICE}/GetTopChannels", _ID, _ID)
+        # max_results=1: first page may not be the end (suite leaves live
+        # channels around); walking with start_channel_id terminates
+        start, seen, pages = 0, 0, 0
+        while True:
+            resp = mc(vf(1, start) + vf(2, 1))
+            chans = _submsgs(resp, 1)
+            seen += len(chans)
+            pages += 1
+            if _field(resp, 2) == 1 or not chans:
+                break
+            ref = _field(chans[-1], 1)
+            start = _field(ref, 1) + 1
+            assert pages < 1000
+    assert seen >= 1
+
+
+def test_get_server_sockets_empty_page(served):
+    srv, port = served
+    with grpc.insecure_channel(f"127.0.0.1:{port}") as ch:
+        mc = ch.unary_unary(f"/{SERVICE}/GetServerSockets", _ID, _ID)
+        resp = mc(vf(1, srv._channelz_id))
+        assert _field(resp, 2) == 1  # end, no sockets
+        with pytest.raises(grpc.RpcError) as ei:
+            mc(vf(1, 999999))
+        assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+
+
+def test_deadline_expired_call_counts_as_failed(served):
+    import time as _t
+
+    srv, port = served
+
+    def slow(req, ctx):
+        _t.sleep(1.0)
+        return b"late"
+
+    srv.add_method("/z.S/Slow", rpc.unary_unary_rpc_method_handler(slow))
+    with rpc.insecure_channel(f"127.0.0.1:{port}") as tch:
+        with pytest.raises(rpc.RpcError):
+            tch.unary_unary("/z.S/Slow")(b"", timeout=0.2)
+        c = tch.call_counters
+        deadline = _t.monotonic() + 5
+        while c.failed < 1 and _t.monotonic() < deadline:
+            _t.sleep(0.02)
+        assert c.started == 1 and c.failed == 1  # reconciled

@@ -92,4 +92,8 @@ from tpurpc.rpc.health import add_health_servicer  # noqa: E402
 
 __all__ += ["add_health_servicer"]
 
+from tpurpc.rpc.channelz_v1 import enable_channelz  # noqa: E402
+
+__all__ += ["enable_channelz"]
+
 __all__ += ["NativeChannel"]

@@ -457,6 +457,8 @@ class Channel:
         self._closed = False
         from tpurpc.rpc import channelz as _channelz
 
+        #: channelz ChannelData counters (started/succeeded/failed)
+        self.call_counters = _channelz.CallCounters()
         _channelz.register_channel(self)
 
     # -- connection management ----------------------------------------------
@@ -619,7 +621,8 @@ class Call:
     """In-flight call handle: response iteration, cancel, metadata accessors."""
 
     def __init__(self, conn: _Connection, st: _ClientStream,
-                 deserializer: Deserializer, deadline: Optional[float]):
+                 deserializer: Deserializer, deadline: Optional[float],
+                 counters=None):
         self._conn = conn
         self._st = st
         self._deser = deserializer
@@ -628,6 +631,7 @@ class Call:
         self._code: Optional[StatusCode] = None
         self._details = ""
         self._cancelled = False
+        self._counters = counters  # channelz ChannelData (counted once)
 
     # -- metadata/status ------------------------------------------------------
 
@@ -693,6 +697,9 @@ class Call:
                            "deadline exceeded awaiting response") from None
 
     def _expire(self) -> None:
+        if self._counters is not None:  # counters reconcile: expiry = failed
+            self._counters.on_finish(False)
+            self._counters = None
         self._code = StatusCode.DEADLINE_EXCEEDED
         self._details = "deadline exceeded"
         try:
@@ -704,6 +711,9 @@ class Call:
         self._conn.close_stream(self._st)
 
     def _finish(self, code: StatusCode, details: str, md) -> None:
+        if self._counters is not None:
+            self._counters.on_finish(code is StatusCode.OK)
+            self._counters = None  # retries/dup events must not double-count
         self._code = code
         self._details = details
         self._trailing = md
@@ -844,7 +854,9 @@ class _MultiCallable:
         except (EndpointError, OSError) as exc:
             raise RpcError(StatusCode.UNAVAILABLE,
                            f"transport failed: {exc}") from exc
-        return conn, st, Call(conn, st, self._deser, deadline)
+        self._channel.call_counters.on_start()
+        return conn, st, Call(conn, st, self._deser, deadline,
+                              counters=self._channel.call_counters)
 
     def _send_one(self, conn: _Connection, st: _ClientStream, request,
                   end_stream: bool) -> None:

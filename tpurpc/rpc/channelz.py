@@ -22,25 +22,32 @@ _channels: "weakref.WeakSet" = weakref.WeakSet()
 
 
 class CallCounters:
-    """started/succeeded/failed + last-activity timestamps (channelz core)."""
+    """started/succeeded/failed + last-activity timestamps (channelz core).
 
-    __slots__ = ("started", "succeeded", "failed", "last_call_started")
+    Lock-guarded: one instance is shared by every thread of a channel or
+    server, and ``+=`` is a read-modify-write the GIL can split."""
+
+    __slots__ = ("started", "succeeded", "failed", "last_call_started",
+                 "_mu")
 
     def __init__(self):
         self.started = 0
         self.succeeded = 0
         self.failed = 0
         self.last_call_started = 0.0
+        self._mu = threading.Lock()
 
     def on_start(self) -> None:
-        self.started += 1
-        self.last_call_started = time.time()
+        with self._mu:
+            self.started += 1
+            self.last_call_started = time.time()
 
     def on_finish(self, ok: bool) -> None:
-        if ok:
-            self.succeeded += 1
-        else:
-            self.failed += 1
+        with self._mu:
+            if ok:
+                self.succeeded += 1
+            else:
+                self.failed += 1
 
     def as_dict(self) -> Dict:
         return {"calls_started": self.started,
@@ -49,14 +56,52 @@ class CallCounters:
                 "last_call_started": self.last_call_started}
 
 
+_next_id = 0
+
+
+def _assign_id(obj) -> None:
+    global _next_id
+    _next_id += 1
+    obj._channelz_id = _next_id
+
+
 def register_server(srv) -> None:
     with _lock:
+        _assign_id(srv)
         _servers.add(srv)
 
 
 def register_channel(ch) -> None:
     with _lock:
+        _assign_id(ch)
         _channels.add(ch)
+
+
+def live_servers():
+    """(id, server) pairs, id-ordered (channelz v1 pagination contract)."""
+    with _lock:
+        return sorted(((s._channelz_id, s) for s in _servers))
+
+
+def live_channels():
+    with _lock:
+        return sorted(((c._channelz_id, c) for c in _channels))
+
+
+_socket_ids: Dict = {}
+
+
+def socket_id_for(srv, port: int) -> int:
+    """Stable channelz id for a server's listen socket, drawn from the same
+    entity-id space as servers/channels (global uniqueness contract)."""
+    global _next_id
+    key = (id(srv), port)
+    with _lock:
+        sid = _socket_ids.get(key)
+        if sid is None:
+            _next_id += 1
+            sid = _socket_ids[key] = _next_id
+        return sid
 
 
 def server_info(srv) -> Dict:

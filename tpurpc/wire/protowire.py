@@ -41,6 +41,13 @@ def ld(field_no: int, payload: bytes) -> bytes:
     return bytes([(field_no << 3) | 2]) + encode_varint(len(payload)) + payload
 
 
+def vf(field_no: int, value: int) -> bytes:
+    """A varint (wire type 0) field; proto3 default-0 is omitted."""
+    if not value:
+        return b""
+    return encode_varint((field_no << 3) | 0) + encode_varint(int(value))
+
+
 def fields(data: bytes) -> Iterator[Tuple[int, int, Union[int, bytes]]]:
     """Yield ``(field_no, wire_type, value)`` over a serialized message.
 
