@@ -109,3 +109,32 @@ def test_copy_ledger_accumulates_and_resets():
     assert led.as_dict()["device_dma"] == 4096
     led.reset()
     assert all(v == 0 for v in led.as_dict().values())
+
+
+def test_timer_wheel_schedules_and_cancels():
+    """One wheel thread serves many timers (iomgr/timer.cc role); cancel is
+    best-effort; a raising callback doesn't kill the wheel."""
+    import threading
+    import time as _t
+
+    from tpurpc.utils import timers
+
+    fired = []
+    ev = threading.Event()
+    timers.schedule(0.05, lambda: (fired.append("a"), ev.set()))
+    h = timers.schedule(0.05, lambda: fired.append("cancelled"))
+    h.cancel()
+    timers.schedule(0.01, lambda: 1 / 0)  # must not kill the wheel
+    assert ev.wait(5)
+    ev2 = threading.Event()
+    timers.schedule(0.02, ev2.set)  # wheel survived the exception
+    assert ev2.wait(5)
+    _t.sleep(0.15)
+    assert fired == ["a"]
+    # ordering: earlier deadline fires first even if scheduled later
+    order = []
+    done = threading.Event()
+    timers.schedule(0.10, lambda: (order.append(2), done.set()))
+    timers.schedule(0.02, lambda: order.append(1))
+    assert done.wait(5)
+    assert order == [1, 2]

@@ -1,0 +1,95 @@
+"""Process-wide timer wheel — one thread for every scheduled deadline.
+
+The reference's iomgr has a timer subsystem (``iomgr/timer.cc``: a heap of
+deadlines serviced by the timer thread) precisely because spawning a thread
+per timer is unaffordable on hot paths. ``threading.Timer`` is exactly
+that unaffordable thing (~100µs thread spawn per arm — measured turning
+the inline-handler deadline watchdog into a 25% RPC-rate regression).
+
+    handle = schedule(0.3, fn)   # fn() on the wheel thread after 0.3s
+    handle.cancel()              # best-effort; no-op if already fired
+
+Callbacks run on the single wheel thread and must be short/non-blocking
+(they get the same contract as iomgr timer closures). Exceptions are
+swallowed with a traceback to stderr — one bad callback must not kill
+every timer in the process.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+import traceback
+from typing import Callable, Optional
+
+
+class TimerHandle:
+    __slots__ = ("cancelled",)
+
+    def __init__(self):
+        self.cancelled = False
+
+    def cancel(self) -> None:
+        self.cancelled = True
+
+
+class TimerWheel:
+    _instance: "Optional[TimerWheel]" = None
+    _instance_lock = threading.Lock()
+
+    @classmethod
+    def get(cls) -> "TimerWheel":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = TimerWheel()
+            return cls._instance
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._heap: list = []
+        self._seq = itertools.count()  # tie-break: heap never compares fns
+        self._thread: Optional[threading.Thread] = None
+
+    def schedule(self, delay_s: float, fn: Callable[[], None]) -> TimerHandle:
+        handle = TimerHandle()
+        when = time.monotonic() + max(0.0, delay_s)
+        with self._cond:
+            heapq.heappush(self._heap, (when, next(self._seq), handle, fn))
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(target=self._run, daemon=True,
+                                                name="tpurpc-timers")
+                self._thread.start()
+            self._cond.notify()
+        return handle
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while True:
+                    now = time.monotonic()
+                    if not self._heap:
+                        # park until new work (bounded: a dead wheel thread
+                        # is restarted by schedule(), but don't exit eagerly
+                        # and churn threads under bursty load)
+                        self._cond.wait(timeout=60.0)
+                        if not self._heap:
+                            return  # idle a full minute: let the thread go
+                        continue
+                    when = self._heap[0][0]
+                    if when <= now:
+                        _, _, handle, fn = heapq.heappop(self._heap)
+                        break
+                    self._cond.wait(timeout=when - now)
+            if handle.cancelled:
+                continue
+            try:
+                fn()
+            except Exception:
+                traceback.print_exc()
+
+
+def schedule(delay_s: float, fn: Callable[[], None]) -> TimerHandle:
+    """Module-level convenience over the singleton wheel."""
+    return TimerWheel.get().schedule(delay_s, fn)
