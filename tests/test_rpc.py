@@ -690,3 +690,41 @@ def test_inline_handler_deadline_without_body():
         time.sleep(0.02)
     assert not conn._streams
     srv.stop(grace=0)
+
+
+def test_keepalive_healthy_idle_survives_aggressive_knobs(monkeypatch):
+    """Both sides keepalive at 400ms/400ms: a healthy-but-quiet connection
+    must survive indefinitely (regression: stamp-after-send raced the
+    loopback PONG and read the PING as ignored, reaping healthy clients),
+    and a silent peer must still die within interval+timeout."""
+    monkeypatch.setenv("GRPC_ARG_KEEPALIVE_TIME_MS", "400")
+    monkeypatch.setenv("GRPC_ARG_KEEPALIVE_TIMEOUT_MS", "400")
+    from tpurpc.core.endpoint import passthru_endpoint_pair
+    from tpurpc.rpc.channel import Channel
+    from tpurpc.utils import config as config_mod
+
+    config_mod.set_config(None)  # re-read env
+    try:
+        srv = make_server()
+        srv.add_insecure_port("127.0.0.1:0")
+        srv.start()
+        ch = rpc.insecure_channel(f"127.0.0.1:{srv.bound_ports[0]}")
+        echo = ch.unary_unary("/t.Echo/Echo")
+        assert echo(b"a", timeout=10) == b"a"
+        conn = ch._subchannels[0]._conn
+        time.sleep(2.0)  # ~5 silence windows, PINGs ping-ponging both ways
+        assert conn.alive
+        assert conn.pong_count >= 1  # client really pinged and was answered
+        ch.close()
+        srv.stop(grace=0)
+
+        a, _b = passthru_endpoint_pair()  # nobody reads _b: silent peer
+        ch2 = Channel(endpoint_factory=lambda: a)
+        c2 = ch2._subchannels[0].get()
+        deadline = time.monotonic() + 5
+        while c2.alive and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert not c2.alive
+        ch2.close()
+    finally:
+        config_mod.set_config(None)

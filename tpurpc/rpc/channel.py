@@ -123,6 +123,7 @@ class _Connection:
         self._lock = threading.Lock()
         self._next_stream_id = 1  # odd ids, client-initiated (h2 convention)
         self._pong_waiters: List[threading.Event] = []
+        self.pong_count = 0  # keepalive verdict ticks compare against this
         self.alive = True
         self.draining = False        # GOAWAY received: no new streams
         self.last_activity = time.monotonic()
@@ -138,80 +139,116 @@ class _Connection:
         """Client keepalive (GRPC_ARG_KEEPALIVE_TIME_MS family, off by
         default like gRPC): PING on an idle cadence; a missed PONG within
         keepalive_timeout kills the connection so the channel's reconnect
-        machinery takes over instead of calls hanging on a dead peer."""
+        machinery takes over instead of calls hanging on a dead peer.
+
+        Runs on the shared timer wheel, event-style (the reference drives
+        keepalive from iomgr timers the same way): one tick sends the PING
+        and schedules a verdict tick that compares pong_count — no blocking
+        ping() on the wheel thread, and no dedicated thread per connection
+        (a thread per connection was 2x128 threads at the reference's
+        128-client scale)."""
         from tpurpc.utils.config import get_config
+        from tpurpc.utils.timers import schedule
 
         cfg = get_config()
         if cfg.keepalive_time_ms <= 0:
             return
         interval = cfg.keepalive_time_ms / 1000.0
         timeout = max(0.001, cfg.keepalive_timeout_ms / 1000.0)
-        # Interruptible sleep: _die() sets the event so a dead connection's
-        # keepalive thread (and its reference to this connection) unwinds
-        # immediately instead of parking in sleep() for up to a full
-        # interval (think GRPC_ARG_KEEPALIVE_TIME_MS=2h on a flaky link).
-        self._ka_stop = threading.Event()
 
-        def loop():
-            while self.alive:
-                if self._ka_stop.wait(interval):
-                    return
+        from tpurpc.utils.timers import run_blocking
+
+        def tick():
+            if not self.alive:
+                return
+            # Ping only a genuinely idle connection (gRPC pings after
+            # keepalive_time of *inactivity*; the server loop skips
+            # in-flight streams for the same reason): with streams open,
+            # the single reader thread can be parked in credit-acquire or
+            # a long message burst, leaving the PONG unread past the
+            # timeout — and the keepalive would then kill a healthy
+            # connection, failing every in-flight call UNAVAILABLE.
+            with self._lock:
+                busy = (bool(self._streams)
+                        or time.monotonic() - self.last_activity < interval)
+                before = self.pong_count
+            if busy:
+                self._ka_handle = schedule(interval, tick)
+                return
+            sent_at = time.monotonic()
+
+            def send_ping():  # endpoint write: never on the wheel thread
+                try:
+                    self.writer.send(fr.PING, 0, 0, b"tpurpc-keepalive")
+                except (EndpointError, OSError, fr.FrameError):
+                    self._die("keepalive ping send failed")
+
+            run_blocking(send_ping)
+
+            def check():
+                # Sliced verdict: answered → next PING an INTERVAL after
+                # this one (the configured cadence; waiting the full
+                # timeout first would stretch it to interval+timeout);
+                # unanswered past timeout → reap, off-wheel (teardown
+                # closes fds / fails streams).
                 if not self.alive:
                     return
-                # Ping only a genuinely idle connection (gRPC pings after
-                # keepalive_time of *inactivity*; the server loop skips
-                # in-flight streams for the same reason): with streams open,
-                # the single reader thread can be parked in credit-acquire or
-                # a long message burst, leaving the PONG unread past the
-                # timeout — and the keepalive would then kill a healthy
-                # connection, failing every in-flight call UNAVAILABLE.
+                elapsed = time.monotonic() - sent_at
                 with self._lock:
-                    busy = (bool(self._streams)
-                            or time.monotonic() - self.last_activity < interval)
-                if busy:
-                    continue
-                try:
-                    self.ping(timeout)
-                except (EndpointError, TimeoutError, OSError):
-                    self._die("keepalive ping timed out")
-                    return
+                    ponged = self.pong_count > before
+                if ponged:
+                    self._ka_handle = schedule(max(0.05, interval - elapsed),
+                                               tick)
+                elif elapsed >= timeout:
+                    run_blocking(
+                        lambda: self._die("keepalive ping timed out"))
+                else:
+                    self._ka_handle = schedule(
+                        min(1.0, max(0.05, timeout - elapsed)), check)
 
-        threading.Thread(target=loop, daemon=True,
-                         name="tpurpc-keepalive").start()
+            self._ka_handle = schedule(min(1.0, timeout), check)
+
+        self._ka_handle = schedule(interval, tick)
 
     def _start_idle_monitor(self) -> None:
         """client_idle filter analog (GRPC_ARG_CLIENT_IDLE_TIMEOUT_MS, off
         by default): a connection with no streams and no activity for the
         idle window is closed; the next call dials fresh. Frees server-side
-        per-connection state (pairs, rings) held by forgotten channels."""
+        per-connection state (pairs, rings) held by forgotten channels.
+        Wheel-scheduled checks — no per-connection thread."""
         from tpurpc.utils.config import get_config
+        from tpurpc.utils.timers import schedule
 
         cfg = get_config()
         if cfg.client_idle_timeout_ms <= 0:
             return
         window = cfg.client_idle_timeout_ms / 1000.0
-        self._idle_stop = threading.Event()
 
-        def loop():
-            while self.alive:
-                if self._idle_stop.wait(min(window, 1.0)):
-                    return
-                with self._lock:
-                    idle = (not self._streams
-                            and time.monotonic() - self.last_activity >= window)
-                    if idle:
-                        # Gate BEFORE releasing the lock: open_stream checks
-                        # draining under this same lock, so a call racing
-                        # the idle close gets "draining" (transparently
-                        # re-dialed) instead of a spurious UNAVAILABLE
-                        # after its HEADERS hit a dying connection.
-                        self.draining = True
+        def tick():
+            if not self.alive:
+                return
+            with self._lock:
+                remain = window - (time.monotonic() - self.last_activity)
+                busy = bool(self._streams)
+                idle = not busy and remain <= 0
                 if idle:
-                    self._die("client idle timeout")
-                    return
+                    # Gate BEFORE releasing the lock: open_stream checks
+                    # draining under this same lock, so a call racing
+                    # the idle close gets "draining" (transparently
+                    # re-dialed) instead of a spurious UNAVAILABLE
+                    # after its HEADERS hit a dying connection.
+                    self.draining = True
+                # streams in flight: re-check a full window from now;
+                # otherwise wake exactly when the idle window would lapse
+                delay = window if busy else max(0.05, remain)
+            if idle:
+                from tpurpc.utils.timers import run_blocking
 
-        threading.Thread(target=loop, daemon=True,
-                         name="tpurpc-client-idle").start()
+                run_blocking(lambda: self._die("client idle timeout"))
+                return
+            self._idle_handle = schedule(delay, tick)
+
+        self._idle_handle = schedule(window, tick)
 
     def open_stream(self) -> _ClientStream:
         with self._lock:
@@ -259,6 +296,7 @@ class _Connection:
             return
         if f.type == fr.PONG:
             with self._lock:
+                self.pong_count += 1
                 waiters, self._pong_waiters = self._pong_waiters, []
             for ev in waiters:
                 ev.set()
@@ -319,12 +357,10 @@ class _Connection:
             waiters, self._pong_waiters = self._pong_waiters, []
         for ev in waiters:
             ev.set()  # ping() observes !alive via the raced send/raise below
-        ka = getattr(self, "_ka_stop", None)
-        if ka is not None:
-            ka.set()  # release the keepalive thread immediately
-        idle = getattr(self, "_idle_stop", None)
-        if idle is not None:
-            idle.set()
+        for attr in ("_ka_handle", "_idle_handle"):
+            h = getattr(self, attr, None)
+            if h is not None:
+                h.cancel()  # wheel ticks also re-check alive themselves
         trace_channel.log("connection dead: %s", why)
         for st in streams:
             st.deliver_failure(StatusCode.UNAVAILABLE, f"transport failed: {why}")

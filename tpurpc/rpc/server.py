@@ -350,47 +350,60 @@ class _ServerConnection:
             return
         interval = cfg.keepalive_time_ms / 1000.0
         timeout = max(0.001, cfg.keepalive_timeout_ms / 1000.0)
-        self._ka_stop = threading.Event()
+        from tpurpc.utils.timers import schedule
 
-        def loop():
-            ping_sent_at = None  # monotonic ts of the outstanding PING
-            while self.alive:
-                if self._ka_stop.wait(min(interval, 1.0)):
-                    return
-                with self._lock:
-                    busy = bool(self._streams)
-                if busy:
-                    # In-flight streams: the reader may be deliberately
-                    # stalled on per-stream backpressure (stream_queue_depth)
-                    # with the client's PONGs sitting unread — reaping here
-                    # would kill live transfers. Peer death mid-stream is
-                    # caught by write errors / EOF; keepalive exists for the
-                    # IDLE-and-silent case (dead clients pinning pool state).
-                    ping_sent_at = None
-                    continue
-                if ping_sent_at is not None and self.last_frame > ping_sent_at:
-                    ping_sent_at = None  # the PING was answered (PONG/any
-                    # frame arrived after it): next silence window gets a
-                    # fresh PING instead of timing out on the old one
-                quiet = time.monotonic() - self.last_frame
-                if quiet < interval:
-                    ping_sent_at = None  # frames flowed; window restarts
-                    continue
-                if ping_sent_at is None:
+        from tpurpc.utils.timers import run_blocking
+
+        state = {"ping_sent_at": None}  # monotonic ts of outstanding PING
+
+        def tick():
+            # Wheel-scheduled (no thread per connection; iomgr-timer style).
+            if not self.alive:
+                return
+            with self._lock:
+                busy = bool(self._streams)
+            if busy:
+                # In-flight streams: the reader may be deliberately
+                # stalled on per-stream backpressure (stream_queue_depth)
+                # with the client's PONGs sitting unread — reaping here
+                # would kill live transfers. Peer death mid-stream is
+                # caught by write errors / EOF; keepalive exists for the
+                # IDLE-and-silent case (dead clients pinning pool state).
+                state["ping_sent_at"] = None
+                self._ka_handle = schedule(min(interval, 1.0), tick)
+                return
+            ping_sent_at = state["ping_sent_at"]
+            if ping_sent_at is not None and self.last_frame >= ping_sent_at:
+                ping_sent_at = state["ping_sent_at"] = None  # PING answered
+            quiet = time.monotonic() - self.last_frame
+            if quiet < interval:
+                state["ping_sent_at"] = None  # frames flowed; window restarts
+                self._ka_handle = schedule(min(interval - quiet, 1.0), tick)
+                return
+            if ping_sent_at is None:
+                # Stamp BEFORE the send: on one core the reader can process
+                # the loopback PONG before a stamp-after-send executes, and
+                # the answered-check would then read the PING as ignored —
+                # a healthy-but-quiet client reaped at the next tick.
+                state["ping_sent_at"] = time.monotonic()
+
+                def send_ping():  # endpoint write: never on the wheel
                     try:  # ONE ping per silence window (gRPC parity)
                         self.writer.send(fr.PING, 0, 0, b"srv-keepalive")
-                        ping_sent_at = time.monotonic()
                     except (EndpointError, OSError, fr.FrameError):
                         self._shutdown()
-                        return
-                elif time.monotonic() - ping_sent_at >= timeout:
-                    trace_server.log("keepalive: client silent %.1fs, closing",
-                                     quiet)
-                    self._shutdown()
-                    return
 
-        threading.Thread(target=loop, daemon=True,
-                         name="tpurpc-srv-keepalive").start()
+                run_blocking(send_ping)
+                self._ka_handle = schedule(min(timeout, 1.0), tick)
+                return
+            if time.monotonic() - ping_sent_at >= timeout:
+                trace_server.log("keepalive: client silent %.1fs, closing",
+                                 quiet)
+                run_blocking(self._shutdown)
+                return
+            self._ka_handle = schedule(min(timeout, 1.0), tick)
+
+        self._ka_handle = schedule(min(interval, 1.0), tick)
 
     def _start_age_timer(self) -> None:
         """max_age filter analog (GRPC_ARG_MAX_CONNECTION_AGE_MS, off by
@@ -414,10 +427,12 @@ class _ServerConnection:
             if empty:
                 self._linger_then_shutdown()
 
-        t = threading.Timer(age_ms / 1000.0, expire)
-        t.daemon = True
-        t.start()
-        self._age_timer = t
+        from tpurpc.utils.timers import run_blocking, schedule
+
+        # the GOAWAY is an endpoint write (can stall on a credit-wedged
+        # transport): run it off the wheel thread
+        self._age_timer = schedule(age_ms / 1000.0,
+                                   lambda: run_blocking(expire))
 
     #: After GOAWAY, wait this long before closing the socket: a HEADERS
     #: frame already in flight from a client that hasn't processed the
@@ -427,10 +442,10 @@ class _ServerConnection:
     _GOAWAY_LINGER_S = 1.0
 
     def _linger_then_shutdown(self) -> None:
-        t = threading.Timer(self._GOAWAY_LINGER_S, self._shutdown)
-        t.daemon = True
-        t.start()
-        self._linger_timer = t
+        from tpurpc.utils.timers import run_blocking, schedule
+
+        self._linger_timer = schedule(
+            self._GOAWAY_LINGER_S, lambda: run_blocking(self._shutdown))
 
     def _read_loop(self) -> None:
         try:
@@ -512,12 +527,13 @@ class _ServerConnection:
             st.inline_call = (handler, ctx, path)
             if deadline is not None:
                 # shared timer wheel, NOT threading.Timer: a thread spawn
-                # per call was measured as a 25% RPC-rate regression
-                from tpurpc.utils.timers import schedule
+                # per call was measured as a 25% RPC-rate regression. The
+                # expiry itself sends trailers (endpoint write) — off-wheel.
+                from tpurpc.utils.timers import run_blocking, schedule
 
                 st.inline_timer = schedule(
                     max(0.0, deadline - time.monotonic()),
-                    lambda: self._inline_deadline(st))
+                    lambda: run_blocking(lambda: self._inline_deadline(st)))
             return
         try:
             self.server._pool.submit(self._run_handler, handler, st, ctx, path)
@@ -665,15 +681,10 @@ class _ServerConnection:
             self.alive = False
             streams = list(self._streams.values())
             self._streams.clear()
-        timer = getattr(self, "_age_timer", None)
-        if timer is not None:
-            timer.cancel()  # else a dead connection is pinned until its age
-        ka = getattr(self, "_ka_stop", None)
-        if ka is not None:
-            ka.set()  # release the keepalive monitor immediately
-        linger = getattr(self, "_linger_timer", None)
-        if linger is not None:
-            linger.cancel()
+        for attr in ("_age_timer", "_ka_handle", "_linger_timer"):
+            h = getattr(self, attr, None)
+            if h is not None:
+                h.cancel()  # wheel handles; ticks also re-check alive
         for st in streams:
             st.cancel()
         try:

@@ -26,13 +26,20 @@ from typing import Callable, Optional
 
 
 class TimerHandle:
-    __slots__ = ("cancelled",)
+    """Cancellation is flag-based (the heap entry stays until its deadline)
+    but the callback reference is dropped EAGERLY: a cancelled 2-hour
+    keepalive timer must not pin its connection closure (endpoint, buffers)
+    for 2 hours."""
 
-    def __init__(self):
+    __slots__ = ("cancelled", "fn")
+
+    def __init__(self, fn: Callable[[], None]):
         self.cancelled = False
+        self.fn: Optional[Callable[[], None]] = fn
 
     def cancel(self) -> None:
         self.cancelled = True
+        self.fn = None  # release the closure (and everything it captures)
 
 
 class TimerWheel:
@@ -53,10 +60,10 @@ class TimerWheel:
         self._thread: Optional[threading.Thread] = None
 
     def schedule(self, delay_s: float, fn: Callable[[], None]) -> TimerHandle:
-        handle = TimerHandle()
+        handle = TimerHandle(fn)
         when = time.monotonic() + max(0.0, delay_s)
         with self._cond:
-            heapq.heappush(self._heap, (when, next(self._seq), handle, fn))
+            heapq.heappush(self._heap, (when, next(self._seq), handle))
             if self._thread is None or not self._thread.is_alive():
                 self._thread = threading.Thread(target=self._run, daemon=True,
                                                 name="tpurpc-timers")
@@ -79,10 +86,11 @@ class TimerWheel:
                         continue
                     when = self._heap[0][0]
                     if when <= now:
-                        _, _, handle, fn = heapq.heappop(self._heap)
+                        _, _, handle = heapq.heappop(self._heap)
                         break
                     self._cond.wait(timeout=when - now)
-            if handle.cancelled:
+            fn = handle.fn
+            if handle.cancelled or fn is None:
                 continue
             try:
                 fn()
@@ -93,3 +101,27 @@ class TimerWheel:
 def schedule(delay_s: float, fn: Callable[[], None]) -> TimerHandle:
     """Module-level convenience over the singleton wheel."""
     return TimerWheel.get().schedule(delay_s, fn)
+
+
+_blocking_pool = None
+_blocking_lock = threading.Lock()
+
+
+def run_blocking(fn: Callable[[], None]) -> None:
+    """Run ``fn`` off the wheel thread (small shared daemon pool).
+
+    Wheel callbacks must not block — but timer-driven WORK often does
+    (keepalive PINGs and GOAWAYs are endpoint writes that can stall on
+    transport backpressure; teardown closes fds). One blocked send on the
+    wheel would freeze every timer in the process; here it occupies one of
+    a few shared workers instead (still bounded, still not per-connection
+    threads)."""
+    global _blocking_pool
+    with _blocking_lock:
+        if _blocking_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            _blocking_pool = ThreadPoolExecutor(
+                max_workers=4, thread_name_prefix="tpurpc-timerio")
+        pool = _blocking_pool
+    pool.submit(fn)
