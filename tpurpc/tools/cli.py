@@ -48,6 +48,54 @@ def cmd_list(args) -> int:
     return 0
 
 
+def cmd_describe(args) -> int:
+    """Resolve a symbol via reflection and print its descriptor (grpcurl
+    describe). Uses the real protobuf runtime for parsing — a tools-only
+    dependency; the services themselves stay protobuf-free."""
+    from google.protobuf import descriptor_pb2
+
+    from tpurpc.rpc.reflection import V1ALPHA_SERVICE
+    from tpurpc.wire.protowire import fields, ld
+
+    with _channel(args.target) as ch:
+        mc = ch.stream_stream(f"/{V1ALPHA_SERVICE}/ServerReflectionInfo")
+        reply = next(iter(mc(iter([ld(4, args.symbol.encode())]),
+                             timeout=args.timeout)))
+    fdp_blobs = []
+    err = None
+    for f, _w, v in fields(bytes(reply)):
+        if f == 4:  # file_descriptor_response
+            for f2, _w2, v2 in fields(bytes(v)):
+                if f2 == 1:
+                    fdp_blobs.append(bytes(v2))
+        elif f == 7:  # error_response
+            msg = b""
+            for f2, _w2, v2 in fields(bytes(v)):
+                if f2 == 2:
+                    msg = bytes(v2)
+            err = msg.decode("utf-8", "replace")
+    if err is not None:
+        print(f"error: {err}", file=sys.stderr)
+        return 5  # NOT_FOUND
+    for raw in fdp_blobs:
+        fdp = descriptor_pb2.FileDescriptorProto.FromString(raw)
+        print(f"file: {fdp.name}  package: {fdp.package}")
+        for svc in fdp.service:
+            print(f"service {fdp.package + '.' if fdp.package else ''}"
+                  f"{svc.name} {{")
+            for m in svc.method:
+                cs = "stream " if m.client_streaming else ""
+                ss = "stream " if m.server_streaming else ""
+                print(f"  rpc {m.name}({cs}{m.input_type}) returns "
+                      f"({ss}{m.output_type});")
+            print("}")
+        for msg in fdp.message_type:
+            fields_s = ", ".join(f"{fld.name}={fld.number}"
+                                 for fld in msg.field)
+            print(f"message {msg.name} {{ {fields_s} }}")
+    return 0
+
+
 def cmd_health(args) -> int:
     from tpurpc.rpc import health
 
@@ -114,6 +162,10 @@ def main(argv=None) -> int:
     p = sub.add_parser("list", help="reflection: list services")
     p.add_argument("target")
     p.set_defaults(fn=cmd_list)
+    p = sub.add_parser("describe", help="reflection: describe a symbol")
+    p.add_argument("target")
+    p.add_argument("symbol")
+    p.set_defaults(fn=cmd_describe)
     p = sub.add_parser("health", help="grpc.health.v1 check")
     p.add_argument("target")
     p.add_argument("service", nargs="?", default="")
