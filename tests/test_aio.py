@@ -263,3 +263,36 @@ def test_aio_retry_policy_applies():
             await srv.stop(grace=0)
 
     asyncio.run(main())
+
+
+def test_aio_native_channel():
+    """The async face of the ctypes fast path."""
+    import os
+
+    import pytest as _pytest
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if not os.path.exists(os.path.join(root, "native", "build",
+                                       "libtpurpc.so")):
+        _pytest.skip("native lib not built")
+    import asyncio
+
+    import tpurpc.rpc as rpc
+    from tpurpc.rpc import aio
+
+    srv = rpc.Server(max_workers=4)
+    srv.add_method("/a.S/Echo", rpc.unary_unary_rpc_method_handler(
+        lambda r, c: bytes(r) + b"?", inline=True))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+
+    async def main():
+        async with aio.NativeChannel("127.0.0.1", port) as ch:
+            echo = ch.unary_unary("/a.S/Echo")
+            outs = await asyncio.gather(*[echo(b"m%d" % i, timeout=10)
+                                          for i in range(8)])
+            assert outs == [b"m%d?" % i for i in range(8)]
+            assert await ch.ping() < 5
+
+    asyncio.run(main())
+    srv.stop(grace=0)

@@ -482,3 +482,42 @@ def insecure_channel(target: str, **kw) -> Channel:
 
 def secure_channel(target: str, credentials, **kw) -> Channel:
     return Channel(target, credentials=credentials, **kw)
+
+
+class NativeChannel:
+    """grpc.aio-shaped wrapper over :class:`tpurpc.rpc.native_client.
+    NativeChannel`: awaitable unary calls whose blocking halves run inside
+    libtpurpc.so on executor threads (the async face of the ctypes fast
+    path; GRPC_PLATFORM_TYPE is honored inside the .so)."""
+
+    def __init__(self, host: str, port: int, connect_timeout: float = 10.0):
+        from tpurpc.rpc.native_client import NativeChannel as _Sync
+
+        self._sync = _Sync(host, port, connect_timeout)
+
+    async def __aenter__(self):
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.close()
+
+    async def close(self) -> None:
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None, self._sync.close)
+
+    async def ping(self, timeout: float = 5.0) -> float:
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            None, lambda: self._sync.ping(timeout))
+
+    def unary_unary(self, method: str, request_serializer=_identity,
+                    response_deserializer=_identity):
+        mc = self._sync.unary_unary(method, request_serializer,
+                                    response_deserializer)
+
+        async def call(request, timeout=None):
+            loop = asyncio.get_running_loop()
+            return await loop.run_in_executor(
+                None, lambda: mc(request, timeout=timeout))
+
+        return call
