@@ -696,9 +696,24 @@ class Pair:
         """Peer's end of the notify socket closed. Graceful close writes
         peer_exit BEFORE closing (``Disconnect`` pair.cc:325-347), so fold the
         status words first; only an unexplained closure is an ERROR (the
-        crash-detection analog of the zero-byte TCP probe, rdma_conn.h:90-99)."""
+        crash-detection analog of the zero-byte TCP probe, rdma_conn.h:90-99).
+
+        ASYNC domains (tcp_window) add a wrinkle: the exit word travels the
+        record stream while the EOF travels the notify socket — the EOF can
+        win the race even on a graceful close. Give the exit word a short
+        grace window before declaring the peer crashed (the record stream
+        delivers in milliseconds when the peer is alive enough to have
+        closed gracefully; a genuinely crashed peer never sets it and we
+        error after the window exactly as before)."""
         if self.state is PairState.CONNECTED:
             self.process_credits()  # may observe peer_exit -> HALF_CLOSED
+        if self.state is PairState.CONNECTED and self.domain.kind not in (
+                "local", "shm"):
+            deadline = time.monotonic() + 2.0
+            while (self.state is PairState.CONNECTED
+                   and time.monotonic() < deadline):
+                time.sleep(0.005)
+                self.process_credits()
         if self.state is PairState.CONNECTED:
             self._mark_error("peer vanished (notify socket closed)")
 
