@@ -216,3 +216,26 @@ def test_ring_platform_port_serves_stock_grpcio_tls(monkeypatch, platform,
             assert mc(b"h2-on-ring-port", timeout=20) == b"h2-on-ring-port"
     finally:
         srv.stop(grace=0)
+
+
+def test_tls_e2e_over_tcp_window_domain(monkeypatch, certs):
+    """TLS + the cross-host ring domain: bootstrap/notify ride the
+    encrypted socket; the one-sided record stream is a separate plaintext
+    connection (documented boundary, core/tcpw.py docstring — the
+    reference's RDMA payloads bypass TLS on the NIC the same way)."""
+    monkeypatch.setenv("GRPC_PLATFORM_TYPE", "RDMA_BPEV")
+    monkeypatch.setenv("TPURPC_RING_DOMAIN", "tcp_window")
+    monkeypatch.setenv("TPURPC_RING_BUFFER_SIZE_KB", "256")
+    from tpurpc.utils import config as config_mod
+
+    config_mod.set_config(None)
+    srv, port = _tls_server(certs)
+    try:
+        creds = tps.ssl_channel_credentials(root_certificates=certs["ca"])
+        with tps.secure_channel(f"localhost:{port}", creds) as ch:
+            mc = ch.unary_unary("/t.S/Echo")
+            assert bytes(mc(b"secure-tcpw", timeout=20)) == b"secure-tcpw"
+            big = bytes(range(256)) * 4096  # 1 MiB: wraps + credits
+            assert bytes(mc(big, timeout=60)) == big
+    finally:
+        srv.stop(grace=0)
