@@ -878,6 +878,14 @@ class Pair:
         if self.state is not PairState.CONNECTED:
             raise BrokenPipeError(f"pair {self.tag} not sendable: {self.state}"
                                   + (f" ({self.error})" if self.error else ""))
+        from tpurpc.utils import stats as _stats
+
+        if _stats.profiling_on():
+            with _stats.profile("pair_send"):
+                return self._send_profiled(slices, byte_idx)
+        return self._send_profiled(slices, byte_idx)
+
+    def _send_profiled(self, slices: Sequence, byte_idx: int = 0) -> int:
         cfg = get_config()
         with self._send_guard:
             views: List[memoryview] = []

@@ -949,6 +949,16 @@ class UnaryUnary(_MultiCallable):
 
     def with_call(self, request, timeout: Optional[float] = None,
                   metadata: Optional[Metadata] = None, **grpcio_kw):
+        from tpurpc.utils import stats as _stats
+
+        if _stats.profiling_on():  # GRPCProfiler span: whole unary call
+            with _stats.profile("cli_unary"):
+                return self._with_call_impl(request, timeout, metadata,
+                                            **grpcio_kw)
+        return self._with_call_impl(request, timeout, metadata, **grpcio_kw)
+
+    def _with_call_impl(self, request, timeout: Optional[float] = None,
+                        metadata: Optional[Metadata] = None, **grpcio_kw):
         _reject_call_credentials(grpcio_kw)
         policy = self._channel.retry_policy
         deadline = None if timeout is None else time.monotonic() + timeout

@@ -566,11 +566,17 @@ class _ServerConnection:
 
     def _run_handler(self, handler: RpcMethodHandler, st: _ServerStream,
                      ctx: ServerContext, path: str) -> None:
+        from tpurpc.utils import stats as _stats
+
         counters = self.server.call_counters
         counters.on_start()
         ok = False
         try:
-            ok = self._run_handler_inner(handler, st, ctx, path)
+            if _stats.profiling_on():  # GRPCProfiler span: handler execution
+                with _stats.profile("srv_handler"):
+                    ok = self._run_handler_inner(handler, st, ctx, path)
+            else:
+                ok = self._run_handler_inner(handler, st, ctx, path)
         finally:
             counters.on_finish(ok)
 
