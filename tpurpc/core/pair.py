@@ -648,7 +648,17 @@ class Pair:
         if sock is None:
             return
         try:
-            sock.send(token)
+            if hasattr(sock, "pending"):
+                # TLS: OpenSSL forbids concurrent use of one SSL* — an
+                # unlocked send racing drain_notifications' recv corrupts
+                # the record stream (the TcpEndpoint fix, same UB; observed
+                # as 'notify channel read failed' on BOTH peers under load
+                # once tcp_window's unconditional tokens raised the race
+                # frequency). Plain sockets need no lock.
+                with self._notify_lock:
+                    sock.send(token)
+            else:
+                sock.send(token)
         except (ssl.SSLWantWriteError, ssl.SSLWantReadError):
             pass  # TLS record stalled mid-flight; same as a saturated channel
         except (BlockingIOError, InterruptedError):
@@ -669,10 +679,18 @@ class Pair:
         sock = self.notify_sock
         if sock is None:
             return b""
+        is_tls = hasattr(sock, "pending")
         out = b""
         while True:
             try:
-                chunk = sock.recv(65536)
+                if is_tls:
+                    # serialize with _notify's sends (see there: concurrent
+                    # SSL_read/SSL_write on one SSL* is UB). recv is
+                    # non-blocking — the lock hold is microseconds.
+                    with self._notify_lock:
+                        chunk = sock.recv(65536)
+                else:
+                    chunk = sock.recv(65536)
             except (BlockingIOError, InterruptedError,
                     ssl.SSLWantReadError, ssl.SSLWantWriteError):
                 break  # nothing decryptable yet ≡ EAGAIN on a plain socket
@@ -731,9 +749,11 @@ class Pair:
             # meaningless on a record stream. A non-consuming HINT suffices
             # for the poller's purpose: decrypted bytes pending, or raw
             # ciphertext readable on the fd (a spurious True just makes the
-            # owner drain and find nothing).
-            if sock.pending():
-                return True
+            # owner drain and find nothing). pending() reads SSL state —
+            # serialized with sends/recvs like every other SSL op.
+            with self._notify_lock:
+                if sock.pending():
+                    return True
             import select
 
             try:
