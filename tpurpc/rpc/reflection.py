@@ -77,6 +77,25 @@ class _Request:
                 self.arg = bytes(val)
 
 
+#: process-global descriptor registry: generated ``*_tpurpc.py`` modules
+#: register their pb2 files at import, so every reflection servicer created
+#: afterwards can answer describe/file_containing_symbol with no manual
+#: wiring (grpcio gets this from the protobuf descriptor pool; this is the
+#: explicit tpurpc equivalent)
+_GLOBAL_FILES: list = []
+_GLOBAL_LOCK = threading.Lock()
+
+
+def register_module_descriptors(serialized) -> None:
+    """Called by generated modules: add serialized FileDescriptorProtos to
+    the process-global registry (idempotent by content)."""
+    with _GLOBAL_LOCK:
+        for raw in serialized:
+            raw = bytes(raw)
+            if raw not in _GLOBAL_FILES:
+                _GLOBAL_FILES.append(raw)
+
+
 class ServerReflection:
     """The servicer. Attach with :func:`enable_server_reflection`."""
 
@@ -89,6 +108,10 @@ class ServerReflection:
         self._files: Dict[str, bytes] = {}
         #: symbol (pkg.Msg / pkg.Svc / pkg.Svc.Method) -> filename
         self._symbols: Dict[str, str] = {}
+        with _GLOBAL_LOCK:
+            seed = list(_GLOBAL_FILES)
+        if seed:
+            self.add_file_descriptor_protos(seed)
 
     # -- descriptor registry -------------------------------------------------
 
