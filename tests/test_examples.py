@@ -53,3 +53,11 @@ def test_sharded_inference_example():
                          capture_output=True, text=True, timeout=400)
     assert out.returncode == 0, out.stderr
     assert "row-exact logits" in out.stdout
+
+
+def test_lookaside_demo_example():
+    """Blue/green traffic shifting through the look-aside balancer."""
+    out = subprocess.run([sys.executable, "examples/lookaside_demo.py"],
+                         capture_output=True, text=True, timeout=200)
+    assert out.returncode == 0, out.stderr
+    assert "live blue->green shift" in out.stdout
