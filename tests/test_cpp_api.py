@@ -502,3 +502,45 @@ def test_cpp_ring_micro_smoke(tmp_path):
     assert out.returncode == 0, out.stderr
     rec = _json.loads(out.stdout.strip().splitlines()[-1])
     assert rec["rpcs"] > 100
+
+
+def test_native_ring_beats_tcp_small_rpc(tmp_path):
+    """The repo's central perf claim, CI-enforced on the NATIVE loop (it
+    holds even single-core: data crosses shm, only 1-byte notify tokens
+    cross the kernel — bench/results/micro_native_1core.log measured
+    87K vs 53K RPC/s). Asserted with margin: ring must not LOSE to TCP."""
+    gxx = shutil.which("g++")
+    if gxx is None:
+        pytest.skip("no g++ toolchain")
+    binp = tmp_path / "micro_rvt"
+    subprocess.run(
+        [gxx, "-std=c++17", "-O2",
+         os.path.join(ROOT, "native", "bench", "micro_native.cc"),
+         os.path.join(ROOT, "native", "src", "tpurpc_client.cc"),
+         os.path.join(ROOT, "native", "src", "tpurpc_server.cc"),
+         os.path.join(ROOT, "native", "src", "ring.cc"),
+         "-I", os.path.join(ROOT, "native", "include"),
+         "-lpthread", "-o", str(binp)],
+        check=True, timeout=300, capture_output=True)
+    import json as _json
+
+    def rate(env_extra):
+        env = dict(os.environ, **env_extra)
+        best = 0.0
+        for _ in range(2):  # best of 2 absorbs scheduler noise
+            out = subprocess.run([str(binp), "64", "2", "1", "1"],
+                                 capture_output=True, text=True, timeout=60,
+                                 env=env)
+            assert out.returncode == 0, out.stderr
+            rec = _json.loads(out.stdout.strip().splitlines()[-1])
+            best = max(best, rec["rate_rps"])
+        return best
+
+    import sys as _sys
+
+    tcp = rate({"GRPC_PLATFORM_TYPE": "TCP"})
+    ring = rate({"GRPC_PLATFORM_TYPE": "RDMA_BP",
+                 "GRPC_RDMA_RING_BUFFER_SIZE_KB": "1024"})
+    _sys.stderr.write(f"ring={ring:.0f} tcp={tcp:.0f} RPC/s\n")
+    assert ring > tcp * 0.9  # ring must at least match TCP (wins by ~1.6x
+    # unloaded; 0.9 margin absorbs CI noise without masking a regression)
