@@ -111,15 +111,28 @@ def test_pagination(served):
     assert seen >= 1
 
 
-def test_get_server_sockets_empty_page(served):
+def test_get_server_sockets_and_get_socket(served):
     srv, port = served
-    with grpc.insecure_channel(f"127.0.0.1:{port}") as ch:
-        mc = ch.unary_unary(f"/{SERVICE}/GetServerSockets", _ID, _ID)
-        resp = mc(vf(1, srv._channelz_id))
-        assert _field(resp, 2) == 1  # end, no sockets
-        with pytest.raises(grpc.RpcError) as ei:
-            mc(vf(1, 999999))
-        assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+    with rpc.insecure_channel(f"127.0.0.1:{port}") as tch:
+        tch.unary_unary("/z.S/Echo")(b"s", timeout=10)  # a live native conn
+        with grpc.insecure_channel(f"127.0.0.1:{port}") as ch:
+            mc = ch.unary_unary(f"/{SERVICE}/GetServerSockets", _ID, _ID)
+            resp = mc(vf(1, srv._channelz_id))
+            assert _field(resp, 2) == 1  # end
+            refs = _submsgs(resp, 1)
+            assert refs, "no live connection sockets listed"
+            sid = _field(refs[0], 1)
+            gs = ch.unary_unary(f"/{SERVICE}/GetSocket", _ID, _ID)
+            sock = _field(gs(vf(1, sid)), 1)
+            data = _field(sock, 2)
+            assert _field(data, 1, 0) >= 1  # streams_started
+            assert _field(sock, 4) is not None  # remote TcpIpAddress
+            with pytest.raises(grpc.RpcError) as ei:
+                mc(vf(1, 999999))
+            assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+            with pytest.raises(grpc.RpcError) as ei:
+                gs(vf(1, 999999))
+            assert ei.value.code() == grpc.StatusCode.NOT_FOUND
 
 
 def test_deadline_expired_call_counts_as_failed(served):

@@ -8,8 +8,9 @@ debugging tools actually walk:
                                       listen SocketRefs)
     GetTopChannels / GetChannel      (ChannelRef + ChannelData: state,
                                       target, call counters)
-    GetServerSockets                 (empty page: per-socket accounting is
-                                      out of scope; ``end=true``)
+    GetServerSockets / GetSocket     (live connections: SocketRef per
+                                      connection; SocketData streams_started
+                                      + local/remote TcpIpAddress)
 
 Pagination follows the proto contract: requests carry ``start_*_id`` and
 ``max_results``; responses list id-ordered entities and set ``end`` when
@@ -148,11 +149,59 @@ def _get_channel(raw, _ctx) -> bytes:
     raise AbortError(StatusCode.NOT_FOUND, f"no channel with id {want}")
 
 
+def _conn_name(conn) -> str:
+    ep = getattr(conn, "endpoint", None)
+    peer = getattr(ep, "peer", "?")
+    local = getattr(ep, "local_address", "?")
+    return f"{peer} -> {local}"
+
+
+def _tcpip_address(addr_str: str) -> bytes:
+    """'ipv4:1.2.3.4:56' → Address{tcpip_address{ip_address, port}}."""
+    import socket as _socket
+
+    try:
+        body = addr_str.split(":", 1)[1] if ":" in addr_str else addr_str
+        host, _, port_s = body.rpartition(":")
+        packed = _socket.inet_aton(host)
+        return ld(1, ld(1, packed) + vf(2, int(port_s)))
+    except (OSError, ValueError, IndexError):
+        return b""
+
+
+def _socket_msg(sid: int, conn) -> bytes:
+    ref = vf(1, sid) + ld(2, _conn_name(conn).encode())
+    data = vf(1, getattr(conn, "streams_started", 0))
+    ep = getattr(conn, "endpoint", None)
+    out = ld(1, ref) + ld(2, data)
+    local = _tcpip_address(getattr(ep, "local_address", "") or "")
+    remote = _tcpip_address(getattr(ep, "peer", "") or "")
+    if local:
+        out += ld(3, local)
+    if remote:
+        out += ld(4, remote)
+    return out
+
+
 def _get_server_sockets(raw, _ctx) -> bytes:
     want = _id_param(raw)
-    if not any(i == want for i, _s in _cz.live_servers()):
-        raise AbortError(StatusCode.NOT_FOUND, f"no server with id {want}")
-    return vf(2, 1)  # end = true, no per-socket accounting
+    for i, s in _cz.live_servers():
+        if i == want:
+            out = b""
+            for conn in list(getattr(s, "_connections", [])):
+                sid = _cz.socket_id_for(conn, 0)
+                out += ld(1, vf(1, sid) + ld(2, _conn_name(conn).encode()))
+            return out + vf(2, 1)  # end = true
+    raise AbortError(StatusCode.NOT_FOUND, f"no server with id {want}")
+
+
+def _get_socket(raw, _ctx) -> bytes:
+    want = _id_param(raw)
+    for _i, s in _cz.live_servers():
+        for conn in list(getattr(s, "_connections", [])):
+            if _cz.socket_id_for(conn, 0) == want:
+                return ld(1, _socket_msg(want, conn))
+    raise AbortError(StatusCode.NOT_FOUND, f"no socket with id {want}")
 
 
 def enable_channelz(server: Server) -> None:
@@ -161,6 +210,7 @@ def enable_channelz(server: Server) -> None:
                      ("GetTopChannels", _get_top_channels),
                      ("GetServer", _get_server),
                      ("GetChannel", _get_channel),
-                     ("GetServerSockets", _get_server_sockets)):
+                     ("GetServerSockets", _get_server_sockets),
+                     ("GetSocket", _get_socket)):
         server.add_method(f"/{SERVICE}/{name}",
                           unary_unary_rpc_method_handler(fn))

@@ -333,6 +333,7 @@ class _ServerConnection:
         self._lock = threading.Lock()
         self.alive = True
         self.draining = False  # GOAWAY sent; no new streams accepted
+        self.streams_started = 0  # channelz SocketData counter
         self.last_frame = time.monotonic()  # any inbound frame refreshes
         self._thread = threading.Thread(target=self._read_loop, daemon=True,
                                         name="tpurpc-srv-reader")
@@ -501,6 +502,7 @@ class _ServerConnection:
             else:
                 rejected = False
                 self._streams[f.stream_id] = st
+                self.streams_started += 1
         if rejected:
             self.writer.send(fr.RST, 0, f.stream_id,
                              fr.rst_payload(StatusCode.UNAVAILABLE,
