@@ -88,20 +88,26 @@ def live_channels():
         return sorted(((c._channelz_id, c) for c in _channels))
 
 
-_socket_ids: Dict = {}
-
-
-def socket_id_for(srv, port: int) -> int:
-    """Stable channelz id for a server's listen socket, drawn from the same
-    entity-id space as servers/channels (global uniqueness contract)."""
+def socket_id_for(obj, port: int) -> int:
+    """Stable channelz id for a socket-like entity (a server's listen port
+    or a live connection), drawn from the same entity-id space as
+    servers/channels (global uniqueness contract). The id is stored ON the
+    object — it dies with it (a registry keyed by ``id(obj)`` would grow
+    forever and alias recycled ids)."""
     global _next_id
-    key = (id(srv), port)
-    with _lock:
-        sid = _socket_ids.get(key)
-        if sid is None:
-            _next_id += 1
-            sid = _socket_ids[key] = _next_id
-        return sid
+    attr = f"_channelz_sock_{port}"
+    sid = getattr(obj, attr, None)
+    if sid is None:
+        with _lock:
+            sid = getattr(obj, attr, None)  # double-check under the lock
+            if sid is None:
+                _next_id += 1
+                sid = _next_id
+                try:
+                    setattr(obj, attr, sid)
+                except AttributeError:
+                    pass  # __slots__ object: fall back to a fresh id per call
+    return sid
 
 
 def server_info(srv) -> Dict:
