@@ -53,8 +53,8 @@ def main() -> int:
         deadline = time.monotonic() + timeout_s
         while sum(votes.values()) < n and time.monotonic() < deadline:
             try:
-                votes[bytes(which(b"", timeout=5)).decode()] = (
-                    votes.get(bytes(which(b"", timeout=5)).decode(), 0) + 1)
+                who = bytes(which(b"", timeout=5)).decode()
+                votes[who] = votes.get(who, 0) + 1
             except rpc.RpcError:
                 time.sleep(0.05)  # racing a swap: retry
         return votes

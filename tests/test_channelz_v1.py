@@ -153,3 +153,20 @@ def test_deadline_expired_call_counts_as_failed(served):
         while c.failed < 1 and _t.monotonic() < deadline:
             _t.sleep(0.02)
         assert c.started == 1 and c.failed == 1  # reconciled
+
+
+def test_get_socket_resolves_listen_socket_ids(served):
+    """The listen SocketRef ids GetServer advertises must resolve via
+    GetSocket (review finding: they 404'd)."""
+    srv, port = served
+    with grpc.insecure_channel(f"127.0.0.1:{port}") as ch:
+        resp = ch.unary_unary(f"/{SERVICE}/GetServer", _ID, _ID)(
+            vf(1, srv._channelz_id))
+        server_msg = _field(resp, 1)
+        listen_refs = _submsgs(server_msg, 3)
+        assert listen_refs
+        sid = _field(listen_refs[0], 1)
+        sock = _field(ch.unary_unary(f"/{SERVICE}/GetSocket", _ID, _ID)(
+            vf(1, sid)), 1)
+        ref = _field(sock, 1)
+        assert _field(ref, 2) == f"listen:{port}".encode()

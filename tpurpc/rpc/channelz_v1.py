@@ -184,15 +184,37 @@ def _socket_msg(sid: int, conn) -> bytes:
 
 
 def _get_server_sockets(raw, _ctx) -> bytes:
-    want = _id_param(raw)
+    # GetServerSocketsRequest: server_id=1, start_socket_id=2, max_results=3
+    want, start, limit = 0, 0, _MAX_PAGE
+    try:
+        for f, _w, v in fields(bytes(raw)):
+            if f == 1:
+                want = int(v)
+            elif f == 2:
+                start = int(v)
+            elif f == 3:
+                limit = max(1, min(int(v), _MAX_PAGE))
+    except ValueError:
+        raise AbortError(StatusCode.INVALID_ARGUMENT,
+                         "malformed channelz request") from None
     for i, s in _cz.live_servers():
         if i == want:
-            out = b""
-            for conn in list(getattr(s, "_connections", [])):
-                sid = _cz.socket_id_for(conn, 0)
-                out += ld(1, vf(1, sid) + ld(2, _conn_name(conn).encode()))
-            return out + vf(2, 1)  # end = true
+            rows = sorted(
+                (_cz.socket_id_for(conn, 0), conn)
+                for conn in list(getattr(s, "_connections", [])))
+            rows = [(sid, c) for sid, c in rows if sid >= start]
+            out = b"".join(
+                ld(1, vf(1, sid) + ld(2, _conn_name(c).encode()))
+                for sid, c in rows[:limit])
+            if len(rows) <= limit:
+                out += vf(2, 1)  # end = true
+            return out
     raise AbortError(StatusCode.NOT_FOUND, f"no server with id {want}")
+
+
+def _listen_socket_msg(sid: int, srv, port: int) -> bytes:
+    ref = vf(1, sid) + ld(2, f"listen:{port}".encode())
+    return ld(1, ref) + ld(2, b"")  # a listen socket carries no stream data
 
 
 def _get_socket(raw, _ctx) -> bytes:
@@ -201,6 +223,10 @@ def _get_socket(raw, _ctx) -> bytes:
         for conn in list(getattr(s, "_connections", [])):
             if _cz.socket_id_for(conn, 0) == want:
                 return ld(1, _socket_msg(want, conn))
+        # listen sockets: the ids GetServer advertises must resolve too
+        for port in getattr(s, "bound_ports", []):
+            if _cz.socket_id_for(s, port) == want:
+                return ld(1, _listen_socket_msg(want, s, port))
     raise AbortError(StatusCode.NOT_FOUND, f"no socket with id {want}")
 
 
