@@ -1,0 +1,66 @@
+#!/bin/bash
+# Tunnel harvester: loop until the axon TPU tunnel gives us a full artifact
+# set, then stop. Each attempt is its own subprocess bounded by `timeout`,
+# because a black-holing tunnel hangs any jax call uninterruptibly
+# (VERDICT_RESPONSE.md item 1). Probe cheap first; only burn a chipcheck /
+# bench budget when the probe proves the data path is actually moving.
+#
+# Artifacts on success:
+#   bench/results/chipcheck.json      (kernels on-chip, link ceiling, aliasing)
+#   bench/results/bench_tpu.json      (streaming GB/s + serving QPS/MFU on chip)
+# State/log: bench/results/harvest.log
+set -u
+cd "$(dirname "$0")/.."
+LOG=bench/results/harvest.log
+echo "=== harvest loop start $(date -u +%FT%TZ) pid $$ ===" >> "$LOG"
+
+probe() {
+  # Returns 0 iff a SMALL h2d+compute+d2h round trip completes fast.
+  timeout 150 python - <<'EOF' >/dev/null 2>&1
+import numpy as np
+import jax
+d = jax.devices()[0]
+assert d.platform != "cpu"
+x = jax.device_put(np.ones(1024, np.float32), d)
+y = (x + 1).block_until_ready()
+assert float(np.asarray(y)[0]) == 2.0
+EOF
+}
+
+attempt=0
+while true; do
+  attempt=$((attempt + 1))
+  ts=$(date -u +%FT%TZ)
+  if probe; then
+    echo "[$ts] attempt $attempt: probe ALIVE — harvesting" >> "$LOG"
+    if [ ! -s bench/results/chipcheck.json ] || ! grep -q '"ok": true' bench/results/chipcheck.json 2>/dev/null; then
+      CHIPCHECK_BUDGET_S=1500 timeout 1600 python bench/chipcheck.py \
+        > bench/results/chipcheck.stdout 2> bench/results/chipcheck.stderr
+      rc=$?
+      echo "[$(date -u +%FT%TZ)] chipcheck rc=$rc" >> "$LOG"
+    fi
+    if [ ! -s bench/results/bench_tpu.json ]; then
+      TPURPC_BENCH_READY_S=600 timeout 1800 python bench.py \
+        > bench/results/bench_tpu.stdout 2> bench/results/bench_tpu.stderr
+      rc=$?
+      echo "[$(date -u +%FT%TZ)] bench.py rc=$rc" >> "$LOG"
+      # Only keep it as the TPU artifact if it really ran on the chip.
+      if [ $rc -eq 0 ] && grep -q '"jax_platform": "tpu"' bench/results/bench_tpu.stdout; then
+        tail -1 bench/results/bench_tpu.stdout > bench/results/bench_tpu.json
+      elif [ $rc -eq 0 ]; then
+        echo "[$(date -u +%FT%TZ)] bench.py fell back (not tpu); not keeping" >> "$LOG"
+      fi
+    fi
+    ck_ok=false; bj_ok=false
+    grep -q '"ok": true' bench/results/chipcheck.json 2>/dev/null && ck_ok=true
+    [ -s bench/results/bench_tpu.json ] && bj_ok=true
+    echo "[$(date -u +%FT%TZ)] state: chipcheck=$ck_ok bench_tpu=$bj_ok" >> "$LOG"
+    if $ck_ok && $bj_ok; then
+      echo "[$(date -u +%FT%TZ)] HARVEST COMPLETE after $attempt attempts" >> "$LOG"
+      exit 0
+    fi
+  else
+    echo "[$ts] attempt $attempt: probe dead" >> "$LOG"
+  fi
+  sleep 420
+done

@@ -85,6 +85,85 @@ int tpr_unary_call(tpr_channel *ch, const char *method, const uint8_t *req,
                    size_t req_len, uint8_t **resp, size_t *resp_len,
                    char *details, size_t details_cap, int timeout_ms);
 
+/* ---------------------------------------------------------------------------
+ * Completion-queue async API — the reference's CQ-based async client shape
+ * (grpc_completion_queue_next, completion_queue.cc:393; CompletionQueue::Next
+ * in include/grpcpp/). Ops are tagged; completions surface as events pulled
+ * by any number of app threads via tpr_cq_next. Sends remain direct calls
+ * (they complete into the kernel/ring buffer synchronously; the blocking is
+ * bounded by transport backpressure, as in the reference's write path) —
+ * receive/finish, the genuinely asynchronous halves, are tag-driven.
+ *
+ * Deadlines on CQ calls are enforced lazily inside tpr_cq_next (the thread
+ * pulling events doubles as the timer thread, like grpc's cq-driven timer
+ * checks): an expired call is RST'd and its pending ops complete with
+ * TPR_DEADLINE_EXCEEDED.
+ */
+
+typedef struct tpr_cq tpr_cq;
+
+enum {
+  TPR_EV_SHUTDOWN = 0, /* queue shut down and drained */
+  TPR_EV_RECV = 1,     /* a tpr_call_recv_cq op completed */
+  TPR_EV_FINISH = 2,   /* a tpr_call_finish_cq / tpr_unary_call_cq completed */
+};
+
+typedef struct {
+  int type;      /* TPR_EV_* */
+  void *tag;     /* the tag passed when the op was started */
+  int ok;        /* RECV: 1 = data/len hold a message (caller frees),
+                  *       0 = end of response stream (no message).
+                  * FINISH: always 1. */
+  uint8_t *data; /* RECV with ok=1, or unary FINISH response; else NULL */
+  size_t len;
+  int status;         /* FINISH: gRPC status code */
+  char details[256];  /* FINISH: status details, NUL-terminated */
+} tpr_event;
+
+tpr_cq *tpr_cq_create(void);
+
+/* Pull the next completion. Returns 1 and fills *ev on an event; 0 on
+ * timeout (timeout_ms <= 0 means wait forever); -1 when the queue is shut
+ * down and fully drained (ev->type = TPR_EV_SHUTDOWN). */
+int tpr_cq_next(tpr_cq *cq, tpr_event *ev, int timeout_ms);
+
+/* Begin shutdown: wakes waiters; tpr_cq_next keeps returning queued events
+ * until drained, then -1. New ops on the queue are refused (best-effort:
+ * as in grpc, STARTING an op concurrently with shutdown is undefined —
+ * the app must stop issuing ops before calling shutdown, and must not
+ * destroy the queue while an op-arming call is still executing). */
+void tpr_cq_shutdown(tpr_cq *cq);
+
+/* Destroy a shut-down queue. Undelivered RECV payloads are freed. All
+ * calls started against this queue must be destroyed BEFORE the queue
+ * (tpr_call_destroy unhooks the call from the queue's deadline scan). */
+void tpr_cq_destroy(tpr_cq *cq);
+
+/* Start a call whose recv/finish ops complete on `cq`. Same semantics as
+ * tpr_call_start otherwise. Sends use the normal tpr_call_send /
+ * tpr_call_writes_done. */
+tpr_call *tpr_call_start_cq(tpr_channel *ch, const char *method,
+                            const char *const *metadata, size_t n_md,
+                            int timeout_ms, tpr_cq *cq);
+
+/* Request the next response message; completes as a TPR_EV_RECV event.
+ * Multiple outstanding recv ops on one call complete in order. Returns 0,
+ * or -1 if the call is not a CQ call. */
+int tpr_call_recv_cq(tpr_call *c, void *tag);
+
+/* Request the terminal status; completes as TPR_EV_FINISH once trailers
+ * (or a local terminal condition) arrive. At most one per call. */
+int tpr_call_finish_cq(tpr_call *c, void *tag);
+
+/* Async unary: small requests ship HEADERS+request in one buffered write
+ * (large ones fragment); ONE TPR_EV_FINISH completion carries response
+ * bytes (ok path) AND status — the reference's
+ * AsyncResponseReader::Finish(response, status, tag) shape.
+ * Returns the call (destroy after the completion) or NULL on refusal. */
+tpr_call *tpr_unary_call_cq(tpr_channel *ch, const char *method,
+                            const uint8_t *req, size_t req_len,
+                            int timeout_ms, tpr_cq *cq, void *tag);
+
 #ifdef __cplusplus
 } /* extern "C" */
 #endif

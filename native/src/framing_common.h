@@ -24,6 +24,12 @@ constexpr uint8_t kHeaders = 1, kMessage = 2, kTrailers = 3, kRst = 4,
 constexpr uint8_t kFlagEndStream = 0x01, kFlagMore = 0x02,
                   kFlagNoMessage = 0x04;
 constexpr size_t kMaxFramePayload = 1u << 20;
+// Unary requests at or below this ship HEADERS+MESSAGE as ONE buffered
+// write (one syscall / ring message); larger ones take the fragmenting
+// send path (a single MESSAGE frame above kMaxFramePayload is a framing
+// violation that kills the connection). Shared by the blocking and CQ
+// unary fast paths so the cutoff can't drift between them.
+constexpr size_t kSmallUnaryMax = 64u << 10;
 inline const char kMagic[] = "TPURPC\x01\x00";  // 8 bytes incl trailing NUL
 
 inline void put_u16(std::string &out, uint16_t v) {

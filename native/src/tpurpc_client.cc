@@ -32,6 +32,7 @@
 #include <deque>
 #include <map>
 #include <mutex>
+#include <set>
 #include <string>
 #include <thread>
 #include <vector>
@@ -40,6 +41,26 @@ namespace {
 
 using namespace tpr_wire;
 using Clock = std::chrono::steady_clock;
+
+// One queued completion. Value type: owns copies of everything it carries,
+// so events stay valid after the originating call is destroyed.
+struct CqEvent {
+  int type = 0;
+  void *tag = nullptr;
+  int ok = 0;
+  bool has_data = false;
+  std::string data;
+  int status = 0;
+  std::string details;
+};
+
+// (cq, event) pairs collected — and pushed — under ch->mu, so completions
+// reach the queue in the order they were generated (a push after releasing
+// ch->mu could interleave with a racing canceller's terminal events,
+// delivering a RECV after its call's FINISH). Lock nesting is strictly
+// one-way: ch->mu → cq->mu; nothing takes ch->mu while holding cq->mu
+// (tpr_cq_next releases cq->mu before its expiry RST).
+using CqDeliveries = std::vector<std::pair<tpr_cq *, CqEvent>>;
 
 struct Call {
   uint32_t stream_id = 0;
@@ -53,7 +74,100 @@ struct Call {
   bool has_deadline = false;
   bool cancelled = false;
   int internal_users = 0;  // threads inside rst_and_finish_locally's send
+  // CQ-async state (tags guarded by ch->mu; cq_pins by cq->mu; `done` is
+  // atomic so the cq's deadline scan can read it without ch->mu).
+  tpr_cq *cq = nullptr;
+  std::deque<void *> recv_tags;
+  bool finish_armed = false;
+  void *finish_tag = nullptr;
+  bool unary_armed = false;
+  void *unary_tag = nullptr;
+  std::atomic<bool> done{false};
+  int cq_pins = 0;  // tpr_cq_next threads holding this call across an expiry
 };
+
+}  // namespace
+
+struct tpr_cq {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::deque<CqEvent> q;
+  bool shut = false;
+  // CQ calls with a deadline, scanned lazily by tpr_cq_next (the puller
+  // doubles as the timer thread — grpc's cq-driven timer check shape).
+  std::set<tpr_call *> timed_calls;
+};
+
+namespace {
+
+// Under ch->mu: match queued messages with pending recv tags, and emit the
+// terminal completions once trailers are in. Called at every delivery point
+// (reader loop, die(), local RST, op arming).
+void drain_cq_locked(Call &c, CqDeliveries *out) {
+  if (c.cq == nullptr) return;
+  while (!c.recv_tags.empty() && !c.messages.empty()) {
+    CqEvent ev;
+    ev.type = TPR_EV_RECV;
+    ev.tag = c.recv_tags.front();
+    ev.ok = 1;
+    ev.has_data = true;
+    ev.data = std::move(c.messages.front());
+    c.messages.pop_front();
+    c.recv_tags.pop_front();
+    out->emplace_back(c.cq, std::move(ev));
+  }
+  if (!c.trailers_seen) return;
+  c.done.store(true);
+  while (!c.recv_tags.empty()) {  // end of stream: ok=0, no message
+    CqEvent ev;
+    ev.type = TPR_EV_RECV;
+    ev.tag = c.recv_tags.front();
+    c.recv_tags.pop_front();
+    out->emplace_back(c.cq, std::move(ev));
+  }
+  if (c.finish_armed) {
+    CqEvent ev;
+    ev.type = TPR_EV_FINISH;
+    ev.tag = c.finish_tag;
+    ev.ok = 1;
+    ev.status = c.status_code;
+    ev.details = c.status_details;
+    c.finish_armed = false;
+    out->emplace_back(c.cq, std::move(ev));
+  }
+  if (c.unary_armed) {  // response + status in ONE completion
+    CqEvent ev;
+    ev.type = TPR_EV_FINISH;
+    ev.tag = c.unary_tag;
+    ev.ok = 1;
+    ev.status = c.status_code;
+    ev.details = c.status_details;
+    if (!c.messages.empty()) {
+      ev.has_data = true;
+      ev.data = std::move(c.messages.front());
+      c.messages.pop_front();
+    }
+    c.unary_armed = false;
+    out->emplace_back(c.cq, std::move(ev));
+  }
+}
+
+void cq_push(CqDeliveries *evs) {
+  // Batch consecutive events for the same cq (the overwhelmingly common
+  // case) under one lock acquisition + one notify — the caller holds
+  // ch->mu, so per-event churn here would serialize the whole channel.
+  size_t i = 0;
+  while (i < evs->size()) {
+    tpr_cq *cq = (*evs)[i].first;
+    {
+      std::lock_guard<std::mutex> lk(cq->mu);
+      for (; i < evs->size() && (*evs)[i].first == cq; ++i)
+        cq->q.push_back(std::move((*evs)[i].second));
+    }
+    cq->cv.notify_all();
+  }
+  evs->clear();
+}
 
 }  // namespace
 
@@ -108,6 +222,7 @@ struct tpr_channel {
   }
 
   void die() {
+    CqDeliveries evs;
     {
       std::lock_guard<std::mutex> lk(mu);
       // Sweep + notify even when alive was already false: the *first*
@@ -122,7 +237,9 @@ struct tpr_channel {
           c.status_code = TPR_UNAVAILABLE;
           c.status_details = "connection lost";
         }
+        drain_cq_locked(c, &evs);
       }
+      cq_push(&evs);  // under mu: keeps cq ordering = generation ordering
     }
     cv.notify_all();
   }
@@ -157,6 +274,7 @@ struct tpr_channel {
         continue;
       }
 
+      CqDeliveries cq_evs;
       std::unique_lock<std::mutex> lk(mu);
       auto it = streams.find(sid);
       if (it == streams.end()) continue;  // late frame for a finished call
@@ -187,6 +305,8 @@ struct tpr_channel {
         c.trailers_seen = true;
         streams.erase(it);
       }
+      drain_cq_locked(c, &cq_evs);
+      cq_push(&cq_evs);  // under mu: keeps cq ordering = generation ordering
       bool drained = draining && streams.empty();
       lk.unlock();
       cv.notify_all();
@@ -219,6 +339,7 @@ static void rst_and_finish_locally(tpr_call *c, int code,
   md.emplace_back(":message", details);
   std::string payload = encode_metadata(md);
   ch->send_frame(kRst, 0, sid, payload.data(), payload.size());
+  CqDeliveries evs;
   {
     std::lock_guard<std::mutex> lk(ch->mu);
     ch->streams.erase(sid);
@@ -227,9 +348,51 @@ static void rst_and_finish_locally(tpr_call *c, int code,
       c->c.status_code = code;
       c->c.status_details = details;
     }
+    drain_cq_locked(c->c, &evs);
+    cq_push(&evs);  // under mu: keeps cq ordering = generation ordering
     c->c.internal_users--;
   }
   ch->cv.notify_all();
+}
+
+// CQ deadline expiry: terminate + DELIVER FIRST, then best-effort RST.
+// rst_and_finish_locally won't do here — its cancelled/trailers_seen guard
+// early-returns when a concurrent tpr_call_cancel won the race, and that
+// canceller can sit wedged in its RST send indefinitely (peer stopped
+// reading), which would strand the armed finish/unary tag forever. The
+// blocking API bounds the same race with a 5 s wait (tpr_call_finish);
+// the CQ path must not lose the completion at all. Setting the terminal
+// status before the RST is safe: a real trailers frame racing in later
+// finds trailers_seen set and drain emits nothing twice. The trailing RST
+// send can block only if the socket buffer is full of the app's own
+// wedged bulk writes — the same bounded exposure the blocking cancel has.
+static void cq_expire(tpr_call *c, int code, const char *details) {
+  tpr_channel *ch = c->c.ch;
+  uint32_t sid = 0;
+  bool send_rst = false;
+  {
+    std::lock_guard<std::mutex> lk(ch->mu);
+    if (!c->c.trailers_seen) {
+      c->c.trailers_seen = true;
+      c->c.status_code = code;
+      c->c.status_details = details;
+      send_rst = !c->c.cancelled;  // a racing cancel already ships an RST
+      c->c.cancelled = true;       // make later cancels no-ops
+      sid = c->c.stream_id;
+      ch->streams.erase(sid);
+      CqDeliveries evs;
+      drain_cq_locked(c->c, &evs);
+      cq_push(&evs);
+    }
+  }
+  ch->cv.notify_all();
+  if (send_rst) {
+    std::vector<std::pair<std::string, std::string>> md;
+    md.emplace_back(":status", std::to_string(TPR_CANCELLED));
+    md.emplace_back(":message", details);
+    std::string payload = encode_metadata(md);
+    ch->send_frame(kRst, 0, sid, payload.data(), payload.size());
+  }
 }
 
 
@@ -357,6 +520,26 @@ static void unregister_call(tpr_channel *ch, tpr_call *call) {
   delete call;
 }
 
+// Internal: ship HEADERS + the whole request MESSAGE (END_STREAM) for a
+// registered call as one buffered write (one syscall / ring message).
+static bool ship_buffered(tpr_channel *ch, tpr_call *call,
+                          const std::string &hdr_payload, const uint8_t *req,
+                          size_t req_len) {
+  std::string buf;
+  buf.reserve(20 + hdr_payload.size() + req_len);
+  build_frame_header(buf, kHeaders, 0, call->c.stream_id,
+                     hdr_payload.size());
+  buf += hdr_payload;
+  build_frame_header(buf, kMessage, kFlagEndStream, call->c.stream_id,
+                     req_len);
+  buf.append(reinterpret_cast<const char *>(req), req_len);
+  std::lock_guard<std::mutex> lk(ch->write_mu);
+  return ch->alive.load() &&
+         (ch->ring
+              ? ch->ring->write_gather(buf.data(), buf.size(), nullptr, 0)
+              : tpr_wire::fd_write_all(ch->fd, buf.data(), buf.size()));
+}
+
 // Internal: register a call and ship HEADERS + the whole request MESSAGE
 // (END_STREAM) as one buffered write. Small-unary fast path only.
 static tpr_call *tpr_call_start_buffered(tpr_channel *ch, const char *method,
@@ -366,23 +549,7 @@ static tpr_call *tpr_call_start_buffered(tpr_channel *ch, const char *method,
   tpr_call *call = register_call(ch, method, nullptr, 0, timeout_ms,
                                  &hdr_payload);
   if (!call) return nullptr;
-  std::string buf;
-  buf.reserve(20 + hdr_payload.size() + req_len);
-  build_frame_header(buf, kHeaders, 0, call->c.stream_id,
-                     hdr_payload.size());
-  buf += hdr_payload;
-  build_frame_header(buf, kMessage, kFlagEndStream, call->c.stream_id,
-                     req_len);
-  buf.append(reinterpret_cast<const char *>(req), req_len);
-  bool ok;
-  {
-    std::lock_guard<std::mutex> lk(ch->write_mu);
-    ok = ch->alive.load() &&
-         (ch->ring
-              ? ch->ring->write_gather(buf.data(), buf.size(), nullptr, 0)
-              : tpr_wire::fd_write_all(ch->fd, buf.data(), buf.size()));
-  }
-  if (!ok) {
+  if (!ship_buffered(ch, call, hdr_payload, req, req_len)) {
     unregister_call(ch, call);
     return nullptr;
   }
@@ -504,6 +671,17 @@ void tpr_call_cancel(tpr_call *c) {
 
 void tpr_call_destroy(tpr_call *c) {
   tpr_channel *ch = c->c.ch;
+  if (c->c.cq != nullptr) {
+    // Unhook from the queue's deadline scan first: a tpr_cq_next thread may
+    // be mid-expiry holding `c` (cq_pins) — wait for it, bounded, with the
+    // same leak-over-UAF policy as internal_users below.
+    tpr_cq *cq = c->c.cq;
+    std::unique_lock<std::mutex> lk(cq->mu);
+    cq->timed_calls.erase(c);
+    cq->cv.wait_for(lk, std::chrono::seconds(30),
+                    [&] { return c->c.cq_pins == 0; });
+    if (c->c.cq_pins != 0) return;  // pathological: leak beats corruption
+  }
   {
     std::unique_lock<std::mutex> lk(ch->mu);
     ch->streams.erase(c->c.stream_id);
@@ -526,7 +704,7 @@ int tpr_unary_call(tpr_channel *ch, const char *method, const uint8_t *req,
                    size_t req_len, uint8_t **resp, size_t *resp_len,
                    char *details, size_t details_cap, int timeout_ms) {
   tpr_call *c;
-  if (req_len <= (64u << 10)) {
+  if (req_len <= kSmallUnaryMax) {
     // small-unary fast path: HEADERS + MESSAGE|END_STREAM leave in ONE
     // write (one syscall / one ring message+notify). Two separate writes
     // cost a second wakeup on both sides — measured as the native unary
@@ -566,6 +744,226 @@ int tpr_unary_call(tpr_channel *ch, const char *method, const uint8_t *req,
   }
   tpr_call_destroy(c);
   return code;
+}
+
+/* -- completion-queue async API ------------------------------------------- */
+
+tpr_cq *tpr_cq_create(void) { return new tpr_cq(); }
+
+void tpr_cq_shutdown(tpr_cq *cq) {
+  {
+    std::lock_guard<std::mutex> lk(cq->mu);
+    cq->shut = true;
+  }
+  cq->cv.notify_all();
+}
+
+void tpr_cq_destroy(tpr_cq *cq) { delete cq; }
+
+static void fill_event(tpr_event *ev, CqEvent &e) {
+  ev->type = e.type;
+  ev->tag = e.tag;
+  ev->ok = e.ok;
+  ev->data = nullptr;
+  ev->len = 0;
+  if (e.has_data) {
+    ev->len = e.data.size();
+    ev->data = static_cast<uint8_t *>(malloc(e.data.size() ? e.data.size() : 1));
+    memcpy(ev->data, e.data.data(), e.data.size());
+  }
+  ev->status = e.status;
+  size_t n = e.details.size();
+  if (n >= sizeof ev->details) n = sizeof ev->details - 1;
+  memcpy(ev->details, e.details.data(), n);
+  ev->details[n] = '\0';
+}
+
+int tpr_cq_next(tpr_cq *cq, tpr_event *ev, int timeout_ms) {
+  const bool bounded = timeout_ms > 0;
+  const auto overall = Clock::now() + std::chrono::milliseconds(
+                                          bounded ? timeout_ms : 0);
+  std::unique_lock<std::mutex> lk(cq->mu);
+  while (true) {
+    // Deadline enforcement FIRST, even with events queued: on a busy queue
+    // the early return would otherwise starve expiries indefinitely — the
+    // puller is the timer thread, so expiry latency must be bounded by one
+    // cq_next call, not by traffic quiescence.
+    tpr_call *expired = nullptr;
+    auto earliest = Clock::time_point::max();
+    const auto now = Clock::now();
+    for (auto it = cq->timed_calls.begin(); it != cq->timed_calls.end();) {
+      tpr_call *tc = *it;
+      if (tc->c.done.load()) {  // finished normally; drop from the scan
+        it = cq->timed_calls.erase(it);
+        continue;
+      }
+      if (tc->c.deadline <= now) {
+        expired = tc;
+        break;
+      }
+      if (tc->c.deadline < earliest) earliest = tc->c.deadline;
+      ++it;
+    }
+    if (expired != nullptr) {
+      expired->c.cq_pins++;  // pins `expired` across the unlocked expiry
+      lk.unlock();
+      cq_expire(expired, TPR_DEADLINE_EXCEEDED, "deadline exceeded (client)");
+      lk.lock();
+      expired->c.cq_pins--;
+      cq->timed_calls.erase(expired);
+      cq->cv.notify_all();  // a destroy may be waiting for the pin drain
+      continue;             // cq_expire queued this call's completions
+    }
+    if (!cq->q.empty()) {
+      fill_event(ev, cq->q.front());
+      cq->q.pop_front();
+      return 1;
+    }
+    if (cq->shut) {
+      memset(ev, 0, sizeof *ev);
+      ev->type = TPR_EV_SHUTDOWN;
+      return -1;
+    }
+    if (bounded && Clock::now() >= overall) return 0;
+    auto wake = earliest;
+    if (bounded && overall < wake) wake = overall;
+    if (wake == Clock::time_point::max())
+      cq->cv.wait(lk);
+    else
+      cq->cv.wait_until(lk, wake);
+  }
+}
+
+tpr_call *tpr_call_start_cq(tpr_channel *ch, const char *method,
+                            const char *const *metadata, size_t n_md,
+                            int timeout_ms, tpr_cq *cq) {
+  {
+    std::lock_guard<std::mutex> lk(cq->mu);
+    if (cq->shut) return nullptr;
+  }
+  std::string payload;
+  tpr_call *call = register_call(ch, method, metadata, n_md, timeout_ms,
+                                 &payload);
+  if (call == nullptr) return nullptr;
+  {
+    std::lock_guard<std::mutex> lk(ch->mu);
+    call->c.cq = cq;  // before HEADERS leave: the reader reads it under mu
+  }
+  if (!ch->send_frame(kHeaders, 0, call->c.stream_id, payload.data(),
+                      payload.size())) {
+    unregister_call(ch, call);
+    return nullptr;
+  }
+  if (call->c.has_deadline) {
+    // Notify: an already-parked tpr_cq_next must recompute its wake time
+    // around the new deadline or it sleeps through the expiry.
+    std::lock_guard<std::mutex> lk(cq->mu);
+    cq->timed_calls.insert(call);
+    cq->cv.notify_all();
+  }
+  return call;
+}
+
+// A shut queue refuses new ops (client.h contract): once tpr_cq_next has
+// returned -1 the app may destroy the queue, so accepting a late op would
+// let a future delivery write into freed memory.
+static bool cq_refused(tpr_cq *cq) {
+  std::lock_guard<std::mutex> lk(cq->mu);
+  return cq->shut;
+}
+
+int tpr_call_recv_cq(tpr_call *c, void *tag) {
+  if (c->c.cq == nullptr || cq_refused(c->c.cq)) return -1;
+  tpr_channel *ch = c->c.ch;
+  CqDeliveries evs;
+  {
+    std::lock_guard<std::mutex> lk(ch->mu);
+    c->c.recv_tags.push_back(tag);
+    drain_cq_locked(c->c, &evs);  // may complete immediately
+    cq_push(&evs);
+  }
+  return 0;
+}
+
+int tpr_call_finish_cq(tpr_call *c, void *tag) {
+  if (c->c.cq == nullptr || cq_refused(c->c.cq)) return -1;
+  tpr_channel *ch = c->c.ch;
+  CqDeliveries evs;
+  {
+    std::lock_guard<std::mutex> lk(ch->mu);
+    if (c->c.finish_armed) return -1;  // at most one finish op per call
+    c->c.finish_armed = true;
+    c->c.finish_tag = tag;
+    drain_cq_locked(c->c, &evs);
+    cq_push(&evs);
+  }
+  return 0;
+}
+
+tpr_call *tpr_unary_call_cq(tpr_channel *ch, const char *method,
+                            const uint8_t *req, size_t req_len,
+                            int timeout_ms, tpr_cq *cq, void *tag) {
+  {
+    std::lock_guard<std::mutex> lk(cq->mu);
+    if (cq->shut) return nullptr;
+  }
+  std::string hdr_payload;
+  tpr_call *call = register_call(ch, method, nullptr, 0, timeout_ms,
+                                 &hdr_payload);
+  if (call == nullptr) return nullptr;
+  bool timed = call->c.has_deadline;
+  {
+    // Arm BEFORE the request leaves: the response may race back and be
+    // delivered by the reader in the gap after the send returns. Also pin
+    // the call (internal_users) — once the completion is deliverable, a
+    // puller thread may legally tpr_call_destroy it before this thread
+    // runs again, and destroy must wait for us (it already waits for the
+    // cancel path's pin on the same counter).
+    std::lock_guard<std::mutex> lk(ch->mu);
+    call->c.cq = cq;
+    call->c.unary_armed = true;
+    call->c.unary_tag = tag;
+    call->c.internal_users++;
+  }
+  if (timed) {
+    // Register before bytes leave (never touch `call` after the send
+    // succeeds); notify so an already-parked tpr_cq_next recomputes its
+    // wake time around the new deadline.
+    std::lock_guard<std::mutex> lk(cq->mu);
+    cq->timed_calls.insert(call);
+    cq->cv.notify_all();
+  }
+  bool shipped;
+  if (req_len <= kSmallUnaryMax) {
+    shipped = ship_buffered(ch, call, hdr_payload, req, req_len);
+  } else {
+    shipped = ch->send_frame(kHeaders, 0, call->c.stream_id,
+                             hdr_payload.data(), hdr_payload.size()) &&
+              tpr_call_send(call, req, req_len, /*end_stream=*/1) == 0;
+  }
+  bool handed_off;
+  {
+    std::unique_lock<std::mutex> lk(ch->mu);
+    call->c.internal_users--;
+    // On failure: if die() already delivered the UNAVAILABLE completion,
+    // hand the call back so the app's event handling destroys it;
+    // otherwise suppress delivery and tear the call down ourselves.
+    handed_off = shipped || (call->c.trailers_seen && !call->c.unary_armed);
+    if (!handed_off) call->c.unary_armed = false;
+  }
+  ch->cv.notify_all();  // a destroy may be waiting on the pin drain
+  if (handed_off) return call;
+  if (timed) {
+    // Mirror tpr_call_destroy's unhook for a call the app never saw: a
+    // cq_next thread may hold it pinned mid-expiry.
+    std::unique_lock<std::mutex> lk(cq->mu);
+    cq->timed_calls.erase(call);
+    cq->cv.wait_for(lk, std::chrono::seconds(30),
+                    [&] { return call->c.cq_pins == 0; });
+    if (call->c.cq_pins != 0) return nullptr;  // leak beats corruption
+  }
+  unregister_call(ch, call);
+  return nullptr;
 }
 
 }  // extern "C"

@@ -158,6 +158,132 @@ int main() {{
         srv.stop(grace=0)
 
 
+# -- completion-queue async client -------------------------------------------
+
+ASYNC_BIN = os.path.join(ROOT, "native", "build", "cpp_async_example")
+
+
+def _build_async_example():
+    _build_cpp(ASYNC_BIN, "cpp_async_client.cc",
+               ["tpurpc_client.cc", "ring.cc"], ["client.h"])
+
+
+def _async_server():
+    srv = _server()
+    hang = threading.Event()
+
+    def hang_handler(req, ctx):
+        hang.wait(timeout=30)
+        return b"late"
+
+    srv.add_method("/demo.Greeter/Hang",
+                   rpc.unary_unary_rpc_method_handler(hang_handler))
+    return srv, hang
+
+
+def _check_async(out: str):
+    assert "async_unary done=64 matched=64" in out
+    assert "big_async_ok=1" in out  # >1MiB request takes the fragmenting path
+    assert "stream_status=0 got=3" in out
+    assert "deadline_status=4" in out  # DEADLINE_EXCEEDED from the cq puller
+    assert "shutdown_rc=-1" in out
+
+
+def test_cpp_async_client_tcp(monkeypatch):
+    """The CQ async shape (grpc CompletionQueue::Next): 64 pipelined unary
+    calls on one channel, tagged streaming recvs, cq-enforced deadline."""
+    monkeypatch.setenv("GRPC_PLATFORM_TYPE", "TCP")
+    _build_async_example()
+    srv, hang = _async_server()
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    try:
+        proc = subprocess.run([ASYNC_BIN, str(port)], capture_output=True,
+                              text=True, timeout=120)
+        assert proc.returncode == 0, (proc.stdout, proc.stderr)
+        _check_async(proc.stdout)
+    finally:
+        hang.set()
+        srv.stop(grace=0)
+
+
+def test_cpp_async_client_ring(monkeypatch):
+    """Same battery with the byte pipe swapped to the shm ring by env —
+    the CQ surface is transport-agnostic like the blocking one."""
+    monkeypatch.setenv("GRPC_PLATFORM_TYPE", "RDMA_BP")
+    _build_async_example()
+    srv, hang = _async_server()
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    try:
+        env = dict(os.environ, GRPC_PLATFORM_TYPE="RDMA_BP")
+        proc = subprocess.run([ASYNC_BIN, str(port)], capture_output=True,
+                              text=True, timeout=120, env=env)
+        assert proc.returncode == 0, (proc.stdout, proc.stderr)
+        _check_async(proc.stdout)
+    finally:
+        hang.set()
+        srv.stop(grace=0)
+
+
+def test_cpp_async_parked_puller_deadline(monkeypatch):
+    """A puller already parked in tpr_cq_next (no queued events, no timed
+    calls) must be woken by a later deadlined call's registration and
+    enforce its expiry — regression for the missing notify on insert."""
+    monkeypatch.setenv("GRPC_PLATFORM_TYPE", "TCP")
+    srv, hang = _async_server()
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    try:
+        src = f"""
+#include <cstdio>
+#include <thread>
+#include <chrono>
+#include "tpurpc/client.h"
+int main() {{
+  tpr_channel *ch = tpr_channel_create("127.0.0.1", {port}, 5000);
+  if (!ch) return 2;
+  tpr_cq *cq = tpr_cq_create();
+  int dl_status = -1;
+  std::thread puller([&] {{
+    tpr_event ev;
+    // parks in cv.wait (no timeout, nothing queued, no timed calls yet)
+    if (tpr_cq_next(cq, &ev, 0) == 1 && ev.type == TPR_EV_FINISH) {{
+      dl_status = ev.status;
+      if (ev.data) tpr_buf_free(ev.data);
+    }}
+  }});
+  std::this_thread::sleep_for(std::chrono::milliseconds(300));  // park it
+  tpr_call *c = tpr_unary_call_cq(ch, "/demo.Greeter/Hang", nullptr, 0,
+                                  400, cq, (void *)1);
+  puller.join();  // hangs forever if the insert doesn't notify
+  if (c) tpr_call_destroy(c);
+  printf("dl=%d\\n", dl_status);
+  tpr_cq_shutdown(cq);
+  tpr_cq_destroy(cq);
+  tpr_channel_destroy(ch);
+  return dl_status == TPR_DEADLINE_EXCEEDED ? 0 : 1;
+}}
+"""
+        tmp_src = os.path.join(ROOT, "native", "build", "parked_puller.cc")
+        tmp_bin = os.path.join(ROOT, "native", "build", "parked_puller")
+        with open(tmp_src, "w") as f:
+            f.write(src)
+        subprocess.run(
+            ["g++", "-std=c++17", "-O0", tmp_src,
+             os.path.join(ROOT, "native", "src", "tpurpc_client.cc"),
+             os.path.join(ROOT, "native", "src", "ring.cc"),
+             "-I", os.path.join(ROOT, "native", "include"),
+             "-lpthread", "-o", tmp_bin],
+            check=True, timeout=180, capture_output=True)
+        proc = subprocess.run([tmp_bin], capture_output=True, text=True,
+                              timeout=60)
+        assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    finally:
+        hang.set()
+        srv.stop(grace=0)
+
+
 # -- native C++ SERVER -------------------------------------------------------
 
 SRV_BIN = os.path.join(ROOT, "native", "build", "cpp_server_example")
