@@ -10,10 +10,14 @@
 //          native/src/tpurpc_client.cc native/src/tpurpc_server.cc \
 //          -Inative/include -lpthread -o /tmp/micro_native
 // Run:   /tmp/micro_native [req_size=64] [duration_s=5] [threads=1]
-//                          [streaming=0|1]
+//                          [streaming=0|1] [use_cb=1] [outstanding=1]
 // streaming=1 is the reference's measured configuration (its committed
 // latency logs are `streaming_true`): ONE bidi call per thread, ping-pong
 // messages — call setup/teardown off the per-RPC path.
+// outstanding>1 (with streaming=0) pipelines that many unary calls per
+// thread through the CQ async API — the reference's `concurrent` axis
+// (mb_client's concurrency flag in its tput-scalability sweeps): completions
+// amortize wakeups, so rate rises even on one core while per-RPC RTT grows.
 //
 // Output: the reference's log line shape —
 //   "Rate N RPCs/s, TX Bandwidth M Mb/s, RTT (us) mean A P50 B P99 C"
@@ -55,6 +59,10 @@ int main(int argc, char **argv) {
   int threads = argc > 3 ? atoi(argv[3]) : 1;
   int streaming = argc > 4 ? atoi(argv[4]) : 0;
   int use_cb = argc > 5 ? atoi(argv[5]) : 1;  // callback API by default
+  int outstanding = argc > 6 ? atoi(argv[6]) : 1;  // CQ pipeline depth
+  // Depth only applies to the CQ unary mode; normalize so the JSON line
+  // never attributes one-in-flight numbers to a pipelined depth.
+  if (streaming || outstanding < 1) outstanding = 1;
 
   tpr_server *srv = tpr_server_create(0);
   if (!srv) { fprintf(stderr, "server create failed\n"); return 1; }
@@ -78,7 +86,54 @@ int main(int argc, char **argv) {
       std::vector<uint8_t> payload(req_size, 0xAB);
       auto &lat = lat_us_per_thread[t];
       lat.reserve(1 << 20);
-      if (streaming) {
+      if (!streaming && outstanding > 1) {
+        // CQ-pipelined unary: keep K calls in flight; each FINISH
+        // completion immediately refills its slot.
+        tpr_cq *cq = tpr_cq_create();
+        struct Slot {
+          tpr_call *call = nullptr;
+          std::chrono::steady_clock::time_point t0;
+        };
+        std::vector<Slot> slots(outstanding);
+        auto start_slot = [&](size_t i) {
+          slots[i].t0 = std::chrono::steady_clock::now();
+          slots[i].call = tpr_unary_call_cq(ch, "/bench.Echo/Echo",
+                                            payload.data(), payload.size(),
+                                            5000, cq, (void *)(uintptr_t)i);
+          return slots[i].call != nullptr;
+        };
+        size_t inflight = 0;
+        for (size_t i = 0; i < (size_t)outstanding; ++i)
+          if (start_slot(i)) inflight++;
+        while (inflight > 0) {
+          tpr_event ev;
+          if (tpr_cq_next(cq, &ev, 10000) != 1) break;  // > call deadline
+          if (ev.type != TPR_EV_FINISH) continue;
+          size_t i = (size_t)(uintptr_t)ev.tag;
+          if (ev.data) tpr_buf_free(ev.data);
+          tpr_call_destroy(slots[i].call);
+          slots[i].call = nullptr;
+          inflight--;
+          if (ev.status != TPR_OK) continue;  // drain; don't refill
+          auto dt = std::chrono::duration<double, std::micro>(
+                        std::chrono::steady_clock::now() - slots[i].t0)
+                        .count();
+          lat.push_back(dt);
+          total_rpcs.fetch_add(1, std::memory_order_relaxed);
+          if (std::chrono::steady_clock::now() < t_end && start_slot(i))
+            inflight++;
+        }
+        // The drain can bail with calls still live (stalled server): every
+        // call must be destroyed BEFORE the queue (client.h destroy order),
+        // or channel teardown drains completions into a freed cq.
+        for (auto &s : slots)
+          if (s.call) {
+            tpr_call_cancel(s.call);
+            tpr_call_destroy(s.call);
+          }
+        tpr_cq_shutdown(cq);
+        tpr_cq_destroy(cq);
+      } else if (streaming) {
         // one bidi call for the whole run: message round trips only
         tpr_call *c = tpr_call_start(ch, "/bench.Echo/Echo", nullptr, 0, 0);
         if (!c) { tpr_channel_destroy(ch); return; }
@@ -145,10 +200,10 @@ int main(int argc, char **argv) {
   printf("Rate %.0f RPCs/s, TX Bandwidth %.2f Mb/s, RTT (us) mean %.2f "
          "P50 %.2f P99 %.2f\n", rate, tx_mbps, mean, pct(50), pct(99));
   printf("{\"bench\": \"micro_native\", \"req_size\": %zu, \"threads\": %d, "
-         "\"streaming\": %s, "
+         "\"streaming\": %s, \"outstanding\": %d, "
          "\"duration_s\": %.1f, \"rpcs\": %llu, \"rate_rps\": %.0f, "
          "\"rtt_us_mean\": %.2f, \"rtt_us_p50\": %.2f, \"rtt_us_p99\": %.2f}\n",
-         req_size, threads, streaming ? "true" : "false", elapsed,
+         req_size, threads, streaming ? "true" : "false", outstanding, elapsed,
          (unsigned long long)n, rate, mean, pct(50), pct(99));
   return 0;
 }

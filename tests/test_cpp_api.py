@@ -552,6 +552,14 @@ def test_micro_native_bench_smoke(tmp_path):
         assert rec["rpcs"] > 100
         assert rec["rtt_us_p50"] > 0
 
+    # CQ-pipelined mode (outstanding=8): all slots drain cleanly
+    out = subprocess.run([str(binp), "64", "1", "1", "0", "1", "8"],
+                         capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    rec = _json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["outstanding"] == 8
+    assert rec["rpcs"] > 100
+
 
 # -- C++ apps on the RING transport (VERDICT r2 next#8) ----------------------
 
