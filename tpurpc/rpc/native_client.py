@@ -28,6 +28,7 @@ from __future__ import annotations
 import ctypes
 import os
 import threading
+import time
 from typing import Callable, Iterable, Optional
 
 from tpurpc.rpc.status import RpcError, StatusCode
@@ -81,8 +82,36 @@ def _load():
         lib.tpr_call_cancel.argtypes = [ctypes.c_void_p]
         lib.tpr_call_destroy.argtypes = [ctypes.c_void_p]
         lib.tpr_buf_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+        # completion-queue async surface (futures fast path)
+        lib.tpr_cq_create.restype = ctypes.c_void_p
+        lib.tpr_cq_create.argtypes = []
+        lib.tpr_cq_next.restype = ctypes.c_int
+        lib.tpr_cq_next.argtypes = [ctypes.c_void_p,
+                                    ctypes.POINTER(_TprEvent), ctypes.c_int]
+        lib.tpr_cq_shutdown.argtypes = [ctypes.c_void_p]
+        lib.tpr_cq_destroy.argtypes = [ctypes.c_void_p]
+        lib.tpr_unary_call_cq.restype = ctypes.c_void_p
+        lib.tpr_unary_call_cq.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,
+            ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p]
         _LIB = lib
         return lib
+
+
+class _TprEvent(ctypes.Structure):
+    """Mirror of tpr_event (native/include/tpurpc/client.h)."""
+
+    _fields_ = [("type", ctypes.c_int),
+                ("tag", ctypes.c_void_p),
+                ("ok", ctypes.c_int),
+                ("data", ctypes.POINTER(ctypes.c_uint8)),
+                ("len", ctypes.c_size_t),
+                ("status", ctypes.c_int),
+                ("details", ctypes.c_char * 256)]
+
+
+_EV_FINISH = 2  # TPR_EV_FINISH
 
 
 def _u8(data) -> "ctypes.Array":
@@ -160,16 +189,143 @@ class NativeCall:
             pass
 
 
+class _CqDriver:
+    """One completion queue + puller thread per channel: resolves
+    ``unary.future()`` calls from tagged TPR_EV_FINISH completions — the
+    grpcio ``.future()`` shape over the native CQ async API, so a Python
+    client can keep many unary calls in flight on one connection (the
+    micro-bench's pipelined mode, bench/results/micro_native_1core.log)."""
+
+    def __init__(self, lib):
+        import concurrent.futures  # stdlib Future is the contract
+
+        self._lib = lib
+        self._Future = concurrent.futures.Future
+        self._cq = lib.tpr_cq_create()
+        self._lock = threading.Lock()
+        # tag -> entry {fut, call, des, done}; `call` is filled right after
+        # tpr_unary_call_cq returns — the completion can race that store,
+        # so whoever sees both `done` and `call` performs the destroy.
+        self._pending: dict = {}
+        self._next_tag = 1
+        self._thread = threading.Thread(target=self._pull, daemon=True,
+                                        name="tpurpc-native-cq")
+        self._thread.start()
+
+    def submit(self, ch, method_b: bytes, raw, timeout,
+               deserializer) -> "concurrent.futures.Future":
+        buf = _u8(raw)  # before registering: a bad serializer output must
+        fut = self._Future()  # not leak a pending entry (close would stall)
+        with self._lock:
+            tag = self._next_tag
+            self._next_tag += 1
+            entry = {"fut": fut, "call": None, "des": deserializer,
+                     "done": False}
+            self._pending[tag] = entry
+        call = self._lib.tpr_unary_call_cq(ch, method_b, buf, len(buf),
+                                           _timeout_ms(timeout), self._cq,
+                                           ctypes.c_void_p(tag))
+        if not call:
+            with self._lock:
+                self._pending.pop(tag, None)
+            raise RpcError(StatusCode.UNAVAILABLE,
+                           "call refused (channel dead or draining)")
+        destroy = None
+        with self._lock:
+            entry["call"] = call
+            if entry["done"]:  # completion won the race; we own the destroy
+                destroy = call
+                self._pending.pop(tag, None)
+        if destroy:
+            self._lib.tpr_call_destroy(destroy)
+        return fut
+
+    def _pull(self):
+        ev = _TprEvent()
+        while True:
+            rc = self._lib.tpr_cq_next(self._cq, ctypes.byref(ev), 1000)
+            if rc == -1:
+                return  # shut down and drained
+            if rc != 1 or ev.type != _EV_FINISH:
+                continue
+            tag = ev.tag or 0
+            body = b""
+            if ev.data:
+                body = ctypes.string_at(ev.data, ev.len) if ev.len else b""
+                self._lib.tpr_buf_free(ev.data)
+            destroy = None
+            with self._lock:
+                entry = self._pending.get(tag)
+                if entry is None:
+                    continue
+                entry["done"] = True
+                if entry["call"]:
+                    destroy = entry["call"]
+                    self._pending.pop(tag, None)
+                # else: submit() still holds the race; it destroys
+            if destroy:
+                self._lib.tpr_call_destroy(destroy)
+            fut, des = entry["fut"], entry["des"]
+            if not fut.set_running_or_notify_cancel():
+                continue  # user cancelled the Future; drop the result
+            if ev.status == 0:
+                try:
+                    fut.set_result(des(body) if des else body)
+                except Exception as exc:  # deserializer raised
+                    fut.set_exception(exc)
+            else:
+                code = (StatusCode(ev.status)
+                        if ev.status in StatusCode._value2member_map_
+                        else StatusCode.UNKNOWN)
+                fut.set_exception(RpcError(
+                    code, ev.details.decode("utf-8", "replace")))
+
+    def close(self, cancel_inflight: bool = True):
+        """Cancel in-flight calls, drain their completions, stop the
+        puller, free the queue. Must run BEFORE tpr_channel_destroy —
+        destroying a call touches its channel."""
+        if cancel_inflight:
+            # Cancel UNDER the lock: the puller pops an entry (and later
+            # destroys its call) while holding it, so a call still present
+            # in _pending here cannot concurrently be freed under us.
+            with self._lock:
+                for e in self._pending.values():
+                    if e["call"] and not e["done"]:
+                        self._lib.tpr_call_cancel(e["call"])
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not self._pending:
+                    break
+            time.sleep(0.01)
+        self._lib.tpr_cq_shutdown(self._cq)
+        self._thread.join(timeout=10.0)
+        if not self._thread.is_alive():
+            self._lib.tpr_cq_destroy(self._cq)
+        # else: leak the cq — a wedged puller beats a use-after-free
+
+
 class NativeChannel:
     """ctypes channel over the native client loop (see module docstring)."""
 
     def __init__(self, host: str, port: int, connect_timeout: float = 10.0):
         self._lib = _load()
+        self._cq_driver: Optional[_CqDriver] = None
+        self._cq_lock = threading.Lock()
         self._ch = self._lib.tpr_channel_create(
             host.encode(), int(port), _timeout_ms(connect_timeout))
         if not self._ch:
             raise RpcError(StatusCode.UNAVAILABLE,
                            f"native connect to {host}:{port} failed")
+
+    def _driver(self) -> _CqDriver:
+        with self._cq_lock:
+            if not self._ch:  # close() swaps _ch under this same lock, so a
+                # late future() can't resurrect a driver nothing will close
+                raise RpcError(StatusCode.UNAVAILABLE, "channel closed")
+            if self._cq_driver is None:
+                self._cq_driver = _CqDriver(self._lib)
+            return self._cq_driver
 
     def _handle(self):
         """The live native handle; raises (instead of passing a freed/NULL
@@ -215,6 +371,18 @@ class NativeChannel:
             return (response_deserializer(body) if response_deserializer
                     else body)
 
+        def future(request, timeout: Optional[float] = None):
+            """grpcio's ``.future()`` shape: returns a concurrent.futures
+            .Future resolving to the response (or raising RpcError), with
+            the call pipelined through the channel's completion queue —
+            many can be in flight at once on one connection."""
+            ch = self._handle()
+            raw = (request_serializer(request) if request_serializer
+                   else request)
+            return self._driver().submit(ch, mb, raw, timeout,
+                                         response_deserializer)
+
+        call.future = future
         return call
 
     def start_call(self, method: str,
@@ -272,8 +440,14 @@ class NativeChannel:
         return call
 
     def close(self) -> None:
-        ch, self._ch = self._ch, None
+        with self._cq_lock:
+            ch, self._ch = self._ch, None
+            drv, self._cq_driver = self._cq_driver, None
         if ch:
+            # CQ teardown first: destroying a call touches its channel, so
+            # every future's call must be destroyed before the channel is.
+            if drv is not None:
+                drv.close()
             self._lib.tpr_channel_destroy(ch)
 
     def __del__(self):
