@@ -1,0 +1,132 @@
+"""grpc.lb.v1 wire codec — stock grpclb interop for the look-aside LB.
+
+The reference's grpclb policy (``lb_policy/grpclb/grpclb.cc``) speaks the
+``grpc.lb.v1.LoadBalancer/BalanceLoad`` bidi stream defined in
+``src/proto/grpc/lb/v1/load_balancer.proto``. tpurpc's look-aside module
+(:mod:`tpurpc.rpc.lookaside`) carries the same control loop over a
+tpurpc-native JSON protocol; this module adds the standard protobuf wire
+so stock grpclb clients can subscribe to a tpurpc balancer and a tpurpc
+watcher can consume a stock balancer. Hand-rolled field codec in the
+style of :mod:`tpurpc.rpc.health` (no generated code needed).
+
+Message subset (fields we produce/consume; unknown fields are skipped):
+
+    LoadBalanceRequest  { InitialLoadBalanceRequest initial_request = 1; }
+    InitialLoadBalanceRequest { string name = 1; }
+    LoadBalanceResponse { InitialLoadBalanceResponse initial_response = 1;
+                          ServerList server_list = 2;
+                          FallbackResponse fallback_response = 3; }
+    ServerList { repeated Server servers = 1; }
+    Server { bytes ip_address = 1;     // 4 or 16 bytes, network order
+             int32 port = 2;
+             string load_balance_token = 3;
+             bool drop = 4; }
+
+grpc.lb.v1 addresses are IPs, not hostnames: list entries that do not
+parse as IPv4/IPv6 are skipped on encode (traced), matching what a stock
+balancer could legally emit.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import List, Optional, Sequence, Tuple
+
+from tpurpc.rpc.lookaside import trace_lb  # one registry slot, one knob
+from tpurpc.wire.protowire import fields, ld, vf
+
+SERVICE = "grpc.lb.v1.LoadBalancer"
+METHOD = f"/{SERVICE}/BalanceLoad"
+
+
+def _split_hostport(addr: str) -> Tuple[str, int]:
+    host, _, port = addr.rpartition(":")
+    return host.strip("[]"), int(port)
+
+
+def encode_initial_request(name: str) -> bytes:
+    """LoadBalanceRequest{initial_request{name}} — the subscribe message a
+    grpclb client opens the stream with."""
+    return ld(1, ld(1, name.encode()))
+
+
+def decode_request(buf) -> Optional[str]:
+    """Returns the subscribed name for an initial_request, None for
+    client_stats / unknown (grpclb clients send stats on the same stream;
+    a balancer ignores what it doesn't consume)."""
+    for fno, wt, val in fields(bytes(buf)):
+        if fno == 1 and wt == 2:
+            for ifno, iwt, ival in fields(val):
+                if ifno == 1 and iwt == 2:
+                    return ival.decode("utf-8", "replace")
+            return ""  # initial_request with no name: subscribe to default
+    return None
+
+
+def encode_initial_response() -> bytes:
+    """LoadBalanceResponse{initial_response{}} — sent once at stream start
+    (no client-stats interval: we don't request load reports)."""
+    return ld(1, b"")
+
+
+def encode_server_list(addrs: Sequence[str]) -> bytes:
+    """LoadBalanceResponse{server_list} from "ip:port" strings."""
+    servers = b""
+    for addr in addrs:
+        try:
+            host, port = _split_hostport(addr)
+        except ValueError:
+            trace_lb.log("grpc.lb.v1: skipping unparsable address %r", addr)
+            continue
+        packed = None
+        for fam in (socket.AF_INET, socket.AF_INET6):
+            try:
+                packed = socket.inet_pton(fam, host)
+                break
+            except OSError:
+                continue
+        if packed is None:
+            trace_lb.log("grpc.lb.v1: skipping non-IP address %r "
+                         "(the wire carries packed IPs)", addr)
+            continue
+        servers += ld(1, ld(1, packed) + vf(2, port))
+    return ld(2, servers)
+
+
+def decode_response(buf) -> Tuple[str, Optional[List[str]]]:
+    """Returns ("initial", None), ("server_list", ["ip:port", ...]),
+    ("fallback", None), or ("unknown", None)."""
+    for fno, wt, val in fields(bytes(buf)):
+        if fno == 1 and wt == 2:
+            return "initial", None
+        if fno == 3 and wt == 2:
+            return "fallback", None
+        if fno == 2 and wt == 2:
+            out: List[str] = []
+            for sfno, swt, sval in fields(val):
+                if sfno != 1 or swt != 2:
+                    continue
+                ip = b""
+                port = 0
+                drop = False
+                for ffno, fwt, fval in fields(sval):
+                    if ffno == 1 and fwt == 2:
+                        ip = fval
+                    elif ffno == 2 and fwt == 0:
+                        port = fval
+                    elif ffno == 4 and fwt == 0:
+                        drop = bool(fval)
+                if drop or not ip:
+                    continue  # drop-entries steer load shedding, not dialing
+                if len(ip) == 4:
+                    out.append(f"{socket.inet_ntop(socket.AF_INET, ip)}:{port}")
+                elif len(ip) == 16:
+                    out.append(
+                        f"[{socket.inet_ntop(socket.AF_INET6, ip)}]:{port}")
+            return "server_list", out
+    return "unknown", None
+
+
+__all__ = ["SERVICE", "METHOD", "encode_initial_request", "decode_request",
+           "encode_initial_response", "encode_server_list",
+           "decode_response"]

@@ -19,10 +19,11 @@ client side::
     ...                                            # calls rebalance live
     watcher.stop()
 
-Wire format: JSON bodies (this is a tpurpc-native control protocol, not
-the grpc.lb.v1 protobuf — stock-grpclb interop is out of scope the same
-way xds is; the CAPABILITY is what's reproduced). Request:
-``{"name": ...}``; each response: ``{"servers": ["h:p", ...]}``.
+Two wire formats. Native: JSON bodies on ``/tpurpc.lb.v1.LoadBalancer/
+BalanceLoad`` (request ``{"name": ...}``, responses ``{"servers":
+["h:p", ...]}``). Standard: the grpc.lb.v1 protobuf stream
+(:mod:`tpurpc.rpc.lb_v1`) — ``attach`` serves both, and
+``enable_lookaside(..., wire="grpclb")`` consumes a stock balancer.
 """
 
 from __future__ import annotations
@@ -59,17 +60,9 @@ class LoadBalancerServicer:
             self._epoch += 1
             self._lock.notify_all()
 
-    def _balance_load(self, request_iterator, ctx):
-        first = next(iter(request_iterator), None)
-        if first is None:
-            return
-        try:
-            name = json.loads(bytes(first).decode())["name"]
-        except (ValueError, KeyError):
-            from tpurpc.rpc.status import AbortError, StatusCode
-
-            raise AbortError(StatusCode.INVALID_ARGUMENT,
-                             "malformed BalanceLoad request") from None
+    def _updates(self, name: str, ctx):
+        """Yield the current list for ``name``, then every change, until
+        the stream dies — shared by both wire formats."""
         last_sent: Optional[List[str]] = None
         while ctx.is_active():
             with self._lock:
@@ -81,20 +74,67 @@ class LoadBalancerServicer:
                                         timeout=1.0)
                     continue
             last_sent = current
+            yield current
+
+    def _balance_load(self, request_iterator, ctx):
+        first = next(iter(request_iterator), None)
+        if first is None:
+            return
+        try:
+            name = json.loads(bytes(first).decode())["name"]
+        except (ValueError, KeyError):
+            from tpurpc.rpc.status import AbortError, StatusCode
+
+            raise AbortError(StatusCode.INVALID_ARGUMENT,
+                             "malformed BalanceLoad request") from None
+        for current in self._updates(name, ctx):
             yield json.dumps({"servers": current}).encode()
 
+    def _balance_load_v1(self, request_iterator, ctx):
+        """The stock grpc.lb.v1 wire (tpurpc.rpc.lb_v1): initial_response
+        first, then a ServerList per change — what a stock grpclb client
+        expects from its balancer."""
+        from tpurpc.rpc import lb_v1
+
+        first = next(iter(request_iterator), None)
+        if first is None:
+            return
+        try:
+            name = lb_v1.decode_request(first)
+        except ValueError:  # malformed protobuf, not a handler bug
+            name = None
+        if name is None:
+            from tpurpc.rpc.status import AbortError, StatusCode
+
+            raise AbortError(StatusCode.INVALID_ARGUMENT,
+                             "BalanceLoad stream must open with "
+                             "initial_request") from None
+        yield lb_v1.encode_initial_response()
+        for current in self._updates(name, ctx):
+            yield lb_v1.encode_server_list(current)
+
     def attach(self, server) -> None:
+        """Registers BOTH wires: the tpurpc-native JSON method and the
+        standard grpc.lb.v1 protobuf method."""
+        from tpurpc.rpc import lb_v1
         from tpurpc.rpc.server import stream_stream_rpc_method_handler
 
         server.add_method(METHOD,
                           stream_stream_rpc_method_handler(self._balance_load))
+        server.add_method(
+            lb_v1.METHOD,
+            stream_stream_rpc_method_handler(self._balance_load_v1))
 
 
 class LookasideWatcher:
     """The client control loop: subscribe, apply updates, fall back."""
 
     def __init__(self, channel, balancer_target: str, name: str,
-                 fallback_timeout: float = 10.0):
+                 fallback_timeout: float = 10.0, wire: str = "tpurpc"):
+        if wire not in ("tpurpc", "grpclb"):
+            raise ValueError(f"unknown look-aside wire {wire!r} "
+                             "(tpurpc | grpclb)")
+        self._wire = wire
         if getattr(channel, "_addrs", None) is None:
             # fail fast: endpoint_factory channels have fixed membership;
             # discovering this on the first ServerList would kill the
@@ -123,8 +163,15 @@ class LookasideWatcher:
                 with Channel(self._balancer_target,
                              connect_timeout=self._fallback_timeout) as bch:
                     self._bch = bch  # stop() closes it to unblock the recv
-                    stream = bch.stream_stream(METHOD)
-                    sub = json.dumps({"name": self._name}).encode()
+                    if self._wire == "grpclb":
+                        from tpurpc.rpc import lb_v1
+
+                        method = lb_v1.METHOD
+                        sub = lb_v1.encode_initial_request(self._name)
+                    else:
+                        method = METHOD
+                        sub = json.dumps({"name": self._name}).encode()
+                    stream = bch.stream_stream(method)
 
                     def reqs():
                         yield sub
@@ -136,11 +183,25 @@ class LookasideWatcher:
                     for msg in stream(reqs(), timeout=None):
                         if self._stop.is_set():
                             return
-                        try:
-                            servers = json.loads(
-                                bytes(msg).decode()).get("servers")
-                        except ValueError:
-                            servers = None
+                        if self._wire == "grpclb":
+                            from tpurpc.rpc import lb_v1
+
+                            try:
+                                kind, servers = lb_v1.decode_response(msg)
+                            except ValueError:
+                                # one bad message must not tear the stream
+                                # down (the JSON path skips these too)
+                                trace_lb.log("undecodable LoadBalanceResponse"
+                                             " skipped")
+                                continue
+                            if kind in ("initial", "fallback", "unknown"):
+                                continue
+                        else:
+                            try:
+                                servers = json.loads(
+                                    bytes(msg).decode()).get("servers")
+                            except ValueError:
+                                servers = None
                         if not servers:
                             trace_lb.log("ignoring malformed/empty "
                                          "ServerList update")
@@ -181,8 +242,11 @@ class LookasideWatcher:
 
 
 def enable_lookaside(channel, balancer_target: str, name: str,
-                     fallback_timeout: float = 10.0) -> LookasideWatcher:
+                     fallback_timeout: float = 10.0,
+                     wire: str = "tpurpc") -> LookasideWatcher:
     """Attach a grpclb-style watcher to ``channel``; returns the watcher
-    (call ``stop()`` before closing the channel)."""
+    (call ``stop()`` before closing the channel). ``wire="grpclb"``
+    speaks the standard grpc.lb.v1 protobuf stream (stock balancers);
+    the default speaks the tpurpc-native JSON protocol."""
     return LookasideWatcher(channel, balancer_target, name,
-                            fallback_timeout)
+                            fallback_timeout, wire)
