@@ -56,6 +56,19 @@ def initialize_cluster(coordinator: Optional[str] = None,
             and (num_processes is None or num_processes == 1)):
         _initialized = True  # single-process: nothing to join
         return 0
+    # Cross-process collectives on the CPU backend need an explicit
+    # implementation (on TPU the ICI/DCN fabric is implicit) — and the
+    # CPU backend is in play whenever JAX_PLATFORMS is unset (default
+    # fallback), "cpu", or lists cpu, so set it for every multi-process
+    # join: the knob only affects the CPU client and is harmless on TPU.
+    # TPURPC_CPU_COLLECTIVES selects the implementation (gloo | mpi).
+    # Must run before the first backend touch. (CI exercises this with
+    # no TPU pod: tests/test_distributed.py.)
+    impl = os.environ.get("TPURPC_CPU_COLLECTIVES", "gloo")
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", impl)
+    except (AttributeError, ValueError):  # older jax without the knob
+        pass
     if autodetect and coordinator is None:
         jax.distributed.initialize()  # cluster env (GKE/Cloud TPU) fills in
     else:
