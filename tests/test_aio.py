@@ -289,9 +289,12 @@ def test_aio_native_channel():
     async def main():
         async with aio.NativeChannel("127.0.0.1", port) as ch:
             echo = ch.unary_unary("/a.S/Echo")
-            outs = await asyncio.gather(*[echo(b"m%d" % i, timeout=10)
-                                          for i in range(8)])
-            assert outs == [b"m%d?" % i for i in range(8)]
+            # 64 concurrent coroutines = 64 calls genuinely in flight on
+            # one connection via the CQ (far beyond any executor width —
+            # the old thread-per-call face couldn't express this)
+            outs = await asyncio.gather(*[echo(b"m%d" % i, timeout=30)
+                                          for i in range(64)])
+            assert outs == [b"m%d?" % i for i in range(64)]
             assert await ch.ping() < 5
 
     asyncio.run(main())

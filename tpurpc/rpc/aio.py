@@ -486,9 +486,13 @@ def secure_channel(target: str, credentials, **kw) -> Channel:
 
 class NativeChannel:
     """grpc.aio-shaped wrapper over :class:`tpurpc.rpc.native_client.
-    NativeChannel`: awaitable unary calls whose blocking halves run inside
-    libtpurpc.so on executor threads (the async face of the ctypes fast
-    path; GRPC_PLATFORM_TYPE is honored inside the .so)."""
+    NativeChannel`: unary calls submit through the channel's completion
+    queue and await the completion — N coroutines = N calls in flight on
+    one connection with ONE puller thread, no executor thread per call
+    (the async face of the ctypes fast path; GRPC_PLATFORM_TYPE is
+    honored inside the .so). The executor is used only for close/ping
+    and for calls with a non-identity serializer (serialization stays
+    off the event loop)."""
 
     def __init__(self, host: str, port: int, connect_timeout: float = 10.0):
         from tpurpc.rpc.native_client import NativeChannel as _Sync
@@ -516,8 +520,20 @@ class NativeChannel:
                                     response_deserializer)
 
         async def call(request, timeout=None):
-            loop = asyncio.get_running_loop()
-            return await loop.run_in_executor(
-                None, lambda: mc(request, timeout=timeout))
+            # Submit through the channel's completion queue and await the
+            # wrapped Future: N coroutines = N calls in flight on ONE
+            # connection with one puller thread — no executor thread per
+            # call. A heavy (non-identity) serializer runs inside the
+            # submit, so that case offloads to the executor rather than
+            # stall every in-flight coroutine on the loop thread; bare
+            # bytes submit inline (a small buffered write that can block
+            # only under transport backpressure).
+            if request_serializer is _identity:
+                fut = mc.future(request, timeout=timeout)
+            else:
+                loop = asyncio.get_running_loop()
+                fut = await loop.run_in_executor(
+                    None, lambda: mc.future(request, timeout=timeout))
+            return await asyncio.wrap_future(fut)
 
         return call
