@@ -57,6 +57,11 @@ __all__ += ["RetryPolicy"]
 __all__ += ["H2Channel"]
 
 
+# "aio" stays OUT of __all__: star imports must not pay the asyncio
+# import on the sync path (grpcio likewise keeps aio out of `import *`).
+__all__ += ["ChannelConnectivity"]
+
+
 def __getattr__(name):
     if name == "H2Channel":
         from tpurpc.wire.h2_client import H2Channel
@@ -66,6 +71,16 @@ def __getattr__(name):
         from tpurpc.rpc.native_client import NativeChannel
 
         return NativeChannel
+    if name == "aio":
+        # lazy like grpc.aio: `import tpurpc.rpc as grpc; grpc.aio...`
+        # works without paying the asyncio import on the sync path
+        import tpurpc.rpc.aio as aio
+
+        return aio
+    if name == "ChannelConnectivity":
+        from tpurpc.rpc.status import ChannelConnectivity
+
+        return ChannelConnectivity
     raise AttributeError(f"module 'tpurpc.rpc' has no attribute {name!r}")
 
 from tpurpc.rpc.channel import secure_channel  # noqa: E402

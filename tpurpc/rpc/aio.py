@@ -516,24 +516,29 @@ class NativeChannel:
 
     def unary_unary(self, method: str, request_serializer=_identity,
                     response_deserializer=_identity):
-        mc = self._sync.unary_unary(method, request_serializer,
-                                    response_deserializer)
+        # Raw-bytes multicallable: the response deserializer must NOT run
+        # on the channel's single CQ puller thread (it would serialize all
+        # in-flight completions behind each decode) — it runs per-call
+        # below, off-loop when non-trivial.
+        mc = self._sync.unary_unary(method, request_serializer, None)
 
         async def call(request, timeout=None):
             # Submit through the channel's completion queue and await the
             # wrapped Future: N coroutines = N calls in flight on ONE
             # connection with one puller thread — no executor thread per
-            # call. A heavy (non-identity) serializer runs inside the
-            # submit, so that case offloads to the executor rather than
-            # stall every in-flight coroutine on the loop thread; bare
-            # bytes submit inline (a small buffered write that can block
-            # only under transport backpressure).
+            # call. Heavy codecs run on the executor so neither the event
+            # loop (serializer) nor the puller (deserializer) stalls;
+            # bare-bytes calls never touch the executor at all.
+            loop = asyncio.get_running_loop()
             if request_serializer is _identity:
                 fut = mc.future(request, timeout=timeout)
             else:
-                loop = asyncio.get_running_loop()
                 fut = await loop.run_in_executor(
                     None, lambda: mc.future(request, timeout=timeout))
-            return await asyncio.wrap_future(fut)
+            body = await asyncio.wrap_future(fut)
+            if response_deserializer is _identity:
+                return body
+            return await loop.run_in_executor(
+                None, response_deserializer, body)
 
         return call

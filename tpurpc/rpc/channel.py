@@ -25,8 +25,9 @@ from typing import (Callable, Iterable, Iterator, List, Optional, Sequence,
 
 from tpurpc.core.endpoint import Endpoint, EndpointError, connect_endpoint
 from tpurpc.rpc import frame as fr
-from tpurpc.rpc.status import (Deserializer, Metadata, RpcError, Serializer,
-                               StatusCode, deserialize as _deserialize,
+from tpurpc.rpc.status import (ChannelConnectivity, Deserializer, Metadata,
+                               RpcError, Serializer, StatusCode,
+                               deserialize as _deserialize,
                                identity_codec as _identity)
 from tpurpc.utils.trace import TraceFlag
 
@@ -491,6 +492,7 @@ class Channel:
         self._policy = make_policy(lb_policy, len(self._subchannels))
         self._lock = threading.Lock()  # guards _closed
         self._closed = False
+        self._kicker: Optional[threading.Thread] = None  # get_state dialer
         from tpurpc.rpc import channelz as _channelz
 
         #: channelz ChannelData counters (started/succeeded/failed)
@@ -608,6 +610,54 @@ class Channel:
     def _is_closed(self) -> bool:
         with self._lock:
             return self._closed
+
+    def get_state(self, try_to_connect: bool = False):
+        """grpcio's ``Channel.get_state``: the channel-level connectivity
+        summary (connectivity_state.h semantics folded over subchannels).
+
+        READY if any subchannel holds a live connection; CONNECTING while
+        a kicked dial is in flight; TRANSIENT_FAILURE if none are live but
+        some subchannel is in connect backoff; else IDLE.
+        ``try_to_connect=True`` on an idle channel kicks ONE background
+        dial sweep over the subchannels (the way grpcio's flag kicks the
+        channel, not a fixed address) — repeated polls while it runs keep
+        reporting CONNECTING instead of stacking threads."""
+        CC = ChannelConnectivity
+        with self._lock:
+            if self._closed:
+                return CC.SHUTDOWN
+        now = time.monotonic()
+        backing_off = False
+        for sc in self._subchannels:
+            with sc._lock:
+                conn = sc._conn
+                if conn is not None and conn.alive and not conn.draining:
+                    return CC.READY
+                if sc._next_attempt > now:
+                    backing_off = True
+        with self._lock:
+            kicker = self._kicker
+            if kicker is not None and kicker.is_alive():
+                return CC.CONNECTING  # one dial sweep at a time
+            if try_to_connect and self._subchannels:
+                self._kicker = threading.Thread(
+                    target=self._kick_connect, daemon=True,
+                    name="tpurpc-try-connect")
+                self._kicker.start()
+                return CC.CONNECTING
+        return CC.TRANSIENT_FAILURE if backing_off else CC.IDLE
+
+    def _kick_connect(self) -> None:
+        # Dial every subchannel until one answers: a dead first address
+        # must not mask a live second one (the LB policy would reach it).
+        for sc in self._subchannels:
+            if self._is_closed():
+                return
+            try:
+                sc.get()
+                return
+            except RpcError:
+                continue  # backoff state answers TRANSIENT_FAILURE
 
     def close(self) -> None:
         with self._lock:

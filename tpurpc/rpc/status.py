@@ -59,6 +59,19 @@ class StatusCode(enum.IntEnum):
     UNAUTHENTICATED = 16
 
 
+class ChannelConnectivity(enum.Enum):
+    """grpc.ChannelConnectivity analog (connectivity_state.h states).
+
+    Surfaced by :meth:`tpurpc.rpc.channel.Channel.get_state`; the mapping
+    from subchannel reality is documented there."""
+
+    IDLE = 0
+    CONNECTING = 1
+    READY = 2
+    TRANSIENT_FAILURE = 3
+    SHUTDOWN = 4
+
+
 class RpcError(Exception):
     """Raised on the client when a call terminates with a non-OK status."""
 
