@@ -92,6 +92,27 @@ def test_try_to_connect_kicks_idle_channel():
         srv.stop(grace=0)
 
 
+def test_channel_ready_future_and_wait_for_state_change():
+    srv = grpc.server(max_workers=2)
+    srv.add_method("/d.S/Echo",
+                   grpc.unary_unary_rpc_method_handler(lambda r, c: bytes(r)))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    CC = grpc.ChannelConnectivity
+    try:
+        with grpc.Channel(f"127.0.0.1:{port}") as ch:
+            grpc.channel_ready_future(ch).result(timeout=15)  # grpcio idiom
+            assert ch.get_state() is CC.READY
+        # closed channel: the future must fail, not spin forever
+        ch2 = grpc.Channel(f"127.0.0.1:{port}")
+        assert ch2.wait_for_state_change(CC.READY, timeout=0.2) is True
+        ch2.close()
+        with pytest.raises(grpc.RpcError):
+            grpc.channel_ready_future(ch2).result(timeout=15)
+    finally:
+        srv.stop(grace=0)
+
+
 def test_aio_attribute_lazy():
     assert hasattr(grpc, "aio")
     assert hasattr(grpc.aio, "insecure_channel")

@@ -11,8 +11,10 @@ grpcio-shaped public surface so application code ports mechanically:
     srv.add_insecure_port("0.0.0.0:50051"); srv.start()
 """
 
-from tpurpc.rpc.status import AbortError, Metadata, RpcError, StatusCode
-from tpurpc.rpc.channel import Channel, insecure_channel
+from tpurpc.rpc.status import (AbortError, ChannelConnectivity, Metadata,
+                               RpcError, StatusCode)
+from tpurpc.rpc.channel import (Channel, channel_ready_future,
+                                insecure_channel)
 from tpurpc.rpc.server import (
     Server,
     ServerContext,
@@ -28,7 +30,7 @@ from tpurpc.rpc.server import (
 
 __all__ = [
     "AbortError", "Metadata", "RpcError", "StatusCode",
-    "Channel", "insecure_channel",
+    "Channel", "channel_ready_future", "insecure_channel",
     "Server", "ServerContext", "RpcMethodHandler", "server", "inproc_channel",
     "method_handlers_generic_handler",
     "unary_unary_rpc_method_handler", "unary_stream_rpc_method_handler",
@@ -77,10 +79,6 @@ def __getattr__(name):
         import tpurpc.rpc.aio as aio
 
         return aio
-    if name == "ChannelConnectivity":
-        from tpurpc.rpc.status import ChannelConnectivity
-
-        return ChannelConnectivity
     raise AttributeError(f"module 'tpurpc.rpc' has no attribute {name!r}")
 
 from tpurpc.rpc.channel import secure_channel  # noqa: E402

@@ -659,6 +659,18 @@ class Channel:
             except RpcError:
                 continue  # backoff state answers TRANSIENT_FAILURE
 
+    def wait_for_state_change(self, last_observed_state,
+                              timeout: Optional[float] = None) -> bool:
+        """Block until ``get_state()`` differs from ``last_observed_state``
+        (grpcio's experimental channel-watch shape, polled — this channel
+        has no state-subscription machinery to hook)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self.get_state() == last_observed_state:
+            if deadline is not None and time.monotonic() >= deadline:
+                return False
+            time.sleep(0.02)
+        return True
+
     def close(self) -> None:
         with self._lock:
             self._closed = True
@@ -1213,6 +1225,42 @@ class StreamStream(_MultiCallable):
             daemon=True)
         sender.start()
         return call
+
+
+def channel_ready_future(channel: "Channel"):
+    """grpc.channel_ready_future analog: a Future resolving (with None)
+    once the channel reports READY; get_state(try_to_connect=True) drives
+    the dial. Cancel the future to stop waiting early — an abandoned,
+    uncancelled future keeps watching only while the channel object stays
+    alive (the watcher holds a weakref, so it can't pin the Channel from
+    GC or outlive a dropped one)."""
+    import concurrent.futures
+    import weakref
+
+    fut: "concurrent.futures.Future" = concurrent.futures.Future()
+    chref = weakref.ref(channel)
+
+    def watch():
+        while not fut.cancelled():
+            ch = chref()
+            if ch is None:
+                return  # channel was dropped; nobody can ever see READY
+            state = ch.get_state(try_to_connect=True)
+            del ch  # don't pin the channel across the sleep
+            if state is ChannelConnectivity.READY:
+                if fut.set_running_or_notify_cancel():
+                    fut.set_result(None)
+                return
+            if state is ChannelConnectivity.SHUTDOWN:
+                if fut.set_running_or_notify_cancel():
+                    fut.set_exception(
+                        RpcError(StatusCode.UNAVAILABLE, "channel closed"))
+                return
+            time.sleep(0.02)
+
+    threading.Thread(target=watch, daemon=True,
+                     name="tpurpc-channel-ready").start()
+    return fut
 
 
 def insecure_channel(target: str, **kwargs) -> Channel:
