@@ -160,6 +160,44 @@ def test_native_futures_closed_while_inflight():
         srv.stop(grace=0)
 
 
+def test_native_futures_survive_server_death():
+    """Chaos: the server dies with a batch of futures in flight — every
+    future must resolve (UNAVAILABLE or a late success), none may hang,
+    and a fresh channel to a new server works."""
+    srv = rpc.Server(max_workers=4)
+    srv.add_method("/n.S/Echo",
+                   rpc.unary_unary_rpc_method_handler(lambda r, c: bytes(r)))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    ch = NativeChannel("127.0.0.1", port)
+    try:
+        echo = ch.unary_unary("/n.S/Echo")
+        futs = [echo.future(b"x" * 512, timeout=20) for _ in range(32)]
+        srv.stop(grace=0)  # yank the server mid-batch
+        import concurrent.futures as cf
+        done, not_done = cf.wait(futs, timeout=45)
+        assert not not_done, f"{len(not_done)} futures hung"
+        for f in done:
+            try:
+                f.result()  # ok or RpcError both fine; anything else raises
+            except RpcError:
+                pass
+    finally:
+        ch.close()
+        srv.stop(grace=0)
+    # the world keeps turning: a new server + channel round-trips
+    srv2 = rpc.Server(max_workers=2)
+    srv2.add_method("/n.S/Echo",
+                    rpc.unary_unary_rpc_method_handler(lambda r, c: bytes(r)))
+    port2 = srv2.add_insecure_port("127.0.0.1:0")
+    srv2.start()
+    try:
+        with NativeChannel("127.0.0.1", port2) as ch2:
+            assert ch2.unary_unary("/n.S/Echo")(b"hi", timeout=10) == b"hi"
+    finally:
+        srv2.stop(grace=0)
+
+
 def test_native_channel_over_ring_platform():
     """The whole point: a PYTHON process on the native loop gets the ring
     data plane by env alone (GRPC_PLATFORM_TYPE honored inside the .so)."""
