@@ -200,6 +200,40 @@ def test_tcpw_domain_mismatch_rejected():
     assert any("domain mismatch" in e for e in errs)
 
 
+def _run_cross_process(server_src: str, client_src: str, env: dict,
+                       client_timeout: float = 120) -> None:
+    """Spawn the server script, read its port with a bounded wait, run the
+    client script against it, kill the server. One copy of the hazards:
+    readline can't hang the suite (selector-bounded), a bad first line
+    kills the child BEFORE draining stderr (so the read sees EOF), and the
+    child is killed in finally."""
+    import selectors
+
+    srv = subprocess.Popen([sys.executable, "-c", server_src],
+                           stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                           text=True, env=env)
+    try:
+        sel = selectors.DefaultSelector()
+        sel.register(srv.stdout, selectors.EVENT_READ)
+        if not sel.select(timeout=120):
+            srv.kill()
+            raise AssertionError("server never printed its port: "
+                                 + srv.stderr.read()[:2000])
+        port = srv.stdout.readline().strip()
+        if not port.isdigit():
+            srv.kill()
+            raise AssertionError(f"bad port line {port!r}: "
+                                 + srv.stderr.read()[:2000])
+        cli = subprocess.run([sys.executable, "-c", client_src, port],
+                             capture_output=True, text=True, env=env,
+                             timeout=client_timeout)
+        assert cli.returncode == 0, cli.stderr
+        assert "CLIENT_OK" in cli.stdout
+    finally:
+        srv.kill()
+        srv.wait()
+
+
 _RPC_SERVER = r"""
 import sys
 import tpurpc.rpc as rpc
@@ -238,20 +272,7 @@ def test_tcpw_full_rpc_cross_process():
                GRPC_PLATFORM_TYPE="RDMA_BP",
                TPURPC_RING_DOMAIN="tcp_window",
                GRPC_RDMA_RING_BUFFER_SIZE_KB="256")
-    srv = subprocess.Popen([sys.executable, "-c", _RPC_SERVER],
-                           stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-                           text=True, env=env)
-    try:
-        port = srv.stdout.readline().strip()
-        assert port.isdigit(), srv.stderr.read()
-        cli = subprocess.run([sys.executable, "-c", _RPC_CLIENT, port],
-                             capture_output=True, text=True, env=env,
-                             timeout=120)
-        assert cli.returncode == 0, cli.stderr
-        assert "CLIENT_OK" in cli.stdout
-    finally:
-        srv.kill()
-        srv.wait()
+    _run_cross_process(_RPC_SERVER, _RPC_CLIENT, env)
 
 
 def test_tcpw_qps_scenario():
@@ -276,3 +297,60 @@ def test_tcpw_qps_scenario():
     assert out.returncode == 0, out.stderr
     stats = __import__("json").loads(out.stdout.strip().splitlines()[-1])
     assert stats["rpcs"] > 20 and stats["rate"] > 0
+
+
+_TPU_TCPW_SERVER = r"""
+import os, sys
+import numpy as np
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import tpurpc.rpc as rpc
+from tpurpc.jaxshim import add_tensor_method
+from tpurpc.utils.config import get_config
+
+assert get_config().ring_domain == "tcp_window", get_config().ring_domain
+seen = {}
+
+def fn(tree):
+    import jax
+    seen["ok"] = isinstance(tree["x"], jax.Array)
+    return {"y": np.asarray(tree["x"]) * 3, "ring": np.int64(seen["ok"])}
+
+srv = rpc.Server(max_workers=4)
+add_tensor_method(srv, "Call", fn, device=True)
+port = srv.add_insecure_port("127.0.0.1:0")
+srv.start()
+print(port, flush=True)
+srv.wait_for_termination(timeout=120)  # orphan self-reaps if pytest dies
+"""
+
+_TPU_TCPW_CLIENT = r"""
+import os, sys
+import numpy as np
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from tpurpc.jaxshim import TensorClient
+from tpurpc.rpc.channel import Channel
+from tpurpc.utils.config import get_config
+
+assert get_config().ring_domain == "tcp_window"
+x = np.arange(2048, dtype=np.float32).reshape(64, 32)
+with Channel(f"127.0.0.1:{sys.argv[1]}") as ch:
+    out = TensorClient(ch).call("Call", {"x": x}, timeout=60)
+np.testing.assert_array_equal(np.asarray(out["y"]), x * 3)
+assert int(np.asarray(out["ring"]).ravel()[0]) == 1  # device-ring-backed
+print("CLIENT_OK", flush=True)
+"""
+
+
+def test_tpu_platform_over_tcpw_cross_process():
+    """The north-star topology composed: GRPC_PLATFORM_TYPE=TPU (payloads
+    land in the receiver's DEVICE ring, handler gets lease-backed
+    jax.Arrays) x TPURPC_RING_DOMAIN=tcp_window (the one-sided ring carried
+    between PROCESSES standing in for hosts). Tensor bytes from another
+    process land in the device ring purely by env selection."""
+    env = dict(os.environ,
+               GRPC_PLATFORM_TYPE="TPU",
+               TPURPC_RING_DOMAIN="tcp_window",
+               GRPC_RDMA_RING_BUFFER_SIZE_KB="1024",
+               JAX_PLATFORMS="cpu")  # conftest already stripped the tunnel var
+    _run_cross_process(_TPU_TCPW_SERVER, _TPU_TCPW_CLIENT, env,
+                       client_timeout=240)
