@@ -113,6 +113,93 @@ def test_channel_ready_future_and_wait_for_state_change():
         srv.stop(grace=0)
 
 
+def test_wait_for_ready_queues_until_server_appears():
+    """grpcio's per-call wait_for_ready=True: a call issued while the
+    target is down QUEUES (keeps dialing) and completes once the server
+    appears, instead of failing fast — on a port chosen before any server
+    exists."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    ch = grpc.Channel(f"127.0.0.1:{port}")
+    try:
+        # default fail-fast still fails fast while the target is down
+        with pytest.raises(grpc.RpcError) as ei:
+            ch.unary_unary("/d.S/Echo")(b"x", timeout=5)
+        assert ei.value.code() is grpc.StatusCode.UNAVAILABLE
+
+        srv_box = {}
+
+        def start_late():
+            time.sleep(0.8)
+            srv = grpc.server(max_workers=2)
+            srv.add_method("/d.S/Echo", grpc.unary_unary_rpc_method_handler(
+                lambda r, c: bytes(r) + b"!"))
+            srv.add_insecure_port(f"127.0.0.1:{port}")
+            srv.start()
+            srv_box["srv"] = srv
+
+        t = threading.Thread(target=start_late, daemon=True)
+        t.start()
+        out = ch.unary_unary("/d.S/Echo")(b"hi", timeout=30,
+                                          wait_for_ready=True)
+        assert out == b"hi!"
+        t.join()
+        # and the deadline still binds when the server never comes:
+        ch2 = grpc.Channel("127.0.0.1:1")  # reserved port, nothing there
+        t0 = time.monotonic()
+        with pytest.raises(grpc.RpcError) as ei:
+            ch2.unary_unary("/x/Y")(b"", timeout=1.5, wait_for_ready=True)
+        assert ei.value.code() is grpc.StatusCode.DEADLINE_EXCEEDED
+        assert time.monotonic() - t0 < 10
+        ch2.close()
+    finally:
+        ch.close()
+        if "srv" in srv_box:
+            srv_box["srv"].stop(grace=0)
+
+
+def test_wait_for_ready_queue_time_counts_against_deadline():
+    """Time spent queuing for readiness is part of the call's budget: a
+    2.5s-timeout call that waits ~1.2s for the server and then hits a
+    2s handler must DEADLINE_EXCEEDED — under the old post-dial re-anchor
+    it would have been given a fresh 2.5s and succeeded."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    srv_box = {}
+
+    def start_late():
+        time.sleep(1.2)
+        srv = grpc.server(max_workers=2)
+        srv.add_method("/d.S/Slow", grpc.unary_unary_rpc_method_handler(
+            lambda r, c: time.sleep(2.0) or b"late"))
+        srv.add_insecure_port(f"127.0.0.1:{port}")
+        srv.start()
+        srv_box["srv"] = srv
+
+    t = threading.Thread(target=start_late, daemon=True)
+    t.start()
+    try:
+        with grpc.Channel(f"127.0.0.1:{port}") as ch:
+            t0 = time.monotonic()
+            with pytest.raises(grpc.RpcError) as ei:
+                ch.unary_unary("/d.S/Slow")(b"", timeout=2.5,
+                                            wait_for_ready=True)
+            assert ei.value.code() is grpc.StatusCode.DEADLINE_EXCEEDED
+            # and it fired near the ORIGINAL deadline, not a re-anchored one
+            assert time.monotonic() - t0 < 4.0
+    finally:
+        t.join()
+        if "srv" in srv_box:
+            srv_box["srv"].stop(grace=0)
+
+
 def test_aio_attribute_lazy():
     assert hasattr(grpc, "aio")
     assert hasattr(grpc.aio, "insecure_channel")

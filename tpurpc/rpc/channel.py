@@ -909,9 +909,40 @@ class _MultiCallable:
         self._ser = serializer
         self._deser = deserializer
 
+    def _dial(self, wait_for_ready: bool,
+              deadline: Optional[float]) -> _Connection:
+        """One LB-picked connection. With ``wait_for_ready`` (the grpcio
+        per-call flag), a channel in TRANSIENT_FAILURE QUEUES the call —
+        keep redialing until the deadline — instead of failing it fast
+        (gRPC's wait-for-ready semantics; fail-fast is the default)."""
+        if not wait_for_ready:
+            return self._channel._connection()
+        while True:
+            try:
+                return self._channel._connection()
+            except RpcError as exc:
+                if (self._channel._is_closed()
+                        or _status_of(exc) is not StatusCode.UNAVAILABLE):
+                    raise
+                if (deadline is not None
+                        and time.monotonic() >= deadline):
+                    raise RpcError(
+                        StatusCode.DEADLINE_EXCEEDED,
+                        "deadline exceeded waiting for channel readiness",
+                    ) from exc
+                # Subchannel.get already sleeps through its backoff window;
+                # this small sleep only paces the no-deadline case. Known
+                # bound: the deadline is checked BETWEEN attempts, so one
+                # in-flight connect to a blackholed (SYN-dropped) address
+                # can overshoot by up to the channel connect_timeout — the
+                # dial itself is not interruptible.
+                time.sleep(0.05)
+
     def _start(self, metadata: Optional[Metadata],
                timeout: Optional[float],
-               first_request=_NO_REQUEST) -> Tuple[_Connection, _ClientStream, Call]:
+               first_request=_NO_REQUEST,
+               wait_for_ready: bool = False,
+               ) -> Tuple[_Connection, _ClientStream, Call]:
         """Open a stream and send HEADERS — fused with the first (only)
         MESSAGE when the request is known upfront, so a unary call costs one
         transport write/notify instead of two.
@@ -921,8 +952,13 @@ class _MultiCallable:
         gRPC's "transparent retry" for streams the application never saw on
         the wire; without it every age expiry has a window of spurious
         UNAVAILABLE."""
+        # ONE deadline for the whole call, anchored before the dial: time
+        # spent queuing in wait_for_ready counts against the caller's
+        # timeout (grpcio semantics) — re-anchoring after the dial would
+        # let a late-appearing server nearly double the budget.
+        deadline = None if timeout is None else time.monotonic() + timeout
         for _ in range(3):
-            conn = self._channel._connection()
+            conn = self._dial(wait_for_ready, deadline)
             try:
                 st = conn.open_stream()
                 break
@@ -934,8 +970,10 @@ class _MultiCallable:
             raise RpcError(StatusCode.UNAVAILABLE,
                            "no non-draining connection after 3 dials")
         try:
-            deadline = None if timeout is None else time.monotonic() + timeout
-            timeout_us = None if timeout is None else max(0, int(timeout * 1e6))
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+            timeout_us = (None if remaining is None
+                          else max(0, int(remaining * 1e6)))
             hdr_payload = fr.headers_payload(self._method, metadata or (),
                                              timeout_us)
             if first_request is _NO_REQUEST:
@@ -995,7 +1033,8 @@ class _MultiCallable:
 
 def _reject_call_credentials(grpcio_kw: dict) -> None:
     """grpcio callers may pass credentials/wait_for_ready/compression per
-    call. wait_for_ready/compression are advisory — ignored; per-call
+    call. wait_for_ready is honored (queue instead of fail-fast, see
+    _MultiCallable._dial); compression is advisory — ignored; per-call
     CREDENTIALS are a security feature we must not silently drop."""
     if grpcio_kw.get("credentials") is not None:
         raise NotImplementedError(
@@ -1006,7 +1045,8 @@ class UnaryUnary(_MultiCallable):
     def __call__(self, request, timeout: Optional[float] = None,
                  metadata: Optional[Metadata] = None, **grpcio_kw):
         _reject_call_credentials(grpcio_kw)
-        response, _ = self.with_call(request, timeout=timeout, metadata=metadata)
+        response, _ = self.with_call(request, timeout=timeout,
+                                     metadata=metadata, **grpcio_kw)
         return response
 
     def with_call(self, request, timeout: Optional[float] = None,
@@ -1037,9 +1077,11 @@ class UnaryUnary(_MultiCallable):
                 return (None if deadline is None
                         else max(0.0, deadline - time.monotonic()))
 
+            wfr = bool(grpcio_kw.get("wait_for_ready"))
             for _ in range(3):
                 try:
-                    return self._call_once(request, remaining(), metadata)
+                    return self._call_once(request, remaining(), metadata,
+                                           wfr)
                 except RpcError as exc:
                     refused = (_status_of(exc) is StatusCode.UNAVAILABLE
                                and "connection draining" in exc.details()
@@ -1047,15 +1089,16 @@ class UnaryUnary(_MultiCallable):
                                                False))
                     if not refused:
                         raise
-            return self._call_once(request, remaining(), metadata)
+            return self._call_once(request, remaining(), metadata, wfr)
 
         if policy is None:
             return attempt()
         return policy.run(deadline, attempt)
 
     def _call_once(self, request, timeout: Optional[float],
-                   metadata: Optional[Metadata]):
-        conn, st, call = self._start(metadata, timeout, first_request=request)
+                   metadata: Optional[Metadata], wait_for_ready: bool = False):
+        conn, st, call = self._start(metadata, timeout, first_request=request,
+                                     wait_for_ready=wait_for_ready)
         response = None
         got = False
         try:
@@ -1114,7 +1157,7 @@ class _RetryingStreamCall:
     further replays."""
 
     def __init__(self, mc: "UnaryStream", request, timeout, metadata,
-                 policy: "RetryPolicy"):
+                 policy: "RetryPolicy", wait_for_ready: bool = False):
         self._inner: Optional[Call] = None  # first: __getattr__ recursion guard
         self._mc = mc
         self._request = request
@@ -1122,6 +1165,7 @@ class _RetryingStreamCall:
                           else time.monotonic() + timeout)
         self._metadata = metadata
         self._policy = policy
+        self._wait_for_ready = wait_for_ready
         self._attempt = 0
         self._backoff = policy.initial_backoff
         self._cancelled = False
@@ -1148,7 +1192,8 @@ class _RetryingStreamCall:
                 remaining = (None if self._deadline is None
                              else max(0.0, self._deadline - time.monotonic()))
                 _, _, self._inner = self._mc._start(
-                    self._metadata, remaining, first_request=self._request)
+                    self._metadata, remaining, first_request=self._request,
+                    wait_for_ready=self._wait_for_ready)
                 return
             except RpcError as exc:
                 self._handle_failure(exc, committed=False)
@@ -1185,10 +1230,12 @@ class UnaryStream(_MultiCallable):
         _reject_call_credentials(grpcio_kw)
         policy = self._channel.retry_policy
         if policy is None:
-            conn, st, call = self._start(metadata, timeout,
-                                         first_request=request)
+            conn, st, call = self._start(
+                metadata, timeout, first_request=request,
+                wait_for_ready=bool(grpcio_kw.get("wait_for_ready")))
             return call
-        return _RetryingStreamCall(self, request, timeout, metadata, policy)
+        return _RetryingStreamCall(self, request, timeout, metadata, policy,
+                                   bool(grpcio_kw.get("wait_for_ready")))
 
 
 class StreamUnary(_MultiCallable):
@@ -1196,7 +1243,9 @@ class StreamUnary(_MultiCallable):
                  timeout: Optional[float] = None,
                  metadata: Optional[Metadata] = None, **grpcio_kw):
         _reject_call_credentials(grpcio_kw)
-        conn, st, call = self._start(metadata, timeout)
+        conn, st, call = self._start(
+            metadata, timeout,
+            wait_for_ready=bool(grpcio_kw.get("wait_for_ready")))
         sender = threading.Thread(
             target=self._send_stream, args=(conn, st, request_iterator, call),
             daemon=True)
@@ -1219,7 +1268,9 @@ class StreamStream(_MultiCallable):
                  timeout: Optional[float] = None,
                  metadata: Optional[Metadata] = None, **grpcio_kw) -> Call:
         _reject_call_credentials(grpcio_kw)
-        conn, st, call = self._start(metadata, timeout)
+        conn, st, call = self._start(
+            metadata, timeout,
+            wait_for_ready=bool(grpcio_kw.get("wait_for_ready")))
         sender = threading.Thread(
             target=self._send_stream, args=(conn, st, request_iterator, call),
             daemon=True)
