@@ -40,7 +40,18 @@ enum {
   TPR_UNAVAILABLE = 14
 };
 
-/* Connect a channel. timeout_ms bounds the TCP connect. NULL on failure. */
+/* Connect a channel. timeout_ms bounds the TCP connect. NULL on failure.
+ *
+ * TPURPC_NATIVE_INLINE_READ=1 (ring platforms only): the lowest-latency
+ * blocking discipline — no reader thread; the thread waiting in recv/
+ * finish/ping pumps the transport itself (the reference's pollset_work
+ * model), saving a thread wakeup per round trip. Deadlines are enforced
+ * at frame boundaries. CQ async ops need the reader thread and return
+ * NULL on such channels. Trade-off: with NO call in flight nothing reads
+ * the transport, so an idle inline channel does not answer server
+ * keepalive PINGs or observe GOAWAY until the next call — pair it with
+ * call-per-connection or always-busy usage, not server-side keepalive
+ * reaping. */
 tpr_channel *tpr_channel_create(const char *host, int port, int timeout_ms);
 void tpr_channel_destroy(tpr_channel *ch);
 

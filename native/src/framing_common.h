@@ -148,11 +148,13 @@ inline bool ring_send_frame_locked(R &ring, uint8_t type, uint8_t flags,
   return ring.write_gather(hdr.data(), hdr.size(), payload, len);
 }
 
+// Parse a 10-byte frame header and read the payload — shared by the
+// blocking and deadline-bounded frame readers so the header layout and
+// the sanity bound live in exactly one place.
 template <typename T>
-inline bool t_read_frame(T &t, uint8_t *type, uint8_t *flags, uint32_t *sid,
-                         std::vector<uint8_t> *payload) {
-  uint8_t hdr[10];
-  if (!t.read_exact(hdr, sizeof hdr)) return false;
+inline bool t_finish_frame(T &t, const uint8_t hdr[10], uint8_t *type,
+                           uint8_t *flags, uint32_t *sid,
+                           std::vector<uint8_t> *payload) {
   *type = hdr[0];
   *flags = hdr[1];
   *sid = get_u32(hdr + 2);
@@ -160,6 +162,14 @@ inline bool t_read_frame(T &t, uint8_t *type, uint8_t *flags, uint32_t *sid,
   if (len > kMaxFramePayload + 65536) return false;
   payload->resize(len);
   return len == 0 || t.read_exact(payload->data(), len);
+}
+
+template <typename T>
+inline bool t_read_frame(T &t, uint8_t *type, uint8_t *flags, uint32_t *sid,
+                         std::vector<uint8_t> *payload) {
+  uint8_t hdr[10];
+  if (!t.read_exact(hdr, sizeof hdr)) return false;
+  return t_finish_frame(t, hdr, type, flags, sid, payload);
 }
 
 // GRPC_PLATFORM_TYPE dispatch for native apps (iomgr_internal.cc:36-61

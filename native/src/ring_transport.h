@@ -359,22 +359,43 @@ struct RingTransport {
   }
 
   bool read_exact(void *buf, size_t len) {
+    return read_exact_deadline(buf, len, nullptr) == 1;
+  }
+
+  // Deadline-aware read for the inline-pump discipline: 1 = filled,
+  // -1 = dead, 0 = deadline passed with ZERO bytes consumed — the stream
+  // is intact, so a frame-header read can be abandoned cleanly at a frame
+  // boundary. Once any byte is consumed the deadline is ignored (the unit
+  // must complete; peers write whole frames as one ring message on the
+  // hot path, so the remainder is already in the ring).
+  int read_exact_deadline(
+      void *buf, size_t len,
+      const std::chrono::steady_clock::time_point *deadline) {
     uint8_t *p = static_cast<uint8_t *>(buf);
+    const size_t want = len;
     while (len > 0) {
       uint64_t got = tpr_ring_read_into(recv_ring.base, ring_size, &head,
                                         &msg_len, &msg_read, p, len,
                                         &consumed, &rseq);
-      if (got == ~0ULL) return false;  // corruption
+      if (got == ~0ULL) return -1;  // corruption
       p += got;
       len -= got;
       publish_credits_if_due();
       if (len == 0) break;
-      if (!alive.load()) return false;
-      if (ring_empty_and_peer_gone()) return false;  // clean EOF
+      if (!alive.load()) return -1;
+      if (ring_empty_and_peer_gone()) return -1;  // clean EOF
+      int wait_ms = 100;
+      if (deadline != nullptr && len == want) {
+        auto now = std::chrono::steady_clock::now();
+        if (now >= *deadline) return 0;
+        auto rem = std::chrono::duration_cast<std::chrono::milliseconds>(
+                       *deadline - now).count();
+        if (rem < wait_ms) wait_ms = rem < 1 ? 1 : static_cast<int>(rem);
+      }
       if (spin_for_message()) continue;  // BP/BPEV: data landed mid-spin
-      wait_event(100);
+      wait_event(wait_ms);
     }
-    return true;
+    return 1;
   }
 
   // Bounded busy-poll on the ring's header word (the BP/BPEV hot loop).

@@ -189,6 +189,8 @@ struct tpr_channel {
   std::atomic<bool> alive{true};
   uint64_t pong_count = 0;
   std::thread reader;
+  bool inline_read = false;  // no reader thread; waiters pump (ring only)
+  bool pumping = false;      // a thread is inside the transport (mu)
 
   ~tpr_channel() {
     alive.store(false);
@@ -244,75 +246,141 @@ struct tpr_channel {
     cv.notify_all();
   }
 
+  // Dispatch one frame. Returns 0 when the connection should end (last
+  // in-flight call on a GOAWAY'd connection), else 1. Called with mu NOT
+  // held (takes it itself), from the reader thread or an inline pumper.
+  int process_frame(uint8_t type, uint8_t flags, uint32_t sid,
+                    std::vector<uint8_t> &payload) {
+    size_t len = payload.size();
+
+    if (type == kPing) {
+      send_frame(kPong, 0, 0, payload.data(), payload.size());
+      return 1;
+    }
+    if (type == kPong) {
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        pong_count++;
+      }
+      cv.notify_all();
+      return 1;
+    }
+    if (type == kGoaway) {
+      // Graceful drain (server max_connection_age): stop admitting new
+      // calls but keep reading so in-flight calls finish; the connection
+      // dies when the last one completes (below) or at socket EOF.
+      std::lock_guard<std::mutex> lk(mu);
+      draining = true;
+      return streams.empty() ? 0 : 1;
+    }
+
+    CqDeliveries cq_evs;
+    std::unique_lock<std::mutex> lk(mu);
+    auto it = streams.find(sid);
+    if (it == streams.end()) return 1;  // late frame for a finished call
+    Call &c = it->second->c;
+    if (type == kMessage) {
+      if (!(flags & kFlagNoMessage))
+        c.partial.append(reinterpret_cast<char *>(payload.data()), len);
+      if (!(flags & kFlagMore) && !(flags & kFlagNoMessage)) {
+        c.messages.push_back(std::move(c.partial));
+        c.partial.clear();
+      }
+      if (flags & kFlagEndStream) {
+        // server half-closed without trailers: tolerate, finish as OK
+        c.trailers_seen = true;
+        c.status_code = TPR_OK;
+        streams.erase(it);
+      }
+    } else if (type == kHeaders) {
+      // initial metadata: stored nowhere yet (API exposes trailers only)
+    } else if (type == kTrailers || type == kRst) {
+      std::vector<std::pair<std::string, std::string>> md;
+      decode_metadata(payload.data(), len, &md);
+      c.status_code = TPR_UNKNOWN;
+      for (auto &kv : md) {
+        if (kv.first == ":status") c.status_code = atoi(kv.second.c_str());
+        else if (kv.first == ":message") c.status_details = kv.second;
+      }
+      c.trailers_seen = true;
+      streams.erase(it);
+    }
+    drain_cq_locked(c, &cq_evs);
+    cq_push(&cq_evs);  // under mu: keeps cq ordering = generation ordering
+    bool drained = draining && streams.empty();
+    lk.unlock();
+    cv.notify_all();
+    return drained ? 0 : 1;
+  }
+
   void read_loop() {
     std::vector<uint8_t> payload;
     uint8_t type, flags;
     uint32_t sid;
     while (alive.load()) {
       if (!t_read_frame(*this, &type, &flags, &sid, &payload)) break;
-      size_t len = payload.size();
-
-      if (type == kPing) {
-        send_frame(kPong, 0, 0, payload.data(), payload.size());
-        continue;
-      }
-      if (type == kPong) {
-        {
-          std::lock_guard<std::mutex> lk(mu);
-          pong_count++;
-        }
-        cv.notify_all();
-        continue;
-      }
-      if (type == kGoaway) {
-        // Graceful drain (server max_connection_age): stop admitting new
-        // calls but keep reading so in-flight calls finish; the connection
-        // dies when the last one completes (below) or at socket EOF.
-        std::lock_guard<std::mutex> lk(mu);
-        draining = true;
-        if (streams.empty()) break;
-        continue;
-      }
-
-      CqDeliveries cq_evs;
-      std::unique_lock<std::mutex> lk(mu);
-      auto it = streams.find(sid);
-      if (it == streams.end()) continue;  // late frame for a finished call
-      Call &c = it->second->c;
-      if (type == kMessage) {
-        if (!(flags & kFlagNoMessage))
-          c.partial.append(reinterpret_cast<char *>(payload.data()), len);
-        if (!(flags & kFlagMore) && !(flags & kFlagNoMessage)) {
-          c.messages.push_back(std::move(c.partial));
-          c.partial.clear();
-        }
-        if (flags & kFlagEndStream) {
-          // server half-closed without trailers: tolerate, finish as OK
-          c.trailers_seen = true;
-          c.status_code = TPR_OK;
-          streams.erase(it);
-        }
-      } else if (type == kHeaders) {
-        // initial metadata: stored nowhere yet (API exposes trailers only)
-      } else if (type == kTrailers || type == kRst) {
-        std::vector<std::pair<std::string, std::string>> md;
-        decode_metadata(payload.data(), len, &md);
-        c.status_code = TPR_UNKNOWN;
-        for (auto &kv : md) {
-          if (kv.first == ":status") c.status_code = atoi(kv.second.c_str());
-          else if (kv.first == ":message") c.status_details = kv.second;
-        }
-        c.trailers_seen = true;
-        streams.erase(it);
-      }
-      drain_cq_locked(c, &cq_evs);
-      cq_push(&cq_evs);  // under mu: keeps cq ordering = generation ordering
-      bool drained = draining && streams.empty();
-      lk.unlock();
-      cv.notify_all();
-      if (drained) break;  // last in-flight call on a GOAWAY'd connection
+      if (process_frame(type, flags, sid, payload) == 0) break;
     }
     die();
+  }
+
+  // One frame read whose HEADER wait is bounded by `dl` (frame-boundary
+  // abandon; ring only). 1 = frame delivered, 0 = deadline, -1 = dead.
+  int read_frame_dl(const Clock::time_point *dl, uint8_t *type,
+                    uint8_t *flags, uint32_t *sid,
+                    std::vector<uint8_t> *payload) {
+    uint8_t hdr[10];
+    int r = ring->read_exact_deadline(hdr, sizeof hdr, dl);
+    if (r <= 0) return r;
+    return t_finish_frame(*ring, hdr, type, flags, sid, payload) ? 1 : -1;
+  }
+
+  // Inline-read discipline (TPURPC_NATIVE_INLINE_READ=1, ring platforms):
+  // the WAITING thread pumps the transport itself — the reference's
+  // pollset_work model (grpc_completion_queue_next → pollable_epoll,
+  // SURVEY §3.4) — eliminating the reader→caller thread wakeup from every
+  // round trip. One pumper at a time; others park on cv and inherit the
+  // pump when it is released. Returns false only when `dl` passed without
+  // pred becoming true.
+  template <typename Pred>
+  bool pump_until(std::unique_lock<std::mutex> &lk, Pred pred,
+                  const Clock::time_point *dl) {
+    std::vector<uint8_t> payload;
+    uint8_t type, flags;
+    uint32_t sid;
+    while (!pred()) {
+      if (!alive.load()) return true;  // terminal state; caller decodes it
+      // Own-deadline check BEFORE (re)taking the pump: a pumper servicing
+      // another stream's continuous traffic never hits the header-wait
+      // timeout inside read_exact_deadline, so without this check its
+      // deadline could be starved for as long as frames keep arriving.
+      if (dl != nullptr && Clock::now() >= *dl) return false;
+      if (pumping) {
+        // another thread is inside the transport; wait for its dispatch
+        if (dl != nullptr) {
+          if (cv.wait_until(lk, *dl) == std::cv_status::timeout && !pred())
+            return false;
+        } else {
+          cv.wait(lk);
+        }
+        continue;
+      }
+      pumping = true;
+      lk.unlock();
+      int r = read_frame_dl(dl, &type, &flags, &sid, &payload);
+      int cont = (r == 1) ? process_frame(type, flags, sid, payload) : 1;
+      lk.lock();
+      pumping = false;
+      cv.notify_all();  // deliver wakeups + hand off the pump
+      if (r < 0 || cont == 0) {
+        lk.unlock();
+        die();
+        lk.lock();
+      } else if (r == 0 && !pred()) {
+        return false;  // own deadline hit at a frame boundary
+      }
+    }
+    return true;
   }
 };
 
@@ -458,7 +526,14 @@ tpr_channel *tpr_channel_create(const char *host, int port, int timeout_ms) {
     delete ch;
     return nullptr;
   }
-  ch->reader = std::thread([ch] { ch->read_loop(); });
+  // Inline-read (opt-in, ring platforms): the lowest-latency blocking
+  // discipline — callers pump the transport themselves, no reader thread.
+  // CQ async ops need the reader and refuse on such channels.
+  const char *inl = getenv("TPURPC_NATIVE_INLINE_READ");
+  ch->inline_read = ch->ring != nullptr && inl != nullptr &&
+                    inl[0] == '1';
+  if (!ch->inline_read)
+    ch->reader = std::thread([ch] { ch->read_loop(); });
   return ch;
 }
 
@@ -473,9 +548,14 @@ int64_t tpr_channel_ping(tpr_channel *ch, int timeout_ms) {
   auto t0 = Clock::now();
   if (!ch->send_frame(kPing, 0, 0, "p", 1)) return -1;
   std::unique_lock<std::mutex> lk(ch->mu);
-  bool ok = ch->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), [&] {
-    return ch->pong_count > before || !ch->alive.load();
-  });
+  auto pred = [&] { return ch->pong_count > before || !ch->alive.load(); };
+  bool ok;
+  if (ch->inline_read) {
+    auto dl = t0 + std::chrono::milliseconds(timeout_ms);
+    ok = ch->pump_until(lk, pred, &dl);
+  } else {
+    ok = ch->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), pred);
+  }
   if (!ok || ch->pong_count <= before) return -1;
   return std::chrono::duration_cast<std::chrono::microseconds>(Clock::now() - t0)
       .count();
@@ -601,6 +681,9 @@ static bool wait_event(tpr_call *c, std::unique_lock<std::mutex> &lk) {
   auto ready = [&] {
     return !c->c.messages.empty() || c->c.trailers_seen || !ch->alive.load();
   };
+  if (ch->inline_read)
+    return ch->pump_until(lk, ready,
+                          c->c.has_deadline ? &c->c.deadline : nullptr);
   if (c->c.has_deadline)
     return ch->cv.wait_until(lk, c->c.deadline, ready);
   ch->cv.wait(lk, ready);
@@ -837,6 +920,7 @@ int tpr_cq_next(tpr_cq *cq, tpr_event *ev, int timeout_ms) {
 tpr_call *tpr_call_start_cq(tpr_channel *ch, const char *method,
                             const char *const *metadata, size_t n_md,
                             int timeout_ms, tpr_cq *cq) {
+  if (ch->inline_read) return nullptr;  // CQ needs the reader thread
   {
     std::lock_guard<std::mutex> lk(cq->mu);
     if (cq->shut) return nullptr;
@@ -903,6 +987,7 @@ int tpr_call_finish_cq(tpr_call *c, void *tag) {
 tpr_call *tpr_unary_call_cq(tpr_channel *ch, const char *method,
                             const uint8_t *req, size_t req_len,
                             int timeout_ms, tpr_cq *cq, void *tag) {
+  if (ch->inline_read) return nullptr;  // CQ needs the reader thread
   {
     std::lock_guard<std::mutex> lk(cq->mu);
     if (cq->shut) return nullptr;

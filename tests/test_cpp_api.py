@@ -158,6 +158,100 @@ int main() {{
         srv.stop(grace=0)
 
 
+def test_cpp_client_inline_read_ring(monkeypatch):
+    """TPURPC_NATIVE_INLINE_READ=1: no reader thread — callers pump the
+    ring themselves (the reference's pollset_work discipline). The full
+    example battery must behave identically; measured win:
+    5.4us p50 streaming vs 7.2 with the reader thread (micro_native)."""
+    monkeypatch.setenv("GRPC_PLATFORM_TYPE", "RDMA_BP")
+    _build_example()
+    srv = _server()
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    try:
+        env = dict(os.environ, GRPC_PLATFORM_TYPE="RDMA_BP",
+                   TPURPC_NATIVE_INLINE_READ="1")
+        proc = subprocess.run([BIN, str(port)], capture_output=True,
+                              text=True, timeout=120, env=env)
+        assert proc.returncode == 0, (proc.stdout, proc.stderr)
+        _check(proc.stdout)
+    finally:
+        srv.stop(grace=0)
+
+
+def test_cpp_inline_read_deadline_and_threads(monkeypatch):
+    """Inline mode corner cases: a deadline against a silent server must
+    fire at a frame boundary (the pumping thread abandons the header
+    wait), and two app threads sharing one inline channel must hand the
+    pump off correctly under concurrent calls."""
+    monkeypatch.setenv("GRPC_PLATFORM_TYPE", "RDMA_BP")
+    srv = rpc.Server(max_workers=4)
+    release = threading.Event()
+    srv.add_method("/demo.Greeter/Hang", rpc.unary_unary_rpc_method_handler(
+        lambda r, c: release.wait(30) or b"late"))
+    srv.add_method("/demo.Greeter/Echo",
+                   rpc.unary_unary_rpc_method_handler(lambda r, c: bytes(r)))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    src = r"""
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include "tpurpc/client.h"
+int main(int argc, char **argv) {
+  tpr_channel *ch = tpr_channel_create("127.0.0.1", atoi(argv[1]), 5000);
+  if (!ch) return 2;
+  // 1. deadline with the pump blocked on a silent server
+  uint8_t *resp; size_t rlen; char det[256];
+  int st = tpr_unary_call(ch, "/demo.Greeter/Hang", nullptr, 0,
+                          &resp, &rlen, det, sizeof det, 400);
+  printf("deadline_status=%d\n", st);
+  // 2. two threads, concurrent unary calls on ONE inline channel
+  int bad = 0;
+  auto worker = [&](int base) {
+    for (int i = 0; i < 200; i++) {
+      std::string req = "t" + std::to_string(base + i);
+      uint8_t *r2; size_t l2;
+      int s2 = tpr_unary_call(ch, "/demo.Greeter/Echo",
+                              (const uint8_t *)req.data(), req.size(),
+                              &r2, &l2, nullptr, 0, 10000);
+      if (s2 != TPR_OK || l2 != req.size() ||
+          memcmp(r2, req.data(), l2) != 0) { bad++; }
+      if (s2 == TPR_OK) tpr_buf_free(r2);
+    }
+  };
+  std::thread a(worker, 0), b(worker, 1000);
+  a.join(); b.join();
+  printf("threads_bad=%d\n", bad);
+  tpr_channel_destroy(ch);
+  return (st == TPR_DEADLINE_EXCEEDED && bad == 0) ? 0 : 1;
+}
+"""
+    tmp_src = os.path.join(ROOT, "native", "build", "inline_test.cc")
+    tmp_bin = os.path.join(ROOT, "native", "build", "inline_test")
+    with open(tmp_src, "w") as f:
+        f.write(src)
+    try:
+        subprocess.run(
+            ["g++", "-std=c++17", "-O2", tmp_src,
+             os.path.join(ROOT, "native", "src", "tpurpc_client.cc"),
+             os.path.join(ROOT, "native", "src", "ring.cc"),
+             "-I", os.path.join(ROOT, "native", "include"),
+             "-lpthread", "-o", tmp_bin],
+            check=True, timeout=180, capture_output=True)
+        env = dict(os.environ, GRPC_PLATFORM_TYPE="RDMA_BP",
+                   TPURPC_NATIVE_INLINE_READ="1")
+        proc = subprocess.run([tmp_bin, str(port)], capture_output=True,
+                              text=True, timeout=120, env=env)
+        assert proc.returncode == 0, (proc.stdout, proc.stderr)
+        assert "deadline_status=4" in proc.stdout
+        assert "threads_bad=0" in proc.stdout
+    finally:
+        release.set()
+        srv.stop(grace=0)
+
+
 # -- completion-queue async client -------------------------------------------
 
 ASYNC_BIN = os.path.join(ROOT, "native", "build", "cpp_async_example")
