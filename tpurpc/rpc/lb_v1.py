@@ -63,10 +63,46 @@ def decode_request(buf) -> Optional[str]:
     return None
 
 
-def encode_initial_response() -> bytes:
-    """LoadBalanceResponse{initial_response{}} — sent once at stream start
-    (no client-stats interval: we don't request load reports)."""
-    return ld(1, b"")
+def encode_initial_response(report_interval_s: float = 0.0) -> bytes:
+    """LoadBalanceResponse{initial_response{...}} — sent once at stream
+    start. ``report_interval_s > 0`` asks the client to stream ClientStats
+    on that cadence (field 2, a google.protobuf.Duration)."""
+    inner = b""
+    if report_interval_s > 0:
+        secs = int(report_interval_s)
+        nanos = int(round((report_interval_s - secs) * 1e9))
+        if nanos >= 1_000_000_000:  # round() carry: Duration caps nanos
+            secs += 1
+            nanos -= 1_000_000_000
+        inner = ld(2, vf(1, secs) + vf(2, nanos))
+    return ld(1, inner)
+
+
+def encode_client_stats(started: int, finished: int,
+                        known_received: int) -> bytes:
+    """LoadBalanceRequest{client_stats} — the load report a grpclb client
+    streams back when the balancer requested an interval. Counts are
+    DELTAS since the previous report (grpclb accounting)."""
+    return ld(2, vf(2, started) + vf(3, finished) + vf(7, known_received))
+
+
+def decode_client_stats(buf) -> Optional[dict]:
+    """Returns {"started", "finished", "known_received"} for a
+    client_stats request, else None (initial_request / unknown)."""
+    for fno, wt, val in fields(bytes(buf)):
+        if fno == 2 and wt == 2:
+            out = {"started": 0, "finished": 0, "known_received": 0}
+            for sfno, swt, sval in fields(val):
+                if swt != 0:
+                    continue
+                if sfno == 2:
+                    out["started"] = sval
+                elif sfno == 3:
+                    out["finished"] = sval
+                elif sfno == 7:
+                    out["known_received"] = sval
+            return out
+    return None
 
 
 def encode_server_list(addrs: Sequence[str]) -> bytes:
@@ -93,12 +129,22 @@ def encode_server_list(addrs: Sequence[str]) -> bytes:
     return ld(2, servers)
 
 
-def decode_response(buf) -> Tuple[str, Optional[List[str]]]:
-    """Returns ("initial", None), ("server_list", ["ip:port", ...]),
-    ("fallback", None), or ("unknown", None)."""
+def decode_response(buf) -> Tuple[str, object]:
+    """Returns ("initial", report_interval_seconds), ("server_list",
+    ["ip:port", ...]), ("fallback", None), or ("unknown", None)."""
     for fno, wt, val in fields(bytes(buf)):
         if fno == 1 and wt == 2:
-            return "initial", None
+            interval = 0.0
+            for ifno, iwt, ival in fields(val):
+                if ifno == 2 and iwt == 2:  # Duration{seconds=1, nanos=2}
+                    secs = nanos = 0
+                    for dfno, dwt, dval in fields(ival):
+                        if dfno == 1 and dwt == 0:
+                            secs = dval
+                        elif dfno == 2 and dwt == 0:
+                            nanos = dval
+                    interval = secs + nanos / 1e9
+            return "initial", interval
         if fno == 3 and wt == 2:
             return "fallback", None
         if fno == 2 and wt == 2:
@@ -129,4 +175,4 @@ def decode_response(buf) -> Tuple[str, Optional[List[str]]]:
 
 __all__ = ["SERVICE", "METHOD", "encode_initial_request", "decode_request",
            "encode_initial_response", "encode_server_list",
-           "decode_response"]
+           "decode_response", "encode_client_stats", "decode_client_stats"]

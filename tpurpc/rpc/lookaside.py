@@ -49,10 +49,27 @@ class LoadBalancerServicer:
     stream; each stream immediately receives the current list on
     subscribe (grpclb's initial ServerList)."""
 
-    def __init__(self):
+    def __init__(self, stats_interval_s: float = 0.0):
         self._lock = threading.Condition()
         self._lists: Dict[str, List[str]] = {}
         self._epoch = 0
+        #: >0 asks grpc.lb.v1 subscribers to stream ClientStats load
+        #: reports on this cadence (the grpclb load-reporting loop)
+        self._stats_interval_s = stats_interval_s
+        self._client_stats: Dict[str, Dict[str, int]] = {}
+
+    def stats(self, name: str) -> Dict[str, int]:
+        """Accumulated ClientStats deltas reported by grpc.lb.v1
+        subscribers of ``name`` (empty until a report arrives)."""
+        with self._lock:
+            return dict(self._client_stats.get(name, {}))
+
+    def _record_stats(self, name: str, report: Dict[str, int]) -> None:
+        with self._lock:
+            acc = self._client_stats.setdefault(
+                name, {"started": 0, "finished": 0, "known_received": 0})
+            for key, val in report.items():
+                acc[key] = acc.get(key, 0) + val
 
     def set_servers(self, name: str, addrs: Sequence[str]) -> None:
         with self._lock:
@@ -92,11 +109,14 @@ class LoadBalancerServicer:
 
     def _balance_load_v1(self, request_iterator, ctx):
         """The stock grpc.lb.v1 wire (tpurpc.rpc.lb_v1): initial_response
-        first, then a ServerList per change — what a stock grpclb client
-        expects from its balancer."""
+        first (optionally requesting ClientStats reports), then a
+        ServerList per change — what a stock grpclb client expects from
+        its balancer. Incoming ClientStats are drained on a side thread
+        (the update loop must not block on a quiet client)."""
         from tpurpc.rpc import lb_v1
 
-        first = next(iter(request_iterator), None)
+        it = iter(request_iterator)
+        first = next(it, None)
         if first is None:
             return
         try:
@@ -109,7 +129,19 @@ class LoadBalancerServicer:
             raise AbortError(StatusCode.INVALID_ARGUMENT,
                              "BalanceLoad stream must open with "
                              "initial_request") from None
-        yield lb_v1.encode_initial_response()
+
+        def drain_reports():
+            for msg in it:
+                try:
+                    report = lb_v1.decode_client_stats(msg)
+                except ValueError:
+                    continue
+                if report:
+                    self._record_stats(name, report)
+
+        threading.Thread(target=drain_reports, daemon=True,
+                         name="tpurpc-lb-stats").start()
+        yield lb_v1.encode_initial_response(self._stats_interval_s)
         for current in self._updates(name, ctx):
             yield lb_v1.encode_server_list(current)
 
@@ -172,12 +204,41 @@ class LookasideWatcher:
                         method = METHOD
                         sub = json.dumps({"name": self._name}).encode()
                     stream = bch.stream_stream(method)
+                    self._stats_interval = 0.0  # set by initial_response
 
                     def reqs():
                         yield sub
-                        # hold the stream open until stop
-                        while not self._stop.wait(0.5):
-                            pass
+                        # hold the stream open until stop; on the grpclb
+                        # wire, stream ClientStats DELTAS whenever the
+                        # balancer's initial_response requested a cadence
+                        # (grpclb load reporting). Baseline from the
+                        # CURRENT counters: a reconnected stream must not
+                        # re-report the channel's lifetime totals.
+                        cc = self._channel.call_counters
+                        last = (cc.started, cc.succeeded + cc.failed,
+                                cc.succeeded)
+                        next_report: Optional[float] = None
+                        while not self._stop.wait(0.2):
+                            interval = self._stats_interval
+                            if self._wire != "grpclb" or interval <= 0:
+                                continue
+                            now = time.monotonic()
+                            if next_report is None:
+                                next_report = now + interval
+                            if now < next_report:
+                                continue
+                            next_report = now + interval
+                            from tpurpc.rpc import lb_v1
+
+                            cur = (cc.started, cc.succeeded + cc.failed,
+                                   cc.succeeded)
+                            delta = tuple(c - l for c, l in zip(cur, last))
+                            last = cur
+                            # known_received = SUCCEEDED only: failed calls
+                            # never reached a server and must read as loss
+                            # to a balancer computing finished - received
+                            yield lb_v1.encode_client_stats(
+                                delta[0], delta[1], delta[2])
                         return
 
                     for msg in stream(reqs(), timeout=None):
@@ -194,7 +255,10 @@ class LookasideWatcher:
                                 trace_lb.log("undecodable LoadBalanceResponse"
                                              " skipped")
                                 continue
-                            if kind in ("initial", "fallback", "unknown"):
+                            if kind == "initial":
+                                self._stats_interval = float(servers or 0.0)
+                                continue
+                            if kind in ("fallback", "unknown"):
                                 continue
                         else:
                             try:
