@@ -1,0 +1,53 @@
+#!/bin/bash
+# Regenerate the committed bench/results tables in one command (run on an
+# OTHERWISE IDLE host — concurrent load inflates the tail latencies and
+# the logs don't carry a load disclaimer). Usage:
+#   bash bench/regen_results.sh            # native micro sweep
+#   bash bench/regen_results.sh python     # + the (slow) python-path sweep
+set -euo pipefail
+cd "$(dirname "$0")/.."
+BIN=native/build/micro_native
+g++ -std=c++17 -O2 native/bench/micro_native.cc native/src/tpurpc_client.cc \
+    native/src/tpurpc_server.cc native/src/ring.cc -Inative/include \
+    -lpthread -o "$BIN"
+
+OUT=bench/results/micro_native_1core.log
+{
+  echo "# micro_native: native C client<->server closed-loop, $(nproc)-core host"
+  echo "# $(date -u +%FT%TZ) | format: reference examples/cpp/micro-bench log lines (SURVEY.md §6)"
+  echo "# reference (IB EDR, multicore): 7.01us p50 / 211K RPC/s streaming (BASELINE.md)"
+  for plat in TCP RDMA_BP; do
+    echo "#"
+    echo "# == platform=$plat =="
+    for size in 64 1024 65536; do
+      for streaming in 0 1; do
+        echo "## platform=$plat req_size=$size streaming=$streaming threads=1"
+        GRPC_PLATFORM_TYPE=$plat timeout 120 "$BIN" "$size" 4 1 "$streaming"
+      done
+    done
+  done
+  echo "#"
+  echo "# == CQ-pipelined async unary (outstanding>1) =="
+  for plat in TCP RDMA_BP; do
+    for out in 8 64; do
+      echo "## platform=$plat req_size=64 streaming=0 threads=1 outstanding=$out"
+      GRPC_PLATFORM_TYPE=$plat timeout 120 "$BIN" 64 4 1 0 1 "$out"
+    done
+  done
+  echo "#"
+  echo "# == inline-read discipline (TPURPC_NATIVE_INLINE_READ=1) =="
+  for size in 64 1024 65536; do
+    echo "## platform=RDMA_BP req_size=$size streaming=1 threads=1 inline_read=1"
+    GRPC_PLATFORM_TYPE=RDMA_BP TPURPC_NATIVE_INLINE_READ=1 \
+      timeout 120 "$BIN" "$size" 4 1 1
+  done
+} > "$OUT.tmp" && mv "$OUT.tmp" "$OUT"
+echo "wrote $OUT"
+
+if [ "${1:-}" = "python" ]; then
+  python -m tpurpc.bench.sweep \
+    > bench/results/sweep_python_1core.log
+  python -m tpurpc.bench.sweep --streaming \
+    > bench/results/sweep_python_streaming_1core.log
+  echo "wrote bench/results/sweep_python{,_streaming}_1core.log"
+fi
