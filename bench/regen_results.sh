@@ -45,9 +45,15 @@ OUT=bench/results/micro_native_1core.log
 echo "wrote $OUT"
 
 if [ "${1:-}" = "python" ]; then
+  # tmp-then-mv like the native section: an interrupted sweep must not
+  # truncate the committed logs
   python -m tpurpc.bench.sweep \
-    > bench/results/sweep_python_1core.log
+    > bench/results/sweep_python_1core.log.tmp \
+    && mv bench/results/sweep_python_1core.log.tmp \
+          bench/results/sweep_python_1core.log
   python -m tpurpc.bench.sweep --streaming \
-    > bench/results/sweep_python_streaming_1core.log
+    > bench/results/sweep_python_streaming_1core.log.tmp \
+    && mv bench/results/sweep_python_streaming_1core.log.tmp \
+          bench/results/sweep_python_streaming_1core.log
   echo "wrote bench/results/sweep_python{,_streaming}_1core.log"
 fi

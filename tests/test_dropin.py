@@ -200,6 +200,41 @@ def test_wait_for_ready_queue_time_counts_against_deadline():
             srv_box["srv"].stop(grace=0)
 
 
+def test_grpcio_constructor_shapes():
+    """The stock grpcio constructor calls run verbatim: an executor as the
+    first server() argument, options lists on both sides."""
+    from concurrent import futures as cf
+
+    class Greeter:
+        def SayHello(self, request, context):
+            return bytes(request) + b"!"
+
+    server = grpc.server(
+        cf.ThreadPoolExecutor(max_workers=6),
+        options=[("grpc.max_receive_message_length", 128),
+                 ("grpc.so_reuseport", 0)])  # unknown arg: ignored
+    assert server.max_receive_message_length == 128
+    server.add_generic_rpc_handlers((grpc.method_handlers_generic_handler(
+        "d.G", {"SayHello": grpc.unary_unary_rpc_method_handler(
+            Greeter().SayHello)}),))
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+    try:
+        ch = grpc.insecure_channel(
+            f"127.0.0.1:{port}",
+            options=[("grpc.max_receive_message_length", 64 << 20),
+                     ("grpc.lb_policy_name", "round_robin")])
+        assert ch.max_receive_message_length == 64 << 20
+        assert ch.unary_unary("/d.G/SayHello")(b"hi", timeout=10) == b"hi!"
+        # server-side limit from options enforced: >128B rejected
+        with pytest.raises(grpc.RpcError) as ei:
+            ch.unary_unary("/d.G/SayHello")(b"x" * 256, timeout=10)
+        assert ei.value.code() is grpc.StatusCode.RESOURCE_EXHAUSTED
+        ch.close()
+    finally:
+        server.stop(grace=0)
+
+
 def test_aio_attribute_lazy():
     assert hasattr(grpc, "aio")
     assert hasattr(grpc.aio, "insecure_channel")

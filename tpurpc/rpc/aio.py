@@ -359,8 +359,26 @@ class Server:
             None, lambda: self._sync.wait_for_termination(timeout=timeout))
 
 
-def server(max_workers: int = 32, **kw) -> Server:
-    return Server(max_workers=max_workers, **kw)
+def server(migration_thread_pool=None, handlers=None, interceptors=None,
+           options=None, maximum_concurrent_rpcs=None, compression=None, *,
+           max_workers: int = 32, **kw) -> Server:
+    """grpc.aio.server-shaped: the stock call (executor first, options
+    list, advisory kwargs) runs verbatim — same mapping as the sync
+    :func:`tpurpc.rpc.server.server`."""
+    if isinstance(migration_thread_pool, int):  # legacy server(N)
+        max_workers = migration_thread_pool
+    elif migration_thread_pool is not None:
+        workers = getattr(migration_thread_pool, "_max_workers", None)
+        if workers:
+            max_workers = workers
+    if options:
+        kw.setdefault("max_receive_message_length",
+                      dict(options).get("grpc.max_receive_message_length"))
+    srv = Server(max_workers=max_workers, **kw)
+    if handlers:
+        for gh in handlers:
+            srv.add_generic_rpc_handlers((gh,))
+    return srv
 
 
 # ---------------------------------------------------------------------------

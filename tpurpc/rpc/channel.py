@@ -464,7 +464,19 @@ class Channel:
                  lb_policy: "Union[str, dict]" = "pick_first",
                  credentials=None,
                  max_receive_message_length: Optional[int] = None,
-                 retry_policy: "Optional[RetryPolicy]" = None):
+                 retry_policy: "Optional[RetryPolicy]" = None,
+                 options=None):
+        # grpcio channel options: [("grpc.arg_name", value), ...]. The
+        # recognized args map onto this constructor's own parameters (an
+        # explicit parameter wins); unrecognized ones are ignored the way
+        # grpcio ignores unknown channel args.
+        if options:
+            opt = dict(options)
+            if max_receive_message_length is None:
+                max_receive_message_length = opt.get(
+                    "grpc.max_receive_message_length")
+            if lb_policy == "pick_first" and "grpc.lb_policy_name" in opt:
+                lb_policy = opt["grpc.lb_policy_name"]
         #: channel-level retry policy for unary-request calls (None = off,
         #: matching gRPC's default of retries disabled without service config)
         self.retry_policy = retry_policy

@@ -969,9 +969,32 @@ class Server:
         return self._stopped.wait(timeout)
 
 
-def server(max_workers: int = 32) -> Server:
-    """grpcio-shaped constructor (``grpc.server(ThreadPoolExecutor(...))``)."""
-    return Server(max_workers=max_workers)
+def server(thread_pool=None, handlers=None, interceptors=None, options=None,
+           maximum_concurrent_rpcs=None, compression=None, *,
+           max_workers: int = 32) -> Server:
+    """grpcio-shaped constructor — accepts the stock call
+    ``grpc.server(ThreadPoolExecutor(max_workers=N), options=[...])``
+    verbatim: a passed executor contributes its worker count (the Server
+    keeps its own pool), handlers/interceptors register directly, the
+    recognized channel-arg options map onto Server parameters, and the
+    remaining stock kwargs are accepted-and-advisory
+    (maximum_concurrent_rpcs — concurrency is bounded by the worker pool
+    and per-stream credits instead; compression is negotiated per wire).
+    A bare int first argument keeps the historical server(N) meaning."""
+    if isinstance(thread_pool, int):  # legacy positional max_workers
+        max_workers = thread_pool
+    elif thread_pool is not None:
+        workers = getattr(thread_pool, "_max_workers", None)
+        if workers:
+            max_workers = workers
+    max_recv = None
+    if options:
+        max_recv = dict(options).get("grpc.max_receive_message_length")
+    srv = Server(max_workers=max_workers, interceptors=interceptors or (),
+                 max_receive_message_length=max_recv)
+    if handlers:
+        srv.add_generic_rpc_handlers(handlers)
+    return srv
 
 
 def inproc_channel(srv: Server):
