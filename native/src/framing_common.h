@@ -22,7 +22,11 @@ namespace tpr_wire {
 constexpr uint8_t kHeaders = 1, kMessage = 2, kTrailers = 3, kRst = 4,
                   kPing = 5, kPong = 6, kGoaway = 7;
 constexpr uint8_t kFlagEndStream = 0x01, kFlagMore = 0x02,
-                  kFlagNoMessage = 0x04;
+                  kFlagNoMessage = 0x04,
+                  // gzip-compressed message (Python peers only): the native
+                  // loop does not link a decompressor, so receivers REJECT
+                  // the flag loudly instead of delivering garbled bytes
+                  kFlagCompressed = 0x08;
 constexpr size_t kMaxFramePayload = 1u << 20;
 // Unary requests at or below this ship HEADERS+MESSAGE as ONE buffered
 // write (one syscall / ring message); larger ones take the fragmenting

@@ -274,6 +274,11 @@ struct tpr_channel {
       return streams.empty() ? 0 : 1;
     }
 
+    if (type == kMessage && (flags & kFlagCompressed)) {
+      fprintf(stderr, "tpurpc: peer sent a compressed message; the native "
+                      "client does not decompress — closing\n");
+      return 0;  // loud protocol rejection, not garbled delivery
+    }
     CqDeliveries cq_evs;
     std::unique_lock<std::mutex> lk(mu);
     auto it = streams.find(sid);

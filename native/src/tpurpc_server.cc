@@ -247,6 +247,33 @@ struct tpr_server {
         continue;
       }
       // frame for an existing stream
+      if (type == kMessage && (flags & kFlagCompressed)) {
+        // loud protocol rejection: this loop has no decompressor, and
+        // delivering gzip bytes as the message would corrupt the app
+        std::unique_lock<std::mutex> lk(c->mu);
+        auto it = c->streams.find(sid);
+        if (it != c->streams.end()) {
+          tpr_server_call *call = it->second;
+          // Erase the stream NOW in both branches: a fragmented compressed
+          // message delivers kFlagCompressed on every fragment, and later
+          // fragments must fall into the finished/unknown drop instead of
+          // re-sending these trailers.
+          c->streams.erase(it);
+          if (call->inline_cb) {
+            lk.unlock();
+            c->send_trailers(sid, 12 /*UNIMPLEMENTED*/,
+                             "compressed messages unsupported here");
+            delete call;
+          } else {
+            call->cancelled = true;  // handler exits; run_handler frees
+            lk.unlock();
+            c->send_trailers(sid, 12 /*UNIMPLEMENTED*/,
+                             "compressed messages unsupported here");
+            c->cv.notify_all();
+          }
+        }
+        continue;
+      }
       std::unique_lock<std::mutex> lk(c->mu);
       auto it = c->streams.find(sid);
       if (it == c->streams.end()) continue;  // finished/unknown: drop

@@ -1,0 +1,178 @@
+"""Message compression on the tpurpc framing (FLAG_COMPRESSED).
+
+The h2 wire negotiates grpc-encoding with stock peers
+(test_grpc_compat/test_h2_client); this file covers the native framing's
+per-message gzip: channel-level opt-in, server-side mirror on responses,
+fragmentation of compressed payloads, and corrupt-payload handling."""
+
+import gzip
+
+import pytest
+
+import tpurpc.rpc as rpc
+from tpurpc.rpc import frame as fr
+from tpurpc.rpc.status import RpcError, StatusCode
+
+
+def _echo_server():
+    srv = rpc.Server(max_workers=4)
+    srv.add_method("/c.S/Echo",
+                   rpc.unary_unary_rpc_method_handler(lambda r, c: bytes(r)))
+
+    def dbl(req_iter, ctx):
+        for m in req_iter:
+            yield bytes(m) * 2
+
+    srv.add_method("/c.S/Dbl", rpc.stream_stream_rpc_method_handler(dbl))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    return srv, port
+
+
+def test_writer_compresses_flagged_messages():
+    """Unit: FLAG_COMPRESSED input → gzip payload on the wire, flag kept."""
+    wrote = []
+
+    class Ep:
+        def write(self, bufs):
+            wrote.append(b"".join(bytes(b) for b in bufs))
+
+    w = fr.FrameWriter(Ep())
+    body = b"A" * 4096  # compressible
+    w.send(fr.MESSAGE, fr.FLAG_COMPRESSED | fr.FLAG_END_STREAM, 1, body)
+    frame = wrote[0]
+    ftype, flags, sid, ln = fr.HEADER_FMT.unpack(frame[:fr.HEADER_FMT.size])
+    assert flags & fr.FLAG_COMPRESSED
+    payload = frame[fr.HEADER_FMT.size:]
+    assert len(payload) == ln < len(body)  # actually smaller on the wire
+    assert gzip.decompress(payload) == body
+    # control frames and unflagged messages are untouched
+    wrote.clear()
+    w.send(fr.MESSAGE, 0, 1, body)
+    assert wrote[0][fr.HEADER_FMT.size:] == body
+
+
+@pytest.mark.parametrize("spelling", ["gzip", 2])
+def test_compressed_unary_and_streaming_round_trip(spelling, monkeypatch):
+    """e2e with compression on: payloads survive, and the server MIRRORS
+    the encoding on responses (observed via the client-side decompress)."""
+    decompressions = []
+    real = fr.decompress_message
+    monkeypatch.setattr(
+        fr, "decompress_message",
+        lambda data, limit=None: decompressions.append(1) or real(data,
+                                                                  limit))
+    srv, port = _echo_server()
+    try:
+        with rpc.Channel(f"127.0.0.1:{port}", compression=spelling) as ch:
+            body = b"compressible " * 1000
+            assert ch.unary_unary("/c.S/Echo")(body, timeout=15) == body
+            assert decompressions, "response was not mirrored compressed"
+            out = list(ch.stream_stream("/c.S/Dbl")(
+                iter([b"a" * 100, b"b" * 100]), timeout=15))
+            assert out == [b"a" * 200, b"b" * 200]
+    finally:
+        srv.stop(grace=0)
+
+
+def test_compressed_large_message_fragments():
+    """A >1MiB compressed-but-still-large message crosses the frame bound:
+    compression happens before fragmentation, reassembly before gunzip."""
+    import os
+
+    srv, port = _echo_server()
+    try:
+        with rpc.Channel(f"127.0.0.1:{port}", compression="gzip") as ch:
+            body = os.urandom(3 << 20)  # incompressible: stays ~3MiB
+            assert ch.unary_unary("/c.S/Echo")(body, timeout=60) == body
+    finally:
+        srv.stop(grace=0)
+
+
+def test_corrupt_compressed_request_aborts_cleanly():
+    """A flagged message that does not gunzip fails THAT call with a clear
+    status; the connection survives for the next call."""
+    srv, port = _echo_server()
+    try:
+        with rpc.Channel(f"127.0.0.1:{port}") as ch:
+            conn = ch._connection()
+            st = conn.open_stream()
+            conn.writer.send(fr.HEADERS, 0, st.stream_id,
+                             fr.headers_payload("/c.S/Echo", (), None))
+            # forge FLAG_COMPRESSED garbage at the endpoint, bypassing the
+            # writer's gzip step
+            payload = b"\x00garbage-not-gzip\xff"
+            conn.writer._ep.write([fr.HEADER_FMT.pack(
+                fr.MESSAGE, fr.FLAG_END_STREAM | fr.FLAG_COMPRESSED,
+                st.stream_id, len(payload)), payload])
+            while True:  # that CALL fails with a decompression status...
+                ev = st.events.get(timeout=15)
+                if ev[0] == "trailers":
+                    assert ev[1] is StatusCode.INTERNAL
+                    assert "decompress" in ev[2]
+                    break
+            # ...and the CONNECTION survives for the next clean call
+            assert ch.unary_unary("/c.S/Echo")(b"ok", timeout=15) == b"ok"
+    finally:
+        srv.stop(grace=0)
+
+
+def test_unsupported_compression_rejected():
+    with pytest.raises(ValueError):
+        rpc.Channel("127.0.0.1:1", compression="deflate")
+
+
+def test_channel_options_compression():
+    """grpcio's grpc.default_compression_algorithm channel arg (2 = gzip)
+    turns framing compression on."""
+    srv, port = _echo_server()
+    try:
+        ch = rpc.insecure_channel(
+            f"127.0.0.1:{port}",
+            options=[("grpc.default_compression_algorithm", 2)])
+        assert ch._compress_flag == fr.FLAG_COMPRESSED
+        assert ch.unary_unary("/c.S/Echo")(b"z" * 512, timeout=15) == b"z" * 512
+        ch.close()
+    finally:
+        srv.stop(grace=0)
+
+
+def test_gzip_bomb_guard():
+    """The receive limit binds the POST-decompression size: a tiny gzip
+    of a huge message passes the wire-size check but must be rejected
+    RESOURCE_EXHAUSTED instead of inflating into memory."""
+    srv = rpc.Server(max_workers=2, max_receive_message_length=4096)
+    srv.add_method("/c.S/Echo",
+                   rpc.unary_unary_rpc_method_handler(lambda r, c: bytes(r)))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    try:
+        with rpc.Channel(f"127.0.0.1:{port}", compression="gzip") as ch:
+            bomb = b"\x00" * (32 << 20)  # 32 MiB of zeros -> ~32 KiB gzip
+            with pytest.raises(RpcError) as ei:
+                ch.unary_unary("/c.S/Echo")(bomb, timeout=30)
+            assert ei.value.code() is StatusCode.RESOURCE_EXHAUSTED
+            # connection survives for a clean call
+            assert ch.unary_unary("/c.S/Echo")(b"ok", timeout=15) == b"ok"
+    finally:
+        srv.stop(grace=0)
+
+
+def test_incompressible_payload_clears_flag(monkeypatch):
+    """Random bytes gzip LARGER: the writer sends them uncompressed with
+    the bit cleared (gRPC's compressed-flag rule), so the receiver never
+    decompresses."""
+    import os as _os
+
+    calls = []
+    real = fr.decompress_message
+    monkeypatch.setattr(fr, "decompress_message",
+                        lambda d, lim=None: calls.append(1) or real(d, lim))
+    srv, port = _echo_server()
+    try:
+        with rpc.Channel(f"127.0.0.1:{port}", compression="gzip") as ch:
+            body = _os.urandom(4096)
+            assert ch.unary_unary("/c.S/Echo")(body, timeout=15) == body
+        assert not calls  # nothing on either side actually decompressed
+    finally:
+        srv.stop(grace=0)

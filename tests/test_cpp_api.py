@@ -252,6 +252,30 @@ int main(int argc, char **argv) {
         srv.stop(grace=0)
 
 
+def test_native_server_rejects_compressed_messages(monkeypatch):
+    """A Python channel with framing compression on, against the NATIVE C++
+    server: the native loop links no decompressor, so it must answer
+    UNIMPLEMENTED loudly instead of delivering gzip bytes to the handler —
+    and the connection keeps serving uncompressed calls."""
+    monkeypatch.setenv("GRPC_PLATFORM_TYPE", "TCP")
+    _build_server_example()
+    proc = subprocess.Popen([SRV_BIN], stdout=subprocess.PIPE,
+                            stdin=subprocess.PIPE, text=True)
+    try:
+        port = int(proc.stdout.readline().split()[1])
+        with rpc.Channel(f"127.0.0.1:{port}", compression="gzip") as ch:
+            with pytest.raises(rpc.RpcError) as ei:
+                ch.unary_unary("/demo.Greeter/Echo")(b"x" * 256, timeout=15)
+            from tpurpc.rpc.status import StatusCode
+            assert ei.value.code() is StatusCode.UNIMPLEMENTED
+        with rpc.Channel(f"127.0.0.1:{port}") as ch2:  # plain channel works
+            assert ch2.unary_unary("/demo.Greeter/Echo")(b"ok",
+                                                         timeout=15) == b"ok"
+    finally:
+        proc.kill()
+        proc.wait()
+
+
 # -- completion-queue async client -------------------------------------------
 
 ASYNC_BIN = os.path.join(ROOT, "native", "build", "cpp_async_example")

@@ -62,7 +62,9 @@ class _ClientStream:
         except ValueError:
             pass
 
-    def commit_message(self, more: bool, oversized: bool = False) -> None:
+    def commit_message(self, more: bool, oversized: bool = False,
+                       compressed: bool = False,
+                       recv_limit: "Optional[int]" = None) -> None:
         if more:
             return
         if oversized:
@@ -74,7 +76,19 @@ class _ClientStream:
         # take() detaches the storage (consumers may alias it); the Assembly
         # object itself is reusable for the next message.
         if self._acquire_credit():
-            self.events.put(("message", self.assembly.take()))
+            body = self.assembly.take()
+            if compressed:
+                try:
+                    # limit enforced POST-decompression (gzip-bomb guard)
+                    body = fr.decompress_message(body, recv_limit)
+                except fr.DecompressTooLarge as exc:
+                    self.deliver_failure(StatusCode.RESOURCE_EXHAUSTED,
+                                         str(exc))
+                    return
+                except fr.FrameError as exc:
+                    self.deliver_failure(StatusCode.INTERNAL, str(exc))
+                    return
+            self.events.put(("message", body))
         else:
             self.assembly.take()  # stream already finished: drop
 
@@ -107,7 +121,9 @@ class _ChannelSink(fr.MessageSink):
             st = self._conn._streams.get(stream_id)
         if st is not None:
             st.commit_message(bool(flags & fr.FLAG_MORE),
-                              oversized=st.assembly.oversized)
+                              oversized=st.assembly.oversized,
+                              compressed=bool(flags & fr.FLAG_COMPRESSED),
+                              recv_limit=self.max_message_bytes)
 
 
 class _Connection:
@@ -319,7 +335,9 @@ class _Connection:
             return  # late frame for a cancelled/finished stream
         if f.type == fr.MESSAGE:  # only without a sink (never in practice)
             st.assembly.append(f.payload)
-            st.commit_message(bool(f.flags & fr.FLAG_MORE))
+            st.commit_message(
+                bool(f.flags & fr.FLAG_MORE),
+                compressed=bool(f.flags & fr.FLAG_COMPRESSED))
         elif f.type == fr.HEADERS:
             md, _ = fr.decode_metadata(f.payload)
             st.initial_metadata = md
@@ -465,6 +483,7 @@ class Channel:
                  credentials=None,
                  max_receive_message_length: Optional[int] = None,
                  retry_policy: "Optional[RetryPolicy]" = None,
+                 compression=None,
                  options=None):
         # grpcio channel options: [("grpc.arg_name", value), ...]. The
         # recognized args map onto this constructor's own parameters (an
@@ -477,6 +496,20 @@ class Channel:
                     "grpc.max_receive_message_length")
             if lb_policy == "pick_first" and "grpc.lb_policy_name" in opt:
                 lb_policy = opt["grpc.lb_policy_name"]
+            if compression is None:
+                compression = opt.get("grpc.default_compression_algorithm")
+        # Message compression on the tpurpc framing (FLAG_COMPRESSED; the
+        # h2 wire negotiates grpc-encoding separately): requests compress,
+        # tpurpc servers mirror on responses. gzip only — accepts "gzip" or
+        # grpcio's Compression.Gzip enum value (2); 0/None = off.
+        if compression in (None, 0, "identity", False):
+            self._compress_flag = 0
+        elif compression in ("gzip", 2) or str(compression).endswith("Gzip"):
+            self._compress_flag = fr.FLAG_COMPRESSED
+        else:
+            raise ValueError(
+                f"unsupported compression {compression!r}: the tpurpc "
+                "framing speaks gzip only (deflate lives on the h2 wire)")
         #: channel-level retry policy for unary-request calls (None = off,
         #: matching gRPC's default of retries disabled without service config)
         self.retry_policy = retry_policy
@@ -993,8 +1026,9 @@ class _MultiCallable:
             else:
                 conn.writer.send_many([
                     (fr.HEADERS, 0, st.stream_id, hdr_payload),
-                    (fr.MESSAGE, fr.FLAG_END_STREAM, st.stream_id,
-                     self._ser(first_request)),
+                    (fr.MESSAGE,
+                     fr.FLAG_END_STREAM | self._channel._compress_flag,
+                     st.stream_id, self._ser(first_request)),
                 ])
         except fr.FrameError as exc:
             conn.close_stream(st)
@@ -1009,8 +1043,10 @@ class _MultiCallable:
     def _send_one(self, conn: _Connection, st: _ClientStream, request,
                   end_stream: bool) -> None:
         try:
-            conn.writer.send(fr.MESSAGE, fr.FLAG_END_STREAM if end_stream else 0,
-                             st.stream_id, self._ser(request))
+            flags = ((fr.FLAG_END_STREAM if end_stream else 0)
+                     | self._channel._compress_flag)
+            conn.writer.send(fr.MESSAGE, flags, st.stream_id,
+                             self._ser(request))
         except (EndpointError, OSError) as exc:
             raise RpcError(StatusCode.UNAVAILABLE,
                            f"transport failed: {exc}") from exc
@@ -1046,8 +1082,10 @@ class _MultiCallable:
 def _reject_call_credentials(grpcio_kw: dict) -> None:
     """grpcio callers may pass credentials/wait_for_ready/compression per
     call. wait_for_ready is honored (queue instead of fail-fast, see
-    _MultiCallable._dial); compression is advisory — ignored; per-call
-    CREDENTIALS are a security feature we must not silently drop."""
+    _MultiCallable._dial); per-call compression is advisory (use the
+    CHANNEL-level compression= knob — FLAG_COMPRESSED on the framing);
+    per-call CREDENTIALS are a security feature we must not silently
+    drop."""
     if grpcio_kw.get("credentials") is not None:
         raise NotImplementedError(
             "per-call credentials are not supported; use channel credentials")

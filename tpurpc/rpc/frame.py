@@ -48,6 +48,12 @@ GOAWAY = 7
 # flags
 FLAG_END_STREAM = 0x01  # sender half-closes this stream (ref: h2 END_STREAM)
 FLAG_MORE = 0x02        # this MESSAGE frame is a fragment; more follow
+FLAG_COMPRESSED = 0x08  # MESSAGE payload is gzip-compressed (whole message;
+#                         set on every fragment). Senders request it by
+#                         passing the flag to FrameWriter, which performs
+#                         the compression — receivers gunzip at reassembly.
+#                         The gRPC wire's per-message compressed-flag
+#                         (grpc-encoding) recast for the tpurpc framing.
 FLAG_NO_MESSAGE = 0x04  # MESSAGE frame carries no message (pure half-close marker),
                         # distinguishing it from a genuine empty message
 
@@ -184,6 +190,54 @@ def rst_payload(code: StatusCode, details: str = "") -> bytes:
 parse_rst = parse_trailers
 
 
+def _compress_segs(segs, total):
+    """gzip a MESSAGE payload (FLAG_COMPRESSED contract: the WHOLE message
+    is one gzip stream; fragmentation happens after). Returns the segs
+    unchanged with ``compressed=False`` when gzip would ENLARGE the
+    payload (incompressible data: the gRPC wire clears its per-message
+    compressed bit the same way)."""
+    import gzip
+
+    joined = b"".join(bytes(s) for s in segs)
+    out = gzip.compress(joined, compresslevel=1)  # speed over ratio: this
+    # sits on the RPC hot path; level 1 still collapses repetitive tensors
+    if len(out) >= total:
+        return segs, total, False
+    return [memoryview(out)], len(out), True
+
+
+class DecompressTooLarge(FrameError):
+    """FLAG_COMPRESSED payload inflates past the receive limit (a
+    gzip-bomb guard — gRPC enforces max_receive_message_length on the
+    POST-decompression size, and so do we)."""
+
+
+def decompress_message(data, limit: "int | None" = None) -> bytes:
+    """Receiver-side inverse of FLAG_COMPRESSED. Raises
+    :class:`DecompressTooLarge` when the inflated size exceeds ``limit``,
+    :class:`FrameError` on a payload that does not gunzip (protocol
+    violation, not app data)."""
+    import zlib
+
+    d = zlib.decompressobj(31)  # 31 = gzip wrapper
+    try:
+        if limit is None or limit < 0:  # None/-1 both mean "unlimited"
+            out = d.decompress(bytes(data))
+        else:
+            out = d.decompress(bytes(data), max(1, limit) + 1)
+            if len(out) > limit or d.unconsumed_tail:
+                raise DecompressTooLarge(
+                    f"compressed message inflates past the receive "
+                    f"limit ({limit} bytes)")
+        if not d.eof:
+            raise FrameError("FLAG_COMPRESSED payload is a truncated "
+                             "gzip stream")
+        return out
+    except zlib.error as exc:
+        raise FrameError(f"FLAG_COMPRESSED payload does not gunzip: {exc}"
+                         ) from exc
+
+
 class FrameWriter:
     """Serializes frame writes from many threads onto one endpoint.
 
@@ -212,6 +266,10 @@ class FrameWriter:
                 [memoryview(payload).cast("B")])
         segs = [s for s in segs if len(s)]
         total = sum(len(s) for s in segs)
+        if ftype == MESSAGE and flags & FLAG_COMPRESSED:
+            segs, total, did = _compress_segs(segs, total)
+            if not did:  # incompressible: send as-is, clear the bit
+                flags &= ~FLAG_COMPRESSED
         if total <= MAX_FRAME_PAYLOAD:
             with self._lock:
                 self._ep.write(
@@ -269,6 +327,10 @@ class FrameWriter:
                     [memoryview(payload).cast("B")])
             segs = [s for s in segs if len(s)]
             total = sum(len(s) for s in segs)
+            if ftype == MESSAGE and flags & FLAG_COMPRESSED:
+                segs, total, did = _compress_segs(segs, total)
+                if not did:  # incompressible: send as-is, clear the bit
+                    flags &= ~FLAG_COMPRESSED
             if total > MAX_FRAME_PAYLOAD:
                 if batch:
                     with self._lock:

@@ -199,6 +199,7 @@ class _ServerStream:
 
     _END = object()
     _OVERSIZED = object()
+    _BAD_COMPRESSION = object()
 
     def __init__(self, stream_id: int, queue_depth: int = 64,
                  recv_limit: Optional[int] = None):
@@ -210,6 +211,9 @@ class _ServerStream:
         #: fragment assembly — the FrameReader sink appends wire bytes here
         self.assembly = fr.Assembly()
         self.half_closed = False
+        #: a request arrived FLAG_COMPRESSED: mirror the encoding on
+        #: responses (the peer demonstrably speaks it)
+        self.peer_compressed = False
         self.context: Optional[ServerContext] = None
         #: reactor-path pending invocation: (handler, ctx, path) set by
         #: _start_stream for inline unary handlers; consumed by the sink's
@@ -241,7 +245,8 @@ class _ServerStream:
 
     def commit_message(self, more: bool, end_stream: bool,
                        no_message: bool = False,
-                       oversized: bool = False) -> None:
+                       oversized: bool = False,
+                       compressed: bool = False) -> None:
         if oversized and not more:
             self.assembly.oversized = False
             self.requests.put(self._OVERSIZED)
@@ -249,7 +254,22 @@ class _ServerStream:
             # take() detaches the storage (consumers may alias it); the
             # Assembly object itself is reusable for the next message.
             if self._acquire_credit():
-                self.requests.put(self.assembly.take())
+                body = self.assembly.take()
+                if compressed:
+                    self.peer_compressed = True
+                    try:
+                        # limit on the POST-decompression size (bomb guard)
+                        body = fr.decompress_message(body, self.recv_limit)
+                    except fr.DecompressTooLarge:
+                        self._release_credit()  # sentinels bypass credits
+                        self.requests.put(self._OVERSIZED)
+                        body = None
+                    except fr.FrameError:
+                        self._release_credit()
+                        self.requests.put(self._BAD_COMPRESSION)
+                        body = None
+                if body is not None:
+                    self.requests.put(body)
             else:
                 self.assembly.take()  # stream dead: drop, free the bytes
         if end_stream:
@@ -264,7 +284,7 @@ class _ServerStream:
     def next_request(self, timeout: Optional[float] = None):
         """One queue item with its credit returned; queue.Empty on timeout."""
         item = self.requests.get(timeout=timeout)
-        if item is not self._END and item is not self._OVERSIZED:
+        if item not in (self._END, self._OVERSIZED, self._BAD_COMPRESSION):
             self._release_credit()
         return item
 
@@ -279,6 +299,9 @@ class _ServerStream:
                     StatusCode.RESOURCE_EXHAUSTED,
                     "received message larger than max "
                     f"({self.recv_limit} bytes)")
+            if item is self._BAD_COMPRESSION:
+                raise AbortError(StatusCode.INTERNAL,
+                                 "compressed message failed to decompress")
             if not context.is_active():
                 return
             yield _deserialize(deserializer, item)
@@ -306,7 +329,8 @@ class _ServerSink(fr.MessageSink):
             st.commit_message(bool(flags & fr.FLAG_MORE),
                               bool(flags & fr.FLAG_END_STREAM),
                               bool(flags & fr.FLAG_NO_MESSAGE),
-                              oversized=st.assembly.oversized)
+                              oversized=st.assembly.oversized,
+                              compressed=bool(flags & fr.FLAG_COMPRESSED))
             if flags & fr.FLAG_END_STREAM:
                 ic = self._conn._claim_inline(st)
                 if ic is not None:
@@ -484,7 +508,8 @@ class _ServerConnection:
             st.assembly.append(f.payload)
             st.commit_message(bool(f.flags & fr.FLAG_MORE),
                               bool(f.flags & fr.FLAG_END_STREAM),
-                              bool(f.flags & fr.FLAG_NO_MESSAGE))
+                              bool(f.flags & fr.FLAG_NO_MESSAGE),
+                              compressed=bool(f.flags & fr.FLAG_COMPRESSED))
         elif f.type == fr.RST:
             st.cancel()
             self._finish_stream(st)
@@ -603,6 +628,11 @@ class _ServerConnection:
                         "received message larger than max "
                         f"({st.recv_limit} bytes)")
                     return
+                if item is _ServerStream._BAD_COMPRESSION:
+                    self._send_trailers(
+                        st, StatusCode.INTERNAL,
+                        "compressed message failed to decompress")
+                    return
                 if item is _ServerStream._END or not ctx.is_active():
                     if ctx.is_active():
                         self._send_trailers(
@@ -621,8 +651,16 @@ class _ServerConnection:
                         self._send_trailers(st, StatusCode.DEADLINE_EXCEEDED,
                                             "deadline exceeded", ctx._trailing)
                         return
-                    self.writer.send(fr.MESSAGE, 0, st.stream_id,
-                                     handler.response_serializer(response))
+                    # Mirror the request's encoding, read PER SEND: for
+                    # request-streaming shapes peer_compressed is only set
+                    # once the lazy iterator has consumed a compressed
+                    # frame — a value frozen before the generator ran
+                    # would lose the mirror race.
+                    self.writer.send(
+                        fr.MESSAGE,
+                        fr.FLAG_COMPRESSED if st.peer_compressed else 0,
+                        st.stream_id,
+                        handler.response_serializer(response))
                 if ctx.is_active():
                     code = (ctx._code if ctx._code is not None
                             else StatusCode.OK)
@@ -634,7 +672,11 @@ class _ServerConnection:
                 code = ctx._code if ctx._code is not None else StatusCode.OK
                 try:
                     self.writer.send_many([
-                        (fr.MESSAGE, 0, st.stream_id,
+                        (fr.MESSAGE,
+                         # per-send mirror read (request fully consumed by
+                         # now, so peer_compressed is settled)
+                         fr.FLAG_COMPRESSED if st.peer_compressed else 0,
+                         st.stream_id,
                          handler.response_serializer(result)),
                         (fr.TRAILERS, fr.FLAG_END_STREAM, st.stream_id,
                          fr.trailers_payload(code, ctx._details,
