@@ -558,6 +558,7 @@ def test_cpp_loop_under_asan():
                                  text=True, timeout=120, env=env)
             assert out.returncode == 0, (out.stdout, out.stderr)
             assert "ERROR" not in out.stderr, out.stderr
+            assert "runtime error" not in out.stderr, out.stderr
         # CQ async machinery under ASan (pin/destroy lifecycle tripwire).
         # The example's Hang-method deadline phase gets UNIMPLEMENTED here
         # (this server has no Hang) — lifecycle still fully exercised, so
@@ -566,6 +567,7 @@ def test_cpp_loop_under_asan():
                              text=True, timeout=120,
                              env=dict(os.environ, GRPC_PLATFORM_TYPE="TCP"))
         assert "ERROR" not in out.stderr, out.stderr
+        assert "runtime error" not in out.stderr, out.stderr  # UBSan recoverable
         # every phase except the deadline one must still pass outright
         assert "async_unary done=64 matched=64" in out.stdout, out.stdout
         assert "big_async_ok=1" in out.stdout, out.stdout
@@ -576,6 +578,7 @@ def test_cpp_loop_under_asan():
         proc.wait(timeout=15)
         srv_err = proc.stderr.read()
         assert "ERROR" not in srv_err, srv_err
+        assert "runtime error" not in srv_err, srv_err  # UBSan recoverable
 
 
 _CB_SERVER_SRC = r"""
