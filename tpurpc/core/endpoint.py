@@ -95,6 +95,13 @@ class Endpoint:
     def can_track_err(self) -> bool:
         return False
 
+    def peer_cert(self) -> "Optional[dict]":
+        """The peer's TLS certificate (``SSLSocket.getpeercert()`` dict),
+        None on non-TLS transports. Each transport serializes the probe
+        with whatever lock guards its SSL object — OpenSSL forbids
+        concurrent use of one SSL*."""
+        return None
+
 
 class ReadTimeout(TimeoutError):
     pass
@@ -120,6 +127,7 @@ class TcpEndpoint(Endpoint):
         #: two directions against each other.
         self._ssl_lock = (threading.Lock()
                           if hasattr(sock, "pending") else None)
+        # (peer_cert below shares _ssl_lock for the same reason.)
         # The socket stays BLOCKING for its whole life; read deadlines are a
         # select() ahead of the recv instead of settimeout(). settimeout is
         # per-socket state, so a writer thread flipping it to blocking would
@@ -339,6 +347,16 @@ class TcpEndpoint(Endpoint):
     def can_track_err(self) -> bool:
         return True
 
+    def peer_cert(self) -> "Optional[dict]":
+        sock = self._sock
+        if self._ssl_lock is None or not hasattr(sock, "getpeercert"):
+            return None  # plaintext
+        try:
+            with self._ssl_lock:  # ALL OpenSSL calls on one SSL* serialize
+                return sock.getpeercert()
+        except (OSError, ValueError):
+            return None
+
 
 def device_ring_of(endpoint: Endpoint):
     """The endpoint's device (HBM) receive ring, or None off-platform.
@@ -525,6 +543,20 @@ class RingEndpoint(Endpoint):
 
     def fileno(self) -> int:
         return self.pair.wakeup_fd if not self._closed else -1
+
+    def peer_cert(self) -> "Optional[dict]":
+        # Ring platforms keep the (possibly TLS) bootstrap socket as the
+        # pair's notify channel; its SSL object is serialized by the
+        # pair's notify lock.
+        pair = self.pair
+        sock = getattr(pair, "notify_sock", None)
+        if sock is None or not hasattr(sock, "getpeercert"):
+            return None
+        try:
+            with pair._notify_lock:
+                return sock.getpeercert()
+        except (OSError, ValueError):
+            return None
 
 
 # ---------------------------------------------------------------------------

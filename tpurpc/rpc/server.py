@@ -142,6 +142,46 @@ class ServerContext:
     def peer(self) -> str:
         return self._conn.endpoint.peer
 
+    def auth_context(self) -> dict:
+        """grpcio's ServerContext.auth_context: {} on plaintext,
+        transport_security_type alone on certless TLS, plus the peer's
+        x509 names under mTLS. Probed through the Endpoint seam (ring
+        platforms keep the TLS socket as the pair's notify channel), and
+        computed once per context — the cert can't change mid-call."""
+        cached = getattr(self, "_auth_ctx", None)
+        if cached is not None:
+            return cached
+        cert = self._conn.endpoint.peer_cert()
+        if cert is None:  # non-TLS transport
+            out: dict = {}
+        elif not cert:  # TLS without a client certificate
+            out = {"transport_security_type": [b"ssl"]}
+        else:
+            out = {"transport_security_type": [b"ssl"]}
+            # every SAN kind counts as identity (URI carries SPIFFE ids)
+            sans = [v.encode() if isinstance(v, str) else str(v).encode()
+                    for _kind, v in cert.get("subjectAltName", ())]
+            if sans:
+                out["x509_subject_alternative_name"] = sans
+            for rdn in cert.get("subject", ()):
+                for key, val in rdn:
+                    if key == "commonName":
+                        out.setdefault("x509_common_name", []).append(
+                            val.encode())
+        self._auth_ctx = out
+        return out
+
+    def peer_identity_key(self) -> "Optional[str]":
+        ac = self.auth_context()
+        for key in ("x509_subject_alternative_name", "x509_common_name"):
+            if key in ac:
+                return key
+        return None
+
+    def peer_identities(self):
+        key = self.peer_identity_key()
+        return self.auth_context()[key] if key else None
+
     @property
     def device_ring(self):
         """The connection's device (HBM) receive ring, or None off-platform.
