@@ -1,0 +1,269 @@
+"""The canonical gRPC interop-suite cases (doc/interop-test-descriptions
+in the grpc repo), run across the wire against STOCK grpcio — the named
+conformance battery the ecosystem recognizes, adapted to raw-bytes
+payloads (the canon's grpc.testing protos test the same behaviors; the
+payload schema is not the subject).
+
+Direction A: stock grpcio CLIENT -> tpurpc server (wire/grpc_h2.py).
+Direction B: tpurpc H2Channel CLIENT -> stock grpcio server
+(selected cases; B-side plumbing mirrors test_h2_client.py).
+"""
+
+import threading
+import time
+
+import grpc
+import pytest
+
+import tpurpc.rpc as tps
+from tpurpc.rpc.status import StatusCode
+
+_ID = lambda x: x
+
+
+def _interop_server():
+    srv = tps.Server(max_workers=8)
+    release = threading.Event()
+
+    def empty_call(req, ctx):
+        assert bytes(req) == b""
+        return b""
+
+    def unary_call(req, ctx):
+        return bytes(req)
+
+    def streaming_input(req_iter, ctx):
+        return str(sum(len(m) for m in req_iter)).encode()
+
+    def streaming_output(req, ctx):
+        for n in (31415, 9, 2653, 58979):
+            yield bytes(n % 251 for _ in range(1))  # sized markers
+            yield b"x" * (n % 1024)
+
+    def full_duplex(req_iter, ctx):
+        for m in req_iter:
+            yield b"pong:" + bytes(m)
+
+    def custom_status(req, ctx):
+        ctx.abort(StatusCode.UNKNOWN, bytes(req).decode("utf-8"))
+
+    def sleeping(req, ctx):
+        release.wait(timeout=30)
+        return b"late"
+
+    def md_echo(req, ctx):
+        md = {k: v for k, v in ctx.invocation_metadata()}
+        ctx.set_trailing_metadata((
+            ("x-grpc-test-echo-trailing-bin",
+             md.get("x-grpc-test-echo-trailing-bin", b"")),))
+        ctx.send_initial_metadata((
+            ("x-grpc-test-echo-initial",
+             md.get("x-grpc-test-echo-initial", "?")),))
+        return bytes(req)
+
+    S = "/grpc.testing.TestService/"
+    srv.add_method(S + "EmptyCall",
+                   tps.unary_unary_rpc_method_handler(empty_call))
+    srv.add_method(S + "UnaryCall",
+                   tps.unary_unary_rpc_method_handler(unary_call))
+    srv.add_method(S + "StreamingInputCall",
+                   tps.stream_unary_rpc_method_handler(streaming_input))
+    srv.add_method(S + "StreamingOutputCall",
+                   tps.unary_stream_rpc_method_handler(streaming_output))
+    srv.add_method(S + "FullDuplexCall",
+                   tps.stream_stream_rpc_method_handler(full_duplex))
+    srv.add_method(S + "CustomStatus",
+                   tps.unary_unary_rpc_method_handler(custom_status))
+    srv.add_method(S + "Sleeping",
+                   tps.unary_unary_rpc_method_handler(sleeping))
+    srv.add_method(S + "MetadataEcho",
+                   tps.unary_unary_rpc_method_handler(md_echo))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    return srv, port, release
+
+
+@pytest.fixture(scope="module")
+def interop():
+    srv, port, release = _interop_server()
+    ch = grpc.insecure_channel(f"127.0.0.1:{port}")
+    yield ch
+    release.set()
+    ch.close()
+    srv.stop(grace=0)
+
+
+S = "/grpc.testing.TestService/"
+
+
+def test_empty_unary(interop):
+    mc = interop.unary_unary(S + "EmptyCall", _ID, _ID)
+    assert mc(b"", timeout=15) == b""
+
+
+def test_large_unary(interop):
+    mc = interop.unary_unary(S + "UnaryCall", _ID, _ID)
+    body = bytes(range(256)) * 1109  # ~284KB, the canon's 271828-ish size
+    assert mc(body, timeout=30) == body
+
+
+def test_client_streaming(interop):
+    mc = interop.stream_unary(S + "StreamingInputCall", _ID, _ID)
+    sizes = [27182, 8, 1828, 45904]  # the canon's request sizes
+    out = mc(iter(b"q" * n for n in sizes), timeout=30)
+    assert int(out) == sum(sizes)
+
+
+def test_server_streaming(interop):
+    mc = interop.unary_stream(S + "StreamingOutputCall", _ID, _ID)
+    msgs = list(mc(b"", timeout=30))
+    assert len(msgs) == 8
+
+
+def test_ping_pong(interop):
+    """Bidi lockstep: each request answered before the next is sent."""
+    mc = interop.stream_stream(S + "FullDuplexCall", _ID, _ID)
+    lock = threading.Semaphore(1)
+
+    def gen():
+        for i in range(4):
+            lock.acquire()
+            yield b"m%d" % i
+
+    replies = []
+    for reply in mc(gen()):
+        replies.append(reply)
+        lock.release()
+    assert replies == [b"pong:m%d" % i for i in range(4)]
+
+
+def test_custom_metadata(interop):
+    mc = interop.unary_unary(S + "MetadataEcho", _ID, _ID)
+    resp, call = mc.with_call(
+        b"payload", timeout=15,
+        metadata=(("x-grpc-test-echo-initial", "test_initial_metadata_value"),
+                  ("x-grpc-test-echo-trailing-bin", b"\xab\xab\xab")))
+    assert resp == b"payload"
+    init = dict(call.initial_metadata())
+    assert init.get("x-grpc-test-echo-initial") == "test_initial_metadata_value"
+    trail = dict(call.trailing_metadata())
+    assert trail.get("x-grpc-test-echo-trailing-bin") == b"\xab\xab\xab"
+
+
+def test_status_code_and_message(interop):
+    mc = interop.unary_unary(S + "CustomStatus", _ID, _ID)
+    with pytest.raises(grpc.RpcError) as ei:
+        mc(b"test status message", timeout=15)
+    assert ei.value.code() is grpc.StatusCode.UNKNOWN
+    assert ei.value.details() == "test status message"
+
+
+def test_special_status_message(interop):
+    """Unicode + whitespace survive the percent-encoded grpc-message."""
+    msg = "\t\ntest with whitespace\r\nand Unicode BMP ☺ and non-BMP \U0001f600\t\n"
+    mc = interop.unary_unary(S + "CustomStatus", _ID, _ID)
+    with pytest.raises(grpc.RpcError) as ei:
+        mc(msg.encode("utf-8"), timeout=15)
+    assert ei.value.details() == msg
+
+
+def test_unimplemented_method(interop):
+    mc = interop.unary_unary(S + "UnimplementedCall", _ID, _ID)
+    with pytest.raises(grpc.RpcError) as ei:
+        mc(b"", timeout=15)
+    assert ei.value.code() is grpc.StatusCode.UNIMPLEMENTED
+
+
+def test_timeout_on_sleeping_server(interop):
+    mc = interop.unary_unary(S + "Sleeping", _ID, _ID)
+    with pytest.raises(grpc.RpcError) as ei:
+        mc(b"", timeout=0.5)
+    assert ei.value.code() is grpc.StatusCode.DEADLINE_EXCEEDED
+
+
+def test_cancel_after_begin(interop):
+    mc = interop.stream_unary(S + "StreamingInputCall", _ID, _ID)
+    feed = threading.Event()
+
+    def gen():
+        feed.wait(timeout=30)  # hold the stream open, nothing sent
+        return
+        yield  # pragma: no cover
+
+    fut = mc.future(gen())
+    time.sleep(0.2)
+    fut.cancel()
+    # grpcio surfaces a cancelled future as FutureCancelledError on result()
+    with pytest.raises((grpc.RpcError, grpc.FutureCancelledError)):
+        fut.result(timeout=15)
+    assert fut.cancelled()
+    feed.set()
+
+
+def test_cancel_after_first_response(interop):
+    mc = interop.stream_stream(S + "FullDuplexCall", _ID, _ID)
+    hold = threading.Event()
+
+    def gen():
+        yield b"one"
+        hold.wait(timeout=30)
+
+    call = mc(gen())
+    assert next(call) == b"pong:one"
+    call.cancel()
+    with pytest.raises(grpc.RpcError) as ei:
+        next(call)
+    assert ei.value.code() is grpc.StatusCode.CANCELLED
+    hold.set()
+
+
+# -- Direction B: tpurpc H2Channel client vs a STOCK grpcio server -----------
+
+@pytest.fixture(scope="module")
+def stock_server():
+    from concurrent import futures as cf
+
+    class Handler(grpc.GenericRpcHandler):
+        def service(self, details):
+            if details.method == S + "UnaryCall":
+                return grpc.unary_unary_rpc_method_handler(
+                    lambda req, ctx: bytes(req), _ID, _ID)
+            if details.method == S + "CustomStatus":
+                def boom(req, ctx):
+                    ctx.abort(grpc.StatusCode.UNKNOWN,
+                              bytes(req).decode("utf-8"))
+                return grpc.unary_unary_rpc_method_handler(boom, _ID, _ID)
+            if details.method == S + "FullDuplexCall":
+                def duplex(req_iter, ctx):
+                    for m in req_iter:
+                        yield b"pong:" + bytes(m)
+                return grpc.stream_stream_rpc_method_handler(duplex, _ID, _ID)
+            return None
+
+    srv = grpc.server(cf.ThreadPoolExecutor(max_workers=8))
+    srv.add_generic_rpc_handlers((Handler(),))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    yield port
+    srv.stop(grace=0)
+
+
+def test_b_large_unary_and_status(stock_server):
+    from tpurpc.wire.h2_client import H2Channel
+
+    with H2Channel(f"127.0.0.1:{stock_server}") as ch:
+        body = bytes(range(256)) * 1109
+        assert ch.unary_unary(S + "UnaryCall")(body, timeout=30) == body
+        msg = "\ttest with whitespace\nand Unicode BMP ☺\t"
+        with pytest.raises(tps.RpcError) as ei:
+            ch.unary_unary(S + "CustomStatus")(msg.encode(), timeout=15)
+        assert ei.value.details() == msg
+
+
+def test_b_ping_pong(stock_server):
+    from tpurpc.wire.h2_client import H2Channel
+
+    with H2Channel(f"127.0.0.1:{stock_server}") as ch:
+        mc = ch.stream_stream(S + "FullDuplexCall")
+        out = list(mc(iter([b"a", b"bb"]), timeout=30))
+        assert out == [b"pong:a", b"pong:bb"]

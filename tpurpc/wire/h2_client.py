@@ -372,25 +372,36 @@ class H2Channel:
             self._settings_acked.set()
             return
         settings = h2.parse_settings(payload)
-        if h2.SETTINGS_MAX_FRAME_SIZE in settings:
-            self._peer_max_frame = settings[h2.SETTINGS_MAX_FRAME_SIZE]
-        if h2.SETTINGS_INITIAL_WINDOW_SIZE in settings:
-            new = settings[h2.SETTINGS_INITIAL_WINDOW_SIZE]
-            # The write to _peer_initial_window and the snapshot of calls to
-            # retro-adjust must be ONE critical section with _start_call's
-            # window creation: a call created in between would otherwise get
-            # the new initial AND the adjust (double-applied delta →
-            # overrunning the server's window → FLOW_CONTROL_ERROR).
-            with self._lock:
-                delta = new - self._peer_initial_window
-                self._peer_initial_window = new
-                calls = list(self._calls.values())
-            for call in calls:
-                if call.window is not None:
-                    call.window.adjust(delta)
         with self._wlock:
+            # Process EVERY setting, then ACK, in ONE write-lock hold
+            # (RFC 7540 §6.5.3's process-all-then-ACK). The hold is what
+            # makes enlargements safe: a peer may keep enforcing its
+            # PRE-settings limits until it receives our ACK (grpc-core
+            # does exactly that for max frame size — the round-3 sporadic
+            # 'Failed parsing HTTP/2'), and since every DATA/HEADERS write
+            # takes _wlock, a sender that observed an enlarged value can
+            # only reach the socket after the ACK already queued ahead of
+            # it in this critical section.
+            if h2.SETTINGS_MAX_FRAME_SIZE in settings:
+                self._peer_max_frame = settings[h2.SETTINGS_MAX_FRAME_SIZE]
+            if h2.SETTINGS_INITIAL_WINDOW_SIZE in settings:
+                new = settings[h2.SETTINGS_INITIAL_WINDOW_SIZE]
+                # The write to _peer_initial_window and the snapshot of
+                # calls to retro-adjust must be ONE critical section with
+                # _start_call's window creation (which nests _lock inside
+                # _wlock in this same order): a call created in between
+                # would otherwise get the new initial AND the adjust
+                # (double-applied delta → overrunning the server's window
+                # → FLOW_CONTROL_ERROR).
+                with self._lock:
+                    delta = new - self._peer_initial_window
+                    self._peer_initial_window = new
+                    calls = list(self._calls.values())
+                for call in calls:
+                    if call.window is not None:
+                        call.window.adjust(delta)
             # Indexing stays off until this first SETTINGS is processed (the
-            # peer's table ceiling is unknown before); apply + ack under the
+            # peer's table ceiling is unknown before); applied under the
             # write lock so no HEADERS block interleaves the transition.
             self._enc.apply_peer_table_size(
                 settings.get(h2.SETTINGS_HEADER_TABLE_SIZE, 4096))
