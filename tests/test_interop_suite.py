@@ -267,3 +267,30 @@ def test_b_ping_pong(stock_server):
         mc = ch.stream_stream(S + "FullDuplexCall")
         out = list(mc(iter([b"a", b"bb"]), timeout=30))
         assert out == [b"pong:a", b"pong:bb"]
+
+
+def test_concurrent_stock_clients(interop):
+    """Server-side shake-out: several stock grpcio client threads hammer
+    one tpurpc h2 server concurrently with mixed shapes — races in the
+    server's HPACK/flow-control/stream bookkeeping would surface as
+    protocol kills (the client-side analog hid the SETTINGS-ACK race)."""
+    errors: list = []
+
+    def worker(n: int):
+        try:
+            u = interop.unary_unary(S + "UnaryCall", _ID, _ID)
+            d = interop.stream_stream(S + "FullDuplexCall", _ID, _ID)
+            for i in range(40):
+                body = bytes((n + i) % 256 for _ in range(512 * (1 + i % 4)))
+                assert u(body, timeout=30) == body
+                if i % 8 == 0:
+                    out = list(d(iter([b"a", b"b"]), timeout=30))
+                    assert out == [b"pong:a", b"pong:b"]
+        except Exception as exc:  # noqa: BLE001 — surfaced after join
+            errors.append(exc)
+
+    ts = [threading.Thread(target=worker, args=(k,)) for k in range(4)]
+    [t.start() for t in ts]
+    [t.join(timeout=240) for t in ts]
+    assert not errors, errors[0]
+    assert not any(t.is_alive() for t in ts)
