@@ -313,16 +313,26 @@ class GrpcH2Connection:
             if flags & h2.FLAG_ACK:
                 return
             settings = h2.parse_settings(payload)
-            if h2.SETTINGS_MAX_FRAME_SIZE in settings:
-                self._peer_max_frame = settings[h2.SETTINGS_MAX_FRAME_SIZE]
-            if h2.SETTINGS_INITIAL_WINDOW_SIZE in settings:
-                new = settings[h2.SETTINGS_INITIAL_WINDOW_SIZE]
-                delta = new - self._peer_initial_window
-                self._peer_initial_window = new
-                with self._lock:
-                    for st in self._streams.values():
-                        st.window.adjust(delta)
-            self._write(h2.pack_settings({}, ack=True))
+            h2.validate_settings(settings)  # RFC 7540 §6.5.2 ranges
+            with self._write_lock:
+                # Process-all-then-ACK in ONE write-lock hold (the server
+                # mirror of the h2_client SETTINGS-ACK race): a peer may
+                # keep enforcing its pre-settings limits until our ACK
+                # arrives, and every response write takes _write_lock, so
+                # a handler thread that observed an enlarged max-frame /
+                # window can only reach the socket behind the ACK queued
+                # here.
+                if h2.SETTINGS_MAX_FRAME_SIZE in settings:
+                    self._peer_max_frame = settings[
+                        h2.SETTINGS_MAX_FRAME_SIZE]
+                if h2.SETTINGS_INITIAL_WINDOW_SIZE in settings:
+                    new = settings[h2.SETTINGS_INITIAL_WINDOW_SIZE]
+                    delta = new - self._peer_initial_window
+                    self._peer_initial_window = new
+                    with self._lock:
+                        for st in self._streams.values():
+                            st.window.adjust(delta)
+                self.endpoint.write(h2.pack_settings({}, ack=True))
         elif ftype == h2.PING:
             if not flags & h2.FLAG_ACK:
                 self._write(h2.pack_frame(h2.PING, h2.FLAG_ACK, 0, payload))

@@ -80,6 +80,24 @@ def parse_settings(payload: bytes) -> Dict[int, int]:
     return out
 
 
+def validate_settings(settings: Dict[int, int]) -> None:
+    """RFC 7540 §6.5.2 range checks for the values we ACT on — a peer's
+    MAX_FRAME_SIZE outside [16384, 2^24-1] or INITIAL_WINDOW_SIZE above
+    2^31-1 is a connection error, not a loop-step size to adopt (a zero
+    max-frame would spin the send loop forever; an unsigned-huge window
+    delta would blow FlowWindow past its overflow guard later)."""
+    if SETTINGS_MAX_FRAME_SIZE in settings:
+        v = settings[SETTINGS_MAX_FRAME_SIZE]
+        if not (16384 <= v <= (1 << 24) - 1):
+            raise H2Error(f"SETTINGS_MAX_FRAME_SIZE {v} outside "
+                          "[16384, 2^24-1] (PROTOCOL_ERROR)")
+    if SETTINGS_INITIAL_WINDOW_SIZE in settings:
+        v = settings[SETTINGS_INITIAL_WINDOW_SIZE]
+        if v > 0x7FFFFFFF:
+            raise H2Error(f"SETTINGS_INITIAL_WINDOW_SIZE {v} exceeds "
+                          "2^31-1 (FLOW_CONTROL_ERROR)")
+
+
 def pack_goaway(last_stream: int, code: int, debug: bytes = b"") -> List[bytes]:
     return pack_frame(GOAWAY, 0, 0,
                       struct.pack("!II", last_stream & 0x7FFFFFFF, code) + debug)

@@ -372,6 +372,7 @@ class H2Channel:
             self._settings_acked.set()
             return
         settings = h2.parse_settings(payload)
+        h2.validate_settings(settings)  # RFC 7540 §6.5.2 ranges
         with self._wlock:
             # Process EVERY setting, then ACK, in ONE write-lock hold
             # (RFC 7540 §6.5.3's process-all-then-ACK). The hold is what
