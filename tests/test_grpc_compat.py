@@ -212,3 +212,37 @@ def test_graceful_stop_with_h2_connection():
         assert mc(b"hi", timeout=20) == b"hi"
         ev = srv.stop(grace=1)          # h2 conn live: must not raise
         assert ev.wait(timeout=10)
+
+
+def test_malformed_settings_rejected_cleanly(compat):
+    """A peer advertising RFC-invalid SETTINGS (MAX_FRAME_SIZE=0 — would
+    spin the send loop; INITIAL_WINDOW_SIZE>2^31-1 — would blow the flow
+    window) gets its connection torn down instead of poisoning the
+    server, and the server keeps serving other connections."""
+    import socket
+    import struct as _s
+
+    _, port, ch = compat
+    # raw frame bytes: length(3) type(0x4=SETTINGS) flags(0) sid(0)
+    for k, v in ((5, 0), (4, 0xFFFFFFFF)):
+        s = socket.create_connection(("127.0.0.1", port), timeout=10)
+        s.settimeout(10)
+        try:
+            s.sendall(b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n")
+            payload = _s.pack("!HI", k, v)
+            frame = len(payload).to_bytes(3, "big") + b"\x04\x00" + \
+                (0).to_bytes(4, "big") + payload
+            s.sendall(frame)
+            # server must close (possibly after GOAWAY); recv drains to EOF
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                try:
+                    if s.recv(4096) == b"":
+                        break
+                except socket.timeout:
+                    raise AssertionError("server kept the connection open")
+        finally:
+            s.close()
+    # the shared module-scope channel still works: server survived
+    assert ch.unary_unary("/test.Echo/Echo", _ID, _ID)(b"alive",
+                                                       timeout=15) == b"alive"
