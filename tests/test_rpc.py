@@ -728,3 +728,53 @@ def test_keepalive_healthy_idle_survives_aggressive_knobs(monkeypatch):
         ch2.close()
     finally:
         config_mod.set_config(None)
+
+
+def test_listener_survives_garbage_connections():
+    """Adversarial bytes at the protocol sniff and past it: random junk,
+    a truncated native preface, an oversized frame header — each kills
+    only ITS connection; the listener and live channels keep working."""
+    import os
+    import socket
+    import struct
+
+    import tpurpc.rpc as rpc
+
+    import threading
+
+    # Pin the containment: an exception ESCAPING the sniff thread would
+    # previously only print a traceback (daemon thread), so the listener
+    # "survived" either way — record escapes and assert there were none.
+    escapes = []
+    prev_hook = threading.excepthook
+    threading.excepthook = lambda args: escapes.append(args)
+    srv = rpc.Server(max_workers=2)
+    srv.add_method("/g.S/Echo",
+                   rpc.unary_unary_rpc_method_handler(lambda r, c: bytes(r)))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    try:
+        payloads = [
+            os.urandom(64),                     # junk at the sniff
+            b"TPURPC\x01\x00" + os.urandom(64),  # junk after a valid preface
+            b"TPURPC\x01\x00" + struct.pack(     # oversized frame header
+                "<BBII", 2, 0, 1, 0xFFFFFFF0),
+            b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n" + os.urandom(32),  # h2 junk
+            b"TRB",                              # truncated ring magic + EOF
+        ]
+        for _ in range(6):  # repeat: the adoption-write race is timing-y
+            for junk in payloads:
+                s = socket.create_connection(("127.0.0.1", port), timeout=10)
+                try:
+                    s.sendall(junk)
+                except OSError:
+                    pass
+                finally:
+                    s.close()
+        with rpc.Channel(f"127.0.0.1:{port}") as ch:
+            assert ch.unary_unary("/g.S/Echo")(b"alive", timeout=15) == b"alive"
+        time.sleep(0.3)  # let straggler sniff threads finish dying
+        assert not escapes, escapes[0]
+    finally:
+        threading.excepthook = prev_hook
+        srv.stop(grace=0)

@@ -974,14 +974,25 @@ class Server:
         except (EndpointError, TimeoutError):
             endpoint.close()
             return
-        if bytes(first) == fr.MAGIC:
-            conn = _ServerConnection(self, endpoint, preface_consumed=True)
-        elif bytes(first) == b"PRI * HT":
-            from tpurpc.wire.grpc_h2 import GrpcH2Connection
+        try:
+            if bytes(first) == fr.MAGIC:
+                conn = _ServerConnection(self, endpoint,
+                                         preface_consumed=True)
+            elif bytes(first) == b"PRI * HT":
+                from tpurpc.wire.grpc_h2 import GrpcH2Connection
 
-            conn = GrpcH2Connection(self, endpoint, preface_consumed=8)
-        else:
-            trace_server.log("unknown protocol preface %r; dropping", bytes(first))
+                conn = GrpcH2Connection(self, endpoint, preface_consumed=8)
+            else:
+                trace_server.log("unknown protocol preface %r; dropping",
+                                 bytes(first))
+                endpoint.close()
+                return
+        except (EndpointError, OSError) as exc:
+            # The peer vanished mid-adoption (e.g. junk preface + close —
+            # the h2 path writes SETTINGS during construction): contain it
+            # to this connection instead of dying as an unhandled thread
+            # exception.
+            trace_server.log("peer gone during adoption: %s", exc)
             endpoint.close()
             return
         # Registration must be atomic against stop(): this sniff thread may
