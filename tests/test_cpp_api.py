@@ -823,3 +823,41 @@ def test_native_ring_beats_tcp_small_rpc(tmp_path):
     _sys.stderr.write(f"ring={ring:.0f} tcp={tcp:.0f} RPC/s\n")
     assert ring > tcp * 0.9  # ring must at least match TCP (wins by ~1.6x
     # unloaded; 0.9 margin absorbs CI noise without masking a regression)
+
+
+def test_native_server_survives_garbage_connections():
+    """Junk at the native server's protocol sniff (random bytes, truncated
+    TRB1, oversized frame headers) costs only its own connection; the
+    server keeps serving real clients."""
+    import socket
+    import struct
+
+    _build_server_example()
+    proc = subprocess.Popen([SRV_BIN], stdout=subprocess.PIPE,
+                            stdin=subprocess.PIPE, text=True)
+    try:
+        port = int(proc.stdout.readline().split()[1])
+        payloads = [
+            os.urandom(64),
+            b"TRB",                                   # truncated ring magic
+            b"TRB1" + os.urandom(32),                  # bogus bootstrap blob
+            b"TPURPC\x01\x00" + os.urandom(64),        # junk after preface
+            b"TPURPC\x01\x00" + struct.pack(           # oversized frame
+                "<BBII", 2, 0, 1, 0xFFFFFFF0),
+        ]
+        for _ in range(4):
+            for junk in payloads:
+                s = socket.create_connection(("127.0.0.1", port), timeout=10)
+                try:
+                    s.sendall(junk)
+                except OSError:
+                    pass
+                finally:
+                    s.close()
+        with rpc.Channel(f"127.0.0.1:{port}") as ch:
+            assert ch.unary_unary("/demo.Greeter/Echo")(b"alive",
+                                                        timeout=20) == b"alive"
+        assert proc.poll() is None  # the server process itself survived
+    finally:
+        proc.kill()
+        proc.wait()
