@@ -384,14 +384,23 @@ def test_lookaside_grpclb_load_reporting():
                                        wire="grpclb")
             who = ch.unary_unary("/l.S/Who")
             assert _await(lambda: bytes(who(b"", timeout=10)) == b"b1")
+            # grpclb stats are STREAM-relative deltas, so calls racing the
+            # balancer stream's bring-up are legitimately excluded or
+            # half-counted. Wait until reporting is demonstrably live,
+            # capture a base, and assert exact deltas for calls made
+            # strictly after it.
+            assert _await(lambda: balancer.stats("load") != {}, timeout=20)
+            base = balancer.stats("load")
             for _ in range(7):
                 who(b"", timeout=10)
-            assert _await(
-                lambda: balancer.stats("load").get("started", 0) >= 8,
-                timeout=20)
-            st = balancer.stats("load")
-            assert st["finished"] >= 8
-            assert st["known_received"] >= 8
+
+            def _reported():
+                st = balancer.stats("load")
+                return all(st.get(k, 0) - base.get(k, 0) >= 7
+                           for k in ("started", "finished",
+                                     "known_received"))
+
+            assert _await(_reported, timeout=30), (base, balancer.stats("load"))
             watcher.stop()
     finally:
         bal_srv.stop(grace=0)
