@@ -223,3 +223,72 @@ def test_churn_with_full_connection_management(monkeypatch):
     assert not errors, errors
     assert all(n > 5 for n in done), done
     srv.stop(grace=0)
+
+
+def test_connection_churn_soak_no_leak(monkeypatch):
+    """Steady-state resource flatness under connection churn: after a
+    warm-up phase, hundreds more churned connections must not grow
+    threads or RSS (the 4-minute manual soak showed flat 195-206MB over
+    9.4K connections; this is its bounded CI regression)."""
+    import gc
+    import os
+    import threading
+
+    monkeypatch.setenv("GRPC_PLATFORM_TYPE", "RDMA_BPEV")
+    from tpurpc.utils import config as config_mod
+
+    config_mod.set_config(None)
+    import tpurpc.rpc as rpc
+    from tpurpc.rpc.channel import Channel
+
+    def rss_kb():
+        with open("/proc/self/status") as f:
+            for ln in f:
+                if ln.startswith("VmRSS"):
+                    return int(ln.split()[1])
+
+    srv = rpc.Server(max_workers=8)
+    srv.add_method("/soak.S/Echo",
+                   rpc.unary_unary_rpc_method_handler(lambda r, c: bytes(r)))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    try:
+        def churn(n, calls=20):
+            for _ in range(n):
+                with Channel(f"127.0.0.1:{port}") as ch:
+                    e = ch.unary_unary("/soak.S/Echo")
+                    for _ in range(calls):
+                        e(b"x" * 512, timeout=30)
+
+        def settled_threads(timeout=5.0):
+            # per-connection sniff/reader threads die asynchronously after
+            # a churn burst; sample the SETTLED count, not the in-flight
+            # transient — otherwise the assert races thread teardown
+            import time as _t
+
+            end = _t.monotonic() + timeout
+            low = threading.active_count()
+            while _t.monotonic() < end:
+                _t.sleep(0.1)
+                low = min(low, threading.active_count())
+            return low
+
+        churn(60)  # warm: pools, pairs, worker threads reach steady state
+        gc.collect()
+        base_threads, base_rss = settled_threads(), rss_kb()
+        churn(240)
+        gc.collect()
+        dt_threads = settled_threads() - base_threads
+        dt_rss = rss_kb() - base_rss
+        # Shared pools (handler executor, blocking-ops, timer wheel) grow
+        # lazily toward their caps — observed +4-5 across the measured
+        # phase. The guard is against PER-CONNECTION leakage: 240 churned
+        # connections leaking even one thread each would be +240.
+        assert dt_threads <= 12, f"thread growth {dt_threads}"
+        # generous for allocator jitter on a loaded CI host; a real
+        # per-connection leak at even 2KB would show ~0.5MB here on top
+        # of noise that measured +-10MB — this guards order-of-magnitude
+        # regressions (forgotten pairs/rings/threads), not bytes
+        assert dt_rss < 60_000, f"RSS grew {dt_rss}KB over 240 connections"
+    finally:
+        srv.stop(grace=0)
