@@ -292,3 +292,63 @@ def test_connection_churn_soak_no_leak(monkeypatch):
         assert dt_rss < 60_000, f"RSS grew {dt_rss}KB over 240 connections"
     finally:
         srv.stop(grace=0)
+
+
+def test_connection_churn_soak_tcpw_domain(monkeypatch):
+    """The same churn-flatness guard over the CROSS-HOST tcp_window
+    domain: every connection bootstraps a socket-carried one-sided ring,
+    so leaked appliers/regions would show up as thread or RSS growth."""
+    import gc
+    import os
+    import threading
+
+    monkeypatch.setenv("GRPC_PLATFORM_TYPE", "RDMA_BP")
+    monkeypatch.setenv("TPURPC_RING_DOMAIN", "tcp_window")
+    monkeypatch.setenv("GRPC_RDMA_RING_BUFFER_SIZE_KB", "256")
+    from tpurpc.utils import config as config_mod
+
+    config_mod.set_config(None)
+    import tpurpc.rpc as rpc
+    from tpurpc.rpc.channel import Channel
+
+    def rss_kb():
+        with open("/proc/self/status") as f:
+            for ln in f:
+                if ln.startswith("VmRSS"):
+                    return int(ln.split()[1])
+
+    srv = rpc.Server(max_workers=8)
+    srv.add_method("/soakw.S/Echo",
+                   rpc.unary_unary_rpc_method_handler(lambda r, c: bytes(r)))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    try:
+        def churn(n, calls=10):
+            for _ in range(n):
+                with Channel(f"127.0.0.1:{port}") as ch:
+                    e = ch.unary_unary("/soakw.S/Echo")
+                    for _ in range(calls):
+                        e(b"w" * 512, timeout=30)
+
+        def settled_threads(timeout=5.0):
+            import time as _t
+
+            end = _t.monotonic() + timeout
+            low = threading.active_count()
+            while _t.monotonic() < end:
+                _t.sleep(0.1)
+                low = min(low, threading.active_count())
+            return low
+
+        churn(30)
+        gc.collect()
+        base_threads, base_rss = settled_threads(), rss_kb()
+        churn(120)
+        gc.collect()
+        dt_threads = settled_threads() - base_threads
+        dt_rss = rss_kb() - base_rss
+        assert dt_threads <= 12, f"thread growth {dt_threads}"
+        assert dt_rss < 60_000, f"RSS grew {dt_rss}KB over 120 connections"
+    finally:
+        srv.stop(grace=0)
+        config_mod.set_config(None)
