@@ -275,9 +275,38 @@ struct tpr_channel {
     }
 
     if (type == kMessage && (flags & kFlagCompressed)) {
-      fprintf(stderr, "tpurpc: peer sent a compressed message; the native "
-                      "client does not decompress — closing\n");
-      return 0;  // loud protocol rejection, not garbled delivery
+      // Per-stream rejection, mirroring the native server's UNIMPLEMENTED
+      // trailer: fail only the addressed stream (frames for unknown or
+      // finished streams are simply ignored) instead of tearing down the
+      // whole multiplexed connection and every unrelated in-flight call.
+      // The details text must keep "compressed messages unsupported" as a
+      // substring — the Python channel's compression negotiation keys on
+      // it (tpurpc/rpc/frame.py COMPRESSED_UNSUPPORTED_SENTINEL). The
+      // teardown sequence below intentionally mirrors the kTrailers/kRst
+      // branch tail; keep the two in sync (cq ordering under mu, draining
+      // rule).
+      CqDeliveries cq_evs;
+      std::unique_lock<std::mutex> lk(mu);
+      auto it = streams.find(sid);
+      if (it == streams.end()) return 1;  // late frame for a finished call
+      Call &c = it->second->c;
+      c.status_code = TPR_UNIMPLEMENTED;
+      c.status_details =
+          "compressed messages unsupported by the native client";
+      c.trailers_seen = true;
+      streams.erase(it);
+      drain_cq_locked(c, &cq_evs);
+      cq_push(&cq_evs);  // under mu: keeps cq ordering = generation ordering
+      bool drained = draining && streams.empty();
+      lk.unlock();
+      cv.notify_all();
+      // RST so the server stops streaming into the locally-dead stream.
+      std::vector<std::pair<std::string, std::string>> rst_md;
+      rst_md.emplace_back(":status", std::to_string(TPR_UNIMPLEMENTED));
+      rst_md.emplace_back(":message", "compressed messages unsupported");
+      std::string rst_payload = encode_metadata(rst_md);
+      send_frame(kRst, 0, sid, rst_payload.data(), rst_payload.size());
+      return drained ? 0 : 1;
     }
     CqDeliveries cq_evs;
     std::unique_lock<std::mutex> lk(mu);

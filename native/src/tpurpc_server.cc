@@ -257,7 +257,10 @@ struct tpr_server {
           // Erase the stream NOW in both branches: a fragmented compressed
           // message delivers kFlagCompressed on every fragment, and later
           // fragments must fall into the finished/unknown drop instead of
-          // re-sending these trailers.
+          // re-sending these trailers. The details text must keep
+          // "compressed messages unsupported" as a substring — the Python
+          // channel's compression negotiation keys on it
+          // (tpurpc/rpc/frame.py COMPRESSED_UNSUPPORTED_SENTINEL).
           c->streams.erase(it);
           if (call->inline_cb) {
             lk.unlock();

@@ -117,9 +117,28 @@ def test_corrupt_compressed_request_aborts_cleanly():
         srv.stop(grace=0)
 
 
-def test_unsupported_compression_rejected():
-    with pytest.raises(ValueError):
-        rpc.Channel("127.0.0.1:1", compression="deflate")
+def test_deflate_accepted_as_compression():
+    """grpcio accepts Compression.Deflate (1); a drop-in call site passing
+    it must construct (the framing honors the intent with its one codec)."""
+    ch = rpc.Channel("127.0.0.1:1", compression="deflate")
+    assert ch._compress_flag == fr.FLAG_COMPRESSED
+    ch.close()
+    ch = rpc.Channel("127.0.0.1:1", compression=1)  # Compression.Deflate
+    assert ch._compress_flag == fr.FLAG_COMPRESSED
+    ch.close()
+
+
+def test_unknown_compression_degrades_with_warning():
+    """Unknown compression values degrade to identity (warning), keeping
+    constructor drop-in compatibility instead of raising."""
+    import warnings
+
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        ch = rpc.Channel("127.0.0.1:1", compression="snappy")
+    assert ch._compress_flag == 0
+    assert any("snappy" in str(w.message) for w in caught)
+    ch.close()
 
 
 def test_channel_options_compression():

@@ -278,3 +278,78 @@ def test_native_vs_python_latency(tmp_path):
     finally:
         proc.stdin.close()
         proc.wait(timeout=10)
+
+
+def test_compressed_frame_fails_stream_not_connection():
+    """ADVICE r3: a MESSAGE with FLAG_COMPRESSED addressed to one stream
+    must fail THAT stream with UNIMPLEMENTED (the native client links no
+    decompressor) — not tear down the multiplexed connection and every
+    unrelated in-flight call. Exercised with a frame-level fake server so
+    the compressed frame can be forged (real tpurpc servers only mirror
+    compression the client asked for, which the native client never does)."""
+    import socket
+    import threading
+
+    from tpurpc.core.endpoint import TcpEndpoint
+    from tpurpc.rpc import frame as fr
+
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(1)
+    port = lsock.getsockname()[1]
+    server_err: list = []
+
+    def fake_server():
+        try:
+            sock, _ = lsock.accept()
+            ep = TcpEndpoint(sock)
+            reader = fr.FrameReader(ep, expect_preface=True)
+            writer = fr.FrameWriter(ep)
+            sids = []  # HEADERS arrival order = call submission order
+            # Collect the two calls (each: HEADERS + MESSAGE/END_STREAM).
+            while len(sids) < 2:
+                f = reader.read_frame(timeout=15)
+                assert f is not None, "client hung up early"
+                if f is fr.CONSUMED:
+                    continue
+                if f.type == fr.HEADERS:
+                    sids.append(f.stream_id)
+                # MESSAGE frames (sink=None) arrive as Frame objects: ignore
+            a, b = sids
+            # Stream A: forged compressed garbage — must kill only A.
+            # Written raw at the endpoint: FrameWriter.send would helpfully
+            # gzip (or strip the flag from) a FLAG_COMPRESSED payload.
+            forged = b"\x1f\x8b-not-really-gzip"
+            ep.write([fr.HEADER_FMT.pack(
+                fr.MESSAGE, fr.FLAG_COMPRESSED | fr.FLAG_END_STREAM,
+                a, len(forged)), forged])
+            # Stream B: clean response + OK trailers — must still deliver.
+            writer.send(fr.MESSAGE, 0, b, b"fine")
+            writer.send(fr.TRAILERS, 0, b,
+                        fr.trailers_payload(StatusCode.OK, ""))
+            # A's RST (from the per-stream rejection) may arrive; drain
+            # until EOF so the client can close cleanly.
+            while True:
+                f = reader.read_frame(timeout=15)
+                if f is None:
+                    break
+        except Exception as exc:  # surfaced in the main thread's assert
+            server_err.append(exc)
+
+    t = threading.Thread(target=fake_server, daemon=True)
+    t.start()
+    try:
+        with NativeChannel("127.0.0.1", port) as ch:
+            echo = ch.unary_unary("/n.S/Echo")
+            fut_a = echo.future(b"a", timeout=15)
+            fut_b = echo.future(b"b", timeout=15)
+            with pytest.raises(RpcError) as ei:
+                fut_a.result(timeout=20)
+            assert ei.value.code() is StatusCode.UNIMPLEMENTED
+            assert "compressed" in ei.value.details()
+            # The unrelated in-flight call on the SAME connection survives:
+            assert fut_b.result(timeout=20) == b"fine"
+    finally:
+        lsock.close()
+        t.join(timeout=5)
+    assert not server_err, server_err

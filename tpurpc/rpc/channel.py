@@ -64,15 +64,20 @@ class _ClientStream:
 
     def commit_message(self, more: bool, oversized: bool = False,
                        compressed: bool = False,
-                       recv_limit: "Optional[int]" = None) -> None:
+                       recv_limit: "Optional[int]" = None,
+                       ) -> "Optional[Tuple[StatusCode, str]]":
+        """Returns (code, details) when THIS SIDE failed the stream (bad or
+        oversized payload) — the caller owes the server an RST so it stops
+        streaming into a stream we've already finished locally."""
         if more:
-            return
+            return None
         if oversized:
             self.assembly.oversized = False
-            self.deliver_failure(
-                StatusCode.RESOURCE_EXHAUSTED,
-                "received message larger than max_receive_message_length")
-            return
+            code, details = (StatusCode.RESOURCE_EXHAUSTED,
+                             "received message larger than "
+                             "max_receive_message_length")
+            self.deliver_failure(code, details)
+            return (code, details)
         # take() detaches the storage (consumers may alias it); the Assembly
         # object itself is reusable for the next message.
         if self._acquire_credit():
@@ -84,13 +89,14 @@ class _ClientStream:
                 except fr.DecompressTooLarge as exc:
                     self.deliver_failure(StatusCode.RESOURCE_EXHAUSTED,
                                          str(exc))
-                    return
+                    return (StatusCode.RESOURCE_EXHAUSTED, str(exc))
                 except fr.FrameError as exc:
                     self.deliver_failure(StatusCode.INTERNAL, str(exc))
-                    return
+                    return (StatusCode.INTERNAL, str(exc))
             self.events.put(("message", body))
         else:
             self.assembly.take()  # stream already finished: drop
+        return None
 
     def deliver_trailers(self, code: StatusCode, details: str, md) -> None:
         self.done = True
@@ -120,10 +126,22 @@ class _ChannelSink(fr.MessageSink):
         with self._conn._lock:
             st = self._conn._streams.get(stream_id)
         if st is not None:
-            st.commit_message(bool(flags & fr.FLAG_MORE),
-                              oversized=st.assembly.oversized,
-                              compressed=bool(flags & fr.FLAG_COMPRESSED),
-                              recv_limit=self.max_message_bytes)
+            failed = st.commit_message(
+                bool(flags & fr.FLAG_MORE),
+                oversized=st.assembly.oversized,
+                compressed=bool(flags & fr.FLAG_COMPRESSED),
+                recv_limit=self.max_message_bytes)
+            if failed is not None:
+                # Stream finished locally (undecodable/oversized payload):
+                # RST so the server stops streaming into it, and drop the
+                # local stream entry so late frames go to the discard sink.
+                code, details = failed
+                try:
+                    self._conn.writer.send(fr.RST, 0, stream_id,
+                                           fr.rst_payload(code, details))
+                except (EndpointError, OSError):
+                    pass
+                self._conn.close_stream(st)
 
 
 class _Connection:
@@ -500,16 +518,24 @@ class Channel:
                 compression = opt.get("grpc.default_compression_algorithm")
         # Message compression on the tpurpc framing (FLAG_COMPRESSED; the
         # h2 wire negotiates grpc-encoding separately): requests compress,
-        # tpurpc servers mirror on responses. gzip only — accepts "gzip" or
-        # grpcio's Compression.Gzip enum value (2); 0/None = off.
+        # tpurpc servers mirror on responses. The framing's one codec is
+        # gzip, so grpcio's Compression.Deflate (1) — which a drop-in call
+        # site may legitimately pass — is honored as "compress my
+        # messages" using that codec rather than rejected at construction.
+        # Unknown values degrade to identity with a warning (grpcio
+        # tolerates unknown channel args; a constructor ValueError would
+        # break drop-in compatibility).
         if compression in (None, 0, "identity", False):
             self._compress_flag = 0
-        elif compression in ("gzip", 2) or str(compression).endswith("Gzip"):
+        elif (compression in ("gzip", "deflate", 1, 2)
+              or str(compression).endswith(("Gzip", "Deflate"))):
             self._compress_flag = fr.FLAG_COMPRESSED
         else:
-            raise ValueError(
+            import warnings
+            warnings.warn(
                 f"unsupported compression {compression!r}: the tpurpc "
-                "framing speaks gzip only (deflate lives on the h2 wire)")
+                "framing speaks gzip only — using identity", stacklevel=2)
+            self._compress_flag = 0
         #: channel-level retry policy for unary-request calls (None = off,
         #: matching gRPC's default of retries disabled without service config)
         self.retry_policy = retry_policy
@@ -765,7 +791,7 @@ class Call:
 
     def __init__(self, conn: _Connection, st: _ClientStream,
                  deserializer: Deserializer, deadline: Optional[float],
-                 counters=None):
+                 counters=None, channel: "Optional[Channel]" = None):
         self._conn = conn
         self._st = st
         self._deser = deserializer
@@ -775,6 +801,7 @@ class Call:
         self._details = ""
         self._cancelled = False
         self._counters = counters  # channelz ChannelData (counted once)
+        self._channel = channel  # for compression degrade on UNIMPLEMENTED
 
     # -- metadata/status ------------------------------------------------------
 
@@ -860,6 +887,13 @@ class Call:
         self._code = code
         self._details = details
         self._trailing = md
+        if (self._channel is not None and self._channel._compress_flag
+                and code is StatusCode.UNIMPLEMENTED
+                and fr.COMPRESSED_UNSUPPORTED_SENTINEL in details):
+            # Peer can't decompress: degrade the channel to identity so
+            # SUBSEQUENT calls (all four shapes) succeed. The unary path
+            # additionally replays this one transparently (_with_call_impl).
+            self._channel._compress_flag = 0
         self._conn.close_stream(self._st)
 
     def messages(self) -> Iterator[object]:
@@ -1038,7 +1072,8 @@ class _MultiCallable:
                            f"transport failed: {exc}") from exc
         self._channel.call_counters.on_start()
         return conn, st, Call(conn, st, self._deser, deadline,
-                              counters=self._channel.call_counters)
+                              counters=self._channel.call_counters,
+                              channel=self._channel)
 
     def _send_one(self, conn: _Connection, st: _ClientStream, request,
                   end_stream: bool) -> None:
@@ -1133,10 +1168,24 @@ class UnaryUnary(_MultiCallable):
                     return self._call_once(request, remaining(), metadata,
                                            wfr)
                 except RpcError as exc:
+                    committed = getattr(exc, "_tpurpc_committed", False)
                     refused = (_status_of(exc) is StatusCode.UNAVAILABLE
                                and "connection draining" in exc.details()
-                               and not getattr(exc, "_tpurpc_committed",
-                                               False))
+                               and not committed)
+                    # Compression negotiation by probe: a peer that can't
+                    # decompress (the native server/client) rejects the
+                    # stream with UNIMPLEMENTED before any handler runs, so
+                    # degrading the CHANNEL to identity and replaying is
+                    # safe — the grpcio equivalent of the server dropping
+                    # the codec from grpc-accept-encoding.
+                    # (Call._finish already cleared the channel flag when it
+                    # saw this trailer, so don't gate on it still being set.)
+                    if (not committed and not refused
+                            and _status_of(exc) is StatusCode.UNIMPLEMENTED
+                            and fr.COMPRESSED_UNSUPPORTED_SENTINEL
+                            in exc.details()):
+                        self._channel._compress_flag = 0
+                        refused = True
                     if not refused:
                         raise
             return self._call_once(request, remaining(), metadata, wfr)

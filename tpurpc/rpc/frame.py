@@ -57,6 +57,14 @@ FLAG_COMPRESSED = 0x08  # MESSAGE payload is gzip-compressed (whole message;
 FLAG_NO_MESSAGE = 0x04  # MESSAGE frame carries no message (pure half-close marker),
                         # distinguishing it from a genuine empty message
 
+#: Sentinel substring in the UNIMPLEMENTED trailer a decompressor-less peer
+#: sends when rejecting a FLAG_COMPRESSED stream. The channel's compression
+#: negotiation (degrade-to-identity + transparent unary replay) keys on it,
+#: so it MUST stay a substring of the native peers' wordings:
+#: native/src/tpurpc_server.cc ("compressed messages unsupported here") and
+#: native/src/tpurpc_client.cc ("... unsupported by the native client").
+COMPRESSED_UNSUPPORTED_SENTINEL = "compressed messages unsupported"
+
 _U16 = struct.Struct("<H")
 _U32 = struct.Struct("<I")
 

@@ -280,10 +280,17 @@ class _CqDriver:
                 fut.set_exception(RpcError(
                     code, ev.details.decode("utf-8", "replace")))
 
-    def close(self, cancel_inflight: bool = True):
+    def close(self, cancel_inflight: bool = True) -> bool:
         """Cancel in-flight calls, drain their completions, stop the
         puller, free the queue. Must run BEFORE tpr_channel_destroy —
-        destroying a call touches its channel."""
+        destroying a call touches its channel.
+
+        Returns True iff teardown was CLEAN: every pending call drained
+        (so its tpr_call_destroy already ran) and the puller thread
+        exited. On False the caller must NOT destroy the channel — a
+        starved puller (e.g. a slow user deserializer runs on this
+        thread for sync .future() calls) may still call
+        tpr_call_destroy on calls whose channel would then be freed."""
         if cancel_inflight:
             # Cancel UNDER the lock: the puller pops an entry (and later
             # destroys its call) while holding it, so a call still present
@@ -293,16 +300,28 @@ class _CqDriver:
                     if e["call"] and not e["done"]:
                         self._lib.tpr_call_cancel(e["call"])
         deadline = time.monotonic() + 10.0
+        drained = False
         while time.monotonic() < deadline:
             with self._lock:
                 if not self._pending:
+                    drained = True
                     break
             time.sleep(0.01)
         self._lib.tpr_cq_shutdown(self._cq)
         self._thread.join(timeout=10.0)
         if not self._thread.is_alive():
+            if not drained:
+                # tpr_cq_next keeps draining queued events after shutdown,
+                # so a slow (but finite) deserializer may have finished the
+                # backlog between the drain-wait timeout and the join —
+                # re-check rather than return the stale snapshot (which
+                # would leak the channel for nothing).
+                with self._lock:
+                    drained = not self._pending
             self._lib.tpr_cq_destroy(self._cq)
+            return drained
         # else: leak the cq — a wedged puller beats a use-after-free
+        return False
 
 
 class NativeChannel:
@@ -446,8 +465,11 @@ class NativeChannel:
         if ch:
             # CQ teardown first: destroying a call touches its channel, so
             # every future's call must be destroyed before the channel is.
-            if drv is not None:
-                drv.close()
+            # If the driver could not prove a clean drain (wedged/starved
+            # puller still holding live calls), leak the channel too — the
+            # same leak-beats-use-after-free policy the cq itself uses.
+            if drv is not None and not drv.close():
+                return
             self._lib.tpr_channel_destroy(ch)
 
     def __del__(self):
