@@ -12,6 +12,23 @@
 set -u
 cd "$(dirname "$0")/.."
 LOG=bench/results/harvest.log
+
+# Single-instance lock: a restarted harvester REPLACES the old loop instead
+# of doubling probe load on the shared 1-core host (two loops observed
+# interleaving in round 4's log — each probe costs a timeout-bounded jax
+# import attempt).
+PIDFILE=bench/results/harvest.pid
+if [ -f "$PIDFILE" ]; then
+  oldpid=$(cat "$PIDFILE" 2>/dev/null || true)
+  if [ -n "${oldpid:-}" ] && kill -0 "$oldpid" 2>/dev/null \
+     && grep -q harvest "/proc/$oldpid/cmdline" 2>/dev/null; then
+    echo "=== replacing old harvest loop pid $oldpid with $$ ===" >> "$LOG"
+    kill "$oldpid" 2>/dev/null || true
+    sleep 1
+  fi
+fi
+echo $$ > "$PIDFILE"
+
 echo "=== harvest loop start $(date -u +%FT%TZ) pid $$ ===" >> "$LOG"
 
 probe() {
