@@ -369,7 +369,11 @@ def _run_once(env, n_msgs: int, ready_s: float):
             # slow outliers — contamination on this host is always one-sided:
             # a neighbor stealing the core makes rounds slower, never
             # faster), plus best-round alongside for ceiling-spotting.
-            rounds = int(os.environ.get("TPURPC_BENCH_ROUNDS", "5"))
+            try:
+                rounds = max(1, int(os.environ.get("TPURPC_BENCH_ROUNDS",
+                                                   "5")))
+            except ValueError:
+                rounds = 5
             dts = []
             for _ in range(rounds):
                 t0 = time.perf_counter()

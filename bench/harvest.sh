@@ -13,21 +13,34 @@ set -u
 cd "$(dirname "$0")/.."
 LOG=bench/results/harvest.log
 
+# Become a process-group leader so a future replacement can kill the whole
+# tree — probe/chipcheck/bench children included — with one signal (killing
+# only the shell orphans an in-flight `timeout 1800 python bench.py` for up
+# to 30 min of doubled load).
+if [ -z "${HARVEST_PGLEADER:-}" ]; then
+  HARVEST_PGLEADER=1 exec setsid bash "$0" "$@"
+fi
+
 # Single-instance lock: a restarted harvester REPLACES the old loop instead
 # of doubling probe load on the shared 1-core host (two loops observed
 # interleaving in round 4's log — each probe costs a timeout-bounded jax
-# import attempt).
+# import attempt). Acquisition is atomic (noclobber) so two simultaneous
+# starts can't both pass a check-then-write race.
 PIDFILE=bench/results/harvest.pid
-if [ -f "$PIDFILE" ]; then
+acquire_lock() { (set -C; echo $$ > "$PIDFILE") 2>/dev/null; }
+if ! acquire_lock; then
   oldpid=$(cat "$PIDFILE" 2>/dev/null || true)
   if [ -n "${oldpid:-}" ] && kill -0 "$oldpid" 2>/dev/null \
      && grep -q harvest "/proc/$oldpid/cmdline" 2>/dev/null; then
     echo "=== replacing old harvest loop pid $oldpid with $$ ===" >> "$LOG"
-    kill "$oldpid" 2>/dev/null || true
+    kill -- "-$oldpid" 2>/dev/null || kill "$oldpid" 2>/dev/null || true
+    pkill -P "$oldpid" 2>/dev/null || true   # pre-setsid loops: reap children
     sleep 1
   fi
+  rm -f "$PIDFILE"
+  acquire_lock || { echo "=== lost lock race; exiting pid $$ ===" >> "$LOG"; exit 0; }
 fi
-echo $$ > "$PIDFILE"
+trap 'rm -f "$PIDFILE"' EXIT
 
 echo "=== harvest loop start $(date -u +%FT%TZ) pid $$ ===" >> "$LOG"
 
