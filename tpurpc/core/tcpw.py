@@ -13,8 +13,9 @@ Design (and how it mirrors verbs semantics):
 
 - Each process runs ONE record server (lazy singleton). ``alloc`` registers
   a plain local buffer under a 16-byte key and hands out a handle
-  ``tcpw:<host>:<port>:<key>`` — the moral equivalent of an ``ibv_mr``
-  rkey + raddr envelope (``memory_region.h:14-47``).
+  ``tcpw:<host>:<port>:<key>:<secret>`` — the moral equivalent of an
+  ``ibv_mr`` rkey + raddr envelope (``memory_region.h:14-47``), plus the
+  per-region HMAC secret only the bootstrap channel ever carries.
 - ``open_window(handle)`` attaches to the peer process's record server.
   ``Window.write(offset, data)`` ships a ``(key, offset, len, payload)``
   record; the peer's applier thread lands it in the region buffer. The
@@ -37,11 +38,22 @@ Security note: the record stream is a SEPARATE plaintext TCP connection —
 TLS on the RPC port encrypts the bootstrap/notify channel but not these
 one-sided writes (exactly like the reference, whose RDMA payloads bypass
 TLS on the NIC: SURVEY §2.4 "security sits above the endpoint seam").
-Deploy on trusted network segments or under an encrypted overlay.
+Write AUTHORIZATION, however, is stronger than possession of the 16-byte
+region key: every record carries a truncated HMAC-SHA256 over its header
+and payload, keyed by a per-region 32-byte secret that travels only inside
+the region handle — i.e. over the bootstrap channel, which CAN be TLS.
+A connection that delivers a record failing verification is dropped on the
+spot; garbage or forged streams cannot land a single byte in a region
+(``tests/test_tcpw.py::test_forged_records_cannot_land_bytes``). What this
+does NOT provide: confidentiality, or replay protection against an
+on-path observer of the plaintext record stream — for that, deploy on
+trusted segments or under an encrypted overlay, as with the reference's
+NIC-bypassing RDMA.
 """
 
 from __future__ import annotations
 
+import hmac as _hmac
 import os
 import socket
 import struct
@@ -54,9 +66,18 @@ from tpurpc.utils.trace import TraceFlag
 
 trace_tcpw = TraceFlag("tcpw")
 
-#: record header: region key (16B), offset (u64), payload length (u32)
+#: record header: region key (16B), offset (u64), payload length (u32);
+#: followed on the wire by a 16-byte truncated HMAC-SHA256 (header+payload,
+#: per-region secret), then the payload
 _REC = struct.Struct("<16sQI")
-_HELLO = b"TPWD"  # protocol guard on the record connection
+_MAC_LEN = 16
+_HELLO = b"TPW2"  # protocol guard; bumped from TPWD when records grew MACs
+
+
+def _record_mac(secret: bytes, hdr: bytes, payload) -> bytes:
+    h = _hmac.new(secret, hdr, "sha256")
+    h.update(payload)
+    return h.digest()[:_MAC_LEN]
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
@@ -74,6 +95,26 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
             return None
         filled += got
     return bytes(buf)
+
+
+def _recv_discard(sock: socket.socket, n: int) -> bool:
+    """Consume n stream bytes WITHOUT an n-sized allocation — for records
+    that will be dropped anyway (unknown key, oversized length). The wire
+    length field is attacker-controlled; allocating it before any
+    authorization check would hand an unauthenticated connection a 4 GiB
+    bytearray per record."""
+    scratch = bytearray(min(n, 65536))
+    view = memoryview(scratch)
+    left = n
+    while left:
+        try:
+            got = sock.recv_into(view[:min(left, len(scratch))])
+        except OSError:
+            return False
+        if not got:
+            return False
+        left -= got
+    return True
 
 
 class _RecordServer:
@@ -99,7 +140,8 @@ class _RecordServer:
         from tpurpc.utils.config import get_config
 
         self.pid = os.getpid()
-        self._regions: "Dict[bytes, Region]" = {}
+        #: key -> (region, per-region HMAC secret)
+        self._regions: "Dict[bytes, Tuple[Region, bytes]]" = {}
         self._reg_lock = threading.Lock()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -123,9 +165,9 @@ class _RecordServer:
 
     # -- region registry -----------------------------------------------------
 
-    def register(self, key: bytes, region: Region) -> None:
+    def register(self, key: bytes, region: Region, secret: bytes) -> None:
         with self._reg_lock:
-            self._regions[key] = region
+            self._regions[key] = (region, secret)
 
     def unregister(self, key: bytes) -> None:
         with self._reg_lock:
@@ -154,20 +196,52 @@ class _RecordServer:
             if _recv_exact(conn, len(_HELLO)) != _HELLO:
                 trace_tcpw.log("record conn with bad hello; dropping")
                 return
+            # Budget for records that cannot be MAC-verified (unknown key,
+            # oversized length): a few are legit — writes racing region
+            # teardown, the deregistered-MR analog — but an unauthenticated
+            # attacker must not get to stream them forever (or use them as
+            # a live-key oracle at zero cost). Exhausting it drops the
+            # connection; a real peer whose regions are being torn down en
+            # masse just reconnects.
+            unverified_budget = 64
             while True:
                 hdr = _recv_exact(conn, _REC.size)
                 if hdr is None:
                     return
                 key, off, ln = _REC.unpack(hdr)
+                mac = _recv_exact(conn, _MAC_LEN)
+                if mac is None:
+                    return
+                with self._reg_lock:
+                    entry = self._regions.get(key)
+                # Authorization-before-allocation: the wire length is only
+                # trusted up to the registered region's size; everything
+                # else is skimmed through a bounded scratch and dropped.
+                if entry is None or ln > len(entry[0].buf):
+                    if not _recv_discard(conn, ln):
+                        return
+                    unverified_budget -= 1
+                    trace_tcpw.log(
+                        "discarding %dB unverifiable write (%s); budget %d",
+                        ln, "dead region" if entry is None else "oversized",
+                        unverified_budget)
+                    if unverified_budget <= 0:
+                        return
+                    continue
                 payload = _recv_exact(conn, ln)
                 if payload is None:
                     return
-                with self._reg_lock:
-                    region = self._regions.get(key)
-                if region is None:
-                    # write raced region teardown: the deregistered-MR analog
-                    trace_tcpw.log("discarding %dB write to dead region", ln)
-                    continue
+                region, secret = entry
+                if not _hmac.compare_digest(
+                        mac, _record_mac(secret, hdr, payload)):
+                    # Forged/garbage record: authorization is possession of
+                    # the per-region SECRET (bootstrap-channel delivered),
+                    # not the guessable-on-the-wire key. The sender is
+                    # either an attacker or hopelessly desynced — drop the
+                    # whole connection, land nothing.
+                    trace_tcpw.log("record failed HMAC verification; "
+                                   "dropping connection")
+                    return
                 try:
                     buf = region.buf
                     if off + ln > len(buf):
@@ -236,7 +310,7 @@ class _PeerLink:
         self._send_lock = threading.Lock()
         self._sock.sendall(_HELLO)
 
-    def write(self, key: bytes, off: int, data) -> None:
+    def write(self, key: bytes, off: int, data, secret: bytes) -> None:
         with self._send_lock:
             if self.dead:
                 raise ConnectionError("tcp_window peer link closed")
@@ -245,14 +319,15 @@ class _PeerLink:
                 # stop short on backpressure, so finish the record with
                 # sendall — the lock holds until the record is whole, which
                 # is what keeps the shared stream parseable.
-                hdr = _REC.pack(key, off, len(data))
                 view = memoryview(data).cast("B")
-                sent = self._sock.sendmsg([hdr, view])
-                if sent < len(hdr):
-                    self._sock.sendall(hdr[sent:])
-                    sent = len(hdr)
-                if sent < len(hdr) + len(view):
-                    self._sock.sendall(view[sent - len(hdr):])
+                hdr = _REC.pack(key, off, len(view))
+                pre = hdr + _record_mac(secret, hdr, view)
+                sent = self._sock.sendmsg([pre, view])
+                if sent < len(pre):
+                    self._sock.sendall(pre[sent:])
+                    sent = len(pre)
+                if sent < len(pre) + len(view):
+                    self._sock.sendall(view[sent - len(pre):])
             except OSError:
                 # any send failure may have transmitted a PARTIAL record:
                 # the stream is misaligned beyond repair — poison the link
@@ -286,31 +361,35 @@ class TcpWindowDomain(MemoryDomain):
     def alloc(self, nbytes: int) -> Region:
         server = _RecordServer.get()
         key = uuid.uuid4().bytes
+        # Write-authorization secret: travels ONLY inside the handle, i.e.
+        # over the bootstrap channel (TLS-capable) — never on the record
+        # stream. Possession of it is what lets a peer land bytes here.
+        secret = os.urandom(32)
         buf = bytearray(nbytes)
-        mv = memoryview(buf)
         from tpurpc.utils.config import get_config
 
-        handle = f"tcpw:{get_config().tcpw_host}:{server.port}:{key.hex()}"
+        handle = (f"tcpw:{get_config().tcpw_host}:{server.port}:"
+                  f"{key.hex()}:{secret.hex()}")
 
         def _close():
             server.unregister(key)
 
-        del mv
         region = Region(handle, buf, _close)
         # registered as the Region itself: the applier lands bytes through
         # region.buf and runs its on_write kick (async-domain wakeup contract)
-        server.register(key, region)
+        server.register(key, region, secret)
         return region
 
     def open_window(self, handle: str, nbytes: int) -> Window:
         if not handle.startswith("tcpw:"):
             raise ValueError(f"not a tcp_window handle: {handle!r}")
-        host, port_s, key_hex = handle[5:].rsplit(":", 2)
+        host, port_s, key_hex, secret_hex = handle[5:].rsplit(":", 3)
         key = bytes.fromhex(key_hex)
+        secret = bytes.fromhex(secret_hex)
         link = _PeerLink.attach(host, int(port_s))
 
         def write(off: int, data) -> None:
-            link.write(key, off, data)
+            link.write(key, off, data, secret)
 
         # view=None: not host-addressable from this side (cross-host); the
         # pair's native fast paths check for None and stay on the portable
