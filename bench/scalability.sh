@@ -1,0 +1,42 @@
+#!/bin/bash
+# Multi-client scalability sweep (VERDICT r3 next-round #2): aggregate
+# RPC/s + RTT percentiles at 1/8/32/128 client connections, ring vs TCP,
+# closed-loop streaming ping-pong (the reference's measured mode) and
+# CQ-pipelined unary. The reference's counterpart numbers live in
+# examples/cpp/micro-bench/draw/tput-scalability/ (5.23M RPC/s aggregate at
+# 128 clients on dedicated multicore IB-EDR hosts); this host is ONE shared
+# core carrying client threads + server pollers + handlers, so absolute
+# aggregates are not comparable — the axes that matter here are (a) the
+# server holding 128 concurrent connections with bounded threads (the
+# shared-poller model, tpurpc_server.cc; reference poller.cc:52-106) and
+# (b) ring vs TCP at every connection count.
+#
+# Usage: bash bench/scalability.sh   (run on an otherwise idle host)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+BIN=native/build/micro_native
+g++ -std=c++17 -O2 native/bench/micro_native.cc native/src/tpurpc_client.cc \
+    native/src/tpurpc_server.cc native/src/ring.cc -Inative/include \
+    -lpthread -o "$BIN"
+
+OUT=bench/results/scalability_1core.log
+{
+  echo "# micro_native multi-client scalability: native C clients<->shared-poller server, $(nproc)-core host"
+  echo "# $(date -u +%FT%TZ) | cols: connections x platform | format: reference tput-scalability log lines"
+  echo "# reference (IB EDR, multicore, 128 clients): 5.23M RPC/s aggregate (BASELINE.md)"
+  for plat in TCP RDMA_BP; do
+    for conns in 1 8 32 128; do
+      echo "## platform=$plat connections=$conns req_size=64 streaming=1"
+      GRPC_PLATFORM_TYPE=$plat timeout 180 "$BIN" 64 4 "$conns" 1
+    done
+  done
+  echo "#"
+  echo "# == CQ-pipelined unary, depth 8 per connection =="
+  for plat in TCP RDMA_BP; do
+    for conns in 1 8 32; do
+      echo "## platform=$plat connections=$conns req_size=64 streaming=0 outstanding=8"
+      GRPC_PLATFORM_TYPE=$plat timeout 180 "$BIN" 64 4 "$conns" 0 1 8
+    done
+  done
+} | tee "$OUT"
+echo "wrote $OUT"

@@ -2,15 +2,19 @@
  *
  * Counterpart of client.h; together they are the app-facing native surface
  * the reference provides as src/cpp/server (ServerBuilder / sync service,
- * SURVEY.md §1 L7). Scope: blocking handlers on a thread-per-connection
- * accept loop, all four call shapes expressed through one call object
- * (read-until-end / write-many / finish-with-status).
+ * SURVEY.md §1 L7). Scope: blocking handlers, all four call shapes
+ * expressed through one call object (read-until-end / write-many /
+ * finish-with-status).
  *
- * Each connection has a reader thread that demuxes frames to per-stream
- * call objects; every call's handler runs on its OWN thread, so concurrent
- * calls — whether multiplexed on one connection (as tpurpc Python channels
- * do) or on separate connections — execute concurrently. Handlers sharing
- * state must synchronize accordingly.
+ * Threading (round 4, the reference Poller model — ibverbs/poller.cc:52-106,
+ * capacity 4096 pairs over N threads): connections are MULTIPLEXED over a
+ * small fixed set of poller threads (TPURPC_SERVER_POLLERS /
+ * GRPC_RDMA_POLLER_THREAD_NUM, default 1) that epoll every connection's
+ * event fd and parse frames incrementally — NOT a thread per connection,
+ * so the server holds hundreds of concurrent ring/TCP connections with
+ * bounded threads. Callback-API (`tpr_server_register_callback`) handlers
+ * run inline on the poller thread; handler-API (`tpr_server_register`)
+ * calls still get a dedicated thread each (they block in tpr_srv_recv).
  */
 #ifndef TPURPC_SERVER_H
 #define TPURPC_SERVER_H
@@ -62,6 +66,17 @@ int tpr_server_start(tpr_server *s);
 /* Stop accepting, close connections, join threads, free. */
 void tpr_server_destroy(tpr_server *s);
 
+/* Adopt an ALREADY-ACCEPTED connected socket: the server takes ownership
+ * of `fd`, sniffs the protocol (ring bootstrap magic vs framing preface)
+ * and serves it exactly like an accepted connection. `preread` replays
+ * bytes the caller already consumed from the socket (<= 4; pass NULL/0
+ * when the caller peeked instead). This is the seam a language-level
+ * server (tpurpc/rpc/server.py) uses to put its accepted connections on
+ * the native data plane. Requires tpr_server_start to have run. Returns
+ * 0 on success, -1 on refusal (server stopping / preread too long). */
+int tpr_server_adopt_fd(tpr_server *s, int fd, const uint8_t *preread,
+                        size_t preread_len);
+
 /* -- inside a handler -- */
 
 /* Next request message: 1 = got one (*data/*len set, free with
@@ -80,6 +95,24 @@ int64_t tpr_srv_deadline_us(tpr_server_call *c);
 
 /* Set the trailers' :message detail (optional, before returning). */
 void tpr_srv_set_details(tpr_server_call *c, const char *details);
+
+/* Request metadata (every header the client sent except :path/:timeout-us).
+ * Pointers are valid for the handler's duration. */
+size_t tpr_srv_metadata_count(tpr_server_call *c);
+int tpr_srv_metadata_get(tpr_server_call *c, size_t i, const char **key,
+                         const char **val);
+
+/* Queue initial metadata (sent as a HEADERS frame before the first
+ * response message; no-op after the first send). */
+void tpr_srv_send_initial_md(tpr_server_call *c, const char *key,
+                             const char *val);
+
+/* Add a custom trailing-metadata pair to the final trailers. */
+void tpr_srv_add_trailing_md(tpr_server_call *c, const char *key,
+                             const char *val);
+
+/* 1 when the client cancelled (RST) or the connection died. */
+int tpr_srv_cancelled(tpr_server_call *c);
 
 void tpr_srv_buf_free(uint8_t *data);
 

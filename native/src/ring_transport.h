@@ -362,6 +362,51 @@ struct RingTransport {
     return read_exact_deadline(buf, len, nullptr) == 1;
   }
 
+  // -- shared-poller (epoll) primitives ------------------------------------
+  // The server's shared poller multiplexes many connections on one thread:
+  // it epolls event_fd() (level-triggered), drains tokens, then pumps
+  // read_some() until the ring is dry — no blocking read_exact on the
+  // poller thread (the reference Poller's role, poller.cc:52-106).
+
+  int event_fd() const { return notify_fd; }
+
+  // Nonblocking drain of queued notify tokens. Returns -1 when the peer
+  // closed the event channel (connection over), else the token count.
+  int drain_tokens() {
+    char tokens[256];
+    int total = 0;
+    while (true) {
+      ssize_t n = ::recv(notify_fd, tokens, sizeof tokens, MSG_DONTWAIT);
+      if (n == 0) {  // peer closed
+        peer_exited = true;
+        return -1;
+      }
+      if (n < 0) break;  // EAGAIN: drained
+      for (ssize_t i = 0; i < n; ++i)
+        if (tokens[i] == 'x') peer_exited = true;
+      total += static_cast<int>(n);
+      if (n < static_cast<ssize_t>(sizeof tokens)) break;
+    }
+    return total;
+  }
+
+  // Nonblocking ring read: up to `max` framing-stream bytes into buf.
+  // Returns bytes read (0 = nothing available), or -1 when the stream is
+  // over (peer gone with an empty ring, or corruption).
+  ssize_t read_some(void *buf, size_t max) {
+    uint64_t got = tpr_ring_read_into(recv_ring.base, ring_size, &head,
+                                      &msg_len, &msg_read,
+                                      static_cast<uint8_t *>(buf), max,
+                                      &consumed, &rseq);
+    if (got == ~0ULL) return -1;  // corruption
+    if (got) {
+      publish_credits_if_due();
+      return static_cast<ssize_t>(got);
+    }
+    if (!alive.load() || ring_empty_and_peer_gone()) return -1;
+    return 0;
+  }
+
   // Deadline-aware read for the inline-pump discipline: 1 = filled,
   // -1 = dead, 0 = deadline passed with ZERO bytes consumed — the stream
   // is intact, so a frame-header read can be abandoned cleanly at a frame

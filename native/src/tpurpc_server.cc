@@ -1,10 +1,23 @@
 // tpurpc C server implementation — native app servers over the framing.
 //
-// Wire format: tpurpc/rpc/frame.py via framing_common.h. Model: accept-loop
-// thread + one reader thread per connection that DEMUXES frames to
-// per-stream call objects (tpurpc Python channels multiplex concurrent
-// calls over one connection, so per-stream routing is mandatory, not a
-// nicety); each call's handler runs on its own thread. The reference's
+// Wire format: tpurpc/rpc/frame.py via framing_common.h.
+//
+// Threading model (round 4, replacing thread-per-connection): connections
+// are multiplexed over a FIXED set of poller threads — the role of the
+// reference's Poller (src/core/lib/ibverbs/poller.cc:52-106, which
+// round-robins up to 4096 pairs over N background threads). Each poller
+// owns an epoll set of its connections' event fds (the TCP data fd, or the
+// ring's notify fd) and parses frames INCREMENTALLY per connection, so one
+// thread serves any number of connections and a 128-connection fan-in
+// costs 1 poller + handler threads, not 128 readers. The accept loop only
+// accepts; a short-lived thread per NEW connection runs the (blocking,
+// bounded) protocol sniff + ring bootstrap, then hands the connection to a
+// poller and exits.
+//
+// Call dispatch is unchanged: frames demux to per-stream call objects
+// (tpurpc Python channels multiplex concurrent calls over one connection);
+// callback-API handlers run inline on the poller thread; handler-API calls
+// run on a thread each (they block in tpr_srv_recv). The reference's
 // equivalent machinery is src/cpp/server/ + surface/server.cc's
 // registered-method dispatch, collapsed to tpurpc scale.
 
@@ -13,8 +26,10 @@
 #include "ring_transport.h"
 
 #include <arpa/inet.h>
+#include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <sys/epoll.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -44,14 +59,23 @@ struct tpr_server_call {
   std::string method;
   int64_t deadline_us = INT64_MAX;  // absolute, vs Clock epoch
   std::string details;
+  //: every request header except :path/:timeout-us (exposed to handlers —
+  //: the invocation_metadata a language-level server needs)
+  std::vector<std::pair<std::string, std::string>> md;
+  //: queued initial metadata; shipped as a HEADERS frame before the first
+  //: response message
+  std::vector<std::pair<std::string, std::string>> initial_md;
+  bool initial_md_sent = false;
+  //: custom trailing metadata appended to the final trailers
+  std::vector<std::pair<std::string, std::string>> trailing_md;
 
-  // reader-thread-filled state, guarded by conn->mu
+  // reader/poller-filled state, guarded by conn->mu
   std::deque<std::string> pending;  // complete messages
   std::string partial;              // MORE-fragment accumulator
   bool half_closed = false;         // client END_STREAM seen
   bool cancelled = false;           // RST / connection death
 
-  // callback-API calls: handled inline on the reader thread (no thread,
+  // callback-API calls: handled inline on the poller thread (no thread,
   // no pending queue — each complete message goes straight to the cb)
   int (*inline_cb)(tpr_server_call *, const uint8_t *, size_t, void *) =
       nullptr;
@@ -59,6 +83,8 @@ struct tpr_server_call {
 };
 
 namespace {
+
+struct Poller;
 
 struct Conn {
   int fd = -1;
@@ -72,8 +98,24 @@ struct Conn {
   std::map<uint32_t, tpr_server_call *> streams;
   std::atomic<bool> alive{true};
   std::atomic<bool> fd_closed{false};
-  std::thread thread;
   std::atomic<int> handler_threads{0};
+  //: teardown ran (streams failed, fd closed)
+  std::atomic<bool> finished{false};
+  //: safe to free: set only after the conn's poller can no longer hold a
+  //: stale epoll event for it (end of the batch that finished it), or by
+  //: non-poller finishers — reap requires it (frees must not race a
+  //: same-batch duplicate event's `finished` load)
+  std::atomic<bool> reapable{false};
+  Poller *poller = nullptr;  // the poller serving this conn (post-bootstrap)
+
+  // -- incremental frame parse (poller-thread-owned) -----------------------
+  uint8_t hdr[10];
+  size_t got = 0;            // bytes of the CURRENT unit (header or payload)
+  bool in_payload = false;
+  uint8_t f_type = 0, f_flags = 0;
+  uint32_t f_sid = 0;
+  size_t f_len = 0;
+  std::vector<uint8_t> payload;
 
   ~Conn() {
     if (ring) {
@@ -81,6 +123,8 @@ struct Conn {
       delete ring;
     }
   }
+
+  int event_fd() const { return ring ? ring->event_fd() : fd; }
 
   bool write_all(const void *buf, size_t len) {
     return ring ? ring->write_all(buf, len) : fd_write_all(fd, buf, len);
@@ -90,21 +134,43 @@ struct Conn {
     return ring ? ring->read_exact(buf, len) : fd_read_exact(fd, buf, len);
   }
 
+  // Nonblocking byte-stream read for the poller: >0 bytes, 0 would-block,
+  // -1 dead. TCP uses MSG_DONTWAIT (the fd itself stays blocking so
+  // handler-thread WRITES keep their simple semantics).
+  ssize_t read_some(void *buf, size_t max) {
+    if (ring) return ring->read_some(buf, max);
+    ssize_t n = ::recv(fd, buf, max, MSG_DONTWAIT);
+    if (n > 0) return n;
+    if (n == 0) return -1;  // EOF
+    return (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR) ? 0
+                                                                       : -1;
+  }
+
   bool send_frame(uint8_t type, uint8_t flags, uint32_t sid,
-                  const void *payload, size_t len) {
+                  const void *payload_, size_t len) {
     std::lock_guard<std::mutex> lk(write_mu);
     if (fd_closed.load()) return false;
     if (ring)  // one gathered ring message + one notify per frame
-      return ring_send_frame_locked(*ring, type, flags, sid, payload, len);
-    return t_send_frame_locked(*this, type, flags, sid, payload, len);
+      return ring_send_frame_locked(*ring, type, flags, sid, payload_, len);
+    return t_send_frame_locked(*this, type, flags, sid, payload_, len);
   }
 
-  void send_trailers(uint32_t sid, int code, const std::string &details) {
+  void send_trailers(uint32_t sid, int code, const std::string &details,
+                     const std::vector<std::pair<std::string, std::string>>
+                         *extra_md = nullptr) {
     std::vector<std::pair<std::string, std::string>> md;
     md.emplace_back(":status", std::to_string(code));
     if (!details.empty()) md.emplace_back(":message", details);
-    std::string payload = encode_metadata(md);
-    send_frame(kTrailers, kFlagEndStream, sid, payload.data(), payload.size());
+    if (extra_md)
+      for (const auto &kv : *extra_md) md.push_back(kv);
+    std::string payload_ = encode_metadata(md);
+    send_frame(kTrailers, kFlagEndStream, sid, payload_.data(),
+               payload_.size());
+  }
+
+  void finish_call_trailers(tpr_server_call *call, int code) {
+    send_trailers(call->stream_id, code, call->details,
+                  call->trailing_md.empty() ? nullptr : &call->trailing_md);
   }
 
   void close_fd() {
@@ -129,6 +195,60 @@ struct Conn {
   }
 };
 
+// One epoll loop serving N connections (the reference Poller role). Conns
+// are added via a locked pending list + wake pipe (epoll_ctl from another
+// thread is safe, but the add must also trigger an initial pump — ring
+// data that landed during bootstrap sends no further notify token).
+struct Poller {
+  int epfd = -1;
+  int wake_r = -1, wake_w = -1;
+  std::thread th;
+  std::mutex add_mu;
+  std::vector<Conn *> pending_add;
+  std::atomic<bool> running{true};
+  tpr_server *srv = nullptr;
+
+  bool init() {
+    epfd = ::epoll_create1(0);
+    if (epfd < 0) return false;
+    int p[2];
+    if (::pipe(p) != 0) return false;
+    wake_r = p[0];
+    wake_w = p[1];
+    ::fcntl(wake_r, F_SETFL, O_NONBLOCK);  // drain loop must never block
+    struct epoll_event ev = {};
+    ev.events = EPOLLIN;
+    ev.data.ptr = nullptr;  // null = wake pipe
+    ::epoll_ctl(epfd, EPOLL_CTL_ADD, wake_r, &ev);
+    return true;
+  }
+
+  void add(Conn *c) {
+    {
+      std::lock_guard<std::mutex> lk(add_mu);
+      pending_add.push_back(c);
+    }
+    char b = 'a';
+    (void)!::write(wake_w, &b, 1);
+  }
+
+  void wake() {
+    char b = 'w';
+    (void)!::write(wake_w, &b, 1);
+  }
+
+  void stop_and_join() {
+    running.store(false);
+    wake();
+    if (th.joinable()) th.join();
+    if (epfd >= 0) ::close(epfd);
+    if (wake_r >= 0) ::close(wake_r);
+    if (wake_w >= 0) ::close(wake_w);
+  }
+
+  void loop();  // defined after tpr_server (needs dispatch)
+};
+
 }  // namespace
 
 struct tpr_server {
@@ -140,6 +260,18 @@ struct tpr_server {
   std::map<std::string, std::pair<tpr_msg_cb, void *>> cb_handlers;
   std::mutex conns_mu;
   std::vector<Conn *> conns;
+  std::vector<Poller *> pollers;
+  std::atomic<size_t> next_poller{0};
+  std::atomic<int> bootstrap_threads{0};
+
+  static int poller_count_from_env() {
+    const char *v = getenv("TPURPC_SERVER_POLLERS");
+    if (!v) v = getenv("GRPC_RDMA_POLLER_THREAD_NUM");
+    int n = v ? atoi(v) : 1;
+    if (n < 1) n = 1;
+    if (n > 64) n = 64;
+    return n;
+  }
 
   void run_handler(Conn *c, tpr_server_call *call) {
     auto it = handlers.find(call->method);
@@ -156,17 +288,22 @@ struct tpr_server {
       was_cancelled = call->cancelled;
       c->streams.erase(call->stream_id);
     }
-    if (!was_cancelled) c->send_trailers(call->stream_id, code, call->details);
+    if (!was_cancelled) c->finish_call_trailers(call, code);
     delete call;
     c->handler_threads.fetch_sub(1);
   }
 
   // Protocol sniff + preface, mirroring the Python listener (peek_protocol,
   // endpoint.py): ring clients open with the 4-byte TRB1 bootstrap magic;
-  // plain framing clients send the 8-byte TPURPC preface. False = dead conn.
-  bool accept_preface(Conn *c) {
+  // plain framing clients send the 8-byte TPURPC preface. Runs BLOCKING on
+  // the short-lived bootstrap thread (bounded by the client's handshake).
+  // `preread` replays sniff bytes an adopting caller already consumed.
+  bool accept_preface(Conn *c, const uint8_t *preread, size_t preread_len) {
     char magic[8];
-    if (!fd_read_exact(c->fd, magic, 4)) return false;
+    size_t have = preread_len < 4 ? preread_len : 4;
+    if (have) memcpy(magic, preread, have);
+    if (have < 4 && !fd_read_exact(c->fd, magic + have, 4 - have))
+      return false;
     if (memcmp(magic, "TRB1", 4) == 0) {
       auto *rt = new tpr_ring::RingTransport();
       std::string err;
@@ -186,178 +323,230 @@ struct tpr_server {
            memcmp(magic, kMagic, 8) == 0;
   }
 
-  void serve_conn(Conn *c) {
-    bool serving = accept_preface(c);
-    // a failed preface still falls through to the shared teardown below:
-    // early returns here used to leak the Conn (alive stayed true, so
-    // reap_dead_conns never freed it) and its fd
-    uint8_t type, flags;
-    uint32_t sid;
-    std::vector<uint8_t> payload;
-    while (serving && running.load() && c->alive.load()) {
-      if (!t_read_frame(*c, &type, &flags, &sid, &payload)) break;
-      if (type == kPing) {
-        c->send_frame(kPong, 0, 0, payload.data(), payload.size());
-        continue;
+  // Dispatch one complete frame for `c`. Mirrors the pre-rework
+  // serve_conn body; returns false when the connection must end.
+  bool on_frame(Conn *c, uint8_t type, uint8_t flags, uint32_t sid,
+                std::vector<uint8_t> &payload) {
+    if (type == kPing) {
+      c->send_frame(kPong, 0, 0, payload.data(), payload.size());
+      return true;
+    }
+    if (type == kHeaders) {
+      std::vector<std::pair<std::string, std::string>> md;
+      if (!decode_metadata(payload.data(), payload.size(), &md)) return false;
+      auto *call = new tpr_server_call();
+      call->conn = c;
+      call->stream_id = sid;
+      for (auto &kv : md) {
+        if (kv.first == ":path") {
+          call->method = kv.second;
+        } else if (kv.first == ":timeout-us") {
+          call->deadline_us =
+              std::chrono::duration_cast<std::chrono::microseconds>(
+                  Clock::now().time_since_epoch()).count() +
+              atoll(kv.second.c_str());
+        } else {
+          call->md.emplace_back(kv.first, kv.second);
+        }
       }
-      if (type == kHeaders) {
-        std::vector<std::pair<std::string, std::string>> md;
-        if (!decode_metadata(payload.data(), payload.size(), &md)) break;
-        auto *call = new tpr_server_call();
-        call->conn = c;
-        call->stream_id = sid;
-        for (auto &kv : md) {
-          if (kv.first == ":path") call->method = kv.second;
-          else if (kv.first == ":timeout-us")
-            call->deadline_us =
-                std::chrono::duration_cast<std::chrono::microseconds>(
-                    Clock::now().time_since_epoch()).count() +
-                atoll(kv.second.c_str());
-        }
-        bool duplicate;
-        {
-          std::lock_guard<std::mutex> lk(c->mu);
-          duplicate = c->streams.count(sid) != 0;
-          if (!duplicate) c->streams[sid] = call;
-        }
-        if (duplicate) {
-          // duplicate HEADERS on an active sid: protocol violation —
-          // overwriting would orphan one call's frame routing forever
-          c->send_trailers(sid, 13, "duplicate stream id");  // INTERNAL
-          delete call;
-          continue;
-        }
-        auto cb_it = cb_handlers.find(call->method);
-        if (cb_it != cb_handlers.end()) {
-          // callback API: no thread — messages dispatch inline below
-          call->inline_cb = cb_it->second.first;
-          call->inline_ud = cb_it->second.second;
-          if (flags & kFlagEndStream) {  // empty call: trailers now
-            {
-              std::lock_guard<std::mutex> lk2(c->mu);
-              c->streams.erase(sid);
-            }
-            c->send_trailers(sid, 0, call->details);
-            delete call;
-          }
-          continue;
-        }
-        c->handler_threads.fetch_add(1);
-        std::thread([this, c, call] { run_handler(c, call); }).detach();
-        continue;
+      bool duplicate;
+      {
+        std::lock_guard<std::mutex> lk(c->mu);
+        duplicate = c->streams.count(sid) != 0;
+        if (!duplicate) c->streams[sid] = call;
       }
-      // frame for an existing stream
-      if (type == kMessage && (flags & kFlagCompressed)) {
-        // loud protocol rejection: this loop has no decompressor, and
-        // delivering gzip bytes as the message would corrupt the app
-        std::unique_lock<std::mutex> lk(c->mu);
-        auto it = c->streams.find(sid);
-        if (it != c->streams.end()) {
-          tpr_server_call *call = it->second;
-          // Erase the stream NOW in both branches: a fragmented compressed
-          // message delivers kFlagCompressed on every fragment, and later
-          // fragments must fall into the finished/unknown drop instead of
-          // re-sending these trailers. The details text must keep
-          // "compressed messages unsupported" as a substring — the Python
-          // channel's compression negotiation keys on it
-          // (tpurpc/rpc/frame.py COMPRESSED_UNSUPPORTED_SENTINEL).
-          c->streams.erase(it);
-          if (call->inline_cb) {
-            lk.unlock();
-            c->send_trailers(sid, 12 /*UNIMPLEMENTED*/,
-                             "compressed messages unsupported here");
-            delete call;
-          } else {
-            call->cancelled = true;  // handler exits; run_handler frees
-            lk.unlock();
-            c->send_trailers(sid, 12 /*UNIMPLEMENTED*/,
-                             "compressed messages unsupported here");
-            c->cv.notify_all();
-          }
-        }
-        continue;
+      if (duplicate) {
+        // duplicate HEADERS on an active sid: protocol violation —
+        // overwriting would orphan one call's frame routing forever
+        c->send_trailers(sid, 13, "duplicate stream id");  // INTERNAL
+        delete call;
+        return true;
       }
-      std::unique_lock<std::mutex> lk(c->mu);
-      auto it = c->streams.find(sid);
-      if (it == c->streams.end()) continue;  // finished/unknown: drop
-      tpr_server_call *call = it->second;
-      if (call->inline_cb) {
-        // reactor path: complete messages run the cb ON THIS THREAD;
-        // teardown is immediate at RST/half-close/nonzero-return. Only the
-        // reader touches inline calls, so the lock is released first.
-        lk.unlock();
-        bool finished = false;
-        bool rst = false;
-        int code = 0;
-        if (type == kRst) {
-          finished = rst = true;  // cancelled: client left, no trailers
-        } else if (type == kMessage) {
-          const bool has_payload = !(flags & kFlagNoMessage);
-          const bool complete = has_payload && !(flags & kFlagMore);
-          if (complete && call->partial.empty()) {
-            // common case: whole message in one frame — feed the cb the
-            // frame buffer directly, no accumulator alloc/copy
-            code = call->inline_cb(call, payload.data(), payload.size(),
-                                   call->inline_ud);
-          } else {
-            if (has_payload)
-              call->partial.append(reinterpret_cast<char *>(payload.data()),
-                                   payload.size());
-            if (complete) {
-              std::string msg = std::move(call->partial);
-              call->partial.clear();
-              code = call->inline_cb(
-                  call, reinterpret_cast<const uint8_t *>(msg.data()),
-                  msg.size(), call->inline_ud);
-            }
-          }
-          // negative returns are app errors, not a protocol escape hatch:
-          // map them to INTERNAL so the client always gets trailers
-          if (code < 0) code = 13;
-          if (code != 0 || (flags & kFlagEndStream)) finished = true;
-        }
-        if (finished) {
+      auto cb_it = cb_handlers.find(call->method);
+      if (cb_it != cb_handlers.end()) {
+        // callback API: no thread — messages dispatch inline below
+        call->inline_cb = cb_it->second.first;
+        call->inline_ud = cb_it->second.second;
+        if (flags & kFlagEndStream) {  // empty call: trailers now
           {
             std::lock_guard<std::mutex> lk2(c->mu);
             c->streams.erase(sid);
           }
-          if (!rst) c->send_trailers(sid, code, call->details);
+          c->finish_call_trailers(call, 0);
           delete call;
         }
-        continue;
+        return true;
       }
-      if (type == kRst) {
-        call->cancelled = true;
-      } else if (type == kMessage) {
-        if (!(flags & kFlagNoMessage))
-          call->partial.append(reinterpret_cast<char *>(payload.data()),
-                               payload.size());
-        if (!(flags & kFlagMore) && !(flags & kFlagNoMessage)) {
-          call->pending.push_back(std::move(call->partial));
-          call->partial.clear();
-        }
-        if (flags & kFlagEndStream) call->half_closed = true;
-      }
-      lk.unlock();
-      c->cv.notify_all();
+      c->handler_threads.fetch_add(1);
+      std::thread([this, c, call] { run_handler(c, call); }).detach();
+      return true;
     }
-    // connection done: fail outstanding calls, wake their handlers
+    // frame for an existing stream
+    if (type == kMessage && (flags & kFlagCompressed)) {
+      // loud protocol rejection: this loop has no decompressor, and
+      // delivering gzip bytes as the message would corrupt the app
+      std::unique_lock<std::mutex> lk(c->mu);
+      auto it = c->streams.find(sid);
+      if (it != c->streams.end()) {
+        tpr_server_call *call = it->second;
+        // Erase the stream NOW in both branches: a fragmented compressed
+        // message delivers kFlagCompressed on every fragment, and later
+        // fragments must fall into the finished/unknown drop instead of
+        // re-sending these trailers. The details text must keep
+        // "compressed messages unsupported" as a substring — the Python
+        // channel's compression negotiation keys on it
+        // (tpurpc/rpc/frame.py COMPRESSED_UNSUPPORTED_SENTINEL).
+        c->streams.erase(it);
+        if (call->inline_cb) {
+          lk.unlock();
+          c->send_trailers(sid, 12 /*UNIMPLEMENTED*/,
+                           "compressed messages unsupported here");
+          delete call;
+        } else {
+          call->cancelled = true;  // handler exits; run_handler frees
+          lk.unlock();
+          c->send_trailers(sid, 12 /*UNIMPLEMENTED*/,
+                           "compressed messages unsupported here");
+          c->cv.notify_all();
+        }
+      }
+      return true;
+    }
+    std::unique_lock<std::mutex> lk(c->mu);
+    auto it = c->streams.find(sid);
+    if (it == c->streams.end()) return true;  // finished/unknown: drop
+    tpr_server_call *call = it->second;
+    if (call->inline_cb) {
+      // reactor path: complete messages run the cb ON THIS THREAD;
+      // teardown is immediate at RST/half-close/nonzero-return. Only the
+      // poller touches inline calls, so the lock is released first.
+      lk.unlock();
+      bool finished = false;
+      bool rst = false;
+      int code = 0;
+      if (type == kRst) {
+        finished = rst = true;  // cancelled: client left, no trailers
+      } else if (type == kMessage) {
+        const bool has_payload = !(flags & kFlagNoMessage);
+        const bool complete = has_payload && !(flags & kFlagMore);
+        if (complete && call->partial.empty()) {
+          // common case: whole message in one frame — feed the cb the
+          // frame buffer directly, no accumulator alloc/copy
+          code = call->inline_cb(call, payload.data(), payload.size(),
+                                 call->inline_ud);
+        } else {
+          if (has_payload)
+            call->partial.append(reinterpret_cast<char *>(payload.data()),
+                                 payload.size());
+          if (complete) {
+            std::string msg = std::move(call->partial);
+            call->partial.clear();
+            code = call->inline_cb(
+                call, reinterpret_cast<const uint8_t *>(msg.data()),
+                msg.size(), call->inline_ud);
+          }
+        }
+        // negative returns are app errors, not a protocol escape hatch:
+        // map them to INTERNAL so the client always gets trailers
+        if (code < 0) code = 13;
+        if (code != 0 || (flags & kFlagEndStream)) finished = true;
+      }
+      if (finished) {
+        {
+          std::lock_guard<std::mutex> lk2(c->mu);
+          c->streams.erase(sid);
+        }
+        if (!rst) c->finish_call_trailers(call, code);
+        delete call;
+      }
+      return true;
+    }
+    if (type == kRst) {
+      call->cancelled = true;
+    } else if (type == kMessage) {
+      if (!(flags & kFlagNoMessage))
+        call->partial.append(reinterpret_cast<char *>(payload.data()),
+                             payload.size());
+      if (!(flags & kFlagMore) && !(flags & kFlagNoMessage)) {
+        call->pending.push_back(std::move(call->partial));
+        call->partial.clear();
+      }
+      if (flags & kFlagEndStream) call->half_closed = true;
+    }
+    lk.unlock();
+    c->cv.notify_all();
+    return true;
+  }
+
+  // Pump complete frames currently available on `c` (nonblocking), up to
+  // a per-event budget so one saturating sender cannot starve the other
+  // connections sharing this poller thread (fairness; the reference's
+  // Poller round-robins its slot array for the same reason,
+  // poller.cc:52-106). Returns: -1 connection over, 0 drained dry,
+  // 1 budget exhausted with data still pending (caller must re-pump —
+  // the tokens that announced the remaining frames were already drained,
+  // so no further epoll event is guaranteed).
+  int pump_conn(Conn *c) {
+    int budget = 256;
+    while (true) {
+      uint8_t *dst;
+      size_t want;
+      if (!c->in_payload) {
+        dst = c->hdr + c->got;
+        want = sizeof c->hdr - c->got;
+      } else {
+        dst = c->payload.data() + c->got;
+        want = c->f_len - c->got;
+      }
+      if (want) {
+        ssize_t n = c->read_some(dst, want);
+        if (n < 0) return -1;
+        if (n == 0) return 0;  // dry: wait for the next event
+        c->got += static_cast<size_t>(n);
+        if (c->got < (c->in_payload ? c->f_len : sizeof c->hdr)) continue;
+      }
+      if (!c->in_payload) {
+        // header complete: parse (t_finish_frame's header layout)
+        c->f_type = c->hdr[0];
+        c->f_flags = c->hdr[1];
+        c->f_sid = get_u32(c->hdr + 2);
+        c->f_len = get_u32(c->hdr + 6);
+        if (c->f_len > kMaxFramePayload + 65536) return -1;
+        c->payload.resize(c->f_len);
+        c->in_payload = true;
+        c->got = 0;
+        if (c->f_len != 0) continue;  // go read the payload bytes
+      }
+      // frame complete
+      c->in_payload = false;
+      c->got = 0;
+      if (!on_frame(c, c->f_type, c->f_flags, c->f_sid, c->payload))
+        return -1;
+      if (--budget == 0) return 1;
+    }
+  }
+
+  // Connection teardown (poller thread, or destroy): fail streams, wake
+  // handlers. The Conn itself is freed by reap once handler threads drain.
+  void finish_conn(Conn *c) {
+    if (c->finished.exchange(true)) return;
     {
       std::lock_guard<std::mutex> lk(c->mu);
       for (auto &kv : c->streams) kv.second->cancelled = true;
     }
     c->cv.notify_all();
-    // wait for handlers to drain (they hold call pointers)
-    while (c->handler_threads.load() > 0)
-      std::this_thread::sleep_for(std::chrono::milliseconds(1));
-    {
-      // inline (callback-API) calls have no handler thread to free them:
-      // whatever is left in the map now is reader-owned — reap it here
+    c->close_fd();
+    // Inline (callback-API) calls have no handler thread to free them:
+    // whatever still sits in the map with no handler owner is reaped here.
+    // Handler-API calls are freed by run_handler (which erases them from
+    // the map first), so anything left in the map after handlers DRAIN is
+    // poller-owned. With live handler threads, leave the map alone — the
+    // reap path frees stragglers once handler_threads hits zero.
+    if (c->handler_threads.load() == 0) {
       std::lock_guard<std::mutex> lk(c->mu);
       for (auto &kv : c->streams) delete kv.second;
       c->streams.clear();
     }
-    c->close_fd();
     c->alive.store(false);
   }
 
@@ -365,14 +554,48 @@ struct tpr_server {
     std::lock_guard<std::mutex> lk(conns_mu);
     for (auto it = conns.begin(); it != conns.end();) {
       Conn *c = *it;
-      if (!c->alive.load()) {
-        if (c->thread.joinable()) c->thread.join();
+      if (c->reapable.load() && c->handler_threads.load() == 0) {
+        {
+          std::lock_guard<std::mutex> lk2(c->mu);
+          for (auto &kv : c->streams) delete kv.second;
+          c->streams.clear();
+        }
         delete c;
         it = conns.erase(it);
       } else {
         ++it;
       }
     }
+  }
+
+  // Bootstrap (sniff + optional ring handshake) then hand to a poller.
+  void bootstrap_conn(Conn *c, std::vector<uint8_t> preread) {
+    bool ok = accept_preface(c, preread.data(), preread.size());
+    if (!ok || !running.load()) {
+      finish_conn(c);
+      c->reapable.store(true);  // never reached a poller: no stale events
+    } else {
+      Poller *p = pollers[next_poller.fetch_add(1) % pollers.size()];
+      c->poller = p;
+      p->add(c);
+    }
+    bootstrap_threads.fetch_sub(1);
+  }
+
+  void start_conn(int fd, const uint8_t *preread, size_t preread_len) {
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+    auto *c = new Conn();
+    c->fd = fd;
+    {
+      std::lock_guard<std::mutex> lk(conns_mu);
+      conns.push_back(c);
+    }
+    bootstrap_threads.fetch_add(1);
+    std::vector<uint8_t> pre(preread, preread + preread_len);
+    std::thread([this, c, pre = std::move(pre)]() mutable {
+      bootstrap_conn(c, std::move(pre));
+    }).detach();
   }
 
   void accept_loop() {
@@ -385,18 +608,89 @@ struct tpr_server {
         return;  // listener closed
       }
       reap_dead_conns();  // bound growth: finished conns freed on each accept
-      int one = 1;
-      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
-      auto *c = new Conn();
-      c->fd = fd;
-      {
-        std::lock_guard<std::mutex> lk(conns_mu);
-        conns.push_back(c);
-      }
-      c->thread = std::thread([this, c] { serve_conn(c); });
+      start_conn(fd, nullptr, 0);
     }
   }
 };
+
+namespace {
+
+void Poller::loop() {
+  constexpr int kMaxEvents = 64;
+  struct epoll_event evs[kMaxEvents];
+  // Conns whose last pump hit the fairness budget with data still pending:
+  // re-pumped every iteration (their announcing tokens are already
+  // consumed, so no further epoll event is guaranteed). While any are hot
+  // the epoll_wait runs nonblocking so fresh events interleave fairly.
+  std::vector<Conn *> hot;
+  while (running.load()) {
+    int n = ::epoll_wait(epfd, evs, kMaxEvents, hot.empty() ? 200 : 0);
+    if (!running.load()) return;
+    // adopt pending conns FIRST, with an unconditional initial pump: ring
+    // bytes that landed during bootstrap may carry no further token
+    std::vector<Conn *> fresh;
+    {
+      std::lock_guard<std::mutex> lk(add_mu);
+      fresh.swap(pending_add);
+    }
+    std::vector<Conn *> finished_this_batch;
+    auto end_conn = [&](Conn *c) {
+      ::epoll_ctl(epfd, EPOLL_CTL_DEL, c->event_fd(), nullptr);
+      srv->finish_conn(c);
+      finished_this_batch.push_back(c);
+    };
+    auto after_pump = [&](Conn *c, int r) {
+      if (r < 0) {
+        end_conn(c);
+      } else if (r == 1) {
+        hot.push_back(c);  // budget hit: data pending, owe a re-pump
+      }
+    };
+    for (Conn *c : fresh) {
+      struct epoll_event ev = {};
+      ev.events = EPOLLIN;
+      ev.data.ptr = c;
+      if (::epoll_ctl(epfd, EPOLL_CTL_ADD, c->event_fd(), &ev) != 0) {
+        end_conn(c);
+        continue;
+      }
+      after_pump(c, srv->pump_conn(c));
+    }
+    std::vector<Conn *> rehot;
+    rehot.swap(hot);
+    for (Conn *c : rehot) {
+      if (c->finished.load()) continue;
+      after_pump(c, srv->pump_conn(c));
+    }
+    for (int i = 0; i < n; ++i) {
+      Conn *c = static_cast<Conn *>(evs[i].data.ptr);
+      if (c == nullptr) {  // wake pipe (nonblocking): drain
+        char buf[64];
+        while (::read(wake_r, buf, sizeof buf) > 0) {
+        }
+        continue;
+      }
+      if (c->finished.load()) continue;  // stale event post-teardown
+      if (c->ring) {
+        // tokens first (level-triggered fd would re-fire otherwise),
+        // then drain the ring. A closed notify channel still gets its
+        // ring remnants served before teardown (the peer's final frames
+        // race its close, exactly like the old blocking path).
+        int t = c->ring->drain_tokens();
+        int r = srv->pump_conn(c);
+        if (t < 0 && r != 1) r = -1;  // keep pumping remnants while hot
+        after_pump(c, r);
+      } else {
+        after_pump(c, srv->pump_conn(c));
+      }
+    }
+    // only AFTER the batch (no stale event can reference them) may the
+    // reaper free these conns
+    for (Conn *c : finished_this_batch) c->reapable.store(true);
+  }
+}
+
+}  // namespace
 
 // ---------------------------------------------------------------------------
 
@@ -412,7 +706,7 @@ tpr_server *tpr_server_create(int port) {
   addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
   addr.sin_port = htons(static_cast<uint16_t>(port));
   if (::bind(fd, reinterpret_cast<sockaddr *>(&addr), sizeof addr) != 0 ||
-      ::listen(fd, 128) != 0) {
+      ::listen(fd, 512) != 0) {
     ::close(fd);
     return nullptr;
   }
@@ -438,7 +732,25 @@ void tpr_server_register_callback(tpr_server *s, const char *method,
 
 int tpr_server_start(tpr_server *s) {
   s->running.store(true);
+  int np = tpr_server::poller_count_from_env();
+  for (int i = 0; i < np; ++i) {
+    auto *p = new Poller();
+    if (!p->init()) {
+      delete p;
+      return -1;
+    }
+    p->srv = s;
+    p->th = std::thread([p] { p->loop(); });
+    s->pollers.push_back(p);
+  }
   s->accept_thread = std::thread([s] { s->accept_loop(); });
+  return 0;
+}
+
+int tpr_server_adopt_fd(tpr_server *s, int fd, const uint8_t *preread,
+                        size_t preread_len) {
+  if (!s->running.load() || preread_len > 4) return -1;
+  s->start_conn(fd, preread, preread_len);
   return 0;
 }
 
@@ -447,12 +759,30 @@ void tpr_server_destroy(tpr_server *s) {
   ::shutdown(s->listen_fd, SHUT_RDWR);
   ::close(s->listen_fd);
   if (s->accept_thread.joinable()) s->accept_thread.join();
+  // bootstrap threads hold Conn pointers; their sniffs are bounded (the
+  // fd shutdowns below kick any that are mid-handshake)
+  {
+    std::lock_guard<std::mutex> lk(s->conns_mu);
+    for (Conn *c : s->conns) c->shutdown_fd();
+  }
+  while (s->bootstrap_threads.load() > 0)
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  for (Poller *p : s->pollers) {
+    p->stop_and_join();
+    delete p;
+  }
+  s->pollers.clear();
   {
     std::lock_guard<std::mutex> lk(s->conns_mu);
     for (Conn *c : s->conns) {
-      c->alive.store(false);
-      c->shutdown_fd();
-      if (c->thread.joinable()) c->thread.join();
+      s->finish_conn(c);
+      while (c->handler_threads.load() > 0)
+        std::this_thread::sleep_for(std::chrono::milliseconds(1));
+      {
+        std::lock_guard<std::mutex> lk2(c->mu);
+        for (auto &kv : c->streams) delete kv.second;
+        c->streams.clear();
+      }
       delete c;
     }
     s->conns.clear();
@@ -478,7 +808,17 @@ int tpr_srv_recv(tpr_server_call *c, uint8_t **data, size_t *len) {
   }
 }
 
+static void flush_initial_md(tpr_server_call *c) {
+  if (c->initial_md_sent) return;
+  c->initial_md_sent = true;
+  if (c->initial_md.empty()) return;
+  std::string payload = encode_metadata(c->initial_md);
+  c->conn->send_frame(kHeaders, 0, c->stream_id, payload.data(),
+                      payload.size());
+}
+
 int tpr_srv_send(tpr_server_call *c, const uint8_t *data, size_t len) {
+  flush_initial_md(c);
   size_t off = 0;
   do {
     size_t n = len - off;
@@ -504,6 +844,32 @@ int64_t tpr_srv_deadline_us(tpr_server_call *c) {
 
 void tpr_srv_set_details(tpr_server_call *c, const char *details) {
   c->details = details ? details : "";
+}
+
+size_t tpr_srv_metadata_count(tpr_server_call *c) { return c->md.size(); }
+
+int tpr_srv_metadata_get(tpr_server_call *c, size_t i, const char **key,
+                         const char **val) {
+  if (i >= c->md.size()) return -1;
+  *key = c->md[i].first.c_str();
+  *val = c->md[i].second.c_str();
+  return 0;
+}
+
+void tpr_srv_send_initial_md(tpr_server_call *c, const char *key,
+                             const char *val) {
+  if (!c->initial_md_sent)
+    c->initial_md.emplace_back(key ? key : "", val ? val : "");
+}
+
+void tpr_srv_add_trailing_md(tpr_server_call *c, const char *key,
+                             const char *val) {
+  c->trailing_md.emplace_back(key ? key : "", val ? val : "");
+}
+
+int tpr_srv_cancelled(tpr_server_call *c) {
+  std::lock_guard<std::mutex> lk(c->conn->mu);
+  return c->cancelled ? 1 : 0;
 }
 
 void tpr_srv_buf_free(uint8_t *data) { free(data); }

@@ -247,11 +247,14 @@ def test_stopped_server_refuses_late_adoptions():
     ch.close()
 
 
-def test_pool_rejection_kills_connection_so_client_redials():
+def test_pool_rejection_kills_connection_so_client_redials(monkeypatch):
     """Regression (round-2 reconnect bug, defense in depth): if a live
     connection's server can no longer run handlers, the *connection* must
     die with the rejected call — a client stuck on it would otherwise retry
     against the same husk for its whole deadline."""
+    # this test drives the PYTHON transport's connection machinery; keep
+    # the unary fast path (which would bypass it entirely) off
+    monkeypatch.setenv("TPURPC_NATIVE_FAST_UNARY", "0")
     srv = make_server()
     srv.add_insecure_port("127.0.0.1:0")
     srv.start()
@@ -403,6 +406,7 @@ def test_max_connection_age_drains_gracefully(monkeypatch):
     """GRPC_ARG_MAX_CONNECTION_AGE_MS: the server GOAWAYs an aged
     connection; an in-flight call completes, and the NEXT call transparently
     lands on a fresh connection."""
+    monkeypatch.setenv("TPURPC_NATIVE_FAST_UNARY", "0")  # tests the Python transport
     import time as _time
 
     from tpurpc.utils import config as config_mod
@@ -439,6 +443,7 @@ def test_max_connection_age_drains_gracefully(monkeypatch):
 def test_client_idle_timeout_closes_and_redials(monkeypatch):
     """GRPC_ARG_CLIENT_IDLE_TIMEOUT_MS: an idle connection is dropped;
     the next call dials fresh and succeeds."""
+    monkeypatch.setenv("TPURPC_NATIVE_FAST_UNARY", "0")  # tests the Python transport
     import time as _time
 
     from tpurpc.utils import config as config_mod
@@ -697,6 +702,7 @@ def test_keepalive_healthy_idle_survives_aggressive_knobs(monkeypatch):
     must survive indefinitely (regression: stamp-after-send raced the
     loopback PONG and read the PING as ignored, reaping healthy clients),
     and a silent peer must still die within interval+timeout."""
+    monkeypatch.setenv("TPURPC_NATIVE_FAST_UNARY", "0")  # tests the Python transport
     monkeypatch.setenv("GRPC_ARG_KEEPALIVE_TIME_MS", "400")
     monkeypatch.setenv("GRPC_ARG_KEEPALIVE_TIMEOUT_MS", "400")
     from tpurpc.core.endpoint import passthru_endpoint_pair

@@ -409,10 +409,11 @@ def test_forged_records_cannot_land_bytes():
     # same) rather than dropping it
     big_hdr = T._REC.pack(key, 0, 1024)
     assert forge([big_hdr + b"\x00" * T._MAC_LEN + b"B" * 1024]) == b"open"
-    # (5) unknown-key flood: the per-connection unverifiable budget (64)
-    # runs out and the connection is dropped — no infinite free probing
+    # (5) unknown-key flood: the per-connection unverifiable budget runs
+    # out (it only replenishes on VERIFIED records, which a forger can't
+    # produce) and the connection is dropped — no infinite free probing
     flood = []
-    for i in range(70):
+    for i in range(1100):
         fh = T._REC.pack(os.urandom(16), 0, 4)
         flood.append(fh + b"\x00" * T._MAC_LEN + b"XXXX")
     assert forge(flood) == b""

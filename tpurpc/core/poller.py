@@ -249,7 +249,12 @@ def _wait(pair: Pair, timeout: Optional[float], discipline: Optional[str],
     # produces (the reference pins dedicated poller threads, poller.cc:52).
     # On a single-hart host every spin microsecond is stolen from the
     # producer, so hybrid degrades to pure event; explicit "busy" is honored
-    # as configured.
+    # as configured. (A cooperative sleep(0)-yield spin was tried here in
+    # round 4 and MEASURED WORSE — wait p50 274→376µs — because with the
+    # server's reader+worker threads also runnable, the yielding spinner
+    # still consumes every other scheduler slot the handler needed. The
+    # Python-path latency answer is the native unary fast path in
+    # rpc/channel.py, not a smarter spin.)
     if discipline == "hybrid" and _effective_cpus() < 2:
         discipline = "event"
 

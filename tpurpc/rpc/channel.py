@@ -16,6 +16,7 @@ Reference mapping (SURVEY.md §3.2/§3.3):
 
 from __future__ import annotations
 
+import os
 import queue
 import random
 import threading
@@ -164,11 +165,38 @@ class _Connection:
         self.last_activity = time.monotonic()
         self._on_dead = on_dead
         self.writer.send_preface()
-        self._thread = threading.Thread(target=self._read_loop, daemon=True,
-                                        name="tpurpc-chan-reader")
-        self._thread.start()
+        # Inline-pump discipline (the reference's pollset_work model,
+        # SURVEY §3.4; the Python analog of TPURPC_NATIVE_INLINE_READ):
+        # on ring platforms the WAITING CALLER pumps the transport itself,
+        # eliminating the reader-thread→caller wakeup from every RTT — on
+        # the 1-core bench host those 2 extra context switches per round
+        # trip were why the Python ring path LOST to TCP (VERDICT r3 weak
+        # #4). TPURPC_INLINE_PUMP=auto (default) enables it for ring
+        # endpoints; =1 forces it for every endpoint; =0 keeps the
+        # dedicated reader thread everywhere.
+        self._pump_mode = self._pump_enabled(endpoint)
+        self._pumping = False
+        self._pump_cond = threading.Condition(self._lock)
+        if self._pump_mode:
+            self._start_backup_pump()
+        else:
+            self._thread = threading.Thread(target=self._read_loop,
+                                            daemon=True,
+                                            name="tpurpc-chan-reader")
+            self._thread.start()
         self._start_keepalive()
         self._start_idle_monitor()
+
+    @staticmethod
+    def _pump_enabled(endpoint: Endpoint) -> bool:
+        mode = os.environ.get("TPURPC_INLINE_PUMP", "auto").lower()
+        if mode in ("0", "off", "false"):
+            return False
+        if mode in ("1", "on", "true"):
+            return True
+        # auto: ring endpoints only (a Pair-backed byte pipe — the path the
+        # discipline was built for; TCP keeps the blocking reader thread)
+        return hasattr(endpoint, "pair")
 
     def _start_keepalive(self) -> None:
         """Client keepalive (GRPC_ARG_KEEPALIVE_TIME_MS family, off by
@@ -325,6 +353,128 @@ class _Connection:
         except (EndpointError, fr.FrameError, OSError) as exc:
             self._die(str(exc))
 
+    # -- inline pump (pump-mode connections only) -----------------------------
+
+    def _pump_wait(self, pred: Callable[[], bool],
+                   deadline: Optional[float]) -> bool:
+        """Wait for ``pred`` by PUMPING the transport from this thread.
+
+        One pumper at a time owns the FrameReader (it is not thread-safe);
+        others park on the condition and are notified after every dispatched
+        frame, so a parked waiter whose pred was satisfied by the owner's
+        pumping wakes immediately — the owner keeps pumping only until its
+        OWN pred holds (native analog: tpurpc_client.cc pump_until).
+
+        Returns True when pred() holds or the connection died (the caller
+        decodes the terminal state from its event queue); False only when
+        ``deadline`` (a time.monotonic() instant) passed."""
+        while True:
+            with self._pump_cond:
+                while True:
+                    if pred() or not self.alive:
+                        return True
+                    remaining = (None if deadline is None
+                                 else deadline - time.monotonic())
+                    if remaining is not None and remaining <= 0:
+                        return False
+                    if not self._pumping:
+                        self._pumping = True
+                        break  # this thread owns the pump now
+                    self._pump_cond.wait(remaining)
+            try:
+                self._pump(pred, deadline)
+            finally:
+                with self._pump_cond:
+                    self._pumping = False
+                    self._pump_cond.notify_all()
+            # loop: re-evaluate pred/deadline under the lock (the pump may
+            # have returned because the connection died mid-frame)
+
+    def _pump(self, pred: Callable[[], bool],
+              deadline: Optional[float]) -> None:
+        """Drain frames until pred/deadline/death. Runs WITHOUT the
+        connection lock (the credit-backpressure path inside sink.commit
+        may block until a consumer drains its queue; consumers must be able
+        to run), owning the reader exclusively via ``_pumping``."""
+        while True:
+            with self._lock:
+                if pred() or not self.alive:
+                    return
+            remaining = (None if deadline is None
+                         else deadline - time.monotonic())
+            if remaining is not None and remaining <= 0:
+                return
+            try:
+                f = self.reader.read_frame(timeout=remaining)
+            except TimeoutError:
+                return  # deadline passed mid-wait; outer loop re-checks
+            except (EndpointError, fr.FrameError, OSError) as exc:
+                self._die(str(exc))
+                return
+            if f is None:
+                self._die("server closed connection")
+                return
+            if f is not fr.CONSUMED:
+                self._dispatch(f)
+            # every frame (CONSUMED commits included) may satisfy a PARKED
+            # waiter's pred — hand them the wakeup now, not at pump release
+            with self._pump_cond:
+                self._pump_cond.notify_all()
+
+    def _start_backup_pump(self) -> None:
+        """Idle servicing for pump-mode connections: with no caller waiting
+        (no RPC in flight), nobody pumps — server PINGs, GOAWAYs, and
+        keepalive PONGs would sit unread. A timer-wheel tick takes the pump
+        when it is free and drains whatever is already buffered. This is
+        the backup-poller role gRPC's client runs for the same reason."""
+        from tpurpc.utils.config import get_config
+        from tpurpc.utils.timers import run_blocking, schedule
+
+        # The backup pump is the only transport reader on an IDLE pump-mode
+        # connection, so its cadence must beat the keepalive verdict: a
+        # PONG that sits unread past keepalive_timeout would reap a
+        # healthy connection. A third of the timeout guarantees >=2 pump
+        # chances inside any verdict window.
+        cfg = get_config()
+        INTERVAL = 0.5
+        if cfg.keepalive_time_ms > 0:
+            INTERVAL = min(INTERVAL,
+                           max(0.05, cfg.keepalive_timeout_ms / 1000.0 / 3))
+
+        def service():
+            if not self.alive:
+                return
+            with self._pump_cond:
+                grab = not self._pumping and self.alive
+                if grab:
+                    self._pumping = True
+            if grab:
+                try:
+                    while True:
+                        try:
+                            f = self.reader.read_frame(timeout=0.005)
+                        except TimeoutError:
+                            break
+                        except (EndpointError, fr.FrameError, OSError) as exc:
+                            self._die(str(exc))
+                            return
+                        if f is None:
+                            self._die("server closed connection")
+                            return
+                        if f is not fr.CONSUMED:
+                            self._dispatch(f)
+                finally:
+                    with self._pump_cond:
+                        self._pumping = False
+                        self._pump_cond.notify_all()
+            if self.alive:
+                self._backup_handle = schedule(INTERVAL, tick)
+
+        def tick():
+            run_blocking(service)
+
+        self._backup_handle = schedule(INTERVAL, tick)
+
     def _dispatch(self, f: fr.Frame) -> None:
         if f.type == fr.PING:
             self.writer.send(fr.PONG, 0, 0, f.payload)
@@ -376,9 +526,15 @@ class _Connection:
             if not self.alive:
                 raise EndpointError("connection closed")
             self._pong_waiters.append(ev)
+            before = self.pong_count
         t0 = time.perf_counter()
         self.writer.send(fr.PING, 0, 0, b"tpurpc-ping")
-        if not ev.wait(timeout):
+        if self._pump_mode:
+            ok = self._pump_wait(lambda: self.pong_count > before,
+                                 time.monotonic() + timeout)
+            if not ok:
+                raise TimeoutError("ping timed out")
+        elif not ev.wait(timeout):
             raise TimeoutError("ping timed out")
         if not self.alive:  # waiters are released on death too
             raise EndpointError("connection died during ping")
@@ -394,7 +550,7 @@ class _Connection:
             waiters, self._pong_waiters = self._pong_waiters, []
         for ev in waiters:
             ev.set()  # ping() observes !alive via the raced send/raise below
-        for attr in ("_ka_handle", "_idle_handle"):
+        for attr in ("_ka_handle", "_idle_handle", "_backup_handle"):
             h = getattr(self, attr, None)
             if h is not None:
                 h.cancel()  # wheel ticks also re-check alive themselves
@@ -564,6 +720,15 @@ class Channel:
         self._lock = threading.Lock()  # guards _closed
         self._closed = False
         self._kicker: Optional[threading.Thread] = None  # get_state dialer
+        # Native unary fast path (lazy; see _native_fast): the reference's
+        # defining property is that EVERY binding rides the fast pipe
+        # because the hot loop lives in the C core under a thin language
+        # surface (grpcio → core, SURVEY §2.4). _native_ch is the cached
+        # NativeChannel; _native_retry_at throttles re-dial attempts after
+        # a failure so an absent/down native path costs one probe per 5 s.
+        self._native_lock = threading.Lock()
+        self._native_ch = None
+        self._native_retry_at = 0.0
         from tpurpc.rpc import channelz as _channelz
 
         #: channelz ChannelData counters (started/succeeded/failed)
@@ -609,6 +774,18 @@ class Channel:
         # exactly what grpclb runs over its server lists (grpclb.cc).
         spec = (self._lb_spec if isinstance(self._lb_spec, str)
                 else "round_robin")
+        # Dynamic membership (re-resolution / grpclb server lists) is
+        # routing the Python transport owns: the single-address native
+        # fast path would pin traffic to the original backend. Disable it
+        # for this channel permanently.
+        with self._native_lock:
+            nch, self._native_ch = self._native_ch, None
+            self._native_retry_at = float("inf")
+        if nch is not None:
+            try:
+                nch.close()
+            except Exception:
+                pass
         with self._lock:
             if self._closed:
                 raise RpcError(StatusCode.UNAVAILABLE, "channel closed")
@@ -697,6 +874,12 @@ class Channel:
         with self._lock:
             if self._closed:
                 return CC.SHUTDOWN
+        with self._native_lock:
+            nch = self._native_ch
+        if nch is not None and nch._ch:
+            # calls are flowing through the native fast path: the channel
+            # is READY even though no Python-transport connection exists
+            return CC.READY
         now = time.monotonic()
         backing_off = False
         for sc in self._subchannels:
@@ -745,6 +928,14 @@ class Channel:
     def close(self) -> None:
         with self._lock:
             self._closed = True
+        with self._native_lock:
+            nch, self._native_ch = self._native_ch, None
+            self._native_retry_at = float("inf")  # closed: never re-dial
+        if nch is not None:
+            try:
+                nch.close()
+            except Exception:
+                pass
         for sc in self._subchannels:
             sc.close()
 
@@ -753,6 +944,69 @@ class Channel:
 
     def __exit__(self, *exc):
         self.close()
+
+    # -- native unary fast path -----------------------------------------------
+
+    def _native_fast(self):
+        """The channel's NativeChannel (calls run inside libtpurpc.so's
+        inline-read loop — BASELINE.md: 5.42 µs ring RTT vs ~95 µs for the
+        pure-Python path on the same host), or None when ineligible.
+
+        Eligibility is the common drop-in case, checked once: plain single
+        address, pick_first with static membership, no TLS, no
+        compression, a shm-ring platform (where the pure-Python loop
+        measurably loses to kernel TCP — VERDICT r3 weak #4; plain-TCP
+        channels keep the Python transport, whose kernel-socket path is
+        already competitive and fully introspectable), lib present.
+        TPURPC_NATIVE_FAST_UNARY=1 forces it for TCP too; =0 opts out
+        entirely; TPURPC_NATIVE=0 disables all native paths. Everything
+        else silently stays on the Python transport — same wire, same
+        server."""
+        with self._native_lock:
+            if self._native_ch is not None:
+                return self._native_ch
+            now = time.monotonic()
+            if now < self._native_retry_at or self._closed:
+                return None
+            self._native_retry_at = now + 5.0  # throttle failed probes
+            mode = os.environ.get("TPURPC_NATIVE_FAST_UNARY",
+                                  "auto").lower()
+            if mode in ("0", "off", "false"):
+                self._native_retry_at = float("inf")
+                return None
+            if (self._compress_flag or self._addrs is None
+                    or len(self._addrs) != 1 or self._lb_spec != "pick_first"
+                    or self._conn_kw.get("ssl_context") is not None):
+                self._native_retry_at = float("inf")
+                return None
+            from tpurpc.utils.config import get_config
+
+            cfg = get_config()
+            ring_ok = (cfg.platform.is_ring and cfg.platform.name != "TPU"
+                       and cfg.ring_domain == "shm")
+            if not (ring_ok or (mode in ("1", "on", "true")
+                                and not cfg.platform.is_ring)):
+                self._native_retry_at = float("inf")
+                return None
+            try:
+                from tpurpc.rpc.native_client import NativeChannel
+
+                host, port = self._addrs[0]
+                self._native_ch = NativeChannel(
+                    host, port, connect_timeout=self._conn_kw["timeout"])
+            except Exception:
+                return None  # lib absent/unbuildable or server down: retry in 5s
+            return self._native_ch
+
+    def _native_invalidate(self, nch) -> None:
+        """Drop a dead fast-path channel; the next eligible call re-dials."""
+        with self._native_lock:
+            if self._native_ch is nch:
+                self._native_ch = None
+        try:
+            nch.close()
+        except Exception:
+            pass
 
     # -- call surface (grpcio-shaped) ----------------------------------------
 
@@ -858,6 +1112,27 @@ class Call:
     # -- response consumption -------------------------------------------------
 
     def _next_event(self):
+        if self._conn._pump_mode:
+            # Inline pump: THIS thread drains the transport until its
+            # stream has an event — no reader-thread wakeup in the RTT.
+            got = self._conn._pump_wait(
+                lambda: not self._st.events.empty(), self._deadline)
+            if got:
+                try:
+                    return self._st.events.get_nowait()
+                except queue.Empty:
+                    # pred held via `not alive`: the death path delivers the
+                    # failure event right after flipping alive — wait for it
+                    try:
+                        return self._st.events.get(timeout=5)
+                    except queue.Empty:
+                        raise RpcError(
+                            StatusCode.UNAVAILABLE,
+                            "connection died without delivering status",
+                        ) from None
+            self._expire()
+            raise RpcError(StatusCode.DEADLINE_EXCEEDED,
+                           "deadline exceeded awaiting response") from None
         timeout = self.time_remaining()
         try:
             return self._st.events.get(timeout=timeout)
@@ -1127,12 +1402,100 @@ def _reject_call_credentials(grpcio_kw: dict) -> None:
 
 
 class UnaryUnary(_MultiCallable):
+    #: (NativeChannel, native multicallable) cache — rebuilt when the
+    #: channel re-dials its fast path after a failure
+    _native_mc: "Optional[tuple]" = None
+
     def __call__(self, request, timeout: Optional[float] = None,
                  metadata: Optional[Metadata] = None, **grpcio_kw):
         _reject_call_credentials(grpcio_kw)
+        # Native fast path (the grpcio shape: Python surface, C-core hot
+        # loop): plain response-only unary calls with no per-call extras
+        # run inside libtpurpc.so's inline-read loop. with_call (needs a
+        # Call with trailing metadata), metadata, and wait_for_ready stay
+        # on the Python transport.
+        if not metadata and not grpcio_kw.get("wait_for_ready"):
+            from tpurpc.tpu import ledger as _ledger
+            from tpurpc.utils import stats as _stats
+
+            # Measurement honesty: an open copy-ledger window or live
+            # profiling spans are measuring the INSTRUMENTED Python data
+            # plane — don't route around the instruments.
+            if not _ledger.tracking() and not _stats.profiling_on():
+                nch = self._channel._native_fast()
+                if nch is not None:
+                    done, resp = self._native_call(nch, request, timeout)
+                    if done:
+                        return resp
         response, _ = self.with_call(request, timeout=timeout,
                                      metadata=metadata, **grpcio_kw)
         return response
+
+    def _native_call(self, nch, request, timeout: Optional[float]):
+        """One unary call inside the native loop. Returns ``(True, resp)``
+        or ``(False, None)`` — fall back to the Python transport, allowed
+        only for failures that PROVE no handler ran (refused/connect-time),
+        so a fallback can never re-execute a committed call."""
+        cached = self._native_mc
+        if cached is None or cached[0] is not nch:
+            cached = (nch, nch.unary_unary(self._method))
+            self._native_mc = cached
+        mc = cached[1]
+        counters = self._channel.call_counters
+        policy = self._channel.retry_policy
+        deadline = None if timeout is None else time.monotonic() + timeout
+
+        recv_limit = self._channel.max_receive_message_length
+
+        def attempt():
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+            counters.on_start()
+            try:
+                body = mc(self._ser(request), timeout=remaining)
+                if recv_limit is not None and len(body) > recv_limit:
+                    # max_receive_message_length parity: the native loop
+                    # doesn't enforce it, so the contract holds here (the
+                    # bytes crossed the wire, the app never sees them —
+                    # grpcio's client behaves the same at this layer)
+                    raise RpcError(
+                        StatusCode.RESOURCE_EXHAUSTED,
+                        "received message larger than "
+                        "max_receive_message_length")
+            except RpcError:
+                counters.on_finish(False)
+                raise
+            counters.on_finish(True)
+            return _deserialize(self._deser, body)
+
+        try:
+            if policy is None:
+                return True, attempt()
+            return True, policy.run(deadline, attempt)
+        except RpcError as exc:
+            if _status_of(exc) is StatusCode.UNAVAILABLE:
+                # dead fast-path connection: drop it so the next call
+                # re-dials. Fall back to the Python transport (its
+                # reconnect machinery) only when the failure provably
+                # happened before any handler could run.
+                self._channel._native_invalidate(nch)
+                details = exc.details() or ""
+                # pre-execution failures only: admission refusals (closed/
+                # draining/GOAWAY'd channel) and request-send failures —
+                # the server never saw a complete request, so the Python
+                # transport may safely re-dial and replay. NOT in this
+                # list: "connection lost" (the post-send death detail,
+                # tpurpc_client.cc die()) — the handler may have executed
+                # and replaying would double-execute; it surfaces to the
+                # caller exactly as the Python transport's mid-call death
+                # does.
+                if any(s in details for s in ("channel closed",
+                                              "call refused",
+                                              "channel dead",
+                                              "draining",
+                                              "send failed")):
+                    return False, None
+            raise
 
     def with_call(self, request, timeout: Optional[float] = None,
                   metadata: Optional[Metadata] = None, **grpcio_kw):

@@ -141,10 +141,11 @@ def _take_buf(lib, pptr, plen) -> bytes:
 class NativeCall:
     """A streaming call handle (thin ClientCall analog)."""
 
-    def __init__(self, lib, call):
+    def __init__(self, lib, call, on_close: Optional[Callable] = None):
         self._lib = lib
         self._call = call
         self._lock = threading.Lock()
+        self._on_close = on_close  # NativeChannel op release (exactly once)
 
     def write(self, data, end_stream: bool = False) -> None:
         buf = _u8(data)
@@ -177,10 +178,14 @@ class NativeCall:
         self._lib.tpr_call_cancel(self._call)
 
     def close(self) -> None:
+        cb = None
         with self._lock:
             if self._call:
                 self._lib.tpr_call_destroy(self._call)
                 self._call = None
+                cb, self._on_close = self._on_close, None
+        if cb is not None:
+            cb()
 
     def __del__(self):
         try:
@@ -331,6 +336,13 @@ class NativeChannel:
         self._lib = _load()
         self._cq_driver: Optional[_CqDriver] = None
         self._cq_lock = threading.Lock()
+        self._cq_cond = threading.Condition(self._cq_lock)
+        #: native entries currently holding the raw channel pointer inside
+        #: libtpurpc (blocking unary calls, pings, live NativeCall handles).
+        #: close() must not tpr_channel_destroy until this drains — a call
+        #: completing on another thread touches ch->streams in
+        #: tpr_call_destroy (ASan-caught use-after-free, round 4).
+        self._ops = 0
         self._ch = self._lib.tpr_channel_create(
             host.encode(), int(port), _timeout_ms(connect_timeout))
         if not self._ch:
@@ -346,11 +358,27 @@ class NativeChannel:
                 self._cq_driver = _CqDriver(self._lib)
             return self._cq_driver
 
+    def _op_begin(self):
+        """Claim the channel pointer for a native entry. The claim (not a
+        bare pointer read) is what lets close() prove no other thread is
+        inside the C loop before freeing the channel."""
+        with self._cq_lock:
+            if not self._ch:
+                raise RpcError(StatusCode.UNAVAILABLE, "channel closed")
+            self._ops += 1
+            return self._ch
+
+    def _op_end(self) -> None:
+        with self._cq_cond:
+            self._ops -= 1
+            if self._ops == 0:
+                self._cq_cond.notify_all()
+
     def _handle(self):
         """The live native handle; raises (instead of passing a freed/NULL
-        pointer into C and segfaulting) once close() ran. Closing with
-        calls in flight is unsupported, like destroying a grpcio channel
-        mid-call."""
+        pointer into C and segfaulting) once close() ran. For entries that
+        BLOCK inside the C loop use _op_begin/_op_end instead, so close()
+        can wait them out."""
         ch = self._ch
         if not ch:
             raise RpcError(StatusCode.UNAVAILABLE, "channel closed")
@@ -359,7 +387,11 @@ class NativeChannel:
     # -- surface -------------------------------------------------------------
 
     def ping(self, timeout: float = 5.0) -> float:
-        us = self._lib.tpr_channel_ping(self._handle(), _timeout_ms(timeout))
+        ch = self._op_begin()
+        try:
+            us = self._lib.tpr_channel_ping(ch, _timeout_ms(timeout))
+        finally:
+            self._op_end()
         if us < 0:
             raise RpcError(StatusCode.UNAVAILABLE, "ping failed")
         return us / 1e6
@@ -371,16 +403,20 @@ class NativeChannel:
         lib = self._lib
 
         def call(request, timeout: Optional[float] = None):
-            ch = self._handle()  # per-call: a closed channel raises
             raw = (request_serializer(request) if request_serializer
                    else request)
             buf = _u8(raw)
             pptr = ctypes.POINTER(ctypes.c_uint8)()
             plen = ctypes.c_size_t()
             details = ctypes.create_string_buffer(1024)
-            code = lib.tpr_unary_call(ch, mb, buf, len(buf),
-                                      ctypes.byref(pptr), ctypes.byref(plen),
-                                      details, 1024, _timeout_ms(timeout))
+            ch = self._op_begin()  # a closed channel raises; close() waits
+            try:
+                code = lib.tpr_unary_call(
+                    ch, mb, buf, len(buf),
+                    ctypes.byref(pptr), ctypes.byref(plen),
+                    details, 1024, _timeout_ms(timeout))
+            finally:
+                self._op_end()
             if code != 0:
                 raise RpcError(
                     StatusCode(code) if code in StatusCode._value2member_map_
@@ -395,22 +431,31 @@ class NativeChannel:
             .Future resolving to the response (or raising RpcError), with
             the call pipelined through the channel's completion queue —
             many can be in flight at once on one connection."""
-            ch = self._handle()
             raw = (request_serializer(request) if request_serializer
                    else request)
-            return self._driver().submit(ch, mb, raw, timeout,
-                                         response_deserializer)
+            drv = self._driver()
+            ch = self._op_begin()  # guard the submit window; the call's
+            try:                   # lifetime after that is the driver's
+                return drv.submit(ch, mb, raw, timeout,
+                                  response_deserializer)
+            finally:
+                self._op_end()
 
         call.future = future
         return call
 
     def start_call(self, method: str,
                    timeout: Optional[float] = None) -> NativeCall:
-        c = self._lib.tpr_call_start(self._handle(), method.encode(), None,
-                                     0, _timeout_ms(timeout))
-        if not c:
-            raise RpcError(StatusCode.UNAVAILABLE, "call start failed")
-        return NativeCall(self._lib, c)
+        ch = self._op_begin()  # held for the NativeCall's whole lifetime:
+        try:                   # its tpr_call_* entries all touch the channel
+            c = self._lib.tpr_call_start(ch, method.encode(), None,
+                                         0, _timeout_ms(timeout))
+            if not c:
+                raise RpcError(StatusCode.UNAVAILABLE, "call start failed")
+            return NativeCall(self._lib, c, on_close=self._op_end)
+        except BaseException:
+            self._op_end()
+            raise
 
     def stream_stream(self, method: str):
         """Bidi helper with the Channel-compatible iterator shape."""
@@ -459,9 +504,21 @@ class NativeChannel:
         return call
 
     def close(self) -> None:
-        with self._cq_lock:
+        with self._cq_cond:
             ch, self._ch = self._ch, None
             drv, self._cq_driver = self._cq_driver, None
+            # Wait out native entries still holding the raw pointer
+            # (blocking unary calls / pings / live NativeCall handles on
+            # other threads): destroying under them is the ASan-caught
+            # use-after-free. _ch is already None, so no NEW entry can
+            # begin while we wait.
+            deadline = time.monotonic() + 10.0
+            while self._ops > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cq_cond.wait(remaining)
+            ops_drained = self._ops == 0
         if ch:
             # CQ teardown first: destroying a call touches its channel, so
             # every future's call must be destroyed before the channel is.
@@ -470,6 +527,8 @@ class NativeChannel:
             # same leak-beats-use-after-free policy the cq itself uses.
             if drv is not None and not drv.close():
                 return
+            if not ops_drained:
+                return  # leak: an entry is still inside the C loop
             self._lib.tpr_channel_destroy(ch)
 
     def __del__(self):
