@@ -60,6 +60,13 @@ typedef int (*tpr_msg_cb)(tpr_server_call *call, const uint8_t *data,
 void tpr_server_register_callback(tpr_server *s, const char *method,
                                   tpr_msg_cb on_msg, void *ud);
 
+/* Fallback handler for methods with no exact registration (runs on its own
+ * thread, like tpr_server_register handlers). The seam a language-level
+ * server uses for DYNAMIC method resolution (grpcio generic handlers):
+ * the trampoline looks the path up in the language registry per call.
+ * Without a default, unknown methods get UNIMPLEMENTED trailers. */
+void tpr_server_register_default(tpr_server *s, tpr_handler_fn fn, void *ud);
+
 /* Start the accept loop (background thread). */
 int tpr_server_start(tpr_server *s);
 

@@ -258,6 +258,8 @@ struct tpr_server {
   std::thread accept_thread;
   std::map<std::string, std::pair<tpr_handler_fn, void *>> handlers;
   std::map<std::string, std::pair<tpr_msg_cb, void *>> cb_handlers;
+  tpr_handler_fn default_handler = nullptr;  // unknown-method fallback
+  void *default_ud = nullptr;
   std::mutex conns_mu;
   std::vector<Conn *> conns;
   std::vector<Poller *> pollers;
@@ -276,11 +278,13 @@ struct tpr_server {
   void run_handler(Conn *c, tpr_server_call *call) {
     auto it = handlers.find(call->method);
     int code;
-    if (it == handlers.end()) {
+    if (it != handlers.end()) {
+      code = it->second.first(call, it->second.second);
+    } else if (default_handler != nullptr) {
+      code = default_handler(call, default_ud);
+    } else {
       code = 12;  // UNIMPLEMENTED
       call->details = "unknown method " + call->method;
-    } else {
-      code = it->second.first(call, it->second.second);
     }
     bool was_cancelled;
     {
@@ -583,6 +587,11 @@ struct tpr_server {
   }
 
   void start_conn(int fd, const uint8_t *preread, size_t preread_len) {
+    // Bound growth for BOTH intake paths: adopted fds never pass through
+    // accept_loop, and without this an adoption-churn workload accumulates
+    // every dead conn's ring mappings (measured: ~1 GB RSS over 240
+    // churned ring connections).
+    reap_dead_conns();
     int one = 1;
     setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
     auto *c = new Conn();
@@ -607,8 +616,7 @@ struct tpr_server {
         if (errno == EINTR) continue;
         return;  // listener closed
       }
-      reap_dead_conns();  // bound growth: finished conns freed on each accept
-      start_conn(fd, nullptr, 0);
+      start_conn(fd, nullptr, 0);  // start_conn reaps (both intake paths)
     }
   }
 };
@@ -728,6 +736,11 @@ void tpr_server_register(tpr_server *s, const char *method, tpr_handler_fn fn,
 void tpr_server_register_callback(tpr_server *s, const char *method,
                                   tpr_msg_cb on_msg, void *ud) {
   s->cb_handlers[method] = {on_msg, ud};
+}
+
+void tpr_server_register_default(tpr_server *s, tpr_handler_fn fn, void *ud) {
+  s->default_handler = fn;
+  s->default_ud = ud;
 }
 
 int tpr_server_start(tpr_server *s) {

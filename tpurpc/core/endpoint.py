@@ -743,7 +743,13 @@ class EndpointListener:
     def __init__(self, host: str, port: int,
                  on_endpoint: Callable[[Endpoint], None],
                  ready: "Optional[threading.Event]" = None,
-                 ssl_context=None):
+                 ssl_context=None,
+                 raw_hook: "Optional[Callable[[socket.socket], bool]]" = None):
+        #: pre-endpoint interception seam: called with the RAW accepted
+        #: socket (plaintext listeners only); returning True means the hook
+        #: took ownership (the native-server adoption path,
+        #: rpc/native_server.py) and no Endpoint is built
+        self._raw_hook = raw_hook
         self._ssl_context = ssl_context
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -790,6 +796,12 @@ class EndpointListener:
                              name=f"tpurpc-bootstrap-{self.port}").start()
 
     def _bootstrap(self, sock: socket.socket, addr) -> None:
+        if self._raw_hook is not None and self._ssl_context is None:
+            try:
+                if self._raw_hook(sock):
+                    return  # hook owns the socket now
+            except Exception as exc:
+                trace_endpoint.log("raw hook failed (%s); python path", exc)
         try:
             if self._ssl_context is not None:
                 # Handshake before dispatch: the platform sniff/bootstrap

@@ -349,11 +349,14 @@ class PairPool:
         if pair_factory is None:
             # Domain per config (TPURPC_RING_DOMAIN): shm by default (works
             # in-process and cross-process on one host); tcp_window carries
-            # the same protocol across hosts (tpurpc/core/tcpw.py).
+            # the same protocol across hosts (tpurpc/core/tcpw.py). Read at
+            # CALL time — the pool is a process singleton that outlives a
+            # config reload, and take() validates recycled pairs against
+            # the current domain the same way.
             from tpurpc.core.pair import make_domain
 
-            kind = cfg.ring_domain
-            pair_factory = lambda: Pair(make_domain(kind))  # noqa: E731
+            pair_factory = lambda: Pair(  # noqa: E731
+                make_domain(get_config().ring_domain))
         self.pair_factory = pair_factory
         #: global bound = the reference's flat 128-pair pool (pair.h:273);
         #: the per-key default is a QUARTER of it so one hot peer key cannot
@@ -369,11 +372,27 @@ class PairPool:
         self._lock = threading.Lock()
 
     def take(self, key: str) -> Pair:
+        from tpurpc.utils.config import get_config as _gc
+
+        want_domain = _gc().ring_domain
+        stale: List[Pair] = []
         with self._lock:
             bucket = self._idle.get(key)
-            pair = bucket.pop() if bucket else None
-            if pair is not None:
+            pair = None
+            while bucket:
+                cand = bucket.pop()
                 self._idle_total -= 1
+                # A pair is BOUND to its memory domain; recycling one
+                # across a TPURPC_RING_DOMAIN change would advertise the
+                # old domain at bootstrap and fail the handshake with a
+                # domain-mismatch (observed: a tcp_window-era pooled pair
+                # reused after the config flipped back to shm).
+                if getattr(cand.domain, "kind", want_domain) == want_domain:
+                    pair = cand
+                    break
+                stale.append(cand)
+        for cand in stale:
+            cand.destroy()
         if pair is None:
             pair = self.pair_factory()
         pair.init()

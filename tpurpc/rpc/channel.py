@@ -1725,11 +1725,157 @@ class StreamUnary(_MultiCallable):
         return response
 
 
+class _NativeStreamCall:
+    """Call-shaped bidi stream over a native ``NativeCall``. The RPC starts
+    EAGERLY (the Python transport's semantics: requests flow before the
+    first response is consumed), cancel() is cross-thread-safe (a plain C
+    call, unlike closing a running generator), responses honor the
+    channel's receive limit, and completions feed the channel's call
+    counters — the parity points the native unary path already carries."""
+
+    def __init__(self, channel: "Channel", nc, serializer, deserializer,
+                 request_iterator, timeout: Optional[float]):
+        self._nc = nc
+        self._deser = deserializer
+        self._code: Optional[StatusCode] = None
+        self._details = ""
+        self._deadline = (None if timeout is None
+                          else time.monotonic() + timeout)
+        self._recv_limit = channel.max_receive_message_length
+        self._counters = channel.call_counters
+        self._counters.on_start()
+        self._finished = False
+        self._finish_lock = threading.Lock()
+        self._callbacks: list = []
+        self._app_exc: list = []
+        self._sender = threading.Thread(
+            target=self._pump_requests, args=(request_iterator, serializer),
+            daemon=True)
+        self._sender.start()
+
+    def _pump_requests(self, request_iterator, serializer) -> None:
+        try:
+            for item in request_iterator:
+                self._nc.write(serializer(item))
+            self._nc.writes_done()
+        except RpcError:
+            pass  # the read side surfaces the status
+        except BaseException as exc:  # the app's iterator/serializer raised
+            self._app_exc.append(exc)
+            self._nc.cancel()  # both sides unblock; reader sees CANCELLED
+
+    def _finish(self) -> None:
+        with self._finish_lock:
+            if self._finished:
+                return
+            self._finished = True
+        if self._sender.is_alive():
+            # early consumer exit with requests still flowing: RST first
+            # so the blocked writer fails fast, THEN join (destroying the
+            # call under a live writer is a native use-after-free)
+            self._nc.cancel()
+        self._sender.join()
+        code, details = self._nc.finish()
+        self._code, self._details = code, details
+        self._nc.close()
+        self._counters.on_finish(code is StatusCode.OK)
+        for cb in self._callbacks:
+            try:
+                cb()
+            except Exception:
+                pass
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        msg = self._nc.read()
+        if msg is None:
+            self._finish()
+            if self._app_exc:
+                raise self._app_exc[0]
+            if self._code is not StatusCode.OK:
+                raise RpcError(self._code, self._details)
+            raise StopIteration
+        if self._recv_limit is not None and len(msg) > self._recv_limit:
+            self._nc.cancel()
+            self._finish()
+            self._code = StatusCode.RESOURCE_EXHAUSTED
+            self._details = ("received message larger than "
+                            "max_receive_message_length")
+            raise RpcError(self._code, self._details)
+        return _deserialize(self._deser, msg)
+
+    def __del__(self):
+        # abandoned stream: RST + teardown so the server stops producing
+        try:
+            if not self._finished:
+                self._nc.cancel()
+                self._finish()
+        except Exception:
+            pass
+
+    # -- grpc Call surface ---------------------------------------------------
+
+    def cancel(self) -> None:
+        self._nc.cancel()  # thread-safe: plain C call, reader unblocks
+
+    def code(self) -> Optional[StatusCode]:
+        return self._code
+
+    def details(self) -> str:
+        return self._details
+
+    def is_active(self) -> bool:
+        return not self._finished
+
+    def time_remaining(self) -> Optional[float]:
+        if self._deadline is None:
+            return None
+        return max(0.0, self._deadline - time.monotonic())
+
+    def add_callback(self, callback) -> bool:
+        with self._finish_lock:
+            if not self._finished:
+                self._callbacks.append(callback)
+                return True
+        return False
+
+    def initial_metadata(self):
+        return []
+
+    def trailing_metadata(self):
+        return []
+
+
 class StreamStream(_MultiCallable):
     def __call__(self, request_iterator: Iterable,
                  timeout: Optional[float] = None,
-                 metadata: Optional[Metadata] = None, **grpcio_kw) -> Call:
+                 metadata: Optional[Metadata] = None, **grpcio_kw):
         _reject_call_credentials(grpcio_kw)
+        # Native bidi fast path, same eligibility story as UnaryUnary:
+        # plain calls on eligible channels stream through libtpurpc's
+        # loop (the duplex/tensor hot path). Callers needing per-call
+        # metadata stay on the Python transport.
+        if not metadata and not grpcio_kw.get("wait_for_ready"):
+            from tpurpc.tpu import ledger as _ledger
+            from tpurpc.utils import stats as _stats
+
+            if not _ledger.tracking() and not _stats.profiling_on():
+                nch = self._channel._native_fast()
+                if nch is not None:
+                    try:
+                        nc = nch.start_call(self._method, timeout)
+                    except RpcError:
+                        # dead cached fast path: drop it and let the
+                        # Python transport (reconnect machinery) carry
+                        # this call — nothing was sent yet, so replay is
+                        # unconditionally safe
+                        self._channel._native_invalidate(nch)
+                    else:
+                        return _NativeStreamCall(self._channel, nc,
+                                                 self._ser, self._deser,
+                                                 request_iterator, timeout)
         conn, st, call = self._start(
             metadata, timeout,
             wait_for_ready=bool(grpcio_kw.get("wait_for_ready")))

@@ -933,18 +933,64 @@ class Server:
         return bound
 
     def _open_port(self, host: str, port: int, ssl_context=None) -> int:
-        listener = EndpointListener(host, port, self.serve_endpoint,
-                                    ready=self._serving,
-                                    ssl_context=ssl_context)
+        listener = EndpointListener(
+            host, port, self.serve_endpoint, ready=self._serving,
+            ssl_context=ssl_context,
+            raw_hook=None if ssl_context is not None
+            else self._try_native_adopt)
         self._listeners.append(listener)
         return listener.port
 
     def start(self) -> "Server":
         if self._started:
             return self
+        # Native data plane (rpc/native_server.py): eligible servers hand
+        # accepted ring connections to libtpurpc's shared-poller loop with
+        # Python handlers trampolined back — the grpcio architecture
+        # (language surface over the C core). Built at start() so every
+        # registered method exists; listeners only accept after _serving.
+        self._native_dp = None
+        try:
+            from tpurpc.rpc.native_server import (NativeDataplane,
+                                                  adoption_eligible)
+
+            if adoption_eligible(self):
+                self._native_dp = NativeDataplane(self)
+        except Exception as exc:  # lib unbuildable etc.: Python plane
+            trace_server.log("native dataplane unavailable: %s", exc)
         self._started = True
         self._serving.set()  # listeners begin accepting (bound since add_port)
         return self
+
+    def _try_native_adopt(self, sock) -> bool:
+        """Raw-socket listener hook: peek the protocol magic and hand RING
+        connections (TRB1 bootstrap) to the native data plane. Peeking
+        (MSG_PEEK) consumes nothing, so a False return leaves the socket
+        exactly as accepted for the Python path."""
+        import socket as _socket
+
+        dp = getattr(self, "_native_dp", None)
+        if dp is None:
+            return False
+        deadline = time.monotonic() + 30
+        first = b""
+        try:
+            sock.settimeout(2)
+            while len(first) < 4 and time.monotonic() < deadline:
+                try:
+                    first = sock.recv(4, _socket.MSG_PEEK)
+                except (TimeoutError, _socket.timeout):
+                    continue
+                if not first:
+                    return False  # peer closed before the preface
+                if len(first) < 4:
+                    time.sleep(0.002)
+            sock.settimeout(None)
+        except OSError:
+            return False
+        if first != b"TRB1":
+            return False
+        return dp.adopt(sock)
 
     def serve_endpoint(self, endpoint: Endpoint) -> None:
         """Adopt an already-connected endpoint, sniffing the protocol.
@@ -1054,6 +1100,13 @@ class Server:
                 time.sleep(0.01)
         for conn in conns:
             conn.close()
+        dp = getattr(self, "_native_dp", None)
+        if dp is not None:
+            self._native_dp = None
+            try:
+                dp.close()  # tears down adopted connections + native pollers
+            except Exception:
+                pass
         self._pool.shutdown(wait=False)
         self._stopped.set()
         return self._stopped
