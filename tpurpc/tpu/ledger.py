@@ -33,6 +33,14 @@ _counters: Dict[str, int] = {
     "dma_d2h": 0,
     "dma_d2d": 0,
     "zero_copy": 0,
+    # op counts (one per reported movement) alongside the byte totals:
+    # single-movement claims are assertable — "this placement was exactly
+    # ONE device write" is a count, not a byte sum (VERDICT r3 next#6)
+    "host_copy_ops": 0,
+    "dma_h2d_ops": 0,
+    "dma_d2h_ops": 0,
+    "dma_d2d_ops": 0,
+    "zero_copy_ops": 0,
 }
 
 
@@ -40,6 +48,7 @@ def add(kind: str, nbytes: int) -> None:
     if nbytes:
         with _lock:
             _counters[kind] += nbytes
+            _counters[kind + "_ops"] += 1
 
 
 def host_copy(nbytes: int) -> None:
