@@ -1,0 +1,147 @@
+"""xDS-lite: bootstrap, the xds: resolver, and EDS-style dynamic updates.
+
+The reference's xds client_channel family
+(``ext/filters/client_channel/resolver/xds/``, ``lb_policy/xds/``) scoped
+to tpurpc's lite shim (tpurpc/rpc/xds.py): gRPC's bootstrap/target UX over
+tpurpc's own ADS-lite wire, feeding Channel.update_addresses.
+"""
+
+import json
+import time
+
+import pytest
+
+import tpurpc.rpc as rpc
+from tpurpc.rpc.xds import (XdsServicer, XdsWatcher, load_bootstrap,
+                            xds_channel)
+
+
+def _echo_server(tag: bytes):
+    srv = rpc.Server(max_workers=2)
+    srv.add_method("/x.S/Who",
+                   rpc.unary_unary_rpc_method_handler(lambda r, c: tag))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    return srv, port
+
+
+def _control_plane():
+    xds = XdsServicer()
+    srv = rpc.Server(max_workers=4)
+    xds.attach(srv)
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    return xds, srv, port
+
+
+def test_bootstrap_parsing(monkeypatch, tmp_path):
+    monkeypatch.delenv("GRPC_XDS_BOOTSTRAP", raising=False)
+    monkeypatch.delenv("GRPC_XDS_BOOTSTRAP_CONFIG", raising=False)
+    with pytest.raises(RuntimeError):
+        load_bootstrap()  # no bootstrap configured: loud
+    monkeypatch.setenv("GRPC_XDS_BOOTSTRAP_CONFIG",
+                       json.dumps({"xds_servers": []}))
+    with pytest.raises(RuntimeError):
+        load_bootstrap()  # malformed: needs server_uri
+    # inline config works; a FILE wins over it (gRPC precedence)
+    monkeypatch.setenv("GRPC_XDS_BOOTSTRAP_CONFIG", json.dumps(
+        {"xds_servers": [{"server_uri": "inline:1"}]}))
+    assert load_bootstrap()["xds_servers"][0]["server_uri"] == "inline:1"
+    bs = tmp_path / "bootstrap.json"
+    bs.write_text(json.dumps({"xds_servers": [{"server_uri": "file:2"}],
+                              "node": {"id": "n1"}}))
+    monkeypatch.setenv("GRPC_XDS_BOOTSTRAP", str(bs))
+    cfg = load_bootstrap()
+    assert cfg["xds_servers"][0]["server_uri"] == "file:2"
+    assert cfg["node"]["id"] == "n1"
+
+
+def test_xds_target_resolves_via_control_plane(monkeypatch):
+    """Channel("xds:///svc") works like grpcio's: bootstrap names the
+    control plane, the resolver fetches the current EDS assignment."""
+    backend, bport = _echo_server(b"b1")
+    xds, cp, cport = _control_plane()
+    try:
+        xds.set_endpoints("svc", [f"127.0.0.1:{bport}"])
+        monkeypatch.setenv("GRPC_XDS_BOOTSTRAP_CONFIG", json.dumps(
+            {"xds_servers": [{"server_uri": f"127.0.0.1:{cport}"}],
+             "node": {"id": "test-node"}}))
+        monkeypatch.delenv("GRPC_XDS_BOOTSTRAP", raising=False)
+        with rpc.Channel("xds:///svc") as ch:
+            assert ch.unary_unary("/x.S/Who")(b"", timeout=15) == b"b1"
+        # empty assignment: loud resolution failure, not a hang
+        with pytest.raises(Exception):
+            rpc.Channel("xds:///nonexistent-svc")
+    finally:
+        cp.stop(grace=0)
+        backend.stop(grace=0)
+
+
+def test_xds_watcher_moves_traffic_on_eds_update(monkeypatch):
+    """set_endpoints (the EDS update) re-points a live channel: the
+    watcher feeds update_addresses; calls land on the new backend."""
+    b1, p1 = _echo_server(b"b1")
+    b2, p2 = _echo_server(b"b2")
+    xds, cp, cport = _control_plane()
+    monkeypatch.setenv("GRPC_XDS_BOOTSTRAP_CONFIG", json.dumps(
+        {"xds_servers": [{"server_uri": f"127.0.0.1:{cport}"}]}))
+    monkeypatch.delenv("GRPC_XDS_BOOTSTRAP", raising=False)
+    try:
+        xds.set_endpoints("svc", [f"127.0.0.1:{p1}"])
+        ch, watcher = xds_channel("xds:///svc")
+        try:
+            who = ch.unary_unary("/x.S/Who")
+            assert who(b"", timeout=15) == b"b1"
+            # hostname endpoint on purpose: the watcher must normalize it
+            # the same way the channel's keep-live matching does (a raw
+            # string would mismatch the resolved keys and churn live
+            # connections on every identical push)
+            xds.set_endpoints("svc", [f"localhost:{p2}"])
+            deadline = time.monotonic() + 10
+            seen = b""
+            while time.monotonic() < deadline:
+                try:
+                    seen = who(b"", timeout=15)
+                except rpc.RpcError as exc:
+                    # a call racing the membership swap may land on the
+                    # closing backend once (update_addresses' documented
+                    # contract) — the next call re-dials
+                    if exc.code() is not rpc.StatusCode.UNAVAILABLE:
+                        raise
+                if seen == b"b2":
+                    break
+                time.sleep(0.05)
+            assert seen == b"b2", "EDS update never moved traffic"
+            assert watcher.applied_versions, "watcher applied no update"
+        finally:
+            watcher.stop()
+            ch.close()
+    finally:
+        cp.stop(grace=0)
+        b1.stop(grace=0)
+        b2.stop(grace=0)
+
+
+def test_xds_watcher_keeps_last_assignment_on_control_plane_loss(monkeypatch):
+    """Control-plane death must NOT churn a working assignment (gRPC's
+    xds behavior): calls keep flowing to the last applied endpoints."""
+    b1, p1 = _echo_server(b"b1")
+    xds, cp, cport = _control_plane()
+    monkeypatch.setenv("GRPC_XDS_BOOTSTRAP_CONFIG", json.dumps(
+        {"xds_servers": [{"server_uri": f"127.0.0.1:{cport}"}]}))
+    monkeypatch.delenv("GRPC_XDS_BOOTSTRAP", raising=False)
+    try:
+        xds.set_endpoints("svc", [f"127.0.0.1:{p1}"])
+        ch, watcher = xds_channel("xds:///svc")
+        try:
+            who = ch.unary_unary("/x.S/Who")
+            assert who(b"", timeout=15) == b"b1"
+            cp.stop(grace=0)  # control plane goes away
+            time.sleep(0.5)
+            for _ in range(5):  # membership unchanged; calls keep working
+                assert who(b"", timeout=15) == b"b1"
+        finally:
+            watcher.stop()
+            ch.close()
+    finally:
+        b1.stop(grace=0)

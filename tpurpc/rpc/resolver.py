@@ -59,6 +59,11 @@ def _dns_resolve(hostport: str) -> List[Address]:
 def resolve_target(target: str) -> List[Address]:
     """gRPC-style target URI → ordered address list."""
     scheme, sep, rest = target.partition(":")
+    if sep and scheme == "xds" and scheme not in _RESOLVERS:
+        # lazy: importing the xds module registers its resolver (bootstrap
+        # + ADS-lite snapshot; tpurpc/rpc/xds.py — the reference's
+        # resolver/xds analog)
+        import tpurpc.rpc.xds  # noqa: F401
     if sep and scheme in _RESOLVERS:
         return _RESOLVERS[scheme](rest.lstrip("/"))
     if target.startswith("dns:"):

@@ -1,0 +1,318 @@
+"""xDS-lite: an xds resolver + EDS-style endpoint discovery shim.
+
+The reference carries the xDS client_channel family — the ``xds:`` resolver
+(``ext/filters/client_channel/resolver/xds/xds_resolver.cc``), the xds LB
+policies (``lb_policy/xds/{cds,eds}.cc``) and the google-c2p variant — as
+inherited inventory (SURVEY.md §2.4). This module is tpurpc's lite analog
+of that capability, scoped the way VERDICT r3 #9 scoped it: the gRPC xDS
+UX (bootstrap file + ``xds:///service`` targets + dynamic endpoint
+updates) over tpurpc's OWN control-plane wire and existing composition
+tree, NOT the Envoy ADS protobuf surface (that protocol family is
+Envoy-ecosystem infrastructure the way ALTS is Google infrastructure —
+out of scope; the seam where a full ADS client would plug in is exactly
+this module).
+
+Pieces (mirroring how gRPC's pieces fit):
+
+* **Bootstrap** — ``GRPC_XDS_BOOTSTRAP`` (a JSON file path) or
+  ``GRPC_XDS_BOOTSTRAP_CONFIG`` (inline JSON), the real gRPC knobs:
+  ``{"xds_servers": [{"server_uri": "host:port"}], "node": {"id": ...}}``.
+* **``xds:`` resolver** — registered into the channel's resolver registry
+  (``register_resolver``, the fake-resolver seam): ``xds:///service``
+  dials the bootstrap server and returns the service's CURRENT endpoint
+  list — so a plain ``Channel("xds:///service")`` works with a static
+  snapshot, grpcio-style.
+* **:class:`XdsServicer`** — the control plane: per-service endpoint
+  sets pushed to subscribers (``set_endpoints`` = the EDS
+  ClusterLoadAssignment update). Attach to any tpurpc server.
+* **:class:`XdsWatcher`** — the dynamic half: subscribes on the ADS-lite
+  stream and feeds every update into ``Channel.update_addresses`` (the
+  eds policy's job in the reference).
+* **:func:`xds_channel`** — the one-call UX: bootstrap + first snapshot +
+  watcher, returning a channel whose membership tracks the control plane.
+
+Wire (ADS-lite): bidi stream ``/tpurpc.xds.v1.Ads/Stream``; the client
+opens with ``{"node": {...}, "resource": "<service>"}`` (JSON) and
+receives ``{"version": N, "endpoints": ["host:port", ...]}`` — the
+current assignment immediately, then one message per change.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+METHOD = "/tpurpc.xds.v1.Ads/Stream"
+
+
+# -- bootstrap ---------------------------------------------------------------
+
+def load_bootstrap() -> dict:
+    """The gRPC bootstrap contract: file via GRPC_XDS_BOOTSTRAP, inline
+    via GRPC_XDS_BOOTSTRAP_CONFIG (file wins, like gRPC)."""
+    path = os.environ.get("GRPC_XDS_BOOTSTRAP")
+    raw: Optional[str] = None
+    if path:
+        with open(path, "r", encoding="utf-8") as f:
+            raw = f.read()
+    else:
+        raw = os.environ.get("GRPC_XDS_BOOTSTRAP_CONFIG")
+    if not raw:
+        raise RuntimeError(
+            "xds: target needs a bootstrap: set GRPC_XDS_BOOTSTRAP to a "
+            "JSON file or GRPC_XDS_BOOTSTRAP_CONFIG to inline JSON")
+    cfg = json.loads(raw)
+    servers = cfg.get("xds_servers") or []
+    if not servers or "server_uri" not in servers[0]:
+        raise RuntimeError("xds bootstrap needs xds_servers[0].server_uri")
+    return cfg
+
+
+def _server_uri(cfg: dict) -> str:
+    return cfg["xds_servers"][0]["server_uri"]
+
+
+# -- control plane -----------------------------------------------------------
+
+class XdsServicer:
+    """ADS-lite control plane: per-service endpoint assignments, pushed.
+
+    ``set_endpoints(service, ["h:p", ...])`` is the EDS update; every
+    subscriber of that service receives the new assignment immediately,
+    and a fresh subscriber gets the current one on subscribe."""
+
+    def __init__(self):
+        self._lock = threading.Condition()
+        self._assignments: Dict[str, List[str]] = {}
+        self._version = 0
+
+    def set_endpoints(self, service: str, endpoints: Sequence[str]) -> None:
+        with self._lock:
+            self._assignments[service] = list(endpoints)
+            self._version += 1
+            self._lock.notify_all()
+
+    def get_endpoints(self, service: str) -> List[str]:
+        with self._lock:
+            return list(self._assignments.get(service, []))
+
+    def _stream(self, request_iterator, ctx):
+        first = next(iter(request_iterator), None)
+        if first is None:
+            return
+        try:
+            sub = json.loads(bytes(first).decode())
+            resource = sub["resource"]
+        except (ValueError, KeyError):
+            from tpurpc.rpc.status import AbortError, StatusCode
+
+            raise AbortError(StatusCode.INVALID_ARGUMENT,
+                             "ADS stream must open with "
+                             '{"resource": "<service>"}') from None
+        last_sent: Optional[List[str]] = None
+        while ctx.is_active():
+            with self._lock:
+                current = list(self._assignments.get(resource, []))
+                version = self._version
+                if current == last_sent:
+                    self._lock.wait_for(lambda: self._version != version,
+                                        timeout=1.0)
+                    continue
+            last_sent = current
+            yield json.dumps({"version": version,
+                              "endpoints": current}).encode()
+
+    def attach(self, server) -> None:
+        from tpurpc.rpc.server import stream_stream_rpc_method_handler
+
+        server.add_method(METHOD,
+                          stream_stream_rpc_method_handler(self._stream))
+
+
+# -- client side -------------------------------------------------------------
+
+def _fetch_snapshot(server_uri: str, service: str, node: dict,
+                    timeout: float = 10.0) -> List[str]:
+    """One subscribe → first assignment → done (the resolver's job)."""
+    from tpurpc.rpc.channel import Channel
+    from tpurpc.rpc.status import RpcError
+
+    with Channel(server_uri, connect_timeout=timeout) as ch:
+        stream = ch.stream_stream(METHOD)
+        sub = json.dumps({"node": node, "resource": service}).encode()
+
+        def reqs():
+            yield sub
+            # keep the request side open until the response arrives
+
+        call = stream(iter(reqs()), timeout=timeout)
+        try:
+            first = next(iter(call), None)
+        finally:
+            try:
+                call.cancel()
+            except Exception:
+                pass
+        if first is None:
+            raise RuntimeError(
+                f"xds server {server_uri} closed the ADS stream without "
+                f"an assignment for {service!r}")
+        try:
+            return list(json.loads(bytes(first).decode())["endpoints"])
+        except (ValueError, KeyError) as exc:
+            raise RuntimeError(
+                f"malformed ADS response from {server_uri}") from exc
+
+
+def _normalize(endpoints: Sequence[str]) -> list:
+    """Endpoint strings → resolved (host, port) tuples, through the SAME
+    normalization ``Channel.update_addresses`` applies — hostname
+    endpoints must produce identical keys at construction and on every
+    update, or the keep-live matching misses and a no-op update tears
+    down live connections (channel.py's own warning)."""
+    from tpurpc.rpc.resolver import resolve_target
+
+    out = []
+    for e in endpoints:
+        out.extend(resolve_target(e))
+    return out
+
+
+def _resolve_xds(rest: str):
+    """Resolver for ``xds:///service`` (registered below)."""
+    service = rest.lstrip("/")
+    cfg = load_bootstrap()
+    endpoints = _fetch_snapshot(_server_uri(cfg), service,
+                                cfg.get("node", {}))
+    if not endpoints:
+        raise ValueError(f"xds assignment for {service!r} is empty")
+    return _normalize(endpoints)
+
+
+def _install_resolver() -> None:
+    from tpurpc.rpc.resolver import register_resolver
+
+    register_resolver("xds", _resolve_xds)
+
+
+_install_resolver()
+
+
+class XdsWatcher:
+    """Dynamic membership: ADS-lite subscription → update_addresses.
+
+    The eds-policy role (``lb_policy/xds/eds.cc``): every assignment
+    change the control plane pushes lands in the channel's composition
+    tree via :meth:`Channel.update_addresses` (kept subchannels keep
+    their connections). Reconnects with backoff when the control plane
+    drops; the channel keeps its LAST applied assignment meanwhile
+    (gRPC's xds behavior: no assignment churn on control-plane loss).
+
+    Structurally a sibling of :class:`~tpurpc.rpc.lookaside.
+    LookasideWatcher` (same subscribe/stream/apply/backoff skeleton) —
+    kept separate because the wires diverge (grpclb speaks
+    initial_response + ClientStats load reporting; ADS-lite is
+    subscribe→assignments), but fixes to either loop's lifecycle
+    handling likely apply to both."""
+
+    def __init__(self, channel, service: str,
+                 bootstrap: Optional[dict] = None):
+        if getattr(channel, "_addrs", None) is None:
+            raise ValueError(
+                "xds watching needs a target-built channel "
+                "(endpoint_factory channels have fixed membership)")
+        self._channel = channel
+        self._service = service
+        self._cfg = bootstrap or load_bootstrap()
+        self._stop = threading.Event()
+        #: last NORMALIZED assignment applied (seeded from the channel's
+        #: current membership): identical pushes — including the control
+        #: plane's initial resend of the snapshot the resolver already
+        #: fetched — are skipped, so a static assignment never churns the
+        #: LB policy or disqualifies the channel's native fast path
+        self._last_applied = list(channel._addrs)
+        self.applied_versions: List[int] = []  # observability/test seam
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="tpurpc-xds")
+        self._thread.start()
+
+    def _run(self) -> None:
+        from tpurpc.rpc.channel import Channel
+
+        uri = _server_uri(self._cfg)
+        node = self._cfg.get("node", {})
+        backoff = 0.2
+        while not self._stop.is_set():
+            try:
+                with Channel(uri, connect_timeout=10.0) as bch:
+                    self._bch = bch  # stop() closes it to unblock the recv
+                    sub = json.dumps({"node": node,
+                                      "resource": self._service}).encode()
+
+                    def reqs():
+                        yield sub
+                        while not self._stop.wait(0.2):
+                            pass
+
+                    for msg in bch.stream_stream(METHOD)(reqs(),
+                                                         timeout=None):
+                        if self._stop.is_set():
+                            return
+                        try:
+                            upd = json.loads(bytes(msg).decode())
+                            # normalization may raise too (bad host:port
+                            # strings): the whole parse is one
+                            # keep-the-last-good unit, NOT a stream
+                            # teardown — a control plane resending one
+                            # malformed assignment must not put the
+                            # watcher in a reconnect loop
+                            addrs = _normalize(list(upd["endpoints"]))
+                        except (ValueError, KeyError):
+                            continue  # malformed push: keep the last good
+                        if addrs and addrs != self._last_applied:
+                            self._channel.update_addresses(addrs)
+                            self._last_applied = addrs
+                            self.applied_versions.append(
+                                int(upd.get("version", -1)))
+                        backoff = 0.2
+            except Exception:
+                if self._stop.is_set():
+                    return
+            if self._stop.wait(backoff):
+                return
+            backoff = min(backoff * 2, 5.0)
+
+    def stop(self) -> None:
+        self._stop.set()
+        bch = getattr(self, "_bch", None)
+        if bch is not None:
+            try:
+                bch.close()
+            except Exception:
+                pass
+        self._thread.join(timeout=5)
+
+
+def xds_channel(target: str, bootstrap: Optional[dict] = None, **channel_kw):
+    """``xds:///service`` → a channel whose membership tracks the control
+    plane. Returns ``(channel, watcher)``; stop the watcher before (or
+    with) closing the channel."""
+    if not target.startswith("xds:"):
+        raise ValueError(f"not an xds target: {target!r}")
+    from tpurpc.rpc.channel import Channel
+
+    service = target[4:].lstrip("/")
+    cfg = bootstrap or load_bootstrap()
+    endpoints = _fetch_snapshot(_server_uri(cfg), service,
+                                cfg.get("node", {}))
+    if not endpoints:
+        raise ValueError(f"xds assignment for {service!r} is empty")
+    addrs = _normalize(endpoints)  # same keys update_addresses will produce
+    ch = Channel("ipv4:" + ",".join(f"{h}:{p}" for h, p in addrs),
+                 lb_policy=channel_kw.pop("lb_policy", "round_robin"),
+                 **channel_kw)
+    watcher = XdsWatcher(ch, service, bootstrap=cfg)
+    return ch, watcher
