@@ -42,9 +42,18 @@ import numpy as np
 
 from tpurpc.rpc.server import Server
 
-srv = Server(max_workers=8)
+# Two servers, two data planes (deployment guidance, round 4): the BULK
+# streaming sink runs a Python-plane server (native_dataplane=False — its
+# zero-bounce Assembly receive wins on 4 MiB payloads), while the serving
+# flagship keeps the default plane (ring connections adopted onto the
+# native shared-poller loop — the small-RPC latency win feeds the batcher
+# faster). Both effects measured on this host; see rpc/server.py's
+# native_dataplane docstring.
+srv = Server(max_workers=8, native_dataplane=False)
 port = srv.add_insecure_port("127.0.0.1:0")
-print("PORT", port, flush=True)          # bind first: cheap, can't hang
+srv_infer = Server(max_workers=8)
+port_infer = srv_infer.add_insecure_port("127.0.0.1:0")
+print("PORT", port, port_infer, flush=True)  # bind first: cheap, can't hang
 
 # Backend bring-up OUTSIDE any RPC deadline. On the axon TPU tunnel this can
 # take minutes; the client waits for READY with its own wall budget.
@@ -115,7 +124,7 @@ if os.environ.get("TPURPC_BENCH_SERVING", "1") == "1":
     batcher = FanInBatcher(serve_fn, max_batch=MAXB, max_delay_s=0.005,
                           fixed_bucket=True,
                           transfer_dtype=jnp.bfloat16 if on_accel else None)
-    add_tensor_method(srv, "Infer", batcher)
+    add_tensor_method(srv_infer, "Infer", batcher)
     # warm the single compiled batch shape before READY
     warm = np.zeros((MAXB, img, img, 3), np.float32)
     jax.tree_util.tree_map(lambda x: x.block_until_ready(),
@@ -147,10 +156,12 @@ if os.environ.get("TPURPC_BENCH_SERVING", "1") == "1":
     print("SERVING", model_name, img, flush=True)
 
 srv.start()
+srv_infer.start()
 print("DEVKIND", getattr(dev, "device_kind", dev.platform), flush=True)
 print("READY", dev.platform, ("serving" if batcher else "noserving"),
       flush=True)
 srv.wait_for_termination(timeout=1200)
+srv_infer.stop(grace=0)
 """
 
 
@@ -337,7 +348,9 @@ def _run_once(env, n_msgs: int, ready_s: float):
 
     srv = _ServerProc(env)
     try:
-        port = int(srv.wait_line("PORT", 60).split()[1])
+        port_line = srv.wait_line("PORT", 60).split()
+        port = int(port_line[1])
+        port_infer = int(port_line[2]) if len(port_line) > 2 else port
         ready = srv.wait_line("READY", ready_s)
         parts = ready.split()
         platform = parts[1]
@@ -409,7 +422,7 @@ def _run_once(env, n_msgs: int, ready_s: float):
                     extras["device_infer_qps"] = float(dev_qps)
                 except Exception:
                     pass
-                serving = _serving_phase(port, model, int(img))
+                serving = _serving_phase(port_infer, model, int(img))
             except Exception as exc:  # serving is auxiliary: report, don't fail
                 sys.stderr.write(f"serving phase failed: {exc}\n")
         return total / dt / 1e9, platform, serving, extras

@@ -184,9 +184,17 @@ class TensorClient:
         return mc(tree, timeout=timeout)
 
     def duplex(self, name: str, trees: Iterator[Any],
-               timeout: Optional[float] = None) -> Iterator[Any]:
+               timeout: Optional[float] = None,
+               native: bool = False) -> Iterator[Any]:
+        """Bidi tensor stream. ``native=False`` (default) keeps the BULK
+        path on the Python transport, whose zero-bounce Assembly + gather
+        sends move multi-MiB payloads ~25% faster than the native loop's
+        accumulate-and-copy (bench.py streaming A/B); pass ``native=True``
+        for small-tensor ping-pong streams, where the native loop's
+        per-message latency wins instead."""
         mc = self._channel.stream_stream(
-            _method_path(name), codec.tree_serializer, codec.tree_deserializer)
+            _method_path(name), codec.tree_serializer,
+            codec.tree_deserializer, tpurpc_native=native)
         return mc(trees, timeout=timeout)
 
 

@@ -1019,25 +1019,33 @@ class Channel:
                     response_deserializer: Deserializer = _identity,
                     **_grpcio_kwargs) -> "UnaryUnary":
         return UnaryUnary(self, method, request_serializer or _identity,
-                          response_deserializer or _identity)
+                          response_deserializer or _identity,
+                          allow_native=_grpcio_kwargs.pop(
+                              "tpurpc_native", True))
 
     def unary_stream(self, method: str, request_serializer: Serializer = _identity,
                      response_deserializer: Deserializer = _identity,
                      **_grpcio_kwargs) -> "UnaryStream":
         return UnaryStream(self, method, request_serializer or _identity,
-                           response_deserializer or _identity)
+                           response_deserializer or _identity,
+                           allow_native=_grpcio_kwargs.pop(
+                               "tpurpc_native", True))
 
     def stream_unary(self, method: str, request_serializer: Serializer = _identity,
                      response_deserializer: Deserializer = _identity,
                      **_grpcio_kwargs) -> "StreamUnary":
         return StreamUnary(self, method, request_serializer or _identity,
-                           response_deserializer or _identity)
+                           response_deserializer or _identity,
+                           allow_native=_grpcio_kwargs.pop(
+                               "tpurpc_native", True))
 
     def stream_stream(self, method: str, request_serializer: Serializer = _identity,
                       response_deserializer: Deserializer = _identity,
                       **_grpcio_kwargs) -> "StreamStream":
         return StreamStream(self, method, request_serializer or _identity,
-                            response_deserializer or _identity)
+                            response_deserializer or _identity,
+                            allow_native=_grpcio_kwargs.pop(
+                                "tpurpc_native", True))
 
 
 class Call:
@@ -1257,11 +1265,19 @@ class RetryPolicy:
 
 class _MultiCallable:
     def __init__(self, channel: Channel, method: str,
-                 serializer: Serializer, deserializer: Deserializer):
+                 serializer: Serializer, deserializer: Deserializer,
+                 allow_native: bool = True):
         self._channel = channel
         self._method = method
         self._ser = serializer
         self._deser = deserializer
+        #: tpurpc extension (tpurpc_native=False at the factory): opt a
+        #: method out of the native fast paths. The jaxshim tensor bulk
+        #: path uses it — the Python plane's zero-bounce Assembly beats
+        #: the native loop's accumulate-and-copy on multi-MiB payloads
+        #: (measured: 4 MiB streaming 0.43 vs 0.86 GB/s), while the
+        #: native loop wins small-RPC latency.
+        self._allow_native = allow_native
 
     def _dial(self, wait_for_ready: bool,
               deadline: Optional[float]) -> _Connection:
@@ -1414,7 +1430,8 @@ class UnaryUnary(_MultiCallable):
         # run inside libtpurpc.so's inline-read loop. with_call (needs a
         # Call with trailing metadata), metadata, and wait_for_ready stay
         # on the Python transport.
-        if not metadata and not grpcio_kw.get("wait_for_ready"):
+        if (self._allow_native and not metadata
+                and not grpcio_kw.get("wait_for_ready")):
             from tpurpc.tpu import ledger as _ledger
             from tpurpc.utils import stats as _stats
 
@@ -1857,7 +1874,8 @@ class StreamStream(_MultiCallable):
         # plain calls on eligible channels stream through libtpurpc's
         # loop (the duplex/tensor hot path). Callers needing per-call
         # metadata stay on the Python transport.
-        if not metadata and not grpcio_kw.get("wait_for_ready"):
+        if (self._allow_native and not metadata
+                and not grpcio_kw.get("wait_for_ready")):
             from tpurpc.tpu import ledger as _ledger
             from tpurpc.utils import stats as _stats
 

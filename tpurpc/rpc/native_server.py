@@ -372,6 +372,8 @@ def adoption_eligible(py_server) -> bool:
     mode = os.environ.get("TPURPC_NATIVE_SERVER", "auto").lower()
     if mode in ("0", "off", "false"):
         return False
+    if getattr(py_server, "_native_dataplane_opt", None) is False:
+        return False  # Server(native_dataplane=False): bulk-optimized
     from tpurpc.utils.config import get_config
 
     cfg = get_config()
