@@ -1,0 +1,81 @@
+"""xDS-lite demo: bootstrap-discovered backends with live EDS updates.
+
+The xds capability (``tpurpc/rpc/xds.py``, the reference's resolver/xds +
+lb_policy/xds analog): a control plane publishes per-service endpoint
+assignments; channels resolve ``xds:///service`` targets through the
+gRPC bootstrap contract and track assignment changes live. Run it:
+
+    python examples/xds_demo.py
+
+It stands up two backends ("v1", "v2"), an ADS-lite control plane, and
+an ``xds:///demo-svc`` channel; serves from v1; then publishes a new
+assignment mid-flight — traffic moves to v2 without touching the client.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import tpurpc.rpc as rpc  # noqa: E402
+from tpurpc.rpc.xds import XdsServicer, xds_channel  # noqa: E402
+
+
+def backend(version: str):
+    srv = rpc.Server(max_workers=4)
+    srv.add_method(
+        "/demo.Svc/Version",
+        rpc.unary_unary_rpc_method_handler(
+            lambda req, ctx, v=version: v.encode(), inline=True))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    return srv, port
+
+
+def main() -> None:
+    b1, p1 = backend("v1")
+    b2, p2 = backend("v2")
+
+    # the control plane: any tpurpc server carrying the ADS-lite method
+    xds = XdsServicer()
+    cp = rpc.Server(max_workers=4)
+    xds.attach(cp)
+    cp_port = cp.add_insecure_port("127.0.0.1:0")
+    cp.start()
+    xds.set_endpoints("demo-svc", [f"127.0.0.1:{p1}"])
+
+    # the gRPC bootstrap contract (a file via GRPC_XDS_BOOTSTRAP works
+    # identically; inline keeps the demo self-contained)
+    os.environ["GRPC_XDS_BOOTSTRAP_CONFIG"] = json.dumps(
+        {"xds_servers": [{"server_uri": f"127.0.0.1:{cp_port}"}],
+         "node": {"id": "demo-node"}})
+
+    ch, watcher = xds_channel("xds:///demo-svc")
+    try:
+        who = ch.unary_unary("/demo.Svc/Version")
+        print("assignment v1:", who(b"", timeout=10).decode())
+
+        xds.set_endpoints("demo-svc", [f"127.0.0.1:{p2}"])  # the EDS update
+        deadline = time.monotonic() + 10
+        seen = ""
+        while time.monotonic() < deadline and seen != "v2":
+            try:
+                seen = who(b"", timeout=10).decode()
+            except rpc.RpcError:
+                continue  # a call racing the swap; the next one re-dials
+            time.sleep(0.05)
+        print("assignment v2:", seen)
+        assert seen == "v2", "EDS update did not move traffic"
+        print("OK: traffic followed the control plane")
+    finally:
+        watcher.stop()
+        ch.close()
+        cp.stop(grace=0)
+        b1.stop(grace=0)
+        b2.stop(grace=0)
+
+
+if __name__ == "__main__":
+    main()

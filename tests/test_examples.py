@@ -61,3 +61,11 @@ def test_lookaside_demo_example():
                          capture_output=True, text=True, timeout=200)
     assert out.returncode == 0, out.stderr
     assert "live blue->green shift" in out.stdout
+
+
+def test_xds_demo_example():
+    """Control-plane-driven traffic movement through the xds shim."""
+    out = subprocess.run([sys.executable, "examples/xds_demo.py"],
+                         capture_output=True, text=True, timeout=200)
+    assert out.returncode == 0, out.stderr
+    assert "traffic followed the control plane" in out.stdout

@@ -1377,6 +1377,40 @@ class _MultiCallable:
             raise RpcError(StatusCode.UNAVAILABLE,
                            f"transport failed: {exc}") from exc
 
+    @staticmethod
+    def _instruments_live() -> bool:
+        """Measurement honesty, one definition for every call shape: an
+        open copy-ledger window or live profiling spans are measuring the
+        INSTRUMENTED Python data plane — don't route around the
+        instruments."""
+        from tpurpc.tpu import ledger as _ledger
+        from tpurpc.utils import stats as _stats
+
+        return _ledger.tracking() or _stats.profiling_on()
+
+    def _try_native_stream(self, request_iterator: Iterable,
+                           timeout: Optional[float],
+                           pre_serialized: bool = False):
+        """Shared native-stream entry for the three streaming shapes:
+        an eager :class:`_NativeStreamCall` through the channel's fast
+        path, or None to use the Python transport (ineligible channel,
+        live measurement windows, or a dead cached fast channel — which
+        is invalidated so the next call re-dials; nothing was sent, so
+        the Python replay is unconditionally safe)."""
+        if self._instruments_live():
+            return None
+        nch = self._channel._native_fast()
+        if nch is None:
+            return None
+        try:
+            nc = nch.start_call(self._method, timeout)
+        except RpcError:
+            self._channel._native_invalidate(nch)
+            return None
+        ser = (lambda x: x) if pre_serialized else self._ser
+        return _NativeStreamCall(self._channel, nc, ser, self._deser,
+                                 request_iterator, timeout)
+
     def _send_stream(self, conn: _Connection, st: _ClientStream,
                      request_iterator: Iterable, call: Call) -> None:
         try:
@@ -1431,19 +1465,13 @@ class UnaryUnary(_MultiCallable):
         # Call with trailing metadata), metadata, and wait_for_ready stay
         # on the Python transport.
         if (self._allow_native and not metadata
-                and not grpcio_kw.get("wait_for_ready")):
-            from tpurpc.tpu import ledger as _ledger
-            from tpurpc.utils import stats as _stats
-
-            # Measurement honesty: an open copy-ledger window or live
-            # profiling spans are measuring the INSTRUMENTED Python data
-            # plane — don't route around the instruments.
-            if not _ledger.tracking() and not _stats.profiling_on():
-                nch = self._channel._native_fast()
-                if nch is not None:
-                    done, resp = self._native_call(nch, request, timeout)
-                    if done:
-                        return resp
+                and not grpcio_kw.get("wait_for_ready")
+                and not self._instruments_live()):
+            nch = self._channel._native_fast()
+            if nch is not None:
+                done, resp = self._native_call(nch, request, timeout)
+                if done:
+                    return resp
         response, _ = self.with_call(request, timeout=timeout,
                                      metadata=metadata, **grpcio_kw)
         return response
@@ -1703,11 +1731,39 @@ class _RetryingStreamCall:
         return getattr(self._inner, name)
 
 
+def _drain_single_response(messages) -> object:
+    """The exactly-one-response rule, shared by both transports (identical
+    status details either way)."""
+    response = None
+    got = False
+    for msg in messages:
+        if got:
+            raise RpcError(StatusCode.INTERNAL,
+                           "unary call received multiple responses")
+        response, got = msg, True
+    if not got:
+        raise RpcError(StatusCode.INTERNAL, "unary response missing")
+    return response
+
+
 class UnaryStream(_MultiCallable):
     def __call__(self, request, timeout: Optional[float] = None,
                  metadata: Optional[Metadata] = None, **grpcio_kw):
         _reject_call_credentials(grpcio_kw)
         policy = self._channel.retry_policy
+        # Native fast path (same eligibility as the other shapes; retrying
+        # calls stay on the Python transport — _RetryingStreamCall's
+        # first-response rule is built on its Call internals)
+        if (policy is None and self._allow_native and not metadata
+                and not grpcio_kw.get("wait_for_ready")):
+            # serialize EAGERLY: the Python path raises serializer errors
+            # at call time (_start serializes first_request inline), and
+            # the native path must not defer them to first iteration
+            raw = self._ser(request)
+            nsc = self._try_native_stream(iter([raw]), timeout,
+                                          pre_serialized=True)
+            if nsc is not None:
+                return nsc
         if policy is None:
             conn, st, call = self._start(
                 metadata, timeout, first_request=request,
@@ -1722,6 +1778,11 @@ class StreamUnary(_MultiCallable):
                  timeout: Optional[float] = None,
                  metadata: Optional[Metadata] = None, **grpcio_kw):
         _reject_call_credentials(grpcio_kw)
+        if (self._allow_native and not metadata
+                and not grpcio_kw.get("wait_for_ready")):
+            nsc = self._try_native_stream(request_iterator, timeout)
+            if nsc is not None:
+                return _drain_single_response(nsc)
         conn, st, call = self._start(
             metadata, timeout,
             wait_for_ready=bool(grpcio_kw.get("wait_for_ready")))
@@ -1729,16 +1790,8 @@ class StreamUnary(_MultiCallable):
             target=self._send_stream, args=(conn, st, request_iterator, call),
             daemon=True)
         sender.start()
-        response = None
-        got = False
-        for msg in call.messages():
-            if got:
-                raise RpcError(StatusCode.INTERNAL,
-                               "unary call received multiple responses")
-            response, got = msg, True
+        response = _drain_single_response(call.messages())
         sender.join(timeout=5)
-        if not got:
-            raise RpcError(StatusCode.INTERNAL, "unary response missing")
         return response
 
 
@@ -1864,6 +1917,17 @@ class _NativeStreamCall:
     def trailing_metadata(self):
         return []
 
+    def messages(self) -> Iterator[object]:
+        """Call-surface parity: response iteration (UnaryStream callers
+        use this name; on this wrapper it IS the iterator)."""
+        return self
+
+    def device_ring(self):
+        """Call-surface parity: the native loop has no device-ring seam
+        (the TPU platform is never fast-path eligible), so callers get
+        the documented off-platform answer and fall back to host decode."""
+        return None
+
 
 class StreamStream(_MultiCallable):
     def __call__(self, request_iterator: Iterable,
@@ -1876,24 +1940,9 @@ class StreamStream(_MultiCallable):
         # metadata stay on the Python transport.
         if (self._allow_native and not metadata
                 and not grpcio_kw.get("wait_for_ready")):
-            from tpurpc.tpu import ledger as _ledger
-            from tpurpc.utils import stats as _stats
-
-            if not _ledger.tracking() and not _stats.profiling_on():
-                nch = self._channel._native_fast()
-                if nch is not None:
-                    try:
-                        nc = nch.start_call(self._method, timeout)
-                    except RpcError:
-                        # dead cached fast path: drop it and let the
-                        # Python transport (reconnect machinery) carry
-                        # this call — nothing was sent yet, so replay is
-                        # unconditionally safe
-                        self._channel._native_invalidate(nch)
-                    else:
-                        return _NativeStreamCall(self._channel, nc,
-                                                 self._ser, self._deser,
-                                                 request_iterator, timeout)
+            nsc = self._try_native_stream(request_iterator, timeout)
+            if nsc is not None:
+                return nsc
         conn, st, call = self._start(
             metadata, timeout,
             wait_for_ready=bool(grpcio_kw.get("wait_for_ready")))
