@@ -53,6 +53,18 @@ enum {
  * call-per-connection or always-busy usage, not server-side keepalive
  * reaping. */
 tpr_channel *tpr_channel_create(const char *host, int port, int timeout_ms);
+
+/* Flag-taking variant. TPR_CHANNEL_INLINE_READ selects the inline-read
+ * discipline explicitly (per channel, overriding the
+ * TPURPC_NATIVE_INLINE_READ env default): blocking callers pump the
+ * transport themselves — the lowest-latency discipline on ring
+ * platforms (no reader-thread wakeup per RTT), at the price of the CQ
+ * async API refusing on such channels (it needs the reader thread).
+ * Ignored on TCP transports (a blocking fd read can't be caller-pumped
+ * across concurrent streams). */
+#define TPR_CHANNEL_INLINE_READ 1
+tpr_channel *tpr_channel_create2(const char *host, int port, int timeout_ms,
+                                 int flags);
 void tpr_channel_destroy(tpr_channel *ch);
 
 /* Round-trip a PING frame; returns microseconds, or -1 on failure. */

@@ -501,6 +501,16 @@ static void cq_expire(tpr_call *c, int code, const char *details) {
 extern "C" {
 
 tpr_channel *tpr_channel_create(const char *host, int port, int timeout_ms) {
+  // env-derived default discipline (TPURPC_NATIVE_INLINE_READ)
+  const char *inl = getenv("TPURPC_NATIVE_INLINE_READ");
+  return tpr_channel_create2(host, port, timeout_ms,
+                             (inl != nullptr && inl[0] == '1')
+                                 ? TPR_CHANNEL_INLINE_READ
+                                 : 0);
+}
+
+tpr_channel *tpr_channel_create2(const char *host, int port, int timeout_ms,
+                                 int flags) {
   struct addrinfo hints {};
   hints.ai_family = AF_UNSPEC;
   hints.ai_socktype = SOCK_STREAM;
@@ -563,9 +573,8 @@ tpr_channel *tpr_channel_create(const char *host, int port, int timeout_ms) {
   // Inline-read (opt-in, ring platforms): the lowest-latency blocking
   // discipline — callers pump the transport themselves, no reader thread.
   // CQ async ops need the reader and refuse on such channels.
-  const char *inl = getenv("TPURPC_NATIVE_INLINE_READ");
-  ch->inline_read = ch->ring != nullptr && inl != nullptr &&
-                    inl[0] == '1';
+  ch->inline_read =
+      ch->ring != nullptr && (flags & TPR_CHANNEL_INLINE_READ) != 0;
   if (!ch->inline_read)
     ch->reader = std::thread([ch] { ch->read_loop(); });
   return ch;

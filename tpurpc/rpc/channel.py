@@ -992,8 +992,14 @@ class Channel:
                 from tpurpc.rpc.native_client import NativeChannel
 
                 host, port = self._addrs[0]
+                # inline_read: the fast path only issues BLOCKING entries
+                # (unary calls + NativeCall streams — the .future() CQ
+                # path is never used here), so it takes the lowest-latency
+                # discipline: callers pump the ring, no reader-thread
+                # wakeup per RTT (the 5.65 vs 7.63 µs rows in BASELINE.md)
                 self._native_ch = NativeChannel(
-                    host, port, connect_timeout=self._conn_kw["timeout"])
+                    host, port, connect_timeout=self._conn_kw["timeout"],
+                    inline_read=True)
             except Exception:
                 return None  # lib absent/unbuildable or server down: retry in 5s
             return self._native_ch

@@ -51,6 +51,10 @@ def _load():
         lib.tpr_channel_create.restype = ctypes.c_void_p
         lib.tpr_channel_create.argtypes = [ctypes.c_char_p, ctypes.c_int,
                                            ctypes.c_int]
+        if hasattr(lib, "tpr_channel_create2"):  # absent in pre-round-4 .so
+            lib.tpr_channel_create2.restype = ctypes.c_void_p
+            lib.tpr_channel_create2.argtypes = [
+                ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int]
         lib.tpr_channel_destroy.argtypes = [ctypes.c_void_p]
         lib.tpr_channel_ping.restype = ctypes.c_int64
         lib.tpr_channel_ping.argtypes = [ctypes.c_void_p, ctypes.c_int]
@@ -332,7 +336,8 @@ class _CqDriver:
 class NativeChannel:
     """ctypes channel over the native client loop (see module docstring)."""
 
-    def __init__(self, host: str, port: int, connect_timeout: float = 10.0):
+    def __init__(self, host: str, port: int, connect_timeout: float = 10.0,
+                 inline_read: bool = False):
         self._lib = _load()
         self._cq_driver: Optional[_CqDriver] = None
         self._cq_lock = threading.Lock()
@@ -343,8 +348,19 @@ class NativeChannel:
         #: completing on another thread touches ch->streams in
         #: tpr_call_destroy (ASan-caught use-after-free, round 4).
         self._ops = 0
-        self._ch = self._lib.tpr_channel_create(
-            host.encode(), int(port), _timeout_ms(connect_timeout))
+        # inline_read: the per-channel inline-read discipline (blocking
+        # callers pump the ring; no reader thread — the lowest-latency
+        # mode). The CQ async API (.future()) refuses on such channels.
+        # inline_read=False takes tpr_channel_create, which OWNS the
+        # TPURPC_NATIVE_INLINE_READ env default — one copy of that rule,
+        # in C; the explicit flag needs create2 (older .so: fall back to
+        # the env-defaulted entry rather than crash on version skew).
+        if inline_read and hasattr(self._lib, "tpr_channel_create2"):
+            self._ch = self._lib.tpr_channel_create2(
+                host.encode(), int(port), _timeout_ms(connect_timeout), 1)
+        else:
+            self._ch = self._lib.tpr_channel_create(
+                host.encode(), int(port), _timeout_ms(connect_timeout))
         if not self._ch:
             raise RpcError(StatusCode.UNAVAILABLE,
                            f"native connect to {host}:{port} failed")
