@@ -42,3 +42,13 @@ def _reset_config_singleton():
     Poller.reset()
     PairPool.reset()
     config_mod.set_config(None)
+
+
+#: shared skip marker for suites that need the native core built
+#: (tests/test_native_client.py, test_native_server.py, test_aio.py,
+#: test_scalability.py import it instead of hand-rolling the path check)
+requires_native_lib = pytest.mark.skipif(
+    not os.path.exists(os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "native", "build", "libtpurpc.so")),
+    reason="native lib not built")

@@ -1,0 +1,190 @@
+"""Python-server native adoption (tpurpc/rpc/native_server.py).
+
+The round-4 grpcio-architecture seam: a Python ``Server`` on a ring
+platform hands accepted ring connections to libtpurpc's shared-poller
+loop (``tpr_server_adopt_fd``) with Python handlers trampolined back.
+These tests pin the trampoline's SEMANTIC surface — all four shapes,
+metadata both directions, abort, dynamic (generic-handler) dispatch —
+and the eligibility gates that keep feature-carrying servers on the
+Python plane.
+"""
+
+import os
+import threading
+
+import pytest
+
+import tpurpc.rpc as rpc
+from tpurpc.rpc.channel import Channel
+
+from tests.conftest import requires_native_lib  # noqa: E402
+
+pytestmark = requires_native_lib
+
+
+@pytest.fixture()
+def ring_platform(monkeypatch):
+    monkeypatch.setenv("GRPC_PLATFORM_TYPE", "RDMA_BP")
+    from tpurpc.utils import config as config_mod
+
+    config_mod.set_config(None)
+    yield
+    config_mod.set_config(None)
+
+
+def _four_shape_server():
+    srv = rpc.Server(max_workers=4)
+    srv.add_method("/n.S/Echo", rpc.unary_unary_rpc_method_handler(
+        lambda r, c: bytes(r), inline=True))
+    srv.add_method("/n.S/Split", rpc.unary_stream_rpc_method_handler(
+        lambda r, c: iter([bytes(r)] * 3)))
+    srv.add_method("/n.S/Join", rpc.stream_unary_rpc_method_handler(
+        lambda it, c: b"".join(bytes(m) for m in it)))
+
+    def dbl(req_iter, ctx):
+        for m in req_iter:
+            yield bytes(m) * 2
+
+    srv.add_method("/n.S/Dbl", rpc.stream_stream_rpc_method_handler(dbl))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    return srv, port
+
+
+def test_adoption_serves_all_four_shapes(ring_platform):
+    srv, port = _four_shape_server()
+    try:
+        assert srv._native_dp is not None, "adoption did not engage"
+        with Channel(f"127.0.0.1:{port}") as ch:
+            assert ch.unary_unary("/n.S/Echo")(b"u", timeout=20) == b"u"
+            assert list(ch.unary_stream("/n.S/Split")(
+                b"s", timeout=20)) == [b"s"] * 3
+            assert ch.stream_unary("/n.S/Join")(
+                iter([b"a", b"b"]), timeout=20) == b"ab"
+            assert list(ch.stream_stream("/n.S/Dbl")(
+                iter([b"x", b"yy"]), timeout=20)) == [b"xx", b"yyyy"]
+            big = bytes(range(256)) * 8192  # 2 MiB: frame fragmentation
+            assert ch.unary_unary("/n.S/Echo")(big, timeout=60) == big
+    finally:
+        srv.stop(grace=0)
+
+
+def test_adoption_metadata_abort_and_generic_dispatch(ring_platform):
+    srv = rpc.Server(max_workers=4)
+
+    def meta(req, ctx):
+        md = dict(ctx.invocation_metadata())
+        ctx.send_initial_metadata((("x-init", "i1"),))
+        ctx.set_trailing_metadata((("x-tr", "t1"),))
+        return md.get("x-key", "?").encode()
+
+    srv.add_method("/n.S/Meta", rpc.unary_unary_rpc_method_handler(meta))
+
+    def fail(req, ctx):
+        ctx.abort(rpc.StatusCode.FAILED_PRECONDITION, "nope")
+
+    srv.add_method("/n.S/Fail", rpc.unary_unary_rpc_method_handler(fail))
+
+    class GH:  # grpcio generic handler (the codegen registration shape)
+        def service(self, hcd):
+            if hcd.method == "/g.S/Up":
+                return rpc.unary_unary_rpc_method_handler(
+                    lambda r, c: bytes(r).upper())
+            return None
+
+    srv.add_generic_rpc_handlers((GH(),))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    try:
+        assert srv._native_dp is not None
+        with Channel(f"127.0.0.1:{port}") as ch:
+            # metadata calls skip the CLIENT fast path but still land on
+            # the natively-adopted server; trailing metadata comes back
+            mc = ch.unary_unary("/n.S/Meta")
+            resp, call = mc.with_call(b"", timeout=20,
+                                      metadata=(("x-key", "v1"),))
+            assert resp == b"v1"
+            assert ("x-init", "i1") in [tuple(x) for x in
+                                        call.initial_metadata() or []]
+            assert ("x-tr", "t1") in [tuple(x) for x in
+                                      call.trailing_metadata() or []]
+            with pytest.raises(rpc.RpcError) as ei:
+                ch.unary_unary("/n.S/Fail")(b"", timeout=20)
+            assert ei.value.code() is rpc.StatusCode.FAILED_PRECONDITION
+            assert "nope" in ei.value.details()
+            # dynamic dispatch through the native DEFAULT handler
+            assert ch.unary_unary("/g.S/Up")(b"abc", timeout=20) == b"ABC"
+            with pytest.raises(rpc.RpcError) as ei:
+                ch.unary_unary("/none/None")(b"", timeout=20)
+            assert ei.value.code() is rpc.StatusCode.UNIMPLEMENTED
+    finally:
+        srv.stop(grace=0)
+
+
+def test_adoption_eligibility_gates(ring_platform, monkeypatch):
+    # interceptors keep the server on the Python plane
+    class NoopInterceptor:
+        def intercept_service(self, continuation, details):
+            return continuation(details)
+
+    srv = rpc.Server(max_workers=2, interceptors=(NoopInterceptor(),))
+    srv.add_method("/n.S/Echo",
+                   rpc.unary_unary_rpc_method_handler(lambda r, c: bytes(r)))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    try:
+        assert srv._native_dp is None
+        with Channel(f"127.0.0.1:{port}") as ch:  # and it still serves
+            assert ch.unary_unary("/n.S/Echo")(b"i", timeout=20) == b"i"
+    finally:
+        srv.stop(grace=0)
+
+    # the explicit opt-outs
+    srv2 = rpc.Server(max_workers=2, native_dataplane=False)
+    srv2.add_method("/n.S/Echo",
+                    rpc.unary_unary_rpc_method_handler(lambda r, c: bytes(r)))
+    srv2.add_insecure_port("127.0.0.1:0")
+    srv2.start()
+    try:
+        assert srv2._native_dp is None
+    finally:
+        srv2.stop(grace=0)
+
+    monkeypatch.setenv("TPURPC_NATIVE_SERVER", "0")
+    srv3 = rpc.Server(max_workers=2)
+    srv3.add_method("/n.S/Echo",
+                    rpc.unary_unary_rpc_method_handler(lambda r, c: bytes(r)))
+    srv3.add_insecure_port("127.0.0.1:0")
+    srv3.start()
+    try:
+        assert srv3._native_dp is None
+    finally:
+        srv3.stop(grace=0)
+
+
+def test_adoption_concurrent_multiplexed_calls(ring_platform):
+    """Many threads, one adopted connection each + multiplexed calls —
+    the poller demux and trampoline GIL handoffs under pressure."""
+    srv, port = _four_shape_server()
+    try:
+        errs = []
+
+        def worker(i):
+            try:
+                with Channel(f"127.0.0.1:{port}") as ch:
+                    echo = ch.unary_unary("/n.S/Echo")
+                    for j in range(20):
+                        body = f"w{i}-{j}".encode() + b"p" * (i * 53)
+                        assert echo(body, timeout=30) == body
+            except Exception as exc:
+                errs.append(exc)
+
+        ts = [threading.Thread(target=worker, args=(i,)) for i in range(6)]
+        [t.start() for t in ts]
+        [t.join(120) for t in ts]
+        # liveness, not just error-freeness: a deadlocked worker must FAIL
+        # this test, not time out of join() into a vacuous pass
+        assert not any(t.is_alive() for t in ts), "worker deadlocked"
+        assert not errs, errs[:3]
+    finally:
+        srv.stop(grace=0)

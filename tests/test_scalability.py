@@ -20,10 +20,9 @@ import pytest
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SRV_BIN = os.path.join(ROOT, "native", "build", "cpp_server_example")
 
-pytestmark = pytest.mark.skipif(
-    not os.path.exists(os.path.join(ROOT, "native", "build",
-                                    "libtpurpc.so")),
-    reason="native lib not built")
+from tests.conftest import requires_native_lib  # noqa: E402
+
+pytestmark = requires_native_lib
 
 
 def _start_server(env):
