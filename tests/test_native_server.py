@@ -22,9 +22,9 @@ from tests.conftest import requires_native_lib  # noqa: E402
 pytestmark = requires_native_lib
 
 
-@pytest.fixture()
-def ring_platform(monkeypatch):
-    monkeypatch.setenv("GRPC_PLATFORM_TYPE", "RDMA_BP")
+@pytest.fixture(params=["RDMA_BP"])
+def ring_platform(request, monkeypatch):
+    monkeypatch.setenv("GRPC_PLATFORM_TYPE", request.param)
     from tpurpc.utils import config as config_mod
 
     config_mod.set_config(None)
@@ -51,7 +51,13 @@ def _four_shape_server():
     return srv, port
 
 
+@pytest.mark.parametrize("ring_platform",
+                         ["RDMA_BP", "RDMA_EVENT", "RDMA_BPEV"],
+                         indirect=True)
 def test_adoption_serves_all_four_shapes(ring_platform):
+    """All three wakeup disciplines ride the round-4 planes: the adopted
+    server's poller epolls the notify fd regardless of discipline, and
+    the client fast path's inline-read pump is discipline-independent."""
     srv, port = _four_shape_server()
     try:
         assert srv._native_dp is not None, "adoption did not engage"
