@@ -42,14 +42,18 @@ import numpy as np
 
 from tpurpc.rpc.server import Server
 
-# Two servers, two data planes (deployment guidance, round 4): the BULK
-# streaming sink runs a Python-plane server (native_dataplane=False — its
-# zero-bounce Assembly receive wins on 4 MiB payloads), while the serving
-# flagship keeps the default plane (ring connections adopted onto the
-# native shared-poller loop — the small-RPC latency win feeds the batcher
-# faster). Both effects measured on this host; see rpc/server.py's
-# native_dataplane docstring.
-srv = Server(max_workers=8, native_dataplane=False)
+# Two servers (deployment guidance, round 4): the serving flagship keeps
+# the default plane (ring connections adopted onto the native
+# shared-poller loop — the small-RPC latency win feeds the batcher
+# faster). The BULK streaming sink runs the Python plane by default: with
+# the native server's zero-copy recv handoff (OwnedBuf) the two planes
+# A/B within noise on 4 MiB streams (0.52 vs 0.53 GB/s same-weather; the
+# native plane was 19% behind before it), and the Python plane keeps the
+# copy-ledger instrumentation. TPURPC_BENCH_SINK_NATIVE=1 flips it.
+srv = Server(max_workers=8,
+             native_dataplane=None
+             if os.environ.get("TPURPC_BENCH_SINK_NATIVE", "0") == "1"
+             else False)
 port = srv.add_insecure_port("127.0.0.1:0")
 srv_infer = Server(max_workers=8)
 port_infer = srv_infer.add_insecure_port("127.0.0.1:0")

@@ -53,6 +53,64 @@ using Clock = std::chrono::steady_clock;
 struct Conn;
 }  // namespace
 
+// Message accumulator backed by malloc from the start, so tpr_srv_recv can
+// hand ownership straight to the handler (the tpr_srv_buf_free contract is
+// free()) with ZERO copy — the old std::string deque paid a malloc+memcpy
+// per delivered message, one full extra pass on the bulk path.
+struct OwnedBuf {
+  uint8_t *p = nullptr;
+  size_t len = 0;
+  size_t cap = 0;
+
+  // move-only: a raw-owning struct that the compiler lets you copy is a
+  // double free waiting for a maintainer (the container moves below are
+  // the only ownership transfers)
+  OwnedBuf() = default;
+  OwnedBuf(const OwnedBuf &) = delete;
+  OwnedBuf &operator=(const OwnedBuf &) = delete;
+  OwnedBuf(OwnedBuf &&o) noexcept : p(o.p), len(o.len), cap(o.cap) {
+    o.p = nullptr;
+    o.len = o.cap = 0;
+  }
+  OwnedBuf &operator=(OwnedBuf &&o) noexcept {
+    if (this != &o) {
+      free(p);
+      p = o.p;
+      len = o.len;
+      cap = o.cap;
+      o.p = nullptr;
+      o.len = o.cap = 0;
+    }
+    return *this;
+  }
+  ~OwnedBuf() { free(p); }
+
+  void append(const uint8_t *src, size_t n) {
+    if (n == 0) return;  // empty message: memcpy(NULL,..,0) is still UB
+    if (len + n > cap) {
+      size_t want = cap ? cap : 4096;
+      while (want < len + n) want *= 2;
+      uint8_t *np = static_cast<uint8_t *>(realloc(p, want));
+      if (np == nullptr) abort();  // OOM: same fate as the old path's
+      p = np;                      // uncaught bad_alloc, without the UB
+      cap = want;
+    }
+    memcpy(p + len, src, n);
+    len += n;
+  }
+
+  // hand the malloc'd buffer to the caller (who frees with free())
+  uint8_t *release(size_t *out_len) {
+    uint8_t *out = p ? p : static_cast<uint8_t *>(malloc(1));
+    *out_len = len;
+    p = nullptr;
+    len = cap = 0;
+    return out;
+  }
+
+  void reset() { *this = OwnedBuf(); }
+};
+
 struct tpr_server_call {
   Conn *conn = nullptr;
   uint32_t stream_id = 0;
@@ -70,16 +128,17 @@ struct tpr_server_call {
   std::vector<std::pair<std::string, std::string>> trailing_md;
 
   // reader/poller-filled state, guarded by conn->mu
-  std::deque<std::string> pending;  // complete messages
-  std::string partial;              // MORE-fragment accumulator
-  bool half_closed = false;         // client END_STREAM seen
-  bool cancelled = false;           // RST / connection death
+  std::deque<OwnedBuf> pending;  // complete messages (malloc-backed)
+  OwnedBuf partial;              // MORE-fragment accumulator
+  bool half_closed = false;      // client END_STREAM seen
+  bool cancelled = false;        // RST / connection death
 
   // callback-API calls: handled inline on the poller thread (no thread,
   // no pending queue — each complete message goes straight to the cb)
   int (*inline_cb)(tpr_server_call *, const uint8_t *, size_t, void *) =
       nullptr;
   void *inline_ud = nullptr;
+
 };
 
 namespace {
@@ -433,21 +492,18 @@ struct tpr_server {
       } else if (type == kMessage) {
         const bool has_payload = !(flags & kFlagNoMessage);
         const bool complete = has_payload && !(flags & kFlagMore);
-        if (complete && call->partial.empty()) {
+        if (complete && call->partial.len == 0) {
           // common case: whole message in one frame — feed the cb the
           // frame buffer directly, no accumulator alloc/copy
           code = call->inline_cb(call, payload.data(), payload.size(),
                                  call->inline_ud);
         } else {
           if (has_payload)
-            call->partial.append(reinterpret_cast<char *>(payload.data()),
-                                 payload.size());
+            call->partial.append(payload.data(), payload.size());
           if (complete) {
-            std::string msg = std::move(call->partial);
-            call->partial.clear();
-            code = call->inline_cb(
-                call, reinterpret_cast<const uint8_t *>(msg.data()),
-                msg.size(), call->inline_ud);
+            code = call->inline_cb(call, call->partial.p,
+                                   call->partial.len, call->inline_ud);
+            call->partial.reset();
           }
         }
         // negative returns are app errors, not a protocol escape hatch:
@@ -469,12 +525,9 @@ struct tpr_server {
       call->cancelled = true;
     } else if (type == kMessage) {
       if (!(flags & kFlagNoMessage))
-        call->partial.append(reinterpret_cast<char *>(payload.data()),
-                             payload.size());
-      if (!(flags & kFlagMore) && !(flags & kFlagNoMessage)) {
+        call->partial.append(payload.data(), payload.size());
+      if (!(flags & kFlagMore) && !(flags & kFlagNoMessage))
         call->pending.push_back(std::move(call->partial));
-        call->partial.clear();
-      }
       if (flags & kFlagEndStream) call->half_closed = true;
     }
     lk.unlock();
@@ -808,10 +861,10 @@ int tpr_srv_recv(tpr_server_call *c, uint8_t **data, size_t *len) {
   std::unique_lock<std::mutex> lk(conn->mu);
   while (true) {
     if (!c->pending.empty()) {
-      std::string &m = c->pending.front();
-      *len = m.size();
-      *data = static_cast<uint8_t *>(malloc(m.size() ? m.size() : 1));
-      memcpy(*data, m.data(), m.size());
+      // zero-copy handoff: the accumulator is malloc-backed from the
+      // start, so the handler takes the buffer itself (frees with
+      // tpr_srv_buf_free == free(), the unchanged contract)
+      *data = c->pending.front().release(len);
       c->pending.pop_front();
       return 1;
     }

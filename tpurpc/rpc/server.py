@@ -798,11 +798,12 @@ class Server:
                  native_dataplane: Optional[bool] = None):
         #: tpurpc extension: None = auto (adopt ring connections onto the
         #: native shared-poller loop when eligible — the small-RPC latency
-        #: plane); False = always the Python plane (its zero-bounce
-        #: Assembly receive moves multi-MiB payloads ~25% faster than the
-        #: native trampoline's accumulate-and-copy — bulk tensor services
-        #: like jaxshim's Sink want this). True behaves like auto (the
-        #: eligibility gates still apply; they are correctness gates).
+        #: plane); False = always the Python plane (fully instrumented —
+        #: the copy ledger counts its passes; on multi-MiB payloads the
+        #: two planes measure within noise of each other now that the
+        #: native recv hands its malloc-backed accumulator to the handler
+        #: zero-copy). True behaves like auto (the eligibility gates still
+        #: apply; they are correctness gates).
         self._native_dataplane_opt = native_dataplane
         self._pool = ThreadPoolExecutor(max_workers=max_workers,
                                         thread_name_prefix="tpurpc-handler")
