@@ -299,3 +299,57 @@ def test_aio_native_channel():
 
     asyncio.run(main())
     srv.stop(grace=0)
+
+
+from tests.conftest import requires_native_lib  # noqa: E402
+
+
+@requires_native_lib
+def test_aio_over_ring_platform_round4_planes(monkeypatch):
+    """asyncio surface over the round-4 data planes: a ring-platform aio
+    channel's calls run through the sync channel's native fast path (the
+    executor hop) against a natively-adopted server — the whole stack a
+    drop-in asyncio app would ride."""
+    import asyncio
+
+    import tpurpc.rpc as rpc
+
+    monkeypatch.setenv("GRPC_PLATFORM_TYPE", "RDMA_BP")
+    from tpurpc.utils import config as config_mod
+
+    config_mod.set_config(None)
+    srv = rpc.Server(max_workers=4)
+    srv.add_method("/a.S/Echo", rpc.unary_unary_rpc_method_handler(
+        lambda r, c: bytes(r), inline=True))
+
+    def dbl(it, c):
+        for m in it:
+            yield bytes(m) * 2
+
+    srv.add_method("/a.S/Dbl", rpc.stream_stream_rpc_method_handler(dbl))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    try:
+        assert srv._native_dp is not None  # server adopted
+
+        async def main():
+            async with aio.insecure_channel(f"127.0.0.1:{port}") as ch:
+                echo = ch.unary_unary("/a.S/Echo")
+                out = await asyncio.gather(
+                    *[echo(f"m{i}".encode(), timeout=30) for i in range(16)])
+                assert out == [f"m{i}".encode() for i in range(16)]
+
+                async def gen():
+                    yield b"x"
+                    yield b"yy"
+
+                got = []
+                async for resp in ch.stream_stream("/a.S/Dbl")(gen(),
+                                                               timeout=30):
+                    got.append(bytes(resp))
+                assert got == [b"xx", b"yyyy"]
+
+        asyncio.run(main())
+    finally:
+        srv.stop(grace=0)
+        config_mod.set_config(None)

@@ -173,3 +173,15 @@ def test_sweep_cell_runs():
     assert cell["rate_rps"] > 0
     assert {"p50", "p95", "p99"} <= set(cell["rtt_us"])
     assert cell["platform"] == "TCP" and cell["size"] == 64
+
+
+def test_wire_sweep_cell_runs():
+    """One cell of the gRPC-wire-path sweep (tpurpc/bench/wire.py): a
+    stock grpcio client against the tpurpc h2 server produces a sane
+    measurement record — the rig behind bench/results/wire_1core.log."""
+    from tpurpc.bench.wire import run_cell
+
+    cell = run_cell("tpurpc", 64, duration=0.5, streaming=False)
+    assert cell["server"] == "tpurpc" and cell["size"] == 64
+    assert cell["rpcs"] > 10
+    assert cell["rtt_us"]["p50"] > 0
