@@ -108,6 +108,20 @@ int tpr_unary_call(tpr_channel *ch, const char *method, const uint8_t *req,
                    size_t req_len, uint8_t **resp, size_t *resp_len,
                    char *details, size_t details_cap, int timeout_ms);
 
+/* Like tpr_unary_call, plus a machine-readable replay-safety verdict:
+ * *preexec is set to 1 iff the failure provably happened BEFORE the complete
+ * request could have reached a server handler (admission refusal on a
+ * dead/draining channel, or a request-send failure that left END_STREAM
+ * unsent), and 0 otherwise — including every failure after the request was
+ * fully shipped, where a handler MAY have executed and a caller replay would
+ * double-execute. Callers deciding whether to transparently retry MUST use
+ * this flag, never the human-readable details text (tpurpc/rpc/channel.py
+ * _native_call consumes it as RpcError._tpurpc_preexec). */
+int tpr_unary_call_ex(tpr_channel *ch, const char *method, const uint8_t *req,
+                      size_t req_len, uint8_t **resp, size_t *resp_len,
+                      char *details, size_t details_cap, int timeout_ms,
+                      int *preexec);
+
 /* ---------------------------------------------------------------------------
  * Completion-queue async API — the reference's CQ-based async client shape
  * (grpc_completion_queue_next, completion_queue.cc:393; CompletionQueue::Next

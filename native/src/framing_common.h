@@ -26,7 +26,13 @@ constexpr uint8_t kFlagEndStream = 0x01, kFlagMore = 0x02,
                   // gzip-compressed message (Python peers only): the native
                   // loop does not link a decompressor, so receivers REJECT
                   // the flag loudly instead of delivering garbled bytes
-                  kFlagCompressed = 0x08;
+                  kFlagCompressed = 0x08,
+                  // on kRst only: the stream was REFUSED at admission — no
+                  // handler ran, the caller may replay on a fresh connection
+                  // (h2 REFUSED_STREAM semantics; the machine-readable form
+                  // of the old "connection draining" detail wording —
+                  // frame.py FLAG_REFUSED is the Python mirror)
+                  kFlagRefused = 0x10;
 constexpr size_t kMaxFramePayload = 1u << 20;
 // Unary requests at or below this ship HEADERS+MESSAGE as ONE buffered
 // write (one syscall / ring message); larger ones take the fragmenting

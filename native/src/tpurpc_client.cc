@@ -70,6 +70,7 @@ struct Call {
   bool trailers_seen = false;
   int status_code = TPR_UNKNOWN;
   std::string status_details;
+  bool refused = false;  // kRst|kFlagRefused: admission refusal, no handler
   Clock::time_point deadline{};
   bool has_deadline = false;
   bool cancelled = false;
@@ -331,6 +332,7 @@ struct tpr_channel {
     } else if (type == kTrailers || type == kRst) {
       std::vector<std::pair<std::string, std::string>> md;
       decode_metadata(payload.data(), len, &md);
+      if (type == kRst && (flags & kFlagRefused)) c.refused = true;
       c.status_code = TPR_UNKNOWN;
       for (auto &kv : md) {
         if (kv.first == ":status") c.status_code = atoi(kv.second.c_str());
@@ -826,9 +828,16 @@ void tpr_call_destroy(tpr_call *c) {
 
 void tpr_buf_free(uint8_t *data) { free(data); }
 
-int tpr_unary_call(tpr_channel *ch, const char *method, const uint8_t *req,
-                   size_t req_len, uint8_t **resp, size_t *resp_len,
-                   char *details, size_t details_cap, int timeout_ms) {
+int tpr_unary_call_ex(tpr_channel *ch, const char *method, const uint8_t *req,
+                      size_t req_len, uint8_t **resp, size_t *resp_len,
+                      char *details, size_t details_cap, int timeout_ms,
+                      int *preexec) {
+  // *preexec==1 marks the three early returns below — the ONLY failures
+  // where the complete request provably never left this process (admission
+  // refusal, or fd_write_all/ring write returning false, which leaves at
+  // least the trailing END_STREAM byte unsent so no unary handler can have
+  // run). Everything past the send is 0: a handler may have executed.
+  if (preexec) *preexec = 0;
   tpr_call *c;
   if (req_len <= kSmallUnaryMax) {
     // small-unary fast path: HEADERS + MESSAGE|END_STREAM leave in ONE
@@ -839,6 +848,7 @@ int tpr_unary_call(tpr_channel *ch, const char *method, const uint8_t *req,
     if (!c) {
       if (details && details_cap)
         snprintf(details, details_cap, "channel dead or send failed");
+      if (preexec) *preexec = 1;
       return TPR_UNAVAILABLE;
     }
   } else {
@@ -846,12 +856,14 @@ int tpr_unary_call(tpr_channel *ch, const char *method, const uint8_t *req,
     if (!c) {
       if (details && details_cap)
         snprintf(details, details_cap, "channel dead");
+      if (preexec) *preexec = 1;
       return TPR_UNAVAILABLE;
     }
     if (tpr_call_send(c, req, req_len, /*end_stream=*/1) != 0) {
       tpr_call_destroy(c);
       if (details && details_cap)
         snprintf(details, details_cap, "send failed");
+      if (preexec) *preexec = 1;
       return TPR_UNAVAILABLE;
     }
   }
@@ -859,6 +871,11 @@ int tpr_unary_call(tpr_channel *ch, const char *method, const uint8_t *req,
   size_t len = 0;
   int got = tpr_call_recv(c, &data, &len);
   int code = tpr_call_finish(c, details, details_cap);
+  // Admission refusal (kRst|kFlagRefused, e.g. a max_age GOAWAY race): the
+  // SERVER certifies no handler ran, so the failure is replay-safe even
+  // though the request left this process. finish() returned, so the RST was
+  // fully processed before this read (no torn state).
+  if (preexec && code != TPR_OK && c->c.refused) *preexec = 1;
   if (code == TPR_OK && got == 1) {
     *resp = data;
     *resp_len = len;
@@ -870,6 +887,13 @@ int tpr_unary_call(tpr_channel *ch, const char *method, const uint8_t *req,
   }
   tpr_call_destroy(c);
   return code;
+}
+
+int tpr_unary_call(tpr_channel *ch, const char *method, const uint8_t *req,
+                   size_t req_len, uint8_t **resp, size_t *resp_len,
+                   char *details, size_t details_cap, int timeout_ms) {
+  return tpr_unary_call_ex(ch, method, req, req_len, resp, resp_len, details,
+                           details_cap, timeout_ms, nullptr);
 }
 
 /* -- completion-queue async API ------------------------------------------- */

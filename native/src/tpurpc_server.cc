@@ -32,6 +32,7 @@
 #include <sys/epoll.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
@@ -745,6 +746,14 @@ void Poller::loop() {
         after_pump(c, srv->pump_conn(c));
       }
     }
+    // A conn can land in `hot` (budget hit) and THEN be finished by a later
+    // epoll event in the same batch; it stays in `hot` across iterations, so
+    // if the reaper freed it between batches the next rehot pass would read
+    // freed memory. Purge finished conns from `hot` before making anything
+    // reapable — only then is no poller-local pointer left to them.
+    hot.erase(std::remove_if(hot.begin(), hot.end(),
+                             [](Conn *c) { return c->finished.load(); }),
+              hot.end());
     // only AFTER the batch (no stale event can reference them) may the
     // reaper free these conns
     for (Conn *c : finished_this_batch) c->reapable.store(true);

@@ -46,6 +46,7 @@ class _ClientStream:
         #: directly (single receive-side copy; no per-fragment bytes + join)
         self.assembly = fr.Assembly()
         self.done = False  # trailers or failure delivered
+        self.refused = False  # RST|FLAG_REFUSED: admission refusal, replayable
         #: backpressure: bounded count of completed-but-unconsumed response
         #: messages (see _ServerStream._credits for the full rationale);
         #: trailers/failure events bypass — they must never deadlock
@@ -512,6 +513,10 @@ class _Connection:
             st.events.put(("initial_metadata", md))
         elif f.type in (fr.TRAILERS, fr.RST):
             code, details, md = fr.parse_trailers(f.payload)
+            if f.type == fr.RST and f.flags & fr.FLAG_REFUSED:
+                # admission refusal: the server certifies no handler ran
+                # (set BEFORE the event lands; the queue orders the read)
+                st.refused = True
             # Terminal frame: nothing further arrives for this stream — drop it
             # now so abandoned Call objects don't leak connection state.
             self.close_stream(st)
@@ -1198,7 +1203,10 @@ class Call:
             _, code, details, md = ev
             self._finish(code, details, md)
             if code is not StatusCode.OK:
-                raise RpcError(code, details, md)
+                exc = RpcError(code, details, md)
+                if getattr(self._st, "refused", False):
+                    exc._tpurpc_refused = True  # replay-safe: FLAG_REFUSED
+                raise exc
             return
 
     def __iter__(self):
@@ -1530,21 +1538,22 @@ class UnaryUnary(_MultiCallable):
                 # reconnect machinery) only when the failure provably
                 # happened before any handler could run.
                 self._channel._native_invalidate(nch)
-                details = exc.details() or ""
-                # pre-execution failures only: admission refusals (closed/
-                # draining/GOAWAY'd channel) and request-send failures —
-                # the server never saw a complete request, so the Python
-                # transport may safely re-dial and replay. NOT in this
-                # list: "connection lost" (the post-send death detail,
-                # tpurpc_client.cc die()) — the handler may have executed
-                # and replaying would double-execute; it surfaces to the
-                # caller exactly as the Python transport's mid-call death
-                # does.
-                if any(s in details for s in ("channel closed",
-                                              "call refused",
-                                              "channel dead",
-                                              "draining",
-                                              "send failed")):
+                # Pre-execution failures only: the native side reports the
+                # verdict machine-readably (_tpurpc_preexec, set from
+                # tpr_unary_call_ex's preexec out-param or by the ctypes
+                # wrapper's own admission refusals) — True means the server
+                # never saw a complete request, so the Python transport may
+                # safely re-dial and replay. Post-send deaths ("connection
+                # lost", tpurpc_client.cc die()) carry False — the handler
+                # may have executed and replaying would double-execute; they
+                # surface to the caller exactly as the Python transport's
+                # mid-call death does. Never match on details wording: the
+                # human-readable text is not a contract (ADVICE r4 #2). One
+                # compat exception, mirroring the transparent-retry gate
+                # below: a pre-round-5 SERVER sends its max_age refusal RST
+                # without FLAG_REFUSED, so the wording is the only signal.
+                if (getattr(exc, "_tpurpc_preexec", False)
+                        or "connection draining" in (exc.details() or "")):
                     return False, None
             raise
 
@@ -1583,8 +1592,13 @@ class UnaryUnary(_MultiCallable):
                                            wfr)
                 except RpcError as exc:
                     committed = getattr(exc, "_tpurpc_committed", False)
-                    refused = (_status_of(exc) is StatusCode.UNAVAILABLE
-                               and "connection draining" in exc.details()
+                    # FLAG_REFUSED is the contract; the "connection draining"
+                    # wording stays as compat with pre-round-5 servers that
+                    # sent the RST without the flag
+                    refused = ((getattr(exc, "_tpurpc_refused", False)
+                                or (_status_of(exc) is StatusCode.UNAVAILABLE
+                                    and "connection draining"
+                                    in exc.details()))
                                and not committed)
                     # Compression negotiation by probe: a peer that can't
                     # decompress (the native server/client) rejects the
@@ -1761,7 +1775,13 @@ class UnaryStream(_MultiCallable):
         # calls stay on the Python transport — _RetryingStreamCall's
         # first-response rule is built on its Call internals)
         if (policy is None and self._allow_native and not metadata
-                and not grpcio_kw.get("wait_for_ready")):
+                and not grpcio_kw.get("wait_for_ready")
+                # cheap eligibility FIRST (same gates _try_native_stream
+                # re-checks): when the call is headed for the Python path
+                # anyway, don't serialize here only to have _start
+                # re-serialize the same request (ADVICE r4 #3)
+                and not self._instruments_live()
+                and self._channel._native_fast() is not None):
             # serialize EAGERLY: the Python path raises serializer errors
             # at call time (_start serializes first_request inline), and
             # the native path must not defer them to first iteration

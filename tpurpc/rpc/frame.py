@@ -54,6 +54,9 @@ FLAG_COMPRESSED = 0x08  # MESSAGE payload is gzip-compressed (whole message;
 #                         the compression — receivers gunzip at reassembly.
 #                         The gRPC wire's per-message compressed-flag
 #                         (grpc-encoding) recast for the tpurpc framing.
+FLAG_REFUSED = 0x10     # RST only: stream refused at admission — no handler ran,
+                        # replay on a fresh connection is safe (h2 REFUSED_STREAM;
+                        # C mirror: framing_common.h kFlagRefused)
 FLAG_NO_MESSAGE = 0x04  # MESSAGE frame carries no message (pure half-close marker),
                         # distinguishing it from a genuine empty message
 

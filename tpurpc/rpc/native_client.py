@@ -65,6 +65,15 @@ def _load():
             ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
             ctypes.POINTER(ctypes.c_size_t),
             ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int]
+        if hasattr(lib, "tpr_unary_call_ex"):  # absent in pre-round-5 .so
+            lib.tpr_unary_call_ex.restype = ctypes.c_int
+            lib.tpr_unary_call_ex.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+                ctypes.POINTER(ctypes.c_size_t),
+                ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int)]
         lib.tpr_call_start.restype = ctypes.c_void_p
         lib.tpr_call_start.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p,
@@ -237,8 +246,10 @@ class _CqDriver:
         if not call:
             with self._lock:
                 self._pending.pop(tag, None)
-            raise RpcError(StatusCode.UNAVAILABLE,
+            exc = RpcError(StatusCode.UNAVAILABLE,
                            "call refused (channel dead or draining)")
+            exc._tpurpc_preexec = True  # admission refusal: nothing sent
+            raise exc
         destroy = None
         with self._lock:
             entry["call"] = call
@@ -369,7 +380,9 @@ class NativeChannel:
         with self._cq_lock:
             if not self._ch:  # close() swaps _ch under this same lock, so a
                 # late future() can't resurrect a driver nothing will close
-                raise RpcError(StatusCode.UNAVAILABLE, "channel closed")
+                exc = RpcError(StatusCode.UNAVAILABLE, "channel closed")
+                exc._tpurpc_preexec = True
+                raise exc
             if self._cq_driver is None:
                 self._cq_driver = _CqDriver(self._lib)
             return self._cq_driver
@@ -380,7 +393,9 @@ class NativeChannel:
         inside the C loop before freeing the channel."""
         with self._cq_lock:
             if not self._ch:
-                raise RpcError(StatusCode.UNAVAILABLE, "channel closed")
+                exc = RpcError(StatusCode.UNAVAILABLE, "channel closed")
+                exc._tpurpc_preexec = True  # nothing entered the C loop
+                raise exc
             self._ops += 1
             return self._ch
 
@@ -418,6 +433,8 @@ class NativeChannel:
         mb = method.encode()
         lib = self._lib
 
+        have_ex = hasattr(lib, "tpr_unary_call_ex")
+
         def call(request, timeout: Optional[float] = None):
             raw = (request_serializer(request) if request_serializer
                    else request)
@@ -425,19 +442,39 @@ class NativeChannel:
             pptr = ctypes.POINTER(ctypes.c_uint8)()
             plen = ctypes.c_size_t()
             details = ctypes.create_string_buffer(1024)
+            preexec = ctypes.c_int(0)
             ch = self._op_begin()  # a closed channel raises; close() waits
             try:
-                code = lib.tpr_unary_call(
-                    ch, mb, buf, len(buf),
-                    ctypes.byref(pptr), ctypes.byref(plen),
-                    details, 1024, _timeout_ms(timeout))
+                if have_ex:
+                    code = lib.tpr_unary_call_ex(
+                        ch, mb, buf, len(buf),
+                        ctypes.byref(pptr), ctypes.byref(plen),
+                        details, 1024, _timeout_ms(timeout),
+                        ctypes.byref(preexec))
+                else:
+                    code = lib.tpr_unary_call(
+                        ch, mb, buf, len(buf),
+                        ctypes.byref(pptr), ctypes.byref(plen),
+                        details, 1024, _timeout_ms(timeout))
             finally:
                 self._op_end()
             if code != 0:
-                raise RpcError(
+                text = details.value.decode("utf-8", "replace")
+                exc = RpcError(
                     StatusCode(code) if code in StatusCode._value2member_map_
-                    else StatusCode.UNKNOWN,
-                    details.value.decode("utf-8", "replace"))
+                    else StatusCode.UNKNOWN, text)
+                # Machine-readable replay-safety verdict from the C loop
+                # (tpr_unary_call_ex): True iff the failure provably
+                # happened before the request fully left this process, so
+                # replaying it can never double-execute a handler. Channel
+                # consumers gate fallback on this attribute, never on the
+                # human-readable details wording. Legacy shim: a
+                # pre-round-5 .so has no preexec out-param, so its known
+                # pre-exec wordings (tpr_unary_call's three early returns)
+                # are the only signal left.
+                exc._tpurpc_preexec = bool(preexec.value) if have_ex else any(
+                    s in text for s in ("channel dead", "send failed"))
+                raise exc
             body = _take_buf(lib, pptr, plen)
             return (response_deserializer(body) if response_deserializer
                     else body)

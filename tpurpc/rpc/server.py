@@ -569,7 +569,9 @@ class _ServerConnection:
                 self._streams[f.stream_id] = st
                 self.streams_started += 1
         if rejected:
-            self.writer.send(fr.RST, 0, f.stream_id,
+            # FLAG_REFUSED is the contract ("no handler ran, replay is
+            # safe"); the detail text is for humans only
+            self.writer.send(fr.RST, fr.FLAG_REFUSED, f.stream_id,
                              fr.rst_payload(StatusCode.UNAVAILABLE,
                                             "connection draining (max_age)"))
             return
@@ -995,9 +997,16 @@ class Server:
                     return False  # peer closed before the preface
                 if len(first) < 4:
                     time.sleep(0.002)
-            sock.settimeout(None)
         except OSError:
             return False
+        finally:
+            # EVERY False return hands the socket to the Python plane, which
+            # expects it exactly as accepted (blocking); a leaked 2s timeout
+            # would surface as spurious socket.timeout on slow valid reads.
+            try:
+                sock.settimeout(None)
+            except OSError:
+                pass  # already closed/reset: the caller's read will see it
         if first != b"TRB1":
             return False
         return dp.adopt(sock)

@@ -143,15 +143,23 @@ def _fetch_snapshot(server_uri: str, service: str, node: dict,
     with Channel(server_uri, connect_timeout=timeout) as ch:
         stream = ch.stream_stream(METHOD)
         sub = json.dumps({"node": node, "resource": service}).encode()
+        # ACTUALLY hold the request side open until the response lands (or
+        # the fetch gives up): a generator that returns right after the
+        # subscribe half-closes immediately, and a strict control plane may
+        # treat client half-close as end-of-stream before its first push
+        # (ADVICE r4 #5). The sender thread parks on this event; cancel()
+        # below releases it on every exit path.
+        done = threading.Event()
 
         def reqs():
             yield sub
-            # keep the request side open until the response arrives
+            done.wait(timeout)
 
-        call = stream(iter(reqs()), timeout=timeout)
+        call = stream(reqs(), timeout=timeout)
         try:
             first = next(iter(call), None)
         finally:
+            done.set()
             try:
                 call.cancel()
             except Exception:
