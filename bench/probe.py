@@ -1,0 +1,23 @@
+"""Single source of truth for the accelerator liveness probe.
+
+One SMALL h2d + compute + d2h round trip on the default jax backend; exits
+0 iff it completed and the backend is not cpu. Both bench/harvest.sh's
+probe() and bench.py's pre-probe run THIS file — the two used to carry
+byte-duplicated snippets in two languages and drifted on the one parameter
+that matters (the timeout), producing inconsistent liveness verdicts.
+
+The caller MUST bound this process externally (`timeout 150 python
+bench/probe.py` / subprocess timeout): a black-holing tunnel hangs jax
+calls uninterruptibly, and SIGALRM does not fire while blocked in the C
+extension. 150 s is the settled budget — an ALIVE tunnel answers this
+small round trip well inside it, while full backend bring-up (minutes) is
+deliberately NOT what is being measured.
+"""
+import numpy as np
+import jax
+
+d = jax.devices()[0]
+assert d.platform != "cpu"
+x = jax.device_put(np.ones(1024, np.float32), d)
+y = (x + 1).block_until_ready()
+assert float(np.asarray(y)[0]) == 2.0
