@@ -112,33 +112,43 @@ def add_tensor_method(server: Server, name: str,
 
     # device mode: identity deserializer (raw message bytes reach the
     # behavior), decode inside where ctx exposes the connection's ring.
+    # Responses are serialized INSIDE the behavior, before finish():
+    # round-5 ring views ALIAS ring memory (HbmRing._dlpack_view), so a
+    # passthrough response (``return {"y": tree["a"]}``) read by the RPC
+    # layer's serializer AFTER the lease release could see the span
+    # overwritten in place by a concurrent RPC on the same connection.
+    # Serialize-then-release makes the alias's whole read window sit
+    # inside the lease window; the handler's serializer is identity.
+    _ident = lambda b: b  # noqa: E731 — already-encoded bytes pass through
     if kind == "unary_unary":
         def behavior(raw, ctx):
             decode, finish = _device_decoder(ctx)
             try:
-                return fn(decode(raw))
+                return codec.tree_serializer(fn(decode(raw)))
             finally:
                 finish()
         handler = unary_unary_rpc_method_handler(
-            behavior, codec.raw_view, codec.tree_serializer)
+            behavior, codec.raw_view, _ident)
     elif kind == "unary_stream":
         def behavior(raw, ctx):
             decode, finish = _device_decoder(ctx)
             try:
-                yield from fn(decode(raw))
+                for item in fn(decode(raw)):
+                    yield codec.tree_serializer(item)
             finally:
                 finish()
         handler = unary_stream_rpc_method_handler(
-            behavior, codec.raw_view, codec.tree_serializer)
+            behavior, codec.raw_view, _ident)
     elif kind == "stream_stream":
         def behavior(raw_iter, ctx):
             decode, finish = _device_decoder(ctx)
             try:
-                yield from fn(decode(raw) for raw in raw_iter)
+                for item in fn(decode(raw) for raw in raw_iter):
+                    yield codec.tree_serializer(item)
             finally:
                 finish()
         handler = stream_stream_rpc_method_handler(
-            behavior, codec.raw_view, codec.tree_serializer)
+            behavior, codec.raw_view, _ident)
     else:
         raise ValueError(f"unsupported tensor method kind {kind}")
     server.add_method(_method_path(name), handler)
