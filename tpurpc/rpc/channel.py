@@ -698,9 +698,16 @@ class Channel:
                 "framing speaks gzip only — using identity", stacklevel=2)
             self._compress_flag = 0
         #: channel-level retry policy for unary-request calls (None = off,
-        #: matching gRPC's default of retries disabled without service config)
+        #: matching gRPC's default of retries disabled without service
+        #: config). An explicit policy here WINS over any service config the
+        #: resolver delivers (explicit code beats delivered config).
         self.retry_policy = retry_policy
-        from tpurpc.rpc.resolver import make_policy, resolve_target
+        #: parsed resolver-delivered service config (per-method timeout /
+        #: retryPolicy / retryThrottling — service_config.cc analog); swapped
+        #: whole by update_service_config, consulted per call via
+        #: _policy_for/_effective_timeout
+        self._service_config = None
+        from tpurpc.rpc.resolver import make_policy, resolve_target_full
         from tpurpc.utils.config import get_config
 
         self.max_receive_message_length = get_config().resolve_recv_limit(
@@ -714,9 +721,12 @@ class Channel:
         if endpoint_factory is None:
             if target is None:
                 raise ValueError("need target or endpoint_factory")
-            addrs = resolve_target(target)
+            resolution = resolve_target_full(target)
+            addrs = resolution.addresses
             self._addrs: "Optional[list]" = list(addrs)
             factories = [self._addr_factory(h, p) for h, p in addrs]
+            if resolution.service_config is not None:
+                self.update_service_config(resolution.service_config)
         else:
             self._addrs = None  # injected factory: membership is fixed
             factories = [endpoint_factory]
@@ -747,6 +757,47 @@ class Channel:
         return lambda: connect_endpoint(h, p, timeout=kw["timeout"],
                                         ssl_context=kw["ssl_context"],
                                         server_hostname=kw["server_hostname"])
+
+    def update_service_config(self, cfg) -> None:
+        """Apply a resolver-delivered JSON service config (dict or JSON
+        text): per-method timeouts, retry policies, and channel-wide retry
+        throttling take effect for SUBSEQUENT calls without touching call
+        sites — the reference's service_config.cc/retry_service_config.cc
+        behavior. A malformed config raises and the previous one stays
+        (reject-whole, keep-last-good). Retry-throttle DRAIN state carries
+        across updates (retry_throttle.cc): a re-resolution re-delivering
+        the same config must not refill the bucket and resume a suppressed
+        retry storm."""
+        from tpurpc.rpc.service_config import ServiceConfig
+
+        new = ServiceConfig.from_json(cfg)
+        prev = self._service_config
+        if new.retry_throttle is not None:
+            new.retry_throttle.carry_from(
+                prev.retry_throttle if prev else None)
+        self._service_config = new
+
+    def _call_plan(self, method: str, timeout: "Optional[float]",
+                   wait_for_ready: bool = False):
+        """ONE consistent per-call snapshot of the service-config-derived
+        values: ``(retry_policy, timeout, throttle, wait_for_ready)``.
+        Derived from a single read of ``_service_config`` so a concurrent
+        resolver update can never pair one config's retry policy with
+        another's throttle or timeout. Rules: explicit constructor policy
+        wins; config timeout can only TIGHTEN the call's (min rule);
+        waitForReady is or-ed with the per-call kwarg (gRFC A2: the config
+        enables it, a call-site value may also enable it)."""
+        sc = self._service_config
+        mc = sc.for_method(method) if sc is not None else None
+        policy = self.retry_policy
+        if policy is None and mc is not None:
+            policy = mc.retry_policy
+        if mc is not None and mc.timeout is not None:
+            timeout = (mc.timeout if timeout is None
+                       else min(timeout, mc.timeout))
+        return (policy, timeout,
+                sc.retry_throttle if sc is not None else None,
+                bool(wait_for_ready) or bool(mc and mc.wait_for_ready))
 
     def update_addresses(self, addrs) -> None:
         """Replace the channel's backend set (re-resolution / look-aside
@@ -1256,25 +1307,38 @@ class RetryPolicy:
             return None
         return sleep
 
-    def run(self, deadline: Optional[float], attempt_fn):
-        """Drive attempt_fn() under this policy."""
+    def run(self, deadline: Optional[float], attempt_fn, throttle=None):
+        """Drive attempt_fn() under this policy. ``throttle`` is the
+        channel-wide :class:`~tpurpc.rpc.service_config.RetryThrottle`
+        (gRFC A6): retryable failures drain it, successes refill it, and a
+        drained bucket suppresses the retry (the failure surfaces) so a
+        collapsing backend is not hammered by retry storms."""
         backoff = self.initial_backoff
         attempt = 0
         while True:
             try:
-                return attempt_fn()
+                result = attempt_fn()
             except RpcError as exc:
                 attempt += 1
                 code = _status_of(exc)
+                retryable = code in self.retryable_codes
+                if throttle is not None and retryable:
+                    throttle.record_failure()
                 if (attempt >= self.max_attempts
-                        or code not in self.retryable_codes
-                        or getattr(exc, "_tpurpc_committed", False)):
+                        or not retryable
+                        or getattr(exc, "_tpurpc_committed", False)
+                        or (throttle is not None
+                            and not throttle.allow_retry())):
                     raise
                 sleep = self.next_sleep(backoff, deadline)
                 if sleep is None:
                     raise
                 time.sleep(sleep)
                 backoff *= self.backoff_multiplier
+            else:
+                if throttle is not None:
+                    throttle.record_success()
+                return result
 
 
 class _MultiCallable:
@@ -1476,10 +1540,12 @@ class UnaryUnary(_MultiCallable):
         # Native fast path (the grpcio shape: Python surface, C-core hot
         # loop): plain response-only unary calls with no per-call extras
         # run inside libtpurpc.so's inline-read loop. with_call (needs a
-        # Call with trailing metadata), metadata, and wait_for_ready stay
-        # on the Python transport.
+        # Call with trailing metadata), metadata, and wait_for_ready —
+        # whether per-call or via the service config — stay on the Python
+        # transport (the queue-until-ready dial loop lives there).
         if (self._allow_native and not metadata
                 and not grpcio_kw.get("wait_for_ready")
+                and not self._channel._call_plan(self._method, None)[3]
                 and not self._instruments_live()):
             nch = self._channel._native_fast()
             if nch is not None:
@@ -1501,7 +1567,8 @@ class UnaryUnary(_MultiCallable):
             self._native_mc = cached
         mc = cached[1]
         counters = self._channel.call_counters
-        policy = self._channel.retry_policy
+        policy, timeout, throttle, _ = self._channel._call_plan(
+            self._method, timeout)
         deadline = None if timeout is None else time.monotonic() + timeout
 
         recv_limit = self._channel.max_receive_message_length
@@ -1530,7 +1597,7 @@ class UnaryUnary(_MultiCallable):
         try:
             if policy is None:
                 return True, attempt()
-            return True, policy.run(deadline, attempt)
+            return True, policy.run(deadline, attempt, throttle=throttle)
         except RpcError as exc:
             if _status_of(exc) is StatusCode.UNAVAILABLE:
                 # dead fast-path connection: drop it so the next call
@@ -1570,7 +1637,8 @@ class UnaryUnary(_MultiCallable):
     def _with_call_impl(self, request, timeout: Optional[float] = None,
                         metadata: Optional[Metadata] = None, **grpcio_kw):
         _reject_call_credentials(grpcio_kw)
-        policy = self._channel.retry_policy
+        policy, timeout, throttle, eff_wfr = self._channel._call_plan(
+            self._method, timeout, bool(grpcio_kw.get("wait_for_ready")))
         deadline = None if timeout is None else time.monotonic() + timeout
 
         def attempt():
@@ -1585,7 +1653,7 @@ class UnaryUnary(_MultiCallable):
                 return (None if deadline is None
                         else max(0.0, deadline - time.monotonic()))
 
-            wfr = bool(grpcio_kw.get("wait_for_ready"))
+            wfr = eff_wfr
             for _ in range(3):
                 try:
                     return self._call_once(request, remaining(), metadata,
@@ -1620,7 +1688,7 @@ class UnaryUnary(_MultiCallable):
 
         if policy is None:
             return attempt()
-        return policy.run(deadline, attempt)
+        return policy.run(deadline, attempt, throttle=throttle)
 
     def _call_once(self, request, timeout: Optional[float],
                    metadata: Optional[Metadata], wait_for_ready: bool = False):
@@ -1684,7 +1752,8 @@ class _RetryingStreamCall:
     further replays."""
 
     def __init__(self, mc: "UnaryStream", request, timeout, metadata,
-                 policy: "RetryPolicy", wait_for_ready: bool = False):
+                 policy: "RetryPolicy", wait_for_ready: bool = False,
+                 throttle=None):
         self._inner: Optional[Call] = None  # first: __getattr__ recursion guard
         self._mc = mc
         self._request = request
@@ -1692,6 +1761,7 @@ class _RetryingStreamCall:
                           else time.monotonic() + timeout)
         self._metadata = metadata
         self._policy = policy
+        self._throttle = throttle  # channel-wide gRFC A6 token bucket
         self._wait_for_ready = wait_for_ready
         self._attempt = 0
         self._backoff = policy.initial_backoff
@@ -1701,9 +1771,14 @@ class _RetryingStreamCall:
     def _handle_failure(self, exc: RpcError, committed: bool) -> None:
         """Count the attempt; sleep for the backoff; or re-raise."""
         self._attempt += 1
+        retryable = _status_of(exc) in self._policy.retryable_codes
+        if self._throttle is not None and retryable:
+            self._throttle.record_failure()
         if (self._cancelled or committed
                 or self._attempt >= self._policy.max_attempts
-                or _status_of(exc) not in self._policy.retryable_codes):
+                or not retryable
+                or (self._throttle is not None
+                    and not self._throttle.allow_retry())):
             raise exc
         sleep = self._policy.next_sleep(self._backoff, self._deadline)
         if sleep is None:
@@ -1732,6 +1807,8 @@ class _RetryingStreamCall:
                 for msg in self._inner.messages():
                     delivered = True
                     yield msg
+                if self._throttle is not None:
+                    self._throttle.record_success()
                 return
             except RpcError as exc:
                 self._handle_failure(exc, committed=delivered)
@@ -1770,12 +1847,14 @@ class UnaryStream(_MultiCallable):
     def __call__(self, request, timeout: Optional[float] = None,
                  metadata: Optional[Metadata] = None, **grpcio_kw):
         _reject_call_credentials(grpcio_kw)
-        policy = self._channel.retry_policy
+        policy, timeout, throttle, wfr = self._channel._call_plan(
+            self._method, timeout, bool(grpcio_kw.get("wait_for_ready")))
         # Native fast path (same eligibility as the other shapes; retrying
-        # calls stay on the Python transport — _RetryingStreamCall's
-        # first-response rule is built on its Call internals)
+        # and wait-for-ready calls stay on the Python transport —
+        # _RetryingStreamCall's first-response rule and the queue-until-
+        # ready dial loop are built on its Call internals)
         if (policy is None and self._allow_native and not metadata
-                and not grpcio_kw.get("wait_for_ready")
+                and not wfr
                 # cheap eligibility FIRST (same gates _try_native_stream
                 # re-checks): when the call is headed for the Python path
                 # anyway, don't serialize here only to have _start
@@ -1793,10 +1872,10 @@ class UnaryStream(_MultiCallable):
         if policy is None:
             conn, st, call = self._start(
                 metadata, timeout, first_request=request,
-                wait_for_ready=bool(grpcio_kw.get("wait_for_ready")))
+                wait_for_ready=wfr)
             return call
         return _RetryingStreamCall(self, request, timeout, metadata, policy,
-                                   bool(grpcio_kw.get("wait_for_ready")))
+                                   wfr, throttle=throttle)
 
 
 class StreamUnary(_MultiCallable):
@@ -1804,14 +1883,14 @@ class StreamUnary(_MultiCallable):
                  timeout: Optional[float] = None,
                  metadata: Optional[Metadata] = None, **grpcio_kw):
         _reject_call_credentials(grpcio_kw)
-        if (self._allow_native and not metadata
-                and not grpcio_kw.get("wait_for_ready")):
+        _, timeout, _, wfr = self._channel._call_plan(
+            self._method, timeout, bool(grpcio_kw.get("wait_for_ready")))
+        if self._allow_native and not metadata and not wfr:
             nsc = self._try_native_stream(request_iterator, timeout)
             if nsc is not None:
                 return _drain_single_response(nsc)
         conn, st, call = self._start(
-            metadata, timeout,
-            wait_for_ready=bool(grpcio_kw.get("wait_for_ready")))
+            metadata, timeout, wait_for_ready=wfr)
         sender = threading.Thread(
             target=self._send_stream, args=(conn, st, request_iterator, call),
             daemon=True)
@@ -1960,18 +2039,18 @@ class StreamStream(_MultiCallable):
                  timeout: Optional[float] = None,
                  metadata: Optional[Metadata] = None, **grpcio_kw):
         _reject_call_credentials(grpcio_kw)
+        _, timeout, _, wfr = self._channel._call_plan(
+            self._method, timeout, bool(grpcio_kw.get("wait_for_ready")))
         # Native bidi fast path, same eligibility story as UnaryUnary:
         # plain calls on eligible channels stream through libtpurpc's
         # loop (the duplex/tensor hot path). Callers needing per-call
-        # metadata stay on the Python transport.
-        if (self._allow_native and not metadata
-                and not grpcio_kw.get("wait_for_ready")):
+        # metadata (or queue-until-ready) stay on the Python transport.
+        if self._allow_native and not metadata and not wfr:
             nsc = self._try_native_stream(request_iterator, timeout)
             if nsc is not None:
                 return nsc
         conn, st, call = self._start(
-            metadata, timeout,
-            wait_for_ready=bool(grpcio_kw.get("wait_for_ready")))
+            metadata, timeout, wait_for_ready=wfr)
         sender = threading.Thread(
             target=self._send_stream, args=(conn, st, request_iterator, call),
             daemon=True)

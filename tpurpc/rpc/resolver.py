@@ -29,10 +29,38 @@ ResolveFn = Callable[[str], List[Address]]
 _RESOLVERS: dict = {}
 
 
+class Resolution:
+    """One resolver result: addresses plus (optionally) the service config
+    the resolver delivers with them — gRPC's resolver-result shape
+    (``resolver.h`` Result carries addresses + service_config; the
+    client_channel consumes per-method timeout/retry from it,
+    ``service_config.cc``). ``service_config`` is the raw JSON dict; the
+    channel parses it via :class:`tpurpc.rpc.service_config.ServiceConfig`."""
+
+    __slots__ = ("addresses", "service_config")
+
+    def __init__(self, addresses: List[Address],
+                 service_config: "Optional[dict]" = None):
+        self.addresses = list(addresses)
+        self.service_config = service_config
+
+
 def register_resolver(scheme: str, fn: ResolveFn) -> None:
     """Register a scheme (the reference's fake resolver seam,
-    ``resolver/fake/fake_resolver.cc``)."""
+    ``resolver/fake/fake_resolver.cc``). The fn may return a plain address
+    list, an ``(addresses, service_config_dict)`` tuple, or a
+    :class:`Resolution` — the latter two deliver per-method config with
+    the membership, the way gRPC resolvers do."""
     _RESOLVERS[scheme] = fn
+
+
+def _as_resolution(result) -> Resolution:
+    if isinstance(result, Resolution):
+        return result
+    if (isinstance(result, tuple) and len(result) == 2
+            and isinstance(result[1], (dict, type(None)))):
+        return Resolution(list(result[0]), result[1])
+    return Resolution(list(result), None)
 
 
 def _parse_hostport(hp: str) -> Address:
@@ -58,6 +86,12 @@ def _dns_resolve(hostport: str) -> List[Address]:
 
 def resolve_target(target: str) -> List[Address]:
     """gRPC-style target URI → ordered address list."""
+    return resolve_target_full(target).addresses
+
+
+def resolve_target_full(target: str) -> Resolution:
+    """gRPC-style target URI → :class:`Resolution` (addresses + any
+    service config the scheme's resolver attached)."""
     scheme, sep, rest = target.partition(":")
     if sep and scheme == "xds" and scheme not in _RESOLVERS:
         # lazy: importing the xds module registers its resolver (bootstrap
@@ -65,15 +99,16 @@ def resolve_target(target: str) -> List[Address]:
         # resolver/xds analog)
         import tpurpc.rpc.xds  # noqa: F401
     if sep and scheme in _RESOLVERS:
-        return _RESOLVERS[scheme](rest.lstrip("/"))
+        return _as_resolution(_RESOLVERS[scheme](rest.lstrip("/")))
     if target.startswith("dns:"):
-        return _dns_resolve(target[4:].lstrip("/"))
+        return Resolution(_dns_resolve(target[4:].lstrip("/")))
     if target.startswith("ipv4:") or target.startswith("ipv6:"):
         rest = target.split(":", 1)[1]
-        return [_parse_hostport(a) for a in rest.split(",") if a]
+        return Resolution([_parse_hostport(a) for a in rest.split(",") if a])
     if target.startswith("static:"):
-        return [_parse_hostport(a) for a in target[7:].split(",") if a]
-    return _dns_resolve(target)
+        return Resolution([_parse_hostport(a)
+                           for a in target[7:].split(",") if a])
+    return Resolution(_dns_resolve(target))
 
 
 class PickFirst:
