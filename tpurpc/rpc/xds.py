@@ -1,16 +1,18 @@
-"""xDS-lite: an xds resolver + EDS-style endpoint discovery shim.
+"""xDS: an xds resolver + EDS endpoint discovery over the real ADS wire.
 
 The reference carries the xDS client_channel family — the ``xds:`` resolver
 (``ext/filters/client_channel/resolver/xds/xds_resolver.cc``), the xds LB
 policies (``lb_policy/xds/{cds,eds}.cc``) and the google-c2p variant — as
-inherited inventory (SURVEY.md §2.4). This module is tpurpc's lite analog
-of that capability, scoped the way VERDICT r3 #9 scoped it: the gRPC xDS
-UX (bootstrap file + ``xds:///service`` targets + dynamic endpoint
-updates) over tpurpc's OWN control-plane wire and existing composition
-tree, NOT the Envoy ADS protobuf surface (that protocol family is
-Envoy-ecosystem infrastructure the way ALTS is Google infrastructure —
-out of scope; the seam where a full ADS client would plug in is exactly
-this module).
+inherited inventory (SURVEY.md §2.4). This module is tpurpc's analog: the
+gRPC xds UX (bootstrap file + ``xds:///service`` targets + dynamic
+endpoint updates into the channel's composition tree), speaking — as of
+round 5 — the REAL v3 ADS protobuf stream for EDS
+(``AggregatedDiscoveryService/StreamAggregatedResources`` carrying
+``ClusterLoadAssignment``, hand-rolled codec in
+:mod:`tpurpc.rpc.xds_v3`), so a stock control plane can feed endpoints.
+LDS/RDS/CDS and the google-c2p resolver remain scoped out (ecosystem
+surface, VERDICT r4 next #7); the legacy ADS-lite JSON wire stays
+available behind bootstrap ``server_features: ["ads_lite"]``.
 
 Pieces (mirroring how gRPC's pieces fit):
 
@@ -125,37 +127,103 @@ class XdsServicer:
             yield json.dumps({"version": version,
                               "endpoints": current}).encode()
 
+    def _stream_v3(self, request_iterator, ctx):
+        """The REAL wire: v3 ADS ``StreamAggregatedResources`` (round 5,
+        VERDICT r4 next #7). Subscribes are DiscoveryRequests (hand-rolled
+        codec, :mod:`tpurpc.rpc.xds_v3` — the lb_v1 pattern); pushes are
+        DiscoveryResponses carrying ClusterLoadAssignment Anys. A reader
+        thread drains ACKs/resubscriptions so the push loop never blocks
+        on the request side (real clients ACK every response)."""
+        from tpurpc.rpc import xds_v3
+
+        subscribed: List[str] = []
+        sub_changed = threading.Event()
+        req_iter = iter(request_iterator)
+        first = next(req_iter, None)
+        if first is None:
+            return
+        req = xds_v3.decode_discovery_request(first)
+        if req["type_url"] not in ("", xds_v3.CLA_TYPE_URL):
+            from tpurpc.rpc.status import AbortError, StatusCode
+
+            raise AbortError(
+                StatusCode.UNIMPLEMENTED,
+                f"only {xds_v3.CLA_TYPE_URL} is served") from None
+        subscribed = req["resource_names"]
+
+        def drain_requests():
+            # ACKs and resubscriptions; a resource_names change re-arms
+            # the push loop (the A* protocols allow re-subscribing on the
+            # same stream)
+            for raw in req_iter:
+                upd = xds_v3.decode_discovery_request(raw)
+                if upd["resource_names"] and (upd["resource_names"]
+                                              != subscribed):
+                    subscribed[:] = upd["resource_names"]
+                    with self._lock:
+                        self._lock.notify_all()
+                    sub_changed.set()
+
+        threading.Thread(target=drain_requests, daemon=True,
+                         name="tpurpc-ads-v3-reader").start()
+        last_sent: Optional[List[tuple]] = None
+        nonce = 0
+        while ctx.is_active():
+            with self._lock:
+                current = [(name, tuple(self._assignments.get(name, [])))
+                           for name in subscribed]
+                version = self._version
+                if current == last_sent and not sub_changed.is_set():
+                    self._lock.wait_for(lambda: self._version != version,
+                                        timeout=1.0)
+                    continue
+            sub_changed.clear()
+            last_sent = current
+            nonce += 1
+            yield xds_v3.encode_discovery_response(
+                [(name, list(addrs)) for name, addrs in current],
+                version_info=str(version), nonce=str(nonce))
+
     def attach(self, server) -> None:
+        from tpurpc.rpc import xds_v3
         from tpurpc.rpc.server import stream_stream_rpc_method_handler
 
         server.add_method(METHOD,
                           stream_stream_rpc_method_handler(self._stream))
+        server.add_method(xds_v3.METHOD,
+                          stream_stream_rpc_method_handler(self._stream_v3))
 
 
 # -- client side -------------------------------------------------------------
 
-def _fetch_snapshot(server_uri: str, service: str, node: dict,
-                    timeout: float = 10.0) -> List[str]:
-    """One subscribe → first assignment → done (the resolver's job)."""
+def _use_ads_lite(cfg: dict) -> bool:
+    """Wire selection from the bootstrap: the REAL v3 ADS protobuf stream
+    is the default (a stock control plane can serve it); the legacy JSON
+    ADS-lite wire is opt-in via ``server_features: ["ads_lite"]`` (the
+    gRPC bootstrap's server_features mechanism, repurposed)."""
+    feats = (cfg.get("xds_servers") or [{}])[0].get("server_features", [])
+    return "ads_lite" in feats
+
+
+def _fetch_first(server_uri: str, method: str, sub: bytes, service: str,
+                 timeout: float) -> bytes:
+    """Shared snapshot-fetch skeleton: open ``method``, send ``sub``, HOLD
+    the request side open until the first response lands (a generator that
+    returns right after the subscribe half-closes immediately, and a
+    strict control plane may treat client half-close as end-of-stream
+    before its first push — ADVICE r4 #5), cancel on every exit path, and
+    return the first message's bytes. One copy of this subtle lifecycle
+    for both wires (reviewer finding, round 5)."""
     from tpurpc.rpc.channel import Channel
-    from tpurpc.rpc.status import RpcError
 
     with Channel(server_uri, connect_timeout=timeout) as ch:
-        stream = ch.stream_stream(METHOD)
-        sub = json.dumps({"node": node, "resource": service}).encode()
-        # ACTUALLY hold the request side open until the response lands (or
-        # the fetch gives up): a generator that returns right after the
-        # subscribe half-closes immediately, and a strict control plane may
-        # treat client half-close as end-of-stream before its first push
-        # (ADVICE r4 #5). The sender thread parks on this event; cancel()
-        # below releases it on every exit path.
         done = threading.Event()
 
         def reqs():
             yield sub
             done.wait(timeout)
 
-        call = stream(reqs(), timeout=timeout)
+        call = ch.stream_stream(method)(reqs(), timeout=timeout)
         try:
             first = next(iter(call), None)
         finally:
@@ -168,11 +236,37 @@ def _fetch_snapshot(server_uri: str, service: str, node: dict,
             raise RuntimeError(
                 f"xds server {server_uri} closed the ADS stream without "
                 f"an assignment for {service!r}")
-        try:
-            return list(json.loads(bytes(first).decode())["endpoints"])
-        except (ValueError, KeyError) as exc:
-            raise RuntimeError(
-                f"malformed ADS response from {server_uri}") from exc
+        return bytes(first)
+
+
+def _fetch_snapshot_v3(server_uri: str, service: str, node: dict,
+                       timeout: float = 10.0) -> List[str]:
+    """One v3 ADS subscribe → first ClusterLoadAssignment → done."""
+    from tpurpc.rpc import xds_v3
+
+    sub = xds_v3.encode_discovery_request(
+        [service], node_id=str(node.get("id", "")),
+        node_cluster=str(node.get("cluster", "")))
+    first = _fetch_first(server_uri, xds_v3.METHOD, sub, service, timeout)
+    upd = xds_v3.decode_discovery_response(first)
+    if service not in upd["assignments"]:
+        raise RuntimeError(
+            f"ADS response from {server_uri} carries no "
+            f"ClusterLoadAssignment for {service!r}")
+    return list(upd["assignments"][service])
+
+
+def _fetch_snapshot(server_uri: str, service: str, node: dict,
+                    timeout: float = 10.0) -> List[str]:
+    """One subscribe → first assignment → done (the resolver's job).
+    Legacy ADS-lite JSON wire (bootstrap ``server_features: ["ads_lite"]``)."""
+    sub = json.dumps({"node": node, "resource": service}).encode()
+    first = _fetch_first(server_uri, METHOD, sub, service, timeout)
+    try:
+        return list(json.loads(first.decode())["endpoints"])
+    except (ValueError, KeyError) as exc:
+        raise RuntimeError(
+            f"malformed ADS response from {server_uri}") from exc
 
 
 def _normalize(endpoints: Sequence[str]) -> list:
@@ -193,8 +287,8 @@ def _resolve_xds(rest: str):
     """Resolver for ``xds:///service`` (registered below)."""
     service = rest.lstrip("/")
     cfg = load_bootstrap()
-    endpoints = _fetch_snapshot(_server_uri(cfg), service,
-                                cfg.get("node", {}))
+    fetch = _fetch_snapshot if _use_ads_lite(cfg) else _fetch_snapshot_v3
+    endpoints = fetch(_server_uri(cfg), service, cfg.get("node", {}))
     if not endpoints:
         raise ValueError(f"xds assignment for {service!r} is empty")
     return _normalize(endpoints)
@@ -248,50 +342,116 @@ class XdsWatcher:
         self._thread.start()
 
     def _run(self) -> None:
-        from tpurpc.rpc.channel import Channel
-
+        run = (self._run_lite if _use_ads_lite(self._cfg)
+               else self._run_v3)
         uri = _server_uri(self._cfg)
-        node = self._cfg.get("node", {})
         backoff = 0.2
         while not self._stop.is_set():
+            # _healthy: the stream delivered at least one response this
+            # connection — reset the reconnect backoff EVEN when the stream
+            # later dies by exception (a plane that served for hours then
+            # dropped deserves a fast re-dial, not the escalated backoff)
+            self._healthy = False
             try:
-                with Channel(uri, connect_timeout=10.0) as bch:
-                    self._bch = bch  # stop() closes it to unblock the recv
-                    sub = json.dumps({"node": node,
-                                      "resource": self._service}).encode()
-
-                    def reqs():
-                        yield sub
-                        while not self._stop.wait(0.2):
-                            pass
-
-                    for msg in bch.stream_stream(METHOD)(reqs(),
-                                                         timeout=None):
-                        if self._stop.is_set():
-                            return
-                        try:
-                            upd = json.loads(bytes(msg).decode())
-                            # normalization may raise too (bad host:port
-                            # strings): the whole parse is one
-                            # keep-the-last-good unit, NOT a stream
-                            # teardown — a control plane resending one
-                            # malformed assignment must not put the
-                            # watcher in a reconnect loop
-                            addrs = _normalize(list(upd["endpoints"]))
-                        except (ValueError, KeyError):
-                            continue  # malformed push: keep the last good
-                        if addrs and addrs != self._last_applied:
-                            self._channel.update_addresses(addrs)
-                            self._last_applied = addrs
-                            self.applied_versions.append(
-                                int(upd.get("version", -1)))
-                        backoff = 0.2
+                run(uri)
             except Exception:
                 if self._stop.is_set():
                     return
+            if self._healthy:
+                backoff = 0.2
             if self._stop.wait(backoff):
                 return
             backoff = min(backoff * 2, 5.0)
+
+    def _apply(self, endpoints, version: int) -> None:
+        """One keep-the-last-good application unit: normalization may
+        raise (bad host:port strings) and must NOT tear the stream down —
+        a control plane resending one malformed assignment must not put
+        the watcher in a reconnect loop."""
+        try:
+            addrs = _normalize(list(endpoints))
+        except (ValueError, KeyError):
+            return
+        if addrs and addrs != self._last_applied:
+            self._channel.update_addresses(addrs)
+            self._last_applied = addrs
+            self.applied_versions.append(version)
+
+    def _run_v3(self, uri: str) -> None:
+        """The real wire: v3 ADS subscribe → responses → ACK each one
+        (version_info + response_nonce echoed, the A* protocols' ACK
+        contract) → apply assignments. A response that does not DECODE at
+        all is skipped without ACK (its nonce is unreadable, so no NACK is
+        possible either); a decodable response is always ACKed, even when
+        its assignment is unusable — keep-the-last-good without stalling
+        an ACK-gated control plane."""
+        import queue as _queue
+
+        from tpurpc.rpc import xds_v3
+        from tpurpc.rpc.channel import Channel
+
+        node = self._cfg.get("node", {})
+        node_id = str(node.get("id", ""))
+        with Channel(uri, connect_timeout=10.0) as bch:
+            self._bch = bch  # stop() closes it to unblock the recv
+            acks: "_queue.Queue[bytes]" = _queue.Queue()
+
+            def reqs():
+                yield xds_v3.encode_discovery_request(
+                    [self._service], node_id=node_id,
+                    node_cluster=str(node.get("cluster", "")))
+                while not self._stop.is_set():
+                    try:
+                        yield acks.get(timeout=0.2)
+                    except _queue.Empty:
+                        continue
+
+            for msg in bch.stream_stream(xds_v3.METHOD)(reqs(),
+                                                        timeout=None):
+                if self._stop.is_set():
+                    return
+                self._healthy = True
+                try:
+                    upd = xds_v3.decode_discovery_response(bytes(msg))
+                except ValueError:
+                    continue  # undecodable: no nonce to ACK/NACK with
+                acks.put(xds_v3.encode_discovery_request(
+                    [self._service], version_info=upd["version_info"],
+                    response_nonce=upd["nonce"], node_id=node_id))
+                if self._service in upd["assignments"]:
+                    try:
+                        version = int(upd["version_info"])
+                    except ValueError:
+                        version = -1
+                    self._apply(upd["assignments"][self._service], version)
+
+    def _run_lite(self, uri: str) -> None:
+        """Legacy ADS-lite JSON wire (bootstrap server_features
+        ["ads_lite"])."""
+        from tpurpc.rpc.channel import Channel
+
+        node = self._cfg.get("node", {})
+        with Channel(uri, connect_timeout=10.0) as bch:
+            self._bch = bch  # stop() closes it to unblock the recv
+            sub = json.dumps({"node": node,
+                              "resource": self._service}).encode()
+
+            def reqs():
+                yield sub
+                while not self._stop.wait(0.2):
+                    pass
+
+            for msg in bch.stream_stream(METHOD)(reqs(), timeout=None):
+                if self._stop.is_set():
+                    return
+                self._healthy = True  # resets backoff even if we die later
+                try:
+                    upd = json.loads(bytes(msg).decode())
+                    endpoints = list(upd["endpoints"])
+                    version = int(upd.get("version", -1))
+                except (ValueError, KeyError):
+                    continue  # malformed push (incl. version): keep last good
+                self._apply(endpoints, version)
 
     def stop(self) -> None:
         self._stop.set()
@@ -314,8 +474,8 @@ def xds_channel(target: str, bootstrap: Optional[dict] = None, **channel_kw):
 
     service = target[4:].lstrip("/")
     cfg = bootstrap or load_bootstrap()
-    endpoints = _fetch_snapshot(_server_uri(cfg), service,
-                                cfg.get("node", {}))
+    fetch = _fetch_snapshot if _use_ads_lite(cfg) else _fetch_snapshot_v3
+    endpoints = fetch(_server_uri(cfg), service, cfg.get("node", {}))
     if not endpoints:
         raise ValueError(f"xds assignment for {service!r} is empty")
     addrs = _normalize(endpoints)  # same keys update_addresses will produce
