@@ -1,0 +1,118 @@
+// A/B microbench for the zero-copy send lease (VERDICT r4 next #6):
+// does eliminating the staging memcpy on the ring send path matter?
+//
+//   A (staging):  produce payload into an app buffer (one pattern write),
+//                 then tpr_call_send — which memcpys it into the peer ring
+//                 (tpr_ring_writev copy_in). Two passes over the bytes.
+//   B (lease):    tpr_call_send_reserve — produce the SAME payload pattern
+//                 directly into the reserved ring span — commit. One pass.
+//
+// The producer work (one pattern write over the payload) is identical in
+// both modes, so the measured delta is exactly the staging memcpy the
+// reference's SendZerocopy eliminates (pair.cc:793-941; its NIC moves the
+// bytes instead of the CPU — in shm the producing store IS the move).
+//
+// Server side: handler-API sink draining the stream (no echo traffic).
+// Build+run: bash bench/send_ab.sh  -> bench/results/send_ab_1core.log
+//
+// Output: one line + one JSON line per (mode, size).
+
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+#include "tpurpc/client.h"
+#include "tpurpc/server.h"
+
+static int sink_handler(tpr_server_call *call, void *) {
+  uint8_t *data;
+  size_t len;
+  while (tpr_srv_recv(call, &data, &len) == 1) tpr_srv_buf_free(data);
+  static const uint8_t ok = 1;
+  tpr_srv_send(call, &ok, 1);
+  return 0;
+}
+
+// the "serialization" both modes perform: one full pass writing the bytes
+static void produce(uint8_t *dst, size_t len, uint8_t salt) {
+  memset(dst, 0xA0 ^ salt, len);
+}
+
+int main(int argc, char **argv) {
+  double secs = argc > 1 ? atof(argv[1]) : 3.0;
+
+  tpr_server *srv = tpr_server_create(0);
+  if (!srv) return 1;
+  tpr_server_register(srv, "/ab.Sink/Drain", sink_handler, nullptr);
+  if (tpr_server_start(srv) != 0) return 1;
+  int port = tpr_server_port(srv);
+
+  const size_t sizes[] = {16 * 1024, 128 * 1024, 1024 * 1024};
+  for (size_t size : sizes) {
+    for (int mode = 0; mode < 2; ++mode) {  // 0 = A staging, 1 = B lease
+      tpr_channel *ch = tpr_channel_create("127.0.0.1", port, 5000);
+      if (!ch) return 1;
+      tpr_call *c = tpr_call_start(ch, "/ab.Sink/Drain", nullptr, 0, 0);
+      if (!c) return 1;
+      std::vector<uint8_t> staging(size);
+      uint64_t sent = 0, msgs = 0;
+      bool lease_ok = true;
+      auto t0 = std::chrono::steady_clock::now();
+      auto t_end = t0 + std::chrono::duration<double>(secs);
+      while (std::chrono::steady_clock::now() < t_end) {
+        uint8_t salt = (uint8_t)msgs;
+        if (mode == 0) {
+          produce(staging.data(), size, salt);
+          if (tpr_call_send(c, staging.data(), size, 0) != 0) return 1;
+        } else {
+          uint8_t *p1, *p2;
+          size_t l1, l2;
+          if (tpr_call_send_reserve(c, size, 0, &p1, &l1, &p2, &l2) != 0) {
+            lease_ok = false;  // e.g. TCP platform: lease ineligible
+            break;
+          }
+          produce(p1, l1, salt);
+          if (l2) produce(p2, l2, salt);
+          if (tpr_call_send_commit(c) != 0) return 1;
+        }
+        sent += size;
+        ++msgs;
+      }
+      if (!lease_ok) {
+        printf("mode=lease size=%zu SKIP (lease ineligible on this "
+               "platform)\n", size);
+        tpr_call_cancel(c);
+        tpr_call_destroy(c);
+        tpr_channel_destroy(ch);
+        continue;
+      }
+      // half-close and wait for the sink's ack so every byte is DRAINED
+      // (otherwise the timer would stop while the ring still holds data)
+      tpr_call_send(c, nullptr, 0, 1);
+      uint8_t *resp;
+      size_t rlen;
+      if (tpr_call_recv(c, &resp, &rlen) == 1) tpr_buf_free(resp);
+      int st = tpr_call_finish(c, nullptr, 0);
+      double dt = std::chrono::duration<double>(
+                      std::chrono::steady_clock::now() - t0).count();
+      tpr_call_destroy(c);
+      tpr_channel_destroy(ch);
+      if (st != TPR_OK) {
+        fprintf(stderr, "finish status %d\n", st);
+        return 1;
+      }
+      double gbps = (double)sent / dt / 1e9;
+      const char *m = mode == 0 ? "staging" : "lease";
+      printf("mode=%s size=%zu msgs=%llu %.3f GB/s\n", m, size,
+             (unsigned long long)msgs, gbps);
+      printf("{\"bench\": \"send_ab\", \"mode\": \"%s\", \"size\": %zu, "
+             "\"msgs\": %llu, \"secs\": %.2f, \"gbps\": %.3f}\n",
+             m, size, (unsigned long long)msgs, dt, gbps);
+    }
+  }
+  tpr_server_destroy(srv);
+  return 0;
+}

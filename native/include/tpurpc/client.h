@@ -94,6 +94,23 @@ int tpr_call_recv(tpr_call *c, uint8_t **data, size_t *len);
  * the status message, NUL-terminated, truncated to cap. */
 int tpr_call_finish(tpr_call *c, char *details, size_t cap);
 
+/* Zero-copy send lease (ring transports only) — the reference's
+ * SendZerocopy shape (pair.cc:793-941) for a shm ring: reserve `len`
+ * payload bytes of ONE message directly in the transport ring, so the
+ * producer serializes in place and the staging-buffer memcpy disappears.
+ * On 0, the frame header is already written and (p1,l1)(+(p2,l2) at a
+ * ring wrap) are the payload span to fill; then call
+ * tpr_call_send_commit (publish + notify) or tpr_call_send_abort
+ * (release without publishing). The channel's send path is LOCKED from a
+ * successful reserve until commit/abort: commit promptly, same thread,
+ * no other sends in between. -1 = not eligible (no ring, len 0 or over
+ * one frame, channel dead, lease already held) — use tpr_call_send. */
+int tpr_call_send_reserve(tpr_call *c, size_t len, int end_stream,
+                          uint8_t **p1, size_t *l1,
+                          uint8_t **p2, size_t *l2);
+int tpr_call_send_commit(tpr_call *c);
+int tpr_call_send_abort(tpr_call *c);
+
 /* Cancel: RST the stream. Safe at any point before finish. */
 void tpr_call_cancel(tpr_call *c);
 

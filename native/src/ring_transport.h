@@ -33,6 +33,7 @@
 
 #include <atomic>
 #include <chrono>
+#include <condition_variable>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -52,6 +53,13 @@ uint64_t tpr_ring_read_into(uint8_t *ring, uint64_t cap, uint64_t *head,
 uint64_t tpr_ring_writev(uint8_t *ring, uint64_t cap, uint64_t *tail,
                          uint64_t remote_head, const uint8_t *const *segs,
                          const uint64_t *lens, uint32_t nsegs, uint64_t *seq);
+uint64_t tpr_ring_max_payload(uint64_t cap);
+uint64_t tpr_ring_reserve(uint8_t *ring, uint64_t cap, uint64_t tail,
+                          uint64_t remote_head, uint64_t payload_len,
+                          uint8_t **p1, uint64_t *l1,
+                          uint8_t **p2, uint64_t *l2);
+void tpr_ring_commit(uint8_t *ring, uint64_t cap, uint64_t *tail,
+                     uint64_t payload_len, uint64_t *seq);
 int tpr_ring_has_message(const uint8_t *ring, uint64_t cap, uint64_t head,
                          uint64_t seq);
 void tpr_store_u64_seqcst(uint8_t *addr, uint64_t val);
@@ -362,6 +370,38 @@ struct RingTransport {
     return read_exact_deadline(buf, len, nullptr) == 1;
   }
 
+  // -- zero-copy send lease (SendZerocopy analog, pair.cc:793-941) ---------
+  // Reserve ONE message's payload span in the peer ring (blocking for
+  // credits like write_gather); the producer fills the returned (<=2,
+  // wrap-split) segments in place — serialization targets the ring, no
+  // staging copy — then commit_lease publishes (footer+header stamps) and
+  // notifies. The caller must serialize reserve->commit against all other
+  // sends on this transport (the channel write lock).
+  bool reserve_lease(uint64_t payload_len, uint8_t **p1, uint64_t *l1,
+                     uint8_t **p2, uint64_t *l2) {
+    // can-NEVER-fit gate (same bound tpr_ring_reserve enforces, from the
+    // same ring.cc home) — without it the credit loop below would wait
+    // forever on a payload no amount of credits can grant
+    if (payload_len == 0 ||
+        payload_len > tpr_ring_max_payload(peer_ring_size))
+      return false;
+    while (alive.load()) {
+      fold_credits();
+      if (tpr_ring_reserve(peer_ring.base, peer_ring_size, tail, remote_head,
+                           payload_len, p1, l1, p2, l2))
+        return true;
+      if (peer_gone()) return false;
+      if (!spin_for_credits()) wait_event(100);
+    }
+    return false;
+  }
+
+  void commit_lease(uint64_t payload_len) {
+    tpr_ring_commit(peer_ring.base, peer_ring_size, &tail, payload_len,
+                    &wseq);
+    notify('d');
+  }
+
   // -- shared-poller (epoll) primitives ------------------------------------
   // The server's shared poller multiplexes many connections on one thread:
   // it epolls event_fd() (level-triggered), drains tokens, then pumps
@@ -372,13 +412,26 @@ struct RingTransport {
 
   // Nonblocking drain of queued notify tokens. Returns -1 when the peer
   // closed the event channel (connection over), else the token count.
+  // ALSO wakes any wait_event parkers: tokens are not addressed to a
+  // particular waiter, so whoever drains them must publish "something
+  // happened" to every blocked thread (see wait_event's epoch).
   int drain_tokens() {
+    if (!epoll_tid_set.load(std::memory_order_acquire)) {
+      // record the epoll loop's identity: ITS wait_event calls (a
+      // callback handler blocking for response credits runs on this very
+      // thread) must keep polling the fd — nobody else will — while
+      // foreign threads park on ev_cv
+      std::lock_guard<std::mutex> lk(ev_mu);
+      epoll_tid = std::this_thread::get_id();
+      epoll_tid_set.store(true, std::memory_order_release);
+    }
     char tokens[256];
     int total = 0;
     while (true) {
       ssize_t n = ::recv(notify_fd, tokens, sizeof tokens, MSG_DONTWAIT);
       if (n == 0) {  // peer closed
         peer_exited = true;
+        wake_waiters();
         return -1;
       }
       if (n < 0) break;  // EAGAIN: drained
@@ -387,7 +440,16 @@ struct RingTransport {
       total += static_cast<int>(n);
       if (n < static_cast<ssize_t>(sizeof tokens)) break;
     }
+    if (total > 0) wake_waiters();
     return total;
+  }
+
+  void wake_waiters() {
+    {
+      std::lock_guard<std::mutex> lk(ev_mu);
+      ++ev_epoch;
+    }
+    ev_cv.notify_all();
   }
 
   // Nonblocking ring read: up to `max` framing-stream bytes into buf.
@@ -542,21 +604,73 @@ struct RingTransport {
   }
 
   // Block up to timeout_ms for a notify token (or peer close). Returns true
-  // if an event arrived. Always drains every queued token.
+  // if an event arrived (possibly drained by ANOTHER thread).
+  //
+  // Multiple threads legally block here at once — a reader waiting for
+  // data and a writer waiting for credits share ONE notify fd, and the
+  // tokens are not addressed. Two threads racing poll()+recv() on the fd
+  // STEAL each other's wakeups: the reader can drain the writer's 'c'
+  // credit token, re-check its (empty) ring, and sleep again, leaving the
+  // writer to burn its full timeout while the peer has already returned
+  // credits — measured as bulk sends moving exactly one ring per 100 ms
+  // slice (~0.07 GB/s; 6-8x off). So: ONE thread polls the fd; everyone
+  // else parks on a condition variable that the drainer (this poller, or
+  // the server's epoll loop via drain_tokens) bumps for every drain.
   bool wait_event(int timeout_ms) {
+    std::unique_lock<std::mutex> lk(ev_mu);
+    uint64_t e = ev_epoch;
+    // Is the fd owned by a shared epoll loop, and is this a FOREIGN
+    // thread? Then a recv() here would steal 'd' tokens the
+    // level-triggered epoll needs to pump requests (they'd sit unread in
+    // the ring) — park for the owner's drain instead. The epoll thread
+    // ITSELF (a callback handler blocking for response credits) keeps
+    // polling: its pump_conn continuation drains the ring either way,
+    // and nobody else would read the fd while it is blocked here.
+    bool foreign = epoll_owned.load() &&
+                   !(epoll_tid_set.load() &&
+                     epoll_tid == std::this_thread::get_id());
+    if (foreign || ev_polling) {
+      ev_cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                     [&] { return ev_epoch != e; });
+      return ev_epoch != e;
+    }
+    ev_polling = true;
+    lk.unlock();
     struct pollfd pfd = {notify_fd, POLLIN, 0};
     int r = ::poll(&pfd, 1, timeout_ms);
-    if (r <= 0) return false;
-    char tokens[64];
-    ssize_t n = ::recv(notify_fd, tokens, sizeof tokens, MSG_DONTWAIT);
-    if (n == 0) {  // peer closed the event channel: connection over
-      peer_exited = true;
-      return true;
+    bool got = false;
+    if (r > 0) {
+      char tokens[64];
+      ssize_t n = ::recv(notify_fd, tokens, sizeof tokens, MSG_DONTWAIT);
+      if (n == 0) {  // peer closed the event channel: connection over
+        peer_exited = true;
+        got = true;
+      } else if (n > 0) {
+        for (ssize_t i = 0; i < n; ++i)
+          if (tokens[i] == 'x') peer_exited = true;
+        got = true;
+      }
     }
-    for (ssize_t i = 0; i < n; ++i)
-      if (tokens[i] == 'x') peer_exited = true;
-    return n > 0;
+    lk.lock();
+    ev_polling = false;
+    if (got) ++ev_epoch;
+    bool advanced = ev_epoch != e;
+    lk.unlock();
+    ev_cv.notify_all();  // hand the fd off + deliver the drain
+    return advanced;
   }
+
+  std::mutex ev_mu;
+  std::condition_variable ev_cv;
+  uint64_t ev_epoch = 0;   // bumped on every token drain (any drainer)
+  bool ev_polling = false; // a thread owns the poll on notify_fd
+  //: set when a shared epoll poller adopts this transport's fd
+  //: (tpurpc_server.cc Poller::add): from then on only drain_tokens (the
+  //: epoll loop) and the epoll thread's own wait_event calls touch the
+  //: fd; foreign wait_event callers park on ev_cv
+  std::atomic<bool> epoll_owned{false};
+  std::thread::id epoll_tid{};           // ev_mu; valid once epoll_tid_set
+  std::atomic<bool> epoll_tid_set{false};
 };
 
 }  // namespace tpr_ring

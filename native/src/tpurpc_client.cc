@@ -192,6 +192,14 @@ struct tpr_channel {
   std::thread reader;
   bool inline_read = false;  // no reader thread; waiters pump (ring only)
   bool pumping = false;      // a thread is inside the transport (mu)
+  // zero-copy send lease state. write_mu is HELD from a successful
+  // tpr_call_send_reserve until commit/abort; lease_active is atomic and
+  // lease_owner records the holder so misuse (same-thread re-reserve,
+  // commit from a thread that isn't the owner) returns -1 instead of
+  // deadlocking on the non-recursive mutex / unlocking a foreign lock.
+  std::atomic<bool> lease_active{false};
+  std::thread::id lease_owner{};
+  uint64_t lease_len = 0;
 
   ~tpr_channel() {
     alive.store(false);
@@ -711,6 +719,88 @@ int tpr_call_send(tpr_call *c, const uint8_t *data, size_t len, int end_stream) 
       return -1;
     off += n;
   } while (off < len);
+  return 0;
+}
+
+int tpr_call_send_reserve(tpr_call *c, size_t len, int end_stream,
+                          uint8_t **p1, size_t *l1,
+                          uint8_t **p2, size_t *l2) {
+  // Zero-copy send (the reference's SendZerocopy shape, pair.cc:793-941,
+  // recast for a shm ring): reserve ONE message's span in the peer ring so
+  // the producer SERIALIZES INTO THE TRANSPORT — the staging buffer and
+  // its memcpy disappear. The 10-byte frame header is written here; the
+  // caller fills the returned payload segments then commits. write_mu is
+  // HELD between reserve and commit/abort: commit promptly from the same
+  // thread, and issue no other sends in between (they would deadlock).
+  tpr_channel *ch = c->c.ch;
+  if (ch->ring == nullptr || len == 0 || len > kMaxFramePayload) return -1;
+  // BEFORE taking write_mu: the holder of an uncommitted lease already
+  // owns the lock, so a same-thread re-reserve must fail fast here — the
+  // lock() below would self-deadlock a non-recursive mutex (and another
+  // thread's reserve would block, which is just normal send serialization)
+  if (ch->lease_active.load()) return -1;
+  ch->write_mu.lock();
+  if (!ch->alive.load() || ch->lease_active.load()) {
+    ch->write_mu.unlock();
+    return -1;
+  }
+  uint64_t total = 10 + (uint64_t)len;
+  uint8_t *q1;
+  uint64_t m1;
+  uint8_t *q2;
+  uint64_t m2;
+  if (!ch->ring->reserve_lease(total, &q1, &m1, &q2, &m2)) {
+    ch->write_mu.unlock();
+    return -1;
+  }
+  std::string hdr;
+  build_frame_header(hdr, kMessage,
+                     end_stream ? kFlagEndStream : 0, c->c.stream_id, len);
+  // header may straddle the wrap split
+  size_t h1 = hdr.size() < m1 ? hdr.size() : (size_t)m1;
+  memcpy(q1, hdr.data(), h1);
+  if (h1 < hdr.size()) memcpy(q2, hdr.data() + h1, hdr.size() - h1);
+  if (m1 > hdr.size()) {
+    *p1 = q1 + hdr.size();
+    *l1 = (size_t)(m1 - hdr.size());
+    *p2 = q2;
+    *l2 = (size_t)m2;
+  } else {
+    *p1 = q2 + (hdr.size() - m1);
+    *l1 = (size_t)(m2 - (hdr.size() - m1));
+    *p2 = nullptr;
+    *l2 = 0;
+  }
+  ch->lease_owner = std::this_thread::get_id();
+  ch->lease_len = total;
+  ch->lease_active.store(true);
+  return 0;
+}
+
+// Only the RESERVING thread may finish a lease: a stranger "committing"
+// would publish a half-filled message to the peer and unlock a mutex it
+// never locked (both UB). The owner-id gate turns that misuse into -1.
+static bool lease_owned_by_me(tpr_channel *ch) {
+  return ch->lease_active.load() &&
+         ch->lease_owner == std::this_thread::get_id();
+}
+
+int tpr_call_send_commit(tpr_call *c) {
+  tpr_channel *ch = c->c.ch;
+  if (!lease_owned_by_me(ch)) return -1;
+  ch->ring->commit_lease(ch->lease_len);
+  ch->lease_active.store(false);
+  ch->write_mu.unlock();
+  return 0;
+}
+
+int tpr_call_send_abort(tpr_call *c) {
+  // Un-publish: reserve never advanced the tail, so the span is simply
+  // reused by the next send. Releases the channel's send path.
+  tpr_channel *ch = c->c.ch;
+  if (!lease_owned_by_me(ch)) return -1;
+  ch->lease_active.store(false);
+  ch->write_mu.unlock();
   return 0;
 }
 

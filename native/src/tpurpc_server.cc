@@ -284,6 +284,11 @@ struct Poller {
   }
 
   void add(Conn *c) {
+    // From adoption on, this epoll loop is the fd's only reader: blocked
+    // writers (handler responses waiting for credits) must park on the
+    // transport cv, not steal request tokens out of epoll's mouth
+    // (ring_transport.h wait_event epoll_owned).
+    if (c->ring) c->ring->epoll_owned.store(true);
     {
       std::lock_guard<std::mutex> lk(add_mu);
       pending_add.push_back(c);
