@@ -42,18 +42,19 @@ import numpy as np
 
 from tpurpc.rpc.server import Server
 
-# Two servers (deployment guidance, round 4): the serving flagship keeps
-# the default plane (ring connections adopted onto the native
-# shared-poller loop — the small-RPC latency win feeds the batcher
-# faster). The BULK streaming sink runs the Python plane by default: with
-# the native server's zero-copy recv handoff (OwnedBuf) the two planes
-# A/B within noise on 4 MiB streams (0.52 vs 0.53 GB/s same-weather; the
-# native plane was 19% behind before it), and the Python plane keeps the
-# copy-ledger instrumentation. TPURPC_BENCH_SINK_NATIVE=1 flips it.
+# Two servers (deployment guidance, round 5): BOTH phases now ride the
+# native plane. Round 4 measured the bulk sink "within noise / 19%
+# behind" on the native plane — that was the notify-token-stealing bug
+# (ring_transport.h wait_event, fixed round 5: bulk ring sends went
+# 0.07 -> 5.4 GB/s). Re-A/B'd same-weather after the fix, 4 MiB tensor
+# streams: native server + native client 1.20 GB/s vs Python/Python
+# 0.86 vs mixed 0.67-0.91 — both-native wins by ~40%, so it is the
+# default; TPURPC_BENCH_SINK_NATIVE=0 flips back to the instrumented
+# Python plane (copy-ledger runs).
 srv = Server(max_workers=8,
-             native_dataplane=None
-             if os.environ.get("TPURPC_BENCH_SINK_NATIVE", "0") == "1"
-             else False)
+             native_dataplane=False
+             if os.environ.get("TPURPC_BENCH_SINK_NATIVE", "1") == "0"
+             else None)
 port = srv.add_insecure_port("127.0.0.1:0")
 srv_infer = Server(max_workers=8)
 port_infer = srv_infer.add_insecure_port("127.0.0.1:0")
@@ -371,8 +372,14 @@ def _run_once(env, n_msgs: int, ready_s: float):
                 for _ in range(k):
                     yield {"x": payload}
 
+            # The client side of the measured-best both-native plane (see
+            # _SERVER_CODE's sink comment): the bulk stream rides the
+            # libtpurpc loop unless the env opts back to the Python plane.
+            sink_native = os.environ.get("TPURPC_BENCH_SINK_NATIVE",
+                                         "1") != "0"
+
             # warmup RPC: decode jit + ring bring-up out of the timing
-            list(cli.duplex("Sink", gen(2), timeout=300))
+            list(cli.duplex("Sink", gen(2), native=sink_native, timeout=300))
 
             # Calibrate HERE — after the (possibly minutes-long) backend
             # bring-up, immediately before the timed rounds — so the
@@ -394,7 +401,8 @@ def _run_once(env, n_msgs: int, ready_s: float):
             dts = []
             for _ in range(rounds):
                 t0 = time.perf_counter()
-                replies = list(cli.duplex("Sink", gen(n_msgs), timeout=600))
+                replies = list(cli.duplex("Sink", gen(n_msgs),
+                                          native=sink_native, timeout=600))
                 dt = time.perf_counter() - t0
                 total = int(np.asarray(replies[-1]["bytes"]).ravel()[0])
                 assert total == n_msgs * payload.nbytes, (total, n_msgs)

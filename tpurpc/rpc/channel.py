@@ -1350,11 +1350,14 @@ class _MultiCallable:
         self._ser = serializer
         self._deser = deserializer
         #: tpurpc extension (tpurpc_native=False at the factory): opt a
-        #: method out of the native fast paths. The jaxshim tensor bulk
-        #: path uses it — the Python plane's zero-bounce Assembly beats
-        #: the native loop's accumulate-and-copy on multi-MiB payloads
-        #: (measured: 4 MiB streaming 0.43 vs 0.86 GB/s), while the
-        #: native loop wins small-RPC latency.
+        #: method out of the native fast paths — e.g. to keep a bulk
+        #: stream on the fully instrumented Python plane (copy-ledger
+        #: runs). Historical note: rounds 3-4 measured the Python plane
+        #: FASTER on multi-MiB payloads (0.43 vs 0.86 GB/s) — that gap
+        #: was the notify-token-stealing bug fixed in round 5
+        #: (ring_transport.h wait_event); the same A/B now measures the
+        #: native loop ~40% ahead (1.20 vs 0.86 GB/s), and it wins
+        #: small-RPC latency as before.
         self._allow_native = allow_native
 
     def _dial(self, wait_for_ready: bool,

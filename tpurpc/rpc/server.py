@@ -801,11 +801,11 @@ class Server:
         #: tpurpc extension: None = auto (adopt ring connections onto the
         #: native shared-poller loop when eligible — the small-RPC latency
         #: plane); False = always the Python plane (fully instrumented —
-        #: the copy ledger counts its passes; on multi-MiB payloads the
-        #: two planes measure within noise of each other now that the
-        #: native recv hands its malloc-backed accumulator to the handler
-        #: zero-copy). True behaves like auto (the eligibility gates still
-        #: apply; they are correctness gates).
+        #: the copy ledger counts its passes; note it is ~40% slower on
+        #: multi-MiB streams since round 5 fixed the native plane's
+        #: notify-token-stealing bug — 1.20 vs 0.86 GB/s same-weather,
+        #: bench.py sink A/B). True behaves like auto (the eligibility
+        #: gates still apply; they are correctness gates).
         self._native_dataplane_opt = native_dataplane
         self._pool = ThreadPoolExecutor(max_workers=max_workers,
                                         thread_name_prefix="tpurpc-handler")
