@@ -113,6 +113,39 @@ def test_cpp_client_against_ring_platform_server(monkeypatch):
         srv.stop(grace=0)
 
 
+def test_cpp_send_lease_ring(monkeypatch):
+    """Zero-copy send lease E2E (round 5): a C client serializes payloads
+    DIRECTLY into the transport ring (tpr_call_send_reserve/commit — the
+    reference's SendZerocopy shape) and a live Python server verifies
+    every byte. Covers wrapped spans (odd sizes walk the tail over the
+    ring edge), interleaving with classic sends on the same stream, and
+    the misuse guards (double reserve / stray commit return -1)."""
+    import numpy as np
+
+    monkeypatch.setenv("GRPC_PLATFORM_TYPE", "RDMA_BPEV")
+    lease_bin = os.path.join(ROOT, "native", "build", "cpp_send_lease")
+    _build_cpp(lease_bin, "cpp_send_lease.cc",
+               ["tpurpc_client.cc", "ring.cc"], ["client.h"])
+
+    def check(req_iter, ctx):
+        for m in req_iter:
+            arr = np.frombuffer(bytes(m), np.uint8)
+            yield f"{arr.size}:{int(arr.sum(dtype=np.uint64))}".encode()
+
+    srv = rpc.Server(max_workers=4)
+    srv.add_method("/lease.S/Check",
+                   rpc.stream_stream_rpc_method_handler(check))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    try:
+        out = subprocess.run([lease_bin, str(port)], capture_output=True,
+                             text=True, timeout=120)
+        assert out.returncode == 0, (out.stdout, out.stderr)
+        assert "LEASE-OK" in out.stdout and "wrapped=" in out.stdout
+    finally:
+        srv.stop(grace=0)
+
+
 def test_cpp_client_deadline(monkeypatch):
     """A stalled server method must produce DEADLINE_EXCEEDED client-side."""
     monkeypatch.setenv("GRPC_PLATFORM_TYPE", "TCP")

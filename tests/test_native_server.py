@@ -194,3 +194,45 @@ def test_adoption_concurrent_multiplexed_calls(ring_platform):
         assert not errs, errs[:3]
     finally:
         srv.stop(grace=0)
+
+
+def test_bulk_stream_no_token_stealing_stall(ring_platform, monkeypatch):
+    """Round-5 regression (ring_transport.h wait_event): a reader and a
+    credit-blocked bulk writer share one notify fd, and before the
+    one-poller-others-park rewrite the reader could STEAL the writer's
+    credit token — bulk senders then moved exactly one ring per 100ms
+    poll slice. A deliberately small ring makes that pathology blow this
+    generous deadline by ~10x (32MB through a 256KB ring: ~13s broken,
+    well under a second fixed), while byte integrity proves the fast
+    path is still correct."""
+    monkeypatch.setenv("GRPC_RDMA_RING_BUFFER_SIZE_KB", "256")
+    from tpurpc.utils import config as config_mod
+
+    config_mod.set_config(None)
+
+    def total(req_iter, ctx):
+        n = 0
+        for m in req_iter:
+            n += len(m)
+        yield str(n).encode()
+
+    srv = rpc.Server(max_workers=4)
+    srv.add_method("/n.S/Total", rpc.stream_stream_rpc_method_handler(total))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    payload = b"\x5a" * (1024 * 1024)
+    msgs = 32
+    try:
+        import time as _time
+
+        with Channel(f"127.0.0.1:{port}") as ch:
+            mc = ch.stream_stream("/n.S/Total")
+            t0 = _time.monotonic()
+            out = list(mc(iter([payload] * msgs), timeout=60))
+            dt = _time.monotonic() - t0
+        assert out == [str(msgs * len(payload)).encode()]
+        # stolen-wakeup regime: >= (total/ring) * 100ms ≈ 12.8s. The bound
+        # leaves 10x headroom over the fixed path for shared-core weather.
+        assert dt < 8.0, f"bulk stream took {dt:.1f}s — token stealing?"
+    finally:
+        srv.stop(grace=0)
