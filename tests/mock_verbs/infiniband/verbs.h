@@ -1,0 +1,299 @@
+// MOCK <infiniband/verbs.h> — CI's compile-and-behavior proof for the
+// verbs domain skeleton (native/src/verbs_domain.cc) on hosts with no IB
+// hardware or headers. Implements exactly the subset the skeleton uses,
+// in-process: ibv_reg_mr tracks regions in a global registry keyed by
+// rkey; IBV_WR_RDMA_WRITE validates {rkey, bounds} and memcpys into the
+// target region (the NIC's placement write, minus the NIC); every
+// signaled write completes immediately on the CQ. QP state transitions
+// are recorded and order-checked (RESET->INIT->RTR->RTS), so the
+// skeleton's bring-up sequence is verified, not just compiled.
+//
+// THIS IS A TEST DOUBLE. It lives under tests/ and is only reachable via
+// -Itests/mock_verbs -DTPR_TEST_MOCK_VERBS; production builds pick up the
+// real libibverbs header or compile the unavailable stubs.
+#ifndef TPURPC_TESTS_MOCK_VERBS_H
+#define TPURPC_TESTS_MOCK_VERBS_H
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <queue>
+#include <vector>
+
+enum ibv_qp_type { IBV_QPT_RC = 2 };
+enum ibv_qp_state {
+  IBV_QPS_RESET,
+  IBV_QPS_INIT,
+  IBV_QPS_RTR,
+  IBV_QPS_RTS,
+  IBV_QPS_ERR
+};
+enum ibv_mtu { IBV_MTU_1024 = 3 };
+enum ibv_wr_opcode { IBV_WR_RDMA_WRITE = 0 };
+enum ibv_wc_status { IBV_WC_SUCCESS = 0, IBV_WC_REM_ACCESS_ERR = 10 };
+enum {
+  IBV_ACCESS_LOCAL_WRITE = 1,
+  IBV_ACCESS_REMOTE_WRITE = 2,
+  IBV_SEND_SIGNALED = 2,
+  IBV_QP_STATE = 1 << 0,
+  IBV_QP_PKEY_INDEX = 1 << 1,
+  IBV_QP_PORT = 1 << 2,
+  IBV_QP_ACCESS_FLAGS = 1 << 3,
+  IBV_QP_AV = 1 << 4,
+  IBV_QP_PATH_MTU = 1 << 5,
+  IBV_QP_DEST_QPN = 1 << 6,
+  IBV_QP_RQ_PSN = 1 << 7,
+  IBV_QP_MAX_DEST_RD_ATOMIC = 1 << 8,
+  IBV_QP_MIN_RNR_TIMER = 1 << 9,
+  IBV_QP_SQ_PSN = 1 << 10,
+  IBV_QP_TIMEOUT = 1 << 11,
+  IBV_QP_RETRY_CNT = 1 << 12,
+  IBV_QP_RNR_RETRY = 1 << 13,
+  IBV_QP_MAX_QP_RD_ATOMIC = 1 << 14
+};
+
+struct ibv_device {
+  const char *name;
+};
+struct ibv_context {
+  ibv_device *device;
+};
+struct ibv_pd {
+  ibv_context *context;
+};
+struct ibv_wc {
+  uint64_t wr_id;
+  int status;
+};
+struct ibv_cq {
+  std::mutex mu;
+  std::queue<ibv_wc> completions;
+};
+struct ibv_mr {
+  ibv_pd *pd;
+  void *addr;
+  size_t length;
+  uint32_t lkey, rkey;
+};
+union ibv_gid {
+  uint8_t raw[16];
+};
+struct ibv_port_attr {
+  uint16_t lid;
+};
+struct ibv_global_route {
+  ibv_gid dgid;
+  uint8_t hop_limit;
+};
+struct ibv_ah_attr {
+  ibv_global_route grh;
+  uint16_t dlid;
+  uint8_t sl, src_path_bits, is_global, port_num;
+};
+struct ibv_qp_cap {
+  uint32_t max_send_wr, max_recv_wr, max_send_sge, max_recv_sge;
+};
+struct ibv_qp_init_attr {
+  void *qp_context;
+  ibv_cq *send_cq, *recv_cq;
+  void *srq;
+  ibv_qp_cap cap;
+  int qp_type;
+  int sq_sig_all;
+};
+struct ibv_qp_attr {
+  int qp_state;
+  int path_mtu;
+  uint32_t dest_qp_num, rq_psn, sq_psn;
+  uint8_t max_dest_rd_atomic, min_rnr_timer, max_rd_atomic;
+  uint8_t timeout, retry_cnt, rnr_retry;
+  uint16_t pkey_index;
+  uint8_t port_num;
+  int qp_access_flags;
+  ibv_ah_attr ah_attr;
+};
+struct ibv_qp {
+  ibv_pd *pd;
+  ibv_cq *send_cq;
+  uint32_t qp_num;
+  int state;
+  uint32_t dest_qp_num;
+};
+struct ibv_sge {
+  uint64_t addr;
+  uint32_t length, lkey;
+};
+struct ibv_send_wr {
+  uint64_t wr_id;
+  ibv_send_wr *next;
+  ibv_sge *sg_list;
+  int num_sge;
+  int opcode;
+  int send_flags;
+  struct {
+    struct {
+      uint64_t remote_addr;
+      uint32_t rkey;
+    } rdma;
+  } wr;
+};
+
+// ---- in-process fabric state ------------------------------------------------
+
+struct tpr_mock_fabric {
+  std::mutex mu;
+  std::map<uint32_t, ibv_mr *> mrs_by_rkey;  // the "NIC's" MR table
+  uint32_t next_key = 0x1000;
+  uint32_t next_qpn = 0x100;
+  static tpr_mock_fabric &get() {
+    static tpr_mock_fabric f;
+    return f;
+  }
+};
+
+// ---- API subset -------------------------------------------------------------
+
+static inline ibv_device **ibv_get_device_list(int *n) {
+  static ibv_device dev = {"mock0"};
+  static ibv_device *list[2] = {&dev, nullptr};
+  if (n) *n = 1;
+  return list;
+}
+static inline void ibv_free_device_list(ibv_device **) {}
+static inline const char *ibv_get_device_name(ibv_device *d) {
+  return d->name;
+}
+static inline ibv_context *ibv_open_device(ibv_device *d) {
+  return new ibv_context{d};
+}
+static inline int ibv_close_device(ibv_context *c) {
+  delete c;
+  return 0;
+}
+static inline ibv_pd *ibv_alloc_pd(ibv_context *c) { return new ibv_pd{c}; }
+static inline int ibv_dealloc_pd(ibv_pd *p) {
+  delete p;
+  return 0;
+}
+static inline ibv_cq *ibv_create_cq(ibv_context *, int, void *, void *, int) {
+  return new ibv_cq();
+}
+static inline int ibv_destroy_cq(ibv_cq *cq) {
+  delete cq;
+  return 0;
+}
+static inline int ibv_query_port(ibv_context *, uint8_t,
+                                 ibv_port_attr *attr) {
+  attr->lid = 7;  // a plausible LID: the skeleton ships it in rendezvous
+  return 0;
+}
+static inline int ibv_query_gid(ibv_context *, uint8_t, int, ibv_gid *gid) {
+  memset(gid->raw, 0xAB, 16);
+  return 0;
+}
+
+static inline ibv_mr *ibv_reg_mr(ibv_pd *pd, void *addr, size_t len,
+                                 int access) {
+  if (!(access & IBV_ACCESS_REMOTE_WRITE)) return nullptr;  // domain needs it
+  auto &f = tpr_mock_fabric::get();
+  std::lock_guard<std::mutex> lk(f.mu);
+  auto *mr = new ibv_mr{pd, addr, len, f.next_key, f.next_key + 1};
+  f.next_key += 2;
+  f.mrs_by_rkey[mr->rkey] = mr;
+  return mr;
+}
+static inline int ibv_dereg_mr(ibv_mr *mr) {
+  auto &f = tpr_mock_fabric::get();
+  std::lock_guard<std::mutex> lk(f.mu);
+  f.mrs_by_rkey.erase(mr->rkey);
+  delete mr;
+  return 0;
+}
+
+static inline ibv_qp *ibv_create_qp(ibv_pd *pd, ibv_qp_init_attr *ia) {
+  if (ia->qp_type != IBV_QPT_RC) return nullptr;
+  auto &f = tpr_mock_fabric::get();
+  std::lock_guard<std::mutex> lk(f.mu);
+  return new ibv_qp{pd, ia->send_cq, f.next_qpn++, IBV_QPS_RESET, 0};
+}
+static inline int ibv_destroy_qp(ibv_qp *qp) {
+  delete qp;
+  return 0;
+}
+static inline int ibv_modify_qp(ibv_qp *qp, ibv_qp_attr *a, int mask) {
+  if (!(mask & IBV_QP_STATE)) return -1;
+  // order-check the bring-up: the skeleton must walk RESET->INIT->RTR->RTS
+  switch (a->qp_state) {
+    case IBV_QPS_INIT:
+      if (qp->state != IBV_QPS_RESET) return -1;
+      if (!(mask & IBV_QP_ACCESS_FLAGS) ||
+          !(a->qp_access_flags & IBV_ACCESS_REMOTE_WRITE))
+        return -1;
+      break;
+    case IBV_QPS_RTR:
+      if (qp->state != IBV_QPS_INIT) return -1;
+      if (!(mask & IBV_QP_DEST_QPN)) return -1;
+      qp->dest_qp_num = a->dest_qp_num;
+      break;
+    case IBV_QPS_RTS:
+      if (qp->state != IBV_QPS_RTR) return -1;
+      break;
+    default:
+      return -1;
+  }
+  qp->state = a->qp_state;
+  return 0;
+}
+
+static inline int ibv_post_send(ibv_qp *qp, ibv_send_wr *wr,
+                                ibv_send_wr **bad) {
+  if (qp->state != IBV_QPS_RTS) {
+    if (bad) *bad = wr;
+    return -1;
+  }
+  auto &f = tpr_mock_fabric::get();
+  for (; wr; wr = wr->next) {
+    if (wr->opcode != IBV_WR_RDMA_WRITE) {
+      if (bad) *bad = wr;
+      return -1;
+    }
+    int status = IBV_WC_SUCCESS;
+    {
+      std::lock_guard<std::mutex> lk(f.mu);
+      auto it = f.mrs_by_rkey.find(wr->wr.rdma.rkey);
+      uint64_t off = 0;
+      ibv_mr *mr = it == f.mrs_by_rkey.end() ? nullptr : it->second;
+      if (mr) off = wr->wr.rdma.remote_addr - (uint64_t)(uintptr_t)mr->addr;
+      uint64_t total = 0;
+      for (int i = 0; i < wr->num_sge; ++i) total += wr->sg_list[i].length;
+      if (!mr || off > mr->length || total > mr->length - off) {
+        status = IBV_WC_REM_ACCESS_ERR;  // bad rkey/bounds: NIC would NAK
+      } else {
+        uint8_t *dst = (uint8_t *)mr->addr + off;
+        for (int i = 0; i < wr->num_sge; ++i) {
+          memcpy(dst, (const void *)(uintptr_t)wr->sg_list[i].addr,
+                 wr->sg_list[i].length);
+          dst += wr->sg_list[i].length;
+        }
+      }
+    }
+    if (wr->send_flags & IBV_SEND_SIGNALED) {
+      std::lock_guard<std::mutex> lk(qp->send_cq->mu);
+      qp->send_cq->completions.push(ibv_wc{wr->wr_id, status});
+    }
+  }
+  return 0;
+}
+
+static inline int ibv_poll_cq(ibv_cq *cq, int max, ibv_wc *wc) {
+  std::lock_guard<std::mutex> lk(cq->mu);
+  int n = 0;
+  while (n < max && !cq->completions.empty()) {
+    wc[n++] = cq->completions.front();
+    cq->completions.pop();
+  }
+  return n;
+}
+
+#endif  // TPURPC_TESTS_MOCK_VERBS_H

@@ -1,0 +1,130 @@
+"""Verbs (RDMA NIC) domain skeleton: availability contract + the full
+one-sided call sequence proven against the in-process mock fabric.
+
+The environment has no IB hardware, so the REAL branch of
+``native/src/verbs_domain.cc`` is compiled here against
+``tests/mock_verbs/infiniband/verbs.h`` — a registry-backed verbs subset
+whose RDMA WRITE is a bounds/rkey-checked memcpy and whose QP transitions
+are order-checked (RESET→INIT→RTR→RTS). That proves the skeleton's call
+sequence and the Python domain's Region/Window wiring end-to-end; the
+default build's stubs prove the honest-unavailability contract.
+Reference analogs: ``ibverbs/pair.cc`` bring-up + postWrite,
+``buffer.h``/``memory_region.h``.
+"""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MOCK_LIB = os.path.join(ROOT, "native", "build", "libtpurpc_verbs_mock.so")
+
+
+def _build_mock_lib():
+    gxx = shutil.which("g++")
+    if gxx is None:
+        pytest.skip("no g++ toolchain")
+    src = os.path.join(ROOT, "native", "src", "verbs_domain.cc")
+    mock_inc = os.path.join(ROOT, "tests", "mock_verbs")
+    deps = [src, os.path.join(mock_inc, "infiniband", "verbs.h")]
+    if (os.path.exists(MOCK_LIB)
+            and all(os.path.getmtime(MOCK_LIB) > os.path.getmtime(d)
+                    for d in deps)):
+        return
+    os.makedirs(os.path.dirname(MOCK_LIB), exist_ok=True)
+    subprocess.run(
+        [gxx, "-std=c++17", "-O2", "-shared", "-fPIC",
+         "-DTPR_TEST_MOCK_VERBS", f"-I{mock_inc}", src, "-o", MOCK_LIB],
+        check=True, timeout=180, capture_output=True)
+
+
+def _fresh_domain_module(monkeypatch, lib_path=None):
+    """verbs.py caches its ctypes lib process-wide; point it somewhere
+    specific and reset the cache for this test."""
+    import tpurpc.core.verbs as verbs
+
+    if lib_path is not None:
+        monkeypatch.setenv("TPURPC_VERBS_LIB", lib_path)
+    else:
+        monkeypatch.delenv("TPURPC_VERBS_LIB", raising=False)
+    monkeypatch.setattr(verbs, "_LIB", None)
+    return verbs
+
+
+def test_stub_build_reports_unavailable_cleanly(monkeypatch):
+    """Default libtpurpc.so (no <infiniband/verbs.h> in this image): the
+    domain must raise a RuntimeError NAMING the missing capability, never
+    fake placement."""
+    lib = os.path.join(ROOT, "native", "build", "libtpurpc.so")
+    if not os.path.exists(lib):
+        pytest.skip("native lib not built")
+    verbs = _fresh_domain_module(monkeypatch, lib)
+    with pytest.raises(RuntimeError, match="libibverbs|RDMA NIC"):
+        verbs.VerbsDomain()
+    # the make_domain("verbs") spelling surfaces the same error
+    from tpurpc.core.pair import make_domain
+
+    with pytest.raises(RuntimeError, match="libibverbs|RDMA NIC"):
+        make_domain("verbs")
+
+
+def test_one_sided_write_through_mock_fabric(monkeypatch):
+    """alloc → reg_mr + QP; open_window → QP bring-up (order-checked by
+    the mock) + RDMA WRITE; bytes LAND in the registered region with zero
+    receiver involvement — the skeleton's whole reason to exist."""
+    _build_mock_lib()
+    verbs = _fresh_domain_module(monkeypatch, MOCK_LIB)
+    dom = verbs.VerbsDomain()
+    region = dom.alloc(4096)
+    try:
+        assert region.handle.startswith("verbs:")
+        win = dom.open_window(region.handle, 4096)
+        try:
+            win.write(0, b"nic-placed")
+            win.write(1000, b"\x01\x02\x03\x04")
+            assert bytes(region.buf[:10]) == b"nic-placed"
+            assert bytes(region.buf[1000:1004]) == b"\x01\x02\x03\x04"
+            # bounds violations are NAK'd (mock: IBV_WC_REM_ACCESS_ERR),
+            # surfaced as an error — never a silent wild write
+            with pytest.raises((IndexError, OSError)):
+                win.write(4090, b"overruns-the-region")
+            # the writer exposes its attrs for the reverse RC leg; the
+            # region owner installs them (real hardware requires this
+            # before the first WRITE; the mock just order-checks it)
+            qpn, lid, gid, psn = win.writer_attrs
+            dom.accept_writer(region.handle, qpn, lid, gid, psn)
+        finally:
+            win.close()
+    finally:
+        region.close()
+    # region closed: its handle is gone
+    with pytest.raises(KeyError):
+        dom.accept_writer(region.handle, 0, 0, b"\x00" * 16, 0)
+    dom.close()  # regions first, then the device context (teardown order)
+    dom.close()  # idempotent
+
+
+def test_window_rejects_foreign_and_oversized_handles(monkeypatch):
+    _build_mock_lib()
+    verbs = _fresh_domain_module(monkeypatch, MOCK_LIB)
+    dom = verbs.VerbsDomain()
+    with pytest.raises(ValueError):
+        dom.open_window("shm:abcdef", 64)
+    region = dom.alloc(1024)
+    try:
+        with pytest.raises(ValueError):
+            dom.open_window(region.handle, 4096)  # window > region
+        # the nbytes arg is ENFORCED per write, not open-time decoration:
+        # a 64-byte window on a 1KB region must reject writes past 64
+        win = dom.open_window(region.handle, 64)
+        try:
+            win.write(0, b"ok")
+            with pytest.raises(IndexError):
+                win.write(60, b"spills-past-the-window")
+        finally:
+            win.close()
+    finally:
+        region.close()
+        dom.close()

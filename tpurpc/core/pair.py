@@ -266,6 +266,13 @@ _DOMAINS: Dict[str, Callable[[], MemoryDomain]] = {
     "shm": ShmDomain,
 }
 
+#: domains that register themselves on first import — tcp_window because
+#: its import starts background machinery (a record server), verbs (the
+#: RDMA-NIC skeleton) because construction raises a clear RuntimeError
+#: where libibverbs is unavailable
+_LAZY_DOMAINS = {"tcp_window": "tpurpc.core.tcpw",
+                 "verbs": "tpurpc.core.verbs"}
+
 
 def register_domain(kind: str, factory: Callable[[], MemoryDomain]) -> None:
     """Extension point the TPU domain uses (``tpurpc.tpu``)."""
@@ -276,8 +283,10 @@ def make_domain(kind: str) -> MemoryDomain:
     """Instantiate a registered domain by name (the ``TPURPC_RING_DOMAIN``
     dispatch). ``tcp_window`` registers lazily on first use — it is the only
     domain whose import starts background machinery (a record server)."""
-    if kind not in _DOMAINS and kind == "tcp_window":
-        import tpurpc.core.tcpw  # noqa: F401  (registers itself)
+    if kind not in _DOMAINS and kind in _LAZY_DOMAINS:
+        import importlib
+
+        importlib.import_module(_LAZY_DOMAINS[kind])  # registers itself
     factory = _DOMAINS.get(kind)
     if factory is None:
         raise ValueError(f"unknown ring domain {kind!r} "
