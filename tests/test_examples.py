@@ -69,3 +69,13 @@ def test_xds_demo_example():
                          capture_output=True, text=True, timeout=200)
     assert out.returncode == 0, out.stderr
     assert "traffic followed the control plane" in out.stdout
+
+
+def test_service_config_demo_example():
+    """Resolver-delivered per-method retry/timeout (gRFC A2/A6 shape)."""
+    out = subprocess.run([sys.executable, "examples/service_config_demo.py"],
+                         capture_output=True, text=True, timeout=200)
+    assert out.returncode == 0, out.stderr
+    assert "ok after 3 attempts" in out.stdout
+    assert "DEADLINE_EXCEEDED" in out.stdout
+    assert "done" in out.stdout
