@@ -44,7 +44,6 @@ from __future__ import annotations
 import json
 import os
 import threading
-import time
 from typing import Dict, List, Optional, Sequence
 
 METHOD = "/tpurpc.xds.v1.Ads/Stream"
