@@ -353,3 +353,48 @@ def test_aio_over_ring_platform_round4_planes(monkeypatch):
     finally:
         srv.stop(grace=0)
         config_mod.set_config(None)
+
+
+def test_aio_channel_honors_resolver_service_config():
+    """Round-5 service config reaches the aio surface: the aio channel
+    wraps the sync core, so a resolver-delivered retryPolicy retries a
+    flaky method transparently from async call sites too."""
+    import threading
+
+    from tpurpc.rpc import resolver as resolver_mod
+    from tpurpc.rpc.resolver import Resolution, register_resolver
+
+    calls = {"n": 0}
+    lock = threading.Lock()
+
+    async def flaky(req, ctx):
+        with lock:
+            calls["n"] += 1
+            n = calls["n"]
+        if n <= 2:
+            raise AbortError(StatusCode.UNAVAILABLE, "flaky")
+        return b"ok-aio"
+
+    cfg = {"methodConfig": [{
+        "name": [{"service": "a.S", "method": "Flaky"}],
+        "retryPolicy": {"maxAttempts": 4, "initialBackoff": "0.01s",
+                        "maxBackoff": "0.05s", "backoffMultiplier": 2,
+                        "retryableStatusCodes": ["UNAVAILABLE"]}}]}
+
+    async def main():
+        srv = aio.Server(max_workers=4)
+        srv.add_method("/a.S/Flaky", aio.unary_unary_rpc_method_handler(flaky))
+        port = srv.add_insecure_port("127.0.0.1:0")
+        await srv.start()
+        register_resolver("aiocfg",
+                          lambda rest: Resolution([("127.0.0.1", port)], cfg))
+        try:
+            async with aio.insecure_channel("aiocfg:///x") as ch:
+                out = await ch.unary_unary("/a.S/Flaky")(b"", timeout=20)
+                assert out == b"ok-aio"
+                assert calls["n"] == 3  # 2 failures + 1 success, all config
+        finally:
+            resolver_mod._RESOLVERS.pop("aiocfg", None)
+            await srv.stop()
+
+    _run(main())
