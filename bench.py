@@ -548,8 +548,10 @@ def main() -> None:
 
     fallback = False
     fallback_reason = "accelerator bring-up failed; reran on cpu"
-    # Pre-probe (≤60 s) instead of paying ready_s for a dead tunnel; the
-    # reclaimed minutes buy more timed rounds (noise, the actual r4 weakness).
+    # Pre-probe (free via a fresh harvest-log verdict; else ≤150 s, the
+    # bound shared with harvest.sh's probe) instead of paying ready_s for
+    # a dead tunnel; the reclaimed minutes buy more timed rounds (noise,
+    # the actual r4 weakness).
     if (env.get("TPURPC_BENCH_CPU") != "1"
             and env.get("TPURPC_BENCH_PROBE", "1") == "1"
             and env.get("PALLAS_AXON_POOL_IPS")

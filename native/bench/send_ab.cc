@@ -90,8 +90,9 @@ int main(int argc, char **argv) {
         continue;
       }
       // half-close and wait for the sink's ack so every byte is DRAINED
-      // (otherwise the timer would stop while the ring still holds data)
-      tpr_call_send(c, nullptr, 0, 1);
+      // (otherwise the timer would stop while the ring still holds data);
+      // writes_done sends the pure half-close marker, NOT an empty message
+      tpr_call_writes_done(c);
       uint8_t *resp;
       size_t rlen;
       if (tpr_call_recv(c, &resp, &rlen) == 1) tpr_buf_free(resp);

@@ -128,3 +128,16 @@ def test_window_rejects_foreign_and_oversized_handles(monkeypatch):
     finally:
         region.close()
         dom.close()
+
+
+def test_domain_close_with_live_region_is_safe(monkeypatch):
+    """close() tears down leftover regions FIRST (a PD with live MRs can't
+    dealloc on real hardware); the region's own later close() must then
+    be a no-op, not a double free."""
+    _build_mock_lib()
+    verbs = _fresh_domain_module(monkeypatch, MOCK_LIB)
+    dom = verbs.VerbsDomain()
+    region = dom.alloc(512)
+    dom.close()      # region still open: domain reaps it
+    region.close()   # no-op now (registry pop already happened)
+    dom.close()      # idempotent

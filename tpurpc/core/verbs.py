@@ -124,10 +124,18 @@ class VerbsDomain(MemoryDomain):
         self._regions: Dict[str, Tuple[int, int]] = {}
 
     def close(self) -> None:
-        """Release the device context (PD + CQ + device). Close REGIONS
-        first (Region.close derefs MRs/QPs; real hardware refuses to
-        dealloc a PD with live MRs) — mirroring the teardown order every
-        other domain documents. Idempotent."""
+        """Release the device context (PD + CQ + device). Still-open
+        regions are torn down FIRST (real hardware refuses to dealloc a
+        PD with live MRs — closing the ctx under them would leak the
+        pinned memory and leave Region.close poking freed state); their
+        later Region.close() calls become no-ops via the registry pop.
+        Idempotent."""
+        with self._lock:
+            leftovers = list(self._regions.items())
+            self._regions.clear()
+        for _handle, (mr, qp) in leftovers:
+            self._lib.tpr_verbs_qp_destroy(qp)
+            self._lib.tpr_verbs_dereg(mr)
         ctx, self._ctx = self._ctx, None
         if ctx:
             self._lib.tpr_verbs_close(ctx)
