@@ -192,6 +192,32 @@ def main() -> int:
         zc["error"] = f"{type(exc).__name__}: {exc}"
     out["zero_copy"] = zc
 
+    # -- 4. round-5 receive path on THIS backend ---------------------------
+    # HbmRing.view's dlpack-alias path is gated to CPU-backed platforms
+    # (tpu/hbm_ring.py); on a real chip it must DECLINE (fall back to the
+    # materializing slice, billed dma_d2d) because the host-pointer alias
+    # has no meaning for HBM. Record which branch actually ran + the
+    # ledger's verdict, so the on-chip artifact documents the behavior
+    # instead of leaving it inferred.
+    hv = {}
+    try:
+        from tpurpc.tpu import HbmRing, ledger
+
+        ring = HbmRing(1 << 14, device=dev)
+        off, n = ring.place(np.arange(1024, dtype=np.float32))
+        with ledger.track() as w:
+            lease = ring.view(off, n, np.float32, (1024,))
+        hv["view_aliased"] = bool(lease.aliased)
+        hv["ledger_zero_copy"] = w["zero_copy"]
+        hv["ledger_dma_d2d"] = w["dma_d2d"]
+        np.testing.assert_array_equal(
+            np.asarray(lease.array), np.arange(1024, dtype=np.float32))
+        hv["view_bytes_correct"] = True
+        lease.release()
+    except Exception as exc:
+        hv["error"] = f"{type(exc).__name__}: {exc}"
+    out["hbm_view"] = hv
+
     out["ok"] = "error" not in kern and "error" not in link
     out["on_chip"] = on_chip
     out["total_s"] = round(_now() - t0, 1)
