@@ -29,6 +29,8 @@
 #include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <pthread.h>
+#include <sched.h>
 #include <sys/epoll.h>
 #include <unistd.h>
 
@@ -810,9 +812,22 @@ void tpr_server_register_default(tpr_server *s, tpr_handler_fn fn, void *ud) {
   s->default_ud = ud;
 }
 
+// GRPC_RDMA_AFFINITY / TPURPC_AFFINITY: pin poller i to core i % ncores.
+// The reference PARSES this knob but never consumes it (rdma_utils.h:72-73
+// is_affinity has zero call sites); here it actually pins — on multicore
+// hosts a wandering poller pays cache/TLB refills every migration, the
+// cost the round-5 scalability profile measured as per-RPC cycle growth.
+static bool affinity_from_env() {
+  const char *v = getenv("TPURPC_AFFINITY");
+  if (!v) v = getenv("GRPC_RDMA_AFFINITY");
+  return v != nullptr && (v[0] == '1' || strcmp(v, "true") == 0);
+}
+
 int tpr_server_start(tpr_server *s) {
   s->running.store(true);
   int np = tpr_server::poller_count_from_env();
+  bool pin = affinity_from_env();
+  unsigned ncores = std::thread::hardware_concurrency();
   for (int i = 0; i < np; ++i) {
     auto *p = new Poller();
     if (!p->init()) {
@@ -821,6 +836,13 @@ int tpr_server_start(tpr_server *s) {
     }
     p->srv = s;
     p->th = std::thread([p] { p->loop(); });
+    if (pin && ncores > 0) {
+      cpu_set_t set;
+      CPU_ZERO(&set);
+      CPU_SET(i % ncores, &set);
+      // best effort: a denied setaffinity (cgroup mask) is not an error
+      pthread_setaffinity_np(p->th.native_handle(), sizeof set, &set);
+    }
     s->pollers.push_back(p);
   }
   s->accept_thread = std::thread([s] { s->accept_loop(); });
