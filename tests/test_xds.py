@@ -311,3 +311,36 @@ def test_xds_watcher_keeps_last_assignment_on_control_plane_loss(monkeypatch):
             ch.close()
     finally:
         b1.stop(grace=0)
+
+
+def test_ads_v3_decoder_robust_to_garbage():
+    """Truncation raises ValueError (protowire's corruption contract);
+    unknown fields and foreign Any types are skipped, never crashes —
+    a real control plane populates far more of these messages than the
+    subset tpurpc consumes."""
+    import random
+
+    from tpurpc.rpc import xds_v3
+    from tpurpc.wire.protowire import encode_varint, ld, vf
+
+    rng = random.Random(5)
+    for _ in range(200):
+        blob = bytes(rng.randrange(256) for _ in range(rng.randrange(64)))
+        try:
+            xds_v3.decode_discovery_response(blob)
+            xds_v3.decode_discovery_request(blob)
+            xds_v3.decode_cluster_load_assignment(blob)
+        except ValueError:
+            pass  # truncation/corruption: the documented loud outcome
+    # unknown fields interleaved with known ones decode fine — including
+    # a multi-byte tag (field 1000, what a future envoy proto could use)
+    big_tag_field = encode_varint((1000 << 3) | 0) + encode_varint(5)
+    body = (ld(1, b"v9") + vf(29, 7) + ld(30, b"future-field")
+            + big_tag_field
+            + ld(5, b"n1") + ld(4, xds_v3.CLA_TYPE_URL.encode()))
+    out = xds_v3.decode_discovery_response(body)
+    assert out["version_info"] == "v9" and out["nonce"] == "n1"
+    # a non-CLA Any resource is skipped, not an error
+    foreign = ld(2, ld(1, b"type.googleapis.com/envoy.Listener") + ld(2, b"x"))
+    out = xds_v3.decode_discovery_response(foreign + ld(5, b"n2"))
+    assert out["assignments"] == {} and out["nonce"] == "n2"
