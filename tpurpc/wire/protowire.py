@@ -37,8 +37,14 @@ def decode_varint(data: bytes, pos: int) -> Tuple[int, int]:
 
 
 def ld(field_no: int, payload: bytes) -> bytes:
-    """A length-delimited (wire type 2) field."""
-    return bytes([(field_no << 3) | 2]) + encode_varint(len(payload)) + payload
+    """A length-delimited (wire type 2) field.
+
+    The tag is a VARINT like any other (a raw ``bytes([tag])`` is invalid
+    past field 15 — tag ≥ 128 sets the continuation bit and the decoder
+    eats the length byte as tag continuation; latent until a field number
+    ≥ 16 exists, caught by the xds_v3 fuzz test)."""
+    return encode_varint((field_no << 3) | 2) + encode_varint(
+        len(payload)) + payload
 
 
 def vf(field_no: int, value: int) -> bytes:
