@@ -827,7 +827,19 @@ int tpr_server_start(tpr_server *s) {
   s->running.store(true);
   int np = tpr_server::poller_count_from_env();
   bool pin = affinity_from_env();
-  unsigned ncores = std::thread::hardware_concurrency();
+  // Pin within the process's ALLOWED set, not raw core ids: under a
+  // cpuset/taskset restriction (cores 60-63, say) CPU_SET(i % ncores)
+  // would target forbidden cores and the knob would silently no-op in
+  // exactly the containerized deployments that need it.
+  std::vector<int> allowed;
+  if (pin) {
+    cpu_set_t proc_set;
+    CPU_ZERO(&proc_set);
+    if (sched_getaffinity(0, sizeof proc_set, &proc_set) == 0) {
+      for (int c = 0; c < CPU_SETSIZE; ++c)
+        if (CPU_ISSET(c, &proc_set)) allowed.push_back(c);
+    }
+  }
   for (int i = 0; i < np; ++i) {
     auto *p = new Poller();
     if (!p->init()) {
@@ -836,11 +848,11 @@ int tpr_server_start(tpr_server *s) {
     }
     p->srv = s;
     p->th = std::thread([p] { p->loop(); });
-    if (pin && ncores > 0) {
+    if (pin && !allowed.empty()) {
       cpu_set_t set;
       CPU_ZERO(&set);
-      CPU_SET(i % ncores, &set);
-      // best effort: a denied setaffinity (cgroup mask) is not an error
+      CPU_SET(allowed[i % allowed.size()], &set);
+      // best effort: a denied setaffinity is not an error
       pthread_setaffinity_np(p->th.native_handle(), sizeof set, &set);
     }
     s->pollers.push_back(p);
