@@ -24,5 +24,15 @@ OUT=bench/results/send_ab_1core.log
   GRPC_PLATFORM_TYPE=RDMA_BP timeout 120 "$BIN" 3
   echo "## repeat (weather control)"
   GRPC_PLATFORM_TYPE=RDMA_BP timeout 120 "$BIN" 3
+  echo "#"
+  echo "# == varying ring size (the reference's varying-rb-size axis:"
+  echo "# draw/varying-rb-size-old/client_bandwidth_RDMA_BP_cli_4_req_131072_ringbuf_2048"
+  echo "# = 82.6 Gb/s on IB EDR; here 128KB messages through the shm ring"
+  echo "# on one shared core, staging mode = the comparable configuration) =="
+  for rb in 1024 2048 8192 32768; do
+    echo "## platform=RDMA_BP ring_kb=$rb req_size=131072"
+    GRPC_PLATFORM_TYPE=RDMA_BP GRPC_RDMA_RING_BUFFER_SIZE_KB=$rb \
+      timeout 120 "$BIN" 2 131072
+  done
 } | tee "$OUT"
 echo "wrote $OUT"

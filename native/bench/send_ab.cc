@@ -43,6 +43,7 @@ static void produce(uint8_t *dst, size_t len, uint8_t salt) {
 
 int main(int argc, char **argv) {
   double secs = argc > 1 ? atof(argv[1]) : 3.0;
+  size_t only_size = argc > 2 ? (size_t)atoll(argv[2]) : 0;  // 0 = all
 
   tpr_server *srv = tpr_server_create(0);
   if (!srv) return 1;
@@ -52,6 +53,7 @@ int main(int argc, char **argv) {
 
   const size_t sizes[] = {16 * 1024, 128 * 1024, 1024 * 1024};
   for (size_t size : sizes) {
+    if (only_size && size != only_size) continue;
     for (int mode = 0; mode < 2; ++mode) {  // 0 = A staging, 1 = B lease
       tpr_channel *ch = tpr_channel_create("127.0.0.1", port, 5000);
       if (!ch) return 1;
