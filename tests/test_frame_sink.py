@@ -88,3 +88,22 @@ def test_many_segment_gather_write_survives_iov_max():
     finally:
         a.close()
         b.close()
+
+
+def test_metadata_and_header_parsers_never_crash_on_garbage():
+    """Wire-facing parsers must fail LOUDLY-BUT-TYPED on hostile bytes
+    (FrameError — the reader turns it into a connection error), never
+    with an unexpected exception class a dispatcher wouldn't catch."""
+    import random
+
+    from tpurpc.rpc import frame as fr
+
+    rng = random.Random(11)
+    for _ in range(300):
+        blob = bytes(rng.randrange(256) for _ in range(rng.randrange(96)))
+        for parse in (fr.decode_metadata, fr.parse_headers,
+                      fr.parse_trailers):
+            try:
+                parse(blob)
+            except fr.FrameError:
+                pass  # the documented loud-but-typed outcome
