@@ -320,3 +320,45 @@ def test_update_service_config_reconfigures_live_channel():
                     .retry_policy is not None)
     finally:
         srv.stop(grace=0)
+
+
+def test_grpc_service_config_channel_option():
+    """grpcio drop-in parity: options=[("grpc.service_config", json)] is
+    the FALLBACK config — applied when the resolver delivers none,
+    IGNORED when it does (gRPC documents GRPC_ARG_SERVICE_CONFIG as
+    ignored when the name resolver returns a service config)."""
+    import json
+
+    flaky = _Flaky(fail=2)
+    srv, port = _server({"/cfg.Svc/Flaky": flaky})
+    try:
+        # no resolver config: the option applies
+        with Channel(f"127.0.0.1:{port}",
+                     options=[("grpc.service_config",
+                               json.dumps(RETRY_CFG))]) as ch:
+            assert bytes(ch.unary_unary("/cfg.Svc/Flaky")(
+                b"p", timeout=10)) == b"p"
+            assert flaky.calls == 3  # retried per the option's config
+        # resolver DELIVERS a config: the resolver wins, the option is
+        # ignored. The resolver's config has no retry for this method
+        # (service-level entry with timeout only), the option's would
+        # retry — so a single attempt proves the resolver governed.
+        resolver_cfg = {"methodConfig": [{
+            "name": [{"service": "cfg.Svc"}], "timeout": "5s"}]}
+        register_resolver(
+            "svcopt", lambda rest: Resolution([("127.0.0.1", port)],
+                                              resolver_cfg))
+        try:
+            flaky2 = _Flaky(fail=10 ** 6)
+            srv.add_method("/cfg.Svc/Flaky",  # replace with always-flaky
+                           unary_unary_rpc_method_handler(flaky2))
+            with Channel("svcopt:///x",
+                         options=[("grpc.service_config",
+                                   json.dumps(RETRY_CFG))]) as ch:
+                with pytest.raises(RpcError):
+                    ch.unary_unary("/cfg.Svc/Flaky")(b"p", timeout=10)
+                assert flaky2.calls == 1  # resolver won: no retries
+        finally:
+            resolver_mod._RESOLVERS.pop("svcopt", None)
+    finally:
+        srv.stop(grace=0)

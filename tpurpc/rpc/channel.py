@@ -677,6 +677,13 @@ class Channel:
                 lb_policy = opt["grpc.lb_policy_name"]
             if compression is None:
                 compression = opt.get("grpc.default_compression_algorithm")
+            #: grpcio's service-config channel arg: a JSON FALLBACK used
+            #: only when the resolver delivers no config (gRPC documents
+            #: GRPC_ARG_SERVICE_CONFIG as ignored when the resolver
+            #: returns one; the resolver wins)
+            self._svc_cfg_fallback = opt.get("grpc.service_config")
+        else:
+            self._svc_cfg_fallback = None
         # Message compression on the tpurpc framing (FLAG_COMPRESSED; the
         # h2 wire negotiates grpc-encoding separately): requests compress,
         # tpurpc servers mirror on responses. The framing's one codec is
@@ -730,6 +737,8 @@ class Channel:
         else:
             self._addrs = None  # injected factory: membership is fixed
             factories = [endpoint_factory]
+        if self._service_config is None and self._svc_cfg_fallback is not None:
+            self.update_service_config(self._svc_cfg_fallback)
         self._subchannels = [_Subchannel(f, self) for f in factories]
         self._policy = make_policy(lb_policy, len(self._subchannels))
         self._lock = threading.Lock()  # guards _closed
