@@ -261,6 +261,18 @@ def _serving_phase(port: int, model: str, img: int):
     done = [0] * n_clients
     start = threading.Barrier(n_clients + 1)
 
+    # Serving client discipline (round 5, interleaved same-weather A/B):
+    # 8 BLOCKING clients on inline-read channels beat 8 CQ-futures clients
+    # at depth 4 by 10-29% (883-947 vs 674-735 QPS) — the CQ puller
+    # thread's wake chain costs more than pipelining recovers on this
+    # shared core (the same reader-thread result the scalability profile
+    # measured). Default: depth 1 + inline; TPURPC_BENCH_CLIENT_DEPTH>1
+    # restores the CQ pipeline (which needs the reader thread).
+    try:
+        depth_env = int(os.environ.get("TPURPC_BENCH_CLIENT_DEPTH", "1"))
+    except ValueError:
+        depth_env = 1
+
     def _make_channel():
         # NativeChannel (ctypes over libtpurpc.so) when available: the
         # closed-loop client's per-call overhead is part of the measured
@@ -270,18 +282,20 @@ def _serving_phase(port: int, model: str, img: int):
             try:
                 from tpurpc.rpc.native_client import NativeChannel
 
-                return NativeChannel("127.0.0.1", port)
+                return NativeChannel("127.0.0.1", port,
+                                     inline_read=depth_env <= 1)
             except Exception:
                 pass  # lib missing/unbuildable: pure-Python path
         return Channel(f"127.0.0.1:{port}")
 
-    # In-flight calls per client (TPURPC_BENCH_CLIENT_DEPTH): >1 pipelines
-    # through the native CQ futures path so the batcher sees
-    # clients*depth outstanding requests — fuller batches when per-call
-    # latency (h2d, tunnel) dominates. Measured +36% QPS at depth 4 on the
-    # CPU path; recorded in the bench JSON (serving_client_depth) since
-    # earlier rounds ran the depth-1 closed loop.
-    depth = int(os.environ.get("TPURPC_BENCH_CLIENT_DEPTH", "4"))
+    # In-flight calls per client: >1 pipelines through the native CQ
+    # futures path so the batcher sees clients*depth outstanding requests.
+    # History: round 4 measured +36% at depth 4 over depth-1-with-reader;
+    # round 5's wake-chain findings flipped it — depth 1 on INLINE-READ
+    # channels (no reader, no CQ puller) wins by 10-29% same-weather, so
+    # it is the default (the artifact's serving_client_depth records what
+    # ran; r4 artifacts carry depth 4).
+    depth = depth_env
 
     used_depth = [1] * n_clients  # what each client ACTUALLY ran
 
