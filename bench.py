@@ -238,7 +238,7 @@ class _ServerProc:
             pass
 
 
-def _serving_phase(port: int, model: str, img: int):
+def _serving_phase(port: int, model: str, img: int, platform: str = "cpu"):
     """8-client fan-in (BASELINE config #4): concurrent image requests over
     independent connections, batched server-side into one jitted call.
     Returns (qps, model_name, n_requests); raises on failure.
@@ -262,16 +262,21 @@ def _serving_phase(port: int, model: str, img: int):
     start = threading.Barrier(n_clients + 1)
 
     # Serving client discipline (round 5, interleaved same-weather A/B):
-    # 8 BLOCKING clients on inline-read channels beat 8 CQ-futures clients
-    # at depth 4 by 10-29% (883-947 vs 674-735 QPS) — the CQ puller
-    # thread's wake chain costs more than pipelining recovers on this
-    # shared core (the same reader-thread result the scalability profile
-    # measured). Default: depth 1 + inline; TPURPC_BENCH_CLIENT_DEPTH>1
-    # restores the CQ pipeline (which needs the reader thread).
+    # on the CPU fallback 8 BLOCKING clients on inline-read channels beat
+    # 8 CQ-futures clients at depth 4 in 6 of 7 pairs, by 10-74% — the CQ
+    # puller's wake chain costs more than pipelining recovers on one
+    # shared core (the scalability profile's reader-thread result again).
+    # On an ACCELERATOR the per-call latency (h2d over the tunnel)
+    # dominates instead and pipelining is what keeps the batcher fed
+    # (round 4's +36%), so the platform picks the default:
+    # cpu -> depth 1 + inline; accelerator -> depth 4 + CQ.
+    # TPURPC_BENCH_CLIENT_DEPTH overrides either way.
+    default_depth = "1" if platform == "cpu" else "4"
     try:
-        depth_env = int(os.environ.get("TPURPC_BENCH_CLIENT_DEPTH", "1"))
+        depth_env = int(os.environ.get("TPURPC_BENCH_CLIENT_DEPTH",
+                                       default_depth))
     except ValueError:
-        depth_env = 1
+        depth_env = int(default_depth)
 
     def _make_channel():
         # NativeChannel (ctypes over libtpurpc.so) when available: the
@@ -448,7 +453,8 @@ def _run_once(env, n_msgs: int, ready_s: float):
                     extras["device_infer_qps"] = float(dev_qps)
                 except Exception:
                     pass
-                serving = _serving_phase(port_infer, model, int(img))
+                serving = _serving_phase(port_infer, model, int(img),
+                                         platform=platform)
             except Exception as exc:  # serving is auxiliary: report, don't fail
                 sys.stderr.write(f"serving phase failed: {exc}\n")
         return total / dt / 1e9, platform, serving, extras
