@@ -272,11 +272,10 @@ def _serving_phase(port: int, model: str, img: int, platform: str = "cpu"):
     # cpu -> depth 1 + inline; accelerator -> depth 4 + CQ.
     # TPURPC_BENCH_CLIENT_DEPTH overrides either way.
     default_depth = "1" if platform == "cpu" else "4"
-    try:
-        depth_env = int(os.environ.get("TPURPC_BENCH_CLIENT_DEPTH",
-                                       default_depth))
-    except ValueError:
-        depth_env = int(default_depth)
+    # a malformed override must FAIL (the phase reports it), not silently
+    # benchmark the platform default as if the operator's depth ran
+    depth_env = int(os.environ.get("TPURPC_BENCH_CLIENT_DEPTH",
+                                   default_depth))
 
     def _make_channel():
         # NativeChannel (ctypes over libtpurpc.so) when available: the
@@ -303,10 +302,19 @@ def _serving_phase(port: int, model: str, img: int, platform: str = "cpu"):
     depth = depth_env
 
     used_depth = [1] * n_clients  # what each client ACTUALLY ran
+    #: channel discipline each client ACTUALLY got — depth-1 artifacts are
+    #: only cross-round comparable within one mode (inline vs reader vs
+    #: python differ 10-74%, the whole point of the round-5 default)
+    used_mode = ["python"] * n_clients
 
     def client(idx: int):
         try:
             with _make_channel() as ch:
+                from tpurpc.rpc.native_client import NativeChannel as _NC
+
+                if isinstance(ch, _NC):
+                    used_mode[idx] = ("native-inline" if ch.inline_read
+                                      else "native-reader")
                 cli = TensorClient(ch)
                 cli.call("Infer", {"x": image}, timeout=300)  # per-conn warm
                 futures_fn = None
@@ -364,7 +372,11 @@ def _serving_phase(port: int, model: str, img: int, platform: str = "cpu"):
                            "timeout; qps would be measured on a racing "
                            "partial count")
     total = sum(done)
-    return total / dt, model, total, max(used_depth)
+    # one mode in practice (all clients build identically); report the set
+    # defensively so a mixed run is visible rather than mislabeled
+    modes = sorted(set(used_mode))
+    return (total / dt, model, total, max(used_depth),
+            modes[0] if len(modes) == 1 else ",".join(modes))
 
 
 def _run_once(env, n_msgs: int, ready_s: float):
@@ -628,13 +640,16 @@ def main() -> None:
     if serving is not None:
         # BASELINE configs #4/#5 (8-client fan-in batching into a ResNet
         # server); the reference publishes no figure, so no vs_baseline.
-        qps, model, total, used_depth = serving
+        qps, model, total, used_depth, used_mode = serving
         out["serving_qps"] = round(qps, 1)
         out["serving_model"] = model
         out["serving_requests"] = total
-        # config provenance: the depth the phase ACTUALLY ran (1 when the
-        # pure-Python client path was in play); rounds 1-2 ran depth 1
+        # config provenance: the depth AND channel discipline the phase
+        # ACTUALLY ran (depth-1 artifacts are only comparable within one
+        # mode — native-inline vs native-reader vs python differ 10-74%);
+        # r1-r2 ran depth-1 reader/python, r4 depth-4 CQ
         out["serving_client_depth"] = used_depth
+        out["serving_client_mode"] = used_mode
         flops = extras.get("model_flops_per_inference")
         if flops:
             # MFU = achieved model FLOP/s ÷ chip peak. Two flavors:

@@ -369,9 +369,14 @@ class NativeChannel:
         if inline_read and hasattr(self._lib, "tpr_channel_create2"):
             self._ch = self._lib.tpr_channel_create2(
                 host.encode(), int(port), _timeout_ms(connect_timeout), 1)
+            #: what was ACTUALLY requested of the C loop (observability:
+            #: bench artifacts record the discipline; the old-.so fallback
+            #: below reports False even when inline was asked for)
+            self.inline_read = True
         else:
             self._ch = self._lib.tpr_channel_create(
                 host.encode(), int(port), _timeout_ms(connect_timeout))
+            self.inline_read = False
         if not self._ch:
             raise RpcError(StatusCode.UNAVAILABLE,
                            f"native connect to {host}:{port} failed")
