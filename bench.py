@@ -58,7 +58,13 @@ srv = Server(max_workers=8,
 port = srv.add_insecure_port("127.0.0.1:0")
 srv_infer = Server(max_workers=8)
 port_infer = srv_infer.add_insecure_port("127.0.0.1:0")
-print("PORT", port, port_infer, flush=True)  # bind first: cheap, can't hang
+# Python-dataplane sink for the batch-stats probe: when the MEASURED plane
+# is the native one (whose batching is C-side, invisible to the Python
+# counters), the client runs one short untimed stream against this server
+# so the artifact still carries real drain-batch histograms.
+srv_probe = Server(max_workers=2, native_dataplane=False)
+port_probe = srv_probe.add_insecure_port("127.0.0.1:0")
+print("PORT", port, port_infer, port_probe, flush=True)  # bind first
 
 # Backend bring-up OUTSIDE any RPC deadline. On the axon TPU tunnel this can
 # take minutes; the client waits for READY with its own wall budget.
@@ -101,9 +107,24 @@ def consume(req_iter):
             retire(inflight.popleft())
     while inflight:
         retire(inflight.popleft())
+    # Batched-pipeline observability (ISSUE 1): snapshot the cumulative
+    # batch histograms + wakeup counters at the end of every Sink stream.
+    # Printed BEFORE the final yield so the line is flushed before the
+    # client unblocks on the stream reply; the client picks the snapshot
+    # matching its last timed round by ordinal.
+    try:
+        import json as _json
+
+        from tpurpc.utils import stats as _st
+        print("BATCHSTATS", _json.dumps({"batch": _st.batch_snapshot(),
+                                         "counters": _st.counters_snapshot()}),
+              flush=True)
+    except Exception:
+        pass
     yield {"bytes": np.int64(total), "check": np.float64(float(checksum))}
 
 add_tensor_method(srv, "Sink", consume, kind="stream_stream")
+add_tensor_method(srv_probe, "Sink", consume, kind="stream_stream")
 
 # ---- serving flagship (BASELINE configs #4/#5): ResNet + fan-in batching --
 # Full ResNet-50 @224 on an accelerator; the thin-18 @64 stand-in on the CPU
@@ -162,11 +183,13 @@ if os.environ.get("TPURPC_BENCH_SERVING", "1") == "1":
 
 srv.start()
 srv_infer.start()
+srv_probe.start()
 print("DEVKIND", getattr(dev, "device_kind", dev.platform), flush=True)
 print("READY", dev.platform, ("serving" if batcher else "noserving"),
       flush=True)
 srv.wait_for_termination(timeout=1200)
 srv_infer.stop(grace=0)
+srv_probe.stop(grace=0)
 """
 
 
@@ -213,6 +236,24 @@ class _ServerProc:
                     raise TimeoutError(
                         f"server did not print '{prefix}' within {timeout}s\n"
                         f"{self.stderr_tail()}")
+                self._cond.wait(remain)
+
+    def nth_line(self, prefix: str, n: int, timeout: float):
+        """n-th (1-based) buffered line starting with ``prefix``, waiting up
+        to ``timeout`` for it to arrive; on timeout/EOF falls back to the
+        latest earlier match (or None). Unlike ``wait_line`` this never
+        raises — it serves auxiliary observability, not readiness."""
+        deadline = time.time() + timeout
+        with self._cond:
+            while True:
+                matches = [ln for ln in self._lines
+                           if ln is not None and ln.startswith(prefix)]
+                if len(matches) >= n:
+                    return matches[n - 1]
+                eof = bool(self._lines) and self._lines[-1] is None
+                remain = deadline - time.time()
+                if eof or remain <= 0:
+                    return matches[-1] if matches else None
                 self._cond.wait(remain)
 
     def stderr_tail(self, n=4000) -> str:
@@ -382,11 +423,20 @@ def _serving_phase(port: int, model: str, img: int, platform: str = "cpu"):
 def _run_once(env, n_msgs: int, ready_s: float):
     import numpy as np
 
+    # Round isolation for the client-side batch/wakeup counters (a fallback
+    # rerun must not inherit the dead first attempt's histograms).
+    try:
+        from tpurpc.utils import stats as _st
+        _st.reset_batch_stats()
+    except Exception:
+        pass
+
     srv = _ServerProc(env)
     try:
         port_line = srv.wait_line("PORT", 60).split()
         port = int(port_line[1])
         port_infer = int(port_line[2]) if len(port_line) > 2 else port
+        port_probe = int(port_line[3]) if len(port_line) > 3 else port
         ready = srv.wait_line("READY", ready_s)
         parts = ready.split()
         platform = parts[1]
@@ -445,10 +495,43 @@ def _run_once(env, n_msgs: int, ready_s: float):
             dt = kept[len(kept) // 2]  # median of kept
             globals()["_LAST_STREAM_DTS"] = dts  # full sorted detail for JSON
 
+        # Batch-pipeline observability (ISSUE 1): the server prints one
+        # cumulative BATCHSTATS snapshot per completed Sink stream —
+        # warmup is match #1, the last timed round is match rounds+1.
+        batch_stats: dict = {}
+        nstats = rounds + 1
+        if sink_native:
+            # The timed rounds rode the native C plane, whose batching isn't
+            # visible to the Python counters. One short UNTIMED pass on the
+            # instrumented Python plane (after the measurement) fills the
+            # histograms so the artifact can still attribute throughput to
+            # batch sizes; it is labeled as a probe, never the measurement.
+            try:
+                with Channel(f"127.0.0.1:{port_probe}") as pch:
+                    list(TensorClient(pch).duplex("Sink", gen(8),
+                                                  native=False, timeout=300))
+                nstats += 1
+                batch_stats["probe"] = "python-plane, 8 msgs, untimed"
+            except Exception:
+                pass
+        try:
+            line = srv.nth_line("BATCHSTATS", nstats, 10)
+            if line:
+                batch_stats["server"] = json.loads(line.split(" ", 1)[1])
+        except Exception:
+            pass
+        try:
+            from tpurpc.utils import stats as _st
+            batch_stats["client"] = {"batch": _st.batch_snapshot(),
+                                     "counters": _st.counters_snapshot()}
+        except Exception:
+            pass
+
         serving = None
         extras = {"stream_dts": [round(x, 3) for x in
                                  globals().get("_LAST_STREAM_DTS", [])],
-                  "calibration": calib}
+                  "calibration": calib,
+                  "batch_stats": batch_stats}
         try:
             extras["device_kind"] = srv.wait_line("DEVKIND", 5).split(
                 " ", 1)[1].strip()
@@ -637,6 +720,39 @@ def main() -> None:
         out["fallback_reason"] = fallback_reason
     if extras.get("stream_dts"):
         out["stream_round_secs"] = extras["stream_dts"]  # sorted; median used
+    # Batched receive pipeline (ISSUE 1): messages moved per receive-drain
+    # wakeup, and how often waiters were satisfied inside the busy window
+    # vs parked on fds. The drain happens on whichever side RECEIVES the
+    # bulk stream — the server for Sink — so prefer its histogram; the
+    # client-side one covers the ack path. A zero-count histogram means the
+    # measured plane was the native one (C-side batching, not instrumented
+    # by the Python counters) — the field is still emitted so rounds are
+    # comparable.
+    bs = extras.get("batch_stats") or {}
+    hist = {"count": 0, "mean": 0.0, "p50": 0, "p99": 0, "side": None}
+    for side in ("server", "client"):
+        h = ((bs.get(side) or {}).get("batch") or {}).get("ring_drain")
+        if h and h.get("count"):
+            hist = dict(h, side=side)
+            break
+    out["batch_msgs_per_wakeup"] = hist
+    merged: dict = {}
+    for side in ("server", "client"):
+        for name, v in ((bs.get(side) or {}).get("counters") or {}).items():
+            merged[name] = merged.get(name, 0) + v
+    waits = (merged.get("wait_spin_hit", 0) + merged.get("wait_spin_miss", 0)
+             + merged.get("wait_spin_skipped", 0))
+    out["poller_spin_sleep"] = {
+        "spin_hit": merged.get("wait_spin_hit", 0),
+        "spin_miss": merged.get("wait_spin_miss", 0),
+        "spin_skipped": merged.get("wait_spin_skipped", 0),
+        "sleep": merged.get("wait_sleep", 0),
+        # fraction of waits satisfied inside the busy window (hit / all
+        # wait entries); None when nothing waited (pure native plane)
+        "spin_ratio": (round(merged.get("wait_spin_hit", 0) / waits, 4)
+                       if waits else None),
+    }
+    out["batch_stats"] = bs  # full per-side detail for round-over-round
     if serving is not None:
         # BASELINE configs #4/#5 (8-client fan-in batching into a ResNet
         # server); the reference publishes no figure, so no vs_baseline.

@@ -108,6 +108,17 @@ int tpr_call_finish(tpr_call *c, char *details, size_t cap);
 int tpr_call_send_reserve(tpr_call *c, size_t len, int end_stream,
                           uint8_t **p1, size_t *l1,
                           uint8_t **p2, size_t *l2);
+
+/* Fragment-aware reserve: flags is a bitmask. TPR_RESERVE_MORE marks this
+ * frame as a non-final fragment of one logical message (the peer keeps
+ * accumulating until a frame without it), letting a producer gather a
+ * message LARGER than one frame through several reserve/commit leases.
+ * TPR_RESERVE_END_STREAM half-closes after the final fragment. */
+#define TPR_RESERVE_END_STREAM 1
+#define TPR_RESERVE_MORE 2
+int tpr_call_send_reserve2(tpr_call *c, size_t len, int flags,
+                           uint8_t **p1, size_t *l1,
+                           uint8_t **p2, size_t *l2);
 int tpr_call_send_commit(tpr_call *c);
 int tpr_call_send_abort(tpr_call *c);
 

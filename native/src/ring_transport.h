@@ -617,47 +617,66 @@ struct RingTransport {
   // else parks on a condition variable that the drainer (this poller, or
   // the server's epoll loop via drain_tokens) bumps for every drain.
   bool wait_event(int timeout_ms) {
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms);
     std::unique_lock<std::mutex> lk(ev_mu);
     uint64_t e = ev_epoch;
-    // Is the fd owned by a shared epoll loop, and is this a FOREIGN
-    // thread? Then a recv() here would steal 'd' tokens the
-    // level-triggered epoll needs to pump requests (they'd sit unread in
-    // the ring) — park for the owner's drain instead. The epoll thread
-    // ITSELF (a callback handler blocking for response credits) keeps
-    // polling: its pump_conn continuation drains the ring either way,
-    // and nobody else would read the fd while it is blocked here.
-    bool foreign = epoll_owned.load() &&
-                   !(epoll_tid_set.load() &&
-                     epoll_tid == std::this_thread::get_id());
-    if (foreign || ev_polling) {
-      ev_cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
-                     [&] { return ev_epoch != e; });
-      return ev_epoch != e;
-    }
-    ev_polling = true;
-    lk.unlock();
-    struct pollfd pfd = {notify_fd, POLLIN, 0};
-    int r = ::poll(&pfd, 1, timeout_ms);
-    bool got = false;
-    if (r > 0) {
-      char tokens[64];
-      ssize_t n = ::recv(notify_fd, tokens, sizeof tokens, MSG_DONTWAIT);
-      if (n == 0) {  // peer closed the event channel: connection over
-        peer_exited = true;
-        got = true;
-      } else if (n > 0) {
-        for (ssize_t i = 0; i < n; ++i)
-          if (tokens[i] == 'x') peer_exited = true;
-        got = true;
+    for (;;) {
+      // Is the fd owned by a shared epoll loop, and is this a FOREIGN
+      // thread? Then a recv() here would steal 'd' tokens the
+      // level-triggered epoll needs to pump requests (they'd sit unread in
+      // the ring) — park for the owner's drain instead. The epoll thread
+      // ITSELF (a callback handler blocking for response credits) keeps
+      // polling: its pump_conn continuation drains the ring either way,
+      // and nobody else would read the fd while it is blocked here.
+      bool foreign = epoll_owned.load() &&
+                     !(epoll_tid_set.load() &&
+                       epoll_tid == std::this_thread::get_id());
+      if (foreign) {
+        ev_cv.wait_until(lk, deadline, [&] { return ev_epoch != e; });
+        return ev_epoch != e;
       }
+      if (ev_polling) {
+        // Parked waiters also wake when the polling thread STANDS DOWN
+        // (ev_polling -> false, e.g. its own timeout): one of them must
+        // take over the fd poll, or queued tokens sit unread while every
+        // parked waiter sleeps out its full timeout (ADVICE r5 — a
+        // bounded re-run of the wake-latency bug this machinery fixed).
+        ev_cv.wait_until(lk, deadline,
+                         [&] { return ev_epoch != e || !ev_polling; });
+        if (ev_epoch != e) return true;
+        if (std::chrono::steady_clock::now() >= deadline) return false;
+        continue;  // poller stood down with time left: take over the fd
+      }
+      ev_polling = true;
+      lk.unlock();
+      auto remain = std::chrono::duration_cast<std::chrono::milliseconds>(
+                        deadline - std::chrono::steady_clock::now())
+                        .count();
+      if (remain < 0) remain = 0;
+      struct pollfd pfd = {notify_fd, POLLIN, 0};
+      int r = ::poll(&pfd, 1, static_cast<int>(remain));
+      bool got = false;
+      if (r > 0) {
+        char tokens[64];
+        ssize_t n = ::recv(notify_fd, tokens, sizeof tokens, MSG_DONTWAIT);
+        if (n == 0) {  // peer closed the event channel: connection over
+          peer_exited = true;
+          got = true;
+        } else if (n > 0) {
+          for (ssize_t i = 0; i < n; ++i)
+            if (tokens[i] == 'x') peer_exited = true;
+          got = true;
+        }
+      }
+      lk.lock();
+      ev_polling = false;
+      if (got) ++ev_epoch;
+      bool advanced = ev_epoch != e;
+      lk.unlock();
+      ev_cv.notify_all();  // hand the fd off + deliver the drain
+      return advanced;
     }
-    lk.lock();
-    ev_polling = false;
-    if (got) ++ev_epoch;
-    bool advanced = ev_epoch != e;
-    lk.unlock();
-    ev_cv.notify_all();  // hand the fd off + deliver the drain
-    return advanced;
   }
 
   std::mutex ev_mu;

@@ -590,7 +590,14 @@ tpr_channel *tpr_channel_create2(const char *host, int port, int timeout_ms,
   return ch;
 }
 
-void tpr_channel_destroy(tpr_channel *ch) { delete ch; }
+static void abort_lease_if_owned(tpr_channel *ch);  // defined with the lease API
+
+void tpr_channel_destroy(tpr_channel *ch) {
+  // Last-resort abandoned-lease recovery before ~tpr_channel joins the
+  // reader (which a wedged write_mu could deadlock behind a sender).
+  abort_lease_if_owned(ch);
+  delete ch;
+}
 
 int64_t tpr_channel_ping(tpr_channel *ch, int timeout_ms) {
   uint64_t before;
@@ -722,9 +729,9 @@ int tpr_call_send(tpr_call *c, const uint8_t *data, size_t len, int end_stream) 
   return 0;
 }
 
-int tpr_call_send_reserve(tpr_call *c, size_t len, int end_stream,
-                          uint8_t **p1, size_t *l1,
-                          uint8_t **p2, size_t *l2) {
+static int send_reserve_flagged(tpr_call *c, size_t len, uint8_t fflags,
+                                uint8_t **p1, size_t *l1,
+                                uint8_t **p2, size_t *l2) {
   // Zero-copy send (the reference's SendZerocopy shape, pair.cc:793-941,
   // recast for a shm ring): reserve ONE message's span in the peer ring so
   // the producer SERIALIZES INTO THE TRANSPORT — the staging buffer and
@@ -754,8 +761,7 @@ int tpr_call_send_reserve(tpr_call *c, size_t len, int end_stream,
     return -1;
   }
   std::string hdr;
-  build_frame_header(hdr, kMessage,
-                     end_stream ? kFlagEndStream : 0, c->c.stream_id, len);
+  build_frame_header(hdr, kMessage, fflags, c->c.stream_id, len);
   // header may straddle the wrap split
   size_t h1 = hdr.size() < m1 ? hdr.size() : (size_t)m1;
   memcpy(q1, hdr.data(), h1);
@@ -777,12 +783,50 @@ int tpr_call_send_reserve(tpr_call *c, size_t len, int end_stream,
   return 0;
 }
 
+int tpr_call_send_reserve(tpr_call *c, size_t len, int end_stream,
+                          uint8_t **p1, size_t *l1,
+                          uint8_t **p2, size_t *l2) {
+  return send_reserve_flagged(c, len, end_stream ? kFlagEndStream : 0,
+                              p1, l1, p2, l2);
+}
+
+int tpr_call_send_reserve2(tpr_call *c, size_t len, int flags,
+                           uint8_t **p1, size_t *l1,
+                           uint8_t **p2, size_t *l2) {
+  // Fragment-aware lease: TPR_RESERVE_MORE marks this frame as a non-final
+  // fragment of one message (kFlagMore), so a producer can gather a payload
+  // LARGER than kMaxFramePayload through several leases and the peer still
+  // reassembles ONE message — the zero-copy analog of tpr_call_send's
+  // fragmentation loop. TPR_RESERVE_END_STREAM only makes sense on the
+  // final fragment (callers pass it with MORE clear).
+  uint8_t f = 0;
+  if (flags & TPR_RESERVE_END_STREAM) f |= kFlagEndStream;
+  if (flags & TPR_RESERVE_MORE) f |= kFlagMore;
+  return send_reserve_flagged(c, len, f, p1, l1, p2, l2);
+}
+
 // Only the RESERVING thread may finish a lease: a stranger "committing"
 // would publish a half-filled message to the peer and unlock a mutex it
 // never locked (both UB). The owner-id gate turns that misuse into -1.
 static bool lease_owned_by_me(tpr_channel *ch) {
   return ch->lease_active.load() &&
          ch->lease_owner == std::this_thread::get_id();
+}
+
+// Abandoned-lease recovery (ADVICE r5): a caller that throws between
+// reserve and commit/abort (ctypes exception mid-fill) would otherwise
+// leave write_mu locked forever, wedging every send on the channel. The
+// destroy paths call this so same-thread cleanup (the normal Python
+// exception unwind: reserve → raise → call/channel destroy) releases the
+// lease. Reserve never advanced the tail, so the span is simply reused.
+// Only the owning thread can recover — unlocking a foreign thread's mutex
+// is UB — which matches the failure mode: the thread that abandoned the
+// lease is the one running the unwind.
+static void abort_lease_if_owned(tpr_channel *ch) {
+  if (lease_owned_by_me(ch)) {
+    ch->lease_active.store(false);
+    ch->write_mu.unlock();
+  }
 }
 
 int tpr_call_send_commit(tpr_call *c) {
@@ -889,6 +933,9 @@ void tpr_call_cancel(tpr_call *c) {
 
 void tpr_call_destroy(tpr_call *c) {
   tpr_channel *ch = c->c.ch;
+  // An exception between send_reserve and commit unwinds through here:
+  // free the channel's send path before anything that could block on it.
+  abort_lease_if_owned(ch);
   if (c->c.cq != nullptr) {
     // Unhook from the queue's deadline scan first: a tpr_cq_next thread may
     // be mid-expiry holding `c` (cq_pins) — wait for it, bounded, with the

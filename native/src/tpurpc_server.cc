@@ -91,11 +91,23 @@ struct OwnedBuf {
   void append(const uint8_t *src, size_t n) {
     if (n == 0) return;  // empty message: memcpy(NULL,..,0) is still UB
     if (len + n > cap) {
-      size_t want = cap ? cap : 4096;
+      // 64-byte-aligned storage (freeable with free(), so the
+      // tpr_srv_buf_free contract is unchanged): the tensor codec lays
+      // leaves out on 64-byte offsets, so an aligned message base is what
+      // lets the Python binding's dlpack import alias the receive buffer
+      // into a jax.Array with zero copy — glibc's mmap'd malloc chunks sit
+      // at 16 mod 64 and force a 4 MiB landing copy per message.
+      // aligned_alloc can't mremap-grow like realloc, so fragmented
+      // messages (a MORE first fragment) reserve 8x the fragment upfront:
+      // one allocation covers the whole message for anything ≤ 8 frames,
+      // and the doubling copy is the rare tail, not the steady state.
+      size_t want = cap ? cap * 2 : (n > 4096 ? n * 8 : 4096);
       while (want < len + n) want *= 2;
-      uint8_t *np = static_cast<uint8_t *>(realloc(p, want));
+      uint8_t *np = static_cast<uint8_t *>(aligned_alloc(64, want));
       if (np == nullptr) abort();  // OOM: same fate as the old path's
-      p = np;                      // uncaught bad_alloc, without the UB
+      if (len) memcpy(np, p, len);  // uncaught bad_alloc, without the UB
+      free(p);
+      p = np;
       cap = want;
     }
     memcpy(p + len, src, n);

@@ -177,7 +177,7 @@ def cpp_build(tmp_path_factory):
          str(ROOT / "native" / "src" / "tpurpc_server.cc"),
          str(ROOT / "native" / "src" / "ring.cc"),
          "-I", str(out), "-I", str(ROOT / "native" / "include"),
-         *pb_flags, "-lpthread", "-o", str(binp)],
+         *pb_flags, "-lpthread", "-lrt", "-o", str(binp)],
         check=True, timeout=300, capture_output=True)
     return out, binp
 

@@ -43,7 +43,7 @@ def _build_cpp(out_bin, example, native_src, headers):
     subprocess.run(
         [gxx, "-std=c++17", "-O2", *srcs,
          "-I", os.path.join(ROOT, "native", "include"),
-         "-lpthread", "-o", out_bin],
+         "-lpthread", "-lrt", "-o", out_bin],
         check=True, timeout=180, capture_output=True)
 
 
@@ -181,7 +181,7 @@ int main() {{
              os.path.join(ROOT, "native", "src", "tpurpc_client.cc"),
              os.path.join(ROOT, "native", "src", "ring.cc"),
              "-I", os.path.join(ROOT, "native", "include"),
-             "-lpthread", "-o", tmp_bin],
+             "-lpthread", "-lrt", "-o", tmp_bin],
             check=True, timeout=180, capture_output=True)
         proc = subprocess.run([tmp_bin], capture_output=True, text=True,
                               timeout=60)
@@ -271,7 +271,7 @@ int main(int argc, char **argv) {
              os.path.join(ROOT, "native", "src", "tpurpc_client.cc"),
              os.path.join(ROOT, "native", "src", "ring.cc"),
              "-I", os.path.join(ROOT, "native", "include"),
-             "-lpthread", "-o", tmp_bin],
+             "-lpthread", "-lrt", "-o", tmp_bin],
             check=True, timeout=180, capture_output=True)
         env = dict(os.environ, GRPC_PLATFORM_TYPE="RDMA_BP",
                    TPURPC_NATIVE_INLINE_READ="1")
@@ -428,7 +428,7 @@ int main() {{
              os.path.join(ROOT, "native", "src", "tpurpc_client.cc"),
              os.path.join(ROOT, "native", "src", "ring.cc"),
              "-I", os.path.join(ROOT, "native", "include"),
-             "-lpthread", "-o", tmp_bin],
+             "-lpthread", "-lrt", "-o", tmp_bin],
             check=True, timeout=180, capture_output=True)
         proc = subprocess.run([tmp_bin], capture_output=True, text=True,
                               timeout=60)
@@ -562,7 +562,7 @@ def test_cpp_loop_under_asan():
     asan_srv = os.path.join(bd, "asan_server")
     asan_cli = os.path.join(bd, "asan_client")
     flags = ["-std=c++17", "-O1", "-g", "-fsanitize=address,undefined",
-             "-I", os.path.join(ROOT, "native", "include"), "-lpthread"]
+             "-I", os.path.join(ROOT, "native", "include"), "-lpthread", "-lrt"]
     subprocess.run([gxx, os.path.join(ROOT, "examples", "cpp_server.cc"),
                     os.path.join(ROOT, "native", "src", "tpurpc_server.cc"),
                     os.path.join(ROOT, "native", "src", "ring.cc"),
@@ -634,7 +634,7 @@ def test_bulk_lease_loop_under_asan():
          os.path.join(ROOT, "native", "src", "tpurpc_server.cc"),
          os.path.join(ROOT, "native", "src", "ring.cc"),
          "-std=c++17", "-O1", "-g", "-fsanitize=address,undefined",
-         "-I", os.path.join(ROOT, "native", "include"), "-lpthread",
+         "-I", os.path.join(ROOT, "native", "include"), "-lpthread", "-lrt",
          "-o", asan_ab],
         check=True, timeout=240, capture_output=True)
     out = subprocess.run(
@@ -702,7 +702,7 @@ def test_python_client_against_cpp_callback_server(tmp_path):
          os.path.join(ROOT, "native", "src", "tpurpc_server.cc"),
          os.path.join(ROOT, "native", "src", "ring.cc"),
          "-I", os.path.join(ROOT, "native", "include"),
-         "-lpthread", "-o", str(binp)],
+         "-lpthread", "-lrt", "-o", str(binp)],
         check=True, timeout=180, capture_output=True)
     proc = subprocess.Popen([str(binp)], stdout=subprocess.PIPE,
                             stdin=subprocess.PIPE, text=True)
@@ -756,7 +756,7 @@ def test_micro_native_bench_smoke(tmp_path):
          os.path.join(ROOT, "native", "src", "tpurpc_server.cc"),
          os.path.join(ROOT, "native", "src", "ring.cc"),
          "-I", os.path.join(ROOT, "native", "include"),
-         "-lpthread", "-o", str(binp)],
+         "-lpthread", "-lrt", "-o", str(binp)],
         check=True, timeout=180, capture_output=True)
     import json as _json
 
@@ -841,7 +841,7 @@ def test_cpp_ring_micro_smoke(tmp_path):
          os.path.join(ROOT, "native", "src", "tpurpc_server.cc"),
          os.path.join(ROOT, "native", "src", "ring.cc"),
          "-I", os.path.join(ROOT, "native", "include"),
-         "-lpthread", "-o", str(binp)],
+         "-lpthread", "-lrt", "-o", str(binp)],
         check=True, timeout=180, capture_output=True)
     import json as _json
 
@@ -870,7 +870,7 @@ def test_native_ring_beats_tcp_small_rpc(tmp_path):
          os.path.join(ROOT, "native", "src", "tpurpc_server.cc"),
          os.path.join(ROOT, "native", "src", "ring.cc"),
          "-I", os.path.join(ROOT, "native", "include"),
-         "-lpthread", "-o", str(binp)],
+         "-lpthread", "-lrt", "-o", str(binp)],
         check=True, timeout=300, capture_output=True)
     import json as _json
 

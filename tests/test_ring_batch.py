@@ -1,0 +1,261 @@
+"""Batched ring primitives (ISSUE 1 tentpole): ``RingReader.drain_into`` /
+``read_many`` and ``RingWriter.write_many`` must be byte-identical to the
+message-at-a-time loops across wrap points, partial messages, and corruption
+stamps — the batch is an amortization, never a semantic change.
+
+Seeded-random property style (the repo's test_ring.py fuzz idiom; the
+hypothesis dependency isn't in the image), run over both the native and the
+pure-Python drain paths.
+"""
+
+import random
+
+import pytest
+
+from tpurpc.core import ring as R
+
+
+def make_pipe(capacity=1024, native=True):
+    buf = bytearray(capacity)
+    reader = R.RingReader(buf)
+    if not native:
+        reader._nat = None  # force the pure-Python scan/copy path
+    writer = R.RingWriter(capacity, lambda off, data: buf.__setitem__(
+        slice(off, off + len(data)), bytes(data)))
+    return reader, writer
+
+
+def pump_credits(reader, writer, force=False):
+    if force or reader.should_publish_head():
+        writer.update_remote_head(reader.take_publish())
+
+
+def _random_payload(rng, choices=(0, 1, 3, 8, 17, 64, 100, 255)):
+    return bytes(rng.randrange(256) for _ in range(rng.choice(choices)))
+
+
+# ---------------------------------------------------------------------------
+# write_many ≡ write-at-a-time
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", range(4))
+def test_write_many_byte_identical_to_write_loop(seed):
+    """The batch encoder and the per-message encoder must produce identical
+    ring byte streams (same framing, same stamps) for identical inputs."""
+    rng = random.Random(seed)
+    r_one, w_one = make_pipe(2048)
+    r_many, w_many = make_pipe(2048)
+    for _ in range(300):
+        batch = [_random_payload(rng) for _ in range(rng.randrange(1, 5))]
+        nonzero = [p for p in batch if p]
+        pump_credits(r_one, w_one, force=True)
+        pump_credits(r_many, w_many, force=True)
+        nm, nb = w_many.write_many(batch)
+        wrote = 0
+        for p in nonzero[:nm]:
+            wrote += w_one.write(p)
+        assert nb == wrote
+        assert w_one.tail == w_many.tail and w_one.seq == w_many.seq
+        a = r_one.read(2048)
+        b = r_many.read(2048)
+        assert a == b == b"".join(nonzero[:nm])
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_write_many_respects_credits_and_resumes(seed):
+    """A batch that exceeds current credits writes a prefix (all-or-nothing
+    per message, in order); the rest goes through after the reader drains
+    and credits return — and the reassembled stream is byte-exact."""
+    rng = random.Random(seed)
+    reader, writer = make_pipe(256)
+    pending = [bytes([i]) * rng.choice([8, 24, 56]) for i in range(64)]
+    expected = b"".join(pending)
+    got = bytearray()
+    stalls = 0
+    while len(got) < len(expected):
+        nm, _ = writer.write_many(pending[:6])
+        assert nm <= 6
+        del pending[:nm]
+        if nm == 0:
+            stalls += 1
+            assert stalls < 1000, "no forward progress"
+        dst = bytearray(256)
+        n, _ = reader.drain_into(dst)
+        got += dst[:n]
+        pump_credits(reader, writer, force=True)
+    assert bytes(got) == expected and not pending
+
+
+def test_write_many_single_message_matches_writev():
+    reader, writer = make_pipe(512)
+    nm, nb = writer.write_many([[b"ab", b"cd", b"ef"]])
+    assert (nm, nb) == (1, 6)
+    assert reader.read(512) == b"abcdef"
+
+
+def test_write_many_empty_messages_skipped():
+    reader, writer = make_pipe(256)
+    nm, nb = writer.write_many([b"", b"xy", b""])
+    assert (nm, nb) == (1, 2)
+    assert reader.read(256) == b"xy"
+
+
+# ---------------------------------------------------------------------------
+# drain_into ≡ read_into, across wraps and partial messages
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("native", [True, False], ids=["native", "python"])
+@pytest.mark.parametrize("seed", range(4))
+def test_drain_into_byte_identical_to_read_into(seed, native):
+    """Interleaved random writes and drains with deliberately small dst
+    buffers (forcing partial-message resumption) must yield the same byte
+    stream as a reference reader using read_into on an identical ring."""
+    rng = random.Random(seed)
+    r_a, w_a = make_pipe(1024, native=native)
+    r_b, w_b = make_pipe(1024, native=native)
+    stream_a = bytearray()
+    stream_b = bytearray()
+    for _ in range(400):
+        p = _random_payload(rng)
+        pump_credits(r_a, w_a, force=True)
+        pump_credits(r_b, w_b, force=True)
+        if p and len(p) <= min(w_a.writable_payload(), w_b.writable_payload()):
+            w_a.write(p)
+            w_b.write(p)
+        size = rng.choice([7, 33, 128, 1024])
+        dst = bytearray(size)
+        n, msgs = r_a.drain_into(dst)
+        stream_a += dst[:n]
+        assert msgs >= 0
+        dst2 = bytearray(size)
+        n2 = r_b.read_into(dst2)
+        stream_b += dst2[:n2]
+        assert n == n2
+    assert stream_a == stream_b
+
+
+def test_drain_into_message_count_matches_seq_delta():
+    reader, writer = make_pipe(4096)
+    for i in range(7):
+        writer.write(bytes([i]) * 10)
+    seq0 = reader.seq
+    dst = bytearray(4096)
+    n, msgs = reader.drain_into(dst)
+    assert n == 70 and msgs == 7
+    assert reader.seq - seq0 == 7
+
+
+def test_drain_into_partial_message_counts_zero():
+    """A drain that only moves part of one message reports 0 completed
+    messages; the completion lands with the drain that finishes it."""
+    reader, writer = make_pipe(1024)
+    writer.write(b"z" * 100)
+    n1, m1 = reader.drain_into(bytearray(40))
+    n2, m2 = reader.drain_into(bytearray(100))
+    assert (n1, m1) == (40, 0)
+    assert (n2, m2) == (60, 1)
+
+
+# ---------------------------------------------------------------------------
+# read_many: whole messages, one segmented copy-out
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", range(4))
+def test_read_many_returns_whole_messages_in_order(seed):
+    rng = random.Random(seed)
+    reader, writer = make_pipe(2048)
+    outstanding = []
+    for _ in range(300):
+        p = _random_payload(rng)
+        pump_credits(reader, writer, force=True)
+        if p and len(p) <= writer.writable_payload():
+            writer.write(p)
+            outstanding.append(p)
+        if rng.random() < 0.4:
+            msgs = reader.read_many()
+            assert [bytes(m) for m in msgs] == outstanding[:len(msgs)]
+            del outstanding[:len(msgs)]
+    msgs = reader.read_many()
+    assert [bytes(m) for m in msgs] == outstanding
+
+
+def test_read_many_spans_the_wrap_point():
+    """Messages written across the ring's physical wrap come back intact —
+    the batch copy splits into exactly the two wrap segments."""
+    reader, writer = make_pipe(256)
+    # advance the ring close to the wrap point
+    for _ in range(3):
+        writer.write(b"a" * 48)
+    dst = bytearray(256)
+    reader.drain_into(dst)
+    writer.update_remote_head(reader.take_publish())
+    # these two messages straddle capacity=256
+    m1, m2 = b"b" * 40, b"c" * 40
+    writer.write(m1)
+    writer.write(m2)
+    msgs = reader.read_many()
+    assert [bytes(m) for m in msgs] == [m1, m2]
+
+
+def test_read_many_respects_in_progress_partial():
+    """read_many never interleaves with a partial read_into in flight — the
+    caller finishes the partial message first."""
+    reader, writer = make_pipe(1024)
+    writer.write(b"x" * 64)
+    writer.write(b"y" * 64)
+    reader.read_into(bytearray(10))  # starts message 1, leaves it partial
+    assert reader.read_many() == []
+    rest = bytearray(1024)
+    n = reader.read_into(rest)
+    assert bytes(rest[:n]) == b"x" * 54 + b"y" * 64
+
+
+def test_read_many_views_survive_ring_reuse():
+    """The returned views are detached copies: overwriting the ring span
+    afterward (a full wrap of new traffic) must not mutate them."""
+    reader, writer = make_pipe(256)
+    writer.write(b"m" * 64)
+    (msg,) = reader.read_many()
+    writer.update_remote_head(reader.take_publish())
+    for i in range(6):  # enough traffic to lap the span
+        writer.write(bytes([i]) * 32)
+        reader.drain_into(bytearray(256))
+        writer.update_remote_head(reader.take_publish())
+    assert bytes(msg) == b"m" * 64
+
+
+# ---------------------------------------------------------------------------
+# corruption stamps: stale/garbage framing never surfaces as data
+# ---------------------------------------------------------------------------
+
+def test_batched_reads_ignore_stale_stamps_after_wrap():
+    """Bytes left from previous laps (valid-looking headers with old seq
+    stamps) must read as 'no message' to the batched scanners, exactly as
+    they do to the one-at-a-time path."""
+    reader, writer = make_pipe(256)
+    for lap in range(8):  # several full laps leave stale framing behind
+        writer.write(bytes([lap]) * 48)
+        msgs = reader.read_many()
+        assert len(msgs) == 1 and bytes(msgs[0]) == bytes([lap]) * 48
+        writer.update_remote_head(reader.take_publish())
+    assert reader.read_many() == []
+    assert reader.drain_into(bytearray(64))[0] == 0
+
+
+def test_drain_stops_at_corrupt_footer():
+    """A message whose footer stamp is wrong is incomplete to the batch scan:
+    everything before it drains, nothing after it does."""
+    buf = bytearray(1024)
+    reader = R.RingReader(buf)
+    writer = R.RingWriter(1024, lambda off, data: buf.__setitem__(
+        slice(off, off + len(data)), bytes(data)))
+    writer.write(b"ok" * 8)
+    tail_before = writer.tail
+    writer.write(b"bad" * 8)
+    # smash the second message's footer stamp
+    footer_off = tail_before + R.HEADER_BYTES + R.align_up(24)
+    buf[footer_off & (1024 - 1):(footer_off & (1024 - 1)) + 8] = b"\xde" * 8
+    msgs = reader.read_many()
+    assert [bytes(m) for m in msgs] == [b"ok" * 8]
+    n, cnt = reader.drain_into(bytearray(1024))
+    assert (n, cnt) == (0, 0)

@@ -75,9 +75,15 @@ def _try_build(path: str) -> None:
             if not os.path.exists(path):
                 tmp = path + ".tmp"
                 try:
+                    # -lrt: shm_open/shm_unlink live in librt on older glibc
+                    # (< 2.34); without it the link "succeeds" but dlopen
+                    # fails with an undefined-symbol error and the whole
+                    # native data plane silently falls back to Python — the
+                    # exact failure observed on this host. Harmless where
+                    # libc already provides them.
                     subprocess.run(
                         [gxx, "-std=c++17", "-O3", "-DNDEBUG", "-shared",
-                         "-fPIC", *srcs, "-o", tmp, "-lpthread"],
+                         "-fPIC", *srcs, "-o", tmp, "-lpthread", "-lrt"],
                         check=True, timeout=120, capture_output=True)
                 except Exception as exc:
                     # Stamp the failure so future processes skip the broken
@@ -111,7 +117,21 @@ def load() -> "Optional[ctypes.CDLL]":
         # exact safety the pure-Python slicing path gets implicitly.
         lib = ctypes.PyDLL(path)
     except OSError:
-        return None
+        # A stale or mis-linked artifact fails dlopen (observed: a build
+        # without -lrt leaves shm_open undefined on older glibc). Rebuild
+        # from sources once instead of silently dropping the whole native
+        # data plane to Python for the life of the process.
+        try:
+            os.unlink(path)
+        except OSError:
+            return None
+        _try_build(path)
+        if not os.path.exists(path):
+            return None
+        try:
+            lib = ctypes.PyDLL(path)
+        except OSError:
+            return None
     if lib.tpr_abi_version() != ABI_VERSION:
         return None
     u64 = ctypes.c_uint64

@@ -45,6 +45,7 @@ import numpy as np
 from tpurpc.core import _native
 from tpurpc.tpu import ledger as ring_ledger
 from tpurpc.core.ring import RingCorruption, RingFull, RingReader, RingWriter
+from tpurpc.utils import stats as _stats
 from tpurpc.utils.config import get_config
 from tpurpc.utils.trace import trace_ring
 
@@ -473,6 +474,10 @@ class Pair:
         self._credit_lock = threading.Lock()
         self._published_head_mirror = 0  # last head value we published to the peer
         self.want_write = False  # a sender is stalled waiting for credits
+        #: adaptive-BPEV activity score (see tpurpc/core/poller.py EWMA
+        #: constants): 1.0 = hot (waiters busy-poll), decays toward 0 on
+        #: spin misses so idle pairs park on fds without spinning first
+        self.activity_ewma = 1.0
         # monotonic counters (ref: per-pair live counters, pair.h:235-270)
         self.total_sent = 0
         self.total_recv = 0
@@ -506,6 +511,7 @@ class Pair:
         self._published_head_mirror = 0
         self.error = None
         self.want_write = False
+        self.activity_ewma = 1.0  # recycled pairs start hot like fresh ones
         for role in ("read", "write"):
             r, w = os.pipe()
             os.set_blocking(r, False)
@@ -907,8 +913,6 @@ class Pair:
         if self.state is not PairState.CONNECTED:
             raise BrokenPipeError(f"pair {self.tag} not sendable: {self.state}"
                                   + (f" ({self.error})" if self.error else ""))
-        from tpurpc.utils import stats as _stats
-
         if _stats.profiling_on():
             with _stats.profile("pair_send"):
                 return self._send_profiled(slices, byte_idx)
@@ -932,27 +936,48 @@ class Pair:
             self.process_credits()
             total = 0
             while views:
-                budget = min(self.writer.writable_payload(), cfg.send_chunk_size)
+                # Batch EVERY chunk the current credits admit into one
+                # writer.write_many call (one bulk ring placement + one
+                # header store per chunk) instead of a writev per chunk —
+                # the gather-side half of the batched pipeline. Chunks stay
+                # ≤ send_chunk_size so the peer's drain granularity (and
+                # the old-gen chunked-flush semantics) are unchanged.
+                budget = self.writer.writable_payload()
                 if budget == 0:
                     self.want_write = True
                     break
-                chunk: List[memoryview] = []
+                chunks: List[List[memoryview]] = []
                 n = 0
                 while views and n < budget:
-                    v = views[0]
-                    take = min(len(v), budget - n)
-                    chunk.append(v[:take])
-                    if take == len(v):
-                        views.pop(0)
-                    else:
-                        views[0] = v[take:]
-                    n += take
-                try:
-                    self.writer.writev(chunk)
-                except RingFull:  # lost race with our own budget math — treat as stall
+                    chunk: List[memoryview] = []
+                    c = 0
+                    room = min(cfg.send_chunk_size, budget - n)
+                    while views and c < room:
+                        v = views[0]
+                        take = min(len(v), room - c)
+                        chunk.append(v[:take])
+                        if take == len(v):
+                            views.pop(0)
+                        else:
+                            views[0] = v[take:]
+                        c += take
+                    chunks.append(chunk)
+                    n += c
+                    # every chunk's framing overhead eats writable payload;
+                    # leave the precise accept/stop decision to write_many
+                    budget = max(0, budget - (c + 24))
+                wrote_msgs, wrote_bytes = self.writer.write_many(chunks)
+                if wrote_msgs:
+                    _stats.batch_hist("ring_write").record(wrote_msgs)
+                if wrote_msgs < len(chunks):
+                    # credits moved under us: re-queue the unwritten chunks'
+                    # segments (identity-preserving) and stall for credits
+                    views[0:0] = [seg for ch in chunks[wrote_msgs:]
+                                  for seg in ch]
+                    total += wrote_bytes
                     self.want_write = True
                     break
-                total += n
+                total += wrote_bytes
             if not views:
                 self.want_write = False
             self.total_sent += total
@@ -1011,6 +1036,7 @@ class Pair:
         # process_credits() folding that head against the not-yet-written-
         # back tail would raise a spurious RingCorruption. The call is
         # GIL-held and bounded, so the hold is short.
+        seq_before = writer.seq
         with self._credit_lock:
             got = lib.tpr_send_fast(
                 writer._nat_addr, writer.layout.capacity,
@@ -1022,6 +1048,8 @@ class Pair:
             writer.seq = seq.value
             if rh.value > writer.remote_head:
                 writer.remote_head = rh.value
+        if writer.seq > seq_before:  # ring messages this one C call encoded
+            _stats.batch_hist("ring_write").record(writer.seq - seq_before)
         ring_ledger.host_copy(got)
         self.total_sent += got
         total_len = sum(len(v) for v in views)
@@ -1042,19 +1070,26 @@ class Pair:
     def recv_into(self, dst) -> int:
         """Drain the receive ring into ``dst``; publishes credits as a side effect
         (``PairPollable::Recv`` → ``RingBufferPollable::Read``,
-        ``ring_buffer.cc:122-191``)."""
+        ``ring_buffer.cc:122-191``).
+
+        Rides the BATCHED drain (``RingReader.drain_into``): every complete
+        message queued in the ring moves in one pass with one head publish,
+        and the batch size feeds the ``ring_drain`` histogram the bench
+        reports as ``batch_msgs_per_wakeup``."""
         with self._recv_guard:
             reader = self.reader
             if reader is None:  # quiesced/destroyed under a racing reader thread
                 raise ConnectionError("pair is closed")
             try:
-                n = reader.read_into(dst)
+                n, nmsgs = reader.drain_into(dst)
             except (RingCorruption, ValueError) as exc:
                 # ring memory released by a concurrent teardown — surface as a
                 # connection error, not data corruption
                 if "released" in str(exc):
                     raise ConnectionError("pair is closed") from None
                 raise
+            if nmsgs:
+                _stats.batch_hist("ring_drain").record(nmsgs)
             self.total_recv += n
             self._publish_credits_if_due()
             return n

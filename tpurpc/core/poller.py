@@ -20,8 +20,38 @@ from collections import defaultdict
 from typing import Callable, Dict, List, Optional
 
 from tpurpc.core.pair import Pair, PairState
+from tpurpc.utils import stats as _stats
 from tpurpc.utils.config import get_config
 from tpurpc.utils.trace import trace_ring
+
+#: Adaptive-spin state machine (BPEV recast with a per-pair activity EWMA
+#: instead of an unconditional busy window):
+#:
+#:   hit  (spin fired / events arrived within the hot window) → ewma += α(1-ewma)
+#:   miss (spin expired / slow fd wake / sleep timeout)        → ewma *= β
+#:   ewma < floor → the hybrid waiter SKIPS the busy window entirely and
+#:   parks on its fds (the condvar/select leg, bounded by the caller's
+#:   timeout and the poller's watchdog within poller_sleep_timeout_ms).
+#:
+#: Hot pairs therefore stay in busy-poll — every message is caught inside a
+#: spin slice and drained in a batch — while idle pairs cost zero spin CPU.
+#: One spin-hit pulls a decayed pair back over the floor (α=0.5 from 0.1 →
+#: 0.55), so a stream that re-heats pays exactly one fd wake.
+_EWMA_HIT_ALPHA = 0.5
+_EWMA_MISS_BETA = 0.5
+_EWMA_SPIN_FLOOR = 0.1
+#: an fd wake this close behind the (skipped or missed) busy window counts
+#: as "spinning would have caught it" — in multiples of busy_polling_timeout
+_HOT_WAKE_MULTIPLE = 4.0
+
+
+def _ewma_hit(pair: Pair) -> None:
+    e = getattr(pair, "activity_ewma", 1.0)
+    pair.activity_ewma = e + _EWMA_HIT_ALPHA * (1.0 - e)
+
+
+def _ewma_miss(pair: Pair) -> None:
+    pair.activity_ewma = getattr(pair, "activity_ewma", 1.0) * _EWMA_MISS_BETA
 
 
 class Poller:
@@ -52,7 +82,9 @@ class Poller:
         self.thread_num = thread_num or cfg.poller_thread_num
         self.capacity = cfg.poller_capacity
         self.sleep_timeout_s = cfg.poller_sleep_timeout_ms / 1000.0
-        self.polling_yield = cfg.polling_yield
+        # cfg.polling_yield (the reference's fixed yield knob) is subsumed
+        # by the adaptive scan cadence in _run: hot scans run at 1 ms, idle
+        # streaks back off exponentially to sleep_timeout_s.
         self._pairs: List[Optional[Pair]] = []
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
@@ -112,27 +144,43 @@ class Poller:
         # no events at all (poller.cc:52-106); tpurpc's domains deliver a
         # notify token on every send/credit-publish, and kicks are per-role-
         # pipe lossless, so waiters are woken by tokens in the common path.
-        # The poller's job is recovery from pathological token loss — a fixed
-        # millisecond heartbeat bounds that recovery without stealing the
-        # core from the data-plane threads (a hot scan measured ~15-25% of
-        # wall time on a 1-CPU host).
+        # The poller's job is recovery from pathological token loss — the
+        # cadence ADAPTS (round 1 was a hot scan, ~15-25% of wall time on a
+        # 1-CPU host; the round-5 fixed 1 ms heartbeat burned 1000 wakeups/s
+        # on a fully idle process): scans that find work keep the 1 ms
+        # cadence, idle streaks back the interval off exponentially toward
+        # the condvar bound (poller_sleep_timeout_ms), which also caps the
+        # token-loss recovery latency exactly as configured.
+        interval = 0.001
         while True:
             with self._cv:
                 if not self._running:
                     return
                 if self._pair_count == 0:
                     self._cv.wait(timeout=self.sleep_timeout_s)
+                    interval = 0.001  # registrations re-arm the fast scan
                     continue
                 snapshot = [p for p in self._pairs if p is not None]
+            hot = False
             for pair in snapshot:
                 try:
                     if self._scan_edges(pair):
                         pair.kick()
+                        hot = True
                 except Exception:
                     # A dying pair must never take the poller down; kick so the
                     # owner observes the error state.
                     pair.kick()
-            time.sleep(0.001)
+            if hot:
+                _stats.counter_inc("poller_scan_hot")
+                interval = 0.001
+            else:
+                _stats.counter_inc("poller_scan_idle")
+                interval = min(interval * 2, self.sleep_timeout_s)
+            with self._cv:
+                if not self._running:
+                    return
+                self._cv.wait(timeout=interval)
 
     @staticmethod
     def _needs_attention(pair: Pair) -> bool:
@@ -259,23 +307,38 @@ def _wait(pair: Pair, timeout: Optional[float], discipline: Optional[str],
         discipline = "event"
 
     if discipline in ("busy", "hybrid"):
-        if discipline == "busy":
-            spin_deadline = deadline if deadline is not None else float("inf")
+        # Adaptive gate (hybrid only): a pair whose activity EWMA decayed
+        # below the floor — spins haven't paid off lately — skips the busy
+        # window and parks on its fds immediately. "busy" is explicit
+        # operator intent and always spins.
+        ewma = getattr(pair, "activity_ewma", 1.0)
+        if discipline == "hybrid" and ewma < _EWMA_SPIN_FLOOR:
+            _stats.counter_inc("wait_spin_skipped")
         else:
-            spin_deadline = time.monotonic() + cfg.busy_polling_timeout_us / 1e6
-        while True:
-            now = time.monotonic()
-            if now >= spin_deadline:
-                break
-            slice_us = _SLICE_US
-            if spin_deadline != float("inf"):
-                slice_us = max(1, min(_SLICE_US,
-                                      int((spin_deadline - now) * 1e6)))
-            # GIL-free native spin on the watched words; True = fired (or spin
-            # unavailable — then this degrades to a pure Python poll loop).
-            pair.spin(role, slice_us)
-            if ready():
-                return True
+            if discipline == "busy":
+                spin_deadline = (deadline if deadline is not None
+                                 else float("inf"))
+            else:
+                spin_deadline = (time.monotonic()
+                                 + cfg.busy_polling_timeout_us / 1e6)
+            while True:
+                now = time.monotonic()
+                if now >= spin_deadline:
+                    break
+                slice_us = _SLICE_US
+                if spin_deadline != float("inf"):
+                    slice_us = max(1, min(_SLICE_US,
+                                          int((spin_deadline - now) * 1e6)))
+                # GIL-free native spin on the watched words; True = fired (or
+                # spin unavailable — then this degrades to a pure Python poll
+                # loop).
+                pair.spin(role, slice_us)
+                if ready():
+                    _ewma_hit(pair)
+                    _stats.counter_inc("wait_spin_hit")
+                    return True
+            _ewma_miss(pair)
+            _stats.counter_inc("wait_spin_miss")
         if discipline == "busy":
             return ready()
 
@@ -299,9 +362,18 @@ def _wait(pair: Pair, timeout: Optional[float], discipline: Optional[str],
     # select — a producer that missed the flag must be visible to the
     # re-check, and one that saw it sends the byte the select consumes.
     pair.set_waiting(role, True)
+    _stats.counter_inc("wait_sleep")
+    sleep_t0 = time.monotonic()
+    #: a wake this fast after parking means a busy window would have caught
+    #: the event — count it toward re-arming the adaptive spin
+    hot_window_s = _HOT_WAKE_MULTIPLE * cfg.busy_polling_timeout_us / 1e6
     try:
         while True:
             if ready():
+                if time.monotonic() - sleep_t0 <= hot_window_s:
+                    _ewma_hit(pair)
+                else:
+                    _ewma_miss(pair)
                 return True
             remain = None if deadline is None else deadline - time.monotonic()
             if remain is not None and remain <= 0:

@@ -308,6 +308,130 @@ class RingReader:
         n = self.read_into(out)
         return bytes(out[:n])
 
+    # -- batched draining -----------------------------------------------------
+
+    def scan_complete(self, max_msgs: Optional[int] = None,
+                      max_bytes: Optional[int] = None
+                      ) -> Tuple[List[Tuple[int, int]], int]:
+        """One scan pass: descriptors of the complete messages queued at head.
+
+        Returns ``(descs, span)`` — ``descs`` is ``[(abs_msg_off, payload_len),
+        ...]`` in arrival order, ``span`` the total ring bytes they occupy.
+        Stops at the first incomplete message, at ``max_msgs`` descriptors, or
+        once accepting another message would push total payload past
+        ``max_bytes``. Requires no partial read in progress (``_msg_len == 0``)
+        — partial resumption stays on :meth:`read_into`.
+        """
+        assert self._msg_len == 0, "scan_complete during a partial read"
+        descs: List[Tuple[int, int]] = []
+        span = 0
+        payload = 0
+        off = self.head
+        seq = self.seq
+        while span < self.layout.capacity:
+            if max_msgs is not None and len(descs) >= max_msgs:
+                break
+            ln = self._message_at(off, seq)
+            if ln == 0:
+                break
+            if max_bytes is not None and descs and payload + ln > max_bytes:
+                break
+            descs.append((off, ln))
+            s = message_span(ln)
+            off += s
+            span += s
+            payload += ln
+            seq += 1
+        return descs, span
+
+    def drain_into(self, dst) -> Tuple[int, int]:
+        """Batched :meth:`read_into`: same bytes, same partial-message
+        semantics, but head/seq/credit state and the copy ledger are updated
+        ONCE per call instead of once per message, and the whole batch is
+        planned in a single framing scan.  Returns ``(payload_bytes,
+        completed_messages)`` — the tentpole primitive of the batched receive
+        pipeline (one wakeup → one drain → many messages).
+
+        The native path is already a single C call per batch
+        (``tpr_ring_read_into`` drains everything that fits); there the
+        message count falls out of the sequence-stamp delta.
+        """
+        dst = memoryview(dst)
+        if dst.readonly:
+            raise ValueError("drain_into needs a writable buffer")
+        dst = dst.cast("B")
+        if self._nat is not None and len(dst) > 0:
+            seq0 = self.seq
+            n = self._read_into_native(dst)
+            return n, self.seq - seq0
+        total = 0
+        nmsgs = 0
+        head = self.head
+        seq = self.seq
+        msg_len = self._msg_len
+        msg_read = self._msg_read
+        while total < len(dst):
+            if msg_len == 0:
+                ln = self._message_at(head, seq)
+                if ln == 0:
+                    break
+                msg_len = ln
+                msg_read = 0
+            n = min(len(dst) - total, msg_len - msg_read)
+            self._copy_out(head + HEADER_BYTES + msg_read, n, dst, total)
+            msg_read += n
+            total += n
+            if msg_read == msg_len:
+                head += message_span(msg_len)
+                msg_len = 0
+                msg_read = 0
+                seq += 1
+                nmsgs += 1
+        # publish the whole batch's progress once
+        self.consumed_since_publish += head - self.head
+        self.head = head
+        self.seq = seq
+        self._msg_len = msg_len
+        self._msg_read = msg_read
+        ledger.host_copy(total)
+        return total, nmsgs
+
+    def read_many(self, max_msgs: Optional[int] = None,
+                  max_bytes: Optional[int] = None) -> List[memoryview]:
+        """Drain every complete message in ONE segmented copy-out.
+
+        The batch's whole ring span (headers, payloads, footers) is copied
+        into one fresh buffer with at most 2 ``memoryview`` copies (the split
+        at the wrap point); per-message payloads come back as zero-copy views
+        over that buffer, and head/seq publish once for the batch.  Returns
+        ``[]`` when nothing is complete or a partial read is in progress
+        (resume that via :meth:`read_into` first).
+
+        Callers own the backing buffer through the returned views — the ring
+        span is released (head advanced) before this returns, so the views
+        never alias ring memory.
+        """
+        if self._msg_len:
+            return []
+        if max_bytes is None:
+            max_bytes = self.layout.capacity
+        descs, span = self.scan_complete(max_msgs, max_bytes)
+        if not descs:
+            return []
+        scratch = memoryview(bytearray(span))
+        base = self.head
+        dst_off = 0
+        for seg_off, seg_len in self.layout.segments(base, span):
+            scratch[dst_off:dst_off + seg_len] = self.buf[seg_off:seg_off + seg_len]
+            dst_off += seg_len
+        out = [scratch[off - base + HEADER_BYTES:
+                       off - base + HEADER_BYTES + ln] for off, ln in descs]
+        self.head = base + span
+        self.seq += len(descs)
+        self.consumed_since_publish += span
+        ledger.host_copy(span)
+        return out
+
     # -- credits ------------------------------------------------------------
 
     #: Credit-publish threshold divisor. The reference publishes after half
@@ -461,6 +585,75 @@ class RingWriter:
         self.seq += 1
         return payload_len
 
+
+    def write_many(self, payloads: Sequence) -> Tuple[int, int]:
+        """Encode a BATCH of messages with one bulk placement.
+
+        ``payloads`` is a sequence of messages; each message is a bytes-like
+        or a gather list of segments.  As many whole messages as current
+        credits allow are framed into one scratch image — payloads, padding
+        and footers — which lands in the peer ring as a single contiguous
+        span (≤2 ``write_fn`` segments at the wrap), followed by one 8-byte
+        header store per message.  The headers are the completion gates and
+        must become visible AFTER their payload+footer bytes, which the bulk
+        copy cannot order internally; everything else is one writev-style
+        placement instead of 3 stores per message.
+
+        Returns ``(messages_written, payload_bytes_written)``; messages are
+        all-or-nothing, in order, and the caller re-arms on credits for the
+        rest (same contract as :meth:`write`).
+        """
+        views_per_msg: List[List[memoryview]] = []
+        lens: List[int] = []
+        # Each accepted message shrinks the remaining writable payload by its
+        # whole span (writable_payload's 8-aligned invariant holds per
+        # message inductively: budget' = budget - span keeps the 8-byte gap
+        # before the consumer's head untouched for every prefix).
+        budget = self.writable_payload()
+        for p in payloads:
+            segs = ([memoryview(s).cast("B") for s in p]
+                    if isinstance(p, (list, tuple))
+                    else [memoryview(p).cast("B")])
+            ln = sum(len(v) for v in segs)
+            if ln == 0:
+                continue
+            if ln > budget:
+                break
+            views_per_msg.append(segs)
+            lens.append(ln)
+            budget -= message_span(ln)
+        if not views_per_msg:
+            return 0, 0
+        if len(views_per_msg) == 1:
+            return 1, self.writev(views_per_msg[0])
+        total_span = sum(message_span(ln) for ln in lens)
+        scratch = memoryview(bytearray(total_span))
+        rel = 0
+        seq = self.seq
+        for segs, ln in zip(views_per_msg, lens):
+            pos = rel + HEADER_BYTES
+            for v in segs:
+                scratch[pos:pos + len(v)] = v
+                pos += len(v)
+            footer_rel = rel + HEADER_BYTES + align_up(ln)
+            scratch[footer_rel:footer_rel + 8] = _U64.pack(footer_stamp(seq))
+            # header word stays zero in the image — placed individually below
+            rel += message_span(ln)
+            seq += 1
+        # one bulk placement: payloads + padding + footers, headers zeroed
+        self._put(self.tail, scratch)
+        # completion gates, in order, AFTER the bulk copy landed
+        rel = 0
+        seq = self.seq
+        for ln in lens:
+            self._put(self.tail + rel, _U64.pack(header_stamp(ln, seq)))
+            rel += message_span(ln)
+            seq += 1
+        payload_total = sum(lens)
+        ledger.host_copy(payload_total)
+        self.tail += total_span
+        self.seq = seq
+        return len(lens), payload_total
 
     def _writev_native(self, views: Sequence[memoryview],
                        payload_len: int) -> int:

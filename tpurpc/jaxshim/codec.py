@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import json
 import struct
-from typing import Any, List, Sequence, Tuple
+from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -202,18 +202,35 @@ def encode_tree_bytes(tree: Any) -> bytes:
     return b"".join(bytes(s) for s in encode_tree(tree))
 
 
-def decode_tree(buf, copy: bool = False, as_jax: bool = False) -> Any:
+def decode_tree(buf, copy: bool = False, as_jax: bool = False,
+                offset: int = 0) -> Any:
+    tree, _ = decode_tree_at(buf, offset, copy=copy, as_jax=as_jax)
+    return tree
+
+
+def decode_tree_at(buf, offset: int = 0, copy: bool = False,
+                   as_jax: bool = False) -> Tuple[Any, int]:
+    """Decode one tree record at ``offset``; returns ``(tree, next_offset)``.
+
+    All alignment arithmetic is RELATIVE to the record start, so records
+    decode identically at any position — the walk primitive behind
+    :func:`decode_tree_many`'s batched fast path over a contiguous drained
+    buffer (memoryview offsets all the way down, no intermediate ``bytes``
+    slices of the payload).
+    """
     import jax
 
     view = memoryview(buf)
-    magic, n, trailer_len = _TREE.unpack_from(view, 0)
+    if len(view) - offset < _TREE.size:
+        raise CodecError("short tree header")
+    magic, n, trailer_len = _TREE.unpack_from(view, offset)
     if magic != TREE_MAGIC:
         raise CodecError(f"bad tree magic {magic!r}")
-    pos = _TREE.size + ((-_TREE.size) % _ALIGN)
+    pos = offset + _TREE.size + ((-_TREE.size) % _ALIGN)
     leaves = []
     for _ in range(n):
         arr, pos = decode_tensor(view, pos, copy=copy)
-        pos += (-pos) % _ALIGN
+        pos += (-(pos - offset)) % _ALIGN
         leaves.append(to_jax(arr) if as_jax else arr)
     # Trailer sits at the decode cursor — never measure from the buffer end;
     # zero-copy receive windows may carry ring-alignment slack behind it.
@@ -221,7 +238,36 @@ def decode_tree(buf, copy: bool = False, as_jax: bool = False) -> Any:
         raise CodecError("short tree trailer")
     trailer = bytes(view[pos:pos + trailer_len])
     treedef = _treedef_from_json(json.loads(trailer.decode()))
-    return jax.tree_util.tree_unflatten(treedef, leaves)
+    return jax.tree_util.tree_unflatten(treedef, leaves), pos + trailer_len
+
+
+def decode_tree_many(buf, count: Optional[int] = None, copy: bool = False,
+                     as_jax: bool = False) -> List[Any]:
+    """Batched decode: walk a contiguous buffer of back-to-back tree records
+    (e.g. one ring drain's worth of messages) and return every tree.
+
+    With ``count=None`` the walk stops cleanly at the buffer end or at the
+    first position that does not start a record (zero-copy receive windows
+    may carry ring-alignment slack behind the last record); a ``count``
+    makes truncation an error instead. The buffer is sliced by memoryview
+    offsets only — one decode pass, no per-record ``bytes`` copies.
+    """
+    view = memoryview(buf)
+    out: List[Any] = []
+    pos = 0
+    while count is None or len(out) < count:
+        if len(view) - pos < _TREE.size:
+            if count is not None:
+                raise CodecError(
+                    f"short batch: {len(out)} of {count} tree records")
+            break
+        if bytes(view[pos:pos + 4]) != TREE_MAGIC:
+            if count is not None:
+                raise CodecError(f"bad tree magic at batch offset {pos}")
+            break
+        tree, pos = decode_tree_at(view, pos, copy=copy, as_jax=as_jax)
+        out.append(tree)
+    return out
 
 
 class _LeafSentinel:

@@ -108,6 +108,24 @@ def _load():
             ctypes.c_void_p, ctypes.c_char_p,
             ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,
             ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p]
+        # zero-copy send lease (the reference's SendZerocopy shape): gather
+        # segments serialize DIRECTLY into the transport ring — the staging
+        # join and the ctypes from_buffer_copy both disappear. Optional: a
+        # pre-round-5 .so has no lease entry points.
+        try:
+            u8p = ctypes.POINTER(ctypes.c_uint8)
+            lib.tpr_call_send_reserve2.restype = ctypes.c_int
+            lib.tpr_call_send_reserve2.argtypes = [
+                ctypes.c_void_p, ctypes.c_size_t, ctypes.c_int,
+                ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_size_t),
+                ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_size_t)]
+            lib.tpr_call_send_commit.restype = ctypes.c_int
+            lib.tpr_call_send_commit.argtypes = [ctypes.c_void_p]
+            lib.tpr_call_send_abort.restype = ctypes.c_int
+            lib.tpr_call_send_abort.argtypes = [ctypes.c_void_p]
+            lib._tpr_has_lease = True
+        except AttributeError:  # pre-round-6 .so: no fragment-aware lease
+            lib._tpr_has_lease = False
         _LIB = lib
         return lib
 
@@ -160,11 +178,81 @@ class NativeCall:
         self._lock = threading.Lock()
         self._on_close = on_close  # NativeChannel op release (exactly once)
 
+    #: lease path cut-in: below this a join+send is as cheap as the
+    #: reserve/commit round trips, and control-plane messages stay on the
+    #: battle-tested classic path
+    _LEASE_MIN = 64 * 1024
+    #: one ring message per frame — kMaxFramePayload (framing_common.h)
+    _LEASE_FRAME = 1 << 20
+
     def write(self, data, end_stream: bool = False) -> None:
+        if (getattr(self._lib, "_tpr_has_lease", False)
+                and isinstance(data, (list, tuple))):
+            segs = [v for v in (memoryview(s).cast("B") for s in data)
+                    if len(v)]
+            total = sum(len(v) for v in segs)
+            if total >= self._LEASE_MIN and self._write_lease(
+                    segs, total, end_stream):
+                return
         buf = _u8(data)
         if self._lib.tpr_call_send(self._call, buf, len(buf),
                                    1 if end_stream else 0) != 0:
             raise RpcError(StatusCode.UNAVAILABLE, "send failed")
+
+    def _write_lease(self, segs, total: int, end_stream: bool) -> bool:
+        """Gather ``segs`` straight into the transport ring via the
+        zero-copy send lease (tpr_call_send_reserve/commit): one
+        frame-sized reserve per ≤1 MiB chunk, segments copied in place
+        with memoryview slice assignment, commit publishes. Returns False
+        with NO bytes sent when the channel has no ring (first reserve
+        fails — the classic path handles it); raises on a mid-message
+        failure (the channel died; nothing can be un-sent)."""
+        lib = self._lib
+        p1 = ctypes.POINTER(ctypes.c_uint8)()
+        l1 = ctypes.c_size_t()
+        p2 = ctypes.POINTER(ctypes.c_uint8)()
+        l2 = ctypes.c_size_t()
+        sent = 0
+        si = 0  # segment cursor
+        so = 0  # offset within segs[si]
+        while sent < total:
+            n = min(total - sent, self._LEASE_FRAME)
+            last = sent + n == total
+            # non-final fragments carry MORE so the peer reassembles ONE
+            # message; END_STREAM only ever rides the final fragment
+            flags = (1 if end_stream else 0) if last else 2
+            if lib.tpr_call_send_reserve2(
+                    self._call, n, flags,
+                    ctypes.byref(p1), ctypes.byref(l1),
+                    ctypes.byref(p2), ctypes.byref(l2)) != 0:
+                if sent == 0:
+                    return False  # no ring under this channel: classic path
+                raise RpcError(StatusCode.UNAVAILABLE, "send failed")
+            try:
+                # ≤2 wrap-split ring spans; fill from the segment stream
+                for ptr, ln in ((p1, l1.value), (p2, l2.value)):
+                    if not ln:
+                        continue
+                    dst = memoryview(ctypes.cast(
+                        ptr, ctypes.POINTER(ctypes.c_uint8 * ln)).contents
+                    ).cast("B")
+                    off = 0
+                    while off < ln:
+                        seg = segs[si]
+                        take = min(len(seg) - so, ln - off)
+                        dst[off:off + take] = seg[so:so + take]
+                        off += take
+                        so += take
+                        if so == len(seg):
+                            si += 1
+                            so = 0
+            except BaseException:
+                lib.tpr_call_send_abort(self._call)  # release write_mu
+                raise
+            if lib.tpr_call_send_commit(self._call) != 0:
+                raise RpcError(StatusCode.UNAVAILABLE, "send failed")
+            sent += n
+        return True
 
     def writes_done(self) -> None:
         self._lib.tpr_call_writes_done(self._call)

@@ -39,9 +39,10 @@ import ctypes
 import os
 import socket
 import threading
+import weakref
 from typing import Dict, Optional
 
-from tpurpc.rpc.status import AbortError, StatusCode
+from tpurpc.rpc.status import AbortError, StatusCode, deserialize
 from tpurpc.utils.trace import TraceFlag
 
 trace_nsrv = TraceFlag("native_server")
@@ -200,12 +201,30 @@ class NativeServerContext:
         return 0 if default_ok else 13
 
 
-def _take(lib, pptr, plen) -> bytes:
-    try:
-        return ctypes.string_at(pptr, plen.value) if plen.value else b""
-    finally:
+def _take(lib, pptr, plen) -> memoryview:
+    """Adopt the C plane's message buffer ZERO-COPY.
+
+    Returns a writable memoryview directly over the malloc'd buffer
+    ``tpr_srv_recv`` handed us; a finalizer frees it when the last Python
+    reference (the view, or numpy arrays decoded over it) dies. The old
+    ``ctypes.string_at`` here was one whole extra pass over every received
+    message — and its read-only ``bytes`` result forced ``to_jax`` off the
+    writable-buffer dlpack import on top of that. ``alias_ok``
+    deserializers (the tensor codec) decode straight over this view;
+    everyone else gets grpcio-contract ``bytes`` via ``deserialize``.
+    """
+    n = plen.value
+    if not n:
         if pptr:
             lib.tpr_srv_buf_free(pptr)
+        return memoryview(b"")
+    addr = ctypes.cast(pptr, ctypes.c_void_p).value
+    raw = (ctypes.c_uint8 * n).from_address(addr)
+    # a fresh pointer object: the caller's pptr is reused per recv loop
+    owned = ctypes.cast(ctypes.c_void_p(addr),
+                        ctypes.POINTER(ctypes.c_uint8))
+    weakref.finalize(raw, lib.tpr_srv_buf_free, owned)
+    return memoryview(raw).cast("B")
 
 
 class NativeDataplane:
@@ -291,7 +310,8 @@ class NativeDataplane:
                                              ctypes.byref(plen))
                         if r != 1:
                             return
-                        yield _h.request_deserializer(_take(lib, pptr, plen))
+                        yield deserialize(_h.request_deserializer,
+                                          _take(lib, pptr, plen))
 
                 def send(resp) -> int:
                     raw = _h.response_serializer(resp)

@@ -153,15 +153,19 @@ class XdsServicer:
         def drain_requests():
             # ACKs and resubscriptions; a resource_names change re-arms
             # the push loop (the A* protocols allow re-subscribing on the
-            # same stream)
+            # same stream). The mutation, the flag, and the wakeup are ONE
+            # critical section with the push loop's snapshot+clear — a
+            # resubscription can land entirely before or entirely after a
+            # snapshot, never half inside it (ADVICE r5: the unlocked
+            # mutation relied on the 1 s wait timeout to be observed).
             for raw in req_iter:
                 upd = xds_v3.decode_discovery_request(raw)
                 if upd["resource_names"] and (upd["resource_names"]
                                               != subscribed):
-                    subscribed[:] = upd["resource_names"]
                     with self._lock:
+                        subscribed[:] = upd["resource_names"]
+                        sub_changed.set()
                         self._lock.notify_all()
-                    sub_changed.set()
 
         threading.Thread(target=drain_requests, daemon=True,
                          name="tpurpc-ads-v3-reader").start()
@@ -172,11 +176,18 @@ class XdsServicer:
                 current = [(name, tuple(self._assignments.get(name, [])))
                            for name in subscribed]
                 version = self._version
-                if current == last_sent and not sub_changed.is_set():
+                # Re-check AND clear under the same lock as the snapshot:
+                # the snapshot above already reflects any subscription the
+                # flag announced (both mutate under self._lock), so clearing
+                # here cannot eat a change the snapshot missed; one landing
+                # after release simply re-sets the flag for the next lap.
+                changed = sub_changed.is_set()
+                if changed:
+                    sub_changed.clear()
+                if current == last_sent and not changed:
                     self._lock.wait_for(lambda: self._version != version,
                                         timeout=1.0)
                     continue
-            sub_changed.clear()
             last_sent = current
             nonce += 1
             yield xds_v3.encode_discovery_response(

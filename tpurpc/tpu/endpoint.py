@@ -95,18 +95,10 @@ class TpuRingEndpoint(RingEndpoint):
 # DeserializeToDevice over the device ring.
 # ---------------------------------------------------------------------------
 
-def decode_tensor_to_ring(ring: HbmRing, buf, offset: int = 0,
-                          timeout: Optional[float] = PLACE_TIMEOUT_S
-                          ) -> Tuple[HbmLease, int]:
-    """One wire tensor record → device-ring placement + lease-backed view.
-
-    Parses the codec header host-side (control words), places ONLY the
-    payload span into ``ring`` directly from ``buf`` (no intermediate host
-    buffer — the ledger's host_copy stays 0 for this step), and returns
-    ``(lease, next_offset)``. ``lease.array`` is the shaped/dtyped device
-    view; releasing the lease returns the span's credit.
-    """
-    view = memoryview(buf)
+def _parse_tensor_record(view: memoryview, offset: int):
+    """Host-side parse of one codec tensor record: ``(dtype, shape,
+    payload_view, next_offset)`` with the payload as a zero-copy numpy view
+    over ``view`` — shared by the single and batched placement paths."""
     if len(view) - offset < codec._HDR.size:
         raise codec.CodecError("short tensor header")
     magic, code, ndim, _, nbytes = codec._HDR.unpack_from(view, offset)
@@ -126,9 +118,24 @@ def decode_tensor_to_ring(ring: HbmRing, buf, offset: int = 0,
         raise codec.CodecError(
             f"short tensor payload: want {nbytes}, have {len(view) - pos}")
     payload = np.frombuffer(view, dtype=np.uint8, count=nbytes, offset=pos)
+    return dt, shape, payload, pos + nbytes
+
+
+def decode_tensor_to_ring(ring: HbmRing, buf, offset: int = 0,
+                          timeout: Optional[float] = PLACE_TIMEOUT_S
+                          ) -> Tuple[HbmLease, int]:
+    """One wire tensor record → device-ring placement + lease-backed view.
+
+    Parses the codec header host-side (control words), places ONLY the
+    payload span into ``ring`` directly from ``buf`` (no intermediate host
+    buffer — the ledger's host_copy stays 0 for this step), and returns
+    ``(lease, next_offset)``. ``lease.array`` is the shaped/dtyped device
+    view; releasing the lease returns the span's credit.
+    """
+    dt, shape, payload, next_pos = _parse_tensor_record(memoryview(buf), offset)
     off, n = ring.place(payload, timeout=timeout)
     lease = ring.view(off, n, dtype=dt, shape=shape)
-    return lease, pos + nbytes
+    return lease, next_pos
 
 
 def decode_tree_to_ring(ring: HbmRing, buf,
@@ -159,24 +166,42 @@ def decode_tree_to_ring(ring: HbmRing, buf,
             f"tree payloads total {total} bytes > ring capacity "
             f"{ring.capacity}; raise TPURPC_HBM_RING_SIZE_KB")
     pos = codec._TREE.size + ((-codec._TREE.size) % codec._ALIGN)
+    # Batched placement: parse EVERY leaf header first (host control words),
+    # then land all payloads with ONE ring.place_many dispatch — one h2d +
+    # one donated update per tree instead of per leaf (ISSUE 1 tentpole;
+    # a transformer pytree has hundreds of leaves and paid a dispatch each).
+    metas = []  # (dtype, shape)
+    payloads = []
+    for _ in range(n_leaves):
+        dt, shape, payload, pos = _parse_tensor_record(view, pos)
+        pos += (-pos) % codec._ALIGN
+        metas.append((dt, shape))
+        payloads.append(payload)
+    if len(view) - pos < trailer_len:
+        raise codec.CodecError("short tree trailer")
+    spans = ring.place_many(payloads, timeout=timeout)
     leases: List[HbmLease] = []
     leaves = []
     try:
-        for _ in range(n_leaves):
-            lease, pos = decode_tensor_to_ring(ring, view, pos, timeout=timeout)
-            pos += (-pos) % codec._ALIGN
+        for (dt, shape), (off, n) in zip(metas, spans):
+            lease = ring.view(off, n, dtype=dt, shape=shape)
             leases.append(lease)
             leaves.append(lease.array)
-        if len(view) - pos < trailer_len:
-            raise codec.CodecError("short tree trailer")
         trailer = bytes(view[pos:pos + trailer_len])
         treedef = codec._treedef_from_json(json.loads(trailer.decode()))
         tree = jax.tree_util.tree_unflatten(treedef, leaves)
     except Exception:
         # Corrupt leaf, trailer, or treedef: every already-taken lease must
-        # go back, or a poison message permanently pins ring credit.
+        # go back, or a poison message permanently pins ring credit — and
+        # spans placed but never viewed must be consumed-and-released too,
+        # or the batch's tail spans block the head forever.
         for lease in leases:
             lease.release()
+        for off, n in spans[len(leases):]:
+            try:
+                ring.view(off, n).release()
+            except Exception:
+                pass  # span already torn down; nothing more to free
         raise
     return tree, leases
 

@@ -145,6 +145,14 @@ class HbmRing:
 
         import jax.numpy as jnp
 
+        # Order the alias read after every pending placement: the raw-pointer
+        # view below has NO dataflow dependency on the donated
+        # dynamic_update_slice that landed the span, and under JAX async
+        # dispatch (default-on for CPU) a consumer could otherwise read the
+        # span before place()'s update executed — stale tensor bytes on the
+        # zero-copy path (ADVICE r5, medium). Real hardware gets this
+        # ordering from the NIC's completion; the emulation must ask for it.
+        self.buf.block_until_ready()
         try:
             raw = (ctypes.c_uint8 * n).from_address(self._base_ptr + p)
             npv = np.ctypeslib.as_array(raw)
@@ -300,6 +308,69 @@ class HbmRing:
                 ledger.dma_d2d(n - first)
             self._assert_stable()
         return off, n
+
+    def place_many(self, payloads,
+                   timeout: Optional[float] = None) -> "list[Tuple[int, int]]":
+        """DMA a BATCH of payloads into the ring with ONE landing dispatch.
+
+        The payloads pack host-side into one contiguous image (one pass), move
+        with one h2d, and land with a single donated ``dynamic_update_slice``
+        (or one aliased ring_scatter kernel across the wrap) — one XLA
+        dispatch per *batch* instead of per tensor, the device half of the
+        batched receive pipeline.  Returns the per-payload ``(offset,
+        nbytes)`` spans, each leased/credited independently exactly as if
+        placed by :meth:`place` back to back.
+
+        Flow control matches :meth:`place` with the batch treated as one
+        unit: blocks up to ``timeout`` for the TOTAL to fit; a batch larger
+        than the whole ring raises."""
+        import jax
+
+        srcs = [np.frombuffer(p, np.uint8) if not isinstance(p, np.ndarray)
+                else p.reshape(-1).view(np.uint8) for p in payloads]
+        lens = [s.nbytes for s in srcs]
+        total = sum(lens)
+        if total == 0:
+            return [(self.tail, 0) for _ in srcs]
+        if total > self.capacity:
+            raise BufferError(
+                f"batch of {total} bytes exceeds ring capacity {self.capacity}")
+        with self._lock:
+            if total > self.writable() and timeout is not None:
+                import time as _time
+                deadline = _time.monotonic() + timeout
+                while total > self.writable():
+                    remain = deadline - _time.monotonic()
+                    if remain <= 0 or not self._space.wait(timeout=remain):
+                        break
+            if total > self.writable():
+                raise BufferError(
+                    f"HBM ring full: {total} > {self.writable()}")
+            off = self.tail
+            self.tail += total
+            spans = []
+            for n in lens:
+                if n:  # zero-size spans hold no credit (see place())
+                    self._live[(off, n)] = [0, False]
+                spans.append((off, n))
+                off += n
+            packed = np.concatenate(srcs) if len(srcs) > 1 else srcs[0]
+            p = spans[0][0] & self._mask
+            dev = jax.device_put(jax.numpy.asarray(packed), self.device)
+            ledger.dma_h2d(total)
+            first = min(total, self.capacity - p)
+            if first >= total:  # unwrapped: one donated landing write
+                self.buf = self._update(self.buf, dev, p)
+                ledger.dma_d2d(total)
+            elif self._pallas_place(dev, p, total):
+                ledger.dma_d2d(total)  # one aliased kernel write at the wrap
+            else:
+                self.buf = self._update(self.buf, dev[:first], p)
+                ledger.dma_d2d(first)
+                self.buf = self._update(self.buf, dev[first:], 0)
+                ledger.dma_d2d(total - first)
+            self._assert_stable()
+        return spans
 
     def _assert_stable(self) -> None:
         """Donation-stability invariant behind the dlpack aliases (called

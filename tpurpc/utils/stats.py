@@ -156,6 +156,119 @@ def print_table() -> str:
 
 
 # ---------------------------------------------------------------------------
+# Batch + wakeup counters — the batched receive pipeline's observability.
+#
+# Always on (unlike the profile spans): one lock-guarded integer bump per
+# BATCH, which is exactly the amortization the pipeline exists to buy — if
+# these counters were per-message they would be part of the problem they
+# measure. The bench reads them to report batch_msgs_per_wakeup and the
+# adaptive poller's spin/sleep ratio (ISSUE 1 acceptance).
+# ---------------------------------------------------------------------------
+
+class BatchHist:
+    """Thread-safe size histogram for per-batch counts.
+
+    Batch sizes are small integers, so counts are EXACT below
+    ``_EXACT_MAX`` (percentiles come out precise, unlike the log-bucketed
+    latency hist whose bucket upper bounds would double-count small
+    batches); larger sizes clamp into the top bucket."""
+
+    _EXACT_MAX = 4096
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: Dict[int, int] = defaultdict(int)
+        self._total = 0
+        self._n = 0
+        self._max = 0
+
+    def record(self, n: int) -> None:
+        if n <= 0:
+            return
+        with self._lock:
+            self._counts[min(n, self._EXACT_MAX)] += 1
+            self._total += n
+            self._n += 1
+            if n > self._max:
+                self._max = n
+
+    def _percentile_locked(self, q: float) -> int:
+        target = math.ceil(self._n * q)
+        seen = 0
+        for size in sorted(self._counts):
+            seen += self._counts[size]
+            if seen >= target:
+                return size
+        return self._max
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            if self._n == 0:
+                return {"count": 0, "mean": 0.0, "p50": 0, "p99": 0, "max": 0}
+            return {
+                "count": self._n,
+                "mean": round(self._total / self._n, 2),
+                "p50": self._percentile_locked(0.5),
+                "p99": self._percentile_locked(0.99),
+                "max": self._max,
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts.clear()
+            self._total = 0
+            self._n = 0
+            self._max = 0
+
+
+_batch_lock = threading.Lock()
+_batch_hists: Dict[str, BatchHist] = {}
+_counters: Dict[str, int] = defaultdict(int)
+_counter_lock = threading.Lock()
+
+
+def batch_hist(name: str) -> BatchHist:
+    """Named batch-size histogram (created on first use). Canonical names:
+    ``ring_drain`` (messages per receive drain), ``ring_write`` (messages
+    per gathered send batch), ``h2_data_coalesce`` (DATA frames merged per
+    dispatch)."""
+    with _batch_lock:
+        h = _batch_hists.get(name)
+        if h is None:
+            h = _batch_hists[name] = BatchHist()
+        return h
+
+
+def counter_inc(name: str, n: int = 1) -> None:
+    """Bump a named monotonic counter. Canonical names: ``wait_spin_hit`` /
+    ``wait_spin_miss`` (hybrid busy window fired / expired), ``wait_sleep``
+    (waiter parked on fds), ``poller_scan_hot`` / ``poller_scan_idle``
+    (background scans that found / did not find work)."""
+    with _counter_lock:
+        _counters[name] += n
+
+
+def counters_snapshot() -> Dict[str, int]:
+    with _counter_lock:
+        return dict(_counters)
+
+
+def batch_snapshot() -> Dict[str, Dict[str, float]]:
+    with _batch_lock:
+        hists = dict(_batch_hists)
+    return {name: h.snapshot() for name, h in hists.items()}
+
+
+def reset_batch_stats() -> None:
+    """Zero the batch histograms and counters (bench round isolation)."""
+    with _batch_lock:
+        for h in _batch_hists.values():
+            h.reset()
+    with _counter_lock:
+        _counters.clear()
+
+
+# ---------------------------------------------------------------------------
 # Copy ledger — new in tpurpc (BASELINE.md target: receive-path host memcpy == 0).
 # ---------------------------------------------------------------------------
 

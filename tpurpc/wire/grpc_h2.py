@@ -27,6 +27,7 @@ from typing import Dict, List, Optional, Tuple
 
 from tpurpc.core.endpoint import Endpoint, EndpointError
 from tpurpc.rpc.status import AbortError, Metadata, StatusCode
+from tpurpc.utils import stats as _stats
 from tpurpc.wire import h2
 from tpurpc.wire.hpack import HpackDecoder, HpackEncoder, HpackError
 
@@ -208,13 +209,14 @@ class GrpcH2Connection:
         # lift the connection-level receive window too
         self._write(h2.pack_window_update(0, RECV_WINDOW - h2.DEFAULT_WINDOW))
 
-    def _send_header_block(self, sid: int, block: bytes,
-                           end_stream: bool) -> None:
-        """Emit one logical header block as HEADERS (+ CONTINUATIONs when the
+    def _header_block_segs(self, sid: int, block: bytes,
+                           end_stream: bool) -> List[bytes]:
+        """One logical header block as HEADERS (+ CONTINUATIONs when the
         encoded block exceeds the peer's SETTINGS_MAX_FRAME_SIZE — e.g. a large
-        trailing ``-bin`` metadata blob). END_HEADERS only on the last
-        fragment; an oversized single frame is a FRAME_SIZE_ERROR that kills
-        the whole connection on a compliant peer (RFC 7540 §4.2)."""
+        trailing ``-bin`` metadata blob), returned as gather segments.
+        END_HEADERS only on the last fragment; an oversized single frame is a
+        FRAME_SIZE_ERROR that kills the whole connection on a compliant peer
+        (RFC 7540 §4.2)."""
         limit = self._peer_max_frame
         es = h2.FLAG_END_STREAM if end_stream else 0
         frags = [block[i:i + limit] for i in range(0, len(block), limit)] or [b""]
@@ -225,19 +227,32 @@ class GrpcH2Connection:
             if i == len(frags) - 1:
                 flags |= h2.FLAG_END_HEADERS
             segs.extend(h2.pack_frame(ftype, flags, sid, frag))
-        # one gather write: CONTINUATIONs must be contiguous on the wire
-        self._write(segs)
+        return segs
 
-    def send_response_headers(self, st: _H2Stream, metadata: Metadata = ()) -> None:
+    def _send_header_block(self, sid: int, block: bytes,
+                           end_stream: bool) -> None:
+        # one gather write: CONTINUATIONs must be contiguous on the wire
+        self._write(self._header_block_segs(sid, block, end_stream))
+
+    def _response_header_segs(self, st: _H2Stream,
+                              metadata: Metadata = ()) -> List[bytes]:
+        """Initial-metadata HEADERS segments (marks them sent), or [] when
+        already sent — the building block send paths gather into one write."""
         if st.headers_sent:
-            return
+            return []
         st.headers_sent = True
         hdrs = [(":status", "200"), ("content-type", "application/grpc"),
                 ("grpc-accept-encoding", "identity,gzip,deflate")]
         for k, v in metadata:
             hdrs.append((k.lower(), _encode_metadata_value(k.lower(), v)))
-        self._send_header_block(st.stream_id, self._encoder.encode(hdrs),
-                                end_stream=False)
+        return self._header_block_segs(st.stream_id,
+                                       self._encoder.encode(hdrs),
+                                       end_stream=False)
+
+    def send_response_headers(self, st: _H2Stream, metadata: Metadata = ()) -> None:
+        segs = self._response_header_segs(st, metadata)
+        if segs:
+            self._write(segs)
 
     def send_message(self, st: _H2Stream, payload) -> None:
         if isinstance(payload, (list, tuple)):
@@ -268,17 +283,48 @@ class GrpcH2Connection:
             self._write(h2.pack_frame(h2.DATA, 0, st.stream_id, bytes(chunk)))
             pos += got
 
-    def send_trailers(self, st: _H2Stream, code: StatusCode, details: str,
-                      metadata: Metadata = ()) -> None:
-        if not st.headers_sent:
-            self.send_response_headers(st)
+    def _trailer_segs(self, st: _H2Stream, code: StatusCode, details: str,
+                      metadata: Metadata = ()) -> List[bytes]:
         hdrs = [("grpc-status", str(int(code)))]
         if details:
             hdrs.append(("grpc-message", _pct_encode(details)))
         for k, v in metadata:
             hdrs.append((k.lower(), _encode_metadata_value(k.lower(), v)))
-        self._send_header_block(st.stream_id, self._encoder.encode(hdrs),
-                                end_stream=True)
+        return self._header_block_segs(st.stream_id,
+                                       self._encoder.encode(hdrs),
+                                       end_stream=True)
+
+    def send_trailers(self, st: _H2Stream, code: StatusCode, details: str,
+                      metadata: Metadata = ()) -> None:
+        # initial metadata (when still unsent) and trailers gather into ONE
+        # endpoint write — trailers-only responses cost a single syscall
+        segs = self._response_header_segs(st)
+        segs += self._trailer_segs(st, code, details, metadata)
+        self._write(segs)
+
+    def _send_unary_fused(self, st: _H2Stream, payload, code: StatusCode,
+                          details: str, metadata: Metadata = ()) -> bool:
+        """The unary fast path: initial metadata + the whole response message
+        + trailers in ONE gather write, when the message fits a single DATA
+        frame and both flow-control windows can reserve it without blocking.
+        Returns False (nothing written) to use the chunked blocking path."""
+        if isinstance(payload, (list, tuple)):
+            payload = b"".join(bytes(p) for p in payload)
+        else:
+            payload = bytes(payload)
+        data = _GRPC_MSG_HDR.pack(0, len(payload)) + payload
+        if len(data) > self._peer_max_frame or st.window is None:
+            return False
+        if not st.window.try_take(len(data)):
+            return False
+        if not self._conn_window.try_take(len(data)):
+            st.window.grant(len(data))
+            return False
+        segs = self._response_header_segs(st)
+        segs += h2.pack_frame(h2.DATA, 0, st.stream_id, data)
+        segs += self._trailer_segs(st, code, details, metadata)
+        self._write(segs)
+        return True
 
     # -- reading -------------------------------------------------------------
 
@@ -293,18 +339,50 @@ class GrpcH2Connection:
                         return
                     self._preface_left -= n
                     continue
-                frame = self._scanner.next_frame()
-                if frame is None:
+                frames = self._scanner.next_frames()
+                if not frames:
                     n = self.endpoint.read_into(mv)
                     if n == 0:
                         return
                     self._scanner.feed(mv[:n])
                     continue
-                self._dispatch(*frame)
+                self._dispatch_burst(frames)
         except (EndpointError, h2.H2Error, HpackError, OSError) as exc:
             _log.debug("h2 connection error: %s", exc)
         finally:
             self._shutdown()
+
+    def _dispatch_burst(self, frames) -> None:
+        """Dispatch one transport read's worth of frames, coalescing runs of
+        consecutive DATA frames on the same stream into a single payload
+        span (one ``_on_data`` — one window-update write and one gRPC
+        reassembly pass — per run instead of per frame)."""
+        i = 0
+        n = len(frames)
+        while i < n:
+            ftype, flags, sid, payload = frames[i]
+            if ftype != h2.DATA or self._headers_frag is not None:
+                self._dispatch(ftype, flags, sid, payload)
+                i += 1
+                continue
+            datas = [h2.strip_padding(flags, payload, has_priority=False)]
+            consumed = len(payload)
+            last_flags = flags
+            j = i + 1
+            while (j < n and not last_flags & h2.FLAG_END_STREAM):
+                ft2, fl2, sid2, pl2 = frames[j]
+                if ft2 != h2.DATA or sid2 != sid:
+                    break
+                datas.append(h2.strip_padding(fl2, pl2, has_priority=False))
+                consumed += len(pl2)
+                last_flags = fl2
+                j += 1
+            if j - i > 1:
+                _stats.batch_hist("h2_data_coalesce").record(j - i)
+            self._on_data(sid, last_flags,
+                          b"".join(datas) if len(datas) > 1 else datas[0],
+                          consumed)
+            i = j
 
     def _dispatch(self, ftype: int, flags: int, sid: int, payload: bytes) -> None:
         if self._headers_frag is not None and ftype != h2.CONTINUATION:
@@ -361,7 +439,9 @@ class GrpcH2Connection:
                 self._on_headers(fsid, bytes(buf),
                                  bool(fflags & h2.FLAG_END_STREAM))
         elif ftype == h2.DATA:
-            self._on_data(sid, flags, payload)
+            self._on_data(sid, flags,
+                          h2.strip_padding(flags, payload, has_priority=False),
+                          len(payload))
         elif ftype == h2.RST_STREAM:
             with self._lock:
                 st = self._streams.pop(sid, None)
@@ -425,16 +505,21 @@ class GrpcH2Connection:
             # server cannot run handlers kills itself so clients redial.
             self.close()
 
-    def _on_data(self, sid: int, flags: int, payload: bytes) -> None:
-        data = h2.strip_padding(flags, payload, has_priority=False)
+    def _on_data(self, sid: int, flags: int, data: bytes,
+                 consumed: int) -> None:
+        """``data`` is the padding-stripped payload (possibly several
+        coalesced DATA frames' worth); ``consumed`` the flow-control bytes
+        the run occupied on the wire (RFC 7540 §6.9 counts padding)."""
         with self._lock:
             st = self._streams.get(sid)
         # flow control: grant back what we consumed, always (even on unknown
-        # streams — the bytes crossed the connection window regardless)
-        if payload:
-            self._write(h2.pack_window_update(0, len(payload)))
+        # streams — the bytes crossed the connection window regardless).
+        # Both grants ride ONE endpoint write.
+        if consumed:
+            segs = h2.pack_window_update(0, consumed)
             if st is not None:
-                self._write(h2.pack_window_update(sid, len(payload)))
+                segs = segs + h2.pack_window_update(sid, consumed)
+            self._write(segs)
         if st is None:
             return
         st.partial += data
@@ -509,8 +594,8 @@ class GrpcH2Connection:
 
             result = handler.behavior(request_in, ctx)
 
-            self.send_response_headers(st)
             if handler.response_streaming:
+                self.send_response_headers(st)
                 for response in result:
                     if not ctx.is_active():
                         return
@@ -519,9 +604,19 @@ class GrpcH2Connection:
                                            "deadline exceeded", ctx._trailing)
                         return
                     self.send_message(st, handler.response_serializer(response))
+            elif ctx.is_active():
+                # unary: headers + message + trailers fuse into one endpoint
+                # write when windows allow (the h2 mirror of the native
+                # framing's send_many fast path); else the chunked path below
+                code = ctx._code if ctx._code is not None else StatusCode.OK
+                payload = handler.response_serializer(result)
+                if self._send_unary_fused(st, payload, code, ctx._details,
+                                          ctx._trailing):
+                    return code is StatusCode.OK
+                self.send_response_headers(st)
+                self.send_message(st, payload)
             else:
-                if ctx.is_active():
-                    self.send_message(st, handler.response_serializer(result))
+                self.send_response_headers(st)
             if ctx.is_active():
                 code = ctx._code if ctx._code is not None else StatusCode.OK
                 self.send_trailers(st, code, ctx._details, ctx._trailing)

@@ -166,6 +166,16 @@ class FlowWindow:
             self._dead = True
             self._cv.notify_all()
 
+    def try_take(self, want: int) -> bool:
+        """Reserve exactly ``want`` bytes iff fully available right now —
+        the non-blocking probe the fused (single-write) response path uses;
+        callers fall back to the blocking chunked path on False."""
+        with self._cv:
+            if self._dead or self._value < want:
+                return False
+            self._value -= want
+            return True
+
 
 class FrameScanner:
     """Incremental frame parser over a growing byte buffer."""
@@ -188,3 +198,16 @@ class FrameScanner:
         payload = bytes(self.buf[9:9 + length])
         del self.buf[:9 + length]
         return ftype, flags, stream_id, payload
+
+    def next_frames(self) -> List[Tuple[int, int, int, bytes]]:
+        """Every complete frame currently buffered, in order (the burst the
+        last transport read delivered). One endpoint read on the tensor path
+        typically carries a run of DATA frames for one stream — returning
+        the burst lets receivers coalesce them into a single dispatch
+        instead of re-entering the parser per frame."""
+        out: List[Tuple[int, int, int, bytes]] = []
+        while True:
+            f = self.next_frame()
+            if f is None:
+                return out
+            out.append(f)

@@ -39,6 +39,7 @@ from typing import Dict, Iterable, List, Optional, Tuple
 
 from tpurpc.core.endpoint import Endpoint, EndpointError, ReadTimeout, TcpEndpoint
 from tpurpc.rpc.status import Metadata, RpcError, StatusCode
+from tpurpc.utils import stats as _stats
 from tpurpc.wire import h2
 from tpurpc.wire.grpc_h2 import (RECV_WINDOW, _decode_metadata_value,
                                  _encode_metadata_value, decode_grpc_message)
@@ -227,19 +228,48 @@ class H2Channel:
     def _read_loop(self) -> None:
         scanner = h2.FrameScanner()
         hdr_accum: Optional[Tuple[int, int, bytearray]] = None  # sid, flags, block
+        pending: List[Tuple[int, int, int, bytes]] = []  # burst being walked
         try:
             while True:
-                frame = scanner.next_frame()
-                if frame is None:
+                if not pending:
+                    pending = scanner.next_frames()
+                if not pending:
                     data = self._ep.read(1 << 20)
                     if not data:
                         self._die("server closed connection")
                         return
                     scanner.feed(data)
                     continue
-                ftype, flags, sid, payload = frame
+                ftype, flags, sid, payload = pending[0]
                 if hdr_accum is not None and ftype != h2.CONTINUATION:
                     raise h2.H2Error("expected CONTINUATION")
+                if ftype == h2.DATA:
+                    # Coalesce the burst's run of DATA frames for this stream
+                    # into ONE reassembly pass + ONE window-update write —
+                    # a 4 MiB tensor response arrives as ≥256 DATA frames
+                    # and per-frame dispatch was a measured hot spot.
+                    datas = [h2.strip_padding(flags, payload,
+                                              has_priority=False)]
+                    consumed = len(payload)
+                    taken = 1
+                    while (taken < len(pending)
+                           and not flags & h2.FLAG_END_STREAM):
+                        ft2, fl2, sid2, pl2 = pending[taken]
+                        if ft2 != h2.DATA or sid2 != sid:
+                            break
+                        datas.append(h2.strip_padding(fl2, pl2,
+                                                      has_priority=False))
+                        consumed += len(pl2)
+                        flags = fl2
+                        taken += 1
+                    del pending[:taken]
+                    if taken > 1:
+                        _stats.batch_hist("h2_data_coalesce").record(taken)
+                    self._on_data(sid, flags,
+                                  b"".join(datas) if len(datas) > 1
+                                  else datas[0], consumed)
+                    continue
+                del pending[:1]
                 if ftype == h2.HEADERS:
                     block = bytearray(
                         h2.strip_padding(flags, payload, has_priority=True))
@@ -255,8 +285,6 @@ class H2Channel:
                         sid0, flags0, block = hdr_accum
                         hdr_accum = None
                         self._on_headers(sid0, flags0, block)
-                elif ftype == h2.DATA:
-                    self._on_data(sid, flags, payload)
                 elif ftype == h2.SETTINGS:
                     self._on_settings(flags, payload)
                 elif ftype == h2.WINDOW_UPDATE:
@@ -342,17 +370,19 @@ class H2Channel:
         else:
             call.deliver_initial(md)
 
-    def _on_data(self, sid: int, flags: int, payload: bytes) -> None:
-        data = h2.strip_padding(flags, payload, has_priority=False)
+    def _on_data(self, sid: int, flags: int, data: bytes,
+                 consumed: int) -> None:
+        """``data`` is padding-stripped (possibly a whole coalesced run of
+        DATA frames); ``consumed`` the wire-level flow-control bytes.
+        RFC 7540 §6.9: flow control covers the ENTIRE DATA payload including
+        padding, so the grant uses ``consumed``, not ``len(data)`` —
+        stripping-before-granting leaks the pad bytes until the sender's
+        view of our window runs dry."""
         call = self._get_call(sid)
         if call is not None and data:
             call.feed_data(data)
         # Replenish both windows aggressively (we sized RECV_WINDOW for
-        # tensors). RFC 7540 §6.9: flow control covers the ENTIRE DATA
-        # payload including padding, so grant len(payload), not len(data) —
-        # stripping-before-granting leaks the pad bytes until the sender's
-        # view of our window runs dry.
-        consumed = len(payload)
+        # tensors).
         if consumed:
             segs = h2.pack_window_update(0, consumed)
             if call is not None:
