@@ -1,0 +1,471 @@
+"""tpurpc.analysis: lint fixtures, lock-order detector, ring model checker.
+
+Three layers (ISSUE 2):
+* AST lint — positive/negative fixtures per rule, and the repo-wide gate
+  (the tree must be clean, with zero copy-suppressions in hot modules).
+* CheckedLock — a seeded lock-order cycle the detector must flag, the
+  self-deadlock trap, cv-wait-while-holding, and blocking-call notes.
+* ringcheck — the exhaustive suites must pass on the real protocol and
+  reject every seeded mutant.
+
+Plus regression tests for the concurrency fixes this subsystem surfaced
+(poller start/stop, channelz counter snapshots, xds subscription handoff).
+"""
+
+import threading
+
+import pytest
+
+from tpurpc.analysis import lint, locks, ringcheck
+from tpurpc.analysis.lint import lint_source
+
+
+def _rules(violations):
+    return sorted({v.rule for v in violations})
+
+
+# ---------------------------------------------------------------------------
+# lint: lease pairing
+# ---------------------------------------------------------------------------
+
+LEASE_OK = '''
+def write_lease(lib, call, segs):
+    if lib.tpr_call_send_reserve2(call) != 0:
+        return False
+    try:
+        fill(segs)
+    except BaseException:
+        lib.tpr_call_send_abort(call)
+        raise
+    if lib.tpr_call_send_commit(call) != 0:
+        raise RuntimeError("send failed")
+    return True
+'''
+
+LEASE_NO_COMMIT = '''
+def write_lease(lib, call, segs):
+    lib.tpr_call_send_reserve2(call)
+    try:
+        fill(segs)
+    except BaseException:
+        lib.tpr_call_send_abort(call)
+        raise
+'''
+
+LEASE_NO_ABORT = '''
+def write_lease(lib, call, segs):
+    lib.tpr_call_send_reserve2(call)
+    fill(segs)
+    lib.tpr_call_send_commit(call)
+'''
+
+LEASE_ABORT_NOT_EXCEPTIONAL = '''
+def write_lease(lib, call, segs):
+    lib.tpr_call_send_reserve2(call)
+    if not fill(segs):
+        lib.tpr_call_send_abort(call)
+        return False
+    lib.tpr_call_send_commit(call)
+    return True
+'''
+
+LEASE_UNCOVERED_FILL = '''
+def write_lease(lib, call, segs):
+    lib.tpr_call_send_reserve2(call)
+    fill(segs)  # raises -> lease leaks: not inside the try
+    try:
+        fill(segs)
+    except BaseException:
+        lib.tpr_call_send_abort(call)
+        raise
+    lib.tpr_call_send_commit(call)
+'''
+
+
+def test_lease_pairing_positive():
+    assert lint_source(LEASE_OK, "fixture.py") == []
+
+
+def test_lease_missing_commit_flagged():
+    vs = lint_source(LEASE_NO_COMMIT, "fixture.py")
+    assert _rules(vs) == ["lease"] and "never commits" in vs[0].message
+
+
+def test_lease_missing_abort_flagged():
+    vs = lint_source(LEASE_NO_ABORT, "fixture.py")
+    assert _rules(vs) == ["lease"] and "exception path" in vs[0].message
+
+
+def test_lease_abort_outside_handler_flagged():
+    vs = lint_source(LEASE_ABORT_NOT_EXCEPTIONAL, "fixture.py")
+    assert _rules(vs) == ["lease"]
+
+
+def test_lease_uncovered_fill_flagged():
+    vs = lint_source(LEASE_UNCOVERED_FILL, "fixture.py")
+    assert any("not covered" in v.message for v in vs)
+
+
+def test_lease_suppression():
+    src = LEASE_NO_COMMIT.replace(
+        "lib.tpr_call_send_reserve2(call)",
+        "lib.tpr_call_send_reserve2(call)  # tpr: allow(lease)")
+    assert lint_source(src, "fixture.py") == []
+
+
+# ---------------------------------------------------------------------------
+# lint: hot-path no-copy
+# ---------------------------------------------------------------------------
+
+def test_copy_join_flagged_in_hot_module():
+    src = 'def f(parts):\n    return b"".join(parts)\n'
+    vs = lint_source(src, "fixture.py", hot_copy=True)
+    assert _rules(vs) == ["copy"]
+    # the same source outside a hot module passes
+    assert lint_source(src, "fixture.py", hot_copy=False) == []
+
+
+def test_copy_from_buffer_copy_flagged():
+    src = "def f(ctypes, v):\n    return (ctypes.c_uint8 * 4).from_buffer_copy(v)\n"
+    assert _rules(lint_source(src, "fixture.py", hot_copy=True)) == ["copy"]
+
+
+def test_copy_slice_to_bytes_flagged():
+    src = "def f(buf, n):\n    return bytes(buf[:n])\n"
+    assert _rules(lint_source(src, "fixture.py", hot_copy=True)) == ["copy"]
+
+
+def test_copy_tobytes_escape_hatch_allowed():
+    src = ("def f(buf, n):\n"
+           "    mv = memoryview(buf)\n"
+           "    return mv[:n].tobytes()\n")
+    assert lint_source(src, "fixture.py", hot_copy=True) == []
+
+
+def test_copy_suppression_comment():
+    src = 'def f(parts):\n    return b"".join(parts)  # tpr: allow(copy)\n'
+    assert lint_source(src, "fixture.py", hot_copy=True) == []
+
+
+def test_hot_modules_carry_no_copy_suppressions():
+    """Acceptance: the data-plane modules are clean WITHOUT suppressions."""
+    import os
+
+    root = os.path.dirname(lint.tree_root())
+    for suffix in lint.HOT_COPY_MODULES:
+        path = os.path.join(root, suffix)
+        with open(path, "r", encoding="utf-8") as f:
+            src = f.read()
+        assert "allow(copy" not in src, f"{suffix} suppresses the copy rule"
+        assert lint_source(src, path) == []
+
+
+# ---------------------------------------------------------------------------
+# lint: lock map
+# ---------------------------------------------------------------------------
+
+LOCKMAP_OK = '''
+class Pool:
+    _GUARDED_BY = {"items": "_lock", "count": "_lock"}
+
+    def __init__(self):
+        self.items = []   # __init__ exempt: construction happens-before
+        self.count = 0
+
+    def add(self, x):
+        with self._lock:
+            self.items.append(x)
+            self.count += 1
+'''
+
+LOCKMAP_BAD = '''
+class Pool:
+    _GUARDED_BY = {"items": "_lock"}
+
+    def add(self, x):
+        self.items.append(x)
+
+    def reset(self):
+        self.items[:] = []
+'''
+
+
+def test_lockmap_positive():
+    assert lint_source(LOCKMAP_OK, "fixture.py") == []
+
+
+def test_lockmap_unlocked_mutations_flagged():
+    vs = lint_source(LOCKMAP_BAD, "fixture.py")
+    assert _rules(vs) == ["lock"] and len(vs) == 2  # append + slice-assign
+
+
+def test_lockmap_wrong_lock_flagged():
+    src = LOCKMAP_OK.replace('with self._lock:', 'with self._other:')
+    vs = lint_source(src, "fixture.py")
+    assert _rules(vs) == ["lock"]
+
+
+# ---------------------------------------------------------------------------
+# lint: monotonic clocks
+# ---------------------------------------------------------------------------
+
+def test_wallclock_flagged_and_suppressable():
+    src = "import time\n\ndef f():\n    return time.time()\n"
+    assert _rules(lint_source(src, "fixture.py")) == ["wallclock"]
+    ok = src.replace("time.time()", "time.time()  # tpr: allow(wallclock)")
+    assert lint_source(ok, "fixture.py") == []
+    mono = src.replace("time.time()", "time.monotonic()")
+    assert lint_source(mono, "fixture.py") == []
+
+
+# ---------------------------------------------------------------------------
+# the repo-wide gate
+# ---------------------------------------------------------------------------
+
+def test_tree_is_lint_clean():
+    violations = lint.lint_tree()
+    assert violations == [], "\n".join(map(str, violations))
+
+
+# ---------------------------------------------------------------------------
+# runtime lock-order detector
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(autouse=True)
+def _clean_lock_state():
+    locks.reset_lock_state()
+    yield
+    locks.reset_lock_state()
+
+
+def test_checked_lock_passthrough_semantics():
+    lk = locks.CheckedLock("t.lk")
+    with lk:
+        assert lk.locked()
+    assert not lk.locked()
+    assert lk.acquire(blocking=False)
+    lk.release()
+
+
+def test_lock_order_cycle_reported():
+    a = locks.CheckedLock("t.A")
+    b = locks.CheckedLock("t.B")
+
+    def order_ab():
+        with a:
+            with b:
+                pass
+
+    def order_ba():
+        with b:
+            with a:
+                pass
+
+    t1 = threading.Thread(target=order_ab)
+    t1.start()
+    t1.join()
+    t2 = threading.Thread(target=order_ba)
+    t2.start()
+    t2.join()
+    v = locks.lock_violations()
+    assert any("lock-order cycle" in m and "t.A" in m and "t.B" in m
+               for m in v), v
+
+
+def test_lock_order_cycle_by_name_across_instances():
+    """Lockdep-style: two INSTANCES of the same named lock form one graph
+    node, so the cycle is caught without the same objects ever deadlocking."""
+    a1, a2 = locks.CheckedLock("t.A"), locks.CheckedLock("t.A")
+    b = locks.CheckedLock("t.B")
+    with a1:
+        with b:
+            pass
+    with b:
+        with a2:
+            pass
+    assert any("lock-order cycle" in m for m in locks.lock_violations())
+
+
+def test_no_cycle_no_violation():
+    a = locks.CheckedLock("t.A")
+    b = locks.CheckedLock("t.B")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert locks.lock_violations() == []
+
+
+def test_self_deadlock_trapped():
+    lk = locks.CheckedLock("t.self")
+    with lk:
+        with pytest.raises(RuntimeError, match="re-acquire"):
+            lk.acquire()
+    assert any("self-deadlock" in m for m in locks.lock_violations())
+
+
+def test_cv_wait_while_holding_other_lock_flagged():
+    other = locks.CheckedLock("t.other")
+    cv = locks.checked_condition("t.cv")
+    with other:
+        with cv:
+            cv.wait(timeout=0.01)
+    assert any("cv-wait" in m and "t.other" in m
+               for m in locks.lock_violations())
+
+
+def test_cv_wait_alone_is_clean():
+    cv = locks.checked_condition("t.cv")
+    with cv:
+        cv.wait(timeout=0.01)
+    assert locks.lock_violations() == []
+
+
+def test_note_blocking_flags_held_locks(monkeypatch):
+    monkeypatch.setattr(locks, "ENABLED", True)
+    lk = locks.CheckedLock("t.held")
+    locks.note_blocking("socket recv")  # nothing held: no violation
+    assert locks.lock_violations() == []
+    with lk:
+        locks.note_blocking("socket recv")
+    assert any("held across blocking call" in m
+               for m in locks.lock_violations())
+
+
+def test_factories_are_zero_overhead_when_disabled(monkeypatch):
+    monkeypatch.setattr(locks, "ENABLED", False)
+    assert type(locks.make_lock("x")) is type(threading.Lock())
+    assert isinstance(locks.make_condition("x"), threading.Condition)
+    monkeypatch.setattr(locks, "ENABLED", True)
+    assert isinstance(locks.make_lock("x"), locks.CheckedLock)
+    assert isinstance(locks.make_condition("x"), locks.CheckedCondition)
+
+
+# ---------------------------------------------------------------------------
+# ring model checker
+# ---------------------------------------------------------------------------
+
+def test_ring_protocol_exhaustive_ok():
+    for res in ringcheck.default_suite():
+        assert res.ok, repr(res)
+        assert res.states > 0
+
+
+def test_ring_capacity4_exhausts_with_wrap():
+    # 3 messages x span 3 through a 4-word ring: every offset wraps twice
+    res = ringcheck.check_ring(4, [1, 1, 1])
+    assert res.ok and res.states > 0
+
+
+def test_batched_write_many_protocol_ok():
+    res = ringcheck.check_ring(8, [1, 1, 1], batched=True)
+    assert res.ok, repr(res)
+
+
+@pytest.mark.parametrize("mutant", ringcheck.MUTANTS)
+def test_every_seeded_mutant_is_killed(mutant):
+    kills = ringcheck.mutant_kill_suite()
+    assert kills[mutant], f"mutant {mutant} survived the checker"
+
+
+def test_publish_before_write_is_torn_read():
+    res = ringcheck.check_ring(8, [1, 1], mutant="publish_before_write")
+    assert not res.ok and res.violation.kind == "torn"
+    assert res.violation.trace  # a concrete interleaving is reported
+
+
+def test_ignore_credits_is_overwrite():
+    res = ringcheck.check_ring(4, [1, 1, 1], mutant="ignore_credits")
+    assert not res.ok and res.violation.kind in ("overwrite", "torn")
+
+
+def test_cli_default_gate_exits_zero():
+    from tpurpc.analysis.__main__ import main
+
+    assert main([]) == 0
+
+
+# ---------------------------------------------------------------------------
+# regressions for the fixes the new passes surfaced
+# ---------------------------------------------------------------------------
+
+def test_poller_concurrent_start_stop_regression():
+    """start() used to flip _running outside the cv lock; racing starts or a
+    start/stop overlap could wedge the scan threads."""
+    from tpurpc.core.poller import Poller
+
+    p = Poller(thread_num=2)
+    threads = [threading.Thread(target=p.start) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert p._running and len(p._threads) == 2
+    p.stop()
+    assert not p._running and p._threads == []
+
+
+def test_channelz_counter_snapshot_regression():
+    """as_dict() used to read the counters unlocked — a snapshot could pair
+    a call count with the previous call's timestamp."""
+    from tpurpc.rpc.channelz import CallCounters
+
+    c = CallCounters()
+    stop = threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            c.on_start()
+            c.on_finish(True)
+
+    t = threading.Thread(target=hammer)
+    t.start()
+    try:
+        for _ in range(200):
+            snap = c.as_dict()
+            if snap["calls_started"]:
+                assert snap["last_call_started"] > 0.0
+            assert snap["calls_succeeded"] <= snap["calls_started"]
+    finally:
+        stop.set()
+        t.join()
+
+
+def test_xds_subscription_swap_under_load_regression():
+    """The v3 reader thread now compares AND swaps `subscribed` inside the
+    servicer lock; set_endpoints churn concurrent with subscription reads
+    must never tear (the round-5 xds.py:161 bug class)."""
+    from tpurpc.rpc.xds import XdsServicer
+
+    s = XdsServicer()
+    stop = threading.Event()
+
+    def churn():
+        i = 0
+        while not stop.is_set():
+            s.set_endpoints("svc", [f"h{i}:1"])
+            i += 1
+
+    t = threading.Thread(target=churn)
+    t.start()
+    try:
+        for _ in range(300):
+            eps = s.get_endpoints("svc")
+            assert len(eps) <= 1
+    finally:
+        stop.set()
+        t.join()
+
+
+def test_lockmap_declarations_hold_on_declaring_modules():
+    """The regression guard for the declared lock maps: the modules that
+    declare _GUARDED_BY must stay clean under the lock-map pass."""
+    import tpurpc.core.poller as poller_mod
+    import tpurpc.rpc.channelz as channelz_mod
+    import tpurpc.rpc.xds as xds_mod
+
+    for mod in (poller_mod, channelz_mod, xds_mod):
+        path = mod.__file__
+        with open(path, "r", encoding="utf-8") as f:
+            vs = [v for v in lint_source(f.read(), path) if v.rule == "lock"]
+        assert vs == [], vs

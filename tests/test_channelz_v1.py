@@ -7,7 +7,7 @@ import pytest
 
 import tpurpc.rpc as rpc
 from tpurpc.rpc.channelz_v1 import SERVICE, enable_channelz
-from tpurpc.wire.protowire import encode_varint, fields, ld, vf
+from tpurpc.wire.protowire import fields, ld, vf
 
 _ID = lambda b: b
 

@@ -9,7 +9,6 @@ and the eligibility gates that keep feature-carrying servers on the
 Python plane.
 """
 
-import os
 import threading
 
 import pytest

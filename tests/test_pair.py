@@ -6,14 +6,12 @@ the loopback and shm domains, including a genuine cross-process shared-memory ex
 
 import os
 import socket
-import struct
 import threading
 import time
 
 import pytest
 
 from tpurpc.core import pair as P
-from tpurpc.core import poller as PL
 from tpurpc.core.pair import Pair, PairState, create_loopback_pair
 from tpurpc.core.poller import PairPool, Poller, wait_readable
 

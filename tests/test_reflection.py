@@ -12,7 +12,6 @@ import pytest
 import tpurpc.rpc as rpc
 from tpurpc.rpc.reflection import (V1_SERVICE, V1ALPHA_SERVICE,
                                    enable_server_reflection)
-from tpurpc.wire.protowire import encode_varint as _varint
 from tpurpc.wire.protowire import fields as _fields
 from tpurpc.wire.protowire import ld as _ld
 

@@ -1,6 +1,5 @@
 """Resolver + load balancing: target URIs, pick_first failover, round_robin."""
 
-import threading
 
 import pytest
 

@@ -12,8 +12,7 @@ import pytest
 from hypothesis import HealthCheck, given, settings
 from hypothesis import strategies as st
 
-from tpurpc.core.pair import LocalDomain, Pair, create_loopback_pair
-from tpurpc.core.poller import wait_readable
+from tpurpc.core.pair import LocalDomain, create_loopback_pair
 
 _SETTINGS = dict(max_examples=40, deadline=None,
                  suppress_health_check=[HealthCheck.too_slow])

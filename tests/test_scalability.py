@@ -11,7 +11,6 @@ with BOUNDED server threads, every connection's calls succeeding.
 
 import os
 import subprocess
-import sys
 import threading
 import time
 

@@ -361,7 +361,6 @@ def test_forged_records_cannot_land_bytes():
     HMAC secret (delivered only via the handle, i.e. the bootstrap channel)
     — an attacker who knows everything ON THE WIRE short of the secret
     (host, port, hello, region key, record format) cannot land a byte."""
-    import struct
 
     from tpurpc.core import tcpw as T
 

@@ -9,7 +9,6 @@ a stock grpcio TLS server.
 """
 
 import datetime
-import threading
 
 import grpc
 import pytest

@@ -12,7 +12,7 @@ import time
 import pytest
 
 import tpurpc.rpc as rpc
-from tpurpc.rpc.xds import (XdsServicer, XdsWatcher, load_bootstrap,
+from tpurpc.rpc.xds import (XdsServicer, load_bootstrap,
                             xds_channel)
 
 

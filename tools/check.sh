@@ -1,0 +1,87 @@
+#!/usr/bin/env bash
+# tpurpc verification gate: lint + model check + (toolchain permitting)
+# sanitizer builds of the native plane. Run from the repo root:
+#
+#   tools/check.sh            # everything available on this host
+#   tools/check.sh --fast     # python-side checks only (no native builds)
+#
+# Exit 0 iff every check that COULD run passed; unavailable toolchain steps
+# are reported as SKIP, never as silent success of something that didn't run.
+set -u
+cd "$(dirname "$0")/.."
+
+FAST=0
+[ "${1:-}" = "--fast" ] && FAST=1
+
+fail=0
+note() { printf '== %s\n' "$*"; }
+
+# 1) ruff, when installed (config lives in pyproject.toml [tool.ruff])
+if command -v ruff >/dev/null 2>&1; then
+    note "ruff"
+    ruff check tpurpc/ tests/ || fail=1
+else
+    note "ruff: SKIP (not installed)"
+fi
+
+# 2) the tpurpc-specific static gate: AST lint + bounded exhaustive ring
+#    model check + mutant kill check (see tpurpc/analysis/)
+note "python -m tpurpc.analysis (lint + ringcheck + mutants)"
+python -m tpurpc.analysis || fail=1
+
+# 3) the analysis subsystem's own tests, plus a lock-order-instrumented run
+#    of the concurrency-heavy suites (TPURPC_DEBUG_LOCKS exercises the
+#    CheckedLock shim wired into poller/pair/xds/channel/channelz)
+if python -c "import pytest" >/dev/null 2>&1; then
+    note "pytest tests/test_analysis.py"
+    JAX_PLATFORMS=cpu python -m pytest tests/test_analysis.py -q \
+        -p no:cacheprovider || fail=1
+    note "TPURPC_DEBUG_LOCKS=1 pytest (concurrency suites)"
+    JAX_PLATFORMS=cpu TPURPC_DEBUG_LOCKS=1 python -m pytest \
+        tests/test_pair.py tests/test_rpc.py tests/test_xds.py \
+        tests/test_channelz.py -q -m 'not slow' -p no:cacheprovider \
+        || fail=1
+else
+    note "pytest: SKIP (not installed)"
+fi
+
+# 4) sanitizer build + native smoke tests. Prefers cmake (the
+#    TPURPC_SANITIZE cache/env option in native/CMakeLists.txt); falls back
+#    to direct g++ with the same flags — the container images carry g++ but
+#    not always cmake.
+if [ "$FAST" = "1" ]; then
+    note "native sanitizer builds: SKIP (--fast)"
+elif command -v cmake >/dev/null 2>&1 && command -v ninja >/dev/null 2>&1; then
+    note "TSan build via cmake (TPURPC_SANITIZE=thread)"
+    bdir=native/build/sanitize-cmake
+    cmake -G Ninja -B "$bdir" -DTPURPC_SANITIZE=thread native >/dev/null \
+        && ninja -C "$bdir" >/dev/null \
+        && TSAN_OPTIONS="suppressions=$PWD/native/sanitize/tsan.supp halt_on_error=1" \
+           "$bdir/ring_smoke" || fail=1
+elif command -v g++ >/dev/null 2>&1; then
+    note "TSan build via direct g++ (no cmake on this host)"
+    mkdir -p native/build/sanitize
+    g++ -std=c++17 -O1 -g -fsanitize=thread -fno-omit-frame-pointer \
+        -shared -fPIC native/src/*.cc \
+        -o native/build/sanitize/libtpurpc-tsan.so -lpthread -lrt \
+        || fail=1
+    g++ -std=c++17 -O1 -g -fsanitize=thread -fno-omit-frame-pointer \
+        native/src/*.cc native/test/ring_smoke.cc \
+        -o native/build/sanitize/ring_smoke-tsan -lpthread -lrt \
+        && TSAN_OPTIONS="suppressions=$PWD/native/sanitize/tsan.supp halt_on_error=1" \
+           native/build/sanitize/ring_smoke-tsan || fail=1
+    note "ASan build + smoke"
+    g++ -std=c++17 -O1 -g -fsanitize=address -fno-omit-frame-pointer \
+        native/src/*.cc native/test/ring_smoke.cc \
+        -o native/build/sanitize/ring_smoke-asan -lpthread -lrt \
+        && native/build/sanitize/ring_smoke-asan || fail=1
+else
+    note "native sanitizer builds: SKIP (no cmake/g++)"
+fi
+
+if [ "$fail" = "0" ]; then
+    note "ALL CHECKS PASSED"
+else
+    note "CHECKS FAILED"
+fi
+exit "$fail"

@@ -1,0 +1,41 @@
+"""tpurpc-verify: concurrency lint, runtime lock checking, ring model checking.
+
+Three layers of correctness tooling for the invariants the data plane lives by
+(ARCHITECTURE.md §11 documents the invariants themselves):
+
+* :mod:`tpurpc.analysis.lint` — tpurpc-specific AST passes: lease pairing
+  (every ``send_reserve`` reaches commit or abort on all paths), hot-path
+  no-copy rules (``b"".join`` / ``from_buffer_copy`` / slice-into-``bytes``
+  banned in the ring/pair/h2/codec modules), a lock map (class-declared
+  ``_GUARDED_BY`` attributes only mutate under their lock), and monotonic
+  clock enforcement (``time.time()`` needs a wall-clock annotation).
+* :mod:`tpurpc.analysis.locks` — an opt-in (``TPURPC_DEBUG_LOCKS=1``)
+  :class:`CheckedLock` shim that records the cross-thread lock acquisition
+  graph, reports cycles as potential deadlocks, and flags locks held across
+  blocking calls. Zero overhead when disabled: the factories hand back plain
+  ``threading`` primitives.
+* :mod:`tpurpc.analysis.ringcheck` — an exhaustive interleaving checker for
+  the SPSC ring protocol (single and batched ``write_many`` publishes, wrap,
+  credits), with seeded protocol mutants the checker must reject.
+
+CLI: ``python -m tpurpc.analysis`` runs lint + the bounded model check and
+exits non-zero on any violation (wired into ``tools/check.sh``).
+"""
+
+from tpurpc.analysis.lint import LintViolation, lint_paths, lint_tree  # noqa: F401
+from tpurpc.analysis.locks import (  # noqa: F401
+    CheckedLock,
+    checked_condition,
+    lock_violations,
+    make_condition,
+    make_lock,
+    note_blocking,
+    reset_lock_state,
+)
+from tpurpc.analysis.ringcheck import (  # noqa: F401
+    CheckResult,
+    MUTANTS,
+    check_ring,
+    default_suite,
+    mutant_kill_suite,
+)

@@ -1,0 +1,446 @@
+"""tpurpc-specific AST lint passes.
+
+Four rules, each guarding an invariant the round-5 review found violated by
+hand (ISSUE 2) and that no general-purpose linter knows about:
+
+* ``lease``    — lease pairing: a function that calls a ``*send_reserve*``
+  entry point must reach ``*send_commit*`` on success and ``*send_abort*`` on
+  every exception path (the abort must sit in an ``except``/``finally``), and
+  the fill code between reserve and commit must be covered by that handler.
+  An unaborted lease wedges the peer's ring write lock forever (the exact
+  round-5 native-plane bug).
+* ``copy``     — hot-path no-copy: in the data-plane modules
+  (``core/ring.py``, ``core/pair.py``, ``wire/grpc_h2.py``,
+  ``jaxshim/codec.py``) the patterns ``b"".join(...)``,
+  ``*.from_buffer_copy(...)`` and ``bytes(x[a:b])`` / ``bytearray(x[a:b])``
+  are banned: the first two hide whole-payload copies, the last double-copies
+  (slicing ``bytes``/``bytearray`` copies once, materializing again copies
+  twice). The sanctioned escape hatch is slicing a ``memoryview`` (zero-copy)
+  and calling ``.tobytes()`` — one visible, greppable copy.
+* ``lock``     — lock map: a class that declares ``_GUARDED_BY =
+  {"attr": "_lock"}`` promises that ``self.attr`` is only MUTATED inside
+  ``with self._lock:`` (``__init__`` is exempt: construction happens-before
+  sharing). This is the bug class of the round-5 ``xds.py`` finding — an
+  unlocked ``subscribed[:]`` mutation racing a locked snapshot.
+* ``wallclock``— monotonic clocks: ``time.time()`` is banned for anything
+  that could feed duration/interval math; genuinely absolute timestamps
+  (channelz report fields, human-facing log stamps) carry an explicit
+  ``# tpr: allow(wallclock)`` annotation.
+
+Suppression grammar: a line comment ``# tpr: allow(<rule>)`` disables that
+rule for its line. The hot-path modules are expected to carry NO ``copy``
+suppressions — a copy on the data plane is either fixed or it is a finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+#: repo-relative suffixes of the modules under the no-copy rules
+HOT_COPY_MODULES = (
+    os.path.join("tpurpc", "core", "ring.py"),
+    os.path.join("tpurpc", "core", "pair.py"),
+    os.path.join("tpurpc", "wire", "grpc_h2.py"),
+    os.path.join("tpurpc", "jaxshim", "codec.py"),
+)
+
+#: method names whose call on a guarded attribute counts as a mutation
+_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "insert", "pop", "popleft", "popitem",
+    "remove", "clear", "update", "add", "discard", "setdefault", "sort",
+})
+
+_ALLOW_RE = re.compile(r"#\s*tpr:\s*allow\(([a-z_,\s]+)\)")
+
+
+class LintViolation:
+    __slots__ = ("path", "line", "col", "rule", "message")
+
+    def __init__(self, path: str, line: int, col: int, rule: str,
+                 message: str):
+        self.path = path
+        self.line = line
+        self.col = col
+        self.rule = rule
+        self.message = message
+
+    def __repr__(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+    __str__ = __repr__
+
+
+def _allowed_rules(source_lines: Sequence[str], line: int) -> Set[str]:
+    """Rules suppressed on ``line`` (1-based) via ``# tpr: allow(rule)``."""
+    if 1 <= line <= len(source_lines):
+        m = _ALLOW_RE.search(source_lines[line - 1])
+        if m:
+            return {tok.strip() for tok in m.group(1).split(",")}
+    return set()
+
+
+def _attach_parents(tree: ast.AST) -> None:
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._tpr_parent = node  # type: ignore[attr-defined]
+
+
+def _ancestors(node: ast.AST) -> Iterable[ast.AST]:
+    cur = getattr(node, "_tpr_parent", None)
+    while cur is not None:
+        yield cur
+        cur = getattr(cur, "_tpr_parent", None)
+
+
+def _call_name(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return ""
+
+
+def _is_self_attr(node: ast.AST, attr: Optional[str] = None) -> Optional[str]:
+    """``self.X`` / ``cls.X`` → ``X`` (optionally requiring ``X == attr``)."""
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id in ("self", "cls")):
+        if attr is None or node.attr == attr:
+            return node.attr
+    return None
+
+
+# -- rule: wallclock ---------------------------------------------------------
+
+def _check_wallclock(tree: ast.AST, path: str,
+                     lines: Sequence[str]) -> List[LintViolation]:
+    out = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "time"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "time"):
+            if "wallclock" in _allowed_rules(lines, node.lineno):
+                continue
+            out.append(LintViolation(
+                path, node.lineno, node.col_offset, "wallclock",
+                "time.time() is not monotonic: use time.monotonic() for "
+                "durations/intervals, or annotate a genuinely absolute "
+                "timestamp with '# tpr: allow(wallclock)'"))
+    return out
+
+
+# -- rule: copy --------------------------------------------------------------
+
+def _check_copy(tree: ast.AST, path: str,
+                lines: Sequence[str]) -> List[LintViolation]:
+    out = []
+    for node in ast.walk(tree):
+        viol = None
+        if isinstance(node, ast.Call):
+            f = node.func
+            if (isinstance(f, ast.Attribute) and f.attr == "join"
+                    and isinstance(f.value, ast.Constant)
+                    and isinstance(f.value.value, bytes)):
+                viol = ("b\"\".join() gathers with a hidden whole-payload "
+                        "copy: encode into a preallocated buffer or pass the "
+                        "segment list through (gather writes)")
+            elif (isinstance(f, ast.Attribute)
+                  and f.attr == "from_buffer_copy"):
+                viol = ("from_buffer_copy duplicates the payload: use "
+                        "from_buffer / a memoryview over the source")
+            elif (isinstance(f, ast.Name) and f.id in ("bytes", "bytearray")
+                  and len(node.args) == 1
+                  and isinstance(node.args[0], ast.Subscript)
+                  and isinstance(node.args[0].slice, ast.Slice)):
+                viol = (f"{f.id}(x[a:b]) double-copies when x is "
+                        "bytes/bytearray: slice a memoryview (zero-copy) "
+                        "and .tobytes() if you truly need to materialize")
+        if viol is None:
+            continue
+        if "copy" in _allowed_rules(lines, node.lineno):
+            continue
+        out.append(LintViolation(path, node.lineno, node.col_offset,
+                                 "copy", viol))
+    return out
+
+
+# -- rule: lock --------------------------------------------------------------
+
+def _guarded_by_decl(cls: ast.ClassDef) -> Dict[str, Tuple[str, ...]]:
+    """Parse a class-level ``_GUARDED_BY = {"attr": "_lock" | ("_a","_b")}``."""
+    for stmt in cls.body:
+        if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and stmt.targets[0].id == "_GUARDED_BY"
+                and isinstance(stmt.value, ast.Dict)):
+            decl: Dict[str, Tuple[str, ...]] = {}
+            for k, v in zip(stmt.value.keys, stmt.value.values):
+                if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+                    continue
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    decl[k.value] = (v.value,)
+                elif isinstance(v, (ast.Tuple, ast.List)):
+                    locks = tuple(e.value for e in v.elts
+                                  if isinstance(e, ast.Constant)
+                                  and isinstance(e.value, str))
+                    if locks:
+                        decl[k.value] = locks
+            return decl
+    return {}
+
+
+def _with_holds(node: ast.AST, locks: Tuple[str, ...]) -> bool:
+    """Is ``node`` lexically inside ``with self.<lock>:`` for a lock in
+    ``locks``? (``with self._cv`` counts for the condition's own lock.)"""
+    for anc in _ancestors(node):
+        if isinstance(anc, ast.With):
+            for item in anc.items:
+                expr = item.context_expr
+                # with self._lock: / with self._lock.something(): not counted
+                name = _is_self_attr(expr)
+                if name is None and isinstance(expr, ast.Call):
+                    # e.g. `with self._lock_for(x):` — not a declared guard
+                    continue
+                if name in locks:
+                    return True
+    return False
+
+
+def _mutation_target(node: ast.AST) -> Optional[ast.AST]:
+    """The ``self.attr`` expression this statement mutates, if any."""
+    if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for t in targets:
+            if isinstance(t, ast.Tuple):
+                for e in t.elts:
+                    got = _mutation_target_expr(e)
+                    if got is not None:
+                        return got
+            got = _mutation_target_expr(t)
+            if got is not None:
+                return got
+    elif isinstance(node, ast.Delete):
+        for t in node.targets:
+            got = _mutation_target_expr(t)
+            if got is not None:
+                return got
+    elif isinstance(node, ast.Call):
+        f = node.func
+        if (isinstance(f, ast.Attribute) and f.attr in _MUTATORS
+                and _is_self_attr(f.value) is not None):
+            return f.value
+    return None
+
+
+def _mutation_target_expr(t: ast.AST) -> Optional[ast.AST]:
+    # self.attr = ... / self.attr[...] = ... / self.attr[:] = ...
+    if _is_self_attr(t) is not None:
+        return t
+    if isinstance(t, ast.Subscript) and _is_self_attr(t.value) is not None:
+        return t.value
+    return None
+
+
+def _check_locks(tree: ast.AST, path: str,
+                 lines: Sequence[str]) -> List[LintViolation]:
+    out = []
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        decl = _guarded_by_decl(cls)
+        if not decl:
+            continue
+        for fn in cls.body:
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if fn.name == "__init__":
+                continue  # construction happens-before sharing
+            for node in ast.walk(fn):
+                tgt = _mutation_target(node)
+                if tgt is None:
+                    continue
+                attr = _is_self_attr(tgt)
+                if attr not in decl:
+                    continue
+                if _with_holds(node, decl[attr]):
+                    continue
+                if "lock" in _allowed_rules(lines, node.lineno):
+                    continue
+                out.append(LintViolation(
+                    path, node.lineno, node.col_offset, "lock",
+                    f"{cls.name}.{attr} is declared guarded by "
+                    f"{'/'.join(decl[attr])} but is mutated outside "
+                    f"'with self.{decl[attr][0]}:' (in {fn.name})"))
+    return out
+
+
+# -- rule: lease -------------------------------------------------------------
+
+def _calls_matching(node: ast.AST, needle: str) -> List[ast.Call]:
+    return [n for n in ast.walk(node)
+            if isinstance(n, ast.Call) and needle in _call_name(n)]
+
+
+def _try_aborts(try_node: ast.Try) -> bool:
+    """Does this Try call ``*send_abort*`` in a handler or finally?"""
+    for h in try_node.handlers:
+        for stmt in h.body:
+            if _calls_matching(stmt, "send_abort"):
+                return True
+    for stmt in try_node.finalbody:
+        if _calls_matching(stmt, "send_abort"):
+            return True
+    return False
+
+
+def _enclosing_stmt(node: ast.AST, block: List[ast.stmt]) -> Optional[ast.stmt]:
+    """The statement of ``block`` that (transitively) contains ``node``."""
+    chain = [node] + list(_ancestors(node))
+    for stmt in block:
+        if stmt in chain:
+            return stmt
+    return None
+
+
+def _check_lease(tree: ast.AST, path: str,
+                 lines: Sequence[str]) -> List[LintViolation]:
+    out = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        reserves = [c for c in _calls_matching(fn, "send_reserve")
+                    if _enclosing_fn(c) is fn]
+        if not reserves:
+            continue
+        if any("lease" in _allowed_rules(lines, r.lineno) for r in reserves):
+            continue
+        commits = [c for c in _calls_matching(fn, "send_commit")
+                   if _enclosing_fn(c) is fn]
+        aborts = [c for c in _calls_matching(fn, "send_abort")
+                  if _enclosing_fn(c) is fn]
+        rl = reserves[0].lineno
+        if not commits:
+            out.append(LintViolation(
+                path, rl, reserves[0].col_offset, "lease",
+                f"{fn.name} reserves a send lease but never commits it: a "
+                "reserved-and-dropped lease wedges the ring write lock"))
+            continue
+        covered_aborts = [
+            a for a in aborts
+            if any(isinstance(anc, (ast.ExceptHandler,)) for anc in
+                   _ancestors(a))
+            or any(isinstance(anc, ast.Try) and a in
+                   [d for s in anc.finalbody for d in ast.walk(s)]
+                   for anc in _ancestors(a))]
+        if not covered_aborts:
+            out.append(LintViolation(
+                path, rl, reserves[0].col_offset, "lease",
+                f"{fn.name} reserves a send lease with no send_abort on any "
+                "exception path (except/finally): a raise between reserve "
+                "and commit leaks the lease"))
+            continue
+        out.extend(_check_lease_region(fn, reserves, commits, path))
+    return out
+
+
+def _enclosing_fn(node: ast.AST) -> Optional[ast.AST]:
+    for anc in _ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            return anc
+    return None
+
+
+def _check_lease_region(fn, reserves, commits, path) -> List[LintViolation]:
+    """Fill code strictly between reserve and commit (same statement block)
+    must sit inside a Try whose handler/finally aborts — an exception raised
+    while filling the reserved span must release the lease."""
+    out = []
+    for res in reserves:
+        # locate the common block holding both the reserve and a commit
+        for anc in [res] + list(_ancestors(res)):
+            parent = getattr(anc, "_tpr_parent", None)
+            if parent is None:
+                break
+            for field in ("body", "orelse", "finalbody"):
+                block = getattr(parent, field, None)
+                if not (isinstance(block, list) and anc in block):
+                    continue
+                commit_stmts = [s for c in commits
+                                for s in [_enclosing_stmt(c, block)]
+                                if s is not None]
+                if not commit_stmts:
+                    continue
+                ri = block.index(anc)
+                ci = max(block.index(s) for s in commit_stmts)
+                for between in block[ri + 1:ci]:
+                    ok = (isinstance(between, ast.Try)
+                          and _try_aborts(between))
+                    ok = ok or isinstance(between, (ast.Pass, ast.Continue,
+                                                    ast.Break))
+                    if not ok:
+                        out.append(LintViolation(
+                            path, between.lineno, between.col_offset,
+                            "lease",
+                            f"{fn.name}: statement between send_reserve and "
+                            "send_commit is not covered by a "
+                            "try/except-abort — an exception here leaks the "
+                            "lease"))
+                return out
+    return out
+
+
+# -- driver ------------------------------------------------------------------
+
+def lint_source(source: str, path: str,
+                hot_copy: Optional[bool] = None) -> List[LintViolation]:
+    """Lint one module's source. ``hot_copy`` forces/suppresses the no-copy
+    rules (default: decided by ``path`` suffix against HOT_COPY_MODULES)."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as exc:
+        return [LintViolation(path, exc.lineno or 0, exc.offset or 0,
+                              "syntax", str(exc))]
+    _attach_parents(tree)
+    lines = source.splitlines()
+    out = []
+    out.extend(_check_wallclock(tree, path, lines))
+    if hot_copy is None:
+        hot_copy = path.replace("\\", "/").endswith(
+            tuple(m.replace(os.sep, "/") for m in HOT_COPY_MODULES))
+    if hot_copy:
+        out.extend(_check_copy(tree, path, lines))
+    out.extend(_check_locks(tree, path, lines))
+    out.extend(_check_lease(tree, path, lines))
+    out.sort(key=lambda v: (v.path, v.line, v.col))
+    return out
+
+
+def lint_paths(paths: Iterable[str]) -> List[LintViolation]:
+    out = []
+    for p in paths:
+        with open(p, "r", encoding="utf-8") as f:
+            out.extend(lint_source(f.read(), p))
+    return out
+
+
+def tree_root() -> str:
+    """The repo's ``tpurpc`` package directory."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint_tree(root: Optional[str] = None) -> List[LintViolation]:
+    """Lint every ``.py`` under the tpurpc package (the default CLI pass)."""
+    root = root or tree_root()
+    paths = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                paths.append(os.path.join(dirpath, fn))
+    return lint_paths(paths)

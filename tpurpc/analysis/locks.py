@@ -1,0 +1,231 @@
+"""Runtime lock-order detection: the ``CheckedLock`` shim.
+
+Opt-in via ``TPURPC_DEBUG_LOCKS=1``. When disabled (the default) the
+``make_lock``/``make_condition`` factories hand back plain ``threading``
+primitives — zero overhead, byte-identical hot paths. When enabled, every
+factory-made lock is a :class:`CheckedLock` that:
+
+* records the **cross-thread acquisition graph**: an edge ``A → B`` whenever
+  a thread acquires ``B`` while holding ``A``. Locks are keyed by *name*
+  (``Class._attr``), not identity — every instance of a class contributes to
+  one graph node, exactly like kernel lockdep's lock classes, so a cycle is
+  reported the first time two code paths disagree about order, without ever
+  needing the actual deadlock to fire.
+* reports **cycles** in that graph as potential deadlocks (recorded in
+  :func:`lock_violations`, logged once per distinct cycle).
+* flags **locks held across blocking calls**: ``Condition.wait`` while
+  holding any *other* checked lock, and any call site instrumented with
+  :func:`note_blocking` (selector ``select``, bootstrap socket reads).
+
+The existing test suite exercises the instrumented modules
+(poller/pair/xds/channel/channelz); run it under ``TPURPC_DEBUG_LOCKS=1``
+to sweep for ordering regressions (``tools/check.sh`` does).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+#: read once at import: the factories must cost nothing when disabled
+ENABLED = os.environ.get("TPURPC_DEBUG_LOCKS", "") == "1"
+
+_tls = threading.local()
+
+_graph_mu = threading.Lock()
+#: name -> set of names acquired while holding it (the order graph)
+_edges: Dict[str, Set[str]] = {}
+_violations: List[str] = []
+_reported: Set[Tuple[str, ...]] = set()
+
+
+def _held() -> List["CheckedLock"]:
+    held = getattr(_tls, "held", None)
+    if held is None:
+        held = _tls.held = []
+    return held
+
+
+def _record_violation(msg: str) -> None:
+    from tpurpc.utils.trace import log_error
+
+    with _graph_mu:
+        _violations.append(msg)
+    log_error("TPURPC_DEBUG_LOCKS: %s", msg)
+
+
+def _find_cycle(src: str, dst: str) -> Optional[List[str]]:
+    """After adding edge src→dst: a path dst→…→src closes a cycle.
+    Caller holds ``_graph_mu``."""
+    stack = [(dst, [dst])]
+    seen = set()
+    while stack:
+        node, path = stack.pop()
+        if node == src:
+            return path + [src] if node != dst or len(path) > 1 else [dst, src]
+        if node in seen:
+            continue
+        seen.add(node)
+        for nxt in _edges.get(node, ()):
+            stack.append((nxt, path + [nxt]))
+    return None
+
+
+def _note_acquire_edge(lock: "CheckedLock") -> None:
+    held = _held()
+    for h in held:
+        if h is lock or h.name == lock.name:
+            continue
+        with _graph_mu:
+            peers = _edges.setdefault(h.name, set())
+            if lock.name in peers:
+                continue
+            peers.add(lock.name)
+            cycle = _find_cycle(h.name, lock.name)
+        if cycle:
+            key = tuple(sorted(set(cycle)))
+            with _graph_mu:
+                fresh = key not in _reported
+                _reported.add(key)
+            if fresh:
+                _record_violation(
+                    "lock-order cycle (potential deadlock): "
+                    + " -> ".join([h.name] + cycle)
+                    + f" (thread {threading.current_thread().name})")
+
+
+class CheckedLock:
+    """``threading.Lock`` wrapper feeding the acquisition-order graph.
+
+    Non-reentrant, same semantics as the lock it wraps; re-acquiring it on
+    the same thread is reported (and would deadlock) — use
+    :func:`make_rlock` for reentrant use."""
+
+    _reentrant = False
+
+    def __init__(self, name: str):
+        self.name = name
+        self._inner = (threading.RLock() if self._reentrant
+                       else threading.Lock())
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        held = _held()
+        if (not self._reentrant and blocking
+                and any(h is self for h in held)):
+            _record_violation(
+                f"self-deadlock: {self.name} re-acquired by holding thread "
+                f"{threading.current_thread().name}")
+            raise RuntimeError(
+                f"re-acquire of non-reentrant checked lock {self.name}")
+        _note_acquire_edge(self)
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            held.append(self)
+        return got
+
+    def release(self) -> None:
+        held = _held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is self:
+                del held[i]
+                break
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<CheckedLock {self.name}>"
+
+    # threading.Condition(lock) uses these when the lock provides them; the
+    # default release()/acquire() round-trip keeps our bookkeeping correct,
+    # so no _release_save/_acquire_restore overrides are needed.
+
+
+class CheckedRLock(CheckedLock):
+    _reentrant = True
+
+
+class CheckedCondition(threading.Condition):
+    """Condition over a CheckedLock that flags waits while other checked
+    locks are held — a parked waiter holding an unrelated lock is the
+    round-5 ``wait_event`` parked-waiter bug class."""
+
+    def __init__(self, name: str, lock=None):
+        self.name = name
+        super().__init__(lock if lock is not None else CheckedLock(name))
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        others = [h.name for h in _held()
+                  if h is not self._lock]
+        if others:
+            _record_violation(
+                f"cv-wait on {self.name} while holding {', '.join(others)} "
+                "(lock held across a blocking wait)")
+        return super().wait(timeout)
+    # wait_for() funnels through wait(); notify paths need no bookkeeping.
+
+
+# -- factories (the wiring surface) ------------------------------------------
+
+def make_lock(name: str):
+    """A mutex for ``name`` (``Class._attr``): plain ``threading.Lock``
+    normally, :class:`CheckedLock` under ``TPURPC_DEBUG_LOCKS=1``."""
+    return CheckedLock(name) if ENABLED else threading.Lock()
+
+
+def make_rlock(name: str):
+    return CheckedRLock(name) if ENABLED else threading.RLock()
+
+
+def make_condition(name: str, lock=None):
+    """A condition variable; pass ``lock`` to share an existing factory-made
+    lock (the Condition then guards the same graph node)."""
+    if not ENABLED:
+        return threading.Condition(lock)
+    return CheckedCondition(name, lock)
+
+
+def checked_condition(name: str, lock=None) -> CheckedCondition:
+    """Always-checked variant (tests use this regardless of ENABLED)."""
+    return CheckedCondition(name, lock)
+
+
+def note_blocking(what: str) -> None:
+    """Instrument a blocking call site: any checked lock held here is a
+    latency/deadlock hazard (the selector ``select`` in the waiter path, the
+    bootstrap blob reads). No-op unless debugging is enabled AND a checked
+    lock is actually held."""
+    if not ENABLED:
+        return
+    held = _held()
+    if held:
+        _record_violation(
+            f"lock(s) {', '.join(h.name for h in held)} held across "
+            f"blocking call: {what} "
+            f"(thread {threading.current_thread().name})")
+
+
+def lock_violations() -> List[str]:
+    with _graph_mu:
+        return list(_violations)
+
+
+def acquisition_graph() -> Dict[str, Set[str]]:
+    with _graph_mu:
+        return {k: set(v) for k, v in _edges.items()}
+
+
+def reset_lock_state() -> None:
+    """Clear the graph and recorded violations (tests)."""
+    with _graph_mu:
+        _edges.clear()
+        _violations.clear()
+        _reported.clear()

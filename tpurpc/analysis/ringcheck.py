@@ -1,0 +1,417 @@
+"""Exhaustive SPSC ring protocol model checker.
+
+Models the sequence-stamped ring of :mod:`tpurpc.core.ring` at **word
+granularity** (ALIGN = 1 word, header = footer = 1 word, so a message of
+``n`` payload words spans ``n + 2``), and exhaustively explores every
+writer/reader interleaving on small rings by depth-first search over global
+states with memoization. Each shared-memory **word store is one atomic
+step** — exactly the granularity at which the real protocol's ordering
+argument lives (the release fence before the header store orders it after
+the payload+footer stores; under exhaustive interleaving, a wrong order is
+a reachable torn state).
+
+What is modeled (mirroring ``ring.py`` / ``ring.cc``):
+
+* message framing ``[header | payload… | footer]`` with the header carrying
+  ``(seq, len)`` and the footer carrying the sequence stamp — completion is
+  "header seq matches AND footer stamp matches", nothing is ever zeroed;
+* the 3-word reserved slack (header + footer + one-word gap) and the credit
+  check ``span ≤ capacity − in_flight − 3`` before a write begins;
+* credit return: the reader publishes its head as a single shared-word
+  store, at a **nondeterministic** moment (any point with unconsumed
+  progress), which covers every batching/threshold timing;
+* the PR-1 batched ``write_many`` protocol: one bulk placement of all
+  payloads+footers (headers withheld), then the per-message header stores
+  in order — the single-head-publish batch;
+* wrap handling: runs push several messages through capacity-4/8 rings so
+  every offset wraps at least once and stale stamps from prior laps are in
+  memory during completion checks.
+
+Checked invariants:
+
+* **no torn reads** — every payload word a reader consumes belongs to the
+  message (sequence) the framing claimed;
+* **no lost or duplicated messages** — at quiescence the reader received
+  exactly the sent sequence, in order, payloads intact;
+* **publish ordering** — a writer store never lands on a word the reader
+  has not yet consumed (one-sided-overwrite ghost check), and the published
+  credit head never runs ahead of what was actually consumed.
+
+Seeded mutants (:data:`MUTANTS`) break the protocol in known ways
+(publish-before-write, batched headers published before the bulk copy,
+ignored credit checks, early reader head publish, misstamped batch footers);
+:func:`mutant_kill_suite` asserts the checker rejects every one — the
+checker is itself checked.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+#: reserved slack in words: header + footer + one-word gap (ring.py RESERVED)
+RESERVED_WORDS = 3
+
+#: memory word tags
+_ZERO = ("zero",)
+
+
+def _span(ln: int) -> int:
+    return ln + 2
+
+
+class Violation(Exception):
+    """A protocol invariant failed in some interleaving."""
+
+    def __init__(self, kind: str, detail: str, trace: List[str]):
+        super().__init__(f"[{kind}] {detail}")
+        self.kind = kind
+        self.detail = detail
+        self.trace = trace
+
+
+class CheckResult:
+    __slots__ = ("ok", "states", "violation", "config")
+
+    def __init__(self, ok: bool, states: int, violation: Optional[Violation],
+                 config: str):
+        self.ok = ok
+        self.states = states
+        self.violation = violation
+        self.config = config
+
+    def __repr__(self) -> str:
+        if self.ok:
+            return f"<ringcheck OK {self.config}: {self.states} states>"
+        return (f"<ringcheck VIOLATION {self.config}: {self.violation} "
+                f"after {self.states} states>")
+
+
+#: writer mutants: reorder/weaken the store protocol
+#: reader mutants: break the consume/publish ordering
+MUTANTS = (
+    "publish_before_write",     # header+footer stored BEFORE the payload
+    "batch_publish_before_write",  # batch: headers stored before bulk copy
+    "ignore_credits",           # writer skips the credit/space check
+    "early_head_publish",       # reader advances+publishes before copying
+    "batch_misstamped_footer",  # batch: every footer stamped with batch seq0
+)
+
+
+# -- state -------------------------------------------------------------------
+#
+# Global state is a flat tuple so the DFS memo can hash it:
+#   (mem, credit_head,
+#    w_tail, w_seq, w_msg_idx, w_pending,           # writer
+#    r_head, r_seq, r_phase, r_len, r_idx, r_consumed, received)
+#
+# w_pending: a tuple of GROUPS. Each group is a tuple of atomic ops that are
+# mutually UNORDERED — any op of the first group may fire next (a bulk
+# memcpy guarantees nothing about its internal store order, so the model
+# must not either); a group only starts once the previous group drained
+# (that is what the release fence buys the real protocol). Ops:
+#   ("st", abs_off, word) — store `word` at abs offset,
+#   ("adv", new_tail, new_seq, n_msgs) — local tail/seq advance.
+# r_phase: "scan" | "copy" | ("copy_at", base) for the early-publish mutant
+
+
+def check_ring(capacity: int, payload_lens: Sequence[int],
+               batched: bool = False, mutant: Optional[str] = None,
+               max_states: int = 5_000_000) -> CheckResult:
+    """Exhaustively check one configuration; returns a :class:`CheckResult`.
+
+    ``payload_lens`` — the payload word counts of the messages to send, in
+    order. ``batched=True`` drives the ``write_many`` single-publish
+    protocol (as many whole messages per batch as credits allow).
+    """
+    if mutant is not None and mutant not in MUTANTS:
+        raise ValueError(f"unknown mutant {mutant!r}; known: {MUTANTS}")
+    cfg = (f"cap={capacity} msgs={list(payload_lens)} "
+           f"batched={batched} mutant={mutant}")
+    msgs = tuple(payload_lens)
+    for ln in msgs:
+        if _span(ln) > capacity - 1:
+            raise ValueError(f"payload {ln} cannot ever fit capacity "
+                             f"{capacity}")
+
+    init = (
+        (_ZERO,) * capacity,  # mem
+        0,                    # credit_head (shared word)
+        0, 0, 0, (),          # w_tail, w_seq, w_msg_idx, w_pending
+        0, 0, "scan", 0, 0, 0,  # r_head, r_seq, r_phase, r_len, r_idx, r_consumed
+        (),                   # received: tuple of (seq, payload words tuple)
+    )
+
+    visited = set()
+    # DFS over (state, trace); trace kept short — step labels only
+    stack: List[Tuple[tuple, Tuple[str, ...]]] = [(init, ())]
+    states = 0
+    try:
+        while stack:
+            state, trace = stack.pop()
+            if state in visited:
+                continue
+            visited.add(state)
+            states += 1
+            if states > max_states:
+                raise RuntimeError(
+                    f"state space exceeds {max_states} states ({cfg})")
+            succ = _successors(state, msgs, capacity, batched, mutant,
+                               trace)
+            if not succ:
+                _check_quiescent(state, msgs, trace)
+                continue
+            stack.extend(succ)
+    except Violation as v:
+        return CheckResult(False, states, v, cfg)
+    return CheckResult(True, states, None, cfg)
+
+
+def _check_quiescent(state, msgs, trace) -> None:
+    (mem, credit_head, w_tail, w_seq, w_msg_idx, w_pending,
+     r_head, r_seq, r_phase, r_len, r_idx, r_consumed, received) = state
+    if w_msg_idx < len(msgs) or w_pending:
+        raise Violation(
+            "stuck", f"writer wedged at message {w_msg_idx}/{len(msgs)} "
+            "with no enabled step (credit starvation or protocol wedge)",
+            list(trace))
+    if len(received) != len(msgs):
+        raise Violation(
+            "lost", f"quiescent with {len(received)}/{len(msgs)} messages "
+            "delivered", list(trace))
+    for i, (seq, words) in enumerate(received):
+        if seq != i:
+            raise Violation("order", f"message {i} delivered with seq {seq}",
+                            list(trace))
+        if list(words) != [("pay", i, j) for j in range(msgs[i])]:
+            raise Violation("torn", f"message {i} payload corrupt: {words}",
+                            list(trace))
+
+
+def _successors(state, msgs, capacity, batched, mutant, trace):
+    (mem, credit_head, w_tail, w_seq, w_msg_idx, w_pending,
+     r_head, r_seq, r_phase, r_len, r_idx, r_consumed, received) = state
+    succ = []
+
+    # ---- writer steps ----
+    if w_pending:
+        group = w_pending[0]
+        for op in group:
+            rest_group = tuple(o for o in group if o is not op)
+            rest = ((rest_group,) + w_pending[1:] if rest_group
+                    else w_pending[1:])
+            if op[0] == "st":
+                _, abs_off, word = op
+                # ghost overwrite check: a store may never land on a word
+                # the reader has not consumed (reader's consumed boundary is
+                # r_head; during a copy r_head still sits at the message
+                # start).
+                if abs_off >= r_head + capacity:
+                    raise Violation(
+                        "overwrite",
+                        f"writer store at abs {abs_off} laps unconsumed "
+                        f"reader head {r_head} (capacity {capacity})",
+                        list(trace) + [f"w:store@{abs_off}"])
+                new_mem = list(mem)
+                new_mem[abs_off % capacity] = word
+                succ.append((
+                    (tuple(new_mem), credit_head,
+                     w_tail, w_seq, w_msg_idx, rest,
+                     r_head, r_seq, r_phase, r_len, r_idx, r_consumed,
+                     received),
+                    trace + (f"w:store@{abs_off}",)))
+            elif op[0] == "adv":
+                _, new_tail, new_seq, n_msgs = op
+                succ.append((
+                    (mem, credit_head,
+                     new_tail, new_seq, w_msg_idx + n_msgs, rest,
+                     r_head, r_seq, r_phase, r_len, r_idx, r_consumed,
+                     received),
+                    trace + ("w:adv",)))
+    elif w_msg_idx < len(msgs):
+        # begin the next write: fold the credit word, check space, stage the
+        # store sequence. One step (the credit word read is one load).
+        if credit_head > w_tail:
+            raise Violation(
+                "credit", f"published credit head {credit_head} ahead of "
+                f"writer tail {w_tail}", list(trace) + ["w:begin"])
+        pending = _stage_write(msgs, w_msg_idx, w_tail, w_seq, credit_head,
+                               capacity, batched, mutant)
+        if pending is not None:
+            succ.append((
+                (mem, credit_head,
+                 w_tail, w_seq, w_msg_idx, pending,
+                 r_head, r_seq, r_phase, r_len, r_idx, r_consumed, received),
+                trace + ("w:begin",)))
+
+    # ---- reader steps ----
+    if r_phase == "scan":
+        hdr = mem[r_head % capacity]
+        if (isinstance(hdr, tuple) and hdr[0] == "hdr" and hdr[1] == r_seq):
+            ln = hdr[2]
+            ftr = mem[(r_head + 1 + ln) % capacity]
+            if ftr == ("ftr", r_seq):
+                if mutant == "early_head_publish":
+                    # MUTANT: advance + publish the head BEFORE copying
+                    succ.append((
+                        (mem, r_head + _span(ln),
+                         w_tail, w_seq, w_msg_idx, w_pending,
+                         r_head + _span(ln), r_seq, ("copy_at", r_head), ln,
+                         0, 0, received),
+                        trace + ("r:detect!early",)))
+                else:
+                    succ.append((
+                        (mem, credit_head,
+                         w_tail, w_seq, w_msg_idx, w_pending,
+                         r_head, r_seq, "copy", ln, 0, r_consumed, received),
+                        trace + ("r:detect",)))
+    elif r_phase == "copy" or (isinstance(r_phase, tuple)
+                               and r_phase[0] == "copy_at"):
+        base = r_head if r_phase == "copy" else r_phase[1]
+        if r_idx < r_len:
+            word = mem[(base + 1 + r_idx) % capacity]
+            # a mismatched word is a torn read the moment it is consumed
+            if word != ("pay", r_seq, r_idx):
+                raise Violation(
+                    "torn", f"reader consumed {word} for message {r_seq} "
+                    f"word {r_idx}", list(trace) + [f"r:copy{r_idx}"])
+            succ.append((
+                (mem, credit_head,
+                 w_tail, w_seq, w_msg_idx, w_pending,
+                 r_head, r_seq, r_phase, r_len, r_idx + 1, r_consumed,
+                 received),
+                trace + (f"r:copy{r_idx}",)))
+        else:
+            # message complete: advance head (unless the mutant already did)
+            new_head = (r_head if isinstance(r_phase, tuple)
+                        else r_head + _span(r_len))
+            payload = tuple(("pay", r_seq, j) for j in range(r_len))
+            succ.append((
+                (mem, credit_head,
+                 w_tail, w_seq, w_msg_idx, w_pending,
+                 new_head, r_seq + 1, "scan", 0, 0,
+                 r_consumed + _span(r_len), received + ((r_seq, payload),)),
+                trace + ("r:done",)))
+    if r_consumed > 0:
+        # publish credits: a single shared-word store, at any moment with
+        # unpublished progress (covers every threshold/batching timing)
+        succ.append((
+            (mem, r_head,
+             w_tail, w_seq, w_msg_idx, w_pending,
+             r_head, r_seq, r_phase, r_len, r_idx, 0, received),
+            trace + ("r:publish",)))
+    return succ
+
+
+def _stage_write(msgs, idx, tail, seq, credit_head, capacity, batched,
+                 mutant):
+    """Stage the atomic store sequence for the next write (or batch).
+    Returns None when credits do not admit even one message (step disabled
+    until the credit word changes)."""
+    in_flight = tail - credit_head
+    budget = capacity - in_flight - RESERVED_WORDS
+    if mutant == "ignore_credits":
+        budget = capacity  # MUTANT: skip the space check entirely
+    take: List[int] = []
+    for ln in msgs[idx:]:
+        if ln > budget:
+            break
+        take.append(ln)
+        budget -= _span(ln)
+        if not batched:
+            break
+    if not take:
+        return None
+
+    groups: List[tuple] = []
+    if batched and len(take) > 1:
+        # write_many: ONE bulk placement (payloads + footers, headers
+        # withheld) — a memcpy, so its stores are one UNORDERED group —
+        # then the header stores, each its own group, in message order.
+        bulk: List[tuple] = []
+        headers: List[tuple] = []
+        rel = 0
+        s = seq
+        for ln in take:
+            base = tail + rel
+            for j in range(ln):
+                bulk.append(("st", base + 1 + j, ("pay", s, j)))
+            fseq = seq if mutant == "batch_misstamped_footer" else s
+            bulk.append(("st", base + 1 + ln, ("ftr", fseq)))
+            headers.append(("st", base, ("hdr", s, ln)))
+            rel += _span(ln)
+            s += 1
+        if mutant == "batch_publish_before_write":
+            # MUTANT: no ordering between the bulk copy and the header
+            # publishes — the batch's completion gates may land first
+            groups = [tuple(bulk + headers)]
+        else:
+            groups = [tuple(bulk)] + [(h,) for h in headers]
+        groups.append((("adv", tail + rel, s, len(take)),))
+    else:
+        ln = take[0]
+        payload = tuple(("st", tail + 1 + j, ("pay", seq, j))
+                        for j in range(ln))
+        footer = ("st", tail + 1 + ln, ("ftr", seq))
+        header = ("st", tail, ("hdr", seq, ln))
+        if mutant == "publish_before_write":
+            # MUTANT: completion gates placed before the payload
+            groups = [(header,), (footer,), payload]
+        else:
+            # the real order: payload (memcpy, unordered), footer, release
+            # fence, header
+            groups = [payload, (footer,), (header,)]
+        groups.append((("adv", tail + _span(ln), seq + 1, 1),))
+    return tuple(g for g in groups if g)
+
+
+# -- suites ------------------------------------------------------------------
+
+def default_suite(verbose: bool = False) -> List[CheckResult]:
+    """The bounded exhaustive pass the CLI runs: capacity ≤ 4-word rings
+    fully exhausted for the single-message protocol (with wrap), plus the
+    batched ``write_many`` protocol and a mixed-size run at capacity 8."""
+    configs = [
+        dict(capacity=4, payload_lens=[1, 1, 1], batched=False),
+        dict(capacity=4, payload_lens=[1, 1, 1, 1], batched=False),
+        dict(capacity=8, payload_lens=[1, 2, 1], batched=False),
+        dict(capacity=8, payload_lens=[1, 1, 1], batched=True),
+        dict(capacity=8, payload_lens=[2, 1, 2], batched=True),
+    ]
+    out = []
+    for cfg in configs:
+        res = check_ring(**cfg)
+        out.append(res)
+        if verbose:
+            print(f"  {res!r}")
+    return out
+
+
+def mutant_kill_suite(verbose: bool = False) -> Dict[str, bool]:
+    """Run every seeded mutant; a mutant is *killed* when at least one
+    configuration produces a violation. Returns {mutant: killed}."""
+    kill_configs = {
+        "publish_before_write": [
+            dict(capacity=8, payload_lens=[1, 1, 1], batched=False)],
+        "batch_publish_before_write": [
+            dict(capacity=8, payload_lens=[1, 1], batched=True)],
+        "ignore_credits": [
+            dict(capacity=4, payload_lens=[1, 1, 1], batched=False)],
+        "early_head_publish": [
+            dict(capacity=4, payload_lens=[1, 1, 1], batched=False)],
+        "batch_misstamped_footer": [
+            dict(capacity=8, payload_lens=[1, 1], batched=True)],
+    }
+    out = {}
+    for mutant, configs in kill_configs.items():
+        killed = False
+        for cfg in configs:
+            res = check_ring(mutant=mutant, **cfg)
+            if not res.ok:
+                killed = True
+                if verbose:
+                    print(f"  mutant {mutant}: KILLED — {res.violation}")
+                break
+        if not killed and verbose:
+            print(f"  mutant {mutant}: SURVIVED")
+        out[mutant] = killed
+    return out

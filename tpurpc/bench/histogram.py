@@ -8,7 +8,7 @@ histograms); this is the same idea sized for Python: ~2,048 buckets with
 
 from __future__ import annotations
 
-from typing import Dict, List
+from typing import Dict
 
 
 class LatencyHistogram:

@@ -84,10 +84,10 @@ def main(argv=None) -> int:
     cells = []
     for platform in args.platforms.split(","):
         for size in (int(s) for s in args.sizes.split(",")):
-            t0 = time.time()
+            t0 = time.monotonic()
             cell = run_cell(platform.strip(), size, args.duration,
                             args.concurrency, args.streaming)
-            cell["wall_s"] = round(time.time() - t0, 1)
+            cell["wall_s"] = round(time.monotonic() - t0, 1)
             print(json.dumps(cell), flush=True)
             cells.append(cell)
 

@@ -23,6 +23,14 @@ ABI_VERSION = 5
 
 
 def _lib_path() -> str:
+    # TPURPC_NATIVE_LIB points the loader at an alternate artifact — e.g. a
+    # TPURPC_SANITIZE=thread build (tools/check.sh) — without clobbering the
+    # release .so. A sanitized lib additionally needs the sanitizer runtime
+    # preloaded into the (uninstrumented) Python process:
+    #   LD_PRELOAD=libtsan.so.0 TPURPC_NATIVE_LIB=… python -m pytest …
+    override = os.environ.get("TPURPC_NATIVE_LIB")
+    if override:
+        return override
     root = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     return os.path.join(root, "native", "build", "libtpurpc.so")
@@ -39,6 +47,8 @@ def _try_build(path: str) -> None:
 
     if os.environ.get("TPURPC_NATIVE_BUILD", "1") == "0":
         return
+    if os.environ.get("TPURPC_NATIVE_LIB"):
+        return  # an explicitly pointed-at artifact is never auto-built
     gxx = shutil.which("g++")
     if gxx is None:
         return

@@ -42,9 +42,10 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from tpurpc.analysis.locks import make_lock
 from tpurpc.core import _native
 from tpurpc.tpu import ledger as ring_ledger
-from tpurpc.core.ring import RingCorruption, RingFull, RingReader, RingWriter
+from tpurpc.core.ring import RingCorruption, RingReader, RingWriter
 from tpurpc.utils import stats as _stats
 from tpurpc.utils.config import get_config
 from tpurpc.utils.trace import trace_ring
@@ -163,7 +164,7 @@ class LocalDomain(MemoryDomain):
 
     kind = "local"
     _registry: Dict[str, bytearray] = {}
-    _lock = threading.Lock()
+    _lock = make_lock("LocalDomain._lock")
 
     def alloc(self, nbytes: int) -> Region:
         handle = f"local:{uuid.uuid4().hex}"
@@ -201,7 +202,7 @@ class ShmDomain(MemoryDomain):
     # sharing one inherited tracker each send UNREGISTER → KeyError spam in the
     # tracker daemon), so suppress the registration itself. Python 3.13 has
     # SharedMemory(track=False); this is the 3.12 equivalent.
-    _track_mu = threading.Lock()
+    _track_mu = make_lock("ShmDomain._track_mu")
 
     @staticmethod
     @contextlib.contextmanager
@@ -414,7 +415,7 @@ class ContentAssertion:
     def __init__(self, name: str):
         self._name = name
         self._flag = False
-        self._lock = threading.Lock()
+        self._lock = make_lock(f"ContentAssertion[{name}]._lock")
 
     def __enter__(self):
         with self._lock:
@@ -471,7 +472,7 @@ class Pair:
 
         self._send_guard = ContentAssertion("Pair.send")
         self._recv_guard = ContentAssertion("Pair.recv")
-        self._credit_lock = threading.Lock()
+        self._credit_lock = make_lock("Pair._credit_lock")
         self._published_head_mirror = 0  # last head value we published to the peer
         self.want_write = False  # a sender is stalled waiting for credits
         #: adaptive-BPEV activity score (see tpurpc/core/poller.py EWMA
@@ -482,7 +483,8 @@ class Pair:
         self.total_sent = 0
         self.total_recv = 0
 
-        self._notify_lock = threading.Lock()  # serializes notify-socket writes
+        # serializes notify-socket writes
+        self._notify_lock = make_lock("Pair._notify_lock")
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -1014,13 +1016,20 @@ class Pair:
             peer_pin = self._peer_status_pin()
             if peer_pin is not None:
                 peer_rxwait = peer_pin[1] + _STATUS_RXWAIT_OFF
-        # Small gather lists join into ONE buffer first: address extraction
-        # costs a numpy construction per segment (~1µs), which exceeds the
-        # memcpy of a few hundred bytes — one join + one pin beats N pins on
-        # the small-RPC path. Large payloads keep true scatter-gather.
-        if len(views) > 1 and sum(len(v) for v in views) <= 4096:
-            # join accepts memoryviews directly: one pass, one copy
-            views = [memoryview(b"".join(views))]
+        # Small gather lists coalesce into ONE buffer first: address
+        # extraction costs a numpy construction per segment (~1µs), which
+        # exceeds the memcpy of a few hundred bytes — one staging copy + one
+        # pin beats N pins on the small-RPC path. Large payloads keep true
+        # scatter-gather. (Preallocated fill, not b"".join: the hot-path
+        # no-copy lint bans the join idiom outright.)
+        small_total = sum(len(v) for v in views)
+        if len(views) > 1 and small_total <= 4096:
+            staged = bytearray(small_total)
+            pos = 0
+            for v in views:
+                staged[pos:pos + len(v)] = v
+                pos += len(v)
+            views = [memoryview(staged)]
         n = len(views)
         # locals pin every view for the call's duration
         seg_ptrs = (ctypes.c_void_p * n)(
@@ -1098,7 +1107,8 @@ class Pair:
         cap = self.reader.layout.capacity if self.reader is not None else 0
         buf = bytearray(min(max_bytes, cap))
         n = self.recv_into(buf)
-        return bytes(buf[:n])
+        del buf[n:]  # truncate in place: bytes(buf[:n]) would copy twice
+        return bytes(buf)
 
     def has_message(self) -> bool:
         return self.reader is not None and self.reader.has_message()

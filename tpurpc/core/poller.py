@@ -19,6 +19,8 @@ import time
 from collections import defaultdict
 from typing import Callable, Dict, List, Optional
 
+from tpurpc.analysis import locks as _dbglocks
+from tpurpc.analysis.locks import make_condition, make_lock
 from tpurpc.core.pair import Pair, PairState
 from tpurpc.utils import stats as _stats
 from tpurpc.utils.config import get_config
@@ -58,7 +60,13 @@ class Poller:
     """Round-robin scanner kicking wakeup fds (the BPEV background engine)."""
 
     _instance: Optional["Poller"] = None
-    _instance_lock = threading.Lock()
+    _instance_lock = make_lock("Poller._instance_lock")
+
+    #: lock map, checked by `python -m tpurpc.analysis` (lint rule `lock`):
+    #: the pair slots, their count, and the run flag only mutate under the
+    #: condition's lock (waiters key decisions off all three)
+    _GUARDED_BY = {"_pairs": "_cv", "_pair_count": "_cv", "_running": "_cv",
+                   "_instance": "_instance_lock"}
 
     @classmethod
     def get(cls) -> "Poller":
@@ -86,8 +94,8 @@ class Poller:
         # by the adaptive scan cadence in _run: hot scans run at 1 ms, idle
         # streaks back off exponentially to sleep_timeout_s.
         self._pairs: List[Optional[Pair]] = []
-        self._lock = threading.Lock()
-        self._cv = threading.Condition(self._lock)
+        self._lock = make_lock("Poller._lock")
+        self._cv = make_condition("Poller._cv", self._lock)
         self._threads: List[threading.Thread] = []
         self._running = False
         self._pair_count = 0
@@ -118,9 +126,14 @@ class Poller:
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> None:
-        if self._running:
-            return
-        self._running = True
+        # Flip the run flag under the scan loop's lock: an unlocked
+        # `self._running = True` could race a concurrent stop() into a
+        # started-but-flagged-stopped poller whose threads never exit their
+        # first wait (the lock-map pass flags the unlocked mutation).
+        with self._cv:
+            if self._running:
+                return
+            self._running = True
         for i in range(self.thread_num):
             t = threading.Thread(target=self._run, name=f"tpurpc-poller-{i}",
                                  daemon=True)
@@ -269,8 +282,6 @@ def _effective_cpus() -> int:
 
 def _wait(pair: Pair, timeout: Optional[float], discipline: Optional[str],
           predicate, role: str = "read") -> bool:
-    import selectors
-
     cfg = get_config()
     if discipline is None:
         discipline = cfg.platform.discipline or "hybrid"
@@ -362,6 +373,9 @@ def _wait(pair: Pair, timeout: Optional[float], discipline: Optional[str],
     # select — a producer that missed the flag must be visible to the
     # re-check, and one that saw it sends the byte the select consumes.
     pair.set_waiting(role, True)
+    if _dbglocks.ENABLED:
+        _dbglocks.note_blocking("waiter selector.select "
+                                f"({role}, pair {pair.tag})")
     _stats.counter_inc("wait_sleep")
     sleep_t0 = time.monotonic()
     #: a wake this fast after parking means a busy window would have caught
@@ -398,7 +412,11 @@ class PairPool:
     connection (see ``Pair.init`` for why stale one-sided writes forbid reuse)."""
 
     _instance: Optional["PairPool"] = None
-    _instance_lock = threading.Lock()
+    _instance_lock = make_lock("PairPool._instance_lock")
+
+    #: lock map, checked by `python -m tpurpc.analysis` (lint rule `lock`)
+    _GUARDED_BY = {"_idle": "_lock", "_idle_total": "_lock",
+                   "_instance": "_instance_lock"}
 
     @classmethod
     def get(cls) -> "PairPool":
@@ -441,7 +459,7 @@ class PairPool:
                                  else max(1, self.max_idle_total // 4))
         self._idle: Dict[str, List[Pair]] = defaultdict(list)
         self._idle_total = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("PairPool._lock")
 
     def take(self, key: str) -> Pair:
         from tpurpc.utils.config import get_config as _gc

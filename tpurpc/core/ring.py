@@ -47,7 +47,7 @@ from __future__ import annotations
 
 import ctypes
 import struct
-from typing import Callable, Iterable, List, Optional, Sequence, Tuple
+from typing import Callable, List, Optional, Sequence, Tuple
 
 from tpurpc.core import _native
 from tpurpc.tpu import ledger
@@ -306,7 +306,8 @@ class RingReader:
         # queued message's framing, and read_into() is about to do that walk anyway.
         out = bytearray(min(nbytes, self.layout.capacity))
         n = self.read_into(out)
-        return bytes(out[:n])
+        del out[n:]  # truncate in place: bytes(out[:n]) would copy twice
+        return bytes(out)
 
     # -- batched draining -----------------------------------------------------
 

@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import json
 import struct
-from typing import Any, List, Optional, Sequence, Tuple
+from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
@@ -107,7 +107,11 @@ def encode_tensor(x) -> List[bytes]:
 
 
 def encode_tensor_bytes(x) -> bytes:
-    return b"".join(bytes(s) for s in encode_tensor(x))
+    # materializing convenience API (tests/interop): accumulate, don't join
+    out = bytearray()
+    for s in encode_tensor(x):
+        out += s
+    return bytes(out)
 
 
 def decode_tensor(buf, offset: int = 0, copy: bool = False) -> Tuple[np.ndarray, int]:
@@ -199,7 +203,11 @@ def encode_tree(tree: Any) -> List[bytes]:
 
 
 def encode_tree_bytes(tree: Any) -> bytes:
-    return b"".join(bytes(s) for s in encode_tree(tree))
+    # materializing convenience API (tests/interop): accumulate, don't join
+    out = bytearray()
+    for s in encode_tree(tree):
+        out += s
+    return bytes(out)
 
 
 def decode_tree(buf, copy: bool = False, as_jax: bool = False,
@@ -236,7 +244,7 @@ def decode_tree_at(buf, offset: int = 0, copy: bool = False,
     # zero-copy receive windows may carry ring-alignment slack behind it.
     if len(view) - pos < trailer_len:
         raise CodecError("short tree trailer")
-    trailer = bytes(view[pos:pos + trailer_len])
+    trailer = view[pos:pos + trailer_len].tobytes()
     treedef = _treedef_from_json(json.loads(trailer.decode()))
     return jax.tree_util.tree_unflatten(treedef, leaves), pos + trailer_len
 
@@ -261,7 +269,7 @@ def decode_tree_many(buf, count: Optional[int] = None, copy: bool = False,
                 raise CodecError(
                     f"short batch: {len(out)} of {count} tree records")
             break
-        if bytes(view[pos:pos + 4]) != TREE_MAGIC:
+        if view[pos:pos + 4].tobytes() != TREE_MAGIC:  # 4-byte peek
             if count is not None:
                 raise CodecError(f"bad tree magic at batch offset {pos}")
             break

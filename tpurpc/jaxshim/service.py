@@ -27,7 +27,6 @@ from tpurpc.jaxshim import codec
 from tpurpc.rpc.server import (Server, stream_stream_rpc_method_handler,
                                unary_stream_rpc_method_handler,
                                unary_unary_rpc_method_handler)
-from tpurpc.rpc.status import StatusCode
 from tpurpc.utils.trace import TraceFlag
 
 trace_jax = TraceFlag("jaxshim")

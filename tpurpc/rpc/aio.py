@@ -27,7 +27,7 @@ from __future__ import annotations
 
 import asyncio
 import threading
-from typing import Any, AsyncIterator, Callable, Iterator, Optional, Sequence
+from typing import Any, AsyncIterator, Callable, Iterator, Optional
 
 import importlib
 
@@ -35,7 +35,7 @@ import importlib
 # server()/insecure_channel() FUNCTIONS that shadow the submodules.
 _server_mod = importlib.import_module("tpurpc.rpc.server")
 _channel_mod = importlib.import_module("tpurpc.rpc.channel")
-from tpurpc.rpc.status import Deserializer, Metadata, RpcError, Serializer
+from tpurpc.rpc.status import Deserializer, Metadata, Serializer
 from tpurpc.rpc.status import identity_codec as _identity
 
 __all__ = ["Server", "Channel", "server", "insecure_channel",

@@ -24,6 +24,7 @@ import time
 from typing import (Callable, Iterable, Iterator, List, Optional, Sequence,
                     Tuple, Union)
 
+from tpurpc.analysis.locks import make_condition, make_lock
 from tpurpc.core.endpoint import Endpoint, EndpointError, connect_endpoint
 from tpurpc.rpc import frame as fr
 from tpurpc.rpc.status import (ChannelConnectivity, Deserializer, Metadata,
@@ -157,7 +158,7 @@ class _Connection:
         self.reader.sink = _ChannelSink(self)
         self.reader.sink.max_message_bytes = max_recv_bytes
         self._streams: dict[int, _ClientStream] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("_Connection._lock")
         self._next_stream_id = 1  # odd ids, client-initiated (h2 convention)
         self._pong_waiters: List[threading.Event] = []
         self.pong_count = 0  # keepalive verdict ticks compare against this
@@ -177,7 +178,7 @@ class _Connection:
         # dedicated reader thread everywhere.
         self._pump_mode = self._pump_enabled(endpoint)
         self._pumping = False
-        self._pump_cond = threading.Condition(self._lock)
+        self._pump_cond = make_condition("_Connection._pump_cond", self._lock)
         if self._pump_mode:
             self._start_backup_pump()
         else:
@@ -580,8 +581,10 @@ class _Subchannel:
         self._factory = factory
         self._channel = channel
         self._conn: Optional[_Connection] = None
-        self._lock = threading.Lock()          # guards _conn/backoff state
-        self._connect_lock = threading.Lock()  # serializes dial attempts only
+        # guards _conn/backoff state
+        self._lock = make_lock("_Subchannel._lock")
+        # serializes dial attempts only
+        self._connect_lock = make_lock("_Subchannel._connect_lock")
         self._backoff = Channel._BACKOFF_INITIAL
         self._next_attempt = 0.0
 
@@ -741,7 +744,7 @@ class Channel:
             self.update_service_config(self._svc_cfg_fallback)
         self._subchannels = [_Subchannel(f, self) for f in factories]
         self._policy = make_policy(lb_policy, len(self._subchannels))
-        self._lock = threading.Lock()  # guards _closed
+        self._lock = make_lock("Channel._lock")  # guards _closed
         self._closed = False
         self._kicker: Optional[threading.Thread] = None  # get_state dialer
         # Native unary fast path (lazy; see _native_fast): the reference's
@@ -750,7 +753,7 @@ class Channel:
         # surface (grpcio → core, SURVEY §2.4). _native_ch is the cached
         # NativeChannel; _native_retry_at throttles re-dial attempts after
         # a failure so an absent/down native path costs one probe per 5 s.
-        self._native_lock = threading.Lock()
+        self._native_lock = make_lock("Channel._native_lock")
         self._native_ch = None
         self._native_retry_at = 0.0
         from tpurpc.rpc import channelz as _channelz
@@ -1932,7 +1935,7 @@ class _NativeStreamCall:
         self._counters = channel.call_counters
         self._counters.on_start()
         self._finished = False
-        self._finish_lock = threading.Lock()
+        self._finish_lock = make_lock("_NativeStreamCall._finish_lock")
         self._callbacks: list = []
         self._app_exc: list = []
         self._sender = threading.Thread(

@@ -10,13 +10,13 @@ inspection works over tpurpc itself.
 from __future__ import annotations
 
 import json
-import threading
 import time
 import weakref
-from typing import Dict, List
+from typing import Dict
 
+from tpurpc.analysis.locks import make_lock
 
-_lock = threading.Lock()
+_lock = make_lock("channelz._lock")
 _servers: "weakref.WeakSet" = weakref.WeakSet()
 _channels: "weakref.WeakSet" = weakref.WeakSet()
 
@@ -30,17 +30,22 @@ class CallCounters:
     __slots__ = ("started", "succeeded", "failed", "last_call_started",
                  "_mu")
 
+    #: lock map, checked by `python -m tpurpc.analysis` (lint rule `lock`)
+    _GUARDED_BY = {"started": "_mu", "succeeded": "_mu", "failed": "_mu",
+                   "last_call_started": "_mu"}
+
     def __init__(self):
         self.started = 0
         self.succeeded = 0
         self.failed = 0
         self.last_call_started = 0.0
-        self._mu = threading.Lock()
+        self._mu = make_lock("CallCounters._mu")
 
     def on_start(self) -> None:
         with self._mu:
             self.started += 1
-            self.last_call_started = time.time()
+            # channelz REPORTS this as an absolute wall timestamp
+            self.last_call_started = time.time()  # tpr: allow(wallclock)
 
     def on_finish(self, ok: bool) -> None:
         with self._mu:
@@ -50,10 +55,14 @@ class CallCounters:
                 self.failed += 1
 
     def as_dict(self) -> Dict:
-        return {"calls_started": self.started,
-                "calls_succeeded": self.succeeded,
-                "calls_failed": self.failed,
-                "last_call_started": self.last_call_started}
+        # snapshot under the same lock as the writers: a reader between the
+        # started += 1 and the timestamp store would report a call count
+        # with the previous call's timestamp (unlocked-snapshot window)
+        with self._mu:
+            return {"calls_started": self.started,
+                    "calls_succeeded": self.succeeded,
+                    "calls_failed": self.failed,
+                    "last_call_started": self.last_call_started}
 
 
 _next_id = 0

@@ -26,7 +26,7 @@ convention); all other values are utf-8 text.
 from __future__ import annotations
 
 import struct
-from typing import Iterable, List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 from tpurpc.core.endpoint import Endpoint
 from tpurpc.rpc.status import StatusCode

@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import enum
 import threading
-from typing import Dict, Iterator, List
+from typing import Dict, Iterator
 
 from tpurpc.rpc.server import (Server, unary_stream_rpc_method_handler,
                                unary_unary_rpc_method_handler)

@@ -18,9 +18,9 @@ from __future__ import annotations
 
 import random
 import time
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Callable, Dict, Optional, Sequence
 
-from tpurpc.rpc.status import AbortError, Metadata, RpcError, StatusCode
+from tpurpc.rpc.status import AbortError, Metadata, StatusCode
 
 
 class HandlerCallDetails:

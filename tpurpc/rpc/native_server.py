@@ -40,7 +40,7 @@ import os
 import socket
 import threading
 import weakref
-from typing import Dict, Optional
+from typing import Optional
 
 from tpurpc.rpc.status import AbortError, StatusCode, deserialize
 from tpurpc.utils.trace import TraceFlag

@@ -22,7 +22,7 @@ import queue
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import (Callable, Dict, Iterable, Iterator, List, Optional,
+from typing import (Callable, Dict, Iterator, List, Optional,
                     Sequence, Tuple)
 
 from tpurpc.core.endpoint import (Endpoint, EndpointError, EndpointListener,

@@ -46,6 +46,8 @@ import os
 import threading
 from typing import Dict, List, Optional, Sequence
 
+from tpurpc.analysis.locks import make_condition
+
 METHOD = "/tpurpc.xds.v1.Ads/Stream"
 
 
@@ -85,8 +87,11 @@ class XdsServicer:
     subscriber of that service receives the new assignment immediately,
     and a fresh subscriber gets the current one on subscribe."""
 
+    #: lock map, checked by `python -m tpurpc.analysis` (lint rule `lock`)
+    _GUARDED_BY = {"_assignments": "_lock", "_version": "_lock"}
+
     def __init__(self):
-        self._lock = threading.Condition()
+        self._lock = make_condition("XdsServicer._lock")
         self._assignments: Dict[str, List[str]] = {}
         self._version = 0
 
@@ -160,9 +165,14 @@ class XdsServicer:
             # mutation relied on the 1 s wait timeout to be observed).
             for raw in req_iter:
                 upd = xds_v3.decode_discovery_request(raw)
-                if upd["resource_names"] and (upd["resource_names"]
-                                              != subscribed):
-                    with self._lock:
+                if not upd["resource_names"]:
+                    continue
+                with self._lock:
+                    # the compare must sit INSIDE the critical section too:
+                    # comparing against `subscribed` unlocked reads the list
+                    # while the push loop's snapshot may observe it — the
+                    # residual window of the round-5 fix (ISSUE 2 satellite)
+                    if upd["resource_names"] != subscribed:
                         subscribed[:] = upd["resource_names"]
                         sub_changed.set()
                         self._lock.notify_all()

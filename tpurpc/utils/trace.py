@@ -87,9 +87,14 @@ def _verbosity() -> int:
 
 
 def _emit(level: str, msg: str) -> None:
-    ts = time.strftime("%H:%M:%S", time.localtime())
+    # ONE wall-clock read for the whole stamp: deriving the seconds and the
+    # sub-second fraction from separate reads tears across a second boundary
+    # (…:01.999 followed by …:01.000042). Log stamps are absolute times for
+    # humans; anything computing durations uses time.monotonic().
+    now = time.time()  # tpr: allow(wallclock)
+    ts = time.strftime("%H:%M:%S", time.localtime(now))
     tid = threading.get_ident() & 0xFFFF
-    print(f"{level[0]}{ts}.{int(time.time()*1e6)%1000000:06d} {tid:5d} {msg}",
+    print(f"{level[0]}{ts}.{int(now * 1e6) % 1000000:06d} {tid:5d} {msg}",
           file=sys.stderr, flush=True)
 
 

@@ -36,6 +36,25 @@ _log = logging.getLogger("tpurpc.grpc_h2")
 _GRPC_MSG_HDR = struct.Struct("!BI")
 
 
+def _frame_grpc_message(payload) -> bytearray:
+    """gRPC length-prefix framing in ONE preallocated buffer.
+
+    ``payload`` may be bytes-like or a serializer gather list; either way
+    the 5-byte header + every segment lands with a single staging copy —
+    the ``b"".join`` + header-concat idiom this replaces copied the whole
+    message twice (and is banned by the hot-path no-copy lint)."""
+    parts = payload if isinstance(payload, (list, tuple)) else (payload,)
+    views = [memoryview(p).cast("B") for p in parts]
+    total = sum(len(v) for v in views)
+    data = bytearray(_GRPC_MSG_HDR.size + total)
+    _GRPC_MSG_HDR.pack_into(data, 0, 0, total)
+    pos = _GRPC_MSG_HDR.size
+    for v in views:
+        data[pos:pos + len(v)] = v
+        pos += len(v)
+    return data
+
+
 def decode_grpc_message(msg: bytes, compressed: int, encoding: str):
     """Per-message decompression per the gRPC spec; shared by the h2 server
     and client. Returns ``(message, None)`` or ``(None, (status, details))``:
@@ -255,12 +274,7 @@ class GrpcH2Connection:
             self._write(segs)
 
     def send_message(self, st: _H2Stream, payload) -> None:
-        if isinstance(payload, (list, tuple)):
-            payload = b"".join(bytes(p) for p in payload)
-        else:
-            payload = bytes(payload)
-        data = _GRPC_MSG_HDR.pack(0, len(payload)) + payload
-        mv = memoryview(data)
+        mv = memoryview(_frame_grpc_message(payload))
         pos = 0
         while pos < len(mv):
             want = min(len(mv) - pos, self._peer_max_frame)
@@ -279,8 +293,9 @@ class GrpcH2Connection:
             if conn_got < got:  # return the stream window over-reservation
                 st.window.grant(got - conn_got)
                 got = conn_got
-            chunk = mv[pos:pos + got]
-            self._write(h2.pack_frame(h2.DATA, 0, st.stream_id, bytes(chunk)))
+            # the chunk view passes through to the gather write unmaterialized
+            self._write(h2.pack_frame(h2.DATA, 0, st.stream_id,
+                                      mv[pos:pos + got]))
             pos += got
 
     def _trailer_segs(self, st: _H2Stream, code: StatusCode, details: str,
@@ -308,11 +323,7 @@ class GrpcH2Connection:
         + trailers in ONE gather write, when the message fits a single DATA
         frame and both flow-control windows can reserve it without blocking.
         Returns False (nothing written) to use the chunked blocking path."""
-        if isinstance(payload, (list, tuple)):
-            payload = b"".join(bytes(p) for p in payload)
-        else:
-            payload = bytes(payload)
-        data = _GRPC_MSG_HDR.pack(0, len(payload)) + payload
+        data = _frame_grpc_message(payload)
         if len(data) > self._peer_max_frame or st.window is None:
             return False
         if not st.window.try_take(len(data)):
@@ -379,8 +390,10 @@ class GrpcH2Connection:
                 j += 1
             if j - i > 1:
                 _stats.batch_hist("h2_data_coalesce").record(j - i)
+            # the run's payloads pass through as a segment list — _on_data
+            # appends each to the reassembly buffer (no join copy)
             self._on_data(sid, last_flags,
-                          b"".join(datas) if len(datas) > 1 else datas[0],
+                          datas if len(datas) > 1 else datas[0],
                           consumed)
             i = j
 
@@ -505,11 +518,12 @@ class GrpcH2Connection:
             # server cannot run handlers kills itself so clients redial.
             self.close()
 
-    def _on_data(self, sid: int, flags: int, data: bytes,
+    def _on_data(self, sid: int, flags: int, data,
                  consumed: int) -> None:
-        """``data`` is the padding-stripped payload (possibly several
-        coalesced DATA frames' worth); ``consumed`` the flow-control bytes
-        the run occupied on the wire (RFC 7540 §6.9 counts padding)."""
+        """``data`` is the padding-stripped payload — one bytes-like, or a
+        LIST of them for a coalesced run of DATA frames; ``consumed`` the
+        flow-control bytes the run occupied on the wire (RFC 7540 §6.9
+        counts padding)."""
         with self._lock:
             st = self._streams.get(sid)
         # flow control: grant back what we consumed, always (even on unknown
@@ -522,15 +536,22 @@ class GrpcH2Connection:
             self._write(segs)
         if st is None:
             return
-        st.partial += data
+        if isinstance(data, list):
+            for d in data:
+                st.partial += d
+        else:
+            st.partial += data
         while True:
             if len(st.partial) < _GRPC_MSG_HDR.size:
                 break
             compressed, length = _GRPC_MSG_HDR.unpack_from(st.partial)
             if len(st.partial) < _GRPC_MSG_HDR.size + length:
                 break
-            msg = bytes(st.partial[_GRPC_MSG_HDR.size:
-                                   _GRPC_MSG_HDR.size + length])
+            # one copy out of the reassembly buffer via a released view —
+            # bytes(partial[a:b]) would slice-copy and then copy again
+            mv = memoryview(st.partial)
+            msg = mv[_GRPC_MSG_HDR.size:_GRPC_MSG_HDR.size + length].tobytes()
+            mv.release()
             del st.partial[:_GRPC_MSG_HDR.size + length]
             msg, err = decode_grpc_message(msg, compressed, st.recv_encoding)
             if err is not None:

@@ -37,7 +37,7 @@ import threading
 import time
 from typing import Dict, Iterable, List, Optional, Tuple
 
-from tpurpc.core.endpoint import Endpoint, EndpointError, ReadTimeout, TcpEndpoint
+from tpurpc.core.endpoint import Endpoint, EndpointError, TcpEndpoint
 from tpurpc.rpc.status import Metadata, RpcError, StatusCode
 from tpurpc.utils import stats as _stats
 from tpurpc.wire import h2
