@@ -288,6 +288,17 @@ void tpr_verbs_qp_destroy(tpr_verbs_qp *q) {
   delete q;
 }
 
+#if defined(TPR_TEST_MOCK_VERBS)
+// Test-only observability: how many MRs the mock "NIC" currently holds.
+// Lets tests prove the registered-source post path really registers (and
+// deregisters) its staging bounce MR rather than posting from raw memory.
+int tpr_mock_mr_count(void) {
+  auto &f = tpr_mock_fabric::get();
+  std::lock_guard<std::mutex> lk(f.mu);
+  return (int)f.mrs_by_rkey.size();
+}
+#endif  // TPR_TEST_MOCK_VERBS
+
 #else  // !TPR_HAVE_VERBS — honest unavailability, never a silent fake
 
 struct tpr_verbs_ctx;

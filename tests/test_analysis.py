@@ -219,6 +219,78 @@ def test_wallclock_flagged_and_suppressable():
 
 
 # ---------------------------------------------------------------------------
+# lint: no blocking calls on the inline dispatch path (ISSUE 3)
+# ---------------------------------------------------------------------------
+
+BLOCK_SRC = '''
+import time
+
+class _ServerConnection:
+    def _run_handler_inner(self, handler, st, ctx, path):
+        time.sleep(0.1)
+        item = st.requests.get()
+        self._lock.acquire()
+        st._credits.wait()
+        self._thread.join()
+
+    def off_path_helper(self):
+        time.sleep(1)          # not an inline-dispatch function: allowed
+'''
+
+BLOCK_BOUNDED = '''
+class _ServerStream:
+    def next_request(self, timeout=None):
+        item = self.requests.get(timeout=timeout)
+        self._credits.acquire(timeout=0.25)
+        self._credits.acquire(blocking=False)
+        self._done.wait(timeout=1.0)
+        self._thread.join(5)
+        return item
+'''
+
+
+def test_block_rule_flags_unbounded_calls_on_dispatch_path():
+    vs = lint_source(BLOCK_SRC, "tpurpc/rpc/server.py")
+    assert _rules(vs) == ["block"]
+    # sleep, bare .get(), bare .acquire(), bare .wait(), bare .join() —
+    # and ONLY inside the configured inline-path functions
+    assert len(vs) == 5
+    assert all("_run_handler_inner" in v.message for v in vs)
+
+
+def test_block_rule_bounded_waits_pass():
+    assert lint_source(BLOCK_BOUNDED, "tpurpc/rpc/server.py") == []
+
+
+def test_block_rule_scoped_to_inline_dispatch_module():
+    # the same source outside rpc/server.py is not on the dispatch path
+    assert lint_source(BLOCK_SRC, "tpurpc/rpc/channel.py") == []
+    assert lint_source(BLOCK_SRC, "fixture.py") == []
+
+
+def test_block_rule_suppression_comment():
+    src = BLOCK_BOUNDED.replace(
+        "item = self.requests.get(timeout=timeout)",
+        "item = self.requests.get()  # tpr: allow(block)")
+    assert lint_source(src, "tpurpc/rpc/server.py") == []
+    # without the annotation the same line is a finding
+    bare = BLOCK_BOUNDED.replace(
+        "item = self.requests.get(timeout=timeout)",
+        "item = self.requests.get()")
+    assert _rules(lint_source(bare, "tpurpc/rpc/server.py")) == ["block"]
+
+
+def test_block_rule_real_server_module_is_clean():
+    import importlib
+
+    server_mod = importlib.import_module("tpurpc.rpc.server")
+    path = server_mod.__file__
+    with open(path, "r", encoding="utf-8") as f:
+        vs = lint_source(f.read(), path)
+    assert [v for v in vs if v.rule == "block"] == []
+
+
+# ---------------------------------------------------------------------------
 # the repo-wide gate
 # ---------------------------------------------------------------------------
 

@@ -76,6 +76,38 @@ def test_qps_localhost_scenario_two_clients():
     assert agg["rpcs"] > 20
     assert agg["rate_rps"] > 0
     assert agg["rtt_us"]["p50"] > 0
+    # achieved-concurrency provenance (ISSUE 3 satellite): workers can fall
+    # behind --concurrency; a healthy localhost run must achieve all of it,
+    # summed across the 2 client workers
+    assert agg["concurrency_requested"] == 2
+    assert agg["concurrency_achieved"] == 2
+
+
+def test_micro_records_achieved_concurrency():
+    srv = micro.run_server(0)
+    try:
+        result = micro.run_client(f"127.0.0.1:{srv.bench_port}", req_size=32,
+                                  duration=1.0, concurrency=3,
+                                  report_every=0.5, out=io.StringIO())
+        assert result["concurrency_requested"] == 3
+        assert result["concurrency_achieved"] == 3  # nobody fell behind
+    finally:
+        srv.stop(grace=0)
+
+
+def test_micro_achieved_concurrency_drops_when_workers_die():
+    """A worker that dies mid-run (server torn down under it while others
+    already stopped... simulated directly: bogus target for some workers)
+    must NOT be counted as achieved load."""
+    srv = micro.run_server(0)
+    port = srv.bench_port
+    srv.stop(grace=0)  # nothing listens: every worker errors out mid-run
+    result = micro.run_client(f"127.0.0.1:{port}", req_size=32,
+                              duration=1.0, concurrency=2,
+                              report_every=0.5, out=io.StringIO())
+    assert result["concurrency_requested"] == 2
+    assert result["concurrency_achieved"] < 2
+    assert result["rpcs"] == 0
 
 
 def _cpus() -> int:

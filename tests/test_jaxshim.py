@@ -5,6 +5,7 @@ jax.Array) and #4 (8-client fan-in, batched dispatch).
 """
 
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -267,6 +268,106 @@ def test_batcher_fixed_bucket_single_shape():
         out = b({"x": np.ones((1, 4), np.float32)})
         assert out["x"].shape[0] == 1  # reply sliced back to the request rows
         assert shapes == [8]           # but the dispatch was padded to 8
+    finally:
+        b.close()
+
+
+def test_batcher_close_with_pending_requests_fails_or_serves_cleanly():
+    """ISSUE 3 edge case: close() racing queued requests must resolve every
+    caller — a result if the final batch dispatched, the documented
+    'batcher closed' error otherwise. Never a stranded p.event.wait()."""
+    import queue as _q
+
+    gate = threading.Event()
+
+    def fn(tree):
+        gate.wait(5)  # hold the batcher thread so requests pile up
+        return tree
+
+    b = FanInBatcher(fn, max_batch=4, max_delay_s=0.01)
+    outcomes: "_q.Queue" = _q.Queue()
+
+    def caller(i):
+        try:
+            outcomes.put(("ok", b({"x": np.full((1, 2), float(i),
+                                               np.float32)})))
+        except RuntimeError as exc:
+            outcomes.put(("err", str(exc)))
+
+    ts = [threading.Thread(target=caller, args=(i,), daemon=True)
+          for i in range(6)]
+    [t.start() for t in ts]
+    time.sleep(0.1)  # let requests queue behind the gated dispatch
+    gate.set()
+    b.close()
+    [t.join(timeout=10) for t in ts]
+    assert not any(t.is_alive() for t in ts), "caller stranded by close()"
+    got = [outcomes.get(timeout=1) for _ in range(6)]
+    assert len(got) == 6
+    for kind, val in got:
+        assert kind == "ok" or "closed" in val
+
+
+def test_batcher_bad_request_does_not_poison_siblings():
+    """One mis-shaped request in a mixed batch fails ALONE; siblings'
+    futures still deliver results (ISSUE 3 edge case)."""
+    import jax.numpy as jnp
+
+    def fn(tree):
+        return {"y": jnp.asarray(tree["x"]) * 2.0}
+
+    b = FanInBatcher(fn, max_batch=8, max_delay_s=0.05)
+    results = [None] * 5
+    errors = [None] * 5
+
+    def caller(i):
+        try:
+            if i == 2:  # wrong trailing shape: can't stack with siblings
+                results[i] = b({"x": np.ones((1, 7), np.float32)})
+            else:
+                results[i] = b({"x": np.full((1, 4), float(i), np.float32)})
+        except Exception as exc:
+            errors[i] = exc
+
+    try:
+        ts = [threading.Thread(target=caller, args=(i,)) for i in range(5)]
+        [t.start() for t in ts]
+        [t.join(timeout=10) for t in ts]
+        assert errors[2] is not None and "incompatible" in str(errors[2])
+        for i in (0, 1, 3, 4):
+            assert errors[i] is None, errors[i]
+            np.testing.assert_allclose(np.asarray(results[i]["y"]),
+                                       np.full((1, 4), i * 2.0))
+    finally:
+        b.close()
+
+
+def test_batcher_max_delay_flush_fires_under_single_slow_producer():
+    """A lone producer (batch never fills) must still be served within
+    ~max_delay_s — the timer flush, not the size trigger."""
+    b = FanInBatcher(lambda t: t, max_batch=64, max_delay_s=0.05)
+    try:
+        t0 = time.monotonic()
+        out = b({"x": np.ones((1, 2), np.float32)})
+        dt = time.monotonic() - t0
+        assert out["x"].shape == (1, 2)
+        assert dt < 5.0  # flushed by the timer, not stuck awaiting 64 rows
+        assert b.batches_run == 1 and b.rows_run == 1
+    finally:
+        b.close()
+
+
+def test_batcher_depth_aware_flush_beats_max_delay():
+    """With inflight_fn reporting that every in-flight request is already
+    queued, the batch dispatches immediately instead of waiting out a
+    long max_delay_s (ISSUE 3's depth-aware flush)."""
+    b = FanInBatcher(lambda t: t, max_batch=64, max_delay_s=2.0,
+                     inflight_fn=lambda: 1)
+    try:
+        t0 = time.monotonic()
+        b({"x": np.ones((1, 2), np.float32)})
+        dt = time.monotonic() - t0
+        assert dt < 1.0, f"depth-aware flush did not fire early ({dt:.2f}s)"
     finally:
         b.close()
 

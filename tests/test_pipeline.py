@@ -1,0 +1,217 @@
+"""Pipelined serving path (ISSUE 3): multi-in-flight unary clients.
+
+Deterministic coverage of the properties the bench's depth sweep can only
+measure statistically:
+
+* stream-id demux — N concurrent calls on ONE connection each get their
+  own response, including when the server completes them out of order;
+* window backpressure — the depth+1'th call_async blocks until a
+  completion frees a slot;
+* out-of-order completion — a parked call must not block siblings;
+* deadline watchdog — a never-answered pipelined call fails
+  DEADLINE_EXCEEDED and releases its window slot;
+* cross-stream response coalescing — responses stay intact through the
+  server's gathered writev (tag echo over many concurrent streams);
+* the native plane's inline-window futures (lib permitting).
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+import tpurpc.rpc as rpc
+from tpurpc.rpc.channel import Channel
+from tpurpc.rpc.status import RpcError, StatusCode
+
+NATIVE_LIB = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "native", "build", "libtpurpc.so")
+
+
+@pytest.fixture()
+def echo_server():
+    """Echo server with a parkable method for out-of-order scenarios."""
+    park = threading.Event()
+
+    def echo(req, ctx):
+        return b"ok:" + bytes(req)
+
+    def parked(req, ctx):
+        park.wait(10)
+        return b"late:" + bytes(req)
+
+    def fail_odd(req, ctx):
+        if int(bytes(req)) % 2:
+            ctx.abort(StatusCode.FAILED_PRECONDITION, "odd rejected")
+        return bytes(req)
+
+    srv = rpc.Server(max_workers=8)
+    srv.add_method("/p/Echo", rpc.unary_unary_rpc_method_handler(echo))
+    srv.add_method("/p/EchoInline",
+                   rpc.unary_unary_rpc_method_handler(echo, inline=True))
+    srv.add_method("/p/Park", rpc.unary_unary_rpc_method_handler(parked))
+    srv.add_method("/p/FailOdd",
+                   rpc.unary_unary_rpc_method_handler(fail_odd))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    yield port, park
+    park.set()
+    srv.stop(grace=0)
+
+
+def test_stream_id_demux_many_in_flight(echo_server):
+    port, _ = echo_server
+    with Channel(f"127.0.0.1:{port}") as ch:
+        pl = ch.unary_unary("/p/Echo").pipeline(depth=16)
+        futs = [pl.call_async(b"r%d" % i, timeout=30) for i in range(48)]
+        for i, f in enumerate(futs):
+            assert f.result(timeout=10) == b"ok:r%d" % i
+
+
+def test_out_of_order_completion_does_not_block_siblings(echo_server):
+    port, park = echo_server
+    with Channel(f"127.0.0.1:{port}") as ch:
+        mc_park = ch.unary_unary("/p/Park").pipeline(depth=4)
+        mc_echo = ch.unary_unary("/p/Echo").pipeline(depth=4)
+        slow = mc_park.call_async(b"s", timeout=30)
+        fasts = [mc_echo.call_async(b"f%d" % i, timeout=30)
+                 for i in range(8)]
+        for i, f in enumerate(fasts):
+            assert f.result(timeout=10) == b"ok:f%d" % i
+        assert not slow.done()  # still parked while siblings completed
+        park.set()
+        assert slow.result(timeout=10) == b"late:s"
+
+
+def test_window_backpressure_blocks_depth_plus_one(echo_server):
+    port, park = echo_server
+    with Channel(f"127.0.0.1:{port}") as ch:
+        pl = ch.unary_unary("/p/Park").pipeline(depth=2)
+        a = pl.call_async(b"a", timeout=30)
+        b = pl.call_async(b"b", timeout=30)
+        third = []
+
+        def blocked():
+            third.append(pl.call_async(b"c", timeout=30))
+
+        t = threading.Thread(target=blocked, daemon=True)
+        t.start()
+        time.sleep(0.3)
+        assert not third, "3rd call should block on the depth-2 window"
+        park.set()  # completions free slots; the blocked call proceeds
+        t.join(timeout=10)
+        assert third and third[0].result(timeout=10) == b"late:c"
+        assert a.result(10) == b"late:a" and b.result(10) == b"late:b"
+
+
+def test_pipelined_deadline_fails_future_and_frees_window(echo_server):
+    port, park = echo_server
+    with Channel(f"127.0.0.1:{port}") as ch:
+        pl = ch.unary_unary("/p/Park").pipeline(depth=1)
+        f = pl.call_async(b"never", timeout=0.3)
+        with pytest.raises(RpcError) as ei:
+            f.result(timeout=10)
+        code = ei.value.code() if callable(ei.value.code) else ei.value.code
+        assert code is StatusCode.DEADLINE_EXCEEDED
+        # the expired call released its window slot: the next call on the
+        # SAME depth-1 pipeline proceeds instead of wedging
+        park.set()
+        f2 = pl.call_async(b"after", timeout=30)
+        assert f2.result(timeout=10) == b"late:after"
+
+
+def test_pipelined_errors_demux_to_their_own_futures(echo_server):
+    port, _ = echo_server
+    with Channel(f"127.0.0.1:{port}") as ch:
+        pl = ch.unary_unary("/p/FailOdd").pipeline(depth=8)
+        futs = [pl.call_async(b"%d" % i, timeout=30) for i in range(10)]
+        for i, f in enumerate(futs):
+            if i % 2:
+                with pytest.raises(RpcError, match="odd rejected"):
+                    f.result(timeout=10)
+            else:
+                assert f.result(timeout=10) == b"%d" % i
+
+
+def test_coalesced_responses_survive_concurrent_streams(echo_server):
+    """Responses completing close together flush as one gathered writev
+    (server-side coalescing); every payload must still reach its own
+    stream intact. The histogram proves multi-response flushes happened."""
+    from tpurpc.utils import stats
+
+    stats.reset_batch_stats()
+    port, _ = echo_server
+    n_conns, per_conn = 4, 32
+    errors: list = []
+
+    def one(conn_idx):
+        try:
+            with Channel(f"127.0.0.1:{port}") as ch:
+                pl = ch.unary_unary("/p/Echo").pipeline(depth=16)
+                futs = [pl.call_async(b"c%d-%d" % (conn_idx, i), timeout=30)
+                        for i in range(per_conn)]
+                for i, f in enumerate(futs):
+                    got = f.result(timeout=15)
+                    assert got == b"ok:c%d-%d" % (conn_idx, i), got
+        except Exception as exc:
+            errors.append(exc)
+
+    ts = [threading.Thread(target=one, args=(i,)) for i in range(n_conns)]
+    [t.start() for t in ts]
+    [t.join(timeout=30) for t in ts]
+    assert not errors, errors
+    h = stats.batch_snapshot().get("resp_coalesce")
+    assert h and h["count"] > 0  # the combiner ran
+    # not asserting mean>1: coalescing opportunities are load-dependent;
+    # correctness above is the deterministic claim
+
+
+def test_inline_dispatch_pipelined(echo_server):
+    """Inline (reader-thread) handlers serve pipelined clients too: the
+    fused responses demux correctly and the connection stays healthy."""
+    port, _ = echo_server
+    with Channel(f"127.0.0.1:{port}") as ch:
+        pl = ch.unary_unary("/p/EchoInline").pipeline(depth=8)
+        futs = [pl.call_async(b"i%d" % i, timeout=30) for i in range(32)]
+        for i, f in enumerate(futs):
+            assert f.result(timeout=10) == b"ok:i%d" % i
+
+
+def test_tensor_client_call_async_roundtrip(echo_server):
+    import numpy as np
+
+    from tpurpc.jaxshim import TensorClient, add_tensor_method
+
+    srv = rpc.Server(max_workers=4)
+    add_tensor_method(srv, "Dbl", lambda t: {"y": np.asarray(t["x"]) * 2})
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    try:
+        with Channel(f"127.0.0.1:{port}") as ch:
+            cli = TensorClient(ch, depth=8)
+            xs = [np.full((2, 3), i, np.float32) for i in range(12)]
+            futs = [cli.call_async("Dbl", {"x": x}, timeout=30) for x in xs]
+            for i, f in enumerate(futs):
+                out = f.result(timeout=10)
+                assert np.array_equal(np.asarray(out["y"]), xs[i] * 2)
+    finally:
+        srv.stop(grace=0)
+
+
+@pytest.mark.skipif(not os.path.exists(NATIVE_LIB),
+                    reason="native lib not built")
+def test_native_inline_window_futures(echo_server):
+    """NativeChannel(inline_read=True).unary_unary(...).future — the CQ
+    refuses on inline channels, so the bounded worker window carries the
+    multi-in-flight contract there."""
+    from tpurpc.rpc.native_client import NativeChannel
+
+    port, _ = echo_server
+    with NativeChannel("127.0.0.1", port, inline_read=True,
+                       pipeline_depth=4) as ch:
+        mc = ch.unary_unary("/p/Echo")
+        futs = [mc.future(b"n%d" % i, timeout=30) for i in range(16)]
+        for i, f in enumerate(futs):
+            assert f.result(timeout=15) == b"ok:n%d" % i

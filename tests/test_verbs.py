@@ -106,6 +106,41 @@ def test_one_sided_write_through_mock_fabric(monkeypatch):
     dom.close()  # idempotent
 
 
+def test_write_posts_from_registered_bounce_source(monkeypatch):
+    """The registered-source post path (ISSUE 3 satellite, closes the
+    round-5 skeleton TODO): every WRITE's local SGE must come from an
+    ibv_reg_mr'd staging buffer with that MR's real lkey — real RC
+    hardware faults on unregistered sources, so the window registers a
+    bounce MR at open and stages through it. Proven here by observing the
+    bounce registration itself: opening a window adds a second MR (the
+    region's + the bounce), closing the window deregisters it, and writes
+    still land — including from a read-only bytes source (the old
+    from_buffer_copy path is gone; staging handles readonly views)."""
+    import ctypes
+
+    _build_mock_lib()
+    verbs = _fresh_domain_module(monkeypatch, MOCK_LIB)
+    lib = ctypes.CDLL(MOCK_LIB)
+    lib.tpr_mock_mr_count.restype = ctypes.c_int
+    dom = verbs.VerbsDomain()
+    region = dom.alloc(256)
+    try:
+        before = lib.tpr_mock_mr_count()
+        win = dom.open_window(region.handle, 256)
+        try:
+            assert lib.tpr_mock_mr_count() == before + 1  # the bounce MR
+            win.write(8, b"readonly-bytes-source")  # readonly view: stages
+            assert bytes(region.buf[8:29]) == b"readonly-bytes-source"
+            win.write(8, memoryview(bytearray(b"writable-view-source!")))
+            assert bytes(region.buf[8:29]) == b"writable-view-source!"
+        finally:
+            win.close()
+        assert lib.tpr_mock_mr_count() == before  # bounce deregistered
+    finally:
+        region.close()
+        dom.close()
+
+
 def test_window_rejects_foreign_and_oversized_handles(monkeypatch):
     _build_mock_lib()
     verbs = _fresh_domain_module(monkeypatch, MOCK_LIB)

@@ -29,6 +29,13 @@ fi
 note "python -m tpurpc.analysis (lint + ringcheck + mutants)"
 python -m tpurpc.analysis || fail=1
 
+# 2b) serving-pipeline smoke (ISSUE 3): depth-4 loopback, 32 pipelined
+#     requests over pool AND inline dispatch — every future must complete
+#     and demux to the stream that asked. Catches pipelining regressions
+#     (demux mix-ups, window wedges, coalescing corruption) in ~1s, no jax.
+note "serving pipeline smoke (depth=4, 32 reqs)"
+python -m tpurpc.tools.serving_smoke || fail=1
+
 # 3) the analysis subsystem's own tests, plus a lock-order-instrumented run
 #    of the concurrency-heavy suites (TPURPC_DEBUG_LOCKS exercises the
 #    CheckedLock shim wired into poller/pair/xds/channel/channelz)

@@ -26,6 +26,13 @@ hand (ISSUE 2) and that no general-purpose linter knows about:
   that could feed duration/interval math; genuinely absolute timestamps
   (channelz report fields, human-facing log stamps) carry an explicit
   ``# tpr: allow(wallclock)`` annotation.
+* ``block``    — no unbounded blocking on the inline dispatch path
+  (``rpc/server.py``, the functions the reactor invocation from
+  ``_ServerSink.commit`` runs on the connection READER thread —
+  ``INLINE_DISPATCH_PATH``): ``time.sleep`` and timeout-less
+  ``.acquire()`` / ``.get()`` / ``.wait()`` / ``.join()`` stall every
+  stream on the connection. Bounded-slice waits (an explicit timeout)
+  pass; deliberate exceptions carry ``# tpr: allow(block)``.
 
 Suppression grammar: a line comment ``# tpr: allow(<rule>)`` disables that
 rule for its line. The hot-path modules are expected to carry NO ``copy``
@@ -46,6 +53,29 @@ HOT_COPY_MODULES = (
     os.path.join("tpurpc", "wire", "grpc_h2.py"),
     os.path.join("tpurpc", "jaxshim", "codec.py"),
 )
+
+#: module suffix -> qualified functions on its INLINE DISPATCH path (the
+#: reactor invocation from _ServerSink.commit: these run on the connection
+#: reader thread, where an unbounded block stalls every stream on the
+#: connection — ISSUE 3's no-block-in-dispatch rule). The `block` rule
+#: forbids time.sleep and timeout-less .acquire()/.get()/.wait()/.join()
+#: inside them; bounded-slice waits (an explicit timeout) pass, and a
+#: deliberate exception carries `# tpr: allow(block)`.
+INLINE_DISPATCH_PATH: Dict[str, Tuple[str, ...]] = {
+    os.path.join("tpurpc", "rpc", "server.py"): (
+        "_ServerSink.commit",
+        "_ServerStream.commit_message",
+        "_ServerStream._acquire_credit",
+        "_ServerStream._release_credit",
+        "_ServerStream.next_request",
+        "_ServerConnection._claim_inline",
+        "_ServerConnection._run_inline",
+        "_ServerConnection._run_handler",
+        "_ServerConnection._run_handler_inner",
+        "_ServerConnection._send_trailers",
+        "_ServerConnection._finish_stream",
+    ),
+}
 
 #: method names whose call on a guarded attribute counts as a mutation
 _MUTATORS = frozenset({
@@ -165,6 +195,60 @@ def _check_copy(tree: ast.AST, path: str,
             continue
         out.append(LintViolation(path, node.lineno, node.col_offset,
                                  "copy", viol))
+    return out
+
+
+# -- rule: block -------------------------------------------------------------
+
+def _block_violation(node: ast.Call) -> Optional[str]:
+    """Why this call is an unbounded block, or None."""
+    f = node.func
+    if not isinstance(f, ast.Attribute):
+        return None
+    kw = {k.arg for k in node.keywords}
+    if (f.attr == "sleep" and isinstance(f.value, ast.Name)
+            and f.value.id == "time"):
+        return "time.sleep() parks the reader thread"
+    if f.attr == "acquire" and not node.args and not (
+            kw & {"timeout", "blocking"}):
+        return ".acquire() with no timeout can park forever"
+    if f.attr == "get" and not node.args and "timeout" not in kw:
+        return ".get() with no timeout can park forever"
+    if f.attr == "wait" and not node.args and "timeout" not in kw:
+        return ".wait() with no timeout can park forever"
+    if f.attr == "join" and not node.args and "timeout" not in kw:
+        return ".join() with no timeout can park forever"
+    return None
+
+
+def _check_block(tree: ast.AST, path: str, lines: Sequence[str],
+                 functions: "frozenset[str]") -> List[LintViolation]:
+    """Forbid unbounded blocking calls inside the named functions (the
+    inline-dispatch path: they run on the connection reader thread)."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        parent = getattr(node, "_tpr_parent", None)
+        qual = (f"{parent.name}.{node.name}"
+                if isinstance(parent, ast.ClassDef) else node.name)
+        if qual not in functions:
+            continue
+        for call in ast.walk(node):
+            if not isinstance(call, ast.Call):
+                continue
+            why = _block_violation(call)
+            if why is None:
+                continue
+            if "block" in _allowed_rules(lines, call.lineno):
+                continue
+            out.append(LintViolation(
+                path, call.lineno, call.col_offset, "block",
+                f"{qual} is on the inline dispatch path (runs on the "
+                f"connection reader thread) and {why}: every stream on the "
+                "connection stalls behind it — bound the wait with a "
+                "timeout or move the work to the pool; a deliberate "
+                "exception carries '# tpr: allow(block)'"))
     return out
 
 
@@ -415,6 +499,10 @@ def lint_source(source: str, path: str,
             tuple(m.replace(os.sep, "/") for m in HOT_COPY_MODULES))
     if hot_copy:
         out.extend(_check_copy(tree, path, lines))
+    norm = path.replace("\\", "/")
+    for suffix, fns in INLINE_DISPATCH_PATH.items():
+        if norm.endswith(suffix.replace(os.sep, "/")):
+            out.extend(_check_block(tree, path, lines, frozenset(fns)))
     out.extend(_check_locks(tree, path, lines))
     out.extend(_check_lease(tree, path, lines))
     out.sort(key=lambda v: (v.path, v.line, v.col))

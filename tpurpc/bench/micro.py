@@ -165,7 +165,12 @@ def run_client(target: str, req_size: int = 64, streaming: bool = False,
     stop = threading.Event()
     channels = [rpc.insecure_channel(target) for _ in range(concurrency)]
     workers = []
-    for ch in channels:
+    #: per-worker verdict: ran until the stop signal without dying. A
+    #: worker that raised mid-run fell out of the offered load — the
+    #: ACHIEVED concurrency the result records is what the measurement
+    #: really exercised, not what --concurrency asked for.
+    worker_ok = [False] * concurrency
+    for i, ch in enumerate(channels):
         if rate is not None:
             fn = lambda c=ch: _open_loop_unary(c, stats, payload, stop,
                                                rate / concurrency)
@@ -173,7 +178,15 @@ def run_client(target: str, req_size: int = 64, streaming: bool = False,
             fn = lambda c=ch: _closed_loop_streaming(c, stats, payload, stop)
         else:
             fn = lambda c=ch: _closed_loop_unary(c, stats, payload, stop)
-        t = threading.Thread(target=fn, daemon=True)
+
+        def run(fn=fn, i=i):
+            try:
+                fn()
+            except BaseException:
+                return  # died mid-run: this slot's load stopped early
+            worker_ok[i] = stop.is_set()  # clean exit = lasted the run
+
+        t = threading.Thread(target=run, daemon=True)
         t.start()
         workers.append(t)
 
@@ -192,9 +205,13 @@ def run_client(target: str, req_size: int = 64, streaming: bool = False,
     stop.set()
     for ch in channels:
         try:
-            ch.close()
+            ch.close()  # unblocks workers parked mid-RPC
         except Exception:
             pass
+    for t in workers:
+        t.join(timeout=5)
+    achieved = sum(1 for i, t in enumerate(workers)
+                   if worker_ok[i] and not t.is_alive())
     total_dt = time.perf_counter() - t_start
     rpcs, nbytes = stats.take_interval()
     agg_rpcs += rpcs
@@ -204,6 +221,8 @@ def run_client(target: str, req_size: int = 64, streaming: bool = False,
           file=out)
     return {
         "rpcs": agg_rpcs, "duration_s": total_dt,
+        "concurrency_requested": concurrency,
+        "concurrency_achieved": achieved,
         "rate_rps": agg_rpcs / total_dt if total_dt else 0.0,
         "tx_mbps": agg_bytes * 8 / total_dt / 1e6 if total_dt else 0.0,
         "rtt_us": {"mean": h.mean_ns / 1e3, "p50": h.percentile(50) / 1e3,

@@ -128,13 +128,19 @@ def run_scenario(worker_targets: List[str], req_size: int = 64,
 
         # merge: aggregate rate sums; RTT percentiles from merged histograms
         merged = LatencyHistogram()
-        agg = {"rate_rps": 0.0, "tx_mbps": 0.0, "rpcs": 0}
+        agg = {"rate_rps": 0.0, "tx_mbps": 0.0, "rpcs": 0,
+               "concurrency_requested": 0, "concurrency_achieved": 0}
         for r in results:
             if r is None:
                 continue
             agg["rate_rps"] += r["rate_rps"]
             agg["tx_mbps"] += r["tx_mbps"]
             agg["rpcs"] += r["rpcs"]
+            # achieved vs requested load provenance: workers can fall
+            # behind --concurrency (die mid-run); the scenario records
+            # what actually ran so rates aren't misattributed
+            agg["concurrency_requested"] += r.get("concurrency_requested", 0)
+            agg["concurrency_achieved"] += r.get("concurrency_achieved", 0)
             merged.merge(LatencyHistogram.from_dict(r["histogram"]))
         agg["rtt_us"] = {"mean": merged.mean_ns / 1e3,
                          "p50": merged.percentile(50) / 1e3,

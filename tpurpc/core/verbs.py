@@ -224,6 +224,26 @@ class VerbsDomain(MemoryDomain):
         #: the region owner for accept_writer (the reverse RC leg)
         local_attrs = (qpn.value, lid.value, gid.raw, psn.value)
 
+        # Registered-source post path: real RC hardware only accepts a
+        # WRITE whose local SGE sits inside an ibv_reg_mr'd buffer carrying
+        # that MR's lkey — posting from arbitrary user memory is a local
+        # protection fault, not a slow path. Writes stage through a
+        # window-sized registered bounce MR and post with its real lkey.
+        # (The reference's SendZerocopy instead reg_mr's user buffers on
+        # the fly, pair.cc:793-941; a persistent bounce trades ONE staging
+        # copy per write for zero per-write registrations — registration
+        # is µs-scale and pins pages, the wrong trade for a window written
+        # repeatedly.) Staging is offset-mapped (window offset == bounce
+        # offset), so concurrent writes to disjoint spans don't collide.
+        bounce = lib.tpr_verbs_reg(self._ctx, None, nbytes)
+        if not bounce:
+            lib.tpr_verbs_qp_destroy(qp)
+            raise MemoryError("verbs open_window: bounce ibv_reg_mr failed")
+        bounce_lkey = lib.tpr_verbs_mr_lkey(bounce)
+        bounce_addr = lib.tpr_verbs_mr_addr(bounce)
+        staging = memoryview((ctypes.c_uint8 * nbytes).from_address(
+            bounce_addr)).cast("B")
+
         def write(offset: int, data) -> None:
             view = memoryview(data).cast("B")
             n = len(view)
@@ -232,18 +252,15 @@ class VerbsDomain(MemoryDomain):
             if offset < 0 or offset + n > nbytes:
                 raise IndexError(f"write [{offset}, {offset + n}) outside "
                                  f"window of {nbytes}")
-            src = (ctypes.c_uint8 * n).from_buffer_copy(view)
-            # staging copy into a registered bounce buffer would go here on
-            # real hardware (or reg_mr the source); the mock accepts any
-            # local address. lkey 0 is the mock's wildcard — the real-NIC
-            # path must post from a registered source (skeleton TODO,
-            # documented: SendZerocopy registers user buffers on the fly,
-            # pair.cc:793-941).
-            if self._lib.tpr_verbs_write(qp, src, 0, base + offset, rkey,
-                                         n) != 0:
+            staging[offset:offset + n] = view  # the one staging copy
+            if self._lib.tpr_verbs_write(
+                    qp, ctypes.c_void_p(bounce_addr + offset), bounce_lkey,
+                    base + offset, rkey, n) != 0:
                 raise OSError("RDMA WRITE failed")
 
         def close() -> None:
+            staging.release()  # drop the alias before the MR goes away
+            lib.tpr_verbs_dereg(bounce)
             lib.tpr_verbs_qp_destroy(qp)
 
         w = VerbsWindow(write, close)

@@ -48,6 +48,10 @@ class _ClientStream:
         self.assembly = fr.Assembly()
         self.done = False  # trailers or failure delivered
         self.refused = False  # RST|FLAG_REFUSED: admission refusal, replayable
+        #: pipelined-call completion hook: invoked (on the delivering thread)
+        #: AFTER the terminal event is queued — PipelinedUnary resolves its
+        #: future here instead of parking a thread on the event queue
+        self.on_terminal: Optional[Callable[[], None]] = None
         #: backpressure: bounded count of completed-but-unconsumed response
         #: messages (see _ServerStream._credits for the full rationale);
         #: trailers/failure events bypass — they must never deadlock
@@ -104,10 +108,20 @@ class _ClientStream:
     def deliver_trailers(self, code: StatusCode, details: str, md) -> None:
         self.done = True
         self.events.put(("trailers", code, details, md))
+        self._fire_terminal()
 
     def deliver_failure(self, code: StatusCode, details: str) -> None:
         self.done = True
         self.events.put(("trailers", code, details, []))
+        self._fire_terminal()
+
+    def _fire_terminal(self) -> None:
+        cb = self.on_terminal
+        if cb is not None:
+            try:
+                cb()
+            except Exception:  # a completion hook bug must not kill the
+                pass           # reader thread (every stream rides it)
 
 
 class _ChannelSink(fr.MessageSink):
@@ -1755,6 +1769,188 @@ class UnaryUnary(_MultiCallable):
         threading.Thread(target=run, daemon=True,
                          name="tpurpc-unary-future").start()
         return fut
+
+    def pipeline(self, depth: int = 16) -> "PipelinedUnary":
+        """A bounded-window pipelined caller for this method: many unary
+        calls in flight on ONE connection, demuxed by stream id — no
+        thread per call (contrast :meth:`future`, which spawns one)."""
+        return PipelinedUnary(self, depth=depth)
+
+
+class PipelinedUnary:
+    """Multi-in-flight unary calls over one connection (the serving
+    pipeline's client half, ISSUE 3).
+
+    ``call_async`` sends the fused HEADERS+MESSAGE immediately and returns
+    a ``concurrent.futures.Future``; the connection's reader (or inline
+    pump) thread demuxes completions by stream id and resolves each future
+    in place, so N in-flight calls cost N streams — not N parked threads.
+    The bounded window (``depth``) backpressures callers: the depth+1'th
+    ``call_async`` blocks until a completion frees a slot, which is what
+    keeps a fast client from ballooning server-side queues.
+
+    Completion (including response deserialization) runs on the delivering
+    thread — keep deserializers cheap (the tensor codec's zero-copy decode
+    qualifies). Out-of-order completion across streams is the point: a
+    slow call does not head-of-line-block its siblings' futures.
+    """
+
+    def __init__(self, mc: "UnaryUnary", depth: int = 16):
+        import concurrent.futures
+
+        self._Future = concurrent.futures.Future
+        self._mc = mc
+        self.depth = max(1, int(depth))
+        self._window = threading.BoundedSemaphore(self.depth)
+        self._lock = make_lock("PipelinedUnary._lock")
+        self._inflight = 0
+        self._closed = False
+        self._pump_threads: dict = {}  # conn id -> Thread (pump-mode only)
+
+    def call_async(self, request, timeout: Optional[float] = None,
+                   metadata: Optional[Metadata] = None):
+        """One pipelined call; returns a Future of the deserialized
+        response. Blocks only for a window slot (backpressure), never for
+        the response."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        if not self._window.acquire(
+                timeout=None if timeout is None else timeout):
+            raise RpcError(StatusCode.DEADLINE_EXCEEDED,
+                           "deadline exceeded waiting for pipeline window")
+        fut = self._Future()
+        try:
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+            conn, st, call = self._mc._start(metadata, remaining,
+                                             first_request=request)
+        except BaseException:
+            self._window.release()
+            raise
+        state = {"claimed": False}
+
+        def claim() -> bool:
+            with self._lock:
+                if state["claimed"]:
+                    return False
+                state["claimed"] = True
+                self._inflight -= 1
+            self._window.release()
+            return True
+
+        def complete():
+            if not claim():
+                return
+            timer = state.get("timer")
+            if timer is not None:
+                timer.cancel()
+            msgs = []
+            code, details, md = None, "", []
+            while True:
+                try:
+                    ev = st.events.get_nowait()
+                except queue.Empty:
+                    break
+                if ev[0] == "message":
+                    st.release_credit()
+                    msgs.append(ev[1])
+                elif ev[0] == "trailers":
+                    _, code, details, md = ev
+            if code is None:  # terminal hook without a queued trailer event
+                code, details = StatusCode.INTERNAL, "terminal without status"
+            call._finish(code, details, md)
+            if not fut.set_running_or_notify_cancel():
+                return  # caller cancelled the future; drop the result
+            if code is not StatusCode.OK:
+                exc = RpcError(code, details, md)
+                if getattr(st, "refused", False):
+                    exc._tpurpc_refused = True
+                fut.set_exception(exc)
+            elif len(msgs) != 1:
+                fut.set_exception(RpcError(
+                    StatusCode.INTERNAL,
+                    "unary call received no response" if not msgs
+                    else "unary call received multiple responses"))
+            else:
+                try:
+                    fut.set_result(_deserialize(self._mc._deser, msgs[0]))
+                except BaseException as exc:  # a raising deserializer must
+                    fut.set_exception(exc)    # fail the future, never hang it
+        with self._lock:
+            self._inflight += 1
+        if deadline is not None:
+            # No thread waits on this call, so the deadline needs its own
+            # watchdog: expire RSTs the stream (endpoint write — off the
+            # wheel thread) and fails the future.
+            from tpurpc.utils.timers import run_blocking, schedule
+
+            def expire():
+                if not claim():
+                    return
+                call._expire()
+                if fut.set_running_or_notify_cancel():
+                    fut.set_exception(RpcError(
+                        StatusCode.DEADLINE_EXCEEDED,
+                        "deadline exceeded awaiting pipelined response"))
+
+            state["timer"] = schedule(
+                max(0.0, deadline - time.monotonic()),
+                lambda: run_blocking(expire))
+        # Hook AFTER the send: the terminal may already have been delivered
+        # (fast server + slow caller), in which case st.done is set and the
+        # hook will never fire — complete from here instead. Both sides
+        # funnel through claim(), so exactly one completion runs.
+        st.on_terminal = complete
+        if st.done:
+            complete()
+        self._ensure_pump(conn)
+        return fut
+
+    # -- pump-mode servicing --------------------------------------------------
+
+    def _ensure_pump(self, conn: _Connection) -> None:
+        """Pump-mode connections have no reader thread: with every caller
+        detached (futures, nobody blocking in _pump_wait), the transport
+        would never be drained. One servicing thread per live connection
+        pumps while this pipeline has calls in flight."""
+        if not conn._pump_mode:
+            return
+        key = id(conn)
+        with self._lock:
+            t = self._pump_threads.get(key)
+            if t is not None and t.is_alive():
+                return
+            t = threading.Thread(target=self._pump_loop, args=(conn, key),
+                                 daemon=True, name="tpurpc-pipeline-pump")
+            self._pump_threads[key] = t
+        t.start()
+
+    def _pump_loop(self, conn: _Connection, key: int) -> None:
+        try:
+            while True:
+                conn._pump_wait(
+                    lambda: self._idle() or not conn.alive, None)
+                with self._lock:
+                    if self._idle() or not conn.alive:
+                        self._pump_threads.pop(key, None)
+                        return
+        except Exception:
+            with self._lock:
+                self._pump_threads.pop(key, None)
+
+    def _idle(self) -> bool:
+        return self._inflight == 0 or self._closed
+
+    def close(self) -> None:
+        """Stop servicing. Outstanding futures still resolve off the
+        reader thread; pump-mode servicing threads wind down."""
+        with self._lock:
+            self._closed = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
 
 class _RetryingStreamCall:

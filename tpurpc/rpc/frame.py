@@ -255,13 +255,39 @@ class FrameWriter:
     The single lock is the moral equivalent of chttp2's write-combiner
     (``chttp2_transport.cc:997`` write_action): one writer at a time, gather slices,
     large messages fragmented so no stream can monopolize the pipe.
+
+    With ``coalesce=True`` (the server's response path, ISSUE 3),
+    ``send_many`` becomes a cross-stream write combiner: responses
+    completing close together on one connection flush as ONE gathered
+    writev — one transport write/notify for N streams' responses instead
+    of N. The flush window is self-clocking: while one thread's writev is
+    in flight, later responses queue and the flusher drains them in its
+    next writev, so an idle connection pays zero added latency (no timer)
+    and a busy one amortizes wakeups. ``max_coalesce_bytes`` caps a single
+    gathered writev; the remainder flushes in the next one. Plain
+    ``send`` and the fragmenting path stay direct — per-stream frame order
+    is preserved because a unary stream's fused response is its only
+    coalesced write.
     """
 
-    def __init__(self, endpoint: Endpoint):
+    #: cap on one coalesced writev (gather-list growth bound); responses
+    #: past it flush in the flusher's next writev
+    MAX_COALESCE_BYTES = 256 << 10
+
+    def __init__(self, endpoint: Endpoint, coalesce: bool = False,
+                 max_coalesce_bytes: Optional[int] = None):
         import threading
 
         self._ep = endpoint
         self._lock = threading.Lock()
+        self._coalesce = coalesce
+        self._max_coalesce = max_coalesce_bytes or self.MAX_COALESCE_BYTES
+        self._pend_lock = threading.Lock()
+        #: queued coalescable writes: (nbytes, [segs]) — appended when a
+        #: flush is in flight; drained by the flusher (FIFO, so one
+        #: stream's queued writes can never reorder)
+        self._pending: List = []
+        self._flushing = False
 
     def send(self, ftype: int, flags: int, stream_id: int,
              payload: "bytes | Sequence" = b"") -> None:
@@ -330,8 +356,14 @@ class FrameWriter:
         notify/wakeup instead of one per frame — the unary fast path sends
         HEADERS+MESSAGE / MESSAGE+TRAILERS fused). Frames whose payload
         exceeds MAX_FRAME_PAYLOAD fall back to the fragmenting path in order.
+        On a ``coalesce=True`` writer, non-fragmented calls additionally
+        combine ACROSS threads (see the class docstring).
         """
-        batch: List[memoryview] = []
+        # Encode first: oversized-control-frame failures must surface
+        # before any byte is written or queued (an aborted half-written
+        # batch would corrupt the coalescing queue's FIFO contract).
+        encoded: List[Tuple[int, int, int, List[memoryview], int]] = []
+        fragment = False
         for ftype, flags, stream_id, payload in frames:
             segs = ([memoryview(s).cast("B") for s in payload]
                     if isinstance(payload, (list, tuple)) else
@@ -343,22 +375,81 @@ class FrameWriter:
                 if not did:  # incompressible: send as-is, clear the bit
                     flags &= ~FLAG_COMPRESSED
             if total > MAX_FRAME_PAYLOAD:
-                if batch:
-                    with self._lock:
-                        self._ep.write(batch)
-                    batch = []
                 if ftype != MESSAGE:
                     raise FrameError(
                         f"control frame payload {total} exceeds "
                         f"{MAX_FRAME_PAYLOAD}; metadata too large")
-                self._send_fragmented(flags, stream_id, segs, total)
-                continue
+                fragment = True
+            encoded.append((ftype, flags, stream_id, segs, total))
+        if fragment:
+            # Fragmenting calls stay on the direct path whole (their
+            # per-stream order must not straddle the pending queue).
+            batch: List[memoryview] = []
+            for ftype, flags, stream_id, segs, total in encoded:
+                if total > MAX_FRAME_PAYLOAD:
+                    if batch:
+                        with self._lock:
+                            self._ep.write(batch)
+                        batch = []
+                    self._send_fragmented(flags, stream_id, segs, total)
+                    continue
+                batch.append(memoryview(
+                    HEADER_FMT.pack(ftype, flags, stream_id, total)))
+                batch.extend(segs)
+            if batch:
+                with self._lock:
+                    self._ep.write(batch)
+            return
+        batch = []
+        nbytes = 0
+        for ftype, flags, stream_id, segs, total in encoded:
             batch.append(memoryview(
                 HEADER_FMT.pack(ftype, flags, stream_id, total)))
             batch.extend(segs)
-        if batch:
+            nbytes += HEADER_FMT.size + total
+        if not batch:
+            return
+        if not self._coalesce:
             with self._lock:
                 self._ep.write(batch)
+            return
+        with self._pend_lock:
+            self._pending.append((nbytes, batch))
+            if self._flushing:
+                return  # the in-flight flusher writes it: zero extra wakeups
+            self._flushing = True
+        self._flush_pending()
+
+    def _flush_pending(self) -> None:
+        """Drain the coalescing queue, one capped gathered writev at a
+        time, until it is empty (then hand back the flusher role). A write
+        failure drops the queue — the connection is dying and every server
+        response path treats sends as best-effort."""
+        from tpurpc.utils import stats as _stats
+
+        while True:
+            with self._pend_lock:
+                if not self._pending:
+                    self._flushing = False
+                    return
+                take: List[memoryview] = []
+                nresp = size = 0
+                while self._pending and (
+                        not take
+                        or size + self._pending[0][0] <= self._max_coalesce):
+                    nb, segs = self._pending.pop(0)
+                    take.extend(segs)
+                    size += nb
+                    nresp += 1
+            try:
+                with self._lock:
+                    self._ep.write(take)
+            except BaseException:
+                with self._pend_lock:
+                    self._pending.clear()
+                    self._flushing = False
+                raise
+            _stats.batch_hist("resp_coalesce").record(nresp)
 
     def send_preface(self) -> None:
         with self._lock:

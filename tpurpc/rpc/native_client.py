@@ -432,15 +432,70 @@ class _CqDriver:
         return False
 
 
+class _InlineWindow:
+    """Bounded multi-in-flight window for INLINE-READ channels, where the
+    CQ async surface refuses (``tpr_unary_call_cq`` needs the reader
+    thread). ``depth`` persistent daemon workers issue the blocking C calls
+    — the native loop multiplexes concurrent streams on one connection
+    (each blocking caller pumps or parks on the channel's cv), so this is
+    genuine wire pipelining, not thread-per-call churn: the worker set is
+    fixed and the depth+1'th submit blocks (window backpressure)."""
+
+    def __init__(self, depth: int):
+        import concurrent.futures
+        import queue as _queue
+
+        self._Future = concurrent.futures.Future
+        self._jobs: "_queue.Queue" = _queue.Queue()
+        self._depth = max(1, depth)
+        self._window = threading.BoundedSemaphore(self._depth)
+        self._workers = [
+            threading.Thread(target=self._run, daemon=True,
+                             name=f"tpurpc-native-inline-{i}")
+            for i in range(self._depth)]
+        for w in self._workers:
+            w.start()
+
+    def submit(self, call_fn, request, timeout):
+        self._window.acquire()  # backpressure: at most depth in flight
+        fut = self._Future()
+        self._jobs.put((call_fn, request, timeout, fut))
+        return fut
+
+    def _run(self) -> None:
+        while True:
+            job = self._jobs.get()
+            if job is None:
+                return
+            call_fn, request, timeout, fut = job
+            try:
+                if not fut.set_running_or_notify_cancel():
+                    continue
+                try:
+                    fut.set_result(call_fn(request, timeout))
+                except BaseException as exc:
+                    fut.set_exception(exc)
+            finally:
+                self._window.release()
+
+    def close(self) -> None:
+        for _ in self._workers:
+            self._jobs.put(None)
+
+
 class NativeChannel:
     """ctypes channel over the native client loop (see module docstring)."""
 
     def __init__(self, host: str, port: int, connect_timeout: float = 10.0,
-                 inline_read: bool = False):
+                 inline_read: bool = False, pipeline_depth: int = 16):
         self._lib = _load()
         self._cq_driver: Optional[_CqDriver] = None
         self._cq_lock = threading.Lock()
         self._cq_cond = threading.Condition(self._cq_lock)
+        #: in-flight bound for .future() calls on inline-read channels
+        #: (reader-thread channels bound in the C CQ instead)
+        self._pipeline_depth = max(1, pipeline_depth)
+        self._inline_window: Optional[_InlineWindow] = None
         #: native entries currently holding the raw channel pointer inside
         #: libtpurpc (blocking unary calls, pings, live NativeCall handles).
         #: close() must not tpr_channel_destroy until this drains — a call
@@ -479,6 +534,16 @@ class NativeChannel:
             if self._cq_driver is None:
                 self._cq_driver = _CqDriver(self._lib)
             return self._cq_driver
+
+    def _window(self) -> _InlineWindow:
+        with self._cq_lock:
+            if not self._ch:
+                exc = RpcError(StatusCode.UNAVAILABLE, "channel closed")
+                exc._tpurpc_preexec = True
+                raise exc
+            if self._inline_window is None:
+                self._inline_window = _InlineWindow(self._pipeline_depth)
+            return self._inline_window
 
     def _op_begin(self):
         """Claim the channel pointer for a native entry. The claim (not a
@@ -576,7 +641,13 @@ class NativeChannel:
             """grpcio's ``.future()`` shape: returns a concurrent.futures
             .Future resolving to the response (or raising RpcError), with
             the call pipelined through the channel's completion queue —
-            many can be in flight at once on one connection."""
+            many can be in flight at once on one connection. On
+            INLINE-READ channels (no reader thread, so no CQ) the same
+            multi-in-flight contract rides a bounded worker window over
+            the blocking entry: the C loop multiplexes the concurrent
+            streams on the one connection either way."""
+            if self.inline_read:
+                return self._window().submit(call, request, timeout)
             raw = (request_serializer(request) if request_serializer
                    else request)
             drv = self._driver()
@@ -653,6 +724,7 @@ class NativeChannel:
         with self._cq_cond:
             ch, self._ch = self._ch, None
             drv, self._cq_driver = self._cq_driver, None
+            win, self._inline_window = self._inline_window, None
             # Wait out native entries still holding the raw pointer
             # (blocking unary calls / pings / live NativeCall handles on
             # other threads): destroying under them is the ASan-caught
@@ -665,6 +737,8 @@ class NativeChannel:
                     break
                 self._cq_cond.wait(remaining)
             ops_drained = self._ops == 0
+        if win is not None:
+            win.close()  # idle workers exit; busy ones were waited out above
         if ch:
             # CQ teardown first: destroying a call touches its channel, so
             # every future's call must be destroyed before the channel is.

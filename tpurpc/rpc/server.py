@@ -380,7 +380,16 @@ class _ServerSink(fr.MessageSink):
                     # (server.h), opt-in per handler; a blocking handler
                     # stalls this connection.
                     handler, ctx, path = ic
-                    self._conn._run_handler(handler, st, ctx, path)
+                    self._conn._run_inline(handler, st, ctx, path)
+
+
+#: reentrancy guard for the inline dispatch path: set while a thread is
+#: inside an inline handler. An inline handler that (transitively) completes
+#: ANOTHER request on the same thread — inproc passthru endpoints and
+#: loopback self-calls can do this synchronously — must not nest dispatches:
+#: unbounded recursion, and a second handler's blocking would be invisible
+#: to the first connection. Nested inline work reroutes to the pool.
+_inline_tls = threading.local()
 
 
 class _ServerConnection:
@@ -388,7 +397,10 @@ class _ServerConnection:
                  preface_consumed: bool = False):
         self.server = server
         self.endpoint = endpoint
-        self.writer = fr.FrameWriter(endpoint)
+        # coalesce=True: unary responses completing close together on this
+        # connection (any mix of pool and inline handlers) flush as one
+        # gathered writev — one client-side wakeup for N streams (ISSUE 3)
+        self.writer = fr.FrameWriter(endpoint, coalesce=True)
         self.reader = fr.FrameReader(endpoint,
                                      expect_preface=not preface_consumed)
         self.reader.sink = _ServerSink(self)
@@ -626,6 +638,27 @@ class _ServerConnection:
             st.inline_timer.cancel()
             st.inline_timer = None
         return ic
+
+    def _run_inline(self, handler: RpcMethodHandler, st: _ServerStream,
+                    ctx: ServerContext, path: str) -> None:
+        """Inline dispatch with the reentrancy guard: first level runs on
+        the calling (reader) thread; a nested inline completion reroutes
+        to the pool (see _inline_tls)."""
+        if getattr(_inline_tls, "active", False):
+            try:
+                self.server._pool.submit(self._run_handler, handler, st,
+                                         ctx, path)
+            except RuntimeError:  # pool shut down: server is stopping
+                self._send_trailers(st, StatusCode.UNAVAILABLE,
+                                    "server shutting down")
+                self._finish_stream(st)
+                self.close()
+            return
+        _inline_tls.active = True
+        try:
+            self._run_handler(handler, st, ctx, path)
+        finally:
+            _inline_tls.active = False
 
     def _inline_deadline(self, st: _ServerStream) -> None:
         if self._claim_inline(st) is not None:
@@ -1132,6 +1165,17 @@ class Server:
 
     def wait_for_termination(self, timeout: Optional[float] = None) -> bool:
         return self._stopped.wait(timeout)
+
+    def inflight_requests(self) -> int:
+        """Number of currently open inbound streams across connections —
+        requests admitted (HEADERS seen) whose response hasn't finished.
+        The FanInBatcher's depth-aware flush probe (serve_jax wiring): when
+        its queue holds this many, no further arrival can happen until
+        responses go out, so it flushes instead of waiting out max_delay_s.
+        A snapshot, not a fence — callers must tolerate staleness."""
+        with self._lock:
+            conns = list(self._connections)
+        return sum(len(getattr(c, "_streams", ())) for c in conns)
 
 
 def server(thread_pool=None, handlers=None, interceptors=None, options=None,
