@@ -219,6 +219,63 @@ def test_wallclock_flagged_and_suppressable():
 
 
 # ---------------------------------------------------------------------------
+# lint: guarded logging on hot-path modules (ISSUE 4)
+# ---------------------------------------------------------------------------
+
+LOG_SRC = '''
+from tpurpc.utils.trace import log_debug, log_info, log_error, trace_ring
+
+def hot(msg):
+    log_debug("got %r", msg)            # unguarded: formatting always runs
+    log_info("state %s", msg)           # unguarded
+    log_error("broken: %s", msg)        # error paths are cold: exempt
+    if trace_ring:
+        log_debug("guarded %r", msg)    # behind the flag: fine
+    if trace_ring.enabled:
+        log_info("also guarded %s", msg)
+    trace_ring.log("flag-local %r", msg)  # TraceFlag.log checks enabled
+'''
+
+
+def test_log_rule_flags_unguarded_hot_logging():
+    vs = lint_source(LOG_SRC, "tpurpc/core/ring.py")
+    assert _rules(vs) == ["log"]
+    assert len(vs) == 2  # the two unguarded log_debug/log_info calls
+    assert {v.line for v in vs} == {5, 6}
+
+
+def test_log_rule_scoped_to_hot_modules():
+    # the same source off the hot-path module set is fine
+    assert lint_source(LOG_SRC, "tpurpc/rpc/server.py") == []
+    assert lint_source(LOG_SRC, "fixture.py") == []
+    # ...and every declared hot module enforces it
+    for mod in ("tpurpc/core/pair.py", "tpurpc/core/poller.py",
+                "tpurpc/wire/grpc_h2.py"):
+        assert _rules(lint_source(LOG_SRC, mod)) == ["log"]
+
+
+def test_log_rule_suppression_comment():
+    ok = LOG_SRC.replace('log_debug("got %r", msg)',
+                         'log_debug("got %r", msg)  # tpr: allow(log)')
+    ok = ok.replace('log_info("state %s", msg)',
+                    'log_info("state %s", msg)  # tpr: allow(log)')
+    assert lint_source(ok, "tpurpc/core/ring.py") == []
+
+
+def test_log_rule_hot_modules_are_clean():
+    import tpurpc.core.pair
+    import tpurpc.core.poller
+    import tpurpc.core.ring
+    import tpurpc.wire.grpc_h2
+
+    for mod in (tpurpc.core.ring, tpurpc.core.pair, tpurpc.core.poller,
+                tpurpc.wire.grpc_h2):
+        with open(mod.__file__, "r", encoding="utf-8") as f:
+            vs = lint_source(f.read(), mod.__file__)
+        assert [v for v in vs if v.rule == "log"] == []
+
+
+# ---------------------------------------------------------------------------
 # lint: no blocking calls on the inline dispatch path (ISSUE 3)
 # ---------------------------------------------------------------------------
 

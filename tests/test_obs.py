@@ -1,0 +1,464 @@
+"""tpurpc-scope (ISSUE 4): metrics registry, span timelines, trace-context
+propagation on both planes, the scrape endpoint, and the trace-env grammar.
+
+The acceptance test is :func:`test_depth4_pipeline_trace_python_plane`: a
+depth-4 pipelined TensorClient request against serve_jax produces a single
+trace_id whose exported span tree shows client-send, wire, batch-wait,
+infer, and respond spans in order, while the Prometheus endpoint on the
+SAME serving port exposes ring/batcher/pipeline series that channelz
+mirrors.
+"""
+
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tpurpc.obs import metrics, tracing
+from tpurpc.utils import stats, trace
+
+NATIVE_LIB = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "native", "build", "libtpurpc.so")
+
+
+@pytest.fixture
+def forced_tracing():
+    tracing.reset()
+    tracing.force(True)
+    yield
+    tracing.force(None)
+    tracing.reset()
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+def test_counter_gauge_basics():
+    reg = metrics.Registry()
+    c = reg.counter("c")
+    c.inc()
+    c.inc(4)
+    assert c.snapshot() == 5
+    g = reg.gauge("g")
+    g.set(3.5)
+    g.inc()
+    g.dec(0.5)
+    assert g.snapshot() == 4.0
+    assert reg.counter("c") is c  # same name, same object
+    with pytest.raises(TypeError):
+        reg.gauge("c")  # kind conflict is an error, not a shadow
+
+
+def test_size_histogram_exact_percentiles():
+    reg = metrics.Registry()
+    h = reg.histogram("h")
+    for v in (1, 1, 1, 2, 8):
+        h.record(v)
+    s = h.snapshot()
+    assert s["count"] == 5 and s["p50"] == 1 and s["max"] == 8
+    assert s["p99"] == 8
+
+
+def test_latency_histogram_interpolates_within_bucket():
+    reg = metrics.Registry()
+    h = reg.histogram("lat", kind="latency")
+    for v in range(1000, 2000):
+        h.record(v)
+    # uniform [1000, 2000): true p50 ~1500. The log2 bucket holding it is
+    # [1024, 2048) — a bucket-upper-bound answer would say 2048.
+    assert 1300 <= h.percentile(0.5) <= 1700
+    assert h.percentile(0.99) <= 2000  # clamped to the observed max
+
+
+def test_fleet_gauge_drops_dead_objects():
+    reg = metrics.Registry()
+
+    class Obj:
+        depth = 7
+
+    f = reg.fleet("live_depth", lambda o: o.depth)
+    a, b = Obj(), Obj()
+    f.track(a)
+    f.track(b)
+    assert f.collect() == (14.0, 2)
+    del b
+    import gc
+
+    gc.collect()
+    assert f.collect() == (7.0, 1)
+
+
+def test_registry_reset_keeps_fleet_membership():
+    reg = metrics.Registry()
+    reg.counter("x").inc(9)
+
+    class Obj:
+        pass
+
+    f = reg.fleet("objs")
+    f.track(Obj.__call__ if False else Obj())  # noqa — tracked instance dies
+    o = Obj()
+    f.track(o)
+    reg.reset()
+    assert reg.counter("x").snapshot() == 0
+    assert f.collect()[1] >= 1  # membership survived the reset
+
+
+# ---------------------------------------------------------------------------
+# utils/stats façade folds into the registry (no parallel bookkeeping)
+# ---------------------------------------------------------------------------
+
+def test_stats_facade_is_registry_backed():
+    stats.counter_inc("obs_test_counter", 3)
+    assert metrics.counter("obs_test_counter").snapshot() >= 3
+    h = stats.batch_hist("obs_test_hist")
+    assert h is metrics.histogram("obs_test_hist")
+    h.record(4)
+    assert stats.batch_snapshot()["obs_test_hist"]["count"] >= 1
+    assert isinstance(h, stats.BatchHist)  # PR 1 alias still holds
+
+
+def test_copy_ledger_backed_by_registry():
+    before = metrics.counter("copyledger_host_copy").snapshot()
+    stats.ledger.add("host_copy", 64)
+    assert metrics.counter("copyledger_host_copy").snapshot() == before + 64
+    assert stats.ledger.host_copy == before + 64
+    with pytest.raises(ValueError):
+        stats.ledger.add("bogus", 1)
+
+
+def test_stats_hist_percentile_interpolated():
+    # the satellite fix: p50 of a known distribution must not snap to the
+    # power-of-two bucket upper bound (2048 for uniform [1000, 2000))
+    h = stats._Hist()
+    for v in range(1000, 2000):
+        h.record(v)
+    p50 = h.percentile(0.5)
+    assert 1300 <= p50 <= 1700, p50
+    assert h.percentile(0.99) <= 2000
+
+
+# ---------------------------------------------------------------------------
+# trace-env grammar (satellite): -name negation, all, list_tracers,
+# TPURPC_TRACE overriding GRPC_TRACE
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def clean_trace_env(monkeypatch):
+    for var in ("TPURPC_TRACE", "GRPC_TRACE"):
+        monkeypatch.delenv(var, raising=False)
+    yield monkeypatch
+    for var in ("TPURPC_TRACE", "GRPC_TRACE"):
+        monkeypatch.delenv(var, raising=False)
+    trace.reapply_env()
+
+
+def test_trace_all_with_negation(clean_trace_env):
+    clean_trace_env.setenv("TPURPC_TRACE", "all,-ring")
+    trace.reapply_env()
+    flags = trace.list_tracers()
+    assert flags["endpoint"] and flags["http2"] and not flags["ring"]
+
+
+def test_tpurpc_trace_overrides_grpc_trace(clean_trace_env):
+    clean_trace_env.setenv("GRPC_TRACE", "ring")
+    clean_trace_env.setenv("TPURPC_TRACE", "endpoint")
+    trace.reapply_env()
+    flags = trace.list_tracers()
+    assert flags["endpoint"] and not flags["ring"]
+    # GRPC_TRACE alone still works (reference debugging habits carry over)
+    clean_trace_env.delenv("TPURPC_TRACE")
+    trace.reapply_env()
+    flags = trace.list_tracers()
+    assert flags["ring"] and not flags["endpoint"]
+
+
+def test_list_tracers_token_prints_registry_once(clean_trace_env, capfd):
+    clean_trace_env.setenv("TPURPC_TRACE", "list_tracers,ring")
+    trace.reapply_env()
+    assert bool(trace.trace_ring)  # first USE flushes the listing
+    err = capfd.readouterr().err
+    assert "available tracers:" in err
+    assert "ring: on" in err and "endpoint: off" in err
+    bool(trace.trace_ring)  # one-shot: no second print
+    assert "available tracers:" not in capfd.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# tracing core
+# ---------------------------------------------------------------------------
+
+def test_context_encode_decode_roundtrip():
+    ctx = tracing.TraceContext(0xDEADBEEF12345678, 42, True)
+    got = tracing.TraceContext.decode(ctx.encode())
+    assert (got.trace_id, got.span_id, got.sampled) == (
+        ctx.trace_id, ctx.span_id, True)
+    off = tracing.TraceContext(1, 2, False)
+    assert not tracing.TraceContext.decode(off.encode()).sampled
+    assert tracing.TraceContext.decode("garbage") is None
+    assert tracing.TraceContext.decode(b"") is None
+
+
+def test_disabled_tracing_is_inert():
+    tracing.force(None)
+    tracing.configure(0.0)
+    assert not tracing.ACTIVE
+    assert tracing.maybe_sample() is None
+    assert tracing.current() is None
+    with tracing.span("nope") as sp:
+        assert sp is None
+
+
+def test_span_record_and_tree(forced_tracing):
+    ctx = tracing.maybe_sample()
+    with tracing.use(ctx):
+        with tracing.span("outer"):
+            tracing.record("manual", ctx, 123, 456, note="x")
+    flat = tracing.spans(ctx.trace_id)
+    assert {s["name"] for s in flat} == {"outer", "manual"}
+    tree = tracing.span_tree(f"{ctx.trace_id:016x}")
+    assert tree["trace_id"] == f"{ctx.trace_id:016x}"
+    assert {n["name"] for n in tree["spans"]} == {"outer", "manual"}
+    chrome = tracing.chrome_trace(ctx.trace_id)
+    assert len(chrome["traceEvents"]) == 2
+    ev = {e["name"]: e for e in chrome["traceEvents"]}
+    assert ev["manual"]["args"]["note"] == "x"
+    assert ev["manual"]["dur"] == 456 / 1e3
+
+
+# ---------------------------------------------------------------------------
+# the acceptance path: depth-4 pipelined tensor serving, Python plane
+# ---------------------------------------------------------------------------
+
+def test_depth4_pipeline_trace_python_plane(forced_tracing):
+    import jax
+
+    from tpurpc.jaxshim import TensorClient, serve_jax
+    from tpurpc.rpc.channel import Channel
+
+    srv, port, batcher = serve_jax(jax.jit(lambda t: {"y": t["x"] * 2}),
+                                   batching=True, max_batch=4,
+                                   max_delay_s=0.01)
+    try:
+        with Channel(f"127.0.0.1:{port}") as ch:
+            cli = TensorClient(ch, depth=4)
+            futs = [cli.call_async("Call",
+                                   {"x": np.full((1, 3), i, np.float32)},
+                                   timeout=60)
+                    for i in range(8)]
+            for i, f in enumerate(futs):
+                out = f.result(60)
+                assert np.asarray(out["y"]).ravel()[0] == 2 * i
+
+            # -- span timeline: one trace_id per request, 5 spans in order
+            by_trace = {}
+            for s in tracing.spans():
+                by_trace.setdefault(s["trace_id"], []).append(s)
+            complete = [tid for tid, ss in by_trace.items()
+                        if {"client-send", "wire", "batch-wait", "infer",
+                            "respond"} <= {s["name"] for s in ss}]
+            assert len(complete) >= 8, (
+                {tid: sorted({s['name'] for s in ss})
+                 for tid, ss in by_trace.items()})
+            ss = by_trace[complete[0]]
+            t0 = {s["name"]: s["t0_ns"] for s in ss}
+            assert (t0["client-send"] <= t0["wire"] <= t0["batch-wait"]
+                    <= t0["infer"] <= t0["respond"]), t0
+            # the tree export carries the same spans
+            tree = tracing.span_tree(complete[0])
+
+            def names(nodes):
+                out = set()
+                for n in nodes:
+                    out.add(n["name"])
+                    out |= names(n["children"])
+                return out
+
+            assert {"client-send", "wire", "batch-wait", "infer",
+                    "respond"} <= names(tree["spans"])
+
+            # -- the introspection plane on the SAME serving port
+            txt = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10
+            ).read().decode()
+            for series in ("tpurpc_fanin_batch_count",
+                           "tpurpc_batcher_rows",
+                           "tpurpc_pipeline_call_us_count",
+                           "tpurpc_ring_msgs_read",
+                           "tpurpc_srv_call_us_count",
+                           "tpurpc_channelz_calls"):
+                assert series in txt, f"{series} missing from scrape"
+
+            # -- channelz mirrors what the scrape says
+            from tpurpc.rpc import channelz
+
+            started = sum(
+                float(line.rsplit(" ", 1)[1])
+                for line in txt.splitlines()
+                if line.startswith("tpurpc_channelz_calls")
+                and 'entity="server"' in line and 'kind="started"' in line)
+            infos = [channelz.server_info(s)
+                     for _id, s in channelz.live_servers()]
+            assert sum(i.get("calls_started", 0) for i in infos) >= started
+            assert started >= 8
+    finally:
+        srv.stop(grace=0)
+        batcher.close()
+
+
+# ---------------------------------------------------------------------------
+# native plane: depth-4 propagation through tpr_call_start metadata
+# ---------------------------------------------------------------------------
+
+@pytest.mark.skipif(not os.path.exists(NATIVE_LIB),
+                    reason="native lib not built")
+def test_depth4_native_plane_trace_propagation(forced_tracing):
+    import tpurpc.rpc as rpc
+    from tpurpc.rpc.native_client import NativeChannel
+
+    def whoami(req, ctx):
+        cur = tracing.current()
+        return cur.encode().encode() if cur is not None else b"none"
+
+    srv = rpc.Server(max_workers=8)
+    srv.add_method("/obs/WhoAmI", rpc.unary_unary_rpc_method_handler(whoami))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    try:
+        with NativeChannel("127.0.0.1", port) as ch:
+            ctxs = [tracing.TraceContext(0x1000 + i, i + 1) for i in range(4)]
+            calls = [ch.start_call(
+                "/obs/WhoAmI", timeout=30,
+                metadata=[(tracing.HEADER, c.encode())]) for c in ctxs]
+            for nc in calls:  # depth-4: all four streams in flight at once
+                nc.write(b"hi")
+                nc.writes_done()
+            for nc, ctx in zip(calls, ctxs):
+                body = nc.read()
+                assert body is not None
+                got = tracing.TraceContext.decode(bytes(body))
+                assert got is not None, bytes(body)
+                assert got.trace_id == ctx.trace_id, (
+                    f"{got.trace_id:x} != {ctx.trace_id:x}")
+                assert nc.read() is None
+                code, _ = nc.finish()
+                nc.close()
+                assert code is rpc.StatusCode.OK
+            # the server-side spans carry the propagated trace ids — via
+            # the native trampoline's "handler" span when the connection
+            # was adopted onto the C plane, or the Python plane's
+            # "dispatch"/"respond" spans otherwise; propagation must hold
+            # either way (the body echo above already proved current()).
+            srv_traces = {s["trace_id"] for s in tracing.spans()
+                          if s["name"] in ("handler", "dispatch", "respond")}
+            assert {f"{c.trace_id:016x}" for c in ctxs} <= srv_traces
+    finally:
+        srv.stop(grace=0)
+
+
+@pytest.mark.skipif(not os.path.exists(NATIVE_LIB),
+                    reason="native lib not built")
+def test_native_dataplane_trace_extraction(forced_tracing, monkeypatch):
+    """Ring platform: the server ADOPTS the connection onto the C plane, so
+    the trace context must survive tpr_call_start → tpr_srv_metadata_get →
+    the default trampoline's ambient install ("handler" span)."""
+    import tpurpc.rpc as rpc
+    from tpurpc.rpc.native_client import NativeChannel
+    from tpurpc.rpc.native_server import adoption_eligible
+    from tpurpc.utils import config as config_mod
+
+    monkeypatch.setenv("GRPC_PLATFORM_TYPE", "RDMA_BPEV")
+    config_mod.set_config(None)
+    try:
+        def whoami(req, ctx):
+            cur = tracing.current()
+            return cur.encode().encode() if cur is not None else b"none"
+
+        srv = rpc.Server(max_workers=4)
+        srv.add_method("/obs/WhoAmI",
+                       rpc.unary_unary_rpc_method_handler(whoami))
+        port = srv.add_insecure_port("127.0.0.1:0")
+        srv.start()
+        assert adoption_eligible(srv)
+        try:
+            with NativeChannel("127.0.0.1", port) as ch:
+                ctx = tracing.TraceContext(0xFACE, 7)
+                nc = ch.start_call("/obs/WhoAmI", timeout=30,
+                                   metadata=[(tracing.HEADER, ctx.encode())])
+                nc.write(b"q")
+                nc.writes_done()
+                body = nc.read()
+                got = tracing.TraceContext.decode(bytes(body))
+                assert got is not None and got.trace_id == ctx.trace_id
+                assert nc.read() is None
+                nc.finish()
+                nc.close()
+            assert f"{ctx.trace_id:016x}" in {
+                s["trace_id"] for s in tracing.spans()
+                if s["name"] == "handler"}
+        finally:
+            srv.stop(grace=0)
+    finally:
+        config_mod.set_config(None)
+
+
+# ---------------------------------------------------------------------------
+# scrape endpoint plumbing
+# ---------------------------------------------------------------------------
+
+def test_scrape_routes_on_serving_port():
+    import tpurpc.rpc as rpc
+
+    srv = rpc.Server(max_workers=2)
+    srv.add_method("/obs/Echo",
+                   rpc.unary_unary_rpc_method_handler(lambda r, c: bytes(r)))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{port}"
+        assert urllib.request.urlopen(
+            f"{base}/healthz", timeout=10).read() == b"ok\n"
+        hz = json.loads(urllib.request.urlopen(
+            f"{base}/channelz", timeout=10).read())
+        assert "servers" in hz and "channels" in hz
+        tr = json.loads(urllib.request.urlopen(
+            f"{base}/traces", timeout=10).read())
+        assert "traceEvents" in tr
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"{base}/nope", timeout=10)
+        assert exc.value.code == 404
+        # RPC traffic still works on the same port after the scrapes
+        from tpurpc.rpc.channel import Channel
+
+        with Channel(f"127.0.0.1:{port}") as ch:
+            assert ch.unary_unary("/obs/Echo")(b"x", timeout=10) == b"x"
+    finally:
+        srv.stop(grace=0)
+
+
+def test_standalone_http_server():
+    from tpurpc.obs import scrape
+
+    srv, port = scrape.start_http_server()
+    try:
+        txt = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+        assert "tpurpc_" in txt
+    finally:
+        srv.shutdown()
+
+
+def test_prometheus_render_parses():
+    from tpurpc.obs import scrape
+    from tpurpc.tools.top import parse_prometheus
+
+    metrics.counter("render_probe").inc(3)
+    metrics.histogram("render_hist").record(5)
+    parsed = parse_prometheus(scrape.render_prometheus())
+    assert parsed[("tpurpc_render_probe", "")] == 3
+    assert parsed[("tpurpc_render_hist", 'quantile="0.5"')] == 5
+    assert parsed[("tpurpc_render_hist_count", "")] >= 1

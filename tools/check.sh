@@ -36,6 +36,15 @@ python -m tpurpc.analysis || fail=1
 note "serving pipeline smoke (depth=4, 32 reqs)"
 python -m tpurpc.tools.serving_smoke || fail=1
 
+# 2c) tpurpc-scope metrics smoke (ISSUE 4): start a server, scrape the
+#     SAME serving port over plain HTTP, assert the core series are
+#     present and monotonic across two scrapes, and that a forced-sampled
+#     call yields a unified span tree + chrome trace export. ~1s, no jax.
+#     (The new `log` lint rule runs inside `python -m tpurpc.analysis`
+#     above — hot-path log calls must sit behind a TraceFlag guard.)
+note "tpurpc-scope metrics smoke (scrape + spans)"
+python -m tpurpc.tools.obs_smoke || fail=1
+
 # 3) the analysis subsystem's own tests, plus a lock-order-instrumented run
 #    of the concurrency-heavy suites (TPURPC_DEBUG_LOCKS exercises the
 #    CheckedLock shim wired into poller/pair/xds/channel/channelz)

@@ -33,6 +33,13 @@ hand (ISSUE 2) and that no general-purpose linter knows about:
   ``.acquire()`` / ``.get()`` / ``.wait()`` / ``.join()`` stall every
   stream on the connection. Bounded-slice waits (an explicit timeout)
   pass; deliberate exceptions carry ``# tpr: allow(block)``.
+* ``log``      — hot-path modules (``core/ring.py``, ``core/pair.py``,
+  ``core/poller.py``, ``wire/grpc_h2.py``) may only call ``log_debug`` /
+  ``log_info`` behind a ``TraceFlag`` guard — ``flag.log(...)`` (which
+  tests ``enabled`` first) or ``if flag:`` / ``if flag.enabled:`` — so
+  %-formatting and string building never run on the fast path when
+  tracing is off. ``log_error`` is exempt (error paths are cold by
+  definition). Deliberate exceptions carry ``# tpr: allow(log)``.
 
 Suppression grammar: a line comment ``# tpr: allow(<rule>)`` disables that
 rule for its line. The hot-path modules are expected to carry NO ``copy``
@@ -52,6 +59,16 @@ HOT_COPY_MODULES = (
     os.path.join("tpurpc", "core", "pair.py"),
     os.path.join("tpurpc", "wire", "grpc_h2.py"),
     os.path.join("tpurpc", "jaxshim", "codec.py"),
+)
+
+#: repo-relative suffixes of the modules under the guarded-logging rule:
+#: the data plane's per-message/per-scan code, where an unguarded
+#: log_debug("%s", x) pays its string formatting even with tracing off
+HOT_LOG_MODULES = (
+    os.path.join("tpurpc", "core", "ring.py"),
+    os.path.join("tpurpc", "core", "pair.py"),
+    os.path.join("tpurpc", "core", "poller.py"),
+    os.path.join("tpurpc", "wire", "grpc_h2.py"),
 )
 
 #: module suffix -> qualified functions on its INLINE DISPATCH path (the
@@ -249,6 +266,55 @@ def _check_block(tree: ast.AST, path: str, lines: Sequence[str],
                 "connection stalls behind it — bound the wait with a "
                 "timeout or move the work to the pool; a deliberate "
                 "exception carries '# tpr: allow(block)'"))
+    return out
+
+
+# -- rule: log ---------------------------------------------------------------
+
+_HOT_LOG_CALLS = frozenset({"log_debug", "log_info"})
+
+
+def _is_flag_guard(test: ast.AST) -> bool:
+    """Does this ``if`` test reference a TraceFlag? Convention-based: a
+    name/attribute starting with ``trace_`` (every flag instance in the
+    tree), a bare ``flag``/``*_flag`` binding, or an ``.enabled`` read."""
+    for node in ast.walk(test):
+        if isinstance(node, ast.Name) and (
+                node.id.startswith("trace_") or node.id == "flag"
+                or node.id.endswith("_flag")):
+            return True
+        if isinstance(node, ast.Attribute) and (
+                node.attr.startswith("trace_") or node.attr == "enabled"
+                or node.attr.endswith("_flag")):
+            return True
+    return False
+
+
+def _check_log(tree: ast.AST, path: str,
+               lines: Sequence[str]) -> List[LintViolation]:
+    """Guarded logging on the hot paths: ``log_debug``/``log_info`` must
+    sit inside ``if <TraceFlag>:`` (or use ``flag.log(...)``, which never
+    matches here — ``.log`` is a method name, not these functions)."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = (f.id if isinstance(f, ast.Name)
+                else f.attr if isinstance(f, ast.Attribute) else "")
+        if name not in _HOT_LOG_CALLS:
+            continue
+        if any(isinstance(anc, ast.If) and _is_flag_guard(anc.test)
+               for anc in _ancestors(node)):
+            continue
+        if "log" in _allowed_rules(lines, node.lineno):
+            continue
+        out.append(LintViolation(
+            path, node.lineno, node.col_offset, "log",
+            f"{name}() on a hot-path module without a TraceFlag guard: "
+            "its string formatting runs even with tracing off — use "
+            "flag.log(...) or wrap in 'if <trace_flag>:'; a deliberate "
+            "exception carries '# tpr: allow(log)'"))
     return out
 
 
@@ -482,9 +548,11 @@ def _check_lease_region(fn, reserves, commits, path) -> List[LintViolation]:
 # -- driver ------------------------------------------------------------------
 
 def lint_source(source: str, path: str,
-                hot_copy: Optional[bool] = None) -> List[LintViolation]:
-    """Lint one module's source. ``hot_copy`` forces/suppresses the no-copy
-    rules (default: decided by ``path`` suffix against HOT_COPY_MODULES)."""
+                hot_copy: Optional[bool] = None,
+                hot_log: Optional[bool] = None) -> List[LintViolation]:
+    """Lint one module's source. ``hot_copy``/``hot_log`` force/suppress
+    the no-copy and guarded-logging rules (default: decided by ``path``
+    suffix against HOT_COPY_MODULES / HOT_LOG_MODULES)."""
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError as exc:
@@ -499,6 +567,11 @@ def lint_source(source: str, path: str,
             tuple(m.replace(os.sep, "/") for m in HOT_COPY_MODULES))
     if hot_copy:
         out.extend(_check_copy(tree, path, lines))
+    if hot_log is None:
+        hot_log = path.replace("\\", "/").endswith(
+            tuple(m.replace(os.sep, "/") for m in HOT_LOG_MODULES))
+    if hot_log:
+        out.extend(_check_log(tree, path, lines))
     norm = path.replace("\\", "/")
     for suffix, fns in INLINE_DISPATCH_PATH.items():
         if norm.endswith(suffix.replace(os.sep, "/")):

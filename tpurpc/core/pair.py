@@ -44,10 +44,21 @@ import numpy as np
 
 from tpurpc.analysis.locks import make_lock
 from tpurpc.core import _native
+from tpurpc.obs import metrics as _metrics
+from tpurpc.obs import tracing as _tracing
 from tpurpc.tpu import ledger as ring_ledger
-from tpurpc.core.ring import RingCorruption, RingReader, RingWriter
+from tpurpc.core.ring import (RingCorruption, RingReader, RingWriter,
+                              _BYTES_OUT, _MSGS_OUT)
 from tpurpc.utils import stats as _stats
 from tpurpc.utils.config import get_config
+
+# tpurpc-scope fleet gauges (ISSUE 4): evaluated at scrape time over the
+# weakly-referenced live pairs — send-credit stalls and connection counts
+# become visible on a live process with zero hot-path cost.
+_PAIRS_CONNECTED = _metrics.fleet(
+    "pairs_connected", lambda p: 1.0 if p.state.name == "CONNECTED" else 0.0)
+_PAIRS_WRITE_STALLED = _metrics.fleet(
+    "pairs_write_stalled", lambda p: 1.0 if p.want_write else 0.0)
 from tpurpc.utils.trace import trace_ring
 
 _U64 = struct.Struct("<Q")
@@ -485,6 +496,8 @@ class Pair:
 
         # serializes notify-socket writes
         self._notify_lock = make_lock("Pair._notify_lock")
+        _PAIRS_CONNECTED.track(self)
+        _PAIRS_WRITE_STALLED.track(self)
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -915,6 +928,14 @@ class Pair:
         if self.state is not PairState.CONNECTED:
             raise BrokenPipeError(f"pair {self.tag} not sendable: {self.state}"
                                   + (f" ({self.error})" if self.error else ""))
+        if _tracing.ACTIVE and _tracing.current() is not None:
+            # traced call on this thread: the ring-encode interval is the
+            # "send-lease" span of the per-RPC timeline (SURVEY §7 #4)
+            with _tracing.span("send-lease"):
+                return self._send_traced(slices, byte_idx)
+        return self._send_traced(slices, byte_idx)
+
+    def _send_traced(self, slices: Sequence, byte_idx: int = 0) -> int:
         if _stats.profiling_on():
             with _stats.profile("pair_send"):
                 return self._send_profiled(slices, byte_idx)
@@ -1059,6 +1080,10 @@ class Pair:
                 writer.remote_head = rh.value
         if writer.seq > seq_before:  # ring messages this one C call encoded
             _stats.batch_hist("ring_write").record(writer.seq - seq_before)
+            # the fused C path bypasses RingWriter.writev, so the registry
+            # totals are bumped here (same counters, same meaning)
+            _MSGS_OUT.inc(writer.seq - seq_before)
+            _BYTES_OUT.inc(got)
         ring_ledger.host_copy(got)
         self.total_sent += got
         total_len = sum(len(v) for v in views)

@@ -22,9 +22,15 @@ from typing import Callable, Dict, List, Optional
 from tpurpc.analysis import locks as _dbglocks
 from tpurpc.analysis.locks import make_condition, make_lock
 from tpurpc.core.pair import Pair, PairState
+from tpurpc.obs import metrics as _metrics
 from tpurpc.utils import stats as _stats
 from tpurpc.utils.config import get_config
 from tpurpc.utils.trace import trace_ring
+
+#: scrape-time gauge: pairs registered with live pollers (the wake/spin/
+#: sleep counters themselves ride _stats.counter_inc → the obs registry)
+_POLLER_PAIRS = _metrics.fleet("poller_registered_pairs",
+                               lambda p: p._pair_count)
 
 #: Adaptive-spin state machine (BPEV recast with a per-pair activity EWMA
 #: instead of an unconditional busy window):
@@ -99,6 +105,7 @@ class Poller:
         self._threads: List[threading.Thread] = []
         self._running = False
         self._pair_count = 0
+        _POLLER_PAIRS.track(self)
 
     # -- registration --------------------------------------------------------
 

@@ -50,7 +50,21 @@ import struct
 from typing import Callable, List, Optional, Sequence, Tuple
 
 from tpurpc.core import _native
+from tpurpc.obs import metrics as _metrics
 from tpurpc.tpu import ledger
+
+# tpurpc-scope (ISSUE 4): hot counters are cached module-level objects —
+# one GIL-atomic int add per DRAIN/BATCH, no lookup, no lock. Ring state
+# (head/tail/credits) costs the hot path nothing: the fleet gauges read
+# the attributes the ring already maintains, at scrape time only.
+_MSGS_IN = _metrics.counter("ring_msgs_read")
+_BYTES_IN = _metrics.counter("ring_bytes_read")
+_MSGS_OUT = _metrics.counter("ring_msgs_written")
+_BYTES_OUT = _metrics.counter("ring_bytes_written")
+_READERS = _metrics.fleet("ring_credit_unpublished_bytes",
+                          lambda r: r.consumed_since_publish)
+_WRITERS = _metrics.fleet("ring_in_flight_bytes",
+                          lambda w: w.tail - w.remote_head)
 
 ALIGN = 8
 HEADER_BYTES = 8
@@ -146,6 +160,7 @@ class RingReader:
                     self.buf, writable=True)
             except (ValueError, TypeError):
                 self._nat = None
+        _READERS.track(self)
 
     # -- completion scanning ------------------------------------------------
 
@@ -254,6 +269,7 @@ class RingReader:
         if self._nat is not None and len(dst) > 0:
             return self._read_into_native(dst)
         total = 0
+        seq0 = self.seq
         while total < len(dst):
             if self._msg_len == 0:
                 ln = self._message_at(self.head, self.seq)
@@ -274,6 +290,8 @@ class RingReader:
                 self._msg_read = 0
                 self.seq += 1
         ledger.host_copy(total)
+        _MSGS_IN.inc(self.seq - seq0)
+        _BYTES_IN.inc(total)
         return total
 
     def _read_into_native(self, dst: memoryview) -> int:
@@ -283,6 +301,7 @@ class RingReader:
         msg_len = ctypes.c_uint64(self._msg_len)
         msg_read = ctypes.c_uint64(self._msg_read)
         consumed = ctypes.c_uint64(self.consumed_since_publish)
+        seq0 = self.seq
         seq = ctypes.c_uint64(self.seq)
         n = self._nat.tpr_ring_read_into(
             self._nat_addr, self.layout.capacity,
@@ -299,6 +318,8 @@ class RingReader:
         self.consumed_since_publish = consumed.value
         self.seq = seq.value
         ledger.host_copy(n)
+        _MSGS_IN.inc(self.seq - seq0)
+        _BYTES_IN.inc(n)
         return n
 
     def read(self, nbytes: int) -> bytes:
@@ -395,6 +416,8 @@ class RingReader:
         self._msg_len = msg_len
         self._msg_read = msg_read
         ledger.host_copy(total)
+        _MSGS_IN.inc(nmsgs)
+        _BYTES_IN.inc(total)
         return total, nmsgs
 
     def read_many(self, max_msgs: Optional[int] = None,
@@ -431,6 +454,8 @@ class RingReader:
         self.seq += len(descs)
         self.consumed_since_publish += span
         ledger.host_copy(span)
+        _MSGS_IN.inc(len(descs))
+        _BYTES_IN.inc(sum(ln for _off, ln in descs))
         return out
 
     # -- credits ------------------------------------------------------------
@@ -517,6 +542,7 @@ class RingWriter:
                 self._mapped = mapped  # keep the exporter alive
             except (ValueError, TypeError):
                 self._nat = None
+        _WRITERS.track(self)
 
     # -- flow control -------------------------------------------------------
 
@@ -584,6 +610,8 @@ class RingWriter:
         self._put(self.tail, _U64.pack(header_stamp(payload_len, self.seq)))
         self.tail += message_span(payload_len)
         self.seq += 1
+        _MSGS_OUT.inc()
+        _BYTES_OUT.inc(payload_len)
         return payload_len
 
 
@@ -654,6 +682,8 @@ class RingWriter:
         ledger.host_copy(payload_total)
         self.tail += total_span
         self.seq = seq
+        _MSGS_OUT.inc(len(lens))
+        _BYTES_OUT.inc(payload_total)
         return len(lens), payload_total
 
     def _writev_native(self, views: Sequence[memoryview],
@@ -676,6 +706,8 @@ class RingWriter:
         self.tail = tail.value
         self.seq = seq.value
         ledger.host_copy(got)
+        _MSGS_OUT.inc()
+        _BYTES_OUT.inc(got)
         return got
 
 

@@ -24,12 +24,28 @@ from typing import Any, Callable, Iterator, List, Optional, Sequence
 import numpy as np
 
 from tpurpc.jaxshim import codec
+from tpurpc.obs import metrics as _metrics
+from tpurpc.obs import tracing as _tracing
 from tpurpc.rpc.server import (Server, stream_stream_rpc_method_handler,
                                unary_stream_rpc_method_handler,
                                unary_unary_rpc_method_handler)
 from tpurpc.utils.trace import TraceFlag
 
 trace_jax = TraceFlag("jaxshim")
+
+# tpurpc-scope (ISSUE 4): fan-in batching observability. One histogram
+# record + one counter bump per DISPATCHED BATCH (amortized by design);
+# the flush-reason counters say WHY batches went out — a serving stack
+# stuck on "timer" is leaving latency on the table, one stuck on
+# "drained" with tiny batches is the batch-of-one fixed point ISSUE 3
+# fought (see FanInBatcher._drained_inflight).
+_FANIN_BATCH = _metrics.histogram("fanin_batch")
+_BATCHER_BATCHES = _metrics.counter("batcher_batches")
+_BATCHER_ROWS = _metrics.counter("batcher_rows")
+_FLUSH_REASONS = {
+    reason: _metrics.counter(f"batcher_flush_{reason}")
+    for reason in ("size", "timer", "drained", "close")
+}
 
 TENSOR_SERVICE = "tpurpc.Tensor"
 
@@ -277,13 +293,18 @@ class _NativePipeline:
 # ---------------------------------------------------------------------------
 
 class _Pending:
-    __slots__ = ("tree", "event", "result", "error")
+    __slots__ = ("tree", "event", "result", "error", "tctx", "t_enq")
 
     def __init__(self, tree):
         self.tree = tree
         self.event = threading.Event()
         self.result = None
         self.error: Optional[Exception] = None
+        #: tpurpc-scope: the calling RPC's trace context (captured from the
+        #: handler thread's ambient) + enqueue stamp — the batcher thread
+        #: turns them into "batch-wait"/"infer" spans per request
+        self.tctx = _tracing.current() if _tracing.ACTIVE else None
+        self.t_enq = time.monotonic_ns() if self.tctx is not None else 0
 
 
 class FanInBatcher:
@@ -443,18 +464,26 @@ class FanInBatcher:
                 if self._closed and not self._queue:
                     return
                 deadline = time.monotonic() + self.max_delay_s
+                reason = None
                 while (len(self._queue) < self.max_batch and not self._closed):
                     if self._drained_inflight():
-                        break  # nobody else is coming: flush early
+                        reason = "drained"  # nobody else is coming
+                        break
                     left = deadline - time.monotonic()
                     if left <= 0:
+                        reason = "timer"
                         break
                     self._kick.wait(timeout=left)
+                if reason is None:
+                    reason = ("size" if len(self._queue) >= self.max_batch
+                              else "close")
                 batch, self._queue = (self._queue[:self.max_batch],
                                       self._queue[self.max_batch:])
                 if batch:
                     self._recent_batches.append(len(batch))
             if batch:
+                _FLUSH_REASONS[reason].inc()
+                _FANIN_BATCH.record(len(batch))
                 self._run(batch)
 
     def _drained_inflight(self) -> bool:
@@ -541,6 +570,12 @@ class FanInBatcher:
         batch = self._split_compatible(batch)
         if not batch:
             return
+        t_disp = time.monotonic_ns()
+        for p in batch:
+            if p.tctx is not None:
+                # enqueue → dispatch: the per-request "batch-wait" span
+                _tracing.record("batch-wait", p.tctx, p.t_enq,
+                                t_disp - p.t_enq)
         try:
             rows = [p.tree for p in batch]
             sizes = [jax.tree_util.tree_leaves(t)[0].shape[0] for t in rows]
@@ -579,7 +614,8 @@ class FanInBatcher:
                 fail_batch(batch)
                 return
             try:
-                self._inflight.put((batch, sizes, total, out), timeout=0.25)
+                self._inflight.put((batch, sizes, total, out, t_disp),
+                                   timeout=0.25)
                 break
             except _queue.Full:
                 continue
@@ -602,11 +638,20 @@ class FanInBatcher:
             item = self._inflight.get()
             if item is None:
                 return
-            batch, sizes, total, out = item
+            batch, sizes, total, out, t_disp = item
             try:
                 # ONE d2h per output leaf for the whole batch; per-request
                 # splits below are host views, free of device round trips
                 host = jax.device_get(out)
+                t_done = time.monotonic_ns()
+                for p in batch:
+                    if p.tctx is not None:
+                        # dispatch → materialized: the "infer" span (jitted
+                        # call + whole-batch d2h, shared by the batch)
+                        _tracing.record("infer", p.tctx, t_disp,
+                                        t_done - t_disp, rows=total)
+                _BATCHER_BATCHES.inc()
+                _BATCHER_ROWS.inc(total)
                 with self._lock:
                     self.batches_run += 1
                     self.rows_run += total

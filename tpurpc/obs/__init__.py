@@ -1,0 +1,27 @@
+"""tpurpc-scope: the unified telemetry subsystem (ISSUE 4).
+
+Three faces over one always-on core:
+
+* :mod:`tpurpc.obs.metrics` — the process-wide metrics registry. Counters
+  are plain-int, GIL-atomic bumps (branch-free on the hot path); batch/
+  latency histograms amortize one lock per *batch*; state gauges are
+  evaluated at SCRAPE time over weakly-referenced live objects (fleet
+  gauges), so idle-state observability costs the hot path nothing.
+* :mod:`tpurpc.obs.tracing` — per-RPC span timelines with a trace context
+  (trace_id / span_id / sampled bit) carried in call metadata
+  client→server→batcher→device on both the Python and native planes.
+  Sampling defaults OFF; the whole plane is behind one module-global gate.
+* :mod:`tpurpc.obs.scrape` — the introspection plane: a Prometheus-text
+  endpoint served in-process on every :class:`tpurpc.rpc.server.Server`
+  port (the protocol sniff answers plain ``GET /metrics``), feeding the
+  registry, the copy ledger, and channelz; ``python -m tpurpc.tools.top``
+  renders it live.
+
+The reference fork's whole debugging story was trace flags plus a
+shutdown-time profiler table (SURVEY.md §5, ``stats_time.cc``); tpurpc-scope
+replaces post-hoc printf with always-on, near-free telemetry.
+"""
+
+from tpurpc.obs import metrics, tracing  # noqa: F401
+
+__all__ = ["metrics", "tracing"]

@@ -1,0 +1,240 @@
+"""The introspection plane: Prometheus text + trace export over plain HTTP.
+
+Two ways in, one rendering core:
+
+* **In-process on every serving port** — :class:`tpurpc.rpc.server.Server`'s
+  protocol sniff recognizes an HTTP request line (``GET`` / ``HEAD``) and
+  hands the endpoint to :func:`handle_http`, so the SAME port that serves
+  RPCs answers ``curl http://host:port/metrics``. No extra listener, no
+  extra thread pool — the sniff thread serves the one response and closes
+  (scrapes are rare and tiny). Disable with ``TPURPC_SCRAPE=0``.
+* **Standalone** — :func:`start_http_server` for processes that are pure
+  clients (no Server): a daemon-threaded ``http.server`` with the same
+  routes.
+
+Routes::
+
+    /metrics    Prometheus text: registry counters/gauges/histograms/fleet
+                gauges + the copy ledger + channelz server/channel counters
+    /traces     Chrome trace_event JSON of the span buffer (?trace_id=hex)
+    /channelz   channelz snapshot JSON (the live data test_channelz asserts)
+    /healthz    "ok"
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional, Tuple
+
+from tpurpc.obs import metrics as _metrics
+from tpurpc.obs import tracing as _tracing
+
+PREFIX = "tpurpc_"
+
+#: HTTP request-line openers the server sniff routes here (8-byte prefixes
+#: compared against the sniffed first bytes)
+HTTP_METHOD_PREFIXES = (b"GET ", b"HEAD")
+
+
+def scrape_enabled() -> bool:
+    from tpurpc.utils.config import _env
+
+    return (_env("TPURPC_SCRAPE") or "1").lower() not in ("0", "off", "false")
+
+
+def _san(name: str) -> str:
+    return "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+
+
+def render_prometheus() -> str:
+    """The full Prometheus text exposition: one pass over the registry,
+    the copy ledger, and channelz — scrape-time reads only."""
+    lines: List[str] = []
+
+    snap = _metrics.registry().metrics()
+    for name in sorted(snap):
+        m = snap[name]
+        full = PREFIX + _san(name)
+        if isinstance(m, _metrics.Counter):
+            lines.append(f"# TYPE {full} counter")
+            lines.append(f"{full} {m.snapshot()}")
+        elif isinstance(m, _metrics.Gauge):
+            lines.append(f"# TYPE {full} gauge")
+            lines.append(f"{full} {m.snapshot()}")
+        elif isinstance(m, _metrics.Histogram):
+            s = m.snapshot()
+            lines.append(f"# TYPE {full} summary")
+            lines.append(f'{full}{{quantile="0.5"}} {s["p50"]}')
+            lines.append(f'{full}{{quantile="0.99"}} {s["p99"]}')
+            lines.append(f"{full}_sum {m.sum()}")
+            lines.append(f"{full}_count {s['count']}")
+            lines.append(f"{full}_max {s['max']}")
+        elif isinstance(m, _metrics.FleetGauge):
+            total, n = m.collect()
+            lines.append(f"# TYPE {full} gauge")
+            lines.append(f"{full} {total}")
+            lines.append(f"{full}_objects {n}")
+
+    # copy ledger (tpurpc/tpu/ledger.py): byte + op totals per mechanism
+    try:
+        from tpurpc.tpu import ledger
+
+        led = ledger.snapshot()
+        lines.append(f"# TYPE {PREFIX}ledger_bytes counter")
+        lines.append(f"# TYPE {PREFIX}ledger_ops counter")
+        for k in sorted(led):
+            if k.endswith("_ops"):
+                lines.append(
+                    f'{PREFIX}ledger_ops{{kind="{k[:-4]}"}} {led[k]}')
+            else:
+                lines.append(f'{PREFIX}ledger_bytes{{kind="{k}"}} {led[k]}')
+    except Exception:
+        pass
+
+    # channelz: per-entity call counters + stream/connection gauges — the
+    # data test_channelz asserts programmatically, live on the scrape
+    try:
+        from tpurpc.rpc import channelz
+
+        lines.append(f"# TYPE {PREFIX}channelz_calls counter")
+        lines.append(f"# TYPE {PREFIX}channelz_streams gauge")
+        lines.append(f"# TYPE {PREFIX}channelz_connections gauge")
+        for sid, srv in channelz.live_servers():
+            info = channelz.server_info(srv)
+            ent = f'entity="server",id="{sid}"'
+            for key in ("calls_started", "calls_succeeded", "calls_failed"):
+                if key in info:
+                    lines.append(
+                        f'{PREFIX}channelz_calls{{{ent},'
+                        f'kind="{key[6:]}"}} {info[key]}')
+            lines.append(f'{PREFIX}channelz_streams{{{ent}}} '
+                         f'{info["active_streams"]}')
+            lines.append(f'{PREFIX}channelz_connections{{{ent}}} '
+                         f'{info["connections"]}')
+        for cid, ch in channelz.live_channels():
+            info = channelz.channel_info(ch)
+            ent = f'entity="channel",id="{cid}"'
+            counters = getattr(ch, "call_counters", None)
+            if counters is not None:
+                cd = counters.as_dict()
+                for key in ("calls_started", "calls_succeeded",
+                            "calls_failed"):
+                    lines.append(
+                        f'{PREFIX}channelz_calls{{{ent},'
+                        f'kind="{key[6:]}"}} {cd[key]}')
+            lines.append(f'{PREFIX}channelz_streams{{{ent}}} '
+                         f'{info["active_streams"]}')
+            lines.append(f'{PREFIX}channelz_connections{{{ent}}} '
+                         f'{info["connected"]}')
+    except Exception:
+        pass
+
+    return "\n".join(lines) + "\n"
+
+
+# -- request handling (shared by the sniff path and the standalone server) --
+
+def _route(path: str) -> Tuple[int, str, bytes]:
+    """(status, content_type, body) for one GET path."""
+    route, _, query = path.partition("?")
+    if route in ("/metrics", "/metrics/"):
+        return 200, "text/plain; version=0.0.4", render_prometheus().encode()
+    if route in ("/healthz", "/health"):
+        return 200, "text/plain", b"ok\n"
+    if route in ("/channelz", "/channelz/"):
+        from tpurpc.rpc import channelz
+
+        return (200, "application/json",
+                json.dumps(channelz.snapshot(), indent=1).encode())
+    if route in ("/traces", "/traces/"):
+        trace_id: Optional[str] = None
+        for part in query.split("&"):
+            k, _, v = part.partition("=")
+            if k == "trace_id" and v:
+                trace_id = v
+        try:
+            body = json.dumps(_tracing.chrome_trace(trace_id)).encode()
+        except ValueError:
+            return 400, "text/plain", b"bad trace_id\n"
+        return 200, "application/json", body
+    return (404, "text/plain",
+            b"tpurpc-scope: /metrics /traces /channelz /healthz\n")
+
+
+def _response(status: int, ctype: str, body: bytes,
+              head_only: bool = False) -> List[bytes]:
+    reason = {200: "OK", 400: "Bad Request", 404: "Not Found"}.get(status, "")
+    head = (f"HTTP/1.0 {status} {reason}\r\n"
+            f"Content-Type: {ctype}\r\n"
+            f"Content-Length: {len(body)}\r\n"
+            f"Connection: close\r\n\r\n").encode()
+    return [head] if head_only else [head, body]
+
+
+def handle_http(endpoint, first: bytes) -> None:
+    """Serve one HTTP request on a freshly-sniffed Endpoint and close it.
+
+    ``first`` is whatever the protocol sniff already consumed. Reads to the
+    end of the request line only (headers are irrelevant), bounded at 8 KiB
+    / 5 s so a stuck client can't pin the sniff thread."""
+    buf = bytearray(first)
+    try:
+        scratch = bytearray(1024)
+        mv = memoryview(scratch)
+        while b"\r\n" not in buf and b"\n" not in buf and len(buf) < 8192:
+            n = endpoint.read_into(mv, timeout=5)
+            if n == 0:
+                break
+            buf += mv[:n]
+        line = bytes(buf).split(b"\n", 1)[0].strip().decode("latin-1")
+        parts = line.split()
+        method = parts[0] if parts else "GET"
+        path = parts[1] if len(parts) > 1 else "/metrics"
+        status, ctype, body = _route(path)
+        endpoint.write(_response(status, ctype, body,
+                                 head_only=method == "HEAD"))
+    except Exception:
+        pass  # a scrape must never take anything down
+    finally:
+        try:
+            endpoint.close()
+        except Exception:
+            pass
+
+
+def start_http_server(host: str = "127.0.0.1", port: int = 0):
+    """Standalone introspection endpoint (client-only processes): returns
+    ``(server, bound_port)``; ``server.shutdown()`` stops it. Daemon
+    threads — it never blocks interpreter exit."""
+    import http.server
+    import socketserver
+    import threading
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 — http.server API
+            status, ctype, body = _route(self.path)
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_HEAD(self):  # noqa: N802
+            status, ctype, body = _route(self.path)
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+
+        def log_message(self, *args):  # quiet: scrapes are periodic
+            pass
+
+    class Srv(socketserver.ThreadingMixIn, http.server.HTTPServer):
+        daemon_threads = True
+        allow_reuse_address = True
+
+    srv = Srv((host, port), Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True,
+                         name="tpurpc-obs-http")
+    t.start()
+    return srv, srv.server_address[1]

@@ -26,6 +26,8 @@ from typing import (Callable, Iterable, Iterator, List, Optional, Sequence,
 
 from tpurpc.analysis.locks import make_condition, make_lock
 from tpurpc.core.endpoint import Endpoint, EndpointError, connect_endpoint
+from tpurpc.obs import metrics as _obs_metrics
+from tpurpc.obs import tracing as _tracing
 from tpurpc.rpc import frame as fr
 from tpurpc.rpc.status import (ChannelConnectivity, Deserializer, Metadata,
                                RpcError, Serializer, StatusCode,
@@ -34,6 +36,16 @@ from tpurpc.rpc.status import (ChannelConnectivity, Deserializer, Metadata,
 from tpurpc.utils.trace import TraceFlag
 
 trace_channel = TraceFlag("channel")
+
+# tpurpc-scope (ISSUE 4): pipelined-client observability. In-flight depth
+# is a scrape-time fleet gauge over live PipelinedUnary windows; the two
+# latency histograms record once per pipelined call (microseconds) —
+# call_us is send→future-resolved, demux_us is the reader-thread hop from
+# terminal delivery to future resolution.
+_PIPELINES_INFLIGHT = _obs_metrics.fleet("pipeline_inflight",
+                                         lambda pl: pl._inflight)
+_PIPE_CALL_US = _obs_metrics.histogram("pipeline_call_us", kind="latency")
+_PIPE_DEMUX_US = _obs_metrics.histogram("pipeline_demux_us", kind="latency")
 
 
 class _ClientStream:
@@ -48,6 +60,10 @@ class _ClientStream:
         self.assembly = fr.Assembly()
         self.done = False  # trailers or failure delivered
         self.refused = False  # RST|FLAG_REFUSED: admission refusal, replayable
+        #: tpurpc-scope: open "wire" span of a traced call (closed at the
+        #: terminal event) + the terminal-delivery stamp for demux latency
+        self._wire_span = None
+        self._t_terminal = 0
         #: pipelined-call completion hook: invoked (on the delivering thread)
         #: AFTER the terminal event is queued — PipelinedUnary resolves its
         #: future here instead of parking a thread on the event queue
@@ -116,6 +132,11 @@ class _ClientStream:
         self._fire_terminal()
 
     def _fire_terminal(self) -> None:
+        sp = self._wire_span
+        if sp is not None:
+            self._wire_span = None
+            _tracing.finish(sp)
+        self._t_terminal = time.perf_counter_ns()
         cb = self.on_terminal
         if cb is not None:
             try:
@@ -1291,6 +1312,9 @@ class Call:
 
 
 _NO_REQUEST = object()
+#: "no sampling decision was made upstream" sentinel for _start's
+#: trace_ctx parameter (None means DECIDED-unsampled — don't redraw)
+_TRACE_UNSET = object()
 
 
 def _status_of(exc: RpcError) -> StatusCode:
@@ -1419,6 +1443,7 @@ class _MultiCallable:
                timeout: Optional[float],
                first_request=_NO_REQUEST,
                wait_for_ready: bool = False,
+               trace_ctx=_TRACE_UNSET,
                ) -> Tuple[_Connection, _ClientStream, Call]:
         """Open a stream and send HEADERS — fused with the first (only)
         MESSAGE when the request is known upfront, so a unary call costs one
@@ -1446,6 +1471,27 @@ class _MultiCallable:
         else:
             raise RpcError(StatusCode.UNAVAILABLE,
                            "no non-draining connection after 3 dials")
+        # tpurpc-scope trace propagation (ISSUE 4): a sampled call carries
+        # its context in ordinary metadata; the send interval is the
+        # "client-send" span, and the open "wire" span rides the stream
+        # until the terminal event closes it on the delivering thread.
+        # Callers that already drew the sampling decision (UnaryUnary's
+        # native-path gate) pass it via trace_ctx; _TRACE_UNSET means
+        # decide here.
+        if trace_ctx is _TRACE_UNSET:
+            tctx = _tracing.maybe_sample() if _tracing.ACTIVE else None
+        else:
+            tctx = trace_ctx
+        send_sp = None
+        if tctx is not None:
+            tctx = tctx.child()  # this call's own span id
+            metadata = list(metadata or ())
+            metadata.append((_tracing.HEADER, tctx.encode()))
+            send_sp = _tracing.begin("client-send", tctx)
+            # Open the wire span BEFORE the write: on a loopback transport
+            # the server can be parsing HEADERS before send_many returns,
+            # and the wire interval must enclose every server-side span.
+            st._wire_span = _tracing.begin("wire", tctx)
         try:
             remaining = (None if deadline is None
                          else max(0.0, deadline - time.monotonic()))
@@ -1453,15 +1499,20 @@ class _MultiCallable:
                           else max(0, int(remaining * 1e6)))
             hdr_payload = fr.headers_payload(self._method, metadata or (),
                                              timeout_us)
-            if first_request is _NO_REQUEST:
-                conn.writer.send(fr.HEADERS, 0, st.stream_id, hdr_payload)
-            else:
-                conn.writer.send_many([
-                    (fr.HEADERS, 0, st.stream_id, hdr_payload),
-                    (fr.MESSAGE,
-                     fr.FLAG_END_STREAM | self._channel._compress_flag,
-                     st.stream_id, self._ser(first_request)),
-                ])
+            with _tracing.use(tctx) if tctx is not None \
+                    else _tracing.NULL_CM:
+                if first_request is _NO_REQUEST:
+                    conn.writer.send(fr.HEADERS, 0, st.stream_id, hdr_payload)
+                else:
+                    conn.writer.send_many([
+                        (fr.HEADERS, 0, st.stream_id, hdr_payload),
+                        (fr.MESSAGE,
+                         fr.FLAG_END_STREAM | self._channel._compress_flag,
+                         st.stream_id, self._ser(first_request)),
+                    ])
+            if tctx is not None:
+                _tracing.finish(send_sp)
+                send_sp = None
         except fr.FrameError as exc:
             conn.close_stream(st)
             raise RpcError(StatusCode.RESOURCE_EXHAUSTED, str(exc)) from exc
@@ -1509,8 +1560,16 @@ class _MultiCallable:
         nch = self._channel._native_fast()
         if nch is None:
             return None
+        # Native-plane trace propagation (ISSUE 4): a sampled stream call
+        # carries its context through tpr_call_start's metadata array —
+        # same wire key, same server-side extraction as the Python plane.
+        md = None
+        if _tracing.ACTIVE:
+            tctx = _tracing.maybe_sample()
+            if tctx is not None:
+                md = [(_tracing.HEADER, tctx.child().encode())]
         try:
-            nc = nch.start_call(self._method, timeout)
+            nc = nch.start_call(self._method, timeout, metadata=md)
         except RpcError:
             self._channel._native_invalidate(nch)
             return None
@@ -1572,7 +1631,13 @@ class UnaryUnary(_MultiCallable):
         # Call with trailing metadata), metadata, and wait_for_ready —
         # whether per-call or via the service config — stay on the Python
         # transport (the queue-until-ready dial loop lives there).
-        if (self._allow_native and not metadata
+        # Sampled (traced) calls stay on the Python transport: the unary
+        # native entry has no metadata channel to carry the trace context
+        # (NativeCall STREAMS do — _try_native_stream threads it through
+        # tpr_call_start). Sampling defaults off, so the common path pays
+        # one global load.
+        tctx = _tracing.maybe_sample() if _tracing.ACTIVE else None
+        if (tctx is None and self._allow_native and not metadata
                 and not grpcio_kw.get("wait_for_ready")
                 and not self._channel._call_plan(self._method, None)[3]
                 and not self._instruments_live()):
@@ -1581,8 +1646,12 @@ class UnaryUnary(_MultiCallable):
                 done, resp = self._native_call(nch, request, timeout)
                 if done:
                     return resp
+        # the sampling decision rides DOWN the call explicitly (not via
+        # ambient TLS): re-deriving it in _start would cost a second
+        # sampler draw per call even when tracing never fires
         response, _ = self.with_call(request, timeout=timeout,
-                                     metadata=metadata, **grpcio_kw)
+                                     metadata=metadata,
+                                     _trace_ctx=tctx, **grpcio_kw)
         return response
 
     def _native_call(self, nch, request, timeout: Optional[float]):
@@ -1654,17 +1723,21 @@ class UnaryUnary(_MultiCallable):
             raise
 
     def with_call(self, request, timeout: Optional[float] = None,
-                  metadata: Optional[Metadata] = None, **grpcio_kw):
+                  metadata: Optional[Metadata] = None,
+                  _trace_ctx=_TRACE_UNSET, **grpcio_kw):
         from tpurpc.utils import stats as _stats
 
         if _stats.profiling_on():  # GRPCProfiler span: whole unary call
             with _stats.profile("cli_unary"):
                 return self._with_call_impl(request, timeout, metadata,
+                                            _trace_ctx=_trace_ctx,
                                             **grpcio_kw)
-        return self._with_call_impl(request, timeout, metadata, **grpcio_kw)
+        return self._with_call_impl(request, timeout, metadata,
+                                    _trace_ctx=_trace_ctx, **grpcio_kw)
 
     def _with_call_impl(self, request, timeout: Optional[float] = None,
-                        metadata: Optional[Metadata] = None, **grpcio_kw):
+                        metadata: Optional[Metadata] = None,
+                        _trace_ctx=_TRACE_UNSET, **grpcio_kw):
         _reject_call_credentials(grpcio_kw)
         policy, timeout, throttle, eff_wfr = self._channel._call_plan(
             self._method, timeout, bool(grpcio_kw.get("wait_for_ready")))
@@ -1686,7 +1759,7 @@ class UnaryUnary(_MultiCallable):
             for _ in range(3):
                 try:
                     return self._call_once(request, remaining(), metadata,
-                                           wfr)
+                                           wfr, trace_ctx=_trace_ctx)
                 except RpcError as exc:
                     committed = getattr(exc, "_tpurpc_committed", False)
                     # FLAG_REFUSED is the contract; the "connection draining"
@@ -1713,16 +1786,19 @@ class UnaryUnary(_MultiCallable):
                         refused = True
                     if not refused:
                         raise
-            return self._call_once(request, remaining(), metadata, wfr)
+            return self._call_once(request, remaining(), metadata, wfr,
+                                   trace_ctx=_trace_ctx)
 
         if policy is None:
             return attempt()
         return policy.run(deadline, attempt, throttle=throttle)
 
     def _call_once(self, request, timeout: Optional[float],
-                   metadata: Optional[Metadata], wait_for_ready: bool = False):
+                   metadata: Optional[Metadata], wait_for_ready: bool = False,
+                   trace_ctx=_TRACE_UNSET):
         conn, st, call = self._start(metadata, timeout, first_request=request,
-                                     wait_for_ready=wait_for_ready)
+                                     wait_for_ready=wait_for_ready,
+                                     trace_ctx=trace_ctx)
         response = None
         got = False
         try:
@@ -1806,6 +1882,7 @@ class PipelinedUnary:
         self._inflight = 0
         self._closed = False
         self._pump_threads: dict = {}  # conn id -> Thread (pump-mode only)
+        _PIPELINES_INFLIGHT.track(self)
 
     def call_async(self, request, timeout: Optional[float] = None,
                    metadata: Optional[Metadata] = None):
@@ -1817,6 +1894,7 @@ class PipelinedUnary:
                 timeout=None if timeout is None else timeout):
             raise RpcError(StatusCode.DEADLINE_EXCEEDED,
                            "deadline exceeded waiting for pipeline window")
+        t_start = time.perf_counter_ns()
         fut = self._Future()
         try:
             remaining = (None if deadline is None
@@ -1875,6 +1953,10 @@ class PipelinedUnary:
                     fut.set_result(_deserialize(self._mc._deser, msgs[0]))
                 except BaseException as exc:  # a raising deserializer must
                     fut.set_exception(exc)    # fail the future, never hang it
+            now = time.perf_counter_ns()
+            _PIPE_CALL_US.record((now - t_start) // 1000)
+            if st._t_terminal:
+                _PIPE_DEMUX_US.record((now - st._t_terminal) // 1000)
         with self._lock:
             self._inflight += 1
         if deadline is not None:

@@ -661,12 +661,24 @@ class NativeChannel:
         call.future = future
         return call
 
-    def start_call(self, method: str,
-                   timeout: Optional[float] = None) -> NativeCall:
+    def start_call(self, method: str, timeout: Optional[float] = None,
+                   metadata=None) -> NativeCall:
+        """Start a streaming call. ``metadata`` is an optional list of
+        ``(key, value)`` text pairs shipped through ``tpr_call_start``'s
+        flat ``k,v,k,v`` array — the seam the tpurpc-scope trace context
+        (``tpurpc-trace``) rides on the native plane."""
+        md_arr, n_md = None, 0
+        if metadata:
+            flat = []
+            for k, v in metadata:
+                flat.append(str(k).encode())
+                flat.append(v if isinstance(v, bytes) else str(v).encode())
+            md_arr = (ctypes.c_char_p * len(flat))(*flat)
+            n_md = len(metadata)
         ch = self._op_begin()  # held for the NativeCall's whole lifetime:
         try:                   # its tpr_call_* entries all touch the channel
-            c = self._lib.tpr_call_start(ch, method.encode(), None,
-                                         0, _timeout_ms(timeout))
+            c = self._lib.tpr_call_start(ch, method.encode(), md_arr,
+                                         n_md, _timeout_ms(timeout))
             if not c:
                 raise RpcError(StatusCode.UNAVAILABLE, "call start failed")
             return NativeCall(self._lib, c, on_close=self._op_end)

@@ -42,6 +42,7 @@ import threading
 import weakref
 from typing import Optional
 
+from tpurpc.obs import tracing as _tracing
 from tpurpc.rpc.status import AbortError, StatusCode, deserialize
 from tpurpc.utils.trace import TraceFlag
 
@@ -320,27 +321,42 @@ class NativeDataplane:
                     buf = (ctypes.c_uint8 * len(raw)).from_buffer_copy(raw)
                     return lib.tpr_srv_send(call, buf, len(raw))
 
+                # tpurpc-scope (ISSUE 4): the trace context a sampled
+                # caller shipped through tpr_call_start's metadata — same
+                # wire key as the Python plane, installed as this handler
+                # thread's ambient so handler spans (and the batcher's
+                # batch-wait/infer) attribute to the caller's trace_id.
+                tctx = None
+                if _tracing.ACTIVE:
+                    for _k, _v in ctx.invocation_metadata():
+                        if _k == _tracing.HEADER:
+                            tctx = _tracing.TraceContext.decode(_v)
+                            break
                 try:
-                    if _h.kind == "unary_unary":
-                        req = next(requests(), None)
-                        if req is None:
-                            return 13  # half-close with no message
-                        if send(_h.behavior(req, ctx)) != 0:
-                            return 14  # UNAVAILABLE: connection died
-                    elif _h.kind == "unary_stream":
-                        req = next(requests(), None)
-                        if req is None:
-                            return 13
-                        for resp in _h.behavior(req, ctx):
+                    with _tracing.use(tctx) if tctx is not None \
+                            else _tracing.NULL_CM:
+                        if _h.kind == "unary_unary":
+                            req = next(requests(), None)
+                            if req is None:
+                                return 13  # half-close with no message
+                            with _tracing.span("handler", tctx):
+                                resp = _h.behavior(req, ctx)
                             if send(resp) != 0:
+                                return 14  # UNAVAILABLE: connection died
+                        elif _h.kind == "unary_stream":
+                            req = next(requests(), None)
+                            if req is None:
+                                return 13
+                            for resp in _h.behavior(req, ctx):
+                                if send(resp) != 0:
+                                    return 14
+                        elif _h.kind == "stream_unary":
+                            if send(_h.behavior(requests(), ctx)) != 0:
                                 return 14
-                    elif _h.kind == "stream_unary":
-                        if send(_h.behavior(requests(), ctx)) != 0:
-                            return 14
-                    else:  # stream_stream
-                        for resp in _h.behavior(requests(), ctx):
-                            if send(resp) != 0:
-                                return 14
+                        else:  # stream_stream
+                            for resp in _h.behavior(requests(), ctx):
+                                if send(resp) != 0:
+                                    return 14
                 except AbortError as exc:
                     lib.tpr_srv_set_details(call, exc.details.encode())
                     return int(exc.code.value)

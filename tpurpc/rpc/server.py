@@ -27,6 +27,8 @@ from typing import (Callable, Dict, Iterator, List, Optional,
 
 from tpurpc.core.endpoint import (Endpoint, EndpointError, EndpointListener,
                                   passthru_endpoint_pair)
+from tpurpc.obs import metrics as _obs_metrics
+from tpurpc.obs import tracing as _tracing
 from tpurpc.rpc import frame as fr
 from tpurpc.rpc.status import (AbortError, Deserializer, Metadata, Serializer,
                                StatusCode, deserialize as _deserialize,
@@ -36,6 +38,21 @@ from tpurpc.utils.trace import TraceFlag
 
 trace_server = TraceFlag("server")
 _log = logging.getLogger("tpurpc.server")
+
+#: tpurpc-scope (ISSUE 4): always-on server-side handler latency (one
+#: perf_counter pair + one amortized histogram record per RPC — what
+#: `tools.top` renders as serving percentiles)
+_SRV_CALL_US = _obs_metrics.histogram("srv_call_us", kind="latency")
+
+
+def _extract_trace(metadata) -> "Optional[_tracing.TraceContext]":
+    """The tpurpc-trace context a client attached, if sampling is live."""
+    if not _tracing.ACTIVE:
+        return None
+    for key, value in metadata:
+        if key == _tracing.HEADER:
+            return _tracing.TraceContext.decode(value)
+    return None
 
 
 class RpcMethodHandler:
@@ -255,6 +272,10 @@ class _ServerStream:
         #: responses (the peer demonstrably speaks it)
         self.peer_compressed = False
         self.context: Optional[ServerContext] = None
+        #: tpurpc-scope: the caller's trace context (None untraced) + the
+        #: HEADERS-arrival stamp feeding the "dispatch" span
+        self.trace_ctx = None
+        self.trace_t0 = 0
         #: reactor-path pending invocation: (handler, ctx, path) set by
         #: _start_stream for inline unary handlers; consumed by the sink's
         #: commit when the request completes (runs on the reader thread)
@@ -589,6 +610,10 @@ class _ServerConnection:
             return
         deadline = (None if timeout_us is None
                     else time.monotonic() + timeout_us / 1e6)
+        # tpurpc-scope: pick up a sampled caller's trace context; the
+        # HEADERS→handler-start interval becomes the "dispatch" span
+        st.trace_ctx = _extract_trace(metadata)
+        st.trace_t0 = time.monotonic_ns() if st.trace_ctx is not None else 0
         handler = self.server._lookup_intercepted(path, metadata)
         if handler is None:
             self._send_trailers(st, StatusCode.UNIMPLEMENTED,
@@ -673,14 +698,23 @@ class _ServerConnection:
         counters = self.server.call_counters
         counters.on_start()
         ok = False
+        tctx = st.trace_ctx
+        if tctx is not None and st.trace_t0:
+            # HEADERS arrival → handler start: the queue/handoff interval
+            _tracing.record("dispatch", tctx, st.trace_t0,
+                            time.monotonic_ns() - st.trace_t0, method=path)
+        t0 = time.perf_counter_ns()
         try:
-            if _stats.profiling_on():  # GRPCProfiler span: handler execution
-                with _stats.profile("srv_handler"):
+            with _tracing.use(tctx) if tctx is not None \
+                    else _tracing.NULL_CM:
+                if _stats.profiling_on():  # GRPCProfiler span: handler exec
+                    with _stats.profile("srv_handler"):
+                        ok = self._run_handler_inner(handler, st, ctx, path)
+                else:
                     ok = self._run_handler_inner(handler, st, ctx, path)
-            else:
-                ok = self._run_handler_inner(handler, st, ctx, path)
         finally:
             counters.on_finish(ok)
+            _SRV_CALL_US.record((time.perf_counter_ns() - t0) // 1000)
 
     def _run_handler_inner(self, handler: RpcMethodHandler, st: _ServerStream,
                            ctx: ServerContext, path: str) -> bool:
@@ -743,20 +777,23 @@ class _ServerConnection:
                     return code is StatusCode.OK
             elif ctx.is_active():
                 # Unary response: MESSAGE + TRAILERS fused into one transport
-                # write (one receiver wakeup instead of two).
+                # write (one receiver wakeup instead of two). Serialization
+                # + the gathered write are the trace timeline's "respond".
                 code = ctx._code if ctx._code is not None else StatusCode.OK
                 try:
-                    self.writer.send_many([
-                        (fr.MESSAGE,
-                         # per-send mirror read (request fully consumed by
-                         # now, so peer_compressed is settled)
-                         fr.FLAG_COMPRESSED if st.peer_compressed else 0,
-                         st.stream_id,
-                         handler.response_serializer(result)),
-                        (fr.TRAILERS, fr.FLAG_END_STREAM, st.stream_id,
-                         fr.trailers_payload(code, ctx._details,
-                                             list(ctx._trailing))),
-                    ])
+                    with (_tracing.span("respond", st.trace_ctx)
+                          if st.trace_ctx is not None else _tracing.NULL_CM):
+                        self.writer.send_many([
+                            (fr.MESSAGE,
+                             # per-send mirror read (request fully consumed
+                             # by now, so peer_compressed is settled)
+                             fr.FLAG_COMPRESSED if st.peer_compressed else 0,
+                             st.stream_id,
+                             handler.response_serializer(result)),
+                            (fr.TRAILERS, fr.FLAG_END_STREAM, st.stream_id,
+                             fr.trailers_payload(code, ctx._details,
+                                                 list(ctx._trailing))),
+                        ])
                 except fr.FrameError:
                     self._send_trailers(st, StatusCode.INTERNAL,
                                         "trailing metadata too large")
@@ -1080,6 +1117,20 @@ class Server:
                 from tpurpc.wire.grpc_h2 import GrpcH2Connection
 
                 conn = GrpcH2Connection(self, endpoint, preface_consumed=8)
+            elif (bytes(first[:4]) == b"GET "
+                  or bytes(first[:5]) == b"HEAD "):
+                # tpurpc-scope introspection plane (ISSUE 4): the SAME
+                # serving port answers plain-HTTP scrapes — /metrics
+                # (Prometheus text), /traces (chrome trace JSON),
+                # /channelz, /healthz. One request per connection, served
+                # on this sniff thread, then closed. TPURPC_SCRAPE=0 off.
+                from tpurpc.obs import scrape as _scrape
+
+                if _scrape.scrape_enabled():
+                    _scrape.handle_http(endpoint, bytes(first))
+                else:
+                    endpoint.close()
+                return
             else:
                 trace_server.log("unknown protocol preface %r; dropping",
                                  bytes(first))

@@ -1,0 +1,110 @@
+"""~1s metrics smoke for the verification gate (tools/check.sh, ISSUE 4).
+
+Stands up a loopback server, runs a handful of RPCs (pool + pipelined),
+scrapes the SAME serving port over plain HTTP twice, and asserts:
+
+* the core series are present (srv_call_us, channelz calls, resp_coalesce,
+  pipeline_call_us, ledger bytes);
+* the call counters are MONOTONIC between the two scrapes and account for
+  the traffic we just generated;
+* a forced-sampled traced call produces a span tree whose client-send /
+  wire / dispatch / respond spans share one trace_id, and the /traces
+  endpoint serves it as chrome trace JSON;
+* `tools.top --once` parses the scrape (the dashboard's parser is the
+  same code path).
+
+Exit 0 on success; any assertion/exception exits 1 with the reason.
+
+    python -m tpurpc.tools.obs_smoke
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import urllib.request
+
+
+def run() -> int:
+    from tpurpc.obs import tracing
+    from tpurpc.rpc.channel import Channel
+    from tpurpc.rpc.server import Server, unary_unary_rpc_method_handler
+    from tpurpc.tools.top import parse_prometheus
+
+    srv = Server(max_workers=4)
+    srv.add_method("/obs/Echo",
+                   unary_unary_rpc_method_handler(
+                       lambda req, ctx: b"ok:" + bytes(req)))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    tracing.force(True)
+    try:
+        def scrape(path="/metrics"):
+            return urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10).read()
+
+        with Channel(f"127.0.0.1:{port}") as ch:
+            mc = ch.unary_unary("/obs/Echo")
+            assert mc(b"a", timeout=10) == b"ok:a"
+            m1 = parse_prometheus(scrape().decode())
+            pl = mc.pipeline(depth=4)
+            futs = [pl.call_async(b"r%d" % i, timeout=10) for i in range(8)]
+            for i, f in enumerate(futs):
+                assert f.result(10) == b"ok:r%d" % i
+            m2 = parse_prometheus(scrape().decode())
+
+        # core series present
+        for name in ("tpurpc_srv_call_us_count", "tpurpc_pipeline_call_us_count",
+                     "tpurpc_resp_coalesce_count"):
+            assert (name, "") in m2, f"series {name} missing from scrape"
+        assert any(n == "tpurpc_channelz_calls" for n, _l in m2), \
+            "channelz call series missing"
+        assert any(n == "tpurpc_ledger_bytes" for n, _l in m2), \
+            "copy-ledger series missing"
+
+        # monotonic + accounts for the traffic between the scrapes
+        def calls(m):
+            return sum(v for (n, lab), v in m.items()
+                       if n == "tpurpc_channelz_calls"
+                       and 'kind="started"' in lab)
+
+        c1, c2 = calls(m1), calls(m2)
+        assert c2 >= c1 + 8, f"call counter not monotonic/complete: {c1}->{c2}"
+        s1 = m1.get(("tpurpc_srv_call_us_count", ""), 0)
+        s2 = m2.get(("tpurpc_srv_call_us_count", ""), 0)
+        assert s2 >= s1 + 8, f"srv latency histogram stalled: {s1}->{s2}"
+
+        # traced spans: one trace_id across client-send/wire/dispatch/respond
+        spans = tracing.spans()
+        byname = {}
+        for s in spans:
+            byname.setdefault(s["name"], s)
+        for need in ("client-send", "wire", "dispatch", "respond"):
+            assert need in byname, f"span {need} missing ({sorted(byname)})"
+        one = [s for s in spans
+               if s["trace_id"] == byname["respond"]["trace_id"]]
+        assert {"client-send", "wire", "dispatch", "respond"} <= {
+            s["name"] for s in one}, "trace_id does not unify the call's spans"
+
+        # /traces serves chrome trace JSON; /healthz answers
+        tr = json.loads(scrape("/traces"))
+        assert tr["traceEvents"], "trace export empty"
+        assert scrape("/healthz").strip() == b"ok"
+        print(f"obs smoke OK: {len(m2)} series, {len(spans)} spans, "
+              f"calls {int(c1)}->{int(c2)}")
+        return 0
+    finally:
+        tracing.force(None)
+        srv.stop(grace=0)
+
+
+def main() -> int:
+    try:
+        return run()
+    except Exception as exc:
+        print(f"obs smoke FAILED: {exc!r}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

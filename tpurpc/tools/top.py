@@ -1,0 +1,168 @@
+"""tpurpc-top: a terminal dashboard over the introspection plane.
+
+Polls a tpurpc process's Prometheus endpoint (any serving port answers
+``GET /metrics`` — see tpurpc/obs/scrape.py) and renders live QPS, handler
+latency percentiles, ring occupancy/credits, pipelined-window depth, and
+the fan-in batcher's batch-size/flush-reason profile.
+
+    python -m tpurpc.tools.top HOST:PORT [--interval 1.0] [--once]
+
+``--once`` prints a single snapshot (no screen clearing) — what the CI
+metrics smoke and scripts use.
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+import time
+import urllib.request
+from typing import Dict, Optional, Tuple
+
+_LINE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>[-+0-9.eE]+|NaN)$")
+
+
+def parse_prometheus(text: str) -> Dict[Tuple[str, str], float]:
+    """{(name, labels): value} for every sample line (types ignored)."""
+    out: Dict[Tuple[str, str], float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _LINE.match(line)
+        if m is None:
+            continue
+        try:
+            out[(m.group("name"), m.group("labels") or "")] = float(
+                m.group("value"))
+        except ValueError:
+            continue
+    return out
+
+
+def fetch(target: str, timeout: float = 5.0) -> Dict[Tuple[str, str], float]:
+    url = f"http://{target}/metrics"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return parse_prometheus(resp.read().decode("utf-8", "replace"))
+
+
+def _val(m: Dict, name: str, labels: str = "") -> float:
+    return m.get((name, labels), 0.0)
+
+
+def _sum_label(m: Dict, name: str, needle: str = "") -> float:
+    return sum(v for (n, lab), v in m.items()
+               if n == name and needle in lab)
+
+
+def _fmt_us(us: float) -> str:
+    if us >= 1e6:
+        return f"{us / 1e6:.2f}s"
+    if us >= 1e3:
+        return f"{us / 1e3:.1f}ms"
+    return f"{us:.0f}us"
+
+
+def render(cur: Dict, prev: Optional[Dict], dt: float,
+           target: str) -> str:
+    P = "tpurpc_"
+    Q50 = 'quantile="0.5"'
+    Q99 = 'quantile="0.99"'
+    lines = []
+    lines.append(f"tpurpc-top — {target} — {time.strftime('%H:%M:%S')}")
+    lines.append("=" * 64)
+
+    def rate(name: str, labels: str = "") -> float:
+        if prev is None or dt <= 0:
+            return 0.0
+        return max(0.0, (_val(cur, name, labels)
+                         - _val(prev, name, labels))) / dt
+
+    # QPS from channelz call counters (sum across entities)
+    def crate(kind: str) -> float:
+        if prev is None or dt <= 0:
+            return 0.0
+        name = P + "channelz_calls"
+        now = sum(v for (n, lab), v in cur.items()
+                  if n == name and f'kind="{kind}"' in lab)
+        was = sum(v for (n, lab), v in (prev or {}).items()
+                  if n == name and f'kind="{kind}"' in lab)
+        return max(0.0, now - was) / dt
+
+    lines.append(f"rpc   qps {crate('started'):8.1f}   "
+                 f"ok/s {crate('succeeded'):8.1f}   "
+                 f"fail/s {crate('failed'):6.1f}   "
+                 f"streams {int(_sum_label(cur, P + 'channelz_streams')):4d}")
+    lines.append(
+        f"lat   srv p50 {_fmt_us(_val(cur, P + 'srv_call_us', Q50)):>8}  "
+        f"p99 {_fmt_us(_val(cur, P + 'srv_call_us', Q99)):>8}   "
+        f"pipe p50 {_fmt_us(_val(cur, P + 'pipeline_call_us', Q50)):>8}  "
+        f"p99 {_fmt_us(_val(cur, P + 'pipeline_call_us', Q99)):>8}")
+    lines.append(
+        f"ring  in-flight {int(_val(cur, P + 'ring_in_flight_bytes')):>10}B  "
+        f"unpub-credit {int(_val(cur, P + 'ring_credit_unpublished_bytes')):>8}B  "
+        f"msgs/s in {rate(P + 'ring_msgs_read'):8.0f} "
+        f"out {rate(P + 'ring_msgs_written'):8.0f}")
+    lines.append(
+        f"pipe  in-flight {int(_val(cur, P + 'pipeline_inflight')):>4} over "
+        f"{int(_val(cur, P + 'pipeline_inflight_objects')):>3} windows   "
+        f"pairs {int(_val(cur, P + 'pairs_connected')):>3} "
+        f"(stalled {int(_val(cur, P + 'pairs_write_stalled'))})")
+    lines.append(
+        f"wake  spin-hit/s {rate(P + 'wait_spin_hit'):7.0f}  "
+        f"spin-miss/s {rate(P + 'wait_spin_miss'):7.0f}  "
+        f"sleep/s {rate(P + 'wait_sleep'):7.0f}")
+    lines.append(
+        f"batch fanin p50 {int(_val(cur, P + 'fanin_batch', Q50)):>3}  "
+        f"p99 {int(_val(cur, P + 'fanin_batch', Q99)):>3}  "
+        f"rows/s {rate(P + 'batcher_rows'):8.0f}  "
+        "flush size/timer/drained "
+        f"{int(_val(cur, P + 'batcher_flush_size'))}/"
+        f"{int(_val(cur, P + 'batcher_flush_timer'))}/"
+        f"{int(_val(cur, P + 'batcher_flush_drained'))}")
+    lines.append(
+        f"coal  resp p50 {int(_val(cur, P + 'resp_coalesce', Q50)):>3}  "
+        f"h2-data p50 {int(_val(cur, P + 'h2_data_coalesce', Q50)):>3}   "
+        f"drain p50 {int(_val(cur, P + 'ring_drain', Q50)):>3} "
+        f"msgs/wakeup")
+    led = {k[1]: v for k, v in cur.items() if k[0] == P + "ledger_bytes"}
+    if led:
+        hc = led.get('kind="host_copy"', 0)
+        zc = led.get('kind="zero_copy"', 0)
+        lines.append(f"copy  host {int(hc):>12}B   zero-copy {int(zc):>12}B")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tpurpc.tools.top")
+    ap.add_argument("target", help="HOST:PORT of any tpurpc serving port")
+    ap.add_argument("--interval", type=float, default=1.0)
+    ap.add_argument("--once", action="store_true",
+                    help="print one snapshot and exit")
+    args = ap.parse_args(argv)
+
+    prev: Optional[Dict] = None
+    t_prev = time.monotonic()
+    while True:
+        try:
+            cur = fetch(args.target)
+        except OSError as exc:
+            print(f"tpurpc-top: {args.target} unreachable: {exc}",
+                  file=sys.stderr)
+            return 1
+        now = time.monotonic()
+        out = render(cur, prev, now - t_prev, args.target)
+        if args.once:
+            print(out)
+            return 0
+        sys.stdout.write("\x1b[2J\x1b[H" + out + "\n")
+        sys.stdout.flush()
+        prev, t_prev = cur, now
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -58,7 +58,16 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from tpurpc.obs import metrics as _metrics
 from tpurpc.tpu import ledger
+
+# tpurpc-scope (ISSUE 4): device-ring placement totals + scrape-time
+# occupancy over live HBM rings (one counter bump per placement BATCH; the
+# per-byte movement accounting stays the copy ledger's job)
+_HBM_PLACE_MSGS = _metrics.counter("hbm_place_msgs")
+_HBM_PLACE_BYTES = _metrics.counter("hbm_place_bytes")
+_HBM_RINGS = _metrics.fleet("hbm_ring_occupancy_bytes",
+                            lambda r: r.tail - r.head)
 
 
 class HbmRing:
@@ -88,6 +97,7 @@ class HbmRing:
         #: outstanding leases whose array ALIASES ring memory (dlpack views):
         #: while > 0, the allocation-stability assert in place() is fatal
         self._aliased = 0
+        _HBM_RINGS.track(self)
         #: ring base address (unsafe_buffer_pointer), or None where the
         #: backend doesn't expose one — the dlpack view path needs it both
         #: to build the alias and to verify stability across donations
@@ -307,6 +317,8 @@ class HbmRing:
                 self.buf = self._update(self.buf, dev[first:], 0)
                 ledger.dma_d2d(n - first)
             self._assert_stable()
+        _HBM_PLACE_MSGS.inc()
+        _HBM_PLACE_BYTES.inc(n)
         return off, n
 
     def place_many(self, payloads,
@@ -370,6 +382,8 @@ class HbmRing:
                 self.buf = self._update(self.buf, dev[first:], 0)
                 ledger.dma_d2d(total - first)
             self._assert_stable()
+        _HBM_PLACE_MSGS.inc(len(spans))
+        _HBM_PLACE_BYTES.inc(total)
         return spans
 
     def _assert_stable(self) -> None:

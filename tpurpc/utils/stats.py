@@ -47,15 +47,26 @@ class _Hist:
         self.buckets[min(63, max(0, ns.bit_length()))] += 1
 
     def percentile(self, q: float) -> float:
-        """Approximate: returns the upper bound of the bucket holding quantile q."""
+        """Log-bucketed percentile with within-bucket interpolation.
+
+        Bucket ``i`` holds values whose bit_length is ``i``, i.e. the
+        half-open range ``[2^(i-1), 2^i)``. The old behavior returned the
+        bucket's UPPER bound, so a reported p50/p99 could run ~2x high;
+        interpolating linearly inside the bucket keeps the error within
+        the bucket's own resolution."""
         if self.count == 0:
             return 0.0
         target = math.ceil(self.count * q)
         seen = 0
         for i, n in enumerate(self.buckets):
+            if not n:
+                continue
+            if seen + n >= target:
+                lo = 0.0 if i == 0 else float(1 << (i - 1))
+                hi = float(1 << i)
+                frac = (target - seen) / n
+                return min(lo + frac * (hi - lo), float(self.max_ns))
             seen += n
-            if seen >= target:
-                return float(1 << i)
         return float(self.max_ns)
 
 
@@ -163,80 +174,26 @@ def print_table() -> str:
 # these counters were per-message they would be part of the problem they
 # measure. The bench reads them to report batch_msgs_per_wakeup and the
 # adaptive poller's spin/sleep ratio (ISSUE 1 acceptance).
+#
+# Since ISSUE 4 the STORE is the tpurpc-scope metrics registry
+# (tpurpc/obs/metrics.py) — these functions are the stable façade PR 1's
+# call sites keep using, with no parallel bookkeeping behind them: the same
+# objects feed the Prometheus scrape endpoint.
 # ---------------------------------------------------------------------------
 
-class BatchHist:
-    """Thread-safe size histogram for per-batch counts.
+from tpurpc.obs import metrics as _metrics  # noqa: E402
 
-    Batch sizes are small integers, so counts are EXACT below
-    ``_EXACT_MAX`` (percentiles come out precise, unlike the log-bucketed
-    latency hist whose bucket upper bounds would double-count small
-    batches); larger sizes clamp into the top bucket."""
-
-    _EXACT_MAX = 4096
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._counts: Dict[int, int] = defaultdict(int)
-        self._total = 0
-        self._n = 0
-        self._max = 0
-
-    def record(self, n: int) -> None:
-        if n <= 0:
-            return
-        with self._lock:
-            self._counts[min(n, self._EXACT_MAX)] += 1
-            self._total += n
-            self._n += 1
-            if n > self._max:
-                self._max = n
-
-    def _percentile_locked(self, q: float) -> int:
-        target = math.ceil(self._n * q)
-        seen = 0
-        for size in sorted(self._counts):
-            seen += self._counts[size]
-            if seen >= target:
-                return size
-        return self._max
-
-    def snapshot(self) -> Dict[str, float]:
-        with self._lock:
-            if self._n == 0:
-                return {"count": 0, "mean": 0.0, "p50": 0, "p99": 0, "max": 0}
-            return {
-                "count": self._n,
-                "mean": round(self._total / self._n, 2),
-                "p50": self._percentile_locked(0.5),
-                "p99": self._percentile_locked(0.99),
-                "max": self._max,
-            }
-
-    def reset(self) -> None:
-        with self._lock:
-            self._counts.clear()
-            self._total = 0
-            self._n = 0
-            self._max = 0
+#: compat alias: PR 1's BatchHist is the registry's exact-count histogram
+BatchHist = _metrics.Histogram
 
 
-_batch_lock = threading.Lock()
-_batch_hists: Dict[str, BatchHist] = {}
-_counters: Dict[str, int] = defaultdict(int)
-_counter_lock = threading.Lock()
-
-
-def batch_hist(name: str) -> BatchHist:
+def batch_hist(name: str) -> "_metrics.Histogram":
     """Named batch-size histogram (created on first use). Canonical names:
     ``ring_drain`` (messages per receive drain), ``ring_write`` (messages
     per gathered send batch), ``h2_data_coalesce`` (DATA frames merged per
-    dispatch)."""
-    with _batch_lock:
-        h = _batch_hists.get(name)
-        if h is None:
-            h = _batch_hists[name] = BatchHist()
-        return h
+    dispatch), ``resp_coalesce`` (responses per gathered server writev),
+    ``fanin_batch`` (rows per dispatched fan-in batch)."""
+    return _metrics.histogram(name, kind="size")
 
 
 def counter_inc(name: str, n: int = 1) -> None:
@@ -244,32 +201,27 @@ def counter_inc(name: str, n: int = 1) -> None:
     ``wait_spin_miss`` (hybrid busy window fired / expired), ``wait_sleep``
     (waiter parked on fds), ``poller_scan_hot`` / ``poller_scan_idle``
     (background scans that found / did not find work)."""
-    with _counter_lock:
-        _counters[name] += n
+    _metrics.counter(name).inc(n)
 
 
 def counters_snapshot() -> Dict[str, int]:
-    with _counter_lock:
-        return dict(_counters)
+    return _metrics.registry().counters_snapshot()
 
 
 def batch_snapshot() -> Dict[str, Dict[str, float]]:
-    with _batch_lock:
-        hists = dict(_batch_hists)
-    return {name: h.snapshot() for name, h in hists.items()}
+    return _metrics.registry().histograms_snapshot()
 
 
 def reset_batch_stats() -> None:
-    """Zero the batch histograms and counters (bench round isolation)."""
-    with _batch_lock:
-        for h in _batch_hists.values():
-            h.reset()
-    with _counter_lock:
-        _counters.clear()
+    """Zero the registry's histograms/counters (bench round isolation)."""
+    _metrics.reset()
 
 
 # ---------------------------------------------------------------------------
 # Copy ledger — new in tpurpc (BASELINE.md target: receive-path host memcpy == 0).
+# Folded onto the metrics registry (ISSUE 4): each category is a registry
+# counter named ``copyledger_<category>``, so the Prometheus endpoint sees
+# the same numbers with zero duplicate accounting.
 # ---------------------------------------------------------------------------
 
 class CopyLedger:
@@ -285,33 +237,29 @@ class CopyLedger:
     CATEGORIES = ("host_copy", "device_dma", "device_alias", "host_staged")
 
     def __init__(self):
-        self._lock = threading.Lock()
-        self.host_copy = 0
-        self.device_dma = 0
-        self.device_alias = 0
-        self.host_staged = 0
+        self._counters = {c: _metrics.counter(f"copyledger_{c}")
+                          for c in self.CATEGORIES}
 
     def add(self, category: str, nbytes: int) -> None:
-        if category not in self.CATEGORIES:
+        c = self._counters.get(category)
+        if c is None:
             raise ValueError(
                 f"unknown copy-ledger category {category!r}; "
                 f"expected one of {self.CATEGORIES}")
-        with self._lock:
-            setattr(self, category, getattr(self, category) + nbytes)
+        c.inc(nbytes)
 
     def reset(self) -> None:
-        with self._lock:
-            self.host_copy = self.device_dma = 0
-            self.device_alias = self.host_staged = 0
+        for c in self._counters.values():
+            c.reset()
 
     def as_dict(self) -> Dict[str, int]:
-        with self._lock:
-            return {
-                "host_copy": self.host_copy,
-                "device_dma": self.device_dma,
-                "device_alias": self.device_alias,
-                "host_staged": self.host_staged,
-            }
+        return {name: c.snapshot() for name, c in self._counters.items()}
+
+    def __getattr__(self, name: str) -> int:
+        counters = self.__dict__.get("_counters") or {}
+        if name in counters:
+            return counters[name].snapshot()
+        raise AttributeError(name)
 
 
 ledger = CopyLedger()

@@ -33,11 +33,22 @@ class TraceFlag:
         _apply_env_to(self)
 
     def __bool__(self) -> bool:
+        if _list_pending:
+            _print_tracers()
         return self.enabled
 
     def log(self, fmt: str, *args) -> None:
+        if _list_pending:
+            _print_tracers()
         if self.enabled:
             _emit("TRACE", f"[{self.name}] " + (fmt % args if args else fmt))
+
+
+#: a ``list_tracers`` token was seen in the trace spec and the registry
+#: dump hasn't printed yet — flushed on the first flag USE (by then the
+#: process's flags are registered), mirroring the reference's
+#: ``GRPC_TRACE=list_tracers`` one-shot listing (trace.cc LogAllTracers)
+_list_pending = False
 
 
 def _trace_spec() -> str:
@@ -46,13 +57,27 @@ def _trace_spec() -> str:
     return _env("TPURPC_TRACE", "GRPC_TRACE") or ""
 
 
+def _print_tracers() -> None:
+    global _list_pending
+    _list_pending = False
+    with _registry_lock:
+        flags = sorted(_registry.items())
+    _emit("INFO", "available tracers:")
+    for name, f in flags:
+        _emit("INFO", f"  {name}: {'on' if f.enabled else 'off'}")
+
+
 def _apply_env_to(flag: TraceFlag) -> None:
+    global _list_pending
     spec = _trace_spec()
     if not spec:
         return
     for tok in spec.split(","):
         tok = tok.strip()
         if not tok:
+            continue
+        if tok == "list_tracers":
+            _list_pending = True
             continue
         neg = tok.startswith("-")
         name = tok[1:] if neg else tok
@@ -62,6 +87,8 @@ def _apply_env_to(flag: TraceFlag) -> None:
 
 def reapply_env() -> None:
     """Re-read the trace env for every registered flag (tests use this)."""
+    global _list_pending
+    _list_pending = False
     with _registry_lock:
         flags = list(_registry.values())
     for f in flags:

@@ -26,10 +26,18 @@ import zlib
 from typing import Dict, List, Optional, Tuple
 
 from tpurpc.core.endpoint import Endpoint, EndpointError
+from tpurpc.obs import metrics as _obs_metrics
 from tpurpc.rpc.status import AbortError, Metadata, StatusCode
 from tpurpc.utils import stats as _stats
 from tpurpc.wire import h2
 from tpurpc.wire.hpack import HpackDecoder, HpackEncoder, HpackError
+
+#: tpurpc-scope (ISSUE 4): live h2 server connections + their send-side
+#: connection window, read at scrape time only (the DATA-coalescing batch
+#: histogram h2_data_coalesce already rides _stats.batch_hist → registry)
+_H2_SRV_CONNS = _obs_metrics.fleet("h2_server_connections")
+_H2_SRV_WINDOW = _obs_metrics.fleet("h2_server_send_window_bytes",
+                                    lambda c: c._conn_window._value)
 
 _log = logging.getLogger("tpurpc.grpc_h2")
 
@@ -208,6 +216,8 @@ class GrpcH2Connection:
         self._preface_left = len(h2.PREFACE) - preface_consumed
         self._headers_frag: Optional[Tuple[int, int, bytearray]] = None
         self.alive = True
+        _H2_SRV_CONNS.track(self)
+        _H2_SRV_WINDOW.track(self)
         self._send_settings()
         self._thread = threading.Thread(target=self._read_loop, daemon=True,
                                         name="tpurpc-h2-reader")

@@ -38,12 +38,19 @@ import time
 from typing import Dict, Iterable, List, Optional, Tuple
 
 from tpurpc.core.endpoint import Endpoint, EndpointError, TcpEndpoint
+from tpurpc.obs import metrics as _obs_metrics
 from tpurpc.rpc.status import Metadata, RpcError, StatusCode
 from tpurpc.utils import stats as _stats
 from tpurpc.wire import h2
 from tpurpc.wire.grpc_h2 import (RECV_WINDOW, _decode_metadata_value,
                                  _encode_metadata_value, decode_grpc_message)
 from tpurpc.wire.hpack import HpackDecoder, HpackEncoder, HpackError
+
+#: tpurpc-scope (ISSUE 4): live h2 client channels + their send-side
+#: connection window — scrape-time reads only
+_H2_CLI_CONNS = _obs_metrics.fleet("h2_client_connections")
+_H2_CLI_WINDOW = _obs_metrics.fleet("h2_client_send_window_bytes",
+                                    lambda c: c._conn_window._value)
 
 _log = logging.getLogger("tpurpc.h2_client")
 
@@ -178,6 +185,8 @@ class H2Channel:
         self._peer_initial_window = h2.DEFAULT_WINDOW
         self._conn_window = h2.FlowWindow(h2.DEFAULT_WINDOW)  # our sends
         self._settings_acked = threading.Event()
+        _H2_CLI_CONNS.track(self)
+        _H2_CLI_WINDOW.track(self)
 
         with self._wlock:
             self._ep.write([h2.PREFACE]
