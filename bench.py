@@ -719,6 +719,100 @@ def _obs_overhead(duration: "float | None" = None, pairs: int = 3) -> dict:
     }
 
 
+def _flight_overhead(duration: "float | None" = None, pairs: int = 2) -> dict:
+    """tpurpc-blackbox overhead gate (ISSUE 5): the ALWAYS-ON postmortem
+    core — flight recorder emitting + stall-watchdog per-RPC registration
+    and background sweeps — versus the same loop with both suppressed.
+    ``flight_overhead_pct`` carries the <3% acceptance gate. By design the
+    recorder emits on state EDGES only (a healthy closed loop produces
+    near-zero events), so the measured cost is the watchdog's dict
+    store/delete per RPC plus the suppressed-emit branch.
+
+    ``tail_capture_pct`` is the INFORMATIONAL cost of tail-based trace
+    capture (every RPC gets a provisional span buffer; spans are recorded
+    and then dropped for healthy calls) — it is a separately-toggleable
+    feature (TPURPC_TRACE_TAIL=0) and is reported, not gated: its price is
+    the same ballpark as obs_traced100_pct, paid to guarantee a span tree
+    for every pathological call at sample rate 0.
+
+    Tail capture is held in its default-ON state for BOTH flight legs so
+    the flight delta isolates the recorder+watchdog; the tail legs then
+    toggle only tail capture with recorder+watchdog on. Same alternation
+    and best-draw-p50 methodology as _obs_overhead."""
+    import io
+
+    from tpurpc.bench import micro
+    from tpurpc.obs import flight, tracing, watchdog
+    from tpurpc.utils import stats as _st
+
+    if duration is None:
+        duration = float(os.environ.get("TPURPC_BENCH_OBS_S", "1.0"))
+    prev_fast = os.environ.get("TPURPC_NATIVE_FAST_UNARY")
+    os.environ["TPURPC_NATIVE_FAST_UNARY"] = "0"
+    srv = micro.run_server(0, max_workers=8)
+    target = f"127.0.0.1:{srv.bench_port}"
+    devnull = io.StringIO()
+    p50s = {"off": [], "on": [], "tail_off": [], "tail_on": []}
+    wd = watchdog.get()
+
+    def leg(key, dur):
+        r = micro.run_client(target, req_size=64, duration=dur, out=devnull)
+        p50s[key].append(r["rtt_us"]["p50"])
+
+    try:
+        tracing.force(None)
+        tracing.configure(0.0)
+        micro.run_client(target, req_size=64, duration=0.3,
+                         out=devnull)  # warm: connect + first-dispatch
+        for i in range(max(1, pairs)):
+            legs = [("off", False), ("on", True)]
+            if i % 2:
+                legs.reverse()
+            for key, enabled in legs:
+                flight.RECORDER.enabled = enabled
+                wd.enabled = enabled
+                leg(key, duration)
+            # tail capture A/B (informational): recorder+watchdog stay on
+            tail_legs = [("tail_off", False), ("tail_on", None)]
+            if i % 2:
+                tail_legs.reverse()
+            for key, mode in tail_legs:
+                tracing.tail(mode)
+                leg(key, duration / 2)
+    finally:
+        flight.RECORDER.enabled = True
+        wd.enabled = True
+        wd.reset()
+        tracing.tail(None)
+        tracing.force(None)
+        tracing.configure(0.0)
+        if prev_fast is None:
+            os.environ.pop("TPURPC_NATIVE_FAST_UNARY", None)
+        else:
+            os.environ["TPURPC_NATIVE_FAST_UNARY"] = prev_fast
+        srv.stop(grace=0)
+        _st.reset_batch_stats()
+        tracing.reset()
+
+    def pct(on_key, off_key):
+        # best-draw p50s: contamination on a shared core is one-sided (see
+        # _obs_overhead.pct) — the minimum of each leg approximates its
+        # uncontended cost
+        off = min(p50s[off_key])
+        on = min(p50s[on_key])
+        return round((on - off) / off * 100, 2) if off else 0.0
+
+    gate = pct("on", "off")
+    return {
+        "flight_overhead_pct": gate,
+        "flight_overhead_gate_pct": 3.0,
+        "flight_overhead_pass": gate < 3.0,
+        "tail_capture_pct": pct("tail_on", "tail_off"),
+        "flight_p50_us": {k: [round(x, 1) for x in sorted(v)]
+                          for k, v in p50s.items()},
+    }
+
+
 def _calibration() -> dict:
     """Tiny host-speed probes so round-over-round artifacts are comparable
     across noisy-neighbor weather (VERDICT r3 weak #1): a memcpy-bandwidth
@@ -879,6 +973,13 @@ def main() -> None:
         except Exception as exc:  # the gate is auxiliary: report, don't fail
             sys.stderr.write(f"obs overhead gate failed: {exc}\n")
             out["obs_overhead_error"] = repr(exc)
+        # tpurpc-blackbox flight-recorder gate (ISSUE 5): recorder+watchdog
+        # always-on vs suppressed; <3% is the acceptance contract.
+        try:
+            out.update(_flight_overhead())
+        except Exception as exc:
+            sys.stderr.write(f"flight overhead gate failed: {exc}\n")
+            out["flight_overhead_error"] = repr(exc)
     if fallback:
         # Loud, unmissable: this artifact measured the CPU fallback, not the
         # chip — the number is NOT comparable to an accelerator run (and the

@@ -85,6 +85,80 @@ def test_garbage_and_churn_peers_dont_break_service(monkeypatch, platform):
     srv.stop(grace=0)
 
 
+@pytest.mark.parametrize("platform", ["TCP", "RDMA_BPEV"])
+def test_server_death_reconnect_flight_sequence(monkeypatch, platform):
+    """tpurpc-blackbox (ISSUE 5): the flight recorder must replay the
+    server-death/reconnect story IN ORDER — connection death, the
+    subchannel's re-dial, and the first successful call on the fresh
+    connection — on both the TCP and ring (RDMA_BPEV) platforms. This is
+    the postmortem the recorder exists for: after the incident, the event
+    ring alone reconstructs what happened and when."""
+    from tpurpc.obs import flight
+
+    monkeypatch.setenv("GRPC_PLATFORM_TYPE", platform)
+    from tpurpc.utils import config as config_mod
+
+    config_mod.set_config(None)
+    flight.RECORDER.reset()
+
+    srv, port = _echo_server()
+    with tps.Channel(f"127.0.0.1:{port}") as ch:
+        # pin to the Python transport: the lifecycle events under test
+        # (conn-dead / reconnect / call-first-ok) are its subchannel
+        # machinery; the native fast path has its own (slower) death
+        # detection that would only add timing noise here
+        mc = ch.unary_unary("/c.S/Echo", tpurpc_native=False)
+        assert bytes(mc(b"warm", timeout=30)) == b"warm"
+        t_kill = time.monotonic_ns()
+        srv.stop(grace=0)
+        # the in-flight-less death may surface on the next call attempt
+        with pytest.raises(RpcError):
+            for _ in range(20):
+                mc(b"probe", timeout=5)
+                time.sleep(0.05)
+        # revive a server on the SAME port; the channel's backoff redials
+        deadline = time.monotonic() + 20
+        srv2 = None
+        while srv2 is None and time.monotonic() < deadline:
+            try:
+                srv2 = tps.Server(max_workers=4)
+                srv2.add_method("/c.S/Echo", tps.unary_unary_rpc_method_handler(
+                    lambda req, ctx: req))
+                srv2.add_insecure_port(f"127.0.0.1:{port}")
+                srv2.start()
+            except OSError:
+                srv2 = None
+                time.sleep(0.2)
+        assert srv2 is not None, "could not rebind the port"
+        try:
+            deadline = time.monotonic() + 20
+            while True:
+                try:
+                    assert bytes(mc(b"back", timeout=5)) == b"back"
+                    break
+                except RpcError:
+                    if time.monotonic() >= deadline:
+                        raise
+                    time.sleep(0.1)
+            # the ordered postmortem: death -> re-dial -> first OK call,
+            # all after the kill stamp (warmup events precede it)
+            events = [(e["event"], e["t_ns"]) for e in flight.snapshot()]
+            def first_after(name, t):
+                for ev, t_ns in events:
+                    if ev == name and t_ns >= t:
+                        return t_ns
+                return None
+            t_dead = first_after("conn-dead", t_kill)
+            assert t_dead is not None, events
+            t_re = first_after("reconnect", t_dead)
+            assert t_re is not None, events
+            t_ok = first_after("call-first-ok", t_re)
+            assert t_ok is not None, events
+            assert t_dead <= t_re <= t_ok
+        finally:
+            srv2.stop(grace=0)
+
+
 def test_server_death_mid_streams_fails_calls_cleanly():
     """Kill the server while many streaming calls are in flight: every call
     must terminate with a status (UNAVAILABLE/CANCELLED), never hang."""

@@ -1,0 +1,489 @@
+"""tpurpc-blackbox (ISSUE 5): flight recorder, stall watchdog, tail capture.
+
+Covers the tentpole's three pieces — the binary event ring (bounds, wrap,
+preallocated-encoder reuse, tag interning), the stall watchdog (stage
+attribution from flight tail + fleet gauges, trip side effects, clearing),
+and tail-based trace capture (promotion on slow/error/flag, drop on
+healthy) — plus the satellites: RED counters, the pipelined deadline
+counter + flight event, the /debug scrape routes, degraded /healthz, and
+the `flight` lint rule.
+"""
+
+import json
+import struct
+import threading
+import time
+
+import pytest
+
+from tpurpc.obs import flight, metrics, scrape, tracing, watchdog
+from tpurpc.obs.flight import FlightRecorder
+
+
+@pytest.fixture(autouse=True)
+def _clean_blackbox_state():
+    flight.RECORDER.reset()
+    tracing.reset()
+    tracing.force(None)
+    tracing.configure(0.0)
+    wd = watchdog.get()
+    wd.reset()
+    prev = (wd.min_stall_s, wd.sweep_s, wd.mult, wd.enabled)
+    yield
+    wd.min_stall_s, wd.sweep_s, wd.mult, wd.enabled = prev
+    wd.reset()
+    flight.RECORDER.reset()
+    tracing.reset()
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: ring bounds, wrap, encoder reuse, tags
+# ---------------------------------------------------------------------------
+
+def test_ring_wrap_keeps_newest_and_stays_bounded():
+    rec = FlightRecorder(capacity=8)
+    for i in range(20):
+        rec.emit(flight.PAIR_CONNECT, 0, i)
+    events = rec.snapshot()
+    assert len(events) == 8  # exactly the capacity survives a wrap
+    assert [e["a1"] for e in events] == list(range(12, 20))  # newest 8
+    assert len(rec._buf) == 8 * flight.RECORD_BYTES  # fixed-size, no growth
+
+
+def test_encoder_reuse_no_reallocation():
+    rec = FlightRecorder(capacity=16)
+    buf_id = id(rec._buf)
+    for i in range(100):
+        rec.emit(flight.BATCH_FLUSH, 0, i % 4, i)
+    assert id(rec._buf) is not None and id(rec._buf) == buf_id
+    assert len(rec._buf) == 16 * flight.RECORD_BYTES
+    # disabled recorder emits nothing (the bench's off leg)
+    rec.enabled = False
+    before = rec.snapshot()
+    rec.emit(flight.PAIR_CONNECT, 0, 1)
+    assert rec.snapshot() == before
+
+
+def test_record_fields_roundtrip_and_time_order():
+    rec = FlightRecorder(capacity=64)
+    rec.emit(flight.LEASE_RESERVE, 3, 12345, -7)
+    rec.emit(flight.LEASE_COMMIT, 3, 12345)
+    events = rec.snapshot()
+    assert [e["event"] for e in events] == ["lease-reserve", "lease-commit"]
+    e = events[0]
+    assert (e["tag"], e["a1"], e["a2"]) == (3, 12345, -7)
+    assert e["tid"] == threading.get_ident() & 0xFFFFFFFF
+    assert events[0]["t_ns"] <= events[1]["t_ns"]
+    # huge args clamp instead of raising (emit must never throw)
+    rec.emit(flight.PAIR_CONNECT, 0, 1 << 80, -(1 << 80))
+    got = rec.snapshot()[-1]
+    assert got["a1"] == (1 << 63) - 1 and got["a2"] == -(1 << 63)
+
+
+def test_torn_records_are_skipped():
+    rec = FlightRecorder(capacity=8)
+    rec.emit(flight.PAIR_CONNECT, 1)
+    # simulate a torn slot: plausible timestamp, garbage code
+    struct.pack_into("<QHHIqq", rec._buf, flight.RECORD_BYTES,
+                     time.monotonic_ns(), 9999, 0, 0, 0, 0)
+    events = rec.snapshot()
+    assert [e["event"] for e in events] == ["pair-connect"]
+
+
+def test_tag_interning_is_stable_and_bounded():
+    t1 = flight.tag_for("pair:abc")
+    t2 = flight.tag_for("pair:abc")
+    t3 = flight.tag_for("pair:def")
+    assert t1 == t2 != t3
+    assert flight.tag_name(t1) == "pair:abc"
+    assert flight.tag_name(10 ** 6).startswith("#")  # unknown: no KeyError
+
+
+def test_dump_text_renders_every_event():
+    rec = FlightRecorder(capacity=8)
+    rec.emit(flight.WRITE_STALL_BEGIN, flight.tag_for("pair:dump"), 42)
+    text = rec.dump_text()
+    assert "write-stall-begin" in text and "pair:dump" in text
+
+
+# ---------------------------------------------------------------------------
+# transport emission: a real stalled pair leaves the right evidence
+# ---------------------------------------------------------------------------
+
+def test_pair_stall_emits_edge_events():
+    from tpurpc.core.pair import create_loopback_pair
+
+    a, b = create_loopback_pair(ring_size=4096)
+    try:
+        sent = a.send([b"z" * 16384])
+        assert sent < 16384 and a.want_write
+        names = [e["event"] for e in flight.snapshot()]
+        assert "write-stall-begin" in names
+        assert "credit-starve-begin" in names
+        # drain + resume: the end edges land
+        b.recv(1 << 20)
+        a.send([b"tail"])
+        names = [e["event"] for e in flight.snapshot()]
+        assert "write-stall-end" in names
+        assert "credit-starve-end" in names
+    finally:
+        a.destroy()
+        b.destroy()
+
+
+# ---------------------------------------------------------------------------
+# stall watchdog: attribution, trip side effects, clearing
+# ---------------------------------------------------------------------------
+
+def _fast_wd():
+    wd = watchdog.get()
+    wd.enabled = True
+    wd.min_stall_s = 0.01
+    wd.sweep_s = 0.05
+    return wd
+
+
+def test_watchdog_attributes_held_lease_as_credit_starvation():
+    wd = _fast_wd()
+    tag = flight.tag_for("nclease")
+    flight.emit(flight.LEASE_RESERVE, tag, 4096)  # reserve, never commit
+    tok = wd.call_started("/t/Lease")
+    time.sleep(0.02)
+    diags = wd.sweep_once()
+    assert diags and diags[0]["stage"] == "credit-starvation"
+    assert "send-lease held" in diags[0]["detail"]
+    wd.call_finished(tok)
+    assert wd.sweep_once() == []
+
+
+def test_watchdog_attributes_h2_flow_control():
+    wd = _fast_wd()
+    flight.emit(flight.H2_WINDOW_EXHAUSTED, flight.tag_for("h2srv:t"), 7)
+    tok = wd.call_started("/t/H2")
+    time.sleep(0.02)
+    diags = wd.sweep_once()
+    assert diags and diags[0]["stage"] == "h2-flow-control"
+    wd.call_finished(tok)
+
+
+def test_watchdog_quiet_transport_names_device_infer():
+    wd = _fast_wd()
+    tok = wd.call_started("/t/Infer")
+    time.sleep(0.02)
+    diags = wd.sweep_once()
+    assert diags and diags[0]["stage"] == "device-infer"
+    wd.call_finished(tok)
+    assert wd.sweep_once() == []
+
+
+def test_watchdog_trip_side_effects():
+    wd = _fast_wd()
+    trips0 = metrics.counter("watchdog_trips").snapshot()
+    tctx = tracing.maybe_sample()  # provisional (sample rate 0, tail on)
+    assert tctx is not None and tctx.provisional
+    with tracing.use(tctx):
+        with tracing.span("stuck-phase"):
+            pass
+    assert tracing.spans(tctx.trace_id) == []  # still buffered
+    tok = wd.call_started("/t/Trip", tctx.trace_id)
+    time.sleep(0.02)
+    diags = wd.sweep_once()
+    assert diags
+    # trip: counter bumped, flight event emitted, trace promoted LIVE
+    assert metrics.counter("watchdog_trips").snapshot() == trips0 + 1
+    assert any(e["event"] == "watchdog-trip" for e in flight.snapshot())
+    assert [s["name"] for s in tracing.spans(tctx.trace_id)] == \
+        ["stuck-phase"]
+    # second sweep does NOT re-trip (one trip per stalled call)
+    wd.sweep_once()
+    assert metrics.counter("watchdog_trips").snapshot() == trips0 + 1
+    labeled = metrics.labeled_counter("watchdog_stalls", ("stage",))
+    assert sum(labeled.snapshot().values()) >= 1
+    wd.call_finished(tok)
+
+
+def test_watchdog_respects_rolling_p99_bar():
+    wd = _fast_wd()
+    wd.min_stall_s = 0.05
+    wd.mult = 100.0
+    # history: ~1ms calls → bar = max(min_stall, 100 * ~1ms) ≈ 0.1s+
+    for _ in range(16):
+        t = wd.call_started("/t/Fast")
+        time.sleep(0.001)
+        wd.call_finished(t)
+    assert wd.slow_threshold_ns("/t/Fast") is not None
+    tok = wd.call_started("/t/Fast")
+    time.sleep(0.06)  # over min_stall but under the p99 multiple
+    assert wd.sweep_once() == []
+    wd.call_finished(tok)
+
+
+# ---------------------------------------------------------------------------
+# tail capture: promotion rules
+# ---------------------------------------------------------------------------
+
+def test_tail_slow_call_promotes_fast_call_drops():
+    ctx_fast = tracing.maybe_sample()
+    ctx_slow = tracing.maybe_sample()
+    for ctx in (ctx_fast, ctx_slow):
+        with tracing.use(ctx):
+            with tracing.span("work"):
+                pass
+    assert not tracing.tail_decide(ctx_fast, 1_000_000, method="/t/M")
+    assert tracing.tail_decide(ctx_slow, 10 ** 12, method="/t/M")
+    assert tracing.spans(ctx_fast.trace_id) == []
+    assert [s["name"] for s in tracing.spans(ctx_slow.trace_id)] == ["work"]
+    # post-commit spans land directly in the main ring
+    tracing.record("late", ctx_slow, 1, 2)
+    assert len(tracing.spans(ctx_slow.trace_id)) == 2
+
+
+def test_tail_error_promotes():
+    ctx = tracing.maybe_sample()
+    with tracing.use(ctx):
+        with tracing.span("failing"):
+            pass
+    assert tracing.tail_decide(ctx, 1_000, error=True)
+    assert [s["name"] for s in tracing.spans(ctx.trace_id)] == ["failing"]
+
+
+def test_tail_p99_multiple_tightens_static_bar():
+    wd = _fast_wd()
+    wd.mult = 2.0
+    for _ in range(16):
+        t = wd.call_started("/t/Tight")
+        wd.call_finished(t)  # ~0 duration history
+    ctx = tracing.maybe_sample()
+    with tracing.use(ctx):
+        with tracing.span("outlier"):
+            pass
+    # 5ms is far under the 250ms static bar but far over 2 x p99(~µs)
+    assert tracing.tail_decide(ctx, 5_000_000, method="/t/Tight")
+
+
+def test_tail_pending_is_bounded():
+    first = tracing.maybe_sample()
+    for _ in range(tracing._PENDING_TRACES + 10):
+        ctx = tracing.maybe_sample()
+        with tracing.use(ctx):
+            tracing.record("s", ctx, 1, 1)
+    assert tracing.tail_pending() <= tracing._PENDING_TRACES
+    # the oldest trace was evicted; committing it now yields nothing
+    tracing.tail_commit(first.trace_id)
+    assert tracing.spans(first.trace_id) == []
+
+
+def test_wire_context_adopt_registers_provisional():
+    ctx = tracing.TraceContext(0xABC, 1, provisional=True)
+    assert ctx.encode().endswith("-2")
+    got = tracing.adopt(ctx.encode())
+    assert got is not None and got.provisional and got.sampled
+    with tracing.use(got):
+        with tracing.span("server-side"):
+            pass
+    assert tracing.spans(0xABC) == []  # buffered under the SAME trace id
+    tracing.tail_commit(0xABC)
+    assert [s["name"] for s in tracing.spans(0xABC)] == ["server-side"]
+    # non-provisional wire flags stay committed-style
+    assert not tracing.adopt(
+        tracing.TraceContext(1, 2, True).encode()).provisional
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: RED counters, deadline satellite, scrape routes
+# ---------------------------------------------------------------------------
+
+def _echo_server(hold=None):
+    from tpurpc.rpc.server import Server, unary_unary_rpc_method_handler
+    from tpurpc.rpc.status import StatusCode
+
+    srv = Server(max_workers=4)
+
+    def echo(req, ctx):
+        if hold is not None:
+            hold.wait(5)
+        return bytes(req)
+
+    def boom(req, ctx):
+        ctx.abort(StatusCode.INVALID_ARGUMENT, "nope")
+
+    srv.add_method("/f.S/Echo", unary_unary_rpc_method_handler(echo))
+    srv.add_method("/f.S/Boom", unary_unary_rpc_method_handler(boom))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    return srv, port
+
+
+def test_red_counters_per_method_per_code():
+    from tpurpc.rpc.channel import Channel
+    from tpurpc.rpc.status import RpcError
+
+    srv, port = _echo_server()
+    try:
+        fam = metrics.labeled_counter("srv_calls", ("method", "code"))
+        before_ok = fam.snapshot().get(("/f.S/Echo", "0"), 0)
+        before_bad = fam.snapshot().get(("/f.S/Boom", "3"), 0)
+        with Channel(f"127.0.0.1:{port}") as ch:
+            mc = ch.unary_unary("/f.S/Echo", tpurpc_native=False)
+            for _ in range(3):
+                assert mc(b"ok", timeout=20) == b"ok"
+            with pytest.raises(RpcError):
+                ch.unary_unary("/f.S/Boom", tpurpc_native=False)(
+                    b"x", timeout=20)
+        # the RED bump lands in the server handler's finally, which can
+        # trail the client-visible trailer by a beat — poll briefly
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            snap = fam.snapshot()
+            if (snap.get(("/f.S/Echo", "0"), 0) >= before_ok + 3
+                    and snap.get(("/f.S/Boom", "3"), 0) >= before_bad + 1):
+                break
+            time.sleep(0.02)
+        assert snap.get(("/f.S/Echo", "0"), 0) >= before_ok + 3
+        assert snap.get(("/f.S/Boom", "3"), 0) >= before_bad + 1
+        # the Prometheus face renders the labels
+        text = scrape.render_prometheus()
+        assert 'tpurpc_srv_calls{method="/f.S/Echo",code="0"}' in text
+    finally:
+        srv.stop(grace=0)
+
+
+def test_pipelined_deadline_expiry_counter_and_flight_event():
+    from tpurpc.rpc.channel import Channel
+    from tpurpc.rpc.status import RpcError, StatusCode
+
+    hold = threading.Event()
+    srv, port = _echo_server(hold=hold)
+    try:
+        fam = metrics.labeled_counter("deadline_exceeded", ("method",))
+        before = fam.snapshot().get(("/f.S/Echo",), 0)
+        with Channel(f"127.0.0.1:{port}") as ch:
+            pl = ch.unary_unary("/f.S/Echo").pipeline(depth=2)
+            fut = pl.call_async(b"wedge", timeout=0.2)
+            with pytest.raises(RpcError) as ei:
+                fut.result(20)
+            assert ei.value.code() is StatusCode.DEADLINE_EXCEEDED
+        hold.set()
+        assert fam.snapshot().get(("/f.S/Echo",), 0) >= before + 1
+        assert any(e["event"] == "deadline-expired"
+                   for e in flight.snapshot())
+    finally:
+        hold.set()
+        srv.stop(grace=0)
+
+
+def test_debug_routes_and_healthz_degradation():
+    flight.emit(flight.PAIR_CONNECT, flight.tag_for("pair:route"), 1)
+    status, ctype, body = scrape._route("/debug/flight")
+    assert status == 200 and ctype == "application/json"
+    events = json.loads(body)["events"]
+    assert any(e["event"] == "pair-connect" and e["entity"] == "pair:route"
+               for e in events)
+    status, _, body = scrape._route("/debug/flight?text=1")
+    assert status == 200 and b"pair-connect" in body
+
+    status, ctype, body = scrape._route("/debug/stalls")
+    assert status == 200
+    snap = json.loads(body)
+    assert {"active", "history", "inflight"} <= set(snap)
+
+    # healthz: ok when quiet, degraded (503) while a diagnosis is active
+    wd = _fast_wd()
+    assert scrape._route("/healthz")[0] == 200
+    tok = wd.call_started("/t/Health")
+    time.sleep(0.02)
+    wd.sweep_once()
+    status, _, body = scrape._route("/healthz")
+    assert status == 503 and b"degraded" in body and b"/t/Health" in body
+    wd.call_finished(tok)
+    wd.sweep_once()
+    assert scrape._route("/healthz")[0] == 200
+
+
+def test_tail_capture_end_to_end_sample_zero():
+    """TPURPC_TRACE_SAMPLE=0: a slow RPC yields a committed span tree (the
+    acceptance property), a fast RPC leaves the main ring untouched."""
+    from tpurpc.rpc.channel import Channel
+
+    hold = threading.Event()
+    srv, port = _echo_server(hold=hold)
+    try:
+        assert not tracing.ACTIVE and tracing.LIVE
+        hold.set()  # fast path first
+        with Channel(f"127.0.0.1:{port}") as ch:
+            mc = ch.unary_unary("/f.S/Echo", tpurpc_native=False)
+            assert mc(b"fast", timeout=20) == b"fast"
+            fast_traces = {s["trace_id"] for s in tracing.spans()}
+            hold.clear()
+
+            def release():
+                time.sleep(0.45)  # > the 250ms static tail bar
+                hold.set()
+
+            threading.Thread(target=release, daemon=True).start()
+            assert mc(b"slow", timeout=30) == b"slow"
+        deadline = time.monotonic() + 2
+        names = set()
+        while time.monotonic() < deadline:
+            by_trace = {}
+            for s in tracing.spans():
+                if s["trace_id"] in fast_traces:
+                    continue
+                by_trace.setdefault(s["trace_id"], set()).add(s["name"])
+            names = set().union(*by_trace.values()) if by_trace else set()
+            if {"client-send", "wire", "dispatch", "respond"} <= names:
+                break
+            time.sleep(0.05)
+        assert {"client-send", "wire", "dispatch", "respond"} <= names, names
+    finally:
+        hold.set()
+        srv.stop(grace=0)
+
+
+# ---------------------------------------------------------------------------
+# the `flight` lint rule
+# ---------------------------------------------------------------------------
+
+HOT = "tpurpc/core/pair.py"  # any FLIGHT_HOT_MODULES suffix
+
+
+def _lint(src):
+    from tpurpc.analysis.lint import lint_source
+
+    return [v for v in lint_source(src, HOT) if v.rule == "flight"]
+
+
+def test_flight_lint_accepts_preallocated_int_plumbing():
+    src = (
+        "def f(self):\n"
+        "    _flight.emit(_flight.PAIR_CONNECT, self._ftag,\n"
+        "                 self.writer.tail - self.writer.remote_head)\n")
+    assert _lint(src) == []
+
+
+def test_flight_lint_rejects_dict_fstring_call_and_str():
+    bad = [
+        "_flight.emit(_flight.PAIR_CONNECT, 0, {'k': 1})\n",
+        "_flight.emit(_flight.PAIR_CONNECT, 0, f'{x}')\n",
+        "_flight.emit(_flight.PAIR_CONNECT, tag_for(self.tag))\n",
+        "_flight.emit(_flight.PAIR_CONNECT, 0, len(views))\n",
+        "_flight.emit(_flight.PAIR_CONNECT, 0, 'stringy')\n",
+        "flight.RECORDER.emit(_flight.PAIR_CONNECT, str(x))\n",
+    ]
+    for src in bad:
+        assert _lint(src), f"should flag: {src!r}"
+
+
+def test_flight_lint_suppression_and_cold_modules():
+    src = "_flight.emit(C, 0, len(x))  # tpr: allow(flight)\n"
+    assert _lint(src) == []
+    from tpurpc.analysis.lint import lint_source
+
+    cold = lint_source("_flight.emit(C, 0, len(x))\n", "tpurpc/rpc/aio.py")
+    assert [v for v in cold if v.rule == "flight"] == []
+
+
+def test_repo_tree_is_flight_clean():
+    from tpurpc.analysis.lint import lint_tree
+
+    assert [v for v in lint_tree() if v.rule == "flight"] == []

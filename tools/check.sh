@@ -45,6 +45,15 @@ python -m tpurpc.tools.serving_smoke || fail=1
 note "tpurpc-scope metrics smoke (scrape + spans)"
 python -m tpurpc.tools.obs_smoke || fail=1
 
+# 2d) tpurpc-blackbox watchdog smoke (ISSUE 5): with TPURPC_TRACE_SAMPLE=0,
+#     wedge a ring sender and a handler on purpose — the stall watchdog
+#     must diagnose each within two sweep periods naming the right stage
+#     (credit-starvation / device-infer), the wedged call's span tree must
+#     exist via tail capture, and /debug/flight must replay the ordered
+#     event sequence. ~1.5s, no jax.
+note "tpurpc-blackbox watchdog smoke (wedge + diagnose + tail capture)"
+python -m tpurpc.tools.watchdog_smoke || fail=1
+
 # 3) the analysis subsystem's own tests, plus a lock-order-instrumented run
 #    of the concurrency-heavy suites (TPURPC_DEBUG_LOCKS exercises the
 #    CheckedLock shim wired into poller/pair/xds/channel/channelz)

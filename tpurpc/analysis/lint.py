@@ -40,6 +40,14 @@ hand (ISSUE 2) and that no general-purpose linter knows about:
   %-formatting and string building never run on the fast path when
   tracing is off. ``log_error`` is exempt (error paths are cold by
   definition). Deliberate exceptions carry ``# tpr: allow(log)``.
+* ``flight``   — flight-recorder emission sites in the same hot modules
+  must use the preallocated event encoder as designed: arguments to
+  ``*flight*.emit(...)`` may be names, attributes, numeric constants and
+  arithmetic over them — never dict/list/set/tuple displays, f-strings,
+  string/bytes constants, comprehensions, or nested CALLS (a ``str()``,
+  ``format()``, ``tag_for()`` or even ``len()`` in the argument list is
+  per-event work the always-on recorder must not pay; precompute the int
+  on a cold path). Deliberate exceptions carry ``# tpr: allow(flight)``.
 
 Suppression grammar: a line comment ``# tpr: allow(<rule>)`` disables that
 rule for its line. The hot-path modules are expected to carry NO ``copy``
@@ -70,6 +78,11 @@ HOT_LOG_MODULES = (
     os.path.join("tpurpc", "core", "poller.py"),
     os.path.join("tpurpc", "wire", "grpc_h2.py"),
 )
+
+#: modules whose flight-recorder emission sites must stay on the
+#: preallocated-encoder discipline (ISSUE 5 — the recorder is ALWAYS on,
+#: so any per-event construction here is a permanent hot-path tax)
+FLIGHT_HOT_MODULES = HOT_LOG_MODULES
 
 #: module suffix -> qualified functions on its INLINE DISPATCH path (the
 #: reactor invocation from _ServerSink.commit: these run on the connection
@@ -318,6 +331,72 @@ def _check_log(tree: ast.AST, path: str,
     return out
 
 
+# -- rule: flight -------------------------------------------------------------
+
+#: node types allowed inside a flight-emit argument: plain value reads and
+#: integer arithmetic over them — nothing that allocates or calls
+_FLIGHT_BANNED = (ast.Dict, ast.Set, ast.List, ast.Tuple, ast.JoinedStr,
+                  ast.FormattedValue, ast.Call, ast.ListComp, ast.SetComp,
+                  ast.DictComp, ast.GeneratorExp, ast.Lambda, ast.Starred)
+
+
+def _is_flight_emit(node: ast.Call) -> bool:
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr == "emit":
+        base = f.value
+        if isinstance(base, ast.Name) and "flight" in base.id.lower():
+            return True
+        if isinstance(base, ast.Attribute) and "flight" in base.attr.lower():
+            return True  # e.g. flight.RECORDER.emit — RECORDER's owner
+        # RECORDER.emit / self._recorder.emit shapes
+        if isinstance(base, ast.Name) and "recorder" in base.id.lower():
+            return True
+        if (isinstance(base, ast.Attribute)
+                and "recorder" in base.attr.lower()):
+            return True
+    if isinstance(f, ast.Name) and "flight_emit" in f.id:
+        return True
+    return False
+
+
+def _flight_arg_violation(arg: ast.AST) -> Optional[str]:
+    for node in ast.walk(arg):
+        if isinstance(node, _FLIGHT_BANNED):
+            return (f"builds a {type(node).__name__} per event")
+        if isinstance(node, ast.Constant) and isinstance(
+                node.value, (str, bytes)):
+            return "passes a str/bytes constant (events carry ints; "\
+                   "intern strings once with tag_for on a cold path)"
+    return None
+
+
+def _check_flight(tree: ast.AST, path: str,
+                  lines: Sequence[str]) -> List[LintViolation]:
+    """Flight-recorder emission sites must be pure int plumbing: the
+    recorder is ALWAYS on, so allocation/calls in an emit argument are a
+    permanent per-event cost the preallocated encoder exists to avoid."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not _is_flight_emit(node):
+            continue
+        if "flight" in _allowed_rules(lines, node.lineno):
+            continue
+        args = list(node.args) + [k.value for k in node.keywords]
+        for arg in args:
+            why = _flight_arg_violation(arg)
+            if why is None:
+                continue
+            out.append(LintViolation(
+                path, node.lineno, node.col_offset, "flight",
+                f"flight emit argument {why}: the always-on recorder's "
+                "hot path must stay on the preallocated encoder — "
+                "precompute ints (tag_for at connect time, lengths on the "
+                "cold path); a deliberate exception carries "
+                "'# tpr: allow(flight)'"))
+            break
+    return out
+
+
 # -- rule: lock --------------------------------------------------------------
 
 def _guarded_by_decl(cls: ast.ClassDef) -> Dict[str, Tuple[str, ...]]:
@@ -549,10 +628,12 @@ def _check_lease_region(fn, reserves, commits, path) -> List[LintViolation]:
 
 def lint_source(source: str, path: str,
                 hot_copy: Optional[bool] = None,
-                hot_log: Optional[bool] = None) -> List[LintViolation]:
-    """Lint one module's source. ``hot_copy``/``hot_log`` force/suppress
-    the no-copy and guarded-logging rules (default: decided by ``path``
-    suffix against HOT_COPY_MODULES / HOT_LOG_MODULES)."""
+                hot_log: Optional[bool] = None,
+                hot_flight: Optional[bool] = None) -> List[LintViolation]:
+    """Lint one module's source. ``hot_copy``/``hot_log``/``hot_flight``
+    force/suppress the no-copy, guarded-logging and flight-encoder rules
+    (default: decided by ``path`` suffix against HOT_COPY_MODULES /
+    HOT_LOG_MODULES / FLIGHT_HOT_MODULES)."""
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError as exc:
@@ -572,6 +653,11 @@ def lint_source(source: str, path: str,
             tuple(m.replace(os.sep, "/") for m in HOT_LOG_MODULES))
     if hot_log:
         out.extend(_check_log(tree, path, lines))
+    if hot_flight is None:
+        hot_flight = path.replace("\\", "/").endswith(
+            tuple(m.replace(os.sep, "/") for m in FLIGHT_HOT_MODULES))
+    if hot_flight:
+        out.extend(_check_flight(tree, path, lines))
     norm = path.replace("\\", "/")
     for suffix, fns in INLINE_DISPATCH_PATH.items():
         if norm.endswith(suffix.replace(os.sep, "/")):

@@ -44,6 +44,7 @@ import numpy as np
 
 from tpurpc.analysis.locks import make_lock
 from tpurpc.core import _native
+from tpurpc.obs import flight as _flight
 from tpurpc.obs import metrics as _metrics
 from tpurpc.obs import tracing as _tracing
 from tpurpc.tpu import ledger as ring_ledger
@@ -59,6 +60,13 @@ _PAIRS_CONNECTED = _metrics.fleet(
     "pairs_connected", lambda p: 1.0 if p.state.name == "CONNECTED" else 0.0)
 _PAIRS_WRITE_STALLED = _metrics.fleet(
     "pairs_write_stalled", lambda p: 1.0 if p.want_write else 0.0)
+# tpurpc-blackbox (ISSUE 5): a CONNECTED pair with a complete message
+# sitting undrained — the watchdog's poller-wake-latency evidence. Scrape/
+# sweep-time only; has_message is a header peek (native scan when built).
+_PAIRS_MSG_WAITING = _metrics.fleet(
+    "pairs_msg_waiting",
+    lambda p: 1.0 if (p.state.name == "CONNECTED" and p.has_message())
+    else 0.0)
 from tpurpc.utils.trace import trace_ring
 
 _U64 = struct.Struct("<Q")
@@ -496,8 +504,15 @@ class Pair:
 
         # serializes notify-socket writes
         self._notify_lock = make_lock("Pair._notify_lock")
+        #: tpurpc-blackbox: interned flight-recorder tag (ints on the hot
+        #: path) + open credit-starvation edge + adaptive-poll mode, all
+        #: edge-triggered so a healthy pair emits nothing per message
+        self._ftag = _flight.tag_for("pair:" + self.tag)
+        self._starve_open = False
+        self._flight_mode = "bp"
         _PAIRS_CONNECTED.track(self)
         _PAIRS_WRITE_STALLED.track(self)
+        _PAIRS_MSG_WAITING.track(self)
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -585,7 +600,9 @@ class Pair:
         self.peer_caps = peer.caps
         self.writer = RingWriter(peer.ring_size, self._peer_ring.write,
                                  mapped=self._peer_ring.view)
+        self.writer.flight_tag = self._ftag
         self.state = PairState.CONNECTED
+        _flight.emit(_flight.PAIR_CONNECT, self._ftag, peer.ring_size)
         trace_ring.log("pair %s connected (peer tag %s, ring %d)",
                        self.tag, peer.tag, peer.ring_size)
 
@@ -928,7 +945,7 @@ class Pair:
         if self.state is not PairState.CONNECTED:
             raise BrokenPipeError(f"pair {self.tag} not sendable: {self.state}"
                                   + (f" ({self.error})" if self.error else ""))
-        if _tracing.ACTIVE and _tracing.current() is not None:
+        if _tracing.LIVE and _tracing.current() is not None:
             # traced call on this thread: the ring-encode interval is the
             # "send-lease" span of the per-RPC timeline (SURVEY §7 #4)
             with _tracing.span("send-lease"):
@@ -942,6 +959,33 @@ class Pair:
         return self._send_profiled(slices, byte_idx)
 
     def _send_profiled(self, slices: Sequence, byte_idx: int = 0) -> int:
+        # tpurpc-blackbox: emit want_write EDGES only (stall begin/end) —
+        # the bool compare in the finally is the whole per-send cost
+        was_stalled = self.want_write
+        try:
+            return self._send_inner(slices, byte_idx)
+        finally:
+            now_stalled = self.want_write
+            if now_stalled != was_stalled:
+                if now_stalled:
+                    _flight.emit(_flight.WRITE_STALL_BEGIN, self._ftag)
+                    # distinguish "partial send re-armed" from "writer is
+                    # OUT of credits" — every fast/slow path that stalls
+                    # with zero writable payload is a starvation edge
+                    w = self.writer
+                    if (w is not None and not self._starve_open
+                            and w.writable_payload() == 0):
+                        self._starve_open = True
+                        inflight = w.tail - w.remote_head
+                        _flight.emit(_flight.CREDIT_STARVE_BEGIN,
+                                     self._ftag, inflight)
+                else:
+                    _flight.emit(_flight.WRITE_STALL_END, self._ftag)
+                    if self._starve_open:
+                        self._starve_open = False
+                        _flight.emit(_flight.CREDIT_STARVE_END, self._ftag)
+
+    def _send_inner(self, slices: Sequence, byte_idx: int = 0) -> int:
         cfg = get_config()
         with self._send_guard:
             views: List[memoryview] = []
@@ -968,6 +1012,11 @@ class Pair:
                 budget = self.writer.writable_payload()
                 if budget == 0:
                     self.want_write = True
+                    if not self._starve_open:
+                        self._starve_open = True
+                        _flight.emit(_flight.CREDIT_STARVE_BEGIN, self._ftag,
+                                     self.writer.tail
+                                     - self.writer.remote_head)
                     break
                 chunks: List[List[memoryview]] = []
                 n = 0
@@ -1224,11 +1273,13 @@ class Pair:
                 self._notify(NOTIFY_EXIT)
             except Exception:
                 pass
+            _flight.emit(_flight.PAIR_DISCONNECT, self._ftag)
         self.state = PairState.DISCONNECTED
 
     def _mark_error(self, why: str) -> None:
         if self.state not in (PairState.DISCONNECTED,):
             self.state = PairState.ERROR
+            _flight.emit(_flight.PEER_DEATH, self._ftag)
         if self.error is None:
             self.error = why
         # Waiters may be blocked in an uncapped select; the state change IS

@@ -22,6 +22,7 @@ from typing import Callable, Dict, List, Optional
 from tpurpc.analysis import locks as _dbglocks
 from tpurpc.analysis.locks import make_condition, make_lock
 from tpurpc.core.pair import Pair, PairState
+from tpurpc.obs import flight as _flight
 from tpurpc.obs import metrics as _metrics
 from tpurpc.utils import stats as _stats
 from tpurpc.utils.config import get_config
@@ -328,11 +329,22 @@ def _wait(pair: Pair, timeout: Optional[float], discipline: Optional[str],
         # Adaptive gate (hybrid only): a pair whose activity EWMA decayed
         # below the floor — spins haven't paid off lately — skips the busy
         # window and parks on its fds immediately. "busy" is explicit
-        # operator intent and always spins.
+        # operator intent and always spins. Mode FLIPS (BP↔EV adoption)
+        # are flight-recorder events: rare edges, and exactly the record a
+        # wake-latency postmortem needs (tpurpc-blackbox, ISSUE 5).
         ewma = getattr(pair, "activity_ewma", 1.0)
         if discipline == "hybrid" and ewma < _EWMA_SPIN_FLOOR:
             _stats.counter_inc("wait_spin_skipped")
+            if getattr(pair, "_flight_mode", "bp") != "ev":
+                pair._flight_mode = "ev"
+                ftag = getattr(pair, "_ftag", 0)
+                _flight.emit(_flight.POLLER_EV, ftag)
         else:
+            if (discipline == "hybrid"
+                    and getattr(pair, "_flight_mode", "bp") != "bp"):
+                pair._flight_mode = "bp"
+                ftag = getattr(pair, "_ftag", 0)
+                _flight.emit(_flight.POLLER_BP, ftag)
             if discipline == "busy":
                 spin_deadline = (deadline if deadline is not None
                                  else float("inf"))

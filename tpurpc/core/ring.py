@@ -50,6 +50,7 @@ import struct
 from typing import Callable, List, Optional, Sequence, Tuple
 
 from tpurpc.core import _native
+from tpurpc.obs import flight as _flight
 from tpurpc.obs import metrics as _metrics
 from tpurpc.tpu import ledger
 
@@ -532,6 +533,11 @@ class RingWriter:
         self.tail = 0         # absolute count of ring bytes ever written
         self.seq = 0          # sequence stamp of the next message
         self.remote_head = 0  # mirrored consumer head (credits)
+        #: tpurpc-blackbox: owner-assigned flight tag + the open credit-
+        #: starvation edge — emission is edge-triggered (one event per
+        #: starve episode, one per recovery), never per message
+        self.flight_tag = 0
+        self._starved = False
         # Native gather-encode straight into the mapped peer ring (shm window);
         # transports whose placement is a callback (TPU DMA) stay on write_fn.
         self._nat = _native.load() if mapped is not None else None
@@ -595,7 +601,14 @@ class RingWriter:
         if payload_len == 0:
             return 0
         if payload_len > self.writable_payload():
+            if not self._starved:
+                self._starved = True
+                _flight.emit(_flight.CREDIT_STARVE_BEGIN, self.flight_tag,
+                             self.tail - self.remote_head)
             raise RingFull(payload_len, self.writable_payload())
+        if self._starved:
+            self._starved = False
+            _flight.emit(_flight.CREDIT_STARVE_END, self.flight_tag)
         if self._nat is not None:
             return self._writev_native(views, payload_len)
         # Order matters for lock-free completion detection: payload, footer, header.
@@ -639,6 +652,7 @@ class RingWriter:
         # message inductively: budget' = budget - span keeps the 8-byte gap
         # before the consumer's head untouched for every prefix).
         budget = self.writable_payload()
+        rejected = False
         for p in payloads:
             segs = ([memoryview(s).cast("B") for s in p]
                     if isinstance(p, (list, tuple))
@@ -647,12 +661,22 @@ class RingWriter:
             if ln == 0:
                 continue
             if ln > budget:
+                rejected = True
                 break
             views_per_msg.append(segs)
             lens.append(ln)
             budget -= message_span(ln)
         if not views_per_msg:
+            if rejected and not self._starved:
+                # offered messages, accepted none: the writer is credit-
+                # starved (edge event; write resumption clears it)
+                self._starved = True
+                _flight.emit(_flight.CREDIT_STARVE_BEGIN, self.flight_tag,
+                             self.tail - self.remote_head)
             return 0, 0
+        if self._starved:
+            self._starved = False
+            _flight.emit(_flight.CREDIT_STARVE_END, self.flight_tag)
         if len(views_per_msg) == 1:
             return 1, self.writev(views_per_msg[0])
         total_span = sum(message_span(ln) for ln in lens)

@@ -24,6 +24,7 @@ from typing import Any, Callable, Iterator, List, Optional, Sequence
 import numpy as np
 
 from tpurpc.jaxshim import codec
+from tpurpc.obs import flight as _flight
 from tpurpc.obs import metrics as _metrics
 from tpurpc.obs import tracing as _tracing
 from tpurpc.rpc.server import (Server, stream_stream_rpc_method_handler,
@@ -46,6 +47,10 @@ _FLUSH_REASONS = {
     reason: _metrics.counter(f"batcher_flush_{reason}")
     for reason in ("size", "timer", "drained", "close")
 }
+#: tpurpc-blackbox (ISSUE 5): live batcher queue depth at sweep/scrape
+#: time — the watchdog's "batcher-wait" stage evidence
+_BATCHER_DEPTH = _metrics.fleet("batcher_queue_depth",
+                                lambda b: len(b._queue))
 
 TENSOR_SERVICE = "tpurpc.Tensor"
 
@@ -303,7 +308,7 @@ class _Pending:
         #: tpurpc-scope: the calling RPC's trace context (captured from the
         #: handler thread's ambient) + enqueue stamp — the batcher thread
         #: turns them into "batch-wait"/"infer" spans per request
-        self.tctx = _tracing.current() if _tracing.ACTIVE else None
+        self.tctx = _tracing.current() if _tracing.LIVE else None
         self.t_enq = time.monotonic_ns() if self.tctx is not None else 0
 
 
@@ -389,6 +394,7 @@ class FanInBatcher:
         #: thread, and through it the callers, when the device falls behind
         self._inflight: "_queue.Queue" = _queue.Queue(maxsize=max(2, d2h_workers))
         self._reaped = False  # set by close() after the workers are gone
+        _BATCHER_DEPTH.track(self)
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="tpurpc-batcher")
         self._completers = [
@@ -484,6 +490,10 @@ class FanInBatcher:
             if batch:
                 _FLUSH_REASONS[reason].inc()
                 _FANIN_BATCH.record(len(batch))
+                # flight: one event per DISPATCHED batch — the flush
+                # decision (reason + size) a latency postmortem replays
+                _flight.emit(_flight.BATCH_FLUSH, 0,
+                             _flight.FLUSH_REASON_CODE[reason], len(batch))
                 self._run(batch)
 
     def _drained_inflight(self) -> bool:

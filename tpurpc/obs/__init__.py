@@ -17,11 +17,30 @@ Three faces over one always-on core:
   registry, the copy ledger, and channelz; ``python -m tpurpc.tools.top``
   renders it live.
 
+tpurpc-blackbox (ISSUE 5) adds the POSTMORTEM faces on top:
+
+* :mod:`tpurpc.obs.flight` — an always-on, fixed-size binary ring of
+  structured transport events (stall/starvation edges, lease lifecycle,
+  poller mode flips, window exhaustion, deadline expiry, peer death) with
+  a preallocated lock-free encoder; dump via ``GET /debug/flight``,
+  ``SIGUSR2``, or automatically on watchdog trip.
+* :mod:`tpurpc.obs.watchdog` — a stall sweeper over the in-flight-RPC
+  registry that names the blocked STAGE (credit starvation / poller wake /
+  h2 flow control / batcher wait / device infer / peer-not-reading) from
+  the flight tail + fleet gauges; served at ``GET /debug/stalls`` and
+  reflected in ``/healthz``.
+* tail-based trace capture (in :mod:`tpurpc.obs.tracing`) — every RPC gets
+  a provisional span buffer regardless of sample rate, committed iff the
+  call was slow, errored, or watchdog-flagged: ``TPURPC_TRACE_SAMPLE=0``
+  still yields a full span tree for every pathological call.
+
 The reference fork's whole debugging story was trace flags plus a
 shutdown-time profiler table (SURVEY.md §5, ``stats_time.cc``); tpurpc-scope
-replaces post-hoc printf with always-on, near-free telemetry.
+replaces post-hoc printf with always-on, near-free telemetry, and
+tpurpc-blackbox makes the rare-event failures it samples away recoverable
+after the fact.
 """
 
-from tpurpc.obs import metrics, tracing  # noqa: F401
+from tpurpc.obs import flight, metrics, tracing  # noqa: F401
 
-__all__ = ["metrics", "tracing"]
+__all__ = ["flight", "metrics", "tracing"]

@@ -1,0 +1,284 @@
+"""tpurpc-blackbox flight recorder: always-on binary ring of transport events.
+
+The rare-event failures that matter in serving fleets — credit starvation,
+wake-latency stalls, head-of-line blocking — are exactly what sampled
+telemetry misses by construction (Biswas et al. 1804.01138 §5; Xue et al.
+1805.08430 §3): by the time an operator looks, the evidence is gone. The
+flight recorder is the postmortem answer: a fixed-size binary ring of
+structured transport EVENTS (connect/disconnect, write-stall and
+credit-starvation edges, send-lease reserve/commit/abort, poller BP↔EV
+adoption, h2 window exhaustion, batcher flush decisions, deadline expiry,
+peer death/reconnect) that is cheap enough to leave on in production and
+replayable after the fact.
+
+Cost model — why this can be ALWAYS ON:
+
+* **Events are edges, not traffic.** Nothing on the per-message path emits;
+  only state *transitions* do (a pair entering a write stall, a poller mode
+  flip, a lease opening). A healthy serving loop emits near zero events.
+* **Preallocated encoder, no per-event allocation.** ``emit`` is one
+  ``struct.pack_into`` of five ints into a preallocated ``bytearray`` ring —
+  no dicts, no f-strings, no bytes objects. The ``flight`` lint rule
+  (``analysis/lint.py``) enforces this shape at every hot-module call site.
+* **Lock-free.** Slot allocation is ``next()`` on an ``itertools.count``
+  (GIL-atomic); concurrent emitters write distinct slots. A reader racing a
+  wrap can observe one torn record, which the defensive decoder skips —
+  the trade a crash recorder should make (a lock on the emit path is a
+  probe effect; a torn record is a skipped line in a postmortem).
+
+Record layout (32 bytes, little-endian)::
+
+    <Q t_ns> <H code> <H tag> <I tid> <q a1> <q a2>
+
+``tag`` is an interned small int naming the entity (pair, connection,
+method) — intern once at connect time via :func:`tag_for`, emit plain ints
+forever after. Dump via ``GET /debug/flight`` on the scrape plane, on
+``SIGUSR2`` (stderr), or automatically when the stall watchdog trips.
+"""
+
+from __future__ import annotations
+
+import itertools
+import struct
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = [
+    "RECORDER", "FlightRecorder", "emit", "tag_for", "tag_name",
+    "snapshot", "dump_text", "install_sigusr2", "EVENT_NAMES",
+]
+
+# -- event codes --------------------------------------------------------------
+# Stable small ints: they land in the binary ring and in dumps; append-only.
+
+PAIR_CONNECT = 1          # a1 = peer ring size
+PAIR_DISCONNECT = 2       # graceful close
+WRITE_STALL_BEGIN = 3     # pair sender stalled (want_write edge up)
+WRITE_STALL_END = 4       # stall resolved (want_write edge down)
+CREDIT_STARVE_BEGIN = 5   # ring writer out of credits; a1 = in-flight bytes
+CREDIT_STARVE_END = 6
+LEASE_RESERVE = 7         # a1 = reserved bytes
+LEASE_COMMIT = 8
+LEASE_ABORT = 9
+POLLER_BP = 10            # hybrid waiter (re)adopted the busy-poll window
+POLLER_EV = 11            # hybrid waiter parked on fds (EWMA below floor)
+H2_WINDOW_EXHAUSTED = 12  # a1 = stream id
+BATCH_FLUSH = 13          # a1 = flush reason code, a2 = batch size
+DEADLINE_EXPIRED = 14     # a1 = configured timeout (us)
+PEER_DEATH = 15           # pair/connection died unexpectedly
+RECONNECT = 16            # subchannel re-dialed after a death
+CONN_CONNECT = 17         # client transport connection established
+CONN_DEAD = 18            # client transport connection died; a1 = 1 if graceful
+CALL_FIRST_OK = 19        # first OK call on a connection (reconnect proof)
+WATCHDOG_TRIP = 20        # a1 = stalled-call age (ms)
+
+EVENT_NAMES: Dict[int, str] = {
+    PAIR_CONNECT: "pair-connect",
+    PAIR_DISCONNECT: "pair-disconnect",
+    WRITE_STALL_BEGIN: "write-stall-begin",
+    WRITE_STALL_END: "write-stall-end",
+    CREDIT_STARVE_BEGIN: "credit-starve-begin",
+    CREDIT_STARVE_END: "credit-starve-end",
+    LEASE_RESERVE: "lease-reserve",
+    LEASE_COMMIT: "lease-commit",
+    LEASE_ABORT: "lease-abort",
+    POLLER_BP: "poller-mode-bp",
+    POLLER_EV: "poller-mode-ev",
+    H2_WINDOW_EXHAUSTED: "h2-window-exhausted",
+    BATCH_FLUSH: "batch-flush",
+    DEADLINE_EXPIRED: "deadline-expired",
+    PEER_DEATH: "peer-death",
+    RECONNECT: "reconnect",
+    CONN_CONNECT: "conn-connect",
+    CONN_DEAD: "conn-dead",
+    CALL_FIRST_OK: "call-first-ok",
+    WATCHDOG_TRIP: "watchdog-trip",
+}
+
+#: batch-flush reason codes (a1 of BATCH_FLUSH) — mirrors the jaxshim
+#: flush-reason counters so one event names both the decision and the size
+FLUSH_REASONS = ("size", "timer", "drained", "close")
+FLUSH_REASON_CODE = {name: i for i, name in enumerate(FLUSH_REASONS)}
+
+_REC = struct.Struct("<QHHIqq")
+RECORD_BYTES = _REC.size  # 32
+_I64_MAX = (1 << 63) - 1
+_I64_MIN = -(1 << 63)
+
+
+def _default_capacity() -> int:
+    import os
+
+    raw = os.environ.get("TPURPC_FLIGHT_BUFFER", "")
+    try:
+        return max(64, int(raw)) if raw else 4096
+    except ValueError:
+        return 4096
+
+
+# -- tag interning ------------------------------------------------------------
+
+_tag_lock = threading.Lock()
+_tags: Dict[str, int] = {}
+_tag_names: List[str] = ["-"]  # tag 0 = anonymous
+
+
+def tag_for(name: str) -> int:
+    """Intern ``name`` to a small int, once per entity lifetime (connect
+    time) — the hot emit path then carries only ints. Bounded at 2^16-1
+    tags; overflow degrades to the anonymous tag 0, never an error."""
+    t = _tags.get(name)
+    if t is not None:
+        return t
+    with _tag_lock:
+        t = _tags.get(name)
+        if t is None:
+            if len(_tag_names) >= 0xFFFF:
+                return 0
+            t = len(_tag_names)
+            _tag_names.append(name)
+            _tags[name] = t
+        return t
+
+
+def tag_name(tag: int) -> str:
+    try:
+        return _tag_names[tag]
+    except IndexError:
+        return f"#{tag}"
+
+
+# -- the recorder -------------------------------------------------------------
+
+class FlightRecorder:
+    """Fixed-size binary event ring. See the module docstring for the cost
+    argument; the public face is :meth:`emit` (hot) and :meth:`snapshot`
+    (cold — decodes, validates, time-orders)."""
+
+    def __init__(self, capacity: Optional[int] = None):
+        self.capacity = capacity or _default_capacity()
+        self._buf = bytearray(self.capacity * RECORD_BYTES)
+        self._slots = itertools.count()
+        self.enabled = True
+
+    # -- hot path ------------------------------------------------------------
+
+    def emit(self, code: int, tag: int = 0, a1: int = 0, a2: int = 0) -> None:
+        """Record one event: one pack_into, zero allocation beyond the slot
+        int. Never raises — a recorder failure must not take down the
+        transport it is recording."""
+        if not self.enabled:
+            return
+        try:
+            _REC.pack_into(
+                self._buf, (next(self._slots) % self.capacity) * RECORD_BYTES,
+                time.monotonic_ns(), code, tag,
+                threading.get_ident() & 0xFFFFFFFF,
+                min(max(int(a1), _I64_MIN), _I64_MAX),
+                min(max(int(a2), _I64_MIN), _I64_MAX))
+        except (struct.error, ValueError):
+            pass
+
+    # -- cold paths ----------------------------------------------------------
+
+    def snapshot(self, since_ns: int = 0,
+                 limit: Optional[int] = None) -> List[dict]:
+        """Decode the ring into time-ordered event dicts (oldest first).
+
+        Slot order is not arrival order after a wrap, so ordering comes from
+        the monotonic stamps; zeroed slots and torn/unknown records (a
+        reader racing a wrap) are skipped — defensive by design."""
+        out: List[dict] = []
+        buf = bytes(self._buf)  # one copy: decode from a stable image
+        for off in range(0, len(buf), RECORD_BYTES):
+            t_ns, code, tag, tid, a1, a2 = _REC.unpack_from(buf, off)
+            if t_ns == 0 or code not in EVENT_NAMES or t_ns < since_ns:
+                continue
+            out.append({"t_ns": t_ns, "code": code,
+                        "event": EVENT_NAMES[code], "tag": tag,
+                        "entity": tag_name(tag), "tid": tid,
+                        "a1": a1, "a2": a2})
+        out.sort(key=lambda d: d["t_ns"])
+        if limit is not None and len(out) > limit:
+            out = out[-limit:]
+        return out
+
+    def dump_text(self, since_ns: int = 0) -> str:
+        """Human-readable replay (the SIGUSR2 / watchdog-trip rendering)."""
+        events = self.snapshot(since_ns=since_ns)
+        if not events:
+            return "flight recorder: no events\n"
+        t0 = events[0]["t_ns"]
+        lines = [f"flight recorder: {len(events)} events "
+                 f"(capacity {self.capacity})"]
+        for e in events:
+            lines.append(
+                f"  +{(e['t_ns'] - t0) / 1e6:10.3f}ms "
+                f"{e['event']:<22} {e['entity']:<20} "
+                f"a1={e['a1']} a2={e['a2']} tid={e['tid']:#x}")
+        return "\n".join(lines) + "\n"
+
+    def reset(self) -> None:
+        """Zero every slot (test isolation). Not synchronized against
+        concurrent emitters — callers quiesce first."""
+        for i in range(len(self._buf)):
+            self._buf[i] = 0
+
+
+#: the process-wide recorder; hot modules cache ``flight.emit`` (below)
+RECORDER = FlightRecorder()
+
+#: module-level emit — the one name instrumented sites call
+#: (``_flight.emit(CODE, tag, a1, a2)``; the `flight` lint rule keys on it)
+emit = RECORDER.emit
+
+
+def snapshot(since_ns: int = 0, limit: Optional[int] = None) -> List[dict]:
+    return RECORDER.snapshot(since_ns=since_ns, limit=limit)
+
+
+def dump_text(since_ns: int = 0) -> str:
+    return RECORDER.dump_text(since_ns=since_ns)
+
+
+# -- SIGUSR2 dump -------------------------------------------------------------
+
+_sig_installed = False
+
+
+def install_sigusr2() -> bool:
+    """Dump the flight ring to stderr on SIGUSR2 (``kill -USR2 <pid>``).
+
+    Best-effort: signal handlers only install from the main thread, and not
+    every platform has SIGUSR2 — failure leaves the recorder fully usable
+    via ``/debug/flight``. The previous handler is chained."""
+    global _sig_installed
+    if _sig_installed:
+        return True
+    import signal
+    import sys
+
+    if not hasattr(signal, "SIGUSR2"):
+        return False
+    try:
+        prev = signal.getsignal(signal.SIGUSR2)
+
+        def _dump(signum, frame):
+            try:
+                sys.stderr.write(RECORDER.dump_text())
+                sys.stderr.flush()
+            except Exception:
+                pass
+            if callable(prev) and prev not in (signal.SIG_IGN,
+                                               signal.SIG_DFL):
+                prev(signum, frame)
+
+        signal.signal(signal.SIGUSR2, _dump)
+    except (ValueError, OSError):  # non-main thread / exotic platform
+        return False
+    _sig_installed = True
+    return True
+
+
+install_sigusr2()

@@ -33,9 +33,9 @@ from collections import defaultdict
 from typing import Callable, Dict, Optional, Tuple
 
 __all__ = [
-    "Counter", "Gauge", "Histogram", "FleetGauge", "Registry",
-    "registry", "counter", "gauge", "histogram", "fleet",
-    "snapshot", "reset",
+    "Counter", "Gauge", "Histogram", "FleetGauge", "LabeledCounter",
+    "Registry", "registry", "counter", "gauge", "histogram", "fleet",
+    "labeled_counter", "snapshot", "reset",
 ]
 
 
@@ -192,6 +192,52 @@ class Histogram:
             self._max = 0
 
 
+class LabeledCounter:
+    """A counter FAMILY keyed by a fixed label tuple (Prometheus labels):
+    ``family.labels("method", "0").inc()``. Children are plain
+    :class:`Counter`\\ s — the hot path caches the child and pays the same
+    single GIL-atomic bump; ``labels()`` itself is a dict hit after the
+    first call per label set. Cardinality is bounded (``_MAX_CHILDREN``):
+    overflow collapses into an ``overflow`` child instead of growing the
+    registry without bound on hostile method names."""
+
+    kind = "labeled_counter"
+    _MAX_CHILDREN = 512
+
+    __slots__ = ("name", "labelnames", "_children", "_lock", "_overflow")
+
+    def __init__(self, name: str, labelnames: Tuple[str, ...]):
+        self.name = name
+        self.labelnames = tuple(labelnames)
+        self._children: Dict[Tuple[str, ...], Counter] = {}
+        self._lock = threading.Lock()
+        self._overflow: Optional[Counter] = None
+
+    def labels(self, *values) -> Counter:
+        key = tuple(str(v) for v in values)
+        c = self._children.get(key)
+        if c is not None:
+            return c
+        with self._lock:
+            c = self._children.get(key)
+            if c is None:
+                if len(self._children) >= self._MAX_CHILDREN:
+                    if self._overflow is None:
+                        self._overflow = Counter(self.name + ":overflow")
+                    return self._overflow
+                c = self._children[key] = Counter(self.name)
+            return c
+
+    def snapshot(self) -> Dict[Tuple[str, ...], int]:
+        with self._lock:
+            return {k: c.value for k, c in self._children.items()}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._children.clear()
+            self._overflow = None
+
+
 class FleetGauge:
     """Scrape-time aggregate over live instances (weakly referenced).
 
@@ -261,6 +307,11 @@ class Registry:
             fn = lambda _o: 1.0  # noqa: E731 — membership count gauge
         return self._get(name, lambda: FleetGauge(name, fn), FleetGauge)
 
+    def labeled_counter(self, name: str,
+                        labelnames: Tuple[str, ...]) -> LabeledCounter:
+        return self._get(name, lambda: LabeledCounter(name, labelnames),
+                         LabeledCounter)
+
     # -- export --------------------------------------------------------------
 
     def metrics(self) -> Dict[str, object]:
@@ -270,7 +321,8 @@ class Registry:
     def snapshot(self) -> Dict[str, Dict]:
         """All metrics as plain dicts (tests / JSON export)."""
         out: Dict[str, Dict] = {"counters": {}, "gauges": {},
-                                "histograms": {}, "fleet": {}}
+                                "histograms": {}, "fleet": {},
+                                "labeled": {}}
         for name, m in self.metrics().items():
             if isinstance(m, Counter):
                 out["counters"][name] = m.snapshot()
@@ -278,6 +330,9 @@ class Registry:
                 out["gauges"][name] = m.snapshot()
             elif isinstance(m, Histogram):
                 out["histograms"][name] = m.snapshot()
+            elif isinstance(m, LabeledCounter):
+                out["labeled"][name] = {
+                    ",".join(k): v for k, v in m.snapshot().items()}
             elif isinstance(m, FleetGauge):
                 total, n = m.collect()
                 out["fleet"][name] = {"sum": total, "objects": n}
@@ -321,6 +376,10 @@ def histogram(name: str, kind: str = "size") -> Histogram:
 def fleet(name: str, fn: Optional[Callable[[object], float]] = None
           ) -> FleetGauge:
     return _REGISTRY.fleet(name, fn)
+
+
+def labeled_counter(name: str, labelnames: Tuple[str, ...]) -> LabeledCounter:
+    return _REGISTRY.labeled_counter(name, labelnames)
 
 
 def snapshot() -> Dict[str, Dict]:

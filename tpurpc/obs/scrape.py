@@ -14,11 +14,15 @@ Two ways in, one rendering core:
 
 Routes::
 
-    /metrics    Prometheus text: registry counters/gauges/histograms/fleet
-                gauges + the copy ledger + channelz server/channel counters
-    /traces     Chrome trace_event JSON of the span buffer (?trace_id=hex)
-    /channelz   channelz snapshot JSON (the live data test_channelz asserts)
-    /healthz    "ok"
+    /metrics       Prometheus text: registry counters/gauges/histograms/
+                   fleet gauges + the copy ledger + channelz counters
+    /traces        Chrome trace_event JSON of the span buffer (?trace_id=hex)
+    /channelz      channelz snapshot JSON (the live data test_channelz asserts)
+    /healthz       "ok", or 503 "degraded: ..." while the stall watchdog
+                   has an active diagnosis (tpurpc-blackbox, ISSUE 5)
+    /debug/flight  flight-recorder replay: JSON event list (?text=1 for the
+                   human rendering, ?since_ns=N to bound)
+    /debug/stalls  stall-watchdog diagnoses: active + recent history JSON
 """
 
 from __future__ import annotations
@@ -58,6 +62,12 @@ def render_prometheus() -> str:
         if isinstance(m, _metrics.Counter):
             lines.append(f"# TYPE {full} counter")
             lines.append(f"{full} {m.snapshot()}")
+        elif isinstance(m, _metrics.LabeledCounter):
+            lines.append(f"# TYPE {full} counter")
+            names = m.labelnames
+            for key, value in sorted(m.snapshot().items()):
+                labels = ",".join(f'{n}="{v}"' for n, v in zip(names, key))
+                lines.append(f"{full}{{{labels}}} {value}")
         elif isinstance(m, _metrics.Gauge):
             lines.append(f"# TYPE {full} gauge")
             lines.append(f"{full} {m.snapshot()}")
@@ -134,13 +144,55 @@ def render_prometheus() -> str:
 
 # -- request handling (shared by the sniff path and the standalone server) --
 
+def _query_params(query: str) -> dict:
+    out = {}
+    for part in query.split("&"):
+        k, _, v = part.partition("=")
+        if k:
+            out[k] = v
+    return out
+
+
 def _route(path: str) -> Tuple[int, str, bytes]:
     """(status, content_type, body) for one GET path."""
     route, _, query = path.partition("?")
     if route in ("/metrics", "/metrics/"):
         return 200, "text/plain; version=0.0.4", render_prometheus().encode()
     if route in ("/healthz", "/health"):
+        # tpurpc-blackbox: a live stall diagnosis degrades health — LBs and
+        # probes see the wedge without scraping /debug/stalls themselves
+        try:
+            from tpurpc.obs import watchdog as _watchdog
+
+            active = _watchdog.get().active()
+        except Exception:
+            active = []
+        if active:
+            worst = active[0]
+            body = (f"degraded: {len(active)} stalled call(s); "
+                    f"{worst['method']} blocked on {worst['stage']} "
+                    f"for {worst['age_s']}s\n").encode()
+            return 503, "text/plain", body
         return 200, "text/plain", b"ok\n"
+    if route in ("/debug/flight", "/debug/flight/"):
+        from tpurpc.obs import flight as _flight
+
+        params = _query_params(query)
+        try:
+            since_ns = int(params.get("since_ns") or 0)
+        except ValueError:
+            return 400, "text/plain", b"bad since_ns\n"
+        if params.get("text"):
+            return (200, "text/plain",
+                    _flight.dump_text(since_ns=since_ns).encode())
+        return (200, "application/json",
+                json.dumps({"events": _flight.snapshot(since_ns=since_ns),
+                            "capacity": _flight.RECORDER.capacity}).encode())
+    if route in ("/debug/stalls", "/debug/stalls/"):
+        from tpurpc.obs import watchdog as _watchdog
+
+        return (200, "application/json",
+                json.dumps(_watchdog.get().snapshot(), indent=1).encode())
     if route in ("/channelz", "/channelz/"):
         from tpurpc.rpc import channelz
 
@@ -158,12 +210,14 @@ def _route(path: str) -> Tuple[int, str, bytes]:
             return 400, "text/plain", b"bad trace_id\n"
         return 200, "application/json", body
     return (404, "text/plain",
-            b"tpurpc-scope: /metrics /traces /channelz /healthz\n")
+            b"tpurpc-scope: /metrics /traces /channelz /healthz "
+            b"/debug/flight /debug/stalls\n")
 
 
 def _response(status: int, ctype: str, body: bytes,
               head_only: bool = False) -> List[bytes]:
-    reason = {200: "OK", 400: "Bad Request", 404: "Not Found"}.get(status, "")
+    reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
+              503: "Service Unavailable"}.get(status, "")
     head = (f"HTTP/1.0 {status} {reason}\r\n"
             f"Content-Type: {ctype}\r\n"
             f"Content-Length: {len(body)}\r\n"

@@ -19,6 +19,25 @@ Finished spans land in a bounded in-process ring (default 4096, env
 tests (:func:`spans`, :func:`span_tree`) or as Chrome ``trace_event`` JSON
 for perfetto/chrome://tracing (:func:`chrome_trace`, served at
 ``GET /traces`` by the introspection plane).
+
+**Tail-based capture (tpurpc-blackbox, ISSUE 5).** Head sampling misses the
+one wedged RPC in a million by construction — the pathological call is
+exactly the one the sampler skipped. With tail capture on (the default;
+``TPURPC_TRACE_TAIL=0`` opts out), every RPC whose sampler draw declined
+still gets a PROVISIONAL trace context (header flag ``2``): its spans
+accumulate in a bounded side buffer keyed by trace id, and on completion
+:func:`tail_decide` COMMITS them to the main span ring iff the call was
+slow (over ``TPURPC_TRACE_TAIL_MS`` or the method's rolling-p99 multiple,
+fed by the stall watchdog), errored, or watchdog-flagged
+(:func:`tail_flag`) — otherwise they age out untouched. So
+``TPURPC_TRACE_SAMPLE=0`` still yields a full span tree for every
+pathological call, at a bounded always-on cost (the provisional buffer is
+a fixed-size dict of fixed-size lists; the per-call price is the same span
+records a sampled call pays).
+
+Two gates, one fast check: :data:`ACTIVE` stays "head sampling is live"
+(back-compat), :data:`LIVE` is the union gate instrumented sites load —
+``ACTIVE or tail-capture-on``.
 """
 
 from __future__ import annotations
@@ -31,8 +50,9 @@ from collections import deque
 from typing import Dict, List, Optional
 
 __all__ = [
-    "HEADER", "ACTIVE", "TraceContext", "configure", "force",
-    "maybe_sample", "current", "use", "span", "begin", "finish", "record",
+    "HEADER", "ACTIVE", "LIVE", "TraceContext", "configure", "force",
+    "tail", "maybe_sample", "current", "adopt", "use", "span", "begin",
+    "finish", "record", "tail_decide", "tail_flag", "tail_pending",
     "spans", "span_tree", "chrome_trace", "reset",
 ]
 
@@ -40,12 +60,31 @@ __all__ = [
 #: ascii metadata and the native plane's char* arrays alike)
 HEADER = "tpurpc-trace"
 
-#: fast gate: False ⇒ every instrumented site is one global load + branch
+#: head-sampling gate (back-compat): True iff the sampler can fire
 ACTIVE = False
+#: the ONE fast gate instrumented sites load: sampling OR tail capture live
+LIVE = False
 
 _rate = 0.0
 _forced: Optional[bool] = None
+_tail: Optional[bool] = None  # None = env default (on)
 _lock = threading.Lock()
+#: cached per-call gates, recomputed by configure()/force()/tail() — the
+#: env reads behind them cost microseconds and must never sit on the
+#: per-RPC path (measured: _env twice per call ≈ 8 µs on a 60 µs RPC)
+_TAIL_LIVE = False
+_TAIL_STATIC_NS = 250_000_000
+
+
+def _tail_default() -> bool:
+    from tpurpc.utils.config import _env
+
+    return (_env("TPURPC_TRACE_TAIL") or "1").lower() not in (
+        "0", "off", "false")
+
+
+def _tail_on() -> bool:
+    return _tail if _tail is not None else _tail_default()
 
 
 def _buffer_cap() -> int:
@@ -72,17 +111,25 @@ def _next_span_id() -> int:
 
 
 class TraceContext:
-    """(trace_id, span_id, sampled) — what propagates, nothing else."""
+    """(trace_id, span_id, sampled, provisional) — what propagates.
 
-    __slots__ = ("trace_id", "span_id", "sampled")
+    ``provisional`` marks a tail-capture context: spans route to the
+    pending side buffer until :func:`tail_decide` commits or ages them out.
+    On the wire the flag field carries ``2`` (old peers read it as
+    "sampled", which merely over-records one call on a mixed fleet)."""
 
-    def __init__(self, trace_id: int, span_id: int, sampled: bool = True):
+    __slots__ = ("trace_id", "span_id", "sampled", "provisional")
+
+    def __init__(self, trace_id: int, span_id: int, sampled: bool = True,
+                 provisional: bool = False):
         self.trace_id = trace_id & (1 << 64) - 1
         self.span_id = span_id & (1 << 32) - 1
         self.sampled = sampled
+        self.provisional = provisional
 
     def encode(self) -> str:
-        return f"{self.trace_id:016x}-{self.span_id:08x}-{int(self.sampled)}"
+        fl = 2 if self.provisional else int(self.sampled)
+        return f"{self.trace_id:016x}-{self.span_id:08x}-{fl}"
 
     @staticmethod
     def decode(value) -> "Optional[TraceContext]":
@@ -90,22 +137,50 @@ class TraceContext:
             if isinstance(value, (bytes, bytearray, memoryview)):
                 value = bytes(value).decode("ascii")
             t, s, fl = value.split("-")
-            return TraceContext(int(t, 16), int(s, 16), fl != "0")
+            return TraceContext(int(t, 16), int(s, 16), fl != "0",
+                                provisional=fl == "2")
         except (ValueError, AttributeError):
             return None  # malformed context: untraced, never an error
 
     def child(self) -> "TraceContext":
-        return TraceContext(self.trace_id, _next_span_id(), self.sampled)
+        return TraceContext(self.trace_id, _next_span_id(), self.sampled,
+                            provisional=self.provisional)
 
     def __repr__(self) -> str:
         return f"<TraceContext {self.encode()}>"
 
 
+def adopt(value) -> "Optional[TraceContext]":
+    """Decode a wire context AND register tail-capture state: a provisional
+    context arriving from a peer opens this process's pending buffer for
+    the trace, so server-side spans join the same tail decision. The
+    server planes use this instead of bare ``decode``."""
+    ctx = TraceContext.decode(value)
+    if ctx is not None and ctx.provisional:
+        _tail_register(ctx.trace_id)
+    return ctx
+
+
 # -- sampling ----------------------------------------------------------------
+
+def _recompute_gates() -> None:
+    global ACTIVE, LIVE, _TAIL_LIVE, _TAIL_STATIC_NS
+    ACTIVE = _forced if _forced is not None else _rate > 0.0
+    _TAIL_LIVE = _forced is not False and _tail_on()
+    LIVE = ACTIVE or _TAIL_LIVE
+    from tpurpc.utils.config import _env
+
+    raw = _env("TPURPC_TRACE_TAIL_MS") or ""
+    try:
+        _TAIL_STATIC_NS = int(float(raw) * 1e6) if raw else int(
+            _TAIL_MS_DEFAULT * 1e6)
+    except ValueError:
+        _TAIL_STATIC_NS = int(_TAIL_MS_DEFAULT * 1e6)
+
 
 def configure(rate: Optional[float] = None) -> None:
     """Set the sampling rate (None = re-read ``TPURPC_TRACE_SAMPLE``)."""
-    global _rate, ACTIVE
+    global _rate
     if rate is None:
         from tpurpc.utils.config import _env
 
@@ -116,36 +191,52 @@ def configure(rate: Optional[float] = None) -> None:
             rate = 0.0
     with _lock:
         _rate = min(1.0, max(0.0, rate))
-        ACTIVE = _forced if _forced is not None else _rate > 0.0
+        _recompute_gates()
 
 
 def force(on: Optional[bool]) -> None:
-    """Tests/bench: True samples every call, False disables everything,
-    None returns control to the configured rate."""
-    global _forced, ACTIVE
+    """Tests/bench: True samples every call, False disables everything
+    (tail capture included — the bench's true-off leg), None returns
+    control to the configured rate."""
+    global _forced
     with _lock:
         _forced = on
-        ACTIVE = bool(on) if on is not None else _rate > 0.0
+        _recompute_gates()
+
+
+def tail(on: Optional[bool]) -> None:
+    """Enable/disable tail capture (None = re-read ``TPURPC_TRACE_TAIL``,
+    whose default is ON — the blackbox contract)."""
+    global _tail
+    with _lock:
+        _tail = on
+        _recompute_gates()
 
 
 def maybe_sample() -> Optional[TraceContext]:
-    """Root-sampling decision for a new outgoing RPC: the ambient context
-    if one is installed, else a fresh root context when the sampler fires,
-    else None (the overwhelmingly common untraced path)."""
-    if not ACTIVE:
+    """Root decision for a new outgoing RPC: the ambient context if one is
+    installed; a fresh COMMITTED root when the head sampler fires; a fresh
+    PROVISIONAL root when tail capture is on (spans buffered, committed
+    only if the call turns out pathological); else None."""
+    if not LIVE:
         return None
     cur = getattr(_tls, "ctx", None)
     if cur is not None:
         return cur
-    if _forced or random.random() < _rate:
+    if ACTIVE and (_forced or random.random() < _rate):
         return TraceContext(random.getrandbits(64), _next_span_id())
+    if _TAIL_LIVE:
+        ctx = TraceContext(random.getrandbits(64), _next_span_id(),
+                           provisional=True)
+        _tail_register(ctx.trace_id)
+        return ctx
     return None
 
 
 # -- ambient context ---------------------------------------------------------
 
 def current() -> Optional[TraceContext]:
-    return getattr(_tls, "ctx", None) if ACTIVE else None
+    return getattr(_tls, "ctx", None) if LIVE else None
 
 
 class use:
@@ -173,6 +264,41 @@ class use:
 # A finished span is a plain 8-tuple — one allocation, no attribute churn:
 #   (trace_id, span_id, parent_id, name, t0_ns, dur_ns, tid, attrs|None)
 # The tuple shape is private; export (:func:`spans`) rebuilds dicts.
+#
+# Routing: spans of a PROVISIONAL trace go to its bounded pending list;
+# spans of a committed (or never-registered, i.e. head-sampled) trace go
+# straight to the main ring. One dict.get per span decides.
+
+#: tail-capture side buffer: trace_id -> list of span tuples, or
+#: _COMMITTED once tail_decide/tail_flag promoted the trace (late spans
+#: then land in the main ring directly). Uncommitted traces simply AGE OUT
+#: by insertion-order eviction — a "drop" needs no bookkeeping and can
+#: never race a peer's commit.
+_COMMITTED: list = []  # sentinel (identity compare)
+_pending: "Dict[int, list]" = {}
+_plock = threading.Lock()
+_PENDING_TRACES = 512
+_PENDING_SPANS = 96
+
+
+def _tail_register(trace_id: int) -> None:
+    if trace_id in _pending:
+        return
+    with _plock:
+        if trace_id in _pending:
+            return
+        while len(_pending) >= _PENDING_TRACES:
+            _pending.pop(next(iter(_pending)), None)  # evict oldest
+        _pending[trace_id] = []
+
+
+def _route_append(trace_id: int, tup: tuple) -> None:
+    lst = _pending.get(trace_id)
+    if lst is None or lst is _COMMITTED:
+        _spans.append(tup)
+    elif len(lst) < _PENDING_SPANS:
+        lst.append(tup)
+
 
 def record(name: str, ctx: Optional[TraceContext], t0_ns: int, dur_ns: int,
            **attrs) -> None:
@@ -180,9 +306,10 @@ def record(name: str, ctx: Optional[TraceContext], t0_ns: int, dur_ns: int,
     enqueue/dispatch/retire times)."""
     if ctx is None or not ctx.sampled:
         return
-    _spans.append((ctx.trace_id, _next_span_id(), ctx.span_id, name, t0_ns,
+    _route_append(ctx.trace_id,
+                  (ctx.trace_id, _next_span_id(), ctx.span_id, name, t0_ns,
                    max(0, dur_ns), threading.get_ident() & 0xFFFF,
-                   attrs or None))  # deque.append: GIL-atomic, maxlen-bounded
+                   attrs or None))
 
 
 def begin(name: str, ctx: Optional[TraceContext]) -> Optional[list]:
@@ -201,7 +328,7 @@ def finish(sp: Optional[list], **attrs) -> None:
     sp[5] = time.monotonic_ns() - sp[4]
     if attrs:
         sp[7] = attrs
-    _spans.append(tuple(sp))
+    _route_append(sp[0], tuple(sp))
 
 
 class _NullSpan:
@@ -236,7 +363,8 @@ class _SpanCtx:
 
     def __exit__(self, *exc):
         ctx = self._ctx
-        _spans.append((ctx.trace_id, _next_span_id(), ctx.span_id,
+        _route_append(ctx.trace_id,
+                      (ctx.trace_id, _next_span_id(), ctx.span_id,
                        self._name, self._t0,
                        time.monotonic_ns() - self._t0,
                        threading.get_ident() & 0xFFFF, self._attrs))
@@ -249,12 +377,82 @@ def span(name: str, ctx: Optional[TraceContext] = None, **attrs):
     itself (no ambient reinstall: body code that captures
     :func:`current` sees the call's context, and the per-span TLS churn
     stays off the sampled hot path)."""
-    if not ACTIVE:
+    if not LIVE:
         return _NULL
     ctx = ctx if ctx is not None else current()
     if ctx is None or not ctx.sampled:
         return _NULL
     return _SpanCtx(name, ctx, attrs or None)
+
+
+# -- tail-capture decisions ---------------------------------------------------
+
+_TAIL_MS_DEFAULT = 250.0
+
+
+def _tail_threshold_ns(method: Optional[str]) -> int:
+    """The slow bar: the static ``TPURPC_TRACE_TAIL_MS`` floor (cached —
+    re-read on configure()/tail()), tightened by the method's rolling-p99
+    multiple when the stall watchdog has one (so a 2 ms method's 50 ms
+    outlier is captured even far under the static bar)."""
+    static_ns = _TAIL_STATIC_NS
+    if method is not None:
+        try:
+            from tpurpc.obs import watchdog as _wd
+
+            p99_mult = _wd.get().slow_threshold_ns(method)
+            if p99_mult is not None:
+                return min(static_ns, p99_mult)
+        except Exception:
+            pass
+    return static_ns
+
+
+def tail_commit(trace_id: int) -> None:
+    """Promote a provisional trace's buffered spans into the main ring;
+    later spans for the trace land there directly."""
+    with _plock:
+        lst = _pending.get(trace_id)
+        if lst is _COMMITTED:
+            return
+        if lst:
+            _spans.extend(lst)
+        if trace_id not in _pending:
+            while len(_pending) >= _PENDING_TRACES:
+                _pending.pop(next(iter(_pending)), None)
+        _pending[trace_id] = _COMMITTED
+
+
+#: watchdog face: flag a wedged call's trace for capture while it is STILL
+#: in flight — the spans recorded so far surface immediately on /traces
+tail_flag = tail_commit
+
+
+def tail_decide(ctx: Optional[TraceContext], dur_ns: int,
+                error: bool = False, method: Optional[str] = None) -> bool:
+    """The tail-sampling decision, called where an RPC completes: commit
+    the provisional trace iff the call errored or was slow (static
+    threshold or method-p99 multiple). Returns True when the trace is
+    committed (callers may then record post-hoc spans). No-op for
+    non-provisional contexts — head-sampled spans are already in the
+    ring."""
+    if ctx is None or not getattr(ctx, "provisional", False):
+        return False
+    if _pending.get(ctx.trace_id) is _COMMITTED:
+        return True
+    if error or dur_ns >= _tail_threshold_ns(method):
+        tail_commit(ctx.trace_id)
+        return True
+    return False
+
+
+def tail_pending(trace_id: Optional[int] = None) -> int:
+    """Observability of the buffer itself (tests, /debug): the number of
+    pending (uncommitted) traces, or one trace's buffered span count."""
+    if trace_id is None:
+        return sum(1 for v in _pending.values() if v is not _COMMITTED)
+    lst = _pending.get(trace_id)
+    return len(lst) if isinstance(lst, list) and lst is not _COMMITTED else 0
 
 
 # -- export ------------------------------------------------------------------
@@ -301,9 +499,23 @@ def span_tree(trace_id: "int | str") -> Dict:
 
 def chrome_trace(trace_id: "Optional[int | str]" = None) -> Dict:
     """Chrome ``trace_event`` JSON (perfetto / chrome://tracing): complete
-    ("X") events, microsecond timestamps, one row per recording thread."""
-    events = []
+    ("X") events with microsecond timestamps, one row per recording
+    thread, plus the ``process_name``/``thread_name`` metadata ("M")
+    events — without them perfetto renders bare pid/tid numbers instead of
+    named lanes. Span attrs pass through as ``args``."""
+    events: List[Dict] = [{
+        "ph": "M", "name": "process_name", "pid": 1, "tid": 0,
+        "args": {"name": "tpurpc"},
+    }]
+    named_tids = set()
     for d in spans(trace_id):
+        tid = d["tid"]
+        if tid not in named_tids:
+            named_tids.add(tid)
+            events.append({
+                "ph": "M", "name": "thread_name", "pid": 1, "tid": tid,
+                "args": {"name": f"tpurpc-thread-{tid:#x}"},
+            })
         events.append({
             "ph": "X",
             "name": d["name"],
@@ -311,7 +523,7 @@ def chrome_trace(trace_id: "Optional[int | str]" = None) -> Dict:
             "ts": d["t0_ns"] / 1e3,
             "dur": max(d["dur_ns"], 0) / 1e3,
             "pid": 1,
-            "tid": d["tid"],
+            "tid": tid,
             "args": dict(d.get("attrs") or {},
                          trace_id=d["trace_id"],
                          span_id=d["span_id"]),
@@ -321,6 +533,8 @@ def chrome_trace(trace_id: "Optional[int | str]" = None) -> Dict:
 
 def reset() -> None:
     _spans.clear()
+    with _plock:
+        _pending.clear()
 
 
 configure()

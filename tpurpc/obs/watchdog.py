@@ -1,0 +1,368 @@
+"""tpurpc-blackbox stall watchdog: find the wedged RPC and name the stage.
+
+A serving fleet's worst page is "one call is stuck and nothing says where".
+The watchdog is a background sweeper over an in-process registry of
+in-flight RPCs (both server handlers and the pipelined client's windows
+register): any call in flight past a multiple of its method's ROLLING p99
+— or past a static floor when the method has no history yet — produces a
+structured diagnosis naming the blocked *stage*, derived from the flight
+recorder's tail plus the scrape plane's fleet gauges:
+
+* ``credit-starvation`` — an open (unmatched) send-lease reserve, an
+  unresolved ring credit-starvation edge, or a freshly write-stalled pair;
+* ``peer-not-reading`` — a write stall/starvation that has persisted well
+  past the stall bar (the peer is alive but not draining its ring);
+* ``h2-flow-control`` — an h2 send window exhausted within the stall
+  window (the peer stopped granting WINDOW_UPDATE credit);
+* ``batcher-wait`` — requests parked in the fan-in batcher's queue;
+* ``poller-wake`` — a pair with a complete message waiting that no waiter
+  has drained (wake-latency / lost-kick territory);
+* ``device-infer`` — the transport is quiet and the handler is simply
+  still executing (the model/device is the long pole).
+
+Diagnoses are served at ``GET /debug/stalls``, mirrored into the
+``watchdog_trips`` / ``watchdog_stalls{stage}`` anomaly counters, flip
+``/healthz`` to degraded (503) while active, flag the call's trace for
+tail capture (:func:`tpurpc.obs.tracing.tail_flag` — so the postmortem has
+the span tree), and log one flight-recorder replay per trip.
+
+Cost: registration is a dict store + one monotonic stamp per RPC;
+completion feeds a fixed-size rolling duration window per method (p99
+computed lazily, cached 0.5 s). The sweeper is one daemon thread at
+``TPURPC_WATCHDOG_SWEEP_S`` (default 0.25 s) that does nothing while no
+call is over its bar. ``TPURPC_WATCHDOG=0`` disables everything.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from tpurpc.obs import flight as _flight
+from tpurpc.obs import metrics as _metrics
+
+__all__ = ["StallWatchdog", "get", "call_started", "call_finished",
+           "STAGES"]
+
+_log = logging.getLogger("tpurpc.watchdog")
+
+STAGES = ("credit-starvation", "peer-not-reading", "h2-flow-control",
+          "batcher-wait", "poller-wake", "device-infer", "unknown")
+
+#: anomaly counters (always-on registry): total trips + per-stage breakdown
+_TRIPS = _metrics.counter("watchdog_trips")
+_STALLS = _metrics.labeled_counter("watchdog_stalls", ("stage",))
+
+_BEGIN_END = {
+    _flight.WRITE_STALL_BEGIN: _flight.WRITE_STALL_END,
+    _flight.CREDIT_STARVE_BEGIN: _flight.CREDIT_STARVE_END,
+}
+
+
+class _Roll:
+    """Fixed-size rolling duration window per method; p99 cached 0.5 s."""
+
+    __slots__ = ("buf", "n", "_p99", "_stamp")
+    SIZE = 128
+
+    def __init__(self):
+        self.buf = [0] * self.SIZE
+        self.n = 0
+        self._p99 = None
+        self._stamp = 0.0
+
+    def record(self, dur_ns: int) -> None:
+        self.buf[self.n % self.SIZE] = dur_ns
+        self.n += 1
+
+    def p99_ns(self) -> Optional[int]:
+        if self.n < 8:
+            return None  # too little history to call anything an outlier
+        now = time.monotonic()
+        if self._p99 is None or now - self._stamp > 0.5:
+            window = sorted(self.buf[:min(self.n, self.SIZE)])
+            self._p99 = window[max(0, int(len(window) * 0.99) - 1)]
+            self._stamp = now
+        return self._p99
+
+
+class StallWatchdog:
+    def __init__(self, sweep_s: Optional[float] = None,
+                 mult: Optional[float] = None,
+                 min_stall_s: Optional[float] = None):
+        import os
+
+        self.enabled = os.environ.get("TPURPC_WATCHDOG", "1").lower() not in (
+            "0", "off", "false")
+        self.sweep_s = sweep_s if sweep_s is not None else float(
+            os.environ.get("TPURPC_WATCHDOG_SWEEP_S", "0.25"))
+        self.mult = mult if mult is not None else float(
+            os.environ.get("TPURPC_WATCHDOG_MULT", "8"))
+        self.min_stall_s = min_stall_s if min_stall_s is not None else float(
+            os.environ.get("TPURPC_WATCHDOG_MIN_S", "1.0"))
+        #: token -> [method, t0_ns, trace_id, kind, tripped]
+        self._inflight: Dict[int, list] = {}
+        self._tokens = itertools.count(1)
+        self._rolls: Dict[str, _Roll] = {}
+        self._active: List[dict] = []
+        self._history: deque = deque(maxlen=64)
+        self._thread: Optional[threading.Thread] = None
+        self._thread_lock = threading.Lock()
+        self._wake = threading.Event()
+
+    # -- per-RPC face (hot-ish: one dict store / delete) ----------------------
+
+    def call_started(self, method: str, trace_id: int = 0,
+                     kind: str = "server") -> Optional[int]:
+        if not self.enabled:
+            return None
+        tok = next(self._tokens)
+        self._inflight[tok] = [method, time.monotonic_ns(), trace_id, kind,
+                               False]
+        if self._thread is None:
+            self._ensure_thread()
+        return tok
+
+    def call_finished(self, token: Optional[int],
+                      error: bool = False) -> None:
+        if token is None:
+            return
+        entry = self._inflight.pop(token, None)
+        if entry is None or error:
+            return  # failures don't tighten the p99 bar
+        dur = time.monotonic_ns() - entry[1]
+        method = entry[0]
+        roll = self._rolls.get(method)
+        if roll is None:
+            if len(self._rolls) >= 256:
+                return  # bounded method cardinality
+            roll = self._rolls.setdefault(method, _Roll())
+        roll.record(dur)
+
+    def slow_threshold_ns(self, method: str) -> Optional[int]:
+        """``mult × rolling-p99`` for tail capture's slow bar, or None
+        without enough history."""
+        roll = self._rolls.get(method)
+        if roll is None:
+            return None
+        p99 = roll.p99_ns()
+        return None if p99 is None else int(p99 * self.mult)
+
+    # -- the sweeper ----------------------------------------------------------
+
+    def _ensure_thread(self) -> None:
+        with self._thread_lock:
+            if self._thread is not None:
+                return
+            t = threading.Thread(target=self._loop, daemon=True,
+                                 name="tpurpc-watchdog")
+            self._thread = t
+            t.start()
+
+    def _loop(self) -> None:
+        while True:
+            self._wake.wait(timeout=self.sweep_s)
+            self._wake.clear()
+            try:
+                self.sweep_once()
+            except Exception:  # the watchdog must never take anything down
+                _log.exception("watchdog sweep failed")
+
+    def _stall_bar_ns(self, method: str) -> int:
+        bar = int(self.min_stall_s * 1e9)
+        p99m = self.slow_threshold_ns(method)
+        if p99m is not None:
+            bar = max(bar, p99m)  # never page on a method's normal tail
+        return bar
+
+    def sweep_once(self, now_ns: Optional[int] = None) -> List[dict]:
+        """One sweep: rebuild the active diagnosis list; fire trip actions
+        for newly detected stalls. Exposed for tests (deterministic
+        sweeps) — the daemon loop calls it on the configured cadence."""
+        now = now_ns if now_ns is not None else time.monotonic_ns()
+        active: List[dict] = []
+        evidence = None
+        for tok, entry in list(self._inflight.items()):
+            method, t0, trace_id, kind, tripped = entry
+            age = now - t0
+            if age < self._stall_bar_ns(method):
+                continue
+            if evidence is None:
+                evidence = self._gather_evidence(now)
+            stage, detail = self._attribute(evidence, kind, age)
+            diag = {
+                "method": method,
+                "kind": kind,
+                "stage": stage,
+                "detail": detail,
+                "age_s": round(age / 1e9, 3),
+                "trace_id": f"{trace_id:016x}" if trace_id else None,
+                "since_ns": t0,
+            }
+            active.append(diag)
+            if not tripped:
+                entry[4] = True
+                self._trip(diag, trace_id, age)
+        self._active = active
+        if active:
+            for d in active:
+                done = {"t": time.time()}  # tpr: allow(wallclock)
+                done.update(d)
+                if not self._history or self._history[-1].get(
+                        "since_ns") != d["since_ns"] or \
+                        self._history[-1].get("stage") != d["stage"]:
+                    self._history.append(done)
+        return active
+
+    def _trip(self, diag: dict, trace_id: int, age_ns: int) -> None:
+        _TRIPS.inc()
+        _STALLS.labels(diag["stage"]).inc()
+        _flight.emit(_flight.WATCHDOG_TRIP,
+                     _flight.tag_for(diag["method"]), age_ns // 1_000_000)
+        if trace_id:
+            # postmortem spans: promote the wedged call's provisional trace
+            # NOW, while it is still in flight — /traces has the tree even
+            # if the call never completes
+            from tpurpc.obs import tracing as _tracing
+
+            _tracing.tail_flag(trace_id)
+        _log.warning(
+            "stall: %s %s in flight %.2fs — stage %s (%s)\n%s",
+            diag["kind"], diag["method"], diag["age_s"], diag["stage"],
+            diag["detail"],
+            _flight.RECORDER.dump_text(
+                since_ns=diag["since_ns"] - 1_000_000_000))
+
+    # -- stage attribution ----------------------------------------------------
+
+    def _gather_evidence(self, now_ns: int) -> dict:
+        """One pass over the flight tail + fleet gauges, shared by every
+        diagnosis in a sweep."""
+        events = _flight.RECORDER.snapshot(
+            since_ns=now_ns - 60_000_000_000, limit=512)
+        open_lease = 0
+        open_edges: Dict[tuple, int] = {}  # (begin_code, tag) -> t_ns
+        last_h2 = 0
+        for e in events:
+            code = e["code"]
+            if code == _flight.LEASE_RESERVE:
+                open_lease += 1
+            elif code in (_flight.LEASE_COMMIT, _flight.LEASE_ABORT):
+                open_lease = max(0, open_lease - 1)
+            elif code in _BEGIN_END:
+                open_edges[(code, e["tag"])] = e["t_ns"]
+            elif code in _BEGIN_END.values():
+                for b, en in _BEGIN_END.items():
+                    if en == code:
+                        open_edges.pop((b, e["tag"]), None)
+            elif code == _flight.H2_WINDOW_EXHAUSTED:
+                last_h2 = e["t_ns"]
+
+        def fleet_sum(name: str) -> float:
+            m = _metrics.registry().metrics().get(name)
+            if m is None or not isinstance(m, _metrics.FleetGauge):
+                return 0.0
+            return m.collect()[0]
+
+        return {
+            "now_ns": now_ns,
+            "open_lease": open_lease,
+            "open_edges": open_edges,
+            "last_h2_ns": last_h2,
+            "pairs_write_stalled": fleet_sum("pairs_write_stalled"),
+            "batcher_queue_depth": fleet_sum("batcher_queue_depth"),
+            "pairs_msg_waiting": fleet_sum("pairs_msg_waiting"),
+        }
+
+    def _attribute(self, ev: dict, kind: str, age_ns: int) -> tuple:
+        now = ev["now_ns"]
+        starve_age = 0
+        for (code, tag), t in ev["open_edges"].items():
+            starve_age = max(starve_age, now - t)
+        if ev["open_lease"] > 0:
+            return ("credit-starvation",
+                    "send-lease held: reserve without commit/abort in the "
+                    "flight tail — the ring write lock is wedged")
+        if starve_age or ev["pairs_write_stalled"] > 0:
+            if starve_age > 2 * age_ns or (
+                    starve_age > 3 * self.min_stall_s * 1e9):
+                return ("peer-not-reading",
+                        "write stall/credit starvation persisted "
+                        f"{starve_age / 1e9:.2f}s: the peer is connected "
+                        "but not draining its receive ring")
+            return ("credit-starvation",
+                    "ring writer out of credits "
+                    f"({int(ev['pairs_write_stalled'])} pair(s) "
+                    "write-stalled)")
+        if ev["last_h2_ns"] and now - ev["last_h2_ns"] < age_ns + int(1e9):
+            # an exhaustion event within the stalled call's lifetime
+            # (plus a second of slack for sweep-phase skew)
+            return ("h2-flow-control",
+                    "h2 send window exhausted: the peer stopped granting "
+                    "WINDOW_UPDATE credit")
+        if ev["batcher_queue_depth"] > 0:
+            return ("batcher-wait",
+                    f"{int(ev['batcher_queue_depth'])} request(s) parked "
+                    "in the fan-in batcher queue")
+        if ev["pairs_msg_waiting"] > 0:
+            return ("poller-wake",
+                    "a complete message is sitting undrained in a pair's "
+                    "receive ring — wake latency or a lost kick")
+        if kind == "server":
+            return ("device-infer",
+                    "transport quiet, handler still executing: the "
+                    "model/device call is the long pole")
+        return ("device-infer",
+                "no local transport anomaly: the call is in flight at the "
+                "peer (its handler/device is the long pole)")
+
+    # -- export ---------------------------------------------------------------
+
+    def active(self) -> List[dict]:
+        return list(self._active)
+
+    def snapshot(self) -> dict:
+        return {
+            "active": list(self._active),
+            "history": list(self._history),
+            "inflight": len(self._inflight),
+            "sweep_s": self.sweep_s,
+            "mult": self.mult,
+            "min_stall_s": self.min_stall_s,
+            "enabled": self.enabled,
+        }
+
+    def reset(self) -> None:
+        """Test isolation: forget in-flight calls and diagnoses (the
+        sweeper thread, if started, keeps running harmlessly)."""
+        self._inflight.clear()
+        self._rolls.clear()
+        self._active = []
+        self._history.clear()
+
+
+_instance: Optional[StallWatchdog] = None
+_instance_lock = threading.Lock()
+
+
+def get() -> StallWatchdog:
+    global _instance
+    if _instance is None:
+        with _instance_lock:
+            if _instance is None:
+                _instance = StallWatchdog()
+    return _instance
+
+
+def call_started(method: str, trace_id: int = 0,
+                 kind: str = "server") -> Optional[int]:
+    return get().call_started(method, trace_id, kind)
+
+
+def call_finished(token: Optional[int], error: bool = False) -> None:
+    if token is not None:
+        get().call_finished(token, error=error)

@@ -26,6 +26,7 @@ from typing import (Callable, Iterable, Iterator, List, Optional, Sequence,
 
 from tpurpc.analysis.locks import make_condition, make_lock
 from tpurpc.core.endpoint import Endpoint, EndpointError, connect_endpoint
+from tpurpc.obs import flight as _flight
 from tpurpc.obs import metrics as _obs_metrics
 from tpurpc.obs import tracing as _tracing
 from tpurpc.rpc import frame as fr
@@ -46,6 +47,10 @@ _PIPELINES_INFLIGHT = _obs_metrics.fleet("pipeline_inflight",
                                          lambda pl: pl._inflight)
 _PIPE_CALL_US = _obs_metrics.histogram("pipeline_call_us", kind="latency")
 _PIPE_DEMUX_US = _obs_metrics.histogram("pipeline_demux_us", kind="latency")
+#: tpurpc-blackbox (ISSUE 5): per-method client-observed deadline expiries
+#: (PipelinedUnary's timer wheel + the blocking unary path both feed it)
+_DEADLINE_EXCEEDED = _obs_metrics.labeled_counter("deadline_exceeded",
+                                                  ("method",))
 
 
 class _ClientStream:
@@ -201,6 +206,12 @@ class _Connection:
         self.draining = False        # GOAWAY received: no new streams
         self.last_activity = time.monotonic()
         self._on_dead = on_dead
+        #: tpurpc-blackbox: connection lifecycle in the flight ring — the
+        #: disconnect→reconnect→first-OK sequence a postmortem replays
+        self._ftag = _flight.tag_for("conn:" + getattr(endpoint, "peer",
+                                                       "?"))
+        self._flight_first_ok = False
+        _flight.emit(_flight.CONN_CONNECT, self._ftag)
         self.writer.send_preface()
         # Inline-pump discipline (the reference's pollset_work model,
         # SURVEY §3.4; the Python analog of TPURPC_NATIVE_INLINE_READ):
@@ -595,6 +606,8 @@ class _Connection:
             h = getattr(self, attr, None)
             if h is not None:
                 h.cancel()  # wheel ticks also re-check alive themselves
+        graceful = "GOAWAY" in why or "closed" in why or "idle" in why
+        _flight.emit(_flight.CONN_DEAD, self._ftag, 1 if graceful else 0)
         trace_channel.log("connection dead: %s", why)
         for st in streams:
             st.deliver_failure(StatusCode.UNAVAILABLE, f"transport failed: {why}")
@@ -622,6 +635,9 @@ class _Subchannel:
         self._connect_lock = make_lock("_Subchannel._connect_lock")
         self._backoff = Channel._BACKOFF_INITIAL
         self._next_attempt = 0.0
+        #: tpurpc-blackbox: a previous connection died — the NEXT
+        #: successful dial is a reconnect (flight-recorder event)
+        self._lost_conn = False
 
     def get(self) -> _Connection:
         with self._lock:
@@ -660,12 +676,16 @@ class _Subchannel:
                     raise RpcError(StatusCode.UNAVAILABLE, "channel closed")
                 self._backoff = Channel._BACKOFF_INITIAL
                 self._conn = conn
-                return conn
+                was_lost, self._lost_conn = self._lost_conn, False
+            if was_lost:
+                _flight.emit(_flight.RECONNECT, conn._ftag)
+            return conn
 
     def _on_conn_dead(self, conn: _Connection) -> None:
         with self._lock:
             if self._conn is conn:
                 self._conn = None
+            self._lost_conn = True
 
     def close(self) -> None:
         with self._lock:
@@ -1258,12 +1278,30 @@ class Call:
             raise RpcError(StatusCode.DEADLINE_EXCEEDED,
                            "deadline exceeded awaiting response") from None
 
+    def _tail_decide(self, error: bool) -> None:
+        """tpurpc-blackbox: the client half of the tail-capture decision —
+        commit this call's provisional span tree iff it was slow or
+        failed (either endpoint committing promotes the shared trace)."""
+        stash = getattr(self._st, "_tail", None)
+        if stash is None:
+            return
+        self._st._tail = None  # decide once per stream
+        tctx, t0, method = stash
+        _tracing.tail_decide(tctx, time.monotonic_ns() - t0,
+                             error=error, method=method)
+
     def _expire(self) -> None:
         if self._counters is not None:  # counters reconcile: expiry = failed
             self._counters.on_finish(False)
             self._counters = None
         self._code = StatusCode.DEADLINE_EXCEEDED
         self._details = "deadline exceeded"
+        stash = getattr(self._st, "_tail", None)
+        if stash is not None and stash[2]:
+            _DEADLINE_EXCEEDED.labels(stash[2]).inc()
+        _flight.emit(_flight.DEADLINE_EXPIRED, self._conn._ftag,
+                     self._st.stream_id)
+        self._tail_decide(error=True)
         try:
             self._conn.writer.send(fr.RST, 0, self._st.stream_id,
                                    fr.rst_payload(StatusCode.DEADLINE_EXCEEDED,
@@ -1279,6 +1317,10 @@ class Call:
         self._code = code
         self._details = details
         self._trailing = md
+        self._tail_decide(error=code is not StatusCode.OK)
+        if code is StatusCode.OK and not self._conn._flight_first_ok:
+            self._conn._flight_first_ok = True
+            _flight.emit(_flight.CALL_FIRST_OK, self._conn._ftag)
         if (self._channel is not None and self._channel._compress_flag
                 and code is StatusCode.UNIMPLEMENTED
                 and fr.COMPRESSED_UNSUPPORTED_SENTINEL in details):
@@ -1479,7 +1521,7 @@ class _MultiCallable:
         # native-path gate) pass it via trace_ctx; _TRACE_UNSET means
         # decide here.
         if trace_ctx is _TRACE_UNSET:
-            tctx = _tracing.maybe_sample() if _tracing.ACTIVE else None
+            tctx = _tracing.maybe_sample() if _tracing.LIVE else None
         else:
             tctx = trace_ctx
         send_sp = None
@@ -1492,6 +1534,9 @@ class _MultiCallable:
             # the server can be parsing HEADERS before send_many returns,
             # and the wire interval must enclose every server-side span.
             st._wire_span = _tracing.begin("wire", tctx)
+        # tpurpc-blackbox: what Call needs to make the client-side tail
+        # decision (and to label deadline expiries) at terminal time
+        st._tail = (tctx, time.monotonic_ns(), self._method)
         try:
             remaining = (None if deadline is None
                          else max(0.0, deadline - time.monotonic()))
@@ -1564,7 +1609,7 @@ class _MultiCallable:
         # carries its context through tpr_call_start's metadata array —
         # same wire key, same server-side extraction as the Python plane.
         md = None
-        if _tracing.ACTIVE:
+        if _tracing.LIVE:
             tctx = _tracing.maybe_sample()
             if tctx is not None:
                 md = [(_tracing.HEADER, tctx.child().encode())]
@@ -1635,15 +1680,20 @@ class UnaryUnary(_MultiCallable):
         # native entry has no metadata channel to carry the trace context
         # (NativeCall STREAMS do — _try_native_stream threads it through
         # tpr_call_start). Sampling defaults off, so the common path pays
-        # one global load.
-        tctx = _tracing.maybe_sample() if _tracing.ACTIVE else None
-        if (tctx is None and self._allow_native and not metadata
+        # one global load. TAIL-provisional contexts do NOT force the
+        # Python path — the 5 µs native loop must not pay the 95 µs plane
+        # for a trace that is overwhelmingly about to be dropped; instead
+        # _native_call synthesizes a post-hoc span iff the call turns out
+        # pathological (client-side-only tree, documented trade).
+        tctx = _tracing.maybe_sample() if _tracing.LIVE else None
+        if ((tctx is None or getattr(tctx, "provisional", False))
+                and self._allow_native and not metadata
                 and not grpcio_kw.get("wait_for_ready")
                 and not self._channel._call_plan(self._method, None)[3]
                 and not self._instruments_live()):
             nch = self._channel._native_fast()
             if nch is not None:
-                done, resp = self._native_call(nch, request, timeout)
+                done, resp = self._native_call(nch, request, timeout, tctx)
                 if done:
                     return resp
         # the sampling decision rides DOWN the call explicitly (not via
@@ -1654,11 +1704,17 @@ class UnaryUnary(_MultiCallable):
                                      _trace_ctx=tctx, **grpcio_kw)
         return response
 
-    def _native_call(self, nch, request, timeout: Optional[float]):
+    def _native_call(self, nch, request, timeout: Optional[float],
+                     tctx=None):
         """One unary call inside the native loop. Returns ``(True, resp)``
         or ``(False, None)`` — fall back to the Python transport, allowed
         only for failures that PROVE no handler ran (refused/connect-time),
-        so a fallback can never re-execute a committed call."""
+        so a fallback can never re-execute a committed call.
+
+        ``tctx`` is a tail-capture provisional context: nothing is recorded
+        on the fast path; iff the call turns out slow or errored, the trace
+        commits and a post-hoc ``native-unary`` span materializes — the
+        native plane's bounded-cost tail story."""
         cached = self._native_mc
         if cached is None or cached[0] is not nch:
             cached = (nch, nch.unary_unary(self._method))
@@ -1692,11 +1748,26 @@ class UnaryUnary(_MultiCallable):
             counters.on_finish(True)
             return _deserialize(self._deser, body)
 
+        t0 = time.monotonic_ns() if tctx is not None else 0
+
+        def _tail(error: bool) -> None:
+            if tctx is None:
+                return
+            dur = time.monotonic_ns() - t0
+            if _tracing.tail_decide(tctx, dur, error=error,
+                                    method=self._method):
+                _tracing.record("native-unary", tctx, t0, dur,
+                                method=self._method)
+
         try:
             if policy is None:
-                return True, attempt()
-            return True, policy.run(deadline, attempt, throttle=throttle)
+                result = attempt()
+            else:
+                result = policy.run(deadline, attempt, throttle=throttle)
+            _tail(error=False)
+            return True, result
         except RpcError as exc:
+            _tail(error=True)
             if _status_of(exc) is StatusCode.UNAVAILABLE:
                 # dead fast-path connection: drop it so the next call
                 # re-dials. Fall back to the Python transport (its
@@ -1905,6 +1976,16 @@ class PipelinedUnary:
             self._window.release()
             raise
         state = {"claimed": False}
+        # tpurpc-blackbox: register with the stall watchdog — a pipelined
+        # call has NO thread parked on it, so the sweeper is the only
+        # observer that can notice it wedged and name the stage
+        from tpurpc.obs import watchdog as _watchdog
+
+        stash = getattr(st, "_tail", None)
+        wd_tok = _watchdog.call_started(
+            self._mc._method,
+            stash[0].trace_id if stash and stash[0] is not None else 0,
+            kind="client")
 
         def claim() -> bool:
             with self._lock:
@@ -1936,6 +2017,8 @@ class PipelinedUnary:
             if code is None:  # terminal hook without a queued trailer event
                 code, details = StatusCode.INTERNAL, "terminal without status"
             call._finish(code, details, md)
+            _watchdog.call_finished(wd_tok,
+                                    error=code is not StatusCode.OK)
             if not fut.set_running_or_notify_cancel():
                 return  # caller cancelled the future; drop the result
             if code is not StatusCode.OK:
@@ -1969,6 +2052,7 @@ class PipelinedUnary:
                 if not claim():
                     return
                 call._expire()
+                _watchdog.call_finished(wd_tok, error=True)
                 if fut.set_running_or_notify_cancel():
                     fut.set_exception(RpcError(
                         StatusCode.DEADLINE_EXCEEDED,

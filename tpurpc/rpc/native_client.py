@@ -31,6 +31,7 @@ import threading
 import time
 from typing import Callable, Iterable, Optional
 
+from tpurpc.obs import flight as _flight
 from tpurpc.rpc.status import RpcError, StatusCode
 
 _LIB = None
@@ -215,6 +216,10 @@ class NativeCall:
         sent = 0
         si = 0  # segment cursor
         so = 0  # offset within segs[si]
+        # tpurpc-blackbox: the lease lifecycle in the flight ring — an
+        # unmatched reserve in the tail is the watchdog's smoking gun for
+        # a wedged ring write lock (the round-5 bug class, now observable)
+        ftag = _flight.tag_for("nclease")
         while sent < total:
             n = min(total - sent, self._LEASE_FRAME)
             last = sent + n == total
@@ -229,6 +234,7 @@ class NativeCall:
                     return False  # no ring under this channel: classic path
                 raise RpcError(StatusCode.UNAVAILABLE, "send failed")
             try:
+                _flight.emit(_flight.LEASE_RESERVE, ftag, n)
                 # ≤2 wrap-split ring spans; fill from the segment stream
                 for ptr, ln in ((p1, l1.value), (p2, l2.value)):
                     if not ln:
@@ -248,9 +254,12 @@ class NativeCall:
                             so = 0
             except BaseException:
                 lib.tpr_call_send_abort(self._call)  # release write_mu
+                _flight.emit(_flight.LEASE_ABORT, ftag, n)
                 raise
             if lib.tpr_call_send_commit(self._call) != 0:
+                _flight.emit(_flight.LEASE_ABORT, ftag, n)
                 raise RpcError(StatusCode.UNAVAILABLE, "send failed")
+            _flight.emit(_flight.LEASE_COMMIT, ftag, n)
             sent += n
         return True
 

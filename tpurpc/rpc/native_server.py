@@ -327,40 +327,61 @@ class NativeDataplane:
                 # thread's ambient so handler spans (and the batcher's
                 # batch-wait/infer) attribute to the caller's trace_id.
                 tctx = None
-                if _tracing.ACTIVE:
+                if _tracing.LIVE:
                     for _k, _v in ctx.invocation_metadata():
                         if _k == _tracing.HEADER:
-                            tctx = _tracing.TraceContext.decode(_v)
+                            # adopt (not bare decode): a tail-provisional
+                            # caller opens this process's pending buffer so
+                            # handler spans join the same tail decision
+                            tctx = _tracing.adopt(_v)
                             break
+                # tpurpc-blackbox: the native plane registers with the
+                # stall watchdog and makes the tail-capture decision like
+                # the Python plane (ISSUE 5 — both planes)
+                import time as _time
+
+                from tpurpc.obs import watchdog as _watchdog
+
+                wd_tok = _watchdog.call_started(
+                    path, tctx.trace_id if tctx is not None else 0)
+                t0 = _time.monotonic_ns()
+                rc = 13
                 try:
-                    with _tracing.use(tctx) if tctx is not None \
-                            else _tracing.NULL_CM:
-                        if _h.kind == "unary_unary":
-                            req = next(requests(), None)
-                            if req is None:
-                                return 13  # half-close with no message
-                            with _tracing.span("handler", tctx):
-                                resp = _h.behavior(req, ctx)
-                            if send(resp) != 0:
-                                return 14  # UNAVAILABLE: connection died
-                        elif _h.kind == "unary_stream":
-                            req = next(requests(), None)
-                            if req is None:
-                                return 13
-                            for resp in _h.behavior(req, ctx):
+                    try:
+                        with _tracing.use(tctx) if tctx is not None \
+                                else _tracing.NULL_CM:
+                            if _h.kind == "unary_unary":
+                                req = next(requests(), None)
+                                if req is None:
+                                    return 13  # half-close with no message
+                                with _tracing.span("handler", tctx):
+                                    resp = _h.behavior(req, ctx)
                                 if send(resp) != 0:
+                                    return 14  # UNAVAILABLE: conn died
+                            elif _h.kind == "unary_stream":
+                                req = next(requests(), None)
+                                if req is None:
+                                    return 13
+                                for resp in _h.behavior(req, ctx):
+                                    if send(resp) != 0:
+                                        return 14
+                            elif _h.kind == "stream_unary":
+                                if send(_h.behavior(requests(), ctx)) != 0:
                                     return 14
-                        elif _h.kind == "stream_unary":
-                            if send(_h.behavior(requests(), ctx)) != 0:
-                                return 14
-                        else:  # stream_stream
-                            for resp in _h.behavior(requests(), ctx):
-                                if send(resp) != 0:
-                                    return 14
-                except AbortError as exc:
-                    lib.tpr_srv_set_details(call, exc.details.encode())
-                    return int(exc.code.value)
-                return ctx._finish_code()
+                            else:  # stream_stream
+                                for resp in _h.behavior(requests(), ctx):
+                                    if send(resp) != 0:
+                                        return 14
+                    except AbortError as exc:
+                        lib.tpr_srv_set_details(call, exc.details.encode())
+                        rc = int(exc.code.value)
+                        return rc
+                    rc = ctx._finish_code()
+                    return rc
+                finally:
+                    _watchdog.call_finished(wd_tok, error=rc != 0)
+                    _tracing.tail_decide(tctx, _time.monotonic_ns() - t0,
+                                         error=rc != 0, method=path)
             except Exception as exc:  # handler raised: INTERNAL
                 try:
                     lib.tpr_srv_set_details(call, repr(exc).encode())

@@ -27,6 +27,7 @@ from typing import (Callable, Dict, Iterator, List, Optional,
 
 from tpurpc.core.endpoint import (Endpoint, EndpointError, EndpointListener,
                                   passthru_endpoint_pair)
+from tpurpc.obs import flight as _flight
 from tpurpc.obs import metrics as _obs_metrics
 from tpurpc.obs import tracing as _tracing
 from tpurpc.rpc import frame as fr
@@ -43,15 +44,23 @@ _log = logging.getLogger("tpurpc.server")
 #: perf_counter pair + one amortized histogram record per RPC — what
 #: `tools.top` renders as serving percentiles)
 _SRV_CALL_US = _obs_metrics.histogram("srv_call_us", kind="latency")
+#: tpurpc-blackbox (ISSUE 5): per-method, per-status-code RED counters
+#: (`srv_calls{method,code}` on /metrics); shared with the h2 plane
+_SRV_CALLS = _obs_metrics.labeled_counter("srv_calls", ("method", "code"))
 
 
 def _extract_trace(metadata) -> "Optional[_tracing.TraceContext]":
-    """The tpurpc-trace context a client attached, if sampling is live."""
-    if not _tracing.ACTIVE:
+    """The tpurpc-trace context a client attached (sampled or tail-
+    provisional), stripped from ``metadata`` IN PLACE — the context is
+    transport-internal and must not surface to handlers (grpcio parity
+    with te/content-type filtering; with tail capture on, EVERY call
+    carries it)."""
+    if not _tracing.LIVE:
         return None
-    for key, value in metadata:
+    for i, (key, value) in enumerate(metadata):
         if key == _tracing.HEADER:
-            return _tracing.TraceContext.decode(value)
+            del metadata[i]
+            return _tracing.adopt(value)
     return None
 
 
@@ -276,6 +285,9 @@ class _ServerStream:
         #: HEADERS-arrival stamp feeding the "dispatch" span
         self.trace_ctx = None
         self.trace_t0 = 0
+        #: tpurpc-blackbox: the status this stream terminated with (set at
+        #: every trailer-send site) — what srv_calls{method,code} records
+        self.final_code: Optional[StatusCode] = None
         #: reactor-path pending invocation: (handler, ctx, path) set by
         #: _start_stream for inline unary handlers; consumed by the sink's
         #: commit when the request completes (runs on the reader thread)
@@ -687,12 +699,15 @@ class _ServerConnection:
 
     def _inline_deadline(self, st: _ServerStream) -> None:
         if self._claim_inline(st) is not None:
+            _flight.emit(_flight.DEADLINE_EXPIRED,
+                         _flight.tag_for("srv-inline"), st.stream_id)
             self._send_trailers(st, StatusCode.DEADLINE_EXCEEDED,
                                 "deadline exceeded awaiting request")
             self._finish_stream(st)
 
     def _run_handler(self, handler: RpcMethodHandler, st: _ServerStream,
                      ctx: ServerContext, path: str) -> None:
+        from tpurpc.obs import watchdog as _watchdog
         from tpurpc.utils import stats as _stats
 
         counters = self.server.call_counters
@@ -703,7 +718,13 @@ class _ServerConnection:
             # HEADERS arrival → handler start: the queue/handoff interval
             _tracing.record("dispatch", tctx, st.trace_t0,
                             time.monotonic_ns() - st.trace_t0, method=path)
+        # tpurpc-blackbox: in-flight registration — the stall watchdog
+        # sweeps these and names the blocked stage for any call past its
+        # method's rolling-p99 multiple
+        wd_tok = _watchdog.call_started(
+            path, tctx.trace_id if tctx is not None else 0)
         t0 = time.perf_counter_ns()
+        t0_mono = time.monotonic_ns()
         try:
             with _tracing.use(tctx) if tctx is not None \
                     else _tracing.NULL_CM:
@@ -715,6 +736,14 @@ class _ServerConnection:
         finally:
             counters.on_finish(ok)
             _SRV_CALL_US.record((time.perf_counter_ns() - t0) // 1000)
+            code = st.final_code if st.final_code is not None \
+                else StatusCode.CANCELLED
+            _SRV_CALLS.labels(path, int(code)).inc()
+            _watchdog.call_finished(wd_tok, error=not ok)
+            # tail capture: commit the provisional span tree iff this call
+            # turned out pathological (slow for its method, or failed)
+            _tracing.tail_decide(tctx, time.monotonic_ns() - t0_mono,
+                                 error=not ok, method=path)
 
     def _run_handler_inner(self, handler: RpcMethodHandler, st: _ServerStream,
                            ctx: ServerContext, path: str) -> bool:
@@ -780,6 +809,7 @@ class _ServerConnection:
                 # write (one receiver wakeup instead of two). Serialization
                 # + the gathered write are the trace timeline's "respond".
                 code = ctx._code if ctx._code is not None else StatusCode.OK
+                st.final_code = code
                 try:
                     with (_tracing.span("respond", st.trace_ctx)
                           if st.trace_ctx is not None else _tracing.NULL_CM):
@@ -813,6 +843,7 @@ class _ServerConnection:
 
     def _send_trailers(self, st: _ServerStream, code: StatusCode, details: str,
                        metadata: Metadata = ()) -> None:
+        st.final_code = code
         try:
             try:
                 self.writer.send(fr.TRAILERS, fr.FLAG_END_STREAM, st.stream_id,

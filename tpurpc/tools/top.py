@@ -2,18 +2,22 @@
 
 Polls a tpurpc process's Prometheus endpoint (any serving port answers
 ``GET /metrics`` — see tpurpc/obs/scrape.py) and renders live QPS, handler
-latency percentiles, ring occupancy/credits, pipelined-window depth, and
-the fan-in batcher's batch-size/flush-reason profile.
+latency percentiles, ring occupancy/credits, pipelined-window depth, the
+fan-in batcher's batch-size/flush-reason profile, and — tpurpc-blackbox
+(ISSUE 5) — a stalls/anomalies pane fed by ``/debug/stalls`` (active
+watchdog diagnoses with their attributed stage, plus the trip counters).
 
     python -m tpurpc.tools.top HOST:PORT [--interval 1.0] [--once]
 
 ``--once`` prints a single snapshot (no screen clearing) — what the CI
-metrics smoke and scripts use.
+metrics smoke and scripts use. When stdout is not a TTY (CI logs, pipes),
+one-shot mode is the automatic default: no ANSI clears in captured logs.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import re
 import sys
 import time
@@ -49,6 +53,17 @@ def fetch(target: str, timeout: float = 5.0) -> Dict[Tuple[str, str], float]:
         return parse_prometheus(resp.read().decode("utf-8", "replace"))
 
 
+def fetch_stalls(target: str, timeout: float = 5.0) -> Optional[dict]:
+    """The watchdog's /debug/stalls snapshot, or None when unreachable /
+    pre-blackbox server (the dashboard degrades to 'n/a', never dies)."""
+    try:
+        with urllib.request.urlopen(f"http://{target}/debug/stalls",
+                                    timeout=timeout) as resp:
+            return json.loads(resp.read().decode("utf-8", "replace"))
+    except Exception:
+        return None
+
+
 def _val(m: Dict, name: str, labels: str = "") -> float:
     return m.get((name, labels), 0.0)
 
@@ -67,7 +82,7 @@ def _fmt_us(us: float) -> str:
 
 
 def render(cur: Dict, prev: Optional[Dict], dt: float,
-           target: str) -> str:
+           target: str, stalls: Optional[dict] = None) -> str:
     P = "tpurpc_"
     Q50 = 'quantile="0.5"'
     Q99 = 'quantile="0.99"'
@@ -133,6 +148,22 @@ def render(cur: Dict, prev: Optional[Dict], dt: float,
         hc = led.get('kind="host_copy"', 0)
         zc = led.get('kind="zero_copy"', 0)
         lines.append(f"copy  host {int(hc):>12}B   zero-copy {int(zc):>12}B")
+    # tpurpc-blackbox stalls/anomalies pane (/debug/stalls + trip counters)
+    trips = int(_val(cur, P + "watchdog_trips"))
+    errs = int(_sum_label(cur, P + "deadline_exceeded"))
+    if stalls is None:
+        lines.append(f"stall n/a (no /debug/stalls)   trips {trips}   "
+                     f"deadline-exceeded {errs}")
+    else:
+        active = stalls.get("active", [])
+        lines.append(
+            f"stall active {len(active)}   in-flight "
+            f"{stalls.get('inflight', 0)}   trips {trips}   "
+            f"deadline-exceeded {errs}")
+        for d in active[:3]:
+            lines.append(
+                f"  !! {d.get('kind', '?'):>6} {d.get('method', '?'):<28} "
+                f"{d.get('age_s', 0):>7.2f}s  {d.get('stage', '?')}")
     return "\n".join(lines)
 
 
@@ -141,8 +172,11 @@ def main(argv=None) -> int:
     ap.add_argument("target", help="HOST:PORT of any tpurpc serving port")
     ap.add_argument("--interval", type=float, default=1.0)
     ap.add_argument("--once", action="store_true",
-                    help="print one snapshot and exit")
+                    help="print one snapshot and exit (automatic when "
+                         "stdout is not a TTY — CI/pipe safe)")
     args = ap.parse_args(argv)
+    if not args.once and not sys.stdout.isatty():
+        args.once = True  # non-TTY: never emit ANSI clears into a log
 
     prev: Optional[Dict] = None
     t_prev = time.monotonic()
@@ -153,8 +187,9 @@ def main(argv=None) -> int:
             print(f"tpurpc-top: {args.target} unreachable: {exc}",
                   file=sys.stderr)
             return 1
+        stalls = fetch_stalls(args.target)
         now = time.monotonic()
-        out = render(cur, prev, now - t_prev, args.target)
+        out = render(cur, prev, now - t_prev, args.target, stalls=stalls)
         if args.once:
             print(out)
             return 0

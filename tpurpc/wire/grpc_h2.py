@@ -26,7 +26,9 @@ import zlib
 from typing import Dict, List, Optional, Tuple
 
 from tpurpc.core.endpoint import Endpoint, EndpointError
+from tpurpc.obs import flight as _flight
 from tpurpc.obs import metrics as _obs_metrics
+from tpurpc.obs import tracing as _tracing
 from tpurpc.rpc.status import AbortError, Metadata, StatusCode
 from tpurpc.utils import stats as _stats
 from tpurpc.wire import h2
@@ -38,6 +40,9 @@ from tpurpc.wire.hpack import HpackDecoder, HpackEncoder, HpackError
 _H2_SRV_CONNS = _obs_metrics.fleet("h2_server_connections")
 _H2_SRV_WINDOW = _obs_metrics.fleet("h2_server_send_window_bytes",
                                     lambda c: c._conn_window._value)
+#: tpurpc-blackbox (ISSUE 5): per-method per-status RED counters — shared
+#: with the native-framing server plane (same family, same labels)
+_SRV_CALLS = _obs_metrics.labeled_counter("srv_calls", ("method", "code"))
 
 _log = logging.getLogger("tpurpc.grpc_h2")
 
@@ -140,6 +145,10 @@ class _H2Stream:
         self.cancelled = threading.Event()
         self.window: Optional[h2.FlowWindow] = None  # send window, set by conn
         self.headers_sent = False
+        #: tpurpc-blackbox: the caller's trace context (tail capture rides
+        #: the h2 plane too) + the status the RED counters record
+        self.trace_ctx = None
+        self.final_code: Optional[StatusCode] = None
 
 
 class H2ServerContext:
@@ -216,6 +225,8 @@ class GrpcH2Connection:
         self._preface_left = len(h2.PREFACE) - preface_consumed
         self._headers_frag: Optional[Tuple[int, int, bytearray]] = None
         self.alive = True
+        self._ftag = _flight.tag_for("h2srv:" + getattr(endpoint, "peer",
+                                                        "?"))
         _H2_SRV_CONNS.track(self)
         _H2_SRV_WINDOW.track(self)
         self._send_settings()
@@ -288,6 +299,12 @@ class GrpcH2Connection:
         pos = 0
         while pos < len(mv):
             want = min(len(mv) - pos, self._peer_max_frame)
+            if st.window._value <= 0 or self._conn_window._value <= 0:
+                # about to block on peer credit: the h2-flow-control stall
+                # evidence the watchdog attributes from (edge-ish: once per
+                # starved chunk, not per healthy frame)
+                _flight.emit(_flight.H2_WINDOW_EXHAUSTED, self._ftag,
+                             st.stream_id)
             got = st.window.take(want, timeout=120)
             try:
                 conn_got = self._conn_window.take(got, timeout=120)
@@ -323,6 +340,7 @@ class GrpcH2Connection:
                       metadata: Metadata = ()) -> None:
         # initial metadata (when still unsent) and trailers gather into ONE
         # endpoint write — trailers-only responses cost a single syscall
+        st.final_code = code
         segs = self._response_header_segs(st)
         segs += self._trailer_segs(st, code, details, metadata)
         self._write(segs)
@@ -341,6 +359,7 @@ class GrpcH2Connection:
         if not self._conn_window.try_take(len(data)):
             st.window.grant(len(data))
             return False
+        st.final_code = code
         segs = self._response_header_segs(st)
         segs += h2.pack_frame(h2.DATA, 0, st.stream_id, data)
         segs += self._trailer_segs(st, code, details, metadata)
@@ -487,6 +506,7 @@ class GrpcH2Connection:
         metadata: List[Tuple[str, object]] = []
         timeout_s: Optional[float] = None
         encoding = "identity"
+        trace_raw: Optional[bytes] = None
         for name_b, value_b in headers:
             name = name_b.decode("ascii", "replace")
             if name.startswith(":"):
@@ -495,6 +515,10 @@ class GrpcH2Connection:
                 timeout_s = _parse_timeout(value_b.decode("ascii", "replace"))
             elif name == "grpc-encoding":
                 encoding = value_b.decode("ascii", "replace")
+            elif name == _tracing.HEADER:
+                # transport-internal like te/content-type: consumed here,
+                # never surfaced to handlers
+                trace_raw = value_b
             elif name in ("te", "content-type", "user-agent",
                           "grpc-accept-encoding", "accept-encoding"):
                 pass  # transport-level, not surfaced as metadata (grpcio parity)
@@ -503,6 +527,8 @@ class GrpcH2Connection:
         path = pseudo.get(":path", "")
         st = _H2Stream(sid)
         st.recv_encoding = encoding
+        if trace_raw is not None and _tracing.LIVE:
+            st.trace_ctx = _tracing.adopt(trace_raw)
         st.window = h2.FlowWindow(self._peer_initial_window)
         with self._lock:
             self._streams[sid] = st
@@ -594,13 +620,29 @@ class GrpcH2Connection:
 
     def _run_handler(self, handler, st: _H2Stream, ctx: H2ServerContext,
                      path: str) -> None:
+        from tpurpc.obs import watchdog as _watchdog
+
         counters = self.server.call_counters
         counters.on_start()
         ok = False
+        tctx = st.trace_ctx
+        wd_tok = _watchdog.call_started(
+            path, tctx.trace_id if tctx is not None else 0)
+        t0 = time.monotonic_ns()
         try:
-            ok = bool(self._run_handler_inner(handler, st, ctx, path))
+            with _tracing.use(tctx) if tctx is not None \
+                    else _tracing.NULL_CM:
+                with (_tracing.span("dispatch", tctx, method=path)
+                      if tctx is not None else _tracing.NULL_CM):
+                    ok = bool(self._run_handler_inner(handler, st, ctx, path))
         finally:
             counters.on_finish(ok)
+            code = st.final_code if st.final_code is not None \
+                else StatusCode.CANCELLED
+            _SRV_CALLS.labels(path, int(code)).inc()
+            _watchdog.call_finished(wd_tok, error=not ok)
+            _tracing.tail_decide(tctx, time.monotonic_ns() - t0,
+                                 error=not ok, method=path)
 
     def _run_handler_inner(self, handler, st: _H2Stream,
                            ctx: H2ServerContext, path: str):

@@ -38,6 +38,7 @@ import time
 from typing import Dict, Iterable, List, Optional, Tuple
 
 from tpurpc.core.endpoint import Endpoint, EndpointError, TcpEndpoint
+from tpurpc.obs import flight as _flight
 from tpurpc.obs import metrics as _obs_metrics
 from tpurpc.rpc.status import Metadata, RpcError, StatusCode
 from tpurpc.utils import stats as _stats
@@ -185,6 +186,7 @@ class H2Channel:
         self._peer_initial_window = h2.DEFAULT_WINDOW
         self._conn_window = h2.FlowWindow(h2.DEFAULT_WINDOW)  # our sends
         self._settings_acked = threading.Event()
+        self._ftag = _flight.tag_for("h2cli:" + str(target))
         _H2_CLI_CONNS.track(self)
         _H2_CLI_WINDOW.track(self)
 
@@ -515,6 +517,11 @@ class H2Channel:
         view = memoryview(buf)
         while view:
             want = min(len(view), self._peer_max_frame)
+            if call.window._value <= 0 or self._conn_window._value <= 0:
+                # tpurpc-blackbox: about to block on peer WINDOW_UPDATE
+                # credit — the watchdog's h2-flow-control stall evidence
+                _flight.emit(_flight.H2_WINDOW_EXHAUSTED, self._ftag,
+                             call.stream_id)
             try:
                 got = call.window.take(want, timeout=call._remaining())
                 conn_got = self._conn_window.take(got,
