@@ -368,6 +368,240 @@ def test_connection_churn_soak_no_leak(monkeypatch):
         srv.stop(grace=0)
 
 
+@pytest.mark.parametrize("platform", ["TCP", "RDMA_BPEV"])
+def test_fleet_drain_zero_failed_rpcs(monkeypatch, platform):
+    """tpurpc-fleet (ISSUE 6) acceptance: a 3-server fleet under steady
+    pipelined traffic, one server drained mid-flight — ZERO failed RPCs,
+    the drain completes within its linger budget, migration is visible
+    (the drained server receives no calls afterwards), and the flight
+    ring replays drain-begin → drain-end in order."""
+    from tpurpc.obs import flight
+
+    monkeypatch.setenv("GRPC_PLATFORM_TYPE", platform)
+    from tpurpc.utils import config as config_mod
+
+    config_mod.set_config(None)
+    flight.RECORDER.reset()
+
+    rigs = []
+    for i in range(3):
+        # native_dataplane=False: the drain machinery under test (GOAWAY +
+        # refused-stream migration) is the Python plane's
+        srv = tps.Server(max_workers=8, native_dataplane=False)
+        calls = []
+
+        def handler(req, ctx, _c=calls):
+            _c.append(1)
+            time.sleep(0.002)
+            return req
+
+        srv.add_method("/fd.S/Echo",
+                       tps.unary_unary_rpc_method_handler(handler))
+        port = srv.add_insecure_port("127.0.0.1:0")
+        srv.start()
+        rigs.append((srv, port, calls))
+    addrs = ",".join(f"127.0.0.1:{p}" for _, p, _ in rigs)
+    try:
+        with tps.Channel(f"ipv4:{addrs}", lb_policy="round_robin") as ch:
+            pipe = ch.unary_unary("/fd.S/Echo").pipeline(depth=4)
+            futs = []
+            drain_result = []
+
+            def drainer():
+                drain_result.append(rigs[1][0].drain(linger=10.0))
+
+            t_end = time.monotonic() + 0.6
+            while time.monotonic() < t_end:
+                futs.append(pipe.call_async(b"x", timeout=30))
+                time.sleep(0.002)
+            dt = threading.Thread(target=drainer)
+            t_drain = time.monotonic_ns()
+            dt.start()
+            t_end = time.monotonic() + 1.2
+            while time.monotonic() < t_end:
+                futs.append(pipe.call_async(b"x", timeout=30))
+                time.sleep(0.002)
+            dt.join(timeout=30)
+            # zero failed RPCs: every future resolves OK
+            for f in futs:
+                assert bytes(f.result(timeout=30)) == b"x"
+            assert drain_result == [True], "drain missed its linger budget"
+            # migration: the drained server gets NO further traffic
+            settled = len(rigs[1][2])
+            more = [pipe.call_async(b"y", timeout=30) for _ in range(30)]
+            for f in more:
+                assert bytes(f.result(timeout=30)) == b"y"
+            assert len(rigs[1][2]) == settled, "drained server saw traffic"
+            assert len(rigs[0][2]) + len(rigs[2][2]) > 0
+            pipe.close()
+        events = [(e["event"], e["t_ns"]) for e in flight.snapshot()]
+        t_begin = next((t for ev, t in events if ev == "drain-begin"), None)
+        t_done = next((t for ev, t in events if ev == "drain-end"), None)
+        assert t_begin is not None and t_done is not None, events
+        assert t_drain <= t_begin <= t_done
+    finally:
+        for srv, _, _ in rigs:
+            srv.stop(grace=0)
+        config_mod.set_config(None)
+
+
+@pytest.mark.parametrize("platform", ["TCP", "RDMA_BPEV"])
+def test_partition_peer_stops_reading_names_stage(monkeypatch, platform):
+    """Chaos scenario: network partition mid-stream — the peer stays
+    connected but stops reading. The server handler wedges in the
+    transport write; the watchdog must diagnose it (naming a write-side
+    stage on the ring plane, where the flight ring carries the credit
+    evidence) and the flight sequence must be ordered."""
+    from tpurpc.obs import flight, watchdog
+
+    monkeypatch.setenv("GRPC_PLATFORM_TYPE", platform)
+    from tpurpc.utils import config as config_mod
+
+    config_mod.set_config(None)
+    flight.RECORDER.reset()
+    wd = watchdog.get()
+    wd.reset()
+    prev = (wd.min_stall_s, wd.sweep_s, wd.mult)
+    wd.min_stall_s, wd.sweep_s, wd.mult = 0.3, 0.1, 8.0
+
+    srv = tps.Server(max_workers=4, native_dataplane=False)
+    chunk = b"\x5a" * (256 * 1024)
+
+    def firehose(req, ctx):
+        for _ in range(100_000):
+            if not ctx.is_active():
+                return
+            yield chunk
+
+    srv.add_method("/pt.S/Hose", tps.unary_stream_rpc_method_handler(firehose))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    t_start = time.monotonic_ns()
+    try:
+        with tps.Channel(f"127.0.0.1:{port}") as ch:
+            call = ch.unary_stream("/pt.S/Hose", tpurpc_native=False)(
+                b"", timeout=60)
+            it = iter(call)
+            for _ in range(3):
+                next(it)  # stream established and flowing
+            # ... then the partition: this peer never reads again. The
+            # client's per-stream credits fill, its reader stops draining
+            # the transport, and the server's writer wedges.
+            diag = None
+            deadline = time.monotonic() + 15
+            while diag is None and time.monotonic() < deadline:
+                time.sleep(0.15)
+                for d in wd.sweep_once():
+                    if d["method"] == "/pt.S/Hose":
+                        diag = d
+                        break
+            assert diag is not None, "watchdog never diagnosed the wedge"
+            assert diag["stage"] in watchdog.STAGES
+            assert diag["stage"] != "unknown"
+            if platform == "RDMA_BPEV":
+                # the ring plane carries the credit evidence: the stage
+                # must name the write side, and the flight ring must hold
+                # the starvation edge that justified it
+                assert diag["stage"] in ("credit-starvation",
+                                         "peer-not-reading"), diag
+                evs = [(e["event"], e["t_ns"]) for e in flight.snapshot()]
+                starves = [t for ev, t in evs
+                           if ev in ("credit-starve-begin",
+                                     "write-stall-begin")]
+                assert starves and starves[0] >= t_start
+            # the trip itself is flight evidence on BOTH planes, ordered
+            # after the stream began
+            trips = [e for e in flight.snapshot()
+                     if e["event"] == "watchdog-trip"]
+            assert trips and trips[0]["t_ns"] >= t_start
+            call.cancel()
+    finally:
+        wd.min_stall_s, wd.sweep_s, wd.mult = prev
+        wd.reset()
+        srv.stop(grace=0)
+        config_mod.set_config(None)
+        # leave no wedged pair behind: a write-stalled fleet gauge that
+        # outlives this test would skew the NEXT test's stage attribution
+        from tpurpc.obs import metrics as _metrics
+
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            gauge = _metrics.registry().metrics().get("pairs_write_stalled")
+            if gauge is None or gauge.collect()[0] == 0:
+                break
+            time.sleep(0.1)
+
+
+@pytest.mark.parametrize("platform", ["TCP", "RDMA_BPEV"])
+def test_slow_peer_names_device_infer_stage(monkeypatch, platform):
+    """Chaos scenario: slow peer — an artificially delayed handler with a
+    quiet transport. The watchdog must attribute the stall to the handler
+    (device-infer), NOT to a transport stage, and the flight replay must
+    order the trip inside the call's lifetime."""
+    from tpurpc.obs import flight, watchdog
+
+    monkeypatch.setenv("GRPC_PLATFORM_TYPE", platform)
+    from tpurpc.utils import config as config_mod
+
+    config_mod.set_config(None)
+    # settle: a wedged pair from a PRIOR test (the partition scenario) must
+    # finish dying first, or its write-stall fleet gauge would skew this
+    # test's stage attribution toward the transport
+    from tpurpc.obs import metrics as _metrics
+
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        gauge = _metrics.registry().metrics().get("pairs_write_stalled")
+        if gauge is None or gauge.collect()[0] == 0:
+            break
+        time.sleep(0.1)
+    flight.RECORDER.reset()
+    wd = watchdog.get()
+    wd.reset()
+    prev = (wd.min_stall_s, wd.sweep_s, wd.mult)
+    wd.min_stall_s, wd.sweep_s, wd.mult = 0.3, 0.1, 8.0
+
+    srv = tps.Server(max_workers=4, native_dataplane=False)
+
+    def slow(req, ctx):
+        time.sleep(1.2)
+        return req
+
+    srv.add_method("/sp.S/Slow", tps.unary_unary_rpc_method_handler(slow))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    t_start = time.monotonic_ns()
+    try:
+        with tps.Channel(f"127.0.0.1:{port}") as ch:
+            mc = ch.unary_unary("/sp.S/Slow", tpurpc_native=False)
+            result = []
+            t = threading.Thread(
+                target=lambda: result.append(bytes(mc(b"z", timeout=30))))
+            t.start()
+            diag = None
+            deadline = time.monotonic() + 10
+            while diag is None and time.monotonic() < deadline:
+                time.sleep(0.1)
+                for d in wd.sweep_once():
+                    if d["method"] == "/sp.S/Slow" and d["kind"] == "server":
+                        diag = d
+                        break
+            assert diag is not None, "watchdog never diagnosed the slow peer"
+            assert diag["stage"] == "device-infer", diag
+            t.join(timeout=30)
+            assert result == [b"z"]  # the call itself completes fine
+            t_done = time.monotonic_ns()
+            trips = [e for e in flight.snapshot()
+                     if e["event"] == "watchdog-trip"]
+            assert trips, "no watchdog-trip flight event"
+            assert t_start <= trips[0]["t_ns"] <= t_done
+    finally:
+        wd.min_stall_s, wd.sweep_s, wd.mult = prev
+        wd.reset()
+        srv.stop(grace=0)
+        config_mod.set_config(None)
+
+
 def test_connection_churn_soak_tcpw_domain(monkeypatch):
     """The same churn-flatness guard over the CROSS-HOST tcp_window
     domain: every connection bootstraps a socket-carried one-sided ring,

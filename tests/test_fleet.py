@@ -1,0 +1,453 @@
+"""tpurpc-fleet (ISSUE 6): hedged retries, load-aware picking, graceful
+drain, and overload admission control — the fleet front door.
+
+The gRFC A6 hedging state machine, the ORCA-style load-report loop
+(server piggyback → client strip → least_loaded EWMA), the admission
+gate's shed-with-pushback contract, and their flight-recorder evidence.
+The multi-server chaos scenarios live in test_chaos.py; this file is the
+per-mechanism contract."""
+
+import threading
+import time
+
+import pytest
+
+import tpurpc.rpc as tps
+from tpurpc.obs import flight
+from tpurpc.rpc import health
+from tpurpc.rpc.channel import (Channel, HedgingPolicy, RetryPolicy,
+                                _LOAD_KEY, _PUSHBACK_KEY)
+from tpurpc.rpc.resolver import LeastLoaded, make_policy
+from tpurpc.rpc.server import (LOAD_KEY, PUSHBACK_KEY, AdmissionGate,
+                               Server)
+from tpurpc.rpc.service_config import ServiceConfig
+from tpurpc.rpc.status import RpcError, StatusCode
+
+
+def test_metadata_keys_agree_across_modules():
+    # channel.py carries its own literals to avoid a server import in the
+    # client module; they MUST stay in lockstep with the server's
+    assert _LOAD_KEY == LOAD_KEY
+    assert _PUSHBACK_KEY == PUSHBACK_KEY
+
+
+def _server(name: str, delay: float = 0.0, max_workers: int = 8, **kw):
+    srv = Server(max_workers=max_workers, **kw)
+    calls = []
+
+    def who(req, ctx):
+        calls.append(bytes(req))
+        if delay:
+            time.sleep(delay)
+        return name.encode()
+
+    srv.add_method("/fleet.S/Who", tps.unary_unary_rpc_method_handler(who))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    return srv, port, calls
+
+
+# -- hedging ------------------------------------------------------------------
+
+def test_hedging_policy_validation():
+    with pytest.raises(ValueError):
+        HedgingPolicy(max_attempts=1)
+    with pytest.raises(ValueError):
+        HedgingPolicy(hedging_delay=-0.1)
+
+
+def test_service_config_parses_hedging_policy():
+    sc = ServiceConfig.from_json({"methodConfig": [{
+        "name": [{"service": "fleet.S"}],
+        "hedgingPolicy": {"maxAttempts": 7, "hedgingDelay": "0.02s",
+                          "nonFatalStatusCodes": ["UNAVAILABLE",
+                                                  "ABORTED"]}}]})
+    hp = sc.for_method("/fleet.S/Who").hedging_policy
+    assert hp.max_attempts == 5  # capped like retryPolicy
+    assert hp.hedging_delay == pytest.approx(0.02)
+    assert StatusCode.ABORTED in hp.non_fatal_codes
+
+
+def test_service_config_rejects_retry_plus_hedging():
+    with pytest.raises(ValueError):
+        ServiceConfig.from_json({"methodConfig": [{
+            "name": [{}],
+            "retryPolicy": {"maxAttempts": 2,
+                            "retryableStatusCodes": ["UNAVAILABLE"]},
+            "hedgingPolicy": {"maxAttempts": 2}}]})
+
+
+def test_hedge_beats_slow_replica_and_cancels_loser():
+    """One slow replica; the hedge fires after the delay, wins on the fast
+    one, and the flight ring shows fired → won → cancelled."""
+    s1, p1, calls1 = _server("slow", delay=0.5)
+    s2, p2, _ = _server("fast")
+    flight.RECORDER.reset()
+    try:
+        with Channel(f"ipv4:127.0.0.1:{p1},127.0.0.1:{p2}",
+                     lb_policy="pick_first",
+                     hedging_policy=HedgingPolicy(max_attempts=3,
+                                                  hedging_delay=0.02)) as ch:
+            mc = ch.unary_unary("/fleet.S/Who")
+            t0 = time.monotonic()
+            assert bytes(mc(b"x", timeout=5)) == b"fast"
+            assert time.monotonic() - t0 < 0.4  # did not wait out the slow
+        events = [e["event"] for e in flight.snapshot()]
+        assert "hedge-fired" in events
+        assert "hedge-won" in events
+        assert "hedge-cancelled" in events
+        fired = [e for e in flight.snapshot() if e["event"] == "hedge-fired"]
+        won = [e for e in flight.snapshot() if e["event"] == "hedge-won"]
+        assert fired[0]["t_ns"] <= won[0]["t_ns"]
+    finally:
+        s1.stop(grace=0)
+        s2.stop(grace=0)
+
+
+def test_hedge_attempts_prefer_distinct_subchannels():
+    """With every replica slow, max_attempts hedges land on DISTINCT
+    backends (the used-subchannel exclusion), not the same one thrice."""
+    rigs = [_server(f"s{i}", delay=0.3) for i in range(3)]
+    addrs = ",".join(f"127.0.0.1:{p}" for _, p, _ in rigs)
+    try:
+        with Channel(f"ipv4:{addrs}", lb_policy="pick_first",
+                     hedging_policy=HedgingPolicy(max_attempts=3,
+                                                  hedging_delay=0.01)) as ch:
+            mc = ch.unary_unary("/fleet.S/Who")
+            mc(b"x", timeout=5)
+        time.sleep(0.4)  # let cancelled losers' handlers finish appending
+        touched = sum(1 for _, _, calls in rigs if calls)
+        assert touched == 3, [len(c) for _, _, c in rigs]
+    finally:
+        for s, _, _ in rigs:
+            s.stop(grace=0)
+
+
+def test_hedging_no_delay_on_healthy_fleet():
+    """A fast first response means NO hedge fires — hedging must cost a
+    healthy fleet nothing."""
+    s1, p1, calls1 = _server("a")
+    flight.RECORDER.reset()
+    try:
+        with Channel(f"ipv4:127.0.0.1:{p1}",
+                     hedging_policy=HedgingPolicy(max_attempts=3,
+                                                  hedging_delay=0.25)) as ch:
+            mc = ch.unary_unary("/fleet.S/Who")
+            for _ in range(5):
+                assert bytes(mc(b"x", timeout=5)) == b"a"
+        assert len(calls1) == 5  # no duplicate attempts
+        events = [e["event"] for e in flight.snapshot()]
+        assert "hedge-fired" not in events
+    finally:
+        s1.stop(grace=0)
+
+
+def test_hedging_gated_by_retry_throttle():
+    """A drained retry-throttle bucket suppresses hedges — the gRFC A6
+    no-retry-storm rule applies to hedging too."""
+    s1, p1, calls1 = _server("only", delay=0.15)
+    try:
+        with Channel(f"ipv4:127.0.0.1:{p1}",
+                     hedging_policy=HedgingPolicy(max_attempts=3,
+                                                  hedging_delay=0.01)) as ch:
+            ch.update_service_config(
+                {"retryThrottling": {"maxTokens": 10, "tokenRatio": 0.1}})
+            ch._service_config.retry_throttle._tokens = 0.0  # drained
+            mc = ch.unary_unary("/fleet.S/Who")
+            assert bytes(mc(b"x", timeout=5)) == b"only"
+        time.sleep(0.2)
+        assert len(calls1) == 1  # no hedge was allowed to fire
+    finally:
+        s1.stop(grace=0)
+
+
+def test_hedging_fatal_status_resolves_immediately():
+    """A non-retryable failure (here INVALID_ARGUMENT) must surface at
+    once instead of waiting out other hedges."""
+    srv = Server(max_workers=4)
+
+    def bad(req, ctx):
+        ctx.abort(StatusCode.INVALID_ARGUMENT, "nope")
+
+    srv.add_method("/fleet.S/Who", tps.unary_unary_rpc_method_handler(bad))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    try:
+        with Channel(f"ipv4:127.0.0.1:{port}",
+                     hedging_policy=HedgingPolicy(max_attempts=3,
+                                                  hedging_delay=1.0)) as ch:
+            t0 = time.monotonic()
+            with pytest.raises(RpcError) as ei:
+                ch.unary_unary("/fleet.S/Who")(b"x", timeout=10)
+            assert ei.value.code() is StatusCode.INVALID_ARGUMENT
+            assert time.monotonic() - t0 < 0.9  # not a hedging_delay wait
+    finally:
+        srv.stop(grace=0)
+
+
+# -- load reports + least_loaded ----------------------------------------------
+
+def test_load_report_stripped_from_app_metadata():
+    """The piggyback is transport-internal: trailing metadata surfaced to
+    the application must NOT contain the load key."""
+    s1, p1, _ = _server("a")
+    try:
+        with Channel(f"127.0.0.1:{p1}") as ch:
+            mc = ch.unary_unary("/fleet.S/Who", tpurpc_native=False)
+            _resp, call = mc.with_call(b"x", timeout=5)
+            keys = [k for k, _v in (call.trailing_metadata() or ())]
+            assert _LOAD_KEY not in keys
+    finally:
+        s1.stop(grace=0)
+
+
+def test_least_loaded_policy_feeds_from_responses():
+    """End-to-end loop: server piggyback → channel strip → policy EWMA."""
+    s1, p1, _ = _server("a")
+    s2, p2, _ = _server("b")
+    try:
+        with Channel(f"ipv4:127.0.0.1:{p1},127.0.0.1:{p2}",
+                     lb_policy="least_loaded") as ch:
+            mc = ch.unary_unary("/fleet.S/Who")
+            for _ in range(6):
+                mc(b"x", timeout=5)
+            snap = ch._policy.snapshot()
+            assert any(snap["reported"]), snap
+    finally:
+        s1.stop(grace=0)
+        s2.stop(grace=0)
+
+
+def test_least_loaded_orders_by_reported_load():
+    pol = make_policy("least_loaded", 3)
+    pol.load_report(0, b"9,4,0.0")   # util 13
+    pol.load_report(1, b"1,0,0.0")   # util 1
+    pol.load_report(2, b"4,1,0.0")   # util 5
+    order = list(pol.order())
+    assert order == [1, 2, 0]
+    # reports keep steering after EWMA updates
+    for _ in range(8):
+        pol.load_report(1, b"50,0,0.0")
+    assert list(pol.order())[0] != 1
+
+
+def test_least_loaded_parse_tolerates_junk():
+    assert LeastLoaded.parse_report(b"3,5,12.5") == (8.0, 12.5)
+    assert LeastLoaded.parse_report(b"3") == (3.0, 0.0)
+    assert LeastLoaded.parse_report(b"junk") is None
+    assert LeastLoaded.parse_report("") is None
+    pol = LeastLoaded(2)
+    pol.load_report(7, b"1,1,1")  # out-of-range index: ignored
+    pol.load_report(0, b"not,numbers")
+    assert pol.snapshot()["reported"] == [False, False]
+
+
+def test_least_loaded_ejects_erroring_and_reinstates():
+    flight.RECORDER.reset()
+    pol = LeastLoaded(3, ejection_failures=2, ejection_s=0.2)
+    for _ in range(2):
+        pol.failed(1)
+    snap = pol.snapshot()
+    assert snap["ejected"] == [False, True, False]
+    assert list(pol.order())[-1] == 1  # ejected sorts last, never dropped
+    events = [e for e in flight.snapshot() if e["event"] == "subch-ejected"]
+    assert events and events[0]["a1"] == 1 and events[0]["a2"] == 0
+    time.sleep(0.25)
+    pol.order()  # expiry observed on the next pick
+    assert pol.snapshot()["ejected"] == [False, False, False]
+    assert any(e["event"] == "subch-reinstated" and e["a1"] == 1
+               for e in flight.snapshot())
+
+
+def test_least_loaded_ejects_slow_outlier():
+    flight.RECORDER.reset()
+    pol = LeastLoaded(3, slow_mult=3.0)
+    for _ in range(4):
+        pol.load_report(0, b"1,0,5.0")
+        pol.load_report(1, b"1,0,5.0")
+        pol.load_report(2, b"1,0,500.0")  # GC-hell replica: modest load,
+    snap = pol.snapshot()                  # garbage latency
+    assert snap["ejected"] == [False, False, True]
+    events = [e for e in flight.snapshot() if e["event"] == "subch-ejected"]
+    assert events and events[-1]["a1"] == 2 and events[-1]["a2"] == 1
+
+
+# -- admission control --------------------------------------------------------
+
+def test_admission_gate_validation_and_env():
+    with pytest.raises(ValueError):
+        AdmissionGate(0)
+    with pytest.raises(ValueError):
+        AdmissionGate(4, soft_limit=9)
+    assert AdmissionGate.from_env() is None  # unset: opt-in
+
+
+def test_admission_gate_soft_hard_and_release():
+    gate = AdmissionGate(3, soft_limit=2)
+    assert gate.try_admit() is None
+    assert gate.try_admit() is None
+    # between soft and hard with no SLO configured: admitted
+    assert gate.try_admit() is None
+    pb = gate.try_admit()  # at the hard limit: shed, pushback grows
+    assert isinstance(pb, int) and pb >= gate.base_pushback_ms
+    assert gate.rejected == 1
+    gate.release()
+    assert gate.try_admit() is None
+
+
+def test_admission_shed_carries_pushback_and_recovers():
+    srv = Server(max_workers=8, admission=AdmissionGate(2, soft_limit=2))
+    gate_open = threading.Event()
+
+    def slow(req, ctx):
+        gate_open.wait(5)
+        return b"ok"
+
+    srv.add_method("/fleet.S/Slow", tps.unary_unary_rpc_method_handler(slow))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    flight.RECORDER.reset()
+    try:
+        with Channel(f"127.0.0.1:{port}") as ch:
+            mc = ch.unary_unary("/fleet.S/Slow", tpurpc_native=False)
+            futs = [mc.future(b"", timeout=10) for _ in range(2)]
+            deadline = time.monotonic() + 5
+            shed = None
+            while shed is None and time.monotonic() < deadline:
+                try:
+                    mc(b"", timeout=2)
+                except RpcError as exc:
+                    if exc.code() is StatusCode.UNAVAILABLE:
+                        shed = exc
+                time.sleep(0.02)
+            assert shed is not None, "gate never shed"
+            md = dict(shed.trailing_metadata() or ())
+            assert _PUSHBACK_KEY in md and int(md[_PUSHBACK_KEY]) > 0
+            assert "overloaded" in shed.details()
+            assert any(e["event"] == "admit-reject"
+                       for e in flight.snapshot())
+            gate_open.set()
+            for f in futs:
+                f.result(timeout=10)
+            # capacity released: admitted again
+            assert bytes(mc(b"", timeout=5)) == b"ok"
+    finally:
+        gate_open.set()
+        srv.stop(grace=0)
+
+
+def test_admission_exempts_health_probes():
+    srv = Server(max_workers=8, admission=AdmissionGate(1, soft_limit=1))
+    servicer = health.add_health_servicer(srv)
+    hold = threading.Event()
+
+    def slow(req, ctx):
+        hold.wait(5)
+        return b"ok"
+
+    srv.add_method("/fleet.S/Slow", tps.unary_unary_rpc_method_handler(slow))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    try:
+        with Channel(f"127.0.0.1:{port}") as ch:
+            mc = ch.unary_unary("/fleet.S/Slow", tpurpc_native=False)
+            fut = mc.future(b"", timeout=10)  # occupies the whole gate
+            time.sleep(0.2)
+            check = ch.unary_unary(f"/{health.SERVICE_NAME}/Check",
+                                   tpurpc_native=False)
+            # the probe is admitted even though the gate is full
+            assert health.decode_response(
+                check(health.encode_request(""), timeout=5)) \
+                is health.ServingStatus.SERVING
+            hold.set()
+            fut.result(timeout=10)
+    finally:
+        hold.set()
+        srv.stop(grace=0)
+        _ = servicer
+
+
+def test_retry_policy_honors_pushback_floor(monkeypatch):
+    """RetryPolicy sleeps at least the server-named pushback before the
+    next attempt (the shed is not immediately re-hammered)."""
+    attempts = []
+
+    def attempt():
+        attempts.append(time.monotonic())
+        if len(attempts) == 1:
+            raise RpcError(StatusCode.UNAVAILABLE, "shed",
+                           [(_PUSHBACK_KEY, "200")])
+        return "ok"
+
+    policy = RetryPolicy(max_attempts=3, initial_backoff=0.001,
+                         max_backoff=0.002)
+    assert policy.run(None, attempt) == "ok"
+    assert attempts[1] - attempts[0] >= 0.2 * 0.95
+
+
+def test_pushback_stops_hedging():
+    """An admission-shedding fleet must not receive further hedges: the
+    pushback resolves the hedged call with the shed failure once the
+    original attempt is done, without launching more attempts."""
+    srv = Server(max_workers=4)
+    seen = []
+
+    def shed(req, ctx):
+        seen.append(1)
+        ctx.set_trailing_metadata([(_PUSHBACK_KEY, "100")])
+        ctx.abort(StatusCode.UNAVAILABLE, "synthetic shed")
+
+    srv.add_method("/fleet.S/Who", tps.unary_unary_rpc_method_handler(shed))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    try:
+        with Channel(f"ipv4:127.0.0.1:{port}",
+                     hedging_policy=HedgingPolicy(max_attempts=3,
+                                                  hedging_delay=0.01)) as ch:
+            with pytest.raises(RpcError) as ei:
+                ch.unary_unary("/fleet.S/Who")(b"x", timeout=5)
+            assert ei.value.code() is StatusCode.UNAVAILABLE
+        time.sleep(0.2)
+        assert len(seen) == 1, seen  # pushback stopped attempts 2..N
+    finally:
+        srv.stop(grace=0)
+
+
+# -- drain --------------------------------------------------------------------
+
+def test_drain_sets_health_and_draining_flag():
+    srv = Server(max_workers=4)
+    servicer = health.add_health_servicer(srv)
+    servicer.set("fleet.S", health.ServingStatus.SERVING)
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    try:
+        assert srv.draining is False
+        assert srv.drain(linger=1.0) is True  # no streams: clean
+        assert srv.draining is True
+        with Channel(f"127.0.0.1:{port}") as ch:
+            check = ch.unary_unary(f"/{health.SERVICE_NAME}/Check",
+                                   tpurpc_native=False)
+            # overall AND named services answer NOT_SERVING (set_all)
+            for svc in ("", "fleet.S"):
+                st = health.decode_response(
+                    check(health.encode_request(svc), timeout=5))
+                assert st is health.ServingStatus.NOT_SERVING, svc
+    finally:
+        srv.stop(grace=0)
+
+
+def test_drain_is_idempotent_and_flight_ordered():
+    flight.RECORDER.reset()
+    srv, port, _ = _server("d")
+    try:
+        assert srv.drain(linger=1.0) is True
+        assert srv.drain(linger=0.1) is True  # second call: re-wait only
+        begins = [e for e in flight.snapshot()
+                  if e["event"] == "drain-begin"]
+        ends = [e for e in flight.snapshot() if e["event"] == "drain-end"]
+        assert len(begins) == 1 and len(ends) == 1  # one drain, one pair
+        assert begins[0]["t_ns"] <= ends[0]["t_ns"]
+        assert ends[0]["a1"] == 0  # clean: nothing left at budget expiry
+    finally:
+        srv.stop(grace=0)

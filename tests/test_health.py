@@ -117,6 +117,82 @@ def test_proto_roundtrip_and_unknown_fields():
     assert health.decode_request(extra) == "svc"
 
 
+def test_drain_reports_draining_not_serving():
+    """tpurpc-fleet (ISSUE 6): during Server.drain() the health service
+    answers NOT_SERVING (overall and named services) and /healthz reports
+    'draining' with a 200 — healthy-but-leaving, distinct from the
+    watchdog's degraded 503."""
+    from tpurpc.obs import scrape, watchdog
+
+    srv, servicer, port = _rig()
+    servicer.set("drain.Svc", health.ServingStatus.SERVING)
+    try:
+        watchdog.get().reset()  # no stale degraded state from other tests
+        status, _ctype, body = scrape._route("/healthz")
+        assert (status, body) == (200, b"ok\n")
+        assert srv.drain(linger=1.0) is True  # no streams: clean drain
+        with tps.Channel(f"127.0.0.1:{port}") as ch:
+            check = ch.unary_unary(f"/{health.SERVICE_NAME}/Check",
+                                   tpurpc_native=False)
+            for svc in ("", "drain.Svc"):
+                got = health.decode_response(
+                    check(health.encode_request(svc), timeout=10))
+                assert got is health.ServingStatus.NOT_SERVING, svc
+        status, _ctype, body = scrape._route("/healthz")
+        assert status == 200, "draining is NOT a failure state"
+        assert body == b"draining\n"
+    finally:
+        srv.stop(grace=0)
+    # /healthz recovers once the drained server object is gone (channelz
+    # holds it weakly; winding-down connections pin it briefly after stop)
+    import gc
+
+    del srv
+    deadline = time.monotonic() + 10
+    body = b""
+    while time.monotonic() < deadline:
+        gc.collect()
+        _status, _ctype, body = scrape._route("/healthz")
+        if body == b"ok\n":
+            break
+        time.sleep(0.1)
+    assert body == b"ok\n"
+
+
+def test_watch_sees_drain_transition():
+    """A health Watch stream open across Server.drain() observes the
+    SERVING → NOT_SERVING transition (set_all bumps one epoch) before the
+    drained connection winds down."""
+    srv, servicer, port = _rig()
+    servicer.set("wd.Svc", health.ServingStatus.SERVING)
+    seen = []
+    try:
+        def watch():
+            try:
+                with tps.Channel(f"127.0.0.1:{port}") as ch:
+                    stream = ch.unary_stream(
+                        f"/{health.SERVICE_NAME}/Watch", tpurpc_native=False)(
+                        health.encode_request("wd.Svc"), timeout=30)
+                    for msg in stream:
+                        seen.append(health.decode_response(msg))
+                        if seen[-1] is health.ServingStatus.NOT_SERVING:
+                            return
+            except RpcError:
+                pass  # the draining server may close after delivery
+
+        t = threading.Thread(target=watch, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 10
+        while not seen and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert seen and seen[0] is health.ServingStatus.SERVING
+        srv.drain(linger=5.0)
+        t.join(timeout=10)
+        assert health.ServingStatus.NOT_SERVING in seen, seen
+    finally:
+        srv.stop(grace=0)
+
+
 def test_malformed_request_maps_to_invalid_argument():
     srv, _, port = _rig()
     try:

@@ -54,6 +54,13 @@ python -m tpurpc.tools.obs_smoke || fail=1
 note "tpurpc-blackbox watchdog smoke (wedge + diagnose + tail capture)"
 python -m tpurpc.tools.watchdog_smoke || fail=1
 
+# 2e) tpurpc-fleet smoke (ISSUE 6): 3 servers behind round_robin, hedged
+#     clients; one server degrades + dies, another drains mid-traffic —
+#     zero failed RPCs, hedge + drain flight events present and ordered.
+#     ~3s, no jax.
+note "tpurpc-fleet smoke (kill + drain under hedged traffic)"
+python -m tpurpc.tools.fleet_smoke || fail=1
+
 # 3) the analysis subsystem's own tests, plus a lock-order-instrumented run
 #    of the concurrency-heavy suites (TPURPC_DEBUG_LOCKS exercises the
 #    CheckedLock shim wired into poller/pair/xds/channel/channelz)

@@ -81,8 +81,16 @@ HOT_LOG_MODULES = (
 
 #: modules whose flight-recorder emission sites must stay on the
 #: preallocated-encoder discipline (ISSUE 5 — the recorder is ALWAYS on,
-#: so any per-event construction here is a permanent hot-path tax)
-FLIGHT_HOT_MODULES = HOT_LOG_MODULES
+#: so any per-event construction here is a permanent hot-path tax).
+#: tpurpc-fleet (ISSUE 6) extends the rule to the fleet plumbing: the
+#: hedge / drain / admission / subchannel-ejection emission sites in the
+#: channel, server, and resolver run per-RPC or per-pick — same
+#: discipline, interned tags, pure-int args.
+FLIGHT_HOT_MODULES = HOT_LOG_MODULES + (
+    os.path.join("tpurpc", "rpc", "channel.py"),
+    os.path.join("tpurpc", "rpc", "server.py"),
+    os.path.join("tpurpc", "rpc", "resolver.py"),
+)
 
 #: module suffix -> qualified functions on its INLINE DISPATCH path (the
 #: reactor invocation from _ServerSink.commit: these run on the connection

@@ -59,7 +59,13 @@ from tpurpc.utils.config import get_config
 _PAIRS_CONNECTED = _metrics.fleet(
     "pairs_connected", lambda p: 1.0 if p.state.name == "CONNECTED" else 0.0)
 _PAIRS_WRITE_STALLED = _metrics.fleet(
-    "pairs_write_stalled", lambda p: 1.0 if p.want_write else 0.0)
+    "pairs_write_stalled",
+    # CONNECTED only: a pair that died MID-STALL keeps want_write set while
+    # anything still references it, and a dead pair's stall is not evidence
+    # — the watchdog would keep attributing live calls to credit-starvation
+    # long after the wedged peer was torn down (tpurpc-fleet, ISSUE 6)
+    lambda p: 1.0 if (p.want_write and p.state.name == "CONNECTED")
+    else 0.0)
 # tpurpc-blackbox (ISSUE 5): a CONNECTED pair with a complete message
 # sitting undrained — the watchdog's poller-wake-latency evidence. Scrape/
 # sweep-time only; has_message is a header peek (native scan when built).
@@ -1275,11 +1281,22 @@ class Pair:
                 pass
             _flight.emit(_flight.PAIR_DISCONNECT, self._ftag)
         self.state = PairState.DISCONNECTED
+        if self.want_write:
+            # balance the open stall edge: a dead pair's stall is over (the
+            # sender fails, the RPC surfaces an error) — an unclosed begin
+            # would keep the watchdog attributing to credit-starvation for
+            # the whole flight-evidence window after the peer is gone
+            _flight.emit(_flight.WRITE_STALL_END, self._ftag)
+        self.want_write = False  # no sender can stall on a closed pair
 
     def _mark_error(self, why: str) -> None:
         if self.state not in (PairState.DISCONNECTED,):
             self.state = PairState.ERROR
             _flight.emit(_flight.PEER_DEATH, self._ftag)
+            if self.want_write:
+                # same balancing as disconnect(): peer death mid-stall ends
+                # the stall — the evidence must say so
+                _flight.emit(_flight.WRITE_STALL_END, self._ftag)
         if self.error is None:
             self.error = why
         # Waiters may be blocked in an uncapped select; the state change IS

@@ -405,6 +405,15 @@ class FanInBatcher:
         for c in self._completers:
             c.start()
 
+    def queue_depth(self) -> int:
+        """Requests parked behind the transport (queued here + dispatched
+        batches not yet materialized) — the tpurpc-fleet load report's
+        queue-depth field (Server.set_load_provider wiring in serve_jax):
+        on a model server THIS is where overload actually accumulates."""
+        with self._lock:
+            queued = len(self._queue)
+        return queued + self._inflight.qsize()
+
     def close(self) -> None:
         import queue as _queue
 
@@ -726,6 +735,10 @@ def serve_jax(fn: Callable[[Any], Any], address: str = "127.0.0.1:0", *,
                                max_delay_s=max_delay_s,
                                inflight_fn=srv.inflight_requests)
         add_tensor_method(srv, name, batcher)
+        # tpurpc-fleet: the batcher's queue depth rides the per-response
+        # load report, so a least_loaded client sees model-side queueing
+        # the transport-level inflight count alone would miss
+        srv.set_load_provider(batcher.queue_depth)
     else:
         add_tensor_method(srv, name, fn)
     srv.start()

@@ -72,6 +72,15 @@ CONN_CONNECT = 17         # client transport connection established
 CONN_DEAD = 18            # client transport connection died; a1 = 1 if graceful
 CALL_FIRST_OK = 19        # first OK call on a connection (reconnect proof)
 WATCHDOG_TRIP = 20        # a1 = stalled-call age (ms)
+# tpurpc-fleet (ISSUE 6): hedging / drain / admission / subchannel health
+HEDGE_FIRED = 21          # a1 = attempt index (1 = first hedge)
+HEDGE_WON = 22            # a1 = winning attempt index (0 = original)
+HEDGE_CANCELLED = 23      # a1 = cancelled attempt index
+DRAIN_BEGIN = 24          # a1 = connections at drain start
+DRAIN_END = 25            # a1 = streams still open at budget expiry (0=clean)
+ADMIT_REJECT = 26         # a1 = inflight at rejection, a2 = pushback (ms)
+SUBCH_EJECT = 27          # a1 = subchannel index, a2 = reason (0=errors,1=slow)
+SUBCH_REINSTATE = 28      # a1 = subchannel index
 
 EVENT_NAMES: Dict[int, str] = {
     PAIR_CONNECT: "pair-connect",
@@ -94,6 +103,14 @@ EVENT_NAMES: Dict[int, str] = {
     CONN_DEAD: "conn-dead",
     CALL_FIRST_OK: "call-first-ok",
     WATCHDOG_TRIP: "watchdog-trip",
+    HEDGE_FIRED: "hedge-fired",
+    HEDGE_WON: "hedge-won",
+    HEDGE_CANCELLED: "hedge-cancelled",
+    DRAIN_BEGIN: "drain-begin",
+    DRAIN_END: "drain-end",
+    ADMIT_REJECT: "admit-reject",
+    SUBCH_EJECT: "subch-ejected",
+    SUBCH_REINSTATE: "subch-reinstated",
 }
 
 #: batch-flush reason codes (a1 of BATCH_FLUSH) — mirrors the jaxshim

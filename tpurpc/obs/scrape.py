@@ -18,8 +18,10 @@ Routes::
                    fleet gauges + the copy ledger + channelz counters
     /traces        Chrome trace_event JSON of the span buffer (?trace_id=hex)
     /channelz      channelz snapshot JSON (the live data test_channelz asserts)
-    /healthz       "ok", or 503 "degraded: ..." while the stall watchdog
-                   has an active diagnosis (tpurpc-blackbox, ISSUE 5)
+    /healthz       "ok"; 503 "degraded: ..." while the stall watchdog has
+                   an active diagnosis (tpurpc-blackbox, ISSUE 5); 200
+                   "draining" while Server.drain() bleeds connections
+                   (tpurpc-fleet, ISSUE 6 — healthy but leaving rotation)
     /debug/flight  flight-recorder replay: JSON event list (?text=1 for the
                    human rendering, ?since_ns=N to bound)
     /debug/stalls  stall-watchdog diagnoses: active + recent history JSON
@@ -173,6 +175,18 @@ def _route(path: str) -> Tuple[int, str, bytes]:
                     f"{worst['method']} blocked on {worst['stage']} "
                     f"for {worst['age_s']}s\n").encode()
             return 503, "text/plain", body
+        # tpurpc-fleet: a draining server is HEALTHY but leaving — 200
+        # with a distinct body (a 503 would read as failure and page;
+        # orchestrators key on the text to stop routing without alarming)
+        try:
+            from tpurpc.rpc import channelz as _channelz
+
+            draining = any(getattr(srv, "draining", False)
+                           for _sid, srv in _channelz.live_servers())
+        except Exception:
+            draining = False
+        if draining:
+            return 200, "text/plain", b"draining\n"
         return 200, "text/plain", b"ok\n"
     if route in ("/debug/flight", "/debug/flight/"):
         from tpurpc.obs import flight as _flight

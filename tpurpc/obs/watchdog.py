@@ -152,6 +152,18 @@ class StallWatchdog:
         p99 = roll.p99_ns()
         return None if p99 is None else int(p99 * self.mult)
 
+    def rolling_p99_ns(self) -> Optional[int]:
+        """The WORST rolling p99 across methods with history, or None —
+        tpurpc-fleet's admission gate and load reports read this as the
+        server's latency signal (one method in trouble is the fleet
+        signal; averaging would hide it)."""
+        worst = None
+        for roll in list(self._rolls.values()):
+            p99 = roll.p99_ns()
+            if p99 is not None and (worst is None or p99 > worst):
+                worst = p99
+        return worst
+
     # -- the sweeper ----------------------------------------------------------
 
     def _ensure_thread(self) -> None:

@@ -51,6 +51,32 @@ _PIPE_DEMUX_US = _obs_metrics.histogram("pipeline_demux_us", kind="latency")
 #: (PipelinedUnary's timer wheel + the blocking unary path both feed it)
 _DEADLINE_EXCEEDED = _obs_metrics.labeled_counter("deadline_exceeded",
                                                   ("method",))
+# tpurpc-fleet (ISSUE 6): hedging counters + the interned flight tag for
+# the hedge emission sites (pure-int plumbing; the `flight` lint rule
+# covers this module). The metadata keys mirror tpurpc.rpc.server's
+# LOAD_KEY/PUSHBACK_KEY — duplicated literals rather than a server import
+# in the client module (test_fleet pins them equal).
+_HEDGES_FIRED = _obs_metrics.counter("hedges_fired")
+_HEDGES_WON = _obs_metrics.counter("hedges_won")
+_HEDGE_TAG = _flight.tag_for("hedge")
+_LOAD_KEY = "tpurpc-load"
+_PUSHBACK_KEY = "tpurpc-pushback-ms"
+
+
+def _pushback_s(exc) -> "Optional[float]":
+    """Server retry-pushback (``tpurpc-pushback-ms`` trailing metadata on
+    an admission rejection) in seconds, or None when absent/junk."""
+    try:
+        md = exc.trailing_metadata() or ()
+    except Exception:
+        return None
+    for key, value in md:
+        if key == _PUSHBACK_KEY:
+            try:
+                return max(0.0, float(value) / 1000.0)
+            except (TypeError, ValueError):
+                return None
+    return None
 
 
 class _ClientStream:
@@ -206,6 +232,10 @@ class _Connection:
         self.draining = False        # GOAWAY received: no new streams
         self.last_activity = time.monotonic()
         self._on_dead = on_dead
+        #: tpurpc-fleet: sink for server load reports stripped from
+        #: trailing metadata (bound per pick by Channel._connection when
+        #: the LB policy consumes them; None otherwise)
+        self.on_load = None
         #: tpurpc-blackbox: connection lifecycle in the flight ring — the
         #: disconnect→reconnect→first-OK sequence a postmortem replays
         self._ftag = _flight.tag_for("conn:" + getattr(endpoint, "peer",
@@ -560,6 +590,20 @@ class _Connection:
             st.events.put(("initial_metadata", md))
         elif f.type in (fr.TRAILERS, fr.RST):
             code, details, md = fr.parse_trailers(f.payload)
+            if md:
+                # tpurpc-fleet: the server's piggybacked load report is
+                # transport-internal — strip it before metadata surfaces
+                # to the app, feed it to the LB policy's sink
+                for i, (key, value) in enumerate(md):
+                    if key == _LOAD_KEY:
+                        del md[i]
+                        cb = self.on_load
+                        if cb is not None:
+                            try:
+                                cb(value)
+                            except Exception:
+                                pass  # a policy bug must not kill the reader
+                        break
             if f.type == fr.RST and f.flags & fr.FLAG_REFUSED:
                 # admission refusal: the server certifies no handler ran
                 # (set BEFORE the event lands; the queue orders the read)
@@ -639,11 +683,23 @@ class _Subchannel:
         #: successful dial is a reconnect (flight-recorder event)
         self._lost_conn = False
 
-    def get(self) -> _Connection:
+    def get(self, fail_fast: bool = False) -> _Connection:
+        """The live connection, dialing if needed. ``fail_fast=True`` (the
+        multi-subchannel LB walk) raises UNAVAILABLE immediately while the
+        subchannel is in connect backoff instead of sleeping it out —
+        sleeping through backoff INSIDE the dial lock convoys every walker
+        behind one dead backend (observed: hedged fleet traffic serializing
+        2 s per caller on a killed server), and with other backends in the
+        walk there is nothing worth waiting for. Single-subchannel channels
+        keep the sleep: there, waiting out the backoff IS the reconnect
+        contract."""
         with self._lock:
             if (self._conn is not None and self._conn.alive
                     and not self._conn.draining):
                 return self._conn
+            if fail_fast and self._next_attempt > time.monotonic():
+                raise RpcError(StatusCode.UNAVAILABLE,
+                               "subchannel in connect backoff")
         # Dial outside self._lock: a blackholed connect must not freeze close()
         # or concurrent calls for the whole connect timeout.
         with self._connect_lock:
@@ -720,6 +776,7 @@ class Channel:
                  credentials=None,
                  max_receive_message_length: Optional[int] = None,
                  retry_policy: "Optional[RetryPolicy]" = None,
+                 hedging_policy: "Optional[HedgingPolicy]" = None,
                  compression=None,
                  options=None):
         # grpcio channel options: [("grpc.arg_name", value), ...]. The
@@ -767,6 +824,11 @@ class Channel:
         #: config). An explicit policy here WINS over any service config the
         #: resolver delivers (explicit code beats delivered config).
         self.retry_policy = retry_policy
+        #: channel-level hedging policy (tpurpc-fleet, gRFC A6): staggered
+        #: parallel attempts on distinct subchannels, first response wins.
+        #: Retry wins when both are configured (a call runs ONE strategy);
+        #: same explicit-beats-config precedence as retry_policy.
+        self.hedging_policy = hedging_policy
         #: parsed resolver-delivered service config (per-method timeout /
         #: retryPolicy / retryThrottling — service_config.cc analog); swapped
         #: whole by update_service_config, consulted per call via
@@ -847,24 +909,33 @@ class Channel:
     def _call_plan(self, method: str, timeout: "Optional[float]",
                    wait_for_ready: bool = False):
         """ONE consistent per-call snapshot of the service-config-derived
-        values: ``(retry_policy, timeout, throttle, wait_for_ready)``.
-        Derived from a single read of ``_service_config`` so a concurrent
-        resolver update can never pair one config's retry policy with
-        another's throttle or timeout. Rules: explicit constructor policy
-        wins; config timeout can only TIGHTEN the call's (min rule);
-        waitForReady is or-ed with the per-call kwarg (gRFC A2: the config
-        enables it, a call-site value may also enable it)."""
+        values: ``(retry_policy, timeout, throttle, wait_for_ready,
+        hedging_policy)``. Derived from a single read of
+        ``_service_config`` so a concurrent resolver update can never pair
+        one config's retry policy with another's throttle or timeout.
+        Rules: explicit constructor policy wins; config timeout can only
+        TIGHTEN the call's (min rule); waitForReady is or-ed with the
+        per-call kwarg (gRFC A2: the config enables it, a call-site value
+        may also enable it); a method runs ONE execution strategy — when
+        both retry and hedging resolve, retry wins (the config layer
+        already rejects both in one entry, gRFC A6)."""
         sc = self._service_config
         mc = sc.for_method(method) if sc is not None else None
         policy = self.retry_policy
         if policy is None and mc is not None:
             policy = mc.retry_policy
+        hedging = self.hedging_policy
+        if hedging is None and mc is not None:
+            hedging = mc.hedging_policy
+        if policy is not None:
+            hedging = None
         if mc is not None and mc.timeout is not None:
             timeout = (mc.timeout if timeout is None
                        else min(timeout, mc.timeout))
         return (policy, timeout,
                 sc.retry_throttle if sc is not None else None,
-                bool(wait_for_ready) or bool(mc and mc.wait_for_ready))
+                bool(wait_for_ready) or bool(mc and mc.wait_for_ready),
+                hedging)
 
     def update_addresses(self, addrs) -> None:
         """Replace the channel's backend set (re-resolution / look-aside
@@ -934,9 +1005,17 @@ class Channel:
         for sc in removed:
             sc.close()
 
-    def _connection(self) -> _Connection:
+    def _connection(self, exclude=None, picked=None) -> _Connection:
         """LB pick: walk subchannels in policy order, first READY/dialable
-        wins (client_channel resolver→LB→subchannel flow, SURVEY.md §3.2)."""
+        wins (client_channel resolver→LB→subchannel flow, SURVEY.md §3.2).
+
+        ``exclude`` (a set of :class:`_Subchannel` objects) deprioritizes
+        backends this logical call already used — hedged attempts prefer
+        distinct subchannels, and a drain-refused replay migrates instead
+        of re-hitting the drainer. Excluded subchannels are appended LAST,
+        not dropped: landing on a busy backend beats failing the call when
+        nothing else is dialable. ``picked`` (a list, out-param) receives
+        the chosen subchannel."""
         with self._lock:
             if self._closed:
                 raise RpcError(StatusCode.UNAVAILABLE, "channel closed")
@@ -944,15 +1023,29 @@ class Channel:
             # pick never mixes one generation's policy with another's subs
             policy, subs = self._policy, self._subchannels
         last_exc: Optional[Exception] = None
-        for idx in policy.order():
+        order = list(policy.order())
+        if exclude:
+            order = ([i for i in order if subs[i] not in exclude]
+                     + [i for i in order if subs[i] in exclude])
+        fail_fast = len(subs) > 1  # walkers skip backing-off members
+        for idx in order:
             sc = subs[idx]
             try:
-                conn = sc.get()
-                policy.connected(idx)
-                return conn
+                conn = sc.get(fail_fast=fail_fast)
             except RpcError as exc:
                 policy.failed(idx)
                 last_exc = exc
+                continue
+            policy.connected(idx)
+            # tpurpc-fleet: bind the connection's load-report sink to this
+            # pick's (policy, index) — rebound every pick so a policy
+            # rebuilt by update_addresses never receives stale indices
+            if hasattr(policy, "load_report"):
+                conn.on_load = (lambda raw, _p=policy, _i=idx:
+                                _p.load_report(_i, raw))
+            if picked is not None:
+                picked.append(sc)
+            return conn
         raise last_exc if last_exc is not None else RpcError(
             StatusCode.UNAVAILABLE, "no subchannels")
 
@@ -1423,6 +1516,17 @@ class RetryPolicy:
                             and not throttle.allow_retry())):
                     raise
                 sleep = self.next_sleep(backoff, deadline)
+                # tpurpc-fleet: an admission-shedding server names its own
+                # backoff (tpurpc-pushback-ms) — honor it as the FLOOR of
+                # the retry sleep so a shedding backend isn't re-hammered
+                # on the client's (possibly tiny) early-attempt backoff
+                pushback = _pushback_s(exc)
+                if pushback is not None:
+                    sleep = pushback if sleep is None else max(sleep,
+                                                               pushback)
+                    if (deadline is not None
+                            and time.monotonic() + sleep >= deadline):
+                        sleep = None
                 if sleep is None:
                     raise
                 time.sleep(sleep)
@@ -1431,6 +1535,41 @@ class RetryPolicy:
                 if throttle is not None:
                     throttle.record_success()
                 return result
+
+
+class HedgingPolicy:
+    """gRFC A6 hedging: up to ``max_attempts`` copies of one unary call in
+    flight, staggered ``hedging_delay`` apart, each preferring a subchannel
+    the call hasn't used yet. The first usable response wins and the losers
+    are cancelled (RST on their streams); a failure with a status in
+    ``non_fatal_codes`` fires the next hedge IMMEDIATELY instead of waiting
+    out the delay; any other failure is fatal and resolves the call.
+
+    Hedging trades duplicate work for tail latency — the method must be
+    idempotent (two servers may both execute it; that is the contract, not
+    a bug). All attempts share ONE deadline budget (the caller's timeout,
+    anchored once), the channel-wide :class:`RetryThrottle` gates every
+    hedge beyond the first (a collapsing fleet stops receiving hedges the
+    same way it stops receiving retries), and a server's admission
+    pushback stops further hedging outright.
+
+    >>> ch = Channel(target, lb_policy="round_robin",
+    ...              hedging_policy=HedgingPolicy(max_attempts=3,
+    ...                                           hedging_delay=0.01))
+    """
+
+    __slots__ = ("max_attempts", "hedging_delay", "non_fatal_codes")
+
+    def __init__(self, max_attempts: int = 2, hedging_delay: float = 0.05,
+                 non_fatal_codes: Sequence[StatusCode] = (
+                     StatusCode.UNAVAILABLE,)):
+        if max_attempts < 2:
+            raise ValueError("max_attempts must be >= 2")
+        if hedging_delay < 0:
+            raise ValueError("hedging_delay must be >= 0")
+        self.max_attempts = int(max_attempts)
+        self.hedging_delay = float(hedging_delay)
+        self.non_fatal_codes = tuple(non_fatal_codes)
 
 
 class _MultiCallable:
@@ -1453,16 +1592,18 @@ class _MultiCallable:
         self._allow_native = allow_native
 
     def _dial(self, wait_for_ready: bool,
-              deadline: Optional[float]) -> _Connection:
+              deadline: Optional[float],
+              exclude=None, picked=None) -> _Connection:
         """One LB-picked connection. With ``wait_for_ready`` (the grpcio
         per-call flag), a channel in TRANSIENT_FAILURE QUEUES the call —
         keep redialing until the deadline — instead of failing it fast
         (gRPC's wait-for-ready semantics; fail-fast is the default)."""
         if not wait_for_ready:
-            return self._channel._connection()
+            return self._channel._connection(exclude=exclude, picked=picked)
         while True:
             try:
-                return self._channel._connection()
+                return self._channel._connection(exclude=exclude,
+                                                 picked=picked)
             except RpcError as exc:
                 if (self._channel._is_closed()
                         or _status_of(exc) is not StatusCode.UNAVAILABLE):
@@ -1486,6 +1627,7 @@ class _MultiCallable:
                first_request=_NO_REQUEST,
                wait_for_ready: bool = False,
                trace_ctx=_TRACE_UNSET,
+               exclude=None, picked=None,
                ) -> Tuple[_Connection, _ClientStream, Call]:
         """Open a stream and send HEADERS — fused with the first (only)
         MESSAGE when the request is known upfront, so a unary call costs one
@@ -1502,7 +1644,8 @@ class _MultiCallable:
         # let a late-appearing server nearly double the budget.
         deadline = None if timeout is None else time.monotonic() + timeout
         for _ in range(3):
-            conn = self._dial(wait_for_ready, deadline)
+            conn = self._dial(wait_for_ready, deadline,
+                              exclude=exclude, picked=picked)
             try:
                 st = conn.open_stream()
                 break
@@ -1686,10 +1829,15 @@ class UnaryUnary(_MultiCallable):
         # _native_call synthesizes a post-hoc span iff the call turns out
         # pathological (client-side-only tree, documented trade).
         tctx = _tracing.maybe_sample() if _tracing.LIVE else None
+        plan = self._channel._call_plan(self._method, None)
         if ((tctx is None or getattr(tctx, "provisional", False))
                 and self._allow_native and not metadata
                 and not grpcio_kw.get("wait_for_ready")
-                and not self._channel._call_plan(self._method, None)[3]
+                and not plan[3]
+                # hedged calls stay on the Python transport: hedging wants
+                # N streams on distinct subchannels + cross-thread cancel,
+                # none of which the single-pipe native loop can express
+                and plan[4] is None
                 and not self._instruments_live()):
             nch = self._channel._native_fast()
             if nch is not None:
@@ -1721,7 +1869,7 @@ class UnaryUnary(_MultiCallable):
             self._native_mc = cached
         mc = cached[1]
         counters = self._channel.call_counters
-        policy, timeout, throttle, _ = self._channel._call_plan(
+        policy, timeout, throttle, _, _hedging = self._channel._call_plan(
             self._method, timeout)
         deadline = None if timeout is None else time.monotonic() + timeout
 
@@ -1810,9 +1958,17 @@ class UnaryUnary(_MultiCallable):
                         metadata: Optional[Metadata] = None,
                         _trace_ctx=_TRACE_UNSET, **grpcio_kw):
         _reject_call_credentials(grpcio_kw)
-        policy, timeout, throttle, eff_wfr = self._channel._call_plan(
-            self._method, timeout, bool(grpcio_kw.get("wait_for_ready")))
+        policy, timeout, throttle, eff_wfr, hedging = \
+            self._channel._call_plan(
+                self._method, timeout, bool(grpcio_kw.get("wait_for_ready")))
         deadline = None if timeout is None else time.monotonic() + timeout
+        if policy is None and hedging is not None:
+            return self._hedged_call(request, deadline, metadata, eff_wfr,
+                                     hedging, throttle, _trace_ctx)
+        #: subchannels that REFUSED this logical call (drain/max-age): the
+        #: replay deprioritizes them, so a draining backend's traffic
+        #: deterministically migrates instead of re-racing the same GOAWAY
+        refused_subs: set = set()
 
         def attempt():
             # Transparent retry (distinct from RetryPolicy): a stream the
@@ -1830,7 +1986,8 @@ class UnaryUnary(_MultiCallable):
             for _ in range(3):
                 try:
                     return self._call_once(request, remaining(), metadata,
-                                           wfr, trace_ctx=_trace_ctx)
+                                           wfr, trace_ctx=_trace_ctx,
+                                           exclude=refused_subs or None)
                 except RpcError as exc:
                     committed = getattr(exc, "_tpurpc_committed", False)
                     # FLAG_REFUSED is the contract; the "connection draining"
@@ -1857,19 +2014,195 @@ class UnaryUnary(_MultiCallable):
                         refused = True
                     if not refused:
                         raise
+                    sub = getattr(exc, "_tpurpc_sub", None)
+                    if sub is not None:
+                        refused_subs.add(sub)
             return self._call_once(request, remaining(), metadata, wfr,
-                                   trace_ctx=_trace_ctx)
+                                   trace_ctx=_trace_ctx,
+                                   exclude=refused_subs or None)
 
         if policy is None:
             return attempt()
         return policy.run(deadline, attempt, throttle=throttle)
 
+    def _hedged_call(self, request, deadline: Optional[float],
+                     metadata: Optional[Metadata], wait_for_ready: bool,
+                     hp: "HedgingPolicy", throttle, trace_ctx):
+        """The gRFC A6 hedging state machine (tpurpc-fleet, ISSUE 6).
+
+        One orchestrating thread (the caller's) drives N attempt threads:
+
+        * attempt 0 launches immediately; attempt k+1 launches when the
+          hedging delay lapses with nothing resolved, OR immediately when
+          an attempt fails with a non-fatal status;
+        * every launch beyond the first consults the channel-wide
+          RetryThrottle — a drained bucket stops hedging, so hedges can
+          never amplify into the retry storm the throttle exists to stop;
+        * admission pushback from any attempt stops further hedging
+          outright (the fleet said "back off");
+        * the first OK response wins: the losers' streams are RST and
+          their Calls observe CANCELLED. A fatal (non-retryable) failure
+          resolves the call the same way.
+
+        All attempts share the ONE deadline anchored by the caller; each
+        attempt thread carries its own remaining-budget snapshot, so every
+        outstanding attempt self-resolves by the deadline and the
+        orchestrator's final wait cannot hang."""
+        def remaining():
+            return (None if deadline is None
+                    else max(0.0, deadline - time.monotonic()))
+
+        results: "queue.Queue[tuple]" = queue.Queue()
+        lock = threading.Lock()
+        calls: dict = {}       # attempt idx -> live Call (for cancellation)
+        used_subs: set = set()  # prefer-distinct exclusion, cross-attempt
+        done = [False]
+
+        def on_call_for(idx):
+            def on_call(call, sub):
+                cancel_now = False
+                with lock:
+                    calls[idx] = call
+                    if sub is not None:
+                        used_subs.add(sub)
+                    if done[0]:
+                        cancel_now = True  # raced the winner: die quietly
+                if cancel_now:
+                    call.cancel()
+            return on_call
+
+        def run_attempt(idx):
+            refused_local: set = set()
+            last_exc = None
+            for _ in range(3):  # transparent refused-replay, per attempt
+                with lock:
+                    excl = set(used_subs) | refused_local
+                try:
+                    resp, call = self._call_once(
+                        request, remaining(), metadata, wait_for_ready,
+                        trace_ctx=trace_ctx, exclude=excl or None,
+                        on_call=on_call_for(idx))
+                    results.put((idx, (resp, call), None))
+                    return
+                except RpcError as exc:
+                    last_exc = exc
+                    if (getattr(exc, "_tpurpc_refused", False)
+                            and not getattr(exc, "_tpurpc_committed",
+                                            False)):
+                        sub = getattr(exc, "_tpurpc_sub", None)
+                        if sub is not None:
+                            refused_local.add(sub)
+                        continue
+                    results.put((idx, None, exc))
+                    return
+                except BaseException as exc:  # serializer bug etc.
+                    results.put((idx, None, exc))
+                    return
+            results.put((idx, None, last_exc))
+
+        launched = 0
+        outstanding = 0
+        stop_hedging = False  # flipped by admission pushback
+
+        def may_hedge():
+            return (launched < hp.max_attempts and not stop_hedging
+                    and (throttle is None or throttle.allow_retry()))
+
+        def launch():
+            nonlocal launched, outstanding
+            idx = launched
+            launched += 1
+            outstanding += 1
+            if idx > 0:
+                _HEDGES_FIRED.inc()
+                _flight.emit(_flight.HEDGE_FIRED, _HEDGE_TAG, idx)
+            threading.Thread(target=run_attempt, args=(idx,), daemon=True,
+                             name="tpurpc-hedge").start()
+
+        def finish(win_idx=None):
+            with lock:
+                done[0] = True
+                losers = [(i, c) for i, c in calls.items() if i != win_idx]
+            for i, call in losers:
+                try:
+                    call.cancel()
+                except Exception:
+                    pass
+                if win_idx is not None:
+                    _flight.emit(_flight.HEDGE_CANCELLED, _HEDGE_TAG, i)
+
+        launch()
+        last_failure = None
+        while True:
+            wait = hp.hedging_delay if may_hedge() else None
+            rem = remaining()
+            if rem is not None and (wait is None or rem < wait):
+                # bound the wait by the budget + slack: outstanding
+                # attempts self-expire at the deadline and deliver here
+                wait = rem + 1.0
+            try:
+                idx, ok, exc = results.get(timeout=wait)
+            except queue.Empty:
+                if may_hedge():
+                    launch()  # the delay lapsed unresolved: hedge
+                    continue
+                if outstanding > 0:
+                    continue  # just wait: attempts carry their own deadline
+                # nothing in flight, nothing launchable
+                finish()
+                raise last_failure if last_failure is not None else RpcError(
+                    StatusCode.DEADLINE_EXCEEDED,
+                    "deadline exceeded before any hedged attempt resolved")
+            outstanding -= 1
+            if exc is None:
+                resp, call = ok
+                if idx > 0:
+                    _HEDGES_WON.inc()
+                _flight.emit(_flight.HEDGE_WON, _HEDGE_TAG, idx)
+                finish(win_idx=idx)
+                if throttle is not None:
+                    throttle.record_success()
+                return resp, call
+            if done[0]:
+                continue  # a cancelled loser reporting in: ignore
+            if isinstance(exc, RpcError):
+                code = _status_of(exc)
+                retryable = (code in hp.non_fatal_codes
+                             and not getattr(exc, "_tpurpc_committed",
+                                             False))
+                if throttle is not None and retryable:
+                    throttle.record_failure()
+                if _pushback_s(exc) is not None:
+                    stop_hedging = True  # the fleet is shedding: no more
+                if retryable:
+                    last_failure = exc
+                    if may_hedge():
+                        launch()  # gRFC A6: non-fatal fires the next
+                        continue  # hedge immediately
+                    if outstanding > 0:
+                        continue
+                    finish()
+                    raise exc
+            # fatal failure (or a non-RpcError bug): resolve now
+            finish()
+            raise exc
+
     def _call_once(self, request, timeout: Optional[float],
                    metadata: Optional[Metadata], wait_for_ready: bool = False,
-                   trace_ctx=_TRACE_UNSET):
+                   trace_ctx=_TRACE_UNSET, exclude=None, on_call=None):
+        """One wire attempt. ``exclude`` deprioritizes subchannels this
+        logical call already touched (drain migration / hedge spread);
+        ``on_call(call, subchannel)`` fires as soon as the stream is open —
+        the hedged driver registers the Call for cross-attempt
+        cancellation there. A failure carries the subchannel it ran on as
+        ``_tpurpc_sub`` so callers can extend their exclusion set."""
+        picked: list = []
         conn, st, call = self._start(metadata, timeout, first_request=request,
                                      wait_for_ready=wait_for_ready,
-                                     trace_ctx=trace_ctx)
+                                     trace_ctx=trace_ctx,
+                                     exclude=exclude, picked=picked)
+        if on_call is not None:
+            on_call(call, picked[-1] if picked else None)
         response = None
         got = False
         try:
@@ -1884,6 +2217,8 @@ class UnaryUnary(_MultiCallable):
                 # committed — replaying it would re-execute the handler
                 # (gRPC's retry contract forbids this too).
                 exc._tpurpc_committed = True
+            if picked:
+                exc._tpurpc_sub = picked[-1]
             raise
         if not got:
             raise RpcError(StatusCode.INTERNAL, "unary call received no response")
@@ -1959,7 +2294,14 @@ class PipelinedUnary:
                    metadata: Optional[Metadata] = None):
         """One pipelined call; returns a Future of the deserialized
         response. Blocks only for a window slot (backpressure), never for
-        the response."""
+        the response.
+
+        tpurpc-fleet: a REFUSED terminal (drain / max-age GOAWAY race —
+        the server certifies no handler ran) replays transparently on
+        another subchannel instead of failing the future, up to 3 times
+        under the original deadline — the pipelined half of the
+        zero-failed-RPC drain contract. The replay's dial runs off the
+        delivering reader thread (timer-wheel blocking pool)."""
         deadline = None if timeout is None else time.monotonic() + timeout
         if not self._window.acquire(
                 timeout=None if timeout is None else timeout):
@@ -1967,25 +2309,12 @@ class PipelinedUnary:
                            "deadline exceeded waiting for pipeline window")
         t_start = time.perf_counter_ns()
         fut = self._Future()
-        try:
-            remaining = (None if deadline is None
-                         else max(0.0, deadline - time.monotonic()))
-            conn, st, call = self._mc._start(metadata, remaining,
-                                             first_request=request)
-        except BaseException:
-            self._window.release()
-            raise
-        state = {"claimed": False}
+        state = {"claimed": False, "timer": None, "replays": 0,
+                 "exclude": set(), "cur": None}
         # tpurpc-blackbox: register with the stall watchdog — a pipelined
         # call has NO thread parked on it, so the sweeper is the only
         # observer that can notice it wedged and name the stage
         from tpurpc.obs import watchdog as _watchdog
-
-        stash = getattr(st, "_tail", None)
-        wd_tok = _watchdog.call_started(
-            self._mc._method,
-            stash[0].trace_id if stash and stash[0] is not None else 0,
-            kind="client")
 
         def claim() -> bool:
             with self._lock:
@@ -1996,63 +2325,133 @@ class PipelinedUnary:
             self._window.release()
             return True
 
-        def complete():
-            if not claim():
-                return
-            timer = state.get("timer")
-            if timer is not None:
-                timer.cancel()
-            msgs = []
-            code, details, md = None, "", []
-            while True:
-                try:
-                    ev = st.events.get_nowait()
-                except queue.Empty:
-                    break
-                if ev[0] == "message":
-                    st.release_credit()
-                    msgs.append(ev[1])
-                elif ev[0] == "trailers":
-                    _, code, details, md = ev
-            if code is None:  # terminal hook without a queued trailer event
-                code, details = StatusCode.INTERNAL, "terminal without status"
-            call._finish(code, details, md)
-            _watchdog.call_finished(wd_tok,
-                                    error=code is not StatusCode.OK)
-            if not fut.set_running_or_notify_cancel():
-                return  # caller cancelled the future; drop the result
-            if code is not StatusCode.OK:
-                exc = RpcError(code, details, md)
-                if getattr(st, "refused", False):
-                    exc._tpurpc_refused = True
-                fut.set_exception(exc)
-            elif len(msgs) != 1:
-                fut.set_exception(RpcError(
-                    StatusCode.INTERNAL,
-                    "unary call received no response" if not msgs
-                    else "unary call received multiple responses"))
-            else:
-                try:
-                    fut.set_result(_deserialize(self._mc._deser, msgs[0]))
-                except BaseException as exc:  # a raising deserializer must
-                    fut.set_exception(exc)    # fail the future, never hang it
-            now = time.perf_counter_ns()
-            _PIPE_CALL_US.record((now - t_start) // 1000)
-            if st._t_terminal:
-                _PIPE_DEMUX_US.record((now - st._t_terminal) // 1000)
+        def start_attempt():
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+            picked: list = []
+            conn, st, call = self._mc._start(
+                metadata, remaining, first_request=request,
+                exclude=state["exclude"] or None, picked=picked)
+            stash = getattr(st, "_tail", None)
+            wd_tok = _watchdog.call_started(
+                self._mc._method,
+                stash[0].trace_id if stash and stash[0] is not None else 0,
+                kind="client")
+            cur = {"st": st, "call": call, "wd": wd_tok, "fired": False,
+                   "sub": picked[-1] if picked else None}
+            state["cur"] = cur
+
+            def complete():
+                with self._lock:
+                    if cur["fired"]:
+                        return  # hook + done-fallback both ran: once only
+                    cur["fired"] = True
+                msgs = []
+                code, details, md = None, "", []
+                while True:
+                    try:
+                        ev = st.events.get_nowait()
+                    except queue.Empty:
+                        break
+                    if ev[0] == "message":
+                        st.release_credit()
+                        msgs.append(ev[1])
+                    elif ev[0] == "trailers":
+                        _, code, details, md = ev
+                if code is None:  # terminal hook without a queued trailer
+                    code, details = (StatusCode.INTERNAL,
+                                     "terminal without status")
+                refused = (code is not StatusCode.OK and not msgs
+                           and getattr(st, "refused", False))
+                if refused and state["replays"] < 3 and not state["claimed"]:
+                    # migrate: the refusing subchannel is deprioritized and
+                    # the attempt replays — off this (reader) thread, which
+                    # must not block in a dial
+                    state["replays"] += 1
+                    if cur["sub"] is not None:
+                        state["exclude"].add(cur["sub"])
+                    call._finish(code, details, md)
+                    _watchdog.call_finished(wd_tok, error=True)
+                    from tpurpc.utils.timers import run_blocking
+
+                    def replay():
+                        if state["claimed"]:
+                            return  # expired while queued
+                        try:
+                            start_attempt()
+                        except BaseException as exc:
+                            if claim():
+                                timer = state.get("timer")
+                                if timer is not None:
+                                    timer.cancel()
+                                if fut.set_running_or_notify_cancel():
+                                    fut.set_exception(exc)
+
+                    run_blocking(replay)
+                    return
+                if not claim():
+                    return
+                timer = state.get("timer")
+                if timer is not None:
+                    timer.cancel()
+                call._finish(code, details, md)
+                _watchdog.call_finished(wd_tok,
+                                        error=code is not StatusCode.OK)
+                if not fut.set_running_or_notify_cancel():
+                    return  # caller cancelled the future; drop the result
+                if code is not StatusCode.OK:
+                    exc = RpcError(code, details, md)
+                    if refused:
+                        exc._tpurpc_refused = True
+                    fut.set_exception(exc)
+                elif len(msgs) != 1:
+                    fut.set_exception(RpcError(
+                        StatusCode.INTERNAL,
+                        "unary call received no response" if not msgs
+                        else "unary call received multiple responses"))
+                else:
+                    try:
+                        fut.set_result(
+                            _deserialize(self._mc._deser, msgs[0]))
+                    except BaseException as exc:  # a raising deserializer
+                        fut.set_exception(exc)    # fails, never hangs
+                now = time.perf_counter_ns()
+                _PIPE_CALL_US.record((now - t_start) // 1000)
+                if st._t_terminal:
+                    _PIPE_DEMUX_US.record((now - st._t_terminal) // 1000)
+
+            # Hook AFTER the send: the terminal may already have been
+            # delivered (fast server + slow caller), in which case st.done
+            # is set and the hook will never fire — complete from here
+            # instead. cur["fired"] makes the two funnels once-only.
+            st.on_terminal = complete
+            if st.done:
+                complete()
+            self._ensure_pump(conn)
+
         with self._lock:
             self._inflight += 1
+        try:
+            start_attempt()
+        except BaseException:
+            with self._lock:
+                self._inflight -= 1
+            self._window.release()
+            raise
         if deadline is not None:
             # No thread waits on this call, so the deadline needs its own
-            # watchdog: expire RSTs the stream (endpoint write — off the
-            # wheel thread) and fails the future.
+            # watchdog: expire RSTs the CURRENT attempt's stream (endpoint
+            # write — off the wheel thread) and fails the future. One
+            # absolute deadline covers every replay.
             from tpurpc.utils.timers import run_blocking, schedule
 
             def expire():
                 if not claim():
                     return
-                call._expire()
-                _watchdog.call_finished(wd_tok, error=True)
+                cur = state["cur"]
+                if cur is not None:
+                    cur["call"]._expire()
+                    _watchdog.call_finished(cur["wd"], error=True)
                 if fut.set_running_or_notify_cancel():
                     fut.set_exception(RpcError(
                         StatusCode.DEADLINE_EXCEEDED,
@@ -2061,14 +2460,6 @@ class PipelinedUnary:
             state["timer"] = schedule(
                 max(0.0, deadline - time.monotonic()),
                 lambda: run_blocking(expire))
-        # Hook AFTER the send: the terminal may already have been delivered
-        # (fast server + slow caller), in which case st.done is set and the
-        # hook will never fire — complete from here instead. Both sides
-        # funnel through claim(), so exactly one completion runs.
-        st.on_terminal = complete
-        if st.done:
-            complete()
-        self._ensure_pump(conn)
         return fut
 
     # -- pump-mode servicing --------------------------------------------------
@@ -2158,6 +2549,12 @@ class _RetryingStreamCall:
                     and not self._throttle.allow_retry())):
             raise exc
         sleep = self._policy.next_sleep(self._backoff, self._deadline)
+        pushback = _pushback_s(exc)  # admission shed: server-named floor
+        if pushback is not None:
+            sleep = pushback if sleep is None else max(sleep, pushback)
+            if (self._deadline is not None
+                    and time.monotonic() + sleep >= self._deadline):
+                sleep = None
         if sleep is None:
             raise exc
         time.sleep(sleep)
@@ -2224,7 +2621,7 @@ class UnaryStream(_MultiCallable):
     def __call__(self, request, timeout: Optional[float] = None,
                  metadata: Optional[Metadata] = None, **grpcio_kw):
         _reject_call_credentials(grpcio_kw)
-        policy, timeout, throttle, wfr = self._channel._call_plan(
+        policy, timeout, throttle, wfr, _hedging = self._channel._call_plan(
             self._method, timeout, bool(grpcio_kw.get("wait_for_ready")))
         # Native fast path (same eligibility as the other shapes; retrying
         # and wait-for-ready calls stay on the Python transport —
@@ -2260,7 +2657,7 @@ class StreamUnary(_MultiCallable):
                  timeout: Optional[float] = None,
                  metadata: Optional[Metadata] = None, **grpcio_kw):
         _reject_call_credentials(grpcio_kw)
-        _, timeout, _, wfr = self._channel._call_plan(
+        _, timeout, _, wfr, _hedging = self._channel._call_plan(
             self._method, timeout, bool(grpcio_kw.get("wait_for_ready")))
         if self._allow_native and not metadata and not wfr:
             nsc = self._try_native_stream(request_iterator, timeout)
@@ -2416,7 +2813,7 @@ class StreamStream(_MultiCallable):
                  timeout: Optional[float] = None,
                  metadata: Optional[Metadata] = None, **grpcio_kw):
         _reject_call_credentials(grpcio_kw)
-        _, timeout, _, wfr = self._channel._call_plan(
+        _, timeout, _, wfr, _hedging = self._channel._call_plan(
             self._method, timeout, bool(grpcio_kw.get("wait_for_ready")))
         # Native bidi fast path, same eligibility story as UnaryUnary:
         # plain calls on eligible channels stream through libtpurpc's

@@ -112,6 +112,17 @@ class HealthServicer:
             self._epoch += 1
             self._lock.notify_all()
 
+    def set_all(self, status: ServingStatus) -> None:
+        """Flip EVERY registered service (the overall key included) in one
+        epoch — what :meth:`tpurpc.rpc.server.Server.drain` calls so LBs
+        and watchers see the whole backend leave rotation at once
+        (grpcio's ``enter_graceful_shutdown`` analog)."""
+        with self._lock:
+            for service in self._statuses:
+                self._statuses[service] = ServingStatus(status)
+            self._epoch += 1
+            self._lock.notify_all()
+
     def _check(self, raw, ctx) -> bytes:
         try:
             service = decode_request(raw)
@@ -154,6 +165,9 @@ class HealthServicer:
         server.add_method(
             f"/{SERVICE_NAME}/Watch",
             unary_stream_rpc_method_handler(self._watch))
+        # tpurpc-fleet: the server drives this servicer on drain()
+        # (NOT_SERVING fleet-wide while connections bleed)
+        server._health_servicer = self
 
 
 def add_health_servicer(server: Server) -> HealthServicer:

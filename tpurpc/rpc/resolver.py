@@ -9,10 +9,13 @@ The reference inherits these from gRPC's client_channel filter
 * ``register_resolver("scheme", fn)``       → the fake-resolver test seam
 
 Policies: ``pick_first`` (dial addresses in order, stick with the winner —
-gRPC's default), ``round_robin`` (rotate READY subchannels per call), and
+gRPC's default), ``round_robin`` (rotate READY subchannels per call),
 ``ring_hash`` (consistent hashing — the reference inherits
 ``lb_policy/ring_hash/ring_hash.cc``; same calls land on the same backend,
-and a dead backend's keys spill to its ring successor only).
+and a dead backend's keys spill to its ring successor only), and
+``least_loaded`` (tpurpc-fleet: ORCA-style load reports piggybacked in
+trailing metadata drive an EWMA pick order with outlier ejection of
+slow/erroring backends).
 """
 
 from __future__ import annotations
@@ -21,6 +24,7 @@ import hashlib
 import itertools
 import socket
 import threading
+import time
 from typing import Callable, List, Optional, Sequence, Tuple
 
 Address = Tuple[str, int]
@@ -156,6 +160,150 @@ class RoundRobin:
 
     def failed(self, idx: int) -> None:
         pass
+
+
+class LeastLoaded:
+    """Load-aware picking from ORCA-style per-response load reports
+    (tpurpc-fleet, ISSUE 6 — the reference's analog is the xds
+    ``orca_load_report`` consumed by custom LB policies).
+
+    Servers piggyback ``tpurpc-load: "<inflight>,<queue_depth>,<p99_ms>"``
+    in trailing metadata (see :func:`tpurpc.rpc.server.Server._load_md`);
+    the channel strips it off every response and feeds
+    :meth:`load_report`. Pick order sorts subchannels by an EWMA of the
+    reported utilization (inflight + queue depth), with a rotating
+    tiebreak so equally-loaded backends still round-robin.
+
+    Outlier ejection covers the two degradation modes load alone misses:
+
+    * **erroring** — ``ejection_failures`` consecutive dial/call failures
+      eject the subchannel for ``ejection_s`` seconds (flight event
+      ``subch-ejected``, reason 0); any success resets the streak.
+    * **slow** — a backend whose reported p99 EWMA exceeds
+      ``slow_mult`` × the fleet median (above a 1 ms floor) is ejected
+      the same way (reason 1) — a replica in GC hell or on a sick host
+      reports modest queue depth while serving garbage latency.
+
+    Ejection expiry reinstates the backend (``subch-reinstated``) so a
+    recovered replica is re-probed; ejected backends still appear LAST in
+    the pick order — a fleet with every member ejected degrades to
+    round-robin rather than failing picks.
+    """
+
+    name = "least_loaded"
+    ewma_alpha = 0.3
+
+    def __init__(self, n: int, *, ejection_failures: int = 3,
+                 ejection_s: float = 5.0, slow_mult: float = 4.0):
+        self._n = n
+        self.ejection_failures = ejection_failures
+        self.ejection_s = ejection_s
+        self.slow_mult = slow_mult
+        self._counter = itertools.count()
+        self._lock = threading.Lock()
+        self._load = [0.0] * n          # EWMA of inflight + queue depth
+        self._p99 = [0.0] * n           # EWMA of reported p99 (ms)
+        self._reported = [False] * n
+        self._fail_streak = [0] * n
+        self._ejected_until = [0.0] * n
+        # interned once; emits below are pure-int (flight lint discipline)
+        from tpurpc.obs import flight as _flight
+
+        self._flight = _flight
+        self._ftag = _flight.tag_for("lb:least_loaded")
+
+    @staticmethod
+    def parse_report(raw) -> "Optional[Tuple[float, float]]":
+        """``b"3,5,12.5"`` → ``(utilization, p99_ms)`` or None on junk."""
+        try:
+            if isinstance(raw, (bytes, bytearray, memoryview)):
+                raw = bytes(raw).decode("ascii")
+            parts = str(raw).split(",")
+            inflight = float(parts[0])
+            qdepth = float(parts[1]) if len(parts) > 1 else 0.0
+            p99_ms = float(parts[2]) if len(parts) > 2 else 0.0
+            return max(0.0, inflight) + max(0.0, qdepth), max(0.0, p99_ms)
+        except (ValueError, IndexError):
+            return None
+
+    def load_report(self, idx: int, raw) -> None:
+        """One server-piggybacked report for subchannel ``idx`` (called by
+        the channel on every response carrying one)."""
+        parsed = self.parse_report(raw)
+        if parsed is None or not 0 <= idx < self._n:
+            return
+        util, p99_ms = parsed
+        a = self.ewma_alpha
+        with self._lock:
+            if self._reported[idx]:
+                self._load[idx] += a * (util - self._load[idx])
+                self._p99[idx] += a * (p99_ms - self._p99[idx])
+            else:
+                self._reported[idx] = True
+                self._load[idx] = util
+                self._p99[idx] = p99_ms
+            self._maybe_eject_slow_locked(idx)
+
+    def _maybe_eject_slow_locked(self, idx: int) -> None:
+        now = time.monotonic()
+        if now < self._ejected_until[idx]:
+            return
+        peers = [self._p99[i] for i in range(self._n)
+                 if i != idx and self._reported[i]]
+        if not peers:
+            return
+        peers.sort()
+        median = peers[len(peers) // 2]
+        if self._p99[idx] > max(1.0, median * self.slow_mult):
+            self._ejected_until[idx] = now + self.ejection_s
+            self._flight.emit(self._flight.SUBCH_EJECT, self._ftag, idx, 1)
+
+    def order(self) -> Sequence[int]:
+        now = time.monotonic()
+        with self._lock:
+            rr = next(self._counter) % self._n
+            expired = [i for i in range(self._n)
+                       if self._ejected_until[i]
+                       and now >= self._ejected_until[i]]
+            for i in expired:
+                self._ejected_until[i] = 0.0
+                self._fail_streak[i] = 0
+                self._flight.emit(self._flight.SUBCH_REINSTATE,
+                                  self._ftag, i)
+            ranked = sorted(
+                range(self._n),
+                key=lambda i: (1 if now < self._ejected_until[i] else 0,
+                               self._load[i], (i - rr) % self._n))
+        return ranked
+
+    def connected(self, idx: int) -> None:
+        with self._lock:
+            if 0 <= idx < self._n:
+                self._fail_streak[idx] = 0
+
+    def failed(self, idx: int) -> None:
+        if not 0 <= idx < self._n:
+            return
+        with self._lock:
+            self._fail_streak[idx] += 1
+            if (self._fail_streak[idx] >= self.ejection_failures
+                    and time.monotonic() >= self._ejected_until[idx]):
+                self._ejected_until[idx] = (time.monotonic()
+                                            + self.ejection_s)
+                self._flight.emit(self._flight.SUBCH_EJECT,
+                                  self._ftag, idx, 0)
+
+    def snapshot(self) -> dict:
+        """Introspection/test seam: current EWMAs + ejection state."""
+        now = time.monotonic()
+        with self._lock:
+            return {
+                "load": list(self._load),
+                "p99_ms": list(self._p99),
+                "reported": list(self._reported),
+                "ejected": [now < t for t in self._ejected_until],
+                "fail_streak": list(self._fail_streak),
+            }
 
 
 _call_key = threading.local()
@@ -376,7 +524,7 @@ class WeightedTarget:
 
 
 POLICIES = {"pick_first": PickFirst, "round_robin": RoundRobin,
-            "ring_hash": RingHash}
+            "ring_hash": RingHash, "least_loaded": LeastLoaded}
 
 
 def make_policy(spec, n: int):

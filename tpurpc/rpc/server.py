@@ -47,6 +47,125 @@ _SRV_CALL_US = _obs_metrics.histogram("srv_call_us", kind="latency")
 #: tpurpc-blackbox (ISSUE 5): per-method, per-status-code RED counters
 #: (`srv_calls{method,code}` on /metrics); shared with the h2 plane
 _SRV_CALLS = _obs_metrics.labeled_counter("srv_calls", ("method", "code"))
+#: tpurpc-fleet (ISSUE 6): admission-control shed counter + the interned
+#: flight tags for the emission sites below (pure-int plumbing — the
+#: `flight` lint rule covers this module)
+_SRV_SHED = _obs_metrics.counter("srv_admission_rejected")
+_SRV_INLINE_TAG = _flight.tag_for("srv-inline")
+_SRV_ADMIT_TAG = _flight.tag_for("srv-admission")
+_SRV_DRAIN_TAG = _flight.tag_for("srv-drain")
+
+#: trailing-metadata key carrying the ORCA-style per-response load report
+#: (``"<inflight>,<queue_depth>,<p99_ms>"`` — see Server._load_md); the
+#: client channel strips it and feeds the ``least_loaded`` LB policy
+LOAD_KEY = "tpurpc-load"
+#: trailing-metadata key on admission rejections: how long the client
+#: should back off before retrying (milliseconds; RetryPolicy honors it)
+PUSHBACK_KEY = "tpurpc-pushback-ms"
+
+
+class AdmissionGate:
+    """Server-side overload admission control (tpurpc-fleet, ISSUE 6).
+
+    The gate sits at stream admission — BEFORE handler lookup, context
+    construction, or any pool handoff — and sheds load while the server
+    can still say so cheaply, instead of queueing toward collapse
+    (RDMAvisor's shared-daemon lesson: a multiplexing service must bound
+    what it accepts, arXiv:1802.01870). Two signals:
+
+    * **queue depth** — admitted-but-unfinished RPCs. Below
+      ``soft_limit`` everything is admitted; at ``max_inflight`` nothing
+      is.
+    * **rolling latency** — between the two limits, admission requires
+      the stall watchdog's rolling p99 (PR 5's per-method duration
+      windows) to be under ``latency_slo_ms``: rising latency at partial
+      queue depth is the pre-collapse signature the hard limit alone
+      would miss.
+
+    Rejections carry ``UNAVAILABLE`` plus :data:`PUSHBACK_KEY` trailing
+    metadata whose value grows with the excess — clients with a
+    :class:`~tpurpc.rpc.channel.RetryPolicy` honor it as their backoff
+    floor, so a shedding server is not immediately re-hammered. Health
+    RPCs are exempt (the server dispatch layer skips the gate for
+    ``/grpc.health.``-prefixed paths): an overloaded-but-alive backend
+    must keep answering its probes.
+    """
+
+    def __init__(self, max_inflight: int, *,
+                 soft_limit: Optional[int] = None,
+                 latency_slo_ms: Optional[float] = None,
+                 base_pushback_ms: int = 25,
+                 max_pushback_ms: int = 1000):
+        if max_inflight < 1:
+            raise ValueError("max_inflight must be >= 1")
+        self.max_inflight = int(max_inflight)
+        self.soft_limit = (int(soft_limit) if soft_limit is not None
+                           else max(1, (self.max_inflight * 3) // 4))
+        if not 1 <= self.soft_limit <= self.max_inflight:
+            raise ValueError("need 1 <= soft_limit <= max_inflight")
+        self.latency_slo_ms = latency_slo_ms
+        self.base_pushback_ms = int(base_pushback_ms)
+        self.max_pushback_ms = int(max_pushback_ms)
+        self._inflight = 0
+        self._lock = threading.Lock()
+        self.rejected = 0
+
+    def try_admit(self) -> Optional[int]:
+        """None = admitted (the caller OWES a :meth:`release`); an int =
+        rejected, with that many milliseconds of retry pushback."""
+        with self._lock:
+            n = self._inflight
+            if n < self.soft_limit:
+                self._inflight = n + 1
+                return None
+            slow = False
+            if n < self.max_inflight:
+                if self.latency_slo_ms is not None:
+                    from tpurpc.obs import watchdog as _watchdog
+
+                    p99 = _watchdog.get().rolling_p99_ns()
+                    slow = (p99 is not None
+                            and p99 / 1e6 > self.latency_slo_ms)
+                if not slow:
+                    self._inflight = n + 1
+                    return None
+            self.rejected += 1
+            excess = max(1, n - self.soft_limit + 1)
+            return min(self.max_pushback_ms,
+                       self.base_pushback_ms * excess)
+
+    def release(self) -> None:
+        with self._lock:
+            self._inflight = max(0, self._inflight - 1)
+
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    @classmethod
+    def from_env(cls) -> "Optional[AdmissionGate]":
+        """Gate configured by ``TPURPC_ADMISSION_MAX_INFLIGHT`` (+ optional
+        ``TPURPC_ADMISSION_SLO_MS``), or None when unset — admission
+        control is opt-in, like gRPC's resource quota."""
+        import os
+
+        raw = os.environ.get("TPURPC_ADMISSION_MAX_INFLIGHT", "")
+        if not raw:
+            return None
+        try:
+            max_inflight = int(raw)
+        except ValueError:
+            return None
+        if max_inflight < 1:
+            return None
+        slo = None
+        raw_slo = os.environ.get("TPURPC_ADMISSION_SLO_MS", "")
+        if raw_slo:
+            try:
+                slo = float(raw_slo)
+            except ValueError:
+                slo = None
+        return cls(max_inflight, latency_slo_ms=slo)
 
 
 def _extract_trace(metadata) -> "Optional[_tracing.TraceContext]":
@@ -606,8 +725,20 @@ class _ServerConnection:
         st = _ServerStream(f.stream_id,
                            queue_depth=get_config().stream_queue_depth,
                            recv_limit=self.server.max_receive_message_length)
+        #: health probes are admitted during drain and excluded from the
+        #: drain's remaining-stream count (a held-open Watch must not make
+        #: a clean drain report as missing its budget)
+        st.is_probe = path.startswith("/grpc.health.")
+        # Health RPCs are admitted even while draining: the drain contract
+        # is that the health service ANSWERS NOT_SERVING — a refused probe
+        # reads as death, not as leaving rotation.
+        probe = st.is_probe
         with self._lock:
-            if self.draining:
+            # server._draining closes the adoption race: a connection
+            # dialed into a draining server can dispatch HEADERS before
+            # _sniff_and_serve marks it draining — the stream must still
+            # be refused (zero-failed-RPC drain contract)
+            if (self.draining or self.server._draining) and not probe:
                 rejected = True  # raced the GOAWAY: client dials fresh
             else:
                 rejected = False
@@ -620,6 +751,26 @@ class _ServerConnection:
                              fr.rst_payload(StatusCode.UNAVAILABLE,
                                             "connection draining (max_age)"))
             return
+        # tpurpc-fleet admission control: shed BEFORE any handler work.
+        # Health probes are exempt — an overloaded backend must keep
+        # answering its LB's probes or shedding reads as death.
+        gate = self.server.admission
+        if gate is not None and not path.startswith("/grpc.health."):
+            pushback_ms = gate.try_admit()
+            if pushback_ms is not None:
+                _SRV_SHED.inc()
+                inflight_now = gate.inflight()
+                _flight.emit(_flight.ADMIT_REJECT, _SRV_ADMIT_TAG,
+                             inflight_now, pushback_ms)
+                self._send_trailers(
+                    st, StatusCode.UNAVAILABLE,
+                    f"server overloaded: admission rejected "
+                    f"({inflight_now} in flight); retry after "
+                    f"{pushback_ms}ms",
+                    [(PUSHBACK_KEY, str(pushback_ms))])
+                self._finish_stream(st)
+                return
+            st._gate = gate  # released exactly once in _finish_stream
         deadline = (None if timeout_us is None
                     else time.monotonic() + timeout_us / 1e6)
         # tpurpc-scope: pick up a sampled caller's trace context; the
@@ -700,7 +851,7 @@ class _ServerConnection:
     def _inline_deadline(self, st: _ServerStream) -> None:
         if self._claim_inline(st) is not None:
             _flight.emit(_flight.DEADLINE_EXPIRED,
-                         _flight.tag_for("srv-inline"), st.stream_id)
+                         _SRV_INLINE_TAG, st.stream_id)
             self._send_trailers(st, StatusCode.DEADLINE_EXCEEDED,
                                 "deadline exceeded awaiting request")
             self._finish_stream(st)
@@ -821,8 +972,10 @@ class _ServerConnection:
                              st.stream_id,
                              handler.response_serializer(result)),
                             (fr.TRAILERS, fr.FLAG_END_STREAM, st.stream_id,
-                             fr.trailers_payload(code, ctx._details,
-                                                 list(ctx._trailing))),
+                             fr.trailers_payload(
+                                 code, ctx._details,
+                                 list(ctx._trailing)
+                                 + self.server._load_md())),
                         ])
                 except fr.FrameError:
                     self._send_trailers(st, StatusCode.INTERNAL,
@@ -844,10 +997,13 @@ class _ServerConnection:
     def _send_trailers(self, st: _ServerStream, code: StatusCode, details: str,
                        metadata: Metadata = ()) -> None:
         st.final_code = code
+        # tpurpc-fleet: every terminal response piggybacks the (cached)
+        # load report — the least_loaded policy's per-response feed
+        md = list(metadata) + self.server._load_md()
         try:
             try:
                 self.writer.send(fr.TRAILERS, fr.FLAG_END_STREAM, st.stream_id,
-                                 fr.trailers_payload(code, details, list(metadata)))
+                                 fr.trailers_payload(code, details, md))
             except fr.FrameError:
                 # User trailing metadata too large for one control frame: still
                 # terminate the stream correctly, just without the metadata.
@@ -861,7 +1017,14 @@ class _ServerConnection:
     def _finish_stream(self, st: _ServerStream) -> None:
         with self._lock:
             self._streams.pop(st.stream_id, None)
+            # admission release exactly once (the RST path and the handler
+            # finally can both land here; the lock orders the take)
+            gate = getattr(st, "_gate", None)
+            if gate is not None:
+                st._gate = None
             drained = self.draining and not self._streams and self.alive
+        if gate is not None:
+            gate.release()
         if drained and getattr(self, "_linger_timer", None) is None:
             # last in-flight stream after GOAWAY: close after the linger
             # (racing HEADERS still get a clean RST meanwhile)
@@ -879,6 +1042,10 @@ class _ServerConnection:
             if h is not None:
                 h.cancel()  # wheel handles; ticks also re-check alive
         for st in streams:
+            gate = getattr(st, "_gate", None)
+            if gate is not None:
+                st._gate = None
+                gate.release()  # connection died with the stream admitted
             st.cancel()
         try:
             self.endpoint.close()
@@ -898,7 +1065,8 @@ class Server:
 
     def __init__(self, max_workers: int = 32, interceptors: Sequence = (),
                  max_receive_message_length: Optional[int] = None,
-                 native_dataplane: Optional[bool] = None):
+                 native_dataplane: Optional[bool] = None,
+                 admission: "Optional[AdmissionGate]" = None):
         #: tpurpc extension: None = auto (adopt ring connections onto the
         #: native shared-poller loop when eligible — the small-RPC latency
         #: plane); False = always the Python plane (fully instrumented —
@@ -928,6 +1096,19 @@ class Server:
         self._stopping = False  # set under _lock before conns are torn down
         self._serving = threading.Event()
         self._stopped = threading.Event()
+        # tpurpc-fleet (ISSUE 6): overload admission gate (explicit wins;
+        # TPURPC_ADMISSION_MAX_INFLIGHT configures one from the env),
+        # graceful-drain state, and the per-response load-report cache
+        self.admission = (admission if admission is not None
+                          else AdmissionGate.from_env())
+        self._draining = False
+        self._health_servicer = None  # set by HealthServicer.add_to_server
+        import os as _os
+
+        self._load_reports = _os.environ.get(
+            "TPURPC_LOAD_REPORTS", "1").lower() not in ("0", "off", "false")
+        self._load_extra: Optional[Callable[[], int]] = None
+        self._load_cache: Tuple[float, Optional[list]] = (0.0, None)
 
     # -- registration --------------------------------------------------------
 
@@ -1183,10 +1364,25 @@ class Server:
         # reconnect bug: client saw healthy trailers, so it never redialed).
         with self._lock:
             adopted = not self._stopping
+            drain_new = self._draining
             if adopted:
                 self._connections.append(conn)
         if not adopted:
             conn.close()
+        elif drain_new:
+            # tpurpc-fleet: a connection dialed INTO a draining server (a
+            # stale resolver, or a subchannel racing the drain) is told
+            # immediately — streams that race the GOAWAY get the refused
+            # RST, which clients replay on another backend
+            writer = getattr(conn, "writer", None)
+            if writer is not None:
+                with conn._lock:
+                    conn.draining = True
+                try:
+                    writer.send(fr.GOAWAY, 0, 0, b"server drain")
+                except (EndpointError, OSError, fr.FrameError):
+                    pass
+                conn._linger_then_shutdown()
 
     def _forget(self, conn: _ServerConnection) -> None:
         with self._lock:
@@ -1258,6 +1454,134 @@ class Server:
         with self._lock:
             conns = list(self._connections)
         return sum(len(getattr(c, "_streams", ())) for c in conns)
+
+    # -- fleet front door (tpurpc-fleet, ISSUE 6) -----------------------------
+
+    def set_load_provider(self, fn: Optional[Callable[[], int]]) -> None:
+        """Register an extra queue-depth signal for the load report —
+        serve_jax wires the FanInBatcher's queue depth here, so the
+        ``least_loaded`` policy sees requests parked BEHIND the transport
+        (the batcher is where overload actually queues on a model server)."""
+        self._load_extra = fn
+
+    def _load_md(self) -> list:
+        """The ORCA-style piggyback: ``[(LOAD_KEY, "i,q,p99ms")]`` appended
+        to every terminal response's trailing metadata, or ``[]`` when
+        disabled (``TPURPC_LOAD_REPORTS=0``).
+
+        Cached ~20 ms so the per-response cost is one monotonic read plus a
+        list concat — load is a trend, not a fence, and the client-side
+        EWMA smooths staleness anyway. Inflight comes from the admission
+        gate's own counter when one is installed (no lock sweep), else from
+        :meth:`inflight_requests`."""
+        if not self._load_reports:
+            return []
+        now = time.monotonic()
+        stamp, cached = self._load_cache
+        if cached is not None and now - stamp < 0.02:
+            return cached
+        gate = self.admission
+        inflight = (gate.inflight() if gate is not None
+                    else self.inflight_requests())
+        qdepth = 0
+        extra = self._load_extra
+        if extra is not None:
+            try:
+                qdepth = int(extra())
+            except Exception:
+                qdepth = 0
+        p99_ms = 0.0
+        try:
+            from tpurpc.obs import watchdog as _watchdog
+
+            p99 = _watchdog.get().rolling_p99_ns()
+            if p99:
+                p99_ms = p99 / 1e6
+        except Exception:
+            pass
+        md = [(LOAD_KEY, f"{inflight},{qdepth},{p99_ms:.1f}")]
+        self._load_cache = (now, md)
+        return md
+
+    @property
+    def draining(self) -> bool:
+        """True between :meth:`drain` and :meth:`stop` — /healthz reports
+        ``draining`` and the health service answers NOT_SERVING. A stopped
+        server is not draining (it is gone): /healthz on a process whose
+        old server object lingers must not keep reporting the drain."""
+        return self._draining and not self._stopped.is_set()
+
+    def drain(self, linger: float = 5.0) -> bool:
+        """Server-wide graceful drain: announce, bleed, never fail a call.
+
+        Generalizes the per-connection ``max_connection_age`` path to the
+        whole server: (1) the attached health servicer (if any) flips every
+        service to NOT_SERVING so LBs stop routing here; (2) every live
+        connection gets a GOAWAY — clients stop opening streams on it and
+        dial elsewhere; streams that race the GOAWAY are refused with
+        FLAG_REFUSED, which clients replay on another subchannel
+        (zero failed RPCs); (3) in-flight streams run to completion under
+        the ``linger`` budget. Connections opened DURING the drain are
+        GOAWAY'd at adoption, so a stale resolver can't keep feeding this
+        backend.
+
+        The server object stays alive (listeners answer /healthz scrapes
+        and health RPCs — orchestrators need the probe plane up while
+        connections bleed); call :meth:`stop` once traffic has moved.
+        Returns True iff every in-flight stream finished within the budget.
+        Idempotent: a second call just re-waits the remaining streams."""
+        with self._lock:
+            first = not self._draining
+            self._draining = True
+            conns = list(self._connections)
+        n_conns = len(conns)
+        if first:
+            _flight.emit(_flight.DRAIN_BEGIN, _SRV_DRAIN_TAG, n_conns)
+            hs = self._health_servicer
+            if hs is not None:
+                from tpurpc.rpc.health import ServingStatus
+
+                hs.set_all(ServingStatus.NOT_SERVING)
+            from tpurpc.wire import h2 as _h2
+
+            for conn in conns:
+                writer = getattr(conn, "writer", None)
+                if writer is None:
+                    # h2-protocol connection: speak h2's own GOAWAY
+                    try:
+                        conn._write(_h2.pack_goaway(0, 0, b"server drain"))
+                    except Exception:
+                        pass  # connection already dying
+                    continue
+                with conn._lock:
+                    if not conn.alive or conn.draining:
+                        continue
+                    conn.draining = True
+                    empty = not conn._streams
+                try:
+                    writer.send(fr.GOAWAY, 0, 0, b"server drain")
+                except (EndpointError, OSError, fr.FrameError):
+                    continue  # connection already dying
+                if empty:
+                    # no in-flight streams: close after the refused-HEADERS
+                    # linger (the max_age path's exact contract)
+                    conn._linger_then_shutdown()
+        deadline = time.monotonic() + max(0.0, linger)
+        while True:
+            with self._lock:
+                # health probes (Check + held-open Watch streams) are
+                # admitted during drain and must not count against it
+                remaining = sum(
+                    1
+                    for c in self._connections
+                    for st in list(getattr(c, "_streams", {}).values())
+                    if not getattr(st, "is_probe", False))
+            if remaining == 0 or time.monotonic() >= deadline:
+                break
+            time.sleep(0.01)
+        if first:
+            _flight.emit(_flight.DRAIN_END, _SRV_DRAIN_TAG, remaining)
+        return remaining == 0
 
 
 def server(thread_pool=None, handlers=None, interceptors=None, options=None,

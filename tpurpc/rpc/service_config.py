@@ -106,13 +106,16 @@ class RetryThrottle:
 class MethodConfig:
     """One resolved per-method view: what the channel consults at call time."""
 
-    __slots__ = ("timeout", "retry_policy", "wait_for_ready")
+    __slots__ = ("timeout", "retry_policy", "wait_for_ready",
+                 "hedging_policy")
 
     def __init__(self, timeout: Optional[float] = None,
-                 retry_policy=None, wait_for_ready: Optional[bool] = None):
+                 retry_policy=None, wait_for_ready: Optional[bool] = None,
+                 hedging_policy=None):
         self.timeout = timeout
         self.retry_policy = retry_policy
         self.wait_for_ready = wait_for_ready
+        self.hedging_policy = hedging_policy
 
 
 _EMPTY = MethodConfig()
@@ -154,6 +157,41 @@ def _parse_retry_policy(body: dict):
         max_backoff=maxi,
         backoff_multiplier=mult,
         retryable_codes=codes)
+
+
+def _parse_hedging_policy(body: dict):
+    """gRFC A6 ``hedgingPolicy``: N staggered attempts under one deadline.
+
+    Schema (proto3 JSON, like retryPolicy)::
+
+        "hedgingPolicy": {"maxAttempts": 3,
+                          "hedgingDelay": "0.01s",
+                          "nonFatalStatusCodes": ["UNAVAILABLE"]}
+
+    A status in ``nonFatalStatusCodes`` lets the NEXT hedge fire
+    immediately; any other failure is fatal and resolves the call. The
+    same ``maxAttempts`` cap as retryPolicy applies."""
+    from tpurpc.rpc.channel import HedgingPolicy  # lazy: channel imports us
+
+    if not isinstance(body, dict):
+        raise ValueError(f"hedgingPolicy must be an object, got {body!r}")
+    codes = []
+    for name in body.get("nonFatalStatusCodes", ()):
+        try:
+            codes.append(StatusCode[str(name).upper()])
+        except KeyError:
+            raise ValueError(f"unknown status code {name!r} in "
+                             "nonFatalStatusCodes") from None
+    max_attempts = int(body.get("maxAttempts", 0))
+    if max_attempts < 2:
+        raise ValueError("hedgingPolicy.maxAttempts must be >= 2")
+    delay = _parse_duration(body.get("hedgingDelay", "0s"))
+    if delay < 0:
+        raise ValueError("hedgingPolicy.hedgingDelay must be >= 0")
+    return HedgingPolicy(
+        max_attempts=min(max_attempts, MAX_ATTEMPTS_CAP),
+        hedging_delay=delay,
+        non_fatal_codes=codes or (StatusCode.UNAVAILABLE,))
 
 
 def split_method(method: str) -> Tuple[str, str]:
@@ -227,12 +265,18 @@ class ServiceConfig:
                 names.append((service, name))
             if not names:
                 raise ValueError("methodConfig entry without name list")
+            if "retryPolicy" in entry and "hedgingPolicy" in entry:
+                # gRFC A6: a method has ONE of the two execution strategies
+                raise ValueError("methodConfig entry has both retryPolicy "
+                                 "and hedgingPolicy (mutually exclusive)")
             mc = MethodConfig(
                 timeout=(_parse_duration(entry["timeout"])
                          if "timeout" in entry else None),
                 retry_policy=(_parse_retry_policy(entry["retryPolicy"])
                               if "retryPolicy" in entry else None),
-                wait_for_ready=entry.get("waitForReady"))
+                wait_for_ready=entry.get("waitForReady"),
+                hedging_policy=(_parse_hedging_policy(entry["hedgingPolicy"])
+                                if "hedgingPolicy" in entry else None))
             entries.append((names, mc))
         return cls(entries, throttle, obj)
 

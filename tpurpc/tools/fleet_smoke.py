@@ -1,0 +1,126 @@
+"""Fleet front-door smoke for the verification gate (tools/check.sh).
+
+The ISSUE 6 story end to end in a few seconds, no jax: 3 echo servers
+behind a ``round_robin`` channel, steady traffic from hedged unary
+callers, then — mid-traffic — one server is KILLED (stop grace=0) and
+another is DRAINED (``Server.drain``). Asserts:
+
+* **zero failed RPCs**: every call completes OK. Kill coverage comes from
+  hedging (the attempt on the dead server fails UNAVAILABLE, the hedge on
+  a live one wins); drain coverage from the refused-stream migration
+  (FLAG_REFUSED replays exclude the drainer).
+* the drain completes within its linger budget, and the drained server
+  receives no traffic afterwards;
+* the flight recorder holds the hedge (``hedge-fired``/``hedge-won``) and
+  drain (``drain-begin``→``drain-end``, ordered) evidence the chaos
+  postmortem story depends on.
+
+Exit 0 on success; any assertion/exception exits 1 with the reason.
+
+    python -m tpurpc.tools.fleet_smoke
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+
+CLIENTS = 4
+SERVERS = 3
+
+
+def run() -> int:
+    from tpurpc.obs import flight
+    from tpurpc.rpc.channel import Channel, HedgingPolicy
+    from tpurpc.rpc.server import Server, unary_unary_rpc_method_handler
+
+    #: set → server 0 turns into the SLOW replica (the degraded-backend
+    #: phase: in-flight calls on it must hedge to a healthy sibling)
+    slow_mode = threading.Event()
+    rigs = []
+    for i in range(SERVERS):
+        srv = Server(max_workers=8, native_dataplane=False)
+        calls = [0]
+
+        def handler(req, ctx, _c=calls, _slow=(i == 0)):
+            _c[0] += 1
+            time.sleep(0.25 if _slow and slow_mode.is_set() else 0.001)
+            return req
+
+        srv.add_method("/fleet/Echo", unary_unary_rpc_method_handler(handler))
+        port = srv.add_insecure_port("127.0.0.1:0")
+        srv.start()
+        rigs.append((srv, port, calls))
+    addrs = ",".join(f"127.0.0.1:{p}" for _, p, _ in rigs)
+    flight.RECORDER.reset()
+    stop = threading.Event()
+    errors: list = []
+    done = [0] * CLIENTS
+    try:
+        with Channel(f"ipv4:{addrs}", lb_policy="round_robin",
+                     hedging_policy=HedgingPolicy(max_attempts=3,
+                                                  hedging_delay=0.05)) as ch:
+            mc = ch.unary_unary("/fleet/Echo")
+
+            def worker(idx: int):
+                while not stop.is_set():
+                    payload = b"c%d-%d" % (idx, done[idx])
+                    try:
+                        got = bytes(mc(payload, timeout=30))
+                        assert got == payload, (got, payload)
+                        done[idx] += 1
+                    except Exception as exc:
+                        errors.append(exc)
+                        return
+
+            threads = [threading.Thread(target=worker, args=(i,))
+                       for i in range(CLIENTS)]
+            [t.start() for t in threads]
+            time.sleep(0.4)  # steady state
+            slow_mode.set()  # server 0 degrades: its calls must hedge out
+            time.sleep(0.5)
+            rigs[0][0].stop(grace=0)          # ...then KILL it outright...
+            time.sleep(0.4)
+            clean = rigs[1][0].drain(linger=10.0)  # ...DRAIN another
+            drained_at = rigs[1][2][0]
+            time.sleep(0.8)  # traffic continues on the last healthy server
+            stop.set()
+            [t.join(timeout=30) for t in threads]
+        assert not errors, f"failed RPCs: {errors[:3]}"
+        assert all(n > 10 for n in done), f"a client stalled: {done}"
+        assert clean, "drain missed its linger budget"
+        assert rigs[1][2][0] == drained_at, \
+            "drained server saw traffic after drain"
+        assert rigs[2][2][0] > 0, "surviving server took no traffic"
+        events = [(e["event"], e["t_ns"]) for e in flight.snapshot()]
+        names = [ev for ev, _t in events]
+        assert "hedge-fired" in names, \
+            "no hedge fired across the slow/kill phase"
+        assert "hedge-won" in names, "no hedge won"
+        t_begin = next(t for ev, t in events if ev == "drain-begin")
+        t_end = next(t for ev, t in events if ev == "drain-end")
+        assert t_begin <= t_end, "drain flight events out of order"
+    finally:
+        stop.set()
+        for srv, _, _ in rigs:
+            try:
+                srv.stop(grace=0)
+            except Exception:
+                pass
+    print(f"fleet smoke: {sum(done)} RPCs across {CLIENTS} hedged clients, "
+          f"1 server killed + 1 drained mid-traffic, zero failures; "
+          "hedge + drain flight events present and ordered")
+    return 0
+
+
+def main() -> int:
+    try:
+        return run()
+    except BaseException as exc:  # the gate wants a reasoned nonzero exit
+        print(f"fleet smoke FAILED: {exc!r}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
