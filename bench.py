@@ -1024,6 +1024,216 @@ def _fleet_bench() -> dict:
     return out
 
 
+#: tpurpc-manycore (ISSUE 7) — the sharded serving rig. The model is a
+#: NUMPY matmul stand-in built pre-fork (plain arrays are fork-safe,
+#: copy-on-write; an XLA client is not — that is why shard workers stay
+#: jax-free here, and the artifact names the stand-in). Workers are full
+#: per-core servers: own poller, rings (auto-scaled per shard), pool.
+_SHARD_SERVER_CODE = r"""
+import os, sys
+import numpy as np
+from tpurpc.jaxshim.service import add_tensor_method
+from tpurpc.rpc.server import Server
+from tpurpc.rpc.shard import ShardedServer
+
+IMG = int(os.environ.get("TPURPC_BENCH_CORES_IMG", "48"))
+WORKERS = int(sys.argv[1])
+
+rng = np.random.default_rng(0)
+W1 = rng.standard_normal((IMG * IMG * 3, 128)).astype(np.float32) * 0.01
+W2 = rng.standard_normal((128, 10)).astype(np.float32) * 0.1
+
+def model(tree):
+    x = np.asarray(tree["x"], dtype=np.float32)
+    x = x.reshape(x.shape[0], -1)
+    return {"logits": np.maximum(x @ W1, 0.0) @ W2}
+
+def build(shard_id):
+    srv = Server(max_workers=32)
+    add_tensor_method(srv, "Infer", model)
+    return srv
+
+sup = ShardedServer(build, workers=WORKERS, listener="reuseport").start()
+print("PORT", sup.port, flush=True)
+print("READY", flush=True)
+sys.stdin.readline()
+sup.stop()
+"""
+
+#: closed-loop client PROCESS (not thread): on a multi-core rig the load
+#: generators must scale past the GIL too, or the sweep measures the
+#: client's one core instead of the server's N.
+_SHARD_CLIENT_CODE = r"""
+import sys, time
+import numpy as np
+from tpurpc.jaxshim import TensorClient
+from tpurpc.rpc.channel import Channel
+
+port, depth, dur, img = (int(sys.argv[1]), int(sys.argv[2]),
+                         float(sys.argv[3]), int(sys.argv[4]))
+image = np.random.default_rng(0).standard_normal(
+    (1, img, img, 3)).astype(np.float32)
+with Channel(f"127.0.0.1:{port}") as ch:
+    cli = TensorClient(ch, depth=max(1, depth))
+    out = cli.call("Infer", {"x": image}, timeout=120)  # warm this conn
+    assert np.asarray(out["logits"]).shape[0] == 1
+    print("READY", flush=True)
+    sys.stdin.readline()  # GO
+    n = 0
+    end = time.perf_counter() + dur
+    if depth <= 1:
+        while time.perf_counter() < end:
+            cli.call("Infer", {"x": image}, timeout=120)
+            n += 1
+    else:
+        pl = cli.pipeline("Infer", depth=depth)
+        inflight = []
+        while time.perf_counter() < end:
+            while len(inflight) < depth:
+                inflight.append(pl.call_async({"x": image}, timeout=120))
+            inflight.pop(0).result(timeout=120)
+            n += 1
+        for f in inflight:
+            f.result(timeout=120)
+            n += 1
+    print("DONE", n, flush=True)
+"""
+
+
+def _shard_cell(env, workers: int, n_clients: int, depth: int,
+                duration_s: float, img: int) -> float:
+    """One sweep cell: a sharded server subprocess + ``n_clients`` client
+    processes released on a barrier; returns aggregate QPS."""
+    srv = subprocess.Popen(
+        [sys.executable, "-u", "-c", _SHARD_SERVER_CODE, str(workers)],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, env=env, text=True)
+    clients = []
+    try:
+        port = None
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            line = srv.stdout.readline()
+            if not line:
+                raise RuntimeError(
+                    f"shard server died: {srv.stderr.read()[-800:]}")
+            if line.startswith("PORT"):
+                port = int(line.split()[1])
+            if line.startswith("READY"):
+                break
+        if port is None:
+            raise TimeoutError("shard server never reported PORT")
+        clients = [subprocess.Popen(
+            [sys.executable, "-u", "-c", _SHARD_CLIENT_CODE, str(port),
+             str(depth), str(duration_s), str(img)],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, env=env, text=True)
+            for _ in range(n_clients)]
+        for c in clients:
+            line = c.stdout.readline()
+            if not line.startswith("READY"):
+                raise RuntimeError(
+                    f"shard client died: {c.stderr.read()[-800:]}")
+        t0 = time.perf_counter()
+        for c in clients:  # the GO barrier: one newline each
+            c.stdin.write("\n")
+            c.stdin.flush()
+        total = 0
+        for c in clients:
+            line = c.stdout.readline()
+            if not line.startswith("DONE"):
+                raise RuntimeError(
+                    f"shard client failed: {c.stderr.read()[-800:]}")
+            total += int(line.split()[1])
+        dt = time.perf_counter() - t0
+        return total / dt
+    finally:
+        for c in clients:
+            c.kill()
+        try:
+            srv.stdin.write("\n")
+            srv.stdin.flush()
+            srv.wait(timeout=10)
+        except (OSError, subprocess.TimeoutExpired):
+            srv.kill()
+
+
+def _shard_bench() -> dict:
+    """tpurpc-manycore (ISSUE 7): ``serving_qps_by_cores`` — aggregate QPS
+    vs. worker count (1/2/4 per-core shard processes behind one
+    SO_REUSEPORT port), plus the PR 3 depth sweep re-run WITH sharding.
+
+    Methodology notes the artifact must carry:
+
+    * ``cores_requested`` vs ``cores_achieved`` per cell, exactly like
+      PR 3's concurrency probes — on a 1-core rig every worker count
+      timeshares one core, so the sweep is expected ~flat there and the
+      ≥2.5x@4 acceptance gate only APPLIES where ``cores_achieved >= 4``;
+    * clients are PROCESSES (closed-loop, depth-4, barrier-released), so
+      on a multi-core rig the load generation scales past the GIL too;
+    * the model is a numpy matmul stand-in built pre-fork (fork-safe,
+      jax-free workers) — this measures the SERVING PATH's core scaling,
+      which is the thing sharding changes.
+    """
+    cpus = _cores_available()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (os.path.dirname(os.path.abspath(__file__))
+                         + os.pathsep + env.get("PYTHONPATH", ""))
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # jax-free, but belt+braces
+    img = int(os.environ.get("TPURPC_BENCH_CORES_IMG", "48"))
+    dur = float(os.environ.get("TPURPC_BENCH_CORES_S", "2.5"))
+    n_clients = int(os.environ.get("TPURPC_BENCH_CORES_CLIENTS", "4"))
+    out: dict = {}
+    by_cores = {}
+    achieved = {}
+    for workers in (1, 2, 4):
+        qps = _shard_cell(env, workers, n_clients, depth=4,
+                          duration_s=dur, img=img)
+        by_cores[str(workers)] = round(qps, 1)
+        achieved[str(workers)] = min(workers, cpus)
+    out["serving_qps_by_cores"] = by_cores
+    out["serving_by_cores_requested"] = [1, 2, 4]
+    out["serving_by_cores_achieved"] = achieved
+    out["serving_by_cores_clients"] = n_clients
+    out["serving_by_cores_model"] = (
+        f"numpy relu-matmul stand-in @{img} (jax-free shard workers; "
+        "fork-safe)")
+    ratio = (by_cores["4"] / by_cores["1"]) if by_cores["1"] else 0.0
+    out["serving_by_cores_scaling_x4"] = round(ratio, 2)
+    # the acceptance gate (≥2.5x at 4 workers) binds on multi-core rigs;
+    # elsewhere the honest record is requested-vs-achieved + a note
+    out["serving_by_cores_gate"] = {
+        "target_x": 2.5,
+        "applicable": cpus >= 4,
+        "pass": (ratio >= 2.5) if cpus >= 4 else None,
+    }
+    if cpus < 4:
+        out["serving_by_cores_note"] = (
+            f"{cpus}-core rig: all worker counts timeshare "
+            f"{cpus} core(s), so the sweep is ~flat by physics (same "
+            "regime as the PR 3 depth sweep); the machinery is validated "
+            "here, the scaling claim binds on a multi-core rig — "
+            "cores_achieved records the truth per cell")
+    # the PR 3 depth sweep, re-run with sharding enabled: once the serving
+    # core has headroom (multi-core rigs), depth should stop being flat
+    sharded_workers = min(4, max(2, cpus))
+    sweep = {}
+    for depth in (1, 4, 16):
+        qps = _shard_cell(env, sharded_workers, n_clients, depth=depth,
+                          duration_s=dur, img=img)
+        sweep[str(depth)] = round(qps, 1)
+    out["serving_qps_by_depth_sharded"] = sweep
+    out["serving_by_depth_sharded_workers"] = sharded_workers
+    return out
+
+
+def _cores_available() -> int:
+    try:
+        return len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        return os.cpu_count() or 1
+
+
 def _calibration() -> dict:
     """Tiny host-speed probes so round-over-round artifacts are comparable
     across noisy-neighbor weather (VERDICT r3 weak #1): a memcpy-bandwidth
@@ -1200,6 +1410,16 @@ def main() -> None:
         except Exception as exc:
             sys.stderr.write(f"fleet bench failed: {exc}\n")
             out["fleet_bench_error"] = repr(exc)
+    # tpurpc-manycore (ISSUE 7): serving QPS vs. shard-worker count (1/2/4
+    # per-core processes, one SO_REUSEPORT port) + the depth sweep re-run
+    # under sharding; cores_requested/achieved recorded like PR 3's
+    # concurrency probes. ~35s, jax-free subprocesses.
+    if os.environ.get("TPURPC_BENCH_CORES", "1") == "1":
+        try:
+            out.update(_shard_bench())
+        except Exception as exc:
+            sys.stderr.write(f"shard bench failed: {exc}\n")
+            out["shard_bench_error"] = repr(exc)
     if fallback:
         # Loud, unmissable: this artifact measured the CPU fallback, not the
         # chip — the number is NOT comparable to an accelerator run (and the

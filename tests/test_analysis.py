@@ -598,3 +598,162 @@ def test_lockmap_declarations_hold_on_declaring_modules():
         with open(path, "r", encoding="utf-8") as f:
             vs = [v for v in lint_source(f.read(), path) if v.rule == "lock"]
         assert vs == [], vs
+
+
+# ---------------------------------------------------------------------------
+# lint: shard confinement (tpurpc-manycore, ISSUE 7)
+# ---------------------------------------------------------------------------
+
+SHARD_OK = '''
+class Sub:
+    _GUARDED_BY = {"out": "done"}
+
+class Merger:
+    _MERGE_BOUNDARY = ("_merge_loop", "_resolve")
+
+    def _merge_loop(self):
+        sub = self.ring.take()
+        self._resolve(sub)
+
+    def _resolve(self, sub):
+        sub.out = 1        # cross-shard write INSIDE the boundary: legal
+'''
+
+SHARD_CROSS_MUTATION = '''
+class Sub:
+    _GUARDED_BY = {"out": "done"}
+
+class Merger:
+    _MERGE_BOUNDARY = ("_merge_loop",)
+
+    def _merge_loop(self):
+        pass
+
+    def helper(self, sub):
+        sub.out = 1        # cross-shard write OUTSIDE the boundary
+'''
+
+SHARD_MUTATOR_CALL = '''
+class Shard:
+    _GUARDED_BY = {"_queue": "_lock"}
+
+class Merger:
+    _MERGE_BOUNDARY = ("_merge_loop",)
+
+    def _merge_loop(self):
+        pass
+
+    def steal(self, other):
+        other._queue.append(1)   # reaching into another shard's queue
+'''
+
+SHARD_SELF_OK = '''
+class Shard:
+    _GUARDED_BY = {"_queue": "_lock"}
+    _MERGE_BOUNDARY = ("_merge_loop",)
+
+    def _merge_loop(self):
+        pass
+
+    def local(self):
+        with self._lock:
+            self._queue.append(1)   # shard-LOCAL mutation: the lock map rules
+'''
+
+SHARD_NOT_ARMED = '''
+class Shard:
+    _GUARDED_BY = {"_queue": "_lock"}
+
+def elsewhere(other):
+    other._queue.append(1)   # no _MERGE_BOUNDARY in module: rule silent
+'''
+
+
+def test_shard_rule_boundary_mutation_passes():
+    assert "shard" not in _rules(lint_source(SHARD_OK, "x.py"))
+
+
+def test_shard_rule_flags_cross_shard_mutation():
+    v = [x for x in lint_source(SHARD_CROSS_MUTATION, "x.py")
+         if x.rule == "shard"]
+    assert len(v) == 1 and "Sub.out" in v[0].message
+
+
+def test_shard_rule_flags_mutator_calls():
+    v = [x for x in lint_source(SHARD_MUTATOR_CALL, "x.py")
+         if x.rule == "shard"]
+    assert len(v) == 1 and "Shard._queue" in v[0].message
+
+
+def test_shard_rule_self_mutation_is_lock_maps_job():
+    assert "shard" not in _rules(lint_source(SHARD_SELF_OK, "x.py"))
+
+
+def test_shard_rule_only_armed_with_merge_boundary():
+    assert "shard" not in _rules(lint_source(SHARD_NOT_ARMED, "x.py"))
+
+
+def test_shard_rule_suppression_comment():
+    src = SHARD_CROSS_MUTATION.replace(
+        "sub.out = 1 ", "sub.out = 1  # tpr: allow(shard)")
+    assert "shard" not in _rules(lint_source(src, "x.py"))
+
+
+def test_shard_rule_jaxshim_service_is_clean():
+    """The real merge module must satisfy its own declared boundary."""
+    import os
+
+    import tpurpc
+
+    path = os.path.join(os.path.dirname(tpurpc.__file__), "jaxshim",
+                        "service.py")
+    with open(path, "r", encoding="utf-8") as f:
+        src = f.read()
+    assert "_MERGE_BOUNDARY" in src  # the rule is ARMED there
+    assert "shard" not in _rules(lint_source(src, path))
+
+
+# ---------------------------------------------------------------------------
+# ringcheck: MPMC handoff model (tpurpc-manycore, ISSUE 7)
+# ---------------------------------------------------------------------------
+
+def test_handoff_protocol_exhaustive_ok():
+    res = ringcheck.check_handoff(n_producers=2, items_per_producer=2,
+                                  capacity=2, words=2)
+    assert res.ok, res
+
+
+def test_handoff_three_producers_ok():
+    res = ringcheck.check_handoff(n_producers=3, items_per_producer=1,
+                                  capacity=2, words=2)
+    assert res.ok, res
+
+
+@pytest.mark.parametrize("mutant", ringcheck.HANDOFF_MUTANTS)
+def test_every_handoff_mutant_is_killed(mutant):
+    kills = ringcheck.handoff_mutant_kill_suite()
+    assert kills[mutant], f"handoff mutant {mutant} survived"
+
+
+def test_handoff_read_uncommitted_is_torn():
+    res = ringcheck.check_handoff(n_producers=2, items_per_producer=2,
+                                  capacity=2, words=2,
+                                  mutant="handoff_read_uncommitted")
+    assert not res.ok and res.violation.kind == "torn"
+
+
+def test_handoff_runtime_matches_model_shape():
+    """The runtime HandoffRing implements the modeled protocol: claim via
+    one atomic ticket, commit stamp after payload, ticket-order consume,
+    lap-free stamp — spot-check the stamps through one lap."""
+    from tpurpc.core.handoff import HandoffRing
+
+    ring = HandoffRing(capacity=2)
+    assert ring._seq == [0, 1]         # lap-0 free stamps
+    assert ring.publish("a")
+    assert ring._seq[0] == 1           # commit stamp t+1
+    assert ring.take() == "a"
+    assert ring._seq[0] == 2           # freed for lap 1 (h + capacity)
+    assert ring.publish("b") and ring.publish("c")
+    assert ring.take() == "b" and ring.take() == "c"
+    ring.close()

@@ -660,3 +660,126 @@ def test_connection_churn_soak_tcpw_domain(monkeypatch):
     finally:
         srv.stop(grace=0)
         config_mod.set_config(None)
+
+
+@pytest.mark.parametrize("platform", ["TCP", "RDMA_BPEV"])
+def test_kill_one_shard_under_pipelined_traffic(monkeypatch, platform):
+    """tpurpc-manycore (ISSUE 7): SIGKILL one of two shard workers while
+    pipelined depth-4 traffic runs. Contract: in-flight calls on the dead
+    shard fail with a STATUS (UNAVAILABLE — never a hang), clients re-dial
+    onto the survivor and keep making progress, the supervisor's flight
+    ring records shard-death, and the aggregated /metrics drops the dead
+    shard's series — on both the TCP and ring (RDMA_BPEV) platforms."""
+    import json as _json
+    import socket as _socket
+
+    from tpurpc.obs import flight
+    from tpurpc.rpc.shard import ShardedServer
+
+    monkeypatch.setenv("GRPC_PLATFORM_TYPE", platform)
+    from tpurpc.utils import config as config_mod
+
+    config_mod.set_config(None)
+    flight.RECORDER.reset()
+
+    def build(shard_id):
+        srv = tps.Server(max_workers=8)
+        srv.add_method("/c.S/Who", tps.unary_unary_rpc_method_handler(
+            lambda req, ctx: str(shard_id).encode()))
+        return srv
+
+    sup = ShardedServer(build, workers=2, listener="reuseport").start()
+    stop = threading.Event()
+    t_kill = [0]
+    progress_after_kill = [0] * 3
+    bad_codes: list = []
+    hung: list = []
+
+    def client(idx: int):
+        while not stop.is_set():
+            try:
+                with tps.Channel(f"127.0.0.1:{sup.port}") as ch:
+                    pl = ch.unary_unary("/c.S/Who",
+                                        tpurpc_native=False).pipeline(4)
+                    while not stop.is_set():
+                        futs = [pl.call_async(b"x", timeout=20)
+                                for _ in range(4)]
+                        for f in futs:
+                            who = bytes(f.result(timeout=25))
+                            assert who in (b"0", b"1")
+                        if t_kill[0]:
+                            progress_after_kill[idx] += 1
+            except RpcError as exc:
+                if exc.code() not in (StatusCode.UNAVAILABLE,
+                                      StatusCode.CANCELLED,
+                                      StatusCode.DEADLINE_EXCEEDED):
+                    bad_codes.append(exc.code())
+                time.sleep(0.05)  # redial
+            except (TimeoutError, OSError):
+                hung.append(idx)
+                return
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(3)]
+    try:
+        [t.start() for t in threads]
+        time.sleep(1.5)  # steady traffic on both shards
+        victim = sup.alive_workers()[0]
+        assert sup.kill_worker(victim)
+        t_kill[0] = time.monotonic_ns()
+        time.sleep(2.5)  # survivors absorb the re-dials
+    finally:
+        stop.set()
+        [t.join(timeout=60) for t in threads]
+    try:
+        assert not any(t.is_alive() for t in threads), "client thread hung"
+        assert not hung, f"clients timed out instead of failing fast: {hung}"
+        assert not bad_codes, f"non-UNAVAILABLE failures: {bad_codes}"
+        assert all(n > 0 for n in progress_after_kill), (
+            f"a client made no progress after the kill: "
+            f"{progress_after_kill}")
+        # supervisor postmortem: the death is in the flight ring
+        deaths = [e for e in flight.snapshot()
+                  if e["event"] == "shard-death"]
+        assert [e["a1"] for e in deaths] == [victim], deaths
+        # aggregated scrape: the dead shard's series are GONE
+        survivor = 1 - victim
+        deadline = time.monotonic() + 10
+        text = ""
+        while time.monotonic() < deadline:
+            try:
+                with _socket.create_connection(
+                        ("127.0.0.1", sup.port), timeout=5) as s:
+                    s.settimeout(5)
+                    s.sendall(b"GET /metrics HTTP/1.0\r\n\r\n")
+                    buf = bytearray()
+                    while True:
+                        chunk = s.recv(65536)
+                        if not chunk:
+                            break
+                        buf += chunk
+                text = bytes(buf).partition(b"\r\n\r\n")[2].decode()
+                if (f'tpurpc_shard_up{{shard="{victim}"}}' not in text
+                        and f'tpurpc_shard_up{{shard="{survivor}"}} 1'
+                        in text):
+                    break
+            except OSError:
+                pass
+            time.sleep(0.2)
+        assert f'tpurpc_shard_up{{shard="{victim}"}}' not in text
+        assert f'tpurpc_shard_up{{shard="{survivor}"}} 1' in text
+        # and the merged flight view still answers, single-shard
+        with _socket.create_connection(("127.0.0.1", sup.port),
+                                       timeout=5) as s:
+            s.settimeout(5)
+            s.sendall(b"GET /debug/flight HTTP/1.0\r\n\r\n")
+            buf = bytearray()
+            while True:
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                buf += chunk
+        doc = _json.loads(bytes(buf).partition(b"\r\n\r\n")[2])
+        assert doc["shards"] == [survivor]
+    finally:
+        sup.stop()
+        config_mod.set_config(None)

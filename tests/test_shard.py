@@ -1,0 +1,509 @@
+"""tpurpc-manycore (ISSUE 7): shard lifecycle, handoff, merge, observability.
+
+The sharding unit is a worker PROCESS (fork-based, see
+tpurpc/rpc/shard.py), so these tests exercise real crash semantics: a
+killed shard's in-flight calls must fail UNAVAILABLE (never hang), its
+connections re-accept onto survivors, and its telemetry must VANISH from
+the aggregated scrape instead of freezing.
+"""
+
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import tpurpc.rpc as tps
+from tpurpc.rpc.shard import ShardedServer
+from tpurpc.rpc.status import RpcError, StatusCode
+
+
+def _build_who(shard_id):
+    """Worker build fn: /Who answers the serving shard's id; /Slow parks."""
+    srv = tps.Server(max_workers=8)
+    srv.add_method("/t.S/Who", tps.unary_unary_rpc_method_handler(
+        lambda req, ctx: str(shard_id).encode()))
+
+    def slow(req, ctx):
+        time.sleep(float(req.decode()))
+        return str(shard_id).encode()
+
+    srv.add_method("/t.S/Slow", tps.unary_unary_rpc_method_handler(slow))
+    return srv
+
+
+def _who(port, timeout=20):
+    with tps.Channel(f"127.0.0.1:{port}") as ch:
+        return bytes(ch.unary_unary("/t.S/Who")(b"x", timeout=timeout)).decode()
+
+
+def _http_get(port, path, timeout=5.0):
+    with socket.create_connection(("127.0.0.1", port), timeout=timeout) as s:
+        s.settimeout(timeout)
+        s.sendall(f"GET {path} HTTP/1.0\r\n\r\n".encode())
+        buf = bytearray()
+        while True:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+    head, _, body = bytes(buf).partition(b"\r\n\r\n")
+    return int(head.split(None, 2)[1]), body
+
+
+# ---------------------------------------------------------------------------
+# listener sharding
+# ---------------------------------------------------------------------------
+
+def test_reuseport_accept_spread():
+    """SO_REUSEPORT: with enough distinct connections the kernel's spread
+    must land work on EVERY shard (P[all-on-one] ≈ 2^-31 at 32 conns)."""
+    sup = ShardedServer(_build_who, workers=2, listener="reuseport").start()
+    try:
+        seen = {}
+        for _ in range(32):
+            who = _who(sup.port)
+            seen[who] = seen.get(who, 0) + 1
+        assert set(seen) == {"0", "1"}, seen
+        assert sum(seen.values()) == 32
+    finally:
+        sup.stop()
+
+
+def test_handoff_round_robin_distribution():
+    """Supervisor fd handoff: round-robin is deterministic per connection —
+    an even split, every fd delivered over SCM_RIGHTS and served."""
+    sup = ShardedServer(_build_who, workers=2, listener="handoff").start()
+    try:
+        seen = {}
+        for _ in range(12):
+            who = _who(sup.port)
+            seen[who] = seen.get(who, 0) + 1
+        assert seen == {"0": 6, "1": 6}, seen
+        from tpurpc.obs import flight
+
+        handoffs = [e for e in flight.snapshot()
+                    if e["event"] == "conn-handoff"]
+        assert len(handoffs) >= 12
+    finally:
+        sup.stop()
+
+
+def test_handoff_least_loaded_avoids_busy_shard():
+    """least_loaded: with shard 0 pinned by slow calls (streamed load
+    reports > 0), new connections route to the idle shard."""
+    sup = ShardedServer(_build_who, workers=2, listener="handoff",
+                        handoff_policy="least_loaded").start()
+    try:
+        # occupy ONE shard with parked calls; learn which one it was
+        ch = tps.Channel(f"127.0.0.1:{sup.port}")
+        busy = bytes(ch.unary_unary("/t.S/Who")(b"x", timeout=20)).decode()
+        slow_mc = ch.unary_unary("/t.S/Slow")
+        threads = [threading.Thread(
+            target=lambda: slow_mc(b"3", timeout=30)) for _ in range(4)]
+        [t.start() for t in threads]
+        time.sleep(0.5)  # load report interval is 50ms; let it propagate
+        other = {"0": "1", "1": "0"}[busy]
+        placed = [_who(sup.port) for _ in range(6)]
+        assert placed.count(other) >= 5, (busy, placed)
+        [t.join(timeout=40) for t in threads]
+        ch.close()
+    finally:
+        sup.stop()
+
+
+# ---------------------------------------------------------------------------
+# crash semantics
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("listener", ["reuseport", "handoff"])
+def test_worker_crash_inflight_unavailable_and_reaccept(listener):
+    """Kill the shard serving an in-flight call: the call must fail with
+    UNAVAILABLE (not hang), and a redial must land on a survivor."""
+    sup = ShardedServer(_build_who, workers=2, listener=listener).start()
+    try:
+        ch = tps.Channel(f"127.0.0.1:{sup.port}")
+        victim = int(bytes(
+            ch.unary_unary("/t.S/Who")(b"x", timeout=20)).decode())
+        outcome = {}
+
+        def call():
+            try:
+                ch.unary_unary("/t.S/Slow")(b"30", timeout=45)
+                outcome["ok"] = True
+            except RpcError as exc:
+                outcome["code"] = exc.code()
+
+        t = threading.Thread(target=call)
+        t.start()
+        time.sleep(0.5)
+        assert sup.kill_worker(victim)
+        t.join(timeout=20)
+        assert not t.is_alive(), "in-flight call hung after shard death"
+        assert outcome.get("code") is StatusCode.UNAVAILABLE, outcome
+        ch.close()
+        # connections re-accept on the survivor
+        deadline = time.monotonic() + 10
+        served = None
+        while time.monotonic() < deadline:
+            try:
+                served = _who(sup.port, timeout=5)
+                break
+            except (RpcError, OSError):
+                time.sleep(0.1)
+        assert served == str(1 - victim)
+        assert sup.alive_workers() == [1 - victim]
+    finally:
+        sup.stop()
+
+
+def test_dead_shard_drops_out_of_aggregated_metrics():
+    """The PR 4 weakref-death contract across the process boundary: a dead
+    worker's series VANISH from /metrics (no frozen last values), and
+    tpurpc_shard_up enumerates only the living."""
+    sup = ShardedServer(_build_who, workers=2, listener="reuseport").start()
+    try:
+        for _ in range(8):
+            _who(sup.port)
+        status, body = _http_get(sup.port, "/metrics")
+        text = body.decode()
+        assert status == 200
+        assert 'tpurpc_shard_up{shard="0"} 1' in text
+        assert 'tpurpc_shard_up{shard="1"} 1' in text
+        assert 'shard="0"' in text and 'shard="1"' in text
+        sup.kill_worker(0)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            try:
+                status, body = _http_get(sup.port, "/metrics")
+                text = body.decode()
+                if 'tpurpc_shard_up{shard="0"}' not in text:
+                    break
+            except OSError:
+                pass
+            time.sleep(0.2)
+        assert 'tpurpc_shard_up{shard="0"}' not in text, text[:2000]
+        assert 'tpurpc_shard_up{shard="1"} 1' in text
+        # no shard-0 series linger anywhere (frozen values are the bug)
+        assert 'shard="0"' not in text
+    finally:
+        sup.stop()
+
+
+# ---------------------------------------------------------------------------
+# shard-tagged observability
+# ---------------------------------------------------------------------------
+
+def test_aggregated_flight_and_stalls_carry_shard_tags():
+    sup = ShardedServer(_build_who, workers=2, listener="reuseport").start()
+    try:
+        for _ in range(16):
+            _who(sup.port)
+        status, body = _http_get(sup.port, "/debug/flight")
+        assert status == 200
+        doc = json.loads(body)
+        assert sorted(doc["shards"]) == [0, 1]
+        starts = {(e["a1"], e.get("shard")) for e in doc["events"]
+                  if e["event"] == "shard-start"}
+        assert starts == {(0, 0), (1, 1)}, starts
+        # every merged event names its shard
+        assert all("shard" in e for e in doc["events"])
+        status, body = _http_get(sup.port, "/debug/stalls")
+        assert status == 200
+        stalls = json.loads(body)
+        assert sorted(stalls["shards"]) == ["0", "1"]
+        assert all(s.get("shard") in (0, 1)
+                   for s in stalls["shards"].values())
+        status, body = _http_get(sup.port, "/healthz")
+        assert status == 200 and body.strip() == b"ok"
+        # ?local=1 escape hatch: one worker's own view, no shard fan-out
+        ports = sup.scrape_ports()
+        status, body = _http_get(ports[0], "/metrics?local=1")
+        assert status == 200 and b"tpurpc_shard_up" not in body
+    finally:
+        sup.stop()
+
+
+def test_worker_fleet_gauges_visible_in_aggregate():
+    """FleetGauge satellite: gauges registered INSIDE a worker (its poller,
+    its streams) must surface in the aggregated scrape, shard-tagged."""
+    sup = ShardedServer(_build_who, workers=2, listener="reuseport").start()
+    try:
+        for _ in range(8):
+            _who(sup.port)
+        _status, body = _http_get(sup.port, "/metrics")
+        text = body.decode()
+        # the fleet gauges exist per worker (weakref'd live objects were
+        # cleared at fork and re-registered by the worker's own transport)
+        assert "tpurpc_srv_call_us" in text
+        for k in ("0", "1"):
+            assert f'tpurpc_srv_calls{{shard="{k}"' in text, text[:2000]
+    finally:
+        sup.stop()
+
+
+def test_graceful_drain_broadcast():
+    """drain() reaches every worker: /healthz flips to draining while the
+    servers bleed (PR 6 drain semantics, per shard)."""
+    sup = ShardedServer(_build_who, workers=2, listener="reuseport").start()
+    try:
+        for _ in range(4):
+            _who(sup.port)
+        sup.drain(linger=1.0)
+        deadline = time.monotonic() + 10
+        seen = b""
+        while time.monotonic() < deadline:
+            _status, seen = _http_get(sup.port, "/healthz")
+            if seen.strip() == b"draining":
+                break
+            time.sleep(0.1)
+        assert seen.strip() == b"draining", seen
+    finally:
+        sup.stop()
+
+
+# ---------------------------------------------------------------------------
+# device-boundary merge (in-process: DeviceMerger / ShardedFanIn)
+# ---------------------------------------------------------------------------
+
+def test_device_merger_gathers_concurrent_subbatches():
+    from tpurpc.jaxshim.service import DeviceMerger
+
+    calls = []
+    gate = threading.Event()
+    first_in = threading.Event()
+
+    def fn(tree):
+        calls.append(np.asarray(tree["a"]).shape)
+        if len(calls) == 1:
+            first_in.set()
+            gate.wait(10)
+        return {"y": np.asarray(tree["a"]) * 2}
+
+    merger = DeviceMerger(fn)
+    try:
+        results = {}
+
+        def sub(name, rows, val):
+            results[name] = merger.entry()(
+                {"a": np.full((rows, 2), val, np.float32)})
+
+        t1 = threading.Thread(target=sub, args=("A", 2, 1.0))
+        t1.start()
+        assert first_in.wait(10)  # merger busy inside A's dispatch
+        t2 = threading.Thread(target=sub, args=("B", 3, 2.0))
+        t3 = threading.Thread(target=sub, args=("C", 1, 3.0))
+        t2.start()
+        t3.start()
+        time.sleep(0.3)  # B and C commit into the handoff ring
+        gate.set()
+        for t in (t1, t2, t3):
+            t.join(10)
+        # B+C merged into ONE 4-row dispatch; every caller's rows correct
+        assert calls == [(2, 2), (4, 2)], calls
+        assert list(results["A"]["y"][:, 0]) == [2.0, 2.0]
+        assert list(results["B"]["y"][:, 0]) == [4.0, 4.0, 4.0]
+        assert list(results["C"]["y"][:, 0]) == [6.0]
+        assert merger.subs_merged == 2
+    finally:
+        merger.close()
+
+
+def test_device_merger_misshaped_subbatch_dispatches_alone():
+    """Incompatible signatures never co-dispatch: each shape group gets its
+    own device call, both succeed."""
+    from tpurpc.jaxshim.service import DeviceMerger
+
+    shapes = []
+    gate = threading.Event()
+    first_in = threading.Event()
+
+    def fn(tree):
+        a = np.asarray(tree["a"])
+        shapes.append(a.shape)
+        if len(shapes) == 1:
+            first_in.set()
+            gate.wait(10)
+        return {"y": a.sum(axis=tuple(range(1, a.ndim)))}
+
+    merger = DeviceMerger(fn)
+    try:
+        out = {}
+
+        def sub(name, shape, val):
+            out[name] = merger.entry()(
+                {"a": np.full(shape, val, np.float32)})
+
+        t1 = threading.Thread(target=sub, args=("warm", (1, 2), 0.0))
+        t1.start()
+        assert first_in.wait(10)
+        t2 = threading.Thread(target=sub, args=("wide", (2, 4), 1.0))
+        t3 = threading.Thread(target=sub, args=("narrow", (2, 2), 1.0))
+        t2.start()
+        t3.start()
+        time.sleep(0.3)
+        gate.set()
+        for t in (t1, t2, t3):
+            t.join(10)
+        assert sorted(shapes[1:]) == [(2, 2), (2, 4)], shapes
+        assert list(out["wide"]["y"]) == [4.0, 4.0]
+        assert list(out["narrow"]["y"]) == [2.0, 2.0]
+    finally:
+        merger.close()
+
+
+def test_device_merger_poison_subbatch_fails_alone():
+    """PR 3's poison-isolation contract across the merge boundary: a merged
+    dispatch that fails is retried per sub-batch, so only the poisoned
+    shard's callers see the error."""
+    from tpurpc.jaxshim.service import DeviceMerger
+
+    gate = threading.Event()
+    first_in = threading.Event()
+    ncalls = [0]
+
+    def fn(tree):
+        a = np.asarray(tree["a"])
+        ncalls[0] += 1
+        if ncalls[0] == 1:
+            first_in.set()
+            gate.wait(10)
+        if (a == 666.0).any():
+            raise ValueError("poison row")
+        return {"y": a + 1}
+
+    merger = DeviceMerger(fn)
+    try:
+        out = {}
+
+        def sub(name, val):
+            try:
+                out[name] = ("ok",
+                             merger.entry()(
+                                 {"a": np.full((2, 2), val, np.float32)}))
+            except Exception as exc:
+                out[name] = ("err", str(exc))
+
+        t1 = threading.Thread(target=sub, args=("warm", 0.0))
+        t1.start()
+        assert first_in.wait(10)
+        t2 = threading.Thread(target=sub, args=("good", 5.0))
+        t3 = threading.Thread(target=sub, args=("poison", 666.0))
+        t2.start()
+        t3.start()
+        time.sleep(0.3)
+        gate.set()
+        for t in (t1, t2, t3):
+            t.join(10)
+        assert out["warm"][0] == "ok"
+        assert out["good"][0] == "ok", out
+        assert list(out["good"][1]["y"][:, 0]) == [6.0, 6.0]
+        assert out["poison"][0] == "err" and "poison" in out["poison"][1]
+    finally:
+        merger.close()
+
+
+def test_sharded_fanin_end_to_end():
+    from tpurpc.jaxshim.service import ShardedFanIn
+
+    fan = ShardedFanIn(lambda t: {"y": np.asarray(t["a"]) * 10.0},
+                       n_shards=2, max_batch=4, max_delay_s=0.001)
+    try:
+        outs = [None] * 12
+
+        def caller(i):
+            outs[i] = fan({"a": np.full((1, 3), float(i), np.float32)})
+
+        threads = [threading.Thread(target=caller, args=(i,))
+                   for i in range(12)]
+        [t.start() for t in threads]
+        [t.join(15) for t in threads]
+        for i in range(12):
+            assert outs[i] is not None, f"caller {i} stranded"
+            assert float(outs[i]["y"][0, 0]) == i * 10.0
+        assert fan.batches_run >= 1
+        assert fan.queue_depth() == 0
+    finally:
+        fan.close()
+
+
+def test_sharded_fanin_close_fails_pending_cleanly():
+    from tpurpc.jaxshim.service import ShardedFanIn
+
+    hold = threading.Event()
+
+    def fn(t):
+        hold.wait(5)
+        return {"y": np.asarray(t["a"])}
+
+    fan = ShardedFanIn(fn, n_shards=2, max_batch=2, max_delay_s=0.001)
+    outs = []
+
+    def caller():
+        try:
+            outs.append(("ok", fan({"a": np.zeros((1, 2), np.float32)})))
+        except Exception as exc:
+            outs.append(("err", exc))
+
+    threads = [threading.Thread(target=caller) for _ in range(4)]
+    [t.start() for t in threads]
+    time.sleep(0.2)
+    hold.set()
+    fan.close()
+    [t.join(15) for t in threads]
+    assert len(outs) == 4  # nobody stranded on a closed merge boundary
+
+
+# ---------------------------------------------------------------------------
+# the handoff ring itself
+# ---------------------------------------------------------------------------
+
+def test_handoff_ring_mpmc_order_and_completeness():
+    from tpurpc.core.handoff import HandoffRing
+
+    ring = HandoffRing(capacity=4)
+    n_producers, per = 4, 50
+    done = threading.Event()
+    got = []
+
+    def producer(pid):
+        for k in range(per):
+            assert ring.publish((pid, k), timeout=10)
+
+    def consumer():
+        while len(got) < n_producers * per:
+            item = ring.take(timeout=10)
+            if item is None:
+                break
+            got.append(item)
+        done.set()
+
+    threads = [threading.Thread(target=producer, args=(p,))
+               for p in range(n_producers)]
+    tc = threading.Thread(target=consumer)
+    tc.start()
+    [t.start() for t in threads]
+    [t.join(20) for t in threads]
+    assert done.wait(20)
+    ring.close()
+    assert len(got) == n_producers * per
+    assert len(set(got)) == len(got), "duplicate delivery"
+    for p in range(n_producers):  # per-producer FIFO survives the MPMC merge
+        ks = [k for pid, k in got if pid == p]
+        assert ks == list(range(per))
+
+
+def test_handoff_ring_close_unblocks_producer():
+    from tpurpc.core.handoff import HandoffRing
+
+    ring = HandoffRing(capacity=2)
+    assert ring.publish("a") and ring.publish("b")
+    result = []
+    t = threading.Thread(target=lambda: result.append(ring.publish("c")))
+    t.start()
+    time.sleep(0.1)
+    ring.close()
+    t.join(5)
+    assert result == [False]

@@ -61,6 +61,13 @@ python -m tpurpc.tools.watchdog_smoke || fail=1
 note "tpurpc-fleet smoke (kill + drain under hedged traffic)"
 python -m tpurpc.tools.fleet_smoke || fail=1
 
+# 2f) tpurpc-manycore smoke (ISSUE 7): 2 forked shard workers behind one
+#     SO_REUSEPORT port, pipelined depth-4 traffic — both shards must serve
+#     calls, and the MERGED /metrics + /debug/flight (fetched through the
+#     serving port) must carry per-shard series. ~2s, no jax.
+note "tpurpc-manycore smoke (2 shards, accept spread, merged scrape)"
+python -m tpurpc.tools.shard_smoke || fail=1
+
 # 3) the analysis subsystem's own tests, plus a lock-order-instrumented run
 #    of the concurrency-heavy suites (TPURPC_DEBUG_LOCKS exercises the
 #    CheckedLock shim wired into poller/pair/xds/channel/channelz)

@@ -40,6 +40,13 @@ hand (ISSUE 2) and that no general-purpose linter knows about:
   %-formatting and string building never run on the fast path when
   tracing is off. ``log_error`` is exempt (error paths are cold by
   definition). Deliberate exceptions carry ``# tpr: allow(log)``.
+* ``shard``    — shard confinement (tpurpc-manycore, ISSUE 7): in modules
+  where a class declares ``_MERGE_BOUNDARY = ("fn", ...)``, any attribute
+  named in any class's ``_GUARDED_BY`` is shard-local state — mutating it
+  through a non-``self`` base (another shard's queue, a sub-batch's result
+  slot) is a cross-shard write, allowed ONLY inside the declared merge-
+  boundary functions. Per-core shards meet at exactly one place; the rule
+  keeps it that way. Deliberate exceptions carry ``# tpr: allow(shard)``.
 * ``flight``   — flight-recorder emission sites in the same hot modules
   must use the preallocated event encoder as designed: arguments to
   ``*flight*.emit(...)`` may be names, attributes, numeric constants and
@@ -516,6 +523,103 @@ def _check_locks(tree: ast.AST, path: str,
     return out
 
 
+# -- rule: shard -------------------------------------------------------------
+
+def _merge_boundary_decl(cls: ast.ClassDef) -> Optional[Tuple[str, ...]]:
+    """Parse a class-level ``_MERGE_BOUNDARY = ("fn", ...)`` declaration."""
+    for stmt in cls.body:
+        if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and stmt.targets[0].id == "_MERGE_BOUNDARY"
+                and isinstance(stmt.value, (ast.Tuple, ast.List))):
+            return tuple(e.value for e in stmt.value.elts
+                         if isinstance(e, ast.Constant)
+                         and isinstance(e.value, str))
+    return None
+
+
+def _attr_mutation_target(node: ast.AST) -> Optional[ast.Attribute]:
+    """The ``<expr>.attr`` an Assign/AugAssign/Delete/mutator-call mutates,
+    for ANY base expression (the cross-instance analog of
+    :func:`_mutation_target`, which only matches ``self``)."""
+    def as_attr(t: ast.AST) -> Optional[ast.Attribute]:
+        if isinstance(t, ast.Attribute):
+            return t
+        if isinstance(t, ast.Subscript) and isinstance(t.value, ast.Attribute):
+            return t.value
+        return None
+
+    if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for t in targets:
+            if isinstance(t, ast.Tuple):
+                for e in t.elts:
+                    got = as_attr(e)
+                    if got is not None:
+                        return got
+            got = as_attr(t)
+            if got is not None:
+                return got
+    elif isinstance(node, ast.Delete):
+        for t in node.targets:
+            got = as_attr(t)
+            if got is not None:
+                return got
+    elif isinstance(node, ast.Call):
+        f = node.func
+        if (isinstance(f, ast.Attribute) and f.attr in _MUTATORS
+                and isinstance(f.value, ast.Attribute)):
+            return f.value
+    return None
+
+
+def _check_shard(tree: ast.AST, path: str,
+                 lines: Sequence[str]) -> List[LintViolation]:
+    """tpurpc-manycore (ISSUE 7): shard-confinement of guarded state.
+
+    Armed only in modules where some class declares ``_MERGE_BOUNDARY =
+    ("fn", ...)`` — a shard/merger module. There, any attribute listed in
+    ANY class's ``_GUARDED_BY`` is shard-local state: mutating it through a
+    base other than ``self`` (``other_shard._queue.append``,
+    ``sub.out = ...``) is a cross-shard mutation, legal ONLY inside a
+    function named in a ``_MERGE_BOUNDARY`` — the single place shards are
+    allowed to meet. Everything else is the hot path, where cross-shard
+    writes are exactly the coupling the per-core design forbids.
+    Deliberate exceptions carry ``# tpr: allow(shard)``."""
+    boundary: Set[str] = set()
+    guarded: Dict[str, str] = {}  # attr -> declaring class (for the message)
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        mb = _merge_boundary_decl(cls)
+        if mb is not None:
+            boundary.update(mb)
+        for attr in _guarded_by_decl(cls):
+            guarded.setdefault(attr, cls.name)
+    if not boundary or not guarded:
+        return []
+    out = []
+    for node in ast.walk(tree):
+        tgt = _attr_mutation_target(node)
+        if tgt is None or tgt.attr not in guarded:
+            continue
+        if isinstance(tgt.value, ast.Name) and tgt.value.id in ("self", "cls"):
+            continue  # shard-local mutation: the lock map's jurisdiction
+        fn = _enclosing_fn(node)
+        if fn is not None and getattr(fn, "name", None) in boundary:
+            continue
+        if "shard" in _allowed_rules(lines, node.lineno):
+            continue
+        out.append(LintViolation(
+            path, node.lineno, node.col_offset, "shard",
+            f"cross-shard mutation of {guarded[tgt.attr]}.{tgt.attr} "
+            f"(guarded shard-local state) outside the merge boundary "
+            f"{sorted(boundary)} — shards may only meet at the declared "
+            "boundary; a deliberate exception carries '# tpr: allow(shard)'"))
+    return out
+
+
 # -- rule: lease -------------------------------------------------------------
 
 def _calls_matching(node: ast.AST, needle: str) -> List[ast.Call]:
@@ -671,6 +775,7 @@ def lint_source(source: str, path: str,
         if norm.endswith(suffix.replace(os.sep, "/")):
             out.extend(_check_block(tree, path, lines, frozenset(fns)))
     out.extend(_check_locks(tree, path, lines))
+    out.extend(_check_shard(tree, path, lines))
     out.extend(_check_lease(tree, path, lines))
     out.sort(key=lambda v: (v.path, v.line, v.col))
     return out

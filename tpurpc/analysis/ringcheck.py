@@ -364,12 +364,277 @@ def _stage_write(msgs, idx, tail, seq, credit_head, capacity, batched,
     return tuple(g for g in groups if g)
 
 
+# -- MPMC handoff model (tpurpc-manycore, ISSUE 7) ----------------------------
+#
+# Models tpurpc/core/handoff.py — the bounded MPMC ring carrying sub-batches
+# from N per-shard batchers to the single device merger — at the same word
+# granularity as the SPSC model: every shared store (a ticket update, one
+# payload word, a slot's sequence stamp) is one atomic step, exhaustively
+# interleaved. Protocol (Vyukov-style, N producers / 1 consumer):
+#
+#   producer: t = fetch_add(ticket)          # ONE atomic step (the claim)
+#             await seq[t % cap] == t        # slot's previous lap consumed
+#             store payload words            # item body, word by word
+#             seq[t % cap] = t + 1           # COMMIT, strictly after payload
+#   merger:   await seq[h % cap] == h + 1    # commit gate, ticket order
+#             read payload words
+#             seq[h % cap] = h + cap         # free for lap N+1; h += 1
+#
+# Invariants: every published item consumed exactly once, untorn (all its
+# words name the same (producer, item)), per-producer publish order
+# preserved; no wedged quiescent state.
+
+#: seeded MPMC/handoff mutants — each breaks the protocol the way a real
+#: sharding bug would, and each must be killed:
+#:   handoff_torn_claim         two producers read-then-increment the ticket
+#:                              as separate steps → both own one slot (the
+#:                              "two producers publishing the same head
+#:                              slot" failure)
+#:   handoff_commit_before_write  the commit stamp lands before the payload
+#:                              → the merger reads a half-written sub-batch
+#:   handoff_read_uncommitted   the merger ignores the commit gate and reads
+#:                              as soon as a word appears → stale/torn reads
+HANDOFF_MUTANTS = (
+    "handoff_torn_claim",
+    "handoff_commit_before_write",
+    "handoff_read_uncommitted",
+)
+
+_H_ZERO = ("hzero",)
+
+
+def check_handoff(n_producers: int = 2, items_per_producer: int = 2,
+                  capacity: int = 2, words: int = 2,
+                  mutant: Optional[str] = None,
+                  max_states: int = 2_000_000) -> CheckResult:
+    """Exhaustively interleave N producers against the single merger."""
+    if mutant is not None and mutant not in HANDOFF_MUTANTS:
+        raise ValueError(f"unknown mutant {mutant!r}; known: "
+                         f"{HANDOFF_MUTANTS}")
+    cfg = (f"handoff producers={n_producers} items={items_per_producer} "
+           f"cap={capacity} words={words} mutant={mutant}")
+    total = n_producers * items_per_producer
+
+    # producer state: (phase, t, widx, k)
+    #   phase: "idle" | "claimed"(torn-claim midpoint) | "wait" | "write"
+    #          | "commit" | "write_after_commit"(commit-first mutant)
+    init = (
+        0,                                    # ticket
+        tuple(range(capacity)),               # seq stamps
+        (_H_ZERO,) * (capacity * words),      # payload words
+        (("idle", 0, 0, 0),) * n_producers,   # producers
+        0, 0, (),                             # h, ridx, current-item words
+        (),                                   # received: ((pid, k), ...)
+    )
+    visited = set()
+    stack: List[Tuple[tuple, Tuple[str, ...]]] = [(init, ())]
+    states = 0
+    try:
+        while stack:
+            state, trace = stack.pop()
+            if state in visited:
+                continue
+            visited.add(state)
+            states += 1
+            if states > max_states:
+                raise RuntimeError(
+                    f"state space exceeds {max_states} states ({cfg})")
+            succ = _handoff_successors(state, n_producers,
+                                       items_per_producer, capacity, words,
+                                       mutant, trace)
+            if not succ:
+                _handoff_quiescent(state, n_producers, items_per_producer,
+                                   total, trace)
+                continue
+            stack.extend(succ)
+    except Violation as v:
+        return CheckResult(False, states, v, cfg)
+    return CheckResult(True, states, None, cfg)
+
+
+def _handoff_quiescent(state, n_producers, items_per_producer, total,
+                       trace) -> None:
+    ticket, seq, data, prods, h, ridx, rwords, received = state
+    for pid, (phase, _t, _w, k) in enumerate(prods):
+        if phase != "idle" or k < items_per_producer:
+            raise Violation(
+                "stuck", f"producer {pid} wedged in phase {phase} at item "
+                f"{k}/{items_per_producer} with no enabled step",
+                list(trace))
+    if len(received) != total:
+        raise Violation(
+            "lost", f"quiescent with {len(received)}/{total} items "
+            "delivered", list(trace))
+    seen = set(received)
+    if len(seen) != len(received):
+        raise Violation("dup", f"duplicate delivery: {received}",
+                        list(trace))
+    for pid in range(n_producers):
+        ks = [k for p, k in received if p == pid]
+        if ks != sorted(ks) or ks != list(range(items_per_producer)):
+            raise Violation(
+                "order", f"producer {pid} items delivered as {ks}",
+                list(trace))
+
+
+def _handoff_successors(state, n_producers, items_per_producer, capacity,
+                        words, mutant, trace):
+    ticket, seq, data, prods, h, ridx, rwords, received = state
+    succ = []
+
+    def with_prod(pid, p):
+        return prods[:pid] + (p,) + prods[pid + 1:]
+
+    # ---- producer steps ----
+    for pid, (phase, t, widx, k) in enumerate(prods):
+        slot = None if phase == "idle" else t % capacity
+        if phase == "idle" and k < items_per_producer:
+            if mutant == "handoff_torn_claim":
+                # MUTANT: the claim is read-then-increment, two steps — two
+                # producers can read the same ticket and co-own one slot
+                succ.append((
+                    (ticket, seq, data,
+                     with_prod(pid, ("claimed", ticket, 0, k)),
+                     h, ridx, rwords, received),
+                    trace + (f"p{pid}:claim_read",)))
+            else:
+                # the real claim: ONE atomic fetch_add (itertools.count)
+                succ.append((
+                    (ticket + 1, seq, data,
+                     with_prod(pid, ("wait", ticket, 0, k)),
+                     h, ridx, rwords, received),
+                    trace + (f"p{pid}:claim",)))
+        elif phase == "claimed":
+            # second half of the torn claim: a plain store of t+1 — the
+            # lost-update this mutant exists to model (a racing producer's
+            # increment is overwritten, and both own ticket t's slot)
+            succ.append((
+                (t + 1, seq, data,
+                 with_prod(pid, ("wait", t, 0, k)),
+                 h, ridx, rwords, received),
+                trace + (f"p{pid}:claim_inc",)))
+        elif phase == "wait":
+            if seq[slot] == t:  # slot free for this lap: start writing
+                nxt = ("write_after_commit"
+                       if mutant == "handoff_commit_before_write"
+                       else "write")
+                if nxt == "write_after_commit":
+                    # MUTANT: commit stamp BEFORE the payload stores
+                    new_seq = seq[:slot] + (t + 1,) + seq[slot + 1:]
+                    succ.append((
+                        (ticket, new_seq, data,
+                         with_prod(pid, (nxt, t, 0, k)),
+                         h, ridx, rwords, received),
+                        trace + (f"p{pid}:commit!early",)))
+                else:
+                    succ.append((
+                        (ticket, seq, data,
+                         with_prod(pid, ("write", t, 0, k)),
+                         h, ridx, rwords, received),
+                        trace + (f"p{pid}:own",)))
+        elif phase in ("write", "write_after_commit"):
+            if widx < words:
+                off = slot * words + widx
+                new_data = data[:off] + (("pay", pid, k, widx),) + data[off + 1:]
+                succ.append((
+                    (ticket, seq, new_data,
+                     with_prod(pid, (phase, t, widx + 1, k)),
+                     h, ridx, rwords, received),
+                    trace + (f"p{pid}:w{widx}",)))
+            elif phase == "write_after_commit":
+                # commit already landed (mutant): item done
+                succ.append((
+                    (ticket, seq, data,
+                     with_prod(pid, ("idle", 0, 0, k + 1)),
+                     h, ridx, rwords, received),
+                    trace + (f"p{pid}:done",)))
+            else:
+                new_seq = seq[:slot] + (t + 1,) + seq[slot + 1:]
+                succ.append((
+                    (ticket, new_seq, data,
+                     with_prod(pid, ("idle", 0, 0, k + 1)),
+                     h, ridx, rwords, received),
+                    trace + (f"p{pid}:commit",)))
+
+    # ---- merger steps (single consumer, ticket order) ----
+    slot = h % capacity
+    if mutant == "handoff_read_uncommitted":
+        readable = data[slot * words][0] == "pay"  # MUTANT: no commit gate
+    else:
+        readable = seq[slot] == h + 1
+    if readable and len(received) < n_producers * items_per_producer:
+        if ridx < words:
+            word = data[slot * words + ridx]
+            succ.append((
+                (ticket, seq, data, prods,
+                 h, ridx + 1, rwords + (word,), received),
+                trace + (f"m:r{ridx}",)))
+        else:
+            # item complete: torn unless every word names ONE (pid, k)
+            heads = {(w[1], w[2]) for w in rwords if w[0] == "pay"}
+            if len(heads) != 1 or any(w[0] != "pay" for w in rwords) \
+                    or [w[3] for w in rwords] != list(range(words)):
+                raise Violation(
+                    "torn", f"merger consumed mixed/stale words {rwords} "
+                    f"at slot {slot}", list(trace) + ["m:done"])
+            item = next(iter(heads))
+            new_seq = seq[:slot] + (h + capacity,) + seq[slot + 1:]
+            succ.append((
+                (ticket, new_seq, data, prods,
+                 h + 1, 0, (), received + (item,)),
+                trace + ("m:done",)))
+    return succ
+
+
+def handoff_default_suite(verbose: bool = False) -> List[CheckResult]:
+    """Clean handoff configs the CLI exhausts alongside the SPSC suite."""
+    configs = [
+        dict(n_producers=2, items_per_producer=2, capacity=2, words=2),
+        dict(n_producers=2, items_per_producer=1, capacity=2, words=3),
+        dict(n_producers=3, items_per_producer=1, capacity=2, words=2),
+    ]
+    out = []
+    for cfg in configs:
+        res = check_handoff(**cfg)
+        out.append(res)
+        if verbose:
+            print(f"  {res!r}")
+    return out
+
+
+def handoff_mutant_kill_suite(verbose: bool = False) -> Dict[str, bool]:
+    """Every seeded handoff mutant must produce a violation somewhere."""
+    kill_configs = {
+        "handoff_torn_claim": [
+            dict(n_producers=2, items_per_producer=2, capacity=2, words=2)],
+        "handoff_commit_before_write": [
+            dict(n_producers=2, items_per_producer=1, capacity=2, words=2)],
+        "handoff_read_uncommitted": [
+            dict(n_producers=2, items_per_producer=2, capacity=2, words=2)],
+    }
+    out = {}
+    for mutant, configs in kill_configs.items():
+        killed = False
+        for cfg in configs:
+            res = check_handoff(mutant=mutant, **cfg)
+            if not res.ok:
+                killed = True
+                if verbose:
+                    print(f"  mutant {mutant}: KILLED — {res.violation}")
+                break
+        if not killed and verbose:
+            print(f"  mutant {mutant}: SURVIVED")
+        out[mutant] = killed
+    return out
+
+
 # -- suites ------------------------------------------------------------------
 
 def default_suite(verbose: bool = False) -> List[CheckResult]:
     """The bounded exhaustive pass the CLI runs: capacity ≤ 4-word rings
     fully exhausted for the single-message protocol (with wrap), plus the
-    batched ``write_many`` protocol and a mixed-size run at capacity 8."""
+    batched ``write_many`` protocol and a mixed-size run at capacity 8,
+    plus the MPMC handoff (shard → merger) configurations."""
     configs = [
         dict(capacity=4, payload_lens=[1, 1, 1], batched=False),
         dict(capacity=4, payload_lens=[1, 1, 1, 1], batched=False),
@@ -383,6 +648,7 @@ def default_suite(verbose: bool = False) -> List[CheckResult]:
         out.append(res)
         if verbose:
             print(f"  {res!r}")
+    out.extend(handoff_default_suite(verbose=verbose))
     return out
 
 
@@ -414,4 +680,5 @@ def mutant_kill_suite(verbose: bool = False) -> Dict[str, bool]:
         if not killed and verbose:
             print(f"  mutant {mutant}: SURVIVED")
         out[mutant] = killed
+    out.update(handoff_mutant_kill_suite(verbose=verbose))
     return out

@@ -744,7 +744,8 @@ class EndpointListener:
                  on_endpoint: Callable[[Endpoint], None],
                  ready: "Optional[threading.Event]" = None,
                  ssl_context=None,
-                 raw_hook: "Optional[Callable[[socket.socket], bool]]" = None):
+                 raw_hook: "Optional[Callable[[socket.socket], bool]]" = None,
+                 reuseport: bool = False):
         #: pre-endpoint interception seam: called with the RAW accepted
         #: socket (plaintext listeners only); returning True means the hook
         #: took ownership (the native-server adoption path,
@@ -753,6 +754,14 @@ class EndpointListener:
         self._ssl_context = ssl_context
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        if reuseport:
+            # tpurpc-manycore listener sharding: N worker processes listen
+            # on the SAME port and the kernel spreads accepted connections
+            # across them (the SO_REUSEPORT accept spread — no supervisor
+            # in the accept path at all). Every sharing socket must set the
+            # flag before bind; a dead worker's socket closes with it, so
+            # the kernel stops routing there without coordination.
+            self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
         self._sock.bind((host, port))
         self._sock.listen(128)
         self.port = self._sock.getsockname()[1]

@@ -9,12 +9,14 @@ from tpurpc.jaxshim.codec import (decode_tensor, decode_tree, encode_tensor,
                                   encode_tree_bytes, tensor_deserializer,
                                   tensor_serializer, to_jax,
                                   tree_deserializer, tree_serializer)
-from tpurpc.jaxshim.service import (FanInBatcher, TensorClient,
-                                    add_tensor_method, serve_jax)
+from tpurpc.jaxshim.service import (DeviceMerger, FanInBatcher, ShardedFanIn,
+                                    TensorClient, add_tensor_method,
+                                    serve_jax, serve_jax_sharded)
 
 __all__ = [
     "decode_tensor", "decode_tree", "encode_tensor", "encode_tensor_bytes",
     "encode_tree", "encode_tree_bytes", "tensor_deserializer",
     "tensor_serializer", "to_jax", "tree_deserializer", "tree_serializer",
-    "FanInBatcher", "TensorClient", "add_tensor_method", "serve_jax",
+    "FanInBatcher", "ShardedFanIn", "DeviceMerger", "TensorClient",
+    "add_tensor_method", "serve_jax", "serve_jax_sharded",
 ]

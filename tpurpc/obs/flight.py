@@ -81,6 +81,11 @@ DRAIN_END = 25            # a1 = streams still open at budget expiry (0=clean)
 ADMIT_REJECT = 26         # a1 = inflight at rejection, a2 = pushback (ms)
 SUBCH_EJECT = 27          # a1 = subchannel index, a2 = reason (0=errors,1=slow)
 SUBCH_REINSTATE = 28      # a1 = subchannel index
+# tpurpc-manycore (ISSUE 7): shard lifecycle + connection handoff
+SHARD_START = 29          # worker up; a1 = shard id, a2 = n_shards
+SHARD_EXIT = 30           # worker exited gracefully; a1 = shard id
+SHARD_DEATH = 31          # supervisor saw a worker die; a1 = shard id, a2 = wait status
+CONN_HANDOFF = 32         # supervisor passed an accepted fd; a1 = shard id
 
 EVENT_NAMES: Dict[int, str] = {
     PAIR_CONNECT: "pair-connect",
@@ -111,6 +116,10 @@ EVENT_NAMES: Dict[int, str] = {
     ADMIT_REJECT: "admit-reject",
     SUBCH_EJECT: "subch-ejected",
     SUBCH_REINSTATE: "subch-reinstated",
+    SHARD_START: "shard-start",
+    SHARD_EXIT: "shard-exit",
+    SHARD_DEATH: "shard-death",
+    CONN_HANDOFF: "conn-handoff",
 }
 
 #: batch-flush reason codes (a1 of BATCH_FLUSH) — mirrors the jaxshim
@@ -207,15 +216,23 @@ class FlightRecorder:
         the monotonic stamps; zeroed slots and torn/unknown records (a
         reader racing a wrap) are skipped — defensive by design."""
         out: List[dict] = []
+        # tpurpc-manycore: a worker's events carry its shard id so merged
+        # replays (obs.shard.aggregate_flight) attribute every edge
+        from tpurpc.obs import shard as _shard
+
+        sid = _shard.shard_id()
         buf = bytes(self._buf)  # one copy: decode from a stable image
         for off in range(0, len(buf), RECORD_BYTES):
             t_ns, code, tag, tid, a1, a2 = _REC.unpack_from(buf, off)
             if t_ns == 0 or code not in EVENT_NAMES or t_ns < since_ns:
                 continue
-            out.append({"t_ns": t_ns, "code": code,
-                        "event": EVENT_NAMES[code], "tag": tag,
-                        "entity": tag_name(tag), "tid": tid,
-                        "a1": a1, "a2": a2})
+            rec = {"t_ns": t_ns, "code": code,
+                   "event": EVENT_NAMES[code], "tag": tag,
+                   "entity": tag_name(tag), "tid": tid,
+                   "a1": a1, "a2": a2}
+            if sid >= 0:
+                rec["shard"] = sid
+            out.append(rec)
         out.sort(key=lambda d: d["t_ns"])
         if limit is not None and len(out) > limit:
             out = out[-limit:]
@@ -257,6 +274,15 @@ def snapshot(since_ns: int = 0, limit: Optional[int] = None) -> List[dict]:
 
 def dump_text(since_ns: int = 0) -> str:
     return RECORDER.dump_text(since_ns=since_ns)
+
+
+def postfork_restart() -> None:
+    """Fresh ring in a forked shard worker: the inherited buffer holds the
+    supervisor's pre-fork events, which would replay as this worker's
+    history. Zeroing + a fresh slot counter keeps the module-level ``emit``
+    binding (hot modules reference ``_flight.emit``) intact."""
+    RECORDER.reset()
+    RECORDER._slots = itertools.count()
 
 
 # -- SIGUSR2 dump -------------------------------------------------------------

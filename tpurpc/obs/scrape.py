@@ -156,7 +156,27 @@ def _query_params(query: str) -> dict:
 
 
 def _route(path: str) -> Tuple[int, str, bytes]:
-    """(status, content_type, body) for one GET path."""
+    """(status, content_type, body) for one GET path.
+
+    tpurpc-manycore: in a shard worker, the aggregate-aware routes
+    (/metrics, /debug/flight, /debug/stalls, /healthz) merge EVERY live
+    worker's view — one GET on the serving port tells the whole truth no
+    matter which shard the accept spread picked. ``?local=1`` serves this
+    worker alone (it is also the recursion guard for peer fetches)."""
+    route, _, query = path.partition("?")
+    params = _query_params(query)
+    if not params.get("local"):
+        from tpurpc.obs import shard as _shard
+
+        if _shard.sharded():
+            agg = _shard.route_aggregate(route, params)
+            if agg is not None:
+                return agg
+    return route_local(path)
+
+
+def route_local(path: str) -> Tuple[int, str, bytes]:
+    """The single-process rendering of one GET path (no shard fan-out)."""
     route, _, query = path.partition("?")
     if route in ("/metrics", "/metrics/"):
         return 200, "text/plain; version=0.0.4", render_prometheus().encode()

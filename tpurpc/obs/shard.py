@@ -1,0 +1,296 @@
+"""tpurpc-manycore: shard identity + cross-worker scrape aggregation.
+
+A sharded server (``tpurpc.rpc.shard.ShardedServer``) runs N worker
+PROCESSES, each owning its poller, rings, batcher, and — crucially for this
+module — its own metrics registry, flight ring, and watchdog. Telemetry
+that only describes one worker is useless to an operator who scraped
+"the server": this module makes ONE ``GET /metrics`` (or ``/debug/flight``,
+``/debug/stalls``, ``/healthz``) on the serving port tell the whole truth,
+whichever worker the kernel's accept spread happened to hand the scrape to.
+
+Mechanics:
+
+* every worker runs a loopback-only scrape listener
+  (:func:`tpurpc.obs.scrape.start_http_server`) and the supervisor
+  broadcasts the full ``{shard_id: scrape_port}`` map to every worker;
+* a worker answering an aggregate route fetches each peer's LOCAL view
+  (``?local=1`` — the recursion guard) over loopback, renders its own view
+  in-process, and merges, tagging every series/event with ``shard="k"``;
+* a shard that died is simply unreachable: its series VANISH from the next
+  scrape (the PR 4 weakref-death contract extended across the process
+  boundary — a dead worker must drop out, never freeze its last values),
+  and ``tpurpc_shard_up`` enumerates who answered.
+
+The per-request hot path pays nothing for any of this: shard identity is
+two module ints, and all fan-out happens at scrape time on the sniff
+thread that was already serving the HTTP request.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+from typing import Dict, List, Optional, Tuple
+
+__all__ = [
+    "set_identity", "shard_id", "n_shards", "set_peers", "peers",
+    "sharded", "route_aggregate", "aggregate_metrics", "aggregate_flight",
+    "aggregate_stalls", "aggregate_healthz",
+]
+
+_lock = threading.Lock()
+_SHARD_ID = -1   # -1 = this process is not a shard worker
+_N_SHARDS = 0
+_PEERS: Dict[int, int] = {}  # shard_id -> loopback scrape port
+
+#: how long one peer fetch may take; a SIGKILLed worker's port refuses
+#: instantly, so this bound only matters for a wedged-but-alive worker
+_FETCH_TIMEOUT_S = 0.6
+
+
+def set_identity(shard: int, total: int) -> None:
+    global _SHARD_ID, _N_SHARDS
+    with _lock:
+        _SHARD_ID = int(shard)
+        _N_SHARDS = int(total)
+
+
+def shard_id() -> int:
+    return _SHARD_ID
+
+
+def n_shards() -> int:
+    return _N_SHARDS
+
+
+def set_peers(mapping: Dict[int, int]) -> None:
+    """Install the supervisor-broadcast ``{shard_id: scrape_port}`` map
+    (including this worker's own entry)."""
+    global _PEERS
+    with _lock:
+        _PEERS = {int(k): int(v) for k, v in mapping.items()}
+
+
+def peers() -> Dict[int, int]:
+    with _lock:
+        return dict(_PEERS)
+
+
+def sharded() -> bool:
+    """True when this process should answer scrapes with the AGGREGATE
+    view (it is a shard worker and knows its peers)."""
+    return _SHARD_ID >= 0 and bool(_PEERS)
+
+
+# -- peer fetch ---------------------------------------------------------------
+
+def _fetch(port: int, path: str) -> Optional[Tuple[int, bytes]]:
+    """One loopback HTTP/1.0 GET; None when the peer is gone/wedged —
+    the caller drops that shard from the merged view."""
+    try:
+        with socket.create_connection(("127.0.0.1", port),
+                                      timeout=_FETCH_TIMEOUT_S) as s:
+            s.settimeout(_FETCH_TIMEOUT_S)
+            s.sendall(f"GET {path} HTTP/1.0\r\n\r\n".encode())
+            buf = bytearray()
+            while True:
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                buf += chunk
+    except OSError:
+        return None
+    head, _, body = bytes(buf).partition(b"\r\n\r\n")
+    parts = head.split(None, 2)
+    if len(parts) < 2:
+        return None
+    try:
+        return int(parts[1]), body
+    except ValueError:
+        return None
+
+
+def _each_shard(path: str):
+    """Yield ``(shard_id, status, body_bytes)`` for every REACHABLE shard;
+    self is rendered in-process (never through its own HTTP listener)."""
+    from tpurpc.obs import scrape as _scrape
+
+    me = _SHARD_ID
+    for k in sorted(peers()):
+        if k == me:
+            status, _ctype, body = _scrape.route_local(path)
+            yield k, status, body
+            continue
+        got = _fetch(peers()[k], path if "?" in path else path + "?local=1")
+        if got is None:
+            continue  # dead/unreachable shard: drops out of the merge
+        yield k, got[0], got[1]
+
+
+# -- /metrics -----------------------------------------------------------------
+
+def _shard_label(line: str, k: int) -> str:
+    """Inject ``shard="k"`` as the first label of one exposition line."""
+    brace = line.find("{")
+    space = line.find(" ")
+    if brace != -1 and (space == -1 or brace < space):
+        return f'{line[:brace]}{{shard="{k}",{line[brace + 1:]}'
+    name, _, rest = line.partition(" ")
+    return f'{name}{{shard="{k}"}} {rest}'
+
+
+def aggregate_metrics() -> str:
+    """The merged Prometheus text: every reachable worker's series with a
+    ``shard`` label, one ``# TYPE`` line per family, plus ``tpurpc_shard_up``
+    per answering shard (a dead shard is ABSENT — presence is liveness)."""
+    types: Dict[str, str] = {}
+    series: List[str] = []
+    up: List[int] = []
+    for k, status, body in _each_shard("/metrics"):
+        if status != 200:
+            continue
+        up.append(k)
+        for line in body.decode("utf-8", errors="replace").splitlines():
+            if line.startswith("# TYPE "):
+                parts = line.split()
+                if len(parts) >= 4:
+                    types.setdefault(parts[2], parts[3])
+                continue
+            if not line or line.startswith("#"):
+                continue
+            series.append(_shard_label(line, k))
+    lines = [f"# TYPE {name} {t}" for name, t in sorted(types.items())]
+    lines.append("# TYPE tpurpc_shard_up gauge")
+    lines.extend(f'tpurpc_shard_up{{shard="{k}"}} 1' for k in up)
+    lines.append(f"tpurpc_shards_configured {_N_SHARDS}")
+    lines.extend(series)
+    return "\n".join(lines) + "\n"
+
+
+# -- /debug/flight ------------------------------------------------------------
+
+def aggregate_flight(since_ns: int = 0) -> dict:
+    """Every reachable shard's flight events in ONE time-ordered replay.
+    CLOCK_MONOTONIC is system-wide on Linux, so cross-process ``t_ns``
+    stamps order correctly — the whole point of merging: one timeline of
+    what every worker's transport did."""
+    events: List[dict] = []
+    capacity = 0
+    up: List[int] = []
+    for k, status, body in _each_shard(
+            f"/debug/flight?local=1&since_ns={since_ns}"):
+        if status != 200:
+            continue
+        try:
+            doc = json.loads(body)
+        except ValueError:
+            continue
+        up.append(k)
+        capacity = max(capacity, int(doc.get("capacity") or 0))
+        for e in doc.get("events", ()):
+            e["shard"] = k
+            events.append(e)
+    events.sort(key=lambda e: e.get("t_ns", 0))
+    return {"events": events, "capacity": capacity, "shards": up}
+
+
+def aggregate_flight_text(since_ns: int = 0) -> str:
+    doc = aggregate_flight(since_ns=since_ns)
+    events = doc["events"]
+    if not events:
+        return "flight recorder: no events (any shard)\n"
+    t0 = events[0]["t_ns"]
+    lines = [f"flight recorder: {len(events)} events across "
+             f"{len(doc['shards'])} shard(s)"]
+    for e in events:
+        lines.append(
+            f"  +{(e['t_ns'] - t0) / 1e6:10.3f}ms s{e.get('shard', '?')} "
+            f"{e['event']:<22} {e.get('entity', '-'):<20} "
+            f"a1={e['a1']} a2={e['a2']}")
+    return "\n".join(lines) + "\n"
+
+
+# -- /debug/stalls ------------------------------------------------------------
+
+def aggregate_stalls() -> dict:
+    """Per-shard watchdog snapshots plus a merged active/history view (each
+    diagnosis tagged with its shard) — the keys tools.top and the smoke
+    scripts already read stay present and truthful."""
+    shards: Dict[str, dict] = {}
+    active: List[dict] = []
+    history: List[dict] = []
+    inflight = 0
+    for k, status, body in _each_shard("/debug/stalls"):
+        if status != 200:
+            continue
+        try:
+            snap = json.loads(body)
+        except ValueError:
+            continue
+        shards[str(k)] = snap
+        for d in snap.get("active", ()):
+            d = dict(d, shard=k)
+            active.append(d)
+        for d in snap.get("history", ()):
+            history.append(dict(d, shard=k))
+        inflight += int(snap.get("inflight") or 0)
+    history.sort(key=lambda d: d.get("since_ns", 0))
+    return {"shards": shards, "active": active, "history": history,
+            "inflight": inflight,
+            "enabled": any(s.get("enabled") for s in shards.values())}
+
+
+# -- /healthz -----------------------------------------------------------------
+
+def aggregate_healthz() -> Tuple[int, bytes]:
+    """Worst-of health: any degraded shard degrades the whole server (one
+    wedged worker IS an incident); all-draining reports draining. A dead
+    shard is skipped — its connections are already gone, and liveness is
+    ``tpurpc_shard_up``'s job, not the health probe's."""
+    degraded: List[str] = []
+    bodies: List[bytes] = []
+    for k, status, body in _each_shard("/healthz"):
+        if status == 503:
+            degraded.append(f"shard {k}: {body.decode(errors='replace').strip()}")
+        bodies.append(body.strip())
+    if degraded:
+        return 503, ("\n".join(degraded) + "\n").encode()
+    if bodies and all(b == b"draining" for b in bodies):
+        return 200, b"draining\n"
+    return 200, b"ok\n"
+
+
+# -- scrape-plane hook --------------------------------------------------------
+
+def route_aggregate(route: str, params: dict
+                    ) -> Optional[Tuple[int, str, bytes]]:
+    """The scrape plane's shard hook: the merged ``(status, ctype, body)``
+    for an aggregate-aware route, or None for routes served locally
+    (/traces and /channelz stay per-worker — span buffers and channelz
+    entities are process-scoped by design; scrape them via ?local=1 on a
+    worker's own scrape port when debugging one shard)."""
+    try:
+        if route in ("/metrics", "/metrics/"):
+            return 200, "text/plain; version=0.0.4", aggregate_metrics().encode()
+        if route in ("/debug/flight", "/debug/flight/"):
+            try:
+                since_ns = int(params.get("since_ns") or 0)
+            except ValueError:
+                return 400, "text/plain", b"bad since_ns\n"
+            if params.get("text"):
+                return (200, "text/plain",
+                        aggregate_flight_text(since_ns=since_ns).encode())
+            return (200, "application/json",
+                    json.dumps(aggregate_flight(since_ns=since_ns)).encode())
+        if route in ("/debug/stalls", "/debug/stalls/"):
+            return (200, "application/json",
+                    json.dumps(aggregate_stalls(), indent=1).encode())
+        if route in ("/healthz", "/health"):
+            status, body = aggregate_healthz()
+            return status, "text/plain", body
+    except Exception:
+        # an aggregation bug must never take the scrape down: fall back to
+        # the local view (the pre-manycore behavior)
+        return None
+    return None

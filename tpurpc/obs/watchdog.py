@@ -338,7 +338,7 @@ class StallWatchdog:
         return list(self._active)
 
     def snapshot(self) -> dict:
-        return {
+        out = {
             "active": list(self._active),
             "history": list(self._history),
             "inflight": len(self._inflight),
@@ -347,6 +347,13 @@ class StallWatchdog:
             "min_stall_s": self.min_stall_s,
             "enabled": self.enabled,
         }
+        # tpurpc-manycore: a shard worker's registry names its shard so the
+        # aggregated /debug/stalls view attributes each diagnosis
+        from tpurpc.obs import shard as _shard
+
+        if _shard.shard_id() >= 0:
+            out["shard"] = _shard.shard_id()
+        return out
 
     def reset(self) -> None:
         """Test isolation: forget in-flight calls and diagnoses (the
@@ -378,3 +385,13 @@ def call_started(method: str, trace_id: int = 0,
 def call_finished(token: Optional[int], error: bool = False) -> None:
     if token is not None:
         get().call_finished(token, error=error)
+
+
+def postfork_reset() -> None:
+    """Fresh watchdog in a forked shard worker: the inherited instance's
+    sweeper thread did not survive the fork (and ``call_started`` would
+    never restart it — ``_thread`` is non-None but dead), and its in-flight
+    registry describes the supervisor's calls, not this worker's."""
+    global _instance, _instance_lock
+    _instance_lock = threading.Lock()
+    _instance = None

@@ -1207,11 +1207,18 @@ class Server:
 
     # -- ports / lifecycle ---------------------------------------------------
 
-    def add_insecure_port(self, address: str) -> int:
+    def add_insecure_port(self, address: str, *,
+                          reuseport: bool = False) -> int:
         """Bind now, return the real port (grpcio semantics: the port for
-        ":0" must be known before start so clients can be pointed at it)."""
+        ":0" must be known before start so clients can be pointed at it).
+
+        ``reuseport=True`` is the tpurpc-manycore listener-sharding mode:
+        shard workers bind the SAME port with ``SO_REUSEPORT`` and the
+        kernel spreads accepts across them (see
+        :class:`tpurpc.rpc.shard.ShardedServer`)."""
         host, _, port = address.rpartition(":")
-        bound = self._open_port(host or "0.0.0.0", int(port))
+        bound = self._open_port(host or "0.0.0.0", int(port),
+                                reuseport=reuseport)
         self.bound_ports.append(bound)
         return bound
 
@@ -1226,14 +1233,50 @@ class Server:
         self.bound_ports.append(bound)
         return bound
 
-    def _open_port(self, host: str, port: int, ssl_context=None) -> int:
+    def _open_port(self, host: str, port: int, ssl_context=None,
+                   reuseport: bool = False) -> int:
         listener = EndpointListener(
             host, port, self.serve_endpoint, ready=self._serving,
             ssl_context=ssl_context,
             raw_hook=None if ssl_context is not None
-            else self._try_native_adopt)
+            else self._try_native_adopt,
+            reuseport=reuseport)
         self._listeners.append(listener)
         return listener.port
+
+    def adopt_socket(self, sock) -> None:
+        """tpurpc-manycore handoff entry: serve a connection that was
+        ACCEPTED ELSEWHERE (the shard supervisor's accept loop, delivered
+        over SCM_RIGHTS) exactly as this server's own listener would —
+        native-plane adoption probe first, then the platform endpoint
+        factory, then the protocol sniff. Runs off the caller's thread: a
+        ring bootstrap blocks, and the worker's control loop must not stall
+        behind one silent client."""
+
+        def _adopt():
+            try:
+                if self._try_native_adopt(sock):
+                    return  # native data plane owns the socket now
+            except Exception as exc:
+                trace_server.log("handoff native probe failed (%s)", exc)
+            try:
+                peer = sock.getpeername()
+                host = peer[0] if isinstance(peer, tuple) else str(peer)
+                from tpurpc.core.endpoint import create_endpoint
+
+                ep = create_endpoint(sock, is_server=True,
+                                     pool_key=f"peer:{host}")
+            except Exception as exc:
+                trace_server.log("handoff bootstrap failed: %s", exc)
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                return
+            self.serve_endpoint(ep)
+
+        threading.Thread(target=_adopt, daemon=True,
+                         name="tpurpc-handoff").start()
 
     def start(self) -> "Server":
         if self._started:

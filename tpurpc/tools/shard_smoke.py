@@ -1,0 +1,125 @@
+"""tpurpc-manycore smoke for the verification gate (tools/check.sh).
+
+Stands up a 2-worker sharded server (fork + SO_REUSEPORT accept spread),
+drives pipelined depth-4 traffic over enough distinct connections to land
+on both shards, and asserts the manycore contract in ~2s with no jax:
+
+* both shards actually served calls (per-shard ``srv_calls`` on the
+  MERGED ``/metrics``, fetched through the serving port — whichever worker
+  answers must aggregate its peers);
+* the merged ``/debug/flight`` replay carries per-shard series: both
+  workers' ``shard-start`` events, every event shard-tagged;
+* ``tpurpc_shard_up`` enumerates exactly the running shards.
+
+Exit 0 on success; any assertion/exception exits 1 with the reason.
+
+    python -m tpurpc.tools.shard_smoke
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import sys
+
+WORKERS = 2
+DEPTH = 4
+CONNECTIONS = 8
+PER_CONNECTION = 8
+
+
+def _http_get(port: int, path: str, timeout: float = 10.0):
+    with socket.create_connection(("127.0.0.1", port), timeout=timeout) as s:
+        s.settimeout(timeout)
+        s.sendall(f"GET {path} HTTP/1.0\r\n\r\n".encode())
+        buf = bytearray()
+        while True:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+    head, _, body = bytes(buf).partition(b"\r\n\r\n")
+    return int(head.split(None, 2)[1]), body
+
+
+def run() -> int:
+    from tpurpc.rpc.channel import Channel
+    from tpurpc.rpc.server import Server, unary_unary_rpc_method_handler
+    from tpurpc.rpc.shard import ShardedServer
+
+    def build(shard_id: int) -> Server:
+        srv = Server(max_workers=8)
+        srv.add_method("/smoke.S/Echo", unary_unary_rpc_method_handler(
+            lambda req, ctx: bytes(req) + b"|" + str(shard_id).encode()))
+        return srv
+
+    sup = ShardedServer(build, workers=WORKERS,
+                        listener="reuseport").start()
+    try:
+        served_by = set()
+        total = 0
+        for c in range(CONNECTIONS):
+            with Channel(f"127.0.0.1:{sup.port}") as ch:
+                pl = ch.unary_unary("/smoke.S/Echo",
+                                    tpurpc_native=False).pipeline(DEPTH)
+                futs = [pl.call_async(f"c{c}r{i}".encode(), timeout=20)
+                        for i in range(PER_CONNECTION)]
+                for i, f in enumerate(futs):
+                    body, _, shard = bytes(f.result(timeout=25)).partition(
+                        b"|")
+                    assert body == f"c{c}r{i}".encode(), (
+                        f"demux mix-up: {body!r} for c{c}r{i}")
+                    served_by.add(int(shard))
+                    total += 1
+        assert total == CONNECTIONS * PER_CONNECTION
+        assert served_by == set(range(WORKERS)), (
+            f"accept spread left shards idle: only {sorted(served_by)} "
+            f"served calls")
+
+        # merged /metrics through the SERVING port: per-shard series + the
+        # liveness roster, whichever worker answered the scrape
+        status, body = _http_get(sup.port, "/metrics")
+        assert status == 200, status
+        text = body.decode()
+        calls = {}
+        for line in text.splitlines():
+            if line.startswith("tpurpc_srv_calls{") and '/smoke.S/Echo' in line:
+                shard = int(line.split('shard="', 1)[1].split('"', 1)[0])
+                calls[shard] = calls.get(shard, 0) + int(float(
+                    line.rsplit(" ", 1)[1]))
+        assert set(calls) == set(range(WORKERS)), (
+            f"/metrics missing per-shard srv_calls series: {calls}; "
+            f"head: {text[:400]!r}")
+        assert sum(calls.values()) == total, (calls, total)
+        for k in range(WORKERS):
+            assert f'tpurpc_shard_up{{shard="{k}"}} 1' in text
+
+        # merged /debug/flight: both shards' lifecycles, every event tagged
+        status, body = _http_get(sup.port, "/debug/flight")
+        assert status == 200, status
+        doc = json.loads(body)
+        assert sorted(doc["shards"]) == list(range(WORKERS)), doc["shards"]
+        starts = {(e["a1"], e.get("shard")) for e in doc["events"]
+                  if e["event"] == "shard-start"}
+        assert starts == {(k, k) for k in range(WORKERS)}, starts
+        untagged = [e for e in doc["events"] if "shard" not in e]
+        assert not untagged, f"untagged flight events: {untagged[:3]}"
+
+        print(f"shard smoke: {WORKERS} workers, depth={DEPTH}, {total} "
+              f"pipelined requests spread as {dict(sorted(calls.items()))}; "
+              "merged /metrics + /debug/flight carry per-shard series")
+        return 0
+    finally:
+        sup.stop()
+
+
+def main() -> int:
+    try:
+        return run()
+    except BaseException as exc:  # the gate wants a reasoned nonzero exit
+        print(f"shard smoke FAILED: {exc!r}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
