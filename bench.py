@@ -560,11 +560,37 @@ def _run_once(env, n_msgs: int, ready_s: float):
         except Exception:
             pass
 
+        # tpurpc-lens (ISSUE 8): per-hop byte-flow waterfall for the
+        # streaming path — the instrument that names the 1.72→8.5 GB/s
+        # bottleneck hop (ROADMAP item 2). Client-side hops come from this
+        # process's lens counters; server-side hops are scraped over the
+        # introspection plane from the sink that ran the INSTRUMENTED
+        # python plane (the probe port when the measured sink was native —
+        # labeled, exactly like the batch-stats probe above).
+        waterfall = None
+        try:
+            import urllib.request
+
+            from tpurpc.obs import lens as _lens
+
+            wf_port = port_probe if sink_native else port
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{wf_port}/debug/waterfall",
+                    timeout=5) as resp:
+                wf_server = json.loads(resp.read())
+            wf_client = _lens.waterfall()
+            waterfall = _merge_waterfalls([wf_client, wf_server])
+            waterfall["plane"] = ("python-probe" if sink_native
+                                  else "measured")
+        except Exception as exc:
+            sys.stderr.write(f"waterfall capture failed: {exc}\n")
+
         serving = None
         extras = {"stream_dts": [round(x, 3) for x in
                                  globals().get("_LAST_STREAM_DTS", [])],
                   "calibration": calib,
-                  "batch_stats": batch_stats}
+                  "batch_stats": batch_stats,
+                  "waterfall": waterfall}
         try:
             extras["device_kind"] = srv.wait_line("DEVKIND", 5).split(
                 " ", 1)[1].strip()
@@ -605,6 +631,104 @@ def _run_once(env, n_msgs: int, ready_s: float):
         raise
     finally:
         srv.kill()
+
+
+def _merge_waterfalls(docs: "list[dict]") -> dict:
+    """Sum hop tables from several processes (client + server side of one
+    stream): bytes and busy time add, effective GB/s recomputes over the
+    sums — the same merge the shard fan-out applies."""
+    merged: dict = {}
+    order: list = []
+    for doc in docs:
+        for r in (doc or {}).get("hops", ()):
+            hop = r.get("hop")
+            if hop not in merged:
+                merged[hop] = {"hop": hop, "bytes": 0, "busy_ms": 0.0,
+                               "copy_bytes": 0}
+                order.append(hop)
+            merged[hop]["bytes"] += int(r.get("bytes") or 0)
+            merged[hop]["busy_ms"] += float(r.get("busy_ms") or 0.0)
+            merged[hop]["copy_bytes"] += int(r.get("copy_bytes") or 0)
+    rows = []
+    for hop in order:
+        r = merged[hop]
+        ns = r["busy_ms"] * 1e6
+        r["gbps"] = round(r["bytes"] / ns, 3) if ns else 0.0
+        r["busy_ms"] = round(r["busy_ms"], 3)
+        rows.append(r)
+    live = [r for r in rows if r["bytes"] > 0 and r["busy_ms"] > 0]
+    return {"hops": rows,
+            "slowest_hop": (min(live, key=lambda r: r["gbps"])["hop"]
+                            if live else None)}
+
+
+def _lens_overhead(duration: "float | None" = None, pairs: int = 2) -> dict:
+    """tpurpc-lens overhead gate (ISSUE 8): the continuous stage-sampling
+    profiler at its DEFAULT rate (~50 Hz walking every thread stack)
+    versus the same closed loop with the sampler stopped.
+    ``lens_overhead_pct`` carries the <3% acceptance gate. The waterfall
+    hop counters are always-on in BOTH legs (they are plain registry
+    counters, priced by the obs gate since ISSUE 4) — this gate isolates
+    the one genuinely new continuous cost, the sampler thread. Same
+    alternation and best-draw-p50 methodology as _obs_overhead."""
+    import io
+
+    from tpurpc.bench import micro
+    from tpurpc.obs import profiler
+    from tpurpc.utils import stats as _st
+
+    if duration is None:
+        duration = float(os.environ.get("TPURPC_BENCH_OBS_S", "1.0"))
+    prev_fast = os.environ.get("TPURPC_NATIVE_FAST_UNARY")
+    os.environ["TPURPC_NATIVE_FAST_UNARY"] = "0"
+    prof = profiler.get()
+    srv = micro.run_server(0, max_workers=8)
+    target = f"127.0.0.1:{srv.bench_port}"
+    devnull = io.StringIO()
+    p50s = {"off": [], "on": []}
+
+    def leg(key, dur):
+        r = micro.run_client(target, req_size=64, duration=dur, out=devnull)
+        p50s[key].append(r["rtt_us"]["p50"])
+
+    try:
+        micro.run_client(target, req_size=64, duration=0.3,
+                         out=devnull)  # warm: connect + first-dispatch
+        for i in range(max(1, pairs)):
+            legs = [("off", False), ("on", True)]
+            if i % 2:
+                legs.reverse()
+            for key, on in legs:
+                if on:
+                    prof.start()
+                else:
+                    prof.stop()
+                leg(key, duration)
+    finally:
+        prof.stop()  # later benches decide their own profiling
+        if prev_fast is None:
+            os.environ.pop("TPURPC_NATIVE_FAST_UNARY", None)
+        else:
+            os.environ["TPURPC_NATIVE_FAST_UNARY"] = prev_fast
+        srv.stop(grace=0)
+        _st.reset_batch_stats()
+
+    def pct(on_key, off_key):
+        # best-draw p50s: contamination on a shared core is one-sided (see
+        # _obs_overhead.pct)
+        off = min(p50s[off_key])
+        on = min(p50s[on_key])
+        return round((on - off) / off * 100, 2) if off else 0.0
+
+    gate = pct("on", "off")
+    return {
+        "lens_overhead_pct": gate,
+        "lens_overhead_gate_pct": 3.0,
+        "lens_overhead_pass": gate < 3.0,
+        "lens_hz": prof.hz,
+        "lens_p50_us": {k: [round(x, 1) for x in sorted(v)]
+                        for k, v in p50s.items()},
+    }
 
 
 def _obs_overhead(duration: "float | None" = None, pairs: int = 3) -> dict:
@@ -1401,6 +1525,13 @@ def main() -> None:
         except Exception as exc:
             sys.stderr.write(f"flight overhead gate failed: {exc}\n")
             out["flight_overhead_error"] = repr(exc)
+        # tpurpc-lens (ISSUE 8): continuous stage profiler at default Hz
+        # vs stopped; <3% is the acceptance contract.
+        try:
+            out.update(_lens_overhead())
+        except Exception as exc:
+            sys.stderr.write(f"lens overhead gate failed: {exc}\n")
+            out["lens_overhead_error"] = repr(exc)
     # tpurpc-fleet (ISSUE 6): fleet_qps / fleet_p99_degraded_pct (hedging
     # on-vs-off with one slow replica) / shed_curve (admission gate vs
     # offered load). In-process, ~10s total.
@@ -1428,6 +1559,15 @@ def main() -> None:
         out["fallback_reason"] = fallback_reason
     if extras.get("stream_dts"):
         out["stream_round_secs"] = extras["stream_dts"]  # sorted; median used
+    # tpurpc-lens (ISSUE 8): the streaming phase's per-hop waterfall — the
+    # next PR finds ROADMAP item 2's bottleneck hop ON FILE here.
+    if extras.get("waterfall"):
+        wf = extras["waterfall"]
+        out["waterfall_gbps_by_hop"] = {
+            r["hop"]: r["gbps"] for r in wf["hops"]}
+        out["waterfall_slowest_hop"] = wf.get("slowest_hop")
+        out["waterfall_plane"] = wf.get("plane")
+        out["waterfall_detail"] = wf["hops"]
     # Batched receive pipeline (ISSUE 1): messages moved per receive-drain
     # wakeup, and how often waiters were satisfied inside the busy window
     # vs parked on fds. The drain happens on whichever side RECEIVES the

@@ -757,3 +757,143 @@ def test_handoff_runtime_matches_model_shape():
     assert ring.publish("b") and ring.publish("c")
     assert ring.take() == "b" and ring.take() == "c"
     ring.close()
+
+
+# ---------------------------------------------------------------------------
+# lint: static stage/hop registrations + pure-int hop accounting (ISSUE 8)
+# ---------------------------------------------------------------------------
+
+STAGE_OK = '''
+from tpurpc.obs import lens as _lens
+from tpurpc.obs import profiler as _profiler
+
+_LENS_WIRE_BYTES, _LENS_WIRE_NS, _LENS_WIRE_COPY = _lens.hop_counters("wire")
+
+_LENS_STAGES = {"write": "wire", "read": "wire"}
+_profiler.register_stages(__file__, _LENS_STAGES)
+_profiler.register_stages("socketserver.py", {"serve_forever": "idle"})
+
+
+def site(n, t0, t1):
+    dt = t1 - t0
+    _LENS_WIRE_NS.inc(dt)
+    _LENS_WIRE_BYTES.inc(n)
+'''
+
+
+def test_stage_rule_static_registrations_pass():
+    assert lint_source(STAGE_OK, "fixture.py") == []
+
+
+def test_stage_rule_flags_registration_inside_function():
+    src = STAGE_OK + '''
+
+def late(profiler):
+    profiler.register_stages(__file__, _LENS_STAGES)
+'''
+    vs = lint_source(src, "fixture.py")
+    assert _rules(vs) == ["stage"] and "module-level" in vs[0].message
+
+
+def test_stage_rule_flags_dynamic_strings():
+    src = '''
+from tpurpc.obs import profiler as _profiler
+
+name = "ring" + "-write"
+_profiler.register_stages(__file__, {"writev": name})
+'''
+    vs = lint_source(src, "fixture.py")
+    assert _rules(vs) == ["stage"] and "static" in vs[0].message
+
+
+def test_stage_rule_flags_non_constant_mapping_name():
+    src = '''
+from tpurpc.obs import profiler as _profiler
+
+
+def build():
+    return {"writev": "ring-write"}
+
+
+_MAPPING = build()
+_profiler.register_stages(__file__, _MAPPING)
+'''
+    assert _rules(lint_source(src, "fixture.py")) == ["stage"]
+
+
+def test_stage_rule_flags_dynamic_hop_name():
+    src = '''
+from tpurpc.obs import lens as _lens
+
+hop = "wire"
+_LENS_X_B, _LENS_X_NS, _LENS_X_C = _lens.hop_counters(hop)
+'''
+    vs = lint_source(src, "fixture.py")
+    assert _rules(vs) == ["stage"] and "string-literal" in vs[0].message
+
+
+def test_stage_rule_flags_hop_binding_inside_function():
+    src = '''
+from tpurpc.obs import lens as _lens
+
+
+def bind():
+    return _lens.hop_counters("wire")
+'''
+    assert _rules(lint_source(src, "fixture.py")) == ["stage"]
+
+
+def test_stage_rule_flags_calls_in_hop_accounting():
+    src = STAGE_OK + '''
+
+def bad_site(views):
+    _LENS_WIRE_BYTES.inc(sum(len(v) for v in views))
+'''
+    vs = lint_source(src, "fixture.py")
+    assert _rules(vs) == ["stage"]
+    assert "precompute the int" in vs[0].message
+
+
+def test_stage_rule_flags_str_constant_in_hop_accounting():
+    src = STAGE_OK + '''
+
+def bad_site2():
+    _LENS_WIRE_NS.inc("12")
+'''
+    assert _rules(lint_source(src, "fixture.py")) == ["stage"]
+
+
+def test_stage_rule_ignores_non_lens_counters():
+    src = '''
+def site(c, n):
+    c.inc(len(n))          # a plain counter: not hop accounting
+    _OTHER.inc(str(n))     # not a _LENS_ binding either
+'''
+    assert lint_source(src, "fixture.py") == []
+
+
+def test_stage_rule_suppression_comment():
+    src = STAGE_OK + '''
+
+def deliberate(views):
+    _LENS_WIRE_BYTES.inc(len(views))  # tpr: allow(stage)
+'''
+    assert lint_source(src, "fixture.py") == []
+
+
+def test_stage_rule_instrumented_modules_are_clean():
+    """The real hop-accounting/marker modules hold the contract."""
+    import tpurpc.core.endpoint
+    import tpurpc.core.pair
+    import tpurpc.core.ring
+    import tpurpc.jaxshim.codec
+    import tpurpc.obs.profiler
+    import tpurpc.tpu.endpoint
+    import tpurpc.tpu.hbm_ring
+
+    for mod in (tpurpc.core.ring, tpurpc.core.pair, tpurpc.core.endpoint,
+                tpurpc.jaxshim.codec, tpurpc.tpu.hbm_ring,
+                tpurpc.tpu.endpoint, tpurpc.obs.profiler):
+        with open(mod.__file__, "r", encoding="utf-8") as f:
+            vs = lint_source(f.read(), mod.__file__)
+        assert [v for v in vs if v.rule == "stage"] == [], mod.__name__

@@ -1,0 +1,460 @@
+"""tpurpc-lens (ISSUE 8): waterfall hops, stage profiler, clock-anchored
+timeline, shard fan-out of the new routes, concurrent-scrape safety.
+
+The profiler tests drive ``sample_once`` with SYNTHETIC frames so the
+stage attribution is deterministic; the scrape/shard tests run real
+servers (the routes exist to be curled)."""
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from tpurpc.obs import lens, metrics, profiler, tracing
+from tpurpc.obs.profiler import StageProfiler
+
+
+def _http_get(port, path, timeout=5.0):
+    with socket.create_connection(("127.0.0.1", port), timeout=timeout) as s:
+        s.settimeout(timeout)
+        s.sendall(f"GET {path} HTTP/1.0\r\n\r\n".encode())
+        buf = bytearray()
+        while True:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+    head, _, body = bytes(buf).partition(b"\r\n\r\n")
+    return int(head.split(None, 2)[1]), body
+
+
+# ---------------------------------------------------------------------------
+# waterfall hop registry + export
+# ---------------------------------------------------------------------------
+
+def test_hop_counters_known_hops_only():
+    b, ns, cp = lens.hop_counters("wire")
+    assert b.name == "lens_wire_bytes"
+    with pytest.raises(ValueError):
+        lens.hop_counters("warp-drive")
+
+
+def test_waterfall_rates_and_slowest_hop():
+    b, ns, cp = lens.hop_counters("send_ring")
+    b0, ns0 = b.snapshot(), ns.snapshot()
+    b.inc(10_000_000)
+    ns.inc(1_000_000)  # 10 MB in 1 ms = 10 GB/s on top of whatever was there
+    doc = lens.waterfall()
+    row = next(r for r in doc["hops"] if r["hop"] == "send_ring")
+    assert row["bytes"] == b0 + 10_000_000
+    expect = (b0 + 10_000_000) / (ns0 + 1_000_000)
+    assert row["gbps"] == pytest.approx(expect, rel=0.01)
+    assert doc["slowest_hop"] in {r["hop"] for r in doc["hops"]}
+    assert "ledger" in doc
+    # hop order is the declared data-flow order
+    assert tuple(r["hop"] for r in doc["hops"]) == lens.HOP_NAMES
+
+
+def test_waterfall_text_rendering_flags_slowest():
+    slow_b, slow_ns, _ = lens.hop_counters("decode")
+    slow_b.inc(1000)
+    slow_ns.inc(50_000_000_000)  # pathologically slow: must win the argmin
+    txt = lens.render_text()
+    assert "slowest" in txt and "decode" in txt
+
+
+def test_streaming_hops_account_ring_traffic():
+    """A ring write/read round trip lands bytes in send_ring AND peer_ring
+    with nonzero busy time."""
+    from tpurpc.core.ring import RingReader, RingWriter
+
+    sb, sn, sc = lens.hop_counters("send_ring")
+    rb, rn, rc = lens.hop_counters("peer_ring")
+    s0, r0 = sb.snapshot(), rb.snapshot()
+    buf = bytearray(4096)
+
+    def place(off, data):
+        buf[off:off + len(data)] = bytes(data)
+
+    w = RingWriter(4096, place)
+    payload = b"z" * 1500
+    w.writev([payload])
+    reader = RingReader(buf)
+    out = reader.read(4096)
+    assert out == payload
+    assert sb.snapshot() - s0 == 1500
+    assert rb.snapshot() - r0 == 1500
+    assert sc.snapshot() >= 1500  # ring bytes move by host memcpy: copies
+    assert sn.snapshot() > 0 and rn.snapshot() > 0
+
+
+# ---------------------------------------------------------------------------
+# stage profiler: deterministic classification via synthetic frames
+# ---------------------------------------------------------------------------
+
+class _Code:
+    def __init__(self, filename, name):
+        self.co_filename = filename
+        self.co_name = name
+
+
+class _Frame:
+    def __init__(self, filename, name, back=None):
+        self.f_code = _Code(filename, name)
+        self.f_back = back
+
+
+def _stack(*frames):
+    """Build a frame chain from (filename, funcname) outermost-first;
+    returns the INNERMOST frame (what sys._current_frames yields)."""
+    top = None
+    for filename, name in frames:
+        top = _Frame(filename, name, back=top)
+    return top
+
+
+_RING = "/x/tpurpc/core/ring.py"  # matches the registered basename markers
+
+
+def test_classify_innermost_marker_wins():
+    # innermost→outermost walk: drain_into (ring-read) shadows the outer
+    # server dispatch frame
+    f = _stack(("/x/tpurpc/rpc/server.py", "_run_handler"),
+               (_RING, "drain_into"))
+    stage, parts = StageProfiler.classify(f)
+    assert stage == "ring-read"
+    assert parts[-1].endswith("drain_into")  # leaf-last collapsed stack
+
+
+def test_classify_stdlib_park_attributes_to_outer_tpurpc_frame():
+    # a batcher thread parked in threading.Condition.wait: the stdlib
+    # frame carries no marker, the outer jaxshim frame names the stage
+    import tpurpc.jaxshim.service  # noqa: F401 — registers its markers
+
+    f = _stack(("/x/tpurpc/jaxshim/service.py", "_loop"),
+               ("/usr/lib/python3/threading.py", "wait"))
+    stage, _ = StageProfiler.classify(f)
+    assert stage == "batcher"
+
+
+def test_classify_unattributed_vs_other():
+    in_tree = profiler._TPURPC_DIR + "/rpc/mystery.py"
+    stage, _ = StageProfiler.classify(_stack((in_tree, "enigma")))
+    assert stage == "unattributed"
+    stage, _ = StageProfiler.classify(
+        _stack(("/usr/lib/python3/selectors.py", "select")))
+    assert stage == "other"
+
+
+def test_sample_once_aggregates_and_bounds():
+    p = StageProfiler(hz=50)
+    frames = {
+        1: _stack((_RING, "writev")),
+        2: _stack((_RING, "drain_into")),
+        3: _stack(("/usr/lib/python3/queue.py", "get")),
+    }
+    for _ in range(10):
+        p.sample_once(frames=frames, now_ns=123)
+    assert p.samples == 30
+    assert p.stages["ring-write"] == 10
+    assert p.stages["ring-read"] == 10
+    assert p.stages["other"] == 10
+    snap = p.snapshot()
+    # `other` is excluded from the attribution denominator
+    assert snap["attributed_pct"] == 100.0
+    assert snap["stage_pct"]["ring-write"] == 50.0
+    assert len(p.recent) == 30
+    collapsed = p.collapsed_text()
+    assert "ring:writev 10" in collapsed
+
+
+def test_sampler_thread_runs_and_stops():
+    p = StageProfiler(hz=200)
+    p.start()
+    try:
+        deadline = time.monotonic() + 5
+        while p.samples == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert p.samples > 0
+    finally:
+        p.stop()
+    assert not p.running()
+    n = p.samples
+    time.sleep(0.05)
+    assert p.samples == n  # genuinely stopped
+
+
+def test_register_stages_keys_by_basename():
+    profiler.register_stages("/weird/path/fake_lens_mod.py",
+                             {"fake_fn": "codec"})
+    assert profiler.markers()[("fake_lens_mod.py", "fake_fn")] == "codec"
+    stage, _ = StageProfiler.classify(
+        _stack(("/other/prefix/fake_lens_mod.py", "fake_fn")))
+    assert stage == "codec"
+
+
+# ---------------------------------------------------------------------------
+# clock anchor + timeline rebasing (the pinned-skew satellite)
+# ---------------------------------------------------------------------------
+
+def test_chrome_trace_carries_clock_anchor():
+    doc = tracing.chrome_trace()
+    a = doc["clock_anchor"]
+    assert abs(a["mono_ns"] - time.monotonic_ns()) < 5e9
+    assert abs(a["wall_ns"] - time.time_ns()) < 5e9  # tpr: allow(wallclock)
+    assert a["uncertainty_ns"] >= 0 and a["pid"] > 0
+
+
+def test_timeline_rebase_pinned_math():
+    from tpurpc.tools.timeline import rebase_ns
+
+    anchor = {"mono_ns": 1_000_000, "wall_ns": 500_000_000}
+    # mono 1.5ms = wall 500.5ms; epoch 500ms -> 500us on the shared axis
+    assert rebase_ns(1_500_000, anchor, 500_000_000) == pytest.approx(500.0)
+    # no anchor: raw monotonic passes through (flagged upstream)
+    assert rebase_ns(2_000, None, 0) == pytest.approx(2.0)
+
+
+def test_timeline_aligns_two_processes_with_known_skew():
+    """Two fake processes whose monotonic epochs differ by exactly 7s:
+    events that happened at the SAME wall instant must land at the same
+    rebased timestamp, and lanes stay distinct."""
+    from tpurpc.tools.timeline import build_timeline
+
+    wall = 1_700_000_000_000_000_000
+    skew_ns = 7_000_000_000
+
+    def member(target, mono_anchor, ev_mono_ns):
+        return {
+            "target": target,
+            "traces": {
+                "traceEvents": [
+                    {"ph": "X", "name": "spanA", "cat": "tpurpc",
+                     "ts": ev_mono_ns / 1e3, "dur": 10.0,
+                     "pid": 1, "tid": 1},
+                ],
+                "displayTimeUnit": "ms",
+                "clock_anchor": {"pid": 1, "mono_ns": mono_anchor,
+                                 "wall_ns": wall},
+            },
+            "flight": {"events": []},
+            "profile": {},
+            "metrics": "",
+        }
+
+    # proc A: event 1ms after its anchor. proc B: its monotonic clock is
+    # 7s AHEAD (started later), same wall anchor instant, event also 1ms
+    # after the anchor — the two events are wall-simultaneous.
+    a = member("a:1", 10_000_000, 10_000_000 + 1_000_000)
+    b = member("b:1", 10_000_000 + skew_ns,
+               10_000_000 + skew_ns + 1_000_000)
+    doc = build_timeline([a, b])
+    spans = [e for e in doc["traceEvents"]
+             if e.get("ph") == "X" and e["name"] == "spanA"]
+    assert len(spans) == 2
+    assert spans[0]["ts"] == pytest.approx(spans[1]["ts"], abs=1e-6)
+    assert spans[0]["pid"] != spans[1]["pid"]  # distinct lanes
+    assert not doc["otherData"]["unanchored"]
+    names = [e["args"]["name"] for e in doc["traceEvents"]
+             if e.get("ph") == "M" and e.get("name") == "process_name"]
+    assert names == ["a:1", "b:1"]
+
+
+def test_timeline_unanchored_member_is_flagged_not_dropped():
+    from tpurpc.tools.timeline import build_timeline
+
+    doc = build_timeline([{
+        "target": "old:1",
+        "traces": {"traceEvents": [
+            {"ph": "X", "name": "s", "ts": 5.0, "dur": 1.0,
+             "pid": 1, "tid": 1}]},
+        "flight": None, "profile": None, "metrics": "",
+    }])
+    assert doc["otherData"]["unanchored"] == ["old:1"]
+    assert any(e.get("ph") == "X" for e in doc["traceEvents"])
+
+
+def test_merge_waterfalls_sums_and_recomputes_rate():
+    import bench
+
+    a = {"hops": [{"hop": "wire", "bytes": 1_000_000, "busy_ms": 1.0,
+                   "copy_bytes": 0}]}
+    b = {"hops": [{"hop": "wire", "bytes": 3_000_000, "busy_ms": 1.0,
+                   "copy_bytes": 100}]}
+    m = bench._merge_waterfalls([a, b])
+    row = m["hops"][0]
+    assert row["bytes"] == 4_000_000 and row["copy_bytes"] == 100
+    assert row["gbps"] == pytest.approx(2.0, rel=0.01)  # 4MB / 2ms
+    assert m["slowest_hop"] == "wire"
+
+
+# ---------------------------------------------------------------------------
+# scrape routes + concurrent-scraper hammering (satellite 3)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def echo_server():
+    from tpurpc.rpc.server import Server, unary_unary_rpc_method_handler
+
+    srv = Server(max_workers=8)
+    srv.add_method("/lens/Echo",
+                   unary_unary_rpc_method_handler(
+                       lambda req, ctx: bytes(req)))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    yield srv, port
+    srv.stop(0)
+
+
+def test_profile_and_waterfall_routes(echo_server):
+    _srv, port = echo_server
+    status, body = _http_get(port, "/debug/profile")
+    assert status == 200
+    doc = json.loads(body)
+    assert doc["enabled"] and doc["hz"] > 0
+    status, body = _http_get(port, "/debug/waterfall")
+    assert status == 200
+    doc = json.loads(body)
+    assert tuple(r["hop"] for r in doc["hops"]) == lens.HOP_NAMES
+    status, body = _http_get(port, "/debug/waterfall?text=1")
+    assert status == 200 and b"GB/s" in body
+    status, _body = _http_get(port, "/debug/profile?collapsed=1")
+    assert status == 200
+
+
+def test_lens_off_switch_disables_profile_route(echo_server, monkeypatch):
+    _srv, port = echo_server
+    monkeypatch.setenv("TPURPC_LENS", "0")
+    try:
+        status, body = _http_get(port, "/debug/profile")
+        assert status == 200
+        assert json.loads(body) == {"enabled": False,
+                                    "reason": "TPURPC_LENS=0"}
+    finally:
+        monkeypatch.delenv("TPURPC_LENS", raising=False)
+
+
+def test_concurrent_scrapers_vs_pipelined_traffic(echo_server):
+    """N scraper threads hammer /metrics + /debug/profile +
+    /debug/waterfall on the SERVING port while depth-4 pipelined traffic
+    runs: no exception anywhere, no torn Prometheus output, and the
+    scrape cost lands in the scrape_us histogram."""
+    from tpurpc.rpc.channel import Channel
+    from tpurpc.tools.top import parse_prometheus
+
+    _srv, port = echo_server
+    scrape_us = metrics.histogram("scrape_us", kind="latency")
+    count0 = scrape_us.snapshot()["count"]
+    errors = []
+    stop = threading.Event()
+    scrapes = {"n": 0}
+
+    def scraper(k):
+        paths = ["/metrics", "/debug/profile", "/debug/waterfall"]
+        try:
+            while not stop.is_set():
+                path = paths[scrapes["n"] % len(paths)]
+                status, body = _http_get(port, path)
+                assert status == 200, (path, status)
+                if path == "/metrics":
+                    m = parse_prometheus(body.decode())
+                    # a torn exposition drops whole families: the core
+                    # series must be present in EVERY scrape
+                    assert ("tpurpc_ring_msgs_read", "") in m, "torn scrape"
+                else:
+                    json.loads(body)  # torn JSON would raise
+                scrapes["n"] += 1
+        except Exception as exc:  # noqa: BLE001 — recorded, test asserts
+            errors.append((k, repr(exc)))
+
+    threads = [threading.Thread(target=scraper, args=(k,), daemon=True)
+               for k in range(3)]
+    [t.start() for t in threads]
+    try:
+        with Channel(f"127.0.0.1:{port}") as ch:
+            pl = ch.unary_unary("/lens/Echo").pipeline(depth=4)
+            for round_ in range(6):
+                futs = [pl.call_async(b"m%d" % i, timeout=20)
+                        for i in range(16)]
+                for i, f in enumerate(futs):
+                    assert f.result(20) == b"m%d" % i
+    finally:
+        stop.set()
+        [t.join(timeout=10) for t in threads]
+    assert not errors, errors
+    assert scrapes["n"] >= 6, "scrapers barely ran"
+    # the scrape cost is accounted where it runs — the scrape_us histogram
+    got = metrics.histogram("scrape_us", kind="latency").snapshot()
+    assert got["count"] >= count0 + scrapes["n"]
+    assert got["p50"] > 0
+
+
+# ---------------------------------------------------------------------------
+# shard fan-out of /traces, /debug/profile, /debug/waterfall (satellite 1)
+# ---------------------------------------------------------------------------
+
+def _build_traced(shard_id):
+    import tpurpc.rpc as tps
+    from tpurpc.obs import tracing as _tracing
+
+    _tracing.force(True)
+    srv = tps.Server(max_workers=4)
+    srv.add_method("/lens/Who", tps.unary_unary_rpc_method_handler(
+        lambda req, ctx: str(shard_id).encode()))
+    return srv
+
+
+def test_trace_on_non_answering_shard_appears_in_merged_view():
+    """The satellite-1 regression: a sampled span born on shard k must be
+    visible in GET /traces on the serving port no matter which worker
+    answers the scrape — plus the new /debug/profile and /debug/waterfall
+    fan-outs carry every live worker."""
+    import tpurpc.rpc as tps
+    from tpurpc.rpc.shard import ShardedServer
+
+    sup = ShardedServer(_build_traced, workers=2,
+                        listener="reuseport").start()
+    tracing.force(True)  # client roots propagate; each serving worker
+    try:                 # records its half of the span tree
+        seen = set()
+        deadline = time.monotonic() + 30
+        while len(seen) < 2 and time.monotonic() < deadline:
+            with tps.Channel(f"127.0.0.1:{sup.port}") as ch:
+                seen.add(bytes(ch.unary_unary("/lens/Who")(
+                    b"x", timeout=20)).decode())
+        assert seen == {"0", "1"}, seen
+
+        def merged_traces():
+            status, body = _http_get(sup.port, "/traces")
+            assert status == 200
+            return json.loads(body)
+
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            doc = merged_traces()
+            span_pids = {e["pid"] for e in doc.get("traceEvents", ())
+                         if e.get("ph") == "X"}
+            if span_pids >= {0, 1}:
+                break
+            time.sleep(0.25)
+        # BOTH workers' spans are in the one merged doc — whichever shard
+        # answered, the other one's spans crossed the fan-out
+        assert span_pids >= {0, 1}, (span_pids, doc.get("shards"))
+        assert set(doc["clock_anchors"]) == {"0", "1"}
+
+        status, body = _http_get(sup.port, "/debug/profile")
+        assert status == 200
+        prof = json.loads(body)
+        assert set(prof["shards"]) == {"0", "1"}, prof.get("shards")
+        assert prof["samples"] >= 0 and prof["enabled"]
+
+        status, body = _http_get(sup.port, "/debug/waterfall")
+        assert status == 200
+        wf = json.loads(body)
+        assert set(wf["shards"]) == {"0", "1"}
+        assert tuple(r["hop"] for r in wf["hops"]) == lens.HOP_NAMES
+    finally:
+        tracing.force(None)
+        sup.stop()

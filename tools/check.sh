@@ -68,6 +68,14 @@ python -m tpurpc.tools.fleet_smoke || fail=1
 note "tpurpc-manycore smoke (2 shards, accept spread, merged scrape)"
 python -m tpurpc.tools.shard_smoke || fail=1
 
+# 2g) tpurpc-lens smoke (ISSUE 8): streaming + serving burst, then assert
+#     the sampling profiler names >=3 known stages (>=80% attributed), the
+#     /debug/waterfall reports every declared hop with nonzero bytes and a
+#     slowest hop, and the timeline tool emits a Perfetto-loadable trace
+#     with >=2 clock-anchored process lanes. ~15s (jax on cpu).
+note "tpurpc-lens smoke (profiler + waterfall + timeline)"
+JAX_PLATFORMS=cpu python -m tpurpc.tools.lens_smoke || fail=1
+
 # 3) the analysis subsystem's own tests, plus a lock-order-instrumented run
 #    of the concurrency-heavy suites (TPURPC_DEBUG_LOCKS exercises the
 #    CheckedLock shim wired into poller/pair/xds/channel/channelz)

@@ -55,6 +55,19 @@ hand (ISSUE 2) and that no general-purpose linter knows about:
   ``format()``, ``tag_for()`` or even ``len()`` in the argument list is
   per-event work the always-on recorder must not pay; precompute the int
   on a cold path). Deliberate exceptions carry ``# tpr: allow(flight)``.
+* ``stage``    — tpurpc-lens (ISSUE 8) attribution plumbing, two halves.
+  (a) Frame-marker / hop registrations are STATIC module-level constants:
+  ``profiler.register_stages(...)`` and ``lens.hop_counters(...)`` calls
+  must sit at module level (the sampler reads the registry lock-free, so
+  it must be fully populated at import and never mutate at runtime), with
+  ``register_stages`` taking ``__file__``/a string literal plus a dict of
+  string constants (literal or a module-level ``_LENS_STAGES`` constant)
+  and ``hop_counters`` a declared-hop string literal — no dynamic
+  strings. (b) Waterfall hop accounting sites — ``.inc(...)`` on a
+  ``_LENS_*``-bound counter — run per batched op on the data plane and
+  must use the same pure-int plumbing the ``flight`` rule enforces: names,
+  attributes and arithmetic only, no calls/displays/str constants.
+  Deliberate exceptions carry ``# tpr: allow(stage)``.
 
 Suppression grammar: a line comment ``# tpr: allow(<rule>)`` disables that
 rule for its line. The hot-path modules are expected to carry NO ``copy``
@@ -409,6 +422,107 @@ def _check_flight(tree: ast.AST, path: str,
                 "cold path); a deliberate exception carries "
                 "'# tpr: allow(flight)'"))
             break
+    return out
+
+
+# -- rule: stage -------------------------------------------------------------
+
+def _module_consts(tree: ast.AST) -> Dict[str, ast.AST]:
+    """Top-level ``NAME = <expr>`` bindings (the constants registrations
+    may reference)."""
+    out: Dict[str, ast.AST] = {}
+    for stmt in getattr(tree, "body", ()):
+        if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)):
+            out[stmt.targets[0].id] = stmt.value
+    return out
+
+
+def _static_str_dict(node: Optional[ast.AST],
+                     consts: Dict[str, ast.AST]) -> bool:
+    """Is ``node`` a dict of string constants — directly or via a
+    module-level constant Name?"""
+    if isinstance(node, ast.Name):
+        node = consts.get(node.id)
+    if not isinstance(node, ast.Dict):
+        return False
+    for k, v in zip(node.keys, node.values):
+        if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+            return False
+        if not (isinstance(v, ast.Constant) and isinstance(v.value, str)):
+            return False
+    return True
+
+
+def _check_stage(tree: ast.AST, path: str,
+                 lines: Sequence[str]) -> List[LintViolation]:
+    """tpurpc-lens (ISSUE 8): static stage/hop registrations + pure-int
+    hop accounting. See the module docstring's ``stage`` entry."""
+    out = []
+    consts = _module_consts(tree)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        if name == "register_stages":
+            if "stage" in _allowed_rules(lines, node.lineno):
+                continue
+            if _enclosing_fn(node) is not None:
+                out.append(LintViolation(
+                    path, node.lineno, node.col_offset, "stage",
+                    "register_stages inside a function: frame-marker "
+                    "registrations must be module-level (the sampler reads "
+                    "the registry lock-free — populate it at import, never "
+                    "at runtime); a deliberate exception carries "
+                    "'# tpr: allow(stage)'"))
+                continue
+            args = list(node.args)
+            a0_ok = len(args) >= 1 and (
+                (isinstance(args[0], ast.Name) and args[0].id == "__file__")
+                or (isinstance(args[0], ast.Constant)
+                    and isinstance(args[0].value, str)))
+            a1_ok = len(args) >= 2 and _static_str_dict(args[1], consts)
+            if not (a0_ok and a1_ok):
+                out.append(LintViolation(
+                    path, node.lineno, node.col_offset, "stage",
+                    "register_stages arguments must be static: __file__ "
+                    "(or a string literal) plus a dict of string constants "
+                    "— a module-level _LENS_STAGES constant or a literal; "
+                    "dynamic strings make the frame registry unauditable; "
+                    "a deliberate exception carries '# tpr: allow(stage)'"))
+        elif name == "hop_counters":
+            if "stage" in _allowed_rules(lines, node.lineno):
+                continue
+            bad = _enclosing_fn(node) is not None
+            bad = bad or not (node.args
+                              and isinstance(node.args[0], ast.Constant)
+                              and isinstance(node.args[0].value, str))
+            if bad:
+                out.append(LintViolation(
+                    path, node.lineno, node.col_offset, "stage",
+                    "hop_counters must bind a declared hop at module level "
+                    "with a string-literal hop name (the cached-counter "
+                    "contract: sites pay only the bump); a deliberate "
+                    "exception carries '# tpr: allow(stage)'"))
+        elif name == "inc":
+            f = node.func
+            if not (isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id.startswith("_LENS_")):
+                continue
+            if "stage" in _allowed_rules(lines, node.lineno):
+                continue
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                why = _flight_arg_violation(arg)
+                if why is None:
+                    continue
+                out.append(LintViolation(
+                    path, node.lineno, node.col_offset, "stage",
+                    f"waterfall hop accounting argument {why}: hop "
+                    "counters bump per batched op on the data plane — "
+                    "precompute the int (the flight rule's contract); a "
+                    "deliberate exception carries '# tpr: allow(stage)'"))
+                break
     return out
 
 
@@ -776,6 +890,7 @@ def lint_source(source: str, path: str,
             out.extend(_check_block(tree, path, lines, frozenset(fns)))
     out.extend(_check_locks(tree, path, lines))
     out.extend(_check_shard(tree, path, lines))
+    out.extend(_check_stage(tree, path, lines))
     out.extend(_check_lease(tree, path, lines))
     out.sort(key=lambda v: (v.path, v.line, v.col))
     return out

@@ -39,8 +39,24 @@ from typing import Callable, Optional, Tuple
 
 from tpurpc.core.pair import Pair, PairState
 from tpurpc.core.poller import PairPool, Poller, wait_readable, wait_writable
+from tpurpc.obs import lens as _lens
+from tpurpc.obs import profiler as _profiler
 from tpurpc.utils.config import Platform, get_config
 from tpurpc.utils.trace import trace_endpoint
+
+# tpurpc-lens (ISSUE 8): on the framed (h2-over-TCP) plane the `wire`
+# waterfall hop is the socket write — gather sendmsg / sendall / the TLS
+# chunk loop. (The pair plane's wire hop is Pair.send in core/pair.py.)
+_LENS_WIRE_BYTES, _LENS_WIRE_NS, _LENS_WIRE_COPY = _lens.hop_counters("wire")
+
+_LENS_STAGES = {
+    "write": "wire",
+    "_ssl_send_all": "wire",
+    "read": "wire",
+    "read_into": "wire",
+    "_await_readable": "poller-wait",
+}
+_profiler.register_stages(__file__, _LENS_STAGES)
 
 
 class EndpointError(ConnectionError):
@@ -293,6 +309,8 @@ class TcpEndpoint(Endpoint):
     def write(self, data) -> None:
         if self._closed:
             raise EndpointError("write on closed endpoint")
+        t0 = time.monotonic_ns()
+        total = 0
         try:
             if self._ssl_lock is not None:
                 # SSLSocket (sendmsg raises NotImplementedError there):
@@ -300,9 +318,10 @@ class TcpEndpoint(Endpoint):
                 # TLS layer would have paid internally (bytes.join accepts
                 # memoryviews directly; scalars pass through zero-copy —
                 # _ssl_send_all wraps them in a memoryview itself).
-                self._ssl_send_all(b"".join(data)
-                                   if isinstance(data, (list, tuple))
-                                   else data)
+                blob = (b"".join(data) if isinstance(data, (list, tuple))
+                        else data)
+                total = len(blob)
+                self._ssl_send_all(blob)
                 return
             if isinstance(data, (list, tuple)):
                 # sendmsg is a gather write but may place PARTIALLY under
@@ -310,6 +329,7 @@ class TcpEndpoint(Endpoint):
                 # iovecs (a large pytree serializes to 2-3 segments per leaf);
                 # loop chunked until every byte is on the wire.
                 views = [memoryview(s).cast("B") for s in data if len(s)]
+                total = sum(len(v) for v in views)
                 while views:
                     sent = self._sock.sendmsg(views[:1024])
                     while sent:
@@ -320,9 +340,18 @@ class TcpEndpoint(Endpoint):
                             views[0] = views[0][sent:]
                             sent = 0
             else:
+                total = len(memoryview(data).cast("B"))
                 self._sock.sendall(data)
         except OSError as exc:
             raise EndpointError(f"tcp write failed: {exc}") from exc
+        finally:
+            # tpurpc-lens `wire` hop: socket bytes moved + the nanoseconds
+            # the kernel handoff took (backpressure blocking included — a
+            # full socket buffer IS wire time). One bump set per writev.
+            dt = time.monotonic_ns() - t0
+            _LENS_WIRE_NS.inc(dt)
+            _LENS_WIRE_BYTES.inc(total)
+            _LENS_WIRE_COPY.inc(total)
 
     def close(self) -> None:
         if not self._closed:
@@ -439,9 +468,11 @@ class RingEndpoint(Endpoint):
 
     def read(self, max_bytes: int = 1 << 20,
              timeout: Optional[float] = None) -> bytes:
+        from tpurpc.core.ring import truncate_after_read
+
         buf = bytearray(min(max_bytes, self.pair.ring_size))
         n = self.read_into(buf, timeout=timeout)
-        del buf[n:]
+        truncate_after_read(buf, n)
         return bytes(buf)
 
     def read_into(self, dst, timeout: Optional[float] = None) -> int:

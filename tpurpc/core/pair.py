@@ -45,11 +45,14 @@ import numpy as np
 from tpurpc.analysis.locks import make_lock
 from tpurpc.core import _native
 from tpurpc.obs import flight as _flight
+from tpurpc.obs import lens as _lens
 from tpurpc.obs import metrics as _metrics
+from tpurpc.obs import profiler as _profiler
 from tpurpc.obs import tracing as _tracing
 from tpurpc.tpu import ledger as ring_ledger
 from tpurpc.core.ring import (RingCorruption, RingReader, RingWriter,
-                              _BYTES_OUT, _MSGS_OUT)
+                              _BYTES_OUT, _MSGS_OUT,
+                              truncate_after_read as ring_truncate)
 from tpurpc.utils import stats as _stats
 from tpurpc.utils.config import get_config
 
@@ -74,6 +77,23 @@ _PAIRS_MSG_WAITING = _metrics.fleet(
     lambda p: 1.0 if (p.state.name == "CONNECTED" and p.has_message())
     else 0.0)
 from tpurpc.utils.trace import trace_ring
+
+# tpurpc-lens (ISSUE 8): the `wire` waterfall hop is the transport
+# boundary — on this plane, Pair.send's one-sided placement (credit fold,
+# chunking and ring encode included). The fused native send bypasses
+# RingWriter, so its bytes land in the send_ring hop here too.
+_LENS_WIRE_BYTES, _LENS_WIRE_NS, _LENS_WIRE_COPY = _lens.hop_counters("wire")
+_LENS_SR_BYTES, _LENS_SR_NS, _LENS_SR_COPY = _lens.hop_counters("send_ring")
+
+_LENS_STAGES = {
+    "send": "pair-send",
+    "_send_inner": "pair-send",
+    "_send_fast": "pair-send",
+    "recv_into": "ring-read",
+    "recv": "ring-read",
+    "spin": "poller-wait",
+}
+_profiler.register_stages(__file__, _LENS_STAGES)
 
 _U64 = struct.Struct("<Q")
 
@@ -951,12 +971,22 @@ class Pair:
         if self.state is not PairState.CONNECTED:
             raise BrokenPipeError(f"pair {self.tag} not sendable: {self.state}"
                                   + (f" ({self.error})" if self.error else ""))
+        t0 = time.monotonic_ns()
         if _tracing.LIVE and _tracing.current() is not None:
             # traced call on this thread: the ring-encode interval is the
             # "send-lease" span of the per-RPC timeline (SURVEY §7 #4)
             with _tracing.span("send-lease"):
-                return self._send_traced(slices, byte_idx)
-        return self._send_traced(slices, byte_idx)
+                n = self._send_traced(slices, byte_idx)
+        else:
+            n = self._send_traced(slices, byte_idx)
+        # tpurpc-lens `wire` hop: bytes accepted across the transport
+        # boundary and the nanoseconds the placement (credits + chunking +
+        # ring encode) took — one pair of bumps per send call
+        dt = time.monotonic_ns() - t0
+        _LENS_WIRE_NS.inc(dt)
+        _LENS_WIRE_BYTES.inc(n)
+        _LENS_WIRE_COPY.inc(n)
+        return n
 
     def _send_traced(self, slices: Sequence, byte_idx: int = 0) -> int:
         if _stats.profiling_on():
@@ -1122,6 +1152,7 @@ class Pair:
         # back tail would raise a spurious RingCorruption. The call is
         # GIL-held and bounded, so the hold is short.
         seq_before = writer.seq
+        t0 = time.monotonic_ns()
         with self._credit_lock:
             got = lib.tpr_send_fast(
                 writer._nat_addr, writer.layout.capacity,
@@ -1133,12 +1164,17 @@ class Pair:
             writer.seq = seq.value
             if rh.value > writer.remote_head:
                 writer.remote_head = rh.value
+        dt = time.monotonic_ns() - t0
         if writer.seq > seq_before:  # ring messages this one C call encoded
             _stats.batch_hist("ring_write").record(writer.seq - seq_before)
             # the fused C path bypasses RingWriter.writev, so the registry
-            # totals are bumped here (same counters, same meaning)
+            # totals are bumped here (same counters, same meaning) — and so
+            # are the lens send_ring hop counters
             _MSGS_OUT.inc(writer.seq - seq_before)
             _BYTES_OUT.inc(got)
+            _LENS_SR_BYTES.inc(got)
+            _LENS_SR_NS.inc(dt)
+            _LENS_SR_COPY.inc(got)
         ring_ledger.host_copy(got)
         self.total_sent += got
         total_len = sum(len(v) for v in views)
@@ -1187,7 +1223,7 @@ class Pair:
         cap = self.reader.layout.capacity if self.reader is not None else 0
         buf = bytearray(min(max_bytes, cap))
         n = self.recv_into(buf)
-        del buf[n:]  # truncate in place: bytes(buf[:n]) would copy twice
+        ring_truncate(buf, n)  # in place: bytes(buf[:n]) would copy twice
         return bytes(buf)
 
     def has_message(self) -> bool:

@@ -24,9 +24,21 @@ from tpurpc.analysis.locks import make_condition, make_lock
 from tpurpc.core.pair import Pair, PairState
 from tpurpc.obs import flight as _flight
 from tpurpc.obs import metrics as _metrics
+from tpurpc.obs import profiler as _profiler
 from tpurpc.utils import stats as _stats
 from tpurpc.utils.config import get_config
 from tpurpc.utils.trace import trace_ring
+
+# tpurpc-lens (ISSUE 8) sampling-profiler frame markers: a thread parked
+# or spinning anywhere under these functions is in the poller-wait stage
+_LENS_STAGES = {
+    "_wait": "poller-wait",
+    "wait_readable": "poller-wait",
+    "wait_writable": "poller-wait",
+    "_scan_edges": "poller-wait",
+    "_run": "poller-wait",
+}
+_profiler.register_stages(__file__, _LENS_STAGES)
 
 #: scrape-time gauge: pairs registered with live pollers (the wake/spin/
 #: sleep counters themselves ride _stats.counter_inc → the obs registry)

@@ -47,11 +47,14 @@ from __future__ import annotations
 
 import ctypes
 import struct
+import time
 from typing import Callable, List, Optional, Sequence, Tuple
 
 from tpurpc.core import _native
 from tpurpc.obs import flight as _flight
+from tpurpc.obs import lens as _lens
 from tpurpc.obs import metrics as _metrics
+from tpurpc.obs import profiler as _profiler
 from tpurpc.tpu import ledger
 
 # tpurpc-scope (ISSUE 4): hot counters are cached module-level objects —
@@ -66,6 +69,26 @@ _READERS = _metrics.fleet("ring_credit_unpublished_bytes",
                           lambda r: r.consumed_since_publish)
 _WRITERS = _metrics.fleet("ring_in_flight_bytes",
                           lambda w: w.tail - w.remote_head)
+
+# tpurpc-lens (ISSUE 8): byte-flow waterfall hop counters (bytes / busy_ns
+# / copy_bytes per batched op — ring bytes move by host memcpy, so every
+# accounted byte is also a copy byte) + sampling-profiler frame markers.
+_LENS_SR_BYTES, _LENS_SR_NS, _LENS_SR_COPY = _lens.hop_counters("send_ring")
+_LENS_PR_BYTES, _LENS_PR_NS, _LENS_PR_COPY = _lens.hop_counters("peer_ring")
+
+_LENS_STAGES = {
+    "write": "ring-write",
+    "writev": "ring-write",
+    "write_many": "ring-write",
+    "_writev_native": "ring-write",
+    "read": "ring-read",
+    "read_into": "ring-read",
+    "_read_into_native": "ring-read",
+    "drain_into": "ring-read",
+    "read_many": "ring-read",
+    "scan_complete": "ring-read",
+}
+_profiler.register_stages(__file__, _LENS_STAGES)
 
 ALIGN = 8
 HEADER_BYTES = 8
@@ -90,6 +113,28 @@ def header_stamp(length: int, seq: int) -> int:
 
 def align_up(n: int) -> int:
     return (n + ALIGN - 1) & ~(ALIGN - 1)
+
+
+def truncate_after_read(buf: bytearray, n: int) -> None:
+    """``del buf[n:]`` with a bounded BufferError retry.
+
+    The reader frames that just filled ``buf`` exported memoryviews over
+    it, and a frame that has RETURNED can be kept alive for a sub-
+    millisecond window by anything iterating ``sys._current_frames`` —
+    notably the tpurpc-lens sampling profiler (a held frame object keeps
+    its locals, exports included, until the holder drops it). An in-place
+    resize racing that window raises BufferError; retrying for a few
+    milliseconds is the same trade ``RingReader.release`` makes for the
+    GIL-free spin. The final attempt re-raises honestly."""
+    import time as _t
+
+    for _ in range(200):
+        try:
+            del buf[n:]
+            return
+        except BufferError:
+            _t.sleep(0.0001)
+    del buf[n:]
 
 
 def message_span(payload_len: int) -> int:
@@ -271,6 +316,7 @@ class RingReader:
             return self._read_into_native(dst)
         total = 0
         seq0 = self.seq
+        t0 = time.monotonic_ns()
         while total < len(dst):
             if self._msg_len == 0:
                 ln = self._message_at(self.head, self.seq)
@@ -290,9 +336,13 @@ class RingReader:
                 self._msg_len = 0
                 self._msg_read = 0
                 self.seq += 1
+        dt = time.monotonic_ns() - t0
         ledger.host_copy(total)
         _MSGS_IN.inc(self.seq - seq0)
         _BYTES_IN.inc(total)
+        _LENS_PR_BYTES.inc(total)
+        _LENS_PR_NS.inc(dt)
+        _LENS_PR_COPY.inc(total)
         return total
 
     def _read_into_native(self, dst: memoryview) -> int:
@@ -304,11 +354,13 @@ class RingReader:
         consumed = ctypes.c_uint64(self.consumed_since_publish)
         seq0 = self.seq
         seq = ctypes.c_uint64(self.seq)
+        t0 = time.monotonic_ns()
         n = self._nat.tpr_ring_read_into(
             self._nat_addr, self.layout.capacity,
             ctypes.byref(head), ctypes.byref(msg_len), ctypes.byref(msg_read),
             _native.addr_of(dst, writable=True), len(dst),
             ctypes.byref(consumed), ctypes.byref(seq))
+        dt = time.monotonic_ns() - t0
         if n == 0xFFFFFFFFFFFFFFFF:
             raise RingCorruption(
                 f"invalid header length at offset "
@@ -321,6 +373,9 @@ class RingReader:
         ledger.host_copy(n)
         _MSGS_IN.inc(self.seq - seq0)
         _BYTES_IN.inc(n)
+        _LENS_PR_BYTES.inc(n)
+        _LENS_PR_NS.inc(dt)
+        _LENS_PR_COPY.inc(n)
         return n
 
     def read(self, nbytes: int) -> bytes:
@@ -328,7 +383,7 @@ class RingReader:
         # queued message's framing, and read_into() is about to do that walk anyway.
         out = bytearray(min(nbytes, self.layout.capacity))
         n = self.read_into(out)
-        del out[n:]  # truncate in place: bytes(out[:n]) would copy twice
+        truncate_after_read(out, n)  # in place: bytes(out[:n]) copies twice
         return bytes(out)
 
     # -- batched draining -----------------------------------------------------
@@ -393,6 +448,7 @@ class RingReader:
         seq = self.seq
         msg_len = self._msg_len
         msg_read = self._msg_read
+        t0 = time.monotonic_ns()
         while total < len(dst):
             if msg_len == 0:
                 ln = self._message_at(head, seq)
@@ -411,6 +467,7 @@ class RingReader:
                 seq += 1
                 nmsgs += 1
         # publish the whole batch's progress once
+        dt = time.monotonic_ns() - t0
         self.consumed_since_publish += head - self.head
         self.head = head
         self.seq = seq
@@ -419,6 +476,9 @@ class RingReader:
         ledger.host_copy(total)
         _MSGS_IN.inc(nmsgs)
         _BYTES_IN.inc(total)
+        _LENS_PR_BYTES.inc(total)
+        _LENS_PR_NS.inc(dt)
+        _LENS_PR_COPY.inc(total)
         return total, nmsgs
 
     def read_many(self, max_msgs: Optional[int] = None,
@@ -440,6 +500,7 @@ class RingReader:
             return []
         if max_bytes is None:
             max_bytes = self.layout.capacity
+        t0 = time.monotonic_ns()
         descs, span = self.scan_complete(max_msgs, max_bytes)
         if not descs:
             return []
@@ -451,12 +512,17 @@ class RingReader:
             dst_off += seg_len
         out = [scratch[off - base + HEADER_BYTES:
                        off - base + HEADER_BYTES + ln] for off, ln in descs]
+        dt = time.monotonic_ns() - t0
         self.head = base + span
         self.seq += len(descs)
         self.consumed_since_publish += span
+        payload = sum(ln for _off, ln in descs)
         ledger.host_copy(span)
         _MSGS_IN.inc(len(descs))
-        _BYTES_IN.inc(sum(ln for _off, ln in descs))
+        _BYTES_IN.inc(payload)
+        _LENS_PR_BYTES.inc(payload)
+        _LENS_PR_NS.inc(dt)
+        _LENS_PR_COPY.inc(payload)
         return out
 
     # -- credits ------------------------------------------------------------
@@ -612,6 +678,7 @@ class RingWriter:
         if self._nat is not None:
             return self._writev_native(views, payload_len)
         # Order matters for lock-free completion detection: payload, footer, header.
+        t0 = time.monotonic_ns()
         ledger.host_copy(payload_len)
         off = self.tail + HEADER_BYTES
         for v in views:
@@ -621,10 +688,14 @@ class RingWriter:
         footer_off = self.tail + HEADER_BYTES + align_up(payload_len)
         self._put(footer_off, _U64.pack(footer_stamp(self.seq)))
         self._put(self.tail, _U64.pack(header_stamp(payload_len, self.seq)))
+        dt = time.monotonic_ns() - t0
         self.tail += message_span(payload_len)
         self.seq += 1
         _MSGS_OUT.inc()
         _BYTES_OUT.inc(payload_len)
+        _LENS_SR_BYTES.inc(payload_len)
+        _LENS_SR_NS.inc(dt)
+        _LENS_SR_COPY.inc(payload_len)
         return payload_len
 
 
@@ -679,6 +750,7 @@ class RingWriter:
             _flight.emit(_flight.CREDIT_STARVE_END, self.flight_tag)
         if len(views_per_msg) == 1:
             return 1, self.writev(views_per_msg[0])
+        t0 = time.monotonic_ns()
         total_span = sum(message_span(ln) for ln in lens)
         scratch = memoryview(bytearray(total_span))
         rel = 0
@@ -702,12 +774,16 @@ class RingWriter:
             self._put(self.tail + rel, _U64.pack(header_stamp(ln, seq)))
             rel += message_span(ln)
             seq += 1
+        dt = time.monotonic_ns() - t0
         payload_total = sum(lens)
         ledger.host_copy(payload_total)
         self.tail += total_span
         self.seq = seq
         _MSGS_OUT.inc(len(lens))
         _BYTES_OUT.inc(payload_total)
+        _LENS_SR_BYTES.inc(payload_total)
+        _LENS_SR_NS.inc(dt)
+        _LENS_SR_COPY.inc(payload_total)
         return len(lens), payload_total
 
     def _writev_native(self, views: Sequence[memoryview],
@@ -722,9 +798,11 @@ class RingWriter:
         seg_lens = (ctypes.c_uint64 * n)(*[len(v) for v in views])
         tail = ctypes.c_uint64(self.tail)
         seq = ctypes.c_uint64(self.seq)
+        t0 = time.monotonic_ns()
         got = self._nat.tpr_ring_writev(
             self._nat_addr, self.layout.capacity, ctypes.byref(tail),
             self.remote_head, seg_ptrs, seg_lens, n, ctypes.byref(seq))
+        dt = time.monotonic_ns() - t0
         if got == 0xFFFFFFFFFFFFFFFF:
             raise RingFull(payload_len, self.writable_payload())
         self.tail = tail.value
@@ -732,6 +810,9 @@ class RingWriter:
         ledger.host_copy(got)
         _MSGS_OUT.inc()
         _BYTES_OUT.inc(got)
+        _LENS_SR_BYTES.inc(got)
+        _LENS_SR_NS.inc(dt)
+        _LENS_SR_COPY.inc(got)
         return got
 
 

@@ -22,9 +22,32 @@ from __future__ import annotations
 
 import json
 import struct
+import time
 from typing import Any, List, Optional, Tuple
 
 import numpy as np
+
+from tpurpc.obs import lens as _lens
+from tpurpc.obs import profiler as _profiler
+
+# tpurpc-lens (ISSUE 8) waterfall hops on the codec boundary: `device` is
+# the serialize leg (device/host tensor bytes gathered into wire form),
+# `decode` the parse back, `jax_array` the final materialization. One bump
+# set per tensor record / tree record — never per byte.
+_LENS_DEV_BYTES, _LENS_DEV_NS, _LENS_DEV_COPY = _lens.hop_counters("device")
+_LENS_DEC_BYTES, _LENS_DEC_NS, _LENS_DEC_COPY = _lens.hop_counters("decode")
+_LENS_JAX_BYTES, _LENS_JAX_NS, _LENS_JAX_COPY = _lens.hop_counters(
+    "jax_array")
+
+_LENS_STAGES = {
+    "encode_tensor": "codec",
+    "encode_tree": "codec",
+    "decode_tensor": "codec",
+    "decode_tree_at": "codec",
+    "decode_tree_many": "codec",
+    "to_jax": "device-dispatch",
+}
+_profiler.register_stages(__file__, _LENS_STAGES)
 
 try:  # bfloat16 et al. — baked into the image alongside jax
     import ml_dtypes
@@ -96,13 +119,24 @@ def encode_tensor(x) -> List[bytes]:
     (reference: ``PairPollable::Send`` builds one doorbell from a grpc_slice*
     gather list, ``ibverbs/pair.cc:645-734``).
     """
+    t0 = time.monotonic_ns()
     arr = _as_numpy(x)
+    # contiguity copies are provable for ndarray inputs (ascontiguousarray
+    # returns the same object when it aliased); a jax input's d2h gather is
+    # the ledger's jurisdiction, not double-counted here
+    materialized = isinstance(x, np.ndarray) and arr is not x
     code = dtype_code(arr.dtype)
     dims = struct.pack(f"<{arr.ndim}q", *arr.shape) if arr.ndim else b""
     head = _HDR.pack(MAGIC, code, arr.ndim, 0, arr.nbytes) + dims
     pad = (-len(head)) % _ALIGN
     head += b"\x00" * pad
     payload = arr.reshape(-1).view(np.uint8).data  # memoryview, no copy
+    dt = time.monotonic_ns() - t0
+    nbytes = arr.nbytes
+    _LENS_DEV_NS.inc(dt)
+    _LENS_DEV_BYTES.inc(nbytes)
+    if materialized:
+        _LENS_DEV_COPY.inc(nbytes)
     return [head, payload]
 
 
@@ -160,18 +194,27 @@ def to_jax(arr: np.ndarray):
 
     from tpurpc.tpu import ledger
 
-    if not arr.flags.writeable:
-        # jax dlpack import refuses read-only buffers; device_put instead
-        # (still a single copy onto device / into the backend arena).
-        ledger.dma_h2d(arr.nbytes)
-        return jax.device_put(arr)
+    t0 = time.monotonic_ns()
+    nbytes = arr.nbytes
     try:
-        out = jax.dlpack.from_dlpack(arr)
-        ledger.zero_copy(arr.nbytes)
-        return out
-    except (TypeError, RuntimeError, ValueError):
-        ledger.dma_h2d(arr.nbytes)
-        return jax.device_put(arr)
+        if not arr.flags.writeable:
+            # jax dlpack import refuses read-only buffers; device_put
+            # instead (still a single copy onto device / into the arena).
+            ledger.dma_h2d(nbytes)
+            _LENS_JAX_COPY.inc(nbytes)
+            return jax.device_put(arr)
+        try:
+            out = jax.dlpack.from_dlpack(arr)
+            ledger.zero_copy(nbytes)
+            return out
+        except (TypeError, RuntimeError, ValueError):
+            ledger.dma_h2d(nbytes)
+            _LENS_JAX_COPY.inc(nbytes)
+            return jax.device_put(arr)
+    finally:
+        dt = time.monotonic_ns() - t0
+        _LENS_JAX_NS.inc(dt)
+        _LENS_JAX_BYTES.inc(nbytes)
 
 
 # ---------------------------------------------------------------------------
@@ -228,6 +271,7 @@ def decode_tree_at(buf, offset: int = 0, copy: bool = False,
     """
     import jax
 
+    t0 = time.monotonic_ns()
     view = memoryview(buf)
     if len(view) - offset < _TREE.size:
         raise CodecError("short tree header")
@@ -236,9 +280,11 @@ def decode_tree_at(buf, offset: int = 0, copy: bool = False,
         raise CodecError(f"bad tree magic {magic!r}")
     pos = offset + _TREE.size + ((-_TREE.size) % _ALIGN)
     leaves = []
+    payload = 0
     for _ in range(n):
         arr, pos = decode_tensor(view, pos, copy=copy)
         pos += (-(pos - offset)) % _ALIGN
+        payload += arr.nbytes
         leaves.append(to_jax(arr) if as_jax else arr)
     # Trailer sits at the decode cursor — never measure from the buffer end;
     # zero-copy receive windows may carry ring-alignment slack behind it.
@@ -246,7 +292,15 @@ def decode_tree_at(buf, offset: int = 0, copy: bool = False,
         raise CodecError("short tree trailer")
     trailer = view[pos:pos + trailer_len].tobytes()
     treedef = _treedef_from_json(json.loads(trailer.decode()))
-    return jax.tree_util.tree_unflatten(treedef, leaves), pos + trailer_len
+    out = jax.tree_util.tree_unflatten(treedef, leaves), pos + trailer_len
+    # tpurpc-lens `decode` hop: one bump set per tree record (to_jax's
+    # share is also visible on its own jax_array row — hops may nest)
+    dt = time.monotonic_ns() - t0
+    _LENS_DEC_NS.inc(dt)
+    _LENS_DEC_BYTES.inc(payload)
+    if copy:
+        _LENS_DEC_COPY.inc(payload)
+    return out
 
 
 def decode_tree_many(buf, count: Optional[int] = None, copy: bool = False,
@@ -357,7 +411,12 @@ def tensor_serializer(x) -> List[bytes]:
 
 
 def tensor_deserializer(buf) -> np.ndarray:
+    t0 = time.monotonic_ns()
     arr, _ = decode_tensor(buf)
+    dt = time.monotonic_ns() - t0
+    nbytes = arr.nbytes
+    _LENS_DEC_NS.inc(dt)
+    _LENS_DEC_BYTES.inc(nbytes)
     return arr
 
 

@@ -26,6 +26,7 @@ import numpy as np
 from tpurpc.jaxshim import codec
 from tpurpc.obs import flight as _flight
 from tpurpc.obs import metrics as _metrics
+from tpurpc.obs import profiler as _profiler
 from tpurpc.obs import tracing as _tracing
 from tpurpc.rpc.server import (Server, stream_stream_rpc_method_handler,
                                unary_stream_rpc_method_handler,
@@ -33,6 +34,21 @@ from tpurpc.rpc.server import (Server, stream_stream_rpc_method_handler,
 from tpurpc.utils.trace import TraceFlag
 
 trace_jax = TraceFlag("jaxshim")
+
+# tpurpc-lens (ISSUE 8) sampling-profiler frame markers: batching control
+# flow is `batcher`, running a gathered batch on the model/device (and the
+# cross-shard merged dispatch) is `device-dispatch`
+_LENS_STAGES = {
+    "_loop": "batcher",
+    "_split_compatible": "batcher",
+    "_concat_pad": "batcher",
+    "_complete_loop": "batcher",
+    "_run": "device-dispatch",
+    "_merge_loop": "batcher",
+    "_dispatch_group": "device-dispatch",
+    "_run_one": "device-dispatch",
+}
+_profiler.register_stages(__file__, _LENS_STAGES)
 
 # tpurpc-scope (ISSUE 4): fan-in batching observability. One histogram
 # record + one counter bump per DISPATCHED BATCH (amortized by design);

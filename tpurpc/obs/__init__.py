@@ -34,13 +34,27 @@ tpurpc-blackbox (ISSUE 5) adds the POSTMORTEM faces on top:
   call was slow, errored, or watchdog-flagged: ``TPURPC_TRACE_SAMPLE=0``
   still yields a full span tree for every pathological call.
 
+tpurpc-lens (ISSUE 8) adds the PERFORMANCE-ATTRIBUTION faces:
+
+* :mod:`tpurpc.obs.profiler` — a continuous stage-tagged sampling
+  profiler: thread stacks sampled at ~50 Hz and mapped to pipeline stages
+  via a static frame-marker registry; per-stage shares + collapsed stacks
+  at ``GET /debug/profile``.
+* :mod:`tpurpc.obs.lens` — the byte-flow waterfall: per-hop (device →
+  send ring → wire → peer ring → decode → hbm → jax.Array) bytes/busy-ns
+  counters whose scrape-time ratio is each hop's effective GB/s; the
+  argmin names the bottleneck. ``GET /debug/waterfall``.
+* ``python -m tpurpc.tools.timeline`` — one Perfetto trace for a whole
+  deployment: spans + flight edges + CPU samples from every shard/fleet
+  member, aligned on per-process monotonic↔wall clock anchors.
+
 The reference fork's whole debugging story was trace flags plus a
 shutdown-time profiler table (SURVEY.md §5, ``stats_time.cc``); tpurpc-scope
-replaces post-hoc printf with always-on, near-free telemetry, and
-tpurpc-blackbox makes the rare-event failures it samples away recoverable
-after the fact.
+replaces post-hoc printf with always-on, near-free telemetry, tpurpc-blackbox
+makes the rare-event failures it samples away recoverable after the fact,
+and tpurpc-lens says where the cycles and bytes actually go.
 """
 
-from tpurpc.obs import flight, metrics, tracing  # noqa: F401
+from tpurpc.obs import flight, lens, metrics, profiler, tracing  # noqa: F401
 
-__all__ = ["flight", "metrics", "tracing"]
+__all__ = ["flight", "lens", "metrics", "profiler", "tracing"]

@@ -1,0 +1,180 @@
+"""tpurpc-lens byte-flow waterfall: per-hop byte/nanosecond attribution.
+
+ROADMAP item 2's question is "streaming runs at 1.72 GB/s against an
+8.5 GB/s memcpy ceiling — WHICH hop eats the gap?", and nothing in the
+telemetry stack could answer it: the registry counts bytes per subsystem
+and the copy ledger counts bytes per mechanism, but neither says how much
+*time* each hop of the streaming path spent moving those bytes. The
+waterfall is that instrument: every hop of the data path carries a pair of
+always-on registry counters — bytes moved and busy nanoseconds — and the
+scrape-time division ``bytes / busy_ns`` is that hop's effective GB/s
+(B/ns ≡ GB/s, no unit conversion). The hop with the lowest effective rate
+under load is, by construction, the one to attack.
+
+The hop chain, in data-flow order (the ISSUE 8 vocabulary)::
+
+    device     serialize: tensor bytes gathered host-side into wire form
+               (jaxshim/codec.py encode — the device→host leg)
+    send_ring  RingWriter placement into the peer's receive ring
+               (core/ring.py writev/write_many + the fused native send)
+    wire       bytes crossing the transport boundary: the pair-plane
+               one-sided send (core/pair.py Pair.send, credit machinery
+               included) and TCP socket writes (core/endpoint.py)
+    peer_ring  RingReader drain out of the local receive ring
+               (core/ring.py read_into/drain_into/read_many)
+    decode     codec parse of wire bytes back into tensors
+               (jaxshim/codec.py decode_tree_at, tpu/endpoint.py
+               decode_tree_to_ring)
+    hbm        placement into the device-resident landing ring
+               (tpu/hbm_ring.py place/place_many)
+    jax_array  materialization as jax.Array — dlpack alias or the
+               device_put staging copy (jaxshim/codec.py to_jax)
+
+Cost model — why this is ALWAYS on, like the rest of the obs stack:
+
+* accounting sites run once per **batched operation** (a drain, a gathered
+  writev, a tree decode), never per byte: two ``time.monotonic_ns`` reads
+  and two/three GIL-atomic Counter bumps per op;
+* the counters are plain registry Counters, cached as module globals at
+  import by every instrumented module (the ``stage`` lint rule enforces
+  the pure-int plumbing contract at each site, exactly as the ``flight``
+  rule does for the recorder);
+* hops may NEST (``wire`` wraps ``send_ring`` on the pair plane;
+  ``decode`` wraps ``jax_array``): the table is a waterfall of per-hop
+  effective rates, not a disjoint partition of wall time. The invariant
+  that matters holds regardless: every hop's effective GB/s is an upper
+  bound on the end-to-end rate through it, so the MINIMUM names the
+  bottleneck.
+
+The copy ledger is folded in: each hop row carries ``copy_bytes`` (bytes
+that hop moved via a host memcpy / staging copy) so the table shows copies
+alongside throughput — a hop running fast *because* it aliases reads
+differently from one running fast while copying.
+
+Served at ``GET /debug/waterfall`` (``?text=1`` for the table rendering,
+``?local=1`` per-shard), merged across shard workers by the PR 7 fan-out,
+rendered live by ``python -m tpurpc.tools.top``, and recorded into the
+bench artifact (``waterfall_gbps_by_hop`` + ``waterfall_slowest_hop``).
+
+``TPURPC_LENS=0`` switches the lens plane off (the sampling profiler stops
+and the scrape routes answer 404-style disabled docs); the hop counters
+themselves are branch-free and stay live — they are the same class of
+always-on accounting as ``ring_bytes_read``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from tpurpc.obs import metrics as _metrics
+
+__all__ = [
+    "HOPS", "HOP_NAMES", "hop_counters", "enabled", "waterfall",
+    "render_text", "slowest_hop",
+]
+
+#: the declared hop registry, in data-flow order: (name, accounting site /
+#: what the hop means). Append-only — names land in scrape output and
+#: bench artifacts.
+HOPS: Tuple[Tuple[str, str], ...] = (
+    ("device", "serialize: tensor bytes gathered into wire form "
+               "(codec encode, the device→host leg)"),
+    ("send_ring", "RingWriter placement into the peer's receive ring"),
+    ("wire", "transport boundary: pair one-sided send / TCP socket write"),
+    ("peer_ring", "RingReader drain out of the local receive ring"),
+    ("decode", "codec parse of wire bytes back into tensors"),
+    ("hbm", "placement into the device-resident HBM landing ring"),
+    ("jax_array", "materialization as jax.Array (dlpack alias or "
+                  "device_put staging)"),
+)
+
+HOP_NAMES: Tuple[str, ...] = tuple(name for name, _ in HOPS)
+
+_BYTES: Dict[str, _metrics.Counter] = {}
+_NS: Dict[str, _metrics.Counter] = {}
+_COPY: Dict[str, _metrics.Counter] = {}
+for _name, _desc in HOPS:
+    _BYTES[_name] = _metrics.counter(f"lens_{_name}_bytes")
+    _NS[_name] = _metrics.counter(f"lens_{_name}_busy_ns")
+    _COPY[_name] = _metrics.counter(f"lens_{_name}_copy_bytes")
+
+
+def hop_counters(name: str) -> Tuple[_metrics.Counter, _metrics.Counter,
+                                     _metrics.Counter]:
+    """The ``(bytes, busy_ns, copy_bytes)`` counter triple for one declared
+    hop. Instrumented modules call this ONCE at import (module-level, a
+    string-constant hop name — the ``stage`` lint rule checks both) and
+    cache the counters as globals; the per-op cost is then the bumps alone.
+    """
+    if name not in _BYTES:
+        raise ValueError(f"unknown waterfall hop {name!r}; "
+                         f"declared hops: {HOP_NAMES}")
+    return _BYTES[name], _NS[name], _COPY[name]
+
+
+def enabled() -> bool:
+    """The lens master switch (``TPURPC_LENS=0`` off). Gates the sampling
+    profiler and the scrape routes; the hop counters are branch-free
+    always-on accounting and ignore it."""
+    from tpurpc.utils.config import _env
+
+    return (_env("TPURPC_LENS") or "1").lower() not in ("0", "off", "false")
+
+
+# -- scrape-time export -------------------------------------------------------
+
+def waterfall() -> dict:
+    """The per-hop effective-throughput table, sampled from the counters at
+    call time. ``gbps`` is ``bytes / busy_ns`` (identical units); a hop
+    that has seen no traffic reports zeros and is excluded from the
+    bottleneck argmin."""
+    rows: List[dict] = []
+    for name, desc in HOPS:
+        b = _BYTES[name].snapshot()
+        ns = _NS[name].snapshot()
+        cp = _COPY[name].snapshot()
+        rows.append({
+            "hop": name,
+            "bytes": b,
+            "busy_ms": round(ns / 1e6, 3),
+            "gbps": round(b / ns, 3) if ns else 0.0,
+            "copy_bytes": cp,
+            "what": desc,
+        })
+    out = {"hops": rows, "slowest_hop": slowest_hop(rows)}
+    try:
+        from tpurpc.tpu import ledger
+
+        out["ledger"] = ledger.snapshot()
+    except Exception:
+        pass
+    return out
+
+
+def slowest_hop(rows: Optional[List[dict]] = None) -> Optional[str]:
+    """The bottleneck hop: lowest effective GB/s among hops that actually
+    moved bytes (and spent time doing it). None before any traffic."""
+    if rows is None:
+        rows = waterfall()["hops"]
+    live = [r for r in rows if r["bytes"] > 0 and r["busy_ms"] > 0]
+    if not live:
+        return None
+    return min(live, key=lambda r: r["gbps"])["hop"]
+
+
+def render_text(doc: Optional[dict] = None) -> str:
+    """Human rendering of the waterfall (``?text=1`` / tools.top pane)."""
+    doc = doc if doc is not None else waterfall()
+    rows = doc["hops"]
+    lines = [f"{'hop':<10} {'GB/s':>8} {'MiB':>10} {'busy_ms':>10} "
+             f"{'copy_MiB':>9}  what"]
+    lines.append("-" * len(lines[0]))
+    for r in rows:
+        mark = " <-- slowest" if r["hop"] == doc.get("slowest_hop") else ""
+        lines.append(
+            f"{r['hop']:<10} {r['gbps']:>8.3f} "
+            f"{r['bytes'] / (1 << 20):>10.1f} {r['busy_ms']:>10.1f} "
+            f"{r['copy_bytes'] / (1 << 20):>9.1f}  {r['what'][:46]}{mark}")
+    if doc.get("slowest_hop") is None:
+        lines.append("(no traffic yet: every hop idle)")
+    return "\n".join(lines) + "\n"

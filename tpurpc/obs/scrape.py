@@ -25,14 +25,26 @@ Routes::
     /debug/flight  flight-recorder replay: JSON event list (?text=1 for the
                    human rendering, ?since_ns=N to bound)
     /debug/stalls  stall-watchdog diagnoses: active + recent history JSON
+    /debug/profile tpurpc-lens stage-tagged sampling profiler: per-stage
+                   sample shares + top collapsed stacks (?collapsed=1 for
+                   flamegraph.pl text, ?samples=1 to include the recent
+                   raw samples the timeline tool renders)
+    /debug/waterfall  tpurpc-lens byte-flow waterfall: per-hop effective
+                   GB/s with the copy ledger folded in (?text=1 table)
+
+tpurpc-lens (ISSUE 8): every ``_route`` dispatch records its own cost into
+the ``scrape_us`` latency histogram — the concurrent-scraper test asserts
+scrape work shows up THERE, not in serving p99.
 """
 
 from __future__ import annotations
 
 import json
+import time as _time
 from typing import List, Optional, Tuple
 
 from tpurpc.obs import metrics as _metrics
+from tpurpc.obs import profiler as _obs_profiler
 from tpurpc.obs import tracing as _tracing
 
 PREFIX = "tpurpc_"
@@ -40,6 +52,20 @@ PREFIX = "tpurpc_"
 #: HTTP request-line openers the server sniff routes here (8-byte prefixes
 #: compared against the sniffed first bytes)
 HTTP_METHOD_PREFIXES = (b"GET ", b"HEAD")
+
+#: tpurpc-lens: what one scrape costs, measured where it runs (the sniff /
+#: http threads) — so scrape load is attributable without touching serving
+#: latency histograms
+_SCRAPE_US = _metrics.histogram("scrape_us", kind="latency")
+
+#: sampling-profiler frame markers: scrape rendering is its own stage
+_LENS_STAGES = {
+    "handle_http": "scrape",
+    "render_prometheus": "scrape",
+    "_route": "scrape",
+    "route_local": "scrape",
+}
+_obs_profiler.register_stages(__file__, _LENS_STAGES)
 
 
 def scrape_enabled() -> bool:
@@ -159,20 +185,25 @@ def _route(path: str) -> Tuple[int, str, bytes]:
     """(status, content_type, body) for one GET path.
 
     tpurpc-manycore: in a shard worker, the aggregate-aware routes
-    (/metrics, /debug/flight, /debug/stalls, /healthz) merge EVERY live
-    worker's view — one GET on the serving port tells the whole truth no
-    matter which shard the accept spread picked. ``?local=1`` serves this
-    worker alone (it is also the recursion guard for peer fetches)."""
-    route, _, query = path.partition("?")
-    params = _query_params(query)
-    if not params.get("local"):
-        from tpurpc.obs import shard as _shard
+    (/metrics, /traces, /debug/flight, /debug/stalls, /debug/profile,
+    /debug/waterfall, /healthz) merge EVERY live worker's view — one GET on
+    the serving port tells the whole truth no matter which shard the accept
+    spread picked. ``?local=1`` serves this worker alone (it is also the
+    recursion guard for peer fetches)."""
+    t0 = _time.monotonic_ns()
+    try:
+        route, _, query = path.partition("?")
+        params = _query_params(query)
+        if not params.get("local"):
+            from tpurpc.obs import shard as _shard
 
-        if _shard.sharded():
-            agg = _shard.route_aggregate(route, params)
-            if agg is not None:
-                return agg
-    return route_local(path)
+            if _shard.sharded():
+                agg = _shard.route_aggregate(route, params)
+                if agg is not None:
+                    return agg
+        return route_local(path)
+    finally:
+        _SCRAPE_US.record((_time.monotonic_ns() - t0) // 1000)
 
 
 def route_local(path: str) -> Tuple[int, str, bytes]:
@@ -227,6 +258,30 @@ def route_local(path: str) -> Tuple[int, str, bytes]:
 
         return (200, "application/json",
                 json.dumps(_watchdog.get().snapshot(), indent=1).encode())
+    if route in ("/debug/profile", "/debug/profile/"):
+        from tpurpc.obs import lens as _lens
+
+        params = _query_params(query)
+        if not _lens.enabled():
+            return (200, "application/json",
+                    json.dumps({"enabled": False,
+                                "reason": "TPURPC_LENS=0"}).encode())
+        _obs_profiler.ensure_started()  # client-only processes: first scrape
+        if params.get("collapsed"):
+            return (200, "text/plain",
+                    _obs_profiler.collapsed_text().encode())
+        snap = _obs_profiler.snapshot(
+            include_samples=bool(params.get("samples")))
+        snap["enabled"] = True
+        return 200, "application/json", json.dumps(snap).encode()
+    if route in ("/debug/waterfall", "/debug/waterfall/"):
+        from tpurpc.obs import lens as _lens
+
+        params = _query_params(query)
+        if params.get("text"):
+            return 200, "text/plain", _lens.render_text().encode()
+        return (200, "application/json",
+                json.dumps(_lens.waterfall()).encode())
     if route in ("/channelz", "/channelz/"):
         from tpurpc.rpc import channelz
 
@@ -245,7 +300,7 @@ def route_local(path: str) -> Tuple[int, str, bytes]:
         return 200, "application/json", body
     return (404, "text/plain",
             b"tpurpc-scope: /metrics /traces /channelz /healthz "
-            b"/debug/flight /debug/stalls\n")
+            b"/debug/flight /debug/stalls /debug/profile /debug/waterfall\n")
 
 
 def _response(status: int, ctype: str, body: bytes,

@@ -4,9 +4,11 @@ A sharded server (``tpurpc.rpc.shard.ShardedServer``) runs N worker
 PROCESSES, each owning its poller, rings, batcher, and — crucially for this
 module — its own metrics registry, flight ring, and watchdog. Telemetry
 that only describes one worker is useless to an operator who scraped
-"the server": this module makes ONE ``GET /metrics`` (or ``/debug/flight``,
-``/debug/stalls``, ``/healthz``) on the serving port tell the whole truth,
-whichever worker the kernel's accept spread happened to hand the scrape to.
+"the server": this module makes ONE ``GET /metrics`` (or ``/traces``,
+``/debug/flight``, ``/debug/stalls``, ``/debug/profile``,
+``/debug/waterfall``, ``/healthz``) on the serving port tell the whole
+truth, whichever worker the kernel's accept spread happened to hand the
+scrape to.
 
 Mechanics:
 
@@ -36,7 +38,8 @@ from typing import Dict, List, Optional, Tuple
 __all__ = [
     "set_identity", "shard_id", "n_shards", "set_peers", "peers",
     "sharded", "route_aggregate", "aggregate_metrics", "aggregate_flight",
-    "aggregate_stalls", "aggregate_healthz",
+    "aggregate_stalls", "aggregate_healthz", "aggregate_traces",
+    "aggregate_profile", "aggregate_waterfall",
 ]
 
 _lock = threading.Lock()
@@ -211,6 +214,125 @@ def aggregate_flight_text(since_ns: int = 0) -> str:
     return "\n".join(lines) + "\n"
 
 
+# -- /traces ------------------------------------------------------------------
+
+def aggregate_traces(trace_id: str = "") -> dict:
+    """Every reachable shard's span buffer in ONE chrome-trace document
+    (tpurpc-lens, ISSUE 8 — before this, a trace born on shard 2 was
+    invisible on the serving port). Each shard becomes its own process
+    lane: its events are re-pid'd to the shard id, its ``process_name``
+    metadata renamed, and its monotonic↔wall :func:`clock anchor
+    <tpurpc.obs.tracing.clock_anchor>` preserved per shard under
+    ``clock_anchors`` — timestamps stay in each worker's monotonic clock
+    here (the timeline tool rebases; fork-inherited CLOCK_MONOTONIC is
+    system-wide on Linux, so same-host lanes already line up)."""
+    events: List[dict] = []
+    anchors: Dict[str, dict] = {}
+    up: List[int] = []
+    q = f"&trace_id={trace_id}" if trace_id else ""
+    for k, status, body in _each_shard(f"/traces?local=1{q}"):
+        if status != 200:
+            continue
+        try:
+            doc = json.loads(body)
+        except ValueError:
+            continue
+        up.append(k)
+        anchor = doc.get("clock_anchor")
+        if anchor:
+            anchors[str(k)] = anchor
+        for e in doc.get("traceEvents", ()):
+            e["pid"] = k
+            if e.get("ph") == "M" and e.get("name") == "process_name":
+                e.setdefault("args", {})["name"] = f"tpurpc shard {k}"
+            events.append(e)
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "clock_anchors": anchors, "shards": up}
+
+
+# -- /debug/profile -----------------------------------------------------------
+
+def aggregate_profile(include_samples: bool = False) -> dict:
+    """Per-shard profiler snapshots plus a merged per-stage sample count —
+    the serving-port answer to "where do the cycles go, fleet-wide"."""
+    shards: Dict[str, dict] = {}
+    stages: Dict[str, int] = {}
+    samples = 0
+    q = "&samples=1" if include_samples else ""
+    for k, status, body in _each_shard(f"/debug/profile?local=1{q}"):
+        if status != 200:
+            continue
+        try:
+            snap = json.loads(body)
+        except ValueError:
+            continue
+        shards[str(k)] = snap
+        samples += int(snap.get("samples") or 0)
+        for stage, n in (snap.get("stages") or {}).items():
+            stages[stage] = stages.get(stage, 0) + int(n)
+    other = stages.get("other", 0)
+    unatt = stages.get("unattributed", 0)
+    denom = samples - other
+    return {"shards": shards, "stages": stages, "samples": samples,
+            "attributed_pct": (round((denom - unatt) / denom * 100, 1)
+                               if denom else 0.0),
+            "enabled": any(s.get("enabled") for s in shards.values())}
+
+
+def aggregate_profile_collapsed() -> str:
+    """Merged collapsed stacks, each line prefixed ``shard-k;`` so one
+    flamegraph shows every worker side by side."""
+    lines: List[str] = []
+    for k, status, body in _each_shard("/debug/profile?local=1&collapsed=1"):
+        if status != 200:
+            continue
+        for line in body.decode("utf-8", errors="replace").splitlines():
+            if line:
+                lines.append(f"shard-{k};{line}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+# -- /debug/waterfall ---------------------------------------------------------
+
+def aggregate_waterfall() -> dict:
+    """Per-shard waterfalls plus a merged hop table (bytes and busy_ns sum
+    across workers; effective GB/s recomputed over the sums — N workers
+    each moving b bytes in t ns aggregate to Nb/Nt, the same rate, not an
+    inflated one)."""
+    shards: Dict[str, dict] = {}
+    merged: Dict[str, dict] = {}
+    order: List[str] = []
+    for k, status, body in _each_shard("/debug/waterfall?local=1"):
+        if status != 200:
+            continue
+        try:
+            doc = json.loads(body)
+        except ValueError:
+            continue
+        shards[str(k)] = doc
+        for row in doc.get("hops", ()):
+            hop = row.get("hop")
+            if hop not in merged:
+                merged[hop] = {"hop": hop, "bytes": 0, "busy_ms": 0.0,
+                               "copy_bytes": 0, "what": row.get("what", "")}
+                order.append(hop)
+            merged[hop]["bytes"] += int(row.get("bytes") or 0)
+            merged[hop]["busy_ms"] += float(row.get("busy_ms") or 0.0)
+            merged[hop]["copy_bytes"] += int(row.get("copy_bytes") or 0)
+    rows = []
+    for hop in order:
+        r = merged[hop]
+        ns = r["busy_ms"] * 1e6
+        r["gbps"] = round(r["bytes"] / ns, 3) if ns else 0.0
+        r["busy_ms"] = round(r["busy_ms"], 3)
+        rows.append(r)
+    live = [r for r in rows if r["bytes"] > 0 and r["busy_ms"] > 0]
+    return {"hops": rows,
+            "slowest_hop": (min(live, key=lambda r: r["gbps"])["hop"]
+                            if live else None),
+            "shards": shards}
+
+
 # -- /debug/stalls ------------------------------------------------------------
 
 def aggregate_stalls() -> dict:
@@ -267,10 +389,29 @@ def route_aggregate(route: str, params: dict
                     ) -> Optional[Tuple[int, str, bytes]]:
     """The scrape plane's shard hook: the merged ``(status, ctype, body)``
     for an aggregate-aware route, or None for routes served locally
-    (/traces and /channelz stay per-worker — span buffers and channelz
-    entities are process-scoped by design; scrape them via ?local=1 on a
-    worker's own scrape port when debugging one shard)."""
+    (/channelz stays per-worker — channelz entities are process-scoped by
+    design; scrape it via ?local=1 on a worker's own scrape port when
+    debugging one shard). tpurpc-lens (ISSUE 8) added /traces,
+    /debug/profile and /debug/waterfall to the fan-out: a trace or a hot
+    stage born on shard 2 must be visible on the serving port."""
     try:
+        if route in ("/traces", "/traces/"):
+            doc = aggregate_traces(trace_id=params.get("trace_id") or "")
+            return 200, "application/json", json.dumps(doc).encode()
+        if route in ("/debug/profile", "/debug/profile/"):
+            if params.get("collapsed"):
+                return (200, "text/plain",
+                        aggregate_profile_collapsed().encode())
+            doc = aggregate_profile(
+                include_samples=bool(params.get("samples")))
+            return 200, "application/json", json.dumps(doc).encode()
+        if route in ("/debug/waterfall", "/debug/waterfall/"):
+            doc = aggregate_waterfall()
+            if params.get("text"):
+                from tpurpc.obs import lens as _lens
+
+                return 200, "text/plain", _lens.render_text(doc).encode()
+            return 200, "application/json", json.dumps(doc).encode()
         if route in ("/metrics", "/metrics/"):
             return 200, "text/plain; version=0.0.4", aggregate_metrics().encode()
         if route in ("/debug/flight", "/debug/flight/"):

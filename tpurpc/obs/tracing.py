@@ -53,7 +53,7 @@ __all__ = [
     "HEADER", "ACTIVE", "LIVE", "TraceContext", "configure", "force",
     "tail", "maybe_sample", "current", "adopt", "use", "span", "begin",
     "finish", "record", "tail_decide", "tail_flag", "tail_pending",
-    "spans", "span_tree", "chrome_trace", "reset",
+    "spans", "span_tree", "chrome_trace", "clock_anchor", "reset",
 ]
 
 #: metadata key the context rides in (text — works across the h2 plane's
@@ -497,12 +497,37 @@ def span_tree(trace_id: "int | str") -> Dict:
     return {"trace_id": tid, "spans": roots}
 
 
+def clock_anchor() -> Dict:
+    """This process's monotonic↔wallclock anchor (tpurpc-lens, ISSUE 8).
+
+    Span/flight timestamps are ``time.monotonic_ns`` — correct for
+    durations, but each process has its OWN monotonic epoch, so traces
+    exported by different processes (shard workers, fleet members) cannot
+    be merged by raw ``ts``. The anchor is one simultaneous reading of both
+    clocks: a collector rebases any monotonic stamp from this process onto
+    the shared wall clock as ``wall = t_mono - mono_ns + wall_ns``. The
+    wall read is bracketed by two monotonic reads and paired with their
+    midpoint, bounding the skew to half the bracket width."""
+    import os
+
+    m0 = time.monotonic_ns()
+    wall = time.time_ns()  # tpr: allow(wallclock) — the anchor IS absolute
+    m1 = time.monotonic_ns()
+    return {"pid": os.getpid(), "mono_ns": (m0 + m1) // 2, "wall_ns": wall,
+            "uncertainty_ns": m1 - m0}
+
+
 def chrome_trace(trace_id: "Optional[int | str]" = None) -> Dict:
     """Chrome ``trace_event`` JSON (perfetto / chrome://tracing): complete
     ("X") events with microsecond timestamps, one row per recording
     thread, plus the ``process_name``/``thread_name`` metadata ("M")
     events — without them perfetto renders bare pid/tid numbers instead of
-    named lanes. Span attrs pass through as ``args``."""
+    named lanes. Span attrs pass through as ``args``.
+
+    The top-level ``clock_anchor`` (chrome-trace tolerates extra keys) is
+    this process's monotonic↔wall pairing — the piece that lets
+    ``python -m tpurpc.tools.timeline`` align traces exported by different
+    processes onto one wall-clock axis (see :func:`clock_anchor`)."""
     events: List[Dict] = [{
         "ph": "M", "name": "process_name", "pid": 1, "tid": 0,
         "args": {"name": "tpurpc"},
@@ -528,7 +553,8 @@ def chrome_trace(trace_id: "Optional[int | str]" = None) -> Dict:
                          trace_id=d["trace_id"],
                          span_id=d["span_id"]),
         })
-    return {"traceEvents": events, "displayTimeUnit": "ms"}
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "clock_anchor": clock_anchor()}
 
 
 def reset() -> None:

@@ -44,9 +44,15 @@ from typing import Dict, List, Optional
 
 from tpurpc.obs import flight as _flight
 from tpurpc.obs import metrics as _metrics
+from tpurpc.obs import profiler as _obs_profiler
 
 __all__ = ["StallWatchdog", "get", "call_started", "call_finished",
            "STAGES"]
+
+#: tpurpc-lens: the sweeper thread parked between sweeps is infrastructure
+#: idle time, not unattributed serving work
+_LENS_STAGES = {"_loop": "idle", "sweep_once": "idle"}
+_obs_profiler.register_stages(__file__, _LENS_STAGES)
 
 _log = logging.getLogger("tpurpc.watchdog")
 

@@ -29,8 +29,20 @@ import struct
 from typing import List, Optional, Sequence, Tuple
 
 from tpurpc.core.endpoint import Endpoint
+from tpurpc.obs import profiler as _profiler
 from tpurpc.rpc.status import StatusCode
 from tpurpc.tpu import ledger as _ledger
+
+# tpurpc-lens (ISSUE 8): native-framing encode + the coalescing writev
+# flusher are h2-framing-stage work for the sampling profiler
+_LENS_STAGES = {
+    "send": "h2-framing",
+    "send_many": "h2-framing",
+    "_send_fragmented": "h2-framing",
+    "_flush_pending": "h2-framing",
+    "encode_frame": "h2-framing",
+}
+_profiler.register_stages(__file__, _LENS_STAGES)
 
 MAGIC = b"TPURPC\x01\x00"  # connection preface, client → server
 MAX_FRAME_PAYLOAD = 1 << 20

@@ -29,6 +29,7 @@ from tpurpc.core.endpoint import (Endpoint, EndpointError, EndpointListener,
                                   passthru_endpoint_pair)
 from tpurpc.obs import flight as _flight
 from tpurpc.obs import metrics as _obs_metrics
+from tpurpc.obs import profiler as _obs_profiler
 from tpurpc.obs import tracing as _tracing
 from tpurpc.rpc import frame as fr
 from tpurpc.rpc.status import (AbortError, Deserializer, Metadata, Serializer,
@@ -39,6 +40,15 @@ from tpurpc.utils.trace import TraceFlag
 
 trace_server = TraceFlag("server")
 _log = logging.getLogger("tpurpc.server")
+
+# tpurpc-lens (ISSUE 8) sampling-profiler frame markers: handler dispatch
+# on either execution path is the `dispatch` stage
+_LENS_STAGES = {
+    "_run_handler": "dispatch",
+    "_run_handler_inner": "dispatch",
+    "_run_inline": "dispatch",
+}
+_obs_profiler.register_stages(__file__, _LENS_STAGES)
 
 #: tpurpc-scope (ISSUE 4): always-on server-side handler latency (one
 #: perf_counter pair + one amortized histogram record per RPC — what
@@ -1296,6 +1306,12 @@ class Server:
         except Exception as exc:  # lib unbuildable etc.: Python plane
             trace_server.log("native dataplane unavailable: %s", exc)
         self._started = True
+        # tpurpc-lens (ISSUE 8): continuous stage profiling starts with the
+        # server (idempotent; no-op under TPURPC_LENS=0)
+        try:
+            _obs_profiler.ensure_started()
+        except Exception:
+            pass
         self._serving.set()  # listeners begin accepting (bound since add_port)
         return self
 

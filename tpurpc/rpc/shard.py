@@ -133,11 +133,13 @@ def _postfork_worker_init(shard_id: int, n_shards: int) -> None:
             m._lock = threading.Lock()
         m.reset()
 
+    from tpurpc.obs import profiler as _profiler
     from tpurpc.obs import shard as _obs_shard
     from tpurpc.obs import watchdog as _watchdog
 
     _flight.postfork_restart()
     _watchdog.postfork_reset()
+    _profiler.postfork_reset()  # tpurpc-lens: supervisor samples are not ours
     _obs_shard.set_identity(shard_id, n_shards)
 
     from tpurpc.rpc import channelz as _channelz

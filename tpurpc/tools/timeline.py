@@ -1,0 +1,274 @@
+"""tpurpc-lens unified timeline: one Perfetto file for a whole deployment.
+
+    python -m tpurpc.tools.timeline HOST:PORT [HOST:PORT ...] -o trace.json
+
+Collects, from EVERY named shard/fleet member over the existing
+introspection plane (the same plain-HTTP routes ``curl`` reaches):
+
+* ``/traces``          — the per-RPC span trees (chrome-trace export; the
+                         PR 7 fan-out merges shard workers, so one serving
+                         port yields every worker's spans);
+* ``/debug/flight``    — the flight recorder's transport edges;
+* ``/debug/profile``   — the sampling profiler's recent raw samples
+                         (``?samples=1``): what each thread's CPU was doing;
+* ``/metrics``         — a handful of load gauges as counter tracks.
+
+and emits ONE Perfetto-loadable chrome-trace JSON with named process/thread
+lanes: a slow RPC's span tree, the transport edges under it, and the CPU
+stages alongside — on a single shared time axis.
+
+**Clock alignment (the satellite fix).** Every tpurpc timestamp is
+``time.monotonic_ns``, and every process has its OWN monotonic epoch —
+merging raw stamps from two processes misaligns by their boot-time delta.
+Each exporter therefore publishes a monotonic↔wall *clock anchor*
+(:func:`tpurpc.obs.tracing.clock_anchor` — one bracketed simultaneous
+reading of both clocks) in its trace metadata, and this collector rebases
+every event onto the wall clock::
+
+    wall_ns = t_mono_ns - anchor.mono_ns + anchor.wall_ns
+
+then subtracts the earliest anchor's wall time so ``ts`` stays small. A
+process exporting no anchor (a pre-lens build) is rebased with zero offset
+and flagged in the summary — visible, never silently wrong.
+
+The merge itself is pure (:func:`rebase_events`, :func:`build_timeline`),
+so the pinned two-fake-processes-with-known-skew test needs no sockets.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+#: synthetic tid lanes inside each process row
+TID_FLIGHT = 0xF11D
+TID_GAUGES = 0xF22E
+
+#: gauges worth a counter track (present on any post-PR4 build)
+GAUGE_TRACKS = (
+    "tpurpc_ring_in_flight_bytes",
+    "tpurpc_pipeline_inflight",
+    "tpurpc_batcher_queue_depth",
+    "tpurpc_pairs_connected",
+)
+
+
+def _get(target: str, path: str, timeout: float = 10.0) -> Optional[bytes]:
+    try:
+        with urllib.request.urlopen(f"http://{target}{path}",
+                                    timeout=timeout) as resp:
+            return resp.read()
+    except Exception:
+        return None
+
+
+def _get_json(target: str, path: str) -> Optional[dict]:
+    raw = _get(target, path)
+    if raw is None:
+        return None
+    try:
+        return json.loads(raw)
+    except ValueError:
+        return None
+
+
+# -- clock rebasing (pure: the pinned skew test drives these directly) --------
+
+def rebase_ns(t_mono_ns: int, anchor: Optional[dict],
+              epoch_wall_ns: int) -> float:
+    """One monotonic stamp → microseconds since ``epoch_wall_ns`` on the
+    shared wall clock, via the exporting process's anchor."""
+    if anchor:
+        wall = t_mono_ns - int(anchor["mono_ns"]) + int(anchor["wall_ns"])
+    else:
+        wall = t_mono_ns  # no anchor (pre-lens exporter): raw, flagged
+    return (wall - epoch_wall_ns) / 1e3
+
+
+def rebase_events(events: List[dict], anchor: Optional[dict],
+                  epoch_wall_ns: int, pid: int) -> List[dict]:
+    """Rebase one process's chrome-trace events onto the shared axis and
+    re-pid them into their assigned lane. ``ts`` arrives in µs of the
+    process-local monotonic clock (chrome_trace's export unit)."""
+    out = []
+    for e in events:
+        e = dict(e)
+        e["pid"] = pid
+        if e.get("ph") != "M":  # metadata rows carry no timestamp
+            ts_us = float(e.get("ts", 0.0))
+            e["ts"] = rebase_ns(int(ts_us * 1e3), anchor, epoch_wall_ns)
+        out.append(e)
+    return out
+
+
+# -- collection ---------------------------------------------------------------
+
+def collect(target: str) -> dict:
+    """Everything one member exports, raw (monotonic clocks intact)."""
+    return {
+        "target": target,
+        "traces": _get_json(target, "/traces"),
+        "flight": _get_json(target, "/debug/flight"),
+        "profile": _get_json(target, "/debug/profile?samples=1"),
+        "metrics": (_get(target, "/metrics") or b"").decode(
+            "utf-8", "replace"),
+    }
+
+
+def _processes(col: dict) -> List[Tuple[str, Optional[int], Optional[dict],
+                                        List[dict]]]:
+    """Split one member's /traces doc into per-process lanes:
+    ``(label, shard_id|None, anchor|None, traceEvents)``. A sharded member
+    (the PR 7 fan-out doc: per-shard pids + ``clock_anchors``) yields one
+    lane per worker; a plain member yields one lane."""
+    doc = col.get("traces") or {}
+    target = col["target"]
+    anchors = doc.get("clock_anchors")
+    if anchors is not None:  # merged multi-shard document
+        by_shard: Dict[int, List[dict]] = {}
+        for e in doc.get("traceEvents", ()):
+            by_shard.setdefault(int(e.get("pid", 0)), []).append(e)
+        shards = sorted(set(by_shard) | {int(k) for k in anchors})
+        return [(f"{target} shard {k}", k, anchors.get(str(k)),
+                 by_shard.get(k, [])) for k in shards]
+    return [(target, None, doc.get("clock_anchor"),
+             list(doc.get("traceEvents", ())))]
+
+
+def build_timeline(collected: List[dict]) -> dict:
+    """The pure merge: N members' raw collections → one chrome-trace doc
+    with named per-process lanes, everything rebased onto the earliest
+    anchor's wall clock."""
+    lanes = []  # (label, shard, anchor, trace_events, member)
+    for col in collected:
+        for label, shard, anchor, events in _processes(col):
+            lanes.append((label, shard, anchor, events, col))
+    anchors = [a for _l, _s, a, _e, _c in lanes if a]
+    epoch = min(int(a["wall_ns"]) for a in anchors) if anchors else 0
+    out_events: List[dict] = []
+    unanchored: List[str] = []
+    for pid, (label, shard, anchor, events, col) in enumerate(lanes, 1):
+        if not anchor:
+            unanchored.append(label)
+        out_events.append({"ph": "M", "name": "process_name", "pid": pid,
+                           "tid": 0, "args": {"name": label}})
+        out_events.extend(
+            e for e in rebase_events(events, anchor, epoch, pid)
+            if not (e.get("ph") == "M" and e.get("name") == "process_name"))
+
+        # flight edges as instant events under the same lane
+        fdoc = col.get("flight") or {}
+        out_events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                           "tid": TID_FLIGHT,
+                           "args": {"name": "flight-recorder"}})
+        for ev in fdoc.get("events", ()):
+            if shard is not None and ev.get("shard") not in (None, shard):
+                continue
+            out_events.append({
+                "ph": "i", "s": "t", "cat": "flight",
+                "name": ev.get("event", "?"),
+                "ts": rebase_ns(int(ev.get("t_ns", 0)), anchor, epoch),
+                "pid": pid, "tid": TID_FLIGHT,
+                "args": {"entity": ev.get("entity"), "a1": ev.get("a1"),
+                         "a2": ev.get("a2")},
+            })
+
+        # profiler samples as fixed-width slices per sampled thread
+        pdoc = col.get("profile") or {}
+        if shard is not None and "shards" in pdoc:
+            pdoc = (pdoc.get("shards") or {}).get(str(shard)) or {}
+        hz = float(pdoc.get("hz") or 50.0)
+        width_us = 1e6 / hz
+        named = set()
+        for s in pdoc.get("recent", ()):
+            tid = int(s.get("tid", 0)) & 0xFFFF
+            if tid not in named:
+                named.add(tid)
+                out_events.append({
+                    "ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
+                    "args": {"name": f"cpu {s.get('thread') or hex(tid)}"}})
+            out_events.append({
+                "ph": "X", "cat": "lens-profile",
+                "name": s.get("stage", "?"),
+                "ts": rebase_ns(int(s.get("t_ns", 0)), anchor, epoch),
+                "dur": width_us, "pid": pid, "tid": tid,
+            })
+
+        # gauge snapshot as counter events at collection time (one point —
+        # live dashboards are tools.top's job; the timeline wants context)
+        if shard is None and col.get("metrics") and anchor:
+            from tpurpc.tools.top import parse_prometheus
+
+            m = parse_prometheus(col["metrics"])
+            ts = rebase_ns(int(anchor["mono_ns"]), anchor, epoch)
+            for gname in GAUGE_TRACKS:
+                val = m.get((gname, ""))
+                if val is None:
+                    continue
+                out_events.append({
+                    "ph": "C", "name": gname, "ts": ts, "pid": pid,
+                    "tid": TID_GAUGES, "args": {"value": val}})
+    # normalize: anchors are captured at EXPORT time, so events recorded
+    # before the earliest export rebase negative — shift the whole doc so
+    # the earliest event is t=0 (the epoch records the absolute origin)
+    stamps = [e["ts"] for e in out_events if "ts" in e]
+    t_min = min(stamps) if stamps else 0.0
+    for e in out_events:
+        if "ts" in e:
+            e["ts"] = round(e["ts"] - t_min, 3)
+    return {
+        "traceEvents": out_events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "tool": "tpurpc.tools.timeline",
+            "members": [c["target"] for c in collected],
+            "lanes": len(lanes),
+            "epoch_wall_ns": epoch + int(t_min * 1e3),
+            "unanchored": unanchored,
+        },
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpurpc.tools.timeline",
+        description="Collect spans + flight edges + profile samples from "
+                    "every shard/fleet member and emit one Perfetto-"
+                    "loadable trace on a shared wall-clock axis.")
+    ap.add_argument("targets", nargs="+",
+                    help="HOST:PORT of each member's serving port")
+    ap.add_argument("-o", "--out", default="tpurpc-timeline.json")
+    args = ap.parse_args(argv)
+
+    collected = []
+    for t in args.targets:
+        col = collect(t)
+        if col["traces"] is None and col["flight"] is None:
+            print(f"timeline: {t} unreachable (no /traces, no /debug/flight)",
+                  file=sys.stderr)
+            continue
+        collected.append(col)
+    if not collected:
+        print("timeline: no reachable members", file=sys.stderr)
+        return 1
+    doc = build_timeline(collected)
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(doc, f)
+    meta = doc["otherData"]
+    n_span = sum(1 for e in doc["traceEvents"] if e.get("ph") == "X"
+                 and e.get("cat") != "lens-profile")
+    n_prof = sum(1 for e in doc["traceEvents"] if e.get("cat")
+                 == "lens-profile")
+    n_flight = sum(1 for e in doc["traceEvents"] if e.get("ph") == "i")
+    print(f"timeline: {args.out} — {meta['lanes']} process lane(s), "
+          f"{n_span} spans, {n_flight} flight edges, {n_prof} cpu samples"
+          + (f"; UNANCHORED (raw clock): {meta['unanchored']}"
+             if meta["unanchored"] else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -64,6 +64,17 @@ def fetch_stalls(target: str, timeout: float = 5.0) -> Optional[dict]:
         return None
 
 
+def fetch_waterfall(target: str, timeout: float = 5.0) -> Optional[dict]:
+    """tpurpc-lens /debug/waterfall (per-hop effective GB/s), or None when
+    unreachable / pre-lens server."""
+    try:
+        with urllib.request.urlopen(f"http://{target}/debug/waterfall",
+                                    timeout=timeout) as resp:
+            return json.loads(resp.read().decode("utf-8", "replace"))
+    except Exception:
+        return None
+
+
 def _val(m: Dict, name: str, labels: str = "") -> float:
     return m.get((name, labels), 0.0)
 
@@ -82,7 +93,8 @@ def _fmt_us(us: float) -> str:
 
 
 def render(cur: Dict, prev: Optional[Dict], dt: float,
-           target: str, stalls: Optional[dict] = None) -> str:
+           target: str, stalls: Optional[dict] = None,
+           waterfall: Optional[dict] = None) -> str:
     P = "tpurpc_"
     Q50 = 'quantile="0.5"'
     Q99 = 'quantile="0.99"'
@@ -164,6 +176,20 @@ def render(cur: Dict, prev: Optional[Dict], dt: float,
             lines.append(
                 f"  !! {d.get('kind', '?'):>6} {d.get('method', '?'):<28} "
                 f"{d.get('age_s', 0):>7.2f}s  {d.get('stage', '?')}")
+    # tpurpc-lens byte-flow waterfall pane (/debug/waterfall): per-hop
+    # effective GB/s, slowest hop flagged — the streaming-gap instrument
+    if waterfall is not None:
+        hops = [r for r in waterfall.get("hops", ()) if r.get("bytes")]
+        slow = waterfall.get("slowest_hop")
+        if hops:
+            cells = "  ".join(
+                f"{r['hop']} {r['gbps']:.2f}" + ("*" if r["hop"] == slow
+                                                 else "")
+                for r in hops)
+            lines.append(f"flow  GB/s by hop: {cells}")
+            if slow:
+                lines.append(f"      slowest hop: {slow} "
+                             "(* = the hop to attack)")
     return "\n".join(lines)
 
 
@@ -188,8 +214,10 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 1
         stalls = fetch_stalls(args.target)
+        wf = fetch_waterfall(args.target)
         now = time.monotonic()
-        out = render(cur, prev, now - t_prev, args.target, stalls=stalls)
+        out = render(cur, prev, now - t_prev, args.target, stalls=stalls,
+                     waterfall=wf)
         if args.once:
             print(out)
             return 0

@@ -34,15 +34,30 @@ from __future__ import annotations
 
 import socket
 import struct
+import time
 from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
 from tpurpc.core.endpoint import RingEndpoint
 from tpurpc.jaxshim import codec
+from tpurpc.obs import lens as _lens
+from tpurpc.obs import profiler as _profiler
 from tpurpc.tpu.hbm_ring import HbmLease, HbmRing
 from tpurpc.utils.config import Platform, get_config
 from tpurpc.utils.trace import trace_endpoint
+
+# tpurpc-lens (ISSUE 8): the device-plane decode (wire record → placed
+# device view) is the `decode` waterfall hop here; its HBM placement share
+# is visible on the `hbm` row (hops may nest — see obs/lens.py).
+_LENS_DEC_BYTES, _LENS_DEC_NS, _LENS_DEC_COPY = _lens.hop_counters("decode")
+
+_LENS_STAGES = {
+    "decode_tensor_to_ring": "codec",
+    "decode_tree_to_ring": "codec",
+    "_parse_tensor_record": "codec",
+}
+_profiler.register_stages(__file__, _LENS_STAGES)
 
 #: Default wait for device-ring space before failing a decode: long enough to
 #: ride out a burst of unreleased leases, short enough to surface a genuine
@@ -132,9 +147,13 @@ def decode_tensor_to_ring(ring: HbmRing, buf, offset: int = 0,
     ``(lease, next_offset)``. ``lease.array`` is the shaped/dtyped device
     view; releasing the lease returns the span's credit.
     """
+    t0 = time.monotonic_ns()
     dt, shape, payload, next_pos = _parse_tensor_record(memoryview(buf), offset)
     off, n = ring.place(payload, timeout=timeout)
     lease = ring.view(off, n, dtype=dt, shape=shape)
+    elapsed = time.monotonic_ns() - t0
+    _LENS_DEC_NS.inc(elapsed)
+    _LENS_DEC_BYTES.inc(n)
     return lease, next_pos
 
 
@@ -152,6 +171,7 @@ def decode_tree_to_ring(ring: HbmRing, buf,
 
     import jax
 
+    t0 = time.monotonic_ns()
     view = memoryview(buf)
     magic, n_leaves, trailer_len = codec._TREE.unpack_from(view, 0)
     if magic != codec.TREE_MAGIC:
@@ -203,6 +223,9 @@ def decode_tree_to_ring(ring: HbmRing, buf,
             except Exception:
                 pass  # span already torn down; nothing more to free
         raise
+    elapsed = time.monotonic_ns() - t0
+    _LENS_DEC_NS.inc(elapsed)
+    _LENS_DEC_BYTES.inc(total)
     return tree, leases
 
 

@@ -54,11 +54,14 @@ here the drain's landing target is device memory.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from tpurpc.obs import lens as _lens
 from tpurpc.obs import metrics as _metrics
+from tpurpc.obs import profiler as _profiler
 from tpurpc.tpu import ledger
 
 # tpurpc-scope (ISSUE 4): device-ring placement totals + scrape-time
@@ -68,6 +71,20 @@ _HBM_PLACE_MSGS = _metrics.counter("hbm_place_msgs")
 _HBM_PLACE_BYTES = _metrics.counter("hbm_place_bytes")
 _HBM_RINGS = _metrics.fleet("hbm_ring_occupancy_bytes",
                             lambda r: r.tail - r.head)
+
+# tpurpc-lens (ISSUE 8): the `hbm` waterfall hop — bytes landed in the
+# device ring and the nanoseconds the placement dispatch took, one bump
+# set per place/place_many call. The emulated placement stages host→device
+# (dma_h2d), so every placed byte is also a copy byte here.
+_LENS_HBM_BYTES, _LENS_HBM_NS, _LENS_HBM_COPY = _lens.hop_counters("hbm")
+
+_LENS_STAGES = {
+    "place": "hbm-place",
+    "place_many": "hbm-place",
+    "_pallas_place": "hbm-place",
+    "view": "device-dispatch",
+}
+_profiler.register_stages(__file__, _LENS_STAGES)
 
 
 class HbmRing:
@@ -276,6 +293,7 @@ class HbmRing:
             return self.tail, 0
         if n > self.capacity:
             raise BufferError(f"payload {n} exceeds ring capacity {self.capacity}")
+        t0 = time.monotonic_ns()
         with self._lock:
             if n > self.writable() and timeout is not None:
                 import time as _time
@@ -317,8 +335,12 @@ class HbmRing:
                 self.buf = self._update(self.buf, dev[first:], 0)
                 ledger.dma_d2d(n - first)
             self._assert_stable()
+        dt = time.monotonic_ns() - t0
         _HBM_PLACE_MSGS.inc()
         _HBM_PLACE_BYTES.inc(n)
+        _LENS_HBM_BYTES.inc(n)
+        _LENS_HBM_NS.inc(dt)
+        _LENS_HBM_COPY.inc(n)
         return off, n
 
     def place_many(self, payloads,
@@ -347,6 +369,7 @@ class HbmRing:
         if total > self.capacity:
             raise BufferError(
                 f"batch of {total} bytes exceeds ring capacity {self.capacity}")
+        t0 = time.monotonic_ns()
         with self._lock:
             if total > self.writable() and timeout is not None:
                 import time as _time
@@ -382,8 +405,12 @@ class HbmRing:
                 self.buf = self._update(self.buf, dev[first:], 0)
                 ledger.dma_d2d(total - first)
             self._assert_stable()
+        dt = time.monotonic_ns() - t0
         _HBM_PLACE_MSGS.inc(len(spans))
         _HBM_PLACE_BYTES.inc(total)
+        _LENS_HBM_BYTES.inc(total)
+        _LENS_HBM_NS.inc(dt)
+        _LENS_HBM_COPY.inc(total)
         return spans
 
     def _assert_stable(self) -> None:

@@ -28,11 +28,23 @@ from typing import Dict, List, Optional, Tuple
 from tpurpc.core.endpoint import Endpoint, EndpointError
 from tpurpc.obs import flight as _flight
 from tpurpc.obs import metrics as _obs_metrics
+from tpurpc.obs import profiler as _profiler
 from tpurpc.obs import tracing as _tracing
 from tpurpc.rpc.status import AbortError, Metadata, StatusCode
 from tpurpc.utils import stats as _stats
 from tpurpc.wire import h2
 from tpurpc.wire.hpack import HpackDecoder, HpackEncoder, HpackError
+
+# tpurpc-lens (ISSUE 8) sampling-profiler frame markers: message↔frame
+# translation on the server h2 plane is the h2-framing stage
+_LENS_STAGES = {
+    "send_message": "h2-framing",
+    "_send_unary_fused": "h2-framing",
+    "_on_data": "h2-framing",
+    "recv_message": "h2-framing",
+    "_read_loop": "h2-framing",
+}
+_profiler.register_stages(__file__, _LENS_STAGES)
 
 #: tpurpc-scope (ISSUE 4): live h2 server connections + their send-side
 #: connection window, read at scrape time only (the DATA-coalescing batch

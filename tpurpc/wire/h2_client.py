@@ -38,6 +38,16 @@ import time
 from typing import Dict, Iterable, List, Optional, Tuple
 
 from tpurpc.core.endpoint import Endpoint, EndpointError, TcpEndpoint
+from tpurpc.obs import profiler as _profiler
+
+# tpurpc-lens (ISSUE 8): client-side h2 framing frame markers
+_LENS_STAGES = {
+    "_send_message": "h2-framing",
+    "_on_data": "h2-framing",
+    "_read_loop": "h2-framing",
+    "_pump": "h2-framing",
+}
+_profiler.register_stages(__file__, _LENS_STAGES)
 from tpurpc.obs import flight as _flight
 from tpurpc.obs import metrics as _obs_metrics
 from tpurpc.rpc.status import Metadata, RpcError, StatusCode
