@@ -42,18 +42,19 @@ import numpy as np
 
 from tpurpc.rpc.server import Server
 
-# Two servers (deployment guidance, round 5): BOTH phases now ride the
-# native plane. Round 4 measured the bulk sink "within noise / 19%
-# behind" on the native plane — that was the notify-token-stealing bug
-# (ring_transport.h wait_event, fixed round 5: bulk ring sends went
-# 0.07 -> 5.4 GB/s). Re-A/B'd same-weather after the fix, 4 MiB tensor
-# streams: native server + native client 1.20 GB/s vs Python/Python
-# 0.86 vs mixed 0.67-0.91 — both-native wins by ~40%, so it is the
-# default; TPURPC_BENCH_SINK_NATIVE=0 flips back to the instrumented
-# Python plane (copy-ledger runs).
+# Two servers (deployment guidance, round 9 / tpurpc-express): the BULK
+# sink defaults to the instrumented PYTHON plane because that is where
+# the rendezvous bulk-tensor path lives — payloads over the size bar move
+# as ONE one-sided write into a pre-granted landing region and the codec
+# aliases it in place (ISSUE 9). Same-weather A/B on this rig, 4 MiB
+# tensor streams: python+rendezvous 3.6 GB/s vs native framed 1.72 vs
+# python framed 0.65 — the rendezvous plane wins by ~2.1x over the
+# previous default, so it IS the default; TPURPC_BENCH_SINK_NATIVE=1
+# flips back to the native framed plane (the C loop does not speak the
+# rendezvous control frames yet — ROADMAP item 5 territory).
 srv = Server(max_workers=8,
              native_dataplane=False
-             if os.environ.get("TPURPC_BENCH_SINK_NATIVE", "1") == "0"
+             if os.environ.get("TPURPC_BENCH_SINK_NATIVE", "0") == "0"
              else None)
 port = srv.add_insecure_port("127.0.0.1:0")
 # Serving workers sized for PIPELINED clients (ISSUE 3): a request parks
@@ -90,21 +91,31 @@ from tpurpc.jaxshim import FanInBatcher, add_tensor_method, to_jax
 def consume(req_iter):
     # Bounded-depth h2d pipeline: receive/decode message k+1 while message
     # k's device_put is in flight (the tunnel moves h2d at ~1 GB/s;
-    # overlapping hides ring-transport time behind the transfers). The
-    # checksum accumulates ON DEVICE — d2h round trips over the tunnel cost
-    # tens-to-hundreds of ms each and are wildly jittery, so the hot loop
-    # must contain zero of them; ONE readback happens at stream end.
+    # overlapping hides ring-transport time behind the transfers). On
+    # ACCELERATORS the checksum accumulates ON DEVICE — d2h round trips
+    # over the tunnel cost tens-to-hundreds of ms each and are wildly
+    # jittery, so the hot loop must contain zero of them; ONE readback
+    # happens at stream end. On the CPU fallback that device-side
+    # accumulate is ~0.6 ms/message of pure op-dispatch overhead
+    # (measured, tpurpc-express round) for arrays the rendezvous path
+    # dlpack-ALIASES host-side — a zero-copy numpy read is the same
+    # delivery proof at ~1 µs.
     from collections import deque
     import jax.numpy as jnp
     total = 0
+    on_cpu = dev.platform == "cpu"
     checksum = jnp.float32(0.0)
+    checksum_f = 0.0
     inflight = deque()
 
     def retire(arr):
-        nonlocal total, checksum
+        nonlocal total, checksum, checksum_f
         arr.block_until_ready()   # bound in-flight transfers to the deque
         total += arr.nbytes       # depth (deep queues collapse the tunnel)
-        checksum = checksum + arr[0, 0]      # async device-side accumulate
+        if on_cpu:
+            checksum_f += float(np.asarray(arr)[0, 0])  # zero-copy read
+        else:
+            checksum = checksum + arr[0, 0]  # async device-side accumulate
 
     for tree in req_iter:
         inflight.append(to_jax(tree["x"]))   # async dispatch -> device
@@ -112,6 +123,7 @@ def consume(req_iter):
             retire(inflight.popleft())
     while inflight:
         retire(inflight.popleft())
+    checksum = checksum + jnp.float32(checksum_f)
     # Batched-pipeline observability (ISSUE 1): snapshot the cumulative
     # batch histograms + wakeup counters at the end of every Sink stream.
     # Printed BEFORE the final yield so the line is flushed before the
@@ -486,11 +498,14 @@ def _run_once(env, n_msgs: int, ready_s: float):
                 for _ in range(k):
                     yield {"x": payload}
 
-            # The client side of the measured-best both-native plane (see
-            # _SERVER_CODE's sink comment): the bulk stream rides the
-            # libtpurpc loop unless the env opts back to the Python plane.
+            # The client side of the measured-best plane (see _SERVER_CODE's
+            # sink comment): the bulk stream rides the PYTHON plane, whose
+            # rendezvous path one-sided-writes every 4 MiB payload into the
+            # server's pre-granted landing region (tpurpc-express, ISSUE 9;
+            # 3.6 vs 1.72 GB/s same-weather). TPURPC_BENCH_SINK_NATIVE=1
+            # opts back to the native framed loop.
             sink_native = os.environ.get("TPURPC_BENCH_SINK_NATIVE",
-                                         "1") != "0"
+                                         "0") != "0"
 
             # warmup RPC: decode jit + ring bring-up out of the timing
             list(cli.duplex("Sink", gen(2), native=sink_native, timeout=300))
@@ -585,12 +600,23 @@ def _run_once(env, n_msgs: int, ready_s: float):
         except Exception as exc:
             sys.stderr.write(f"waterfall capture failed: {exc}\n")
 
+        # tpurpc-express (ISSUE 9): the message-size sweep measuring the
+        # rendezvous-vs-framed crossover (~20s; Python plane, fresh
+        # channels; the main timed rounds above are untouched)
+        size_sweep = None
+        if os.environ.get("TPURPC_BENCH_SIZESWEEP", "1") == "1":
+            try:
+                size_sweep = _stream_by_size(port)
+            except Exception as exc:
+                sys.stderr.write(f"stream_by_size sweep failed: {exc}\n")
+
         serving = None
         extras = {"stream_dts": [round(x, 3) for x in
                                  globals().get("_LAST_STREAM_DTS", [])],
                   "calibration": calib,
                   "batch_stats": batch_stats,
-                  "waterfall": waterfall}
+                  "waterfall": waterfall,
+                  "stream_by_size": size_sweep}
         try:
             extras["device_kind"] = srv.wait_line("DEVKIND", 5).split(
                 " ", 1)[1].strip()
@@ -656,10 +682,11 @@ def _merge_waterfalls(docs: "list[dict]") -> dict:
         r["gbps"] = round(r["bytes"] / ns, 3) if ns else 0.0
         r["busy_ms"] = round(r["busy_ms"], 3)
         rows.append(r)
-    live = [r for r in rows if r["bytes"] > 0 and r["busy_ms"] > 0]
-    return {"hops": rows,
-            "slowest_hop": (min(live, key=lambda r: r["gbps"])["hop"]
-                            if live else None)}
+    # the lens's bottleneck rule (incl. the control-only-traffic guard: a
+    # hop carrying <1% of the bulk bytes cannot be the bulk bottleneck)
+    from tpurpc.obs import lens as _lens
+
+    return {"hops": rows, "slowest_hop": _lens.slowest_hop(rows)}
 
 
 def _lens_overhead(duration: "float | None" = None, pairs: int = 2) -> dict:
@@ -1351,6 +1378,77 @@ def _shard_bench() -> dict:
     return out
 
 
+def _stream_by_size(port: int) -> dict:
+    """tpurpc-express (ISSUE 9): message-size sweep 64 KiB → 16 MiB on the
+    Python plane, rendezvous ON vs OFF (the size bar pushed above every
+    payload), recording GB/s per cell and the measured crossover — so the
+    TPURPC_RENDEZVOUS_MIN_KB default is a number this artifact justifies,
+    not a guess. Each leg is budgeted by bytes, keeps the whole sweep to
+    ~20 s, and reuses one channel per mode so steady-state (standing
+    landing regions pre-granted) is what's measured."""
+    import numpy as np
+
+    from tpurpc.jaxshim import TensorClient
+    from tpurpc.rpc.channel import Channel
+
+    sizes = [64 << 10, 256 << 10, 1 << 20, 4 << 20, 16 << 20]
+    budget = 96 << 20  # bytes per cell
+    out: dict = {"sizes_kib": [s >> 10 for s in sizes],
+                 "rendezvous_gbps": [], "framed_gbps": []}
+    saved = os.environ.get("TPURPC_RENDEZVOUS_MIN_KB")
+    try:
+        for mode in ("rendezvous", "framed"):
+            if mode == "framed":
+                # push the size bar above every payload: same code path,
+                # zero offers — the honest framed control leg
+                os.environ["TPURPC_RENDEZVOUS_MIN_KB"] = str(1 << 20)
+            elif saved is not None:
+                os.environ["TPURPC_RENDEZVOUS_MIN_KB"] = saved
+            else:
+                os.environ.pop("TPURPC_RENDEZVOUS_MIN_KB", None)
+            with Channel(f"127.0.0.1:{port}") as ch:
+                cli = TensorClient(ch)
+                for size in sizes:
+                    # 2-D: the Sink handler's checksum reads arr[0, 0]
+                    payload = np.ones((size // 1024, 256), np.float32)
+                    msgs = max(4, budget // payload.nbytes)
+
+                    def gen(k, p=payload):
+                        for _ in range(k):
+                            yield {"x": p}
+
+                    # warm: jit + (rendezvous mode) standing grants
+                    list(cli.duplex("Sink", gen(2), native=False,
+                                    timeout=120))
+                    t0 = time.perf_counter()
+                    replies = list(cli.duplex("Sink", gen(msgs),
+                                              native=False, timeout=300))
+                    dt = time.perf_counter() - t0
+                    import numpy as _np
+
+                    total = int(_np.asarray(
+                        replies[-1]["bytes"]).ravel()[0])
+                    assert total == msgs * payload.nbytes
+                    out[f"{mode}_gbps"].append(round(total / dt / 1e9, 2))
+    finally:
+        if saved is not None:
+            os.environ["TPURPC_RENDEZVOUS_MIN_KB"] = saved
+        else:
+            os.environ.pop("TPURPC_RENDEZVOUS_MIN_KB", None)
+    crossover = None
+    for size, r, f in zip(sizes, out["rendezvous_gbps"],
+                          out["framed_gbps"]):
+        if r > f:
+            crossover = size
+            break
+    out["crossover_bytes"] = crossover
+    out["note"] = ("crossover = smallest message size where the "
+                   "rendezvous plane beats the framed path; the "
+                   "TPURPC_RENDEZVOUS_MIN_KB default (256) should sit at "
+                   "or below it")
+    return out
+
+
 def _cores_available() -> int:
     try:
         return len(os.sched_getaffinity(0))
@@ -1559,6 +1657,16 @@ def main() -> None:
         out["fallback_reason"] = fallback_reason
     if extras.get("stream_dts"):
         out["stream_round_secs"] = extras["stream_dts"]  # sorted; median used
+    # tpurpc-express (ISSUE 9): the headline stream vs the SAME-WEATHER
+    # memcpy yardstick (the acceptance ratio), plus the size sweep with the
+    # measured rendezvous-vs-framed crossover
+    yard = out.get("calibration", {}).get("memcpy_gbps_best")
+    if yard:
+        out["stream_4MiB_vs_memcpy_pct"] = round(100 * gbps / yard, 1)
+    if extras.get("stream_by_size"):
+        out["stream_by_size"] = extras["stream_by_size"]
+        out["rendezvous_crossover_bytes"] = extras["stream_by_size"].get(
+            "crossover_bytes")
     # tpurpc-lens (ISSUE 8): the streaming phase's per-hop waterfall — the
     # next PR finds ROADMAP item 2's bottleneck hop ON FILE here.
     if extras.get("waterfall"):

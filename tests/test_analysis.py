@@ -897,3 +897,149 @@ def test_stage_rule_instrumented_modules_are_clean():
         with open(mod.__file__, "r", encoding="utf-8") as f:
             vs = lint_source(f.read(), mod.__file__)
         assert [v for v in vs if v.rule == "stage"] == [], mod.__name__
+
+
+# ---------------------------------------------------------------------------
+# lint: rendezvous claim pairing (tpurpc-express, ISSUE 9)
+# ---------------------------------------------------------------------------
+
+RDV_OK = '''
+def send_big(self, stream_id, flags, segs, total):
+    claim = self.rdv_claim(stream_id, total, 1)
+    if claim is None:
+        return False
+    try:
+        self._rdv_write(claim, segs, total)
+    except BaseException:
+        self.rdv_release(claim)
+        raise
+    self.rdv_complete(claim, stream_id, flags, total)
+    return True
+'''
+
+RDV_NO_COMPLETE = '''
+def send_big(self, stream_id, total):
+    claim = self.rdv_claim(stream_id, total, 1)
+    self._rdv_write(claim, [], total)
+'''
+
+RDV_NO_RELEASE = '''
+def send_big(self, stream_id, flags, segs, total):
+    claim = self.rdv_claim(stream_id, total, 1)
+    self._rdv_write(claim, segs, total)
+    self.rdv_complete(claim, stream_id, flags, total)
+'''
+
+RDV_RELEASE_NOT_EXCEPTIONAL = '''
+def send_big(self, stream_id, flags, segs, total):
+    claim = self.rdv_claim(stream_id, total, 1)
+    if bad(claim):
+        self.rdv_release(claim)
+        return False
+    self._rdv_write(claim, segs, total)
+    self.rdv_complete(claim, stream_id, flags, total)
+'''
+
+
+def test_rdv_pairing_positive():
+    assert lint_source(RDV_OK, "fixture.py") == []
+
+
+def test_rdv_missing_complete_flagged():
+    vs = lint_source(RDV_NO_COMPLETE, "fixture.py")
+    assert _rules(vs) == ["rdv"] and "never" in vs[0].message
+
+
+def test_rdv_missing_release_flagged():
+    vs = lint_source(RDV_NO_RELEASE, "fixture.py")
+    assert _rules(vs) == ["rdv"] and "exception path" in vs[0].message
+
+
+def test_rdv_release_outside_handler_flagged():
+    # a release on a NON-exception branch does not cover the raise-between-
+    # claim-and-complete window
+    vs = lint_source(RDV_RELEASE_NOT_EXCEPTIONAL, "fixture.py")
+    assert _rules(vs) == ["rdv"]
+
+
+def test_rdv_finally_release_passes():
+    src = RDV_NO_RELEASE.replace(
+        "    self._rdv_write(claim, segs, total)\n",
+        "    try:\n"
+        "        self._rdv_write(claim, segs, total)\n"
+        "    finally:\n"
+        "        self.rdv_release(claim)\n")
+    assert lint_source(src, "fixture.py") == []
+
+
+def test_rdv_suppression():
+    src = RDV_NO_COMPLETE.replace(
+        "self.rdv_claim(stream_id, total, 1)",
+        "self.rdv_claim(stream_id, total, 1)  # tpr: allow(rdv)")
+    assert lint_source(src, "fixture.py") == []
+
+
+def test_rdv_rendezvous_module_is_clean():
+    """The real sender (core/rendezvous.py) holds the claim-pairing and
+    flight-encoder contracts it exports."""
+    import tpurpc.core.rendezvous as rdv_mod
+
+    with open(rdv_mod.__file__, "r", encoding="utf-8") as f:
+        vs = lint_source(f.read(), rdv_mod.__file__)
+    assert [v for v in vs if v.rule in ("rdv", "flight")] == []
+
+
+# ---------------------------------------------------------------------------
+# ringcheck: rendezvous offer/claim/write/complete model (tpurpc-express)
+# ---------------------------------------------------------------------------
+
+def test_rendezvous_model_clean_configs():
+    from tpurpc.analysis import ringcheck
+
+    for cfg in (dict(messages=2, words=2, standing=True),
+                dict(messages=2, words=2, standing=False),
+                dict(messages=3, words=2, standing=True)):
+        res = ringcheck.check_rendezvous(**cfg)
+        assert res.ok, res
+
+
+def test_rendezvous_model_peer_death_releases_claims():
+    """Sender death explored at EVERY protocol point: the receiver's close
+    must release the claimed landing region (the leaked-claim violation
+    fires otherwise — proven by the mutant-free death configs passing and
+    by hand-wiring a close-less variant being impossible without editing
+    the model)."""
+    from tpurpc.analysis import ringcheck
+
+    for standing in (True, False):
+        res = ringcheck.check_rendezvous(messages=2, words=2,
+                                         standing=standing,
+                                         with_death=True)
+        assert res.ok, res
+
+
+def test_rendezvous_mutants_killed():
+    from tpurpc.analysis import ringcheck
+
+    verdicts = ringcheck.rendezvous_mutant_kill_suite()
+    assert verdicts == {"write_before_claim": True,
+                       "complete_before_write": True}
+
+
+def test_rendezvous_mutants_ride_default_kill_suite():
+    """The CLI gate (python -m tpurpc.analysis) must exercise the
+    rendezvous mutants alongside the ring + handoff ones."""
+    from tpurpc.analysis import ringcheck
+
+    verdicts = ringcheck.mutant_kill_suite()
+    for mutant in ringcheck.RDV_MUTANTS:
+        assert verdicts.get(mutant) is True, verdicts
+    assert all(verdicts.values()), verdicts
+
+
+def test_rendezvous_model_rides_default_suite():
+    from tpurpc.analysis import ringcheck
+
+    results = ringcheck.default_suite()
+    rdv = [r for r in results if r.config.startswith("rendezvous")]
+    assert len(rdv) >= 4 and all(r.ok for r in rdv)

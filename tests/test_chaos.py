@@ -455,6 +455,12 @@ def test_partition_peer_stops_reading_names_stage(monkeypatch, platform):
     from tpurpc.obs import flight, watchdog
 
     monkeypatch.setenv("GRPC_PLATFORM_TYPE", platform)
+    # pin the FRAMED plane: 256 KiB chunks are at the rendezvous size bar,
+    # and a partition mid-bulk-transfer is the rendezvous plane's own
+    # scenario (test_rendezvous_peer_death_releases_claimed_region) with
+    # its own watchdog stage — this test exists for the ring-credit
+    # evidence path
+    monkeypatch.setenv("TPURPC_RENDEZVOUS", "0")
     from tpurpc.utils import config as config_mod
 
     config_mod.set_config(None)
@@ -782,4 +788,93 @@ def test_kill_one_shard_under_pipelined_traffic(monkeypatch, platform):
         assert doc["shards"] == [survivor]
     finally:
         sup.stop()
+        config_mod.set_config(None)
+
+
+@pytest.mark.parametrize("platform", ["TCP", "RDMA_BPEV"])
+def test_peer_death_mid_rendezvous_releases_region(monkeypatch, platform):
+    """tpurpc-express (ISSUE 9): kill the peer MID-RENDEZVOUS — after the
+    receiver claimed a landing region but before the sender completed. The
+    claimed region must be released (the ringcheck model's peer-death
+    invariant, here proven against the implementation), the call must fail
+    with a status (never hang), and the flight recorder must replay the
+    ordered offer → claim → death → release story."""
+    import tpurpc.core.rendezvous as rdv
+    from tpurpc.obs import flight
+
+    monkeypatch.setenv("GRPC_PLATFORM_TYPE", platform)
+    from tpurpc.utils import config as config_mod
+
+    config_mod.set_config(None)
+    flight.RECORDER.reset()
+
+    srv = tps.Server(max_workers=4, native_dataplane=False)
+    big = b"\x6b" * (1 << 20)
+    srv.add_method("/rdvx.S/Big", tps.unary_unary_rpc_method_handler(
+        lambda req, ctx: big))
+    srv.add_method("/rdvx.S/Warm", tps.unary_unary_rpc_method_handler(
+        lambda req, ctx: b"ok"))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    wedge = threading.Event()  # never set: the sender wedges after claim
+    outcome: list = []
+    try:
+        with tps.Channel(f"127.0.0.1:{port}") as ch:
+            mc = ch.unary_unary("/rdvx.S/Big", tpurpc_native=False)
+            # a SMALL warm call settles the capability hello without
+            # creating standing grants for the big size class — the wedged
+            # transfer below is then SOLICITED (observable offer/claim)
+            warm = ch.unary_unary("/rdvx.S/Warm", tpurpc_native=False)
+            assert bytes(warm(b"w", timeout=30)) == b"ok"
+            rdv.TEST_HOOKS["wedge_after_claim"] = wedge
+
+            def call():
+                try:
+                    mc(b"x", timeout=60)
+                    outcome.append(("ok",))
+                except RpcError as exc:
+                    outcome.append(("status", exc.code()))
+
+            t = threading.Thread(target=call)
+            t.start()
+            # wait until the CLIENT (the receiver of the big response) has
+            # claimed a landing region for the wedged transfer
+            t_armed = time.monotonic_ns()
+            deadline = time.monotonic() + 15
+            claimed = None
+            while claimed is None and time.monotonic() < deadline:
+                time.sleep(0.05)
+                for e in flight.snapshot(since_ns=t_armed):
+                    if e["event"] == "rdv-claim" and e["a1"] != 0:
+                        claimed = e
+                        break
+            assert claimed is not None, "claim never observed"
+            t_kill = time.monotonic_ns()
+            srv.stop(grace=0)  # ... and the peer dies mid-rendezvous
+            t.join(timeout=30)
+            assert not t.is_alive(), "call hung after peer death"
+            assert outcome and outcome[0][0] == "status", outcome
+            assert outcome[0][1] in (StatusCode.UNAVAILABLE,
+                                     StatusCode.CANCELLED,
+                                     StatusCode.DEADLINE_EXCEEDED), outcome
+            # ordered postmortem on the CLAIMING side: offer -> claim ->
+            # death -> release, all for the same link+lease
+            events = flight.snapshot()
+            tag, lease = claimed["tag"], claimed["a2"]
+            t_offer = [e["t_ns"] for e in events
+                       if e["event"] == "rdv-offer" and e["tag"] == tag
+                       and e["t_ns"] >= t_armed]
+            t_dead = [e["t_ns"] for e in events
+                      if e["event"] in ("conn-dead", "peer-death")
+                      and e["t_ns"] >= t_kill]
+            t_rel = [e["t_ns"] for e in events
+                     if e["event"] == "rdv-release" and e["tag"] == tag
+                     and e["a1"] == lease]
+            assert t_offer and t_dead and t_rel, events
+            assert min(t_offer) <= claimed["t_ns"] <= min(t_dead) \
+                <= max(t_rel)
+    finally:
+        rdv.TEST_HOOKS.pop("wedge_after_claim", None)
+        wedge.set()  # free any straggling sender thread
+        srv.stop(grace=0)
         config_mod.set_config(None)

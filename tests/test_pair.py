@@ -258,7 +258,14 @@ def test_bootstrap_negotiates_waitflag_caps():
 
     a, b = P.create_loopback_pair()
     try:
-        expect = frozenset(["waitflag"]) if _native.load() else frozenset()
+        # "rdv" (tpurpc-express, ISSUE 9) is advertised whenever the
+        # rendezvous plane is enabled — it rides alongside waitflag
+        expect = {"waitflag"} if _native.load() else set()
+        import os
+        if os.environ.get("TPURPC_RENDEZVOUS", "1").lower() not in (
+                "0", "off", "false"):
+            expect.add("rdv")
+        expect = frozenset(expect)
         assert a.peer_caps == expect and b.peer_caps == expect
     finally:
         a.destroy()

@@ -1,0 +1,459 @@
+"""tpurpc-express (ISSUE 9): one-sided rendezvous bulk-tensor plane.
+
+Covers the landing pool's lifetime rules (weakref-finalize recycling, size
+classes, budget refusal, death-path quarantine), the end-to-end transfer on
+the native-framing plane (TCP and ring platforms) and the gRPC wire plane,
+the copy-ledger zero-host-landing-copy proof, the framed fallback, the
+flight/watchdog evidence, and the TPU-plane halves (HbmRing region leases,
+SerializeFromDevice into a window, descriptor-only codec)."""
+
+import gc
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import tpurpc.core.rendezvous as rdv
+from tpurpc.tpu import ledger
+
+
+@pytest.fixture
+def fresh_config(monkeypatch):
+    """Platform/env changes need a config rebuild; restore after."""
+    from tpurpc.utils import config as config_mod
+
+    yield monkeypatch
+    config_mod.set_config(None)
+
+
+def _reset_platform(monkeypatch, platform):
+    from tpurpc.utils import config as config_mod
+
+    monkeypatch.setenv("GRPC_PLATFORM_TYPE", platform)
+    config_mod.set_config(None)
+
+
+# ---------------------------------------------------------------------------
+# landing pool
+# ---------------------------------------------------------------------------
+
+def test_pool_size_classes_and_alignment():
+    pool = rdv.LandingPool("local")
+    lease = pool.lease(100_000, 1)
+    assert lease.pr.capacity == 128 * 1024  # next pow2 ≥ 64 KiB floor
+    wrapper = lease.deliver(100_000)
+    flat = np.frombuffer(wrapper, np.uint8)
+    assert flat.ctypes.data % 64 == 0  # dlpack-aliasable landing span
+
+
+def test_pool_recycles_only_after_last_alias_dies():
+    pool = rdv.LandingPool("local")
+    lease = pool.lease(70_000, 1)
+    body = lease.deliver(70_000)
+    view = np.frombuffer(body, np.uint8)[10:20]  # consumer alias chain
+    del body
+    gc.collect()
+    assert pool.stats()["free_regions"] == 0  # alias still pins the region
+    del view
+    gc.collect()
+    assert pool.stats()["free_regions"] == 1
+    # and the recycled region is reused, not re-allocated
+    before = pool.stats()["allocated_bytes"]
+    lease2 = pool.lease(70_000, 2)
+    assert pool.stats()["allocated_bytes"] == before
+    lease2.release()
+
+
+def test_pool_budget_refuses_not_raises():
+    pool = rdv.LandingPool("local", budget=256 * 1024)
+    l1 = pool.lease(100_000, 1)
+    assert l1 is not None
+    assert pool.lease(100_000, 2) is None  # over budget: refusal
+    l1.release()
+    assert pool.lease(100_000, 3) is not None  # freed capacity reusable
+
+
+def test_pool_discard_quarantines_instead_of_pooling():
+    """The peer-death path must never re-lease a region a straggling
+    window might still write (the Pair.init stale-write rule)."""
+    pool = rdv.LandingPool("local")
+    lease = pool.lease(65_536, 1)
+    lease.release(discard=True)
+    assert pool.stats()["free_regions"] == 0
+    # a discarded-while-aliased region defers destruction to the alias GC
+    lease2 = pool.lease(65_536, 2)
+    body = lease2.deliver(65_536)
+    lease2.release(discard=True)
+    del body
+    gc.collect()
+    pool.lease(65_536, 3).release()  # sweeps zombies; no crash, no reuse
+
+
+def test_standing_doorbell_rings_on_alias_death():
+    pool = rdv.LandingPool("local")
+    lease = pool.lease(65_536, 1)
+    lease.standing = True
+    db_off = lease.pr.offset + lease.pr.capacity + 16
+    body = lease.deliver(1024)
+    assert bytes(lease.pr.region.buf[db_off:db_off + 8]) == b"\x00" * 8
+    del body
+    gc.collect()
+    assert lease.pr.region.buf[db_off] == 1  # consumer-freed count == 1
+    # a second delivery is legal now (freed == delivered)
+    body2 = lease.deliver(2048)
+    # ... but a THIRD while body2 is aliased is the protocol violation
+    with pytest.raises(RuntimeError):
+        lease.deliver(512)
+    del body2
+    gc.collect()
+    lease.release()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: native framing plane
+# ---------------------------------------------------------------------------
+
+def _echo_server(**kw):
+    from tpurpc.rpc.server import Server, unary_unary_rpc_method_handler
+
+    # the Python data plane: ring-platform servers otherwise adopt
+    # connections onto the native C loop, which does not speak the
+    # rendezvous control frames (negotiation correctly leaves such
+    # connections on the framed path)
+    kw.setdefault("native_dataplane", False)
+    srv = Server(max_workers=4, **kw)
+    srv.add_method("/rdv.S/Echo",
+                   unary_unary_rpc_method_handler(
+                       lambda req, ctx: bytes(req)))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    return srv, port
+
+
+@pytest.mark.parametrize("platform", ["TCP", "RDMA_BPEV"])
+def test_big_unary_roundtrip_both_directions(fresh_config, platform):
+    _reset_platform(fresh_config, platform)
+    from tpurpc.obs import metrics as _metrics
+    from tpurpc.rpc.channel import Channel
+
+    sent0 = _metrics.registry().metrics()["rdv_transfers_sent"].snapshot()
+    srv, port = _echo_server()
+    try:
+        with Channel(f"127.0.0.1:{port}") as ch:
+            mc = ch.unary_unary("/rdv.S/Echo", tpurpc_native=False)
+            # small calls keep the framed path untouched — and the first
+            # one also settles the capability hello exchange (a big send
+            # racing the hello simply frames; steady state never does)
+            assert bytes(mc(b"tiny", timeout=10)) == b"tiny"
+            big = bytes(range(256)) * (4096 + 13)  # ~1 MiB, patterned
+            out = mc(big, timeout=30)
+            assert bytes(out) == big
+        sent = _metrics.registry().metrics()["rdv_transfers_sent"].snapshot()
+        assert sent >= sent0 + 2  # request AND response rode the bulk plane
+    finally:
+        srv.stop(grace=1)
+
+
+def test_tensor_stream_zero_host_landing_copies(fresh_config):
+    """The acceptance claim: on the rendezvous path the copy ledger shows
+    the one-sided write (rdma_write) and the aliasing decode (zero_copy) —
+    and ZERO host landing copies of the payload."""
+    _reset_platform(fresh_config, "RDMA_BPEV")
+    from tpurpc.jaxshim import TensorClient, add_tensor_method
+    from tpurpc.rpc.channel import Channel
+    from tpurpc.rpc.server import Server
+
+    srv = Server(max_workers=4, native_dataplane=False)
+
+    def consume(req_iter):
+        total = 0
+        checks = 0.0
+        for tree in req_iter:
+            arr = tree["x"]          # zero-copy view over the landing region
+            total += arr.nbytes
+            checks += float(arr[0, 0]) + float(arr[-1, -1])
+        yield {"bytes": np.int64(total), "check": np.float64(checks)}
+
+    add_tensor_method(srv, "Sink", consume, kind="stream_stream")
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    payload = np.random.default_rng(7).standard_normal(
+        (512, 512)).astype(np.float32)  # 1 MiB
+    try:
+        with Channel(f"127.0.0.1:{port}") as ch:
+            cli = TensorClient(ch)
+
+            def gen(k):
+                for _ in range(k):
+                    yield {"x": payload}
+
+            list(cli.duplex("Sink", gen(2), native=False, timeout=60))
+            n = 8
+            with ledger.track() as w:
+                replies = list(cli.duplex("Sink", gen(n), native=False,
+                                          timeout=60))
+            total = int(np.asarray(replies[-1]["bytes"]).ravel()[0])
+            assert total == n * payload.nbytes
+            expect = n * (float(payload[0, 0]) + float(payload[-1, -1]))
+            assert abs(float(np.asarray(
+                replies[-1]["check"]).ravel()[0]) - expect) < 1e-3
+            # every payload byte moved by exactly one one-sided write...
+            assert w["rdma_write"] >= n * payload.nbytes
+            # ...and landed ZERO host copies (the small control/reply
+            # frames still ride the instrumented framed path)
+            assert w["host_copy"] < 64 * 1024, w.delta
+    finally:
+        srv.stop(grace=1)
+
+
+def test_disabled_rendezvous_keeps_framed_path(fresh_config):
+    _reset_platform(fresh_config, "TCP")
+    fresh_config.setenv("TPURPC_RENDEZVOUS", "0")
+    from tpurpc.obs import metrics as _metrics
+    from tpurpc.rpc.channel import Channel
+
+    sent0 = _metrics.registry().metrics()["rdv_transfers_sent"].snapshot()
+    srv, port = _echo_server()
+    try:
+        with Channel(f"127.0.0.1:{port}") as ch:
+            mc = ch.unary_unary("/rdv.S/Echo", tpurpc_native=False)
+            big = b"q" * (1 << 20)
+            assert bytes(mc(big, timeout=30)) == big
+        assert _metrics.registry().metrics()[
+            "rdv_transfers_sent"].snapshot() == sent0
+    finally:
+        srv.stop(grace=1)
+
+
+def test_pool_exhaustion_falls_back_to_framed(fresh_config):
+    """A refused claim degrades to the framed path — never an error,
+    never a hang."""
+    _reset_platform(fresh_config, "TCP")
+    fresh_config.setenv("TPURPC_RENDEZVOUS_POOL_MB", "1")  # 1 MiB budget
+    # fresh pools so the tiny budget binds (the process-global pool may
+    # hold regions from earlier tests)
+    old_pools = dict(rdv._pools)
+    rdv._pools.clear()
+    from tpurpc.obs import metrics as _metrics
+    from tpurpc.rpc.channel import Channel
+
+    srv, port = _echo_server()
+    try:
+        with Channel(f"127.0.0.1:{port}") as ch:
+            mc = ch.unary_unary("/rdv.S/Echo", tpurpc_native=False)
+            big = b"f" * (4 << 20)  # 4 MiB > the whole pool budget
+            out = mc(big, timeout=60)
+            assert bytes(out) == big
+        assert _metrics.registry().metrics()[
+            "rdv_fallbacks"].snapshot() >= 1
+    finally:
+        srv.stop(grace=1)
+        rdv._pools.clear()
+        rdv._pools.update(old_pools)
+
+
+def test_flight_sequence_offer_claim_write_complete(fresh_config):
+    _reset_platform(fresh_config, "TCP")
+    from tpurpc.obs import flight
+    from tpurpc.rpc.channel import Channel
+
+    flight.RECORDER.reset()
+    srv, port = _echo_server()
+    try:
+        with Channel(f"127.0.0.1:{port}") as ch:
+            mc = ch.unary_unary("/rdv.S/Echo", tpurpc_native=False)
+            assert bytes(mc(b"warm", timeout=10)) == b"warm"  # hello settles
+            big = b"e" * (1 << 20)
+            assert bytes(mc(big, timeout=30)) == big
+        events = [e for e in flight.snapshot()
+                  if e["event"].startswith("rdv-")]
+        order = [e["event"] for e in events]
+        for name in ("rdv-offer", "rdv-claim", "rdv-write", "rdv-complete"):
+            assert name in order, order
+        # per-transfer ordering: for every sender-side write, the SAME
+        # link's claim of the SAME lease precedes it and its complete
+        # follows (one link is sender for requests AND receiver for
+        # responses, so ordering is per (tag, lease), not per tag)
+        for w in [e for e in events if e["event"] == "rdv-write"]:
+            tag, lease = w["tag"], w["a1"]
+            t_claim = [e["t_ns"] for e in events
+                       if e["event"] == "rdv-claim" and e["tag"] == tag
+                       and e["a2"] == lease]
+            t_done = [e["t_ns"] for e in events
+                      if e["event"] == "rdv-complete" and e["tag"] == tag
+                      and e["a1"] == lease]
+            assert t_claim and min(t_claim) <= w["t_ns"], events
+            assert t_done and w["t_ns"] <= max(t_done), events
+    finally:
+        srv.stop(grace=1)
+
+
+def test_watchdog_names_rendezvous_stage(fresh_config):
+    """A claim-starved sender (drop_offers chaos seam) must be diagnosed
+    by the watchdog as stuck in the `rendezvous` stage."""
+    _reset_platform(fresh_config, "TCP")
+    fresh_config.setenv("TPURPC_RENDEZVOUS_CLAIM_TIMEOUT_S", "3")
+    from tpurpc.obs import flight, watchdog
+    from tpurpc.rpc.channel import Channel
+
+    flight.RECORDER.reset()
+    wd = watchdog.get()
+    wd.reset()
+    prev = (wd.min_stall_s, wd.sweep_s)
+    wd.min_stall_s, wd.sweep_s = 0.3, 0.1
+    srv, port = _echo_server()
+    rdv.TEST_HOOKS["drop_offers"] = True
+    try:
+        with Channel(f"127.0.0.1:{port}") as ch:
+            mc = ch.unary_unary("/rdv.S/Echo", tpurpc_native=False)
+            big = b"w" * (1 << 20)
+            result = {}
+
+            def call():
+                result["out"] = bytes(mc(big, timeout=30))
+
+            t = threading.Thread(target=call)
+            t.start()
+            diag = None
+            deadline = time.monotonic() + 10
+            while diag is None and time.monotonic() < deadline:
+                time.sleep(0.15)
+                for d in wd.sweep_once():
+                    if d["stage"] == "rendezvous":
+                        diag = d
+                        break
+            assert diag is not None, wd.active()
+            assert "offer" in diag["detail"]
+            # after the claim timeout the sender falls back to the framed
+            # path — the call COMPLETES despite the starved bulk plane
+            t.join(timeout=30)
+            assert result.get("out") == big
+    finally:
+        rdv.TEST_HOOKS.pop("drop_offers", None)
+        wd.min_stall_s, wd.sweep_s = prev
+        wd.reset()
+        srv.stop(grace=1)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: gRPC wire plane
+# ---------------------------------------------------------------------------
+
+def test_h2_plane_big_payloads_bypass_data_frames(fresh_config):
+    _reset_platform(fresh_config, "TCP")
+    from tpurpc.obs import metrics as _metrics
+    from tpurpc.wire.h2_client import H2Channel
+
+    sent0 = _metrics.registry().metrics()["rdv_transfers_sent"].snapshot()
+    srv, port = _echo_server()
+    try:
+        with H2Channel(f"127.0.0.1:{port}") as ch:
+            mc = ch.unary_unary("/rdv.S/Echo")
+            assert bytes(mc(b"small", timeout=10)) == b"small"  # settles
+            big = bytes(range(251)) * 8192  # ~2 MiB patterned
+            out = mc(big, timeout=30)
+            assert bytes(out) == big
+        assert _metrics.registry().metrics()[
+            "rdv_transfers_sent"].snapshot() >= sent0 + 2
+    finally:
+        srv.stop(grace=1)
+
+
+# ---------------------------------------------------------------------------
+# TPU plane: region leases, SerializeFromDevice, descriptor codec
+# ---------------------------------------------------------------------------
+
+def test_hbm_lease_region_single_movement_ledger():
+    from tpurpc.tpu.hbm_ring import HbmRing
+
+    ring = HbmRing(1 << 20)
+    x = np.arange(65536, dtype=np.float32)
+    with ledger.track() as w:
+        lease = ring.lease_region(x.nbytes)
+        lease.fill(x)
+    # the single-movement claim, assertable via op counts: ONE h2d DMA +
+    # ONE in-ring landing write, zero host copies
+    assert w["dma_h2d_ops"] == 1 and w["dma_d2d_ops"] == 1, w.delta
+    assert w["host_copy"] == 0
+    hl = lease.view(dtype=np.float32, shape=(65536,))
+    assert np.allclose(np.asarray(hl.array), x)
+    hl.release()
+    lease.release()
+
+
+def test_hbm_lease_region_death_release_frees_credit():
+    from tpurpc.tpu.hbm_ring import HbmRing
+
+    ring = HbmRing(1 << 18)
+    writable0 = ring.writable()
+    lease = ring.lease_region(1 << 17)
+    assert ring.writable() == writable0 - (1 << 17)
+    lease.release()  # peer died before any fill
+    assert ring.writable() == writable0
+    with pytest.raises(RuntimeError):
+        lease.fill(np.zeros(1 << 17, np.uint8))  # released: no late landing
+
+
+def test_serialize_into_zero_host_staging():
+    import jax
+
+    from tpurpc.tpu import serialize
+
+    dst = bytearray(1 << 20)
+    view = memoryview(dst)
+
+    def write(off, seg):
+        view[off:off + len(seg)] = seg
+
+    tree = {"a": jax.device_put(np.ones((128, 128), np.float32)),
+            "b": np.arange(64, dtype=np.int64)}
+    with ledger.track() as w:
+        n = serialize.serialize_tree_into(tree, write)
+    assert n > 0
+    assert w["host_copy"] == 0, w.delta       # no staging buffer, ever
+    assert w["rdma_write"] == n               # the placement IS the move
+    from tpurpc.jaxshim import codec
+
+    back = codec.decode_tree(view)
+    assert np.allclose(back["a"], 1.0) and back["b"][63] == 63
+
+
+def test_codec_descriptor_only_encode_roundtrip():
+    from tpurpc.jaxshim import codec
+
+    x = np.random.default_rng(3).standard_normal((65, 3)).astype(np.float32)
+    desc, payload = codec.encode_tensor_descriptor(x)
+    assert len(desc) % 64 == 0          # descriptor pads to the alignment
+    assert payload.nbytes == x.nbytes   # payload view aliases the array
+    back = codec.decode_tensor_external(desc, payload)
+    assert np.allclose(back, x)
+    with pytest.raises(codec.CodecError):
+        codec.decode_tensor_external(desc, payload[:100])  # short payload
+
+
+def test_recv_limit_not_bypassed(fresh_config):
+    """The bulk plane must not become a max_receive_message_length bypass:
+    an over-limit OFFER is refused, the framed fallback carries the
+    payload, and the framed oversize machinery rejects it properly."""
+    _reset_platform(fresh_config, "TCP")
+    from tpurpc.rpc.channel import Channel
+    from tpurpc.rpc.server import Server, unary_unary_rpc_method_handler
+    from tpurpc.rpc.status import RpcError, StatusCode
+
+    srv = Server(max_workers=4, native_dataplane=False,
+                 max_receive_message_length=512 * 1024)
+    srv.add_method("/rdv.S/Echo",
+                   unary_unary_rpc_method_handler(
+                       lambda req, ctx: bytes(req)))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    try:
+        with Channel(f"127.0.0.1:{port}") as ch:
+            mc = ch.unary_unary("/rdv.S/Echo", tpurpc_native=False)
+            assert bytes(mc(b"ok", timeout=10)) == b"ok"
+            with pytest.raises(RpcError) as exc:
+                mc(b"z" * (1 << 20), timeout=30)
+            assert exc.value.code() == StatusCode.RESOURCE_EXHAUSTED
+    finally:
+        srv.stop(grace=1)

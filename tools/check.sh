@@ -68,7 +68,16 @@ python -m tpurpc.tools.fleet_smoke || fail=1
 note "tpurpc-manycore smoke (2 shards, accept spread, merged scrape)"
 python -m tpurpc.tools.shard_smoke || fail=1
 
-# 2g) tpurpc-lens smoke (ISSUE 8): streaming + serving burst, then assert
+# 2g) tpurpc-express smoke (ISSUE 9): one 8 MiB tensor rendezvous'd over
+#     the shm ring plane AND loopback TCP — the copy ledger must show the
+#     one-sided write with ZERO host landing copies, the flight ring the
+#     ordered offer/claim/write/complete, and an induced claim-starved
+#     stall must be attributed to the `rendezvous` watchdog stage (then
+#     complete via the framed fallback). ~20s (jax on cpu, 2 subprocesses).
+note "tpurpc-express rendezvous smoke (8 MiB, shm + TCP, zero-copy ledger)"
+JAX_PLATFORMS=cpu python -m tpurpc.tools.rendezvous_smoke || fail=1
+
+# 2h) tpurpc-lens smoke (ISSUE 8): streaming + serving burst, then assert
 #     the sampling profiler names >=3 known stages (>=80% attributed), the
 #     /debug/waterfall reports every declared hop with nonzero bytes and a
 #     slowest hop, and the timeline tool emits a Perfetto-loadable trace

@@ -110,6 +110,9 @@ FLIGHT_HOT_MODULES = HOT_LOG_MODULES + (
     os.path.join("tpurpc", "rpc", "channel.py"),
     os.path.join("tpurpc", "rpc", "server.py"),
     os.path.join("tpurpc", "rpc", "resolver.py"),
+    # tpurpc-express (ISSUE 9): rendezvous emission sites run per solicited
+    # bulk transfer — interned link tags, pure-int args
+    os.path.join("tpurpc", "core", "rendezvous.py"),
 )
 
 #: module suffix -> qualified functions on its INLINE DISPATCH path (the
@@ -123,6 +126,7 @@ INLINE_DISPATCH_PATH: Dict[str, Tuple[str, ...]] = {
     os.path.join("tpurpc", "rpc", "server.py"): (
         "_ServerSink.commit",
         "_ServerStream.commit_message",
+        "_ServerStream.commit_external",
         "_ServerStream._acquire_credit",
         "_ServerStream._release_credit",
         "_ServerStream.next_request",
@@ -132,6 +136,7 @@ INLINE_DISPATCH_PATH: Dict[str, Tuple[str, ...]] = {
         "_ServerConnection._run_handler_inner",
         "_ServerConnection._send_trailers",
         "_ServerConnection._finish_stream",
+        "_ServerConnection._rdv_deliver",
     ),
 }
 
@@ -850,6 +855,54 @@ def _check_lease_region(fn, reserves, commits, path) -> List[LintViolation]:
     return out
 
 
+# -- rule: rdv (rendezvous claim pairing, tpurpc-express ISSUE 9) -------------
+
+def _check_rdv(tree: ast.AST, path: str,
+               lines: Sequence[str]) -> List[LintViolation]:
+    """A function that obtains a rendezvous region claim (``*rdv_claim*``)
+    must send ``*rdv_complete*`` on the success path AND cover an exception
+    path (except/finally) with ``*rdv_release*`` — a claimed-and-dropped
+    region pins the peer's landing pool until the connection dies (the
+    lease-pairing rule's shape, lifted to the bulk-transfer plane).
+    Suppression: ``# tpr: allow(rdv)`` on the claim line."""
+    out = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        claims = [c for c in _calls_matching(fn, "rdv_claim")
+                  if _enclosing_fn(c) is fn]
+        if not claims:
+            continue
+        if any("rdv" in _allowed_rules(lines, c.lineno) for c in claims):
+            continue
+        completes = [c for c in _calls_matching(fn, "rdv_complete")
+                     if _enclosing_fn(c) is fn]
+        releases = [c for c in _calls_matching(fn, "rdv_release")
+                    if _enclosing_fn(c) is fn]
+        cl = claims[0].lineno
+        if not completes:
+            out.append(LintViolation(
+                path, cl, claims[0].col_offset, "rdv",
+                f"{fn.name} claims a rendezvous region but never "
+                "completes it: the peer's landing region stays claimed "
+                "until the connection dies"))
+            continue
+        covered = [
+            r for r in releases
+            if any(isinstance(anc, ast.ExceptHandler)
+                   for anc in _ancestors(r))
+            or any(isinstance(anc, ast.Try) and r in
+                   [d for s in anc.finalbody for d in ast.walk(s)]
+                   for anc in _ancestors(r))]
+        if not covered:
+            out.append(LintViolation(
+                path, cl, claims[0].col_offset, "rdv",
+                f"{fn.name} claims a rendezvous region with no "
+                "rdv_release on any exception path (except/finally): a "
+                "raise between claim and complete leaks the claim"))
+    return out
+
+
 # -- driver ------------------------------------------------------------------
 
 def lint_source(source: str, path: str,
@@ -892,6 +945,7 @@ def lint_source(source: str, path: str,
     out.extend(_check_shard(tree, path, lines))
     out.extend(_check_stage(tree, path, lines))
     out.extend(_check_lease(tree, path, lines))
+    out.extend(_check_rdv(tree, path, lines))
     out.sort(key=lambda v: (v.path, v.line, v.col))
     return out
 

@@ -628,6 +628,236 @@ def handoff_mutant_kill_suite(verbose: bool = False) -> Dict[str, bool]:
     return out
 
 
+# -- rendezvous bulk-transfer model (tpurpc-express, ISSUE 9) -----------------
+#
+# Models tpurpc/core/rendezvous.py — the offer/claim/write/complete protocol
+# moving bulk payloads by one-sided writes into a receiver-advertised landing
+# region — at the same word granularity: every region word store, every
+# control-message consumption, every consumer action is one atomic step,
+# exhaustively interleaved. Control messages ride ordered queues (the framed
+# connection preserves order); the region and its doorbell word are shared
+# memory.
+#
+#   sender:   OFFER(k) → await CLAIM(lease) → store payload words →
+#             COMPLETE(k, lease)  [standing mode: subsequent messages skip
+#             OFFER/CLAIM and gate on the region's doorbell word instead]
+#   receiver: OFFER → grant the region (when free) → CLAIM;
+#             COMPLETE → read the region words (the zero-copy delivery),
+#             hold the alias until the nondeterministic consumer-free step
+#             (weakref-finalize in the implementation), which re-checks the
+#             words and rings the doorbell
+#   death:    with_death=True explores sender death at every point; the
+#             receiver's close must release the claimed region
+#
+# Invariants: every message delivered exactly once in order with intact
+# payload; a delivered-and-still-aliased region is never overwritten (the
+# reuse-only-after-complete-and-free rule); a dead peer's claimed region is
+# released; no wedged quiescent states.
+
+RDV_MUTANTS = (
+    "write_before_claim",    # sender stores payload before the claim/
+    #                          doorbell says the region is its to write
+    "complete_before_write",  # COMPLETE control message sent before the
+    #                          payload stores (delivery reads torn words)
+)
+
+_R_ZERO = ("rzero",)
+
+
+def check_rendezvous(messages: int = 2, words: int = 2,
+                     standing: bool = True, with_death: bool = False,
+                     mutant: Optional[str] = None,
+                     max_states: int = 2_000_000) -> CheckResult:
+    """Exhaustively interleave one sender, the receiver's control loop, and
+    the consumer over a single landing region."""
+    if mutant is not None and mutant not in RDV_MUTANTS:
+        raise ValueError(f"unknown mutant {mutant!r}; known: {RDV_MUTANTS}")
+    cfg = (f"rendezvous msgs={messages} words={words} standing={standing} "
+           f"death={with_death} mutant={mutant}")
+
+    # state:
+    #  (sr, rs,                  control queues (ordered, like the framing)
+    #   mem, doorbell,           region words + consumer-freed count
+    #   s_phase, s_k, s_w, s_used, s_grant, s_alive,
+    #   r_lease, r_phase, r_k, r_w, delivered, alias, closed)
+    # s_phase: idle|wait|write|dead-ish via s_alive; r_lease: 0 = not
+    # granted, else the granted lease id; r_phase: "ctrl" | "deliver";
+    # alias: None or (k,) the consumer still holds
+    init = ((), (), (_R_ZERO,) * words, 0,
+            "idle", 0, 0, 0, 0, True,
+            0, "ctrl", 0, 0, (), None, False)
+    visited = set()
+    stack: List[Tuple[tuple, Tuple[str, ...]]] = [(init, ())]
+    states = 0
+    try:
+        while stack:
+            state, trace = stack.pop()
+            if state in visited:
+                continue
+            visited.add(state)
+            states += 1
+            if states > max_states:
+                raise RuntimeError(
+                    f"state space exceeds {max_states} states ({cfg})")
+            succ = _rdv_successors(state, messages, words, standing,
+                                   with_death, mutant, trace)
+            if not succ:
+                _rdv_quiescent(state, messages, trace)
+                continue
+            stack.extend(succ)
+    except Violation as v:
+        return CheckResult(False, states, v, cfg)
+    return CheckResult(True, states, None, cfg)
+
+
+def _rdv_quiescent(state, messages, trace) -> None:
+    (sr, rs, mem, doorbell, s_phase, s_k, s_w, s_used, s_grant, s_alive,
+     r_lease, r_phase, r_k, r_w, delivered, alias, closed) = state
+    if alias is not None:
+        raise Violation("stuck", "quiescent with a live consumer alias",
+                        list(trace))
+    if s_alive:
+        if s_k < messages:
+            raise Violation(
+                "stuck", f"sender wedged at message {s_k}/{messages}",
+                list(trace))
+        if delivered != tuple(range(messages)):
+            raise Violation(
+                "lost", f"quiescent with deliveries {delivered} "
+                f"(wanted 0..{messages - 1} in order)", list(trace))
+    else:
+        # peer death: the receiver's close must have run and released the
+        # claimed region — a leaked claim pins pool memory forever
+        if r_lease:
+            raise Violation(
+                "leak", "sender died but the claimed landing region was "
+                "never released", list(trace))
+        if list(delivered) != sorted(set(delivered)) or any(
+                delivered[i] != i for i in range(len(delivered))):
+            raise Violation(
+                "order", f"out-of-order deliveries {delivered} before the "
+                "death", list(trace))
+
+
+def _rdv_successors(state, messages, words, standing, with_death, mutant,
+                    trace):
+    (sr, rs, mem, doorbell, s_phase, s_k, s_w, s_used, s_grant, s_alive,
+     r_lease, r_phase, r_k, r_w, delivered, alias, closed) = state
+    succ = []
+
+    def mk(sr=sr, rs=rs, mem=mem, doorbell=doorbell, s_phase=s_phase,
+           s_k=s_k, s_w=s_w, s_used=s_used, s_grant=s_grant,
+           s_alive=s_alive, r_lease=r_lease, r_phase=r_phase, r_k=r_k,
+           r_w=r_w, delivered=delivered, alias=alias, closed=closed,
+           step=""):
+        return ((sr, rs, mem, doorbell, s_phase, s_k, s_w, s_used,
+                 s_grant, s_alive, r_lease, r_phase, r_k, r_w, delivered,
+                 alias, closed), trace + (step,))
+
+    # ---- sender ----
+    if s_alive and s_k < messages:
+        if s_phase == "idle":
+            if s_grant:
+                if mutant == "write_before_claim" or doorbell == s_used:
+                    # correct: gate on the doorbell (the consumer freed
+                    # every previous use); MUTANT: skip the gate
+                    succ.append(mk(s_phase="write", s_w=0,
+                                   step="s:own" if doorbell == s_used
+                                   else "s:own!early"))
+            else:
+                nxt = ("write" if mutant == "write_before_claim"
+                       else "wait")
+                succ.append(mk(sr=sr + (("offer", s_k),), s_phase=nxt,
+                               s_w=0, step="s:offer"))
+        elif s_phase == "wait":
+            if rs and rs[0][0] == "claim":
+                succ.append(mk(rs=rs[1:], s_grant=rs[0][1],
+                               s_phase="write", s_w=0, step="s:claim"))
+        elif s_phase == "write":
+            if mutant == "complete_before_write" and s_w == 0 \
+                    and s_phase != "completed":
+                # MUTANT: the COMPLETE control message leaves first
+                succ.append(mk(sr=sr + (("complete", s_k, s_grant),),
+                               s_phase="write2", step="s:complete!early"))
+            elif s_w < words:
+                nm = list(mem)
+                nm[s_w] = ("pay", s_k, s_w)
+                succ.append(mk(mem=tuple(nm), s_w=s_w + 1,
+                               step=f"s:w{s_w}"))
+            else:
+                succ.append(mk(sr=sr + (("complete", s_k, s_grant),),
+                               s_phase="idle", s_k=s_k + 1,
+                               s_used=s_used + 1,
+                               s_grant=s_grant if standing else 0,
+                               step="s:complete"))
+        elif s_phase == "write2":  # mutant: stores after the early complete
+            if s_w < words:
+                nm = list(mem)
+                nm[s_w] = ("pay", s_k, s_w)
+                succ.append(mk(mem=tuple(nm), s_w=s_w + 1,
+                               step=f"s:w{s_w}"))
+            else:
+                succ.append(mk(s_phase="idle", s_k=s_k + 1,
+                               s_used=s_used + 1,
+                               s_grant=s_grant if standing else 0,
+                               step="s:done"))
+    if with_death and s_alive:
+        succ.append(mk(s_alive=False, step="s:die"))
+
+    # ---- receiver control loop ----
+    if r_phase == "ctrl" and sr:
+        kind = sr[0][0]
+        if kind == "offer":
+            # grant only a FREE region (granted/aliased = pool empty; the
+            # offer defers — the implementation would refuse-and-fallback,
+            # which is outside this model's scope), and never after close
+            # (a closed link refuses every op — granting after the peer's
+            # death released everything would leak the region forever)
+            if not r_lease and alias is None and not closed:
+                lease = len(delivered) + s_used + 1  # unique enough
+                succ.append(mk(sr=sr[1:], r_lease=lease,
+                               rs=rs + (("claim", lease),),
+                               step="r:claim"))
+        else:  # complete
+            _, k, lease = sr[0]
+            if lease and lease == r_lease:
+                succ.append(mk(sr=sr[1:], r_phase="deliver", r_k=k, r_w=0,
+                               step="r:begin"))
+            else:
+                # unknown/never-claimed lease: the implementation drops the
+                # completion (the message is LOST — quiescence catches it)
+                succ.append(mk(sr=sr[1:], step="r:drop"))
+    elif r_phase == "deliver":
+        if r_w < words:
+            word = mem[r_w]
+            if word != ("pay", r_k, r_w):
+                raise Violation(
+                    "torn", f"delivery of message {r_k} read {word} at "
+                    f"word {r_w}", list(trace) + [f"r:r{r_w}"])
+            succ.append(mk(r_w=r_w + 1, step=f"r:r{r_w}"))
+        else:
+            succ.append(mk(r_phase="ctrl", delivered=delivered + (r_k,),
+                           alias=(r_k,),
+                           r_lease=r_lease if standing else 0,
+                           step="r:deliver"))
+
+    # ---- consumer: holds the alias, then frees (weakref-finalize) ----
+    if alias is not None:
+        for j in range(words):
+            if mem[j] != ("pay", alias[0], j):
+                raise Violation(
+                    "overwrite", f"region overwritten while message "
+                    f"{alias[0]}'s delivery is still aliased: word {j} = "
+                    f"{mem[j]}", list(trace) + ["c:free"])
+        succ.append(mk(alias=None, doorbell=doorbell + 1, step="c:free"))
+
+    # ---- receiver close after peer death ----
+    if not s_alive and not closed:
+        succ.append(mk(r_lease=0, closed=True, step="r:close"))
+
+    return succ
+
+
 # -- suites ------------------------------------------------------------------
 
 def default_suite(verbose: bool = False) -> List[CheckResult]:
@@ -649,6 +879,48 @@ def default_suite(verbose: bool = False) -> List[CheckResult]:
         if verbose:
             print(f"  {res!r}")
     out.extend(handoff_default_suite(verbose=verbose))
+    out.extend(rendezvous_default_suite(verbose=verbose))
+    return out
+
+
+def rendezvous_default_suite(verbose: bool = False) -> List[CheckResult]:
+    """Clean rendezvous configs (tpurpc-express, ISSUE 9): solicited and
+    standing modes, multi-message reuse, and sender-death-at-every-point
+    runs proving a claimed region always releases."""
+    configs = [
+        dict(messages=2, words=2, standing=True),
+        dict(messages=2, words=2, standing=False),
+        dict(messages=3, words=2, standing=True),
+        dict(messages=2, words=3, standing=False),
+        dict(messages=2, words=2, standing=True, with_death=True),
+        dict(messages=2, words=2, standing=False, with_death=True),
+    ]
+    out = []
+    for cfg in configs:
+        res = check_rendezvous(**cfg)
+        out.append(res)
+        if verbose:
+            print(f"  {res!r}")
+    return out
+
+
+def rendezvous_mutant_kill_suite(verbose: bool = False) -> Dict[str, bool]:
+    """Every seeded rendezvous mutant must produce a violation in at least
+    one mode."""
+    out = {}
+    for mutant in RDV_MUTANTS:
+        killed = False
+        for standing in (True, False):
+            res = check_rendezvous(messages=2, words=2, standing=standing,
+                                   mutant=mutant)
+            if not res.ok:
+                killed = True
+                if verbose:
+                    print(f"  mutant {mutant}: KILLED — {res.violation}")
+                break
+        if not killed and verbose:
+            print(f"  mutant {mutant}: SURVIVED")
+        out[mutant] = killed
     return out
 
 
@@ -681,4 +953,5 @@ def mutant_kill_suite(verbose: bool = False) -> Dict[str, bool]:
             print(f"  mutant {mutant}: SURVIVED")
         out[mutant] = killed
     out.update(handoff_mutant_kill_suite(verbose=verbose))
+    out.update(rendezvous_mutant_kill_suite(verbose=verbose))
     return out

@@ -579,6 +579,15 @@ class Pair:
     def local_address(self) -> Address:
         assert self.state in (PairState.INITIALIZED, PairState.CONNECTED)
         caps = ["waitflag"] if _native.load() is not None else []
+        # tpurpc-express (ISSUE 9): advertise the rendezvous capability in
+        # the bootstrap blob — a ring-plane connection then arms its bulk
+        # plane at CONNECT TIME (core/rendezvous.py), with no hello round
+        # trip to race the first big payload. Import-cycle-free probe: the
+        # env gate lives in the rendezvous module, but pair must not
+        # import it (rendezvous imports pair), so read the switch directly.
+        if os.environ.get("TPURPC_RENDEZVOUS", "1").lower() not in (
+                "0", "off", "false"):
+            caps.append("rdv")
         return Address(self.tag, self.domain.kind, self.ring_size,
                        self.recv_region.handle, self.status_region.handle,
                        caps=caps)

@@ -1,0 +1,1089 @@
+"""tpurpc-express: one-sided rendezvous transfers for bulk tensor payloads.
+
+The paper's real thesis ("RPC Considered Harmful", arXiv:1805.08430) is that
+large DL tensors should not ride the framed request/response path at all:
+chunked ring framing pays per-chunk credit handshakes, per-fragment headers,
+and a receive-side landing copy for every payload byte. This module moves
+any payload over a size bar the way the reference moves every payload —
+as ONE one-sided write into a peer-advertised registered landing region
+(RDMAbox, arXiv:2104.12197: merged writes into pre-registered regions) —
+while the framed RPC carries only a small offer/claim/complete control
+exchange:
+
+    sender                                  receiver
+    ------                                  --------
+    OFFER(req, nbytes, kinds)  ──────────►  lease landing region from pool
+                               ◄──────────  CLAIM(req, lease, region descr)
+    one-sided write of every
+    gather segment into the
+    region (RDMA WRITE on the
+    verbs domain; ONE memoryview
+    copy on shm/local/tcp_window)
+    COMPLETE(lease, nbytes, flags) ───────► deliver region view zero-copy
+                                            (decode aliases it in place)
+
+Every control message rides the existing framed connection, so ordering
+with interleaved small MESSAGEs is free (frame arrival order), and a peer
+that never negotiated the capability never sees an unknown frame.
+
+Protocol invariants (modeled exhaustively in ``analysis/ringcheck.py
+check_rendezvous``; mutants ``write_before_claim`` and
+``complete_before_write`` are both killed):
+
+* the sender writes a region only between CLAIM and COMPLETE/RELEASE;
+* a region is reused only after COMPLETE (and, in this emulation, after
+  every consumer alias died — the pool's weakref-finalize recycling) or
+  after an explicit RELEASE;
+* peer death with a claimed region releases it (``RdvLink.close``).
+
+Steady-state fast path: after each completed transfer the receiver
+PRE-GRANTS a fresh claim of the same size class (req id 0), so a stream of
+same-shaped tensors pays zero claim round trips — the RDMAbox
+pre-registered-buffer discipline. Pre-granted transfers emit no flight
+events (edges, not traffic); solicited offers/claims/releases do, which is
+exactly the evidence the stall watchdog's ``rendezvous`` stage reads.
+
+Lifetime/recycling: a delivered payload is a numpy wrapper over the landing
+region. Every downstream alias — codec decode views, 64B-aligned dlpack
+imports into jax.Arrays — transitively references the wrapper, so a
+``weakref.finalize`` on it is a sound "no consumer can observe this memory"
+signal; only then does the region return to the pool's free list. Consumers
+that copy simply never pin.
+
+Env knobs: ``TPURPC_RENDEZVOUS`` (default on), ``TPURPC_RENDEZVOUS_MIN_KB``
+(size bar, default 256 — bench ``stream_by_size`` measures the crossover),
+``TPURPC_RENDEZVOUS_POOL_MB`` (landing pool budget per domain, default 256),
+``TPURPC_RENDEZVOUS_CLAIM_TIMEOUT_S`` (claim wait before falling back to
+the framed path, default 5).
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import struct
+import threading
+import time
+import weakref
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tpurpc.analysis.locks import make_condition, make_lock
+from tpurpc.core import pair as _pair
+from tpurpc.obs import flight as _flight
+from tpurpc.obs import lens as _lens
+from tpurpc.obs import metrics as _metrics
+from tpurpc.obs import profiler as _profiler
+from tpurpc.tpu import ledger as _ledger
+
+__all__ = [
+    "LandingPool", "RegionLease", "RdvLink", "landing_pool",
+    "link_for_endpoint", "enabled", "min_bytes", "size_class",
+    "OP_OFFER", "OP_CLAIM", "OP_COMPLETE", "OP_RELEASE", "HELLO_PAYLOAD",
+]
+
+# tpurpc-lens: the one-sided bulk write is its own waterfall hop — the
+# bytes that no longer flow through wire/send_ring show up here
+_LENS_RDV_BYTES, _LENS_RDV_NS, _LENS_RDV_COPY = _lens.hop_counters(
+    "rendezvous")
+
+_LENS_STAGES = {
+    "send_message": "rendezvous",
+    "_rdv_write": "rendezvous",
+    "rdv_claim": "rendezvous",
+    "on_offer": "rendezvous",
+    "on_complete": "rendezvous",
+}
+_profiler.register_stages(__file__, _LENS_STAGES)
+
+#: transfers negotiated / completed / fallen back — the ops-facing truth of
+#: whether the bulk plane is actually carrying traffic
+_RDV_SENT = _metrics.counter("rdv_transfers_sent")
+_RDV_RECV = _metrics.counter("rdv_transfers_received")
+_RDV_FALLBACK = _metrics.counter("rdv_fallbacks")
+_RDV_REFUSED = _metrics.counter("rdv_claims_refused")
+
+# -- control ops (canonical small ints; each wire plane maps them onto its
+#    own frame vocabulary — frame.py types 8..11, h2 extension-frame flags)
+OP_OFFER = 1
+OP_CLAIM = 2
+OP_COMPLETE = 3
+OP_RELEASE = 4
+
+#: capability hello for the native framing plane: a PING with this payload.
+#: Any compliant peer (including the C plane and older builds) just echoes
+#: it in a PONG; only a rendezvous-capable peer ALSO recognizes it and
+#: arms its link — so the negotiation is safe against every deployed peer.
+HELLO_PAYLOAD = b"\x00tpurpc-rdv1"
+
+_OFFER = struct.Struct("<QQ")       # req_id, nbytes (+ kinds utf8 tail)
+_CLAIM_HDR = struct.Struct("<QQB")  # req_id, lease_id, ok
+_CLAIM_REG = struct.Struct("<QQ16sB")  # offset, capacity, nonce, standing
+_COMPLETE = struct.Struct("<QQB")   # lease_id, nbytes, flags
+_RELEASE = struct.Struct("<QQ")     # lease_id (0 = none), req_id
+_DOORBELL = struct.Struct("<Q")     # consumer-freed count (see below)
+
+_MIN_CLASS = 64 * 1024
+_ALIGN = 64
+_NONCE_BYTES = 16
+_MAX_TRANSFER = 1 << 30  # sanity bound on one offer
+_WINDOW_CACHE = 64       # open peer-region windows kept per link
+#: standing claims per (link, size class). Sized so a pipelined sender
+#: (bounded stream-credit window) never waits a claim round trip in steady
+#: state — misses re-pay ~0.8 ms on the 1-core rig (measured; 18/64
+#: messages missed at depth 2, zero at 4).
+_PREGRANT_DEPTH = 4
+
+_SENTINEL_PENDING = object()
+_SENTINEL_REFUSED = object()
+
+#: test seams (tests/test_chaos.py, tools/rendezvous_smoke.py): a receiver
+#: with drop_offers set ignores OFFERs entirely (claim-starved sender); a
+#: sender with wedge_after_claim set blocks there until the event fires or
+#: the link dies (peer-death-mid-rendezvous chaos scenario)
+TEST_HOOKS: Dict[str, object] = {}
+
+
+def _env(name: str, default: str) -> str:
+    return os.environ.get(name, default)
+
+
+def enabled() -> bool:
+    return _env("TPURPC_RENDEZVOUS", "1").lower() not in ("0", "off",
+                                                          "false")
+
+
+def min_bytes() -> int:
+    """The size bar: payloads at or above it rendezvous, below it they keep
+    today's framed path untouched. Read live (the bench A/B toggles it)."""
+    try:
+        return max(1, int(_env("TPURPC_RENDEZVOUS_MIN_KB", "256"))) * 1024
+    except ValueError:
+        return 256 * 1024
+
+
+def _pool_budget() -> int:
+    try:
+        return max(1, int(_env("TPURPC_RENDEZVOUS_POOL_MB", "256"))) << 20
+    except ValueError:
+        return 256 << 20
+
+
+def _claim_timeout() -> float:
+    try:
+        return float(_env("TPURPC_RENDEZVOUS_CLAIM_TIMEOUT_S", "5"))
+    except ValueError:
+        return 5.0
+
+
+def size_class(nbytes: int) -> int:
+    """Round a transfer size up to its pool size class (power of two,
+    floor 64 KiB) — the granularity at which regions pool and pre-grants
+    match."""
+    if nbytes > _MAX_TRANSFER:
+        raise ValueError(f"transfer of {nbytes} bytes exceeds the "
+                         f"{_MAX_TRANSFER} rendezvous bound")
+    c = _MIN_CLASS
+    while c < nbytes:
+        c <<= 1
+    return c
+
+
+# ---------------------------------------------------------------------------
+# Landing pool: registered regions the receiver advertises.
+# ---------------------------------------------------------------------------
+
+class _PoolRegion:
+    """One registered landing region: domain Region + the 64B alignment
+    offset of its payload span + the anti-mixup nonce and the consumer-done
+    DOORBELL word behind it (layout: ``[pad][payload cap][nonce 16]
+    [doorbell 8]``)."""
+
+    __slots__ = ("region", "offset", "capacity", "nonce")
+
+    def __init__(self, region: _pair.Region, offset: int, capacity: int,
+                 nonce: bytes):
+        self.region = region
+        self.offset = offset
+        self.capacity = capacity
+        self.nonce = nonce
+
+    def doorbell_store(self, value: int) -> None:
+        """Publish the consumer-freed count INTO the region, where the
+        sender reads it through its already-open window — the zero-frame
+        "this region is reusable" signal (RDMAbox's pre-registered-buffer
+        discipline without a control message per transfer). Plain stores
+        suffice on TSO hardware: the count is monotonic and the sender
+        orders its payload write after the matching read by program order;
+        non-view domains (verbs/tcp_window) never read it and stay on
+        explicit grant frames."""
+        _DOORBELL.pack_into(self.region.buf,
+                            self.offset + self.capacity + _NONCE_BYTES,
+                            value)
+
+
+class RegionLease:
+    """A pool region claimed for transfers on one link.
+
+    Two lifetimes: a one-shot lease (solicited claim) delivers once and
+    recycles when the delivered wrapper's last alias dies; a STANDING
+    lease (``standing=True``, the steady-state grant) stays claimed across
+    many transfers — after each delivery the wrapper's death rings the
+    region's doorbell instead of recycling, and the sender reuses the
+    region with no further control traffic."""
+
+    __slots__ = ("pool", "pr", "lease_id", "cls", "kind", "pregrant",
+                 "standing", "delivered", "_freed", "_retired", "_recycled",
+                 "_discard", "_lock")
+
+    def __init__(self, pool: "LandingPool", pr: _PoolRegion, lease_id: int,
+                 cls: int):
+        self.pool = pool
+        self.pr = pr
+        self.lease_id = lease_id
+        self.cls = cls
+        self.kind = pool.kind
+        self.pregrant = False
+        self.standing = False
+        self.delivered = 0
+        self._freed = 0
+        self._retired = False
+        self._recycled = False
+        self._discard = False
+        self._lock = threading.Lock()
+
+    def _maybe_recycle_locked(self) -> bool:
+        """The ONE recycle rule: a region returns to the pool exactly once,
+        when no further delivery can happen (retired, or a one-shot lease
+        already delivered) AND no delivered wrapper is still aliased."""
+        if self._recycled:
+            return False
+        done = self._retired or (self.delivered > 0 and not self.standing)
+        if done and self._freed == self.delivered:
+            self._recycled = True
+            return True
+        return False
+
+    def claim_fields(self) -> Tuple[str, str, int, int, bytes, bool]:
+        pr = self.pr
+        return (self.kind, pr.region.handle, pr.offset, pr.capacity,
+                pr.nonce, self.standing)
+
+    def deliver(self, nbytes: int):
+        """The received payload as a writable buffer aliasing the region.
+        Region reuse is gated on the wrapper's death: every consumer alias
+        (decode views, aligned dlpack imports) transitively references it,
+        so the finalize fires only when no consumer can observe the memory
+        anymore — then a one-shot lease recycles to the pool and a
+        standing lease rings the doorbell for the sender."""
+        with self._lock:
+            if self._retired or (self.delivered and not self.standing):
+                raise RuntimeError("lease already settled")
+            if nbytes > self.pr.capacity:
+                raise ValueError(f"complete of {nbytes} exceeds leased "
+                                 f"capacity {self.pr.capacity}")
+            if self.standing and self.delivered != self._freed:
+                # the sender reused a standing region before its previous
+                # wrapper died — a protocol violation the doorbell exists
+                # to prevent; refuse the delivery rather than hand out a
+                # second alias over live memory
+                raise RuntimeError("standing region completed while its "
+                                   "previous delivery is still aliased")
+            self.delivered += 1
+            gen = self.delivered
+        wrapper = np.frombuffer(self.pr.region.buf, np.uint8, count=nbytes,
+                                offset=self.pr.offset)
+        weakref.finalize(wrapper, self._on_wrapper_dead, gen)
+        # hand out a memoryview OVER the wrapper (not the ndarray itself):
+        # the stream layer treats message bodies as buffers (`body in
+        # (sentinels)` must stay a scalar check), and every consumer alias
+        # still chains to the wrapper, so the finalize stays sound
+        return memoryview(wrapper)
+
+    def _on_wrapper_dead(self, gen: int) -> None:
+        with self._lock:
+            self._freed = max(self._freed, gen)
+            recycle = self._maybe_recycle_locked()
+            discard = self._discard
+            ring = self.standing and not self._retired
+        if recycle:
+            self.pool._recycle(self.pr, self.cls, discard=discard)
+        elif ring:
+            self.pr.doorbell_store(gen)
+
+    def release(self, discard: bool = False) -> None:
+        """Return the region without (further) delivery: refused/aborted
+        transfer, or link teardown with the region claimed/standing. If a
+        delivered wrapper is still aliased, the actual recycle defers to
+        its finalize.
+
+        ``discard=True`` (the PEER-DEATH path): the region is destroyed
+        instead of pooled — a straggling sender on the dead connection may
+        still hold a window and land a late one-sided write, which must hit
+        orphaned memory, never a region re-leased to a new transfer (the
+        same stale-write rule Pair.init enforces by never reusing ring
+        regions across connections)."""
+        with self._lock:
+            self._retired = True
+            if discard:
+                self._discard = True
+            recycle = self._maybe_recycle_locked()
+            discard = self._discard
+        if recycle:
+            self.pool._recycle(self.pr, self.cls, discard=discard)
+
+
+class LandingPool:
+    """Per-domain pool of registered, 64B-aligned landing regions.
+
+    Regions are allocated from the :class:`~tpurpc.core.pair.MemoryDomain`
+    named by ``kind`` (shm for cross-process on one host, the pair's own
+    domain on ring planes, verbs on RDMA hardware), pooled by power-of-two
+    size class under a byte budget, and recycled only when provably
+    unobservable (see :meth:`RegionLease.deliver`)."""
+
+    def __init__(self, kind: str, budget: Optional[int] = None):
+        self.kind = kind
+        self._domain = _pair.make_domain(kind)
+        self._lock = make_lock("LandingPool._lock")
+        self._free: Dict[int, List[_PoolRegion]] = {}
+        #: discarded (death-quarantined) regions still pinned by consumer
+        #: aliases; close retried on later pool activity, never re-leased
+        self._zombies: List[_PoolRegion] = []
+        self._allocated = 0
+        self._budget = budget if budget is not None else _pool_budget()
+
+    @staticmethod
+    def _try_close(pr: _PoolRegion) -> bool:
+        """Non-blocking best-effort region destruction (the GC-callback
+        discard path must never sit in Region.close's bounded retry)."""
+        try:
+            pr.region.buf.release()
+        except BufferError:
+            return False
+        try:
+            pr.region._close()
+        except Exception:
+            pass  # the mapping is gone either way at process exit
+        return True
+
+    def lease(self, nbytes: int, lease_id: int) -> Optional[RegionLease]:
+        """A region of capacity ≥ ``nbytes``, or None when the budget is
+        exhausted (the claim is then refused and the sender falls back to
+        the framed path — degradation, never a deadlock)."""
+        cls = size_class(nbytes)
+        with self._lock:
+            zombies, self._zombies = self._zombies, []
+        if zombies:  # retry quarantined closes off the hot path
+            still = [pr for pr in zombies if not self._try_close(pr)]
+            if still:
+                with self._lock:
+                    self._zombies.extend(still)
+        with self._lock:
+            bucket = self._free.get(cls)
+            if bucket:
+                pr = bucket.pop()
+                pr.doorbell_store(0)  # fresh lease: no consumer history
+                return RegionLease(self, pr, lease_id, cls)
+            alloc_bytes = cls + _ALIGN + _NONCE_BYTES + _DOORBELL.size
+            if self._allocated + alloc_bytes > self._budget:
+                return None
+            self._allocated += alloc_bytes
+        try:
+            region = self._domain.alloc(alloc_bytes)
+        except Exception:
+            with self._lock:
+                self._allocated -= alloc_bytes
+            return None
+        base = np.frombuffer(region.buf, np.uint8)
+        offset = int((-base.ctypes.data) % _ALIGN)
+        del base
+        nonce = os.urandom(_NONCE_BYTES)
+        region.buf[offset + cls:offset + cls + _NONCE_BYTES] = nonce
+        return RegionLease(self, _PoolRegion(region, offset, cls, nonce),
+                           lease_id, cls)
+
+    def _recycle(self, pr: _PoolRegion, cls: int,
+                 discard: bool = False) -> None:
+        if discard:
+            # death-path quarantine: never re-lease a region a straggling
+            # peer window might still write; destroy it (deferred to the
+            # zombie sweep while consumer aliases pin the mapping)
+            with self._lock:
+                self._allocated -= (pr.capacity + _ALIGN + _NONCE_BYTES
+                                    + _DOORBELL.size)
+            if not self._try_close(pr):
+                with self._lock:
+                    self._zombies.append(pr)
+            return
+        with self._lock:
+            self._free.setdefault(cls, []).append(pr)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "allocated_bytes": self._allocated,
+                "free_regions": sum(len(v) for v in self._free.values()),
+            }
+
+    def trim(self) -> None:
+        """Release every pooled free region back to the OS (atexit / test
+        isolation). In-flight and alias-pinned regions are untouched."""
+        with self._lock:
+            buckets, self._free = self._free, {}
+            for bucket in buckets.values():
+                for pr in bucket:
+                    self._allocated -= (pr.capacity + _ALIGN + _NONCE_BYTES
+                                        + _DOORBELL.size)
+        for bucket in buckets.values():
+            for pr in bucket:
+                try:
+                    pr.region.close()
+                except Exception:
+                    pass  # an alias raced the trim; the region stays mapped
+
+
+_pools: Dict[str, LandingPool] = {}
+_pools_lock = threading.Lock()
+
+
+def landing_pool(kind: str) -> LandingPool:
+    """The process-wide landing pool for one domain kind (regions are
+    shared across connections; per-link lease registries keep the death-
+    release story per connection)."""
+    pool = _pools.get(kind)
+    if pool is None:
+        with _pools_lock:
+            pool = _pools.get(kind)
+            if pool is None:
+                pool = _pools[kind] = LandingPool(kind)
+    return pool
+
+
+def _trim_pools_atexit() -> None:
+    for pool in list(_pools.values()):
+        pool.trim()
+    # Regions still pinned by live consumer aliases (an app holding a
+    # decoded tensor at exit) cannot close; at interpreter teardown their
+    # SharedMemory destructors would each print an unraisable BufferError
+    # ("Exception ignored in __del__") for a condition that is expected and
+    # harmless — the OS reclaims the mappings with the process. Neutralize
+    # the destructor AFTER the orderly trim; explicit close paths all ran
+    # (or can no longer run) by now.
+    try:
+        from multiprocessing import shared_memory
+
+        shared_memory.SharedMemory.__del__ = lambda self: None
+    except Exception:
+        pass
+
+
+import atexit  # noqa: E402  (registration belongs next to what it cleans)
+
+atexit.register(_trim_pools_atexit)
+
+
+# ---------------------------------------------------------------------------
+# Wire payload codecs (control messages are tiny; clarity over cleverness).
+# ---------------------------------------------------------------------------
+
+def _pack_offer(req_id: int, nbytes: int, kinds: Sequence[str]) -> bytes:
+    return _OFFER.pack(req_id, nbytes) + ",".join(kinds).encode()
+
+
+def _unpack_offer(payload) -> Tuple[int, int, List[str]]:
+    buf = bytes(payload)
+    req_id, nbytes = _OFFER.unpack_from(buf)
+    kinds = buf[_OFFER.size:].decode() or ""
+    return req_id, nbytes, [k for k in kinds.split(",") if k]
+
+
+def _pack_claim(req_id: int, lease: Optional[RegionLease]) -> bytes:
+    if lease is None:
+        return _CLAIM_HDR.pack(req_id, 0, 0)
+    kind, handle, offset, capacity, nonce, standing = lease.claim_fields()
+    kb = kind.encode()
+    return (_CLAIM_HDR.pack(req_id, lease.lease_id, 1)
+            + _CLAIM_REG.pack(offset, capacity, nonce, 1 if standing else 0)
+            + bytes([len(kb)]) + kb + handle.encode())
+
+
+class _Claim:
+    """Sender-side view of a claimed region. A STANDING claim is reusable:
+    after each COMPLETE the sender bumps ``used`` and may write again only
+    once the region's doorbell word (consumer-freed count, stored by the
+    receiver's wrapper finalize) catches up — zero control frames per
+    steady-state transfer."""
+
+    __slots__ = ("lease_id", "kind", "handle", "offset", "capacity",
+                 "nonce", "standing", "used", "inflight")
+
+    def __init__(self, lease_id, kind, handle, offset, capacity, nonce,
+                 standing=False):
+        self.lease_id = lease_id
+        self.kind = kind
+        self.handle = handle
+        self.offset = offset
+        self.capacity = capacity
+        self.nonce = nonce
+        self.standing = standing
+        self.used = 0
+        self.inflight = False  # a sender thread owns this claim right now
+
+
+def _unpack_claim(payload) -> Tuple[int, Optional[_Claim]]:
+    buf = bytes(payload)
+    req_id, lease_id, ok = _CLAIM_HDR.unpack_from(buf)
+    if not ok:
+        return req_id, None
+    pos = _CLAIM_HDR.size
+    offset, capacity, nonce, standing = _CLAIM_REG.unpack_from(buf, pos)
+    pos += _CLAIM_REG.size
+    klen = buf[pos]
+    pos += 1
+    kind = buf[pos:pos + klen].decode()
+    handle = buf[pos + klen:].decode()
+    return req_id, _Claim(lease_id, kind, handle, offset, capacity, nonce,
+                          standing=bool(standing))
+
+
+# ---------------------------------------------------------------------------
+# The link: one per framed connection, both roles.
+# ---------------------------------------------------------------------------
+
+class RdvLink:
+    """Rendezvous state for ONE framed connection: the sender role (offer,
+    one-sided write, complete) and the receiver role (pool leases, claims,
+    zero-copy delivery) — every connection carries both directions.
+
+    Transport-agnostic: the owning connection supplies ``send_op(op,
+    stream_id, payload)`` (frame the control message), ``deliver(stream_id,
+    flags, wrapper)`` (hand a completed payload to the stream layer), and
+    optionally ``pump(pred, deadline)`` for inline-pump transports where
+    the waiting sender must drive the reader itself."""
+
+    def __init__(self, name: str,
+                 send_op: Callable[[int, int, bytes], None],
+                 deliver: Callable[[int, int, object], None],
+                 pool_kinds: Sequence[str] = ("shm",),
+                 open_kinds: Sequence[str] = ("shm", "local"),
+                 pump: Optional[Callable] = None):
+        self._send_op = send_op
+        self._deliver = deliver
+        self._pool_kinds = tuple(pool_kinds)
+        self._open_kinds = tuple(open_kinds)
+        self._pump = pump
+        self._lock = make_lock("RdvLink._lock")
+        self._cond = make_condition("RdvLink._cond", self._lock)
+        self.negotiated = False
+        self.closed = False
+        #: reader-thread ident the sender must never block on (a claim wait
+        #: there would deadlock against the claim's own delivery)
+        self.disallowed_thread: Optional[int] = None
+        #: the connection's max_receive_message_length (None/negative =
+        #: unlimited): offers past it are REFUSED, pushing the transfer to
+        #: the framed path whose oversize machinery rejects it with the
+        #: proper RESOURCE_EXHAUSTED — the bulk plane must not become a
+        #: receive-limit bypass
+        self.recv_limit: Optional[int] = None
+        self._req_ids = itertools.count(1)
+        self._lease_ids = itertools.count(1)
+        self._reqs: Dict[int, dict] = {}            # sender: req -> state
+        self._grants: Dict[int, List[_Claim]] = {}  # sender: cls -> claims
+        self._leases: Dict[int, RegionLease] = {}   # receiver: id -> lease
+        self._req_lease: Dict[int, int] = {}        # receiver: req -> lease
+        self._pregrants_out: Dict[int, int] = {}    # receiver: cls -> count
+        self._windows: Dict[Tuple[str, str], _pair.Window] = {}
+        self._window_order: List[Tuple[str, str]] = []
+        self._domains: Dict[str, _pair.MemoryDomain] = {}
+        self._ftag = _flight.tag_for("rdv:" + name)
+
+    # -- negotiation ---------------------------------------------------------
+
+    def on_peer_hello(self, payload: bytes = b"") -> None:
+        """The peer demonstrated it speaks the rendezvous control frames
+        (hello PING on the native framing, the custom SETTINGS id on h2)."""
+        self.negotiated = True
+
+    # -- sender role ---------------------------------------------------------
+
+    def eligible(self, total: int, flags_compressed: bool = False) -> bool:
+        return (self.negotiated and not self.closed and enabled()
+                and not flags_compressed
+                and total >= min_bytes() and total <= _MAX_TRANSFER
+                and threading.get_ident() != self.disallowed_thread)
+
+    def send_message(self, stream_id: int, flags: int,
+                     segs: Sequence, total: int) -> bool:
+        """Move one whole MESSAGE payload via rendezvous. True when the
+        payload was placed and COMPLETE sent (the framed path must NOT also
+        send it); False to fall back to the framed path — refused claim,
+        timeout, write failure — never an exception for fallback cases."""
+        cls = size_class(total)
+        claim = self._take_grant(cls, total)
+        if claim is None:
+            claim = self.rdv_claim(stream_id, total, cls)
+        if claim is None:
+            _RDV_FALLBACK.inc()
+            return False
+        wedge = TEST_HOOKS.get("wedge_after_claim")
+        if wedge is not None:
+            while not wedge.wait(timeout=0.05):  # pragma: no cover - chaos
+                if self.closed:
+                    break
+        try:
+            self._rdv_write(claim, segs, total)
+        except BaseException:
+            self._drop_grant(claim)
+            self.rdv_release(claim)
+            _RDV_FALLBACK.inc()
+            return False
+        self.rdv_complete(claim, stream_id, flags, total)
+        _RDV_SENT.inc()
+        return True
+
+    def _take_grant(self, cls: int, total: int) -> Optional[_Claim]:
+        """A usable cached grant: a one-shot claim is consumed; a STANDING
+        claim is acquired (inflight flag) and reused only when its doorbell
+        shows every previous delivery's aliases died — the zero-frame
+        steady-state path."""
+        with self._lock:
+            if self.closed:
+                return None
+            bucket = list(self._grants.get(cls) or ())
+        for claim in bucket:
+            if claim.capacity < total:
+                continue
+            if not claim.standing:
+                with self._lock:
+                    b = self._grants.get(cls)
+                    if b is not None and claim in b:
+                        b.remove(claim)
+                        return claim
+                continue
+            with self._lock:
+                if claim.inflight:
+                    continue
+                claim.inflight = True
+            if self._standing_free(claim):
+                return claim
+            with self._lock:
+                claim.inflight = False
+        return None
+
+    def _standing_free(self, claim: _Claim) -> bool:
+        """Has the receiver's consumer freed every previous use? Reads the
+        region-resident doorbell word through the sender's mapped window —
+        no control frame. Non-view domains can't read it and answer False
+        (they stay on explicit offer/claim rounds)."""
+        try:
+            win = self._window_for(claim)
+        except Exception:
+            return False
+        view = win.view
+        if view is None:
+            return False
+        db = claim.offset + claim.capacity + _NONCE_BYTES
+        try:
+            (freed,) = _DOORBELL.unpack_from(view, db)
+        except (ValueError, struct.error):
+            return False
+        return freed == claim.used
+
+    def _drop_grant(self, claim: _Claim) -> None:
+        """Forget a cached grant after a failed write (its region is being
+        released): it must not be reused."""
+        with self._lock:
+            claim.inflight = False
+            b = self._grants.get(size_class(claim.capacity))
+            if b is not None and claim in b:
+                b.remove(claim)
+
+    def rdv_claim(self, stream_id: int, total: int,
+                  cls: int) -> Optional[_Claim]:
+        """OFFER the transfer and wait (pumping where the transport needs
+        it) for the peer's CLAIM. None = refused or timed out (the offer is
+        then explicitly abandoned with a RELEASE so a crossing claim frees
+        its region)."""
+        req = next(self._req_ids)
+        st = {"claim": _SENTINEL_PENDING}
+        with self._lock:
+            if self.closed:
+                return None
+            self._reqs[req] = st
+        _flight.emit(_flight.RDV_OFFER, self._ftag, req, total)
+        try:
+            self._send_op(OP_OFFER, stream_id,
+                          _pack_offer(req, total, self._open_kinds))
+        except Exception:
+            with self._lock:
+                self._reqs.pop(req, None)
+            return None
+        deadline = time.monotonic() + _claim_timeout()
+
+        def pred() -> bool:
+            return st["claim"] is not _SENTINEL_PENDING or self.closed
+
+        if self._pump is not None:
+            self._pump(pred, deadline)
+        else:
+            with self._cond:
+                while not pred():
+                    remain = deadline - time.monotonic()
+                    if remain <= 0:
+                        break
+                    self._cond.wait(remain)
+        with self._lock:
+            self._reqs.pop(req, None)
+            claim = st["claim"]
+        if claim is _SENTINEL_PENDING:
+            # timed out: abandon the offer — a claim crossing this release
+            # on the wire finds no pending request and is released by
+            # on_claim's unknown-request path
+            _flight.emit(_flight.RDV_RELEASE, self._ftag, 0, req)
+            try:
+                self._send_op(OP_RELEASE, 0, _RELEASE.pack(0, req))
+            except Exception:
+                pass
+            return None
+        if claim is _SENTINEL_REFUSED or claim is None:
+            return None
+        _flight.emit(_flight.RDV_CLAIM, self._ftag, req, claim.lease_id)
+        return claim
+
+    def _window_for(self, claim: _Claim) -> _pair.Window:
+        key = (claim.kind, claim.handle)
+        win = self._windows.get(key)
+        if win is not None:
+            return win
+        domain = self._domains.get(claim.kind)
+        if domain is None:
+            domain = self._domains[claim.kind] = _pair.make_domain(
+                claim.kind)
+        win = domain.open_window(claim.handle,
+                                 claim.offset + claim.capacity
+                                 + _NONCE_BYTES + _DOORBELL.size)
+        with self._lock:
+            self._windows[key] = win
+            self._window_order.append(key)
+            evict = None
+            if len(self._window_order) > _WINDOW_CACHE:
+                evict = self._windows.pop(self._window_order.pop(0), None)
+        if evict is not None:
+            try:
+                evict.close()
+            except Exception:
+                pass
+        return win
+
+    def _rdv_write(self, claim: _Claim, segs: Sequence, total: int) -> None:
+        """The one-sided placement: every gather segment lands directly in
+        the claimed region — no staging join, no landing copy on the other
+        side. One RDMA WRITE per segment on the verbs domain, one
+        memoryview copy per segment on the software domains."""
+        t0 = time.monotonic_ns()
+        win = self._window_for(claim)
+        view = win.view
+        off = claim.offset
+        if view is not None:
+            if claim.nonce and bytes(
+                    view[claim.offset + claim.capacity:
+                         claim.offset + claim.capacity + _NONCE_BYTES]
+                    ) != claim.nonce:
+                raise OSError("rendezvous region nonce mismatch: the "
+                              "claimed handle resolves to different memory "
+                              "on this host")
+            for seg in segs:
+                sv = memoryview(seg).cast("B")
+                view[off:off + len(sv)] = sv
+                off += len(sv)
+        else:
+            for seg in segs:
+                sv = memoryview(seg).cast("B")
+                win.write(off, sv)
+                off += len(sv)
+        _ledger.rdma_write(total)
+        dt = time.monotonic_ns() - t0
+        _LENS_RDV_NS.inc(dt)
+        _LENS_RDV_BYTES.inc(total)
+        _LENS_RDV_COPY.inc(total)
+
+    def rdv_complete(self, claim: _Claim, stream_id: int, flags: int,
+                     total: int) -> None:
+        if not claim.standing:
+            # solicited transfers are edges worth recording; standing-
+            # region reuse is steady-state traffic and stays silent (the
+            # flight recorder's edges-not-traffic contract)
+            _flight.emit(_flight.RDV_WRITE, self._ftag, claim.lease_id,
+                         total)
+            _flight.emit(_flight.RDV_COMPLETE, self._ftag, claim.lease_id,
+                         total)
+        with self._lock:
+            claim.used += 1
+            claim.inflight = False
+        self._send_op(OP_COMPLETE, stream_id,
+                      _COMPLETE.pack(claim.lease_id, total, flags & 0xFF))
+
+    def rdv_release(self, claim: _Claim) -> None:
+        """Abandon a claimed region without completing (write failure,
+        cancelled transfer): the peer frees it for reuse."""
+        _flight.emit(_flight.RDV_RELEASE, self._ftag, claim.lease_id, 0)
+        try:
+            self._send_op(OP_RELEASE, 0, _RELEASE.pack(claim.lease_id, 0))
+        except Exception:
+            pass
+
+    # -- receiver role -------------------------------------------------------
+
+    def on_op(self, op: int, stream_id: int, payload) -> None:
+        """Dispatch one control frame (called from the connection's reader/
+        pump). Never raises — a malformed control message degrades to a
+        refused/ignored transfer, not a dead connection."""
+        try:
+            if op == OP_OFFER:
+                self.on_offer(stream_id, payload)
+            elif op == OP_CLAIM:
+                self.on_claim(payload)
+            elif op == OP_COMPLETE:
+                self.on_complete(stream_id, payload)
+            elif op == OP_RELEASE:
+                self.on_release(payload)
+        except Exception:
+            from tpurpc.utils.trace import trace_endpoint
+
+            trace_endpoint.log("rendezvous control op %d failed", op)
+
+    def on_offer(self, stream_id: int, payload) -> None:
+        req, nbytes, kinds = _unpack_offer(payload)
+        _flight.emit(_flight.RDV_OFFER, self._ftag, req, nbytes)
+        if TEST_HOOKS.get("drop_offers"):
+            return  # chaos seam: starve the sender's claim wait
+        lease = self._lease_for(nbytes, kinds)
+        if lease is None:
+            _RDV_REFUSED.inc()
+            self._send_op(OP_CLAIM, stream_id, _pack_claim(req, None))
+            return
+        with self._lock:
+            if self.closed:
+                lease.release()
+                return
+            self._leases[lease.lease_id] = lease
+            self._req_lease[req] = lease.lease_id
+        _flight.emit(_flight.RDV_CLAIM, self._ftag, req, lease.lease_id)
+        self._send_op(OP_CLAIM, stream_id, _pack_claim(req, lease))
+
+    def _lease_for(self, nbytes: int, kinds: Sequence[str]
+                   ) -> Optional[RegionLease]:
+        if not enabled() or nbytes > _MAX_TRANSFER:
+            return None
+        limit = self.recv_limit
+        if limit is not None and limit >= 0 and nbytes > limit:
+            return None  # refusal → framed path → RESOURCE_EXHAUSTED there
+        for kind in self._pool_kinds:
+            if kind not in kinds:
+                continue
+            try:
+                lease = landing_pool(kind).lease(nbytes,
+                                                 next(self._lease_ids))
+            except Exception:
+                continue
+            if lease is not None:
+                return lease
+        return None
+
+    def on_claim(self, payload) -> None:
+        req, claim = _unpack_claim(payload)
+        if req == 0:
+            # unsolicited pre-grant: cache it for the next same-class send
+            if claim is not None:
+                with self._lock:
+                    if self.closed:
+                        pass  # receiver's close releases everything anyway
+                    else:
+                        self._grants.setdefault(claim.capacity,
+                                                []).append(claim)
+            return
+        with self._lock:
+            st = self._reqs.get(req)
+            if st is not None:
+                st["claim"] = claim if claim is not None \
+                    else _SENTINEL_REFUSED
+                self._cond.notify_all()
+                return
+        # the sender already gave up on this request (timeout raced the
+        # claim): hand the region straight back
+        if claim is not None:
+            try:
+                self._send_op(OP_RELEASE, 0,
+                              _RELEASE.pack(claim.lease_id, 0))
+            except Exception:
+                pass
+
+    def on_complete(self, stream_id: int, payload) -> None:
+        lease_id, nbytes, flags = _COMPLETE.unpack(bytes(payload))
+        with self._lock:
+            lease = self._leases.get(lease_id)
+            if lease is not None and not lease.standing:
+                # one-shot lease: consumed by this completion. STANDING
+                # leases stay claimed — the sender reuses the region on
+                # the doorbell with no further grants.
+                del self._leases[lease_id]
+                for r, lid in list(self._req_lease.items()):
+                    if lid == lease_id:
+                        del self._req_lease[r]
+        if lease is None:
+            return  # already released (crossed a release) — drop
+        if not lease.pregrant:
+            _flight.emit(_flight.RDV_COMPLETE, self._ftag, lease_id, nbytes)
+        try:
+            wrapper = lease.deliver(nbytes)
+        except Exception:
+            # protocol violation (oversized complete / reuse while the
+            # previous delivery is aliased): drop the region entirely —
+            # its pool recycle re-zeroes the doorbell, so a confused
+            # sender can never land bytes in it again
+            with self._lock:
+                self._leases.pop(lease_id, None)
+                if lease.pregrant:
+                    self._pregrants_out[lease.cls] = max(
+                        0, self._pregrants_out.get(lease.cls, 1) - 1)
+            lease.release(discard=True)  # a confused sender may write again
+            return
+        _RDV_RECV.inc()
+        cls, kind = lease.cls, lease.kind
+        self._deliver(stream_id, flags, wrapper)
+        self._maybe_pregrant(cls, kind)
+
+    def _maybe_pregrant(self, cls: int, kind: str) -> None:
+        """RDMAbox discipline: keep STANDING regions granted for the
+        classes the peer is actively streaming, topped up to
+        ``_PREGRANT_DEPTH``. A standing grant costs one claim frame EVER:
+        after each use the consumer-done signal rides the region's own
+        doorbell word, so steady-state transfers carry exactly one control
+        frame (the COMPLETE) and zero claim round trips."""
+        while True:
+            with self._lock:
+                if (self.closed or self._pregrants_out.get(
+                        cls, 0) >= _PREGRANT_DEPTH):
+                    return
+            try:
+                lease = landing_pool(kind).lease(cls, next(self._lease_ids))
+            except Exception:
+                return
+            if lease is None:
+                return
+            lease.pregrant = True
+            lease.standing = True
+            with self._lock:
+                if self.closed:
+                    lease.release()
+                    return
+                self._leases[lease.lease_id] = lease
+                self._pregrants_out[cls] = self._pregrants_out.get(cls,
+                                                                   0) + 1
+            try:
+                self._send_op(OP_CLAIM, 0, _pack_claim(0, lease))
+            except Exception:
+                with self._lock:
+                    self._leases.pop(lease.lease_id, None)
+                    self._pregrants_out[cls] = max(
+                        0, self._pregrants_out.get(cls, 1) - 1)
+                lease.release()
+                return
+
+    def on_release(self, payload) -> None:
+        lease_id, req = _RELEASE.unpack(bytes(payload))
+        with self._lock:
+            if not lease_id and req:
+                lease_id = self._req_lease.pop(req, 0)
+            lease = self._leases.pop(lease_id, None)
+            if lease is not None and lease.pregrant:
+                self._pregrants_out[lease.cls] = max(
+                    0, self._pregrants_out.get(lease.cls, 1) - 1)
+        if lease is not None:
+            _flight.emit(_flight.RDV_RELEASE, self._ftag, lease_id, req)
+            lease.release()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self) -> None:
+        """Connection teardown / peer death: every claimed region is
+        released back to its pool (the modeled peer-death invariant), every
+        waiting sender is woken to fall back or fail with the transport."""
+        with self._lock:
+            if self.closed:
+                return
+            self.closed = True
+            leases = list(self._leases.values())
+            self._leases.clear()
+            self._req_lease.clear()
+            self._pregrants_out.clear()
+            self._grants.clear()
+            windows = list(self._windows.values())
+            self._windows.clear()
+            self._window_order = []
+            self._cond.notify_all()
+        for lease in leases:
+            # teardown is an EDGE (once per connection death), so every
+            # claimed region's release is recorded — standing grants
+            # included; the postmortem's claim→death→release story needs it
+            _flight.emit(_flight.RDV_RELEASE, self._ftag,
+                         lease.lease_id, 0)
+            # DISCARD, don't pool: the peer (or a straggling sender thread
+            # on this dying connection) may still hold a window and land a
+            # late one-sided write — it must hit orphaned memory, never a
+            # region re-leased to a new transfer
+            lease.release(discard=True)
+        for win in windows:
+            try:
+                win.close()
+            except Exception:
+                pass
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "negotiated": int(self.negotiated),
+                "claimed_leases": len(self._leases),
+                "cached_grants": sum(len(v) for v in
+                                     self._grants.values()),
+            }
+
+
+def domains_for_endpoint(endpoint) -> Tuple[Tuple[str, ...],
+                                            Tuple[str, ...]]:
+    """(pool_kinds, open_kinds) for a connection over ``endpoint``.
+
+    Ring endpoints prefer the pair's own domain (the registered memory the
+    connection already trusts — verbs MRs on hardware, shm segments on one
+    host, tcp_window regions cross-host, whose shared ordered record
+    connection also sequences the COMPLETE after the payload); everything
+    else (plain TCP, h2) uses the shm pool, the one-host emulation of a
+    registered region. ``open_kinds`` is what OUR sender can open windows
+    into — a claim naming anything else is impossible to honor and the
+    receiver never issues one (it picks from the offer's kinds)."""
+    pair = getattr(endpoint, "pair", None)
+    pool: List[str] = []
+    if pair is not None:
+        kind = pair.domain.kind
+        if kind in ("shm", "local", "tcp_window", "verbs"):
+            pool.append(kind)
+    if "shm" not in pool:
+        pool.append("shm")
+    open_kinds = list(dict.fromkeys(pool + ["shm", "local"]))
+    return tuple(pool), tuple(open_kinds)
+
+
+def link_for_endpoint(endpoint, name: str,
+                      send_op: Callable[[int, int, bytes], None],
+                      deliver: Callable[[int, int, object], None],
+                      pump: Optional[Callable] = None
+                      ) -> Optional[RdvLink]:
+    """An armed-but-unnegotiated link for a new framed connection, or None
+    when rendezvous is disabled process-wide."""
+    if not enabled():
+        return None
+    pool_kinds, open_kinds = domains_for_endpoint(endpoint)
+    return RdvLink(name, send_op, deliver, pool_kinds=pool_kinds,
+                   open_kinds=open_kinds, pump=pump)

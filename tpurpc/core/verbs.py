@@ -22,7 +22,16 @@ Rendezvous: ``alloc`` registers the region AND creates its RC queue
 pair, embedding ``rkey/addr/qpn/lid/gid/psn`` in the region handle (the
 reference's Address carries lid/qpn/psn/gid the same way,
 ``address.h:24-31``); ``open_window`` creates the writer-side QP and
-connects it to those attrs. The reverse leg — the region owner
+connects it to those attrs. This is also what makes the domain a
+tpurpc-express landing-pool backend (ISSUE 9,
+``core/rendezvous.py LandingPool("verbs")``): a bulk-tensor CLAIM
+carries the verbs handle and the sender's one-sided payload write IS an
+RDMA WRITE into the registered landing region. Two verbs-specific
+consequences: the window exposes no host-readable ``view``, so the
+standing-region doorbell (consumer-done word read through the window)
+is unavailable and steady-state reuse stays on explicit grant frames;
+and the per-region write path rides the bounce-MR staging below, one
+post per gather segment. The reverse leg — the region owner
 connecting ITS QP to the writer's attrs, which real RC hardware requires
 before the first WRITE lands — is :meth:`VerbsDomain.accept_writer`, the
 integration point the pair bootstrap's capability negotiation calls

@@ -140,6 +140,51 @@ def encode_tensor(x) -> List[bytes]:
     return [head, payload]
 
 
+def encode_tensor_descriptor(x) -> Tuple[bytes, memoryview]:
+    """Descriptor-only encode for rendezvous'd tensors (tpurpc-express,
+    ISSUE 9): returns ``(descriptor, payload_view)`` where the descriptor
+    is the header+dims+pad bytes the framed control path carries, and the
+    payload view ALIASES the array's memory (or its d2h landing buffer) —
+    the bytes the one-sided rendezvous write places directly into the
+    peer's landing region. :func:`decode_tensor_external` is the inverse,
+    grafting the externally-landed payload back under the descriptor with
+    zero copies."""
+    head, payload = encode_tensor(x)
+    return bytes(head), memoryview(payload).cast("B")
+
+
+def decode_tensor_external(desc, payload) -> np.ndarray:
+    """Rebuild a tensor from a descriptor (control path) and its
+    externally-delivered payload (the rendezvous landing region). The
+    returned array is a zero-copy view over ``payload`` — with a
+    64B-aligned landing region (the pool guarantees it), ``to_jax``
+    dlpack-aliases it onward with no movement."""
+    view = memoryview(desc)
+    if len(view) < _HDR.size:
+        raise CodecError("short tensor descriptor")
+    magic, code, ndim, _, nbytes = _HDR.unpack_from(view, 0)
+    if magic != MAGIC:
+        raise CodecError(f"bad tensor magic {magic!r}")
+    try:
+        dt = _CODE_TO_DTYPE[code]
+    except KeyError:
+        raise CodecError(f"unknown dtype code {code}") from None
+    if len(view) < _HDR.size + 8 * ndim:
+        raise CodecError("short tensor descriptor dims")
+    shape = struct.unpack_from(f"<{ndim}q", view, _HDR.size) if ndim else ()
+    pv = memoryview(payload).cast("B")
+    if len(pv) < nbytes:
+        raise CodecError(f"external payload short: want {nbytes}, "
+                         f"have {len(pv)}")
+    expect = (int(np.prod(shape, dtype=np.int64)) * dt.itemsize
+              if ndim else dt.itemsize)
+    if expect != nbytes:
+        raise CodecError(f"shape/nbytes mismatch: {shape} x {dt} "
+                         f"!= {nbytes}")
+    flat = np.frombuffer(pv, dtype=np.uint8, count=nbytes)
+    return flat.view(dt).reshape(shape)
+
+
 def encode_tensor_bytes(x) -> bytes:
     # materializing convenience API (tests/interop): accumulate, don't join
     out = bytearray()

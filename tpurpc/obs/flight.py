@@ -86,6 +86,16 @@ SHARD_START = 29          # worker up; a1 = shard id, a2 = n_shards
 SHARD_EXIT = 30           # worker exited gracefully; a1 = shard id
 SHARD_DEATH = 31          # supervisor saw a worker die; a1 = shard id, a2 = wait status
 CONN_HANDOFF = 32         # supervisor passed an accepted fd; a1 = shard id
+# tpurpc-express (ISSUE 9): one-sided rendezvous bulk-tensor transfers.
+# Edges pair per link tag: OFFER(a1=req) closed by CLAIM(a1=req) or
+# RELEASE(a2=req); CLAIM opens a lease edge (a2=lease) closed by
+# COMPLETE(a1=lease) or RELEASE(a1=lease) — the watchdog's rendezvous-stage
+# evidence is an unmatched edge in this algebra.
+RDV_OFFER = 33            # a1 = request id, a2 = payload bytes
+RDV_CLAIM = 34            # a1 = request id (0 = cached grant), a2 = lease id
+RDV_WRITE = 35            # one-sided payload write done; a1 = lease id, a2 = bytes
+RDV_COMPLETE = 36         # a1 = lease id, a2 = bytes
+RDV_RELEASE = 37          # lease/offer abandoned; a1 = lease id (0 = none), a2 = request id
 
 EVENT_NAMES: Dict[int, str] = {
     PAIR_CONNECT: "pair-connect",
@@ -120,6 +130,11 @@ EVENT_NAMES: Dict[int, str] = {
     SHARD_EXIT: "shard-exit",
     SHARD_DEATH: "shard-death",
     CONN_HANDOFF: "conn-handoff",
+    RDV_OFFER: "rdv-offer",
+    RDV_CLAIM: "rdv-claim",
+    RDV_WRITE: "rdv-write",
+    RDV_COMPLETE: "rdv-complete",
+    RDV_RELEASE: "rdv-release",
 }
 
 #: batch-flush reason codes (a1 of BATCH_FLUSH) — mirrors the jaxshim

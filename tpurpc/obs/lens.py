@@ -81,6 +81,8 @@ HOPS: Tuple[Tuple[str, str], ...] = (
                "(codec encode, the device→host leg)"),
     ("send_ring", "RingWriter placement into the peer's receive ring"),
     ("wire", "transport boundary: pair one-sided send / TCP socket write"),
+    ("rendezvous", "one-sided bulk payload write into the peer-advertised "
+                   "landing region (tpurpc-express)"),
     ("peer_ring", "RingReader drain out of the local receive ring"),
     ("decode", "codec parse of wire bytes back into tensors"),
     ("hbm", "placement into the device-resident HBM landing ring"),
@@ -153,13 +155,21 @@ def waterfall() -> dict:
 
 def slowest_hop(rows: Optional[List[dict]] = None) -> Optional[str]:
     """The bottleneck hop: lowest effective GB/s among hops that actually
-    moved bytes (and spent time doing it). None before any traffic."""
+    moved bytes (and spent time doing it). None before any traffic.
+
+    Hops that carried under 1% of the busiest hop's bytes are excluded:
+    once the rendezvous plane carries the bulk payloads, the framed ``wire``
+    hop sees only control frames — a few KB at small-message rates — and a
+    control-only hop's low GB/s is not an upper bound on the BULK flow, so
+    naming it the bottleneck would be the instrument lying."""
     if rows is None:
         rows = waterfall()["hops"]
     live = [r for r in rows if r["bytes"] > 0 and r["busy_ms"] > 0]
     if not live:
         return None
-    return min(live, key=lambda r: r["gbps"])["hop"]
+    bar = max(r["bytes"] for r in live) * 0.01
+    bulk = [r for r in live if r["bytes"] >= bar]
+    return min(bulk or live, key=lambda r: r["gbps"])["hop"]
 
 
 def render_text(doc: Optional[dict] = None) -> str:

@@ -57,7 +57,8 @@ _obs_profiler.register_stages(__file__, _LENS_STAGES)
 _log = logging.getLogger("tpurpc.watchdog")
 
 STAGES = ("credit-starvation", "peer-not-reading", "h2-flow-control",
-          "batcher-wait", "poller-wake", "device-infer", "unknown")
+          "rendezvous", "batcher-wait", "poller-wake", "device-infer",
+          "unknown")
 
 #: anomaly counters (always-on registry): total trips + per-stage breakdown
 _TRIPS = _metrics.counter("watchdog_trips")
@@ -264,6 +265,11 @@ class StallWatchdog:
             since_ns=now_ns - 60_000_000_000, limit=512)
         open_lease = 0
         open_edges: Dict[tuple, int] = {}  # (begin_code, tag) -> t_ns
+        # tpurpc-express: unmatched rendezvous edges — an OFFER the peer
+        # never claimed ((tag, 'o', req)) or a claimed region never
+        # completed/released ((tag, 'l', lease)) — are the evidence a call
+        # is wedged INSIDE a bulk-tensor handoff, not in the ring/h2 path
+        open_rdv: Dict[tuple, int] = {}
         last_h2 = 0
         for e in events:
             code = e["code"]
@@ -279,6 +285,16 @@ class StallWatchdog:
                         open_edges.pop((b, e["tag"]), None)
             elif code == _flight.H2_WINDOW_EXHAUSTED:
                 last_h2 = e["t_ns"]
+            elif code == _flight.RDV_OFFER:
+                open_rdv[(e["tag"], "o", e["a1"])] = e["t_ns"]
+            elif code == _flight.RDV_CLAIM:
+                open_rdv.pop((e["tag"], "o", e["a1"]), None)
+                open_rdv[(e["tag"], "l", e["a2"])] = e["t_ns"]
+            elif code == _flight.RDV_COMPLETE:
+                open_rdv.pop((e["tag"], "l", e["a1"]), None)
+            elif code == _flight.RDV_RELEASE:
+                open_rdv.pop((e["tag"], "l", e["a1"]), None)
+                open_rdv.pop((e["tag"], "o", e["a2"]), None)
 
         def fleet_sum(name: str) -> float:
             m = _metrics.registry().metrics().get(name)
@@ -290,6 +306,7 @@ class StallWatchdog:
             "now_ns": now_ns,
             "open_lease": open_lease,
             "open_edges": open_edges,
+            "open_rdv": open_rdv,
             "last_h2_ns": last_h2,
             "pairs_write_stalled": fleet_sum("pairs_write_stalled"),
             "batcher_queue_depth": fleet_sum("batcher_queue_depth"),
@@ -305,6 +322,20 @@ class StallWatchdog:
             return ("credit-starvation",
                     "send-lease held: reserve without commit/abort in the "
                     "flight tail — the ring write lock is wedged")
+        open_rdv = ev.get("open_rdv") or {}
+        if open_rdv:
+            oldest = max(now - t for t in open_rdv.values())
+            # a fresh edge is a transfer IN PROGRESS (claim round trips are
+            # µs-scale); only an edge aged past half the stall floor is
+            # evidence of a wedge rather than of traffic
+            if oldest >= self.min_stall_s * 1e9 / 2:
+                offers = sum(1 for k in open_rdv if k[1] == "o")
+                claims = len(open_rdv) - offers
+                return ("rendezvous",
+                        f"bulk-tensor rendezvous wedged {oldest / 1e9:.2f}s:"
+                        f" {offers} offer(s) unanswered, {claims} claimed "
+                        "region(s) without complete/release in the flight "
+                        "tail")
         if starve_age or ev["pairs_write_stalled"] > 0:
             if starve_age > 2 * age_ns or (
                     starve_age > 3 * self.min_stall_s * 1e9):

@@ -25,6 +25,7 @@ from typing import (Callable, Iterable, Iterator, List, Optional, Sequence,
                     Tuple, Union)
 
 from tpurpc.analysis.locks import make_condition, make_lock
+from tpurpc.core import rendezvous as _rdv
 from tpurpc.core.endpoint import Endpoint, EndpointError, connect_endpoint
 from tpurpc.obs import flight as _flight
 from tpurpc.obs import metrics as _obs_metrics
@@ -152,6 +153,13 @@ class _ClientStream:
             self.assembly.take()  # stream already finished: drop
         return None
 
+    def commit_external(self, body) -> None:
+        """tpurpc-express: a rendezvous'd response payload — already whole,
+        already in its final resting buffer (the landing region the decode
+        will alias). Same credit backpressure as framed commits."""
+        if self._acquire_credit():
+            self.events.put(("message", body))
+
     def deliver_trailers(self, code: StatusCode, details: str, md) -> None:
         self.done = True
         self.events.put(("trailers", code, details, md))
@@ -243,6 +251,27 @@ class _Connection:
         self._flight_first_ok = False
         _flight.emit(_flight.CONN_CONNECT, self._ftag)
         self.writer.send_preface()
+        # tpurpc-express: arm the rendezvous link and say hello. The hello
+        # is a PING any peer (native C plane, older builds) safely echoes;
+        # only a rendezvous-capable peer recognizes it and replies with its
+        # own, which flips `negotiated` — until then every payload frames.
+        self.rdv = _rdv.link_for_endpoint(
+            endpoint, "chan:" + getattr(endpoint, "peer", "?"),
+            self._rdv_send_op, self._rdv_deliver)
+        self.writer.rdv = self.rdv
+        if self.rdv is not None:
+            self.rdv.recv_limit = max_recv_bytes
+            # ring planes negotiated at the PAIR BOOTSTRAP (Address.caps
+            # "rdv"): arm immediately — no hello round trip for the first
+            # bulk payload to race
+            pair = getattr(endpoint, "pair", None)
+            if pair is not None and "rdv" in getattr(pair, "peer_caps",
+                                                     ()):
+                self.rdv.on_peer_hello()
+            try:
+                self.writer.send(fr.PING, 0, 0, _rdv.HELLO_PAYLOAD)
+            except (EndpointError, OSError, fr.FrameError):
+                pass  # connection dying; normal paths surface it
         # Inline-pump discipline (the reference's pollset_work model,
         # SURVEY §3.4; the Python analog of TPURPC_NATIVE_INLINE_READ):
         # on ring platforms the WAITING CALLER pumps the transport itself,
@@ -255,6 +284,11 @@ class _Connection:
         self._pump_mode = self._pump_enabled(endpoint)
         self._pumping = False
         self._pump_cond = make_condition("_Connection._pump_cond", self._lock)
+        if self.rdv is not None and self._pump_mode:
+            # inline-pump transports: a sender waiting for a CLAIM must
+            # drive the reader itself (nobody else will) — hand the link
+            # the pump-wait primitive instead of its condition fallback
+            self.rdv._pump = self._pump_wait
         if self._pump_mode:
             self._start_backup_pump()
         else:
@@ -553,9 +587,33 @@ class _Connection:
 
         self._backup_handle = schedule(INTERVAL, tick)
 
+    # -- rendezvous plumbing (tpurpc-express) ---------------------------------
+
+    def _rdv_send_op(self, op: int, stream_id: int, payload: bytes) -> None:
+        self.writer.send(fr.RDV_FRAME_OF_OP[op], 0, stream_id, payload)
+
+    def _rdv_deliver(self, stream_id: int, flags: int, body) -> None:
+        """A completed rendezvous payload IS the stream's next message —
+        delivered in frame-arrival order, zero-copy (the body aliases the
+        landing region; credits/backpressure identical to framed commits)."""
+        with self._lock:
+            st = self._streams.get(stream_id)
+        if st is not None:
+            st.commit_external(body)
+
     def _dispatch(self, f: fr.Frame) -> None:
         if f.type == fr.PING:
+            if (self.rdv is not None
+                    and f.payload == _rdv.HELLO_PAYLOAD):
+                # capability hello: the peer speaks rendezvous (both sides
+                # send one proactively at connection start, so no echo)
+                self.rdv.on_peer_hello(f.payload)
             self.writer.send(fr.PONG, 0, 0, f.payload)
+            return
+        if f.type in fr.RDV_OP_OF_FRAME:
+            if self.rdv is not None:
+                self.rdv.on_op(fr.RDV_OP_OF_FRAME[f.type], f.stream_id,
+                               f.payload)
             return
         if f.type == fr.PONG:
             with self._lock:
@@ -652,6 +710,11 @@ class _Connection:
                 h.cancel()  # wheel ticks also re-check alive themselves
         graceful = "GOAWAY" in why or "closed" in why or "idle" in why
         _flight.emit(_flight.CONN_DEAD, self._ftag, 1 if graceful else 0)
+        if self.rdv is not None:
+            # peer gone mid-rendezvous: every claimed landing region is
+            # released (the modeled peer-death invariant) and any sender
+            # parked on a claim wakes to fall back/fail with the transport
+            self.rdv.close()
         trace_channel.log("connection dead: %s", why)
         for st in streams:
             st.deliver_failure(StatusCode.UNAVAILABLE, f"transport failed: {why}")

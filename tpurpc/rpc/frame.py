@@ -56,6 +56,21 @@ RST = 4
 PING = 5
 PONG = 6
 GOAWAY = 7
+# tpurpc-express (ISSUE 9) rendezvous control frames: the bulk payload
+# itself never rides a frame — it is one-sided-written into the receiver's
+# advertised landing region; these tiny control messages are all the framed
+# connection carries for a rendezvous'd MESSAGE. Only sent after the PING
+# capability hello proved the peer speaks them (core/rendezvous.py).
+RDV_OFFER = 8
+RDV_CLAIM = 9
+RDV_COMPLETE = 10
+RDV_RELEASE = 11
+
+#: canonical rendezvous op <-> native frame type (ops are transport-
+#: agnostic small ints; the h2 planes carry them in an extension frame)
+RDV_FRAME_OF_OP = {1: RDV_OFFER, 2: RDV_CLAIM, 3: RDV_COMPLETE,
+                   4: RDV_RELEASE}
+RDV_OP_OF_FRAME = {v: k for k, v in RDV_FRAME_OF_OP.items()}
 
 # flags
 FLAG_END_STREAM = 0x01  # sender half-closes this stream (ref: h2 END_STREAM)
@@ -132,7 +147,8 @@ class Frame:
 
     def __repr__(self) -> str:
         names = {1: "HEADERS", 2: "MESSAGE", 3: "TRAILERS", 4: "RST",
-                 5: "PING", 6: "PONG", 7: "GOAWAY"}
+                 5: "PING", 6: "PONG", 7: "GOAWAY", 8: "RDV_OFFER",
+                 9: "RDV_CLAIM", 10: "RDV_COMPLETE", 11: "RDV_RELEASE"}
         return (f"<Frame {names.get(self.type, self.type)} sid={self.stream_id} "
                 f"flags={self.flags:#x} len={len(self.payload)}>")
 
@@ -292,6 +308,12 @@ class FrameWriter:
 
         self._ep = endpoint
         self._lock = threading.Lock()
+        #: tpurpc-express: the connection's rendezvous link, bound by the
+        #: owning connection once constructed. When set, MESSAGE payloads
+        #: over the size bar are moved by a one-sided write into the
+        #: peer's landing region instead of fragmented frames; everything
+        #: below the bar (and every control frame) keeps this path.
+        self.rdv = None
         self._coalesce = coalesce
         self._max_coalesce = max_coalesce_bytes or self.MAX_COALESCE_BYTES
         self._pend_lock = threading.Lock()
@@ -315,6 +337,14 @@ class FrameWriter:
                 [memoryview(payload).cast("B")])
         segs = [s for s in segs if len(s)]
         total = sum(len(s) for s in segs)
+        rdv = self.rdv
+        if (rdv is not None and ftype == MESSAGE and total
+                and not (flags & (FLAG_NO_MESSAGE | FLAG_MORE))
+                and rdv.eligible(total,
+                                 flags_compressed=bool(
+                                     flags & FLAG_COMPRESSED))
+                and rdv.send_message(stream_id, flags, segs, total)):
+            return  # payload one-sided-written; COMPLETE already framed
         if ftype == MESSAGE and flags & FLAG_COMPRESSED:
             segs, total, did = _compress_segs(segs, total)
             if not did:  # incompressible: send as-is, clear the bit
@@ -371,6 +401,23 @@ class FrameWriter:
         On a ``coalesce=True`` writer, non-fragmented calls additionally
         combine ACROSS threads (see the class docstring).
         """
+        rdv = self.rdv
+        if rdv is not None:
+            for ftype, flags, _sid, payload in frames:
+                if ftype != MESSAGE or flags & (FLAG_NO_MESSAGE | FLAG_MORE):
+                    continue
+                n = (sum(len(s) for s in payload)
+                     if isinstance(payload, (list, tuple)) else len(payload))
+                if rdv.eligible(n, flags_compressed=bool(
+                        flags & FLAG_COMPRESSED)):
+                    # a rendezvous-bound payload in the batch: degrade to
+                    # ordered per-frame sends — the bulk member routes via
+                    # the one-sided plane, the rest frame normally, and
+                    # per-stream order is preserved because the COMPLETE
+                    # control frame is itself sent in sequence
+                    for f in frames:
+                        self.send(*f)
+                    return
         # Encode first: oversized-control-frame failures must surface
         # before any byte is written or queued (an aborted half-written
         # batch would corrupt the coalescing queue's FIFO contract).

@@ -25,6 +25,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import (Callable, Dict, Iterator, List, Optional,
                     Sequence, Tuple)
 
+from tpurpc.core import rendezvous as _rdv
 from tpurpc.core.endpoint import (Endpoint, EndpointError, EndpointListener,
                                   passthru_endpoint_pair)
 from tpurpc.obs import flight as _flight
@@ -478,6 +479,16 @@ class _ServerStream:
             self.half_closed = True
             self.requests.put(self._END)
 
+    def commit_external(self, body, end_stream: bool) -> None:
+        """tpurpc-express: a rendezvous'd request payload — already whole,
+        already in its final landing buffer (decode aliases it in place).
+        Same per-stream credit backpressure as framed commits."""
+        if self._acquire_credit():
+            self.requests.put(body)
+        if end_stream:
+            self.half_closed = True
+            self.requests.put(self._END)
+
     def cancel(self) -> None:
         if self.context is not None:
             self.context.cancel()
@@ -573,6 +584,25 @@ class _ServerConnection:
         self.draining = False  # GOAWAY sent; no new streams accepted
         self.streams_started = 0  # channelz SocketData counter
         self.last_frame = time.monotonic()  # any inbound frame refreshes
+        # tpurpc-express: the rendezvous link (big requests land one-sided
+        # in this side's pool; big responses go one-sided into the
+        # client's). Created BEFORE the reader starts so the client's
+        # capability hello can never race past an unarmed link.
+        self.rdv = _rdv.link_for_endpoint(
+            endpoint, "srv:" + getattr(endpoint, "peer", "?"),
+            self._rdv_send_op, self._rdv_deliver)
+        self.writer.rdv = self.rdv
+        if self.rdv is not None:
+            self.rdv.recv_limit = server.max_receive_message_length
+            # ring planes negotiated at the pair bootstrap (Address.caps)
+            pair = getattr(endpoint, "pair", None)
+            if pair is not None and "rdv" in getattr(pair, "peer_caps",
+                                                     ()):
+                self.rdv.on_peer_hello()
+            try:
+                self.writer.send(fr.PING, 0, 0, _rdv.HELLO_PAYLOAD)
+            except (EndpointError, OSError, fr.FrameError):
+                pass  # connection dying; the read loop surfaces it
         self._thread = threading.Thread(target=self._read_loop, daemon=True,
                                         name="tpurpc-srv-reader")
         self._thread.start()
@@ -687,6 +717,11 @@ class _ServerConnection:
             self._GOAWAY_LINGER_S, lambda: run_blocking(self._shutdown))
 
     def _read_loop(self) -> None:
+        if self.rdv is not None:
+            # a handler sending a big response on THIS thread (inline
+            # dispatch) must never park waiting for a CLAIM this very
+            # thread would have to deliver — such sends stay framed
+            self.rdv.disallowed_thread = threading.get_ident()
         try:
             while True:
                 f = self.reader.read_frame()
@@ -701,9 +736,38 @@ class _ServerConnection:
         finally:
             self._shutdown()
 
+    # -- rendezvous plumbing (tpurpc-express) ---------------------------------
+
+    def _rdv_send_op(self, op: int, stream_id: int, payload: bytes) -> None:
+        self.writer.send(fr.RDV_FRAME_OF_OP[op], 0, stream_id, payload)
+
+    def _rdv_deliver(self, stream_id: int, flags: int, body) -> None:
+        """A completed rendezvous request payload: the stream's next
+        message, zero-copy (the body aliases the landing region). Mirrors
+        _ServerSink.commit — including the reactor claim when the message
+        half-closes the stream."""
+        with self._lock:
+            st = self._streams.get(stream_id)
+        if st is None:
+            return
+        st.commit_external(body, bool(flags & fr.FLAG_END_STREAM))
+        if flags & fr.FLAG_END_STREAM:
+            ic = self._claim_inline(st)
+            if ic is not None:
+                handler, ctx, path = ic
+                self._run_inline(handler, st, ctx, path)
+
     def _dispatch(self, f: fr.Frame) -> None:
         if f.type == fr.PING:
+            if (self.rdv is not None
+                    and f.payload == _rdv.HELLO_PAYLOAD):
+                self.rdv.on_peer_hello(f.payload)
             self.writer.send(fr.PONG, 0, 0, f.payload)
+            return
+        if f.type in fr.RDV_OP_OF_FRAME:
+            if self.rdv is not None:
+                self.rdv.on_op(fr.RDV_OP_OF_FRAME[f.type], f.stream_id,
+                               f.payload)
             return
         if f.type == fr.PONG:
             return
@@ -1051,6 +1115,9 @@ class _ServerConnection:
             h = getattr(self, attr, None)
             if h is not None:
                 h.cancel()  # wheel handles; ticks also re-check alive
+        if self.rdv is not None:
+            # peer gone mid-rendezvous: claimed landing regions release
+            self.rdv.close()
         for st in streams:
             gate = getattr(st, "_gate", None)
             if gate is not None:

@@ -1,0 +1,160 @@
+"""tpurpc-express smoke (ISSUE 9): one 8 MiB tensor over shm rings AND
+loopback TCP, rendezvous'd.
+
+Per platform (RDMA_BPEV = shm ring plane, TCP = loopback TCP framing):
+
+* stream one 8 MiB float32 tensor through a Sink handler that decodes it
+  zero-copy and materializes it as a jax.Array;
+* the copy ledger must show the one-sided write (``rdma_write`` ≥ payload)
+  and ZERO host landing copies of the payload (< 64 KiB of small control/
+  reply frames on the instrumented framed path);
+* the flight recorder must carry the ordered offer → claim → write →
+  complete evidence for the solicited transfer;
+* then a claim-starved transfer (the ``drop_offers`` chaos seam) must be
+  diagnosed by the stall watchdog as stuck in the ``rendezvous`` stage —
+  and still COMPLETE via the framed fallback once the claim times out.
+
+Runs each platform in a subprocess (GRPC_PLATFORM_TYPE is read at import).
+Exit 0 = both planes passed.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+PAYLOAD_SHAPE = (2048, 1024)  # 8 MiB float32
+
+
+def run_phase() -> None:
+    import numpy as np
+
+    import tpurpc.core.rendezvous as rdv
+    from tpurpc.jaxshim import TensorClient, add_tensor_method, to_jax
+    from tpurpc.obs import flight, watchdog
+    from tpurpc.rpc.channel import Channel
+    from tpurpc.rpc.server import Server
+    from tpurpc.tpu import ledger
+
+    platform = os.environ.get("GRPC_PLATFORM_TYPE", "?")
+    flight.RECORDER.reset()
+    srv = Server(max_workers=4, native_dataplane=False)
+    seen = {}
+
+    def consume(req_iter):
+        total = 0
+        for tree in req_iter:
+            arr = to_jax(tree["x"])  # zero-copy on 64B-aligned landings
+            total += arr.nbytes
+            seen["corner"] = float(np.asarray(arr)[-1, -1])
+        yield {"bytes": np.int64(total)}
+
+    add_tensor_method(srv, "Sink", consume, kind="stream_stream")
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    payload = np.random.default_rng(9).standard_normal(
+        PAYLOAD_SHAPE).astype(np.float32)
+    try:
+        with Channel(f"127.0.0.1:{port}") as ch:
+            cli = TensorClient(ch)
+
+            def gen(k):
+                for _ in range(k):
+                    yield {"x": payload}
+
+            # warm: settles the capability hello, jits the decode
+            list(cli.duplex("Sink", gen(1), native=False, timeout=60))
+            with ledger.track() as w:
+                replies = list(cli.duplex("Sink", gen(1), native=False,
+                                          timeout=60))
+            total = int(np.asarray(replies[-1]["bytes"]).ravel()[0])
+            assert total == payload.nbytes, (total, payload.nbytes)
+            assert abs(seen["corner"] - float(payload[-1, -1])) < 1e-6
+            assert w["rdma_write"] >= payload.nbytes, w.delta
+            assert w["host_copy"] < 64 * 1024, (
+                "host landing copies on the rendezvous path", w.delta)
+            evs = [e["event"] for e in flight.snapshot()
+                   if e["event"].startswith("rdv-")]
+            for name in ("rdv-offer", "rdv-claim", "rdv-write",
+                         "rdv-complete"):
+                assert name in evs, evs
+            print(f"  [{platform}] 8 MiB tensor rendezvous'd: "
+                  f"rdma_write={w['rdma_write']} host_copy={w['host_copy']}"
+                  f" (zero landing copies)")
+
+            # induced stall: starve the claims; the watchdog must name the
+            # rendezvous stage, then the framed fallback completes the call
+            wd = watchdog.get()
+            wd.reset()
+            prev = (wd.min_stall_s, wd.sweep_s)
+            wd.min_stall_s, wd.sweep_s = 0.3, 0.1
+            rdv.TEST_HOOKS["drop_offers"] = True
+            os.environ["TPURPC_RENDEZVOUS_CLAIM_TIMEOUT_S"] = "3"
+            result = {}
+            # a DIFFERENT size class than the 8 MiB stream: the standing
+            # grants it left behind must not short-circuit the starvation
+            stall_payload = np.ones((1024, 512), np.float32)  # 2 MiB
+
+            def stalled():
+                result["replies"] = list(
+                    cli.duplex("Sink", iter([{"x": stall_payload}]),
+                               native=False, timeout=60))
+
+            t = threading.Thread(target=stalled)
+            t.start()
+            diag = None
+            deadline = time.monotonic() + 10
+            try:
+                while diag is None and time.monotonic() < deadline:
+                    time.sleep(0.15)
+                    for d in wd.sweep_once():
+                        if d["stage"] == "rendezvous":
+                            diag = d
+                            break
+                assert diag is not None, (
+                    "watchdog never named the rendezvous stage",
+                    wd.active())
+                t.join(timeout=60)
+                assert not t.is_alive(), "stalled call never completed"
+                total = int(np.asarray(
+                    result["replies"][-1]["bytes"]).ravel()[0])
+                assert total == stall_payload.nbytes
+            finally:
+                rdv.TEST_HOOKS.pop("drop_offers", None)
+                os.environ.pop("TPURPC_RENDEZVOUS_CLAIM_TIMEOUT_S", None)
+                wd.min_stall_s, wd.sweep_s = prev
+                wd.reset()
+            print(f"  [{platform}] induced stall diagnosed as "
+                  f"'{diag['stage']}' ({diag['detail'][:60]}...); framed "
+                  "fallback completed the call")
+    finally:
+        srv.stop(grace=1)
+
+
+def main() -> int:
+    if "--phase" in sys.argv:
+        run_phase()
+        return 0
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    for platform in ("RDMA_BPEV", "TCP"):
+        env = dict(os.environ)
+        env["GRPC_PLATFORM_TYPE"] = platform
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env["PYTHONPATH"] = here + os.pathsep + env.get("PYTHONPATH", "")
+        rc = subprocess.run(
+            [sys.executable, "-m", "tpurpc.tools.rendezvous_smoke",
+             "--phase"], env=env, timeout=300).returncode
+        if rc != 0:
+            print(f"rendezvous smoke FAILED on {platform}")
+            return 1
+    print("rendezvous smoke: PASS (shm ring + loopback TCP, zero host "
+          "landing copies, watchdog names the stage)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

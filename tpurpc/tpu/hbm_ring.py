@@ -541,6 +541,78 @@ class HbmRing:
                     "tail": self.tail, "live_spans": len(self._live),
                     "writable": self.writable()}
 
+    # -- rendezvous landing leases (tpurpc-express, ISSUE 9) ------------------
+
+    def lease_region(self, nbytes: int,
+                     timeout: Optional[float] = None) -> "HbmRegionLease":
+        """Reserve a ring span as a RENDEZVOUS LANDING REGION: the span is
+        claimed (credit held, placement deferred) and advertised to a bulk
+        sender; :meth:`HbmRegionLease.fill` later lands the payload with
+        exactly one h2d DMA + one in-ring landing write — the accelerator-
+        plane half of the peer-advertised landing region (the shm/verbs
+        pools play this role on the host planes). Release without fill
+        (peer death with the region claimed) returns the credit.
+
+        Blocks up to ``timeout`` for credit like :meth:`place`; raises
+        :class:`BufferError` when the ring cannot ever hold ``nbytes``."""
+        if nbytes <= 0:
+            raise ValueError("lease_region needs a positive size")
+        if nbytes > self.capacity:
+            raise BufferError(
+                f"payload {nbytes} exceeds ring capacity {self.capacity}")
+        with self._lock:
+            if nbytes > self.writable() and timeout is not None:
+                import time as _time
+                deadline = _time.monotonic() + timeout
+                while nbytes > self.writable():
+                    remain = deadline - _time.monotonic()
+                    if remain <= 0 or not self._space.wait(timeout=remain):
+                        break
+            if nbytes > self.writable():
+                raise BufferError(
+                    f"HBM ring full: {nbytes} > {self.writable()}")
+            off = self.tail
+            self.tail += nbytes
+            self._live[(off, nbytes)] = [0, False]
+        return HbmRegionLease(self, off, nbytes)
+
+    def _fill_span(self, off: int, nbytes: int, payload) -> None:
+        """Land ``payload`` into a reserved span (lease_region's deferred
+        placement): ONE h2d transfer + the single landing write, same
+        discipline and ledger accounting as :meth:`place`."""
+        import jax
+
+        src = np.frombuffer(payload, np.uint8) if not isinstance(
+            payload, np.ndarray) else payload.reshape(-1).view(np.uint8)
+        if src.nbytes != nbytes:
+            raise ValueError(f"fill of {src.nbytes} bytes into a "
+                             f"{nbytes}-byte lease")
+        t0 = time.monotonic_ns()
+        with self._lock:
+            if (off, nbytes) not in self._live:
+                raise KeyError(f"span ({off}, {nbytes}) not live")
+            p = off & self._mask
+            dev = jax.device_put(jax.numpy.asarray(src), self.device)
+            ledger.dma_h2d(nbytes)
+            first = min(nbytes, self.capacity - p)
+            if first >= nbytes:
+                self.buf = self._update(self.buf, dev, p)
+                ledger.dma_d2d(nbytes)
+            elif self._pallas_place(dev, p, nbytes):
+                ledger.dma_d2d(nbytes)
+            else:
+                self.buf = self._update(self.buf, dev[:first], p)
+                ledger.dma_d2d(first)
+                self.buf = self._update(self.buf, dev[first:], 0)
+                ledger.dma_d2d(nbytes - first)
+            self._assert_stable()
+        dt = time.monotonic_ns() - t0
+        _HBM_PLACE_MSGS.inc()
+        _HBM_PLACE_BYTES.inc(nbytes)
+        _LENS_HBM_BYTES.inc(nbytes)
+        _LENS_HBM_NS.inc(dt)
+        _LENS_HBM_COPY.inc(nbytes)
+
 
 class HbmLease:
     """A device view pinning its ring span; release returns the credit.
@@ -576,3 +648,55 @@ class HbmLease:
     def __exit__(self, *exc):
         self.release()
         return False
+
+
+class HbmRegionLease:
+    """A reserved-but-unfilled ring span advertised as a rendezvous landing
+    region (see :meth:`HbmRing.lease_region`).
+
+    Lifecycle mirrors the rendezvous protocol the ringcheck model proves:
+    claim (this object) → :meth:`fill` (the one-sided placement) →
+    :meth:`view` (zero-copy consumption) → :meth:`release`; release without
+    fill is the peer-death path and simply returns the credit."""
+
+    __slots__ = ("ring", "offset", "nbytes", "filled", "_released")
+
+    def __init__(self, ring: HbmRing, offset: int, nbytes: int):
+        self.ring = ring
+        self.offset = offset
+        self.nbytes = nbytes
+        self.filled = False
+        self._released = False
+
+    def fill(self, payload) -> None:
+        """Land the payload: one dma_h2d + one in-ring landing write (the
+        ledger's op counts assert the single-movement claim)."""
+        if self._released:
+            raise RuntimeError("lease already released")
+        self.ring._fill_span(self.offset, self.nbytes, payload)
+        self.filled = True
+
+    def view(self, dtype=np.uint8, shape: Optional[tuple] = None
+             ) -> HbmLease:
+        """Device view of the landed payload (dlpack alias on eligible
+        backends, ledger-billed either way). Only valid after fill."""
+        if not self.filled:
+            raise RuntimeError("view before fill: the landing write has "
+                               "not happened")
+        return self.ring.view(self.offset, self.nbytes, dtype=dtype,
+                              shape=shape)
+
+    def release(self) -> None:
+        """Return the span's credit (idempotent). An unfilled release is
+        the peer-death path: the span is marked consumed so the head can
+        advance over it."""
+        if self._released:
+            return
+        self._released = True
+        with self.ring._lock:
+            entry = self.ring._live.get((self.offset, self.nbytes))
+            if entry is None:
+                return
+            entry[1] = True  # consumed (possibly without any fill/view)
+            if entry[0] == 0:
+                self.ring._advance_locked()

@@ -74,3 +74,42 @@ def tree_from_device(tree: Any) -> List[bytes]:
         else:
             ledger.zero_copy(getattr(leaf, "nbytes", 0))
     return codec.encode_tree(tree)
+
+
+# ---------------------------------------------------------------------------
+# SerializeFromDevice → rendezvous region / send ring (tpurpc-express, ISSUE 9)
+# ---------------------------------------------------------------------------
+
+def serialize_into(x, write, offset: int = 0) -> int:
+    """``SerializeFromDevice`` finished end-to-end: gather-serialize one
+    array STRAIGHT into a rendezvous landing window (or any one-sided
+    write target) with zero host staging — each codec segment (header,
+    payload view aliasing the d2h landing buffer or the array itself)
+    lands via ``write(offset, segment)``; no intermediate host buffer is
+    ever allocated or joined. ``write`` must be a one-sided placement
+    (a :class:`~tpurpc.core.pair.Window` write / rendezvous region); the
+    movement is billed as ``rdma_write``, and the copy ledger proves the
+    zero-staging claim: exactly one ``dma_d2h`` on device backends (zero on
+    host backends, where the segments alias the array) and zero
+    ``host_copy``. Returns bytes written past ``offset``."""
+    segs = serialize_from_device(x)
+    return _write_segments(segs, write, offset)
+
+
+def serialize_tree_into(tree: Any, write, offset: int = 0) -> int:
+    """Pytree variant of :func:`serialize_into` — the outbound half the
+    multi-host activation transport (ROADMAP item 5) consumes: device
+    activations leave HBM and land in the peer's advertised region with
+    no host staging buffer in between."""
+    segs = tree_from_device(tree)
+    return _write_segments(segs, write, offset)
+
+
+def _write_segments(segs: List[bytes], write, offset: int) -> int:
+    total = 0
+    for seg in segs:
+        view = memoryview(seg).cast("B")
+        write(offset + total, view)
+        total += len(view)
+    ledger.rdma_write(total)
+    return total

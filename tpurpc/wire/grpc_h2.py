@@ -25,6 +25,7 @@ import time
 import zlib
 from typing import Dict, List, Optional, Tuple
 
+from tpurpc.core import rendezvous as _rdv
 from tpurpc.core.endpoint import Endpoint, EndpointError
 from tpurpc.obs import flight as _flight
 from tpurpc.obs import metrics as _obs_metrics
@@ -241,6 +242,12 @@ class GrpcH2Connection:
                                                         "?"))
         _H2_SRV_CONNS.track(self)
         _H2_SRV_WINDOW.track(self)
+        # tpurpc-express over the gRPC wire: arm the rendezvous link; the
+        # custom SETTINGS id in _send_settings is the capability advert,
+        # and only a peer that advertised it back ever sees an RDV frame
+        self.rdv = _rdv.link_for_endpoint(
+            endpoint, "h2srv:" + getattr(endpoint, "peer", "?"),
+            self._rdv_send_op, self._rdv_deliver)
         self._send_settings()
         self._thread = threading.Thread(target=self._read_loop, daemon=True,
                                         name="tpurpc-h2-reader")
@@ -253,11 +260,14 @@ class GrpcH2Connection:
             self.endpoint.write(segs)
 
     def _send_settings(self) -> None:
-        self._write(h2.pack_settings({
+        settings = {
             h2.SETTINGS_MAX_CONCURRENT_STREAMS: 1024,
             h2.SETTINGS_INITIAL_WINDOW_SIZE: RECV_WINDOW,
             h2.SETTINGS_MAX_FRAME_SIZE: h2.DEFAULT_MAX_FRAME,
-        }))
+        }
+        if self.rdv is not None:
+            settings[h2.SETTINGS_TPURPC_RDV] = 1
+        self._write(h2.pack_settings(settings))
         # lift the connection-level receive window too
         self._write(h2.pack_window_update(0, RECV_WINDOW - h2.DEFAULT_WINDOW))
 
@@ -306,7 +316,35 @@ class GrpcH2Connection:
         if segs:
             self._write(segs)
 
+    # -- rendezvous plumbing (tpurpc-express) ---------------------------------
+
+    def _rdv_send_op(self, op: int, stream_id: int, payload: bytes) -> None:
+        self._write(h2.pack_frame(h2.TPURPC_RDV, op, stream_id, payload))
+
+    def _rdv_deliver(self, stream_id: int, flags: int, body) -> None:
+        """A completed rendezvous request payload: the stream's next gRPC
+        message, bypassing DATA reassembly and flow control entirely
+        (flags bit 0 = the sender half-closed with this message)."""
+        with self._lock:
+            st = self._streams.get(stream_id)
+        if st is None:
+            return
+        st.requests.put(body)
+        if flags & 0x01:
+            st.half_closed = True
+            st.requests.put(_H2Stream._END)
+
     def send_message(self, st: _H2Stream, payload) -> None:
+        rdv = self.rdv
+        if rdv is not None:
+            segs = ([memoryview(s).cast("B") for s in payload]
+                    if isinstance(payload, (list, tuple)) else
+                    [memoryview(payload).cast("B")])
+            segs = [s for s in segs if len(s)]
+            total = sum(len(s) for s in segs)
+            if rdv.eligible(total) and rdv.send_message(
+                    st.stream_id, 0, segs, total):
+                return  # one-sided write done; COMPLETE frame already sent
         mv = memoryview(_frame_grpc_message(payload))
         pos = 0
         while pos < len(mv):
@@ -381,6 +419,10 @@ class GrpcH2Connection:
     # -- reading -------------------------------------------------------------
 
     def _read_loop(self) -> None:
+        if self.rdv is not None:
+            # big responses from inline/reader-thread contexts must never
+            # park here waiting for a CLAIM this thread would deliver
+            self.rdv.disallowed_thread = threading.get_ident()
         scratch = bytearray(1 << 16)
         mv = memoryview(scratch)
         try:
@@ -465,6 +507,8 @@ class GrpcH2Connection:
                         for st in self._streams.values():
                             st.window.adjust(delta)
                 self.endpoint.write(h2.pack_settings({}, ack=True))
+            if settings.get(h2.SETTINGS_TPURPC_RDV) and self.rdv is not None:
+                self.rdv.on_peer_hello()
         elif ftype == h2.PING:
             if not flags & h2.FLAG_ACK:
                 self._write(h2.pack_frame(h2.PING, h2.FLAG_ACK, 0, payload))
@@ -502,6 +546,9 @@ class GrpcH2Connection:
             if st is not None:
                 st.cancelled.set()
                 st.requests.put(_H2Stream._END)
+        elif ftype == h2.TPURPC_RDV:
+            if self.rdv is not None:  # never sent un-negotiated
+                self.rdv.on_op(flags, sid, payload)
         elif ftype == h2.GOAWAY:
             raise h2.H2Error("client sent GOAWAY")
         # PRIORITY / PUSH_PROMISE / unknown: ignore
@@ -729,6 +776,8 @@ class GrpcH2Connection:
             self.alive = False
             streams = list(self._streams.values())
             self._streams.clear()
+        if self.rdv is not None:
+            self.rdv.close()  # peer gone: claimed landing regions release
         self._conn_window.kill()
         for st in streams:
             st.cancelled.set()

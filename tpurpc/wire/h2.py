@@ -41,6 +41,17 @@ SETTINGS_INITIAL_WINDOW_SIZE = 0x4
 SETTINGS_MAX_FRAME_SIZE = 0x5
 SETTINGS_MAX_HEADER_LIST_SIZE = 0x6
 
+#: tpurpc-express (ISSUE 9) over the gRPC wire: an EXTENSION frame type
+#: carrying the rendezvous offer/claim/complete/release control messages
+#: (the FLAGS byte is the op from tpurpc.core.rendezvous; the bulk payload
+#: itself bypasses DATA/flow-control entirely via the one-sided landing
+#: region), negotiated through a custom SETTINGS identifier. Both are safe
+#: against stock peers by RFC 7540: implementations MUST ignore unknown
+#: frame types (§4.1) and unknown settings (§6.5.2) — a vanilla grpcio
+#: peer never advertises the setting, so it never sees the frame.
+TPURPC_RDV = 0xF0
+SETTINGS_TPURPC_RDV = 0xF0F0
+
 DEFAULT_WINDOW = 65535
 DEFAULT_MAX_FRAME = 16384
 

@@ -37,6 +37,7 @@ import threading
 import time
 from typing import Dict, Iterable, List, Optional, Tuple
 
+from tpurpc.core import rendezvous as _rdv
 from tpurpc.core.endpoint import Endpoint, EndpointError, TcpEndpoint
 from tpurpc.obs import profiler as _profiler
 
@@ -200,11 +201,19 @@ class H2Channel:
         _H2_CLI_CONNS.track(self)
         _H2_CLI_WINDOW.track(self)
 
+        # tpurpc-express over the gRPC wire: arm the rendezvous link and
+        # advertise the capability in our SETTINGS; it activates only when
+        # the server's SETTINGS carry the id back (stock servers never do)
+        self.rdv = _rdv.link_for_endpoint(
+            self._ep, "h2cli:" + str(target),
+            self._rdv_send_op, self._rdv_deliver)
+        settings = {h2.SETTINGS_INITIAL_WINDOW_SIZE: RECV_WINDOW,
+                    h2.SETTINGS_MAX_FRAME_SIZE: 1 << 20}
+        if self.rdv is not None:
+            settings[h2.SETTINGS_TPURPC_RDV] = 1
         with self._wlock:
             self._ep.write([h2.PREFACE]
-                           + h2.pack_settings({
-                               h2.SETTINGS_INITIAL_WINDOW_SIZE: RECV_WINDOW,
-                               h2.SETTINGS_MAX_FRAME_SIZE: 1 << 20})
+                           + h2.pack_settings(settings)
                            + h2.pack_window_update(0, RECV_WINDOW))
         self._reader = threading.Thread(target=self._read_loop, daemon=True,
                                         name="tpurpc-h2c-reader")
@@ -234,6 +243,8 @@ class H2Channel:
                     self._ep.write(h2.pack_goaway(0, h2.NO_ERROR))
             except (EndpointError, OSError):
                 pass
+        if self.rdv is not None:
+            self.rdv.close()  # claimed landing regions release on death
         for call in calls:
             if call.window is not None:
                 call.window.kill()
@@ -247,6 +258,8 @@ class H2Channel:
     # -- reader thread --------------------------------------------------------
 
     def _read_loop(self) -> None:
+        if self.rdv is not None:
+            self.rdv.disallowed_thread = threading.get_ident()
         scanner = h2.FrameScanner()
         hdr_accum: Optional[Tuple[int, int, bytearray]] = None  # sid, flags, block
         pending: List[Tuple[int, int, int, bytes]] = []  # burst being walked
@@ -323,6 +336,9 @@ class H2Channel:
                             StatusCode.CANCELLED if code == h2.CANCEL
                             else StatusCode.UNAVAILABLE,
                             f"stream reset by server (h2 error {code})", [])
+                elif ftype == h2.TPURPC_RDV:
+                    if self.rdv is not None:  # never sent un-negotiated
+                        self.rdv.on_op(flags, sid, payload)
                 elif ftype == h2.GOAWAY:
                     last, code = struct.unpack_from("!II", payload)
                     self._goaway_last = last
@@ -458,6 +474,8 @@ class H2Channel:
             self._enc.apply_peer_table_size(
                 settings.get(h2.SETTINGS_HEADER_TABLE_SIZE, 4096))
             self._ep.write(h2.pack_settings({}, ack=True))
+        if settings.get(h2.SETTINGS_TPURPC_RDV) and self.rdv is not None:
+            self.rdv.on_peer_hello()
 
     def _on_window_update(self, sid: int, payload: bytes) -> None:
         (inc,) = struct.unpack("!I", payload)
@@ -520,7 +538,34 @@ class H2Channel:
             self._ep.write(frames)
         return call
 
+    # -- rendezvous plumbing (tpurpc-express) ---------------------------------
+
+    def _rdv_send_op(self, op: int, stream_id: int, payload: bytes) -> None:
+        with self._wlock:
+            self._ep.write(h2.pack_frame(h2.TPURPC_RDV, op, stream_id,
+                                         payload))
+
+    def _rdv_deliver(self, stream_id: int, flags: int, body) -> None:
+        """A completed rendezvous response payload: the call's next gRPC
+        message, bypassing DATA reassembly and flow control (end-of-stream
+        rides trailers on the response direction)."""
+        call = self._get_call(stream_id)
+        if call is not None:
+            call.events.put(("message", body))
+
     def _send_message(self, call: _H2Call, payload, end: bool) -> None:
+        rdv = self.rdv
+        if rdv is not None:
+            segs = ([memoryview(s).cast("B") for s in payload]
+                    if isinstance(payload, (list, tuple)) else
+                    [memoryview(payload).cast("B")])
+            segs = [s for s in segs if len(s)]
+            total = sum(len(s) for s in segs)
+            # COMPLETE's flags bit 0 carries the half-close, so the whole
+            # message+end costs one one-sided write + one control frame
+            if rdv.eligible(total) and rdv.send_message(
+                    call.stream_id, 1 if end else 0, segs, total):
+                return
         data = (b"".join(bytes(s) for s in payload)
                 if isinstance(payload, (list, tuple)) else bytes(payload))
         buf = _GRPC_MSG_HDR.pack(0, len(data)) + data
