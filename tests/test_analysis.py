@@ -1043,3 +1043,96 @@ def test_rendezvous_model_rides_default_suite():
     results = ringcheck.default_suite()
     rdv = [r for r in results if r.config.startswith("rendezvous")]
     assert len(rdv) >= 4 and all(r.ok for r in rdv)
+
+
+# ---------------------------------------------------------------------------
+# tpurpc-cadence (ISSUE 10): the decode step loop under the analysis gate
+# ---------------------------------------------------------------------------
+
+SERVING_BLOCK_SRC = '''
+import time
+
+class DecodeScheduler:
+    def _step_loop(self):
+        time.sleep(0.01)               # unbounded nap on the step loop
+        self._lock.acquire()           # timeout-less lock
+
+    def _boundary(self):
+        self._kick.wait()              # timeout-less park
+
+    def _run_step(self):
+        out = self._inflight.get()     # timeout-less queue get
+
+    def _off_loop_helper(self):
+        time.sleep(1)                  # not a step-loop function: allowed
+'''
+
+SERVING_BLOCK_BOUNDED = '''
+class DecodeScheduler:
+    def _boundary(self):
+        self._kick.wait(timeout=self.idle_wait_s)   # bounded slice: fine
+
+    def _run_step(self):
+        ok = self._lock.acquire(timeout=0.5)        # bounded: fine
+'''
+
+
+def test_serving_step_loop_under_block_rule():
+    vs = lint_source(SERVING_BLOCK_SRC, "tpurpc/serving/scheduler.py")
+    assert _rules(vs) == ["block"] and len(vs) == 4
+    assert {v.line for v in vs} == {6, 7, 10, 13}
+
+
+def test_serving_block_rule_bounded_waits_pass():
+    assert lint_source(SERVING_BLOCK_BOUNDED,
+                       "tpurpc/serving/scheduler.py") == []
+
+
+def test_serving_block_rule_scoped_to_scheduler_module():
+    # the same source elsewhere in the serving package is not on the path
+    assert lint_source(SERVING_BLOCK_SRC, "tpurpc/serving/api.py") == []
+
+
+def test_serving_block_rule_suppression_comment():
+    ok = SERVING_BLOCK_SRC
+    for needle in ('time.sleep(0.01)               # unbounded nap on the step loop',
+                   'self._lock.acquire()           # timeout-less lock',
+                   'self._kick.wait()              # timeout-less park',
+                   'out = self._inflight.get()     # timeout-less queue get'):
+        ok = ok.replace(needle, needle.split("#")[0].rstrip()
+                        + "  # tpr: allow(block)")
+    assert lint_source(ok, "tpurpc/serving/scheduler.py") == []
+
+
+SERVING_FLIGHT_SRC = '''
+from tpurpc.obs import flight as _flight
+
+class DecodeScheduler:
+    def _run_step(self):
+        _flight.emit(_flight.GEN_STEP_BEGIN, self._tag,
+                     len(self._running), 0)      # Call in an emit arg
+        _flight.emit(_flight.GEN_SHED, self._tag, 0, "batch")  # str const
+
+    def _ok_site(self):
+        nb = 4
+        _flight.emit(_flight.GEN_STEP_END, self._tag, nb, 0)  # pure ints
+'''
+
+
+def test_serving_flight_rule_enforced():
+    vs = lint_source(SERVING_FLIGHT_SRC, "tpurpc/serving/scheduler.py")
+    assert _rules(vs) == ["flight"] and len(vs) == 2
+    assert {v.line for v in vs} == {6, 8}
+
+
+def test_serving_flight_rule_scoped():
+    # serving/api.py is transport glue, not an emission site — exempt
+    assert lint_source(SERVING_FLIGHT_SRC, "tpurpc/serving/api.py") == []
+
+
+def test_serving_scheduler_module_is_clean():
+    import tpurpc.serving.scheduler as sched_mod
+
+    with open(sched_mod.__file__, "r", encoding="utf-8") as f:
+        vs = lint_source(f.read(), sched_mod.__file__)
+    assert vs == []

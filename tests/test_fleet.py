@@ -24,6 +24,31 @@ from tpurpc.rpc.service_config import ServiceConfig
 from tpurpc.rpc.status import RpcError, StatusCode
 
 
+def _poll_until(pred, timeout: float = 5.0, interval: float = 0.02):
+    """Condition-polling replacement for fixed sleeps (PR 9 noted the
+    fixed-sleep flakes on 1-core containers: a loaded host can need far
+    longer than any constant, and an idle one shouldn't pay it)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        got = pred()
+        if got:
+            return got
+        time.sleep(interval)
+    return pred()
+
+
+def _settles_at(fn, expect, settle_s: float = 0.4, interval: float = 0.02):
+    """Negative-assertion helper: ``fn()`` must equal ``expect`` for the
+    whole settle window (e.g. "no further attempt ever lands"). Returns
+    False the moment it diverges instead of sleeping blind."""
+    deadline = time.monotonic() + settle_s
+    while time.monotonic() < deadline:
+        if fn() != expect:
+            return False
+        time.sleep(interval)
+    return fn() == expect
+
+
 def test_metadata_keys_agree_across_modules():
     # channel.py carries its own literals to avoid a server import in the
     # client module; they MUST stay in lockstep with the server's
@@ -80,7 +105,7 @@ def test_service_config_rejects_retry_plus_hedging():
 def test_hedge_beats_slow_replica_and_cancels_loser():
     """One slow replica; the hedge fires after the delay, wins on the fast
     one, and the flight ring shows fired → won → cancelled."""
-    s1, p1, calls1 = _server("slow", delay=0.5)
+    s1, p1, calls1 = _server("slow", delay=1.0)
     s2, p2, _ = _server("fast")
     flight.RECORDER.reset()
     try:
@@ -91,7 +116,10 @@ def test_hedge_beats_slow_replica_and_cancels_loser():
             mc = ch.unary_unary("/fleet.S/Who")
             t0 = time.monotonic()
             assert bytes(mc(b"x", timeout=5)) == b"fast"
-            assert time.monotonic() - t0 < 0.4  # did not wait out the slow
+            # did not wait out the slow replica. The window is WIDE on
+            # purpose (1-core flake, PR 9): the claim is "well under the
+            # 1.0s handler", not a scheduling-latency bound.
+            assert time.monotonic() - t0 < 0.8
         events = [e["event"] for e in flight.snapshot()]
         assert "hedge-fired" in events
         assert "hedge-won" in events
@@ -115,9 +143,10 @@ def test_hedge_attempts_prefer_distinct_subchannels():
                                                   hedging_delay=0.01)) as ch:
             mc = ch.unary_unary("/fleet.S/Who")
             mc(b"x", timeout=5)
-        time.sleep(0.4)  # let cancelled losers' handlers finish appending
-        touched = sum(1 for _, _, calls in rigs if calls)
-        assert touched == 3, [len(c) for _, _, c in rigs]
+        # cancelled losers' handlers finish appending asynchronously
+        assert _poll_until(
+            lambda: sum(1 for _, _, calls in rigs if calls) == 3,
+            timeout=5.0), [len(c) for _, _, c in rigs]
     finally:
         for s, _, _ in rigs:
             s.stop(grace=0)
@@ -155,8 +184,8 @@ def test_hedging_gated_by_retry_throttle():
             ch._service_config.retry_throttle._tokens = 0.0  # drained
             mc = ch.unary_unary("/fleet.S/Who")
             assert bytes(mc(b"x", timeout=5)) == b"only"
-        time.sleep(0.2)
-        assert len(calls1) == 1  # no hedge was allowed to fire
+        # no hedge was allowed to fire — and none trickles in late
+        assert _settles_at(lambda: len(calls1), 1), calls1
     finally:
         s1.stop(grace=0)
 
@@ -252,9 +281,11 @@ def test_least_loaded_ejects_erroring_and_reinstates():
     assert list(pol.order())[-1] == 1  # ejected sorts last, never dropped
     events = [e for e in flight.snapshot() if e["event"] == "subch-ejected"]
     assert events and events[0]["a1"] == 1 and events[0]["a2"] == 0
-    time.sleep(0.25)
-    pol.order()  # expiry observed on the next pick
-    assert pol.snapshot()["ejected"] == [False, False, False]
+    # expiry is observed on a pick AFTER ejection_s has elapsed — poll
+    # picks instead of trusting one fixed sleep to out-wait the clock
+    assert _poll_until(
+        lambda: (pol.order(), pol.snapshot()["ejected"])[1]
+        == [False, False, False], timeout=3.0)
     assert any(e["event"] == "subch-reinstated" and e["a1"] == 1
                for e in flight.snapshot())
 
@@ -352,7 +383,8 @@ def test_admission_exempts_health_probes():
         with Channel(f"127.0.0.1:{port}") as ch:
             mc = ch.unary_unary("/fleet.S/Slow", tpurpc_native=False)
             fut = mc.future(b"", timeout=10)  # occupies the whole gate
-            time.sleep(0.2)
+            assert _poll_until(lambda: srv.admission.inflight() >= 1,
+                               timeout=5.0), "gate never saw the call"
             check = ch.unary_unary(f"/{health.SERVICE_NAME}/Check",
                                    tpurpc_native=False)
             # the probe is admitted even though the gate is full
@@ -407,8 +439,8 @@ def test_pushback_stops_hedging():
             with pytest.raises(RpcError) as ei:
                 ch.unary_unary("/fleet.S/Who")(b"x", timeout=5)
             assert ei.value.code() is StatusCode.UNAVAILABLE
-        time.sleep(0.2)
-        assert len(seen) == 1, seen  # pushback stopped attempts 2..N
+        # pushback stopped attempts 2..N — and none trickles in late
+        assert _settles_at(lambda: len(seen), 1), seen
     finally:
         srv.stop(grace=0)
 

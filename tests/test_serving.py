@@ -1,0 +1,706 @@
+"""tpurpc-cadence (ISSUE 10): the continuous-batching decode scheduler.
+
+The acceptance claim — batching is demonstrably CONTINUOUS — plus the
+scheduler's edge cases: join-during-step, leave-mid-stream, idle→wake,
+poison isolation, drain-during-decode, SLO priority + preemption, and
+class-aware shedding; then the transport face (per-token streaming over
+RPC, shed → UNAVAILABLE + pushback, /healthz state lines) and the
+AdmissionGate's new step-time latency hook."""
+
+import gc
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpurpc.jaxshim.generate import ToyDecodeModel, reference_decode
+from tpurpc.obs import flight, watchdog
+from tpurpc.rpc.channel import Channel
+from tpurpc.rpc.server import PUSHBACK_KEY, AdmissionGate
+from tpurpc.rpc.status import RpcError, StatusCode
+from tpurpc.serving import (SLO_BATCH, SLO_INTERACTIVE, DecodeScheduler,
+                            DrainingError, GenerationClient, ShedError,
+                            serve_generation)
+from tpurpc.serving.scheduler import TokenStream
+
+
+@pytest.fixture(autouse=True)
+def _fast_streams():
+    """A broken scheduler must fail the test, not hang the suite."""
+    old = TokenStream.MAX_IDLE_S
+    TokenStream.MAX_IDLE_S = 10.0
+    yield
+    TokenStream.MAX_IDLE_S = old
+
+
+def _sched(model=None, **kw):
+    kw.setdefault("idle_wait_s", 0.01)
+    return DecodeScheduler(model or ToyDecodeModel(), **kw)
+
+
+def _poll(pred, timeout=5.0, interval=0.005):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        got = pred()
+        if got:
+            return got
+        time.sleep(interval)
+    return pred()
+
+
+# -- the model contract -------------------------------------------------------
+
+def test_toy_model_matches_reference():
+    m = ToyDecodeModel()
+    states, toks = m.prefill([np.asarray([3, 1, 4], np.int32)])
+    out = [int(toks[0])]
+    for _ in range(7):
+        states, toks = m.step(states, np.asarray(toks, np.int32))
+        out.append(int(toks[0]))
+    assert out == reference_decode([3, 1, 4], 8)
+
+
+def test_toy_model_rows_independent():
+    """Batched step == per-row steps: the property the scheduler's
+    re-batching (join/leave/preempt) and isolation retry rely on."""
+    m = ToyDecodeModel()
+    sa, ta = m.prefill([np.asarray([1], np.int32)])
+    sb, tb = m.prefill([np.asarray([2], np.int32)])
+    both, tboth = m.prefill([np.asarray([1], np.int32),
+                             np.asarray([2], np.int32)])
+    assert int(tboth[0]) == int(ta[0]) and int(tboth[1]) == int(tb[0])
+    s2, t2 = m.step(both, tboth)
+    sa2, ta2 = m.step(sa, ta)
+    assert int(t2[0]) == int(ta2[0])
+
+
+# -- basic streaming ----------------------------------------------------------
+
+def test_single_sequence_streams_reference_tokens():
+    s = _sched()
+    try:
+        assert list(s.submit([1, 2, 3], max_tokens=16)) == \
+            reference_decode([1, 2, 3], 16)
+    finally:
+        s.close()
+
+
+def test_eos_stops_early():
+    # pick an eos that actually occurs in the stream
+    full = reference_decode([7], 64)
+    eos = full[5]
+    s = _sched(ToyDecodeModel(eos=eos))
+    try:
+        got = list(s.submit([7], max_tokens=64))
+        assert got == full[:full.index(eos) + 1]
+    finally:
+        s.close()
+
+
+def test_many_concurrent_sequences_no_crosstalk():
+    s = _sched(max_batch=4)
+    try:
+        handles = {i: s.submit([i, i + 1], max_tokens=24)
+                   for i in range(10)}
+        for i, h in handles.items():
+            assert list(h) == reference_decode([i, i + 1], 24), i
+        assert s.steps > 0 and s.tokens_out >= 10 * 24
+    finally:
+        s.close()
+
+
+# -- ACCEPTANCE: continuous batching is continuous ----------------------------
+
+def test_join_mid_decode_streams_first_token_before_batch_drains():
+    """A request admitted mid-decode joins the running device batch
+    within one step boundary — flight shows its `gen-join` BETWEEN two
+    step events — and streams its first token while the earlier request
+    is still generating (no waiting for the batch to drain)."""
+    flight.RECORDER.reset()
+    s = _sched(ToyDecodeModel(step_delay_s=0.003), max_batch=4,
+               idle_wait_s=0.005)
+    try:
+        a = s.submit([1], max_tokens=400)
+        for _ in range(10):            # A is mid-decode, far from done
+            a.next(timeout=5)
+        steps_at_submit = s.steps
+        b = s.submit([2], max_tokens=4)
+        first_b = b.next(timeout=5)
+        steps_at_first = s.steps
+        assert first_b == reference_decode([2], 1)[0]
+        # B's first token did NOT wait for A's 400-token stream
+        assert a.emitted < 400
+        # join landed within one step boundary of the submit (one step
+        # may already be in flight when submit lands, plus the boundary
+        # that admits B and the step that follows it)
+        assert steps_at_first - steps_at_submit <= 3, \
+            (steps_at_submit, steps_at_first)
+        ev = flight.snapshot()
+        joins = [e for e in ev
+                 if e["event"] == "gen-join" and e["a1"] == b.sid]
+        assert joins, "no gen-join for the mid-decode request"
+        t_join = joins[0]["t_ns"]
+        steps = [e for e in ev
+                 if e["event"] in ("gen-step-begin", "gen-step-end")]
+        assert any(e["t_ns"] < t_join for e in steps), \
+            "no step events before the join: batch was not running"
+        assert any(e["t_ns"] > t_join for e in steps), \
+            "no step events after the join: batch drained instead"
+        # and A kept streaming correct values across the membership change
+        rest = [a.next(timeout=5) for _ in range(10)]
+        assert [a_tok for a_tok in rest] == \
+            reference_decode([1], 400)[10:20]
+        a.cancel()
+        list(b)
+    finally:
+        s.close()
+
+
+def test_join_during_step_lands_next_boundary():
+    """Submit while a step is EXECUTING: the join must not corrupt the
+    in-flight step and lands at the next boundary."""
+    gate = threading.Event()
+    release = threading.Event()
+
+    class GateModel(ToyDecodeModel):
+        def step(self, states, tokens):
+            gate.set()                 # the test knows a step is running
+            release.wait(5)
+            return super().step(states, tokens)
+
+    s = _sched(GateModel(), max_batch=4, idle_wait_s=0.005)
+    try:
+        a = s.submit([1], max_tokens=6)
+        assert gate.wait(5)            # step 1 in flight
+        b = s.submit([2], max_tokens=6)   # joins while stepping
+        release.set()
+        assert list(a) == reference_decode([1], 6)
+        assert list(b) == reference_decode([2], 6)
+    finally:
+        release.set()
+        s.close()
+
+
+# -- leave / idle / poison / drain -------------------------------------------
+
+def test_leave_mid_stream_retires_at_boundary_without_stalling_siblings():
+    flight.RECORDER.reset()
+    s = _sched(max_batch=4)
+    try:
+        a = s.submit([1], max_tokens=5000)
+        b = s.submit([2], max_tokens=40)
+        for _ in range(5):
+            a.next(timeout=5)
+        a.cancel()
+        # the sibling's stream is unaffected, values exact
+        assert list(b) == reference_decode([2], 40)
+        ev = _poll(lambda: [e for e in flight.snapshot()
+                            if e["event"] == "gen-leave"
+                            and e["a1"] == a.sid])
+        assert ev, "no gen-leave for the cancelled sequence"
+        # the scheduler dropped it from the running batch
+        assert _poll(lambda: s.running_depth() == 0)
+    finally:
+        s.close()
+
+
+def test_idle_scheduler_wakes_on_submit():
+    s = _sched(idle_wait_s=0.5)   # long idle slice: the wake must be the
+    try:                          # kick, not the timeout
+        list(s.submit([1], max_tokens=2))
+        time.sleep(0.05)
+        n0 = s.steps
+        time.sleep(0.2)
+        assert s.steps == n0, "idle scheduler kept stepping"
+        t0 = time.monotonic()
+        h = s.submit([2], max_tokens=3)
+        first = h.next(timeout=5)
+        assert time.monotonic() - t0 < 0.4, "wake waited out the idle slice"
+        assert first == reference_decode([2], 1)[0]
+        list(h)
+    finally:
+        s.close()
+
+
+def test_poisoned_sequence_fails_alone():
+    """A poisoned row fails the BATCHED step; the scheduler's row-by-row
+    retry fails only the poisoned sequence — siblings' streams complete
+    with exact values (PR 3/7 poison discipline, decode edition)."""
+    s = _sched(ToyDecodeModel(poison_token=666), max_batch=4)
+    try:
+        good1 = s.submit([3], max_tokens=20)
+        bad = s.submit([666], max_tokens=20)
+        good2 = s.submit([4], max_tokens=20)
+        assert list(good1) == reference_decode([3], 20)
+        assert list(good2) == reference_decode([4], 20)
+        with pytest.raises(ValueError, match="poison"):
+            list(bad)
+    finally:
+        s.close()
+
+
+def test_drain_finishes_inflight_and_refuses_new():
+    s = _sched(ToyDecodeModel(step_delay_s=0.002), max_batch=4)
+    try:
+        a = s.submit([1], max_tokens=60)
+        for _ in range(3):
+            a.next(timeout=5)
+        s.drain()
+        with pytest.raises(DrainingError):
+            s.submit([2], max_tokens=5)
+        # in-flight sequence runs to completion
+        rest = list(a)
+        assert [*reference_decode([1], 60)][3:] == rest
+        assert s.state_str() == "draining"
+    finally:
+        s.close()
+
+
+def test_drain_refuses_already_queued_prefills():
+    """Sequences still WAITING (never prefillled) when the drain lands
+    are refused, not stranded."""
+    gate = threading.Event()
+
+    class SlowPrefill(ToyDecodeModel):
+        def step(self, states, tokens):
+            gate.wait(2)
+            return super().step(states, tokens)
+
+    s = _sched(SlowPrefill(), max_batch=1, idle_wait_s=0.005)
+    try:
+        a = s.submit([1], max_tokens=50)   # occupies the whole batch
+        a.next(timeout=5)
+        b = s.submit([2], max_tokens=5)    # parked waiting
+        s.drain()
+        gate.set()
+        with pytest.raises(DrainingError):
+            list(b)
+        a.cancel()
+    finally:
+        gate.set()
+        s.close()
+
+
+# -- SLO classes: priority, preemption, shedding ------------------------------
+
+def test_interactive_admitted_before_earlier_batch_submit():
+    gate = threading.Event()
+
+    class Gated(ToyDecodeModel):
+        def step(self, states, tokens):
+            gate.wait(2)
+            return super().step(states, tokens)
+
+    flight.RECORDER.reset()
+    s = _sched(Gated(), max_batch=1, idle_wait_s=0.005)
+    try:
+        a = s.submit([1], max_tokens=2)
+        _poll(lambda: s.running_depth() == 1)
+        b_batch = s.submit([2], max_tokens=2, slo=SLO_BATCH)
+        c_inter = s.submit([3], max_tokens=2, slo=SLO_INTERACTIVE)
+        gate.set()
+        list(a), list(b_batch), list(c_inter)
+        ev = flight.snapshot()
+        joins = [e["a1"] for e in ev if e["event"] == "gen-join"]
+        # interactive (later submit) joined before the batch-class one
+        assert joins.index(c_inter.sid) < joins.index(b_batch.sid), joins
+    finally:
+        gate.set()
+        s.close()
+
+
+def test_preemption_makes_room_and_preempted_resumes_exact():
+    flight.RECORDER.reset()
+    s = _sched(ToyDecodeModel(step_delay_s=0.002), max_batch=2,
+               idle_wait_s=0.005)
+    try:
+        b1 = s.submit([1], max_tokens=300, slo=SLO_BATCH)
+        b2 = s.submit([2], max_tokens=300, slo=SLO_BATCH)
+        for _ in range(4):
+            b1.next(timeout=5)
+        inter = s.submit([3], max_tokens=6, slo=SLO_INTERACTIVE)
+        got = list(inter)
+        assert got == reference_decode([3], 6)
+        ev = flight.snapshot()
+        pre = [e for e in ev if e["event"] == "gen-preempt"]
+        assert pre, "interactive never preempted the full batch-class batch"
+        assert pre[0]["a2"] == 1  # the preempted row was batch-class
+        assert s.preempted_total >= 1
+        # the preempted sequence RESUMES (no re-prefill) and its stream
+        # stays value-exact across preempt/resume
+        b1.cancel()
+        b2.cancel()
+    finally:
+        s.close()
+
+
+def test_preempted_stream_values_survive_resume():
+    s = _sched(ToyDecodeModel(step_delay_s=0.001), max_batch=1,
+               idle_wait_s=0.005)
+    try:
+        long = s.submit([9], max_tokens=50, slo=SLO_BATCH)
+        for _ in range(5):
+            long.next(timeout=5)
+        quick = s.submit([4], max_tokens=4, slo=SLO_INTERACTIVE)
+        assert list(quick) == reference_decode([4], 4)
+        # the preempted batch stream finishes with the exact remainder
+        rest = list(long)
+        assert [*reference_decode([9], 50)][5:] == rest
+    finally:
+        s.close()
+
+
+def test_shed_batch_first_interactive_still_admitted():
+    flight.RECORDER.reset()
+    gate = threading.Event()
+
+    class Gated(ToyDecodeModel):
+        def step(self, states, tokens):
+            gate.wait(2)
+            return super().step(states, tokens)
+
+    s = _sched(Gated(), max_batch=1, max_waiting=6, batch_shed_depth=2,
+               idle_wait_s=0.005)
+    try:
+        running = s.submit([1], max_tokens=50)
+        _poll(lambda: s.running_depth() == 1)
+        w1 = s.submit([2], max_tokens=2)
+        w2 = s.submit([3], max_tokens=2)
+        # batch class sheds at its bar (2 waiting)...
+        with pytest.raises(ShedError) as ei:
+            s.submit([4], max_tokens=2, slo=SLO_BATCH)
+        assert ei.value.pushback_ms > 0 and ei.value.slo == SLO_BATCH
+        # ...while interactive is still admitted at the same depth
+        w3 = s.submit([5], max_tokens=2, slo=SLO_INTERACTIVE)
+        assert s.shed_total == 1
+        assert any(e["event"] == "gen-shed" and e["a1"] == 1
+                   for e in flight.snapshot())
+        assert s.state_str() == "shedding"
+        # interactive sheds only at the full bar
+        for i in range(6, 20):
+            try:
+                s.submit([i], max_tokens=2)
+            except ShedError as exc:
+                assert exc.slo == SLO_INTERACTIVE
+                break
+        else:
+            pytest.fail("interactive never shed at the full bar")
+        running.cancel()
+        gate.set()
+        list(w1), list(w2), list(w3)
+    finally:
+        gate.set()
+        s.close()
+
+
+def test_step_time_slo_sheds_batch_class():
+    s = _sched(ToyDecodeModel(step_delay_s=0.02), max_batch=1,
+               max_waiting=64, batch_shed_depth=64, step_slo_ms=1.0,
+               idle_wait_s=0.005)
+    try:
+        a = s.submit([1], max_tokens=100)
+        _poll(lambda: s.steps >= 3)      # EWMA has seen slow steps
+        s.submit([2], max_tokens=2)      # one waiter (depth > 0)
+        with pytest.raises(ShedError, match="step time over SLO"):
+            s.submit([3], max_tokens=2, slo=SLO_BATCH)
+        a.cancel()
+    finally:
+        s.close()
+
+
+# -- prefill token budget -----------------------------------------------------
+
+def test_prefill_budget_staggers_joins_but_all_complete():
+    flight.RECORDER.reset()
+    s = _sched(ToyDecodeModel(step_delay_s=0.001), max_batch=8,
+               prefill_budget=8, idle_wait_s=0.005)
+    try:
+        # 4 prompts of 6 tokens each: at most one fits the per-step
+        # budget (6 <= 8 but 12 > 8), so joins spread across boundaries
+        handles = [s.submit([i] * 6, max_tokens=10) for i in range(4)]
+        for i, h in enumerate(handles):
+            assert list(h) == reference_decode([i] * 6, 10)
+        ev = [e for e in flight.snapshot() if e["event"] == "gen-join"]
+        assert len(ev) == 4
+    finally:
+        s.close()
+
+
+def test_oversized_prompt_still_admitted_alone():
+    s = _sched(prefill_budget=4)
+    try:
+        assert list(s.submit([1] * 64, max_tokens=5)) == \
+            reference_decode([1] * 64, 5)
+    finally:
+        s.close()
+
+
+# -- watchdog: the decode-step stage ------------------------------------------
+
+def test_watchdog_names_decode_step_for_wedged_step():
+    flight.RECORDER.reset()
+    wedge = threading.Event()
+
+    class Wedged(ToyDecodeModel):
+        def step(self, states, tokens):
+            wedge.wait(3)
+            return super().step(states, tokens)
+
+    wd = watchdog.StallWatchdog(sweep_s=10, mult=8, min_stall_s=0.2)
+    wd.enabled = True
+    s = _sched(Wedged(), idle_wait_s=0.005)
+    try:
+        tok = wd.call_started("/tpurpc.Generate/Generate")
+        h = s.submit([1], max_tokens=5)
+        _poll(lambda: [e for e in flight.snapshot()
+                       if e["event"] == "gen-step-begin"])
+        time.sleep(0.35)                 # past the stall bar, step open
+        diags = wd.sweep_once()
+        assert diags and diags[0]["stage"] == "decode-step", diags
+        assert "wedged" in diags[0]["detail"]
+        wedge.set()
+        list(h)
+        wd.call_finished(tok)
+    finally:
+        wedge.set()
+        s.close()
+
+
+def test_watchdog_decode_step_when_loop_starved():
+    """The other decode failure shape: sequences WAITING but the loop
+    never completes a step inside the stall window."""
+    gc.collect()
+    flight.RECORDER.reset()
+    hold = threading.Event()
+
+    class WedgedPrefill(ToyDecodeModel):
+        def prefill(self, prompts):
+            hold.wait(3)
+            return super().prefill(prompts)
+
+    wd = watchdog.StallWatchdog(sweep_s=10, mult=8, min_stall_s=0.2)
+    wd.enabled = True
+    s = _sched(WedgedPrefill(), max_batch=1, idle_wait_s=0.005)
+    try:
+        tok = wd.call_started("/tpurpc.Generate/Generate")
+        a = s.submit([1], max_tokens=3)     # loop wedges in its prefill
+        b = s.submit([2], max_tokens=3)     # parked waiting
+        time.sleep(0.35)
+        diags = wd.sweep_once()
+        assert diags and diags[0]["stage"] == "decode-step", diags
+        assert "waiting" in diags[0]["detail"]
+        hold.set()
+        list(a), list(b)
+        wd.call_finished(tok)
+    finally:
+        hold.set()
+        s.close()
+
+
+# -- AdmissionGate: the step-time latency hook --------------------------------
+
+def test_admission_gate_latency_fn_overrides_watchdog_signal():
+    sig = [0.5]
+    gate = AdmissionGate(8, soft_limit=2, latency_slo_ms=10.0,
+                         latency_ms_fn=lambda: sig[0])
+    assert gate.try_admit() is None
+    assert gate.try_admit() is None
+    assert gate.try_admit() is None      # between limits, signal healthy
+    sig[0] = 50.0                        # step time over SLO
+    pb = gate.try_admit()
+    assert isinstance(pb, int) and pb > 0
+    sig[0] = 0.5
+    assert gate.try_admit() is None
+
+
+def test_admission_gate_latency_fn_failure_never_blocks():
+    def broken():
+        raise RuntimeError("probe died")
+
+    gate = AdmissionGate(4, soft_limit=1, latency_slo_ms=1.0,
+                         latency_ms_fn=broken)
+    assert gate.try_admit() is None
+    assert gate.try_admit() is None      # broken probe degrades to depth
+
+
+# -- the transport face -------------------------------------------------------
+
+def test_rpc_stream_tokens_in_order_and_exact():
+    srv, port, sched = serve_generation(ToyDecodeModel(), max_batch=4)
+    try:
+        with Channel(f"127.0.0.1:{port}") as ch:
+            gen = GenerationClient(ch)
+            pairs = list(gen.generate_with_meta([1, 2], max_tokens=12,
+                                                timeout=15))
+            assert [i for i, _ in pairs] == list(range(12))
+            assert [t for _, t in pairs] == reference_decode([1, 2], 12)
+    finally:
+        srv.stop(grace=0)
+        sched.close()
+
+
+def test_rpc_concurrent_streams_interleave_without_crosstalk():
+    srv, port, sched = serve_generation(
+        ToyDecodeModel(step_delay_s=0.001), max_batch=4)
+    try:
+        with Channel(f"127.0.0.1:{port}") as ch:
+            gen = GenerationClient(ch)
+            out = {}
+
+            def run(i):
+                out[i] = list(gen.generate([i], max_tokens=16, timeout=20))
+
+            threads = [threading.Thread(target=run, args=(i,))
+                       for i in range(6)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            for i in range(6):
+                assert out[i] == reference_decode([i], 16), i
+        # the device saw merged batches, not 6 serial streams
+        assert sched.steps < 6 * 16
+    finally:
+        srv.stop(grace=0)
+        sched.close()
+
+
+def test_rpc_client_cancel_is_a_leave():
+    flight.RECORDER.reset()
+    srv, port, sched = serve_generation(
+        ToyDecodeModel(step_delay_s=0.002), max_batch=4)
+    try:
+        with Channel(f"127.0.0.1:{port}") as ch:
+            gen = GenerationClient(ch)
+            call = gen.call([1], max_tokens=10000, timeout=60)
+            it = iter(call)
+            next(it)
+            call.cancel()
+        ev = _poll(lambda: [e for e in flight.snapshot()
+                            if e["event"] == "gen-leave"])
+        assert ev, "client cancel never became a scheduler leave"
+        assert _poll(lambda: sched.running_depth() == 0)
+    finally:
+        srv.stop(grace=0)
+        sched.close()
+
+
+def test_rpc_shed_maps_to_unavailable_with_pushback():
+    gate = threading.Event()
+
+    class Gated(ToyDecodeModel):
+        def step(self, states, tokens):
+            gate.wait(3)
+            return super().step(states, tokens)
+
+    srv, port, sched = serve_generation(Gated(), max_batch=1,
+                                        max_waiting=4, batch_shed_depth=1)
+    try:
+        with Channel(f"127.0.0.1:{port}") as ch:
+            gen = GenerationClient(ch)
+            calls = [gen.call([i], max_tokens=5, timeout=30)
+                     for i in range(3)]
+            iters = [iter(c) for c in calls]
+            _poll(lambda: sched.running_depth() + sched.queue_depth() >= 2)
+            with pytest.raises(RpcError) as ei:
+                list(gen.generate([9], max_tokens=5, slo=SLO_BATCH,
+                                  timeout=10))
+            assert ei.value.code() is StatusCode.UNAVAILABLE
+            md = dict(ei.value.trailing_metadata() or ())
+            assert PUSHBACK_KEY in md and int(md[PUSHBACK_KEY]) > 0
+            gate.set()
+            for c in calls:
+                c.cancel()
+    finally:
+        gate.set()
+        srv.stop(grace=0)
+        sched.close()
+
+
+def test_rpc_poisoned_stream_fails_alone():
+    srv, port, sched = serve_generation(
+        ToyDecodeModel(poison_token=666), max_batch=4)
+    try:
+        with Channel(f"127.0.0.1:{port}") as ch:
+            gen = GenerationClient(ch)
+            good_out = {}
+
+            def good():
+                good_out["v"] = list(gen.generate([5], max_tokens=12,
+                                                  timeout=20))
+
+            t = threading.Thread(target=good)
+            t.start()
+            with pytest.raises(RpcError) as ei:
+                list(gen.generate([666], max_tokens=12, timeout=20))
+            assert ei.value.code() is StatusCode.INTERNAL
+            t.join()
+            assert good_out["v"] == reference_decode([5], 12)
+    finally:
+        srv.stop(grace=0)
+        sched.close()
+
+
+def test_rpc_drain_finishes_streams_refuses_new():
+    srv, port, sched = serve_generation(
+        ToyDecodeModel(step_delay_s=0.005), max_batch=4)
+    try:
+        with Channel(f"127.0.0.1:{port}") as ch:
+            gen = GenerationClient(ch)
+            call = gen.call([1], max_tokens=60, timeout=60)
+            it = iter(call)
+            next(it)
+            drained = []
+            t = threading.Thread(
+                target=lambda: drained.append(srv.drain(linger=15.0)))
+            t.start()
+            _poll(lambda: srv.draining)
+            with pytest.raises(RpcError) as ei:
+                with Channel(f"127.0.0.1:{port}") as ch2:
+                    list(GenerationClient(ch2).generate([2], max_tokens=3,
+                                                        timeout=10))
+            assert ei.value.code() is StatusCode.UNAVAILABLE
+            # the in-flight stream finishes every token
+            rest = sum(1 for _ in it)
+            assert 1 + rest == 60
+            t.join(timeout=20)
+            assert drained == [True]
+    finally:
+        srv.stop(grace=0)
+        sched.close()
+
+
+def test_healthz_shows_gen_state():
+    from tpurpc.obs import scrape
+
+    srv, port, sched = serve_generation(ToyDecodeModel(), max_batch=2,
+                                        max_waiting=4, batch_shed_depth=1)
+    try:
+        status, _ctype, body = scrape.route_local("/healthz")
+        assert status == 200
+        text = body.decode()
+        assert f"gen Generate:" in text, text
+        assert "state=ok" in text
+        # shed flips the visible state
+        gate = threading.Event()
+        sched.model.step_delay_s = 0.05
+        h = sched.submit([1], max_tokens=100)
+        sched.submit([2], max_tokens=2)
+        with pytest.raises(ShedError):
+            sched.submit([3], max_tokens=2, slo=SLO_BATCH)
+        status, _ctype, body = scrape.route_local("/healthz")
+        assert b"state=shedding" in body, body
+        h.cancel()
+    finally:
+        srv.stop(grace=0)
+        sched.close()
+
+
+def test_load_provider_reports_scheduler_queue():
+    srv, port, sched = serve_generation(ToyDecodeModel(), max_batch=2)
+    try:
+        assert srv._load_extra == sched.queue_depth  # bound-method equality
+    finally:
+        srv.stop(grace=0)
+        sched.close()

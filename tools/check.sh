@@ -77,6 +77,15 @@ python -m tpurpc.tools.shard_smoke || fail=1
 note "tpurpc-express rendezvous smoke (8 MiB, shm + TCP, zero-copy ledger)"
 JAX_PLATFORMS=cpu python -m tpurpc.tools.rendezvous_smoke || fail=1
 
+# 2g2) tpurpc-cadence smoke (ISSUE 10): interactive + batch clients
+#      stream off one continuous-batching decode server — per-token order
+#      + exact reference values, a mid-decode join between step events,
+#      one shed (with pushback + healthz "shedding") under an
+#      offered-load burst, and an induced slow step attributed to the
+#      `decode-step` watchdog stage. ~5s, no jax.
+note "tpurpc-cadence smoke (continuous batching + shed + decode-step)"
+python -m tpurpc.tools.serving_gen_smoke || fail=1
+
 # 2h) tpurpc-lens smoke (ISSUE 8): streaming + serving burst, then assert
 #     the sampling profiler names >=3 known stages (>=80% attributed), the
 #     /debug/waterfall reports every declared hop with nonzero bytes and a

@@ -113,6 +113,11 @@ FLIGHT_HOT_MODULES = HOT_LOG_MODULES + (
     # tpurpc-express (ISSUE 9): rendezvous emission sites run per solicited
     # bulk transfer — interned link tags, pure-int args
     os.path.join("tpurpc", "core", "rendezvous.py"),
+    # tpurpc-cadence (ISSUE 10): the decode scheduler emits on the step
+    # loop — once per device step and at membership edges, but the step
+    # cadence can be kHz, so the same discipline applies: interned
+    # scheduler tag, precomputed int locals, nothing allocated per emit
+    os.path.join("tpurpc", "serving", "scheduler.py"),
 )
 
 #: module suffix -> qualified functions on its INLINE DISPATCH path (the
@@ -137,6 +142,17 @@ INLINE_DISPATCH_PATH: Dict[str, Tuple[str, ...]] = {
         "_ServerConnection._send_trailers",
         "_ServerConnection._finish_stream",
         "_ServerConnection._rdv_deliver",
+    ),
+    # tpurpc-cadence (ISSUE 10): the decode STEP LOOP is the serving
+    # plane's reader-thread analog — every running stream stalls behind
+    # it, so it must never hold a timeout-less lock or park unbounded
+    # (its idle wait is a bounded condition slice; submit kicks it early)
+    os.path.join("tpurpc", "serving", "scheduler.py"): (
+        "DecodeScheduler._step_loop",
+        "DecodeScheduler._boundary",
+        "DecodeScheduler._admit",
+        "DecodeScheduler._prefill_batch",
+        "DecodeScheduler._run_step",
     ),
 }
 

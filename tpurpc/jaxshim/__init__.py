@@ -2,6 +2,9 @@
 
 * :mod:`tpurpc.jaxshim.codec` — tensor/pytree wire format, zero-copy decode.
 * :mod:`tpurpc.jaxshim.service` — tensor services, fan-in batching, serve_jax.
+* :mod:`tpurpc.jaxshim.generate` — the step-model contract tpurpc-cadence
+  schedules (prefill/step with a leading batch axis), plus the toy
+  reference model.
 """
 
 from tpurpc.jaxshim.codec import (decode_tensor, decode_tree, encode_tensor,
@@ -9,6 +12,7 @@ from tpurpc.jaxshim.codec import (decode_tensor, decode_tree, encode_tensor,
                                   encode_tree_bytes, tensor_deserializer,
                                   tensor_serializer, to_jax,
                                   tree_deserializer, tree_serializer)
+from tpurpc.jaxshim.generate import ToyDecodeModel, reference_decode
 from tpurpc.jaxshim.service import (DeviceMerger, FanInBatcher, ShardedFanIn,
                                     TensorClient, add_tensor_method,
                                     serve_jax, serve_jax_sharded)
@@ -19,4 +23,5 @@ __all__ = [
     "tensor_serializer", "to_jax", "tree_deserializer", "tree_serializer",
     "FanInBatcher", "ShardedFanIn", "DeviceMerger", "TensorClient",
     "add_tensor_method", "serve_jax", "serve_jax_sharded",
+    "ToyDecodeModel", "reference_decode",
 ]

@@ -1,0 +1,154 @@
+"""Step-function decode models: the contract tpurpc-cadence schedules.
+
+One-shot serving (``serve_jax``) wraps a callable ``fn(tree) -> tree`` whose
+leaves carry a leading batch axis; the :class:`~tpurpc.jaxshim.service.
+FanInBatcher` stacks requests along that axis and dispatches once.
+Autoregressive generation needs the SAME discipline applied *per decode
+step*: the model is two batched callables instead of one, and the batch
+membership CHANGES between calls — that re-batching is the scheduler's job
+(:mod:`tpurpc.serving.scheduler`), not the model's.
+
+The **step-model contract** (serve_jax's signature discipline, iterated):
+
+* ``prefill(prompts) -> (states, first_tokens)`` — ``prompts`` is a list of
+  1-D ``int32`` token arrays (ragged lengths are the model's problem: pad,
+  bucket, or loop — the scheduler only promises a per-step *token budget*
+  bound on ``sum(len(p))``). Returns ``states`` with a leading batch axis
+  (row ``i`` is prompt ``i``'s decode state) and ``first_tokens``, the
+  ``int32[B]`` first sampled token per row.
+* ``step(states, tokens) -> (states, tokens)`` — one decode step for the
+  whole batch: row-aligned state and last-token arrays in, advanced state
+  and next-token arrays out. Shape-polymorphic ONLY in the leading axis, so
+  a jitted implementation compiles once per batch bucket exactly like the
+  one-shot path.
+* ``eos`` — the stop token id, or ``None`` for never-stop models.
+
+Rows must be independent: the scheduler concatenates, slices, and re-orders
+rows across calls (join/leave/preempt at step boundaries), and retries a
+failed batched call row-by-row so a poisoned sequence fails ALONE — both
+moves are only sound when row ``i``'s outputs depend on row ``i``'s inputs.
+
+:class:`ToyDecodeModel` is the reference implementation: a deterministic
+affine-hash generator, pure numpy (the smoke tools and scheduler tests stay
+jax-free), with knobs to induce the failure modes the scheduler must
+contain (``poison_token``, ``step_delay_s``). :func:`reference_decode`
+recomputes any prompt's exact token stream out-of-band, so transport tests
+can assert per-token VALUES, not just counts.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["ToyDecodeModel", "reference_decode"]
+
+#: state vector layout of the toy model: [hash, last_token, poisoned]
+_STATE_DIM = 3
+#: multiplier/increment of the toy model's affine hash (any odd pair works;
+#: these keep short prompts from colliding within a few steps)
+_MULT = 1103515245
+_INC = 12345
+
+
+class ToyDecodeModel:
+    """Deterministic autoregressive stand-in implementing the step-model
+    contract in pure numpy.
+
+    The "model" is an affine hash: prefill folds the prompt tokens into a
+    64-bit state, and each step advances ``h = h * MULT + INC`` emitting
+    ``(h >> 16) % vocab``. Deterministic, row-independent, and trivially
+    recomputable (:func:`reference_decode`) — which is exactly what a
+    scheduler test needs: any reordering, cross-row mixup, or dropped step
+    changes the emitted values, not just their count.
+
+    Failure knobs:
+
+    * ``poison_token`` — a prompt containing it marks its ROW poisoned:
+      prefill succeeds (the poison is latent, like a NaN that hasn't hit a
+      check yet), and any ``step`` whose batch contains a poisoned row
+      raises — the whole-batch failure a bad input causes a real jitted
+      call. Single-row steps on clean rows succeed: the scheduler's
+      row-by-row isolation retry can prove poison fails alone.
+    * ``step_delay_s`` — sleeps inside every ``step`` call: an induced slow
+      decode step for watchdog-attribution and saturation tests.
+    """
+
+    def __init__(self, vocab: int = 251, eos: Optional[int] = None,
+                 poison_token: Optional[int] = None,
+                 step_delay_s: float = 0.0):
+        if vocab < 2:
+            raise ValueError("vocab must be >= 2")
+        self.vocab = int(vocab)
+        self.eos = eos
+        self.poison_token = poison_token
+        self.step_delay_s = float(step_delay_s)
+        self.prefills = 0
+        self.steps = 0
+
+    # -- the step-model contract ----------------------------------------------
+
+    def prefill(self, prompts: Sequence[np.ndarray]
+                ) -> Tuple[np.ndarray, np.ndarray]:
+        self.prefills += 1
+        states = np.zeros((len(prompts), _STATE_DIM), dtype=np.uint64)
+        for i, p in enumerate(prompts):
+            p = np.asarray(p, dtype=np.int64).reshape(-1)
+            if p.size == 0:
+                raise ValueError("empty prompt")
+            h = np.uint64(0)
+            for t in p.tolist():
+                h = np.uint64((int(h) * _MULT + _INC + int(t))
+                              & 0xFFFFFFFFFFFFFFFF)
+            bad = (self.poison_token is not None
+                   and bool(np.any(p == self.poison_token)))
+            states[i, 0] = h
+            states[i, 2] = np.uint64(1 if bad else 0)
+        states, tokens = self._advance(states)
+        return states, tokens
+
+    def step(self, states: np.ndarray, tokens: np.ndarray
+             ) -> Tuple[np.ndarray, np.ndarray]:
+        self.steps += 1
+        states = np.asarray(states, dtype=np.uint64)
+        if states.ndim != 2 or states.shape[1] != _STATE_DIM:
+            raise ValueError(f"bad state shape {states.shape}")
+        if self.step_delay_s:
+            time.sleep(self.step_delay_s)
+        if np.any(states[:, 2] != 0):
+            raise ValueError("poisoned row in decode batch")
+        return self._advance(states)
+
+    # -- internals ------------------------------------------------------------
+
+    def _advance(self, states: np.ndarray
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+        out = states.copy()
+        h = out[:, 0].astype(np.uint64)
+        h = (h * np.uint64(_MULT) + np.uint64(_INC))  # wraps mod 2^64
+        out[:, 0] = h
+        tokens = ((h >> np.uint64(16)) % np.uint64(self.vocab)).astype(
+            np.int32)
+        out[:, 1] = tokens.astype(np.uint64)
+        return out, tokens
+
+
+def reference_decode(prompt, n_tokens: int, vocab: int = 251,
+                     eos: Optional[int] = None) -> List[int]:
+    """The exact token stream :class:`ToyDecodeModel` emits for ``prompt``
+    (including the prefill's first token), computed without a model
+    instance — the out-of-band truth transport tests compare against.
+    Stops early at ``eos`` (inclusive) when given."""
+    h = 0
+    for t in np.asarray(prompt, dtype=np.int64).reshape(-1).tolist():
+        h = (h * _MULT + _INC + int(t)) & 0xFFFFFFFFFFFFFFFF
+    out: List[int] = []
+    for _ in range(n_tokens):
+        h = (h * _MULT + _INC) & 0xFFFFFFFFFFFFFFFF
+        tok = (h >> 16) % vocab
+        out.append(int(tok))
+        if eos is not None and tok == eos:
+            break
+    return out

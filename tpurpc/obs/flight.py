@@ -96,6 +96,19 @@ RDV_CLAIM = 34            # a1 = request id (0 = cached grant), a2 = lease id
 RDV_WRITE = 35            # one-sided payload write done; a1 = lease id, a2 = bytes
 RDV_COMPLETE = 36         # a1 = lease id, a2 = bytes
 RDV_RELEASE = 37          # lease/offer abandoned; a1 = lease id (0 = none), a2 = request id
+# tpurpc-cadence (ISSUE 10): continuous-batching decode scheduler. One
+# STEP pair per DEVICE STEP (amortized over every running stream, like
+# BATCH_FLUSH) brackets the membership events: a JOIN/LEAVE/RETIRE names
+# the sequence that entered/left between two steps — the acceptance
+# evidence that batching is continuous. An open STEP edge is the
+# watchdog's `decode-step` stage evidence.
+GEN_STEP_BEGIN = 38       # a1 = running batch size, a2 = waiting depth
+GEN_STEP_END = 39         # a1 = running batch size, a2 = tokens emitted
+GEN_JOIN = 40             # a1 = sequence id, a2 = prompt tokens (0 = resume)
+GEN_LEAVE = 41            # client left mid-stream; a1 = seq id, a2 = emitted
+GEN_RETIRE = 42           # natural finish; a1 = seq id, a2 = tokens emitted
+GEN_SHED = 43             # a1 = slo class (0=interactive,1=batch), a2 = pushback ms
+GEN_PREEMPT = 44          # a1 = seq id, a2 = slo class of the preempted seq
 
 EVENT_NAMES: Dict[int, str] = {
     PAIR_CONNECT: "pair-connect",
@@ -135,6 +148,13 @@ EVENT_NAMES: Dict[int, str] = {
     RDV_WRITE: "rdv-write",
     RDV_COMPLETE: "rdv-complete",
     RDV_RELEASE: "rdv-release",
+    GEN_STEP_BEGIN: "gen-step-begin",
+    GEN_STEP_END: "gen-step-end",
+    GEN_JOIN: "gen-join",
+    GEN_LEAVE: "gen-leave",
+    GEN_RETIRE: "gen-retire",
+    GEN_SHED: "gen-shed",
+    GEN_PREEMPT: "gen-preempt",
 }
 
 #: batch-flush reason codes (a1 of BATCH_FLUSH) — mirrors the jaxshim

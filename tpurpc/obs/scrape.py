@@ -236,6 +236,21 @@ def route_local(path: str) -> Tuple[int, str, bytes]:
                            for _sid, srv in _channelz.live_servers())
         except Exception:
             draining = False
+        # tpurpc-cadence: live decode schedulers append their shed/queue
+        # state — during overload an operator (or probe) reads "shedding"
+        # plus the queue numbers right here, without the metrics plane.
+        # Still 200: a shedding server is doing its job, not failing.
+        try:
+            import sys
+
+            gen_mod = sys.modules.get("tpurpc.serving.scheduler")
+            gen_lines = gen_mod.health_lines() if gen_mod else []
+        except Exception:
+            gen_lines = []
+        head = b"draining" if draining else b"ok"
+        if gen_lines:
+            body = head + b"\n" + "\n".join(gen_lines).encode() + b"\n"
+            return 200, "text/plain", body
         if draining:
             return 200, "text/plain", b"draining\n"
         return 200, "text/plain", b"ok\n"

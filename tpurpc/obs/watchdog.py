@@ -57,8 +57,8 @@ _obs_profiler.register_stages(__file__, _LENS_STAGES)
 _log = logging.getLogger("tpurpc.watchdog")
 
 STAGES = ("credit-starvation", "peer-not-reading", "h2-flow-control",
-          "rendezvous", "batcher-wait", "poller-wake", "device-infer",
-          "unknown")
+          "rendezvous", "decode-step", "batcher-wait", "poller-wake",
+          "device-infer", "unknown")
 
 #: anomaly counters (always-on registry): total trips + per-stage breakdown
 _TRIPS = _metrics.counter("watchdog_trips")
@@ -270,6 +270,14 @@ class StallWatchdog:
         # completed/released ((tag, 'l', lease)) — are the evidence a call
         # is wedged INSIDE a bulk-tensor handoff, not in the ring/h2 path
         open_rdv: Dict[tuple, int] = {}
+        # tpurpc-cadence: per-scheduler step bracket — an open
+        # GEN_STEP_BEGIN (no matching END) is a decode step IN the model
+        # right now; its age says whether that is traffic or a wedge. The
+        # last END stamp catches the other failure shape: sequences
+        # waiting while the loop has stopped stepping entirely.
+        open_step: Dict[int, int] = {}
+        last_step_end = 0
+        last_step_batch = 0
         last_h2 = 0
         for e in events:
             code = e["code"]
@@ -295,6 +303,12 @@ class StallWatchdog:
             elif code == _flight.RDV_RELEASE:
                 open_rdv.pop((e["tag"], "l", e["a1"]), None)
                 open_rdv.pop((e["tag"], "o", e["a2"]), None)
+            elif code == _flight.GEN_STEP_BEGIN:
+                open_step[e["tag"]] = e["t_ns"]
+                last_step_batch = e["a1"]
+            elif code == _flight.GEN_STEP_END:
+                open_step.pop(e["tag"], None)
+                last_step_end = e["t_ns"]
 
         def fleet_sum(name: str) -> float:
             m = _metrics.registry().metrics().get(name)
@@ -307,10 +321,15 @@ class StallWatchdog:
             "open_lease": open_lease,
             "open_edges": open_edges,
             "open_rdv": open_rdv,
+            "open_step": open_step,
+            "last_step_end_ns": last_step_end,
+            "last_step_batch": last_step_batch,
             "last_h2_ns": last_h2,
             "pairs_write_stalled": fleet_sum("pairs_write_stalled"),
             "batcher_queue_depth": fleet_sum("batcher_queue_depth"),
             "pairs_msg_waiting": fleet_sum("pairs_msg_waiting"),
+            "decode_waiting": fleet_sum("decode_waiting"),
+            "decode_running": fleet_sum("decode_running"),
         }
 
     def _attribute(self, ev: dict, kind: str, age_ns: int) -> tuple:
@@ -336,6 +355,28 @@ class StallWatchdog:
                         f" {offers} offer(s) unanswered, {claims} claimed "
                         "region(s) without complete/release in the flight "
                         "tail")
+        open_step = ev.get("open_step") or {}
+        if open_step:
+            oldest = max(now - t for t in open_step.values())
+            # a fresh step edge is a decode step in flight (ms-scale);
+            # only one aged past half the stall floor is a wedge — the
+            # model call itself is the long pole, and every stream in the
+            # batch is stalled behind it
+            if oldest >= self.min_stall_s * 1e9 / 2:
+                return ("decode-step",
+                        f"decode step wedged {oldest / 1e9:.2f}s in the "
+                        f"model (batch of {int(ev.get('last_step_batch', 0))}"
+                        "): every running stream waits on this step")
+        if (ev.get("decode_waiting", 0) > 0
+                and not open_step
+                and (not ev.get("last_step_end_ns")
+                     or now - ev["last_step_end_ns"]
+                     >= self.min_stall_s * 1e9)):
+            return ("decode-step",
+                    f"{int(ev['decode_waiting'])} sequence(s) waiting but "
+                    "the decode loop has not completed a step inside the "
+                    "stall window — the scheduler thread is wedged or "
+                    "starved")
         if starve_age or ev["pairs_write_stalled"] > 0:
             if starve_age > 2 * age_ns or (
                     starve_age > 3 * self.min_stall_s * 1e9):

@@ -105,6 +105,8 @@ class AdmissionGate:
     def __init__(self, max_inflight: int, *,
                  soft_limit: Optional[int] = None,
                  latency_slo_ms: Optional[float] = None,
+                 latency_ms_fn: "Optional[Callable[[], Optional[float]]]"
+                 = None,
                  base_pushback_ms: int = 25,
                  max_pushback_ms: int = 1000):
         if max_inflight < 1:
@@ -115,11 +117,30 @@ class AdmissionGate:
         if not 1 <= self.soft_limit <= self.max_inflight:
             raise ValueError("need 1 <= soft_limit <= max_inflight")
         self.latency_slo_ms = latency_slo_ms
+        #: tpurpc-cadence (ISSUE 10): a workload-specific latency signal
+        #: replacing the watchdog's RPC-level rolling p99. A decode server
+        #: hands the scheduler's step-time p99 here: generate streams are
+        #: SUPPOSED to be long-lived, so their RPC duration says nothing,
+        #: while a rising step time is exactly the pre-collapse signature
+        #: the between-limits band exists to catch. Returns ms or None
+        #: (no signal yet = not slow).
+        self.latency_ms_fn = latency_ms_fn
         self.base_pushback_ms = int(base_pushback_ms)
         self.max_pushback_ms = int(max_pushback_ms)
         self._inflight = 0
         self._lock = threading.Lock()
         self.rejected = 0
+
+    def _latency_ms(self) -> "Optional[float]":
+        if self.latency_ms_fn is not None:
+            try:
+                return self.latency_ms_fn()
+            except Exception:
+                return None  # a broken probe never blocks admission
+        from tpurpc.obs import watchdog as _watchdog
+
+        p99 = _watchdog.get().rolling_p99_ns()
+        return None if p99 is None else p99 / 1e6
 
     def try_admit(self) -> Optional[int]:
         """None = admitted (the caller OWES a :meth:`release`); an int =
@@ -132,11 +153,9 @@ class AdmissionGate:
             slow = False
             if n < self.max_inflight:
                 if self.latency_slo_ms is not None:
-                    from tpurpc.obs import watchdog as _watchdog
-
-                    p99 = _watchdog.get().rolling_p99_ns()
-                    slow = (p99 is not None
-                            and p99 / 1e6 > self.latency_slo_ms)
+                    lat = self._latency_ms()
+                    slow = (lat is not None
+                            and lat > self.latency_slo_ms)
                 if not slow:
                     self._inflight = n + 1
                     return None

@@ -1,0 +1,23 @@
+"""tpurpc-cadence (ISSUE 10): continuous-batching token-streaming serving.
+
+* :mod:`tpurpc.serving.scheduler` — the :class:`DecodeScheduler` state
+  machine: sequences JOIN and LEAVE the device batch between decode steps,
+  prefill rides a per-step token budget, SLO classes gate admission and
+  preemption, and load shedding trips before collapse.
+* :mod:`tpurpc.serving.api` — the transport face: ``serve_generation``
+  stands up a streaming Generate method around a step model;
+  ``GenerationClient`` consumes per-token streams.
+"""
+
+from tpurpc.serving.api import (GEN_SERVICE, GenerationClient,
+                                add_generation_method, serve_generation)
+from tpurpc.serving.scheduler import (SLO_BATCH, SLO_INTERACTIVE,
+                                      DecodeScheduler, DrainingError,
+                                      ShedError, TokenStream)
+
+__all__ = [
+    "DecodeScheduler", "TokenStream", "ShedError", "DrainingError",
+    "SLO_INTERACTIVE", "SLO_BATCH",
+    "GEN_SERVICE", "GenerationClient", "add_generation_method",
+    "serve_generation",
+]

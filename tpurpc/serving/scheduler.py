@@ -1,0 +1,672 @@
+"""tpurpc-cadence: the continuous-batching decode scheduler.
+
+The FanInBatcher batches ONCE: gather, dispatch, split, done — the right
+shape for one-shot inference, and exactly wrong for autoregressive
+generation, where a "request" is hundreds of device steps and a
+flush-once batcher would hold every new request hostage until the whole
+batch drains (the convoy the serving-loop studies in PAPERS.md measure:
+small-payload overheads, not bandwidth, dominate the decode regime).
+
+:class:`DecodeScheduler` generalizes flush-once to **iterative
+re-batching**. One loop thread owns the running batch and walks a strict
+two-phase cycle:
+
+``boundary`` (membership changes happen HERE and only here)
+    retire finished sequences, drop sequences whose client left, preempt
+    batch-class sequences when interactive work is waiting and the batch
+    is full, then admit waiting prefills under a per-step token budget —
+    a new request JOINs the running batch without the batch draining,
+    and its first token (the prefill's sample) streams immediately.
+``step``
+    one batched ``model.step`` over every running sequence: row ``i`` of
+    the stacked state/token arrays is sequence ``i``. Each emitted token
+    is pushed to its sequence's stream queue; the RPC handler threads
+    parked there forward them over the streaming response, where PR 3's
+    cross-stream coalescing folds many streams' tokens into one writev.
+
+Locking: the running batch is **loop-private** — only the loop thread
+touches it, so the decode hot path takes no lock at all. The one shared
+edge is the waiting queue (``submit`` appends, the boundary pops), guarded
+by ``_lock``; the loop only ever takes it with bounded waits (the `block`
+lint rule enforces this: a timeout-less acquire in the step loop would
+stall every running stream behind one wedged submit).
+
+Overload: shedding is class-aware and trips BEFORE collapse. Batch-class
+work sheds at half the queue bar or as soon as the step-time EWMA exceeds
+its SLO; interactive work sheds only at the full bar. Rejections carry a
+pushback hint (the PR 6 admission contract), and every shed leaves a
+flight event + counter so /healthz can say "shedding" while it is true.
+
+Failure isolation: a batched ``prefill``/``step`` that raises is retried
+row-by-row, so a poisoned sequence fails ALONE — the PR 3 batch / PR 7
+merge-boundary poison discipline, lifted to the decode loop.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue as _qmod
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from tpurpc.obs import flight as _flight
+from tpurpc.obs import metrics as _metrics
+from tpurpc.obs import profiler as _profiler
+
+__all__ = ["DecodeScheduler", "TokenStream", "ShedError", "DrainingError",
+           "SLO_INTERACTIVE", "SLO_BATCH", "health_lines"]
+
+#: tpurpc-lens: everything the loop thread does — stacking, the batched
+#: model call, membership bookkeeping — is the `decode_step` stage
+_LENS_STAGES = {
+    "_step_loop": "decode_step",
+    "_boundary": "decode_step",
+    "_admit": "decode_step",
+    "_run_step": "decode_step",
+    "_prefill_batch": "decode_step",
+}
+_profiler.register_stages(__file__, _LENS_STAGES)
+
+SLO_INTERACTIVE = "interactive"
+SLO_BATCH = "batch"
+_SLO_CODE = {SLO_INTERACTIVE: 0, SLO_BATCH: 1}
+
+#: tpurpc-cadence observability: one counter bump / histogram record per
+#: DEVICE STEP (amortized over the whole batch, the BATCH_FLUSH economy),
+#: per-sequence records only at the joins/retires edges
+_STEPS = _metrics.counter("decode_steps")
+_TOKENS = _metrics.counter("gen_tokens")
+_STEP_US = _metrics.histogram("decode_step_us", kind="latency")
+_STEP_BATCH = _metrics.histogram("decode_batch")
+_TTFT_US = _metrics.histogram("gen_ttft_us", kind="latency")
+_SHED = _metrics.labeled_counter("gen_shed", ("slo",))
+_PREEMPTS = _metrics.counter("gen_preempted")
+_SEQ_FAILED = _metrics.counter("gen_seq_failed")
+#: scrape-time truth for the watchdog + /healthz: live batch occupancy
+#: and queue depth, weakref'd like every fleet gauge
+_RUNNING_G = _metrics.fleet("decode_running", lambda s: s.running_depth())
+_WAITING_G = _metrics.fleet("decode_waiting", lambda s: s.queue_depth())
+
+#: live schedulers for /healthz's "shed/queue states visible" line
+_LIVE: "weakref.WeakSet[DecodeScheduler]" = weakref.WeakSet()
+
+
+class ShedError(RuntimeError):
+    """Request shed at submit: the scheduler is protecting its SLOs.
+    ``pushback_ms`` is the retry floor the transport layer forwards
+    (the PR 6 ``tpurpc-pushback-ms`` contract)."""
+
+    def __init__(self, reason: str, pushback_ms: int, slo: str):
+        super().__init__(reason)
+        self.pushback_ms = int(pushback_ms)
+        self.slo = slo
+
+
+class DrainingError(RuntimeError):
+    """Request refused because the scheduler (or its server) is draining:
+    in-flight sequences finish, new prefills do not start."""
+
+
+_DONE = object()
+
+
+class _Seq:
+    """One generation request inside the scheduler. ``q`` is the only
+    egress: the loop thread puts tokens / _DONE / an Exception; the
+    handler thread gets. ``cancelled`` is the leave flag — set by any
+    thread, honored by the loop at the NEXT step boundary."""
+
+    __slots__ = ("sid", "prompt", "prompt_len", "max_tokens", "slo",
+                 "slo_code", "state", "last_token", "emitted", "q",
+                 "cancelled", "t_submit_ns", "t_first_ns", "preempted")
+
+    def __init__(self, sid: int, prompt: np.ndarray, max_tokens: int,
+                 slo: str):
+        self.sid = sid
+        self.prompt = prompt
+        self.prompt_len = int(prompt.shape[0])
+        self.max_tokens = max_tokens
+        self.slo = slo
+        self.slo_code = _SLO_CODE[slo]
+        self.state = None           # set by prefill; survives preemption
+        self.last_token = 0
+        self.emitted = 0
+        self.q: "_qmod.Queue" = _qmod.Queue()
+        self.cancelled = False
+        self.t_submit_ns = time.monotonic_ns()
+        self.t_first_ns = 0
+        self.preempted = False
+
+
+class TokenStream:
+    """Caller-facing handle for one sequence: iterate tokens, or drive it
+    manually with :meth:`next` (bounded waits — the RPC handler's shape,
+    interleaving client-liveness checks). :meth:`cancel` is the LEAVE
+    signal: the sequence is retired at the next step boundary without
+    stalling its batch siblings."""
+
+    #: safety net for blocking iteration in tests: a stream nobody feeds
+    #: for this long raises instead of hanging the suite
+    MAX_IDLE_S = 60.0
+
+    def __init__(self, seq: _Seq, sched: "DecodeScheduler"):
+        self._seq = seq
+        self._sched = sched
+
+    @property
+    def sid(self) -> int:
+        return self._seq.sid
+
+    @property
+    def emitted(self) -> int:
+        return self._seq.emitted
+
+    def next(self, timeout: Optional[float] = None):
+        """The next token (int), ``None`` on timeout, or raise
+        ``StopIteration`` when the sequence is done / the sequence's own
+        error when it failed."""
+        try:
+            item = self._seq.q.get(timeout=timeout)
+        except _qmod.Empty:
+            return None
+        if item is _DONE:
+            raise StopIteration
+        if isinstance(item, BaseException):
+            raise item
+        return item
+
+    def cancel(self) -> None:
+        """Leave: flag the sequence; the loop retires it at the next step
+        boundary (and a waiting sequence is dropped at admission time).
+        Idempotent, callable from any thread."""
+        seq = self._seq
+        if not seq.cancelled:
+            seq.cancelled = True
+            self._sched._wake()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        tok = self.next(timeout=self.MAX_IDLE_S)
+        if tok is None:
+            raise TimeoutError(
+                f"sequence {self._seq.sid}: no token in "
+                f"{self.MAX_IDLE_S}s")
+        return tok
+
+
+class DecodeScheduler:
+    """Continuous-batching scheduler around a step model (see the
+    module docstring for the state machine and
+    :mod:`tpurpc.jaxshim.generate` for the model contract).
+
+    Knobs:
+
+    * ``max_batch`` — running-batch bound (rows per device step).
+    * ``prefill_budget`` — prompt tokens admitted per step boundary: new
+      joins cost their prompt length, resumed (preempted) sequences cost
+      nothing. At least one prefill is always admitted into a non-full
+      batch, so a prompt longer than the whole budget still runs.
+    * ``max_waiting`` — the interactive shed bar; batch-class work sheds
+      at ``batch_shed_depth`` (default half) and additionally as soon as
+      the step-time EWMA exceeds ``step_slo_ms`` — the trip-BEFORE-
+      collapse signal: rising step time at partial queue depth.
+    * ``draining_fn`` — usually ``lambda: server.draining``: when true,
+      submit refuses new work (:class:`DrainingError`) while in-flight
+      sequences finish.
+    """
+
+    #: lock map (lint rule `lock`): the waiting queue and lifecycle flags
+    #: are the ONLY cross-thread state; the running batch is loop-private
+    _GUARDED_BY = {"_waiting": "_lock", "_closed": "_lock",
+                   "_draining": "_lock"}
+
+    def __init__(self, model, *, max_batch: int = 8,
+                 prefill_budget: int = 128, max_waiting: int = 32,
+                 batch_shed_depth: Optional[int] = None,
+                 step_slo_ms: Optional[float] = None,
+                 base_pushback_ms: int = 25, max_pushback_ms: int = 1000,
+                 idle_wait_s: float = 0.05,
+                 draining_fn: Optional[Callable[[], bool]] = None,
+                 name: str = "gen"):
+        self.model = model
+        self.max_batch = max(1, int(max_batch))
+        self.prefill_budget = max(1, int(prefill_budget))
+        self.max_waiting = max(1, int(max_waiting))
+        self.batch_shed_depth = (int(batch_shed_depth)
+                                 if batch_shed_depth is not None
+                                 else max(1, self.max_waiting // 2))
+        self.step_slo_ms = step_slo_ms
+        self.base_pushback_ms = int(base_pushback_ms)
+        self.max_pushback_ms = int(max_pushback_ms)
+        self.idle_wait_s = idle_wait_s
+        self._draining_fn = draining_fn
+        self.name = name
+        self._lock = threading.Lock()
+        self._kick = threading.Condition(self._lock)
+        self._waiting: "deque[_Seq]" = deque()
+        self._closed = False
+        self._draining = False
+        self._running: List[_Seq] = []   # loop-private (no lock by design)
+        self._sids = itertools.count(1)
+        self._tag = _flight.tag_for(f"decode:{name}")
+        self._step_roll: "deque[float]" = deque(maxlen=64)  # step ms
+        self._step_ewma_ms = 0.0
+        self.steps = 0
+        self.tokens_out = 0
+        self.shed_total = 0
+        self.preempted_total = 0
+        self.last_shed_ns = 0
+        _RUNNING_G.track(self)
+        _WAITING_G.track(self)
+        _LIVE.add(self)
+        self._thread = threading.Thread(target=self._step_loop, daemon=True,
+                                        name=f"tpurpc-decode-{name}")
+        self._thread.start()
+
+    # -- submit side ----------------------------------------------------------
+
+    def submit(self, prompt, *, max_tokens: int = 32,
+               slo: str = SLO_INTERACTIVE) -> TokenStream:
+        """Queue one generation request; returns its :class:`TokenStream`.
+
+        Raises :class:`ShedError` (overload; carries the pushback hint),
+        :class:`DrainingError` (server leaving), or ``RuntimeError``
+        (closed). The returned stream's first token arrives after the
+        next step boundary admits the prefill — joining never waits for
+        the running batch to drain."""
+        if slo not in _SLO_CODE:
+            raise ValueError(f"unknown slo class {slo!r} "
+                             f"(want {sorted(_SLO_CODE)})")
+        prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        seq = _Seq(next(self._sids), prompt, max(1, int(max_tokens)), slo)
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("scheduler closed")
+            if self._draining or (self._draining_fn is not None
+                                  and self._draining_fn()):
+                raise DrainingError(
+                    "scheduler draining: in-flight sequences finish, new "
+                    "prefills are refused")
+            reason, pushback = self._shed_decision_locked(slo)
+            if reason is not None:
+                self.shed_total += 1
+                self.last_shed_ns = time.monotonic_ns()
+                slo_code = seq.slo_code
+                _flight.emit(_flight.GEN_SHED, self._tag, slo_code,
+                             pushback)
+                _SHED.labels(slo).inc()
+                raise ShedError(reason, pushback, slo)
+            self._waiting.append(seq)
+            self._kick.notify_all()
+        return TokenStream(seq, self)
+
+    def _shed_decision_locked(self, slo: str):
+        """(reason, pushback_ms) when this submit must shed, else
+        (None, 0). Class-aware and deliberately early for batch work:
+        the cheap class absorbs the first pressure so interactive TTFT
+        holds — the graceful half of the degradation curve."""
+        depth = len(self._waiting)
+        if depth >= self.max_waiting:
+            return ("queue full "
+                    f"({depth}/{self.max_waiting} waiting)",
+                    self._pushback(depth - self.max_waiting + 1))
+        if slo == SLO_BATCH:
+            if depth >= self.batch_shed_depth:
+                return ("batch-class queue bar "
+                        f"({depth}/{self.batch_shed_depth} waiting)",
+                        self._pushback(depth - self.batch_shed_depth + 1))
+            if (self.step_slo_ms is not None and depth > 0
+                    and self._step_ewma_ms > self.step_slo_ms):
+                return ("step time over SLO "
+                        f"({self._step_ewma_ms:.1f}ms > "
+                        f"{self.step_slo_ms}ms)",
+                        self._pushback(2))
+        return None, 0
+
+    def _pushback(self, excess: int) -> int:
+        return min(self.max_pushback_ms,
+                   self.base_pushback_ms * max(1, excess))
+
+    def _wake(self) -> None:
+        with self._lock:
+            self._kick.notify_all()
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def drain(self) -> None:
+        """Refuse new submits; in-flight sequences finish. (serve_
+        generation wires the server's own draining flag instead, via
+        ``draining_fn`` — this is the in-process face.)"""
+        with self._lock:
+            self._draining = True
+
+    def close(self, timeout: float = 5.0) -> None:
+        with self._lock:
+            self._closed = True
+            self._kick.notify_all()
+        self._thread.join(timeout=timeout)
+        # deregister from /healthz NOW: the loop-thread target is a
+        # reference cycle back to self, so waiting for cyclic GC would
+        # leave a dead scheduler's `gen` line on health bodies (and in
+        # anything forked meanwhile)
+        _LIVE.discard(self)
+
+    # -- shared-state reads (GIL-atomic; gauges + admission signals) ----------
+
+    def queue_depth(self) -> int:
+        return len(self._waiting)
+
+    def running_depth(self) -> int:
+        return len(self._running)
+
+    def step_time_ms(self) -> float:
+        return self._step_ewma_ms
+
+    def step_p99_ms(self) -> Optional[float]:
+        """Rolling p99 of recent step times — serve_generation feeds this
+        to the AdmissionGate as its latency signal (a decode server's
+        pre-collapse signature is a rising step time, not RPC latency)."""
+        roll = list(self._step_roll)
+        if len(roll) < 8:
+            return None
+        roll.sort()
+        return roll[max(0, int(len(roll) * 0.99) - 1)]
+
+    def state_str(self) -> str:
+        if self._draining or (self._draining_fn is not None
+                              and self._draining_fn()):
+            return "draining"
+        if (self.last_shed_ns
+                and time.monotonic_ns() - self.last_shed_ns < 5_000_000_000):
+            return "shedding"
+        return "ok"
+
+    # -- the loop thread ------------------------------------------------------
+
+    def _step_loop(self) -> None:
+        while True:
+            alive = self._boundary()
+            if not alive:
+                return
+            if self._running:
+                self._run_step()
+
+    def _boundary(self) -> bool:
+        """One step boundary: retire leaves, preempt, admit (with
+        prefill). Returns False when closed (loop exits). Every wait in
+        here is bounded — this function is on the step loop's no-block
+        path (lint rule `block`)."""
+        # leaves: clients that cancelled since the last step — retire
+        # them without touching their siblings
+        kept: List[_Seq] = []
+        for s in self._running:
+            if s.cancelled:
+                sid = s.sid
+                emitted = s.emitted
+                _flight.emit(_flight.GEN_LEAVE, self._tag, sid, emitted)
+                s.q.put(_DONE)
+            else:
+                kept.append(s)
+        self._running = kept
+        with self._lock:
+            if self._closed:
+                stranded = list(self._running) + list(self._waiting)
+                self._waiting.clear()
+                self._running = []
+                err = RuntimeError("scheduler closed")
+                for s in stranded:
+                    s.q.put(err)
+                return False
+            draining = self._draining or (self._draining_fn is not None
+                                          and self._draining_fn())
+            # decide (pure), then APPLY the queue edit lexically under the
+            # lock — the `lock` lint rule proves the guard holds
+            admit, keep, drop = self._admit(draining)
+            self._waiting.clear()
+            self._waiting.extend(keep)
+            if not self._running and not admit and not drop:
+                # idle: park (bounded — the block rule's contract) until a
+                # submit kicks; the next loop pass re-runs the boundary
+                self._kick.wait(timeout=self.idle_wait_s)
+                return True
+        for s, outcome in drop:
+            sid = s.sid
+            emitted = s.emitted
+            if isinstance(outcome, BaseException):
+                _flight.emit(_flight.GEN_RETIRE, self._tag, sid, emitted)
+                s.q.put(outcome)
+            else:
+                _flight.emit(_flight.GEN_LEAVE, self._tag, sid, emitted)
+                s.q.put(_DONE)
+        if admit:
+            self._prefill_batch(admit)
+        return True
+
+    def _admit(self, draining: bool):
+        """Decide the boundary's joins (runs under ``_lock``; PURE with
+        respect to the waiting queue — the caller applies the edit so the
+        guard is lexically provable). Interactive first; preemption makes
+        room for it; prefill rides the token budget; resumed sequences
+        are free. Returns ``(admit, keep, drop)`` where ``drop`` pairs a
+        sequence with ``None`` (client left) or an exception (refused)."""
+        admit: List[_Seq] = []
+        drop: List[tuple] = []
+        live: List[_Seq] = []
+        for s in self._waiting:
+            if s.cancelled:
+                drop.append((s, None))
+            else:
+                live.append(s)
+        if not live:
+            return admit, live, drop
+        # preemption-at-step-boundary: interactive work waiting, batch
+        # full, batch-class rows running -> the cheap class yields. State
+        # is kept, so the preempted sequence resumes without re-prefill.
+        want_i = sum(1 for s in live if s.slo == SLO_INTERACTIVE)
+        if want_i and len(self._running) >= self.max_batch:
+            for s in reversed(list(self._running)):
+                if want_i <= 0:
+                    break
+                if s.slo == SLO_BATCH:
+                    self._running.remove(s)
+                    s.preempted = True
+                    sid = s.sid
+                    slo_code = s.slo_code
+                    _flight.emit(_flight.GEN_PREEMPT, self._tag, sid,
+                                 slo_code)
+                    _PREEMPTS.inc()
+                    self.preempted_total += 1
+                    live.insert(0, s)
+                    want_i -= 1
+        slots = self.max_batch - len(self._running)
+        budget = self.prefill_budget
+        prefills = 0
+        keep: List[_Seq] = []
+        # two passes, interactive first; within a class, FIFO
+        for klass in (SLO_INTERACTIVE, SLO_BATCH):
+            for s in live:
+                if s.slo != klass:
+                    continue
+                if slots <= 0:
+                    keep.append(s)
+                    continue
+                if s.state is not None:        # resume: no prefill cost
+                    admit.append(s)
+                    slots -= 1
+                    continue
+                if draining:
+                    # drain: no NEW prefills (resumes still land); refuse
+                    # now rather than park callers behind a server that
+                    # will never admit them
+                    drop.append((s, DrainingError(
+                        "scheduler draining: prefill refused")))
+                    continue
+                cost = s.prompt_len
+                # the budget bounds prefill work per step; the first
+                # prefill is exempt so a prompt longer than the whole
+                # budget still runs (it just runs alone)
+                if cost <= budget or prefills == 0:
+                    admit.append(s)
+                    slots -= 1
+                    budget -= cost
+                    prefills += 1
+                else:
+                    keep.append(s)
+        # keep lost the cross-class FIFO interleaving; restore arrival
+        # order (sid order) so re-examination next boundary stays fair
+        keep.sort(key=lambda s: s.sid)
+        return admit, keep, drop
+
+    def _prefill_batch(self, admit: List[_Seq]) -> None:
+        """Join the admitted sequences: resumes re-enter directly, fresh
+        prompts prefill as ONE batched model call (row-isolated on
+        failure) and their first token streams immediately."""
+        fresh = [s for s in admit if s.state is None]
+        for s in admit:
+            if s.state is not None:
+                sid = s.sid
+                _flight.emit(_flight.GEN_JOIN, self._tag, sid, 0)
+                self._running.append(s)
+        if not fresh:
+            return
+        try:
+            states, tokens = self.model.prefill([s.prompt for s in fresh])
+            results = [(states[i], int(tokens[i]))
+                       for i in range(len(fresh))]
+        except Exception:
+            # batched prefill failed: row-by-row isolation (one bad
+            # prompt must not fail its co-admitted siblings)
+            results = []
+            for s in fresh:
+                try:
+                    st, tok = self.model.prefill([s.prompt])
+                    results.append((st[0], int(tok[0])))
+                except Exception as exc:
+                    results.append(exc)
+        emitted = 0
+        for s, res in zip(fresh, results):
+            sid = s.sid
+            plen = s.prompt_len
+            if isinstance(res, Exception):
+                _SEQ_FAILED.inc()
+                _flight.emit(_flight.GEN_RETIRE, self._tag, sid, 0)
+                s.q.put(res)
+                continue
+            s.state, first = res
+            _flight.emit(_flight.GEN_JOIN, self._tag, sid, plen)
+            self._emit_token(s, first)
+            emitted += 1
+            if s.emitted < s.max_tokens and not self._hit_eos(first):
+                self._running.append(s)
+            else:
+                self._retire(s)
+        # prefill's sampled token counts like any other emitted token
+        self.tokens_out += emitted
+        _TOKENS.inc(emitted)
+
+    def _run_step(self) -> None:
+        """One batched decode step over the running batch; delivery and
+        retirement inline (loop-private state, no locks)."""
+        running = self._running
+        nb = len(running)
+        waiting_n = len(self._waiting)
+        _flight.emit(_flight.GEN_STEP_BEGIN, self._tag, nb, waiting_n)
+        t0 = time.monotonic_ns()
+        states = np.stack([s.state for s in running])
+        tokens = np.asarray([s.last_token for s in running],
+                            dtype=np.int32)
+        try:
+            new_states, new_tokens = self.model.step(states, tokens)
+            results = [(new_states[i], int(new_tokens[i]))
+                       for i in range(nb)]
+        except Exception:
+            # poisoned batch: retry row-by-row so the bad sequence fails
+            # ALONE (PR 3/7 poison-isolation discipline, decode edition)
+            results = []
+            for s in running:
+                try:
+                    st, tok = self.model.step(s.state[None],
+                                              np.asarray([s.last_token],
+                                                         dtype=np.int32))
+                    results.append((st[0], int(tok[0])))
+                except Exception as exc:
+                    results.append(exc)
+        dt_ns = time.monotonic_ns() - t0
+        self._note_step_time(dt_ns)
+        emitted = 0
+        kept: List[_Seq] = []
+        for s, res in zip(running, results):
+            if isinstance(res, Exception):
+                _SEQ_FAILED.inc()
+                sid = s.sid
+                n = s.emitted
+                _flight.emit(_flight.GEN_RETIRE, self._tag, sid, n)
+                s.q.put(res)
+                continue
+            s.state, tok = res
+            self._emit_token(s, tok)
+            emitted += 1
+            if s.emitted >= s.max_tokens or self._hit_eos(tok):
+                self._retire(s)
+            else:
+                kept.append(s)
+        self._running = kept
+        self.steps += 1
+        self.tokens_out += emitted
+        _STEPS.inc()
+        _TOKENS.inc(emitted)
+        _STEP_BATCH.record(nb)
+        _STEP_US.record(dt_ns // 1000)
+        _flight.emit(_flight.GEN_STEP_END, self._tag, nb, emitted)
+
+    # -- loop helpers ---------------------------------------------------------
+
+    def _note_step_time(self, dt_ns: int) -> None:
+        ms = dt_ns / 1e6
+        self._step_roll.append(ms)
+        a = 0.2
+        self._step_ewma_ms = ms if self._step_ewma_ms == 0.0 else (
+            (1 - a) * self._step_ewma_ms + a * ms)
+
+    def _emit_token(self, s: _Seq, tok: int) -> None:
+        s.last_token = tok
+        s.emitted += 1
+        if s.t_first_ns == 0:
+            s.t_first_ns = time.monotonic_ns()
+            _TTFT_US.record((s.t_first_ns - s.t_submit_ns) // 1000)
+        s.q.put(tok)
+
+    def _hit_eos(self, tok: int) -> bool:
+        eos = getattr(self.model, "eos", None)
+        return eos is not None and tok == eos
+
+    def _retire(self, s: _Seq) -> None:
+        sid = s.sid
+        n = s.emitted
+        _flight.emit(_flight.GEN_RETIRE, self._tag, sid, n)
+        s.q.put(_DONE)
+
+def health_lines() -> List[str]:
+    """One ``gen:`` line per live scheduler for /healthz — the shed/queue
+    state an operator (or an LB) reads during overload without scraping
+    the full metrics plane."""
+    out = []
+    for s in list(_LIVE):
+        try:
+            if s._closed:
+                continue
+            out.append(
+                f"gen {s.name}: state={s.state_str()} "
+                f"running={s.running_depth()} waiting={s.queue_depth()} "
+                f"steps={s.steps} shed={s.shed_total} "
+                f"preempted={s.preempted_total}")
+        except Exception:
+            continue
+    return sorted(out)

@@ -1,0 +1,218 @@
+"""~5s tpurpc-cadence smoke for the verification gate (tools/check.sh).
+
+The ISSUE 10 acceptance story in miniature, jax-free (the toy decode
+model is pure numpy):
+
+* an interactive AND a batch-class client stream generations
+  concurrently off one continuous-batching server — every token arrives
+  IN ORDER (indices 0..n-1) with the exact values the reference
+  recomputation predicts (any cross-stream mixup or dropped step changes
+  the values, not just the count), and the second client's ``gen-join``
+  sits between two step events (it joined MID-DECODE);
+* an offered-load burst past the batch-class bar sheds AT LEAST ONE
+  request — UNAVAILABLE with the pushback trailer, a ``gen-shed`` flight
+  event, ``/healthz`` saying ``state=shedding`` with the queue numbers —
+  while the admitted remainder COMPLETES once capacity frees (shed, not
+  strand);
+* an induced SLOW STEP (the model wedges mid-step) is attributed by the
+  stall watchdog to the new ``decode-step`` stage within two sweeps,
+  ``/healthz`` degrades while it lasts, and the stream completes once
+  unwedged.
+
+Exit 0 on success; any assertion/exception exits 1 with the reason.
+
+    python -m tpurpc.tools.serving_gen_smoke
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+
+
+def _wait_for(pred, timeout: float, what: str):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        got = pred()
+        if got:
+            return got
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def run() -> int:
+    from tpurpc.jaxshim.generate import ToyDecodeModel, reference_decode
+    from tpurpc.obs import flight, scrape, watchdog
+    from tpurpc.rpc.channel import Channel
+    from tpurpc.rpc.server import PUSHBACK_KEY
+    from tpurpc.rpc.status import RpcError, StatusCode
+    from tpurpc.serving import GenerationClient, serve_generation
+
+    flight.RECORDER.reset()
+    wd = watchdog.get()
+    wd.reset()
+    wd.enabled = False           # quiet until the induced-stall phase:
+    #                              healthy multi-second token streams are
+    #                              not stalls
+
+    wedge = threading.Event()
+    wedge.set()                  # open = steps run normally
+
+    class SmokeModel(ToyDecodeModel):
+        def step(self, states, tokens):
+            wedge.wait(10)
+            return super().step(states, tokens)
+
+    model = SmokeModel(step_delay_s=0.002)
+    srv, port, sched = serve_generation(model, max_batch=4, max_waiting=6,
+                                        batch_shed_depth=2)
+    try:
+        with Channel(f"127.0.0.1:{port}") as ch:
+            gen = GenerationClient(ch)
+
+            # -- per-token order + values, interactive + batch together --
+            out: dict = {}
+
+            def client(key, prompt, slo, n):
+                out[key] = list(gen.generate_with_meta(
+                    prompt, max_tokens=n, slo=slo, timeout=30))
+
+            t1 = threading.Thread(target=client,
+                                  args=("inter", [1, 2], "interactive", 24))
+            t1.start()
+            time.sleep(0.02)     # let the first stream start decoding
+            t2 = threading.Thread(target=client,
+                                  args=("batch", [3], "batch", 24))
+            t2.start()
+            t1.join(20)
+            t2.join(20)
+            for key, prompt in (("inter", [1, 2]), ("batch", [3])):
+                pairs = out.get(key)
+                assert pairs, f"{key} client produced nothing"
+                idxs = [i for i, _ in pairs]
+                assert idxs == list(range(24)), (
+                    f"{key} stream out of order: {idxs}")
+                vals = [t for _, t in pairs]
+                want = reference_decode(prompt, 24)
+                assert vals == want, (
+                    f"{key} stream values wrong: {vals[:4]}... "
+                    f"vs {want[:4]}...")
+            # continuous batching, not serial: the device stepped merged
+            # batches (48 tokens from well under 48 steps)
+            assert sched.steps < 40, (
+                f"{sched.steps} steps for 48 tokens: batches never merged")
+            ev = flight.snapshot()
+            joins = [e for e in ev if e["event"] == "gen-join"]
+            steps = [e for e in ev
+                     if e["event"] in ("gen-step-begin", "gen-step-end")]
+            assert len(joins) >= 2 and steps, "flight missing join/step"
+            t_join2 = joins[1]["t_ns"]
+            assert any(e["t_ns"] < t_join2 for e in steps) and \
+                any(e["t_ns"] > t_join2 for e in steps), (
+                    "second join not between step events: not mid-decode")
+            print(f"gen smoke: 2 classes x 24 tokens in order, "
+                  f"{sched.steps} merged steps, join mid-decode OK")
+
+            # -- offered-load burst: sheds trip, admitted work completes --
+            hold = [gen.call([9], max_tokens=400, timeout=60)
+                    for _ in range(4)]
+            hold_iters = [iter(c) for c in hold]
+            for it in hold_iters:
+                next(it)          # 4 running: the batch is full
+            burst: dict = {}
+
+            def burst_client(i):
+                try:
+                    got = list(gen.generate([i], max_tokens=2, slo="batch",
+                                            timeout=30))
+                    burst[i] = ("ok", got)
+                except RpcError as exc:
+                    burst[i] = ("err", exc)
+
+            threads = [threading.Thread(target=burst_client, args=(i,))
+                       for i in range(6)]
+            for t in threads:
+                t.start()
+                time.sleep(0.01)  # ordered offered load: queue then shed
+            _wait_for(lambda: sched.shed_total >= 1, 10.0,
+                      "the burst to shed")
+            status, _ctype, body = scrape._route("/healthz")
+            assert status == 200 and b"state=shedding" in body, (
+                status, body)
+            assert b"waiting=" in body and b"running=" in body, body
+            for c in hold:        # free capacity: queued burst work lands
+                c.cancel()
+            for t in threads:
+                t.join(20)
+            sheds = 0
+            for i, (kind, payload) in sorted(burst.items()):
+                if kind == "ok":
+                    assert payload == reference_decode([i], 2), (i, payload)
+                    continue
+                assert payload.code() is StatusCode.UNAVAILABLE, payload
+                md = dict(payload.trailing_metadata() or ())
+                assert PUSHBACK_KEY in md and int(md[PUSHBACK_KEY]) > 0, md
+                sheds += 1
+            assert sheds >= 1, f"burst outcomes: {burst}"
+            assert any(e["event"] == "gen-shed"
+                       for e in flight.snapshot()), "no gen-shed event"
+            _wait_for(lambda: sched.running_depth() + sched.queue_depth()
+                      == 0, 10.0, "the burst to drain")
+            print(f"gen smoke: burst shed {sheds}/6 with pushback, "
+                  f"{6 - sheds} completed after capacity freed, healthz "
+                  "showed shedding + queue state")
+
+            # -- induced slow step -> decode-step watchdog stage -----------
+            wd.reset()
+            wd.enabled = True
+            wd.min_stall_s = 0.3  # fast smoke knobs (prod: 1s/0.25s)
+            wd.sweep_s = 0.1
+            wd.mult = 2
+            slow_out: dict = {}
+
+            def slow_client():
+                try:
+                    slow_out["v"] = list(gen.generate([7], max_tokens=6,
+                                                      timeout=30))
+                except Exception as exc:
+                    slow_out["e"] = exc
+
+            wedge.clear()         # the next decode step wedges mid-model
+            t = threading.Thread(target=slow_client)
+            t.start()
+            _wait_for(lambda: sched.running_depth() >= 1, 5.0,
+                      "the slow stream to join")
+            diags = _wait_for(
+                lambda: [d for d in wd.active()
+                         if d["stage"] == "decode-step"],
+                wd.min_stall_s + 6 * wd.sweep_s + 3.0,
+                "decode-step watchdog attribution")
+            assert "wedged" in diags[0]["detail"], diags
+            status, _ctype, body = scrape._route("/healthz")
+            assert status == 503 and b"decode-step" in body, (status, body)
+            wedge.set()
+            t.join(20)
+            assert slow_out.get("v") == reference_decode([7], 6), slow_out
+            _wait_for(lambda: not wd.active(), 5.0, "the stall to clear")
+            print(f"gen smoke: induced slow step attributed to "
+                  f"decode-step, healthz degraded while active, stream "
+                  f"completed after unwedge")
+    finally:
+        srv.stop(grace=0)
+        sched.close()
+        wd.reset()
+        wd.enabled = True
+    return 0
+
+
+def main() -> int:
+    try:
+        return run()
+    except BaseException as exc:  # the gate wants a reasoned nonzero exit
+        print(f"serving gen smoke FAILED: {exc!r}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
