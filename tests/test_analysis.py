@@ -1136,3 +1136,135 @@ def test_serving_scheduler_module_is_clean():
     with open(sched_mod.__file__, "r", encoding="utf-8") as f:
         vs = lint_source(f.read(), sched_mod.__file__)
     assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# tpurpc-keystone (ISSUE 11): the kv block-alloc pairing rule
+# ---------------------------------------------------------------------------
+
+KV_OK = '''
+def prefill_row(self, seq, prompt):
+    kv, hit = self.mgr.alloc_for_prompt(seq, prompt)
+    try:
+        self.model.fold(prompt, kv)
+    except BaseException:
+        self.mgr.free_blocks(kv)
+        raise
+    return kv
+'''
+
+KV_NO_RELEASE = '''
+def prefill_row(self, seq, prompt):
+    kv, hit = self.mgr.alloc_for_prompt(seq, prompt)
+    self.model.fold(prompt, kv)
+    return kv
+'''
+
+KV_SWAP_COVERS = '''
+def preempt(self, seq):
+    blocks = self.mgr.alloc_blocks(seq, 2)
+    try:
+        fill(blocks)
+    finally:
+        self.mgr.swap_out(seq)
+'''
+
+KV_QUARANTINE_COVERS = '''
+def receive(self, seq, n):
+    blocks = self.mgr.alloc_blocks(seq, n)
+    try:
+        land(blocks)
+    except Exception:
+        self.mgr.quarantine(blocks)
+        raise
+'''
+
+
+def test_kv_pairing_positive():
+    assert lint_source(KV_OK, "fixture.py") == []
+
+
+def test_kv_missing_release_flagged():
+    vs = lint_source(KV_NO_RELEASE, "fixture.py")
+    assert _rules(vs) == ["kv"] and "exception path" in vs[0].message
+
+
+def test_kv_swap_out_counts_as_release():
+    assert lint_source(KV_SWAP_COVERS, "fixture.py") == []
+
+
+def test_kv_quarantine_counts_as_release():
+    assert lint_source(KV_QUARANTINE_COVERS, "fixture.py") == []
+
+
+def test_kv_suppression():
+    src = KV_NO_RELEASE.replace(
+        "self.mgr.alloc_for_prompt(seq, prompt)",
+        "self.mgr.alloc_for_prompt(seq, prompt)  # tpr: allow(kv)")
+    assert lint_source(src, "fixture.py") == []
+
+
+def test_kv_modules_are_clean():
+    """The real KV plane holds the pairing + flight-encoder contracts it
+    exports (serving/kv.py and serving/disagg.py are both on the flight
+    hot-module list)."""
+    import tpurpc.serving.disagg as disagg_mod
+    import tpurpc.serving.kv as kv_mod
+
+    for mod in (kv_mod, disagg_mod):
+        with open(mod.__file__, "r", encoding="utf-8") as f:
+            vs = lint_source(f.read(), mod.__file__)
+        assert [v for v in vs
+                if v.rule in ("kv", "flight", "lock")] == [], mod.__name__
+
+
+# ---------------------------------------------------------------------------
+# ringcheck: the kv block-table handoff model (tpurpc-keystone)
+# ---------------------------------------------------------------------------
+
+def test_kv_handoff_model_clean_configs():
+    from tpurpc.analysis import ringcheck
+
+    for cfg in (dict(blocks=2), dict(blocks=3),
+                dict(blocks=2, with_death=True),
+                dict(blocks=3, with_death=True)):
+        res = ringcheck.check_kv_handoff(**cfg)
+        assert res.ok, res
+
+
+def test_kv_handoff_reuse_before_quarantine_killed():
+    """The ISSUE 11 seeded mutant: a dest that returns a reaped handoff's
+    blocks to the free list lets a straggling one-sided write land in
+    re-leased memory — the model must catch exactly that."""
+    from tpurpc.analysis import ringcheck
+
+    res = ringcheck.check_kv_handoff(blocks=2, with_death=True,
+                                     mutant="kv_reuse_before_quarantine")
+    assert not res.ok
+    assert res.violation.kind == "stale-write"
+
+
+def test_kv_handoff_free_before_complete_killed():
+    from tpurpc.analysis import ringcheck
+
+    res = ringcheck.check_kv_handoff(blocks=2,
+                                     mutant="kv_free_before_complete")
+    assert not res.ok
+    assert res.violation.kind == "torn"
+
+
+def test_kv_handoff_mutants_ride_default_kill_suite():
+    from tpurpc.analysis import ringcheck
+
+    verdicts = ringcheck.mutant_kill_suite()
+    for mutant in ringcheck.KV_MUTANTS:
+        assert verdicts.get(mutant) is True, verdicts
+    assert all(verdicts.values()), verdicts
+
+
+def test_kv_handoff_model_rides_default_suite():
+    from tpurpc.analysis import ringcheck
+
+    results = ringcheck.default_suite()
+    kv = [r for r in results if r.config.startswith("kv_handoff")]
+    assert len(kv) >= 4 and all(r.ok for r in kv)

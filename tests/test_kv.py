@@ -1,0 +1,479 @@
+"""tpurpc-keystone (ISSUE 11): the paged KV-cache plane.
+
+The block manager's contracts — alloc/free accounting, block tables,
+copy-on-write prefix reuse, preempt-to-host swap, quarantine — then the
+explicit-KV model contract's exact-token equivalence with the opaque-state
+path (the satellite regression), the paged scheduler end-to-end, and the
+new observability: gauges, flight edges, the `kv-swap` watchdog stage,
+and the /healthz kv lines."""
+
+import time
+
+import numpy as np
+import pytest
+
+from tpurpc.jaxshim.generate import ToyDecodeModel, reference_decode
+from tpurpc.obs import flight, watchdog
+from tpurpc.serving.kv import (ENTRY_BYTES, FLAG_POISONED, HostKv,
+                               KvArenaFull, KvBlockManager)
+from tpurpc.serving.scheduler import (SLO_BATCH, SLO_INTERACTIVE,
+                                      DecodeScheduler, TokenStream)
+
+
+@pytest.fixture(autouse=True)
+def _fast_streams():
+    old = TokenStream.MAX_IDLE_S
+    TokenStream.MAX_IDLE_S = 10.0
+    yield
+    TokenStream.MAX_IDLE_S = old
+
+
+def _mgr(**kw):
+    kw.setdefault("n_blocks", 64)
+    kw.setdefault("block_bytes", 64)   # 4 entries per block
+    kw.setdefault("kind", "local")
+    return KvBlockManager(**kw)
+
+
+def _poll(pred, timeout=5.0, interval=0.005):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        got = pred()
+        if got:
+            return got
+        time.sleep(interval)
+    return pred()
+
+
+# -- the arena / block tables -------------------------------------------------
+
+def test_alloc_free_accounting_roundtrips():
+    m = _mgr(n_blocks=8)
+    try:
+        assert m.free_count() == 8 and m.used_count() == 0
+        kv, hit = m.alloc_for_prompt(1, np.arange(9, dtype=np.int32))
+        assert hit == 0
+        for i in range(9):
+            kv.append(i * 3, i)
+        assert len(kv.blocks) == 3 and m.used_count() == 3
+        m.free_blocks(kv)
+        assert m.free_count() == 8 and not kv.blocks
+    finally:
+        m.close()
+
+
+def test_entries_survive_block_boundaries():
+    m = _mgr()
+    try:
+        kv, _ = m.alloc_for_prompt(1, np.asarray([1], np.int32))
+        for i in range(13):       # crosses 3 block boundaries
+            kv.append(i * 1000003, i % 251, i % 2)
+        for i in range(13):
+            assert kv.entry(i) == (i * 1000003, i % 251, i % 2)
+        assert kv.last() == kv.entry(12)
+        m.free_blocks(kv)
+    finally:
+        m.close()
+
+
+def test_arena_full_raises_after_evicting_prefix_cache():
+    m = _mgr(n_blocks=4)
+    try:
+        # retire a sequence donating a 4-entry (1 block) prefix
+        kv, _ = m.alloc_for_prompt(1, np.arange(4, dtype=np.int32))
+        for i in range(4):
+            kv.append(i, i)
+        m.free_blocks(kv, cache_prefix=True)
+        assert m.prefix_entries() == 1
+        # demand every block: the cache entry is evicted to make room
+        kv2, _ = m.alloc_for_prompt(2, np.asarray([9], np.int32))
+        kv2.reserve(16)
+        assert m.prefix_entries() == 0 and len(kv2.blocks) == 4
+        with pytest.raises(KvArenaFull):
+            kv3, _ = m.alloc_for_prompt(3, np.asarray([8], np.int32))
+            kv3.reserve(4)
+        m.free_blocks(kv2)
+    finally:
+        m.close()
+
+
+def test_truncate_undoes_partial_appends():
+    m = _mgr()
+    try:
+        kv, _ = m.alloc_for_prompt(1, np.asarray([1], np.int32))
+        for i in range(6):
+            kv.append(i, i)
+        kv.truncate(4)
+        assert kv.length == 4
+        kv.append(99, 9)
+        assert kv.entry(4) == (99, 9, 0)
+        m.free_blocks(kv)
+    finally:
+        m.close()
+
+
+# -- copy-on-write prefix reuse -----------------------------------------------
+
+def test_prefix_cache_hit_shares_blocks_refcounted():
+    m = _mgr()
+    try:
+        prompt = np.arange(10, dtype=np.int32)   # aligned span = 8
+        kv, _ = m.alloc_for_prompt(1, prompt)
+        for i in range(10):
+            kv.append(i * 7, i)
+        shared = list(kv.blocks[:2])
+        m.free_blocks(kv, cache_prefix=True)
+        kv2, hit = m.alloc_for_prompt(2, prompt)
+        assert hit == 8 and kv2.blocks[:2] == shared
+        assert kv2.shared_len == 8 and kv2.length == 8
+        assert m.block_refs(shared[0]) == 2   # cache + kv2
+        # entries readable through the shared span, byte-exact
+        assert kv2.entry(7) == (49, 7, 0)
+        assert m.prefix_hits == 1
+        m.free_blocks(kv2)
+        assert m.block_refs(shared[0]) == 1   # cache keeps its ref
+    finally:
+        m.close()
+
+
+def test_cow_write_copies_shared_block():
+    m = _mgr()
+    try:
+        prompt = np.arange(8, dtype=np.int32)
+        kv, _ = m.alloc_for_prompt(1, prompt)
+        for i in range(8):
+            kv.append(i, i)
+        m.free_blocks(kv, cache_prefix=True)
+        kv2, hit = m.alloc_for_prompt(2, prompt)
+        assert hit == 8
+        orig = kv2.blocks[0]
+        fresh = kv2.writable_block(0)
+        assert fresh != orig and m.block_refs(fresh) == 1
+        # the copy carried the bytes; the CACHED block is untouched by
+        # writes through the private copy
+        assert kv2.entry(0) == (0, 0, 0)
+        m.block_view(fresh)[:4] = b"\xff\xff\xff\xff"
+        kv3, hit3 = m.alloc_for_prompt(3, prompt)
+        assert hit3 == 8 and kv3.entry(0) == (0, 0, 0)
+        m.free_blocks(kv2)
+        m.free_blocks(kv3)
+    finally:
+        m.close()
+
+
+def test_poisoned_prefix_never_cached():
+    m = _mgr()
+    try:
+        model = ToyDecodeModel(poison_token=666)
+        prompt = np.asarray([1, 2, 666, 4, 5, 6, 7, 8], np.int32)
+        kv, _ = m.alloc_for_prompt(1, prompt)
+        model.prefill_paged([prompt], [kv])
+        assert kv.entry(7)[2] & FLAG_POISONED
+        m.free_blocks(kv, cache_prefix=True)
+        assert m.prefix_entries() == 0
+        kv2, hit = m.alloc_for_prompt(2, prompt)
+        assert hit == 0
+        m.free_blocks(kv2)
+    finally:
+        m.close()
+
+
+# -- preempt-to-host swap -----------------------------------------------------
+
+def test_swap_roundtrip_byte_exact_and_gauged():
+    m = _mgr()
+    try:
+        kv, _ = m.alloc_for_prompt(7, np.asarray([1], np.int32))
+        for i in range(11):
+            kv.append(i * 31, i, 0)
+        used0 = m.used_count()
+        m.swap_out(kv)
+        assert kv.swapped and not kv.blocks
+        assert m.used_count() == used0 - 3
+        assert m.swapped_count() == 3
+        # entries readable FROM the host image (migration ships them)
+        assert kv.entry(10) == (310, 10, 0)
+        m.swap_in(kv)
+        assert not kv.swapped and m.swapped_count() == 0
+        for i in range(11):
+            assert kv.entry(i) == (i * 31, i, 0)
+        m.free_blocks(kv)
+    finally:
+        m.close()
+
+
+def test_swap_flight_edges_bracket():
+    flight.RECORDER.reset()
+    m = _mgr()
+    try:
+        kv, _ = m.alloc_for_prompt(5, np.asarray([1], np.int32))
+        kv.append(1, 1)
+        m.swap_out(kv)
+        m.swap_in(kv)
+        ev = [(e["event"], e["a2"]) for e in flight.snapshot()
+              if e["event"].startswith("kv-swap")]
+        assert ev == [("kv-swap-begin", 0), ("kv-swap-end", 0),
+                      ("kv-swap-begin", 1), ("kv-swap-end", 1)], ev
+        m.free_blocks(kv)
+    finally:
+        m.close()
+
+
+# -- quarantine ---------------------------------------------------------------
+
+def test_quarantined_blocks_never_return_to_free_list():
+    m = _mgr(n_blocks=4)
+    try:
+        blocks = m.alloc_blocks(1, 2)
+        n = m.quarantine(blocks)
+        assert n == 2
+        assert m.quarantined_count() == 2
+        assert m.free_count() == 2
+        # the arena can never hand them out again
+        got = m.alloc_blocks(2, 2)
+        assert not set(got) & set(blocks)
+        with pytest.raises(KvArenaFull):
+            m.alloc_blocks(3, 1)
+        m.free_blocks_raw(got)
+    finally:
+        m.close()
+
+
+def test_quarantine_respects_shared_refs():
+    m = _mgr()
+    try:
+        prompt = np.arange(8, dtype=np.int32)
+        kv, _ = m.alloc_for_prompt(1, prompt)
+        for i in range(8):
+            kv.append(i, i)
+        m.free_blocks(kv, cache_prefix=True)       # cache holds 2 blocks
+        kv2, hit = m.alloc_for_prompt(2, prompt)
+        assert hit == 8
+        n = m.quarantine(kv2)
+        # shared blocks only decref'd (cache still holds them); nothing
+        # actually quarantined
+        assert n == 0 and m.prefix_entries() == 1
+        kv3, hit3 = m.alloc_for_prompt(3, prompt)
+        assert hit3 == 8
+        m.free_blocks(kv3)
+    finally:
+        m.close()
+
+
+# -- explicit-KV model contract: exact equivalence (satellite) ----------------
+
+def test_paged_contract_matches_opaque_path_exactly():
+    """The satellite regression: prefill_paged/step_paged emit EXACTLY
+    the tokens the opaque prefill/step path (and reference_decode) emit,
+    for a spread of prompts and lengths."""
+    m = _mgr()
+    try:
+        for prompt in ([1], [3, 1, 4], list(range(20)), [7] * 5):
+            model_a = ToyDecodeModel()
+            model_b = ToyDecodeModel()
+            p = np.asarray(prompt, np.int32)
+            # opaque path
+            states, toks = model_a.prefill([p])
+            opaque = [int(toks[0])]
+            for _ in range(15):
+                states, toks = model_a.step(
+                    states, np.asarray(toks, np.int32))
+                opaque.append(int(toks[0]))
+            # paged path
+            kv, _ = m.alloc_for_prompt(hash(tuple(prompt)) & 0xFFFF, p)
+            paged = [int(model_b.prefill_paged([p], [kv])[0])]
+            for _ in range(15):
+                paged.append(int(model_b.step_paged(
+                    [kv], np.asarray([paged[-1]], np.int32))[0]))
+            assert opaque == paged == reference_decode(prompt, 16), prompt
+            m.free_blocks(kv)
+    finally:
+        m.close()
+
+
+def test_paged_prefill_resumes_from_cached_span_exactly():
+    m = _mgr()
+    try:
+        model = ToyDecodeModel()
+        p = np.arange(10, dtype=np.int32)   # span 8 of 10: partial hit
+        kv, _ = m.alloc_for_prompt(1, p)
+        model.prefill_paged([p], [kv])
+        m.free_blocks(kv, cache_prefix=True)
+        kv2, hit = m.alloc_for_prompt(2, p)
+        assert hit == 8
+        first = int(model.prefill_paged([p], [kv2])[0])
+        out = [first]
+        for _ in range(7):
+            out.append(int(model.step_paged(
+                [kv2], np.asarray([out[-1]], np.int32))[0]))
+        assert out == reference_decode(p, 8)
+        m.free_blocks(kv2)
+    finally:
+        m.close()
+
+
+def test_hostkv_seeded_base_matches_cold_prefill():
+    """The prefill server's shape: a HostKv seeded with the resume hash
+    computes the SAME tail entries a cold prefill computes."""
+    model = ToyDecodeModel()
+    p = np.arange(12, dtype=np.int32)
+    cold = HostKv()
+    first_cold = int(model.prefill_paged([p], [cold])[0])
+    # the decode side's claimed resume point: entry 7's hash
+    base_hash = cold.entry(7)[0]
+    warm = HostKv(base_pos=8, base_hash=base_hash, base_flags=0)
+    first_warm = int(model.prefill_paged([p], [warm])[0])
+    assert first_cold == first_warm == reference_decode(p, 1)[0]
+    # shipped payloads agree on the overlapping entries
+    assert bytes(cold.payload()[8 * ENTRY_BYTES:]) == bytes(warm.payload())
+
+
+# -- the paged scheduler end-to-end -------------------------------------------
+
+def test_paged_scheduler_streams_reference_tokens():
+    m = _mgr(n_blocks=256)
+    s = DecodeScheduler(ToyDecodeModel(), kv=m, max_batch=4,
+                        idle_wait_s=0.01)
+    try:
+        handles = {i: s.submit([i, i + 1], max_tokens=24)
+                   for i in range(10)}
+        for i, h in handles.items():
+            assert list(h) == reference_decode([i, i + 1], 24), i
+    finally:
+        s.close()
+        m.close()
+
+
+def test_paged_scheduler_releases_all_blocks_at_retire():
+    m = _mgr(n_blocks=64)
+    s = DecodeScheduler(ToyDecodeModel(), kv=m, max_batch=4,
+                        idle_wait_s=0.01)
+    try:
+        for i in range(6):
+            list(s.submit([i], max_tokens=10))
+        # everything freed (short prompts are below the block-aligned
+        # span bar, so nothing is even cached)
+        assert _poll(lambda: m.used_count() == 0), m.stats()
+    finally:
+        s.close()
+        m.close()
+
+
+def test_paged_swap_preemption_resumes_value_exact():
+    m = _mgr(n_blocks=128, block_bytes=256)
+    s = DecodeScheduler(ToyDecodeModel(step_delay_s=0.002), kv=m,
+                        max_batch=1, idle_wait_s=0.005)
+    try:
+        flight.RECORDER.reset()
+        long = s.submit([9], max_tokens=60, slo=SLO_BATCH)
+        for _ in range(5):
+            long.next(timeout=5)
+        quick = s.submit([4], max_tokens=4, slo=SLO_INTERACTIVE)
+        assert list(quick) == reference_decode([4], 4)
+        rest = list(long)
+        assert reference_decode([9], 60)[5:] == rest
+        assert s.preempted_total >= 1
+        assert m.swaps_out >= 1 and m.swaps_in >= 1
+        ev = [e["event"] for e in flight.snapshot()]
+        assert "kv-swap-begin" in ev and "kv-swap-end" in ev
+    finally:
+        s.close()
+        m.close()
+
+
+def test_paged_poisoned_sequence_fails_alone_and_frees():
+    m = _mgr(n_blocks=64)
+    s = DecodeScheduler(ToyDecodeModel(poison_token=666), kv=m,
+                        max_batch=4, idle_wait_s=0.01)
+    try:
+        good1 = s.submit([3], max_tokens=20)
+        bad = s.submit([666], max_tokens=20)
+        good2 = s.submit([4], max_tokens=20)
+        assert list(good1) == reference_decode([3], 20)
+        assert list(good2) == reference_decode([4], 20)
+        with pytest.raises(ValueError, match="poison"):
+            list(bad)
+        assert _poll(lambda: m.used_count() == 0), m.stats()
+    finally:
+        s.close()
+        m.close()
+
+
+def test_paged_scheduler_requires_contract():
+    class NoPaged:
+        pass
+
+    m = _mgr()
+    try:
+        with pytest.raises(ValueError, match="explicit-KV"):
+            DecodeScheduler(NoPaged(), kv=m)
+    finally:
+        m.close()
+
+
+# -- observability ------------------------------------------------------------
+
+def test_kv_gauges_registered_and_live():
+    from tpurpc.obs import metrics
+
+    m = _mgr(n_blocks=16)
+    try:
+        kv, _ = m.alloc_for_prompt(1, np.asarray([1], np.int32))
+        kv.append(1, 1)
+        reg = metrics.registry().metrics()
+        for name in ("kv_blocks_used", "kv_blocks_free",
+                     "kv_blocks_swapped", "kv_blocks_quarantined"):
+            assert name in reg, name
+        assert reg["kv_blocks_used"].collect()[0] >= 1
+        m.free_blocks(kv)
+    finally:
+        m.close()
+
+
+def test_healthz_shows_kv_lines():
+    from tpurpc.obs import scrape
+
+    m = _mgr(n_blocks=16, name="hz")
+    try:
+        kv, _ = m.alloc_for_prompt(1, np.asarray([1], np.int32))
+        kv.append(1, 1)
+        status, _ctype, body = scrape.route_local("/healthz")
+        assert status == 200
+        text = body.decode()
+        assert "kv hz:" in text and "used=1/16" in text, text
+        m.free_blocks(kv)
+    finally:
+        m.close()
+
+
+def test_watchdog_names_kv_swap_stage():
+    """An open kv-swap bracket aged past the stall floor is attributed to
+    the new `kv-swap` stage."""
+    flight.RECORDER.reset()
+    wd = watchdog.StallWatchdog(sweep_s=10, mult=8, min_stall_s=0.2)
+    wd.enabled = True
+    tag = flight.tag_for("kv:wdtest")
+    tok = wd.call_started("/tpurpc.Generate/Generate")
+    flight.emit(flight.KV_SWAP_BEGIN, tag, 42, 0)   # no END: wedged
+    time.sleep(0.35)
+    diags = wd.sweep_once()
+    assert diags and diags[0]["stage"] == "kv-swap", diags
+    assert "swap" in diags[0]["detail"]
+    flight.emit(flight.KV_SWAP_END, tag, 42, 0)
+    wd.call_finished(tok)
+    wd.reset()
+
+
+def test_watchdog_names_migration_stage():
+    flight.RECORDER.reset()
+    wd = watchdog.StallWatchdog(sweep_s=10, mult=8, min_stall_s=0.2)
+    wd.enabled = True
+    tag = flight.tag_for("disagg:wdtest")
+    tok = wd.call_started("/tpurpc.Kv/ResumeSeq")
+    flight.emit(flight.MIG_BEGIN, tag, 7, 100)      # no END: wedged
+    time.sleep(0.35)
+    diags = wd.sweep_once()
+    assert diags and diags[0]["stage"] == "migration", diags
+    flight.emit(flight.MIG_END, tag, 7, 1)
+    wd.call_finished(tok)
+    wd.reset()

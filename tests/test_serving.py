@@ -697,10 +697,43 @@ def test_healthz_shows_gen_state():
         sched.close()
 
 
-def test_load_provider_reports_scheduler_queue():
+def test_load_provider_reports_waiting_plus_swapped():
+    """ISSUE 11 satellite fix: the fleet load report must include
+    preempted/swapped rows — queue_depth alone made a server holding
+    swapped work look idle to least_loaded picking."""
     srv, port, sched = serve_generation(ToyDecodeModel(), max_batch=2)
     try:
-        assert srv._load_extra == sched.queue_depth  # bound-method equality
+        assert srv._load_extra == sched.load_depth  # bound-method equality
+        # a swapped sequence counts toward the load signal even though it
+        # is in no queue
+        sched._swapped.append(object())
+        assert sched.load_depth() == sched.queue_depth() + 1
+        sched._swapped.clear()
     finally:
         srv.stop(grace=0)
         sched.close()
+
+
+def test_load_depth_counts_preempted_swapped_rows():
+    """End-to-end: preempt a batch-class sequence on a paged scheduler —
+    while its KV sits swapped on host, load_depth reports the debt that
+    queue_depth omits."""
+    from tpurpc.serving.kv import KvBlockManager
+
+    mgr = KvBlockManager(n_blocks=64, block_bytes=256, kind="local",
+                         name="loadsig")
+    s = _sched(ToyDecodeModel(step_delay_s=0.002), kv=mgr, max_batch=1)
+    try:
+        long = s.submit([9], max_tokens=4000, slo=SLO_BATCH)
+        for _ in range(3):
+            long.next(timeout=5)
+        quick = s.submit([4], max_tokens=50, slo=SLO_INTERACTIVE)
+        # the batch row is preempted to host while interactive runs
+        assert _poll(lambda: s.swapped_depth() == 1), s.swapped_depth()
+        assert s.load_depth() >= 1
+        assert s.queue_depth() == 0  # the omission the fix closes
+        quick.cancel()
+        long.cancel()
+    finally:
+        s.close()
+        mgr.close()

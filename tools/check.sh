@@ -86,6 +86,15 @@ JAX_PLATFORMS=cpu python -m tpurpc.tools.rendezvous_smoke || fail=1
 note "tpurpc-cadence smoke (continuous batching + shed + decode-step)"
 python -m tpurpc.tools.serving_gen_smoke || fail=1
 
+# 2g3) tpurpc-keystone smoke (ISSUE 11): one prefill + one decode PROCESS
+#      over shm block grants — the copy ledger must prove the KV blocks
+#      landed in the decode arena with zero host landing copies (control
+#      frames only), token values must equal the reference exactly across
+#      the process split, and a repeated prompt must score a prefix-cache
+#      hit (warm handoff ships exactly one entry). ~10s, no jax.
+note "tpurpc-keystone disagg smoke (2 processes, zero-copy KV handoff)"
+python -m tpurpc.tools.disagg_smoke || fail=1
+
 # 2h) tpurpc-lens smoke (ISSUE 8): streaming + serving burst, then assert
 #     the sampling profiler names >=3 known stages (>=80% attributed), the
 #     /debug/waterfall reports every declared hop with nonzero bytes and a

@@ -69,6 +69,14 @@ hand (ISSUE 2) and that no general-purpose linter knows about:
   attributes and arithmetic only, no calls/displays/str constants.
   Deliberate exceptions carry ``# tpr: allow(stage)``.
 
+* ``kv``       — KV block-alloc pairing (tpurpc-keystone, ISSUE 11): a
+  function that calls ``*alloc_blocks*`` / ``*alloc_for_prompt*`` must
+  reach a ``*free_blocks*`` / ``*swap_out*`` / ``*quarantine*`` /
+  ``*release_kv*`` on an exception path (except/finally) — a raise
+  between alloc and ownership hand-off leaks arena blocks (device
+  memory) forever. ``# tpr: allow(kv)`` marks same-statement ownership
+  transfers.
+
 Suppression grammar: a line comment ``# tpr: allow(<rule>)`` disables that
 rule for its line. The hot-path modules are expected to carry NO ``copy``
 suppressions — a copy on the data plane is either fixed or it is a finding.
@@ -118,6 +126,11 @@ FLIGHT_HOT_MODULES = HOT_LOG_MODULES + (
     # cadence can be kHz, so the same discipline applies: interned
     # scheduler tag, precomputed int locals, nothing allocated per emit
     os.path.join("tpurpc", "serving", "scheduler.py"),
+    # tpurpc-keystone (ISSUE 11): the KV plane emits at alloc/free/swap/
+    # handoff edges — per-sequence, but a preemption storm makes that a
+    # high-rate path; same pure-int discipline
+    os.path.join("tpurpc", "serving", "kv.py"),
+    os.path.join("tpurpc", "serving", "disagg.py"),
 )
 
 #: module suffix -> qualified functions on its INLINE DISPATCH path (the
@@ -919,6 +932,54 @@ def _check_rdv(tree: ast.AST, path: str,
     return out
 
 
+# -- rule: kv (block-alloc pairing, tpurpc-keystone ISSUE 11) -----------------
+
+#: call-name fragments that RELEASE kv blocks for the `kv` rule
+_KV_RELEASERS = ("free_blocks", "swap_out", "quarantine", "release_kv")
+
+
+def _check_kv(tree: ast.AST, path: str,
+              lines: Sequence[str]) -> List[LintViolation]:
+    """A function that allocates KV blocks (``*alloc_blocks*`` /
+    ``*alloc_for_prompt*``) must cover an exception path (except/finally)
+    with a release — ``*free_blocks*`` / ``*swap_out*`` /
+    ``*quarantine*`` / ``*release_kv*`` — or the blocks leak out of the
+    arena's accounting forever (the rdv/lease pairing rule, lifted to the
+    KV plane, where the leak is device memory). Ownership-transfer sites
+    (the table adopts the blocks in the same statement) carry
+    ``# tpr: allow(kv)`` on the alloc line."""
+    out = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        allocs = [c for c in (_calls_matching(fn, "alloc_blocks")
+                              + _calls_matching(fn, "alloc_for_prompt"))
+                  if _enclosing_fn(c) is fn]
+        if not allocs:
+            continue
+        if any("kv" in _allowed_rules(lines, c.lineno) for c in allocs):
+            continue
+        releases = [c for frag in _KV_RELEASERS
+                    for c in _calls_matching(fn, frag)
+                    if _enclosing_fn(c) is fn]
+        covered = [
+            r for r in releases
+            if any(isinstance(anc, ast.ExceptHandler)
+                   for anc in _ancestors(r))
+            or any(isinstance(anc, ast.Try) and r in
+                   [d for s in anc.finalbody for d in ast.walk(s)]
+                   for anc in _ancestors(r))]
+        if not covered:
+            al = allocs[0].lineno
+            out.append(LintViolation(
+                path, al, allocs[0].col_offset, "kv",
+                f"{fn.name} allocates KV blocks with no free/swap/"
+                "quarantine on any exception path (except/finally): a "
+                "raise between alloc and ownership hand-off leaks arena "
+                "blocks forever"))
+    return out
+
+
 # -- driver ------------------------------------------------------------------
 
 def lint_source(source: str, path: str,
@@ -962,6 +1023,7 @@ def lint_source(source: str, path: str,
     out.extend(_check_stage(tree, path, lines))
     out.extend(_check_lease(tree, path, lines))
     out.extend(_check_rdv(tree, path, lines))
+    out.extend(_check_kv(tree, path, lines))
     out.sort(key=lambda v: (v.path, v.line, v.col))
     return out
 

@@ -858,6 +858,243 @@ def _rdv_successors(state, messages, words, standing, with_death, mutant,
     return succ
 
 
+# -- kv block-table handoff model (tpurpc-keystone, ISSUE 11) ----------------
+#
+# One sequence's KV blocks move from a SOURCE (prefill server or migrating
+# decode server) into a DEST decode arena:
+#
+#   source: OFFER → await CLAIM(blocks) → one-sided write each block
+#           (writes LAND asynchronously — the RDMA-straggler danger) →
+#           COMPLETE → await ACK, then free the local copy
+#   dest:   OFFER → grant B free blocks → CLAIM; COMPLETE (processable
+#           only once every issued write has landed — frame-after-payload
+#           ordering) → verify + ADOPT → ACK; a pending (claimed,
+#           un-completed) handoff may be REAPED at any time (TTL expiry /
+#           source death): its blocks are QUARANTINED, never re-leased —
+#           a late landing write must hit dead memory; a COMPLETE for a
+#           reaped handoff is NAK'd (the source fails that sequence
+#           ALONE).
+#
+# Invariants: an adopted sequence's blocks hold exactly the payload words
+# (torn otherwise); a landing write must never hit a block re-leased to a
+# NEW owner (stale-write); the source never wedges (every path reaches
+# done/failed); a dead source's claimed blocks end quarantined.
+
+KV_MUTANTS = (
+    "kv_reuse_before_quarantine",  # dest returns reaped blocks to the
+    #                                free list — a straggling one-sided
+    #                                write then lands in re-leased memory
+    "kv_free_before_complete",     # source frees its local copy while
+    #                                block writes are still outstanding —
+    #                                the remaining writes ship junk
+)
+
+
+def check_kv_handoff(blocks: int = 2, with_death: bool = False,
+                     mutant: Optional[str] = None,
+                     max_states: int = 2_000_000) -> CheckResult:
+    """Exhaustively interleave the source, the async write landings, and
+    the dest's control loop over one block-table handoff."""
+    if mutant is not None and mutant not in KV_MUTANTS:
+        raise ValueError(f"unknown mutant {mutant!r}; known: {KV_MUTANTS}")
+    cfg = f"kv_handoff blocks={blocks} death={with_death} mutant={mutant}"
+    B = blocks
+    # state: (sq, dq, s_phase, s_w, s_blocks, s_alive, s_freed, failed,
+    #         pending, free, claimed, mem, quarantined, new_owner,
+    #         adopted, reaped)
+    init = ((), (), "idle", 0, (), True, False, False,
+            (), tuple(range(B)), (), ("z",) * B, (), (), False, False)
+    visited = set()
+    stack: List[Tuple[tuple, Tuple[str, ...]]] = [(init, ())]
+    states = 0
+    try:
+        while stack:
+            state, trace = stack.pop()
+            if state in visited:
+                continue
+            visited.add(state)
+            states += 1
+            if states > max_states:
+                raise RuntimeError(
+                    f"state space exceeds {max_states} states ({cfg})")
+            succ = _kv_successors(state, B, with_death, mutant, trace)
+            if not succ:
+                _kv_quiescent(state, trace)
+                continue
+            stack.extend(succ)
+    except Violation as v:
+        return CheckResult(False, states, v, cfg)
+    return CheckResult(True, states, None, cfg)
+
+
+def _kv_quiescent(state, trace) -> None:
+    (sq, dq, s_phase, s_w, s_blocks, s_alive, s_freed, failed,
+     pending, free, claimed, mem, quarantined, new_owner,
+     adopted, reaped) = state
+    if pending:
+        raise Violation("stuck", "quiescent with unlanded writes",
+                        list(trace))
+    if s_alive and s_phase != "done":
+        raise Violation("stuck", f"source wedged in phase {s_phase}",
+                        list(trace))
+    if s_alive and not failed and not adopted:
+        raise Violation("lost", "source finished cleanly but the dest "
+                        "never adopted the sequence", list(trace))
+    if claimed:
+        raise Violation("leak", "quiescent with a claimed, unresolved "
+                        "handoff (neither adopted nor reaped)",
+                        list(trace))
+    if adopted and failed:
+        raise Violation("split", "sequence both adopted at the dest and "
+                        "failed at the source", list(trace))
+
+
+def _kv_successors(state, B, with_death, mutant, trace):
+    (sq, dq, s_phase, s_w, s_blocks, s_alive, s_freed, failed,
+     pending, free, claimed, mem, quarantined, new_owner,
+     adopted, reaped) = state
+    succ = []
+
+    def mk(sq=sq, dq=dq, s_phase=s_phase, s_w=s_w, s_blocks=s_blocks,
+           s_alive=s_alive, s_freed=s_freed, failed=failed,
+           pending=pending, free=free, claimed=claimed, mem=mem,
+           quarantined=quarantined, new_owner=new_owner, adopted=adopted,
+           reaped=reaped, step=""):
+        return ((sq, dq, s_phase, s_w, s_blocks, s_alive, s_freed,
+                 failed, pending, free, claimed, mem, quarantined,
+                 new_owner, adopted, reaped), trace + (step,))
+
+    # ---- source ----
+    if s_alive and not failed:
+        if s_phase == "idle":
+            succ.append(mk(sq=sq + (("offer",),), s_phase="wait",
+                           step="s:offer"))
+        elif s_phase == "wait" and dq and dq[0][0] == "claim":
+            succ.append(mk(dq=dq[1:], s_blocks=dq[0][1], s_phase="write",
+                           s_w=0, step="s:claim"))
+        elif s_phase == "write":
+            if s_w < B:
+                val = "junk" if s_freed else ("pay", s_w)
+                freed = s_freed or (mutant == "kv_free_before_complete"
+                                    and s_w == 0)
+                succ.append(mk(pending=pending
+                               + ((s_blocks[s_w], val),),
+                               s_w=s_w + 1, s_freed=freed,
+                               step=f"s:w{s_w}"))
+            else:
+                succ.append(mk(sq=sq + (("complete",),),
+                               s_phase="finish", step="s:complete"))
+        elif s_phase == "finish" and dq:
+            if dq[0][0] == "ack":
+                succ.append(mk(dq=dq[1:], s_phase="done", s_freed=True,
+                               step="s:ack"))
+            elif dq[0][0] == "nak":
+                # the dest reaped this handoff: the sequence fails ALONE
+                succ.append(mk(dq=dq[1:], s_phase="done", s_freed=True,
+                               failed=True, step="s:nak"))
+    if with_death and s_alive:
+        succ.append(mk(s_alive=False, step="s:die"))
+
+    # ---- async write landings (any order — the RDMA straggler) ----
+    for i in range(len(pending)):
+        blk, val = pending[i]
+        if blk in new_owner:
+            raise Violation(
+                "stale-write", f"a landing one-sided write hit block "
+                f"{blk}, which was re-leased to a new owner — reaped "
+                "blocks must QUARANTINE, never re-enter the free list",
+                list(trace) + [f"wire:land{blk}"])
+        nm = list(mem)
+        nm[blk] = val
+        succ.append(mk(pending=pending[:i] + pending[i + 1:],
+                       mem=tuple(nm), step=f"wire:land{blk}"))
+
+    # ---- dest control loop ----
+    if sq:
+        kind = sq[0][0]
+        if kind == "offer":
+            if not claimed and not reaped and len(free) >= B:
+                grant = tuple(sorted(free)[:B])
+                rest = tuple(b for b in free if b not in grant)
+                succ.append(mk(sq=sq[1:], free=rest, claimed=grant,
+                               dq=dq + (("claim", grant),),
+                               step="d:claim"))
+        else:  # complete
+            if claimed:
+                # frame-after-payload: the COMPLETE is processable only
+                # once every issued write has landed
+                if not pending:
+                    for i, blk in enumerate(claimed):
+                        if mem[blk] != ("pay", i):
+                            raise Violation(
+                                "torn", f"adopt read {mem[blk]} at block "
+                                f"{blk} (wanted ('pay', {i})) — the "
+                                "source freed/corrupted its copy before "
+                                "the handoff completed",
+                                list(trace) + ["d:adopt"])
+                    succ.append(mk(sq=sq[1:], claimed=(), adopted=True,
+                                   dq=dq + (("ack",),), step="d:adopt"))
+            else:
+                # reaped (or never-claimed) handoff: NAK — the source
+                # fails that sequence alone, blocks stay quarantined
+                succ.append(mk(sq=sq[1:], dq=dq + (("nak",),),
+                               step="d:nak"))
+    # reap: TTL expiry / death detection on a pending handoff
+    if claimed and not adopted:
+        if mutant == "kv_reuse_before_quarantine":
+            succ.append(mk(free=tuple(sorted(free + claimed)),
+                           claimed=(), reaped=True, step="d:reap!free"))
+        else:
+            succ.append(mk(quarantined=tuple(sorted(quarantined
+                                                    + claimed)),
+                           claimed=(), reaped=True, step="d:reap"))
+    # a later local sequence leases a free block (bounded to one)
+    if reaped and free and not new_owner:
+        b = free[0]
+        nm = list(mem)
+        nm[b] = "new"
+        succ.append(mk(free=free[1:], new_owner=(b,), mem=tuple(nm),
+                       step=f"d:lease{b}"))
+
+    return succ
+
+
+def kv_default_suite(verbose: bool = False) -> List[CheckResult]:
+    """Clean kv-handoff configs: 2- and 3-block tables, with and without
+    source-death-at-every-point."""
+    configs = [
+        dict(blocks=2),
+        dict(blocks=3),
+        dict(blocks=2, with_death=True),
+        dict(blocks=3, with_death=True),
+    ]
+    out = []
+    for cfg in configs:
+        res = check_kv_handoff(**cfg)
+        out.append(res)
+        if verbose:
+            print(f"  {res!r}")
+    return out
+
+
+def kv_mutant_kill_suite(verbose: bool = False) -> Dict[str, bool]:
+    """Every seeded kv-handoff mutant must produce a violation."""
+    out = {}
+    for mutant in KV_MUTANTS:
+        killed = False
+        for cfg in (dict(blocks=2), dict(blocks=2, with_death=True)):
+            res = check_kv_handoff(mutant=mutant, **cfg)
+            if not res.ok:
+                killed = True
+                if verbose:
+                    print(f"  mutant {mutant}: KILLED — {res.violation}")
+                break
+        if not killed and verbose:
+            print(f"  mutant {mutant}: SURVIVED")
+        out[mutant] = killed
+    return out
+
+
 # -- suites ------------------------------------------------------------------
 
 def default_suite(verbose: bool = False) -> List[CheckResult]:
@@ -880,6 +1117,7 @@ def default_suite(verbose: bool = False) -> List[CheckResult]:
             print(f"  {res!r}")
     out.extend(handoff_default_suite(verbose=verbose))
     out.extend(rendezvous_default_suite(verbose=verbose))
+    out.extend(kv_default_suite(verbose=verbose))
     return out
 
 
@@ -954,4 +1192,5 @@ def mutant_kill_suite(verbose: bool = False) -> Dict[str, bool]:
         out[mutant] = killed
     out.update(handoff_mutant_kill_suite(verbose=verbose))
     out.update(rendezvous_mutant_kill_suite(verbose=verbose))
+    out.update(kv_mutant_kill_suite(verbose=verbose))
     return out

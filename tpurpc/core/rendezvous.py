@@ -81,6 +81,7 @@ __all__ = [
     "LandingPool", "RegionLease", "RdvLink", "landing_pool",
     "link_for_endpoint", "enabled", "min_bytes", "size_class",
     "OP_OFFER", "OP_CLAIM", "OP_COMPLETE", "OP_RELEASE", "HELLO_PAYLOAD",
+    "BlockGrant", "GrantWriter",
 ]
 
 # tpurpc-lens: the one-sided bulk write is its own waterfall hop — the
@@ -1049,6 +1050,152 @@ class RdvLink:
                 "cached_grants": sum(len(v) for v in
                                      self._grants.values()),
             }
+
+
+# ---------------------------------------------------------------------------
+# Block-granular standing grants (tpurpc-keystone, ISSUE 11).
+#
+# The LandingPool leases CONTIGUOUS size-classed spans; the KV plane's unit
+# is the BLOCK — a grant names a scatter of block offsets inside one
+# registered arena region (the decode server's KvBlockManager), and the
+# sender one-sided-writes each block straight into place: KV lands in the
+# decode arena with zero host landing copies and zero staging joins. A
+# grant is STANDING in the RDMAbox sense at the window level: the sender's
+# GrantWriter keeps one open window per (kind, handle), so a stream of
+# handoffs into the same arena pays the window-open exactly once.
+# ---------------------------------------------------------------------------
+
+_GRANT_HDR = struct.Struct("<QIIQQ16s")  # grant_id, block_bytes, n_offsets,
+#                                          window_bytes, nonce_off, nonce
+
+
+class BlockGrant:
+    """A peer-advertised landing descriptor at block granularity: which
+    blocks of which registered region the sender may write, plus the
+    anti-mixup nonce (stored at ``nonce_off`` inside the region — the
+    writer verifies it through its window before placing a byte, the same
+    stale-handle defense as RegionLease's trailer nonce)."""
+
+    __slots__ = ("grant_id", "kind", "handle", "block_bytes", "offsets",
+                 "window_bytes", "nonce", "nonce_off")
+
+    def __init__(self, grant_id: int, kind: str, handle: str,
+                 block_bytes: int, offsets: Sequence[int],
+                 window_bytes: int, nonce: bytes, nonce_off: int):
+        self.grant_id = int(grant_id)
+        self.kind = kind
+        self.handle = handle
+        self.block_bytes = int(block_bytes)
+        self.offsets = tuple(int(o) for o in offsets)
+        self.window_bytes = int(window_bytes)
+        self.nonce = bytes(nonce)
+        self.nonce_off = int(nonce_off)
+
+    @property
+    def capacity(self) -> int:
+        return self.block_bytes * len(self.offsets)
+
+    def to_wire(self) -> bytes:
+        kb = self.kind.encode()
+        return (_GRANT_HDR.pack(self.grant_id, self.block_bytes,
+                                len(self.offsets), self.window_bytes,
+                                self.nonce_off, self.nonce)
+                + bytes([len(kb)]) + kb + self.handle.encode()
+                + b"\x00" + b"".join(struct.pack("<Q", o)
+                                     for o in self.offsets))
+
+    @classmethod
+    def from_wire(cls, payload) -> "BlockGrant":
+        buf = bytes(payload)
+        (grant_id, block_bytes, n, window_bytes, nonce_off,
+         nonce) = _GRANT_HDR.unpack_from(buf)
+        pos = _GRANT_HDR.size
+        klen = buf[pos]
+        pos += 1
+        kind = buf[pos:pos + klen].decode()
+        pos += klen
+        end = buf.index(b"\x00", pos)
+        handle = buf[pos:end].decode()
+        pos = end + 1
+        offsets = struct.unpack_from(f"<{n}Q", buf, pos)
+        return cls(grant_id, kind, handle, block_bytes, offsets,
+                   window_bytes, nonce, nonce_off)
+
+
+class GrantWriter:
+    """The sender half of block-granular grants: opens (and CACHES — the
+    standing discipline) one window per (kind, handle), verifies the
+    grant's nonce, then places each chunk with a one-sided write. All
+    placement bytes ride the ``rendezvous`` lens hop and the ledger's
+    ``rdma_write`` — the same accounting as RdvLink's bulk path, so the
+    copy-ledger proof ("KV landed with zero host landing copies") is one
+    ``ledger.track()`` window away."""
+
+    def __init__(self):
+        self._domains: Dict[str, _pair.MemoryDomain] = {}
+        self._windows: Dict[Tuple[str, str], _pair.Window] = {}
+        self._lock = make_lock("GrantWriter._lock")
+
+    def _window(self, grant: BlockGrant) -> _pair.Window:
+        key = (grant.kind, grant.handle)
+        win = self._windows.get(key)
+        if win is not None:
+            return win
+        domain = self._domains.get(grant.kind)
+        if domain is None:
+            domain = self._domains[grant.kind] = _pair.make_domain(
+                grant.kind)
+        win = domain.open_window(grant.handle, grant.window_bytes)
+        with self._lock:
+            self._windows[key] = win
+        return win
+
+    def write_blocks(self, grant: BlockGrant, chunks: Sequence) -> int:
+        """Place ``chunks[i]`` (bytes-like, ≤ block_bytes) at
+        ``grant.offsets[i]``. Returns bytes written. Raises on nonce
+        mismatch or oversized chunks — the caller releases/abandons the
+        grant (the `rdv` pairing discipline applies to grants too)."""
+        if len(chunks) > len(grant.offsets):
+            raise ValueError(f"{len(chunks)} chunks for a "
+                             f"{len(grant.offsets)}-block grant")
+        win = self._window(grant)
+        view = win.view
+        if grant.nonce:
+            if view is not None:
+                seen = bytes(view[grant.nonce_off:
+                                  grant.nonce_off + len(grant.nonce)])
+                if seen != grant.nonce:
+                    raise OSError(
+                        "block-grant nonce mismatch: the granted handle "
+                        "resolves to different memory on this host")
+        t0 = time.monotonic_ns()
+        total = 0
+        for off, chunk in zip(grant.offsets, chunks):
+            sv = memoryview(chunk).cast("B")
+            if len(sv) > grant.block_bytes:
+                raise ValueError(f"chunk of {len(sv)} exceeds the "
+                                 f"{grant.block_bytes}-byte block")
+            if view is not None:
+                view[off:off + len(sv)] = sv
+            else:
+                win.write(off, sv)
+            total += len(sv)
+        _ledger.rdma_write(total)
+        dt = time.monotonic_ns() - t0
+        _LENS_RDV_NS.inc(dt)
+        _LENS_RDV_BYTES.inc(total)
+        _LENS_RDV_COPY.inc(total)
+        return total
+
+    def close(self) -> None:
+        with self._lock:
+            windows = list(self._windows.values())
+            self._windows.clear()
+        for win in windows:
+            try:
+                win.close()
+            except Exception:
+                pass
 
 
 def domains_for_endpoint(endpoint) -> Tuple[Tuple[str, ...],

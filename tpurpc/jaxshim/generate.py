@@ -28,12 +28,34 @@ rows across calls (join/leave/preempt at step boundaries), and retries a
 failed batched call row-by-row so a poisoned sequence fails ALONE — both
 moves are only sound when row ``i``'s outputs depend on row ``i``'s inputs.
 
-:class:`ToyDecodeModel` is the reference implementation: a deterministic
-affine-hash generator, pure numpy (the smoke tools and scheduler tests stay
-jax-free), with knobs to induce the failure modes the scheduler must
-contain (``poison_token``, ``step_delay_s``). :func:`reference_decode`
-recomputes any prompt's exact token stream out-of-band, so transport tests
-can assert per-token VALUES, not just counts.
+The **explicit-KV contract** (tpurpc-keystone, ISSUE 11) is the same
+discipline with the state made addressable: instead of an opaque
+``states`` array the model reads and writes per-sequence KV through a
+block table (:class:`~tpurpc.serving.kv.SeqKv` / ``HostKv`` — anything
+with ``entry``/``last``/``append``/``truncate`` over 16-byte
+``(hash, token, flags)`` records):
+
+* ``prefill_paged(prompts, kvs) -> first_tokens`` — for each row,
+  entries ``[0, kvs[i].length)`` are ALREADY PRESENT (a prefix-cache hit
+  or a resumed handoff: prefill is SKIPPED for that span) and the model
+  appends one entry per remaining prompt token plus the first sampled
+  token's entry. Entry ``p`` must depend only on tokens ``0..p`` — the
+  invariant that makes prefix sharing, swap, and migration sound.
+* ``step_paged(kvs, tokens) -> tokens`` — one decode step reading each
+  row's LAST entry and appending the next. Rows independent, same
+  poison/batch-failure semantics as ``step``.
+
+The two contracts are value-equivalent by construction (the regression
+tests assert exact token equality between the opaque-state and paged
+paths for the same prompts).
+
+:class:`ToyDecodeModel` is the reference implementation of BOTH contracts:
+a deterministic affine-hash generator, pure numpy (the smoke tools and
+scheduler tests stay jax-free), with knobs to induce the failure modes the
+scheduler must contain (``poison_token``, ``step_delay_s``).
+:func:`reference_decode` recomputes any prompt's exact token stream
+out-of-band, so transport tests can assert per-token VALUES, not just
+counts.
 """
 
 from __future__ import annotations
@@ -120,6 +142,64 @@ class ToyDecodeModel:
         if np.any(states[:, 2] != 0):
             raise ValueError("poisoned row in decode batch")
         return self._advance(states)
+
+    # -- the explicit-KV contract (tpurpc-keystone) ---------------------------
+
+    def prefill_paged(self, prompts: Sequence[np.ndarray], kvs: Sequence
+                      ) -> np.ndarray:
+        """Paged prefill: fold each prompt's UNCACHED tail into its block
+        table. Row ``i`` starts from ``kvs[i].length`` entries already
+        present (0 for a cold prompt; the shared span on a prefix-cache
+        hit, whose last entry seeds the hash — prefill skipped for it),
+        appends one entry per remaining prompt token, then samples and
+        appends the first generated token. Returns ``int32[B]`` first
+        tokens. Value-identical to :meth:`prefill`."""
+        self.prefills += 1
+        out = np.zeros(len(prompts), dtype=np.int32)
+        for i, (p, kv) in enumerate(zip(prompts, kvs)):
+            p = np.asarray(p, dtype=np.int64).reshape(-1)
+            if p.size == 0:
+                raise ValueError("empty prompt")
+            start = kv.length
+            if start > p.size:
+                raise ValueError(f"table holds {start} entries for a "
+                                 f"{p.size}-token prompt")
+            if start:
+                h, _tok, flags = kv.entry(start - 1)
+            else:
+                h, flags = 0, 0
+            for t in p[start:].tolist():
+                h = (int(h) * _MULT + _INC + int(t)) & 0xFFFFFFFFFFFFFFFF
+                if self.poison_token is not None \
+                        and t == self.poison_token:
+                    flags |= 1  # FLAG_POISONED: latent, trips at step
+                kv.append(h, int(t), flags)
+            h = (int(h) * _MULT + _INC) & 0xFFFFFFFFFFFFFFFF
+            tok = int((h >> 16) % self.vocab)
+            kv.append(h, tok, flags)
+            out[i] = tok
+        return out
+
+    def step_paged(self, kvs: Sequence, tokens: np.ndarray) -> np.ndarray:
+        """One paged decode step for the whole batch: read each row's
+        last entry, advance, append. Batched-failure semantics match
+        :meth:`step`: any poisoned row fails the WHOLE batched call (the
+        scheduler's row-by-row isolation retry then proves poison fails
+        alone), and a partial append is undone by the scheduler via
+        ``truncate`` before the retry."""
+        self.steps += 1
+        if self.step_delay_s:
+            time.sleep(self.step_delay_s)
+        lasts = [kv.last() for kv in kvs]
+        if any(flags & 1 for _h, _t, flags in lasts):
+            raise ValueError("poisoned row in decode batch")
+        out = np.zeros(len(kvs), dtype=np.int32)
+        for i, (kv, (h, _t, flags)) in enumerate(zip(kvs, lasts)):
+            h = (int(h) * _MULT + _INC) & 0xFFFFFFFFFFFFFFFF
+            tok = int((h >> 16) % self.vocab)
+            kv.append(h, tok, flags)
+            out[i] = tok
+        return out
 
     # -- internals ------------------------------------------------------------
 

@@ -109,6 +109,26 @@ GEN_LEAVE = 41            # client left mid-stream; a1 = seq id, a2 = emitted
 GEN_RETIRE = 42           # natural finish; a1 = seq id, a2 = tokens emitted
 GEN_SHED = 43             # a1 = slo class (0=interactive,1=batch), a2 = pushback ms
 GEN_PREEMPT = 44          # a1 = seq id, a2 = slo class of the preempted seq
+# tpurpc-keystone (ISSUE 11): the paged KV-cache plane + disaggregated
+# prefill/decode. Alloc/free/prefix-hit are sequence-lifetime edges;
+# KV_SWAP_BEGIN/END bracket one swap (a2: 0 = out-to-host, 1 = in-from-
+# host) and MIG_BEGIN/MIG_END bracket one live migration — an open
+# bracket aged past the stall floor is the watchdog's `kv-swap` /
+# `migration` stage evidence. KV_SHIP_* are the block-granular handoff's
+# control edges (OFFER-KV answered by a grant, COMPLETE after the
+# one-sided block writes); KV_QUARANTINE records blocks pulled from
+# circulation on a death path (never an alloc/free pair — quarantined
+# blocks do not come back).
+KV_ALLOC = 45             # a1 = owner/seq key, a2 = blocks allocated
+KV_FREE = 46              # a1 = owner/seq key (0 = raw), a2 = blocks freed
+KV_SWAP_BEGIN = 47        # a1 = seq key, a2 = direction (0=out, 1=in)
+KV_SWAP_END = 48          # a1 = seq key, a2 = direction
+KV_PREFIX_HIT = 49        # a1 = seq key, a2 = entries reused (prefill skipped)
+KV_SHIP_OFFER = 50        # a1 = handoff id, a2 = payload bytes offered
+KV_SHIP_COMPLETE = 51     # a1 = handoff id, a2 = payload bytes landed
+KV_QUARANTINE = 52        # a1 = handoff/seq key (0 = link), a2 = blocks
+MIG_BEGIN = 53            # a1 = seq id, a2 = entries to move
+MIG_END = 54              # a1 = seq id, a2 = 1 ok / 0 failed
 
 EVENT_NAMES: Dict[int, str] = {
     PAIR_CONNECT: "pair-connect",
@@ -155,6 +175,16 @@ EVENT_NAMES: Dict[int, str] = {
     GEN_RETIRE: "gen-retire",
     GEN_SHED: "gen-shed",
     GEN_PREEMPT: "gen-preempt",
+    KV_ALLOC: "kv-alloc",
+    KV_FREE: "kv-free",
+    KV_SWAP_BEGIN: "kv-swap-begin",
+    KV_SWAP_END: "kv-swap-end",
+    KV_PREFIX_HIT: "kv-prefix-hit",
+    KV_SHIP_OFFER: "kv-ship-offer",
+    KV_SHIP_COMPLETE: "kv-ship-complete",
+    KV_QUARANTINE: "kv-quarantine",
+    MIG_BEGIN: "migration-begin",
+    MIG_END: "migration-end",
 }
 
 #: batch-flush reason codes (a1 of BATCH_FLUSH) — mirrors the jaxshim

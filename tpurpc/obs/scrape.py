@@ -247,6 +247,17 @@ def route_local(path: str) -> Tuple[int, str, bytes]:
             gen_lines = gen_mod.health_lines() if gen_mod else []
         except Exception:
             gen_lines = []
+        # tpurpc-keystone: live KV arenas append block occupancy / swap
+        # pressure / quarantine counts — same sys.modules gate, so
+        # processes without a KV plane keep their exact old bodies
+        try:
+            import sys
+
+            kv_mod = sys.modules.get("tpurpc.serving.kv")
+            gen_lines = gen_lines + (kv_mod.health_lines() if kv_mod
+                                     else [])
+        except Exception:
+            pass
         head = b"draining" if draining else b"ok"
         if gen_lines:
             body = head + b"\n" + "\n".join(gen_lines).encode() + b"\n"

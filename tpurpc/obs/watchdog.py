@@ -57,8 +57,8 @@ _obs_profiler.register_stages(__file__, _LENS_STAGES)
 _log = logging.getLogger("tpurpc.watchdog")
 
 STAGES = ("credit-starvation", "peer-not-reading", "h2-flow-control",
-          "rendezvous", "decode-step", "batcher-wait", "poller-wake",
-          "device-infer", "unknown")
+          "rendezvous", "kv-swap", "migration", "decode-step",
+          "batcher-wait", "poller-wake", "device-infer", "unknown")
 
 #: anomaly counters (always-on registry): total trips + per-stage breakdown
 _TRIPS = _metrics.counter("watchdog_trips")
@@ -276,6 +276,12 @@ class StallWatchdog:
         # last END stamp catches the other failure shape: sequences
         # waiting while the loop has stopped stepping entirely.
         open_step: Dict[int, int] = {}
+        # tpurpc-keystone: open swap/migration brackets — a KV_SWAP_BEGIN
+        # or MIG_BEGIN with no matching END is a sequence mid-move; aged
+        # past the stall floor it is the wedge, and it outranks the
+        # generic decode-step story (more specific evidence wins)
+        open_swap: Dict[tuple, int] = {}
+        open_mig: Dict[tuple, int] = {}
         last_step_end = 0
         last_step_batch = 0
         last_h2 = 0
@@ -309,6 +315,14 @@ class StallWatchdog:
             elif code == _flight.GEN_STEP_END:
                 open_step.pop(e["tag"], None)
                 last_step_end = e["t_ns"]
+            elif code == _flight.KV_SWAP_BEGIN:
+                open_swap[(e["tag"], e["a1"])] = e["t_ns"]
+            elif code == _flight.KV_SWAP_END:
+                open_swap.pop((e["tag"], e["a1"]), None)
+            elif code == _flight.MIG_BEGIN:
+                open_mig[(e["tag"], e["a1"])] = e["t_ns"]
+            elif code == _flight.MIG_END:
+                open_mig.pop((e["tag"], e["a1"]), None)
 
         def fleet_sum(name: str) -> float:
             m = _metrics.registry().metrics().get(name)
@@ -321,6 +335,8 @@ class StallWatchdog:
             "open_lease": open_lease,
             "open_edges": open_edges,
             "open_rdv": open_rdv,
+            "open_swap": open_swap,
+            "open_mig": open_mig,
             "open_step": open_step,
             "last_step_end_ns": last_step_end,
             "last_step_batch": last_step_batch,
@@ -355,6 +371,28 @@ class StallWatchdog:
                         f" {offers} offer(s) unanswered, {claims} claimed "
                         "region(s) without complete/release in the flight "
                         "tail")
+        # tpurpc-keystone: an aged open swap/migration bracket is MORE
+        # specific than the decode-step story — the loop (or a migration
+        # thread) is inside a KV move, and every stream behind the
+        # boundary waits on it
+        open_swap = ev.get("open_swap") or {}
+        if open_swap:
+            oldest = max(now - t for t in open_swap.values())
+            if oldest >= self.min_stall_s * 1e9 / 2:
+                return ("kv-swap",
+                        f"KV swap wedged {oldest / 1e9:.2f}s: a "
+                        f"swap begin without its end in the flight tail "
+                        f"({len(open_swap)} open) — the host copy or "
+                        "arena re-admission is stuck")
+        open_mig = ev.get("open_mig") or {}
+        if open_mig:
+            oldest = max(now - t for t in open_mig.values())
+            if oldest >= self.min_stall_s * 1e9 / 2:
+                return ("migration",
+                        f"live migration wedged {oldest / 1e9:.2f}s: "
+                        f"{len(open_mig)} sequence(s) detached with no "
+                        "migration-end — the peer handoff "
+                        "(offer/ship/complete) is stuck")
         open_step = ev.get("open_step") or {}
         if open_step:
             oldest = max(now - t for t in open_step.values())

@@ -1205,6 +1205,7 @@ class Server:
             "TPURPC_LOAD_REPORTS", "1").lower() not in ("0", "off", "false")
         self._load_extra: Optional[Callable[[], int]] = None
         self._load_cache: Tuple[float, Optional[list]] = (0.0, None)
+        self._drain_hooks: List[Callable[[], None]] = []
 
     # -- registration --------------------------------------------------------
 
@@ -1606,8 +1607,21 @@ class Server:
         """Register an extra queue-depth signal for the load report —
         serve_jax wires the FanInBatcher's queue depth here, so the
         ``least_loaded`` policy sees requests parked BEHIND the transport
-        (the batcher is where overload actually queues on a model server)."""
+        (the batcher is where overload actually queues on a model server).
+        tpurpc-keystone wires ``DecodeScheduler.load_depth`` (waiting AND
+        swapped) — queue depth alone made a server holding preempted work
+        look idle."""
         self._load_extra = fn
+
+    def add_drain_hook(self, fn: Callable[[], None]) -> None:
+        """Register a callback the FIRST :meth:`drain` runs after the
+        GOAWAY round, before waiting out in-flight streams — the seam
+        stateful serving uses to MIGRATE live sequences to a peer instead
+        of merely finishing them (tpurpc-keystone: the zero-failed-RPC
+        drain contract extended to generation state). Hooks run on the
+        draining thread; exceptions are swallowed (a failed hook degrades
+        to a plain drain, never a stuck one)."""
+        self._drain_hooks.append(fn)
 
     def _load_md(self) -> list:
         """The ORCA-style piggyback: ``[(LOAD_KEY, "i,q,p99ms")]`` appended
@@ -1711,6 +1725,15 @@ class Server:
                     # no in-flight streams: close after the refused-HEADERS
                     # linger (the max_age path's exact contract)
                     conn._linger_then_shutdown()
+            # stateful-serving seam: migrate live sequences BEFORE the
+            # in-flight wait, so streams end with re-attach records (and
+            # stop counting against the linger) instead of running out
+            # their full generations here
+            for hook in list(self._drain_hooks):
+                try:
+                    hook()
+                except Exception:
+                    pass  # a failed hook degrades to a plain drain
         deadline = time.monotonic() + max(0.0, linger)
         while True:
             with self._lock:

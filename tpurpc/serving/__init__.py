@@ -1,16 +1,29 @@
-"""tpurpc-cadence (ISSUE 10): continuous-batching token-streaming serving.
+"""tpurpc serving: continuous-batching generation + the paged KV plane.
 
 * :mod:`tpurpc.serving.scheduler` — the :class:`DecodeScheduler` state
   machine: sequences JOIN and LEAVE the device batch between decode steps,
   prefill rides a per-step token budget, SLO classes gate admission and
-  preemption, and load shedding trips before collapse.
+  preemption, and load shedding trips before collapse. With ``kv=`` it
+  runs PAGED: block-table state, prefix-cache prefill skips, and
+  preempt-to-host swap (tpurpc-keystone, ISSUE 11).
+* :mod:`tpurpc.serving.kv` — the paged KV block manager: block arena over
+  a registered region, per-sequence block tables, copy-on-write prefix
+  reuse, swap, quarantine.
 * :mod:`tpurpc.serving.api` — the transport face: ``serve_generation``
   stands up a streaming Generate method around a step model;
   ``GenerationClient`` consumes per-token streams.
+* :mod:`tpurpc.serving.disagg` — disaggregated prefill/decode: KV blocks
+  ship over the rendezvous plane's block grants, sequences hand off and
+  MIGRATE live between decode servers, clients re-attach transparently.
 """
 
 from tpurpc.serving.api import (GEN_SERVICE, GenerationClient,
                                 add_generation_method, serve_generation)
+from tpurpc.serving.disagg import (KV_SERVICE, DisaggClient, DisaggDecode,
+                                   DisaggPrefill, MigrationFailed,
+                                   SeqMigrated, migrate, serve_decode,
+                                   serve_prefill)
+from tpurpc.serving.kv import HostKv, KvArenaFull, KvBlockManager, SeqKv
 from tpurpc.serving.scheduler import (SLO_BATCH, SLO_INTERACTIVE,
                                       DecodeScheduler, DrainingError,
                                       ShedError, TokenStream)
@@ -20,4 +33,8 @@ __all__ = [
     "SLO_INTERACTIVE", "SLO_BATCH",
     "GEN_SERVICE", "GenerationClient", "add_generation_method",
     "serve_generation",
+    "KvBlockManager", "SeqKv", "HostKv", "KvArenaFull",
+    "KV_SERVICE", "DisaggClient", "DisaggDecode", "DisaggPrefill",
+    "SeqMigrated", "MigrationFailed", "migrate", "serve_decode",
+    "serve_prefill",
 ]

@@ -118,7 +118,7 @@ def serve_generation(model, address: str = "127.0.0.1:0", *,
                      batch_shed_depth: Optional[int] = None,
                      step_slo_ms: Optional[float] = None,
                      admission: "bool | AdmissionGate" = True,
-                     max_workers: int = 32,
+                     kv=None, max_workers: int = 32,
                      ) -> Tuple[Server, int, DecodeScheduler]:
     """Stand up a continuous-batching generation server around a step
     model (:mod:`tpurpc.jaxshim.generate` contract). Returns
@@ -145,7 +145,7 @@ def serve_generation(model, address: str = "127.0.0.1:0", *,
     sched = DecodeScheduler(
         model, max_batch=max_batch, prefill_budget=prefill_budget,
         max_waiting=max_waiting, batch_shed_depth=batch_shed_depth,
-        step_slo_ms=step_slo_ms, draining_fn=draining, name=name)
+        step_slo_ms=step_slo_ms, draining_fn=draining, kv=kv, name=name)
     gate: Optional[AdmissionGate]
     if admission is True:
         gate = AdmissionGate(
@@ -160,7 +160,10 @@ def serve_generation(model, address: str = "127.0.0.1:0", *,
     srv = Server(max_workers=max_workers, admission=gate)
     srv_box.append(srv)
     add_generation_method(srv, sched, name=name)
-    srv.set_load_provider(sched.queue_depth)
+    # the fleet load report carries waiting AND preempted/swapped work —
+    # queue_depth alone made a server holding swapped sequences look idle
+    # to least_loaded picking (ISSUE 11 satellite fix)
+    srv.set_load_provider(sched.load_depth)
     srv.start()
     port = srv.add_insecure_port(address)
     return srv, port, sched

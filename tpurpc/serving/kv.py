@@ -1,0 +1,692 @@
+"""tpurpc-keystone: the paged KV-cache plane.
+
+PR 10's DecodeScheduler treats sequence state as opaque model rows stacked
+into a batch array — fine for a toy, wrong for a generation fleet, where
+the KV cache IS the resource being scheduled (ROADMAP item 2). This module
+makes it explicit:
+
+* :class:`KvBlockManager` — a block arena carved from ONE registered
+  region (an HbmRing-style span allocated through the
+  :class:`~tpurpc.core.pair.MemoryDomain` seam, so on ``shm`` the same
+  bytes are one-sided-writable cross-process — the property
+  :mod:`tpurpc.serving.disagg` ships KV over). Fixed block size, free-list
+  allocation, per-block refcounts.
+* per-sequence **block tables** (:class:`SeqKv`) — a sequence's KV is an
+  ordered list of block ids; entries are 16-byte ``<hash u64, token u32,
+  flags u32>`` records appended as decode advances. Entry ``p`` depends
+  only on the token stream up to ``p`` — the invariant every reuse move
+  below leans on.
+* **copy-on-write prefix reuse** keyed by prompt-prefix hash: retiring a
+  sequence donates its block-aligned prompt span to a prefix cache
+  (refcounted, LRU-evicted under arena pressure). A later prompt with the
+  same prefix starts with those blocks SHARED — prefill is skipped for the
+  shared span (``kv_prefix_hits``), and shared blocks are never written:
+  decode only appends into fresh private blocks, and an explicit write
+  into a shared span goes through :meth:`SeqKv.writable_block`, which
+  copies first (the COW rule; tested directly).
+* **preempt-to-host swap** — preemption no longer parks rows in HBM
+  (PR 10's keep-in-HBM move): :meth:`swap_out` copies a sequence's blocks
+  to a host buffer and returns every block to the arena;
+  :meth:`swap_in` re-allocates and restores byte-exactly. The
+  ``kv_blocks_swapped`` gauge and the ``kv-swap`` flight edge pair
+  (:data:`~tpurpc.obs.flight.KV_SWAP_BEGIN`/``END``) make a stuck swap a
+  watchdog-attributable stage.
+* **quarantine** — blocks that a dead peer's straggling one-sided write
+  might still reach (a migration that died between CLAIM and COMPLETE)
+  are quarantined, never returned to the free list: the Pair.init /
+  LandingPool stale-write rule, applied at block granularity. The
+  ``reuse-before-quarantine`` mutant in ``analysis/ringcheck.py
+  check_kv_handoff`` is the modeled version of exactly this bug.
+
+Every alloc / free / swap / quarantine is flight-logged (edges at
+sequence-lifetime boundaries, not per token) and gauged
+(``kv_blocks_used/free/swapped/quarantined``, ``kv_prefix_hits``).
+
+The lint rule ``kv`` (analysis/lint.py) holds callers to the discipline:
+a function that calls ``alloc_blocks``/``alloc_for_prompt`` must reach a
+``free_blocks``/``swap_out``/``quarantine`` on an exception path
+(except/finally), or carry ``# tpr: allow(kv)`` where ownership provably
+transfers.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import struct
+import weakref
+from collections import OrderedDict
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tpurpc.analysis.locks import make_lock
+from tpurpc.core import pair as _pair
+from tpurpc.obs import flight as _flight
+from tpurpc.obs import metrics as _metrics
+from tpurpc.obs import profiler as _profiler
+
+__all__ = [
+    "KvBlockManager", "SeqKv", "HostKv", "KvArenaFull",
+    "ENTRY", "ENTRY_BYTES", "FLAG_POISONED", "health_lines",
+]
+
+#: tpurpc-lens: swap traffic is the kv plane's CPU story — a preemption
+#: storm shows up as kv_swap time, not as unattributed serving work
+_LENS_STAGES = {
+    "swap_out": "kv_swap",
+    "swap_in": "kv_swap",
+    "alloc_for_prompt": "decode_step",
+    "free_blocks": "decode_step",
+}
+_profiler.register_stages(__file__, _LENS_STAGES)
+
+#: one KV entry: the model-visible record per token position
+ENTRY = struct.Struct("<QII")  # hash u64, token u32, flags u32
+ENTRY_BYTES = ENTRY.size       # 16
+
+FLAG_POISONED = 1
+
+_ALIGN = 64
+_NONCE_BYTES = 16
+
+# -- gauges / counters (process-wide registry, weakref fleet like PR 10) ------
+_USED_G = _metrics.fleet("kv_blocks_used", lambda m: m.used_count())
+_FREE_G = _metrics.fleet("kv_blocks_free", lambda m: m.free_count())
+_SWAPPED_G = _metrics.fleet("kv_blocks_swapped", lambda m: m.swapped_count())
+_QUAR_G = _metrics.fleet("kv_blocks_quarantined",
+                         lambda m: m.quarantined_count())
+_PREFIX_HITS = _metrics.counter("kv_prefix_hits")
+_PREFIX_HIT_TOKENS = _metrics.counter("kv_prefix_hit_tokens")
+_SWAPS = _metrics.counter("kv_swaps")
+_COW_COPIES = _metrics.counter("kv_cow_copies")
+
+#: live managers for the /healthz "kv" lines (the gen-lines pattern)
+_LIVE: "weakref.WeakSet[KvBlockManager]" = weakref.WeakSet()
+
+
+class KvArenaFull(RuntimeError):
+    """No free block in the arena (after prefix-cache eviction). The
+    scheduler maps this to a row-alone failure or keeps the sequence
+    parked — never a batch-wide error."""
+
+
+class SeqKv:
+    """One sequence's block table over a :class:`KvBlockManager` arena.
+
+    ``length`` counts ENTRIES present (not capacity). The first
+    ``shared_len`` entries may live in blocks shared with the prefix
+    cache or sibling sequences (refs > 1); those are read-only — appends
+    go to private blocks, and :meth:`writable_block` is the COW door.
+
+    A swapped-out table has ``host`` set (the byte image) and an empty
+    ``blocks`` list; :meth:`KvBlockManager.swap_in` restores it.
+    """
+
+    __slots__ = ("mgr", "key", "blocks", "length", "shared_len",
+                 "prefix_key", "prefix_span", "host", "_reserved")
+
+    def __init__(self, mgr: "KvBlockManager", key: int):
+        self.mgr = mgr
+        self.key = key
+        self.blocks: List[int] = []
+        self.length = 0          # entries present
+        self.shared_len = 0      # entries covered by shared (COW) blocks
+        self.prefix_key: Optional[bytes] = None   # cache key of the
+        self.prefix_span = 0                      # block-aligned prompt span
+        self.host: Optional[bytearray] = None     # swap image when parked
+        self._reserved = 0       # entries of pre-allocated capacity
+
+    # -- capacity ------------------------------------------------------------
+
+    @property
+    def swapped(self) -> bool:
+        return self.host is not None
+
+    def capacity(self) -> int:
+        return len(self.blocks) * self.mgr.block_tokens
+
+    def reserve(self, n_entries: int) -> None:
+        """Pre-allocate blocks so ``n_entries`` total entries fit (the
+        handoff receiver's move: the grant must name every landing block
+        up front)."""
+        bt = self.mgr.block_tokens
+        need = (n_entries + bt - 1) // bt - len(self.blocks)
+        if need > 0:
+            # ownership transfers to the table in the same statement
+            self.blocks.extend(
+                self.mgr.alloc_blocks(self.key, need))  # tpr: allow(kv)
+        self._reserved = max(self._reserved, n_entries)
+
+    # -- entry access ---------------------------------------------------------
+
+    def _entry_site(self, pos: int) -> Tuple[memoryview, int]:
+        bt = self.mgr.block_tokens
+        block = self.blocks[pos // bt]
+        off = self.mgr.block_offset(block) + (pos % bt) * ENTRY_BYTES
+        return self.mgr.region_buf, off
+
+    def entry(self, pos: int) -> Tuple[int, int, int]:
+        """``(hash, token, flags)`` at entry position ``pos``."""
+        if not 0 <= pos < self.length:
+            raise IndexError(f"entry {pos} of {self.length}")
+        if self.host is not None:
+            return ENTRY.unpack_from(self.host, pos * ENTRY_BYTES)
+        buf, off = self._entry_site(pos)
+        return ENTRY.unpack_from(buf, off)
+
+    def last(self) -> Tuple[int, int, int]:
+        return self.entry(self.length - 1)
+
+    def append(self, h: int, token: int, flags: int = 0) -> None:
+        """Write the next entry (decode's per-token move). Allocates a
+        fresh PRIVATE block at block boundaries; never touches a shared
+        block (appends beyond ``shared_len`` by construction)."""
+        if self.host is not None:
+            raise RuntimeError("append to a swapped-out table")
+        if self.length >= self.capacity():
+            # ownership transfers to the table in the same statement
+            self.blocks.extend(
+                self.mgr.alloc_blocks(self.key, 1))  # tpr: allow(kv)
+        buf, off = self._entry_site(self.length)
+        ENTRY.pack_into(buf, off, h & 0xFFFFFFFFFFFFFFFF,
+                        token & 0xFFFFFFFF, flags & 0xFFFFFFFF)
+        self.length += 1
+
+    def truncate(self, n_entries: int) -> None:
+        """Forget entries past ``n_entries`` (the row-isolation retry's
+        undo: a failed batched call may have appended for some rows).
+        Blocks are kept — capacity is not ownership."""
+        self.length = min(self.length, max(0, int(n_entries)))
+
+    def set_length(self, n_entries: int) -> None:
+        """Declare entries [0, n) present (the handoff receiver's move
+        after COMPLETE: the bytes arrived one-sided, not via append)."""
+        bt = self.mgr.block_tokens
+        if n_entries > len(self.blocks) * bt:
+            raise ValueError(f"{n_entries} entries exceed the "
+                             f"{len(self.blocks)}-block table")
+        self.length = int(n_entries)
+
+    def writable_block(self, idx: int) -> int:
+        """The COW door: block ``idx`` of the table, privately owned —
+        if it is shared (refs > 1), its bytes are copied into a fresh
+        block first and the table repointed. Returns the block id."""
+        block = self.blocks[idx]
+        if self.mgr.block_refs(block) <= 1:
+            return block
+        fresh = self.mgr.alloc_blocks(self.key, 1)
+        try:
+            src = self.mgr.block_view(block)
+            self.mgr.block_view(fresh[0])[:] = src
+        except BaseException:
+            self.mgr.free_blocks_raw(fresh)
+            raise
+        self.blocks[idx] = fresh[0]
+        self.mgr._decref(block)
+        bt = self.mgr.block_tokens
+        self.shared_len = min(self.shared_len, idx * bt)
+        _COW_COPIES.inc()
+        return fresh[0]
+
+    # -- bulk views (the ship/swap paths) -------------------------------------
+
+    def chunks(self, start_entry: int, end_entry: int
+               ) -> Iterator[Tuple[int, memoryview]]:
+        """Per-block byte views covering entries [start, end) — the
+        migration/handoff sender's gather list. ``start_entry`` must be
+        block-aligned (shared spans are). Yields ``(block_index,
+        view)``."""
+        bt = self.mgr.block_tokens
+        if start_entry % bt:
+            raise ValueError(f"start entry {start_entry} not block-aligned")
+        for bi in range(start_entry // bt,
+                        (max(start_entry, end_entry) + bt - 1) // bt):
+            lo = bi * bt
+            hi = min(end_entry, lo + bt)
+            nb = (hi - lo) * ENTRY_BYTES
+            if self.host is not None:
+                view = memoryview(self.host)[lo * ENTRY_BYTES:
+                                             lo * ENTRY_BYTES + nb]
+            else:
+                off = self.mgr.block_offset(self.blocks[bi])
+                view = self.mgr.region_buf[off:off + nb]
+            yield bi, view
+
+
+class HostKv:
+    """A host-memory table implementing the SeqKv entry interface — what a
+    PREFILL server computes into before shipping (it has no arena; the
+    landing blocks live in the decode server). ``base_pos``/``base_hash``
+    seed a table that logically starts mid-sequence: the prefix-cache-hit
+    handoff, where the decode side already holds entries [0, base_pos)
+    and returned the resume hash in its CLAIM."""
+
+    __slots__ = ("base_pos", "_base_hash", "_base_flags", "buf", "length")
+
+    def __init__(self, base_pos: int = 0, base_hash: int = 0,
+                 base_flags: int = 0):
+        self.base_pos = int(base_pos)
+        self._base_hash = int(base_hash)
+        self._base_flags = int(base_flags)
+        self.buf = bytearray()
+        self.length = self.base_pos  # entries "present" in the logical seq
+
+    def entry(self, pos: int) -> Tuple[int, int, int]:
+        if pos == self.base_pos - 1 and self.base_pos:
+            return (self._base_hash, 0, self._base_flags)
+        local = pos - self.base_pos
+        if not 0 <= local < (self.length - self.base_pos):
+            raise IndexError(f"entry {pos} (base {self.base_pos}, "
+                             f"length {self.length})")
+        return ENTRY.unpack_from(self.buf, local * ENTRY_BYTES)
+
+    def last(self) -> Tuple[int, int, int]:
+        return self.entry(self.length - 1)
+
+    def append(self, h: int, token: int, flags: int = 0) -> None:
+        self.buf += ENTRY.pack(h & 0xFFFFFFFFFFFFFFFF, token & 0xFFFFFFFF,
+                               flags & 0xFFFFFFFF)
+        self.length += 1
+
+    def truncate(self, n_entries: int) -> None:
+        n_entries = max(self.base_pos, int(n_entries))
+        del self.buf[(n_entries - self.base_pos) * ENTRY_BYTES:]
+        self.length = n_entries
+
+    def payload(self) -> memoryview:
+        """The computed entries [base_pos, length) as bytes — what ships."""
+        return memoryview(self.buf)
+
+
+class _PrefixEntry:
+    __slots__ = ("blocks", "span", "last_hash", "last_flags")
+
+    def __init__(self, blocks: Tuple[int, ...], span: int, last_hash: int,
+                 last_flags: int):
+        self.blocks = blocks
+        self.span = span
+        self.last_hash = last_hash
+        self.last_flags = last_flags
+
+
+class KvBlockManager:
+    """The arena + block tables + prefix cache + swap/quarantine machinery
+    (module docstring has the full story).
+
+    ``kind`` names the :class:`~tpurpc.core.pair.MemoryDomain` backing the
+    arena: ``"local"`` for in-process scheduling, ``"shm"`` when the arena
+    must double as a one-sided landing target for the disaggregated
+    handoff plane (:func:`grant_blocks`).
+    """
+
+    #: lint rule `lock`: every mutable map below is shared between the
+    #: scheduler loop thread, disagg RPC handlers, and migration threads
+    _GUARDED_BY = {
+        "_free": "_lock", "_refs": "_lock", "_owner": "_lock",
+        "_quarantined": "_lock", "_prefix": "_lock", "_swapped_blocks":
+        "_lock",
+    }
+
+    def __init__(self, n_blocks: int = 256, block_bytes: int = 2048,
+                 kind: str = "local", name: str = "kv"):
+        if block_bytes % ENTRY_BYTES:
+            raise ValueError(f"block_bytes {block_bytes} not a multiple of "
+                             f"the {ENTRY_BYTES}-byte entry")
+        self.name = name
+        self.kind = kind
+        self.n_blocks = int(n_blocks)
+        self.block_bytes = int(block_bytes)
+        self.block_tokens = block_bytes // ENTRY_BYTES
+        self._domain = _pair.make_domain(kind)
+        total = _ALIGN + self.n_blocks * self.block_bytes + _NONCE_BYTES
+        self._region = self._domain.alloc(total)
+        base = np.frombuffer(self._region.buf, np.uint8)
+        self._base_off = int((-base.ctypes.data) % _ALIGN)
+        del base
+        self.nonce = os.urandom(_NONCE_BYTES)
+        self.nonce_off = self._base_off + self.n_blocks * self.block_bytes
+        self._region.buf[self.nonce_off:
+                         self.nonce_off + _NONCE_BYTES] = self.nonce
+        self._lock = make_lock("KvBlockManager._lock")
+        self._free: List[int] = list(range(self.n_blocks - 1, -1, -1))
+        self._refs: Dict[int, int] = {}
+        self._owner: Dict[int, int] = {}      # block -> first owner key
+        self._quarantined: List[int] = []
+        #: prompt-prefix hash -> _PrefixEntry (LRU: move_to_end on hit)
+        self._prefix: "OrderedDict[bytes, _PrefixEntry]" = OrderedDict()
+        self._swapped_blocks: Dict[int, int] = {}  # seq key -> block count
+        self.prefix_hits = 0
+        self.swaps_out = 0
+        self.swaps_in = 0
+        self._tag = _flight.tag_for(f"kv:{name}")
+        self._closed = False
+        _USED_G.track(self)
+        _FREE_G.track(self)
+        _SWAPPED_G.track(self)
+        _QUAR_G.track(self)
+        _LIVE.add(self)
+
+    # -- raw arena geometry (the disagg grant path reads these) ---------------
+
+    @property
+    def region_handle(self) -> str:
+        return self._region.handle
+
+    @property
+    def region_buf(self) -> memoryview:
+        return self._region.buf
+
+    @property
+    def window_bytes(self) -> int:
+        """Bytes a peer window must map to reach every block + the nonce."""
+        return self.nonce_off + _NONCE_BYTES
+
+    def block_offset(self, block: int) -> int:
+        return self._base_off + block * self.block_bytes
+
+    def block_view(self, block: int) -> memoryview:
+        off = self.block_offset(block)
+        return self._region.buf[off:off + self.block_bytes]
+
+    def block_refs(self, block: int) -> int:
+        with self._lock:
+            return self._refs.get(block, 0)
+
+    # -- allocation -----------------------------------------------------------
+
+    def alloc_blocks(self, owner_key: int, n: int) -> List[int]:
+        """``n`` fresh private blocks (refs=1) for ``owner_key``. Evicts
+        prefix-cache entries LRU-first under pressure; raises
+        :class:`KvArenaFull` when even eviction cannot cover it."""
+        with self._lock:
+            if self._closed:
+                raise KvArenaFull("arena closed")
+            while len(self._free) < n and self._prefix:
+                self._evict_one_locked()
+            if len(self._free) < n:
+                raise KvArenaFull(
+                    f"arena {self.name}: want {n} blocks, "
+                    f"{len(self._free)} free (of {self.n_blocks})")
+            out = [self._free.pop() for _ in range(n)]
+            for b in out:
+                self._refs[b] = 1
+                self._owner[b] = owner_key
+        nb = len(out)
+        _flight.emit(_flight.KV_ALLOC, self._tag, owner_key, nb)
+        return out
+
+    def alloc_for_prompt(self, seq_key: int, prompt: np.ndarray,
+                         reserve_entries: int = 0) -> Tuple[SeqKv, int]:
+        """A fresh block table for ``prompt``, prefix-cache consulted:
+        returns ``(table, hit_entries)`` where the first ``hit_entries``
+        entries are ALREADY PRESENT via shared blocks — prefill skips
+        them. ``reserve_entries`` pre-allocates capacity (the handoff
+        grant's requirement); 0 defers allocation to append time."""
+        prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        kv = SeqKv(self, seq_key)
+        span = (int(prompt.shape[0]) // self.block_tokens) \
+            * self.block_tokens
+        key = self._prefix_key(prompt, span) if span else None
+        kv.prefix_key = key
+        kv.prefix_span = span
+        hit = 0
+        if key is not None:
+            with self._lock:
+                ent = self._prefix.get(key)
+                if ent is not None:
+                    self._prefix.move_to_end(key)
+                    for b in ent.blocks:
+                        self._refs[b] += 1
+                    kv.blocks.extend(ent.blocks)
+                    hit = ent.span
+                    self.prefix_hits += 1
+            if hit:
+                kv.length = hit
+                kv.shared_len = hit
+                _PREFIX_HITS.inc()
+                _PREFIX_HIT_TOKENS.inc(hit)
+                _flight.emit(_flight.KV_PREFIX_HIT, self._tag, seq_key, hit)
+        if reserve_entries:
+            try:
+                kv.reserve(reserve_entries)
+            except KvArenaFull:
+                self.free_blocks(kv)
+                raise
+        return kv, hit
+
+    def _prefix_key(self, prompt: np.ndarray, span: int) -> bytes:
+        """Content hash of the block-aligned prompt prefix — the cache
+        key. sha1 over the raw int32 bytes: collisions are content-
+        equality for any realistic fleet, and the cached entry's span is
+        re-checked on hit."""
+        return hashlib.sha1(prompt[:span].tobytes()).digest()
+
+    # -- release / prefix donation --------------------------------------------
+
+    def free_blocks(self, kv: SeqKv, cache_prefix: bool = False) -> None:
+        """Release a table. With ``cache_prefix=True`` (natural retire /
+        clean leave) the block-aligned prompt span is donated to the
+        prefix cache first — refcounted, so the data outlives the
+        sequence. Poisoned spans are never cached (a latent-poison prefix
+        would infect clean prompts sharing it)."""
+        if kv.host is not None:
+            with self._lock:
+                self._swapped_blocks.pop(kv.key, None)
+            kv.host = None
+        if not kv.blocks:
+            kv.length = 0
+            return
+        donate: Optional[Tuple[bytes, _PrefixEntry]] = None
+        if (cache_prefix and kv.prefix_key is not None
+                and kv.length >= kv.prefix_span > 0):
+            h, _tok, flags = kv.entry(kv.prefix_span - 1)
+            if not flags & FLAG_POISONED:
+                bt = self.block_tokens
+                span_blocks = tuple(kv.blocks[:kv.prefix_span // bt])
+                donate = (kv.prefix_key,
+                          _PrefixEntry(span_blocks, kv.prefix_span, h,
+                                       flags))
+        blocks, kv.blocks = kv.blocks, []
+        n = len(blocks)
+        kv.length = 0
+        kv.shared_len = 0
+        with self._lock:
+            if donate is not None and donate[0] not in self._prefix:
+                self._prefix[donate[0]] = donate[1]
+                for b in donate[1].blocks:
+                    self._refs[b] += 1
+            for b in blocks:
+                self._decref_locked(b)
+        _flight.emit(_flight.KV_FREE, self._tag, kv.key, n)
+
+    def free_blocks_raw(self, blocks: Sequence[int]) -> None:
+        """Release raw block ids (the grant/undo paths, where no SeqKv
+        owns them yet)."""
+        n = len(blocks)
+        with self._lock:
+            for b in blocks:
+                self._decref_locked(b)
+        if n:
+            _flight.emit(_flight.KV_FREE, self._tag, 0, n)
+
+    def _decref(self, block: int) -> None:
+        with self._lock:
+            self._decref_locked(block)
+
+    def _decref_locked(self, block: int) -> None:
+        # contract: caller holds self._lock (the _locked suffix)
+        r = self._refs.get(block, 0) - 1
+        if r > 0:
+            self._refs[block] = r  # tpr: allow(lock)
+            return
+        self._refs.pop(block, None)  # tpr: allow(lock)
+        self._owner.pop(block, None)  # tpr: allow(lock)
+        self._free.append(block)  # tpr: allow(lock)
+
+    def _evict_one_locked(self) -> None:
+        # contract: caller holds self._lock (the _locked suffix)
+        key, ent = self._prefix.popitem(last=False)  # tpr: allow(lock)
+        for b in ent.blocks:
+            self._decref_locked(b)
+
+    def lookup_prefix(self, prompt: np.ndarray
+                      ) -> Tuple[int, int, int]:
+        """``(span, last_hash, last_flags)`` for the cached prefix of
+        ``prompt`` (0, 0, 0 on miss) WITHOUT taking references — the
+        handoff OFFER's probe (the CLAIM allocates for real)."""
+        prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        span = (int(prompt.shape[0]) // self.block_tokens) \
+            * self.block_tokens
+        if not span:
+            return 0, 0, 0
+        with self._lock:
+            ent = self._prefix.get(self._prefix_key(prompt, span))
+            if ent is None:
+                return 0, 0, 0
+            return ent.span, ent.last_hash, ent.last_flags
+
+    # -- preempt-to-host swap -------------------------------------------------
+
+    def swap_out(self, kv: SeqKv) -> None:
+        """Copy the table's entries to a host image and return every
+        block to the arena — the preemption that actually FREES device
+        memory. Byte-exact restore via :meth:`swap_in`."""
+        if kv.host is not None:
+            return
+        key = kv.key
+        n = len(kv.blocks)
+        _flight.emit(_flight.KV_SWAP_BEGIN, self._tag, key, 0)
+        host = bytearray(kv.length * ENTRY_BYTES)
+        for bi, view in kv.chunks(0, kv.length):
+            lo = bi * self.block_bytes
+            host[lo:lo + len(view)] = view
+        blocks, kv.blocks = kv.blocks, []
+        kv.host = host
+        kv.shared_len = 0
+        with self._lock:
+            for b in blocks:
+                self._decref_locked(b)
+            self._swapped_blocks[key] = n
+        self.swaps_out += 1
+        _SWAPS.inc()
+        _flight.emit(_flight.KV_SWAP_END, self._tag, key, 0)
+
+    def swap_in(self, kv: SeqKv) -> None:
+        """Restore a swapped table into fresh arena blocks (all private —
+        sharing does not survive a swap; the prefix cache keeps its own
+        refs). Raises :class:`KvArenaFull` when the arena cannot take it
+        back — the caller keeps the sequence parked and retries."""
+        if kv.host is None:
+            return
+        key = kv.key
+        length = kv.length
+        bt = self.block_tokens
+        need = (length + bt - 1) // bt
+        _flight.emit(_flight.KV_SWAP_BEGIN, self._tag, key, 1)
+        blocks = self.alloc_blocks(key, need)
+        try:
+            host = kv.host
+            for i, b in enumerate(blocks):
+                lo = i * self.block_bytes
+                chunk = host[lo:lo + self.block_bytes]
+                off = self.block_offset(b)
+                self._region.buf[off:off + len(chunk)] = chunk
+        except BaseException:
+            self.free_blocks_raw(blocks)
+            raise
+        kv.blocks = blocks
+        kv.host = None
+        with self._lock:
+            self._swapped_blocks.pop(key, None)
+        self.swaps_in += 1
+        _flight.emit(_flight.KV_SWAP_END, self._tag, key, 1)
+
+    # -- quarantine (the death path) ------------------------------------------
+
+    def quarantine(self, kv_or_blocks) -> int:
+        """Remove blocks from circulation FOREVER (until arena close): a
+        straggling one-sided writer may still land bytes in them, so they
+        must never be re-leased (the modeled ``reuse_before_quarantine``
+        mutant is this rule violated). Accepts a SeqKv or a block list;
+        returns the count quarantined."""
+        if isinstance(kv_or_blocks, SeqKv):
+            blocks, kv_or_blocks.blocks = kv_or_blocks.blocks, []
+            kv_or_blocks.length = 0
+            kv_or_blocks.shared_len = 0
+        else:
+            blocks = list(kv_or_blocks)
+        n = 0
+        with self._lock:
+            for b in blocks:
+                r = self._refs.get(b, 0) - 1
+                # shared refs (prefix cache) keep THEIR view; only the
+                # final release diverts to quarantine instead of free
+                if r > 0:
+                    self._refs[b] = r
+                    continue
+                self._refs.pop(b, None)
+                self._owner.pop(b, None)
+                self._quarantined.append(b)
+                n += 1
+        if n:
+            _flight.emit(_flight.KV_QUARANTINE, self._tag, 0, n)
+        return n
+
+    # -- introspection --------------------------------------------------------
+
+    def used_count(self) -> int:
+        with self._lock:
+            return self.n_blocks - len(self._free) - len(self._quarantined)
+
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def swapped_count(self) -> int:
+        with self._lock:
+            return sum(self._swapped_blocks.values())
+
+    def quarantined_count(self) -> int:
+        return len(self._quarantined)
+
+    def prefix_entries(self) -> int:
+        return len(self._prefix)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "blocks": self.n_blocks,
+                "free": len(self._free),
+                "used": self.n_blocks - len(self._free)
+                - len(self._quarantined),
+                "swapped_seqs": len(self._swapped_blocks),
+                "swapped_blocks": sum(self._swapped_blocks.values()),
+                "quarantined": len(self._quarantined),
+                "prefix_entries": len(self._prefix),
+                "prefix_hits": self.prefix_hits,
+            }
+
+    def close(self) -> None:
+        self._closed = True
+        _LIVE.discard(self)
+        try:
+            self._region.close()
+        except Exception:
+            pass
+
+
+def health_lines() -> List[str]:
+    """One ``kv`` line per live arena for /healthz — block occupancy and
+    swap pressure at a glance, without the metrics plane."""
+    out = []
+    for m in list(_LIVE):
+        try:
+            s = m.stats()
+            out.append(
+                f"kv {m.name}: used={s['used']}/{s['blocks']} "
+                f"free={s['free']} swapped={s['swapped_blocks']} "
+                f"quarantined={s['quarantined']} "
+                f"prefix_hits={s['prefix_hits']}")
+        except Exception:
+            continue
+    return sorted(out)

@@ -40,6 +40,20 @@ flight event + counter so /healthz can say "shedding" while it is true.
 Failure isolation: a batched ``prefill``/``step`` that raises is retried
 row-by-row, so a poisoned sequence fails ALONE — the PR 3 batch / PR 7
 merge-boundary poison discipline, lifted to the decode loop.
+
+tpurpc-keystone (ISSUE 11): constructed with ``kv=KvBlockManager`` and a
+model implementing the explicit-KV contract (``prefill_paged`` /
+``step_paged``, :mod:`tpurpc.jaxshim.generate`), the scheduler runs
+PAGED: sequence state lives in per-sequence block tables, prefill
+consults the prefix cache (a hit skips the shared span), preemption
+SWAPS the victim's blocks to host (``kv.swap_out`` — the arena is
+actually freed, unlike PR 10's keep-in-HBM parking) and the sequence
+parks in ``_swapped`` until a boundary has room to swap it back.
+``load_depth()`` — waiting + swapped — is the fleet load signal:
+``queue_depth`` alone made a server holding swapped work look idle to
+least_loaded picking (the ISSUE 11 satellite fix). :meth:`detach` and
+:meth:`submit_adopted` are the migration plane's two halves: remove a
+live sequence with its KV intact / graft a shipped one in.
 """
 
 from __future__ import annotations
@@ -50,7 +64,7 @@ import threading
 import time
 import weakref
 from collections import deque
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
@@ -119,11 +133,14 @@ class _Seq:
     """One generation request inside the scheduler. ``q`` is the only
     egress: the loop thread puts tokens / _DONE / an Exception; the
     handler thread gets. ``cancelled`` is the leave flag — set by any
-    thread, honored by the loop at the NEXT step boundary."""
+    thread, honored by the loop at the NEXT step boundary. ``kv`` is the
+    paged-mode block table (None until prefill allocates it, or grafted
+    whole by :meth:`DecodeScheduler.submit_adopted`)."""
 
     __slots__ = ("sid", "prompt", "prompt_len", "max_tokens", "slo",
                  "slo_code", "state", "last_token", "emitted", "q",
-                 "cancelled", "t_submit_ns", "t_first_ns", "preempted")
+                 "cancelled", "t_submit_ns", "t_first_ns", "preempted",
+                 "kv", "adopted")
 
     def __init__(self, sid: int, prompt: np.ndarray, max_tokens: int,
                  slo: str):
@@ -141,6 +158,12 @@ class _Seq:
         self.t_submit_ns = time.monotonic_ns()
         self.t_first_ns = 0
         self.preempted = False
+        self.kv = None              # paged mode: the sequence's block table
+        self.adopted = False        # arrived via handoff/migration
+
+    def resumable(self) -> bool:
+        """Prefilled already — admission is free (no prefill cost)."""
+        return self.state is not None or self.kv is not None
 
 
 class TokenStream:
@@ -222,10 +245,11 @@ class DecodeScheduler:
       sequences finish.
     """
 
-    #: lock map (lint rule `lock`): the waiting queue and lifecycle flags
-    #: are the ONLY cross-thread state; the running batch is loop-private
+    #: lock map (lint rule `lock`): the waiting queue, lifecycle flags and
+    #: the detach-request registry are the ONLY cross-thread state; the
+    #: running batch and the swapped list are loop-private
     _GUARDED_BY = {"_waiting": "_lock", "_closed": "_lock",
-                   "_draining": "_lock"}
+                   "_draining": "_lock", "_detach_req": "_lock"}
 
     def __init__(self, model, *, max_batch: int = 8,
                  prefill_budget: int = 128, max_waiting: int = 32,
@@ -234,8 +258,15 @@ class DecodeScheduler:
                  base_pushback_ms: int = 25, max_pushback_ms: int = 1000,
                  idle_wait_s: float = 0.05,
                  draining_fn: Optional[Callable[[], bool]] = None,
-                 name: str = "gen"):
+                 kv=None, name: str = "gen"):
         self.model = model
+        self.kv = kv
+        self._paged = kv is not None
+        if self._paged and not hasattr(model, "prefill_paged"):
+            raise ValueError(
+                "kv= given but the model implements no explicit-KV "
+                "contract (prefill_paged/step_paged; see "
+                "tpurpc.jaxshim.generate)")
         self.max_batch = max(1, int(max_batch))
         self.prefill_budget = max(1, int(prefill_budget))
         self.max_waiting = max(1, int(max_waiting))
@@ -254,6 +285,12 @@ class DecodeScheduler:
         self._closed = False
         self._draining = False
         self._running: List[_Seq] = []   # loop-private (no lock by design)
+        #: paged mode: preempted sequences whose KV is swapped to host —
+        #: loop-private like _running (only the boundary parks/resumes)
+        self._swapped: List[_Seq] = []
+        #: sid -> (event, box): migration threads asking the boundary to
+        #: hand a live sequence over with its KV intact
+        self._detach_req: Dict[int, tuple] = {}
         self._sids = itertools.count(1)
         self._tag = _flight.tag_for(f"decode:{name}")
         self._step_roll: "deque[float]" = deque(maxlen=64)  # step ms
@@ -306,6 +343,89 @@ class DecodeScheduler:
             self._waiting.append(seq)
             self._kick.notify_all()
         return TokenStream(seq, self)
+
+    def submit_adopted(self, kv_handle, prompt, *, last_token: int,
+                       emitted: int, max_tokens: int,
+                       slo: str = SLO_INTERACTIVE) -> TokenStream:
+        """Graft a sequence whose KV was computed ELSEWHERE — a
+        disaggregated prefill handoff or an inbound migration. The block
+        table arrives whole (entries present through the last generated
+        token); the sequence joins as a free resume at the next boundary
+        and its next token continues the stream exactly where the sender
+        left it. The caller owns nothing afterwards: retire/leave/failure
+        release the table like any local sequence's."""
+        if not self._paged:
+            raise RuntimeError("submit_adopted needs a paged scheduler "
+                               "(kv=)")
+        prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        seq = _Seq(next(self._sids), prompt, max(1, int(max_tokens)), slo)
+        seq.kv = kv_handle
+        seq.adopted = True
+        seq.last_token = int(last_token)
+        seq.emitted = int(emitted)
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("scheduler closed")
+            # a draining server must not accept NEW residency; migration
+            # initiators pick a non-draining peer
+            if self._draining or (self._draining_fn is not None
+                                  and self._draining_fn()):
+                raise DrainingError("scheduler draining: adoption refused")
+            reason, pushback = self._shed_decision_locked(slo)
+            if reason is not None:
+                self.shed_total += 1
+                self.last_shed_ns = time.monotonic_ns()
+                slo_code = seq.slo_code
+                _flight.emit(_flight.GEN_SHED, self._tag, slo_code,
+                             pushback)
+                _SHED.labels(slo).inc()
+                raise ShedError(reason, pushback, slo)
+            self._waiting.append(seq)
+            self._kick.notify_all()
+        return TokenStream(seq, self)
+
+    def detach(self, sid: int, timeout: float = 5.0):
+        """Remove a live sequence (running, waiting-resumable, or
+        swapped) from the scheduler WITH its KV intact — the migration
+        sender's half. Blocks until the next step boundary hands it over
+        (or ``timeout``). Returns the internal sequence object (``kv``,
+        ``prompt``, ``emitted``, ``last_token``, ``q`` all live) or None
+        when the sid is gone/unknown. The caller now owns the KV table:
+        it must ship-and-free, re-adopt, or quarantine it."""
+        ev = threading.Event()
+        box: List[_Seq] = []
+        with self._lock:
+            if self._closed:
+                return None
+            self._detach_req[sid] = (ev, box)
+            self._kick.notify_all()
+        ev.wait(timeout)
+        with self._lock:
+            self._detach_req.pop(sid, None)
+        return box[0] if box else None
+
+    # -- load signals ---------------------------------------------------------
+
+    def swapped_depth(self) -> int:
+        return len(self._swapped)
+
+    def load_depth(self) -> int:
+        """The fleet load signal: waiting AND preempted/swapped work.
+        ``queue_depth`` alone omitted preempted rows, so a server holding
+        swapped sequences looked idle to least_loaded picking and drew
+        MORE traffic exactly when it was oversubscribed (the ISSUE 11
+        satellite fix); the server's load report wires this instead."""
+        return len(self._waiting) + len(self._swapped)
+
+    def live_sids(self) -> List[int]:
+        """Sids currently running / swapped / waiting-resumable — the
+        migration initiator's worklist (loop-private lists read
+        GIL-atomically; a racing boundary only changes membership, which
+        detach re-checks anyway)."""
+        out = [s.sid for s in list(self._running)]
+        out.extend(s.sid for s in list(self._swapped))
+        out.extend(s.sid for s in list(self._waiting) if s.resumable())
+        return out
 
     def _shed_decision_locked(self, slo: str):
         """(reason, pushback_ms) when this submit must shed, else
@@ -411,64 +531,146 @@ class DecodeScheduler:
                 sid = s.sid
                 emitted = s.emitted
                 _flight.emit(_flight.GEN_LEAVE, self._tag, sid, emitted)
+                self._release_kv(s, cache=True)
                 s.q.put(_DONE)
             else:
                 kept.append(s)
         self._running = kept
+        # swapped leaves: a preempted sequence whose client went away
+        # releases its host image without ever swapping back in
+        if self._swapped:
+            self._swapped = [s for s in self._swapped
+                             if not self._drop_if_cancelled(s)]
+        preempt: List[_Seq] = []
         with self._lock:
             if self._closed:
-                stranded = list(self._running) + list(self._waiting)
+                stranded = (list(self._running) + list(self._waiting)
+                            + list(self._swapped))
                 self._waiting.clear()
                 self._running = []
+                self._swapped = []
+                for _sid, (ev, _box) in self._detach_req.items():
+                    ev.set()
+                self._detach_req.clear()
                 err = RuntimeError("scheduler closed")
                 for s in stranded:
+                    self._release_kv(s, cache=False)
                     s.q.put(err)
                 return False
+            if self._detach_req:
+                self._serve_detach_locked()
             draining = self._draining or (self._draining_fn is not None
                                           and self._draining_fn())
             # decide (pure), then APPLY the queue edit lexically under the
             # lock — the `lock` lint rule proves the guard holds
-            admit, keep, drop = self._admit(draining)
+            admit, keep, drop, preempt = self._admit(draining)
             self._waiting.clear()
             self._waiting.extend(keep)
-            if not self._running and not admit and not drop:
+            if (not self._running and not admit and not drop
+                    and not preempt):
                 # idle: park (bounded — the block rule's contract) until a
                 # submit kicks; the next loop pass re-runs the boundary
                 self._kick.wait(timeout=self.idle_wait_s)
                 return True
+        # paged preemption happens OUTSIDE the lock: swap_out copies block
+        # bytes to host, which must not stall a concurrent submit
+        for s in preempt:
+            self.kv.swap_out(s.kv)
+            self._swapped.append(s)
         for s, outcome in drop:
             sid = s.sid
             emitted = s.emitted
             if isinstance(outcome, BaseException):
                 _flight.emit(_flight.GEN_RETIRE, self._tag, sid, emitted)
+                self._release_kv(s, cache=False)
                 s.q.put(outcome)
             else:
                 _flight.emit(_flight.GEN_LEAVE, self._tag, sid, emitted)
+                self._release_kv(s, cache=True)
                 s.q.put(_DONE)
         if admit:
             self._prefill_batch(admit)
         return True
+
+    def _drop_if_cancelled(self, s: _Seq) -> bool:
+        if not s.cancelled:
+            return False
+        sid = s.sid
+        emitted = s.emitted
+        _flight.emit(_flight.GEN_LEAVE, self._tag, sid, emitted)
+        self._release_kv(s, cache=False)
+        s.q.put(_DONE)
+        return True
+
+    def _serve_detach_locked(self) -> None:
+        """Hand requested sequences to waiting migration threads (runs
+        under ``_lock`` on the loop thread — the only mutator of the
+        loop-private lists, so touching them here is safe)."""
+        for sid in list(self._detach_req):
+            ev, box = self._detach_req[sid]
+            found = None
+            for pool in (self._running, self._swapped):
+                for s in pool:
+                    if s.sid == sid:
+                        found = s
+                        pool.remove(s)
+                        break
+                if found is not None:
+                    break
+            if found is None:
+                for s in list(self._waiting):
+                    if s.sid == sid and s.resumable():
+                        found = s
+                        # contract: caller holds _lock (_locked suffix)
+                        self._waiting.remove(s)  # tpr: allow(lock)
+                        break
+            if found is not None:
+                box.append(found)
+                ev.set()
+                del self._detach_req[sid]  # tpr: allow(lock)
+
+    def _release_kv(self, s: _Seq, cache: bool) -> None:
+        """Return a sequence's block table to the arena (no-op in opaque
+        mode or when the table moved elsewhere). ``cache=True`` donates
+        the prompt-prefix span to the prefix cache (retire/leave after a
+        clean prefill)."""
+        kv = s.kv
+        if kv is None:
+            return
+        s.kv = None
+        try:
+            self.kv.free_blocks(kv, cache_prefix=cache)
+        except Exception:
+            # releasing must never take the loop down; the arena's
+            # accounting is best-effort at teardown edges
+            pass
 
     def _admit(self, draining: bool):
         """Decide the boundary's joins (runs under ``_lock``; PURE with
         respect to the waiting queue — the caller applies the edit so the
         guard is lexically provable). Interactive first; preemption makes
         room for it; prefill rides the token budget; resumed sequences
-        are free. Returns ``(admit, keep, drop)`` where ``drop`` pairs a
-        sequence with ``None`` (client left) or an exception (refused)."""
+        are free. Returns ``(admit, keep, drop, preempt)``: ``drop``
+        pairs a sequence with ``None`` (client left) or an exception
+        (refused); ``preempt`` (paged mode only) names victims the caller
+        swaps out AFTER releasing the lock."""
         admit: List[_Seq] = []
         drop: List[tuple] = []
+        preempt: List[_Seq] = []
         live: List[_Seq] = []
         for s in self._waiting:
             if s.cancelled:
                 drop.append((s, None))
             else:
                 live.append(s)
-        if not live:
-            return admit, live, drop
+        if not live and not self._swapped:
+            return admit, live, drop, preempt
         # preemption-at-step-boundary: interactive work waiting, batch
-        # full, batch-class rows running -> the cheap class yields. State
-        # is kept, so the preempted sequence resumes without re-prefill.
+        # full, batch-class rows running -> the cheap class yields. Opaque
+        # mode keeps the victim's state array in memory (PR 10); paged
+        # mode SWAPS its blocks to host (the caller performs the copy
+        # outside the lock) — the arena is actually freed for the
+        # incoming prefill's table.
         want_i = sum(1 for s in live if s.slo == SLO_INTERACTIVE)
         if want_i and len(self._running) >= self.max_batch:
             for s in reversed(list(self._running)):
@@ -483,7 +685,10 @@ class DecodeScheduler:
                                  slo_code)
                     _PREEMPTS.inc()
                     self.preempted_total += 1
-                    live.insert(0, s)
+                    if self._paged:
+                        preempt.append(s)
+                    else:
+                        live.insert(0, s)
                     want_i -= 1
         slots = self.max_batch - len(self._running)
         budget = self.prefill_budget
@@ -497,7 +702,7 @@ class DecodeScheduler:
                 if slots <= 0:
                     keep.append(s)
                     continue
-                if s.state is not None:        # resume: no prefill cost
+                if s.resumable():              # resume: no prefill cost
                     admit.append(s)
                     slots -= 1
                     continue
@@ -519,22 +724,42 @@ class DecodeScheduler:
                     prefills += 1
                 else:
                     keep.append(s)
+        # swapped sequences come back when room remains AFTER the queue
+        # had its turn (they already ran once; fresh interactive work is
+        # not made to wait behind a swap-in) — unless nothing else wants
+        # the slot, in which case they must not starve
+        while slots > 0 and self._swapped and not preempt:
+            admit.append(self._swapped.pop(0))
+            slots -= 1
         # keep lost the cross-class FIFO interleaving; restore arrival
         # order (sid order) so re-examination next boundary stays fair
         keep.sort(key=lambda s: s.sid)
-        return admit, keep, drop
+        return admit, keep, drop, preempt
 
     def _prefill_batch(self, admit: List[_Seq]) -> None:
-        """Join the admitted sequences: resumes re-enter directly, fresh
-        prompts prefill as ONE batched model call (row-isolated on
+        """Join the admitted sequences: resumes re-enter directly (a
+        swapped table swaps back in first; a full arena re-parks it),
+        fresh prompts prefill as ONE batched model call (row-isolated on
         failure) and their first token streams immediately."""
-        fresh = [s for s in admit if s.state is None]
+        fresh = [s for s in admit if not s.resumable()]
         for s in admit:
-            if s.state is not None:
-                sid = s.sid
-                _flight.emit(_flight.GEN_JOIN, self._tag, sid, 0)
-                self._running.append(s)
+            if not s.resumable():
+                continue
+            if s.kv is not None and s.kv.swapped:
+                try:
+                    self.kv.swap_in(s.kv)
+                except Exception:
+                    # arena full right now: stay parked, retry at a later
+                    # boundary (load_depth keeps reporting the debt)
+                    self._swapped.append(s)
+                    continue
+            sid = s.sid
+            _flight.emit(_flight.GEN_JOIN, self._tag, sid, 0)
+            self._running.append(s)
         if not fresh:
+            return
+        if self._paged:
+            self._prefill_paged(fresh)
             return
         try:
             states, tokens = self.model.prefill([s.prompt for s in fresh])
@@ -571,6 +796,64 @@ class DecodeScheduler:
         self.tokens_out += emitted
         _TOKENS.inc(emitted)
 
+    def _prefill_paged(self, fresh: List[_Seq]) -> None:
+        """The explicit-KV prefill: allocate each row's block table
+        (prefix cache consulted — a hit means the model folds only the
+        uncached tail), one batched ``prefill_paged``, row-isolated
+        retry with truncate-undo on failure."""
+        ready: List[_Seq] = []
+        for s in fresh:
+            try:
+                # the sequence adopts the table in the same statement;
+                # every later path releases via _release_kv
+                s.kv, _hit = self.kv.alloc_for_prompt(  # tpr: allow(kv)
+                    s.sid, s.prompt)
+                ready.append(s)
+            except Exception as exc:
+                _SEQ_FAILED.inc()
+                sid = s.sid
+                _flight.emit(_flight.GEN_RETIRE, self._tag, sid, 0)
+                s.q.put(exc)
+        if not ready:
+            return
+        lengths = [s.kv.length for s in ready]
+        try:
+            toks = self.model.prefill_paged([s.prompt for s in ready],
+                                            [s.kv for s in ready])
+            results = [int(toks[i]) for i in range(len(ready))]
+        except Exception:
+            # batched prefill failed: undo partial appends, then
+            # row-by-row isolation (one bad prompt must not fail its
+            # co-admitted siblings)
+            results = []
+            for s, n0 in zip(ready, lengths):
+                s.kv.truncate(n0)
+                try:
+                    t = self.model.prefill_paged([s.prompt], [s.kv])
+                    results.append(int(t[0]))
+                except Exception as exc:
+                    s.kv.truncate(n0)
+                    results.append(exc)
+        emitted = 0
+        for s, res in zip(ready, results):
+            sid = s.sid
+            plen = s.prompt_len
+            if isinstance(res, Exception):
+                _SEQ_FAILED.inc()
+                _flight.emit(_flight.GEN_RETIRE, self._tag, sid, 0)
+                self._release_kv(s, cache=False)
+                s.q.put(res)
+                continue
+            _flight.emit(_flight.GEN_JOIN, self._tag, sid, plen)
+            self._emit_token(s, res)
+            emitted += 1
+            if s.emitted < s.max_tokens and not self._hit_eos(res):
+                self._running.append(s)
+            else:
+                self._retire(s)
+        self.tokens_out += emitted
+        _TOKENS.inc(emitted)
+
     def _run_step(self) -> None:
         """One batched decode step over the running batch; delivery and
         retirement inline (loop-private state, no locks)."""
@@ -579,25 +862,45 @@ class DecodeScheduler:
         waiting_n = len(self._waiting)
         _flight.emit(_flight.GEN_STEP_BEGIN, self._tag, nb, waiting_n)
         t0 = time.monotonic_ns()
-        states = np.stack([s.state for s in running])
         tokens = np.asarray([s.last_token for s in running],
                             dtype=np.int32)
-        try:
-            new_states, new_tokens = self.model.step(states, tokens)
-            results = [(new_states[i], int(new_tokens[i]))
-                       for i in range(nb)]
-        except Exception:
-            # poisoned batch: retry row-by-row so the bad sequence fails
-            # ALONE (PR 3/7 poison-isolation discipline, decode edition)
-            results = []
-            for s in running:
-                try:
-                    st, tok = self.model.step(s.state[None],
-                                              np.asarray([s.last_token],
-                                                         dtype=np.int32))
-                    results.append((st[0], int(tok[0])))
-                except Exception as exc:
-                    results.append(exc)
+        if self._paged:
+            lengths = [s.kv.length for s in running]
+            try:
+                toks = self.model.step_paged([s.kv for s in running],
+                                             tokens)
+                results = [(None, int(toks[i])) for i in range(nb)]
+            except Exception:
+                # poisoned batch: undo partial appends, retry row-by-row
+                # so the bad sequence fails ALONE
+                results = []
+                for s, n0 in zip(running, lengths):
+                    s.kv.truncate(n0)
+                    try:
+                        t = self.model.step_paged(
+                            [s.kv], np.asarray([s.last_token], np.int32))
+                        results.append((None, int(t[0])))
+                    except Exception as exc:
+                        s.kv.truncate(n0)
+                        results.append(exc)
+        else:
+            states = np.stack([s.state for s in running])
+            try:
+                new_states, new_tokens = self.model.step(states, tokens)
+                results = [(new_states[i], int(new_tokens[i]))
+                           for i in range(nb)]
+            except Exception:
+                # poisoned batch: retry row-by-row so the bad sequence
+                # fails ALONE (PR 3/7 poison-isolation discipline)
+                results = []
+                for s in running:
+                    try:
+                        st, tok = self.model.step(
+                            s.state[None],
+                            np.asarray([s.last_token], dtype=np.int32))
+                        results.append((st[0], int(tok[0])))
+                    except Exception as exc:
+                        results.append(exc)
         dt_ns = time.monotonic_ns() - t0
         self._note_step_time(dt_ns)
         emitted = 0
@@ -608,9 +911,12 @@ class DecodeScheduler:
                 sid = s.sid
                 n = s.emitted
                 _flight.emit(_flight.GEN_RETIRE, self._tag, sid, n)
+                self._release_kv(s, cache=False)
                 s.q.put(res)
                 continue
-            s.state, tok = res
+            st, tok = res
+            if not self._paged:
+                s.state = st
             self._emit_token(s, tok)
             emitted += 1
             if s.emitted >= s.max_tokens or self._hit_eos(tok):
@@ -651,6 +957,10 @@ class DecodeScheduler:
         sid = s.sid
         n = s.emitted
         _flight.emit(_flight.GEN_RETIRE, self._tag, sid, n)
+        # natural finish: the prompt's block-aligned prefix is donated to
+        # the prefix cache before the table frees — a repeated prompt
+        # skips prefill for the shared span
+        self._release_kv(s, cache=True)
         s.q.put(_DONE)
 
 def health_lines() -> List[str]:
@@ -665,8 +975,8 @@ def health_lines() -> List[str]:
             out.append(
                 f"gen {s.name}: state={s.state_str()} "
                 f"running={s.running_depth()} waiting={s.queue_depth()} "
-                f"steps={s.steps} shed={s.shed_total} "
-                f"preempted={s.preempted_total}")
+                f"swapped={s.swapped_depth()} steps={s.steps} "
+                f"shed={s.shed_total} preempted={s.preempted_total}")
         except Exception:
             continue
     return sorted(out)
