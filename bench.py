@@ -964,6 +964,75 @@ def _flight_overhead(duration: "float | None" = None, pairs: int = 2) -> dict:
     }
 
 
+def _proto_verify_overhead(duration: "float | None" = None,
+                           pairs: int = 4) -> dict:
+    """tpurpc-proof overhead gate (ISSUE 12): the LIVE protocol verifier
+    (``TPURPC_VERIFY_PROTOCOL=1`` — every flight event checked against
+    the declared machines as it is recorded) versus the same loop with no
+    verifier installed. ``proto_verify_overhead_pct`` carries the <3%
+    acceptance gate. By design the cost rides the flight recorder's
+    edges-not-traffic economy: a healthy closed loop emits near-zero
+    events, so the verifier's per-event machine step is almost never
+    taken — the measured cost is one global load + None check per emit.
+    Same alternation and best-draw-p50 methodology as _obs_overhead."""
+    import io
+
+    from tpurpc.analysis import protocol
+    from tpurpc.bench import micro
+    from tpurpc.utils import stats as _st
+
+    if duration is None:
+        duration = float(os.environ.get("TPURPC_BENCH_OBS_S", "1.0"))
+    prev_fast = os.environ.get("TPURPC_NATIVE_FAST_UNARY")
+    os.environ["TPURPC_NATIVE_FAST_UNARY"] = "0"
+    srv = micro.run_server(0, max_workers=8)
+    target = f"127.0.0.1:{srv.bench_port}"
+    devnull = io.StringIO()
+    p50s = {"off": [], "on": []}
+    verifier = None
+
+    def leg(key, dur):
+        r = micro.run_client(target, req_size=64, duration=dur, out=devnull)
+        p50s[key].append(r["rtt_us"]["p50"])
+
+    try:
+        micro.run_client(target, req_size=64, duration=0.3,
+                         out=devnull)  # warm: connect + first-dispatch
+        for i in range(max(1, pairs)):
+            legs = [("off", False), ("on", True)]
+            if i % 2:
+                legs.reverse()
+            for key, enabled in legs:
+                if enabled:
+                    verifier = protocol.install_live()
+                else:
+                    protocol.uninstall_live()
+                leg(key, duration)
+    finally:
+        protocol.uninstall_live()
+        if prev_fast is None:
+            os.environ.pop("TPURPC_NATIVE_FAST_UNARY", None)
+        else:
+            os.environ["TPURPC_NATIVE_FAST_UNARY"] = prev_fast
+        srv.stop(grace=0)
+        _st.reset_batch_stats()
+
+    off = min(p50s["off"])
+    on = min(p50s["on"])
+    gate = round((on - off) / off * 100, 2) if off else 0.0
+    return {
+        "proto_verify_overhead_pct": gate,
+        "proto_verify_overhead_gate_pct": 3.0,
+        "proto_verify_overhead_pass": gate < 3.0,
+        "proto_verify_events_checked": (verifier.checked if verifier
+                                        else 0),
+        "proto_verify_violations": (len(verifier.violations) if verifier
+                                    else 0),
+        "proto_verify_p50_us": {k: [round(x, 1) for x in sorted(v)]
+                                for k, v in p50s.items()},
+    }
+
+
 def _fleet_bench() -> dict:
     """tpurpc-fleet benches (ISSUE 6), in-process, seconds each:
 
@@ -2014,6 +2083,13 @@ def main() -> None:
         except Exception as exc:
             sys.stderr.write(f"lens overhead gate failed: {exc}\n")
             out["lens_overhead_error"] = repr(exc)
+        # tpurpc-proof (ISSUE 12): live protocol verifier on vs off;
+        # <3% is the acceptance contract (edges-not-traffic economy).
+        try:
+            out.update(_proto_verify_overhead())
+        except Exception as exc:
+            sys.stderr.write(f"proto verify overhead gate failed: {exc}\n")
+            out["proto_verify_overhead_error"] = repr(exc)
     # tpurpc-fleet (ISSUE 6): fleet_qps / fleet_p99_degraded_pct (hedging
     # on-vs-off with one slow replica) / shed_curve (admission gate vs
     # offered load). In-process, ~10s total.

@@ -141,20 +141,16 @@ def test_server_death_reconnect_flight_sequence(monkeypatch, platform):
                         raise
                     time.sleep(0.1)
             # the ordered postmortem: death -> re-dial -> first OK call,
-            # all after the kill stamp (warmup events precede it)
-            events = [(e["event"], e["t_ns"]) for e in flight.snapshot()]
-            def first_after(name, t):
-                for ev, t_ns in events:
-                    if ev == name and t_ns >= t:
-                        return t_ns
-                return None
-            t_dead = first_after("conn-dead", t_kill)
-            assert t_dead is not None, events
-            t_re = first_after("reconnect", t_dead)
-            assert t_re is not None, events
-            t_ok = first_after("call-first-ok", t_re)
-            assert t_ok is not None, events
-            assert t_dead <= t_re <= t_ok
+            # all after the kill stamp (warmup events precede it) — the
+            # cross-entity order via the ONE protocol helper, the
+            # per-entity legality via the declared machines (ISSUE 12)
+            from tpurpc.analysis import protocol
+
+            snap = flight.snapshot()
+            protocol.assert_ordered(
+                snap, ["conn-dead", "reconnect", "call-first-ok"],
+                since_ns=t_kill)
+            assert protocol.check_events(snap, strict=False) == []
         finally:
             srv2.stop(grace=0)
 
@@ -434,11 +430,12 @@ def test_fleet_drain_zero_failed_rpcs(monkeypatch, platform):
             assert len(rigs[1][2]) == settled, "drained server saw traffic"
             assert len(rigs[0][2]) + len(rigs[2][2]) > 0
             pipe.close()
-        events = [(e["event"], e["t_ns"]) for e in flight.snapshot()]
-        t_begin = next((t for ev, t in events if ev == "drain-begin"), None)
-        t_done = next((t for ev, t in events if ev == "drain-end"), None)
-        assert t_begin is not None and t_done is not None, events
-        assert t_drain <= t_begin <= t_done
+        from tpurpc.analysis import protocol
+
+        snap = flight.snapshot()
+        protocol.assert_ordered(snap, ["drain-begin", "drain-end"],
+                                since_ns=t_drain)
+        assert protocol.check_events(snap, strict=False) == []
     finally:
         for srv, _, _ in rigs:
             srv.stop(grace=0)
@@ -510,16 +507,18 @@ def test_partition_peer_stops_reading_names_stage(monkeypatch, platform):
                 # the starvation edge that justified it
                 assert diag["stage"] in ("credit-starvation",
                                          "peer-not-reading"), diag
-                evs = [(e["event"], e["t_ns"]) for e in flight.snapshot()]
-                starves = [t for ev, t in evs
-                           if ev in ("credit-starve-begin",
-                                     "write-stall-begin")]
-                assert starves and starves[0] >= t_start
+                from tpurpc.analysis import protocol
+
+                protocol.assert_ordered(
+                    flight.snapshot(),
+                    [(("credit-starve-begin", "write-stall-begin"), {})],
+                    since_ns=t_start)
             # the trip itself is flight evidence on BOTH planes, ordered
             # after the stream began
-            trips = [e for e in flight.snapshot()
-                     if e["event"] == "watchdog-trip"]
-            assert trips and trips[0]["t_ns"] >= t_start
+            from tpurpc.analysis import protocol
+
+            protocol.assert_ordered(flight.snapshot(), ["watchdog-trip"],
+                                    since_ns=t_start)
             call.cancel()
     finally:
         wd.min_stall_s, wd.sweep_s, wd.mult = prev
@@ -597,10 +596,11 @@ def test_slow_peer_names_device_infer_stage(monkeypatch, platform):
             t.join(timeout=30)
             assert result == [b"z"]  # the call itself completes fine
             t_done = time.monotonic_ns()
-            trips = [e for e in flight.snapshot()
-                     if e["event"] == "watchdog-trip"]
-            assert trips, "no watchdog-trip flight event"
-            assert t_start <= trips[0]["t_ns"] <= t_done
+            from tpurpc.analysis import protocol
+
+            (trip,) = protocol.assert_ordered(
+                flight.snapshot(), ["watchdog-trip"], since_ns=t_start)
+            assert trip["t_ns"] <= t_done
     finally:
         wd.min_stall_s, wd.sweep_s, wd.mult = prev
         wd.reset()
@@ -858,21 +858,21 @@ def test_peer_death_mid_rendezvous_releases_region(monkeypatch, platform):
                                      StatusCode.CANCELLED,
                                      StatusCode.DEADLINE_EXCEEDED), outcome
             # ordered postmortem on the CLAIMING side: offer -> claim ->
-            # death -> release, all for the same link+lease
+            # death -> release, all for the same link+lease — the
+            # machines prove the lease lifecycle, assert_ordered the
+            # cross-entity death placement (ISSUE 12)
+            from tpurpc.analysis import protocol
+
             events = flight.snapshot()
             tag, lease = claimed["tag"], claimed["a2"]
-            t_offer = [e["t_ns"] for e in events
-                       if e["event"] == "rdv-offer" and e["tag"] == tag
-                       and e["t_ns"] >= t_armed]
-            t_dead = [e["t_ns"] for e in events
-                      if e["event"] in ("conn-dead", "peer-death")
-                      and e["t_ns"] >= t_kill]
-            t_rel = [e["t_ns"] for e in events
-                     if e["event"] == "rdv-release" and e["tag"] == tag
-                     and e["a1"] == lease]
-            assert t_offer and t_dead and t_rel, events
-            assert min(t_offer) <= claimed["t_ns"] <= min(t_dead) \
-                <= max(t_rel)
+            protocol.assert_ordered(
+                events,
+                [("rdv-offer", {"tag": tag}),
+                 ("rdv-claim", {"tag": tag, "a2": lease}),
+                 (("conn-dead", "peer-death"), {}),
+                 ("rdv-release", {"tag": tag, "a1": lease})],
+                since_ns=t_armed)
+            assert protocol.check_events(events, strict=False) == []
     finally:
         rdv.TEST_HOOKS.pop("wedge_after_claim", None)
         wedge.set()  # free any straggling sender thread

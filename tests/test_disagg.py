@@ -17,6 +17,7 @@ import pytest
 
 import tpurpc.serving.disagg as disagg
 from tpurpc.jaxshim.generate import ToyDecodeModel, reference_decode
+from tpurpc.analysis import protocol
 from tpurpc.obs import flight
 from tpurpc.rpc.channel import Channel
 from tpurpc.rpc.status import RpcError, StatusCode
@@ -92,9 +93,10 @@ def test_disagg_stream_exact_tokens_and_ship_accounting():
         assert [t for _, t in pairs] == reference_decode(prompt, 12)
         # 21 entries of 16 bytes went one-sided into the decode arena
         assert w["rdma_write"] >= 21 * 16, w.delta
-        ev = [e["event"] for e in flight.snapshot()
-              if e["event"].startswith("kv-ship")]
-        assert "kv-ship-offer" in ev and "kv-ship-complete" in ev
+        snap = flight.snapshot()
+        protocol.assert_ordered(snap, ["kv-ship-offer",
+                                       "kv-ship-complete"])
+        assert protocol.check_events(snap, strict=False) == []
     finally:
         st.close()
 
@@ -217,8 +219,10 @@ def test_migration_continues_stream_exact_on_peer():
         assert [i for i, _ in pairs] == list(range(50))
         assert [v for _, v in pairs] == reference_decode([5, 6], 50)
         assert b[2].tokens_out > 0, "peer never stepped the migrated seq"
-        evs = [e["event"] for e in flight.snapshot()]
-        assert "migration-begin" in evs and "migration-end" in evs
+        snap = flight.snapshot()
+        protocol.assert_ordered(snap, ["migration-begin",
+                                       ("migration-end", {"a2": 1})])
+        assert protocol.check_events(snap, strict=False) == []
         # the source arena let go of the sequence (prefix cache may hold
         # the block-aligned prompt span; [5,6] is below the span bar)
         assert _poll(lambda: a[3].mgr.used_count() == 0), a[3].mgr.stats()
@@ -352,13 +356,14 @@ def test_decode_death_mid_migration_fails_alone_and_quarantines(
         assert b[3].mgr.quarantined_count() >= 1
         assert b[3].mgr.free_count() + b[3].mgr.used_count() \
             + b[3].mgr.quarantined_count() == b[3].mgr.n_blocks
-        evs = [e["event"] for e in flight.snapshot()]
-        assert "kv-quarantine" in evs
-        assert "migration-begin" in evs
-        # the failed migration closed its bracket (a2=0 in MIG_END)
-        ends = [e for e in flight.snapshot()
-                if e["event"] == "migration-end"]
-        assert ends and ends[-1]["a2"] == 0
+        # the failed migration closed its bracket (a2=0 in MIG_END) and
+        # the dead handoff's blocks left circulation — per-entity
+        # legality via the declared machines, order via the one helper
+        snap = flight.snapshot()
+        protocol.assert_ordered(snap, ["migration-begin",
+                                       ("migration-end", {"a2": 0})])
+        protocol.assert_ordered(snap, ["kv-quarantine"])
+        assert protocol.check_events(snap, strict=False) == []
     finally:
         if b_ch is not None:
             b_ch.close()

@@ -1,0 +1,164 @@
+"""tpurpc-proof (ISSUE 12): protocol-machine conformance over flight events.
+
+Contracts: the declared machines accept the protocols the tree actually
+emits (synthesized good trace + real recorder output), every seeded
+event-order mutant is flagged, tolerant mode absorbs mid-history streams
+(wrapped rings), `assert_ordered` expresses the chaos suites' cross-
+entity orderings, and the live verifier (TPURPC_VERIFY_PROTOCOL=1 path)
+records a breadcrumb + trips the watchdog on a violated machine without
+disturbing a clean workload.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from tpurpc.analysis import protocol
+from tpurpc.obs import flight
+
+
+@pytest.fixture(autouse=True)
+def _clean_hook():
+    yield
+    flight.set_verify_hook(None)
+
+
+# -- machines vs. the declared protocols --------------------------------------
+
+def test_good_trace_is_accepted_strict():
+    assert protocol.check_events(protocol._good_trace(), strict=True) == []
+
+
+@pytest.mark.parametrize("mutant", sorted(protocol.machine_mutants()))
+def test_event_order_mutant_is_killed(mutant):
+    trace = protocol.machine_mutants()[mutant]
+    violations = protocol.check_events(trace, strict=True)
+    assert violations, f"event-order mutant {mutant} SURVIVED"
+
+
+def test_self_test_passes():
+    assert protocol.self_test() == []
+
+
+def test_tolerant_mode_absorbs_mid_history():
+    """A dump starting mid-protocol (wrapped ring) must not flag — but an
+    in-dump violation STILL must."""
+    F = flight
+    mid = [protocol._ev(F.MIG_END, tag=4, a1=9, a2=1, t_ns=1)]
+    assert protocol.check_events(mid, strict=False) == []
+    assert protocol.check_events(mid, strict=True)
+    # in-dump violation survives tolerance: claim then an illegal second
+    # write after the lease settled
+    bad = [protocol._ev(F.RDV_CLAIM, tag=2, a1=5, a2=9, t_ns=1),
+           protocol._ev(F.RDV_COMPLETE, tag=2, a1=9, t_ns=2),
+           protocol._ev(F.RDV_WRITE, tag=2, a1=9, t_ns=3)]
+    v = protocol.check_events(bad, strict=False)
+    assert v and v[0].machine == "rdv-lease"
+
+
+def test_real_recorder_roundtrip_conforms():
+    """Events emitted through the real recorder (binary ring, snapshot
+    decode) feed the checker without translation."""
+    rec = flight.FlightRecorder(capacity=64)
+    tag = flight.tag_for("proto-test-entity")
+    rec.emit(flight.GEN_STEP_BEGIN, tag, 2, 0)
+    rec.emit(flight.GEN_STEP_END, tag, 2, 2)
+    rec.emit(flight.MIG_BEGIN, tag, 7, 12)
+    rec.emit(flight.MIG_END, tag, 7, 1)
+    assert protocol.check_events(rec.snapshot(), strict=False) == []
+
+
+# -- dumps --------------------------------------------------------------------
+
+def test_check_dump_file_and_directory(tmp_path):
+    good = protocol._good_trace()
+    f1 = tmp_path / "flight-1.json"
+    f1.write_text(json.dumps(good))
+    n, v = protocol.check_dump(str(f1))
+    assert (n, v) == (len(good), [])
+    # the /debug/flight body shape ({"events": [...]}) and a directory
+    bad = protocol.machine_mutants()["mig_end_without_begin"]
+    (tmp_path / "d").mkdir()
+    (tmp_path / "d" / "flight-2.json").write_text(
+        json.dumps({"events": good}))
+    (tmp_path / "d" / "flight-3.json").write_text(json.dumps(bad))
+    n, v = protocol.check_dump(str(tmp_path / "d"), strict=True)
+    assert n == len(good) + len(bad)
+    assert v, "strict dir check missed the seeded violation"
+    # tolerant (the offline default) skips the mid-history MIG_END
+    n, v = protocol.check_dump(str(tmp_path / "d"))
+    assert v == []
+
+
+# -- the chaos suites' ordering helper ----------------------------------------
+
+def test_assert_ordered_matches_and_returns_events():
+    evs = protocol._good_trace()
+    hits = protocol.assert_ordered(
+        evs, ["conn-connect", "call-first-ok",
+              ("rdv-claim", {"tag": 2, "a2": 501}),
+              ("rdv-complete", {"a1": 501}),
+              "conn-dead"])
+    assert [h["event"] for h in hits] == [
+        "conn-connect", "call-first-ok", "rdv-claim", "rdv-complete",
+        "conn-dead"]
+    assert hits[0]["t_ns"] <= hits[-1]["t_ns"]
+
+
+def test_assert_ordered_rejects_wrong_order_and_since():
+    evs = protocol._good_trace()
+    with pytest.raises(AssertionError):
+        protocol.assert_ordered(evs, ["conn-dead", "conn-connect"])
+    t_dead = next(e["t_ns"] for e in evs if e["event"] == "conn-dead")
+    with pytest.raises(AssertionError):
+        protocol.assert_ordered(evs, ["conn-connect"], since_ns=t_dead)
+
+
+# -- the live verifier --------------------------------------------------------
+
+def test_live_verifier_clean_stream_stays_silent():
+    v = protocol.install_live()
+    tag = flight.tag_for("live-clean-entity")
+    flight.emit(flight.GEN_STEP_BEGIN, tag, 1, 0)
+    flight.emit(flight.GEN_STEP_END, tag, 1, 1)
+    assert v.checked >= 2
+    assert v.violations == []
+
+
+def test_live_verifier_trips_on_violation():
+    from tpurpc.obs import watchdog
+
+    wd = watchdog.get()
+    wd.reset()
+    before = len(wd._history)
+    v = protocol.install_live()
+    tag = flight.tag_for("live-bad-entity")
+    flight.emit(flight.GEN_STEP_BEGIN, tag, 1, 0)
+    flight.emit(flight.GEN_STEP_BEGIN, tag, 2, 0)  # nested begin: illegal
+    assert len(v.violations) == 1
+    assert v.violations[0].machine == "gen-step"
+    # breadcrumb in the ring, watchdog history entry with the stage
+    crumbs = [e for e in flight.snapshot()
+              if e["event"] == "proto-violation" and e["tag"] == tag]
+    assert crumbs and crumbs[-1]["a2"] == flight.GEN_STEP_BEGIN
+    hist = list(wd._history)[before:]
+    assert any(h.get("stage") == "protocol" for h in hist)
+
+
+def test_live_verifier_is_tolerant_of_process_history():
+    """The verifier installs mid-life: events whose openers predate it
+    must not trip (the mid-history contract, live edition)."""
+    v = protocol.install_live()
+    tag = flight.tag_for("live-midlife-entity")
+    flight.emit(flight.MIG_END, tag, 3, 1)  # its BEGIN predates us
+    assert v.violations == []
+
+
+def test_uninstall_live_detaches():
+    protocol.install_live()
+    protocol.uninstall_live()
+    assert protocol.live_verifier() is None
+    tag = flight.tag_for("live-detached-entity")
+    flight.emit(flight.GEN_STEP_BEGIN, tag, 1, 0)  # no verifier: no-op

@@ -24,10 +24,26 @@ else
     note "ruff: SKIP (not installed)"
 fi
 
-# 2) the tpurpc-specific static gate: AST lint + bounded exhaustive ring
-#    model check + mutant kill check (see tpurpc/analysis/)
-note "python -m tpurpc.analysis (lint + ringcheck + mutants)"
+# 2) the tpurpc-specific static gate: AST lint (+ suppression audit) +
+#    bounded exhaustive ring model check + mutant kill check + the
+#    protocol-machine self-test + the quick schedule exploration
+#    (see tpurpc/analysis/)
+note "python -m tpurpc.analysis (lint + ringcheck + mutants + protocol + schedule)"
 python -m tpurpc.analysis || fail=1
+
+# 2a) tpurpc-proof schedule-quick (ISSUE 12): the CHESS-style explorer
+#     over the LIVE classes — every scenario (HandoffRing producers,
+#     DecodeScheduler admission, rendezvous peer-death, KV refcounts)
+#     exhausted clean at preemption bound 1, every seeded real-code
+#     mutant (hoisted publish, removed locks, skipped quarantine) KILLED
+#     by exploration. ~10s, no jax.
+note "tpurpc-proof schedule-quick (deterministic exploration, live code)"
+python -m tpurpc.analysis schedule --quick || fail=1
+
+#     flight dumps from the smokes below land here; the protocol
+#     conformance stage at the end replays them against the declared
+#     event machines (tpurpc-proof, ISSUE 12)
+FLIGHT_DUMPS="$(mktemp -d /tmp/tpurpc-flight-dumps.XXXXXX)"
 
 # 2b) serving-pipeline smoke (ISSUE 3): depth-4 loopback, 32 pipelined
 #     requests over pool AND inline dispatch — every future must complete
@@ -59,7 +75,7 @@ python -m tpurpc.tools.watchdog_smoke || fail=1
 #     zero failed RPCs, hedge + drain flight events present and ordered.
 #     ~3s, no jax.
 note "tpurpc-fleet smoke (kill + drain under hedged traffic)"
-python -m tpurpc.tools.fleet_smoke || fail=1
+TPURPC_FLIGHT_DUMP="$FLIGHT_DUMPS" python -m tpurpc.tools.fleet_smoke || fail=1
 
 # 2f) tpurpc-manycore smoke (ISSUE 7): 2 forked shard workers behind one
 #     SO_REUSEPORT port, pipelined depth-4 traffic — both shards must serve
@@ -75,7 +91,8 @@ python -m tpurpc.tools.shard_smoke || fail=1
 #     stall must be attributed to the `rendezvous` watchdog stage (then
 #     complete via the framed fallback). ~20s (jax on cpu, 2 subprocesses).
 note "tpurpc-express rendezvous smoke (8 MiB, shm + TCP, zero-copy ledger)"
-JAX_PLATFORMS=cpu python -m tpurpc.tools.rendezvous_smoke || fail=1
+TPURPC_FLIGHT_DUMP="$FLIGHT_DUMPS" JAX_PLATFORMS=cpu \
+    python -m tpurpc.tools.rendezvous_smoke || fail=1
 
 # 2g2) tpurpc-cadence smoke (ISSUE 10): interactive + batch clients
 #      stream off one continuous-batching decode server — per-token order
@@ -83,8 +100,12 @@ JAX_PLATFORMS=cpu python -m tpurpc.tools.rendezvous_smoke || fail=1
 #      one shed (with pushback + healthz "shedding") under an
 #      offered-load burst, and an induced slow step attributed to the
 #      `decode-step` watchdog stage. ~5s, no jax.
+#      ... with the LIVE protocol verifier armed (TPURPC_VERIFY_PROTOCOL):
+#      a violated flight machine would emit proto-violation and trip the
+#      watchdog, failing the smoke's healthz/flight assertions
 note "tpurpc-cadence smoke (continuous batching + shed + decode-step)"
-python -m tpurpc.tools.serving_gen_smoke || fail=1
+TPURPC_FLIGHT_DUMP="$FLIGHT_DUMPS" TPURPC_VERIFY_PROTOCOL=1 \
+    python -m tpurpc.tools.serving_gen_smoke || fail=1
 
 # 2g3) tpurpc-keystone smoke (ISSUE 11): one prefill + one decode PROCESS
 #      over shm block grants — the copy ledger must prove the KV blocks
@@ -93,7 +114,21 @@ python -m tpurpc.tools.serving_gen_smoke || fail=1
 #      the process split, and a repeated prompt must score a prefix-cache
 #      hit (warm handoff ships exactly one entry). ~10s, no jax.
 note "tpurpc-keystone disagg smoke (2 processes, zero-copy KV handoff)"
-python -m tpurpc.tools.disagg_smoke || fail=1
+TPURPC_FLIGHT_DUMP="$FLIGHT_DUMPS" python -m tpurpc.tools.disagg_smoke \
+    || fail=1
+
+# 2g4) tpurpc-proof protocol conformance (ISSUE 12): every flight dump
+#      the smokes above produced (fleet, rendezvous, cadence, keystone —
+#      every process, subprocesses included) must conform to the declared
+#      per-entity event machines. Tolerant mode: a dump may begin
+#      mid-history; in-dump transition violations still fail.
+note "tpurpc-proof protocol conformance over the smokes' flight dumps"
+if [ -n "$(ls "$FLIGHT_DUMPS" 2>/dev/null)" ]; then
+    python -m tpurpc.analysis protocol --flight "$FLIGHT_DUMPS" || fail=1
+else
+    note "protocol conformance: SKIP (no dumps produced?)" ; fail=1
+fi
+rm -rf "$FLIGHT_DUMPS"
 
 # 2h) tpurpc-lens smoke (ISSUE 8): streaming + serving burst, then assert
 #     the sampling profiler names >=3 known stages (>=80% attributed), the
@@ -107,8 +142,9 @@ JAX_PLATFORMS=cpu python -m tpurpc.tools.lens_smoke || fail=1
 #    of the concurrency-heavy suites (TPURPC_DEBUG_LOCKS exercises the
 #    CheckedLock shim wired into poller/pair/xds/channel/channelz)
 if python -c "import pytest" >/dev/null 2>&1; then
-    note "pytest tests/test_analysis.py"
-    JAX_PLATFORMS=cpu python -m pytest tests/test_analysis.py -q \
+    note "pytest tests/test_analysis.py tests/test_schedule.py tests/test_protocol.py"
+    JAX_PLATFORMS=cpu python -m pytest tests/test_analysis.py \
+        tests/test_schedule.py tests/test_protocol.py -q \
         -p no:cacheprovider || fail=1
     note "TPURPC_DEBUG_LOCKS=1 pytest (concurrency suites)"
     JAX_PLATFORMS=cpu TPURPC_DEBUG_LOCKS=1 python -m pytest \

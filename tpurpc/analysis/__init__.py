@@ -1,7 +1,8 @@
-"""tpurpc-verify: concurrency lint, runtime lock checking, ring model checking.
+"""tpurpc-verify: concurrency lint, runtime lock checking, ring model
+checking, deterministic schedule exploration, protocol conformance.
 
-Three layers of correctness tooling for the invariants the data plane lives by
-(ARCHITECTURE.md §11 documents the invariants themselves):
+Five layers of correctness tooling for the invariants the data plane lives by
+(ARCHITECTURE.md §11/§21 document the invariants themselves):
 
 * :mod:`tpurpc.analysis.lint` — tpurpc-specific AST passes: lease pairing
   (every ``send_reserve`` reaches commit or abort on all paths), hot-path
@@ -17,12 +18,31 @@ Three layers of correctness tooling for the invariants the data plane lives by
 * :mod:`tpurpc.analysis.ringcheck` — an exhaustive interleaving checker for
   the SPSC ring protocol (single and batched ``write_many`` publishes, wrap,
   credits), with seeded protocol mutants the checker must reject.
+* :mod:`tpurpc.analysis.schedule` — tpurpc-proof (ISSUE 12): a CHESS-style
+  deterministic concurrency explorer that runs the LIVE classes (HandoffRing,
+  DecodeScheduler, RdvLink, KvBlockManager) under a cooperative scheduler
+  with iterative preemption bounding, hooked through the same
+  ``make_lock``/``make_condition`` factory seam TPURPC_DEBUG_LOCKS uses;
+  seeded real-code mutants (:mod:`tpurpc.analysis.schedmutants`) must be
+  found by exploration.
+* :mod:`tpurpc.analysis.protocol` — declared per-entity protocol state
+  machines over flight events, with one conformance checker running offline
+  on dumps (``python -m tpurpc.analysis protocol --flight <dump>``), in
+  tests (``check_events``/``assert_ordered``), and live
+  (``TPURPC_VERIFY_PROTOCOL=1`` — violations trip the stall watchdog).
 
-CLI: ``python -m tpurpc.analysis`` runs lint + the bounded model check and
-exits non-zero on any violation (wired into ``tools/check.sh``).
+CLI: ``python -m tpurpc.analysis`` runs lint (+ suppression audit) + the
+bounded model checks + both new passes and exits non-zero on any violation
+(wired into ``tools/check.sh``).
 """
 
-from tpurpc.analysis.lint import LintViolation, lint_paths, lint_tree  # noqa: F401
+from tpurpc.analysis.lint import (  # noqa: F401
+    LintViolation,
+    audit_suppressions,
+    audit_suppressions_tree,
+    lint_paths,
+    lint_tree,
+)
 from tpurpc.analysis.locks import (  # noqa: F401
     CheckedLock,
     checked_condition,

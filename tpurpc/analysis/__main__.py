@@ -1,21 +1,35 @@
 """``python -m tpurpc.analysis`` — run the verification suite.
 
-Default (no subcommand): AST lint over the whole ``tpurpc`` package + the
-bounded exhaustive ring model check + the mutant kill check. Exit 0 iff all
-pass — ``tools/check.sh`` and CI gate on this.
+Default (no subcommand): AST lint (+ the stale-suppression audit) over
+the whole ``tpurpc`` package + the bounded exhaustive ring model check +
+the mutant kill check + the protocol-machine self-test (good trace
+accepted, seeded event-order mutants killed) + the quick deterministic
+schedule exploration (clean scenarios exhausted at bound 1, seeded
+real-code mutants killed). Exit 0 iff all pass — ``tools/check.sh`` and
+CI gate on this.
 
 Subcommands::
 
     python -m tpurpc.analysis lint [paths...]   # lint only (default: tree)
     python -m tpurpc.analysis ringcheck [--capacity N] [--msgs 1,2,1]
                                         [--batched] [--mutant NAME]
-    python -m tpurpc.analysis mutants           # mutant kill check only
+    python -m tpurpc.analysis mutants           # ring mutant kill check
+    python -m tpurpc.analysis schedule [--quick] [--scenario NAME]
+                                       [--bound K] [--mutant NAME]
+                                       [--max-schedules N]
+    python -m tpurpc.analysis protocol [--flight DUMP] [--strict]
     python -m tpurpc.analysis locks             # how to run the lock detector
+
+``--flight DUMP`` (a ``flight.snapshot()`` JSON file, a ``/debug/flight``
+body, or a ``TPURPC_FLIGHT_DUMP`` directory of them) is also accepted at
+the top level as shorthand for ``protocol --flight DUMP``.
 
 The runtime lock-order detector is not a subcommand of its own — it is the
 ``TPURPC_DEBUG_LOCKS=1`` environment switch, exercised by running any
 workload (the test suite, a bench) with it set; violations print to stderr
-and are queryable via :func:`tpurpc.analysis.locks.lock_violations`.
+and are queryable via :func:`tpurpc.analysis.locks.lock_violations`. The
+live protocol verifier is its sibling switch: ``TPURPC_VERIFY_PROTOCOL=1``
+checks every flight event against the declared machines as it is recorded.
 """
 
 from __future__ import annotations
@@ -28,12 +42,14 @@ from tpurpc.analysis import lint, ringcheck
 
 def _run_lint(paths) -> int:
     violations = (lint.lint_paths(paths) if paths else lint.lint_tree())
+    violations = violations + (lint.audit_suppressions(paths) if paths
+                               else lint.audit_suppressions_tree())
     for v in violations:
         print(v)
     if violations:
         print(f"lint: {len(violations)} violation(s)", file=sys.stderr)
         return 1
-    print("lint: clean")
+    print("lint: clean (incl. suppression audit)")
     return 0
 
 
@@ -68,9 +84,67 @@ def _run_mutants() -> int:
     return 0
 
 
+def _run_schedule(args) -> int:
+    from tpurpc.analysis import schedule
+
+    if args.scenario:
+        res = schedule.run_scenario(
+            args.scenario, preemption_bound=args.bound,
+            max_schedules=args.max_schedules, mutant=args.mutant)
+        print(repr(res))
+        if args.mutant:
+            killed = res.violation is not None
+            print(f"schedule: mutant {args.mutant}: "
+                  f"{'KILLED' if killed else 'SURVIVED'}")
+            return 0 if killed else 1
+        return 0 if res.ok else 1
+    results = schedule.quick_suite(verbose=True)
+    bad = [r for r in results if not r.ok]
+    total = sum(r.schedules for r in results)
+    if bad:
+        print(f"schedule: {len(bad)} failing entr(ies) of {len(results)} "
+              f"({total} schedules)", file=sys.stderr)
+        return 1
+    print(f"schedule: {len(results)} entries clean, {total} schedules "
+          "explored (quick suite, bound 1)")
+    return 0
+
+
+def _run_protocol(flight_path, strict: bool) -> int:
+    from tpurpc.analysis import protocol
+
+    if flight_path:
+        try:
+            total, violations = protocol.check_dump(flight_path,
+                                                    strict=strict)
+        except (OSError, ValueError) as exc:
+            print(f"protocol: cannot read {flight_path}: {exc}",
+                  file=sys.stderr)
+            return 1
+        for v in violations:
+            print(v)
+        if violations:
+            print(f"protocol: {len(violations)} violation(s) over "
+                  f"{total} events in {flight_path}", file=sys.stderr)
+            return 1
+        print(f"protocol: {total} events conform "
+              f"({len(protocol.MACHINES)} machines, "
+              f"{'strict' if strict else 'tolerant'})")
+        return 0
+    failures = protocol.self_test(verbose=True)
+    for f in failures:
+        print(f, file=sys.stderr)
+    return 1 if failures else 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="python -m tpurpc.analysis",
                                  description=__doc__.split("\n\n")[0])
+    ap.add_argument("--flight", default=None, metavar="DUMP",
+                    help="shorthand for: protocol --flight DUMP")
+    ap.add_argument("--strict", action="store_true",
+                    help="with --flight: treat mid-history events as "
+                         "violations (fresh-recorder dumps only)")
     sub = ap.add_subparsers(dest="cmd")
     p_lint = sub.add_parser("lint", help="AST lint (lease/copy/lock/clock)")
     p_lint.add_argument("paths", nargs="*")
@@ -80,7 +154,24 @@ def main(argv=None) -> int:
     p_ring.add_argument("--batched", action="store_true")
     p_ring.add_argument("--mutant", default=None,
                         choices=list(ringcheck.MUTANTS))
-    sub.add_parser("mutants", help="verify seeded mutants are caught")
+    sub.add_parser("mutants", help="verify seeded ring mutants are caught")
+    p_sched = sub.add_parser(
+        "schedule", help="deterministic schedule exploration (live code)")
+    p_sched.add_argument("--quick", action="store_true",
+                         help="bounded quick suite (the default)")
+    p_sched.add_argument("--scenario", default=None,
+                         help="explore one scenario by name")
+    p_sched.add_argument("--bound", type=int, default=2,
+                         help="preemption bound (with --scenario)")
+    p_sched.add_argument("--max-schedules", type=int, default=20000)
+    p_sched.add_argument("--mutant", default=None,
+                         help="apply a seeded real-code mutant")
+    p_proto = sub.add_parser(
+        "protocol", help="flight-event protocol conformance")
+    p_proto.add_argument("--flight", default=None, metavar="DUMP",
+                         help="check a flight dump file or dump directory "
+                              "(default: machine self-test)")
+    p_proto.add_argument("--strict", action="store_true")
     sub.add_parser("locks", help="runtime lock-order detector usage")
     args = ap.parse_args(argv)
 
@@ -90,20 +181,36 @@ def main(argv=None) -> int:
         return _run_ringcheck(args)
     if args.cmd == "mutants":
         return _run_mutants()
+    if args.cmd == "schedule":
+        return _run_schedule(args)
+    if args.cmd == "protocol":
+        return _run_protocol(args.flight, args.strict)
     if args.cmd == "locks":
         print("Runtime lock-order detection is environment-driven:\n"
               "  TPURPC_DEBUG_LOCKS=1 python -m pytest tests/ -q\n"
               "Cycles in the lock acquisition graph, cv-waits holding other "
               "locks,\nand locks held across instrumented blocking calls "
               "print to stderr;\ntpurpc.analysis.locks.lock_violations() "
-              "returns them programmatically.")
+              "returns them programmatically.\n\n"
+              "Live protocol conformance is its sibling:\n"
+              "  TPURPC_VERIFY_PROTOCOL=1 <any workload>\n"
+              "checks every flight event against the declared machines as "
+              "it is\nrecorded; a violation emits a proto-violation flight "
+              "event and trips\nthe stall watchdog (stage `protocol`).")
         return 0
+    if args.flight:
+        return _run_protocol(args.flight, args.strict)
 
-    # default: the full static gate
+    # default: the full static gate — lint + ring models + ring mutants +
+    # protocol machines + quick schedule exploration
     rc = _run_lint(None)
     rc |= _run_ringcheck(argparse.Namespace(capacity=0, msgs="",
                                             batched=False, mutant=None))
     rc |= _run_mutants()
+    rc |= _run_protocol(None, False)
+    rc |= _run_schedule(argparse.Namespace(quick=True, scenario=None,
+                                           bound=1, max_schedules=1500,
+                                           mutant=None))
     return rc
 
 

@@ -77,9 +77,23 @@ hand (ISSUE 2) and that no general-purpose linter knows about:
   memory) forever. ``# tpr: allow(kv)`` marks same-statement ownership
   transfers.
 
+* ``rawlock``  — factory-made locks (tpurpc-proof, ISSUE 12): in a module
+  that imports ``make_lock``/``make_rlock``/``make_condition`` from
+  :mod:`tpurpc.analysis.locks`, constructing ``threading.Lock()`` /
+  ``threading.RLock()`` / ``threading.Condition()`` directly is a blind
+  spot — the raw primitive escapes both ``TPURPC_DEBUG_LOCKS`` lock-order
+  checking and the deterministic schedule explorer's factory seam. Route
+  it through the factory with a ``Class._attr`` name, or carry
+  ``# tpr: allow(rawlock)`` where the raw primitive is the point (the
+  checked-lock implementation itself, post-fork singleton rebuilds).
+
 Suppression grammar: a line comment ``# tpr: allow(<rule>)`` disables that
 rule for its line. The hot-path modules are expected to carry NO ``copy``
 suppressions — a copy on the data plane is either fixed or it is a finding.
+Suppressions are themselves audited (:func:`audit_suppressions`): an
+``allow(rule)`` whose rule would NOT fire on that line with suppressions
+disabled is stale and reported as a ``suppress`` violation — dead
+annotations accrete into camouflage for real ones.
 """
 
 from __future__ import annotations
@@ -139,7 +153,7 @@ FLIGHT_HOT_MODULES = HOT_LOG_MODULES + (
 #: connection — ISSUE 3's no-block-in-dispatch rule). The `block` rule
 #: forbids time.sleep and timeout-less .acquire()/.get()/.wait()/.join()
 #: inside them; bounded-slice waits (an explicit timeout) pass, and a
-#: deliberate exception carries `# tpr: allow(block)`.
+#: deliberate exception carries an allow(block) annotation.
 INLINE_DISPATCH_PATH: Dict[str, Tuple[str, ...]] = {
     os.path.join("tpurpc", "rpc", "server.py"): (
         "_ServerSink.commit",
@@ -177,6 +191,17 @@ _MUTATORS = frozenset({
 
 _ALLOW_RE = re.compile(r"#\s*tpr:\s*allow\(([a-z_,\s]+)\)")
 
+#: every rule an ``allow(...)`` may name (the suppression audit flags
+#: unknown names too — a typo'd rule suppresses nothing forever)
+KNOWN_RULES = frozenset({
+    "lease", "copy", "lock", "wallclock", "block", "log", "shard",
+    "flight", "stage", "rdv", "kv", "rawlock",
+})
+
+#: suppression-audit mode: when True, ``_allowed_rules`` answers empty —
+#: the audit re-lints with suppressions void to learn which would fire
+_AUDIT_IGNORE_SUPPRESSIONS = False
+
 
 class LintViolation:
     __slots__ = ("path", "line", "col", "rule", "message")
@@ -197,6 +222,8 @@ class LintViolation:
 
 def _allowed_rules(source_lines: Sequence[str], line: int) -> Set[str]:
     """Rules suppressed on ``line`` (1-based) via ``# tpr: allow(rule)``."""
+    if _AUDIT_IGNORE_SUPPRESSIONS:
+        return set()
     if 1 <= line <= len(source_lines):
         m = _ALLOW_RE.search(source_lines[line - 1])
         if m:
@@ -768,6 +795,127 @@ def _check_shard(tree: ast.AST, path: str,
     return out
 
 
+# -- rule: rawlock -----------------------------------------------------------
+
+_LOCK_FACTORIES = frozenset({"make_lock", "make_rlock", "make_condition"})
+_RAW_PRIMITIVES = frozenset({"Lock", "RLock", "Condition"})
+
+
+def _imports_lock_factory(tree: ast.AST) -> bool:
+    """Does this module import any lock factory from analysis.locks?"""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if not mod.endswith("locks"):
+                continue
+            if any(alias.name in _LOCK_FACTORIES for alias in node.names):
+                return True
+    return False
+
+
+def _check_rawlock(tree: ast.AST, path: str,
+                   lines: Sequence[str]) -> List[LintViolation]:
+    """tpurpc-proof (ISSUE 12): in a module that already imports the lock
+    factory, a raw ``threading.Lock()``/``RLock()``/``Condition()`` is a
+    verification blind spot — it dodges TPURPC_DEBUG_LOCKS *and* the
+    schedule explorer's factory seam. The decode loop ran unwatched for
+    two PRs exactly this way."""
+    if not _imports_lock_factory(tree):
+        return []
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not (isinstance(f, ast.Attribute) and f.attr in _RAW_PRIMITIVES
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "threading"):
+            continue
+        if "rawlock" in _allowed_rules(lines, node.lineno):
+            continue
+        factory = {"Lock": "make_lock", "RLock": "make_rlock",
+                   "Condition": "make_condition"}[f.attr]
+        out.append(LintViolation(
+            path, node.lineno, node.col_offset, "rawlock",
+            f"raw threading.{f.attr}() in a module that imports the lock "
+            f"factory: TPURPC_DEBUG_LOCKS and the schedule explorer never "
+            f"see it — use {factory}(\"Class._attr\"); a deliberate "
+            "exception carries '# tpr: allow(rawlock)'"))
+    return out
+
+
+# -- the suppression audit ----------------------------------------------------
+
+def find_suppressions(source: str) -> List[Tuple[int, str]]:
+    """Every ``(line, rule)`` named by a real ``# tpr: allow(...)``
+    COMMENT. Tokenized, not regexed over raw lines: docstrings and error
+    messages QUOTE the grammar constantly, and quoting a suppression is
+    not writing one."""
+    import io
+    import tokenize
+
+    out: List[Tuple[int, str]] = []
+    try:
+        tokens = list(tokenize.generate_tokens(
+            io.StringIO(source).readline))
+    except (tokenize.TokenError, SyntaxError, IndentationError):
+        return out
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _ALLOW_RE.search(tok.string)
+        if m:
+            for name in m.group(1).split(","):
+                name = name.strip()
+                if name:
+                    out.append((tok.start[0], name))
+    return out
+
+
+def audit_suppressions_source(source: str, path: str) -> List[LintViolation]:
+    """Report stale suppressions in one module: re-lint with every
+    suppression void, then flag any ``allow(rule)`` whose rule did not
+    fire on that line (plus unknown rule names — a typo suppresses
+    nothing forever). Stale suppressions are gate failures: they read as
+    "this line is a known exception" when nothing is excepted."""
+    sups = find_suppressions(source)
+    if not sups:
+        return []
+    global _AUDIT_IGNORE_SUPPRESSIONS
+    _AUDIT_IGNORE_SUPPRESSIONS = True
+    try:
+        fired = lint_source(source, path)
+    finally:
+        _AUDIT_IGNORE_SUPPRESSIONS = False
+    fired_at = {(v.line, v.rule) for v in fired}
+    out: List[LintViolation] = []
+    for line, rule in sups:
+        if rule not in KNOWN_RULES:
+            out.append(LintViolation(
+                path, line, 0, "suppress",
+                f"suppression names unknown rule '{rule}' "
+                f"(known: {', '.join(sorted(KNOWN_RULES))})"))
+        elif (line, rule) not in fired_at:
+            out.append(LintViolation(
+                path, line, 0, "suppress",
+                f"stale suppression: rule '{rule}' would not fire on this "
+                "line — delete the annotation (dead allows accrete into "
+                "camouflage for live ones)"))
+    return out
+
+
+def audit_suppressions(paths: Iterable[str]) -> List[LintViolation]:
+    out: List[LintViolation] = []
+    for p in paths:
+        with open(p, "r", encoding="utf-8") as f:
+            out.extend(audit_suppressions_source(f.read(), p))
+    return out
+
+
+def audit_suppressions_tree(root: Optional[str] = None) -> List[LintViolation]:
+    return audit_suppressions(_tree_paths(root))
+
+
 # -- rule: lease -------------------------------------------------------------
 
 def _calls_matching(node: ast.AST, needle: str) -> List[ast.Call]:
@@ -1024,6 +1172,7 @@ def lint_source(source: str, path: str,
     out.extend(_check_lease(tree, path, lines))
     out.extend(_check_rdv(tree, path, lines))
     out.extend(_check_kv(tree, path, lines))
+    out.extend(_check_rawlock(tree, path, lines))
     out.sort(key=lambda v: (v.path, v.line, v.col))
     return out
 
@@ -1041,8 +1190,7 @@ def tree_root() -> str:
     return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def lint_tree(root: Optional[str] = None) -> List[LintViolation]:
-    """Lint every ``.py`` under the tpurpc package (the default CLI pass)."""
+def _tree_paths(root: Optional[str] = None) -> List[str]:
     root = root or tree_root()
     paths = []
     for dirpath, dirnames, filenames in os.walk(root):
@@ -1050,4 +1198,9 @@ def lint_tree(root: Optional[str] = None) -> List[LintViolation]:
         for fn in sorted(filenames):
             if fn.endswith(".py"):
                 paths.append(os.path.join(dirpath, fn))
-    return lint_paths(paths)
+    return paths
+
+
+def lint_tree(root: Optional[str] = None) -> List[LintViolation]:
+    """Lint every ``.py`` under the tpurpc package (the default CLI pass)."""
+    return lint_paths(_tree_paths(root))

@@ -175,19 +175,50 @@ class CheckedCondition(threading.Condition):
 
 # -- factories (the wiring surface) ------------------------------------------
 
+#: tpurpc-proof (ISSUE 12): the deterministic schedule explorer
+#: (:mod:`tpurpc.analysis.schedule`) intercepts the factories here — the
+#: SAME seam TPURPC_DEBUG_LOCKS rides — so scenario objects built while an
+#: exploration is active get scheduler-controlled primitives and every
+#: lock/condition operation becomes a scheduling point. ``None`` (the
+#: default, and the only value outside an active exploration) costs one
+#: global load per factory call, all of them at object-construction time.
+_factory_hook = None
+
+
+def set_factory_hook(hook) -> None:
+    """Install (or clear, with ``None``) the exploration factory hook:
+    ``hook(kind, name, lock)`` with kind in ``("lock", "rlock",
+    "condition")`` returns a primitive or ``None`` to decline (the factory
+    then falls through to its normal product)."""
+    global _factory_hook
+    _factory_hook = hook
+
+
 def make_lock(name: str):
     """A mutex for ``name`` (``Class._attr``): plain ``threading.Lock``
     normally, :class:`CheckedLock` under ``TPURPC_DEBUG_LOCKS=1``."""
+    if _factory_hook is not None:
+        got = _factory_hook("lock", name, None)
+        if got is not None:
+            return got
     return CheckedLock(name) if ENABLED else threading.Lock()
 
 
 def make_rlock(name: str):
+    if _factory_hook is not None:
+        got = _factory_hook("rlock", name, None)
+        if got is not None:
+            return got
     return CheckedRLock(name) if ENABLED else threading.RLock()
 
 
 def make_condition(name: str, lock=None):
     """A condition variable; pass ``lock`` to share an existing factory-made
     lock (the Condition then guards the same graph node)."""
+    if _factory_hook is not None:
+        got = _factory_hook("condition", name, lock)
+        if got is not None:
+            return got
     if not ENABLED:
         return threading.Condition(lock)
     return CheckedCondition(name, lock)

@@ -1,0 +1,783 @@
+"""tpurpc-proof: declared protocol state machines over flight events.
+
+Every chaos test and smoke so far asserted flight-event orderings with a
+hand-rolled expected-sequence list — correct once, unreadable forever,
+and useless outside its own test. This module makes the orderings
+first-class: each transport protocol's per-entity lifecycle is a DECLARED
+state machine over the flight vocabulary (:mod:`tpurpc.obs.flight`), and
+one conformance checker runs every machine over any event stream —
+
+* **offline**, on a flight dump (``python -m tpurpc.analysis protocol
+  --flight <dump.json|dir>`` — also reachable as the top-level
+  ``--flight`` convenience), replaying a postmortem against the declared
+  protocols;
+* **in tests**, via :func:`check_events` (strict) and
+  :func:`assert_ordered` — the helper the chaos suites build their
+  flight assertions from instead of per-test sequence lists;
+* **live**, opt-in via ``TPURPC_VERIFY_PROTOCOL=1``: a tap inside
+  ``FlightRecorder.emit`` feeds every event to the machines as it is
+  recorded; a violated machine emits a ``proto-violation`` flight event
+  and trips the stall watchdog (stage ``protocol``). Cost when off: one
+  global None-check per emitted event — and events are EDGES, so a
+  healthy loop pays nothing either way (the <3% bench overhead bar is
+  measured with the verifier ON).
+
+Machine grammar
+---------------
+
+A :class:`Machine` declares ``token(ev)`` (event → symbolic token, or
+``None`` to ignore), ``key(ev)`` (the per-entity instance key),
+``openers`` (tokens that may create an instance) and ``transitions``
+mapping ``(state, token) -> state``; reaching a state in ``terminal``
+retires the instance. A token with no transition from the current state
+is a violation; a non-opener token for an unknown key is a violation in
+STRICT mode (fresh recorders: tests, smokes) and silently skipped in
+tolerant mode (wrapped/truncated production dumps, the live verifier —
+which by construction starts mid-history). An instance still open at the
+end of a dump is NEVER a violation: dumps end mid-flight legitimately.
+
+The declared machines (:data:`MACHINES`) cover the rendezvous lease and
+offer lifecycles (events 33–37), KV swap brackets, ship handoffs and
+live migration (45–54), decode step brackets (38–39), hedging, drain,
+and subchannel ejection (21–28), and the client connection lifecycle
+(17–19 with 15/16).
+
+Seeded event-order mutants (:func:`mutant_kill_suite` — e.g.
+COMPLETE-before-WRITE, MIG_END-without-MIG_BEGIN) prove the machines
+have teeth; they ride the default analysis gate next to ringcheck's.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from tpurpc.obs import flight as _flight
+
+__all__ = [
+    "Machine", "ProtocolViolation", "MACHINES",
+    "check_events", "check_dump", "load_dump", "assert_ordered",
+    "machine_mutants", "mutant_kill_suite", "self_test",
+    "LiveVerifier", "install_live", "uninstall_live", "live_verifier",
+]
+
+
+class ProtocolViolation:
+    __slots__ = ("machine", "key", "state", "token", "event", "message",
+                 "t_ns")
+
+    def __init__(self, machine: str, key, state: Optional[str], token: str,
+                 event: dict, message: str):
+        self.machine = machine
+        self.key = key
+        self.state = state
+        self.token = token
+        self.event = event
+        self.message = message
+        self.t_ns = event.get("t_ns", 0)
+
+    def __repr__(self) -> str:
+        return (f"{self.machine}[{self.key}]: {self.message} "
+                f"(state={self.state!r}, token={self.token!r}, "
+                f"event={self.event.get('event')!r} "
+                f"a1={self.event.get('a1')} a2={self.event.get('a2')})")
+
+    __str__ = __repr__
+
+
+class Machine:
+    """One declared per-entity protocol (see the module docstring for the
+    grammar). ``token``/``key`` are callables over the event dict shape
+    :func:`tpurpc.obs.flight.snapshot` produces."""
+
+    def __init__(self, name: str,
+                 token: Callable[[dict], Optional[str]],
+                 key: Callable[[dict], Optional[tuple]],
+                 openers: Dict[str, str],
+                 transitions: Dict[Tuple[str, str], str],
+                 terminal: Sequence[str] = ("done",),
+                 describe: str = ""):
+        self.name = name
+        self.token = token
+        self.key = key
+        self.openers = dict(openers)      # token -> state it opens into
+        self.transitions = dict(transitions)
+        self.terminal = frozenset(terminal)
+        self.describe = describe
+
+    def tokens(self) -> frozenset:
+        toks = set(self.openers)
+        for (_s, t) in self.transitions:
+            toks.add(t)
+        return frozenset(toks)
+
+
+class _Checker:
+    """Runs every machine over one event stream (instances keyed per
+    machine per entity). Settled instances stay tracked in their terminal
+    state — a post-settle event is a KNOWN entity misbehaving (the
+    complete-before-write signature) even in tolerant mode; an opener on
+    a settled instance reopens it (lease-id reuse, re-dials)."""
+
+    #: instance cap (live verifier runs for the process lifetime): when
+    #: exceeded, the oldest tracked instances are forgotten — tolerance
+    #: degrades gracefully, never memory
+    MAX_INSTANCES = 8192
+
+    def __init__(self, machines: Sequence[Machine], strict: bool):
+        self.machines = list(machines)
+        self.strict = strict
+        self.state: Dict[Tuple[str, tuple], str] = {}
+        self.violations: List[ProtocolViolation] = []
+
+    def feed(self, ev: dict) -> List[ProtocolViolation]:
+        fresh: List[ProtocolViolation] = []
+        for m in self.machines:
+            token = m.token(ev)
+            if token is None:
+                continue
+            key = m.key(ev)
+            if key is None:
+                continue
+            sk = (m.name, key)
+            cur = self.state.get(sk)
+            if cur is None:
+                opened = m.openers.get(token)
+                if opened is not None:
+                    self.state[sk] = opened
+                    self._bound()
+                    continue
+                if self.strict:
+                    fresh.append(ProtocolViolation(
+                        m.name, key, None, token, ev,
+                        f"'{token}' without a preceding opener "
+                        f"({'/'.join(sorted(m.openers))})"))
+                continue
+            nxt = m.transitions.get((cur, token))
+            if nxt is None and cur in m.terminal and token in m.openers:
+                nxt = m.openers[token]  # reopen a settled instance
+            if nxt is None:
+                fresh.append(ProtocolViolation(
+                    m.name, key, cur, token, ev,
+                    f"'{token}' is not a legal transition from "
+                    f"'{cur}'"))
+                continue
+            self.state[sk] = nxt
+        self.violations.extend(fresh)
+        return fresh
+
+    def _bound(self) -> None:
+        while len(self.state) > self.MAX_INSTANCES:
+            self.state.pop(next(iter(self.state)))
+
+    def open_instances(self) -> Dict[Tuple[str, tuple], str]:
+        terminals = {m.name: m.terminal for m in self.machines}
+        return {k: v for k, v in self.state.items()
+                if v not in terminals.get(k[0], frozenset())}
+
+
+# ---------------------------------------------------------------------------
+# The declared machines.
+# ---------------------------------------------------------------------------
+
+def _mk_rdv_lease() -> Machine:
+    F = _flight
+
+    def token(ev):
+        c = ev.get("code")
+        if c == F.RDV_CLAIM:
+            return "claim"
+        if c == F.RDV_WRITE:
+            return "write"
+        if c == F.RDV_COMPLETE:
+            return "complete"
+        if c == F.RDV_RELEASE:
+            return "release" if ev.get("a1") else None
+        return None
+
+    def key(ev):
+        c = ev.get("code")
+        lease = ev.get("a2") if c == F.RDV_CLAIM else ev.get("a1")
+        if not lease:
+            return None
+        return (ev.get("tag"), lease)
+
+    return Machine(
+        "rdv-lease", token, key,
+        openers={"claim": "claimed"},
+        transitions={
+            # sender side: claim -> write -> complete; receiver side never
+            # emits write, so claimed -> complete is legal too. A WRITE
+            # after the lease settled (the complete-before-write mutant's
+            # signature) and any double-settle are violations.
+            ("claimed", "write"): "written",
+            ("claimed", "complete"): "done",
+            ("claimed", "release"): "done",
+            ("written", "complete"): "done",
+            ("written", "release"): "done",
+        },
+        describe="one-sided landing-region lease: claim, at most one "
+                 "solicited write, exactly one settle (complete/release)")
+
+
+def _mk_rdv_offer() -> Machine:
+    F = _flight
+
+    def token(ev):
+        c = ev.get("code")
+        if c == F.RDV_OFFER:
+            return "offer"
+        if c == F.RDV_CLAIM:
+            return "claim" if ev.get("a1") else None
+        if c == F.RDV_RELEASE:
+            # a1=0/a2=req is the abandoned-offer release
+            return "abandon" if (not ev.get("a1") and ev.get("a2")) else None
+        return None
+
+    def key(ev):
+        c = ev.get("code")
+        req = ev.get("a2") if c == F.RDV_RELEASE else ev.get("a1")
+        if not req:
+            return None
+        return (ev.get("tag"), req)
+
+    return Machine(
+        "rdv-offer", token, key,
+        openers={"offer": "offered"},
+        transitions={
+            ("offered", "claim"): "done",
+            ("offered", "abandon"): "done",
+        },
+        describe="solicited transfer negotiation: every claim/abandon "
+                 "answers exactly one offer")
+
+
+def _mk_kv_swap() -> Machine:
+    F = _flight
+
+    def token(ev):
+        c = ev.get("code")
+        if c == F.KV_SWAP_BEGIN:
+            return "begin-out" if ev.get("a2") == 0 else "begin-in"
+        if c == F.KV_SWAP_END:
+            return "end-out" if ev.get("a2") == 0 else "end-in"
+        return None
+
+    def key(ev):
+        return (ev.get("tag"), ev.get("a1"))
+
+    return Machine(
+        "kv-swap", token, key,
+        openers={"begin-out": "swapping-out", "begin-in": "swapping-in"},
+        transitions={
+            ("swapping-out", "end-out"): "done",
+            ("swapping-in", "end-in"): "done",
+        },
+        describe="swap brackets pair per sequence and direction; no "
+                 "nesting, no END without BEGIN")
+
+
+def _mk_migration() -> Machine:
+    F = _flight
+
+    def token(ev):
+        c = ev.get("code")
+        if c == F.MIG_BEGIN:
+            return "begin"
+        if c == F.MIG_END:
+            return "end"
+        return None
+
+    def key(ev):
+        return (ev.get("tag"), ev.get("a1"))
+
+    return Machine(
+        "migration", token, key,
+        openers={"begin": "migrating"},
+        transitions={("migrating", "end"): "done"},
+        describe="live migration brackets pair per sequence: MIG_END "
+                 "always answers a MIG_BEGIN, never nests")
+
+
+def _mk_kv_ship() -> Machine:
+    F = _flight
+
+    def token(ev):
+        c = ev.get("code")
+        if c == F.KV_SHIP_OFFER:
+            return "offer"
+        if c == F.KV_SHIP_COMPLETE:
+            return "complete"
+        return None
+
+    def key(ev):
+        h = ev.get("a1")
+        if not h:
+            return None
+        return (ev.get("tag"), h)
+
+    return Machine(
+        "kv-ship", token, key,
+        openers={"offer": "offered"},
+        transitions={("offered", "complete"): "done"},
+        describe="block-granular KV handoff: COMPLETE answers exactly "
+                 "one OFFER per handoff id")
+
+
+def _mk_gen_step() -> Machine:
+    F = _flight
+
+    def token(ev):
+        c = ev.get("code")
+        if c == F.GEN_STEP_BEGIN:
+            return "begin"
+        if c == F.GEN_STEP_END:
+            return "end"
+        return None
+
+    def key(ev):
+        return (ev.get("tag"),)
+
+    return Machine(
+        "gen-step", token, key,
+        openers={"begin": "stepping"},
+        transitions={("stepping", "end"): "done"},
+        describe="device-step brackets strictly alternate per scheduler "
+                 "(the loop is single-threaded by construction)")
+
+
+def _mk_hedge() -> Machine:
+    F = _flight
+
+    def token(ev):
+        c = ev.get("code")
+        if c == F.HEDGE_FIRED:
+            return "fired"
+        if c == F.HEDGE_WON:
+            return "won"
+        if c == F.HEDGE_CANCELLED:
+            return "cancelled"
+        return None
+
+    def key(ev):
+        return (ev.get("tag"),)
+
+    # per-call-tag view: hedges fire, one attempt wins, losers cancel.
+    # Counting is out of a finite machine's reach; the ordering claims —
+    # nothing settles before something fired — are exactly what the
+    # chaos tests asserted by hand.
+    return Machine(
+        "hedge", token, key,
+        openers={"fired": "hedging"},
+        transitions={
+            ("hedging", "fired"): "hedging",
+            ("hedging", "cancelled"): "hedging",
+            ("hedging", "won"): "settled",
+            ("settled", "cancelled"): "settled",
+            ("settled", "won"): "settled",
+            ("settled", "fired"): "hedging",
+        },
+        describe="no hedge settles (won/cancelled) before one fired on "
+                 "the call")
+
+
+def _mk_drain() -> Machine:
+    F = _flight
+
+    def token(ev):
+        c = ev.get("code")
+        if c == F.DRAIN_BEGIN:
+            return "begin"
+        if c == F.DRAIN_END:
+            return "end"
+        return None
+
+    def key(ev):
+        return (ev.get("tag"),)
+
+    return Machine(
+        "drain", token, key,
+        openers={"begin": "draining"},
+        transitions={("draining", "end"): "done"},
+        describe="drain brackets pair per server; no END without BEGIN")
+
+
+def _mk_subch() -> Machine:
+    F = _flight
+
+    def token(ev):
+        c = ev.get("code")
+        if c == F.SUBCH_EJECT:
+            return "eject"
+        if c == F.SUBCH_REINSTATE:
+            return "reinstate"
+        return None
+
+    def key(ev):
+        return (ev.get("tag"), ev.get("a1"))
+
+    return Machine(
+        "subchannel", token, key,
+        openers={"eject": "ejected"},
+        transitions={("ejected", "reinstate"): "done"},
+        describe="outlier ejection pairs: reinstate answers eject, no "
+                 "double-eject")
+
+
+def _mk_conn() -> Machine:
+    F = _flight
+
+    def token(ev):
+        c = ev.get("code")
+        if c == F.CONN_CONNECT:
+            return "connect"
+        if c == F.CALL_FIRST_OK:
+            return "first-ok"
+        if c == F.CONN_DEAD:
+            return "dead"
+        return None
+
+    def key(ev):
+        return (ev.get("tag"),)
+
+    # the tag is "conn:<peer>" — SHARED by every connection instance to
+    # one peer, so several lifecycles interleave under one key. The
+    # machine is therefore a per-peer hub: once any connection to the
+    # peer existed, further first-OK/death events are legal in any
+    # interleaving; what it still proves (strictly) is that NOTHING —
+    # no first-OK, no death — precedes the peer's first connect.
+    return Machine(
+        "conn", token, key,
+        openers={"connect": "connected"},
+        transitions={
+            ("connected", "first-ok"): "serving",
+            ("connected", "dead"): "done",
+            ("serving", "dead"): "done",
+            ("serving", "first-ok"): "serving",
+            ("done", "dead"): "done",
+            ("done", "first-ok"): "done",
+            ("connected", "connect"): "connected",
+            ("serving", "connect"): "connected",
+        },
+        describe="per-peer connection lifecycle: no first-OK or death "
+                 "before the peer's first connect")
+
+
+#: every declared machine, in evaluation order
+MACHINES: List[Machine] = [
+    _mk_rdv_lease(), _mk_rdv_offer(), _mk_kv_swap(), _mk_migration(),
+    _mk_kv_ship(), _mk_gen_step(), _mk_hedge(), _mk_drain(), _mk_subch(),
+    _mk_conn(),
+]
+
+
+# ---------------------------------------------------------------------------
+# Offline conformance.
+# ---------------------------------------------------------------------------
+
+def check_events(events: Iterable[dict], strict: bool = True,
+                 machines: Optional[Sequence[Machine]] = None
+                 ) -> List[ProtocolViolation]:
+    """Run every machine over a time-ordered event stream (the
+    :func:`tpurpc.obs.flight.snapshot` dict shape). ``strict=False``
+    tolerates streams that begin mid-history (wrapped rings, production
+    dumps): non-opener events for unknown entities are skipped instead of
+    flagged."""
+    chk = _Checker(machines if machines is not None else MACHINES, strict)
+    for ev in sorted(events, key=lambda e: e.get("t_ns", 0)):
+        chk.feed(ev)
+    return chk.violations
+
+
+def load_dump(path: str) -> List[dict]:
+    """Events from one flight dump file: a JSON list of event dicts, or
+    any JSON object carrying them under an ``events`` key (the
+    ``/debug/flight`` body shape)."""
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    if isinstance(data, dict):
+        data = data.get("events", [])
+    if not isinstance(data, list):
+        raise ValueError(f"{path}: not a flight dump (want a list of "
+                        "events or {'events': [...]})")
+    return data
+
+
+def check_dump(path: str, strict: bool = False
+               ) -> Tuple[int, List[ProtocolViolation]]:
+    """Conformance over one dump file, or every ``*.json`` in a directory
+    (the ``TPURPC_FLIGHT_DUMP`` output layout). Returns
+    ``(events_checked, violations)``. Offline dumps default to TOLERANT:
+    a dump may start mid-history."""
+    paths: List[str] = []
+    if os.path.isdir(path):
+        for fn in sorted(os.listdir(path)):
+            if fn.endswith(".json"):
+                paths.append(os.path.join(path, fn))
+    else:
+        paths.append(path)
+    total = 0
+    out: List[ProtocolViolation] = []
+    for p in paths:
+        events = load_dump(p)
+        total += len(events)
+        out.extend(check_events(events, strict=strict))
+    return total, out
+
+
+# ---------------------------------------------------------------------------
+# The test-suite helper (replaces the hand-rolled expected-order lists).
+# ---------------------------------------------------------------------------
+
+def assert_ordered(events: Sequence[dict], steps: Sequence,
+                   since_ns: int = 0) -> List[dict]:
+    """Assert ``steps`` occur in time order within ``events`` and return
+    the matched events. Each step is an event NAME (``"conn-dead"``), a
+    tuple of alternative names (``("conn-dead", "peer-death")``), or
+    either paired with a ``{field: value}`` filter constraining
+    ``tag``/``a1``/``a2``/…; matching is first-at-or-after the previous
+    step's stamp. The chaos suites build their flight-order assertions
+    from this ONE helper plus :func:`check_events` over the same
+    snapshot — the declared machines carry the per-entity legality, this
+    carries the cross-entity ordering."""
+    t = since_ns
+    matched: List[dict] = []
+    ordered = sorted(events, key=lambda e: e.get("t_ns", 0))
+    for step in steps:
+        if isinstance(step, str):
+            names, where = (step,), {}
+        elif (len(step) == 2 and isinstance(step[1], dict)):
+            names = ((step[0],) if isinstance(step[0], str)
+                     else tuple(step[0]))
+            where = step[1]
+        else:
+            names, where = tuple(step), {}
+        hit = None
+        for ev in ordered:
+            if ev.get("t_ns", 0) < t or ev.get("event") not in names:
+                continue
+            if all(ev.get(k) == v for k, v in where.items()):
+                hit = ev
+                break
+        if hit is None:
+            seen = [e.get("event") for e in ordered
+                    if e.get("t_ns", 0) >= since_ns]
+            raise AssertionError(
+                f"flight order: no {'/'.join(names)} matching {where} "
+                f"at/after t={t} (events after since_ns: {seen})")
+        matched.append(hit)
+        t = hit.get("t_ns", 0)
+    return matched
+
+
+# ---------------------------------------------------------------------------
+# Seeded event-order mutants: the machines must have teeth.
+# ---------------------------------------------------------------------------
+
+def _ev(code: int, tag: int = 7, a1: int = 0, a2: int = 0,
+        t_ns: int = 0) -> dict:
+    return {"t_ns": t_ns, "code": code, "event":
+            _flight.EVENT_NAMES.get(code, "?"), "tag": tag,
+            "entity": "-", "tid": 0, "a1": a1, "a2": a2}
+
+
+def _good_trace() -> List[dict]:
+    """A synthesized clean run exercising every machine — the self-test's
+    'the machines accept the declared protocols' half."""
+    F = _flight
+    t = iter(range(1, 10_000))
+    e = []
+    # connection up, serving, down
+    e += [_ev(F.CONN_CONNECT, tag=1, t_ns=next(t)),
+          _ev(F.CALL_FIRST_OK, tag=1, t_ns=next(t))]
+    # solicited rendezvous transfer, then an abandoned offer
+    e += [_ev(F.RDV_OFFER, tag=2, a1=11, a2=1 << 20, t_ns=next(t)),
+          _ev(F.RDV_CLAIM, tag=2, a1=11, a2=501, t_ns=next(t)),
+          _ev(F.RDV_WRITE, tag=2, a1=501, a2=1 << 20, t_ns=next(t)),
+          _ev(F.RDV_COMPLETE, tag=2, a1=501, a2=1 << 20, t_ns=next(t)),
+          _ev(F.RDV_OFFER, tag=2, a1=12, a2=1 << 20, t_ns=next(t)),
+          _ev(F.RDV_RELEASE, tag=2, a1=0, a2=12, t_ns=next(t))]
+    # receiver-side lease: claim then complete (no write event)
+    e += [_ev(F.RDV_OFFER, tag=3, a1=21, a2=1 << 18, t_ns=next(t)),
+          _ev(F.RDV_CLAIM, tag=3, a1=21, a2=601, t_ns=next(t)),
+          _ev(F.RDV_COMPLETE, tag=3, a1=601, a2=1 << 18, t_ns=next(t))]
+    # decode steps bracketing a swap-out/in pair and one migration
+    e += [_ev(F.GEN_STEP_BEGIN, tag=4, a1=2, t_ns=next(t)),
+          _ev(F.GEN_STEP_END, tag=4, a1=2, a2=2, t_ns=next(t)),
+          _ev(F.KV_SWAP_BEGIN, tag=5, a1=9, a2=0, t_ns=next(t)),
+          _ev(F.KV_SWAP_END, tag=5, a1=9, a2=0, t_ns=next(t)),
+          _ev(F.KV_SWAP_BEGIN, tag=5, a1=9, a2=1, t_ns=next(t)),
+          _ev(F.KV_SWAP_END, tag=5, a1=9, a2=1, t_ns=next(t)),
+          _ev(F.MIG_BEGIN, tag=4, a1=9, a2=40, t_ns=next(t)),
+          _ev(F.MIG_END, tag=4, a1=9, a2=1, t_ns=next(t)),
+          _ev(F.KV_SHIP_OFFER, tag=5, a1=77, a2=4096, t_ns=next(t)),
+          _ev(F.KV_SHIP_COMPLETE, tag=5, a1=77, a2=4096, t_ns=next(t))]
+    # hedging, drain, ejection
+    e += [_ev(F.HEDGE_FIRED, tag=6, a1=1, t_ns=next(t)),
+          _ev(F.HEDGE_WON, tag=6, a1=0, t_ns=next(t)),
+          _ev(F.HEDGE_CANCELLED, tag=6, a1=1, t_ns=next(t)),
+          _ev(F.DRAIN_BEGIN, tag=1, a1=3, t_ns=next(t)),
+          _ev(F.DRAIN_END, tag=1, a1=0, t_ns=next(t)),
+          _ev(F.SUBCH_EJECT, tag=6, a1=2, a2=0, t_ns=next(t)),
+          _ev(F.SUBCH_REINSTATE, tag=6, a1=2, t_ns=next(t)),
+          _ev(F.CONN_DEAD, tag=1, a1=1, t_ns=next(t))]
+    return e
+
+
+def machine_mutants() -> Dict[str, List[dict]]:
+    """Seeded BAD traces, each violating one declared protocol — the
+    machines must flag every one (and accept :func:`_good_trace`)."""
+    F = _flight
+    return {
+        # the acceptance-named pair first
+        "complete_before_write": [
+            _ev(F.RDV_OFFER, tag=2, a1=11, a2=1 << 20, t_ns=1),
+            _ev(F.RDV_CLAIM, tag=2, a1=11, a2=501, t_ns=2),
+            _ev(F.RDV_COMPLETE, tag=2, a1=501, a2=1 << 20, t_ns=3),
+            _ev(F.RDV_WRITE, tag=2, a1=501, a2=1 << 20, t_ns=4),
+        ],
+        "mig_end_without_begin": [
+            _ev(F.GEN_STEP_BEGIN, tag=4, a1=1, t_ns=1),
+            _ev(F.GEN_STEP_END, tag=4, a1=1, t_ns=2),
+            _ev(F.MIG_END, tag=4, a1=9, a2=1, t_ns=3),
+        ],
+        "double_claim": [
+            _ev(F.RDV_OFFER, tag=2, a1=11, a2=1 << 20, t_ns=1),
+            _ev(F.RDV_CLAIM, tag=2, a1=11, a2=501, t_ns=2),
+            _ev(F.RDV_CLAIM, tag=2, a1=11, a2=502, t_ns=3),
+        ],
+        "swap_end_wrong_direction": [
+            _ev(F.KV_SWAP_BEGIN, tag=5, a1=9, a2=0, t_ns=1),
+            _ev(F.KV_SWAP_END, tag=5, a1=9, a2=1, t_ns=2),
+        ],
+        "nested_step_begin": [
+            _ev(F.GEN_STEP_BEGIN, tag=4, a1=1, t_ns=1),
+            _ev(F.GEN_STEP_BEGIN, tag=4, a1=2, t_ns=2),
+        ],
+        "drain_end_without_begin": [
+            _ev(F.CONN_CONNECT, tag=1, t_ns=1),
+            _ev(F.DRAIN_END, tag=1, a1=0, t_ns=2),
+        ],
+        "hedge_won_before_fired": [
+            _ev(F.HEDGE_WON, tag=6, a1=1, t_ns=1),
+            _ev(F.HEDGE_FIRED, tag=6, a1=1, t_ns=2),
+        ],
+        "reinstate_without_eject": [
+            _ev(F.SUBCH_EJECT, tag=6, a1=1, t_ns=1),
+            _ev(F.SUBCH_REINSTATE, tag=6, a1=2, t_ns=2),
+        ],
+        "ship_complete_unoffered": [
+            _ev(F.KV_SHIP_OFFER, tag=5, a1=77, a2=4096, t_ns=1),
+            _ev(F.KV_SHIP_COMPLETE, tag=5, a1=78, a2=4096, t_ns=2),
+        ],
+        "first_ok_without_connect": [
+            _ev(F.CALL_FIRST_OK, tag=1, t_ns=1),
+        ],
+    }
+
+
+def mutant_kill_suite(verbose: bool = False) -> Dict[str, bool]:
+    kills: Dict[str, bool] = {}
+    for name, trace in sorted(machine_mutants().items()):
+        v = check_events(trace, strict=True)
+        kills[name] = bool(v)
+        if verbose:
+            print(f"protocol mutant {name}: "
+                  f"{'KILLED' if v else 'SURVIVED'}"
+                  + (f" ({v[0]})" if v else ""))
+    return kills
+
+
+def self_test(verbose: bool = False) -> List[str]:
+    """The default-gate protocol pass: the good trace must check clean
+    (strict) and every seeded event-order mutant must be flagged.
+    Returns failure strings (empty = pass)."""
+    failures: List[str] = []
+    good = check_events(_good_trace(), strict=True)
+    if good:
+        failures.extend(f"good trace rejected: {v}" for v in good)
+    for name, killed in mutant_kill_suite(verbose=verbose).items():
+        if not killed:
+            failures.append(f"event-order mutant SURVIVED: {name}")
+    if verbose and not failures:
+        print(f"protocol: {len(MACHINES)} machines, good trace clean, "
+              f"{len(machine_mutants())} seeded mutants killed")
+    return failures
+
+
+# ---------------------------------------------------------------------------
+# The live verifier (TPURPC_VERIFY_PROTOCOL=1).
+# ---------------------------------------------------------------------------
+
+class LiveVerifier:
+    """Feeds every recorded flight event through the machines as it is
+    emitted (tolerant mode: the process's history predates us). On a
+    violation: one ``proto-violation`` flight event (a1 = machine index,
+    a2 = offending code) and one stall-watchdog external trip naming the
+    machine. Violations are also kept (bounded) for tests and
+    ``/debug``-style introspection."""
+
+    MAX_KEPT = 256
+
+    def __init__(self, machines: Optional[Sequence[Machine]] = None):
+        self._chk = _Checker(machines if machines is not None else MACHINES,
+                             strict=False)
+        self._mu = threading.Lock()
+        self.violations: List[ProtocolViolation] = []
+        self.checked = 0
+
+    def __call__(self, code: int, tag: int, a1: int, a2: int) -> None:
+        if code == _flight.PROTO_VIOLATION:
+            return  # our own breadcrumb
+        ev = {"t_ns": 0, "code": code,
+              "event": _flight.EVENT_NAMES.get(code, "?"),
+              "tag": tag, "a1": a1, "a2": a2}
+        with self._mu:
+            self.checked += 1
+            fresh = self._chk.feed(ev)
+            if fresh and len(self.violations) < self.MAX_KEPT:
+                self.violations.extend(fresh)
+        for v in fresh:
+            self._report(v, code, tag)
+
+    def _report(self, v: ProtocolViolation, code: int, tag: int) -> None:
+        try:
+            idx = next((i for i, m in enumerate(self._chk.machines)
+                        if m.name == v.machine), 0)
+            _flight.emit(_flight.PROTO_VIOLATION, tag, idx, code)
+            from tpurpc.obs import watchdog as _watchdog
+
+            _watchdog.get().external_trip(
+                "protocol", f"machine:{v.machine}", str(v))
+        except Exception:
+            pass  # verification must never take the transport down
+
+
+def install_live(machines: Optional[Sequence[Machine]] = None
+                 ) -> LiveVerifier:
+    """Arm the live verifier on the process-wide flight recorder (the
+    ``TPURPC_VERIFY_PROTOCOL=1`` switch calls this from flight.py)."""
+    v = LiveVerifier(machines)
+    _flight.set_verify_hook(v)
+    return v
+
+
+def uninstall_live() -> None:
+    _flight.set_verify_hook(None)
+
+
+def live_verifier() -> Optional[LiveVerifier]:
+    hook = _flight.verify_hook()
+    return hook if isinstance(hook, LiveVerifier) else None
+
+
+# TPURPC_VERIFY_PROTOCOL=1 arming happens on whichever side finishes
+# importing LAST: flight.py's bottom installs when flight is imported
+# first (the common order); when THIS module is imported first, flight's
+# attempt sees a partially initialized protocol and declines — so we
+# install here once the module is whole.
+if (os.environ.get("TPURPC_VERIFY_PROTOCOL", "") == "1"
+        and _flight.verify_hook() is None):
+    install_live()

@@ -1,0 +1,216 @@
+"""tpurpc-proof: seeded REAL-CODE concurrency mutants for the explorer.
+
+Each mutant here is a faithful copy of a live method with exactly one
+concurrency discipline removed — a hoisted publish, a deleted lock, a
+skipped death-path quarantine. :mod:`tpurpc.analysis.schedule` must find
+every one of them *by exploration* (a violating interleaving, not a
+sequential unit test): that is the proof the explorer has teeth, and the
+"runtime matches model" guarantee ringcheck's hand-written models alone
+cannot give.
+
+This module's file is added to the instrumented set whenever a mutant is
+active, so the mutated lines get the same line-granular scheduling
+points as the originals.
+
+The copies are deliberately line-for-line with their sources (see each
+docstring for the source function) so the ONLY behavioral difference is
+the seeded bug; drift between a mutant and its source weakens the kill
+claim, nothing else.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict
+
+__all__ = ["Mutant", "SCHED_MUTANTS"]
+
+
+class Mutant:
+    """One seeded real-code mutant: ``applied()`` patches ``target.attr``
+    to the mutated copy for the duration of an exploration."""
+
+    def __init__(self, name: str, scenario: str, target, attr: str,
+                 repl, description: str):
+        self.name = name
+        self.scenario = scenario
+        self.target = target
+        self.attr = attr
+        self.repl = repl
+        self.description = description
+
+    @contextlib.contextmanager
+    def applied(self):
+        orig = getattr(self.target, self.attr)
+        setattr(self.target, self.attr, self.repl)
+        try:
+            yield self
+        finally:
+            setattr(self.target, self.attr, orig)
+
+
+# ---------------------------------------------------------------------------
+# handoff_publish_before_store — HandoffRing.publish with the commit stamp
+# HOISTED above the payload store (the modeled handoff_commit_before_write
+# mutant, seeded into the implementation).
+# ---------------------------------------------------------------------------
+
+def _handoff_publish_before_store(self, item, timeout=None):
+    t = next(self._ticket)
+    slot = t % self._cap
+    deadline = None if timeout is None else time.monotonic() + timeout
+    while self._seq[slot] != t:
+        if self._closed:
+            return False
+        if deadline is not None and time.monotonic() >= deadline:
+            return False
+        self._space_evt.wait(0.01)
+        self._space_evt.clear()
+    if self._closed:
+        return False
+    self._seq[slot] = t + 1  # MUTANT: publish hoisted above the payload
+    self._slots[slot] = item
+    self._data_evt.set()
+    return True
+
+
+# ---------------------------------------------------------------------------
+# scheduler_unlocked_submit — DecodeScheduler.submit with `with self._lock`
+# REMOVED: the waiting-queue append races the boundary's locked
+# decide/clear/extend edit (lost submits, deque-mutated-during-iteration).
+# ---------------------------------------------------------------------------
+
+def _scheduler_unlocked_submit(self, prompt, *, max_tokens=32, slo=None):
+    import numpy as np
+
+    from tpurpc.serving import scheduler as _smod
+
+    slo = slo if slo is not None else _smod.SLO_INTERACTIVE
+    if slo not in _smod._SLO_CODE:
+        raise ValueError(f"unknown slo class {slo!r}")
+    prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+    seq = _smod._Seq(next(self._sids), prompt, max(1, int(max_tokens)), slo)
+    # MUTANT: the lock is gone — everything below raced the boundary
+    if self._closed:
+        raise RuntimeError("scheduler closed")
+    if self._draining or (self._draining_fn is not None
+                          and self._draining_fn()):
+        raise _smod.DrainingError("scheduler draining")
+    reason, pushback = self._shed_decision_locked(slo)
+    if reason is not None:
+        self.shed_total += 1
+        raise _smod.ShedError(reason, pushback, slo)
+    self._waiting.append(seq)
+    self._kick.notify_all()
+    return _smod.TokenStream(seq, self)
+
+
+# ---------------------------------------------------------------------------
+# rdv_death_no_quarantine — RdvLink.close with the death-path DISCARD
+# dropped: claimed regions go back to the pool free list, where the
+# straggling writer the quarantine exists for can corrupt the next lease.
+# ---------------------------------------------------------------------------
+
+def _rdv_close_no_quarantine(self):
+    with self._lock:
+        if self.closed:
+            return
+        self.closed = True
+        leases = list(self._leases.values())
+        self._leases.clear()
+        self._req_lease.clear()
+        self._pregrants_out.clear()
+        self._grants.clear()
+        windows = list(self._windows.values())
+        self._windows.clear()
+        self._window_order = []
+        self._cond.notify_all()
+    for lease in leases:
+        lease.release(discard=False)  # MUTANT: quarantine skipped
+    for win in windows:
+        try:
+            win.close()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# kv_free_unlocked — KvBlockManager.free_blocks with the refcount lock
+# REMOVED: the read-modify-write decrement races a concurrent prefix-cache
+# eviction's decrement, and a lost update strands blocks as phantom-used
+# arena memory forever.
+# ---------------------------------------------------------------------------
+
+def _kv_free_unlocked(self, kv, cache_prefix=False):
+    from tpurpc.serving.kv import FLAG_POISONED, _PrefixEntry
+
+    if kv.host is not None:
+        with self._lock:
+            self._swapped_blocks.pop(kv.key, None)
+        kv.host = None
+    if not kv.blocks:
+        kv.length = 0
+        return
+    donate = None
+    if (cache_prefix and kv.prefix_key is not None
+            and kv.length >= kv.prefix_span > 0):
+        h, _tok, flags = kv.entry(kv.prefix_span - 1)
+        if not flags & FLAG_POISONED:
+            bt = self.block_tokens
+            span_blocks = tuple(kv.blocks[:kv.prefix_span // bt])
+            donate = (kv.prefix_key,
+                      _PrefixEntry(span_blocks, kv.prefix_span, h, flags))
+    blocks, kv.blocks = kv.blocks, []
+    kv.length = 0
+    kv.shared_len = 0
+    # MUTANT: the lock is gone — each decrement below is a racy
+    # read-modify-write against a concurrent eviction's decrement
+    if donate is not None and donate[0] not in self._prefix:
+        self._prefix[donate[0]] = donate[1]
+        for b in donate[1].blocks:
+            self._refs[b] += 1
+    for b in blocks:
+        r = self._refs.get(b, 0) - 1
+        if r > 0:
+            self._refs[b] = r
+            continue
+        self._refs.pop(b, None)
+        self._owner.pop(b, None)
+        self._free.append(b)
+
+
+def _targets():
+    from tpurpc.core.handoff import HandoffRing
+    from tpurpc.core.rendezvous import RdvLink
+    from tpurpc.serving.kv import KvBlockManager
+    from tpurpc.serving.scheduler import DecodeScheduler
+
+    return HandoffRing, DecodeScheduler, RdvLink, KvBlockManager
+
+
+def _build() -> Dict[str, Mutant]:
+    HandoffRing, DecodeScheduler, RdvLink, KvBlockManager = _targets()
+    muts = [
+        Mutant("handoff_publish_before_store", "handoff-mpmc",
+               HandoffRing, "publish", _handoff_publish_before_store,
+               "commit stamp stored before the payload: the consumer can "
+               "pass the gate and read an unwritten slot"),
+        Mutant("scheduler_unlocked_submit", "scheduler-admission",
+               DecodeScheduler, "submit", _scheduler_unlocked_submit,
+               "submit appends to the waiting queue without _lock: the "
+               "boundary's clear/extend edit loses it"),
+        Mutant("rdv_death_no_quarantine", "rendezvous-death",
+               RdvLink, "close", _rdv_close_no_quarantine,
+               "peer-death release pools the claimed region instead of "
+               "discarding it: a straggling writer corrupts the next lease"),
+        Mutant("kv_free_unlocked", "kv-refcount",
+               KvBlockManager, "free_blocks", _kv_free_unlocked,
+               "unlocked refcount decrement races an eviction: a lost "
+               "update strands arena blocks forever"),
+    ]
+    return {m.name: m for m in muts}
+
+
+#: name -> Mutant (lazy targets resolved at import of this module)
+SCHED_MUTANTS: Dict[str, Mutant] = _build()

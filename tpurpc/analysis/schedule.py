@@ -1,0 +1,1131 @@
+"""tpurpc-proof: deterministic schedule exploration over the LIVE code.
+
+The analysis gate's model checkers (``ringcheck``) prove hand-written
+*models* of the ring/handoff/rendezvous/KV protocols exhaustively — but a
+model proof says nothing about the threaded Python that claims to
+implement it. This module is the other half of the "runtime matches
+model" guarantee: a CHESS-style deterministic concurrency explorer
+(Musuvathi & Qadeer, PLDI'07 — iterative context bounding) that runs the
+REAL classes under a cooperative scheduler and exhaustively explores
+bounded-preemption interleavings of small harness scenarios.
+
+How the real code becomes schedulable
+-------------------------------------
+
+* **The factory seam.** Scenario objects are constructed while an
+  exploration is active, so every ``make_lock``/``make_condition`` call
+  (the same seam ``TPURPC_DEBUG_LOCKS`` rides) hands back a
+  :class:`SchedLock`/:class:`SchedCondition` — lock acquire/release,
+  condition wait/notify become scheduling points, and a blocked task is
+  *parked in the scheduler*, not in the OS.
+* **Line-granular sched points.** Each explored task thread runs under a
+  ``sys.settrace`` hook filtered to the scenario's instrumented module
+  files: every LINE of the real class is a potential preemption point.
+  Two GIL-atomic stores on consecutive lines (a payload store and its
+  publish stamp) get a scheduling point between them — exactly the
+  granularity the ``publish-before-store`` mutant class needs.
+* **Shimmed waits.** ``threading.Event`` uses the harness-injected
+  :class:`SchedEvent`; timed waits never sleep — a timed waiter parks,
+  and its timeout "fires" (deterministically, oldest first) only when no
+  task is runnable, which is exactly the semantics the real code must
+  tolerate (a timeout is always legal; the shim just makes it prompt).
+
+Exploration
+-----------
+
+One *schedule* is the sequence of task picks made at every scheduling
+point. The explorer runs depth-first over the tree of picks with
+**iterative preemption bounding**: switching away from a still-runnable
+task costs one preemption, switching on a block/finish is free, and only
+schedules with at most ``preemption_bound`` preemptions are explored —
+the CHESS result that almost every concurrency bug hides within 2
+preemptions, which keeps tiny scenarios exhaustive in seconds. The
+default continuation policy (run the current task until it blocks) makes
+the whole search deterministic: same scenario, same bound → same
+schedules in the same order, and any violating schedule's trace (a list
+of task ids) replays to the same violation via :func:`replay`.
+
+A violation is a deadlock (all tasks parked on untimed waits), a task
+exception, a scenario invariant failure after all tasks finish, or a
+diverged schedule (step bound exceeded — clean scenarios never spin).
+
+Scenarios over the live classes live at the bottom of this module
+(:data:`SCENARIOS`); the seeded real-code mutants the explorer must kill
+(a removed lock, a hoisted publish, a skipped quarantine) live in
+:mod:`tpurpc.analysis.schedmutants`, whose file is instrumented too so
+the mutated lines get the same scheduling points.
+
+CLI: ``python -m tpurpc.analysis schedule [--quick]`` — the quick suite
+(clean scenarios + the mutant kill check at bound 1) rides the default
+gate and the ``tools/check.sh`` ``schedule-quick`` stage.
+"""
+
+from __future__ import annotations
+
+import itertools
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from tpurpc.analysis import locks as _locks
+
+__all__ = [
+    "Scenario", "ExploreResult", "Violation", "SchedViolation",
+    "SchedLock", "SchedRLock", "SchedCondition", "SchedEvent",
+    "explore", "explore_random", "replay", "run_scenario",
+    "SCENARIOS", "SCHED_MUTANTS", "quick_suite", "mutant_kill_suite",
+]
+
+
+class SchedViolation(AssertionError):
+    """Raised by a scenario's ``check`` when an invariant does not hold."""
+
+
+class _Abort(BaseException):
+    """Internal: unwind a task thread after the run is over (never leaks
+    out of the wrapper)."""
+
+
+# ---------------------------------------------------------------------------
+# Tasks and the cooperative scheduler.
+# ---------------------------------------------------------------------------
+
+class _Task:
+    __slots__ = ("tid", "fn", "sem", "state", "block_kind", "block_obj",
+                 "timed", "park_seq", "woke_by_timeout", "exc", "thread",
+                 "name")
+
+    def __init__(self, tid: int, fn: Callable, name: str):
+        self.tid = tid
+        self.fn = fn
+        self.name = name
+        self.sem = threading.Semaphore(0)
+        self.state = "new"          # new | runnable | blocked | finished
+        self.block_kind = None      # "lock" | "cond" | "event"
+        self.block_obj = None
+        self.timed = False
+        self.park_seq = 0
+        self.woke_by_timeout = False
+        self.exc: Optional[BaseException] = None
+        self.thread: Optional[threading.Thread] = None
+
+
+class _BranchPoint:
+    __slots__ = ("index", "candidates", "chosen", "preemptions_before",
+                 "prev", "prev_runnable")
+
+    def __init__(self, index, candidates, chosen, preemptions_before,
+                 prev, prev_runnable):
+        self.index = index
+        self.candidates = candidates
+        self.chosen = chosen
+        self.preemptions_before = preemptions_before
+        self.prev = prev
+        self.prev_runnable = prev_runnable
+
+
+class Violation:
+    """One found bug: ``kind`` is ``deadlock`` / ``exception`` /
+    ``invariant`` / ``divergence``; ``trace`` (a list of task ids — the
+    full pick sequence) replays it deterministically."""
+
+    __slots__ = ("kind", "message", "trace")
+
+    def __init__(self, kind: str, message: str, trace: List[int]):
+        self.kind = kind
+        self.message = message
+        self.trace = list(trace)
+
+    def __repr__(self) -> str:
+        return (f"Violation({self.kind}: {self.message!r}, "
+                f"trace={len(self.trace)} picks)")
+
+
+class ExploreResult:
+    __slots__ = ("scenario", "ok", "schedules", "violation", "steps",
+                 "capped", "preemption_bound")
+
+    def __init__(self, scenario: str, ok: bool, schedules: int,
+                 violation: Optional[Violation], steps: int, capped: bool,
+                 preemption_bound: int):
+        self.scenario = scenario
+        self.ok = ok
+        self.schedules = schedules
+        self.violation = violation
+        self.steps = steps
+        self.capped = capped
+        self.preemption_bound = preemption_bound
+
+    def __repr__(self) -> str:
+        s = "OK" if self.ok else f"VIOLATION {self.violation!r}"
+        return (f"<schedule {self.scenario}: {s}, "
+                f"{self.schedules} schedules, {self.steps} steps, "
+                f"bound {self.preemption_bound}"
+                + (", CAPPED" if self.capped else "") + ">")
+
+
+#: one exploration at a time: the factory hook is process-global
+_explore_mu = threading.Lock()
+
+
+class _Scheduler:
+    """One scenario execution under one schedule prefix. The control
+    thread (the caller) runs this; task threads hand control back and
+    forth through per-task semaphores so exactly one thread — task or
+    control — ever runs at a time."""
+
+    def __init__(self, instrument_files: Set[str], max_steps: int):
+        self._files = instrument_files
+        self.max_steps = max_steps
+        self.tasks: List[_Task] = []
+        self.aborting = False
+        self.diverged = False
+        self._ctl_sem = threading.Semaphore(0)
+        self._park_counter = itertools.count(1)
+        self._tls = threading.local()
+        self._hook_threads: Set[int] = {threading.get_ident()}
+        self.steps = 0
+        self.trace: List[int] = []
+        self.branch_points: List[_BranchPoint] = []
+        self.preemptions = 0
+
+    # -- task-side plumbing ---------------------------------------------------
+
+    def current(self) -> Optional[_Task]:
+        return getattr(self._tls, "task", None)
+
+    def owns_current_thread(self) -> bool:
+        return threading.get_ident() in self._hook_threads
+
+    def sched_point(self) -> None:
+        """A visible operation on the current task thread: hand control to
+        the scheduler and wait to be picked again."""
+        task = self.current()
+        if task is None:
+            return
+        if self.aborting:
+            raise _Abort()
+        self.steps += 1
+        if self.steps > self.max_steps:
+            self.diverged = True
+            self.aborting = True
+            self._ctl_sem.release()
+            raise _Abort()
+        task.state = "runnable"
+        self._ctl_sem.release()
+        task.sem.acquire()
+        if self.aborting:
+            raise _Abort()
+
+    def block(self, task: _Task, kind: str, obj, timed: bool) -> None:
+        """Park the current task on ``obj`` until a waker (or, for timed
+        waits, the scheduler's deterministic timeout) re-enables it."""
+        if self.aborting:
+            raise _Abort()
+        task.state = "blocked"
+        task.block_kind = kind
+        task.block_obj = obj
+        task.timed = timed
+        task.park_seq = next(self._park_counter)
+        self._ctl_sem.release()
+        task.sem.acquire()
+        if self.aborting:
+            raise _Abort()
+
+    def unblock(self, task: _Task) -> None:
+        """Mark a parked task runnable (called by the waker — another task
+        thread or the control thread; never schedules it directly)."""
+        if task.state == "blocked":
+            task.state = "runnable"
+            task.block_kind = None
+            task.block_obj = None
+            task.timed = False
+
+    def wake_waiters_of(self, obj, kind: str) -> None:
+        for t in self.tasks:
+            if t.state == "blocked" and t.block_kind == kind \
+                    and t.block_obj is obj:
+                self.unblock(t)
+
+    # -- line tracing ---------------------------------------------------------
+
+    def _make_trace(self, task: _Task):
+        files = self._files
+        sched_point = self.sched_point
+
+        def local_trace(frame, event, arg):
+            if event == "line":
+                sched_point()
+            return local_trace
+
+        def global_trace(frame, event, arg):
+            if event == "call" and frame.f_code.co_filename in files:
+                return local_trace
+            return None
+
+        return global_trace
+
+    def _wrapper(self, task: _Task, state, started: threading.Semaphore):
+        self._tls.task = task
+        self._hook_threads.add(threading.get_ident())
+        started.release()
+        task.sem.acquire()  # first grant
+        if self.aborting:
+            task.state = "finished"
+            self._ctl_sem.release()
+            return
+        sys.settrace(self._make_trace(task))
+        try:
+            task.fn(state)
+        except _Abort:
+            pass
+        except BaseException as exc:  # a task exception IS a finding
+            task.exc = exc
+        finally:
+            sys.settrace(None)
+            task.state = "finished"
+            # extra permits during an abort are harmless (control is in
+            # _abort_all, not parked on the semaphore)
+            self._ctl_sem.release()
+
+    # -- the run --------------------------------------------------------------
+
+    def run(self, scenario: "Scenario", prefix: Sequence[int],
+            preemption_bound: int) -> Optional[Violation]:
+        hook_self = self
+
+        def factory_hook(kind, name, lock):
+            if not hook_self.owns_current_thread():
+                return None
+            if kind == "lock":
+                return SchedLock(hook_self, name)
+            if kind == "rlock":
+                return SchedRLock(hook_self, name)
+            if kind == "condition":
+                return SchedCondition(hook_self, name, lock)
+            return None
+
+        _locks.set_factory_hook(factory_hook)
+        try:
+            state = scenario.setup(self)
+        except BaseException:
+            _locks.set_factory_hook(None)
+            raise
+        started = threading.Semaphore(0)
+        try:
+            for i, fn in enumerate(scenario.threads):
+                task = _Task(i, fn, f"t{i}")
+                self.tasks.append(task)
+            for task in self.tasks:
+                task.thread = threading.Thread(
+                    target=self._wrapper, args=(task, state, started),
+                    daemon=True, name=f"tpurpc-sched-{task.tid}")
+                task.thread.start()
+            for _ in self.tasks:
+                started.acquire()
+            for task in self.tasks:
+                task.state = "runnable"
+
+            violation = self._schedule_loop(prefix, preemption_bound)
+            if violation is None:
+                for task in self.tasks:
+                    if task.exc is not None:
+                        violation = Violation(
+                            "exception",
+                            f"task {task.tid} raised "
+                            f"{type(task.exc).__name__}: {task.exc}",
+                            self.trace)
+                        break
+            if violation is None:
+                try:
+                    scenario.check(state)
+                except AssertionError as exc:
+                    violation = Violation("invariant", str(exc), self.trace)
+            return violation
+        finally:
+            self._abort_all()
+            _locks.set_factory_hook(None)
+            try:
+                scenario.teardown(state)
+            except Exception:
+                pass
+
+    def _schedule_loop(self, prefix: Sequence[int],
+                       preemption_bound: int) -> Optional[Violation]:
+        prev: Optional[int] = None
+        while True:
+            runnable = [t for t in self.tasks if t.state == "runnable"]
+            if not runnable:
+                blocked = [t for t in self.tasks if t.state == "blocked"]
+                if not blocked:
+                    return None  # all finished
+                timed = [t for t in blocked if t.timed]
+                if not timed:
+                    detail = ", ".join(
+                        f"t{t.tid} on {t.block_kind} "
+                        f"{getattr(t.block_obj, 'name', '?')}"
+                        for t in blocked)
+                    return Violation(
+                        "deadlock",
+                        f"all live tasks parked on untimed waits ({detail})",
+                        self.trace)
+                # deterministic timeout: the longest-parked timed waiter
+                t = min(timed, key=lambda t: t.park_seq)
+                t.woke_by_timeout = True
+                self.unblock(t)
+                continue
+            candidates = tuple(sorted(t.tid for t in runnable))
+            idx = len(self.trace)
+            prev_runnable = prev is not None and prev in candidates
+            if idx < len(prefix):
+                chosen = prefix[idx]
+                if chosen not in candidates:
+                    # the prefix no longer matches (can only happen on a
+                    # hand-edited trace): fall back to the default policy
+                    chosen = prev if prev_runnable else candidates[0]
+            elif prev_runnable:
+                chosen = prev
+            else:
+                chosen = candidates[0]
+            if len(candidates) > 1:
+                self.branch_points.append(_BranchPoint(
+                    idx, candidates, chosen, self.preemptions, prev,
+                    prev_runnable))
+            if prev_runnable and chosen != prev:
+                self.preemptions += 1
+            self.trace.append(chosen)
+            task = self.tasks[chosen]
+            prev = chosen
+            task.sem.release()
+            self._ctl_sem.acquire()
+            if self.diverged:
+                return Violation(
+                    "divergence",
+                    f"schedule exceeded {self.max_steps} scheduling points "
+                    "(a spin the shimmed waits cannot park?)", self.trace)
+
+    def _abort_all(self) -> None:
+        self.aborting = True
+        for task in self.tasks:
+            # generous releases: a task may be parked in block() or
+            # sched_point(); extra permits are harmless (thread exits)
+            task.sem.release()
+            task.sem.release()
+        for task in self.tasks:
+            if task.thread is not None:
+                task.thread.join(timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# Scheduler-aware primitives (what the factory seam hands out).
+# ---------------------------------------------------------------------------
+
+class SchedLock:
+    """A mutex whose contention is resolved by the exploration scheduler.
+    Mutual exclusion itself still rests on a real ``threading.Lock`` (so a
+    stray non-task thread can never corrupt it); task threads park in the
+    scheduler instead of the OS."""
+
+    _reentrant = False
+
+    def __init__(self, sched: _Scheduler, name: str):
+        self._sched = sched
+        self.name = name
+        self._inner = threading.Lock()
+        self._owner: Optional[int] = None
+        self._count = 0
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        sched = self._sched
+        task = sched.current()
+        if task is None:
+            return self._inner.acquire(blocking, timeout)
+        if self._reentrant and self._owner == task.tid:
+            self._count += 1
+            return True
+        sched.sched_point()
+        while True:
+            if self._inner.acquire(blocking=False):
+                self._owner = task.tid
+                self._count = 1
+                return True
+            if not blocking:
+                return False
+            sched.block(task, "lock", self,
+                        timed=(timeout is not None and timeout >= 0))
+            if task.woke_by_timeout:
+                task.woke_by_timeout = False
+                return False
+
+    def release(self) -> None:
+        sched = self._sched
+        task = sched.current()
+        if task is None:
+            self._inner.release()
+            return
+        if self._reentrant and self._count > 1:
+            self._count -= 1
+            return
+        self._release_nopoint()
+        sched.sched_point()
+
+    def _release_nopoint(self) -> None:
+        """Release and wake lock-waiters WITHOUT a scheduling point — the
+        condition-wait path, where release+park must be one atomic step
+        from the model's point of view."""
+        self._owner = None
+        self._count = 0
+        self._inner.release()
+        self._sched.wake_waiters_of(self, "lock")
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<SchedLock {self.name}>"
+
+
+class SchedRLock(SchedLock):
+    _reentrant = True
+
+
+class SchedCondition:
+    """Condition variable over a :class:`SchedLock`, scheduler-parked.
+    ``wait`` registers the waiter, releases the lock and parks as ONE
+    model step (no lost wakeups the real primitive would not have);
+    ``notify`` wakes the longest-parked waiter(s), which then re-contend
+    for the lock like real threads do."""
+
+    def __init__(self, sched: _Scheduler, name: str, lock=None):
+        self._sched = sched
+        self.name = name
+        self._lock = lock if lock is not None else SchedLock(sched, name)
+        self._waiters: List[_Task] = []
+
+    # delegate the lock face
+    def acquire(self, *a, **kw):
+        return self._lock.acquire(*a, **kw)
+
+    def release(self) -> None:
+        self._lock.release()
+
+    def __enter__(self):
+        return self._lock.__enter__()
+
+    def __exit__(self, *exc) -> None:
+        self._lock.__exit__(*exc)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        sched = self._sched
+        task = sched.current()
+        if task is None:  # non-task thread: degrade to a poll loop
+            self._lock.release()
+            time.sleep(min(timeout or 0.01, 0.01))
+            self._lock.acquire()
+            return True
+        self._waiters.append(task)
+        self._lock._release_nopoint()
+        sched.block(task, "cond", self, timed=timeout is not None)
+        timed_out = task.woke_by_timeout
+        task.woke_by_timeout = False
+        if task in self._waiters:
+            self._waiters.remove(task)
+        self._lock.acquire()
+        return not timed_out
+
+    def wait_for(self, predicate, timeout: Optional[float] = None) -> bool:
+        result = predicate()
+        while not result:
+            if not self.wait(timeout):
+                return predicate()
+            result = predicate()
+        return result
+
+    def notify(self, n: int = 1) -> None:
+        sched = self._sched
+        for _ in range(n):
+            if not self._waiters:
+                break
+            t = self._waiters.pop(0)
+            sched.unblock(t)
+        if sched.current() is not None:
+            sched.sched_point()
+
+    def notify_all(self) -> None:
+        self.notify(len(self._waiters) or 0)
+
+    def __repr__(self) -> str:
+        return f"<SchedCondition {self.name}>"
+
+
+class SchedEvent:
+    """Harness-injected stand-in for ``threading.Event`` on scenario
+    objects (``ring._data_evt = sched_event``): waits park in the
+    scheduler, timeouts fire only when nothing else can run."""
+
+    def __init__(self, sched: _Scheduler, name: str = "event"):
+        self._sched = sched
+        self.name = name
+        self._flag = False
+
+    def is_set(self) -> bool:
+        return self._flag
+
+    def set(self) -> None:
+        self._flag = True
+        self._sched.wake_waiters_of(self, "event")
+        if self._sched.current() is not None:
+            self._sched.sched_point()
+
+    def clear(self) -> None:
+        self._flag = False
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        sched = self._sched
+        task = sched.current()
+        if task is None:
+            deadline = time.monotonic() + (timeout or 0.01)
+            while not self._flag and time.monotonic() < deadline:
+                time.sleep(0.001)
+            return self._flag
+        sched.sched_point()
+        if self._flag:
+            return True
+        sched.block(task, "event", self, timed=timeout is not None)
+        task.woke_by_timeout = False
+        return self._flag
+
+
+# ---------------------------------------------------------------------------
+# Scenarios.
+# ---------------------------------------------------------------------------
+
+class Scenario:
+    """One explorable harness over live classes.
+
+    ``setup(sched)`` builds the scenario state (factory-made locks become
+    Sched primitives while it runs); ``threads`` are the racing bodies
+    (each called with the state); ``check(state)`` asserts the invariant
+    after every thread finished; ``instrument`` lists the module FILES
+    whose lines are scheduling points; ``teardown(state)`` releases any
+    real resources (arenas, pools) after each run."""
+
+    def __init__(self, name: str, setup, threads, check,
+                 instrument: Sequence[str], teardown=None,
+                 max_steps: int = 60000):
+        self.name = name
+        self.setup = setup
+        self.threads = list(threads)
+        self.check = check
+        self.instrument = set(instrument)
+        self.teardown = teardown or (lambda state: None)
+        self.max_steps = max_steps
+
+
+def _module_file(mod) -> str:
+    return mod.__file__
+
+
+def _run_once(scenario: Scenario, prefix: Sequence[int],
+              preemption_bound: int) -> Tuple[Optional[Violation],
+                                              _Scheduler]:
+    sched = _Scheduler(scenario.instrument, scenario.max_steps)
+    violation = sched.run(scenario, prefix, preemption_bound)
+    return violation, sched
+
+
+def explore(scenario: Scenario, preemption_bound: int = 2,
+            max_schedules: int = 20000) -> ExploreResult:
+    """Depth-first exploration of all schedules within the preemption
+    bound (or until ``max_schedules``). Deterministic: same scenario +
+    bound → same schedules in the same order."""
+    with _explore_mu:
+        return _explore_locked(scenario, preemption_bound, max_schedules)
+
+
+def _explore_locked(scenario: Scenario, preemption_bound: int,
+                    max_schedules: int) -> ExploreResult:
+    stack: List[Tuple[int, ...]] = [()]
+    schedules = 0
+    steps = 0
+    while stack:
+        if schedules >= max_schedules:
+            return ExploreResult(scenario.name, True, schedules, None,
+                                 steps, True, preemption_bound)
+        prefix = stack.pop()
+        violation, sched = _run_once(scenario, prefix, preemption_bound)
+        schedules += 1
+        steps += sched.steps
+        if violation is not None:
+            return ExploreResult(scenario.name, False, schedules,
+                                 violation, steps, False, preemption_bound)
+        # push unexplored alternatives discovered at or after the prefix
+        for bp in reversed(sched.branch_points):
+            if bp.index < len(prefix):
+                continue
+            for alt in bp.candidates:
+                if alt == bp.chosen:
+                    continue
+                cost = 1 if (bp.prev_runnable and alt != bp.prev) else 0
+                if bp.preemptions_before + cost > preemption_bound:
+                    continue
+                stack.append(tuple(sched.trace[:bp.index]) + (alt,))
+    return ExploreResult(scenario.name, True, schedules, None, steps,
+                         False, preemption_bound)
+
+
+class _ScriptScheduler(_Scheduler):
+    def __init__(self, files, max_steps, script):
+        super().__init__(files, max_steps)
+        self._script = script
+
+    def _schedule_loop(self, prefix, preemption_bound):
+        # identical to the base loop, except picks come from the script
+        prev: Optional[int] = None
+        while True:
+            runnable = [t for t in self.tasks if t.state == "runnable"]
+            if not runnable:
+                blocked = [t for t in self.tasks if t.state == "blocked"]
+                if not blocked:
+                    return None
+                timed = [t for t in blocked if t.timed]
+                if not timed:
+                    detail = ", ".join(
+                        f"t{t.tid} on {t.block_kind}" for t in blocked)
+                    return Violation("deadlock",
+                                     f"all live tasks parked ({detail})",
+                                     self.trace)
+                t = min(timed, key=lambda t: t.park_seq)
+                t.woke_by_timeout = True
+                self.unblock(t)
+                continue
+            candidates = tuple(sorted(t.tid for t in runnable))
+            idx = len(self.trace)
+            if idx < len(self._script):
+                chosen = candidates[self._script[idx] % len(candidates)]
+            else:
+                chosen = (prev if prev is not None and prev in candidates
+                          else candidates[0])
+            if prev is not None and prev in candidates and chosen != prev:
+                self.preemptions += 1
+            self.trace.append(chosen)
+            task = self.tasks[chosen]
+            prev = chosen
+            task.sem.release()
+            self._ctl_sem.acquire()
+            if self.diverged:
+                return Violation("divergence",
+                                 f"exceeded {self.max_steps} points",
+                                 self.trace)
+
+
+def explore_random(scenario: Scenario, seed: int,
+                   schedules: int = 50) -> Tuple[ExploreResult,
+                                                 List[List[int]]]:
+    """Seeded random-walk exploration: each schedule's picks come from a
+    seeded PRNG script (reduced modulo the live candidate set at every
+    point). Same seed → identical schedule traces — the determinism
+    contract tests/test_schedule.py pins. Returns ``(result, traces)``."""
+    import random
+
+    rng = random.Random(seed)
+    traces: List[List[int]] = []
+    steps = 0
+    with _explore_mu:
+        for i in range(schedules):
+            script = [rng.randrange(1 << 16) for _ in range(8192)]
+            sched = _ScriptScheduler(scenario.instrument,
+                                     scenario.max_steps, script)
+            violation = sched.run(scenario, (), 1 << 30)
+            traces.append(list(sched.trace))
+            steps += sched.steps
+            if violation is not None:
+                return (ExploreResult(scenario.name, False, i + 1,
+                                      violation, steps, False, -1), traces)
+    return (ExploreResult(scenario.name, True, schedules, None, steps,
+                          False, -1), traces)
+
+
+def replay(scenario: Scenario, trace: Sequence[int]) -> ExploreResult:
+    """Re-run one serialized schedule (a violating trace from a previous
+    exploration). Deterministic: the same trace drives the same picks, so
+    a violation replays to the same violation."""
+    with _explore_mu:
+        violation, sched = _run_once(scenario, tuple(trace), 1 << 30)
+        return ExploreResult(scenario.name, violation is None, 1,
+                             violation, sched.steps, False, -1)
+
+
+# ---------------------------------------------------------------------------
+# The live-code scenarios.
+# ---------------------------------------------------------------------------
+
+def _handoff_scenario() -> Scenario:
+    """Two producers race ``HandoffRing.publish`` against one consumer
+    draining in ticket order — the PR 7 merge-boundary protocol, run for
+    real. Invariant: both items arrive, exactly once, no Nones."""
+    from tpurpc.core import handoff as _handoff
+
+    def setup(sched: _Scheduler):
+        ring = _handoff.HandoffRing(capacity=4)
+        ring._data_evt = SchedEvent(sched, "handoff._data_evt")
+        ring._space_evt = SchedEvent(sched, "handoff._space_evt")
+        return {"ring": ring, "got": []}
+
+    def producer(tag):
+        def body(state):
+            ok = state["ring"].publish(tag, timeout=None)
+            assert ok, f"publish({tag!r}) returned False"
+        return body
+
+    def consumer(state):
+        for _ in range(2):
+            item = state["ring"].take(timeout=None)
+            state["got"].append(item)
+
+    def check(state):
+        got = state["got"]
+        if sorted(x for x in got if x is not None) != ["p0", "p1"]:
+            raise SchedViolation(
+                f"handoff lost/tore a message: consumer saw {got!r} "
+                "(want p0 and p1, each exactly once)")
+
+    return Scenario(
+        "handoff-mpmc",
+        setup, [producer("p0"), producer("p1"), consumer], check,
+        instrument=[_module_file(_handoff), _mutants_file()])
+
+
+def _scheduler_scenario() -> Scenario:
+    """The REAL ``DecodeScheduler._boundary`` races ``submit`` and a
+    client ``cancel`` — the admission edge the ``_lock``/``_kick`` pair
+    guards. Invariant: no sequence is ever lost (every submit is waiting,
+    running, or terminally answered) and the boundary never throws."""
+    from tpurpc.serving import scheduler as _smod
+
+    class _Model:
+        def prefill(self, prompts):
+            import numpy as np
+
+            states = [np.zeros(1, dtype=np.int32) for _ in prompts]
+            tokens = [int(p[-1]) + 1 for p in prompts]
+            return states, tokens
+
+        def step(self, states, tokens):
+            return states, [int(t) + 1 for t in tokens]
+
+    def setup(sched: _Scheduler):
+        orig_loop = _smod.DecodeScheduler._step_loop
+        _smod.DecodeScheduler._step_loop = lambda self: None
+        try:
+            s = _smod.DecodeScheduler(
+                _Model(), max_batch=4, max_waiting=16,
+                idle_wait_s=0.01, name="sched-explore")
+        finally:
+            _smod.DecodeScheduler._step_loop = orig_loop
+        first = s.submit([1, 2], max_tokens=4)
+        return {"s": s, "first": first, "streams": [first], "late": []}
+
+    def boundary(state):
+        alive = state["s"]._boundary()
+        assert alive, "boundary reported closed on a live scheduler"
+
+    def submitter(state):
+        stream = state["s"].submit([3, 4], max_tokens=4)
+        state["late"].append(stream)
+
+    def canceller(state):
+        state["first"].cancel()
+
+    def check(state):
+        s = state["s"]
+        live = {q.sid for q in s._running} | {q.sid for q in s._waiting} \
+            | {q.sid for q in s._swapped}
+        for stream in state["streams"] + state["late"]:
+            seq = stream._seq
+            if seq.sid in live:
+                continue
+            if seq.cancelled or not seq.q.empty():
+                continue  # terminally answered (done/error/token)
+            raise SchedViolation(
+                f"sequence {seq.sid} vanished: not waiting, not running, "
+                "never answered — the admission edge lost a submit")
+
+    def teardown(state):
+        try:
+            state["s"]._closed = True
+        except Exception:
+            pass
+
+    return Scenario(
+        "scheduler-admission",
+        setup, [boundary, submitter, canceller], check,
+        instrument=[_module_file(_smod), _mutants_file()],
+        teardown=teardown)
+
+
+def _rendezvous_scenario() -> Scenario:
+    """Live ``RdvLink`` offer/claim/complete racing peer-death ``close``
+    on the receiver — the modeled sender-death scenario, run against the
+    implementation. Invariants: the transfer never hangs (deadlock-free
+    by construction of the explorer), and any region still claimed when
+    the link died is DISCARDED — never back on the pool free list where a
+    straggling writer could corrupt a re-leased region."""
+    import os
+
+    import tpurpc.core.rendezvous as _rdv
+
+    def setup(sched: _Scheduler):
+        # keep every schedule finite and the state space tiny: no standing
+        # pre-grants (their top-up loop multiplies sched points) and a
+        # zero claim timeout (the loopback wiring answers claims
+        # synchronously; a LOST claim must fall back immediately instead
+        # of spinning the timed cond-wait loop against a 5 s deadline
+        # real time never reaches under the shimmed clockless waits)
+        saved = (_rdv._PREGRANT_DEPTH,
+                 os.environ.get("TPURPC_RENDEZVOUS_CLAIM_TIMEOUT_S"))
+        _rdv._PREGRANT_DEPTH = 0
+        os.environ["TPURPC_RENDEZVOUS_CLAIM_TIMEOUT_S"] = "0"
+        pool = _rdv.LandingPool("local", budget=8 << 20)
+        links = {}
+
+        def send_a(op, stream_id, payload):
+            links["b"].on_op(op, stream_id, payload)
+
+        def send_b(op, stream_id, payload):
+            if links["b"].closed:
+                raise OSError("link closed")
+            links["a"].on_op(op, stream_id, payload)
+
+        delivered = []
+
+        def deliver(stream_id, flags, wrapper):
+            delivered.append(bytes(wrapper[:8]))
+
+        a = _rdv.RdvLink("explore-a", send_a, lambda *a: None,
+                         pool_kinds=("local",), open_kinds=("local",))
+        b = _rdv.RdvLink("explore-b", send_b, deliver,
+                         pool_kinds=("local",), open_kinds=("local",))
+        links["a"], links["b"] = a, b
+
+        # the receiver leases from OUR scenario pool, not the global one
+        def lease_local(nbytes, kinds):
+            if not kinds or "local" not in kinds:
+                return None
+            return pool.lease(nbytes, next(b._lease_ids))
+
+        b._lease_for = lease_local
+        a.negotiated = True
+        b.negotiated = True
+        payload = b"\xabtpurpc!" * (_rdv._MIN_CLASS // 8)
+        return {"a": a, "b": b, "pool": pool, "payload": payload,
+                "delivered": delivered, "death_claimed": [],
+                "saved": saved}
+
+    def sender(state):
+        a = state["a"]
+        payload = state["payload"]
+        # fallback (False) is a legal outcome when close wins the race;
+        # hanging or corrupting the pool is not
+        a.send_message(1, 0, [payload], len(payload))
+
+    def killer(state):
+        b = state["b"]
+        state["death_claimed"].extend(b._leases.values())
+        b.close()
+
+    def check(state):
+        pool = state["pool"]
+        free_regions = [pr for bucket in pool._free.values()
+                        for pr in bucket]
+        for lease in state["death_claimed"]:
+            if lease.delivered:
+                # the transfer completed before the link actually died:
+                # recycling after the wrapper's death is the legal path
+                continue
+            if lease.pr in free_regions:
+                raise SchedViolation(
+                    "a region claimed-but-undelivered at link death was "
+                    "returned to the pool FREE LIST instead of being "
+                    "discarded — a straggling one-sided writer can corrupt "
+                    "whoever leases it next")
+
+    def teardown(state):
+        try:
+            state["a"].close()
+            state["b"].close()
+            state["pool"].trim()
+        except Exception:
+            pass
+        depth, env = state["saved"]
+        _rdv._PREGRANT_DEPTH = depth
+        if env is None:
+            os.environ.pop("TPURPC_RENDEZVOUS_CLAIM_TIMEOUT_S", None)
+        else:
+            os.environ["TPURPC_RENDEZVOUS_CLAIM_TIMEOUT_S"] = env
+
+    return Scenario(
+        "rendezvous-death",
+        setup, [sender, killer], check,
+        instrument=[_module_file(_rdv), _mutants_file()],
+        teardown=teardown, max_steps=120000)
+
+
+def _kv_scenario() -> Scenario:
+    """Live ``KvBlockManager`` refcounts under racing release paths: one
+    thread frees a table whose prompt span is shared with the prefix
+    cache, the other forces a cache eviction (an allocation the arena can
+    only satisfy by evicting). Invariant: after both, every span block is
+    back on the free list — a lost refcount decrement strands blocks as
+    phantom 'used' forever."""
+    import numpy as np
+
+    from tpurpc.serving import kv as _kv
+
+    def setup(sched: _Scheduler):
+        mgr = _kv.KvBlockManager(n_blocks=4, block_bytes=_kv.ENTRY_BYTES * 4,
+                                 kind="local", name="kv-explore")
+        prompt = np.arange(8, dtype=np.int32)  # span = 8 tokens = 2 blocks
+        # harness-scoped tables: teardown closes the whole arena
+        kv1, hit = mgr.alloc_for_prompt(1, prompt)  # tpr: allow(kv)
+        assert hit == 0
+        for i, tok in enumerate(prompt):
+            kv1.append(i + 1, int(tok))
+        # donate the span to the prefix cache: span blocks now refs=2
+        mgr.free_blocks(kv1, cache_prefix=True)
+        kv2, hit = mgr.alloc_for_prompt(2, prompt)
+        assert hit == 8, f"prefix hit expected, got {hit}"
+        return {"mgr": mgr, "kv2": kv2}
+
+    def releaser(state):
+        # drop the table's refs on the shared span (2 -> 1)
+        state["mgr"].free_blocks(state["kv2"], cache_prefix=False)
+
+    def evictor(state):
+        # force the cache's refs to drop too (1 -> 0 => free), by
+        # allocating more than the free list holds without eviction;
+        # KvArenaFull is a legal outcome (the table's refs still pin the
+        # span when this thread runs first) — the eviction itself, which
+        # is the racing decrement, has happened either way
+        mgr = state["mgr"]
+        try:
+            got = mgr.alloc_blocks(99, 3)  # tpr: allow(kv)
+        except _kv.KvArenaFull:
+            return
+        mgr.free_blocks_raw(got)
+
+    def check(state):
+        mgr = state["mgr"]
+        stats = mgr.stats()
+        if stats["free"] != mgr.n_blocks or stats["used"] != 0:
+            raise SchedViolation(
+                "kv refcount race stranded blocks: after releasing the "
+                f"table AND evicting the cache, {stats['used']} block(s) "
+                f"remain phantom-used (free={stats['free']}/"
+                f"{mgr.n_blocks}) — a lost decrement leaks arena memory "
+                "forever")
+
+    def teardown(state):
+        try:
+            state["mgr"].close()
+        except Exception:
+            pass
+
+    return Scenario(
+        "kv-refcount",
+        setup, [releaser, evictor], check,
+        instrument=[_module_file(_kv), _mutants_file()],
+        teardown=teardown)
+
+
+def _mutants_file() -> str:
+    from tpurpc.analysis import schedmutants
+
+    return schedmutants.__file__
+
+
+#: scenario name -> zero-arg factory (fresh Scenario per exploration)
+SCENARIOS: Dict[str, Callable[[], Scenario]] = {
+    "handoff-mpmc": _handoff_scenario,
+    "scheduler-admission": _scheduler_scenario,
+    "rendezvous-death": _rendezvous_scenario,
+    "kv-refcount": _kv_scenario,
+}
+
+
+# ---------------------------------------------------------------------------
+# Seeded real-code mutants (the explorer's teeth).
+# ---------------------------------------------------------------------------
+
+def _mutants():
+    from tpurpc.analysis import schedmutants
+
+    return schedmutants.SCHED_MUTANTS
+
+
+def run_scenario(name: str, preemption_bound: int = 2,
+                 max_schedules: int = 20000,
+                 mutant: Optional[str] = None) -> ExploreResult:
+    """Explore one named scenario, optionally with a seeded real-code
+    mutant applied for the duration (the mutant names which scenario it
+    belongs to; mismatches are an error)."""
+    scenario = SCENARIOS[name]()
+    if mutant is None:
+        return explore(scenario, preemption_bound, max_schedules)
+    m = _mutants()[mutant]
+    if m.scenario != name:
+        raise ValueError(f"mutant {mutant} targets scenario {m.scenario}, "
+                         f"not {name}")
+    with m.applied():
+        return explore(scenario, preemption_bound, max_schedules)
+
+
+def quick_suite(preemption_bound: int = 1, max_schedules: int = 1500,
+                verbose: bool = False) -> List[ExploreResult]:
+    """The check.sh ``schedule-quick`` stage: every scenario explored
+    clean at the given bound, every seeded mutant killed. Sized to fit a
+    ~60 s budget on a 1-core rig; the full-depth runs live in
+    tests/test_schedule.py."""
+    out: List[ExploreResult] = []
+    for name in sorted(SCENARIOS):
+        res = run_scenario(name, preemption_bound, max_schedules)
+        if verbose:
+            print(f"schedule: {res!r}")
+        out.append(res)
+    for mname, m in sorted(_mutants().items()):
+        res = run_scenario(m.scenario, preemption_bound, max_schedules,
+                           mutant=mname)
+        # a mutant result is GOOD when a violation was found
+        res = ExploreResult(f"mutant:{mname}", not res.ok, res.schedules,
+                            res.violation, res.steps, res.capped,
+                            res.preemption_bound)
+        if verbose:
+            kill = "KILLED" if res.ok else "SURVIVED"
+            print(f"schedule: mutant {mname}: {kill} "
+                  f"({res.schedules} schedules)")
+        out.append(res)
+    return out
+
+
+def mutant_kill_suite(preemption_bound: int = 2,
+                      max_schedules: int = 20000,
+                      verbose: bool = False) -> Dict[str, bool]:
+    """killed-by-exploration per seeded real-code mutant (the acceptance
+    gate: every one must be True, and the clean scenarios must pass)."""
+    kills: Dict[str, bool] = {}
+    for mname, m in sorted(_mutants().items()):
+        res = run_scenario(m.scenario, preemption_bound, max_schedules,
+                           mutant=mname)
+        kills[mname] = res.violation is not None
+        if verbose:
+            print(f"schedule mutant {mname}: "
+                  f"{'KILLED' if kills[mname] else 'SURVIVED'} "
+                  f"({res.schedules} schedules, {res.steps} steps)")
+    return kills

@@ -238,6 +238,13 @@ class RegionLease:
                  "standing", "delivered", "_freed", "_retired", "_recycled",
                  "_discard", "_lock")
 
+    #: lint rule `lock`: settlement state shared between the delivering
+    #: reader thread, wrapper finalizers (whichever thread drops the last
+    #: alias) and the link's death path
+    _GUARDED_BY = {"delivered": "_lock", "_freed": "_lock",
+                   "_retired": "_lock", "_recycled": "_lock",
+                   "_discard": "_lock"}
+
     def __init__(self, pool: "LandingPool", pr: _PoolRegion, lease_id: int,
                  cls: int):
         self.pool = pool
@@ -252,7 +259,7 @@ class RegionLease:
         self._retired = False
         self._recycled = False
         self._discard = False
-        self._lock = threading.Lock()
+        self._lock = make_lock("RegionLease._lock")
 
     def _maybe_recycle_locked(self) -> bool:
         """The ONE recycle rule: a region returns to the pool exactly once,
@@ -262,7 +269,8 @@ class RegionLease:
             return False
         done = self._retired or (self.delivered > 0 and not self.standing)
         if done and self._freed == self.delivered:
-            self._recycled = True
+            # contract: caller holds _lock (the _locked suffix)
+            self._recycled = True  # tpr: allow(lock)
             return True
         return False
 
@@ -343,6 +351,11 @@ class LandingPool:
     domain on ring planes, verbs on RDMA hardware), pooled by power-of-two
     size class under a byte budget, and recycled only when provably
     unobservable (see :meth:`RegionLease.deliver`)."""
+
+    #: lint rule `lock`: the free lists, zombie quarantine and byte budget
+    #: are shared between reader threads, finalizers and lease callers
+    _GUARDED_BY = {"_free": "_lock", "_zombies": "_lock",
+                   "_allocated": "_lock"}
 
     def __init__(self, kind: str, budget: Optional[int] = None):
         self.kind = kind
@@ -446,7 +459,7 @@ class LandingPool:
 
 
 _pools: Dict[str, LandingPool] = {}
-_pools_lock = threading.Lock()
+_pools_lock = make_lock("rendezvous._pools_lock")
 
 
 def landing_pool(kind: str) -> LandingPool:
@@ -563,6 +576,13 @@ class RdvLink:
     flags, wrapper)`` (hand a completed payload to the stream layer), and
     optionally ``pump(pred, deadline)`` for inline-pump transports where
     the waiting sender must drive the reader itself."""
+
+    #: lint rule `lock`: every registry below is shared between the
+    #: connection reader/pump thread, sender threads and the death path
+    _GUARDED_BY = {"_reqs": "_lock", "_grants": "_lock",
+                   "_leases": "_lock", "_req_lease": "_lock",
+                   "_pregrants_out": "_lock", "_windows": "_lock",
+                   "_window_order": "_lock"}
 
     def __init__(self, name: str,
                  send_op: Callable[[int, int, bytes], None],
@@ -1130,6 +1150,8 @@ class GrantWriter:
     ``rdma_write`` — the same accounting as RdvLink's bulk path, so the
     copy-ledger proof ("KV landed with zero host landing copies") is one
     ``ledger.track()`` window away."""
+
+    _GUARDED_BY = {"_windows": "_lock"}
 
     def __init__(self):
         self._domains: Dict[str, _pair.MemoryDomain] = {}

@@ -129,6 +129,10 @@ KV_SHIP_COMPLETE = 51     # a1 = handoff id, a2 = payload bytes landed
 KV_QUARANTINE = 52        # a1 = handoff/seq key (0 = link), a2 = blocks
 MIG_BEGIN = 53            # a1 = seq id, a2 = entries to move
 MIG_END = 54              # a1 = seq id, a2 = 1 ok / 0 failed
+# tpurpc-proof (ISSUE 12): the live protocol verifier's breadcrumb — a
+# declared flight-event state machine (analysis/protocol.py) saw an
+# illegal transition. a1 = machine index, a2 = the offending event code.
+PROTO_VIOLATION = 55
 
 EVENT_NAMES: Dict[int, str] = {
     PAIR_CONNECT: "pair-connect",
@@ -185,6 +189,7 @@ EVENT_NAMES: Dict[int, str] = {
     KV_QUARANTINE: "kv-quarantine",
     MIG_BEGIN: "migration-begin",
     MIG_END: "migration-end",
+    PROTO_VIOLATION: "proto-violation",
 }
 
 #: batch-flush reason codes (a1 of BATCH_FLUSH) — mirrors the jaxshim
@@ -240,6 +245,27 @@ def tag_name(tag: int) -> str:
         return f"#{tag}"
 
 
+# -- live protocol verification tap (tpurpc-proof, ISSUE 12) ------------------
+#
+# TPURPC_VERIFY_PROTOCOL=1 installs analysis/protocol.py's LiveVerifier
+# here; emit() forwards every recorded event to it AFTER the pack. Cost
+# when unset: one global load + None check per event — and events are
+# EDGES, so a healthy loop pays nothing either way.
+
+_verify = None
+
+
+def set_verify_hook(hook) -> None:
+    """Install (or clear, with ``None``) the per-event verification tap:
+    ``hook(code, tag, a1, a2)`` is called for every recorded event."""
+    global _verify
+    _verify = hook
+
+
+def verify_hook():
+    return _verify
+
+
 # -- the recorder -------------------------------------------------------------
 
 class FlightRecorder:
@@ -270,6 +296,11 @@ class FlightRecorder:
                 min(max(int(a2), _I64_MIN), _I64_MAX))
         except (struct.error, ValueError):
             pass
+        if _verify is not None:
+            try:
+                _verify(code, tag, a1, a2)
+            except Exception:
+                pass  # verification must never break the recorder contract
 
     # -- cold paths ----------------------------------------------------------
 
@@ -390,3 +421,56 @@ def install_sigusr2() -> bool:
 
 
 install_sigusr2()
+
+
+# -- at-exit dump for offline conformance (tpurpc-proof, ISSUE 12) ------------
+#
+# TPURPC_FLIGHT_DUMP=<dir> makes every process (smokes spawn several)
+# write its flight ring as <dir>/flight-<pid>.json at interpreter exit —
+# the input `python -m tpurpc.analysis protocol --flight <dir>` replays
+# against the declared protocol machines (tools/check.sh wires the two
+# together).
+
+def _install_exit_dump() -> None:
+    import atexit
+    import json
+    import os
+
+    target = os.environ.get("TPURPC_FLIGHT_DUMP", "")
+    if not target:
+        return
+
+    def _dump_at_exit():
+        try:
+            os.makedirs(target, exist_ok=True)
+            path = os.path.join(target, f"flight-{os.getpid()}.json")
+            with open(path, "w", encoding="utf-8") as f:
+                json.dump(RECORDER.snapshot(), f)
+        except Exception:
+            pass  # a failed postmortem dump must not fail the exit
+
+    atexit.register(_dump_at_exit)
+
+
+_install_exit_dump()
+
+
+def _install_env_verifier() -> None:
+    import os
+
+    if os.environ.get("TPURPC_VERIFY_PROTOCOL", "") != "1":
+        return
+    try:
+        # flight's module object is already in sys.modules (constants all
+        # defined above), so protocol's import of it resolves cleanly
+        from tpurpc.analysis import protocol as _protocol
+
+        _protocol.install_live()
+    except Exception:
+        # import-order cycle: something imported analysis.protocol first
+        # and THAT import pulled us in — protocol's own module bottom
+        # installs the verifier once it finishes initializing
+        pass
+
+
+_install_env_verifier()

@@ -511,7 +511,7 @@ def clock_anchor() -> Dict:
     import os
 
     m0 = time.monotonic_ns()
-    wall = time.time_ns()  # tpr: allow(wallclock) — the anchor IS absolute
+    wall = time.time_ns()  # the anchor IS absolute (time_ns, not time())
     m1 = time.monotonic_ns()
     return {"pid": os.getpid(), "mono_ns": (m0 + m1) // 2, "wall_ns": wall,
             "uncertainty_ns": m1 - m0}

@@ -256,6 +256,36 @@ class StallWatchdog:
             _flight.RECORDER.dump_text(
                 since_ns=diag["since_ns"] - 1_000_000_000))
 
+    def external_trip(self, stage: str, method: str, detail: str) -> None:
+        """A trip raised by another verification subsystem rather than the
+        sweeper — tpurpc-proof's live protocol verifier
+        (``TPURPC_VERIFY_PROTOCOL=1``) calls this when a declared flight
+        machine sees an illegal transition. Counts like a sweeper trip
+        (``watchdog_trips`` / ``watchdog_stalls{stage}``), lands in the
+        history served at ``/debug/stalls``, and logs one flight replay —
+        but registers no in-flight call (there is nothing to age out)."""
+        if not self.enabled:
+            return
+        _TRIPS.inc()
+        _STALLS.labels(stage).inc()
+        diag = {
+            "method": method,
+            "kind": "external",
+            "stage": stage,
+            "detail": detail,
+            "age_s": 0.0,
+            "trace_id": None,
+            "since_ns": time.monotonic_ns(),
+        }
+        done = {"t": time.time()}  # tpr: allow(wallclock)
+        done.update(diag)
+        self._history.append(done)
+        _log.warning(
+            "external trip: %s — stage %s (%s)\n%s",
+            method, stage, detail,
+            _flight.RECORDER.dump_text(
+                since_ns=time.monotonic_ns() - 2_000_000_000))
+
     # -- stage attribution ----------------------------------------------------
 
     def _gather_evidence(self, now_ns: int) -> dict:

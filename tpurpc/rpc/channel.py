@@ -2116,7 +2116,7 @@ class UnaryUnary(_MultiCallable):
                     else max(0.0, deadline - time.monotonic()))
 
         results: "queue.Queue[tuple]" = queue.Queue()
-        lock = threading.Lock()
+        lock = make_lock("HedgeOrchestrator._lock")
         calls: dict = {}       # attempt idx -> live Call (for cancellation)
         used_subs: set = set()  # prefer-distinct exclusion, cross-attempt
         done = [False]

@@ -62,6 +62,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from tpurpc.analysis.locks import make_lock
 from tpurpc.obs import flight as _flight
 from tpurpc.utils.trace import TraceFlag
 
@@ -86,8 +87,6 @@ def _postfork_worker_init(shard_id: int, n_shards: int) -> None:
     lock a dead thread still holds. Order matters only for config (the ring
     sizing must land before anything reads it)."""
     import weakref
-
-    from tpurpc.analysis.locks import make_lock
 
     # 1. per-shard cache-resident rings (round-5 working-set effect): N
     # workers share the LLC one ring used to own — scale the configured
@@ -114,7 +113,8 @@ def _postfork_worker_init(shard_id: int, n_shards: int) -> None:
 
     from tpurpc.utils import timers as _timers
 
-    _timers.TimerWheel._instance_lock = threading.Lock()
+    _timers.TimerWheel._instance_lock = make_lock(
+        "TimerWheel._instance_lock")
     _timers.TimerWheel._instance = None
 
     # 3. telemetry: this worker's registry must describe THIS worker.
@@ -123,14 +123,14 @@ def _postfork_worker_init(shard_id: int, n_shards: int) -> None:
     from tpurpc.obs import metrics as _metrics
 
     reg = _metrics.registry()
-    reg._lock = threading.Lock()
+    reg._lock = make_lock("MetricsRegistry._lock")
     for m in reg.metrics().values():
         if isinstance(m, _metrics.FleetGauge):
-            m._lock = threading.Lock()
+            m._lock = make_lock("FleetGauge._lock")
             m._refs = weakref.WeakSet()
             continue
         if hasattr(m, "_lock"):
-            m._lock = threading.Lock()
+            m._lock = make_lock("Metric._lock")
         m.reset()
 
     from tpurpc.obs import profiler as _profiler
@@ -151,7 +151,7 @@ def _postfork_worker_init(shard_id: int, n_shards: int) -> None:
     try:  # tracing buffers: supervisor spans are not this worker's
         from tpurpc.obs import tracing as _tracing
 
-        _tracing._lock = threading.Lock()
+        _tracing._lock = make_lock("tracing._lock")
         _tracing._pending = {}
         _tracing._spans.clear()
     except Exception:
@@ -310,7 +310,7 @@ class ShardedServer:
         self.handoff_policy = handoff_policy
         self.port: Optional[int] = None
         self._workers: List[_Worker] = []
-        self._lock = threading.Lock()
+        self._lock = make_lock("ShardedServer._lock")
         self._stopping = False
         self._started = False
         self._reserve: Optional[socket.socket] = None

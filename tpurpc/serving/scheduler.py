@@ -68,6 +68,7 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from tpurpc.analysis.locks import make_condition, make_lock
 from tpurpc.obs import flight as _flight
 from tpurpc.obs import metrics as _metrics
 from tpurpc.obs import profiler as _profiler
@@ -279,8 +280,11 @@ class DecodeScheduler:
         self.idle_wait_s = idle_wait_s
         self._draining_fn = draining_fn
         self.name = name
-        self._lock = threading.Lock()
-        self._kick = threading.Condition(self._lock)
+        # factory-made (ISSUE 12): TPURPC_DEBUG_LOCKS now covers the
+        # decode loop's one shared edge, and the schedule explorer hooks
+        # the same seam to make boundary-vs-submit races explorable
+        self._lock = make_lock("DecodeScheduler._lock")
+        self._kick = make_condition("DecodeScheduler._kick", self._lock)
         self._waiting: "deque[_Seq]" = deque()
         self._closed = False
         self._draining = False
