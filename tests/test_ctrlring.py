@@ -1,0 +1,574 @@
+"""tpurpc-pulse (ISSUE 13): shared-memory descriptor rings for the
+rendezvous control plane.
+
+Covers the ring protocol itself (post/drain ordering, seq stamping, the
+frame_seq ordering gate, ring-full fallback, the parked/kick handshake,
+nonce verification), the hello-blob negotiation ladder (un-negotiated
+peers and garbage blobs stay framed), the end-to-end zero-control-frames
+steady state, peer death with ring control in flight on both platforms,
+the stale-ring (late write lands in dead memory) rule, the exhaustive
+ringcheck model + its seeded mutants, the watchdog's ``ctrl-ring`` stage,
+the lens ``ctrl`` hop's slowest-hop exclusion, and the coalesced framed
+path (FrameWriter.batch + the migrate burst)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import tpurpc.core.ctrlring as ctrlring
+import tpurpc.core.rendezvous as rdv
+import tpurpc.rpc as tps
+from tpurpc.rpc.channel import Channel
+from tpurpc.rpc.status import RpcError, StatusCode
+
+
+@pytest.fixture
+def fresh_config(monkeypatch):
+    from tpurpc.utils import config as config_mod
+
+    yield monkeypatch
+    config_mod.set_config(None)
+
+
+def _reset_platform(monkeypatch, platform):
+    from tpurpc.utils import config as config_mod
+
+    monkeypatch.setenv("GRPC_PLATFORM_TYPE", platform)
+    config_mod.set_config(None)
+
+
+def _pair_rings():
+    """An (rx, tx) pair the unit tests drive directly: the consumer-owned
+    ring plus a producer window opened from its descriptor."""
+    rx = ctrlring.CtrlRing(kind="shm", nslots=8)
+    desc = rx.descriptor()
+    (nslots, slot_bytes, nbytes, nonce,
+     klen) = ctrlring._DESC.unpack_from(desc)
+    pos = ctrlring._DESC.size
+    kind = desc[pos:pos + klen].decode()
+    handle = desc[pos + klen:].decode()
+    tx = ctrlring.CtrlPeer(kind, handle, nslots, slot_bytes, nbytes, nonce)
+    return rx, tx
+
+
+# ---------------------------------------------------------------------------
+# the ring protocol
+# ---------------------------------------------------------------------------
+
+def test_post_drain_roundtrip_in_order():
+    rx, tx = _pair_rings()
+    try:
+        for i in range(5):
+            assert tx.post(3, 100 + i, bytes([i]) * (i + 1), 0) in (1, 2)
+        got = []
+        n = rx.drain(lambda op, sid, pl: got.append((op, sid, bytes(pl))),
+                     lambda: 0)
+        assert n == 5
+        assert got == [(3, 100 + i, bytes([i]) * (i + 1))
+                       for i in range(5)]
+        assert tx.backlog() == 0  # one cons_head publish per batch
+    finally:
+        tx.close()
+        rx.close()
+
+
+def test_ring_full_refuses_then_recovers():
+    rx, tx = _pair_rings()
+    try:
+        for i in range(rx.nslots):
+            assert tx.post(1, i, b"x", 0)
+        assert tx.post(1, 99, b"x", 0) == 0  # full: framed fallback
+        assert tx.backlog() == rx.nslots
+        got = []
+        rx.drain(lambda *a: got.append(a), lambda: 0)
+        assert len(got) == rx.nslots
+        assert tx.post(1, 99, b"x", 0)  # space returned
+    finally:
+        tx.close()
+        rx.close()
+
+
+def test_oversized_payload_refused():
+    rx, tx = _pair_rings()
+    try:
+        assert tx.post(1, 1, b"y" * (ctrlring.MAX_CTRL_PAYLOAD + 1), 0) == 0
+        assert tx.post(1, 1, b"y" * ctrlring.MAX_CTRL_PAYLOAD, 0)
+    finally:
+        tx.close()
+        rx.close()
+
+
+def test_frame_seq_gate_defers_until_frames_dispatch():
+    """A record stamped with frame_seq N is invisible until the consumer
+    has dispatched N frames — the ordering seam between the ring and the
+    framed path."""
+    rx, tx = _pair_rings()
+    try:
+        assert tx.post(3, 1, b"a", 2)
+        assert tx.post(3, 2, b"b", 4)
+        got = []
+        sink = lambda op, sid, pl: got.append(sid)  # noqa: E731
+        assert rx.drain(sink, lambda: 0) == 0     # both gated
+        assert rx.drain(sink, lambda: 2) == 1     # first passes
+        assert got == [1]
+        assert rx.drain(sink, lambda: 3) == 0     # head-of-line gates
+        assert rx.drain(sink, lambda: 4) == 1
+        assert got == [1, 2]
+    finally:
+        tx.close()
+        rx.close()
+
+
+def test_parked_flag_requests_kick():
+    rx, tx = _pair_rings()
+    try:
+        rx.set_parked(False)
+        assert tx.post(1, 1, b"a", 0) == 1   # consumer polling: no kick
+        rx.set_parked(True)
+        assert tx.post(1, 2, b"b", 0) == 2   # parked: caller must kick
+    finally:
+        tx.close()
+        rx.close()
+
+
+def test_peer_open_rejects_wrong_nonce():
+    rx = ctrlring.CtrlRing(kind="shm", nslots=8)
+    try:
+        desc = rx.descriptor()
+        (nslots, slot_bytes, nbytes, _nonce,
+         klen) = ctrlring._DESC.unpack_from(desc)
+        pos = ctrlring._DESC.size
+        kind = desc[pos:pos + klen].decode()
+        handle = desc[pos + klen:].decode()
+        with pytest.raises(OSError):
+            ctrlring.CtrlPeer(kind, handle, nslots, slot_bytes, nbytes,
+                              b"\x00" * 16)
+    finally:
+        rx.close()
+
+
+def test_stale_ring_write_lands_in_dead_memory():
+    """The satellite claim: a late ring-slot write AFTER link death lands
+    in orphaned memory — never in a ring a new link reads.  The consumer
+    closes (region released on its side); the straggling producer's post
+    hits its still-mapped window without error, and a FRESH ring never
+    observes it."""
+    rx, tx = _pair_rings()
+    rx.close()                      # link death: consumer side gone
+    assert tx.post(3, 7, b"late", 0) in (0, 1, 2)  # no crash either way
+    # a new link allocates a NEW ring (never pooled): the straggler's
+    # bytes are unobservable there
+    rx2, tx2 = _pair_rings()
+    try:
+        got = []
+        assert rx2.drain(lambda *a: got.append(a), lambda: 0) == 0
+        assert got == []
+        assert rx2.drain(lambda *a: got.append(a), lambda: 0) == 0
+    finally:
+        tx2.close()
+        rx2.close()
+        tx.close()
+    # the dead ring's drain is inert too
+    assert rx.drain(lambda *a: None, lambda: 0) == 0
+
+
+def test_plane_negotiation_ladder():
+    """Empty blob (peer predates rings / non-shm), garbage blob, and a
+    valid blob: only the last arms; the rest stay framed."""
+    a = ctrlring.CtrlPlane("test-a")
+    b = ctrlring.CtrlPlane("test-b")
+    try:
+        assert not a.on_hello(b"")          # un-negotiated peer
+        assert not a.armed
+        assert not a.on_hello(b"\x07garbage")
+        assert not a.armed
+        assert a.on_hello(b.hello_blob())   # real descriptor: adopt
+        assert a.armed
+        sent = []
+        assert a.post(3, 1, b"p", 0, kick=lambda: sent.append("kick"))
+        got = []
+        assert b.drain(lambda op, sid, pl: got.append((op, sid)),
+                       lambda: 0) == 1
+        assert got == [(3, 1)]
+    finally:
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# end to end
+# ---------------------------------------------------------------------------
+
+def _sink_server():
+    from tpurpc.jaxshim import add_tensor_method
+
+    srv = tps.Server(max_workers=4, native_dataplane=False)
+
+    def consume(req_iter):
+        total = 0
+        for tree in req_iter:
+            total += np.asarray(tree["x"]).nbytes
+        yield {"bytes": np.int64(total)}
+
+    add_tensor_method(srv, "Sink", consume, kind="stream_stream")
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    return srv, port
+
+
+@pytest.mark.parametrize("platform", ["TCP", "RDMA_BPEV"])
+def test_steady_state_stream_zero_control_frames(fresh_config, platform):
+    """The tentpole claim end to end: after warmup, a stream of standing
+    transfers does one one-sided write + one ring slot per message —
+    ``rdv_ctrl_frames`` stays flat and every control op rides the ring."""
+    _reset_platform(fresh_config, platform)
+    from tpurpc.jaxshim import TensorClient
+    from tpurpc.obs import flight, metrics
+
+    reg = metrics.registry().metrics()
+    srv, port = _sink_server()
+    payload = np.ones((512, 512), np.float32)  # 1 MiB
+    t0 = time.monotonic_ns()
+    try:
+        with Channel(f"127.0.0.1:{port}") as ch:
+            cli = TensorClient(ch)
+
+            def gen(k):
+                for _ in range(k):
+                    yield {"x": payload}
+
+            list(cli.duplex("Sink", gen(2), native=False, timeout=60))
+            frames0 = reg["rdv_ctrl_frames"].snapshot()
+            posts0 = reg["ctrl_ring_posts"].snapshot()
+            sent0 = reg["rdv_transfers_sent"].snapshot()
+            replies = list(cli.duplex("Sink", gen(8), native=False,
+                                      timeout=120))
+            total = int(np.asarray(replies[-1]["bytes"]).ravel()[0])
+            assert total == 8 * payload.nbytes
+            assert reg["rdv_transfers_sent"].snapshot() - sent0 == 8
+            assert reg["rdv_ctrl_frames"].snapshot() - frames0 == 0
+            assert reg["ctrl_ring_posts"].snapshot() - posts0 >= 8
+        evs = [e["event"] for e in flight.snapshot(since_ns=t0)]
+        assert "ctrl-adopt" in evs
+        # the declared ctrl machines hold over everything this emitted
+        from tpurpc.analysis import protocol
+
+        assert protocol.check_events(flight.snapshot(since_ns=t0),
+                                     strict=False) == []
+    finally:
+        srv.stop(grace=1)
+
+
+def test_disabled_env_keeps_framed_control(fresh_config):
+    """TPURPC_CTRL_RING=0: the PR 9 framed control path exactly as it
+    was — transfers still rendezvous, control ops frame."""
+    _reset_platform(fresh_config, "RDMA_BPEV")
+    fresh_config.setenv("TPURPC_CTRL_RING", "0")
+    from tpurpc.jaxshim import TensorClient
+    from tpurpc.obs import metrics
+
+    reg = metrics.registry().metrics()
+    srv, port = _sink_server()
+    payload = np.ones((512, 512), np.float32)
+    try:
+        with Channel(f"127.0.0.1:{port}") as ch:
+            cli = TensorClient(ch)
+
+            def gen(k):
+                for _ in range(k):
+                    yield {"x": payload}
+
+            frames0 = reg["rdv_ctrl_frames"].snapshot()
+            sent0 = reg["rdv_transfers_sent"].snapshot()
+            list(cli.duplex("Sink", gen(4), native=False, timeout=60))
+            assert reg["rdv_transfers_sent"].snapshot() > sent0
+            assert reg["rdv_ctrl_frames"].snapshot() > frames0
+    finally:
+        srv.stop(grace=1)
+
+
+@pytest.mark.parametrize("platform", ["TCP", "RDMA_BPEV"])
+def test_peer_death_with_ring_control_in_flight(fresh_config, platform):
+    """The chaos satellite: kill the peer while descriptor-ring control is
+    mid-transfer (claim observed, COMPLETE never sent).  The victim gets a
+    status (never hangs), the claimed region releases/quarantines, and the
+    protocol checker holds over the dump — ctrl machines included."""
+    from tpurpc.obs import flight
+
+    _reset_platform(fresh_config, platform)
+    flight.RECORDER.reset()
+    srv = tps.Server(max_workers=4, native_dataplane=False)
+    big = b"\x6b" * (1 << 20)
+    srv.add_method("/pulse.S/Big", tps.unary_unary_rpc_method_handler(
+        lambda req, ctx: big))
+    srv.add_method("/pulse.S/Warm", tps.unary_unary_rpc_method_handler(
+        lambda req, ctx: b"ok"))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    wedge = threading.Event()  # never set: the sender wedges after claim
+    outcome: list = []
+    try:
+        with Channel(f"127.0.0.1:{port}") as ch:
+            mc = ch.unary_unary("/pulse.S/Big", tpurpc_native=False)
+            warm = ch.unary_unary("/pulse.S/Warm", tpurpc_native=False)
+            assert bytes(warm(b"w", timeout=30)) == b"ok"
+            t_armed = time.monotonic_ns()
+            # ring control must actually be in flight for this scenario
+            deadline = time.monotonic() + 10
+            adopted = False
+            while not adopted and time.monotonic() < deadline:
+                adopted = any(e["event"] == "ctrl-adopt"
+                              for e in flight.snapshot())
+                time.sleep(0.02)
+            assert adopted, "descriptor ring never adopted"
+            rdv.TEST_HOOKS["wedge_after_claim"] = wedge
+
+            def call():
+                try:
+                    mc(b"x", timeout=60)
+                    outcome.append(("ok",))
+                except RpcError as exc:
+                    outcome.append(("status", exc.code()))
+
+            t = threading.Thread(target=call)
+            t.start()
+            claimed = None
+            deadline = time.monotonic() + 15
+            while claimed is None and time.monotonic() < deadline:
+                time.sleep(0.05)
+                for e in flight.snapshot(since_ns=t_armed):
+                    if e["event"] == "rdv-claim" and e["a1"] != 0:
+                        claimed = e
+                        break
+            assert claimed is not None, "claim never observed"
+            srv.stop(grace=0)  # peer dies with ring control in flight
+            t.join(timeout=30)
+            assert not t.is_alive(), "call hung after peer death"
+            assert outcome and outcome[0][0] == "status", outcome
+            assert outcome[0][1] in (StatusCode.UNAVAILABLE,
+                                     StatusCode.CANCELLED,
+                                     StatusCode.DEADLINE_EXCEEDED), outcome
+            from tpurpc.analysis import protocol
+
+            events = flight.snapshot()
+            tag, lease = claimed["tag"], claimed["a2"]
+            protocol.assert_ordered(
+                events,
+                [("rdv-claim", {"tag": tag, "a2": lease}),
+                 (("conn-dead", "peer-death"), {}),
+                 ("rdv-release", {"tag": tag, "a1": lease})],
+                since_ns=t_armed)
+            assert protocol.check_events(events, strict=False) == []
+    finally:
+        rdv.TEST_HOOKS.pop("wedge_after_claim", None)
+        wedge.set()
+        srv.stop(grace=0)
+
+
+def test_async_domain_complete_stays_framed():
+    """Regression (caught live by the tcpw cross-process test): a COMPLETE
+    whose payload rode an ASYNC landing domain (no host-addressable view —
+    tcp_window records, verbs WRs) must ride the framed path, which the
+    shared record stream sequences after the payload; a ring-posted
+    COMPLETE would overtake the bytes and deliver a torn region."""
+    from tpurpc.core import pair as pair_mod
+
+    framed = []
+    ring = []
+    link = rdv.RdvLink("t", lambda op, sid, pl: framed.append(op),
+                       lambda sid, fl, body: None)
+    link.ctrl_post = lambda op, sid, pl: ring.append(op) or True
+
+    def mk_claim(view):
+        c = rdv._Claim(7, "k", "h", 0, 1 << 20, b"", standing=False)
+        link._windows[("k", "h")] = pair_mod.Window(
+            write=lambda off, data: None, view=view)
+        return c
+
+    # async domain: no view -> framed COMPLETE
+    link.rdv_complete(mk_claim(None), 1, 0, 64)
+    assert framed == [rdv.OP_COMPLETE] and ring == []
+    # sync (view-backed) domain: ring COMPLETE
+    framed.clear()
+    link.rdv_complete(mk_claim(memoryview(bytearray(8))), 1, 0, 64)
+    assert ring == [rdv.OP_COMPLETE] and framed == []
+
+
+# ---------------------------------------------------------------------------
+# the model, the watchdog stage, the lens hop
+# ---------------------------------------------------------------------------
+
+def test_ringcheck_ctrl_model_clean():
+    from tpurpc.analysis import ringcheck
+
+    for res in ringcheck.ctrl_default_suite():
+        assert res.ok, res
+
+
+def test_ringcheck_ctrl_mutants_all_killed():
+    from tpurpc.analysis import ringcheck
+
+    kills = ringcheck.ctrl_mutant_kill_suite()
+    assert set(kills) == set(ringcheck.CTRL_MUTANTS)
+    assert all(kills.values()), kills
+
+
+def test_watchdog_names_ctrl_ring_stage():
+    """An aged ring-full stall bracket (or backlog behind an aged
+    rendezvous edge) attributes to `ctrl-ring`, outranking the generic
+    rendezvous story."""
+    from tpurpc.obs import watchdog as wdmod
+
+    wd = wdmod.StallWatchdog(sweep_s=10, min_stall_s=0.2)
+    now = time.monotonic_ns()
+    ev = {
+        "now_ns": now, "open_lease": 0, "open_edges": {},
+        "open_rdv": {(7, "o", 1): now - int(2e9)},
+        "open_ctrl": {7: now - int(2e9)},
+        "ctrl_ring_backlog": 3,
+        "open_swap": {}, "open_mig": {}, "open_step": {},
+        "last_step_end_ns": 0, "last_step_batch": 0, "last_h2_ns": 0,
+        "pairs_write_stalled": 0, "batcher_queue_depth": 0,
+        "pairs_msg_waiting": 0, "decode_waiting": 0, "decode_running": 0,
+    }
+    stage, detail = wd._attribute(ev, "client", int(2e9))
+    assert stage == "ctrl-ring", (stage, detail)
+    # without ring evidence the rendezvous story is untouched
+    ev2 = dict(ev, open_ctrl={}, ctrl_ring_backlog=0)
+    stage2, _ = wd._attribute(ev2, "client", int(2e9))
+    assert stage2 == "rendezvous"
+    assert "ctrl-ring" in wdmod.STAGES
+
+
+def test_lens_ctrl_hop_declared_and_excluded_from_slowest():
+    """The `ctrl` hop exists, and the <1%-of-bulk-bytes rule keeps a
+    control-only hop out of the slowest-hop argmin."""
+    from tpurpc.obs import lens
+
+    assert "ctrl" in lens.HOP_NAMES
+    rows = [
+        {"hop": "rendezvous", "bytes": 1 << 30, "busy_ms": 500.0,
+         "gbps": 2.0, "copy_bytes": 0, "what": ""},
+        {"hop": "ctrl", "bytes": 4096, "busy_ms": 400.0,
+         "gbps": 0.00001, "copy_bytes": 0, "what": ""},
+    ]
+    assert lens.slowest_hop(rows) == "rendezvous"
+
+
+# ---------------------------------------------------------------------------
+# the coalesced framed path (satellite: one writev per burst)
+# ---------------------------------------------------------------------------
+
+class _FakeEndpoint:
+    def __init__(self):
+        self.writes = []
+
+    def write(self, segs):
+        if isinstance(segs, (bytes, bytearray, memoryview)):
+            segs = [segs]
+        self.writes.append(b"".join(bytes(s) for s in segs))
+
+
+def test_framewriter_batch_one_writev():
+    from tpurpc.rpc import frame as fr
+
+    ep = _FakeEndpoint()
+    w = fr.FrameWriter(ep)
+    with w.batch():
+        for sid in (1, 3, 5):
+            w.send_many([(fr.HEADERS, 0, sid, b"h" * 8),
+                         (fr.MESSAGE, fr.FLAG_END_STREAM, sid, b"m" * 16)])
+    assert len(ep.writes) == 1  # six frames, ONE gathered writev
+    assert w.frames_sent == 6
+    # order inside the batch is issue order
+    r = fr.FrameReader(_ReplayEndpoint(ep.writes[0]))
+    seen = []
+    while True:
+        f = r.read_frame()
+        if f is None:
+            break
+        seen.append((f.type, f.stream_id))
+    assert seen == [(fr.HEADERS, 1), (fr.MESSAGE, 1), (fr.HEADERS, 3),
+                    (fr.MESSAGE, 3), (fr.HEADERS, 5), (fr.MESSAGE, 5)]
+
+
+class _ReplayEndpoint:
+    def __init__(self, blob):
+        self._blob = memoryview(bytes(blob))
+        self._pos = 0
+
+    def read_into(self, dst, timeout=None):
+        n = min(len(dst), len(self._blob) - self._pos)
+        dst[:n] = self._blob[self._pos:self._pos + n]
+        self._pos += n
+        return n
+
+
+def test_ctrl_frame_coalescer_self_clocking():
+    """Ops arriving while a flush is in flight drain in ONE multi-op
+    send — PR 3's self-clocking writev discipline on the control path."""
+    sent_single = []
+    sent_multi = []
+    gate = threading.Event()
+    release = threading.Event()
+
+    def send_op(op, sid, payload):
+        sent_single.append((op, sid))
+        gate.set()
+        release.wait(5)
+
+    def send_ops(ops):
+        sent_multi.append([o[:2] for o in ops])
+
+    co = rdv._CtrlFrameCoalescer(send_op, send_ops)
+    t = threading.Thread(target=lambda: co.send(3, 1, b"a"))
+    t.start()
+    assert gate.wait(5)  # first op mid-flush
+    co.send(3, 2, b"b")  # queue while in flight
+    co.send(3, 3, b"c")
+    release.set()
+    t.join(5)
+    assert sent_single == [(3, 1)]
+    assert sent_multi == [[(3, 2), (3, 3)]]  # one flush for the burst
+
+
+def test_migrate_burst_one_writev(fresh_config):
+    """The disagg satellite end to end: migrating several sequences
+    flushes the OfferKv burst (and the CompleteKv burst) as coalesced
+    writevs — the ctrl_call_batch histogram records multi-frame batches —
+    and every sequence resumes exactly at the peer."""
+    _reset_platform(fresh_config, "TCP")
+    from tpurpc.jaxshim.generate import ToyDecodeModel
+    from tpurpc.serving.disagg import DisaggClient, migrate, serve_decode
+    from tpurpc.utils import stats as _st
+
+    model_a = ToyDecodeModel(step_delay_s=0.004)
+    model_b = ToyDecodeModel(step_delay_s=0.004)
+    srv_a, port_a, sched_a, state_a = serve_decode(
+        model_a, kv_blocks=256, name="pulse-src")
+    srv_b, port_b, sched_b, state_b = serve_decode(
+        model_b, kv_blocks=256, name="pulse-dst")
+    ch_b = Channel(f"127.0.0.1:{port_b}")
+    try:
+        prompts = [[3, 1, 4, 1], [2, 7, 1, 8], [1, 6, 1, 8]]
+        streams = [sched_a.submit(np.array(p, np.int32), max_tokens=200)
+                   for p in prompts]
+        for s in streams:  # a few tokens so KV exists
+            for _ in range(3):
+                s.next(timeout=5)
+        _st.reset_batch_stats()
+        moved, failed = migrate(state_a, ch_b, f"127.0.0.1:{port_b}")
+        assert moved == 3 and failed == 0, (moved, failed)
+        hist = _st.batch_snapshot().get("ctrl_call_batch") or {}
+        assert hist.get("count", 0) >= 1
+        assert hist.get("p99", 0) >= 3, hist  # 3 offers in one writev
+    finally:
+        ch_b.close()
+        for srv, sched, state in ((srv_a, sched_a, state_a),
+                                  (srv_b, sched_b, state_b)):
+            srv.stop(grace=0)
+            sched.close()       # deregister from /healthz (test isolation)
+            state.close()
+            state.mgr.close()

@@ -94,6 +94,17 @@ note "tpurpc-express rendezvous smoke (8 MiB, shm + TCP, zero-copy ledger)"
 TPURPC_FLIGHT_DUMP="$FLIGHT_DUMPS" JAX_PLATFORMS=cpu \
     python -m tpurpc.tools.rendezvous_smoke || fail=1
 
+# 2g1b) tpurpc-pulse smoke (ISSUE 13): descriptor-ring control plane —
+#      a server SUBPROCESS and the client stream 1 MiB tensors over shm
+#      rings with ring adoption asserted via flight, ZERO framed control
+#      ops on either side after warmup (every OFFER/CLAIM/COMPLETE rides
+#      the ring), and an induced stuck ring (frozen consumers) attributed
+#      to the `ctrl-ring` watchdog stage before the framed fallback
+#      completes the call. ~15s, jax on cpu.
+note "tpurpc-pulse ctrlring smoke (2 processes, zero control frames)"
+TPURPC_FLIGHT_DUMP="$FLIGHT_DUMPS" JAX_PLATFORMS=cpu \
+    python -m tpurpc.tools.ctrlring_smoke || fail=1
+
 # 2g2) tpurpc-cadence smoke (ISSUE 10): interactive + batch clients
 #      stream off one continuous-batching decode server — per-token order
 #      + exact reference values, a mid-decode join between step events,

@@ -145,6 +145,10 @@ FLIGHT_HOT_MODULES = HOT_LOG_MODULES + (
     # high-rate path; same pure-int discipline
     os.path.join("tpurpc", "serving", "kv.py"),
     os.path.join("tpurpc", "serving", "disagg.py"),
+    # tpurpc-pulse (ISSUE 13): descriptor-ring emission sites run at
+    # adoption/flip/stall edges on the control hot path — same pure-int
+    # discipline, interned plane tag
+    os.path.join("tpurpc", "core", "ctrlring.py"),
 )
 
 #: module suffix -> qualified functions on its INLINE DISPATCH path (the

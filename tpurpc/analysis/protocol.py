@@ -465,11 +465,68 @@ def _mk_conn() -> Machine:
                  "before the peer's first connect")
 
 
+def _mk_ctrl_ring() -> Machine:
+    F = _flight
+
+    def token(ev):
+        c = ev.get("code")
+        if c == F.CTRL_ADOPT:
+            return "adopt"
+        if c == F.CTRL_SPIN:
+            return "spin"
+        if c == F.CTRL_PARK:
+            return "park"
+        return None
+
+    def key(ev):
+        return (ev.get("tag"),)
+
+    # the tag is "ctrl:<peer>" — shared across reconnects to one peer, so
+    # re-adoption from any state is legal; what the machine proves is that
+    # no consumer ever spins or parks a ring that was never adopted
+    return Machine(
+        "ctrl-ring", token, key,
+        openers={"adopt": "parked"},
+        transitions={
+            ("parked", "spin"): "hot",
+            ("parked", "park"): "parked",
+            ("parked", "adopt"): "parked",
+            ("hot", "park"): "parked",
+            ("hot", "spin"): "hot",
+            ("hot", "adopt"): "parked",
+        },
+        terminal=(),
+        describe="descriptor-ring consumer lifecycle: no spin/park flip "
+                 "before the link adopted a ring")
+
+
+def _mk_ctrl_stall() -> Machine:
+    F = _flight
+
+    def token(ev):
+        c = ev.get("code")
+        if c == F.CTRL_STALL_BEGIN:
+            return "begin"
+        if c == F.CTRL_STALL_END:
+            return "end"
+        return None
+
+    def key(ev):
+        return (ev.get("tag"),)
+
+    return Machine(
+        "ctrl-stall", token, key,
+        openers={"begin": "stalled"},
+        transitions={("stalled", "end"): "done"},
+        describe="ring-full stall brackets pair per link: no END without "
+                 "BEGIN, no nesting")
+
+
 #: every declared machine, in evaluation order
 MACHINES: List[Machine] = [
     _mk_rdv_lease(), _mk_rdv_offer(), _mk_kv_swap(), _mk_migration(),
     _mk_kv_ship(), _mk_gen_step(), _mk_hedge(), _mk_drain(), _mk_subch(),
-    _mk_conn(),
+    _mk_conn(), _mk_ctrl_ring(), _mk_ctrl_stall(),
 ]
 
 
@@ -614,6 +671,14 @@ def _good_trace() -> List[dict]:
           _ev(F.MIG_END, tag=4, a1=9, a2=1, t_ns=next(t)),
           _ev(F.KV_SHIP_OFFER, tag=5, a1=77, a2=4096, t_ns=next(t)),
           _ev(F.KV_SHIP_COMPLETE, tag=5, a1=77, a2=4096, t_ns=next(t))]
+    # descriptor-ring control plane: adopt, hot/parked flips, one ring-full
+    # stall bracket (tpurpc-pulse)
+    e += [_ev(F.CTRL_ADOPT, tag=8, a1=64, a2=128, t_ns=next(t)),
+          _ev(F.CTRL_SPIN, tag=8, a1=0, t_ns=next(t)),
+          _ev(F.CTRL_PARK, tag=8, a1=12, t_ns=next(t)),
+          _ev(F.CTRL_SPIN, tag=8, a1=12, t_ns=next(t)),
+          _ev(F.CTRL_STALL_BEGIN, tag=8, a1=64, t_ns=next(t)),
+          _ev(F.CTRL_STALL_END, tag=8, t_ns=next(t))]
     # hedging, drain, ejection
     e += [_ev(F.HEDGE_FIRED, tag=6, a1=1, t_ns=next(t)),
           _ev(F.HEDGE_WON, tag=6, a1=0, t_ns=next(t)),
@@ -674,6 +739,15 @@ def machine_mutants() -> Dict[str, List[dict]]:
         ],
         "first_ok_without_connect": [
             _ev(F.CALL_FIRST_OK, tag=1, t_ns=1),
+        ],
+        # tpurpc-pulse: the descriptor-ring machines' teeth
+        "ctrl_spin_before_adopt": [
+            _ev(F.CTRL_SPIN, tag=8, a1=0, t_ns=1),
+            _ev(F.CTRL_ADOPT, tag=8, a1=64, a2=128, t_ns=2),
+        ],
+        "ctrl_stall_end_without_begin": [
+            _ev(F.CTRL_ADOPT, tag=8, a1=64, a2=128, t_ns=1),
+            _ev(F.CTRL_STALL_END, tag=8, t_ns=2),
         ],
     }
 

@@ -1095,6 +1095,269 @@ def kv_mutant_kill_suite(verbose: bool = False) -> Dict[str, bool]:
     return out
 
 
+# -- ctrl descriptor-ring model (tpurpc-pulse, ISSUE 13) ----------------------
+#
+# Models tpurpc/core/ctrlring.py — the shared-memory descriptor ring carrying
+# rendezvous control ops (OFFER/CLAIM/COMPLETE/RELEASE) between two processes
+# — at word granularity: every slot word store, the per-batch cons_head
+# publish, the parked-flag handshake and the framed kick are each one atomic
+# step, exhaustively interleaved.
+#
+#   producer (per op): read cons_head; FULL (seq - cons_head >= nslots) =>
+#             step disabled (the implementation falls back to the framed
+#             path; either way it must never overwrite) | store payload
+#             words (unordered group) | store the seq stamp STRICTLY after |
+#             read parked; if set, enqueue one framed kick
+#   consumer: poll the head slot's stamp; == head+1 => read payload words
+#             (torn check per word), consume; publish cons_head at a
+#             NONDETERMINISTIC moment (covers every batching) | park: set
+#             parked, then MANDATORY re-check once, then block until a kick
+#   death:    with_death=True explores producer death at every point; the
+#             consumer may then close — delivered must be an in-order prefix
+#
+# Invariants: every op delivered exactly once in order, untorn; no store on
+# an unconsumed slot; no wedged quiescent state (a lost wakeup IS a wedge).
+
+CTRL_MUTANTS = (
+    "ctrl_publish_before_write",   # stamp stored before/with the payload —
+    #                                the consumer reads a torn record
+    "ctrl_reuse_before_doorbell",  # producer skips the cons_head full
+    #                                check — laps the unconsumed reader
+    "ctrl_park_no_redrain",        # consumer parks without the mandatory
+    #                                re-check — the post/park race loses
+    #                                the wakeup and the link wedges
+)
+
+_C_ZERO = ("czero",)
+
+
+def check_ctrlring(nslots: int = 2, ops: int = 3, words: int = 2,
+                   with_death: bool = False, mutant: Optional[str] = None,
+                   max_states: int = 2_000_000) -> CheckResult:
+    """Exhaustively interleave the producer, the consumer and the framed
+    kick queue over one descriptor ring."""
+    if mutant is not None and mutant not in CTRL_MUTANTS:
+        raise ValueError(f"unknown mutant {mutant!r}; known: {CTRL_MUTANTS}")
+    cfg = (f"ctrlring nslots={nslots} ops={ops} words={words} "
+           f"death={with_death} mutant={mutant}")
+    # state:
+    #  (mem,          nslots*(words+1) words: [stamp, payload...] per slot
+    #   cons_pub,     published cons_head (shared word)
+    #   parked,       consumer-parked flag (shared word)
+    #   kicks,        framed kick queue depth (ordered, lossless)
+    #   p_seq, p_pending, p_alive,
+    #   c_head, c_phase, c_idx, c_unpub, received, closed)
+    # c_phase: "poll" | "park_chk" | "parked" | ("copy", idx done via c_idx)
+    init = ((_C_ZERO,) * (nslots * (words + 1)), 0, 0, 0,
+            0, (), True,
+            0, "poll", 0, 0, (), False)
+    visited = set()
+    stack: List[Tuple[tuple, Tuple[str, ...]]] = [(init, ())]
+    states = 0
+    try:
+        while stack:
+            state, trace = stack.pop()
+            if state in visited:
+                continue
+            visited.add(state)
+            states += 1
+            if states > max_states:
+                raise RuntimeError(
+                    f"state space exceeds {max_states} states ({cfg})")
+            succ = _ctrl_successors(state, nslots, ops, words, with_death,
+                                    mutant, trace)
+            if not succ:
+                _ctrl_quiescent(state, ops, trace)
+                continue
+            stack.extend(succ)
+    except Violation as v:
+        return CheckResult(False, states, v, cfg)
+    return CheckResult(True, states, None, cfg)
+
+
+def _ctrl_quiescent(state, ops, trace) -> None:
+    (mem, cons_pub, parked, kicks, p_seq, p_pending, p_alive,
+     c_head, c_phase, c_idx, c_unpub, received, closed) = state
+    if p_alive:
+        if p_seq < ops or p_pending:
+            raise Violation(
+                "stuck", f"producer wedged at op {p_seq}/{ops}",
+                list(trace))
+        if len(received) != ops:
+            # the park-without-redrain mutant's signature: a posted record
+            # ages in the ring while the consumer sleeps with no kick
+            raise Violation(
+                "stuck", f"quiescent with {len(received)}/{ops} ops "
+                "delivered and the consumer parked — a lost wakeup",
+                list(trace))
+        if list(received) != list(range(ops)):
+            raise Violation("order", f"ops delivered as {received}",
+                            list(trace))
+    else:
+        got = list(received)
+        if got != list(range(len(got))):
+            raise Violation(
+                "order", f"out-of-order deliveries {received} before the "
+                "producer's death", list(trace))
+
+
+def _ctrl_successors(state, nslots, ops, words, with_death, mutant, trace):
+    (mem, cons_pub, parked, kicks, p_seq, p_pending, p_alive,
+     c_head, c_phase, c_idx, c_unpub, received, closed) = state
+    succ = []
+
+    def mk(mem=mem, cons_pub=cons_pub, parked=parked, kicks=kicks,
+           p_seq=p_seq, p_pending=p_pending, p_alive=p_alive,
+           c_head=c_head, c_phase=c_phase, c_idx=c_idx, c_unpub=c_unpub,
+           received=received, closed=closed, step=""):
+        return ((mem, cons_pub, parked, kicks, p_seq, p_pending, p_alive,
+                 c_head, c_phase, c_idx, c_unpub, received, closed),
+                trace + (step,))
+
+    def slot_base(seq):
+        return (seq % nslots) * (words + 1)
+
+    # ---- producer ----
+    if p_alive and p_pending:
+        group = p_pending[0]
+        for op in group:
+            rest_group = tuple(o for o in group if o is not op)
+            rest = ((rest_group,) + p_pending[1:] if rest_group
+                    else p_pending[1:])
+            if op[0] == "st":
+                _, seq, widx, word = op
+                # overwrite check: the slot's previous-lap record must be
+                # CONSUMED before any store lands on it
+                prev = seq - nslots
+                if prev >= 0 and c_head <= prev:
+                    raise Violation(
+                        "overwrite",
+                        f"producer store for op {seq} laps the unconsumed "
+                        f"consumer (head {c_head}, slot lap {prev})",
+                        list(trace) + [f"p:st{seq}.{widx}"])
+                nm = list(mem)
+                nm[slot_base(seq) + widx] = word
+                succ.append(mk(mem=tuple(nm), p_pending=rest,
+                               step=f"p:st{seq}.{widx}"))
+            elif op[0] == "chk_parked":
+                # read parked AFTER the stamp store: kick when set
+                if parked:
+                    succ.append(mk(p_pending=rest, kicks=kicks + 1,
+                                   step="p:kick"))
+                else:
+                    succ.append(mk(p_pending=rest, step="p:chk"))
+    elif p_alive and p_seq < ops:
+        # begin the next op: fold the published cons_head, check space
+        full = p_seq - cons_pub >= nslots
+        if mutant == "ctrl_reuse_before_doorbell":
+            full = False  # MUTANT: no full check at all
+        if not full:
+            payload = tuple(("st", p_seq, 1 + j, ("pay", p_seq, j))
+                            for j in range(words))
+            stamp = ("st", p_seq, 0, ("stamp", p_seq + 1))
+            if mutant == "ctrl_publish_before_write":
+                # MUTANT: stamp and payload land in one unordered group
+                groups = (payload + (stamp,), (("chk_parked",),))
+            else:
+                groups = (payload, (stamp,), (("chk_parked",),))
+            succ.append(mk(p_seq=p_seq + 1, p_pending=groups,
+                           step=f"p:begin{p_seq}"))
+    if with_death and p_alive:
+        succ.append(mk(p_alive=False, step="p:die"))
+
+    # ---- consumer ----
+    if not closed:
+        base = slot_base(c_head)
+        ready = mem[base] == ("stamp", c_head + 1)
+        if c_phase == "poll":
+            if c_idx == 0 and not ready:
+                # nothing readable: the consumer MAY decide to park (it
+                # may also just keep polling — both schedules explored).
+                # The DECISION and the flag store are separate steps: the
+                # producer can stamp-and-check-parked in the gap, which is
+                # exactly the race the mandatory re-drain closes.
+                succ.append(mk(c_phase="park_intent", step="c:park_decide"))
+            if ready or c_idx > 0:
+                if c_idx < words:
+                    word = mem[base + 1 + c_idx]
+                    if word != ("pay", c_head, c_idx):
+                        raise Violation(
+                            "torn", f"consumer read {word} for op "
+                            f"{c_head} word {c_idx}",
+                            list(trace) + [f"c:r{c_idx}"])
+                    succ.append(mk(c_idx=c_idx + 1, step=f"c:r{c_idx}"))
+                else:
+                    succ.append(mk(c_head=c_head + 1, c_idx=0,
+                                   c_unpub=c_unpub + 1,
+                                   received=received + (c_head,),
+                                   step="c:done"))
+            if kicks:  # absorb a stale kick (a frame read, no-op)
+                succ.append(mk(kicks=kicks - 1, step="c:kick_absorb"))
+        elif c_phase == "park_intent":
+            succ.append(mk(parked=1, c_phase="park_chk",
+                           step="c:park_flag"))
+        elif c_phase == "park_chk":
+            # the MANDATORY re-check between flag store and blocking —
+            # the lost-wakeup close the ctrl_park_no_redrain mutant skips
+            if mutant == "ctrl_park_no_redrain":
+                succ.append(mk(c_phase="parked", step="c:parked!blind"))
+            elif ready:
+                succ.append(mk(parked=0, c_phase="poll",
+                               step="c:unpark_found"))
+            else:
+                succ.append(mk(c_phase="parked", step="c:parked"))
+        elif c_phase == "parked":
+            if kicks:
+                succ.append(mk(kicks=kicks - 1, parked=0, c_phase="poll",
+                               step="c:woken"))
+        if c_unpub:
+            # publish cons_head: one shared-word store, any moment with
+            # unpublished progress (covers every batch size)
+            succ.append(mk(cons_pub=c_head, c_unpub=0, step="c:publish"))
+    # close after producer death (the link teardown wakes the reader)
+    if not p_alive and not closed:
+        succ.append(mk(closed=True, parked=0, step="c:close"))
+
+    return succ
+
+
+def ctrl_default_suite(verbose: bool = False) -> List[CheckResult]:
+    """Clean ctrl-ring configs: wrap (ops > nslots), both with and without
+    producer-death-at-every-point."""
+    configs = [
+        dict(nslots=2, ops=3, words=2),
+        dict(nslots=2, ops=4, words=1),
+        dict(nslots=3, ops=4, words=2),
+        dict(nslots=2, ops=3, words=2, with_death=True),
+    ]
+    out = []
+    for cfg in configs:
+        res = check_ctrlring(**cfg)
+        out.append(res)
+        if verbose:
+            print(f"  {res!r}")
+    return out
+
+
+def ctrl_mutant_kill_suite(verbose: bool = False) -> Dict[str, bool]:
+    """Every seeded ctrl-ring mutant must produce a violation."""
+    out = {}
+    for mutant in CTRL_MUTANTS:
+        killed = False
+        for cfg in (dict(nslots=2, ops=3, words=2),
+                    dict(nslots=2, ops=4, words=1)):
+            res = check_ctrlring(mutant=mutant, **cfg)
+            if not res.ok:
+                killed = True
+                if verbose:
+                    print(f"  mutant {mutant}: KILLED — {res.violation}")
+                break
+        if not killed and verbose:
+            print(f"  mutant {mutant}: SURVIVED")
+        out[mutant] = killed
+    return out
+
+
 # -- suites ------------------------------------------------------------------
 
 def default_suite(verbose: bool = False) -> List[CheckResult]:
@@ -1118,6 +1381,7 @@ def default_suite(verbose: bool = False) -> List[CheckResult]:
     out.extend(handoff_default_suite(verbose=verbose))
     out.extend(rendezvous_default_suite(verbose=verbose))
     out.extend(kv_default_suite(verbose=verbose))
+    out.extend(ctrl_default_suite(verbose=verbose))
     return out
 
 
@@ -1193,4 +1457,5 @@ def mutant_kill_suite(verbose: bool = False) -> Dict[str, bool]:
     out.update(handoff_mutant_kill_suite(verbose=verbose))
     out.update(rendezvous_mutant_kill_suite(verbose=verbose))
     out.update(kv_mutant_kill_suite(verbose=verbose))
+    out.update(ctrl_mutant_kill_suite(verbose=verbose))
     return out

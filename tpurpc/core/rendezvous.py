@@ -76,6 +76,7 @@ from tpurpc.obs import lens as _lens
 from tpurpc.obs import metrics as _metrics
 from tpurpc.obs import profiler as _profiler
 from tpurpc.tpu import ledger as _ledger
+from tpurpc.utils import stats as _stats
 
 __all__ = [
     "LandingPool", "RegionLease", "RdvLink", "landing_pool",
@@ -104,6 +105,16 @@ _RDV_SENT = _metrics.counter("rdv_transfers_sent")
 _RDV_RECV = _metrics.counter("rdv_transfers_received")
 _RDV_FALLBACK = _metrics.counter("rdv_fallbacks")
 _RDV_REFUSED = _metrics.counter("rdv_claims_refused")
+#: control ops that rode the FRAMED path (tpurpc-pulse: a descriptor-ring
+#: link in steady state holds this flat — the ctrlring smoke and bench's
+#: ctrl_wakeups_per_msg both read it as the zero-control-frames proof)
+_RDV_CTRL_FRAMES = _metrics.counter("rdv_ctrl_frames")
+
+# tpurpc-pulse: framed control sends are control-plane busy time — same
+# hop as the descriptor-ring posts/drains in core/ctrlring.py, so the
+# waterfall shows the whole control plane's busy share in one row
+_LENS_CTRL_BYTES, _LENS_CTRL_NS, _LENS_CTRL_COPY = _lens.hop_counters(
+    "ctrl")
 
 # -- control ops (canonical small ints; each wire plane maps them onto its
 #    own frame vocabulary — frame.py types 8..11, h2 extension-frame flags)
@@ -566,6 +577,56 @@ def _unpack_claim(payload) -> Tuple[int, Optional[_Claim]]:
 # The link: one per framed connection, both roles.
 # ---------------------------------------------------------------------------
 
+class _CtrlFrameCoalescer:
+    """Self-clocking writev combiner for FRAMED control ops — PR 3's
+    FrameWriter discipline applied to the rendezvous control plane's cold
+    path: the first sender flushes directly; ops arriving while a flush is
+    in flight queue and drain in ONE multi-frame send (``send_ops``), so a
+    burst of COMPLETEs from N streams costs one transport write instead of
+    N.  An idle link pays zero added latency (no timer).  Transports
+    without a multi-op send (``send_ops=None`` — the h2 planes) send
+    per-op; FIFO order is preserved either way."""
+
+    _GUARDED_BY = {"_pending": "_mu", "_flushing": "_mu"}
+
+    def __init__(self, send_op: Callable[[int, int, bytes], None],
+                 send_ops: Optional[Callable] = None):
+        self._send_op = send_op
+        self._send_ops = send_ops
+        self._mu = make_lock("_CtrlFrameCoalescer._mu")
+        self._pending: List[Tuple[int, int, bytes]] = []
+        self._flushing = False
+
+    def send(self, op: int, stream_id: int, payload: bytes) -> None:
+        if self._send_ops is None:
+            self._send_op(op, stream_id, payload)
+            return
+        with self._mu:
+            self._pending.append((op, stream_id, payload))
+            if self._flushing:
+                return  # the in-flight flusher writes it
+            self._flushing = True
+        while True:
+            with self._mu:
+                batch, self._pending = self._pending, []
+                if not batch:
+                    self._flushing = False
+                    return
+            try:
+                if len(batch) == 1:
+                    self._send_op(*batch[0])
+                else:
+                    self._send_ops(batch)
+                    _stats.batch_hist("ctrl_coalesce").record(len(batch))
+            except BaseException:
+                # connection dying: drop the queue (every control path
+                # treats sends as best-effort; link close releases leases)
+                with self._mu:
+                    self._pending = []
+                    self._flushing = False
+                raise
+
+
 class RdvLink:
     """Rendezvous state for ONE framed connection: the sender role (offer,
     one-sided write, complete) and the receiver role (pool leases, claims,
@@ -589,8 +650,17 @@ class RdvLink:
                  deliver: Callable[[int, int, object], None],
                  pool_kinds: Sequence[str] = ("shm",),
                  open_kinds: Sequence[str] = ("shm", "local"),
-                 pump: Optional[Callable] = None):
+                 pump: Optional[Callable] = None,
+                 send_ops: Optional[Callable] = None):
         self._send_op = send_op
+        self._coalescer = _CtrlFrameCoalescer(send_op, send_ops)
+        #: tpurpc-pulse seams, bound by the owning connection when its
+        #: descriptor-ring plane arms: ``ctrl_post(op, sid, payload) ->
+        #: bool`` places a control op in the peer's ring (True = the
+        #: framed path must NOT also send it); ``ctrl_drain()`` consumes
+        #: this side's ring from a sender thread (pregrant pickup)
+        self.ctrl_post: Optional[Callable[[int, int, bytes], bool]] = None
+        self.ctrl_drain: Optional[Callable[[], int]] = None
         self._deliver = deliver
         self._pool_kinds = tuple(pool_kinds)
         self._open_kinds = tuple(open_kinds)
@@ -627,6 +697,39 @@ class RdvLink:
         (hello PING on the native framing, the custom SETTINGS id on h2)."""
         self.negotiated = True
 
+    # -- control send seam (tpurpc-pulse) -------------------------------------
+
+    def _ctrl_send(self, op: int, stream_id: int, payload: bytes,
+                   ring_ok: bool = True) -> None:
+        """Send one control op: descriptor ring when the link adopted one
+        (zero frames, zero wakeups), else the framed path through the
+        self-clocking coalescer.  Ring failures (full, closed, oversized)
+        degrade to framed — never a lost op, never an exception for the
+        degradation itself; framed-path transport errors propagate exactly
+        as ``send_op``'s always did.
+
+        ``ring_ok=False`` pins the op to the framed path: a COMPLETE whose
+        payload rode an ASYNCHRONOUS landing domain (tcp_window records,
+        verbs WRs — anything without a host-addressable view) is ordered
+        after the payload only by the shared record/QP stream the framed
+        connection rides; a ring-posted COMPLETE would overtake the bytes
+        and deliver a torn region (caught live by the tcpw cross-process
+        test)."""
+        post = self.ctrl_post
+        if post is not None and ring_ok:
+            try:
+                if post(op, stream_id, payload):
+                    return
+            except Exception:
+                pass  # ring tearing down: the framed path still works
+        t0 = time.monotonic_ns()
+        self._coalescer.send(op, stream_id, payload)
+        _RDV_CTRL_FRAMES.inc()
+        n = len(payload)
+        dt = time.monotonic_ns() - t0
+        _LENS_CTRL_BYTES.inc(n)
+        _LENS_CTRL_NS.inc(dt)
+
     # -- sender role ---------------------------------------------------------
 
     def eligible(self, total: int, flags_compressed: bool = False) -> bool:
@@ -643,6 +746,23 @@ class RdvLink:
         timeout, write failure — never an exception for fallback cases."""
         cls = size_class(total)
         claim = self._take_grant(cls, total)
+        if claim is None and self._has_standing(cls, total):
+            # tpurpc-pulse: every standing region's doorbell is behind —
+            # the consumer is mid-batch.  A solicited claim here costs a
+            # full control round trip (~0.8 ms on this rig); a bounded
+            # yield-poll of the doorbells (draining our ctrl ring for
+            # pregrant top-ups as we go) hands the core to the consumer
+            # and almost always turns up a freed region in a few slices.
+            deadline = time.monotonic() + 0.002
+            drain = self.ctrl_drain
+            while claim is None and time.monotonic() < deadline:
+                if drain is not None:
+                    try:
+                        drain()
+                    except Exception:
+                        drain = None
+                time.sleep(0)
+                claim = self._take_grant(cls, total)
         if claim is None:
             claim = self.rdv_claim(stream_id, total, cls)
         if claim is None:
@@ -693,6 +813,14 @@ class RdvLink:
                 claim.inflight = False
         return None
 
+    def _has_standing(self, cls: int, total: int) -> bool:
+        """Any STANDING cached grant big enough (busy or not) — the signal
+        that a freed doorbell, not a new claim, is what's worth waiting
+        a moment for."""
+        with self._lock:
+            bucket = self._grants.get(cls) or ()
+            return any(c.standing and c.capacity >= total for c in bucket)
+
     def _standing_free(self, claim: _Claim) -> bool:
         """Has the receiver's consumer freed every previous use? Reads the
         region-resident doorbell word through the sender's mapped window —
@@ -735,7 +863,7 @@ class RdvLink:
             self._reqs[req] = st
         _flight.emit(_flight.RDV_OFFER, self._ftag, req, total)
         try:
-            self._send_op(OP_OFFER, stream_id,
+            self._ctrl_send(OP_OFFER, stream_id,
                           _pack_offer(req, total, self._open_kinds))
         except Exception:
             with self._lock:
@@ -764,7 +892,7 @@ class RdvLink:
             # on_claim's unknown-request path
             _flight.emit(_flight.RDV_RELEASE, self._ftag, 0, req)
             try:
-                self._send_op(OP_RELEASE, 0, _RELEASE.pack(0, req))
+                self._ctrl_send(OP_RELEASE, 0, _RELEASE.pack(0, req))
             except Exception:
                 pass
             return None
@@ -843,15 +971,23 @@ class RdvLink:
         with self._lock:
             claim.used += 1
             claim.inflight = False
-        self._send_op(OP_COMPLETE, stream_id,
-                      _COMPLETE.pack(claim.lease_id, total, flags & 0xFF))
+            # a view-backed (synchronous shm/local) landing write is
+            # visible the moment it returns, so its COMPLETE may ride the
+            # ring; an async domain's bytes are still in flight on the
+            # record/QP stream — only the framed path (same stream)
+            # sequences the COMPLETE after them
+            win = self._windows.get((claim.kind, claim.handle))
+        sync_write = win is not None and win.view is not None
+        self._ctrl_send(OP_COMPLETE, stream_id,
+                        _COMPLETE.pack(claim.lease_id, total, flags & 0xFF),
+                        ring_ok=sync_write)
 
     def rdv_release(self, claim: _Claim) -> None:
         """Abandon a claimed region without completing (write failure,
         cancelled transfer): the peer frees it for reuse."""
         _flight.emit(_flight.RDV_RELEASE, self._ftag, claim.lease_id, 0)
         try:
-            self._send_op(OP_RELEASE, 0, _RELEASE.pack(claim.lease_id, 0))
+            self._ctrl_send(OP_RELEASE, 0, _RELEASE.pack(claim.lease_id, 0))
         except Exception:
             pass
 
@@ -883,7 +1019,7 @@ class RdvLink:
         lease = self._lease_for(nbytes, kinds)
         if lease is None:
             _RDV_REFUSED.inc()
-            self._send_op(OP_CLAIM, stream_id, _pack_claim(req, None))
+            self._ctrl_send(OP_CLAIM, stream_id, _pack_claim(req, None))
             return
         with self._lock:
             if self.closed:
@@ -892,7 +1028,7 @@ class RdvLink:
             self._leases[lease.lease_id] = lease
             self._req_lease[req] = lease.lease_id
         _flight.emit(_flight.RDV_CLAIM, self._ftag, req, lease.lease_id)
-        self._send_op(OP_CLAIM, stream_id, _pack_claim(req, lease))
+        self._ctrl_send(OP_CLAIM, stream_id, _pack_claim(req, lease))
 
     def _lease_for(self, nbytes: int, kinds: Sequence[str]
                    ) -> Optional[RegionLease]:
@@ -936,7 +1072,7 @@ class RdvLink:
         # claim): hand the region straight back
         if claim is not None:
             try:
-                self._send_op(OP_RELEASE, 0,
+                self._ctrl_send(OP_RELEASE, 0,
                               _RELEASE.pack(claim.lease_id, 0))
             except Exception:
                 pass
@@ -1004,7 +1140,7 @@ class RdvLink:
                 self._pregrants_out[cls] = self._pregrants_out.get(cls,
                                                                    0) + 1
             try:
-                self._send_op(OP_CLAIM, 0, _pack_claim(0, lease))
+                self._ctrl_send(OP_CLAIM, 0, _pack_claim(0, lease))
             except Exception:
                 with self._lock:
                     self._leases.pop(lease.lease_id, None)
@@ -1247,12 +1383,15 @@ def domains_for_endpoint(endpoint) -> Tuple[Tuple[str, ...],
 def link_for_endpoint(endpoint, name: str,
                       send_op: Callable[[int, int, bytes], None],
                       deliver: Callable[[int, int, object], None],
-                      pump: Optional[Callable] = None
+                      pump: Optional[Callable] = None,
+                      send_ops: Optional[Callable] = None
                       ) -> Optional[RdvLink]:
     """An armed-but-unnegotiated link for a new framed connection, or None
-    when rendezvous is disabled process-wide."""
+    when rendezvous is disabled process-wide.  ``send_ops(list_of_(op,
+    sid, payload))`` is the multi-frame control send the cold-path
+    coalescer flushes bursts through (native framing only)."""
     if not enabled():
         return None
     pool_kinds, open_kinds = domains_for_endpoint(endpoint)
     return RdvLink(name, send_op, deliver, pool_kinds=pool_kinds,
-                   open_kinds=open_kinds, pump=pump)
+                   open_kinds=open_kinds, pump=pump, send_ops=send_ops)

@@ -133,6 +133,17 @@ MIG_END = 54              # a1 = seq id, a2 = 1 ok / 0 failed
 # declared flight-event state machine (analysis/protocol.py) saw an
 # illegal transition. a1 = machine index, a2 = the offending event code.
 PROTO_VIOLATION = 55
+# tpurpc-pulse (ISSUE 13): shared-memory descriptor rings for the
+# rendezvous control plane. ADOPT fires once per link when the peer's ring
+# descriptor verifies; SPIN/PARK are the consumer's hot↔cold flips (the
+# POLLER_BP/EV discipline applied to ring polling); STALL_BEGIN/END
+# bracket the producer's ring-full condition — an aged open stall edge is
+# the watchdog's `ctrl-ring` evidence that the consumer stopped draining.
+CTRL_ADOPT = 56           # a1 = ring slots, a2 = slot bytes
+CTRL_SPIN = 57            # consumer hot-polling the ring; a1 = consumed so far
+CTRL_PARK = 58            # consumer parked on the framed path; a1 = consumed
+CTRL_STALL_BEGIN = 59     # producer saw the ring full; a1 = backlog
+CTRL_STALL_END = 60       # space returned (consumer drained)
 
 EVENT_NAMES: Dict[int, str] = {
     PAIR_CONNECT: "pair-connect",
@@ -190,6 +201,11 @@ EVENT_NAMES: Dict[int, str] = {
     MIG_BEGIN: "migration-begin",
     MIG_END: "migration-end",
     PROTO_VIOLATION: "proto-violation",
+    CTRL_ADOPT: "ctrl-adopt",
+    CTRL_SPIN: "ctrl-spin",
+    CTRL_PARK: "ctrl-park",
+    CTRL_STALL_BEGIN: "ctrl-stall-begin",
+    CTRL_STALL_END: "ctrl-stall-end",
 }
 
 #: batch-flush reason codes (a1 of BATCH_FLUSH) — mirrors the jaxshim

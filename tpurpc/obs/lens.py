@@ -83,6 +83,8 @@ HOPS: Tuple[Tuple[str, str], ...] = (
     ("wire", "transport boundary: pair one-sided send / TCP socket write"),
     ("rendezvous", "one-sided bulk payload write into the peer-advertised "
                    "landing region (tpurpc-express)"),
+    ("ctrl", "control-plane work: descriptor-ring posts/drains and framed "
+             "rendezvous control sends (tpurpc-pulse)"),
     ("peer_ring", "RingReader drain out of the local receive ring"),
     ("decode", "codec parse of wire bytes back into tensors"),
     ("hbm", "placement into the device-resident HBM landing ring"),

@@ -57,7 +57,7 @@ _obs_profiler.register_stages(__file__, _LENS_STAGES)
 _log = logging.getLogger("tpurpc.watchdog")
 
 STAGES = ("credit-starvation", "peer-not-reading", "h2-flow-control",
-          "rendezvous", "kv-swap", "migration", "decode-step",
+          "ctrl-ring", "rendezvous", "kv-swap", "migration", "decode-step",
           "batcher-wait", "poller-wake", "device-infer", "unknown")
 
 #: anomaly counters (always-on registry): total trips + per-stage breakdown
@@ -300,6 +300,12 @@ class StallWatchdog:
         # completed/released ((tag, 'l', lease)) — are the evidence a call
         # is wedged INSIDE a bulk-tensor handoff, not in the ring/h2 path
         open_rdv: Dict[tuple, int] = {}
+        # tpurpc-pulse: an open (unmatched) ring-full stall edge — the
+        # producer sees the peer's descriptor ring full and the consumer
+        # is not draining it; paired with a nonzero ctrl_ring_backlog
+        # gauge this outranks the generic rendezvous story (the wedge is
+        # the CONTROL plane, not the transfer)
+        open_ctrl: Dict[int, int] = {}
         # tpurpc-cadence: per-scheduler step bracket — an open
         # GEN_STEP_BEGIN (no matching END) is a decode step IN the model
         # right now; its age says whether that is traffic or a wedge. The
@@ -329,6 +335,10 @@ class StallWatchdog:
                         open_edges.pop((b, e["tag"]), None)
             elif code == _flight.H2_WINDOW_EXHAUSTED:
                 last_h2 = e["t_ns"]
+            elif code == _flight.CTRL_STALL_BEGIN:
+                open_ctrl[e["tag"]] = e["t_ns"]
+            elif code == _flight.CTRL_STALL_END:
+                open_ctrl.pop(e["tag"], None)
             elif code == _flight.RDV_OFFER:
                 open_rdv[(e["tag"], "o", e["a1"])] = e["t_ns"]
             elif code == _flight.RDV_CLAIM:
@@ -365,6 +375,8 @@ class StallWatchdog:
             "open_lease": open_lease,
             "open_edges": open_edges,
             "open_rdv": open_rdv,
+            "open_ctrl": open_ctrl,
+            "ctrl_ring_backlog": fleet_sum("ctrl_ring_backlog"),
             "open_swap": open_swap,
             "open_mig": open_mig,
             "open_step": open_step,
@@ -387,7 +399,27 @@ class StallWatchdog:
             return ("credit-starvation",
                     "send-lease held: reserve without commit/abort in the "
                     "flight tail — the ring write lock is wedged")
+        # tpurpc-pulse: a stuck descriptor ring is MORE specific than the
+        # rendezvous story it wedges — the control op (offer/claim/
+        # complete) is sitting in a ring nobody drains.  Evidence: an aged
+        # open ring-full stall bracket, or posted-but-unconsumed records
+        # (the backlog gauge) behind an aged rendezvous edge.
+        open_ctrl = ev.get("open_ctrl") or {}
+        backlog = ev.get("ctrl_ring_backlog", 0)
         open_rdv = ev.get("open_rdv") or {}
+        ctrl_age = 0
+        if open_ctrl:
+            ctrl_age = max(now - t for t in open_ctrl.values())
+        elif backlog > 0 and open_rdv:
+            ctrl_age = max(now - t for t in open_rdv.values())
+        if ctrl_age >= self.min_stall_s * 1e9 / 2:
+            return ("ctrl-ring",
+                    f"descriptor-ring control plane stalled "
+                    f"{ctrl_age / 1e9:.2f}s: {int(backlog)} posted "
+                    f"record(s) undrained"
+                    + (f", {len(open_ctrl)} link(s) ring-full"
+                       if open_ctrl else "")
+                    + " — the peer's ring consumer stopped draining")
         if open_rdv:
             oldest = max(now - t for t in open_rdv.values())
             # a fresh edge is a transfer IN PROGRESS (claim round trips are

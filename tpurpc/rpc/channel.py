@@ -25,6 +25,7 @@ from typing import (Callable, Iterable, Iterator, List, Optional, Sequence,
                     Tuple, Union)
 
 from tpurpc.analysis.locks import make_condition, make_lock
+from tpurpc.core import ctrlring as _ctrl
 from tpurpc.core import rendezvous as _rdv
 from tpurpc.core.endpoint import Endpoint, EndpointError, connect_endpoint
 from tpurpc.obs import flight as _flight
@@ -255,10 +256,28 @@ class _Connection:
         # is a PING any peer (native C plane, older builds) safely echoes;
         # only a rendezvous-capable peer recognizes it and replies with its
         # own, which flips `negotiated` — until then every payload frames.
+        # tpurpc-pulse (ISSUE 13): the hello also carries this side's
+        # descriptor-ring blob; a peer that opens it (same host, shm) moves
+        # the whole control plane off frames.
         self.rdv = _rdv.link_for_endpoint(
             endpoint, "chan:" + getattr(endpoint, "peer", "?"),
-            self._rdv_send_op, self._rdv_deliver)
+            self._rdv_send_op, self._rdv_deliver,
+            send_ops=self._rdv_send_ops)
         self.writer.rdv = self.rdv
+        self._frames_dispatched = 0
+        self.ctrl = None
+        if self.rdv is not None and _ctrl.enabled():
+            try:
+                self.ctrl = _ctrl.CtrlPlane(
+                    "chan:" + getattr(endpoint, "peer", "?"))
+            except Exception:
+                self.ctrl = None  # no shm: framed control forever
+            if self.ctrl is not None:
+                self.rdv.ctrl_post = self._rdv_ctrl_post
+                self.rdv.ctrl_drain = self._ctrl_drain
+                # per-stream order across the ring/framed split: control
+                # ops posted before a sink-routed MESSAGE deliver first
+                self.reader.pre_commit = self._ctrl_drain
         if self.rdv is not None:
             self.rdv.recv_limit = max_recv_bytes
             # ring planes negotiated at the PAIR BOOTSTRAP (Address.caps
@@ -268,8 +287,11 @@ class _Connection:
             if pair is not None and "rdv" in getattr(pair, "peer_caps",
                                                      ()):
                 self.rdv.on_peer_hello()
+            hello = _rdv.HELLO_PAYLOAD
+            if self.ctrl is not None:
+                hello += self.ctrl.hello_blob()
             try:
-                self.writer.send(fr.PING, 0, 0, _rdv.HELLO_PAYLOAD)
+                self.writer.send(fr.PING, 0, 0, hello)
             except (EndpointError, OSError, fr.FrameError):
                 pass  # connection dying; normal paths surface it
         # Inline-pump discipline (the reference's pollset_work model,
@@ -455,13 +477,15 @@ class _Connection:
     def _read_loop(self) -> None:
         try:
             while True:
-                f = self.reader.read_frame()
+                f = self._read_frame_ctrl()
                 if f is None:
                     self._die("server closed connection")
                     return
                 if f is fr.CONSUMED:  # MESSAGE already routed via the sink
+                    self._frames_dispatched += 1
                     continue
                 self._dispatch(f)
+                self._frames_dispatched += 1
         except (EndpointError, fr.FrameError, OSError) as exc:
             self._die(str(exc))
 
@@ -516,10 +540,18 @@ class _Connection:
                          else deadline - time.monotonic())
             if remaining is not None and remaining <= 0:
                 return
+
+            def _stop() -> bool:
+                # a ctrl-ring drain inside the polled read may satisfy the
+                # pred with no frame ever arriving — bail back to the
+                # outer loop instead of blocking out the deadline
+                with self._lock:
+                    return pred() or not self.alive
+
             try:
-                f = self.reader.read_frame(timeout=remaining)
+                f = self._read_frame_ctrl(remaining, should_stop=_stop)
             except TimeoutError:
-                return  # deadline passed mid-wait; outer loop re-checks
+                return  # deadline/pred: outer loop re-checks
             except (EndpointError, fr.FrameError, OSError) as exc:
                 self._die(str(exc))
                 return
@@ -528,6 +560,7 @@ class _Connection:
                 return
             if f is not fr.CONSUMED:
                 self._dispatch(f)
+            self._frames_dispatched += 1
             # every frame (CONSUMED commits included) may satisfy a PARKED
             # waiter's pred — hand them the wakeup now, not at pump release
             with self._pump_cond:
@@ -563,6 +596,7 @@ class _Connection:
             if grab:
                 try:
                     while True:
+                        self._ctrl_drain()
                         try:
                             f = self.reader.read_frame(timeout=0.005)
                         except TimeoutError:
@@ -574,7 +608,9 @@ class _Connection:
                             self._die("server closed connection")
                             return
                         if f is not fr.CONSUMED:
+                            self._ctrl_drain()  # ring ops sent before f
                             self._dispatch(f)
+                        self._frames_dispatched += 1
                 finally:
                     with self._pump_cond:
                         self._pumping = False
@@ -592,6 +628,51 @@ class _Connection:
     def _rdv_send_op(self, op: int, stream_id: int, payload: bytes) -> None:
         self.writer.send(fr.RDV_FRAME_OF_OP[op], 0, stream_id, payload)
 
+    def _rdv_send_ops(self, ops) -> None:
+        """Cold-path coalescer flush: every queued control op in ONE
+        gathered writev (tpurpc-pulse)."""
+        self.writer.send_many([(fr.RDV_FRAME_OF_OP[op], 0, sid, payload)
+                               for op, sid, payload in ops])
+
+    # -- descriptor-ring control plane (tpurpc-pulse, ISSUE 13) ---------------
+
+    def _rdv_ctrl_post(self, op: int, stream_id: int,
+                       payload: bytes) -> bool:
+        plane = self.ctrl
+        if plane is None:
+            return False
+        return plane.post(op, stream_id, payload, self.writer.frames_sent,
+                          self._ctrl_kick)
+
+    def _ctrl_kick(self) -> None:
+        try:
+            self.writer.send(fr.CTRL_KICK, 0, 0, b"")
+        except (EndpointError, OSError, fr.FrameError):
+            pass  # connection dying; the framed paths surface it
+
+    def _frames_count(self) -> int:
+        return self._frames_dispatched
+
+    def _ctrl_drain(self) -> int:
+        plane, rdv = self.ctrl, self.rdv
+        if plane is None or rdv is None:
+            return 0
+        n = plane.drain(rdv.on_op, self._frames_count)
+        if n and self._pump_mode:
+            # a drained record may satisfy a PARKED pump waiter's pred —
+            # same handoff the frame path performs after each dispatch
+            with self._pump_cond:
+                self._pump_cond.notify_all()
+        return n
+
+    def _read_frame_ctrl(self, timeout=None, should_stop=None):
+        plane = self.ctrl
+        if plane is None or plane.rx is None:
+            return self.reader.read_frame(timeout=timeout)
+        return _ctrl.read_frame_polled(self.reader.read_frame,
+                                       self._ctrl_drain, plane, timeout,
+                                       should_stop)
+
     def _rdv_deliver(self, stream_id: int, flags: int, body) -> None:
         """A completed rendezvous payload IS the stream's next message —
         delivered in frame-arrival order, zero-copy (the body aliases the
@@ -604,12 +685,20 @@ class _Connection:
     def _dispatch(self, f: fr.Frame) -> None:
         if f.type == fr.PING:
             if (self.rdv is not None
-                    and f.payload == _rdv.HELLO_PAYLOAD):
+                    and f.payload.startswith(_rdv.HELLO_PAYLOAD)):
                 # capability hello: the peer speaks rendezvous (both sides
-                # send one proactively at connection start, so no echo)
+                # send one proactively at connection start, so no echo).
+                # tpurpc-pulse: the tail of the payload is the peer's
+                # descriptor-ring blob — adopting it moves this link's
+                # control plane off frames entirely.
                 self.rdv.on_peer_hello(f.payload)
+                if self.ctrl is not None:
+                    self.ctrl.on_hello(
+                        f.payload[len(_rdv.HELLO_PAYLOAD):])
             self.writer.send(fr.PONG, 0, 0, f.payload)
             return
+        if f.type == fr.CTRL_KICK:
+            return  # the wake itself was the delivery: read loops drain
         if f.type in fr.RDV_OP_OF_FRAME:
             if self.rdv is not None:
                 self.rdv.on_op(fr.RDV_OP_OF_FRAME[f.type], f.stream_id,
@@ -715,6 +804,11 @@ class _Connection:
             # released (the modeled peer-death invariant) and any sender
             # parked on a claim wakes to fall back/fail with the transport
             self.rdv.close()
+        if self.ctrl is not None:
+            # descriptor rings die with the connection: our rx region is
+            # released (a straggling peer's late slot store lands in the
+            # orphaned mapping — dead memory, never a re-advertised ring)
+            self.ctrl.close()
         trace_channel.log("connection dead: %s", why)
         for st in streams:
             st.deliver_failure(StatusCode.UNAVAILABLE, f"transport failed: {why}")
@@ -1067,6 +1161,24 @@ class Channel:
             self._addrs = list(parsed)
         for sc in removed:
             sc.close()
+
+    def batch_calls(self):
+        """tpurpc-pulse (ISSUE 13): batch the fused unary sends THIS
+        thread issues inside the block into ONE gathered writev — the
+        coalesced control path for bursts of small control RPCs (a
+        migration drain's N sequence handoffs flush as one transport
+        write instead of one frame pair each).  Pipelined ``call_async``
+        inside the block composes naturally: the sends queue, the
+        responses demux as usual.  Best-effort: on a channel with no
+        dialable connection the block simply runs unbatched (the calls
+        themselves will surface the dial failure)."""
+        import contextlib
+
+        try:
+            conn = self._connection()
+        except Exception:
+            return contextlib.nullcontext()
+        return conn.writer.batch()
 
     def _connection(self, exclude=None, picked=None) -> _Connection:
         """LB pick: walk subchannels in policy order, first READY/dialable

@@ -65,6 +65,12 @@ RDV_OFFER = 8
 RDV_CLAIM = 9
 RDV_COMPLETE = 10
 RDV_RELEASE = 11
+# tpurpc-pulse (ISSUE 13): one-shot wake for a PARKED descriptor-ring
+# consumer — the only frame a cold→hot control-plane transition costs.
+# Carries nothing; the receiver's read loop drains its ring on every
+# wakeup, so the frame's arrival IS the delivery. Only ever sent to a
+# peer that advertised a ring in the hello (same-build guarantee).
+CTRL_KICK = 12
 
 #: canonical rendezvous op <-> native frame type (ops are transport-
 #: agnostic small ints; the h2 planes carry them in an extension frame)
@@ -148,7 +154,8 @@ class Frame:
     def __repr__(self) -> str:
         names = {1: "HEADERS", 2: "MESSAGE", 3: "TRAILERS", 4: "RST",
                  5: "PING", 6: "PONG", 7: "GOAWAY", 8: "RDV_OFFER",
-                 9: "RDV_CLAIM", 10: "RDV_COMPLETE", 11: "RDV_RELEASE"}
+                 9: "RDV_CLAIM", 10: "RDV_COMPLETE", 11: "RDV_RELEASE",
+                 12: "CTRL_KICK"}
         return (f"<Frame {names.get(self.type, self.type)} sid={self.stream_id} "
                 f"flags={self.flags:#x} len={len(self.payload)}>")
 
@@ -308,6 +315,17 @@ class FrameWriter:
 
         self._ep = endpoint
         self._lock = threading.Lock()
+        #: tpurpc-pulse: frames this writer has committed to the wire, in
+        #: order.  Descriptor-ring control records stamp this count at post
+        #: time so the receiver can order them against in-flight frames
+        #: (core/ctrlring.py frame_seq gate).  Guarded by its own lock —
+        #: bumps happen under _lock on some paths and _pend_lock on others.
+        self.frames_sent = 0
+        self._fs_lock = threading.Lock()
+        #: per-thread frame batch (FrameWriter.batch): frames queue here
+        #: and flush as ONE gathered writev at context exit — the
+        #: coalesced control path for bursts of small control RPCs
+        self._tls = threading.local()
         #: tpurpc-express: the connection's rendezvous link, bound by the
         #: owning connection once constructed. When set, MESSAGE payloads
         #: over the size bar are moved by a one-sided write into the
@@ -350,10 +368,20 @@ class FrameWriter:
             if not did:  # incompressible: send as-is, clear the bit
                 flags &= ~FLAG_COMPRESSED
         if total <= MAX_FRAME_PAYLOAD:
+            tb = getattr(self._tls, "batch", None)
+            if tb is not None:
+                tb[1].append(memoryview(
+                    HEADER_FMT.pack(ftype, flags, stream_id, total)))
+                tb[1].extend(segs)
+                tb[0] += 1
+                self._count_frames(1)
+                return
             with self._lock:
                 self._ep.write(
                     [HEADER_FMT.pack(ftype, flags, stream_id, total)] + segs)
+            self._count_frames(1)
             return
+        self._flush_thread_batch()  # oversized frame: preserve order
         if ftype != MESSAGE:
             # Control frames don't fragment; sending one oversized would make
             # the peer tear down the whole multiplexed connection.  Fail just
@@ -391,6 +419,7 @@ class FrameWriter:
             with self._lock:
                 self._ep.write(
                     [HEADER_FMT.pack(MESSAGE, fl, stream_id, n)] + frame_segs)
+            self._count_frames(1)
 
     def send_many(self, frames: Sequence[Tuple[int, int, int, "bytes | Sequence"]]
                   ) -> None:
@@ -443,41 +472,99 @@ class FrameWriter:
         if fragment:
             # Fragmenting calls stay on the direct path whole (their
             # per-stream order must not straddle the pending queue).
+            self._flush_thread_batch()
             batch: List[memoryview] = []
+            nframes = 0
             for ftype, flags, stream_id, segs, total in encoded:
                 if total > MAX_FRAME_PAYLOAD:
                     if batch:
                         with self._lock:
                             self._ep.write(batch)
-                        batch = []
+                        self._count_frames(nframes)
+                        batch, nframes = [], 0
                     self._send_fragmented(flags, stream_id, segs, total)
                     continue
                 batch.append(memoryview(
                     HEADER_FMT.pack(ftype, flags, stream_id, total)))
                 batch.extend(segs)
+                nframes += 1
             if batch:
                 with self._lock:
                     self._ep.write(batch)
+                self._count_frames(nframes)
             return
-        batch = []
+        tb = getattr(self._tls, "batch", None)
+        batch = tb[1] if tb is not None else []
         nbytes = 0
         for ftype, flags, stream_id, segs, total in encoded:
             batch.append(memoryview(
                 HEADER_FMT.pack(ftype, flags, stream_id, total)))
             batch.extend(segs)
             nbytes += HEADER_FMT.size + total
+        if tb is not None:  # thread batch: flushed at context exit
+            tb[0] += len(encoded)
+            self._count_frames(len(encoded))
+            return
         if not batch:
             return
         if not self._coalesce:
             with self._lock:
                 self._ep.write(batch)
+            self._count_frames(len(encoded))
             return
+        # counted at queue time: the frames are committed (in order) even
+        # though the flusher writes them — a ring record posted after this
+        # call must gate on them
+        self._count_frames(len(encoded))
         with self._pend_lock:
             self._pending.append((nbytes, batch))
             if self._flushing:
                 return  # the in-flight flusher writes it: zero extra wakeups
             self._flushing = True
         self._flush_pending()
+
+    def _count_frames(self, n: int) -> None:
+        if not n:
+            return
+        with self._fs_lock:
+            self.frames_sent += n
+
+    # -- per-thread frame batching (tpurpc-pulse, ISSUE 13) -------------------
+
+    def batch(self):
+        """Context manager: non-fragmenting frames written by THIS thread
+        inside the block queue and flush as ONE gathered writev at exit —
+        a burst of small control RPCs (e.g. a migration drain's N sequence
+        handoffs) costs one transport write instead of N.  Oversized/
+        fragmenting frames flush the queue first, preserving order; other
+        threads' writes are untouched (their order against the batch is
+        already unconstrained)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _cm():
+            prev = getattr(self._tls, "batch", None)
+            self._tls.batch = [0, []]  # [n_frames, gather segs]
+            try:
+                yield
+            finally:
+                tb, self._tls.batch = self._tls.batch, prev
+                if tb[1]:
+                    with self._lock:
+                        self._ep.write(tb[1])
+                    from tpurpc.utils import stats as _stats
+
+                    _stats.batch_hist("ctrl_call_batch").record(
+                        max(1, tb[0]))
+        return _cm()
+
+    def _flush_thread_batch(self) -> None:
+        tb = getattr(self._tls, "batch", None)
+        if tb is not None and tb[1]:
+            segs = tb[1]
+            tb[0], tb[1] = 0, []
+            with self._lock:
+                self._ep.write(segs)
 
     def _flush_pending(self) -> None:
         """Drain the coalescing queue, one capped gathered writev at a
@@ -618,6 +705,12 @@ class FrameReader:
         self._scratch = bytearray(MAX_FRAME_PAYLOAD)
         self._scratch_mv = memoryview(self._scratch)
         self.sink: Optional[MessageSink] = None
+        #: tpurpc-pulse: called right before each sink commit.  The
+        #: descriptor-ring consumer hangs its drain here so a control op
+        #: posted BEFORE this frame was sent (visible in shm by store
+        #: order) delivers first — per-stream order survives the split
+        #: control plane even for sink-routed MESSAGEs.
+        self.pre_commit = None
         # In-flight sink-routed MESSAGE interrupted by ReadTimeout:
         # (dst, rest, stream_id, flags) — resumed by the next read_frame.
         self._pending_msg: Optional[tuple] = None
@@ -674,6 +767,8 @@ class FrameReader:
             self._pending_msg = (dst, rest, stream_id, flags)
             raise
         self._pending_msg = None
+        if self.pre_commit is not None:
+            self.pre_commit()
         self.sink.commit(stream_id, flags)
         return CONSUMED
 

@@ -25,6 +25,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import (Callable, Dict, Iterator, List, Optional,
                     Sequence, Tuple)
 
+from tpurpc.core import ctrlring as _ctrl
 from tpurpc.core import rendezvous as _rdv
 from tpurpc.core.endpoint import (Endpoint, EndpointError, EndpointListener,
                                   passthru_endpoint_pair)
@@ -609,8 +610,26 @@ class _ServerConnection:
         # capability hello can never race past an unarmed link.
         self.rdv = _rdv.link_for_endpoint(
             endpoint, "srv:" + getattr(endpoint, "peer", "?"),
-            self._rdv_send_op, self._rdv_deliver)
+            self._rdv_send_op, self._rdv_deliver,
+            send_ops=self._rdv_send_ops)
         self.writer.rdv = self.rdv
+        # tpurpc-pulse (ISSUE 13): the descriptor-ring control plane —
+        # our receive ring rides the hello blob; the peer's arrives in its
+        # hello and moves this link's control ops off frames entirely
+        self._frames_dispatched = 0
+        self.ctrl = None
+        if self.rdv is not None and _ctrl.enabled():
+            try:
+                self.ctrl = _ctrl.CtrlPlane(
+                    "srv:" + getattr(endpoint, "peer", "?"))
+            except Exception:
+                self.ctrl = None  # no shm: framed control forever
+            if self.ctrl is not None:
+                self.rdv.ctrl_post = self._rdv_ctrl_post
+                self.rdv.ctrl_drain = self._ctrl_drain
+                # per-stream order across the ring/framed split: control
+                # ops posted before a sink-routed MESSAGE deliver first
+                self.reader.pre_commit = self._ctrl_drain
         if self.rdv is not None:
             self.rdv.recv_limit = server.max_receive_message_length
             # ring planes negotiated at the pair bootstrap (Address.caps)
@@ -618,8 +637,11 @@ class _ServerConnection:
             if pair is not None and "rdv" in getattr(pair, "peer_caps",
                                                      ()):
                 self.rdv.on_peer_hello()
+            hello = _rdv.HELLO_PAYLOAD
+            if self.ctrl is not None:
+                hello += self.ctrl.hello_blob()
             try:
-                self.writer.send(fr.PING, 0, 0, _rdv.HELLO_PAYLOAD)
+                self.writer.send(fr.PING, 0, 0, hello)
             except (EndpointError, OSError, fr.FrameError):
                 pass  # connection dying; the read loop surfaces it
         self._thread = threading.Thread(target=self._read_loop, daemon=True,
@@ -743,13 +765,15 @@ class _ServerConnection:
             self.rdv.disallowed_thread = threading.get_ident()
         try:
             while True:
-                f = self.reader.read_frame()
+                f = self._read_frame_ctrl()
                 if f is None:
                     break
                 self.last_frame = time.monotonic()  # client is alive
                 if f is fr.CONSUMED:  # MESSAGE already routed via the sink
+                    self._frames_dispatched += 1
                     continue
                 self._dispatch(f)
+                self._frames_dispatched += 1
         except (EndpointError, fr.FrameError, OSError) as exc:
             trace_server.log("server connection error: %s", exc)
         finally:
@@ -759,6 +783,49 @@ class _ServerConnection:
 
     def _rdv_send_op(self, op: int, stream_id: int, payload: bytes) -> None:
         self.writer.send(fr.RDV_FRAME_OF_OP[op], 0, stream_id, payload)
+
+    def _rdv_send_ops(self, ops) -> None:
+        """Cold-path coalescer flush: every queued control op in ONE
+        gathered writev (tpurpc-pulse)."""
+        self.writer.send_many([(fr.RDV_FRAME_OF_OP[op], 0, sid, payload)
+                               for op, sid, payload in ops])
+
+    # -- descriptor-ring control plane (tpurpc-pulse, ISSUE 13) ---------------
+
+    def _rdv_ctrl_post(self, op: int, stream_id: int,
+                       payload: bytes) -> bool:
+        plane = self.ctrl
+        if plane is None:
+            return False
+        return plane.post(op, stream_id, payload, self.writer.frames_sent,
+                          self._ctrl_kick)
+
+    def _ctrl_kick(self) -> None:
+        try:
+            self.writer.send(fr.CTRL_KICK, 0, 0, b"")
+        except (EndpointError, OSError, fr.FrameError):
+            pass  # connection dying; the read loop surfaces it
+
+    def _frames_count(self) -> int:
+        return self._frames_dispatched
+
+    def _ctrl_drain(self) -> int:
+        plane, rdv = self.ctrl, self.rdv
+        if plane is None or rdv is None:
+            return 0
+        n = plane.drain(rdv.on_op, self._frames_count)
+        if n:
+            # ring records are client-liveness evidence exactly as frames
+            # are: a pure-ring steady state must not read as "silent"
+            self.last_frame = time.monotonic()
+        return n
+
+    def _read_frame_ctrl(self, timeout=None):
+        plane = self.ctrl
+        if plane is None or plane.rx is None:
+            return self.reader.read_frame(timeout=timeout)
+        return _ctrl.read_frame_polled(self.reader.read_frame,
+                                       self._ctrl_drain, plane, timeout)
 
     def _rdv_deliver(self, stream_id: int, flags: int, body) -> None:
         """A completed rendezvous request payload: the stream's next
@@ -779,10 +846,15 @@ class _ServerConnection:
     def _dispatch(self, f: fr.Frame) -> None:
         if f.type == fr.PING:
             if (self.rdv is not None
-                    and f.payload == _rdv.HELLO_PAYLOAD):
+                    and f.payload.startswith(_rdv.HELLO_PAYLOAD)):
                 self.rdv.on_peer_hello(f.payload)
+                if self.ctrl is not None:
+                    self.ctrl.on_hello(
+                        f.payload[len(_rdv.HELLO_PAYLOAD):])
             self.writer.send(fr.PONG, 0, 0, f.payload)
             return
+        if f.type == fr.CTRL_KICK:
+            return  # the wake itself was the delivery: the loop drains
         if f.type in fr.RDV_OP_OF_FRAME:
             if self.rdv is not None:
                 self.rdv.on_op(fr.RDV_OP_OF_FRAME[f.type], f.stream_id,
@@ -1137,6 +1209,10 @@ class _ServerConnection:
         if self.rdv is not None:
             # peer gone mid-rendezvous: claimed landing regions release
             self.rdv.close()
+        if self.ctrl is not None:
+            # descriptor rings die with the connection (a straggler's late
+            # slot store lands in the orphaned mapping — dead memory)
+            self.ctrl.close()
         for st in streams:
             gate = getattr(st, "_gate", None)
             if gate is not None:

@@ -416,6 +416,7 @@ class _KvShipper:
     releases the claim so the peer frees instead of quarantining."""
 
     def __init__(self, channel):
+        self._channel = channel
         self._offer = channel.unary_unary(_method("OfferKv"),
                                           codec.tree_serializer,
                                           codec.tree_deserializer)
@@ -426,6 +427,34 @@ class _KvShipper:
                                             codec.tree_serializer,
                                             codec.tree_deserializer)
         self.writer = GrantWriter()
+
+    def _burst(self, mc, reqs, timeout: float):
+        """Issue a BURST of small control RPCs: pipelined (N in flight on
+        one connection) with their fused sends coalesced into ONE writev
+        (tpurpc-pulse: Channel.batch_calls + FrameWriter.batch) — a drain
+        migrating N sequences frames one transport write, not N.  Returns
+        one result-or-exception per request, order preserved."""
+        import contextlib
+
+        if len(reqs) == 1:
+            try:
+                return [mc(reqs[0], timeout=timeout)]
+            except Exception as exc:
+                return [exc]
+        pipe = mc.pipeline(depth=max(1, len(reqs)))
+        batcher = getattr(self._channel, "batch_calls", None)
+        cm = batcher() if batcher is not None else contextlib.nullcontext()
+        futs = []
+        with cm:
+            for r in reqs:
+                futs.append(pipe.call_async(r, timeout=timeout))
+        out = []
+        for fut in futs:
+            try:
+                out.append(fut.result(timeout=timeout + 1))
+            except Exception as exc:
+                out.append(exc)
+        return out
 
     def offer(self, seq_key: int, prompt: np.ndarray, n_tokens: int,
               timeout: float):
@@ -566,7 +595,21 @@ def migrate(state: DisaggDecode, peer_channel, peer_address: str,
     sched = state.sched
     shipper = _KvShipper(peer_channel)
     moved = failed = 0
+
+    def fail_one(sid, s, exc) -> None:
+        nonlocal failed
+        _flight.emit(_flight.MIG_END, state._tag, sid, 0)
+        _MIG_FAILED.inc()
+        # the peer may be dead mid-write: OUR blocks saw no foreign
+        # writer, so free (not quarantine) locally; the peer's TTL reap
+        # quarantines ITS claimed blocks
+        state.mgr.free_blocks(s.kv)
+        s.kv = None
+        s.q.put(MigrationFailed(str(exc)))
+        failed += 1
+
     try:
+        live = []
         for sid in (sids if sids is not None else sched.live_sids()):
             s = sched.detach(sid)
             if s is None:
@@ -579,29 +622,50 @@ def migrate(state: DisaggDecode, peer_channel, peer_address: str,
             _flight.emit(_flight.MIG_BEGIN, state._tag, sid, n_entries)
             seq_key = (int(time.monotonic_ns()) << 8 | (sid & 0xFF)) \
                 & 0x7FFFFFFFFFFFFFFF
+            live.append((sid, s, n_entries, seq_key))
+        # Phase 1 — BURST the offers (tpurpc-pulse, ROADMAP item 2's
+        # follow-up): a drain migrating N sequences frames ONE gathered
+        # writev of OfferKv calls instead of N serialized round trips.
+        resps = shipper._burst(
+            shipper._offer,
+            [{"seq_key": np.int64(k), "prompt": s.prompt,
+              "n_tokens": np.int32(n)} for _sid, s, n, k in live],
+            timeout_s) if live else []
+        # Phase 2 — per-sequence one-sided block writes (failures fail
+        # that sequence ALONE; its siblings keep going).
+        pending = []  # (sid, s, seq_key, CompleteKv request)
+        for (sid, s, n_entries, seq_key), resp in zip(live, resps):
             try:
-                grant, handoff, pos, _rh, _rf = shipper.offer(
-                    seq_key, s.prompt, n_entries, timeout_s)
+                if isinstance(resp, Exception):
+                    raise resp
+                if not _scalar(resp["ok"]):
+                    raise MigrationFailed(
+                        f"handoff refused: {_s(resp.get('reason', b''))}")
+                grant = BlockGrant.from_wire(bytes(
+                    np.asarray(resp["grant"], np.uint8)))
+                handoff = _scalar(resp["handoff"])
+                pos = _scalar(resp["resume_pos"])
                 chunks = [v for _bi, v in s.kv.chunks(pos, n_entries)]
                 shipper.writer.write_blocks(grant, chunks)
-                wedge = TEST_HOOKS.get("wedge_before_complete")
-                if wedge is not None:
-                    wedge.wait(10)
-                shipper._complete(
-                    {"handoff": np.int64(handoff),
-                     "n_tokens": np.int32(n_entries),
-                     "last_token": np.int32(s.last_token),
-                     "emitted": np.int32(s.emitted)}, timeout=timeout_s)
             except Exception as exc:
-                _flight.emit(_flight.MIG_END, state._tag, sid, 0)
-                _MIG_FAILED.inc()
-                # the peer may be dead mid-write: OUR blocks saw no
-                # foreign writer, so free (not quarantine) locally; the
-                # peer's TTL reap quarantines ITS claimed blocks
-                state.mgr.free_blocks(s.kv)
-                s.kv = None
-                s.q.put(MigrationFailed(str(exc)))
-                failed += 1
+                fail_one(sid, s, exc)
+                continue
+            pending.append((sid, s, seq_key,
+                            {"handoff": np.int64(handoff),
+                             "n_tokens": np.int32(n_entries),
+                             "last_token": np.int32(s.last_token),
+                             "emitted": np.int32(s.emitted)}))
+        wedge = TEST_HOOKS.get("wedge_before_complete")
+        if wedge is not None and pending:
+            wedge.wait(10)
+        # Phase 3 — burst the completes: one writev flushes every pending
+        # CompleteKv, the exact shape the ISSUE names.
+        cresps = shipper._burst(shipper._complete,
+                                [req for *_x, req in pending],
+                                timeout_s) if pending else []
+        for (sid, s, seq_key, _req), resp in zip(pending, cresps):
+            if isinstance(resp, Exception):
+                fail_one(sid, s, resp)
                 continue
             state.mgr.free_blocks(s.kv, cache_prefix=True)
             s.kv = None

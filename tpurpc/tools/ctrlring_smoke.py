@@ -1,0 +1,248 @@
+"""tpurpc-pulse smoke (ISSUE 13): descriptor-ring control plane, two
+processes over shm.
+
+Phase 1 (cross-process, the deployment shape): a server SUBPROCESS and
+this client stream 1 MiB tensors over the rendezvous plane with the
+descriptor-ring control plane on (the default):
+
+* both sides must ADOPT the ring (ctrl-adopt in the client's flight ring;
+  the server reports its counters per stream);
+* the steady-state stream must carry ZERO control frames after warmup —
+  ``rdv_ctrl_frames`` flat on BOTH sides while ``ctrl_ring_posts`` carries
+  every OFFER/CLAIM/COMPLETE;
+* payload integrity end to end (byte totals + corner values).
+
+Phase 2 (in-process): an induced STUCK RING — the ``freeze_drain`` test
+hook stops every consumer, so a bulk send's OFFER ages in the ring — must
+be attributed by the stall watchdog to the new ``ctrl-ring`` stage, and
+the call must still COMPLETE via the framed fallback once the claim times
+out (the zero-failed-RPC degradation ladder).
+
+Exit 0 = both phases passed.  Runs under TPURPC_FLIGHT_DUMP in
+tools/check.sh, so the protocol-conformance stage replays the ctrl-ring
+machines over everything this smoke emitted.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+N_MSGS = 16
+SHAPE = (512, 512)  # 1 MiB float32
+
+_SERVER = r"""
+import json, os, sys
+import numpy as np
+
+from tpurpc.jaxshim import add_tensor_method
+from tpurpc.rpc.server import Server
+
+srv = Server(max_workers=4, native_dataplane=False)
+port = srv.add_insecure_port("127.0.0.1:0")
+print("PORT", port, flush=True)
+
+def consume(req_iter):
+    total = 0
+    corner = 0.0
+    for tree in req_iter:
+        arr = tree["x"]
+        total += arr.nbytes
+        corner = float(arr[-1, -1])
+    from tpurpc.obs import metrics
+    reg = metrics.registry().metrics()
+    snap = {name: reg[name].snapshot() for name in
+            ("rdv_ctrl_frames", "ctrl_ring_posts", "ctrl_ring_records",
+             "rdv_transfers_received") if name in reg}
+    print("CTRLSTATS", json.dumps(snap), flush=True)
+    yield {"bytes": np.int64(total), "corner": np.float64(corner)}
+
+add_tensor_method(srv, "Sink", consume, kind="stream_stream")
+srv.start()
+print("READY", flush=True)
+srv.wait_for_termination(timeout=180)
+"""
+
+
+def phase_cross_process() -> None:
+    import numpy as np
+
+    from tpurpc.jaxshim import TensorClient
+    from tpurpc.obs import flight, metrics
+    from tpurpc.rpc.channel import Channel
+
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONPATH"] = here + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen([sys.executable, "-c", _SERVER],
+                            stdout=subprocess.PIPE, text=True, env=env)
+    lines: list = []
+    ready = threading.Event()
+
+    def pump():
+        for line in proc.stdout:
+            lines.append(line)
+            if line.startswith("READY"):
+                ready.set()
+
+    threading.Thread(target=pump, daemon=True).start()
+    try:
+        assert ready.wait(60), "server subprocess never came up"
+        port = int([ln for ln in lines if ln.startswith("PORT")][0]
+                   .split()[1])
+        payload = np.arange(SHAPE[0] * SHAPE[1], dtype=np.float32).reshape(
+            SHAPE)
+        with Channel(f"127.0.0.1:{port}") as ch:
+            cli = TensorClient(ch)
+
+            def gen(k):
+                for _ in range(k):
+                    yield {"x": payload}
+
+            # warmup: hello + ring adoption + standing grants settle
+            list(cli.duplex("Sink", gen(2), native=False, timeout=60))
+            stats_seen = len([ln for ln in lines
+                              if ln.startswith("CTRLSTATS")])
+            reg = metrics.registry().metrics()
+            frames0 = reg["rdv_ctrl_frames"].snapshot()
+            posts0 = reg["ctrl_ring_posts"].snapshot()
+            deadline = time.monotonic() + 20
+            while (len([ln for ln in lines if ln.startswith("CTRLSTATS")])
+                   < stats_seen and time.monotonic() < deadline):
+                time.sleep(0.05)
+            warm_lines = [ln for ln in lines if ln.startswith("CTRLSTATS")]
+            srv_warm = json.loads(warm_lines[-1].split(" ", 1)[1])
+
+            # the steady-state stream the zero-frames claim is about
+            replies = list(cli.duplex("Sink", gen(N_MSGS), native=False,
+                                      timeout=120))
+            total = int(np.asarray(replies[-1]["bytes"]).ravel()[0])
+            assert total == N_MSGS * payload.nbytes, (total, N_MSGS)
+            corner = float(np.asarray(replies[-1]["corner"]).ravel()[0])
+            assert abs(corner - float(payload[-1, -1])) < 1e-3, corner
+
+            frames = reg["rdv_ctrl_frames"].snapshot() - frames0
+            posts = reg["ctrl_ring_posts"].snapshot() - posts0
+            assert frames == 0, (
+                f"steady-state stream sent {frames} framed control ops "
+                "(want 0: every OFFER/CLAIM/COMPLETE on the ring)")
+            assert posts >= N_MSGS, (
+                f"only {posts} ring posts for {N_MSGS} bulk messages")
+            evs = [e["event"] for e in flight.snapshot()]
+            assert "ctrl-adopt" in evs, (
+                "client never adopted the peer's descriptor ring", evs)
+
+            deadline = time.monotonic() + 20
+            while (len([ln for ln in lines if ln.startswith("CTRLSTATS")])
+                   <= len(warm_lines) and time.monotonic() < deadline):
+                time.sleep(0.05)
+            srv_end = json.loads(
+                [ln for ln in lines if ln.startswith("CTRLSTATS")][-1]
+                .split(" ", 1)[1])
+            srv_frames = (srv_end.get("rdv_ctrl_frames", 0)
+                          - srv_warm.get("rdv_ctrl_frames", 0))
+            assert srv_frames == 0, (
+                f"server sent {srv_frames} framed control ops during the "
+                "steady stream (want 0)")
+            got = (srv_end.get("rdv_transfers_received", 0)
+                   - srv_warm.get("rdv_transfers_received", 0))
+            assert got == N_MSGS, (got, N_MSGS)
+        print(f"  [shm x 2 processes] {N_MSGS} x 1 MiB rendezvous'd: "
+              f"{posts} ring posts, 0 control frames either side, "
+              "ring adoption in flight")
+    finally:
+        proc.kill()
+
+
+def phase_stuck_ring() -> None:
+    """In-process: freeze every ring consumer, wedge a bulk send, and the
+    watchdog must name the ``ctrl-ring`` stage; the framed fallback (claim
+    timeout) must still complete the call."""
+    import numpy as np
+
+    import tpurpc.core.ctrlring as ctrlring
+    from tpurpc.jaxshim import TensorClient, add_tensor_method
+    from tpurpc.obs import watchdog
+    from tpurpc.rpc.channel import Channel
+    from tpurpc.rpc.server import Server
+
+    srv = Server(max_workers=4, native_dataplane=False)
+
+    def consume(req_iter):
+        total = 0
+        for tree in req_iter:
+            total += np.asarray(tree["x"]).nbytes
+        yield {"bytes": np.int64(total)}
+
+    add_tensor_method(srv, "Sink", consume, kind="stream_stream")
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    payload = np.ones((512, 1024), np.float32)  # 2 MiB
+    wd = watchdog.get()
+    wd.reset()
+    prev = (wd.min_stall_s, wd.sweep_s)
+    wd.min_stall_s, wd.sweep_s = 0.3, 0.1
+    os.environ["TPURPC_RENDEZVOUS_CLAIM_TIMEOUT_S"] = "3"
+    try:
+        with Channel(f"127.0.0.1:{port}") as ch:
+            cli = TensorClient(ch)
+            # warm on a DIFFERENT size class so no standing grant
+            # short-circuits the frozen ring
+            list(cli.duplex("Sink", iter([{"x": np.ones((128, 128),
+                                                        np.float32)}]),
+                            native=False, timeout=60))
+            ctrlring.TEST_HOOKS["freeze_drain"] = True
+            result: dict = {}
+
+            def stalled():
+                result["replies"] = list(
+                    cli.duplex("Sink", iter([{"x": payload}]),
+                               native=False, timeout=60))
+
+            t = threading.Thread(target=stalled)
+            t.start()
+            diag = None
+            deadline = time.monotonic() + 10
+            while diag is None and time.monotonic() < deadline:
+                time.sleep(0.15)
+                for d in wd.sweep_once():
+                    if d["stage"] == "ctrl-ring":
+                        diag = d
+                        break
+            assert diag is not None, (
+                "watchdog never named the ctrl-ring stage", wd.active())
+            ctrlring.TEST_HOOKS.pop("freeze_drain", None)
+            t.join(timeout=60)
+            assert not t.is_alive(), "stalled call never completed"
+            total = int(np.asarray(
+                result["replies"][-1]["bytes"]).ravel()[0])
+            assert total == payload.nbytes
+        print(f"  [stuck ring] watchdog named '{diag['stage']}' "
+              f"({diag['detail'][:58]}...); framed fallback completed "
+              "the call")
+    finally:
+        ctrlring.TEST_HOOKS.pop("freeze_drain", None)
+        os.environ.pop("TPURPC_RENDEZVOUS_CLAIM_TIMEOUT_S", None)
+        wd.min_stall_s, wd.sweep_s = prev
+        wd.reset()
+        srv.stop(grace=1)
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("GRPC_PLATFORM_TYPE", "RDMA_BPEV")
+    phase_cross_process()
+    phase_stuck_ring()
+    print("ctrlring smoke: PASS (2-process shm rings, zero steady-state "
+          "control frames, ctrl-ring stall attributed)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
