@@ -1101,6 +1101,96 @@ def _proto_verify_overhead(duration: "float | None" = None,
     }
 
 
+def _argus_overhead(duration: "float | None" = None, pairs: int = 3) -> dict:
+    """tpurpc-argus overhead gate (ISSUE 14): the whole detect loop armed
+    — tsdb sampler on a 4 Hz grain (4x the production 1 s default), the
+    SLO evaluator ticking at 4 Hz over a declared (never-firing)
+    objective, and a fleet collector polling the serving port's /metrics
+    + /debug/slo + /debug/flight + /traces at 4 Hz over real HTTP —
+    versus the same closed loop with all three stopped.
+    ``argus_overhead_pct`` carries the <3% acceptance gate;
+    ``tsdb_resident_bytes`` records the history plane's bounded memory
+    (informational — fixed by construction: preallocated rings x series
+    cap). Same alternation and best-draw-p50 methodology as
+    _obs_overhead: the sampler/evaluator/collector are background
+    cadences, so their cost shows up as closed-loop RTT contention."""
+    import io
+
+    from tpurpc.bench import micro
+    from tpurpc.obs import slo as _slo
+    from tpurpc.obs import tsdb as _tsdb
+    from tpurpc.obs.collector import FleetCollector
+    from tpurpc.utils import stats as _st
+
+    if duration is None:
+        duration = float(os.environ.get("TPURPC_BENCH_OBS_S", "1.0"))
+    prev_fast = os.environ.get("TPURPC_NATIVE_FAST_UNARY")
+    os.environ["TPURPC_NATIVE_FAST_UNARY"] = "0"
+    srv = micro.run_server(0, max_workers=8)
+    target = f"127.0.0.1:{srv.bench_port}"
+    devnull = io.StringIO()
+    p50s = {"off": [], "on": []}
+
+    # the armed plane: 4 Hz sampler over the REAL registry, an evaluator
+    # with an objective that never fires (no trip/page noise in the timed
+    # window), a collector process-alike polling over loopback HTTP
+    db = _tsdb.Tsdb(fine_s=0.25)
+    ev = _slo.SloEvaluator(eval_s=0.25, tsdb=db)
+    ev.declare(_slo.SloObjective(
+        "bench-guard", latency_ms=60_000.0, target_pct=50.0,
+        windows=[(2.0, 8.0, 1e9)]))
+    col = FleetCollector([target], poll_s=0.25)
+
+    def leg(key, dur):
+        if key == "on":
+            db.start()
+            ev.start()
+            col.start()
+        try:
+            r = micro.run_client(target, req_size=64, duration=dur,
+                                 out=devnull)
+            p50s[key].append(r["rtt_us"]["p50"])
+        finally:
+            if key == "on":
+                col.stop()
+                ev.stop()
+                db.stop()
+
+    try:
+        micro.run_client(target, req_size=64, duration=0.3,
+                         out=devnull)  # warm: connect + first-dispatch
+        for i in range(max(1, pairs)):
+            legs = ["off", "on"]
+            if i % 2:
+                legs.reverse()
+            for key in legs:
+                leg(key, duration)
+    finally:
+        col.stop()
+        ev.stop()
+        db.stop()
+        if prev_fast is None:
+            os.environ.pop("TPURPC_NATIVE_FAST_UNARY", None)
+        else:
+            os.environ["TPURPC_NATIVE_FAST_UNARY"] = prev_fast
+        srv.stop(grace=0)
+        _st.reset_batch_stats()
+
+    off = min(p50s["off"])
+    on = min(p50s["on"])
+    gate = round((on - off) / off * 100, 2) if off else 0.0
+    return {
+        "argus_overhead_pct": gate,
+        "argus_overhead_gate_pct": 3.0,
+        "argus_overhead_pass": gate < 3.0,
+        "argus_sampler_hz": 4.0,
+        "tsdb_resident_bytes": db.resident_bytes(),
+        "tsdb_series": len(db.series()),
+        "argus_p50_us": {k: [round(x, 1) for x in sorted(v)]
+                         for k, v in p50s.items()},
+    }
+
+
 def _fleet_bench() -> dict:
     """tpurpc-fleet benches (ISSUE 6), in-process, seconds each:
 
@@ -2158,6 +2248,14 @@ def main() -> None:
         except Exception as exc:
             sys.stderr.write(f"proto verify overhead gate failed: {exc}\n")
             out["proto_verify_overhead_error"] = repr(exc)
+        # tpurpc-argus (ISSUE 14): tsdb sampler + slo evaluator + a 4 Hz
+        # collector polling the serving port, on vs off; <3% gate plus
+        # the informational tsdb_resident_bytes bound.
+        try:
+            out.update(_argus_overhead())
+        except Exception as exc:
+            sys.stderr.write(f"argus overhead gate failed: {exc}\n")
+            out["argus_overhead_error"] = repr(exc)
     # tpurpc-fleet (ISSUE 6): fleet_qps / fleet_p99_degraded_pct (hedging
     # on-vs-off with one slow replica) / shed_curve (admission gate vs
     # offered load). In-process, ~10s total.

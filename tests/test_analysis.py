@@ -1442,3 +1442,61 @@ def test_tree_suppressions_are_all_live():
     (ISSUE 12 satellite); new dead ones are gate failures."""
     violations = lint.audit_suppressions_tree()
     assert violations == [], "\n".join(map(str, violations))
+
+
+# ---------------------------------------------------------------------------
+# tpurpc-argus (ISSUE 14): the flight rule extends to the obs modules
+# ---------------------------------------------------------------------------
+
+ARGUS_FLIGHT_SRC = '''
+from tpurpc.obs import flight as _flight
+
+class SloEvaluator:
+    def _transition(self, obj, track, burn):
+        _flight.emit(_flight.SLO_FIRING, obj.tag,
+                     int(burn * 100), 0)         # Call in an emit arg
+        _flight.emit(_flight.SLO_RESOLVED, obj.tag, 0, "latency")  # str
+
+    def _ok_site(self, obj):
+        burn_pct = 240
+        _flight.emit(_flight.SLO_FIRING, obj.tag, 2, burn_pct)  # pure ints
+'''
+
+
+@pytest.mark.parametrize("mod", ["tsdb", "slo", "bundle", "collector"])
+def test_argus_flight_rule_enforced_per_module(mod):
+    vs = lint_source(ARGUS_FLIGHT_SRC, f"tpurpc/obs/{mod}.py")
+    assert _rules(vs) == ["flight"] and len(vs) == 2
+    assert {v.line for v in vs} == {6, 8}
+
+
+def test_argus_flight_rule_scoped():
+    # the registry itself is not an emission module — exempt
+    assert lint_source(ARGUS_FLIGHT_SRC, "tpurpc/obs/metrics.py") == []
+
+
+ARGUS_FLIGHT_SUPPRESSED = '''
+from tpurpc.obs import flight as _flight
+
+class SloEvaluator:
+    def _transition(self, obj, burn):
+        _flight.emit(_flight.SLO_FIRING, obj.tag, int(burn), 0)  # tpr: allow(flight)
+'''
+
+
+def test_argus_flight_rule_suppression():
+    assert lint_source(ARGUS_FLIGHT_SUPPRESSED, "tpurpc/obs/slo.py") == []
+
+
+def test_argus_modules_are_clean():
+    """The real tsdb sample path / slo evaluator / bundle / collector hold
+    the pure-int flight contract (and every other rule) they export."""
+    import tpurpc.obs.bundle as bundle_mod
+    import tpurpc.obs.collector as collector_mod
+    import tpurpc.obs.slo as slo_mod
+    import tpurpc.obs.tsdb as tsdb_mod
+
+    for mod in (tsdb_mod, slo_mod, bundle_mod, collector_mod):
+        with open(mod.__file__, "r", encoding="utf-8") as f:
+            vs = lint_source(f.read(), mod.__file__)
+        assert vs == [], (mod.__name__, list(map(str, vs)))

@@ -1,0 +1,786 @@
+"""tpurpc-argus (ISSUE 14): tsdb history, SLO burn-rate alerting, fleet
+collector, and automatic evidence capture.
+
+Covers the tentpole's four pieces — the two-tier ring tsdb (bounds,
+decimation, rate/quantile queries, reset-aware differentiation), the SLO
+evaluator (burn math, pending→firing→resolved, shed-vs-error budgets,
+watchdog bridge), the fleet collector (member labels, staleness,
+counter-reset clamping, merged SLO view), and the bundle writer (content,
+rate limiting, caps, protocol-checkable flight dump) — plus the
+satellites: the structured ``/healthz?json=1`` ``degraded_reasons`` body
+(each subsystem's reason appears and clears), the shard-merge counter
+reset hardening, and the end-to-end detect→capture acceptance proof.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from tpurpc.obs import bundle as obs_bundle
+from tpurpc.obs import flight, metrics, scrape
+from tpurpc.obs import slo as obs_slo
+from tpurpc.obs import tsdb as obs_tsdb
+from tpurpc.obs import watchdog
+from tpurpc.obs.tsdb import ResetClamp, Tsdb
+
+
+@pytest.fixture(autouse=True)
+def _clean_argus_state():
+    flight.RECORDER.reset()
+    wd = watchdog.get()
+    wd.reset()
+    prev = (wd.min_stall_s, wd.sweep_s, wd.mult, wd.enabled)
+    yield
+    obs_slo.reset()
+    obs_bundle.disable()
+    wd.min_stall_s, wd.sweep_s, wd.mult, wd.enabled = prev
+    wd.reset()
+    flight.RECORDER.reset()
+
+
+def _private_db(**kw) -> Tsdb:
+    reg = metrics.Registry()
+    kw.setdefault("fine_s", 1.0)
+    kw.setdefault("fine_window_s", 16.0)
+    kw.setdefault("coarse_s", 4.0)
+    kw.setdefault("coarse_window_s", 64.0)
+    return Tsdb(registry=reg, **kw)
+
+
+S = int(1e9)  # one second of synthetic monotonic nanoseconds
+
+
+# ---------------------------------------------------------------------------
+# ResetClamp
+# ---------------------------------------------------------------------------
+
+def test_reset_clamp_monotone_across_restarts():
+    c = ResetClamp()
+    assert c.clamp("k", 10) == 10
+    assert c.clamp("k", 25) == 25
+    # restart: raw drops to 3 -> continue from last-known (25) + 3
+    assert c.clamp("k", 3) == 28
+    assert c.resets == 1
+    assert c.clamp("k", 7) == 32
+    # second restart accumulates
+    assert c.clamp("k", 1) == 33
+    assert c.resets == 2
+
+
+def test_reset_clamp_forget_by_prefix():
+    c = ResetClamp()
+    c.clamp(("m1", "x"), 10)
+    c.clamp(("m1", "x"), 2)           # reset recorded
+    c.clamp(("m2", "y"), 5)
+    assert c.clamp(("m1", "x"), 4) == 14
+    c.forget("m1")
+    assert c.clamp(("m1", "x"), 4) == 4   # state dropped
+    assert c.clamp(("m2", "y"), 6) == 6   # untouched
+
+
+# ---------------------------------------------------------------------------
+# tsdb: rings, tiers, queries
+# ---------------------------------------------------------------------------
+
+def test_tsdb_window_and_ring_bound():
+    db = _private_db()
+    ctr = db._registry.counter("reqs")
+    for i in range(40):  # 40 samples > 16 fine slots: the ring must wrap
+        ctr.inc(5)
+        db.sample_once(now_ns=(i + 1) * S)
+    pts = db.window("reqs", 100.0, now_ns=40 * S)
+    # coarse tier covers 100s; fine would have been chosen under 16s
+    fine_pts = db.window("reqs", 10.0, now_ns=40 * S)
+    assert len(fine_pts) <= db._fine.slots
+    assert fine_pts[-1][1] == 200.0
+    assert pts[0][0] < fine_pts[0][0]  # coarse reaches further back
+    assert db._fine.n == 40
+
+
+def test_tsdb_rate_and_counter_reset():
+    db = _private_db()
+    ctr = db._registry.counter("reqs")
+    for i in range(10):
+        ctr.inc(10)  # +10/s
+        db.sample_once(now_ns=(i + 1) * S)
+    assert db.rate("reqs", 9.0, now_ns=10 * S) == pytest.approx(10.0)
+    # counter reset mid-window: the restarted process re-counts from zero
+    ctr.reset()
+    ctr.inc(3)
+    db.sample_once(now_ns=11 * S)
+    r = db.rate("reqs", 10.0, now_ns=11 * S)
+    assert r > 0  # never a negative rate off a reset
+    # window {t=9: 90, t=10: 100, t=11: 3}: +10, then the reset -> +3
+    assert db.delta("reqs", 2.0, now_ns=11 * S) == pytest.approx(13.0)
+
+
+def test_tsdb_two_tier_decimation():
+    db = _private_db()  # fine 1s, coarse 4s -> decimation 4
+    g = db._registry.gauge("load")
+    for i in range(12):
+        g.set(i)
+        db.sample_once(now_ns=(i + 1) * S)
+    assert db._coarse.n == 3  # every 4th fine tick
+    coarse = db._coarse.points("load", 0)
+    assert [v for _t, v in coarse] == [0.0, 4.0, 8.0]
+
+
+def test_tsdb_quantile_and_threshold_fraction():
+    db = _private_db()
+    g = db._registry.gauge("p99_us")
+    vals = [10, 10, 10, 10, 10, 10, 10, 10, 90, 90]
+    for i, v in enumerate(vals):
+        g.set(v)
+        db.sample_once(now_ns=(i + 1) * S)
+    assert db.quantile_over_time("p99_us", 0.5, 12.0,
+                                 now_ns=10 * S) == 10.0
+    frac = db.over_threshold_fraction("p99_us", 50.0, 12.0, now_ns=10 * S)
+    assert frac == pytest.approx(0.2)
+    assert db.over_threshold_fraction("nope", 1.0, 12.0,
+                                      now_ns=10 * S) is None
+
+
+def test_tsdb_histogram_and_labeled_series():
+    db = _private_db()
+    h = db._registry.histogram("lat_us", kind="latency")
+    fam = db._registry.labeled_counter("calls", ("method", "code"))
+    h.record(1000)
+    h.record(2000)
+    fam.labels("/m/A", "0").inc(5)
+    fam.labels("/m/A", "14").inc(1)
+    db.sample_once(now_ns=S)
+    kinds = db.series()
+    assert kinds["lat_us:p99"] == "quantile"
+    assert kinds["lat_us:count"] == "counter"
+    assert kinds["calls{/m/A,0}"] == "counter"
+    assert db.window("calls{/m/A,14}", 5.0, now_ns=S)[-1][1] == 1.0
+
+
+def test_tsdb_series_cap_bounds_memory(monkeypatch):
+    monkeypatch.setattr(obs_tsdb, "MAX_SERIES", 4)
+    db = _private_db()
+    for i in range(10):
+        db._registry.counter(f"c{i}")
+    db.sample_once(now_ns=S)
+    assert len(db.series()) == 4
+    before = db.resident_bytes()
+    for i in range(10, 20):
+        db._registry.counter(f"c{i}")
+    db.sample_once(now_ns=2 * S)
+    assert db.resident_bytes() == before  # capped: no growth
+
+
+def test_tsdb_doc_and_resident_bytes():
+    db = _private_db()
+    db._registry.counter("reqs").inc(7)
+    db.sample_once()  # real clock: doc() windows against now
+    doc = db.doc()
+    assert "reqs" in doc["series"]
+    assert doc["resident_bytes"] > 0
+    one = db.doc(series="reqs", window_s=10.0)
+    assert one["points"][-1][1] == 7.0
+    assert one["kind"] == "counter"
+
+
+def test_tsdb_postfork_reset_gives_fresh_instance():
+    a = obs_tsdb.get()
+    obs_tsdb.postfork_reset()
+    b = obs_tsdb.get()
+    assert a is not b
+
+
+def test_debug_history_route():
+    status, ctype, body = scrape._route("/debug/history?local=1")
+    assert status == 200
+    doc = json.loads(body)
+    assert doc["enabled"] is True
+    assert "fine" in doc and "coarse" in doc
+
+
+# ---------------------------------------------------------------------------
+# slo: burn math + the alert state machine (private tsdb, synthetic clock)
+# ---------------------------------------------------------------------------
+
+def _latency_rig(threshold_ms=5.0, windows=((4.0, 8.0, 2.0),)):
+    """A private tsdb + evaluator around one latency objective bound to a
+    gauge series the test drives directly."""
+    db = _private_db(fine_s=1.0, fine_window_s=32.0,
+                     coarse_s=8.0, coarse_window_s=64.0)
+    g = db._registry.gauge("p99g")
+    ev = obs_slo.SloEvaluator(eval_s=1.0, tsdb=db)
+    obj = ev.declare(obs_slo.SloObjective(
+        "lat", latency_ms=threshold_ms, latency_target_pct=50.0,
+        series="p99g", windows=[tuple(w) for w in windows]))
+    return db, g, ev, obj
+
+
+def test_slo_pending_firing_resolved_with_flight_events():
+    db, g, ev, obj = _latency_rig()
+    st = obj.tracks["latency"]
+    # healthy: p99 1ms for 10s
+    for i in range(10):
+        g.set(1000.0)
+        db.sample_once(now_ns=(i + 1) * S)
+        ev.evaluate_once(now_ns=(i + 1) * S)
+    assert st.state == "ok"
+    # degrade: p99 50ms — fast window (4s) saturates before slow (8s)
+    t = 10
+    while st.state == "ok" and t < 30:
+        t += 1
+        g.set(50_000.0)
+        db.sample_once(now_ns=t * S)
+        ev.evaluate_once(now_ns=t * S)
+    assert st.state == "pending"
+    while st.state == "pending" and t < 40:
+        t += 1
+        g.set(50_000.0)
+        db.sample_once(now_ns=t * S)
+        ev.evaluate_once(now_ns=t * S)
+    assert st.state == "firing"
+    fired_at = t
+    # recover: p99 back to 1ms — the alert must resolve
+    while st.state == "firing" and t < fired_at + 30:
+        t += 1
+        g.set(1000.0)
+        db.sample_once(now_ns=t * S)
+        ev.evaluate_once(now_ns=t * S)
+    assert st.state == "ok"
+    transitions = [(h["from"], h["to"]) for h in ev.doc()["history"]
+                   if h["objective"] == "lat"]
+    assert ("ok", "pending") in transitions
+    assert ("pending", "firing") in transitions
+    assert ("firing", "ok") in transitions
+    # flight: firing strictly before resolved, tagged with the objective
+    names = [e["event"] for e in flight.snapshot()
+             if e["entity"] == "slo:lat"]
+    assert names.index("slo-firing") < names.index("slo-resolved")
+    # ... and the bracket satisfies the declared protocol machine
+    from tpurpc.analysis import protocol
+
+    assert protocol.check_events(flight.snapshot(), strict=False) == []
+
+
+def test_slo_blip_does_not_fire():
+    db, g, ev, obj = _latency_rig()
+    st = obj.tracks["latency"]
+    for i in range(20):
+        # one bad sample in ten: fast window burns briefly, slow never
+        g.set(50_000.0 if i % 10 == 0 else 1000.0)
+        db.sample_once(now_ns=(i + 1) * S)
+        ev.evaluate_once(now_ns=(i + 1) * S)
+        assert st.state != "firing"
+    assert st.fired == 0
+
+
+def test_slo_availability_errors_and_sheds_burn_separate_budgets():
+    db = _private_db(fine_s=1.0, fine_window_s=32.0)
+    fam = db._registry.labeled_counter("srv_calls", ("method", "code"))
+    shed = db._registry.counter("srv_admission_rejected")
+    ev = obs_slo.SloEvaluator(eval_s=1.0, tsdb=db)
+    obj = ev.declare(obs_slo.SloObjective(
+        "avail", method="/m/A", target_pct=99.0, shed_target_pct=80.0,
+        windows=[(4.0, 8.0, 2.0)]))
+    ok = fam.labels("/m/A", "0")
+    bad = fam.labels("/m/A", "14")
+    # heavy shedding, zero handler errors: the shed budget burns, the
+    # error budget must NOT (pushback is the system working)
+    for i in range(12):
+        ok.inc(10)
+        shed.inc(10)
+        db.sample_once(now_ns=(i + 1) * S)
+        ev.evaluate_once(now_ns=(i + 1) * S)
+    assert obj.tracks["errors"].state == "ok"
+    assert obj.tracks["sheds"].state == "firing"
+    # now handler errors with no sheds: the error budget burns
+    obj2 = ev.declare(obs_slo.SloObjective(
+        "avail2", method="/m/A", target_pct=99.0,
+        windows=[(4.0, 8.0, 2.0)]))
+    for i in range(12, 26):
+        ok.inc(9)
+        bad.inc(1)  # 10% errors vs a 1% budget: burn 10x > 2.0
+        db.sample_once(now_ns=(i + 1) * S)
+        ev.evaluate_once(now_ns=(i + 1) * S)
+    assert obj2.tracks["errors"].state == "firing"
+
+
+def test_slo_method_scoping():
+    db = _private_db(fine_s=1.0, fine_window_s=32.0)
+    fam = db._registry.labeled_counter("srv_calls", ("method", "code"))
+    ev = obs_slo.SloEvaluator(eval_s=1.0, tsdb=db)
+    obj = ev.declare(obs_slo.SloObjective(
+        "a-only", method="/m/A", target_pct=99.0,
+        windows=[(4.0, 8.0, 2.0)]))
+    # /m/B fails hard; /m/A is clean — the scoped objective must not burn
+    for i in range(12):
+        fam.labels("/m/A", "0").inc(10)
+        fam.labels("/m/B", "14").inc(10)
+        db.sample_once(now_ns=(i + 1) * S)
+        ev.evaluate_once(now_ns=(i + 1) * S)
+    assert obj.tracks["errors"].state == "ok"
+
+
+def test_slo_firing_bridges_watchdog_and_healthz(monkeypatch):
+    # GLOBAL plumbing: a firing alert must land in /debug/stalls history
+    # (stage slo), flip /healthz to 503, and clear back out
+    wd = watchdog.get()
+    db = _private_db(fine_s=1.0, fine_window_s=32.0)
+    g = db._registry.gauge("p99g")
+    ev = obs_slo.SloEvaluator(eval_s=1.0, tsdb=db)
+    monkeypatch.setattr(obs_slo, "_instance", ev)
+    obj = ev.declare(obs_slo.SloObjective(
+        "page-me", latency_ms=5.0, latency_target_pct=50.0,
+        series="p99g", windows=[(2.0, 4.0, 2.0)]))
+    for i in range(10):
+        g.set(50_000.0)
+        db.sample_once(now_ns=(i + 1) * S)
+        ev.evaluate_once(now_ns=(i + 1) * S)
+    assert obj.tracks["latency"].state == "firing"
+    assert any(h.get("stage") == "slo" and h.get("method") == "page-me"
+               for h in wd.snapshot()["history"])
+    status, _ctype, body = scrape._route("/healthz")
+    assert status == 503 and b"slo" in body.lower()
+    status, _ctype, body = scrape._route("/healthz?json=1")
+    doc = json.loads(body)
+    assert doc["status"] == "degraded"
+    assert "slo-firing" in [r["reason"] for r in doc["degraded_reasons"]]
+    # /debug/slo reports it too
+    status, _ctype, body = scrape._route("/debug/slo?local=1")
+    sdoc = json.loads(body)
+    assert sdoc["firing"] and sdoc["firing"][0]["objective"] == "page-me"
+    # recovery clears healthz
+    for i in range(10, 25):
+        g.set(100.0)
+        db.sample_once(now_ns=(i + 1) * S)
+        ev.evaluate_once(now_ns=(i + 1) * S)
+    assert obj.tracks["latency"].state == "ok"
+    status, _ctype, body = scrape._route("/healthz?json=1")
+    doc = json.loads(body)
+    assert doc["status"] == "ok" and doc["degraded_reasons"] == []
+
+
+# ---------------------------------------------------------------------------
+# /healthz?json=1: every subsystem's reason appears and clears
+# ---------------------------------------------------------------------------
+
+def _health_reasons():
+    status, _ctype, body = scrape._route("/healthz?json=1")
+    doc = json.loads(body)
+    return status, [r["reason"] for r in doc["degraded_reasons"]], doc
+
+
+def test_healthz_json_watchdog_reason_appears_and_clears():
+    wd = watchdog.get()
+    wd.enabled = True
+    wd.min_stall_s = 0.01
+    tok = wd.call_started("/argus/Wedge")
+    time.sleep(0.05)
+    wd.sweep_once()
+    status, reasons, doc = _health_reasons()
+    assert status == 503 and "watchdog-stall" in reasons
+    # legacy text body preserved byte-for-byte
+    status, _ctype, body = scrape._route("/healthz")
+    worst = wd.active()[0]
+    expect = (f"degraded: {len(wd.active())} stalled call(s); "
+              f"{worst['method']} blocked on {worst['stage']} "
+              f"for {worst['age_s']}s\n").encode()
+    assert status == 503 and body == expect
+    wd.call_finished(tok)
+    wd.sweep_once()
+    status, reasons, _doc = _health_reasons()
+    assert status == 200 and "watchdog-stall" not in reasons
+
+
+def test_healthz_json_draining_reason_appears_and_clears():
+    from tpurpc.rpc.server import Server
+
+    srv = Server(max_workers=2)
+    srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    try:
+        _status, reasons, _doc = _health_reasons()
+        assert "draining" not in reasons
+        t = threading.Thread(target=srv.drain, args=(0.5,), daemon=True)
+        t.start()
+        deadline = time.monotonic() + 2.0
+        seen = False
+        while time.monotonic() < deadline:
+            status, reasons, doc = _health_reasons()
+            if "draining" in reasons:
+                seen = True
+                assert status == 200 and doc["status"] == "draining"
+                break
+        assert seen, "draining reason never appeared"
+        t.join(timeout=5)
+    finally:
+        srv.stop(grace=0)
+    _status, reasons, _doc = _health_reasons()
+    assert "draining" not in reasons  # a stopped server is not draining
+
+
+def test_healthz_json_shedding_and_kv_reasons(monkeypatch):
+    import sys
+
+    sched_mod = pytest.importorskip("tpurpc.serving.scheduler")
+    kv_mod = pytest.importorskip("tpurpc.serving.kv")
+
+    class _FakeSched:
+        name = "gen0"
+        _closed = False
+        steps = 1
+        shed_total = 2
+        preempted_total = 0
+
+        def state_str(self):
+            return "shedding"
+
+        def running_depth(self):
+            return 1
+
+        def queue_depth(self):
+            return 9
+
+        def swapped_depth(self):
+            return 0
+
+    class _FakeKv:
+        name = "arena0"
+
+        def stats(self):
+            return {"used": 1, "blocks": 4, "free": 3,
+                    "swapped_blocks": 2, "quarantined": 1,
+                    "prefix_hits": 0}
+
+    fake_s, fake_k = _FakeSched(), _FakeKv()
+    sched_mod._LIVE.add(fake_s)
+    kv_mod._LIVE.add(fake_k)
+    try:
+        status, reasons, doc = _health_reasons()
+        assert "shedding" in reasons and "kv-pressure" in reasons
+        assert status == 200  # shedding/pressure inform, they do not page
+        assert any(ln.startswith("gen gen0:") for ln in doc["lines"])
+        assert any(ln.startswith("kv arena0:") for ln in doc["lines"])
+    finally:
+        sched_mod._LIVE.discard(fake_s)
+        kv_mod._LIVE.discard(fake_k)
+    _status, reasons, _doc = _health_reasons()
+    assert "shedding" not in reasons and "kv-pressure" not in reasons
+    assert sys.modules.get("tpurpc.serving.kv") is kv_mod
+
+
+# ---------------------------------------------------------------------------
+# bundle: content, protocol conformance, rate limit, caps
+# ---------------------------------------------------------------------------
+
+def test_bundle_contents_and_protocol_conformance(tmp_path):
+    from tpurpc.analysis import protocol
+
+    # a realistic flight history: an rdv exchange + an slo bracket
+    tag = flight.tag_for("pair:test")
+    flight.emit(flight.RDV_OFFER, tag, 7, 4096)
+    flight.emit(flight.RDV_CLAIM, tag, 7, 99)
+    flight.emit(flight.RDV_COMPLETE, tag, 99, 4096)
+    w = obs_bundle.BundleWriter(str(tmp_path), min_interval_s=0.0)
+    path = w.capture("manual", detail="unit test")
+    assert path is not None and os.path.isdir(path)
+    names = sorted(os.listdir(path))
+    pid = os.getpid()
+    assert f"flight-{pid}.json" in names
+    assert {"meta.json", "traces.json", "history.json",
+            "slo.json", "stalls.json"} <= set(names)
+    with open(os.path.join(path, f"flight-{pid}.json")) as f:
+        events = json.load(f)
+    assert isinstance(events, list) and len(events) >= 3
+    # the acceptance contract: the bundle dir IS a --flight argument
+    total, violations = protocol.check_dump(path)
+    assert violations == [] and total >= 3
+    # a bundle-written flight event landed (pure-int, interned tag)
+    assert any(e["event"] == "bundle-written" for e in flight.snapshot())
+
+
+def test_bundle_rate_limit_one_per_interval(tmp_path):
+    w = obs_bundle.BundleWriter(str(tmp_path), min_interval_s=60.0)
+    assert w.capture("slo", key="slo:lat") is not None
+    # the flap: same alert again inside the interval
+    assert w.capture("slo", key="slo:lat") is None
+    # a DIFFERENT alert shortly after is also held by the global floor
+    assert w.capture("watchdog", key="wd:other") is None
+    assert len(obs_bundle.list_bundles(str(tmp_path))) == 1
+
+
+def test_bundle_caps_delete_oldest(tmp_path):
+    w = obs_bundle.BundleWriter(str(tmp_path), max_bundles=2,
+                                min_interval_s=0.0)
+    paths = [w.capture("manual", key=f"k{i}") for i in range(4)]
+    assert all(p is not None for p in paths)
+    left = obs_bundle.list_bundles(str(tmp_path))
+    assert len(left) == 2
+    assert os.path.basename(paths[-1]) in left  # newest survives
+
+
+def test_bundle_armed_by_watchdog_trip(tmp_path):
+    obs_bundle.enable(str(tmp_path), min_interval_s=0.0)
+    wd = watchdog.get()
+    wd.enabled = True
+    wd.external_trip("slo", "lat-objective", "unit-test page")
+    bundles = obs_bundle.list_bundles(str(tmp_path))
+    assert len(bundles) == 1 and "-slo-" in bundles[0]
+    wd.external_trip("rendezvous", "other", "different stage")
+    # different key but the global floor holds inside min_interval/2=0
+    assert len(obs_bundle.list_bundles(str(tmp_path))) == 2
+
+
+def test_bundle_renderer_cli(tmp_path, capsys):
+    from tpurpc.tools import bundle as bundle_cli
+
+    w = obs_bundle.BundleWriter(str(tmp_path), min_interval_s=0.0)
+    flight.emit(flight.PAIR_CONNECT, 0, 1)
+    w.capture("manual", detail="render me")
+    assert bundle_cli.main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "render me" in out and "flight" in out
+
+
+# ---------------------------------------------------------------------------
+# collector: labels, staleness, reset clamp, merged slo, HTTP face
+# ---------------------------------------------------------------------------
+
+def _fake_member(col, target, text, slo=None):
+    m = col._members[target]
+    m.metrics_text = text
+    m.slo = slo
+    m.misses = 0
+    m.polls += 1
+    m.last_ok_mono = time.monotonic()
+    return m
+
+
+def test_collector_member_labels_and_census():
+    from tpurpc.obs.collector import FleetCollector
+
+    col = FleetCollector(["h1:1", "h2:2"], poll_s=0.1)
+    _fake_member(col, "h1:1",
+                 "# TYPE tpurpc_x counter\ntpurpc_x 5\n")
+    _fake_member(col, "h2:2",
+                 "# TYPE tpurpc_x counter\ntpurpc_x{a=\"b\"} 7\n")
+    text = col.merged_metrics()
+    assert 'tpurpc_x{member="h1:1"} 5' in text
+    assert 'tpurpc_x{member="h2:2",a="b"} 7' in text
+    assert 'tpurpc_member_up{member="h1:1"} 1' in text
+
+
+def test_collector_stale_member_series_vanish():
+    from tpurpc.obs.collector import FleetCollector
+
+    col = FleetCollector(["up:1", "dead:2"], poll_s=0.1, stale_after=2)
+    _fake_member(col, "up:1", "# TYPE tpurpc_x counter\ntpurpc_x 5\n")
+    m = _fake_member(col, "dead:2",
+                     "# TYPE tpurpc_x counter\ntpurpc_x 9\n")
+    text = col.merged_metrics()
+    assert 'tpurpc_x{member="dead:2"} 9' in text
+    m.misses = 3  # the member died: polls failed past the staleness bar
+    text = col.merged_metrics()
+    assert 'member="dead:2"} 9' not in text          # series VANISH
+    assert 'tpurpc_member_up{member="dead:2"} 0' in text     # marked
+    assert 'tpurpc_member_stale{member="dead:2"} 1' in text
+    census = {c["member"]: c["state"] for c in col.census()}
+    assert census == {"up:1": "up", "dead:2": "stale"}
+
+
+def test_collector_counter_reset_clamped():
+    from tpurpc.obs.collector import FleetCollector
+
+    col = FleetCollector(["m:1"], poll_s=0.1)
+    _fake_member(col, "m:1", "# TYPE tpurpc_c counter\ntpurpc_c 100\n")
+    t1 = col.merged_metrics()
+    assert 'tpurpc_c{member="m:1"} 100' in t1
+    # the member restarted: raw counter re-counts from 4
+    _fake_member(col, "m:1", "# TYPE tpurpc_c counter\ntpurpc_c 4\n")
+    t2 = col.merged_metrics()
+    assert 'tpurpc_c{member="m:1"} 104' in t2    # last-known + delta
+    assert "tpurpc_collector_counter_resets 1" in t2
+    # gauges pass through unclamped
+    _fake_member(col, "m:1", "# TYPE tpurpc_g gauge\ntpurpc_g 2\n")
+    assert 'tpurpc_g{member="m:1"} 2' in col.merged_metrics()
+
+
+def test_collector_merged_slo_alerts_carry_member():
+    from tpurpc.obs.collector import FleetCollector
+
+    col = FleetCollector(["a:1", "b:2"], poll_s=0.1)
+    _fake_member(col, "a:1", "", slo={
+        "firing": [{"objective": "lat", "track": "latency",
+                    "burn_fast": 3.0}],
+        "objectives": []})
+    _fake_member(col, "b:2", "", slo={"firing": [], "objectives": []})
+    doc = col.merged_slo()
+    assert doc["firing"] == 1
+    assert doc["alerts"][0]["member"] == "a:1"
+    assert doc["members"]["b:2"]["state"] == "up"
+
+
+def test_collector_live_http_end_to_end():
+    import urllib.request
+
+    from tpurpc.obs.collector import FleetCollector
+    from tpurpc.rpc.server import Server
+
+    srv = Server(max_workers=2)
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    col = FleetCollector([f"127.0.0.1:{port}"], poll_s=0.2)
+    try:
+        col.poll_once()
+        assert col.census()[0]["state"] == "up"
+        text = col.merged_metrics()
+        assert f'member="127.0.0.1:{port}"' in text
+        cport = col.serve(port=0)
+        raw = urllib.request.urlopen(
+            f"http://127.0.0.1:{cport}/fleet/metrics", timeout=5).read()
+        assert b"tpurpc_member_up" in raw
+        raw = urllib.request.urlopen(
+            f"http://127.0.0.1:{cport}/fleet/slo", timeout=5).read()
+        assert b"members" in raw
+        # the member dies: its series must vanish, not freeze
+        srv.stop(grace=0)
+        for _ in range(col.stale_after + 1):
+            col.poll_once()
+        text = col.merged_metrics()
+        assert f'tpurpc_member_up{{member="127.0.0.1:{port}"}} 0' in text
+        assert f'tpurpc_ring_msgs_read{{member="127.0.0.1:{port}"' \
+            not in text
+    finally:
+        col.stop()
+        srv.stop(grace=0)
+
+
+# ---------------------------------------------------------------------------
+# shard merge: counter-reset hardening (satellite)
+# ---------------------------------------------------------------------------
+
+def test_shard_merge_clamps_restarted_worker(monkeypatch):
+    from tpurpc.obs import shard as obs_shard
+
+    monkeypatch.setattr(obs_shard, "_CLAMP", None)  # fresh clamp
+
+    bodies = {"scrape": 0}
+
+    def fake_each(path):
+        # shard 0 healthy both scrapes; shard 1 restarted in between
+        # (killed-and-respawned worker: counters re-count from zero)
+        if path.startswith("/metrics"):
+            v1 = "120" if bodies["scrape"] == 0 else "3"
+            yield 0, 200, b"# TYPE tpurpc_c counter\ntpurpc_c 50\n"
+            yield 1, 200, (f"# TYPE tpurpc_c counter\ntpurpc_c {v1}\n"
+                           ).encode()
+        else:
+            wf1 = {"hops": [{"hop": "wire",
+                             "bytes": 1000 if bodies["scrape"] == 0 else 40,
+                             "busy_ms": 1.0, "copy_bytes": 0,
+                             "what": "w"}]}
+            yield 0, 200, json.dumps(
+                {"hops": [{"hop": "wire", "bytes": 500, "busy_ms": 1.0,
+                           "copy_bytes": 0, "what": "w"}]}).encode()
+            yield 1, 200, json.dumps(wf1).encode()
+
+    monkeypatch.setattr(obs_shard, "_each_shard", fake_each)
+    text1 = obs_shard.aggregate_metrics()
+    assert 'tpurpc_c{shard="1"} 120' in text1
+    wf_before = obs_shard.aggregate_waterfall()
+    assert wf_before["hops"][0]["bytes"] == 1500
+    bodies["scrape"] = 1  # shard 1 has restarted
+    text2 = obs_shard.aggregate_metrics()
+    assert 'tpurpc_c{shard="1"} 123' in text2  # 120 + 3, never backwards
+    wf_after = obs_shard.aggregate_waterfall()
+    assert wf_after["hops"][0]["bytes"] >= wf_before["hops"][0]["bytes"]
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: detect -> localize -> capture (the acceptance proof)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.timeout(60)
+def test_argus_detect_to_capture_end_to_end(tmp_path, monkeypatch):
+    """With windows scaled down: an induced p99 degradation fires a
+    burn-rate alert (pending→firing observed, flight ordered), trips
+    /healthz degraded, and produces exactly ONE rate-limited bundle whose
+    flight dump passes protocol conformance."""
+    from tpurpc.analysis import protocol
+    from tpurpc.rpc.channel import Channel
+    from tpurpc.rpc.server import Server, unary_unary_rpc_method_handler
+
+    # fresh global tsdb on a fast grain (the env knob the smoke uses too)
+    monkeypatch.setenv("TPURPC_TSDB_FINE_S", "0.05")
+    obs_tsdb.postfork_reset()
+    obs_slo.reset()
+    db = obs_tsdb.get()
+
+    slow = threading.Event()
+
+    def handler(req, ctx):
+        if slow.is_set():
+            time.sleep(0.05)
+        return b"ok"
+
+    srv = Server(max_workers=4)
+    srv.add_method("/argus/Probe", unary_unary_rpc_method_handler(handler))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()  # starts the tsdb sampler; arms nothing else yet
+    obs_bundle.enable(str(tmp_path), min_interval_s=30.0)
+    ev = obs_slo.get()
+    ev.eval_s = 0.1
+    obj = obs_slo.declare(
+        "probe-p99", method="/argus/Probe", latency_ms=10.0,
+        latency_target_pct=50.0, windows=[(0.8, 1.6, 1.2)])
+    st = obj.tracks["latency"]
+    try:
+        with Channel(f"127.0.0.1:{port}") as ch:
+            call = ch.unary_unary("/argus/Probe")
+            for _ in range(16):  # build the healthy rolling-p99 history
+                call(b"x", timeout=5)
+            slow.set()           # induce the p99 degradation
+            t0 = time.monotonic()
+            states = set()
+            deadline = t0 + 2 * 0.8 + 8.0  # 2 fast windows + rig slack
+            while time.monotonic() < deadline:
+                call(b"x", timeout=5)
+                states.add(st.state)
+                if st.state == "firing":
+                    break
+            assert st.state == "firing", (st.state, states)
+            assert "pending" in states  # observed BEFORE firing
+            # healthz degraded with the structured reason
+            status, _ctype, body = scrape._route("/healthz?json=1")
+            doc = json.loads(body)
+            assert status == 503
+            assert "slo-firing" in [r["reason"]
+                                    for r in doc["degraded_reasons"]]
+            # the page landed in /debug/stalls
+            assert any(h.get("stage") == "slo"
+                       for h in watchdog.get().snapshot()["history"])
+            # exactly ONE bundle despite continued firing evaluations
+            time.sleep(0.5)
+            bundles = obs_bundle.list_bundles(str(tmp_path))
+            assert len(bundles) == 1, bundles
+            bpath = os.path.join(str(tmp_path), bundles[-1])
+            total, violations = protocol.check_dump(bpath)
+            assert violations == [] and total > 0
+            # the bundle's flight dump shows the firing edge
+            with open(os.path.join(
+                    bpath, f"flight-{os.getpid()}.json")) as f:
+                events = json.load(f)
+            assert any(e["event"] == "slo-firing" for e in events)
+            # the tsdb window in the bundle brackets the degradation
+            with open(os.path.join(bpath, "history.json")) as f:
+                hist = json.load(f)
+            assert "watchdog_p99_us{/argus/Probe}" in hist["series"]
+    finally:
+        ev.stop()
+        srv.stop(grace=0)
+        db.stop()
+        obs_tsdb.postfork_reset()  # next get() rebuilds on default grain
+    # flight order end-to-end: firing recorded, bundle written after
+    names = [e["event"] for e in flight.snapshot()]
+    assert names.index("slo-firing") < names.index("bundle-written")

@@ -128,6 +128,18 @@ note "tpurpc-keystone disagg smoke (2 processes, zero-copy KV handoff)"
 TPURPC_FLIGHT_DUMP="$FLIGHT_DUMPS" python -m tpurpc.tools.disagg_smoke \
     || fail=1
 
+# 2g3b) tpurpc-argus smoke (ISSUE 14): one server + one client + a
+#      collector PROCESS polling it at 4 Hz, burn-rate windows scaled to
+#      fractions of a second — an induced p99 degradation must take the
+#      SLO alert pending->firing within two fast windows, /fleet/slo on
+#      the collector must show it under the right member label, /healthz
+#      must answer the structured slo-firing reason, and exactly one
+#      rate-limited evidence bundle must land on disk with its flight
+#      dump passing protocol conformance unmodified. ~8s, no jax.
+note "tpurpc-argus smoke (slo burn-rate -> fleet collector -> bundle)"
+TPURPC_FLIGHT_DUMP="$FLIGHT_DUMPS" python -m tpurpc.tools.argus_smoke \
+    || fail=1
+
 # 2g4) tpurpc-proof protocol conformance (ISSUE 12): every flight dump
 #      the smokes above produced (fleet, rendezvous, cadence, keystone —
 #      every process, subprocesses included) must conform to the declared

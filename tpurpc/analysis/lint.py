@@ -149,6 +149,15 @@ FLIGHT_HOT_MODULES = HOT_LOG_MODULES + (
     # adoption/flip/stall edges on the control hot path — same pure-int
     # discipline, interned plane tag
     os.path.join("tpurpc", "core", "ctrlring.py"),
+    # tpurpc-argus (ISSUE 14): the tsdb sample tick and the slo evaluator
+    # run forever on background cadences, and the bundle/collector planes
+    # emit lifecycle events — every flight emission site stays on the
+    # interned-tag pure-int discipline (the tsdb sample path itself is
+    # additionally alloc-audited by its preallocated-ring design)
+    os.path.join("tpurpc", "obs", "tsdb.py"),
+    os.path.join("tpurpc", "obs", "slo.py"),
+    os.path.join("tpurpc", "obs", "bundle.py"),
+    os.path.join("tpurpc", "obs", "collector.py"),
 )
 
 #: module suffix -> qualified functions on its INLINE DISPATCH path (the

@@ -522,11 +522,35 @@ def _mk_ctrl_stall() -> Machine:
                  "BEGIN, no nesting")
 
 
+def _mk_slo() -> Machine:
+    F = _flight
+
+    def token(ev):
+        c = ev.get("code")
+        if c == F.SLO_FIRING:
+            return "firing"
+        if c == F.SLO_RESOLVED:
+            return "resolved"
+        return None
+
+    def key(ev):
+        # one alert episode per (objective tag, budget track)
+        return (ev.get("tag"), ev.get("a1"))
+
+    return Machine(
+        "slo-alert", token, key,
+        openers={"firing": "firing"},
+        transitions={("firing", "resolved"): "done"},
+        describe="tpurpc-argus burn-rate alert episodes bracket per "
+                 "(objective, track): no double-fire without a resolve, "
+                 "no orphan resolve")
+
+
 #: every declared machine, in evaluation order
 MACHINES: List[Machine] = [
     _mk_rdv_lease(), _mk_rdv_offer(), _mk_kv_swap(), _mk_migration(),
     _mk_kv_ship(), _mk_gen_step(), _mk_hedge(), _mk_drain(), _mk_subch(),
-    _mk_conn(), _mk_ctrl_ring(), _mk_ctrl_stall(),
+    _mk_conn(), _mk_ctrl_ring(), _mk_ctrl_stall(), _mk_slo(),
 ]
 
 

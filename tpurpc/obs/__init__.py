@@ -48,11 +48,33 @@ tpurpc-lens (ISSUE 8) adds the PERFORMANCE-ATTRIBUTION faces:
   deployment: spans + flight edges + CPU samples from every shard/fleet
   member, aligned on per-process monotonic↔wall clock anchors.
 
+tpurpc-argus (ISSUE 14) adds the TIME and FLEET dimensions:
+
+* :mod:`tpurpc.obs.tsdb` — a bounded in-process ring time-series store:
+  a background sampler snapshots the registry into preallocated
+  two-tier rings (~1 s grain for minutes, ~15 s for the hour);
+  ``rate()`` / ``quantile_over_time()`` / ``window()`` queries at
+  ``GET /debug/history``.
+* :mod:`tpurpc.obs.slo` — declared availability/latency objectives
+  evaluated as multi-window multi-burn-rate alerts over the tsdb
+  (pending→firing→resolved; admission sheds burn a separate budget);
+  ``GET /debug/slo``, flight fire/resolve events, watchdog bridge,
+  degraded ``/healthz``.
+* :mod:`tpurpc.obs.collector` — a standalone fleet collector polling
+  every member's existing routes and serving merged, member-labeled
+  ``/fleet/metrics`` + ``/fleet/slo`` + ``/fleet/timeline`` (stale
+  members' series vanish; counter resets clamped).
+* :mod:`tpurpc.obs.bundle` — automatic evidence capture: a firing alert
+  or watchdog trip writes a rate-limited, size-capped postmortem bundle
+  (flight dump, tail traces, profile, waterfall, tsdb window) that
+  ``python -m tpurpc.analysis protocol --flight`` replays unmodified.
+
 The reference fork's whole debugging story was trace flags plus a
 shutdown-time profiler table (SURVEY.md §5, ``stats_time.cc``); tpurpc-scope
 replaces post-hoc printf with always-on, near-free telemetry, tpurpc-blackbox
 makes the rare-event failures it samples away recoverable after the fact,
-and tpurpc-lens says where the cycles and bytes actually go.
+tpurpc-lens says where the cycles and bytes actually go, and tpurpc-argus
+answers over time and across members — then writes the postmortem itself.
 """
 
 from tpurpc.obs import flight, lens, metrics, profiler, tracing  # noqa: F401

@@ -144,6 +144,14 @@ CTRL_SPIN = 57            # consumer hot-polling the ring; a1 = consumed so far
 CTRL_PARK = 58            # consumer parked on the framed path; a1 = consumed
 CTRL_STALL_BEGIN = 59     # producer saw the ring full; a1 = backlog
 CTRL_STALL_END = 60       # space returned (consumer drained)
+# tpurpc-argus (ISSUE 14): SLO burn-rate alerting + automatic evidence
+# capture. FIRING/RESOLVED bracket one alert episode per (objective tag,
+# track) — the slo protocol machine forbids a double-fire or an orphan
+# resolve. BUNDLE_WRITTEN records one postmortem bundle landing on disk
+# (a1 = trigger code: 0 slo / 1 watchdog / 2 manual, a2 = bundle ordinal).
+SLO_FIRING = 61           # a1 = track (0=errors,1=sheds,2=latency), a2 = burn x100
+SLO_RESOLVED = 62         # a1 = track, a2 = burn x100 at resolve
+BUNDLE_WRITTEN = 63       # a1 = trigger code, a2 = bundle ordinal
 
 EVENT_NAMES: Dict[int, str] = {
     PAIR_CONNECT: "pair-connect",
@@ -206,6 +214,9 @@ EVENT_NAMES: Dict[int, str] = {
     CTRL_PARK: "ctrl-park",
     CTRL_STALL_BEGIN: "ctrl-stall-begin",
     CTRL_STALL_END: "ctrl-stall-end",
+    SLO_FIRING: "slo-firing",
+    SLO_RESOLVED: "slo-resolved",
+    BUNDLE_WRITTEN: "bundle-written",
 }
 
 #: batch-flush reason codes (a1 of BATCH_FLUSH) — mirrors the jaxshim

@@ -31,6 +31,15 @@ Routes::
                    raw samples the timeline tool renders)
     /debug/waterfall  tpurpc-lens byte-flow waterfall: per-hop effective
                    GB/s with the copy ledger folded in (?text=1 table)
+    /debug/history tpurpc-argus ring tsdb: bounded two-tier metric history
+                   (?series=NAME&window_s=S for points, bare = inventory)
+    /debug/slo     tpurpc-argus SLO objectives, burn rates, alert states
+
+tpurpc-argus (ISSUE 14): ``/healthz?json=1`` answers the STRUCTURED body
+(:func:`healthz_doc`) — status plus one ``degraded_reasons`` list where
+watchdog stalls, firing SLO alerts, drain, shedding, and KV pressure each
+contribute a ``{"reason", "detail"}`` entry; the bare text face keeps
+every legacy body byte-for-byte.
 
 tpurpc-lens (ISSUE 8): every ``_route`` dispatch records its own cost into
 the ``scrape_us`` latency histogram — the concurrent-scraper test asserts
@@ -206,63 +215,139 @@ def _route(path: str) -> Tuple[int, str, bytes]:
         _SCRAPE_US.record((_time.monotonic_ns() - t0) // 1000)
 
 
+def healthz_doc() -> dict:
+    """tpurpc-argus (ISSUE 14): ONE structured health assembly feeding
+    both ``/healthz`` faces. Every subsystem that used to compose its own
+    ad-hoc text line (watchdog 503, fleet drain, cadence shedding, kv
+    pressure, and now a firing SLO) contributes one entry to
+    ``degraded_reasons`` — ``[{"reason": <slug>, "detail": <text>}]`` —
+    so probes stop regex-ing prose. ``code`` is the HTTP status the text
+    face answers (503 iff a watchdog stall or SLO page is live);
+    ``lines`` are the legacy per-subsystem body lines, unchanged."""
+    reasons: List[dict] = []
+    code = 200
+    # tpurpc-blackbox: a live stall diagnosis degrades health — LBs and
+    # probes see the wedge without scraping /debug/stalls themselves.
+    # Ordered FIRST so the legacy degraded text body stays byte-for-byte.
+    try:
+        from tpurpc.obs import watchdog as _watchdog
+
+        active = _watchdog.get().active()
+    except Exception:
+        active = []
+    if active:
+        worst = active[0]
+        code = 503
+        reasons.append({
+            "reason": "watchdog-stall",
+            "detail": (f"{len(active)} stalled call(s); "
+                       f"{worst['method']} blocked on {worst['stage']} "
+                       f"for {worst['age_s']}s")})
+    # tpurpc-argus: a FIRING burn-rate alert is a page — degraded, like a
+    # stall (sys.modules-gated: processes without an SLO plane keep their
+    # exact old behavior)
+    import sys
+
+    slo_lines: List[str] = []
+    try:
+        slo_mod = sys.modules.get("tpurpc.obs.slo")
+        if slo_mod:
+            fir = slo_mod.firing()
+            if fir:
+                code = 503
+                f0 = fir[0]
+                reasons.append({
+                    "reason": "slo-firing",
+                    "detail": (f"{len(fir)} firing SLO alert(s); "
+                               f"{f0['objective']}/{f0['track']} burning "
+                               f"{f0['burn_fast']}x fast-window budget")})
+            slo_lines = slo_mod.health_lines()
+    except Exception:
+        pass
+    # tpurpc-fleet: a draining server is HEALTHY but leaving — 200 with a
+    # distinct body (a 503 would read as failure and page; orchestrators
+    # key on the text to stop routing without alarming)
+    try:
+        from tpurpc.rpc import channelz as _channelz
+
+        draining = any(getattr(srv, "draining", False)
+                       for _sid, srv in _channelz.live_servers())
+    except Exception:
+        draining = False
+    if draining:
+        reasons.append({"reason": "draining",
+                        "detail": "graceful drain in progress (healthy, "
+                                  "leaving rotation)"})
+    # tpurpc-cadence: live decode schedulers append their shed/queue
+    # state — during overload an operator (or probe) reads "shedding"
+    # plus the queue numbers right here, without the metrics plane.
+    # Still 200: a shedding server is doing its job, not failing.
+    try:
+        gen_mod = sys.modules.get("tpurpc.serving.scheduler")
+        gen_lines = gen_mod.health_lines() if gen_mod else []
+    except Exception:
+        gen_lines = []
+    shedding = [ln for ln in gen_lines if "state=shedding" in ln]
+    if shedding:
+        reasons.append({"reason": "shedding",
+                        "detail": f"{len(shedding)} scheduler(s) shedding "
+                                  "batch-class load under pressure"})
+    # tpurpc-keystone: live KV arenas append block occupancy / swap
+    # pressure / quarantine counts — same sys.modules gate, so
+    # processes without a KV plane keep their exact old bodies
+    kv_lines: List[str] = []
+    try:
+        kv_mod = sys.modules.get("tpurpc.serving.kv")
+        if kv_mod:
+            kv_lines = kv_mod.health_lines()
+            pressured = []
+            for m in list(getattr(kv_mod, "_LIVE", ()) or ()):
+                try:
+                    s = m.stats()
+                    if s.get("swapped_blocks") or s.get("quarantined"):
+                        pressured.append(m.name)
+                except Exception:
+                    continue
+            if pressured:
+                reasons.append({
+                    "reason": "kv-pressure",
+                    "detail": f"KV arena(s) under pressure "
+                              f"(swap/quarantine): "
+                              f"{', '.join(sorted(pressured))}"})
+    except Exception:
+        pass
+    lines = gen_lines + kv_lines + slo_lines
+    status = ("degraded" if code == 503
+              else "draining" if draining else "ok")
+    return {"status": status, "code": code, "draining": draining,
+            "degraded_reasons": reasons, "lines": lines}
+
+
 def route_local(path: str) -> Tuple[int, str, bytes]:
     """The single-process rendering of one GET path (no shard fan-out)."""
     route, _, query = path.partition("?")
     if route in ("/metrics", "/metrics/"):
         return 200, "text/plain; version=0.0.4", render_prometheus().encode()
     if route in ("/healthz", "/health"):
-        # tpurpc-blackbox: a live stall diagnosis degrades health — LBs and
-        # probes see the wedge without scraping /debug/stalls themselves
-        try:
-            from tpurpc.obs import watchdog as _watchdog
-
-            active = _watchdog.get().active()
-        except Exception:
-            active = []
-        if active:
-            worst = active[0]
-            body = (f"degraded: {len(active)} stalled call(s); "
-                    f"{worst['method']} blocked on {worst['stage']} "
-                    f"for {worst['age_s']}s\n").encode()
+        params = _query_params(query)
+        doc = healthz_doc()
+        # tpurpc-argus (ISSUE 14): the STRUCTURED face — one
+        # degraded_reasons list instead of N ad-hoc text conventions
+        if params.get("json"):
+            return (doc["code"], "application/json",
+                    json.dumps(doc, indent=1).encode())
+        # the text face: every legacy body preserved byte-for-byte (the
+        # fleet/shard/cadence tests and smokes key on these exact bytes)
+        if doc["code"] == 503:
+            worst = doc["degraded_reasons"][0]
+            body = (f"degraded: {worst['detail']}\n").encode()
             return 503, "text/plain", body
-        # tpurpc-fleet: a draining server is HEALTHY but leaving — 200
-        # with a distinct body (a 503 would read as failure and page;
-        # orchestrators key on the text to stop routing without alarming)
-        try:
-            from tpurpc.rpc import channelz as _channelz
-
-            draining = any(getattr(srv, "draining", False)
-                           for _sid, srv in _channelz.live_servers())
-        except Exception:
-            draining = False
-        # tpurpc-cadence: live decode schedulers append their shed/queue
-        # state — during overload an operator (or probe) reads "shedding"
-        # plus the queue numbers right here, without the metrics plane.
-        # Still 200: a shedding server is doing its job, not failing.
-        try:
-            import sys
-
-            gen_mod = sys.modules.get("tpurpc.serving.scheduler")
-            gen_lines = gen_mod.health_lines() if gen_mod else []
-        except Exception:
-            gen_lines = []
-        # tpurpc-keystone: live KV arenas append block occupancy / swap
-        # pressure / quarantine counts — same sys.modules gate, so
-        # processes without a KV plane keep their exact old bodies
-        try:
-            import sys
-
-            kv_mod = sys.modules.get("tpurpc.serving.kv")
-            gen_lines = gen_lines + (kv_mod.health_lines() if kv_mod
-                                     else [])
-        except Exception:
-            pass
-        head = b"draining" if draining else b"ok"
+        head = b"draining" if doc["draining"] else b"ok"
+        gen_lines = doc["lines"]
         if gen_lines:
             body = head + b"\n" + "\n".join(gen_lines).encode() + b"\n"
             return 200, "text/plain", body
-        if draining:
+        if doc["draining"]:
             return 200, "text/plain", b"draining\n"
         return 200, "text/plain", b"ok\n"
     if route in ("/debug/flight", "/debug/flight/"):
@@ -308,6 +393,19 @@ def route_local(path: str) -> Tuple[int, str, bytes]:
             return 200, "text/plain", _lens.render_text().encode()
         return (200, "application/json",
                 json.dumps(_lens.waterfall()).encode())
+    if route in ("/debug/history", "/debug/history/"):
+        # tpurpc-argus (ISSUE 14): the ring tsdb — bounded metric history
+        from tpurpc.obs import tsdb as _tsdb
+
+        params = _query_params(query)
+        return (200, "application/json",
+                json.dumps(_tsdb.history_doc(params)).encode())
+    if route in ("/debug/slo", "/debug/slo/"):
+        # tpurpc-argus: objectives + burn rates + alert states
+        from tpurpc.obs import slo as _slo
+
+        return (200, "application/json",
+                json.dumps(_slo.slo_doc(), indent=1).encode())
     if route in ("/channelz", "/channelz/"):
         from tpurpc.rpc import channelz
 
@@ -326,7 +424,8 @@ def route_local(path: str) -> Tuple[int, str, bytes]:
         return 200, "application/json", body
     return (404, "text/plain",
             b"tpurpc-scope: /metrics /traces /channelz /healthz "
-            b"/debug/flight /debug/stalls /debug/profile /debug/waterfall\n")
+            b"/debug/flight /debug/stalls /debug/profile /debug/waterfall "
+            b"/debug/history /debug/slo\n")
 
 
 def _response(status: int, ctype: str, body: bytes,

@@ -39,8 +39,29 @@ __all__ = [
     "set_identity", "shard_id", "n_shards", "set_peers", "peers",
     "sharded", "route_aggregate", "aggregate_metrics", "aggregate_flight",
     "aggregate_stalls", "aggregate_healthz", "aggregate_traces",
-    "aggregate_profile", "aggregate_waterfall",
+    "aggregate_profile", "aggregate_waterfall", "aggregate_slo",
+    "aggregate_history",
 ]
+
+# tpurpc-argus (ISSUE 14): counter-reset hardening. A shard worker that
+# died and was respawned restarts every counter at zero; summing or
+# re-exporting its raw values silently steps the merged series BACKWARDS
+# (a scrape-side cliff that poisons every rate() downstream). One
+# process-wide ResetClamp — keyed (shard, series) — detects the monotonic
+# break and continues each series from last-known + delta. It persists
+# across scrapes by design: the clamp IS the memory of the restart.
+
+
+def _reset_clamp():
+    from tpurpc.obs.tsdb import ResetClamp
+
+    global _CLAMP
+    if _CLAMP is None:
+        _CLAMP = ResetClamp()
+    return _CLAMP
+
+
+_CLAMP = None
 
 _lock = threading.Lock()
 _SHARD_ID = -1   # -1 = this process is not a shard worker
@@ -150,18 +171,36 @@ def aggregate_metrics() -> str:
     types: Dict[str, str] = {}
     series: List[str] = []
     up: List[int] = []
+    clamp = _reset_clamp()
     for k, status, body in _each_shard("/metrics"):
         if status != 200:
             continue
         up.append(k)
+        counters: set = set()
         for line in body.decode("utf-8", errors="replace").splitlines():
             if line.startswith("# TYPE "):
                 parts = line.split()
                 if len(parts) >= 4:
                     types.setdefault(parts[2], parts[3])
+                    if parts[3] == "counter":
+                        counters.add(parts[2])
                 continue
             if not line or line.startswith("#"):
                 continue
+            name, _, value = line.rpartition(" ")
+            if name and (name in counters
+                         or name.split("{", 1)[0] in counters):
+                # killed-and-restarted worker: clamp the monotonic break
+                try:
+                    v = float(value)
+                except ValueError:
+                    v = None
+                if v is not None:
+                    clamped = clamp.clamp((k, name), v)
+                    if clamped != v:
+                        line = (f"{name} {int(clamped)}"
+                                if clamped.is_integer()
+                                else f"{name} {clamped}")
             series.append(_shard_label(line, k))
     lines = [f"# TYPE {name} {t}" for name, t in sorted(types.items())]
     lines.append("# TYPE tpurpc_shard_up gauge")
@@ -302,6 +341,7 @@ def aggregate_waterfall() -> dict:
     shards: Dict[str, dict] = {}
     merged: Dict[str, dict] = {}
     order: List[str] = []
+    clamp = _reset_clamp()
     for k, status, body in _each_shard("/debug/waterfall?local=1"):
         if status != 200:
             continue
@@ -316,9 +356,15 @@ def aggregate_waterfall() -> dict:
                 merged[hop] = {"hop": hop, "bytes": 0, "busy_ms": 0.0,
                                "copy_bytes": 0, "what": row.get("what", "")}
                 order.append(hop)
-            merged[hop]["bytes"] += int(row.get("bytes") or 0)
-            merged[hop]["busy_ms"] += float(row.get("busy_ms") or 0.0)
-            merged[hop]["copy_bytes"] += int(row.get("copy_bytes") or 0)
+            # tpurpc-argus: these SUM raw per-shard counters — exactly the
+            # merge a worker restart would step backwards; clamp each
+            # shard's contribution to its monotone view first
+            merged[hop]["bytes"] += int(clamp.clamp(
+                (k, hop, "bytes"), int(row.get("bytes") or 0)))
+            merged[hop]["busy_ms"] += clamp.clamp(
+                (k, hop, "busy_ms"), float(row.get("busy_ms") or 0.0))
+            merged[hop]["copy_bytes"] += int(clamp.clamp(
+                (k, hop, "copy_bytes"), int(row.get("copy_bytes") or 0)))
     rows = []
     for hop in order:
         r = merged[hop]
@@ -331,6 +377,40 @@ def aggregate_waterfall() -> dict:
             "slowest_hop": (min(live, key=lambda r: r["gbps"])["hop"]
                             if live else None),
             "shards": shards}
+
+
+# -- /debug/slo + /debug/history (tpurpc-argus, ISSUE 14) ---------------------
+
+def aggregate_slo() -> dict:
+    """Every reachable shard's SLO document plus one flat shard-tagged
+    ``firing`` list — the serving-port answer to "is anything paging"."""
+    shards: Dict[str, dict] = {}
+    firing: List[dict] = []
+    for k, status, body in _each_shard("/debug/slo?local=1"):
+        if status != 200:
+            continue
+        try:
+            doc = json.loads(body)
+        except ValueError:
+            continue
+        shards[str(k)] = doc
+        for a in doc.get("firing", ()):
+            firing.append(dict(a, shard=k))
+    return {"shards": shards, "firing": firing}
+
+
+def aggregate_history() -> dict:
+    """Per-shard tsdb inventories (each worker samples its OWN registry —
+    series merge happens at query time via the shard key, like /traces)."""
+    shards: Dict[str, dict] = {}
+    for k, status, body in _each_shard("/debug/history?local=1"):
+        if status != 200:
+            continue
+        try:
+            shards[str(k)] = json.loads(body)
+        except ValueError:
+            continue
+    return {"shards": shards}
 
 
 # -- /debug/stalls ------------------------------------------------------------
@@ -424,6 +504,15 @@ def route_aggregate(route: str, params: dict
                         aggregate_flight_text(since_ns=since_ns).encode())
             return (200, "application/json",
                     json.dumps(aggregate_flight(since_ns=since_ns)).encode())
+        if route in ("/debug/slo", "/debug/slo/"):
+            return (200, "application/json",
+                    json.dumps(aggregate_slo(), indent=1).encode())
+        if route in ("/debug/history", "/debug/history/") \
+                and not params.get("series"):
+            # a series drill-down (?series=) stays per-worker — points
+            # from different registries must not interleave silently
+            return (200, "application/json",
+                    json.dumps(aggregate_history()).encode())
         if route in ("/debug/stalls", "/debug/stalls/"):
             return (200, "application/json",
                     json.dumps(aggregate_stalls(), indent=1).encode())

@@ -58,7 +58,37 @@ _log = logging.getLogger("tpurpc.watchdog")
 
 STAGES = ("credit-starvation", "peer-not-reading", "h2-flow-control",
           "ctrl-ring", "rendezvous", "kv-swap", "migration", "decode-step",
-          "batcher-wait", "poller-wake", "device-infer", "unknown")
+          "batcher-wait", "poller-wake", "device-infer", "slo", "unknown")
+
+# tpurpc-argus (ISSUE 14): trip hooks — automatic evidence capture
+# (obs/bundle.py) registers here so every sweeper trip and every external
+# trip (a firing SLO page routes through external_trip) can snapshot a
+# postmortem bundle. Hooks run on the tripping thread (the sweeper or the
+# SLO evaluator — never an RPC hot path) and must never raise outward.
+
+_trip_hooks: List = []
+
+
+def add_trip_hook(fn) -> None:
+    """Register ``fn(diag_dict)`` to run once per NEW trip (sweeper or
+    external). Duplicate registrations are ignored."""
+    if fn not in _trip_hooks:
+        _trip_hooks.append(fn)
+
+
+def remove_trip_hook(fn) -> None:
+    try:
+        _trip_hooks.remove(fn)
+    except ValueError:
+        pass
+
+
+def _run_trip_hooks(diag: dict) -> None:
+    for fn in list(_trip_hooks):
+        try:
+            fn(diag)
+        except Exception:
+            _log.exception("watchdog trip hook failed")
 
 #: anomaly counters (always-on registry): total trips + per-stage breakdown
 _TRIPS = _metrics.counter("watchdog_trips")
@@ -171,6 +201,19 @@ class StallWatchdog:
                 worst = p99
         return worst
 
+    def method_p99s(self) -> Dict[str, int]:
+        """Per-method rolling p99s (ns) for methods with enough history —
+        tpurpc-argus's tsdb samples these into ``watchdog_p99_us{method}``
+        series: unlike the cumulative ``srv_call_us`` histogram, a rolling
+        window RECOVERS after a degradation ends, which is what a burn-
+        rate alert must see to resolve."""
+        out: Dict[str, int] = {}
+        for method, roll in list(self._rolls.items()):
+            p99 = roll.p99_ns()
+            if p99 is not None:
+                out[method] = p99
+        return out
+
     # -- the sweeper ----------------------------------------------------------
 
     def _ensure_thread(self) -> None:
@@ -255,6 +298,7 @@ class StallWatchdog:
             diag["detail"],
             _flight.RECORDER.dump_text(
                 since_ns=diag["since_ns"] - 1_000_000_000))
+        _run_trip_hooks(diag)
 
     def external_trip(self, stage: str, method: str, detail: str) -> None:
         """A trip raised by another verification subsystem rather than the
@@ -285,6 +329,7 @@ class StallWatchdog:
             method, stage, detail,
             _flight.RECORDER.dump_text(
                 since_ns=time.monotonic_ns() - 2_000_000_000))
+        _run_trip_hooks(diag)
 
     # -- stage attribution ----------------------------------------------------
 

@@ -1475,6 +1475,20 @@ class Server:
             _obs_profiler.ensure_started()
         except Exception:
             pass
+        # tpurpc-argus (ISSUE 14): the ring tsdb samples this process's
+        # registry from the moment it serves (idempotent; TPURPC_TSDB=0
+        # off), any declared SLO objectives start evaluating, and
+        # TPURPC_BUNDLE_DIR arms automatic evidence capture
+        try:
+            from tpurpc.obs import bundle as _obs_bundle
+            from tpurpc.obs import slo as _obs_slo
+            from tpurpc.obs import tsdb as _obs_tsdb
+
+            _obs_tsdb.ensure_started()
+            _obs_slo.ensure_started()
+            _obs_bundle.maybe_enable_from_env()
+        except Exception:
+            pass
         self._serving.set()  # listeners begin accepting (bound since add_port)
         return self
 

@@ -140,6 +140,17 @@ def _postfork_worker_init(shard_id: int, n_shards: int) -> None:
     _flight.postfork_restart()
     _watchdog.postfork_reset()
     _profiler.postfork_reset()  # tpurpc-lens: supervisor samples are not ours
+    # tpurpc-argus: the inherited tsdb rings hold the SUPERVISOR's history
+    # and the slo evaluator thread died in the fork — fresh instances
+    # (Server.start in the worker's build restarts both)
+    try:
+        from tpurpc.obs import slo as _slo
+        from tpurpc.obs import tsdb as _tsdb
+
+        _tsdb.postfork_reset()
+        _slo.postfork_reset()
+    except Exception:
+        pass
     _obs_shard.set_identity(shard_id, n_shards)
 
     from tpurpc.rpc import channelz as _channelz

@@ -1,0 +1,172 @@
+"""~8s tpurpc-argus smoke for the verification gate (tools/check.sh).
+
+The ISSUE 14 acceptance loop in miniature — detect → localize → capture,
+with the burn-rate windows scaled to fractions of a second:
+
+* one SERVER (slow-able handler) + one CLIENT + one COLLECTOR PROCESS
+  (``python -m tpurpc.tools.collector`` polling the server's serving
+  port at 4 Hz);
+* a latency SLO declared on the probe method; the handler degrades on
+  command → the alert must pass PENDING and reach FIRING within two fast
+  windows (plus evaluator cadence slack);
+* ``/fleet/slo`` on the collector must show the firing alert under the
+  right ``member`` label, and ``/fleet/metrics`` must carry
+  member-labeled series with ``tpurpc_member_up 1``;
+* ``/healthz`` goes 503 with the structured ``slo-firing`` reason;
+* exactly ONE evidence bundle lands on disk (rate-limited against the
+  continuing degradation) and its flight dump passes
+  ``python -m tpurpc.analysis protocol --flight <bundle>`` UNMODIFIED.
+
+Exit 0 on success; any assertion/exception exits 1 with the reason.
+
+    python -m tpurpc.tools.argus_smoke
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+FAST_S = 0.8
+SLOW_S = 1.6
+
+
+def _get_json(url: str, timeout: float = 5.0) -> dict:
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return json.loads(resp.read().decode("utf-8", "replace"))
+    except urllib.error.HTTPError as exc:
+        # a degraded /healthz answers 503 WITH the structured body
+        return json.loads(exc.read().decode("utf-8", "replace"))
+
+
+def run() -> int:
+    os.environ["TPURPC_TSDB_FINE_S"] = "0.05"
+    from tpurpc.analysis import protocol
+    from tpurpc.obs import bundle as obs_bundle
+    from tpurpc.obs import flight
+    from tpurpc.obs import slo as obs_slo
+    from tpurpc.obs import tsdb as obs_tsdb
+    from tpurpc.rpc.channel import Channel
+    from tpurpc.rpc.server import Server, unary_unary_rpc_method_handler
+
+    flight.RECORDER.reset()
+    obs_tsdb.postfork_reset()
+    obs_slo.reset()
+
+    bundle_dir = tempfile.mkdtemp(prefix="tpurpc-argus-smoke-")
+    slow = threading.Event()
+
+    def handler(req, ctx):
+        if slow.is_set():
+            time.sleep(0.05)
+        return b"ok"
+
+    srv = Server(max_workers=4)
+    srv.add_method("/argus/Probe", unary_unary_rpc_method_handler(handler))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    member = f"127.0.0.1:{port}"
+
+    obs_bundle.enable(bundle_dir, min_interval_s=30.0)
+    ev = obs_slo.get()
+    ev.eval_s = 0.1
+    obj = obs_slo.declare(
+        "probe-p99", method="/argus/Probe", latency_ms=10.0,
+        latency_target_pct=50.0, windows=[(FAST_S, SLOW_S, 1.2)])
+    st = obj.tracks["latency"]
+
+    # the collector PROCESS, polling the member at 4 Hz
+    col = subprocess.Popen(
+        [sys.executable, "-m", "tpurpc.tools.collector", member,
+         "--poll", "0.25", "--port", "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    try:
+        line = col.stdout.readline()
+        assert "serving http://" in line, f"collector failed: {line!r}"
+        col_base = line.split("serving ")[1].split("/fleet")[0].strip()
+
+        with Channel(member) as ch:
+            call = ch.unary_unary("/argus/Probe")
+            for _ in range(16):     # healthy rolling-p99 history
+                call(b"x", timeout=5)
+            t_degrade = time.monotonic()
+            slow.set()              # induce the p99 degradation
+            states = set()
+            deadline = t_degrade + 2 * FAST_S + 8.0  # 2 fast windows + slack
+            while time.monotonic() < deadline:
+                call(b"x", timeout=5)
+                states.add(st.state)
+                if st.state == "firing":
+                    break
+            t_fired = time.monotonic() - t_degrade
+            assert st.state == "firing", \
+                f"alert never fired (states seen: {states})"
+            assert "pending" in states, "firing without an observed pending"
+
+            # healthz degraded with the structured reason
+            doc = _get_json(f"http://{member}/healthz?json=1")
+            reasons = [r["reason"] for r in doc["degraded_reasons"]]
+            assert "slo-firing" in reasons, doc
+
+            # the collector's fleet views show it, member-labeled
+            fleet = None
+            for _ in range(20):  # within a few 0.25s polls
+                fleet = _get_json(f"{col_base}/fleet/slo")
+                if any(a.get("member") == member
+                       and a.get("state", "firing") == "firing"
+                       for a in fleet.get("alerts", ())):
+                    break
+                time.sleep(0.25)
+            else:
+                raise AssertionError(f"/fleet/slo never showed the alert: "
+                                     f"{fleet}")
+            raw = urllib.request.urlopen(f"{col_base}/fleet/metrics",
+                                         timeout=5).read().decode()
+            assert f'tpurpc_member_up{{member="{member}"}} 1' in raw
+            assert f'member="{member}"' in raw
+
+        # exactly one rate-limited bundle, protocol-clean flight dump
+        time.sleep(0.5)
+        bundles = obs_bundle.list_bundles(bundle_dir)
+        assert len(bundles) == 1, f"want exactly 1 bundle, got {bundles}"
+        bpath = os.path.join(bundle_dir, bundles[0])
+        total, violations = protocol.check_dump(bpath)
+        assert not violations, violations
+        assert total > 0
+        with open(os.path.join(bpath, f"flight-{os.getpid()}.json")) as f:
+            events = json.load(f)
+        assert any(e["event"] == "slo-firing" for e in events)
+    finally:
+        col.terminate()
+        col.wait(timeout=5)
+        ev.stop()
+        srv.stop(grace=0)
+        obs_slo.reset()
+        obs_bundle.disable()
+        obs_tsdb.get().stop()
+        obs_tsdb.postfork_reset()
+
+    print(f"argus smoke OK: pending->firing in {t_fired:.2f}s "
+          f"(fast window {FAST_S}s), fleet view member-labeled, healthz "
+          f"slo-firing, 1 bundle, protocol-clean ({total} events)")
+    return 0
+
+
+def main() -> int:
+    try:
+        return run()
+    except Exception as exc:
+        print(f"argus smoke FAILED: {exc!r}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

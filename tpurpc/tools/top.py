@@ -75,6 +75,17 @@ def fetch_waterfall(target: str, timeout: float = 5.0) -> Optional[dict]:
         return None
 
 
+def fetch_slo(target: str, timeout: float = 5.0) -> Optional[dict]:
+    """tpurpc-argus /debug/slo (objectives + burn-rate alert states), or
+    None when unreachable / pre-argus server."""
+    try:
+        with urllib.request.urlopen(f"http://{target}/debug/slo",
+                                    timeout=timeout) as resp:
+            return json.loads(resp.read().decode("utf-8", "replace"))
+    except Exception:
+        return None
+
+
 def _val(m: Dict, name: str, labels: str = "") -> float:
     return m.get((name, labels), 0.0)
 
@@ -94,7 +105,8 @@ def _fmt_us(us: float) -> str:
 
 def render(cur: Dict, prev: Optional[Dict], dt: float,
            target: str, stalls: Optional[dict] = None,
-           waterfall: Optional[dict] = None) -> str:
+           waterfall: Optional[dict] = None,
+           slo: Optional[dict] = None) -> str:
     P = "tpurpc_"
     Q50 = 'quantile="0.5"'
     Q99 = 'quantile="0.99"'
@@ -190,6 +202,26 @@ def render(cur: Dict, prev: Optional[Dict], dt: float,
             if slow:
                 lines.append(f"      slowest hop: {slow} "
                              "(* = the hop to attack)")
+    # tpurpc-argus SLO alerts pane (/debug/slo): objective/track states
+    # with burn rates — the page an operator would get, rendered live
+    if slo is not None:
+        objs = slo.get("objectives", ())
+        if objs:
+            n_fire = len(slo.get("firing", ()))
+            lines.append(f"slo   objectives {len(objs)}   firing {n_fire}")
+            for obj in objs:
+                for track, st in sorted((obj.get("tracks") or {}).items()):
+                    state = st.get("state", "ok")
+                    if state == "ok" and not st.get("fired"):
+                        continue
+                    mark = "!!" if state == "firing" else \
+                        " !" if state == "pending" else "  "
+                    lines.append(
+                        f"  {mark} {obj.get('name', '?'):<20} "
+                        f"{track:<8} {state:<8} "
+                        f"burn {st.get('burn_fast', 0):>6.1f}x fast "
+                        f"{st.get('burn_slow', 0):>6.1f}x slow  "
+                        f"fired {st.get('fired', 0)}")
     return "\n".join(lines)
 
 
@@ -215,9 +247,10 @@ def main(argv=None) -> int:
             return 1
         stalls = fetch_stalls(args.target)
         wf = fetch_waterfall(args.target)
+        slo = fetch_slo(args.target)
         now = time.monotonic()
         out = render(cur, prev, now - t_prev, args.target, stalls=stalls,
-                     waterfall=wf)
+                     waterfall=wf, slo=slo)
         if args.once:
             print(out)
             return 0
