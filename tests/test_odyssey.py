@@ -1,0 +1,667 @@
+"""tpurpc-odyssey (ISSUE 15): sequence journeys, token latency, cost ledgers.
+
+Journey tracing stitched across the disagg split (one trace_id through
+prefill -> KV ship -> decode -> migration, including two REAL processes),
+ITL/TPOT correctness against the deterministic reference model's timing,
+ledger conservation across preempt/swap/migrate (byte-seconds monotone,
+no double-count), the new ITL/TTFT SLO track kinds' pending->firing->
+resolved lifecycle, the shard/collector merges, the /debug/seq routes,
+and the TPURPC_ODYSSEY=0 off-switch."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpurpc.analysis import protocol
+from tpurpc.jaxshim.generate import ToyDecodeModel, reference_decode
+from tpurpc.obs import flight, metrics, odyssey
+from tpurpc.obs import slo as obs_slo
+from tpurpc.obs import tracing
+from tpurpc.obs.tsdb import Tsdb
+from tpurpc.serving.scheduler import DecodeScheduler, TokenStream
+
+S = int(1e9)
+
+
+@pytest.fixture(autouse=True)
+def _clean_odyssey_state():
+    flight.RECORDER.reset()
+    odyssey.reset()
+    tracing.reset()
+    old_idle = TokenStream.MAX_IDLE_S
+    TokenStream.MAX_IDLE_S = 10.0
+    yield
+    TokenStream.MAX_IDLE_S = old_idle
+    tracing.force(None)
+    tracing.reset()
+    odyssey.reset()
+    obs_slo.reset()
+    flight.RECORDER.reset()
+
+
+def _drain(stream):
+    return list(stream)
+
+
+def _wait_done(n=1, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        doc = odyssey.seq_doc()
+        if len(doc["recent"]) >= n and not doc["live"]:
+            return doc
+        time.sleep(0.01)
+    return odyssey.seq_doc()
+
+
+# ---------------------------------------------------------------------------
+# Token-latency plane
+# ---------------------------------------------------------------------------
+
+def test_itl_matches_reference_step_timing():
+    """ITL at the stream edge ~= the step cadence of the deterministic
+    model — the 'honest methodology' check against reference_decode's
+    known per-token timing (one token per step_delay_s step)."""
+    step_s = 0.02
+    sched = DecodeScheduler(ToyDecodeModel(step_delay_s=step_s),
+                            max_batch=4, name="itl")
+    try:
+        st = sched.submit([1, 2, 3], max_tokens=10, account="t-itl")
+        toks = _drain(st)
+        assert toks == reference_decode(np.asarray([1, 2, 3], np.int32),
+                                        10)
+        doc = _wait_done()
+    finally:
+        sched.close()
+    p99 = doc["itl_p99_rolling_us"]["interactive"]
+    assert p99 is not None
+    # each inter-token gap is one 20ms step (+scheduler overhead); far
+    # under 2x step and far over half of it on any weather
+    assert step_s * 1e6 * 0.5 < p99 < step_s * 1e6 * 3, p99
+    hist = doc["itl"]["interactive"]
+    assert hist["count"] >= 8  # 10 tokens -> 9 gaps (flushed at retire)
+    led = doc["recent"][0]
+    assert led["tokens"] == 10
+    assert "tpot_us" in led and led["tpot_us"] > step_s * 1e6 * 0.5
+    assert doc["tpot"]["interactive"]["count"] >= 1
+
+
+def test_step_time_attribution_conserves():
+    """Every device-step microsecond lands on exactly one set of
+    sequences: the sum of per-ledger step_us equals the plane's measured
+    step total (the >=95% acceptance instrument, exact in-process)."""
+    sched = DecodeScheduler(ToyDecodeModel(step_delay_s=0.002),
+                            max_batch=4, name="attr")
+    try:
+        streams = [sched.submit([i + 1], max_tokens=8, account="t-a")
+                   for i in range(3)]
+        for st in streams:
+            _drain(st)
+        doc = _wait_done(3)
+    finally:
+        sched.close()
+    assert doc["attributed_pct"] is not None
+    assert doc["attributed_pct"] >= 95.0
+    total = sum(r["step_us"] for r in doc["recent"])
+    assert abs(total - doc["step_us_attributed"]) < 1.0
+    assert doc["step_us_total"] > 0
+
+
+def test_account_rollup_and_anon_default():
+    sched = DecodeScheduler(ToyDecodeModel(), max_batch=4, name="acct")
+    try:
+        _drain(sched.submit([1], max_tokens=4, account="tenant-a"))
+        _drain(sched.submit([2], max_tokens=4, account="tenant-a"))
+        _drain(sched.submit([3], max_tokens=4))  # no account -> anon
+        doc = _wait_done(3)
+    finally:
+        sched.close()
+    accts = doc["accounts"]
+    assert accts["tenant-a"]["seqs"] == 2
+    assert accts["tenant-a"]["tokens"] == 8
+    assert accts["tenant-a"]["step_us"] > 0
+    assert accts["anon"]["seqs"] == 1
+
+
+def test_account_key_grammar():
+    assert odyssey.sanitize_account(None) == "anon"
+    assert odyssey.sanitize_account("") == "anon"
+    assert odyssey.sanitize_account("team-a.prod:v2") == "team-a.prod:v2"
+    assert odyssey.sanitize_account(b"bytes-ok") == "bytes-ok"
+    assert odyssey.sanitize_account("has space/slash") == "has_space_slash"
+    assert len(odyssey.sanitize_account("x" * 200)) == 64
+
+
+# ---------------------------------------------------------------------------
+# Ledger conservation across preempt / swap / migrate
+# ---------------------------------------------------------------------------
+
+def _paged_sched(name, **kw):
+    from tpurpc.serving.kv import KvBlockManager
+
+    mgr = KvBlockManager(n_blocks=64, block_bytes=256, name=name)
+    kw.setdefault("max_batch", 1)
+    sched = DecodeScheduler(ToyDecodeModel(step_delay_s=0.005), kv=mgr,
+                            name=name, **kw)
+    return sched, mgr
+
+
+def test_kv_byte_seconds_monotone_across_preempt_swap():
+    """A preempted-and-swapped sequence's ledger: arena byte-seconds stop
+    growing while swapped (swap_byte_s grows instead), both are monotone
+    non-decreasing, and neither interval is double-counted (their sum is
+    bounded by max-residency x wall time)."""
+    sched, mgr = _paged_sched("swap")
+    try:
+        t_start = time.monotonic()
+        batch_st = sched.submit([1] * 40, max_tokens=60,
+                                slo="batch", account="t-batch")
+        for _ in range(5):  # running
+            batch_st.next(timeout=2.0)
+        reads = []
+        # interactive work preempts the batch seq (max_batch=1 -> swap)
+        inter_st = sched.submit([2, 3], max_tokens=20, account="t-int")
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            doc = odyssey.seq_doc(
+                {"account": "t-batch", "n": "4"})
+            rows = doc["live"] or doc["recent"]
+            if rows:
+                reads.append((rows[0]["kv_byte_s"],
+                              rows[0]["swap_byte_s"],
+                              rows[0].get("state")))
+                if rows[0].get("state") == "done":
+                    break
+            time.sleep(0.01)
+        _drain(inter_st)
+        _drain(batch_st)
+        doc = _wait_done(2)
+        dur_s = time.monotonic() - t_start
+    finally:
+        sched.close()
+        mgr.close()
+    led = [r for r in doc["recent"] if r["account"] == "t-batch"][0]
+    assert led["preempts"] >= 1, led
+    assert led["swaps"] >= 2, led          # out + back in
+    assert led["swap_byte_s"] > 0, led     # swapped residency is charged
+    assert led["kv_byte_s"] > 0, led
+    # monotone under observation: no read ever went backwards
+    for (a0, s0, _), (a1, s1, _) in zip(reads, reads[1:]):
+        assert a1 >= a0 - 1e-6 and s1 >= s0 - 1e-6, reads
+    # no double-count: total residency-seconds bounded by the arena's
+    # worst case held for the whole wall window
+    bound = mgr.n_blocks * mgr.block_bytes * dur_s
+    assert led["kv_byte_s"] + led["swap_byte_s"] < bound
+
+
+def test_shed_and_refused_settle_ledgers():
+    sched = DecodeScheduler(ToyDecodeModel(step_delay_s=0.05),
+                            max_batch=1, max_waiting=1, name="shed")
+    try:
+        st = sched.submit([1] * 4, max_tokens=30, account="t-ok")
+        st.next(timeout=2.0)  # running now, not waiting
+        # fill the one-slot waiting queue, then overflow it
+        q = sched.submit([2], max_tokens=4, account="t-q")
+        from tpurpc.serving.scheduler import ShedError
+
+        with pytest.raises(ShedError):
+            sched.submit([3], max_tokens=4, account="t-shed")
+        q.cancel()
+        st.cancel()
+        accts = odyssey.accounts_snapshot()
+        assert accts["t-shed"]["sheds"] == 1
+        assert accts["t-shed"]["seqs"] == 1
+    finally:
+        sched.close()
+
+
+# ---------------------------------------------------------------------------
+# Journey tracing
+# ---------------------------------------------------------------------------
+
+def test_journey_spans_single_trace_in_process():
+    tracing.force(True)
+    ctx = tracing.TraceContext(0xABCD1234, 1)
+    sched = DecodeScheduler(ToyDecodeModel(step_delay_s=0.002),
+                            max_batch=4, name="jrny")
+    try:
+        _drain(sched.submit([5, 6], max_tokens=6, trace=ctx,
+                            account="t-j"))
+        _wait_done()
+    finally:
+        sched.close()
+    spans = tracing.spans(ctx.trace_id)
+    names = [s["name"] for s in spans]
+    for needed in ("seq-admit", "seq-prefill", "seq-decode"):
+        assert needed in names, names
+    assert all(s["trace_id"] == f"{ctx.trace_id:016x}" for s in spans)
+    dec = [s for s in spans if s["name"] == "seq-decode"][0]
+    assert dec["attrs"]["account"] == "t-j"
+    assert dec["attrs"]["tokens"] == 6
+
+
+def test_tail_commit_rules_interesting_journeys():
+    """With head sampling OFF (tail-only), a preempted sequence's
+    provisional journey COMMITS while a fast clean one ages out — the
+    PR 5 rule at sequence granularity."""
+    tracing.force(None)
+    tracing.configure(0.0)  # no head sampling; tail capture stays on
+    assert tracing.LIVE and not tracing.ACTIVE
+    sched, mgr = _paged_sched("tail")
+    try:
+        ctx_b = tracing.maybe_sample()
+        assert ctx_b is not None and ctx_b.provisional
+        batch_st = sched.submit([1] * 8, max_tokens=40, slo="batch",
+                                trace=ctx_b, account="t-b")
+        for _ in range(3):
+            batch_st.next(timeout=2.0)
+        ctx_i = tracing.TraceContext(0x77, 1, provisional=True)
+        tracing._tail_register(ctx_i.trace_id)
+        inter_st = sched.submit([2], max_tokens=3, trace=ctx_i,
+                                account="t-i")
+        _drain(inter_st)
+        _drain(batch_st)
+        _wait_done(2)
+    finally:
+        sched.close()
+        mgr.close()
+    # the preempted batch journey committed: its spans are in the ring
+    committed = {s["name"] for s in tracing.spans(ctx_b.trace_id)}
+    assert "seq-decode" in committed, committed
+    # the fast clean interactive one did not (still pending, uncommitted)
+    assert tracing.spans(ctx_i.trace_id) == []
+    assert tracing.tail_pending(ctx_i.trace_id) > 0
+
+
+def test_flight_journey_order_and_strict_conformance():
+    t0 = time.monotonic_ns()
+    sched = DecodeScheduler(ToyDecodeModel(step_delay_s=0.002),
+                            max_batch=2, name="fl")
+    try:
+        _drain(sched.submit([1, 2], max_tokens=5, account="t-f"))
+        _wait_done()
+    finally:
+        sched.close()
+    events = flight.snapshot(since_ns=t0)
+    assert protocol.check_events(events, strict=True) == []
+    protocol.assert_ordered(events, [
+        ("seq-submit", {"a2": 2}), "gen-join", "seq-first-token",
+        "gen-retire",
+    ], since_ns=t0)
+
+
+def test_seq_journey_mutants_killed():
+    muts = protocol.machine_mutants()
+    assert "seq_token_after_retire" in muts
+    assert "seq_join_without_submit" in muts
+    kills = protocol.mutant_kill_suite()
+    assert kills["seq_token_after_retire"]
+    assert kills["seq_join_without_submit"]
+
+
+# ---------------------------------------------------------------------------
+# Disagg: the journey crosses the split; migration settles the ledger
+# ---------------------------------------------------------------------------
+
+def _disagg_stack(n_decode=2, step_delay_s=0.01):
+    from tpurpc.rpc.channel import Channel
+    from tpurpc.serving import DisaggClient, serve_decode, serve_prefill
+
+    decodes = [serve_decode(ToyDecodeModel(step_delay_s=step_delay_s),
+                            kv_blocks=96, block_bytes=256, name=f"d{i}")
+               for i in range(n_decode)]
+    d_ch = Channel(f"127.0.0.1:{decodes[0][1]}")
+    p_srv, p_port, p_state = serve_prefill(
+        ToyDecodeModel(), d_ch, f"127.0.0.1:{decodes[0][1]}")
+    p_ch = Channel(f"127.0.0.1:{p_port}")
+    cli = DisaggClient(p_ch, f"127.0.0.1:{decodes[0][1]}",
+                       account="t-mig")
+
+    def close():
+        cli.close()
+        p_srv.stop(grace=0)
+        p_state.close()
+        for srv, _p, sched, state in decodes:
+            srv.stop(grace=0)
+            sched.close()
+            state.close()
+            state.mgr.close()
+        p_ch.close()
+        d_ch.close()
+
+    return decodes, p_ch, cli, close
+
+
+def test_journey_and_ledger_across_migration():
+    """In-process disagg pair: one trace_id carries seq-ship (handoff),
+    seq-resume/seq-decode (decode A), seq-migrate (the hop), and the
+    adopted sequence's decode spans on B; the source ledger settles
+    'migrated' with shipped bytes, and the account rollup sums both
+    halves under the account that rode the metadata."""
+    from tpurpc.rpc.channel import Channel
+    from tpurpc.serving import migrate
+
+    tracing.force(True)
+    decodes, p_ch, cli, close = _disagg_stack()
+    b_ch = Channel(f"127.0.0.1:{decodes[1][1]}")
+    try:
+        prompt = np.arange(64, dtype=np.int32) % 31
+        want = reference_decode(prompt, 32)
+        ctx = tracing.TraceContext(0xFEED0001, 1)
+        with tracing.use(ctx):
+            it = cli.generate_with_meta(prompt, max_tokens=32, timeout=20)
+            pairs = [next(it) for _ in range(5)]
+            moved, failed = migrate(decodes[0][3], b_ch,
+                                    f"127.0.0.1:{decodes[1][1]}")
+            assert moved == 1 and failed == 0
+            pairs.extend(it)
+        assert [t for _i, t in pairs] == want
+        assert [i for i, _t in pairs] == list(range(32))
+
+        names = {s["name"] for s in tracing.spans(ctx.trace_id)}
+        for needed in ("seq-ship", "seq-resume", "seq-decode",
+                       "seq-migrate"):
+            assert needed in names, names
+        doc = _wait_done(2, timeout=8.0)
+        by_outcome = {r["outcome"]: r for r in doc["recent"]
+                      if r["account"] == "t-mig"}
+        assert "migrated" in by_outcome, doc["recent"]
+        src = by_outcome["migrated"]
+        assert src["migrations"] == 1 and src["shipped_bytes"] > 0
+        assert src["trace_id"] == f"{ctx.trace_id:016x}"
+        assert "retire" in by_outcome  # the adopted half finished on B
+        dst = by_outcome["retire"]
+        assert dst["trace_id"] == src["trace_id"]
+        assert dst["shipped_bytes"] > 0  # the handoff bytes it arrived by
+        acct = doc["accounts"]["t-mig"]
+        assert acct["migrations"] >= 1
+        assert acct["tokens"] >= 31
+    finally:
+        b_ch.close()
+        close()
+
+
+def test_journey_across_two_real_processes():
+    """The disagg split with the prefill tier in a REAL child process:
+    the child's /traces (fetched over its serving port) carries spans of
+    the SAME trace_id the parent's decode journey used."""
+    import urllib.request
+
+    from tpurpc.rpc.channel import Channel
+    from tpurpc.serving import DisaggClient, serve_decode
+
+    tracing.force(True)
+    d_srv, d_port, d_sched, d_state = serve_decode(
+        ToyDecodeModel(), kv_blocks=96, block_bytes=256, kv_kind="shm",
+        name="twoproc")
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = here + os.pathsep + env.get("PYTHONPATH", "")
+    env["TPURPC_TRACE_SAMPLE"] = "1"
+    child = subprocess.Popen(
+        [sys.executable, "-m", "tpurpc.tools.odyssey_smoke", "--prefill",
+         f"127.0.0.1:{d_port}"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env, text=True)
+    try:
+        line = child.stdout.readline().strip()
+        assert line.startswith("PORT "), line
+        p_port = int(line.split()[1])
+        p_ch = Channel(f"127.0.0.1:{p_port}")
+        cli = DisaggClient(p_ch, f"127.0.0.1:{d_port}", account="t-2p")
+        prompt = np.arange(48, dtype=np.int32) % 23
+        ctx = tracing.TraceContext(0xFEED0002, 1)
+        with tracing.use(ctx):
+            pairs = list(cli.generate_with_meta(prompt, max_tokens=8,
+                                                timeout=20))
+        assert [t for _i, t in pairs] == reference_decode(prompt, 8)
+        # decode-side journey spans, locally
+        local = {s["name"] for s in tracing.spans(ctx.trace_id)}
+        assert "seq-ship" in local and "seq-decode" in local, local
+        # prefill-side spans of the SAME trace, via the child's exporter
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{p_port}/traces?trace_id="
+                f"{ctx.trace_id:016x}", timeout=5) as resp:
+            peer = json.loads(resp.read())
+        peer_spans = [e for e in peer["traceEvents"]
+                      if e.get("ph") == "X"]
+        assert peer_spans, "prefill process exported no spans"
+        assert peer.get("clock_anchor"), "peer missing clock anchor"
+        # merged journey: two anchored lanes
+        doc = odyssey.journey([f"127.0.0.1:{d_port}",
+                               f"127.0.0.1:{p_port}"], ctx.trace_id)
+        assert doc["otherData"]["lanes"] >= 2
+        assert not doc["otherData"]["unanchored"]
+        cli.close()
+        p_ch.close()
+    finally:
+        try:
+            child.stdin.close()
+            child.wait(timeout=10)
+        except Exception:
+            child.kill()
+        d_srv.stop(grace=0)
+        d_sched.close()
+        d_state.close()
+        d_state.mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# SLO track kinds: ITL / TTFT burn-rate objectives
+# ---------------------------------------------------------------------------
+
+def _private_db(**kw) -> Tsdb:
+    reg = metrics.Registry()
+    kw.setdefault("fine_s", 1.0)
+    kw.setdefault("fine_window_s", 32.0)
+    kw.setdefault("coarse_s", 8.0)
+    kw.setdefault("coarse_window_s", 64.0)
+    return Tsdb(registry=reg, **kw)
+
+
+def test_slo_itl_objective_pending_firing_resolved():
+    db = _private_db()
+    g = db._registry.gauge("gen_itl_p99_us{interactive}")
+    ev = obs_slo.SloEvaluator(eval_s=1.0, tsdb=db)
+    obj = ev.declare(obs_slo.SloObjective(
+        "tok-itl", itl_ms=5.0, token_target_pct=50.0,
+        windows=[(4.0, 8.0, 2.0)]))
+    st = obj.tracks["itl"]
+    assert obj._threshold_tracks["itl"][0] == \
+        "gen_itl_p99_us{interactive}"
+    for i in range(10):  # healthy: 1ms ITL
+        g.set(1000.0)
+        db.sample_once(now_ns=(i + 1) * S)
+        ev.evaluate_once(now_ns=(i + 1) * S)
+    assert st.state == "ok"
+    t = 10
+    while st.state == "ok" and t < 30:  # degrade: 40ms ITL
+        t += 1
+        g.set(40_000.0)
+        db.sample_once(now_ns=t * S)
+        ev.evaluate_once(now_ns=t * S)
+    assert st.state == "pending"
+    while st.state == "pending" and t < 45:
+        t += 1
+        g.set(40_000.0)
+        db.sample_once(now_ns=t * S)
+        ev.evaluate_once(now_ns=t * S)
+    assert st.state == "firing"
+    fired_at = t
+    while st.state == "firing" and t < fired_at + 30:  # recover
+        t += 1
+        g.set(1000.0)
+        db.sample_once(now_ns=t * S)
+        ev.evaluate_once(now_ns=t * S)
+    assert st.state == "ok"
+    # flight bracket conforms to the slo-alert machine, track code 4
+    evs = [e for e in flight.snapshot() if e["entity"] == "slo:tok-itl"]
+    assert [e["event"] for e in evs] == ["slo-firing", "slo-resolved"]
+    assert evs[0]["a1"] == obs_slo.TRACK_CODES["itl"] == 4
+    assert protocol.check_events(flight.snapshot(), strict=False) == []
+
+
+def test_slo_ttft_track_and_doc_shape():
+    db = _private_db()
+    db._registry.gauge("gen_ttft_p99_us{batch}").set(100.0)
+    ev = obs_slo.SloEvaluator(eval_s=1.0, tsdb=db)
+    obj = ev.declare(obs_slo.SloObjective(
+        "tok-ttft", ttft_ms=200.0, slo_class="batch",
+        windows=[(4.0, 8.0, 2.0)]))
+    assert set(obj.tracks) == {"ttft"}
+    assert obj._threshold_tracks["ttft"] == \
+        ("gen_ttft_p99_us{batch}", 200_000.0)
+    assert obs_slo.TRACK_CODES["ttft"] == 3
+    doc = ev.doc()["objectives"][0]
+    assert doc["ttft_ms"] == 200.0 and doc["slo_class"] == "batch"
+
+
+def test_tsdb_samples_odyssey_rolling_series():
+    """The process-wide tsdb picks up the odyssey rolling p99s (the
+    sys.modules-gated hook) once tokens have flowed."""
+    from tpurpc.obs import tsdb as tsdb_mod
+
+    sched = DecodeScheduler(ToyDecodeModel(step_delay_s=0.002),
+                            max_batch=2, name="roll")
+    try:
+        _drain(sched.submit([1], max_tokens=6, account="t-r"))
+        _wait_done()
+    finally:
+        sched.close()
+    assert odyssey.rolling_series().get(
+        "gen_itl_p99_us{interactive}") is not None
+    db = tsdb_mod.get()
+    db.sample_once()
+    assert "gen_itl_p99_us{interactive}" in db.series()
+    assert "gen_ttft_p99_us{interactive}" in db.series()
+
+
+# ---------------------------------------------------------------------------
+# Routes, merges, off-switch
+# ---------------------------------------------------------------------------
+
+def test_debug_seq_route_filters_and_bounds():
+    from tpurpc.obs import scrape
+
+    sched = DecodeScheduler(ToyDecodeModel(), max_batch=4, name="route")
+    try:
+        _drain(sched.submit([1], max_tokens=4, account="t-x"))
+        _drain(sched.submit([2], max_tokens=4, account="t-y"))
+        _wait_done(2)
+    finally:
+        sched.close()
+    status, ctype, body = scrape.route_local("/debug/seq")
+    assert status == 200 and ctype == "application/json"
+    doc = json.loads(body)
+    assert doc["enabled"]
+    assert {"t-x", "t-y"} <= set(doc["accounts"])
+    status, _c, body = scrape.route_local("/debug/seq?account=t-x&n=1")
+    filt = json.loads(body)
+    assert all(r["account"] == "t-x" for r in filt["recent"])
+    assert len(filt["recent"]) <= 1
+
+
+def test_off_switch_env_and_force():
+    odyssey.force(False)
+    assert not odyssey.ACTIVE
+    sched = DecodeScheduler(ToyDecodeModel(), max_batch=2, name="off")
+    try:
+        t0 = time.monotonic_ns()
+        toks = _drain(sched.submit([1, 2], max_tokens=4,
+                                   account="t-off"))
+        assert len(toks) == 4  # serving is unaffected
+    finally:
+        sched.close()
+    doc = odyssey.seq_doc()
+    assert doc == {"enabled": False, "reason": "TPURPC_ODYSSEY=0"}
+    # the flight SEQ_* edges stay (always-on postmortem contract)
+    names = [e["event"] for e in flight.snapshot(since_ns=t0)]
+    assert "seq-submit" in names and "seq-first-token" in names
+    odyssey.force(None)
+    # env gate honored by configure()
+    os.environ["TPURPC_ODYSSEY"] = "0"
+    try:
+        odyssey.configure()
+        assert not odyssey.ACTIVE
+    finally:
+        del os.environ["TPURPC_ODYSSEY"]
+        odyssey.configure()
+    assert odyssey.ACTIVE
+
+
+def test_merge_seq_docs_sums_accounts_and_tags_rows():
+    d1 = {"enabled": True,
+          "live": [{"sid": 1, "account": "a", "step_us": 50.0}],
+          "recent": [{"sid": 2, "account": "a", "step_us": 10.0}],
+          "accounts": {"a": {"seqs": 2, "tokens": 10, "step_us": 60.0,
+                             "kv_byte_s": 1.0}},
+          "step_us_total": 100.0, "step_us_attributed": 98.0}
+    d2 = {"enabled": True, "live": [],
+          "recent": [{"sid": 9, "account": "a", "step_us": 70.0}],
+          "accounts": {"a": {"seqs": 1, "tokens": 5, "step_us": 70.0},
+                       "b": {"seqs": 1, "tokens": 2, "step_us": 5.0}},
+          "step_us_total": 80.0, "step_us_attributed": 80.0}
+    out = odyssey.merge_seq_docs({"0": d1, "1": d2}, label="shard")
+    assert out["enabled"]
+    assert out["accounts"]["a"]["seqs"] == 3
+    assert out["accounts"]["a"]["tokens"] == 15
+    assert out["accounts"]["b"]["seqs"] == 1
+    assert out["step_us_total"] == 180.0
+    assert out["attributed_pct"] == round(178 / 180 * 100, 2)
+    assert out["live"][0]["shard"] == "0"
+    assert {r["shard"] for r in out["recent"]} == {"0", "1"}
+    # a disabled/unreachable source merges to disabled-only-if-all-are
+    assert odyssey.merge_seq_docs({"0": {"enabled": False}})["enabled"] \
+        is False
+
+
+def test_collector_fleet_seq_member_merge():
+    from tpurpc.obs.collector import FleetCollector
+    from tpurpc.rpc.channel import Channel
+    from tpurpc.serving import GenerationClient, serve_generation
+
+    srv, port, sched = serve_generation(ToyDecodeModel(), max_batch=4)
+    try:
+        with Channel(f"127.0.0.1:{port}") as ch:
+            gen = GenerationClient(ch, account="t-fleet")
+            assert len(list(gen.generate([3, 4], max_tokens=5,
+                                         timeout=20))) == 5
+        col = FleetCollector([f"127.0.0.1:{port}"], poll_s=60)
+        col.poll_once()
+        status, ctype, body = col.route("/fleet/seq")
+        assert status == 200
+        doc = json.loads(body)
+        assert doc["enabled"]
+        assert doc["accounts"]["t-fleet"]["tokens"] >= 5
+        member = f"127.0.0.1:{port}"
+        assert doc["members"][member] == "up"
+        assert all(r["member"] == member for r in doc["recent"])
+    finally:
+        srv.stop(grace=0)
+        sched.close()
+
+
+def test_generation_rpc_attaches_account_and_trace():
+    """End-to-end over the RPC face: the tpurpc-account metadata key and
+    the call's (tail-provisional) trace context reach the ledger."""
+    from tpurpc.rpc.channel import Channel
+    from tpurpc.serving import GenerationClient, serve_generation
+
+    srv, port, sched = serve_generation(ToyDecodeModel(), max_batch=4)
+    try:
+        with Channel(f"127.0.0.1:{port}") as ch:
+            gen = GenerationClient(ch)
+            toks = list(gen.generate([1, 2], max_tokens=4,
+                                     account="t-rpc", timeout=20))
+            assert len(toks) == 4
+        doc = _wait_done()
+    finally:
+        srv.stop(grace=0)
+        sched.close()
+    led = [r for r in doc["recent"] if r["account"] == "t-rpc"]
+    assert led, doc["recent"]
+    assert "trace_id" in led[0]  # tail capture gave it a journey context

@@ -128,6 +128,17 @@ note "tpurpc-keystone disagg smoke (2 processes, zero-copy KV handoff)"
 TPURPC_FLIGHT_DUMP="$FLIGHT_DUMPS" python -m tpurpc.tools.disagg_smoke \
     || fail=1
 
+# 2g3a) tpurpc-odyssey smoke (ISSUE 15): a disagg pair over shm (prefill
+#      child process + two decode servers) serving ONE account's stream,
+#      live-migrated mid-decode — tokens exact across all three hops,
+#      ONE trace_id's journey doc with >=2 clock-anchored process lanes
+#      (seq-ship/seq-decode/seq-migrate spans present), /debug/seq
+#      attributing >=95% of device-step time with the account rollup,
+#      and the SEQ_* flight journey protocol-conformant. ~5s, no jax.
+note "tpurpc-odyssey smoke (journey + /debug/seq across a migration)"
+TPURPC_FLIGHT_DUMP="$FLIGHT_DUMPS" python -m tpurpc.tools.odyssey_smoke \
+    || fail=1
+
 # 2g3b) tpurpc-argus smoke (ISSUE 14): one server + one client + a
 #      collector PROCESS polling it at 4 Hz, burn-rate windows scaled to
 #      fractions of a second — an induced p99 degradation must take the

@@ -522,6 +522,68 @@ def _mk_ctrl_stall() -> Machine:
                  "BEGIN, no nesting")
 
 
+def _mk_seq_journey() -> Machine:
+    F = _flight
+
+    def token(ev):
+        c = ev.get("code")
+        if c == F.SEQ_SUBMIT:
+            return "submit"
+        if c == F.GEN_JOIN:
+            return "join"
+        if c == F.SEQ_FIRST_TOKEN:
+            return "first-token"
+        if c == F.GEN_PREEMPT:
+            return "preempt"
+        if c == F.GEN_LEAVE:
+            return "leave"
+        if c == F.GEN_RETIRE:
+            return "retire"
+        if c == F.SEQ_DETACH:
+            return "detach"
+        return None
+
+    def key(ev):
+        sid = ev.get("a1")
+        if not sid:
+            return None
+        return (ev.get("tag"), sid)
+
+    # tpurpc-odyssey (ISSUE 15): one sequence's lifecycle per (scheduler
+    # tag, seq id). submit opens; join admits (a failed prefill retires
+    # straight from submitted); the single first-token edge happens once
+    # and only in the running window (first-token after retire — the
+    # "token after retire" bug — has no transition out of done/detached);
+    # preempt parks and a later join resumes; detach hands the sequence
+    # to a migration (the journey continues on the peer under a fresh
+    # sid, same trace). Shed sequences never open: the shed decision
+    # precedes SEQ_SUBMIT by construction.
+    return Machine(
+        "seq-journey", token, key,
+        openers={"submit": "submitted"},
+        transitions={
+            ("submitted", "join"): "running",
+            ("submitted", "retire"): "done",    # prefill failed, row alone
+            ("submitted", "leave"): "done",     # dropped at admission
+            ("submitted", "detach"): "done",    # adopted-waiting, migrated
+            ("running", "first-token"): "streaming",
+            ("running", "retire"): "done",
+            ("running", "leave"): "done",
+            ("running", "preempt"): "parked",
+            ("running", "detach"): "done",
+            ("streaming", "preempt"): "parked",
+            ("streaming", "retire"): "done",
+            ("streaming", "leave"): "done",
+            ("streaming", "detach"): "done",
+            ("parked", "join"): "streaming",
+            ("parked", "leave"): "done",
+            ("parked", "detach"): "done",
+        },
+        describe="sequence lifecycle: submit before join, one first-token "
+                 "inside the running window, no token/membership event "
+                 "after retire/leave/detach")
+
+
 def _mk_slo() -> Machine:
     F = _flight
 
@@ -551,6 +613,7 @@ MACHINES: List[Machine] = [
     _mk_rdv_lease(), _mk_rdv_offer(), _mk_kv_swap(), _mk_migration(),
     _mk_kv_ship(), _mk_gen_step(), _mk_hedge(), _mk_drain(), _mk_subch(),
     _mk_conn(), _mk_ctrl_ring(), _mk_ctrl_stall(), _mk_slo(),
+    _mk_seq_journey(),
 ]
 
 
@@ -684,6 +747,19 @@ def _good_trace() -> List[dict]:
     e += [_ev(F.RDV_OFFER, tag=3, a1=21, a2=1 << 18, t_ns=next(t)),
           _ev(F.RDV_CLAIM, tag=3, a1=21, a2=601, t_ns=next(t)),
           _ev(F.RDV_COMPLETE, tag=3, a1=601, a2=1 << 18, t_ns=next(t))]
+    # one full sequence journey (tpurpc-odyssey): submit -> join ->
+    # first token -> preempt -> resume-join -> retire; and an adopted
+    # sequence detached mid-life (migrated out)
+    e += [_ev(F.SEQ_SUBMIT, tag=4, a1=9, a2=32, t_ns=next(t)),
+          _ev(F.GEN_JOIN, tag=4, a1=9, a2=32, t_ns=next(t)),
+          _ev(F.SEQ_FIRST_TOKEN, tag=4, a1=9, a2=1800, t_ns=next(t)),
+          _ev(F.GEN_PREEMPT, tag=4, a1=9, a2=1, t_ns=next(t)),
+          _ev(F.GEN_JOIN, tag=4, a1=9, a2=0, t_ns=next(t)),
+          _ev(F.GEN_RETIRE, tag=4, a1=9, a2=24, t_ns=next(t)),
+          _ev(F.SEQ_SUBMIT, tag=4, a1=10, a2=16, t_ns=next(t)),
+          _ev(F.GEN_JOIN, tag=4, a1=10, a2=16, t_ns=next(t)),
+          _ev(F.SEQ_FIRST_TOKEN, tag=4, a1=10, a2=900, t_ns=next(t)),
+          _ev(F.SEQ_DETACH, tag=4, a1=10, a2=17, t_ns=next(t))]
     # decode steps bracketing a swap-out/in pair and one migration
     e += [_ev(F.GEN_STEP_BEGIN, tag=4, a1=2, t_ns=next(t)),
           _ev(F.GEN_STEP_END, tag=4, a1=2, a2=2, t_ns=next(t)),
@@ -763,6 +839,19 @@ def machine_mutants() -> Dict[str, List[dict]]:
         ],
         "first_ok_without_connect": [
             _ev(F.CALL_FIRST_OK, tag=1, t_ns=1),
+        ],
+        # tpurpc-odyssey: the seq-journey machine's teeth — a token after
+        # the sequence retired, and membership without a submit
+        "seq_token_after_retire": [
+            _ev(F.SEQ_SUBMIT, tag=4, a1=9, a2=8, t_ns=1),
+            _ev(F.GEN_JOIN, tag=4, a1=9, a2=8, t_ns=2),
+            _ev(F.SEQ_FIRST_TOKEN, tag=4, a1=9, a2=500, t_ns=3),
+            _ev(F.GEN_RETIRE, tag=4, a1=9, a2=4, t_ns=4),
+            _ev(F.SEQ_FIRST_TOKEN, tag=4, a1=9, a2=900, t_ns=5),
+        ],
+        "seq_join_without_submit": [
+            _ev(F.GEN_JOIN, tag=4, a1=9, a2=8, t_ns=1),
+            _ev(F.GEN_RETIRE, tag=4, a1=9, a2=4, t_ns=2),
         ],
         # tpurpc-pulse: the descriptor-ring machines' teeth
         "ctrl_spin_before_adopt": [

@@ -78,7 +78,7 @@ def resolve_targets(specs: List[str]) -> List[str]:
 
 class _Member:
     __slots__ = ("target", "metrics_text", "slo", "flight", "anchor",
-                 "last_ok_mono", "polls", "misses", "resets_seen")
+                 "seq", "last_ok_mono", "polls", "misses", "resets_seen")
 
     def __init__(self, target: str):
         self.target = target
@@ -86,6 +86,7 @@ class _Member:
         self.slo: Optional[dict] = None
         self.flight: Optional[dict] = None
         self.anchor: Optional[dict] = None
+        self.seq: Optional[dict] = None
         self.last_ok_mono = 0.0
         self.polls = 0
         self.misses = 0
@@ -133,12 +134,14 @@ class FleetCollector:
             slo_raw = self._fetch(target, "/debug/slo")
             flight_raw = self._fetch(target, "/debug/flight")
             traces_raw = self._fetch(target, "/traces")
+            seq_raw = self._fetch(target, "/debug/seq")
             with self._lock:
                 m.misses = 0
                 m.last_ok_mono = time.monotonic()
                 m.metrics_text = raw.decode("utf-8", "replace")
                 m.slo = _loads(slo_raw)
                 m.flight = _loads(flight_raw)
+                m.seq = _loads(seq_raw)
                 traces = _loads(traces_raw) or {}
                 m.anchor = (traces.get("clock_anchor")
                             or _first_anchor(traces))
@@ -284,6 +287,24 @@ class FleetCollector:
                 "firing": sum(1 for a in alerts
                               if a.get("state", "firing") == "firing")}
 
+    # -- /fleet/seq (tpurpc-odyssey, ISSUE 15) --------------------------------
+
+    def merged_seq(self) -> dict:
+        """The fleet-wide sequence/account view: every UP member's
+        /debug/seq merged through the same pure merge the shard fan-out
+        uses — rows tagged ``member``, account rollups summed across the
+        fleet (a stale member's sequences VANISH, never freeze)."""
+        from tpurpc.obs.odyssey import merge_seq_docs
+
+        with self._lock:
+            snap = [(m.target, self.member_state(m), m.seq)
+                    for m in self._members.values()]
+        docs = {t: doc for t, state, doc in snap
+                if state == "up" and doc}
+        out = merge_seq_docs(docs, label="member")
+        out["members"] = {t: state for t, state, _d in snap}
+        return out
+
     # -- /fleet/timeline ------------------------------------------------------
 
     def timeline(self) -> dict:
@@ -309,6 +330,9 @@ class FleetCollector:
         if route in ("/fleet/slo", "/fleet/slo/"):
             return (200, "application/json",
                     json.dumps(self.merged_slo(), indent=1).encode())
+        if route in ("/fleet/seq", "/fleet/seq/"):
+            return (200, "application/json",
+                    json.dumps(self.merged_seq(), indent=1).encode())
         if route in ("/fleet/timeline", "/fleet/timeline/"):
             try:
                 return (200, "application/json",
@@ -321,7 +345,7 @@ class FleetCollector:
                    "poll_s": self.poll_s}
             return 200, "application/json", json.dumps(doc).encode()
         return (404, "text/plain",
-                b"tpurpc-collector: /fleet/metrics /fleet/slo "
+                b"tpurpc-collector: /fleet/metrics /fleet/slo /fleet/seq "
                 b"/fleet/timeline /healthz\n")
 
     def serve(self, host: str = "127.0.0.1", port: int = 0) -> int:

@@ -152,6 +152,17 @@ CTRL_STALL_END = 60       # space returned (consumer drained)
 SLO_FIRING = 61           # a1 = track (0=errors,1=sheds,2=latency), a2 = burn x100
 SLO_RESOLVED = 62         # a1 = track, a2 = burn x100 at resolve
 BUNDLE_WRITTEN = 63       # a1 = trigger code, a2 = bundle ordinal
+# tpurpc-odyssey (ISSUE 15): sequence identity as a first-class flight
+# key — the `seq-journey` protocol machine (analysis/protocol.py) runs
+# over these plus the PR 10/11 GEN_JOIN/LEAVE/RETIRE/PREEMPT and MIG_*
+# events, keyed (scheduler tag, seq id). SUBMIT opens the journey (before
+# any JOIN can fire — emitted under the admission lock), FIRST_TOKEN is
+# the one per-sequence token edge (TTFT; events are edges, not traffic —
+# per-token emission stays banned), DETACH is the migration sender's
+# hand-out (the journey continues on the peer under the same trace).
+SEQ_SUBMIT = 64           # a1 = seq id, a2 = prompt tokens
+SEQ_FIRST_TOKEN = 65      # a1 = seq id, a2 = TTFT (us)
+SEQ_DETACH = 66           # a1 = seq id, a2 = KV entries handed out
 
 EVENT_NAMES: Dict[int, str] = {
     PAIR_CONNECT: "pair-connect",
@@ -217,6 +228,9 @@ EVENT_NAMES: Dict[int, str] = {
     SLO_FIRING: "slo-firing",
     SLO_RESOLVED: "slo-resolved",
     BUNDLE_WRITTEN: "bundle-written",
+    SEQ_SUBMIT: "seq-submit",
+    SEQ_FIRST_TOKEN: "seq-first-token",
+    SEQ_DETACH: "seq-detach",
 }
 
 #: batch-flush reason codes (a1 of BATCH_FLUSH) — mirrors the jaxshim

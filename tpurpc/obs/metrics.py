@@ -129,6 +129,25 @@ class Histogram:
             if v > self._max:
                 self._max = v
 
+    def record_many(self, values) -> None:
+        """Record a BATCH under one lock — the amortization this registry
+        was designed around (PR 4's "histograms amortize"), for callers
+        that accumulate per-item samples and flush per batch/lifetime
+        (tpurpc-odyssey's per-sequence ITL flush)."""
+        with self._lock:
+            for v in values:
+                if v <= 0:
+                    continue
+                v = int(v)
+                if self._buckets is None:
+                    self._counts[min(v, self._EXACT_MAX)] += 1
+                else:
+                    self._buckets[min(63, v.bit_length())] += 1
+                self._total += v
+                self._n += 1
+                if v > self._max:
+                    self._max = v
+
     # -- percentiles ---------------------------------------------------------
 
     def _percentile_locked(self, q: float) -> float:

@@ -1,0 +1,755 @@
+"""tpurpc-odyssey: sequence-lifecycle tracing, token latency, cost ledgers.
+
+Every observability face before this one (spans PR 4, flight PR 5, lens
+PR 8, argus PR 14) is RPC- or process-scoped. Since PR 10/11 the unit of
+work is a *sequence* whose life spans many RPCs and up to three processes
+(prefill -> KV ship -> decode -> preempt/swap -> migrate) — and the
+cross-layer attribution gap that opens is exactly the blind spot the RPC-
+under-ML studies name (arXiv:1805.08430: where a request's time actually
+goes; arXiv:1804.01138: tails only exist under honest methodology). This
+module is the sequence-scoped answer, three planes over one per-sequence
+record:
+
+* **Journey tracing.** The originating generation RPC's
+  :class:`~tpurpc.obs.tracing.TraceContext` rides into the scheduler's
+  sequence object and across the disagg control plane (OfferKv /
+  CompleteKv / ResumeSeq / ``migrate()`` request metadata), so ONE
+  trace_id stitches admission -> prefill -> KV ship -> every decode-step
+  membership window -> preempt/swap -> migration -> final token. Spans
+  land in the ordinary span ring of whichever process did the work;
+  :func:`journey` merges the processes' ``/traces?trace_id=`` exports
+  onto one wall-clock axis via the PR 8 clock anchors. The PR 5
+  tail-commit rules apply at sequence granularity: a slow, shed,
+  preempted, or migrated sequence ALWAYS commits its provisional trace —
+  the pathological journey is never the one the sampler skipped.
+* **Token-latency plane.** Per-SLO-class inter-token latency
+  (``gen_itl_us`` + ``gen_itl_<class>_us``) and time-per-output-token
+  (``gen_tpot_us`` + ``gen_tpot_<class>_us``) histograms recorded at the
+  stream edge (the sequence's token queue — the last point the scheduler
+  can see), plus bounded ROLLING windows whose p99s the tsdb samples as
+  ``gen_itl_p99_us{class}`` / ``gen_ttft_p99_us{class}`` — rolling so an
+  ITL/TTFT SLO objective (:mod:`tpurpc.obs.slo`'s new track kinds) can
+  RESOLVE when the degradation ends, which the cumulative histograms
+  never allow (the PR 14 watchdog_p99 move, applied to tokens).
+* **Cost accounting.** A per-sequence :class:`SeqLedger`: device-step
+  microseconds consumed (each step's duration divided by batch occupancy
+  — row i of an N-row step owns 1/N of it), prefill microseconds, KV
+  block-byte-seconds held (arena residency) and swap-byte-seconds (host
+  residency while preempted — swapped work is not free work), rendezvous
+  bytes shipped, preemption/swap/migration counts. Ledgers aggregate by
+  the metadata key :data:`ACCOUNT_KEY` (``tpurpc-account`` — the tenant
+  stand-in ROADMAP item 4 builds on; default ``anon``), and export at
+  ``GET /debug/seq`` (live + recent-completed ring), shard-merged by
+  :mod:`tpurpc.obs.shard` and fleet-merged at the collector's
+  ``/fleet/seq``.
+
+Cost model: everything here is per-sequence-EDGE or per-DEVICE-STEP
+(amortized over the whole batch), except the one per-token ITL record —
+a monotonic read, a subtraction, one histogram record, one deque append.
+The bench gate ``odyssey_overhead_pct < 3%`` holds the line; the
+off-switch ``TPURPC_ODYSSEY=0`` (or :func:`force`) drops even that (the
+flight SEQ_* events stay — the always-on postmortem contract).
+
+Account-key grammar: ``[A-Za-z0-9._:-]{1,64}``; anything else is
+character-sanitized to ``_`` and truncated; an empty/absent key is
+``anon`` (:func:`sanitize_account`).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from tpurpc.obs import metrics as _metrics
+from tpurpc.obs import tracing as _tracing
+
+__all__ = [
+    "ACTIVE", "ACCOUNT_KEY", "DEFAULT_ACCOUNT", "SeqLedger",
+    "configure", "force", "enabled", "sanitize_account",
+    "seq_submit", "seq_join", "seq_prefill", "seq_first_token",
+    "seq_token", "seq_step", "seq_swap", "seq_preempt", "seq_detached",
+    "seq_migrated", "seq_done",
+    "itl_p99_us", "ttft_p99_us", "rolling_series",
+    "seq_doc", "accounts_snapshot", "merge_seq_docs", "journey",
+    "reset", "postfork_reset",
+]
+
+#: metadata key carrying the accounting identity (tenant stand-in)
+ACCOUNT_KEY = "tpurpc-account"
+DEFAULT_ACCOUNT = "anon"
+
+#: the ONE gate the scheduler's hot sites load (the tracing.ACTIVE shape)
+ACTIVE = True
+_forced: Optional[bool] = None
+
+#: completed-sequence ring + per-class rolling token-latency windows
+_DONE_CAP = 256
+_ROLL_CAP = 512
+
+#: terminal outcomes a ledger can settle with
+_OUTCOMES = ("retire", "left", "shed", "refused", "failed", "migrated")
+
+# -- token-latency histograms (per SLO class; the hot path records ONE) ------
+_ITL = {
+    "interactive": _metrics.histogram("gen_itl_interactive_us",
+                                      kind="latency"),
+    "batch": _metrics.histogram("gen_itl_batch_us", kind="latency"),
+}
+_TPOT = {
+    "interactive": _metrics.histogram("gen_tpot_interactive_us",
+                                      kind="latency"),
+    "batch": _metrics.histogram("gen_tpot_batch_us", kind="latency"),
+}
+_SEQS_DONE = _metrics.counter("seq_completed")
+_SEQS_MIGRATED = _metrics.counter("seq_migrated")
+
+
+def _env_on() -> bool:
+    import os
+
+    return os.environ.get("TPURPC_ODYSSEY", "1").lower() not in (
+        "0", "off", "false")
+
+
+def configure() -> None:
+    """Recompute the gate from ``TPURPC_ODYSSEY`` (honoring :func:`force`)."""
+    global ACTIVE
+    ACTIVE = _forced if _forced is not None else _env_on()
+
+
+def force(on: Optional[bool]) -> None:
+    """Tests/bench: pin the plane on/off; ``None`` returns to the env."""
+    global _forced
+    _forced = on
+    configure()
+
+
+def enabled() -> bool:
+    return ACTIVE
+
+
+def sanitize_account(raw) -> str:
+    """The account-key grammar (module docstring): ``[A-Za-z0-9._:-]``,
+    at most 64 chars; invalid characters become ``_``; empty -> anon."""
+    if raw is None:
+        return DEFAULT_ACCOUNT
+    if isinstance(raw, (bytes, bytearray, memoryview)):
+        raw = bytes(raw).decode("utf-8", "replace")
+    s = str(raw)[:64]
+    if not s:
+        return DEFAULT_ACCOUNT
+    return "".join(c if (c.isalnum() or c in "._:-") else "_" for c in s)
+
+
+# -- the ledger ---------------------------------------------------------------
+
+class SeqLedger:
+    """One sequence's lifetime record. Mutated by the scheduler loop
+    thread (join/step/swap/retire), the submitting thread (creation), and
+    a migration thread after detach — phases never overlap, so field
+    updates are plain GIL-atomic stores; only the registry (live/done/
+    accounts maps) takes the module lock."""
+
+    __slots__ = (
+        "sid", "name", "account", "slo", "trace", "prompt_len", "state",
+        "tokens", "steps", "step_us", "prefill_us", "kv_byte_s",
+        "swap_byte_s", "shipped_bytes", "preempts", "swaps", "migrations",
+        "adopted", "t_submit_ns", "t_first_ns", "t_done_ns", "outcome",
+        "block_bytes", "_arena_bytes", "_host_bytes", "_mark_ns",
+        "_last_tok_ns", "_win_t0_ns", "_itl_hist", "_itl_roll",
+        "_itl_pend",
+    )
+
+    def __init__(self, name: str, sid: int, account: str, slo: str,
+                 trace, prompt_len: int, block_bytes: int,
+                 shipped_bytes: int, adopted: bool):
+        self.sid = sid
+        self.name = name
+        self.account = account
+        self.slo = slo
+        self.trace = trace
+        self.prompt_len = prompt_len
+        self.state = "waiting"
+        self.tokens = 0
+        self.steps = 0
+        self.step_us = 0.0
+        self.prefill_us = 0.0
+        self.kv_byte_s = 0.0
+        self.swap_byte_s = 0.0
+        self.shipped_bytes = shipped_bytes
+        self.preempts = 0
+        self.swaps = 0
+        self.migrations = 0
+        self.adopted = adopted
+        self.t_submit_ns = time.monotonic_ns()
+        self.t_first_ns = 0
+        self.t_done_ns = 0
+        self.outcome = ""
+        self.block_bytes = block_bytes
+        self._arena_bytes = 0
+        self._host_bytes = 0
+        self._mark_ns = self.t_submit_ns
+        self._last_tok_ns = 0
+        self._win_t0_ns = 0
+        # per-token hot-path references resolved ONCE per sequence; ITL
+        # samples accumulate in _itl_pend and flush to the histogram in
+        # BATCHES (one lock per flush — the registry's amortization rule)
+        self._itl_hist = _ITL[slo]
+        self._itl_roll = _itl_roll[slo]
+        self._itl_pend: List[int] = []
+
+    # -- residency integration ------------------------------------------------
+
+    def _charge(self, now_ns: int) -> None:
+        """Integrate byte-seconds held since the last mark. Monotone by
+        construction: the mark only moves forward, and each elapsed
+        interval is charged exactly once (never at two call sites — every
+        transition charges BEFORE flipping the residency fields)."""
+        dt = now_ns - self._mark_ns
+        if dt <= 0:
+            return
+        if self._arena_bytes:
+            self.kv_byte_s += self._arena_bytes * dt / 1e9
+        if self._host_bytes:
+            self.swap_byte_s += self._host_bytes * dt / 1e9
+        self._mark_ns = now_ns
+
+    def _projected(self, now_ns: int):
+        """(kv_byte_s, swap_byte_s) as of ``now_ns`` WITHOUT mutating —
+        the live /debug/seq view."""
+        dt = max(0, now_ns - self._mark_ns)
+        return (self.kv_byte_s + self._arena_bytes * dt / 1e9,
+                self.swap_byte_s + self._host_bytes * dt / 1e9)
+
+    def to_dict(self, now_ns: Optional[int] = None) -> dict:
+        now = now_ns if now_ns is not None else time.monotonic_ns()
+        kv_bs, swap_bs = self._projected(now)
+        end = self.t_done_ns or now
+        d = {
+            "sid": self.sid, "sched": self.name, "account": self.account,
+            "slo": self.slo, "state": self.state,
+            "prompt_len": self.prompt_len, "tokens": self.tokens,
+            "steps": self.steps,
+            "step_us": round(self.step_us, 1),
+            "prefill_us": round(self.prefill_us, 1),
+            "kv_byte_s": round(kv_bs, 3),
+            "swap_byte_s": round(swap_bs, 3),
+            "shipped_bytes": self.shipped_bytes,
+            "preempts": self.preempts, "swaps": self.swaps,
+            "migrations": self.migrations,
+            "dur_s": round((end - self.t_submit_ns) / 1e9, 3),
+        }
+        if self.t_first_ns:
+            d["ttft_us"] = (self.t_first_ns - self.t_submit_ns) // 1000
+        if self.tokens >= 2 and self._last_tok_ns > self.t_first_ns:
+            d["tpot_us"] = round((self._last_tok_ns - self.t_first_ns)
+                                 / 1e3 / (self.tokens - 1), 1)
+        if self.outcome:
+            d["outcome"] = self.outcome
+        if self.trace is not None:
+            d["trace_id"] = f"{self.trace.trace_id:016x}"
+        return d
+
+
+# -- registry -----------------------------------------------------------------
+
+_lock = threading.Lock()
+_live: Dict[tuple, SeqLedger] = {}
+_done: "deque[SeqLedger]" = deque(maxlen=_DONE_CAP)
+#: account -> accumulated totals of COMPLETED sequences (live sequences
+#: are folded in at render time)
+_accounts: Dict[str, Dict[str, float]] = {}
+#: device-step time seen / attributed (the >=95% acceptance instrument)
+_step_us_total = [0.0]
+_step_us_attrib = [0.0]
+
+_itl_roll: Dict[str, "deque[int]"] = {
+    "interactive": deque(maxlen=_ROLL_CAP),
+    "batch": deque(maxlen=_ROLL_CAP),
+}
+_ttft_roll: Dict[str, "deque[int]"] = {
+    "interactive": deque(maxlen=_ROLL_CAP),
+    "batch": deque(maxlen=_ROLL_CAP),
+}
+
+_ACCOUNT_FIELDS = ("seqs", "tokens", "step_us", "prefill_us", "kv_byte_s",
+                   "swap_byte_s", "shipped_bytes", "preempts", "swaps",
+                   "migrations", "sheds", "failed")
+
+
+def _account_bucket(account: str) -> Dict[str, float]:
+    b = _accounts.get(account)
+    if b is None:
+        b = _accounts[account] = {k: 0 for k in _ACCOUNT_FIELDS}
+    return b
+
+
+# -- scheduler-facing hooks ---------------------------------------------------
+#
+# Every hook tolerates ``led is None`` (the off-switch: the scheduler
+# skips ledger creation when ACTIVE is false, and every later site passes
+# the None through).
+
+def seq_submit(name: str, sid: int, account: str, slo: str, trace,
+               prompt_len: int, block_bytes: int = 0,
+               shipped_bytes: int = 0,
+               adopted: bool = False) -> SeqLedger:
+    led = SeqLedger(name, sid, account, slo, trace, prompt_len,
+                    block_bytes, shipped_bytes, adopted)
+    with _lock:
+        _live[(name, sid)] = led
+    return led
+
+
+def seq_join(led: Optional[SeqLedger], resumed: bool = False) -> None:
+    """The boundary admitted the sequence into the running batch: close
+    the admit/park wait with a journey span, open a decode window."""
+    if led is None:
+        return
+    now = time.monotonic_ns()
+    t0 = led._mark_ns \
+        if led.state in ("waiting", "swapped", "preempted") else now
+    name = "seq-resume" \
+        if (resumed or led.state in ("swapped", "preempted")) \
+        else "seq-admit"
+    _record(led, name, t0, now - t0)
+    led._charge(now)
+    led.state = "running"
+    led._win_t0_ns = now
+
+
+def seq_prefill(led: Optional[SeqLedger], dur_ns: int, share: int,
+                kv_bytes: int = 0) -> None:
+    """One batched prefill landed this sequence's entries: charge its
+    1/share of the batch's device time, start arena residency."""
+    if led is None:
+        return
+    now = time.monotonic_ns()
+    led._charge(now)
+    led.prefill_us += dur_ns / 1e3 / max(1, share)
+    if kv_bytes:
+        led._arena_bytes = kv_bytes
+    _record(led, "seq-prefill", now - dur_ns, dur_ns)
+
+
+def seq_first_token(led: Optional[SeqLedger], ttft_us: int,
+                    now_ns: int = 0) -> None:
+    if led is None:
+        return
+    now = now_ns or time.monotonic_ns()
+    led.tokens += 1
+    led.t_first_ns = now
+    led._last_tok_ns = now
+    _ttft_roll[led.slo].append(ttft_us)
+
+
+def seq_token(led: Optional[SeqLedger], now_ns: int = 0) -> None:
+    """The per-token record (the one hot-path site): inter-token latency
+    at the stream edge — one histogram record (the per-class hist,
+    resolved at ledger creation) and one deque append. The scheduler
+    passes its step-end stamp as ``now_ns`` so a whole batch's token
+    emissions share ONE clock read (the skew inside the delivery loop is
+    microseconds against millisecond steps)."""
+    if led is None:
+        return
+    now = now_ns or time.monotonic_ns()
+    last = led._last_tok_ns
+    led._last_tok_ns = now
+    led.tokens += 1
+    if last and now > last:
+        # ONE list append on the per-token path; the histogram (one lock
+        # per flush) and the rolling SLO window both fill in 64-token
+        # batches — at worst a few steps of staleness against SLO
+        # windows measured in seconds
+        pend = led._itl_pend
+        pend.append((now - last) // 1000)
+        if len(pend) >= 64:
+            _itl_flush(led)
+
+
+def _itl_flush(led: "SeqLedger") -> None:
+    pend = led._itl_pend
+    led._itl_hist.record_many(pend)
+    led._itl_roll.extend(pend)
+    del pend[:]
+
+
+def seq_step(running, dt_ns: int, now_ns: Optional[int] = None) -> None:
+    """One device step over ``running`` completed in ``dt_ns``: charge
+    each member its occupancy share and integrate its arena residency.
+    Duck-typed over the scheduler's sequence objects (``.led``, ``.kv``)
+    so this module never imports the scheduler."""
+    nb = len(running)
+    if nb == 0:
+        return
+    now = now_ns if now_ns is not None else time.monotonic_ns()
+    dt_us = dt_ns / 1e3
+    share = dt_us / nb
+    _step_us_total[0] += dt_us
+    attrib = 0.0
+    for s in running:
+        led = s.led
+        if led is None:
+            continue
+        led.steps += 1
+        led.step_us += share
+        attrib += share
+        # residency integrates only for rows that HOLD bytes (paged
+        # mode); an opaque row pays two attribute loads and moves on
+        kv = s.kv
+        if kv is not None and led.block_bytes:
+            led._arena_bytes = len(kv.blocks) * led.block_bytes
+            led._charge(now)
+        elif led._host_bytes:
+            led._charge(now)
+    _step_us_attrib[0] += attrib
+
+
+def seq_swap(led: Optional[SeqLedger], direction: int, nbytes: int,
+             dur_ns: int) -> None:
+    """Residency flip: ``direction`` 0 = out-to-host (``nbytes`` = host
+    image), 1 = in-from-host (``nbytes`` = arena bytes re-held)."""
+    if led is None:
+        return
+    now = time.monotonic_ns()
+    led._charge(now)
+    led.swaps += 1
+    if direction == 0:
+        led._arena_bytes = 0
+        led._host_bytes = nbytes
+        led.state = "swapped"
+        _record(led, "seq-swap-out", now - dur_ns, dur_ns)
+    else:
+        led._host_bytes = 0
+        led._arena_bytes = nbytes
+        _record(led, "seq-swap-in", now - dur_ns, dur_ns)
+
+
+def seq_preempt(led: Optional[SeqLedger]) -> None:
+    if led is None:
+        return
+    now = time.monotonic_ns()
+    led.preempts += 1
+    if led._win_t0_ns:
+        _record(led, "seq-decode", led._win_t0_ns, now - led._win_t0_ns,
+                tokens=led.tokens)
+        led._win_t0_ns = 0
+    led._charge(now)
+    led.state = "preempted"
+
+
+def seq_detached(led: Optional[SeqLedger], entries: int) -> None:
+    """The boundary handed the sequence out (migration sender half): the
+    ledger stays live — :func:`seq_migrated` / :func:`seq_done` settles
+    it once the shipper knows the outcome."""
+    if led is None:
+        return
+    now = time.monotonic_ns()
+    if led._win_t0_ns:
+        _record(led, "seq-decode", led._win_t0_ns, now - led._win_t0_ns,
+                tokens=led.tokens)
+        led._win_t0_ns = 0
+    led._charge(now)
+    led.state = "detached"
+
+
+def seq_migrated(led: Optional[SeqLedger], shipped_bytes: int,
+                 t0_ns: int) -> None:
+    """The migration completed on the peer: final settle on the source.
+    ``t0_ns`` brackets the ship (detach -> peer CompleteKv ok)."""
+    if led is None:
+        return
+    led.shipped_bytes += shipped_bytes
+    led.migrations += 1
+    _SEQS_MIGRATED.inc()
+    now = time.monotonic_ns()
+    _record(led, "seq-migrate", t0_ns, now - t0_ns,
+            shipped_bytes=shipped_bytes)
+    seq_done(led, "migrated")
+
+
+def seq_done(led: Optional[SeqLedger], outcome: str) -> None:
+    """Terminal settle: integrate, close the decode window, record TPOT,
+    fold into the account rollup, move live -> done, and make the journey
+    tail-commit decision (a shed/failed/migrated/preempted or slow
+    sequence always yields a full journey)."""
+    if led is None or led.outcome:
+        return
+    now = time.monotonic_ns()
+    if led._win_t0_ns:
+        _record(led, "seq-decode", led._win_t0_ns, now - led._win_t0_ns,
+                tokens=led.tokens)
+        led._win_t0_ns = 0
+    led._charge(now)
+    led._arena_bytes = 0
+    led._host_bytes = 0
+    led.outcome = outcome if outcome in _OUTCOMES else "failed"
+    led.t_done_ns = now
+    led.state = "done"
+    if led._itl_pend:
+        _itl_flush(led)
+    if led.tokens >= 2 and led._last_tok_ns > led.t_first_ns:
+        tpot = int((led._last_tok_ns - led.t_first_ns)
+                   / 1e3 / (led.tokens - 1))
+        _TPOT[led.slo].record(tpot)
+    _SEQS_DONE.inc()
+    with _lock:
+        _live.pop((led.name, led.sid), None)
+        _done.append(led)
+        b = _account_bucket(led.account)
+        b["seqs"] += 1
+        b["tokens"] += led.tokens
+        b["step_us"] += led.step_us
+        b["prefill_us"] += led.prefill_us
+        b["kv_byte_s"] += led.kv_byte_s
+        b["swap_byte_s"] += led.swap_byte_s
+        b["shipped_bytes"] += led.shipped_bytes
+        b["preempts"] += led.preempts
+        b["swaps"] += led.swaps
+        b["migrations"] += led.migrations
+        if led.outcome == "shed":
+            b["sheds"] += 1
+        elif led.outcome == "failed":
+            b["failed"] += 1
+    _journey_settle(led)
+
+
+# -- journey spans ------------------------------------------------------------
+
+def _record(led: SeqLedger, name: str, t0_ns: int, dur_ns: int,
+            **attrs) -> None:
+    ctx = led.trace
+    if ctx is None:
+        return
+    _tracing.record(name, ctx, t0_ns, dur_ns, sid=led.sid,
+                    account=led.account, **attrs)
+
+
+def _journey_settle(led: SeqLedger) -> None:
+    """The PR 5 tail-commit rules at sequence granularity: a provisional
+    journey commits when the sequence was shed/refused/failed/migrated,
+    was preempted or swapped (the interesting journeys), or was slow by
+    the ordinary tail bar; a healthy fast retire ages out untouched."""
+    ctx = led.trace
+    if ctx is None or not getattr(ctx, "provisional", False):
+        return
+    if (led.outcome in ("shed", "refused", "failed", "migrated")
+            or led.preempts or led.swaps or led.migrations):
+        _tracing.tail_commit(ctx.trace_id)
+        return
+    _tracing.tail_decide(ctx, led.t_done_ns - led.t_submit_ns)
+
+
+# -- rolling token-latency windows (the SLO substrate) ------------------------
+
+def _roll_p(roll, q: float) -> Optional[float]:
+    vals = sorted(roll)
+    if not vals:
+        return None
+    return float(vals[min(len(vals) - 1, max(0, int(len(vals) * q) - 1))])
+
+
+def itl_p99_us(slo: str = "interactive") -> Optional[float]:
+    return _roll_p(list(_itl_roll.get(slo, ())), 0.99)
+
+
+def ttft_p99_us(slo: str = "interactive") -> Optional[float]:
+    return _roll_p(list(_ttft_roll.get(slo, ())), 0.99)
+
+
+def rolling_series() -> Dict[str, float]:
+    """Series for the tsdb sampler: ``gen_itl_p99_us{class}`` /
+    ``gen_ttft_p99_us{class}`` from the bounded rolling windows — the
+    resolvable latency signal the new SLO track kinds threshold."""
+    out: Dict[str, float] = {}
+    for klass, roll in _itl_roll.items():
+        p = _roll_p(list(roll), 0.99)
+        if p is not None:
+            out["gen_itl_p99_us{" + klass + "}"] = p
+    for klass, roll in _ttft_roll.items():
+        p = _roll_p(list(roll), 0.99)
+        if p is not None:
+            out["gen_ttft_p99_us{" + klass + "}"] = p
+    return out
+
+
+# -- export -------------------------------------------------------------------
+
+def accounts_snapshot() -> Dict[str, Dict[str, float]]:
+    """Account rollup with LIVE sequences folded in at read time."""
+    now = time.monotonic_ns()
+    with _lock:
+        out = {a: dict(b) for a, b in _accounts.items()}
+        live = list(_live.values())
+    for led in live:
+        b = out.setdefault(led.account, {k: 0 for k in _ACCOUNT_FIELDS})
+        kv_bs, swap_bs = led._projected(now)
+        b["seqs"] += 1
+        b["tokens"] += led.tokens
+        b["step_us"] += led.step_us
+        b["prefill_us"] += led.prefill_us
+        b["kv_byte_s"] += kv_bs
+        b["swap_byte_s"] += swap_bs
+        b["shipped_bytes"] += led.shipped_bytes
+        b["preempts"] += led.preempts
+        b["swaps"] += led.swaps
+        b["migrations"] += led.migrations
+    for b in out.values():
+        for k in ("step_us", "prefill_us", "kv_byte_s", "swap_byte_s"):
+            b[k] = round(b[k], 3)
+    return out
+
+
+def _hist_doc(h) -> dict:
+    s = h.snapshot()
+    return {"p50_us": s["p50"], "p99_us": s["p99"], "count": s["count"]}
+
+
+def seq_doc(params: Optional[dict] = None) -> dict:
+    """The ``GET /debug/seq`` body: live ledgers, the recent-completed
+    ring, the account rollup, the step-time attribution check, and the
+    token-latency summaries. ``?account=`` filters the sequence lists;
+    ``?n=`` bounds them (default 32 live / 32 recent)."""
+    if not ACTIVE:
+        return {"enabled": False, "reason": "TPURPC_ODYSSEY=0"}
+    params = params or {}
+    want = params.get("account") or None
+    try:
+        n = max(1, int(params.get("n") or 32))
+    except ValueError:
+        n = 32
+    now = time.monotonic_ns()
+    with _lock:
+        live = list(_live.values())
+        done = list(_done)
+    if want:
+        live = [led for led in live if led.account == want]
+        done = [led for led in done if led.account == want]
+    live.sort(key=lambda led: led.step_us, reverse=True)
+    total = _step_us_total[0]
+    attrib = _step_us_attrib[0]
+    return {
+        "enabled": True,
+        "live": [led.to_dict(now) for led in live[:n]],
+        "live_total": len(live),
+        "recent": [led.to_dict(now) for led in done[-n:]][::-1],
+        "accounts": accounts_snapshot(),
+        "step_us_total": round(total, 1),
+        "step_us_attributed": round(attrib, 1),
+        "attributed_pct": round(attrib / total * 100, 2) if total else None,
+        "itl": {k: _hist_doc(h) for k, h in _ITL.items()},
+        "tpot": {k: _hist_doc(h) for k, h in _TPOT.items()},
+        "itl_p99_rolling_us": {k: _roll_p(list(r), 0.99)
+                               for k, r in _itl_roll.items()},
+        "ttft_p99_rolling_us": {k: _roll_p(list(r), 0.99)
+                                for k, r in _ttft_roll.items()},
+    }
+
+
+def merge_seq_docs(docs: Dict[str, dict], label: str = "member") -> dict:
+    """The pure shard/fleet merge: per-source docs keyed by shard id or
+    member target -> one doc with tagged sequence lists and SUMMED
+    account/attribution totals (used by ``obs.shard.aggregate_seq`` and
+    the collector's ``/fleet/seq``)."""
+    live: List[dict] = []
+    recent: List[dict] = []
+    accounts: Dict[str, Dict[str, float]] = {}
+    total = attrib = 0.0
+    enabled = False
+    for src in sorted(docs):
+        doc = docs[src] or {}
+        if not doc.get("enabled"):
+            continue
+        enabled = True
+        for row in doc.get("live", ()):
+            live.append(dict(row, **{label: src}))
+        for row in doc.get("recent", ()):
+            recent.append(dict(row, **{label: src}))
+        for acct, b in (doc.get("accounts") or {}).items():
+            agg = accounts.setdefault(acct, {k: 0 for k in _ACCOUNT_FIELDS})
+            for k in _ACCOUNT_FIELDS:
+                agg[k] = round(agg[k] + (b.get(k) or 0), 3)
+        total += float(doc.get("step_us_total") or 0.0)
+        attrib += float(doc.get("step_us_attributed") or 0.0)
+    live.sort(key=lambda r: r.get("step_us", 0), reverse=True)
+    return {
+        "enabled": enabled,
+        "sources": sorted(docs),
+        "live": live,
+        "recent": recent,
+        "accounts": accounts,
+        "step_us_total": round(total, 1),
+        "step_us_attributed": round(attrib, 1),
+        "attributed_pct": round(attrib / total * 100, 2) if total else None,
+    }
+
+
+def journey(targets: List[str], trace_id: "int | str") -> dict:
+    """One sequence's cross-process journey as a Perfetto chrome-trace:
+    fetch ``/traces?trace_id=`` from every named process (serving ports —
+    the scrape plane answers) and merge on the shared wall-clock axis via
+    the PR 8 clock anchors (:mod:`tpurpc.tools.timeline`'s pure rebase).
+    Each process is one named lane; unanchored members are flagged in
+    ``otherData.unanchored``, never silently misaligned."""
+    import json as _json
+    import urllib.request
+
+    from tpurpc.tools import timeline as _timeline
+
+    if isinstance(trace_id, int):
+        trace_id = f"{trace_id:016x}"
+    collected = []
+    for t in targets:
+        try:
+            with urllib.request.urlopen(
+                    f"http://{t}/traces?trace_id={trace_id}",
+                    timeout=5) as resp:
+                doc = _json.loads(resp.read())
+        except Exception:
+            continue
+        collected.append({"target": t, "traces": doc, "flight": None,
+                          "profile": None, "metrics": ""})
+    out = _timeline.build_timeline(collected)
+    out["trace_id"] = trace_id
+    return out
+
+
+# -- lifecycle ----------------------------------------------------------------
+
+def reset() -> None:
+    """Test isolation: forget every ledger, rollup, and rolling window."""
+    global _forced
+    with _lock:
+        _live.clear()
+        _done.clear()
+        _accounts.clear()
+    _step_us_total[0] = 0.0
+    _step_us_attrib[0] = 0.0
+    for r in _itl_roll.values():
+        r.clear()
+    for r in _ttft_roll.values():
+        r.clear()
+    _forced = None
+    configure()
+
+
+def postfork_reset() -> None:
+    """Fresh registry in a forked shard worker (the inherited ledgers are
+    the supervisor's, not this worker's)."""
+    global _lock
+    _lock = threading.Lock()
+    _live.clear()
+    _done.clear()
+    _accounts.clear()
+    _step_us_total[0] = 0.0
+    _step_us_attrib[0] = 0.0
+    for r in _itl_roll.values():
+        r.clear()
+    for r in _ttft_roll.values():
+        r.clear()
+    configure()
+
+
+configure()

@@ -406,6 +406,15 @@ def route_local(path: str) -> Tuple[int, str, bytes]:
 
         return (200, "application/json",
                 json.dumps(_slo.slo_doc(), indent=1).encode())
+    if route in ("/debug/seq", "/debug/seq/"):
+        # tpurpc-odyssey (ISSUE 15): per-sequence cost ledgers — live +
+        # recent-completed, account rollup, step-time attribution check
+        # (?account= filters, ?n= bounds the lists)
+        from tpurpc.obs import odyssey as _odyssey
+
+        params = _query_params(query)
+        return (200, "application/json",
+                json.dumps(_odyssey.seq_doc(params), indent=1).encode())
     if route in ("/channelz", "/channelz/"):
         from tpurpc.rpc import channelz
 
@@ -425,7 +434,7 @@ def route_local(path: str) -> Tuple[int, str, bytes]:
     return (404, "text/plain",
             b"tpurpc-scope: /metrics /traces /channelz /healthz "
             b"/debug/flight /debug/stalls /debug/profile /debug/waterfall "
-            b"/debug/history /debug/slo\n")
+            b"/debug/history /debug/slo /debug/seq\n")
 
 
 def _response(status: int, ctype: str, body: bytes,

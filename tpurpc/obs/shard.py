@@ -40,7 +40,7 @@ __all__ = [
     "sharded", "route_aggregate", "aggregate_metrics", "aggregate_flight",
     "aggregate_stalls", "aggregate_healthz", "aggregate_traces",
     "aggregate_profile", "aggregate_waterfall", "aggregate_slo",
-    "aggregate_history",
+    "aggregate_history", "aggregate_seq",
 ]
 
 # tpurpc-argus (ISSUE 14): counter-reset hardening. A shard worker that
@@ -399,6 +399,25 @@ def aggregate_slo() -> dict:
     return {"shards": shards, "firing": firing}
 
 
+def aggregate_seq() -> dict:
+    """tpurpc-odyssey (ISSUE 15): every reachable shard's /debug/seq
+    merged — sequence rows tagged ``shard``, account rollups and the
+    step-time attribution totals SUMMED (the pure merge lives in
+    :func:`tpurpc.obs.odyssey.merge_seq_docs`, shared with the fleet
+    collector's /fleet/seq)."""
+    from tpurpc.obs import odyssey as _odyssey
+
+    docs: Dict[str, dict] = {}
+    for k, status, body in _each_shard("/debug/seq?local=1"):
+        if status != 200:
+            continue
+        try:
+            docs[str(k)] = json.loads(body)
+        except ValueError:
+            continue
+    return _odyssey.merge_seq_docs(docs, label="shard")
+
+
 def aggregate_history() -> dict:
     """Per-shard tsdb inventories (each worker samples its OWN registry —
     series merge happens at query time via the shard key, like /traces)."""
@@ -507,6 +526,9 @@ def route_aggregate(route: str, params: dict
         if route in ("/debug/slo", "/debug/slo/"):
             return (200, "application/json",
                     json.dumps(aggregate_slo(), indent=1).encode())
+        if route in ("/debug/seq", "/debug/seq/"):
+            return (200, "application/json",
+                    json.dumps(aggregate_seq(), indent=1).encode())
         if route in ("/debug/history", "/debug/history/") \
                 and not params.get("series"):
             # a series drill-down (?series=) stays per-worker — points

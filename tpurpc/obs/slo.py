@@ -65,8 +65,12 @@ __all__ = [
     "postfork_reset", "TRACK_CODES",
 ]
 
-#: flight-event a1 values naming the burning track (append-only)
-TRACK_CODES = {"errors": 0, "sheds": 1, "latency": 2}
+#: flight-event a1 values naming the burning track (append-only).
+#: tpurpc-odyssey (ISSUE 15) adds the token-latency objectives: ``ttft``
+#: and ``itl`` threshold the odyssey plane's ROLLING per-class p99 series
+#: (``gen_ttft_p99_us{class}`` / ``gen_itl_p99_us{class}``) exactly like
+#: ``latency`` thresholds the watchdog roll — rolling, so they resolve.
+TRACK_CODES = {"errors": 0, "sheds": 1, "latency": 2, "ttft": 3, "itl": 4}
 TRACK_NAMES = {v: k for k, v in TRACK_CODES.items()}
 
 #: anomaly counters: alert transitions, always-on
@@ -108,7 +112,10 @@ class _TrackState:
 class SloObjective:
     """One declared objective. ``method=None`` binds server-wide. Tracks
     exist for whichever targets were given: ``target_pct`` opens the
-    ``errors`` + ``sheds`` pair, ``latency_ms`` opens ``latency``."""
+    ``errors`` + ``sheds`` pair, ``latency_ms`` opens ``latency``, and
+    (tpurpc-odyssey) ``ttft_ms`` / ``itl_ms`` open the token-latency
+    objectives over the ``slo_class``'s rolling p99 series — "p99 ITL
+    over X ms" as a burn-rate page that can resolve."""
 
     def __init__(self, name: str, method: Optional[str] = None,
                  target_pct: Optional[float] = None,
@@ -116,6 +123,10 @@ class SloObjective:
                  latency_target_pct: float = 99.0,
                  shed_target_pct: float = 95.0,
                  series: Optional[str] = None,
+                 ttft_ms: Optional[float] = None,
+                 itl_ms: Optional[float] = None,
+                 token_target_pct: float = 99.0,
+                 slo_class: str = "interactive",
                  windows: Optional[List[Tuple[float, float, float]]] = None):
         self.name = name
         self.method = method
@@ -123,6 +134,10 @@ class SloObjective:
         self.latency_ms = latency_ms
         self.latency_target_pct = latency_target_pct
         self.shed_target_pct = shed_target_pct
+        self.ttft_ms = ttft_ms
+        self.itl_ms = itl_ms
+        self.token_target_pct = token_target_pct
+        self.slo_class = slo_class
         #: the sampled quantile series the latency track thresholds (µs):
         #: by default the watchdog's ROLLING p99 — per-method when the
         #: objective is, the worst-method roll otherwise. Rolling, not the
@@ -136,12 +151,23 @@ class SloObjective:
             self.series = "watchdog_rolling_p99_us"
         self.windows = list(windows) if windows else default_windows()
         self.tag = _flight.tag_for(f"slo:{name}")
+        #: threshold tracks share one evaluation shape: (series, µs bar)
+        self._threshold_tracks: Dict[str, Tuple[str, float]] = {}
+        if latency_ms is not None:
+            self._threshold_tracks["latency"] = (self.series,
+                                                 latency_ms * 1000.0)
+        if ttft_ms is not None:
+            self._threshold_tracks["ttft"] = (
+                "gen_ttft_p99_us{" + slo_class + "}", ttft_ms * 1000.0)
+        if itl_ms is not None:
+            self._threshold_tracks["itl"] = (
+                "gen_itl_p99_us{" + slo_class + "}", itl_ms * 1000.0)
         self.tracks: Dict[str, _TrackState] = {}
         if target_pct is not None:
             self.tracks["errors"] = _TrackState()
             self.tracks["sheds"] = _TrackState()
-        if latency_ms is not None:
-            self.tracks["latency"] = _TrackState()
+        for t in self._threshold_tracks:
+            self.tracks[t] = _TrackState()
 
     # -- budget math ----------------------------------------------------------
 
@@ -150,6 +176,8 @@ class SloObjective:
             return max(1e-9, 1.0 - (self.target_pct or 100.0) / 100.0)
         if track == "sheds":
             return max(1e-9, 1.0 - self.shed_target_pct / 100.0)
+        if track in ("ttft", "itl"):
+            return max(1e-9, 1.0 - self.token_target_pct / 100.0)
         return max(1e-9, 1.0 - self.latency_target_pct / 100.0)
 
     def _counts(self, db, window_s: float,
@@ -176,11 +204,11 @@ class SloObjective:
                   now_ns: Optional[int] = None) -> Optional[float]:
         """The fraction of the window that was 'bad' for one track, or
         None when the window holds no evidence yet."""
-        if track == "latency":
-            assert self.latency_ms is not None
-            return db.over_threshold_fraction(
-                self.series, self.latency_ms * 1000.0, window_s,
-                now_ns=now_ns)
+        thr = self._threshold_tracks.get(track)
+        if thr is not None:
+            series, bar_us = thr
+            return db.over_threshold_fraction(series, bar_us, window_s,
+                                              now_ns=now_ns)
         total, errors, sheds = self._counts(db, window_s, now_ns)
         if track == "sheds":
             denom = total + sheds
@@ -374,6 +402,9 @@ class SloEvaluator:
                 "latency_ms": obj.latency_ms,
                 "latency_target_pct": obj.latency_target_pct,
                 "shed_target_pct": obj.shed_target_pct,
+                "ttft_ms": obj.ttft_ms,
+                "itl_ms": obj.itl_ms,
+                "slo_class": obj.slo_class,
                 "series": obj.series,
                 "windows": [list(w) for w in obj.windows],
                 "tracks": tracks,

@@ -256,6 +256,21 @@ class Tsdb:
                 readings["watchdog_rolling_p99_us"] = worst / 1e3
         except Exception:
             pass
+        # tpurpc-odyssey (ISSUE 15): per-SLO-class ROLLING token-latency
+        # p99s (gen_itl_p99_us{class} / gen_ttft_p99_us{class}) — the
+        # watchdog_p99 move applied to tokens, so the new ITL/TTFT SLO
+        # track kinds can fire AND resolve. sys.modules-gated: processes
+        # that never served generation sample nothing new.
+        try:
+            import sys
+
+            ody = sys.modules.get("tpurpc.obs.odyssey")
+            if ody is not None and ody.ACTIVE:
+                for sname, v in ody.rolling_series().items():
+                    if self._register(sname, "gauge"):
+                        readings[sname] = v
+        except Exception:
+            pass
         return readings
 
     def sample_once(self, now_ns: Optional[int] = None) -> None:

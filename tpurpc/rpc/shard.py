@@ -151,6 +151,13 @@ def _postfork_worker_init(shard_id: int, n_shards: int) -> None:
         _slo.postfork_reset()
     except Exception:
         pass
+    # tpurpc-odyssey: the inherited sequence ledgers are the supervisor's
+    try:
+        from tpurpc.obs import odyssey as _ody
+
+        _ody.postfork_reset()
+    except Exception:
+        pass
     _obs_shard.set_identity(shard_id, n_shards)
 
     from tpurpc.rpc import channelz as _channelz

@@ -32,6 +32,8 @@ from typing import Iterator, Optional, Tuple
 import numpy as np
 
 from tpurpc.jaxshim import codec
+from tpurpc.obs import odyssey as _odyssey
+from tpurpc.obs import tracing as _tracing
 from tpurpc.rpc.server import (PUSHBACK_KEY, AdmissionGate, Server,
                                unary_stream_rpc_method_handler)
 from tpurpc.rpc.status import StatusCode
@@ -63,6 +65,18 @@ def _scalar(x) -> int:
     return int(arr if arr.ndim == 0 else arr.ravel()[0])
 
 
+def _account_from(ctx) -> Optional[str]:
+    """The ``tpurpc-account`` metadata value, if the caller sent one —
+    tpurpc-odyssey's accounting identity (tenant stand-in)."""
+    try:
+        for key, value in ctx.invocation_metadata():
+            if key == _odyssey.ACCOUNT_KEY:
+                return _odyssey.sanitize_account(value)
+    except Exception:
+        pass
+    return None
+
+
 def add_generation_method(server: Server, scheduler: DecodeScheduler,
                           name: str = "Generate") -> None:
     """Register ``/tpurpc.Generate/<name>`` streaming tokens from
@@ -77,8 +91,13 @@ def add_generation_method(server: Server, scheduler: DecodeScheduler,
         slo = _SLO_BY_CODE.get(_scalar(req.get("slo", 0)),
                                SLO_INTERACTIVE)
         try:
+            # tpurpc-odyssey: the sequence inherits this RPC's trace
+            # context (the server installed it as ambient) and the
+            # caller's accounting identity — the journey and the ledger
+            # start HERE, at admission
             stream = scheduler.submit(prompt, max_tokens=max_tokens,
-                                      slo=slo)
+                                      slo=slo, trace=_tracing.current(),
+                                      account=_account_from(ctx))
         except ShedError as exc:
             ctx.set_trailing_metadata([(PUSHBACK_KEY,
                                         str(exc.pushback_ms))])
@@ -172,15 +191,20 @@ def serve_generation(model, address: str = "127.0.0.1:0", *,
 class GenerationClient:
     """Per-token streaming client for generation methods; wraps a
     :class:`tpurpc.rpc.channel.Channel` (or anything with
-    ``unary_stream``)."""
+    ``unary_stream``). ``account=`` (constructor or per call) attaches
+    the ``tpurpc-account`` accounting identity tpurpc-odyssey rolls
+    per-sequence cost under."""
 
-    def __init__(self, channel, name: str = "Generate"):
+    def __init__(self, channel, name: str = "Generate",
+                 account: Optional[str] = None):
         self._channel = channel
         self._name = name
+        self._account = account
 
     def call(self, prompt, *, max_tokens: int = 32,
              slo: str = SLO_INTERACTIVE,
-             timeout: Optional[float] = None):
+             timeout: Optional[float] = None,
+             account: Optional[str] = None):
         """The raw streaming call: an iterator of response trees (and a
         grpc Call underneath — ``.cancel()`` it to leave mid-stream)."""
         mc = self._channel.unary_stream(
@@ -189,22 +213,26 @@ class GenerationClient:
         req = {"prompt": np.asarray(prompt, dtype=np.int32).reshape(-1),
                "max_tokens": np.int32(max_tokens),
                "slo": np.int32(_CODE_BY_SLO[slo])}
-        return mc(req, timeout=timeout)
+        acct = account if account is not None else self._account
+        md = [(_odyssey.ACCOUNT_KEY, acct)] if acct else None
+        return mc(req, timeout=timeout, metadata=md)
 
     def generate(self, prompt, *, max_tokens: int = 32,
                  slo: str = SLO_INTERACTIVE,
-                 timeout: Optional[float] = None) -> Iterator[int]:
+                 timeout: Optional[float] = None,
+                 account: Optional[str] = None) -> Iterator[int]:
         """Iterate generated token ids, in order, as they stream."""
         for item in self.call(prompt, max_tokens=max_tokens, slo=slo,
-                              timeout=timeout):
+                              timeout=timeout, account=account):
             yield _scalar(item["token"])
 
     def generate_with_meta(self, prompt, *, max_tokens: int = 32,
                            slo: str = SLO_INTERACTIVE,
-                           timeout: Optional[float] = None
+                           timeout: Optional[float] = None,
+                           account: Optional[str] = None
                            ) -> Iterator[Tuple[int, int]]:
         """Like :meth:`generate` but yields ``(index, token)`` — the
         per-token ordering proof the smoke/bench clients assert."""
         for item in self.call(prompt, max_tokens=max_tokens, slo=slo,
-                              timeout=timeout):
+                              timeout=timeout, account=account):
             yield (_scalar(item["index"]), _scalar(item["token"]))

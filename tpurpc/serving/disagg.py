@@ -64,6 +64,8 @@ from tpurpc.core.rendezvous import BlockGrant, GrantWriter
 from tpurpc.jaxshim import codec
 from tpurpc.obs import flight as _flight
 from tpurpc.obs import metrics as _metrics
+from tpurpc.obs import odyssey as _odyssey
+from tpurpc.obs import tracing as _tracing
 from tpurpc.rpc.server import (PUSHBACK_KEY, Server,
                                unary_stream_rpc_method_handler,
                                unary_unary_rpc_method_handler)
@@ -137,31 +139,43 @@ def _s(arr) -> str:
 
 class _Pending:
     """A handoff between CLAIM and COMPLETE: the sender may still write
-    these blocks one-sided. Expiry => QUARANTINE (module docstring)."""
+    these blocks one-sided. Expiry => QUARANTINE (module docstring).
+    ``trace``/``account`` (tpurpc-odyssey) are the sender's journey
+    context and accounting identity, carried in the OfferKv request —
+    the sequence's identity crosses the process split with its KV."""
 
-    __slots__ = ("kv", "seq_key", "prompt", "deadline")
+    __slots__ = ("kv", "seq_key", "prompt", "deadline", "trace",
+                 "account", "t0_ns")
 
     def __init__(self, kv, seq_key: int, prompt: np.ndarray,
-                 deadline: float):
+                 deadline: float, trace=None, account: str = "anon"):
         self.kv = kv
         self.seq_key = seq_key
         self.prompt = prompt
         self.deadline = deadline
+        self.trace = trace
+        self.account = account
+        self.t0_ns = time.monotonic_ns()
 
 
 class _Parked:
     """A completed handoff awaiting its client's ResumeSeq. The writer is
     done, so expiry frees (prefix donated — the bytes are good)."""
 
-    __slots__ = ("kv", "prompt", "last_token", "emitted", "deadline")
+    __slots__ = ("kv", "prompt", "last_token", "emitted", "deadline",
+                 "trace", "account", "nbytes")
 
     def __init__(self, kv, prompt: np.ndarray, last_token: int,
-                 emitted: int, deadline: float):
+                 emitted: int, deadline: float, trace=None,
+                 account: str = "anon", nbytes: int = 0):
         self.kv = kv
         self.prompt = prompt
         self.last_token = last_token
         self.emitted = emitted
         self.deadline = deadline
+        self.trace = trace
+        self.account = account
+        self.nbytes = nbytes
 
 
 # ---------------------------------------------------------------------------
@@ -258,10 +272,19 @@ class DisaggDecode:
             if hit:
                 resume_hash, _tok, resume_flags = kv.entry(hit - 1)
                 self.prefix_hits += 1
+            # tpurpc-odyssey: the sender's journey context + account ride
+            # the offer — adopt() opens this process's tail buffer for
+            # the trace, so decode-side spans join the same commit
+            tr = req.get("trace")
+            trace = _tracing.adopt(bytes(np.asarray(tr, np.uint8))) \
+                if tr is not None else _tracing.current()
+            account = _odyssey.sanitize_account(
+                _s(req["account"]) if "account" in req else None)
             with self._lock:
                 self._pending[handoff] = _Pending(
                     kv, seq_key, prompt,
-                    time.monotonic() + self.pending_ttl_s)
+                    time.monotonic() + self.pending_ttl_s,
+                    trace=trace, account=account)
         except BaseException:
             self.mgr.free_blocks(kv)
             raise
@@ -292,15 +315,23 @@ class DisaggDecode:
         except Exception as exc:
             self.mgr.quarantine(pend.kv)
             ctx.abort(StatusCode.INVALID_ARGUMENT, str(exc))
+        nbytes = n_tokens * ENTRY_BYTES
         with self._lock:
             self._parked[pend.seq_key] = _Parked(
                 pend.kv, pend.prompt, last_token, emitted,
-                time.monotonic() + self.parked_ttl_s)
+                time.monotonic() + self.parked_ttl_s,
+                trace=pend.trace, account=pend.account, nbytes=nbytes)
         self.handoffs_in += 1
         _HANDOFFS.inc()
-        nbytes = n_tokens * ENTRY_BYTES
         _HANDOFF_BYTES.inc(nbytes)
         _flight.emit(_flight.KV_SHIP_COMPLETE, self._tag, handoff, nbytes)
+        # journey: the receive side of the ship, offer -> complete, under
+        # the sequence's own trace (the sender records its write side)
+        if pend.trace is not None:
+            now = time.monotonic_ns()
+            _tracing.record("seq-ship", pend.trace, pend.t0_ns,
+                            now - pend.t0_ns, handoff=handoff,
+                            nbytes=nbytes, account=pend.account)
         return {"ok": np.int32(1)}
 
     def on_release(self, req, ctx):
@@ -330,7 +361,9 @@ class DisaggDecode:
         try:
             stream = self.sched.submit_adopted(
                 parked.kv, parked.prompt, last_token=parked.last_token,
-                emitted=parked.emitted, max_tokens=max_tokens, slo=slo)
+                emitted=parked.emitted, max_tokens=max_tokens, slo=slo,
+                trace=parked.trace, account=parked.account,
+                shipped_bytes=parked.nbytes)
         except ShedError as exc:
             self.mgr.free_blocks(parked.kv, cache_prefix=True)
             ctx.set_trailing_metadata([(PUSHBACK_KEY,
@@ -457,11 +490,17 @@ class _KvShipper:
         return out
 
     def offer(self, seq_key: int, prompt: np.ndarray, n_tokens: int,
-              timeout: float):
-        resp = self._offer({"seq_key": np.int64(seq_key),
-                            "prompt": prompt,
-                            "n_tokens": np.int32(n_tokens)},
-                           timeout=timeout)
+              timeout: float, trace=None, account: Optional[str] = None):
+        req = {"seq_key": np.int64(seq_key), "prompt": prompt,
+               "n_tokens": np.int32(n_tokens)}
+        # tpurpc-odyssey: the sequence's journey context + accounting
+        # identity cross the split IN the offer (metadata would bind to
+        # the RPC; bursts carry a different sequence per request)
+        if trace is not None:
+            req["trace"] = _b(trace.encode())
+        if account:
+            req["account"] = _b(account)
+        resp = self._offer(req, timeout=timeout)
         if not _scalar(resp["ok"]):
             raise MigrationFailed(
                 f"handoff refused: {_s(resp.get('reason', b''))}")
@@ -530,9 +569,22 @@ class DisaggPrefill:
             ctx.abort(StatusCode.INVALID_ARGUMENT, "empty prompt")
         seq_key = next(self._keys)
         n_tokens = int(prompt.size) + 1  # prompt entries + first sample
+        # tpurpc-odyssey: this RPC's ambient trace + the caller's account
+        # ride the offer, so the decode side parks the sequence under the
+        # same journey/identity the client started
+        trace = _tracing.current()
+        account = None
+        try:
+            for key, value in ctx.invocation_metadata():
+                if key == _odyssey.ACCOUNT_KEY:
+                    account = _odyssey.sanitize_account(value)
+                    break
+        except Exception:
+            pass
         try:
             grant, handoff, pos, rhash, rflags = self._shipper.offer(
-                seq_key, prompt, n_tokens, self.timeout_s)
+                seq_key, prompt, n_tokens, self.timeout_s,
+                trace=trace, account=account)
             host = HostKv(base_pos=pos, base_hash=rhash, base_flags=rflags)
             first = int(self.model.prefill_paged([prompt], [host])[0])
             payload = host.payload()
@@ -605,8 +657,21 @@ def migrate(state: DisaggDecode, peer_channel, peer_address: str,
         # quarantines ITS claimed blocks
         state.mgr.free_blocks(s.kv)
         s.kv = None
+        _odyssey.seq_done(s.led, "failed")
         s.q.put(MigrationFailed(str(exc)))
         failed += 1
+
+    def _offer_req(s, n, k) -> dict:
+        req = {"seq_key": np.int64(k), "prompt": s.prompt,
+               "n_tokens": np.int32(n)}
+        # odyssey: each migrating sequence carries ITS OWN journey
+        # context and account across the hop (bursts span sequences, so
+        # per-request fields, not call metadata)
+        if s.trace is not None:
+            req["trace"] = _b(s.trace.encode())
+        if s.account:
+            req["account"] = _b(s.account)
+        return req
 
     try:
         live = []
@@ -615,6 +680,7 @@ def migrate(state: DisaggDecode, peer_channel, peer_address: str,
             if s is None:
                 continue
             if s.kv is None or s.cancelled:
+                _odyssey.seq_done(s.led, "failed")
                 s.q.put(MigrationFailed("sequence had no shippable KV"))
                 failed += 1
                 continue
@@ -622,19 +688,18 @@ def migrate(state: DisaggDecode, peer_channel, peer_address: str,
             _flight.emit(_flight.MIG_BEGIN, state._tag, sid, n_entries)
             seq_key = (int(time.monotonic_ns()) << 8 | (sid & 0xFF)) \
                 & 0x7FFFFFFFFFFFFFFF
-            live.append((sid, s, n_entries, seq_key))
+            live.append((sid, s, n_entries, seq_key, time.monotonic_ns()))
         # Phase 1 — BURST the offers (tpurpc-pulse, ROADMAP item 2's
         # follow-up): a drain migrating N sequences frames ONE gathered
         # writev of OfferKv calls instead of N serialized round trips.
         resps = shipper._burst(
             shipper._offer,
-            [{"seq_key": np.int64(k), "prompt": s.prompt,
-              "n_tokens": np.int32(n)} for _sid, s, n, k in live],
+            [_offer_req(s, n, k) for _sid, s, n, k, _t0 in live],
             timeout_s) if live else []
         # Phase 2 — per-sequence one-sided block writes (failures fail
         # that sequence ALONE; its siblings keep going).
-        pending = []  # (sid, s, seq_key, CompleteKv request)
-        for (sid, s, n_entries, seq_key), resp in zip(live, resps):
+        pending = []  # (sid, s, seq_key, t0, shipped, CompleteKv request)
+        for (sid, s, n_entries, seq_key, t0), resp in zip(live, resps):
             try:
                 if isinstance(resp, Exception):
                     raise resp
@@ -650,7 +715,8 @@ def migrate(state: DisaggDecode, peer_channel, peer_address: str,
             except Exception as exc:
                 fail_one(sid, s, exc)
                 continue
-            pending.append((sid, s, seq_key,
+            pending.append((sid, s, seq_key, t0,
+                            (n_entries - pos) * ENTRY_BYTES,
                             {"handoff": np.int64(handoff),
                              "n_tokens": np.int32(n_entries),
                              "last_token": np.int32(s.last_token),
@@ -663,7 +729,8 @@ def migrate(state: DisaggDecode, peer_channel, peer_address: str,
         cresps = shipper._burst(shipper._complete,
                                 [req for *_x, req in pending],
                                 timeout_s) if pending else []
-        for (sid, s, seq_key, _req), resp in zip(pending, cresps):
+        for (sid, s, seq_key, t0, shipped, _req), resp in zip(pending,
+                                                              cresps):
             if isinstance(resp, Exception):
                 fail_one(sid, s, resp)
                 continue
@@ -672,6 +739,10 @@ def migrate(state: DisaggDecode, peer_channel, peer_address: str,
             emitted = s.emitted
             _flight.emit(_flight.MIG_END, state._tag, sid, 1)
             _MIGRATIONS.inc()
+            # odyssey: settle the source ledger — migration count, the
+            # hop's rendezvous bytes, the seq-migrate journey span; a
+            # migrated journey always tail-commits (PR 5 rule)
+            _odyssey.seq_migrated(s.led, shipped, t0)
             s.q.put(SeqMigrated(peer_address, seq_key, emitted))
             moved += 1
     finally:
@@ -760,17 +831,24 @@ class DisaggClient:
 
     def __init__(self, prefill_channel, decode_address: str,
                  channel_factory: Optional[Callable[[str], object]]
-                 = None):
+                 = None, account: Optional[str] = None):
         self._prefill = prefill_channel.unary_unary(
             _method("Prefill"), codec.tree_serializer,
             codec.tree_deserializer)
         self._decode_address = decode_address
+        #: tpurpc-odyssey accounting identity, attached to every control
+        #: RPC as the ``tpurpc-account`` metadata key
+        self._account = account
         if channel_factory is None:
             from tpurpc.rpc.channel import Channel
 
             channel_factory = Channel
         self._factory = channel_factory
         self._channels: Dict[str, object] = {}
+
+    def _md(self):
+        return [(_odyssey.ACCOUNT_KEY, self._account)] \
+            if self._account else None
 
     def _channel(self, address: str):
         ch = self._channels.get(address)
@@ -784,7 +862,8 @@ class DisaggClient:
         """Yield ``(index, token)`` pairs, indices 0..n-1 across prefill,
         decode, and any number of migrations."""
         prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
-        resp = self._prefill({"prompt": prompt}, timeout=timeout)
+        resp = self._prefill({"prompt": prompt}, timeout=timeout,
+                             metadata=self._md())
         seq_key = _scalar(resp["seq_key"])
         address = _s(resp["decode_address"]) or self._decode_address
         yield 0, _scalar(resp["first_token"])
@@ -796,7 +875,8 @@ class DisaggClient:
                                  codec.tree_deserializer)
             call = mc({"seq_key": np.int64(seq_key),
                        "max_tokens": np.int32(max_tokens),
-                       "slo": np.int32(_SLO_CODE[slo])}, timeout=timeout)
+                       "slo": np.int32(_SLO_CODE[slo])}, timeout=timeout,
+                      metadata=self._md())
             migrated = None
             for item in call:
                 if "migrated" in item:

@@ -54,6 +54,16 @@ parks in ``_swapped`` until a boundary has room to swap it back.
 least_loaded picking (the ISSUE 11 satellite fix). :meth:`detach` and
 :meth:`submit_adopted` are the migration plane's two halves: remove a
 live sequence with its KV intact / graft a shipped one in.
+
+tpurpc-odyssey (ISSUE 15): every sequence carries its originating RPC's
+trace context and an accounting identity (``trace=``/``account=`` on
+submit/submit_adopted — the transport face reads the ambient context and
+the ``tpurpc-account`` metadata key), and the loop feeds the
+:mod:`tpurpc.obs.odyssey` hooks at lifecycle edges: ledger at submit,
+journey spans at join/preempt/swap/retire, per-token ITL at the stream
+edge, per-step cost shares at step end. All of it behind the ONE
+``_odyssey.ACTIVE`` gate (``TPURPC_ODYSSEY=0`` drops everything but the
+always-on SEQ_* flight edges).
 """
 
 from __future__ import annotations
@@ -71,6 +81,7 @@ import numpy as np
 from tpurpc.analysis.locks import make_condition, make_lock
 from tpurpc.obs import flight as _flight
 from tpurpc.obs import metrics as _metrics
+from tpurpc.obs import odyssey as _odyssey
 from tpurpc.obs import profiler as _profiler
 
 __all__ = ["DecodeScheduler", "TokenStream", "ShedError", "DrainingError",
@@ -141,7 +152,7 @@ class _Seq:
     __slots__ = ("sid", "prompt", "prompt_len", "max_tokens", "slo",
                  "slo_code", "state", "last_token", "emitted", "q",
                  "cancelled", "t_submit_ns", "t_first_ns", "preempted",
-                 "kv", "adopted")
+                 "kv", "adopted", "trace", "account", "led")
 
     def __init__(self, sid: int, prompt: np.ndarray, max_tokens: int,
                  slo: str):
@@ -161,6 +172,9 @@ class _Seq:
         self.preempted = False
         self.kv = None              # paged mode: the sequence's block table
         self.adopted = False        # arrived via handoff/migration
+        self.trace = None           # odyssey: the originating RPC's context
+        self.account = _odyssey.DEFAULT_ACCOUNT
+        self.led = None             # odyssey: the sequence's cost ledger
 
     def resumable(self) -> bool:
         """Prefilled already — admission is free (no prefill cost)."""
@@ -296,6 +310,10 @@ class DecodeScheduler:
         #: hand a live sequence over with its KV intact
         self._detach_req: Dict[int, tuple] = {}
         self._sids = itertools.count(1)
+        #: odyssey: arena bytes per block (0 in opaque mode) — the ledger's
+        #: KV byte-second integrand
+        self._kv_block_bytes = int(getattr(kv, "block_bytes", 0) or 0) \
+            if kv is not None else 0
         self._tag = _flight.tag_for(f"decode:{name}")
         self._step_roll: "deque[float]" = deque(maxlen=64)  # step ms
         self._step_ewma_ms = 0.0
@@ -314,50 +332,80 @@ class DecodeScheduler:
     # -- submit side ----------------------------------------------------------
 
     def submit(self, prompt, *, max_tokens: int = 32,
-               slo: str = SLO_INTERACTIVE) -> TokenStream:
+               slo: str = SLO_INTERACTIVE, trace=None,
+               account: Optional[str] = None) -> TokenStream:
         """Queue one generation request; returns its :class:`TokenStream`.
 
         Raises :class:`ShedError` (overload; carries the pushback hint),
         :class:`DrainingError` (server leaving), or ``RuntimeError``
         (closed). The returned stream's first token arrives after the
         next step boundary admits the prefill — joining never waits for
-        the running batch to drain."""
+        the running batch to drain.
+
+        ``trace``/``account`` (tpurpc-odyssey): the originating RPC's
+        :class:`~tpurpc.obs.tracing.TraceContext` and accounting identity
+        — the transport face passes the ambient context and the
+        ``tpurpc-account`` metadata key; in-process callers may pass
+        their own."""
         if slo not in _SLO_CODE:
             raise ValueError(f"unknown slo class {slo!r} "
                              f"(want {sorted(_SLO_CODE)})")
         prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
         seq = _Seq(next(self._sids), prompt, max(1, int(max_tokens)), slo)
-        with self._lock:
-            if self._closed:
-                raise RuntimeError("scheduler closed")
-            if self._draining or (self._draining_fn is not None
-                                  and self._draining_fn()):
-                raise DrainingError(
-                    "scheduler draining: in-flight sequences finish, new "
-                    "prefills are refused")
-            reason, pushback = self._shed_decision_locked(slo)
-            if reason is not None:
-                self.shed_total += 1
-                self.last_shed_ns = time.monotonic_ns()
-                slo_code = seq.slo_code
-                _flight.emit(_flight.GEN_SHED, self._tag, slo_code,
-                             pushback)
-                _SHED.labels(slo).inc()
-                raise ShedError(reason, pushback, slo)
-            self._waiting.append(seq)
-            self._kick.notify_all()
+        seq.trace = trace
+        seq.account = _odyssey.sanitize_account(account)
+        if _odyssey.ACTIVE:
+            seq.led = _odyssey.seq_submit(
+                self.name, seq.sid, seq.account, slo, trace,
+                seq.prompt_len, self._kv_block_bytes)
+        try:
+            with self._lock:
+                if self._closed:
+                    raise RuntimeError("scheduler closed")
+                if self._draining or (self._draining_fn is not None
+                                      and self._draining_fn()):
+                    raise DrainingError(
+                        "scheduler draining: in-flight sequences finish, "
+                        "new prefills are refused")
+                reason, pushback = self._shed_decision_locked(slo)
+                if reason is not None:
+                    self.shed_total += 1
+                    self.last_shed_ns = time.monotonic_ns()
+                    slo_code = seq.slo_code
+                    _flight.emit(_flight.GEN_SHED, self._tag, slo_code,
+                                 pushback)
+                    _SHED.labels(slo).inc()
+                    raise ShedError(reason, pushback, slo)
+                sid = seq.sid
+                plen = seq.prompt_len
+                _flight.emit(_flight.SEQ_SUBMIT, self._tag, sid, plen)
+                self._waiting.append(seq)
+                self._kick.notify_all()
+        except ShedError:
+            _odyssey.seq_done(seq.led, "shed")
+            raise
+        except BaseException:
+            _odyssey.seq_done(seq.led, "refused")
+            raise
         return TokenStream(seq, self)
 
     def submit_adopted(self, kv_handle, prompt, *, last_token: int,
                        emitted: int, max_tokens: int,
-                       slo: str = SLO_INTERACTIVE) -> TokenStream:
+                       slo: str = SLO_INTERACTIVE, trace=None,
+                       account: Optional[str] = None,
+                       shipped_bytes: int = 0) -> TokenStream:
         """Graft a sequence whose KV was computed ELSEWHERE — a
         disaggregated prefill handoff or an inbound migration. The block
         table arrives whole (entries present through the last generated
         token); the sequence joins as a free resume at the next boundary
         and its next token continues the stream exactly where the sender
         left it. The caller owns nothing afterwards: retire/leave/failure
-        release the table like any local sequence's."""
+        release the table like any local sequence's.
+
+        ``trace``/``account``/``shipped_bytes`` (tpurpc-odyssey): the
+        sender's journey context, accounting identity, and the handoff's
+        rendezvous bytes — the journey and the ledger continue across the
+        process split under the same trace_id / account key."""
         if not self._paged:
             raise RuntimeError("submit_adopted needs a paged scheduler "
                                "(kv=)")
@@ -367,25 +415,43 @@ class DecodeScheduler:
         seq.adopted = True
         seq.last_token = int(last_token)
         seq.emitted = int(emitted)
-        with self._lock:
-            if self._closed:
-                raise RuntimeError("scheduler closed")
-            # a draining server must not accept NEW residency; migration
-            # initiators pick a non-draining peer
-            if self._draining or (self._draining_fn is not None
-                                  and self._draining_fn()):
-                raise DrainingError("scheduler draining: adoption refused")
-            reason, pushback = self._shed_decision_locked(slo)
-            if reason is not None:
-                self.shed_total += 1
-                self.last_shed_ns = time.monotonic_ns()
-                slo_code = seq.slo_code
-                _flight.emit(_flight.GEN_SHED, self._tag, slo_code,
-                             pushback)
-                _SHED.labels(slo).inc()
-                raise ShedError(reason, pushback, slo)
-            self._waiting.append(seq)
-            self._kick.notify_all()
+        seq.trace = trace
+        seq.account = _odyssey.sanitize_account(account)
+        if _odyssey.ACTIVE:
+            seq.led = _odyssey.seq_submit(
+                self.name, seq.sid, seq.account, slo, trace,
+                seq.prompt_len, self._kv_block_bytes,
+                shipped_bytes=int(shipped_bytes), adopted=True)
+        try:
+            with self._lock:
+                if self._closed:
+                    raise RuntimeError("scheduler closed")
+                # a draining server must not accept NEW residency;
+                # migration initiators pick a non-draining peer
+                if self._draining or (self._draining_fn is not None
+                                      and self._draining_fn()):
+                    raise DrainingError(
+                        "scheduler draining: adoption refused")
+                reason, pushback = self._shed_decision_locked(slo)
+                if reason is not None:
+                    self.shed_total += 1
+                    self.last_shed_ns = time.monotonic_ns()
+                    slo_code = seq.slo_code
+                    _flight.emit(_flight.GEN_SHED, self._tag, slo_code,
+                                 pushback)
+                    _SHED.labels(slo).inc()
+                    raise ShedError(reason, pushback, slo)
+                sid = seq.sid
+                plen = seq.prompt_len
+                _flight.emit(_flight.SEQ_SUBMIT, self._tag, sid, plen)
+                self._waiting.append(seq)
+                self._kick.notify_all()
+        except ShedError:
+            _odyssey.seq_done(seq.led, "shed")
+            raise
+        except BaseException:
+            _odyssey.seq_done(seq.led, "refused")
+            raise
         return TokenStream(seq, self)
 
     def detach(self, sid: int, timeout: float = 5.0):
@@ -536,6 +602,7 @@ class DecodeScheduler:
                 emitted = s.emitted
                 _flight.emit(_flight.GEN_LEAVE, self._tag, sid, emitted)
                 self._release_kv(s, cache=True)
+                _odyssey.seq_done(s.led, "left")
                 s.q.put(_DONE)
             else:
                 kept.append(s)
@@ -559,6 +626,7 @@ class DecodeScheduler:
                 err = RuntimeError("scheduler closed")
                 for s in stranded:
                     self._release_kv(s, cache=False)
+                    _odyssey.seq_done(s.led, "failed")
                     s.q.put(err)
                 return False
             if self._detach_req:
@@ -579,7 +647,13 @@ class DecodeScheduler:
         # paged preemption happens OUTSIDE the lock: swap_out copies block
         # bytes to host, which must not stall a concurrent submit
         for s in preempt:
+            t0 = time.monotonic_ns()
             self.kv.swap_out(s.kv)
+            if s.led is not None:
+                host = s.kv.host
+                _odyssey.seq_swap(s.led, 0,
+                                  len(host) if host is not None else 0,
+                                  time.monotonic_ns() - t0)
             self._swapped.append(s)
         for s, outcome in drop:
             sid = s.sid
@@ -587,10 +661,14 @@ class DecodeScheduler:
             if isinstance(outcome, BaseException):
                 _flight.emit(_flight.GEN_RETIRE, self._tag, sid, emitted)
                 self._release_kv(s, cache=False)
+                _odyssey.seq_done(
+                    s.led, "refused" if isinstance(outcome, DrainingError)
+                    else "failed")
                 s.q.put(outcome)
             else:
                 _flight.emit(_flight.GEN_LEAVE, self._tag, sid, emitted)
                 self._release_kv(s, cache=True)
+                _odyssey.seq_done(s.led, "left")
                 s.q.put(_DONE)
         if admit:
             self._prefill_batch(admit)
@@ -603,6 +681,7 @@ class DecodeScheduler:
         emitted = s.emitted
         _flight.emit(_flight.GEN_LEAVE, self._tag, sid, emitted)
         self._release_kv(s, cache=False)
+        _odyssey.seq_done(s.led, "left")
         s.q.put(_DONE)
         return True
 
@@ -629,6 +708,10 @@ class DecodeScheduler:
                         self._waiting.remove(s)  # tpr: allow(lock)
                         break
             if found is not None:
+                kv = found.kv
+                entries = kv.length if kv is not None else 0
+                _flight.emit(_flight.SEQ_DETACH, self._tag, sid, entries)
+                _odyssey.seq_detached(found.led, entries)
                 box.append(found)
                 ev.set()
                 del self._detach_req[sid]  # tpr: allow(lock)
@@ -687,6 +770,7 @@ class DecodeScheduler:
                     slo_code = s.slo_code
                     _flight.emit(_flight.GEN_PREEMPT, self._tag, sid,
                                  slo_code)
+                    _odyssey.seq_preempt(s.led)
                     _PREEMPTS.inc()
                     self.preempted_total += 1
                     if self._paged:
@@ -750,6 +834,7 @@ class DecodeScheduler:
             if not s.resumable():
                 continue
             if s.kv is not None and s.kv.swapped:
+                t0 = time.monotonic_ns()
                 try:
                     self.kv.swap_in(s.kv)
                 except Exception:
@@ -757,14 +842,21 @@ class DecodeScheduler:
                     # boundary (load_depth keeps reporting the debt)
                     self._swapped.append(s)
                     continue
+                if s.led is not None:
+                    _odyssey.seq_swap(
+                        s.led, 1,
+                        len(s.kv.blocks) * self._kv_block_bytes,
+                        time.monotonic_ns() - t0)
             sid = s.sid
             _flight.emit(_flight.GEN_JOIN, self._tag, sid, 0)
+            _odyssey.seq_join(s.led, resumed=True)
             self._running.append(s)
         if not fresh:
             return
         if self._paged:
             self._prefill_paged(fresh)
             return
+        t0_pf = time.monotonic_ns()
         try:
             states, tokens = self.model.prefill([s.prompt for s in fresh])
             results = [(states[i], int(tokens[i]))
@@ -779,6 +871,7 @@ class DecodeScheduler:
                     results.append((st[0], int(tok[0])))
                 except Exception as exc:
                     results.append(exc)
+        dt_pf = time.monotonic_ns() - t0_pf
         emitted = 0
         for s, res in zip(fresh, results):
             sid = s.sid
@@ -786,10 +879,13 @@ class DecodeScheduler:
             if isinstance(res, Exception):
                 _SEQ_FAILED.inc()
                 _flight.emit(_flight.GEN_RETIRE, self._tag, sid, 0)
+                _odyssey.seq_done(s.led, "failed")
                 s.q.put(res)
                 continue
             s.state, first = res
             _flight.emit(_flight.GEN_JOIN, self._tag, sid, plen)
+            _odyssey.seq_join(s.led)
+            _odyssey.seq_prefill(s.led, dt_pf, len(fresh))
             self._emit_token(s, first)
             emitted += 1
             if s.emitted < s.max_tokens and not self._hit_eos(first):
@@ -817,10 +913,12 @@ class DecodeScheduler:
                 _SEQ_FAILED.inc()
                 sid = s.sid
                 _flight.emit(_flight.GEN_RETIRE, self._tag, sid, 0)
+                _odyssey.seq_done(s.led, "failed")
                 s.q.put(exc)
         if not ready:
             return
         lengths = [s.kv.length for s in ready]
+        t0_pf = time.monotonic_ns()
         try:
             toks = self.model.prefill_paged([s.prompt for s in ready],
                                             [s.kv for s in ready])
@@ -838,6 +936,7 @@ class DecodeScheduler:
                 except Exception as exc:
                     s.kv.truncate(n0)
                     results.append(exc)
+        dt_pf = time.monotonic_ns() - t0_pf
         emitted = 0
         for s, res in zip(ready, results):
             sid = s.sid
@@ -846,9 +945,14 @@ class DecodeScheduler:
                 _SEQ_FAILED.inc()
                 _flight.emit(_flight.GEN_RETIRE, self._tag, sid, 0)
                 self._release_kv(s, cache=False)
+                _odyssey.seq_done(s.led, "failed")
                 s.q.put(res)
                 continue
             _flight.emit(_flight.GEN_JOIN, self._tag, sid, plen)
+            _odyssey.seq_join(s.led)
+            _odyssey.seq_prefill(
+                s.led, dt_pf, len(ready),
+                kv_bytes=len(s.kv.blocks) * self._kv_block_bytes)
             self._emit_token(s, res)
             emitted += 1
             if s.emitted < s.max_tokens and not self._hit_eos(res):
@@ -905,8 +1009,13 @@ class DecodeScheduler:
                         results.append((st[0], int(tok[0])))
                     except Exception as exc:
                         results.append(exc)
-        dt_ns = time.monotonic_ns() - t0
+        t_end = time.monotonic_ns()
+        dt_ns = t_end - t0
         self._note_step_time(dt_ns)
+        if _odyssey.ACTIVE:
+            # cost attribution: each row owns 1/nb of this device step,
+            # and its arena residency integrates against the same clock
+            _odyssey.seq_step(running, dt_ns, t_end)
         emitted = 0
         kept: List[_Seq] = []
         for s, res in zip(running, results):
@@ -916,12 +1025,13 @@ class DecodeScheduler:
                 n = s.emitted
                 _flight.emit(_flight.GEN_RETIRE, self._tag, sid, n)
                 self._release_kv(s, cache=False)
+                _odyssey.seq_done(s.led, "failed")
                 s.q.put(res)
                 continue
             st, tok = res
             if not self._paged:
                 s.state = st
-            self._emit_token(s, tok)
+            self._emit_token(s, tok, t_end)
             emitted += 1
             if s.emitted >= s.max_tokens or self._hit_eos(tok):
                 self._retire(s)
@@ -945,12 +1055,21 @@ class DecodeScheduler:
         self._step_ewma_ms = ms if self._step_ewma_ms == 0.0 else (
             (1 - a) * self._step_ewma_ms + a * ms)
 
-    def _emit_token(self, s: _Seq, tok: int) -> None:
+    def _emit_token(self, s: _Seq, tok: int, now_ns: int = 0) -> None:
         s.last_token = tok
         s.emitted += 1
         if s.t_first_ns == 0:
-            s.t_first_ns = time.monotonic_ns()
-            _TTFT_US.record((s.t_first_ns - s.t_submit_ns) // 1000)
+            s.t_first_ns = now_ns or time.monotonic_ns()
+            ttft_us = (s.t_first_ns - s.t_submit_ns) // 1000
+            _TTFT_US.record(ttft_us)
+            sid = s.sid
+            _flight.emit(_flight.SEQ_FIRST_TOKEN, self._tag, sid, ttft_us)
+            _odyssey.seq_first_token(s.led, ttft_us, s.t_first_ns)
+        else:
+            # the stream edge: inter-token latency lands here, per token —
+            # the one per-token odyssey site (a subtraction + one record;
+            # the step's shared end stamp stands in for a clock read)
+            _odyssey.seq_token(s.led, now_ns)
         s.q.put(tok)
 
     def _hit_eos(self, tok: int) -> bool:
@@ -965,6 +1084,7 @@ class DecodeScheduler:
         # the prefix cache before the table frees — a repeated prompt
         # skips prefill for the shared span
         self._release_kv(s, cache=True)
+        _odyssey.seq_done(s.led, "retire")
         s.q.put(_DONE)
 
 def health_lines() -> List[str]:

@@ -1,0 +1,206 @@
+"""tpurpc-odyssey smoke (ISSUE 15): one sequence's whole journey, proven.
+
+A disaggregated pair over shm block grants — prefill in a CHILD process,
+two decode servers in this one — serves a single account's generation
+stream which is live-MIGRATED mid-decode from decode A to decode B.
+Asserted:
+
+* **token exactness across three hops**: prefill process -> decode A ->
+  migration -> decode B, values equal ``reference_decode`` bit-exactly
+  and indices 0..n-1 (the PR 11 contract, still holding under odyssey);
+* **one trace_id spans the split**: the client opens ONE trace context;
+  the journey doc built from ``/traces?trace_id=`` of the decode process
+  AND the prefill process parses as Perfetto JSON with >=2 clock-anchored
+  process lanes, and carries prefill-side spans plus the decode-side
+  ``seq-ship``/``seq-decode``/``seq-migrate`` journey spans;
+* **the cost plane attributes**: ``/debug/seq`` rolls the account up with
+  tokens, >=1 migration, shipped bytes, and >=95% of measured device-step
+  time attributed to named sequences;
+* **protocol conformance**: the in-process flight stream (SEQ_SUBMIT ->
+  GEN_JOIN -> SEQ_FIRST_TOKEN -> ... -> SEQ_DETACH / MIG brackets)
+  checks clean against the declared machines, and the
+  ``TPURPC_FLIGHT_DUMP`` dump rides the check.sh conformance stage.
+
+Exit 0 on success. ~5 s, numpy only (no jax).
+
+    python -m tpurpc.tools.odyssey_smoke
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+PROMPT_LEN = 192
+MAX_TOKENS = 48
+ACCOUNT = "smoke-tenant"
+
+
+def run_prefill_child() -> int:
+    decode_addr = sys.argv[sys.argv.index("--prefill") + 1]
+
+    from tpurpc.jaxshim.generate import ToyDecodeModel
+    from tpurpc.rpc.channel import Channel
+    from tpurpc.serving import serve_prefill
+
+    ch = Channel(decode_addr)
+    srv, port, state = serve_prefill(ToyDecodeModel(), ch, decode_addr)
+    print(f"PORT {port}", flush=True)
+    try:
+        sys.stdin.read()
+    finally:
+        srv.stop(grace=0)
+        state.close()
+        ch.close()
+    return 0
+
+
+def run() -> int:
+    import numpy as np
+
+    from tpurpc.analysis import protocol
+    from tpurpc.jaxshim.generate import ToyDecodeModel, reference_decode
+    from tpurpc.obs import flight, odyssey, scrape, tracing
+    from tpurpc.rpc.channel import Channel
+    from tpurpc.serving import DisaggClient, migrate, serve_decode
+
+    tracing.force(True)  # every span commits: the journey must be whole
+    t0_flight = __import__("time").monotonic_ns()
+
+    # decode A (the handoff target) and decode B (the migration target),
+    # both paged over shm arenas; a slow-ish step keeps the stream alive
+    # long enough to migrate it mid-decode
+    a_srv, a_port, a_sched, a_state = serve_decode(
+        ToyDecodeModel(step_delay_s=0.01), kv_blocks=96, block_bytes=512,
+        kv_kind="shm", name="odyA")
+    b_srv, b_port, b_sched, b_state = serve_decode(
+        ToyDecodeModel(step_delay_s=0.01), kv_blocks=96, block_bytes=512,
+        kv_kind="shm", name="odyB")
+    b_ch = Channel(f"127.0.0.1:{b_port}")
+
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = here + os.pathsep + env.get("PYTHONPATH", "")
+    env["TPURPC_TRACE_SAMPLE"] = "1"  # the child commits its spans too
+    child = subprocess.Popen(
+        [sys.executable, "-m", "tpurpc.tools.odyssey_smoke", "--prefill",
+         f"127.0.0.1:{a_port}"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env, text=True)
+    try:
+        line = child.stdout.readline().strip()
+        assert line.startswith("PORT "), f"child said {line!r}"
+        p_port = int(line.split()[1])
+        p_ch = Channel(f"127.0.0.1:{p_port}")
+        cli = DisaggClient(p_ch, f"127.0.0.1:{a_port}", account=ACCOUNT)
+        prompt = np.arange(PROMPT_LEN, dtype=np.int32) % 97
+        want = reference_decode(prompt, MAX_TOKENS)
+
+        # ONE trace context for the whole journey: it rides the Prefill
+        # RPC into the child, the OfferKv into decode A, the migration
+        # offer into decode B — every process's spans share its trace_id.
+        ctx = tracing.TraceContext(
+            int.from_bytes(os.urandom(8), "big"), 1)
+        pairs = []
+        with tracing.use(ctx):
+            it = cli.generate_with_meta(prompt, max_tokens=MAX_TOKENS,
+                                        timeout=30)
+            for _ in range(6):
+                pairs.append(next(it))
+            # mid-stream: move every live sequence A -> B; the client
+            # follows the `migrated` record transparently
+            moved, failed = migrate(a_state, b_ch, f"127.0.0.1:{b_port}")
+            assert moved >= 1 and failed == 0, (moved, failed)
+            pairs.extend(it)
+        idxs = [i for i, _ in pairs]
+        vals = [t for _, t in pairs]
+        assert idxs == list(range(MAX_TOKENS)), idxs
+        assert vals == want, (vals[:8], want[:8])
+        print(f"  odyssey smoke: {MAX_TOKENS} tokens exact across "
+              f"prefill-child -> decode A -> migrate -> decode B "
+              f"(moved={moved})")
+
+        # -- the journey: one trace_id, >=2 anchored process lanes ------
+        doc = odyssey.journey([f"127.0.0.1:{a_port}",
+                               f"127.0.0.1:{p_port}"], ctx.trace_id)
+        doc = json.loads(json.dumps(doc))  # must be pure JSON
+        meta = doc["otherData"]
+        assert meta["lanes"] >= 2, meta
+        assert not meta["unanchored"], meta
+        names = {e.get("name") for e in doc["traceEvents"]
+                 if e.get("ph") == "X"}
+        for needed in ("seq-ship", "seq-decode", "seq-migrate"):
+            assert needed in names, (needed, sorted(names))
+        # the prefill process contributed spans of the SAME trace
+        lane_pids = {e.get("pid") for e in doc["traceEvents"]
+                     if e.get("ph") == "X"}
+        assert len(lane_pids) >= 2, sorted(names)
+        print(f"  odyssey smoke: journey doc has {meta['lanes']} anchored "
+              f"lanes, spans {sorted(names)}")
+
+        # -- the cost plane: account rollup + attribution ---------------
+        status, _ctype, body = scrape.route_local("/debug/seq")
+        assert status == 200
+        seq = json.loads(body)
+        assert seq["enabled"], seq
+        accounts = seq["accounts"]
+        assert ACCOUNT in accounts, sorted(accounts)
+        acct = accounts[ACCOUNT]
+        assert acct["tokens"] >= MAX_TOKENS - 1, acct
+        assert acct["migrations"] >= 1, acct
+        assert acct["shipped_bytes"] > 0, acct
+        assert seq["attributed_pct"] is not None \
+            and seq["attributed_pct"] >= 95.0, seq["attributed_pct"]
+        print(f"  odyssey smoke: /debug/seq attributes "
+              f"{seq['attributed_pct']}% of step time; account "
+              f"'{ACCOUNT}': tokens={int(acct['tokens'])} "
+              f"migrations={int(acct['migrations'])} "
+              f"shipped={int(acct['shipped_bytes'])}B")
+
+        # -- flight conformance (the dump also rides check.sh) ----------
+        events = flight.snapshot(since_ns=t0_flight)
+        bad = protocol.check_events(events, strict=False)
+        assert not bad, bad[:3]
+        protocol.assert_ordered(events, [
+            ("seq-submit", {"a2": PROMPT_LEN}),
+            "gen-join", "seq-first-token", "seq-detach",
+            "migration-begin", ("migration-end", {"a2": 1}),
+        ], since_ns=t0_flight)
+        print("  odyssey smoke: flight journey protocol-conformant "
+              "(submit -> join -> first-token -> detach -> migration)")
+        cli.close()
+        p_ch.close()
+    finally:
+        try:
+            child.stdin.close()
+            child.wait(timeout=10)
+        except Exception:
+            child.kill()
+        tracing.force(None)
+        for srv, _port, sched, state in ((a_srv, a_port, a_sched, a_state),
+                                         (b_srv, b_port, b_sched,
+                                          b_state)):
+            srv.stop(grace=0)
+            sched.close()
+            state.close()
+            state.mgr.close()
+        b_ch.close()
+    print("odyssey smoke: PASS (2 processes, one trace_id end-to-end, "
+          "account rollup + >=95% step attribution, conformant flight)")
+    return 0
+
+
+def main() -> int:
+    if "--prefill" in sys.argv:
+        return run_prefill_child()
+    try:
+        return run()
+    except BaseException as exc:
+        print(f"odyssey smoke FAILED: {exc!r}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -5,7 +5,10 @@ Polls a tpurpc process's Prometheus endpoint (any serving port answers
 latency percentiles, ring occupancy/credits, pipelined-window depth, the
 fan-in batcher's batch-size/flush-reason profile, and — tpurpc-blackbox
 (ISSUE 5) — a stalls/anomalies pane fed by ``/debug/stalls`` (active
-watchdog diagnoses with their attributed stage, plus the trip counters).
+watchdog diagnoses with their attributed stage, plus the trip counters),
+and — tpurpc-odyssey (ISSUE 15) — a ``seq`` pane fed by ``/debug/seq``
+(top sequences by device step-ms and KV byte-seconds, per-account cost
+rollup).
 
     python -m tpurpc.tools.top HOST:PORT [--interval 1.0] [--once]
 
@@ -86,6 +89,17 @@ def fetch_slo(target: str, timeout: float = 5.0) -> Optional[dict]:
         return None
 
 
+def fetch_seq(target: str, timeout: float = 5.0) -> Optional[dict]:
+    """tpurpc-odyssey /debug/seq (per-sequence cost ledgers + account
+    rollup), or None when unreachable / pre-odyssey server."""
+    try:
+        with urllib.request.urlopen(f"http://{target}/debug/seq",
+                                    timeout=timeout) as resp:
+            return json.loads(resp.read().decode("utf-8", "replace"))
+    except Exception:
+        return None
+
+
 def _val(m: Dict, name: str, labels: str = "") -> float:
     return m.get((name, labels), 0.0)
 
@@ -106,7 +120,8 @@ def _fmt_us(us: float) -> str:
 def render(cur: Dict, prev: Optional[Dict], dt: float,
            target: str, stalls: Optional[dict] = None,
            waterfall: Optional[dict] = None,
-           slo: Optional[dict] = None) -> str:
+           slo: Optional[dict] = None,
+           seq: Optional[dict] = None) -> str:
     P = "tpurpc_"
     Q50 = 'quantile="0.5"'
     Q99 = 'quantile="0.99"'
@@ -222,6 +237,36 @@ def render(cur: Dict, prev: Optional[Dict], dt: float,
                         f"burn {st.get('burn_fast', 0):>6.1f}x fast "
                         f"{st.get('burn_slow', 0):>6.1f}x slow  "
                         f"fired {st.get('fired', 0)}")
+    # tpurpc-odyssey sequence pane (/debug/seq): top sequences by device
+    # step-ms and KV byte-seconds, plus the per-account cost rollup — the
+    # "whose sequences own the device" view
+    if seq is not None and seq.get("enabled"):
+        live = seq.get("live", ())
+        att = seq.get("attributed_pct")
+        lines.append(
+            f"seq   live {seq.get('live_total', len(live))}   "
+            f"step-time attributed "
+            f"{att if att is not None else 'n/a'}%")
+        rows = sorted(list(live) + list(seq.get("recent", ()))[:8],
+                      key=lambda r: r.get("step_us", 0), reverse=True)
+        for r in rows[:4]:
+            lines.append(
+                f"   #{r.get('sid', '?'):<5} {r.get('account', '?'):<14} "
+                f"{r.get('state', '?'):<9} tok {r.get('tokens', 0):>4}  "
+                f"step {r.get('step_us', 0) / 1e3:>8.1f}ms  "
+                f"kv {r.get('kv_byte_s', 0):>8.1f}B·s  "
+                f"swap {r.get('swap_byte_s', 0):>6.1f}B·s")
+        accounts = seq.get("accounts") or {}
+        for name in sorted(accounts,
+                           key=lambda a: -accounts[a].get("step_us", 0))[:4]:
+            b = accounts[name]
+            lines.append(
+                f"   @{name:<14} seqs {int(b.get('seqs', 0)):>4}  "
+                f"tok {int(b.get('tokens', 0)):>6}  "
+                f"step {b.get('step_us', 0) / 1e3:>8.1f}ms  "
+                f"kv {b.get('kv_byte_s', 0):>8.1f}B·s  "
+                f"preempt {int(b.get('preempts', 0))}  "
+                f"mig {int(b.get('migrations', 0))}")
     return "\n".join(lines)
 
 
@@ -248,9 +293,10 @@ def main(argv=None) -> int:
         stalls = fetch_stalls(args.target)
         wf = fetch_waterfall(args.target)
         slo = fetch_slo(args.target)
+        seq = fetch_seq(args.target)
         now = time.monotonic()
         out = render(cur, prev, now - t_prev, args.target, stalls=stalls,
-                     waterfall=wf, slo=slo)
+                     waterfall=wf, slo=slo, seq=seq)
         if args.once:
             print(out)
             return 0
