@@ -878,3 +878,169 @@ def test_peer_death_mid_rendezvous_releases_region(monkeypatch, platform):
         wedge.set()  # free any straggling sender thread
         srv.stop(grace=0)
         config_mod.set_config(None)
+
+
+# -- reconnect storm (tpurpc-hive, ISSUE 16) ---------------------------------
+
+
+def _p99(xs):
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(len(xs) * 0.99))]
+
+
+@pytest.mark.parametrize("platform", ["TCP", "RDMA_BPEV"])
+def test_reconnect_storm_sheds_and_survivors_recover(monkeypatch, platform):
+    """tpurpc-hive (ISSUE 16): kill the server under live clients, revive
+    it at hard admission saturation, then hit the port with a mass
+    re-dial storm of 2000 dial attempts. The accept gate must SHED each
+    one (cheap close + ACCEPT_SHED flight event) BEFORE any handshake
+    work, no client thread may hang, the surviving clients' post-recovery
+    p99 must be bounded, and the whole episode's flight ring must replay
+    protocol-conformant.
+
+    The 2k-client storm is expressed as 2000 dial attempts from a bounded
+    thread pool so tier-1 stays inside its fd/time budget; the shed path
+    exercised is identical — ``EndpointListener._dispatch`` consulting
+    ``AdmissionGate.connection_pushback_ms`` per accepted socket."""
+    monkeypatch.setenv("GRPC_PLATFORM_TYPE", platform)
+    monkeypatch.setenv("TPURPC_ACCEPT_BURST", "4")  # handshake cap -> 64
+    from tpurpc.obs import flight, metrics
+    from tpurpc.utils import config as config_mod
+
+    config_mod.set_config(None)
+    flight.RECORDER.reset()
+    shed_before = metrics.registry().counter("accept_shed").snapshot()
+
+    srv, port = _echo_server()
+    stop = threading.Event()
+    server_down = threading.Event()
+    recovered = threading.Event()
+    lat_before: list = []
+    lat_after: list = []
+    errors: list = []
+    recovered_at = [float("inf")]
+    payload = b"storm-survivor"
+
+    def _past_grace() -> bool:
+        # calls caught mid-shed surface UNAVAILABLE a beat after the gate
+        # un-wedges; recovery claims start once the re-dials had a chance
+        return (recovered.is_set()
+                and time.monotonic() - recovered_at[0] > 2.0)
+
+    def survivor(idx: int):
+        try:
+            with tps.Channel(f"127.0.0.1:{port}") as ch:
+                mc = ch.unary_unary("/c.S/Echo", tpurpc_native=False)
+                while not stop.is_set():
+                    t0 = time.monotonic()
+                    try:
+                        assert bytes(mc(payload, timeout=30)) == payload
+                    except RpcError:
+                        # the down window (and the shed storm after it) is
+                        # allowed to fail calls; afterwards it is not
+                        if not _past_grace():
+                            time.sleep(0.05)
+                            continue
+                        raise
+                    dt = time.monotonic() - t0
+                    if _past_grace():
+                        lat_after.append(dt)
+                    elif not server_down.is_set():
+                        lat_before.append(dt)
+        except Exception as exc:  # noqa: BLE001 — surfaced via `errors`
+            errors.append((idx, exc))
+
+    survivors = [threading.Thread(target=survivor, args=(i,))
+                 for i in range(16)]
+    wedged = 0
+    gate = None
+    srv2 = None
+    try:
+        [t.start() for t in survivors]
+        deadline = time.monotonic() + 20
+        while len(lat_before) < 64 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert lat_before, "no baseline traffic before the kill"
+
+        server_down.set()
+        srv.stop(grace=0)
+
+        # revive on the SAME port, then storm it before the survivors'
+        # backoff re-dials have drained
+        deadline = time.monotonic() + 20
+        from tpurpc.rpc.server import AdmissionGate
+
+        while srv2 is None and time.monotonic() < deadline:
+            try:
+                srv2 = tps.Server(max_workers=8,
+                                  admission=AdmissionGate(max_inflight=32))
+                srv2.add_method("/c.S/Echo",
+                                tps.unary_unary_rpc_method_handler(
+                                    lambda req, ctx: req))
+                srv2.add_insecure_port(f"127.0.0.1:{port}")
+                srv2.start()
+            except OSError:
+                srv2 = None
+                time.sleep(0.2)
+        assert srv2 is not None, "could not rebind the port"
+
+        # wedge the admission gate at hard saturation — the storm of
+        # reconnecting peers below lands on a server whose RPC plane is
+        # already full, the exact condition the accept-path shed exists
+        # for (each slot owes a release; the finally pays the debt)
+        gate = srv2.admission
+        while gate.try_admit() is None:
+            wedged += 1
+        assert gate.connection_pushback_ms() is not None
+
+        def storm(n: int):
+            for _ in range(n):
+                try:
+                    s = socket.create_connection(("127.0.0.1", port),
+                                                 timeout=5)
+                    s.close()
+                except OSError:
+                    pass
+
+        stormers = [threading.Thread(target=storm, args=(250,))
+                    for _ in range(8)]  # 2000 dials total
+        [t.start() for t in stormers]
+        [t.join(timeout=60) for t in stormers]
+        assert not any(t.is_alive() for t in stormers), "storm dialers hung"
+
+        # storm over: un-wedge the gate and let survivors re-dial
+        for _ in range(wedged):
+            gate.release()
+        wedged = 0
+        recovered_at[0] = time.monotonic()
+        recovered.set()
+        deadline = time.monotonic() + 30
+        while len(lat_after) < 64 and time.monotonic() < deadline:
+            time.sleep(0.05)
+    finally:
+        stop.set()
+        [t.join(timeout=60) for t in survivors]
+        if gate is not None:
+            for _ in range(wedged):
+                gate.release()
+        if srv2 is not None:
+            srv2.stop(grace=0)
+        srv.stop(grace=0)
+        config_mod.set_config(None)
+
+    assert not any(t.is_alive() for t in survivors), "survivor thread hung"
+    assert not errors, errors
+    assert len(lat_after) >= 64, \
+        f"survivors made no progress after the storm ({len(lat_after)} calls)"
+    shed = metrics.registry().counter("accept_shed").snapshot() - shed_before
+    assert shed > 0, "storm never hit the accept-shed path"
+    bound = max(1.5, 20 * _p99(lat_before))
+    p99 = _p99(lat_after)
+    assert p99 <= bound, \
+        f"post-storm p99 {p99 * 1e3:.1f}ms blew the bound {bound * 1e3:.1f}ms"
+    events = flight.snapshot()
+    assert any(e["event"] == "accept-shed" for e in events), \
+        "no ACCEPT_SHED flight event"
+    from tpurpc.analysis import protocol
+
+    assert protocol.check_events(events, strict=False) == []

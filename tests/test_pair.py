@@ -265,6 +265,9 @@ def test_bootstrap_negotiates_waitflag_caps():
         if os.environ.get("TPURPC_RENDEZVOUS", "1").lower() not in (
                 "0", "off", "false"):
             expect.add("rdv")
+        # park (tpurpc-hive, ISSUE 16): always advertised by Python pairs —
+        # maybe_park initiates only against peers that answer the handshake
+        expect.add("park")
         expect = frozenset(expect)
         assert a.peer_caps == expect and b.peer_caps == expect
     finally:
@@ -295,3 +298,175 @@ def test_address_caps_roundtrip_and_legacy_blob():
     legacy = _json.dumps({"tag": "t", "domain": "local", "ring_size": 4096,
                           "ring": "r", "status": "s"}).encode()
     assert P.Address.from_bytes(legacy).caps == frozenset()
+
+
+# -- idle-pair parking (tpurpc-hive, ISSUE 16) --------------------------------
+
+def _pump(a, b):
+    """One unconditional drain of both notify streams."""
+    if b.drain_notifications():
+        b.kick()
+    if a.drain_notifications():
+        a.kick()
+
+
+def _pump_until(a, b, pred, rounds=200):
+    """Drain both notify streams until ``pred()`` holds (or give up)."""
+    for _ in range(rounds):
+        if pred():
+            return True
+        _pump(a, b)
+        time.sleep(0.001)
+    return pred()
+
+
+def _park(a, b):
+    assert a.maybe_park(time.monotonic(), 0.0), "idle pair refused to park"
+    assert _pump_until(a, b, lambda: a._parked or not a._park_pending)
+    return a._parked
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ring_pool():
+    yield
+    P.RingPool.reset()
+
+
+def test_park_releases_rings_and_unpark_restores_traffic():
+    a, b = create_loopback_pair(ring_size=4096)
+    try:
+        base = P.RingPool.get().stats()["free_bytes"]
+        assert _park(a, b)
+        st = P.RingPool.get().stats()
+        # a's recv ring + status page went to the shared pool; the stub
+        # holds no ring memory (the C100K acceptance bound is <=4KiB)
+        assert st["free_bytes"] - base == 4096 + P.STATUS_BYTES
+        assert a.recv_region is None and a.reader is None
+        assert a.resident_bytes_est() <= 4096
+        # peer demand wakes the pair invisibly: first send reports 0 with
+        # the WAKE in flight, the retry lands on the re-armed rings
+        payload = b"wake-traffic" * 8
+        sent = b.send([payload])
+        assert _pump_until(a, b, lambda: not a._parked)
+        deadline = time.monotonic() + 5
+        while sent < len(payload) and time.monotonic() < deadline:
+            _pump(a, b)
+            sent += b.send([payload], sent)
+        got = bytearray()
+        deadline = time.monotonic() + 5
+        while len(got) < len(payload) and time.monotonic() < deadline:
+            if wait_readable(a, timeout=1, discipline="event"):
+                got += a.recv()
+        assert bytes(got) == payload
+        assert a.parked_epochs == 1
+    finally:
+        a.destroy()
+        b.destroy()
+
+
+def test_park_epochs_survive_both_wake_directions():
+    a, b = create_loopback_pair(ring_size=4096)
+    try:
+        for epoch in range(1, 4):
+            assert _park(a, b)
+            if epoch % 2:
+                a.unpark()  # local demand
+            else:
+                b.send([b"x"])  # remote demand
+            assert _pump_until(a, b, lambda: not a._parked
+                               and a.writer is not None
+                               and b.writer is not None)
+            # the fresh rings carry traffic both ways every epoch
+            msg = f"epoch-{epoch}".encode()
+            sent = 0
+            deadline = time.monotonic() + 5
+            while sent < len(msg) and time.monotonic() < deadline:
+                _pump(a, b)
+                sent += b.send([msg], sent)
+            got = bytearray()
+            deadline = time.monotonic() + 5
+            while len(got) < len(msg) and time.monotonic() < deadline:
+                if wait_readable(a, timeout=1, discipline="event"):
+                    got += a.recv()
+            assert bytes(got) == msg
+            a.send([b"ack"])
+            assert wait_readable(b, timeout=5, discipline="event")
+            assert b.recv() == b"ack"
+            assert a.parked_epochs == epoch
+    finally:
+        a.destroy()
+        b.destroy()
+
+
+def test_park_aborts_when_bytes_race_the_ack():
+    """The park-decide vs incoming-byte race: bytes landing between the
+    PARK decision and the peer's window-close must abort the park — the
+    rings (with the payload inside) never enter the shared pool."""
+    a, b = create_loopback_pair(ring_size=4096)
+    try:
+        assert a.maybe_park(time.monotonic(), 0.0)  # PARK sent, not yet seen
+        payload = b"raced-bytes!"
+        assert b.send([payload]) == len(payload)  # lands in a's live ring
+        assert _pump_until(a, b, lambda: not a._park_pending)
+        assert not a._parked, "park must abort with bytes in the ring"
+        assert a.recv() == payload
+        # the retained re-arm restored b's exact write position: the
+        # stream continues uncorrupted
+        assert _pump_until(a, b, lambda: b.writer is not None
+                           and not b._peer_parked)
+        assert b.send([b"after"]) == 5
+        assert wait_readable(a, timeout=5, discipline="event")
+        assert a.recv() == b"after"
+    finally:
+        a.destroy()
+        b.destroy()
+
+
+def test_parked_pair_recv_reads_zero_and_send_unparks():
+    a, b = create_loopback_pair(ring_size=4096)
+    try:
+        assert _park(a, b)
+        assert a.recv() == b""  # parked, not closed — callers keep waiting
+        # a LOCAL send on the parked pair unparks on demand, invisibly
+        sent = a.send([b"local-demand"])
+        assert not a._parked
+        deadline = time.monotonic() + 5
+        while sent < 12 and time.monotonic() < deadline:
+            _pump(a, b)
+            sent += a.send([b"local-demand"], sent)
+        assert wait_readable(b, timeout=5, discipline="event")
+        assert b.recv() == b"local-demand"
+    finally:
+        a.destroy()
+        b.destroy()
+
+
+def test_maintenance_guard_entry_is_retryable_not_a_tripwire():
+    """A send racing a park-protocol handler must get the retryable
+    _ParkBusy (found by schedule exploration), while two CALLER threads
+    colliding still trip the loud AssertionError."""
+    a, b = create_loopback_pair(ring_size=4096)
+    try:
+        with a._send_guard.maintenance():
+            with pytest.raises(P._ParkBusy):
+                a._send_guard.__enter__()
+        with a._send_guard:
+            with pytest.raises(AssertionError, match="concurrent entry"):
+                a._send_guard.__enter__()
+        # and the guard is reusable after both
+        a.send([b"still-works"])
+        assert wait_readable(b, timeout=5, discipline="event")
+        assert b.recv() == b"still-works"
+    finally:
+        a.destroy()
+        b.destroy()
+
+
+def test_destroy_while_parked_forgets_pool_accounting():
+    a, b = create_loopback_pair(ring_size=4096)
+    parked = _park(a, b)
+    a.destroy()
+    b.destroy()
+    assert parked
+    st = P.RingPool.get().stats()
+    assert st["leased_regions"] == 0, "destroy left pool leases dangling"

@@ -113,9 +113,12 @@ def test_write_posts_from_registered_bounce_source(monkeypatch):
     hardware faults on unregistered sources, so the window registers a
     bounce MR at open and stages through it. Proven here by observing the
     bounce registration itself: opening a window adds a second MR (the
-    region's + the bounce), closing the window deregisters it, and writes
-    still land — including from a read-only bytes source (the old
-    from_buffer_copy path is gone; staging handles readonly views)."""
+    region's + the bounce), closing the window parks it in the domain's
+    MR cache (ISSUE 16: registrations are recycled, not deregistered — a
+    second same-class window reuses it), domain close deregisters
+    everything, and writes still land — including from a read-only bytes
+    source (the old from_buffer_copy path is gone; staging handles
+    readonly views)."""
     import ctypes
 
     _build_mock_lib()
@@ -135,10 +138,22 @@ def test_write_posts_from_registered_bounce_source(monkeypatch):
             assert bytes(region.buf[8:29]) == b"writable-view-source!"
         finally:
             win.close()
-        assert lib.tpr_mock_mr_count() == before  # bounce deregistered
+        # close PARKS the bounce registration (no dereg); reopening the
+        # same size class reuses it instead of registering a fresh MR
+        assert lib.tpr_mock_mr_count() == before + 1
+        assert dom.mr_cache.stats()["free_entries"] == 1
+        win2 = dom.open_window(region.handle, 256)
+        try:
+            assert lib.tpr_mock_mr_count() == before + 1  # cache hit
+            assert dom.mr_cache.stats()["hits"] >= 1
+            win2.write(0, b"after-recycle")
+            assert bytes(region.buf[0:13]) == b"after-recycle"
+        finally:
+            win2.close()
     finally:
         region.close()
         dom.close()
+    assert lib.tpr_mock_mr_count() == 0  # domain close drains the cache
 
 
 def test_window_rejects_foreign_and_oversized_handles(monkeypatch):

@@ -151,6 +151,19 @@ note "tpurpc-argus smoke (slo burn-rate -> fleet collector -> bundle)"
 TPURPC_FLIGHT_DUMP="$FLIGHT_DUMPS" python -m tpurpc.tools.argus_smoke \
     || fail=1
 
+# 2g3c) tpurpc-hive scale smoke (ISSUE 16): thousands of parked pairs in
+#      one process (fd-budget capped toward the 5000-pair target) — every
+#      parked pair must shed its rings to the shared RingPool (accounting
+#      balances exactly, <=4KiB resident each), a 64-connection slice must
+#      wake under pipelined traffic with payloads intact and pool bytes
+#      conserved, gauges/counters/flight must agree with ground truth, and
+#      the Poller's idle sweep must park + ownerlessly wake a registered
+#      pair end-to-end. ~3s, no jax. Its flight dump (PAIR_PARK/PAIR_UNPARK
+#      under the `park` machine) feeds the conformance stage below.
+note "tpurpc-hive scale smoke (mass park/unpark, pool conservation)"
+TPURPC_FLIGHT_DUMP="$FLIGHT_DUMPS" python -m tpurpc.tools.scale_smoke \
+    || fail=1
+
 # 2g4) tpurpc-proof protocol conformance (ISSUE 12): every flight dump
 #      the smokes above produced (fleet, rendezvous, cadence, keystone —
 #      every process, subprocesses included) must conform to the declared

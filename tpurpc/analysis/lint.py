@@ -135,6 +135,9 @@ FLIGHT_HOT_MODULES = HOT_LOG_MODULES + (
     # tpurpc-express (ISSUE 9): rendezvous emission sites run per solicited
     # bulk transfer — interned link tags, pure-int args
     os.path.join("tpurpc", "core", "rendezvous.py"),
+    # tpurpc-hive (ISSUE 16): the accept path emits ACCEPT_SHED at storm
+    # rate — one interned listener tag, two precomputed ints, per shed
+    os.path.join("tpurpc", "core", "endpoint.py"),
     # tpurpc-cadence (ISSUE 10): the decode scheduler emits on the step
     # loop — once per device step and at membership edges, but the step
     # cadence can be kHz, so the same discipline applies: interned
@@ -208,7 +211,7 @@ _ALLOW_RE = re.compile(r"#\s*tpr:\s*allow\(([a-z_,\s]+)\)")
 #: unknown names too — a typo'd rule suppresses nothing forever)
 KNOWN_RULES = frozenset({
     "lease", "copy", "lock", "wallclock", "block", "log", "shard",
-    "flight", "stage", "rdv", "kv", "rawlock",
+    "flight", "stage", "rdv", "kv", "rawlock", "ringpool",
 })
 
 #: suppression-audit mode: when True, ``_allowed_rules`` answers empty —
@@ -1141,6 +1144,82 @@ def _check_kv(tree: ast.AST, path: str,
     return out
 
 
+# -- rule: ringpool (shared ring-pool lease pairing, tpurpc-hive ISSUE 16) ----
+
+def _pool_calls(fn: ast.AST, attr: str) -> List[ast.Call]:
+    """Calls ``<something-pool>.<attr>(...)`` — the receiver's source text
+    must mention "pool" (``pool.lease``, ``self._pool.release``,
+    ``RingPool.get().lease``), which keeps the rule off the unrelated
+    ``lease``/``release`` vocabularies (KV leases, RegionLease, reader
+    release)."""
+    out = []
+    for n in ast.walk(fn):
+        if (isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+                and n.func.attr == attr):
+            try:
+                base = ast.unparse(n.func.value)
+            except Exception:
+                base = ""
+            if "pool" in base.lower():
+                out.append(n)
+    return out
+
+
+def _check_ringpool(tree: ast.AST, path: str,
+                    lines: Sequence[str]) -> List[LintViolation]:
+    """A function that leases from a shared ring pool (``pool.lease``)
+    must cover an exception path (except/finally) with ``pool.release``
+    — a leased-and-dropped region strands bytes in the pool's ``leased``
+    accounting forever and, worse, the region itself is gone (the
+    kv/rdv pairing rule, lifted to the C100K ring plane where the leak
+    is the pool the whole fleet parks into). Ownership-transfer sites
+    (the pair adopts the regions in the same lock scope and its
+    ``_release_regions`` owns the return path) carry
+    ``# tpr: allow(ringpool)`` on the lease line."""
+    out = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        leases = [c for c in _pool_calls(fn, "lease")
+                  if _enclosing_fn(c) is fn]
+        if not leases:
+            continue
+        if any("ringpool" in _allowed_rules(lines, c.lineno)
+               for c in leases):
+            continue
+        # both return idioms pair a pool lease: RingPool's
+        # ``pool.release(region)`` and the landing plane's
+        # ``lease.release()`` (RegionLease returns itself to its pool)
+        releases = [c for c in _pool_calls(fn, "release")
+                    if _enclosing_fn(c) is fn]
+        for n in ast.walk(fn):
+            if (isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "release"
+                    and _enclosing_fn(n) is fn):
+                try:
+                    base = ast.unparse(n.func.value)
+                except Exception:
+                    base = ""
+                if "lease" in base.lower():
+                    releases.append(n)
+        covered = [
+            r for r in releases
+            if any(isinstance(anc, ast.ExceptHandler)
+                   for anc in _ancestors(r))
+            or any(isinstance(anc, ast.Try) and r in
+                   [d for s in anc.finalbody for d in ast.walk(s)]
+                   for anc in _ancestors(r))]
+        if not covered:
+            ln = leases[0].lineno
+            out.append(LintViolation(
+                path, ln, leases[0].col_offset, "ringpool",
+                f"{fn.name} leases from a ring pool with no pool.release "
+                "on any exception path (except/finally): a raise between "
+                "lease and adoption strands the region and its "
+                "leased-bytes accounting forever"))
+    return out
+
+
 # -- driver ------------------------------------------------------------------
 
 def lint_source(source: str, path: str,
@@ -1185,6 +1264,7 @@ def lint_source(source: str, path: str,
     out.extend(_check_lease(tree, path, lines))
     out.extend(_check_rdv(tree, path, lines))
     out.extend(_check_kv(tree, path, lines))
+    out.extend(_check_ringpool(tree, path, lines))
     out.extend(_check_rawlock(tree, path, lines))
     out.sort(key=lambda v: (v.path, v.line, v.col))
     return out

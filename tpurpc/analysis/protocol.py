@@ -584,6 +584,35 @@ def _mk_seq_journey() -> Machine:
                  "after retire/leave/detach")
 
 
+def _mk_park() -> Machine:
+    F = _flight
+
+    def token(ev):
+        c = ev.get("code")
+        if c == F.PAIR_PARK:
+            return "park"
+        if c == F.PAIR_UNPARK:
+            return "unpark"
+        return None
+
+    def key(ev):
+        return (ev.get("tag"),)
+
+    # tpurpc-hive (ISSUE 16): one pair's park episodes, keyed per pair
+    # flight tag. PARK is emitted only when the regions actually went to
+    # the RingPool, UNPARK only when fresh rings were leased back — so a
+    # double PARK (regions pooled twice) or an UNPARK with nothing parked
+    # (a lease the accounting would never see returned) are both real
+    # bugs, not telemetry noise. Settled episodes reopen on the next
+    # park (a pair parks many times over its life).
+    return Machine(
+        "pair-park", token, key,
+        openers={"park": "parked"},
+        transitions={("parked", "unpark"): "done"},
+        describe="idle-pair parking episodes per pair: no double-park, "
+                 "unpark only after park")
+
+
 def _mk_slo() -> Machine:
     F = _flight
 
@@ -613,7 +642,7 @@ MACHINES: List[Machine] = [
     _mk_rdv_lease(), _mk_rdv_offer(), _mk_kv_swap(), _mk_migration(),
     _mk_kv_ship(), _mk_gen_step(), _mk_hedge(), _mk_drain(), _mk_subch(),
     _mk_conn(), _mk_ctrl_ring(), _mk_ctrl_stall(), _mk_slo(),
-    _mk_seq_journey(),
+    _mk_seq_journey(), _mk_park(),
 ]
 
 
@@ -779,6 +808,13 @@ def _good_trace() -> List[dict]:
           _ev(F.CTRL_SPIN, tag=8, a1=12, t_ns=next(t)),
           _ev(F.CTRL_STALL_BEGIN, tag=8, a1=64, t_ns=next(t)),
           _ev(F.CTRL_STALL_END, tag=8, t_ns=next(t))]
+    # tpurpc-hive: two park episodes on one pair (park -> unpark, reopen)
+    # and an accept-shed edge (unkeyed by any machine, must stay clean)
+    e += [_ev(F.PAIR_PARK, tag=9, a1=16384, t_ns=next(t)),
+          _ev(F.PAIR_UNPARK, tag=9, a1=16512, a2=1, t_ns=next(t)),
+          _ev(F.PAIR_PARK, tag=9, a1=16384, t_ns=next(t)),
+          _ev(F.PAIR_UNPARK, tag=9, a1=16512, a2=0, t_ns=next(t)),
+          _ev(F.ACCEPT_SHED, tag=9, a1=64, a2=50, t_ns=next(t))]
     # hedging, drain, ejection
     e += [_ev(F.HEDGE_FIRED, tag=6, a1=1, t_ns=next(t)),
           _ev(F.HEDGE_WON, tag=6, a1=0, t_ns=next(t)),
@@ -861,6 +897,15 @@ def machine_mutants() -> Dict[str, List[dict]]:
         "ctrl_stall_end_without_begin": [
             _ev(F.CTRL_ADOPT, tag=8, a1=64, a2=128, t_ns=1),
             _ev(F.CTRL_STALL_END, tag=8, t_ns=2),
+        ],
+        # tpurpc-hive: the pair-park machine's teeth — regions pooled
+        # twice without an intervening unpark
+        "double_park": [
+            _ev(F.PAIR_PARK, tag=9, a1=16384, t_ns=1),
+            _ev(F.PAIR_PARK, tag=9, a1=16384, t_ns=2),
+        ],
+        "unpark_without_park": [
+            _ev(F.PAIR_UNPARK, tag=9, a1=16512, a2=0, t_ns=1),
         ],
     }
 

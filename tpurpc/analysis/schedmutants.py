@@ -113,6 +113,9 @@ def _scheduler_unlocked_submit(self, prompt, *, max_tokens=32, slo=None):
 # ---------------------------------------------------------------------------
 
 def _rdv_close_no_quarantine(self):
+    from tpurpc.core.rendezvous import window_share
+    from tpurpc.obs import flight as _flight
+
     with self._lock:
         if self.closed:
             return
@@ -122,17 +125,16 @@ def _rdv_close_no_quarantine(self):
         self._req_lease.clear()
         self._pregrants_out.clear()
         self._grants.clear()
-        windows = list(self._windows.values())
+        windows = list(self._windows.items())
         self._windows.clear()
         self._window_order = []
         self._cond.notify_all()
     for lease in leases:
+        _flight.emit(_flight.RDV_RELEASE, self._ftag,
+                     lease.lease_id, 0)
         lease.release(discard=False)  # MUTANT: quarantine skipped
-    for win in windows:
-        try:
-            win.close()
-        except Exception:
-            pass
+    for (kind, handle), win in windows:
+        window_share().release(kind, handle, win)
 
 
 # ---------------------------------------------------------------------------
@@ -180,17 +182,78 @@ def _kv_free_unlocked(self, kv, cache_prefix=False):
         self._free.append(b)
 
 
+# ---------------------------------------------------------------------------
+# park_lost_wakeup — Pair._complete_park with the post-ack readable()/
+# has_message() re-check REMOVED: a byte that lands between our park
+# decision and the peer's window-close+ack is stranded when the reader
+# and rings are released to the pool (the park-decide vs incoming-byte
+# race the re-check exists for).
+# ---------------------------------------------------------------------------
+
+def _park_lost_wakeup(self):
+    from tpurpc.core.pair import PairState, RingPool
+    from tpurpc.core.pair import _flight, _stats, trace_ring
+
+    released = 0
+    aborted = False
+    with self._park_lock:
+        if not self._park_pending:
+            return
+        self._park_pending = False
+        if self.state is not PairState.CONNECTED:
+            return
+        try:
+            # _recv_guard RAISES on concurrent entry: a receiver mid-
+            # drain means the pair is not idle — abort, don't block
+            with self._recv_guard:
+                # MUTANT: the readable()/has_message() re-check is gone —
+                # bytes that landed between the park decision and the
+                # peer's ack are stranded when the reader is released
+                pool = RingPool.get()
+                if self.reader is not None:
+                    self.reader.release()
+                    self.reader = None
+                self._status_np = None
+                for attr in ("recv_region", "status_region"):
+                    region = getattr(self, attr)
+                    if region is not None:
+                        setattr(self, attr, None)
+                        try:
+                            released += len(region.buf)
+                        except ValueError:
+                            pass
+                        pool.release(region)
+                self._published_head_mirror = 0
+                self._parked = True
+                self.parked_epochs += 1
+        except AssertionError:
+            aborted = True
+    if aborted:
+        self._send_rearm(retained=True)
+        self.kick()
+        return
+    _flight.emit(_flight.PAIR_PARK, self._ftag, released)
+    _stats.counter_inc("pair_park")
+    from tpurpc.core.poller import Poller
+
+    Poller.note_parked(self)
+    trace_ring.log("pair %s parked (%d ring bytes pooled)",
+                   self.tag, released)
+
+
 def _targets():
     from tpurpc.core.handoff import HandoffRing
+    from tpurpc.core.pair import Pair
     from tpurpc.core.rendezvous import RdvLink
     from tpurpc.serving.kv import KvBlockManager
     from tpurpc.serving.scheduler import DecodeScheduler
 
-    return HandoffRing, DecodeScheduler, RdvLink, KvBlockManager
+    return HandoffRing, DecodeScheduler, RdvLink, KvBlockManager, Pair
 
 
 def _build() -> Dict[str, Mutant]:
-    HandoffRing, DecodeScheduler, RdvLink, KvBlockManager = _targets()
+    (HandoffRing, DecodeScheduler, RdvLink, KvBlockManager,
+     Pair) = _targets()
     muts = [
         Mutant("handoff_publish_before_store", "handoff-mpmc",
                HandoffRing, "publish", _handoff_publish_before_store,
@@ -208,6 +271,10 @@ def _build() -> Dict[str, Mutant]:
                KvBlockManager, "free_blocks", _kv_free_unlocked,
                "unlocked refcount decrement races an eviction: a lost "
                "update strands arena blocks forever"),
+        Mutant("park_lost_wakeup", "pair-park",
+               Pair, "_complete_park", _park_lost_wakeup,
+               "park completion skips the readable re-check: a byte that "
+               "raced the park decision is stranded in a pooled ring"),
     ]
     return {m.name: m for m in muts}
 

@@ -919,7 +919,9 @@ def _rendezvous_scenario() -> Scenario:
         def lease_local(nbytes, kinds):
             if not kinds or "local" not in kinds:
                 return None
-            return pool.lease(nbytes, next(b._lease_ids))
+            # ownership transfers by return (the link registers it)
+            return pool.lease(nbytes,  # tpr: allow(ringpool)
+                              next(b._lease_ids))
 
         b._lease_for = lease_local
         a.negotiated = True
@@ -1045,6 +1047,80 @@ def _kv_scenario() -> Scenario:
         teardown=teardown)
 
 
+def _park_scenario() -> Scenario:
+    """The live ``Pair`` park handshake racing an incoming send — the
+    park-decide vs incoming-byte race ``_complete_park``'s post-ack
+    re-check exists for (tpurpc-hive).  One thread initiates a park on
+    an idle pair A and pumps the notify handshake to completion; the
+    other pushes a payload from B into A's ring.  Invariant: whatever
+    the interleaving (park aborted, parked-then-woken, NACKed), the
+    payload is retrievable at A afterwards — a byte stranded in a ring
+    that went back to the shared pool is the violation."""
+    import tpurpc.core.pair as _pair
+
+    def setup(sched: _Scheduler):
+        a, b = _pair.create_loopback_pair(ring_size=1 << 14)
+        payload = b"\xa5hive-park-race!" * 4
+        return {"a": a, "b": b, "payload": payload, "sent": [0]}
+
+    def parker(state):
+        a, b = state["a"], state["b"]
+        # decide to park (idle right now), then pump both notify streams
+        # so the handshake progresses: B handles "p" (window close +
+        # ack), A handles "q" (_complete_park — the racy completion)
+        a.maybe_park(time.monotonic(), 0.0)
+        if b.drain_notifications():
+            b.kick()
+        if a.drain_notifications():
+            a.kick()
+
+    def sender(state):
+        state["sent"][0] = state["b"].send([state["payload"]])
+
+    def check(state):
+        a, b, payload = state["a"], state["b"], state["payload"]
+        got = bytearray()
+        # drive the episode to quiescence: every LEGAL end-state must
+        # surface the payload (abort kept the rings; a wake/unpark
+        # re-armed them; a NACK never parked at all)
+        for _ in range(64):
+            if b.drain_notifications():
+                b.kick()
+            if a.drain_notifications():
+                a.kick()
+            if state["sent"][0] < len(payload):
+                state["sent"][0] += b.send([payload], state["sent"][0])
+                continue
+            if a._parked:
+                a.unpark()
+                continue
+            if a.readable() or a.has_message():
+                got += a.recv()
+            if bytes(got) == payload:
+                break
+        if bytes(got) != payload:
+            raise SchedViolation(
+                "park lost the race payload: "
+                f"{len(got)}/{len(payload)} bytes recovered "
+                f"(parked={a._parked}, pending={a._park_pending}) — a "
+                "byte that landed between the park decision and the "
+                "peer's ack was stranded in a ring released to the pool")
+
+    def teardown(state):
+        try:
+            state["a"].destroy()
+            state["b"].destroy()
+        except Exception:
+            pass
+        _pair.RingPool.reset()
+
+    return Scenario(
+        "pair-park",
+        setup, [parker, sender], check,
+        instrument=[_module_file(_pair), _mutants_file()],
+        teardown=teardown, max_steps=200000)
+
+
 def _mutants_file() -> str:
     from tpurpc.analysis import schedmutants
 
@@ -1057,6 +1133,7 @@ SCENARIOS: Dict[str, Callable[[], Scenario]] = {
     "scheduler-admission": _scheduler_scenario,
     "rendezvous-death": _rendezvous_scenario,
     "kv-refcount": _kv_scenario,
+    "pair-park": _park_scenario,
 }
 
 

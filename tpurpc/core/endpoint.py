@@ -39,7 +39,9 @@ from typing import Callable, Optional, Tuple
 
 from tpurpc.core.pair import Pair, PairState
 from tpurpc.core.poller import PairPool, Poller, wait_readable, wait_writable
+from tpurpc.obs import flight as _flight
 from tpurpc.obs import lens as _lens
+from tpurpc.obs import metrics as _metrics
 from tpurpc.obs import profiler as _profiler
 from tpurpc.utils.config import Platform, get_config
 from tpurpc.utils.trace import trace_endpoint
@@ -768,15 +770,38 @@ def connect_endpoint(host: str, port: int,
     return create_endpoint(sock, is_server=False, pool_key=f"{host}:{port}")
 
 
+#: connections closed at the accept gate before any handshake work
+_ACCEPT_SHED = _metrics.counter("accept_shed")
+
+
 class EndpointListener:
-    """Accept loop feeding the factory (``tcp_server_posix.cc:267``)."""
+    """Accept loop feeding the factory (``tcp_server_posix.cc:267``).
+
+    ISSUE 16 accept-storm hardening: a reconnect storm after a shard
+    death lands the whole listen backlog at once. Two defenses, both
+    BEFORE any handshake work is spent on a connection:
+
+    * **bounded burst draining** — each accept-loop turn drains up to
+      ``TPURPC_ACCEPT_BURST`` queued connections in one sweep (one
+      blocking accept, then non-blocking accepts) instead of one per
+      0.2 s loop turn, so the backlog clears in O(backlog/burst) sweeps
+      while ``close()`` stays responsive;
+    * **admission pushback** — an optional ``admission()`` probe
+      (``None`` = admit, int = pushback ms — the RPC server wires its
+      :class:`~tpurpc.rpc.server.AdmissionGate`'s connection-level face
+      here) is consulted per accepted socket, and the count of in-flight
+      bootstrap handshakes is bounded, so a storm sheds with a cheap
+      close + ``ACCEPT_SHED`` flight event instead of a thousand
+      concurrent handshakes starving live traffic.
+    """
 
     def __init__(self, host: str, port: int,
                  on_endpoint: Callable[[Endpoint], None],
                  ready: "Optional[threading.Event]" = None,
                  ssl_context=None,
                  raw_hook: "Optional[Callable[[socket.socket], bool]]" = None,
-                 reuseport: bool = False):
+                 reuseport: bool = False,
+                 admission: "Optional[Callable[[], Optional[int]]]" = None):
         #: pre-endpoint interception seam: called with the RAW accepted
         #: socket (plaintext listeners only); returning True means the hook
         #: took ownership (the native-server adoption path,
@@ -797,6 +822,14 @@ class EndpointListener:
         self._sock.listen(128)
         self.port = self._sock.getsockname()[1]
         self._on_endpoint = on_endpoint
+        self._admission = admission
+        self._burst = max(1, get_config().accept_burst)
+        #: in-flight bootstrap handshakes — bounded so a storm cannot
+        #: spawn unbounded handshake threads; guarded by _handshakes_mu
+        self._handshakes = 0
+        self._max_handshakes = max(self._burst * 4, 64)
+        self._handshakes_mu = threading.Lock()
+        self._ftag = _flight.tag_for(f"accept:{self.port}")
         # grpcio semantics: the port is bound (connects land in the listen
         # backlog) but nothing is accepted until the server starts — otherwise
         # an early client could race method registration into UNIMPLEMENTED.
@@ -828,14 +861,69 @@ class EndpointListener:
                 trace_endpoint.log("accept failed (%s); continuing", exc)
                 time.sleep(0.05)
                 continue
-            # Bootstrap off the accept thread: a ring handshake blocks (bounded
-            # by BOOTSTRAP_TIMEOUT_S), and one silent client must not stall
-            # every other accept behind it.
-            threading.Thread(target=self._bootstrap, args=(sock, addr),
-                             daemon=True,
-                             name=f"tpurpc-bootstrap-{self.port}").start()
+            # Bounded burst drain: the rest of the backlog is sitting in
+            # the kernel queue right now — take up to accept_burst of it
+            # in this sweep rather than one connection per loop turn.
+            batch = [(sock, addr)]
+            self._sock.settimeout(0)
+            try:
+                while len(batch) < self._burst and not self._stopped:
+                    try:
+                        s2, a2 = self._sock.accept()
+                    except (BlockingIOError, socket.timeout):
+                        break
+                    except OSError:
+                        break
+                    s2.settimeout(None)
+                    batch.append((s2, a2))
+            finally:
+                self._sock.settimeout(0.2)
+            for s, a in batch:
+                self._dispatch(s, a)
+
+    def _dispatch(self, sock: socket.socket, addr) -> None:
+        """Admission gate, then bootstrap off the accept thread: a ring
+        handshake blocks (bounded by BOOTSTRAP_TIMEOUT_S), and one silent
+        client must not stall every other accept behind it. Shedding
+        happens HERE — before TLS, before the protocol sniff, before any
+        endpoint state — so an overloaded server's cost per stormed
+        connection is one accept + one close."""
+        pushback = None
+        if self._admission is not None:
+            try:
+                pushback = self._admission()
+            except Exception:
+                pushback = None  # a broken probe never sheds
+        with self._handshakes_mu:
+            inflight = self._handshakes
+            if pushback is None and inflight >= self._max_handshakes:
+                # the handshake plane itself is the bottleneck: shed with
+                # a nominal pushback rather than queue threads unboundedly
+                pushback = 50
+            if pushback is None:
+                self._handshakes = inflight + 1
+        if pushback is not None:
+            pushback = int(pushback)
+            _ACCEPT_SHED.inc()
+            _flight.emit(_flight.ACCEPT_SHED, self._ftag, inflight,
+                         pushback)
+            try:
+                sock.close()
+            except OSError:
+                pass
+            return
+        threading.Thread(target=self._bootstrap, args=(sock, addr),
+                         daemon=True,
+                         name=f"tpurpc-bootstrap-{self.port}").start()
 
     def _bootstrap(self, sock: socket.socket, addr) -> None:
+        try:
+            self._bootstrap_inner(sock, addr)
+        finally:
+            with self._handshakes_mu:
+                self._handshakes = max(0, self._handshakes - 1)
+
+    def _bootstrap_inner(self, sock: socket.socket, addr) -> None:
         if self._raw_hook is not None and self._ssl_context is None:
             try:
                 if self._raw_hook(sock):

@@ -76,6 +76,14 @@ _PAIRS_MSG_WAITING = _metrics.fleet(
     "pairs_msg_waiting",
     lambda p: 1.0 if (p.state.name == "CONNECTED" and p.has_message())
     else 0.0)
+# tpurpc-hive (ISSUE 16): the connection-scale plane. A parked pair holds
+# no ring regions and no poller slot — just the notify socket and a stub —
+# and the per-connection resident estimate is what the C100K bench curves
+# report per ramp stage.
+_PAIRS_PARKED = _metrics.fleet(
+    "pairs_parked", lambda p: 1.0 if p._parked else 0.0)
+_PAIR_RESIDENT = _metrics.fleet(
+    "pair_resident_bytes_est", lambda p: float(p.resident_bytes_est()))
 from tpurpc.utils.trace import trace_ring
 
 # tpurpc-lens (ISSUE 8): the `wire` waterfall hop is the transport
@@ -344,6 +352,161 @@ def make_domain(kind: str) -> MemoryDomain:
 
 
 # ---------------------------------------------------------------------------
+# Shared ring-region pool (tpurpc-hive, ISSUE 16).
+# ---------------------------------------------------------------------------
+
+_POOL_LEASED_BYTES = _metrics.gauge("ring_pool_leased_bytes")
+_POOL_FREE_BYTES = _metrics.gauge("ring_pool_free_bytes")
+
+
+class RingPool:
+    """Process-wide free list of ring/status regions keyed by
+    ``(domain kind, byte size)`` — the RDMAvisor-style shared resource pool
+    that lets 50k mostly-idle pairs multiplex O(size-classes) ring
+    allocations instead of pinning one ring each.
+
+    Safety invariant: a region may enter the free list ONLY once no peer
+    window onto it can still write.  ``Pair.init`` forbids region reuse
+    within a connection exactly because a stale one-sided writer could land
+    bytes in the next tenant's ring; the park handshake's ACK (the peer
+    confirming it closed its windows) is the proof that makes cross-pair
+    reuse safe here.  Free regions are zeroed before shelving so a fresh
+    :class:`~tpurpc.core.ring.RingReader` can never misparse a previous
+    tenant's frame headers as live messages.
+
+    Only plain host-memory domains are pooled; device/NIC-bound regions
+    (verbs QPs, tcp_window applier bindings) pass through to alloc/close so
+    their peer-specific state is never handed to a different pair.
+    """
+
+    _instance: "Optional[RingPool]" = None
+    _instance_lock = make_lock("RingPool._instance_lock")
+
+    #: lock map, checked by `python -m tpurpc.analysis` (lint rule `lock`)
+    _GUARDED_BY = {"_free": "_lock", "_free_bytes": "_lock",
+                   "_out": "_lock", "_instance": "_instance_lock"}
+
+    _POOLABLE = frozenset({"local", "shm"})
+    _MAX_FREE_BYTES = 256 << 20
+    _MAX_FREE_PER_CLASS = 4096
+
+    @classmethod
+    def get(cls) -> "RingPool":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = RingPool()
+            return cls._instance
+
+    @classmethod
+    def reset(cls) -> None:
+        with cls._instance_lock:
+            inst, cls._instance = cls._instance, None
+        if inst is not None:
+            inst.drain()
+
+    def __init__(self):
+        self._free: Dict[Tuple[str, int], List[Region]] = {}
+        self._free_bytes = 0
+        #: id(region) -> nbytes for regions handed out by lease() — release
+        #: of a region the pool never leased (a pair's original init()
+        #: allocation entering the pool at first park) must not drive the
+        #: leased gauge negative
+        self._out: Dict[int, int] = {}
+        self._lock = make_lock("RingPool._lock")
+
+    def lease(self, domain: MemoryDomain, nbytes: int) -> Region:
+        """Hand out a writer-free region of exactly ``nbytes`` — recycled
+        from the free list when the size class has one, freshly allocated
+        otherwise.  Callers MUST pair every lease with a :meth:`release` on
+        their failure paths (lint rule ``ringpool``)."""
+        key = (domain.kind, nbytes)
+        region = None
+        with self._lock:
+            bucket = self._free.get(key)
+            if bucket:
+                region = bucket.pop()
+                self._free_bytes -= nbytes
+        if region is None:
+            region = domain.alloc(nbytes)
+            _stats.counter_inc("ring_pool_alloc")
+        else:
+            _stats.counter_inc("ring_pool_hit")
+        with self._lock:
+            self._out[id(region)] = nbytes
+            _POOL_LEASED_BYTES.set(float(sum(self._out.values())))
+            _POOL_FREE_BYTES.set(float(self._free_bytes))
+        return region
+
+    def release(self, region: Optional[Region]) -> None:
+        """Return a region to the free list (or close it when the domain
+        isn't poolable / the list is full).  The caller asserts the pool
+        invariant: no peer window onto this region can still write."""
+        if region is None:
+            return
+        region.on_write = None
+        try:
+            nbytes = len(region.buf)
+        except ValueError:
+            nbytes = 0  # already released; nothing to pool
+        kind = region.handle.split(":", 1)[0]
+        with self._lock:
+            self._out.pop(id(region), None)
+            poolable = (nbytes > 0 and kind in self._POOLABLE
+                        and self._free_bytes + nbytes <= self._MAX_FREE_BYTES
+                        and len(self._free.get((kind, nbytes), ()))
+                        < self._MAX_FREE_PER_CLASS)
+        if poolable:
+            try:
+                # zero before shelving: the next tenant's reader starts at
+                # head 0 and must never see this tenant's frame headers
+                np.frombuffer(region.buf, dtype=np.uint8).fill(0)
+            except (ValueError, TypeError):
+                poolable = False
+        if not poolable:
+            try:
+                region.close()
+            except Exception:
+                pass
+            with self._lock:
+                _POOL_LEASED_BYTES.set(float(sum(self._out.values())))
+            return
+        with self._lock:
+            self._free.setdefault((kind, nbytes), []).append(region)
+            self._free_bytes += nbytes
+            _POOL_LEASED_BYTES.set(float(sum(self._out.values())))
+            _POOL_FREE_BYTES.set(float(self._free_bytes))
+
+    def forget(self, region: Optional[Region]) -> None:
+        """Drop lease accounting for a region its owner is closing directly
+        — teardown paths where the region must NOT re-enter the free list
+        (no peer window-close ack exists, so the pool invariant is unproven)."""
+        if region is None:
+            return
+        with self._lock:
+            if self._out.pop(id(region), None) is not None:
+                _POOL_LEASED_BYTES.set(float(sum(self._out.values())))
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"free_bytes": self._free_bytes,
+                    "free_regions": sum(len(b) for b in self._free.values()),
+                    "leased_bytes": sum(self._out.values()),
+                    "leased_regions": len(self._out)}
+
+    def drain(self) -> None:
+        with self._lock:
+            regions = [r for b in self._free.values() for r in b]
+            self._free.clear()
+            self._free_bytes = 0
+            _POOL_FREE_BYTES.set(0.0)
+        for r in regions:
+            try:
+                r.close()
+            except Exception:
+                pass
+
+
+# ---------------------------------------------------------------------------
 # Address: what gets exchanged at bootstrap.
 # ---------------------------------------------------------------------------
 
@@ -450,28 +613,85 @@ def peek_protocol(sock: socket.socket, timeout: float = BOOTSTRAP_TIMEOUT_S
 NOTIFY_DATA = b"d"
 NOTIFY_CREDIT = b"c"
 NOTIFY_EXIT = b"x"
+#: tpurpc-hive park-protocol tokens (same stream; see Pair.maybe_park).
+#: PARK asks the peer to close its one-sided windows into our regions and
+#: answer ACK — only that ack proves no stale writer remains, which is THE
+#: invariant letting the regions enter the shared RingPool despite init()'s
+#: always-fresh rule. NACK aborts (peer mid-send). WAKE asks a parked peer
+#: to re-arm because we have bytes for it; REARM prefixes a framed Address
+#: blob advertising fresh (or, on a park abort, retained) rings.
+NOTIFY_PARK = b"p"
+NOTIFY_PARK_ACK = b"q"
+NOTIFY_PARK_NACK = b"n"
+NOTIFY_WAKE = b"w"
+#: "r" = re-arm onto FRESHLY LEASED rings (unpark): the peer builds a writer
+#: at position zero. "R" = re-arm onto RETAINED rings (park abort / repair):
+#: the peer restores its snapshotted writer position. The distinction must
+#: ride the frame itself — the RingPool can hand the SAME region straight
+#: back to the same pair, so handle identity cannot tell a fresh lease from
+#: retained rings (observed: a recycled handle made the peer restore a stale
+#: tail against a zeroed ring, black-holing the first post-unpark send).
+NOTIFY_REARM = b"r"
+NOTIFY_REARM_KEEP = b"R"
+_CLASSIC_TOKENS = b"dcx"
+
+
+class _ParkBusy(Exception):
+    """Raised inside the send guard when a park episode owns the write side.
+    Internal control flow only: ``Pair.send`` catches it, resolves the episode
+    OUTSIDE the guard (strict lock order: _park_lock before _send_guard), and
+    retries — callers never see it."""
 
 
 class ContentAssertion:
     """Single-entrant tripwire on send/recv, like the reference's reentrancy guard
     (``pair.h:64-81``): two threads inside Send (or Recv) concurrently is a caller bug
-    we want to explode loudly, not corrupt a ring."""
+    we want to explode loudly, not corrupt a ring.
+
+    The park protocol's handlers (window close, re-arm, park initiation) also
+    need the guard — they mutate the same side — but they run on the DRAIN or
+    poller thread, not the caller's: a legitimate send/recv racing one of them
+    is NOT a caller bug.  ``maintenance()`` entry marks the occupancy so the
+    regular entry raises the retryable :class:`_ParkBusy` instead of the
+    tripwire (found by schedule exploration: a sender crashed with the
+    concurrent-entry AssertionError while the peer's park request was being
+    handled)."""
 
     def __init__(self, name: str):
         self._name = name
         self._flag = False
+        self._maint = False
         self._lock = make_lock(f"ContentAssertion[{name}]._lock")
 
     def __enter__(self):
         with self._lock:
             if self._flag:
+                if self._maint:
+                    raise _ParkBusy
                 raise AssertionError(f"concurrent entry into {self._name}")
             self._flag = True
 
     def __exit__(self, *exc):
         with self._lock:
             self._flag = False
+            self._maint = False
         return False
+
+    @contextlib.contextmanager
+    def maintenance(self):
+        """Guard entry for a park-protocol handler: excludes an in-flight
+        send/recv exactly like regular entry (AssertionError on conflict —
+        the handler aborts or NACKs), but marks the hold so a racing
+        REGULAR entrant gets the retryable :class:`_ParkBusy`."""
+        with self._lock:
+            if self._flag:
+                raise AssertionError(f"concurrent entry into {self._name}")
+            self._flag = True
+            self._maint = True
+        try:
+            yield self
+        finally:
+            self.__exit__()
 
 
 class Pair:
@@ -528,8 +748,32 @@ class Pair:
         self.total_sent = 0
         self.total_recv = 0
 
-        # serializes notify-socket writes
+        # serializes notify-socket writes (single-byte tokens AND the
+        # multi-byte re-arm frame — an interleaved token inside a frame
+        # would corrupt the peer's stream parser)
         self._notify_lock = make_lock("Pair._notify_lock")
+        # tpurpc-hive (ISSUE 16): idle-pair parking. Lock order where both
+        # are held: _park_lock BEFORE _send_guard (the park-request handler
+        # takes them in that order; send paths check the park flags inside
+        # the guard and RETRY outside it, never acquiring _park_lock under
+        # the guard).
+        self._park_lock = make_lock("Pair._park_lock")
+        #: serializes drain_notifications end to end so the park-protocol
+        #: parser sees the token stream in order (two waiters recv'ing
+        #: concurrently would otherwise interleave a framed re-arm blob)
+        self._drain_mu = make_lock("Pair._drain_mu")
+        self._parked = False          # own regions pooled; ~stub remains
+        self._park_pending = False    # PARK sent, ack/nack not yet seen
+        self._park_sent_at = 0.0
+        self._peer_parked = False     # peer's regions gone; writer is None
+        #: (peer ring handle, tail, seq, remote_head) snapshot taken when a
+        #: peer's PARK closes our writer — restored verbatim if the peer
+        #: aborts the park and re-arms with the SAME rings
+        self._saved_wstate: Optional[Tuple[str, int, int, int]] = None
+        self._peer_ring_handle = ""
+        self._notify_buf = b""        # partial re-arm frame reassembly
+        self.last_activity = time.monotonic()
+        self.parked_epochs = 0        # completed park->unpark round trips
         #: tpurpc-blackbox: interned flight-recorder tag (ints on the hot
         #: path) + open credit-starvation edge + adaptive-poll mode, all
         #: edge-triggered so a healthy pair emits nothing per message
@@ -539,6 +783,8 @@ class Pair:
         _PAIRS_CONNECTED.track(self)
         _PAIRS_WRITE_STALLED.track(self)
         _PAIRS_MSG_WAITING.track(self)
+        _PAIRS_PARKED.track(self)
+        _PAIR_RESIDENT.track(self)
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -568,6 +814,13 @@ class Pair:
         self.error = None
         self.want_write = False
         self.activity_ewma = 1.0  # recycled pairs start hot like fresh ones
+        # hive park state never survives a re-init (fresh connection)
+        self._parked = False
+        self._park_pending = False
+        self._peer_parked = False
+        self._saved_wstate = None
+        self._notify_buf = b""
+        self.last_activity = time.monotonic()
         for role in ("read", "write"):
             r, w = os.pipe()
             os.set_blocking(r, False)
@@ -588,6 +841,12 @@ class Pair:
         if os.environ.get("TPURPC_RENDEZVOUS", "1").lower() not in (
                 "0", "off", "false"):
             caps.append("rdv")
+        # tpurpc-hive (ISSUE 16): park is a two-sided protocol — the peer
+        # must ack the window-close and honor WAKE/REARM. Advertise it so
+        # maybe_park never initiates against a peer that cannot answer
+        # (the native C loop bootstraps its own Address without this cap;
+        # a park request to it would retry forever and never complete).
+        caps.append("park")
         return Address(self.tag, self.domain.kind, self.ring_size,
                        self.recv_region.handle, self.status_region.handle,
                        caps=caps)
@@ -632,6 +891,7 @@ class Pair:
         # rings — the writer just honors the peer's capacity.
         self._peer_ring = self.domain.open_window(peer.ring_handle, peer.ring_size)
         self._peer_status = self.domain.open_window(peer.status_handle, STATUS_BYTES)
+        self._peer_ring_handle = peer.ring_handle
         self.peer_caps = peer.caps
         self.writer = RingWriter(peer.ring_size, self._peer_ring.write,
                                  mapped=self._peer_ring.view)
@@ -730,16 +990,13 @@ class Pair:
         if sock is None:
             return
         try:
-            if hasattr(sock, "pending"):
-                # TLS: OpenSSL forbids concurrent use of one SSL* — an
-                # unlocked send racing drain_notifications' recv corrupts
-                # the record stream (the TcpEndpoint fix, same UB; observed
-                # as 'notify channel read failed' on BOTH peers under load
-                # once tcp_window's unconditional tokens raised the race
-                # frequency). Plain sockets need no lock.
-                with self._notify_lock:
-                    sock.send(token)
-            else:
+            # Always locked since tpurpc-hive: the notify stream now also
+            # carries multi-byte re-arm frames (_send_frame), and a token
+            # landing INSIDE a frame corrupts the peer's parser. (TLS needed
+            # the lock anyway — OpenSSL forbids concurrent use of one SSL*,
+            # the TcpEndpoint fix.) Single-byte sends can't partially
+            # complete, so a dropped token under EAGAIN stays best-effort.
+            with self._notify_lock:
                 sock.send(token)
         except (ssl.SSLWantWriteError, ssl.SSLWantReadError):
             pass  # TLS record stalled mid-flight; same as a saturated channel
@@ -754,10 +1011,55 @@ class Pair:
             # for whatever data was still draining.
             pass
 
+    def _send_frame(self, payload: bytes, timeout_s: float = 5.0) -> bool:
+        """Ship a multi-byte park-protocol frame over the notify stream,
+        contiguously (the lock excludes token sends) and completely (the
+        socket is non-blocking; a PARTIAL frame would corrupt the peer's
+        parser, so retry to a bounded deadline instead of dropping)."""
+        import select as _select
+
+        sock = self.notify_sock
+        if sock is None:
+            return False
+        deadline = time.monotonic() + timeout_s
+        sent = 0
+        with self._notify_lock:
+            while sent < len(payload):
+                try:
+                    sent += sock.send(payload[sent:])
+                except (BlockingIOError, InterruptedError,
+                        ssl.SSLWantWriteError, ssl.SSLWantReadError):
+                    if time.monotonic() >= deadline:
+                        return False
+                    try:
+                        _select.select([], [sock.fileno()], [], 0.05)
+                    except (OSError, ValueError):
+                        return False
+                except OSError:
+                    return False
+        return True
+
     def drain_notifications(self) -> bytes:
         """Non-blocking drain of the peer-event channel; returns the tokens seen.
         An empty-read (peer closed) flips the pair to ERROR, the moral equivalent of
-        the reference's TCP-fd zero-byte liveness probe (``rdma_conn.h:90-99``)."""
+        the reference's TCP-fd zero-byte liveness probe (``rdma_conn.h:90-99``).
+
+        Serialized end to end (``_drain_mu``) since tpurpc-hive: the stream
+        now carries park-protocol bytes and framed re-arm blobs whose parse
+        requires seeing the bytes in order — two waiters recv'ing
+        concurrently would interleave a split frame.  Park-protocol bytes
+        are acted on here and stripped; callers see only the classic
+        data/credit/exit tokens."""
+        with self._drain_mu:
+            raw = self._drain_raw()
+            if not raw and not self._notify_buf:
+                return raw
+            if not self._notify_buf and not raw.translate(None,
+                                                          _CLASSIC_TOKENS):
+                return raw  # fast path: classic tokens only
+            return self._fold_park_tokens(raw)
+
+    def _drain_raw(self) -> bytes:
         sock = self.notify_sock
         if sock is None:
             return b""
@@ -791,6 +1093,341 @@ class Pair:
             if len(chunk) < 65536:
                 break  # drained; skip the guaranteed-EAGAIN second recv
         return out
+
+    # -- idle-pair parking (tpurpc-hive, ISSUE 16) ----------------------------
+
+    def _fold_park_tokens(self, raw: bytes) -> bytes:
+        """Act on and strip park-protocol bytes; return the classic tokens.
+        Caller holds ``_drain_mu`` (stream order).  A re-arm frame split
+        across recv chunks is stashed in ``_notify_buf`` until complete —
+        the sender shipped it atomically, so the rest is already in flight."""
+        data = self._notify_buf + raw
+        self._notify_buf = b""
+        out = bytearray()
+        i = 0
+        n = len(data)
+        while i < n:
+            tok = data[i:i + 1]
+            if tok == NOTIFY_PARK:
+                i += 1
+                self._handle_park_request()
+            elif tok == NOTIFY_PARK_ACK:
+                i += 1
+                self._complete_park()
+            elif tok == NOTIFY_PARK_NACK:
+                i += 1
+                with self._park_lock:
+                    self._park_pending = False
+            elif tok == NOTIFY_WAKE:
+                i += 1
+                self._handle_wake_request()
+            elif tok in (NOTIFY_REARM, NOTIFY_REARM_KEEP):
+                frame = data[i + 1:]
+                if len(frame) < 8:
+                    self._notify_buf = data[i:]
+                    break
+                if frame[:4] != _BOOTSTRAP_MAGIC:
+                    self._mark_error("corrupt re-arm frame on notify stream")
+                    break
+                blen = struct.unpack("<I", frame[4:8])[0]
+                if blen > _MAX_BLOB:
+                    self._mark_error("re-arm frame implausibly large")
+                    break
+                if len(frame) < 8 + blen:
+                    self._notify_buf = data[i:]
+                    break
+                self._handle_rearm(frame[8:8 + blen],
+                                   retained=(tok == NOTIFY_REARM_KEEP))
+                i += 1 + 8 + blen
+            else:
+                out += tok
+                i += 1
+        return bytes(out)
+
+    def _handle_park_request(self) -> None:
+        """Peer announced it will park: close our one-sided windows into its
+        regions (after this no stale write of ours can land there — the pool
+        invariant), snapshot the writer position for an abort-restore, ack."""
+        with self._park_lock:
+            if self.state is not PairState.CONNECTED or self.want_write:
+                self._notify(NOTIFY_PARK_NACK)
+                return
+            try:
+                # excludes an in-flight send; a send ENTERING after us gets
+                # the retryable _ParkBusy, not the caller-bug tripwire
+                with self._send_guard.maintenance():
+                    if self.want_write:
+                        self._notify(NOTIFY_PARK_NACK)
+                        return
+                    w = self.writer
+                    if w is not None:
+                        self._saved_wstate = (self._peer_ring_handle, w.tail,
+                                              w.seq, w.remote_head)
+                    self.writer = None
+                    for attr in ("_peer_ring", "_peer_status"):
+                        win = getattr(self, attr)
+                        if win is not None:
+                            setattr(self, attr, None)
+                            self._peer_status_np = None
+                            retry_buffer_op(win.close)
+                    self._peer_parked = True
+            except AssertionError:
+                # a sender is inside send() right now — the pair is not idle
+                self._notify(NOTIFY_PARK_NACK)
+                return
+        self._notify(NOTIFY_PARK_ACK)
+
+    def _complete_park(self) -> None:
+        """Peer acked our park request: its windows into our regions are
+        closed, so they are writer-free — the one condition under which they
+        may enter the shared :class:`RingPool`.  Re-check the ring FIRST:
+        bytes that landed between our park decision and the peer's window
+        close (the park-decide vs incoming-byte race) abort the park."""
+        released = 0
+        aborted = False
+        with self._park_lock:
+            if not self._park_pending:
+                return
+            self._park_pending = False
+            if self.state is not PairState.CONNECTED:
+                return
+            try:
+                # _recv_guard RAISES on concurrent entry: a receiver mid-
+                # drain means the pair is not idle — abort, don't block.
+                # maintenance entry: a receiver racing US retries as empty
+                with self._recv_guard.maintenance():
+                    if self.readable() or self.has_message():
+                        aborted = True
+                    else:
+                        # The wake pipes and waiter selectors SURVIVE the
+                        # park: a waiter asleep on them stays reachable by
+                        # kick() across the whole episode, so unpark can
+                        # never lose its wakeup. Only the rings (the actual
+                        # memory) and the reader go; ~fd-sized stub remains.
+                        pool = RingPool.get()
+                        if self.reader is not None:
+                            self.reader.release()
+                            self.reader = None
+                        self._status_np = None
+                        for attr in ("recv_region", "status_region"):
+                            region = getattr(self, attr)
+                            if region is not None:
+                                setattr(self, attr, None)
+                                try:
+                                    released += len(region.buf)
+                                except ValueError:
+                                    pass
+                                pool.release(region)
+                        self._published_head_mirror = 0
+                        self._parked = True
+                        self.parked_epochs += 1
+            except AssertionError:
+                aborted = True
+        if aborted:
+            # our rings survive untouched — re-arm the peer's write side
+            # against the SAME handles (its saved writer state restores)
+            self._send_rearm(retained=True)
+            self.kick()
+            return
+        _flight.emit(_flight.PAIR_PARK, self._ftag, released)
+        _stats.counter_inc("pair_park")
+        from tpurpc.core.poller import Poller
+
+        Poller.note_parked(self)
+        trace_ring.log("pair %s parked (%d ring bytes pooled)",
+                       self.tag, released)
+
+    def unpark(self, *, remote: bool = False) -> None:
+        """Re-arm a parked pair: lease fresh rings from the pool, rebuild the
+        receive plumbing, and ship the new Address to the peer.  Invisible to
+        the RPC layers — callers' sends/recvs resume on the fresh rings."""
+        leased = 0
+        with self._park_lock:
+            if not self._parked:
+                return
+            if self.state is not PairState.CONNECTED:
+                return  # dying while parked; teardown forgets the stub
+            pool = RingPool.get()
+            ring = pool.lease(self.domain, self.ring_size)
+            try:
+                status = pool.lease(self.domain, STATUS_BYTES)
+            except BaseException:
+                pool.release(ring)
+                raise
+            try:
+                self.recv_region = ring
+                self.status_region = status
+                self.recv_region.on_write = self.kick
+                self.status_region.on_write = self.kick
+                self.reader = RingReader(self.recv_region.buf, self.ring_size)
+                self._published_head_mirror = 0
+                self._parked = False
+            except BaseException:
+                # lease-pairing discipline (lint rule `ringpool`): a failed
+                # re-arm returns both rings to the pool
+                self.recv_region = None
+                self.status_region = None
+                self.reader = None
+                pool.release(ring)
+                pool.release(status)
+                raise
+            leased = self.ring_size + STATUS_BYTES
+            self._send_rearm()
+        _flight.emit(_flight.PAIR_UNPARK, self._ftag, leased,
+                     1 if remote else 0)
+        _stats.counter_inc("pair_unpark")
+        from tpurpc.core.poller import Poller
+
+        Poller.note_unparked(self)
+        self.kick()
+        trace_ring.log("pair %s unparked (%s)", self.tag,
+                       "remote wake" if remote else "local demand")
+
+    def _send_rearm(self, *, retained: bool = False) -> None:
+        """Frame our current Address over the notify stream — the peer
+        reopens windows onto these rings and rebuilds its writer."""
+        if (self.recv_region is None or self.status_region is None
+                or self.state not in (PairState.INITIALIZED,
+                                      PairState.CONNECTED)):
+            return
+        blob = self.local_address().to_bytes()
+        tok = NOTIFY_REARM_KEEP if retained else NOTIFY_REARM
+        frame = tok + _BOOTSTRAP_MAGIC + struct.pack("<I", len(blob)) + blob
+        if not self._send_frame(frame):
+            self._mark_error("re-arm frame could not be delivered")
+
+    def _handle_wake_request(self) -> None:
+        """Peer has bytes for us but believes our rings are parked — re-arm.
+        When we are NOT parked (the WAKE crossed our re-arm in flight, or an
+        ack-overdue sender gave up on a park the peer did honor), re-send the
+        current Address: the peer's duplicate-re-arm dedup makes this
+        idempotent, and it repairs a peer stuck with its windows closed."""
+        if self._parked:
+            try:
+                self.unpark(remote=True)
+            except Exception as exc:  # pool exhaustion / racing teardown
+                trace_ring.log("pair %s: remote unpark failed: %r",
+                               self.tag, exc)
+        elif self.state is PairState.CONNECTED:
+            self._send_rearm(retained=True)
+            self.kick()
+
+    def _handle_rearm(self, blob: bytes, *, retained: bool = False) -> None:
+        """Peer advertised (fresh or retained) rings: rebuild our write side.
+        Duplicate re-arms for rings we already write are ignored — rebuilding
+        a live writer would reset its position mid-stream."""
+        try:
+            peer = Address.from_bytes(blob)
+        except Exception:
+            self._mark_error("undecodable re-arm frame")
+            return
+        with self._park_lock:
+            saved, self._saved_wstate = self._saved_wstate, None
+            if self.writer is not None:
+                if self._peer_ring_handle == peer.ring_handle:
+                    return  # duplicate
+                # stale windows onto rings the peer replaced: close first
+                try:
+                    with self._send_guard.maintenance():
+                        self.writer = None
+                        for attr in ("_peer_ring", "_peer_status"):
+                            win = getattr(self, attr)
+                            if win is not None:
+                                setattr(self, attr, None)
+                                self._peer_status_np = None
+                                retry_buffer_op(win.close)
+                except AssertionError:
+                    self._mark_error("re-arm raced an in-flight send")
+                    return
+            try:
+                self._peer_ring = self.domain.open_window(peer.ring_handle,
+                                                          peer.ring_size)
+                self._peer_status = self.domain.open_window(peer.status_handle,
+                                                            STATUS_BYTES)
+            except Exception as exc:
+                self._mark_error(f"re-arm window open failed: {exc!r}")
+                return
+            self._peer_ring_handle = peer.ring_handle
+            self.writer = RingWriter(peer.ring_size, self._peer_ring.write,
+                                     mapped=self._peer_ring.view)
+            self.writer.flight_tag = self._ftag
+            if retained:
+                # park ABORT / repair: the peer kept its rings and its reader
+                # position — restore our exact write position (a fresh
+                # writer's zero tail would corrupt mid-ring)
+                if saved is not None and saved[0] == peer.ring_handle:
+                    _, self.writer.tail, self.writer.seq, rh = saved
+                    self.writer.remote_head = rh
+                else:
+                    # rings retained but our snapshot is gone/mismatched: any
+                    # guess at the write position corrupts the stream — fail
+                    # loudly instead (never observed; belt and braces)
+                    self._mark_error("retained re-arm without writer state")
+                    return
+            elif self.status_region is not None:
+                # fresh peer rings: its reader restarts at head 0, so the
+                # stale published-head word in OUR status region must never
+                # fold into the fresh writer. The peer cannot be publishing
+                # concurrently — it publishes only after reading data, and
+                # no data can flow until this writer exists.
+                try:
+                    self.status_region.buf[
+                        _STATUS_HEAD_OFF:_STATUS_HEAD_OFF + 8] = bytes(8)
+                except (ValueError, TypeError):
+                    pass  # racing teardown; state checks surface it
+            self._peer_parked = False
+        if self.want_write:
+            self.process_credits()
+        self.kick()
+
+    def maybe_park(self, now: float, park_s: float) -> bool:
+        """Poller-sweep hook: initiate (or progress) a park episode for an
+        idle pair.  Returns True when park budget was consumed."""
+        if (self._parked or self.notify_sock is None
+                or "park" not in self.peer_caps):
+            return False
+        if self._park_pending:
+            if now - self._park_sent_at > 2.0:
+                with self._park_lock:
+                    self._park_pending = False  # ack lost/peer gone; retry
+            # an ownerless idle pair has no waiter to consume the ack —
+            # drain here (kick after: token theft is safe only with a kick)
+            if self.drain_notifications():
+                self.kick()
+            return False
+        if (self.state is not PairState.CONNECTED or self.want_write
+                or self.has_message() or self.readable()
+                or now - self.last_activity < park_s):
+            return False
+        with self._park_lock:
+            if self._park_pending or self._parked:
+                return False
+            try:
+                with self._send_guard.maintenance():
+                    if self.want_write or self.has_message():
+                        return False
+                    # the flag is visible to any sender that enters the
+                    # guard after us — no write can race the peer's
+                    # window-close (senders divert to the park-aware path)
+                    self._park_pending = True
+                    self._park_sent_at = now
+            except AssertionError:
+                return False  # a sender is mid-flight: not idle
+        self._notify(NOTIFY_PARK)
+        _stats.counter_inc("pair_park_requested")
+        return True
+
+    def resident_bytes_est(self) -> int:
+        """Estimated per-connection resident bytes this pair pins: ring
+        allocations while live, a ~stub while parked (scrape-time gauge and
+        the hive bench's bytes/connection curve)."""
+        n = 256  # object + bookkeeping stub
+        region = self.recv_region
+        if region is not None:
+            n += self.ring_size
+        if self.status_region is not None:
+            n += STATUS_BYTES
+        return n
 
     def _on_notify_closed(self) -> None:
         """Peer's end of the notify socket closed. Graceful close writes
@@ -941,12 +1578,16 @@ class Pair:
         (``pair.cc:294-301`` reading mirrored remote_head; peer_exit check
         ``pair.cc:349-375``).  Serialized: sender thread and poller thread both call
         this, and check-then-act on ``remote_head`` must be atomic."""
-        if self.writer is None:
-            return
+        w = self.writer
+        if w is None or self.status_region is None:
+            return  # no write side / our status inbox is parked in the pool
         with self._credit_lock:
-            head, peer_exit = self._poll_status_words()
-            if head > self.writer.remote_head:
-                self.writer.update_remote_head(head)
+            try:
+                head, peer_exit = self._poll_status_words()
+            except ValueError:
+                return  # region released under us (park/teardown race)
+            if head > w.remote_head:
+                w.update_remote_head(head)
         if peer_exit and self.state is PairState.CONNECTED:
             self.state = PairState.HALF_CLOSED
             trace_ring.log("pair %s: peer_exit observed -> HALF_CLOSED", self.tag)
@@ -954,13 +1595,21 @@ class Pair:
     def _publish_credits_if_due(self, force: bool = False) -> None:
         """One-sided-write our head into the peer's status buffer after consuming
         ≥ half ring (``pair.cc:276-284``, ``updateStatus`` ``:624-641``)."""
-        if self._peer_status is None:
-            return
-        if force or self.reader.should_publish_head():
-            head = self.reader.take_publish()
+        reader = self.reader
+        win = self._peer_status
+        if win is None or reader is None:
+            return  # reader parked: head 0 re-publishes on the fresh ring
+        if force or reader.should_publish_head():
+            head = reader.take_publish()
             if head != self._published_head_mirror:
                 self._published_head_mirror = head
-                self._peer_status.write(_STATUS_HEAD_OFF, _U64.pack(head))
+                try:
+                    win.write(_STATUS_HEAD_OFF, _U64.pack(head))
+                except ValueError:
+                    # window closed under us (peer parking): the publish is
+                    # lost but heads are cumulative — the next publish after
+                    # re-arm carries it
+                    return
                 # Wake the peer's credit-stalled writer only if one is
                 # actually asleep; a spinning writer watches the head word
                 # natively (tpr_spin_u64_change) and needs no byte.
@@ -981,13 +1630,20 @@ class Pair:
             raise BrokenPipeError(f"pair {self.tag} not sendable: {self.state}"
                                   + (f" ({self.error})" if self.error else ""))
         t0 = time.monotonic_ns()
-        if _tracing.LIVE and _tracing.current() is not None:
-            # traced call on this thread: the ring-encode interval is the
-            # "send-lease" span of the per-RPC timeline (SURVEY §7 #4)
-            with _tracing.span("send-lease"):
-                n = self._send_traced(slices, byte_idx)
-        else:
-            n = self._send_traced(slices, byte_idx)
+        while True:
+            try:
+                if _tracing.LIVE and _tracing.current() is not None:
+                    # traced call on this thread: the ring-encode interval is
+                    # the "send-lease" span of the timeline (SURVEY §7 #4)
+                    with _tracing.span("send-lease"):
+                        n = self._send_traced(slices, byte_idx)
+                else:
+                    n = self._send_traced(slices, byte_idx)
+                break
+            except _ParkBusy:
+                n = self._resolve_park_for_send()
+                if n is not None:
+                    break  # peer parked: 0 accepted, wake in flight
         # tpurpc-lens `wire` hop: bytes accepted across the transport
         # boundary and the nanoseconds the placement (credits + chunking +
         # ring encode) took — one pair of bumps per send call
@@ -996,6 +1652,45 @@ class Pair:
         _LENS_WIRE_BYTES.inc(n)
         _LENS_WIRE_COPY.inc(n)
         return n
+
+    def _resolve_park_for_send(self) -> Optional[int]:
+        """Resolve the park episode that made ``_send_inner`` raise
+        :class:`_ParkBusy` — called OUTSIDE the send guard (lock order).
+        Returns a byte count for ``send`` to report (peer parked: 0 accepted,
+        partial-send semantics — the endpoint re-arms on write-ready and the
+        WAKE token is already in flight), or None to retry the send."""
+        with self._park_lock:
+            peer_parked = self._peer_parked
+            parked = self._parked
+            pending = self._park_pending
+        if peer_parked:
+            # each retry re-sends the wake: idempotent, and it makes a lost
+            # token survivable (the endpoint's wait_writable has a timeout)
+            self._notify(NOTIFY_WAKE)
+            self.want_write = True
+            return 0
+        if parked:
+            self.unpark()
+            return None
+        if pending:
+            # our own park request is in flight; drain for the ack/nack so
+            # the episode resolves, bounded so a dead peer can't wedge senders
+            deadline = time.monotonic() + 2.5
+            while time.monotonic() < deadline:
+                if self.drain_notifications():
+                    self.kick()  # stolen tokens: waiters re-check predicates
+                with self._park_lock:
+                    if not (self._park_pending or self._parked
+                            or self._peer_parked):
+                        return None
+                    if self._parked or self._peer_parked:
+                        return None  # resolved; next retry takes that branch
+                if self.state is not PairState.CONNECTED:
+                    return None  # retry surfaces the state error
+                time.sleep(0.001)
+            with self._park_lock:
+                self._park_pending = False  # ack overdue; peer likely gone
+        return None
 
     def _send_traced(self, slices: Sequence, byte_idx: int = 0) -> int:
         if _stats.profiling_on():
@@ -1033,6 +1728,11 @@ class Pair:
     def _send_inner(self, slices: Sequence, byte_idx: int = 0) -> int:
         cfg = get_config()
         with self._send_guard:
+            if self._parked or self._park_pending or self._peer_parked:
+                # checked INSIDE the guard: park initiation/ack also hold it,
+                # so a sender entering after a park decision always observes
+                # the flag — no write can race the peer's window close
+                raise _ParkBusy
             views: List[memoryview] = []
             skip = byte_idx
             for s in slices:
@@ -1107,8 +1807,10 @@ class Pair:
             # completion on the passive side; only the event path wakes via
             # the completion channel, poller.cc:92-101). The waiting flag +
             # fences make the skip lossless (ring.cc sleep-protocol proof).
-            if total and self._peer_waiting("read"):
-                self._notify(NOTIFY_DATA)
+            if total:
+                self.last_activity = time.monotonic()
+                if self._peer_waiting("read"):
+                    self._notify(NOTIFY_DATA)
             return total
 
     def _send_fast(self, views: "List[memoryview]", cfg) -> "Optional[int]":
@@ -1186,6 +1888,8 @@ class Pair:
             _LENS_SR_COPY.inc(got)
         ring_ledger.host_copy(got)
         self.total_sent += got
+        if got:
+            self.last_activity = time.monotonic()
         total_len = sum(len(v) for v in views)
         self.want_write = got < total_len
         # the fast path folds only the credit word; peer_exit still must
@@ -1210,9 +1914,22 @@ class Pair:
         message queued in the ring moves in one pass with one head publish,
         and the batch size feeds the ``ring_drain`` histogram the bench
         reports as ``batch_msgs_per_wakeup``."""
+        try:
+            return self._recv_into_guarded(dst)
+        except _ParkBusy:
+            # park completion owns the read side this instant; it either
+            # aborts (rings intact, kick re-wakes us) or parks (recv on a
+            # parked pair reads 0 anyway) — transient empty, not an error
+            return 0
+
+    def _recv_into_guarded(self, dst) -> int:
         with self._recv_guard:
             reader = self.reader
             if reader is None:  # quiesced/destroyed under a racing reader thread
+                if self._parked:
+                    return 0  # parked, not closed: the first peer byte
+                    # arrives as a WAKE on the notify fd and re-arms us —
+                    # callers just keep wait_readable-ing, RPC-invisible
                 raise ConnectionError("pair is closed")
             try:
                 n, nmsgs = reader.drain_into(dst)
@@ -1225,6 +1942,8 @@ class Pair:
             if nmsgs:
                 _stats.batch_hist("ring_drain").record(nmsgs)
             self.total_recv += n
+            if n:
+                self.last_activity = time.monotonic()
             self._publish_credits_if_due()
             return n
 
@@ -1367,6 +2086,17 @@ class Pair:
         for region in (self.recv_region, self.status_region):
             if region is not None:
                 region.on_write = None
+        if self._parked or self._park_pending:
+            # a parked pair dying mid-park: drop its parked-watcher slot so
+            # the poller's map can't accumulate dead stubs (gauge hygiene)
+            self._parked = False
+            self._park_pending = False
+            try:
+                from tpurpc.core.poller import Poller
+
+                Poller.forget_parked(self)
+            except Exception:
+                pass
         self.kick()
         sels, self._selectors = self._selectors, {}
         for sel in sels.values():
@@ -1411,6 +2141,7 @@ class Pair:
             if r is not None:
                 setattr(self, attr, None)
                 self._status_np = None
+                RingPool.get().forget(r)  # pool-leased (unparked) regions
                 r.close()
 
     def _release_resources(self) -> None:

@@ -65,6 +65,29 @@ _EWMA_SPIN_FLOOR = 0.1
 #: as "spinning would have caught it" — in multiples of busy_polling_timeout
 _HOT_WAKE_MULTIPLE = 4.0
 
+#: Per-BATCH adoption (tpurpc-hive): one poller sweep now dispatches every
+#: ready pair in a burst, and the EWMA of that burst size is the fleet-wide
+#: load signal. When sweeps keep finding many ready pairs at once, per-pair
+#: busy windows stop paying for themselves — N spinners on ≤cores harts just
+#: steal cycles from each other — so the hybrid gate suppresses spinning
+#: fleet-wide above the threshold, regardless of each pair's own hot EWMA.
+#: Lock-free float (CPython stores are atomic; a lost update is one sweep of
+#: staleness in a smoothed signal).
+_BATCH_ALPHA = 0.3
+_BATCH_SPIN_SUPPRESS = 8.0
+_batch_ewma = 0.0
+
+
+def _note_batch(n: int) -> None:
+    global _batch_ewma
+    _batch_ewma += _BATCH_ALPHA * (n - _batch_ewma)
+
+
+def batch_pressure() -> float:
+    """EWMA of ready-pairs-per-poller-sweep — the C100K spin-suppression
+    signal (also exported for the bench artifact)."""
+    return _batch_ewma
+
 
 def _ewma_hit(pair: Pair) -> None:
     e = getattr(pair, "activity_ewma", 1.0)
@@ -85,7 +108,8 @@ class Poller:
     #: the pair slots, their count, and the run flag only mutate under the
     #: condition's lock (waiters key decisions off all three)
     _GUARDED_BY = {"_pairs": "_cv", "_pair_count": "_cv", "_running": "_cv",
-                   "_instance": "_instance_lock"}
+                   "_instance": "_instance_lock",
+                   "_parked_map": "_parked_mu", "_parked_sel": "_parked_mu"}
 
     @classmethod
     def get(cls) -> "Poller":
@@ -118,6 +142,13 @@ class Poller:
         self._threads: List[threading.Thread] = []
         self._running = False
         self._pair_count = 0
+        # Parked-stub watcher (tpurpc-hive): notify sockets of parked pairs,
+        # polled each sweep so an OWNERLESS parked pair (no endpoint thread
+        # blocked on it) still sees the peer's WAKE/REARM frames. Lock order:
+        # _cv before _parked_mu where both are held.
+        self._parked_mu = make_lock("Poller._parked_mu")
+        self._parked_map: Dict[int, Pair] = {}
+        self._parked_sel = None  # lazy selectors.DefaultSelector
         _POLLER_PAIRS.track(self)
 
     # -- registration --------------------------------------------------------
@@ -135,13 +166,124 @@ class Poller:
             self._pair_count += 1
             self._cv.notify_all()
 
-    def remove_pollable(self, pair: Pair) -> None:
+    def remove_pollable(self, pair: Pair) -> bool:
+        """Returns True when the pair held a slot — park remembers it so
+        unpark can restore the registration."""
         with self._cv:
             for i, slot in enumerate(self._pairs):
                 if slot is pair:
                     self._pairs[i] = None
                     self._pair_count -= 1
-                    break
+                    return True
+        return False
+
+    # -- parked-stub watcher (tpurpc-hive) -----------------------------------
+
+    @classmethod
+    def note_parked(cls, pair: Pair) -> None:
+        """A pair completed its park: free its poller slot (its scan cost
+        drops to zero) and watch its notify socket for the wake/re-arm
+        frames that end the episode."""
+        inst = cls.get()
+        pair._poller_was_registered = inst.remove_pollable(pair)
+        inst.add_parked(pair)
+
+    @classmethod
+    def note_unparked(cls, pair: Pair) -> None:
+        inst = cls.get()
+        inst.remove_parked(pair)
+        if getattr(pair, "_poller_was_registered", False):
+            pair._poller_was_registered = False
+            try:
+                inst.add_pollable(pair)
+            except RuntimeError:
+                # poller refilled while we were parked; waiters still wake on
+                # tokens/kicks, just without the recovery scan
+                _stats.counter_inc("poller_unpark_slotless")
+
+    @classmethod
+    def forget_parked(cls, pair: Pair) -> None:
+        """Teardown of a parked pair: drop the watcher slot, nothing else."""
+        with cls._instance_lock:
+            inst = cls._instance
+        if inst is not None:
+            inst.remove_parked(pair)
+
+    def add_parked(self, pair: Pair) -> None:
+        import selectors
+
+        sock = pair.notify_sock
+        if sock is None:
+            return
+        with self._parked_mu:
+            if self._parked_sel is None:
+                self._parked_sel = selectors.DefaultSelector()
+            try:
+                fd = sock.fileno()
+                self._parked_sel.register(sock, selectors.EVENT_READ, pair)
+                self._parked_map[fd] = pair
+            except (KeyError, ValueError, OSError):
+                return  # already watched / socket racing closed
+        with self._cv:
+            self._cv.notify_all()  # leave the zero-pairs long sleep
+
+    def remove_parked(self, pair: Pair) -> None:
+        with self._parked_mu:
+            sel = self._parked_sel
+            if sel is None:
+                return
+            for fd in [f for f, p in self._parked_map.items() if p is pair]:
+                del self._parked_map[fd]
+                try:
+                    sel.unregister(fd)
+                except (KeyError, ValueError, OSError):
+                    pass
+
+    def parked_count(self) -> int:
+        with self._parked_mu:
+            return len(self._parked_map)
+
+    def _scan_parked(self) -> bool:
+        """Drain notify streams of parked stubs (one zero-timeout select over
+        the whole fleet); a WAKE/REARM found here runs the unpark inline."""
+        with self._parked_mu:
+            sel = self._parked_sel
+            if sel is None or not self._parked_map:
+                return False
+            try:
+                events = sel.select(timeout=0)
+            except (OSError, ValueError):
+                events = []
+            ready = [key.data for key, _ in events]
+        hot = False
+        for pair in ready:
+            try:
+                if pair.drain_notifications():
+                    pair.kick()
+                hot = True
+                if (pair.state is not PairState.CONNECTED
+                        or not (pair._parked or pair._park_pending)):
+                    self.remove_parked(pair)
+            except Exception:
+                self.remove_parked(pair)
+        return hot
+
+    def _park_sweep(self, snapshot: List[Pair], now: float) -> None:
+        """Initiate park episodes for idle registered pairs — bounded per
+        sweep so a mass-idle fleet parks over a few sweeps instead of one
+        stop-the-world burst of handshakes."""
+        park_s = get_config().pair_park_s
+        if park_s <= 0:
+            return
+        budget = 64
+        for pair in snapshot:
+            if budget <= 0:
+                return
+            try:
+                if pair.maybe_park(now, park_s):
+                    budget -= 1
+            except Exception:
+                pass  # dying pair; its owner observes the state
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -189,21 +331,38 @@ class Poller:
             with self._cv:
                 if not self._running:
                     return
-                if self._pair_count == 0:
+                with self._parked_mu:
+                    n_parked = len(self._parked_map)
+                if self._pair_count == 0 and n_parked == 0:
                     self._cv.wait(timeout=self.sleep_timeout_s)
                     interval = 0.001  # registrations re-arm the fast scan
                     continue
                 snapshot = [p for p in self._pairs if p is not None]
-            hot = False
+            # Batched dispatch (tpurpc-hive): ONE sweep collects every pair
+            # whose watched condition edged, then kicks them all in a burst —
+            # the Python rendering of one epoll_wait batch fanning out N
+            # wakeups, instead of N interleaved scan/kick round-trips. The
+            # burst size feeds the per-batch adoption EWMA that suppresses
+            # per-pair busy windows under fleet-wide pressure.
+            woken: List[Pair] = []
             for pair in snapshot:
                 try:
                     if self._scan_edges(pair):
-                        pair.kick()
-                        hot = True
+                        woken.append(pair)
                 except Exception:
-                    # A dying pair must never take the poller down; kick so the
-                    # owner observes the error state.
+                    # A dying pair must never take the poller down; kick so
+                    # the owner observes the error state.
                     pair.kick()
+            for pair in woken:
+                pair.kick()
+            hot = bool(woken)
+            if snapshot:
+                _note_batch(len(woken))
+            if woken:
+                _stats.batch_hist("poller_batch_wakeups").record(len(woken))
+            if self._scan_parked():
+                hot = True
+            self._park_sweep(snapshot, time.monotonic())
             if hot:
                 _stats.counter_inc("poller_scan_hot")
                 interval = 0.001
@@ -345,7 +504,16 @@ def _wait(pair: Pair, timeout: Optional[float], discipline: Optional[str],
         # are flight-recorder events: rare edges, and exactly the record a
         # wake-latency postmortem needs (tpurpc-blackbox, ISSUE 5).
         ewma = getattr(pair, "activity_ewma", 1.0)
-        if discipline == "hybrid" and ewma < _EWMA_SPIN_FLOOR:
+        suppressed = False
+        if (discipline == "hybrid"
+                and batch_pressure() >= _BATCH_SPIN_SUPPRESS):
+            # fleet-wide pressure: sweeps keep finding many ready pairs at
+            # once, so per-pair spinners only steal each other's cycles —
+            # adopt the event leg regardless of this pair's own hot EWMA
+            suppressed = True
+            _stats.counter_inc("wait_spin_suppressed_batch")
+        if discipline == "hybrid" and (suppressed
+                                       or ewma < _EWMA_SPIN_FLOOR):
             _stats.counter_inc("wait_spin_skipped")
             if getattr(pair, "_flight_mode", "bp") != "ev":
                 pair._flight_mode = "ev"

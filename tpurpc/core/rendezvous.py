@@ -627,6 +627,153 @@ class _CtrlFrameCoalescer:
                 raise
 
 
+#: cross-link window reuse — every hit is a writer QP + bounce
+#: registration NOT created (verbs) or a mmap/attach NOT repeated (shm)
+_WINDOW_SHARE_HITS = _metrics.counter("rdv_window_share_hits")
+
+
+class _WindowShare:
+    """Process-wide refcounted cache of open peer-region windows keyed
+    ``(kind, handle)`` — the rendezvous half of the ISSUE 16 shared-MR
+    plane. Ten links (or ten thousand pairs' links) writing into the same
+    peer arena share ONE open window — on verbs that is one writer QP and
+    one bounce registration instead of one per link, which is how the
+    registration count stays O(distinct regions × size-classes) rather
+    than O(pairs).
+
+    ``acquire`` bumps a refcount (opening on a miss); ``release`` drops
+    it, parking a zero-ref window on a bounded idle LRU so the next
+    acquirer of the same region skips the open entirely. Windows are
+    opened on the share's OWN domains, never a link's, so a shared window
+    cannot die with whichever link happened to open it first.
+
+    Write safety across holders: a claim/grant leases a region to exactly
+    one transfer at a time, and the verbs bounce staging is offset-mapped
+    (window offset == bounce offset), so concurrent holders writing
+    disjoint claimed spans never collide — the argument that makes
+    per-link window reuse sound extends unchanged across links.
+    """
+
+    _GUARDED_BY = {"_entries": "_lock", "_idle": "_lock",
+                   "_domains": "_lock"}
+
+    _MAX_IDLE = 64
+
+    def __init__(self):
+        self._lock = make_lock("WindowShare._lock")
+        #: key -> [window, refcount, window_bytes]
+        self._entries: Dict[Tuple[str, str], list] = {}
+        self._idle: List[Tuple[str, str]] = []  # refcount-0 keys, LRU
+        self._domains: Dict[str, _pair.MemoryDomain] = {}
+
+    def _domain(self, kind: str) -> _pair.MemoryDomain:
+        with self._lock:
+            d = self._domains.get(kind)
+            if d is None:
+                d = self._domains[kind] = _pair.make_domain(kind)
+            return d
+
+    def acquire(self, kind: str, handle: str, nbytes: int) -> _pair.Window:
+        key = (kind, handle)
+        stale = None
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None:
+                if e[2] >= nbytes:
+                    if e[1] == 0:
+                        try:
+                            self._idle.remove(key)
+                        except ValueError:
+                            pass
+                    e[1] += 1
+                    _WINDOW_SHARE_HITS.inc()
+                    return e[0]
+                if e[1] == 0:
+                    # undersized and idle: retire it, reopen bigger below
+                    stale = self._entries.pop(key)
+                    try:
+                        self._idle.remove(key)
+                    except ValueError:
+                        pass
+        if stale is not None:
+            try:
+                stale[0].close()
+            except Exception:
+                pass
+        win = self._domain(kind).open_window(handle, nbytes)
+        with self._lock:
+            if key not in self._entries:
+                self._entries[key] = [win, 1, nbytes]
+                return win
+        # raced another opener, or an undersized entry is still
+        # referenced: hand out a PRIVATE window — release()'s identity
+        # check routes it straight to close instead of the refcount
+        return win
+
+    def release(self, kind: str, handle: str, win: _pair.Window) -> None:
+        key = (kind, handle)
+        close_now = []
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None and e[0] is win:
+                if e[1] > 0:
+                    e[1] -= 1
+                    if e[1] == 0:
+                        self._idle.append(key)
+                        while len(self._idle) > self._MAX_IDLE:
+                            k = self._idle.pop(0)
+                            dead = self._entries.pop(k, None)
+                            if dead is not None:
+                                close_now.append(dead[0])
+            else:
+                close_now.append(win)  # private window (see acquire)
+        for w in close_now:
+            try:
+                w.close()
+            except Exception:
+                pass
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"entries": len(self._entries),
+                    "idle": len(self._idle),
+                    "referenced": sum(1 for e in self._entries.values()
+                                      if e[1] > 0)}
+
+    def drain(self) -> None:
+        """Close every cached window and domain (test isolation; callers
+        must have released their refs — a drained-under window fails its
+        next write, same as a closed link's would)."""
+        with self._lock:
+            wins = [e[0] for e in self._entries.values()]
+            self._entries.clear()
+            self._idle = []
+            domains = list(self._domains.values())
+            self._domains.clear()
+        for w in wins:
+            try:
+                w.close()
+            except Exception:
+                pass
+        for d in domains:
+            try:
+                d.close()
+            except Exception:
+                pass
+
+
+_WINDOW_SHARE: Optional[_WindowShare] = None
+_WINDOW_SHARE_LOCK = make_lock("rendezvous._WINDOW_SHARE")
+
+
+def window_share() -> _WindowShare:
+    global _WINDOW_SHARE
+    with _WINDOW_SHARE_LOCK:
+        if _WINDOW_SHARE is None:
+            _WINDOW_SHARE = _WindowShare()
+        return _WINDOW_SHARE
+
+
 class RdvLink:
     """Rendezvous state for ONE framed connection: the sender role (offer,
     one-sided write, complete) and the receiver role (pool leases, claims,
@@ -906,24 +1053,29 @@ class RdvLink:
         win = self._windows.get(key)
         if win is not None:
             return win
-        domain = self._domains.get(claim.kind)
-        if domain is None:
-            domain = self._domains[claim.kind] = _pair.make_domain(
-                claim.kind)
-        win = domain.open_window(claim.handle,
-                                 claim.offset + claim.capacity
-                                 + _NONCE_BYTES + _DOORBELL.size)
+        # the per-link map holds a REF on the process-wide share — the
+        # open (QP connect + bounce registration on verbs) happens at most
+        # once per region across every link in the process
+        win = window_share().acquire(
+            claim.kind, claim.handle,
+            claim.offset + claim.capacity + _NONCE_BYTES + _DOORBELL.size)
+        extra = None
+        evict_key = None
+        evict_win = None
         with self._lock:
-            self._windows[key] = win
-            self._window_order.append(key)
-            evict = None
-            if len(self._window_order) > _WINDOW_CACHE:
-                evict = self._windows.pop(self._window_order.pop(0), None)
-        if evict is not None:
-            try:
-                evict.close()
-            except Exception:
-                pass
+            prev = self._windows.get(key)
+            if prev is not None:
+                extra, win = win, prev  # raced a sibling sender thread
+            else:
+                self._windows[key] = win
+                self._window_order.append(key)
+                if len(self._window_order) > _WINDOW_CACHE:
+                    evict_key = self._window_order.pop(0)
+                    evict_win = self._windows.pop(evict_key, None)
+        if extra is not None:
+            window_share().release(claim.kind, claim.handle, extra)
+        if evict_win is not None:
+            window_share().release(evict_key[0], evict_key[1], evict_win)
         return win
 
     def _rdv_write(self, claim: _Claim, segs: Sequence, total: int) -> None:
@@ -1041,8 +1193,10 @@ class RdvLink:
             if kind not in kinds:
                 continue
             try:
-                lease = landing_pool(kind).lease(nbytes,
-                                                 next(self._lease_ids))
+                # ownership transfers by return: the caller registers the
+                # lease in _leases and every death path releases it there
+                lease = landing_pool(kind).lease(  # tpr: allow(ringpool)
+                    nbytes, next(self._lease_ids))
             except Exception:
                 continue
             if lease is not None:
@@ -1177,7 +1331,7 @@ class RdvLink:
             self._req_lease.clear()
             self._pregrants_out.clear()
             self._grants.clear()
-            windows = list(self._windows.values())
+            windows = list(self._windows.items())
             self._windows.clear()
             self._window_order = []
             self._cond.notify_all()
@@ -1192,11 +1346,10 @@ class RdvLink:
             # late one-sided write — it must hit orphaned memory, never a
             # region re-leased to a new transfer
             lease.release(discard=True)
-        for win in windows:
-            try:
-                win.close()
-            except Exception:
-                pass
+        for (kind, handle), win in windows:
+            # drop this link's refs; the share parks or closes as the
+            # cross-link refcount dictates
+            window_share().release(kind, handle, win)
 
     def stats(self) -> Dict[str, int]:
         with self._lock:
@@ -1299,13 +1452,17 @@ class GrantWriter:
         win = self._windows.get(key)
         if win is not None:
             return win
-        domain = self._domains.get(grant.kind)
-        if domain is None:
-            domain = self._domains[grant.kind] = _pair.make_domain(
-                grant.kind)
-        win = domain.open_window(grant.handle, grant.window_bytes)
+        win = window_share().acquire(grant.kind, grant.handle,
+                                     grant.window_bytes)
+        extra = None
         with self._lock:
-            self._windows[key] = win
+            prev = self._windows.get(key)
+            if prev is not None:
+                extra, win = win, prev
+            else:
+                self._windows[key] = win
+        if extra is not None:
+            window_share().release(grant.kind, grant.handle, extra)
         return win
 
     def write_blocks(self, grant: BlockGrant, chunks: Sequence) -> int:
@@ -1347,13 +1504,10 @@ class GrantWriter:
 
     def close(self) -> None:
         with self._lock:
-            windows = list(self._windows.values())
+            windows = list(self._windows.items())
             self._windows.clear()
-        for win in windows:
-            try:
-                win.close()
-            except Exception:
-                pass
+        for (kind, handle), win in windows:
+            window_share().release(kind, handle, win)
 
 
 def domains_for_endpoint(endpoint) -> Tuple[Tuple[str, ...],

@@ -45,9 +45,10 @@ from __future__ import annotations
 import ctypes
 import os
 import threading
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from tpurpc.core.pair import MemoryDomain, Region, Window, register_domain
+from tpurpc.obs import metrics as _metrics
 
 
 class VerbsWindow(Window):
@@ -59,6 +60,111 @@ class VerbsWindow(Window):
 
 _LIB = None
 _LIB_LOCK = threading.Lock()
+
+#: registrations currently parked in MR caches, and cumulative cache hits
+#: (gauges so tools/scale_smoke.py and /metrics read them without a
+#: registry walk; hits only ever grows — it is a counter wearing a gauge
+#: face because the ISSUE 16 scrape contract names it with the gauges)
+_MR_CACHE_ENTRIES = _metrics.gauge("mr_cache_entries")
+_MR_CACHE_HITS = _metrics.gauge("mr_cache_hits")
+
+
+def _size_class(nbytes: int) -> int:
+    """Power-of-two round-up with a page floor — the cache key. Rounding
+    means a 12 KiB ring and a 16 KiB ring share the 16 KiB class, which
+    is the whole point: 10k pairs hold O(size-classes) distinct
+    registration shapes, not O(pairs)."""
+    return max(4096, 1 << max(nbytes - 1, 1).bit_length())
+
+
+class _MRCache:
+    """Size-classed free list of NIC registrations (``ibv_reg_mr``
+    results) owned by one :class:`VerbsDomain` (MRs belong to a PD — a
+    registration can never migrate between device contexts).
+
+    Registration is the expensive, page-pinning verb (µs-scale kernel
+    round-trip + IOMMU work). At C100K churn — pairs parking/unparking,
+    rendezvous windows cycling through the per-link cache — deregistering
+    on every close and re-registering on every open is O(events)
+    registrations. This cache makes it O(size-classes): ``lease`` pops a
+    parked MR of the right class or registers a fresh one, ``release``
+    parks it again instead of deregistering. Leased MRs are exclusively
+    owned by the leaseholder (a bounce MR is staged into concurrently —
+    sharing one between two live windows would interleave their staging
+    copies); the refcounted *window* sharing that lets many pairs reuse
+    one live registration sits above this in
+    ``rendezvous._WindowShare``.
+
+    Bounded two ways (entries per class, total parked bytes) so a burst
+    of huge landing regions cannot pin memory forever; overflow falls
+    back to the plain dereg path."""
+
+    _GUARDED_BY = {"_free": "_lock", "_free_bytes": "_lock",
+                   "hits": "_lock", "misses": "_lock"}
+
+    _MAX_PER_CLASS = 64
+    _MAX_FREE_BYTES = 256 << 20
+
+    def __init__(self, lib, ctx):
+        self._lib = lib
+        self._ctx = ctx
+        self._lock = threading.Lock()
+        self._free: Dict[int, List[int]] = {}   # class bytes -> [mr, ...]
+        self._free_bytes = 0
+        self.hits = 0
+        self.misses = 0
+
+    def lease(self, nbytes: int) -> Tuple[int, int]:
+        """Return ``(mr, class_bytes)`` with ``class_bytes >= nbytes``.
+        The backing memory is zeroed on a cache hit — a recycled
+        registration still holds the previous tenant's bytes, and a fresh
+        RingReader parsing a stale frame header is exactly the corruption
+        class RingPool zeroes against."""
+        cls = _size_class(nbytes)
+        with self._lock:
+            lst = self._free.get(cls)
+            if lst:
+                mr = lst.pop()
+                self._free_bytes -= cls
+                self.hits += 1
+                _MR_CACHE_HITS.inc()
+                _MR_CACHE_ENTRIES.dec()
+                ctypes.memset(self._lib.tpr_verbs_mr_addr(mr), 0, cls)
+                return mr, cls
+            self.misses += 1
+        mr = self._lib.tpr_verbs_reg(self._ctx, None, cls)
+        if not mr:
+            raise MemoryError(f"ibv_reg_mr failed ({cls} bytes)")
+        return mr, cls
+
+    def release(self, mr: int, cls: int) -> None:
+        with self._lock:
+            lst = self._free.setdefault(cls, [])
+            if (len(lst) < self._MAX_PER_CLASS
+                    and self._free_bytes + cls <= self._MAX_FREE_BYTES):
+                lst.append(mr)
+                self._free_bytes += cls
+                _MR_CACHE_ENTRIES.inc()
+                return
+        self._lib.tpr_verbs_dereg(mr)
+
+    def drain(self) -> None:
+        """Dereg every parked registration (domain close — the PD is
+        about to go away and real hardware refuses dealloc_pd under live
+        MRs)."""
+        with self._lock:
+            mrs = [mr for lst in self._free.values() for mr in lst]
+            self._free.clear()
+            self._free_bytes = 0
+            _MR_CACHE_ENTRIES.dec(len(mrs))
+        for mr in mrs:
+            self._lib.tpr_verbs_dereg(mr)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"free_entries": sum(len(v) for v in self._free.values()),
+                    "free_bytes": self._free_bytes,
+                    "hits": self.hits, "misses": self.misses}
 
 
 def _load():
@@ -128,9 +234,14 @@ class VerbsDomain(MemoryDomain):
         if not self._ctx:
             raise RuntimeError("verbs domain: no RDMA device opened")
         self._lock = threading.Lock()
-        #: region handle -> (mr, receiver-side qp) — accept_writer connects
-        #: the qp once the writer's attrs arrive via the bootstrap
-        self._regions: Dict[str, Tuple[int, int]] = {}
+        #: region handle -> (mr, receiver-side qp, size class) —
+        #: accept_writer connects the qp once the writer's attrs arrive
+        #: via the bootstrap; the class routes close back to the MR cache
+        self._regions: Dict[str, Tuple[int, int, int]] = {}
+        #: shared registration cache — alloc'd regions AND window bounce
+        #: buffers lease from here, so pair park/unpark and rendezvous
+        #: window churn recycle O(size-classes) registrations
+        self.mr_cache = _MRCache(lib, self._ctx)
 
     def close(self) -> None:
         """Release the device context (PD + CQ + device). Still-open
@@ -142,9 +253,10 @@ class VerbsDomain(MemoryDomain):
         with self._lock:
             leftovers = list(self._regions.items())
             self._regions.clear()
-        for _handle, (mr, qp) in leftovers:
+        for _handle, (mr, qp, _cls) in leftovers:
             self._lib.tpr_verbs_qp_destroy(qp)
             self._lib.tpr_verbs_dereg(mr)
+        self.mr_cache.drain()
         ctx, self._ctx = self._ctx, None
         if ctx:
             self._lib.tpr_verbs_close(ctx)
@@ -159,9 +271,9 @@ class VerbsDomain(MemoryDomain):
 
     def alloc(self, nbytes: int) -> Region:
         lib = self._lib
-        mr = lib.tpr_verbs_reg(self._ctx, None, nbytes)
-        if not mr:
-            raise MemoryError("ibv_reg_mr failed")
+        # lease a (possibly recycled) registration: the MR backs cls
+        # bytes >= nbytes, the handle advertises the logical nbytes
+        mr, cls = self.mr_cache.lease(nbytes)
         addr = lib.tpr_verbs_mr_addr(mr)
         rkey = lib.tpr_verbs_mr_rkey(mr)
         qpn = ctypes.c_uint32()
@@ -172,20 +284,23 @@ class VerbsDomain(MemoryDomain):
                                      ctypes.byref(lid), gid,
                                      ctypes.byref(psn))
         if not qp:
-            lib.tpr_verbs_dereg(mr)
+            self.mr_cache.release(mr, cls)
             raise RuntimeError("verbs qp_create failed")
         handle = (f"verbs:{rkey}:{addr}:{nbytes}:{qpn.value}:{lid.value}:"
                   f"{gid.raw.hex()}:{psn.value}")
         buf = (ctypes.c_uint8 * nbytes).from_address(addr)
         with self._lock:
-            self._regions[handle] = (mr, qp)
+            self._regions[handle] = (mr, qp, cls)
 
         def _close():
             with self._lock:
                 entry = self._regions.pop(handle, None)
             if entry:
+                # the QP is peer-state and dies with the region; the
+                # REGISTRATION is the expensive part and goes back to
+                # the pool for the next same-class alloc
                 lib.tpr_verbs_qp_destroy(entry[1])
-                lib.tpr_verbs_dereg(entry[0])
+                self.mr_cache.release(entry[0], entry[2])
 
         return Region(handle, buf, _close)
 
@@ -244,8 +359,13 @@ class VerbsDomain(MemoryDomain):
         # is µs-scale and pins pages, the wrong trade for a window written
         # repeatedly.) Staging is offset-mapped (window offset == bounce
         # offset), so concurrent writes to disjoint spans don't collide.
-        bounce = lib.tpr_verbs_reg(self._ctx, None, nbytes)
-        if not bounce:
+        # The bounce is LEASED from the MR cache — a live bounce is
+        # exclusively this window's (two windows staging into one buffer
+        # would interleave), but close returns the registration for the
+        # next window of the same size class instead of deregistering.
+        try:
+            bounce, bounce_cls = self.mr_cache.lease(nbytes)
+        except MemoryError:
             lib.tpr_verbs_qp_destroy(qp)
             raise MemoryError("verbs open_window: bounce ibv_reg_mr failed")
         bounce_lkey = lib.tpr_verbs_mr_lkey(bounce)
@@ -268,8 +388,8 @@ class VerbsDomain(MemoryDomain):
                 raise OSError("RDMA WRITE failed")
 
         def close() -> None:
-            staging.release()  # drop the alias before the MR goes away
-            lib.tpr_verbs_dereg(bounce)
+            staging.release()  # drop the alias before the MR changes hands
+            self.mr_cache.release(bounce, bounce_cls)
             lib.tpr_verbs_qp_destroy(qp)
 
         w = VerbsWindow(write, close)

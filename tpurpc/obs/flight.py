@@ -163,6 +163,13 @@ BUNDLE_WRITTEN = 63       # a1 = trigger code, a2 = bundle ordinal
 SEQ_SUBMIT = 64           # a1 = seq id, a2 = prompt tokens
 SEQ_FIRST_TOKEN = 65      # a1 = seq id, a2 = TTFT (us)
 SEQ_DETACH = 66           # a1 = seq id, a2 = KV entries handed out
+# tpurpc-hive (ISSUE 16): the connection-scale plane. PARK/UNPARK bracket
+# one parked episode per pair (the `park` protocol machine forbids a
+# double-park or an unpark with no preceding park); ACCEPT_SHED is the
+# listener's pre-handshake pushback under a reconnect storm.
+PAIR_PARK = 67            # a1 = ring bytes returned to the pool
+PAIR_UNPARK = 68          # a1 = ring bytes re-leased, a2 = 1 if remote wake
+ACCEPT_SHED = 69          # a1 = inflight handshakes, a2 = pushback (ms)
 
 EVENT_NAMES: Dict[int, str] = {
     PAIR_CONNECT: "pair-connect",
@@ -231,6 +238,9 @@ EVENT_NAMES: Dict[int, str] = {
     SEQ_SUBMIT: "seq-submit",
     SEQ_FIRST_TOKEN: "seq-first-token",
     SEQ_DETACH: "seq-detach",
+    PAIR_PARK: "pair-park",
+    PAIR_UNPARK: "pair-unpark",
+    ACCEPT_SHED: "accept-shed",
 }
 
 #: batch-flush reason codes (a1 of BATCH_FLUSH) — mirrors the jaxshim

@@ -173,6 +173,22 @@ class AdmissionGate:
         with self._lock:
             return self._inflight
 
+    def connection_pushback_ms(self) -> Optional[int]:
+        """Connection-level pressure probe for the accept path (ISSUE 16
+        accept-storm hardening — ``EndpointListener`` consults this before
+        spending any handshake work on a freshly accepted socket). Sheds
+        new CONNECTIONS only at hard saturation (inflight at
+        ``max_inflight``): between the limits, existing clients keep
+        reconnecting and the per-RPC gate does the fine-grained shedding.
+        Pure probe — admits nothing, so no :meth:`release` is owed."""
+        with self._lock:
+            n = self._inflight
+            if n < self.max_inflight:
+                return None
+            excess = max(1, n - self.soft_limit + 1)
+            return min(self.max_pushback_ms,
+                       self.base_pushback_ms * excess)
+
     @classmethod
     def from_env(cls) -> "Optional[AdmissionGate]":
         """Gate configured by ``TPURPC_ADMISSION_MAX_INFLIGHT`` (+ optional
@@ -1413,9 +1429,19 @@ class Server:
             ssl_context=ssl_context,
             raw_hook=None if ssl_context is not None
             else self._try_native_adopt,
-            reuseport=reuseport)
+            reuseport=reuseport,
+            admission=self._accept_pushback)
         self._listeners.append(listener)
         return listener.port
+
+    def _accept_pushback(self) -> "Optional[int]":
+        """Accept-path face of the admission gate (ISSUE 16): the
+        listener sheds stormed connections before handshake work when the
+        RPC plane is saturated."""
+        gate = self.admission
+        if gate is None:
+            return None
+        return gate.connection_pushback_ms()
 
     def adopt_socket(self, sock) -> None:
         """tpurpc-manycore handoff entry: serve a connection that was

@@ -1,0 +1,291 @@
+"""tpurpc-hive connection-scale smoke (ISSUE 16).
+
+One process, thousands of parked pairs: build a loopback fleet sized to the
+fd budget (target 5000 pairs = 2500 connections, 10 fds each), park BOTH
+sides of every connection, then wake a slice of it under live pipelined
+traffic.  Asserts the things the C100K plane promises:
+
+  * a parked pair holds no ring — RingPool accounting balances exactly
+    (free bytes == parked pairs x (ring + status class)), and every parked
+    pair's resident estimate is <= 4KiB;
+  * park/unpark is invisible to traffic — payloads pipelined into parked
+    connections arrive intact after the automatic wake;
+  * pool accounting is conserved across unpark (leased + free bytes is
+    constant) and drains to zero leased regions at quiesce;
+  * the ``pairs_parked`` / ``pair_resident_bytes_est`` fleet gauges and the
+    ``ring_pool_{leased,free}_bytes`` gauges agree with ground truth, and
+    PAIR_PARK / PAIR_UNPARK flight events exist for the protocol replay;
+  * the Poller's idle sweep (TPURPC_PAIR_PARK_S) parks a registered pair
+    end-to-end and its parked-stub watcher completes a remote wake with no
+    owner thread blocked on the pair.
+
+Runs in ~5s with no jax and no network.  Wired into tools/check.sh.
+"""
+
+import dataclasses
+import os
+import resource
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+RING = 4096
+TARGET_CONNS = 2500          # 5000 pairs, the ISSUE 16 floor
+FDS_PER_CONN = 10            # 2 socketpair ends + 8 wake-pipe ends (measured)
+WAKE_CONNS = 64              # slice woken under pipelined traffic
+PAYLOADS = [b"hive-%02d!" % i * 23 for i in range(4)]  # pipelined per conn
+
+
+def _pump(a, b) -> bool:
+    hot = False
+    for p in (a, b):
+        try:
+            if p.drain_notifications():
+                p.kick()
+                hot = True
+        except Exception:
+            pass
+    return hot
+
+
+def _pump_until(pairs, pred, deadline_s=10.0) -> bool:
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        hot = False
+        for a, b in pairs:
+            hot |= _pump(a, b)
+        if not hot:
+            time.sleep(0.001)
+    return pred()
+
+
+def _build_fleet():
+    import tpurpc.core.pair as P
+
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    if soft < hard:
+        try:
+            resource.setrlimit(resource.RLIMIT_NOFILE, (hard, hard))
+            soft = hard
+        except (ValueError, OSError):
+            pass
+    cap = max(8, (soft - 100) // FDS_PER_CONN)
+    conns = min(TARGET_CONNS, cap)
+    if conns < TARGET_CONNS:
+        print(f"  [fleet] NOTE: fd limit {soft} caps the fleet at "
+              f"{conns} connections ({2 * conns} pairs) — the 5000-pair "
+              f"target needs RLIMIT_NOFILE >= {TARGET_CONNS * FDS_PER_CONN + 100}")
+    t0 = time.monotonic()
+    fleet = [P.create_loopback_pair(ring_size=RING) for _ in range(conns)]
+    print(f"  [fleet] {conns} loopback connections ({2 * conns} pairs) "
+          f"in {time.monotonic() - t0:.2f}s")
+    return fleet
+
+
+def _park_fleet(fleet) -> None:
+    import tpurpc.core.pair as P
+
+    t0 = time.monotonic()
+    now = time.monotonic()
+    for a, b in fleet:
+        a.maybe_park(now, 0.0)
+        b.maybe_park(now, 0.0)
+    def all_parked():
+        return all(a._parked and b._parked for a, b in fleet)
+    # a re-initiating sweep: an ack can race the first round's drain order
+    deadline = time.monotonic() + 15.0
+    while not all_parked() and time.monotonic() < deadline:
+        if not _pump_until(fleet, all_parked, deadline_s=1.0):
+            now = time.monotonic()
+            for a, b in fleet:
+                if not a._parked:
+                    a.maybe_park(now, 0.0)
+                if not b._parked:
+                    b.maybe_park(now, 0.0)
+    parked = sum(int(a._parked) + int(b._parked) for a, b in fleet)
+    assert parked == 2 * len(fleet), \
+        f"park sweep incomplete: {parked}/{2 * len(fleet)} pairs parked"
+    print(f"  [park] {parked} pairs parked in {time.monotonic() - t0:.2f}s")
+
+    stats = P.RingPool.get().stats()
+    per_pair = RING + P.STATUS_BYTES
+    want_free = parked * per_pair
+    assert stats["free_bytes"] == want_free, \
+        f"pool free {stats['free_bytes']} != parked rings {want_free}"
+    assert stats["leased_regions"] == 0, stats
+    for a, b in fleet:
+        for p in (a, b):
+            est = p.resident_bytes_est()
+            assert est <= 4096, f"parked pair resident estimate {est} > 4KiB"
+    print(f"  [park] pool holds {stats['free_bytes']} free bytes "
+          f"({stats['free_regions']} regions), 0 leased; "
+          f"resident estimate <= 4KiB per parked pair")
+
+
+def _wake_slice(fleet) -> None:
+    import tpurpc.core.pair as P
+
+    subset = fleet[:WAKE_CONNS]
+    total_stats = P.RingPool.get().stats()
+    conserved = total_stats["free_bytes"] + total_stats["leased_bytes"]
+
+    t0 = time.monotonic()
+    want = b"".join(PAYLOADS)
+    got = {id(a): bytearray() for a, _ in subset}
+    sent = {id(a): 0 for a, _ in subset}
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        done = True
+        for a, b in subset:
+            k = id(a)
+            if sent[k] < len(want):
+                # b.send unparks b and wakes parked a in-band; a retryable
+                # 0 while the park episode resolves is the contract
+                sent[k] += b.send([want[sent[k]:]])
+            if len(got[k]) < len(want):
+                chunk = a.recv()
+                if chunk:
+                    got[k] += chunk
+            if sent[k] < len(want) or len(got[k]) < len(want):
+                done = False
+            _pump(a, b)
+        if done:
+            break
+    bad = [k for k, v in got.items() if bytes(v) != want]
+    assert not bad, \
+        f"{len(bad)}/{len(subset)} woken connections corrupted or incomplete"
+    print(f"  [wake] {len(subset)} connections woken under pipelined traffic "
+          f"in {time.monotonic() - t0:.2f}s; "
+          f"{len(subset)} x {len(want)}B payloads intact")
+
+    stats = P.RingPool.get().stats()
+    assert stats["free_bytes"] + stats["leased_bytes"] == conserved, \
+        (stats, conserved)
+    per_pair = RING + P.STATUS_BYTES
+    assert stats["leased_bytes"] == 2 * len(subset) * per_pair, stats
+    print(f"  [wake] pool conserved: {stats['leased_bytes']}B re-leased to "
+          f"{2 * len(subset)} unparked pairs, "
+          f"{stats['free_bytes']}B still pooled")
+
+
+def _check_observability(fleet) -> None:
+    from tpurpc.obs import flight, metrics
+
+    snap = metrics.snapshot()
+    parked_truth = sum(int(a._parked) + int(b._parked) for a, b in fleet)
+    fleet_gauge = snap["fleet"].get("pairs_parked", {})
+    assert fleet_gauge.get("sum") == float(parked_truth), \
+        (fleet_gauge, parked_truth)
+    import tpurpc.core.pair as P
+    stats = P.RingPool.get().stats()
+    gauges = snap["gauges"]
+    assert gauges.get("ring_pool_free_bytes") == float(stats["free_bytes"]), \
+        (gauges.get("ring_pool_free_bytes"), stats)
+    assert gauges.get("ring_pool_leased_bytes") == float(
+        stats["leased_bytes"]), (gauges.get("ring_pool_leased_bytes"), stats)
+    counters = snap["counters"]
+    assert counters.get("pair_park", 0) >= parked_truth, counters
+    assert counters.get("pair_unpark", 0) >= 2 * WAKE_CONNS, counters
+    events = {e["event"] for e in flight.snapshot()}
+    assert "pair-park" in events and "pair-unpark" in events, sorted(events)
+    print(f"  [obs] pairs_parked={int(fleet_gauge['sum'])} "
+          f"pair_park={counters['pair_park']} "
+          f"pair_unpark={counters['pair_unpark']}; "
+          f"flight has pair-park/pair-unpark events")
+
+
+def _poller_sweep_roundtrip() -> None:
+    """End-to-end: an idle pair registered on the Poller is parked by the
+    background sweep, and the parked-stub watcher completes a remote wake
+    with no owner thread involved."""
+    import tpurpc.core.pair as P
+    from tpurpc.core.poller import Poller
+    from tpurpc.utils.config import get_config, set_config
+
+    cfg = get_config()
+    set_config(dataclasses.replace(cfg, pair_park_s=0.05))
+    try:
+        Poller.reset()
+        poller = Poller.get()
+        a, b = P.create_loopback_pair(ring_size=RING)
+        poller.add_pollable(a)
+        deadline = time.monotonic() + 5.0
+        while not a._parked and time.monotonic() < deadline:
+            if b.drain_notifications():  # b acks the sweep's park request
+                b.kick()
+            time.sleep(0.002)
+        assert a._parked, "poller sweep never parked the idle pair"
+        print("  [sweep] background sweep parked the registered pair "
+              "(TPURPC_PAIR_PARK_S=0.05)")
+        payload = b"sweep-wake!"
+        sent = 0
+        deadline = time.monotonic() + 5.0
+        while sent < len(payload) and time.monotonic() < deadline:
+            sent += b.send([payload[sent:]])
+            if b.drain_notifications():
+                b.kick()
+            time.sleep(0.002)
+        # a has NO owner thread: only the poller's parked-stub watcher can
+        # see the wake frame and run the unpark
+        deadline = time.monotonic() + 5.0
+        got = bytearray()
+        while len(got) < len(payload) and time.monotonic() < deadline:
+            if a._parked:
+                time.sleep(0.002)
+                continue
+            chunk = a.recv()
+            if chunk:
+                got += chunk
+            else:
+                time.sleep(0.002)
+        assert bytes(got) == payload, \
+            f"ownerless wake lost data: {bytes(got)!r}"
+        print("  [sweep] parked-stub watcher completed the ownerless wake; "
+              "payload intact")
+        a.destroy()
+        b.destroy()
+    finally:
+        set_config(cfg)
+        Poller.reset()
+
+
+def _teardown(fleet) -> None:
+    import tpurpc.core.pair as P
+
+    for a, b in fleet:
+        try:
+            a.destroy()
+            b.destroy()
+        except Exception:
+            pass
+    stats = P.RingPool.get().stats()
+    assert stats["leased_regions"] == 0, \
+        f"destroy leaked pool leases: {stats}"
+    print(f"  [teardown] fleet destroyed; pool leases drained to zero "
+          f"({stats['free_regions']} regions retained for reuse)")
+
+
+def main() -> int:
+    t0 = time.monotonic()
+    import tpurpc.core.pair as P
+
+    P.RingPool.reset()
+    fleet = _build_fleet()
+    try:
+        _park_fleet(fleet)
+        _wake_slice(fleet)
+        _check_observability(fleet)
+        _poller_sweep_roundtrip()
+    finally:
+        _teardown(fleet)
+        P.RingPool.reset()
+    print(f"scale smoke: PASS ({2 * len(fleet)} pairs, "
+          f"{time.monotonic() - t0:.1f}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

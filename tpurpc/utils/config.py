@@ -93,6 +93,16 @@ def _env_int(name: str, default: int, *aliases: str) -> int:
         raise ValueError(f"{key}={val!r} is not an integer") from exc
 
 
+def _env_float(name: str, default: float, *aliases: str) -> float:
+    key, val = env_lookup(name, *aliases)
+    if val is None:
+        return default
+    try:
+        return float(val)
+    except ValueError as exc:
+        raise ValueError(f"{key}={val!r} is not a number") from exc
+
+
 def _env_bool(name: str, default: bool, *aliases: str) -> bool:
     val = _env(name, *aliases)
     if val is None:
@@ -176,6 +186,16 @@ class Config:
     #: real cross-host deployments.
     tcpw_host: str = "127.0.0.1"
     tcpw_bind: str = "0.0.0.0"
+    #: tpurpc-hive (ISSUE 16): park a pair whose rings have been quiet this
+    #: many seconds — its ring regions return to the shared RingPool and its
+    #: poller slot frees, leaving a ~200-byte stub until the next byte.
+    #: 0 (the default) disables parking entirely; the C100K deployments the
+    #: RDMAvisor analysis targets opt in explicitly.
+    pair_park_s: float = 0.0
+    #: bound on how many extra pending accepts one listener wakeup may
+    #: drain (the accept-storm burst); each drained socket still passes the
+    #: admission gate before any handshake work is spent on it
+    accept_burst: int = 64
 
     @property
     def ring_buffer_size(self) -> int:
@@ -260,6 +280,8 @@ class Config:
                          or cls.ring_domain).strip().lower(),
             tcpw_host=_env("TPURPC_TCPW_HOST") or cls.tcpw_host,
             tcpw_bind=_env("TPURPC_TCPW_BIND") or cls.tcpw_bind,
+            pair_park_s=_env_float("TPURPC_PAIR_PARK_S", cls.pair_park_s),
+            accept_burst=_env_int("TPURPC_ACCEPT_BURST", cls.accept_burst),
         )
 
     @property
