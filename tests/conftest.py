@@ -29,6 +29,13 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 run (-m 'not slow'); covered by "
+        "the analysis gate or a dedicated stage instead")
+
+
 @pytest.fixture(autouse=True)
 def _reset_config_singleton():
     """Each test sees a fresh Config.from_env() so monkeypatched env vars apply;

@@ -1500,3 +1500,94 @@ def test_argus_modules_are_clean():
         with open(mod.__file__, "r", encoding="utf-8") as f:
             vs = lint_source(f.read(), mod.__file__)
         assert vs == [], (mod.__name__, list(map(str, vs)))
+
+
+# ---------------------------------------------------------------------------
+# lint: cross-process sends route through the transport seam (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+XPROC_BAD_RAW = '''
+class Pair:
+    def hot_notify(self, token):
+        self._notify_raw(token)          # around the seam: flagged
+
+    def _send_frame(self, payload):
+        r = _transport.dispatch("frame", self, self._send_frame_raw, payload)
+        if r is NotImplemented:
+            return self._send_frame_raw(payload)  # seam fallback: fine
+        return r
+
+    def _send_frame_raw(self, payload):
+        return self.sock.sendall(payload)         # raw impl: fine
+'''
+
+XPROC_BAD_RING = '''
+class CtrlPlane:
+    def post_fast(self, op, payload):
+        tx = self.tx
+        return tx.post(op, 0, payload, 0)  # peer-ring store, no seam
+'''
+
+
+def test_xproc_flags_raw_send_around_the_seam():
+    vs = [v for v in lint_source(XPROC_BAD_RAW, "tpurpc/core/pair.py")
+          if v.rule == "xproc"]
+    assert len(vs) == 1 and vs[0].line == 4, list(map(str, vs))
+
+
+def test_xproc_seam_wrapper_and_raw_impl_are_exempt():
+    ok = XPROC_BAD_RAW.replace("self._notify_raw(token)",
+                               '_transport.dispatch("frame", self, '
+                               "self._notify_raw, token)")
+    assert [v for v in lint_source(ok, "tpurpc/core/pair.py")
+            if v.rule == "xproc"] == []
+
+
+def test_xproc_flags_direct_peer_ring_post():
+    vs = [v for v in lint_source(XPROC_BAD_RING, "tpurpc/core/ctrlring.py")
+          if v.rule == "xproc"]
+    assert len(vs) == 1 and "tx.post" in vs[0].message
+
+
+def test_xproc_scoped_to_cross_process_modules():
+    # the same source off the cross-process module set is fine
+    assert lint_source(XPROC_BAD_RAW, "tpurpc/obs/flight.py") == []
+    assert lint_source(XPROC_BAD_RAW, "fixture.py") == []
+    # ...and every declared cross-process module enforces it
+    for mod in ("tpurpc/core/pair.py", "tpurpc/core/rendezvous.py",
+                "tpurpc/core/ctrlring.py", "tpurpc/serving/disagg.py"):
+        assert [v.rule for v in lint_source(XPROC_BAD_RAW, mod)
+                if v.rule == "xproc"] == ["xproc"]
+
+
+def test_xproc_receive_side_raw_is_not_a_send():
+    src = '''
+class Pair:
+    def drain_notifications(self):
+        return self._drain_raw()   # local read of our own socket: fine
+'''
+    assert lint_source(src, "tpurpc/core/pair.py") == []
+
+
+def test_xproc_suppression_comment():
+    ok = XPROC_BAD_RAW.replace(
+        "self._notify_raw(token)          # around the seam: flagged",
+        "self._notify_raw(token)  # tpr: allow(xproc)")
+    assert [v for v in lint_source(ok, "tpurpc/core/pair.py")
+            if v.rule == "xproc"] == []
+
+
+def test_xproc_modules_are_clean():
+    """The real cross-process modules route every wire effect through the
+    seam — the property that makes simnet's exploration exhaustive over
+    their sends."""
+    import tpurpc.core.ctrlring as ctrlring_mod
+    import tpurpc.core.pair as pair_mod
+    import tpurpc.core.rendezvous as rendezvous_mod
+    import tpurpc.serving.disagg as disagg_mod
+
+    for mod in (pair_mod, rendezvous_mod, ctrlring_mod, disagg_mod):
+        with open(mod.__file__, "r", encoding="utf-8") as f:
+            vs = lint_source(f.read(), mod.__file__)
+        assert [v for v in vs if v.rule == "xproc"] == [], (
+            mod.__name__, list(map(str, vs)))

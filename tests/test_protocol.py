@@ -162,3 +162,94 @@ def test_uninstall_live_detaches():
     assert protocol.live_verifier() is None
     tag = flight.tag_for("live-detached-entity")
     flight.emit(flight.GEN_STEP_BEGIN, tag, 1, 0)  # no verifier: no-op
+
+
+# -- merged per-process dumps (ISSUE 17) --------------------------------------
+
+def _anchored_dump(path, events, pid, mono_ns, wall_ns, unc=1000):
+    path.write_text(json.dumps({
+        "events": events,
+        "clock_anchor": {"pid": pid, "mono_ns": mono_ns,
+                         "wall_ns": wall_ns, "uncertainty_ns": unc}}))
+
+
+def test_real_disagg_ship_split_into_two_anchored_dumps(tmp_path):
+    """The regression the merged checker exists for: a REAL in-process
+    handoff + migration recorded through the real recorder, split into a
+    source dump (migration bracket) and a destination dump (ship
+    offer/complete) with DIFFERENT monotonic clocks anchored to one wall
+    clock — the merged stream must conform, including the cross-process
+    rule that the successful MIG_END covers the destination's
+    KV_SHIP_COMPLETE."""
+    F = flight
+    src_rec = F.FlightRecorder(capacity=64)
+    dst_rec = F.FlightRecorder(capacity=64)
+    tag_s, tag_d = F.tag_for("mig-src"), F.tag_for("mig-dst")
+    # two processes, two monotonic clocks: src t=100.., dst t=9000..,
+    # anchored so wall(src 100) == wall(dst 9000)
+    src_rec.emit(F.MIG_BEGIN, tag_s, 42, 4)          # src mono ~now
+    dst_rec.emit(F.KV_SHIP_OFFER, tag_d, 11, 1 << 20)
+    dst_rec.emit(F.KV_SHIP_COMPLETE, tag_d, 11, 1 << 20)
+    src_rec.emit(F.MIG_END, tag_s, 42, 1)
+    src_ev, dst_ev = src_rec.snapshot(), dst_rec.snapshot()
+    # rebase both rings onto synthetic per-process clocks sharing a wall
+    # anchor: src events at mono 100/400, dst at mono 9200/9300 — the
+    # raw t_ns values would interleave WRONG without the anchors
+    for ev, t in zip(src_ev, (100, 400)):
+        ev["t_ns"] = t
+    for ev, t in zip(dst_ev, (9150, 9250)):
+        ev["t_ns"] = t
+    a, b = tmp_path / "src.json", tmp_path / "dst.json"
+    _anchored_dump(a, src_ev, pid=100, mono_ns=0, wall_ns=5_000_000)
+    _anchored_dump(b, dst_ev, pid=200, mono_ns=9_000, wall_ns=5_000_000)
+    total, v = protocol.check_dumps([str(a), str(b)])
+    assert (total, v) == (4, []), list(map(str, v))
+
+
+def test_merged_dumps_catch_missing_cross_process_landing(tmp_path):
+    """Tampered pair: the destination's COMPLETE falls OUTSIDE the
+    migration bracket on the shared wall clock — each per-process dump
+    still conforms on its own, only the merged stream can see the
+    successful migration whose bytes never landed."""
+    F = flight
+    src = [protocol._ev(F.MIG_BEGIN, tag=7, a1=42, a2=4, t_ns=100),
+           protocol._ev(F.MIG_END, tag=7, a1=42, a2=1, t_ns=400)]
+    dst = [protocol._ev(F.KV_SHIP_OFFER, tag=9, a1=11, a2=1, t_ns=50_000),
+           protocol._ev(F.KV_SHIP_COMPLETE, tag=9, a1=11, a2=1,
+                        t_ns=50_100)]
+    a, b = tmp_path / "src.json", tmp_path / "dst.json"
+    _anchored_dump(a, src, pid=100, mono_ns=0, wall_ns=5_000_000)
+    _anchored_dump(b, dst, pid=200, mono_ns=0, wall_ns=5_000_000)
+    total, v = protocol.check_dumps([str(a), str(b)])
+    assert total == 4
+    assert [x.machine for x in v] == ["xproc-mig-ship"], list(map(str, v))
+    # each dump alone is blind to the defect
+    assert protocol.check_dumps([str(a)])[1] == []
+    assert protocol.check_dumps([str(b)])[1] == []
+
+
+def test_explicit_multi_dump_without_anchors_is_loud(tmp_path):
+    good = protocol._good_trace()
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    a.write_text(json.dumps(good))
+    b.write_text(json.dumps({"events": []}))
+    _, v = protocol.check_dumps([str(a), str(b)])
+    assert [x.machine for x in v] == ["xproc-merge"]
+    # ...but a DIRECTORY of historical anchorless dumps stays tolerant
+    _, v = protocol.check_dumps([str(tmp_path)])
+    assert v == []
+
+
+def test_merged_tags_do_not_collide_across_processes(tmp_path):
+    """Two processes both use tag 7 for UNRELATED machine instances; the
+    per-process namespacing must keep them apart in the merged stream
+    (without it, dst's open migration would collide with src's)."""
+    F = flight
+    src = [protocol._ev(F.MIG_BEGIN, tag=7, a1=42, a2=4, t_ns=100),
+           protocol._ev(F.MIG_END, tag=7, a1=42, a2=0, t_ns=400)]
+    dst = [protocol._ev(F.MIG_BEGIN, tag=7, a1=42, a2=4, t_ns=200)]
+    merged = protocol.merge_anchored([
+        (src, {"mono_ns": 0, "wall_ns": 0}),
+        (dst, {"mono_ns": 0, "wall_ns": 0})])
+    assert len({e["tag"] for e in merged}) == 2
+    assert protocol.check_events(merged, strict=True) == []

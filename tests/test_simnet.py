@@ -1,0 +1,387 @@
+"""tpurpc-simnet (ISSUE 17): the deterministic distributed simulator.
+
+The contracts under test:
+
+* every cross-process scenario — the REAL DisaggDecode/_KvShipper/
+  migrate/DecodeScheduler/CtrlPlane classes wired as simulated nodes
+  through the transport seam — explores CLEAN at the quick bound (the
+  simulated fabric does not invent bugs);
+* every seeded distributed mutant (:mod:`tpurpc.analysis.simmutants`:
+  a COMPLETE hoisted over its one-sided write, a reap that frees instead
+  of quarantining, a drain dropping resumable sequences, a skipped ring
+  kick, the pre-fix close/complete park race) is found BY MESSAGE-LEVEL
+  EXPLORATION — a violating delivery order or a reported deadlock, never
+  a sequential unit failure;
+* determinism and replay: DFS is repeatable, a violating pick trace
+  serializes and replays to the same violation;
+* crash coverage: killing the sender at EVERY message index of the
+  handoff leaves the receiver's arena fully accounted (no leak at any
+  crash point);
+* the SimNet fabric itself: FIFO links, held-not-lost partitions,
+  dead-node drops, crash-at-interaction-k, RPC abort/fault surfacing,
+  and the arena-accounting invariant helper.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from tpurpc.analysis import schedule, simnet
+from tpurpc.analysis.schedule import SchedViolation
+from tpurpc.analysis.simmutants import SIM_MUTANTS
+from tpurpc.analysis.simnet import NodeCrashed, SimChannel, SimNet, SimRpcError
+
+pytestmark = pytest.mark.filterwarnings("ignore::ResourceWarning")
+
+
+# -- clean tree: the simulated protocols hold within the bound ----------------
+
+@pytest.mark.parametrize("name", sorted(simnet.SIM_SCENARIOS))
+def test_clean_scenarios_explore_ok_at_bound1(name):
+    res = simnet.run_scenario(name, preemption_bound=1, max_schedules=150)
+    assert res.ok, res.violation
+    assert res.schedules > 1, "no delivery interleavings explored?"
+
+
+def test_kvship_exhausts_at_bound0():
+    """Run-to-block exploration (delivery orders only, no mid-function
+    preemption) must EXHAUST — uncapped — and stay clean."""
+    res = simnet.run_scenario("simnet-kvship", preemption_bound=0,
+                              max_schedules=4000)
+    assert res.ok, res.violation
+    assert not res.capped, "bound-0 delivery orders should exhaust"
+
+
+# -- seeded distributed mutants: found by exploration -------------------------
+
+@pytest.mark.parametrize("mutant", sorted(SIM_MUTANTS))
+def test_every_sim_mutant_is_killed(mutant):
+    m = SIM_MUTANTS[mutant]
+    res = simnet.run_scenario(m.scenario, preemption_bound=2,
+                              max_schedules=4000, mutant=mutant)
+    assert res.violation is not None, (
+        f"mutant {mutant} SURVIVED {res.schedules} schedules — the "
+        "simulated fabric lost its teeth")
+
+
+def test_mutant_kill_suite_all_killed():
+    kills = simnet.mutant_kill_suite(preemption_bound=2,
+                                     max_schedules=4000)
+    assert len(kills) >= 4  # the acceptance floor
+    survivors = [k for k, v in kills.items() if not v]
+    assert not survivors, survivors
+
+
+def test_skipped_kick_is_a_deadlock_report_not_a_hang():
+    """The lost-wakeup mutant must surface as the explorer's DEADLOCK
+    violation — every live task parked on untimed waits, with the pick
+    trace — not as a hung test run (the liveness half of the contract)."""
+    res = simnet.run_scenario("simnet-ctrl-kick", preemption_bound=1,
+                              max_schedules=2000,
+                              mutant="ctrl_kick_skipped")
+    assert res.violation is not None
+    assert res.violation.kind == "deadlock", res.violation
+    assert res.violation.trace, "deadlock report lost its pick trace"
+
+
+def test_hoisted_complete_dies_in_any_delivery_order():
+    """ship_complete_before_write is an ORDERING bug at the message
+    level: once the COMPLETE is posted before the write, the FIFO link
+    delivers it first in EVERY schedule — the very first explored
+    schedule must already kill it (the invariant runs at each
+    delivery)."""
+    res = simnet.run_scenario("simnet-kvship", preemption_bound=0,
+                              max_schedules=50,
+                              mutant="ship_complete_before_write")
+    assert res.violation is not None
+    assert "PARKED before its bytes landed" in res.violation.message
+
+
+# -- determinism and replay ---------------------------------------------------
+
+def test_dfs_is_deterministic():
+    r1 = simnet.run_scenario("simnet-kvship", preemption_bound=1,
+                             max_schedules=60)
+    r2 = simnet.run_scenario("simnet-kvship", preemption_bound=1,
+                             max_schedules=60)
+    assert (r1.schedules, r1.steps) == (r2.schedules, r2.steps)
+
+
+def test_random_exploration_same_seed_identical_traces():
+    scen = simnet.SIM_SCENARIOS["simnet-ctrl-kick"]
+    r1, traces1 = schedule.explore_random(scen(), seed=77, schedules=4)
+    r2, traces2 = schedule.explore_random(scen(), seed=77, schedules=4)
+    assert r1.ok and r2.ok
+    assert traces1 == traces2, "same seed must drive identical schedules"
+
+
+@pytest.mark.parametrize("mutant", ["ship_complete_before_write",
+                                    "reap_free_instead_of_quarantine"])
+def test_violating_trace_replays_to_same_violation(mutant):
+    m = SIM_MUTANTS[mutant]
+    found = simnet.run_scenario(m.scenario, preemption_bound=1,
+                                max_schedules=2000, mutant=mutant)
+    assert found.violation is not None
+    # serialize the pick trace the way an operator would ship it
+    wire = json.dumps(found.violation.trace)
+    trace = json.loads(wire)
+    scenario = simnet.SIM_SCENARIOS[m.scenario]()
+    with m.applied():
+        replayed = schedule.replay(scenario, trace)
+    assert replayed.violation is not None, "replay lost the violation"
+    assert replayed.violation.kind == found.violation.kind
+
+
+@pytest.mark.parametrize("mutant", ["ship_complete_before_write"])
+def test_bug_found_at_bound_k_is_found_at_k_plus_1(mutant):
+    m = SIM_MUTANTS[mutant]
+    at_1 = simnet.run_scenario(m.scenario, preemption_bound=1,
+                               max_schedules=2000, mutant=mutant)
+    assert at_1.violation is not None
+    at_2 = simnet.run_scenario(m.scenario, preemption_bound=2,
+                               max_schedules=4000, mutant=mutant)
+    assert at_2.violation is not None, (
+        "found at bound 1 but NOT at bound 2 — the bound-k schedules "
+        "are not a subset of bound-k+1's")
+
+
+# -- crash coverage: every message index of the handoff -----------------------
+
+@pytest.mark.parametrize("crash_at", [0, 1, 2])
+def test_sender_crash_at_every_message_point_leaks_nothing(crash_at):
+    """Kill the prefill node at its (crash_at+1)-th transport interaction
+    — before the offer (0), between offer and write (1), before the
+    COMPLETE (2, the stock scenario) — and the receiver's arena must
+    still be fully accounted (free + quarantined + cache + owned covers
+    every block). The death scenario's own check pins the crash-at-2
+    shape; this sweep asserts the universal no-leak contract at every
+    point where the sender can actually die mid-handoff."""
+    factory = simnet.SIM_SCENARIOS["simnet-kvship-death"]
+
+    def patched():
+        scen = factory()
+        orig_setup = scen.setup
+
+        def setup(sched):
+            state = orig_setup(sched)
+            state["net"].crash_after("P", crash_at)
+            return state
+
+        return schedule.Scenario(scen.name, setup, scen.threads,
+                                 _crashpoint_check, scen.instrument,
+                                 teardown=scen.teardown,
+                                 max_steps=scen.max_steps)
+
+    res = schedule.explore(patched(), preemption_bound=0,
+                           max_schedules=300)
+    assert res.ok, f"crash at interaction {crash_at}: {res.violation}"
+
+
+def _crashpoint_check(state):
+    # the stock death-scenario check pins q_after_reap to the crash-at-2
+    # shape; the sweep only asserts the universal invariant — a dead
+    # sender never strands or double-frees receiver blocks
+    dec = state["decode"]
+    simnet._accounted(state["mgr"],
+                      owners=[p.kv for p in dec._parked.values()]
+                      + [p.kv for p in dec._pending.values()])
+
+
+# -- the fabric itself --------------------------------------------------------
+
+def _explore_net(nodes, driver_nodes, drivers, check,
+                 prepare=None, bound=0, max_schedules=50):
+    """One-shot SimNet harness: build the net, run ``drivers`` on their
+    nodes with couriers on every directed pair, explore, return result."""
+    def setup(sched):
+        net = SimNet(sched, nodes)
+        state = {"net": net}
+        if prepare is not None:
+            prepare(net, state)
+        net.drivers_expected = len(drivers)
+        net.install()
+        return state
+
+    threads = [lambda state, n=n, fn=fn: state["net"].on_node(n, fn)(state)
+               for n, fn in zip(driver_nodes, drivers)]
+    for a in nodes:
+        for b in nodes:
+            if a != b:
+                threads.append(
+                    lambda state, a=a, b=b: state["net"]._courier(a, b))
+    scen = schedule.Scenario("simnet-fabric", setup, threads, check,
+                             instrument=[],
+                             teardown=lambda state: state["net"].close())
+    return schedule.explore(scen, preemption_bound=bound,
+                            max_schedules=max_schedules)
+
+
+def test_fifo_link_preserves_per_pair_order():
+    """Two effects posted A->B arrive in post order in EVERY schedule —
+    the same-QP/FIFO rule the real handoff's write-before-complete
+    ordering leans on."""
+    def driver(state):
+        net, log = state["net"], state["log"]
+        net.post("A", "B", "first", lambda: log.append(1))
+        net.post("A", "B", "second", lambda: log.append(2))
+
+    def check(state):
+        assert state["log"] == [1, 2], state["log"]
+
+    res = _explore_net(["A", "B"], ["A"], [driver], check,
+                       prepare=lambda net, st: st.update(log=[]),
+                       bound=2, max_schedules=200)
+    assert res.ok, res.violation
+
+
+def test_partition_holds_then_heal_delivers():
+    def driver(state):
+        net = state["net"]
+        net.partition("A", "B")
+        net.post("A", "B", "held", lambda: state["log"].append("x"))
+        assert state["log"] == []  # held, not delivered, not lost
+        net.heal("A", "B")
+
+    def check(state):
+        assert state["log"] == ["x"]
+        state["net"].assert_delivered()
+
+    res = _explore_net(["A", "B"], ["A"], [driver], check,
+                       prepare=lambda net, st: st.update(log=[]))
+    assert res.ok, res.violation
+
+
+def test_permanent_partition_flushes_to_dropped():
+    def driver(state):
+        net = state["net"]
+        net.partition("A", "B")
+        net.post("A", "B", "lost-frame", lambda: state["log"].append("x"))
+
+    def check(state):
+        assert state["log"] == []
+        assert state["net"].links[("A", "B")].dropped == ["lost-frame"]
+
+    res = _explore_net(["A", "B"], ["A"], [driver], check,
+                       prepare=lambda net, st: st.update(log=[]))
+    assert res.ok, res.violation
+
+
+def test_effects_to_a_dead_node_drop_with_attribution():
+    def driver(state):
+        net = state["net"]
+        net.kill("B")
+        net.post("A", "B", "to-the-dead", lambda: state["log"].append("x"))
+
+    def check(state):
+        assert state["log"] == []
+        assert state["net"].links[("A", "B")].dropped == ["to-the-dead"]
+
+    res = _explore_net(["A", "B"], ["A"], [driver], check,
+                       prepare=lambda net, st: st.update(log=[]))
+    assert res.ok, res.violation
+
+
+def test_crash_after_k_interactions_unwinds_the_driver():
+    def driver(state):
+        net = state["net"]
+        net.post("A", "B", "one", lambda: state["log"].append(1))
+        net.post("A", "B", "two", lambda: state["log"].append(2))
+        net.post("A", "B", "three", lambda: state["log"].append(3))
+        state["ran-past-crash"] = True  # must be unreachable
+
+    def check(state):
+        # crash at the 3rd interaction: two effects queued, the third
+        # never sent, the driver unwound via NodeCrashed (absorbed)
+        assert state["log"] == [1, 2], state["log"]
+        assert "ran-past-crash" not in state
+        assert state["net"].alive["A"] is False
+
+    res = _explore_net(["A", "B"], ["A"], [driver], check,
+                       prepare=lambda net, st: (st.update(log=[]),
+                                                net.crash_after("A", 2)))
+    assert res.ok, res.violation
+
+
+def test_sim_rpc_abort_surfaces_to_caller():
+    from tpurpc.rpc.status import StatusCode
+
+    def prepare(net, state):
+        chan = SimChannel(net, "A", "B", {
+            "/svc/deny": lambda req, ctx: ctx.abort(
+                StatusCode.PERMISSION_DENIED, "no"),
+        })
+        state["m"] = chan.unary_unary("/svc/deny", None, None)
+
+    def driver(state):
+        with pytest.raises(SimRpcError) as ei:
+            state["m"]({})
+        state["code"] = ei.value.code
+
+    def check(state):
+        from tpurpc.rpc.status import StatusCode
+        assert state["code"] == StatusCode.PERMISSION_DENIED
+
+    res = _explore_net(["A", "B"], ["A"], [driver], check, prepare=prepare)
+    assert res.ok, res.violation
+
+
+def test_handler_fault_is_internal_error_not_a_hang():
+    def prepare(net, state):
+        def broken(req, ctx):
+            raise RuntimeError("handler bug")
+        chan = SimChannel(net, "A", "B", {"/svc/broken": broken})
+        state["m"] = chan.unary_unary("/svc/broken", None, None)
+
+    def driver(state):
+        with pytest.raises(SimRpcError):
+            state["m"]({})
+
+    def check(state):
+        assert state["net"].handler_faults, "fault not recorded"
+
+    res = _explore_net(["A", "B"], ["A"], [driver], check, prepare=prepare)
+    assert res.ok, res.violation
+
+
+# -- the accounting invariant helper ------------------------------------------
+
+def _arena(n_blocks=4):
+    from tpurpc.serving import kv as _kv
+    return _kv.KvBlockManager(n_blocks, _kv.ENTRY_BYTES * 2,
+                              kind="local", name="simnet-test")
+
+
+def test_accounted_passes_on_a_clean_arena():
+    mgr = _arena()
+    try:
+        simnet._accounted(mgr)
+    finally:
+        mgr.close()
+
+
+def test_accounted_catches_a_leaked_block():
+    from types import SimpleNamespace
+    mgr = _arena()
+    try:
+        blocks = mgr.alloc_blocks(999, 2)
+        # unnamed allocation == leaked as far as the invariant knows
+        with pytest.raises(SchedViolation):
+            simnet._accounted(mgr)
+        # named as a live owner: accounted
+        simnet._accounted(mgr, owners=[SimpleNamespace(blocks=blocks)])
+        # quarantined is accounted too (the reap discipline's bucket)
+        mgr.quarantine(blocks)
+        simnet._accounted(mgr)
+    finally:
+        mgr.close()
+
+
+# -- the gate -----------------------------------------------------------------
+
+@pytest.mark.slow
+def test_quick_suite_is_green():
+    results = simnet.quick_suite()
+    bad = [r for r in results if not r.ok]
+    assert not bad, bad

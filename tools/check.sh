@@ -26,9 +26,9 @@ fi
 
 # 2) the tpurpc-specific static gate: AST lint (+ suppression audit) +
 #    bounded exhaustive ring model check + mutant kill check + the
-#    protocol-machine self-test + the quick schedule exploration
-#    (see tpurpc/analysis/)
-note "python -m tpurpc.analysis (lint + ringcheck + mutants + protocol + schedule)"
+#    protocol-machine self-test + the quick schedule exploration + the
+#    quick distributed simulation (see tpurpc/analysis/)
+note "python -m tpurpc.analysis (lint + ringcheck + mutants + protocol + schedule + simnet)"
 python -m tpurpc.analysis || fail=1
 
 # 2a) tpurpc-proof schedule-quick (ISSUE 12): the CHESS-style explorer
@@ -39,6 +39,18 @@ python -m tpurpc.analysis || fail=1
 #     by exploration. ~10s, no jax.
 note "tpurpc-proof schedule-quick (deterministic exploration, live code)"
 python -m tpurpc.analysis schedule --quick || fail=1
+
+# 2a2) tpurpc-simnet simnet-quick (ISSUE 17): the deterministic
+#      DISTRIBUTED simulation — the real DisaggDecode/_KvShipper/migrate/
+#      DecodeScheduler/CtrlPlane classes as simulated nodes, every
+#      cross-process frame/write/kick an explorable courier delivery.
+#      All six scenarios (handoff, sender-death reap, adopt-vs-drain,
+#      park/kick, close-vs-complete, live migration) explored clean and
+#      every seeded distributed mutant KILLED by message-level
+#      exploration (a violating delivery order or a reported deadlock).
+#      ~20s (<=30s budget), no jax.
+note "tpurpc-simnet simnet-quick (distributed simulation, live code)"
+python -m tpurpc.analysis simnet --quick || fail=1
 
 #     flight dumps from the smokes below land here; the protocol
 #     conformance stage at the end replays them against the declared
@@ -189,9 +201,10 @@ JAX_PLATFORMS=cpu python -m tpurpc.tools.lens_smoke || fail=1
 #    of the concurrency-heavy suites (TPURPC_DEBUG_LOCKS exercises the
 #    CheckedLock shim wired into poller/pair/xds/channel/channelz)
 if python -c "import pytest" >/dev/null 2>&1; then
-    note "pytest tests/test_analysis.py tests/test_schedule.py tests/test_protocol.py"
+    note "pytest tests/test_analysis.py tests/test_schedule.py tests/test_simnet.py tests/test_protocol.py"
     JAX_PLATFORMS=cpu python -m pytest tests/test_analysis.py \
-        tests/test_schedule.py tests/test_protocol.py -q \
+        tests/test_schedule.py tests/test_simnet.py \
+        tests/test_protocol.py -q \
         -p no:cacheprovider || fail=1
     note "TPURPC_DEBUG_LOCKS=1 pytest (concurrency suites)"
     JAX_PLATFORMS=cpu TPURPC_DEBUG_LOCKS=1 python -m pytest \

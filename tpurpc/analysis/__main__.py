@@ -5,8 +5,9 @@ the whole ``tpurpc`` package + the bounded exhaustive ring model check +
 the mutant kill check + the protocol-machine self-test (good trace
 accepted, seeded event-order mutants killed) + the quick deterministic
 schedule exploration (clean scenarios exhausted at bound 1, seeded
-real-code mutants killed). Exit 0 iff all pass — ``tools/check.sh`` and
-CI gate on this.
+real-code mutants killed) + the quick distributed simulation (simnet:
+cross-process scenarios exhausted, seeded distributed mutants killed).
+Exit 0 iff all pass — ``tools/check.sh`` and CI gate on this.
 
 Subcommands::
 
@@ -17,7 +18,10 @@ Subcommands::
     python -m tpurpc.analysis schedule [--quick] [--scenario NAME]
                                        [--bound K] [--mutant NAME]
                                        [--max-schedules N]
-    python -m tpurpc.analysis protocol [--flight DUMP] [--strict]
+    python -m tpurpc.analysis simnet [--quick] [--scenario NAME]
+                                     [--bound K] [--mutant NAME]
+                                     [--max-schedules N]
+    python -m tpurpc.analysis protocol [--flight DUMP]... [--strict]
     python -m tpurpc.analysis locks             # how to run the lock detector
 
 ``--flight DUMP`` (a ``flight.snapshot()`` JSON file, a ``/debug/flight``
@@ -110,26 +114,55 @@ def _run_schedule(args) -> int:
     return 0
 
 
+def _run_simnet(args) -> int:
+    from tpurpc.analysis import simnet
+
+    if args.scenario:
+        res = simnet.run_scenario(
+            args.scenario, preemption_bound=args.bound,
+            max_schedules=args.max_schedules, mutant=args.mutant)
+        print(repr(res))
+        if args.mutant:
+            killed = res.violation is not None
+            print(f"simnet: mutant {args.mutant}: "
+                  f"{'KILLED' if killed else 'SURVIVED'}")
+            return 0 if killed else 1
+        return 0 if res.ok else 1
+    results = simnet.quick_suite(verbose=True)
+    bad = [r for r in results if not r.ok]
+    total = sum(r.schedules for r in results)
+    if bad:
+        print(f"simnet: {len(bad)} failing entr(ies) of {len(results)} "
+              f"({total} schedules)", file=sys.stderr)
+        return 1
+    print(f"simnet: {len(results)} entries clean, {total} schedules "
+          "explored (quick suite: scenarios bound 1, mutants bound 2)")
+    return 0
+
+
 def _run_protocol(flight_path, strict: bool) -> int:
     from tpurpc.analysis import protocol
 
     if flight_path:
+        paths = ([flight_path] if isinstance(flight_path, str)
+                 else list(flight_path))
+        label = ", ".join(paths)
         try:
-            total, violations = protocol.check_dump(flight_path,
-                                                    strict=strict)
+            total, violations = protocol.check_dumps(paths, strict=strict)
         except (OSError, ValueError) as exc:
-            print(f"protocol: cannot read {flight_path}: {exc}",
+            print(f"protocol: cannot read {label}: {exc}",
                   file=sys.stderr)
             return 1
         for v in violations:
             print(v)
         if violations:
             print(f"protocol: {len(violations)} violation(s) over "
-                  f"{total} events in {flight_path}", file=sys.stderr)
+                  f"{total} events in {label}", file=sys.stderr)
             return 1
+        merged = " + merged cross-process pairing" if len(paths) > 1 else ""
         print(f"protocol: {total} events conform "
               f"({len(protocol.MACHINES)} machines, "
-              f"{'strict' if strict else 'tolerant'})")
+              f"{'strict' if strict else 'tolerant'}{merged})")
         return 0
     failures = protocol.self_test(verbose=True)
     for f in failures:
@@ -166,10 +199,25 @@ def main(argv=None) -> int:
     p_sched.add_argument("--max-schedules", type=int, default=20000)
     p_sched.add_argument("--mutant", default=None,
                          help="apply a seeded real-code mutant")
+    p_sim = sub.add_parser(
+        "simnet", help="deterministic distributed simulation (live code)")
+    p_sim.add_argument("--quick", action="store_true",
+                       help="bounded quick suite (the default)")
+    p_sim.add_argument("--scenario", default=None,
+                       help="explore one simnet scenario by name")
+    p_sim.add_argument("--bound", type=int, default=2,
+                       help="preemption bound (with --scenario)")
+    p_sim.add_argument("--max-schedules", type=int, default=20000)
+    p_sim.add_argument("--mutant", default=None,
+                       help="apply a seeded distributed mutant")
     p_proto = sub.add_parser(
         "protocol", help="flight-event protocol conformance")
-    p_proto.add_argument("--flight", default=None, metavar="DUMP",
-                         help="check a flight dump file or dump directory "
+    p_proto.add_argument("--flight", action="append", default=None,
+                         metavar="DUMP",
+                         help="check a flight dump file or dump directory; "
+                              "repeat for per-process dumps of ONE run — "
+                              "anchored dumps are rebased onto the shared "
+                              "wall clock and checked as a MERGED stream "
                               "(default: machine self-test)")
     p_proto.add_argument("--strict", action="store_true")
     sub.add_parser("locks", help="runtime lock-order detector usage")
@@ -183,6 +231,8 @@ def main(argv=None) -> int:
         return _run_mutants()
     if args.cmd == "schedule":
         return _run_schedule(args)
+    if args.cmd == "simnet":
+        return _run_simnet(args)
     if args.cmd == "protocol":
         return _run_protocol(args.flight, args.strict)
     if args.cmd == "locks":
@@ -211,6 +261,9 @@ def main(argv=None) -> int:
     rc |= _run_schedule(argparse.Namespace(quick=True, scenario=None,
                                            bound=1, max_schedules=1500,
                                            mutant=None))
+    rc |= _run_simnet(argparse.Namespace(quick=True, scenario=None,
+                                         bound=1, max_schedules=200,
+                                         mutant=None))
     return rc
 
 

@@ -199,6 +199,25 @@ INLINE_DISPATCH_PATH: Dict[str, Tuple[str, ...]] = {
     ),
 }
 
+#: the CROSS-PROCESS modules (ISSUE 17): every wire effect these emit —
+#: a framed send, a peer-ring post, a one-sided landing, a wakeup kick —
+#: must leave through ``tpurpc.core.transport.dispatch``, the seam the
+#: simnet simulator (and any future fault injector) hooks.  A raw
+#: primitive called around the seam is an effect message-level
+#: exploration can never reorder, drop, or partition — a hole in the
+#: checked protocol surface.
+XPROC_MODULES = (
+    os.path.join("tpurpc", "core", "pair.py"),
+    os.path.join("tpurpc", "core", "rendezvous.py"),
+    os.path.join("tpurpc", "core", "ctrlring.py"),
+    os.path.join("tpurpc", "serving", "disagg.py"),
+)
+
+#: send-side raw-primitive name keywords: a ``*_raw`` callee whose name
+#: carries one of these is a wire send (``_drain_raw`` and friends are
+#: receive-side — local reads of the process's own ring/socket)
+_XPROC_SEND_WORDS = ("notify", "send", "frame", "post", "write", "kick")
+
 #: method names whose call on a guarded attribute counts as a mutation
 _MUTATORS = frozenset({
     "append", "appendleft", "extend", "insert", "pop", "popleft", "popitem",
@@ -211,7 +230,7 @@ _ALLOW_RE = re.compile(r"#\s*tpr:\s*allow\(([a-z_,\s]+)\)")
 #: unknown names too — a typo'd rule suppresses nothing forever)
 KNOWN_RULES = frozenset({
     "lease", "copy", "lock", "wallclock", "block", "log", "shard",
-    "flight", "stage", "rdv", "kv", "rawlock", "ringpool",
+    "flight", "stage", "rdv", "kv", "rawlock", "ringpool", "xproc",
 })
 
 #: suppression-audit mode: when True, ``_allowed_rules`` answers empty —
@@ -1220,6 +1239,78 @@ def _check_ringpool(tree: ast.AST, path: str,
     return out
 
 
+def _xproc_raw_send(call: ast.Call) -> Optional[str]:
+    """The raw-send tag of ``call`` if it is a cross-process wire effect
+    invoked directly, else None.  Three shapes count: a send-side
+    ``*_raw`` primitive (the designated dispatch target of a seam
+    wrapper), the rendezvous ``_place`` landing closure, and a peer-ring
+    window post (``tx.post(...)`` — the receiver lives in the OTHER
+    process's mapped ring)."""
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        name = func.attr
+    elif isinstance(func, ast.Name):
+        name = func.id
+    else:
+        return None
+    if name.endswith("_raw") and any(w in name for w in _XPROC_SEND_WORDS):
+        return name
+    if name == "_place":
+        return name
+    if name == "post" and isinstance(func, ast.Attribute):
+        try:
+            base = ast.unparse(func.value)
+        except Exception:
+            base = ""
+        if base == "tx" or base.endswith(".tx"):
+            return f"{base}.post"
+    return None
+
+
+def _check_xproc(tree: ast.AST, path: str,
+                 lines: Sequence[str]) -> List[LintViolation]:
+    """Cross-process modules (XPROC_MODULES) must route wire effects
+    through the transport seam (ISSUE 17): a raw send primitive — a
+    send-side ``*_raw`` callee, the ``_place`` one-sided landing
+    closure, a direct peer-ring ``tx.post`` — may be CALLED only from
+    (a) a function that itself routes through ``transport.dispatch``
+    (the seam wrapper, whose ``NotImplemented`` fallback is the
+    un-hooked production path), or (b) another ``*_raw`` function (raw
+    implementations may compose below the seam).  Anything else is a
+    wire effect the simnet explorer can never see, reorder, or drop.
+    Suppress deliberate pre-seam paths (the bootstrap address-exchange
+    handshake) with ``# tpr: allow(xproc)``."""
+    out = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        raws = []
+        dispatches = False
+        for n in ast.walk(fn):
+            if not isinstance(n, ast.Call) or _enclosing_fn(n) is not fn:
+                continue
+            f = n.func
+            cname = (f.attr if isinstance(f, ast.Attribute)
+                     else f.id if isinstance(f, ast.Name) else None)
+            if cname == "dispatch":
+                dispatches = True
+            tag = _xproc_raw_send(n)
+            if tag is not None:
+                raws.append((n, tag))
+        if not raws or dispatches or fn.name.endswith("_raw"):
+            continue
+        for n, tag in raws:
+            if "xproc" in _allowed_rules(lines, n.lineno):
+                continue
+            out.append(LintViolation(
+                path, n.lineno, n.col_offset, "xproc",
+                f"{fn.name} calls raw transport primitive {tag} around "
+                "the transport seam: cross-process effects must leave "
+                "through transport.dispatch so message-level exploration "
+                "(simnet) and fault injection see every send"))
+    return out
+
+
 # -- driver ------------------------------------------------------------------
 
 def lint_source(source: str, path: str,
@@ -1265,6 +1356,8 @@ def lint_source(source: str, path: str,
     out.extend(_check_rdv(tree, path, lines))
     out.extend(_check_kv(tree, path, lines))
     out.extend(_check_ringpool(tree, path, lines))
+    if norm.endswith(tuple(m.replace(os.sep, "/") for m in XPROC_MODULES)):
+        out.extend(_check_xproc(tree, path, lines))
     out.extend(_check_rawlock(tree, path, lines))
     out.sort(key=lambda v: (v.path, v.line, v.col))
     return out

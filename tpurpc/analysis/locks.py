@@ -188,8 +188,8 @@ _factory_hook = None
 def set_factory_hook(hook) -> None:
     """Install (or clear, with ``None``) the exploration factory hook:
     ``hook(kind, name, lock)`` with kind in ``("lock", "rlock",
-    "condition")`` returns a primitive or ``None`` to decline (the factory
-    then falls through to its normal product)."""
+    "condition", "event")`` returns a primitive or ``None`` to decline
+    (the factory then falls through to its normal product)."""
     global _factory_hook
     _factory_hook = hook
 
@@ -222,6 +222,18 @@ def make_condition(name: str, lock=None):
     if not ENABLED:
         return threading.Condition(lock)
     return CheckedCondition(name, lock)
+
+
+def make_event(name: str):
+    """An event for ``name`` (``Class._attr``): plain ``threading.Event``
+    normally; under an active schedule exploration the factory hook hands
+    back a scheduler-controlled event so ``wait()`` parks cooperatively
+    instead of stalling the explorer on a wall-clock timeout."""
+    if _factory_hook is not None:
+        got = _factory_hook("event", name, None)
+        if got is not None:
+            return got
+    return threading.Event()
 
 
 def checked_condition(name: str, lock=None) -> CheckedCondition:

@@ -58,7 +58,8 @@ from tpurpc.obs import flight as _flight
 
 __all__ = [
     "Machine", "ProtocolViolation", "MACHINES",
-    "check_events", "check_dump", "load_dump", "assert_ordered",
+    "check_events", "check_dump", "check_dumps", "check_cross_process",
+    "merge_anchored", "load_dump", "assert_ordered",
     "machine_mutants", "mutant_kill_suite", "self_test",
     "LiveVerifier", "install_live", "uninstall_live", "live_verifier",
 ]
@@ -684,19 +685,151 @@ def check_dump(path: str, strict: bool = False
     (the ``TPURPC_FLIGHT_DUMP`` output layout). Returns
     ``(events_checked, violations)``. Offline dumps default to TOLERANT:
     a dump may start mid-history."""
-    paths: List[str] = []
-    if os.path.isdir(path):
-        for fn in sorted(os.listdir(path)):
-            if fn.endswith(".json"):
-                paths.append(os.path.join(path, fn))
-    else:
-        paths.append(path)
+    return check_dumps([path], strict=strict)
+
+
+def _expand_dump_paths(paths: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    for path in paths:
+        if os.path.isdir(path):
+            for fn in sorted(os.listdir(path)):
+                if fn.endswith(".json"):
+                    out.append(os.path.join(path, fn))
+        else:
+            out.append(path)
+    return out
+
+
+def _load_doc(path: str) -> Tuple[List[dict], Optional[dict]]:
+    """``(events, clock_anchor-or-None)`` from one dump file; the anchor
+    is present when the dump was written by the anchored exit hook
+    (``TPURPC_FLIGHT_DUMP`` since ISSUE 17) or a ``/debug/flight`` body
+    that carries one."""
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    if isinstance(data, dict):
+        events = data.get("events", [])
+        if not isinstance(events, list):
+            raise ValueError(f"{path}: 'events' is not a list")
+        return events, data.get("clock_anchor")
+    if not isinstance(data, list):
+        raise ValueError(f"{path}: not a flight dump (want a list of "
+                         "events or {'events': [...]})")
+    return data, None
+
+
+#: per-process tag namespace width in the merged stream — tags are
+#: process-local ints; two processes' tag 7 must not collide into one
+#: machine instance when their dumps merge
+_MERGE_TAG_SHIFT = 48
+
+
+def merge_anchored(docs: Sequence[Tuple[List[dict], dict]]) -> List[dict]:
+    """Several per-process event streams → ONE stream on the shared wall
+    clock.  Each dump's ``clock_anchor`` gives the rebase
+    ``wall = t_mono - mono_ns + wall_ns``; tags are namespaced per
+    process (``(i+1) << 48 | tag``) so per-entity machine keys never
+    collide across processes.  Per-process relative order is preserved
+    exactly (a constant offset per stream + a stable sort)."""
+    merged: List[dict] = []
+    for i, (events, anchor) in enumerate(docs):
+        off = int(anchor["wall_ns"]) - int(anchor["mono_ns"])
+        ns = (i + 1) << _MERGE_TAG_SHIFT
+        for ev in events:
+            e2 = dict(ev)
+            e2["t_ns"] = int(ev.get("t_ns", 0)) + off
+            e2["tag"] = ns | (int(ev.get("tag", 0))
+                              & ((1 << _MERGE_TAG_SHIFT) - 1))
+            merged.append(e2)
+    merged.sort(key=lambda e: e.get("t_ns", 0))
+    return merged
+
+
+def check_cross_process(merged: Sequence[dict],
+                        slack_ns: int = 0) -> List[ProtocolViolation]:
+    """The merged-stream pairing rule no single process can check:
+    every SUCCESSFUL migration (``MIG_END`` with ``a2 == 1``) must cover
+    at least one ``KV_SHIP_COMPLETE`` — emitted by the DESTINATION
+    process — between its ``MIG_BEGIN`` and itself.  The source's own
+    dump shows only the bracket; the landing proof for the bytes it
+    claims it moved lives in the other process's stream.  ``slack_ns``
+    widens the bracket by the summed anchor uncertainties (two rebased
+    clocks agree only to within their bracketing error)."""
+    F = _flight
+    out: List[ProtocolViolation] = []
+    begins: Dict[tuple, int] = {}
+    completes: List[int] = []
+    for ev in merged:
+        c = ev.get("code")
+        if c == F.KV_SHIP_COMPLETE:
+            completes.append(int(ev.get("t_ns", 0)))
+        elif c == F.MIG_BEGIN:
+            begins[(ev.get("tag"), ev.get("a1"))] = int(ev.get("t_ns", 0))
+        elif c == F.MIG_END and ev.get("a2") == 1:
+            k = (ev.get("tag"), ev.get("a1"))
+            t0 = begins.pop(k, None)
+            if t0 is None:
+                continue  # bracket opened before the dump: tolerated
+            t1 = int(ev.get("t_ns", 0))
+            if not any(t0 - slack_ns <= t <= t1 + slack_ns
+                       for t in completes):
+                out.append(ProtocolViolation(
+                    "xproc-mig-ship", k, "migrating", "end", ev,
+                    "successful migration with NO KV_SHIP_COMPLETE in "
+                    "ANY process between MIG_BEGIN and MIG_END — the "
+                    "bytes the source claims it moved never landed "
+                    "anywhere"))
+    return out
+
+
+def check_dumps(paths: Iterable[str], strict: bool = False
+                ) -> Tuple[int, List[ProtocolViolation]]:
+    """Conformance over one or SEVERAL per-process dumps of one run
+    (``protocol --flight A.json --flight B.json``, ISSUE 17).
+
+    Each file (directories expand to their ``*.json``) is first checked
+    on its own clock exactly as :func:`check_dump` always has.  With two
+    or more dumps that all carry a ``clock_anchor``, the streams are
+    additionally rebased onto the shared wall clock, merged, and the
+    CROSS-PROCESS pairing rules run over the merged stream
+    (:func:`check_cross_process`).  The per-entity machines are NOT
+    re-run on the merged stream: every machine key includes the
+    process-local ``tag``, so a merged machine pass would partition back
+    into the per-file passes and report each violation twice.
+
+    Anchor policy: EXPLICITLY passing several paths demands
+    mergeability — an un-anchored dump among them is reported as a
+    violation, not silently skipped (a quiet skip reads as 'merged
+    stream checked' when it wasn't).  A single DIRECTORY argument (the
+    ``TPURPC_FLIGHT_DUMP`` layout, which may hold pre-anchor dumps)
+    merges opportunistically: all anchored → merged check; otherwise
+    per-file only, exactly the historical behavior."""
+    explicit = list(paths)
+    files = _expand_dump_paths(explicit)
+    docs: List[Tuple[str, List[dict], Optional[dict]]] = []
     total = 0
     out: List[ProtocolViolation] = []
-    for p in paths:
-        events = load_dump(p)
+    for p in files:
+        events, anchor = _load_doc(p)
+        docs.append((p, events, anchor))
         total += len(events)
         out.extend(check_events(events, strict=strict))
+    if len(docs) >= 2:
+        missing = [p for p, _e, a in docs if not a]
+        if missing:
+            if len(explicit) >= 2:
+                out.append(ProtocolViolation(
+                    "xproc-merge",
+                    tuple(os.path.basename(p) for p in missing),
+                    None, "anchor", {"event": "merge", "t_ns": 0},
+                    "multi-dump check requested but these dumps carry "
+                    "no clock_anchor — cannot rebase onto one wall "
+                    "clock (re-record with TPURPC_FLIGHT_DUMP)"))
+        else:
+            anchors = [a for _p, _e, a in docs]
+            slack = sum(int(a.get("uncertainty_ns", 0)) for a in anchors)
+            merged = merge_anchored([(e, a) for _p, e, a in docs])
+            out.extend(check_cross_process(merged, slack_ns=slack))
     return total, out
 
 

@@ -304,6 +304,8 @@ class _Scheduler:
                 return SchedRLock(hook_self, name)
             if kind == "condition":
                 return SchedCondition(hook_self, name, lock)
+            if kind == "event":
+                return SchedEvent(hook_self, name)
             return None
 
         _locks.set_factory_hook(factory_hook)

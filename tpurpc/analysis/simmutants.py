@@ -1,0 +1,290 @@
+"""tpurpc-simnet: seeded REAL-CODE distributed mutants for the simulator.
+
+The cross-process sibling of :mod:`tpurpc.analysis.schedmutants`: each
+mutant is a faithful copy of a live cross-process method with exactly one
+DISTRIBUTED discipline removed — a COMPLETE issued before the one-sided
+write it announces, a TTL reap that frees instead of quarantining, a
+drain that drops the resumable sequences it already accepted, a skipped
+ring kick, the pre-fix close/complete park race.
+:mod:`tpurpc.analysis.simnet` must kill every one *by message-level
+exploration* at small bounds (a violating delivery order or a reported
+deadlock, not a sequential unit test) — the proof the simulated fabric
+has teeth.
+
+This module's file is added to the instrumented set whenever a simnet
+scenario runs, so mutated lines get the same line-granular scheduling
+points as the originals. The copies are deliberately line-for-line with
+their sources (named in each docstring) so the ONLY behavioral
+difference is the seeded bug.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from tpurpc.analysis.schedmutants import Mutant
+
+__all__ = ["SIM_MUTANTS"]
+
+
+# ---------------------------------------------------------------------------
+# ship_complete_before_write — _KvShipper.ship with the COMPLETE hoisted
+# above the one-sided writes: nothing orders the receiver's park after
+# the landing, so it can park (and later resume) unwritten memory.
+# ---------------------------------------------------------------------------
+
+def _ship_complete_before_write(self, grant, handoff, payload, n_tokens,
+                                last_token, emitted, timeout):
+    """Mutated copy of tpurpc.serving.disagg._KvShipper.ship."""
+    import numpy as np
+
+    from tpurpc.serving.disagg import TEST_HOOKS
+
+    chunks = [payload[o:o + grant.block_bytes]
+              for o in range(0, len(payload), grant.block_bytes)]
+    # MUTANT: COMPLETE first — the write-before-complete ordering the
+    # same-QP FIFO (and the simnet link contract) guarantees is gone
+    self._complete({"handoff": np.int64(handoff),
+                    "n_tokens": np.int32(n_tokens),
+                    "last_token": np.int32(last_token),
+                    "emitted": np.int32(emitted)}, timeout=timeout)
+    wedge = TEST_HOOKS.get("wedge_before_complete")
+    if wedge is not None:
+        wedge.wait(10)
+    self.writer.write_blocks(grant, chunks)
+
+
+# ---------------------------------------------------------------------------
+# reap_free_instead_of_quarantine — DisaggDecode.reap returning a dead
+# sender's pending blocks to the FREE list: the straggling one-sided
+# write the quarantine exists for can corrupt the next lease.
+# ---------------------------------------------------------------------------
+
+def _reap_free_instead_of_quarantine(self, now=None):
+    """Mutated copy of tpurpc.serving.disagg.DisaggDecode.reap."""
+    import time
+
+    from tpurpc.serving.disagg import _REAPED
+
+    now = time.monotonic() if now is None else now
+    with self._lock:
+        dead_p = [h for h, p in self._pending.items()
+                  if p.deadline <= now]
+        pend = [self._pending.pop(h) for h in dead_p]
+        dead_k = [k for k, p in self._parked.items()
+                  if p.deadline <= now]
+        parked = [self._parked.pop(k) for k in dead_k]
+    nq = 0
+    for p in pend:
+        # MUTANT: freed, not quarantined — the dead sender's write is
+        # still in flight and these blocks go straight back to the pool
+        self.mgr.free_blocks(p.kv)
+        self.quarantined_handoffs += 1
+        _REAPED.inc()
+    for p in parked:
+        self.mgr.free_blocks(p.kv, cache_prefix=True)
+        _REAPED.inc()
+    return nq, len(parked)
+
+
+# ---------------------------------------------------------------------------
+# drain_drops_resumable — DecodeScheduler._admit refusing EVERY waiting
+# sequence under drain, including the resumable ones it already accepted
+# (a migrated-in sequence killed by the very drain that migrated it).
+# ---------------------------------------------------------------------------
+
+def _admit_drain_drops_resumable(self, draining):
+    """Mutated copy of tpurpc.serving.scheduler.DecodeScheduler._admit."""
+    from tpurpc.serving.scheduler import (SLO_BATCH, SLO_INTERACTIVE,
+                                          DrainingError, _PREEMPTS,
+                                          _flight, _odyssey)
+
+    admit = []
+    drop = []
+    preempt = []
+    live = []
+    for s in self._waiting:
+        if s.cancelled:
+            drop.append((s, None))
+        else:
+            live.append(s)
+    if not live and not self._swapped:
+        return admit, live, drop, preempt
+    want_i = sum(1 for s in live if s.slo == SLO_INTERACTIVE)
+    if want_i and len(self._running) >= self.max_batch:
+        for s in reversed(list(self._running)):
+            if want_i <= 0:
+                break
+            if s.slo == SLO_BATCH:
+                self._running.remove(s)
+                s.preempted = True
+                _flight.emit(_flight.GEN_PREEMPT, self._tag, s.sid,
+                             s.slo_code)
+                _odyssey.seq_preempt(s.led)
+                _PREEMPTS.inc()
+                self.preempted_total += 1
+                if self._paged:
+                    preempt.append(s)
+                else:
+                    live.insert(0, s)
+                want_i -= 1
+    slots = self.max_batch - len(self._running)
+    budget = self.prefill_budget
+    prefills = 0
+    keep = []
+    for klass in (SLO_INTERACTIVE, SLO_BATCH):
+        for s in live:
+            if s.slo != klass:
+                continue
+            if slots <= 0:
+                keep.append(s)
+                continue
+            if draining:
+                # MUTANT: the resumable() exemption is gone — a draining
+                # scheduler refuses sequences it ALREADY accepted
+                drop.append((s, DrainingError(
+                    "scheduler draining: prefill refused")))
+                continue
+            if s.resumable():
+                admit.append(s)
+                slots -= 1
+                continue
+            cost = s.prompt_len
+            if cost <= budget or prefills == 0:
+                admit.append(s)
+                slots -= 1
+                budget -= cost
+                prefills += 1
+            else:
+                keep.append(s)
+    while slots > 0 and self._swapped and not preempt:
+        admit.append(self._swapped.pop(0))
+        slots -= 1
+    keep.sort(key=lambda s: s.sid)
+    return admit, keep, drop, preempt
+
+
+# ---------------------------------------------------------------------------
+# ctrl_kick_skipped — CtrlPlane.post without the parked-consumer kick:
+# the record is in the ring but the framed wakeup never sails — a
+# consumer blocked on the kick sleeps forever (lost wakeup, reported by
+# the explorer as a deadlock with the pick trace).
+# ---------------------------------------------------------------------------
+
+def _ctrl_kick_skipped(self, op, stream_id, payload, frame_seq, kick):
+    """Mutated copy of tpurpc.core.ctrlring.CtrlPlane.post."""
+    import time
+
+    from tpurpc.core import transport as _transport
+    from tpurpc.core.ctrlring import _KICKS, _LENS_CTRL_BYTES, _LENS_CTRL_NS
+
+    tx = self.tx
+    if tx is None or not self.armed:
+        return False
+    t0 = time.monotonic_ns()
+    r = _transport.dispatch("post", self, tx.post, op, stream_id,
+                            payload, frame_seq)
+    if not r:
+        return False
+    n = len(payload)
+    dt = time.monotonic_ns() - t0
+    _LENS_CTRL_BYTES.inc(n)
+    _LENS_CTRL_NS.inc(dt)
+    if r == 2:
+        _KICKS.inc()
+        # MUTANT: the kick dispatch is gone — the parked consumer is
+        # never woken for the record that raced its park
+    return True
+
+
+# ---------------------------------------------------------------------------
+# close_leaks_inflight_complete — the PRE-FIX DisaggDecode.on_complete:
+# no _closed re-check at the park insert, so a close() racing the
+# unlocked set_length window sweeps the registries and THEN the handler
+# parks into them — blocks stranded forever in a closed server.
+# ---------------------------------------------------------------------------
+
+def _close_leaks_inflight_complete(self, req, ctx):
+    """Mutated copy of tpurpc.serving.disagg.DisaggDecode.on_complete."""
+    import time
+
+    import numpy as np
+
+    from tpurpc.rpc.status import StatusCode
+    from tpurpc.obs import flight as _flight
+    from tpurpc.obs import tracing as _tracing
+    from tpurpc.serving.disagg import (ENTRY_BYTES, _HANDOFF_BYTES,
+                                       _HANDOFFS, _Parked, _scalar)
+
+    handoff = _scalar(req["handoff"])
+    n_tokens = _scalar(req["n_tokens"])
+    last_token = _scalar(req["last_token"])
+    emitted = _scalar(req["emitted"])
+    with self._lock:
+        pend = self._pending.pop(handoff, None)
+    if pend is None:
+        ctx.abort(StatusCode.FAILED_PRECONDITION,
+                  f"unknown/expired handoff {handoff} (blocks "
+                  "quarantined; offer again)")
+    try:
+        pend.kv.set_length(n_tokens)
+    except Exception as exc:
+        self.mgr.quarantine(pend.kv)
+        ctx.abort(StatusCode.INVALID_ARGUMENT, str(exc))
+    nbytes = n_tokens * ENTRY_BYTES
+    with self._lock:
+        # MUTANT: no _closed re-check — a close() that ran during the
+        # unlocked set_length above already swept this registry
+        self._parked[pend.seq_key] = _Parked(
+            pend.kv, pend.prompt, last_token, emitted,
+            time.monotonic() + self.parked_ttl_s,
+            trace=pend.trace, account=pend.account, nbytes=nbytes)
+    self.handoffs_in += 1
+    _HANDOFFS.inc()
+    _HANDOFF_BYTES.inc(nbytes)
+    _flight.emit(_flight.KV_SHIP_COMPLETE, self._tag, handoff, nbytes)
+    if pend.trace is not None:
+        now = time.monotonic_ns()
+        _tracing.record("seq-ship", pend.trace, pend.t0_ns,
+                        now - pend.t0_ns, handoff=handoff,
+                        nbytes=nbytes, account=pend.account)
+    return {"ok": np.int32(1)}
+
+
+def _targets():
+    from tpurpc.core.ctrlring import CtrlPlane
+    from tpurpc.serving.disagg import DisaggDecode, _KvShipper
+    from tpurpc.serving.scheduler import DecodeScheduler
+
+    return _KvShipper, DisaggDecode, DecodeScheduler, CtrlPlane
+
+
+def _build() -> Dict[str, Mutant]:
+    _KvShipper, DisaggDecode, DecodeScheduler, CtrlPlane = _targets()
+    muts = [
+        Mutant("ship_complete_before_write", "simnet-kvship",
+               _KvShipper, "ship", _ship_complete_before_write,
+               "COMPLETE issued before the one-sided writes: the receiver "
+               "parks (and can resume) memory the bytes never reached"),
+        Mutant("reap_free_instead_of_quarantine", "simnet-kvship-death",
+               DisaggDecode, "reap", _reap_free_instead_of_quarantine,
+               "a dead sender's pending blocks go back to the free list: "
+               "its in-flight write corrupts whoever leases them next"),
+        Mutant("drain_drops_resumable", "simnet-adopt-drain",
+               DecodeScheduler, "_admit", _admit_drain_drops_resumable,
+               "drain refuses resumable sequences it already accepted: a "
+               "migrated-in sequence dies instead of finishing"),
+        Mutant("ctrl_kick_skipped", "simnet-ctrl-kick",
+               CtrlPlane, "post", _ctrl_kick_skipped,
+               "the parked consumer's framed kick is skipped: a record "
+               "that raced the park strands the consumer forever"),
+        Mutant("close_leaks_inflight_complete", "simnet-close-complete",
+               DisaggDecode, "on_complete", _close_leaks_inflight_complete,
+               "no _closed re-check at the park insert: close() sweeps, "
+               "the in-flight COMPLETE parks after it, blocks leak"),
+    ]
+    return {m.name: m for m in muts}
+
+
+#: name -> Mutant (targets resolved at import of this module)
+SIM_MUTANTS: Dict[str, Mutant] = _build()

@@ -1,0 +1,1247 @@
+"""tpurpc-simnet: deterministic distributed simulation of the live
+cross-process protocols (ISSUE 17).
+
+:mod:`tpurpc.analysis.schedule` proves the THREADED half of "runtime
+matches model": the real classes, explored under a cooperative scheduler.
+This module is the DISTRIBUTED half. The cross-process protocols — the
+KV handoff (OfferKv -> one-sided writes -> CompleteKv), migration,
+ctrl-ring park/kick, scheduler adoption vs drain — are exercised by the
+REAL classes (:class:`~tpurpc.serving.disagg.DisaggDecode`,
+``_KvShipper``/``migrate``, :class:`~tpurpc.core.ctrlring.CtrlPlane`,
+:class:`~tpurpc.serving.scheduler.DecodeScheduler`) running as N
+*simulated nodes* inside one explored process.
+
+The transport seam
+------------------
+
+:mod:`tpurpc.core.transport` is the one door every cross-process effect
+walks through (the analogue of PR 12's lock-factory seam): framed sends
+(``"frame"``), ring posts (``"post"``), one-sided window landings
+(``"write"``) and doorbell kicks (``"kick"``) all go via
+``transport.dispatch(point, obj, fn, *args)``. In production the hook is
+``None`` and dispatch is a single None-check. Under simnet the hook
+routes each effect onto a per-direction FIFO *link* whose delivery is a
+courier task — so every message's delivery becomes a scheduler pick that
+the DFS / preemption-bounded explorer in :mod:`schedule` enumerates:
+
+* **delivery order** — couriers are ordinary tasks; the explorer decides
+  when each queued effect lands relative to every other task step;
+* **ordering contract** — effects on the SAME directed link deliver
+  FIFO (the RDMA same-QP rule: a one-sided write issued before a send is
+  visible before it). Cross-link orders are unconstrained;
+* **bounded delay** — a courier left unscheduled models arbitrary but
+  finite delay; untimed parks that can never be woken surface as the
+  explorer's deadlock violation (reported, never hung);
+* **partitions** — a partitioned link holds its entries; ``heal``
+  releases them (shared-memory stores — the ``"post"`` point — land
+  immediately: partitioning models the framed/TCP plane);
+* **crash-at-any-point** — ``crash_after(node, k)`` kills the node at
+  its (k+1)-th transport interaction; already-queued effects FROM the
+  dead node still deliver (the straggling-NIC rule the quarantine
+  protocol exists for), effects TO it are dropped.
+
+Invariants are DECLARED per scenario (``net.invariant(fn)``) and checked
+by couriers at every quiescent point (after each delivered effect), plus
+a final ``check`` after all drivers retire: arena accounting conserved,
+no sequence lost or duplicated across a migration, stale one-sided
+writes land only in quarantined/never-re-leased memory, drain refuses at
+the gate or finishes what it accepted. Liveness is the explorer's
+deadlock rule plus per-scenario outcome attribution: every submitted
+operation must retire or fail with a recorded reason; a quiescent
+non-final state raises a :class:`SchedViolation` naming what hung, with
+the replayable pick trace.
+
+Seeded distributed mutants (a COMPLETE sent before the write, a reap
+that frees instead of quarantining, a drain that drops resumable
+sequences, a skipped ring kick, the pre-fix close/complete race) live in
+:mod:`tpurpc.analysis.simmutants`; the kill suite proves each dies at
+small bounds.
+
+CLI: ``python -m tpurpc.analysis simnet [--quick]`` — the quick suite
+rides the default analysis gate and ``tools/check.sh`` (``simnet-quick``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tpurpc.analysis.schedule import (ExploreResult, Scenario, SchedEvent,
+                                      SchedViolation, Violation, _Scheduler,
+                                      _module_file, explore, explore_random,
+                                      replay)
+from tpurpc.core import transport as _transport
+
+__all__ = [
+    "NodeCrashed", "SimRpcError", "SimNet", "SimChannel",
+    "SIM_SCENARIOS", "run_scenario", "quick_suite", "mutant_kill_suite",
+]
+
+
+class NodeCrashed(Exception):
+    """Raised at a dead node's next transport interaction — the simulated
+    process is gone; its driver unwinds (``on_node`` absorbs it)."""
+
+
+class SimRpcError(RuntimeError):
+    """A simulated RPC failure: what ``ctx.abort`` raises on the handler
+    side and the caller re-raises — carries the grpc-shaped status."""
+
+    def __init__(self, code, details: str):
+        super().__init__(f"{code}: {details}")
+        self.code = code
+        self.details = details
+
+
+class _SimContext:
+    """The handler-facing slice of a server RPC context."""
+
+    def is_active(self) -> bool:
+        return True
+
+    def abort(self, code, details: str):
+        raise SimRpcError(code, details)
+
+    def set_trailing_metadata(self, md) -> None:
+        pass
+
+    def invocation_metadata(self):
+        return []
+
+
+class _Link:
+    """One directed link: a FIFO of (deliver, label) effects plus the
+    courier's wake event and the partition flag."""
+
+    __slots__ = ("src", "dst", "entries", "evt", "partitioned", "dropped")
+
+    def __init__(self, src: str, dst: str, sched: _Scheduler):
+        self.src = src
+        self.dst = dst
+        self.entries: "deque[Tuple[Callable[[], None], str]]" = deque()
+        self.evt = SchedEvent(sched, f"simnet:{src}->{dst}")
+        self.partitioned = False
+        self.dropped: List[str] = []
+
+
+class SimNet:
+    """The simulated network: named nodes, directed FIFO links, and the
+    transport hook that turns every cross-node effect into a courier
+    delivery the explorer schedules. Built in a scenario's ``setup``;
+    ``install()`` arms the hook, ``close()`` (teardown) disarms it."""
+
+    def __init__(self, sched: _Scheduler, nodes: List[str]):
+        self._sched = sched
+        self.nodes = list(nodes)
+        self.alive: Dict[str, bool] = {n: True for n in nodes}
+        self.links: Dict[Tuple[str, str], _Link] = {
+            (a, b): _Link(a, b, sched)
+            for a in nodes for b in nodes if a != b}
+        self._tls = threading.local()
+        #: routed objects: id -> (obj, dst); the obj ref pins the id
+        self._routes: Dict[int, Tuple[Any, str]] = {}
+        self._default_dst: Dict[str, str] = {}
+        self._invariants: List[Callable[[], None]] = []
+        self._sent: Dict[str, int] = {n: 0 for n in nodes}
+        self._crash_at: Dict[str, int] = {}
+        self.delivered: List[str] = []
+        self.handler_faults: List[str] = []
+        self.drivers_expected = 0
+        self.drivers_done = 0
+
+    # -- wiring ---------------------------------------------------------------
+
+    def route(self, obj: Any, dst: str) -> None:
+        """Effects dispatched on ``obj`` deliver to ``dst``."""
+        self._routes[id(obj)] = (obj, dst)
+
+    def default_route(self, src: str, dst: str) -> None:
+        """Unrouted effects dispatched while ``src``'s code runs deliver
+        to ``dst`` (the single-peer case: a shipper's GrantWriter is born
+        inside ``migrate``, so per-object routing can't see it)."""
+        self._default_dst[src] = dst
+
+    def invariant(self, fn: Callable[[], None]) -> None:
+        """Checked at every quiescent point (after each delivery); raise
+        :class:`SchedViolation` to report."""
+        self._invariants.append(fn)
+
+    def install(self) -> None:
+        _transport.set_transport_hook(self._hook)
+
+    def close(self) -> None:
+        if _transport.transport_hook() is self._hook:
+            _transport.set_transport_hook(None)
+
+    # -- node context ---------------------------------------------------------
+
+    @contextlib.contextmanager
+    def on(self, node: str):
+        prev = getattr(self._tls, "node", None)
+        self._tls.node = node
+        try:
+            yield
+        finally:
+            self._tls.node = prev
+
+    def current_node(self) -> Optional[str]:
+        return getattr(self._tls, "node", None)
+
+    def on_node(self, node: str, fn: Callable[[dict], None]
+                ) -> Callable[[dict], None]:
+        """Wrap a driver body to run in ``node``'s context; a crash ends
+        the driver cleanly (the process died — that IS the behavior)."""
+        def body(state: dict) -> None:
+            try:
+                with self.on(node):
+                    fn(state)
+            except NodeCrashed:
+                pass
+            finally:
+                self.drivers_done += 1
+                if self.drivers_done >= self.drivers_expected:
+                    self._broadcast()
+        return body
+
+    # -- failure injection ----------------------------------------------------
+
+    def crash_after(self, node: str, interactions: int) -> None:
+        """Kill ``node`` at its (interactions+1)-th transport interaction:
+        queued effects FROM it still deliver (straggler writes), effects
+        TO it drop, its drivers unwind via :class:`NodeCrashed`."""
+        self._crash_at[node] = int(interactions)
+
+    def kill(self, node: str) -> None:
+        self.alive[node] = False
+        self._broadcast()
+
+    def partition(self, a: str, b: str) -> None:
+        """Cut the framed plane both ways; queued + new effects are HELD
+        (not lost) until :meth:`heal`."""
+        self.links[(a, b)].partitioned = True
+        self.links[(b, a)].partitioned = True
+
+    def heal(self, a: str, b: str) -> None:
+        for key in ((a, b), (b, a)):
+            link = self.links[key]
+            link.partitioned = False
+            link.evt.set()
+
+    # -- the transport hook ---------------------------------------------------
+
+    def _tick(self, node: str) -> None:
+        if not self.alive[node]:
+            raise NodeCrashed(node)
+        self._sent[node] += 1
+        k = self._crash_at.get(node)
+        if k is not None and self._sent[node] > k:
+            self.kill(node)
+            raise NodeCrashed(node)
+
+    def _hook(self, point: str, obj: Any, fn: Callable, args, kwargs):
+        node = self.current_node()
+        if node is None:
+            return NotImplemented  # not simulated code: pass through
+        if point == "post":
+            # a ring post is a shared-memory store: it lands immediately
+            # (partitions model the framed plane) but still counts as an
+            # interaction for crash sweeps
+            self._tick(node)
+            return NotImplemented
+        ent = self._routes.get(id(obj))
+        dst = ent[1] if ent is not None else self._default_dst.get(node)
+        if dst is None or dst == node:
+            return NotImplemented
+        self.post(node, dst, f"{point}:{type(obj).__name__}",
+                  lambda: fn(*args, **kwargs))
+        return True  # claimed: a "frame" dispatch must read as sent
+
+    def post(self, src: str, dst: str, label: str,
+             fn: Callable[[], None]) -> None:
+        """Enqueue one effect on the ``src -> dst`` link (counts as an
+        interaction at ``src``). The courier runs ``fn`` in ``dst``'s
+        node context, so nested sends route from the receiver."""
+        self._tick(src)
+        link = self.links[(src, dst)]
+
+        def deliver() -> None:
+            with self.on(dst):
+                fn()
+
+        link.entries.append((deliver, label))
+        link.evt.set()
+
+    # -- couriers -------------------------------------------------------------
+
+    def courier(self, src: str, dst: str) -> Callable[[dict], None]:
+        """The delivery task for one directed link (add to a scenario's
+        ``threads``). Runs queued effects in order, checks the declared
+        invariants after each, exits when every driver finished and all
+        queues drained."""
+        def body(state: dict) -> None:
+            self._courier(src, dst)
+        return body
+
+    def _courier(self, src: str, dst: str) -> None:
+        link = self.links[(src, dst)]
+        while True:
+            link.evt.clear()
+            while link.entries and not link.partitioned:
+                deliver, label = link.entries.popleft()
+                if not self.alive[dst]:
+                    link.dropped.append(label)
+                    continue
+                try:
+                    deliver()
+                except NodeCrashed:
+                    pass  # the receiver died mid-handler: effect lost
+                self.delivered.append(f"{src}->{dst} {label}")
+                self._check_invariants()
+            if self._quiesced():
+                # flush a permanently-partitioned backlog into dropped so
+                # the final check can attribute the loss
+                while link.entries:
+                    link.dropped.append(link.entries.popleft()[1])
+                self._broadcast()
+                return
+            link.evt.wait()  # untimed: a lost wakeup IS a deadlock report
+
+    def _quiesced(self) -> bool:
+        if self.drivers_done < self.drivers_expected:
+            return False
+        return all((not l.entries) or l.partitioned
+                   for l in self.links.values())
+
+    def _broadcast(self) -> None:
+        for link in self.links.values():
+            link.evt.set()
+
+    def _check_invariants(self) -> None:
+        for fn in self._invariants:
+            fn()
+
+    # -- driver utilities -----------------------------------------------------
+
+    def settle(self) -> None:
+        """A deterministic yield for driver polling loops: park timed; the
+        explorer wakes us only when nothing else can run."""
+        SchedEvent(self._sched, "simnet.settle").wait(timeout=0.001)
+
+    def assert_delivered(self) -> None:
+        """Final-check helper: nothing still queued or silently dropped."""
+        stuck = [f"{l.src}->{l.dst}:{len(l.entries)} queued"
+                 for l in self.links.values() if l.entries]
+        if stuck:
+            raise SchedViolation(
+                f"simnet quiesced with undelivered effects: {stuck}")
+
+
+class _SimMethod:
+    """One unary-unary RPC face: the request rides the src->dst link, the
+    handler runs at the receiver, the response rides dst->src; the caller
+    parks (timed) until it lands or a peer dies."""
+
+    def __init__(self, net: SimNet, src: str, dst: str, method: str,
+                 handler: Callable):
+        self._net = net
+        self._src = src
+        self._dst = dst
+        self._method = method
+        self._handler = handler
+
+    def __call__(self, request, timeout: Optional[float] = None):
+        net, src, dst = self._net, self._src, self._dst
+        box: List[Any] = []
+        evt = SchedEvent(net._sched, f"rpc:{self._method}")
+
+        def respond(result) -> None:
+            box.append(result)
+            evt.set()
+
+        def handle() -> None:
+            ctx = _SimContext()
+            try:
+                resp = self._handler(request, ctx)
+            except SimRpcError as exc:
+                resp = exc
+            except NodeCrashed:
+                raise
+            except Exception as exc:  # a handler bug: surfaced, not hung
+                net.handler_faults.append(
+                    f"{self._method}: {type(exc).__name__}: {exc}")
+                resp = SimRpcError("INTERNAL", repr(exc))
+            net.post(dst, src, f"resp:{self._method}",
+                     lambda: respond(resp))
+
+        net.post(src, dst, f"req:{self._method}", handle)
+        for _ in range(20000):
+            if box:
+                break
+            if not net.alive[dst]:
+                raise OSError(f"simnet: peer {dst} is dead")
+            if not net.alive[src]:
+                raise NodeCrashed(src)
+            evt.wait(timeout=0.001)
+        else:
+            raise RuntimeError(f"simnet rpc {self._method} never settled")
+        result = box[0]
+        if isinstance(result, SimRpcError):
+            raise result
+        return result
+
+    def pipeline(self, depth: int = 1):
+        raise NotImplementedError(
+            "simnet channels model single-call RPCs; bursts of one ride "
+            "the fast path")
+
+
+class SimChannel:
+    """The client-channel face a :class:`_KvShipper` needs, bound to one
+    simulated direction: ``unary_unary`` hands back a :class:`_SimMethod`
+    whose request/response legs are courier deliveries."""
+
+    def __init__(self, net: SimNet, src: str, dst: str,
+                 handlers: Dict[str, Callable]):
+        self._net = net
+        self._src = src
+        self._dst = dst
+        self._handlers = dict(handlers)
+
+    def unary_unary(self, method: str, serializer, deserializer
+                    ) -> _SimMethod:
+        try:
+            handler = self._handlers[method]
+        except KeyError:
+            raise KeyError(f"simnet channel has no handler for {method}")
+        # codecs are identity in-sim: the real tree codec is exercised by
+        # the RPC-plane tests; simnet explores ORDERING, not encoding
+        return _SimMethod(self._net, self._src, self._dst, method, handler)
+
+    def close(self) -> None:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Shared scenario plumbing.
+# ---------------------------------------------------------------------------
+
+class _StubSched:
+    """The scheduler face DisaggDecode needs when a scenario exercises
+    only the KV-handoff plane."""
+
+    def __init__(self, name: str = "simnet"):
+        self.name = name
+
+    def state_str(self) -> str:
+        return "ok"
+
+
+def _ship_payload(n_tokens: int = 4):
+    """A real KV image to ship: ``(prompt, payload bytes, entries)`` built
+    through a throwaway arena so the bytes have the genuine entry layout
+    (nonzero hashes — the 'bytes actually landed' invariant's signal)."""
+    from tpurpc.serving import kv as _kv
+
+    m = _kv.KvBlockManager(n_blocks=2, block_bytes=_kv.ENTRY_BYTES * 2,
+                           kind="local", name="simnet-src")
+    try:
+        prompt = np.arange(1, n_tokens + 1, dtype=np.int32)
+        skv, _hit = m.alloc_for_prompt(99, prompt)  # tpr: allow(kv)
+        for i in range(n_tokens):
+            skv.append(0x5A5A0 + i + 1, int(prompt[i]))
+        payload = b"".join(bytes(v) for _b, v in skv.chunks(0, n_tokens))
+        entries = [skv.entry(i) for i in range(n_tokens)]
+        m.free_blocks(skv)
+    finally:
+        m.close()
+    return prompt, payload, entries
+
+
+def _cache_blocks(mgr) -> set:
+    return {b for ent in mgr._prefix.values() for b in ent.blocks}
+
+
+def _accounted(mgr, owners=()) -> None:
+    """The conservation invariant: every arena block is free, quarantined,
+    prefix-cached, or owned by a named live table — a block in none of
+    those is leaked forever."""
+    owned = set()
+    for kv in owners:
+        if kv is not None:
+            owned |= set(kv.blocks)
+    have = (set(mgr._free) | set(mgr._quarantined) | _cache_blocks(mgr)
+            | owned)
+    missing = set(range(mgr.n_blocks)) - have
+    if missing:
+        raise SchedViolation(
+            f"arena accounting violated: blocks {sorted(missing)} are "
+            "neither free, quarantined, cached, nor owned by any live "
+            "table — leaked (zero-leak close/reap contract)")
+
+
+def _mutants_file() -> str:
+    from tpurpc.analysis import simmutants
+
+    return simmutants.__file__
+
+
+# ---------------------------------------------------------------------------
+# Scenario 1: the clean KV handoff, offer -> one-sided write -> complete.
+# ---------------------------------------------------------------------------
+
+def _kvship_scenario() -> Scenario:
+    """Prefill node P ships one sequence's KV to decode node D through the
+    real ``_KvShipper`` + ``DisaggDecode`` handlers over a simulated
+    link. Declared invariant (checked at every quiescent point): a PARKED
+    sequence's bytes have landed — COMPLETE processed before the
+    one-sided write delivers is the ordering bug the FIFO link contract
+    (and the real RDMA QP) forbids, and what the
+    ``ship_complete_before_write`` mutant reintroduces."""
+    from tpurpc.serving import disagg as _disagg
+    from tpurpc.serving import kv as _kv
+
+    def setup(sched: _Scheduler):
+        net = SimNet(sched, ["P", "D"])
+        prompt, payload, entries = _ship_payload(4)
+        mgr = _kv.KvBlockManager(n_blocks=8,
+                                 block_bytes=_kv.ENTRY_BYTES * 2,
+                                 kind="local", name="simnet-kvship")
+        decode = _disagg.DisaggDecode(_StubSched("sim-kvship"), mgr)
+        chan = SimChannel(net, "P", "D", {
+            _disagg._method("OfferKv"): decode.on_offer,
+            _disagg._method("CompleteKv"): decode.on_complete,
+            _disagg._method("ReleaseKv"): decode.on_release,
+        })
+        shipper = _disagg._KvShipper(chan)
+        net.default_route("P", "D")
+
+        def parked_bytes_landed() -> None:
+            for key, parked in list(decode._parked.items()):
+                n = parked.kv.length
+                if n and parked.kv.entry(n - 1)[0] == 0:
+                    raise SchedViolation(
+                        f"sequence {key} PARKED before its bytes landed "
+                        "(zero entry hash at the tail): COMPLETE was "
+                        "processed ahead of the one-sided write — the "
+                        "write-before-complete ordering contract is "
+                        "broken")
+        net.invariant(parked_bytes_landed)
+        net.install()
+        return {"net": net, "mgr": mgr, "decode": decode,
+                "shipper": shipper, "prompt": prompt, "payload": payload,
+                "entries": entries, "shipped": [], "err": []}
+
+    def sender(state):
+        sh = state["shipper"]
+        try:
+            grant, handoff, pos, _rh, _rf = sh.offer(
+                501, state["prompt"], 4, timeout=5.0)
+            sh.ship(grant, handoff, memoryview(state["payload"]), 4,
+                    last_token=int(state["prompt"][-1]), emitted=1,
+                    timeout=5.0)
+            state["shipped"].append(handoff)
+        except Exception as exc:
+            state["err"].append(repr(exc))
+
+    def check(state):
+        net, decode, mgr = state["net"], state["decode"], state["mgr"]
+        net.assert_delivered()
+        if net.handler_faults:
+            raise SchedViolation(
+                f"handler faults: {net.handler_faults}")
+        if state["err"] or not state["shipped"]:
+            raise SchedViolation(
+                "clean handoff did not complete: "
+                f"err={state['err']} shipped={state['shipped']} — every "
+                "submitted ship must retire or fail with attribution")
+        parked = decode._parked.get(501)
+        if parked is None:
+            raise SchedViolation(
+                "sequence lost: COMPLETE succeeded at the sender but 501 "
+                "is not parked at the receiver")
+        got = [parked.kv.entry(i) for i in range(parked.kv.length)]
+        if got != state["entries"]:
+            raise SchedViolation(
+                f"shipped KV content diverged: {got} != "
+                f"{state['entries']}")
+        if decode._pending:
+            raise SchedViolation(
+                f"pending registry not drained: {list(decode._pending)}")
+        _accounted(mgr, owners=[p.kv for p in decode._parked.values()])
+
+    def teardown(state):
+        state["net"].close()
+        try:
+            state["decode"].close()
+            state["mgr"].close()
+        except Exception:
+            pass
+
+    return _with_couriers(
+        "simnet-kvship", setup, [("P", sender)], check,
+        [_module_file(_disagg), _mutants_file()], teardown, ["P", "D"])
+
+
+def _with_couriers(scenario_name: str, setup, drivers, check, instrument,
+                   teardown, nodes: List[str],
+                   max_steps: int = 120000) -> Scenario:
+    """Assemble a Scenario whose threads are the node drivers plus one
+    courier per directed link. ``drivers`` is ``[(node, fn), ...]``."""
+    def full_setup(sched: _Scheduler):
+        state = setup(sched)
+        state["net"].drivers_expected = len(drivers)
+        return state
+
+    threads: List[Callable] = []
+
+    def make_driver(node, fn):
+        def body(state):
+            state["net"].on_node(node, fn)(state)
+        return body
+
+    for node, fn in drivers:
+        threads.append(make_driver(node, fn))
+    for a in nodes:
+        for b in nodes:
+            if a != b:
+                def make_courier(src=a, dst=b):
+                    def body(state):
+                        state["net"]._courier(src, dst)
+                    return body
+                threads.append(make_courier())
+    return Scenario(scenario_name, full_setup, threads, check,
+                    instrument=instrument, teardown=teardown,
+                    max_steps=max_steps)
+
+
+# ---------------------------------------------------------------------------
+# Scenario 2: sender dies between its one-sided write and COMPLETE — the
+# straggling-writer case the TTL reap + quarantine protocol exists for.
+# ---------------------------------------------------------------------------
+
+def _kvship_death_scenario() -> Scenario:
+    """P crashes at its third transport interaction: OFFER lands, the
+    one-sided write is queued (an RDMA NIC can have it in flight long
+    after the process died), COMPLETE never sends. The receiver's TTL
+    reap fires. Declared invariants: the reap QUARANTINES the claimed
+    blocks (never frees them back to the lease pool — the
+    ``reap_free_instead_of_quarantine`` mutant's discipline), and a
+    probe that then leases everything it can must never see its memory
+    corrupted by the straggler."""
+    from tpurpc.serving import disagg as _disagg
+    from tpurpc.serving import kv as _kv
+
+    _SENT = b"PROBE-OK"
+
+    def setup(sched: _Scheduler):
+        net = SimNet(sched, ["P", "D"])
+        prompt, payload, entries = _ship_payload(4)
+        mgr = _kv.KvBlockManager(n_blocks=8,
+                                 block_bytes=_kv.ENTRY_BYTES * 2,
+                                 kind="local", name="simnet-death")
+        decode = _disagg.DisaggDecode(_StubSched("sim-death"), mgr,
+                                      pending_ttl_s=30.0)
+        chan = SimChannel(net, "P", "D", {
+            _disagg._method("OfferKv"): decode.on_offer,
+            _disagg._method("CompleteKv"): decode.on_complete,
+            _disagg._method("ReleaseKv"): decode.on_release,
+        })
+        shipper = _disagg._KvShipper(chan)
+        net.default_route("P", "D")
+        # interactions at P: OFFER request (1), the one-sided write (2),
+        # COMPLETE request (3) -> dies issuing COMPLETE, write in flight
+        net.crash_after("P", 2)
+        net.install()
+        return {"net": net, "mgr": mgr, "decode": decode,
+                "shipper": shipper, "prompt": prompt, "payload": payload,
+                "probe": [], "probe_blocks": [], "reap": []}
+
+    def sender(state):
+        sh = state["shipper"]
+        grant, handoff, _pos, _rh, _rf = sh.offer(
+            502, state["prompt"], 4, timeout=5.0)
+        sh.ship(grant, handoff, memoryview(state["payload"]), 4,
+                last_token=4, emitted=1, timeout=5.0)
+
+    def receiver(state):
+        net, decode, mgr = state["net"], state["decode"], state["mgr"]
+        for _ in range(300):
+            if decode.stats()["pending"]:
+                break
+            net.settle()
+        else:
+            state["reap"].append("offer-never-arrived")
+            return
+        nq, nfreed = decode.reap(now=time.monotonic() + 1e6)
+        state["reap"].append((nq, nfreed))
+        state["q_after_reap"] = mgr.quarantined_count()
+        # the adversarial probe: lease EVERYTHING the arena will give and
+        # stamp it — if the dead sender's write can land in any of it,
+        # the quarantine discipline is broken
+        try:
+            got = mgr.alloc_blocks(777, mgr.n_blocks)  # tpr: allow(kv)
+        except _kv.KvArenaFull:
+            state["probe"].append("full")
+            return
+        for b in got:
+            mgr.block_view(b)[:len(_SENT)] = _SENT
+        state["probe_blocks"].extend(got)
+
+    def check(state):
+        net, mgr = state["net"], state["mgr"]
+        net.assert_delivered()
+        if state["reap"] == ["offer-never-arrived"]:
+            raise SchedViolation(
+                "OFFER never reached the receiver though no partition or "
+                "receiver crash was injected — message lost")
+        if state.get("q_after_reap") != 2:
+            raise SchedViolation(
+                "TTL reap of a dead sender's pending handoff must "
+                "QUARANTINE its claimed blocks (a one-sided write may "
+                "still be in flight); quarantined_count=="
+                f"{state.get('q_after_reap')} after reap={state['reap']}")
+        for b in state["probe_blocks"]:
+            if bytes(mgr.block_view(b)[:len(_SENT)]) != _SENT:
+                raise SchedViolation(
+                    f"stale one-sided write from the dead sender landed "
+                    f"in re-leased block {b} — corruption the quarantine "
+                    "exists to prevent")
+        _accounted(mgr)
+
+    def teardown(state):
+        state["net"].close()
+        try:
+            state["decode"].close()
+            state["mgr"].close()
+        except Exception:
+            pass
+
+    return _with_couriers(
+        "simnet-kvship-death", setup, [("P", sender), ("D", receiver)],
+        check, [_module_file(_disagg), _mutants_file()], teardown,
+        ["P", "D"])
+
+
+# ---------------------------------------------------------------------------
+# Scenario 3: adoption races a cross-node drain on the real scheduler.
+# ---------------------------------------------------------------------------
+
+def _adopt_drain_scenario() -> Scenario:
+    """A real paged :class:`DecodeScheduler` (its daemon step loop
+    stubbed; a driver pumps boundaries) adopts a shipped sequence while
+    a controller node delivers ``drain`` through the transport seam.
+    Declared liveness invariant: the adoption is refused AT THE GATE or
+    the sequence RETIRES — accepted-then-dropped is the
+    ``drain_drops_resumable`` mutant's bug (a migrated sequence silently
+    killed by the very drain that migrated it)."""
+    from tpurpc.jaxshim.generate import ToyDecodeModel
+    from tpurpc.serving import kv as _kv
+    from tpurpc.serving import scheduler as _smod
+
+    def setup(sched: _Scheduler):
+        net = SimNet(sched, ["D", "C"])
+        orig = _smod.DecodeScheduler._step_loop
+        _smod.DecodeScheduler._step_loop = lambda self: None
+        mgr = _kv.KvBlockManager(n_blocks=16,
+                                 block_bytes=_kv.ENTRY_BYTES * 2,
+                                 kind="local", name="simnet-adopt")
+        model = ToyDecodeModel()
+        dec = _smod.DecodeScheduler(model, max_batch=4, idle_wait_s=0.001,
+                                    kv=mgr, name="sim-adopt")
+        prompt = np.arange(3, 7, dtype=np.int32)
+        kv1, _hit = mgr.alloc_for_prompt(4242, prompt)  # tpr: allow(kv)
+        first = model.prefill_paged([prompt], [kv1])
+        ctl = object()
+        net.route(ctl, "D")
+        net.install()
+        return {"net": net, "mgr": mgr, "dec": dec, "kv1": kv1,
+                "prompt": prompt, "ctl": ctl, "orig_loop": orig,
+                "last_token": int(np.asarray(first).ravel()[0])}
+
+    def adopter(state):
+        dec, net = state["dec"], state["net"]
+        try:
+            stream = dec.submit_adopted(
+                state["kv1"], state["prompt"],
+                last_token=state["last_token"], emitted=1, max_tokens=3)
+        except _smod.DrainingError:
+            state["outcome"] = "refused-at-gate"
+            state["mgr"].free_blocks(state["kv1"])
+            return
+        for _ in range(300):
+            try:
+                tok = stream.next(timeout=0)
+            except StopIteration:
+                state["outcome"] = "retired"
+                return
+            except _smod.DrainingError as exc:
+                state["outcome"] = f"dropped-after-accept: {exc}"
+                return
+            except Exception as exc:
+                state["outcome"] = f"failed: {exc!r}"
+                return
+            if tok is None:
+                net.settle()
+        state["outcome"] = "no-terminal"
+
+    def pump(state):
+        dec = state["dec"]
+        for _ in range(400):
+            if state.get("outcome"):
+                return
+            dec._boundary()
+            if dec._running:
+                dec._run_step()
+        state["pump_exhausted"] = True
+
+    def drainer(state):
+        _transport.dispatch("frame", state["ctl"], state["dec"].drain)
+
+    def check(state):
+        net = state["net"]
+        net.assert_delivered()
+        outcome = state.get("outcome")
+        if outcome not in ("retired", "refused-at-gate"):
+            raise SchedViolation(
+                "adopted sequence neither retired nor was refused at the "
+                f"gate: {outcome!r} — drain must FINISH what it already "
+                "accepted (resumable sequences ride out a drain)")
+        dec = state["dec"]
+        live = [s.sid for s in (list(dec._running) + list(dec._waiting)
+                                + list(dec._swapped))]
+        if live:
+            raise SchedViolation(
+                f"scheduler quiesced with live sequences {live} after a "
+                "terminal stream outcome")
+        _accounted(state["mgr"])
+
+    def teardown(state):
+        state["net"].close()
+        _smod.DecodeScheduler._step_loop = state["orig_loop"]
+        try:
+            state["dec"].close(timeout=1.0)
+            state["mgr"].close()
+        except Exception:
+            pass
+
+    return _with_couriers(
+        "simnet-adopt-drain", setup,
+        [("D", adopter), ("D", pump), ("C", drainer)], check,
+        [_module_file(_smod), _mutants_file()], teardown, ["D", "C"],
+        max_steps=300000)
+
+
+# ---------------------------------------------------------------------------
+# Scenario 4: the ctrl-ring park/kick handshake across a partition.
+# ---------------------------------------------------------------------------
+
+def _ctrl_kick_scenario() -> Scenario:
+    """Producer A posts into consumer B's real ring (shared-memory
+    stores land immediately) while the FRAMED plane — which carries the
+    wake-up kick — is partitioned and later healed. The consumer drains,
+    parks, re-drains once (the mandatory lost-wakeup close), then blocks
+    UNTIMED on the kick. Declared invariants: both records arrive in
+    order, and the consumer always wakes — a skipped kick (the
+    ``ctrl_kick_skipped`` mutant) must surface as the explorer's
+    deadlock violation with the pick trace, never as a silent hang."""
+    from tpurpc.core import ctrlring as _ctrl
+
+    def setup(sched: _Scheduler):
+        if not _ctrl.enabled():
+            raise RuntimeError("ctrl ring disabled in this environment")
+        net = SimNet(sched, ["A", "B"])
+        plane_b = _ctrl.CtrlPlane("simnet-b", kind="local")
+        plane_a = _ctrl.CtrlPlane("simnet-a", kind="local")
+        if plane_b.rx is None or not plane_a.on_hello(plane_b.hello_blob()):
+            raise RuntimeError("simnet: local ring adoption failed")
+        wake = SchedEvent(sched, "simnet-ctrl-wake")
+        net.route(plane_a, "B")
+        net.install()
+        return {"net": net, "pa": plane_a, "pb": plane_b, "wake": wake,
+                "records": [], "posted": []}
+
+    def producer(state):
+        net, pa, wake = state["net"], state["pa"], state["wake"]
+        net.partition("A", "B")
+        ok1 = pa.post(1, 7, b"x1", 0, wake.set)
+        net.heal("A", "B")
+        ok2 = pa.post(2, 7, b"x2", 0, wake.set)
+        state["posted"] = [ok1, ok2]
+
+    def consumer(state):
+        pb, wake, records = state["pb"], state["wake"], state["records"]
+
+        def on_op(op, sid, payload):
+            records.append((op, bytes(payload)))
+
+        far = lambda: 1 << 30
+        for _ in range(200):
+            if len(records) >= 2:
+                return
+            if pb.drain(on_op, far):
+                continue
+            pb.park()
+            if pb.drain(on_op, far):  # the mandatory post-park re-drain
+                pb.unpark()
+                continue
+            wake.wait()  # untimed: a lost kick IS a reported deadlock
+            wake.clear()
+            pb.unpark()
+        state["spun_out"] = True
+
+    def check(state):
+        state["net"].assert_delivered()
+        if state.get("spun_out"):
+            raise SchedViolation(
+                "ctrl consumer spun without making progress")
+        if state["posted"] != [True, True]:
+            raise SchedViolation(
+                f"ring posts did not all place: {state['posted']}")
+        if state["records"] != [(1, b"x1"), (2, b"x2")]:
+            raise SchedViolation(
+                "ring records lost or reordered: "
+                f"{state['records']} != [(1, b'x1'), (2, b'x2')]")
+
+    def teardown(state):
+        state["net"].close()
+        for key in ("pa", "pb"):
+            plane = state.get(key)
+            close = getattr(plane, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:
+                    pass
+
+    return _with_couriers(
+        "simnet-ctrl-kick", setup,
+        [("A", producer), ("B", consumer)], check,
+        [_module_file(_ctrl), _mutants_file()], teardown, ["A", "B"])
+
+
+# ---------------------------------------------------------------------------
+# Scenario 5: DisaggDecode.close races an in-flight COMPLETE — the real
+# interleaving bug this simulator surfaced (and disagg now fixes).
+# ---------------------------------------------------------------------------
+
+def _close_complete_scenario() -> Scenario:
+    """P ships a handoff while D closes the decode server. Every
+    interleaving is legal EXCEPT a leak: after quiesce the registries of
+    a closed server are empty and every arena block is free, quarantined
+    or prefix-cached. The pre-fix ``on_complete`` (kept as the
+    ``close_leaks_inflight_complete`` mutant) parked the sequence into a
+    registry ``close()`` had already swept — blocks leaked forever; the
+    fix re-checks ``_closed`` under the lock at the park insert and
+    refuses with UNAVAILABLE."""
+    from tpurpc.serving import disagg as _disagg
+    from tpurpc.serving import kv as _kv
+
+    def setup(sched: _Scheduler):
+        net = SimNet(sched, ["P", "D"])
+        prompt, payload, entries = _ship_payload(4)
+        mgr = _kv.KvBlockManager(n_blocks=8,
+                                 block_bytes=_kv.ENTRY_BYTES * 2,
+                                 kind="local", name="simnet-close")
+        decode = _disagg.DisaggDecode(_StubSched("sim-close"), mgr)
+        chan = SimChannel(net, "P", "D", {
+            _disagg._method("OfferKv"): decode.on_offer,
+            _disagg._method("CompleteKv"): decode.on_complete,
+            _disagg._method("ReleaseKv"): decode.on_release,
+        })
+        shipper = _disagg._KvShipper(chan)
+        net.default_route("P", "D")
+        net.install()
+        return {"net": net, "mgr": mgr, "decode": decode,
+                "shipper": shipper, "prompt": prompt, "payload": payload,
+                "sent": [], "err": []}
+
+    def sender(state):
+        sh = state["shipper"]
+        try:
+            grant, handoff, _pos, _rh, _rf = sh.offer(
+                503, state["prompt"], 4, timeout=5.0)
+            sh.ship(grant, handoff, memoryview(state["payload"]), 4,
+                    last_token=4, emitted=1, timeout=5.0)
+            state["sent"].append(handoff)
+        except (SimRpcError, OSError) as exc:
+            state["err"].append(repr(exc))
+
+    def closer(state):
+        state["decode"].close()
+
+    def check(state):
+        net, decode, mgr = state["net"], state["decode"], state["mgr"]
+        net.assert_delivered()
+        if net.handler_faults:
+            raise SchedViolation(f"handler faults: {net.handler_faults}")
+        if decode._pending or decode._parked:
+            raise SchedViolation(
+                "closed server's registries not empty at quiesce: "
+                f"pending={list(decode._pending)} "
+                f"parked={list(decode._parked)} — the close/complete "
+                "race parked into a swept registry (blocks leak forever)")
+        _accounted(mgr)
+        if not state["sent"] and not state["err"]:
+            raise SchedViolation(
+                "ship neither succeeded nor failed with attribution")
+
+    def teardown(state):
+        state["net"].close()
+        try:
+            state["decode"].close()
+            state["mgr"].close()
+        except Exception:
+            pass
+
+    return _with_couriers(
+        "simnet-close-complete", setup,
+        [("P", sender), ("D", closer)], check,
+        [_module_file(_disagg), _mutants_file()], teardown, ["P", "D"])
+
+
+# ---------------------------------------------------------------------------
+# Scenario 6: live migration, source scheduler to destination decode.
+# ---------------------------------------------------------------------------
+
+def _migrate_scenario() -> Scenario:
+    """The full ``migrate()`` path over the simulated fabric: a sequence
+    decoding on source node S (real paged scheduler, pumped) is frozen,
+    detached at a boundary, offered/shipped/completed to node D's real
+    ``DisaggDecode``. Declared invariants: exactly one terminal stream
+    record (migrated — never lost, never ALSO still live at the source),
+    byte-identical KV at the destination, and both arenas conserved."""
+    from tpurpc.jaxshim.generate import ToyDecodeModel
+    from tpurpc.serving import disagg as _disagg
+    from tpurpc.serving import kv as _kv
+    from tpurpc.serving import scheduler as _smod
+
+    def setup(sched: _Scheduler):
+        net = SimNet(sched, ["S", "D"])
+        orig = _smod.DecodeScheduler._step_loop
+        _smod.DecodeScheduler._step_loop = lambda self: None
+        mgr_s = _kv.KvBlockManager(n_blocks=16,
+                                   block_bytes=_kv.ENTRY_BYTES * 2,
+                                   kind="local", name="simnet-mig-src")
+        mgr_d = _kv.KvBlockManager(n_blocks=16,
+                                   block_bytes=_kv.ENTRY_BYTES * 2,
+                                   kind="local", name="simnet-mig-dst")
+        model = ToyDecodeModel()
+        sched_s = _smod.DecodeScheduler(model, max_batch=4,
+                                        idle_wait_s=0.001, kv=mgr_s,
+                                        name="sim-mig-src")
+        src_state = _disagg.DisaggDecode(sched_s, mgr_s)
+        decode_d = _disagg.DisaggDecode(_StubSched("sim-mig-dst"), mgr_d)
+        chan = SimChannel(net, "S", "D", {
+            _disagg._method("OfferKv"): decode_d.on_offer,
+            _disagg._method("CompleteKv"): decode_d.on_complete,
+            _disagg._method("ReleaseKv"): decode_d.on_release,
+        })
+        prompt = np.arange(11, 15, dtype=np.int32)
+        stream = sched_s.submit(prompt, max_tokens=8)
+        net.default_route("S", "D")
+        net.install()
+        return {"net": net, "mgr_s": mgr_s, "mgr_d": mgr_d,
+                "sched_s": sched_s, "src_state": src_state,
+                "decode_d": decode_d, "chan": chan, "stream": stream,
+                "orig_loop": orig, "snap": []}
+
+    def pump(state):
+        dec, net = state["sched_s"], state["net"]
+        for _ in range(500):
+            if state.get("done"):
+                return
+            dec._boundary()
+            if not state.get("freeze") and dec._running:
+                dec._run_step()
+            if not state.get("freeze") and state["stream"].emitted >= 2:
+                state["freeze"] = True
+            net.settle()
+        state["pump_exhausted"] = True
+
+    def migrator(state):
+        net = state["net"]
+        for _ in range(400):
+            if state.get("freeze"):
+                break
+            net.settle()
+        else:
+            state["mig"] = ("never-froze",)
+            return
+        sid = state["stream"].sid
+        seq = next((s for s in list(state["sched_s"]._running)
+                    if s.sid == sid), None)
+        if seq is not None and seq.kv is not None:
+            state["snap"] = [seq.kv.entry(i)
+                             for i in range(seq.kv.length)]
+        moved, failed = _disagg.migrate(
+            state["src_state"], state["chan"], "sim-dst:0", sids=[sid],
+            timeout_s=5.0)
+        state["mig"] = (moved, failed)
+
+    def reader(state):
+        stream, net = state["stream"], state["net"]
+        for _ in range(600):
+            try:
+                tok = stream.next(timeout=0)
+            except StopIteration:
+                state["outcome"] = ("retired",)
+                break
+            except _disagg.SeqMigrated as m:
+                state["outcome"] = ("migrated", m.seq_key, m.next_index)
+                break
+            except _disagg.MigrationFailed as exc:
+                state["outcome"] = ("failed", str(exc))
+                break
+            except Exception as exc:
+                state["outcome"] = ("error", repr(exc))
+                break
+            if tok is None:
+                net.settle()
+        else:
+            state["outcome"] = ("no-terminal",)
+        state["done"] = True
+
+    def check(state):
+        net = state["net"]
+        net.assert_delivered()
+        if net.handler_faults:
+            raise SchedViolation(f"handler faults: {net.handler_faults}")
+        if state.get("pump_exhausted"):
+            raise SchedViolation("source pump exhausted before quiesce")
+        if state.get("mig") != (1, 0):
+            raise SchedViolation(
+                f"migrate() did not move exactly the one sequence: "
+                f"{state.get('mig')}")
+        outcome = state.get("outcome")
+        if not outcome or outcome[0] != "migrated":
+            raise SchedViolation(
+                "source stream did not end with the re-attach record: "
+                f"{outcome!r} — the sequence was lost across migration")
+        parked = state["decode_d"]._parked
+        if len(parked) != 1 or outcome[1] not in parked:
+            raise SchedViolation(
+                f"destination parked registry {list(parked)} does not "
+                f"hold exactly the migrated key {outcome[1]} — sequence "
+                "lost or duplicated")
+        sid = state["stream"].sid
+        if any(s.sid == sid for s in list(state["sched_s"]._running)):
+            raise SchedViolation(
+                "sequence still live at the source AFTER migrating — "
+                "duplicated execution")
+        snap = state["snap"]
+        pk = parked[outcome[1]]
+        got = [pk.kv.entry(i) for i in range(pk.kv.length)]
+        if not snap or got != snap:
+            raise SchedViolation(
+                f"KV content diverged across migration: {len(got)} "
+                f"entries at destination vs snapshot of {len(snap)}")
+        _accounted(state["mgr_s"])
+        _accounted(state["mgr_d"],
+                   owners=[p.kv for p in parked.values()])
+
+    def teardown(state):
+        state["net"].close()
+        _smod.DecodeScheduler._step_loop = state["orig_loop"]
+        try:
+            state["sched_s"].close(timeout=1.0)
+            state["decode_d"].close()
+            state["mgr_s"].close()
+            state["mgr_d"].close()
+        except Exception:
+            pass
+
+    return _with_couriers(
+        "simnet-migrate", setup,
+        [("S", pump), ("S", migrator), ("S", reader)], check,
+        [_module_file(_disagg), _mutants_file()], teardown, ["S", "D"],
+        max_steps=400000)
+
+
+# ---------------------------------------------------------------------------
+# Registry + suite faces (mirrors tpurpc.analysis.schedule).
+# ---------------------------------------------------------------------------
+
+#: scenario name -> zero-arg factory (fresh Scenario per exploration)
+SIM_SCENARIOS: Dict[str, Callable[[], Scenario]] = {
+    "simnet-kvship": _kvship_scenario,
+    "simnet-kvship-death": _kvship_death_scenario,
+    "simnet-adopt-drain": _adopt_drain_scenario,
+    "simnet-ctrl-kick": _ctrl_kick_scenario,
+    "simnet-close-complete": _close_complete_scenario,
+    "simnet-migrate": _migrate_scenario,
+}
+
+
+def _mutants():
+    from tpurpc.analysis import simmutants
+
+    return simmutants.SIM_MUTANTS
+
+
+def run_scenario(name: str, preemption_bound: int = 2,
+                 max_schedules: int = 20000,
+                 mutant: Optional[str] = None) -> ExploreResult:
+    """Explore one named simnet scenario, optionally with a seeded
+    real-code distributed mutant applied for the duration."""
+    scenario = SIM_SCENARIOS[name]()
+    if mutant is None:
+        return explore(scenario, preemption_bound, max_schedules)
+    m = _mutants()[mutant]
+    if m.scenario != name:
+        raise ValueError(f"mutant {mutant} targets scenario {m.scenario}, "
+                         f"not {name}")
+    with m.applied():
+        return explore(scenario, preemption_bound, max_schedules)
+
+
+def quick_suite(preemption_bound: int = 1, max_schedules: int = 200,
+                mutant_bound: int = 2, mutant_schedules: int = 4000,
+                verbose: bool = False) -> List[ExploreResult]:
+    """The check.sh ``simnet-quick`` stage: every scenario explored clean
+    at the given bound, every seeded distributed mutant killed. Mutants
+    search at ``mutant_bound`` with a deeper schedule budget — the
+    close/complete leak needs the courier preempted inside the unlocked
+    ``set_length`` window, which bound 1's DFS prefix order reaches only
+    ~1.2k schedules in. Sized to a ~30 s budget; the full-depth runs
+    live in tests/test_simnet.py."""
+    out: List[ExploreResult] = []
+    for name in sorted(SIM_SCENARIOS):
+        res = run_scenario(name, preemption_bound, max_schedules)
+        if verbose:
+            print(f"simnet: {res!r}")
+        out.append(res)
+    for mname, m in sorted(_mutants().items()):
+        res = run_scenario(m.scenario, mutant_bound, mutant_schedules,
+                           mutant=mname)
+        # a mutant result is GOOD when a violation was found
+        res = ExploreResult(f"mutant:{mname}", not res.ok, res.schedules,
+                            res.violation, res.steps, res.capped,
+                            res.preemption_bound)
+        if verbose:
+            kill = "KILLED" if res.ok else "SURVIVED"
+            print(f"simnet: mutant {mname}: {kill} "
+                  f"({res.schedules} schedules)")
+        out.append(res)
+    return out
+
+
+def mutant_kill_suite(preemption_bound: int = 2,
+                      max_schedules: int = 20000,
+                      verbose: bool = False) -> Dict[str, bool]:
+    """killed-by-exploration per seeded distributed mutant (acceptance:
+    every one True, and the clean scenarios must pass)."""
+    kills: Dict[str, bool] = {}
+    for mname, m in sorted(_mutants().items()):
+        res = run_scenario(m.scenario, preemption_bound, max_schedules,
+                           mutant=mname)
+        kills[mname] = res.violation is not None
+        if verbose:
+            print(f"simnet mutant {mname}: "
+                  f"{'KILLED' if kills[mname] else 'SURVIVED'} "
+                  f"({res.schedules} schedules, {res.steps} steps)")
+    return kills

@@ -69,6 +69,7 @@ from typing import Callable, Dict, Optional, Tuple
 
 from tpurpc.analysis.locks import make_lock
 from tpurpc.core import pair as _pair
+from tpurpc.core import transport as _transport
 from tpurpc.obs import flight as _flight
 from tpurpc.obs import lens as _lens
 from tpurpc.obs import metrics as _metrics
@@ -439,7 +440,8 @@ class CtrlPlane:
         if tx is None or not self.armed:
             return False
         t0 = time.monotonic_ns()
-        r = tx.post(op, stream_id, payload, frame_seq)
+        r = _transport.dispatch("post", self, tx.post, op, stream_id,
+                                payload, frame_seq)
         if not r:
             return False
         n = len(payload)
@@ -449,7 +451,7 @@ class CtrlPlane:
         if r == 2:
             _KICKS.inc()
             try:
-                kick()
+                _transport.dispatch("kick", self, kick)
             except Exception:
                 pass  # connection dying; the framed paths surface it
         return True

@@ -44,6 +44,7 @@ import numpy as np
 
 from tpurpc.analysis.locks import make_lock
 from tpurpc.core import _native
+from tpurpc.core import transport as _transport
 from tpurpc.obs import flight as _flight
 from tpurpc.obs import lens as _lens
 from tpurpc.obs import metrics as _metrics
@@ -986,6 +987,12 @@ class Pair:
         return bool(lib.tpr_load_u64_fenced(pin[1] + _WAIT_OFF[role]))
 
     def _notify(self, token: bytes) -> None:
+        # cross-process message: the transport seam makes the token's
+        # send timing an explorable pick under simnet (the raw socket
+        # send stays in _notify_raw — the xproc lint rule's allowance)
+        _transport.dispatch("frame", self, self._notify_raw, token)
+
+    def _notify_raw(self, token: bytes) -> None:
         sock = self.notify_sock
         if sock is None:
             return
@@ -1016,6 +1023,10 @@ class Pair:
         contiguously (the lock excludes token sends) and completely (the
         socket is non-blocking; a PARTIAL frame would corrupt the peer's
         parser, so retry to a bounded deadline instead of dropping)."""
+        return bool(_transport.dispatch("frame", self, self._send_frame_raw,
+                                        payload, timeout_s))
+
+    def _send_frame_raw(self, payload: bytes, timeout_s: float = 5.0) -> bool:
         import select as _select
 
         sock = self.notify_sock

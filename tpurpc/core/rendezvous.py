@@ -71,6 +71,7 @@ import numpy as np
 
 from tpurpc.analysis.locks import make_condition, make_lock
 from tpurpc.core import pair as _pair
+from tpurpc.core import transport as _transport
 from tpurpc.obs import flight as _flight
 from tpurpc.obs import lens as _lens
 from tpurpc.obs import metrics as _metrics
@@ -865,12 +866,14 @@ class RdvLink:
         post = self.ctrl_post
         if post is not None and ring_ok:
             try:
-                if post(op, stream_id, payload):
+                if _transport.dispatch("post", self, post, op, stream_id,
+                                       payload):
                     return
             except Exception:
                 pass  # ring tearing down: the framed path still works
         t0 = time.monotonic_ns()
-        self._coalescer.send(op, stream_id, payload)
+        _transport.dispatch("frame", self, self._coalescer.send, op,
+                            stream_id, payload)
         _RDV_CTRL_FRAMES.inc()
         n = len(payload)
         dt = time.monotonic_ns() - t0
@@ -1086,7 +1089,6 @@ class RdvLink:
         t0 = time.monotonic_ns()
         win = self._window_for(claim)
         view = win.view
-        off = claim.offset
         if view is not None:
             if claim.nonce and bytes(
                     view[claim.offset + claim.capacity:
@@ -1095,15 +1097,24 @@ class RdvLink:
                 raise OSError("rendezvous region nonce mismatch: the "
                               "claimed handle resolves to different memory "
                               "on this host")
-            for seg in segs:
-                sv = memoryview(seg).cast("B")
-                view[off:off + len(sv)] = sv
-                off += len(sv)
-        else:
-            for seg in segs:
-                sv = memoryview(seg).cast("B")
-                win.write(off, sv)
-                off += len(sv)
+
+        def _place() -> None:
+            off = claim.offset
+            if view is not None:
+                for seg in segs:
+                    sv = memoryview(seg).cast("B")
+                    view[off:off + len(sv)] = sv
+                    off += len(sv)
+            else:
+                for seg in segs:
+                    sv = memoryview(seg).cast("B")
+                    win.write(off, sv)
+                    off += len(sv)
+
+        # the one-sided landing is a cross-process message: under simnet
+        # the store itself becomes a deliverable, reorderable event (a
+        # straggler's write must land only in quarantined memory)
+        _transport.dispatch("write", self, _place)
         _ledger.rdma_write(total)
         dt = time.monotonic_ns() - t0
         _LENS_RDV_NS.inc(dt)
@@ -1485,16 +1496,25 @@ class GrantWriter:
                         "resolves to different memory on this host")
         t0 = time.monotonic_ns()
         total = 0
+        placed = []
         for off, chunk in zip(grant.offsets, chunks):
             sv = memoryview(chunk).cast("B")
             if len(sv) > grant.block_bytes:
                 raise ValueError(f"chunk of {len(sv)} exceeds the "
                                  f"{grant.block_bytes}-byte block")
-            if view is not None:
-                view[off:off + len(sv)] = sv
-            else:
-                win.write(off, sv)
+            placed.append((off, sv))
             total += len(sv)
+
+        def _place() -> None:
+            for off, sv in placed:
+                if view is not None:
+                    view[off:off + len(sv)] = sv
+                else:
+                    win.write(off, sv)
+
+        # the block placement is a cross-process one-sided write: simnet
+        # reorders/crashes it against the COMPLETE that must follow it
+        _transport.dispatch("write", self, _place)
         _ledger.rdma_write(total)
         dt = time.monotonic_ns() - t0
         _LENS_RDV_NS.inc(dt)

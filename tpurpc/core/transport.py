@@ -1,0 +1,73 @@
+"""The cross-process transport seam (tpurpc-simnet, ISSUE 17).
+
+Every message a tpurpc process sends to ANOTHER process — a framed
+control op, a descriptor-ring doorbell store, a one-sided window write,
+an fd kick — funnels through :func:`dispatch`. Outside an active
+simulation the seam is one global ``None``-check and a direct call:
+byte-identical behavior, no allocation, nothing observable.
+
+Under :mod:`tpurpc.analysis.simnet` the hook intercepts each dispatch
+and turns it into a *scheduler pick*: delivery order, bounded delay,
+per-link partitions, and node-crash-at-this-message-point all become
+explorable choices of the deterministic schedule explorer (the exact
+analog of the PR 12 lock-factory hook, one layer up the stack).
+
+The seam's contract, enforced structurally by the ``xproc`` lint rule:
+
+* Protocol logic in the cross-process modules (``rendezvous.py``,
+  ``ctrlring.py``, ``disagg.py``, the pair notify path) calls
+  ``dispatch(point, obj, fn, *args)`` instead of invoking the raw
+  send/store/kick directly.
+* ``point`` names the message class — ``"frame"`` (a framed/socket
+  control message), ``"post"`` (a descriptor-ring slot store), ``"write"``
+  (a one-sided window landing), ``"kick"`` (an fd doorbell).
+* ``obj`` identifies the emitting protocol object (the hook routes by
+  it); ``fn(*args, **kw)`` performs the real I/O when the hook declines
+  or is absent.
+* The hook returns ``NotImplemented`` to decline (the seam then calls
+  ``fn`` directly) or any other value to claim the dispatch — typically
+  after enqueuing ``fn`` for later in-order delivery on a simulated
+  link. A claimed ``"frame"`` dispatch must return a truthy value where
+  the caller checks delivery (``Pair._send_frame``).
+
+The hook is process-global and installation is not thread-safe by
+design: simulations install it before spawning scenario tasks and clear
+it after joining them, exactly like ``set_factory_hook``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+__all__ = ["set_transport_hook", "transport_hook", "dispatch"]
+
+#: ``hook(point, obj, fn, args, kwargs)`` -> ``NotImplemented`` to
+#: decline, anything else to claim the dispatch. ``None`` = no sim.
+_hook: Optional[Callable[..., Any]] = None
+
+
+def set_transport_hook(hook: Optional[Callable[..., Any]]) -> None:
+    """Install (or clear, with ``None``) the simulation transport hook."""
+    global _hook
+    _hook = hook
+
+
+def transport_hook() -> Optional[Callable[..., Any]]:
+    return _hook
+
+
+def dispatch(point: str, obj: Any, fn: Callable[..., Any],
+             *args: Any, **kwargs: Any) -> Any:
+    """Route one cross-process message emission through the seam.
+
+    ``fn(*args, **kwargs)`` is the real emission (socket send, ring slot
+    store, window write loop, fd kick); with no hook installed — the
+    production path — that call happens immediately and its value is
+    returned unchanged.
+    """
+    h = _hook
+    if h is not None:
+        r = h(point, obj, fn, args, kwargs)
+        if r is not NotImplemented:
+            return r
+    return fn(*args, **kwargs)

@@ -493,10 +493,17 @@ def _install_exit_dump() -> None:
 
     def _dump_at_exit():
         try:
+            # the clock anchor (pid + bracketed mono/wall pair) lets the
+            # offline checker rebase several per-process dumps of ONE run
+            # onto the shared wall clock and check them as a MERGED
+            # stream (`protocol --flight A --flight B`, ISSUE 17)
+            from tpurpc.obs import tracing as _tracing
+            doc = {"events": RECORDER.snapshot(),
+                   "clock_anchor": _tracing.clock_anchor()}
             os.makedirs(target, exist_ok=True)
             path = os.path.join(target, f"flight-{os.getpid()}.json")
             with open(path, "w", encoding="utf-8") as f:
-                json.dump(RECORDER.snapshot(), f)
+                json.dump(doc, f)
         except Exception:
             pass  # a failed postmortem dump must not fail the exit
 

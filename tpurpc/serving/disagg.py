@@ -178,6 +178,10 @@ class _Parked:
         self.nbytes = nbytes
 
 
+class _ClosedError(Exception):
+    """Internal: a registry insert lost the race against ``close()``."""
+
+
 # ---------------------------------------------------------------------------
 # Decode side: the handoff receiver + resume/park registry.
 # ---------------------------------------------------------------------------
@@ -200,6 +204,7 @@ class DisaggDecode:
         self._lock = make_lock("DisaggDecode._lock")
         self._pending: Dict[int, _Pending] = {}
         self._parked: Dict[int, _Parked] = {}
+        self._closed = False
         self._handoff_ids = itertools.count(1)
         self._tag = _flight.tag_for(f"disagg:{sched.name}")
         self.handoffs_in = 0
@@ -233,8 +238,16 @@ class DisaggDecode:
 
     def close(self) -> None:
         """Server teardown: pending handoffs quarantine (stragglers),
-        parked sequences free."""
+        parked sequences free. The ``_closed`` flag closes the window an
+        in-flight handler would otherwise slip through: ``on_complete``
+        drops ``_lock`` between popping its pending entry and parking the
+        result (``set_length`` must run unlocked), and an ``on_offer``
+        mid-alloc holds no lock at all — either one landing its registry
+        insert AFTER this clear would strand live blocks in a closed
+        server's registries, neither freed nor quarantined (found by the
+        simnet ``close-complete`` scenario, ISSUE 17)."""
         with self._lock:
+            self._closed = True
             pend = list(self._pending.values())
             self._pending.clear()
             parked = list(self._parked.values())
@@ -281,10 +294,18 @@ class DisaggDecode:
             account = _odyssey.sanitize_account(
                 _s(req["account"]) if "account" in req else None)
             with self._lock:
+                if self._closed:
+                    raise _ClosedError()
                 self._pending[handoff] = _Pending(
                     kv, seq_key, prompt,
                     time.monotonic() + self.pending_ttl_s,
                     trace=trace, account=account)
+        except _ClosedError:
+            # close() already swept the registries; registering now would
+            # strand these blocks forever — free and refuse instead
+            self.mgr.free_blocks(kv)
+            ctx.abort(StatusCode.UNAVAILABLE,
+                      "decode server closed: handoff refused")
         except BaseException:
             self.mgr.free_blocks(kv)
             raise
@@ -317,10 +338,22 @@ class DisaggDecode:
             ctx.abort(StatusCode.INVALID_ARGUMENT, str(exc))
         nbytes = n_tokens * ENTRY_BYTES
         with self._lock:
-            self._parked[pend.seq_key] = _Parked(
-                pend.kv, pend.prompt, last_token, emitted,
-                time.monotonic() + self.parked_ttl_s,
-                trace=pend.trace, account=pend.account, nbytes=nbytes)
+            if self._closed:
+                parked_ok = False
+            else:
+                parked_ok = True
+                self._parked[pend.seq_key] = _Parked(
+                    pend.kv, pend.prompt, last_token, emitted,
+                    time.monotonic() + self.parked_ttl_s,
+                    trace=pend.trace, account=pend.account, nbytes=nbytes)
+        if not parked_ok:
+            # close() ran between our pending-pop and this park: its sweep
+            # never saw these blocks, so release them here (the writer is
+            # done — COMPLETE means the bytes landed — so free, not
+            # quarantine) and tell the sender the server is gone
+            self.mgr.free_blocks(pend.kv, cache_prefix=True)
+            ctx.abort(StatusCode.UNAVAILABLE,
+                      "decode server closed: handoff not parked")
         self.handoffs_in += 1
         _HANDOFFS.inc()
         _HANDOFF_BYTES.inc(nbytes)

@@ -78,7 +78,7 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
-from tpurpc.analysis.locks import make_condition, make_lock
+from tpurpc.analysis.locks import make_condition, make_event, make_lock
 from tpurpc.obs import flight as _flight
 from tpurpc.obs import metrics as _metrics
 from tpurpc.obs import odyssey as _odyssey
@@ -462,7 +462,7 @@ class DecodeScheduler:
         ``prompt``, ``emitted``, ``last_token``, ``q`` all live) or None
         when the sid is gone/unknown. The caller now owns the KV table:
         it must ship-and-free, re-adopt, or quarantine it."""
-        ev = threading.Event()
+        ev = make_event("DecodeScheduler.detach")
         box: List[_Seq] = []
         with self._lock:
             if self._closed:
