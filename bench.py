@@ -2234,6 +2234,155 @@ def _hive_bench() -> dict:
     return out
 
 
+_NATIVE_LEG_CODE = r"""
+import json, statistics, sys, time
+
+mode, msgs, rounds = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+from tpurpc.rpc import native_client
+from tpurpc.rpc.channel import Channel
+from tpurpc.rpc.server import Server, stream_stream_rpc_method_handler
+
+kw = {} if mode.startswith("native") else {"native_dataplane": False}
+srv = Server(max_workers=4, **kw)
+def total(req_iter, ctx):
+    n = 0
+    for m in req_iter:
+        n += len(m)
+    yield str(n).encode()
+srv.add_method("/natbench.S/Sink", stream_stream_rpc_method_handler(total))
+port = srv.add_insecure_port("127.0.0.1:0")
+srv.start()
+payload = b"\xa5" * (4 << 20)
+opts = {} if mode.startswith("native") else {"tpurpc_native": False}
+with Channel(f"127.0.0.1:{port}") as ch:
+    mc = ch.stream_stream("/natbench.S/Sink", **opts)
+    # warmup settles the capability hello + standing grants — the first
+    # big send legitimately races the hello and frames
+    list(mc(iter([payload, payload]), timeout=60))
+    c0 = native_client.rdv_counters() or {}
+    gbps = []
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        out = list(mc(iter([payload] * msgs), timeout=300))
+        dt = time.perf_counter() - t0
+        assert out[-1] == str(msgs * len(payload)).encode(), out
+        gbps.append(msgs * len(payload) / dt / 1e9)
+    c1 = native_client.rdv_counters() or {}
+srv.stop(grace=1)
+delta = {k: c1.get(k, 0) - c0.get(k, 0) for k in c1}
+print("RESULT " + json.dumps({
+    "gbps": round(statistics.median(gbps), 3),
+    "gbps_rounds": [round(g, 3) for g in sorted(gbps)],
+    "counters_delta": delta,
+    "total_msgs": rounds * msgs,
+}), flush=True)
+"""
+
+
+def _native_bench(env) -> dict:
+    """tpurpc-ironclad (ISSUE 18): the native-plane A/B — ``stream_4MiB``
+    over (a) native client+server with rendezvous (the default ladder),
+    (b) native forced framed (size bar pushed above every payload — same
+    code path, zero offers, the honest framed control leg), and (c) the
+    Python plane with rendezvous (the PR 7 headline path) — same weather:
+    one run, sequential legs bracketed by a fresh memcpy yardstick.
+
+    Emits the native plane's ``ctrl_wakeups_per_msg`` (process-global C
+    counters: forced consumer kicks + framed control ops per message,
+    ≈0 in the ring-borne steady state) and ``native_stream_vs_memcpy_pct``
+    with the ≥80% gate BINDING wherever the rig has ≥2 cores; the honest
+    ``applicable: false`` + note survives only on true 1-core rigs, where
+    sender memcpy and receiver deliver timeshare one hart. Each leg is a
+    fresh subprocess so the env knobs and the process-global counters
+    start clean."""
+    cpus = _cores_available()
+    msgs = int(os.environ.get("TPURPC_BENCH_NATIVE_MSGS", "48"))
+    rounds = int(os.environ.get("TPURPC_BENCH_NATIVE_ROUNDS", "5"))
+    lenv = dict(env)
+    lenv["GRPC_PLATFORM_TYPE"] = "RDMA_BPEV"  # ring platform: C adoption
+    lenv["JAX_PLATFORMS"] = "cpu"  # jax-free legs; belt + braces
+    lenv.pop("PALLAS_AXON_POOL_IPS", None)
+
+    def leg(mode, extra=None):
+        e = dict(lenv)
+        if extra:
+            e.update(extra)
+        p = subprocess.run(
+            [sys.executable, "-u", "-c", _NATIVE_LEG_CODE, mode,
+             str(msgs), str(rounds)],
+            env=e, capture_output=True, text=True, timeout=240)
+        lines = [ln for ln in p.stdout.splitlines()
+                 if ln.startswith("RESULT ")]
+        if p.returncode != 0 or not lines:
+            raise RuntimeError(
+                f"native bench leg {mode} failed: {p.stderr[-800:]}")
+        return json.loads(lines[0][len("RESULT "):])
+
+    out: dict = {}
+    yard = _calibration().get("memcpy_gbps_best")  # same-weather yardstick
+    rdv = leg("native_rdv")
+    framed = leg("native_framed",
+                 {"TPURPC_RENDEZVOUS_MIN_KB": str(1 << 20)})
+    py = leg("python_rdv")
+    d = rdv["counters_delta"]
+    n = rdv["total_msgs"]
+    out["native_stream_4MiB_gbps"] = rdv["gbps"]
+    out["native_framed_4MiB_gbps"] = framed["gbps"]
+    out["python_rdv_4MiB_gbps"] = py["gbps"]
+    if framed["gbps"]:
+        out["native_rdv_vs_framed_x"] = round(rdv["gbps"] / framed["gbps"],
+                                              2)
+    if py["gbps"]:
+        out["native_vs_python_x"] = round(rdv["gbps"] / py["gbps"], 2)
+    # the control-plane claim, C-side: kicks + framed control ops per bulk
+    # message across the native leg's timed window (client AND server —
+    # the counters are process-global, so ≈0 is the stronger statement)
+    out["native_ctrl_wakeups_per_msg"] = round(
+        (d.get("ctrl_kicks", 0) + d.get("ctrl_frames", 0)) / n, 4)
+    out["native_rdv_fallbacks"] = d.get("rdv_fallback", 0)
+    out["native_host_copy_bytes_per_msg"] = round(
+        d.get("host_copy_bytes", 0) / n, 1)
+    if yard:
+        out["native_memcpy_gbps"] = yard
+        pct = round(100 * rdv["gbps"] / yard, 1)
+        out["native_stream_vs_memcpy_pct"] = pct
+        # the ISSUE 18 flip: the 80% gate BINDS wherever ≥2 cores let the
+        # receiver's deliver run beside the sender's memcpy
+        out["native_stream_vs_memcpy_gate"] = {
+            "target_pct": 80.0,
+            "applicable": cpus >= 2,
+            "pass": (pct >= 80.0) if cpus >= 2 else None,
+        }
+        if cpus < 2:
+            out["native_stream_vs_memcpy_note"] = (
+                "1-core rig: sender memcpy and receiver deliver timeshare "
+                "one hart, so the ceiling is 1/(t_memcpy + t_consume) "
+                "regardless of control-plane cost; "
+                "native_ctrl_wakeups_per_msg (≈0) and the rdv-vs-framed "
+                "A/B carry the native-plane claim here")
+    if cpus >= 2:
+        # delivery-shard A/B: decode/deliver off the receive hart is only
+        # a win when there is a second hart to take it
+        noshard = leg("native_rdv", {"TPURPC_NATIVE_DELIVERY": "0"})
+        out["native_noshard_4MiB_gbps"] = noshard["gbps"]
+        if noshard["gbps"]:
+            out["native_delivery_shard_speedup_x"] = round(
+                rdv["gbps"] / noshard["gbps"], 2)
+    else:
+        out["native_delivery_shard_note"] = (
+            "1-core rig: the delivery shard is auto-off (a queue handoff "
+            "to the only hart); its A/B binds on ≥2-core rigs and the "
+            "≥2.5x@4-core serving gate lives in serving_by_cores_gate")
+    out["native_bench_method"] = {
+        "payload_mib": 4, "msgs_per_round": msgs, "rounds": rounds,
+        "stat": "median of rounds", "handler": "bytes sink (jax-free)",
+        "rounds_sorted": {"native_rdv": rdv["gbps_rounds"],
+                          "native_framed": framed["gbps_rounds"],
+                          "python_rdv": py["gbps_rounds"]},
+    }
+    return out
+
+
 def _stream_by_size(port: int) -> dict:
     """tpurpc-express (ISSUE 9): message-size sweep 64 KiB → 16 MiB on the
     Python plane, rendezvous ON vs OFF (the size bar pushed above every
@@ -2556,6 +2705,15 @@ def main() -> None:
         except Exception as exc:
             sys.stderr.write(f"hive bench failed: {exc}\n")
             out["hive_bench_error"] = repr(exc)
+    # tpurpc-ironclad (ISSUE 18): the native-plane A/B — stream_4MiB over
+    # native+rdv vs native-framed vs python+rdv, same weather, with the
+    # native ctrl_wakeups_per_msg and the memcpy gate binding on ≥2 cores.
+    if os.environ.get("TPURPC_BENCH_NATIVE", "1") == "1":
+        try:
+            out.update(_native_bench(env))
+        except Exception as exc:
+            sys.stderr.write(f"native bench failed: {exc}\n")
+            out["native_bench_error"] = repr(exc)
     if fallback:
         # Loud, unmissable: this artifact measured the CPU fallback, not the
         # chip — the number is NOT comparable to an accelerator run (and the

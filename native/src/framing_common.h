@@ -20,7 +20,14 @@
 namespace tpr_wire {
 
 constexpr uint8_t kHeaders = 1, kMessage = 2, kTrailers = 3, kRst = 4,
-                  kPing = 5, kPong = 6, kGoaway = 7;
+                  kPing = 5, kPong = 6, kGoaway = 7,
+                  // rendezvous control ladder (frame.py RDV_*): frame type
+                  // = canonical op + 7 (OP_OFFER=1 .. OP_RELEASE=4)
+                  kRdvOffer = 8, kRdvClaim = 9, kRdvComplete = 10,
+                  kRdvRelease = 11,
+                  // one framed wakeup for a parked ctrl-ring consumer; the
+                  // fd readiness IS the wake — the frame body is ignored
+                  kCtrlKick = 12;
 constexpr uint8_t kFlagEndStream = 0x01, kFlagMore = 0x02,
                   kFlagNoMessage = 0x04,
                   // gzip-compressed message (Python peers only): the native

@@ -61,7 +61,7 @@ uint64_t tpr_ring_reserve(uint8_t *ring, uint64_t cap, uint64_t tail,
 void tpr_ring_commit(uint8_t *ring, uint64_t cap, uint64_t *tail,
                      uint64_t payload_len, uint64_t *seq);
 int tpr_ring_has_message(const uint8_t *ring, uint64_t cap, uint64_t head,
-                         uint64_t seq);
+                         uint64_t msg_len, uint64_t seq);
 void tpr_store_u64_seqcst(uint8_t *addr, uint64_t val);
 uint64_t tpr_load_u64_fenced(const uint8_t *addr);
 }
@@ -512,7 +512,8 @@ struct RingTransport {
     auto end = std::chrono::steady_clock::now() +
                std::chrono::microseconds(spin_us);
     while (std::chrono::steady_clock::now() < end) {
-      if (tpr_ring_has_message(recv_ring.base, ring_size, head, rseq))
+      if (tpr_ring_has_message(recv_ring.base, ring_size, head, msg_len,
+                               rseq))
         return true;
       if (!alive.load() || peer_exited.load()) return false;
 #if defined(__x86_64__) || defined(__i386__)
@@ -580,7 +581,8 @@ struct RingTransport {
   bool ring_empty_and_peer_gone() {
     if (!peer_gone()) return false;
     // peer exited, but drain whatever it wrote before leaving
-    return !tpr_ring_has_message(recv_ring.base, ring_size, head, rseq) &&
+    return !tpr_ring_has_message(recv_ring.base, ring_size, head, msg_len,
+                                 rseq) &&
            msg_len == 0;
   }
 

@@ -13,6 +13,7 @@
 
 #include "framing_common.h"
 #include "ring_transport.h"
+#include "tpr_rdv.h"
 
 #include <arpa/inet.h>
 #include <fcntl.h>
@@ -200,12 +201,18 @@ struct tpr_channel {
   std::atomic<bool> lease_active{false};
   std::thread::id lease_owner{};
   uint64_t lease_len = 0;
+  // rendezvous + ctrl-ring side of this channel (tpr_rdv.h); armed only if
+  // the peer's hello PING negotiates the ladder
+  tpr_rdv::Link *link = nullptr;
 
   ~tpr_channel() {
     alive.store(false);
+    if (link) link->close();  // wake claim waiters before the reader join
     if (ring) ring->shutdown();
     if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
     if (reader.joinable()) reader.join();
+    delete link;  // after the join: the reader drains/dispatches into it
+    link = nullptr;
     if (ring) {
       ring->close();
       delete ring;
@@ -222,9 +229,15 @@ struct tpr_channel {
                   const void *payload, size_t len) {
     std::lock_guard<std::mutex> lk(write_mu);
     if (!alive.load()) return false;
-    if (ring)  // one gathered ring message + one notify per frame
-      return ring_send_frame_locked(*ring, type, flags, sid, payload, len);
-    return t_send_frame_locked(*this, type, flags, sid, payload, len);
+    bool ok = ring  // one gathered ring message + one notify per frame
+                  ? ring_send_frame_locked(*ring, type, flags, sid, payload,
+                                           len)
+                  : t_send_frame_locked(*this, type, flags, sid, payload,
+                                        len);
+    // EVERY frame actually written counts (ctrl-ring records stamp this
+    // value as their ordering gate; an overcount would strand records)
+    if (ok && link) link->frames_sent.fetch_add(1, std::memory_order_release);
+    return ok;
   }
 
   bool read_exact(void *buf, size_t len) {
@@ -233,6 +246,7 @@ struct tpr_channel {
   }
 
   void die() {
+    if (link) link->close();  // fail rdv waiters; quarantine leases
     CqDeliveries evs;
     {
       std::lock_guard<std::mutex> lk(mu);
@@ -262,7 +276,16 @@ struct tpr_channel {
                     std::vector<uint8_t> &payload) {
     size_t len = payload.size();
 
+    // Rendezvous / ctrl-ring control plane rides its own frame types —
+    // routed before the stream demux (they address leases, not streams).
+    if (type >= kRdvOffer && type <= kCtrlKick) {
+      if (link) link->on_frame(type, sid, payload.data(), len);
+      return 1;
+    }
     if (type == kPing) {
+      // hello negotiation piggybacks on PING (maybe_hello no-ops on
+      // ordinary keepalive pings); always echo PONG regardless
+      if (link) link->maybe_hello(payload.data(), len);
       send_frame(kPong, 0, 0, payload.data(), payload.size());
       return 1;
     }
@@ -317,6 +340,10 @@ struct tpr_channel {
       send_frame(kRst, 0, sid, rst_payload.data(), rst_payload.size());
       return drained ? 0 : 1;
     }
+    // Framed bulk on a rendezvous-negotiated connection = a host landing
+    // copy the rdv path would have avoided; the ledger keeps that honest.
+    if (type == kMessage && link && link->negotiated.load())
+      tpr_rdv::count(tpr_rdv::kCtrHostCopyBytes, len);
     CqDeliveries cq_evs;
     std::unique_lock<std::mutex> lk(mu);
     auto it = streams.find(sid);
@@ -357,13 +384,61 @@ struct tpr_channel {
     return drained ? 0 : 1;
   }
 
+  // Bounded single-frame read for the hot ctrl-polling mode: 1 = frame,
+  // 0 = nothing within ~1ms, -1 = transport dead. For TCP the 1ms bound is
+  // on frame START (poll); once the header begins the read blocks to the
+  // frame boundary — fine, the bytes are already in flight.
+  int read_frame_slice(uint8_t *type, uint8_t *flags, uint32_t *sid,
+                       std::vector<uint8_t> *payload) {
+    if (ring) {
+      auto dl = Clock::now() + std::chrono::milliseconds(1);
+      return read_frame_dl(&dl, type, flags, sid, payload);
+    }
+    struct pollfd pfd;
+    pfd.fd = fd;
+    pfd.events = POLLIN;
+    pfd.revents = 0;
+    int pr = ::poll(&pfd, 1, 1);
+    if (pr == 0) return 0;
+    if (pr < 0) return errno == EINTR ? 0 : -1;
+    return t_read_frame(*this, type, flags, sid, payload) ? 1 : -1;
+  }
+
   void read_loop() {
+    if (link) link->set_dispatch_thread();
     std::vector<uint8_t> payload;
     uint8_t type, flags;
     uint32_t sid;
     while (alive.load()) {
-      if (!t_read_frame(*this, &type, &flags, &sid, &payload)) break;
-      if (process_frame(type, flags, sid, payload) == 0) break;
+      int r;
+      if (link && link->ctrl_rx_ready() && link->ctrl_hot()) {
+        // hot discipline: poll the ctrl ring between 1ms frame slices —
+        // steady-state bulk needs no frames and no fd kicks at all
+        if (link->ctrl_drain() == 0) {
+          link->ctrl_decay();
+          if (!link->ctrl_hot()) link->ctrl_park();
+        }
+        r = read_frame_slice(&type, &flags, &sid, &payload);
+        if (r == 0) continue;
+      } else {
+        // cold/parked: block on the fd; a parked producer sends CTRL_KICK
+        r = t_read_frame(*this, &type, &flags, &sid, &payload) ? 1 : -1;
+      }
+      if (r < 0) break;
+      // ctrl records whose ordering gate has been reached must land before
+      // the frame they precede (Python pre-commit drain analog)
+      if (link) link->ctrl_drain();
+      int cont = process_frame(type, flags, sid, payload);
+      if (link) {
+        link->frames_dispatched.fetch_add(1, std::memory_order_release);
+        // re-drain AFTER the dispatch count advances: a record gated on
+        // exactly this frame deferred in the pre-dispatch drain, and the
+        // producer may have posted it while we were unparked — without
+        // this pass it would strand until the next (possibly never)
+        // frame arrives (observed as 5s claim timeouts)
+        link->ctrl_drain();
+      }
+      if (cont == 0) break;
     }
     die();
   }
@@ -411,8 +486,36 @@ struct tpr_channel {
       }
       pumping = true;
       lk.unlock();
-      int r = read_frame_dl(dl, &type, &flags, &sid, &payload);
-      int cont = (r == 1) ? process_frame(type, flags, sid, payload) : 1;
+      if (link) link->ctrl_drain();  // inline pumpers service the ring too
+      int r;
+      if (link && link->ctrl_rx_ready() && link->ctrl_hot()) {
+        // read_loop's hot/cold ctrl discipline, inline-pumper edition: a
+        // pumper must never commit to a blocking read while the ctrl ring
+        // is unparked — a producer that read parked=0 skips the CTRL_KICK,
+        // so a record posted behind this read would strand until some
+        // unrelated frame arrives (the defer-then-block lost wakeup,
+        // observed as 5s claim timeouts). Poll in 1ms slices while hot;
+        // park before blocking for real.
+        auto slice = Clock::now() + std::chrono::milliseconds(1);
+        const Clock::time_point *sdl =
+            (dl != nullptr && *dl < slice) ? dl : &slice;
+        r = read_frame_dl(sdl, &type, &flags, &sid, &payload);
+        if (r == 0 && link->ctrl_drain() == 0) {
+          link->ctrl_decay();
+          if (!link->ctrl_hot()) link->ctrl_park();
+        }
+      } else {
+        r = read_frame_dl(dl, &type, &flags, &sid, &payload);
+      }
+      int cont = 1;
+      if (r == 1) {
+        if (link) link->ctrl_drain();
+        cont = process_frame(type, flags, sid, payload);
+        if (link) {
+          link->frames_dispatched.fetch_add(1, std::memory_order_release);
+          link->ctrl_drain();  // lift the gate for records on THIS frame
+        }
+      }
       lk.lock();
       pumping = false;
       cv.notify_all();  // deliver wakeups + hand off the pump
@@ -420,9 +523,10 @@ struct tpr_channel {
         lk.unlock();
         die();
         lk.lock();
-      } else if (r == 0 && !pred()) {
-        return false;  // own deadline hit at a frame boundary
       }
+      // r == 0: a slice or deadline expired at a frame boundary — loop;
+      // the own-deadline check at the top returns false when `dl` truly
+      // passed (slice expiries with dl unset just keep pumping).
     }
     return true;
   }
@@ -580,11 +684,59 @@ tpr_channel *tpr_channel_create2(const char *host, int port, int timeout_ms,
     delete ch;
     return nullptr;
   }
+  if (tpr_rdv::enabled()) {
+    // Rendezvous link: send_frame goes through ch->send_frame (which also
+    // does the frames_sent accounting); deliver copies the landing region
+    // into the call mailbox then settles the lease. The client API's recv
+    // copies out of a std::string anyway, so the zero-landing-copy win is
+    // a server-side property; the client-side rdv win is skipping framed
+    // fragmentation + per-frame wakeups on send.
+    ch->link = new tpr_rdv::Link("cli");
+    ch->link->send_frame = [ch](uint8_t type, uint32_t sid,
+                                const std::string &payload) {
+      return ch->send_frame(type, 0, sid, payload.data(), payload.size());
+    };
+    ch->link->deliver = [ch](uint32_t sid, uint8_t dflags, uint8_t *data,
+                             size_t len) {
+      CqDeliveries evs;
+      {
+        std::unique_lock<std::mutex> lk(ch->mu);
+        auto it = ch->streams.find(sid);
+        if (it != ch->streams.end()) {
+          Call &c = it->second->c;
+          c.messages.emplace_back(reinterpret_cast<char *>(data), len);
+          if (dflags & kFlagEndStream) {
+            c.trailers_seen = true;
+            c.status_code = TPR_OK;
+            ch->streams.erase(it);
+          }
+          drain_cq_locked(c, &evs);
+          cq_push(&evs);
+        }
+      }
+      ch->cv.notify_all();
+      tpr_rdv::settle(data);  // recycle the lease; `data` is region memory
+    };
+    ch->link->wake = [ch] { ch->cv.notify_all(); };
+    if (!ch->send_frame(kPing, 0, 0, ch->link->hello_payload().data(),
+                        ch->link->hello_payload().size())) {
+      delete ch;
+      return nullptr;
+    }
+  }
   // Inline-read (opt-in, ring platforms): the lowest-latency blocking
   // discipline — callers pump the transport themselves, no reader thread.
   // CQ async ops need the reader and refuse on such channels.
   ch->inline_read =
       ch->ring != nullptr && (flags & TPR_CHANNEL_INLINE_READ) != 0;
+  if (ch->inline_read && ch->link) {
+    // no reader thread: rdv claim waiters pump the transport themselves
+    ch->link->pump = [ch](const std::function<bool()> &pred,
+                          Clock::time_point dl) {
+      std::unique_lock<std::mutex> lk(ch->mu);
+      ch->pump_until(lk, [&] { return pred(); }, &dl);
+    };
+  }
   if (!ch->inline_read)
     ch->reader = std::thread([ch] { ch->read_loop(); });
   return ch;
@@ -674,10 +826,14 @@ static bool ship_buffered(tpr_channel *ch, tpr_call *call,
                      req_len);
   buf.append(reinterpret_cast<const char *>(req), req_len);
   std::lock_guard<std::mutex> lk(ch->write_mu);
-  return ch->alive.load() &&
-         (ch->ring
-              ? ch->ring->write_gather(buf.data(), buf.size(), nullptr, 0)
-              : tpr_wire::fd_write_all(ch->fd, buf.data(), buf.size()));
+  bool ok =
+      ch->alive.load() &&
+      (ch->ring ? ch->ring->write_gather(buf.data(), buf.size(), nullptr, 0)
+                : tpr_wire::fd_write_all(ch->fd, buf.data(), buf.size()));
+  // this path bypasses send_frame; it ships TWO frames in one write
+  if (ok && ch->link)
+    ch->link->frames_sent.fetch_add(2, std::memory_order_release);
+  return ok;
 }
 
 // Internal: register a call and ship HEADERS + the whole request MESSAGE
@@ -713,6 +869,14 @@ tpr_call *tpr_call_start(tpr_channel *ch, const char *method,
 
 int tpr_call_send(tpr_call *c, const uint8_t *data, size_t len, int end_stream) {
   tpr_channel *ch = c->c.ch;
+  // Rendezvous ladder first: on a negotiated native connection, payloads
+  // at/above the threshold one-sided-write into a leased landing region —
+  // no framed fragmentation, and in steady state no control frames either
+  // (the COMPLETE rides the ctrl ring). Any failure falls through framed.
+  if (ch->link && ch->link->eligible(len) &&
+      ch->link->send_message(c->c.stream_id,
+                             end_stream ? kFlagEndStream : 0, data, len))
+    return 0;
   // fragment at the frame bound with MORE on all but the last piece
   size_t off = 0;
   do {
@@ -833,6 +997,9 @@ int tpr_call_send_commit(tpr_call *c) {
   tpr_channel *ch = c->c.ch;
   if (!lease_owned_by_me(ch)) return -1;
   ch->ring->commit_lease(ch->lease_len);
+  // the lease published one MESSAGE frame outside send_frame
+  if (ch->link)
+    ch->link->frames_sent.fetch_add(1, std::memory_order_release);
   ch->lease_active.store(false);
   ch->write_mu.unlock();
   return 0;

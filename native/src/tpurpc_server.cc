@@ -48,6 +48,7 @@
 #include <vector>
 
 #include "framing_common.h"
+#include "tpr_rdv.h"
 
 using namespace tpr_wire;
 
@@ -64,6 +65,11 @@ struct OwnedBuf {
   uint8_t *p = nullptr;
   size_t len = 0;
   size_t cap = 0;
+  // true when p points into a rendezvous landing region (tpr_rdv): NOT a
+  // malloc chunk — disposal must settle() (ring the doorbell / recycle),
+  // never free(). tpr_srv_buf_free consults the same registry, so the
+  // handler-facing contract is unchanged either way.
+  bool ext = false;
 
   // move-only: a raw-owning struct that the compiler lets you copy is a
   // double free waiting for a maintainer (the container moves below are
@@ -71,22 +77,41 @@ struct OwnedBuf {
   OwnedBuf() = default;
   OwnedBuf(const OwnedBuf &) = delete;
   OwnedBuf &operator=(const OwnedBuf &) = delete;
-  OwnedBuf(OwnedBuf &&o) noexcept : p(o.p), len(o.len), cap(o.cap) {
+  OwnedBuf(OwnedBuf &&o) noexcept
+      : p(o.p), len(o.len), cap(o.cap), ext(o.ext) {
     o.p = nullptr;
     o.len = o.cap = 0;
+    o.ext = false;
   }
   OwnedBuf &operator=(OwnedBuf &&o) noexcept {
     if (this != &o) {
-      free(p);
+      dispose();
       p = o.p;
       len = o.len;
       cap = o.cap;
+      ext = o.ext;
       o.p = nullptr;
       o.len = o.cap = 0;
+      o.ext = false;
     }
     return *this;
   }
-  ~OwnedBuf() { free(p); }
+  ~OwnedBuf() { dispose(); }
+
+  void dispose() {
+    if (p == nullptr) return;
+    if (!ext || !tpr_rdv::settle(p)) free(p);
+    p = nullptr;
+  }
+
+  // take ownership of an existing buffer: a malloc chunk (rdv=false) or a
+  // delivered landing-region pointer (rdv=true)
+  void adopt(uint8_t *buf, size_t n, bool rdv) {
+    dispose();
+    p = buf;
+    len = cap = n;
+    ext = rdv;
+  }
 
   void append(const uint8_t *src, size_t n) {
     if (n == 0) return;  // empty message: memcpy(NULL,..,0) is still UB
@@ -173,6 +198,12 @@ struct Conn {
   std::atomic<bool> alive{true};
   std::atomic<bool> fd_closed{false};
   std::atomic<int> handler_threads{0};
+  // rendezvous + ctrl-ring side of this connection (tpr_rdv.h); created at
+  // bootstrap, armed only if the peer's hello negotiates
+  tpr_rdv::Link *link = nullptr;
+  // delivery-shard items in flight for this conn: reap must wait for zero
+  // (an item holds a raw Conn*)
+  std::atomic<int> delivery_refs{0};
   //: teardown ran (streams failed, fd closed)
   std::atomic<bool> finished{false};
   //: safe to free: set only after the conn's poller can no longer hold a
@@ -192,6 +223,7 @@ struct Conn {
   std::vector<uint8_t> payload;
 
   ~Conn() {
+    delete link;  // ~Link closes: discards leases, unmaps rings/windows
     if (ring) {
       ring->close();
       delete ring;
@@ -224,9 +256,15 @@ struct Conn {
                   const void *payload_, size_t len) {
     std::lock_guard<std::mutex> lk(write_mu);
     if (fd_closed.load()) return false;
-    if (ring)  // one gathered ring message + one notify per frame
-      return ring_send_frame_locked(*ring, type, flags, sid, payload_, len);
-    return t_send_frame_locked(*this, type, flags, sid, payload_, len);
+    bool ok = ring  // one gathered ring message + one notify per frame
+                  ? ring_send_frame_locked(*ring, type, flags, sid,
+                                           payload_, len)
+                  : t_send_frame_locked(*this, type, flags, sid, payload_,
+                                        len);
+    // EVERY frame actually written counts (ctrl-ring records stamp this
+    // value as their ordering gate; an overcount would strand records)
+    if (ok && link) link->frames_sent.fetch_add(1, std::memory_order_release);
+    return ok;
   }
 
   void send_trailers(uint32_t sid, int code, const std::string &details,
@@ -345,6 +383,130 @@ struct tpr_server {
   std::atomic<size_t> next_poller{0};
   std::atomic<int> bootstrap_threads{0};
 
+  // -- delivery shard (tentpole 3): decode/materialization off the poller --
+  // On negotiated connections (and when enabled — TPURPC_NATIVE_DELIVERY,
+  // auto = on with >= 2 cores) completed messages, half-closes and RSTs go
+  // through ONE FIFO drained by a dedicated thread, so the poller does
+  // nothing but land bytes and publish. Rendezvous deliveries ride the same
+  // queue, which is what keeps framed and rdv messages of one stream in
+  // order. Items pin their Conn via delivery_refs (reap waits for zero).
+  struct DeliveryItem {
+    Conn *c;
+    uint32_t sid;
+    uint8_t flags;
+    uint8_t *data;  // malloc (rdv=false) or landing region (rdv=true)
+    size_t len;
+    bool rdv;
+    bool rst;
+  };
+  std::thread delivery_th;
+  std::mutex dq_mu;
+  std::condition_variable dq_cv;
+  std::deque<DeliveryItem> dq;
+  std::atomic<bool> delivery_on{false};
+  bool dq_stop = false;
+
+  static bool delivery_from_env() {
+    const char *v = getenv("TPURPC_NATIVE_DELIVERY");
+    if (v) {
+      if (strcmp(v, "0") == 0 || strcasecmp(v, "off") == 0 ||
+          strcasecmp(v, "false") == 0)
+        return false;
+      if (strcasecmp(v, "auto") != 0) return true;
+    }
+    // the measured reason the memcpy gate was inapplicable on 1 core: a
+    // shard there just adds a handoff to the only hart
+    return std::thread::hardware_concurrency() >= 2;
+  }
+
+  static void dispose_payload(uint8_t *data, bool rdv) {
+    if (data == nullptr) return;
+    if (!rdv || !tpr_rdv::settle(data)) free(data);
+  }
+
+  void enqueue_delivery(Conn *c, uint32_t sid, uint8_t flags, uint8_t *data,
+                        size_t len, bool rdv, bool rst = false) {
+    c->delivery_refs.fetch_add(1);
+    {
+      std::lock_guard<std::mutex> lk(dq_mu);
+      dq.push_back(DeliveryItem{c, sid, flags, data, len, rdv, rst});
+    }
+    dq_cv.notify_one();
+  }
+
+  // The single delivery entry: runs on the shard when enabled, inline on
+  // the poller otherwise. data==nullptr is a pure marker (half-close/RST).
+  void deliver_msg(Conn *c, uint32_t sid, uint8_t flags, uint8_t *data,
+                   size_t len, bool rdv, bool rst) {
+    if (c->finished.load()) {  // conn tore down with this item in flight
+      dispose_payload(data, rdv);
+      return;
+    }
+    std::unique_lock<std::mutex> lk(c->mu);
+    auto it = c->streams.find(sid);
+    if (it == c->streams.end()) {
+      lk.unlock();
+      dispose_payload(data, rdv);
+      return;
+    }
+    tpr_server_call *call = it->second;
+    if (rst) {
+      if (call->inline_cb) {
+        c->streams.erase(it);
+        lk.unlock();
+        delete call;
+      } else {
+        call->cancelled = true;
+        lk.unlock();
+        c->cv.notify_all();
+      }
+      return;
+    }
+    if (call->inline_cb) {
+      lk.unlock();
+      int code = 0;
+      if (data != nullptr) {
+        // the cb borrows the buffer (region or malloc) for the call only
+        code = call->inline_cb(call, data, len, call->inline_ud);
+        dispose_payload(data, rdv);
+      }
+      if (code < 0) code = 13;
+      if (code != 0 || (flags & kFlagEndStream)) {
+        {
+          std::lock_guard<std::mutex> lk2(c->mu);
+          c->streams.erase(sid);
+        }
+        c->finish_call_trailers(call, code);
+        delete call;
+      }
+      return;
+    }
+    if (data != nullptr) {
+      OwnedBuf b;
+      b.adopt(data, len, rdv);
+      call->pending.push_back(std::move(b));
+    }
+    if (flags & kFlagEndStream) call->half_closed = true;
+    lk.unlock();
+    c->cv.notify_all();
+  }
+
+  void delivery_loop() {
+    for (;;) {
+      DeliveryItem item;
+      {
+        std::unique_lock<std::mutex> lk(dq_mu);
+        dq_cv.wait(lk, [&] { return dq_stop || !dq.empty(); });
+        if (dq.empty()) return;  // stop requested and fully drained
+        item = dq.front();
+        dq.pop_front();
+      }
+      deliver_msg(item.c, item.sid, item.flags, item.data, item.len,
+                  item.rdv, item.rst);
+      item.c->delivery_refs.fetch_sub(1);
+    }
+  }
+
   static int poller_count_from_env() {
     const char *v = getenv("TPURPC_SERVER_POLLERS");
     if (!v) v = getenv("GRPC_RDMA_POLLER_THREAD_NUM");
@@ -410,9 +572,24 @@ struct tpr_server {
   // serve_conn body; returns false when the connection must end.
   bool on_frame(Conn *c, uint8_t type, uint8_t flags, uint32_t sid,
                 std::vector<uint8_t> &payload) {
+    if (type >= kRdvOffer && type <= kCtrlKick) {
+      // rendezvous/ctrl control ladder: the link consumes these (framed
+      // fallback ops, or a kick for our parked ring)
+      if (c->link) c->link->on_frame(type, sid, payload.data(),
+                                     payload.size());
+      return true;
+    }
     if (type == kPing) {
+      // capability hello rides the PING payload; the echo below doubles
+      // as the hello ack either way
+      if (c->link) c->link->maybe_hello(payload.data(), payload.size());
       c->send_frame(kPong, 0, 0, payload.data(), payload.size());
       return true;
+    }
+    if (type == kMessage && c->link && c->link->negotiated.load()) {
+      // framed message bytes on a rendezvous-capable conn = host landing
+      // copies the ladder did NOT absorb (the ledger the smoke checks)
+      tpr_rdv::count(tpr_rdv::kCtrHostCopyBytes, payload.size());
     }
     if (type == kHeaders) {
       std::vector<std::pair<std::string, std::string>> md;
@@ -499,6 +676,47 @@ struct tpr_server {
     auto it = c->streams.find(sid);
     if (it == c->streams.end()) return true;  // finished/unknown: drop
     tpr_server_call *call = it->second;
+    if (delivery_on.load() && c->link && c->link->negotiated.load() &&
+        (type == kMessage || type == kRst)) {
+      // Shard routing: on negotiated conns the poller only LANDS bytes —
+      // completed messages, half-closes and RSTs flow through the delivery
+      // FIFO, which is also where rendezvous completions surface, so the
+      // two kinds of message stay in their arrival order and no inline cb
+      // ever runs concurrently on two threads for one call. (The fragment
+      // accumulator stays poller-owned; touching it under c->mu here
+      // excludes the shard's erase-then-delete.)
+      if (type == kRst) {
+        lk.unlock();
+        enqueue_delivery(c, sid, flags, nullptr, 0, false, /*rst=*/true);
+        return true;
+      }
+      const bool has_payload = !(flags & kFlagNoMessage);
+      const bool complete = has_payload && !(flags & kFlagMore);
+      uint8_t *buf = nullptr;
+      size_t blen = 0;
+      bool have_msg = false;
+      if (has_payload) {
+        if (complete && call->partial.len == 0) {
+          blen = payload.size();
+          buf = static_cast<uint8_t *>(malloc(blen ? blen : 1));
+          if (buf == nullptr) abort();  // OOM: accumulator path's fate too
+          if (blen) memcpy(buf, payload.data(), blen);
+          have_msg = true;
+        } else {
+          call->partial.append(payload.data(), payload.size());
+          if (complete) {
+            buf = call->partial.release(&blen);
+            have_msg = true;
+          }
+        }
+      }
+      lk.unlock();
+      if (have_msg)
+        enqueue_delivery(c, sid, flags, buf, blen, /*rdv=*/false);
+      else if (flags & kFlagEndStream)  // pure half-close marker
+        enqueue_delivery(c, sid, flags, nullptr, 0, /*rdv=*/false);
+      return true;
+    }
     if (call->inline_cb) {
       // reactor path: complete messages run the cb ON THIS THREAD;
       // teardown is immediate at RST/half-close/nonzero-return. Only the
@@ -597,8 +815,20 @@ struct tpr_server {
       // frame complete
       c->in_payload = false;
       c->got = 0;
-      if (!on_frame(c, c->f_type, c->f_flags, c->f_sid, c->payload))
-        return -1;
+      // ctrl-ring records ordered BEFORE this frame (frame_seq gate)
+      // drain first — the Python reader's pre-commit drain; this is what
+      // makes ring-borne COMPLETEs land before the TRAILERS behind them
+      if (c->link) c->link->ctrl_drain();
+      bool frame_ok =
+          on_frame(c, c->f_type, c->f_flags, c->f_sid, c->payload);
+      if (c->link) {
+        c->link->frames_dispatched.fetch_add(1, std::memory_order_release);
+        // re-drain now that the count covers this frame: a record gated
+        // on it deferred above and would otherwise strand until the next
+        // frame (the defer-then-block lost wakeup)
+        c->link->ctrl_drain();
+      }
+      if (!frame_ok) return -1;
       if (--budget == 0) return 1;
     }
   }
@@ -607,6 +837,10 @@ struct tpr_server {
   // handlers. The Conn itself is freed by reap once handler threads drain.
   void finish_conn(Conn *c) {
     if (c->finished.exchange(true)) return;
+    // discard-quarantine claimed regions, wake claim waiters (handler
+    // threads blocked in a rendezvous claim exit via the framed-fallback
+    // path, whose send then fails cleanly on the closed fd)
+    if (c->link) c->link->close();
     {
       std::lock_guard<std::mutex> lk(c->mu);
       for (auto &kv : c->streams) kv.second->cancelled = true;
@@ -619,7 +853,7 @@ struct tpr_server {
     // the map first), so anything left in the map after handlers DRAIN is
     // poller-owned. With live handler threads, leave the map alone — the
     // reap path frees stragglers once handler_threads hits zero.
-    if (c->handler_threads.load() == 0) {
+    if (c->handler_threads.load() == 0 && c->delivery_refs.load() == 0) {
       std::lock_guard<std::mutex> lk(c->mu);
       for (auto &kv : c->streams) delete kv.second;
       c->streams.clear();
@@ -631,7 +865,8 @@ struct tpr_server {
     std::lock_guard<std::mutex> lk(conns_mu);
     for (auto it = conns.begin(); it != conns.end();) {
       Conn *c = *it;
-      if (c->reapable.load() && c->handler_threads.load() == 0) {
+      if (c->reapable.load() && c->handler_threads.load() == 0 &&
+          c->delivery_refs.load() == 0) {
         {
           std::lock_guard<std::mutex> lk2(c->mu);
           for (auto &kv : c->streams) delete kv.second;
@@ -652,6 +887,25 @@ struct tpr_server {
       finish_conn(c);
       c->reapable.store(true);  // never reached a poller: no stale events
     } else {
+      // rendezvous/ctrl-ring link: wired before the conn can dispatch a
+      // frame. The hello PING (capability + our ring descriptor) goes out
+      // right after the preface; an un-negotiated peer just echoes PONG
+      // and stays on the framed path, byte-identical to before.
+      c->link = new tpr_rdv::Link("srv");
+      c->link->send_frame = [c](uint8_t type, uint32_t sid,
+                                const std::string &p) {
+        return c->send_frame(type, 0, sid, p.data(), p.size());
+      };
+      c->link->deliver = [this, c](uint32_t sid, uint8_t flags,
+                                   uint8_t *data, size_t len) {
+        if (delivery_on.load())
+          enqueue_delivery(c, sid, flags, data, len, /*rdv=*/true);
+        else
+          deliver_msg(c, sid, flags, data, len, /*rdv=*/true, false);
+      };
+      c->link->wake = [c] { c->cv.notify_all(); };
+      std::string hello = c->link->hello_payload();
+      c->send_frame(kPing, 0, 0, hello.data(), hello.size());
       Poller *p = pollers[next_poller.fetch_add(1) % pollers.size()];
       c->poller = p;
       p->add(c);
@@ -704,8 +958,21 @@ void Poller::loop() {
   // consumed, so no further epoll event is guaranteed). While any are hot
   // the epoll_wait runs nonblocking so fresh events interleave fairly.
   std::vector<Conn *> hot;
+  // every conn this poller serves (for the ctrl-ring hot-poll sweep)
+  std::vector<Conn *> managed;
   while (running.load()) {
-    int n = ::epoll_wait(epfd, evs, kMaxEvents, hot.empty() ? 200 : 0);
+    // drain-EWMA hot/cold (read_frame_polled's discipline): while any
+    // link's ring is hot, poll on ~1 ms slices instead of the 200 ms
+    // block — steady-state bulk then needs zero fd kicks
+    bool ctrl_hot_any = false;
+    for (Conn *mc : managed) {
+      if (!mc->finished.load() && mc->link && mc->link->ctrl_hot()) {
+        ctrl_hot_any = true;
+        break;
+      }
+    }
+    int n = ::epoll_wait(epfd, evs, kMaxEvents,
+                         !hot.empty() ? 0 : (ctrl_hot_any ? 1 : 200));
     if (!running.load()) return;
     // adopt pending conns FIRST, with an unconditional initial pump: ring
     // bytes that landed during bootstrap may carry no further token
@@ -735,6 +1002,10 @@ void Poller::loop() {
         end_conn(c);
         continue;
       }
+      managed.push_back(c);
+      // this thread is the conn's frame-dispatch hart: it must never
+      // block in a claim wait (the claim it waits for dispatches here)
+      if (c->link) c->link->set_dispatch_thread();
       after_pump(c, srv->pump_conn(c));
     }
     std::vector<Conn *> rehot;
@@ -765,6 +1036,18 @@ void Poller::loop() {
         after_pump(c, srv->pump_conn(c));
       }
     }
+    // ctrl-ring sweep: drain hot links; an empty probe decays the EWMA,
+    // and a link that just went cold PARKS (parked=1 + one mandatory
+    // re-drain, closing the lost-wakeup race — the producer reads parked
+    // strictly after its stamp store). Kicks then wake us via the fd.
+    for (Conn *c : managed) {
+      if (c->finished.load() || !c->link || !c->link->ctrl_rx_ready())
+        continue;
+      if (c->link->ctrl_hot() && c->link->ctrl_drain() == 0) {
+        c->link->ctrl_decay();
+        if (!c->link->ctrl_hot()) c->link->ctrl_park();
+      }
+    }
     // A conn can land in `hot` (budget hit) and THEN be finished by a later
     // epoll event in the same batch; it stays in `hot` across iterations, so
     // if the reaper freed it between batches the next rehot pass would read
@@ -773,6 +1056,9 @@ void Poller::loop() {
     hot.erase(std::remove_if(hot.begin(), hot.end(),
                              [](Conn *c) { return c->finished.load(); }),
               hot.end());
+    managed.erase(std::remove_if(managed.begin(), managed.end(),
+                                 [](Conn *c) { return c->finished.load(); }),
+                  managed.end());
     // only AFTER the batch (no stale event can reference them) may the
     // reaper free these conns
     for (Conn *c : finished_this_batch) c->reapable.store(true);
@@ -869,6 +1155,9 @@ int tpr_server_start(tpr_server *s) {
     }
     s->pollers.push_back(p);
   }
+  s->delivery_on.store(tpr_server::delivery_from_env());
+  if (s->delivery_on.load())
+    s->delivery_th = std::thread([s] { s->delivery_loop(); });
   s->accept_thread = std::thread([s] { s->accept_loop(); });
   return 0;
 }
@@ -904,6 +1193,21 @@ void tpr_server_destroy(tpr_server *s) {
       s->finish_conn(c);
       while (c->handler_threads.load() > 0)
         std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    }
+  }
+  // All producers (pollers, handlers) are quiet: drain and stop the
+  // delivery shard BEFORE freeing conns — queued items hold raw Conn*.
+  if (s->delivery_th.joinable()) {
+    {
+      std::lock_guard<std::mutex> lk(s->dq_mu);
+      s->dq_stop = true;
+    }
+    s->dq_cv.notify_all();
+    s->delivery_th.join();
+  }
+  {
+    std::lock_guard<std::mutex> lk(s->conns_mu);
+    for (Conn *c : s->conns) {
       {
         std::lock_guard<std::mutex> lk2(c->mu);
         for (auto &kv : c->streams) delete kv.second;
@@ -945,6 +1249,14 @@ static void flush_initial_md(tpr_server_call *c) {
 
 int tpr_srv_send(tpr_server_call *c, const uint8_t *data, size_t len) {
   flush_initial_md(c);
+  // Bulk ladder: eligible payloads on a negotiated link move by one
+  // one-sided write into a claimed landing region + one COMPLETE record —
+  // zero framed MESSAGE bytes. ANY failure returns false and the framed
+  // loop below carries the message instead (fallback, never a hang).
+  tpr_rdv::Link *link = c->conn->link;
+  if (link && link->eligible(len) &&
+      link->send_message(c->stream_id, 0, data, len))
+    return 0;
   size_t off = 0;
   do {
     size_t n = len - off;
@@ -998,6 +1310,10 @@ int tpr_srv_cancelled(tpr_server_call *c) {
   return c->cancelled ? 1 : 0;
 }
 
-void tpr_srv_buf_free(uint8_t *data) { free(data); }
+void tpr_srv_buf_free(uint8_t *data) {
+  // a delivered rendezvous region settles (doorbell/recycle); everything
+  // else keeps the original free() contract
+  if (!tpr_rdv::settle(data)) free(data);
+}
 
 }  // extern "C"

@@ -48,7 +48,7 @@ def _build_cpp(out_bin, example, native_src, headers):
 
 
 def _build_example():
-    _build_cpp(BIN, "cpp_client.cc", ["tpurpc_client.cc", "ring.cc"],
+    _build_cpp(BIN, "cpp_client.cc", ["tpurpc_client.cc", "tpr_rdv.cc", "ring.cc"],
                ["client.h", "client.hpp"])
 
 
@@ -125,7 +125,7 @@ def test_cpp_send_lease_ring(monkeypatch):
     monkeypatch.setenv("GRPC_PLATFORM_TYPE", "RDMA_BPEV")
     lease_bin = os.path.join(ROOT, "native", "build", "cpp_send_lease")
     _build_cpp(lease_bin, "cpp_send_lease.cc",
-               ["tpurpc_client.cc", "ring.cc"], ["client.h"])
+               ["tpurpc_client.cc", "tpr_rdv.cc", "ring.cc"], ["client.h"])
 
     def check(req_iter, ctx):
         for m in req_iter:
@@ -179,6 +179,7 @@ int main() {{
         subprocess.run(
             ["g++", "-std=c++17", "-O0", tmp_src,
              os.path.join(ROOT, "native", "src", "tpurpc_client.cc"),
+             os.path.join(ROOT, "native", "src", "tpr_rdv.cc"),
              os.path.join(ROOT, "native", "src", "ring.cc"),
              "-I", os.path.join(ROOT, "native", "include"),
              "-lpthread", "-lrt", "-o", tmp_bin],
@@ -269,6 +270,7 @@ int main(int argc, char **argv) {
         subprocess.run(
             ["g++", "-std=c++17", "-O2", tmp_src,
              os.path.join(ROOT, "native", "src", "tpurpc_client.cc"),
+             os.path.join(ROOT, "native", "src", "tpr_rdv.cc"),
              os.path.join(ROOT, "native", "src", "ring.cc"),
              "-I", os.path.join(ROOT, "native", "include"),
              "-lpthread", "-lrt", "-o", tmp_bin],
@@ -319,7 +321,7 @@ ASYNC_BIN = os.path.join(ROOT, "native", "build", "cpp_async_example")
 
 def _build_async_example():
     _build_cpp(ASYNC_BIN, "cpp_async_client.cc",
-               ["tpurpc_client.cc", "ring.cc"], ["client.h"])
+               ["tpurpc_client.cc", "tpr_rdv.cc", "ring.cc"], ["client.h"])
 
 
 def _async_server():
@@ -426,6 +428,7 @@ int main() {{
         subprocess.run(
             ["g++", "-std=c++17", "-O0", tmp_src,
              os.path.join(ROOT, "native", "src", "tpurpc_client.cc"),
+             os.path.join(ROOT, "native", "src", "tpr_rdv.cc"),
              os.path.join(ROOT, "native", "src", "ring.cc"),
              "-I", os.path.join(ROOT, "native", "include"),
              "-lpthread", "-lrt", "-o", tmp_bin],
@@ -444,7 +447,7 @@ SRV_BIN = os.path.join(ROOT, "native", "build", "cpp_server_example")
 
 
 def _build_server_example():
-    _build_cpp(SRV_BIN, "cpp_server.cc", ["tpurpc_server.cc", "ring.cc"],
+    _build_cpp(SRV_BIN, "cpp_server.cc", ["tpurpc_server.cc", "tpr_rdv.cc", "ring.cc"],
                ["server.h", "server.hpp"])
 
 
@@ -565,17 +568,20 @@ def test_cpp_loop_under_asan():
              "-I", os.path.join(ROOT, "native", "include"), "-lpthread", "-lrt"]
     subprocess.run([gxx, os.path.join(ROOT, "examples", "cpp_server.cc"),
                     os.path.join(ROOT, "native", "src", "tpurpc_server.cc"),
+                    os.path.join(ROOT, "native", "src", "tpr_rdv.cc"),
                     os.path.join(ROOT, "native", "src", "ring.cc"),
                     *flags, "-o", asan_srv],
                    check=True, timeout=180, capture_output=True)
     subprocess.run([gxx, os.path.join(ROOT, "examples", "cpp_client.cc"),
                     os.path.join(ROOT, "native", "src", "tpurpc_client.cc"),
+                    os.path.join(ROOT, "native", "src", "tpr_rdv.cc"),
                     os.path.join(ROOT, "native", "src", "ring.cc"),
                     *flags, "-o", asan_cli],
                    check=True, timeout=180, capture_output=True)
     asan_async = os.path.join(bd, "asan_async_client")
     subprocess.run([gxx, os.path.join(ROOT, "examples", "cpp_async_client.cc"),
                     os.path.join(ROOT, "native", "src", "tpurpc_client.cc"),
+                    os.path.join(ROOT, "native", "src", "tpr_rdv.cc"),
                     os.path.join(ROOT, "native", "src", "ring.cc"),
                     *flags, "-o", asan_async],
                    check=True, timeout=180, capture_output=True)
@@ -632,6 +638,7 @@ def test_bulk_lease_loop_under_asan():
         [gxx, os.path.join(ROOT, "native", "bench", "send_ab.cc"),
          os.path.join(ROOT, "native", "src", "tpurpc_client.cc"),
          os.path.join(ROOT, "native", "src", "tpurpc_server.cc"),
+         os.path.join(ROOT, "native", "src", "tpr_rdv.cc"),
          os.path.join(ROOT, "native", "src", "ring.cc"),
          "-std=c++17", "-O1", "-g", "-fsanitize=address,undefined",
          "-I", os.path.join(ROOT, "native", "include"), "-lpthread", "-lrt",
@@ -700,6 +707,7 @@ def test_python_client_against_cpp_callback_server(tmp_path):
     subprocess.run(
         [gxx, "-std=c++17", "-O1", str(src),
          os.path.join(ROOT, "native", "src", "tpurpc_server.cc"),
+         os.path.join(ROOT, "native", "src", "tpr_rdv.cc"),
          os.path.join(ROOT, "native", "src", "ring.cc"),
          "-I", os.path.join(ROOT, "native", "include"),
          "-lpthread", "-lrt", "-o", str(binp)],
@@ -754,6 +762,7 @@ def test_micro_native_bench_smoke(tmp_path):
          os.path.join(ROOT, "native", "bench", "micro_native.cc"),
          os.path.join(ROOT, "native", "src", "tpurpc_client.cc"),
          os.path.join(ROOT, "native", "src", "tpurpc_server.cc"),
+         os.path.join(ROOT, "native", "src", "tpr_rdv.cc"),
          os.path.join(ROOT, "native", "src", "ring.cc"),
          "-I", os.path.join(ROOT, "native", "include"),
          "-lpthread", "-lrt", "-o", str(binp)],
@@ -839,6 +848,7 @@ def test_cpp_ring_micro_smoke(tmp_path):
          os.path.join(ROOT, "native", "bench", "micro_native.cc"),
          os.path.join(ROOT, "native", "src", "tpurpc_client.cc"),
          os.path.join(ROOT, "native", "src", "tpurpc_server.cc"),
+         os.path.join(ROOT, "native", "src", "tpr_rdv.cc"),
          os.path.join(ROOT, "native", "src", "ring.cc"),
          "-I", os.path.join(ROOT, "native", "include"),
          "-lpthread", "-lrt", "-o", str(binp)],
@@ -868,6 +878,7 @@ def test_native_ring_beats_tcp_small_rpc(tmp_path):
          os.path.join(ROOT, "native", "bench", "micro_native.cc"),
          os.path.join(ROOT, "native", "src", "tpurpc_client.cc"),
          os.path.join(ROOT, "native", "src", "tpurpc_server.cc"),
+         os.path.join(ROOT, "native", "src", "tpr_rdv.cc"),
          os.path.join(ROOT, "native", "src", "ring.cc"),
          "-I", os.path.join(ROOT, "native", "include"),
          "-lpthread", "-lrt", "-o", str(binp)],

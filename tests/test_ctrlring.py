@@ -572,3 +572,97 @@ def test_migrate_burst_one_writev(fresh_config):
             sched.close()       # deregister from /healthz (test isolation)
             state.close()
             state.mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# native planes (tpurpc-ironclad): the C consumer's drain discipline
+# ---------------------------------------------------------------------------
+
+def _native_counters():
+    from tpurpc.rpc import native_client
+
+    return native_client.rdv_counters()
+
+
+@pytest.mark.parametrize("platform", ["RDMA_BP", "RDMA_BPEV"])
+def test_native_steady_state_zero_control_frames(fresh_config, platform):
+    """The acceptance bar on the C planes: after warmup, native bulk moves
+    with ZERO framed control ops — every OFFER/CLAIM/COMPLETE rides the
+    128 B descriptor ring — and (near-)zero CTRL_KICK fd wakeups (parking
+    transitions at stream edges are the only legitimate kicks)."""
+    _reset_platform(fresh_config, platform)
+    if _native_counters() is None:
+        pytest.skip("native data plane unavailable")
+    from tpurpc.rpc.server import Server, stream_stream_rpc_method_handler
+
+    srv = Server(max_workers=4)
+
+    def total(req_iter, ctx):
+        n = 0
+        for m in req_iter:
+            n += len(m)
+        yield str(n).encode()
+
+    srv.add_method("/ctrlnat.S/Total",
+                   stream_stream_rpc_method_handler(total))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    payload = b"\xa5" * (1 << 20)
+    n = 8
+    try:
+        with Channel(f"127.0.0.1:{port}") as ch:
+            mc = ch.stream_stream("/ctrlnat.S/Total")
+            list(mc(iter([payload] * 2), timeout=60))  # warmup: hello+heat
+            c0 = _native_counters()
+            out = list(mc(iter([payload] * n), timeout=120))
+            c1 = _native_counters()
+        assert out[-1] == str(n * len(payload)).encode()
+        assert c1["rdv_sent"] - c0["rdv_sent"] >= n
+        # ZERO control ops fell back to frames...
+        assert c1["ctrl_frames"] == c0["ctrl_frames"]
+        # ...the ring carried them — steady state on a standing grant is
+        # ONE COMPLETE descriptor per message (no OFFER/CLAIM at all)...
+        assert c1["ctrl_posts"] - c0["ctrl_posts"] >= n
+        assert c1["ctrl_records"] - c0["ctrl_records"] >= n
+        # ...and fd kicks happened at most at the stream's cold edges,
+        # never once per message (the wakeup the ring exists to delete)
+        assert c1["ctrl_kicks"] - c0["ctrl_kicks"] <= n // 2
+    finally:
+        srv.stop(grace=1)
+
+
+def test_native_ctrl_disabled_still_rendezvous(fresh_config):
+    """TPURPC_CTRL_RING=0 on the native planes: transfers still ride the
+    rendezvous ladder, control ops go framed — correct, just chattier."""
+    _reset_platform(fresh_config, "RDMA_BP")
+    if _native_counters() is None:
+        pytest.skip("native data plane unavailable")
+    fresh_config.setenv("TPURPC_CTRL_RING", "0")
+    from tpurpc.rpc.server import Server, stream_stream_rpc_method_handler
+
+    srv = Server(max_workers=4)
+
+    def total(req_iter, ctx):
+        n = 0
+        for m in req_iter:
+            n += len(m)
+        yield str(n).encode()
+
+    srv.add_method("/ctrlnat.S/Total2",
+                   stream_stream_rpc_method_handler(total))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    payload = b"\x3c" * (1 << 20)
+    try:
+        with Channel(f"127.0.0.1:{port}") as ch:
+            mc = ch.stream_stream("/ctrlnat.S/Total2")
+            list(mc(iter([b"warm"]), timeout=30))
+            c0 = _native_counters()
+            out = list(mc(iter([payload] * 3), timeout=60))
+            c1 = _native_counters()
+        assert out[-1] == str(3 * len(payload)).encode()
+        assert c1["rdv_sent"] - c0["rdv_sent"] >= 3   # ladder still on
+        assert c1["ctrl_posts"] == c0["ctrl_posts"]   # no ring
+        assert c1["ctrl_frames"] > c0["ctrl_frames"]  # framed control
+    finally:
+        srv.stop(grace=1)

@@ -457,3 +457,195 @@ def test_recv_limit_not_bypassed(fresh_config):
             assert exc.value.code() == StatusCode.RESOURCE_EXHAUSTED
     finally:
         srv.stop(grace=1)
+
+
+# ---------------------------------------------------------------------------
+# cross-plane interop: the native (C) planes speak the same ladder
+# ---------------------------------------------------------------------------
+# tpurpc-ironclad: tpr_rdv.cc mirrors rendezvous.py byte for byte, so every
+# pairing of {python, native} x {client, server} must move bulk payloads
+# over the same OFFER/CLAIM/COMPLETE wire and the same ctrl-ring slots. The
+# native ledger (tpr_rdv_counters) is process-global — both in-process C
+# planes report into it.
+
+def _native_counters():
+    from tpurpc.rpc import native_client
+
+    return native_client.rdv_counters()
+
+
+def _stream_total_server(**kw):
+    from tpurpc.rpc.server import Server, stream_stream_rpc_method_handler
+
+    srv = Server(max_workers=4, **kw)
+
+    def total(req_iter, ctx):
+        n = 0
+        for m in req_iter:
+            n += len(m)
+        yield str(n).encode()
+
+    srv.add_method("/rdvnat.S/Total",
+                   stream_stream_rpc_method_handler(total))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    return srv, port
+
+
+def _require_native():
+    if _native_counters() is None:
+        pytest.skip("native data plane unavailable")
+
+
+@pytest.mark.parametrize("platform", ["RDMA_BP", "RDMA_BPEV"])
+def test_native_both_planes_stream_rendezvous(fresh_config, platform):
+    """native client <-> native server: the stream's bulk payloads ride
+    the C ladder — the native ledger proves zero fallbacks and (near-)zero
+    host landing copies."""
+    _reset_platform(fresh_config, platform)
+    _require_native()
+    from tpurpc.rpc.channel import Channel
+
+    srv, port = _stream_total_server()
+    payload = bytes(range(256)) * 4096  # 1 MiB, patterned
+    n = 4
+    try:
+        with Channel(f"127.0.0.1:{port}") as ch:
+            mc = ch.stream_stream("/rdvnat.S/Total")
+            # a tiny warmup stream settles the capability hello (a big
+            # send racing the hello frames, correctly); snapshot after
+            list(mc(iter([b"warm"]), timeout=30))
+            c0 = _native_counters()
+            out = list(mc(iter([payload] * n), timeout=60))
+        assert out[-1] == str(n * len(payload)).encode()
+        c1 = _native_counters()
+        assert c1["rdv_sent"] - c0["rdv_sent"] >= n
+        assert c1["rdv_recv"] - c0["rdv_recv"] >= n
+        assert c1["rdv_fallback"] == c0["rdv_fallback"]
+        assert (c1["rdv_bytes_sent"] - c0["rdv_bytes_sent"]
+                >= n * len(payload))
+        # the tiny reply is the only framed payload on the negotiated link
+        assert c1["host_copy_bytes"] - c0["host_copy_bytes"] < 64 * 1024
+    finally:
+        srv.stop(grace=1)
+
+
+def test_python_client_native_server_rendezvous(fresh_config):
+    """python client plane -> native server plane: the Python CtrlPeer's
+    offers land in the C Link, and the C server's bulk echo comes back
+    through the Python receiver — both ledgers move."""
+    _reset_platform(fresh_config, "RDMA_BPEV")
+    _require_native()
+    from tpurpc.obs import metrics as _metrics
+    from tpurpc.rpc.channel import Channel
+    from tpurpc.rpc.server import Server, unary_unary_rpc_method_handler
+
+    srv = Server(max_workers=4)  # ring platform: adopts onto the C loop
+    srv.add_method("/rdvnat.S/Echo",
+                   unary_unary_rpc_method_handler(
+                       lambda req, ctx: bytes(req)))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    py_sent0 = _metrics.registry().metrics()["rdv_transfers_sent"].snapshot()
+    try:
+        c0 = _native_counters()
+        with Channel(f"127.0.0.1:{port}") as ch:
+            mc = ch.unary_unary("/rdvnat.S/Echo", tpurpc_native=False)
+            assert bytes(mc(b"tiny", timeout=10)) == b"tiny"  # settle hello
+            big = bytes(range(256)) * (4096 + 3)
+            assert bytes(mc(big, timeout=60)) == big
+        c1 = _native_counters()
+        # the request landed in the C server's pool...
+        assert c1["rdv_recv"] - c0["rdv_recv"] >= 1
+        # ...and the response left through the C sender role
+        assert c1["rdv_sent"] - c0["rdv_sent"] >= 1
+        # the python client's own ledger saw its send
+        assert _metrics.registry().metrics()[
+            "rdv_transfers_sent"].snapshot() >= py_sent0 + 1
+    finally:
+        srv.stop(grace=1)
+
+
+def test_native_client_python_server_rendezvous(fresh_config):
+    """native client plane -> python server plane: the C Link's offers are
+    claimed by rendezvous.py, and the bulk echo comes back the other way."""
+    _reset_platform(fresh_config, "RDMA_BPEV")
+    _require_native()
+    from tpurpc.obs import metrics as _metrics
+    from tpurpc.rpc.channel import Channel
+    from tpurpc.rpc.server import Server, unary_unary_rpc_method_handler
+
+    srv = Server(max_workers=4, native_dataplane=False)  # python loop
+    srv.add_method("/rdvnat.S/Echo",
+                   unary_unary_rpc_method_handler(
+                       lambda req, ctx: bytes(req)))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    py_sent0 = _metrics.registry().metrics()["rdv_transfers_sent"].snapshot()
+    try:
+        c0 = _native_counters()
+        with Channel(f"127.0.0.1:{port}") as ch:
+            mc = ch.unary_unary("/rdvnat.S/Echo")  # native C client plane
+            assert bytes(mc(b"tiny", timeout=10)) == b"tiny"
+            big = bytes(range(256)) * (4096 + 7)
+            assert bytes(mc(big, timeout=60)) == big
+        c1 = _native_counters()
+        assert c1["rdv_sent"] - c0["rdv_sent"] >= 1   # C sender role
+        assert c1["rdv_recv"] - c0["rdv_recv"] >= 1   # C receiver role
+        # the python server's ledger saw its (response) send
+        assert _metrics.registry().metrics()[
+            "rdv_transfers_sent"].snapshot() >= py_sent0 + 1
+    finally:
+        srv.stop(grace=1)
+
+
+def test_native_disabled_rendezvous_stays_framed(fresh_config):
+    """TPURPC_RENDEZVOUS=0: no hello, no Link — un-negotiated native peers
+    move every byte framed, correctly."""
+    _reset_platform(fresh_config, "RDMA_BP")
+    _require_native()
+    fresh_config.setenv("TPURPC_RENDEZVOUS", "0")
+    from tpurpc.rpc.channel import Channel
+
+    srv, port = _stream_total_server()
+    payload = b"q" * (1 << 20)
+    try:
+        c0 = _native_counters()
+        with Channel(f"127.0.0.1:{port}") as ch:
+            mc = ch.stream_stream("/rdvnat.S/Total")
+            out = list(mc(iter([payload] * 3), timeout=60))
+        assert out[-1] == str(3 * len(payload)).encode()
+        c1 = _native_counters()
+        assert c1["rdv_sent"] == c0["rdv_sent"]
+        assert c1["ctrl_posts"] == c0["ctrl_posts"]
+    finally:
+        srv.stop(grace=1)
+
+
+def test_native_pool_exhaustion_falls_back_framed(fresh_config):
+    """A C-side refused claim (budget) degrades the transfer to framed —
+    byte-exact, never an error, never a hang."""
+    _reset_platform(fresh_config, "RDMA_BP")
+    _require_native()
+    # 11 MiB rounds to a 16 MiB landing class: over this 1 MiB budget, and
+    # a class no earlier test leaves in the process-global recycle cache
+    fresh_config.setenv("TPURPC_RENDEZVOUS_POOL_MB", "1")
+    from tpurpc.rpc.channel import Channel
+
+    srv, port = _stream_total_server()
+    payload = b"x" * (11 << 20)
+    try:
+        c0 = _native_counters()
+        with Channel(f"127.0.0.1:{port}") as ch:
+            mc = ch.stream_stream("/rdvnat.S/Total")
+            # warmup settles the capability hello: an un-negotiated first
+            # send frames WITHOUT offering, which is not this test's path
+            list(mc(iter([b"warm"]), timeout=30))
+            out = list(mc(iter([payload]), timeout=120))
+        assert out[-1] == str(len(payload)).encode()
+        c1 = _native_counters()
+        assert (c1["rdv_refused"] > c0["rdv_refused"]
+                or c1["rdv_fallback"] > c0["rdv_fallback"])
+        assert c1["rdv_bytes_sent"] == c0["rdv_bytes_sent"]
+    finally:
+        srv.stop(grace=1)

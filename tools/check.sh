@@ -117,6 +117,18 @@ note "tpurpc-pulse ctrlring smoke (2 processes, zero control frames)"
 TPURPC_FLIGHT_DUMP="$FLIGHT_DUMPS" JAX_PLATFORMS=cpu \
     python -m tpurpc.tools.ctrlring_smoke || fail=1
 
+# 2g1c) tpurpc-ironclad smoke (ISSUE 18): the NATIVE-plane rendezvous —
+#      one 8 MiB tensor native<->native with the C ledger showing the
+#      one-sided write (rdv_bytes_sent >= payload, < 64 KiB host copy,
+#      ZERO framed control ops), a python->native-subprocess transfer
+#      with the ORDERED offer/claim/write/complete flight and a clean
+#      python copy ledger, and an induced frozen C consumer attributed
+#      to the `ctrl-ring` watchdog stage before the framed fallback
+#      completes the call. ~15s, no jax.
+note "tpurpc-ironclad native rdv smoke (C plane, zero-copy ledger)"
+TPURPC_FLIGHT_DUMP="$FLIGHT_DUMPS" JAX_PLATFORMS=cpu \
+    python -m tpurpc.tools.native_rdv_smoke || fail=1
+
 # 2g2) tpurpc-cadence smoke (ISSUE 10): interactive + batch clients
 #      stream off one continuous-batching decode server — per-token order
 #      + exact reference values, a mid-decode join between step events,

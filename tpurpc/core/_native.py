@@ -19,7 +19,7 @@ _LIB: "Optional[ctypes.CDLL]" = None
 _SPIN: "Optional[ctypes.CDLL]" = None
 _TRIED = False
 
-ABI_VERSION = 5
+ABI_VERSION = 6
 
 
 def _lib_path() -> str:
@@ -143,7 +143,25 @@ def load() -> "Optional[ctypes.CDLL]":
         except OSError:
             return None
     if lib.tpr_abi_version() != ABI_VERSION:
-        return None
+        # A stale artifact from an older checkout: rebuild from the sources
+        # on disk instead of silently dropping the native data plane (the
+        # same recovery the dlopen-failure path gets). An explicitly
+        # pointed-at TPURPC_NATIVE_LIB is never deleted or rebuilt.
+        if os.environ.get("TPURPC_NATIVE_LIB"):
+            return None
+        try:
+            os.unlink(path)
+        except OSError:
+            return None
+        _try_build(path)
+        if not os.path.exists(path):
+            return None
+        try:
+            lib = ctypes.PyDLL(path)
+        except OSError:
+            return None
+        if lib.tpr_abi_version() != ABI_VERSION:
+            return None
     u64 = ctypes.c_uint64
     pu64 = ctypes.POINTER(u64)
     pu8 = ctypes.c_void_p

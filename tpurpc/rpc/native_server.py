@@ -43,6 +43,7 @@ import weakref
 from typing import Optional
 
 from tpurpc.obs import tracing as _tracing
+from tpurpc.rpc.native_client import _u8_zc
 from tpurpc.rpc.status import AbortError, StatusCode, deserialize
 from tpurpc.utils.trace import TraceFlag
 
@@ -272,10 +273,10 @@ class NativeDataplane:
                     lib.tpr_srv_set_details(call, exc.details.encode())
                     return int(exc.code.value)
                 raw = _h.response_serializer(resp)
-                if isinstance(raw, (list, tuple)):
-                    raw = b"".join(raw)
-                buf = (ctypes.c_uint8 * len(raw)).from_buffer_copy(raw)
-                lib.tpr_srv_send(call, buf, len(raw))
+                # zero-copy for bytes (tpr_srv_send consumes the buffer
+                # before returning: rdv memcpy or framed ring write inline)
+                buf, blen = _u8_zc(raw)
+                lib.tpr_srv_send(call, buf, blen)
                 return ctx._finish_code()  # 0 unless set_code()
             except Exception as exc:  # handler raised: INTERNAL
                 try:
@@ -316,10 +317,10 @@ class NativeDataplane:
 
                 def send(resp) -> int:
                     raw = _h.response_serializer(resp)
-                    if isinstance(raw, (list, tuple)):
-                        raw = b"".join(raw)
-                    buf = (ctypes.c_uint8 * len(raw)).from_buffer_copy(raw)
-                    return lib.tpr_srv_send(call, buf, len(raw))
+                    # zero-copy for bytes: tpr_srv_send consumes the
+                    # buffer (rdv memcpy or framed write) before returning
+                    buf, blen = _u8_zc(raw)
+                    return lib.tpr_srv_send(call, buf, blen)
 
                 # tpurpc-scope (ISSUE 4): the trace context a sampled
                 # caller shipped through tpr_call_start's metadata — same

@@ -1,0 +1,263 @@
+"""tpurpc-ironclad smoke (ISSUE 18): the NATIVE-plane rendezvous + ctrl
+rings, ledger-proven.
+
+Phase 1 (native <-> native): a default Server (ring adoption onto the C
+loop) and a default Channel (C client plane) move one 8 MiB tensor — the
+process-global C ledger must show the one-sided write
+(``rdv_bytes_sent`` >= payload) with < 64 KiB of framed host-copy bytes,
+zero fallbacks, and ZERO framed control ops (every OFFER/CLAIM/COMPLETE
+rode the descriptor ring).
+
+Phase 2 (python client -> native server, the cross-plane bar): the Python
+sender's copy ledger must show ``rdma_write`` >= payload with < 64 KiB
+host landing copies, and its flight ring the ORDERED
+offer -> claim -> write -> complete evidence; the C server's receiver
+counters must move in step.
+
+Phase 3 (induced stall): TPURPC_TEST_FREEZE_NCTRL freezes the C
+consumer's drain — the python sender's OFFER ages in a ring nobody
+drains, the stall watchdog must name the ``ctrl-ring`` stage, and the
+call must still COMPLETE via the framed fallback once the claim times
+out (the zero-failed-RPC degradation ladder).
+
+Runs everything in one subprocess (GRPC_PLATFORM_TYPE is read at import);
+under TPURPC_FLIGHT_DUMP the flight dump feeds tools/check.sh's protocol
+conformance stage. Exit 0 = all three phases passed.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+PAYLOAD_BYTES = 8 << 20  # the 8 MiB tensor
+
+
+def _native_counters():
+    from tpurpc.rpc import native_client
+
+    return native_client.rdv_counters()
+
+
+def _totaling_server(**kw):
+    from tpurpc.rpc.server import Server, stream_stream_rpc_method_handler
+
+    srv = Server(max_workers=4, **kw)
+
+    def total(req_iter, ctx):
+        n = 0
+        for m in req_iter:
+            n += len(m)
+        yield str(n).encode()
+
+    srv.add_method("/natsmoke.S/Total",
+                   stream_stream_rpc_method_handler(total))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    return srv, port
+
+
+def phase_native_ledger() -> None:
+    """Native client -> native server: one 8 MiB message, C ledger proof."""
+    from tpurpc.rpc.channel import Channel
+
+    srv, port = _totaling_server()  # ring platform: adopts onto the C loop
+    payload = bytes(range(256)) * (PAYLOAD_BYTES // 256)
+    try:
+        with Channel(f"127.0.0.1:{port}") as ch:
+            mc = ch.stream_stream("/natsmoke.S/Total")
+            # warmup settles the capability hello + standing grants; the
+            # first big send legitimately races the hello and frames
+            list(mc(iter([b"warm"]), timeout=30))
+            c0 = _native_counters()
+            assert c0 is not None, "native plane unavailable on this rig"
+            out = list(mc(iter([payload]), timeout=60))
+            assert out[-1] == str(len(payload)).encode(), out
+            c1 = _native_counters()
+        sent = c1["rdv_sent"] - c0["rdv_sent"]
+        wrote = c1["rdv_bytes_sent"] - c0["rdv_bytes_sent"]
+        host = c1["host_copy_bytes"] - c0["host_copy_bytes"]
+        frames = c1["ctrl_frames"] - c0["ctrl_frames"]
+        assert sent >= 1 and c1["rdv_fallback"] == c0["rdv_fallback"], c1
+        assert wrote >= len(payload), (wrote, len(payload))
+        assert host < 64 * 1024, (
+            "host landing copies on the native rendezvous path", host)
+        assert frames == 0, (
+            f"{frames} framed control ops (want 0: ring-borne steady state)")
+        print(f"  [native<->native] 8 MiB one-sided write: "
+              f"rdv_bytes_sent={wrote} host_copy={host} ctrl_frames=0")
+    finally:
+        srv.stop(grace=1)
+
+
+_NATIVE_SERVER = r"""
+import json, sys
+from tpurpc.rpc import native_client
+from tpurpc.rpc.server import Server, stream_stream_rpc_method_handler
+
+srv = Server(max_workers=4)  # ring platform: adopts onto the C loop
+def total(req_iter, ctx):
+    n = 0
+    for m in req_iter:
+        n += len(m)
+    c = native_client.rdv_counters() or {}
+    print("NATCOUNTS", json.dumps(c), flush=True)
+    yield str(n).encode()
+srv.add_method("/natsmoke.S/Total", stream_stream_rpc_method_handler(total))
+port = srv.add_insecure_port("127.0.0.1:0")
+print("PORT", port, flush=True)
+srv.start()
+print("READY", flush=True)
+srv.wait_for_termination(timeout=180)
+"""
+
+
+def phase_cross_plane_flight() -> None:
+    """Python sender -> native server SUBPROCESS (the deployment shape):
+    ordered rdv flight + a clean python copy ledger — the in-process
+    trampoline's handler materialization must not pollute the proof."""
+    from tpurpc.obs import flight
+    from tpurpc.rpc.channel import Channel
+    from tpurpc.tpu import ledger
+
+    flight.RECORDER.reset()
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONPATH"] = here + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen([sys.executable, "-c", _NATIVE_SERVER],
+                            stdout=subprocess.PIPE, text=True, env=env)
+    lines: list = []
+    ready = threading.Event()
+
+    def pump():
+        for line in proc.stdout:
+            lines.append(line)
+            if line.startswith("READY"):
+                ready.set()
+
+    threading.Thread(target=pump, daemon=True).start()
+    payload = bytes(range(256)) * (PAYLOAD_BYTES // 256)
+    try:
+        assert ready.wait(60), "native server subprocess never came up"
+        port = int([ln for ln in lines if ln.startswith("PORT")][0]
+                   .split()[1])
+        import json
+
+        def natcounts():
+            got = [ln for ln in lines if ln.startswith("NATCOUNTS")]
+            return [json.loads(ln.split(" ", 1)[1]) for ln in got]
+
+        with Channel(f"127.0.0.1:{port}") as ch:
+            mc = ch.stream_stream("/natsmoke.S/Total", tpurpc_native=False)
+            list(mc(iter([b"warm"]), timeout=30))
+            with ledger.track() as w:
+                out = list(mc(iter([payload]), timeout=60))
+            assert out[-1] == str(len(payload)).encode(), out
+        deadline = time.monotonic() + 20
+        while len(natcounts()) < 2 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        counts = natcounts()
+        assert len(counts) >= 2 and counts[-1] and (
+            counts[-1]["rdv_recv"] - counts[0]["rdv_recv"] >= 1), (
+            "the C receiver never saw the python sender's transfer", counts)
+        assert w["rdma_write"] >= len(payload), w.delta
+        assert w["host_copy"] < 64 * 1024, (
+            "host landing copies on the cross-plane path", w.delta)
+        evs = [e["event"] for e in flight.snapshot()
+               if e["event"].startswith("rdv-")]
+        order = ("rdv-offer", "rdv-claim", "rdv-write", "rdv-complete")
+        idx = [evs.index(name) for name in order if name in evs]
+        assert len(idx) == len(order), (order, evs)
+        assert idx == sorted(idx), ("rdv flight out of order", evs)
+        print(f"  [python->native x 2 processes] ordered offer/claim/write/"
+              f"complete; rdma_write={w['rdma_write']} "
+              f"host_copy={w['host_copy']}")
+    finally:
+        proc.kill()
+
+
+def phase_frozen_consumer() -> None:
+    """Freeze the C drain: watchdog names ctrl-ring, framed fallback
+    completes the call anyway."""
+    from tpurpc.obs import watchdog
+    from tpurpc.rpc.channel import Channel
+
+    srv, port = _totaling_server()
+    payload = bytes(512) * 4096  # 2 MiB: a class with no standing grant
+    wd = watchdog.get()
+    wd.reset()
+    prev = (wd.min_stall_s, wd.sweep_s)
+    wd.min_stall_s, wd.sweep_s = 0.3, 0.1
+    os.environ["TPURPC_RENDEZVOUS_CLAIM_TIMEOUT_S"] = "3"
+    try:
+        with Channel(f"127.0.0.1:{port}") as ch:
+            mc = ch.stream_stream("/natsmoke.S/Total", tpurpc_native=False)
+            list(mc(iter([b"warm"]), timeout=30))  # hello + ring adoption
+            # the C lib reads this env LIVE in ctrl_drain: every native
+            # consumer goes quiet, posted records age in the ring
+            os.environ["TPURPC_TEST_FREEZE_NCTRL"] = "1"
+            result: dict = {}
+
+            def stalled():
+                result["out"] = list(mc(iter([payload]), timeout=60))
+
+            t = threading.Thread(target=stalled)
+            t.start()
+            diag = None
+            deadline = time.monotonic() + 10
+            while diag is None and time.monotonic() < deadline:
+                time.sleep(0.15)
+                for d in wd.sweep_once():
+                    if d["stage"] == "ctrl-ring":
+                        diag = d
+                        break
+            assert diag is not None, (
+                "watchdog never named the ctrl-ring stage", wd.active())
+            t.join(timeout=60)
+            assert not t.is_alive(), "stalled call never completed"
+            assert result["out"][-1] == str(len(payload)).encode()
+        print(f"  [frozen C consumer] watchdog named '{diag['stage']}' "
+              f"({diag['detail'][:56]}...); framed fallback completed "
+              "the call")
+    finally:
+        os.environ.pop("TPURPC_TEST_FREEZE_NCTRL", None)
+        os.environ.pop("TPURPC_RENDEZVOUS_CLAIM_TIMEOUT_S", None)
+        wd.min_stall_s, wd.sweep_s = prev
+        wd.reset()
+        srv.stop(grace=1)
+
+
+def run_phases() -> None:
+    phase_native_ledger()
+    phase_cross_plane_flight()
+    phase_frozen_consumer()
+
+
+def main() -> int:
+    if "--phase" in sys.argv:
+        run_phases()
+        return 0
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env["GRPC_PLATFORM_TYPE"] = "RDMA_BPEV"
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONPATH"] = here + os.pathsep + env.get("PYTHONPATH", "")
+    rc = subprocess.run(
+        [sys.executable, "-m", "tpurpc.tools.native_rdv_smoke", "--phase"],
+        env=env, timeout=300).returncode
+    if rc != 0:
+        print("native rdv smoke FAILED")
+        return 1
+    print("native rdv smoke: PASS (C-plane one-sided 8 MiB, cross-plane "
+          "ordered flight, ctrl-ring stall attributed)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
