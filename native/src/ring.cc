@@ -113,7 +113,7 @@ uint64_t message_at(const uint8_t* ring, uint64_t cap, uint64_t mask,
 
 extern "C" {
 
-int tpr_abi_version() { return 6; }
+int tpr_abi_version() { return 7; }
 
 // --- waiter-advertisement protocol (the futex-style sleep handshake) --------
 //

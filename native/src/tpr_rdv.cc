@@ -6,6 +6,8 @@
 #include <pthread.h>
 #include <sched.h>
 
+#include "tpr_obs.h"
+
 #include <algorithm>
 #include <cstring>
 #include <random>
@@ -382,7 +384,22 @@ static std::string pack_release(uint64_t lease_id, uint64_t req) {
 // Link
 // ---------------------------------------------------------------------------
 
+// per-process link ordinal: makes every link's flight tags unique, so the
+// per-link protocol machine keys (tag, lease)/(tag, req) never collide
+// across links whose lease/req counters both start at 1
+static std::atomic<uint64_t> g_link_ord{1};
+
 Link::Link(const char *name) : name_(name ? name : "") {
+  if (tpr_obs::enabled()) {
+    uint64_t ord = g_link_ord.fetch_add(1, std::memory_order_relaxed);
+    char tb[44];
+    snprintf(tb, sizeof tb, "nrdv:%s#%llu", name_.c_str(),
+             (unsigned long long)ord);
+    otag_rdv_ = tpr_obs::tag_for(tb);
+    snprintf(tb, sizeof tb, "nctrl:%s#%llu", name_.c_str(),
+             (unsigned long long)ord);
+    otag_ctrl_ = tpr_obs::tag_for(tb);
+  }
   if (!enabled() || !ctrl_enabled()) return;
   // consumer-owned receive ring, advertised in our hello
   uint32_t nslots = ctrl_slots();
@@ -462,6 +479,8 @@ bool Link::maybe_hello(const uint8_t *payload, size_t len) {
   tx_.nslots = nslots;
   tx_.seq = 0;
   ctrl_tx_open_.store(true);
+  obs_adopted_.store(true, std::memory_order_relaxed);
+  TPR_OBS(tpr_obs::kEvCtrlAdopt, otag_ctrl_, nslots, kCtrlSlotBytes);
   return true;
 }
 
@@ -480,9 +499,16 @@ void Link::ctrl_send(uint8_t op, uint32_t sid, const std::string &payload,
             reinterpret_cast<uint64_t *>(b + kConsHeadOff),
             __ATOMIC_ACQUIRE);
         if (tx_.seq - head >= tx_.nslots) {
-          tx_.stalled = true;  // full: degrade framed, never overwrite
+          if (!tx_.stalled) {
+            tx_.stalled = true;  // full: degrade framed, never overwrite
+            TPR_OBS(tpr_obs::kEvCtrlStallBegin, otag_ctrl_,
+                    tx_.seq - head, 0);
+          }
         } else {
-          tx_.stalled = false;
+          if (tx_.stalled) {
+            tx_.stalled = false;
+            TPR_OBS(tpr_obs::kEvCtrlStallEnd, otag_ctrl_, 0, 0);
+          }
           uint8_t *slot = b + kCtrlHdrBytes +
                           (tx_.seq % tx_.nslots) * kCtrlSlotBytes;
           // payload and fields FIRST...
@@ -515,6 +541,7 @@ void Link::ctrl_send(uint8_t op, uint32_t sid, const std::string &payload,
       RDV_DBG("ctrl_send op=%u sid=%u ring r=%d fseq=%llu", op, sid, r,
               (unsigned long long)frames_sent.load());
       count(kCtrCtrlPosts);
+      tpr_obs::metric_add(tpr_obs::kMetCtrlPosts);
       if (r == 2) ctrl_kick();
       return;
     }
@@ -523,11 +550,13 @@ void Link::ctrl_send(uint8_t op, uint32_t sid, const std::string &payload,
   RDV_DBG("ctrl_send op=%u sid=%u FRAMED (tx_open=%d len=%zu)", op, sid,
           (int)ctrl_tx_open_.load(), payload.size());
   count(kCtrCtrlFrames);
+  tpr_obs::metric_add(tpr_obs::kMetCtrlFrames);
   if (send_frame) send_frame((uint8_t)(op + 7), sid, payload);
 }
 
 void Link::ctrl_kick() {
   count(kCtrCtrlKicks);
+  tpr_obs::metric_add(tpr_obs::kMetCtrlKicks);
   if (send_frame) send_frame(12 /* kCtrlKick */, 0, std::string());
 }
 
@@ -567,6 +596,7 @@ int Link::ctrl_drain() {
     on_op(op, sid, payload, ln);
     ++n;
   }
+  uint64_t head_now = rx_.head;
   if (n) {
     // ONE cons_head publish per drained batch (release: our payload
     // reads can't sink past the producer's licence to reuse the slots)
@@ -576,6 +606,8 @@ int Link::ctrl_drain() {
   rx_mu_.unlock();
   if (n) {
     count(kCtrCtrlRecords, (uint64_t)n);
+    tpr_obs::metric_add(tpr_obs::kMetCtrlDrainBatches);
+    tpr_obs::metric_add(tpr_obs::kMetCtrlDrainRecords, (uint64_t)n);
     std::lock_guard<std::mutex> lk(ewma_mu_);
     ewma_ = ewma_ + 0.5 * (1.0 - ewma_);  // _EWMA_HIT
     if (!mode_hot_) {
@@ -583,6 +615,8 @@ int Link::ctrl_drain() {
       uint32_t v = 0;
       __atomic_store_n(reinterpret_cast<uint32_t *>(b + kParkedOff), v,
                        __ATOMIC_RELEASE);
+      if (obs_adopted_.load(std::memory_order_relaxed))
+        TPR_OBS(tpr_obs::kEvCtrlSpin, otag_ctrl_, head_now, 0);
     }
   }
   return n;
@@ -601,9 +635,19 @@ void Link::ctrl_decay() {
 
 void Link::ctrl_park() {
   if (!rx_inited_) return;
+  bool was_hot;
   {
     std::lock_guard<std::mutex> lk(ewma_mu_);
+    was_hot = mode_hot_;
     mode_hot_ = false;
+  }
+  if (was_hot && obs_adopted_.load(std::memory_order_relaxed)) {
+    uint64_t h;
+    {
+      std::lock_guard<std::mutex> lk(rx_mu_);
+      h = rx_.head;
+    }
+    TPR_OBS(tpr_obs::kEvCtrlPark, otag_ctrl_, h, 0);
   }
   uint32_t v = 1;
   __atomic_store_n(reinterpret_cast<uint32_t *>(rx_.shm.base + kParkedOff),
@@ -767,6 +811,9 @@ std::shared_ptr<Claim> Link::rdv_claim(uint32_t sid, size_t total,
   }
   RDV_DBG("rdv_claim OFFER req=%llu total=%zu", (unsigned long long)req,
           total);
+  TPR_OBS(tpr_obs::kEvRdvOffer, otag_rdv_, req, total);
+  tpr_obs::metric_add(tpr_obs::kMetRdvWaits);
+  uint64_t wait_t0 = tpr_obs::now_ns();
   ctrl_send(kOpOffer, sid, pack_offer(req, total));
   auto dl = std::chrono::steady_clock::now() +
             std::chrono::duration_cast<std::chrono::steady_clock::duration>(
@@ -790,13 +837,18 @@ std::shared_ptr<Claim> Link::rdv_claim(uint32_t sid, size_t total,
     state = pr->state;
     claim = pr->claim;
   }
+  tpr_obs::metric_add(tpr_obs::kMetRdvWaitNs,
+                      tpr_obs::now_ns() - wait_t0);
   if (state == 0) {
     RDV_DBG("rdv_claim TIMEOUT req=%llu", (unsigned long long)req);
     // timed out: abandon the offer — a claim crossing this release finds
     // no pending request and is released by on_claim's unknown-req path
+    TPR_OBS(tpr_obs::kEvRdvRelease, otag_rdv_, 0, req);
     ctrl_send(kOpRelease, 0, pack_release(0, req));
     return nullptr;
   }
+  if (state == 1 && claim)
+    TPR_OBS(tpr_obs::kEvRdvClaim, otag_rdv_, req, claim->lease_id);
   return state == 1 ? claim : nullptr;
 }
 
@@ -814,7 +866,15 @@ bool Link::rdv_write(const std::shared_ptr<Claim> &c, const uint8_t *data,
   // receiver advertised, never a recycled name
   if (base != nullptr &&
       memcmp(base + c->offset + c->capacity, c->nonce, kNonceBytes) == 0) {
-    memcpy(base + c->offset, data, total);  // the one-sided placement
+    if (tpr_obs::enabled()) {
+      uint64_t t0 = tpr_obs::now_ns();
+      memcpy(base + c->offset, data, total);  // the one-sided placement
+      tpr_obs::metric_add(tpr_obs::kMetRdvSendBusyNs,
+                          tpr_obs::now_ns() - t0);
+      tpr_obs::metric_add(tpr_obs::kMetRdvSendBytes, total);
+    } else {
+      memcpy(base + c->offset, data, total);  // the one-sided placement
+    }
     count(kCtrRdvBytesSent, total);
     ok = true;
   }
@@ -829,12 +889,20 @@ void Link::rdv_complete(const std::shared_ptr<Claim> &c, uint32_t sid,
     c->used++;
     c->inflight = false;
   }
+  if (!c->standing) {
+    // solicited transfers are edges worth recording; standing-region
+    // reuse is steady-state traffic and stays silent (the flight
+    // recorder's edges-not-traffic contract — rendezvous.py's rule)
+    TPR_OBS(tpr_obs::kEvRdvWrite, otag_rdv_, c->lease_id, total);
+    TPR_OBS(tpr_obs::kEvRdvComplete, otag_rdv_, c->lease_id, total);
+  }
   // shm windows are synchronous (the memcpy returned ⇒ bytes visible), so
   // the COMPLETE may ride the ring
   ctrl_send(kOpComplete, sid, pack_complete(c->lease_id, total, flags));
 }
 
 void Link::rdv_release(const std::shared_ptr<Claim> &c) {
+  TPR_OBS(tpr_obs::kEvRdvRelease, otag_rdv_, c->lease_id, 0);
   ctrl_send(kOpRelease, 0, pack_release(c->lease_id, 0));
 }
 
@@ -858,12 +926,16 @@ bool Link::send_message(uint32_t sid, uint8_t flags, const uint8_t *data,
   if (!claim) claim = rdv_claim(sid, total, cls);
   if (!claim) {
     count(kCtrRdvFallback);
+    tpr_obs::metric_add(tpr_obs::kMetRdvFallbacks);
+    TPR_OBS(tpr_obs::kEvRdvFallback, otag_rdv_, total, 0);
     return false;
   }
   if (!rdv_write(claim, data, total)) {
     drop_grant(claim);
     rdv_release(claim);
     count(kCtrRdvFallback);
+    tpr_obs::metric_add(tpr_obs::kMetRdvFallbacks);
+    TPR_OBS(tpr_obs::kEvRdvFallback, otag_rdv_, total, 1);
     return false;
   }
   rdv_complete(claim, sid, flags, total);
@@ -877,6 +949,7 @@ void Link::on_offer(uint32_t sid, const uint8_t *p, size_t len) {
   if (len < 16) return;
   uint64_t req = rd_u64(p);
   uint64_t nbytes = rd_u64(p + 8);
+  TPR_OBS(tpr_obs::kEvRdvOffer, otag_rdv_, req, nbytes);
   std::string kinds(reinterpret_cast<const char *>(p + 16), len - 16);
   bool shm_ok = false;
   size_t pos = 0;
@@ -915,6 +988,7 @@ void Link::on_offer(uint32_t sid, const uint8_t *p, size_t len) {
   RDV_DBG("on_offer req=%llu -> CLAIM lease=%llu cls=%llu standing=%d",
           (unsigned long long)req, (unsigned long long)lease->id,
           (unsigned long long)lease->cls, (int)lease->standing);
+  TPR_OBS(tpr_obs::kEvRdvClaim, otag_rdv_, req, lease->id);
   ctrl_send(kOpClaim, sid, pack_claim(req, *lease));
 }
 
@@ -1028,9 +1102,19 @@ void Link::on_complete(uint32_t sid, const uint8_t *p, size_t len) {
   }
   count(kCtrRdvRecv);
   count(kCtrRdvBytesRecv, nbytes);
+  if (!lease->pregrant)
+    TPR_OBS(tpr_obs::kEvRdvComplete, otag_rdv_, lease_id, nbytes);
   uint64_t cls = lease->cls;
   if (deliver) {
-    deliver(sid, flags, base, (size_t)nbytes);
+    if (tpr_obs::enabled()) {
+      uint64_t t0 = tpr_obs::now_ns();
+      deliver(sid, flags, base, (size_t)nbytes);
+      tpr_obs::metric_add(tpr_obs::kMetRdvRecvBusyNs,
+                          tpr_obs::now_ns() - t0);
+      tpr_obs::metric_add(tpr_obs::kMetRdvRecvBytes, nbytes);
+    } else {
+      deliver(sid, flags, base, (size_t)nbytes);
+    }
   } else {
     settle(base);  // no consumer wired: drop, ring the doorbell
   }
@@ -1092,7 +1176,10 @@ void Link::on_release(const uint8_t *p, size_t len) {
       }
     }
   }
-  if (lease) lease->release(false);
+  if (lease) {
+    TPR_OBS(tpr_obs::kEvRdvRelease, otag_rdv_, lease_id, req);
+    lease->release(false);
+  }
 }
 
 // -- lifecycle ---------------------------------------------------------------
@@ -1116,14 +1203,26 @@ void Link::close() {
   for (auto &lease : leases) {
     // DISCARD, don't pool: the peer (or a straggling sender on this
     // dying connection) may still hold a window and land a late write —
-    // it must hit orphaned memory, never a re-leased region
+    // it must hit orphaned memory, never a re-leased region; teardown is
+    // an EDGE (once per connection death), so every claimed region's
+    // release is recorded — standing grants included
+    TPR_OBS(tpr_obs::kEvRdvRelease, otag_rdv_, lease->id, 0);
     lease->release(true);
   }
   // Straggling senders may still be inside rdv_write's memcpy with a raw
   // window pointer (pinned): wait for every pin to drain before the
   // munmap. Bounded — a pin only spans a memcpy or one doorbell load.
-  while (window_pins_.load(std::memory_order_seq_cst) != 0)
-    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  int pins = window_pins_.load(std::memory_order_seq_cst);
+  if (pins != 0) {
+    uint64_t t0 = tpr_obs::now_ns();
+    TPR_OBS(tpr_obs::kEvPinWaitBegin, otag_rdv_, pins, 0);
+    tpr_obs::metric_add(tpr_obs::kMetPinWaits);
+    while (window_pins_.load(std::memory_order_seq_cst) != 0)
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    uint64_t waited = tpr_obs::now_ns() - t0;
+    tpr_obs::metric_add(tpr_obs::kMetPinWaitNs, waited);
+    TPR_OBS(tpr_obs::kEvPinWaitEnd, otag_rdv_, waited, 0);
+  }
   for (auto &w : wins) w.close();
   {
     std::lock_guard<std::mutex> lk(tx_mu_);

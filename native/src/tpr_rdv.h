@@ -234,6 +234,11 @@ class Link {
   mutable std::mutex mu_;
   std::condition_variable cv_;
   std::atomic<bool> closed_{false};
+  // tpurpc-xray flight tags, interned ONCE at link construction (the
+  // tpr-obs static-tag discipline); obs_adopted_ gates spin/park/stall
+  // emission so the ctrl-ring machine never sees a flip before ADOPT
+  uint16_t otag_rdv_ = 0, otag_ctrl_ = 0;
+  std::atomic<bool> obs_adopted_{false};
   std::atomic<unsigned long> dispatch_tid_{0};
   std::atomic<int> window_pins_{0};  // senders inside a window deref
 

@@ -13,6 +13,7 @@
 
 #include "framing_common.h"
 #include "ring_transport.h"
+#include "tpr_obs.h"
 #include "tpr_rdv.h"
 
 #include <arpa/inet.h>
@@ -204,8 +205,16 @@ struct tpr_channel {
   // rendezvous + ctrl-ring side of this channel (tpr_rdv.h); armed only if
   // the peer's hello PING negotiates the ladder
   tpr_rdv::Link *link = nullptr;
+  // tpurpc-xray conn-lifecycle flight tag (interned once at create);
+  // dead_emitted keeps the death edge an EDGE across die()/destructor
+  uint16_t otag_conn = 0;
+  std::atomic<bool> dead_emitted{false};
 
   ~tpr_channel() {
+    if (otag_conn && !dead_emitted.exchange(true)) {
+      TPR_OBS(tpr_obs::kEvConnDead, otag_conn, 1, 0);  // graceful teardown
+      tpr_obs::metric_add(tpr_obs::kMetConnDown);
+    }
     alive.store(false);
     if (link) link->close();  // wake claim waiters before the reader join
     if (ring) ring->shutdown();
@@ -246,6 +255,10 @@ struct tpr_channel {
   }
 
   void die() {
+    if (otag_conn && !dead_emitted.exchange(true)) {
+      TPR_OBS(tpr_obs::kEvConnDead, otag_conn, 0, 0);
+      tpr_obs::metric_add(tpr_obs::kMetConnDown);
+    }
     if (link) link->close();  // fail rdv waiters; quarantine leases
     CqDeliveries evs;
     {
@@ -739,6 +752,16 @@ tpr_channel *tpr_channel_create2(const char *host, int port, int timeout_ms,
   }
   if (!ch->inline_read)
     ch->reader = std::thread([ch] { ch->read_loop(); });
+  if (tpr_obs::enabled()) {
+    static std::atomic<uint64_t> conn_ord{1};
+    char tb[44];
+    snprintf(tb, sizeof tb, "nconn:cli#%llu",
+             (unsigned long long)conn_ord.fetch_add(
+                 1, std::memory_order_relaxed));
+    ch->otag_conn = tpr_obs::tag_for(tb);
+    TPR_OBS(tpr_obs::kEvConnConnect, ch->otag_conn, 0, 0);
+    tpr_obs::metric_add(tpr_obs::kMetConnUp);
+  }
   return ch;
 }
 

@@ -48,6 +48,7 @@
 #include <vector>
 
 #include "framing_common.h"
+#include "tpr_obs.h"
 #include "tpr_rdv.h"
 
 using namespace tpr_wire;
@@ -201,6 +202,9 @@ struct Conn {
   // rendezvous + ctrl-ring side of this connection (tpr_rdv.h); created at
   // bootstrap, armed only if the peer's hello negotiates
   tpr_rdv::Link *link = nullptr;
+  // tpurpc-xray conn tag, interned once when bootstrap succeeds (the
+  // tpr-obs static-tag discipline); 0 = plane off or never bootstrapped
+  uint16_t otag_conn = 0;
   // delivery-shard items in flight for this conn: reap must wait for zero
   // (an item holds a raw Conn*)
   std::atomic<int> delivery_refs{0};
@@ -405,6 +409,13 @@ struct tpr_server {
   std::deque<DeliveryItem> dq;
   std::atomic<bool> delivery_on{false};
   bool dq_stop = false;
+  // tpurpc-xray delivery-shard backlog tracking (both under dq_mu): the
+  // stall edge fires on a high-water crossing, clears below low water, so
+  // a busy-but-draining queue emits nothing
+  uint16_t otag_dlv = 0;
+  bool dlv_stalled = false;
+  static constexpr size_t kDlvHighWater = 64;
+  static constexpr size_t kDlvLowWater = 8;
 
   static bool delivery_from_env() {
     const char *v = getenv("TPURPC_NATIVE_DELIVERY");
@@ -430,6 +441,14 @@ struct tpr_server {
     {
       std::lock_guard<std::mutex> lk(dq_mu);
       dq.push_back(DeliveryItem{c, sid, flags, data, len, rdv, rst});
+      size_t depth = dq.size();
+      tpr_obs::metric_add(tpr_obs::kMetDlvEnqueued);
+      tpr_obs::metric_store(tpr_obs::kMetDlvDepth, depth);
+      if (depth >= kDlvHighWater && !dlv_stalled && otag_dlv) {
+        dlv_stalled = true;
+        tpr_obs::metric_add(tpr_obs::kMetDlvStalls);
+        TPR_OBS(tpr_obs::kEvDlvStallBegin, otag_dlv, depth, 0);
+      }
     }
     dq_cv.notify_one();
   }
@@ -500,9 +519,16 @@ struct tpr_server {
         if (dq.empty()) return;  // stop requested and fully drained
         item = dq.front();
         dq.pop_front();
+        size_t depth = dq.size();
+        tpr_obs::metric_store(tpr_obs::kMetDlvDepth, depth);
+        if (dlv_stalled && depth <= kDlvLowWater) {
+          dlv_stalled = false;
+          TPR_OBS(tpr_obs::kEvDlvStallEnd, otag_dlv, depth, 0);
+        }
       }
       deliver_msg(item.c, item.sid, item.flags, item.data, item.len,
                   item.rdv, item.rst);
+      tpr_obs::metric_add(tpr_obs::kMetDlvDrained);
       item.c->delivery_refs.fetch_sub(1);
     }
   }
@@ -837,6 +863,10 @@ struct tpr_server {
   // handlers. The Conn itself is freed by reap once handler threads drain.
   void finish_conn(Conn *c) {
     if (c->finished.exchange(true)) return;
+    if (c->otag_conn) {  // the exchange above makes this once-only
+      TPR_OBS(tpr_obs::kEvConnDead, c->otag_conn, 0, 0);
+      tpr_obs::metric_add(tpr_obs::kMetConnDown);
+    }
     // discard-quarantine claimed regions, wake claim waiters (handler
     // threads blocked in a rendezvous claim exit via the framed-fallback
     // path, whose send then fails cleanly on the closed fd)
@@ -904,6 +934,15 @@ struct tpr_server {
           deliver_msg(c, sid, flags, data, len, /*rdv=*/true, false);
       };
       c->link->wake = [c] { c->cv.notify_all(); };
+      if (tpr_obs::enabled()) {
+        static std::atomic<uint64_t> g_conn_ord{1};
+        char tb[44];
+        snprintf(tb, sizeof tb, "nconn:srv#%llu",
+                 (unsigned long long)g_conn_ord.fetch_add(1));
+        c->otag_conn = tpr_obs::tag_for(tb);
+        TPR_OBS(tpr_obs::kEvConnConnect, c->otag_conn, 0, 0);
+        tpr_obs::metric_add(tpr_obs::kMetConnUp);
+      }
       std::string hello = c->link->hello_payload();
       c->send_frame(kPing, 0, 0, hello.data(), hello.size());
       Poller *p = pollers[next_poller.fetch_add(1) % pollers.size()];
@@ -1156,8 +1195,10 @@ int tpr_server_start(tpr_server *s) {
     s->pollers.push_back(p);
   }
   s->delivery_on.store(tpr_server::delivery_from_env());
-  if (s->delivery_on.load())
+  if (s->delivery_on.load()) {
+    if (tpr_obs::enabled()) s->otag_dlv = tpr_obs::tag_for("ndlv:srv");
     s->delivery_th = std::thread([s] { s->delivery_loop(); });
+  }
   s->accept_thread = std::thread([s] { s->accept_loop(); });
   return 0;
 }

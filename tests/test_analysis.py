@@ -1591,3 +1591,104 @@ def test_xproc_modules_are_clean():
             vs = lint_source(f.read(), mod.__file__)
         assert [v for v in vs if v.rule == "xproc"] == [], (
             mod.__name__, list(map(str, vs)))
+
+
+# ---------------------------------------------------------------------------
+# lint: tpr-obs — the C emission macro's discipline (tpurpc-xray, ISSUE 19)
+# ---------------------------------------------------------------------------
+
+from tpurpc.analysis.lint import lint_native_source, lint_native_tree
+
+TPROBS_OK = '''
+void Link::rdv_release(const std::shared_ptr<Claim> &c) {
+  TPR_OBS(tpr_obs::kEvRdvRelease, otag_rdv_, c->lease_id, 0);
+  TPR_OBS(tpr_obs::kEvCtrlStallBegin, otag_ctrl_,
+          tx_.seq - head, 0);
+}
+'''
+
+TPROBS_DYNAMIC_CODE = '''
+void f(uint16_t code) {
+  TPR_OBS(code, otag_rdv_, 1, 0);
+}
+'''
+
+TPROBS_TAG_FOR = '''
+void f() {
+  TPR_OBS(tpr_obs::kEvRdvOffer, tpr_obs::tag_for("nrdv:x"), req, total);
+}
+'''
+
+TPROBS_STRING_ARG = '''
+void f() {
+  TPR_OBS(tpr_obs::kEvRdvOffer, otag_rdv_, 'x', 0);
+}
+'''
+
+TPROBS_CALL_ARG = '''
+void f() {
+  TPR_OBS(tpr_obs::kEvRdvOffer, otag_rdv_, payload.size(), 0);
+}
+'''
+
+TPROBS_RAW_EMIT = '''
+void f() {
+  tpr_obs::emit(tpr_obs::kEvRdvOffer, otag_rdv_, 1, 0);
+}
+'''
+
+
+def _nrules(vs):
+    return sorted(v.rule for v in vs)
+
+
+def test_tprobs_clean_site_passes():
+    assert lint_native_source(TPROBS_OK, "native/src/tpr_rdv.cc") == []
+
+
+def test_tprobs_dynamic_event_code_flagged():
+    vs = lint_native_source(TPROBS_DYNAMIC_CODE, "native/src/tpr_rdv.cc")
+    assert _nrules(vs) == ["tpr-obs"] and "kEv*" in vs[0].message
+
+
+def test_tprobs_tag_for_in_args_flagged():
+    vs = lint_native_source(TPROBS_TAG_FOR, "native/src/tpr_rdv.cc")
+    assert any("interns per event" in v.message for v in vs)
+
+
+def test_tprobs_string_literal_flagged():
+    vs = lint_native_source(TPROBS_STRING_ARG, "native/src/tpr_rdv.cc")
+    assert any("string/char literal" in v.message for v in vs)
+
+
+def test_tprobs_per_event_call_flagged():
+    vs = lint_native_source(TPROBS_CALL_ARG, "native/src/tpr_rdv.cc")
+    assert _nrules(vs) == ["tpr-obs"] and "per event" in vs[0].message
+
+
+def test_tprobs_raw_emit_outside_plane_flagged():
+    vs = lint_native_source(TPROBS_RAW_EMIT, "native/src/tpr_rdv.cc")
+    assert _nrules(vs) == ["tpr-obs"] and "enabled() guard" in vs[0].message
+
+
+def test_tprobs_raw_emit_inside_plane_exempt():
+    assert lint_native_source(TPROBS_RAW_EMIT, "native/src/tpr_obs.cc") == []
+
+
+def test_tprobs_macro_definition_exempt():
+    src = "#define TPR_OBS(code, tag, a1, a2) tpr_obs::emit(code, tag)\n"
+    assert lint_native_source(src, "native/src/tpr_obs.h") == []
+
+
+def test_tprobs_suppression_comment():
+    ok = TPROBS_CALL_ARG.replace(
+        "payload.size(), 0);",
+        "payload.size(), 0);  // tpr: allow(tpr-obs)")
+    assert lint_native_source(ok, "native/src/tpr_rdv.cc") == []
+
+
+def test_tprobs_native_tree_is_clean():
+    """Every real TPR_OBS site in native/src keeps the static-tag pure-int
+    discipline — the same bar the `flight` rule holds the Python plane to."""
+    vs = lint_native_tree()
+    assert vs == [], list(map(str, vs))

@@ -176,6 +176,7 @@ def cpp_build(tmp_path_factory):
          str(ROOT / "native" / "src" / "tpurpc_client.cc"),
          str(ROOT / "native" / "src" / "tpurpc_server.cc"),
          str(ROOT / "native" / "src" / "tpr_rdv.cc"),
+         str(ROOT / "native" / "src" / "tpr_obs.cc"),
          str(ROOT / "native" / "src" / "ring.cc"),
          "-I", str(out), "-I", str(ROOT / "native" / "include"),
          *pb_flags, "-lpthread", "-lrt", "-o", str(binp)],

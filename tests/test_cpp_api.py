@@ -34,7 +34,7 @@ def _build_cpp(out_bin, example, native_src, headers):
     srcs = [os.path.join(ROOT, "examples", example)] + [
         os.path.join(ROOT, "native", "src", ns) for ns in native_srcs]
     deps = srcs + [os.path.join(ROOT, "native", "src", h) for h in
-                   ("framing_common.h", "ring_transport.h")] + [
+                   ("framing_common.h", "ring_transport.h", "tpr_obs.h")] + [
         os.path.join(ROOT, "native", "include", "tpurpc", h) for h in headers]
     if (os.path.exists(out_bin)
             and all(os.path.getmtime(out_bin) > os.path.getmtime(d)
@@ -48,7 +48,7 @@ def _build_cpp(out_bin, example, native_src, headers):
 
 
 def _build_example():
-    _build_cpp(BIN, "cpp_client.cc", ["tpurpc_client.cc", "tpr_rdv.cc", "ring.cc"],
+    _build_cpp(BIN, "cpp_client.cc", ["tpurpc_client.cc", "tpr_rdv.cc", "tpr_obs.cc", "ring.cc"],
                ["client.h", "client.hpp"])
 
 
@@ -125,7 +125,7 @@ def test_cpp_send_lease_ring(monkeypatch):
     monkeypatch.setenv("GRPC_PLATFORM_TYPE", "RDMA_BPEV")
     lease_bin = os.path.join(ROOT, "native", "build", "cpp_send_lease")
     _build_cpp(lease_bin, "cpp_send_lease.cc",
-               ["tpurpc_client.cc", "tpr_rdv.cc", "ring.cc"], ["client.h"])
+               ["tpurpc_client.cc", "tpr_rdv.cc", "tpr_obs.cc", "ring.cc"], ["client.h"])
 
     def check(req_iter, ctx):
         for m in req_iter:
@@ -180,6 +180,7 @@ int main() {{
             ["g++", "-std=c++17", "-O0", tmp_src,
              os.path.join(ROOT, "native", "src", "tpurpc_client.cc"),
              os.path.join(ROOT, "native", "src", "tpr_rdv.cc"),
+             os.path.join(ROOT, "native", "src", "tpr_obs.cc"),
              os.path.join(ROOT, "native", "src", "ring.cc"),
              "-I", os.path.join(ROOT, "native", "include"),
              "-lpthread", "-lrt", "-o", tmp_bin],
@@ -271,6 +272,7 @@ int main(int argc, char **argv) {
             ["g++", "-std=c++17", "-O2", tmp_src,
              os.path.join(ROOT, "native", "src", "tpurpc_client.cc"),
              os.path.join(ROOT, "native", "src", "tpr_rdv.cc"),
+             os.path.join(ROOT, "native", "src", "tpr_obs.cc"),
              os.path.join(ROOT, "native", "src", "ring.cc"),
              "-I", os.path.join(ROOT, "native", "include"),
              "-lpthread", "-lrt", "-o", tmp_bin],
@@ -321,7 +323,7 @@ ASYNC_BIN = os.path.join(ROOT, "native", "build", "cpp_async_example")
 
 def _build_async_example():
     _build_cpp(ASYNC_BIN, "cpp_async_client.cc",
-               ["tpurpc_client.cc", "tpr_rdv.cc", "ring.cc"], ["client.h"])
+               ["tpurpc_client.cc", "tpr_rdv.cc", "tpr_obs.cc", "ring.cc"], ["client.h"])
 
 
 def _async_server():
@@ -429,6 +431,7 @@ int main() {{
             ["g++", "-std=c++17", "-O0", tmp_src,
              os.path.join(ROOT, "native", "src", "tpurpc_client.cc"),
              os.path.join(ROOT, "native", "src", "tpr_rdv.cc"),
+             os.path.join(ROOT, "native", "src", "tpr_obs.cc"),
              os.path.join(ROOT, "native", "src", "ring.cc"),
              "-I", os.path.join(ROOT, "native", "include"),
              "-lpthread", "-lrt", "-o", tmp_bin],
@@ -447,7 +450,7 @@ SRV_BIN = os.path.join(ROOT, "native", "build", "cpp_server_example")
 
 
 def _build_server_example():
-    _build_cpp(SRV_BIN, "cpp_server.cc", ["tpurpc_server.cc", "tpr_rdv.cc", "ring.cc"],
+    _build_cpp(SRV_BIN, "cpp_server.cc", ["tpurpc_server.cc", "tpr_rdv.cc", "tpr_obs.cc", "ring.cc"],
                ["server.h", "server.hpp"])
 
 
@@ -569,12 +572,14 @@ def test_cpp_loop_under_asan():
     subprocess.run([gxx, os.path.join(ROOT, "examples", "cpp_server.cc"),
                     os.path.join(ROOT, "native", "src", "tpurpc_server.cc"),
                     os.path.join(ROOT, "native", "src", "tpr_rdv.cc"),
+                    os.path.join(ROOT, "native", "src", "tpr_obs.cc"),
                     os.path.join(ROOT, "native", "src", "ring.cc"),
                     *flags, "-o", asan_srv],
                    check=True, timeout=180, capture_output=True)
     subprocess.run([gxx, os.path.join(ROOT, "examples", "cpp_client.cc"),
                     os.path.join(ROOT, "native", "src", "tpurpc_client.cc"),
                     os.path.join(ROOT, "native", "src", "tpr_rdv.cc"),
+                    os.path.join(ROOT, "native", "src", "tpr_obs.cc"),
                     os.path.join(ROOT, "native", "src", "ring.cc"),
                     *flags, "-o", asan_cli],
                    check=True, timeout=180, capture_output=True)
@@ -582,6 +587,7 @@ def test_cpp_loop_under_asan():
     subprocess.run([gxx, os.path.join(ROOT, "examples", "cpp_async_client.cc"),
                     os.path.join(ROOT, "native", "src", "tpurpc_client.cc"),
                     os.path.join(ROOT, "native", "src", "tpr_rdv.cc"),
+                    os.path.join(ROOT, "native", "src", "tpr_obs.cc"),
                     os.path.join(ROOT, "native", "src", "ring.cc"),
                     *flags, "-o", asan_async],
                    check=True, timeout=180, capture_output=True)
@@ -639,6 +645,7 @@ def test_bulk_lease_loop_under_asan():
          os.path.join(ROOT, "native", "src", "tpurpc_client.cc"),
          os.path.join(ROOT, "native", "src", "tpurpc_server.cc"),
          os.path.join(ROOT, "native", "src", "tpr_rdv.cc"),
+         os.path.join(ROOT, "native", "src", "tpr_obs.cc"),
          os.path.join(ROOT, "native", "src", "ring.cc"),
          "-std=c++17", "-O1", "-g", "-fsanitize=address,undefined",
          "-I", os.path.join(ROOT, "native", "include"), "-lpthread", "-lrt",
@@ -708,6 +715,7 @@ def test_python_client_against_cpp_callback_server(tmp_path):
         [gxx, "-std=c++17", "-O1", str(src),
          os.path.join(ROOT, "native", "src", "tpurpc_server.cc"),
          os.path.join(ROOT, "native", "src", "tpr_rdv.cc"),
+         os.path.join(ROOT, "native", "src", "tpr_obs.cc"),
          os.path.join(ROOT, "native", "src", "ring.cc"),
          "-I", os.path.join(ROOT, "native", "include"),
          "-lpthread", "-lrt", "-o", str(binp)],
@@ -763,6 +771,7 @@ def test_micro_native_bench_smoke(tmp_path):
          os.path.join(ROOT, "native", "src", "tpurpc_client.cc"),
          os.path.join(ROOT, "native", "src", "tpurpc_server.cc"),
          os.path.join(ROOT, "native", "src", "tpr_rdv.cc"),
+         os.path.join(ROOT, "native", "src", "tpr_obs.cc"),
          os.path.join(ROOT, "native", "src", "ring.cc"),
          "-I", os.path.join(ROOT, "native", "include"),
          "-lpthread", "-lrt", "-o", str(binp)],
@@ -849,6 +858,7 @@ def test_cpp_ring_micro_smoke(tmp_path):
          os.path.join(ROOT, "native", "src", "tpurpc_client.cc"),
          os.path.join(ROOT, "native", "src", "tpurpc_server.cc"),
          os.path.join(ROOT, "native", "src", "tpr_rdv.cc"),
+         os.path.join(ROOT, "native", "src", "tpr_obs.cc"),
          os.path.join(ROOT, "native", "src", "ring.cc"),
          "-I", os.path.join(ROOT, "native", "include"),
          "-lpthread", "-lrt", "-o", str(binp)],
@@ -879,6 +889,7 @@ def test_native_ring_beats_tcp_small_rpc(tmp_path):
          os.path.join(ROOT, "native", "src", "tpurpc_client.cc"),
          os.path.join(ROOT, "native", "src", "tpurpc_server.cc"),
          os.path.join(ROOT, "native", "src", "tpr_rdv.cc"),
+         os.path.join(ROOT, "native", "src", "tpr_obs.cc"),
          os.path.join(ROOT, "native", "src", "ring.cc"),
          "-I", os.path.join(ROOT, "native", "include"),
          "-lpthread", "-lrt", "-o", str(binp)],

@@ -293,6 +293,7 @@ def test_native_vs_python_latency(tmp_path):
         [gxx, "-std=c++17", "-O2", str(src),
          os.path.join(root, "native", "src", "tpurpc_server.cc"),
          os.path.join(root, "native", "src", "tpr_rdv.cc"),
+         os.path.join(root, "native", "src", "tpr_obs.cc"),
          os.path.join(root, "native", "src", "ring.cc"),
          "-I", os.path.join(root, "native", "include"),
          "-lpthread", "-lrt", "-o", str(binp)],

@@ -1,0 +1,264 @@
+"""tpurpc-xray: the Python face of the C observability plane (ISSUE 19).
+
+The merged-flight contract (``tpurpc/obs/native_obs.py`` + the
+``flight.snapshot`` merge): the C core's shm flight ring and metrics
+table surface through the SAME consumers the Python plane feeds —
+one monotonic timeline with lane tags, protocol conformance over the
+merged stream, ``native_*`` registry series into the tsdb, postfork
+remapping in forked shard workers, and a clean off switch that leaves
+the PR 18 ``tpr_rdv_counters`` ledger ABI untouched.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import tpurpc.rpc as rpc
+from tpurpc.rpc.channel import Channel
+
+from tests.conftest import requires_native_lib  # noqa: E402
+
+pytestmark = requires_native_lib
+
+PY_PAYLOAD = bytes(512) * 4096  # 2 MiB: over the py-plane rdv floor
+NATIVE_PAYLOAD = bytes(range(256)) * 4096  # 1 MiB on the C plane
+
+
+@pytest.fixture
+def ring_platform(monkeypatch):
+    monkeypatch.setenv("GRPC_PLATFORM_TYPE", "RDMA_BPEV")
+    from tpurpc.utils import config as config_mod
+
+    config_mod.set_config(None)
+    yield
+    config_mod.set_config(None)
+
+
+@pytest.fixture
+def obs_plane(ring_platform):
+    """Fresh C + py flight state; skips when the .so was built with the
+    plane compiled out or disabled in this environment."""
+    from tpurpc.obs import flight, native_obs
+
+    if not native_obs.available():
+        pytest.skip("native obs plane not available in this process")
+    flight.RECORDER.reset()
+    native_obs.reset()
+    yield native_obs
+    flight.RECORDER.reset()
+
+
+def _totaling_server():
+    srv = rpc.Server(max_workers=4)
+
+    def total(req_iter, ctx):
+        n = 0
+        for m in req_iter:
+            n += len(m)
+        yield str(n).encode()
+
+    srv.add_method("/nobs.S/Total",
+                   rpc.stream_stream_rpc_method_handler(total))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    return srv, port
+
+
+def _cross_plane_exchange():
+    """One native-plane leg and one py-plane leg on the same wire, so the
+    merged flight carries BOTH lanes."""
+    srv, port = _totaling_server()
+    try:
+        assert srv._native_dp is not None, "server adoption did not engage"
+        with Channel(f"127.0.0.1:{port}") as ch:
+            mc = ch.stream_stream("/nobs.S/Total")
+            list(mc(iter([b"warm"]), timeout=30))
+            out = list(mc(iter([NATIVE_PAYLOAD]), timeout=60))
+            assert out[-1] == str(len(NATIVE_PAYLOAD)).encode(), out
+            mc_py = ch.stream_stream("/nobs.S/Total", tpurpc_native=False)
+            out = list(mc_py(iter([PY_PAYLOAD]), timeout=60))
+            assert out[-1] == str(len(PY_PAYLOAD)).encode(), out
+    finally:
+        srv.stop(grace=1)
+
+
+def test_merged_snapshot_two_lanes_one_timeline(obs_plane):
+    """Cross-plane calls produce ONE time-ordered flight view: C records
+    lane-tagged ``native`` on n* entities, py records tagged ``py``,
+    interleaved on the shared CLOCK_MONOTONIC axis."""
+    from tpurpc.obs import flight
+
+    _cross_plane_exchange()
+    snap = flight.snapshot()
+    stamps = [e["t_ns"] for e in snap]
+    assert stamps == sorted(stamps), "merged timeline out of order"
+    native = [e for e in snap if e.get("lane") == "native"]
+    py = [e for e in snap if e.get("lane") == "py"]
+    assert native, "C plane contributed nothing to the merge"
+    assert py, "python lane lost its tag in the merge"
+    assert all(e["entity"].startswith("n") for e in native), native[:5]
+    # the C rendezvous evidence arrives whole and in causal order
+    evs = [e["event"] for e in native]
+    for name in ("rdv-offer", "rdv-claim", "rdv-complete"):
+        assert name in evs, (name, evs)
+    assert evs.index("rdv-offer") < evs.index("rdv-claim") \
+        < evs.index("rdv-complete")
+
+
+def test_merged_snapshot_replays_through_protocol_machines(obs_plane):
+    """The C plane emits the SAME event vocabulary the protocol machines
+    were built for: the merged dump replays with zero violations, and the
+    dump file round-trips through the offline checker."""
+    from tpurpc.analysis import protocol
+    from tpurpc.obs import flight
+
+    _cross_plane_exchange()
+    snap = flight.snapshot()
+    assert any(e.get("lane") == "native" for e in snap)
+    violations = protocol.check_events(snap, strict=False)
+    assert violations == [], violations[:5]
+    # and as a dump FILE (the TPURPC_FLIGHT_DUMP / CI-artifact path)
+    path = "/tmp/_tpurpc_test_native_obs_dump.json"
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"events": snap}, f)
+    try:
+        total, violations = protocol.check_dump(path, strict=False)
+        assert total == len(snap)
+        assert violations == [], violations[:5]
+    finally:
+        os.unlink(path)
+
+
+def test_counters_scrape_registry_and_tsdb_pickup(obs_plane):
+    """The metrics table reaches every layered consumer: the raw dict,
+    the registry mirror (``native_*``), /metrics rendering, and tsdb
+    history — all without the C hot path seeing Python."""
+    from tpurpc.obs import metrics as metrics_mod
+    from tpurpc.obs import scrape, tsdb
+    from tpurpc.rpc import native_client
+
+    _cross_plane_exchange()
+    tab = obs_plane.counters()
+    assert tab["rdv_send_bytes"] >= len(NATIVE_PAYLOAD), tab
+    assert tab["emitted"] > 0 and tab["conn_up"] >= 1, tab
+    assert set(tab) == set(obs_plane.METRIC_NAMES)
+    # registry mirror: externally-owned totals, assigned not inc()ed
+    assert obs_plane.sync_registry() is True
+    reg = metrics_mod.registry()
+    assert reg.counter("native_rdv_send_bytes").value == \
+        tab["rdv_send_bytes"]
+    assert "tpurpc_native_rdv_send_bytes" in scrape.render_prometheus()
+    # tsdb: one sampler tick picks the mirror up as history
+    db = tsdb.Tsdb(fine_s=0.05)
+    db.sample_once()
+    kinds = db.series()
+    assert kinds.get("native_rdv_send_bytes") == "counter", kinds
+    assert kinds.get("native_dlv_depth") == "gauge", kinds
+    pts = db.window("native_rdv_send_bytes", 60.0)
+    assert pts and pts[-1][1] >= len(NATIVE_PAYLOAD), pts
+    # the PR 18 rdv ledger rides alongside, not underneath: both ABIs
+    # answer, from independent storage
+    led = native_client.rdv_counters()
+    assert led is not None
+    assert set(led) == set(native_client.RDV_COUNTER_NAMES)
+
+
+def test_postfork_reset_attaches_fresh_region(obs_plane):
+    """A forked shard worker must NOT keep writing into the parent's shm
+    region: postfork_reset drops the inherited mapping, the C side builds
+    its own region under a new name, and the parent's stays intact."""
+    parent_name = obs_plane._lib().tpr_obs_shm_name().decode()
+    assert parent_name
+    r, w = os.pipe()
+    pid = os.fork()
+    if pid == 0:
+        status = 1
+        try:
+            os.close(r)
+            obs_plane.postfork_reset()
+            child_name = obs_plane._lib().tpr_obs_shm_name().decode()
+            doc = {"name": child_name,
+                   "available": obs_plane.available(),
+                   "emitted": obs_plane.counters().get("emitted", -1)}
+            os.write(w, json.dumps(doc).encode())
+            os.close(w)
+            status = 0
+        finally:
+            os._exit(status)
+    os.close(w)
+    try:
+        raw = b""
+        while True:
+            chunk = os.read(r, 4096)
+            if not chunk:
+                break
+            raw += chunk
+    finally:
+        os.close(r)
+        _, code = os.waitpid(pid, 0)
+    assert os.waitstatus_to_exitcode(code) == 0
+    doc = json.loads(raw)
+    assert doc["available"] is True
+    assert doc["name"] and doc["name"] != parent_name, doc
+    assert doc["emitted"] == 0, doc  # fresh table, not the parent's totals
+    # the parent keeps its region AND its mapping (staleness probe holds)
+    assert obs_plane._lib().tpr_obs_shm_name().decode() == parent_name
+    assert obs_plane.available()
+
+
+def test_off_switch_leaves_rdv_ledger_abi_intact(ring_platform):
+    """TPURPC_NATIVE_OBS=0 (read by the C side at first use, hence the
+    subprocess): the plane reports unavailable, the flight snapshot grows
+    no lane tags, and the PR 18 ``tpr_rdv_counters`` ledger still answers
+    — observability off must not degrade the data plane's own telemetry."""
+    script = """
+import json
+from tpurpc.obs import flight, native_obs
+import tpurpc.rpc as rpc
+from tpurpc.rpc.channel import Channel
+from tpurpc.rpc import native_client
+
+srv = rpc.Server(max_workers=2)
+
+def total(req_iter, ctx):
+    yield str(sum(len(m) for m in req_iter)).encode()
+
+srv.add_method("/off.S/Total", rpc.stream_stream_rpc_method_handler(total))
+port = srv.add_insecure_port("127.0.0.1:0")
+srv.start()
+payload = bytes(512) * 4096
+try:
+    with Channel(f"127.0.0.1:{port}") as ch:
+        mc = ch.stream_stream("/off.S/Total")
+        assert list(mc(iter([payload]), timeout=60))[-1] == \\
+            str(len(payload)).encode()
+        mc_py = ch.stream_stream("/off.S/Total", tpurpc_native=False)
+        assert list(mc_py(iter([payload]), timeout=60))[-1] == \\
+            str(len(payload)).encode()
+finally:
+    srv.stop(grace=1)
+assert not native_obs.available()
+assert native_obs.counters() == {}
+assert native_obs.records() == []
+snap = flight.snapshot()
+assert snap, "py recorder must still record with the plane off"
+assert all("lane" not in e for e in snap), "lane tags leaked"
+led = native_client.rdv_counters()
+assert led is not None
+assert set(led) == set(native_client.RDV_COUNTER_NAMES)
+assert native_client.rdv_counters_reset() is True
+print("OFFSWITCH-OK")
+"""
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["TPURPC_NATIVE_OBS"] = "0"
+    env["GRPC_PLATFORM_TYPE"] = "RDMA_BPEV"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = here + os.pathsep + env.get("PYTHONPATH", "")
+    res = subprocess.run([sys.executable, "-c", script], env=env,
+                         capture_output=True, text=True, timeout=180)
+    assert res.returncode == 0, (res.stdout, res.stderr)
+    assert "OFFSWITCH-OK" in res.stdout

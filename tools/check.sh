@@ -129,6 +129,20 @@ note "tpurpc-ironclad native rdv smoke (C plane, zero-copy ledger)"
 TPURPC_FLIGHT_DUMP="$FLIGHT_DUMPS" JAX_PLATFORMS=cpu \
     python -m tpurpc.tools.native_rdv_smoke || fail=1
 
+# 2g1d) tpurpc-xray smoke (ISSUE 19): the C observability plane — a
+#      native<->native 4 MiB stream whose merged /debug/flight carries
+#      the C plane's ORDERED offer/claim/complete next to the python
+#      lane on one monotonic clock, the native metrics table scraped
+#      (native_rdv_send_bytes >= payload) with the waterfall's native
+#      hops live, and an induced frozen C consumer attributed to the
+#      `native-ctrl-frozen` watchdog stage from C evidence ALONE before
+#      the framed fallback completes the calls. Its merged dump rides
+#      the protocol-conformance stage below (the C plane's events replay
+#      through the same machines). ~15s, no jax.
+note "tpurpc-xray native obs smoke (merged C+py flight, C-evidence stall)"
+TPURPC_FLIGHT_DUMP="$FLIGHT_DUMPS" JAX_PLATFORMS=cpu \
+    python -m tpurpc.tools.native_obs_smoke || fail=1
+
 # 2g2) tpurpc-cadence smoke (ISSUE 10): interactive + batch clients
 #      stream off one continuous-batching decode server — per-token order
 #      + exact reference values, a mid-decode join between step events,

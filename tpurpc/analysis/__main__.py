@@ -48,6 +48,10 @@ def _run_lint(paths) -> int:
     violations = (lint.lint_paths(paths) if paths else lint.lint_tree())
     violations = violations + (lint.audit_suppressions(paths) if paths
                                else lint.audit_suppressions_tree())
+    if not paths:
+        # tpurpc-xray (ISSUE 19): the C plane's emission sites ride the
+        # same gate — TPR_OBS discipline over native/src
+        violations = violations + lint.lint_native_tree()
     for v in violations:
         print(v)
     if violations:

@@ -87,6 +87,19 @@ hand (ISSUE 2) and that no general-purpose linter knows about:
   ``# tpr: allow(rawlock)`` where the raw primitive is the point (the
   checked-lock implementation itself, post-fork singleton rebuilds).
 
+* ``tpr-obs``  — the C emission macro (tpurpc-xray, ISSUE 19): the
+  ``flight`` rule's discipline, extended to the native plane's
+  ``TPR_OBS(kEv..., tag, a1, a2)`` sites in ``native/src``. Text-based
+  (no C AST here): the event code must be a static ``kEv*`` constant,
+  the tag a pre-interned variable (``tag_for(...)`` in the argument
+  list interns per event — cold-path work on the hot path), arguments
+  carry no string/char literals and no function calls (the same
+  precompute-the-int contract), and raw ``tpr_obs::emit(...)`` outside
+  the plane's own implementation bypasses the macro's ``enabled()``
+  guard. Checked by :func:`lint_native_source` /
+  :func:`lint_native_tree` (the CLI's default pass includes it);
+  deliberate exceptions carry ``// tpr: allow(tpr-obs)``.
+
 Suppression grammar: a line comment ``# tpr: allow(<rule>)`` disables that
 rule for its line. The hot-path modules are expected to carry NO ``copy``
 suppressions — a copy on the data plane is either fixed or it is a finding.
@@ -1308,6 +1321,136 @@ def _check_xproc(tree: ast.AST, path: str,
                 "the transport seam: cross-process effects must leave "
                 "through transport.dispatch so message-level exploration "
                 "(simnet) and fault injection see every send"))
+    return out
+
+
+# -- rule: tpr-obs (C emission discipline, tpurpc-xray ISSUE 19) --------------
+
+#: C-side suppression comment — ``// tpr: allow(tpr-obs)`` (the python
+#: grammar's char class has no ``-``, so the C rule carries its own)
+_NATIVE_ALLOW_RE = re.compile(r"//\s*tpr:\s*allow\(([a-z_\-,\s]+)\)")
+_NATIVE_CODE_RE = re.compile(r"^(?:tpr_obs::)?kEv\w+$")
+_NATIVE_CALL_RE = re.compile(r"\b\w+\s*\(")
+#: files that ARE the obs plane — raw emit is their implementation detail
+_NATIVE_OBS_IMPL = ("tpr_obs.h", "tpr_obs.cc")
+
+
+def _native_allowed(lines: Sequence[str], line: int) -> bool:
+    if _AUDIT_IGNORE_SUPPRESSIONS:
+        return False
+    if 1 <= line <= len(lines):
+        m = _NATIVE_ALLOW_RE.search(lines[line - 1])
+        if m:
+            return "tpr-obs" in {t.strip() for t in m.group(1).split(",")}
+    return False
+
+
+def _native_split_args(text: str) -> List[str]:
+    """Top-level comma split of a balanced C argument list."""
+    out, depth, start = [], 0, 0
+    for i, ch in enumerate(text):
+        if ch in "([{":
+            depth += 1
+        elif ch in ")]}":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            out.append(text[start:i])
+            start = i + 1
+    out.append(text[start:])
+    return [a.strip() for a in out]
+
+
+def lint_native_source(source: str, path: str) -> List[LintViolation]:
+    """The ``tpr-obs`` rule over one C source: every ``TPR_OBS(...)``
+    site must be static-tag pure-int plumbing (see the module docstring's
+    ``tpr-obs`` entry), and ``tpr_obs::emit`` may only be called raw
+    inside the obs plane's own implementation."""
+    out: List[LintViolation] = []
+    lines = source.split("\n")
+    base = os.path.basename(path)
+    if base not in _NATIVE_OBS_IMPL:
+        for i, ln in enumerate(lines, 1):
+            if "tpr_obs::emit" not in ln or _native_allowed(lines, i):
+                continue
+            out.append(LintViolation(
+                path, i, ln.index("tpr_obs::emit"), "tpr-obs",
+                "raw tpr_obs::emit() bypasses the TPR_OBS macro's "
+                "enabled() guard: the off-switch must cost one relaxed "
+                "load, not an emit — go through TPR_OBS; a deliberate "
+                "exception carries '// tpr: allow(tpr-obs)'"))
+    for m in re.finditer(r"\bTPR_OBS\s*\(", source):
+        line = source.count("\n", 0, m.start()) + 1
+        stripped = lines[line - 1].lstrip()
+        if stripped.startswith("#define") or stripped.startswith("//"):
+            continue
+        if _native_allowed(lines, line):
+            continue
+        # balanced-paren argument extraction (sites span lines)
+        depth, i = 1, m.end()
+        while i < len(source) and depth:
+            if source[i] == "(":
+                depth += 1
+            elif source[i] == ")":
+                depth -= 1
+            i += 1
+        if depth:
+            continue  # unbalanced tail: not a call site we can judge
+        args = _native_split_args(source[m.end():i - 1])
+        col = m.start() - (source.rfind("\n", 0, m.start()) + 1)
+
+        def flag(msg: str) -> None:
+            out.append(LintViolation(path, line, col, "tpr-obs", msg))
+
+        if len(args) != 4:
+            flag(f"TPR_OBS takes (code, tag, a1, a2); got {len(args)} "
+                 "argument(s)")
+            continue
+        if not _NATIVE_CODE_RE.match(args[0]):
+            flag(f"event code {args[0]!r} is not a static kEv* constant: "
+                 "dynamic codes make the shared-ABI event vocabulary "
+                 "unauditable (flight.py mirrors these numbers)")
+        if "tag_for" in args[1]:
+            flag("tag_for() in the tag argument interns per event: "
+                 "intern ONCE at link/conn setup (the cold path) and "
+                 "pass the cached uint16 — the flight rule's interned-"
+                 "tag contract, on the C plane")
+        for arg in args:
+            if '"' in arg or "'" in arg:
+                flag(f"argument {arg!r} carries a string/char literal: "
+                     "events carry ints (tags are interned, names live "
+                     "in the shm tag table)")
+                break
+        for arg in args[1:]:
+            if "tag_for" in arg:
+                continue  # already flagged with the specific story
+            if _NATIVE_CALL_RE.search(arg):
+                flag(f"argument {arg!r} calls a function per event: the "
+                     "always-on C ring's writers pay 4 relaxed stores "
+                     "and 2 seq stamps per record — precompute the int; "
+                     "a deliberate exception carries "
+                     "'// tpr: allow(tpr-obs)'")
+                break
+    out.sort(key=lambda v: (v.path, v.line, v.col))
+    return out
+
+
+def native_src_root() -> str:
+    """The repo's ``native/src`` directory (sibling of the package)."""
+    return os.path.join(os.path.dirname(tree_root()), "native", "src")
+
+
+def lint_native_tree(root: Optional[str] = None) -> List[LintViolation]:
+    """The ``tpr-obs`` pass over every C source under ``native/src``."""
+    root = root or native_src_root()
+    if not os.path.isdir(root):
+        return []
+    out: List[LintViolation] = []
+    for fn in sorted(os.listdir(root)):
+        if not fn.endswith((".cc", ".h", ".cpp", ".hpp")):
+            continue
+        p = os.path.join(root, fn)
+        with open(p, "r", encoding="utf-8") as f:
+            out.extend(lint_native_source(f.read(), p))
     return out
 
 

@@ -19,7 +19,7 @@ _LIB: "Optional[ctypes.CDLL]" = None
 _SPIN: "Optional[ctypes.CDLL]" = None
 _TRIED = False
 
-ABI_VERSION = 6
+ABI_VERSION = 7
 
 
 def _lib_path() -> str:

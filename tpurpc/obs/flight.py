@@ -170,6 +170,18 @@ SEQ_DETACH = 66           # a1 = seq id, a2 = KV entries handed out
 PAIR_PARK = 67            # a1 = ring bytes returned to the pool
 PAIR_UNPARK = 68          # a1 = ring bytes re-leased, a2 = 1 if remote wake
 ACCEPT_SHED = 69          # a1 = inflight handshakes, a2 = pushback (ms)
+# tpurpc-xray (ISSUE 19): native-only edges. The C plane (native/src/
+# tpr_obs.cc) REUSES the shared codes above for every edge the Python
+# plane also records (RDV_*, CTRL_*, CONN_*) so the protocol machines
+# replay it unmodified; these five are edges only the C core can see.
+# They arrive through the merged module-level snapshot() with lane
+# "native" — the Python recorder never emits them.
+NATIVE_PIN_WAIT_BEGIN = 70   # link close() waiting on window pins; a1 = pins
+NATIVE_PIN_WAIT_END = 71     # a1 = ns waited
+NATIVE_DLV_STALL_BEGIN = 72  # delivery-shard backlog over high water; a1 = depth
+NATIVE_DLV_STALL_END = 73    # backlog drained below low water; a1 = depth
+NATIVE_RDV_FALLBACK = 74     # eligible send fell back framed; a1 = bytes,
+                             # a2 = reason (0 no claim, 1 write failed)
 
 EVENT_NAMES: Dict[int, str] = {
     PAIR_CONNECT: "pair-connect",
@@ -241,6 +253,11 @@ EVENT_NAMES: Dict[int, str] = {
     PAIR_PARK: "pair-park",
     PAIR_UNPARK: "pair-unpark",
     ACCEPT_SHED: "accept-shed",
+    NATIVE_PIN_WAIT_BEGIN: "native-pin-wait-begin",
+    NATIVE_PIN_WAIT_END: "native-pin-wait-end",
+    NATIVE_DLV_STALL_BEGIN: "native-dlv-stall-begin",
+    NATIVE_DLV_STALL_END: "native-dlv-stall-end",
+    NATIVE_RDV_FALLBACK: "native-rdv-fallback",
 }
 
 #: batch-flush reason codes (a1 of BATCH_FLUSH) — mirrors the jaxshim
@@ -402,9 +419,19 @@ class FlightRecorder:
 
     def reset(self) -> None:
         """Zero every slot (test isolation). Not synchronized against
-        concurrent emitters — callers quiesce first."""
+        concurrent emitters — callers quiesce first. Resetting the
+        process-wide recorder also clears the native shm ring: snapshot()
+        merges both lanes into one timeline, so a reset that left the C
+        half standing would hand every later caller a seed of stale
+        native brackets."""
         for i in range(len(self._buf)):
             self._buf[i] = 0
+        if globals().get("RECORDER") is self:
+            try:
+                from tpurpc.obs import native_obs as _nobs
+                _nobs.reset()
+            except Exception:
+                pass  # the native plane must never break the Python one
 
 
 #: the process-wide recorder; hot modules cache ``flight.emit`` (below)
@@ -415,12 +442,74 @@ RECORDER = FlightRecorder()
 emit = RECORDER.emit
 
 
+def _native_events(since_ns: int = 0) -> List[dict]:
+    """tpurpc-xray: decode the C core's shm flight ring into event dicts
+    (lane ``"native"``). Native tags are re-interned through THIS module's
+    table so entity names resolve uniformly downstream (watchdog evidence,
+    protocol replay, dump rendering); both planes stamp CLOCK_MONOTONIC,
+    so in-process merge order is a plain sort on ``t_ns``."""
+    try:
+        from tpurpc.obs import native_obs as _nobs
+
+        recs = _nobs.records()
+        if not recs:
+            return []
+        tags = _nobs.tag_table()
+    except Exception:
+        return []  # the native plane must never break the Python one
+    from tpurpc.obs import shard as _shard
+
+    sid = _shard.shard_id()
+    out: List[dict] = []
+    for t_ns, code, tag, tid, a1, a2 in recs:
+        if t_ns == 0 or code not in EVENT_NAMES or t_ns < since_ns:
+            continue
+        entity = tags[tag] if 0 <= tag < len(tags) else f"#{tag}"
+        rec = {"t_ns": t_ns, "code": code, "event": EVENT_NAMES[code],
+               "tag": tag_for(entity) if entity != "-" else 0,
+               "entity": entity, "tid": tid, "a1": a1, "a2": a2,
+               "lane": "native"}
+        if sid >= 0:
+            rec["shard"] = sid
+        out.append(rec)
+    return out
+
+
 def snapshot(since_ns: int = 0, limit: Optional[int] = None) -> List[dict]:
-    return RECORDER.snapshot(since_ns=since_ns, limit=limit)
+    """The merged flight view: Python recorder + native shm ring, one
+    monotonic timeline. When the native plane is off (or absent) this is
+    byte-identical to the recorder's own snapshot — lane tags appear only
+    once there are two lanes to tell apart."""
+    out = RECORDER.snapshot(since_ns=since_ns)
+    native = _native_events(since_ns=since_ns)
+    if native:
+        for e in out:
+            e["lane"] = "py"
+        out.extend(native)
+        out.sort(key=lambda d: d["t_ns"])
+    if limit is not None and len(out) > limit:
+        out = out[-limit:]
+    return out
 
 
 def dump_text(since_ns: int = 0) -> str:
-    return RECORDER.dump_text(since_ns=since_ns)
+    """Human-readable replay of the MERGED timeline (the /debug/flight
+    ?text=1 and SIGUSR2 rendering; single-lane output matches the
+    recorder's own dump format exactly)."""
+    events = snapshot(since_ns=since_ns)
+    if not events:
+        return "flight recorder: no events\n"
+    t0 = events[0]["t_ns"]
+    lines = [f"flight recorder: {len(events)} events "
+             f"(capacity {RECORDER.capacity})"]
+    for e in events:
+        lane = e.get("lane")
+        lines.append(
+            f"  +{(e['t_ns'] - t0) / 1e6:10.3f}ms "
+            f"{e['event']:<22} {e['entity']:<20} "
+            f"a1={e['a1']} a2={e['a2']} tid={e['tid']:#x}"
+            + (f" [{lane}]" if lane else ""))
+    return "\n".join(lines) + "\n"
 
 
 def postfork_restart() -> None:
@@ -428,6 +517,16 @@ def postfork_restart() -> None:
     supervisor's pre-fork events, which would replay as this worker's
     history. Zeroing + a fresh slot counter keeps the module-level ``emit``
     binding (hot modules reference ``_flight.emit``) intact."""
+    # tpurpc-xray: swap the C plane's inherited shm mapping for a fresh
+    # per-worker region BEFORE RECORDER.reset() — reset() also clears the
+    # native ring, and doing that while still attached to the inherited
+    # mapping would wipe the parent's evidence.
+    try:
+        from tpurpc.obs import native_obs as _nobs
+
+        _nobs.postfork_reset()
+    except Exception:
+        pass
     RECORDER.reset()
     RECORDER._slots = itertools.count()
 
@@ -498,7 +597,11 @@ def _install_exit_dump() -> None:
             # onto the shared wall clock and check them as a MERGED
             # stream (`protocol --flight A --flight B`, ISSUE 17)
             from tpurpc.obs import tracing as _tracing
-            doc = {"events": RECORDER.snapshot(),
+
+            # the MERGED timeline (tpurpc-xray): C-plane rdv/ctrl/conn
+            # edges ride the same dump and replay through the same
+            # protocol machines as the Python lane's
+            doc = {"events": snapshot(),
                    "clock_anchor": _tracing.clock_anchor()}
             os.makedirs(target, exist_ok=True)
             path = os.path.join(target, f"flight-{os.getpid()}.json")

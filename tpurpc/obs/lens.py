@@ -85,6 +85,12 @@ HOPS: Tuple[Tuple[str, str], ...] = (
                    "landing region (tpurpc-express)"),
     ("ctrl", "control-plane work: descriptor-ring posts/drains and framed "
              "rendezvous control sends (tpurpc-pulse)"),
+    ("native_send", "native-plane rdv placement: the one-sided memcpy "
+                    "into the peer-advertised landing region (tpr_rdv.cc)"),
+    ("native_recv", "native-plane delivery: completed landing regions "
+                    "handed to the stream layer (tpr_rdv.cc deliver)"),
+    ("native_rdv", "native-plane claim wait: solicited offer -> claim "
+                   "grant round trip (tpr_rdv.cc rdv_claim)"),
     ("peer_ring", "RingReader drain out of the local receive ring"),
     ("decode", "codec parse of wire bytes back into tensors"),
     ("hbm", "placement into the device-resident HBM landing ring"),
@@ -132,6 +138,14 @@ def waterfall() -> dict:
     call time. ``gbps`` is ``bytes / busy_ns`` (identical units); a hop
     that has seen no traffic reports zeros and is excluded from the
     bottleneck argmin."""
+    # tpurpc-xray: pull the C core's byte/busy_ns table into the native
+    # hops first, so slowest_hop judges the PRODUCTION plane too
+    try:
+        from tpurpc.obs import native_obs as _nobs
+
+        _nobs.sync_registry()
+    except Exception:
+        pass
     rows: List[dict] = []
     for name, desc in HOPS:
         b = _BYTES[name].snapshot()

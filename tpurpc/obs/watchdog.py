@@ -58,7 +58,12 @@ _log = logging.getLogger("tpurpc.watchdog")
 
 STAGES = ("credit-starvation", "peer-not-reading", "h2-flow-control",
           "ctrl-ring", "rendezvous", "kv-swap", "migration", "decode-step",
-          "batcher-wait", "poller-wake", "device-infer", "slo", "unknown")
+          "batcher-wait", "poller-wake", "device-infer", "slo", "unknown",
+          # tpurpc-xray (ISSUE 19): stages diagnosed from the C core's
+          # shm flight ring + metrics table — evidence the Python plane
+          # cannot see (append-only, like the event codes)
+          "native-ctrl-frozen", "native-pin-wait", "native-rdv-fallback",
+          "native-delivery")
 
 # tpurpc-argus (ISSUE 14): trip hooks — automatic evidence capture
 # (obs/bundle.py) registers here so every sweeper trip and every external
@@ -292,11 +297,13 @@ class StallWatchdog:
             from tpurpc.obs import tracing as _tracing
 
             _tracing.tail_flag(trace_id)
+        # module-level dump_text, not the recorder's: the trip log must
+        # replay the MERGED timeline — native-plane stages cite C evidence
         _log.warning(
             "stall: %s %s in flight %.2fs — stage %s (%s)\n%s",
             diag["kind"], diag["method"], diag["age_s"], diag["stage"],
             diag["detail"],
-            _flight.RECORDER.dump_text(
+            _flight.dump_text(
                 since_ns=diag["since_ns"] - 1_000_000_000))
         _run_trip_hooks(diag)
 
@@ -327,7 +334,7 @@ class StallWatchdog:
         _log.warning(
             "external trip: %s — stage %s (%s)\n%s",
             method, stage, detail,
-            _flight.RECORDER.dump_text(
+            _flight.dump_text(
                 since_ns=time.monotonic_ns() - 2_000_000_000))
         _run_trip_hooks(diag)
 
@@ -336,7 +343,10 @@ class StallWatchdog:
     def _gather_evidence(self, now_ns: int) -> dict:
         """One pass over the flight tail + fleet gauges, shared by every
         diagnosis in a sweep."""
-        events = _flight.RECORDER.snapshot(
+        # the MERGED timeline (tpurpc-xray): the module-level snapshot
+        # folds the C core's shm flight ring in, so native rdv/ctrl edges
+        # and the native-only codes below are first-class evidence
+        events = _flight.snapshot(
             since_ns=now_ns - 60_000_000_000, limit=512)
         open_lease = 0
         open_edges: Dict[tuple, int] = {}  # (begin_code, tag) -> t_ns
@@ -363,6 +373,15 @@ class StallWatchdog:
         # generic decode-step story (more specific evidence wins)
         open_swap: Dict[tuple, int] = {}
         open_mig: Dict[tuple, int] = {}
+        # tpurpc-xray: native-plane evidence. A C-side tx-ring-full stall
+        # (CTRL_STALL_BEGIN on an "nctrl:*" entity) is a FROZEN C CONSUMER
+        # — the peer's native drain loop stopped; a pin-wait bracket is a
+        # link close() wedged behind window pins; delivery-stall brackets
+        # and recent fallbacks come straight off the C ring.
+        open_nctrl: Dict[int, int] = {}
+        open_pin: Dict[int, int] = {}
+        open_dlv: Dict[int, int] = {}
+        native_fallbacks: List[int] = []
         last_step_end = 0
         last_step_batch = 0
         last_h2 = 0
@@ -381,9 +400,25 @@ class StallWatchdog:
             elif code == _flight.H2_WINDOW_EXHAUSTED:
                 last_h2 = e["t_ns"]
             elif code == _flight.CTRL_STALL_BEGIN:
-                open_ctrl[e["tag"]] = e["t_ns"]
+                if e.get("lane") == "native":
+                    open_nctrl[e["tag"]] = e["t_ns"]
+                else:
+                    open_ctrl[e["tag"]] = e["t_ns"]
             elif code == _flight.CTRL_STALL_END:
-                open_ctrl.pop(e["tag"], None)
+                if e.get("lane") == "native":
+                    open_nctrl.pop(e["tag"], None)
+                else:
+                    open_ctrl.pop(e["tag"], None)
+            elif code == _flight.NATIVE_PIN_WAIT_BEGIN:
+                open_pin[e["tag"]] = e["t_ns"]
+            elif code == _flight.NATIVE_PIN_WAIT_END:
+                open_pin.pop(e["tag"], None)
+            elif code == _flight.NATIVE_DLV_STALL_BEGIN:
+                open_dlv[e["tag"]] = e["t_ns"]
+            elif code == _flight.NATIVE_DLV_STALL_END:
+                open_dlv.pop(e["tag"], None)
+            elif code == _flight.NATIVE_RDV_FALLBACK:
+                native_fallbacks.append(e["t_ns"])
             elif code == _flight.RDV_OFFER:
                 open_rdv[(e["tag"], "o", e["a1"])] = e["t_ns"]
             elif code == _flight.RDV_CLAIM:
@@ -409,6 +444,15 @@ class StallWatchdog:
             elif code == _flight.MIG_END:
                 open_mig.pop((e["tag"], e["a1"]), None)
 
+        # tpurpc-xray: the C metrics table backs the flight-tail evidence
+        # (depth gauge for the delivery story, fallback total for storms)
+        try:
+            from tpurpc.obs import native_obs as _nobs
+
+            ntab = _nobs.counters()
+        except Exception:
+            ntab = {}
+
         def fleet_sum(name: str) -> float:
             m = _metrics.registry().metrics().get(name)
             if m is None or not isinstance(m, _metrics.FleetGauge):
@@ -422,6 +466,12 @@ class StallWatchdog:
             "open_rdv": open_rdv,
             "open_ctrl": open_ctrl,
             "ctrl_ring_backlog": fleet_sum("ctrl_ring_backlog"),
+            "open_nctrl": open_nctrl,
+            "open_pin": open_pin,
+            "open_dlv": open_dlv,
+            "native_fallbacks": native_fallbacks,
+            "native_dlv_depth": ntab.get("dlv_depth", 0),
+            "native_fallback_total": ntab.get("rdv_fallbacks", 0),
             "open_swap": open_swap,
             "open_mig": open_mig,
             "open_step": open_step,
@@ -444,6 +494,18 @@ class StallWatchdog:
             return ("credit-starvation",
                     "send-lease held: reserve without commit/abort in the "
                     "flight tail — the ring write lock is wedged")
+        # tpurpc-xray: a C-side tx-ring-full stall bracket is the most
+        # specific control-plane story there is — the peer's NATIVE drain
+        # loop (poller/pump thread) froze, diagnosed purely from C
+        # evidence (the Python plane never sees these posts at all)
+        open_nctrl = ev.get("open_nctrl") or {}
+        if open_nctrl:
+            oldest = max(now - t for t in open_nctrl.values())
+            if oldest >= self.min_stall_s * 1e9 / 2:
+                return ("native-ctrl-frozen",
+                        f"native ctrl ring full {oldest / 1e9:.2f}s on "
+                        f"{len(open_nctrl)} link(s): the peer's C consumer "
+                        "stopped draining its descriptor ring")
         # tpurpc-pulse: a stuck descriptor ring is MORE specific than the
         # rendezvous story it wedges — the control op (offer/claim/
         # complete) is sitting in a ring nobody drains.  Evidence: an aged
@@ -478,6 +540,40 @@ class StallWatchdog:
                         f" {offers} offer(s) unanswered, {claims} claimed "
                         "region(s) without complete/release in the flight "
                         "tail")
+        # tpurpc-xray: the remaining native-plane stories, all from C
+        # evidence alone. A pin-wait bracket is a link close() wedged
+        # behind window pins (a claim waiter or in-flight placement holds
+        # the mapping); a delivery-stall bracket backed by the depth
+        # gauge is the server's delivery shard not draining; a burst of
+        # fallback edges is the rendezvous plane silently degrading every
+        # bulk send to the framed path.
+        open_pin = ev.get("open_pin") or {}
+        if open_pin:
+            oldest = max(now - t for t in open_pin.values())
+            if oldest >= self.min_stall_s * 1e9 / 2:
+                return ("native-pin-wait",
+                        f"native link close() waiting {oldest / 1e9:.2f}s "
+                        "on pinned landing windows — a claim waiter or "
+                        "in-flight placement still holds the mapping")
+        open_dlv = ev.get("open_dlv") or {}
+        if open_dlv:
+            oldest = max(now - t for t in open_dlv.values())
+            if oldest >= self.min_stall_s * 1e9 / 2:
+                return ("native-delivery",
+                        f"native delivery shard backlogged "
+                        f"{oldest / 1e9:.2f}s "
+                        f"({int(ev.get('native_dlv_depth', 0))} item(s) "
+                        "queued): decode/materialization is not keeping "
+                        "up with the pollers")
+        fallbacks = ev.get("native_fallbacks") or []
+        recent_fb = [t for t in fallbacks if now - t < 10e9]
+        if len(recent_fb) >= 3:
+            return ("native-rdv-fallback",
+                    f"{len(recent_fb)} native rendezvous fallback(s) in "
+                    "10s (total "
+                    f"{int(ev.get('native_fallback_total', 0))}): bulk "
+                    "sends are degrading to the framed path — claims "
+                    "refused, timing out, or placement failing")
         # tpurpc-keystone: an aged open swap/migration bracket is MORE
         # specific than the decode-step story — the loop (or a migration
         # thread) is inside a KV move, and every stream behind the

@@ -1,0 +1,225 @@
+"""tpurpc-xray smoke (ISSUE 19): the C observability plane, end to end.
+
+Phase 1 (native <-> native merged flight): a default Server (ring
+adoption onto the C loop) and a default Channel (C client plane) move one
+4 MiB tensor — ``GET /debug/flight`` must return a MERGED timeline where
+the C plane's rendezvous evidence (offer -> claim -> complete, emitted by
+tpr_rdv.cc into the shm ring) appears in order on the same monotonic
+clock as the Python recorder's events, lane-tagged so the two planes stay
+distinguishable; the native metrics table must show the one-sided bytes
+(``native_rdv_send_bytes`` >= payload) and the waterfall must carry the
+native hops so ``slowest_hop`` can name the production plane.
+
+Phase 2 (frozen C consumer, attributed from C evidence alone):
+TPURPC_TEST_FREEZE_NCTRL freezes every native drain while a native client
+keeps posting control ops into an 8-slot ring — the C plane's own
+tx-ring-full stall bracket (CTRL_STALL_BEGIN on an ``nctrl:*`` entity,
+lane ``native``) is the ONLY stall evidence in the merged flight, and the
+stall watchdog must name the ``native-ctrl-frozen`` stage from it. The
+calls must still COMPLETE via the framed fallback (the zero-failed-RPC
+degradation ladder holds while the instrument points at the freeze).
+
+Runs everything in one subprocess (GRPC_PLATFORM_TYPE is read at import);
+under TPURPC_FLIGHT_DUMP the MERGED flight dump feeds tools/check.sh's
+protocol conformance stage — the C plane's offer/claim/complete/release
+replay through the rdv-lease/rdv-offer/ctrl-ring machines unmodified.
+Exit 0 = both phases passed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+PAYLOAD_BYTES = 4 << 20  # the 4 MiB tensor
+
+
+def _totaling_server(**kw):
+    from tpurpc.rpc.server import Server, stream_stream_rpc_method_handler
+
+    srv = Server(max_workers=4, **kw)
+
+    def total(req_iter, ctx):
+        n = 0
+        for m in req_iter:
+            n += len(m)
+        yield str(n).encode()
+
+    srv.add_method("/xraysmoke.S/Total",
+                   stream_stream_rpc_method_handler(total))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    return srv, port
+
+
+def phase_merged_flight() -> None:
+    """Native client -> native server: one 4 MiB message, merged
+    /debug/flight + native table + waterfall proof."""
+    from tpurpc.obs import flight, lens, native_obs, scrape
+    from tpurpc.rpc.channel import Channel
+
+    flight.RECORDER.reset()
+    assert native_obs.available(), "native obs plane unavailable on this rig"
+    native_obs.reset()
+    srv, port = _totaling_server()
+    payload = bytes(range(256)) * (PAYLOAD_BYTES // 256)
+    try:
+        with Channel(f"127.0.0.1:{port}") as ch:
+            mc = ch.stream_stream("/xraysmoke.S/Total")
+            list(mc(iter([b"warm"]), timeout=30))  # hello + standing grants
+            out = list(mc(iter([payload]), timeout=60))
+            assert out[-1] == str(len(payload)).encode(), out
+            # one PYTHON-plane leg on the same wire: a native<->native
+            # exchange rides the C loop end to end and the py recorder
+            # stays silent — the merge claim needs both lanes live
+            mc_py = ch.stream_stream("/xraysmoke.S/Total",
+                                     tpurpc_native=False)
+            py_payload = bytes(512) * 4096  # 2 MiB: over the rdv floor
+            out = list(mc_py(iter([py_payload]), timeout=60))
+            assert out[-1] == str(len(py_payload)).encode(), out
+        # the REAL route, not a side door: /debug/flight must serve the
+        # merged timeline
+        status, _ctype, body = scrape._route("/debug/flight")
+        assert status == 200, status
+        events = json.loads(body)["events"]
+        stamps = [e["t_ns"] for e in events]
+        assert stamps == sorted(stamps), "merged timeline out of order"
+        native = [e for e in events if e.get("lane") == "native"]
+        assert native, ("the C plane contributed nothing to the merged "
+                        "flight", [e["event"] for e in events][:20])
+        assert any(e.get("lane") == "py" for e in events), (
+            "python lane lost its tag in the merge")
+        evs = [e["event"] for e in native]
+        order = ("rdv-offer", "rdv-claim", "rdv-complete")
+        idx = [evs.index(name) for name in order if name in evs]
+        assert len(idx) == len(order), (order, evs)
+        assert idx == sorted(idx), ("C rdv flight out of order", evs)
+        assert all(e["entity"].startswith("n") for e in native), (
+            "native entities must carry the n* tag vocabulary", native[:5])
+        # the metrics table saw the one-sided write, and the scrape +
+        # waterfall surfaces carry it
+        tab = native_obs.counters()
+        assert tab["rdv_send_bytes"] >= len(payload), tab
+        assert tab["emitted"] >= len(native), tab
+        assert "tpurpc_native_rdv_send_bytes" in scrape.render_prometheus()
+        rows = lens.waterfall()["hops"]
+        live = {r["hop"] for r in rows if r["bytes"] > 0}
+        assert "native_send" in live, (
+            "waterfall never grew the native hop", sorted(live))
+        print(f"  [native<->native] merged /debug/flight: "
+              f"{len(native)} C-plane events in order with "
+              f"{len(events) - len(native)} py events; "
+              f"native_rdv_send_bytes={tab['rdv_send_bytes']}")
+    finally:
+        srv.stop(grace=1)
+
+
+def phase_frozen_native_consumer() -> None:
+    """Freeze every native drain: the C plane's tx-ring-full bracket is
+    the only stall evidence, and the watchdog names native-ctrl-frozen
+    from it; framed fallback completes the calls anyway."""
+    from tpurpc.obs import flight, native_obs, watchdog
+    from tpurpc.rpc.channel import Channel
+
+    # an 8-slot ring fills after a handful of undrained control posts —
+    # read at ring creation, so set BEFORE the server/channel exist
+    os.environ["TPURPC_CTRL_RING_SLOTS"] = "8"
+    os.environ["TPURPC_RENDEZVOUS_CLAIM_TIMEOUT_S"] = "0.5"
+    flight.RECORDER.reset()
+    native_obs.reset()
+    srv, port = _totaling_server()
+    payload = bytes(512) * 4096  # 2 MiB: a class with no standing grant
+    wd = watchdog.get()
+    wd.reset()
+    prev = (wd.min_stall_s, wd.sweep_s)
+    wd.min_stall_s, wd.sweep_s = 0.3, 0.1
+    try:
+        with Channel(f"127.0.0.1:{port}") as ch:
+            mc = ch.stream_stream("/xraysmoke.S/Total")
+            list(mc(iter([b"warm"]), timeout=30))  # hello + ring adoption
+            # the C lib reads this env LIVE in ctrl_drain: every native
+            # consumer goes quiet, posted records age in the ring
+            os.environ["TPURPC_TEST_FREEZE_NCTRL"] = "1"
+            result: dict = {}
+
+            # the native client self-pumps (no thread parked on it), so
+            # the smoke registers the in-flight marker the way the server
+            # plane does — the ATTRIBUTION below still rests on C
+            # evidence alone
+            tok = wd.call_started("/xraysmoke.S/Total", kind="client")
+
+            def stalled():
+                try:
+                    result["out"] = list(
+                        mc(iter([payload] * 8), timeout=120))
+                finally:
+                    wd.call_finished(tok)
+
+            t = threading.Thread(target=stalled)
+            t.start()
+            diag = None
+            deadline = time.monotonic() + 30
+            while diag is None and time.monotonic() < deadline:
+                time.sleep(0.15)
+                for d in wd.sweep_once():
+                    if d["stage"] == "native-ctrl-frozen":
+                        diag = d
+                        break
+            assert diag is not None, (
+                "watchdog never named native-ctrl-frozen", wd.active())
+            # C evidence ALONE: every open stall bracket in the merged
+            # flight is native-lane (the python plane never saw a post)
+            stalls = [e for e in flight.snapshot()
+                      if e["event"] == "ctrl-stall-begin"]
+            assert stalls and all(
+                e.get("lane") == "native" for e in stalls), stalls
+            assert all(e["entity"].startswith("nctrl:") for e in stalls)
+            os.environ.pop("TPURPC_TEST_FREEZE_NCTRL", None)  # thaw
+            t.join(timeout=120)
+            assert not t.is_alive(), "stalled calls never completed"
+            assert result["out"][-1] == str(len(payload) * 8).encode()
+        print(f"  [frozen C consumer] watchdog named '{diag['stage']}' "
+              f"({diag['detail'][:56]}...) from {len(stalls)} native-lane "
+              "bracket(s); framed fallback completed the calls")
+    finally:
+        os.environ.pop("TPURPC_TEST_FREEZE_NCTRL", None)
+        os.environ.pop("TPURPC_RENDEZVOUS_CLAIM_TIMEOUT_S", None)
+        os.environ.pop("TPURPC_CTRL_RING_SLOTS", None)
+        wd.min_stall_s, wd.sweep_s = prev
+        wd.reset()
+        srv.stop(grace=1)
+
+
+def run_phases() -> None:
+    phase_merged_flight()
+    phase_frozen_native_consumer()
+
+
+def main() -> int:
+    if "--phase" in sys.argv:
+        run_phases()
+        return 0
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env["GRPC_PLATFORM_TYPE"] = "RDMA_BPEV"
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONPATH"] = here + os.pathsep + env.get("PYTHONPATH", "")
+    rc = subprocess.run(
+        [sys.executable, "-m", "tpurpc.tools.native_obs_smoke", "--phase"],
+        env=env, timeout=300).returncode
+    if rc != 0:
+        print("native obs smoke FAILED")
+        return 1
+    print("native obs smoke: PASS (merged C+py /debug/flight ordered, "
+          "native table scraped, frozen C consumer attributed from "
+          "C evidence alone)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

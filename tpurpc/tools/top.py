@@ -8,7 +8,9 @@ fan-in batcher's batch-size/flush-reason profile, and — tpurpc-blackbox
 watchdog diagnoses with their attributed stage, plus the trip counters),
 and — tpurpc-odyssey (ISSUE 15) — a ``seq`` pane fed by ``/debug/seq``
 (top sequences by device step-ms and KV byte-seconds, per-account cost
-rollup).
+rollup), and — tpurpc-xray (ISSUE 19) — a ``natv`` pane from the
+``native_*`` series the scrape mirrors out of the C core's shm metrics
+table (rdv ledger, ctrl drain cadence, fallbacks, pin/delivery pressure).
 
     python -m tpurpc.tools.top HOST:PORT [--interval 1.0] [--once]
 
@@ -187,6 +189,29 @@ def render(cur: Dict, prev: Optional[Dict], dt: float,
         hc = led.get('kind="host_copy"', 0)
         zc = led.get('kind="zero_copy"', 0)
         lines.append(f"copy  host {int(hc):>12}B   zero-copy {int(zc):>12}B")
+    # tpurpc-xray native-plane pane (ISSUE 19): the native_* series the
+    # scrape mirrors out of the C core's shm metrics table — rdv ledger,
+    # ctrl-ring drain cadence, fallbacks, pin/delivery pressure. Absent
+    # (emitted == 0) on python-plane-only processes.
+    if _val(cur, P + "native_emitted") > 0:
+        lines.append(
+            f"natv  rdv sent "
+            f"{int(_val(cur, P + 'native_rdv_send_bytes')):>12}B  recv "
+            f"{int(_val(cur, P + 'native_rdv_recv_bytes')):>12}B  "
+            f"waits {int(_val(cur, P + 'native_rdv_waits'))}  "
+            f"fallbacks {int(_val(cur, P + 'native_rdv_fallbacks'))}")
+        lines.append(
+            f"      ctrl drains/s {rate(P + 'native_ctrl_drain_batches'):7.0f} "
+            f"({rate(P + 'native_ctrl_drain_records'):8.0f} rec/s)  "
+            f"posts/s {rate(P + 'native_ctrl_posts'):7.0f}  "
+            f"kicks/s {rate(P + 'native_ctrl_kicks'):5.0f}  "
+            f"frames {int(_val(cur, P + 'native_ctrl_frames'))}")
+        lines.append(
+            f"      pin-waits {int(_val(cur, P + 'native_pin_waits'))} "
+            f"({_fmt_us(_val(cur, P + 'native_pin_wait_ns') / 1e3):>7})  "
+            f"dlv depth {int(_val(cur, P + 'native_dlv_depth')):>4} "
+            f"stalls {int(_val(cur, P + 'native_dlv_stalls'))}  conns "
+            f"{int(_val(cur, P + 'native_conn_up') - _val(cur, P + 'native_conn_down'))}")
     # tpurpc-blackbox stalls/anomalies pane (/debug/stalls + trip counters)
     trips = int(_val(cur, P + "watchdog_trips"))
     errs = int(_sum_label(cur, P + "deadline_exceeded"))
