@@ -1191,6 +1191,97 @@ def _argus_overhead(duration: "float | None" = None, pairs: int = 3) -> dict:
     }
 
 
+def _diagnose_overhead(duration: "float | None" = None,
+                       pairs: int = 3) -> dict:
+    """tpurpc-oracle overhead gate (ISSUE 20): the causal diagnosis
+    engine armed — a tsdb sampler feeding the fine windows at 4 Hz plus
+    a background querier running the FULL ``diagnose_doc`` pipeline
+    (symptom scan, change-point detection over every series, all rules'
+    collect+score, noisy-OR combination) at 4 Hz — versus the same
+    closed loop with both stopped. ``diagnose_overhead_pct`` carries the
+    <3% acceptance gate. The engine is pull-only (the `diag` lint rule
+    enforces read-only evidence collection), so its cost is pure reader
+    contention on the planes' locks — exactly what this gate prices.
+    Same alternation and best-draw-p50 methodology as _obs_overhead."""
+    import io
+    import threading
+
+    from tpurpc.bench import micro
+    from tpurpc.obs import diagnose as _dz
+    from tpurpc.obs import tsdb as _tsdb
+    from tpurpc.utils import stats as _st
+
+    if duration is None:
+        duration = float(os.environ.get("TPURPC_BENCH_OBS_S", "1.0"))
+    prev_fast = os.environ.get("TPURPC_NATIVE_FAST_UNARY")
+    os.environ["TPURPC_NATIVE_FAST_UNARY"] = "0"
+    srv = micro.run_server(0, max_workers=8)
+    target = f"127.0.0.1:{srv.bench_port}"
+    devnull = io.StringIO()
+    p50s = {"off": [], "on": []}
+    runs = {"n": 0}
+
+    db = _tsdb.Tsdb(fine_s=0.25)
+    stop_ev = threading.Event()
+    worker = {"t": None}
+
+    def query_loop():
+        while not stop_ev.wait(0.25):
+            try:
+                _dz.diagnose_doc({})
+                runs["n"] += 1
+            except Exception:
+                pass
+
+    def leg(key, dur):
+        if key == "on":
+            db.start()
+            stop_ev.clear()
+            worker["t"] = threading.Thread(target=query_loop, daemon=True)
+            worker["t"].start()
+        try:
+            r = micro.run_client(target, req_size=64, duration=dur,
+                                 out=devnull)
+            p50s[key].append(r["rtt_us"]["p50"])
+        finally:
+            if key == "on":
+                stop_ev.set()
+                if worker["t"] is not None:
+                    worker["t"].join(timeout=2.0)
+                db.stop()
+
+    try:
+        micro.run_client(target, req_size=64, duration=0.3,
+                         out=devnull)  # warm: connect + first-dispatch
+        for i in range(max(1, pairs)):
+            legs = ["off", "on"]
+            if i % 2:
+                legs.reverse()
+            for key in legs:
+                leg(key, duration)
+    finally:
+        stop_ev.set()
+        db.stop()
+        if prev_fast is None:
+            os.environ.pop("TPURPC_NATIVE_FAST_UNARY", None)
+        else:
+            os.environ["TPURPC_NATIVE_FAST_UNARY"] = prev_fast
+        srv.stop(grace=0)
+        _st.reset_batch_stats()
+
+    off = min(p50s["off"])
+    on = min(p50s["on"])
+    gate = round((on - off) / off * 100, 2) if off else 0.0
+    return {
+        "diagnose_overhead_pct": gate,
+        "diagnose_overhead_gate_pct": 3.0,
+        "diagnose_overhead_pass": gate < 3.0,
+        "diagnose_queries_run": runs["n"],
+        "diagnose_p50_us": {k: [round(x, 1) for x in sorted(v)]
+                            for k, v in p50s.items()},
+    }
+
+
 def _fleet_bench() -> dict:
     """tpurpc-fleet benches (ISSUE 6), in-process, seconds each:
 
@@ -2685,6 +2776,14 @@ def main() -> None:
         except Exception as exc:
             sys.stderr.write(f"argus overhead gate failed: {exc}\n")
             out["argus_overhead_error"] = repr(exc)
+        # tpurpc-oracle (ISSUE 20): the full diagnosis pipeline querying
+        # at 4 Hz (change-point scan + every rule) vs idle; <3% gate —
+        # asking "why" must cost nothing measurable.
+        try:
+            out.update(_diagnose_overhead())
+        except Exception as exc:
+            sys.stderr.write(f"diagnose overhead gate failed: {exc}\n")
+            out["diagnose_overhead_error"] = repr(exc)
     # tpurpc-fleet (ISSUE 6): fleet_qps / fleet_p99_degraded_pct (hedging
     # on-vs-off with one slow replica) / shed_curve (admission gate vs
     # offered load). In-process, ~10s total.

@@ -1692,3 +1692,65 @@ def test_tprobs_native_tree_is_clean():
     discipline — the same bar the `flight` rule holds the Python plane to."""
     vs = lint_native_tree()
     assert vs == [], list(map(str, vs))
+
+
+# -- diag: evidence rules are read-only (tpurpc-oracle, ISSUE 20) ------------
+
+DIAG_MUTATING = '''
+def _collect_widget(planes):
+    flight.emit(LEASE_RESERVE, tag, 1)
+    return [("flight", "x", 1)]
+
+def _score_widget(facts, planes):
+    c.inc()
+    return 0.5
+'''
+
+DIAG_CLEAN = '''
+def _collect_widget(planes):
+    ev = planes.flight_events()
+    wins = planes.windows()
+    seen = set()           # builtin set() is not the mutator set()
+    return [("flight", e["event"], e["a1"]) for e in ev if ev]
+
+def helper_outside_rule():
+    flight.emit(1, 2, 3)   # not a _collect_*/_score_* function
+'''
+
+
+def test_diag_mutating_collect_and_score_flagged():
+    vs = [v for v in lint_source(DIAG_MUTATING, "tpurpc/obs/diagnose.py")
+          if v.rule == "diag"]
+    assert len(vs) == 2
+    assert "read-only" in vs[0].message and "emit()" in vs[0].message
+    assert "inc()" in vs[1].message
+
+
+def test_diag_clean_rule_and_non_rule_function_pass():
+    assert [v for v in lint_source(DIAG_CLEAN, "tpurpc/obs/diagnose.py")
+            if v.rule == "diag"] == []
+
+
+def test_diag_scoped_to_diagnose_module():
+    assert [v for v in lint_source(DIAG_MUTATING, "tpurpc/obs/other.py")
+            if v.rule == "diag"] == []
+
+
+def test_diag_suppression_comment():
+    ok = DIAG_MUTATING.replace(
+        "flight.emit(LEASE_RESERVE, tag, 1)",
+        "flight.emit(LEASE_RESERVE, tag, 1)  # tpr: allow(diag)")
+    vs = [v for v in lint_source(ok, "tpurpc/obs/diagnose.py")
+          if v.rule == "diag"]
+    assert len(vs) == 1 and "inc()" in vs[0].message
+
+
+def test_diagnose_module_is_diag_flight_and_block_clean():
+    """The real engine holds its own bar: read-only evidence rules,
+    pure-int flight discipline, and no unbounded blocking on the
+    dispatch-path functions."""
+    import tpurpc.obs.diagnose as dz
+    path = dz.__file__
+    with open(path, "r", encoding="utf-8") as f:
+        vs = lint_source(f.read(), path)
+    assert vs == [], list(map(str, vs))

@@ -1,0 +1,536 @@
+"""tpurpc-oracle (ISSUE 20): the causal diagnosis engine.
+
+Covers the tentpole's three layers — change-point detection (pinned
+math: mean-shift split, reset-aware counter deltas, noise floor), the
+declarative rule registry (read-only collect + score over the Planes
+interface), and ranked noisy-OR hypothesis combination — plus every
+face: the live ``/debug/diagnose`` route through the real scrape
+dispatch, the shard and fleet merges, bundle replay parity (the frozen
+planes rank the same cause the live engine ranked), and the
+``TPURPC_DIAGNOSE=0`` off-switch. Three induced fault classes must come
+out rank-1 correct: credit-starvation (held send-lease), device-infer
+(slow peer: in-flight client call, quiet transport), and a frozen
+native ctrl ring (synthesized planes here; the REAL
+TPURPC_TEST_FREEZE_NCTRL freeze runs in tools/diagnose_smoke.py, wired
+into check.sh).
+"""
+
+import json
+import time
+
+import pytest
+
+from tpurpc.obs import bundle as obs_bundle
+from tpurpc.obs import diagnose, flight, scrape
+from tpurpc.obs import tsdb as obs_tsdb
+from tpurpc.obs import watchdog
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    flight.RECORDER.reset()
+    # a fresh tsdb: earlier tests' series (decode schedulers, benches…)
+    # would otherwise feed this diagnosis real-looking onsets
+    obs_tsdb.postfork_reset()
+    wd = watchdog.get()
+    wd.reset()
+    prev = (wd.min_stall_s, wd.sweep_s, wd.mult, wd.enabled)
+    yield
+    obs_bundle.disable()
+    wd.min_stall_s, wd.sweep_s, wd.mult, wd.enabled = prev
+    wd.reset()
+    flight.RECORDER.reset()
+
+
+def _fast_wd():
+    wd = watchdog.get()
+    wd.enabled = True
+    wd.min_stall_s = 0.01
+    wd.sweep_s = 0.05
+    return wd
+
+
+def _top(doc):
+    hyps = doc.get("hypotheses") or []
+    return hyps[0]["cause"] if hyps else None
+
+
+# ---------------------------------------------------------------------------
+# change-point detection: the math is pinned
+# ---------------------------------------------------------------------------
+
+def test_onset_step_function_found_at_exact_index():
+    pts = [(i * 1000, 0.0) for i in range(16)]
+    pts += [(i * 1000, 10.0) for i in range(16, 32)]
+    onset = diagnose.detect_onset(pts)
+    assert onset is not None
+    assert onset["index"] == 16          # FIRST point of the right segment
+    assert onset["t_ns"] == 16_000
+    assert onset["direction"] == 1
+    assert onset["magnitude"] == pytest.approx(10.0)
+    assert onset["score"] >= diagnose.MIN_SCORE
+
+
+def test_onset_falling_step_has_negative_direction():
+    pts = [(i, 8.0) for i in range(12)] + [(i, 1.0) for i in range(12, 24)]
+    onset = diagnose.detect_onset(pts)
+    assert onset["direction"] == -1
+    assert onset["magnitude"] == pytest.approx(-7.0)
+
+
+def test_onset_constant_and_noise_series_return_none():
+    assert diagnose.detect_onset([(i, 5.0) for i in range(32)]) is None
+    # alternating jitter has no single split beating the noise floor
+    jitter = [(i, 5.0 + (0.1 if i % 2 else -0.1)) for i in range(32)]
+    assert diagnose.detect_onset(jitter) is None
+
+
+def test_onset_too_few_points_is_inadmissible():
+    pts = [(i, 0.0) for i in range(3)] + [(i, 9.0) for i in range(3, 6)]
+    assert diagnose.detect_onset(pts) is None
+
+
+def test_onset_counter_series_diffed_before_split():
+    # raw counter: +1/step for 16 steps then +10/step — the SHIFT is in
+    # the rate, invisible to a raw mean split over the ramp
+    vals = []
+    v = 0.0
+    for i in range(32):
+        v += 1.0 if i < 16 else 10.0
+        vals.append((i * 10, v))
+    onset = diagnose.detect_onset(vals, kind="counter")
+    assert onset is not None and onset["direction"] == 1
+    assert onset["magnitude"] == pytest.approx(9.0, abs=0.5)
+
+
+def test_onset_counter_reset_cannot_fake_a_cliff():
+    # a restart (counter falls back to ~0 and re-climbs at the same
+    # rate) must NOT read as an onset: the post-reset value IS the delta
+    pts = [(i, float(i)) for i in range(16)]
+    pts += [(16 + i, float(i)) for i in range(16)]
+    assert diagnose.detect_onset(pts, kind="counter") is None
+
+
+def test_series_shifts_scans_every_series():
+    wins = {
+        "flat": [(i, 1.0) for i in range(16)],
+        "step": [(i, 0.0) for i in range(12)] + [(i, 6.0)
+                                                 for i in range(12, 24)],
+    }
+    shifts = diagnose.series_shifts(wins, {"flat": "gauge",
+                                           "step": "gauge"})
+    assert set(shifts) == {"step"}
+
+
+# ---------------------------------------------------------------------------
+# rule registry + combination
+# ---------------------------------------------------------------------------
+
+def test_registry_carries_the_six_stock_rules():
+    names = [r.name for r in diagnose.rules()]
+    for want in ("watchdog-stage", "flight-edges", "tsdb-shift",
+                 "lens-hop", "seq-ledger", "native-counters"):
+        assert want in names
+
+
+def test_register_and_symptom_kind_gating():
+    ran = []
+
+    def collect(planes, symptom):
+        ran.append(symptom["kind"])
+        return None
+
+    rule = diagnose.Rule("test-gated", ("query",), collect,
+                         lambda f, p, s: [])
+    diagnose.register(rule)
+    try:
+        planes = diagnose.Planes()
+        diagnose.diagnose(planes, want="why slow")     # kind=query: runs
+        assert ran == ["query"]
+    finally:
+        diagnose._RULES.remove(rule)
+
+
+def test_combine_noisy_or_and_evidence_dedup():
+    hyps = [
+        diagnose.Hypothesis("credit-starvation", 0.6,
+                            [("flight", "lease", 1)], rule="a"),
+        diagnose.Hypothesis("credit-starvation", 0.5,
+                            [("flight", "lease", 1),
+                             ("tsdb", "credit@9", -3)], rule="b"),
+        diagnose.Hypothesis("other", 0.3, [("x", "y", 0)], rule="a"),
+    ]
+    out = diagnose._combine(hyps)
+    assert out[0]["cause"] == "credit-starvation"
+    assert out[0]["confidence"] == pytest.approx(1 - 0.4 * 0.5, abs=1e-3)
+    assert out[0]["rules"] == ["a", "b"]
+    # (flight, lease) cited twice dedups to one evidence row
+    assert out[0]["evidence"] == [["flight", "lease", 1],
+                                  ["tsdb", "credit@9", -3]]
+    assert out[0]["actionable"]  # every ranked cause ships its hint
+
+
+def test_combine_confidence_capped_under_certainty():
+    hyps = [diagnose.Hypothesis("x", 0.99, rule="a"),
+            diagnose.Hypothesis("x", 0.99, rule="b")]
+    assert diagnose._combine(hyps)[0]["confidence"] <= 0.99
+
+
+def test_broken_rule_never_breaks_the_report():
+    rule = diagnose.Rule(
+        "test-broken", (),
+        lambda p, s: (_ for _ in ()).throw(RuntimeError("boom")),
+        lambda f, p, s: [])
+    diagnose.register(rule)
+    try:
+        wd = _fast_wd()
+        tok = wd.call_started("/t/M")
+        time.sleep(3 * wd.min_stall_s)
+        wd.sweep_once()
+        doc = diagnose.diagnose(diagnose.LivePlanes())
+        assert doc["hypotheses"]          # the other rules still ran
+        wd.call_finished(tok)
+    finally:
+        diagnose._RULES.remove(rule)
+
+
+# ---------------------------------------------------------------------------
+# induced faults: rank-1 correct
+# ---------------------------------------------------------------------------
+
+def test_fault_credit_starvation_ranks_first():
+    wd = _fast_wd()
+    tag = flight.tag_for("pair:diagtest")
+    flight.emit(flight.LEASE_RESERVE, tag, 4096)
+    tok = wd.call_started("/diag/Wedged")
+    try:
+        time.sleep(3 * wd.min_stall_s)
+        wd.sweep_once()
+        doc = diagnose.diagnose(diagnose.LivePlanes())
+        assert doc["symptom"]["stage"] == "credit-starvation"
+        assert _top(doc) == "credit-starvation"
+        top = doc["hypotheses"][0]
+        # independent planes corroborate: watchdog stage + flight edge
+        assert {"watchdog-stage", "flight-edges"} <= set(top["rules"])
+        assert top["confidence"] > 0.9
+        assert any(p == "flight" for p, _r, _v in top["evidence"])
+        assert "ring" in top["actionable"].lower() \
+            or "shed" in top["actionable"].lower()
+    finally:
+        wd.call_finished(tok)
+        flight.emit(flight.LEASE_COMMIT, tag, 4096)
+
+
+def test_fault_device_infer_ranks_first():
+    wd = _fast_wd()
+    tok = wd.call_started("/diag/SlowPeer", kind="client")
+    try:
+        time.sleep(3 * wd.min_stall_s)
+        wd.sweep_once()
+        doc = diagnose.diagnose(diagnose.LivePlanes())
+        assert doc["symptom"]["stage"] == "device-infer"
+        assert _top(doc) == "device-infer"
+        assert "fleet" in doc["hypotheses"][0]["actionable"]
+    finally:
+        wd.call_finished(tok)
+
+
+class _FrozenNctrlPlanes(diagnose.Planes):
+    """The native-ctrl-frozen fault as frozen planes: an active watchdog
+    diagnosis plus an aged native-lane ctrl-stall bracket — exactly what
+    the live planes show under a real TPURPC_TEST_FREEZE_NCTRL freeze
+    (tools/diagnose_smoke.py induces the real one)."""
+
+    NOW = 200_000_000_000
+
+    def now_ns(self):
+        return self.NOW
+
+    def watchdog(self):
+        return {"active": [{
+            "stage": "native-ctrl-frozen", "method": "/m/Bulk",
+            "kind": "client", "age_s": 4.2, "since_ns": self.NOW - int(4.2e9),
+            "cause": {"stage": "native-ctrl-frozen", "entity": "conn-7",
+                      "evidence": [["flight", "nctrl-ring-full:conn-7", 4.1]]},
+        }], "history": []}
+
+    def flight_events(self):
+        return [{"t_ns": self.NOW - 4_000_000_000,
+                 "code": flight.CTRL_STALL_BEGIN, "event": "ctrl-stall",
+                 "tag": 1, "entity": "conn-7", "tid": 1, "a1": 8, "a2": 0,
+                 "lane": "native"}]
+
+
+def test_fault_frozen_native_ctrl_ranks_first():
+    doc = diagnose.diagnose(_FrozenNctrlPlanes())
+    assert doc["symptom"]["stage"] == "native-ctrl-frozen"
+    assert _top(doc) == "native-ctrl-frozen"
+    top = doc["hypotheses"][0]
+    assert {"watchdog-stage", "flight-edges"} <= set(top["rules"])
+    assert "restart" in top["actionable"]
+
+
+def test_fresh_flight_edges_are_traffic_not_wedges():
+    """A bracket open for <1s is in-flight traffic; only AGED edges are
+    evidence (otherwise every healthy bulk send diagnoses as a wedge)."""
+    class Fresh(_FrozenNctrlPlanes):
+        def watchdog(self):
+            return {}
+
+        def flight_events(self):
+            return [{"t_ns": self.NOW - 100_000_000,   # 0.1s old
+                     "code": flight.CTRL_STALL_BEGIN, "event": "ctrl-stall",
+                     "tag": 1, "entity": "conn-7", "tid": 1, "a1": 8,
+                     "a2": 0, "lane": "native"}]
+
+    doc = diagnose.diagnose(Fresh(), want="anything wrong?")
+    assert all(h["cause"] != "native-ctrl-frozen"
+               for h in doc["hypotheses"])
+
+
+# ---------------------------------------------------------------------------
+# symptom resolution
+# ---------------------------------------------------------------------------
+
+def test_symptom_precedence_active_watchdog_beats_history():
+    class P(diagnose.Planes):
+        def watchdog(self):
+            return {"active": [{"stage": "kv-swap", "method": "/a"}],
+                    "history": [{"stage": "migration", "method": "/b"}]}
+
+    sym = diagnose.find_symptom(P())
+    assert sym["stage"] == "kv-swap" and sym["state"] == "active"
+
+
+def test_symptom_history_serves_the_bundle_replay_case():
+    class P(diagnose.Planes):
+        def watchdog(self):
+            return {"active": [],
+                    "history": [{"stage": "rendezvous", "method": "/b"}]}
+
+    sym = diagnose.find_symptom(P())
+    assert sym["stage"] == "rendezvous" and sym["state"] == "history"
+
+
+def test_symptom_operator_query_is_a_first_class_kind():
+    sym = diagnose.find_symptom(diagnose.Planes(), want="why is p99 up")
+    assert sym == {"kind": "query", "detail": "why is p99 up",
+                   "t_ns": None}
+
+
+def test_no_symptom_no_hypotheses():
+    doc = diagnose.diagnose(diagnose.Planes())
+    assert doc["symptom"] is None and doc["hypotheses"] == []
+
+
+# ---------------------------------------------------------------------------
+# faces: live route, off-switch, bundle replay, shard + fleet merge
+# ---------------------------------------------------------------------------
+
+def test_debug_diagnose_route_json_and_text():
+    wd = _fast_wd()
+    tag = flight.tag_for("pair:routetest")
+    flight.emit(flight.LEASE_RESERVE, tag, 64)
+    tok = wd.call_started("/diag/Route")
+    try:
+        time.sleep(3 * wd.min_stall_s)
+        wd.sweep_once()
+        status, ctype, body = scrape._route("/debug/diagnose")
+        assert status == 200 and "json" in ctype
+        doc = json.loads(body)
+        assert doc["enabled"] and _top(doc) == "credit-starvation"
+        status, ctype, body = scrape._route("/debug/diagnose?text=1")
+        assert status == 200 and ctype.startswith("text/plain")
+        text = body.decode()
+        assert "credit-starvation" in text and "#1" in text
+    finally:
+        wd.call_finished(tok)
+        flight.emit(flight.LEASE_COMMIT, tag, 64)
+
+
+def test_off_switch_disables_engine_and_bundle_dump(tmp_path,
+                                                    monkeypatch):
+    monkeypatch.setenv("TPURPC_DIAGNOSE", "0")
+    doc = diagnose.diagnose_doc({})
+    assert doc == {"enabled": False, "reason": "TPURPC_DIAGNOSE=0"}
+    assert "disabled" in diagnose.render_text(doc)
+    w = obs_bundle.enable(str(tmp_path), min_interval_s=0.0)
+    w.capture("manual", detail="off-switch")
+    names = obs_bundle.list_bundles(str(tmp_path))
+    assert names
+    assert not (tmp_path / names[-1] / "diagnosis.json").exists()
+
+
+def test_bundle_replay_parity_with_live(tmp_path):
+    """The acceptance core: the bundle frozen at trip time replays to
+    the same rank-1 cause the live engine reports."""
+    wd = _fast_wd()
+    tag = flight.tag_for("pair:paritytest")
+    flight.emit(flight.LEASE_RESERVE, tag, 128)
+    tok = wd.call_started("/diag/Parity")
+    try:
+        time.sleep(3 * wd.min_stall_s)
+        wd.sweep_once()
+        live = diagnose.diagnose(diagnose.LivePlanes())
+        w = obs_bundle.enable(str(tmp_path), min_interval_s=0.0)
+        w.capture("manual", detail="parity")
+        names = obs_bundle.list_bundles(str(tmp_path))
+        path = str(tmp_path / names[-1])
+        shipped = json.loads(
+            (tmp_path / names[-1] / "diagnosis.json").read_text())
+        replayed = diagnose.diagnose_bundle(path)
+        assert (_top(live) == _top(shipped) == _top(replayed)
+                == "credit-starvation")
+        assert replayed["bundle"] == names[-1]
+    finally:
+        wd.call_finished(tok)
+        flight.emit(flight.LEASE_COMMIT, tag, 128)
+
+
+def test_offline_cli_renders_bundle(tmp_path, capsys):
+    from tpurpc.tools import diagnose as diagnose_cli
+
+    wd = _fast_wd()
+    tag = flight.tag_for("pair:clitest")
+    flight.emit(flight.LEASE_RESERVE, tag, 64)
+    tok = wd.call_started("/diag/Cli")
+    try:
+        time.sleep(3 * wd.min_stall_s)
+        wd.sweep_once()
+        w = obs_bundle.enable(str(tmp_path), min_interval_s=0.0)
+        w.capture("manual", detail="cli")
+    finally:
+        wd.call_finished(tok)
+        flight.emit(flight.LEASE_COMMIT, tag, 64)
+    # pointed at the ROOT it resolves the newest bundle
+    assert diagnose_cli.main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "credit-starvation" in out and "bundle:" in out
+    assert diagnose_cli.main([str(tmp_path), "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert _top(doc) == "credit-starvation"
+
+
+def _doc(cause, conf, stage=None, state="active"):
+    sym = None
+    if stage:
+        sym = {"kind": "watchdog", "state": state, "stage": stage,
+               "method": "/m", "detail": None, "t_ns": 1}
+    return {"enabled": True, "symptom": sym,
+            "hypotheses": [{"cause": cause, "confidence": conf,
+                            "evidence": [["flight", "e", 1]],
+                            "rules": ["watchdog-stage"],
+                            "actionable": "act"}],
+            "onsets": {}, "rules_run": []}
+
+
+def test_merge_diagnose_docs_corroboration_and_ranking():
+    docs = {"0": _doc("credit-starvation", 0.6, stage="credit-starvation"),
+            "1": _doc("credit-starvation", 0.5),
+            "2": _doc("kv-swap", 0.9, stage="kv-swap", state="history")}
+    out = diagnose.merge_diagnose_docs(docs, label="shard")
+    assert out["enabled"] and out["sources"] == ["0", "1", "2"]
+    by = {h["cause"]: h for h in out["hypotheses"]}
+    # two shards citing the same cause compound past either alone
+    assert by["credit-starvation"]["confidence"] == pytest.approx(
+        1 - 0.4 * 0.5, abs=1e-3)
+    assert by["credit-starvation"]["sources"] == ["0", "1"]
+    assert out["corroboration"] == {"credit-starvation": ["0", "1"]}
+    # evidence rows are source-tagged
+    assert by["kv-swap"]["evidence"] == [["flight", "shard=2:e", 1]]
+    # the ACTIVE symptom outranks the history one
+    assert out["symptom"]["stage"] == "credit-starvation"
+
+
+def test_merge_diagnose_docs_skips_disabled_members():
+    docs = {"a": {"enabled": False},
+            "b": _doc("migration", 0.7, stage="migration")}
+    out = diagnose.merge_diagnose_docs(docs)
+    assert out["enabled"] and [h["cause"] for h in out["hypotheses"]] \
+        == ["migration"]
+    empty = diagnose.merge_diagnose_docs({"a": {"enabled": False}})
+    assert not empty["enabled"] and empty["hypotheses"] == []
+
+
+def test_collector_fleet_diagnose_merge():
+    from tpurpc.obs.collector import FleetCollector
+
+    col = FleetCollector(["h1:1", "h2:2", "h3:3"], poll_s=0.1)
+    for t, doc in (("h1:1", _doc("rendezvous", 0.6, stage="rendezvous")),
+                   ("h2:2", _doc("rendezvous", 0.5)),
+                   ("h3:3", None)):
+        m = col._members[t]
+        m.metrics_text = "tpurpc_x 1\n"
+        m.diagnose = doc
+        m.misses = 0
+        m.polls += 1
+        m.last_ok_mono = time.monotonic()
+    out = col.merged_diagnose()
+    assert out["enabled"]
+    assert _top(out) == "rendezvous"
+    assert out["corroboration"] == {"rendezvous": ["h1:1", "h2:2"]}
+    assert out["members"] == {"h1:1": "up", "h2:2": "up", "h3:3": "up"}
+    assert out["degraded"] == ["h1:1"]   # only h1 reports a symptom
+    # evidence carries the member tag
+    by = {h["cause"]: h for h in out["hypotheses"]}
+    assert by["rendezvous"]["evidence"][0][1].startswith("member=h1:1:")
+
+
+def test_render_text_cites_evidence_and_action():
+    doc = _doc("credit-starvation", 0.8, stage="credit-starvation")
+    text = diagnose.render_text(doc)
+    assert "symptom [watchdog] credit-starvation" in text
+    assert "#1 credit-starvation" in text
+    assert "[flight] e = 1" in text
+    assert "-> act" in text
+
+
+# ---------------------------------------------------------------------------
+# watchdog structured causes (satellite a): objects under the same prose
+# ---------------------------------------------------------------------------
+
+def test_watchdog_diag_carries_structured_cause():
+    wd = _fast_wd()
+    tag = flight.tag_for("pair:structtest")
+    flight.emit(flight.LEASE_RESERVE, tag, 77)
+    tok = wd.call_started("/diag/Struct")
+    try:
+        time.sleep(3 * wd.min_stall_s)
+        diags = wd.sweep_once()
+        d = next(x for x in diags if x["method"] == "/diag/Struct")
+        cause = d["cause"]
+        assert cause["stage"] == d["stage"] == "credit-starvation"
+        assert cause["evidence"], "structured cause cites no evidence"
+        plane, ref, _v = cause["evidence"][0]
+        assert plane == "flight" and "lease-reserve-open" in ref
+        # the prose face is still the prose face
+        assert "send-lease held" in d["detail"]
+    finally:
+        wd.call_finished(tok)
+        flight.emit(flight.LEASE_COMMIT, tag, 77)
+
+
+def test_watchdog_retrips_once_per_distinct_stage():
+    """A stall that SHARPENS (rendezvous -> native-ctrl-frozen as the C
+    evidence lands) must re-trip so the trip-time bundle carries the
+    sharper diagnosis — but the same stage never trips twice."""
+    wd = _fast_wd()
+    trips = []
+    hook = lambda diag: trips.append(diag["stage"])  # noqa: E731
+    watchdog.add_trip_hook(hook)
+    tag = flight.tag_for("pair:retrip")
+    tok = wd.call_started("/diag/Retrip")
+    try:
+        time.sleep(3 * wd.min_stall_s)
+        wd.sweep_once()
+        wd.sweep_once()                      # same stage: no second trip
+        assert trips == ["device-infer"]
+        flight.emit(flight.LEASE_RESERVE, tag, 9)   # evidence sharpens
+        wd.sweep_once()
+        assert trips == ["device-infer", "credit-starvation"]
+        wd.sweep_once()
+        assert trips == ["device-infer", "credit-starvation"]
+    finally:
+        watchdog.remove_trip_hook(hook)
+        wd.call_finished(tok)
+        flight.emit(flight.LEASE_COMMIT, tag, 9)

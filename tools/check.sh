@@ -189,6 +189,18 @@ note "tpurpc-argus smoke (slo burn-rate -> fleet collector -> bundle)"
 TPURPC_FLIGHT_DUMP="$FLIGHT_DUMPS" python -m tpurpc.tools.argus_smoke \
     || fail=1
 
+# 2g3b2) tpurpc-oracle diagnose smoke (ISSUE 20): three induced fault
+#      classes (open send-lease -> credit-starvation, quiet-transport
+#      slow peer -> device-infer, TPURPC_TEST_FREEZE_NCTRL frozen C
+#      consumer -> native-ctrl-frozen) — for each, the live
+#      /debug/diagnose route must rank the injected cause #1 with cited
+#      evidence, the watchdog trip must auto-capture a bundle whose
+#      diagnosis.json agrees, and replaying that bundle offline through
+#      tpurpc.tools.diagnose must return the identical verdict. ~10s.
+note "tpurpc-oracle diagnose smoke (induced faults -> rank-1 live == bundle replay)"
+TPURPC_FLIGHT_DUMP="$FLIGHT_DUMPS" python -m tpurpc.tools.diagnose_smoke \
+    || fail=1
+
 # 2g3c) tpurpc-hive scale smoke (ISSUE 16): thousands of parked pairs in
 #      one process (fd-budget capped toward the 5000-pair target) — every
 #      parked pair must shed its rings to the shared RingPool (accounting

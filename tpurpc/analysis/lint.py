@@ -100,6 +100,16 @@ hand (ISSUE 2) and that no general-purpose linter knows about:
   :func:`lint_native_tree` (the CLI's default pass includes it);
   deliberate exceptions carry ``// tpr: allow(tpr-obs)``.
 
+* ``diag``     — read-only diagnosis (tpurpc-oracle, ISSUE 20): the
+  evidence-rule functions in ``obs/diagnose.py`` (``_collect_*`` /
+  ``_score_*``) may only READ the telemetry planes. A counter bump, a
+  flight emit, a trip, a capture, or a tag intern from inside a
+  diagnosis mutates the very evidence the next diagnosis reads — the
+  observer effect as a bug class. Banned callee names inside those
+  functions: ``inc``/``dec``/``set``/``observe``/``record``/``emit``/
+  ``capture``/``external_trip``/``tag_for``/``sample_once``/``reset``/
+  ``clamp``. Deliberate exceptions carry ``# tpr: allow(diag)``.
+
 Suppression grammar: a line comment ``# tpr: allow(<rule>)`` disables that
 rule for its line. The hot-path modules are expected to carry NO ``copy``
 suppressions — a copy on the data plane is either fixed or it is a finding.
@@ -174,6 +184,11 @@ FLIGHT_HOT_MODULES = HOT_LOG_MODULES + (
     os.path.join("tpurpc", "obs", "slo.py"),
     os.path.join("tpurpc", "obs", "bundle.py"),
     os.path.join("tpurpc", "obs", "collector.py"),
+    # tpurpc-oracle (ISSUE 20): the diagnosis engine is read-only by
+    # contract (the `diag` rule) — but keeping it under the flight
+    # pure-int discipline means any future emission site added here
+    # inherits the interned-tag contract instead of silently regressing
+    os.path.join("tpurpc", "obs", "diagnose.py"),
 )
 
 #: module suffix -> qualified functions on its INLINE DISPATCH path (the
@@ -210,6 +225,17 @@ INLINE_DISPATCH_PATH: Dict[str, Tuple[str, ...]] = {
         "DecodeScheduler._prefill_batch",
         "DecodeScheduler._run_step",
     ),
+    # tpurpc-oracle (ISSUE 20): the diagnosis engine runs inside scrape
+    # dispatch, watchdog trip hooks, and the bundle writer — a diagnosis
+    # that parks unbounded wedges the very sweep that called it
+    os.path.join("tpurpc", "obs", "diagnose.py"): (
+        "detect_onset",
+        "series_shifts",
+        "find_symptom",
+        "diagnose",
+        "diagnose_doc",
+        "_combine",
+    ),
 }
 
 #: the CROSS-PROCESS modules (ISSUE 17): every wire effect these emit —
@@ -244,6 +270,7 @@ _ALLOW_RE = re.compile(r"#\s*tpr:\s*allow\(([a-z_,\s]+)\)")
 KNOWN_RULES = frozenset({
     "lease", "copy", "lock", "wallclock", "block", "log", "shard",
     "flight", "stage", "rdv", "kv", "rawlock", "ringpool", "xproc",
+    "diag",
 })
 
 #: suppression-audit mode: when True, ``_allowed_rules`` answers empty —
@@ -1456,6 +1483,59 @@ def lint_native_tree(root: Optional[str] = None) -> List[LintViolation]:
 
 # -- driver ------------------------------------------------------------------
 
+# -- rule: diag --------------------------------------------------------------
+
+# Callee names that mutate a telemetry plane. Matched by name (Attribute
+# attr or bare Name) because the evidence rules reach planes through the
+# Planes facade and module handles — a cheap syntactic net that catches
+# the real mutators (Counter.inc, flight.emit, watchdog.external_trip,
+# tag_for interning, bundle capture) without a type system.
+_DIAG_MUTATORS = frozenset({
+    "inc", "dec", "set", "observe", "record", "emit", "capture",
+    "external_trip", "tag_for", "sample_once", "reset", "clamp",
+})
+# Bare-name calls that are common builtins share names with mutators
+# ("set" the constructor) — only these bare names count as mutation.
+_DIAG_BARE_MUTATORS = frozenset({"emit", "tag_for", "external_trip"})
+
+
+def _check_diag(tree: ast.AST, path: str,
+                lines: Sequence[str]) -> List[LintViolation]:
+    """Evidence rules (``_collect_*`` / ``_score_*``) must only READ the
+    planes: a diagnosis that emits, bumps, trips or interns mutates the
+    evidence the next diagnosis reads (the observer effect as a bug)."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not (node.name.startswith("_collect_")
+                or node.name.startswith("_score_")):
+            continue
+        for call in ast.walk(node):
+            if not isinstance(call, ast.Call):
+                continue
+            f = call.func
+            if isinstance(f, ast.Attribute):
+                bad = f.attr in _DIAG_MUTATORS
+            elif isinstance(f, ast.Name):
+                bad = f.id in _DIAG_BARE_MUTATORS
+            else:
+                bad = False
+            if not bad:
+                continue
+            if "diag" in _allowed_rules(lines, call.lineno):
+                continue
+            name = f.attr if isinstance(f, ast.Attribute) else f.id
+            out.append(LintViolation(
+                path, call.lineno, call.col_offset, "diag",
+                f"{node.name} is an evidence rule and must be read-only, "
+                f"but calls {name}(): mutating a telemetry plane from "
+                "inside a diagnosis corrupts the evidence the next "
+                "diagnosis reads — collect facts, return them; a "
+                "deliberate exception carries '# tpr: allow(diag)'"))
+    return out
+
+
 def lint_source(source: str, path: str,
                 hot_copy: Optional[bool] = None,
                 hot_log: Optional[bool] = None,
@@ -1492,6 +1572,8 @@ def lint_source(source: str, path: str,
     for suffix, fns in INLINE_DISPATCH_PATH.items():
         if norm.endswith(suffix.replace(os.sep, "/")):
             out.extend(_check_block(tree, path, lines, frozenset(fns)))
+    if norm.endswith("tpurpc/obs/diagnose.py"):
+        out.extend(_check_diag(tree, path, lines))
     out.extend(_check_locks(tree, path, lines))
     out.extend(_check_shard(tree, path, lines))
     out.extend(_check_stage(tree, path, lines))

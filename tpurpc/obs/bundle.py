@@ -20,6 +20,10 @@ to disk at the moment of degradation:
         history.json        tsdb series windows bracketing the event
         slo.json            objective/track states + transition history
         stalls.json         watchdog snapshot (active + history)
+        diagnosis.json      tpurpc-oracle ranked causal hypotheses at
+                            capture time (the same report
+                            `python -m tpurpc.tools.diagnose <dir>`
+                            recomputes offline)
         meta.json           trigger, detail, stamps, cap accounting
 
 Every sibling file is a JSON *object* (or plain text), so a directory
@@ -164,11 +168,17 @@ class BundleWriter:
 
             db = _tsdb.get()
             span = db.fine_window_s
-            hist = {"window_s": span, "grain_s": db.fine_s, "series": {}}
-            for s in sorted(db.series()):
+            kinds = db.series()
+            hist = {"window_s": span, "grain_s": db.fine_s, "series": {},
+                    # tpurpc-oracle: series kinds ride along so the
+                    # offline replay applies the same reset-aware delta
+                    # transform the live change-point scan uses
+                    "kinds": {}}
+            for s in sorted(kinds):
                 pts = db.window(s, span)
                 if pts:
                     hist["series"][s] = [[t, v] for t, v in pts]
+                    hist["kinds"][s] = kinds[s]
             self._dump(path, "history.json", hist)
         except Exception:
             pass
@@ -183,6 +193,17 @@ class BundleWriter:
             from tpurpc.obs import watchdog as _watchdog
 
             self._dump(path, "stalls.json", _watchdog.get().snapshot())
+        except Exception:
+            pass
+        # 7) tpurpc-oracle: the diagnosis AT CAPTURE TIME — the ranked
+        #    hypotheses for the trip that caused this bundle (a JSON
+        #    object, no top-level "events": protocol walks stay clean)
+        try:
+            from tpurpc.obs import diagnose as _diagnose
+
+            if _diagnose.enabled():
+                self._dump(path, "diagnosis.json",
+                           _diagnose.diagnose(_diagnose.LivePlanes()))
         except Exception:
             pass
         meta = {

@@ -18,6 +18,10 @@ merged views:
 * ``GET /fleet/slo``      — every member's ``/debug/slo`` document plus a
   flat ``alerts`` list (each alert tagged with its member) — the fleet
   pager's one stop;
+* ``GET /fleet/diagnose`` — every member's causal diagnosis report
+  (tpurpc-oracle, ISSUE 20) merged: hypotheses re-combined by cause
+  across members, a ``corroboration`` map naming which members cite
+  each cause, and the ``degraded`` member list;
 * ``GET /fleet/timeline`` — one Perfetto chrome-trace for the whole
   fleet, reusing :mod:`tpurpc.tools.timeline`'s clock-anchor rebase
   (members' monotonic clocks aligned on their exported anchors);
@@ -78,7 +82,8 @@ def resolve_targets(specs: List[str]) -> List[str]:
 
 class _Member:
     __slots__ = ("target", "metrics_text", "slo", "flight", "anchor",
-                 "seq", "last_ok_mono", "polls", "misses", "resets_seen")
+                 "seq", "diagnose", "last_ok_mono", "polls", "misses",
+                 "resets_seen")
 
     def __init__(self, target: str):
         self.target = target
@@ -87,6 +92,7 @@ class _Member:
         self.flight: Optional[dict] = None
         self.anchor: Optional[dict] = None
         self.seq: Optional[dict] = None
+        self.diagnose: Optional[dict] = None
         self.last_ok_mono = 0.0
         self.polls = 0
         self.misses = 0
@@ -135,6 +141,7 @@ class FleetCollector:
             flight_raw = self._fetch(target, "/debug/flight")
             traces_raw = self._fetch(target, "/traces")
             seq_raw = self._fetch(target, "/debug/seq")
+            diag_raw = self._fetch(target, "/debug/diagnose")
             with self._lock:
                 m.misses = 0
                 m.last_ok_mono = time.monotonic()
@@ -142,6 +149,7 @@ class FleetCollector:
                 m.slo = _loads(slo_raw)
                 m.flight = _loads(flight_raw)
                 m.seq = _loads(seq_raw)
+                m.diagnose = _loads(diag_raw)
                 traces = _loads(traces_raw) or {}
                 m.anchor = (traces.get("clock_anchor")
                             or _first_anchor(traces))
@@ -305,6 +313,28 @@ class FleetCollector:
         out["members"] = {t: state for t, state, _d in snap}
         return out
 
+    # -- /fleet/diagnose (tpurpc-oracle, ISSUE 20) ----------------------------
+
+    def merged_diagnose(self) -> dict:
+        """The fleet-wide causal view: every UP member's /debug/diagnose
+        merged through the same pure merge the shard fan-out uses —
+        hypotheses re-combined by cause, evidence member-tagged, and a
+        ``corroboration`` map naming which members cite each cause ("3
+        members degraded, all cite the same peer" is one dict lookup)."""
+        from tpurpc.obs.diagnose import merge_diagnose_docs
+
+        with self._lock:
+            snap = [(m.target, self.member_state(m), m.diagnose)
+                    for m in self._members.values()]
+        docs = {t: doc for t, state, doc in snap
+                if state == "up" and doc}
+        out = merge_diagnose_docs(docs, label="member")
+        out["members"] = {t: state for t, state, _d in snap}
+        out["degraded"] = sorted(
+            t for t, state, doc in snap
+            if state == "up" and doc and doc.get("symptom"))
+        return out
+
     # -- /fleet/timeline ------------------------------------------------------
 
     def timeline(self) -> dict:
@@ -333,6 +363,9 @@ class FleetCollector:
         if route in ("/fleet/seq", "/fleet/seq/"):
             return (200, "application/json",
                     json.dumps(self.merged_seq(), indent=1).encode())
+        if route in ("/fleet/diagnose", "/fleet/diagnose/"):
+            return (200, "application/json",
+                    json.dumps(self.merged_diagnose(), indent=1).encode())
         if route in ("/fleet/timeline", "/fleet/timeline/"):
             try:
                 return (200, "application/json",
@@ -346,7 +379,7 @@ class FleetCollector:
             return 200, "application/json", json.dumps(doc).encode()
         return (404, "text/plain",
                 b"tpurpc-collector: /fleet/metrics /fleet/slo /fleet/seq "
-                b"/fleet/timeline /healthz\n")
+                b"/fleet/diagnose /fleet/timeline /healthz\n")
 
     def serve(self, host: str = "127.0.0.1", port: int = 0) -> int:
         """Start polling + the HTTP face; returns the bound port."""

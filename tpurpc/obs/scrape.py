@@ -34,6 +34,9 @@ Routes::
     /debug/history tpurpc-argus ring tsdb: bounded two-tier metric history
                    (?series=NAME&window_s=S for points, bare = inventory)
     /debug/slo     tpurpc-argus SLO objectives, burn rates, alert states
+    /debug/diagnose  tpurpc-oracle causal diagnosis: ranked hypotheses with
+                   cited evidence for the current symptom (?symptom= pins
+                   one, ?text=1 for the prose report)
 
 tpurpc-argus (ISSUE 14): ``/healthz?json=1`` answers the STRUCTURED body
 (:func:`healthz_doc`) — status plus one ``degraded_reasons`` list where
@@ -425,6 +428,18 @@ def route_local(path: str) -> Tuple[int, str, bytes]:
         params = _query_params(query)
         return (200, "application/json",
                 json.dumps(_odyssey.seq_doc(params), indent=1).encode())
+    if route in ("/debug/diagnose", "/debug/diagnose/"):
+        # tpurpc-oracle (ISSUE 20): ranked causal hypotheses for the
+        # current symptom (?symptom= pins one; ?text=1 the prose face)
+        from tpurpc.obs import diagnose as _diagnose
+
+        params = _query_params(query)
+        doc = _diagnose.diagnose_doc(params)
+        if params.get("text"):
+            return (200, "text/plain",
+                    _diagnose.render_text(doc).encode())
+        return (200, "application/json",
+                json.dumps(doc, indent=1).encode())
     if route in ("/channelz", "/channelz/"):
         from tpurpc.rpc import channelz
 
@@ -444,7 +459,7 @@ def route_local(path: str) -> Tuple[int, str, bytes]:
     return (404, "text/plain",
             b"tpurpc-scope: /metrics /traces /channelz /healthz "
             b"/debug/flight /debug/stalls /debug/profile /debug/waterfall "
-            b"/debug/history /debug/slo /debug/seq\n")
+            b"/debug/history /debug/slo /debug/seq /debug/diagnose\n")
 
 
 def _response(status: int, ctype: str, body: bytes,

@@ -40,7 +40,7 @@ __all__ = [
     "sharded", "route_aggregate", "aggregate_metrics", "aggregate_flight",
     "aggregate_stalls", "aggregate_healthz", "aggregate_traces",
     "aggregate_profile", "aggregate_waterfall", "aggregate_slo",
-    "aggregate_history", "aggregate_seq",
+    "aggregate_history", "aggregate_seq", "aggregate_diagnose",
 ]
 
 # tpurpc-argus (ISSUE 14): counter-reset hardening. A shard worker that
@@ -418,6 +418,29 @@ def aggregate_seq() -> dict:
     return _odyssey.merge_seq_docs(docs, label="shard")
 
 
+def aggregate_diagnose(params: Optional[dict] = None) -> dict:
+    """tpurpc-oracle (ISSUE 20): every reachable shard's /debug/diagnose
+    merged — hypotheses re-combined by cause across workers, evidence
+    rows shard-tagged, cross-shard corroboration surfaced (the pure
+    merge lives in :func:`tpurpc.obs.diagnose.merge_diagnose_docs`,
+    shared with the fleet collector's /fleet/diagnose)."""
+    from tpurpc.obs import diagnose as _diagnose
+
+    want = (params or {}).get("symptom")
+    path = "/debug/diagnose?local=1"
+    if want:
+        path += f"&symptom={want}"
+    docs: Dict[str, dict] = {}
+    for k, status, body in _each_shard(path):
+        if status != 200:
+            continue
+        try:
+            docs[str(k)] = json.loads(body)
+        except ValueError:
+            continue
+    return _diagnose.merge_diagnose_docs(docs, label="shard")
+
+
 def aggregate_history() -> dict:
     """Per-shard tsdb inventories (each worker samples its OWN registry —
     series merge happens at query time via the shard key, like /traces)."""
@@ -538,6 +561,14 @@ def route_aggregate(route: str, params: dict
         if route in ("/debug/stalls", "/debug/stalls/"):
             return (200, "application/json",
                     json.dumps(aggregate_stalls(), indent=1).encode())
+        if route in ("/debug/diagnose", "/debug/diagnose/"):
+            doc = aggregate_diagnose(params)
+            if params.get("text"):
+                from tpurpc.obs import diagnose as _diagnose
+
+                return 200, "text/plain", _diagnose.render_text(doc).encode()
+            return (200, "application/json",
+                    json.dumps(doc, indent=1).encode())
         if route in ("/healthz", "/health"):
             status, body = aggregate_healthz()
             return status, "text/plain", body

@@ -346,6 +346,26 @@ class Tsdb:
         with self._lock:
             return self._tier_for(window_s).points(name, since)
 
+    def snapshot_windows(self, window_s: Optional[float] = None,
+                         now_ns: Optional[int] = None
+                         ) -> Dict[str, List[Tuple[int, float]]]:
+        """tpurpc-oracle: every series' trailing window in ONE lock
+        acquisition — the diagnosis engine's change-point scan needs a
+        consistent cross-series view (per-series ``window()`` calls
+        could straddle a sampler tick and skew onsets across series).
+        Defaults to the fine window; empty series are omitted."""
+        span = window_s if window_s is not None else self.fine_window_s
+        now = now_ns if now_ns is not None else time.monotonic_ns()
+        since = now - int(span * 1e9)
+        out: Dict[str, List[Tuple[int, float]]] = {}
+        with self._lock:
+            tier = self._tier_for(span)
+            for name in self._kinds:
+                pts = tier.points(name, since)
+                if pts:
+                    out[name] = pts
+        return out
+
     def rate(self, name: str, window_s: float,
              now_ns: Optional[int] = None) -> float:
         """Per-second rate of a cumulative series over the window: the sum

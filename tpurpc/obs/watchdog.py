@@ -163,8 +163,13 @@ class StallWatchdog:
         if not self.enabled:
             return None
         tok = next(self._tokens)
+        # [method, t0, trace_id, kind, tripped-stages] — the last slot
+        # records which stages already paged for THIS call (tpurpc-oracle:
+        # a diagnosis that sharpens, e.g. rendezvous -> native-ctrl-frozen
+        # once the C evidence lands, re-trips under the sharper stage so
+        # the trip hooks capture the better story; each stage at most once)
         self._inflight[tok] = [method, time.monotonic_ns(), trace_id, kind,
-                               False]
+                               set()]
         if self._thread is None:
             self._ensure_thread()
         return tok
@@ -252,9 +257,10 @@ class StallWatchdog:
         sweeps) — the daemon loop calls it on the configured cadence."""
         now = now_ns if now_ns is not None else time.monotonic_ns()
         active: List[dict] = []
+        to_trip: List[tuple] = []
         evidence = None
         for tok, entry in list(self._inflight.items()):
-            method, t0, trace_id, kind, tripped = entry
+            method, t0, trace_id, kind, tripped_stages = entry
             age = now - t0
             if age < self._stall_bar_ns(method):
                 continue
@@ -266,14 +272,18 @@ class StallWatchdog:
                 "kind": kind,
                 "stage": stage,
                 "detail": detail,
+                # tpurpc-oracle: the same diagnosis as a structured
+                # object (stage + entity + evidence refs) — the prose
+                # above stays byte-identical for the text face
+                "cause": self._cause_struct(evidence, stage),
                 "age_s": round(age / 1e9, 3),
                 "trace_id": f"{trace_id:016x}" if trace_id else None,
                 "since_ns": t0,
             }
             active.append(diag)
-            if not tripped:
-                entry[4] = True
-                self._trip(diag, trace_id, age)
+            if stage not in tripped_stages:
+                tripped_stages.add(stage)
+                to_trip.append((diag, trace_id, age))
         self._active = active
         if active:
             for d in active:
@@ -283,6 +293,11 @@ class StallWatchdog:
                         "since_ns") != d["since_ns"] or \
                         self._history[-1].get("stage") != d["stage"]:
                     self._history.append(done)
+        # trips fire AFTER the snapshot state is updated: a trip hook
+        # (the bundle writer, tpurpc-oracle's diagnosis) that reads
+        # ``snapshot()`` must see the diagnosis that tripped it
+        for diag, trace_id, age in to_trip:
+            self._trip(diag, trace_id, age)
         return active
 
     def _trip(self, diag: dict, trace_id: int, age_ns: int) -> None:
@@ -324,6 +339,8 @@ class StallWatchdog:
             "kind": "external",
             "stage": stage,
             "detail": detail,
+            # an external verifier supplies no flight-edge evidence
+            "cause": {"stage": stage, "entity": None, "evidence": []},
             "age_s": 0.0,
             "trace_id": None,
             "since_ns": time.monotonic_ns(),
@@ -650,6 +667,84 @@ class StallWatchdog:
         return ("device-infer",
                 "no local transport anomaly: the call is in flight at the "
                 "peer (its handler/device is the long pole)")
+
+    def _cause_struct(self, ev: dict, stage: str) -> dict:
+        """tpurpc-oracle: the machine-readable twin of ``_attribute`` —
+        the stage, the entity (connection/link) the oldest witness names,
+        and ``[plane, ref, value]`` evidence rows citing the exact flight
+        edges / gauges the prose describes. ``diagnose.py`` consumes this
+        directly; the prose face stays untouched. ``device-infer`` (and
+        external trips) legitimately carry no local evidence."""
+        now = ev["now_ns"]
+        evidence: List[list] = []
+        entity: Optional[str] = None
+
+        def add_table(table, slug, tag_index=None):
+            nonlocal entity
+            for key, t in sorted(table.items(), key=lambda kv: kv[1])[:4]:
+                tag = key[tag_index] if tag_index is not None else key
+                name = _flight.tag_name(tag)
+                if entity is None:
+                    entity = name
+                evidence.append(
+                    ["flight", f"{slug}:{name}@{t}",
+                     round((now - t) / 1e9, 3)])
+
+        def add_gauge(name):
+            v = ev.get(name, 0)
+            if v:
+                evidence.append(["metrics", name, v])
+
+        if stage == "credit-starvation":
+            if ev.get("open_lease"):
+                evidence.append(
+                    ["flight", "lease-reserve-open", ev["open_lease"]])
+            add_table(ev.get("open_edges") or {}, "stall-edge", 1)
+            add_gauge("pairs_write_stalled")
+        elif stage == "peer-not-reading":
+            add_table(ev.get("open_edges") or {}, "stall-edge", 1)
+            add_gauge("pairs_write_stalled")
+        elif stage == "native-ctrl-frozen":
+            add_table(ev.get("open_nctrl") or {}, "nctrl-ring-full")
+        elif stage == "ctrl-ring":
+            add_table(ev.get("open_ctrl") or {}, "ctrl-ring-full")
+            add_gauge("ctrl_ring_backlog")
+            if not ev.get("open_ctrl"):
+                add_table(ev.get("open_rdv") or {}, "rdv-open", 0)
+        elif stage == "rendezvous":
+            add_table(ev.get("open_rdv") or {}, "rdv-open", 0)
+        elif stage == "native-pin-wait":
+            add_table(ev.get("open_pin") or {}, "pin-wait")
+        elif stage == "native-delivery":
+            add_table(ev.get("open_dlv") or {}, "dlv-stall")
+            add_gauge("native_dlv_depth")
+        elif stage == "native-rdv-fallback":
+            for t in (ev.get("native_fallbacks") or [])[-4:]:
+                evidence.append(
+                    ["flight", f"rdv-fallback@{t}",
+                     round((now - t) / 1e9, 3)])
+            add_gauge("native_fallback_total")
+        elif stage == "kv-swap":
+            add_table(ev.get("open_swap") or {}, "kv-swap-open", 0)
+        elif stage == "migration":
+            add_table(ev.get("open_mig") or {}, "mig-open", 0)
+        elif stage == "decode-step":
+            add_table(ev.get("open_step") or {}, "step-open")
+            add_gauge("decode_waiting")
+            if ev.get("last_step_end_ns"):
+                evidence.append(
+                    ["flight", f"last-step-end@{ev['last_step_end_ns']}",
+                     round((now - ev["last_step_end_ns"]) / 1e9, 3)])
+        elif stage == "h2-flow-control":
+            if ev.get("last_h2_ns"):
+                evidence.append(
+                    ["flight", f"h2-exhausted@{ev['last_h2_ns']}",
+                     round((now - ev["last_h2_ns"]) / 1e9, 3)])
+        elif stage == "batcher-wait":
+            add_gauge("batcher_queue_depth")
+        elif stage == "poller-wake":
+            add_gauge("pairs_msg_waiting")
+        return {"stage": stage, "entity": entity, "evidence": evidence}
 
     # -- export ---------------------------------------------------------------
 

@@ -1,0 +1,309 @@
+"""tpurpc-oracle smoke (ISSUE 20): induced fault -> correct rank-1
+diagnosis, live AND via offline bundle replay.
+
+Three distinct fault classes, each injected for real:
+
+* **credit-starvation** — an open send-lease (reserve without commit)
+  in the flight tail behind an in-flight call;
+* **device-infer** — a slow peer: a call in flight with a quiet
+  transport (no local anomaly to blame);
+* **native-ctrl-frozen** — TPURPC_TEST_FREEZE_NCTRL freezes the C drain
+  loop while a native client posts into an 8-slot ring (the real PR-19
+  freeze; on rigs without the native plane a rendezvous wedge — an aged
+  unanswered RDV_OFFER — substitutes as the third class).
+
+For each fault the smoke asserts: (1) the LIVE ``/debug/diagnose``
+route (through ``scrape._route``, the real dispatch) ranks the injected
+cause #1; (2) the watchdog trip auto-captured a bundle whose
+``diagnosis.json`` ranks it #1; (3) replaying that bundle offline
+through ``tpurpc.tools.diagnose`` machinery agrees — live and offline
+verdicts identical. Runs in one subprocess with
+GRPC_PLATFORM_TYPE=RDMA_BPEV (read at import) so the native freeze is
+real. Exit 0 = all faults diagnosed correctly both ways.
+
+    python -m tpurpc.tools.diagnose_smoke
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+
+def _top_cause(doc: dict):
+    hyps = doc.get("hypotheses") or []
+    return hyps[0]["cause"] if hyps else None
+
+
+def _live_doc():
+    from tpurpc.obs import scrape
+
+    status, _ctype, body = scrape._route("/debug/diagnose")
+    assert status == 200, status
+    return json.loads(body)
+
+
+def _bundle_order(name: str):
+    """Chronological sort key for bundle names: the trailing capture
+    sequence number is unpadded, so plain lexical order puts -9 after
+    -10 within one second."""
+    head, _, seq = name.rpartition("-")
+    try:
+        return (head, int(seq))
+    except ValueError:
+        return (name, 0)
+
+
+def _pick_bundle(expect: str, root: str, before: set,
+                 deadline_s: float = 10.0):
+    """Newest complete new bundle whose diagnosis names *expect* #1.
+
+    The watchdog's background sweeper keeps tripping (once per distinct
+    stage, on client AND server entries) while we read: a listed dir may
+    be mid-write (diagnosis.json is written late in capture), and
+    _enforce_caps may prune the very dir we just chose.  Earlier trips
+    in the SAME phase legitimately diagnose the coarser stage they saw
+    (the verdict sharpens as evidence ages), so the contract is: the
+    trip fired at *expect* ships a bundle that ranks it #1 — wait out
+    the write race for that newest bundle rather than trusting one
+    listing."""
+    from tpurpc.obs import bundle as obs_bundle
+
+    deadline = time.monotonic() + deadline_s
+    last_seen = None
+    while True:
+        new = sorted(
+            (n for n in obs_bundle.list_bundles(root) if n not in before),
+            key=_bundle_order)
+        for name in reversed(new):
+            path = os.path.join(root, name)
+            try:
+                with open(os.path.join(path, "diagnosis.json"),
+                          encoding="utf-8") as f:
+                    shipped = json.load(f)
+            except (OSError, ValueError):
+                continue  # mid-write or pruned underneath us
+            last_seen = (name, _top_cause(shipped))
+            if last_seen[1] == expect:
+                return path, shipped
+            break  # newest complete bundle predates the expect trip
+        if time.monotonic() > deadline:
+            raise AssertionError(
+                f"no complete bundle ranks {expect} #1 "
+                f"(newest complete: {last_seen}, new bundles: {new})")
+        time.sleep(0.1)
+
+
+def _check_fault(expect: str, root: str, before: set) -> None:
+    """Live rank-1 correct, trip bundle written, offline replay agrees."""
+    from tpurpc.obs import diagnose as obs_diagnose
+
+    live = _live_doc()
+    assert live.get("enabled"), live
+    sym = live.get("symptom") or {}
+    assert sym.get("stage") == expect, (expect, sym)
+    live_top = _top_cause(live)
+    assert live_top == expect, (
+        f"live rank-1 was {live_top}, wanted {expect}",
+        live.get("hypotheses"))
+    # the trip auto-captured a bundle carrying diagnosis.json
+    path, shipped = _pick_bundle(expect, root, before)
+    assert _top_cause(shipped) == expect, (
+        "diagnosis.json disagrees", _top_cause(shipped))
+    # offline replay through the same engine: identical verdict
+    offline = obs_diagnose.diagnose_bundle(path)
+    off_top = _top_cause(offline)
+    assert off_top == live_top == expect, (
+        f"offline rank-1 {off_top} != live {live_top}")
+    print(f"  [{expect}] live rank-1 OK, bundle "
+          f"{os.path.basename(path)} agrees offline "
+          f"(confidence {live['hypotheses'][0]['confidence']})")
+
+
+def fault_credit_starvation(root: str) -> None:
+    from tpurpc.obs import bundle as obs_bundle
+    from tpurpc.obs import flight, watchdog
+
+    wd = watchdog.get()
+    flight.RECORDER.reset()
+    wd.reset()
+    before = set(obs_bundle.list_bundles(root))
+    tag = flight.tag_for("pair:oracle-smoke")
+    flight.emit(flight.LEASE_RESERVE, tag, 4096)
+    tok = wd.call_started("/oracle/WedgedSend")
+    try:
+        time.sleep(3 * wd.min_stall_s)
+        wd.sweep_once()
+        _check_fault("credit-starvation", root, before)
+    finally:
+        wd.call_finished(tok)
+        flight.emit(flight.LEASE_COMMIT, tag, 4096)
+        wd.reset()
+
+
+def fault_device_infer(root: str) -> None:
+    from tpurpc.obs import bundle as obs_bundle
+    from tpurpc.obs import flight, watchdog
+
+    wd = watchdog.get()
+    flight.RECORDER.reset()
+    wd.reset()
+    before = set(obs_bundle.list_bundles(root))
+    tok = wd.call_started("/oracle/SlowPeer", kind="client")
+    try:
+        time.sleep(3 * wd.min_stall_s)
+        wd.sweep_once()
+        _check_fault("device-infer", root, before)
+    finally:
+        wd.call_finished(tok)
+        wd.reset()
+
+
+def fault_frozen_nctrl(root: str) -> None:
+    """The real PR-19 freeze: TPURPC_TEST_FREEZE_NCTRL is read LIVE by
+    the C drain loop; ring knobs are read at ring creation, so they are
+    set before the server/channel exist (by run_phases)."""
+    from tpurpc.obs import bundle as obs_bundle
+    from tpurpc.obs import flight, native_obs, watchdog
+    from tpurpc.rpc.channel import Channel
+    from tpurpc.rpc.server import Server, stream_stream_rpc_method_handler
+
+    wd = watchdog.get()
+    flight.RECORDER.reset()
+    native_obs.reset()
+    wd.reset()
+    before = set(obs_bundle.list_bundles(root))
+
+    srv = Server(max_workers=4)
+
+    def total(req_iter, ctx):
+        n = 0
+        for m in req_iter:
+            n += len(m)
+        yield str(n).encode()
+
+    srv.add_method("/oraclesmoke.S/Total",
+                   stream_stream_rpc_method_handler(total))
+    port = srv.add_insecure_port("127.0.0.1:0")
+    srv.start()
+    payload = bytes(512) * 4096  # 2 MiB: no standing grant covers it
+    try:
+        with Channel(f"127.0.0.1:{port}") as ch:
+            mc = ch.stream_stream("/oraclesmoke.S/Total")
+            list(mc(iter([b"warm"]), timeout=30))  # hello + ring adoption
+            os.environ["TPURPC_TEST_FREEZE_NCTRL"] = "1"
+            tok = wd.call_started("/oraclesmoke.S/Total", kind="client")
+            result: dict = {}
+
+            def stalled():
+                try:
+                    result["out"] = list(
+                        mc(iter([payload] * 8), timeout=120))
+                finally:
+                    wd.call_finished(tok)
+
+            t = threading.Thread(target=stalled)
+            t.start()
+            found = False
+            deadline = time.monotonic() + 30
+            while not found and time.monotonic() < deadline:
+                time.sleep(0.15)
+                found = any(d["stage"] == "native-ctrl-frozen"
+                            for d in wd.sweep_once())
+            assert found, ("watchdog never named native-ctrl-frozen",
+                           wd.active())
+            _check_fault("native-ctrl-frozen", root, before)
+            os.environ.pop("TPURPC_TEST_FREEZE_NCTRL", None)  # thaw
+            t.join(timeout=120)
+            assert not t.is_alive(), "frozen calls never completed"
+    finally:
+        os.environ.pop("TPURPC_TEST_FREEZE_NCTRL", None)
+        wd.reset()
+        srv.stop(grace=1)
+
+
+def fault_rendezvous_substitute(root: str) -> None:
+    """Third class on rigs without the native plane: an unanswered
+    rendezvous offer aged behind an in-flight call."""
+    from tpurpc.obs import bundle as obs_bundle
+    from tpurpc.obs import flight, watchdog
+
+    wd = watchdog.get()
+    flight.RECORDER.reset()
+    wd.reset()
+    before = set(obs_bundle.list_bundles(root))
+    tag = flight.tag_for("rdv:oracle-smoke")
+    flight.emit(flight.RDV_OFFER, tag, 7)
+    tok = wd.call_started("/oracle/BulkSend", kind="client")
+    try:
+        time.sleep(3 * wd.min_stall_s)
+        wd.sweep_once()
+        _check_fault("rendezvous", root, before)
+    finally:
+        wd.call_finished(tok)
+        flight.emit(flight.RDV_RELEASE, tag, 0, 7)
+        wd.reset()
+
+
+def run_phases() -> int:
+    from tpurpc.obs import bundle as obs_bundle
+    from tpurpc.obs import native_obs, tracing, watchdog
+
+    assert (os.environ.get("TPURPC_DIAGNOSE", "1") != "0"), \
+        "smoke needs the diagnosis plane on"
+    tracing.configure(0.0)
+    wd = watchdog.get()
+    wd.enabled = True
+    wd.min_stall_s = 0.25
+    wd.sweep_s = 0.1
+    root = tempfile.mkdtemp(prefix="tpurpc-diagnose-smoke-")
+    obs_bundle.enable(root, min_interval_s=0.0)
+    try:
+        fault_credit_starvation(root)
+        fault_device_infer(root)
+        if native_obs.available():
+            fault_frozen_nctrl(root)
+        else:
+            print("  (native plane unavailable: rendezvous wedge "
+                  "substitutes for the frozen-nctrl class)")
+            fault_rendezvous_substitute(root)
+    finally:
+        obs_bundle.disable()
+        wd.reset()
+    print("diagnose smoke: PASS (3 fault classes rank-1 correct, "
+          "live == bundle replay)")
+    return 0
+
+
+def main() -> int:
+    if "--phase" in sys.argv:
+        try:
+            return run_phases()
+        except Exception as exc:
+            print(f"diagnose smoke FAILED: {exc!r}", file=sys.stderr)
+            return 1
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env = dict(os.environ)
+    # ring knobs are read at ring creation; the freeze env is read live
+    env["GRPC_PLATFORM_TYPE"] = "RDMA_BPEV"
+    env["TPURPC_CTRL_RING_SLOTS"] = "8"
+    env["TPURPC_RENDEZVOUS_CLAIM_TIMEOUT_S"] = "0.5"
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONPATH"] = here + os.pathsep + env.get("PYTHONPATH", "")
+    rc = subprocess.run(
+        [sys.executable, "-m", "tpurpc.tools.diagnose_smoke", "--phase"],
+        env=env, timeout=300).returncode
+    if rc != 0:
+        print("diagnose smoke FAILED")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,7 +10,10 @@ and — tpurpc-odyssey (ISSUE 15) — a ``seq`` pane fed by ``/debug/seq``
 (top sequences by device step-ms and KV byte-seconds, per-account cost
 rollup), and — tpurpc-xray (ISSUE 19) — a ``natv`` pane from the
 ``native_*`` series the scrape mirrors out of the C core's shm metrics
-table (rdv ledger, ctrl drain cadence, fallbacks, pin/delivery pressure).
+table (rdv ledger, ctrl drain cadence, fallbacks, pin/delivery pressure),
+and — tpurpc-oracle (ISSUE 20) — a ``diag`` pane fed by
+``/debug/diagnose``: when a symptom is active, the top ranked cause with
+confidence and the suggested action.
 
     python -m tpurpc.tools.top HOST:PORT [--interval 1.0] [--once]
 
@@ -102,6 +105,17 @@ def fetch_seq(target: str, timeout: float = 5.0) -> Optional[dict]:
         return None
 
 
+def fetch_diagnose(target: str, timeout: float = 5.0) -> Optional[dict]:
+    """tpurpc-oracle /debug/diagnose (ranked causal hypotheses for the
+    active symptom), or None when unreachable / pre-oracle server."""
+    try:
+        with urllib.request.urlopen(f"http://{target}/debug/diagnose",
+                                    timeout=timeout) as resp:
+            return json.loads(resp.read().decode("utf-8", "replace"))
+    except Exception:
+        return None
+
+
 def _val(m: Dict, name: str, labels: str = "") -> float:
     return m.get((name, labels), 0.0)
 
@@ -123,7 +137,8 @@ def render(cur: Dict, prev: Optional[Dict], dt: float,
            target: str, stalls: Optional[dict] = None,
            waterfall: Optional[dict] = None,
            slo: Optional[dict] = None,
-           seq: Optional[dict] = None) -> str:
+           seq: Optional[dict] = None,
+           diagnose: Optional[dict] = None) -> str:
     P = "tpurpc_"
     Q50 = 'quantile="0.5"'
     Q99 = 'quantile="0.99"'
@@ -292,6 +307,23 @@ def render(cur: Dict, prev: Optional[Dict], dt: float,
                 f"kv {b.get('kv_byte_s', 0):>8.1f}B·s  "
                 f"preempt {int(b.get('preempts', 0))}  "
                 f"mig {int(b.get('migrations', 0))}")
+    # tpurpc-oracle diagnosis pane (/debug/diagnose): when any symptom is
+    # active, the top ranked cause with its confidence and the action
+    # hint — the "why", one line under all the "what" panes above
+    if diagnose is not None and diagnose.get("enabled"):
+        sym = diagnose.get("symptom") or {}
+        hyps = diagnose.get("hypotheses") or []
+        if sym.get("stage") and hyps:
+            top = hyps[0]
+            lines.append(
+                f"diag  symptom {sym.get('stage', '?'):<22} "
+                f"-> {top.get('cause', '?'):<22} "
+                f"conf {top.get('confidence', 0):.2f}  "
+                f"({len(top.get('evidence', ()))} evidence, "
+                f"{len(hyps)} hypotheses)")
+            act = top.get("actionable")
+            if act:
+                lines.append(f"      action: {act}")
     return "\n".join(lines)
 
 
@@ -319,9 +351,10 @@ def main(argv=None) -> int:
         wf = fetch_waterfall(args.target)
         slo = fetch_slo(args.target)
         seq = fetch_seq(args.target)
+        diag = fetch_diagnose(args.target)
         now = time.monotonic()
         out = render(cur, prev, now - t_prev, args.target, stalls=stalls,
-                     waterfall=wf, slo=slo, seq=seq)
+                     waterfall=wf, slo=slo, seq=seq, diagnose=diag)
         if args.once:
             print(out)
             return 0
